@@ -1,0 +1,101 @@
+"""Benchmark harness — BASELINE.json config #1: multiclass Accuracy update loop.
+
+Measures stateful metric-update throughput (updates/sec/chip) of the jitted, donated
+update path on the available accelerator, against a reference-equivalent torch CPU loop
+(the reference library is pure torch ops; no CUDA in this image — see BASELINE.md).
+
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+BATCH = 65536
+NUM_CLASSES = 5
+WARMUP = 5
+ITERS = 200
+
+
+def bench_ours() -> float:
+    import jax
+    import jax.numpy as jnp
+
+    from torchmetrics_tpu.classification import MulticlassAccuracy
+
+    rng = np.random.default_rng(0)
+    preds = jnp.asarray(rng.normal(size=(BATCH, NUM_CLASSES)).astype(np.float32))
+    target = jnp.asarray(rng.integers(0, NUM_CLASSES, BATCH, dtype=np.int32))
+
+    metric = MulticlassAccuracy(num_classes=NUM_CLASSES, average="micro", validate_args=False)
+    for _ in range(WARMUP):
+        metric.update(preds, target)
+    jax.block_until_ready(metric._state)
+
+    start = time.perf_counter()
+    for _ in range(ITERS):
+        metric.update(preds, target)
+    jax.block_until_ready(metric._state)
+    elapsed = time.perf_counter() - start
+    return ITERS / elapsed
+
+
+def bench_torch_baseline() -> float:
+    """Reference-equivalent stateful loop in pure torch (CPU): argmax + one-hot
+    stat-score accumulation, mirroring reference
+    functional/classification/stat_scores.py multiclass update semantics."""
+    import torch
+
+    rng = np.random.default_rng(0)
+    preds = torch.from_numpy(rng.normal(size=(BATCH, NUM_CLASSES)).astype(np.float32))
+    target = torch.from_numpy(rng.integers(0, NUM_CLASSES, BATCH, dtype=np.int64))
+
+    tp = torch.zeros(NUM_CLASSES, dtype=torch.long)
+    fp = torch.zeros(NUM_CLASSES, dtype=torch.long)
+    fn = torch.zeros(NUM_CLASSES, dtype=torch.long)
+    tn = torch.zeros(NUM_CLASSES, dtype=torch.long)
+
+    def update() -> None:
+        nonlocal tp, fp, fn, tn
+        with torch.no_grad():
+            p = preds.argmax(-1)
+            unique_mapping = target * NUM_CLASSES + p
+            bins = torch.bincount(unique_mapping, minlength=NUM_CLASSES**2).reshape(NUM_CLASSES, NUM_CLASSES)
+            tp = tp + bins.diagonal()
+            fp = fp + bins.sum(0) - bins.diagonal()
+            fn = fn + bins.sum(1) - bins.diagonal()
+            tn = tn + bins.sum() - bins.sum(0) - bins.sum(1) + bins.diagonal()
+
+    for _ in range(WARMUP):
+        update()
+    start = time.perf_counter()
+    for _ in range(ITERS):
+        update()
+    elapsed = time.perf_counter() - start
+    return ITERS / elapsed
+
+
+def main() -> None:
+    ours = bench_ours()
+    try:
+        baseline = bench_torch_baseline()
+    except Exception:
+        baseline = float("nan")
+    vs = ours / baseline if baseline == baseline and baseline > 0 else float("nan")
+    print(
+        json.dumps(
+            {
+                "metric": "multiclass_accuracy_updates_per_sec",
+                "value": round(ours, 2),
+                "unit": "updates/s (batch=65536, C=5)",
+                "vs_baseline": round(vs, 3) if vs == vs else None,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

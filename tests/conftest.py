@@ -1,0 +1,38 @@
+"""Test session config.
+
+Multi-device-without-a-cluster (SURVEY §4): the reference spins a 2-proc gloo pool;
+here XLA gives us an 8-device CPU mesh in one process — same trick, no cluster. Must run
+before jax initializes a backend (the axon sitecustomize may have registered a TPU
+plugin; forcing the cpu platform keeps tests hermetic and runnable anywhere).
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+NUM_PROCESSES = 2  # parity constant with reference conftest.py:26 (unused: no proc pool needed)
+NUM_DEVICES = 8
+NUM_BATCHES = 4
+BATCH_SIZE = 32
+NUM_CLASSES = 5
+EXTRA_DIM = 3
+THRESHOLD = 0.5
+
+
+def seed_all(seed: int = 42) -> np.random.Generator:
+    return np.random.default_rng(seed)
+
+
+@pytest.fixture(autouse=True)
+def _assert_cpu_devices():
+    assert jax.devices()[0].platform == "cpu"
+    assert len(jax.devices()) == NUM_DEVICES
+    yield

@@ -1,0 +1,187 @@
+"""MetricTester-equivalent harness.
+
+Parity with reference ``tests/unittests/_helpers/testers.py:142-324``: every metric is
+exercised functional + stateful + multi-device against an independent reference
+implementation (sklearn/scipy/numpy), checking per-batch forward values, the final
+aggregated value over all batches, forward-vs-update+compute equivalence, clone
+identity, pickling, reset, and merge_state. Multi-device modes:
+
+- ``merge``:   one metric instance per simulated rank, folded with ``merge_state``
+               (commless map-reduce plane).
+- ``ingraph``: pure ``update_state`` inside ``shard_map`` over the 8-device CPU mesh
+               with per-leaf collective reduction (the pjit/ICI plane).
+"""
+
+from __future__ import annotations
+
+import pickle
+from functools import partial
+from typing import Any, Callable, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+ATOL = 1e-6
+
+
+def _assert_allclose(tm_result, ref_result, atol: float = ATOL, msg: str = ""):
+    if isinstance(tm_result, dict):
+        assert isinstance(ref_result, dict), msg
+        for k in tm_result:
+            _assert_allclose(tm_result[k], ref_result[k], atol, msg=f"{msg} key={k}")
+        return
+    if isinstance(tm_result, (list, tuple)) and not hasattr(tm_result, "shape"):
+        for a, b in zip(tm_result, ref_result):
+            _assert_allclose(a, b, atol, msg)
+        return
+    np.testing.assert_allclose(
+        np.asarray(tm_result, dtype=np.float64),
+        np.asarray(ref_result, dtype=np.float64),
+        atol=atol,
+        rtol=1e-5,
+        err_msg=msg,
+    )
+
+
+class MetricTester:
+    """Drives functional / class / multi-device parity checks."""
+
+    atol: float = ATOL
+
+    def run_functional_metric_test(
+        self,
+        preds,
+        target,
+        metric_functional: Callable,
+        reference_metric: Callable,
+        metric_args: Optional[dict] = None,
+        atol: Optional[float] = None,
+    ) -> None:
+        """Per-batch functional result vs reference (testers.py:463)."""
+        metric_args = metric_args or {}
+        atol = atol or self.atol
+        num_batches = preds.shape[0]
+        for i in range(num_batches):
+            tm_result = metric_functional(jnp.asarray(preds[i]), jnp.asarray(target[i]), **metric_args)
+            ref_result = reference_metric(np.asarray(preds[i]), np.asarray(target[i]))
+            _assert_allclose(tm_result, ref_result, atol, msg=f"batch {i} functional mismatch")
+
+    def run_class_metric_test(
+        self,
+        preds,
+        target,
+        metric_class: Callable,
+        reference_metric: Callable,
+        metric_args: Optional[dict] = None,
+        check_batch: bool = True,
+        atol: Optional[float] = None,
+    ) -> None:
+        """Stateful loop: forward batch values + final aggregate vs reference
+        (testers.py:142-324), plus clone/pickle/reset/merge_state invariants."""
+        metric_args = metric_args or {}
+        atol = atol or self.atol
+        metric = metric_class(**metric_args)
+
+        # clone identity (testers.py:208)
+        cloned = metric.clone()
+        assert type(cloned) is type(metric)
+
+        # pickling round-trip (testers.py:221)
+        pickled = pickle.dumps(metric)
+        unpickled = pickle.loads(pickled)
+        assert type(unpickled) is type(metric)
+
+        num_batches = preds.shape[0]
+        for i in range(num_batches):
+            batch_val = metric(jnp.asarray(preds[i]), jnp.asarray(target[i]))  # forward
+            if check_batch:
+                ref_batch = reference_metric(np.asarray(preds[i]), np.asarray(target[i]))
+                _assert_allclose(batch_val, ref_batch, atol, msg=f"batch {i} forward mismatch")
+
+        total_ref = reference_metric(
+            np.concatenate([np.asarray(p) for p in preds]), np.concatenate([np.asarray(t) for t in target])
+        )
+        _assert_allclose(metric.compute(), total_ref, atol, msg="final compute mismatch")
+
+        # update+compute equivalence with forward path (testers.py:231-239)
+        metric2 = metric_class(**metric_args)
+        for i in range(num_batches):
+            metric2.update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+        _assert_allclose(metric2.compute(), total_ref, atol, msg="update+compute mismatch")
+
+        # reset restores defaults (then recompute from scratch still works)
+        metric2.reset()
+        metric2.update(jnp.asarray(preds[0]), jnp.asarray(target[0]))
+        ref0 = reference_metric(np.asarray(preds[0]), np.asarray(target[0]))
+        _assert_allclose(metric2.compute(), ref0, atol, msg="post-reset compute mismatch")
+
+    def run_merge_state_test(
+        self,
+        preds,
+        target,
+        metric_class: Callable,
+        reference_metric: Callable,
+        metric_args: Optional[dict] = None,
+        num_ranks: int = 2,
+        atol: Optional[float] = None,
+    ) -> None:
+        """Simulated map-reduce: per-rank instances folded via merge_state
+        (reference metric.py:404 semantics, bases/test_ddp.py scenarios)."""
+        metric_args = metric_args or {}
+        atol = atol or self.atol
+        num_batches = preds.shape[0]
+        rank_metrics = [metric_class(**metric_args) for _ in range(num_ranks)]
+        for i in range(num_batches):
+            rank = i % num_ranks
+            rank_metrics[rank].update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+        main = rank_metrics[0]
+        for other in rank_metrics[1:]:
+            main.merge_state(other)
+        total_ref = reference_metric(
+            np.concatenate([np.asarray(p) for p in preds]), np.concatenate([np.asarray(t) for t in target])
+        )
+        _assert_allclose(main.compute(), total_ref, atol, msg="merge_state compute mismatch")
+
+    def run_ingraph_sharded_test(
+        self,
+        preds,
+        target,
+        metric_class: Callable,
+        reference_metric: Callable,
+        metric_args: Optional[dict] = None,
+        atol: Optional[float] = None,
+    ) -> None:
+        """In-graph SPMD plane: pure update_state inside shard_map over the 8-device
+        CPU mesh, reduced with per-leaf collectives (psum/pmax/...)."""
+        metric_args = metric_args or {}
+        atol = atol or self.atol
+        metric = metric_class(**metric_args)
+        if metric._list_state_names:
+            pytest.skip("concat-state metric: no fully in-graph path")
+        n_dev = len(jax.devices())
+        mesh = jax.make_mesh((n_dev,), ("dp",))
+
+        preds_all = jnp.concatenate([jnp.asarray(p) for p in preds], axis=0)
+        target_all = jnp.concatenate([jnp.asarray(t) for t in target], axis=0)
+        # pad so the leading axis divides the mesh
+        rem = (-preds_all.shape[0]) % n_dev
+        assert rem == 0, "test data must divide the mesh for this harness"
+
+        def shard_fn(p, t):
+            state = metric.update_state(metric.init_state(), p, t)
+            return metric.reduce_state(state, "dp")
+
+        fn = jax.shard_map(
+            shard_fn,
+            mesh=mesh,
+            in_specs=(P("dp"), P("dp")),
+            out_specs=P(),
+            check_vma=False,
+        )
+        synced = jax.jit(fn)(preds_all, target_all)
+        value = metric.compute_state(synced)
+        total_ref = reference_metric(np.asarray(preds_all), np.asarray(target_all))
+        _assert_allclose(value, total_ref, atol, msg="in-graph sharded compute mismatch")

@@ -1,0 +1,98 @@
+"""Aggregator tests (reference tests/unittests/bases/test_aggregation.py)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu import CatMetric, MaxMetric, MeanMetric, MinMetric, RunningMean, RunningSum, SumMetric
+from conftest import seed_all
+
+
+def test_sum_metric():
+    m = SumMetric()
+    m.update(jnp.asarray([1.0, 2.0]))
+    m.update(3.0)
+    assert float(m.compute()) == 6.0
+
+
+def test_mean_metric_weighted():
+    m = MeanMetric()
+    m.update(jnp.asarray([1.0, 3.0]))
+    m.update(5.0, weight=2.0)
+    # (1 + 3 + 5*2) / (1 + 1 + 2)
+    assert float(m.compute()) == pytest.approx(14 / 4)
+
+
+def test_max_min_metric():
+    mx, mn = MaxMetric(), MinMetric()
+    for v in ([1.0, 5.0], [3.0], [-2.0]):
+        mx.update(jnp.asarray(v))
+        mn.update(jnp.asarray(v))
+    assert float(mx.compute()) == 5.0
+    assert float(mn.compute()) == -2.0
+
+
+def test_cat_metric():
+    m = CatMetric()
+    m.update(jnp.asarray([1.0, 2.0]))
+    m.update(3.0)
+    np.testing.assert_array_equal(np.asarray(m.compute()), [1.0, 2.0, 3.0])
+
+
+def test_nan_error():
+    m = SumMetric(nan_strategy="error")
+    with pytest.raises(RuntimeError, match="nan"):
+        m.update(jnp.asarray([1.0, jnp.nan]))
+
+
+def test_nan_warn_ignores():
+    m = SumMetric(nan_strategy="warn")
+    with pytest.warns(UserWarning):
+        m.update(jnp.asarray([1.0, jnp.nan, 2.0]))
+    assert float(m.compute()) == 3.0
+
+
+def test_nan_impute():
+    m = SumMetric(nan_strategy=10.0)
+    m.update(jnp.asarray([1.0, jnp.nan]))
+    assert float(m.compute()) == 11.0
+
+
+def test_nan_ignore_mean():
+    m = MeanMetric(nan_strategy="ignore")
+    m.update(jnp.asarray([2.0, jnp.nan, 4.0]))
+    assert float(m.compute()) == 3.0
+
+
+def test_running_mean_window():
+    m = RunningMean(window=3)
+    vals = [1.0, 2.0, 3.0, 4.0, 5.0]
+    for v in vals:
+        m.update(v)
+    # last 3 batch means: 3, 4, 5
+    assert float(m.compute()) == pytest.approx(4.0)
+
+
+def test_running_sum_window():
+    m = RunningSum(window=2)
+    for v in ([1.0, 1.0], [2.0], [3.0]):
+        m.update(jnp.asarray(v))
+    # last 2 batch sums: 2, 3
+    assert float(m.compute()) == 5.0
+
+
+def test_running_partial_window():
+    m = RunningMean(window=5)
+    m.update(2.0)
+    m.update(4.0)
+    assert float(m.compute()) == 3.0
+
+
+def test_aggregators_compose_in_collection():
+    from torchmetrics_tpu import MetricCollection
+
+    col = MetricCollection({"sum": SumMetric(), "mean": MeanMetric()}, compute_groups=False)
+    col.update(jnp.asarray([2.0, 4.0]))
+    out = col.compute()
+    assert float(out["sum"]) == 6.0
+    assert float(out["mean"]) == 3.0

@@ -1,0 +1,273 @@
+"""Stat-scores family vs sklearn (reference tests/unittests/classification/test_accuracy.py
+et al: golden rule — every metric tested against an independent reference over random
+inputs, functional + class + multi-device)."""
+
+from functools import partial
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn import metrics as sk
+
+import torchmetrics_tpu.functional as F
+from torchmetrics_tpu.classification import (
+    BinaryAccuracy,
+    BinaryF1Score,
+    BinaryPrecision,
+    BinaryRecall,
+    BinarySpecificity,
+    MulticlassAccuracy,
+    MulticlassF1Score,
+    MulticlassFBetaScore,
+    MulticlassPrecision,
+    MulticlassRecall,
+    MultilabelAccuracy,
+    MultilabelF1Score,
+    MultilabelPrecision,
+    MultilabelRecall,
+)
+from conftest import BATCH_SIZE, NUM_BATCHES, NUM_CLASSES, THRESHOLD, seed_all
+from helpers import MetricTester
+
+_rng = seed_all(7)
+
+# binary case: probs in [0,1]
+_bin_preds = _rng.random((NUM_BATCHES, BATCH_SIZE)).astype(np.float32)
+_bin_target = _rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE))
+
+# multiclass case: logits (N, C)
+_mc_logits = _rng.normal(size=(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)).astype(np.float32)
+_mc_target = _rng.integers(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE))
+
+# multilabel case: probs (N, C)
+_ml_preds = _rng.random((NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)).astype(np.float32)
+_ml_target = _rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES))
+
+
+def _sk_binary(fn):
+    def ref(preds, target):
+        return fn(target, (preds >= THRESHOLD).astype(int))
+
+    return ref
+
+
+def _sk_multiclass(fn):
+    def ref(preds, target):
+        return fn(target, preds.argmax(-1))
+
+    return ref
+
+
+def _sk_multilabel(fn):
+    def ref(preds, target):
+        return fn(target.reshape(-1, NUM_CLASSES), (preds >= THRESHOLD).astype(int).reshape(-1, NUM_CLASSES))
+
+    return ref
+
+
+_mc_labels = list(range(NUM_CLASSES))
+
+BINARY_CASES = [
+    (BinaryAccuracy, F.binary_accuracy, _sk_binary(sk.accuracy_score), {}),
+    (BinaryPrecision, F.binary_precision, _sk_binary(partial(sk.precision_score, zero_division=0)), {}),
+    (BinaryRecall, F.binary_recall, _sk_binary(partial(sk.recall_score, zero_division=0)), {}),
+    (BinaryF1Score, F.binary_f1_score, _sk_binary(partial(sk.f1_score, zero_division=0)), {}),
+    (
+        BinarySpecificity,
+        F.binary_specificity,
+        _sk_binary(lambda t, p: sk.recall_score(1 - np.asarray(t), 1 - np.asarray(p), zero_division=0)),
+        {},
+    ),
+]
+
+
+@pytest.mark.parametrize("metric_class,functional,ref,extra", BINARY_CASES)
+class TestBinaryFamily(MetricTester):
+    def test_functional(self, metric_class, functional, ref, extra):
+        self.run_functional_metric_test(_bin_preds, _bin_target, functional, ref, extra)
+
+    def test_class(self, metric_class, functional, ref, extra):
+        self.run_class_metric_test(_bin_preds, _bin_target, metric_class, ref, extra)
+
+    def test_merge(self, metric_class, functional, ref, extra):
+        self.run_merge_state_test(_bin_preds, _bin_target, metric_class, ref, extra)
+
+    def test_ingraph(self, metric_class, functional, ref, extra):
+        self.run_ingraph_sharded_test(_bin_preds, _bin_target, metric_class, ref, extra)
+
+
+def _mc_cases():
+    cases = []
+    for average in ["micro", "macro", "weighted", None]:
+        sk_avg = average if average else None
+        cases.append((
+            MulticlassAccuracy,
+            partial(F.multiclass_accuracy, num_classes=NUM_CLASSES, average=average),
+            _sk_multiclass(
+                sk.accuracy_score
+                if average == "micro"
+                else partial(sk.recall_score, average=sk_avg, labels=_mc_labels, zero_division=0)
+            ),
+            {"num_classes": NUM_CLASSES, "average": average},
+            f"acc-{average}",
+        ))
+        for metric_class, functional, sk_fn, nm in [
+            (MulticlassPrecision, F.multiclass_precision, sk.precision_score, "prec"),
+            (MulticlassRecall, F.multiclass_recall, sk.recall_score, "rec"),
+            (MulticlassF1Score, F.multiclass_f1_score, sk.f1_score, "f1"),
+        ]:
+            cases.append((
+                metric_class,
+                partial(functional, num_classes=NUM_CLASSES, average=average),
+                _sk_multiclass(partial(sk_fn, average=sk_avg, labels=_mc_labels, zero_division=0)),
+                {"num_classes": NUM_CLASSES, "average": average},
+                f"{nm}-{average}",
+            ))
+    cases.append((
+        MulticlassFBetaScore,
+        partial(F.multiclass_fbeta_score, beta=2.0, num_classes=NUM_CLASSES, average="macro"),
+        _sk_multiclass(partial(sk.fbeta_score, beta=2.0, average="macro", labels=_mc_labels, zero_division=0)),
+        {"beta": 2.0, "num_classes": NUM_CLASSES, "average": "macro"},
+        "fbeta2-macro",
+    ))
+    return cases
+
+
+_MC_CASES = _mc_cases()
+
+
+@pytest.mark.parametrize(
+    "metric_class,functional,ref,extra", [c[:4] for c in _MC_CASES], ids=[c[4] for c in _MC_CASES]
+)
+class TestMulticlassFamily(MetricTester):
+    def test_functional(self, metric_class, functional, ref, extra):
+        self.run_functional_metric_test(_mc_logits, _mc_target, functional, ref, {})
+
+    def test_class(self, metric_class, functional, ref, extra):
+        self.run_class_metric_test(_mc_logits, _mc_target, metric_class, ref, extra)
+
+    def test_merge(self, metric_class, functional, ref, extra):
+        self.run_merge_state_test(_mc_logits, _mc_target, metric_class, ref, extra)
+
+    def test_ingraph(self, metric_class, functional, ref, extra):
+        self.run_ingraph_sharded_test(_mc_logits, _mc_target, metric_class, ref, extra)
+
+
+ML_CASES = [
+    (
+        MultilabelAccuracy,
+        partial(F.multilabel_accuracy, num_labels=NUM_CLASSES, average="macro"),
+        # sklearn has no per-label accuracy avg; macro accuracy over labels == mean over
+        # label columns of accuracy
+        _sk_multilabel(
+            lambda t, p: np.mean([sk.accuracy_score(t[:, i], p[:, i]) for i in range(NUM_CLASSES)])
+        ),
+        {"num_labels": NUM_CLASSES, "average": "macro"},
+        "mlacc-macro",
+    ),
+    (
+        MultilabelPrecision,
+        partial(F.multilabel_precision, num_labels=NUM_CLASSES, average="macro"),
+        _sk_multilabel(partial(sk.precision_score, average="macro", zero_division=0)),
+        {"num_labels": NUM_CLASSES, "average": "macro"},
+        "mlprec-macro",
+    ),
+    (
+        MultilabelRecall,
+        partial(F.multilabel_recall, num_labels=NUM_CLASSES, average="micro"),
+        _sk_multilabel(partial(sk.recall_score, average="micro", zero_division=0)),
+        {"num_labels": NUM_CLASSES, "average": "micro"},
+        "mlrec-micro",
+    ),
+    (
+        MultilabelF1Score,
+        partial(F.multilabel_f1_score, num_labels=NUM_CLASSES, average="weighted"),
+        _sk_multilabel(partial(sk.f1_score, average="weighted", zero_division=0)),
+        {"num_labels": NUM_CLASSES, "average": "weighted"},
+        "mlf1-weighted",
+    ),
+]
+
+
+@pytest.mark.parametrize(
+    "metric_class,functional,ref,extra", [c[:4] for c in ML_CASES], ids=[c[4] for c in ML_CASES]
+)
+class TestMultilabelFamily(MetricTester):
+    def test_functional(self, metric_class, functional, ref, extra):
+        self.run_functional_metric_test(_ml_preds, _ml_target, functional, ref, {})
+
+    def test_class(self, metric_class, functional, ref, extra):
+        self.run_class_metric_test(_ml_preds, _ml_target, metric_class, ref, extra)
+
+    def test_merge(self, metric_class, functional, ref, extra):
+        self.run_merge_state_test(_ml_preds, _ml_target, metric_class, ref, extra)
+
+    def test_ingraph(self, metric_class, functional, ref, extra):
+        self.run_ingraph_sharded_test(_ml_preds, _ml_target, metric_class, ref, extra)
+
+
+def test_ignore_index_binary():
+    target = np.array([0, 1, -1, 1, 0, -1])
+    preds = np.array([0.9, 0.8, 0.7, 0.3, 0.1, 0.9])
+    acc = float(F.binary_accuracy(jnp.asarray(preds), jnp.asarray(target), ignore_index=-1))
+    # valid: (0,0.9)->wrong, (1,0.8)->right, (1,0.3)->wrong, (0,0.1)->right
+    assert acc == pytest.approx(0.5)
+
+
+def test_ignore_index_multiclass():
+    target = np.array([0, 1, 2, -1, 1])
+    preds = np.array([0, 1, 1, 2, 1])
+    acc = float(F.multiclass_accuracy(jnp.asarray(preds), jnp.asarray(target), num_classes=3, average="micro", ignore_index=-1))
+    assert acc == pytest.approx(3 / 4)
+
+
+def test_top_k_accuracy():
+    preds = np.asarray([
+        [0.5, 0.3, 0.2],
+        [0.1, 0.6, 0.3],
+        [0.2, 0.3, 0.5],
+    ], dtype=np.float32)
+    target = np.asarray([1, 1, 0])
+    top1 = float(F.multiclass_accuracy(jnp.asarray(preds), jnp.asarray(target), num_classes=3, average="micro", top_k=1))
+    top2 = float(F.multiclass_accuracy(jnp.asarray(preds), jnp.asarray(target), num_classes=3, average="micro", top_k=2))
+    assert top1 == pytest.approx(1 / 3)
+    assert top2 == pytest.approx(2 / 3)
+
+
+def test_samplewise_multidim():
+    rng = seed_all(3)
+    preds = rng.integers(0, 2, (4, 10))
+    target = rng.integers(0, 2, (4, 10))
+    out = F.binary_accuracy(jnp.asarray(preds), jnp.asarray(target), multidim_average="samplewise")
+    assert out.shape == (4,)
+    expected = (preds == target).mean(-1)
+    np.testing.assert_allclose(np.asarray(out), expected, atol=1e-6)
+
+
+def test_stat_scores_output_shape():
+    out = F.multiclass_stat_scores(
+        jnp.asarray(_mc_logits[0]), jnp.asarray(_mc_target[0]), num_classes=NUM_CLASSES, average=None
+    )
+    assert out.shape == (NUM_CLASSES, 5)
+    out_micro = F.multiclass_stat_scores(
+        jnp.asarray(_mc_logits[0]), jnp.asarray(_mc_target[0]), num_classes=NUM_CLASSES, average="micro"
+    )
+    assert out_micro.shape == (5,)
+    # support equals class occurrence counts
+    np.testing.assert_array_equal(
+        np.asarray(out[:, 4]), np.bincount(_mc_target[0], minlength=NUM_CLASSES)
+    )
+
+
+def test_task_facades_route():
+    from torchmetrics_tpu import Accuracy
+    from torchmetrics_tpu.classification import MulticlassAccuracy as MCA
+
+    m = Accuracy(task="multiclass", num_classes=NUM_CLASSES)
+    assert isinstance(m, MCA)
+    f = F.accuracy(
+        jnp.asarray(_mc_logits[0]), jnp.asarray(_mc_target[0]), task="multiclass", num_classes=NUM_CLASSES,
+        average="micro",
+    )
+    ref = sk.accuracy_score(_mc_target[0], _mc_logits[0].argmax(-1))
+    assert float(f) == pytest.approx(ref, abs=1e-6)

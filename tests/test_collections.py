@@ -1,0 +1,138 @@
+"""MetricCollection + compute groups tests (reference tests/unittests/bases/test_collections.py)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn import metrics as sk
+
+from torchmetrics_tpu import MetricCollection
+from torchmetrics_tpu.classification import (
+    BinaryAccuracy,
+    MulticlassAccuracy,
+    MulticlassConfusionMatrix,
+    MulticlassF1Score,
+    MulticlassPrecision,
+    MulticlassRecall,
+)
+from conftest import BATCH_SIZE, NUM_BATCHES, NUM_CLASSES, seed_all
+
+_rng = seed_all(23)
+_preds = _rng.normal(size=(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)).astype(np.float32)
+_target = _rng.integers(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE))
+
+
+def _make_collection(compute_groups=True):
+    return MetricCollection(
+        {
+            "acc": MulticlassAccuracy(NUM_CLASSES, average="micro"),
+            "prec": MulticlassPrecision(NUM_CLASSES, average="macro"),
+            "rec": MulticlassRecall(NUM_CLASSES, average="macro"),
+            "f1": MulticlassF1Score(NUM_CLASSES, average="macro"),
+            "cm": MulticlassConfusionMatrix(NUM_CLASSES),
+        },
+        compute_groups=compute_groups,
+    )
+
+
+def _reference_values():
+    p = np.concatenate(list(_preds)).argmax(-1)
+    t = np.concatenate(list(_target))
+    return {
+        "acc": sk.accuracy_score(t, p),
+        "prec": sk.precision_score(t, p, average="macro", labels=list(range(NUM_CLASSES)), zero_division=0),
+        "rec": sk.recall_score(t, p, average="macro", labels=list(range(NUM_CLASSES)), zero_division=0),
+        "f1": sk.f1_score(t, p, average="macro", labels=list(range(NUM_CLASSES)), zero_division=0),
+        "cm": sk.confusion_matrix(t, p, labels=list(range(NUM_CLASSES))),
+    }
+
+
+@pytest.mark.parametrize("compute_groups", [True, False])
+def test_collection_matches_sklearn(compute_groups):
+    col = _make_collection(compute_groups)
+    for i in range(NUM_BATCHES):
+        col.update(jnp.asarray(_preds[i]), jnp.asarray(_target[i]))
+    out = col.compute()
+    ref = _reference_values()
+    for k, v in ref.items():
+        np.testing.assert_allclose(np.asarray(out[k]), v, atol=1e-6, err_msg=k)
+
+
+def test_compute_groups_formed():
+    """acc/prec/rec/f1 share tp/fp/tn/fn states → one group; cm separate (reference
+    collections.py:269-356 + docs overview.rst:393-401)."""
+    col = _make_collection(True)
+    col.update(jnp.asarray(_preds[0]), jnp.asarray(_target[0]))
+    groups = col.compute_groups
+    sizes = sorted(len(v) for v in groups.values())
+    assert sizes == [1, 4]
+    # group members share the SAME state dict object
+    big = max(groups.values(), key=len)
+    leader = col[big[0]]
+    for name in big[1:]:
+        assert col[name]._state is leader._state
+
+
+def test_compute_groups_match_no_groups():
+    col_g = _make_collection(True)
+    col_n = _make_collection(False)
+    for i in range(NUM_BATCHES):
+        col_g.update(jnp.asarray(_preds[i]), jnp.asarray(_target[i]))
+        col_n.update(jnp.asarray(_preds[i]), jnp.asarray(_target[i]))
+    out_g, out_n = col_g.compute(), col_n.compute()
+    for k in out_g:
+        np.testing.assert_allclose(np.asarray(out_g[k]), np.asarray(out_n[k]), atol=1e-7)
+
+
+def test_collection_forward_returns_batch_values():
+    col = _make_collection(True)
+    out0 = col(jnp.asarray(_preds[0]), jnp.asarray(_target[0]))
+    p0, t0 = _preds[0].argmax(-1), _target[0]
+    np.testing.assert_allclose(np.asarray(out0["acc"]), sk.accuracy_score(t0, p0), atol=1e-6)
+    # second forward exercises the grouped path
+    out1 = col(jnp.asarray(_preds[1]), jnp.asarray(_target[1]))
+    p1, t1 = _preds[1].argmax(-1), _target[1]
+    np.testing.assert_allclose(np.asarray(out1["acc"]), sk.accuracy_score(t1, p1), atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(out1["f1"]),
+        sk.f1_score(t1, p1, average="macro", labels=list(range(NUM_CLASSES)), zero_division=0),
+        atol=1e-6,
+    )
+
+
+def test_collection_reset():
+    col = _make_collection(True)
+    for i in range(2):
+        col.update(jnp.asarray(_preds[i]), jnp.asarray(_target[i]))
+    col.reset()
+    col.update(jnp.asarray(_preds[0]), jnp.asarray(_target[0]))
+    out = col.compute()
+    p0, t0 = _preds[0].argmax(-1), _target[0]
+    np.testing.assert_allclose(np.asarray(out["acc"]), sk.accuracy_score(t0, p0), atol=1e-6)
+
+
+def test_prefix_postfix():
+    col = MetricCollection([BinaryAccuracy()], prefix="train_", postfix="_tpu")
+    col.update(jnp.asarray([1, 0, 1]), jnp.asarray([1, 0, 0]))
+    out = col.compute()
+    assert list(out.keys()) == ["train_BinaryAccuracy_tpu"]
+
+
+def test_clone_with_prefix():
+    col = MetricCollection([BinaryAccuracy()])
+    col.update(jnp.asarray([1, 0, 1]), jnp.asarray([1, 0, 0]))
+    col2 = col.clone(prefix="val_")
+    out = col2.compute()
+    assert "val_BinaryAccuracy" in out
+
+
+def test_collection_from_sequence_and_duplicate_error():
+    with pytest.raises(ValueError, match="two metrics both named"):
+        MetricCollection([BinaryAccuracy(), BinaryAccuracy()])
+
+
+def test_collection_kwargs_filtering():
+    col = _make_collection(False)
+    # extra kwarg silently filtered per-metric (reference metric.py:992-1011)
+    col.update(preds=jnp.asarray(_preds[0]), target=jnp.asarray(_target[0]))
+    out = col.compute()
+    assert "acc" in out

@@ -1,0 +1,110 @@
+"""Confusion matrix vs sklearn (reference tests/unittests/classification/test_confusion_matrix.py)."""
+
+from functools import partial
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn import metrics as sk
+
+import torchmetrics_tpu.functional as F
+from torchmetrics_tpu.classification import (
+    BinaryConfusionMatrix,
+    MulticlassConfusionMatrix,
+    MultilabelConfusionMatrix,
+)
+from conftest import BATCH_SIZE, NUM_BATCHES, NUM_CLASSES, THRESHOLD, seed_all
+from helpers import MetricTester
+
+_rng = seed_all(11)
+_bin_preds = _rng.random((NUM_BATCHES, BATCH_SIZE)).astype(np.float32)
+_bin_target = _rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE))
+_mc_preds = _rng.integers(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE))
+_mc_target = _rng.integers(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE))
+_ml_preds = _rng.random((NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)).astype(np.float32)
+_ml_target = _rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES))
+
+
+def _sk_bin_cm(preds, target):
+    return sk.confusion_matrix(target, (preds >= THRESHOLD).astype(int), labels=[0, 1])
+
+
+def _sk_mc_cm(preds, target):
+    return sk.confusion_matrix(target, preds, labels=list(range(NUM_CLASSES)))
+
+
+def _sk_ml_cm(preds, target):
+    return sk.multilabel_confusion_matrix(
+        target.reshape(-1, NUM_CLASSES), (preds >= THRESHOLD).astype(int).reshape(-1, NUM_CLASSES)
+    )
+
+
+class TestBinaryConfusionMatrix(MetricTester):
+    def test_functional(self):
+        self.run_functional_metric_test(_bin_preds, _bin_target, F.binary_confusion_matrix, _sk_bin_cm)
+
+    def test_class(self):
+        self.run_class_metric_test(_bin_preds, _bin_target, BinaryConfusionMatrix, _sk_bin_cm)
+
+    def test_merge(self):
+        self.run_merge_state_test(_bin_preds, _bin_target, BinaryConfusionMatrix, _sk_bin_cm)
+
+    def test_ingraph(self):
+        self.run_ingraph_sharded_test(_bin_preds, _bin_target, BinaryConfusionMatrix, _sk_bin_cm)
+
+
+class TestMulticlassConfusionMatrix(MetricTester):
+    def test_functional(self):
+        self.run_functional_metric_test(
+            _mc_preds, _mc_target, partial(F.multiclass_confusion_matrix, num_classes=NUM_CLASSES), _sk_mc_cm
+        )
+
+    def test_class(self):
+        self.run_class_metric_test(
+            _mc_preds, _mc_target, MulticlassConfusionMatrix, _sk_mc_cm, {"num_classes": NUM_CLASSES}
+        )
+
+    def test_merge(self):
+        self.run_merge_state_test(
+            _mc_preds, _mc_target, MulticlassConfusionMatrix, _sk_mc_cm, {"num_classes": NUM_CLASSES}
+        )
+
+    def test_ingraph(self):
+        self.run_ingraph_sharded_test(
+            _mc_preds, _mc_target, MulticlassConfusionMatrix, _sk_mc_cm, {"num_classes": NUM_CLASSES}
+        )
+
+
+class TestMultilabelConfusionMatrix(MetricTester):
+    def test_functional(self):
+        self.run_functional_metric_test(
+            _ml_preds, _ml_target, partial(F.multilabel_confusion_matrix, num_labels=NUM_CLASSES), _sk_ml_cm
+        )
+
+    def test_class(self):
+        self.run_class_metric_test(
+            _ml_preds, _ml_target, MultilabelConfusionMatrix, _sk_ml_cm, {"num_labels": NUM_CLASSES}
+        )
+
+
+@pytest.mark.parametrize("normalize", ["true", "pred", "all"])
+def test_normalization(normalize):
+    ours = np.asarray(
+        F.multiclass_confusion_matrix(
+            jnp.asarray(_mc_preds[0]), jnp.asarray(_mc_target[0]), num_classes=NUM_CLASSES, normalize=normalize
+        )
+    )
+    ref = sk.confusion_matrix(
+        _mc_target[0], _mc_preds[0], labels=list(range(NUM_CLASSES)), normalize=normalize
+    )
+    np.testing.assert_allclose(ours, ref, atol=1e-6)
+
+
+def test_confusion_matrix_ignore_index():
+    target = np.array([0, 1, -1, 2])
+    preds = np.array([0, 1, 2, 2])
+    cm = np.asarray(
+        F.multiclass_confusion_matrix(jnp.asarray(preds), jnp.asarray(target), num_classes=3, ignore_index=-1)
+    )
+    expected = np.array([[1, 0, 0], [0, 1, 0], [0, 0, 1]])
+    np.testing.assert_array_equal(cm, expected)

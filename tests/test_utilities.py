@@ -1,0 +1,102 @@
+"""Utility kernel tests (reference tests/unittests/utilities/)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu.utilities.compute import _auc_compute, _safe_divide, _safe_xlogy, normalize_logits_if_needed
+from torchmetrics_tpu.utilities.data import (
+    _bincount,
+    _bincount_2d,
+    _bincount_matmul,
+    dim_zero_cat,
+    select_topk,
+    to_categorical,
+    to_onehot,
+)
+
+from conftest import seed_all
+
+
+def test_safe_divide():
+    num = jnp.asarray([1.0, 2.0, 3.0])
+    denom = jnp.asarray([2.0, 0.0, 6.0])
+    out = _safe_divide(num, denom)
+    np.testing.assert_allclose(np.asarray(out), [0.5, 0.0, 0.5])
+    out1 = _safe_divide(num, denom, zero_division=1.0)
+    np.testing.assert_allclose(np.asarray(out1), [0.5, 1.0, 0.5])
+
+
+def test_safe_divide_jit():
+    out = jax.jit(_safe_divide)(jnp.asarray([4.0]), jnp.asarray([0.0]))
+    np.testing.assert_allclose(np.asarray(out), [0.0])
+
+
+def test_safe_xlogy():
+    x = jnp.asarray([0.0, 1.0, 2.0])
+    y = jnp.asarray([0.0, jnp.e, jnp.e])
+    out = _safe_xlogy(x, y)
+    np.testing.assert_allclose(np.asarray(out), [0.0, 1.0, 2.0], atol=1e-6)
+
+
+@pytest.mark.parametrize("fn", [_bincount, _bincount_matmul])
+def test_bincount_matches_numpy(fn):
+    rng = seed_all(0)
+    x = rng.integers(0, 10, size=1000)
+    ours = np.asarray(fn(jnp.asarray(x), minlength=10))
+    ref = np.bincount(x, minlength=10)
+    np.testing.assert_array_equal(ours, ref)
+
+
+def test_bincount_out_of_range_dropped():
+    x = jnp.asarray([0, 1, -1, 5, 2])
+    out = np.asarray(_bincount(x, minlength=3))
+    np.testing.assert_array_equal(out, [1, 1, 1])
+
+
+def test_bincount_2d_confusion():
+    t = jnp.asarray([0, 0, 1, 2, 2, 2])
+    p = jnp.asarray([0, 1, 1, 2, 0, 2])
+    cm = np.asarray(_bincount_2d(t, p, 3, 3))
+    expected = np.asarray([[1, 1, 0], [0, 1, 0], [1, 0, 2]])
+    np.testing.assert_array_equal(cm, expected)
+
+
+def test_to_onehot_roundtrip():
+    labels = jnp.asarray([0, 2, 1, 3])
+    oh = to_onehot(labels, 4)
+    assert oh.shape == (4, 4)
+    np.testing.assert_array_equal(np.asarray(to_categorical(oh)), np.asarray(labels))
+
+
+@pytest.mark.parametrize("topk", [1, 2, 3])
+def test_select_topk(topk):
+    rng = seed_all(1)
+    probs = rng.random((8, 5)).astype(np.float32)
+    mask = np.asarray(select_topk(jnp.asarray(probs), topk, dim=1))
+    assert mask.sum() == 8 * topk
+    for i in range(8):
+        top_idx = np.argsort(probs[i])[-topk:]
+        assert mask[i, top_idx].all()
+
+
+def test_auc_compute():
+    x = jnp.asarray([0.0, 1.0])
+    y = jnp.asarray([0.0, 1.0])
+    np.testing.assert_allclose(float(_auc_compute(x, y)), 0.5)
+    # decreasing x with direction auto-detect
+    np.testing.assert_allclose(float(_auc_compute(x[::-1], y[::-1])), 0.5)
+
+
+def test_normalize_logits_if_needed():
+    probs = jnp.asarray([0.1, 0.9])
+    np.testing.assert_allclose(np.asarray(normalize_logits_if_needed(probs, "sigmoid")), np.asarray(probs))
+    logits = jnp.asarray([-2.0, 3.0])
+    out = np.asarray(normalize_logits_if_needed(logits, "sigmoid"))
+    np.testing.assert_allclose(out, 1 / (1 + np.exp(-np.asarray(logits))), atol=1e-6)
+
+
+def test_dim_zero_cat():
+    out = dim_zero_cat([jnp.asarray([1, 2]), jnp.asarray([3])])
+    np.testing.assert_array_equal(np.asarray(out), [1, 2, 3])

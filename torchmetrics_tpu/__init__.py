@@ -1,0 +1,93 @@
+"""torchmetrics_tpu — TPU-native (JAX/XLA/pjit) metrics framework.
+
+A from-scratch re-design of the torchmetrics capability surface for TPU: pure-functional
+metric cores (init/update/merge/compute pytree transforms) jit-compiled by XLA, mesh-
+axis collectives for distributed sync, and a stateful API shell matching the reference
+(`/root/reference`, alifa98/torchmetrics) for drop-in familiarity.
+"""
+
+from torchmetrics_tpu import classification, functional, parallel, utilities, wrappers
+from torchmetrics_tpu.aggregation import (
+    CatMetric,
+    MaxMetric,
+    MeanMetric,
+    MinMetric,
+    RunningMean,
+    RunningSum,
+    SumMetric,
+)
+from torchmetrics_tpu.collections import MetricCollection
+from torchmetrics_tpu.metric import CompositionalMetric, Metric
+
+from torchmetrics_tpu.classification import (  # noqa: E402
+    Accuracy,
+    BinaryAccuracy,
+    BinaryConfusionMatrix,
+    BinaryF1Score,
+    BinaryFBetaScore,
+    BinaryHammingDistance,
+    BinaryNegativePredictiveValue,
+    BinaryPrecision,
+    BinaryRecall,
+    BinarySpecificity,
+    BinaryStatScores,
+    ConfusionMatrix,
+    F1Score,
+    FBetaScore,
+    HammingDistance,
+    MulticlassAccuracy,
+    MulticlassConfusionMatrix,
+    MulticlassF1Score,
+    MulticlassFBetaScore,
+    MulticlassHammingDistance,
+    MulticlassNegativePredictiveValue,
+    MulticlassPrecision,
+    MulticlassRecall,
+    MulticlassSpecificity,
+    MulticlassStatScores,
+    MultilabelAccuracy,
+    MultilabelConfusionMatrix,
+    MultilabelF1Score,
+    MultilabelFBetaScore,
+    MultilabelHammingDistance,
+    MultilabelNegativePredictiveValue,
+    MultilabelPrecision,
+    MultilabelRecall,
+    MultilabelSpecificity,
+    MultilabelStatScores,
+    NegativePredictiveValue,
+    Precision,
+    Recall,
+    Specificity,
+    StatScores,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Accuracy",
+    "CatMetric",
+    "CompositionalMetric",
+    "ConfusionMatrix",
+    "F1Score",
+    "FBetaScore",
+    "HammingDistance",
+    "MaxMetric",
+    "MeanMetric",
+    "Metric",
+    "MetricCollection",
+    "MinMetric",
+    "NegativePredictiveValue",
+    "Precision",
+    "Recall",
+    "RunningMean",
+    "RunningSum",
+    "Specificity",
+    "StatScores",
+    "SumMetric",
+    "classification",
+    "functional",
+    "parallel",
+    "utilities",
+    "wrappers",
+]

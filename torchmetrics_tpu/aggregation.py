@@ -1,0 +1,245 @@
+"""Stream aggregation metrics with NaN policy.
+
+Parity: reference ``aggregation.py`` (BaseAggregator:32, MaxMetric:118, MinMetric:224,
+SumMetric:330, CatMetric:436, MeanMetric:501, RunningMean:628, RunningSum:685).
+
+TPU notes: NaN handling is in-graph and branchless — ``ignore`` maps NaNs to the
+reduction identity (−inf/+inf/0) or zero weight, ``float`` imputes via ``where``;
+``error``/``warn`` need host values so they run in the eager pre-step only.
+``RunningMean``/``RunningSum`` use a static-shape ring buffer (capacity = window) plus a
+cyclic cursor instead of the reference's per-window state copies — fully jittable.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .metric import Metric
+from .utilities.data import dim_zero_cat
+from .utilities.exceptions import TorchMetricsUserError
+from .utilities.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+class BaseAggregator(Metric):
+    """Base for aggregators (reference aggregation.py:32)."""
+
+    is_differentiable = None
+    higher_is_better = None
+    full_state_update = False
+
+    def __init__(
+        self,
+        fn: Union[Callable, str],
+        default_value: Any,
+        nan_strategy: Union[str, float] = "error",
+        state_name: str = "value",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        allowed_nan_strategy = ("error", "warn", "ignore", "disable")
+        if nan_strategy not in allowed_nan_strategy and not isinstance(nan_strategy, float):
+            raise ValueError(
+                f"Arg `nan_strategy` should either be a float or one of {allowed_nan_strategy} but got {nan_strategy}."
+            )
+        self.nan_strategy = nan_strategy
+        self.state_name = state_name
+        if state_name is not None:
+            self.add_state(state_name, default=default_value, dist_reduce_fx=fn)
+
+    def _host_nan_check(self, x) -> None:
+        if self.nan_strategy in ("error", "warn"):
+            xv = np.asarray(x, dtype=np.float32)
+            if np.isnan(xv).any():
+                if self.nan_strategy == "error":
+                    raise RuntimeError("Encountered `nan` values in tensor")
+                rank_zero_warn("Encountered `nan` values in tensor. Will be removed.", UserWarning)
+
+    def _nan_fill(self, x: Array, fill: float) -> Array:
+        """In-graph NaN policy: replace NaNs by ``fill`` (reduction identity or impute)."""
+        x = jnp.asarray(x, jnp.float32)
+        if self.nan_strategy == "disable":
+            return x
+        if isinstance(self.nan_strategy, float):
+            fill = self.nan_strategy
+        return jnp.where(jnp.isnan(x), jnp.asarray(fill, x.dtype), x)
+
+    def _compute(self, state):
+        return state[self.state_name]
+
+
+class MaxMetric(BaseAggregator):
+    """Running max (reference aggregation.py:118)."""
+
+    full_state_update = True
+    higher_is_better = True
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("max", -jnp.asarray(jnp.inf, jnp.float32), nan_strategy, state_name="max_value", **kwargs)
+
+    def _prepare_inputs(self, value):
+        self._host_nan_check(value)
+        return (value,), {}
+
+    def _batch_state(self, value):
+        v = self._nan_fill(value, -jnp.inf)
+        return {"max_value": jnp.max(v)}
+
+
+class MinMetric(BaseAggregator):
+    """Running min (reference aggregation.py:224)."""
+
+    full_state_update = True
+    higher_is_better = False
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("min", jnp.asarray(jnp.inf, jnp.float32), nan_strategy, state_name="min_value", **kwargs)
+
+    def _prepare_inputs(self, value):
+        self._host_nan_check(value)
+        return (value,), {}
+
+    def _batch_state(self, value):
+        v = self._nan_fill(value, jnp.inf)
+        return {"min_value": jnp.min(v)}
+
+
+class SumMetric(BaseAggregator):
+    """Running sum (reference aggregation.py:330)."""
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("sum", jnp.zeros((), jnp.float32), nan_strategy, state_name="sum_value", **kwargs)
+
+    def _prepare_inputs(self, value):
+        self._host_nan_check(value)
+        return (value,), {}
+
+    def _batch_state(self, value):
+        v = self._nan_fill(value, 0.0)
+        return {"sum_value": jnp.sum(v)}
+
+
+class CatMetric(BaseAggregator):
+    """Concatenate all seen values (reference aggregation.py:436)."""
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("cat", [], nan_strategy, **kwargs)
+
+    def _prepare_inputs(self, value):
+        self._host_nan_check(value)
+        if self.nan_strategy == "ignore" or self.nan_strategy == "warn" or self.nan_strategy == "error":
+            # drop NaNs host-side (dynamic shape — cat states are host-side anyway)
+            v = np.asarray(value, dtype=np.float32).reshape(-1)
+            v = v[~np.isnan(v)]
+            return (jnp.asarray(v),), {}
+        if isinstance(self.nan_strategy, float):
+            v = jnp.asarray(value, jnp.float32)
+            return (jnp.where(jnp.isnan(v), self.nan_strategy, v),), {}
+        return (jnp.asarray(value, jnp.float32),), {}
+
+    def _batch_state(self, value):
+        return {"value": jnp.atleast_1d(value)}
+
+    def _compute(self, state):
+        v = state["value"]
+        return v if not isinstance(v, list) else dim_zero_cat(v)
+
+
+class MeanMetric(BaseAggregator):
+    """Weighted running mean — value & weight sum states (reference aggregation.py:501)."""
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("sum", jnp.zeros((), jnp.float32), nan_strategy, state_name="mean_value", **kwargs)
+        self.add_state("weight", default=jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+
+    def _prepare_inputs(self, value, weight=1.0):
+        self._host_nan_check(value)
+        return (value, weight), {}
+
+    def _batch_state(self, value, weight=1.0):
+        value = jnp.asarray(value, jnp.float32)
+        weight = jnp.broadcast_to(jnp.asarray(weight, jnp.float32), value.shape)
+        nan = jnp.isnan(value)
+        if self.nan_strategy == "disable":
+            pass
+        elif isinstance(self.nan_strategy, float):
+            value = jnp.where(nan, self.nan_strategy, value)
+        else:  # error/warn already handled host-side; ignore: zero weight
+            weight = jnp.where(nan, 0.0, weight)
+            value = jnp.where(nan, 0.0, value)
+        return {"mean_value": jnp.sum(value * weight), "weight": jnp.sum(weight)}
+
+    def _compute(self, state):
+        from .utilities.compute import _safe_divide
+
+        return _safe_divide(state["mean_value"], state["weight"])
+
+
+class _RunningBase(BaseAggregator):
+    """Static-shape ring buffer over the last ``window`` update values."""
+
+    def __init__(self, window: int = 5, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        if not (isinstance(window, int) and window > 0):
+            raise ValueError(f"Argument `window` should be a positive integer but got {window}")
+        super().__init__("sum", None, nan_strategy, state_name=None, **kwargs)
+        self.window = window
+        self.add_state("ring", default=jnp.zeros((window,), jnp.float32), dist_reduce_fx=None)
+        self.add_state("ring_valid", default=jnp.zeros((window,), jnp.bool_), dist_reduce_fx=None)
+        self.add_state("cursor", default=jnp.zeros((), jnp.int32), dist_reduce_fx=None)
+
+    def _prepare_inputs(self, value):
+        self._host_nan_check(value)
+        return (value,), {}
+
+    def _agg(self, value: Array) -> Array:
+        raise NotImplementedError
+
+    def _batch_state(self, value):
+        v = jnp.asarray(value, jnp.float32)
+        nan = jnp.isnan(v)
+        if isinstance(self.nan_strategy, float):
+            v = jnp.where(nan, self.nan_strategy, v)
+        elif self.nan_strategy != "disable":
+            v = jnp.where(nan, 0.0, v)
+        return {"_batch_agg": self._agg(v)}
+
+    def _merge(self, a, b):  # custom: cyclic write into the ring
+        if "_batch_agg" not in b:  # merge of two ring states (merge_state path)
+            return {**a, **b}
+        cursor = a["cursor"]
+        pos = jnp.mod(cursor, self.window)
+        ring = a["ring"].at[pos].set(b["_batch_agg"])
+        valid = a["ring_valid"].at[pos].set(True)
+        return {"ring": ring, "ring_valid": valid, "cursor": cursor + 1}
+
+    def _compute(self, state):
+        raise NotImplementedError
+
+
+class RunningMean(_RunningBase):
+    """Mean over the last ``window`` batch-means (reference aggregation.py:628)."""
+
+    def _agg(self, value):
+        return jnp.mean(value)
+
+    def _compute(self, state):
+        from .utilities.compute import _safe_divide
+
+        valid = state["ring_valid"].astype(jnp.float32)
+        return _safe_divide(jnp.sum(state["ring"] * valid), jnp.sum(valid))
+
+
+class RunningSum(_RunningBase):
+    """Sum over the last ``window`` batch-sums (reference aggregation.py:685)."""
+
+    def _agg(self, value):
+        return jnp.sum(value)
+
+    def _compute(self, state):
+        valid = state["ring_valid"].astype(jnp.float32)
+        return jnp.sum(state["ring"] * valid)

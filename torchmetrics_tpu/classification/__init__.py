@@ -1,0 +1,65 @@
+from .accuracy import Accuracy, BinaryAccuracy, MulticlassAccuracy, MultilabelAccuracy
+from .confusion_matrix import (
+    BinaryConfusionMatrix,
+    ConfusionMatrix,
+    MulticlassConfusionMatrix,
+    MultilabelConfusionMatrix,
+)
+from .f_beta import (
+    BinaryF1Score,
+    BinaryFBetaScore,
+    F1Score,
+    FBetaScore,
+    MulticlassF1Score,
+    MulticlassFBetaScore,
+    MultilabelF1Score,
+    MultilabelFBetaScore,
+)
+from .hamming import (
+    BinaryHammingDistance,
+    HammingDistance,
+    MulticlassHammingDistance,
+    MultilabelHammingDistance,
+)
+from .negative_predictive_value import (
+    BinaryNegativePredictiveValue,
+    MulticlassNegativePredictiveValue,
+    MultilabelNegativePredictiveValue,
+    NegativePredictiveValue,
+)
+from .precision_recall import (
+    BinaryPrecision,
+    BinaryRecall,
+    MulticlassPrecision,
+    MulticlassRecall,
+    MultilabelPrecision,
+    MultilabelRecall,
+    Precision,
+    Recall,
+)
+from .specificity import (
+    BinarySpecificity,
+    MulticlassSpecificity,
+    MultilabelSpecificity,
+    Specificity,
+)
+from .stat_scores import (
+    BinaryStatScores,
+    MulticlassStatScores,
+    MultilabelStatScores,
+    StatScores,
+)
+
+__all__ = [
+    "Accuracy", "BinaryAccuracy", "MulticlassAccuracy", "MultilabelAccuracy",
+    "BinaryConfusionMatrix", "ConfusionMatrix", "MulticlassConfusionMatrix", "MultilabelConfusionMatrix",
+    "BinaryF1Score", "BinaryFBetaScore", "F1Score", "FBetaScore",
+    "MulticlassF1Score", "MulticlassFBetaScore", "MultilabelF1Score", "MultilabelFBetaScore",
+    "BinaryHammingDistance", "HammingDistance", "MulticlassHammingDistance", "MultilabelHammingDistance",
+    "BinaryNegativePredictiveValue", "MulticlassNegativePredictiveValue",
+    "MultilabelNegativePredictiveValue", "NegativePredictiveValue",
+    "BinaryPrecision", "BinaryRecall", "MulticlassPrecision", "MulticlassRecall",
+    "MultilabelPrecision", "MultilabelRecall", "Precision", "Recall",
+    "BinarySpecificity", "MulticlassSpecificity", "MultilabelSpecificity", "Specificity",
+    "BinaryStatScores", "MulticlassStatScores", "MultilabelStatScores", "StatScores",
+]

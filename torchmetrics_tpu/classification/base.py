@@ -1,0 +1,15 @@
+"""Task-dispatch facade base (reference ``classification/base.py:19``)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..metric import Metric
+
+
+class _ClassificationTaskWrapper:
+    """Base for facade classes whose ``__new__`` routes on ``task=`` string
+    (e.g. ``Accuracy(task="multiclass", num_classes=5)`` → ``MulticlassAccuracy``)."""
+
+    def __new__(cls: type, *args: Any, **kwargs: Any) -> Metric:
+        raise NotImplementedError(f"`{cls.__name__}` is a factory class; it cannot be instantiated directly.")

@@ -1,0 +1,111 @@
+"""Precision / Recall metric classes. Parity: reference
+``classification/precision_recall.py:41-959``."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from ..functional.classification.precision_recall import _precision_recall_reduce
+from ..metric import Metric
+from ..utilities.enums import ClassificationTask
+from .base import _ClassificationTaskWrapper
+from .stat_scores import BinaryStatScores, MulticlassStatScores, MultilabelStatScores
+
+
+class _PrecisionRecallMixin:
+    _stat: str  # "precision" | "recall"
+    is_differentiable = False
+    higher_is_better = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+
+class BinaryPrecision(_PrecisionRecallMixin, BinaryStatScores):
+    _stat = "precision"
+
+    def _compute(self, state):
+        return _precision_recall_reduce(
+            self._stat, state["tp"], state["fp"], state["tn"], state["fn"],
+            average="binary", multidim_average=self.multidim_average, zero_division=self.zero_division,
+        )
+
+
+class BinaryRecall(BinaryPrecision):
+    _stat = "recall"
+
+
+class MulticlassPrecision(_PrecisionRecallMixin, MulticlassStatScores):
+    _stat = "precision"
+    plot_legend_name = "Class"
+
+    def _compute(self, state):
+        return _precision_recall_reduce(
+            self._stat, state["tp"], state["fp"], state["tn"], state["fn"],
+            average=self.average, multidim_average=self.multidim_average, top_k=self.top_k,
+            zero_division=self.zero_division,
+        )
+
+
+class MulticlassRecall(MulticlassPrecision):
+    _stat = "recall"
+
+
+class MultilabelPrecision(_PrecisionRecallMixin, MultilabelStatScores):
+    _stat = "precision"
+    plot_legend_name = "Label"
+
+    def _compute(self, state):
+        return _precision_recall_reduce(
+            self._stat, state["tp"], state["fp"], state["tn"], state["fn"],
+            average=self.average, multidim_average=self.multidim_average, multilabel=True,
+            zero_division=self.zero_division,
+        )
+
+
+class MultilabelRecall(MultilabelPrecision):
+    _stat = "recall"
+
+
+def _pr_facade_new(binary_cls, multiclass_cls, multilabel_cls):
+    def __new__(
+        cls,
+        task: str,
+        threshold: float = 0.5,
+        num_classes: Optional[int] = None,
+        num_labels: Optional[int] = None,
+        average: Optional[str] = "micro",
+        multidim_average: str = "global",
+        top_k: Optional[int] = 1,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> Metric:
+        task = ClassificationTask.from_str(task)
+        kwargs.update({
+            "multidim_average": multidim_average,
+            "ignore_index": ignore_index,
+            "validate_args": validate_args,
+        })
+        if task == ClassificationTask.BINARY:
+            return binary_cls(threshold, **kwargs)
+        if task == ClassificationTask.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            if not isinstance(top_k, int):
+                raise ValueError(f"`top_k` is expected to be `int` but `{type(top_k)} was passed.`")
+            return multiclass_cls(num_classes, top_k, average, **kwargs)
+        if task == ClassificationTask.MULTILABEL:
+            if not isinstance(num_labels, int):
+                raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+            return multilabel_cls(num_labels, threshold, average, **kwargs)
+        raise ValueError(f"Not handled value: {task}")
+
+    return __new__
+
+
+class Precision(_ClassificationTaskWrapper):
+    __new__ = _pr_facade_new(BinaryPrecision, MulticlassPrecision, MultilabelPrecision)
+
+
+class Recall(_ClassificationTaskWrapper):
+    __new__ = _pr_facade_new(BinaryRecall, MulticlassRecall, MultilabelRecall)

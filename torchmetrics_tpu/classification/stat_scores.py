@@ -1,0 +1,218 @@
+"""StatScores metric classes — state holders over the functional kernels.
+
+Parity: reference ``classification/stat_scores.py`` (_AbstractStatScores._create_state
+:43-88, BinaryStatScores, MulticlassStatScores, MultilabelStatScores, StatScores facade).
+
+State families (reference semantics): ``multidim_average="global"`` → sum-reduced
+tensor states tp/fp/tn/fn; ``"samplewise"`` → concat list states. The whole
+accuracy/precision/recall/F-beta/specificity/NPV/hamming tower subclasses these and
+overrides only ``_compute``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+
+from ..functional.classification.stat_scores import (
+    _binary_stat_scores_arg_validation,
+    _binary_stat_scores_compute,
+    _binary_stat_scores_format,
+    _binary_stat_scores_tensor_validation,
+    _binary_stat_scores_update,
+    _multiclass_stat_scores_arg_validation,
+    _multiclass_stat_scores_compute,
+    _multiclass_stat_scores_format,
+    _multiclass_stat_scores_tensor_validation,
+    _multiclass_stat_scores_update,
+    _multilabel_stat_scores_arg_validation,
+    _multilabel_stat_scores_compute,
+    _multilabel_stat_scores_format,
+    _multilabel_stat_scores_tensor_validation,
+    _multilabel_stat_scores_update,
+)
+from ..metric import Metric
+from ..utilities.enums import ClassificationTask
+from .base import _ClassificationTaskWrapper
+
+
+class _AbstractStatScores(Metric):
+    """Creates the tp/fp/tn/fn states (reference classification/stat_scores.py:43-88)."""
+
+    def _create_state(self, size: int, multidim_average: str = "global") -> None:
+        for name in ("tp", "fp", "tn", "fn"):
+            if multidim_average == "samplewise":
+                self.add_state(name, default=[], dist_reduce_fx="cat")
+            else:
+                d = jnp.zeros((), jnp.int32) if size == 1 else jnp.zeros((size,), jnp.int32)
+                self.add_state(name, default=d, dist_reduce_fx="sum")
+
+
+class BinaryStatScores(_AbstractStatScores):
+    """Reference: classification/stat_scores.py (BinaryStatScores)."""
+
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update = False
+
+    def __init__(
+        self,
+        threshold: float = 0.5,
+        multidim_average: str = "global",
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        zero_division: float = 0,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            _binary_stat_scores_arg_validation(threshold, multidim_average, ignore_index, zero_division)
+        self.threshold = threshold
+        self.multidim_average = multidim_average
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self.zero_division = zero_division
+        self._create_state(size=1, multidim_average=multidim_average)
+
+    def _prepare_inputs(self, preds, target):
+        if self.validate_args:
+            _binary_stat_scores_tensor_validation(preds, target, self.multidim_average, self.ignore_index)
+        return (preds, target), {}
+
+    def _batch_state(self, preds, target):
+        p, t, w = _binary_stat_scores_format(preds, target, self.threshold, self.ignore_index)
+        tp, fp, tn, fn = _binary_stat_scores_update(p, t, w, self.multidim_average)
+        return {"tp": tp, "fp": fp, "tn": tn, "fn": fn}
+
+    def _compute(self, state):
+        return _binary_stat_scores_compute(state["tp"], state["fp"], state["tn"], state["fn"], self.multidim_average)
+
+
+class MulticlassStatScores(_AbstractStatScores):
+    """Reference: classification/stat_scores.py (MulticlassStatScores)."""
+
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update = False
+
+    def __init__(
+        self,
+        num_classes: int,
+        top_k: int = 1,
+        average: Optional[str] = "macro",
+        multidim_average: str = "global",
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        zero_division: float = 0,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            _multiclass_stat_scores_arg_validation(num_classes, top_k, average, multidim_average, ignore_index, zero_division)
+        self.num_classes = num_classes
+        self.top_k = top_k
+        self.average = average
+        self.multidim_average = multidim_average
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self.zero_division = zero_division
+        self._create_state(size=num_classes, multidim_average=multidim_average)
+
+    def _prepare_inputs(self, preds, target):
+        if self.validate_args:
+            _multiclass_stat_scores_tensor_validation(preds, target, self.num_classes, self.multidim_average, self.ignore_index)
+        return (preds, target), {}
+
+    def _batch_state(self, preds, target):
+        p_oh, t, w = _multiclass_stat_scores_format(preds, target, self.num_classes, self.top_k, self.ignore_index)
+        tp, fp, tn, fn = _multiclass_stat_scores_update(p_oh, t, w, self.num_classes, self.multidim_average)
+        return {"tp": tp, "fp": fp, "tn": tn, "fn": fn}
+
+    def _compute(self, state):
+        return _multiclass_stat_scores_compute(
+            state["tp"], state["fp"], state["tn"], state["fn"], self.average, self.multidim_average
+        )
+
+
+class MultilabelStatScores(_AbstractStatScores):
+    """Reference: classification/stat_scores.py (MultilabelStatScores)."""
+
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update = False
+
+    def __init__(
+        self,
+        num_labels: int,
+        threshold: float = 0.5,
+        average: Optional[str] = "macro",
+        multidim_average: str = "global",
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        zero_division: float = 0,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            _multilabel_stat_scores_arg_validation(num_labels, threshold, average, multidim_average, ignore_index, zero_division)
+        self.num_labels = num_labels
+        self.threshold = threshold
+        self.average = average
+        self.multidim_average = multidim_average
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self.zero_division = zero_division
+        self._create_state(size=num_labels, multidim_average=multidim_average)
+
+    def _prepare_inputs(self, preds, target):
+        if self.validate_args:
+            _multilabel_stat_scores_tensor_validation(preds, target, self.num_labels, self.multidim_average, self.ignore_index)
+        return (preds, target), {}
+
+    def _batch_state(self, preds, target):
+        p, t, w = _multilabel_stat_scores_format(preds, target, self.num_labels, self.threshold, self.ignore_index)
+        tp, fp, tn, fn = _multilabel_stat_scores_update(p, t, w, self.multidim_average)
+        return {"tp": tp, "fp": fp, "tn": tn, "fn": fn}
+
+    def _compute(self, state):
+        return _multilabel_stat_scores_compute(
+            state["tp"], state["fp"], state["tn"], state["fn"], self.average, self.multidim_average
+        )
+
+
+class StatScores(_ClassificationTaskWrapper):
+    """Task facade (reference classification/stat_scores.py, bottom)."""
+
+    def __new__(
+        cls,
+        task: str,
+        threshold: float = 0.5,
+        num_classes: Optional[int] = None,
+        num_labels: Optional[int] = None,
+        average: Optional[str] = "micro",
+        multidim_average: str = "global",
+        top_k: Optional[int] = 1,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> Metric:
+        task = ClassificationTask.from_str(task)
+        kwargs.update({
+            "multidim_average": multidim_average,
+            "ignore_index": ignore_index,
+            "validate_args": validate_args,
+        })
+        if task == ClassificationTask.BINARY:
+            return BinaryStatScores(threshold, **kwargs)
+        if task == ClassificationTask.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            if not isinstance(top_k, int):
+                raise ValueError(f"`top_k` is expected to be `int` but `{type(top_k)} was passed.`")
+            return MulticlassStatScores(num_classes, top_k, average, **kwargs)
+        if task == ClassificationTask.MULTILABEL:
+            if not isinstance(num_labels, int):
+                raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+            return MultilabelStatScores(num_labels, threshold, average, **kwargs)
+        raise ValueError(f"Not handled value: {task}")

@@ -1,0 +1,264 @@
+"""Confusion matrix. Parity: reference
+``functional/classification/confusion_matrix.py`` (binary:51, multiclass:191,
+multilabel:335 in the class file; kernels here).
+
+TPU note: the multiclass kernel is a single fused-index scatter-add
+(``_bincount_2d``) — one XLA scatter for the whole batch, static ``(C, C)`` output; no
+boolean indexing, ``ignore_index`` handled by zero weights.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...utilities.checks import _check_same_shape, _is_traced
+from ...utilities.compute import _safe_divide, normalize_logits_if_needed
+from ...utilities.data import _bincount_2d
+from ...utilities.enums import ClassificationTask
+from ...utilities.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+def _confusion_matrix_reduce(confmat: Array, normalize: Optional[str] = None) -> Array:
+    allowed_normalize = ("true", "pred", "all", "none", None)
+    if normalize not in allowed_normalize:
+        raise ValueError(f"Argument `normalize` needs to one of the following: {allowed_normalize}")
+    if normalize is not None and normalize != "none":
+        confmat = confmat.astype(jnp.float32)
+        if normalize == "true":
+            return _safe_divide(confmat, confmat.sum(axis=-1, keepdims=True))
+        if normalize == "pred":
+            return _safe_divide(confmat, confmat.sum(axis=-2, keepdims=True))
+        if normalize == "all":
+            return _safe_divide(confmat, confmat.sum(axis=(-2, -1), keepdims=True))
+    return confmat
+
+
+# --------------------------------------------------------------------- binary
+
+
+def _binary_confusion_matrix_arg_validation(
+    threshold: float = 0.5, ignore_index: Optional[int] = None, normalize: Optional[str] = None
+) -> None:
+    if not (isinstance(threshold, float) and (0 <= threshold <= 1)):
+        raise ValueError(f"Expected argument `threshold` to be a float in the [0,1] range, but got {threshold}.")
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+    if normalize not in ("true", "pred", "all", "none", None):
+        raise ValueError(f"Argument `normalize` needs to one of the following: ('true', 'pred', 'all', 'none', None)")
+
+
+def _binary_confusion_matrix_tensor_validation(preds, target, ignore_index: Optional[int] = None) -> None:
+    from .stat_scores import _binary_stat_scores_tensor_validation
+
+    _binary_stat_scores_tensor_validation(preds, target, "global", ignore_index)
+
+
+def _binary_confusion_matrix_format(
+    preds, target, threshold: float = 0.5, ignore_index: Optional[int] = None, convert_to_labels: bool = True
+) -> Tuple[Array, Array, Array]:
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target).reshape(-1)
+    if jnp.issubdtype(preds.dtype, jnp.floating):
+        preds = normalize_logits_if_needed(preds, "sigmoid")
+        if convert_to_labels:
+            preds = preds > threshold
+    preds = preds.reshape(-1)
+    if ignore_index is not None:
+        w = (target != ignore_index).astype(jnp.int32)
+        target = jnp.where(w == 1, target, 0)
+    else:
+        w = jnp.ones(target.shape, jnp.int32)
+    return preds.astype(jnp.int32) if convert_to_labels else preds, target.astype(jnp.int32), w
+
+
+def _binary_confusion_matrix_update(preds: Array, target: Array, weights: Array) -> Array:
+    return _bincount_2d(target, preds, 2, 2, weights=None if weights is None else weights)
+
+
+def _binary_confusion_matrix_compute(confmat: Array, normalize: Optional[str] = None) -> Array:
+    return _confusion_matrix_reduce(confmat, normalize)
+
+
+def binary_confusion_matrix(
+    preds,
+    target,
+    threshold: float = 0.5,
+    normalize: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    if validate_args:
+        _binary_confusion_matrix_arg_validation(threshold, ignore_index, normalize)
+        _binary_confusion_matrix_tensor_validation(preds, target, ignore_index)
+    preds, target, w = _binary_confusion_matrix_format(preds, target, threshold, ignore_index)
+    confmat = _binary_confusion_matrix_update(preds, target, w)
+    return _binary_confusion_matrix_compute(confmat, normalize)
+
+
+# ------------------------------------------------------------------ multiclass
+
+
+def _multiclass_confusion_matrix_arg_validation(
+    num_classes: int, ignore_index: Optional[int] = None, normalize: Optional[str] = None
+) -> None:
+    if not isinstance(num_classes, int) or num_classes < 2:
+        raise ValueError(f"Expected argument `num_classes` to be an integer larger than 1, but got {num_classes}")
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+    if normalize not in ("true", "pred", "all", "none", None):
+        raise ValueError(f"Argument `normalize` needs to one of the following: ('true', 'pred', 'all', 'none', None)")
+
+
+def _multiclass_confusion_matrix_tensor_validation(
+    preds, target, num_classes: int, ignore_index: Optional[int] = None
+) -> None:
+    from .stat_scores import _multiclass_stat_scores_tensor_validation
+
+    _multiclass_stat_scores_tensor_validation(preds, target, num_classes, "global", ignore_index)
+
+
+def _multiclass_confusion_matrix_format(
+    preds, target, ignore_index: Optional[int] = None, convert_to_labels: bool = True
+) -> Tuple[Array, Array, Array]:
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if preds.ndim == target.ndim + 1 and convert_to_labels:
+        preds = jnp.argmax(preds, axis=1)
+    preds = preds.reshape(-1) if convert_to_labels else preds
+    target = target.reshape(-1)
+    if ignore_index is not None:
+        w = (target != ignore_index).astype(jnp.int32)
+        target = jnp.where(w == 1, target, 0)
+    else:
+        w = jnp.ones(target.shape, jnp.int32)
+    return preds, target.astype(jnp.int32), w
+
+
+def _multiclass_confusion_matrix_update(preds: Array, target: Array, weights: Array, num_classes: int) -> Array:
+    return _bincount_2d(target, preds, num_classes, num_classes, weights=None if weights is None else weights)
+
+
+def _multiclass_confusion_matrix_compute(confmat: Array, normalize: Optional[str] = None) -> Array:
+    return _confusion_matrix_reduce(confmat, normalize)
+
+
+def multiclass_confusion_matrix(
+    preds,
+    target,
+    num_classes: int,
+    normalize: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    if validate_args:
+        _multiclass_confusion_matrix_arg_validation(num_classes, ignore_index, normalize)
+        _multiclass_confusion_matrix_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target, w = _multiclass_confusion_matrix_format(preds, target, ignore_index)
+    confmat = _multiclass_confusion_matrix_update(preds, target, w, num_classes)
+    return _multiclass_confusion_matrix_compute(confmat, normalize)
+
+
+# ------------------------------------------------------------------ multilabel
+
+
+def _multilabel_confusion_matrix_arg_validation(
+    num_labels: int, threshold: float = 0.5, ignore_index: Optional[int] = None, normalize: Optional[str] = None
+) -> None:
+    if not isinstance(num_labels, int) or num_labels < 2:
+        raise ValueError(f"Expected argument `num_labels` to be an integer larger than 1, but got {num_labels}")
+    if not (isinstance(threshold, float) and (0 <= threshold <= 1)):
+        raise ValueError(f"Expected argument `threshold` to be a float in the [0,1] range, but got {threshold}.")
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+    if normalize not in ("true", "pred", "all", "none", None):
+        raise ValueError(f"Argument `normalize` needs to one of the following: ('true', 'pred', 'all', 'none', None)")
+
+
+def _multilabel_confusion_matrix_tensor_validation(
+    preds, target, num_labels: int, ignore_index: Optional[int] = None
+) -> None:
+    from .stat_scores import _multilabel_stat_scores_tensor_validation
+
+    _multilabel_stat_scores_tensor_validation(preds, target, num_labels, "global", ignore_index)
+
+
+def _multilabel_confusion_matrix_format(
+    preds, target, num_labels: int, threshold: float = 0.5, ignore_index: Optional[int] = None, should_threshold: bool = True
+) -> Tuple[Array, Array, Array]:
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if jnp.issubdtype(preds.dtype, jnp.floating):
+        preds = normalize_logits_if_needed(preds, "sigmoid")
+        if should_threshold:
+            preds = preds > threshold
+    n, c = preds.shape[0], preds.shape[1]
+    preds = jnp.moveaxis(preds.reshape(n, c, -1), 1, -1).reshape(-1, c)  # (N*S, C)
+    target = jnp.moveaxis(target.reshape(n, c, -1), 1, -1).reshape(-1, c)
+    if ignore_index is not None:
+        w = (target != ignore_index).astype(jnp.int32)
+        target = jnp.where(w == 1, target, 0)
+    else:
+        w = jnp.ones(target.shape, jnp.int32)
+    return preds.astype(jnp.int32) if should_threshold else preds, target.astype(jnp.int32), w
+
+
+def _multilabel_confusion_matrix_update(preds: Array, target: Array, weights: Array, num_labels: int) -> Array:
+    """Per-label 2×2 confusion: ``(C, 2, 2)`` via elementwise sums (no scatter)."""
+    w = weights
+    tp = (w * preds * target).sum(0)
+    fp = (w * preds * (1 - target)).sum(0)
+    fn = (w * (1 - preds) * target).sum(0)
+    tn = (w * (1 - preds) * (1 - target)).sum(0)
+    return jnp.stack([tn, fp, fn, tp], axis=-1).reshape(num_labels, 2, 2)
+
+
+def _multilabel_confusion_matrix_compute(confmat: Array, normalize: Optional[str] = None) -> Array:
+    return _confusion_matrix_reduce(confmat, normalize)
+
+
+def multilabel_confusion_matrix(
+    preds,
+    target,
+    num_labels: int,
+    threshold: float = 0.5,
+    normalize: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    if validate_args:
+        _multilabel_confusion_matrix_arg_validation(num_labels, threshold, ignore_index, normalize)
+        _multilabel_confusion_matrix_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target, w = _multilabel_confusion_matrix_format(preds, target, num_labels, threshold, ignore_index)
+    confmat = _multilabel_confusion_matrix_update(preds, target, w, num_labels)
+    return _multilabel_confusion_matrix_compute(confmat, normalize)
+
+
+def confusion_matrix(
+    preds,
+    target,
+    task: str,
+    threshold: float = 0.5,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    normalize: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Task facade."""
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_confusion_matrix(preds, target, threshold, normalize, ignore_index, validate_args)
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_confusion_matrix(preds, target, num_classes, normalize, ignore_index, validate_args)
+    if task == ClassificationTask.MULTILABEL:
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+        return multilabel_confusion_matrix(preds, target, num_labels, threshold, normalize, ignore_index, validate_args)
+    raise ValueError(f"Not handled value: {task}")

@@ -1,0 +1,108 @@
+"""F-beta / F1. Parity: reference ``functional/classification/f_beta.py:44-1158``."""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+
+from ...utilities.compute import _adjust_weights_safe_divide, _safe_divide
+from ._family import make_binary, make_multiclass, make_multilabel, make_task_dispatch
+from ...utilities.enums import ClassificationTask
+
+Array = jax.Array
+
+
+def _fbeta_reduce(
+    tp: Array,
+    fp: Array,
+    tn: Array,
+    fn: Array,
+    beta: float,
+    average: Optional[str],
+    multidim_average: str = "global",
+    multilabel: bool = False,
+    top_k: int = 1,
+    zero_division: float = 0,
+) -> Array:
+    beta2 = beta**2
+    if average == "binary":
+        return _safe_divide((1 + beta2) * tp, (1 + beta2) * tp + beta2 * fn + fp, zero_division)
+    if average == "micro":
+        axis = 0 if multidim_average == "global" else 1
+        tp_s, fn_s, fp_s = tp.sum(axis), fn.sum(axis), fp.sum(axis)
+        return _safe_divide((1 + beta2) * tp_s, (1 + beta2) * tp_s + beta2 * fn_s + fp_s, zero_division)
+    fbeta_score = _safe_divide((1 + beta2) * tp, (1 + beta2) * tp + beta2 * fn + fp, zero_division)
+    return _adjust_weights_safe_divide(fbeta_score, average, multilabel, tp, fp, fn, top_k)
+
+
+def _make_fbeta_entry(maker, name: str, beta_arg: bool):
+    """Entry points for fbeta carry an extra leading ``beta`` argument."""
+
+    def reduce_with_beta(beta):
+        return lambda tp, fp, tn, fn, average, mda="global", ml=False, top_k=1, zd=0: _fbeta_reduce(
+            tp, fp, tn, fn, beta, average, mda, ml, top_k, zd
+        )
+
+    if not beta_arg:  # f1: beta fixed at 1.0
+        return maker(reduce_with_beta(1.0), name)
+
+    base_factory = maker
+
+    def fn(preds, target, beta: float = 1.0, *args, **kwargs):
+        if not (isinstance(beta, float) and beta > 0):
+            raise ValueError(f"Expected argument `beta` to be a positive float, but got {beta}.")
+        inner = base_factory(reduce_with_beta(beta), name)
+        return inner(preds, target, *args, **kwargs)
+
+    fn.__name__ = name
+    fn.__qualname__ = name
+    return fn
+
+
+binary_fbeta_score = _make_fbeta_entry(make_binary, "binary_fbeta_score", beta_arg=True)
+multiclass_fbeta_score = _make_fbeta_entry(make_multiclass, "multiclass_fbeta_score", beta_arg=True)
+multilabel_fbeta_score = _make_fbeta_entry(make_multilabel, "multilabel_fbeta_score", beta_arg=True)
+
+binary_f1_score = _make_fbeta_entry(make_binary, "binary_f1_score", beta_arg=False)
+multiclass_f1_score = _make_fbeta_entry(make_multiclass, "multiclass_f1_score", beta_arg=False)
+multilabel_f1_score = _make_fbeta_entry(make_multilabel, "multilabel_f1_score", beta_arg=False)
+
+f1_score = make_task_dispatch(binary_f1_score, multiclass_f1_score, multilabel_f1_score, "f1_score")
+
+
+def fbeta_score(
+    preds,
+    target,
+    task: str,
+    beta: float = 1.0,
+    threshold: float = 0.5,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    average: Optional[str] = "micro",
+    multidim_average: Optional[str] = "global",
+    top_k: Optional[int] = 1,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+    zero_division: float = 0,
+) -> Array:
+    """Task facade with explicit beta (reference f_beta.py, bottom)."""
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_fbeta_score(preds, target, beta, threshold, multidim_average, ignore_index, validate_args, zero_division)
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        if not isinstance(top_k, int):
+            raise ValueError(f"`top_k` is expected to be `int` but `{type(top_k)} was passed.`")
+        return multiclass_fbeta_score(
+            preds, target, beta, num_classes, average, top_k, multidim_average, ignore_index, validate_args, zero_division
+        )
+    if task == ClassificationTask.MULTILABEL:
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+        return multilabel_fbeta_score(
+            preds, target, beta, num_labels, threshold, average, multidim_average, ignore_index, validate_args, zero_division
+        )
+    raise ValueError(f"Not handled value: {task}")

@@ -1,0 +1,45 @@
+"""Hamming distance. Parity: reference ``functional/classification/hamming.py``
+(_hamming_distance_reduce:37-83)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from ...utilities.compute import _adjust_weights_safe_divide, _safe_divide
+from ._family import make_binary, make_multiclass, make_multilabel, make_task_dispatch
+
+Array = jax.Array
+
+
+def _hamming_distance_reduce(
+    tp: Array,
+    fp: Array,
+    tn: Array,
+    fn: Array,
+    average: Optional[str],
+    multidim_average: str = "global",
+    multilabel: bool = False,
+    top_k: int = 1,
+    zero_division: float = 0,
+) -> Array:
+    if average == "binary":
+        return 1 - _safe_divide(tp + tn, tp + fp + tn + fn)
+    if average == "micro":
+        axis = 0 if multidim_average == "global" else 1
+        tp_s, fn_s = tp.sum(axis), fn.sum(axis)
+        if multilabel:
+            fp_s, tn_s = fp.sum(axis), tn.sum(axis)
+            return 1 - _safe_divide(tp_s + tn_s, tp_s + tn_s + fp_s + fn_s)
+        return 1 - _safe_divide(tp_s, tp_s + fn_s)
+    score = 1 - _safe_divide(tp + tn, tp + tn + fp + fn) if multilabel else 1 - _safe_divide(tp, tp + fn)
+    return _adjust_weights_safe_divide(score, average, multilabel, tp, fp, fn)
+
+
+binary_hamming_distance = make_binary(_hamming_distance_reduce, "binary_hamming_distance")
+multiclass_hamming_distance = make_multiclass(_hamming_distance_reduce, "multiclass_hamming_distance")
+multilabel_hamming_distance = make_multilabel(_hamming_distance_reduce, "multilabel_hamming_distance")
+hamming_distance = make_task_dispatch(
+    binary_hamming_distance, multiclass_hamming_distance, multilabel_hamming_distance, "hamming_distance"
+)

@@ -1,0 +1,39 @@
+"""Specificity (TNR). Parity: reference ``functional/classification/specificity.py``."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from ...utilities.compute import _adjust_weights_safe_divide, _safe_divide
+from ._family import make_binary, make_multiclass, make_multilabel, make_task_dispatch
+
+Array = jax.Array
+
+
+def _specificity_reduce(
+    tp: Array,
+    fp: Array,
+    tn: Array,
+    fn: Array,
+    average: Optional[str],
+    multidim_average: str = "global",
+    multilabel: bool = False,
+    top_k: int = 1,
+    zero_division: float = 0,
+) -> Array:
+    if average == "binary":
+        return _safe_divide(tn, tn + fp, zero_division)
+    if average == "micro":
+        axis = 0 if multidim_average == "global" else 1
+        tn_s, fp_s = tn.sum(axis), fp.sum(axis)
+        return _safe_divide(tn_s, tn_s + fp_s, zero_division)
+    specificity_score = _safe_divide(tn, tn + fp, zero_division)
+    return _adjust_weights_safe_divide(specificity_score, average, multilabel, tp, fp, fn, top_k)
+
+
+binary_specificity = make_binary(_specificity_reduce, "binary_specificity")
+multiclass_specificity = make_multiclass(_specificity_reduce, "multiclass_specificity")
+multilabel_specificity = make_multilabel(_specificity_reduce, "multilabel_specificity")
+specificity = make_task_dispatch(binary_specificity, multiclass_specificity, multilabel_specificity, "specificity")

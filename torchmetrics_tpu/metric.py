@@ -1,0 +1,728 @@
+"""Core ``Metric`` runtime — TPU-native redesign of the reference's
+``src/torchmetrics/metric.py`` (1,312 LoC).
+
+Reference design: stateful ``nn.Module`` with in-place tensor mutation, a double-update
+``forward`` trick (metric.py:287-402), and a barrier+pad+gather sync protocol
+(metric.py:501-540).
+
+TPU-native design (SURVEY §7 translation table): the metric is a **pytree of pure
+functions** —
+
+    init()                     -> State                  (dict pytree)
+    _batch_state(*inputs)      -> State  (this batch's contribution; REQUIRED, pure)
+    _merge(a, b)               -> State  (fold; default driven by per-state reduce tag)
+    _compute(State)            -> value  (REQUIRED, pure for tensor-state metrics)
+
+Everything else falls out of purity:
+
+- ``update``  = one jitted, buffer-donated XLA call: ``merge(global, batch_state(x))``.
+- ``forward`` = same call, additionally returning ``compute(batch_state)`` — no
+  cache/restore gymnastics (reference's ``_forward_full_state_update`` double-update).
+- ``merge_state`` = pytree fold (free).
+- sync = per-leaf ``psum/pmax/pmin/all_gather`` over mesh axes (in-graph) or
+  process-allgather + fold (multi-controller) — see ``parallel/sync.py``.
+- checkpoint = the state dict *is* a pytree; hand it to orbax as-is.
+
+A thin stateful OO shell on top preserves the reference's public API surface
+(``add_state``/``update``/``compute``/``reset``/``forward``/``merge_state``/operator
+arithmetic/persistence).
+
+Concat ("cat") states hold dynamic-length data and therefore live as host-side lists of
+device arrays (appended per batch, concatenated at compute) — XLA requires static
+shapes; metrics that can express their state in static shape (binned curves, sufficient
+statistics) always do so.
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+from copy import deepcopy
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .parallel import sync as _sync
+from .utilities.data import _flatten, dim_zero_cat
+from .utilities.exceptions import TorchMetricsUserError
+from .utilities.prints import rank_zero_warn
+
+Array = jax.Array
+StateDict = Dict[str, Any]
+
+_ALLOWED_REDUCE = ("sum", "mean", "cat", "min", "max", None)
+
+
+class Metric:
+    """Base class for all metrics (stateful shell over a pure core).
+
+    Subclass contract::
+
+        class MyMetric(Metric):
+            def __init__(self, **kwargs):
+                super().__init__(**kwargs)
+                self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+            def _batch_state(self, preds, target) -> dict:   # pure, jit-traced
+                return {"total": (preds == target).sum()}
+
+            def _compute(self, state) -> jax.Array:          # pure
+                return state["total"]
+
+    Supported kwargs (parity with reference metric.py:105-154):
+    ``compute_on_cpu``, ``dist_sync_on_step``, ``process_group`` (mesh axis name(s)),
+    ``dist_sync_fn``, ``distributed_available_fn``, ``sync_on_compute``,
+    ``compute_with_cache``, plus TPU-specific ``jit`` (default True) to disable the
+    jitted update path for debugging.
+    """
+
+    __jit_warned = False
+
+    is_differentiable: Optional[bool] = None
+    higher_is_better: Optional[bool] = None
+    full_state_update: Optional[bool] = False  # parity attr; purity makes it moot
+    plot_lower_bound: Optional[float] = None
+    plot_upper_bound: Optional[float] = None
+    plot_legend_name: Optional[str] = None
+    _jittable_compute: bool = True  # False => batch-value/compute run eagerly (host path)
+
+    def __init__(self, **kwargs: Any) -> None:
+        self._device = None
+        self._dtype = None
+
+        self.compute_on_cpu = kwargs.pop("compute_on_cpu", False)
+        self.dist_sync_on_step = kwargs.pop("dist_sync_on_step", False)
+        if not isinstance(self.dist_sync_on_step, bool):
+            raise ValueError(f"Expected keyword argument `dist_sync_on_step` to be a `bool` but got {self.dist_sync_on_step}")
+        self.process_group = kwargs.pop("process_group", None)
+        self.dist_sync_fn = kwargs.pop("dist_sync_fn", None)
+        if self.dist_sync_fn is not None and not callable(self.dist_sync_fn):
+            raise ValueError(f"Expected keyword argument `dist_sync_fn` to be an callable function but got {self.dist_sync_fn}")
+        self.distributed_available_fn = kwargs.pop("distributed_available_fn", None) or _sync.distributed_available
+        self.sync_on_compute = kwargs.pop("sync_on_compute", True)
+        if not isinstance(self.sync_on_compute, bool):
+            raise ValueError(f"Expected keyword argument `sync_on_compute` to be a `bool` but got {self.sync_on_compute}")
+        self.compute_with_cache = kwargs.pop("compute_with_cache", True)
+        self._enable_jit = kwargs.pop("jit", True)
+        if kwargs:
+            kwargs_ = [f"`{a}`" for a in sorted(kwargs)]
+            raise ValueError(f"Unexpected keyword arguments: {', '.join(kwargs_)}")
+
+        self._defaults: Dict[str, Any] = {}
+        self._reductions: Dict[str, Any] = {}
+        self._persistent: Dict[str, bool] = {}
+        self._state: StateDict = {}
+
+        self._update_count = 0
+        self._computed: Any = None
+        self._is_synced = False
+        self._cache: Optional[StateDict] = None
+        self._jit_cache: Dict[str, Callable] = {}
+        self._update_called_warned = False
+
+    # ------------------------------------------------------------------ states
+
+    def add_state(
+        self,
+        name: str,
+        default: Any,
+        dist_reduce_fx: Optional[Union[str, Callable]] = None,
+        persistent: bool = False,
+    ) -> None:
+        """Register a metric state (reference metric.py:201-284).
+
+        ``default`` is either an array (tensor state — lives in the jitted path) or an
+        empty list (concat state — host list of per-batch arrays).
+        """
+        if not isinstance(default, (list,)) and not hasattr(default, "shape"):
+            default = jnp.asarray(default)
+        if isinstance(default, list) and default != []:
+            raise ValueError("state variable must be a tensor or any empty list (where you can append tensors)")
+        if dist_reduce_fx not in _ALLOWED_REDUCE and not callable(dist_reduce_fx):
+            raise ValueError("`dist_reduce_fx` must be callable or one of ['mean', 'sum', 'cat', 'min', 'max', None]")
+        if isinstance(default, list) and dist_reduce_fx is None:
+            dist_reduce_fx = "cat"
+        if name in ("_defaults", "_reductions", "_persistent", "_state"):
+            raise ValueError(f"The name `{name}` is reserved.")
+
+        # defaults live host-side (numpy): update() donates state buffers to XLA, so a
+        # default aliased into the live state would be deleted by the first update
+        self._defaults[name] = default if isinstance(default, list) else np.asarray(default)
+        self._reductions[name] = dist_reduce_fx
+        self._persistent[name] = persistent
+        self._state[name] = [] if isinstance(default, list) else jnp.asarray(self._defaults[name])
+        self._jit_cache.clear()
+
+    @property
+    def _list_state_names(self) -> Tuple[str, ...]:
+        return tuple(n for n, d in self._defaults.items() if isinstance(d, list))
+
+    @property
+    def _tensor_state_names(self) -> Tuple[str, ...]:
+        return tuple(n for n, d in self._defaults.items() if not isinstance(d, list))
+
+    def __getattr__(self, name: str):
+        state = self.__dict__.get("_state")
+        if state is not None and name in state:
+            return state[name]
+        raise AttributeError(f"{type(self).__name__!r} object has no attribute {name!r}")
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        state = self.__dict__.get("_state")
+        if state is not None and name in state:
+            state[name] = value
+            return
+        object.__setattr__(self, name, value)
+
+    # ------------------------------------------------------------- pure core
+
+    def init_state(self) -> StateDict:
+        """Fresh default state (pure)."""
+        return {n: ([] if isinstance(d, list) else jnp.asarray(d)) for n, d in self._defaults.items()}
+
+    def _batch_state(self, *args: Any, **kwargs: Any) -> StateDict:
+        """This batch's state contribution (pure, jit-traced). REQUIRED override."""
+        raise NotImplementedError
+
+    def _merge(self, a: StateDict, b: StateDict) -> StateDict:
+        """Fold ``b`` into ``a``; default uses per-state reduce tags (pure)."""
+        return _sync.merge_states(a, b, self._reductions)
+
+    def _compute(self, state: StateDict) -> Any:
+        """Final value from a state whose concat states are single arrays. REQUIRED."""
+        raise NotImplementedError
+
+    def _prepare_inputs(self, *args: Any, **kwargs: Any) -> Tuple[tuple, dict]:
+        """Host-side validation/formatting hook run OUTSIDE jit. Default: identity."""
+        return args, kwargs
+
+    # pure in-graph API -----------------------------------------------------
+
+    def update_state(self, state: StateDict, *args: Any, **kwargs: Any) -> StateDict:
+        """Pure update for use inside user ``jit``/``shard_map`` (tensor-state only)."""
+        if self._list_state_names:
+            raise TorchMetricsUserError(
+                f"{type(self).__name__} holds dynamic-length concat states and cannot run fully in-graph; "
+                "use the stateful API or a binned/static variant."
+            )
+        return self._merge(state, self._batch_state(*args, **kwargs))
+
+    def compute_state(self, state: StateDict) -> Any:
+        """Pure compute for use inside user ``jit``."""
+        return self._compute(state)
+
+    def reduce_state(self, state: StateDict, axis_name: Union[str, Sequence[str]]) -> StateDict:
+        """Cross-device reduction inside ``shard_map`` (one collective per leaf)."""
+        return _sync.reduce_states(state, self._reductions, axis_name)
+
+    # ------------------------------------------------------------- lifecycle
+
+    def _split_tensor_list(self, state: StateDict) -> Tuple[StateDict, StateDict]:
+        lists = {k: state[k] for k in self._list_state_names if k in state}
+        tensors = {k: v for k, v in state.items() if k not in lists}
+        return tensors, lists
+
+    def _get_update_fn(self) -> Callable:
+        key = "update"
+        if key not in self._jit_cache:
+            list_names = set(self._list_state_names)
+
+            def fn(tensor_state, *args, **kwargs):
+                bs = self._batch_state(*args, **kwargs)
+                appends = {k: v for k, v in bs.items() if k in list_names}
+                bs_t = {k: v for k, v in bs.items() if k not in list_names}
+                new_t = {k: _sync.pairwise_merge(self._reductions.get(k), tensor_state[k], v) for k, v in bs_t.items()} if not self._has_custom_merge() else None
+                if new_t is None:
+                    new_t = self._merge({**tensor_state}, bs_t)
+                # keep state dtype stable under merge promotion (set_dtype semantics)
+                new_t = {k: jnp.asarray(v).astype(tensor_state[k].dtype) if k in tensor_state else v for k, v in new_t.items()}
+                # carry through tensor states the batch didn't touch
+                for k, v in tensor_state.items():
+                    new_t.setdefault(k, v)
+                return new_t, appends
+
+            self._jit_cache[key] = jax.jit(fn, donate_argnums=0) if self._enable_jit else fn
+        return self._jit_cache[key]
+
+    def _has_custom_merge(self) -> bool:
+        return type(self)._merge is not Metric._merge
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Accumulate this batch into global state (one donated XLA call)."""
+        if self._is_synced:
+            raise TorchMetricsUserError(
+                "The Metric shouldn't be synced when performing ``update``. "
+                "HINT: Did you forget to call ``unsync`` ?"
+            )
+        args, kwargs = self._prepare_inputs(*args, **kwargs)
+        tensors, _ = self._split_tensor_list(self._state)
+        new_t, appends = self._get_update_fn()(tensors, *args, **kwargs)
+        for k, v in new_t.items():
+            self._state[k] = v
+        for k, v in appends.items():
+            self._state[k].append(v)
+        self._update_count += 1
+        self._computed = None
+
+    def _batch_state_full(self, *args: Any, **kwargs: Any) -> StateDict:
+        """Batch state with concat states as single arrays (compute-ready)."""
+        return self._batch_state(*args, **kwargs)
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        """Batch value AND global accumulation in one pass (reference metric.py:287).
+
+        Purity kills the double-update trick: the batch state is computed once, its
+        value returned, and the same arrays merged into the global state.
+        """
+        if self._is_synced:
+            raise TorchMetricsUserError("The Metric shouldn't be synced when performing ``forward``.")
+        if self.dist_sync_on_step:
+            # per-step synced value: update then compute-with-sync (reference semantics)
+            self.update(*args, **kwargs)
+            self._computed = None
+            val = self.compute()
+            self._computed = None
+            return val
+        args, kwargs = self._prepare_inputs(*args, **kwargs)
+        key = "forward"
+        if key not in self._jit_cache:
+            list_names = set(self._list_state_names)
+
+            def fn(tensor_state, *args, **kwargs):
+                bs = self._batch_state(*args, **kwargs)
+                appends = {k: v for k, v in bs.items() if k in list_names}
+                bs_t = {k: v for k, v in bs.items() if k not in list_names}
+                new_t = self._merge(dict(tensor_state), bs_t) if self._has_custom_merge() else {
+                    k: _sync.pairwise_merge(self._reductions.get(k), tensor_state[k], v) for k, v in bs_t.items()
+                }
+                new_t = {k: jnp.asarray(v).astype(tensor_state[k].dtype) if k in tensor_state else v for k, v in new_t.items()}
+                for k, v in tensor_state.items():
+                    new_t.setdefault(k, v)
+                batch_full = dict(bs_t)
+                defaults_t, _ = self._split_tensor_list(self.init_state())
+                for k, v in defaults_t.items():
+                    batch_full.setdefault(k, v)
+                batch_full.update(appends)
+                val = self._compute(batch_full) if self._jittable_compute else None
+                return new_t, appends, val, batch_full
+
+            self._jit_cache[key] = jax.jit(fn, donate_argnums=0) if (self._enable_jit and self._jittable_compute) else fn
+        new_t, appends, val, batch_full = self._jit_cache[key](self._split_tensor_list(self._state)[0], *args, **kwargs)
+        for k, v in new_t.items():
+            self._state[k] = v
+        for k, v in appends.items():
+            self._state[k].append(v)
+        self._update_count += 1
+        self._computed = None
+        self._last_batch_state = batch_full  # consumed by MetricCollection compute groups
+        if val is None and not self._jittable_compute:
+            val = self._compute(batch_full)
+        return val
+
+    __call__ = forward
+
+    def _concat_state(self, state: Optional[StateDict] = None) -> StateDict:
+        """State with host lists concatenated to single arrays (empty lists dropped to
+        zero-length arrays where possible)."""
+        state = self._state if state is None else state
+        out: StateDict = {}
+        for k, v in state.items():
+            if isinstance(v, list):
+                if len(v) == 0:
+                    out[k] = jnp.zeros((0,), jnp.float32)
+                else:
+                    out[k] = dim_zero_cat(v)
+            else:
+                out[k] = v
+        return out
+
+    def compute(self) -> Any:
+        """Synced final value (reference metric.py:676-708)."""
+        if self._update_count == 0 and not self._update_called_warned:
+            rank_zero_warn(
+                f"The ``compute`` method of metric {type(self).__name__} was called before the ``update`` method "
+                "which may lead to errors, as metric states have not yet been updated.",
+                UserWarning,
+            )
+            self._update_called_warned = True
+        if self.compute_with_cache and self._computed is not None:
+            return self._computed
+
+        did_sync = False
+        if self.sync_on_compute and self.distributed_available_fn():
+            self.sync()
+            did_sync = True
+        try:
+            state = self._concat_state()
+            value = self._compute(state)
+        finally:
+            if did_sync:
+                self.unsync()
+        if self.compute_with_cache:
+            self._computed = value
+        return value
+
+    def reset(self) -> None:
+        """Restore default states (reference metric.py:758)."""
+        self._update_count = 0
+        self._computed = None
+        for name, default in self._defaults.items():
+            self._state[name] = [] if isinstance(default, list) else jnp.asarray(default)
+        self._is_synced = False
+        self._cache = None
+
+    # ------------------------------------------------------------------ sync
+
+    def sync(
+        self,
+        dist_sync_fn: Optional[Callable] = None,
+        process_group: Optional[Any] = None,
+        should_sync: bool = True,
+        distributed_available: Optional[Callable] = None,
+    ) -> None:
+        """Replace local state with cross-process-reduced state (reference metric.py:573)."""
+        if self._is_synced and should_sync:
+            raise TorchMetricsUserError("The Metric has already been synced.")
+        is_dist = (distributed_available or self.distributed_available_fn)()
+        if not should_sync or not is_dist:
+            return
+        self._cache = {k: (list(v) if isinstance(v, list) else v) for k, v in self._state.items()}
+        synced = _sync.process_sync(
+            self._state,
+            self._reductions,
+            process_group=process_group or self.process_group,
+            dist_sync_fn=dist_sync_fn or self.dist_sync_fn,
+        )
+        self._state = synced
+        self._is_synced = True
+
+    def unsync(self, should_unsync: bool = True) -> None:
+        if not should_unsync:
+            return
+        if not self._is_synced:
+            raise TorchMetricsUserError("The Metric has already been un-synced.")
+        assert self._cache is not None
+        self._state = self._cache
+        self._cache = None
+        self._is_synced = False
+
+    class _SyncContext:
+        def __init__(self, metric: "Metric", **kwargs: Any) -> None:
+            self.metric = metric
+            self.kwargs = kwargs
+
+        def __enter__(self) -> None:
+            self.metric.sync(**self.kwargs)
+
+        def __exit__(self, *exc: Any) -> None:
+            if self.metric._is_synced:
+                self.metric.unsync()
+
+    def sync_context(self, **kwargs: Any) -> "Metric._SyncContext":
+        return Metric._SyncContext(self, **kwargs)
+
+    # ------------------------------------------------------------- merge/clone
+
+    def merge_state(self, incoming_state: Union[StateDict, "Metric"]) -> None:
+        """Fold another metric's state into this one — no communication
+        (reference metric.py:404). Pure pytree fold."""
+        if isinstance(incoming_state, Metric):
+            if type(incoming_state) is not type(self):
+                raise ValueError(f"Expected incoming state to be of type {type(self).__name__}")
+            incoming = incoming_state._state
+        elif isinstance(incoming_state, dict):
+            incoming = incoming_state
+            unknown = set(incoming) - set(self._state)
+            if unknown:
+                raise RuntimeError(f"Got unknown state keys {sorted(unknown)}")
+        else:
+            raise ValueError("Expected incoming state to be a dict or an instance of Metric")
+        if self._is_synced:
+            raise TorchMetricsUserError("The Metric shouldn't be synced when performing ``merge_state``.")
+        merged = self._merge(
+            {k: v for k, v in self._state.items()},
+            {k: incoming[k] for k in incoming},
+        )
+        for k, v in merged.items():
+            self._state[k] = v
+        if isinstance(incoming_state, Metric):
+            self._update_count += incoming_state._update_count
+        self._computed = None
+
+    def clone(self) -> "Metric":
+        return deepcopy(self)
+
+    def __deepcopy__(self, memo: dict) -> "Metric":
+        cls = self.__class__
+        new = cls.__new__(cls)
+        memo[id(self)] = new
+        # state arrays must be value-copied: update() donates buffers, so an aliased
+        # clone would delete the original's state on its first update
+        copy_state = lambda d: {
+            n: ([jnp.copy(x) for x in s] if isinstance(s, list) else jnp.copy(s)) for n, s in d.items()
+        }
+        for k, v in self.__dict__.items():
+            if k == "_jit_cache":
+                object.__setattr__(new, k, {})
+            elif k == "_state":
+                object.__setattr__(new, k, copy_state(v))
+            elif k in ("_defaults", "_reductions", "_persistent"):
+                object.__setattr__(new, k, dict(v))
+            elif k == "_cache":
+                object.__setattr__(new, k, None if v is None else copy_state(v))
+            else:
+                try:
+                    object.__setattr__(new, k, deepcopy(v, memo))
+                except Exception:
+                    object.__setattr__(new, k, v)
+        return new
+
+    # --------------------------------------------------------------- persist
+
+    def persistent(self, mode: bool = False) -> None:
+        for key in self._persistent:
+            self._persistent[key] = mode
+
+    def state_dict(self, destination: Optional[dict] = None, prefix: str = "") -> dict:
+        """States flagged persistent, as numpy (checkpoint-friendly; orbax takes the
+        raw state pytree via ``metric._state`` directly). Reference metric.py:924-956."""
+        destination = {} if destination is None else destination
+        for name in self._defaults:
+            if not self._persistent[name]:
+                continue
+            current = self._state[name]
+            if isinstance(current, list):
+                destination[prefix + name] = [np.asarray(x) for x in current]
+            else:
+                destination[prefix + name] = np.asarray(current)
+        return destination
+
+    def load_state_dict(self, state_dict: dict, prefix: str = "") -> None:
+        for name in self._defaults:
+            key = prefix + name
+            if key in state_dict:
+                v = state_dict[key]
+                self._state[name] = [jnp.asarray(x) for x in v] if isinstance(v, list) else jnp.asarray(v)
+
+    def __getstate__(self) -> dict:
+        d = dict(self.__dict__)
+        d.pop("_jit_cache", None)
+        d["_state"] = {
+            k: ([np.asarray(x) for x in v] if isinstance(v, list) else np.asarray(v)) for k, v in self._state.items()
+        }
+        d["_defaults"] = {k: (v if isinstance(v, list) else np.asarray(v)) for k, v in self._defaults.items()}
+        d["_cache"] = None
+        d["_computed"] = None
+        d["dist_sync_fn"] = None if self.dist_sync_fn is not None else None
+        return d
+
+    def __setstate__(self, state: dict) -> None:
+        self.__dict__.update(state)
+        self._jit_cache = {}
+        self._state = {
+            k: ([jnp.asarray(x) for x in v] if isinstance(v, list) else jnp.asarray(v)) for k, v in self._state.items()
+        }
+        self.distributed_available_fn = _sync.distributed_available
+
+    # ------------------------------------------------------------ device/dtype
+
+    def to_device(self, device_or_sharding: Any) -> "Metric":
+        """Move states (reference ``_apply`` device transfer, metric.py:867-917)."""
+        put = lambda x: jax.device_put(x, device_or_sharding)
+        for k, v in self._state.items():
+            self._state[k] = [put(x) for x in v] if isinstance(v, list) else put(v)
+        self._device = device_or_sharding
+        return self
+
+    def set_dtype(self, dst_type: Any) -> "Metric":
+        """Cast float states (float()/half() style calls are deliberate no-ops in the
+        reference; only ``set_dtype`` changes dtype, metric.py:823-865)."""
+        def cast(x):
+            x = jnp.asarray(x)
+            return x.astype(dst_type) if jnp.issubdtype(x.dtype, jnp.floating) else x
+
+        for k, v in self._state.items():
+            self._state[k] = [cast(x) for x in v] if isinstance(v, list) else cast(v)
+        self._defaults = {
+            k: (v if isinstance(v, list) else np.asarray(cast(v))) for k, v in self._defaults.items()
+        }
+        self._dtype = dst_type
+        self._jit_cache.clear()
+        return self
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @property
+    def device(self):
+        return self._device
+
+    @property
+    def update_called(self) -> bool:
+        return self._update_count > 0
+
+    @property
+    def update_count(self) -> int:
+        return self._update_count
+
+    @property
+    def metric_state(self) -> StateDict:
+        return {k: (list(v) if isinstance(v, list) else v) for k, v in self._state.items()}
+
+    # ------------------------------------------------------------ kwarg filter
+
+    def _filter_kwargs(self, **kwargs: Any) -> Dict[str, Any]:
+        """Keep only kwargs that this metric's ``_batch_state`` accepts
+        (reference metric.py:992-1011; enables heterogeneous collections)."""
+        sig = inspect.signature(self._batch_state)
+        params = sig.parameters
+        has_varkw = any(p.kind == p.VAR_KEYWORD for p in params.values())
+        if has_varkw:
+            return kwargs
+        names = {
+            n for n, p in params.items() if p.kind in (p.POSITIONAL_OR_KEYWORD, p.KEYWORD_ONLY)
+        }
+        return {k: v for k, v in kwargs.items() if k in names}
+
+    # ---------------------------------------------------------------- dunder
+
+    def __hash__(self) -> int:
+        hash_vals = [type(self).__name__]
+        for key in self._defaults:
+            val = self._state[key]
+            if isinstance(val, list):
+                hash_vals.extend(id(v) for v in val)
+            else:
+                hash_vals.append(id(val))
+        return hash(tuple(hash_vals))
+
+    def __repr__(self) -> str:
+        return f"{type(self).__name__}()"
+
+    def __abs__(self): return CompositionalMetric(jnp.abs, self, None)
+    def __add__(self, other): return CompositionalMetric(jnp.add, self, other)
+    def __and__(self, other): return CompositionalMetric(jnp.bitwise_and, self, other)
+    def __eq__(self, other): return CompositionalMetric(jnp.equal, self, other)  # type: ignore[override]
+    def __floordiv__(self, other): return CompositionalMetric(jnp.floor_divide, self, other)
+    def __ge__(self, other): return CompositionalMetric(jnp.greater_equal, self, other)
+    def __gt__(self, other): return CompositionalMetric(jnp.greater, self, other)
+    def __le__(self, other): return CompositionalMetric(jnp.less_equal, self, other)
+    def __lt__(self, other): return CompositionalMetric(jnp.less, self, other)
+    def __matmul__(self, other): return CompositionalMetric(jnp.matmul, self, other)
+    def __mod__(self, other): return CompositionalMetric(jnp.mod, self, other)
+    def __mul__(self, other): return CompositionalMetric(jnp.multiply, self, other)
+    def __ne__(self, other): return CompositionalMetric(jnp.not_equal, self, other)  # type: ignore[override]
+    def __neg__(self): return CompositionalMetric(lambda x: -x, self, None)
+    def __or__(self, other): return CompositionalMetric(jnp.bitwise_or, self, other)
+    def __pos__(self): return CompositionalMetric(jnp.abs, self, None)
+    def __pow__(self, other): return CompositionalMetric(jnp.power, self, other)
+    def __radd__(self, other): return CompositionalMetric(jnp.add, other, self)
+    def __rand__(self, other): return CompositionalMetric(jnp.bitwise_and, other, self)
+    def __rfloordiv__(self, other): return CompositionalMetric(jnp.floor_divide, other, self)
+    def __rmatmul__(self, other): return CompositionalMetric(jnp.matmul, other, self)
+    def __rmod__(self, other): return CompositionalMetric(jnp.mod, other, self)
+    def __rmul__(self, other): return CompositionalMetric(jnp.multiply, other, self)
+    def __ror__(self, other): return CompositionalMetric(jnp.bitwise_or, other, self)
+    def __rpow__(self, other): return CompositionalMetric(jnp.power, other, self)
+    def __rsub__(self, other): return CompositionalMetric(jnp.subtract, other, self)
+    def __rtruediv__(self, other): return CompositionalMetric(jnp.true_divide, other, self)
+    def __rxor__(self, other): return CompositionalMetric(jnp.bitwise_xor, other, self)
+    def __sub__(self, other): return CompositionalMetric(jnp.subtract, self, other)
+    def __truediv__(self, other): return CompositionalMetric(jnp.true_divide, self, other)
+    def __xor__(self, other): return CompositionalMetric(jnp.bitwise_xor, self, other)
+    def __invert__(self): return CompositionalMetric(jnp.bitwise_not, self, None)
+
+    def __getitem__(self, idx) -> "CompositionalMetric":
+        return CompositionalMetric(lambda x: x[idx], self, None)
+
+    # ---------------------------------------------------------------- plotting
+
+    def plot(self, *args: Any, **kwargs: Any):
+        from .utilities.plot import plot_single_or_multi_val
+
+        val = args[0] if args else self.compute()
+        return plot_single_or_multi_val(
+            val,
+            higher_is_better=self.higher_is_better,
+            lower_bound=self.plot_lower_bound,
+            upper_bound=self.plot_upper_bound,
+            legend_name=self.plot_legend_name,
+            name=type(self).__name__,
+            ax=kwargs.get("ax"),
+        )
+
+
+class CompositionalMetric(Metric):
+    """Lazy operator tree over metrics/constants (reference metric.py:1188-1311)."""
+
+    def __init__(self, operator: Callable, metric_a: Any, metric_b: Any) -> None:
+        super().__init__()
+        self.op = operator
+        self.metric_a = metric_a if isinstance(metric_a, Metric) else (None if metric_a is None else jnp.asarray(metric_a))
+        self.metric_b = metric_b if isinstance(metric_b, Metric) else (None if metric_b is None else jnp.asarray(metric_b))
+        self._op_a_raw = metric_a
+        self._op_b_raw = metric_b
+
+    def _filter_kwargs(self, **kwargs: Any) -> Dict[str, Any]:
+        return kwargs
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        if isinstance(self.metric_a, Metric):
+            self.metric_a.update(*args, **self.metric_a._filter_kwargs(**kwargs))
+        if isinstance(self.metric_b, Metric):
+            self.metric_b.update(*args, **self.metric_b._filter_kwargs(**kwargs))
+        self._update_count += 1
+        self._computed = None
+
+    def compute(self) -> Any:
+        val_a = self.metric_a.compute() if isinstance(self.metric_a, Metric) else self.metric_a
+        val_b = self.metric_b.compute() if isinstance(self.metric_b, Metric) else self.metric_b
+        if val_b is None:
+            return self.op(val_a)
+        return self.op(val_a, val_b)
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        val_a = (
+            self.metric_a.forward(*args, **self.metric_a._filter_kwargs(**kwargs))
+            if isinstance(self.metric_a, Metric)
+            else self.metric_a
+        )
+        val_b = (
+            self.metric_b.forward(*args, **self.metric_b._filter_kwargs(**kwargs))
+            if isinstance(self.metric_b, Metric)
+            else self.metric_b
+        )
+        self._update_count += 1
+        if val_a is None:
+            return None
+        if val_b is None:
+            if self._op_b_raw is None:
+                return self.op(val_a)
+            return None
+        return self.op(val_a, val_b)
+
+    __call__ = forward
+
+    def reset(self) -> None:
+        if isinstance(self.metric_a, Metric):
+            self.metric_a.reset()
+        if isinstance(self.metric_b, Metric):
+            self.metric_b.reset()
+        self._update_count = 0
+        self._computed = None
+
+    def persistent(self, mode: bool = False) -> None:
+        if isinstance(self.metric_a, Metric):
+            self.metric_a.persistent(mode=mode)
+        if isinstance(self.metric_b, Metric):
+            self.metric_b.persistent(mode=mode)
+
+    def __repr__(self) -> str:
+        _op_metrics = f"(\n  {self.op.__name__ if hasattr(self.op, '__name__') else 'op'}(\n    {self.metric_a!r},\n    {self.metric_b!r}\n  )\n)"
+        return self.__class__.__name__ + _op_metrics
+
+    def __hash__(self) -> int:
+        return object.__hash__(self)

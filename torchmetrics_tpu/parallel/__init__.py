@@ -1,0 +1,25 @@
+from .mesh import DEFAULT_AXIS, batch_sharding, make_2d_mesh, make_data_mesh, replicated
+from .sync import (
+    distributed_available,
+    gather_all_arrays,
+    merge_states,
+    pairwise_merge,
+    process_sync,
+    reduce_over_axis,
+    reduce_states,
+)
+
+__all__ = [
+    "DEFAULT_AXIS",
+    "batch_sharding",
+    "distributed_available",
+    "gather_all_arrays",
+    "make_2d_mesh",
+    "make_data_mesh",
+    "merge_states",
+    "pairwise_merge",
+    "process_sync",
+    "reduce_over_axis",
+    "reduce_states",
+    "replicated",
+]

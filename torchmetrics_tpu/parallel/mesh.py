@@ -1,0 +1,35 @@
+"""Mesh helpers — the ``process_group`` analogue for TPU.
+
+The reference scopes collectives by ``torch.distributed`` process groups; here the scope
+is a named axis (or axes) of a ``jax.sharding.Mesh``. These helpers build standard
+meshes and hold a default axis name used by metric sync when running in-graph.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+DEFAULT_AXIS = "metrics_dp"
+
+
+def make_data_mesh(n_devices: Optional[int] = None, axis_name: str = DEFAULT_AXIS) -> Mesh:
+    """1-D data-parallel mesh over the first ``n_devices`` devices."""
+    devs = jax.devices()[: (n_devices or len(jax.devices()))]
+    return jax.make_mesh((len(devs),), (axis_name,), devices=devs)
+
+
+def make_2d_mesh(dp: int, mp: int, axis_names: Tuple[str, str] = ("data", "model")) -> Mesh:
+    """2-D (data, model) mesh — dp×mp must equal the device count used."""
+    devs = jax.devices()[: dp * mp]
+    return jax.make_mesh((dp, mp), axis_names, devices=devs)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def batch_sharding(mesh: Mesh, axis_name: str = DEFAULT_AXIS) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec(axis_name))

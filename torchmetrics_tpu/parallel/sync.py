@@ -1,0 +1,231 @@
+"""Distributed state synchronization — TPU-native replacement for the reference's
+``utilities/distributed.py`` + ``Metric._sync_dist`` stack.
+
+Reference model (SURVEY §2.12): one padded ``all_gather`` per state + barrier over
+torch.distributed (gloo/NCCL), driven by per-state ``dist_reduce_fx``.
+
+TPU-native model — three sync planes, all driven by the same per-state reduction tag:
+
+1. **In-graph** (``reduce_states``): inside ``shard_map``/``pjit`` over a
+   ``jax.sharding.Mesh`` axis — sum→``lax.psum``, mean→``lax.pmean``, max→``lax.pmax``,
+   min→``lax.pmin``, cat→``lax.all_gather(tiled=True)``. Static shapes ⇒ no
+   barrier+shape-gather+pad dance (reference utilities/distributed.py:100-153); XLA
+   lowers these onto ICI collectives directly.
+2. **Cross-process** (``process_sync``): multi-controller JAX (one process per host,
+   torchmetrics' usage pattern) — ``multihost_utils.process_allgather`` per state then a
+   host-side fold with the registered merge. Used by ``Metric.sync()`` when
+   ``jax.process_count() > 1``.
+3. **Commless** (``merge_states``): pure pytree fold of two state dicts — the
+   reference's ``merge_state`` (metric.py:404) — also the building block for tree
+   reductions of gathered custom states.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+Reduction = Union[str, Callable, None]
+
+# ---------------------------------------------------------------------------
+# pairwise merge semantics per reduction tag (across batches / processes)
+# ---------------------------------------------------------------------------
+
+
+def _merge_sum(a, b):
+    return a + b
+
+
+def _merge_mean(a, b):  # mean across replicas: without counts, plain average
+    return (a + b) / 2.0
+
+
+def _merge_max(a, b):
+    return jnp.maximum(a, b)
+
+
+def _merge_min(a, b):
+    return jnp.minimum(a, b)
+
+
+def _merge_cat(a, b):
+    if isinstance(a, list):
+        return a + (b if isinstance(b, list) else [b])
+    return jnp.concatenate([jnp.atleast_1d(a), jnp.atleast_1d(b)], axis=0)
+
+
+_PAIRWISE: Dict[str, Callable] = {
+    "sum": _merge_sum,
+    "mean": _merge_mean,
+    "max": _merge_max,
+    "min": _merge_min,
+    "cat": _merge_cat,
+}
+
+
+def pairwise_merge(fx: Reduction, a, b):
+    """Merge two values of one state according to its reduction tag."""
+    if fx is None:
+        return a  # keep local value (reference semantics for fx=None)
+    if callable(fx):
+        # custom reduction operating on a stacked/concatenated tensor (reference
+        # contract) — emulate pairwise by stacking
+        return fx(jnp.stack([jnp.asarray(a), jnp.asarray(b)], axis=0))
+    return _PAIRWISE[fx](a, b)
+
+
+# ---------------------------------------------------------------------------
+# plane 1: in-graph mesh-axis reduction (use inside shard_map / pjit)
+# ---------------------------------------------------------------------------
+
+
+def reduce_over_axis(value: Array, fx: Reduction, axis_name: Union[str, Sequence[str]]):
+    """Reduce one state leaf across a named mesh axis. Call inside shard_map."""
+    if fx is None:
+        return value
+    if fx == "sum":
+        return jax.lax.psum(value, axis_name)
+    if fx == "mean":
+        return jax.lax.pmean(value, axis_name)
+    if fx == "max":
+        return jax.lax.pmax(value, axis_name)
+    if fx == "min":
+        return jax.lax.pmin(value, axis_name)
+    if fx == "cat":
+        return jax.lax.all_gather(jnp.atleast_1d(value), axis_name, axis=0, tiled=True)
+    if callable(fx):
+        gathered = jax.lax.all_gather(value, axis_name, axis=0)
+        return fx(gathered)
+    raise ValueError(f"Unknown dist_reduce_fx: {fx!r}")
+
+
+def reduce_states(
+    state: Dict[str, Any], reductions: Mapping[str, Reduction], axis_name: Union[str, Sequence[str]]
+) -> Dict[str, Any]:
+    """Reduce a whole state dict across a mesh axis (in-graph)."""
+    return {k: reduce_over_axis(v, reductions.get(k), axis_name) for k, v in state.items()}
+
+
+# ---------------------------------------------------------------------------
+# plane 2: cross-process sync (multi-controller)
+# ---------------------------------------------------------------------------
+
+
+def distributed_available() -> bool:
+    """Counterpart of the reference's ``jittable_distributed_available``
+    (metric.py:47-49): True when more than one JAX process is attached."""
+    try:
+        return jax.process_count() > 1
+    except Exception:
+        return False
+
+
+def gather_all_arrays(value: Array, process_group: Any = None) -> List[Array]:
+    """All-gather one array across JAX processes → list of per-process values.
+
+    Counterpart of reference ``gather_all_tensors`` (utilities/distributed.py:100).
+    Static-shape fast path only: JAX multi-controller requires equal shapes per process;
+    uneven concat-states carry an explicit count and pad to a static capacity instead
+    (the reference pads dynamically at :130-147 — we make capacity static for XLA).
+    """
+    from jax.experimental import multihost_utils
+
+    stacked = multihost_utils.process_allgather(value, tiled=False)
+    return [stacked[i] for i in range(stacked.shape[0])]
+
+
+def process_sync(
+    state: Dict[str, Any],
+    reductions: Mapping[str, Reduction],
+    process_group: Any = None,
+    dist_sync_fn: Optional[Callable] = None,
+) -> Dict[str, Any]:
+    """Synchronize a state dict across JAX processes (host-driven plane).
+
+    ``dist_sync_fn`` is the injection seam (reference metric.py:133): signature
+    ``fn(value, group) -> list_of_values``.
+    """
+    gather = dist_sync_fn or gather_all_arrays
+    out: Dict[str, Any] = {}
+    for name, value in state.items():
+        fx = reductions.get(name)
+        if isinstance(value, list):  # concat list state: gather each element? pre-concat first
+            if not value:
+                out[name] = value
+                continue
+            local = jnp.concatenate([jnp.atleast_1d(jnp.asarray(v)) for v in value], axis=0)
+            gathered = gather(local, process_group)
+            out[name] = [g for g in gathered]
+            continue
+        gathered = gather(value, process_group)
+        out[name] = _fold_gathered(gathered, fx)
+    return out
+
+
+def _fold_gathered(gathered: List[Array], fx: Reduction):
+    if fx is None:
+        return gathered[0] if len(gathered) == 1 else jnp.stack(gathered)
+    if callable(fx):
+        return fx(jnp.stack(gathered))
+    if fx == "cat":
+        return jnp.concatenate([jnp.atleast_1d(g) for g in gathered], axis=0)
+    acc = gathered[0]
+    for g in gathered[1:]:
+        acc = _PAIRWISE[fx](acc, g)
+    return acc
+
+
+# ---------------------------------------------------------------------------
+# plane 3: commless merge (pytree fold)
+# ---------------------------------------------------------------------------
+
+
+def merge_states(
+    a: Dict[str, Any], b: Dict[str, Any], reductions: Mapping[str, Reduction]
+) -> Dict[str, Any]:
+    """Fold state dict ``b`` into ``a`` using per-state reductions (pure)."""
+    out: Dict[str, Any] = {}
+    for name, va in a.items():
+        vb = b[name]
+        fx = reductions.get(name)
+        if isinstance(va, list) or isinstance(vb, list):
+            la = va if isinstance(va, list) else [va]
+            lb = vb if isinstance(vb, list) else [vb]
+            out[name] = la + lb
+        else:
+            out[name] = pairwise_merge(fx, va, vb)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# classic reductions on stacked tensors (reference utilities/distributed.py:22-88)
+# ---------------------------------------------------------------------------
+
+
+def reduce(x: Array, reduction: str) -> Array:
+    if reduction == "elementwise_mean":
+        return jnp.mean(x)
+    if reduction == "sum":
+        return jnp.sum(x)
+    if reduction == "none" or reduction is None:
+        return x
+    raise ValueError("Reduction parameter unknown.")
+
+
+def class_reduce(num: Array, denom: Array, weights: Array, class_reduction: str = "none") -> Array:
+    from ..utilities.compute import _safe_divide
+
+    valid_reduction = ("micro", "macro", "weighted", "none", None)
+    fraction = _safe_divide(jnp.sum(num), jnp.sum(denom)) if class_reduction == "micro" else _safe_divide(num, denom)
+    if class_reduction == "micro":
+        return fraction
+    if class_reduction == "macro":
+        return jnp.mean(fraction)
+    if class_reduction == "weighted":
+        return jnp.sum(fraction * (weights.astype(jnp.float32) / jnp.sum(weights)))
+    if class_reduction == "none" or class_reduction is None:
+        return fraction
+    raise ValueError(f"Reduction parameter {class_reduction!r} unknown. Choose between one of these: {valid_reduction}")

@@ -1,0 +1,17 @@
+from .checks import _check_same_shape
+from .data import dim_zero_cat, dim_zero_max, dim_zero_mean, dim_zero_min, dim_zero_sum
+from .exceptions import TorchMetricsUserError, TorchMetricsUserWarning
+from .prints import rank_zero_debug, rank_zero_info, rank_zero_warn
+
+__all__ = [
+    "TorchMetricsUserError",
+    "TorchMetricsUserWarning",
+    "dim_zero_cat",
+    "dim_zero_max",
+    "dim_zero_mean",
+    "dim_zero_min",
+    "dim_zero_sum",
+    "rank_zero_debug",
+    "rank_zero_info",
+    "rank_zero_warn",
+]

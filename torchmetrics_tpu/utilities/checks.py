@@ -1,0 +1,94 @@
+"""Host-side input validation (NOT jit-traceable — gate with ``validate_args``).
+
+Parity: reference ``src/torchmetrics/utilities/checks.py`` (_check_same_shape:36,
+retrieval checks:44-120). Shape/dtype checks are trace-safe (static metadata); any check
+that must look at *values* pulls to host and therefore only runs when ``validate_args``
+is True outside of jit — mirroring the reference's ``validate_args`` speed knob.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+def _is_traced(*arrays) -> bool:
+    return any(isinstance(a, jax.core.Tracer) for a in arrays)
+
+
+def _check_same_shape(preds, target) -> None:
+    """Raise if shapes differ (static — safe under jit)."""
+    if tuple(preds.shape) != tuple(target.shape):
+        raise RuntimeError(
+            f"Predictions and targets are expected to have the same shape, "
+            f"but got {tuple(preds.shape)} and {tuple(target.shape)}."
+        )
+
+
+def _check_value_range(x, low: float, high: float, name: str) -> None:
+    """Value check — skipped when traced (cannot sync inside jit)."""
+    if _is_traced(x):
+        return
+    xv = np.asarray(x)
+    if xv.size and (xv.min() < low or xv.max() > high):
+        raise ValueError(f"Expected `{name}` values in [{low}, {high}] but got range [{xv.min()}, {xv.max()}].")
+
+
+def _check_int_dtype(x, name: str) -> None:
+    if not jnp.issubdtype(jnp.asarray(x).dtype, jnp.integer) and not jnp.issubdtype(jnp.asarray(x).dtype, jnp.bool_):
+        raise ValueError(f"Expected `{name}` to be an int tensor, but got {jnp.asarray(x).dtype}.")
+
+
+def _check_label_values(x, num_classes: int, name: str, ignore_index: Optional[int] = None) -> None:
+    if _is_traced(x):
+        return
+    xv = np.asarray(x)
+    if ignore_index is not None:
+        xv = xv[xv != ignore_index]
+    if xv.size and (xv.min() < 0 or xv.max() >= num_classes):
+        raise RuntimeError(
+            f"Detected more unique values in `{name}` than expected. Expected only {num_classes} but found "
+            f"values in range [{xv.min()}, {xv.max()}]."
+        )
+
+
+def _check_for_empty_tensors(preds, target) -> bool:
+    return preds.size == 0 or target.size == 0
+
+
+def _check_retrieval_inputs(
+    indexes, preds, target, allow_non_binary_target: bool = False, ignore_index: Optional[int] = None
+) -> Tuple[Array, Array, Array]:
+    """Validate and flatten retrieval (indexes, preds, target) triples.
+
+    Reference: utilities/checks.py:44-120.
+    """
+    if indexes.shape != preds.shape or preds.shape != target.shape:
+        raise ValueError("`indexes`, `preds` and `target` must be of the same shape")
+    if not jnp.issubdtype(jnp.asarray(indexes).dtype, jnp.integer):
+        raise ValueError("`indexes` must be a tensor of long integers")
+    if not jnp.issubdtype(jnp.asarray(preds).dtype, jnp.floating):
+        raise ValueError("`preds` must be a tensor of floats")
+    tgt = jnp.asarray(target)
+    if not (jnp.issubdtype(tgt.dtype, jnp.integer) or jnp.issubdtype(tgt.dtype, jnp.bool_) or jnp.issubdtype(tgt.dtype, jnp.floating)):
+        raise ValueError("`target` must be a tensor of booleans, integers or floats")
+    if not allow_non_binary_target and not _is_traced(target):
+        tv = np.asarray(target)
+        if tv.size and (tv.max() > 1 or tv.min() < 0):
+            raise ValueError("`target` must contain `binary` values")
+    indexes = jnp.asarray(indexes).reshape(-1)
+    preds = jnp.asarray(preds).reshape(-1).astype(jnp.float32)
+    target = tgt.reshape(-1)
+    if ignore_index is not None:
+        keep = target != ignore_index
+        # host-side compaction (compute-time path, not jitted)
+        keep_np = np.asarray(keep)
+        indexes = indexes[keep_np]
+        preds = preds[keep_np]
+        target = target[keep_np]
+    return indexes, preds, target

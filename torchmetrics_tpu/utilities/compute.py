@@ -1,0 +1,121 @@
+"""Numerics helpers — jit-safe, static-shape.
+
+Parity: reference ``src/torchmetrics/utilities/compute.py`` (_safe_divide:49,
+_safe_xlogy, _auc_compute, interp, normalize_logits_if_needed:240-246). All functions are
+pure jnp and safe to call inside ``jax.jit`` / ``shard_map``; data-dependent branches use
+``jnp.where`` (both sides computed — cheap elementwise, fuses into one XLA kernel) so
+nothing forces a device→host sync.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _safe_divide(num: Array, denom: Array, zero_division: Union[float, Array] = 0.0) -> Array:
+    """``num / denom`` with 0-denominator positions replaced by ``zero_division``.
+
+    Both operands are promoted to float. Reference: utilities/compute.py:49.
+    """
+    num = jnp.asarray(num)
+    denom = jnp.asarray(denom)
+    dtype = jnp.result_type(num.dtype, denom.dtype, jnp.float32)
+    if not jnp.issubdtype(dtype, jnp.floating):
+        dtype = jnp.float32
+    num = num.astype(dtype)
+    denom = denom.astype(dtype)
+    zero = denom == 0
+    safe_denom = jnp.where(zero, jnp.ones_like(denom), denom)
+    return jnp.where(zero, jnp.asarray(zero_division, dtype=dtype), num / safe_denom)
+
+
+def _safe_xlogy(x: Array, y: Array) -> Array:
+    """``x * log(y)`` that is 0 where ``x == 0`` (even if y is 0/inf)."""
+    x, y = jnp.asarray(x), jnp.asarray(y)
+    dtype = jnp.result_type(x.dtype, y.dtype, jnp.float32)
+    x, y = x.astype(dtype), y.astype(dtype)
+    safe_y = jnp.where(x == 0, jnp.ones_like(y), y)
+    return jnp.where(x == 0, jnp.zeros_like(x), x * jnp.log(safe_y))
+
+
+def _safe_log(x: Array, eps: float = 1e-20) -> Array:
+    return jnp.log(jnp.clip(x, min=eps))
+
+
+def _safe_matmul(x: Array, y: Array) -> Array:
+    """Matmul with fp16/bf16 inputs accumulated in fp32 (MXU-native on TPU)."""
+    if x.dtype in (jnp.float16, jnp.bfloat16) or y.dtype in (jnp.float16, jnp.bfloat16):
+        return jnp.matmul(x, y, preferred_element_type=jnp.float32).astype(x.dtype)
+    return jnp.matmul(x, y)
+
+
+def _adjust_weights_safe_divide(
+    score: Array, average: Optional[str], multilabel: bool, tp: Array, fp: Array, fn: Array, top_k: int = 1
+) -> Array:
+    """Weighted/macro reduction of per-class scores, ignoring absent classes.
+
+    Reference: utilities/compute.py (same name).
+    """
+    if average is None or average == "none":
+        return score
+    if average == "weighted":
+        weights = (tp + fn).astype(jnp.float32)
+    else:
+        weights = jnp.ones_like(score, dtype=jnp.float32)
+        if not multilabel:
+            # drop classes that never appear (neither predicted nor present); with
+            # top_k > 1 only true absence (no support) drops a class
+            absent = (tp + fp + fn) == 0 if top_k == 1 else (tp + fn) == 0
+            weights = weights * (~absent)
+    norm = weights.sum(-1, keepdims=True)
+    return (_safe_divide(weights, norm) * score).sum(-1)
+
+
+def _auc_compute(x: Array, y: Array, direction: Optional[float] = None, reorder: bool = False) -> Array:
+    """Trapezoidal area under the (x, y) curve.
+
+    ``direction`` handles monotonically decreasing x (e.g. PR curves built from
+    descending thresholds) without a host round-trip: when None, the sign of the first
+    finite dx decides, computed in-graph. Reference: utilities/compute.py (_auc_compute).
+    """
+    x, y = jnp.asarray(x, jnp.float32), jnp.asarray(y, jnp.float32)
+    if reorder:
+        order = jnp.argsort(x)
+        x, y = x[order], y[order]
+    dx = jnp.diff(x)
+    trapz = ((y[1:] + y[:-1]) / 2 * dx).sum()
+    if direction is None:
+        sign = jnp.where(jnp.all(dx <= 0), -1.0, 1.0)
+        sign = jnp.where(jnp.all(dx >= 0), 1.0, sign)
+        return trapz * sign
+    return trapz * direction
+
+
+def interp(x: Array, xp: Array, fp: Array) -> Array:
+    """1-D linear interpolation (jnp.interp wrapper, static-shape)."""
+    return jnp.interp(x, xp, fp)
+
+
+def normalize_logits_if_needed(preds: Array, normalization: str = "sigmoid") -> Array:
+    """Apply sigmoid/softmax only when values fall outside [0, 1].
+
+    In-graph branchless formulation (reference uses the same torch.where trick at
+    utilities/compute.py:240-246 to avoid a device→host sync).
+    """
+    if not jnp.issubdtype(jnp.asarray(preds).dtype, jnp.floating):
+        preds = jnp.asarray(preds, jnp.float32)
+    outside = (preds.min() < 0) | (preds.max() > 1)
+    if normalization == "sigmoid":
+        return jnp.where(outside, jax.nn.sigmoid(preds), preds)
+    if normalization == "softmax":
+        return jnp.where(outside, jax.nn.softmax(preds, axis=1), preds)
+    return preds
+
+
+def _auc_reorder_and_compute(x: Array, y: Array) -> Array:
+    return _auc_compute(x, y, reorder=True)

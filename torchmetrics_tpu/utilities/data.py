@@ -1,0 +1,181 @@
+"""Data/layout helpers — static-shape, TPU-first.
+
+Parity: reference ``src/torchmetrics/utilities/data.py`` (dim_zero_*:29-56, to_onehot,
+select_topk:116, to_categorical, _bincount:178-206, _cumsum:209, _flexible_bincount).
+
+Design notes (TPU):
+- ``_bincount`` uses ``jax.ops.segment_sum`` (scatter-add) with a masked weight vector —
+  the formulation the reference reserves for its XLA fallback (data.py:202-206) is the
+  *primary* path here since dynamic-shape boolean indexing cannot be jitted.
+- A one-hot-matmul variant (``_bincount_matmul``) rides the MXU for large batches.
+- All helpers accept an optional ``weights`` argument so ``ignore_index`` filtering is
+  expressed as zero weights instead of dynamic-shape gathers (SURVEY §7 hard parts).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def dim_zero_cat(x: Union[Array, List[Array]]) -> Array:
+    """Concatenate a (possibly nested) list of arrays along dim 0."""
+    if isinstance(x, (jax.Array, jnp.ndarray)) or hasattr(x, "ndim"):
+        return jnp.asarray(x)
+    if not isinstance(x, (list, tuple)):
+        return jnp.asarray(x)
+    x = [jnp.atleast_1d(jnp.asarray(el)) for el in _flatten(x)]
+    if not x:
+        raise ValueError("No samples to concatenate")
+    return jnp.concatenate(x, axis=0)
+
+
+def dim_zero_sum(x: Array) -> Array:
+    return jnp.sum(x, axis=0)
+
+
+def dim_zero_mean(x: Array) -> Array:
+    return jnp.mean(x, axis=0)
+
+
+def dim_zero_max(x: Array) -> Array:
+    return jnp.max(x, axis=0)
+
+
+def dim_zero_min(x: Array) -> Array:
+    return jnp.min(x, axis=0)
+
+
+def _flatten(x: Sequence) -> list:
+    """Flatten one level of nesting."""
+    out = []
+    for item in x:
+        if isinstance(item, (list, tuple)):
+            out.extend(item)
+        else:
+            out.append(item)
+    return out
+
+
+def _flatten_dict(x: dict) -> tuple:
+    """Flatten one level of nested dicts; returns (flat_dict, duplicates_found)."""
+    new_dict = {}
+    duplicates = False
+    for key, value in x.items():
+        if isinstance(value, dict):
+            for k, v in value.items():
+                if k in new_dict:
+                    duplicates = True
+                new_dict[k] = v
+        else:
+            if key in new_dict:
+                duplicates = True
+            new_dict[key] = value
+    return new_dict, duplicates
+
+
+def to_onehot(label_tensor: Array, num_classes: int) -> Array:
+    """Integer labels ``(N, ...)`` → one-hot ``(N, C, ...)``."""
+    oh = jax.nn.one_hot(label_tensor, num_classes, dtype=jnp.int32)  # (N, ..., C)
+    return jnp.moveaxis(oh, -1, 1)
+
+
+def select_topk(prob_tensor: Array, topk: int = 1, dim: int = 1) -> Array:
+    """Binary mask of the top-k entries along ``dim``. Reference: data.py:116."""
+    if topk == 1:  # cheap argmax path
+        idx = jnp.argmax(prob_tensor, axis=dim, keepdims=True)
+        mask = jnp.zeros_like(prob_tensor, dtype=jnp.int32)
+        return jnp.put_along_axis(mask, idx, 1, axis=dim, inplace=False)
+    _, idx = jax.lax.top_k(jnp.moveaxis(prob_tensor, dim, -1), topk)
+    mask = jnp.zeros(jnp.moveaxis(prob_tensor, dim, -1).shape, dtype=jnp.int32)
+    mask = jnp.put_along_axis(mask, idx, 1, axis=-1, inplace=False)
+    return jnp.moveaxis(mask, -1, dim)
+
+
+def to_categorical(x: Array, argmax_dim: int = 1) -> Array:
+    """Probabilities → class labels via argmax."""
+    return jnp.argmax(x, axis=argmax_dim)
+
+
+def _bincount(x: Array, minlength: int, weights: Optional[Array] = None) -> Array:
+    """Histogram of integer values with static output shape ``(minlength,)``.
+
+    Out-of-range / negative entries (e.g. an ``ignore_index`` remapped to -1) drop out
+    via zero weights. scatter-add lowers efficiently on TPU; reference keeps this
+    formulation as its deterministic/XLA fallback (data.py:202-206).
+    """
+    x = jnp.asarray(x).reshape(-1)
+    valid = (x >= 0) & (x < minlength)
+    w = jnp.where(valid, jnp.ones(x.shape, jnp.float32) if weights is None else jnp.asarray(weights).reshape(-1).astype(jnp.float32), 0.0)
+    idx = jnp.where(valid, x, 0)
+    counts = jax.ops.segment_sum(w, idx, num_segments=minlength)
+    if weights is None:
+        return counts.astype(jnp.int32)
+    return counts
+
+
+def _bincount_matmul(x: Array, minlength: int, weights: Optional[Array] = None) -> Array:
+    """One-hot × weights bincount — rides the MXU; better for huge fused batches."""
+    x = jnp.asarray(x).reshape(-1)
+    oh = jax.nn.one_hot(x, minlength, dtype=jnp.float32)  # out-of-range rows are all-zero
+    w = jnp.ones(x.shape, jnp.float32) if weights is None else jnp.asarray(weights).reshape(-1).astype(jnp.float32)
+    counts = w @ oh
+    if weights is None:
+        return counts.astype(jnp.int32)
+    return counts
+
+
+def _bincount_2d(x: Array, y: Array, nx: int, ny: int, weights: Optional[Array] = None) -> Array:
+    """Joint histogram (confusion-matrix kernel): returns ``(nx, ny)`` counts.
+
+    Implemented as a single 1-D bincount over fused index ``x * ny + y`` — one
+    scatter-add instead of a Python loop over classes.
+    """
+    x = jnp.asarray(x).reshape(-1)
+    y = jnp.asarray(y).reshape(-1)
+    valid = (x >= 0) & (x < nx) & (y >= 0) & (y < ny)
+    w = jnp.where(valid, jnp.ones(x.shape, jnp.float32) if weights is None else jnp.asarray(weights).reshape(-1).astype(jnp.float32), 0.0)
+    fused = jnp.where(valid, x * ny + y, 0)
+    counts = jax.ops.segment_sum(w, fused, num_segments=nx * ny).reshape(nx, ny)
+    if weights is None:
+        return counts.astype(jnp.int32)
+    return counts
+
+
+def _cumsum(x: Array, axis: int = 0) -> Array:
+    """Deterministic cumulative sum (XLA cumsum is deterministic on TPU)."""
+    return jnp.cumsum(x, axis=axis)
+
+
+def _flexible_bincount(x: Array) -> Array:
+    """Count occurrences of each *unique* value (dynamic output — host-side only).
+
+    Used by retrieval metrics at compute time; under jit prefer ``_bincount`` with a
+    static upper bound. Reference: data.py (_flexible_bincount).
+    """
+    import numpy as np
+
+    xs = np.asarray(x)
+    _, counts = np.unique(xs, return_counts=True)
+    return jnp.asarray(counts)
+
+
+def _squeeze_if_scalar(data):
+    """Squeeze 0-d arrays inside (possibly nested) containers to python-friendly scalars."""
+    if isinstance(data, dict):
+        return {k: _squeeze_if_scalar(v) for k, v in data.items()}
+    if isinstance(data, (list, tuple)):
+        return type(data)(_squeeze_if_scalar(d) for d in data)
+    if hasattr(data, "ndim") and data.ndim == 0:
+        return data
+    return data
+
+
+def allclose(a, b, rtol: float = 1e-5, atol: float = 1e-8) -> bool:
+    import numpy as np
+
+    return bool(np.allclose(np.asarray(a), np.asarray(b), rtol=rtol, atol=atol))

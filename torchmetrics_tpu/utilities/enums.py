@@ -1,0 +1,88 @@
+"""Task / averaging enums.
+
+Parity: reference ``src/torchmetrics/utilities/enums.py:19-153``.
+"""
+
+from __future__ import annotations
+
+from enum import Enum
+from typing import Optional
+
+
+class EnumStr(str, Enum):
+    """String enum with case/sep-insensitive ``from_str`` lookup."""
+
+    @staticmethod
+    def _name() -> str:
+        return "Task"
+
+    @classmethod
+    def from_str(cls, value: str, source: str = "Key") -> "EnumStr":
+        try:
+            return cls[value.replace("-", "_").upper()]
+        except KeyError as err:
+            valid = [m.lower() for m in cls.__members__]
+            raise ValueError(
+                f"Invalid {cls._name()}: expected one of {valid}, but got {value}."
+            ) from err
+
+    def __str__(self) -> str:
+        return self.value.lower()
+
+
+class DataType(EnumStr):
+    """Input data type classification."""
+
+    @staticmethod
+    def _name() -> str:
+        return "Data type"
+
+    BINARY = "binary"
+    MULTILABEL = "multi-label"
+    MULTICLASS = "multi-class"
+    MULTIDIM_MULTICLASS = "multi-dim multi-class"
+
+
+class AverageMethod(EnumStr):
+    """Averaging strategy for multi-class reductions."""
+
+    @staticmethod
+    def _name() -> str:
+        return "Average method"
+
+    MICRO = "micro"
+    MACRO = "macro"
+    WEIGHTED = "weighted"
+    NONE = None  # type: ignore[assignment]
+    SAMPLES = "samples"
+
+
+class MDMCAverageMethod(EnumStr):
+    """Multi-dim multi-class averaging."""
+
+    GLOBAL = "global"
+    SAMPLEWISE = "samplewise"
+
+
+class ClassificationTask(EnumStr):
+    """binary / multiclass / multilabel task switch."""
+
+    BINARY = "binary"
+    MULTICLASS = "multiclass"
+    MULTILABEL = "multilabel"
+
+
+class ClassificationTaskNoBinary(EnumStr):
+    MULTICLASS = "multiclass"
+    MULTILABEL = "multilabel"
+
+
+class ClassificationTaskNoMultilabel(EnumStr):
+    BINARY = "binary"
+    MULTICLASS = "multiclass"
+
+
+def _resolve_average(average: Optional[str], allowed=("micro", "macro", "weighted", "none", None)) -> Optional[str]:
+    if average not in allowed:
+        raise ValueError(f"Argument `average` has to be one of {allowed}, got {average}.")
+    return None if average == "none" else average

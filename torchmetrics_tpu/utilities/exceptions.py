@@ -1,0 +1,12 @@
+"""Exception types.
+
+Parity: reference ``src/torchmetrics/utilities/exceptions.py``.
+"""
+
+
+class TorchMetricsUserError(Exception):
+    """Error raised on wrong usage of the metric API."""
+
+
+class TorchMetricsUserWarning(UserWarning):
+    """Warning raised on questionable usage of the metric API."""

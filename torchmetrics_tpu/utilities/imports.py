@@ -1,0 +1,45 @@
+"""Optional-dependency registry.
+
+Parity: reference ``src/torchmetrics/utilities/imports.py:22-68`` (~45 RequirementCache
+flags). Here flags are plain lazy booleans; anything unavailable in the zero-install TPU
+image is gated off and the dependent metric raises a clear ModuleNotFoundError.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import sys
+
+
+def _module_available(name: str) -> bool:
+    try:
+        return importlib.util.find_spec(name) is not None
+    except (ModuleNotFoundError, ValueError):
+        return False
+
+
+_PYTHON_GREATER_EQUAL_3_10 = sys.version_info >= (3, 10)
+
+_JAX_AVAILABLE = _module_available("jax")
+_FLAX_AVAILABLE = _module_available("flax")
+_TORCH_AVAILABLE = _module_available("torch")  # CPU torch: weight conversion only
+_TRANSFORMERS_AVAILABLE = _module_available("transformers")
+_SKLEARN_AVAILABLE = _module_available("sklearn")
+_SCIPY_AVAILABLE = _module_available("scipy")
+_MATPLOTLIB_AVAILABLE = _module_available("matplotlib")
+_NLTK_AVAILABLE = _module_available("nltk")
+_PESQ_AVAILABLE = _module_available("pesq")
+_PYSTOI_AVAILABLE = _module_available("pystoi")
+_LIBROSA_AVAILABLE = _module_available("librosa")
+_ONNXRUNTIME_AVAILABLE = _module_available("onnxruntime")
+_GAMMATONE_AVAILABLE = _module_available("gammatone")
+_TORCHAUDIO_AVAILABLE = _module_available("torchaudio")
+_TORCHVISION_AVAILABLE = _module_available("torchvision")
+_PYCOCOTOOLS_AVAILABLE = _module_available("pycocotools")
+_FASTER_COCO_EVAL_AVAILABLE = _module_available("faster_coco_eval")
+_MECAB_AVAILABLE = _module_available("MeCab")
+_IPADIC_AVAILABLE = _module_available("ipadic")
+_SENTENCEPIECE_AVAILABLE = _module_available("sentencepiece")
+_REGEX_AVAILABLE = _module_available("regex")
+_VMAF_AVAILABLE = False  # vmaf_torch: CUDA-only upstream; no TPU equivalent shipped
+_PANDAS_AVAILABLE = _module_available("pandas")

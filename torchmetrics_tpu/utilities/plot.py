@@ -1,0 +1,132 @@
+"""Plotting backend (matplotlib optional).
+
+Parity: reference ``utilities/plot.py`` (plot_single_or_multi_val:65,
+plot_confusion_matrix:221, plot_curve:297).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from .imports import _MATPLOTLIB_AVAILABLE
+
+_error_msg = "matplotlib is required to plot metrics, install it to use the `.plot` method"
+
+
+def _get_ax(ax=None):
+    if not _MATPLOTLIB_AVAILABLE:
+        raise ModuleNotFoundError(_error_msg)
+    import matplotlib.pyplot as plt
+
+    if ax is None:
+        fig, ax = plt.subplots()
+    else:
+        fig = ax.get_figure()
+    return fig, ax
+
+
+def _to_np(val):
+    if isinstance(val, dict):
+        return {k: _to_np(v) for k, v in val.items()}
+    if isinstance(val, (list, tuple)):
+        return [np.asarray(v) for v in val]
+    return np.asarray(val)
+
+
+def plot_single_or_multi_val(
+    val,
+    ax=None,
+    higher_is_better: Optional[bool] = None,
+    lower_bound: Optional[float] = None,
+    upper_bound: Optional[float] = None,
+    legend_name: Optional[str] = None,
+    name: Optional[str] = None,
+):
+    """Scalar → point; vector/dict/list-over-steps → lines (reference plot.py:65)."""
+    fig, ax = _get_ax(ax)
+    val = _to_np(val)
+    if isinstance(val, dict):
+        for i, (k, v) in enumerate(val.items()):
+            v = np.atleast_1d(v)
+            if v.size == 1:
+                ax.plot([i], v, "o", label=str(k))
+            else:
+                ax.plot(v, label=str(k))
+        ax.legend()
+    elif isinstance(val, list):
+        arr = np.stack([np.atleast_1d(v) for v in val])
+        if arr.ndim == 2 and arr.shape[1] > 1:
+            for c in range(arr.shape[1]):
+                ax.plot(arr[:, c], label=f"{legend_name or 'dim'} {c}")
+            ax.legend()
+        else:
+            ax.plot(arr.reshape(arr.shape[0], -1))
+        ax.set_xlabel("Step")
+    else:
+        arr = np.atleast_1d(val)
+        if arr.size == 1:
+            ax.plot([0], arr, "o")
+        else:
+            labels = [f"{legend_name or 'dim'} {i}" for i in range(arr.size)]
+            ax.bar(np.arange(arr.size), arr.reshape(-1), tick_label=labels)
+    if lower_bound is not None and upper_bound is not None:
+        ax.set_ylim(lower_bound, upper_bound)
+    if name:
+        ax.set_title(name)
+    return fig, ax
+
+
+def plot_confusion_matrix(
+    confmat,
+    ax=None,
+    add_text: bool = True,
+    labels: Optional[Sequence] = None,
+    cmap: Optional[str] = None,
+):
+    """Heatmap(s) for (C,C) or (N,2,2) confusion matrices (reference plot.py:221)."""
+    fig, ax = _get_ax(ax)
+    cm = np.asarray(confmat)
+    if cm.ndim == 3:  # multilabel — plot the first, reference creates a grid; keep simple
+        cm = cm[0]
+    im = ax.imshow(cm, cmap=cmap or "Blues")
+    fig.colorbar(im, ax=ax)
+    n = cm.shape[0]
+    ticks = labels if labels is not None else list(range(n))
+    ax.set_xticks(range(n), ticks)
+    ax.set_yticks(range(n), ticks)
+    ax.set_xlabel("Predicted class")
+    ax.set_ylabel("True class")
+    if add_text:
+        for i in range(n):
+            for j in range(cm.shape[1]):
+                ax.text(j, i, f"{cm[i, j]:.2g}", ha="center", va="center")
+    return fig, ax
+
+
+def plot_curve(
+    curve: Tuple,
+    score=None,
+    ax=None,
+    label_names: Optional[Tuple[str, str]] = None,
+    legend_name: Optional[str] = None,
+    name: Optional[str] = None,
+):
+    """ROC/PR-style curve plot (reference plot.py:297)."""
+    fig, ax = _get_ax(ax)
+    x, y = np.asarray(curve[0]), np.asarray(curve[1])
+    if x.ndim == 1:
+        ax.plot(x, y)
+    else:
+        for c in range(x.shape[0]):
+            ax.plot(x[c], y[c], label=f"{legend_name or 'class'} {c}")
+        ax.legend()
+    if label_names:
+        ax.set_xlabel(label_names[0])
+        ax.set_ylabel(label_names[1])
+    if score is not None:
+        ax.set_title(f"{name or 'curve'} (score={np.asarray(score):.3f})")
+    elif name:
+        ax.set_title(name)
+    return fig, ax

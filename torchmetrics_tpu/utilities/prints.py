@@ -1,0 +1,63 @@
+"""Rank-zero-gated logging / warnings.
+
+Parity: reference ``src/torchmetrics/utilities/prints.py:23-73``. On TPU the rank is the
+JAX process index (single-controller SPMD: one Python process may drive many chips, so
+"rank zero" means process 0 of the distributed runtime, not device 0).
+"""
+
+from __future__ import annotations
+
+import logging
+import warnings
+from functools import partial, wraps
+from typing import Any, Callable
+
+_logger = logging.getLogger("torchmetrics_tpu")
+
+
+def _process_index() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def rank_zero_only(fn: Callable) -> Callable:
+    """Run ``fn`` only on process 0 of the JAX distributed runtime."""
+
+    @wraps(fn)
+    def wrapped_fn(*args: Any, **kwargs: Any) -> Any:
+        if _process_index() == 0:
+            return fn(*args, **kwargs)
+        return None
+
+    return wrapped_fn
+
+
+@rank_zero_only
+def rank_zero_print(*args: Any, **kwargs: Any) -> None:
+    print(*args, **kwargs)
+
+
+@rank_zero_only
+def rank_zero_debug(*args: Any, **kwargs: Any) -> None:
+    _logger.debug(*args, **kwargs)
+
+
+@rank_zero_only
+def rank_zero_info(*args: Any, **kwargs: Any) -> None:
+    _logger.info(*args, **kwargs)
+
+
+def _warn(message: str, kind: type = UserWarning, **kwargs: Any) -> None:
+    warnings.warn(message, kind, stacklevel=kwargs.pop("stacklevel", 5), **kwargs)
+
+
+@rank_zero_only
+def rank_zero_warn(message: str, kind: type = UserWarning, **kwargs: Any) -> None:
+    _warn(message, kind, **kwargs)
+
+
+rank_zero_warn_deprecated = partial(rank_zero_warn, kind=DeprecationWarning)
