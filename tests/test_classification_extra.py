@@ -1,0 +1,263 @@
+"""CohenKappa / Matthews / Jaccard / ExactMatch / Hinge / Calibration / Ranking vs
+sklearn (reference tests/unittests/classification/test_<metric>.py)."""
+
+from functools import partial
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn import metrics as sk
+
+import torchmetrics_tpu.functional as F
+from torchmetrics_tpu.classification import (
+    BinaryCohenKappa,
+    BinaryMatthewsCorrCoef,
+    MulticlassCalibrationError,
+    MulticlassCohenKappa,
+    MulticlassExactMatch,
+    MulticlassJaccardIndex,
+    MulticlassMatthewsCorrCoef,
+    MultilabelRankingLoss,
+)
+from conftest import BATCH_SIZE, NUM_BATCHES, NUM_CLASSES, THRESHOLD, seed_all
+from helpers import MetricTester
+
+_rng = seed_all(43)
+_bin_preds = _rng.random((NUM_BATCHES, BATCH_SIZE)).astype(np.float32)
+_bin_target = _rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE))
+_mc_preds = _rng.integers(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE))
+_mc_target = _rng.integers(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE))
+_ml_scores = _rng.random((NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)).astype(np.float32)
+_ml_target = _rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES))
+
+
+def _sk_bin_kappa(weights=None):
+    def ref(preds, target):
+        return sk.cohen_kappa_score(target, (preds >= THRESHOLD).astype(int), weights=weights)
+
+    return ref
+
+
+def _sk_mc_kappa(weights=None):
+    def ref(preds, target):
+        return sk.cohen_kappa_score(target, preds, weights=weights, labels=list(range(NUM_CLASSES)))
+
+    return ref
+
+
+class TestCohenKappa(MetricTester):
+    @pytest.mark.parametrize("weights", [None, "linear", "quadratic"])
+    def test_binary(self, weights):
+        self.run_functional_metric_test(
+            _bin_preds, _bin_target, partial(F.binary_cohen_kappa, weights=weights), _sk_bin_kappa(weights)
+        )
+
+    @pytest.mark.parametrize("weights", [None, "linear"])
+    def test_multiclass(self, weights):
+        self.run_functional_metric_test(
+            _mc_preds, _mc_target,
+            partial(F.multiclass_cohen_kappa, num_classes=NUM_CLASSES, weights=weights),
+            _sk_mc_kappa(weights),
+        )
+
+    def test_class_stateful(self):
+        self.run_class_metric_test(
+            _mc_preds, _mc_target, MulticlassCohenKappa, _sk_mc_kappa(None), {"num_classes": NUM_CLASSES}
+        )
+
+    def test_merge(self):
+        self.run_merge_state_test(
+            _mc_preds, _mc_target, MulticlassCohenKappa, _sk_mc_kappa(None), {"num_classes": NUM_CLASSES}
+        )
+
+    def test_ingraph(self):
+        self.run_ingraph_sharded_test(
+            _mc_preds, _mc_target, MulticlassCohenKappa, _sk_mc_kappa(None), {"num_classes": NUM_CLASSES}
+        )
+
+
+def _sk_bin_mcc(preds, target):
+    return sk.matthews_corrcoef(target, (preds >= THRESHOLD).astype(int))
+
+
+def _sk_mc_mcc(preds, target):
+    return sk.matthews_corrcoef(target, preds)
+
+
+class TestMatthews(MetricTester):
+    def test_binary_functional(self):
+        self.run_functional_metric_test(_bin_preds, _bin_target, F.binary_matthews_corrcoef, _sk_bin_mcc)
+
+    def test_multiclass_functional(self):
+        self.run_functional_metric_test(
+            _mc_preds, _mc_target, partial(F.multiclass_matthews_corrcoef, num_classes=NUM_CLASSES), _sk_mc_mcc
+        )
+
+    def test_class_stateful(self):
+        self.run_class_metric_test(_bin_preds, _bin_target, BinaryMatthewsCorrCoef, _sk_bin_mcc)
+
+    def test_merge(self):
+        self.run_merge_state_test(
+            _mc_preds, _mc_target, MulticlassMatthewsCorrCoef, _sk_mc_mcc, {"num_classes": NUM_CLASSES}
+        )
+
+    def test_edge_all_correct(self):
+        preds = jnp.asarray([1, 1, 0, 0])
+        target = jnp.asarray([1, 1, 0, 0])
+        assert float(F.binary_matthews_corrcoef(preds, target)) == 1.0
+
+    def test_edge_all_wrong(self):
+        preds = jnp.asarray([1, 1, 0, 0])
+        target = jnp.asarray([0, 0, 1, 1])
+        assert float(F.binary_matthews_corrcoef(preds, target)) == -1.0
+
+
+def _sk_mc_jaccard(average):
+    def ref(preds, target):
+        return sk.jaccard_score(target, preds, average=average, labels=list(range(NUM_CLASSES)), zero_division=0)
+
+    return ref
+
+
+class TestJaccard(MetricTester):
+    @pytest.mark.parametrize("average", ["micro", "macro", "weighted", None])
+    def test_multiclass_functional(self, average):
+        sk_avg = average
+        self.run_functional_metric_test(
+            _mc_preds, _mc_target,
+            partial(F.multiclass_jaccard_index, num_classes=NUM_CLASSES, average=average),
+            _sk_mc_jaccard(sk_avg),
+        )
+
+    def test_binary_functional(self):
+        self.run_functional_metric_test(
+            _bin_preds, _bin_target, F.binary_jaccard_index,
+            lambda p, t: sk.jaccard_score(t, (p >= THRESHOLD).astype(int), zero_division=0),
+        )
+
+    def test_class_stateful(self):
+        self.run_class_metric_test(
+            _mc_preds, _mc_target, MulticlassJaccardIndex, _sk_mc_jaccard("macro"), {"num_classes": NUM_CLASSES}
+        )
+
+    def test_ingraph(self):
+        self.run_ingraph_sharded_test(
+            _mc_preds, _mc_target, MulticlassJaccardIndex, _sk_mc_jaccard("macro"), {"num_classes": NUM_CLASSES}
+        )
+
+
+class TestExactMatch(MetricTester):
+    def test_multilabel_functional(self):
+        def ref(preds, target):
+            p = (preds >= THRESHOLD).astype(int)
+            return (p == target).all(-1).mean()
+
+        self.run_functional_metric_test(
+            _ml_scores, _ml_target, partial(F.multilabel_exact_match, num_labels=NUM_CLASSES), ref
+        )
+
+    def test_multiclass_multidim(self):
+        rng = seed_all(5)
+        preds = rng.integers(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE, 4))
+        target = rng.integers(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE, 4))
+
+        def ref(p, t):
+            return (p == t).all(-1).mean()
+
+        self.run_functional_metric_test(
+            preds, target, partial(F.multiclass_exact_match, num_classes=NUM_CLASSES), ref
+        )
+        self.run_class_metric_test(preds, target, MulticlassExactMatch, ref, {"num_classes": NUM_CLASSES})
+
+
+class TestHinge(MetricTester):
+    def test_binary_functional(self):
+        def ref(preds, target):
+            # binary hinge on probabilities with targets in {-1, 1}
+            margin = np.where(target == 1, preds, -preds)
+            return np.clip(1 - margin, 0, None).mean()
+
+        self.run_functional_metric_test(_bin_preds, _bin_target, F.binary_hinge_loss, ref)
+
+    def test_multiclass_crammer_singer(self):
+        logits = seed_all(6).random((NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)).astype(np.float32)
+        logits /= logits.sum(-1, keepdims=True)
+
+        def ref(preds, target):
+            true_score = preds[np.arange(len(target)), target]
+            masked = preds.copy()
+            masked[np.arange(len(target)), target] = -np.inf
+            margin = true_score - masked.max(-1)
+            return np.clip(1 - margin, 0, None).mean()
+
+        self.run_functional_metric_test(
+            logits, _mc_target, partial(F.multiclass_hinge_loss, num_classes=NUM_CLASSES), ref
+        )
+
+
+class TestCalibration(MetricTester):
+    def test_binary_ece_vs_manual(self):
+        p = _bin_preds.reshape(-1)
+        t = _bin_target.reshape(-1)
+        n_bins = 10
+        ours = float(F.binary_calibration_error(jnp.asarray(p), jnp.asarray(t), n_bins=n_bins, norm="l1"))
+        # manual uniform-bin ECE
+        edges = np.linspace(0, 1, n_bins + 1)
+        idx = np.clip(np.searchsorted(edges, p, side="right") - 1, 0, n_bins)
+        ece = 0.0
+        for b in range(n_bins + 1):
+            m = idx == b
+            if m.sum():
+                ece += m.mean() * abs(t[m].mean() - p[m].mean())
+        assert ours == pytest.approx(ece, abs=1e-6)
+
+    def test_multiclass_class_stateful_consistent(self):
+        logits = seed_all(8).normal(size=(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)).astype(np.float32)
+        m = MulticlassCalibrationError(NUM_CLASSES, n_bins=15)
+        for i in range(NUM_BATCHES):
+            m.update(jnp.asarray(logits[i]), jnp.asarray(_mc_target[i]))
+        stateful = float(m.compute())
+        oneshot = float(
+            F.multiclass_calibration_error(
+                jnp.asarray(np.concatenate(list(logits))), jnp.asarray(np.concatenate(list(_mc_target))),
+                num_classes=NUM_CLASSES, n_bins=15,
+            )
+        )
+        assert stateful == pytest.approx(oneshot, abs=1e-6)
+
+
+class TestRanking(MetricTester):
+    def test_coverage_error(self):
+        def ref(preds, target):
+            return sk.coverage_error(target, preds)
+
+        self.run_functional_metric_test(
+            _ml_scores, _ml_target, partial(F.multilabel_coverage_error, num_labels=NUM_CLASSES), ref
+        )
+
+    def test_label_ranking_average_precision(self):
+        def ref(preds, target):
+            return sk.label_ranking_average_precision_score(target, preds)
+
+        self.run_functional_metric_test(
+            _ml_scores, _ml_target, partial(F.multilabel_ranking_average_precision, num_labels=NUM_CLASSES), ref
+        )
+
+    def test_label_ranking_loss(self):
+        def ref(preds, target):
+            return sk.label_ranking_loss(target, preds)
+
+        self.run_functional_metric_test(
+            _ml_scores, _ml_target, partial(F.multilabel_ranking_loss, num_labels=NUM_CLASSES), ref
+        )
+
+    def test_ranking_loss_class(self):
+        def ref(preds, target):
+            return sk.label_ranking_loss(target.reshape(-1, NUM_CLASSES), preds.reshape(-1, NUM_CLASSES))
+
+        self.run_class_metric_test(
+            _ml_scores, _ml_target, MultilabelRankingLoss, ref, {"num_labels": NUM_CLASSES}
+        )
+        self.run_ingraph_sharded_test(
+            _ml_scores, _ml_target, MultilabelRankingLoss, ref, {"num_labels": NUM_CLASSES}
+        )

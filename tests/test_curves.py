@@ -1,0 +1,197 @@
+"""Curve family (PR curve / ROC / AUROC / AP) vs sklearn.
+
+Exact mode (thresholds=None) checked strictly against sklearn; binned mode checked
+against exact mode within binning tolerance and for internal consistency (reference
+tests/unittests/classification/test_precision_recall_curve.py, test_auroc.py)."""
+
+from functools import partial
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn import metrics as sk
+
+import torchmetrics_tpu.functional as F
+from torchmetrics_tpu.classification import (
+    BinaryAUROC,
+    BinaryAveragePrecision,
+    BinaryPrecisionRecallCurve,
+    BinaryROC,
+    MulticlassAUROC,
+    MulticlassAveragePrecision,
+    MultilabelAUROC,
+)
+from conftest import BATCH_SIZE, NUM_BATCHES, NUM_CLASSES, seed_all
+from helpers import MetricTester, _assert_allclose
+
+_rng = seed_all(31)
+_bin_preds = _rng.random((NUM_BATCHES, BATCH_SIZE)).astype(np.float32)
+_bin_target = _rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE))
+_mc_scores = _rng.random((NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)).astype(np.float32)
+_mc_scores /= _mc_scores.sum(-1, keepdims=True)
+_mc_target = _rng.integers(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE))
+_ml_target = _rng.integers(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES))
+
+_bp = np.concatenate(list(_bin_preds))
+_bt = np.concatenate(list(_bin_target))
+_mp = np.concatenate(list(_mc_scores))
+_mt = np.concatenate(list(_mc_target))
+_mlt = np.concatenate(list(_ml_target))
+
+
+def test_binary_pr_curve_exact_vs_sklearn():
+    p, r, t = F.binary_precision_recall_curve(jnp.asarray(_bp), jnp.asarray(_bt), thresholds=None)
+    skp, skr, skt = sk.precision_recall_curve(_bt, _bp)
+    np.testing.assert_allclose(np.asarray(p), skp, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(r), skr, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(t), skt, atol=1e-6)
+
+
+def test_binary_roc_exact_vs_sklearn():
+    fpr, tpr, _ = F.binary_roc(jnp.asarray(_bp), jnp.asarray(_bt), thresholds=None)
+    skfpr, sktpr, _ = sk.roc_curve(_bt, _bp, drop_intermediate=False)
+    np.testing.assert_allclose(np.asarray(fpr), skfpr, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(tpr), sktpr, atol=1e-6)
+
+
+def test_binary_auroc_exact_vs_sklearn():
+    ours = float(F.binary_auroc(jnp.asarray(_bp), jnp.asarray(_bt), thresholds=None))
+    ref = sk.roc_auc_score(_bt, _bp)
+    assert ours == pytest.approx(ref, abs=1e-6)
+
+
+def test_binary_auroc_max_fpr():
+    ours = float(F.binary_auroc(jnp.asarray(_bp), jnp.asarray(_bt), max_fpr=0.3, thresholds=None))
+    ref = sk.roc_auc_score(_bt, _bp, max_fpr=0.3)
+    assert ours == pytest.approx(ref, abs=1e-5)
+
+
+def test_binary_average_precision_exact_vs_sklearn():
+    ours = float(F.binary_average_precision(jnp.asarray(_bp), jnp.asarray(_bt), thresholds=None))
+    ref = sk.average_precision_score(_bt, _bp)
+    assert ours == pytest.approx(ref, abs=1e-6)
+
+
+def test_multiclass_auroc_exact_vs_sklearn():
+    for average, sk_avg in [("macro", "macro"), ("weighted", "weighted")]:
+        ours = float(
+            F.multiclass_auroc(jnp.asarray(_mp), jnp.asarray(_mt), num_classes=NUM_CLASSES, average=average, thresholds=None)
+        )
+        ref = sk.roc_auc_score(_mt, _mp, multi_class="ovr", average=sk_avg, labels=list(range(NUM_CLASSES)))
+        assert ours == pytest.approx(ref, abs=1e-6), average
+
+
+def test_multiclass_average_precision_exact_vs_sklearn():
+    ours = np.asarray(
+        F.multiclass_average_precision(jnp.asarray(_mp), jnp.asarray(_mt), num_classes=NUM_CLASSES, average=None, thresholds=None)
+    )
+    t_oh = np.eye(NUM_CLASSES)[_mt]
+    ref = np.array([sk.average_precision_score(t_oh[:, c], _mp[:, c]) for c in range(NUM_CLASSES)])
+    np.testing.assert_allclose(ours, ref, atol=1e-6)
+
+
+def test_multilabel_auroc_exact_vs_sklearn():
+    ours = float(
+        F.multilabel_auroc(jnp.asarray(_mp), jnp.asarray(_mlt.reshape(-1, NUM_CLASSES)[: _mp.shape[0]]), num_labels=NUM_CLASSES, average="macro", thresholds=None)
+    )
+    ref = sk.roc_auc_score(_mlt.reshape(-1, NUM_CLASSES)[: _mp.shape[0]], _mp, average="macro")
+    assert ours == pytest.approx(ref, abs=1e-6)
+
+
+@pytest.mark.parametrize("thresholds", [None, 200])
+def test_binary_auroc_class_stateful(thresholds):
+    metric = BinaryAUROC(thresholds=thresholds)
+    for i in range(NUM_BATCHES):
+        metric.update(jnp.asarray(_bin_preds[i]), jnp.asarray(_bin_target[i]))
+    ours = float(metric.compute())
+    ref = sk.roc_auc_score(_bt, _bp)
+    tol = 1e-6 if thresholds is None else 0.02
+    assert ours == pytest.approx(ref, abs=tol)
+
+
+def test_binned_matches_exact_closely():
+    exact = float(F.binary_average_precision(jnp.asarray(_bp), jnp.asarray(_bt), thresholds=None))
+    binned = float(F.binary_average_precision(jnp.asarray(_bp), jnp.asarray(_bt), thresholds=500))
+    assert binned == pytest.approx(exact, abs=0.01)
+
+
+def test_binned_pr_curve_shapes():
+    p, r, t = F.binary_precision_recall_curve(jnp.asarray(_bp), jnp.asarray(_bt), thresholds=50)
+    assert p.shape == (51,) and r.shape == (51,) and t.shape == (50,)
+    p, r, t = F.multiclass_precision_recall_curve(
+        jnp.asarray(_mp), jnp.asarray(_mt), num_classes=NUM_CLASSES, thresholds=50
+    )
+    assert p.shape == (NUM_CLASSES, 51) and r.shape == (NUM_CLASSES, 51) and t.shape == (50,)
+
+
+def test_binned_stateful_merge_and_ingraph():
+    tester = MetricTester()
+
+    def ref(preds, target):
+        # binned AP reference: exact sklearn is within binning tolerance at T=500
+        return sk.average_precision_score(target, preds)
+
+    m = BinaryAveragePrecision(thresholds=500)
+    for i in range(NUM_BATCHES):
+        m.update(jnp.asarray(_bin_preds[i]), jnp.asarray(_bin_target[i]))
+    assert float(m.compute()) == pytest.approx(ref(_bp, _bt), abs=0.01)
+
+    tester.run_merge_state_test(
+        _bin_preds, _bin_target, partial(BinaryAveragePrecision, thresholds=500), ref, atol=0.01
+    )
+    tester.run_ingraph_sharded_test(
+        _bin_preds, _bin_target, partial(BinaryAveragePrecision, thresholds=500), ref, atol=0.01
+    )
+
+
+def test_exact_mode_list_state_stateful():
+    m = BinaryPrecisionRecallCurve(thresholds=None)
+    for i in range(NUM_BATCHES):
+        m.update(jnp.asarray(_bin_preds[i]), jnp.asarray(_bin_target[i]))
+    p, r, t = m.compute()
+    skp, skr, skt = sk.precision_recall_curve(_bt, _bp)
+    np.testing.assert_allclose(np.asarray(p), skp, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(r), skr, atol=1e-6)
+
+
+def test_roc_class_binned():
+    m = BinaryROC(thresholds=101)
+    m.update(jnp.asarray(_bp), jnp.asarray(_bt))
+    fpr, tpr, thr = m.compute()
+    assert fpr.shape == (101,) and tpr.shape == (101,)
+    # fpr/tpr monotone non-decreasing when thresholds descend
+    assert bool(jnp.all(jnp.diff(fpr) >= 0))
+    assert bool(jnp.all(jnp.diff(tpr) >= 0))
+
+
+def test_auroc_ignore_index():
+    target = np.where(_bt[:50] == 0, -1, _bt[:50])  # ignore all negatives → degenerate
+    # mixed case instead: ignore arbitrary quarter
+    target = _bt.copy()
+    target[::4] = -1
+    ours = float(F.binary_auroc(jnp.asarray(_bp), jnp.asarray(target), thresholds=None, ignore_index=-1))
+    keep = target != -1
+    ref = sk.roc_auc_score(_bt[keep], _bp[keep])
+    assert ours == pytest.approx(ref, abs=1e-6)
+
+
+def test_multiclass_pr_curve_micro():
+    p, r, t = F.multiclass_precision_recall_curve(
+        jnp.asarray(_mp), jnp.asarray(_mt), num_classes=NUM_CLASSES, thresholds=None, average="micro"
+    )
+    t_oh = np.eye(NUM_CLASSES)[_mt].reshape(-1)
+    skp, skr, _ = sk.precision_recall_curve(t_oh, _mp.reshape(-1))
+    np.testing.assert_allclose(np.asarray(p), skp, atol=1e-6)
+
+
+def test_multilabel_exact_curve_ignore_index():
+    """Regression: exact path must filter ignored samples per label, not count them
+    as negatives (found in review; reference remaps only when thresholds given)."""
+    preds = jnp.asarray([[0.9, 0.9], [0.8, 0.8], [0.1, 0.1], [0.2, 0.2]])
+    target = jnp.asarray([[1, 1], [-1, -1], [0, 0], [-1, -1]])
+    p, r, t = F.multilabel_precision_recall_curve(preds, target, num_labels=2, thresholds=None, ignore_index=-1)
+    skp, skr, _ = sk.precision_recall_curve([1, 0], [0.9, 0.1])
+    np.testing.assert_allclose(np.asarray(p[0]), skp, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(r[0]), skr, atol=1e-6)
+    ours = float(F.multilabel_auroc(preds, target, num_labels=2, average="macro", thresholds=None, ignore_index=-1))
+    assert ours == pytest.approx(1.0)

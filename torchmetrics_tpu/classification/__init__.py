@@ -1,10 +1,20 @@
 from .accuracy import Accuracy, BinaryAccuracy, MulticlassAccuracy, MultilabelAccuracy
+from .auroc import AUROC, BinaryAUROC, MulticlassAUROC, MultilabelAUROC
+from .average_precision import (
+    AveragePrecision,
+    BinaryAveragePrecision,
+    MulticlassAveragePrecision,
+    MultilabelAveragePrecision,
+)
+from .calibration_error import BinaryCalibrationError, CalibrationError, MulticlassCalibrationError
+from .cohen_kappa import BinaryCohenKappa, CohenKappa, MulticlassCohenKappa
 from .confusion_matrix import (
     BinaryConfusionMatrix,
     ConfusionMatrix,
     MulticlassConfusionMatrix,
     MultilabelConfusionMatrix,
 )
+from .exact_match import ExactMatch, MulticlassExactMatch, MultilabelExactMatch
 from .f_beta import (
     BinaryF1Score,
     BinaryFBetaScore,
@@ -20,6 +30,14 @@ from .hamming import (
     HammingDistance,
     MulticlassHammingDistance,
     MultilabelHammingDistance,
+)
+from .hinge import BinaryHingeLoss, HingeLoss, MulticlassHingeLoss
+from .jaccard import BinaryJaccardIndex, JaccardIndex, MulticlassJaccardIndex, MultilabelJaccardIndex
+from .matthews_corrcoef import (
+    BinaryMatthewsCorrCoef,
+    MatthewsCorrCoef,
+    MulticlassMatthewsCorrCoef,
+    MultilabelMatthewsCorrCoef,
 )
 from .negative_predictive_value import (
     BinaryNegativePredictiveValue,
@@ -43,6 +61,18 @@ from .specificity import (
     MultilabelSpecificity,
     Specificity,
 )
+from .precision_recall_curve import (
+    BinaryPrecisionRecallCurve,
+    MulticlassPrecisionRecallCurve,
+    MultilabelPrecisionRecallCurve,
+    PrecisionRecallCurve,
+)
+from .ranking import (
+    MultilabelCoverageError,
+    MultilabelRankingAveragePrecision,
+    MultilabelRankingLoss,
+)
+from .roc import ROC, BinaryROC, MulticlassROC, MultilabelROC
 from .stat_scores import (
     BinaryStatScores,
     MulticlassStatScores,
@@ -51,6 +81,17 @@ from .stat_scores import (
 )
 
 __all__ = [
+    "BinaryCalibrationError", "CalibrationError", "MulticlassCalibrationError",
+    "BinaryCohenKappa", "CohenKappa", "MulticlassCohenKappa",
+    "ExactMatch", "MulticlassExactMatch", "MultilabelExactMatch",
+    "BinaryHingeLoss", "HingeLoss", "MulticlassHingeLoss",
+    "BinaryJaccardIndex", "JaccardIndex", "MulticlassJaccardIndex", "MultilabelJaccardIndex",
+    "BinaryMatthewsCorrCoef", "MatthewsCorrCoef", "MulticlassMatthewsCorrCoef", "MultilabelMatthewsCorrCoef",
+    "MultilabelCoverageError", "MultilabelRankingAveragePrecision", "MultilabelRankingLoss",
+    "AUROC", "BinaryAUROC", "MulticlassAUROC", "MultilabelAUROC",
+    "AveragePrecision", "BinaryAveragePrecision", "MulticlassAveragePrecision", "MultilabelAveragePrecision",
+    "BinaryPrecisionRecallCurve", "MulticlassPrecisionRecallCurve", "MultilabelPrecisionRecallCurve",
+    "PrecisionRecallCurve", "ROC", "BinaryROC", "MulticlassROC", "MultilabelROC",
     "Accuracy", "BinaryAccuracy", "MulticlassAccuracy", "MultilabelAccuracy",
     "BinaryConfusionMatrix", "ConfusionMatrix", "MulticlassConfusionMatrix", "MultilabelConfusionMatrix",
     "BinaryF1Score", "BinaryFBetaScore", "F1Score", "FBetaScore",

@@ -1,0 +1,124 @@
+"""CalibrationError metric classes (reference ``classification/calibration_error.py:42,190``).
+
+State: per-bin sufficient statistics (static shapes, sum-reduced) — see the functional
+module's TPU note; the reference keeps unbounded confidence lists instead."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+
+from ..functional.classification.calibration_error import (
+    _binary_calibration_error_arg_validation,
+    _binary_calibration_error_format,
+    _binary_calibration_error_tensor_validation,
+    _binned_stats_update,
+    _ce_compute_from_bins,
+    _multiclass_calibration_error_arg_validation,
+    _multiclass_calibration_error_update,
+)
+from ..functional.classification.stat_scores import _multiclass_stat_scores_tensor_validation
+from ..metric import Metric
+from ..utilities.compute import normalize_logits_if_needed
+from ..utilities.enums import ClassificationTaskNoMultilabel
+from .base import _ClassificationTaskWrapper
+
+
+class _CalibrationBase(Metric):
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def _create_state(self, n_bins: int) -> None:
+        self.add_state("conf_bin", default=jnp.zeros((n_bins + 1,), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("acc_bin", default=jnp.zeros((n_bins + 1,), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("count_bin", default=jnp.zeros((n_bins + 1,), jnp.float32), dist_reduce_fx="sum")
+
+    def _compute(self, state):
+        return _ce_compute_from_bins(state["conf_bin"], state["acc_bin"], state["count_bin"], self.norm)
+
+
+class BinaryCalibrationError(_CalibrationBase):
+    def __init__(
+        self, n_bins: int = 15, norm: str = "l1", ignore_index: Optional[int] = None,
+        validate_args: bool = True, **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            _binary_calibration_error_arg_validation(n_bins, norm, ignore_index)
+        self.n_bins = n_bins
+        self.norm = norm
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self._create_state(n_bins)
+
+    def _prepare_inputs(self, preds, target):
+        if self.validate_args:
+            _binary_calibration_error_tensor_validation(preds, target, self.ignore_index)
+        return (preds, target), {}
+
+    def _batch_state(self, preds, target):
+        p, t, w = _binary_calibration_error_format(preds, target, self.ignore_index)
+        conf, acc, count = _binned_stats_update(p, t, self.n_bins, w)
+        return {"conf_bin": conf, "acc_bin": acc, "count_bin": count}
+
+
+class MulticlassCalibrationError(_CalibrationBase):
+    def __init__(
+        self, num_classes: int, n_bins: int = 15, norm: str = "l1", ignore_index: Optional[int] = None,
+        validate_args: bool = True, **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            _multiclass_calibration_error_arg_validation(num_classes, n_bins, norm, ignore_index)
+        self.num_classes = num_classes
+        self.n_bins = n_bins
+        self.norm = norm
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self._create_state(n_bins)
+
+    def _prepare_inputs(self, preds, target):
+        if self.validate_args:
+            _multiclass_stat_scores_tensor_validation(preds, target, self.num_classes, "global", self.ignore_index)
+        return (preds, target), {}
+
+    def _batch_state(self, preds, target):
+        preds = jnp.asarray(preds).astype(jnp.float32)
+        target = jnp.asarray(target).reshape(-1)
+        preds = normalize_logits_if_needed(preds, "softmax")
+        if self.ignore_index is not None:
+            w = (target != self.ignore_index).astype(jnp.float32)
+            target = jnp.where(w == 1, target, 0)
+        else:
+            w = jnp.ones(target.shape, jnp.float32)
+        confidences, accuracies = _multiclass_calibration_error_update(
+            preds, jnp.clip(target, 0, self.num_classes - 1)
+        )
+        conf, acc, count = _binned_stats_update(confidences, accuracies, self.n_bins, w)
+        return {"conf_bin": conf, "acc_bin": acc, "count_bin": count}
+
+
+class CalibrationError(_ClassificationTaskWrapper):
+    def __new__(
+        cls,
+        task: str,
+        n_bins: int = 15,
+        norm: str = "l1",
+        num_classes: Optional[int] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> Metric:
+        task = ClassificationTaskNoMultilabel.from_str(task)
+        kwargs.update({"n_bins": n_bins, "norm": norm, "ignore_index": ignore_index, "validate_args": validate_args})
+        if task == ClassificationTaskNoMultilabel.BINARY:
+            return BinaryCalibrationError(**kwargs)
+        if task == ClassificationTaskNoMultilabel.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            return MulticlassCalibrationError(num_classes, **kwargs)
+        raise ValueError(f"Not handled value: {task}")

@@ -1,0 +1,111 @@
+"""Jaccard index metric classes (reference ``classification/jaccard.py:40``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from ..functional.classification.jaccard import _jaccard_index_reduce
+from ..metric import Metric
+from ..utilities.enums import ClassificationTask
+from .base import _ClassificationTaskWrapper
+from .confusion_matrix import BinaryConfusionMatrix, MulticlassConfusionMatrix, MultilabelConfusionMatrix
+
+
+class BinaryJaccardIndex(BinaryConfusionMatrix):
+    is_differentiable = False
+    higher_is_better = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self, threshold: float = 0.5, ignore_index: Optional[int] = None, validate_args: bool = True,
+        zero_division: float = 0.0, **kwargs: Any,
+    ) -> None:
+        super().__init__(threshold, ignore_index, normalize=None, validate_args=validate_args, **kwargs)
+        self.zero_division = zero_division
+
+    def _compute(self, state):
+        return _jaccard_index_reduce(state["confmat"], average="binary", zero_division=self.zero_division)
+
+    def plot(self, val=None, ax=None):
+        return Metric.plot(self, *([val] if val is not None else []), ax=ax)
+
+
+class MulticlassJaccardIndex(MulticlassConfusionMatrix):
+    is_differentiable = False
+    higher_is_better = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+    plot_legend_name = "Class"
+
+    def __init__(
+        self, num_classes: int, average: Optional[str] = "macro", ignore_index: Optional[int] = None,
+        validate_args: bool = True, zero_division: float = 0.0, **kwargs: Any,
+    ) -> None:
+        super().__init__(num_classes, ignore_index, normalize=None, validate_args=validate_args, **kwargs)
+        if validate_args and average not in ("micro", "macro", "weighted", "none", None):
+            raise ValueError(f"Expected argument `average` to be one of ('micro', 'macro', 'weighted', 'none', None)"
+                             f" but got {average}")
+        self.average = average
+        self.zero_division = zero_division
+
+    def _compute(self, state):
+        return _jaccard_index_reduce(
+            state["confmat"], average=self.average, ignore_index=self.ignore_index, zero_division=self.zero_division
+        )
+
+    def plot(self, val=None, ax=None):
+        return Metric.plot(self, *([val] if val is not None else []), ax=ax)
+
+
+class MultilabelJaccardIndex(MultilabelConfusionMatrix):
+    is_differentiable = False
+    higher_is_better = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+    plot_legend_name = "Label"
+
+    def __init__(
+        self, num_labels: int, threshold: float = 0.5, average: Optional[str] = "macro",
+        ignore_index: Optional[int] = None, validate_args: bool = True, zero_division: float = 0.0, **kwargs: Any,
+    ) -> None:
+        super().__init__(num_labels, threshold, ignore_index, normalize=None, validate_args=validate_args, **kwargs)
+        if validate_args and average not in ("micro", "macro", "weighted", "none", None):
+            raise ValueError(f"Expected argument `average` to be one of ('micro', 'macro', 'weighted', 'none', None)"
+                             f" but got {average}")
+        self.average = average
+        self.zero_division = zero_division
+
+    def _compute(self, state):
+        return _jaccard_index_reduce(state["confmat"], average=self.average, zero_division=self.zero_division)
+
+    def plot(self, val=None, ax=None):
+        return Metric.plot(self, *([val] if val is not None else []), ax=ax)
+
+
+class JaccardIndex(_ClassificationTaskWrapper):
+    def __new__(
+        cls,
+        task: str,
+        threshold: float = 0.5,
+        num_classes: Optional[int] = None,
+        num_labels: Optional[int] = None,
+        average: Optional[str] = "macro",
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        zero_division: float = 0.0,
+        **kwargs: Any,
+    ) -> Metric:
+        task = ClassificationTask.from_str(task)
+        kwargs.update({"ignore_index": ignore_index, "validate_args": validate_args, "zero_division": zero_division})
+        if task == ClassificationTask.BINARY:
+            return BinaryJaccardIndex(threshold, **kwargs)
+        if task == ClassificationTask.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            return MulticlassJaccardIndex(num_classes, average, **kwargs)
+        if task == ClassificationTask.MULTILABEL:
+            if not isinstance(num_labels, int):
+                raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+            return MultilabelJaccardIndex(num_labels, threshold, average, **kwargs)
+        raise ValueError(f"Not handled value: {task}")

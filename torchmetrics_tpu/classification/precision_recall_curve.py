@@ -1,0 +1,255 @@
+"""PR-curve metric classes — the curve-family state holders.
+
+Parity: reference ``classification/precision_recall_curve.py``
+(BinaryPrecisionRecallCurve:55, binned-vs-cat states:239,441).
+
+State families (SURVEY §2.3): ``thresholds=None`` → cat-list states of raw
+preds/target (exact curve, host-side sort at compute — jit disabled since filtered
+shapes are dynamic); ``thresholds`` given → ONE sum-reduced ``(T,[C,]2,2)`` confusion
+tensor updated by a fused einsum (the TPU-native default; prefer it on TPU).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..functional.classification.precision_recall_curve import (
+    _adjust_threshold_arg,
+    _binary_precision_recall_curve_arg_validation,
+    _binary_precision_recall_curve_compute,
+    _binary_precision_recall_curve_format,
+    _binary_precision_recall_curve_tensor_validation,
+    _binary_precision_recall_curve_update,
+    _multiclass_precision_recall_curve_arg_validation,
+    _multiclass_precision_recall_curve_compute,
+    _multiclass_precision_recall_curve_format,
+    _multiclass_precision_recall_curve_tensor_validation,
+    _multiclass_precision_recall_curve_update,
+    _multilabel_precision_recall_curve_arg_validation,
+    _multilabel_precision_recall_curve_compute,
+    _multilabel_precision_recall_curve_format,
+    _multilabel_precision_recall_curve_tensor_validation,
+    _multilabel_precision_recall_curve_update,
+)
+from ..metric import Metric
+from ..utilities.enums import ClassificationTask
+from .base import _ClassificationTaskWrapper
+
+
+class BinaryPrecisionRecallCurve(Metric):
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update = False
+
+    def __init__(
+        self,
+        thresholds: Optional[Union[int, List[float], Any]] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self.thresholds = _adjust_threshold_arg(thresholds)
+        if self.thresholds is None:
+            self.add_state("preds", default=[], dist_reduce_fx="cat")
+            self.add_state("target", default=[], dist_reduce_fx="cat")
+            self._enable_jit = False
+            self._jittable_compute = False
+        else:
+            self.add_state(
+                "confmat", default=jnp.zeros((len(self.thresholds), 2, 2), jnp.int32), dist_reduce_fx="sum"
+            )
+
+    def _prepare_inputs(self, preds, target):
+        if self.validate_args:
+            _binary_precision_recall_curve_tensor_validation(preds, target, self.ignore_index)
+        if self.thresholds is None and self.ignore_index is not None:
+            keep = np.asarray(target).reshape(-1) != self.ignore_index
+            preds = jnp.asarray(preds).reshape(-1)[keep]
+            target = jnp.asarray(target).reshape(-1)[keep]
+        return (preds, target), {}
+
+    def _batch_state(self, preds, target):
+        p, t, thr, w = _binary_precision_recall_curve_format(
+            preds, target, self.thresholds, self.ignore_index if self.thresholds is not None else None
+        )
+        if self.thresholds is None:
+            return {"preds": p, "target": t}
+        return {"confmat": _binary_precision_recall_curve_update(p, t, self.thresholds, w)}
+
+    def _curve_state(self, state):
+        if self.thresholds is None:
+            return (state["preds"], state["target"])
+        return state["confmat"]
+
+    def _compute(self, state):
+        return _binary_precision_recall_curve_compute(self._curve_state(state), self.thresholds)
+
+    def plot(self, curve=None, score=None, ax=None):
+        from ..utilities.plot import plot_curve
+
+        curve = curve or self.compute()
+        return plot_curve(curve, score=score, ax=ax, label_names=("Recall", "Precision"), name=type(self).__name__)
+
+
+class MulticlassPrecisionRecallCurve(Metric):
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update = False
+    plot_legend_name = "Class"
+
+    def __init__(
+        self,
+        num_classes: int,
+        thresholds: Optional[Union[int, List[float], Any]] = None,
+        average: Optional[str] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index, average)
+        self.num_classes = num_classes
+        self.average = average
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self.thresholds = _adjust_threshold_arg(thresholds)
+        if self.thresholds is None:
+            self.add_state("preds", default=[], dist_reduce_fx="cat")
+            self.add_state("target", default=[], dist_reduce_fx="cat")
+            self._enable_jit = False
+            self._jittable_compute = False
+        else:
+            shape = (len(self.thresholds), 2, 2) if average == "micro" else (len(self.thresholds), num_classes, 2, 2)
+            self.add_state("confmat", default=jnp.zeros(shape, jnp.int32), dist_reduce_fx="sum")
+
+    def _prepare_inputs(self, preds, target):
+        if self.validate_args:
+            _multiclass_precision_recall_curve_tensor_validation(preds, target, self.num_classes, self.ignore_index)
+        return (preds, target), {}
+
+    def _batch_state(self, preds, target):
+        p, t, thr, w = _multiclass_precision_recall_curve_format(
+            preds, target, self.num_classes, self.thresholds, self.ignore_index, self.average
+        )
+        if self.thresholds is None:
+            if self.ignore_index is not None:
+                keep = np.asarray(w) == 1
+                p, t = p[keep], t[keep]
+            return {"preds": p, "target": t}
+        return {
+            "confmat": _multiclass_precision_recall_curve_update(
+                p, t, self.num_classes, self.thresholds, w, self.average
+            )
+        }
+
+    def _curve_state(self, state):
+        if self.thresholds is None:
+            return (state["preds"], state["target"])
+        return state["confmat"]
+
+    def _compute(self, state):
+        return _multiclass_precision_recall_curve_compute(
+            self._curve_state(state), self.num_classes, self.thresholds, self.average
+        )
+
+    def plot(self, curve=None, score=None, ax=None):
+        from ..utilities.plot import plot_curve
+
+        curve = curve or self.compute()
+        return plot_curve(curve, score=score, ax=ax, label_names=("Recall", "Precision"), name=type(self).__name__)
+
+
+class MultilabelPrecisionRecallCurve(Metric):
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update = False
+    plot_legend_name = "Label"
+
+    def __init__(
+        self,
+        num_labels: int,
+        thresholds: Optional[Union[int, List[float], Any]] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            _multilabel_precision_recall_curve_arg_validation(num_labels, thresholds, ignore_index)
+        self.num_labels = num_labels
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self.thresholds = _adjust_threshold_arg(thresholds)
+        if self.thresholds is None:
+            self.add_state("preds", default=[], dist_reduce_fx="cat")
+            self.add_state("target", default=[], dist_reduce_fx="cat")
+            self._enable_jit = False
+            self._jittable_compute = False
+        else:
+            self.add_state(
+                "confmat", default=jnp.zeros((len(self.thresholds), num_labels, 2, 2), jnp.int32), dist_reduce_fx="sum"
+            )
+
+    def _prepare_inputs(self, preds, target):
+        if self.validate_args:
+            _multilabel_precision_recall_curve_tensor_validation(preds, target, self.num_labels, self.ignore_index)
+        return (preds, target), {}
+
+    def _batch_state(self, preds, target):
+        p, t, thr, w = _multilabel_precision_recall_curve_format(
+            preds, target, self.num_labels, self.thresholds, self.ignore_index
+        )
+        if self.thresholds is None:
+            return {"preds": p, "target": t}
+        return {"confmat": _multilabel_precision_recall_curve_update(p, t, self.num_labels, self.thresholds, w)}
+
+    def _curve_state(self, state):
+        if self.thresholds is None:
+            return (state["preds"], state["target"])
+        return state["confmat"]
+
+    def _compute(self, state):
+        return _multilabel_precision_recall_curve_compute(
+            self._curve_state(state), self.num_labels, self.thresholds, self.ignore_index
+        )
+
+    def plot(self, curve=None, score=None, ax=None):
+        from ..utilities.plot import plot_curve
+
+        curve = curve or self.compute()
+        return plot_curve(curve, score=score, ax=ax, label_names=("Recall", "Precision"), name=type(self).__name__)
+
+
+class PrecisionRecallCurve(_ClassificationTaskWrapper):
+    def __new__(
+        cls,
+        task: str,
+        thresholds=None,
+        num_classes: Optional[int] = None,
+        num_labels: Optional[int] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> Metric:
+        task = ClassificationTask.from_str(task)
+        kwargs.update({"thresholds": thresholds, "ignore_index": ignore_index, "validate_args": validate_args})
+        if task == ClassificationTask.BINARY:
+            return BinaryPrecisionRecallCurve(**kwargs)
+        if task == ClassificationTask.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            return MulticlassPrecisionRecallCurve(num_classes, **kwargs)
+        if task == ClassificationTask.MULTILABEL:
+            if not isinstance(num_labels, int):
+                raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+            return MultilabelPrecisionRecallCurve(num_labels, **kwargs)
+        raise ValueError(f"Not handled value: {task}")

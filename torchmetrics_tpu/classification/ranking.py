@@ -1,0 +1,65 @@
+"""Multilabel ranking metric classes (reference ``classification/ranking.py:41,172,302``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+
+from ..functional.classification.ranking import (
+    _multilabel_coverage_error_update,
+    _multilabel_ranking_average_precision_update,
+    _multilabel_ranking_format,
+    _multilabel_ranking_loss_update,
+    _multilabel_ranking_tensor_validation,
+    _ranking_reduce,
+)
+from ..metric import Metric
+
+
+class _RankingBase(Metric):
+    is_differentiable = False
+    full_state_update = False
+
+    def __init__(
+        self, num_labels: int, ignore_index: Optional[int] = None, validate_args: bool = True, **kwargs: Any
+    ) -> None:
+        super().__init__(**kwargs)
+        self.num_labels = num_labels
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self.add_state("measure", default=jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+
+    _update_fn = None  # (preds, target) -> (measure, total)
+
+    def _prepare_inputs(self, preds, target):
+        if self.validate_args:
+            _multilabel_ranking_tensor_validation(preds, target, self.num_labels, self.ignore_index)
+        return (preds, target), {}
+
+    def _batch_state(self, preds, target):
+        p, t = _multilabel_ranking_format(preds, target, self.num_labels, self.ignore_index)
+        measure, total = type(self)._update_fn(p, t)
+        return {"measure": measure, "total": total}
+
+    def _compute(self, state):
+        return _ranking_reduce(state["measure"], state["total"])
+
+
+class MultilabelCoverageError(_RankingBase):
+    higher_is_better = False
+    _update_fn = staticmethod(_multilabel_coverage_error_update)
+
+
+class MultilabelRankingAveragePrecision(_RankingBase):
+    higher_is_better = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+    _update_fn = staticmethod(_multilabel_ranking_average_precision_update)
+
+
+class MultilabelRankingLoss(_RankingBase):
+    higher_is_better = False
+    plot_lower_bound = 0.0
+    _update_fn = staticmethod(_multilabel_ranking_loss_update)

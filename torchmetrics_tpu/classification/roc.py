@@ -1,0 +1,79 @@
+"""ROC metric classes (reference ``classification/roc.py:42``) — curve-family
+subclasses overriding only ``_compute``."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from ..functional.classification.roc import (
+    _binary_roc_compute,
+    _multiclass_roc_compute,
+    _multilabel_roc_compute,
+)
+from ..metric import Metric
+from ..utilities.enums import ClassificationTask
+from .base import _ClassificationTaskWrapper
+from .precision_recall_curve import (
+    BinaryPrecisionRecallCurve,
+    MulticlassPrecisionRecallCurve,
+    MultilabelPrecisionRecallCurve,
+)
+
+
+class BinaryROC(BinaryPrecisionRecallCurve):
+    def _compute(self, state):
+        return _binary_roc_compute(self._curve_state(state), self.thresholds)
+
+    def plot(self, curve=None, score=None, ax=None):
+        from ..utilities.plot import plot_curve
+
+        curve = curve or self.compute()
+        return plot_curve(curve, score=score, ax=ax, label_names=("FPR", "TPR"), name=type(self).__name__)
+
+
+class MulticlassROC(MulticlassPrecisionRecallCurve):
+    def _compute(self, state):
+        return _multiclass_roc_compute(self._curve_state(state), self.num_classes, self.thresholds, self.average)
+
+    def plot(self, curve=None, score=None, ax=None):
+        from ..utilities.plot import plot_curve
+
+        curve = curve or self.compute()
+        return plot_curve(curve, score=score, ax=ax, label_names=("FPR", "TPR"), name=type(self).__name__)
+
+
+class MultilabelROC(MultilabelPrecisionRecallCurve):
+    def _compute(self, state):
+        return _multilabel_roc_compute(self._curve_state(state), self.num_labels, self.thresholds, self.ignore_index)
+
+    def plot(self, curve=None, score=None, ax=None):
+        from ..utilities.plot import plot_curve
+
+        curve = curve or self.compute()
+        return plot_curve(curve, score=score, ax=ax, label_names=("FPR", "TPR"), name=type(self).__name__)
+
+
+class ROC(_ClassificationTaskWrapper):
+    def __new__(
+        cls,
+        task: str,
+        thresholds=None,
+        num_classes: Optional[int] = None,
+        num_labels: Optional[int] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> Metric:
+        task = ClassificationTask.from_str(task)
+        kwargs.update({"thresholds": thresholds, "ignore_index": ignore_index, "validate_args": validate_args})
+        if task == ClassificationTask.BINARY:
+            return BinaryROC(**kwargs)
+        if task == ClassificationTask.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            return MulticlassROC(num_classes, **kwargs)
+        if task == ClassificationTask.MULTILABEL:
+            if not isinstance(num_labels, int):
+                raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+            return MultilabelROC(num_labels, **kwargs)
+        raise ValueError(f"Not handled value: {task}")

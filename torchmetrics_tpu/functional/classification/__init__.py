@@ -1,10 +1,20 @@
 from .accuracy import accuracy, binary_accuracy, multiclass_accuracy, multilabel_accuracy
+from .auroc import auroc, binary_auroc, multiclass_auroc, multilabel_auroc
+from .average_precision import (
+    average_precision,
+    binary_average_precision,
+    multiclass_average_precision,
+    multilabel_average_precision,
+)
+from .calibration_error import binary_calibration_error, calibration_error, multiclass_calibration_error
+from .cohen_kappa import binary_cohen_kappa, cohen_kappa, multiclass_cohen_kappa
 from .confusion_matrix import (
     binary_confusion_matrix,
     confusion_matrix,
     multiclass_confusion_matrix,
     multilabel_confusion_matrix,
 )
+from .exact_match import exact_match, multiclass_exact_match, multilabel_exact_match
 from .f_beta import (
     binary_f1_score,
     binary_fbeta_score,
@@ -20,6 +30,14 @@ from .hamming import (
     hamming_distance,
     multiclass_hamming_distance,
     multilabel_hamming_distance,
+)
+from .hinge import binary_hinge_loss, hinge_loss, multiclass_hinge_loss
+from .jaccard import binary_jaccard_index, jaccard_index, multiclass_jaccard_index, multilabel_jaccard_index
+from .matthews_corrcoef import (
+    binary_matthews_corrcoef,
+    matthews_corrcoef,
+    multiclass_matthews_corrcoef,
+    multilabel_matthews_corrcoef,
 )
 from .negative_predictive_value import (
     binary_negative_predictive_value,
@@ -43,6 +61,18 @@ from .specificity import (
     multilabel_specificity,
     specificity,
 )
+from .precision_recall_curve import (
+    binary_precision_recall_curve,
+    multiclass_precision_recall_curve,
+    multilabel_precision_recall_curve,
+    precision_recall_curve,
+)
+from .ranking import (
+    multilabel_coverage_error,
+    multilabel_ranking_average_precision,
+    multilabel_ranking_loss,
+)
+from .roc import binary_roc, multiclass_roc, multilabel_roc, roc
 from .stat_scores import (
     binary_stat_scores,
     multiclass_stat_scores,

@@ -1,0 +1,199 @@
+"""AUROC. Parity: reference ``functional/classification/auroc.py``
+(_reduce_auroc:45-70, _binary_auroc_compute:83-107, multiclass/multilabel below)."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...utilities.compute import _auc_compute, _safe_divide
+from ...utilities.prints import rank_zero_warn
+from .precision_recall_curve import (
+    _binary_precision_recall_curve_arg_validation,
+    _binary_precision_recall_curve_format,
+    _binary_precision_recall_curve_tensor_validation,
+    _binary_precision_recall_curve_update,
+    _multiclass_precision_recall_curve_arg_validation,
+    _multiclass_precision_recall_curve_format,
+    _multiclass_precision_recall_curve_tensor_validation,
+    _multiclass_precision_recall_curve_update,
+    _multilabel_precision_recall_curve_arg_validation,
+    _multilabel_precision_recall_curve_format,
+    _multilabel_precision_recall_curve_tensor_validation,
+    _multilabel_precision_recall_curve_update,
+)
+from .roc import _binary_roc_compute, _multiclass_roc_compute, _multilabel_roc_compute
+
+Array = jax.Array
+
+
+def _reduce_auroc(fpr, tpr, average: Optional[str] = "macro", weights=None, direction: float = 1.0) -> Array:
+    """Reduce per-class AUCs (reference auroc.py:45-70)."""
+    if not isinstance(fpr, list):
+        res = jax.vmap(lambda x, y: _auc_compute(x, y, direction=direction))(fpr, tpr)
+    else:
+        res = jnp.stack([_auc_compute(x, y, direction=direction) for x, y in zip(fpr, tpr)])
+    if average is None or average == "none":
+        return res
+    if bool(jnp.isnan(res).any()):
+        rank_zero_warn(
+            f"Average precision score for one or more classes was `nan`. Ignoring these classes in {average}-average",
+            UserWarning,
+        )
+    idx = ~jnp.isnan(res)
+    if average == "macro":
+        return (jnp.where(idx, res, 0.0).sum()) / idx.sum()
+    if average == "weighted" and weights is not None:
+        weights = jnp.where(idx, jnp.asarray(weights, jnp.float32), 0.0)
+        weights = _safe_divide(weights, weights.sum())
+        return (jnp.where(idx, res, 0.0) * weights).sum()
+    raise ValueError("Received an incompatible combinations of inputs to make reduction.")
+
+
+def _binary_auroc_arg_validation(max_fpr: Optional[float] = None, thresholds=None, ignore_index=None) -> None:
+    if max_fpr is not None and not (isinstance(max_fpr, float) and 0 < max_fpr <= 1):
+        raise ValueError(f"Argument `max_fpr` should be a float in range (0, 1], but got: {max_fpr}")
+    _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+
+
+def _binary_auroc_compute(state, thresholds: Optional[Array], max_fpr: Optional[float] = None, pos_label: int = 1) -> Array:
+    fpr, tpr, _ = _binary_roc_compute(state, thresholds, pos_label)
+    if max_fpr is None or max_fpr == 1 or float(jnp.sum(fpr)) == 0 or float(jnp.sum(tpr)) == 0:
+        return _auc_compute(fpr, tpr, direction=1.0)
+    # partial AUC with McClish correction (reference auroc.py:94-107)
+    stop = int(np.searchsorted(np.asarray(fpr), max_fpr, side="right"))
+    weight = (max_fpr - float(fpr[stop - 1])) / (float(fpr[stop]) - float(fpr[stop - 1]))
+    interp_tpr = float(tpr[stop - 1]) * (1 - weight) + float(tpr[stop]) * weight
+    tpr_p = jnp.concatenate([tpr[:stop], jnp.asarray([interp_tpr], tpr.dtype)])
+    fpr_p = jnp.concatenate([fpr[:stop], jnp.asarray([max_fpr], fpr.dtype)])
+    partial_auc = _auc_compute(fpr_p, tpr_p, direction=1.0)
+    min_area = 0.5 * max_fpr**2
+    return 0.5 * (1 + (partial_auc - min_area) / (max_fpr - min_area))
+
+
+def binary_auroc(
+    preds, target, max_fpr: Optional[float] = None, thresholds=None, ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    if validate_args:
+        _binary_auroc_arg_validation(max_fpr, thresholds, ignore_index)
+        _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
+    preds, target, thresholds, w = _binary_precision_recall_curve_format(preds, target, thresholds, ignore_index)
+    if thresholds is None and ignore_index is not None:
+        keep = np.asarray(w) == 1
+        preds, target = preds[keep], target[keep]
+    state = _binary_precision_recall_curve_update(preds, target, thresholds, w)
+    return _binary_auroc_compute(state, thresholds, max_fpr)
+
+
+def _multiclass_auroc_arg_validation(num_classes, average="macro", thresholds=None, ignore_index=None) -> None:
+    if average not in ("macro", "weighted", "none", None):
+        raise ValueError(f"Expected argument `average` to be one of ('macro', 'weighted', 'none', None) but got {average}")
+    _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index)
+
+
+def _multiclass_auroc_compute(
+    state, num_classes: int, average: Optional[str] = "macro", thresholds: Optional[Array] = None
+) -> Array:
+    fpr, tpr, _ = _multiclass_roc_compute(state, num_classes, thresholds)
+    # support per class = positives per class
+    if not isinstance(state, tuple) and thresholds is not None:
+        weights = (state[0, :, 1, 0] + state[0, :, 1, 1]).astype(jnp.float32)
+    else:
+        weights = jnp.asarray(np.bincount(np.asarray(state[1]), minlength=num_classes), jnp.float32)
+    return _reduce_auroc(fpr, tpr, average, weights=weights)
+
+
+def multiclass_auroc(
+    preds, target, num_classes: int, average: Optional[str] = "macro", thresholds=None,
+    ignore_index: Optional[int] = None, validate_args: bool = True,
+) -> Array:
+    if validate_args:
+        _multiclass_auroc_arg_validation(num_classes, average, thresholds, ignore_index)
+        _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target, thresholds, w = _multiclass_precision_recall_curve_format(
+        preds, target, num_classes, thresholds, ignore_index
+    )
+    if thresholds is None and ignore_index is not None:
+        keep = np.asarray(w) == 1
+        preds, target = preds[keep], target[keep]
+    state = _multiclass_precision_recall_curve_update(preds, target, num_classes, thresholds, w)
+    return _multiclass_auroc_compute(state, num_classes, average, thresholds)
+
+
+def _multilabel_auroc_arg_validation(num_labels, average="macro", thresholds=None, ignore_index=None) -> None:
+    if average not in ("micro", "macro", "weighted", "none", None):
+        raise ValueError(
+            f"Expected argument `average` to be one of ('micro', 'macro', 'weighted', 'none', None) but got {average}"
+        )
+    _multilabel_precision_recall_curve_arg_validation(num_labels, thresholds, ignore_index)
+
+
+def _multilabel_auroc_compute(
+    state, num_labels: int, average: Optional[str] = "macro", thresholds: Optional[Array] = None,
+    ignore_index: Optional[int] = None,
+) -> Array:
+    if average == "micro":
+        if not isinstance(state, tuple) and thresholds is not None:
+            return _binary_auroc_compute(state.sum(1), thresholds, max_fpr=None)
+        preds = np.asarray(state[0]).reshape(-1)
+        target = np.asarray(state[1]).reshape(-1)
+        if ignore_index is not None:
+            keep = target != ignore_index
+            preds, target = preds[keep], target[keep]
+        return _binary_auroc_compute((jnp.asarray(preds), jnp.asarray(target)), None, max_fpr=None)
+    fpr, tpr, _ = _multilabel_roc_compute(state, num_labels, thresholds, ignore_index)
+    if not isinstance(state, tuple) and thresholds is not None:
+        weights = (state[0, :, 1, 0] + state[0, :, 1, 1]).astype(jnp.float32)
+    else:
+        t = np.asarray(state[1])
+        if ignore_index is not None:
+            t = np.where(t == ignore_index, 0, t)
+        weights = jnp.asarray((t == 1).sum(0), jnp.float32)
+    return _reduce_auroc(fpr, tpr, average, weights=weights)
+
+
+def multilabel_auroc(
+    preds, target, num_labels: int, average: Optional[str] = "macro", thresholds=None,
+    ignore_index: Optional[int] = None, validate_args: bool = True,
+) -> Array:
+    if validate_args:
+        _multilabel_auroc_arg_validation(num_labels, average, thresholds, ignore_index)
+        _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target, thresholds, w = _multilabel_precision_recall_curve_format(
+        preds, target, num_labels, thresholds, ignore_index
+    )
+    state = _multilabel_precision_recall_curve_update(preds, target, num_labels, thresholds, w)
+    return _multilabel_auroc_compute(state, num_labels, average, thresholds, ignore_index)
+
+
+def auroc(
+    preds,
+    target,
+    task: str,
+    thresholds=None,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    average: Optional[str] = "macro",
+    max_fpr: Optional[float] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+):
+    """Task facade."""
+    from ...utilities.enums import ClassificationTask
+
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_auroc(preds, target, max_fpr, thresholds, ignore_index, validate_args)
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_auroc(preds, target, num_classes, average, thresholds, ignore_index, validate_args)
+    if task == ClassificationTask.MULTILABEL:
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+        return multilabel_auroc(preds, target, num_labels, average, thresholds, ignore_index, validate_args)
+    raise ValueError(f"Not handled value: {task}")

@@ -1,0 +1,188 @@
+"""Average precision. Parity: reference
+``functional/classification/average_precision.py`` (_reduce_average_precision:43-68,
+_binary_average_precision_compute:72-79, multiclass:168, multilabel below)."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...utilities.compute import _safe_divide
+from ...utilities.prints import rank_zero_warn
+from .precision_recall_curve import (
+    _binary_precision_recall_curve_arg_validation,
+    _binary_precision_recall_curve_compute,
+    _binary_precision_recall_curve_format,
+    _binary_precision_recall_curve_tensor_validation,
+    _binary_precision_recall_curve_update,
+    _multiclass_precision_recall_curve_arg_validation,
+    _multiclass_precision_recall_curve_compute,
+    _multiclass_precision_recall_curve_format,
+    _multiclass_precision_recall_curve_tensor_validation,
+    _multiclass_precision_recall_curve_update,
+    _multilabel_precision_recall_curve_arg_validation,
+    _multilabel_precision_recall_curve_compute,
+    _multilabel_precision_recall_curve_format,
+    _multilabel_precision_recall_curve_tensor_validation,
+    _multilabel_precision_recall_curve_update,
+)
+
+Array = jax.Array
+
+
+def _nan_to_zero(x: Array) -> Array:
+    return jnp.where(jnp.isnan(x), jnp.zeros_like(x), x)
+
+
+def _reduce_average_precision(precision, recall, average: Optional[str] = "macro", weights=None) -> Array:
+    if not isinstance(precision, list):
+        p, r = _nan_to_zero(precision), _nan_to_zero(recall)
+        res = -jnp.sum((r[:, 1:] - r[:, :-1]) * p[:, :-1], axis=1)
+    else:
+        res = jnp.stack([-jnp.sum((_nan_to_zero(r)[1:] - _nan_to_zero(r)[:-1]) * _nan_to_zero(p)[:-1]) for p, r in zip(precision, recall)])
+    if average is None or average == "none":
+        return res
+    if bool(jnp.isnan(res).any()):
+        rank_zero_warn(
+            f"Average precision score for one or more classes was `nan`. Ignoring these classes in {average}-average",
+            UserWarning,
+        )
+    idx = ~jnp.isnan(res)
+    if average == "macro":
+        return jnp.where(idx, res, 0.0).sum() / idx.sum()
+    if average == "weighted" and weights is not None:
+        weights = jnp.where(idx, jnp.asarray(weights, jnp.float32), 0.0)
+        weights = _safe_divide(weights, weights.sum())
+        return (jnp.where(idx, res, 0.0) * weights).sum()
+    raise ValueError("Received an incompatible combinations of inputs to make reduction.")
+
+
+def _binary_average_precision_compute(state, thresholds: Optional[Array]) -> Array:
+    precision, recall, _ = _binary_precision_recall_curve_compute(state, thresholds)
+    p, r = _nan_to_zero(precision), _nan_to_zero(recall)
+    return -jnp.sum((r[1:] - r[:-1]) * p[:-1])
+
+
+def binary_average_precision(
+    preds, target, thresholds=None, ignore_index: Optional[int] = None, validate_args: bool = True
+) -> Array:
+    if validate_args:
+        _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+        _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
+    preds, target, thresholds, w = _binary_precision_recall_curve_format(preds, target, thresholds, ignore_index)
+    if thresholds is None and ignore_index is not None:
+        keep = np.asarray(w) == 1
+        preds, target = preds[keep], target[keep]
+    state = _binary_precision_recall_curve_update(preds, target, thresholds, w)
+    return _binary_average_precision_compute(state, thresholds)
+
+
+def _multiclass_average_precision_arg_validation(num_classes, average="macro", thresholds=None, ignore_index=None):
+    if average not in ("macro", "weighted", "none", None):
+        raise ValueError(f"Expected argument `average` to be one of ('macro', 'weighted', 'none', None) but got {average}")
+    _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index)
+
+
+def _multiclass_average_precision_compute(
+    state, num_classes: int, average: Optional[str] = "macro", thresholds: Optional[Array] = None
+) -> Array:
+    precision, recall, _ = _multiclass_precision_recall_curve_compute(state, num_classes, thresholds)
+    if not isinstance(state, tuple) and thresholds is not None:
+        weights = (state[0, :, 1, 0] + state[0, :, 1, 1]).astype(jnp.float32)
+    else:
+        weights = jnp.asarray(np.bincount(np.asarray(state[1]), minlength=num_classes), jnp.float32)
+    return _reduce_average_precision(precision, recall, average, weights=weights)
+
+
+def multiclass_average_precision(
+    preds, target, num_classes: int, average: Optional[str] = "macro", thresholds=None,
+    ignore_index: Optional[int] = None, validate_args: bool = True,
+) -> Array:
+    if validate_args:
+        _multiclass_average_precision_arg_validation(num_classes, average, thresholds, ignore_index)
+        _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target, thresholds, w = _multiclass_precision_recall_curve_format(
+        preds, target, num_classes, thresholds, ignore_index
+    )
+    if thresholds is None and ignore_index is not None:
+        keep = np.asarray(w) == 1
+        preds, target = preds[keep], target[keep]
+    state = _multiclass_precision_recall_curve_update(preds, target, num_classes, thresholds, w)
+    return _multiclass_average_precision_compute(state, num_classes, average, thresholds)
+
+
+def _multilabel_average_precision_arg_validation(num_labels, average="macro", thresholds=None, ignore_index=None):
+    if average not in ("micro", "macro", "weighted", "none", None):
+        raise ValueError(
+            f"Expected argument `average` to be one of ('micro', 'macro', 'weighted', 'none', None) but got {average}"
+        )
+    _multilabel_precision_recall_curve_arg_validation(num_labels, thresholds, ignore_index)
+
+
+def _multilabel_average_precision_compute(
+    state, num_labels: int, average: Optional[str] = "macro", thresholds: Optional[Array] = None,
+    ignore_index: Optional[int] = None,
+) -> Array:
+    if average == "micro":
+        if not isinstance(state, tuple) and thresholds is not None:
+            return _binary_average_precision_compute(state.sum(1), thresholds)
+        preds = np.asarray(state[0]).reshape(-1)
+        target = np.asarray(state[1]).reshape(-1)
+        if ignore_index is not None:
+            keep = target != ignore_index
+            preds, target = preds[keep], target[keep]
+        return _binary_average_precision_compute((jnp.asarray(preds), jnp.asarray(target)), None)
+    precision, recall, _ = _multilabel_precision_recall_curve_compute(state, num_labels, thresholds, ignore_index)
+    if not isinstance(state, tuple) and thresholds is not None:
+        weights = (state[0, :, 1, 0] + state[0, :, 1, 1]).astype(jnp.float32)
+    else:
+        t = np.asarray(state[1])
+        if ignore_index is not None:
+            t = np.where(t == ignore_index, 0, t)
+        weights = jnp.asarray((t == 1).sum(0), jnp.float32)
+    return _reduce_average_precision(precision, recall, average, weights=weights)
+
+
+def multilabel_average_precision(
+    preds, target, num_labels: int, average: Optional[str] = "macro", thresholds=None,
+    ignore_index: Optional[int] = None, validate_args: bool = True,
+) -> Array:
+    if validate_args:
+        _multilabel_average_precision_arg_validation(num_labels, average, thresholds, ignore_index)
+        _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target, thresholds, w = _multilabel_precision_recall_curve_format(
+        preds, target, num_labels, thresholds, ignore_index
+    )
+    state = _multilabel_precision_recall_curve_update(preds, target, num_labels, thresholds, w)
+    return _multilabel_average_precision_compute(state, num_labels, average, thresholds, ignore_index)
+
+
+def average_precision(
+    preds,
+    target,
+    task: str,
+    thresholds=None,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    average: Optional[str] = "macro",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+):
+    """Task facade."""
+    from ...utilities.enums import ClassificationTask
+
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_average_precision(preds, target, thresholds, ignore_index, validate_args)
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_average_precision(preds, target, num_classes, average, thresholds, ignore_index, validate_args)
+    if task == ClassificationTask.MULTILABEL:
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+        return multilabel_average_precision(preds, target, num_labels, average, thresholds, ignore_index, validate_args)
+    raise ValueError(f"Not handled value: {task}")

@@ -1,0 +1,147 @@
+"""Calibration error (ECE). Parity: reference
+``functional/classification/calibration_error.py`` (_binning_bucketize:30-60,
+_ce_compute:63-107, updates:137+).
+
+TPU-native: states are the per-bin sufficient statistics (conf sum / acc sum / count
+per bin, static ``(n_bins+1,)`` shapes, sum-reduced) instead of the reference's
+unbounded confidence lists — identical ECE values since the reference bins with the
+same uniform boundaries at compute time anyway.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...utilities.compute import _safe_divide, normalize_logits_if_needed
+from ...utilities.enums import ClassificationTaskNoMultilabel
+
+Array = jax.Array
+
+
+def _binned_stats_update(
+    confidences: Array, accuracies: Array, n_bins: int, weights: Optional[Array] = None
+) -> Tuple[Array, Array, Array]:
+    """Per-bin sufficient statistics (the static-shape metric state)."""
+    bin_boundaries = jnp.linspace(0, 1, n_bins + 1, dtype=jnp.float32)
+    n = bin_boundaries.shape[0]
+    w = jnp.ones(confidences.shape, jnp.float32) if weights is None else weights
+    indices = jnp.clip(jnp.searchsorted(bin_boundaries, confidences, side="right") - 1, 0, n - 1)
+    count_bin = jax.ops.segment_sum(w, indices, num_segments=n)
+    conf_bin = jax.ops.segment_sum(w * confidences, indices, num_segments=n)
+    acc_bin = jax.ops.segment_sum(w * accuracies.astype(jnp.float32), indices, num_segments=n)
+    return conf_bin, acc_bin, count_bin
+
+
+def _ce_compute_from_bins(conf_bin: Array, acc_bin: Array, count_bin: Array, norm: str = "l1") -> Array:
+    if norm not in ("l1", "l2", "max"):
+        raise ValueError(f"Argument `norm` is expected to be one of 'l1', 'l2', 'max' but got {norm}")
+    acc_rate = _safe_divide(acc_bin, count_bin)
+    conf_rate = _safe_divide(conf_bin, count_bin)
+    prop_bin = _safe_divide(count_bin, count_bin.sum())
+    if norm == "l1":
+        return jnp.sum(jnp.abs(acc_rate - conf_rate) * prop_bin)
+    if norm == "max":
+        ce = jnp.max(jnp.abs(acc_rate - conf_rate) * (prop_bin > 0))
+        return ce
+    ce = jnp.sum(jnp.square(acc_rate - conf_rate) * prop_bin)
+    return jnp.where(ce > 0, jnp.sqrt(ce), ce)
+
+
+def _binary_calibration_error_arg_validation(
+    n_bins: int, norm: str = "l1", ignore_index: Optional[int] = None
+) -> None:
+    if not isinstance(n_bins, int) or n_bins < 1:
+        raise ValueError(f"Expected argument `n_bins` to be an integer larger than 0, but got {n_bins}")
+    if norm not in ("l1", "l2", "max"):
+        raise ValueError(f"Expected argument `norm` to be one of 'l1', 'l2' or 'max' but got {norm}")
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+
+
+def _binary_calibration_error_tensor_validation(preds, target, ignore_index: Optional[int] = None) -> None:
+    from .precision_recall_curve import _binary_precision_recall_curve_tensor_validation
+
+    _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
+
+
+def _binary_calibration_error_format(preds, target, ignore_index: Optional[int] = None):
+    preds = jnp.asarray(preds).reshape(-1).astype(jnp.float32)
+    target = jnp.asarray(target).reshape(-1)
+    preds = normalize_logits_if_needed(preds, "sigmoid")
+    if ignore_index is not None:
+        w = (target != ignore_index).astype(jnp.float32)
+        target = jnp.where(w == 1, target, 0)
+    else:
+        w = jnp.ones(target.shape, jnp.float32)
+    return preds, target.astype(jnp.int32), w
+
+
+def _binary_calibration_error_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    return preds, target  # confidences, accuracies (reference :137-139)
+
+
+def binary_calibration_error(
+    preds, target, n_bins: int = 15, norm: str = "l1", ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    if validate_args:
+        _binary_calibration_error_arg_validation(n_bins, norm, ignore_index)
+        _binary_calibration_error_tensor_validation(preds, target, ignore_index)
+    preds, target, w = _binary_calibration_error_format(preds, target, ignore_index)
+    conf_bin, acc_bin, count_bin = _binned_stats_update(preds, target, n_bins, w)
+    return _ce_compute_from_bins(conf_bin, acc_bin, count_bin, norm)
+
+
+def _multiclass_calibration_error_arg_validation(
+    num_classes: int, n_bins: int, norm: str = "l1", ignore_index: Optional[int] = None
+) -> None:
+    if not isinstance(num_classes, int) or num_classes < 2:
+        raise ValueError(f"Expected argument `num_classes` to be an integer larger than 1, but got {num_classes}")
+    _binary_calibration_error_arg_validation(n_bins, norm, ignore_index)
+
+
+def _multiclass_calibration_error_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Top-label confidence + correctness."""
+    confidences = jnp.max(preds, axis=1)
+    accuracies = (jnp.argmax(preds, axis=1) == target).astype(jnp.int32)
+    return confidences, accuracies
+
+
+def multiclass_calibration_error(
+    preds, target, num_classes: int, n_bins: int = 15, norm: str = "l1",
+    ignore_index: Optional[int] = None, validate_args: bool = True,
+) -> Array:
+    if validate_args:
+        _multiclass_calibration_error_arg_validation(num_classes, n_bins, norm, ignore_index)
+        from .stat_scores import _multiclass_stat_scores_tensor_validation
+
+        _multiclass_stat_scores_tensor_validation(preds, target, num_classes, "global", ignore_index)
+    preds = jnp.asarray(preds).astype(jnp.float32)
+    target = jnp.asarray(target).reshape(-1)
+    preds = normalize_logits_if_needed(preds, "softmax")
+    if ignore_index is not None:
+        w = (target != ignore_index).astype(jnp.float32)
+        target = jnp.where(w == 1, target, 0)
+    else:
+        w = jnp.ones(target.shape, jnp.float32)
+    confidences, accuracies = _multiclass_calibration_error_update(preds, jnp.clip(target, 0, num_classes - 1))
+    conf_bin, acc_bin, count_bin = _binned_stats_update(confidences, accuracies, n_bins, w)
+    return _ce_compute_from_bins(conf_bin, acc_bin, count_bin, norm)
+
+
+def calibration_error(
+    preds, target, task: str, n_bins: int = 15, norm: str = "l1", num_classes: Optional[int] = None,
+    ignore_index: Optional[int] = None, validate_args: bool = True,
+) -> Array:
+    """Task facade (binary/multiclass)."""
+    task = ClassificationTaskNoMultilabel.from_str(task)
+    if task == ClassificationTaskNoMultilabel.BINARY:
+        return binary_calibration_error(preds, target, n_bins, norm, ignore_index, validate_args)
+    if task == ClassificationTaskNoMultilabel.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_calibration_error(preds, target, num_classes, n_bins, norm, ignore_index, validate_args)
+    raise ValueError(f"Not handled value: {task}")

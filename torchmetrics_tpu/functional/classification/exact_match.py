@@ -1,0 +1,100 @@
+"""Exact match (subset accuracy). Parity: reference
+``functional/classification/exact_match.py`` (multiclass:45-216 class-side)."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...utilities.compute import _safe_divide
+from ...utilities.enums import ClassificationTaskNoBinary
+from .stat_scores import (
+    _multiclass_stat_scores_arg_validation,
+    _multiclass_stat_scores_tensor_validation,
+    _multilabel_stat_scores_arg_validation,
+    _multilabel_stat_scores_format,
+    _multilabel_stat_scores_tensor_validation,
+)
+
+Array = jax.Array
+
+
+def _exact_match_reduce(correct: Array, total: Array) -> Array:
+    return _safe_divide(correct, total)
+
+
+def _multiclass_exact_match_update(
+    preds, target, multidim_average: str = "global", ignore_index: Optional[int] = None
+) -> Tuple[Array, Array]:
+    """Sample is correct when ALL its (multidim) positions are correct; ignored
+    positions count as correct (reference semantics)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if preds.ndim == target.ndim + 1:
+        preds = jnp.argmax(preds, axis=1)
+    n = target.shape[0]
+    preds = preds.reshape(n, -1)
+    target = target.reshape(n, -1)
+    ok = preds == target
+    if ignore_index is not None:
+        ok = ok | (target == ignore_index)
+    correct = ok.all(axis=1).astype(jnp.int32)
+    if multidim_average == "global":
+        return correct.sum(), jnp.asarray(n, jnp.int32)
+    return correct, jnp.ones((n,), jnp.int32)
+
+
+def multiclass_exact_match(
+    preds, target, num_classes: int, multidim_average: str = "global",
+    ignore_index: Optional[int] = None, validate_args: bool = True,
+) -> Array:
+    if validate_args:
+        _multiclass_stat_scores_arg_validation(num_classes, 1, None, multidim_average, ignore_index)
+        _multiclass_stat_scores_tensor_validation(preds, target, num_classes, multidim_average, ignore_index)
+    correct, total = _multiclass_exact_match_update(preds, target, multidim_average, ignore_index)
+    return _exact_match_reduce(correct, total)
+
+
+def _multilabel_exact_match_update(
+    preds, target, num_labels: int, threshold: float = 0.5, multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array]:
+    p, t, w = _multilabel_stat_scores_format(preds, target, num_labels, threshold, ignore_index)  # (N, C, S)
+    ok = (p == t) | (w == 0)
+    correct = ok.all(axis=1).astype(jnp.int32)  # (N, S)
+    if multidim_average == "global":
+        return correct.sum(), jnp.asarray(correct.size, jnp.int32)
+    return correct.sum(axis=1), jnp.full((correct.shape[0],), correct.shape[1], jnp.int32)
+
+
+def multilabel_exact_match(
+    preds, target, num_labels: int, threshold: float = 0.5, multidim_average: str = "global",
+    ignore_index: Optional[int] = None, validate_args: bool = True,
+) -> Array:
+    if validate_args:
+        _multilabel_stat_scores_arg_validation(num_labels, threshold, None, multidim_average, ignore_index)
+        _multilabel_stat_scores_tensor_validation(preds, target, num_labels, multidim_average, ignore_index)
+    correct, total = _multilabel_exact_match_update(preds, target, num_labels, threshold, multidim_average, ignore_index)
+    return _exact_match_reduce(correct, total)
+
+
+def exact_match(
+    preds, target, task: str, num_classes: Optional[int] = None, num_labels: Optional[int] = None,
+    threshold: float = 0.5, multidim_average: str = "global", ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Task facade (multiclass/multilabel only)."""
+    task = ClassificationTaskNoBinary.from_str(task)
+    if task == ClassificationTaskNoBinary.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_exact_match(preds, target, num_classes, multidim_average, ignore_index, validate_args)
+    if task == ClassificationTaskNoBinary.MULTILABEL:
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+        return multilabel_exact_match(
+            preds, target, num_labels, threshold, multidim_average, ignore_index, validate_args
+        )
+    raise ValueError(f"Not handled value: {task}")

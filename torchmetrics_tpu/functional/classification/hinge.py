@@ -1,0 +1,140 @@
+"""Hinge loss. Parity: reference ``functional/classification/hinge.py``
+(_binary_hinge_loss_update:51-68, _multiclass_hinge_loss_update:151-175)."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...utilities.checks import _check_same_shape, _is_traced
+from ...utilities.compute import normalize_logits_if_needed
+from ...utilities.enums import ClassificationTaskNoMultilabel
+
+Array = jax.Array
+
+
+def _hinge_loss_compute(measure: Array, total: Array) -> Array:
+    return measure / total
+
+
+def _binary_hinge_loss_arg_validation(squared: bool, ignore_index: Optional[int] = None) -> None:
+    if not isinstance(squared, bool):
+        raise ValueError(f"Expected argument `squared` to be an bool but got {squared}")
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+
+
+def _binary_hinge_loss_tensor_validation(preds, target, ignore_index: Optional[int] = None) -> None:
+    _check_same_shape(preds, target)
+    if not jnp.issubdtype(jnp.asarray(preds).dtype, jnp.floating):
+        raise ValueError("Expected argument `preds` to be floating tensor with probabilities/logits"
+                         f" but got tensor with dtype {jnp.asarray(preds).dtype}")
+
+
+def _binary_hinge_loss_format(preds, target, ignore_index: Optional[int] = None):
+    preds = jnp.asarray(preds).reshape(-1).astype(jnp.float32)
+    target = jnp.asarray(target).reshape(-1)
+    preds = normalize_logits_if_needed(preds, "sigmoid")
+    if ignore_index is not None:
+        w = (target != ignore_index).astype(jnp.float32)
+        target = jnp.where(w == 1, target, 0)
+    else:
+        w = jnp.ones(target.shape, jnp.float32)
+    return preds, target.astype(jnp.int32), w
+
+
+def _binary_hinge_loss_update(preds: Array, target: Array, squared: bool, weights: Optional[Array] = None) -> Tuple[Array, Array]:
+    w = jnp.ones(target.shape, jnp.float32) if weights is None else weights
+    margin = jnp.where(target == 1, preds, -preds)
+    measures = jnp.clip(1 - margin, min=0)
+    if squared:
+        measures = measures**2
+    return (w * measures).sum(), w.sum()
+
+
+def binary_hinge_loss(
+    preds, target, squared: bool = False, ignore_index: Optional[int] = None, validate_args: bool = True
+) -> Array:
+    if validate_args:
+        _binary_hinge_loss_arg_validation(squared, ignore_index)
+        _binary_hinge_loss_tensor_validation(preds, target, ignore_index)
+    preds, target, w = _binary_hinge_loss_format(preds, target, ignore_index)
+    measure, total = _binary_hinge_loss_update(preds, target, squared, w)
+    return _hinge_loss_compute(measure, total)
+
+
+def _multiclass_hinge_loss_arg_validation(
+    num_classes: int, squared: bool = False, multiclass_mode: str = "crammer-singer",
+    ignore_index: Optional[int] = None,
+) -> None:
+    if not isinstance(num_classes, int) or num_classes < 2:
+        raise ValueError(f"Expected argument `num_classes` to be an integer larger than 1, but got {num_classes}")
+    _binary_hinge_loss_arg_validation(squared, ignore_index)
+    if multiclass_mode not in ("crammer-singer", "one-vs-all"):
+        raise ValueError(
+            f"Expected argument `multiclass_mode` to be one of ('crammer-singer', 'one-vs-all') but got {multiclass_mode}"
+        )
+
+
+def _multiclass_hinge_loss_format(preds, target, num_classes: int, ignore_index: Optional[int] = None):
+    preds = jnp.asarray(preds).astype(jnp.float32)
+    target = jnp.asarray(target).reshape(-1)
+    preds = normalize_logits_if_needed(preds, "softmax")
+    if ignore_index is not None:
+        w = (target != ignore_index).astype(jnp.float32)
+        target = jnp.where(w == 1, target, 0)
+    else:
+        w = jnp.ones(target.shape, jnp.float32)
+    return preds, jnp.clip(target, 0, num_classes - 1).astype(jnp.int32), w
+
+
+def _multiclass_hinge_loss_update(
+    preds: Array, target: Array, squared: bool, multiclass_mode: str = "crammer-singer",
+    weights: Optional[Array] = None,
+) -> Tuple[Array, Array]:
+    w = jnp.ones(target.shape, jnp.float32) if weights is None else weights
+    num_classes = preds.shape[1]
+    t_oh = jax.nn.one_hot(target, num_classes, dtype=jnp.bool_)
+    if multiclass_mode == "crammer-singer":
+        true_score = jnp.take_along_axis(preds, target[:, None], axis=1)[:, 0]
+        other_max = jnp.max(jnp.where(t_oh, -jnp.inf, preds), axis=1)
+        measures = jnp.clip(1 - (true_score - other_max), min=0)
+        if squared:
+            measures = measures**2
+        return (w * measures).sum(), w.sum()
+    margin = jnp.where(t_oh, preds, -preds)
+    measures = jnp.clip(1 - margin, min=0)
+    if squared:
+        measures = measures**2
+    return (w[:, None] * measures).sum(0), w.sum()
+
+
+def multiclass_hinge_loss(
+    preds, target, num_classes: int, squared: bool = False, multiclass_mode: str = "crammer-singer",
+    ignore_index: Optional[int] = None, validate_args: bool = True,
+) -> Array:
+    if validate_args:
+        _multiclass_hinge_loss_arg_validation(num_classes, squared, multiclass_mode, ignore_index)
+        from .stat_scores import _multiclass_stat_scores_tensor_validation
+
+        _multiclass_stat_scores_tensor_validation(preds, target, num_classes, "global", ignore_index)
+    preds, target, w = _multiclass_hinge_loss_format(preds, target, num_classes, ignore_index)
+    measure, total = _multiclass_hinge_loss_update(preds, target, squared, multiclass_mode, w)
+    return _hinge_loss_compute(measure, total)
+
+
+def hinge_loss(
+    preds, target, task: str, num_classes: Optional[int] = None, squared: bool = False,
+    multiclass_mode: str = "crammer-singer", ignore_index: Optional[int] = None, validate_args: bool = True,
+) -> Array:
+    """Task facade (binary/multiclass)."""
+    task = ClassificationTaskNoMultilabel.from_str(task)
+    if task == ClassificationTaskNoMultilabel.BINARY:
+        return binary_hinge_loss(preds, target, squared, ignore_index, validate_args)
+    if task == ClassificationTaskNoMultilabel.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_hinge_loss(preds, target, num_classes, squared, multiclass_mode, ignore_index, validate_args)
+    raise ValueError(f"Not handled value: {task}")

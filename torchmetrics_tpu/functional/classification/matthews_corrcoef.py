@@ -1,0 +1,131 @@
+"""Matthews correlation coefficient. Parity: reference
+``functional/classification/matthews_corrcoef.py`` (_matthews_corrcoef_reduce:37-89
+including the zero-denominator edge cases)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...utilities.enums import ClassificationTask
+from .confusion_matrix import (
+    _binary_confusion_matrix_arg_validation,
+    _binary_confusion_matrix_format,
+    _binary_confusion_matrix_tensor_validation,
+    _binary_confusion_matrix_update,
+    _multiclass_confusion_matrix_arg_validation,
+    _multiclass_confusion_matrix_format,
+    _multiclass_confusion_matrix_tensor_validation,
+    _multiclass_confusion_matrix_update,
+    _multilabel_confusion_matrix_arg_validation,
+    _multilabel_confusion_matrix_format,
+    _multilabel_confusion_matrix_tensor_validation,
+    _multilabel_confusion_matrix_update,
+)
+
+Array = jax.Array
+
+
+def _matthews_corrcoef_reduce(confmat: Array) -> Array:
+    """Un-normalized confusion matrix → MCC (host-side edge-case handling; runs at
+    compute time on concrete values)."""
+    cm = np.asarray(confmat)
+    if cm.ndim == 3:  # multilabel → binary fold
+        cm = cm.sum(0)
+
+    if cm.size == 4:
+        tn, fp, fn, tp = cm.reshape(-1).astype(np.float64)
+        if tp + tn != 0 and fp + fn == 0:
+            return jnp.asarray(1.0, jnp.float32)
+        if tp + tn == 0 and fp + fn != 0:
+            return jnp.asarray(-1.0, jnp.float32)
+
+    cmf = cm.astype(np.float64)
+    tk = cmf.sum(-1)
+    pk = cmf.sum(-2)
+    c = np.trace(cmf)
+    s = cmf.sum()
+    cov_ytyp = c * s - (tk * pk).sum()
+    cov_ypyp = s**2 - (pk * pk).sum()
+    cov_ytyt = s**2 - (tk * tk).sum()
+    numerator = cov_ytyp
+    denom = cov_ypyp * cov_ytyt
+
+    if denom == 0 and cm.size == 4:
+        eps = np.finfo(np.float32).eps
+        if fn == 0 and tn == 0:
+            numerator = np.sqrt(eps) * (tp - fp)
+        elif fp == 0 and tn == 0:
+            numerator = np.sqrt(eps) * (tp - fn)
+        elif tp == 0 and fn == 0:
+            numerator = np.sqrt(eps) * (tn - fp)
+        elif tp == 0 and fp == 0:
+            numerator = np.sqrt(eps) * (tn - fn)
+        elif tp == 0:
+            numerator = tn - fp * fn
+        elif tn == 0:
+            numerator = tp - fp * fn
+        elif fp == 0 or fn == 0:
+            numerator = tp * tn
+        else:
+            return jnp.asarray(0.0, jnp.float32)
+        denom = (tp + fp + eps) * (tp + fn + eps) * (tn + fp + eps) * (tn + fn + eps)
+    elif denom == 0:
+        return jnp.asarray(0.0, jnp.float32)
+    return jnp.asarray(numerator / np.sqrt(denom), jnp.float32)
+
+
+def binary_matthews_corrcoef(
+    preds, target, threshold: float = 0.5, ignore_index: Optional[int] = None, validate_args: bool = True
+) -> Array:
+    if validate_args:
+        _binary_confusion_matrix_arg_validation(threshold, ignore_index, normalize=None)
+        _binary_confusion_matrix_tensor_validation(preds, target, ignore_index)
+    preds, target, w = _binary_confusion_matrix_format(preds, target, threshold, ignore_index)
+    confmat = _binary_confusion_matrix_update(preds, target, w)
+    return _matthews_corrcoef_reduce(confmat)
+
+
+def multiclass_matthews_corrcoef(
+    preds, target, num_classes: int, ignore_index: Optional[int] = None, validate_args: bool = True
+) -> Array:
+    if validate_args:
+        _multiclass_confusion_matrix_arg_validation(num_classes, ignore_index, normalize=None)
+        _multiclass_confusion_matrix_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target, w = _multiclass_confusion_matrix_format(preds, target, ignore_index)
+    confmat = _multiclass_confusion_matrix_update(preds, target, w, num_classes)
+    return _matthews_corrcoef_reduce(confmat)
+
+
+def multilabel_matthews_corrcoef(
+    preds, target, num_labels: int, threshold: float = 0.5, ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    if validate_args:
+        _multilabel_confusion_matrix_arg_validation(num_labels, threshold, ignore_index, normalize=None)
+        _multilabel_confusion_matrix_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target, w = _multilabel_confusion_matrix_format(preds, target, num_labels, threshold, ignore_index)
+    confmat = _multilabel_confusion_matrix_update(preds, target, w, num_labels)
+    return _matthews_corrcoef_reduce(confmat)
+
+
+def matthews_corrcoef(
+    preds, target, task: str, threshold: float = 0.5, num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None, ignore_index: Optional[int] = None, validate_args: bool = True,
+) -> Array:
+    """Task facade."""
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_matthews_corrcoef(preds, target, threshold, ignore_index, validate_args)
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_matthews_corrcoef(preds, target, num_classes, ignore_index, validate_args)
+    if task == ClassificationTask.MULTILABEL:
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+        return multilabel_matthews_corrcoef(preds, target, num_labels, threshold, ignore_index, validate_args)
+    raise ValueError(f"Not handled value: {task}")

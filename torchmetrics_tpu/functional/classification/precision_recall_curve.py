@@ -1,0 +1,413 @@
+"""Precision-recall curves (the curve-family kernel).
+
+Parity: reference ``functional/classification/precision_recall_curve.py``
+(_binary_clf_curve:30-82, _adjust_threshold_arg:85, binned updates:192-252 with the 50k
+vectorized/loop crossover, computes:255-291, multiclass:489-570, multilabel below).
+
+TPU-native notes:
+- The binned path is the hot path: one fused ``(N,T)`` threshold-mask einsum per batch
+  (rides the MXU as a matmul), producing a static ``(T,2,2)``/(T,C,2,2)`` confusion
+  state — no scatter, no 50k crossover heuristic needed.
+- ``ignore_index`` flows through as zero sample weights (static shapes under jit).
+- The exact path (``thresholds=None``) sorts at compute time on host (numpy): its
+  output length is data-dependent (unique scores), which XLA cannot express — same
+  reason the reference keeps cat-list states for it.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...utilities.checks import _check_same_shape, _is_traced
+from ...utilities.compute import _safe_divide, normalize_logits_if_needed
+from ...utilities.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+def _binary_clf_curve(
+    preds, target, sample_weights=None, pos_label: int = 1
+) -> Tuple[Array, Array, Array]:
+    """fps/tps at every distinct threshold (host-side, sklearn-style sort+cumsum)."""
+    preds = np.asarray(preds)
+    target = np.asarray(target)
+    if preds.ndim > target.ndim:
+        preds = preds[:, 0]
+    order = np.argsort(-preds, kind="stable")
+    preds_s = preds[order]
+    target_s = (target[order] == pos_label).astype(np.float64)
+    weight = np.asarray(sample_weights, dtype=np.float64)[order] if sample_weights is not None else 1.0
+
+    distinct = np.nonzero(np.diff(preds_s))[0]
+    threshold_idxs = np.concatenate([distinct, [target_s.size - 1]])
+    tps = np.cumsum(target_s * weight)[threshold_idxs]
+    if sample_weights is not None:
+        fps = np.cumsum((1 - target_s) * weight)[threshold_idxs]
+    else:
+        fps = 1 + threshold_idxs - tps
+    return jnp.asarray(fps), jnp.asarray(tps), jnp.asarray(preds_s[threshold_idxs])
+
+
+def _adjust_threshold_arg(thresholds=None):
+    if isinstance(thresholds, int):
+        return jnp.linspace(0, 1, thresholds)
+    if isinstance(thresholds, list):
+        return jnp.asarray(thresholds, jnp.float32)
+    if thresholds is None:
+        return None
+    return jnp.asarray(thresholds)
+
+
+# --------------------------------------------------------------------- binary
+
+
+def _binary_precision_recall_curve_arg_validation(
+    thresholds=None, ignore_index: Optional[int] = None
+) -> None:
+    if thresholds is not None and not isinstance(thresholds, (list, int)) and not hasattr(thresholds, "shape"):
+        raise ValueError(
+            "Expected argument `thresholds` to either be an integer, list of floats or tensor of floats,"
+            f" but got {thresholds}"
+        )
+    if isinstance(thresholds, int) and thresholds < 2:
+        raise ValueError(f"If argument `thresholds` is an integer, expected it to be larger than 1, but got {thresholds}")
+    if isinstance(thresholds, list) and not all(isinstance(t, float) and 0 <= t <= 1 for t in thresholds):
+        raise ValueError(f"If argument `thresholds` is a list, expected all elements to be floats in the [0,1] range,"
+                         f" but got {thresholds}")
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+
+
+def _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index: Optional[int] = None) -> None:
+    _check_same_shape(preds, target)
+    if not jnp.issubdtype(jnp.asarray(preds).dtype, jnp.floating):
+        raise ValueError("Expected argument `preds` to be an floating tensor with probability/logit scores,"
+                         f" but got tensor with dtype {jnp.asarray(preds).dtype}")
+    if _is_traced(preds, target):
+        return
+    t = np.asarray(target)
+    ok = (t == 0) | (t == 1)
+    if ignore_index is not None:
+        ok |= t == ignore_index
+    if not ok.all():
+        raise RuntimeError(f"Detected the following values in `target`: {np.unique(t)} but expected only"
+                           f" the following values {[0, 1] if ignore_index is None else [ignore_index]}.")
+
+
+def _binary_precision_recall_curve_format(
+    preds, target, thresholds=None, ignore_index: Optional[int] = None
+) -> Tuple[Array, Array, Optional[Array], Array]:
+    """→ (preds, target, thresholds, weights); preds sigmoid-normalized, flattened."""
+    preds = jnp.asarray(preds).reshape(-1)
+    target = jnp.asarray(target).reshape(-1)
+    preds = normalize_logits_if_needed(preds, "sigmoid")
+    if ignore_index is not None:
+        w = (target != ignore_index).astype(jnp.float32)
+        target = jnp.where(w == 1, target, 0)
+    else:
+        w = jnp.ones(target.shape, jnp.float32)
+    return preds, target.astype(jnp.int32), _adjust_threshold_arg(thresholds), w
+
+
+def _binary_precision_recall_curve_update(
+    preds: Array, target: Array, thresholds: Optional[Array], weights: Optional[Array] = None
+):
+    """Binned multi-threshold confusion: one fused einsum pass → ``(T, 2, 2)``."""
+    if thresholds is None:
+        return preds, target
+    w = jnp.ones(preds.shape, jnp.float32) if weights is None else weights
+    preds_t = (preds[:, None] >= thresholds[None, :]).astype(jnp.float32)  # (N, T)
+    pos = (w * target).astype(jnp.float32)
+    neg = (w * (1 - target)).astype(jnp.float32)
+    tp = pos @ preds_t  # (T,)
+    fp = neg @ preds_t
+    fn = pos.sum() - tp
+    tn = neg.sum() - fp
+    return jnp.stack([jnp.stack([tn, fp], -1), jnp.stack([fn, tp], -1)], -2).astype(jnp.int32)  # (T,2,2)
+
+
+def _binary_precision_recall_curve_compute(
+    state, thresholds: Optional[Array], pos_label: int = 1
+) -> Tuple[Array, Array, Array]:
+    if not isinstance(state, tuple) and thresholds is not None:
+        tps = state[:, 1, 1]
+        fps = state[:, 0, 1]
+        fns = state[:, 1, 0]
+        precision = _safe_divide(tps, tps + fps, zero_division=jnp.nan)
+        recall = _safe_divide(tps, tps + fns, zero_division=jnp.nan)
+        precision = jnp.concatenate([precision, jnp.ones(1, precision.dtype)])
+        recall = jnp.concatenate([recall, jnp.zeros(1, recall.dtype)])
+        return precision, recall, thresholds
+    fps, tps, thres = _binary_clf_curve(state[0], state[1], pos_label=pos_label)
+    precision = tps / (tps + fps)
+    recall = tps / tps[-1] if float(tps[-1]) > 0 else jnp.ones_like(tps)
+    if float(tps[-1]) <= 0:
+        rank_zero_warn(
+            "No positive samples found in target, recall is undefined. Setting recall to one for all thresholds.",
+            UserWarning,
+        )
+    precision = jnp.concatenate([precision[::-1], jnp.ones(1, precision.dtype)])
+    recall = jnp.concatenate([recall[::-1], jnp.zeros(1, recall.dtype)])
+    return precision, recall, thres[::-1]
+
+
+def binary_precision_recall_curve(
+    preds, target, thresholds=None, ignore_index: Optional[int] = None, validate_args: bool = True
+):
+    if validate_args:
+        _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+        _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
+    preds, target, thresholds, w = _binary_precision_recall_curve_format(preds, target, thresholds, ignore_index)
+    if thresholds is None and ignore_index is not None:
+        keep = np.asarray(w) == 1
+        preds, target = preds[keep], target[keep]
+    state = _binary_precision_recall_curve_update(preds, target, thresholds, w)
+    return _binary_precision_recall_curve_compute(state, thresholds)
+
+
+# ------------------------------------------------------------------ multiclass
+
+
+def _multiclass_precision_recall_curve_arg_validation(
+    num_classes: int, thresholds=None, ignore_index: Optional[int] = None, average: Optional[str] = None
+) -> None:
+    if not isinstance(num_classes, int) or num_classes < 2:
+        raise ValueError(f"Expected argument `num_classes` to be an integer larger than 1, but got {num_classes}")
+    if average not in (None, "micro", "macro"):
+        raise ValueError(f"Expected argument `average` to be one of None, 'micro' or 'macro', but got {average}")
+    _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+
+
+def _multiclass_precision_recall_curve_tensor_validation(
+    preds, target, num_classes: int, ignore_index: Optional[int] = None
+) -> None:
+    if preds.ndim != target.ndim + 1:
+        raise ValueError("Expected `preds` to have one more dimension than `target` but got"
+                         f" {preds.ndim} and {target.ndim}")
+    if not jnp.issubdtype(jnp.asarray(preds).dtype, jnp.floating):
+        raise ValueError("Expected `preds` to be a float tensor, but got"
+                         f" {jnp.asarray(preds).dtype}")
+    if preds.shape[1] != num_classes:
+        raise ValueError(f"Expected `preds.shape[1]={preds.shape[1]}` to be equal to the number of classes"
+                         f" {num_classes}")
+    if preds.shape[0] != target.shape[0] or preds.shape[2:] != target.shape[1:]:
+        raise ValueError("Expected the shape of `preds` should be (N, C, ...) and the shape of `target` should be"
+                         " (N, ...).")
+    if _is_traced(preds, target):
+        return
+    t = np.asarray(target)
+    t = t[t != ignore_index] if ignore_index is not None else t
+    if t.size and (t.min() < 0 or t.max() >= num_classes):
+        raise RuntimeError("Detected more unique values in `target` than expected.")
+
+
+def _multiclass_precision_recall_curve_format(
+    preds, target, num_classes: int, thresholds=None, ignore_index: Optional[int] = None, average: Optional[str] = None
+):
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    n, c = preds.shape[0], preds.shape[1]
+    preds = jnp.moveaxis(preds.reshape(n, c, -1), 1, -1).reshape(-1, c)  # (M, C)
+    target = target.reshape(-1)
+    preds = normalize_logits_if_needed(preds, "softmax")
+    if ignore_index is not None:
+        w = (target != ignore_index).astype(jnp.float32)
+        target = jnp.where(w == 1, target, 0)
+    else:
+        w = jnp.ones(target.shape, jnp.float32)
+    target = jnp.clip(target, 0, num_classes - 1).astype(jnp.int32)
+    if average == "micro":
+        # one-vs-rest flatten to a single binary problem (reference :~480)
+        t_oh = jax.nn.one_hot(target, num_classes, dtype=jnp.int32)
+        preds = preds.reshape(-1)
+        target = t_oh.reshape(-1)
+        w = jnp.broadcast_to(w[:, None], t_oh.shape).reshape(-1)
+    return preds, target, _adjust_threshold_arg(thresholds), w
+
+
+def _multiclass_precision_recall_curve_update(
+    preds: Array, target: Array, num_classes: int, thresholds: Optional[Array], weights: Optional[Array] = None,
+    average: Optional[str] = None,
+):
+    if thresholds is None:
+        return preds, target
+    if average == "micro":
+        return _binary_precision_recall_curve_update(preds, target, thresholds, weights)
+    w = jnp.ones(target.shape, jnp.float32) if weights is None else weights
+    preds_t = (preds[:, :, None] >= thresholds[None, None, :]).astype(jnp.float32)  # (M, C, T)
+    t_oh = jax.nn.one_hot(target, num_classes, dtype=jnp.float32) * w[:, None]  # (M, C)
+    n_oh = (1 - jax.nn.one_hot(target, num_classes, dtype=jnp.float32)) * w[:, None]
+    tp = jnp.einsum("mc,mct->tc", t_oh, preds_t)
+    fp = jnp.einsum("mc,mct->tc", n_oh, preds_t)
+    fn = t_oh.sum(0)[None, :] - tp
+    tn = n_oh.sum(0)[None, :] - fp
+    return jnp.stack([jnp.stack([tn, fp], -1), jnp.stack([fn, tp], -1)], -2).astype(jnp.int32)  # (T,C,2,2)
+
+
+def _multiclass_precision_recall_curve_compute(
+    state, num_classes: int, thresholds: Optional[Array], average: Optional[str] = None
+):
+    if average == "micro":
+        return _binary_precision_recall_curve_compute(state, thresholds)
+    if not isinstance(state, tuple) and thresholds is not None:
+        tps = state[:, :, 1, 1]
+        fps = state[:, :, 0, 1]
+        fns = state[:, :, 1, 0]
+        precision = _safe_divide(tps, tps + fps, zero_division=jnp.nan)
+        recall = _safe_divide(tps, tps + fns, zero_division=jnp.nan)
+        precision = jnp.concatenate([precision, jnp.ones((1, num_classes), precision.dtype)], axis=0).T
+        recall = jnp.concatenate([recall, jnp.zeros((1, num_classes), recall.dtype)], axis=0).T
+        return precision, recall, thresholds
+    precision_list, recall_list, thres_list = [], [], []
+    for i in range(num_classes):
+        p, r, t = _binary_precision_recall_curve_compute((state[0][:, i], state[1]), None, pos_label=i)
+        precision_list.append(p)
+        recall_list.append(r)
+        thres_list.append(t)
+    return precision_list, recall_list, thres_list
+
+
+def multiclass_precision_recall_curve(
+    preds,
+    target,
+    num_classes: int,
+    thresholds=None,
+    average: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+):
+    if validate_args:
+        _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index, average)
+        _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target, thresholds, w = _multiclass_precision_recall_curve_format(
+        preds, target, num_classes, thresholds, ignore_index, average
+    )
+    if thresholds is None and ignore_index is not None:
+        keep = np.asarray(w) == 1
+        preds, target = preds[keep], target[keep]
+    state = _multiclass_precision_recall_curve_update(preds, target, num_classes, thresholds, w, average)
+    return _multiclass_precision_recall_curve_compute(state, num_classes, thresholds, average)
+
+
+# ------------------------------------------------------------------ multilabel
+
+
+def _multilabel_precision_recall_curve_arg_validation(
+    num_labels: int, thresholds=None, ignore_index: Optional[int] = None
+) -> None:
+    if not isinstance(num_labels, int) or num_labels < 2:
+        raise ValueError(f"Expected argument `num_labels` to be an integer larger than 1, but got {num_labels}")
+    _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+
+
+def _multilabel_precision_recall_curve_tensor_validation(
+    preds, target, num_labels: int, ignore_index: Optional[int] = None
+) -> None:
+    _check_same_shape(preds, target)
+    if not jnp.issubdtype(jnp.asarray(preds).dtype, jnp.floating):
+        raise ValueError("Expected `preds` to be a float tensor")
+    if preds.shape[1] != num_labels:
+        raise ValueError(f"Expected `preds.shape[1]={preds.shape[1]}` to equal `num_labels={num_labels}`")
+
+
+def _multilabel_precision_recall_curve_format(
+    preds, target, num_labels: int, thresholds=None, ignore_index: Optional[int] = None
+):
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    n, c = preds.shape[0], preds.shape[1]
+    preds = jnp.moveaxis(preds.reshape(n, c, -1), 1, -1).reshape(-1, c)
+    target = jnp.moveaxis(target.reshape(n, c, -1), 1, -1).reshape(-1, c)
+    preds = normalize_logits_if_needed(preds, "sigmoid")
+    if ignore_index is not None:
+        w = (target != ignore_index).astype(jnp.float32)
+        # binned path: ignored points become zero-weight negatives; exact path: keep the
+        # raw ignore_index markers so compute-time per-label filtering works
+        # (reference precision_recall_curve.py:767 remaps only when thresholds given)
+        if thresholds is not None:
+            target = jnp.where(w == 1, target, 0)
+    else:
+        w = jnp.ones(target.shape, jnp.float32)
+    return preds, target.astype(jnp.int32), _adjust_threshold_arg(thresholds), w
+
+
+def _multilabel_precision_recall_curve_update(
+    preds: Array, target: Array, num_labels: int, thresholds: Optional[Array], weights: Optional[Array] = None
+):
+    if thresholds is None:
+        return preds, target
+    w = jnp.ones(target.shape, jnp.float32) if weights is None else weights
+    preds_t = (preds[:, :, None] >= thresholds[None, None, :]).astype(jnp.float32)  # (M, C, T)
+    pos = (w * target).astype(jnp.float32)
+    neg = (w * (1 - target)).astype(jnp.float32)
+    tp = jnp.einsum("mc,mct->tc", pos, preds_t)
+    fp = jnp.einsum("mc,mct->tc", neg, preds_t)
+    fn = pos.sum(0)[None, :] - tp
+    tn = neg.sum(0)[None, :] - fp
+    return jnp.stack([jnp.stack([tn, fp], -1), jnp.stack([fn, tp], -1)], -2).astype(jnp.int32)
+
+
+def _multilabel_precision_recall_curve_compute(
+    state, num_labels: int, thresholds: Optional[Array], ignore_index: Optional[int] = None
+):
+    if not isinstance(state, tuple) and thresholds is not None:
+        return _multiclass_precision_recall_curve_compute(state, num_labels, thresholds, None)
+    precision_list, recall_list, thres_list = [], [], []
+    for i in range(num_labels):
+        preds_i = np.asarray(state[0][:, i])
+        target_i = np.asarray(state[1][:, i])
+        if ignore_index is not None:
+            keep = target_i != ignore_index
+            preds_i, target_i = preds_i[keep], target_i[keep]
+        p, r, t = _binary_precision_recall_curve_compute((jnp.asarray(preds_i), jnp.asarray(target_i)), None)
+        precision_list.append(p)
+        recall_list.append(r)
+        thres_list.append(t)
+    return precision_list, recall_list, thres_list
+
+
+def multilabel_precision_recall_curve(
+    preds, target, num_labels: int, thresholds=None, ignore_index: Optional[int] = None, validate_args: bool = True
+):
+    if validate_args:
+        _multilabel_precision_recall_curve_arg_validation(num_labels, thresholds, ignore_index)
+        _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target, thresholds, w = _multilabel_precision_recall_curve_format(
+        preds, target, num_labels, thresholds, ignore_index
+    )
+    state = _multilabel_precision_recall_curve_update(preds, target, num_labels, thresholds, w)
+    return _multilabel_precision_recall_curve_compute(state, num_labels, thresholds, ignore_index)
+
+
+def precision_recall_curve(
+    preds,
+    target,
+    task: str,
+    thresholds=None,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    average: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+):
+    """Task facade."""
+    from ...utilities.enums import ClassificationTask
+
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_precision_recall_curve(preds, target, thresholds, ignore_index, validate_args)
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_precision_recall_curve(
+            preds, target, num_classes, thresholds, average, ignore_index, validate_args
+        )
+    if task == ClassificationTask.MULTILABEL:
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+        return multilabel_precision_recall_curve(preds, target, num_labels, thresholds, ignore_index, validate_args)
+    raise ValueError(f"Not handled value: {task}")

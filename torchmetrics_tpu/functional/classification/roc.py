@@ -1,0 +1,175 @@
+"""ROC curves. Parity: reference ``functional/classification/roc.py``
+(_binary_roc_compute:40-80, multiclass/multilabel below)."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...utilities.compute import _safe_divide
+from ...utilities.prints import rank_zero_warn
+from .precision_recall_curve import (
+    _binary_clf_curve,
+    _binary_precision_recall_curve_arg_validation,
+    _binary_precision_recall_curve_format,
+    _binary_precision_recall_curve_tensor_validation,
+    _binary_precision_recall_curve_update,
+    _multiclass_precision_recall_curve_arg_validation,
+    _multiclass_precision_recall_curve_format,
+    _multiclass_precision_recall_curve_tensor_validation,
+    _multiclass_precision_recall_curve_update,
+    _multilabel_precision_recall_curve_arg_validation,
+    _multilabel_precision_recall_curve_format,
+    _multilabel_precision_recall_curve_tensor_validation,
+    _multilabel_precision_recall_curve_update,
+)
+
+Array = jax.Array
+
+
+def _binary_roc_compute(
+    state, thresholds: Optional[Array], pos_label: int = 1
+) -> Tuple[Array, Array, Array]:
+    if not isinstance(state, tuple) and thresholds is not None:
+        tps = state[:, 1, 1]
+        fps = state[:, 0, 1]
+        fns = state[:, 1, 0]
+        tns = state[:, 0, 0]
+        tpr = _safe_divide(tps, tps + fns)[::-1]
+        fpr = _safe_divide(fps, fps + tns)[::-1]
+        return fpr, tpr, thresholds[::-1]
+    fps, tps, thres = _binary_clf_curve(preds=state[0], target=state[1], pos_label=pos_label)
+    # extra threshold so the curve starts at (0, 0)
+    tps = jnp.concatenate([jnp.zeros(1, tps.dtype), tps])
+    fps = jnp.concatenate([jnp.zeros(1, fps.dtype), fps])
+    thres = jnp.concatenate([jnp.ones(1, thres.dtype), thres])
+    if float(fps[-1]) <= 0:
+        rank_zero_warn("No negative samples in targets, false positive value should be meaningless.", UserWarning)
+        fpr = jnp.zeros_like(thres)
+    else:
+        fpr = fps / fps[-1]
+    if float(tps[-1]) <= 0:
+        rank_zero_warn("No positive samples in targets, true positive value should be meaningless.", UserWarning)
+        tpr = jnp.zeros_like(thres)
+    else:
+        tpr = tps / tps[-1]
+    return fpr, tpr, thres
+
+
+def binary_roc(preds, target, thresholds=None, ignore_index: Optional[int] = None, validate_args: bool = True):
+    if validate_args:
+        _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+        _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
+    preds, target, thresholds, w = _binary_precision_recall_curve_format(preds, target, thresholds, ignore_index)
+    if thresholds is None and ignore_index is not None:
+        keep = np.asarray(w) == 1
+        preds, target = preds[keep], target[keep]
+    state = _binary_precision_recall_curve_update(preds, target, thresholds, w)
+    return _binary_roc_compute(state, thresholds)
+
+
+def _multiclass_roc_compute(
+    state, num_classes: int, thresholds: Optional[Array], average: Optional[str] = None
+):
+    if average == "micro":
+        return _binary_roc_compute(state, thresholds)
+    if not isinstance(state, tuple) and thresholds is not None:
+        tps = state[:, :, 1, 1]
+        fps = state[:, :, 0, 1]
+        fns = state[:, :, 1, 0]
+        tns = state[:, :, 0, 0]
+        tpr = _safe_divide(tps, tps + fns)[::-1].T
+        fpr = _safe_divide(fps, fps + tns)[::-1].T
+        return fpr, tpr, thresholds[::-1]
+    fpr_list, tpr_list, thres_list = [], [], []
+    for i in range(num_classes):
+        f, t, th = _binary_roc_compute((state[0][:, i], state[1]), None, pos_label=i)
+        fpr_list.append(f)
+        tpr_list.append(t)
+        thres_list.append(th)
+    return fpr_list, tpr_list, thres_list
+
+
+def multiclass_roc(
+    preds,
+    target,
+    num_classes: int,
+    thresholds=None,
+    average: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+):
+    if validate_args:
+        _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index, average)
+        _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target, thresholds, w = _multiclass_precision_recall_curve_format(
+        preds, target, num_classes, thresholds, ignore_index, average
+    )
+    if thresholds is None and ignore_index is not None:
+        keep = np.asarray(w) == 1
+        preds, target = preds[keep], target[keep]
+    state = _multiclass_precision_recall_curve_update(preds, target, num_classes, thresholds, w, average)
+    return _multiclass_roc_compute(state, num_classes, thresholds, average)
+
+
+def _multilabel_roc_compute(
+    state, num_labels: int, thresholds: Optional[Array], ignore_index: Optional[int] = None
+):
+    if not isinstance(state, tuple) and thresholds is not None:
+        return _multiclass_roc_compute(state, num_labels, thresholds, None)
+    fpr_list, tpr_list, thres_list = [], [], []
+    for i in range(num_labels):
+        preds_i = np.asarray(state[0][:, i])
+        target_i = np.asarray(state[1][:, i])
+        if ignore_index is not None:
+            keep = target_i != ignore_index
+            preds_i, target_i = preds_i[keep], target_i[keep]
+        f, t, th = _binary_roc_compute((jnp.asarray(preds_i), jnp.asarray(target_i)), None)
+        fpr_list.append(f)
+        tpr_list.append(t)
+        thres_list.append(th)
+    return fpr_list, tpr_list, thres_list
+
+
+def multilabel_roc(
+    preds, target, num_labels: int, thresholds=None, ignore_index: Optional[int] = None, validate_args: bool = True
+):
+    if validate_args:
+        _multilabel_precision_recall_curve_arg_validation(num_labels, thresholds, ignore_index)
+        _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target, thresholds, w = _multilabel_precision_recall_curve_format(
+        preds, target, num_labels, thresholds, ignore_index
+    )
+    state = _multilabel_precision_recall_curve_update(preds, target, num_labels, thresholds, w)
+    return _multilabel_roc_compute(state, num_labels, thresholds, ignore_index)
+
+
+def roc(
+    preds,
+    target,
+    task: str,
+    thresholds=None,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    average: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+):
+    """Task facade."""
+    from ...utilities.enums import ClassificationTask
+
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_roc(preds, target, thresholds, ignore_index, validate_args)
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_roc(preds, target, num_classes, thresholds, average, ignore_index, validate_args)
+    if task == ClassificationTask.MULTILABEL:
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+        return multilabel_roc(preds, target, num_labels, thresholds, ignore_index, validate_args)
+    raise ValueError(f"Not handled value: {task}")

@@ -515,7 +515,7 @@ class Metric:
         d["_defaults"] = {k: (v if isinstance(v, list) else np.asarray(v)) for k, v in self._defaults.items()}
         d["_cache"] = None
         d["_computed"] = None
-        d["dist_sync_fn"] = None if self.dist_sync_fn is not None else None
+        d["dist_sync_fn"] = None  # callables don't survive pickling
         return d
 
     def __setstate__(self, state: dict) -> None:
