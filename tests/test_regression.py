@@ -1,0 +1,299 @@
+"""Regression metrics vs sklearn/scipy/numpy references (SURVEY §2.4, §4)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import scipy.stats
+from sklearn.metrics import (
+    explained_variance_score,
+    mean_absolute_error as sk_mae,
+    mean_absolute_percentage_error as sk_mape,
+    mean_squared_error as sk_mse,
+    mean_squared_log_error as sk_msle,
+    mean_tweedie_deviance,
+    r2_score as sk_r2,
+)
+
+import torchmetrics_tpu as tm
+import torchmetrics_tpu.functional as F
+
+from conftest import BATCH_SIZE, NUM_BATCHES, seed_all
+from helpers import MetricTester, _assert_allclose
+
+rng = seed_all(7)
+PREDS = rng.normal(size=(NUM_BATCHES, BATCH_SIZE)).astype(np.float32)
+TARGET = rng.normal(size=(NUM_BATCHES, BATCH_SIZE)).astype(np.float32)
+POS_PREDS = np.abs(PREDS) + 0.1
+POS_TARGET = np.abs(TARGET) + 0.1
+PREDS_2D = rng.normal(size=(NUM_BATCHES, BATCH_SIZE, 3)).astype(np.float32)
+TARGET_2D = rng.normal(size=(NUM_BATCHES, BATCH_SIZE, 3)).astype(np.float32)
+PROBS_P = rng.uniform(0.1, 1, size=(NUM_BATCHES, BATCH_SIZE, 5)).astype(np.float32)
+PROBS_Q = rng.uniform(0.1, 1, size=(NUM_BATCHES, BATCH_SIZE, 5)).astype(np.float32)
+
+
+class _Case(MetricTester):
+    pass
+
+
+tester = _Case()
+
+
+def _run_all(preds, target, metric_class, functional, ref, args=None, check_batch=True, ingraph=True, atol=None):
+    args = args or {}
+    tester.run_functional_metric_test(preds, target, functional, ref, args, atol=atol)
+    tester.run_class_metric_test(preds, target, metric_class, ref, args, check_batch=check_batch, atol=atol)
+    tester.run_merge_state_test(preds, target, metric_class, ref, args, atol=atol)
+    if ingraph:
+        tester.run_ingraph_sharded_test(preds, target, metric_class, ref, args, atol=atol)
+
+
+def test_mean_squared_error():
+    _run_all(PREDS, TARGET, tm.MeanSquaredError, F.mean_squared_error, sk_mse)
+
+
+def test_root_mean_squared_error():
+    _run_all(
+        PREDS, TARGET, tm.MeanSquaredError, F.mean_squared_error,
+        lambda p, t: np.sqrt(sk_mse(t, p)) if False else sk_mse(t, p) ** 0.5,
+        args={"squared": False},
+    )
+
+
+def test_mse_ref_order():
+    # sklearn signature is (y_true, y_pred); ours is (preds, target) — symmetric for MSE
+    assert abs(sk_mse(TARGET[0], PREDS[0]) - sk_mse(PREDS[0], TARGET[0])) < 1e-6
+
+
+def test_mean_absolute_error():
+    _run_all(PREDS, TARGET, tm.MeanAbsoluteError, F.mean_absolute_error, lambda p, t: sk_mae(t, p))
+
+
+def test_mean_squared_log_error():
+    _run_all(POS_PREDS, POS_TARGET, tm.MeanSquaredLogError, F.mean_squared_log_error, lambda p, t: sk_msle(t, p))
+
+
+def test_mean_absolute_percentage_error():
+    _run_all(PREDS, POS_TARGET, tm.MeanAbsolutePercentageError, F.mean_absolute_percentage_error, lambda p, t: sk_mape(t, p))
+
+
+def _ref_smape(p, t):
+    return np.mean(2 * np.abs(p - t) / np.clip(np.abs(t) + np.abs(p), 1.17e-6, None))
+
+
+def test_symmetric_mape():
+    _run_all(PREDS, TARGET, tm.SymmetricMeanAbsolutePercentageError, F.symmetric_mean_absolute_percentage_error, _ref_smape)
+
+
+def _ref_wmape(p, t):
+    return np.sum(np.abs(p - t)) / np.sum(np.abs(t))
+
+
+def test_weighted_mape():
+    _run_all(PREDS, TARGET, tm.WeightedMeanAbsolutePercentageError, F.weighted_mean_absolute_percentage_error, _ref_wmape)
+
+
+def _ref_logcosh(p, t):
+    return np.mean(np.log(np.cosh(np.float64(p) - np.float64(t))))
+
+
+def test_log_cosh_error():
+    _run_all(PREDS, TARGET, tm.LogCoshError, F.log_cosh_error, _ref_logcosh, atol=1e-5)
+
+
+def test_minkowski_distance():
+    p_val = 3.0
+    ref = lambda p, t: scipy.spatial.distance.minkowski(p, t, p=p_val)
+    import scipy.spatial
+
+    _run_all(PREDS, TARGET, tm.MinkowskiDistance, F.minkowski_distance, ref, args={"p": p_val}, atol=1e-4)
+
+
+def test_tweedie_deviance():
+    for power in (0.0, 1.0, 2.0, 3.0):
+        ref = lambda p, t: mean_tweedie_deviance(t, p, power=power)
+        _run_all(POS_PREDS, POS_TARGET, tm.TweedieDevianceScore, F.tweedie_deviance_score,
+                 ref, args={"power": power}, atol=1e-4)
+
+
+def test_r2_score():
+    _run_all(PREDS, TARGET, tm.R2Score, F.r2_score, lambda p, t: sk_r2(t, p), check_batch=True)
+
+
+def test_r2_score_multioutput():
+    ref = lambda p, t: sk_r2(t, p, multioutput="raw_values")
+    tester.run_functional_metric_test(PREDS_2D, TARGET_2D, F.r2_score, ref, {"multioutput": "raw_values"})
+    tester.run_class_metric_test(
+        PREDS_2D, TARGET_2D, tm.R2Score, ref, metric_args={"num_outputs": 3, "multioutput": "raw_values"}
+    )
+    tester.run_ingraph_sharded_test(
+        PREDS_2D, TARGET_2D, tm.R2Score, ref, metric_args={"num_outputs": 3, "multioutput": "raw_values"}
+    )
+
+
+def _ref_rse(p, t):
+    t64, p64 = np.float64(t), np.float64(p)
+    return np.sum((t64 - p64) ** 2) / np.sum((t64 - t64.mean()) ** 2)
+
+
+def test_relative_squared_error():
+    tester.run_class_metric_test(PREDS, TARGET, tm.RelativeSquaredError, _ref_rse, check_batch=True)
+    tester.run_functional_metric_test(PREDS, TARGET, F.relative_squared_error, _ref_rse)
+
+
+def test_explained_variance():
+    _run_all(PREDS, TARGET, tm.ExplainedVariance, F.explained_variance, lambda p, t: explained_variance_score(t, p))
+
+
+def test_pearson():
+    ref = lambda p, t: scipy.stats.pearsonr(p, t)[0]
+    _run_all(PREDS, TARGET, tm.PearsonCorrCoef, F.pearson_corrcoef, ref, atol=1e-5)
+
+
+def _ref_ccc(p, t):
+    p64, t64 = np.float64(p), np.float64(t)
+    mx, my = p64.mean(), t64.mean()
+    vx, vy = p64.var(ddof=1), t64.var(ddof=1)
+    r = scipy.stats.pearsonr(p64, t64)[0]
+    return 2 * r * np.sqrt(vx) * np.sqrt(vy) / (vx + vy + (mx - my) ** 2)
+
+
+def test_concordance():
+    _run_all(PREDS, TARGET, tm.ConcordanceCorrCoef, F.concordance_corrcoef, _ref_ccc, atol=1e-5)
+
+
+def test_spearman():
+    ref = lambda p, t: scipy.stats.spearmanr(p, t)[0]
+    _run_all(PREDS, TARGET, tm.SpearmanCorrCoef, F.spearman_corrcoef, ref, ingraph=False, atol=1e-5)
+
+
+def test_kendall():
+    ref = lambda p, t: scipy.stats.kendalltau(p, t, variant="b")[0]
+    _run_all(PREDS, TARGET, tm.KendallRankCorrCoef, F.kendall_rank_corrcoef, ref, ingraph=False, atol=1e-5)
+
+
+def test_kendall_with_ties_and_pvalue():
+    rng2 = seed_all(3)
+    p = rng2.integers(0, 10, size=(1, 64)).astype(np.float32)
+    t = rng2.integers(0, 10, size=(1, 64)).astype(np.float32)
+    tau, pval = F.kendall_rank_corrcoef(p[0], t[0], t_test=True)
+    ref_tau, ref_p = scipy.stats.kendalltau(p[0], t[0], variant="b")
+    _assert_allclose(tau, ref_tau, atol=1e-5)
+    _assert_allclose(pval, ref_p, atol=1e-4)
+
+
+def _ref_cosine(p, t):
+    num = (p * t).sum(-1)
+    den = np.linalg.norm(p, axis=-1) * np.linalg.norm(t, axis=-1)
+    return (num / den).sum()
+
+
+def test_cosine_similarity():
+    _run_all(PREDS_2D, TARGET_2D, tm.CosineSimilarity, F.cosine_similarity, _ref_cosine, ingraph=False, atol=1e-4)
+
+
+def _ref_kl(p, t):
+    pn = p / p.sum(-1, keepdims=True)
+    qn = t / t.sum(-1, keepdims=True)
+    return np.mean([scipy.stats.entropy(pn[i], qn[i]) for i in range(len(pn))])
+
+
+def test_kl_divergence():
+    _run_all(PROBS_P, PROBS_Q, tm.KLDivergence, F.kl_divergence, _ref_kl, atol=1e-5)
+
+
+def _ref_js(p, t):
+    from scipy.spatial.distance import jensenshannon
+
+    pn = p / p.sum(-1, keepdims=True)
+    qn = t / t.sum(-1, keepdims=True)
+    return np.mean([jensenshannon(pn[i], qn[i], base=np.e) ** 2 for i in range(len(pn))])
+
+
+def test_js_divergence():
+    _run_all(PROBS_P, PROBS_Q, tm.JensenShannonDivergence, F.jensen_shannon_divergence, _ref_js, atol=1e-5)
+
+
+def _ref_crps(p, t):
+    m = p.shape[1]
+    diff = np.abs(p - t[:, None]).sum(1) / m
+    spread = np.abs(p[:, :, None] - p[:, None, :]).sum((1, 2)) / (2 * m * m)
+    return np.mean(diff - spread)
+
+
+def test_crps():
+    preds = rng.normal(size=(NUM_BATCHES, BATCH_SIZE, 8)).astype(np.float32)
+    target = rng.normal(size=(NUM_BATCHES, BATCH_SIZE)).astype(np.float32)
+    _run_all(preds, target, tm.ContinuousRankedProbabilityScore, F.continuous_ranked_probability_score, _ref_crps, atol=1e-5)
+
+
+def _ref_csi(p, t, thr=0.5):
+    pb, tb = p >= thr, t >= thr
+    hits = (pb & tb).sum()
+    misses = (~pb & tb).sum()
+    fa = (pb & ~tb).sum()
+    return hits / (hits + misses + fa)
+
+
+def test_critical_success_index():
+    _run_all(PREDS, TARGET, tm.CriticalSuccessIndex, F.critical_success_index, _ref_csi, args={"threshold": 0.5})
+
+
+def _ref_nrmse_mean(p, t):
+    return np.sqrt(np.mean((np.float64(p) - np.float64(t)) ** 2)) / np.mean(np.float64(t))
+
+
+def _ref_nrmse_range(p, t):
+    return np.sqrt(np.mean((np.float64(p) - np.float64(t)) ** 2)) / (t.max() - t.min())
+
+
+def _ref_nrmse_std(p, t):
+    return np.sqrt(np.mean((np.float64(p) - np.float64(t)) ** 2)) / np.std(np.float64(t))
+
+
+def _ref_nrmse_l2(p, t):
+    return np.sqrt(np.mean((np.float64(p) - np.float64(t)) ** 2)) / np.linalg.norm(np.float64(t))
+
+
+@pytest.mark.parametrize(
+    ("normalization", "ref"),
+    [("mean", _ref_nrmse_mean), ("range", _ref_nrmse_range), ("std", _ref_nrmse_std), ("l2", _ref_nrmse_l2)],
+)
+def test_nrmse(normalization, ref):
+    _run_all(
+        POS_PREDS, POS_TARGET, tm.NormalizedRootMeanSquaredError, F.normalized_root_mean_squared_error,
+        ref, args={"normalization": normalization}, atol=1e-5,
+    )
+
+
+def test_pearson_multioutput():
+    def ref(p, t):
+        return np.stack([scipy.stats.pearsonr(p[:, i], t[:, i])[0] for i in range(p.shape[1])])
+
+    tester.run_class_metric_test(
+        PREDS_2D, TARGET_2D, tm.PearsonCorrCoef, ref, metric_args={"num_outputs": 3}, atol=1e-5
+    )
+
+
+def test_spearman_multioutput():
+    def ref(p, t):
+        return np.stack([scipy.stats.spearmanr(p[:, i], t[:, i])[0] for i in range(p.shape[1])])
+
+    tester.run_class_metric_test(
+        PREDS_2D, TARGET_2D, tm.SpearmanCorrCoef, ref, metric_args={"num_outputs": 3}, atol=1e-5
+    )
+
+
+def test_invalid_args():
+    with pytest.raises(ValueError):
+        tm.MeanSquaredError(squared="yes")
+    with pytest.raises(Exception):
+        tm.MinkowskiDistance(p=0.5)
+    with pytest.raises(ValueError):
+        tm.KLDivergence(reduction="bad")
+    with pytest.raises(ValueError):
+        tm.NormalizedRootMeanSquaredError(normalization="bad")
+    with pytest.raises(ValueError):
+        tm.R2Score(multioutput="bad")
+    with pytest.raises(ValueError):
+        tm.KendallRankCorrCoef(variant="z")

@@ -4,9 +4,12 @@ A from-scratch re-design of the torchmetrics capability surface for TPU: pure-fu
 metric cores (init/update/merge/compute pytree transforms) jit-compiled by XLA, mesh-
 axis collectives for distributed sync, and a stateful API shell matching the reference
 (`/root/reference`, alifa98/torchmetrics) for drop-in familiarity.
-"""
 
-from torchmetrics_tpu import classification, functional, parallel, utilities, wrappers
+Every domain package declares its public classes in its own ``__all__``; the flat root
+namespace aggregates them (reference ``__init__.py`` re-exports ~100 names the same
+way, hand-listed)."""
+
+from torchmetrics_tpu import classification, functional, parallel, regression, utilities, wrappers
 from torchmetrics_tpu.aggregation import (
     CatMetric,
     MaxMetric,
@@ -16,78 +19,30 @@ from torchmetrics_tpu.aggregation import (
     RunningSum,
     SumMetric,
 )
+from torchmetrics_tpu.classification import *  # noqa: F401,F403
 from torchmetrics_tpu.collections import MetricCollection
 from torchmetrics_tpu.metric import CompositionalMetric, Metric
-
-from torchmetrics_tpu.classification import (  # noqa: E402
-    Accuracy,
-    BinaryAccuracy,
-    BinaryConfusionMatrix,
-    BinaryF1Score,
-    BinaryFBetaScore,
-    BinaryHammingDistance,
-    BinaryNegativePredictiveValue,
-    BinaryPrecision,
-    BinaryRecall,
-    BinarySpecificity,
-    BinaryStatScores,
-    ConfusionMatrix,
-    F1Score,
-    FBetaScore,
-    HammingDistance,
-    MulticlassAccuracy,
-    MulticlassConfusionMatrix,
-    MulticlassF1Score,
-    MulticlassFBetaScore,
-    MulticlassHammingDistance,
-    MulticlassNegativePredictiveValue,
-    MulticlassPrecision,
-    MulticlassRecall,
-    MulticlassSpecificity,
-    MulticlassStatScores,
-    MultilabelAccuracy,
-    MultilabelConfusionMatrix,
-    MultilabelF1Score,
-    MultilabelFBetaScore,
-    MultilabelHammingDistance,
-    MultilabelNegativePredictiveValue,
-    MultilabelPrecision,
-    MultilabelRecall,
-    MultilabelSpecificity,
-    MultilabelStatScores,
-    NegativePredictiveValue,
-    Precision,
-    Recall,
-    Specificity,
-    StatScores,
-)
+from torchmetrics_tpu.regression import *  # noqa: F401,F403
 
 __version__ = "0.1.0"
 
 __all__ = [
-    "Accuracy",
     "CatMetric",
     "CompositionalMetric",
-    "ConfusionMatrix",
-    "F1Score",
-    "FBetaScore",
-    "HammingDistance",
     "MaxMetric",
     "MeanMetric",
     "Metric",
     "MetricCollection",
     "MinMetric",
-    "NegativePredictiveValue",
-    "Precision",
-    "Recall",
     "RunningMean",
     "RunningSum",
-    "Specificity",
-    "StatScores",
     "SumMetric",
     "classification",
     "functional",
     "parallel",
+    "regression",
     "utilities",
     "wrappers",
+    *classification.__all__,
+    *regression.__all__,
 ]

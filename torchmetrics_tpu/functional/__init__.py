@@ -1,105 +1,15 @@
-from torchmetrics_tpu.functional.classification import (
-    accuracy,
-    auroc,
-    average_precision,
-    binary_accuracy,
-    binary_auroc,
-    binary_average_precision,
-    binary_precision_recall_curve,
-    binary_roc,
-    multiclass_auroc,
-    multiclass_average_precision,
-    binary_calibration_error,
-    binary_cohen_kappa,
-    binary_hinge_loss,
-    binary_jaccard_index,
-    binary_matthews_corrcoef,
-    calibration_error,
-    cohen_kappa,
-    exact_match,
-    hinge_loss,
-    jaccard_index,
-    matthews_corrcoef,
-    multiclass_calibration_error,
-    multiclass_cohen_kappa,
-    multiclass_exact_match,
-    multiclass_hinge_loss,
-    multiclass_jaccard_index,
-    multiclass_matthews_corrcoef,
-    multiclass_precision_recall_curve,
-    multiclass_roc,
-    multilabel_auroc,
-    multilabel_coverage_error,
-    multilabel_exact_match,
-    multilabel_jaccard_index,
-    multilabel_matthews_corrcoef,
-    multilabel_ranking_average_precision,
-    multilabel_ranking_loss,
-    multilabel_average_precision,
-    multilabel_precision_recall_curve,
-    multilabel_roc,
-    precision_recall_curve,
-    roc,
-    binary_confusion_matrix,
-    binary_f1_score,
-    binary_fbeta_score,
-    binary_hamming_distance,
-    binary_negative_predictive_value,
-    binary_precision,
-    binary_recall,
-    binary_specificity,
-    binary_stat_scores,
-    confusion_matrix,
-    f1_score,
-    fbeta_score,
-    hamming_distance,
-    multiclass_accuracy,
-    multiclass_confusion_matrix,
-    multiclass_f1_score,
-    multiclass_fbeta_score,
-    multiclass_hamming_distance,
-    multiclass_negative_predictive_value,
-    multiclass_precision,
-    multiclass_recall,
-    multiclass_specificity,
-    multiclass_stat_scores,
-    multilabel_accuracy,
-    multilabel_confusion_matrix,
-    multilabel_f1_score,
-    multilabel_fbeta_score,
-    multilabel_hamming_distance,
-    multilabel_negative_predictive_value,
-    multilabel_precision,
-    multilabel_recall,
-    multilabel_specificity,
-    multilabel_stat_scores,
-    negative_predictive_value,
-    precision,
-    recall,
-    specificity,
-    stat_scores,
-)
+"""Functional metrics API (stateless one-shot kernels). Parity: reference
+``functional/__init__.py`` (104 top-level exports).
+
+Every domain package declares its public surface in its own ``__all__``; this module
+aggregates them so the flat ``torchmetrics_tpu.functional.<fn>`` namespace stays in
+lock-step with the per-domain namespaces as domains are added."""
+
+from torchmetrics_tpu.functional import classification, regression
+from torchmetrics_tpu.functional.classification import *  # noqa: F401,F403
+from torchmetrics_tpu.functional.regression import *  # noqa: F401,F403
 
 __all__ = [
-    "binary_calibration_error", "binary_cohen_kappa", "binary_hinge_loss", "binary_jaccard_index",
-    "binary_matthews_corrcoef", "calibration_error", "cohen_kappa", "exact_match", "hinge_loss",
-    "jaccard_index", "matthews_corrcoef", "multiclass_calibration_error", "multiclass_cohen_kappa",
-    "multiclass_exact_match", "multiclass_hinge_loss", "multiclass_jaccard_index",
-    "multiclass_matthews_corrcoef", "multilabel_coverage_error", "multilabel_exact_match",
-    "multilabel_jaccard_index", "multilabel_matthews_corrcoef", "multilabel_ranking_average_precision",
-    "multilabel_ranking_loss",
-    "auroc", "average_precision", "binary_auroc", "binary_average_precision",
-    "binary_precision_recall_curve", "binary_roc", "multiclass_auroc", "multiclass_average_precision",
-    "multiclass_precision_recall_curve", "multiclass_roc", "multilabel_auroc", "multilabel_average_precision",
-    "multilabel_precision_recall_curve", "multilabel_roc", "precision_recall_curve", "roc",
-    "accuracy", "binary_accuracy", "binary_confusion_matrix", "binary_f1_score", "binary_fbeta_score",
-    "binary_hamming_distance", "binary_negative_predictive_value", "binary_precision", "binary_recall",
-    "binary_specificity", "binary_stat_scores", "confusion_matrix", "f1_score", "fbeta_score",
-    "hamming_distance", "multiclass_accuracy", "multiclass_confusion_matrix", "multiclass_f1_score",
-    "multiclass_fbeta_score", "multiclass_hamming_distance", "multiclass_negative_predictive_value",
-    "multiclass_precision", "multiclass_recall", "multiclass_specificity", "multiclass_stat_scores",
-    "multilabel_accuracy", "multilabel_confusion_matrix", "multilabel_f1_score", "multilabel_fbeta_score",
-    "multilabel_hamming_distance", "multilabel_negative_predictive_value", "multilabel_precision",
-    "multilabel_recall", "multilabel_specificity", "multilabel_stat_scores", "negative_predictive_value",
-    "precision", "recall", "specificity", "stat_scores",
+    *classification.__all__,
+    *regression.__all__,
 ]

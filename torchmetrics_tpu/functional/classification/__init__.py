@@ -79,3 +79,7 @@ from .stat_scores import (
     multilabel_stat_scores,
     stat_scores,
 )
+
+# public surface = every imported kernel (modules filtered out); aggregated by
+# torchmetrics_tpu.functional.__init__
+__all__ = sorted(n for n, v in list(globals().items()) if not n.startswith("_") and callable(v))

@@ -52,6 +52,49 @@ def _binary_clf_curve(
     return jnp.asarray(fps), jnp.asarray(tps), jnp.asarray(preds_s[threshold_idxs])
 
 
+# Counts from float32 matmuls are only exact below 2^24; chunking the sample axis keeps
+# every partial product exactly representable while still riding the MXU, with the
+# running total held in int32 (exact to 2^31 accumulated samples; the reference uses
+# int64, which default-config JAX does not expose — documented limit).
+_EXACT_F32_CHUNK = 1 << 22
+
+
+def _exact_count_matmul(vec: Array, mat: Array) -> Array:
+    """``vec @ mat`` with integer-exact counts: f32 MXU matmul per ≤2^22-row chunk,
+    accumulated in int32. ``vec`` is a 0/1(/masked) weight row, ``mat`` a 0/1 mask."""
+    n = vec.shape[0]
+    if n <= _EXACT_F32_CHUNK:
+        return (vec @ mat).astype(jnp.int32)
+    acc = jnp.zeros(mat.shape[1:], jnp.int32)
+    for i in range(0, n, _EXACT_F32_CHUNK):
+        acc = acc + (vec[i : i + _EXACT_F32_CHUNK] @ mat[i : i + _EXACT_F32_CHUNK]).astype(jnp.int32)
+    return acc
+
+
+def _exact_count_einsum(spec: str, a: Array, b: Array) -> Array:
+    """Chunked einsum over the leading (sample) axis with int32-exact accumulation."""
+    n = a.shape[0]
+    if n <= _EXACT_F32_CHUNK:
+        return jnp.einsum(spec, a, b).astype(jnp.int32)
+    acc = None
+    for i in range(0, n, _EXACT_F32_CHUNK):
+        part = jnp.einsum(spec, a[i : i + _EXACT_F32_CHUNK], b[i : i + _EXACT_F32_CHUNK]).astype(jnp.int32)
+        acc = part if acc is None else acc + part
+    return acc
+
+
+def _exact_count_sum(x: Array, axis=None) -> Array:
+    """Integer-exact sum of a 0/1 float mask along ``axis`` (chunked over axis 0)."""
+    n = x.shape[0]
+    if n <= _EXACT_F32_CHUNK:
+        return jnp.sum(x, axis=axis).astype(jnp.int32)
+    acc = None
+    for i in range(0, n, _EXACT_F32_CHUNK):
+        part = jnp.sum(x[i : i + _EXACT_F32_CHUNK], axis=axis).astype(jnp.int32)
+        acc = part if acc is None else acc + part
+    return acc
+
+
 def _adjust_threshold_arg(thresholds=None):
     if isinstance(thresholds, int):
         return jnp.linspace(0, 1, thresholds)
@@ -123,11 +166,11 @@ def _binary_precision_recall_curve_update(
     preds_t = (preds[:, None] >= thresholds[None, :]).astype(jnp.float32)  # (N, T)
     pos = (w * target).astype(jnp.float32)
     neg = (w * (1 - target)).astype(jnp.float32)
-    tp = pos @ preds_t  # (T,)
-    fp = neg @ preds_t
-    fn = pos.sum() - tp
-    tn = neg.sum() - fp
-    return jnp.stack([jnp.stack([tn, fp], -1), jnp.stack([fn, tp], -1)], -2).astype(jnp.int32)  # (T,2,2)
+    tp = _exact_count_matmul(pos, preds_t)  # (T,)
+    fp = _exact_count_matmul(neg, preds_t)
+    fn = _exact_count_sum(pos) - tp
+    tn = _exact_count_sum(neg) - fp
+    return jnp.stack([jnp.stack([tn, fp], -1), jnp.stack([fn, tp], -1)], -2)  # (T,2,2) int32
 
 
 def _binary_precision_recall_curve_compute(
@@ -241,11 +284,11 @@ def _multiclass_precision_recall_curve_update(
     preds_t = (preds[:, :, None] >= thresholds[None, None, :]).astype(jnp.float32)  # (M, C, T)
     t_oh = jax.nn.one_hot(target, num_classes, dtype=jnp.float32) * w[:, None]  # (M, C)
     n_oh = (1 - jax.nn.one_hot(target, num_classes, dtype=jnp.float32)) * w[:, None]
-    tp = jnp.einsum("mc,mct->tc", t_oh, preds_t)
-    fp = jnp.einsum("mc,mct->tc", n_oh, preds_t)
-    fn = t_oh.sum(0)[None, :] - tp
-    tn = n_oh.sum(0)[None, :] - fp
-    return jnp.stack([jnp.stack([tn, fp], -1), jnp.stack([fn, tp], -1)], -2).astype(jnp.int32)  # (T,C,2,2)
+    tp = _exact_count_einsum("mc,mct->tc", t_oh, preds_t)
+    fp = _exact_count_einsum("mc,mct->tc", n_oh, preds_t)
+    fn = _exact_count_sum(t_oh, axis=0)[None, :] - tp
+    tn = _exact_count_sum(n_oh, axis=0)[None, :] - fp
+    return jnp.stack([jnp.stack([tn, fp], -1), jnp.stack([fn, tp], -1)], -2)  # (T,C,2,2) int32
 
 
 def _multiclass_precision_recall_curve_compute(
@@ -344,11 +387,11 @@ def _multilabel_precision_recall_curve_update(
     preds_t = (preds[:, :, None] >= thresholds[None, None, :]).astype(jnp.float32)  # (M, C, T)
     pos = (w * target).astype(jnp.float32)
     neg = (w * (1 - target)).astype(jnp.float32)
-    tp = jnp.einsum("mc,mct->tc", pos, preds_t)
-    fp = jnp.einsum("mc,mct->tc", neg, preds_t)
-    fn = pos.sum(0)[None, :] - tp
-    tn = neg.sum(0)[None, :] - fp
-    return jnp.stack([jnp.stack([tn, fp], -1), jnp.stack([fn, tp], -1)], -2).astype(jnp.int32)
+    tp = _exact_count_einsum("mc,mct->tc", pos, preds_t)
+    fp = _exact_count_einsum("mc,mct->tc", neg, preds_t)
+    fn = _exact_count_sum(pos, axis=0)[None, :] - tp
+    tn = _exact_count_sum(neg, axis=0)[None, :] - fp
+    return jnp.stack([jnp.stack([tn, fp], -1), jnp.stack([fn, tp], -1)], -2)
 
 
 def _multilabel_precision_recall_curve_compute(

@@ -1,0 +1,39 @@
+"""Concordance correlation coefficient. Parity: reference
+``functional/regression/concordance.py`` (_concordance_corrcoef_compute:20)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .pearson import _pearson_corrcoef_compute, _pearson_corrcoef_update
+
+Array = jax.Array
+
+
+def _concordance_corrcoef_compute(
+    max_abs_dev_x: Array,
+    max_abs_dev_y: Array,
+    mean_x: Array,
+    mean_y: Array,
+    var_x: Array,
+    var_y: Array,
+    corr_xy: Array,
+    num_total: Array,
+) -> Array:
+    pearson = _pearson_corrcoef_compute(max_abs_dev_x, max_abs_dev_y, var_x, var_y, corr_xy, num_total)
+    var_x = var_x / (num_total - 1)
+    var_y = var_y / (num_total - 1)
+    return 2.0 * pearson * jnp.sqrt(var_x) * jnp.sqrt(var_y) / (var_x + var_y + (mean_x - mean_y) ** 2)
+
+
+def concordance_corrcoef(preds, target) -> Array:
+    """One-shot concordance correlation coefficient."""
+    preds = jnp.asarray(preds)
+    num_outputs = 1 if preds.ndim == 1 else preds.shape[-1]
+    d = (num_outputs,) if num_outputs > 1 else ()
+    zeros = jnp.zeros(d, jnp.float32)
+    mean_x, mean_y, dev_x, dev_y, var_x, var_y, corr_xy, n = _pearson_corrcoef_update(
+        preds, target, zeros, zeros, zeros, zeros, zeros, zeros, zeros, jnp.zeros((), jnp.float32), num_outputs
+    )
+    return _concordance_corrcoef_compute(dev_x, dev_y, mean_x, mean_y, var_x, var_y, corr_xy, n)

@@ -1,0 +1,39 @@
+"""Cosine similarity. Parity: reference ``functional/regression/cosine_similarity.py``."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...utilities.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _cosine_similarity_update(preds, target):
+    _check_same_shape(preds, target)
+    preds = jnp.asarray(preds, jnp.float32)
+    target = jnp.asarray(target, jnp.float32)
+    if preds.ndim != 2:
+        raise ValueError(f"Expected input to cosine similarity to be 2D tensors of shape `[N,D]` where `N` is the number of samples and `D` is the number of dimensions, but got tensor of shape {preds.shape}")
+    return preds, target
+
+
+def _cosine_similarity_compute(preds: Array, target: Array, reduction: Optional[str] = "sum") -> Array:
+    dot = (preds * target).sum(-1)
+    denom = jnp.linalg.norm(preds, axis=-1) * jnp.linalg.norm(target, axis=-1)
+    sim = dot / denom
+    if reduction == "sum":
+        return sim.sum()
+    if reduction == "mean":
+        return sim.mean()
+    if reduction in (None, "none"):
+        return sim
+    raise ValueError(f"Expected reduction to be one of `['sum', 'mean', 'none', None]` but got {reduction}")
+
+
+def cosine_similarity(preds, target, reduction: Optional[str] = "sum") -> Array:
+    preds, target = _cosine_similarity_update(preds, target)
+    return _cosine_similarity_compute(preds, target, reduction)

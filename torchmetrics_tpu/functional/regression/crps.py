@@ -1,0 +1,40 @@
+"""Continuous ranked probability score for ensemble forecasts. Parity: reference
+``functional/regression/crps.py`` (_crps_update:23, _crps_compute:59)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _crps_update(preds, target):
+    """Per-batch CRPS terms: sum of mean-absolute-error terms and pairwise ensemble
+    spread terms, plus the batch size (sum-reducible states).
+
+    The O(m^2) pairwise term is one (B, m, m) elementwise abs-diff — batched and
+    MXU/VPU-friendly; no sort needed for the ensemble term.
+    """
+    preds = jnp.asarray(preds, jnp.float32)
+    target = jnp.asarray(target, jnp.float32)
+    if preds.ndim != 2:
+        raise ValueError(f"Expected preds of shape (batch_size, ensemble_members), but got {preds.shape}.")
+    if target.shape != preds.shape[:1]:
+        raise ValueError(f"Expected target of shape (batch_size,), but got {target.shape}.")
+    batch_size, m = preds.shape
+    if m < 2:
+        raise ValueError(f"CRPS requires at least 2 ensemble members, but you provided {preds.shape}.")
+    diff = jnp.sum(jnp.abs(preds - target[:, None]), axis=1) / m
+    ensemble_diffs = jnp.abs(preds[:, :, None] - preds[:, None, :])
+    ensemble_sum = jnp.sum(ensemble_diffs, axis=(1, 2)) / (2 * m * m)
+    return batch_size, diff, ensemble_sum
+
+
+def _crps_compute(batch_size, diff: Array, ensemble_sum: Array) -> Array:
+    return jnp.mean(diff - ensemble_sum)
+
+
+def continuous_ranked_probability_score(preds, target) -> Array:
+    batch_size, diff, ensemble_sum = _crps_update(preds, target)
+    return _crps_compute(batch_size, diff, ensemble_sum)

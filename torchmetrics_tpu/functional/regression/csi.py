@@ -1,0 +1,39 @@
+"""Critical success index. Parity: reference ``functional/regression/csi.py``
+(_critical_success_index_update:23, _critical_success_index_compute:61)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...utilities.checks import _check_same_shape
+from ...utilities.compute import _safe_divide
+
+Array = jax.Array
+
+
+def _critical_success_index_update(preds, target, threshold: float, keep_sequence_dim: Optional[int] = None):
+    _check_same_shape(preds, target)
+    if keep_sequence_dim is None:
+        axis = None
+    elif not 0 <= keep_sequence_dim < preds.ndim:
+        raise ValueError(f"Expected keep_sequence_dim to be in range [0, {preds.ndim}) but got {keep_sequence_dim}")
+    else:
+        axis = tuple(i for i in range(preds.ndim) if i != keep_sequence_dim)
+    preds_bin = jnp.asarray(preds) >= threshold
+    target_bin = jnp.asarray(target) >= threshold
+    hits = jnp.sum(preds_bin & target_bin, axis=axis)
+    misses = jnp.sum(~preds_bin & target_bin, axis=axis)
+    false_alarms = jnp.sum(preds_bin & ~target_bin, axis=axis)
+    return hits, misses, false_alarms
+
+
+def _critical_success_index_compute(hits: Array, misses: Array, false_alarms: Array) -> Array:
+    return _safe_divide(hits, hits + misses + false_alarms)
+
+
+def critical_success_index(preds, target, threshold: float, keep_sequence_dim: Optional[int] = None) -> Array:
+    hits, misses, false_alarms = _critical_success_index_update(preds, target, threshold, keep_sequence_dim)
+    return _critical_success_index_compute(hits, misses, false_alarms)

@@ -1,0 +1,155 @@
+"""Kendall rank correlation (tau-a/b/c, optional significance test). Parity: reference
+``functional/regression/kendall.py`` (_get_metric_metadata:112, _calculate_tau:153,
+_calculate_p_value:197).
+
+TPU-native formulation: the reference counts concordant/discordant pairs with a Python
+loop over rows (O(n) kernel launches). Here the pair statistics come from one vectorized
+(n, n) sign-comparison — a single fused XLA kernel — and tie-group statistics come from
+sort + run-length ``segment_sum`` with static shapes (no ``unique``)."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...utilities.checks import _check_same_shape
+from .utils import _check_data_shape_to_num_outputs
+
+Array = jax.Array
+
+_ALLOWED_VARIANTS = ("a", "b", "c")
+_ALLOWED_ALTERNATIVES = ("two-sided", "less", "greater")
+
+
+def _tie_stats(x: Array) -> Tuple[Array, Array, Array, Array]:
+    """Per-column tie-group statistics: (Σt(t-1)/2, Σt(t-1)(t-2), Σt(t-1)(2t+5),
+    number of distinct values). Static-shape via run-length segments of sorted x."""
+    n = x.shape[0]
+    xs = jnp.sort(x)
+    change = jnp.concatenate([jnp.zeros((1,), jnp.int32), (xs[1:] != xs[:-1]).astype(jnp.int32)])
+    seg = jnp.cumsum(change)
+    t = jax.ops.segment_sum(jnp.ones((n,), jnp.float32), seg, num_segments=n)
+    ties = jnp.sum(t * (t - 1) / 2)
+    ties_p1 = jnp.sum(t * (t - 1) * (t - 2))
+    ties_p2 = jnp.sum(t * (t - 1) * (2 * t + 5))
+    n_unique = jnp.sum(t > 0)
+    return ties, ties_p1, ties_p2, n_unique
+
+
+# Cap the materialized pairwise block at ~4M elements: memory stays O(chunk·n) instead
+# of O(n²) (the full (n,n) matrix OOMs past ~100k accumulated samples).
+_PAIR_BLOCK_ELEMS = 1 << 22
+
+
+def _pair_counts(x: Array, y: Array) -> Tuple[Array, Array]:
+    """Concordant/discordant pair counts via row-blocked (chunk, n) sign comparisons.
+
+    Per-block counts are integer-exact (≤ 2^22·n block, counted in f32 after an exact
+    int sum per block); totals accumulate in f32 — for n where pair counts exceed 2^24
+    the relative error is ≤2^-24, far below tau's statistical noise.
+    """
+    n = x.shape[0]
+    chunk = int(min(n, max(64, _PAIR_BLOCK_ELEMS // max(n, 1))))
+    pad = (-n) % chunk
+    xp = jnp.pad(x, (0, pad))
+    yp = jnp.pad(y, (0, pad))
+    total = xp.shape[0]
+    rows = jnp.arange(chunk)
+    cols = jnp.arange(total)
+
+    def body(i, acc):
+        start = i * chunk
+        xi = jax.lax.dynamic_slice(xp, (start,), (chunk,))
+        yi = jax.lax.dynamic_slice(yp, (start,), (chunk,))
+        gidx = start + rows
+        mask = (cols[None, :] > gidx[:, None]) & (cols[None, :] < n) & (gidx[:, None] < n)
+        sx = jnp.sign(xi[:, None] - xp[None, :])
+        sy = jnp.sign(yi[:, None] - yp[None, :])
+        prod = sx * sy
+        con = jnp.sum((prod > 0) & mask, dtype=jnp.int32).astype(jnp.float32)
+        dis = jnp.sum((prod < 0) & mask, dtype=jnp.int32).astype(jnp.float32)
+        return acc[0] + con, acc[1] + dis
+
+    concordant, discordant = jax.lax.fori_loop(
+        0, total // chunk, body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32))
+    )
+    return concordant, discordant
+
+
+def _kendall_tau_1d(
+    preds: Array, target: Array, variant: str, t_test: bool, alternative: Optional[str]
+) -> Tuple[Array, Optional[Array]]:
+    n = jnp.asarray(preds.shape[0], jnp.float32)
+    con, dis = _pair_counts(preds, target)
+    con_min_dis = (con - dis).astype(jnp.float32)
+    x_ties, x_p1, x_p2, x_uniq = _tie_stats(preds)
+    y_ties, y_p1, y_p2, y_uniq = _tie_stats(target)
+
+    if variant == "a":
+        tau = con_min_dis / (con + dis)
+    elif variant == "b":
+        total = n * (n - 1) / 2
+        tau = con_min_dis / jnp.sqrt((total - x_ties) * (total - y_ties))
+    else:
+        min_classes = jnp.minimum(x_uniq, y_uniq).astype(jnp.float32)
+        tau = 2 * con_min_dis / ((min_classes - 1) / min_classes * n * n)
+
+    p_value = None
+    if t_test:
+        base = n * (n - 1) * (2 * n + 5)
+        if variant == "a":
+            t_value = 3 * con_min_dis / jnp.sqrt(base / 2)
+        else:
+            m = n * (n - 1)
+            denom = (base - x_p2 - y_p2) / 18
+            denom = denom + (2 * x_ties * y_ties) / m
+            denom = denom + (x_p1 * y_p1) / (9 * m * (n - 2))
+            t_value = con_min_dis / jnp.sqrt(denom)
+        cdf = jax.scipy.stats.norm.cdf
+        if alternative == "two-sided":
+            p_value = 2 * (1 - cdf(jnp.abs(t_value)))
+        elif alternative == "greater":
+            p_value = 1 - cdf(t_value)
+        else:
+            p_value = cdf(t_value)
+    return jnp.clip(tau, -1.0, 1.0), p_value
+
+
+def _kendall_corrcoef_compute(
+    preds: Array, target: Array, variant: str = "b", t_test: bool = False, alternative: Optional[str] = "two-sided"
+):
+    if preds.ndim == 1:
+        return _kendall_tau_1d(preds, target, variant, t_test, alternative)
+    taus, ps = [], []
+    for i in range(preds.shape[-1]):
+        tau, p = _kendall_tau_1d(preds[:, i], target[:, i], variant, t_test, alternative)
+        taus.append(tau)
+        ps.append(p)
+    tau = jnp.stack(taus)
+    p_value = jnp.stack(ps) if t_test else None
+    return tau, p_value
+
+
+def kendall_rank_corrcoef(
+    preds,
+    target,
+    variant: str = "b",
+    t_test: bool = False,
+    alternative: Optional[str] = "two-sided",
+):
+    """Kendall's tau; returns ``tau`` or ``(tau, p_value)`` when ``t_test``."""
+    if variant not in _ALLOWED_VARIANTS:
+        raise ValueError(f"Argument `variant` is expected to be one of {_ALLOWED_VARIANTS}, but got {variant!r}")
+    if not isinstance(t_test, bool):
+        raise ValueError(f"Argument `t_test` is expected to be of a type `bool`, but got {t_test}.")
+    if t_test and alternative not in _ALLOWED_ALTERNATIVES:
+        raise ValueError(f"Argument `alternative` is expected to be one of {_ALLOWED_ALTERNATIVES}, but got {alternative!r}")
+    preds = jnp.asarray(preds, jnp.float32)
+    target = jnp.asarray(target, jnp.float32)
+    _check_same_shape(preds, target)
+    tau, p_value = _kendall_corrcoef_compute(preds, target, variant, t_test, alternative)
+    if p_value is not None:
+        return tau, p_value
+    return tau

@@ -1,0 +1,76 @@
+"""KL divergence + Jensen-Shannon divergence. Parity: reference
+``functional/regression/{kl_divergence,js_divergence}.py``."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...utilities.checks import _check_same_shape
+from ...utilities.compute import _safe_xlogy
+
+Array = jax.Array
+
+
+def _kld_check(p, q, log_prob: bool):
+    _check_same_shape(p, q)
+    if p.ndim != 2 or q.ndim != 2:
+        raise ValueError(f"Expected both p and q distribution to be 2D but got {p.ndim} and {q.ndim} respectively")
+
+
+def _kld_update(p, q, log_prob: bool):
+    _kld_check(p, q, log_prob)
+    p = jnp.asarray(p, jnp.float32)
+    q = jnp.asarray(q, jnp.float32)
+    total = p.shape[0]
+    if log_prob:
+        measures = jnp.sum(jnp.exp(p) * (p - q), axis=-1)
+    else:
+        p = p / p.sum(axis=-1, keepdims=True)
+        q = q / q.sum(axis=-1, keepdims=True)
+        q = jnp.clip(q, min=1e-24)
+        measures = _safe_xlogy(p, p / q).sum(axis=-1)
+    return measures, total
+
+
+def _kld_compute(measures: Array, total, reduction: Optional[str] = "mean") -> Array:
+    if reduction == "sum":
+        return measures.sum()
+    if reduction == "mean":
+        return measures.sum() / total
+    if reduction in (None, "none"):
+        return measures
+    return measures / total
+
+
+def kl_divergence(p, q, log_prob: bool = False, reduction: Optional[str] = "mean") -> Array:
+    measures, total = _kld_update(p, q, log_prob)
+    return _kld_compute(measures, total, reduction)
+
+
+def _jsd_update(p, q, log_prob: bool):
+    _kld_check(p, q, log_prob)
+    p = jnp.asarray(p, jnp.float32)
+    q = jnp.asarray(q, jnp.float32)
+    total = p.shape[0]
+    if log_prob:
+        p = jnp.exp(p)
+        q = jnp.exp(q)
+    else:
+        p = p / p.sum(axis=-1, keepdims=True)
+        q = q / q.sum(axis=-1, keepdims=True)
+    m = 0.5 * (p + q)
+    m = jnp.clip(m, min=1e-24)
+    measures = 0.5 * _safe_xlogy(p, p / m).sum(axis=-1) + 0.5 * _safe_xlogy(q, q / m).sum(axis=-1)
+    return measures, total
+
+
+def _jsd_compute(measures: Array, total, reduction: Optional[str] = "mean") -> Array:
+    return _kld_compute(measures, total, reduction)
+
+
+def jensen_shannon_divergence(p, q, log_prob: bool = False, reduction: Optional[str] = "mean") -> Array:
+    measures, total = _jsd_update(p, q, log_prob)
+    return _jsd_compute(measures, total, reduction)

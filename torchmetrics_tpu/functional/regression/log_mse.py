@@ -1,0 +1,56 @@
+"""Mean squared log error + log-cosh error. Parity: reference
+``functional/regression/{log_mse,log_cosh}.py``."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...utilities.checks import _check_same_shape
+from .utils import _check_data_shape_to_num_outputs
+
+Array = jax.Array
+
+
+def _mean_squared_log_error_update(preds, target):
+    _check_same_shape(preds, target)
+    preds = jnp.asarray(preds, jnp.float32)
+    target = jnp.asarray(target, jnp.float32)
+    d = jnp.log1p(preds) - jnp.log1p(target)
+    return jnp.sum(d * d), target.size
+
+
+def _mean_squared_log_error_compute(sum_squared_log_error: Array, num_obs) -> Array:
+    return sum_squared_log_error / num_obs
+
+
+def mean_squared_log_error(preds, target) -> Array:
+    s, n = _mean_squared_log_error_update(preds, target)
+    return _mean_squared_log_error_compute(s, n)
+
+
+def _unsqueeze_tensors(preds, target):
+    if preds.ndim == 2:
+        return preds, target
+    return preds[:, None], target[:, None]
+
+
+def _log_cosh_error_update(preds, target, num_outputs: int):
+    _check_same_shape(preds, target)
+    _check_data_shape_to_num_outputs(preds, target, num_outputs)
+    preds, target = _unsqueeze_tensors(jnp.asarray(preds, jnp.float32), jnp.asarray(target, jnp.float32))
+    diff = preds - target
+    # stable log(cosh(x)) = x + softplus(-2x) - log(2)
+    sum_log_cosh_error = jnp.sum(diff + jax.nn.softplus(-2.0 * diff) - jnp.log(2.0), axis=0).squeeze()
+    return sum_log_cosh_error, target.shape[0]
+
+
+def _log_cosh_error_compute(sum_log_cosh_error: Array, num_obs) -> Array:
+    return (sum_log_cosh_error / num_obs).squeeze()
+
+
+def log_cosh_error(preds, target) -> Array:
+    preds = jnp.asarray(preds)
+    num_outputs = 1 if preds.ndim == 1 else preds.shape[1]
+    s, n = _log_cosh_error_update(preds, target, num_outputs)
+    return _log_cosh_error_compute(s, n)

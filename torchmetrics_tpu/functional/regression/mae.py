@@ -1,0 +1,32 @@
+"""Mean absolute error. Parity: reference ``functional/regression/mae.py``."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...utilities.checks import _check_same_shape
+from .utils import _check_data_shape_to_num_outputs
+
+Array = jax.Array
+
+
+def _mean_absolute_error_update(preds, target, num_outputs: int = 1):
+    _check_same_shape(preds, target)
+    if num_outputs == 1:
+        preds = jnp.reshape(preds, (-1,))
+        target = jnp.reshape(target, (-1,))
+    _check_data_shape_to_num_outputs(preds, target, num_outputs, allow_1d_reshape=True)
+    preds = jnp.asarray(preds, jnp.float32)
+    target = jnp.asarray(target, jnp.float32)
+    sum_abs_error = jnp.sum(jnp.abs(preds - target), axis=0)
+    return sum_abs_error, target.shape[0]
+
+
+def _mean_absolute_error_compute(sum_abs_error: Array, num_obs) -> Array:
+    return sum_abs_error / num_obs
+
+
+def mean_absolute_error(preds, target, num_outputs: int = 1) -> Array:
+    sum_abs_error, num_obs = _mean_absolute_error_update(preds, target, num_outputs)
+    return _mean_absolute_error_compute(sum_abs_error, num_obs)

@@ -1,0 +1,63 @@
+"""Mean absolute percentage error family. Parity: reference
+``functional/regression/{mape,symmetric_mape,wmape}.py``."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...utilities.checks import _check_same_shape
+
+Array = jax.Array
+
+_EPS = 1.17e-06
+
+
+def _mean_absolute_percentage_error_update(preds, target, epsilon: float = _EPS):
+    _check_same_shape(preds, target)
+    preds = jnp.asarray(preds, jnp.float32)
+    target = jnp.asarray(target, jnp.float32)
+    abs_per_error = jnp.abs(preds - target) / jnp.clip(jnp.abs(target), min=epsilon)
+    return jnp.sum(abs_per_error), target.size
+
+
+def _mean_absolute_percentage_error_compute(sum_abs_per_error: Array, num_obs) -> Array:
+    return sum_abs_per_error / num_obs
+
+
+def mean_absolute_percentage_error(preds, target) -> Array:
+    s, n = _mean_absolute_percentage_error_update(preds, target)
+    return _mean_absolute_percentage_error_compute(s, n)
+
+
+def _symmetric_mean_absolute_percentage_error_update(preds, target, epsilon: float = _EPS):
+    _check_same_shape(preds, target)
+    preds = jnp.asarray(preds, jnp.float32)
+    target = jnp.asarray(target, jnp.float32)
+    abs_per_error = 2 * jnp.abs(preds - target) / jnp.clip(jnp.abs(target) + jnp.abs(preds), min=epsilon)
+    return jnp.sum(abs_per_error), target.size
+
+
+def _symmetric_mean_absolute_percentage_error_compute(sum_abs_per_error: Array, num_obs) -> Array:
+    return sum_abs_per_error / num_obs
+
+
+def symmetric_mean_absolute_percentage_error(preds, target) -> Array:
+    s, n = _symmetric_mean_absolute_percentage_error_update(preds, target)
+    return _symmetric_mean_absolute_percentage_error_compute(s, n)
+
+
+def _weighted_mean_absolute_percentage_error_update(preds, target):
+    _check_same_shape(preds, target)
+    preds = jnp.asarray(preds, jnp.float32).reshape(-1)
+    target = jnp.asarray(target, jnp.float32).reshape(-1)
+    return jnp.sum(jnp.abs(preds - target)), jnp.sum(jnp.abs(target))
+
+
+def _weighted_mean_absolute_percentage_error_compute(sum_abs_error: Array, sum_scale: Array, epsilon: float = _EPS) -> Array:
+    return sum_abs_error / jnp.clip(sum_scale, min=epsilon)
+
+
+def weighted_mean_absolute_percentage_error(preds, target) -> Array:
+    sum_abs_error, sum_scale = _weighted_mean_absolute_percentage_error_update(preds, target)
+    return _weighted_mean_absolute_percentage_error_compute(sum_abs_error, sum_scale)

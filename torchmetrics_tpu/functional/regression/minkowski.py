@@ -1,0 +1,29 @@
+"""Minkowski distance. Parity: reference ``functional/regression/minkowski.py``."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...utilities.checks import _check_same_shape
+from ...utilities.exceptions import TorchMetricsUserError
+
+Array = jax.Array
+
+
+def _minkowski_distance_update(preds, targets, p: float) -> Array:
+    _check_same_shape(preds, targets)
+    if not (isinstance(p, (float, int)) and p >= 1):
+        raise TorchMetricsUserError(f"Argument ``p`` must be a float or int greater than 1, but got {p}")
+    preds = jnp.asarray(preds, jnp.float32)
+    targets = jnp.asarray(targets, jnp.float32)
+    return jnp.sum(jnp.power(jnp.abs(preds - targets), p))
+
+
+def _minkowski_distance_compute(distance: Array, p: float) -> Array:
+    return jnp.power(distance, 1.0 / p)
+
+
+def minkowski_distance(preds, targets, p: float) -> Array:
+    distance = _minkowski_distance_update(preds, targets, p)
+    return _minkowski_distance_compute(distance, p)

@@ -1,0 +1,36 @@
+"""Mean squared error. Parity: reference ``functional/regression/mse.py``
+(_mean_squared_error_update:?, mean_squared_error)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...utilities.checks import _check_same_shape
+from .utils import _check_data_shape_to_num_outputs
+
+Array = jax.Array
+
+
+def _mean_squared_error_update(preds, target, num_outputs: int):
+    _check_same_shape(preds, target)
+    if num_outputs == 1:
+        preds = jnp.reshape(preds, (-1,))
+        target = jnp.reshape(target, (-1,))
+    _check_data_shape_to_num_outputs(preds, target, num_outputs, allow_1d_reshape=True)
+    preds = jnp.asarray(preds, jnp.float32)
+    target = jnp.asarray(target, jnp.float32)
+    diff = preds - target
+    sum_squared_error = jnp.sum(diff * diff, axis=0)
+    return sum_squared_error, target.shape[0]
+
+
+def _mean_squared_error_compute(sum_squared_error: Array, num_obs, squared: bool = True) -> Array:
+    mse = sum_squared_error / num_obs
+    return mse if squared else jnp.sqrt(mse)
+
+
+def mean_squared_error(preds, target, squared: bool = True, num_outputs: int = 1) -> Array:
+    """MSE (or RMSE with ``squared=False``)."""
+    sum_squared_error, num_obs = _mean_squared_error_update(preds, target, num_outputs)
+    return _mean_squared_error_compute(sum_squared_error, num_obs, squared)

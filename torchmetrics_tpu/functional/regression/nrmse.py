@@ -1,0 +1,42 @@
+"""Normalized root mean squared error. Parity: reference
+``functional/regression/nrmse.py`` (_normalized_root_mean_squared_error_update:23)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .mse import _mean_squared_error_update
+
+Array = jax.Array
+
+_ALLOWED_NORM = ("mean", "range", "std", "l2")
+
+
+def _normalized_root_mean_squared_error_update(preds, target, num_outputs: int, normalization: str = "mean"):
+    sum_squared_error, num_obs = _mean_squared_error_update(preds, target, num_outputs)
+    target = jnp.asarray(target, jnp.float32)
+    target = target.reshape(-1) if num_outputs == 1 else target
+    if normalization == "mean":
+        denom = jnp.mean(target, axis=0)
+    elif normalization == "range":
+        denom = jnp.max(target, axis=0) - jnp.min(target, axis=0)
+    elif normalization == "std":
+        denom = jnp.std(target, axis=0)
+    elif normalization == "l2":
+        denom = jnp.linalg.norm(target, axis=0)
+    else:
+        raise ValueError(f"Argument `normalization` should be either 'mean', 'range', 'std' or 'l2', but got {normalization}")
+    return sum_squared_error, num_obs, denom
+
+
+def _normalized_root_mean_squared_error_compute(sum_squared_error: Array, num_obs, denom: Array) -> Array:
+    rmse = jnp.sqrt(sum_squared_error / num_obs)
+    return rmse / denom
+
+
+def normalized_root_mean_squared_error(preds, target, normalization: str = "mean", num_outputs: int = 1) -> Array:
+    if normalization not in _ALLOWED_NORM:
+        raise ValueError(f"Argument `normalization` should be either 'mean', 'range', 'std' or 'l2', but got {normalization}")
+    sum_squared_error, num_obs, denom = _normalized_root_mean_squared_error_update(preds, target, num_outputs, normalization)
+    return _normalized_root_mean_squared_error_compute(sum_squared_error, num_obs, denom)

@@ -1,0 +1,139 @@
+"""Pearson correlation via parallel running moments. Parity: reference
+``functional/regression/pearson.py`` (_pearson_corrcoef_update:24,
+_pearson_corrcoef_compute:91) and ``regression/pearson.py`` (_final_aggregation).
+
+TPU notes: the per-batch moments (mean/var/cov/n) combine with the exact Chan et al.
+parallel formula — associative and commutative, so the same ``_merge_moments`` serves
+batch accumulation, commless ``merge_state`` AND cross-device reduction (fold of
+all-gathered per-device moments). No in-place mutation anywhere."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...utilities.checks import _check_same_shape
+from ...utilities.prints import rank_zero_warn
+from .utils import _check_data_shape_to_num_outputs
+
+Array = jax.Array
+
+
+def _batch_moments(preds: Array, target: Array) -> Tuple[Array, ...]:
+    """Per-batch sufficient statistics (mean_x, mean_y, var_x, var_y, corr_xy, n) where
+    var/corr are *unnormalized* centered sums, as in the reference."""
+    preds = jnp.asarray(preds, jnp.float32)
+    target = jnp.asarray(target, jnp.float32)
+    n = jnp.asarray(preds.shape[0], jnp.float32)
+    mean_x = preds.mean(0)
+    mean_y = target.mean(0)
+    px = preds - mean_x
+    ty = target - mean_y
+    var_x = (px * px).sum(0)
+    var_y = (ty * ty).sum(0)
+    corr_xy = (px * ty).sum(0)
+    max_abs_dev_x = jnp.max(jnp.abs(px), axis=0)
+    max_abs_dev_y = jnp.max(jnp.abs(ty), axis=0)
+    return mean_x, mean_y, max_abs_dev_x, max_abs_dev_y, var_x, var_y, corr_xy, n
+
+
+def _merge_moments(a: Tuple[Array, ...], b: Tuple[Array, ...]) -> Tuple[Array, ...]:
+    """Exact parallel combination of two moment sets (Chan et al.)."""
+    mx_a, my_a, dev_xa, dev_ya, vx_a, vy_a, cxy_a, n_a = a
+    mx_b, my_b, dev_xb, dev_yb, vx_b, vy_b, cxy_b, n_b = b
+    n = n_a + n_b
+    safe_n = jnp.where(n == 0, 1.0, n)
+    delta_x = mx_b - mx_a
+    delta_y = my_b - my_a
+    mean_x = mx_a + delta_x * n_b / safe_n
+    mean_y = my_a + delta_y * n_b / safe_n
+    correction = n_a * n_b / safe_n
+    var_x = vx_a + vx_b + delta_x * delta_x * correction
+    var_y = vy_a + vy_b + delta_y * delta_y * correction
+    corr_xy = cxy_a + cxy_b + delta_x * delta_y * correction
+    # max-abs-deviation is only an instability detector; bound it by shifting each
+    # side's max by its mean shift (upper bound, cheap and shape-static)
+    dev_x = jnp.maximum(dev_xa + jnp.abs(mx_a - mean_x), dev_xb + jnp.abs(mx_b - mean_x))
+    dev_y = jnp.maximum(dev_ya + jnp.abs(my_a - mean_y), dev_yb + jnp.abs(my_b - mean_y))
+    return mean_x, mean_y, dev_x, dev_y, var_x, var_y, corr_xy, n
+
+
+def _pearson_corrcoef_update(
+    preds: Array,
+    target: Array,
+    mean_x: Array,
+    mean_y: Array,
+    max_abs_dev_x: Array,
+    max_abs_dev_y: Array,
+    var_x: Array,
+    var_y: Array,
+    corr_xy: Array,
+    num_prior: Array,
+    num_outputs: int,
+) -> Tuple[Array, ...]:
+    """Fold one batch into the running moments (reference pearson.py:24-88)."""
+    _check_same_shape(preds, target)
+    _check_data_shape_to_num_outputs(preds, target, num_outputs)
+    batch = _batch_moments(preds, target)
+    return _merge_moments((mean_x, mean_y, max_abs_dev_x, max_abs_dev_y, var_x, var_y, corr_xy, num_prior), batch)
+
+
+def _pearson_corrcoef_compute(
+    max_abs_dev_x: Array,
+    max_abs_dev_y: Array,
+    var_x: Array,
+    var_y: Array,
+    corr_xy: Array,
+    num_total: Array,
+) -> Array:
+    """Correlation from final moments (reference pearson.py:91-146)."""
+    var_x = var_x / (num_total - 1)
+    var_y = var_y / (num_total - 1)
+    corr_xy = corr_xy / (num_total - 1)
+    import numpy as np
+
+    if not isinstance(var_x, jax.core.Tracer):
+        vx, vy = np.asarray(var_x), np.asarray(var_y)
+        if (vx < 1e-6).any() or (vy < 1e-6).any():
+            rank_zero_warn(
+                "The variance of predictions or target is close to zero. This can cause instability in Pearson correlation"
+                "coefficient, leading to wrong results. Consider re-scaling the input if possible or computing using a"
+                f"larger dtype (currently using {var_x.dtype}).",
+                UserWarning,
+            )
+    corrcoef = jnp.clip(corr_xy / jnp.sqrt(var_x * var_y), -1.0, 1.0)
+    return corrcoef.squeeze()
+
+
+def _final_aggregation(
+    means_x: Array,
+    means_y: Array,
+    max_abs_dev_x: Array,
+    max_abs_dev_y: Array,
+    vars_x: Array,
+    vars_y: Array,
+    corrs_xy: Array,
+    nbs: Array,
+) -> Tuple[Array, ...]:
+    """Fold per-device moment stacks ``(world, num_outputs)`` into one moment set
+    (reference regression/pearson.py:_final_aggregation) — a lax.scan-free fori fold
+    would also work; world size is tiny so a Python fold is fine at trace time."""
+    acc = (means_x[0], means_y[0], max_abs_dev_x[0], max_abs_dev_y[0], vars_x[0], vars_y[0], corrs_xy[0], nbs[0])
+    for i in range(1, means_x.shape[0]):
+        acc = _merge_moments(acc, (means_x[i], means_y[i], max_abs_dev_x[i], max_abs_dev_y[i], vars_x[i], vars_y[i], corrs_xy[i], nbs[i]))
+    return acc
+
+
+def pearson_corrcoef(preds, target) -> Array:
+    """One-shot Pearson correlation coefficient."""
+    preds = jnp.asarray(preds)
+    num_outputs = 1 if preds.ndim == 1 else preds.shape[-1]
+    d = (num_outputs,) if num_outputs > 1 else ()
+    zeros = jnp.zeros(d, jnp.float32)
+    out = _pearson_corrcoef_update(
+        preds, target, zeros, zeros, zeros, zeros, zeros, zeros, zeros, jnp.zeros((), jnp.float32), num_outputs
+    )
+    _, _, dev_x, dev_y, var_x, var_y, corr_xy, n = out
+    return _pearson_corrcoef_compute(dev_x, dev_y, var_x, var_y, corr_xy, n)

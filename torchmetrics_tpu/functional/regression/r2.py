@@ -1,0 +1,89 @@
+"""R2 score + relative squared error. Parity: reference
+``functional/regression/{r2,rse}.py`` (_r2_score_update:23, _r2_score_compute:47,
+_relative_squared_error_compute)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...utilities.checks import _check_same_shape
+from ...utilities.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+def _r2_score_update(preds, target):
+    _check_same_shape(preds, target)
+    if preds.ndim > 2:
+        raise ValueError(f"Expected both prediction and target to be 1D or 2D tensors, but received tensors with dimension {preds.shape}")
+    preds = jnp.asarray(preds, jnp.float32)
+    target = jnp.asarray(target, jnp.float32)
+    sum_obs = jnp.sum(target, axis=0)
+    sum_squared_obs = jnp.sum(target * target, axis=0)
+    residual = target - preds
+    rss = jnp.sum(residual * residual, axis=0)
+    return sum_squared_obs, sum_obs, rss, target.shape[0]
+
+
+def _r2_score_compute(
+    sum_squared_obs: Array,
+    sum_obs: Array,
+    rss: Array,
+    num_obs,
+    adjusted: int = 0,
+    multioutput: str = "uniform_average",
+) -> Array:
+    mean_obs = sum_obs / num_obs
+    tss = sum_squared_obs - sum_obs * mean_obs
+    cond = tss != 0
+    raw_scores = 1 - rss / jnp.where(cond, tss, 1.0)
+    raw_scores = jnp.where(cond, raw_scores, jnp.zeros_like(raw_scores))
+
+    if multioutput == "raw_values":
+        r2 = raw_scores
+    elif multioutput == "uniform_average":
+        r2 = jnp.mean(raw_scores)
+    elif multioutput == "variance_weighted":
+        tss_sum = jnp.sum(tss)
+        r2 = jnp.sum(tss / tss_sum * raw_scores)
+    else:
+        raise ValueError(
+            f"Argument `multioutput` must be either `raw_values`, `uniform_average` or `variance_weighted`. Received {multioutput}."
+        )
+
+    if adjusted < 0 or not isinstance(adjusted, int):
+        raise ValueError("`adjusted` parameter should be an integer larger or equal to 0.")
+    if adjusted != 0:
+        import numpy as np
+
+        n = int(num_obs) if not hasattr(num_obs, "shape") or num_obs.shape == () else int(np.asarray(num_obs))
+        if n - adjusted - 1 <= 0:
+            rank_zero_warn(
+                "More independent regressions than data points in adjusted r2 score. Falls back to standard r2 score.",
+                UserWarning,
+            )
+        else:
+            return 1 - (1 - r2) * (n - 1) / (n - adjusted - 1)
+    return r2
+
+
+def r2_score(preds, target, adjusted: int = 0, multioutput: str = "uniform_average") -> Array:
+    sum_squared_obs, sum_obs, rss, num_obs = _r2_score_update(preds, target)
+    if num_obs < 2:
+        raise ValueError("Needs at least two samples to calculate r2 score.")
+    return _r2_score_compute(sum_squared_obs, sum_obs, rss, num_obs, adjusted, multioutput)
+
+
+def _relative_squared_error_compute(sum_squared_obs: Array, sum_obs: Array, rss: Array, num_obs, squared: bool = True) -> Array:
+    epsilon = jnp.finfo(jnp.float32).eps
+    tss = jnp.sum(sum_squared_obs - sum_obs * (sum_obs / num_obs))
+    rse = jnp.sum(rss) / jnp.clip(tss, min=epsilon)
+    return rse if squared else jnp.sqrt(rse)
+
+
+def relative_squared_error(preds, target, squared: bool = True) -> Array:
+    sum_squared_obs, sum_obs, rss, num_obs = _r2_score_update(preds, target)
+    return _relative_squared_error_compute(sum_squared_obs, sum_obs, rss, num_obs, squared)

@@ -1,0 +1,48 @@
+"""Spearman rank correlation. Parity: reference
+``functional/regression/spearman.py`` (_rank_data, _spearman_corrcoef_compute)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...utilities.checks import _check_same_shape
+from .utils import _check_data_shape_to_num_outputs, _rank_data
+
+Array = jax.Array
+
+
+def _spearman_corrcoef_update(preds, target, num_outputs: int):
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if not (jnp.issubdtype(preds.dtype, jnp.floating) and jnp.issubdtype(target.dtype, jnp.floating)):
+        raise TypeError(
+            "Expected `preds` and `target` both to be floating point tensors, but got {preds.dtype} and {target.dtype}"
+        )
+    _check_same_shape(preds, target)
+    _check_data_shape_to_num_outputs(preds, target, num_outputs)
+    return jnp.asarray(preds, jnp.float32), jnp.asarray(target, jnp.float32)
+
+
+def _spearman_corrcoef_compute(preds: Array, target: Array, eps: float = 1e-6) -> Array:
+    if preds.ndim == 1:
+        preds = _rank_data(preds)
+        target = _rank_data(target)
+    else:
+        preds = jnp.stack([_rank_data(preds[:, i]) for i in range(preds.shape[-1])], axis=-1)
+        target = jnp.stack([_rank_data(target[:, i]) for i in range(target.shape[-1])], axis=-1)
+
+    preds_diff = preds - preds.mean(0)
+    target_diff = target - target.mean(0)
+    cov = (preds_diff * target_diff).mean(0)
+    preds_std = jnp.sqrt((preds_diff * preds_diff).mean(0))
+    target_std = jnp.sqrt((target_diff * target_diff).mean(0))
+    corrcoef = cov / (preds_std * target_std + eps)
+    return jnp.clip(corrcoef, -1.0, 1.0)
+
+
+def spearman_corrcoef(preds, target) -> Array:
+    preds = jnp.asarray(preds)
+    num_outputs = 1 if preds.ndim == 1 else preds.shape[-1]
+    preds, target = _spearman_corrcoef_update(preds, target, num_outputs)
+    return _spearman_corrcoef_compute(preds, target)

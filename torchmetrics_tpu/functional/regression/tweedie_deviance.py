@@ -1,0 +1,54 @@
+"""Tweedie deviance score. Parity: reference
+``functional/regression/tweedie_deviance.py`` (_tweedie_deviance_score_update:22)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...utilities.checks import _check_same_shape, _is_traced
+from ...utilities.compute import _safe_xlogy
+
+Array = jax.Array
+
+
+def _tweedie_deviance_score_update(preds, targets, power: float = 0.0):
+    _check_same_shape(preds, targets)
+    preds = jnp.asarray(preds, jnp.float32)
+    targets = jnp.asarray(targets, jnp.float32)
+
+    if 0 < power < 1:
+        raise ValueError(f"Deviance Score is not defined for power={power}.")
+
+    # domain checks run host-side only when values are concrete (skipped under jit)
+    if not _is_traced(preds, targets):
+        import numpy as np
+
+        p, t = np.asarray(preds), np.asarray(targets)
+        if power == 1 and ((p <= 0).any() or (t < 0).any()):
+            raise ValueError(f"For power={power}, 'preds' has to be strictly positive and 'targets' cannot be negative.")
+        if power == 2 and ((p <= 0).any() or (t <= 0).any()):
+            raise ValueError(f"For power={power}, both 'preds' and 'targets' have to be strictly positive.")
+
+    if power == 0:
+        deviance_score = jnp.square(targets - preds)
+    elif power == 1:
+        deviance_score = 2 * (_safe_xlogy(targets, targets / preds) + preds - targets)
+    elif power == 2:
+        deviance_score = 2 * (jnp.log(preds / targets) + (targets / preds) - 1)
+    else:
+        term_1 = jnp.power(jnp.clip(targets, min=0), 2 - power) / ((1 - power) * (2 - power))
+        term_2 = targets * jnp.power(preds, 1 - power) / (1 - power)
+        term_3 = jnp.power(preds, 2 - power) / (2 - power)
+        deviance_score = 2 * (term_1 - term_2 + term_3)
+
+    return jnp.sum(deviance_score), jnp.asarray(deviance_score.size, jnp.float32)
+
+
+def _tweedie_deviance_score_compute(sum_deviance_score: Array, num_observations: Array) -> Array:
+    return sum_deviance_score / num_observations
+
+
+def tweedie_deviance_score(preds, targets, power: float = 0.0) -> Array:
+    s, n = _tweedie_deviance_score_update(preds, targets, power)
+    return _tweedie_deviance_score_compute(s, n)

@@ -1,0 +1,45 @@
+"""Shared regression helpers. Parity: reference ``functional/regression/utils.py``
+(_check_data_shape_to_num_outputs) and ``spearman.py`` (_rank_data).
+
+``_rank_data`` is the TPU-native tie-averaged ranking: instead of host loops over
+``unique`` (dynamic shapes), it sorts once and averages tied ranks with a static-shape
+``segment_sum`` keyed on run-change flags — O(n log n), fully jittable."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _check_data_shape_to_num_outputs(preds, target, num_outputs: int, allow_1d_reshape: bool = False) -> None:
+    """Check predictions/target shape against declared ``num_outputs``."""
+    if preds.ndim > 2:
+        raise ValueError(f"Expected both predictions and target to be either 1- or 2-dimensional tensors, but got {target.ndim} and {preds.ndim}.")
+    cond1 = False
+    if not allow_1d_reshape:
+        cond1 = num_outputs == 1 and preds.ndim != 1
+    cond2 = num_outputs > 1 and (preds.ndim < 2 or preds.shape[1] != num_outputs)
+    if cond1 or cond2:
+        raise ValueError(f"Expected argument `num_outputs` to match the second dimension of input, but got {num_outputs} and {preds.shape}")
+
+
+def _rank_data(x: Array) -> Array:
+    """1-based ranks with ties averaged (scipy ``rankdata`` semantics), jittable.
+
+    Sort; segment tied runs via cumsum of change flags; per-segment mean position via
+    ``segment_sum`` (static ``num_segments=n``); scatter back through the sort order.
+    """
+    x = jnp.asarray(x)
+    n = x.shape[0]
+    order = jnp.argsort(x)
+    xs = x[order]
+    change = jnp.concatenate([jnp.zeros((1,), jnp.int32), (xs[1:] != xs[:-1]).astype(jnp.int32)])
+    seg = jnp.cumsum(change)
+    pos = jnp.arange(1, n + 1, dtype=jnp.float32)
+    seg_sum = jax.ops.segment_sum(pos, seg, num_segments=n)
+    seg_cnt = jax.ops.segment_sum(jnp.ones_like(pos), seg, num_segments=n)
+    mean_rank = jnp.where(seg_cnt > 0, seg_sum / jnp.maximum(seg_cnt, 1), 0.0)
+    ranks_sorted = mean_rank[seg]
+    return jnp.zeros_like(ranks_sorted).at[order].set(ranks_sorted)

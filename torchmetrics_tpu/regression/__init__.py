@@ -1,0 +1,45 @@
+"""Regression metric classes. Parity: reference ``regression/__init__.py`` (23 metrics,
+SURVEY §2.4)."""
+
+from .crps import ContinuousRankedProbabilityScore, CriticalSuccessIndex
+from .divergence import JensenShannonDivergence, KLDivergence
+from .mse import (
+    LogCoshError,
+    MeanAbsoluteError,
+    MeanAbsolutePercentageError,
+    MeanSquaredError,
+    MeanSquaredLogError,
+    MinkowskiDistance,
+    SymmetricMeanAbsolutePercentageError,
+    TweedieDevianceScore,
+    WeightedMeanAbsolutePercentageError,
+)
+from .nrmse import NormalizedRootMeanSquaredError
+from .pearson import ConcordanceCorrCoef, PearsonCorrCoef
+from .r2 import ExplainedVariance, R2Score, RelativeSquaredError
+from .rank import CosineSimilarity, KendallRankCorrCoef, SpearmanCorrCoef
+
+__all__ = [
+    "ConcordanceCorrCoef",
+    "ContinuousRankedProbabilityScore",
+    "CosineSimilarity",
+    "CriticalSuccessIndex",
+    "ExplainedVariance",
+    "JensenShannonDivergence",
+    "KLDivergence",
+    "KendallRankCorrCoef",
+    "LogCoshError",
+    "MeanAbsoluteError",
+    "MeanAbsolutePercentageError",
+    "MeanSquaredError",
+    "MeanSquaredLogError",
+    "MinkowskiDistance",
+    "NormalizedRootMeanSquaredError",
+    "PearsonCorrCoef",
+    "R2Score",
+    "RelativeSquaredError",
+    "SpearmanCorrCoef",
+    "SymmetricMeanAbsolutePercentageError",
+    "TweedieDevianceScore",
+    "WeightedMeanAbsolutePercentageError",
+]

@@ -1,0 +1,73 @@
+"""CRPS + CriticalSuccessIndex metric classes. Parity: reference
+``regression/{crps,csi}.py``."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+
+from ..functional.regression.crps import _crps_update
+from ..functional.regression.csi import _critical_success_index_compute, _critical_success_index_update
+from ..metric import Metric
+
+
+class ContinuousRankedProbabilityScore(Metric):
+    """Reference regression/crps.py:29. Sum-state formulation: mean(diff−spread) over
+    all samples ≡ (Σdiff − Σspread)/N, so three scalar sum states suffice."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("diff_sum", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("ensemble_sum", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+    def _batch_state(self, preds, target):
+        batch_size, diff, ensemble_sum = _crps_update(preds, target)
+        return {
+            "diff_sum": diff.sum(),
+            "ensemble_sum": ensemble_sum.sum(),
+            "total": jnp.asarray(batch_size, jnp.float32),
+        }
+
+    def _compute(self, state):
+        return (state["diff_sum"] - state["ensemble_sum"]) / state["total"]
+
+
+class CriticalSuccessIndex(Metric):
+    """Reference regression/csi.py:24."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(self, threshold: float, keep_sequence_dim: Optional[int] = None, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.threshold = float(threshold)
+        if keep_sequence_dim is not None and (not isinstance(keep_sequence_dim, int) or keep_sequence_dim < 0):
+            raise ValueError(f"Expected keep_sequence_dim to be int or None but got {keep_sequence_dim}")
+        self.keep_sequence_dim = keep_sequence_dim
+        if keep_sequence_dim is None:
+            self.add_state("hits", default=jnp.zeros(()), dist_reduce_fx="sum")
+            self.add_state("misses", default=jnp.zeros(()), dist_reduce_fx="sum")
+            self.add_state("false_alarms", default=jnp.zeros(()), dist_reduce_fx="sum")
+        else:
+            self.add_state("hits", default=[], dist_reduce_fx="cat")
+            self.add_state("misses", default=[], dist_reduce_fx="cat")
+            self.add_state("false_alarms", default=[], dist_reduce_fx="cat")
+
+    def _batch_state(self, preds, target):
+        hits, misses, false_alarms = _critical_success_index_update(preds, target, self.threshold, self.keep_sequence_dim)
+        return {
+            "hits": hits.astype(jnp.float32),
+            "misses": misses.astype(jnp.float32),
+            "false_alarms": false_alarms.astype(jnp.float32),
+        }
+
+    def _compute(self, state):
+        return _critical_success_index_compute(state["hits"], state["misses"], state["false_alarms"])

@@ -1,0 +1,67 @@
+"""KL / Jensen-Shannon divergence metric classes. Parity: reference
+``regression/{kl_divergence,js_divergence}.py``."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+
+from ..functional.regression.kl_divergence import _jsd_update, _kld_compute, _kld_update
+from ..metric import Metric
+
+
+class _DivergenceBase(Metric):
+    """Shared state plumbing: scalar sum state when reducing, concat state otherwise."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, log_prob: bool = False, reduction: Optional[str] = "mean", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(log_prob, bool):
+            raise TypeError(f"Expected argument `log_prob` to be bool but got {log_prob}")
+        self.log_prob = log_prob
+        allowed_reduction = ("mean", "sum", "none", None)
+        if reduction not in allowed_reduction:
+            raise ValueError(f"Expected argument `reduction` to be one of {allowed_reduction} but got {reduction}")
+        self.reduction = reduction
+
+        if self.reduction in ("mean", "sum"):
+            self.add_state("measures", default=jnp.zeros(()), dist_reduce_fx="sum")
+        else:
+            self.add_state("measures", default=[], dist_reduce_fx="cat")
+        self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+    def _measures(self, p, q):
+        raise NotImplementedError
+
+    def _batch_state(self, p, q):
+        measures, total = self._measures(p, q)
+        if self.reduction in ("mean", "sum"):
+            measures = measures.sum()
+        return {"measures": measures, "total": jnp.asarray(total, jnp.float32)}
+
+    def _compute(self, state):
+        measures = state["measures"]
+        if self.reduction == "mean":
+            return measures / state["total"]
+        if self.reduction == "sum":
+            return measures
+        return _kld_compute(measures, state["total"], self.reduction)
+
+
+class KLDivergence(_DivergenceBase):
+    """Reference regression/kl_divergence.py:31."""
+
+    def _measures(self, p, q):
+        return _kld_update(p, q, self.log_prob)
+
+
+class JensenShannonDivergence(_DivergenceBase):
+    """Reference regression/js_divergence.py:31."""
+
+    def _measures(self, p, q):
+        return _jsd_update(p, q, self.log_prob)

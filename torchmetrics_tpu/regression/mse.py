@@ -1,0 +1,238 @@
+"""MSE / MAE / MSLE / MAPE / SMAPE / WMAPE / LogCosh / Minkowski / Tweedie metric
+classes — all simple sum-state accumulators. Parity: reference ``regression/{mse,mae,
+log_mse,mape,symmetric_mape,wmape,log_cosh,minkowski,tweedie_deviance}.py``."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+
+from ..functional.regression.log_mse import (
+    _log_cosh_error_compute,
+    _log_cosh_error_update,
+    _mean_squared_log_error_compute,
+    _mean_squared_log_error_update,
+)
+from ..functional.regression.mae import _mean_absolute_error_compute, _mean_absolute_error_update
+from ..functional.regression.mape import (
+    _mean_absolute_percentage_error_compute,
+    _mean_absolute_percentage_error_update,
+    _symmetric_mean_absolute_percentage_error_compute,
+    _symmetric_mean_absolute_percentage_error_update,
+    _weighted_mean_absolute_percentage_error_compute,
+    _weighted_mean_absolute_percentage_error_update,
+)
+from ..functional.regression.minkowski import _minkowski_distance_compute, _minkowski_distance_update
+from ..functional.regression.mse import _mean_squared_error_compute, _mean_squared_error_update
+from ..functional.regression.tweedie_deviance import (
+    _tweedie_deviance_score_compute,
+    _tweedie_deviance_score_update,
+)
+from ..metric import Metric
+from ..utilities.exceptions import TorchMetricsUserError
+
+
+class MeanSquaredError(Metric):
+    """MSE (or RMSE with ``squared=False``). Reference regression/mse.py:29."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, squared: bool = True, num_outputs: int = 1, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(squared, bool):
+            raise ValueError(f"Expected argument `squared` to be a boolean but got {squared}")
+        self.squared = squared
+        if not (isinstance(num_outputs, int) and num_outputs > 0):
+            raise ValueError(f"Expected num_outputs to be a positive integer but got {num_outputs}")
+        self.num_outputs = num_outputs
+        self.add_state("sum_squared_error", default=jnp.zeros(num_outputs), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+    def _batch_state(self, preds, target):
+        sse, n = _mean_squared_error_update(preds, target, self.num_outputs)
+        return {"sum_squared_error": sse, "total": jnp.asarray(n, jnp.float32)}
+
+    def _compute(self, state):
+        return _mean_squared_error_compute(state["sum_squared_error"], state["total"], self.squared).squeeze()
+
+
+class MeanAbsoluteError(Metric):
+    """Reference regression/mae.py:29."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, num_outputs: int = 1, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not (isinstance(num_outputs, int) and num_outputs > 0):
+            raise ValueError(f"Expected num_outputs to be a positive integer but got {num_outputs}")
+        self.num_outputs = num_outputs
+        self.add_state("sum_abs_error", default=jnp.zeros(num_outputs), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+    def _batch_state(self, preds, target):
+        sae, n = _mean_absolute_error_update(preds, target, self.num_outputs)
+        return {"sum_abs_error": sae, "total": jnp.asarray(n, jnp.float32)}
+
+    def _compute(self, state):
+        return _mean_absolute_error_compute(state["sum_abs_error"], state["total"]).squeeze()
+
+
+class MeanSquaredLogError(Metric):
+    """Reference regression/log_mse.py:28."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("sum_squared_log_error", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+    def _batch_state(self, preds, target):
+        s, n = _mean_squared_log_error_update(preds, target)
+        return {"sum_squared_log_error": s, "total": jnp.asarray(n, jnp.float32)}
+
+    def _compute(self, state):
+        return _mean_squared_log_error_compute(state["sum_squared_log_error"], state["total"])
+
+
+class MeanAbsolutePercentageError(Metric):
+    """Reference regression/mape.py:31."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("sum_abs_per_error", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+    def _batch_state(self, preds, target):
+        s, n = _mean_absolute_percentage_error_update(preds, target)
+        return {"sum_abs_per_error": s, "total": jnp.asarray(n, jnp.float32)}
+
+    def _compute(self, state):
+        return _mean_absolute_percentage_error_compute(state["sum_abs_per_error"], state["total"])
+
+
+class SymmetricMeanAbsolutePercentageError(Metric):
+    """Reference regression/symmetric_mape.py:31."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("sum_abs_per_error", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+    def _batch_state(self, preds, target):
+        s, n = _symmetric_mean_absolute_percentage_error_update(preds, target)
+        return {"sum_abs_per_error": s, "total": jnp.asarray(n, jnp.float32)}
+
+    def _compute(self, state):
+        return _symmetric_mean_absolute_percentage_error_compute(state["sum_abs_per_error"], state["total"])
+
+
+class WeightedMeanAbsolutePercentageError(Metric):
+    """Reference regression/wmape.py:32."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("sum_abs_error", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("sum_scale", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+    def _batch_state(self, preds, target):
+        sae, scale = _weighted_mean_absolute_percentage_error_update(preds, target)
+        return {"sum_abs_error": sae, "sum_scale": scale}
+
+    def _compute(self, state):
+        return _weighted_mean_absolute_percentage_error_compute(state["sum_abs_error"], state["sum_scale"])
+
+
+class LogCoshError(Metric):
+    """Reference regression/log_cosh.py:29."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, num_outputs: int = 1, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not (isinstance(num_outputs, int) and num_outputs > 0):
+            raise ValueError(f"Expected num_outputs to be a positive integer but got {num_outputs}")
+        self.num_outputs = num_outputs
+        self.add_state("sum_log_cosh_error", default=jnp.zeros(num_outputs), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+    def _batch_state(self, preds, target):
+        s, n = _log_cosh_error_update(preds, target, self.num_outputs)
+        return {"sum_log_cosh_error": s, "total": jnp.asarray(n, jnp.float32)}
+
+    def _compute(self, state):
+        return _log_cosh_error_compute(state["sum_log_cosh_error"], state["total"])
+
+
+class MinkowskiDistance(Metric):
+    """Reference regression/minkowski.py:30."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, p: float, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not (isinstance(p, (float, int)) and p >= 1):
+            raise TorchMetricsUserError(f"Argument ``p`` must be a float or int greater than 1, but got {p}")
+        self.p = p
+        self.add_state("minkowski_dist_sum", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+    def _batch_state(self, preds, targets):
+        return {"minkowski_dist_sum": _minkowski_distance_update(preds, targets, self.p)}
+
+    def _compute(self, state):
+        return _minkowski_distance_compute(state["minkowski_dist_sum"], self.p)
+
+
+class TweedieDevianceScore(Metric):
+    """Reference regression/tweedie_deviance.py:32."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, power: float = 0.0, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if 0 < power < 1:
+            raise ValueError(f"Deviance Score is not defined for power={power}.")
+        self.power = power
+        self.add_state("sum_deviance_score", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("num_observations", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+    def _batch_state(self, preds, targets):
+        s, n = _tweedie_deviance_score_update(preds, targets, self.power)
+        return {"sum_deviance_score": s, "num_observations": n}
+
+    def _compute(self, state):
+        return _tweedie_deviance_score_compute(state["sum_deviance_score"], state["num_observations"])

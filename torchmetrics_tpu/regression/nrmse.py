@@ -1,0 +1,104 @@
+"""Normalized RMSE metric class. Parity: reference ``regression/nrmse.py:95``
+(states :181-187, update :195-209, compute :217-238).
+
+TPU design: running target statistics (min/max/mean/M2/sumsq) merge with exact parallel
+formulas in a custom ``_merge`` (same trick as :class:`PearsonCorrCoef`); states register
+with ``dist_reduce_fx=None`` so process sync stacks per-device stats and ``_compute``
+folds the stack."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+
+from ..functional.regression.mse import _mean_squared_error_update
+from ..functional.regression.nrmse import _normalized_root_mean_squared_error_compute
+from ..metric import Metric
+
+_KEYS = ("sum_squared_error", "total", "min_val", "max_val", "mean_val", "var_val", "target_squared")
+
+
+class NormalizedRootMeanSquaredError(Metric):
+    """Reference regression/nrmse.py:95."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = True
+    plot_lower_bound = 0.0
+
+    def __init__(self, normalization: str = "mean", num_outputs: int = 1, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if normalization not in ("mean", "range", "std", "l2"):
+            raise ValueError(
+                f"Argument `normalization` should be either 'mean', 'range', 'std' or 'l2', but got {normalization}"
+            )
+        self.normalization = normalization
+        if not (isinstance(num_outputs, int) and num_outputs > 0):
+            raise ValueError(f"Expected num_outputs to be a positive integer but got {num_outputs}")
+        self.num_outputs = num_outputs
+        d = num_outputs
+        self.add_state("sum_squared_error", default=jnp.zeros(d), dist_reduce_fx=None)
+        self.add_state("total", default=jnp.zeros(d), dist_reduce_fx=None)
+        self.add_state("min_val", default=jnp.full((d,), jnp.inf), dist_reduce_fx=None)
+        self.add_state("max_val", default=jnp.full((d,), -jnp.inf), dist_reduce_fx=None)
+        self.add_state("mean_val", default=jnp.zeros(d), dist_reduce_fx=None)
+        self.add_state("var_val", default=jnp.zeros(d), dist_reduce_fx=None)
+        self.add_state("target_squared", default=jnp.zeros(d), dist_reduce_fx=None)
+
+    def _batch_state(self, preds, target):
+        sum_squared_error, num_obs = _mean_squared_error_update(preds, target, self.num_outputs)
+        target = jnp.asarray(target, jnp.float32)
+        target = target.reshape(-1, 1) if self.num_outputs == 1 else target
+        mean = target.mean(0)
+        centered = target - mean
+        return {
+            "sum_squared_error": jnp.atleast_1d(sum_squared_error),
+            "total": jnp.full((self.num_outputs,), jnp.asarray(num_obs, jnp.float32)),
+            "min_val": target.min(0),
+            "max_val": target.max(0),
+            "mean_val": mean,
+            "var_val": (centered * centered).sum(0),
+            "target_squared": (target * target).sum(0),
+        }
+
+    def _merge(self, a, b):
+        n_a, n_b = a["total"], b["total"]
+        n = n_a + n_b
+        safe_n = jnp.where(n == 0, 1.0, n)
+        delta = b["mean_val"] - a["mean_val"]
+        out = dict(a)
+        out["total"] = n
+        out["mean_val"] = a["mean_val"] + delta * n_b / safe_n
+        out["var_val"] = a["var_val"] + b["var_val"] + delta * delta * n_a * n_b / safe_n
+        out["min_val"] = jnp.minimum(a["min_val"], b["min_val"])
+        out["max_val"] = jnp.maximum(a["max_val"], b["max_val"])
+        out["sum_squared_error"] = a["sum_squared_error"] + b["sum_squared_error"]
+        out["target_squared"] = a["target_squared"] + b["target_squared"]
+        return out
+
+    def reduce_state(self, state, axis_name):
+        """In-graph cross-device reduction via all-gather + exact parallel fold."""
+        import jax
+
+        gathered = {k: jax.lax.all_gather(state[k], axis_name, axis=0) for k in _KEYS}
+        acc = {k: gathered[k][0] for k in _KEYS}
+        for i in range(1, jax.lax.axis_size(axis_name)):
+            acc = self._merge(acc, {k: gathered[k][i] for k in _KEYS})
+        return acc
+
+    def _compute(self, state):
+        if state["mean_val"].ndim > 1:  # stacked per-device stats from process sync
+            acc = {k: state[k][0] for k in _KEYS}
+            for i in range(1, state["mean_val"].shape[0]):
+                acc = self._merge(acc, {k: state[k][i] for k in _KEYS})
+            state = acc
+        if self.normalization == "mean":
+            denom = state["mean_val"]
+        elif self.normalization == "range":
+            denom = state["max_val"] - state["min_val"]
+        elif self.normalization == "std":
+            denom = jnp.sqrt(state["var_val"] / state["total"])
+        else:
+            denom = jnp.sqrt(state["target_squared"])
+        return _normalized_root_mean_squared_error_compute(state["sum_squared_error"], state["total"], denom).squeeze()

@@ -1,0 +1,102 @@
+"""Pearson / Concordance correlation metric classes — running parallel moments.
+Parity: reference ``regression/pearson.py:100`` (incl. ``_final_aggregation``) and
+``regression/concordance.py:28``.
+
+TPU design: the custom ``_merge`` is the exact Chan parallel-moment combination —
+associative, so the same code path serves batch folding, commless ``merge_state`` and
+cross-device aggregation. States register with ``dist_reduce_fx=None`` so process sync
+stacks per-device moments; ``_compute`` detects the stacked leading axis and folds with
+``_final_aggregation`` (mirrors the reference's multi-device handling)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+
+from ..functional.regression.concordance import _concordance_corrcoef_compute
+from ..functional.regression.pearson import (
+    _batch_moments,
+    _final_aggregation,
+    _merge_moments,
+    _pearson_corrcoef_compute,
+)
+from ..functional.regression.utils import _check_data_shape_to_num_outputs
+from ..metric import Metric
+from ..utilities.checks import _check_same_shape
+
+_MOMENT_KEYS = ("mean_x", "mean_y", "max_abs_dev_x", "max_abs_dev_y", "var_x", "var_y", "corr_xy", "n_total")
+
+
+class _MomentCorrelationBase(Metric):
+    """Shared running-moment machinery for Pearson-style correlations."""
+
+    is_differentiable = True
+    full_state_update = True
+    plot_lower_bound = -1.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, num_outputs: int = 1, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(num_outputs, int) or num_outputs < 1:
+            raise ValueError("Expected argument `num_outputs` to be an int larger than 0, but got {num_outputs}")
+        self.num_outputs = num_outputs
+        for key in _MOMENT_KEYS[:-1]:
+            self.add_state(key, default=jnp.zeros(self.num_outputs), dist_reduce_fx=None)
+        self.add_state("n_total", default=jnp.zeros(self.num_outputs), dist_reduce_fx=None)
+
+    def _batch_state(self, preds, target):
+        _check_same_shape(preds, target)
+        _check_data_shape_to_num_outputs(preds, target, self.num_outputs)
+        preds = jnp.reshape(jnp.asarray(preds, jnp.float32), (-1, self.num_outputs))
+        target = jnp.reshape(jnp.asarray(target, jnp.float32), (-1, self.num_outputs))
+        moments = _batch_moments(preds, target)
+        out = dict(zip(_MOMENT_KEYS, moments))
+        out["n_total"] = jnp.full((self.num_outputs,), out["n_total"], jnp.float32)
+        return out
+
+    def _merge(self, a, b):
+        am = tuple(a[k] for k in _MOMENT_KEYS)
+        bm = tuple(b[k] for k in _MOMENT_KEYS)
+        merged = _merge_moments(am, bm)
+        out = dict(a)
+        out.update(dict(zip(_MOMENT_KEYS, merged)))
+        return out
+
+    def reduce_state(self, state, axis_name):
+        """In-graph cross-device reduction: all-gather each moment leaf and fold with
+        the exact parallel combination (psum would be wrong for means/vars)."""
+        import jax
+
+        gathered = tuple(jax.lax.all_gather(state[k], axis_name, axis=0) for k in _MOMENT_KEYS)
+        return dict(zip(_MOMENT_KEYS, _final_aggregation(*gathered)))
+
+    def _final_moments(self, state):
+        """Moments ready for compute — folds stacked per-device moments if present."""
+        if state["mean_x"].ndim > 1:
+            return dict(zip(_MOMENT_KEYS, _final_aggregation(*(state[k] for k in _MOMENT_KEYS))))
+        return state
+
+
+class PearsonCorrCoef(_MomentCorrelationBase):
+    """Reference regression/pearson.py:100."""
+
+    higher_is_better = None
+
+    def _compute(self, state):
+        s = self._final_moments(state)
+        return _pearson_corrcoef_compute(
+            s["max_abs_dev_x"], s["max_abs_dev_y"], s["var_x"], s["var_y"], s["corr_xy"], s["n_total"]
+        )
+
+
+class ConcordanceCorrCoef(_MomentCorrelationBase):
+    """Reference regression/concordance.py:28."""
+
+    higher_is_better = None
+
+    def _compute(self, state):
+        s = self._final_moments(state)
+        return _concordance_corrcoef_compute(
+            s["max_abs_dev_x"], s["max_abs_dev_y"], s["mean_x"], s["mean_y"], s["var_x"], s["var_y"], s["corr_xy"], s["n_total"]
+        ).squeeze()

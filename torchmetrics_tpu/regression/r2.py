@@ -1,0 +1,121 @@
+"""R2 / RelativeSquaredError / ExplainedVariance metric classes. Parity: reference
+``regression/{r2,rse,explained_variance}.py``."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+
+from ..functional.regression.explained_variance import ALLOWED_MULTIOUTPUT, _explained_variance_compute, _explained_variance_update
+from ..functional.regression.r2 import _r2_score_compute, _r2_score_update, _relative_squared_error_compute
+from ..metric import Metric
+
+
+class R2Score(Metric):
+    """Reference regression/r2.py:28."""
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(self, num_outputs: int = 1, adjusted: int = 0, multioutput: str = "uniform_average", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.num_outputs = num_outputs
+        if adjusted < 0 or not isinstance(adjusted, int):
+            raise ValueError("`adjusted` parameter should be an integer larger or equal to 0.")
+        self.adjusted = adjusted
+        if multioutput not in ("raw_values", "uniform_average", "variance_weighted"):
+            raise ValueError(
+                f"Invalid input to argument `multioutput`. Choose one of the following: {('raw_values', 'uniform_average', 'variance_weighted')}"
+            )
+        self.multioutput = multioutput
+        self.add_state("sum_squared_error", default=jnp.zeros(num_outputs), dist_reduce_fx="sum")
+        self.add_state("sum_error", default=jnp.zeros(num_outputs), dist_reduce_fx="sum")
+        self.add_state("residual", default=jnp.zeros(num_outputs), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+    def _batch_state(self, preds, target):
+        sum_squared_obs, sum_obs, rss, num_obs = _r2_score_update(preds, target)
+        return {
+            "sum_squared_error": sum_squared_obs,
+            "sum_error": sum_obs,
+            "residual": rss,
+            "total": jnp.asarray(num_obs, jnp.float32),
+        }
+
+    def _compute(self, state):
+        return _r2_score_compute(
+            state["sum_squared_error"], state["sum_error"], state["residual"], state["total"], self.adjusted, self.multioutput
+        )
+
+
+class RelativeSquaredError(Metric):
+    """Reference regression/rse.py:30."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(self, num_outputs: int = 1, squared: bool = True, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.num_outputs = num_outputs
+        self.squared = squared
+        self.add_state("sum_squared_obs", default=jnp.zeros(num_outputs), dist_reduce_fx="sum")
+        self.add_state("sum_obs", default=jnp.zeros(num_outputs), dist_reduce_fx="sum")
+        self.add_state("sum_squared_error", default=jnp.zeros(num_outputs), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+    def _batch_state(self, preds, target):
+        sum_squared_obs, sum_obs, rss, num_obs = _r2_score_update(preds, target)
+        return {
+            "sum_squared_obs": sum_squared_obs,
+            "sum_obs": sum_obs,
+            "sum_squared_error": rss,
+            "total": jnp.asarray(num_obs, jnp.float32),
+        }
+
+    def _compute(self, state):
+        return _relative_squared_error_compute(
+            state["sum_squared_obs"], state["sum_obs"], state["sum_squared_error"], state["total"], self.squared
+        )
+
+
+class ExplainedVariance(Metric):
+    """Reference regression/explained_variance.py:33."""
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+    plot_upper_bound = 1.0
+
+    def __init__(self, multioutput: str = "uniform_average", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if multioutput not in ALLOWED_MULTIOUTPUT:
+            raise ValueError(f"Invalid input to argument `multioutput`. Choose one of the following: {ALLOWED_MULTIOUTPUT}")
+        self.multioutput = multioutput
+        self.add_state("sum_error", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("sum_squared_error", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("sum_target", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("sum_squared_target", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("num_obs", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+    def _batch_state(self, preds, target):
+        num_obs, sum_error, sum_squared_error, sum_target, sum_squared_target = _explained_variance_update(preds, target)
+        return {
+            "num_obs": jnp.asarray(num_obs, jnp.float32),
+            "sum_error": sum_error,
+            "sum_squared_error": sum_squared_error,
+            "sum_target": sum_target,
+            "sum_squared_target": sum_squared_target,
+        }
+
+    def _compute(self, state):
+        return _explained_variance_compute(
+            state["num_obs"],
+            state["sum_error"],
+            state["sum_squared_error"],
+            state["sum_target"],
+            state["sum_squared_target"],
+            self.multioutput,
+        )

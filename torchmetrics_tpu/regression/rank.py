@@ -1,0 +1,112 @@
+"""Rank-based correlation metric classes (Spearman, Kendall) + CosineSimilarity —
+concat-state metrics (raw samples kept, ranked/scored at compute). Parity: reference
+``regression/{spearman,kendall,cosine_similarity}.py``."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+
+from ..functional.regression.cosine_similarity import _cosine_similarity_compute, _cosine_similarity_update
+from ..functional.regression.kendall import (
+    _ALLOWED_ALTERNATIVES,
+    _ALLOWED_VARIANTS,
+    _kendall_corrcoef_compute,
+)
+from ..functional.regression.spearman import _spearman_corrcoef_compute, _spearman_corrcoef_update
+from ..metric import Metric
+
+
+class SpearmanCorrCoef(Metric):
+    """Reference regression/spearman.py:30."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = -1.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, num_outputs: int = 1, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(num_outputs, int) or num_outputs < 1:
+            raise ValueError("Expected argument `num_outputs` to be an int larger than 0, but got {num_outputs}")
+        self.num_outputs = num_outputs
+        self.add_state("preds", default=[], dist_reduce_fx="cat")
+        self.add_state("target", default=[], dist_reduce_fx="cat")
+
+    def _batch_state(self, preds, target):
+        preds, target = _spearman_corrcoef_update(preds, target, self.num_outputs)
+        return {"preds": preds, "target": target}
+
+    def _compute(self, state):
+        return _spearman_corrcoef_compute(state["preds"], state["target"])
+
+
+class KendallRankCorrCoef(Metric):
+    """Reference regression/kendall.py:36."""
+
+    is_differentiable = False
+    higher_is_better = None
+    full_state_update = True
+    plot_lower_bound = -1.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self,
+        variant: str = "b",
+        t_test: bool = False,
+        alternative: Optional[str] = "two-sided",
+        num_outputs: int = 1,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if variant not in _ALLOWED_VARIANTS:
+            raise ValueError(f"Argument `variant` is expected to be one of {_ALLOWED_VARIANTS}, but got {variant!r}")
+        if not isinstance(t_test, bool):
+            raise ValueError(f"Argument `t_test` is expected to be of a type `bool`, but got {t_test}.")
+        if t_test and alternative not in _ALLOWED_ALTERNATIVES:
+            raise ValueError(f"Argument `alternative` is expected to be one of {_ALLOWED_ALTERNATIVES}, but got {alternative!r}")
+        self.variant = variant
+        self.alternative = alternative if t_test else None
+        self.t_test = t_test
+        self.num_outputs = num_outputs
+        self.add_state("preds", default=[], dist_reduce_fx="cat")
+        self.add_state("target", default=[], dist_reduce_fx="cat")
+
+    def _batch_state(self, preds, target):
+        return {"preds": jnp.asarray(preds, jnp.float32), "target": jnp.asarray(target, jnp.float32)}
+
+    def _compute(self, state):
+        tau, p_value = _kendall_corrcoef_compute(
+            state["preds"], state["target"], self.variant, self.t_test, self.alternative
+        )
+        if p_value is not None:
+            return tau, p_value
+        return tau
+
+
+class CosineSimilarity(Metric):
+    """Reference regression/cosine_similarity.py:30."""
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, reduction: str = "sum", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        allowed_reduction = ("sum", "mean", "none", None)
+        if reduction not in allowed_reduction:
+            raise ValueError(f"Expected argument `reduction` to be one of {allowed_reduction} but got {reduction}")
+        self.reduction = reduction
+        self.add_state("preds", default=[], dist_reduce_fx="cat")
+        self.add_state("target", default=[], dist_reduce_fx="cat")
+
+    def _batch_state(self, preds, target):
+        preds, target = _cosine_similarity_update(preds, target)
+        return {"preds": preds, "target": target}
+
+    def _compute(self, state):
+        return _cosine_similarity_compute(state["preds"], state["target"], self.reduction)
