@@ -1,0 +1,335 @@
+"""Wrapper-metric tests (reference tests/unittests/wrappers/)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+from sklearn.metrics import accuracy_score, r2_score
+
+from torchmetrics_tpu import MeanMetric
+from torchmetrics_tpu.classification import BinaryAccuracy, MulticlassAccuracy, MulticlassPrecision
+from torchmetrics_tpu.collections import MetricCollection
+from torchmetrics_tpu.regression import MeanSquaredError, R2Score
+from torchmetrics_tpu.wrappers import (
+    BinaryTargetTransformer,
+    BootStrapper,
+    ClasswiseWrapper,
+    LambdaInputTransformer,
+    MetricTracker,
+    MinMaxMetric,
+    MultioutputWrapper,
+    MultitaskWrapper,
+    Running,
+)
+
+from conftest import seed_all
+
+NUM_CLASSES = 5
+
+
+class TestClasswiseWrapper:
+    def test_output_keys_default_labels(self):
+        rng = seed_all()
+        metric = ClasswiseWrapper(MulticlassAccuracy(num_classes=NUM_CLASSES, average=None))
+        preds = jnp.asarray(rng.normal(size=(64, NUM_CLASSES)).astype(np.float32))
+        target = jnp.asarray(rng.integers(0, NUM_CLASSES, 64))
+        metric.update(preds, target)
+        out = metric.compute()
+        assert set(out.keys()) == {f"multiclassaccuracy_{i}" for i in range(NUM_CLASSES)}
+
+    def test_custom_labels_and_values(self):
+        rng = seed_all()
+        labels = [f"c{i}" for i in range(NUM_CLASSES)]
+        metric = ClasswiseWrapper(MulticlassAccuracy(num_classes=NUM_CLASSES, average=None), labels=labels)
+        raw = MulticlassAccuracy(num_classes=NUM_CLASSES, average=None)
+        preds = jnp.asarray(rng.normal(size=(64, NUM_CLASSES)).astype(np.float32))
+        target = jnp.asarray(rng.integers(0, NUM_CLASSES, 64))
+        metric.update(preds, target)
+        raw.update(preds, target)
+        out = metric.compute()
+        expected = raw.compute()
+        for i, lab in enumerate(labels):
+            np.testing.assert_allclose(out[f"multiclassaccuracy_{lab}"], expected[i], atol=1e-6)
+
+    def test_forward_and_reset(self):
+        rng = seed_all()
+        metric = ClasswiseWrapper(MulticlassAccuracy(num_classes=NUM_CLASSES, average=None), prefix="acc_")
+        preds = jnp.asarray(rng.normal(size=(64, NUM_CLASSES)).astype(np.float32))
+        target = jnp.asarray(rng.integers(0, NUM_CLASSES, 64))
+        out = metric(preds, target)
+        assert set(out.keys()) == {f"acc_{i}" for i in range(NUM_CLASSES)}
+        metric.reset()
+        assert metric.update_count == 0
+
+    def test_raises_on_bad_args(self):
+        with pytest.raises(ValueError):
+            ClasswiseWrapper(1)
+        with pytest.raises(ValueError):
+            ClasswiseWrapper(MulticlassAccuracy(num_classes=3, average=None), labels="notalist")
+
+    def test_label_count_mismatch_raises(self):
+        m = ClasswiseWrapper(MulticlassAccuracy(num_classes=3, average=None), labels=["a", "b", "c", "d"])
+        m.update(jnp.asarray([[1.0, 0, 0], [0, 1.0, 0]]), jnp.asarray([0, 1]))
+        with pytest.raises(ValueError, match="number of labels"):
+            m.compute()
+
+
+class TestBootStrapper:
+    def test_mean_close_to_point_estimate(self):
+        rng = seed_all()
+        base = MulticlassAccuracy(num_classes=NUM_CLASSES, average="micro")
+        boot = BootStrapper(base, num_bootstraps=20, mean=True, std=True, raw=True, seed=1)
+        point = base.clone()
+        preds_all, target_all = [], []
+        for _ in range(4):
+            preds = jnp.asarray(rng.normal(size=(128, NUM_CLASSES)).astype(np.float32))
+            target = jnp.asarray(rng.integers(0, NUM_CLASSES, 128))
+            boot.update(preds, target)
+            point.update(preds, target)
+            preds_all.append(np.asarray(preds))
+            target_all.append(np.asarray(target))
+        out = boot.compute()
+        assert out["raw"].shape[0] == 20
+        ref = accuracy_score(np.concatenate(target_all), np.concatenate(preds_all).argmax(-1))
+        # bootstrap mean should land within a few std of the point estimate
+        assert abs(float(out["mean"]) - ref) < 5 * max(float(out["std"]), 1e-3)
+
+    def test_quantile_output(self):
+        rng = seed_all()
+        boot = BootStrapper(
+            MeanSquaredError(), num_bootstraps=8, quantile=[0.05, 0.95], raw=False, seed=2
+        )
+        preds = jnp.asarray(rng.normal(size=(256,)).astype(np.float32))
+        target = jnp.asarray(rng.normal(size=(256,)).astype(np.float32))
+        boot.update(preds, target)
+        out = boot.compute()
+        assert out["quantile"].shape == (2,)
+        assert float(out["quantile"][0]) <= float(out["quantile"][1])
+
+    def test_poisson_strategy(self):
+        rng = seed_all()
+        boot = BootStrapper(MeanSquaredError(jit=False), num_bootstraps=4, sampling_strategy="poisson", seed=3)
+        preds = jnp.asarray(rng.normal(size=(64,)).astype(np.float32))
+        target = jnp.asarray(rng.normal(size=(64,)).astype(np.float32))
+        boot.update(preds, target)
+        out = boot.compute()
+        assert np.isfinite(float(out["mean"]))
+
+    def test_raises(self):
+        with pytest.raises(ValueError):
+            BootStrapper(MeanSquaredError(), sampling_strategy="bogus")
+        with pytest.raises(ValueError):
+            BootStrapper(17)
+
+    def test_forward_is_batch_only(self):
+        rng = seed_all()
+        boot = BootStrapper(MeanSquaredError(), num_bootstraps=4, seed=5)
+        p1, t1 = jnp.zeros(32), jnp.zeros(32)  # perfect batch: mse 0
+        p2 = jnp.asarray(rng.normal(size=32).astype(np.float32)) + 10.0
+        t2 = jnp.zeros(32)
+        boot(p1, t1)
+        out2 = boot(p2, t2)
+        # second forward value covers batch 2 alone (mse ~100), not the running mix (~50)
+        assert float(out2["mean"]) > 60.0
+        # while global state covers both batches
+        assert float(boot.compute()["mean"]) < 60.0
+
+
+class TestMinMaxMetric:
+    def test_tracks_extremes(self):
+        acc = MinMaxMetric(BinaryAccuracy())
+        # first batch: 100% accuracy
+        out1 = acc(jnp.asarray([1.0, 1.0, 0.0]), jnp.asarray([1, 1, 0]))
+        assert float(out1["raw"]) == 1.0
+        assert float(out1["max"]) == 1.0
+        # second batch: accuracy falls; max stays, min follows the cumulative value
+        acc.update(jnp.asarray([0.0, 0.0, 0.0]), jnp.asarray([1, 1, 1]))
+        out2 = acc.compute()
+        assert float(out2["raw"]) == 0.5
+        assert float(out2["max"]) == 1.0
+        assert float(out2["min"]) == 0.5
+        acc.reset()
+        assert float(acc.min_val) == np.inf
+
+    def test_raises_on_nonscalar(self):
+        mm = MinMaxMetric(MulticlassAccuracy(num_classes=3, average=None))
+        mm.update(jnp.asarray([[1.0, 0, 0], [0, 1.0, 0]]), jnp.asarray([0, 1]))
+        with pytest.raises(RuntimeError):
+            mm.compute()
+
+
+class TestMultioutputWrapper:
+    def test_r2_multioutput_vs_sklearn(self):
+        rng = seed_all()
+        metric = MultioutputWrapper(R2Score(), num_outputs=2)
+        preds = rng.normal(size=(4, 64, 2)).astype(np.float32)
+        target = (preds + 0.3 * rng.normal(size=(4, 64, 2))).astype(np.float32)
+        for i in range(4):
+            metric.update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+        out = np.asarray(metric.compute())
+        p, t = preds.reshape(-1, 2), target.reshape(-1, 2)
+        ref = [r2_score(t[:, j], p[:, j]) for j in range(2)]
+        np.testing.assert_allclose(out, ref, atol=1e-4)
+
+    def test_remove_nans(self):
+        metric = MultioutputWrapper(MeanSquaredError(jit=False), num_outputs=2, remove_nans=True)
+        preds = jnp.asarray([[1.0, 2.0], [np.nan, 3.0], [2.0, np.nan]])
+        target = jnp.asarray([[1.0, 2.0], [1.0, 3.0], [2.0, 1.0]])
+        metric.update(preds, target)
+        out = np.asarray(metric.compute())
+        np.testing.assert_allclose(out, [0.0, (3.0 - 3.0) ** 2 / 2 + (2.0 - 2.0) ** 2 / 2], atol=1e-6)
+
+    def test_forward_stacks(self):
+        rng = seed_all()
+        metric = MultioutputWrapper(MeanSquaredError(), num_outputs=3)
+        preds = jnp.asarray(rng.normal(size=(32, 3)).astype(np.float32))
+        out = metric(preds, preds)
+        assert out.shape == (3,)
+        np.testing.assert_allclose(np.asarray(out), 0.0, atol=1e-6)
+
+
+class TestMultitaskWrapper:
+    def test_mixed_tasks(self):
+        rng = seed_all()
+        wrapper = MultitaskWrapper(
+            {
+                "cls": BinaryAccuracy(),
+                "reg": MeanSquaredError(),
+            }
+        )
+        preds_c = jnp.asarray((rng.random(64) > 0.5).astype(np.float32))
+        target_c = jnp.asarray(rng.integers(0, 2, 64))
+        preds_r = jnp.asarray(rng.normal(size=64).astype(np.float32))
+        target_r = jnp.asarray(rng.normal(size=64).astype(np.float32))
+        wrapper.update({"cls": preds_c, "reg": preds_r}, {"cls": target_c, "reg": target_r})
+        out = wrapper.compute()
+        ref_acc = accuracy_score(np.asarray(target_c), np.asarray(preds_c) > 0.5)
+        ref_mse = np.mean((np.asarray(preds_r) - np.asarray(target_r)) ** 2)
+        np.testing.assert_allclose(float(out["cls"]), ref_acc, atol=1e-6)
+        np.testing.assert_allclose(float(out["reg"]), ref_mse, atol=1e-5)
+
+    def test_prefix_postfix_and_key_mismatch(self):
+        wrapper = MultitaskWrapper({"a": MeanSquaredError()}, prefix="p_", postfix="_s")
+        x = jnp.ones(4)
+        wrapper.update({"a": x}, {"a": x})
+        assert list(wrapper.compute().keys()) == ["p_a_s"]
+        with pytest.raises(ValueError):
+            wrapper.update({"b": x}, {"a": x})
+
+    def test_nested_collection(self):
+        rng = seed_all()
+        wrapper = MultitaskWrapper({"cls": MetricCollection([BinaryAccuracy()])})
+        preds = jnp.asarray((rng.random(32)).astype(np.float32))
+        target = jnp.asarray(rng.integers(0, 2, 32))
+        wrapper.update({"cls": preds}, {"cls": target})
+        out = wrapper.compute()
+        assert "BinaryAccuracy" in out["cls"]
+
+
+class TestRunning:
+    def test_window_mean(self):
+        metric = Running(MeanMetric(), window=3)
+        vals = [1.0, 2.0, 3.0, 4.0, 5.0]
+        for v in vals:
+            metric.update(jnp.asarray(v))
+        # only the last 3 count
+        np.testing.assert_allclose(float(metric.compute()), np.mean(vals[-3:]), atol=1e-6)
+
+    def test_window_accuracy_statefulness(self):
+        rng = seed_all()
+        base = BinaryAccuracy()
+        metric = Running(base, window=2)
+        chunks = []
+        for _ in range(4):
+            p = jnp.asarray(rng.random(16).astype(np.float32))
+            t = jnp.asarray(rng.integers(0, 2, 16))
+            metric.update(p, t)
+            chunks.append((np.asarray(p), np.asarray(t)))
+        p = np.concatenate([c[0] for c in chunks[-2:]])
+        t = np.concatenate([c[1] for c in chunks[-2:]])
+        np.testing.assert_allclose(float(metric.compute()), accuracy_score(t, p > 0.5), atol=1e-6)
+        # base metric state is untouched by the windowed bookkeeping
+        assert base.update_count == 0
+
+    def test_forward_returns_batch_value(self):
+        metric = Running(MeanMetric(), window=2)
+        v = metric(jnp.asarray([2.0, 4.0]))
+        np.testing.assert_allclose(float(v), 3.0, atol=1e-6)
+
+    def test_raises(self):
+        with pytest.raises(ValueError):
+            Running(MeanMetric(), window=0)
+        with pytest.raises(ValueError):
+            Running(7)
+
+
+class TestMetricTracker:
+    def test_best_metric_single(self):
+        rng = seed_all()
+        tracker = MetricTracker(MulticlassAccuracy(num_classes=NUM_CLASSES, average="micro"), maximize=True)
+        accs = []
+        for step in range(3):
+            tracker.increment()
+            preds = jnp.asarray(rng.normal(size=(64, NUM_CLASSES)).astype(np.float32))
+            target = jnp.asarray(rng.integers(0, NUM_CLASSES, 64))
+            tracker.update(preds, target)
+            accs.append(float(tracker.compute()))
+        all_vals = np.asarray(tracker.compute_all())
+        np.testing.assert_allclose(all_vals, accs, atol=1e-6)
+        best, step = tracker.best_metric(return_step=True)
+        assert best == max(accs)
+        assert step == int(np.argmax(accs))
+        assert tracker.n_steps == 3
+
+    def test_collection_tracking(self):
+        rng = seed_all()
+        coll = MetricCollection([MulticlassAccuracy(NUM_CLASSES), MulticlassPrecision(NUM_CLASSES)])
+        tracker = MetricTracker(coll, maximize=[True, True])
+        for _ in range(2):
+            tracker.increment()
+            preds = jnp.asarray(rng.normal(size=(64, NUM_CLASSES)).astype(np.float32))
+            target = jnp.asarray(rng.integers(0, NUM_CLASSES, 64))
+            tracker.update(preds, target)
+        res = tracker.compute_all()
+        assert set(res.keys()) == {"MulticlassAccuracy", "MulticlassPrecision"}
+        assert res["MulticlassAccuracy"].shape == (2,)
+        best = tracker.best_metric()
+        assert set(best.keys()) == {"MulticlassAccuracy", "MulticlassPrecision"}
+
+    def test_raises_before_increment(self):
+        tracker = MetricTracker(MeanSquaredError(), maximize=False)
+        with pytest.raises(ValueError):
+            tracker.update(jnp.ones(2), jnp.ones(2))
+        with pytest.raises(ValueError):
+            tracker.compute()
+
+    def test_maximize_inference(self):
+        # BinaryAccuracy declares higher_is_better=True
+        tracker = MetricTracker(BinaryAccuracy())
+        assert tracker.maximize is True
+
+
+class TestTransformations:
+    def test_lambda_transform(self):
+        metric = LambdaInputTransformer(
+            BinaryAccuracy(),
+            transform_pred=lambda p: 1.0 - p,
+        )
+        preds = jnp.asarray([0.9, 0.1, 0.8, 0.3])
+        target = jnp.asarray([0, 1, 0, 1])
+        metric.update(preds, target)
+        np.testing.assert_allclose(float(metric.compute()), 1.0, atol=1e-6)
+
+    def test_binary_target_transformer(self):
+        metric = BinaryTargetTransformer(BinaryAccuracy(), threshold=2.0)
+        preds = jnp.asarray([0.9, 0.1, 0.9, 0.2])
+        target = jnp.asarray([5.0, 0.5, 3.0, 1.0])  # binarizes to [1, 0, 1, 0]
+        metric.update(preds, target)
+        np.testing.assert_allclose(float(metric.compute()), 1.0, atol=1e-6)
+
+    def test_raises(self):
+        with pytest.raises(TypeError):
+            LambdaInputTransformer(BinaryAccuracy(), transform_pred=123)
+        with pytest.raises(TypeError):
+            BinaryTargetTransformer(BinaryAccuracy(), threshold="x")
+        with pytest.raises(TypeError):
+            BinaryTargetTransformer(42)

@@ -1,1 +1,28 @@
-__all__: list = []
+"""Composition-layer wrappers (reference src/torchmetrics/wrappers/)."""
+
+from .abstract import WrapperMetric
+from .bootstrapping import BootStrapper
+from .classwise import ClasswiseWrapper
+from .feature_share import FeatureShare, NetworkCache
+from .minmax import MinMaxMetric
+from .multioutput import MultioutputWrapper
+from .multitask import MultitaskWrapper
+from .running import Running
+from .tracker import MetricTracker
+from .transformations import BinaryTargetTransformer, LambdaInputTransformer, MetricInputTransformer
+
+__all__ = [
+    "BinaryTargetTransformer",
+    "BootStrapper",
+    "ClasswiseWrapper",
+    "FeatureShare",
+    "LambdaInputTransformer",
+    "MetricInputTransformer",
+    "MetricTracker",
+    "MinMaxMetric",
+    "MultioutputWrapper",
+    "MultitaskWrapper",
+    "NetworkCache",
+    "Running",
+    "WrapperMetric",
+]

@@ -1,0 +1,33 @@
+"""Wrapper base class (reference wrappers/abstract.py:19).
+
+The reference's ``WrapperMetric`` exists to undo ``forward``'s double-update caching
+trickery for metrics that wrap other metrics. Our core is pure (no cache/restore
+gymnastics), so the base here only marks the class as a wrapper and provides the
+delegation-friendly defaults: wrappers own no jitted ``_batch_state``; they drive their
+children's public APIs directly.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..metric import Metric
+
+
+class WrapperMetric(Metric):
+    """Abstract base class for wrapper metrics."""
+
+    def _wrap_children_kwargs(self, **kwargs: Any) -> Any:
+        return kwargs
+
+    def _batch_state(self, *args: Any, **kwargs: Any):  # pragma: no cover - wrappers bypass
+        raise NotImplementedError(f"{type(self).__name__} drives its children directly.")
+
+    def _compute(self, state):  # pragma: no cover - wrappers bypass
+        raise NotImplementedError(f"{type(self).__name__} drives its children directly.")
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        """Wrappers define forward in terms of their children's forward."""
+        raise NotImplementedError
+
+    __call__ = forward

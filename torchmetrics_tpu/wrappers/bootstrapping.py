@@ -1,0 +1,144 @@
+"""BootStrapper (reference wrappers/bootstrapping.py:55).
+
+Maintains ``num_bootstraps`` independent copies of a base metric; every ``update``
+feeds each copy a resampled-with-replacement view of the batch; ``compute`` reports
+mean/std/quantile/raw over the replica values.
+
+TPU-first notes: the default ``multinomial`` sampler draws a *static-shape* index array
+(size == batch) so the jitted update path never recompiles. The reference's default
+``poisson`` sampler produces variable-length index sets (dynamic shape → recompile per
+unique length on XLA); it is supported for parity but ``multinomial`` is the default
+here (the two estimators are asymptotically equivalent bootstraps).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..metric import Metric
+from .abstract import WrapperMetric
+
+
+def _bootstrap_sampler(
+    rng: np.random.Generator, size: int, sampling_strategy: str = "multinomial"
+) -> np.ndarray:
+    """Resample-with-replacement row indices (reference bootstrapping.py:32)."""
+    if sampling_strategy == "poisson":
+        counts = rng.poisson(1.0, size=size)
+        return np.repeat(np.arange(size), counts)
+    if sampling_strategy == "multinomial":
+        return rng.integers(0, size, size=size)
+    raise ValueError("Unknown sampling strategy")
+
+
+class BootStrapper(WrapperMetric):
+    """Bootstrap resampling wrapper for confidence estimation.
+
+    Args:
+        base_metric: metric instance to bootstrap.
+        num_bootstraps: number of replicas.
+        mean/std: include mean/std over replicas in output dict.
+        quantile: optional quantile(s) to report (float or sequence).
+        raw: include the raw per-replica values.
+        sampling_strategy: ``"multinomial"`` (static-shape, default) or ``"poisson"``.
+        seed: host RNG seed for the resampler.
+    """
+
+    def __init__(
+        self,
+        base_metric: Metric,
+        num_bootstraps: int = 10,
+        mean: bool = True,
+        std: bool = True,
+        quantile: Optional[Union[float, Sequence[float]]] = None,
+        raw: bool = False,
+        sampling_strategy: str = "multinomial",
+        seed: int = 0,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(base_metric, Metric):
+            raise ValueError(
+                f"Expected base metric to be an instance of torchmetrics_tpu.Metric but received {base_metric}"
+            )
+        allowed_sampling = ("poisson", "multinomial")
+        if sampling_strategy not in allowed_sampling:
+            raise ValueError(
+                f"Expected argument ``sampling_strategy`` to be one of {allowed_sampling}"
+                f" but received {sampling_strategy}"
+            )
+        self.metrics = [base_metric.clone() for _ in range(num_bootstraps)]
+        self.num_bootstraps = num_bootstraps
+        self.mean = mean
+        self.std = std
+        self.quantile = quantile
+        self.raw = raw
+        self.sampling_strategy = sampling_strategy
+        self._rng = np.random.default_rng(seed)
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Feed each replica a resampled view of this batch (bootstrapping.py:126)."""
+        sizes = [len(a) for a in args if hasattr(a, "shape")]
+        sizes += [len(v) for v in kwargs.values() if hasattr(v, "shape")]
+        if not sizes:
+            raise ValueError("None of the input contained tensors, so could not determine the sampling size")
+        size = sizes[0]
+        for idx in range(self.num_bootstraps):
+            sample_idx = _bootstrap_sampler(self._rng, size, self.sampling_strategy)
+            if sample_idx.size == 0:
+                continue
+            idx_arr = jnp.asarray(sample_idx)
+            new_args = tuple(a[idx_arr] if hasattr(a, "shape") else a for a in args)
+            new_kwargs = {k: (v[idx_arr] if hasattr(v, "shape") else v) for k, v in kwargs.items()}
+            self.metrics[idx].update(*new_args, **new_kwargs)
+        self._update_count += 1
+        self._computed = None
+
+    def compute(self) -> Dict[str, jax.Array]:
+        """Aggregate replica values (bootstrapping.py:149)."""
+        computed_vals = jnp.stack([m.compute() for m in self.metrics], axis=0)
+        output: Dict[str, jax.Array] = {}
+        if self.mean:
+            output["mean"] = computed_vals.mean(axis=0)
+        if self.std:
+            output["std"] = computed_vals.std(axis=0, ddof=1)
+        if self.quantile is not None:
+            output["quantile"] = jnp.quantile(computed_vals, jnp.asarray(self.quantile), axis=0)
+        if self.raw:
+            output["raw"] = computed_vals
+        return output
+
+    def forward(self, *args: Any, **kwargs: Any) -> Dict[str, jax.Array]:
+        """Global accumulate AND batch-only bootstrap dict (reference forward contract:
+        the returned value covers this batch alone, like every other metric)."""
+        self.update(*args, **kwargs)
+        saved = [
+            {k: (list(v) if isinstance(v, list) else v) for k, v in m._state.items()} for m in self.metrics
+        ]
+        saved_counts = [m._update_count for m in self.metrics]
+        for m in self.metrics:
+            m.reset()
+        self.update(*args, **kwargs)  # fresh resample for the batch-only estimate
+        self._update_count -= 1
+        out = self.compute()
+        self._computed = None
+        for m, st, cnt in zip(self.metrics, saved, saved_counts):
+            m._state = st
+            m._update_count = cnt
+            m._computed = None
+        return out
+
+    __call__ = forward
+
+    def reset(self) -> None:
+        for m in self.metrics:
+            m.reset()
+        self._update_count = 0
+        self._computed = None
+
+    def _filter_kwargs(self, **kwargs: Any) -> Dict[str, Any]:
+        return self.metrics[0]._filter_kwargs(**kwargs)

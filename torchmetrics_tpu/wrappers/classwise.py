@@ -1,0 +1,85 @@
+"""ClasswiseWrapper (reference wrappers/classwise.py:32).
+
+Splits a per-class tensor output (``average=None`` metrics) into a labeled dict.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+
+from ..metric import Metric
+from .abstract import WrapperMetric
+
+
+class ClasswiseWrapper(WrapperMetric):
+    """Wrap a metric returning a per-class vector into a ``{label: scalar}`` dict.
+
+    Args:
+        metric: base metric returning a tensor with one element per class.
+        labels: list of class label strings; defaults to class indices.
+        prefix: key prefix; defaults to ``<metricname>_`` when neither prefix nor
+            postfix is given (reference classwise.py:156).
+        postfix: key postfix.
+    """
+
+    def __init__(
+        self,
+        metric: Metric,
+        labels: Optional[List[str]] = None,
+        prefix: Optional[str] = None,
+        postfix: Optional[str] = None,
+    ) -> None:
+        super().__init__()
+        if not isinstance(metric, Metric):
+            raise ValueError(f"Expected argument `metric` to be an instance of `Metric` but got {metric}")
+        if labels is not None and not (isinstance(labels, list) and all(isinstance(lab, str) for lab in labels)):
+            raise ValueError(f"Expected argument `labels` to either be `None` or a list of strings but got {labels}")
+        if prefix is not None and not isinstance(prefix, str):
+            raise ValueError(f"Expected argument `prefix` to either be `None` or a string but got {prefix}")
+        if postfix is not None and not isinstance(postfix, str):
+            raise ValueError(f"Expected argument `postfix` to either be `None` or a string but got {postfix}")
+        self.metric = metric
+        self.labels = labels
+        if prefix is None and postfix is None:
+            prefix = f"{type(metric).__name__.lower()}_"
+        self._prefix = prefix or ""
+        self._postfix = postfix or ""
+
+    def _convert_output(self, x: jax.Array) -> Dict[str, jax.Array]:
+        n = int(x.shape[0]) if getattr(x, "ndim", 0) > 0 else 1
+        labels = self.labels if self.labels is not None else list(range(n))
+        if len(labels) != n:
+            # jnp indexing clamps out-of-bounds, which would silently duplicate values
+            raise ValueError(
+                f"Expected number of labels ({len(labels)}) to match the metric output length ({n})."
+            )
+        return {f"{self._prefix}{lab}{self._postfix}": x[i] for i, lab in enumerate(labels)}
+
+    def _filter_kwargs(self, **kwargs: Any) -> Dict[str, Any]:
+        return self.metric._filter_kwargs(**kwargs)
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        self.metric.update(*args, **kwargs)
+        self._update_count += 1
+        self._computed = None
+
+    def compute(self) -> Dict[str, jax.Array]:
+        return self._convert_output(self.metric.compute())
+
+    def forward(self, *args: Any, **kwargs: Any) -> Dict[str, jax.Array]:
+        val = self.metric.forward(*args, **kwargs)
+        self._update_count += 1
+        return self._convert_output(val)
+
+    __call__ = forward
+
+    def reset(self) -> None:
+        self.metric.reset()
+        self._update_count = 0
+        self._computed = None
+
+    @property
+    def metric_state(self):
+        return self.metric.metric_state

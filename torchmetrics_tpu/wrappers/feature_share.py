@@ -1,0 +1,78 @@
+"""FeatureShare (reference wrappers/feature_share.py:27,46).
+
+A ``MetricCollection`` where all member metrics share ONE feature-extractor forward:
+each metric declares ``feature_network = "<attr name>"`` pointing at its extractor
+callable; the wrapper swaps every member's extractor for a single shared, memoized one
+so e.g. FID+KID+IS run one Inception forward per batch instead of three.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping, Optional, Sequence, Union
+
+from ..collections import MetricCollection
+from ..metric import Metric
+
+
+class NetworkCache:
+    """Memoizing wrapper around a feature-extractor callable (feature_share.py:27).
+
+    Results are cached per input-buffer identity (`id` of the unwrapped arrays), which
+    is exactly the sharing pattern of a collection update: every member metric calls
+    the extractor with the *same* array objects within one ``update`` call.
+    """
+
+    def __init__(self, network: Any, max_size: int = 100) -> None:
+        self.network = network
+        self.max_size = max_size
+        # entries hold strong refs to the input arrays: an id() key is only valid while
+        # the object it names is alive, so inputs must outlive their cache entry
+        self._cache: Dict[tuple, tuple] = {}
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        key = tuple(id(a) for a in args) + tuple((k, id(v)) for k, v in sorted(kwargs.items()))
+        if key in self._cache:
+            return self._cache[key][-1]
+        out = self.network(*args, **kwargs)
+        if len(self._cache) >= self.max_size:
+            self._cache.pop(next(iter(self._cache)))
+        self._cache[key] = (args, kwargs, out)
+        return out
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self.__dict__["network"], name)
+
+
+class FeatureShare(MetricCollection):
+    """MetricCollection that dedupes the members' shared feature extractor."""
+
+    def __init__(
+        self,
+        metrics: Union[Metric, Sequence[Metric], Mapping[str, Metric]],
+        max_cache_size: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(metrics, compute_groups=False, **kwargs)
+        if max_cache_size is None:
+            max_cache_size = len(self)
+        if not isinstance(max_cache_size, int):
+            raise TypeError(f"max_cache_size should be an integer, but got {max_cache_size}")
+
+        try:
+            first = next(iter(self.values()))
+            network_name = str(first.feature_network)
+        except AttributeError as err:
+            raise AttributeError(
+                "Tried to extract the network to share from the first metric, but it did not have a"
+                " `feature_network` attribute. Please make sure that the metric has an attribute with that name,"
+                " else it cannot be shared."
+            ) from err
+        shared = NetworkCache(getattr(first, network_name), max_size=max_cache_size)
+        for metric in self.values():
+            if not hasattr(metric, "feature_network"):
+                raise AttributeError(
+                    "Tried to set the cached network to all metrics, but one of the metrics did not have a"
+                    " `feature_network` attribute. Please make sure that all metrics have that attribute,"
+                    " else the network cannot be shared."
+                )
+            setattr(metric, str(metric.feature_network), shared)

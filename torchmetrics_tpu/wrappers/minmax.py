@@ -1,0 +1,69 @@
+"""MinMaxMetric (reference wrappers/minmax.py:30).
+
+Tracks the running min/max of the wrapped metric's compute value over time.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from ..metric import Metric
+from .abstract import WrapperMetric
+
+
+class MinMaxMetric(WrapperMetric):
+    """Report ``{"raw": value, "min": lowest-seen, "max": highest-seen}``."""
+
+    def __init__(self, base_metric: Metric, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(base_metric, Metric):
+            raise ValueError(
+                f"Expected base metric to be an instance of `torchmetrics_tpu.Metric` but received {base_metric}"
+            )
+        self._base_metric = base_metric
+        self.min_val = jnp.asarray(jnp.inf)
+        self.max_val = jnp.asarray(-jnp.inf)
+
+    @staticmethod
+    def _is_suitable_val(val: Any) -> bool:
+        if isinstance(val, (int, float)):
+            return True
+        if hasattr(val, "shape"):
+            return val.size == 1
+        return False
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        self._base_metric.update(*args, **kwargs)
+        self._update_count += 1
+        self._computed = None
+
+    def compute(self) -> Dict[str, jax.Array]:
+        val = self._base_metric.compute()
+        if not self._is_suitable_val(val):
+            raise RuntimeError(f"Returned value from base metric should be a float or scalar tensor, but got {val}.")
+        self.max_val = jnp.maximum(self.max_val, val)
+        self.min_val = jnp.minimum(self.min_val, val)
+        return {"raw": val, "max": self.max_val, "min": self.min_val}
+
+    def forward(self, *args: Any, **kwargs: Any) -> Dict[str, jax.Array]:
+        val = self._base_metric.forward(*args, **kwargs)
+        self._update_count += 1
+        if self._is_suitable_val(val):
+            self.max_val = jnp.maximum(self.max_val, val)
+            self.min_val = jnp.minimum(self.min_val, val)
+        return {"raw": val, "max": self.max_val, "min": self.min_val}
+
+    __call__ = forward
+
+    def reset(self) -> None:
+        self._base_metric.reset()
+        self.min_val = jnp.asarray(jnp.inf)
+        self.max_val = jnp.asarray(-jnp.inf)
+        self._update_count = 0
+        self._computed = None
+
+    def _filter_kwargs(self, **kwargs: Any) -> Dict[str, Any]:
+        return self._base_metric._filter_kwargs(**kwargs)

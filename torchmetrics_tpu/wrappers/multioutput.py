@@ -1,0 +1,101 @@
+"""MultioutputWrapper (reference wrappers/multioutput.py:44).
+
+Computes one copy of a single-output metric per slice of an output dimension, with
+optional NaN-row removal per output.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..metric import Metric
+from .abstract import WrapperMetric
+
+
+def _nan_rows(*arrays: jax.Array) -> jax.Array:
+    """Rows (dim-0 indices) where any input holds a NaN."""
+    mask = None
+    for a in arrays:
+        flat = jnp.isnan(a.reshape(a.shape[0], -1)).any(axis=-1) if a.ndim > 1 else jnp.isnan(a)
+        mask = flat if mask is None else (mask | flat)
+    return mask
+
+
+class MultioutputWrapper(WrapperMetric):
+    """Evaluate ``base_metric`` independently along ``output_dim`` slices.
+
+    Args:
+        base_metric: single-output metric to replicate.
+        num_outputs: number of slices along ``output_dim``.
+        output_dim: dimension to slice inputs along.
+        remove_nans: drop dim-0 rows containing NaN in any input (per output slice).
+        squeeze_outputs: squeeze the selected slice's output dim before updating.
+    """
+
+    is_differentiable = False
+
+    def __init__(
+        self,
+        base_metric: Metric,
+        num_outputs: int,
+        output_dim: int = -1,
+        remove_nans: bool = True,
+        squeeze_outputs: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.metrics = [base_metric.clone() for _ in range(num_outputs)]
+        self.output_dim = output_dim
+        self.remove_nans = remove_nans
+        self.squeeze_outputs = squeeze_outputs
+
+    def _slice_inputs(self, *args: Any, **kwargs: Any) -> List[Tuple[tuple, dict]]:
+        out = []
+        for i in range(len(self.metrics)):
+            sel = lambda a: jnp.take(a, jnp.asarray([i]), axis=self.output_dim) if hasattr(a, "shape") else a
+            sargs = tuple(sel(a) for a in args)
+            skwargs = {k: sel(v) for k, v in kwargs.items()}
+            if self.remove_nans:
+                tensors = [a for a in (*sargs, *skwargs.values()) if hasattr(a, "shape")]
+                nan_idx = _nan_rows(*tensors)
+                keep = jnp.flatnonzero(~nan_idx)  # dynamic shape: host-side filter (eval path)
+                sargs = tuple(a[keep] if hasattr(a, "shape") else a for a in sargs)
+                skwargs = {k: (v[keep] if hasattr(v, "shape") else v) for k, v in skwargs.items()}
+            if self.squeeze_outputs:
+                sargs = tuple(jnp.squeeze(a, self.output_dim) if hasattr(a, "shape") else a for a in sargs)
+                skwargs = {k: (jnp.squeeze(v, self.output_dim) if hasattr(v, "shape") else v) for k, v in skwargs.items()}
+            out.append((sargs, skwargs))
+        return out
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        for metric, (sargs, skwargs) in zip(self.metrics, self._slice_inputs(*args, **kwargs)):
+            metric.update(*sargs, **skwargs)
+        self._update_count += 1
+        self._computed = None
+
+    def compute(self) -> jax.Array:
+        return jnp.stack([m.compute() for m in self.metrics], axis=0)
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        results = [
+            metric.forward(*sargs, **skwargs)
+            for metric, (sargs, skwargs) in zip(self.metrics, self._slice_inputs(*args, **kwargs))
+        ]
+        self._update_count += 1
+        if any(r is None for r in results):
+            return None
+        return jnp.stack(results, 0)
+
+    __call__ = forward
+
+    def reset(self) -> None:
+        for m in self.metrics:
+            m.reset()
+        self._update_count = 0
+        self._computed = None
+
+    def _filter_kwargs(self, **kwargs: Any) -> Dict[str, Any]:
+        return self.metrics[0]._filter_kwargs(**kwargs)

@@ -1,0 +1,86 @@
+"""Running (reference wrappers/running.py:28).
+
+Metric value over the last ``window`` updates. The reference stores ``window`` extra
+copies of every state inside the base metric (``key_{i}`` states, cyclic overwrite);
+the pure-state design here keeps a ring of ``window`` full state pytrees captured per
+update and folds them at compute — same memory, no name mangling.
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+from typing import Any
+
+from ..metric import Metric
+from .abstract import WrapperMetric
+
+
+def _snapshot(metric: Metric) -> dict:
+    return {k: (list(v) if isinstance(v, list) else v) for k, v in metric._state.items()}
+
+
+class Running(WrapperMetric):
+    """Wrap a metric so ``compute()`` covers only the last ``window`` updates."""
+
+    def __init__(self, base_metric: Metric, window: int = 5) -> None:
+        super().__init__()
+        if not isinstance(base_metric, Metric):
+            raise ValueError(
+                f"Expected argument `base_metric` to be an instance of `torchmetrics_tpu.Metric` but got {base_metric}"
+            )
+        if not (isinstance(window, int) and window > 0):
+            raise ValueError(f"Expected argument `window` to be a positive integer but got {window}")
+        self.base_metric = base_metric
+        self.window = window
+        self._ring: list = []  # newest-last list of per-update state pytrees
+
+    @contextmanager
+    def _scratch_base(self):
+        """Run the base metric from a fresh state, restoring its real state after."""
+        saved, saved_count = _snapshot(self.base_metric), self.base_metric._update_count
+        self.base_metric.reset()
+        try:
+            yield self.base_metric
+        finally:
+            self.base_metric._state = saved
+            self.base_metric._update_count = saved_count
+            self.base_metric._computed = None
+
+    def _push(self, contrib: dict) -> None:
+        self._ring.append(contrib)
+        if len(self._ring) > self.window:
+            self._ring.pop(0)
+        self._update_count += 1
+        self._computed = None
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Capture this update's isolated state contribution into the ring."""
+        with self._scratch_base() as probe:
+            probe.update(*args, **kwargs)
+            self._push(_snapshot(probe))
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        """Batch value from the base metric; ring updated as in ``update``."""
+        with self._scratch_base() as probe:
+            val = probe.forward(*args, **kwargs)
+            self._push(_snapshot(probe))
+        return val
+
+    __call__ = forward
+
+    def compute(self) -> Any:
+        """Fold the ring into a fresh state and compute."""
+        with self._scratch_base() as probe:
+            for contrib in self._ring:
+                probe.merge_state({k: (list(v) if isinstance(v, list) else v) for k, v in contrib.items()})
+            probe._update_count = max(1, len(self._ring))
+            return probe.compute()
+
+    def reset(self) -> None:
+        self.base_metric.reset()
+        self._ring = []
+        self._update_count = 0
+        self._computed = None
+
+    def _filter_kwargs(self, **kwargs: Any):
+        return self.base_metric._filter_kwargs(**kwargs)

@@ -1,0 +1,149 @@
+"""MetricTracker (reference wrappers/tracker.py:33).
+
+Tracks a metric (or collection) across time steps: ``increment()`` starts a new step
+with a fresh clone; ``compute_all()`` stacks per-step values; ``best_metric()`` picks
+the best step per the ``maximize`` flag(s).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..collections import MetricCollection
+from ..metric import Metric
+from ..utilities.prints import rank_zero_warn
+
+
+class MetricTracker:
+    """List of per-step metric clones with best-value bookkeeping."""
+
+    def __init__(
+        self, metric: Union[Metric, MetricCollection], maximize: Union[bool, List[bool], None] = None
+    ) -> None:
+        if not isinstance(metric, (Metric, MetricCollection)):
+            raise TypeError(
+                "Metric arg need to be an instance of a torchmetrics_tpu"
+                f" `Metric` or `MetricCollection` but got {metric}"
+            )
+        self._base_metric = metric
+        if maximize is None:
+            if isinstance(metric, Metric):
+                if getattr(metric, "higher_is_better", None) is None:
+                    raise AttributeError(
+                        f"The metric '{type(metric).__name__}' does not have a 'higher_is_better' attribute set,"
+                        " and the `maximize` argument was not provided."
+                    )
+                maximize = bool(metric.higher_is_better)
+            else:
+                maximize = []
+                for name, m in metric.items(keep_base=True):
+                    if getattr(m, "higher_is_better", None) is None:
+                        raise AttributeError(
+                            f"The metric '{name}' does not have a 'higher_is_better' attribute set,"
+                            " and the `maximize` argument was not provided."
+                        )
+                    maximize.append(bool(m.higher_is_better))
+        if not isinstance(maximize, (bool, list)):
+            raise ValueError("Argument `maximize` should either be a single bool or list of bool")
+        if isinstance(maximize, list) and not (
+            isinstance(metric, MetricCollection) and len(maximize) == len(metric)
+        ):
+            raise ValueError(
+                "The len of argument `maximize` should match the length of the metric collection"
+            )
+        if isinstance(metric, Metric) and not isinstance(maximize, bool):
+            raise ValueError("Argument `maximize` should be a single bool when `metric` is a single Metric")
+        self.maximize = maximize
+        self._steps: List[Union[Metric, MetricCollection]] = []
+        self._increment_called = False
+
+    @property
+    def n_steps(self) -> int:
+        """Number of tracked steps."""
+        return len(self._steps)
+
+    def increment(self) -> None:
+        """Start a new time step with a fresh (reset) clone."""
+        self._increment_called = True
+        clone = self._base_metric.clone()
+        clone.reset()
+        self._steps.append(clone)
+
+    def _check_for_increment(self, method: str) -> None:
+        if not self._increment_called:
+            raise ValueError(f"`{method}` cannot be called before `.increment()` has been called.")
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        self._check_for_increment("forward")
+        return self._steps[-1](*args, **kwargs)
+
+    __call__ = forward
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        self._check_for_increment("update")
+        self._steps[-1].update(*args, **kwargs)
+
+    def compute(self) -> Any:
+        self._check_for_increment("compute")
+        return self._steps[-1].compute()
+
+    def compute_all(self) -> Any:
+        """Stack values from all steps (tracker.py:188)."""
+        self._check_for_increment("compute_all")
+        res = [step.compute() for step in self._steps]
+        if res and isinstance(res[0], dict):
+            keys = res[0].keys()
+            return {k: jnp.stack([jnp.asarray(r[k]) for r in res], axis=0) for k in keys}
+        return jnp.stack([jnp.asarray(r) for r in res], axis=0)
+
+    def reset(self) -> None:
+        """Reset the current step."""
+        self._steps[-1].reset()
+
+    def reset_all(self) -> None:
+        """Drop all tracked steps."""
+        self._steps = []
+        self._increment_called = False
+
+    def best_metric(
+        self, return_step: bool = False
+    ) -> Union[Any, Tuple[Any, Any]]:
+        """Best value (and optionally its step index) over time (tracker.py:238)."""
+        res = self.compute_all()
+        if isinstance(res, dict):
+            maximize = self.maximize if isinstance(self.maximize, list) else [self.maximize] * len(res)
+            value: Dict[str, Any] = {}
+            idx: Dict[str, Any] = {}
+            for i, (k, v) in enumerate(res.items()):
+                try:
+                    arr = np.asarray(v)
+                    best = int(np.argmax(arr)) if maximize[i] else int(np.argmin(arr))
+                    value[k], idx[k] = float(arr[best]), best
+                except (ValueError, TypeError) as err:
+                    rank_zero_warn(
+                        f"Encountered the following error when trying to get the best metric for metric {k}:"
+                        f"{err}. Returning `None` instead.",
+                        UserWarning,
+                    )
+                    value[k], idx[k] = None, None
+            return (value, idx) if return_step else value
+        try:
+            arr = np.asarray(res)
+            best = int(np.argmax(arr)) if self.maximize else int(np.argmin(arr))
+            return (float(arr[best]), best) if return_step else float(arr[best])
+        except (ValueError, TypeError) as err:
+            rank_zero_warn(
+                f"Encountered the following error when trying to get the best metric: {err}."
+                " Returning `None` instead.",
+                UserWarning,
+            )
+            return (None, None) if return_step else None
+
+    def __getitem__(self, idx: int) -> Union[Metric, MetricCollection]:
+        return self._steps[idx]
+
+    def __len__(self) -> int:
+        return len(self._steps)
