@@ -1,0 +1,319 @@
+"""Curve operating-point metrics: EER, LogAUC, {Precision,Recall,Sensitivity,
+Specificity}@Fixed*, group fairness (reference tests/unittests/classification/)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import precision_recall_curve as sk_pr_curve, roc_curve as sk_roc_curve
+
+from conftest import seed_all
+from torchmetrics_tpu.classification import (
+    BinaryEER,
+    BinaryFairness,
+    BinaryGroupStatRates,
+    BinaryLogAUC,
+    BinaryPrecisionAtFixedRecall,
+    BinaryRecallAtFixedPrecision,
+    BinarySensitivityAtSpecificity,
+    BinarySpecificityAtSensitivity,
+    EER,
+    LogAUC,
+    MulticlassEER,
+    MulticlassPrecisionAtFixedRecall,
+    MulticlassRecallAtFixedPrecision,
+    PrecisionAtFixedRecall,
+    RecallAtFixedPrecision,
+)
+from torchmetrics_tpu.functional.classification import (
+    binary_eer,
+    binary_fairness,
+    binary_groups_stat_rates,
+    binary_logauc,
+    binary_precision_at_fixed_recall,
+    binary_recall_at_fixed_precision,
+    binary_sensitivity_at_specificity,
+    binary_specificity_at_sensitivity,
+    demographic_parity,
+    equal_opportunity,
+    multiclass_eer,
+    multiclass_recall_at_fixed_precision,
+)
+
+NUM_CLASSES = 5
+
+
+def _sk_recall_at_fixed_precision(y, p, min_precision):
+    precision, recall, thresholds = sk_pr_curve(y, p)
+    best_r, best_t = 0.0, float("nan")
+    best = None
+    for pr, rc, th in zip(precision[:-1], recall[:-1], thresholds):
+        if pr >= min_precision:
+            cand = (rc, pr, th)
+            if best is None or cand > best:
+                best = cand
+    # final curve point (recall 0, precision 1) has no threshold; reference zips to min len
+    if best is not None:
+        best_r, best_t = best[0], best[2]
+    if best_r == 0.0:
+        best_t = float("nan")
+    return best_r, best_t
+
+
+def _sk_eer(y, p):
+    fpr, tpr, _ = sk_roc_curve(y, p, drop_intermediate=False)
+    fnr = 1 - tpr
+    i = np.argmin(np.abs(fpr - fnr))
+    return (fpr[i] + fnr[i]) / 2
+
+
+class TestRecallAtFixedPrecision:
+    @pytest.mark.parametrize("min_precision", [0.3, 0.5, 0.8])
+    def test_binary_unbinned_vs_sklearn(self, min_precision):
+        rng = seed_all()
+        p = rng.random(200).astype(np.float32)
+        y = rng.integers(0, 2, 200)
+        ref_r, ref_t = _sk_recall_at_fixed_precision(y, p, min_precision)
+        r, t = binary_recall_at_fixed_precision(jnp.asarray(p), jnp.asarray(y), min_precision)
+        np.testing.assert_allclose(float(r), ref_r, atol=1e-6)
+        if not np.isnan(ref_t):
+            np.testing.assert_allclose(float(t), ref_t, atol=1e-6)
+
+    def test_binary_binned_close(self):
+        rng = seed_all()
+        p = rng.random(500).astype(np.float32)
+        y = rng.integers(0, 2, 500)
+        r_exact, _ = binary_recall_at_fixed_precision(jnp.asarray(p), jnp.asarray(y), 0.5)
+        r_binned, _ = binary_recall_at_fixed_precision(jnp.asarray(p), jnp.asarray(y), 0.5, thresholds=200)
+        np.testing.assert_allclose(float(r_binned), float(r_exact), atol=0.05)
+
+    def test_class_accumulation(self):
+        rng = seed_all()
+        metric = BinaryRecallAtFixedPrecision(min_precision=0.5)
+        chunks = [(rng.random(64).astype(np.float32), rng.integers(0, 2, 64)) for _ in range(4)]
+        for p, y in chunks:
+            metric.update(jnp.asarray(p), jnp.asarray(y))
+        p_all = np.concatenate([c[0] for c in chunks])
+        y_all = np.concatenate([c[1] for c in chunks])
+        ref_r, _ = _sk_recall_at_fixed_precision(y_all, p_all, 0.5)
+        r, t = metric.compute()
+        np.testing.assert_allclose(float(r), ref_r, atol=1e-6)
+
+    def test_multiclass_shapes(self):
+        rng = seed_all()
+        p = rng.random((100, NUM_CLASSES)).astype(np.float32)
+        p = p / p.sum(-1, keepdims=True)
+        y = rng.integers(0, NUM_CLASSES, 100)
+        r, t = multiclass_recall_at_fixed_precision(jnp.asarray(p), jnp.asarray(y), NUM_CLASSES, 0.5)
+        assert r.shape == (NUM_CLASSES,)
+        assert t.shape == (NUM_CLASSES,)
+        # per-class parity vs binary sklearn one-vs-rest
+        for c in range(NUM_CLASSES):
+            ref_r, _ = _sk_recall_at_fixed_precision((y == c).astype(int), p[:, c], 0.5)
+            np.testing.assert_allclose(float(r[c]), ref_r, atol=1e-6, err_msg=f"class {c}")
+
+    def test_facade(self):
+        m = RecallAtFixedPrecision(task="binary", min_precision=0.5)
+        assert isinstance(m, BinaryRecallAtFixedPrecision)
+        m = RecallAtFixedPrecision(task="multiclass", min_precision=0.5, num_classes=3)
+        assert isinstance(m, MulticlassRecallAtFixedPrecision)
+
+
+class TestPrecisionAtFixedRecall:
+    @pytest.mark.parametrize("min_recall", [0.3, 0.5, 0.8])
+    def test_binary_vs_sklearn(self, min_recall):
+        rng = seed_all()
+        p = rng.random(200).astype(np.float32)
+        y = rng.integers(0, 2, 200)
+        precision, recall, thresholds = sk_pr_curve(y, p)
+        best = max(
+            ((pr, rc, th) for pr, rc, th in zip(precision[:-1], recall[:-1], thresholds) if rc >= min_recall),
+            default=None,
+        )
+        ref_p = best[0] if best else 0.0
+        p_val, t_val = binary_precision_at_fixed_recall(jnp.asarray(p), jnp.asarray(y), min_recall)
+        np.testing.assert_allclose(float(p_val), ref_p, atol=1e-6)
+
+    def test_class_and_facade(self):
+        m = PrecisionAtFixedRecall(task="binary", min_recall=0.5)
+        assert isinstance(m, BinaryPrecisionAtFixedRecall)
+        rng = seed_all()
+        p = rng.random(128).astype(np.float32)
+        y = rng.integers(0, 2, 128)
+        m.update(jnp.asarray(p), jnp.asarray(y))
+        val, thr = m.compute()
+        fn_val, fn_thr = binary_precision_at_fixed_recall(jnp.asarray(p), jnp.asarray(y), 0.5)
+        np.testing.assert_allclose(float(val), float(fn_val), atol=1e-6)
+
+
+class TestSensitivitySpecificityAt:
+    def test_sensitivity_at_specificity_vs_roc(self):
+        rng = seed_all()
+        p = rng.random(300).astype(np.float32)
+        y = rng.integers(0, 2, 300)
+        min_spec = 0.6
+        fpr, tpr, thr = sk_roc_curve(y, p)
+        mask = (1 - fpr) >= min_spec
+        ref = tpr[mask].max() if mask.any() else 0.0
+        sens, t = binary_sensitivity_at_specificity(jnp.asarray(p), jnp.asarray(y), min_spec)
+        np.testing.assert_allclose(float(sens), ref, atol=1e-6)
+
+    def test_specificity_at_sensitivity_vs_roc(self):
+        rng = seed_all()
+        p = rng.random(300).astype(np.float32)
+        y = rng.integers(0, 2, 300)
+        min_sens = 0.6
+        fpr, tpr, thr = sk_roc_curve(y, p)
+        mask = tpr >= min_sens
+        ref = (1 - fpr)[mask].max() if mask.any() else 0.0
+        spec, t = binary_specificity_at_sensitivity(jnp.asarray(p), jnp.asarray(y), min_sens)
+        np.testing.assert_allclose(float(spec), ref, atol=1e-6)
+
+    def test_class_stateful(self):
+        rng = seed_all()
+        m = BinarySensitivityAtSpecificity(min_specificity=0.5)
+        p = rng.random(128).astype(np.float32)
+        y = rng.integers(0, 2, 128)
+        m.update(jnp.asarray(p), jnp.asarray(y))
+        v1, t1 = m.compute()
+        v2, t2 = binary_sensitivity_at_specificity(jnp.asarray(p), jnp.asarray(y), 0.5)
+        np.testing.assert_allclose(float(v1), float(v2), atol=1e-6)
+        m2 = BinarySpecificityAtSensitivity(min_sensitivity=0.5)
+        m2.update(jnp.asarray(p), jnp.asarray(y))
+        w1, _ = m2.compute()
+        w2, _ = binary_specificity_at_sensitivity(jnp.asarray(p), jnp.asarray(y), 0.5)
+        np.testing.assert_allclose(float(w1), float(w2), atol=1e-6)
+
+
+class TestEER:
+    def test_binary_vs_sklearn_roc(self):
+        rng = seed_all()
+        p = rng.random(300).astype(np.float32)
+        y = rng.integers(0, 2, 300)
+        np.testing.assert_allclose(float(binary_eer(jnp.asarray(p), jnp.asarray(y))), _sk_eer(y, p), atol=1e-6)
+
+    def test_multiclass(self):
+        rng = seed_all()
+        p = rng.random((200, NUM_CLASSES)).astype(np.float32)
+        p = p / p.sum(-1, keepdims=True)
+        y = rng.integers(0, NUM_CLASSES, 200)
+        out = multiclass_eer(jnp.asarray(p), jnp.asarray(y), NUM_CLASSES)
+        assert out.shape == (NUM_CLASSES,)
+        for c in range(NUM_CLASSES):
+            np.testing.assert_allclose(float(out[c]), _sk_eer((y == c).astype(int), p[:, c]), atol=1e-6)
+
+    def test_class_and_facade(self):
+        rng = seed_all()
+        m = EER(task="binary")
+        assert isinstance(m, BinaryEER)
+        p = rng.random(128).astype(np.float32)
+        y = rng.integers(0, 2, 128)
+        m.update(jnp.asarray(p), jnp.asarray(y))
+        np.testing.assert_allclose(float(m.compute()), _sk_eer(y, p), atol=1e-6)
+        assert isinstance(EER(task="multiclass", num_classes=3), MulticlassEER)
+
+    def test_binned_close_to_exact(self):
+        rng = seed_all()
+        p = rng.random(1000).astype(np.float32)
+        y = rng.integers(0, 2, 1000)
+        exact = float(binary_eer(jnp.asarray(p), jnp.asarray(y)))
+        binned = float(binary_eer(jnp.asarray(p), jnp.asarray(y), thresholds=200))
+        np.testing.assert_allclose(binned, exact, atol=0.02)
+
+
+class TestLogAUC:
+    def test_binary_range_properties(self):
+        rng = seed_all()
+        # strong classifier: logauc should be high; random: lower
+        y = rng.integers(0, 2, 2000)
+        strong = np.clip(y + rng.normal(0, 0.2, 2000), 0, 1).astype(np.float32)
+        v_strong = float(binary_logauc(jnp.asarray(strong), jnp.asarray(y), fpr_range=(0.01, 1.0)))
+        v_rand = float(binary_logauc(jnp.asarray(rng.random(2000).astype(np.float32)), jnp.asarray(y), fpr_range=(0.01, 1.0)))
+        assert 0.0 <= v_rand <= 1.0
+        assert v_strong > v_rand
+
+    def test_perfect_classifier_is_one(self):
+        y = np.concatenate([np.zeros(500, int), np.ones(500, int)])
+        p = np.concatenate([np.linspace(0, 0.4, 500), np.linspace(0.6, 1.0, 500)]).astype(np.float32)
+        v = float(binary_logauc(jnp.asarray(p), jnp.asarray(y), fpr_range=(0.001, 0.1)))
+        np.testing.assert_allclose(v, 1.0, atol=1e-5)
+
+    def test_class_and_facade(self):
+        rng = seed_all()
+        m = LogAUC(task="binary")
+        assert isinstance(m, BinaryLogAUC)
+        p = rng.random(256).astype(np.float32)
+        y = rng.integers(0, 2, 256)
+        m.update(jnp.asarray(p), jnp.asarray(y))
+        np.testing.assert_allclose(
+            float(m.compute()), float(binary_logauc(jnp.asarray(p), jnp.asarray(y))), atol=1e-6
+        )
+
+    def test_bad_range_raises(self):
+        with pytest.raises(ValueError):
+            binary_logauc(jnp.asarray([0.5]), jnp.asarray([1]), fpr_range=(0.5, 0.1))
+
+
+class TestGroupFairness:
+    def test_stat_rates(self):
+        preds = jnp.asarray([1, 0, 1, 1, 0, 1], dtype=jnp.int32)
+        target = jnp.asarray([1, 0, 0, 1, 1, 1])
+        groups = jnp.asarray([0, 0, 0, 1, 1, 1])
+        out = binary_groups_stat_rates(preds, target, groups, num_groups=2)
+        # group 0: tp=1 fp=1 tn=1 fn=0 → /3
+        np.testing.assert_allclose(np.asarray(out["group_0"]), [1 / 3, 1 / 3, 1 / 3, 0.0], atol=1e-6)
+        # group 1: tp=2 fp=0 tn=0 fn=1 → /3
+        np.testing.assert_allclose(np.asarray(out["group_1"]), [2 / 3, 0.0, 0.0, 1 / 3], atol=1e-6)
+
+    def test_demographic_parity(self):
+        rng = seed_all()
+        preds = jnp.asarray(rng.random(400).astype(np.float32))
+        groups = jnp.asarray(rng.integers(0, 2, 400))
+        out = demographic_parity(preds, groups)
+        key = next(iter(out))
+        assert key.startswith("DP_")
+        p, g = np.asarray(preds) > 0.5, np.asarray(groups)
+        rates = np.asarray([p[g == i].mean() for i in range(2)])
+        np.testing.assert_allclose(float(out[key]), rates.min() / rates.max(), atol=1e-6)
+
+    def test_equal_opportunity(self):
+        rng = seed_all()
+        preds = jnp.asarray(rng.random(400).astype(np.float32))
+        target = jnp.asarray(rng.integers(0, 2, 400))
+        groups = jnp.asarray(rng.integers(0, 2, 400))
+        out = equal_opportunity(preds, target, groups)
+        key = next(iter(out))
+        assert key.startswith("EO_")
+        p, t, g = np.asarray(preds) > 0.5, np.asarray(target), np.asarray(groups)
+        tprs = np.asarray([(p & (t == 1) & (g == i)).sum() / ((t == 1) & (g == i)).sum() for i in range(2)])
+        np.testing.assert_allclose(float(out[key]), tprs.min() / tprs.max(), atol=1e-6)
+
+    def test_binary_fairness_all_and_class(self):
+        rng = seed_all()
+        preds = jnp.asarray(rng.random(256).astype(np.float32))
+        target = jnp.asarray(rng.integers(0, 2, 256))
+        groups = jnp.asarray(rng.integers(0, 2, 256))
+        fn_out = binary_fairness(preds, target, groups, task="all")
+        assert len(fn_out) == 2
+        m = BinaryFairness(num_groups=2, task="all")
+        m.update(preds, target, groups)
+        cls_out = m.compute()
+        for k in fn_out:
+            np.testing.assert_allclose(float(cls_out[k]), float(fn_out[k]), atol=1e-6)
+
+    def test_group_stat_rates_class_accumulates(self):
+        rng = seed_all()
+        m = BinaryGroupStatRates(num_groups=3)
+        all_p, all_t, all_g = [], [], []
+        for _ in range(3):
+            p = rng.random(64).astype(np.float32)
+            t = rng.integers(0, 2, 64)
+            g = rng.integers(0, 3, 64)
+            m.update(jnp.asarray(p), jnp.asarray(t), jnp.asarray(g))
+            all_p.append(p), all_t.append(t), all_g.append(g)
+        out = m.compute()
+        ref = binary_groups_stat_rates(
+            jnp.asarray(np.concatenate(all_p)), jnp.asarray(np.concatenate(all_t)),
+            jnp.asarray(np.concatenate(all_g)), num_groups=3,
+        )
+        for k in ref:
+            np.testing.assert_allclose(np.asarray(out[k]), np.asarray(ref[k]), atol=1e-6)

@@ -14,7 +14,10 @@ from .confusion_matrix import (
     MulticlassConfusionMatrix,
     MultilabelConfusionMatrix,
 )
+from .eer import EER, BinaryEER, MulticlassEER, MultilabelEER
 from .exact_match import ExactMatch, MulticlassExactMatch, MultilabelExactMatch
+from .group_fairness import BinaryFairness, BinaryGroupStatRates
+from .logauc import BinaryLogAUC, LogAUC, MulticlassLogAUC, MultilabelLogAUC
 from .f_beta import (
     BinaryF1Score,
     BinaryFBetaScore,
@@ -61,11 +64,35 @@ from .specificity import (
     MultilabelSpecificity,
     Specificity,
 )
+from .precision_fixed_recall import (
+    BinaryPrecisionAtFixedRecall,
+    MulticlassPrecisionAtFixedRecall,
+    MultilabelPrecisionAtFixedRecall,
+    PrecisionAtFixedRecall,
+)
 from .precision_recall_curve import (
     BinaryPrecisionRecallCurve,
     MulticlassPrecisionRecallCurve,
     MultilabelPrecisionRecallCurve,
     PrecisionRecallCurve,
+)
+from .recall_fixed_precision import (
+    BinaryRecallAtFixedPrecision,
+    MulticlassRecallAtFixedPrecision,
+    MultilabelRecallAtFixedPrecision,
+    RecallAtFixedPrecision,
+)
+from .sensitivity_specificity import (
+    BinarySensitivityAtSpecificity,
+    MulticlassSensitivityAtSpecificity,
+    MultilabelSensitivityAtSpecificity,
+    SensitivityAtSpecificity,
+)
+from .specificity_sensitivity import (
+    BinarySpecificityAtSensitivity,
+    MulticlassSpecificityAtSensitivity,
+    MultilabelSpecificityAtSensitivity,
+    SpecificityAtSensitivity,
 )
 from .ranking import (
     MultilabelCoverageError,
@@ -103,4 +130,15 @@ __all__ = [
     "MultilabelPrecision", "MultilabelRecall", "Precision", "Recall",
     "BinarySpecificity", "MulticlassSpecificity", "MultilabelSpecificity", "Specificity",
     "BinaryStatScores", "MulticlassStatScores", "MultilabelStatScores", "StatScores",
+    "EER", "BinaryEER", "MulticlassEER", "MultilabelEER",
+    "BinaryFairness", "BinaryGroupStatRates",
+    "BinaryLogAUC", "LogAUC", "MulticlassLogAUC", "MultilabelLogAUC",
+    "BinaryPrecisionAtFixedRecall", "MulticlassPrecisionAtFixedRecall",
+    "MultilabelPrecisionAtFixedRecall", "PrecisionAtFixedRecall",
+    "BinaryRecallAtFixedPrecision", "MulticlassRecallAtFixedPrecision",
+    "MultilabelRecallAtFixedPrecision", "RecallAtFixedPrecision",
+    "BinarySensitivityAtSpecificity", "MulticlassSensitivityAtSpecificity",
+    "MultilabelSensitivityAtSpecificity", "SensitivityAtSpecificity",
+    "BinarySpecificityAtSensitivity", "MulticlassSpecificityAtSensitivity",
+    "MultilabelSpecificityAtSensitivity", "SpecificityAtSensitivity",
 ]

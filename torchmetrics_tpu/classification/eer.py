@@ -1,0 +1,115 @@
+"""EER metric classes (reference ``classification/eer.py:36``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from ..functional.classification.eer import _eer_compute
+from ..functional.classification.roc import _binary_roc_compute, _multiclass_roc_compute, _multilabel_roc_compute
+from ..metric import Metric
+from ..utilities.enums import ClassificationTask
+from .base import _ClassificationTaskWrapper
+from .precision_recall_curve import (
+    BinaryPrecisionRecallCurve,
+    MulticlassPrecisionRecallCurve,
+    MultilabelPrecisionRecallCurve,
+)
+
+
+class BinaryEER(BinaryPrecisionRecallCurve):
+    is_differentiable = False
+    higher_is_better = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, thresholds=None, ignore_index=None, validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(thresholds=thresholds, ignore_index=ignore_index, validate_args=validate_args, **kwargs)
+        self._jittable_compute = False
+
+    def _compute(self, state):
+        fpr, tpr, _ = _binary_roc_compute(self._curve_state(state), self.thresholds)
+        return _eer_compute(fpr, tpr)
+
+    def plot(self, val=None, ax=None):
+        return Metric.plot(self, *([val] if val is not None else []), ax=ax)
+
+
+class MulticlassEER(MulticlassPrecisionRecallCurve):
+    is_differentiable = False
+    higher_is_better = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+    plot_legend_name = "Class"
+
+    def __init__(
+        self, num_classes: int, average: Optional[str] = None, thresholds=None, ignore_index=None,
+        validate_args: bool = True, **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            num_classes=num_classes, thresholds=thresholds, average=None, ignore_index=ignore_index,
+            validate_args=validate_args, **kwargs,
+        )
+        self.average = average
+        self._jittable_compute = False
+
+    def _compute(self, state):
+        fpr, tpr, _ = _multiclass_roc_compute(self._curve_state(state), self.num_classes, self.thresholds)
+        out = _eer_compute(fpr, tpr)
+        return out.mean() if self.average == "macro" else out
+
+    def plot(self, val=None, ax=None):
+        return Metric.plot(self, *([val] if val is not None else []), ax=ax)
+
+
+class MultilabelEER(MultilabelPrecisionRecallCurve):
+    is_differentiable = False
+    higher_is_better = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+    plot_legend_name = "Label"
+
+    def __init__(
+        self, num_labels: int, thresholds=None, ignore_index=None, validate_args: bool = True, **kwargs: Any
+    ) -> None:
+        super().__init__(
+            num_labels=num_labels, thresholds=thresholds, ignore_index=ignore_index,
+            validate_args=validate_args, **kwargs,
+        )
+        self._jittable_compute = False
+
+    def _compute(self, state):
+        fpr, tpr, _ = _multilabel_roc_compute(
+            self._curve_state(state), self.num_labels, self.thresholds, self.ignore_index
+        )
+        return _eer_compute(fpr, tpr)
+
+    def plot(self, val=None, ax=None):
+        return Metric.plot(self, *([val] if val is not None else []), ax=ax)
+
+
+class EER(_ClassificationTaskWrapper):
+    """Task facade (reference classification/eer.py)."""
+
+    def __new__(
+        cls,
+        task: str,
+        thresholds=None,
+        num_classes: Optional[int] = None,
+        num_labels: Optional[int] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> Metric:
+        task = ClassificationTask.from_str(task)
+        kwargs.update({"thresholds": thresholds, "ignore_index": ignore_index, "validate_args": validate_args})
+        if task == ClassificationTask.BINARY:
+            return BinaryEER(**kwargs)
+        if task == ClassificationTask.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            return MulticlassEER(num_classes, **kwargs)
+        if task == ClassificationTask.MULTILABEL:
+            if not isinstance(num_labels, int):
+                raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+            return MultilabelEER(num_labels, **kwargs)
+        raise ValueError(f"Not handled value: {task}")

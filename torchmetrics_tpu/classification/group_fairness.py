@@ -1,0 +1,123 @@
+"""Group fairness metric classes (reference ``classification/group_fairness.py:60,158``).
+
+State is the per-group stat-score matrix — four ``(num_groups,)`` sum-reduced vectors
+filled by a single segment-sum pass (static shapes, jittable update).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..functional.classification.group_fairness import (
+    _binary_groups_stat_scores,
+    _compute_binary_demographic_parity,
+    _compute_binary_equal_opportunity,
+)
+from ..metric import Metric
+from ..utilities.compute import _safe_divide
+
+Array = jax.Array
+
+
+class _AbstractGroupStatScores(Metric):
+    """Holds per-group tp/fp/tn/fn states."""
+
+    def __init__(
+        self,
+        num_groups: int,
+        threshold: float = 0.5,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(num_groups, int) or num_groups < 2:
+            raise ValueError(f"Expected argument `num_groups` to be an int larger than 1, but got {num_groups}")
+        self.num_groups = num_groups
+        self.threshold = threshold
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        for s in ("tp", "fp", "tn", "fn"):
+            self.add_state(s, default=jnp.zeros(num_groups, jnp.int32), dist_reduce_fx="sum")
+
+    def _batch_state(self, preds, target, groups):
+        tp, fp, tn, fn = _binary_groups_stat_scores(
+            preds, target, groups, self.num_groups, self.threshold, self.ignore_index, validate_args=False
+        )
+        return {"tp": tp, "fp": fp, "tn": tn, "fn": fn}
+
+    def _prepare_inputs(self, preds, target, groups):
+        if self.validate_args:
+            from ..functional.classification.group_fairness import _groups_validation
+            from ..functional.classification.stat_scores import (
+                _binary_stat_scores_arg_validation,
+                _binary_stat_scores_tensor_validation,
+            )
+
+            _binary_stat_scores_arg_validation(self.threshold, "global", self.ignore_index)
+            _binary_stat_scores_tensor_validation(preds, target, "global", self.ignore_index)
+            _groups_validation(jnp.asarray(groups), self.num_groups)
+        return (preds, target, groups), {}
+
+
+class BinaryGroupStatRates(_AbstractGroupStatScores):
+    """Per-group tp/fp/tn/fn rates (reference group_fairness.py:60)."""
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+    _jittable_compute = False
+
+    def _compute(self, state) -> Dict[str, Array]:
+        stats = jnp.stack([state["tp"], state["fp"], state["tn"], state["fn"]], axis=-1)
+        rates = _safe_divide(stats, stats.sum(axis=-1, keepdims=True))
+        return {f"group_{g}": rates[g] for g in range(self.num_groups)}
+
+
+class BinaryFairness(_AbstractGroupStatScores):
+    """Demographic parity / equal opportunity ratios (reference group_fairness.py:158)."""
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+    _jittable_compute = False
+
+    def __init__(
+        self,
+        num_groups: int,
+        task: str = "all",
+        threshold: float = 0.5,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        if task not in ["demographic_parity", "equal_opportunity", "all"]:
+            raise ValueError(
+                f"Expected argument `task` to either be ``demographic_parity``,"
+                f"``equal_opportunity`` or ``all`` but got {task}."
+            )
+        super().__init__(num_groups, threshold, ignore_index, validate_args, **kwargs)
+        self.task = task
+
+    def _prepare_inputs(self, preds, target=None, groups=None):
+        if self.task == "demographic_parity":
+            if target is not None:
+                from ..utilities.prints import rank_zero_warn
+
+                rank_zero_warn("The task demographic_parity does not require a target.", UserWarning)
+            target = jnp.zeros(jnp.asarray(preds).shape, jnp.int32)
+        return super()._prepare_inputs(preds, target, groups)
+
+    def _compute(self, state) -> Dict[str, Array]:
+        tp, fp, tn, fn = state["tp"], state["fp"], state["tn"], state["fn"]
+        if self.task == "demographic_parity":
+            return _compute_binary_demographic_parity(tp, fp, tn, fn)
+        if self.task == "equal_opportunity":
+            return _compute_binary_equal_opportunity(tp, fp, tn, fn)
+        return {
+            **_compute_binary_demographic_parity(tp, fp, tn, fn),
+            **_compute_binary_equal_opportunity(tp, fp, tn, fn),
+        }

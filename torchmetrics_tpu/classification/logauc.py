@@ -1,0 +1,133 @@
+"""LogAUC metric classes (reference ``classification/logauc.py:35``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+from ..functional.classification.logauc import _binary_logauc_compute, _reduce_logauc, _validate_fpr_range
+from ..functional.classification.roc import _binary_roc_compute, _multiclass_roc_compute, _multilabel_roc_compute
+from ..metric import Metric
+from ..utilities.enums import ClassificationTask
+from .base import _ClassificationTaskWrapper
+from .precision_recall_curve import (
+    BinaryPrecisionRecallCurve,
+    MulticlassPrecisionRecallCurve,
+    MultilabelPrecisionRecallCurve,
+)
+
+
+class BinaryLogAUC(BinaryPrecisionRecallCurve):
+    is_differentiable = False
+    higher_is_better = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self, fpr_range: Tuple[float, float] = (0.001, 0.1), thresholds=None, ignore_index=None,
+        validate_args: bool = True, **kwargs: Any,
+    ) -> None:
+        super().__init__(thresholds=thresholds, ignore_index=ignore_index, validate_args=validate_args, **kwargs)
+        if validate_args:
+            _validate_fpr_range(fpr_range)
+        self.fpr_range = fpr_range
+        self._jittable_compute = False
+
+    def _compute(self, state):
+        fpr, tpr, _ = _binary_roc_compute(self._curve_state(state), self.thresholds)
+        return _binary_logauc_compute(fpr, tpr, self.fpr_range)
+
+    def plot(self, val=None, ax=None):
+        return Metric.plot(self, *([val] if val is not None else []), ax=ax)
+
+
+class MulticlassLogAUC(MulticlassPrecisionRecallCurve):
+    is_differentiable = False
+    higher_is_better = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+    plot_legend_name = "Class"
+
+    def __init__(
+        self, num_classes: int, fpr_range: Tuple[float, float] = (0.001, 0.1), average: Optional[str] = "macro",
+        thresholds=None, ignore_index=None, validate_args: bool = True, **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            num_classes=num_classes, thresholds=thresholds, average=None, ignore_index=ignore_index,
+            validate_args=validate_args, **kwargs,
+        )
+        if validate_args:
+            _validate_fpr_range(fpr_range)
+        self.fpr_range = fpr_range
+        self.average = average
+        self._jittable_compute = False
+
+    def _compute(self, state):
+        fpr, tpr, _ = _multiclass_roc_compute(self._curve_state(state), self.num_classes, self.thresholds)
+        return _reduce_logauc(fpr, tpr, self.fpr_range, self.average)
+
+    def plot(self, val=None, ax=None):
+        return Metric.plot(self, *([val] if val is not None else []), ax=ax)
+
+
+class MultilabelLogAUC(MultilabelPrecisionRecallCurve):
+    is_differentiable = False
+    higher_is_better = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+    plot_legend_name = "Label"
+
+    def __init__(
+        self, num_labels: int, fpr_range: Tuple[float, float] = (0.001, 0.1), average: Optional[str] = "macro",
+        thresholds=None, ignore_index=None, validate_args: bool = True, **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            num_labels=num_labels, thresholds=thresholds, ignore_index=ignore_index,
+            validate_args=validate_args, **kwargs,
+        )
+        if validate_args:
+            _validate_fpr_range(fpr_range)
+        self.fpr_range = fpr_range
+        self.average = average
+        self._jittable_compute = False
+
+    def _compute(self, state):
+        fpr, tpr, _ = _multilabel_roc_compute(
+            self._curve_state(state), self.num_labels, self.thresholds, self.ignore_index
+        )
+        return _reduce_logauc(fpr, tpr, self.fpr_range, self.average)
+
+    def plot(self, val=None, ax=None):
+        return Metric.plot(self, *([val] if val is not None else []), ax=ax)
+
+
+class LogAUC(_ClassificationTaskWrapper):
+    """Task facade (reference classification/logauc.py)."""
+
+    def __new__(
+        cls,
+        task: str,
+        thresholds=None,
+        fpr_range: Tuple[float, float] = (0.001, 0.1),
+        num_classes: Optional[int] = None,
+        num_labels: Optional[int] = None,
+        average: Optional[str] = "macro",
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> Metric:
+        task = ClassificationTask.from_str(task)
+        kwargs.update(
+            {"thresholds": thresholds, "fpr_range": fpr_range, "ignore_index": ignore_index,
+             "validate_args": validate_args}
+        )
+        if task == ClassificationTask.BINARY:
+            return BinaryLogAUC(**kwargs)
+        if task == ClassificationTask.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            return MulticlassLogAUC(num_classes, average=average, **kwargs)
+        if task == ClassificationTask.MULTILABEL:
+            if not isinstance(num_labels, int):
+                raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+            return MultilabelLogAUC(num_labels, average=average, **kwargs)
+        raise ValueError(f"Not handled value: {task}")

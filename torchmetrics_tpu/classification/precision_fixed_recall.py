@@ -1,0 +1,137 @@
+"""PrecisionAtFixedRecall classes (reference ``classification/precision_fixed_recall.py:49``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from ..functional.classification.precision_fixed_recall import (
+    _binary_precision_at_fixed_recall_compute,
+    _multiclass_precision_at_fixed_recall_compute,
+    _multilabel_precision_at_fixed_recall_compute,
+)
+from ..functional.classification.recall_fixed_precision import (
+    _binary_recall_at_fixed_precision_arg_validation,
+    _multiclass_recall_at_fixed_precision_arg_validation,
+    _multilabel_recall_at_fixed_precision_arg_validation,
+)
+from ..metric import Metric
+from ..utilities.enums import ClassificationTask
+from .base import _ClassificationTaskWrapper
+from .precision_recall_curve import (
+    BinaryPrecisionRecallCurve,
+    MulticlassPrecisionRecallCurve,
+    MultilabelPrecisionRecallCurve,
+)
+
+
+class BinaryPrecisionAtFixedRecall(BinaryPrecisionRecallCurve):
+    is_differentiable = False
+    higher_is_better = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self, min_recall: float, thresholds=None, ignore_index=None, validate_args: bool = True, **kwargs: Any
+    ) -> None:
+        super().__init__(thresholds=thresholds, ignore_index=ignore_index, validate_args=False, **kwargs)
+        if validate_args:
+            _binary_recall_at_fixed_precision_arg_validation(min_recall, thresholds, ignore_index)
+        self.validate_args = validate_args
+        self.min_recall = min_recall
+        self._jittable_compute = False
+
+    def _compute(self, state):
+        return _binary_precision_at_fixed_recall_compute(self._curve_state(state), self.thresholds, self.min_recall)
+
+    def plot(self, val=None, ax=None):
+        return Metric.plot(self, *([val] if val is not None else []), ax=ax)
+
+
+class MulticlassPrecisionAtFixedRecall(MulticlassPrecisionRecallCurve):
+    is_differentiable = False
+    higher_is_better = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+    plot_legend_name = "Class"
+
+    def __init__(
+        self, num_classes: int, min_recall: float, thresholds=None, ignore_index=None,
+        validate_args: bool = True, **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            num_classes=num_classes, thresholds=thresholds, ignore_index=ignore_index, validate_args=False, **kwargs
+        )
+        if validate_args:
+            _multiclass_recall_at_fixed_precision_arg_validation(num_classes, min_recall, thresholds, ignore_index)
+        self.validate_args = validate_args
+        self.min_recall = min_recall
+        self._jittable_compute = False
+
+    def _compute(self, state):
+        return _multiclass_precision_at_fixed_recall_compute(
+            self._curve_state(state), self.num_classes, self.thresholds, self.min_recall
+        )
+
+    def plot(self, val=None, ax=None):
+        return Metric.plot(self, *([val] if val is not None else []), ax=ax)
+
+
+class MultilabelPrecisionAtFixedRecall(MultilabelPrecisionRecallCurve):
+    is_differentiable = False
+    higher_is_better = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+    plot_legend_name = "Label"
+
+    def __init__(
+        self, num_labels: int, min_recall: float, thresholds=None, ignore_index=None,
+        validate_args: bool = True, **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            num_labels=num_labels, thresholds=thresholds, ignore_index=ignore_index, validate_args=False, **kwargs
+        )
+        if validate_args:
+            _multilabel_recall_at_fixed_precision_arg_validation(num_labels, min_recall, thresholds, ignore_index)
+        self.validate_args = validate_args
+        self.min_recall = min_recall
+        self._jittable_compute = False
+
+    def _compute(self, state):
+        return _multilabel_precision_at_fixed_recall_compute(
+            self._curve_state(state), self.num_labels, self.thresholds, self.ignore_index, self.min_recall
+        )
+
+    def plot(self, val=None, ax=None):
+        return Metric.plot(self, *([val] if val is not None else []), ax=ax)
+
+
+class PrecisionAtFixedRecall(_ClassificationTaskWrapper):
+    """Task facade."""
+
+    def __new__(
+        cls,
+        task: str,
+        min_recall: float,
+        thresholds=None,
+        num_classes: Optional[int] = None,
+        num_labels: Optional[int] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> Metric:
+        task = ClassificationTask.from_str(task)
+        if task == ClassificationTask.BINARY:
+            return BinaryPrecisionAtFixedRecall(min_recall, thresholds, ignore_index, validate_args, **kwargs)
+        if task == ClassificationTask.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            return MulticlassPrecisionAtFixedRecall(
+                num_classes, min_recall, thresholds, ignore_index, validate_args, **kwargs
+            )
+        if task == ClassificationTask.MULTILABEL:
+            if not isinstance(num_labels, int):
+                raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+            return MultilabelPrecisionAtFixedRecall(
+                num_labels, min_recall, thresholds, ignore_index, validate_args, **kwargs
+            )
+        raise ValueError(f"Not handled value: {task}")

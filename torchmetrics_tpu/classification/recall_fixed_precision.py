@@ -1,0 +1,135 @@
+"""RecallAtFixedPrecision classes (reference ``classification/recall_fixed_precision.py:48``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from ..functional.classification.recall_fixed_precision import (
+    _binary_recall_at_fixed_precision_arg_validation,
+    _binary_recall_at_fixed_precision_compute,
+    _multiclass_recall_at_fixed_precision_arg_validation,
+    _multiclass_recall_at_fixed_precision_compute,
+    _multilabel_recall_at_fixed_precision_arg_validation,
+    _multilabel_recall_at_fixed_precision_compute,
+)
+from ..metric import Metric
+from ..utilities.enums import ClassificationTask
+from .base import _ClassificationTaskWrapper
+from .precision_recall_curve import (
+    BinaryPrecisionRecallCurve,
+    MulticlassPrecisionRecallCurve,
+    MultilabelPrecisionRecallCurve,
+)
+
+
+class BinaryRecallAtFixedPrecision(BinaryPrecisionRecallCurve):
+    is_differentiable = False
+    higher_is_better = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self, min_precision: float, thresholds=None, ignore_index=None, validate_args: bool = True, **kwargs: Any
+    ) -> None:
+        super().__init__(thresholds=thresholds, ignore_index=ignore_index, validate_args=False, **kwargs)
+        if validate_args:
+            _binary_recall_at_fixed_precision_arg_validation(min_precision, thresholds, ignore_index)
+        self.validate_args = validate_args
+        self.min_precision = min_precision
+        self._jittable_compute = False
+
+    def _compute(self, state):
+        return _binary_recall_at_fixed_precision_compute(self._curve_state(state), self.thresholds, self.min_precision)
+
+    def plot(self, val=None, ax=None):
+        return Metric.plot(self, *([val] if val is not None else []), ax=ax)
+
+
+class MulticlassRecallAtFixedPrecision(MulticlassPrecisionRecallCurve):
+    is_differentiable = False
+    higher_is_better = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+    plot_legend_name = "Class"
+
+    def __init__(
+        self, num_classes: int, min_precision: float, thresholds=None, ignore_index=None,
+        validate_args: bool = True, **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            num_classes=num_classes, thresholds=thresholds, ignore_index=ignore_index, validate_args=False, **kwargs
+        )
+        if validate_args:
+            _multiclass_recall_at_fixed_precision_arg_validation(num_classes, min_precision, thresholds, ignore_index)
+        self.validate_args = validate_args
+        self.min_precision = min_precision
+        self._jittable_compute = False
+
+    def _compute(self, state):
+        return _multiclass_recall_at_fixed_precision_compute(
+            self._curve_state(state), self.num_classes, self.thresholds, self.min_precision
+        )
+
+    def plot(self, val=None, ax=None):
+        return Metric.plot(self, *([val] if val is not None else []), ax=ax)
+
+
+class MultilabelRecallAtFixedPrecision(MultilabelPrecisionRecallCurve):
+    is_differentiable = False
+    higher_is_better = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+    plot_legend_name = "Label"
+
+    def __init__(
+        self, num_labels: int, min_precision: float, thresholds=None, ignore_index=None,
+        validate_args: bool = True, **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            num_labels=num_labels, thresholds=thresholds, ignore_index=ignore_index, validate_args=False, **kwargs
+        )
+        if validate_args:
+            _multilabel_recall_at_fixed_precision_arg_validation(num_labels, min_precision, thresholds, ignore_index)
+        self.validate_args = validate_args
+        self.min_precision = min_precision
+        self._jittable_compute = False
+
+    def _compute(self, state):
+        return _multilabel_recall_at_fixed_precision_compute(
+            self._curve_state(state), self.num_labels, self.thresholds, self.ignore_index, self.min_precision
+        )
+
+    def plot(self, val=None, ax=None):
+        return Metric.plot(self, *([val] if val is not None else []), ax=ax)
+
+
+class RecallAtFixedPrecision(_ClassificationTaskWrapper):
+    """Task facade."""
+
+    def __new__(
+        cls,
+        task: str,
+        min_precision: float,
+        thresholds=None,
+        num_classes: Optional[int] = None,
+        num_labels: Optional[int] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> Metric:
+        task = ClassificationTask.from_str(task)
+        if task == ClassificationTask.BINARY:
+            return BinaryRecallAtFixedPrecision(min_precision, thresholds, ignore_index, validate_args, **kwargs)
+        if task == ClassificationTask.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            return MulticlassRecallAtFixedPrecision(
+                num_classes, min_precision, thresholds, ignore_index, validate_args, **kwargs
+            )
+        if task == ClassificationTask.MULTILABEL:
+            if not isinstance(num_labels, int):
+                raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+            return MultilabelRecallAtFixedPrecision(
+                num_labels, min_precision, thresholds, ignore_index, validate_args, **kwargs
+            )
+        raise ValueError(f"Not handled value: {task}")

@@ -1,0 +1,135 @@
+"""SpecificityAtSensitivity classes (reference ``classification/specificity_sensitivity.py:46``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from ..functional.classification.recall_fixed_precision import _validate_min
+from ..functional.classification.specificity_sensitivity import (
+    _binary_specificity_at_sensitivity_compute,
+    _multiclass_specificity_at_sensitivity_compute,
+    _multilabel_specificity_at_sensitivity_compute,
+)
+from ..metric import Metric
+from ..utilities.enums import ClassificationTask
+from .base import _ClassificationTaskWrapper
+from .precision_recall_curve import (
+    BinaryPrecisionRecallCurve,
+    MulticlassPrecisionRecallCurve,
+    MultilabelPrecisionRecallCurve,
+)
+
+
+class BinarySpecificityAtSensitivity(BinaryPrecisionRecallCurve):
+    is_differentiable = False
+    higher_is_better = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self, min_sensitivity: float, thresholds=None, ignore_index=None, validate_args: bool = True, **kwargs: Any
+    ) -> None:
+        super().__init__(thresholds=thresholds, ignore_index=ignore_index, validate_args=False, **kwargs)
+        if validate_args:
+            _validate_min("min_sensitivity", min_sensitivity)
+        self.validate_args = validate_args
+        self.min_sensitivity = min_sensitivity
+        self._jittable_compute = False
+
+    def _compute(self, state):
+        return _binary_specificity_at_sensitivity_compute(
+            self._curve_state(state), self.thresholds, self.min_sensitivity
+        )
+
+    def plot(self, val=None, ax=None):
+        return Metric.plot(self, *([val] if val is not None else []), ax=ax)
+
+
+class MulticlassSpecificityAtSensitivity(MulticlassPrecisionRecallCurve):
+    is_differentiable = False
+    higher_is_better = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+    plot_legend_name = "Class"
+
+    def __init__(
+        self, num_classes: int, min_sensitivity: float, thresholds=None, ignore_index=None,
+        validate_args: bool = True, **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            num_classes=num_classes, thresholds=thresholds, ignore_index=ignore_index, validate_args=False, **kwargs
+        )
+        if validate_args:
+            _validate_min("min_sensitivity", min_sensitivity)
+        self.validate_args = validate_args
+        self.min_sensitivity = min_sensitivity
+        self._jittable_compute = False
+
+    def _compute(self, state):
+        return _multiclass_specificity_at_sensitivity_compute(
+            self._curve_state(state), self.num_classes, self.thresholds, self.min_sensitivity
+        )
+
+    def plot(self, val=None, ax=None):
+        return Metric.plot(self, *([val] if val is not None else []), ax=ax)
+
+
+class MultilabelSpecificityAtSensitivity(MultilabelPrecisionRecallCurve):
+    is_differentiable = False
+    higher_is_better = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+    plot_legend_name = "Label"
+
+    def __init__(
+        self, num_labels: int, min_sensitivity: float, thresholds=None, ignore_index=None,
+        validate_args: bool = True, **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            num_labels=num_labels, thresholds=thresholds, ignore_index=ignore_index, validate_args=False, **kwargs
+        )
+        if validate_args:
+            _validate_min("min_sensitivity", min_sensitivity)
+        self.validate_args = validate_args
+        self.min_sensitivity = min_sensitivity
+        self._jittable_compute = False
+
+    def _compute(self, state):
+        return _multilabel_specificity_at_sensitivity_compute(
+            self._curve_state(state), self.num_labels, self.thresholds, self.ignore_index, self.min_sensitivity
+        )
+
+    def plot(self, val=None, ax=None):
+        return Metric.plot(self, *([val] if val is not None else []), ax=ax)
+
+
+class SpecificityAtSensitivity(_ClassificationTaskWrapper):
+    """Task facade."""
+
+    def __new__(
+        cls,
+        task: str,
+        min_sensitivity: float,
+        thresholds=None,
+        num_classes: Optional[int] = None,
+        num_labels: Optional[int] = None,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> Metric:
+        task = ClassificationTask.from_str(task)
+        if task == ClassificationTask.BINARY:
+            return BinarySpecificityAtSensitivity(min_sensitivity, thresholds, ignore_index, validate_args, **kwargs)
+        if task == ClassificationTask.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            return MulticlassSpecificityAtSensitivity(
+                num_classes, min_sensitivity, thresholds, ignore_index, validate_args, **kwargs
+            )
+        if task == ClassificationTask.MULTILABEL:
+            if not isinstance(num_labels, int):
+                raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+            return MultilabelSpecificityAtSensitivity(
+                num_labels, min_sensitivity, thresholds, ignore_index, validate_args, **kwargs
+            )
+        raise ValueError(f"Not handled value: {task}")

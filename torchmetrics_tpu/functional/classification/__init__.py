@@ -72,7 +72,35 @@ from .ranking import (
     multilabel_ranking_average_precision,
     multilabel_ranking_loss,
 )
+from .eer import binary_eer, eer, multiclass_eer, multilabel_eer
+from .group_fairness import (
+    binary_fairness,
+    binary_groups_stat_rates,
+    demographic_parity,
+    equal_opportunity,
+)
+from .logauc import binary_logauc, logauc, multiclass_logauc, multilabel_logauc
+from .precision_fixed_recall import (
+    binary_precision_at_fixed_recall,
+    multiclass_precision_at_fixed_recall,
+    multilabel_precision_at_fixed_recall,
+)
+from .recall_fixed_precision import (
+    binary_recall_at_fixed_precision,
+    multiclass_recall_at_fixed_precision,
+    multilabel_recall_at_fixed_precision,
+)
 from .roc import binary_roc, multiclass_roc, multilabel_roc, roc
+from .sensitivity_specificity import (
+    binary_sensitivity_at_specificity,
+    multiclass_sensitivity_at_specificity,
+    multilabel_sensitivity_at_specificity,
+)
+from .specificity_sensitivity import (
+    binary_specificity_at_sensitivity,
+    multiclass_specificity_at_sensitivity,
+    multilabel_specificity_at_sensitivity,
+)
 from .stat_scores import (
     binary_stat_scores,
     multiclass_stat_scores,

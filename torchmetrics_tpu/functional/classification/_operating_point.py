@@ -1,0 +1,73 @@
+"""Shared machinery for curve operating-point metrics (reference
+``functional/classification/{recall_fixed_precision,precision_fixed_recall,
+sensitivity_specificity,specificity_sensitivity}.py``).
+
+All four metrics share one shape: compute a (PR or ROC) curve, mask points violating a
+floor constraint on one coordinate, and pick the best remaining point on the other.
+The reference does this with host-side Python ``max()`` over zipped tuples
+(recall_fixed_precision.py:58-77); here it is one vectorized masked lexicographic
+argmax over static-shape arrays (binned states keep everything jit-compatible).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _masked_lex_best(
+    objective: Array,
+    constraint: Array,
+    thresholds: Array,
+    min_constraint: float,
+    nan_threshold_when_zero: bool = True,
+    fallback_threshold: float = float("nan"),
+) -> Tuple[Array, Array]:
+    """Maximize ``objective`` subject to ``constraint >= min_constraint``.
+
+    Ties on the objective break first by higher constraint, then by higher threshold
+    (the reference's lexicographic ``max()`` over ``(obj, con, thr)`` tuples).
+    Returns ``(best_objective, best_threshold)``; no feasible point → ``(0, fallback)``.
+    """
+    n = min(objective.shape[0], constraint.shape[0], thresholds.shape[0])
+    obj, con, thr = objective[:n], constraint[:n], thresholds[:n]
+    valid = ~(jnp.isnan(obj) | jnp.isnan(con))
+    mask = (con >= min_constraint) & valid
+    neg = -jnp.inf
+    obj_m = jnp.where(mask, obj, neg)
+    best_obj = obj_m.max()
+    tie1 = mask & (obj_m == best_obj)
+    con_m = jnp.where(tie1, con, neg)
+    best_con = con_m.max()
+    tie2 = tie1 & (con_m == best_con)
+    thr_m = jnp.where(tie2, thr, neg)
+    best_thr = thr_m.max()
+    feasible = mask.any()
+    best_obj = jnp.where(feasible, best_obj, 0.0)
+    best_thr = jnp.where(feasible, best_thr, fallback_threshold)
+    if nan_threshold_when_zero:
+        best_thr = jnp.where(best_obj == 0.0, jnp.nan if jnp.isnan(fallback_threshold) else fallback_threshold, best_thr)
+    return best_obj, best_thr
+
+
+def _apply_over_classes(
+    reduce_fn: Callable,
+    a: Union[Array, List[Array]],
+    b: Union[Array, List[Array]],
+    thr: Union[Array, List[Array]],
+) -> Tuple[Array, Array]:
+    """Run a per-curve reduce over per-class curves (stacked 2-D arrays or lists)."""
+    if isinstance(a, list):
+        pairs = [reduce_fn(ai, bi, ti) for ai, bi, ti in zip(a, b, thr)]
+    else:
+        if a.ndim == 1:
+            return reduce_fn(a, b, thr)
+        # binned: a/b are (C, T); thresholds shared (T,)
+        pairs = [reduce_fn(a[i], b[i], thr) for i in range(a.shape[0])]
+    vals = jnp.stack([p[0] for p in pairs])
+    thrs = jnp.stack([jnp.asarray(p[1], jnp.float32) for p in pairs])
+    return vals, thrs

@@ -1,0 +1,105 @@
+"""Equal Error Rate (reference ``functional/classification/eer.py``).
+
+EER is the operating point where FPR equals FNR; computed as the midpoint
+``(FPR + FNR) / 2`` at the threshold minimizing ``|FPR - FNR|``.
+"""
+
+from __future__ import annotations
+
+from typing import List, Union
+
+import jax
+import jax.numpy as jnp
+
+from .precision_recall_curve import (
+    _binary_precision_recall_curve_format,
+    _binary_precision_recall_curve_tensor_validation,
+    _binary_precision_recall_curve_update,
+    _multiclass_precision_recall_curve_format,
+    _multiclass_precision_recall_curve_tensor_validation,
+    _multiclass_precision_recall_curve_update,
+    _multilabel_precision_recall_curve_format,
+    _multilabel_precision_recall_curve_tensor_validation,
+    _multilabel_precision_recall_curve_update,
+)
+from .roc import _binary_roc_compute, _multiclass_roc_compute, _multilabel_roc_compute
+
+Array = jax.Array
+
+
+def _binary_eer_compute(fpr: Array, tpr: Array) -> Array:
+    """Midpoint of FPR/FNR at the |FPR - FNR|-minimizing threshold (ref eer.py:28)."""
+    fnr = 1 - tpr
+    idx = jnp.argmin(jnp.abs(fpr - fnr))
+    return (fpr[idx] + fnr[idx]) / 2
+
+
+def _eer_compute(fpr: Union[Array, List[Array]], tpr: Union[Array, List[Array]]) -> Array:
+    if not isinstance(fpr, list) and fpr.ndim == 1:
+        return _binary_eer_compute(fpr, tpr)
+    return jnp.stack([_binary_eer_compute(f, t) for f, t in zip(fpr, tpr)])
+
+
+def binary_eer(preds, target, thresholds=None, ignore_index=None, validate_args: bool = True) -> Array:
+    if validate_args:
+        _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
+    preds, target, thresholds, w = _binary_precision_recall_curve_format(preds, target, thresholds, ignore_index)
+    if thresholds is None and ignore_index is not None:
+        import numpy as np
+
+        keep = np.asarray(w) == 1
+        preds, target = preds[keep], target[keep]
+    state = _binary_precision_recall_curve_update(preds, target, thresholds, w)
+    return _eer_compute(*_binary_roc_compute(state, thresholds)[:2])
+
+
+def multiclass_eer(
+    preds, target, num_classes: int, thresholds=None, average=None, ignore_index=None, validate_args: bool = True
+) -> Array:
+    if validate_args:
+        _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target, thresholds, w = _multiclass_precision_recall_curve_format(
+        preds, target, num_classes, thresholds, ignore_index, average
+    )
+    if thresholds is None and ignore_index is not None:
+        import numpy as np
+
+        keep = np.asarray(w) == 1
+        preds, target = preds[keep], target[keep]
+    state = _multiclass_precision_recall_curve_update(preds, target, num_classes, thresholds, w, average)
+    fpr, tpr, _ = _multiclass_roc_compute(state, num_classes, thresholds, average)
+    out = _eer_compute(fpr, tpr)
+    if average == "macro":
+        return out.mean()
+    return out
+
+
+def multilabel_eer(
+    preds, target, num_labels: int, thresholds=None, ignore_index=None, validate_args: bool = True
+) -> Array:
+    if validate_args:
+        _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target, thresholds, w = _multilabel_precision_recall_curve_format(
+        preds, target, num_labels, thresholds, ignore_index
+    )
+    state = _multilabel_precision_recall_curve_update(preds, target, num_labels, thresholds, w)
+    fpr, tpr, _ = _multilabel_roc_compute(state, num_labels, thresholds, ignore_index)
+    return _eer_compute(fpr, tpr)
+
+
+def eer(preds, target, task: str, thresholds=None, num_classes=None, num_labels=None, ignore_index=None, validate_args: bool = True):
+    """Task dispatch (reference eer.py facade)."""
+    from ...utilities.enums import ClassificationTask
+
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_eer(preds, target, thresholds, ignore_index, validate_args)
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_eer(preds, target, num_classes, thresholds, None, ignore_index, validate_args)
+    if task == ClassificationTask.MULTILABEL:
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+        return multilabel_eer(preds, target, num_labels, thresholds, ignore_index, validate_args)
+    raise ValueError(f"Not handled value: {task}")

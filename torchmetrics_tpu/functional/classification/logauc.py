@@ -1,0 +1,144 @@
+"""Log-scale partial AUROC (reference ``functional/classification/logauc.py``).
+
+Area under TPR vs log10(FPR) restricted to ``fpr_range``, normalized by the log-range
+width — emphasizes the low-FPR regime (virtual screening, anomaly detection).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from ...utilities.compute import _auc_compute, interp
+from ...utilities.prints import rank_zero_warn
+from .precision_recall_curve import (
+    _binary_precision_recall_curve_format,
+    _binary_precision_recall_curve_tensor_validation,
+    _binary_precision_recall_curve_update,
+    _multiclass_precision_recall_curve_format,
+    _multiclass_precision_recall_curve_tensor_validation,
+    _multiclass_precision_recall_curve_update,
+    _multilabel_precision_recall_curve_format,
+    _multilabel_precision_recall_curve_tensor_validation,
+    _multilabel_precision_recall_curve_update,
+)
+from .roc import _binary_roc_compute, _multiclass_roc_compute, _multilabel_roc_compute
+
+Array = jax.Array
+
+
+def _validate_fpr_range(fpr_range: Tuple[float, float]) -> None:
+    if not isinstance(fpr_range, tuple) or len(fpr_range) != 2:
+        raise ValueError(f"The `fpr_range` should be a tuple of two floats, but got {type(fpr_range)}.")
+    if not (0 <= fpr_range[0] < fpr_range[1] <= 1):
+        raise ValueError(f"The `fpr_range` should be a tuple of two floats in the range [0, 1], but got {fpr_range}.")
+
+
+def _binary_logauc_compute(fpr: Array, tpr: Array, fpr_range: Tuple[float, float] = (0.001, 0.1)) -> Array:
+    if fpr.size < 2 or tpr.size < 2:
+        rank_zero_warn(
+            "At least two values on for the fpr and tpr are required to compute the log AUC. Returns 0 score."
+        )
+        return jnp.zeros(())
+    bounds_lin = jnp.asarray(fpr_range, jnp.result_type(fpr.dtype, jnp.float32))
+    # anchor the curve exactly at the range bounds, then integrate on the log axis
+    tpr = jnp.sort(jnp.concatenate([tpr, interp(bounds_lin, fpr, tpr)]))
+    fpr = jnp.sort(jnp.concatenate([fpr, bounds_lin]))
+    keep = (fpr >= fpr_range[0]) & (fpr <= fpr_range[1])  # host-side: dynamic shape ok
+    x = jnp.log10(fpr[keep])
+    y = tpr[keep]
+    bounds = jnp.log10(bounds_lin)
+    return jnp.trapezoid(y, x) / (bounds[1] - bounds[0])
+
+
+def _reduce_logauc(
+    fpr: Union[Array, List[Array]],
+    tpr: Union[Array, List[Array]],
+    fpr_range: Tuple[float, float] = (0.001, 0.1),
+    average: Optional[str] = "macro",
+) -> Array:
+    if not isinstance(fpr, list) and fpr.ndim == 1:
+        return _binary_logauc_compute(fpr, tpr, fpr_range)
+    scores = jnp.stack([_binary_logauc_compute(f, t, fpr_range) for f, t in zip(fpr, tpr)])
+    if average == "macro":
+        return scores.mean()
+    if average in (None, "none"):
+        return scores
+    raise ValueError(f"Expected argument `average` to be one of ('macro', 'none', None) but got {average}")
+
+
+def binary_logauc(
+    preds, target, fpr_range: Tuple[float, float] = (0.001, 0.1), thresholds=None, ignore_index=None,
+    validate_args: bool = True,
+) -> Array:
+    if validate_args:
+        _validate_fpr_range(fpr_range)
+        _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
+    preds, target, thresholds, w = _binary_precision_recall_curve_format(preds, target, thresholds, ignore_index)
+    if thresholds is None and ignore_index is not None:
+        import numpy as np
+
+        keep = np.asarray(w) == 1
+        preds, target = preds[keep], target[keep]
+    state = _binary_precision_recall_curve_update(preds, target, thresholds, w)
+    fpr, tpr, _ = _binary_roc_compute(state, thresholds)
+    return _binary_logauc_compute(fpr, tpr, fpr_range)
+
+
+def multiclass_logauc(
+    preds, target, num_classes: int, fpr_range: Tuple[float, float] = (0.001, 0.1), average: Optional[str] = "macro",
+    thresholds=None, ignore_index=None, validate_args: bool = True,
+) -> Array:
+    if validate_args:
+        _validate_fpr_range(fpr_range)
+        _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target, thresholds, w = _multiclass_precision_recall_curve_format(
+        preds, target, num_classes, thresholds, ignore_index
+    )
+    if thresholds is None and ignore_index is not None:
+        import numpy as np
+
+        keep = np.asarray(w) == 1
+        preds, target = preds[keep], target[keep]
+    state = _multiclass_precision_recall_curve_update(preds, target, num_classes, thresholds, w)
+    fpr, tpr, _ = _multiclass_roc_compute(state, num_classes, thresholds)
+    return _reduce_logauc(fpr, tpr, fpr_range, average)
+
+
+def multilabel_logauc(
+    preds, target, num_labels: int, fpr_range: Tuple[float, float] = (0.001, 0.1), average: Optional[str] = "macro",
+    thresholds=None, ignore_index=None, validate_args: bool = True,
+) -> Array:
+    if validate_args:
+        _validate_fpr_range(fpr_range)
+        _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target, thresholds, w = _multilabel_precision_recall_curve_format(
+        preds, target, num_labels, thresholds, ignore_index
+    )
+    state = _multilabel_precision_recall_curve_update(preds, target, num_labels, thresholds, w)
+    fpr, tpr, _ = _multilabel_roc_compute(state, num_labels, thresholds, ignore_index)
+    return _reduce_logauc(fpr, tpr, fpr_range, average)
+
+
+def logauc(
+    preds, target, task: str, thresholds=None, num_classes=None, num_labels=None,
+    fpr_range: Tuple[float, float] = (0.001, 0.1), average: Optional[str] = "macro",
+    ignore_index=None, validate_args: bool = True,
+):
+    """Task dispatch (reference logauc.py facade)."""
+    from ...utilities.enums import ClassificationTask
+
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_logauc(preds, target, fpr_range, thresholds, ignore_index, validate_args)
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_logauc(preds, target, num_classes, fpr_range, average, thresholds, ignore_index, validate_args)
+    if task == ClassificationTask.MULTILABEL:
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+        return multilabel_logauc(preds, target, num_labels, fpr_range, average, thresholds, ignore_index, validate_args)
+    raise ValueError(f"Not handled value: {task}")

@@ -1,0 +1,103 @@
+"""Max precision at a recall floor (reference
+``functional/classification/precision_fixed_recall.py``)."""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+
+from ._operating_point import _apply_over_classes, _masked_lex_best
+from .precision_recall_curve import (
+    _binary_precision_recall_curve_compute,
+    _binary_precision_recall_curve_format,
+    _binary_precision_recall_curve_tensor_validation,
+    _binary_precision_recall_curve_update,
+    _multiclass_precision_recall_curve_compute,
+    _multiclass_precision_recall_curve_format,
+    _multiclass_precision_recall_curve_tensor_validation,
+    _multiclass_precision_recall_curve_update,
+    _multilabel_precision_recall_curve_compute,
+    _multilabel_precision_recall_curve_format,
+    _multilabel_precision_recall_curve_tensor_validation,
+    _multilabel_precision_recall_curve_update,
+)
+from .recall_fixed_precision import (
+    _binary_recall_at_fixed_precision_arg_validation as _bin_val,
+    _multiclass_recall_at_fixed_precision_arg_validation as _mc_val,
+    _multilabel_recall_at_fixed_precision_arg_validation as _ml_val,
+    _validate_min,
+)
+
+Array = jax.Array
+
+
+def _precision_at_recall(precision, recall, thresholds, min_recall: float):
+    """Best (precision, threshold) with recall >= floor (ref precision_fixed_recall.py:42)."""
+    return _masked_lex_best(precision, recall, thresholds, min_recall)
+
+
+def _binary_precision_at_fixed_recall_arg_validation(min_recall, thresholds=None, ignore_index=None) -> None:
+    _bin_val(min_recall, thresholds, ignore_index)
+
+
+def _binary_precision_at_fixed_recall_compute(state, thresholds, min_recall: float):
+    precision, recall, thres = _binary_precision_recall_curve_compute(state, thresholds)
+    return _precision_at_recall(precision, recall, thres, min_recall)
+
+
+def binary_precision_at_fixed_recall(
+    preds, target, min_recall: float, thresholds=None, ignore_index=None, validate_args: bool = True
+):
+    if validate_args:
+        _validate_min("min_recall", min_recall)
+        _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
+    preds, target, thresholds, w = _binary_precision_recall_curve_format(preds, target, thresholds, ignore_index)
+    if thresholds is None and ignore_index is not None:
+        import numpy as np
+
+        keep = np.asarray(w) == 1
+        preds, target = preds[keep], target[keep]
+    state = _binary_precision_recall_curve_update(preds, target, thresholds, w)
+    return _binary_precision_at_fixed_recall_compute(state, thresholds, min_recall)
+
+
+def _multiclass_precision_at_fixed_recall_compute(state, num_classes: int, thresholds, min_recall: float):
+    precision, recall, thres = _multiclass_precision_recall_curve_compute(state, num_classes, thresholds)
+    return _apply_over_classes(partial(_precision_at_recall, min_recall=min_recall), precision, recall, thres)
+
+
+def multiclass_precision_at_fixed_recall(
+    preds, target, num_classes: int, min_recall: float, thresholds=None, ignore_index=None, validate_args: bool = True
+):
+    if validate_args:
+        _mc_val(num_classes, min_recall, thresholds, ignore_index)
+        _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target, thresholds, w = _multiclass_precision_recall_curve_format(
+        preds, target, num_classes, thresholds, ignore_index
+    )
+    if thresholds is None and ignore_index is not None:
+        import numpy as np
+
+        keep = np.asarray(w) == 1
+        preds, target = preds[keep], target[keep]
+    state = _multiclass_precision_recall_curve_update(preds, target, num_classes, thresholds, w)
+    return _multiclass_precision_at_fixed_recall_compute(state, num_classes, thresholds, min_recall)
+
+
+def _multilabel_precision_at_fixed_recall_compute(state, num_labels: int, thresholds, ignore_index, min_recall: float):
+    precision, recall, thres = _multilabel_precision_recall_curve_compute(state, num_labels, thresholds, ignore_index)
+    return _apply_over_classes(partial(_precision_at_recall, min_recall=min_recall), precision, recall, thres)
+
+
+def multilabel_precision_at_fixed_recall(
+    preds, target, num_labels: int, min_recall: float, thresholds=None, ignore_index=None, validate_args: bool = True
+):
+    if validate_args:
+        _ml_val(num_labels, min_recall, thresholds, ignore_index)
+        _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target, thresholds, w = _multilabel_precision_recall_curve_format(
+        preds, target, num_labels, thresholds, ignore_index
+    )
+    state = _multilabel_precision_recall_curve_update(preds, target, num_labels, thresholds, w)
+    return _multilabel_precision_at_fixed_recall_compute(state, num_labels, thresholds, ignore_index, min_recall)

@@ -1,0 +1,121 @@
+"""Max recall at a precision floor (reference
+``functional/classification/recall_fixed_precision.py``)."""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional, Union
+
+import jax
+
+from ._operating_point import _apply_over_classes, _masked_lex_best
+from .precision_recall_curve import (
+    _binary_precision_recall_curve_arg_validation,
+    _binary_precision_recall_curve_compute,
+    _binary_precision_recall_curve_format,
+    _binary_precision_recall_curve_tensor_validation,
+    _binary_precision_recall_curve_update,
+    _multiclass_precision_recall_curve_arg_validation,
+    _multiclass_precision_recall_curve_compute,
+    _multiclass_precision_recall_curve_format,
+    _multiclass_precision_recall_curve_tensor_validation,
+    _multiclass_precision_recall_curve_update,
+    _multilabel_precision_recall_curve_arg_validation,
+    _multilabel_precision_recall_curve_compute,
+    _multilabel_precision_recall_curve_format,
+    _multilabel_precision_recall_curve_tensor_validation,
+    _multilabel_precision_recall_curve_update,
+)
+
+Array = jax.Array
+
+
+def _recall_at_precision(precision, recall, thresholds, min_precision: float):
+    """Best (recall, threshold) with precision >= floor (ref recall_fixed_precision.py:58)."""
+    return _masked_lex_best(recall, precision, thresholds, min_precision)
+
+
+def _validate_min(name: str, value: float) -> None:
+    if not isinstance(value, float) or not (0 <= value <= 1):
+        raise ValueError(f"Expected argument `{name}` to be an float in the [0,1] range, but got {value}")
+
+
+def _binary_recall_at_fixed_precision_arg_validation(min_precision, thresholds=None, ignore_index=None) -> None:
+    _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+    _validate_min("min_precision", min_precision)
+
+
+def _binary_recall_at_fixed_precision_compute(state, thresholds, min_precision: float, reduce_fn=_recall_at_precision):
+    precision, recall, thres = _binary_precision_recall_curve_compute(state, thresholds)
+    return reduce_fn(precision, recall, thres, min_precision)
+
+
+def binary_recall_at_fixed_precision(
+    preds, target, min_precision: float, thresholds=None, ignore_index=None, validate_args: bool = True
+):
+    if validate_args:
+        _binary_recall_at_fixed_precision_arg_validation(min_precision, thresholds, ignore_index)
+        _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
+    preds, target, thresholds, w = _binary_precision_recall_curve_format(preds, target, thresholds, ignore_index)
+    if thresholds is None and ignore_index is not None:
+        import numpy as np
+
+        keep = np.asarray(w) == 1
+        preds, target = preds[keep], target[keep]
+    state = _binary_precision_recall_curve_update(preds, target, thresholds, w)
+    return _binary_recall_at_fixed_precision_compute(state, thresholds, min_precision)
+
+
+def _multiclass_recall_at_fixed_precision_arg_validation(num_classes, min_precision, thresholds=None, ignore_index=None) -> None:
+    _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index)
+    _validate_min("min_precision", min_precision)
+
+
+def _multiclass_recall_at_fixed_precision_compute(
+    state, num_classes: int, thresholds, min_precision: float, reduce_fn=_recall_at_precision
+):
+    precision, recall, thres = _multiclass_precision_recall_curve_compute(state, num_classes, thresholds)
+    return _apply_over_classes(partial(reduce_fn, min_precision=min_precision), precision, recall, thres)
+
+
+def multiclass_recall_at_fixed_precision(
+    preds, target, num_classes: int, min_precision: float, thresholds=None, ignore_index=None, validate_args: bool = True
+):
+    if validate_args:
+        _multiclass_recall_at_fixed_precision_arg_validation(num_classes, min_precision, thresholds, ignore_index)
+        _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target, thresholds, w = _multiclass_precision_recall_curve_format(
+        preds, target, num_classes, thresholds, ignore_index
+    )
+    if thresholds is None and ignore_index is not None:
+        import numpy as np
+
+        keep = np.asarray(w) == 1
+        preds, target = preds[keep], target[keep]
+    state = _multiclass_precision_recall_curve_update(preds, target, num_classes, thresholds, w)
+    return _multiclass_recall_at_fixed_precision_compute(state, num_classes, thresholds, min_precision)
+
+
+def _multilabel_recall_at_fixed_precision_arg_validation(num_labels, min_precision, thresholds=None, ignore_index=None) -> None:
+    _multilabel_precision_recall_curve_arg_validation(num_labels, thresholds, ignore_index)
+    _validate_min("min_precision", min_precision)
+
+
+def _multilabel_recall_at_fixed_precision_compute(
+    state, num_labels: int, thresholds, ignore_index, min_precision: float, reduce_fn=_recall_at_precision
+):
+    precision, recall, thres = _multilabel_precision_recall_curve_compute(state, num_labels, thresholds, ignore_index)
+    return _apply_over_classes(partial(reduce_fn, min_precision=min_precision), precision, recall, thres)
+
+
+def multilabel_recall_at_fixed_precision(
+    preds, target, num_labels: int, min_precision: float, thresholds=None, ignore_index=None, validate_args: bool = True
+):
+    if validate_args:
+        _multilabel_recall_at_fixed_precision_arg_validation(num_labels, min_precision, thresholds, ignore_index)
+        _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target, thresholds, w = _multilabel_precision_recall_curve_format(
+        preds, target, num_labels, thresholds, ignore_index
+    )
+    state = _multilabel_precision_recall_curve_update(preds, target, num_labels, thresholds, w)
+    return _multilabel_recall_at_fixed_precision_compute(state, num_labels, thresholds, ignore_index, min_precision)

@@ -1,0 +1,117 @@
+"""Max sensitivity (TPR) at a specificity floor (reference
+``functional/classification/sensitivity_specificity.py``)."""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ._operating_point import _apply_over_classes
+from .precision_recall_curve import (
+    _binary_precision_recall_curve_format,
+    _binary_precision_recall_curve_tensor_validation,
+    _binary_precision_recall_curve_update,
+    _multiclass_precision_recall_curve_format,
+    _multiclass_precision_recall_curve_tensor_validation,
+    _multiclass_precision_recall_curve_update,
+    _multilabel_precision_recall_curve_format,
+    _multilabel_precision_recall_curve_tensor_validation,
+    _multilabel_precision_recall_curve_update,
+)
+from .recall_fixed_precision import _validate_min
+from .roc import _binary_roc_compute, _multiclass_roc_compute, _multilabel_roc_compute
+
+Array = jax.Array
+
+
+def _constrained_first_argmax(objective, constraint, thresholds, min_constraint: float):
+    """First argmax of ``objective`` where ``constraint >= floor``; fallback (0, 1e6).
+
+    Mirrors the reference's boolean-index + ``torch.argmax`` (first occurrence)
+    semantics (sensitivity_specificity.py:47-70) with a static-shape mask.
+    """
+    n = min(objective.shape[0], constraint.shape[0], thresholds.shape[0])
+    obj, con, thr = objective[:n], constraint[:n], thresholds[:n]
+    mask = con >= min_constraint
+    obj_m = jnp.where(mask, obj, -jnp.inf)
+    idx = jnp.argmax(obj_m)
+    feasible = mask.any()
+    best = jnp.where(feasible, obj[idx], 0.0)
+    best_thr = jnp.where(feasible, thr[idx], 1e6)
+    return best, best_thr
+
+
+def _sensitivity_at_specificity(fpr, tpr, thresholds, min_specificity: float):
+    return _constrained_first_argmax(tpr, 1 - fpr, thresholds, min_specificity)
+
+
+def _binary_sensitivity_at_specificity_compute(state, thresholds, min_specificity: float):
+    fpr, tpr, thres = _binary_roc_compute(state, thresholds)
+    return _sensitivity_at_specificity(fpr, tpr, thres, min_specificity)
+
+
+def binary_sensitivity_at_specificity(
+    preds, target, min_specificity: float, thresholds=None, ignore_index=None, validate_args: bool = True
+):
+    if validate_args:
+        _validate_min("min_specificity", min_specificity)
+        _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
+    preds, target, thresholds, w = _binary_precision_recall_curve_format(preds, target, thresholds, ignore_index)
+    if thresholds is None and ignore_index is not None:
+        import numpy as np
+
+        keep = np.asarray(w) == 1
+        preds, target = preds[keep], target[keep]
+    state = _binary_precision_recall_curve_update(preds, target, thresholds, w)
+    return _binary_sensitivity_at_specificity_compute(state, thresholds, min_specificity)
+
+
+def _apply_roc_operating_point(reduce_fn, fpr, tpr, thres, floor):
+    return _apply_over_classes(partial(reduce_fn, **floor), fpr, tpr, thres)
+
+
+def _multiclass_sensitivity_at_specificity_compute(state, num_classes: int, thresholds, min_specificity: float):
+    fpr, tpr, thres = _multiclass_roc_compute(state, num_classes, thresholds)
+    return _apply_over_classes(
+        partial(_sensitivity_at_specificity, min_specificity=min_specificity), fpr, tpr, thres
+    )
+
+
+def multiclass_sensitivity_at_specificity(
+    preds, target, num_classes: int, min_specificity: float, thresholds=None, ignore_index=None, validate_args: bool = True
+):
+    if validate_args:
+        _validate_min("min_specificity", min_specificity)
+        _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target, thresholds, w = _multiclass_precision_recall_curve_format(
+        preds, target, num_classes, thresholds, ignore_index
+    )
+    if thresholds is None and ignore_index is not None:
+        import numpy as np
+
+        keep = np.asarray(w) == 1
+        preds, target = preds[keep], target[keep]
+    state = _multiclass_precision_recall_curve_update(preds, target, num_classes, thresholds, w)
+    return _multiclass_sensitivity_at_specificity_compute(state, num_classes, thresholds, min_specificity)
+
+
+def _multilabel_sensitivity_at_specificity_compute(state, num_labels: int, thresholds, ignore_index, min_specificity: float):
+    fpr, tpr, thres = _multilabel_roc_compute(state, num_labels, thresholds, ignore_index)
+    return _apply_over_classes(
+        partial(_sensitivity_at_specificity, min_specificity=min_specificity), fpr, tpr, thres
+    )
+
+
+def multilabel_sensitivity_at_specificity(
+    preds, target, num_labels: int, min_specificity: float, thresholds=None, ignore_index=None, validate_args: bool = True
+):
+    if validate_args:
+        _validate_min("min_specificity", min_specificity)
+        _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target, thresholds, w = _multilabel_precision_recall_curve_format(
+        preds, target, num_labels, thresholds, ignore_index
+    )
+    state = _multilabel_precision_recall_curve_update(preds, target, num_labels, thresholds, w)
+    return _multilabel_sensitivity_at_specificity_compute(state, num_labels, thresholds, ignore_index, min_specificity)

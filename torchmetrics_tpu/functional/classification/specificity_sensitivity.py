@@ -1,0 +1,96 @@
+"""Max specificity (TNR) at a sensitivity floor (reference
+``functional/classification/specificity_sensitivity.py``)."""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+
+from ._operating_point import _apply_over_classes
+from .precision_recall_curve import (
+    _binary_precision_recall_curve_format,
+    _binary_precision_recall_curve_tensor_validation,
+    _binary_precision_recall_curve_update,
+    _multiclass_precision_recall_curve_format,
+    _multiclass_precision_recall_curve_tensor_validation,
+    _multiclass_precision_recall_curve_update,
+    _multilabel_precision_recall_curve_format,
+    _multilabel_precision_recall_curve_tensor_validation,
+    _multilabel_precision_recall_curve_update,
+)
+from .recall_fixed_precision import _validate_min
+from .roc import _binary_roc_compute, _multiclass_roc_compute, _multilabel_roc_compute
+from .sensitivity_specificity import _constrained_first_argmax
+
+Array = jax.Array
+
+
+def _specificity_at_sensitivity(fpr, tpr, thresholds, min_sensitivity: float):
+    return _constrained_first_argmax(1 - fpr, tpr, thresholds, min_sensitivity)
+
+
+def _binary_specificity_at_sensitivity_compute(state, thresholds, min_sensitivity: float):
+    fpr, tpr, thres = _binary_roc_compute(state, thresholds)
+    return _specificity_at_sensitivity(fpr, tpr, thres, min_sensitivity)
+
+
+def binary_specificity_at_sensitivity(
+    preds, target, min_sensitivity: float, thresholds=None, ignore_index=None, validate_args: bool = True
+):
+    if validate_args:
+        _validate_min("min_sensitivity", min_sensitivity)
+        _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
+    preds, target, thresholds, w = _binary_precision_recall_curve_format(preds, target, thresholds, ignore_index)
+    if thresholds is None and ignore_index is not None:
+        import numpy as np
+
+        keep = np.asarray(w) == 1
+        preds, target = preds[keep], target[keep]
+    state = _binary_precision_recall_curve_update(preds, target, thresholds, w)
+    return _binary_specificity_at_sensitivity_compute(state, thresholds, min_sensitivity)
+
+
+def _multiclass_specificity_at_sensitivity_compute(state, num_classes: int, thresholds, min_sensitivity: float):
+    fpr, tpr, thres = _multiclass_roc_compute(state, num_classes, thresholds)
+    return _apply_over_classes(
+        partial(_specificity_at_sensitivity, min_sensitivity=min_sensitivity), fpr, tpr, thres
+    )
+
+
+def multiclass_specificity_at_sensitivity(
+    preds, target, num_classes: int, min_sensitivity: float, thresholds=None, ignore_index=None, validate_args: bool = True
+):
+    if validate_args:
+        _validate_min("min_sensitivity", min_sensitivity)
+        _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target, thresholds, w = _multiclass_precision_recall_curve_format(
+        preds, target, num_classes, thresholds, ignore_index
+    )
+    if thresholds is None and ignore_index is not None:
+        import numpy as np
+
+        keep = np.asarray(w) == 1
+        preds, target = preds[keep], target[keep]
+    state = _multiclass_precision_recall_curve_update(preds, target, num_classes, thresholds, w)
+    return _multiclass_specificity_at_sensitivity_compute(state, num_classes, thresholds, min_sensitivity)
+
+
+def _multilabel_specificity_at_sensitivity_compute(state, num_labels: int, thresholds, ignore_index, min_sensitivity: float):
+    fpr, tpr, thres = _multilabel_roc_compute(state, num_labels, thresholds, ignore_index)
+    return _apply_over_classes(
+        partial(_specificity_at_sensitivity, min_sensitivity=min_sensitivity), fpr, tpr, thres
+    )
+
+
+def multilabel_specificity_at_sensitivity(
+    preds, target, num_labels: int, min_sensitivity: float, thresholds=None, ignore_index=None, validate_args: bool = True
+):
+    if validate_args:
+        _validate_min("min_sensitivity", min_sensitivity)
+        _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target, thresholds, w = _multilabel_precision_recall_curve_format(
+        preds, target, num_labels, thresholds, ignore_index
+    )
+    state = _multilabel_precision_recall_curve_update(preds, target, num_labels, thresholds, w)
+    return _multilabel_specificity_at_sensitivity_compute(state, num_labels, thresholds, ignore_index, min_sensitivity)
