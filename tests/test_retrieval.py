@@ -1,0 +1,405 @@
+"""Retrieval tower tests (reference tests/unittests/retrieval/).
+
+References: per-query numpy implementations mirroring the reference semantics
+(including the reference's preds>0 filter quirk), plus sklearn ndcg_score/roc_auc_score
+where applicable.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import ndcg_score, roc_auc_score
+
+from conftest import seed_all
+from torchmetrics_tpu.functional.retrieval import (
+    retrieval_auroc,
+    retrieval_average_precision,
+    retrieval_fall_out,
+    retrieval_hit_rate,
+    retrieval_normalized_dcg,
+    retrieval_precision,
+    retrieval_precision_recall_curve,
+    retrieval_r_precision,
+    retrieval_recall,
+    retrieval_reciprocal_rank,
+)
+from torchmetrics_tpu.retrieval import (
+    RetrievalAUROC,
+    RetrievalFallOut,
+    RetrievalHitRate,
+    RetrievalMAP,
+    RetrievalMRR,
+    RetrievalNormalizedDCG,
+    RetrievalPrecision,
+    RetrievalPrecisionRecallCurve,
+    RetrievalRPrecision,
+    RetrievalRecall,
+    RetrievalRecallAtFixedPrecision,
+)
+
+
+def _np_ap(p, t, top_k=None):
+    k = top_k or len(p)
+    t = np.where(p > 0, t, 0)
+    order = np.argsort(-p, kind="stable")[:k]
+    tk = t[order]
+    if tk.sum() == 0:
+        return 0.0
+    pos = np.arange(1, len(tk) + 1)[tk > 0]
+    return np.mean(np.arange(1, len(pos) + 1) / pos)
+
+
+def _np_rr(p, t, top_k=None):
+    k = top_k or len(p)
+    t = np.where(p > 0, t, 0)
+    order = np.argsort(-p, kind="stable")[:k]
+    tk = t[order]
+    nz = np.nonzero(tk)[0]
+    return 0.0 if len(nz) == 0 else 1.0 / (nz[0] + 1)
+
+
+def _np_precision(p, t, top_k=None, adaptive_k=False):
+    if top_k is None or (adaptive_k and top_k > len(p)):
+        top_k = len(p)
+    if t.sum() == 0:
+        return 0.0
+    tf = np.where(p > 0, t, 0)
+    order = np.argsort(-p, kind="stable")[: min(top_k, len(p))]
+    return tf[order].sum() / top_k
+
+
+def _np_recall(p, t, top_k=None):
+    k = top_k or len(p)
+    if t.sum() == 0:
+        return 0.0
+    tf = np.where(p > 0, t, 0)
+    order = np.argsort(-p, kind="stable")[:k]
+    return tf[order].sum() / t.sum()
+
+
+def _np_hit_rate(p, t, top_k=None):
+    k = top_k or len(p)
+    order = np.argsort(-p, kind="stable")[:k]
+    return float(t[order].sum() > 0)
+
+
+def _np_fall_out(p, t, top_k=None):
+    k = top_k or len(p)
+    neg = 1 - t
+    if neg.sum() == 0:
+        return 0.0
+    order = np.argsort(-p, kind="stable")[:k]
+    return neg[order].sum() / neg.sum()
+
+
+def _np_r_precision(p, t):
+    r = t.sum()
+    if r == 0:
+        return 0.0
+    order = np.argsort(-p, kind="stable")[:r]
+    return t[order].sum() / r
+
+
+def _rand_query(rng, n=20, with_pos=True):
+    p = rng.random(n).astype(np.float32)
+    t = rng.integers(0, 2, n)
+    if with_pos and t.sum() == 0:
+        t[rng.integers(0, n)] = 1
+    return p, t
+
+
+class TestFunctionalSingleQuery:
+    @pytest.mark.parametrize("top_k", [None, 3, 10])
+    def test_ap(self, top_k):
+        rng = seed_all()
+        for _ in range(5):
+            p, t = _rand_query(rng)
+            np.testing.assert_allclose(
+                float(retrieval_average_precision(jnp.asarray(p), jnp.asarray(t), top_k)),
+                _np_ap(p, t, top_k), atol=1e-6,
+            )
+
+    @pytest.mark.parametrize("top_k", [None, 3])
+    def test_rr(self, top_k):
+        rng = seed_all()
+        for _ in range(5):
+            p, t = _rand_query(rng)
+            np.testing.assert_allclose(
+                float(retrieval_reciprocal_rank(jnp.asarray(p), jnp.asarray(t), top_k)),
+                _np_rr(p, t, top_k), atol=1e-6,
+            )
+
+    @pytest.mark.parametrize("top_k,adaptive", [(None, False), (5, False), (30, True), (30, False)])
+    def test_precision(self, top_k, adaptive):
+        rng = seed_all()
+        for _ in range(5):
+            p, t = _rand_query(rng)
+            np.testing.assert_allclose(
+                float(retrieval_precision(jnp.asarray(p), jnp.asarray(t), top_k, adaptive)),
+                _np_precision(p, t, top_k, adaptive), atol=1e-6,
+            )
+
+    @pytest.mark.parametrize("top_k", [None, 5])
+    def test_recall(self, top_k):
+        rng = seed_all()
+        for _ in range(5):
+            p, t = _rand_query(rng)
+            np.testing.assert_allclose(
+                float(retrieval_recall(jnp.asarray(p), jnp.asarray(t), top_k)),
+                _np_recall(p, t, top_k), atol=1e-6,
+            )
+
+    @pytest.mark.parametrize("top_k", [None, 5])
+    def test_hit_rate_fall_out_r_precision(self, top_k):
+        rng = seed_all()
+        for _ in range(5):
+            p, t = _rand_query(rng)
+            np.testing.assert_allclose(
+                float(retrieval_hit_rate(jnp.asarray(p), jnp.asarray(t), top_k)), _np_hit_rate(p, t, top_k), atol=1e-6
+            )
+            np.testing.assert_allclose(
+                float(retrieval_fall_out(jnp.asarray(p), jnp.asarray(t), top_k)), _np_fall_out(p, t, top_k), atol=1e-6
+            )
+            np.testing.assert_allclose(
+                float(retrieval_r_precision(jnp.asarray(p), jnp.asarray(t))), _np_r_precision(p, t), atol=1e-6
+            )
+
+    def test_ndcg_vs_sklearn(self):
+        rng = seed_all()
+        for _ in range(5):
+            p = rng.random(15).astype(np.float32)
+            t = rng.integers(0, 5, 15)  # graded relevance
+            ref = ndcg_score(t[None, :], p[None, :])
+            np.testing.assert_allclose(
+                float(retrieval_normalized_dcg(jnp.asarray(p), jnp.asarray(t))), ref, atol=1e-5
+            )
+
+    def test_ndcg_topk_vs_sklearn(self):
+        rng = seed_all()
+        p = rng.random(20).astype(np.float32)
+        t = rng.integers(0, 4, 20)
+        ref = ndcg_score(t[None, :], p[None, :], k=5)
+        np.testing.assert_allclose(
+            float(retrieval_normalized_dcg(jnp.asarray(p), jnp.asarray(t), top_k=5)), ref, atol=1e-5
+        )
+
+    def test_ndcg_with_ties(self):
+        # tie-averaging must match sklearn (default ignore_ties=False)
+        p = np.asarray([0.5, 0.5, 0.5, 0.9, 0.1], np.float32)
+        t = np.asarray([3, 0, 1, 2, 2])
+        ref = ndcg_score(t[None, :], p[None, :])
+        np.testing.assert_allclose(float(retrieval_normalized_dcg(jnp.asarray(p), jnp.asarray(t))), ref, atol=1e-5)
+
+    def test_auroc_vs_sklearn(self):
+        rng = seed_all()
+        for _ in range(5):
+            p = rng.random(30).astype(np.float32)
+            t = rng.integers(0, 2, 30)
+            if len(np.unique(t)) < 2:
+                t[0], t[1] = 0, 1
+            np.testing.assert_allclose(
+                float(retrieval_auroc(jnp.asarray(p), jnp.asarray(t))), roc_auc_score(t, p), atol=1e-6
+            )
+
+    def test_auroc_with_tied_preds(self):
+        p = np.asarray([0.5, 0.5, 0.7, 0.2, 0.5], np.float32)
+        t = np.asarray([1, 0, 1, 0, 1])
+        np.testing.assert_allclose(float(retrieval_auroc(jnp.asarray(p), jnp.asarray(t))), roc_auc_score(t, p), atol=1e-6)
+
+    def test_auroc_single_class_is_zero(self):
+        p = np.asarray([0.5, 0.2], np.float32)
+        assert float(retrieval_auroc(jnp.asarray(p), jnp.asarray(np.asarray([1, 1])))) == 0.0
+
+    def test_pr_curve(self):
+        rng = seed_all()
+        p, t = _rand_query(rng, 10)
+        precision, recall, ks = retrieval_precision_recall_curve(jnp.asarray(p), jnp.asarray(t), max_k=5)
+        assert precision.shape == (5,) and recall.shape == (5,) and ks.shape == (5,)
+        for i, k in enumerate(range(1, 6)):
+            np.testing.assert_allclose(float(precision[i]), _np_precision(p, t, k), atol=1e-6)
+            np.testing.assert_allclose(float(recall[i]), _np_recall(p, t, k), atol=1e-6)
+
+    def test_validation_errors(self):
+        with pytest.raises(ValueError):
+            retrieval_average_precision(jnp.asarray([0.1]), jnp.asarray([1]), top_k=-1)
+        with pytest.raises(ValueError):
+            retrieval_average_precision(jnp.asarray([0.1, 0.2]), jnp.asarray([1]))
+        with pytest.raises(ValueError):
+            retrieval_average_precision(jnp.asarray([0.1]), jnp.asarray([2]))  # non-binary
+
+
+def _grouped_ref(metric_fn, idx, p, t, empty_action="neg", **kw):
+    scores = []
+    for q in np.unique(idx):
+        sel = idx == q
+        pq, tq = p[sel], t[sel]
+        if tq.sum() == 0:
+            if empty_action == "neg":
+                scores.append(0.0)
+            elif empty_action == "pos":
+                scores.append(1.0)
+            elif empty_action == "skip":
+                continue
+            continue
+        scores.append(metric_fn(pq, tq, **kw))
+    return np.mean(scores) if scores else 0.0
+
+
+class TestRetrievalClasses:
+    def _make_corpus(self, rng, n=300, queries=12):
+        idx = rng.integers(0, queries, n)
+        p = rng.random(n).astype(np.float32)
+        t = rng.integers(0, 2, n)
+        return idx, p, t
+
+    @pytest.mark.parametrize(
+        "cls,ref_fn,kw",
+        [
+            (RetrievalMAP, _np_ap, {}),
+            (RetrievalMRR, _np_rr, {}),
+            (RetrievalPrecision, _np_precision, {"top_k": 3}),
+            (RetrievalRecall, _np_recall, {"top_k": 3}),
+            (RetrievalHitRate, _np_hit_rate, {"top_k": 3}),
+            (RetrievalRPrecision, _np_r_precision, {}),
+        ],
+    )
+    def test_vs_grouped_reference(self, cls, ref_fn, kw):
+        rng = seed_all()
+        idx, p, t = self._make_corpus(rng)
+        init_kw = {k: v for k, v in kw.items() if k == "top_k"}
+        metric = cls(**init_kw)
+        # feed in 3 chunks to exercise accumulation
+        for chunk in np.array_split(np.arange(len(idx)), 3):
+            metric.update(jnp.asarray(p[chunk]), jnp.asarray(t[chunk]), jnp.asarray(idx[chunk]))
+        ref = _grouped_ref(ref_fn, idx, p, t, **kw)
+        np.testing.assert_allclose(float(metric.compute()), ref, atol=1e-6)
+
+    def test_fall_out_empty_neg_policy(self):
+        rng = seed_all()
+        idx, p, t = self._make_corpus(rng)
+        metric = RetrievalFallOut(top_k=3)
+        metric.update(jnp.asarray(p), jnp.asarray(t), jnp.asarray(idx))
+        scores = []
+        for q in np.unique(idx):
+            sel = idx == q
+            if (1 - t[sel]).sum() == 0:
+                scores.append(1.0)  # default empty_target_action="pos"
+            else:
+                scores.append(_np_fall_out(p[sel], t[sel], 3))
+        np.testing.assert_allclose(float(metric.compute()), np.mean(scores), atol=1e-6)
+
+    @pytest.mark.parametrize("action", ["neg", "pos", "skip"])
+    def test_empty_target_actions(self, action):
+        idx = np.asarray([0, 0, 1, 1])
+        p = np.asarray([0.3, 0.7, 0.6, 0.2], np.float32)
+        t = np.asarray([0, 0, 1, 0])  # query 0 empty
+        metric = RetrievalMAP(empty_target_action=action)
+        metric.update(jnp.asarray(p), jnp.asarray(t), jnp.asarray(idx))
+        val = float(metric.compute())
+        ap1 = _np_ap(p[2:], t[2:])
+        expected = {"neg": (0.0 + ap1) / 2, "pos": (1.0 + ap1) / 2, "skip": ap1}[action]
+        np.testing.assert_allclose(val, expected, atol=1e-6)
+
+    def test_empty_target_error_raises(self):
+        metric = RetrievalMAP(empty_target_action="error")
+        metric.update(jnp.asarray([0.5, 0.4]), jnp.asarray([0, 0]), jnp.asarray([0, 0]))
+        with pytest.raises(ValueError):
+            metric.compute()
+
+    def test_aggregation_modes(self):
+        idx = np.asarray([0, 0, 1, 1])
+        p = np.asarray([0.9, 0.1, 0.2, 0.8], np.float32)
+        t = np.asarray([1, 0, 1, 0])
+        vals = [_np_ap(p[:2], t[:2]), _np_ap(p[2:], t[2:])]
+        for agg, ref in [("mean", np.mean(vals)), ("median", np.median(vals)), ("min", np.min(vals)), ("max", np.max(vals))]:
+            m = RetrievalMAP(aggregation=agg)
+            m.update(jnp.asarray(p), jnp.asarray(t), jnp.asarray(idx))
+            np.testing.assert_allclose(float(m.compute()), ref, atol=1e-6, err_msg=agg)
+
+    def test_ndcg_class_vs_sklearn(self):
+        rng = seed_all()
+        idx = np.repeat(np.arange(6), 10)
+        p = rng.random(60).astype(np.float32)
+        t = rng.integers(0, 4, 60)
+        metric = RetrievalNormalizedDCG()
+        metric.update(jnp.asarray(p), jnp.asarray(t), jnp.asarray(idx))
+        refs = [ndcg_score(t[idx == q][None], p[idx == q][None]) for q in range(6)]
+        np.testing.assert_allclose(float(metric.compute()), np.mean(refs), atol=1e-5)
+
+    def test_auroc_class_vs_sklearn(self):
+        rng = seed_all()
+        idx = np.repeat(np.arange(5), 20)
+        p = rng.random(100).astype(np.float32)
+        t = rng.integers(0, 2, 100)
+        metric = RetrievalAUROC()
+        metric.update(jnp.asarray(p), jnp.asarray(t), jnp.asarray(idx))
+        refs = []
+        for q in range(5):
+            tq, pq = t[idx == q], p[idx == q]
+            refs.append(roc_auc_score(tq, pq) if len(np.unique(tq)) == 2 else 0.0)
+        np.testing.assert_allclose(float(metric.compute()), np.mean(refs), atol=1e-6)
+
+    def test_uneven_query_sizes(self):
+        # padding correctness: queries of very different lengths
+        idx = np.asarray([0] * 3 + [1] * 25 + [2] * 7)
+        rng = seed_all()
+        p = rng.random(35).astype(np.float32)
+        t = rng.integers(0, 2, 35)
+        t[:3] = [1, 0, 1]
+        metric = RetrievalMAP()
+        metric.update(jnp.asarray(p), jnp.asarray(t), jnp.asarray(idx))
+        ref = _grouped_ref(_np_ap, idx, p, t)
+        np.testing.assert_allclose(float(metric.compute()), ref, atol=1e-6)
+
+    def test_ignore_index(self):
+        idx = np.asarray([0, 0, 0, 0])
+        p = np.asarray([0.9, 0.8, 0.3, 0.2], np.float32)
+        t = np.asarray([1, -1, 0, -1])
+        m = RetrievalMAP(ignore_index=-1)
+        m.update(jnp.asarray(p), jnp.asarray(t), jnp.asarray(idx))
+        np.testing.assert_allclose(float(m.compute()), _np_ap(p[[0, 2]], t[[0, 2]]), atol=1e-6)
+
+    def test_pr_curve_class(self):
+        rng = seed_all()
+        idx, p, t = self._make_corpus(rng, n=100, queries=5)
+        m = RetrievalPrecisionRecallCurve(max_k=4)
+        m.update(jnp.asarray(p), jnp.asarray(t), jnp.asarray(idx))
+        precision, recall, ks = m.compute()
+        assert precision.shape == (4,)
+        for i, k in enumerate(range(1, 5)):
+            ref_p = _grouped_ref(_np_precision, idx, p, t, top_k=k)
+            ref_r = _grouped_ref(_np_recall, idx, p, t, top_k=k)
+            np.testing.assert_allclose(float(precision[i]), ref_p, atol=1e-6)
+            np.testing.assert_allclose(float(recall[i]), ref_r, atol=1e-6)
+
+    def test_recall_at_fixed_precision(self):
+        rng = seed_all()
+        idx, p, t = self._make_corpus(rng, n=100, queries=5)
+        m = RetrievalRecallAtFixedPrecision(min_precision=0.3, max_k=6)
+        m.update(jnp.asarray(p), jnp.asarray(t), jnp.asarray(idx))
+        best_r, best_k = m.compute()
+        prs = [(_grouped_ref(_np_precision, idx, p, t, top_k=k), _grouped_ref(_np_recall, idx, p, t, top_k=k), k) for k in range(1, 7)]
+        feas = [(r, k) for (pp, r, k) in prs if pp >= 0.3]
+        ref_r = max(feas)[0] if feas else 0.0
+        np.testing.assert_allclose(float(best_r), ref_r, atol=1e-6)
+
+    def test_merge_state(self):
+        rng = seed_all()
+        idx, p, t = self._make_corpus(rng, n=200, queries=8)
+        m1, m2, mall = RetrievalMAP(), RetrievalMAP(), RetrievalMAP()
+        h = len(idx) // 2
+        m1.update(jnp.asarray(p[:h]), jnp.asarray(t[:h]), jnp.asarray(idx[:h]))
+        m2.update(jnp.asarray(p[h:]), jnp.asarray(t[h:]), jnp.asarray(idx[h:]))
+        mall.update(jnp.asarray(p), jnp.asarray(t), jnp.asarray(idx))
+        m1.merge_state(m2)
+        np.testing.assert_allclose(float(m1.compute()), float(mall.compute()), atol=1e-6)
+
+    def test_raises(self):
+        with pytest.raises(ValueError):
+            RetrievalMAP(empty_target_action="bogus")
+        with pytest.raises(ValueError):
+            RetrievalMAP(ignore_index="x")
+        with pytest.raises(ValueError):
+            RetrievalPrecision(top_k=-2)
+        with pytest.raises(ValueError):
+            RetrievalMAP(aggregation="bogus")

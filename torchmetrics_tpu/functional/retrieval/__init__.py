@@ -1,0 +1,156 @@
+"""Functional retrieval metrics (reference ``functional/retrieval/``).
+
+Every public function scores ONE query (1-D preds/target), mirroring the reference
+API; all of them are thin wrappers over the vectorized padded kernels in
+``_kernels.py`` (one row = one query).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ._kernels import (
+    _ap_kernel,
+    _auroc_kernel,
+    _fall_out_kernel,
+    _hit_rate_kernel,
+    _ndcg_kernel,
+    _precision_kernel,
+    _r_precision_kernel,
+    _recall_kernel,
+    _rr_kernel,
+)
+from .utils import _check_retrieval_functional_inputs
+
+Array = jax.Array
+
+
+def _validate_top_k(top_k: Optional[int]) -> None:
+    if top_k is not None and not (isinstance(top_k, int) and top_k > 0):
+        raise ValueError("`top_k` has to be a positive integer or None")
+
+
+def _as_row(preds, target, allow_non_binary_target=False):
+    p, t = _check_retrieval_functional_inputs(preds, target, allow_non_binary_target)
+    return p[None, :], t[None, :], jnp.ones((1, p.shape[0]), bool)
+
+
+def retrieval_average_precision(preds, target, top_k: Optional[int] = None) -> Array:
+    """AP of one query (reference functional/retrieval/average_precision.py:16)."""
+    _validate_top_k(top_k)
+    p, t, m = _as_row(preds, target)
+    return _ap_kernel(p, t, m, top_k)[0]
+
+
+def retrieval_reciprocal_rank(preds, target, top_k: Optional[int] = None) -> Array:
+    """RR of one query (reference functional/retrieval/reciprocal_rank.py:16)."""
+    _validate_top_k(top_k)
+    p, t, m = _as_row(preds, target)
+    return _rr_kernel(p, t, m, top_k)[0]
+
+
+def retrieval_precision(preds, target, top_k: Optional[int] = None, adaptive_k: bool = False) -> Array:
+    """Precision@k of one query (reference functional/retrieval/precision.py:20)."""
+    if not isinstance(adaptive_k, bool):
+        raise ValueError("`adaptive_k` has to be a boolean")
+    _validate_top_k(top_k)
+    p, t, m = _as_row(preds, target)
+    return _precision_kernel(p, t, m, top_k, adaptive_k)[0]
+
+
+def retrieval_recall(preds, target, top_k: Optional[int] = None) -> Array:
+    """Recall@k of one query (reference functional/retrieval/recall.py:20)."""
+    _validate_top_k(top_k)
+    p, t, m = _as_row(preds, target)
+    return _recall_kernel(p, t, m, top_k)[0]
+
+
+def retrieval_hit_rate(preds, target, top_k: Optional[int] = None) -> Array:
+    """HitRate@k of one query (reference functional/retrieval/hit_rate.py:20)."""
+    _validate_top_k(top_k)
+    p, t, m = _as_row(preds, target)
+    return _hit_rate_kernel(p, t, m, top_k)[0]
+
+
+def retrieval_fall_out(preds, target, top_k: Optional[int] = None) -> Array:
+    """FallOut@k of one query (reference functional/retrieval/fall_out.py:20)."""
+    _validate_top_k(top_k)
+    p, t, m = _as_row(preds, target)
+    return _fall_out_kernel(p, t, m, top_k)[0]
+
+
+def retrieval_r_precision(preds, target) -> Array:
+    """R-Precision of one query (reference functional/retrieval/r_precision.py:16)."""
+    p, t, m = _as_row(preds, target)
+    return _r_precision_kernel(p, t, m)[0]
+
+
+def retrieval_normalized_dcg(preds, target, top_k: Optional[int] = None) -> Array:
+    """NDCG of one query; non-binary gains allowed (reference functional/retrieval/ndcg.py)."""
+    _validate_top_k(top_k)
+    p, t, m = _as_row(preds, target, allow_non_binary_target=True)
+    return _ndcg_kernel(p, t, m, top_k)[0]
+
+
+def retrieval_auroc(preds, target, top_k: Optional[int] = None, max_fpr: Optional[float] = None) -> Array:
+    """AUROC of one query over top-k docs (reference functional/retrieval/auroc.py:16)."""
+    _validate_top_k(top_k)
+    if max_fpr is not None:
+        # partial AUC needs the ROC curve; delegate to the classification kernel
+        from ..classification.auroc import binary_auroc
+
+        p, t = _check_retrieval_functional_inputs(preds, target)
+        k = min(top_k or p.shape[-1], p.shape[-1])
+        order = jnp.argsort(-p)[:k]
+        tk = t[order]
+        if (int(tk.max(initial=0)) != 1) or (int(tk.min(initial=1)) != 0):
+            return jnp.zeros(())
+        return binary_auroc(p[order], tk, max_fpr=max_fpr)
+    p, t, m = _as_row(preds, target)
+    return _auroc_kernel(p, t, m, top_k)[0]
+
+
+def retrieval_precision_recall_curve(
+    preds, target, max_k: Optional[int] = None, adaptive_k: bool = False
+) -> Tuple[Array, Array, Array]:
+    """Precision@k / Recall@k for k = 1..max_k of one query
+    (reference functional/retrieval/precision_recall_curve.py:24)."""
+    if not isinstance(adaptive_k, bool):
+        raise ValueError("`adaptive_k` has to be a boolean")
+    p, t, m = _as_row(preds, target)
+    n = p.shape[-1]
+    if max_k is None:
+        max_k = n
+    if not (isinstance(max_k, int) and max_k > 0):
+        raise ValueError("`max_k` has to be a positive integer or None")
+    if adaptive_k and max_k > n:
+        max_k = n
+    ks = jnp.arange(1, max_k + 1)
+    tgt = jnp.where(p > 0, t, 0)
+    from .utils import _ranked_by_preds
+
+    ranked, rmask = _ranked_by_preds(p, tgt, m)
+    rel = ((ranked > 0) & rmask).astype(jnp.float32)[0]
+    cum = jnp.cumsum(rel)
+    cum_k = cum[jnp.minimum(ks - 1, n - 1)]
+    precision = cum_k / ks.astype(jnp.float32)
+    total = (jnp.where(m, t, 0) > 0).sum().astype(jnp.float32)
+    recall = jnp.where(total > 0, cum_k / jnp.maximum(total, 1.0), jnp.zeros_like(cum_k))
+    return precision, recall, ks
+
+
+__all__ = [
+    "retrieval_average_precision",
+    "retrieval_auroc",
+    "retrieval_fall_out",
+    "retrieval_hit_rate",
+    "retrieval_normalized_dcg",
+    "retrieval_precision",
+    "retrieval_precision_recall_curve",
+    "retrieval_r_precision",
+    "retrieval_recall",
+    "retrieval_reciprocal_rank",
+]

@@ -1,0 +1,163 @@
+"""Vectorized masked retrieval kernels over padded ``(Q, L)`` query matrices.
+
+Each kernel returns a ``(Q,)`` vector of per-query scores and is the single source of
+truth for both the functional API (one query = one row) and the stateful classes
+(whole corpus = one call). Semantics mirror the reference single-query functions in
+``functional/retrieval/*.py`` (cited per kernel), including the reference's
+``preds > 0`` relevance-filter quirk where present.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .utils import _ranked_by_preds, _row_segment_ids, _tie_average_ranks
+
+Array = jax.Array
+
+
+def _positions_within_k(mask_ranked: Array, top_k: int) -> Array:
+    """Bool (Q, L): ranked position is a real (non-pad) entry within the top-k."""
+    n = mask_ranked.shape[-1]
+    return mask_ranked & (jnp.arange(n)[None, :] < top_k)
+
+
+def _ap_kernel(preds: Array, target: Array, mask: Array, top_k: Optional[int] = None) -> Array:
+    """Average precision (reference functional/retrieval/average_precision.py:16)."""
+    k = top_k or preds.shape[-1]
+    tgt = jnp.where(preds > 0, target, 0)  # reference filter quirk
+    ranked, rmask = _ranked_by_preds(preds, tgt, mask)
+    rel = (ranked > 0) & _positions_within_k(rmask, k)
+    relf = rel.astype(jnp.float32)
+    cum = jnp.cumsum(relf, axis=-1)
+    prec_at = cum / jnp.arange(1, preds.shape[-1] + 1, dtype=jnp.float32)[None, :]
+    n_rel = relf.sum(axis=-1)
+    return jnp.where(n_rel > 0, (prec_at * relf).sum(axis=-1) / jnp.maximum(n_rel, 1.0), 0.0)
+
+
+def _rr_kernel(preds: Array, target: Array, mask: Array, top_k: Optional[int] = None) -> Array:
+    """Reciprocal rank (reference functional/retrieval/reciprocal_rank.py:16)."""
+    k = top_k or preds.shape[-1]
+    tgt = jnp.where(preds > 0, target, 0)
+    ranked, rmask = _ranked_by_preds(preds, tgt, mask)
+    rel = (ranked > 0) & _positions_within_k(rmask, k)
+    first = jnp.argmax(rel, axis=-1)
+    return jnp.where(rel.any(axis=-1), 1.0 / (first + 1.0), 0.0)
+
+
+def _precision_kernel(
+    preds: Array, target: Array, mask: Array, top_k: Optional[int] = None, adaptive_k: bool = False
+) -> Array:
+    """Precision@k (reference functional/retrieval/precision.py:20)."""
+    n_valid = mask.sum(axis=-1).astype(jnp.float32)
+    k = preds.shape[-1] if top_k is None else top_k
+    tgt = jnp.where(preds > 0, target, 0)
+    ranked, rmask = _ranked_by_preds(preds, tgt, mask)
+    rel = ((ranked > 0) & _positions_within_k(rmask, k)).sum(axis=-1).astype(jnp.float32)
+    if adaptive_k:
+        denom = jnp.minimum(float(k), n_valid)
+    else:
+        denom = jnp.full_like(n_valid, float(k))
+    has_pos = (jnp.where(mask, target, 0) > 0).any(axis=-1)
+    return jnp.where(has_pos, rel / denom, 0.0)
+
+
+def _recall_kernel(preds: Array, target: Array, mask: Array, top_k: Optional[int] = None) -> Array:
+    """Recall@k (reference functional/retrieval/recall.py:20)."""
+    k = preds.shape[-1] if top_k is None else top_k
+    tgt = jnp.where(preds > 0, target, 0)
+    ranked, rmask = _ranked_by_preds(preds, tgt, mask)
+    rel = ((ranked > 0) & _positions_within_k(rmask, k)).sum(axis=-1).astype(jnp.float32)
+    total = (jnp.where(mask, target, 0) > 0).sum(axis=-1).astype(jnp.float32)
+    return jnp.where(total > 0, rel / jnp.maximum(total, 1.0), 0.0)
+
+
+def _hit_rate_kernel(preds: Array, target: Array, mask: Array, top_k: Optional[int] = None) -> Array:
+    """HitRate@k (reference functional/retrieval/hit_rate.py:20) — no preds>0 filter."""
+    k = preds.shape[-1] if top_k is None else top_k
+    ranked, rmask = _ranked_by_preds(preds, target, mask)
+    rel = ((ranked > 0) & _positions_within_k(rmask, k)).sum(axis=-1)
+    return (rel > 0).astype(jnp.float32)
+
+
+def _fall_out_kernel(preds: Array, target: Array, mask: Array, top_k: Optional[int] = None) -> Array:
+    """FallOut@k over negative targets (reference functional/retrieval/fall_out.py:20)."""
+    k = preds.shape[-1] if top_k is None else top_k
+    neg = jnp.where(mask, 1 - target, 0)
+    ranked, rmask = _ranked_by_preds(preds, neg, mask)
+    rel = ((ranked > 0) & _positions_within_k(rmask, k)).sum(axis=-1).astype(jnp.float32)
+    total = (neg > 0).sum(axis=-1).astype(jnp.float32)
+    return jnp.where(total > 0, rel / jnp.maximum(total, 1.0), 0.0)
+
+
+def _r_precision_kernel(preds: Array, target: Array, mask: Array) -> Array:
+    """R-Precision (reference functional/retrieval/r_precision.py:16)."""
+    ranked, rmask = _ranked_by_preds(preds, target, mask)
+    n_rel = (jnp.where(mask, target, 0) > 0).sum(axis=-1)
+    within = rmask & (jnp.arange(preds.shape[-1])[None, :] < n_rel[:, None])
+    rel = ((ranked > 0) & within).sum(axis=-1).astype(jnp.float32)
+    return jnp.where(n_rel > 0, rel / jnp.maximum(n_rel.astype(jnp.float32), 1.0), 0.0)
+
+
+def _dcg_tie_averaged(preds: Array, gains: Array, mask: Array, top_k: int) -> Array:
+    """Tie-averaged DCG per row (reference functional/retrieval/ndcg.py:_tie_average_dcg,
+    translated from sklearn): within a tie group the gain is the group mean, weighted by
+    the group's share of the discount budget."""
+    n = preds.shape[-1]
+    discount = 1.0 / jnp.log2(jnp.arange(n, dtype=jnp.float32) + 2.0)
+    discount = jnp.where(jnp.arange(n) < top_k, discount, 0.0)
+    eff = jnp.where(mask, preds, -jnp.inf)
+    order = jnp.argsort(-eff, axis=-1, stable=True)
+    sorted_preds = jnp.take_along_axis(eff, order, axis=-1)
+    sorted_gains = jnp.take_along_axis(jnp.where(mask, gains, 0.0), order, axis=-1)
+    seg = _row_segment_ids(sorted_preds)
+    seg_gain = jax.vmap(lambda s, v: jax.ops.segment_sum(v, s, num_segments=n))(seg, sorted_gains)
+    seg_cnt = jax.vmap(lambda s: jax.ops.segment_sum(jnp.ones(n, jnp.float32), s, num_segments=n))(seg)
+    seg_disc = jax.vmap(lambda s: jax.ops.segment_sum(discount, s, num_segments=n))(
+        jnp.broadcast_to(seg, seg.shape)
+    )
+    avg_gain = seg_gain / jnp.maximum(seg_cnt, 1.0)
+    return (avg_gain * seg_disc).sum(axis=-1)
+
+
+def _dcg_ideal(gains: Array, mask: Array, top_k: int) -> Array:
+    """Ideal (sorted-by-gain) DCG per row, ties irrelevant."""
+    n = gains.shape[-1]
+    discount = 1.0 / jnp.log2(jnp.arange(n, dtype=jnp.float32) + 2.0)
+    discount = jnp.where(jnp.arange(n) < top_k, discount, 0.0)
+    sorted_gains = -jnp.sort(-jnp.where(mask, gains, 0.0), axis=-1)
+    return (sorted_gains * discount).sum(axis=-1)
+
+
+def _ndcg_kernel(preds: Array, target: Array, mask: Array, top_k: Optional[int] = None) -> Array:
+    """NDCG (reference functional/retrieval/ndcg.py:retrieval_normalized_dcg)."""
+    k = preds.shape[-1] if top_k is None else top_k
+    gains = jnp.where(mask, target, 0).astype(jnp.float32)
+    dcg = _dcg_tie_averaged(preds, gains, mask, k)
+    ideal = _dcg_ideal(gains, mask, k)
+    return jnp.where(ideal > 0, dcg / jnp.maximum(ideal, 1e-38), 0.0)
+
+
+def _auroc_kernel(preds: Array, target: Array, mask: Array, top_k: Optional[int] = None) -> Array:
+    """Per-query binary AUROC via tie-averaged rank statistics (Mann-Whitney U),
+    restricted to the top-k documents (reference functional/retrieval/auroc.py:16).
+
+    AUROC = (R_pos - n_pos(n_pos+1)/2) / (n_pos * n_neg) with R_pos the sum of
+    tie-averaged ascending ranks of the positives.
+    """
+    n = preds.shape[-1]
+    k = n if top_k is None else top_k
+    ranked_t, rmask = _ranked_by_preds(preds, target, mask)
+    ranked_p = jnp.take_along_axis(jnp.where(mask, preds, -jnp.inf), jnp.argsort(-jnp.where(mask, preds, -jnp.inf), axis=-1, stable=True), axis=-1)
+    within = _positions_within_k(rmask, k)
+    ranks = _tie_average_ranks(ranked_p, within)
+    pos = (ranked_t > 0) & within
+    neg = (ranked_t == 0) & within
+    n_pos = pos.sum(axis=-1).astype(jnp.float32)
+    n_neg = neg.sum(axis=-1).astype(jnp.float32)
+    r_pos = jnp.where(pos, ranks, 0.0).sum(axis=-1)
+    auc = (r_pos - n_pos * (n_pos + 1) / 2) / jnp.maximum(n_pos * n_neg, 1.0)
+    return jnp.where((n_pos > 0) & (n_neg > 0), auc, 0.0)

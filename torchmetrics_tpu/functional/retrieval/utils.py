@@ -1,0 +1,126 @@
+"""Retrieval machinery: input checks, query padding, tie-aware rank helpers.
+
+TPU-native core (SURVEY §7 step 6): the reference processes queries with a host loop
+over ``torch.split`` chunks (retrieval/base.py:148-182). Here queries are padded into a
+dense ``(Q, L)`` matrix with a validity mask; every metric is a vectorized masked
+kernel over that matrix — one XLA call for the whole corpus, no host loop.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+NEG_INF = -jnp.inf
+
+
+def _check_retrieval_functional_inputs(preds, target, allow_non_binary_target: bool = False) -> Tuple[Array, Array]:
+    """Validate a single query's (preds, target) (reference utilities/checks.py:44)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if preds.shape != target.shape:
+        raise ValueError("`preds` and `target` must be of the same shape")
+    if preds.size == 0:
+        raise ValueError("`preds` and `target` must be non-empty")
+    if not jnp.issubdtype(preds.dtype, jnp.floating):
+        raise ValueError("`preds` must be a tensor of floats")
+    if not (jnp.issubdtype(target.dtype, jnp.integer) or target.dtype == jnp.bool_):
+        raise ValueError("`target` must be a tensor of booleans or integers")
+    target = target.astype(jnp.int32)
+    if not allow_non_binary_target and (int(target.max()) > 1 or int(target.min()) < 0):
+        raise ValueError("`target` must contain `binary` values")
+    return preds.reshape(-1).astype(jnp.float32), target.reshape(-1)
+
+
+def _check_retrieval_inputs(
+    indexes, preds, target, allow_non_binary_target: bool = False, ignore_index: Optional[int] = None
+) -> Tuple[Array, Array, Array]:
+    """Validate (indexes, preds, target) and apply ignore_index filtering
+    (reference utilities/checks.py:64)."""
+    indexes = jnp.asarray(indexes)
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if indexes.shape != preds.shape or preds.shape != target.shape:
+        raise ValueError("`indexes`, `preds` and `target` must be of the same shape")
+    if not jnp.issubdtype(indexes.dtype, jnp.integer):
+        raise ValueError("`indexes` must be a tensor of long integers")
+    if not jnp.issubdtype(preds.dtype, jnp.floating):
+        raise ValueError("`preds` must be a tensor of floats")
+    if not (jnp.issubdtype(target.dtype, jnp.integer) or target.dtype == jnp.bool_):
+        raise ValueError("`target` must be a tensor of booleans or integers")
+    indexes = indexes.reshape(-1)
+    preds = preds.reshape(-1).astype(jnp.float32)
+    target = target.reshape(-1).astype(jnp.int32)
+    if ignore_index is not None:
+        keep = np.asarray(target) != ignore_index  # host filter: cat-states are host lists anyway
+        indexes, preds, target = indexes[keep], preds[keep], target[keep]
+    if preds.size == 0:
+        raise ValueError("`indexes`, `preds` and `target` must be non-empty")
+    if not allow_non_binary_target and (int(target.max()) > 1 or int(target.min()) < 0):
+        raise ValueError("`target` must contain `binary` values")
+    return indexes, preds, target
+
+
+def _pad_queries(indexes, preds, target) -> Tuple[Array, Array, Array]:
+    """Group flat (indexes, preds, target) into padded ``(Q, L)`` arrays + bool mask.
+
+    Host-side scatter (numpy) — runs once per ``compute``; every downstream metric is
+    then a single static-shape XLA kernel.
+    """
+    idx = np.asarray(indexes)
+    p = np.asarray(preds)
+    t = np.asarray(target)
+    uniq, inv, counts = np.unique(idx, return_inverse=True, return_counts=True)
+    q = uniq.shape[0]
+    max_len = int(counts.max()) if q else 1
+    order = np.argsort(inv, kind="stable")
+    inv_sorted = inv[order]
+    pos_in_query = np.arange(idx.shape[0]) - np.concatenate([[0], np.cumsum(counts)[:-1]])[inv_sorted]
+    preds2d = np.zeros((q, max_len), np.float32)
+    target2d = np.zeros((q, max_len), t.dtype)
+    mask2d = np.zeros((q, max_len), bool)
+    preds2d[inv_sorted, pos_in_query] = p[order]
+    target2d[inv_sorted, pos_in_query] = t[order]
+    mask2d[inv_sorted, pos_in_query] = True
+    return jnp.asarray(preds2d), jnp.asarray(target2d), jnp.asarray(mask2d)
+
+
+def _ranked_by_preds(preds: Array, target: Array, mask: Array) -> Tuple[Array, Array]:
+    """Per-row targets/mask reordered by descending preds; padded entries sink last."""
+    eff = jnp.where(mask, preds, NEG_INF)
+    order = jnp.argsort(-eff, axis=-1, stable=True)
+    return jnp.take_along_axis(target, order, axis=-1), jnp.take_along_axis(mask, order, axis=-1)
+
+
+def _row_segment_ids(sorted_vals: Array) -> Array:
+    """Tie-group ids per row for row-wise sorted values (0-based, ascending)."""
+    first = jnp.ones_like(sorted_vals[..., :1], bool)
+    change = sorted_vals[..., 1:] != sorted_vals[..., :-1]
+    return jnp.cumsum(jnp.concatenate([first, change], axis=-1).astype(jnp.int32), axis=-1) - 1
+
+
+def _tie_average_ranks(preds: Array, mask: Array) -> Array:
+    """Average ranks (1-based, ascending preds) with ties averaged, per row.
+
+    Padded entries get rank 0 and must be excluded by the caller via ``mask``.
+    """
+    n = preds.shape[-1]
+    eff = jnp.where(mask, preds, NEG_INF)  # padded sort first (ascending)
+    order = jnp.argsort(eff, axis=-1, stable=True)
+    sorted_vals = jnp.take_along_axis(eff, order, axis=-1)
+    seg = _row_segment_ids(sorted_vals)
+    ordinal = jnp.arange(1, n + 1, dtype=jnp.float32)
+    seg_sum = jax.vmap(lambda s, v: jax.ops.segment_sum(v, s, num_segments=n))(seg, jnp.broadcast_to(ordinal, seg.shape))
+    seg_cnt = jax.vmap(lambda s: jax.ops.segment_sum(jnp.ones(n, jnp.float32), s, num_segments=n))(seg)
+    avg_per_seg = seg_sum / jnp.maximum(seg_cnt, 1.0)
+    avg_sorted = jnp.take_along_axis(avg_per_seg, seg, axis=-1)
+    ranks = jnp.zeros_like(avg_sorted)
+    ranks = jnp.put_along_axis(ranks, order, avg_sorted, axis=-1, inplace=False)
+    # shift so ranks count only real entries (padded occupy the lowest ordinals)
+    n_pad = (~mask).sum(axis=-1, keepdims=True).astype(jnp.float32)
+    return jnp.where(mask, ranks - n_pad, 0.0)

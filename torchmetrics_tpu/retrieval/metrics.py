@@ -1,0 +1,217 @@
+"""Retrieval metric classes (reference ``retrieval/*.py``), all over the padded-kernel
+base. One class per reference file; top_k/adaptive_k knobs match the reference."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..functional.retrieval._kernels import (
+    _ap_kernel,
+    _auroc_kernel,
+    _fall_out_kernel,
+    _hit_rate_kernel,
+    _ndcg_kernel,
+    _precision_kernel,
+    _r_precision_kernel,
+    _recall_kernel,
+    _rr_kernel,
+)
+from .base import RetrievalMetric, _retrieval_aggregate
+
+Array = jax.Array
+
+
+def _validate_top_k(top_k: Optional[int]) -> None:
+    if top_k is not None and not (isinstance(top_k, int) and top_k > 0):
+        raise ValueError("`top_k` has to be a positive integer or None")
+
+
+class _TopKRetrievalMetric(RetrievalMetric):
+    """Shared top_k plumbing."""
+
+    def __init__(self, empty_target_action: str = "neg", ignore_index: Optional[int] = None,
+                 top_k: Optional[int] = None, aggregation: Union[str, Callable] = "mean", **kwargs: Any) -> None:
+        super().__init__(empty_target_action, ignore_index, aggregation, **kwargs)
+        _validate_top_k(top_k)
+        self.top_k = top_k
+
+
+class RetrievalMAP(_TopKRetrievalMetric):
+    """Mean Average Precision (reference retrieval/average_precision.py:29)."""
+
+    def _metric_padded(self, preds, target, mask):
+        return _ap_kernel(preds, target, mask, self.top_k)
+
+
+class RetrievalMRR(_TopKRetrievalMetric):
+    """Mean Reciprocal Rank (reference retrieval/reciprocal_rank.py:29)."""
+
+    def _metric_padded(self, preds, target, mask):
+        return _rr_kernel(preds, target, mask, self.top_k)
+
+
+class RetrievalPrecision(_TopKRetrievalMetric):
+    """Precision@k (reference retrieval/precision.py:29)."""
+
+    def __init__(self, empty_target_action: str = "neg", ignore_index: Optional[int] = None,
+                 top_k: Optional[int] = None, adaptive_k: bool = False,
+                 aggregation: Union[str, Callable] = "mean", **kwargs: Any) -> None:
+        super().__init__(empty_target_action, ignore_index, top_k, aggregation, **kwargs)
+        if not isinstance(adaptive_k, bool):
+            raise ValueError("`adaptive_k` has to be a boolean")
+        self.adaptive_k = adaptive_k
+
+    def _metric_padded(self, preds, target, mask):
+        return _precision_kernel(preds, target, mask, self.top_k, self.adaptive_k)
+
+
+class RetrievalRecall(_TopKRetrievalMetric):
+    """Recall@k (reference retrieval/recall.py:29)."""
+
+    def _metric_padded(self, preds, target, mask):
+        return _recall_kernel(preds, target, mask, self.top_k)
+
+
+class RetrievalHitRate(_TopKRetrievalMetric):
+    """HitRate@k (reference retrieval/hit_rate.py:29)."""
+
+    def _metric_padded(self, preds, target, mask):
+        return _hit_rate_kernel(preds, target, mask, self.top_k)
+
+
+class RetrievalFallOut(_TopKRetrievalMetric):
+    """FallOut@k (reference retrieval/fall_out.py:31). Lower is better; the empty-query
+    policy keys on queries with no NEGATIVE targets."""
+
+    higher_is_better = False
+
+    def __init__(self, empty_target_action: str = "pos", ignore_index: Optional[int] = None,
+                 top_k: Optional[int] = None, aggregation: Union[str, Callable] = "mean", **kwargs: Any) -> None:
+        super().__init__(empty_target_action, ignore_index, top_k, aggregation, **kwargs)
+
+    def _empty_query_mask(self, target2d, mask):
+        return (jnp.where(mask, 1 - target2d, 0) > 0).sum(axis=-1) == 0
+
+    def _metric_padded(self, preds, target, mask):
+        return _fall_out_kernel(preds, target, mask, self.top_k)
+
+
+class RetrievalRPrecision(RetrievalMetric):
+    """R-Precision (reference retrieval/r_precision.py:28)."""
+
+    def _metric_padded(self, preds, target, mask):
+        return _r_precision_kernel(preds, target, mask)
+
+
+class RetrievalNormalizedDCG(_TopKRetrievalMetric):
+    """NDCG@k; non-binary gains allowed (reference retrieval/ndcg.py:29)."""
+
+    allow_non_binary_target = True
+
+    def _metric_padded(self, preds, target, mask):
+        return _ndcg_kernel(preds, target, mask, self.top_k)
+
+
+class RetrievalAUROC(_TopKRetrievalMetric):
+    """Per-query AUROC (reference retrieval/auroc.py:29)."""
+
+    def __init__(self, empty_target_action: str = "neg", ignore_index: Optional[int] = None,
+                 top_k: Optional[int] = None, max_fpr: Optional[float] = None,
+                 aggregation: Union[str, Callable] = "mean", **kwargs: Any) -> None:
+        super().__init__(empty_target_action, ignore_index, top_k, aggregation, **kwargs)
+        if max_fpr is not None and not (isinstance(max_fpr, float) and 0 < max_fpr <= 1):
+            raise ValueError(f"Argument `max_fpr` should be a float in range (0, 1], but got: {max_fpr}")
+        self.max_fpr = max_fpr
+
+    def _metric_padded(self, preds, target, mask):
+        if self.max_fpr is not None:
+            from ..functional.retrieval import retrieval_auroc
+            import numpy as np
+
+            out = []
+            for q in range(preds.shape[0]):
+                keep = np.asarray(mask[q])
+                out.append(retrieval_auroc(preds[q][keep], target[q][keep], self.top_k, self.max_fpr))
+            return jnp.stack(out)
+        return _auroc_kernel(preds, target, mask, self.top_k)
+
+
+class RetrievalPrecisionRecallCurve(RetrievalMetric):
+    """Averaged precision/recall @ k=1..max_k curves
+    (reference retrieval/precision_recall_curve.py:64)."""
+
+    higher_is_better = None
+
+    def __init__(self, max_k: Optional[int] = None, adaptive_k: bool = False,
+                 empty_target_action: str = "neg", ignore_index: Optional[int] = None, **kwargs: Any) -> None:
+        super().__init__(empty_target_action, ignore_index, "mean", **kwargs)
+        if max_k is not None and not (isinstance(max_k, int) and max_k > 0):
+            raise ValueError("`max_k` has to be a positive integer or None")
+        if not isinstance(adaptive_k, bool):
+            raise ValueError("`adaptive_k` has to be a boolean")
+        self.max_k = max_k
+        self.adaptive_k = adaptive_k
+
+    def _compute(self, state):
+        from ..functional.retrieval.utils import _pad_queries
+
+        preds2d, target2d, mask = _pad_queries(state["indexes"], state["preds"], state["target"])
+        q, n = preds2d.shape
+        max_k = self.max_k or n
+        if self.adaptive_k and max_k > n:
+            max_k = n
+        ks = jnp.arange(1, max_k + 1)
+        tgt = jnp.where(preds2d > 0, target2d, 0)
+        from ..functional.retrieval.utils import _ranked_by_preds
+
+        ranked, rmask = _ranked_by_preds(preds2d, tgt, mask)
+        rel = ((ranked > 0) & rmask).astype(jnp.float32)
+        cum = jnp.cumsum(rel, axis=-1)
+        cum_k = cum[:, jnp.minimum(ks - 1, n - 1)]  # (Q, K)
+        denom = jnp.minimum(ks.astype(jnp.float32), mask.sum(-1, keepdims=True).astype(jnp.float32)) if self.adaptive_k else ks.astype(jnp.float32)[None, :]
+        precision_q = cum_k / denom
+        total = (jnp.where(mask, target2d, 0) > 0).sum(axis=-1, keepdims=True).astype(jnp.float32)
+        recall_q = jnp.where(total > 0, cum_k / jnp.maximum(total, 1.0), 0.0)
+        empty = self._empty_query_mask(target2d, mask)
+        if self.empty_target_action == "error" and bool(empty.any()):
+            raise ValueError("`compute` method was provided with a query with no positive target.")
+        if self.empty_target_action == "pos":
+            precision_q = jnp.where(empty[:, None], 1.0, precision_q)
+            recall_q = jnp.where(empty[:, None], 1.0, recall_q)
+        elif self.empty_target_action == "neg":
+            precision_q = jnp.where(empty[:, None], 0.0, precision_q)
+            recall_q = jnp.where(empty[:, None], 0.0, recall_q)
+        elif self.empty_target_action == "skip":
+            keep = ~empty
+            precision_q, recall_q = precision_q[keep], recall_q[keep]
+            if precision_q.shape[0] == 0:
+                z = jnp.zeros(max_k)
+                return z, z, ks
+        return precision_q.mean(axis=0), recall_q.mean(axis=0), ks
+
+
+class RetrievalRecallAtFixedPrecision(RetrievalPrecisionRecallCurve):
+    """Max recall@k with averaged precision@k >= floor
+    (reference retrieval/precision_recall_curve.py:297)."""
+
+    higher_is_better = True
+
+    def __init__(self, min_precision: float = 0.0, max_k: Optional[int] = None, adaptive_k: bool = False,
+                 empty_target_action: str = "neg", ignore_index: Optional[int] = None, **kwargs: Any) -> None:
+        super().__init__(max_k, adaptive_k, empty_target_action, ignore_index, **kwargs)
+        if not (isinstance(min_precision, float) and 0.0 <= min_precision <= 1.0):
+            raise ValueError("`min_precision` has to be a positive float between 0 and 1")
+        self.min_precision = min_precision
+
+    def _compute(self, state):
+        precision, recall, ks = super()._compute(state)
+        feasible = precision >= self.min_precision
+        best_r = jnp.where(feasible, recall, -jnp.inf).max()
+        has = bool(feasible.any())
+        if not has:
+            return jnp.zeros(()), jnp.asarray(self.max_k or int(ks[-1]))
+        best_k = ks[int(jnp.argmax(jnp.where(feasible, recall, -jnp.inf)))]
+        return best_r, best_k
