@@ -1,0 +1,124 @@
+"""Round-2 ADVICE regression tests: ragged-query RetrievalPrecision denominator,
+RetrievalRecallAtFixedPrecision tie-breaking, EER micro/macro averaging, and
+min_recall validation messages."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu.classification import EER, MulticlassEER
+from torchmetrics_tpu.classification.precision_fixed_recall import (
+    BinaryPrecisionAtFixedRecall,
+    MulticlassPrecisionAtFixedRecall,
+)
+from torchmetrics_tpu.functional.classification.eer import eer, multiclass_eer
+from torchmetrics_tpu.retrieval import RetrievalPrecision, RetrievalRecallAtFixedPrecision
+
+
+def test_retrieval_precision_ragged_queries_default_topk():
+    """top_k=None must divide by each query's own document count (ADVICE high):
+    query A: 3 docs 1 relevant → 1/3; query B: 6 docs 4 relevant → 4/6; mean = 1/2."""
+    indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1, 1, 1])
+    preds = jnp.asarray([0.9, 0.8, 0.7, 0.9, 0.8, 0.7, 0.6, 0.5, 0.4])
+    target = jnp.asarray([1, 0, 0, 1, 1, 1, 1, 0, 0])
+    m = RetrievalPrecision()
+    m.update(preds, target, indexes=indexes)
+    assert np.isclose(float(m.compute()), 0.5)
+
+
+def test_retrieval_precision_explicit_topk_unchanged():
+    indexes = jnp.asarray([0, 0, 0, 0])
+    preds = jnp.asarray([0.9, 0.8, 0.7, 0.6])
+    target = jnp.asarray([1, 1, 0, 0])
+    m = RetrievalPrecision(top_k=2)
+    m.update(preds, target, indexes=indexes)
+    assert np.isclose(float(m.compute()), 1.0)
+
+
+def test_recall_at_fixed_precision_prefers_largest_k_tie():
+    """Reference max((r, k)) picks the LARGEST k among max-recall ties (ADVICE low)."""
+    indexes = jnp.asarray([0, 0, 0, 0])
+    preds = jnp.asarray([0.9, 0.8, 0.7, 0.6])
+    target = jnp.asarray([1, 1, 0, 0])
+    # recall@k = [0.5, 1, 1, 1]; precision@k = [1, 1, 2/3, 0.5]; min_precision=0.6
+    # feasible ks = 1,2,3; max recall 1.0 at k=2 and k=3 → best_k must be 3
+    m = RetrievalRecallAtFixedPrecision(min_precision=0.6, max_k=4)
+    m.update(preds, target, indexes=indexes)
+    r, k = m.compute()
+    assert np.isclose(float(r), 1.0)
+    assert int(k) == 3
+
+
+def test_recall_at_fixed_precision_zero_recall_clamps_to_max_k():
+    indexes = jnp.asarray([0, 0, 0])
+    preds = jnp.asarray([0.9, 0.8, 0.7])
+    target = jnp.asarray([0, 0, 1])
+    # only relevant doc ranked last: recall@k = [0,0,1], precision@k = [0,0,1/3]
+    # min_precision=0.9 infeasible everywhere → recall 0, best_k = max_k
+    m = RetrievalRecallAtFixedPrecision(min_precision=0.9, max_k=3)
+    m.update(preds, target, indexes=indexes)
+    r, k = m.compute()
+    assert float(r) == 0.0
+    assert int(k) == 3
+
+
+def _mc_scores(n=60, c=4, seed=7):
+    rng = np.random.default_rng(seed)
+    logits = rng.normal(size=(n, c)).astype(np.float32)
+    preds = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+    target = rng.integers(0, c, n)
+    return jnp.asarray(preds), jnp.asarray(target)
+
+
+def test_multiclass_eer_micro_scalar():
+    preds, target = _mc_scores()
+    out = multiclass_eer(preds, target, num_classes=4, thresholds=20, average="micro")
+    assert out.ndim == 0
+    # micro == binary EER over the one-hot flattened problem
+    from torchmetrics_tpu.functional.classification.eer import binary_eer
+
+    onehot = jnp.zeros((target.shape[0], 4)).at[jnp.arange(target.shape[0]), target].set(1)
+    ref = binary_eer(preds.ravel(), onehot.ravel().astype(jnp.int32), thresholds=20)
+    assert np.isclose(float(out), float(ref), atol=1e-6)
+
+
+def test_multiclass_eer_macro_scalar_and_none_per_class():
+    preds, target = _mc_scores()
+    macro = multiclass_eer(preds, target, num_classes=4, thresholds=20, average="macro")
+    per_class = multiclass_eer(preds, target, num_classes=4, thresholds=20, average=None)
+    assert macro.ndim == 0
+    assert per_class.shape == (4,)
+
+
+@pytest.mark.parametrize("average", ["micro", "macro"])
+def test_multiclass_eer_class_matches_functional(average):
+    preds, target = _mc_scores()
+    m = MulticlassEER(num_classes=4, average=average, thresholds=20)
+    m.update(preds, target)
+    ref = multiclass_eer(preds, target, num_classes=4, thresholds=20, average=average)
+    assert np.isclose(float(m.compute()), float(ref), atol=1e-6)
+
+
+def test_eer_facade_plumbs_average():
+    preds, target = _mc_scores()
+    m = EER(task="multiclass", num_classes=4, average="micro", thresholds=20)
+    m.update(preds, target)
+    f = eer(preds, target, task="multiclass", num_classes=4, average="micro", thresholds=20)
+    assert np.isclose(float(m.compute()), float(f), atol=1e-6)
+
+
+def test_multiclass_eer_invalid_average_raises():
+    with pytest.raises(ValueError, match="average"):
+        MulticlassEER(num_classes=4, average="weighted")
+
+
+@pytest.mark.parametrize(
+    "ctor",
+    [
+        lambda: BinaryPrecisionAtFixedRecall(min_recall=1.5),
+        lambda: MulticlassPrecisionAtFixedRecall(num_classes=3, min_recall=-0.1),
+    ],
+)
+def test_precision_at_fixed_recall_error_names_min_recall(ctor):
+    with pytest.raises(ValueError, match="min_recall"):
+        ctor()

@@ -1,0 +1,142 @@
+"""Round-2 regression tests for the sync planes (VERDICT weak #2/#7).
+
+Covers: n-way "mean" folds (stacked reduction, not sequential pairwise), the
+injectable ``dist_sync_fn`` process plane (plane 2), and the count-weighted
+``merge_state`` chain — reference semantics at metric.py:481,525-540.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu import Metric
+from torchmetrics_tpu.parallel import sync as _sync
+
+
+class DummyMean(Metric):
+    """A metric whose single state uses the public ``dist_reduce_fx="mean"`` contract."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("v", default=jnp.zeros(()), dist_reduce_fx="mean")
+
+    def _batch_state(self, x):
+        return {"v": jnp.asarray(x, jnp.float32).mean()}
+
+    def _compute(self, state):
+        return state["v"]
+
+
+def test_fold_gathered_mean_three_ranks():
+    gathered = [jnp.asarray(1.0), jnp.asarray(2.0), jnp.asarray(6.0)]
+    out = _sync._fold_gathered(gathered, "mean")
+    assert np.isclose(float(out), 3.0)  # ((1+2)/2+6)/2 = 3.75 would be the pairwise bug
+
+
+def test_fold_gathered_all_tags():
+    gathered = [jnp.asarray([1.0, 4.0]), jnp.asarray([2.0, 2.0]), jnp.asarray([6.0, 0.0])]
+    assert np.allclose(np.asarray(_sync._fold_gathered(gathered, "sum")), [9.0, 6.0])
+    assert np.allclose(np.asarray(_sync._fold_gathered(gathered, "mean")), [3.0, 2.0])
+    assert np.allclose(np.asarray(_sync._fold_gathered(gathered, "max")), [6.0, 4.0])
+    assert np.allclose(np.asarray(_sync._fold_gathered(gathered, "min")), [1.0, 0.0])
+    assert np.allclose(np.asarray(_sync._fold_gathered(gathered, "cat")), [1, 4, 2, 2, 6, 0])
+
+
+def test_update_running_mean_exact():
+    """Sequential updates of a mean state equal the mean over all batches."""
+    m = DummyMean()
+    batches = [1.0, 2.0, 6.0, 11.0]
+    for b in batches:
+        m.update(np.asarray(b))
+    assert np.isclose(float(m.compute()), np.mean(batches))
+
+
+def test_forward_running_mean_exact():
+    m = DummyMean()
+    batches = [3.0, 5.0, 13.0]
+    for b in batches:
+        m(np.asarray(b))
+    assert np.isclose(float(m.compute()), np.mean(batches))
+
+
+def test_merge_state_mean_three_participants():
+    """merge_state chains stay exact for mean states (count-weighted fold)."""
+    ms = [DummyMean() for _ in range(3)]
+    vals = [1.0, 2.0, 6.0]
+    for m, v in zip(ms, vals):
+        m.update(np.asarray(v))
+    ms[0].merge_state(ms[1])
+    ms[0].merge_state(ms[2])
+    assert np.isclose(float(ms[0].compute()), np.mean(vals))
+
+
+def test_merge_state_mean_weighted_by_update_count():
+    a, b = DummyMean(), DummyMean()
+    for v in (1.0, 2.0, 3.0):
+        a.update(np.asarray(v))
+    b.update(np.asarray(10.0))
+    a.merge_state(b)
+    assert np.isclose(float(a.compute()), np.mean([1.0, 2.0, 3.0, 10.0]))
+
+
+def _fake_gather_factory(world_size: int):
+    """dist_sync_fn stub: pretend each rank holds value + rank (reference seam
+    metric.py:133) so the fold logic of plane 2 is exercised without processes."""
+
+    def fake_gather(value, process_group=None):
+        return [jnp.asarray(value) + i for i in range(world_size)]
+
+    return fake_gather
+
+
+@pytest.mark.parametrize("world", [2, 3, 4])
+def test_process_sync_mean_with_fake_gather(world):
+    m = DummyMean(dist_sync_fn=_fake_gather_factory(world))
+    m.update(np.asarray(4.0))
+    m.sync(distributed_available=lambda: True)
+    # ranks hold 4, 5, ... 4+world-1 → mean = 4 + (world-1)/2
+    assert np.isclose(float(m._state["v"]), 4.0 + (world - 1) / 2)
+    m.unsync()
+    assert np.isclose(float(m._state["v"]), 4.0)
+
+
+@pytest.mark.parametrize("world", [2, 3])
+def test_process_sync_sum_and_compute_restores(world):
+    from tests.test_metric_base import DummySum
+
+    m = DummySum(dist_sync_fn=_fake_gather_factory(world), distributed_available_fn=lambda: True)
+    m.update(np.asarray([1.0, 2.0]))  # local sum = 3
+    val = m.compute()  # sync → sum over ranks → unsync
+    expect = sum(3.0 + i for i in range(world))
+    assert np.isclose(float(val), expect)
+    assert np.isclose(float(m._state["x"]), 3.0)  # local state restored
+
+
+def test_process_sync_cat_fold():
+    def fake_gather(value, process_group=None):
+        return [jnp.asarray(value), jnp.asarray(value) * 10]
+
+    out = _sync.process_sync({"x": jnp.asarray([1.0, 2.0])}, {"x": "cat"}, dist_sync_fn=fake_gather)
+    assert np.allclose(np.asarray(out["x"]), [1.0, 2.0, 10.0, 20.0])
+
+
+def test_weighted_mean_zero_total_keeps_left():
+    out = _sync.weighted_mean(jnp.asarray(5.0), jnp.asarray(7.0), 0.0, 0.0)
+    assert np.isclose(float(out), 5.0)
+
+
+def test_merge_state_dict_chain_exact():
+    """Dict merges fold weight 1 into the count so chains stay exact (review fix)."""
+    m = DummyMean()
+    m.update(np.asarray(10.0))
+    m.merge_state({"v": jnp.asarray(20.0)})
+    m.merge_state({"v": jnp.asarray(30.0)})
+    assert np.isclose(float(m.compute()), 20.0)
+
+
+def test_update_state_mean_raises():
+    from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
+
+    m = DummyMean()
+    with pytest.raises(TorchMetricsUserError, match="mean"):
+        m.update_state(m.init_state(), np.asarray(1.0))

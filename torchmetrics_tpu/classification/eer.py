@@ -45,17 +45,22 @@ class MulticlassEER(MulticlassPrecisionRecallCurve):
         self, num_classes: int, average: Optional[str] = None, thresholds=None, ignore_index=None,
         validate_args: bool = True, **kwargs: Any,
     ) -> None:
+        if average not in (None, "none", "micro", "macro"):
+            raise ValueError(f"Expected argument `average` to be one of None, 'micro' or 'macro', but got {average}")
+        # average="micro" changes the STATE (one-hot flattened binary curve), so it must
+        # reach the parent curve class, not just compute (reference classification/eer.py)
         super().__init__(
-            num_classes=num_classes, thresholds=thresholds, average=None, ignore_index=ignore_index,
-            validate_args=validate_args, **kwargs,
+            num_classes=num_classes, thresholds=thresholds, average=average if average == "micro" else None,
+            ignore_index=ignore_index, validate_args=validate_args, **kwargs,
         )
         self.average = average
         self._jittable_compute = False
 
     def _compute(self, state):
-        fpr, tpr, _ = _multiclass_roc_compute(self._curve_state(state), self.num_classes, self.thresholds)
-        out = _eer_compute(fpr, tpr)
-        return out.mean() if self.average == "macro" else out
+        fpr, tpr, _ = _multiclass_roc_compute(
+            self._curve_state(state), self.num_classes, self.thresholds, self.average
+        )
+        return _eer_compute(fpr, tpr)
 
     def plot(self, val=None, ax=None):
         return Metric.plot(self, *([val] if val is not None else []), ax=ax)
@@ -96,6 +101,7 @@ class EER(_ClassificationTaskWrapper):
         thresholds=None,
         num_classes: Optional[int] = None,
         num_labels: Optional[int] = None,
+        average: Optional[str] = None,
         ignore_index: Optional[int] = None,
         validate_args: bool = True,
         **kwargs: Any,
@@ -107,7 +113,7 @@ class EER(_ClassificationTaskWrapper):
         if task == ClassificationTask.MULTICLASS:
             if not isinstance(num_classes, int):
                 raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
-            return MulticlassEER(num_classes, **kwargs)
+            return MulticlassEER(num_classes, average=average, **kwargs)
         if task == ClassificationTask.MULTILABEL:
             if not isinstance(num_labels, int):
                 raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")

@@ -9,10 +9,10 @@ from ..functional.classification.precision_fixed_recall import (
     _multiclass_precision_at_fixed_recall_compute,
     _multilabel_precision_at_fixed_recall_compute,
 )
-from ..functional.classification.recall_fixed_precision import (
-    _binary_recall_at_fixed_precision_arg_validation,
-    _multiclass_recall_at_fixed_precision_arg_validation,
-    _multilabel_recall_at_fixed_precision_arg_validation,
+from ..functional.classification.precision_fixed_recall import (
+    _binary_precision_at_fixed_recall_arg_validation,
+    _multiclass_precision_at_fixed_recall_arg_validation,
+    _multilabel_precision_at_fixed_recall_arg_validation,
 )
 from ..metric import Metric
 from ..utilities.enums import ClassificationTask
@@ -35,7 +35,7 @@ class BinaryPrecisionAtFixedRecall(BinaryPrecisionRecallCurve):
     ) -> None:
         super().__init__(thresholds=thresholds, ignore_index=ignore_index, validate_args=False, **kwargs)
         if validate_args:
-            _binary_recall_at_fixed_precision_arg_validation(min_recall, thresholds, ignore_index)
+            _binary_precision_at_fixed_recall_arg_validation(min_recall, thresholds, ignore_index)
         self.validate_args = validate_args
         self.min_recall = min_recall
         self._jittable_compute = False
@@ -62,7 +62,7 @@ class MulticlassPrecisionAtFixedRecall(MulticlassPrecisionRecallCurve):
             num_classes=num_classes, thresholds=thresholds, ignore_index=ignore_index, validate_args=False, **kwargs
         )
         if validate_args:
-            _multiclass_recall_at_fixed_precision_arg_validation(num_classes, min_recall, thresholds, ignore_index)
+            _multiclass_precision_at_fixed_recall_arg_validation(num_classes, min_recall, thresholds, ignore_index)
         self.validate_args = validate_args
         self.min_recall = min_recall
         self._jittable_compute = False
@@ -91,7 +91,7 @@ class MultilabelPrecisionAtFixedRecall(MultilabelPrecisionRecallCurve):
             num_labels=num_labels, thresholds=thresholds, ignore_index=ignore_index, validate_args=False, **kwargs
         )
         if validate_args:
-            _multilabel_recall_at_fixed_precision_arg_validation(num_labels, min_recall, thresholds, ignore_index)
+            _multilabel_precision_at_fixed_recall_arg_validation(num_labels, min_recall, thresholds, ignore_index)
         self.validate_args = validate_args
         self.min_recall = min_recall
         self._jittable_compute = False

@@ -67,11 +67,10 @@ def multiclass_eer(
         keep = np.asarray(w) == 1
         preds, target = preds[keep], target[keep]
     state = _multiclass_precision_recall_curve_update(preds, target, num_classes, thresholds, w, average)
+    # micro (one-hot flattened binary) and macro (interpolated mean curve) both collapse
+    # to a single curve inside _multiclass_roc_compute (reference eer.py:162)
     fpr, tpr, _ = _multiclass_roc_compute(state, num_classes, thresholds, average)
-    out = _eer_compute(fpr, tpr)
-    if average == "macro":
-        return out.mean()
-    return out
+    return _eer_compute(fpr, tpr)
 
 
 def multilabel_eer(
@@ -87,8 +86,8 @@ def multilabel_eer(
     return _eer_compute(fpr, tpr)
 
 
-def eer(preds, target, task: str, thresholds=None, num_classes=None, num_labels=None, ignore_index=None, validate_args: bool = True):
-    """Task dispatch (reference eer.py facade)."""
+def eer(preds, target, task: str, thresholds=None, num_classes=None, num_labels=None, average=None, ignore_index=None, validate_args: bool = True):
+    """Task dispatch (reference eer.py:225-282 facade, incl. ``average``)."""
     from ...utilities.enums import ClassificationTask
 
     task = ClassificationTask.from_str(task)
@@ -97,7 +96,7 @@ def eer(preds, target, task: str, thresholds=None, num_classes=None, num_labels=
     if task == ClassificationTask.MULTICLASS:
         if not isinstance(num_classes, int):
             raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
-        return multiclass_eer(preds, target, num_classes, thresholds, None, ignore_index, validate_args)
+        return multiclass_eer(preds, target, num_classes, thresholds, average, ignore_index, validate_args)
     if task == ClassificationTask.MULTILABEL:
         if not isinstance(num_labels, int):
             raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")

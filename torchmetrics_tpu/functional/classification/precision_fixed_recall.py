@@ -9,25 +9,23 @@ import jax
 
 from ._operating_point import _apply_over_classes, _masked_lex_best
 from .precision_recall_curve import (
+    _binary_precision_recall_curve_arg_validation,
     _binary_precision_recall_curve_compute,
     _binary_precision_recall_curve_format,
     _binary_precision_recall_curve_tensor_validation,
     _binary_precision_recall_curve_update,
+    _multiclass_precision_recall_curve_arg_validation,
     _multiclass_precision_recall_curve_compute,
     _multiclass_precision_recall_curve_format,
     _multiclass_precision_recall_curve_tensor_validation,
     _multiclass_precision_recall_curve_update,
+    _multilabel_precision_recall_curve_arg_validation,
     _multilabel_precision_recall_curve_compute,
     _multilabel_precision_recall_curve_format,
     _multilabel_precision_recall_curve_tensor_validation,
     _multilabel_precision_recall_curve_update,
 )
-from .recall_fixed_precision import (
-    _binary_recall_at_fixed_precision_arg_validation as _bin_val,
-    _multiclass_recall_at_fixed_precision_arg_validation as _mc_val,
-    _multilabel_recall_at_fixed_precision_arg_validation as _ml_val,
-    _validate_min,
-)
+from .recall_fixed_precision import _validate_min
 
 Array = jax.Array
 
@@ -38,7 +36,18 @@ def _precision_at_recall(precision, recall, thresholds, min_recall: float):
 
 
 def _binary_precision_at_fixed_recall_arg_validation(min_recall, thresholds=None, ignore_index=None) -> None:
-    _bin_val(min_recall, thresholds, ignore_index)
+    _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+    _validate_min("min_recall", min_recall)
+
+
+def _multiclass_precision_at_fixed_recall_arg_validation(num_classes, min_recall, thresholds=None, ignore_index=None) -> None:
+    _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index)
+    _validate_min("min_recall", min_recall)
+
+
+def _multilabel_precision_at_fixed_recall_arg_validation(num_labels, min_recall, thresholds=None, ignore_index=None) -> None:
+    _multilabel_precision_recall_curve_arg_validation(num_labels, thresholds, ignore_index)
+    _validate_min("min_recall", min_recall)
 
 
 def _binary_precision_at_fixed_recall_compute(state, thresholds, min_recall: float):
@@ -71,7 +80,7 @@ def multiclass_precision_at_fixed_recall(
     preds, target, num_classes: int, min_recall: float, thresholds=None, ignore_index=None, validate_args: bool = True
 ):
     if validate_args:
-        _mc_val(num_classes, min_recall, thresholds, ignore_index)
+        _multiclass_precision_at_fixed_recall_arg_validation(num_classes, min_recall, thresholds, ignore_index)
         _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
     preds, target, thresholds, w = _multiclass_precision_recall_curve_format(
         preds, target, num_classes, thresholds, ignore_index
@@ -94,7 +103,7 @@ def multilabel_precision_at_fixed_recall(
     preds, target, num_labels: int, min_recall: float, thresholds=None, ignore_index=None, validate_args: bool = True
 ):
     if validate_args:
-        _ml_val(num_labels, min_recall, thresholds, ignore_index)
+        _multilabel_precision_at_fixed_recall_arg_validation(num_labels, min_recall, thresholds, ignore_index)
         _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
     preds, target, thresholds, w = _multilabel_precision_recall_curve_format(
         preds, target, num_labels, thresholds, ignore_index

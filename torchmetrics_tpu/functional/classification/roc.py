@@ -83,6 +83,8 @@ def _multiclass_roc_compute(
         tns = state[:, :, 0, 0]
         tpr = _safe_divide(tps, tps + fns)[::-1].T
         fpr = _safe_divide(fps, fps + tns)[::-1].T
+        if average == "macro":
+            return _macro_interpolate_curves(fpr, tpr, jnp.tile(thresholds[::-1], num_classes), num_classes)
         return fpr, tpr, thresholds[::-1]
     fpr_list, tpr_list, thres_list = [], [], []
     for i in range(num_classes):
@@ -90,7 +92,22 @@ def _multiclass_roc_compute(
         fpr_list.append(f)
         tpr_list.append(t)
         thres_list.append(th)
+    if average == "macro":
+        return _macro_interpolate_curves(fpr_list, tpr_list, jnp.concatenate(thres_list), num_classes)
     return fpr_list, tpr_list, thres_list
+
+
+def _macro_interpolate_curves(fpr, tpr, thres: Array, num_classes: int):
+    """Macro curve aggregation (reference roc.py:187-198): interpolate every classwise
+    curve onto the union of FPR support points and average the TPRs."""
+    from ...utilities.compute import interp
+
+    thres = -jnp.sort(-thres)
+    mean_fpr = jnp.sort(jnp.concatenate([jnp.ravel(f) for f in fpr]) if isinstance(fpr, list) else fpr.ravel())
+    mean_tpr = jnp.zeros_like(mean_fpr)
+    for i in range(num_classes):
+        mean_tpr = mean_tpr + interp(mean_fpr, fpr[i], tpr[i])
+    return mean_fpr, mean_tpr / num_classes, thres
 
 
 def multiclass_roc(

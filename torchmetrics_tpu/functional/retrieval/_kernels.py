@@ -57,7 +57,12 @@ def _precision_kernel(
     tgt = jnp.where(preds > 0, target, 0)
     ranked, rmask = _ranked_by_preds(preds, tgt, mask)
     rel = ((ranked > 0) & _positions_within_k(rmask, k)).sum(axis=-1).astype(jnp.float32)
-    if adaptive_k:
+    if top_k is None:
+        # reference sets top_k to each query's document count when unset
+        # (functional/retrieval/precision.py:20) — the denominator is the per-row
+        # valid count, NOT the padded matrix width
+        denom = n_valid
+    elif adaptive_k:
         denom = jnp.minimum(float(k), n_valid)
     else:
         denom = jnp.full_like(n_valid, float(k))

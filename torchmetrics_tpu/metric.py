@@ -207,6 +207,14 @@ class Metric:
                 f"{type(self).__name__} holds dynamic-length concat states and cannot run fully in-graph; "
                 "use the stateful API or a binned/static variant."
             )
+        if not self._has_custom_merge() and any(fx == "mean" for fx in self._reductions.values()):
+            # a bare mean state cannot fold statelessly — without an update count the
+            # repeated (a+b)/2 fold diverges from the stateful API's exact running mean
+            raise TorchMetricsUserError(
+                f"{type(self).__name__} has a 'mean'-reduced state, which cannot fold in-graph "
+                "without an update count. Keep sum+weight states instead (see MeanMetric) or "
+                "override `_merge`."
+            )
         return self._merge(state, self._batch_state(*args, **kwargs))
 
     def compute_state(self, state: StateDict) -> Any:
@@ -229,11 +237,14 @@ class Metric:
         if key not in self._jit_cache:
             list_names = set(self._list_state_names)
 
-            def fn(tensor_state, *args, **kwargs):
+            def fn(tensor_state, n_prev, *args, **kwargs):
                 bs = self._batch_state(*args, **kwargs)
                 appends = {k: v for k, v in bs.items() if k in list_names}
                 bs_t = {k: v for k, v in bs.items() if k not in list_names}
-                new_t = {k: _sync.pairwise_merge(self._reductions.get(k), tensor_state[k], v) for k, v in bs_t.items()} if not self._has_custom_merge() else None
+                # n_prev (prior update count, traced) makes "mean" states an exact
+                # running mean over updates (reference metric.py:481); other tags
+                # ignore the weights
+                new_t = {k: _sync.pairwise_merge(self._reductions.get(k), tensor_state[k], v, weights=(n_prev, 1.0)) for k, v in bs_t.items()} if not self._has_custom_merge() else None
                 if new_t is None:
                     new_t = self._merge({**tensor_state}, bs_t)
                 # keep state dtype stable under merge promotion (set_dtype semantics)
@@ -258,7 +269,8 @@ class Metric:
             )
         args, kwargs = self._prepare_inputs(*args, **kwargs)
         tensors, _ = self._split_tensor_list(self._state)
-        new_t, appends = self._get_update_fn()(tensors, *args, **kwargs)
+        n_prev = jnp.asarray(float(self._update_count), jnp.float32)
+        new_t, appends = self._get_update_fn()(tensors, n_prev, *args, **kwargs)
         for k, v in new_t.items():
             self._state[k] = v
         for k, v in appends.items():
@@ -290,12 +302,13 @@ class Metric:
         if key not in self._jit_cache:
             list_names = set(self._list_state_names)
 
-            def fn(tensor_state, *args, **kwargs):
+            def fn(tensor_state, n_prev, *args, **kwargs):
                 bs = self._batch_state(*args, **kwargs)
                 appends = {k: v for k, v in bs.items() if k in list_names}
                 bs_t = {k: v for k, v in bs.items() if k not in list_names}
                 new_t = self._merge(dict(tensor_state), bs_t) if self._has_custom_merge() else {
-                    k: _sync.pairwise_merge(self._reductions.get(k), tensor_state[k], v) for k, v in bs_t.items()
+                    k: _sync.pairwise_merge(self._reductions.get(k), tensor_state[k], v, weights=(n_prev, 1.0))
+                    for k, v in bs_t.items()
                 }
                 new_t = {k: jnp.asarray(v).astype(tensor_state[k].dtype) if k in tensor_state else v for k, v in new_t.items()}
                 for k, v in tensor_state.items():
@@ -309,7 +322,9 @@ class Metric:
                 return new_t, appends, val, batch_full
 
             self._jit_cache[key] = jax.jit(fn, donate_argnums=0) if (self._enable_jit and self._jittable_compute) else fn
-        new_t, appends, val, batch_full = self._jit_cache[key](self._split_tensor_list(self._state)[0], *args, **kwargs)
+        new_t, appends, val, batch_full = self._jit_cache[key](
+            self._split_tensor_list(self._state)[0], jnp.asarray(float(self._update_count), jnp.float32), *args, **kwargs
+        )
         for k, v in new_t.items():
             self._state[k] = v
         for k, v in appends.items():
@@ -441,14 +456,27 @@ class Metric:
             raise ValueError("Expected incoming state to be a dict or an instance of Metric")
         if self._is_synced:
             raise TorchMetricsUserError("The Metric shouldn't be synced when performing ``merge_state``.")
-        merged = self._merge(
-            {k: v for k, v in self._state.items()},
-            {k: incoming[k] for k in incoming},
-        )
+        if self._has_custom_merge():
+            merged = self._merge(
+                {k: v for k, v in self._state.items()},
+                {k: incoming[k] for k in incoming},
+            )
+        else:
+            # weight "mean" states by each side's update count so chained merges stay
+            # exact for any number of participants (a dict carries weight 1)
+            incoming_count = incoming_state._update_count if isinstance(incoming_state, Metric) else 1
+            merged = _sync.merge_states(
+                {k: v for k, v in self._state.items()},
+                {k: incoming[k] for k in incoming},
+                self._reductions,
+                weights=(float(self._update_count), float(incoming_count)),
+            )
         for k, v in merged.items():
             self._state[k] = v
-        if isinstance(incoming_state, Metric):
-            self._update_count += incoming_state._update_count
+        # fold the incoming weight into the count so CHAINED merges stay exact for
+        # "mean" states (a dict carries weight 1); the reference leaves the count
+        # untouched for dicts, but it also doesn't weight means by count at all
+        self._update_count += incoming_state._update_count if isinstance(incoming_state, Metric) else 1
         self._computed = None
 
     def clone(self) -> "Metric":

@@ -39,8 +39,8 @@ def _merge_sum(a, b):
     return a + b
 
 
-def _merge_mean(a, b):  # mean across replicas: without counts, plain average
-    return (a + b) / 2.0
+def _merge_mean(a, b):  # mean of TWO participants only — n-way folds must use
+    return (a + b) / 2.0  # weighted_mean / the stacked reduction in _fold_gathered
 
 
 def _merge_max(a, b):
@@ -66,15 +66,31 @@ _PAIRWISE: Dict[str, Callable] = {
 }
 
 
-def pairwise_merge(fx: Reduction, a, b):
-    """Merge two values of one state according to its reduction tag."""
+def pairwise_merge(fx: Reduction, a, b, weights: Optional[tuple] = None):
+    """Merge two values of one state according to its reduction tag.
+
+    ``weights=(w_a, w_b)`` gives the participant weights for ``"mean"`` states —
+    without them a plain 2-way average is used, which is only correct when both
+    sides represent the same number of updates (reference metric.py:481 weights by
+    ``_update_count`` for exactly this reason).
+    """
     if fx is None:
         return a  # keep local value (reference semantics for fx=None)
     if callable(fx):
         # custom reduction operating on a stacked/concatenated tensor (reference
         # contract) — emulate pairwise by stacking
         return fx(jnp.stack([jnp.asarray(a), jnp.asarray(b)], axis=0))
+    if fx == "mean" and weights is not None:
+        return weighted_mean(a, b, weights[0], weights[1])
     return _PAIRWISE[fx](a, b)
+
+
+def weighted_mean(a, b, w_a, w_b):
+    """Count-weighted mean merge: exact for any number of folded participants as long
+    as each carries its cumulative weight (reference metric.py:481 running-mean fold)."""
+    total = w_a + w_b
+    safe = jnp.where(total == 0, 1.0, total)
+    return jnp.where(total == 0, a, (w_a * a + w_b * b) / safe)
 
 
 # ---------------------------------------------------------------------------
@@ -166,16 +182,28 @@ def process_sync(
 
 
 def _fold_gathered(gathered: List[Array], fx: Reduction):
+    """Reduce a world-sized list of one state's values.
+
+    Mirrors the reference's stack-then-reduce (metric.py:525-540): "mean" reduces the
+    whole stacked gather in one shot — a sequential pairwise ``(a+b)/2`` fold would be
+    wrong for 3+ ranks (``((a+b)/2+c)/2 != mean(a,b,c)``).
+    """
     if fx is None:
         return gathered[0] if len(gathered) == 1 else jnp.stack(gathered)
     if callable(fx):
         return fx(jnp.stack(gathered))
     if fx == "cat":
         return jnp.concatenate([jnp.atleast_1d(g) for g in gathered], axis=0)
-    acc = gathered[0]
-    for g in gathered[1:]:
-        acc = _PAIRWISE[fx](acc, g)
-    return acc
+    stacked = jnp.stack(gathered)
+    if fx == "sum":
+        return stacked.sum(axis=0)
+    if fx == "mean":
+        return stacked.mean(axis=0)
+    if fx == "max":
+        return stacked.max(axis=0)
+    if fx == "min":
+        return stacked.min(axis=0)
+    raise ValueError(f"Unknown dist_reduce_fx: {fx!r}")
 
 
 # ---------------------------------------------------------------------------
@@ -184,9 +212,16 @@ def _fold_gathered(gathered: List[Array], fx: Reduction):
 
 
 def merge_states(
-    a: Dict[str, Any], b: Dict[str, Any], reductions: Mapping[str, Reduction]
+    a: Dict[str, Any],
+    b: Dict[str, Any],
+    reductions: Mapping[str, Reduction],
+    weights: Optional[tuple] = None,
 ) -> Dict[str, Any]:
-    """Fold state dict ``b`` into ``a`` using per-state reductions (pure)."""
+    """Fold state dict ``b`` into ``a`` using per-state reductions (pure).
+
+    ``weights=(w_a, w_b)`` carries each side's update count so ``"mean"`` states fold
+    exactly for any chain length (``Metric.merge_state`` passes its ``_update_count``).
+    """
     out: Dict[str, Any] = {}
     for name, va in a.items():
         vb = b[name]
@@ -196,7 +231,7 @@ def merge_states(
             lb = vb if isinstance(vb, list) else [vb]
             out[name] = la + lb
         else:
-            out[name] = pairwise_merge(fx, va, vb)
+            out[name] = pairwise_merge(fx, va, vb, weights=weights)
     return out
 
 

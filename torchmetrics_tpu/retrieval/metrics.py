@@ -209,9 +209,15 @@ class RetrievalRecallAtFixedPrecision(RetrievalPrecisionRecallCurve):
     def _compute(self, state):
         precision, recall, ks = super()._compute(state)
         feasible = precision >= self.min_precision
-        best_r = jnp.where(feasible, recall, -jnp.inf).max()
-        has = bool(feasible.any())
-        if not has:
+        masked = jnp.where(feasible, recall, -jnp.inf)
+        best_r = masked.max()
+        if not bool(feasible.any()):
             return jnp.zeros(()), jnp.asarray(self.max_k or int(ks[-1]))
-        best_k = ks[int(jnp.argmax(jnp.where(feasible, recall, -jnp.inf)))]
+        # reference max((r, k)) tuple-max: among max-recall ties pick the LARGEST k
+        # (recall is non-decreasing in k, so ties at the max are the norm)
+        ties = masked == best_r
+        best_k = ks[int(jnp.max(jnp.where(ties, jnp.arange(ks.shape[0]), -1)))]
+        if float(best_r) == 0.0:
+            # reference clamps best_k to max_k when no recall is achievable
+            best_k = jnp.asarray(self.max_k or int(ks[-1]))
         return best_r, best_k
