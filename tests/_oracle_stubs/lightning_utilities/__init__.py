@@ -1,0 +1,8 @@
+"""Minimal test-only stub of ``lightning_utilities`` so the *reference* torchmetrics
+package (at /root/reference/src) can be imported as a parity oracle in tests.
+
+Only the four symbols the reference actually imports are provided. This is NOT part of
+the shipped framework.
+"""
+
+from .core.apply_func import apply_to_collection  # noqa: F401

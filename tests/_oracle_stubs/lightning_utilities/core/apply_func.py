@@ -1,0 +1,13 @@
+from typing import Any, Callable
+
+
+def apply_to_collection(data: Any, dtype, function: Callable, *args: Any, **kwargs: Any) -> Any:
+    """Recursively apply ``function`` to all elements of ``data`` of type ``dtype``."""
+    if isinstance(data, dtype):
+        return function(data, *args, **kwargs)
+    if isinstance(data, (list, tuple)):
+        out = [apply_to_collection(d, dtype, function, *args, **kwargs) for d in data]
+        return type(data)(out) if not hasattr(data, "_fields") else type(data)(*out)
+    if isinstance(data, dict):
+        return {k: apply_to_collection(v, dtype, function, *args, **kwargs) for k, v in data.items()}
+    return data

@@ -1,0 +1,31 @@
+import importlib.util
+import re
+
+
+def package_available(package_name: str) -> bool:
+    try:
+        return importlib.util.find_spec(package_name) is not None
+    except (ModuleNotFoundError, ValueError):
+        return False
+
+
+class RequirementCache:
+    """Boolean-evaluable availability check (stub; ignores version pins)."""
+
+    def __init__(self, requirement: str = "", module: str = None) -> None:
+        self.requirement = requirement
+        self.module = module
+
+    def _name(self) -> str:
+        if self.module:
+            return self.module
+        return re.split(r"[<>=!\[; ]", self.requirement.strip())[0]
+
+    def __bool__(self) -> bool:
+        name = self._name()
+        return bool(name) and package_available(name)
+
+    def __str__(self) -> str:
+        return f"RequirementCache({self.requirement!r})"
+
+    __repr__ = __str__

@@ -1,0 +1,48 @@
+"""Parity oracle: import the *reference* torchmetrics (torch CPU) for golden values.
+
+Usage in tests::
+
+    from tests.oracle import reference_torchmetrics
+    tm = reference_torchmetrics()           # None if unavailable -> skip
+    ref = tm.functional.segmentation.dice_score(...)
+
+The reference lives at /root/reference/src and needs a tiny ``lightning_utilities``
+stub (tests/_oracle_stubs). Tests compare BEHAVIOR against it — the framework itself
+never imports from the reference.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+_REFERENCE_SRC = "/root/reference/src"
+_STUBS = os.path.join(os.path.dirname(__file__), "_oracle_stubs")
+_state = {"checked": False, "module": None}
+
+
+def reference_torchmetrics():
+    if _state["checked"]:
+        return _state["module"]
+    _state["checked"] = True
+    if not os.path.isdir(_REFERENCE_SRC):
+        return None
+    for p in (_STUBS, _REFERENCE_SRC):
+        if p not in sys.path:
+            sys.path.insert(0, p)
+    try:
+        import torchmetrics  # noqa: F401
+
+        _state["module"] = torchmetrics
+    except Exception:
+        _state["module"] = None
+    return _state["module"]
+
+
+def require_oracle():
+    import pytest
+
+    tm = reference_torchmetrics()
+    if tm is None:
+        pytest.skip("reference torchmetrics oracle unavailable")
+    return tm

@@ -1,0 +1,250 @@
+"""Segmentation tower parity tests — golden values from the reference torchmetrics
+(torch CPU oracle via tests/oracle.py) over randomized inputs, plus harness modes."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+from tests.helpers import MetricTester
+from tests.oracle import require_oracle
+
+from torchmetrics_tpu.functional.segmentation import (
+    dice_score,
+    generalized_dice_score,
+    hausdorff_distance,
+    mean_iou,
+)
+from torchmetrics_tpu.segmentation import DiceScore, GeneralizedDiceScore, HausdorffDistance, MeanIoU
+
+NUM_BATCHES, BATCH, C, H, W = 4, 4, 5, 16, 16
+
+
+def _onehot_data(seed=42):
+    rng = np.random.default_rng(seed)
+    preds = rng.integers(0, 2, size=(NUM_BATCHES, BATCH, C, H, W)).astype(np.int64)
+    target = rng.integers(0, 2, size=(NUM_BATCHES, BATCH, C, H, W)).astype(np.int64)
+    return preds, target
+
+
+def _index_data(seed=43):
+    rng = np.random.default_rng(seed)
+    preds = rng.integers(0, C, size=(NUM_BATCHES, BATCH, H, W)).astype(np.int64)
+    target = rng.integers(0, C, size=(NUM_BATCHES, BATCH, H, W)).astype(np.int64)
+    return preds, target
+
+
+@pytest.mark.parametrize("average", ["micro", "macro", "weighted", "none"])
+@pytest.mark.parametrize("input_format", ["one-hot", "index"])
+@pytest.mark.parametrize("aggregation_level", ["samplewise", "global"])
+def test_dice_score_functional(average, input_format, aggregation_level):
+    tm = require_oracle()
+    from torchmetrics.functional.segmentation import dice_score as ref_dice
+
+    preds, target = _onehot_data() if input_format == "one-hot" else _index_data()
+
+    tester = MetricTester()
+    tester.run_functional_metric_test(
+        preds,
+        target,
+        metric_functional=lambda p, t: dice_score(
+            p, t, num_classes=C, average=average, input_format=input_format, aggregation_level=aggregation_level
+        ),
+        reference_metric=lambda p, t: ref_dice(
+            torch.from_numpy(np.asarray(p)),
+            torch.from_numpy(np.asarray(t)),
+            num_classes=C,
+            average=average,
+            input_format=input_format,
+            aggregation_level=aggregation_level,
+        ).numpy(),
+    )
+
+
+@pytest.mark.parametrize("average", ["micro", "macro", "weighted"])
+@pytest.mark.parametrize("aggregation_level", ["samplewise", "global"])
+def test_dice_score_class(average, aggregation_level):
+    tm = require_oracle()
+    from torchmetrics.segmentation import DiceScore as RefDice
+
+    preds, target = _onehot_data()
+
+    def ref(p, t):
+        m = RefDice(num_classes=C, average=average, aggregation_level=aggregation_level)
+        m.update(torch.from_numpy(np.asarray(p)), torch.from_numpy(np.asarray(t)))
+        return m.compute().numpy()
+
+    tester = MetricTester()
+    tester.run_class_metric_test(
+        preds,
+        target,
+        metric_class=DiceScore,
+        reference_metric=ref,
+        metric_args={"num_classes": C, "average": average, "aggregation_level": aggregation_level},
+        check_batch=False,  # per-batch forward value is the batch's own dice; ref here is cumulative
+    )
+    tester.run_merge_state_test(
+        preds, target, metric_class=DiceScore, reference_metric=ref,
+        metric_args={"num_classes": C, "average": average, "aggregation_level": aggregation_level},
+    )
+
+
+@pytest.mark.parametrize("per_class", [False, True])
+@pytest.mark.parametrize("input_format", ["one-hot", "index"])
+def test_mean_iou_functional(per_class, input_format):
+    tm = require_oracle()
+    from torchmetrics.functional.segmentation import mean_iou as ref_miou
+
+    preds, target = _onehot_data() if input_format == "one-hot" else _index_data()
+    tester = MetricTester()
+    tester.run_functional_metric_test(
+        preds,
+        target,
+        metric_functional=lambda p, t: mean_iou(
+            p, t, num_classes=C, per_class=per_class, input_format=input_format
+        ),
+        reference_metric=lambda p, t: ref_miou(
+            torch.from_numpy(np.asarray(p)),
+            torch.from_numpy(np.asarray(t)),
+            num_classes=C,
+            per_class=per_class,
+            input_format=input_format,
+        ).numpy(),
+    )
+
+
+@pytest.mark.parametrize("per_class", [False, True])
+def test_mean_iou_class(per_class):
+    tm = require_oracle()
+    from torchmetrics.segmentation import MeanIoU as RefMeanIoU
+
+    preds, target = _onehot_data()
+
+    def ref(p, t):
+        m = RefMeanIoU(num_classes=C, per_class=per_class)
+        for pp, tt in zip(p.reshape(-1, BATCH, C, H, W), t.reshape(-1, BATCH, C, H, W)):
+            m.update(torch.from_numpy(np.asarray(pp)), torch.from_numpy(np.asarray(tt)))
+        return m.compute().numpy()
+
+    tester = MetricTester()
+    tester.run_class_metric_test(
+        preds, target, metric_class=MeanIoU, reference_metric=ref,
+        metric_args={"num_classes": C, "per_class": per_class}, check_batch=False,
+    )
+    tester.run_ingraph_sharded_test(
+        preds, target, metric_class=MeanIoU, reference_metric=ref,
+        metric_args={"num_classes": C, "per_class": per_class},
+    )
+
+
+def test_mean_iou_lazy_num_classes():
+    preds, target = _onehot_data()
+    m = MeanIoU()
+    m.update(jnp.asarray(preds[0]), jnp.asarray(target[0]))
+    assert m.num_classes == C
+    assert np.isfinite(float(m.compute()))
+
+
+@pytest.mark.parametrize("per_class", [False, True])
+@pytest.mark.parametrize("weight_type", ["square", "simple", "linear"])
+def test_generalized_dice_functional(per_class, weight_type):
+    tm = require_oracle()
+    from torchmetrics.functional.segmentation import generalized_dice_score as ref_gds
+
+    # keep every class present in the target so the reference's inf-weight path
+    # (whose transposed-flatten indexing scrambles order for N != C) stays cold
+    preds, target = _onehot_data()
+    target[..., 0, 0] = 1
+
+    tester = MetricTester()
+    tester.run_functional_metric_test(
+        preds,
+        target,
+        metric_functional=lambda p, t: generalized_dice_score(
+            p, t, num_classes=C, per_class=per_class, weight_type=weight_type
+        ),
+        reference_metric=lambda p, t: ref_gds(
+            torch.from_numpy(np.asarray(p)),
+            torch.from_numpy(np.asarray(t)),
+            num_classes=C,
+            per_class=per_class,
+            weight_type=weight_type,
+        ).numpy(),
+        atol=1e-4,  # f32 vs torch f64 weight math (1/n^2 spans ~6 decades)
+    )
+
+
+def test_generalized_dice_class():
+    tm = require_oracle()
+    from torchmetrics.segmentation import GeneralizedDiceScore as RefGDS
+
+    preds, target = _onehot_data()
+    target[..., 0, 0] = 1
+
+    def ref(p, t):
+        m = RefGDS(num_classes=C)
+        for pp, tt in zip(p.reshape(-1, BATCH, C, H, W), t.reshape(-1, BATCH, C, H, W)):
+            m.update(torch.from_numpy(np.asarray(pp)), torch.from_numpy(np.asarray(tt)))
+        return m.compute().numpy()
+
+    tester = MetricTester()
+    tester.run_class_metric_test(
+        preds, target, metric_class=GeneralizedDiceScore, reference_metric=ref,
+        metric_args={"num_classes": C}, check_batch=False, atol=1e-4,
+    )
+    tester.run_ingraph_sharded_test(
+        preds, target, metric_class=GeneralizedDiceScore, reference_metric=ref,
+        metric_args={"num_classes": C}, atol=1e-4,
+    )
+
+
+@pytest.mark.parametrize("distance_metric", ["euclidean", "chessboard", "taxicab"])
+@pytest.mark.parametrize("directed", [False, True])
+def test_hausdorff_functional(distance_metric, directed):
+    tm = require_oracle()
+    from torchmetrics.functional.segmentation import hausdorff_distance as ref_hd
+
+    preds, target = _onehot_data(7)
+    preds, target = preds[:2, :2], target[:2, :2]  # hausdorff is O(P^2); keep it small
+
+    tester = MetricTester()
+    tester.run_functional_metric_test(
+        preds,
+        target,
+        metric_functional=lambda p, t: hausdorff_distance(
+            p, t, num_classes=C, distance_metric=distance_metric, directed=directed
+        ),
+        reference_metric=lambda p, t: ref_hd(
+            torch.from_numpy(np.asarray(p)),
+            torch.from_numpy(np.asarray(t)),
+            num_classes=C,
+            distance_metric=distance_metric,
+            directed=directed,
+        ).numpy(),
+    )
+
+
+def test_hausdorff_class_matches_reference():
+    tm = require_oracle()
+    from torchmetrics.segmentation import HausdorffDistance as RefHD
+
+    preds, target = _onehot_data(11)
+    preds, target = preds[:2, :2], target[:2, :2]
+
+    m = HausdorffDistance(num_classes=C)
+    ref = RefHD(num_classes=C)
+    for p, t in zip(preds, target):
+        m.update(jnp.asarray(p), jnp.asarray(t))
+        ref.update(torch.from_numpy(p), torch.from_numpy(t))
+    np.testing.assert_allclose(float(m.compute()), float(ref.compute()), atol=1e-5)
+
+
+def test_hausdorff_spacing():
+    tm = require_oracle()
+    from torchmetrics.functional.segmentation import hausdorff_distance as ref_hd
+
+    preds, target = _onehot_data(13)
+    p, t = preds[0, :2], target[0, :2]
+    ours = hausdorff_distance(jnp.asarray(p), jnp.asarray(t), num_classes=C, spacing=[2.0, 0.5])
+    ref = ref_hd(torch.from_numpy(p), torch.from_numpy(t), num_classes=C, spacing=[2.0, 0.5])
+    np.testing.assert_allclose(np.asarray(ours), ref.numpy(), atol=1e-5)

@@ -9,7 +9,7 @@ Every domain package declares its public classes in its own ``__all__``; the fla
 namespace aggregates them (reference ``__init__.py`` re-exports ~100 names the same
 way, hand-listed)."""
 
-from torchmetrics_tpu import classification, functional, parallel, regression, retrieval, utilities, wrappers
+from torchmetrics_tpu import classification, functional, parallel, regression, retrieval, segmentation, utilities, wrappers
 from torchmetrics_tpu.aggregation import (
     CatMetric,
     MaxMetric,
@@ -24,6 +24,7 @@ from torchmetrics_tpu.collections import MetricCollection
 from torchmetrics_tpu.metric import CompositionalMetric, Metric
 from torchmetrics_tpu.regression import *  # noqa: F401,F403
 from torchmetrics_tpu.retrieval import *  # noqa: F401,F403
+from torchmetrics_tpu.segmentation import *  # noqa: F401,F403
 from torchmetrics_tpu.wrappers import (
     BootStrapper,
     ClasswiseWrapper,
@@ -59,9 +60,11 @@ __all__ = [
     "parallel",
     "regression",
     "retrieval",
+    "segmentation",
     "utilities",
     "wrappers",
     *classification.__all__,
     *regression.__all__,
     *retrieval.__all__,
+    *segmentation.__all__,
 ]

@@ -57,6 +57,8 @@ def multiclass_eer(
     preds, target, num_classes: int, thresholds=None, average=None, ignore_index=None, validate_args: bool = True
 ) -> Array:
     if validate_args:
+        if average not in ("micro", "macro", None):
+            raise ValueError(f"Expected argument `average` to be one of ('micro', 'macro', None), but got {average}")
         _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
     preds, target, thresholds, w = _multiclass_precision_recall_curve_format(
         preds, target, num_classes, thresholds, ignore_index, average

@@ -1,0 +1,8 @@
+"""Stateless segmentation kernels (reference ``functional/segmentation/``)."""
+
+from .dice import dice_score
+from .generalized_dice import generalized_dice_score
+from .hausdorff_distance import hausdorff_distance
+from .mean_iou import mean_iou
+
+__all__ = ["dice_score", "generalized_dice_score", "hausdorff_distance", "mean_iou"]

@@ -1,0 +1,106 @@
+"""Dice score for semantic segmentation (reference ``functional/segmentation/dice.py``).
+
+Per-sample-per-class sufficient statistics (numerator/denominator/support) reduce over
+static spatial axes in one fused pass; every averaging mode is a pure reduction over the
+``(N, C)`` stat matrices.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...utilities.compute import _safe_divide
+from .utils import _segmentation_inputs_format
+
+Array = jax.Array
+
+
+def _dice_score_validate_args(
+    num_classes: int,
+    include_background: bool,
+    average: Optional[str] = "micro",
+    input_format: str = "one-hot",
+    aggregation_level: Optional[str] = "samplewise",
+) -> None:
+    if not isinstance(num_classes, int) or num_classes <= 0:
+        raise ValueError(f"Expected argument `num_classes` must be a positive integer, but got {num_classes}.")
+    if not isinstance(include_background, bool):
+        raise ValueError(f"Expected argument `include_background` must be a boolean, but got {include_background}.")
+    allowed_average = ["micro", "macro", "weighted", "none"]
+    if average is not None and average not in allowed_average:
+        raise ValueError(f"Expected argument `average` to be one of {allowed_average} or None, but got {average}.")
+    if input_format not in ["one-hot", "index", "mixed"]:
+        raise ValueError(
+            f"Expected argument `input_format` to be one of 'one-hot', 'index', 'mixed', but got {input_format}."
+        )
+    if aggregation_level not in ("samplewise", "global"):
+        raise ValueError(
+            f"Expected argument `aggregation_level` to be one of `samplewise`, `global`, but got {aggregation_level}"
+        )
+
+
+def _dice_score_update(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    include_background: bool,
+    input_format: str = "one-hot",
+) -> Tuple[Array, Array, Array]:
+    """Per-sample-per-class 2*intersection / cardinality / support. Reference dice.py:50."""
+    preds, target = _segmentation_inputs_format(preds, target, include_background, num_classes, input_format)
+    reduce_axis = tuple(range(2, target.ndim))
+    predf = preds.astype(jnp.float32)
+    targf = target.astype(jnp.float32)
+    intersection = jnp.sum(predf * targf, axis=reduce_axis)
+    target_sum = jnp.sum(targf, axis=reduce_axis)
+    pred_sum = jnp.sum(predf, axis=reduce_axis)
+    return 2.0 * intersection, pred_sum + target_sum, target_sum
+
+
+def _dice_score_compute(
+    numerator: Array,
+    denominator: Array,
+    average: Optional[str] = "micro",
+    aggregation_level: Optional[str] = "samplewise",
+    support: Optional[Array] = None,
+) -> Array:
+    """Reference dice.py:71 — nan marks absent classes, which every averaging mode skips."""
+    if aggregation_level == "global":
+        numerator = jnp.sum(numerator, axis=0)[None]
+        denominator = jnp.sum(denominator, axis=0)[None]
+        support = jnp.sum(support, axis=0) if support is not None else None
+
+    if average == "micro":
+        return _safe_divide(jnp.sum(numerator, axis=-1), jnp.sum(denominator, axis=-1), zero_division=jnp.nan)
+
+    dice = _safe_divide(numerator, denominator, zero_division=jnp.nan)
+    if average == "macro":
+        return jnp.nanmean(dice, axis=-1)
+    if average == "weighted":
+        if support is None:
+            raise ValueError("Expected argument `support` to be provided for weighted averaging.")
+        weights = _safe_divide(support, jnp.sum(support, axis=-1, keepdims=True), zero_division=jnp.nan)
+        nan_mask = jnp.all(jnp.isnan(dice), axis=-1)
+        out = jnp.nansum(dice * weights, axis=-1)
+        return jnp.where(nan_mask, jnp.nan, out)
+    if average in ("none", None):
+        return dice
+    raise ValueError(f"Invalid value for `average`: {average}.")
+
+
+def dice_score(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    include_background: bool = True,
+    average: Optional[str] = "macro",
+    input_format: str = "one-hot",
+    aggregation_level: Optional[str] = "samplewise",
+) -> Array:
+    """Compute the Dice score for semantic segmentation (reference dice.py:105)."""
+    _dice_score_validate_args(num_classes, include_background, average, input_format, aggregation_level)
+    numerator, denominator, support = _dice_score_update(preds, target, num_classes, include_background, input_format)
+    return _dice_score_compute(numerator, denominator, average, aggregation_level=aggregation_level, support=support)

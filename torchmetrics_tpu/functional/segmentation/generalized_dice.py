@@ -1,0 +1,100 @@
+"""Generalized Dice score (reference ``functional/segmentation/generalized_dice.py``).
+
+Deviation from the reference (documented): when a class is absent from the target the
+reference replaces the infinite ``1/target_sum`` weight using a transposed-flatten
+index dance (generalized_dice.py:75-81) that scrambles sample/class order unless
+``N == C``; here the infinite weight is replaced by that class's maximum finite weight
+across the batch — the intended semantics.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...utilities.compute import _safe_divide
+from .utils import _segmentation_inputs_format
+
+Array = jax.Array
+
+
+def _generalized_dice_validate_args(
+    num_classes: int,
+    include_background: bool,
+    per_class: bool,
+    weight_type: str,
+    input_format: str,
+) -> None:
+    if not isinstance(num_classes, int) or num_classes <= 0:
+        raise ValueError(f"Expected argument `num_classes` must be a positive integer, but got {num_classes}.")
+    if not isinstance(include_background, bool):
+        raise ValueError(f"Expected argument `include_background` must be a boolean, but got {include_background}.")
+    if not isinstance(per_class, bool):
+        raise ValueError(f"Expected argument `per_class` must be a boolean, but got {per_class}.")
+    if weight_type not in ["square", "simple", "linear"]:
+        raise ValueError(
+            f"Expected argument `weight_type` to be one of 'square', 'simple', 'linear', but got {weight_type}."
+        )
+    if input_format not in ["one-hot", "index", "mixed"]:
+        raise ValueError(
+            f"Expected argument `input_format` to be one of 'one-hot', 'index', 'mixed', but got {input_format}."
+        )
+
+
+def _generalized_dice_update(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    include_background: bool,
+    weight_type: str = "square",
+    input_format: str = "one-hot",
+) -> Tuple[Array, Array]:
+    """Weighted per-sample-per-class numerator/denominator (reference generalized_dice.py:48)."""
+    preds, target = _segmentation_inputs_format(preds, target, include_background, num_classes, input_format)
+    reduce_axis = tuple(range(2, target.ndim))
+    predf = preds.astype(jnp.float32)
+    targf = target.astype(jnp.float32)
+    intersection = jnp.sum(predf * targf, axis=reduce_axis)
+    target_sum = jnp.sum(targf, axis=reduce_axis)
+    pred_sum = jnp.sum(predf, axis=reduce_axis)
+    cardinality = target_sum + pred_sum
+
+    if weight_type == "simple":
+        weights = 1.0 / target_sum
+    elif weight_type == "linear":
+        weights = jnp.ones_like(target_sum)
+    else:  # square
+        weights = 1.0 / (target_sum**2)
+
+    infs = jnp.isinf(weights)
+    finite = jnp.where(infs, 0.0, weights)
+    class_max = jnp.max(finite, axis=0, keepdims=True)  # (1, C)
+    weights = jnp.where(infs, jnp.broadcast_to(class_max, weights.shape), weights)
+
+    return 2.0 * intersection * weights, cardinality * weights
+
+
+def _generalized_dice_compute(numerator: Array, denominator: Array, per_class: bool = True) -> Array:
+    if not per_class:
+        numerator = jnp.sum(numerator, axis=1)
+        denominator = jnp.sum(denominator, axis=1)
+    return _safe_divide(numerator, denominator)
+
+
+def generalized_dice_score(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    include_background: bool = True,
+    per_class: bool = False,
+    weight_type: str = "square",
+    input_format: str = "one-hot",
+) -> Array:
+    """Generalized Dice Score (reference generalized_dice.py:96)."""
+    _generalized_dice_validate_args(num_classes, include_background, per_class, weight_type, input_format)
+    numerator, denominator = _generalized_dice_update(
+        preds, target, num_classes, include_background, weight_type, input_format
+    )
+    return _generalized_dice_compute(numerator, denominator, per_class)

@@ -1,0 +1,89 @@
+"""Mean IoU for semantic segmentation (reference ``functional/segmentation/mean_iou.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...utilities.compute import _safe_divide
+from .utils import _segmentation_inputs_format
+
+Array = jax.Array
+
+
+def _mean_iou_reshape_args(preds: Array, target: Array, input_format: str = "one-hot") -> Tuple[Array, Array]:
+    """Promote 1D/2D index inputs to a leading batch axis (reference mean_iou.py:25)."""
+    if input_format == "one-hot":
+        return preds, target
+    if preds.ndim == 1:
+        preds = preds[None, None]
+    elif preds.ndim == 2:
+        preds = preds[None]
+    if target.ndim == 1:
+        target = target[None, None]
+    elif target.ndim == 2:
+        target = target[None]
+    return preds, target
+
+
+def _mean_iou_validate_args(
+    num_classes: Optional[int],
+    include_background: bool,
+    per_class: bool,
+    input_format: str = "one-hot",
+) -> None:
+    if input_format == "index" and num_classes is None:
+        raise ValueError("Argument `num_classes` must be provided when `input_format` is 'index'.")
+    if num_classes is not None and num_classes <= 0:
+        raise ValueError(f"Expected argument `num_classes` must be `None` or a positive integer, but got {num_classes}.")
+    if not isinstance(include_background, bool):
+        raise ValueError(f"Expected argument `include_background` must be a boolean, but got {include_background}.")
+    if not isinstance(per_class, bool):
+        raise ValueError(f"Expected argument `per_class` must be a boolean, but got {per_class}.")
+    if input_format not in ["one-hot", "index", "mixed"]:
+        raise ValueError(
+            f"Expected argument `input_format` to be one of 'one-hot', 'index', 'mixed', but got {input_format}."
+        )
+
+
+def _mean_iou_update(
+    preds: Array,
+    target: Array,
+    num_classes: Optional[int] = None,
+    include_background: bool = False,
+    input_format: str = "one-hot",
+) -> Tuple[Array, Array]:
+    """Per-sample-per-class intersection/union counts (reference mean_iou.py:69)."""
+    preds, target = _mean_iou_reshape_args(jnp.asarray(preds), jnp.asarray(target), input_format)
+    preds, target = _segmentation_inputs_format(preds, target, include_background, num_classes, input_format)
+    reduce_axis = tuple(range(2, preds.ndim))
+    predf = preds.astype(jnp.float32)
+    targf = target.astype(jnp.float32)
+    intersection = jnp.sum(predf * targf, axis=reduce_axis)
+    union = jnp.sum(targf, axis=reduce_axis) + jnp.sum(predf, axis=reduce_axis) - intersection
+    return intersection, union
+
+
+def _mean_iou_compute(intersection: Array, union: Array, zero_division) -> Array:
+    return _safe_divide(intersection, union, zero_division=zero_division)
+
+
+def mean_iou(
+    preds: Array,
+    target: Array,
+    num_classes: Optional[int] = None,
+    include_background: bool = True,
+    per_class: bool = False,
+    input_format: str = "one-hot",
+) -> Array:
+    """Mean Intersection over Union; absent classes score -1 per class, and are skipped
+    in the averaged value (reference mean_iou.py:98)."""
+    _mean_iou_validate_args(num_classes, include_background, per_class, input_format)
+    intersection, union = _mean_iou_update(preds, target, num_classes, include_background, input_format)
+    scores = _mean_iou_compute(intersection, union, zero_division=jnp.nan)
+    valid_classes = union > 0
+    if per_class:
+        return jnp.nan_to_num(scores, nan=-1.0)
+    return jnp.nansum(scores, axis=-1) / jnp.sum(valid_classes, axis=-1)

@@ -1,0 +1,8 @@
+"""Segmentation tower — stateful metric classes (reference ``src/torchmetrics/segmentation/``)."""
+
+from .dice import DiceScore
+from .generalized_dice import GeneralizedDiceScore
+from .hausdorff_distance import HausdorffDistance
+from .mean_iou import MeanIoU
+
+__all__ = ["DiceScore", "GeneralizedDiceScore", "HausdorffDistance", "MeanIoU"]

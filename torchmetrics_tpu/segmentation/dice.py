@@ -1,0 +1,63 @@
+"""DiceScore metric class (reference ``segmentation/dice.py:35``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+
+from ..functional.segmentation.dice import (
+    _dice_score_compute,
+    _dice_score_update,
+    _dice_score_validate_args,
+)
+from ..metric import Metric
+
+
+class DiceScore(Metric):
+    """Dice score over per-sample sufficient statistics (cat states, like the reference
+    segmentation/dice.py:139-141 — samplewise aggregation needs per-sample rows)."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self,
+        num_classes: int,
+        include_background: bool = True,
+        average: Optional[str] = "macro",
+        aggregation_level: Optional[str] = "samplewise",
+        input_format: str = "one-hot",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        _dice_score_validate_args(num_classes, include_background, average, input_format, aggregation_level)
+        self.num_classes = num_classes
+        self.include_background = include_background
+        self.average = average
+        self.aggregation_level = aggregation_level
+        self.input_format = input_format
+        self.add_state("numerator", default=[], dist_reduce_fx="cat")
+        self.add_state("denominator", default=[], dist_reduce_fx="cat")
+        self.add_state("support", default=[], dist_reduce_fx="cat")
+
+    def _batch_state(self, preds, target):
+        numerator, denominator, support = _dice_score_update(
+            preds, target, self.num_classes, self.include_background, self.input_format
+        )
+        return {"numerator": numerator, "denominator": denominator, "support": support}
+
+    def _compute(self, state):
+        return jnp.nanmean(
+            _dice_score_compute(
+                state["numerator"],
+                state["denominator"],
+                self.average,
+                self.aggregation_level,
+                support=state["support"] if self.average == "weighted" else None,
+            ),
+            axis=0,
+        )

@@ -1,0 +1,61 @@
+"""HausdorffDistance metric class (reference ``segmentation/hausdorff_distance.py:31``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Union
+
+import jax.numpy as jnp
+
+from ..functional.segmentation.hausdorff_distance import (
+    _hausdorff_distance_validate_args,
+    hausdorff_distance,
+)
+from ..metric import Metric
+
+
+class HausdorffDistance(Metric):
+    """Mean Hausdorff distance over (sample, class) pairs; scalar sum + count states."""
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(
+        self,
+        num_classes: int,
+        include_background: bool = False,
+        distance_metric: str = "euclidean",
+        spacing: Optional[Union[Sequence[float], Any]] = None,
+        directed: bool = False,
+        input_format: str = "one-hot",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        _hausdorff_distance_validate_args(
+            num_classes, include_background, distance_metric, spacing, directed, input_format
+        )
+        self.num_classes = num_classes
+        self.include_background = include_background
+        self.distance_metric = distance_metric
+        self.spacing = spacing
+        self.directed = directed
+        self.input_format = input_format
+        self.add_state("score", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+    def _batch_state(self, preds, target):
+        score = hausdorff_distance(
+            preds,
+            target,
+            self.num_classes,
+            include_background=self.include_background,
+            distance_metric=self.distance_metric,
+            spacing=self.spacing,
+            directed=self.directed,
+            input_format=self.input_format,
+        )
+        return {"score": score.sum(), "total": jnp.asarray(float(score.size))}
+
+    def _compute(self, state):
+        return state["score"] / state["total"]
