@@ -1,0 +1,4 @@
+"""pycocotools stub (test infra only) — makes the reference's availability flag True so
+its pure-torch bbox mAP oracle can run; mask routines are intentionally absent."""
+
+__version__ = "2.0.8"

@@ -1,0 +1,8 @@
+"""Mask RLE routines are not stubbed — bbox-only oracle."""
+
+
+def _unavailable(*args, **kwargs):
+    raise NotImplementedError("pycocotools mask ops are not available in the test stub")
+
+
+encode = decode = area = iou = toBbox = _unavailable

@@ -1,0 +1,6 @@
+"""Minimal torchvision stub (test infra only) — provides the handful of box ops the
+reference oracle imports, implemented with the standard published formulas."""
+
+__version__ = "0.20.0"
+
+from . import ops  # noqa: F401

@@ -1,0 +1,24 @@
+"""models stub — names only, for the oracle's module-level imports."""
+
+
+class VGG:  # noqa: D101
+    pass
+
+
+class _ResNetModule:
+    def __getattr__(self, name):
+        raise NotImplementedError("torchvision models are not available in the test stub")
+
+
+resnet = _ResNetModule()
+
+
+def _unavailable(*args, **kwargs):
+    raise NotImplementedError("torchvision models are not available in the test stub")
+
+
+resnet50 = resnet18 = resnet34 = resnet101 = vgg16 = alexnet = squeezenet1_1 = _unavailable
+
+
+def __getattr__(name):  # any other model name
+    return _unavailable

@@ -1,0 +1,78 @@
+"""Pure-torch box ops with torchvision-equivalent semantics (test-oracle stub)."""
+
+import torch
+
+
+def box_area(boxes: torch.Tensor) -> torch.Tensor:
+    return (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+
+
+def _inter_union(boxes1: torch.Tensor, boxes2: torch.Tensor):
+    area1 = box_area(boxes1)
+    area2 = box_area(boxes2)
+    lt = torch.max(boxes1[:, None, :2], boxes2[None, :, :2])
+    rb = torch.min(boxes1[:, None, 2:], boxes2[None, :, 2:])
+    wh = (rb - lt).clamp(min=0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area1[:, None] + area2[None, :] - inter
+    return inter, union
+
+
+def box_iou(boxes1: torch.Tensor, boxes2: torch.Tensor) -> torch.Tensor:
+    inter, union = _inter_union(boxes1, boxes2)
+    return inter / union
+
+
+def generalized_box_iou(boxes1: torch.Tensor, boxes2: torch.Tensor) -> torch.Tensor:
+    inter, union = _inter_union(boxes1, boxes2)
+    iou = inter / union
+    lt = torch.min(boxes1[:, None, :2], boxes2[None, :, :2])
+    rb = torch.max(boxes1[:, None, 2:], boxes2[None, :, 2:])
+    wh = (rb - lt).clamp(min=0)
+    areai = wh[..., 0] * wh[..., 1]
+    return iou - (areai - union) / areai
+
+
+def _box_diou_iou(boxes1: torch.Tensor, boxes2: torch.Tensor, eps: float = 1e-7):
+    inter, union = _inter_union(boxes1, boxes2)
+    iou = inter / union
+    lt = torch.min(boxes1[:, None, :2], boxes2[None, :, :2])
+    rb = torch.max(boxes1[:, None, 2:], boxes2[None, :, 2:])
+    wh = (rb - lt).clamp(min=0)
+    diag = wh[..., 0] ** 2 + wh[..., 1] ** 2 + eps
+    c1 = (boxes1[:, :2] + boxes1[:, 2:]) / 2
+    c2 = (boxes2[:, :2] + boxes2[:, 2:]) / 2
+    d = c1[:, None, :] - c2[None, :, :]
+    return iou - (d[..., 0] ** 2 + d[..., 1] ** 2) / diag, iou
+
+
+def distance_box_iou(boxes1: torch.Tensor, boxes2: torch.Tensor, eps: float = 1e-7) -> torch.Tensor:
+    diou, _ = _box_diou_iou(boxes1, boxes2, eps)
+    return diou
+
+
+def complete_box_iou(boxes1: torch.Tensor, boxes2: torch.Tensor, eps: float = 1e-7) -> torch.Tensor:
+    diou, iou = _box_diou_iou(boxes1, boxes2, eps)
+    w1 = boxes1[:, 2] - boxes1[:, 0]
+    h1 = boxes1[:, 3] - boxes1[:, 1]
+    w2 = boxes2[:, 2] - boxes2[:, 0]
+    h2 = boxes2[:, 3] - boxes2[:, 1]
+    import math
+
+    v = (4 / math.pi**2) * (torch.atan(w2 / h2)[None, :] - torch.atan(w1 / h1)[:, None]) ** 2
+    with torch.no_grad():
+        alpha = v / (1 - iou + v + eps)
+    return diou - alpha * v
+
+
+def box_convert(boxes: torch.Tensor, in_fmt: str, out_fmt: str) -> torch.Tensor:
+    if in_fmt == out_fmt:
+        return boxes
+    if out_fmt != "xyxy":
+        raise NotImplementedError(f"stub only converts to xyxy, got {out_fmt}")
+    a, b, c, d = boxes.unbind(-1)
+    if in_fmt == "xywh":
+        return torch.stack([a, b, a + c, b + d], dim=-1)
+    if in_fmt == "cxcywh":
+        return torch.stack([a - c / 2, b - d / 2, a + c / 2, b + d / 2], dim=-1)
+    raise NotImplementedError(f"stub cannot convert from {in_fmt}")

@@ -1,0 +1,11 @@
+"""transforms stub — just enough surface for the oracle's module-level imports."""
+
+
+class _Unavailable:
+    def __init__(self, *args, **kwargs):
+        raise NotImplementedError("torchvision transforms are not available in the test stub")
+
+
+Compose = Normalize = Resize = CenterCrop = ToTensor = InterpolationMode = _Unavailable
+
+from . import functional  # noqa: E402,F401

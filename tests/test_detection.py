@@ -1,0 +1,295 @@
+"""Detection tower parity tests.
+
+Oracles: the reference's torchvision-backed IoU family and its pure-torch mAP template
+(``/root/reference/src/torchmetrics/detection/_mean_ap.py``), both runnable through the
+test-only torchvision/pycocotools stubs in ``tests/_oracle_stubs``.
+
+The legacy oracle excludes area-ignored gts from matching wholesale, while this repo
+follows pycocotools (ignored gts matchable, det then ignored) — so parity fixtures keep
+every box inside one COCO area bucket, where the two protocols coincide.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from tests.helpers import _assert_allclose
+from tests.oracle import reference_torchmetrics
+
+from torchmetrics_tpu.detection import (
+    CompleteIntersectionOverUnion,
+    DistanceIntersectionOverUnion,
+    GeneralizedIntersectionOverUnion,
+    IntersectionOverUnion,
+    MeanAveragePrecision,
+)
+from torchmetrics_tpu.functional.detection import (
+    complete_intersection_over_union,
+    distance_intersection_over_union,
+    generalized_intersection_over_union,
+    intersection_over_union,
+)
+
+_SEED = 7
+
+
+def _rand_boxes(rng, n, lo=0.0, hi=400.0, min_wh=100.0, max_wh=200.0):
+    """xyxy boxes whose areas all land in the COCO 'large' bucket (>96^2)."""
+    xy = rng.uniform(lo, hi, size=(n, 2))
+    wh = rng.uniform(min_wh, max_wh, size=(n, 2))
+    return np.concatenate([xy, xy + wh], axis=-1).astype(np.float32)
+
+
+def _det_batches(num_updates=3, imgs_per_update=2, num_classes=3, seed=_SEED, min_boxes=0):
+    """min_boxes=1 sidesteps a reference crash: its per-class IoU compute boolean-indexes
+    a (N,N) zero matrix with a length-0 label mask when an image has dets but no gts."""
+    rng = np.random.default_rng(seed)
+    batches = []
+    for _ in range(num_updates):
+        preds, target = [], []
+        for _ in range(imgs_per_update):
+            nd = int(rng.integers(min_boxes, 8))
+            ng = int(rng.integers(min_boxes, 6))
+            preds.append({
+                "boxes": _rand_boxes(rng, nd),
+                "scores": rng.uniform(0.1, 1.0, nd).astype(np.float32),
+                "labels": rng.integers(0, num_classes, nd).astype(np.int32),
+            })
+            target.append({
+                "boxes": _rand_boxes(rng, ng),
+                "labels": rng.integers(0, num_classes, ng).astype(np.int32),
+            })
+        batches.append((preds, target))
+    return batches
+
+
+def _to_torch(items, keys):
+    import torch
+
+    return [{k: torch.as_tensor(np.asarray(d[k])) for k in keys if k in d} for d in items]
+
+
+FUNCTIONAL_PAIRS = [
+    (intersection_over_union, "intersection_over_union"),
+    (generalized_intersection_over_union, "generalized_intersection_over_union"),
+    (distance_intersection_over_union, "distance_intersection_over_union"),
+    (complete_intersection_over_union, "complete_intersection_over_union"),
+]
+
+
+@pytest.mark.parametrize("fn,ref_name", FUNCTIONAL_PAIRS, ids=[p[1] for p in FUNCTIONAL_PAIRS])
+@pytest.mark.parametrize("aggregate", [True, False])
+def test_iou_functional_parity(fn, ref_name, aggregate):
+    tm = reference_torchmetrics()
+    if tm is None:
+        pytest.skip("oracle unavailable")
+    import torch
+
+    ref_fn = getattr(tm.functional.detection, ref_name)
+    rng = np.random.default_rng(_SEED)
+    preds = _rand_boxes(rng, 5)
+    target = _rand_boxes(rng, 5)
+    ours = fn(jnp.asarray(preds), jnp.asarray(target), aggregate=aggregate)
+    ref = ref_fn(torch.as_tensor(preds), torch.as_tensor(target), aggregate=aggregate)
+    _assert_allclose(ours, ref.numpy(), atol=1e-5)
+    # thresholded variant
+    ours_t = fn(jnp.asarray(preds), jnp.asarray(target), iou_threshold=0.3, replacement_val=-1, aggregate=aggregate)
+    ref_t = ref_fn(torch.as_tensor(preds), torch.as_tensor(target), iou_threshold=0.3, replacement_val=-1, aggregate=aggregate)
+    _assert_allclose(ours_t, ref_t.numpy(), atol=1e-5)
+
+
+CLASS_PAIRS = [
+    (IntersectionOverUnion, "IntersectionOverUnion"),
+    (GeneralizedIntersectionOverUnion, "GeneralizedIntersectionOverUnion"),
+    (DistanceIntersectionOverUnion, "DistanceIntersectionOverUnion"),
+    (CompleteIntersectionOverUnion, "CompleteIntersectionOverUnion"),
+]
+
+
+@pytest.mark.parametrize("cls,ref_name", CLASS_PAIRS, ids=[p[1] for p in CLASS_PAIRS])
+@pytest.mark.parametrize("respect_labels", [True, False])
+@pytest.mark.parametrize("class_metrics", [True, False])
+def test_iou_class_parity(cls, ref_name, respect_labels, class_metrics):
+    tm = reference_torchmetrics()
+    if tm is None:
+        pytest.skip("oracle unavailable")
+    ref_cls = getattr(tm.detection, ref_name)
+    ours = cls(respect_labels=respect_labels, class_metrics=class_metrics)
+    ref = ref_cls(respect_labels=respect_labels, class_metrics=class_metrics)
+    for preds, target in _det_batches(min_boxes=1 if class_metrics else 0):
+        ours.update(preds, target)
+        ref.update(_to_torch(preds, ("boxes", "scores", "labels")), _to_torch(target, ("boxes", "labels")))
+    r_ours = ours.compute()
+    r_ref = {k: v.numpy() for k, v in ref.compute().items()}
+    assert set(r_ours) == set(r_ref)
+    _assert_allclose(r_ours, r_ref, atol=1e-5)
+
+
+def test_iou_class_merge_matches_single():
+    batches = _det_batches(num_updates=3)
+    single = IntersectionOverUnion(class_metrics=True)
+    shards = [IntersectionOverUnion(class_metrics=True) for _ in range(3)]
+    for (preds, target), shard in zip(batches, shards):
+        single.update(preds, target)
+        shard.update(preds, target)
+    merged = shards[0]
+    merged.merge_state(shards[1])
+    merged.merge_state(shards[2])
+    _assert_allclose(merged.compute(), single.compute(), atol=1e-6)
+
+
+@pytest.mark.parametrize("class_metrics", [False, True])
+def test_map_parity_with_reference_template(class_metrics):
+    tm = reference_torchmetrics()
+    if tm is None:
+        pytest.skip("oracle unavailable")
+    from torchmetrics.detection._mean_ap import MeanAveragePrecision as RefMAP  # type: ignore
+
+    ours = MeanAveragePrecision(class_metrics=class_metrics)
+    ref = RefMAP(class_metrics=class_metrics)
+    for preds, target in _det_batches(num_updates=4, imgs_per_update=3, num_classes=3, seed=11):
+        ours.update(preds, target)
+        ref.update(_to_torch(preds, ("boxes", "scores", "labels")), _to_torch(target, ("boxes", "labels")))
+    r_ours = ours.compute()
+    r_ref = {k: np.asarray(v) for k, v in ref.compute().items()}
+    for key in ("map", "map_50", "map_75", "map_large", "map_small", "map_medium",
+                "mar_1", "mar_10", "mar_100", "mar_large", "classes",
+                "map_per_class", "mar_100_per_class"):
+        _assert_allclose(r_ours[key], np.squeeze(r_ref[key]), atol=1e-6, msg=f"key={key}")
+
+
+def test_map_merge_matches_single():
+    batches = _det_batches(num_updates=3, imgs_per_update=2, seed=23)
+    single = MeanAveragePrecision()
+    shards = [MeanAveragePrecision() for _ in range(3)]
+    for (preds, target), shard in zip(batches, shards):
+        single.update(preds, target)
+        shard.update(preds, target)
+    merged = shards[0]
+    merged.merge_state(shards[1])
+    merged.merge_state(shards[2])
+    _assert_allclose(merged.compute(), single.compute(), atol=1e-6)
+
+
+def test_map_forward_equals_fresh_compute():
+    preds, target = _det_batches(num_updates=1, seed=3)[0]
+    m = MeanAveragePrecision()
+    val = m(preds, target)
+    fresh = MeanAveragePrecision()
+    fresh.update(preds, target)
+    _assert_allclose(val, fresh.compute(), atol=1e-6)
+
+
+def test_map_docstring_example():
+    preds = [dict(boxes=jnp.array([[258.0, 41.0, 606.0, 285.0]]), scores=jnp.array([0.536]), labels=jnp.array([0]))]
+    target = [dict(boxes=jnp.array([[214.0, 41.0, 562.0, 285.0]]), labels=jnp.array([0]))]
+    m = MeanAveragePrecision()
+    m.update(preds, target)
+    out = m.compute()
+    assert np.isclose(float(out["map"]), 0.6, atol=1e-6)
+    assert float(out["map_50"]) == 1.0
+    assert float(out["map_75"]) == 1.0
+    assert float(out["map_medium"]) == -1.0
+    assert np.isclose(float(out["mar_1"]), 0.6, atol=1e-6)
+
+
+def test_map_empty_and_missing_sides():
+    m = MeanAveragePrecision()
+    # image with dets but no gts + image with gts but no dets
+    preds = [
+        dict(boxes=_rand_boxes(np.random.default_rng(0), 2), scores=np.array([0.5, 0.4], np.float32),
+             labels=np.array([0, 0], np.int32)),
+        dict(boxes=np.zeros((0, 4), np.float32), scores=np.zeros(0, np.float32), labels=np.zeros(0, np.int32)),
+    ]
+    target = [
+        dict(boxes=np.zeros((0, 4), np.float32), labels=np.zeros(0, np.int32)),
+        dict(boxes=_rand_boxes(np.random.default_rng(1), 2), labels=np.array([0, 0], np.int32)),
+    ]
+    m.update(preds, target)
+    out = m.compute()
+    assert float(out["map"]) == 0.0  # all dets are FPs, all gts unmatched
+    assert float(out["mar_100"]) == 0.0
+
+
+def test_map_iscrowd_ignored():
+    # one normal gt matched + one crowd gt: crowd det is ignored (neither tp nor fp)
+    box_a = np.array([[0.0, 0.0, 100.0, 100.0]], np.float32)
+    box_b = np.array([[200.0, 200.0, 320.0, 320.0]], np.float32)
+    preds = [dict(boxes=np.concatenate([box_a, box_b]), scores=np.array([0.9, 0.8], np.float32),
+                  labels=np.array([0, 0], np.int32))]
+    target = [dict(boxes=np.concatenate([box_a, box_b]), labels=np.array([0, 0], np.int32),
+                   iscrowd=np.array([0, 1], np.int32))]
+    m = MeanAveragePrecision()
+    m.update(preds, target)
+    out = m.compute()
+    assert float(out["map"]) == 1.0
+    assert float(out["mar_100"]) == 1.0
+
+
+def test_map_micro_pools_classes():
+    # det labeled 1, gt labeled 0: macro finds nothing, micro matches them
+    box = np.array([[0.0, 0.0, 100.0, 100.0]], np.float32)
+    preds = [dict(boxes=box, scores=np.array([0.9], np.float32), labels=np.array([1], np.int32))]
+    target = [dict(boxes=box, labels=np.array([0], np.int32))]
+    macro = MeanAveragePrecision(average="macro")
+    micro = MeanAveragePrecision(average="micro")
+    macro.update(preds, target)
+    micro.update(preds, target)
+    assert float(macro.compute()["map"]) == 0.0
+    assert float(micro.compute()["map"]) == 1.0
+
+
+def test_map_extended_summary_shapes():
+    preds, target = _det_batches(num_updates=1, seed=5)[0]
+    m = MeanAveragePrecision(extended_summary=True)
+    m.update(preds, target)
+    out = m.compute()
+    num_k = len(out["classes"])
+    assert out["precision"].shape == (10, 101, num_k, 4, 3)
+    assert out["recall"].shape == (10, num_k, 4, 3)
+    assert out["scores"].shape == (10, 101, num_k, 4, 3)
+    assert isinstance(out["ious"], dict)
+
+
+def test_map_segm_exact_and_miss():
+    h = w = 32
+    mask_a = np.zeros((h, w), bool)
+    mask_a[4:20, 4:20] = True
+    mask_b = np.zeros((h, w), bool)
+    mask_b[22:30, 22:30] = True
+    preds = [dict(masks=np.stack([mask_a]), scores=np.array([0.8], np.float32), labels=np.array([0], np.int32))]
+    target = [dict(masks=np.stack([mask_a]), labels=np.array([0], np.int32))]
+    m = MeanAveragePrecision(iou_type="segm")
+    m.update(preds, target)
+    assert float(m.compute()["map"]) == 1.0
+
+    m2 = MeanAveragePrecision(iou_type="segm")
+    preds2 = [dict(masks=np.stack([mask_b]), scores=np.array([0.8], np.float32), labels=np.array([0], np.int32))]
+    m2.update(preds2, target)
+    assert float(m2.compute()["map"]) == 0.0
+
+
+def test_map_coco_roundtrip(tmp_path):
+    preds, target = _det_batches(num_updates=1, seed=9)[0]
+    m = MeanAveragePrecision()
+    m.update(preds, target)
+    base = str(tmp_path / "roundtrip")
+    m.tm_to_coco(base)
+    preds2, target2 = MeanAveragePrecision.coco_to_tm(f"{base}_preds.json", f"{base}_target.json")
+    m2 = MeanAveragePrecision()
+    m2.update(preds2, target2)
+    _assert_allclose(m2.compute(), m.compute(), atol=1e-5)
+
+
+def test_map_input_validation_errors():
+    m = MeanAveragePrecision()
+    with pytest.raises(ValueError, match="Expected argument `preds` and `target` to have the same length"):
+        m.update([], [dict(boxes=np.zeros((0, 4)), labels=np.zeros(0))])
+    with pytest.raises(ValueError, match="Expected all dicts in `preds`"):
+        m.update([dict(boxes=np.zeros((0, 4)))], [dict(boxes=np.zeros((0, 4)), labels=np.zeros(0))])
+    with pytest.raises(ValueError, match="Expected argument `average`"):
+        MeanAveragePrecision(average="weird")
+    with pytest.raises(ValueError, match="length 3"):
+        MeanAveragePrecision(max_detection_thresholds=[10])

@@ -9,7 +9,7 @@ Every domain package declares its public classes in its own ``__all__``; the fla
 namespace aggregates them (reference ``__init__.py`` re-exports ~100 names the same
 way, hand-listed)."""
 
-from torchmetrics_tpu import classification, functional, parallel, regression, retrieval, segmentation, utilities, wrappers
+from torchmetrics_tpu import classification, detection, functional, parallel, regression, retrieval, segmentation, utilities, wrappers
 from torchmetrics_tpu.aggregation import (
     CatMetric,
     MaxMetric,
@@ -20,6 +20,7 @@ from torchmetrics_tpu.aggregation import (
     SumMetric,
 )
 from torchmetrics_tpu.classification import *  # noqa: F401,F403
+from torchmetrics_tpu.detection import *  # noqa: F401,F403
 from torchmetrics_tpu.collections import MetricCollection
 from torchmetrics_tpu.metric import CompositionalMetric, Metric
 from torchmetrics_tpu.regression import *  # noqa: F401,F403
@@ -60,11 +61,13 @@ __all__ = [
     "parallel",
     "regression",
     "retrieval",
+    "detection",
     "segmentation",
     "utilities",
     "wrappers",
     *classification.__all__,
     *regression.__all__,
     *retrieval.__all__,
+    *detection.__all__,
     *segmentation.__all__,
 ]

@@ -1,0 +1,15 @@
+"""Detection tower — stateful metric classes (reference ``src/torchmetrics/detection/``)."""
+
+from .ciou import CompleteIntersectionOverUnion
+from .diou import DistanceIntersectionOverUnion
+from .giou import GeneralizedIntersectionOverUnion
+from .iou import IntersectionOverUnion
+from .mean_ap import MeanAveragePrecision
+
+__all__ = [
+    "CompleteIntersectionOverUnion",
+    "DistanceIntersectionOverUnion",
+    "GeneralizedIntersectionOverUnion",
+    "IntersectionOverUnion",
+    "MeanAveragePrecision",
+]

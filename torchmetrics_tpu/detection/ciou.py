@@ -1,0 +1,31 @@
+"""CompleteIntersectionOverUnion metric class (reference ``detection/ciou.py:30``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+
+from ..functional.detection.ciou import _ciou_update
+from .iou import IntersectionOverUnion
+
+
+class CompleteIntersectionOverUnion(IntersectionOverUnion):
+    """CIoU over list-of-dict box inputs; same state design as ``IntersectionOverUnion``."""
+
+    _iou_type: str = "ciou"
+    _invalid_val: float = -2.0  # CIoU lower bound sits below -1 (reference ciou.py:104)
+
+    def __init__(
+        self,
+        box_format: str = "xyxy",
+        iou_threshold: Optional[float] = None,
+        class_metrics: bool = False,
+        respect_labels: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(box_format, iou_threshold, class_metrics, respect_labels, **kwargs)
+
+    @staticmethod
+    def _iou_update_fn(*args: Any, **kwargs: Any) -> jnp.ndarray:
+        return _ciou_update(*args, **kwargs)

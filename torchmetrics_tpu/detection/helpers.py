@@ -1,0 +1,90 @@
+"""Detection input plumbing (reference ``detection/helpers.py:41``).
+
+The reference validates list-of-dict inputs and (for its coco backend) serializes
+states into COCO-format dicts for the pycocotools C extension
+(``detection/helpers.py:193-246``). Here validation is the same host-side contract,
+but there is no serialization layer — the mAP evaluator consumes the arrays directly
+(see ``mean_ap.py``).
+"""
+
+from __future__ import annotations
+
+from collections.abc import Sequence
+from typing import Dict, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def _is_arraylike(x) -> bool:
+    return hasattr(x, "shape") and hasattr(x, "dtype")
+
+
+def _fix_empty_arrays(boxes: jnp.ndarray) -> jnp.ndarray:
+    """Empty tensors can cause problems in DDP mode, this methods corrects them."""
+    if boxes.size == 0 and boxes.ndim == 1:
+        return boxes.reshape((0, 4))
+    return boxes
+
+
+def _input_validator(
+    preds: Sequence[Dict],
+    targets: Sequence[Dict],
+    iou_type: Union[str, Tuple[str, ...]] = "bbox",
+    ignore_score: bool = False,
+) -> None:
+    """Ensure the correct input format of `preds` and `targets` (reference
+    ``detection/helpers.py:41``)."""
+    if isinstance(iou_type, str):
+        iou_type = (iou_type,)
+    name_map = {"bbox": "boxes", "segm": "masks"}
+    if any(tp not in name_map for tp in iou_type):
+        raise Exception(f"IOU type {iou_type} is not supported")
+    item_val_name = [name_map[tp] for tp in iou_type]
+
+    if not isinstance(preds, Sequence):
+        raise ValueError(f"Expected argument `preds` to be of type Sequence, but got {preds}")
+    if not isinstance(targets, Sequence):
+        raise ValueError(f"Expected argument `target` to be of type Sequence, but got {targets}")
+    if len(preds) != len(targets):
+        raise ValueError(
+            f"Expected argument `preds` and `target` to have the same length, but got {len(preds)} and {len(targets)}"
+        )
+
+    for k in [*item_val_name, "labels"] + (["scores"] if not ignore_score else []):
+        if any(k not in p for p in preds):
+            raise ValueError(f"Expected all dicts in `preds` to contain the `{k}` key")
+    for k in [*item_val_name, "labels"]:
+        if any(k not in p for p in targets):
+            raise ValueError(f"Expected all dicts in `target` to contain the `{k}` key")
+
+    for ivn in item_val_name:
+        if not all(_is_arraylike(pred[ivn]) for pred in preds):
+            raise ValueError(f"Expected all {ivn} in `preds` to be of type Tensor")
+    if not ignore_score and not all(_is_arraylike(pred["scores"]) for pred in preds):
+        raise ValueError("Expected all scores in `preds` to be of type Tensor")
+    if not all(_is_arraylike(pred["labels"]) for pred in preds):
+        raise ValueError("Expected all labels in `preds` to be of type Tensor")
+    for ivn in item_val_name:
+        if not all(_is_arraylike(target[ivn]) for target in targets):
+            raise ValueError(f"Expected all {ivn} in `target` to be of type Tensor")
+    if not all(_is_arraylike(target["labels"]) for target in targets):
+        raise ValueError("Expected all labels in `target` to be of type Tensor")
+
+    for i, item in enumerate(targets):
+        for ivn in item_val_name:
+            if item[ivn].shape[0] != item["labels"].shape[0]:
+                raise ValueError(
+                    f"Input '{ivn}' and labels of sample {i} in targets have a"
+                    f" different length (expected {item[ivn].shape[0]} labels, got {item['labels'].shape[0]})"
+                )
+    if ignore_score:
+        return
+    for i, item in enumerate(preds):
+        for ivn in item_val_name:
+            if not (item[ivn].shape[0] == item["labels"].shape[0] == item["scores"].shape[0]):
+                raise ValueError(
+                    f"Input '{ivn}', labels and scores of sample {i} in predictions have a"
+                    f" different length (expected {item[ivn].shape[0]} labels and scores,"
+                    f" got {item['labels'].shape[0]} labels and {item['scores'].shape[0]} scores)"
+                )

@@ -1,0 +1,132 @@
+"""IntersectionOverUnion metric class (reference ``detection/iou.py:33``).
+
+TPU-first redesign of the state: the reference keeps a ragged list of per-image IoU
+matrices and loops over them at compute (``detection/iou.py:217-245``). Here every
+pair entry is flattened into uniform cat rows — ``values`` plus the gt label of its
+column — so compute is three masked reductions over one flat array and the state
+gathers across ranks as plain static-rank concats.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..functional.detection._box_ops import box_convert
+from ..functional.detection.iou import _iou_update
+from ..metric import HostMetric
+from .helpers import _fix_empty_arrays, _input_validator
+
+
+class IntersectionOverUnion(HostMetric):
+    """Computes Intersection Over Union (IoU) over list-of-dict box inputs.
+
+    Update accepts ``preds``/``target`` lists of per-image dicts with ``boxes`` (N,4)
+    and ``labels`` (N,) (plus ``scores`` ignored here); compute returns
+    ``{"iou": mean, ...}`` with optional per-class entries.
+    """
+
+    is_differentiable: bool = False
+    higher_is_better: Optional[bool] = True
+    full_state_update: bool = True
+
+    _iou_type: str = "iou"
+    _invalid_val: float = -1.0
+
+    def __init__(
+        self,
+        box_format: str = "xyxy",
+        iou_threshold: Optional[float] = None,
+        class_metrics: bool = False,
+        respect_labels: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        allowed_box_formats = ("xyxy", "xywh", "cxcywh")
+        if box_format not in allowed_box_formats:
+            raise ValueError(f"Expected argument `box_format` to be one of {allowed_box_formats} but got {box_format}")
+        self.box_format = box_format
+        self.iou_threshold = iou_threshold
+        if not isinstance(class_metrics, bool):
+            raise ValueError("Expected argument `class_metrics` to be a boolean")
+        self.class_metrics = class_metrics
+        if not isinstance(respect_labels, bool):
+            raise ValueError("Expected argument `respect_labels` to be a boolean")
+        self.respect_labels = respect_labels
+
+        self.add_state("iou_values", default=[], dist_reduce_fx="cat")
+        self.add_state("iou_col_labels", default=[], dist_reduce_fx="cat")
+        self.add_state("groundtruth_labels", default=[], dist_reduce_fx="cat")
+        self.add_state("pred_labels", default=[], dist_reduce_fx="cat")
+
+    @staticmethod
+    def _iou_update_fn(*args: Any, **kwargs: Any) -> jnp.ndarray:
+        return _iou_update(*args, **kwargs)
+
+    def _get_safe_item_values(self, boxes) -> jnp.ndarray:
+        boxes = _fix_empty_arrays(jnp.asarray(boxes, jnp.float32))
+        if boxes.size > 0:
+            boxes = box_convert(boxes, in_fmt=self.box_format, out_fmt="xyxy")
+        return boxes
+
+    def _host_batch_state(self, preds: Sequence[Dict], target: Sequence[Dict]) -> Dict[str, jnp.ndarray]:
+        _input_validator(preds, target, ignore_score=True)
+        values: List[np.ndarray] = []
+        col_labels: List[np.ndarray] = []
+        gt_labels: List[np.ndarray] = []
+        pr_labels: List[np.ndarray] = []
+        for p_i, t_i in zip(preds, target):
+            det_boxes = self._get_safe_item_values(p_i["boxes"])
+            gt_boxes = self._get_safe_item_values(t_i["boxes"])
+            p_lab = np.asarray(p_i["labels"]).astype(np.int32).reshape(-1)
+            t_lab = np.asarray(t_i["labels"]).astype(np.int32).reshape(-1)
+            gt_labels.append(t_lab)
+            pr_labels.append(p_lab)
+
+            mat = np.asarray(self._iou_update_fn(det_boxes, gt_boxes, self.iou_threshold, self._invalid_val))
+            if self.respect_labels:
+                if det_boxes.size > 0 and gt_boxes.size > 0:
+                    label_eq = p_lab[:, None] == t_lab[None, :]
+                else:
+                    label_eq = np.eye(mat.shape[0], dtype=bool)
+                mat = np.where(label_eq, mat, self._invalid_val)
+            # column j of the matrix corresponds to gt box j when both sides are
+            # non-empty OR preds are empty (gt-square zeros); otherwise no gt exists
+            if gt_boxes.size > 0 and mat.shape[-1] == t_lab.shape[0]:
+                cols = np.broadcast_to(t_lab[None, :], mat.shape)
+            else:
+                cols = np.full(mat.shape, -1, np.int32)
+            values.append(mat.reshape(-1).astype(np.float32))
+            col_labels.append(cols.reshape(-1).astype(np.int32))
+        cat = lambda parts, dtype: (
+            jnp.asarray(np.concatenate(parts), dtype) if parts else jnp.zeros((0,), dtype)
+        )
+        return {
+            "iou_values": cat(values, jnp.float32),
+            "iou_col_labels": cat(col_labels, jnp.int32),
+            "groundtruth_labels": cat(gt_labels, jnp.int32),
+            "pred_labels": cat(pr_labels, jnp.int32),
+        }
+
+    def _compute(self, state: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
+        values = np.asarray(state["iou_values"], np.float64)
+        valid = values != self._invalid_val
+        score = float(values[valid].mean()) if valid.any() else 0.0
+        if np.isnan(score):
+            score = 0.0
+        results = {f"{self._iou_type}": jnp.asarray(score, jnp.float32)}
+        if self.class_metrics:
+            cols = np.asarray(state["iou_col_labels"])
+            all_labels = np.concatenate([
+                np.asarray(state["groundtruth_labels"]).reshape(-1),
+                np.asarray(state["pred_labels"]).reshape(-1),
+            ])
+            for cl in np.unique(all_labels).tolist():
+                mask = valid & (cols == cl)
+                if mask.sum() == 0:
+                    results[f"{self._iou_type}/cl_{cl}"] = jnp.asarray(0.0, jnp.float32)
+                else:
+                    results[f"{self._iou_type}/cl_{cl}"] = jnp.asarray(values[mask].mean(), jnp.float32)
+        return results

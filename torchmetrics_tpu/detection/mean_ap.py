@@ -1,0 +1,407 @@
+"""MeanAveragePrecision — pure-JAX COCO mAP (reference ``detection/mean_ap.py:50``).
+
+The reference serializes its list states into COCO dicts and calls the pycocotools /
+faster_coco_eval C extensions (``detection/helpers.py:152,666``). Here the evaluator is
+in-tree (``functional/detection/_map_eval.py``): a batched ``lax.scan`` matcher over a
+flat cat-row state. State design: instead of ragged per-image tensors the state is
+uniform rows (boxes/scores/labels) plus a per-image ``counts`` vector, so cross-rank
+sync is plain static-rank concatenation and image boundaries survive any merge order.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..functional.detection._box_ops import box_convert
+from ..functional.detection._map_eval import MAPInputs, evaluate_map, summarize
+from ..metric import HostMetric
+from .helpers import _fix_empty_arrays, _input_validator
+
+
+def _split_by_counts(flat: np.ndarray, counts: np.ndarray) -> List[np.ndarray]:
+    """Reconstruct per-image arrays from cat rows + per-image counts."""
+    return np.split(flat, np.cumsum(counts)[:-1]) if counts.size else []
+
+
+class MeanAveragePrecision(HostMetric):
+    """Mean Average Precision / Recall for object detection (COCO protocol).
+
+    Public surface matches the reference (``detection/mean_ap.py:315``): ``box_format``
+    xyxy/xywh/cxcywh, ``iou_type`` "bbox"/"segm" or a tuple of both, custom
+    IoU/recall/max-detection thresholds, ``class_metrics``, ``extended_summary``,
+    ``average`` macro/micro. ``backend`` is accepted for API parity but ignored — the
+    evaluator is always the in-tree JAX matcher.
+
+    ``target`` dicts may carry ``iscrowd`` and ``area`` like the reference's coco
+    backend; crowd ground truths use the COCO crowd-IoU convention and are ignored in
+    scoring.
+    """
+
+    is_differentiable: bool = False
+    higher_is_better: Optional[bool] = True
+    full_state_update: bool = True
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    warn_on_many_detections: bool = True
+
+    def __init__(
+        self,
+        box_format: str = "xyxy",
+        iou_type: Union[str, Tuple[str, ...]] = "bbox",
+        iou_thresholds: Optional[List[float]] = None,
+        rec_thresholds: Optional[List[float]] = None,
+        max_detection_thresholds: Optional[List[int]] = None,
+        class_metrics: bool = False,
+        extended_summary: bool = False,
+        average: str = "macro",
+        backend: str = "pycocotools",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        allowed_box_formats = ("xyxy", "xywh", "cxcywh")
+        if box_format not in allowed_box_formats:
+            raise ValueError(f"Expected argument `box_format` to be one of {allowed_box_formats} but got {box_format}")
+        self.box_format = box_format
+        self.iou_type = (iou_type,) if isinstance(iou_type, str) else tuple(iou_type)
+        if any(tp not in ("bbox", "segm") for tp in self.iou_type):
+            raise ValueError(f"Expected argument `iou_type` to be one of ('bbox', 'segm') but got {iou_type}")
+        if iou_thresholds is not None and not isinstance(iou_thresholds, list):
+            raise ValueError(
+                f"Expected argument `iou_thresholds` to either be `None` or a list of floats but got {iou_thresholds}"
+            )
+        self.iou_thresholds = iou_thresholds or np.linspace(0.5, 0.95, round((0.95 - 0.5) / 0.05) + 1).tolist()
+        if rec_thresholds is not None and not isinstance(rec_thresholds, list):
+            raise ValueError(
+                f"Expected argument `rec_thresholds` to either be `None` or a list of floats but got {rec_thresholds}"
+            )
+        self.rec_thresholds = rec_thresholds or np.linspace(0.0, 1.00, round(1.00 / 0.01) + 1).tolist()
+        if max_detection_thresholds is not None and not isinstance(max_detection_thresholds, list):
+            raise ValueError(
+                f"Expected argument `max_detection_thresholds` to either be `None` or a list of ints"
+                f" but got {max_detection_thresholds}"
+            )
+        if max_detection_thresholds is not None and len(max_detection_thresholds) != 3:
+            raise ValueError(
+                "When providing a list of max detection thresholds it should have length 3."
+                f" Got value {len(max_detection_thresholds)}"
+            )
+        self.max_detection_thresholds = sorted(max_detection_thresholds or [1, 10, 100])
+        if not isinstance(class_metrics, bool):
+            raise ValueError("Expected argument `class_metrics` to be a boolean")
+        self.class_metrics = class_metrics
+        if not isinstance(extended_summary, bool):
+            raise ValueError("Expected argument `extended_summary` to be a boolean")
+        self.extended_summary = extended_summary
+        if average not in ("macro", "micro"):
+            raise ValueError(f"Expected argument `average` to be one of ('macro', 'micro') but got {average}")
+        self.average = average
+        if backend not in ("pycocotools", "faster_coco_eval"):
+            raise ValueError(
+                f"Expected argument `backend` to be one of ('pycocotools', 'faster_coco_eval') but got {backend}"
+            )
+        self.backend = backend  # accepted for parity; evaluator is the in-tree JAX matcher
+
+        self.add_state("detection_box", default=[], dist_reduce_fx="cat")
+        self.add_state("detection_scores", default=[], dist_reduce_fx="cat")
+        self.add_state("detection_labels", default=[], dist_reduce_fx="cat")
+        self.add_state("detection_counts", default=[], dist_reduce_fx="cat")
+        self.add_state("groundtruth_box", default=[], dist_reduce_fx="cat")
+        self.add_state("groundtruth_labels", default=[], dist_reduce_fx="cat")
+        self.add_state("groundtruth_crowds", default=[], dist_reduce_fx="cat")
+        self.add_state("groundtruth_area", default=[], dist_reduce_fx="cat")
+        self.add_state("groundtruth_counts", default=[], dist_reduce_fx="cat")
+        if "segm" in self.iou_type:
+            # ragged (N, H, W) per image — stays a host list, excluded from concat
+            self.add_state("detection_mask", default=[], dist_reduce_fx="cat")
+            self.add_state("groundtruth_mask", default=[], dist_reduce_fx="cat")
+
+    # ------------------------------------------------------------------ update
+
+    def _boxes_xyxy(self, boxes) -> np.ndarray:
+        boxes = _fix_empty_arrays(jnp.asarray(boxes, jnp.float32))
+        if boxes.size > 0:
+            boxes = box_convert(boxes, in_fmt=self.box_format, out_fmt="xyxy")
+        return np.asarray(boxes, np.float32).reshape(-1, 4)
+
+    def _host_batch_state(self, preds: Sequence[Dict], target: Sequence[Dict]) -> Dict[str, Any]:
+        _input_validator(preds, target, iou_type=self.iou_type)
+        det_box, det_score, det_label, det_count = [], [], [], []
+        det_mask, gt_mask = [], []
+        gt_box, gt_label, gt_crowd, gt_area, gt_count = [], [], [], [], []
+        for item in preds:
+            boxes = self._boxes_xyxy(item.get("boxes", np.zeros((0, 4)))) if "bbox" in self.iou_type else np.zeros(
+                (len(np.asarray(item["labels"]).reshape(-1)), 4), np.float32
+            )
+            labels = np.asarray(item["labels"]).astype(np.int32).reshape(-1)
+            scores = np.asarray(item["scores"]).astype(np.float32).reshape(-1)
+            if self.warn_on_many_detections and labels.size > self.max_detection_thresholds[-1]:
+                from ..utilities.prints import rank_zero_warn
+
+                rank_zero_warn(
+                    f"Encountered more than {self.max_detection_thresholds[-1]} detections in a single image. "
+                    "This means that certain detections with the lowest scores will be ignored, that may have "
+                    "an undesirable impact on performance. Please consider adjusting the `max_detection_threshold` "
+                    "argument to adjust this behavior.",
+                    UserWarning,
+                )
+            det_box.append(boxes)
+            det_score.append(scores)
+            det_label.append(labels)
+            det_count.append(labels.size)
+            if "segm" in self.iou_type:
+                det_mask.append(np.asarray(item["masks"]).astype(bool))
+        for item in target:
+            labels = np.asarray(item["labels"]).astype(np.int32).reshape(-1)
+            boxes = self._boxes_xyxy(item.get("boxes", np.zeros((0, 4)))) if "bbox" in self.iou_type else np.zeros(
+                (labels.size, 4), np.float32
+            )
+            gt_box.append(boxes)
+            gt_label.append(labels)
+            crowd = item.get("iscrowd")
+            gt_crowd.append(
+                np.asarray(crowd).astype(np.int32).reshape(-1) if crowd is not None else np.zeros(labels.size, np.int32)
+            )
+            area = item.get("area")
+            gt_area.append(
+                np.asarray(area).astype(np.float32).reshape(-1) if area is not None else np.zeros(labels.size, np.float32)
+            )
+            gt_count.append(labels.size)
+            if "segm" in self.iou_type:
+                gt_mask.append(np.asarray(item["masks"]).astype(bool))
+
+        cat = lambda parts, dtype, width=None: (
+            jnp.asarray(np.concatenate(parts).astype(dtype))
+            if parts
+            else jnp.zeros((0,) if width is None else (0, width), dtype)
+        )
+        out = {
+            "detection_box": cat(det_box, np.float32, 4),
+            "detection_scores": cat(det_score, np.float32),
+            "detection_labels": cat(det_label, np.int32),
+            "detection_counts": jnp.asarray(np.asarray(det_count, np.int32)),
+            "groundtruth_box": cat(gt_box, np.float32, 4),
+            "groundtruth_labels": cat(gt_label, np.int32),
+            "groundtruth_crowds": cat(gt_crowd, np.int32),
+            "groundtruth_area": cat(gt_area, np.float32),
+            "groundtruth_counts": jnp.asarray(np.asarray(gt_count, np.int32)),
+        }
+        if "segm" in self.iou_type:
+            out["detection_mask"] = det_mask
+            out["groundtruth_mask"] = gt_mask
+        return out
+
+    def _fold_batch(self, bs: Dict[str, Any]) -> None:
+        # mask entries are python lists of ragged arrays: extend instead of append
+        for key in ("detection_mask", "groundtruth_mask"):
+            if key in bs:
+                self._state[key].extend(bs.pop(key))
+        super()._fold_batch(bs)
+
+    def _concat_state(self, state: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        state = self._state if state is None else state
+        out = {}
+        for k, v in state.items():
+            if k in ("detection_mask", "groundtruth_mask"):
+                flat: list = []
+                for e in v if isinstance(v, list) else [v]:
+                    flat.extend(e) if isinstance(e, list) else flat.append(e)
+                out[k] = flat
+            elif isinstance(v, list):
+                if len(v) == 0:
+                    width = 4 if k.endswith("_box") else None
+                    out[k] = jnp.zeros((0,) if width is None else (0, width), jnp.float32)
+                else:
+                    from ..utilities.data import dim_zero_cat
+
+                    out[k] = dim_zero_cat(v)
+            else:
+                out[k] = v
+        return out
+
+    # ----------------------------------------------------------------- compute
+
+    def _inputs_from_state(self, state: Dict[str, Any]) -> MAPInputs:
+        det_counts = np.asarray(state["detection_counts"]).astype(np.int64).reshape(-1)
+        gt_counts = np.asarray(state["groundtruth_counts"]).astype(np.int64).reshape(-1)
+        det_masks = state.get("detection_mask")
+        gt_masks = state.get("groundtruth_mask")
+        if isinstance(det_masks, list) and len(det_masks) == 0:
+            det_masks = None
+        if isinstance(gt_masks, list) and len(gt_masks) == 0:
+            gt_masks = None
+        return MAPInputs(
+            det_boxes=_split_by_counts(np.asarray(state["detection_box"], np.float64).reshape(-1, 4), det_counts),
+            det_scores=_split_by_counts(np.asarray(state["detection_scores"], np.float64).reshape(-1), det_counts),
+            det_labels=_split_by_counts(np.asarray(state["detection_labels"]).reshape(-1), det_counts),
+            gt_boxes=_split_by_counts(np.asarray(state["groundtruth_box"], np.float64).reshape(-1, 4), gt_counts),
+            gt_labels=_split_by_counts(np.asarray(state["groundtruth_labels"]).reshape(-1), gt_counts),
+            gt_crowds=_split_by_counts(np.asarray(state["groundtruth_crowds"]).reshape(-1), gt_counts),
+            gt_areas=_split_by_counts(np.asarray(state["groundtruth_area"], np.float64).reshape(-1), gt_counts),
+            det_masks=det_masks,
+            gt_masks=gt_masks,
+        )
+
+    def _compute(self, state: Dict[str, Any]) -> Dict[str, jnp.ndarray]:
+        inputs = self._inputs_from_state(state)
+        if self.average == "micro":
+            micro_inputs = MAPInputs(
+                det_boxes=inputs.det_boxes,
+                det_scores=inputs.det_scores,
+                det_labels=[np.zeros_like(x) for x in inputs.det_labels],
+                gt_boxes=inputs.gt_boxes,
+                gt_labels=[np.zeros_like(x) for x in inputs.gt_labels],
+                gt_crowds=inputs.gt_crowds,
+                gt_areas=inputs.gt_areas,
+                det_masks=inputs.det_masks,
+                gt_masks=inputs.gt_masks,
+            )
+        result: Dict[str, jnp.ndarray] = {}
+        for i_type in self.iou_type:
+            prefix = "" if len(self.iou_type) == 1 else f"{i_type}_"
+            main_inputs = micro_inputs if self.average == "micro" else inputs
+            if inputs.num_images == 0:
+                stats = {key: -1.0 for key in (
+                    "map", "map_50", "map_75", "map_small", "map_medium", "map_large",
+                    *(f"mar_{m}" for m in self.max_detection_thresholds),
+                    "mar_small", "mar_medium", "mar_large",
+                )}
+                for key, val in stats.items():
+                    result[f"{prefix}{key}"] = jnp.asarray(val, jnp.float32)
+                result[f"{prefix}map_per_class"] = jnp.asarray([-1.0], jnp.float32)
+                result[f"{prefix}mar_{self.max_detection_thresholds[-1]}_per_class"] = jnp.asarray([-1.0], jnp.float32)
+                continue
+            ev = evaluate_map(
+                main_inputs, i_type, self.iou_thresholds, self.rec_thresholds,
+                self.max_detection_thresholds, want_ious=self.extended_summary,
+            )
+            stats = summarize(ev["precision"], ev["recall"], self.iou_thresholds, self.max_detection_thresholds)
+            for key, val in stats.items():
+                result[f"{prefix}{key}"] = jnp.asarray(val, jnp.float32)
+            if self.extended_summary:
+                result[f"{prefix}ious"] = {k: jnp.asarray(v) for k, v in ev["ious"].items()}
+                result[f"{prefix}precision"] = jnp.asarray(ev["precision"], jnp.float32)
+                result[f"{prefix}recall"] = jnp.asarray(ev["recall"], jnp.float32)
+                result[f"{prefix}scores"] = jnp.asarray(ev["scores"], jnp.float32)
+            if self.class_metrics:
+                # per-class eval always uses the true labels (reference helpers.py:744-758)
+                ev_cls = (
+                    ev
+                    if self.average == "macro"
+                    else evaluate_map(
+                        inputs, i_type, self.iou_thresholds, self.rec_thresholds, self.max_detection_thresholds
+                    )
+                )
+                map_pc, mar_pc = [], []
+                for k_idx in range(len(ev_cls["classes"])):
+                    s = summarize(
+                        ev_cls["precision"], ev_cls["recall"], self.iou_thresholds,
+                        self.max_detection_thresholds, class_idx=k_idx,
+                    )
+                    map_pc.append(s["map"])
+                    mar_pc.append(s[f"mar_{self.max_detection_thresholds[-1]}"])
+                result[f"{prefix}map_per_class"] = jnp.asarray(map_pc, jnp.float32)
+                result[f"{prefix}mar_{self.max_detection_thresholds[-1]}_per_class"] = jnp.asarray(
+                    mar_pc, jnp.float32
+                )
+            else:
+                result[f"{prefix}map_per_class"] = jnp.asarray(-1.0, jnp.float32)
+                result[f"{prefix}mar_{self.max_detection_thresholds[-1]}_per_class"] = jnp.asarray(-1.0, jnp.float32)
+        classes = inputs.classes()
+        result["classes"] = jnp.asarray(classes, jnp.int32)
+        return result
+
+    # ------------------------------------------------------------- converters
+
+    def tm_to_coco(self, name: str = "tm_map_input") -> None:
+        """Dump the cached inputs to ``{name}_preds.json`` / ``{name}_target.json`` in
+        COCO format (reference ``detection/mean_ap.py:430``; no pycocotools needed for
+        bbox)."""
+        import json
+
+        state = self._concat_state()
+        inputs = self._inputs_from_state(state)
+        images = [{"id": i} for i in range(inputs.num_images)]
+        classes = [{"id": int(c), "name": str(int(c))} for c in inputs.classes()]
+        annotations = []
+        ann_id = 1
+        for i in range(inputs.num_images):
+            for j in range(inputs.gt_labels[i].size):
+                x1, y1, x2, y2 = inputs.gt_boxes[i][j].tolist()
+                annotations.append({
+                    "id": ann_id,
+                    "image_id": i,
+                    "category_id": int(inputs.gt_labels[i][j]),
+                    "bbox": [x1, y1, x2 - x1, y2 - y1],
+                    "area": float(inputs.gt_areas[i][j]) if inputs.gt_areas[i][j] > 0 else float((x2 - x1) * (y2 - y1)),
+                    "iscrowd": int(inputs.gt_crowds[i][j]),
+                })
+                ann_id += 1
+        target_dict = {"images": images, "annotations": annotations, "categories": classes}
+        preds_list = []
+        for i in range(inputs.num_images):
+            for j in range(inputs.det_labels[i].size):
+                x1, y1, x2, y2 = inputs.det_boxes[i][j].tolist()
+                preds_list.append({
+                    "image_id": i,
+                    "category_id": int(inputs.det_labels[i][j]),
+                    "bbox": [x1, y1, x2 - x1, y2 - y1],
+                    "score": float(inputs.det_scores[i][j]),
+                })
+        with open(f"{name}_preds.json", "w") as f:
+            json.dump(preds_list, f)
+        with open(f"{name}_target.json", "w") as f:
+            json.dump(target_dict, f)
+
+    @staticmethod
+    def coco_to_tm(
+        coco_preds: str,
+        coco_target: str,
+        iou_type: Union[str, Tuple[str, ...]] = ("bbox",),
+        backend: str = "pycocotools",
+    ) -> Tuple[List[Dict[str, jnp.ndarray]], List[Dict[str, jnp.ndarray]]]:
+        """Load COCO-format json files into this metric's input format (reference
+        ``detection/mean_ap.py:475``; bbox only, no pycocotools needed)."""
+        import json
+
+        with open(coco_target) as f:
+            tgt = json.load(f)
+        with open(coco_preds) as f:
+            prd = json.load(f)
+        img_ids = sorted(img["id"] for img in tgt["images"])
+        by_img_t: Dict[Any, Dict[str, list]] = {i: {"boxes": [], "labels": [], "iscrowd": [], "area": []} for i in img_ids}
+        for ann in tgt["annotations"]:
+            x, y, w, h = ann["bbox"]
+            rec = by_img_t[ann["image_id"]]
+            rec["boxes"].append([x, y, x + w, y + h])
+            rec["labels"].append(ann["category_id"])
+            rec["iscrowd"].append(ann.get("iscrowd", 0))
+            rec["area"].append(ann.get("area", w * h))
+        by_img_p: Dict[Any, Dict[str, list]] = {i: {"boxes": [], "labels": [], "scores": []} for i in img_ids}
+        for ann in prd if isinstance(prd, list) else prd["annotations"]:
+            x, y, w, h = ann["bbox"]
+            rec = by_img_p[ann["image_id"]]
+            rec["boxes"].append([x, y, x + w, y + h])
+            rec["labels"].append(ann["category_id"])
+            rec["scores"].append(ann["score"])
+        target_out = [
+            {
+                "boxes": jnp.asarray(np.asarray(r["boxes"], np.float32).reshape(-1, 4)),
+                "labels": jnp.asarray(np.asarray(r["labels"], np.int32)),
+                "iscrowd": jnp.asarray(np.asarray(r["iscrowd"], np.int32)),
+                "area": jnp.asarray(np.asarray(r["area"], np.float32)),
+            }
+            for r in (by_img_t[i] for i in img_ids)
+        ]
+        preds_out = [
+            {
+                "boxes": jnp.asarray(np.asarray(r["boxes"], np.float32).reshape(-1, 4)),
+                "labels": jnp.asarray(np.asarray(r["labels"], np.int32)),
+                "scores": jnp.asarray(np.asarray(r["scores"], np.float32)),
+            }
+            for r in (by_img_p[i] for i in img_ids)
+        ]
+        return preds_out, target_out

@@ -5,15 +5,17 @@ Every domain package declares its public surface in its own ``__all__``; this mo
 aggregates them so the flat ``torchmetrics_tpu.functional.<fn>`` namespace stays in
 lock-step with the per-domain namespaces as domains are added."""
 
-from torchmetrics_tpu.functional import classification, regression, retrieval, segmentation
+from torchmetrics_tpu.functional import classification, detection, regression, retrieval, segmentation
 from torchmetrics_tpu.functional.classification import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.regression import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.retrieval import *  # noqa: F401,F403
+from torchmetrics_tpu.functional.detection import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.segmentation import *  # noqa: F401,F403
 
 __all__ = [
     *classification.__all__,
     *regression.__all__,
     *retrieval.__all__,
+    *detection.__all__,
     *segmentation.__all__,
 ]

@@ -1,0 +1,13 @@
+"""Detection tower — stateless kernels (reference ``src/torchmetrics/functional/detection/``)."""
+
+from .ciou import complete_intersection_over_union
+from .diou import distance_intersection_over_union
+from .giou import generalized_intersection_over_union
+from .iou import intersection_over_union
+
+__all__ = [
+    "complete_intersection_over_union",
+    "distance_intersection_over_union",
+    "generalized_intersection_over_union",
+    "intersection_over_union",
+]

@@ -1,0 +1,105 @@
+"""Pairwise box kernels — pure jnp, static-shape, MXU/VPU-friendly.
+
+The reference delegates these to torchvision ops (``detection/iou.py:21``,
+``functional/detection/iou.py:33``); here they are first-class jittable kernels so the
+whole IoU family (and the mAP matcher built on top) stays in-graph. All kernels accept
+arbitrary leading batch dimensions: ``(..., N, 4) x (..., M, 4) -> (..., N, M)``, which
+is what lets the mAP evaluator vmap one fused matcher over images x area ranges x IoU
+thresholds instead of the reference's per-image Python loop.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+_EPS = 1e-7
+
+
+def box_convert(boxes: jnp.ndarray, in_fmt: str, out_fmt: str = "xyxy") -> jnp.ndarray:
+    """Convert ``(..., 4)`` boxes between xyxy / xywh / cxcywh formats."""
+    if in_fmt == out_fmt:
+        return boxes
+    if out_fmt != "xyxy":
+        raise ValueError(f"Only conversion to 'xyxy' is supported, got {out_fmt}")
+    a, b, c, d = boxes[..., 0], boxes[..., 1], boxes[..., 2], boxes[..., 3]
+    if in_fmt == "xywh":
+        return jnp.stack([a, b, a + c, b + d], axis=-1)
+    if in_fmt == "cxcywh":
+        return jnp.stack([a - c / 2, b - d / 2, a + c / 2, b + d / 2], axis=-1)
+    raise ValueError(f"Unsupported box format {in_fmt}")
+
+
+def box_area(boxes: jnp.ndarray) -> jnp.ndarray:
+    """Area of ``(..., 4)`` xyxy boxes -> ``(...,)``."""
+    return (boxes[..., 2] - boxes[..., 0]) * (boxes[..., 3] - boxes[..., 1])
+
+
+def _pairwise_intersection(preds: jnp.ndarray, target: jnp.ndarray) -> jnp.ndarray:
+    lt = jnp.maximum(preds[..., :, None, :2], target[..., None, :, :2])
+    rb = jnp.minimum(preds[..., :, None, 2:], target[..., None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    return wh[..., 0] * wh[..., 1]
+
+
+def box_iou_matrix(preds: jnp.ndarray, target: jnp.ndarray) -> jnp.ndarray:
+    """Pairwise IoU: ``(..., N, 4) x (..., M, 4) -> (..., N, M)``."""
+    inter = _pairwise_intersection(preds, target)
+    union = box_area(preds)[..., :, None] + box_area(target)[..., None, :] - inter
+    return jnp.where(union > 0, inter / jnp.where(union > 0, union, 1.0), 0.0)
+
+
+def box_iou_matrix_crowd(preds: jnp.ndarray, target: jnp.ndarray, crowd: jnp.ndarray) -> jnp.ndarray:
+    """Pairwise IoU with the COCO crowd convention: for crowd ground truths the
+    denominator is the detection area alone (pycocotools ``maskUtils.iou`` iscrowd
+    semantics, used by the reference through its coco backend)."""
+    inter = _pairwise_intersection(preds, target)
+    pred_area = box_area(preds)[..., :, None]
+    union = pred_area + box_area(target)[..., None, :] - inter
+    denom = jnp.where(crowd[..., None, :], pred_area, union)
+    return jnp.where(denom > 0, inter / jnp.where(denom > 0, denom, 1.0), 0.0)
+
+
+def _enclosure_wh(preds: jnp.ndarray, target: jnp.ndarray) -> jnp.ndarray:
+    lt = jnp.minimum(preds[..., :, None, :2], target[..., None, :, :2])
+    rb = jnp.maximum(preds[..., :, None, 2:], target[..., None, :, 2:])
+    return jnp.clip(rb - lt, 0)
+
+
+def generalized_box_iou_matrix(preds: jnp.ndarray, target: jnp.ndarray) -> jnp.ndarray:
+    """Pairwise GIoU = IoU - (enclosure - union) / enclosure."""
+    inter = _pairwise_intersection(preds, target)
+    union = box_area(preds)[..., :, None] + box_area(target)[..., None, :] - inter
+    iou = jnp.where(union > 0, inter / jnp.where(union > 0, union, 1.0), 0.0)
+    whi = _enclosure_wh(preds, target)
+    areai = whi[..., 0] * whi[..., 1]
+    return iou - jnp.where(areai > 0, (areai - union) / jnp.where(areai > 0, areai, 1.0), 0.0)
+
+
+def _center_distance_ratio(preds: jnp.ndarray, target: jnp.ndarray) -> jnp.ndarray:
+    whi = _enclosure_wh(preds, target)
+    diag = whi[..., 0] ** 2 + whi[..., 1] ** 2 + _EPS
+    cp = (preds[..., :2] + preds[..., 2:]) / 2
+    ct = (target[..., :2] + target[..., 2:]) / 2
+    d = cp[..., :, None, :] - ct[..., None, :, :]
+    return (d[..., 0] ** 2 + d[..., 1] ** 2) / diag
+
+
+def distance_box_iou_matrix(preds: jnp.ndarray, target: jnp.ndarray) -> jnp.ndarray:
+    """Pairwise DIoU = IoU - centre-distance^2 / enclosure-diagonal^2 (eps matches
+    torchvision's ``distance_box_iou``)."""
+    return box_iou_matrix(preds, target) - _center_distance_ratio(preds, target)
+
+
+def complete_box_iou_matrix(preds: jnp.ndarray, target: jnp.ndarray) -> jnp.ndarray:
+    """Pairwise CIoU = DIoU - alpha * v (aspect-ratio consistency term)."""
+    iou = box_iou_matrix(preds, target)
+    diou = iou - _center_distance_ratio(preds, target)
+    wp = preds[..., 2] - preds[..., 0]
+    hp = preds[..., 3] - preds[..., 1]
+    wt = target[..., 2] - target[..., 0]
+    ht = target[..., 3] - target[..., 1]
+    v = (4 / (jnp.pi**2)) * (
+        jnp.arctan(wt / ht)[..., None, :] - jnp.arctan(wp / hp)[..., :, None]
+    ) ** 2
+    alpha = v / (1 - iou + v + _EPS)
+    return diou - alpha * v

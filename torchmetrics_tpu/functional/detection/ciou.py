@@ -1,0 +1,30 @@
+"""Complete IoU — functional (reference ``functional/detection/ciou.py:52``)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ._box_ops import complete_box_iou_matrix
+from .iou import _family_compute, _family_update
+
+
+def _ciou_update(preds, target, iou_threshold: Optional[float], replacement_val: float = 0) -> jnp.ndarray:
+    return _family_update(preds, target, iou_threshold, replacement_val, complete_box_iou_matrix)
+
+
+def _ciou_compute(iou: jnp.ndarray, aggregate: bool = True) -> jnp.ndarray:
+    return _family_compute(iou, aggregate)
+
+
+def complete_intersection_over_union(
+    preds: jnp.ndarray,
+    target: jnp.ndarray,
+    iou_threshold: Optional[float] = None,
+    replacement_val: float = 0,
+    aggregate: bool = True,
+) -> jnp.ndarray:
+    """Compute CIoU between two sets of xyxy boxes."""
+    iou = _ciou_update(preds, target, iou_threshold, replacement_val)
+    return _ciou_compute(iou, aggregate)

@@ -1,0 +1,30 @@
+"""Distance IoU — functional (reference ``functional/detection/diou.py:52``)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ._box_ops import distance_box_iou_matrix
+from .iou import _family_compute, _family_update
+
+
+def _diou_update(preds, target, iou_threshold: Optional[float], replacement_val: float = 0) -> jnp.ndarray:
+    return _family_update(preds, target, iou_threshold, replacement_val, distance_box_iou_matrix)
+
+
+def _diou_compute(iou: jnp.ndarray, aggregate: bool = True) -> jnp.ndarray:
+    return _family_compute(iou, aggregate)
+
+
+def distance_intersection_over_union(
+    preds: jnp.ndarray,
+    target: jnp.ndarray,
+    iou_threshold: Optional[float] = None,
+    replacement_val: float = 0,
+    aggregate: bool = True,
+) -> jnp.ndarray:
+    """Compute DIoU between two sets of xyxy boxes."""
+    iou = _diou_update(preds, target, iou_threshold, replacement_val)
+    return _diou_compute(iou, aggregate)

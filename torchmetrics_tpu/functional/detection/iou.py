@@ -1,0 +1,71 @@
+"""Intersection over Union — functional (reference ``functional/detection/iou.py``).
+
+The reference wraps torchvision's ``box_iou`` and mutates the matrix in place
+(``functional/detection/iou.py:24-49``); here the pairwise kernel is an in-tree jnp
+kernel (``_box_ops.box_iou_matrix``) and thresholding is a ``jnp.where`` so the whole
+path stays jittable.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+
+from ._box_ops import box_iou_matrix
+
+
+def _family_update(
+    preds: jnp.ndarray,
+    target: jnp.ndarray,
+    iou_threshold: Optional[float],
+    replacement_val: float,
+    matrix_fn: Callable,
+) -> jnp.ndarray:
+    """Shared update for the IoU variant family: validate, handle empty sets the way
+    the reference does (square zero matrices), compute the pairwise matrix, apply the
+    threshold floor."""
+    preds = jnp.asarray(preds, jnp.float32)
+    target = jnp.asarray(target, jnp.float32)
+    if preds.ndim != 2 or preds.shape[-1] != 4:
+        raise ValueError(f"Expected preds to be of shape (N, 4) but got {preds.shape}")
+    if target.ndim != 2 or target.shape[-1] != 4:
+        raise ValueError(f"Expected target to be of shape (N, 4) but got {target.shape}")
+    if preds.size == 0:  # no predicted boxes (reference returns a gt-square zero matrix)
+        return jnp.zeros((target.shape[0], target.shape[0]), jnp.float32)
+    if target.size == 0:  # no true boxes
+        return jnp.zeros((preds.shape[0], preds.shape[0]), jnp.float32)
+    iou = matrix_fn(preds, target)
+    if iou_threshold is not None:
+        iou = jnp.where(iou < iou_threshold, replacement_val, iou)
+    return iou
+
+
+def _family_compute(iou: jnp.ndarray, aggregate: bool = True) -> jnp.ndarray:
+    if not aggregate:
+        return iou
+    if iou.size == 0:
+        return jnp.asarray(0.0, jnp.float32)
+    return jnp.diagonal(iou).mean()
+
+
+def _iou_update(preds, target, iou_threshold: Optional[float], replacement_val: float = 0) -> jnp.ndarray:
+    return _family_update(preds, target, iou_threshold, replacement_val, box_iou_matrix)
+
+
+def _iou_compute(iou: jnp.ndarray, aggregate: bool = True) -> jnp.ndarray:
+    return _family_compute(iou, aggregate)
+
+
+def intersection_over_union(
+    preds: jnp.ndarray,
+    target: jnp.ndarray,
+    iou_threshold: Optional[float] = None,
+    replacement_val: float = 0,
+    aggregate: bool = True,
+) -> jnp.ndarray:
+    """Compute IoU between two sets of xyxy boxes (reference
+    ``functional/detection/iou.py:52``). ``aggregate=True`` returns the mean of the
+    matrix diagonal; otherwise the full ``(N, M)`` matrix."""
+    iou = _iou_update(preds, target, iou_threshold, replacement_val)
+    return _iou_compute(iou, aggregate)

@@ -33,11 +33,7 @@ def _ignore_background(preds: Array, target: Array) -> Tuple[Array, Array]:
     return preds, target
 
 
-def _check_same_shape_host(preds, target) -> None:
-    if tuple(preds.shape) != tuple(target.shape):
-        raise RuntimeError(
-            f"Predictions and targets are expected to have the same shape, got {preds.shape} and {target.shape}."
-        )
+from ...utilities.checks import _check_same_shape as _check_same_shape_host
 
 
 def _check_mixed_shape(preds, target) -> None:
@@ -168,7 +164,7 @@ def binary_erosion(image: Array, structure: Optional[Array] = None, border_value
     return out.astype(image.dtype)
 
 
-def _mask_edges(mask: Array, crop: bool = True) -> Array:
+def _mask_edges(mask: Array) -> Array:
     """Edge pixels of a binary mask: mask & ~erosion(mask). Matches the reference's
     ``mask_edges`` (XOR with the eroded mask)."""
     eroded = binary_erosion(mask)

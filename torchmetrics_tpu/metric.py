@@ -683,6 +683,76 @@ class Metric:
         )
 
 
+class HostMetric(Metric):
+    """Base for metrics whose ``update`` must run host-side — ragged per-image shapes
+    (detection), string inputs (text), or third-party host callbacks (audio).
+
+    Subclasses implement ``_host_batch_state(*inputs) -> dict`` returning, per state,
+    either one array to append (concat states — already concatenated over the batch's
+    items) or a tensor contribution to fold. ``_compute`` receives the usual
+    concatenated state. ``forward`` computes the batch value from the batch
+    contribution alone (no double-update — reference metric.py:319's cache/restore
+    dance is unnecessary because the contribution is already materialized).
+    """
+
+    _jittable_compute = False
+
+    def _host_batch_state(self, *args: Any, **kwargs: Any) -> StateDict:
+        raise NotImplementedError
+
+    def _batch_state(self, *args: Any, **kwargs: Any) -> StateDict:  # pragma: no cover
+        return self._host_batch_state(*args, **kwargs)
+
+    def _fold_batch(self, bs: StateDict) -> None:
+        for k, v in bs.items():
+            if k in self._list_state_names:
+                self._state[k].append(v)
+            else:
+                self._state[k] = pairwise_merge_compat(
+                    self._reductions.get(k), self._state[k], v, float(self._update_count)
+                )
+        self._update_count += 1
+        self._computed = None
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        if self._is_synced:
+            raise TorchMetricsUserError(
+                "The Metric shouldn't be synced when performing ``update``. "
+                "HINT: Did you forget to call ``unsync`` ?"
+            )
+        args, kwargs = self._prepare_inputs(*args, **kwargs)
+        self._fold_batch(self._host_batch_state(*args, **kwargs))
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        if self._is_synced:
+            raise TorchMetricsUserError("The Metric shouldn't be synced when performing ``forward``.")
+        if self.dist_sync_on_step:
+            self.update(*args, **kwargs)
+            self._computed = None
+            val = self.compute()
+            self._computed = None
+            return val
+        args, kwargs = self._prepare_inputs(*args, **kwargs)
+        bs = self._host_batch_state(*args, **kwargs)
+        batch_full = dict(self.init_state())
+        for k, v in bs.items():
+            if k in self._list_state_names:
+                batch_full[k] = [v]
+            else:
+                batch_full[k] = v
+        batch_concat = self._concat_state(batch_full)
+        self._fold_batch(bs)
+        self._last_batch_state = batch_concat
+        return self._compute(batch_concat)
+
+    __call__ = forward
+
+
+def pairwise_merge_compat(fx, a, b, n_prev: float):
+    """Fold one tensor-state contribution with count-exact 'mean' handling."""
+    return _sync.pairwise_merge(fx, a, b, weights=(n_prev, 1.0))
+
+
 class CompositionalMetric(Metric):
     """Lazy operator tree over metrics/constants (reference metric.py:1188-1311)."""
 
