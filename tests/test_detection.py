@@ -293,3 +293,83 @@ def test_map_input_validation_errors():
         MeanAveragePrecision(average="weird")
     with pytest.raises(ValueError, match="length 3"):
         MeanAveragePrecision(max_detection_thresholds=[10])
+
+
+# ---------------------------------------------------------------- panoptic quality
+
+def _panoptic_batches(num_updates=3, b=2, h=8, w=8, seed=31):
+    rng = np.random.default_rng(seed)
+    things, stuffs = {0, 1}, {6, 7}
+    cats = np.array(sorted(things | stuffs))
+    out = []
+    for _ in range(num_updates):
+        def gen():
+            cat = cats[rng.integers(0, len(cats), (b, h, w))]
+            inst = rng.integers(0, 3, (b, h, w))
+            return np.stack([cat, inst], axis=-1).astype(np.int32)
+        out.append((gen(), gen()))
+    return things, stuffs, out
+
+
+@pytest.mark.parametrize("variant", ["pq", "mpq"])
+@pytest.mark.parametrize("flags", [dict(), dict(return_per_class=True), dict(return_sq_and_rq=True)])
+def test_panoptic_quality_oracle_parity(variant, flags):
+    tm = reference_torchmetrics()
+    if tm is None:
+        pytest.skip("oracle unavailable")
+    import torch
+
+    from torchmetrics_tpu.detection import ModifiedPanopticQuality, PanopticQuality
+
+    if variant == "mpq" and flags:
+        pytest.skip("reference ModifiedPanopticQuality has no return flags")
+    things, stuffs, batches = _panoptic_batches()
+    if variant == "pq":
+        ours = PanopticQuality(things=things, stuffs=stuffs, **flags)
+        ref = tm.detection.PanopticQuality(things=things, stuffs=stuffs, **flags)
+    else:
+        ours = ModifiedPanopticQuality(things=things, stuffs=stuffs)
+        ref = tm.detection.ModifiedPanopticQuality(things=things, stuffs=stuffs)
+    for preds, target in batches:
+        ours.update(jnp.asarray(preds), jnp.asarray(target))
+        ref.update(torch.as_tensor(preds), torch.as_tensor(target))
+    _assert_allclose(ours.compute(), ref.compute().numpy(), atol=1e-5)
+
+
+def test_panoptic_functional_matches_class():
+    from torchmetrics_tpu.functional.detection import panoptic_quality
+
+    things, stuffs, batches = _panoptic_batches(num_updates=1)
+    preds, target = batches[0]
+    from torchmetrics_tpu.detection import PanopticQuality
+
+    m = PanopticQuality(things=things, stuffs=stuffs)
+    m.update(jnp.asarray(preds), jnp.asarray(target))
+    _assert_allclose(panoptic_quality(jnp.asarray(preds), jnp.asarray(target), things, stuffs), m.compute(), atol=1e-6)
+
+
+def test_panoptic_merge_matches_single():
+    from torchmetrics_tpu.detection import PanopticQuality
+
+    things, stuffs, batches = _panoptic_batches(num_updates=3)
+    single = PanopticQuality(things=things, stuffs=stuffs)
+    shards = [PanopticQuality(things=things, stuffs=stuffs) for _ in range(3)]
+    for (preds, target), shard in zip(batches, shards):
+        single.update(jnp.asarray(preds), jnp.asarray(target))
+        shard.update(jnp.asarray(preds), jnp.asarray(target))
+    merged = shards[0]
+    merged.merge_state(shards[1])
+    merged.merge_state(shards[2])
+    _assert_allclose(merged.compute(), single.compute(), atol=1e-6)
+
+
+def test_panoptic_validation_errors():
+    from torchmetrics_tpu.detection import PanopticQuality
+
+    with pytest.raises(ValueError, match="distinct"):
+        PanopticQuality(things={0, 1}, stuffs={1, 2})
+    with pytest.raises(ValueError, match="non-empty"):
+        PanopticQuality(things=set(), stuffs=set())
+    m = PanopticQuality(things={0}, stuffs={6})
+    with pytest.raises(ValueError, match="Unknown categories"):
+        m.update(jnp.asarray(np.full((1, 2, 2, 2), 3, np.int32)), jnp.asarray(np.zeros((1, 2, 2, 2), np.int32)))

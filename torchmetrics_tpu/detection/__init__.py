@@ -5,6 +5,7 @@ from .diou import DistanceIntersectionOverUnion
 from .giou import GeneralizedIntersectionOverUnion
 from .iou import IntersectionOverUnion
 from .mean_ap import MeanAveragePrecision
+from .panoptic_qualities import ModifiedPanopticQuality, PanopticQuality
 
 __all__ = [
     "CompleteIntersectionOverUnion",
@@ -12,4 +13,6 @@ __all__ = [
     "GeneralizedIntersectionOverUnion",
     "IntersectionOverUnion",
     "MeanAveragePrecision",
+    "ModifiedPanopticQuality",
+    "PanopticQuality",
 ]

@@ -27,6 +27,20 @@ def _fix_empty_arrays(boxes: jnp.ndarray) -> jnp.ndarray:
     return boxes
 
 
+def _boxes_to_xyxy_np(boxes, box_format: str) -> np.ndarray:
+    """Host-side box normalization for the update hot path: (N,4) numpy xyxy, no
+    device round-trip (the pairwise kernels get the arrays later, in one batch)."""
+    arr = np.asarray(boxes, np.float32).reshape(-1, 4) if np.asarray(boxes).size else np.zeros((0, 4), np.float32)
+    if arr.size == 0 or box_format == "xyxy":
+        return arr
+    a, b, c, d = arr[:, 0], arr[:, 1], arr[:, 2], arr[:, 3]
+    if box_format == "xywh":
+        return np.stack([a, b, a + c, b + d], axis=-1)
+    if box_format == "cxcywh":
+        return np.stack([a - c / 2, b - d / 2, a + c / 2, b + d / 2], axis=-1)
+    raise ValueError(f"Unsupported box format {box_format}")
+
+
 def _input_validator(
     preds: Sequence[Dict],
     targets: Sequence[Dict],

@@ -14,10 +14,9 @@ from typing import Any, Dict, List, Optional, Sequence
 import jax.numpy as jnp
 import numpy as np
 
-from ..functional.detection._box_ops import box_convert
 from ..functional.detection.iou import _iou_update
 from ..metric import HostMetric
-from .helpers import _fix_empty_arrays, _input_validator
+from .helpers import _boxes_to_xyxy_np, _input_validator
 
 
 class IntersectionOverUnion(HostMetric):
@@ -65,11 +64,8 @@ class IntersectionOverUnion(HostMetric):
     def _iou_update_fn(*args: Any, **kwargs: Any) -> jnp.ndarray:
         return _iou_update(*args, **kwargs)
 
-    def _get_safe_item_values(self, boxes) -> jnp.ndarray:
-        boxes = _fix_empty_arrays(jnp.asarray(boxes, jnp.float32))
-        if boxes.size > 0:
-            boxes = box_convert(boxes, in_fmt=self.box_format, out_fmt="xyxy")
-        return boxes
+    def _get_safe_item_values(self, boxes) -> np.ndarray:
+        return _boxes_to_xyxy_np(boxes, self.box_format)
 
     def _host_batch_state(self, preds: Sequence[Dict], target: Sequence[Dict]) -> Dict[str, jnp.ndarray]:
         _input_validator(preds, target, ignore_score=True)

@@ -15,10 +15,9 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 import jax.numpy as jnp
 import numpy as np
 
-from ..functional.detection._box_ops import box_convert
 from ..functional.detection._map_eval import MAPInputs, evaluate_map, summarize
 from ..metric import HostMetric
-from .helpers import _fix_empty_arrays, _input_validator
+from .helpers import _boxes_to_xyxy_np, _input_validator
 
 
 def _split_by_counts(flat: np.ndarray, counts: np.ndarray) -> List[np.ndarray]:
@@ -122,10 +121,7 @@ class MeanAveragePrecision(HostMetric):
     # ------------------------------------------------------------------ update
 
     def _boxes_xyxy(self, boxes) -> np.ndarray:
-        boxes = _fix_empty_arrays(jnp.asarray(boxes, jnp.float32))
-        if boxes.size > 0:
-            boxes = box_convert(boxes, in_fmt=self.box_format, out_fmt="xyxy")
-        return np.asarray(boxes, np.float32).reshape(-1, 4)
+        return _boxes_to_xyxy_np(boxes, self.box_format)
 
     def _host_batch_state(self, preds: Sequence[Dict], target: Sequence[Dict]) -> Dict[str, Any]:
         _input_validator(preds, target, iou_type=self.iou_type)

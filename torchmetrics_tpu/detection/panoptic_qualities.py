@@ -1,0 +1,118 @@
+"""PanopticQuality / ModifiedPanopticQuality metric classes (reference
+``detection/panoptic_qualities.py:37,293``).
+
+State is four static-shape per-category sum tensors (iou_sum/tp/fp/fn) — the same
+sufficient statistics as the reference, so sync is four psums; the vectorized update
+lives in ``functional/detection/panoptic_qualities.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Collection, Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..functional.detection.panoptic_qualities import (
+    _get_category_id_to_continuous_id,
+    _get_void_color,
+    _panoptic_quality_compute,
+    _panoptic_quality_update,
+    _parse_categories,
+    _preprocess_inputs,
+    _validate_inputs,
+)
+from ..metric import HostMetric
+
+
+class PanopticQuality(HostMetric):
+    """Panoptic Quality for panoptic segmentations.
+
+    Inputs are ``(B, *spatial_dims, 2)`` int arrays of ``(category_id, instance_id)``
+    pairs; stuff instance ids are ignored.
+    """
+
+    is_differentiable: bool = False
+    higher_is_better: bool = True
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+
+    def __init__(
+        self,
+        things: Collection[int],
+        stuffs: Collection[int],
+        allow_unknown_preds_category: bool = False,
+        return_sq_and_rq: bool = False,
+        return_per_class: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        things, stuffs = _parse_categories(things, stuffs)
+        self.things = things
+        self.stuffs = stuffs
+        self.void_color = _get_void_color(things, stuffs)
+        self.cat_id_to_continuous_id = _get_category_id_to_continuous_id(things, stuffs)
+        self.allow_unknown_preds_category = allow_unknown_preds_category
+        self.return_sq_and_rq = return_sq_and_rq
+        self.return_per_class = return_per_class
+
+        num_categories = len(things) + len(stuffs)
+        self.add_state("iou_sum", default=jnp.zeros(num_categories, jnp.float32), dist_reduce_fx="sum")
+        self.add_state("true_positives", default=jnp.zeros(num_categories, jnp.int32), dist_reduce_fx="sum")
+        self.add_state("false_positives", default=jnp.zeros(num_categories, jnp.int32), dist_reduce_fx="sum")
+        self.add_state("false_negatives", default=jnp.zeros(num_categories, jnp.int32), dist_reduce_fx="sum")
+
+    _modified_stuffs = None  # PQ variant hook (set by ModifiedPanopticQuality)
+
+    def _host_batch_state(self, preds, target) -> Dict[str, jnp.ndarray]:
+        _validate_inputs(preds, target)
+        flatten_preds = _preprocess_inputs(
+            self.things, self.stuffs, np.asarray(preds), self.void_color, self.allow_unknown_preds_category
+        )
+        flatten_target = _preprocess_inputs(self.things, self.stuffs, np.asarray(target), self.void_color, True)
+        iou_sum, tp, fp, fn = _panoptic_quality_update(
+            flatten_preds,
+            flatten_target,
+            self.cat_id_to_continuous_id,
+            self.void_color,
+            modified_metric_stuffs=self._modified_stuffs,
+        )
+        return {
+            "iou_sum": jnp.asarray(iou_sum, jnp.float32),
+            "true_positives": jnp.asarray(tp, jnp.int32),
+            "false_positives": jnp.asarray(fp, jnp.int32),
+            "false_negatives": jnp.asarray(fn, jnp.int32),
+        }
+
+    def _compute(self, state) -> jnp.ndarray:
+        pq, sq, rq, pq_avg, sq_avg, rq_avg = _panoptic_quality_compute(
+            state["iou_sum"], state["true_positives"], state["false_positives"], state["false_negatives"]
+        )
+        if self.return_per_class:
+            if self.return_sq_and_rq:
+                return jnp.stack([pq, sq, rq], axis=-1)
+            return pq.reshape(1, -1)
+        if self.return_sq_and_rq:
+            return jnp.stack([pq_avg, sq_avg, rq_avg])
+        return pq_avg
+
+
+class ModifiedPanopticQuality(PanopticQuality):
+    """Modified Panoptic Quality: stuff classes scored with the relaxed iou>0 rule
+    and one "tp" per target segment (reference ``detection/panoptic_qualities.py:293``)."""
+
+    def __init__(
+        self,
+        things: Collection[int],
+        stuffs: Collection[int],
+        allow_unknown_preds_category: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(things, stuffs, allow_unknown_preds_category, **kwargs)
+        self._modified_stuffs = self.stuffs
+
+    def _compute(self, state) -> jnp.ndarray:
+        _, _, _, pq_avg, _, _ = _panoptic_quality_compute(
+            state["iou_sum"], state["true_positives"], state["false_positives"], state["false_negatives"]
+        )
+        return pq_avg

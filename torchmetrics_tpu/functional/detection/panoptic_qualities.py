@@ -1,0 +1,276 @@
+"""Panoptic Quality kernels (reference ``functional/detection/_panoptic_quality_common.py``).
+
+The reference accumulates per-segment statistics through Python dicts and sets
+(``_get_color_areas``/``_panoptic_quality_update_sample``, one dict lookup per
+segment pair). Here every per-sample pass is vectorized: segment "colors"
+``(category_id, instance_id)`` are encoded into int64 codes, areas and pairwise
+intersections come from ``np.unique`` with counts, and the match/FP/FN filters are
+boolean masks over the unique-pair table. The resulting sufficient statistics
+(per-category iou_sum/tp/fp/fn) are static-shape sum states — the cross-device sync
+is four psums.
+"""
+
+from __future__ import annotations
+
+from typing import Collection, Dict, Optional, Set, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...utilities.prints import rank_zero_warn
+
+_SHIFT = np.int64(1) << np.int64(32)
+
+
+def _parse_categories(things: Collection[int], stuffs: Collection[int]) -> Tuple[Set[int], Set[int]]:
+    things_parsed = set(things)
+    if len(things_parsed) < len(things):
+        rank_zero_warn("The provided `things` categories contained duplicates, which have been removed.", UserWarning)
+    stuffs_parsed = set(stuffs)
+    if len(stuffs_parsed) < len(stuffs):
+        rank_zero_warn("The provided `stuffs` categories contained duplicates, which have been removed.", UserWarning)
+    if not all(isinstance(val, int) and not isinstance(val, bool) for val in things_parsed):
+        raise TypeError(f"Expected argument `things` to contain `int` categories, but got {things}")
+    if not all(isinstance(val, int) and not isinstance(val, bool) for val in stuffs_parsed):
+        raise TypeError(f"Expected argument `stuffs` to contain `int` categories, but got {stuffs}")
+    if things_parsed & stuffs_parsed:
+        raise ValueError(
+            f"Expected arguments `things` and `stuffs` to have distinct keys, but got {things} and {stuffs}"
+        )
+    if not (things_parsed | stuffs_parsed):
+        raise ValueError("At least one of `things` and `stuffs` must be non-empty.")
+    return things_parsed, stuffs_parsed
+
+
+def _get_void_color(things: Set[int], stuffs: Set[int]) -> Tuple[int, int]:
+    return 1 + max([0, *list(things), *list(stuffs)]), 0
+
+
+def _get_category_id_to_continuous_id(things: Set[int], stuffs: Set[int]) -> Dict[int, int]:
+    thing_map = {thing_id: idx for idx, thing_id in enumerate(sorted(things))}
+    stuff_map = {stuff_id: idx + len(things) for idx, stuff_id in enumerate(sorted(stuffs))}
+    return {**thing_map, **stuff_map}
+
+
+def _validate_inputs(preds, target) -> None:
+    if not hasattr(preds, "shape"):
+        raise TypeError(f"Expected argument `preds` to be an array, but got {type(preds)}")
+    if not hasattr(target, "shape"):
+        raise TypeError(f"Expected argument `target` to be an array, but got {type(target)}")
+    if tuple(preds.shape) != tuple(target.shape):
+        raise ValueError(
+            f"Expected argument `preds` and `target` to have the same shape, but got {preds.shape} and {target.shape}"
+        )
+    if preds.ndim < 3:
+        raise ValueError(
+            f"Expected argument `preds` to have at least one spatial dimension (B, *spatial_dims, 2), got {preds.shape}"
+        )
+    if preds.shape[-1] != 2:
+        raise ValueError(
+            "Expected argument `preds` to have exactly 2 channels in the last dimension (category, instance), "
+            f"got {preds.shape} instead"
+        )
+
+
+def _preprocess_inputs(
+    things: Set[int],
+    stuffs: Set[int],
+    inputs,
+    void_color: Tuple[int, int],
+    allow_unknown_category: bool,
+) -> np.ndarray:
+    """Flatten spatial dims, zero stuff instance ids, map unknown categories to void."""
+    arr = np.asarray(inputs).astype(np.int64).reshape(inputs.shape[0], -1, 2).copy()
+    cats = arr[..., 0]
+    mask_stuffs = np.isin(cats, list(stuffs))
+    mask_things = np.isin(cats, list(things))
+    arr[..., 1] = np.where(mask_stuffs, 0, arr[..., 1])
+    unknown = ~(mask_things | mask_stuffs)
+    if not allow_unknown_category and unknown.any():
+        raise ValueError(f"Unknown categories found: {np.unique(cats[unknown])}")
+    arr[unknown] = np.asarray(void_color, np.int64)
+    return arr
+
+
+def _encode(colors: np.ndarray) -> np.ndarray:
+    """(N, 2) colors -> int64 codes (category in the high 32 bits)."""
+    return colors[..., 0] * _SHIFT + colors[..., 1]
+
+
+def _panoptic_quality_update_sample(
+    pred_s: np.ndarray,
+    target_s: np.ndarray,
+    cat_id_to_continuous_id: Dict[int, int],
+    void_color: Tuple[int, int],
+    stuffs_modified_metric: Optional[Set[int]] = None,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Vectorized per-sample sufficient statistics (iou_sum, tp, fp, fn)."""
+    modified = stuffs_modified_metric or set()
+    num_categories = len(cat_id_to_continuous_id)
+    iou_sum = np.zeros(num_categories, np.float64)
+    tp = np.zeros(num_categories, np.int64)
+    fp = np.zeros(num_categories, np.int64)
+    fn = np.zeros(num_categories, np.int64)
+    cont_of = np.vectorize(cat_id_to_continuous_id.__getitem__, otypes=[np.int64])
+
+    pc = _encode(pred_s)
+    tc = _encode(target_s)
+    void = int(void_color[0]) * int(_SHIFT) + int(void_color[1])
+
+    up, p_areas = np.unique(pc, return_counts=True)
+    ut, t_areas = np.unique(tc, return_counts=True)
+    upair, i_areas = np.unique(np.stack([pc, tc], axis=1), axis=0, return_counts=True)
+    p_of, t_of = upair[:, 0], upair[:, 1]
+
+    # per-color void overlaps, aligned to up/ut
+    pred_void = np.zeros(up.shape[0], np.int64)
+    mask_pv = t_of == void
+    pred_void[np.searchsorted(up, p_of[mask_pv])] = i_areas[mask_pv]
+    void_target = np.zeros(ut.shape[0], np.int64)
+    mask_vt = p_of == void
+    void_target[np.searchsorted(ut, t_of[mask_vt])] = i_areas[mask_vt]
+
+    area_p = p_areas[np.searchsorted(up, p_of)]
+    area_t = t_areas[np.searchsorted(ut, t_of)]
+    pv_of = pred_void[np.searchsorted(up, p_of)]
+    vt_of = void_target[np.searchsorted(ut, t_of)]
+
+    cat_p = (p_of >> np.int64(32)).astype(np.int64)
+    cat_t = (t_of >> np.int64(32)).astype(np.int64)
+    cand = (t_of != void) & (cat_p == cat_t)  # void pred code has an out-of-map category
+    union = area_p - pv_of + area_t - vt_of - i_areas
+    iou = np.where(cand & (union > 0), i_areas / np.where(union > 0, union, 1), 0.0)
+
+    is_modified = np.isin(cat_t, list(modified)) if modified else np.zeros_like(cand)
+    matched = cand & ~is_modified & (iou > 0.5)
+    mod_hit = cand & is_modified & (iou > 0)
+    for mask in (matched, mod_hit):
+        if mask.any():
+            np.add.at(iou_sum, cont_of(cat_t[mask]), iou[mask])
+    if matched.any():
+        np.add.at(tp, cont_of(cat_t[matched]), 1)
+
+    matched_p = p_of[matched]
+    matched_t = t_of[matched]
+
+    # FN: unmatched target segments not mostly void in the prediction
+    t_unmatched = (ut != void) & ~np.isin(ut, matched_t)
+    t_keep = t_unmatched & (void_target / t_areas <= 0.5)
+    cat_fn = (ut[t_keep] >> np.int64(32)).astype(np.int64)
+    cat_fn = cat_fn[~np.isin(cat_fn, list(modified))] if modified else cat_fn
+    if cat_fn.size:
+        np.add.at(fn, cont_of(cat_fn), 1)
+
+    # FP: unmatched pred segments not mostly void in the target
+    p_unmatched = (up != void) & ~np.isin(up, matched_p)
+    p_keep = p_unmatched & (pred_void / p_areas <= 0.5)
+    cat_fp = (up[p_keep] >> np.int64(32)).astype(np.int64)
+    cat_fp = cat_fp[~np.isin(cat_fp, list(modified))] if modified else cat_fp
+    if cat_fp.size:
+        np.add.at(fp, cont_of(cat_fp), 1)
+
+    # modified-PQ stuffs: "tp" counts target segments of that category
+    if modified:
+        cat_ut = (ut[ut != void] >> np.int64(32)).astype(np.int64)
+        cat_mod = cat_ut[np.isin(cat_ut, list(modified))]
+        if cat_mod.size:
+            np.add.at(tp, cont_of(cat_mod), 1)
+
+    return iou_sum, tp, fp, fn
+
+
+def _panoptic_quality_update(
+    flatten_preds: np.ndarray,
+    flatten_target: np.ndarray,
+    cat_id_to_continuous_id: Dict[int, int],
+    void_color: Tuple[int, int],
+    modified_metric_stuffs: Optional[Set[int]] = None,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Batch sufficient statistics; segments are never matched across samples."""
+    num_categories = len(cat_id_to_continuous_id)
+    iou_sum = np.zeros(num_categories, np.float64)
+    tp = np.zeros(num_categories, np.int64)
+    fp = np.zeros(num_categories, np.int64)
+    fn = np.zeros(num_categories, np.int64)
+    for pred_s, target_s in zip(flatten_preds, flatten_target):
+        r = _panoptic_quality_update_sample(
+            pred_s, target_s, cat_id_to_continuous_id, void_color, stuffs_modified_metric=modified_metric_stuffs
+        )
+        iou_sum += r[0]
+        tp += r[1]
+        fp += r[2]
+        fn += r[3]
+    return iou_sum, tp, fp, fn
+
+
+def _panoptic_quality_compute(
+    iou_sum: jnp.ndarray,
+    true_positives: jnp.ndarray,
+    false_positives: jnp.ndarray,
+    false_negatives: jnp.ndarray,
+) -> Tuple[jnp.ndarray, ...]:
+    """Per-class (pq, sq, rq) and their averages over observed classes (pure jnp)."""
+    tp = true_positives.astype(jnp.float32)
+    sq = jnp.where(tp > 0, iou_sum / jnp.where(tp > 0, tp, 1.0), 0.0)
+    denominator = tp + 0.5 * false_positives.astype(jnp.float32) + 0.5 * false_negatives.astype(jnp.float32)
+    rq = jnp.where(denominator > 0, tp / jnp.where(denominator > 0, denominator, 1.0), 0.0)
+    pq = sq * rq
+    seen = denominator > 0
+    n_seen = seen.sum()
+    safe = jnp.where(n_seen > 0, n_seen, 1)
+    pq_avg = jnp.where(n_seen > 0, jnp.where(seen, pq, 0.0).sum() / safe, jnp.nan)
+    sq_avg = jnp.where(n_seen > 0, jnp.where(seen, sq, 0.0).sum() / safe, jnp.nan)
+    rq_avg = jnp.where(n_seen > 0, jnp.where(seen, rq, 0.0).sum() / safe, jnp.nan)
+    return pq, sq, rq, pq_avg, sq_avg, rq_avg
+
+
+def panoptic_quality(
+    preds,
+    target,
+    things: Collection[int],
+    stuffs: Collection[int],
+    allow_unknown_preds_category: bool = False,
+    return_sq_and_rq: bool = False,
+    return_per_class: bool = False,
+) -> jnp.ndarray:
+    """Compute Panoptic Quality for panoptic segmentations (reference
+    ``functional/detection/panoptic_qualities.py:30``)."""
+    things, stuffs = _parse_categories(things, stuffs)
+    _validate_inputs(preds, target)
+    void_color = _get_void_color(things, stuffs)
+    cat_id_to_continuous_id = _get_category_id_to_continuous_id(things, stuffs)
+    flatten_preds = _preprocess_inputs(things, stuffs, preds, void_color, allow_unknown_preds_category)
+    flatten_target = _preprocess_inputs(things, stuffs, target, void_color, True)
+    iou_sum, tp, fp, fn = _panoptic_quality_update(flatten_preds, flatten_target, cat_id_to_continuous_id, void_color)
+    pq, sq, rq, pq_avg, sq_avg, rq_avg = _panoptic_quality_compute(
+        jnp.asarray(iou_sum), jnp.asarray(tp), jnp.asarray(fp), jnp.asarray(fn)
+    )
+    if return_per_class:
+        if return_sq_and_rq:
+            return jnp.stack([pq, sq, rq], axis=-1)
+        return pq.reshape(1, -1)
+    if return_sq_and_rq:
+        return jnp.stack([pq_avg, sq_avg, rq_avg])
+    return pq_avg
+
+
+def modified_panoptic_quality(
+    preds,
+    target,
+    things: Collection[int],
+    stuffs: Collection[int],
+    allow_unknown_preds_category: bool = False,
+) -> jnp.ndarray:
+    """Compute Modified Panoptic Quality (stuff classes scored with the relaxed
+    iou>0 rule; reference ``functional/detection/panoptic_qualities.py:175``)."""
+    things, stuffs = _parse_categories(things, stuffs)
+    _validate_inputs(preds, target)
+    void_color = _get_void_color(things, stuffs)
+    cat_id_to_continuous_id = _get_category_id_to_continuous_id(things, stuffs)
+    flatten_preds = _preprocess_inputs(things, stuffs, preds, void_color, allow_unknown_preds_category)
+    flatten_target = _preprocess_inputs(things, stuffs, target, void_color, True)
+    iou_sum, tp, fp, fn = _panoptic_quality_update(
+        flatten_preds, flatten_target, cat_id_to_continuous_id, void_color, modified_metric_stuffs=stuffs
+    )
+    _, _, _, pq_avg, _, _ = _panoptic_quality_compute(jnp.asarray(iou_sum), jnp.asarray(tp), jnp.asarray(fp), jnp.asarray(fn))
+    return pq_avg

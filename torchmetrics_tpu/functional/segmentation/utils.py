@@ -20,6 +20,7 @@ from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+from ...utilities.checks import _check_same_shape as _check_same_shape_host
 
 Array = jax.Array
 
@@ -33,7 +34,6 @@ def _ignore_background(preds: Array, target: Array) -> Tuple[Array, Array]:
     return preds, target
 
 
-from ...utilities.checks import _check_same_shape as _check_same_shape_host
 
 
 def _check_mixed_shape(preds, target) -> None:

@@ -704,13 +704,23 @@ class HostMetric(Metric):
         return self._host_batch_state(*args, **kwargs)
 
     def _fold_batch(self, bs: StateDict) -> None:
-        for k, v in bs.items():
-            if k in self._list_state_names:
-                self._state[k].append(v)
-            else:
-                self._state[k] = pairwise_merge_compat(
-                    self._reductions.get(k), self._state[k], v, float(self._update_count)
+        appends = {k: v for k, v in bs.items() if k in self._list_state_names}
+        tensors = {k: v for k, v in bs.items() if k not in appends}
+        if self._has_custom_merge():
+            current = {k: v for k, v in self._state.items() if k not in self._list_state_names}
+            merged = self._merge(current, tensors)
+        else:
+            merged = {
+                k: _sync.pairwise_merge(
+                    self._reductions.get(k), self._state[k], v, weights=(float(self._update_count), 1.0)
                 )
+                for k, v in tensors.items()
+            }
+        for k, v in merged.items():
+            prev = self._state.get(k)
+            self._state[k] = jnp.asarray(v).astype(prev.dtype) if hasattr(prev, "dtype") else v
+        for k, v in appends.items():
+            self._state[k].append(v)
         self._update_count += 1
         self._computed = None
 
@@ -746,11 +756,6 @@ class HostMetric(Metric):
         return self._compute(batch_concat)
 
     __call__ = forward
-
-
-def pairwise_merge_compat(fx, a, b, n_prev: float):
-    """Fold one tensor-state contribution with count-exact 'mean' handling."""
-    return _sync.pairwise_merge(fx, a, b, weights=(n_prev, 1.0))
 
 
 class CompositionalMetric(Metric):
