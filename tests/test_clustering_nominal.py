@@ -1,0 +1,284 @@
+"""Clustering + nominal + shape + pairwise parity tests (sklearn/scipy golden
+references, reference-torchmetrics oracle where sklearn has no equivalent)."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from tests.helpers import _assert_allclose
+from tests.oracle import reference_torchmetrics
+
+import torchmetrics_tpu as tm
+import torchmetrics_tpu.functional as F
+
+_RNG = np.random.default_rng(42)
+NUM_BATCHES, BATCH = 4, 48
+LABELS_P = _RNG.integers(0, 5, (NUM_BATCHES, BATCH))
+LABELS_T = _RNG.integers(0, 5, (NUM_BATCHES, BATCH))
+DATA = _RNG.normal(size=(NUM_BATCHES, BATCH, 3)).astype(np.float32)
+
+
+# ------------------------------------------------------------------- pairwise
+
+@pytest.mark.parametrize("reduction", [None, "mean", "sum"])
+@pytest.mark.parametrize(
+    "fn,ref",
+    [
+        (F.pairwise_cosine_similarity, "cosine"),
+        (F.pairwise_euclidean_distance, "euclidean"),
+        (F.pairwise_linear_similarity, "linear"),
+        (F.pairwise_manhattan_distance, "manhattan"),
+        (F.pairwise_minkowski_distance, "minkowski"),
+    ],
+)
+def test_pairwise_vs_sklearn(fn, ref, reduction):
+    from sklearn.metrics.pairwise import (
+        cosine_similarity,
+        euclidean_distances,
+        linear_kernel,
+        manhattan_distances,
+    )
+    from scipy.spatial.distance import cdist
+
+    x = _RNG.normal(size=(6, 4)).astype(np.float32)
+    y = _RNG.normal(size=(5, 4)).astype(np.float32)
+    ref_fn = {
+        "cosine": cosine_similarity,
+        "euclidean": euclidean_distances,
+        "linear": linear_kernel,
+        "manhattan": manhattan_distances,
+        "minkowski": lambda a, b: cdist(a, b, metric="minkowski", p=3),
+    }[ref]
+    kwargs = {"exponent": 3} if ref == "minkowski" else {}
+    expected = ref_fn(x, y)
+    if reduction == "mean":
+        expected = expected.mean(-1)
+    elif reduction == "sum":
+        expected = expected.sum(-1)
+    _assert_allclose(fn(jnp.asarray(x), jnp.asarray(y), reduction=reduction, **kwargs), expected, atol=1e-4)
+    # self-comparison path zeroes the diagonal
+    self_mat = np.asarray(fn(jnp.asarray(x), **kwargs))
+    assert np.allclose(np.diagonal(self_mat), 0)
+
+
+def test_pairwise_validation():
+    with pytest.raises(ValueError, match="Expected argument `x`"):
+        F.pairwise_cosine_similarity(jnp.zeros((3,)))
+    with pytest.raises(ValueError, match="Expected argument `y`"):
+        F.pairwise_cosine_similarity(jnp.zeros((3, 2)), jnp.zeros((3, 4)))
+    with pytest.raises(ValueError, match="Expected reduction"):
+        F.pairwise_cosine_similarity(jnp.zeros((3, 2)), reduction="bad")
+
+
+# ------------------------------------------------------------------ clustering
+
+EXTRINSIC = [
+    (tm.MutualInfoScore, F.mutual_info_score, "mutual_info_score", {}),
+    (tm.AdjustedMutualInfoScore, F.adjusted_mutual_info_score, "adjusted_mutual_info_score", {}),
+    (tm.NormalizedMutualInfoScore, F.normalized_mutual_info_score, "normalized_mutual_info_score", {}),
+    (tm.RandScore, F.rand_score, "rand_score", {}),
+    (tm.AdjustedRandScore, F.adjusted_rand_score, "adjusted_rand_score", {}),
+    (tm.FowlkesMallowsIndex, F.fowlkes_mallows_index, "fowlkes_mallows_score", {}),
+    (tm.HomogeneityScore, F.homogeneity_score, "homogeneity_score", {}),
+    (tm.CompletenessScore, F.completeness_score, "completeness_score", {}),
+    (tm.VMeasureScore, F.v_measure_score, "v_measure_score", {}),
+]
+
+
+@pytest.mark.parametrize("cls,fn,sk_name,kwargs", EXTRINSIC, ids=[e[2] for e in EXTRINSIC])
+def test_extrinsic_clustering_vs_sklearn(cls, fn, sk_name, kwargs):
+    import sklearn.metrics as skm
+
+    sk_fn = getattr(skm, sk_name, None) or getattr(skm.cluster, sk_name)
+    # functional per batch
+    for i in range(NUM_BATCHES):
+        ours = fn(jnp.asarray(LABELS_P[i]), jnp.asarray(LABELS_T[i]), **kwargs)
+        ref = sk_fn(LABELS_T[i], LABELS_P[i])
+        _assert_allclose(ours, ref, atol=1e-5, msg=f"batch {i}")
+    # stateful accumulation over all batches
+    m = cls(**kwargs)
+    for i in range(NUM_BATCHES):
+        m.update(jnp.asarray(LABELS_P[i]), jnp.asarray(LABELS_T[i]))
+    _assert_allclose(m.compute(), sk_fn(LABELS_T.reshape(-1), LABELS_P.reshape(-1)), atol=1e-5)
+
+
+@pytest.mark.parametrize(
+    "cls,fn,sk_name",
+    [
+        (tm.CalinskiHarabaszScore, F.calinski_harabasz_score, "calinski_harabasz_score"),
+        (tm.DaviesBouldinScore, F.davies_bouldin_score, "davies_bouldin_score"),
+    ],
+)
+def test_intrinsic_clustering_vs_sklearn(cls, fn, sk_name):
+    import sklearn.metrics as skm
+
+    sk_fn = getattr(skm, sk_name)
+    for i in range(NUM_BATCHES):
+        ours = fn(jnp.asarray(DATA[i]), jnp.asarray(LABELS_T[i]))
+        _assert_allclose(ours, sk_fn(DATA[i], LABELS_T[i]), atol=1e-4, msg=f"batch {i}")
+    m = cls()
+    for i in range(NUM_BATCHES):
+        m.update(jnp.asarray(DATA[i]), jnp.asarray(LABELS_T[i]))
+    _assert_allclose(m.compute(), sk_fn(DATA.reshape(-1, 3), LABELS_T.reshape(-1)), atol=1e-4)
+
+
+def test_dunn_index_vs_oracle():
+    tm_ref = reference_torchmetrics()
+    if tm_ref is None:
+        pytest.skip("oracle unavailable")
+    import torch
+
+    from torchmetrics.functional.clustering import dunn_index as ref_dunn  # type: ignore
+
+    for p in (2, 3):
+        ours = F.dunn_index(jnp.asarray(DATA[0]), jnp.asarray(LABELS_T[0]), p=p)
+        ref = ref_dunn(torch.as_tensor(DATA[0]), torch.as_tensor(LABELS_T[0]), p=p)
+        _assert_allclose(ours, ref.numpy(), atol=1e-4)
+    m = tm.DunnIndex(p=2)
+    m.update(jnp.asarray(DATA[0]), jnp.asarray(LABELS_T[0]))
+    _assert_allclose(m.compute(), F.dunn_index(jnp.asarray(DATA[0]), jnp.asarray(LABELS_T[0])), atol=1e-6)
+
+
+def test_cluster_accuracy():
+    # permuted labels are a perfect clustering under optimal assignment
+    perm = np.array([2, 0, 3, 4, 1])
+    preds = perm[LABELS_T[0]]
+    m = tm.ClusterAccuracy(num_classes=5)
+    m.update(jnp.asarray(preds), jnp.asarray(LABELS_T[0]))
+    assert float(m.compute()) == pytest.approx(1.0)
+    val = F.cluster_accuracy(jnp.asarray(LABELS_P[0]), jnp.asarray(LABELS_T[0]), num_classes=5)
+    assert 0.0 <= float(val) <= 1.0
+
+
+def test_clustering_merge_matches_single():
+    single = tm.MutualInfoScore()
+    shards = [tm.MutualInfoScore() for _ in range(3)]
+    for i in range(3):
+        single.update(jnp.asarray(LABELS_P[i]), jnp.asarray(LABELS_T[i]))
+        shards[i].update(jnp.asarray(LABELS_P[i]), jnp.asarray(LABELS_T[i]))
+    merged = shards[0]
+    merged.merge_state(shards[1])
+    merged.merge_state(shards[2])
+    _assert_allclose(merged.compute(), single.compute(), atol=1e-6)
+
+
+# -------------------------------------------------------------------- nominal
+
+NOMINAL = [
+    (tm.CramersV, F.cramers_v, "CramersV", "cramers_v", {"bias_correction": True}),
+    (tm.CramersV, F.cramers_v, "CramersV", "cramers_v", {"bias_correction": False}),
+    (tm.PearsonsContingencyCoefficient, F.pearsons_contingency_coefficient,
+     "PearsonsContingencyCoefficient", "pearsons_contingency_coefficient", {}),
+    (tm.TheilsU, F.theils_u, "TheilsU", "theils_u", {}),
+    (tm.TschuprowsT, F.tschuprows_t, "TschuprowsT", "tschuprows_t", {"bias_correction": True}),
+    (tm.TschuprowsT, F.tschuprows_t, "TschuprowsT", "tschuprows_t", {"bias_correction": False}),
+]
+
+
+@pytest.mark.parametrize("cls,fn,ref_cls_name,ref_fn_name,kwargs", NOMINAL,
+                         ids=[f"{n[3]}-{n[4]}" for n in NOMINAL])
+def test_nominal_vs_oracle(cls, fn, ref_cls_name, ref_fn_name, kwargs):
+    tm_ref = reference_torchmetrics()
+    if tm_ref is None:
+        pytest.skip("oracle unavailable")
+    import torch
+
+    import torchmetrics.functional.nominal as ref_nominal  # type: ignore
+
+    ref_fn = getattr(ref_nominal, ref_fn_name)
+    for i in range(NUM_BATCHES):
+        ours = fn(jnp.asarray(LABELS_P[i]), jnp.asarray(LABELS_T[i]), **kwargs)
+        ref = ref_fn(torch.as_tensor(LABELS_P[i]), torch.as_tensor(LABELS_T[i]), **kwargs)
+        _assert_allclose(ours, ref.numpy(), atol=1e-5, msg=f"batch {i}")
+    import torchmetrics.nominal as ref_nominal_cls  # type: ignore
+
+    ours_m = cls(num_classes=5, **kwargs)
+    ref_m = getattr(ref_nominal_cls, ref_cls_name)(num_classes=5, **kwargs)
+    for i in range(NUM_BATCHES):
+        ours_m.update(jnp.asarray(LABELS_P[i]), jnp.asarray(LABELS_T[i]))
+        ref_m.update(torch.as_tensor(LABELS_P[i]), torch.as_tensor(LABELS_T[i]))
+    _assert_allclose(ours_m.compute(), ref_m.compute().numpy(), atol=1e-5)
+
+
+def test_fleiss_kappa_vs_oracle():
+    tm_ref = reference_torchmetrics()
+    if tm_ref is None:
+        pytest.skip("oracle unavailable")
+    import torch
+
+    counts = _RNG.integers(0, 10, (40, 5))
+    ours = F.fleiss_kappa(jnp.asarray(counts))
+    from torchmetrics.functional.nominal import fleiss_kappa as ref_fleiss  # type: ignore
+
+    ref = ref_fleiss(torch.as_tensor(counts).long())
+    _assert_allclose(ours, ref.numpy(), atol=1e-5)
+    m = tm.FleissKappa(mode="counts")
+    m.update(jnp.asarray(counts[:20]))
+    m.update(jnp.asarray(counts[20:]))
+    _assert_allclose(m.compute(), ref.numpy(), atol=1e-5)
+    # probs mode smoke (C == R so the reference's internal reshape quirk is inert)
+    probs = _RNG.normal(size=(30, 5, 5)).astype(np.float32)
+    ours_p = F.fleiss_kappa(jnp.asarray(probs), mode="probs")
+    ref_p = ref_fleiss(torch.as_tensor(probs), mode="probs")
+    _assert_allclose(ours_p, ref_p.numpy(), atol=1e-5)
+
+
+def test_nominal_nan_strategies():
+    preds = np.array([0.0, 1.0, np.nan, 2.0, 1.0, 0.0])
+    target = np.array([0.0, 1.0, 2.0, np.nan, 1.0, 0.0])
+    for strategy, repl in (("replace", 0.0), ("drop", None)):
+        val = F.cramers_v(preds, target, nan_strategy=strategy, nan_replace_value=repl or 0.0)
+        assert np.isfinite(float(val))
+    with pytest.raises(ValueError, match="nan_strategy"):
+        tm.CramersV(num_classes=3, nan_strategy="bad")
+
+
+# ----------------------------------------------------------------------- shape
+
+def test_procrustes_vs_scipy():
+    from scipy.spatial import procrustes as scipy_procrustes
+
+    a = _RNG.normal(size=(4, 10, 3)).astype(np.float32)
+    b = _RNG.normal(size=(4, 10, 3)).astype(np.float32)
+    ours = np.asarray(F.procrustes_disparity(jnp.asarray(a), jnp.asarray(b)))
+    for i in range(4):
+        _, _, disparity = scipy_procrustes(a[i], b[i])
+        assert np.isclose(ours[i], disparity, atol=1e-4)
+    m = tm.ProcrustesDisparity(reduction="mean")
+    m.update(jnp.asarray(a), jnp.asarray(b))
+    _assert_allclose(m.compute(), ours.mean(), atol=1e-5)
+    m2 = tm.ProcrustesDisparity(reduction="sum")
+    m2.update(jnp.asarray(a[:2]), jnp.asarray(b[:2]))
+    m2.update(jnp.asarray(a[2:]), jnp.asarray(b[2:]))
+    _assert_allclose(m2.compute(), ours.sum(), atol=1e-5)
+
+
+def test_procrustes_validation():
+    with pytest.raises(ValueError, match="3D tensors"):
+        F.procrustes_disparity(jnp.zeros((3, 2)), jnp.zeros((3, 2)))
+    with pytest.raises(ValueError, match="reduction"):
+        tm.ProcrustesDisparity(reduction="bad")
+
+
+def test_nominal_2d_probability_inputs():
+    """Regression: num_classes must be inferred after the argmax collapse."""
+    tm_ref = reference_torchmetrics()
+    if tm_ref is None:
+        pytest.skip("oracle unavailable")
+    import torch
+    import torchmetrics.functional.nominal as ref_nominal  # type: ignore
+
+    probs_p = _RNG.dirichlet(np.ones(5), size=64).astype(np.float32)
+    probs_t = _RNG.dirichlet(np.ones(5), size=64).astype(np.float32)
+    for fn, ref_name in ((F.cramers_v, "cramers_v"), (F.theils_u, "theils_u")):
+        ours = fn(jnp.asarray(probs_p), jnp.asarray(probs_t))
+        ref = getattr(ref_nominal, ref_name)(torch.as_tensor(probs_p), torch.as_tensor(probs_t))
+        _assert_allclose(ours, ref.numpy(), atol=1e-5)
+
+
+def test_cluster_accuracy_rejects_out_of_range():
+    m = tm.ClusterAccuracy(num_classes=3)
+    with pytest.raises(ValueError, match="labels in"):
+        m.update(jnp.asarray(np.array([0, 1, 2, 7, 7, 7])), jnp.asarray(np.array([0, 1, 2, 0, 1, 2])))

@@ -373,3 +373,16 @@ def test_panoptic_validation_errors():
     m = PanopticQuality(things={0}, stuffs={6})
     with pytest.raises(ValueError, match="Unknown categories"):
         m.update(jnp.asarray(np.full((1, 2, 2, 2), 3, np.int32)), jnp.asarray(np.zeros((1, 2, 2, 2), np.int32)))
+
+
+def test_panoptic_negative_instance_ids():
+    """Regression: negative instance sentinels must not shift categories in the
+    int64 color encoding."""
+    from torchmetrics_tpu.detection import PanopticQuality
+
+    cat = np.array([[[0, 1], [6, 0]]], np.int64)  # (1, 2, 2) cats
+    inst = np.array([[[-1, 2], [5, -1]]], np.int64)
+    arr = np.stack([cat, inst], axis=-1)
+    m = PanopticQuality(things={0, 1}, stuffs={6})
+    m.update(jnp.asarray(arr), jnp.asarray(arr))  # exact match => PQ 1.0
+    assert float(m.compute()) == pytest.approx(1.0)

@@ -9,7 +9,7 @@ Every domain package declares its public classes in its own ``__all__``; the fla
 namespace aggregates them (reference ``__init__.py`` re-exports ~100 names the same
 way, hand-listed)."""
 
-from torchmetrics_tpu import classification, detection, functional, parallel, regression, retrieval, segmentation, utilities, wrappers
+from torchmetrics_tpu import classification, clustering, detection, functional, nominal, parallel, regression, retrieval, segmentation, shape, utilities, wrappers
 from torchmetrics_tpu.aggregation import (
     CatMetric,
     MaxMetric,
@@ -20,7 +20,10 @@ from torchmetrics_tpu.aggregation import (
     SumMetric,
 )
 from torchmetrics_tpu.classification import *  # noqa: F401,F403
+from torchmetrics_tpu.clustering import *  # noqa: F401,F403
 from torchmetrics_tpu.detection import *  # noqa: F401,F403
+from torchmetrics_tpu.nominal import *  # noqa: F401,F403
+from torchmetrics_tpu.shape import *  # noqa: F401,F403
 from torchmetrics_tpu.collections import MetricCollection
 from torchmetrics_tpu.metric import CompositionalMetric, Metric
 from torchmetrics_tpu.regression import *  # noqa: F401,F403
@@ -61,13 +64,19 @@ __all__ = [
     "parallel",
     "regression",
     "retrieval",
+    "clustering",
     "detection",
+    "nominal",
+    "shape",
     "segmentation",
     "utilities",
     "wrappers",
     *classification.__all__,
     *regression.__all__,
     *retrieval.__all__,
+    *clustering.__all__,
     *detection.__all__,
+    *nominal.__all__,
+    *shape.__all__,
     *segmentation.__all__,
 ]

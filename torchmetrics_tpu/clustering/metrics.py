@@ -1,0 +1,257 @@
+"""Clustering metric classes (reference ``src/torchmetrics/clustering/*.py``).
+
+Extrinsic metrics keep cat states of raw label vectors (the contingency table depends
+on the *global* unique label sets, so it cannot be a fixed-shape sufficient statistic
+without a num_classes bound — same design as the reference); ClusterAccuracy, which
+does take ``num_classes``, keeps a static ``(C, C)`` sum state.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+
+from ..functional.classification.confusion_matrix import _multiclass_confusion_matrix_update
+from ..functional.clustering.extrinsic import (
+    _cluster_accuracy_compute,
+    _completeness_score_compute,
+    _homogeneity_score_compute,
+    adjusted_mutual_info_score,
+    adjusted_rand_score,
+    fowlkes_mallows_index,
+    mutual_info_score,
+    normalized_mutual_info_score,
+    rand_score,
+    v_measure_score,
+)
+from ..functional.clustering.intrinsic import calinski_harabasz_score, davies_bouldin_score, dunn_index
+from ..functional.clustering.utils import _validate_average_method_arg
+from ..metric import Metric
+
+
+class _LabelPairMetric(Metric):
+    """Shared shell: cat states of (preds, target) label vectors."""
+
+    is_differentiable = False
+    full_state_update = True
+    _jittable_compute = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("preds", default=[], dist_reduce_fx="cat")
+        self.add_state("target", default=[], dist_reduce_fx="cat")
+
+    def _batch_state(self, preds, target):
+        return {"preds": jnp.asarray(preds), "target": jnp.asarray(target)}
+
+
+class MutualInfoScore(_LabelPairMetric):
+    """Mutual information between cluster assignments (reference
+    ``clustering/mutual_info_score.py:29``)."""
+
+    higher_is_better = True
+    plot_lower_bound = 0.0
+
+    def _compute(self, state):
+        return mutual_info_score(state["preds"], state["target"])
+
+
+class AdjustedMutualInfoScore(_LabelPairMetric):
+    """Chance-adjusted mutual information (reference
+    ``clustering/adjusted_mutual_info_score.py:32``)."""
+
+    higher_is_better = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, average_method: str = "arithmetic", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        _validate_average_method_arg(average_method)
+        self.average_method = average_method
+
+    def _compute(self, state):
+        return adjusted_mutual_info_score(state["preds"], state["target"], self.average_method)
+
+
+class NormalizedMutualInfoScore(_LabelPairMetric):
+    """Entropy-normalized mutual information (reference
+    ``clustering/normalized_mutual_info_score.py:32``)."""
+
+    higher_is_better = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, average_method: str = "arithmetic", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        _validate_average_method_arg(average_method)
+        self.average_method = average_method
+
+    def _compute(self, state):
+        return normalized_mutual_info_score(state["preds"], state["target"], self.average_method)
+
+
+class RandScore(_LabelPairMetric):
+    """Rand index (reference ``clustering/rand_score.py:29``)."""
+
+    higher_is_better = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def _compute(self, state):
+        return rand_score(state["preds"], state["target"])
+
+
+class AdjustedRandScore(_LabelPairMetric):
+    """Chance-adjusted Rand index (reference ``clustering/adjusted_rand_score.py:29``)."""
+
+    higher_is_better = True
+    plot_lower_bound = -0.5
+    plot_upper_bound = 1.0
+
+    def _compute(self, state):
+        return adjusted_rand_score(state["preds"], state["target"])
+
+
+class FowlkesMallowsIndex(_LabelPairMetric):
+    """Fowlkes-Mallows index (reference ``clustering/fowlkes_mallows_index.py:29``)."""
+
+    higher_is_better = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def _compute(self, state):
+        return fowlkes_mallows_index(state["preds"], state["target"])
+
+
+class HomogeneityScore(_LabelPairMetric):
+    """Homogeneity score (reference
+    ``clustering/homogeneity_completeness_v_measure.py:33``)."""
+
+    higher_is_better = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def _compute(self, state):
+        return jnp.asarray(_homogeneity_score_compute(state["preds"], state["target"])[0], jnp.float32)
+
+
+class CompletenessScore(_LabelPairMetric):
+    """Completeness score (reference
+    ``clustering/homogeneity_completeness_v_measure.py:130``)."""
+
+    higher_is_better = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def _compute(self, state):
+        return jnp.asarray(_completeness_score_compute(state["preds"], state["target"])[0], jnp.float32)
+
+
+class VMeasureScore(_LabelPairMetric):
+    """V-measure score (reference
+    ``clustering/homogeneity_completeness_v_measure.py:226``)."""
+
+    higher_is_better = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, beta: float = 1.0, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not (isinstance(beta, float) and beta > 0):
+            raise ValueError(f"Argument `beta` should be a positive float. Got {beta}.")
+        self.beta = beta
+
+    def _compute(self, state):
+        return v_measure_score(state["preds"], state["target"], self.beta)
+
+
+class ClusterAccuracy(Metric):
+    """Clustering accuracy via optimal label assignment (reference
+    ``clustering/cluster_accuracy.py:35``; Hungarian solve via scipy)."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+    _jittable_compute = False
+
+    def __init__(self, num_classes: int, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(num_classes, int) or num_classes < 1:
+            raise ValueError(f"Expected argument `num_classes` to be a positive integer, but got {num_classes}")
+        self.num_classes = num_classes
+        self.add_state("confmat", default=jnp.zeros((num_classes, num_classes), jnp.int32), dist_reduce_fx="sum")
+
+    def _prepare_inputs(self, preds, target):
+        import numpy as np
+
+        for name, x in (("preds", preds), ("target", target)):
+            arr = np.asarray(x)
+            if arr.size and (arr.min() < 0 or arr.max() >= self.num_classes):
+                raise ValueError(
+                    f"Expected argument `{name}` to contain labels in [0, {self.num_classes}), "
+                    f"but got values in [{arr.min()}, {arr.max()}]"
+                )
+        return (preds, target), {}
+
+    def _batch_state(self, preds, target):
+        return {
+            "confmat": _multiclass_confusion_matrix_update(
+                jnp.asarray(preds).reshape(-1), jnp.asarray(target).reshape(-1).astype(jnp.int32), None, self.num_classes
+            )
+        }
+
+    def _compute(self, state):
+        return jnp.asarray(_cluster_accuracy_compute(state["confmat"]), jnp.float32)
+
+
+class _DataLabelMetric(Metric):
+    """Shared shell: cat states of (data, labels)."""
+
+    is_differentiable = False
+    full_state_update = True
+    _jittable_compute = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("data", default=[], dist_reduce_fx="cat")
+        self.add_state("labels", default=[], dist_reduce_fx="cat")
+
+    def _batch_state(self, data, labels):
+        return {"data": jnp.asarray(data), "labels": jnp.asarray(labels)}
+
+
+class CalinskiHarabaszScore(_DataLabelMetric):
+    """Calinski-Harabasz score (reference ``clustering/calinski_harabasz_score.py:29``)."""
+
+    higher_is_better = True
+    plot_lower_bound = 0.0
+
+    def _compute(self, state):
+        return calinski_harabasz_score(state["data"], state["labels"])
+
+
+class DaviesBouldinScore(_DataLabelMetric):
+    """Davies-Bouldin score (reference ``clustering/davies_bouldin_score.py:29``)."""
+
+    higher_is_better = False
+    plot_lower_bound = 0.0
+
+    def _compute(self, state):
+        return davies_bouldin_score(state["data"], state["labels"])
+
+
+class DunnIndex(_DataLabelMetric):
+    """Dunn index (reference ``clustering/dunn_index.py:29``)."""
+
+    higher_is_better = True
+    plot_lower_bound = 0.0
+
+    def __init__(self, p: float = 2, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.p = p
+
+    def _compute(self, state):
+        return dunn_index(state["data"], state["labels"], self.p)

@@ -12,7 +12,6 @@ from __future__ import annotations
 from collections.abc import Sequence
 from typing import Dict, Tuple, Union
 
-import jax.numpy as jnp
 import numpy as np
 
 
@@ -20,17 +19,11 @@ def _is_arraylike(x) -> bool:
     return hasattr(x, "shape") and hasattr(x, "dtype")
 
 
-def _fix_empty_arrays(boxes: jnp.ndarray) -> jnp.ndarray:
-    """Empty tensors can cause problems in DDP mode, this methods corrects them."""
-    if boxes.size == 0 and boxes.ndim == 1:
-        return boxes.reshape((0, 4))
-    return boxes
-
-
 def _boxes_to_xyxy_np(boxes, box_format: str) -> np.ndarray:
     """Host-side box normalization for the update hot path: (N,4) numpy xyxy, no
     device round-trip (the pairwise kernels get the arrays later, in one batch)."""
-    arr = np.asarray(boxes, np.float32).reshape(-1, 4) if np.asarray(boxes).size else np.zeros((0, 4), np.float32)
+    arr = np.asarray(boxes, np.float32)
+    arr = arr.reshape(-1, 4) if arr.size else np.zeros((0, 4), np.float32)
     if arr.size == 0 or box_format == "xyxy":
         return arr
     a, b, c, d = arr[:, 0], arr[:, 1], arr[:, 2], arr[:, 3]

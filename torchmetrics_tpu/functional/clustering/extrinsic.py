@@ -1,0 +1,238 @@
+"""Extrinsic (label-vs-label) clustering metrics (reference
+``functional/clustering/{mutual_info_score,adjusted_mutual_info_score,
+normalized_mutual_info_score,rand_score,adjusted_rand_score,fowlkes_mallows_index,
+homogeneity_completeness_v_measure,cluster_accuracy}.py``).
+
+All operate on the contingency table of two label vectors; see ``utils.py`` for why
+these computes run host-side. ``cluster_accuracy`` uses scipy's Hungarian solver
+instead of the reference's optional ``torch_linear_assignment`` wheel.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from .utils import (
+    _validate_average_method_arg,
+    calculate_contingency_matrix,
+    calculate_entropy,
+    calculate_generalized_mean,
+    calculate_pair_cluster_confusion_matrix,
+    check_cluster_labels,
+)
+
+
+def _as_scalar(x: float) -> jnp.ndarray:
+    return jnp.asarray(float(x), jnp.float32)
+
+
+# ------------------------------------------------------------- mutual information
+
+def _mutual_info_score_update(preds, target) -> np.ndarray:
+    check_cluster_labels(preds, target)
+    return calculate_contingency_matrix(preds, target)
+
+
+def _mutual_info_score_compute(contingency: np.ndarray) -> float:
+    n = contingency.sum()
+    u = contingency.sum(axis=1)
+    v = contingency.sum(axis=0)
+    if u.size == 1 or v.size == 1:
+        return 0.0
+    nzu, nzv = np.nonzero(contingency)
+    nz = contingency[nzu, nzv]
+    log_outer = np.log(u[nzu]) + np.log(v[nzv])
+    mutual_info = nz / n * (np.log(n) + np.log(nz) - log_outer)
+    return float(mutual_info.sum())
+
+
+def mutual_info_score(preds, target) -> jnp.ndarray:
+    r"""Mutual information between two clusterings (reference
+    ``functional/clustering/mutual_info_score.py:65``)."""
+    return _as_scalar(_mutual_info_score_compute(_mutual_info_score_update(preds, target)))
+
+
+def expected_mutual_info_score(contingency: np.ndarray, n_samples: int) -> float:
+    """Expected MI under the hypergeometric null (sklearn
+    ``_expected_mutual_info_fast`` semantics, vectorized over the inner sum)."""
+    contingency = np.asarray(contingency, np.float64)
+    a = contingency.sum(axis=1)
+    b = contingency.sum(axis=0)
+    if a.size == 1 or b.size == 1:
+        return 0.0
+    max_n = int(max(a.max(), b.max())) + 1
+    nijs = np.arange(max_n, dtype=np.float64)
+    nijs[0] = 1.0
+    term1 = nijs / n_samples
+    log_a, log_b = np.log(a), np.log(b)
+    log_nnij = np.log(n_samples) + np.log(nijs)
+    from scipy.special import gammaln
+
+    gln_a = gammaln(a + 1)
+    gln_b = gammaln(b + 1)
+    gln_na = gammaln(n_samples - a + 1)
+    gln_nb = gammaln(n_samples - b + 1)
+    gln_nnij = gammaln(nijs + 1) + gammaln(n_samples + 1)
+    emi = 0.0
+    for i in range(a.size):
+        for j in range(b.size):
+            start = int(max(1, a[i] - n_samples + b[j]))
+            end = int(min(a[i], b[j])) + 1
+            if end <= start:
+                continue
+            nij = np.arange(start, end, dtype=np.float64)
+            term2 = log_nnij[start:end] - log_a[i] - log_b[j]
+            gln = (
+                gln_a[i]
+                + gln_b[j]
+                + gln_na[i]
+                + gln_nb[j]
+                - gln_nnij[start:end]
+                - gammaln(a[i] - nij + 1)
+                - gammaln(b[j] - nij + 1)
+                - gammaln(n_samples - a[i] - b[j] + nij + 1)
+            )
+            emi += float((term1[start:end] * term2 * np.exp(gln)).sum())
+    return emi
+
+
+def adjusted_mutual_info_score(preds, target, average_method: str = "arithmetic") -> jnp.ndarray:
+    r"""Adjusted mutual information: ``(MI - E[MI]) / (normalizer - E[MI])``."""
+    _validate_average_method_arg(average_method)
+    contingency = _mutual_info_score_update(preds, target)
+    mutual_info = _mutual_info_score_compute(contingency)
+    n_samples = int(np.asarray(target).size)
+    emi = expected_mutual_info_score(contingency, n_samples)
+    normalizer = calculate_generalized_mean(
+        np.array([calculate_entropy(preds), calculate_entropy(target)]), average_method
+    )
+    denominator = normalizer - emi
+    eps = float(np.finfo(np.float32).eps)
+    denominator = min(denominator, -eps) if denominator < 0 else max(denominator, eps)
+    return _as_scalar((mutual_info - emi) / denominator)
+
+
+def normalized_mutual_info_score(preds, target, average_method: str = "arithmetic") -> jnp.ndarray:
+    r"""Normalized mutual information: ``MI / generalized_mean(H(preds), H(target))``."""
+    check_cluster_labels(preds, target)
+    _validate_average_method_arg(average_method)
+    mutual_info = _mutual_info_score_compute(_mutual_info_score_update(preds, target))
+    if abs(mutual_info) <= np.finfo(np.float32).eps:
+        return _as_scalar(mutual_info)
+    normalizer = calculate_generalized_mean(
+        np.array([calculate_entropy(preds), calculate_entropy(target)]), average_method
+    )
+    return _as_scalar(mutual_info / normalizer)
+
+
+# --------------------------------------------------------------------- rand family
+
+def _rand_score_update(preds, target) -> np.ndarray:
+    check_cluster_labels(preds, target)
+    return calculate_contingency_matrix(preds, target)
+
+
+def _rand_score_compute(contingency: np.ndarray) -> float:
+    pair_matrix = calculate_pair_cluster_confusion_matrix(contingency=contingency)
+    numerator = pair_matrix.diagonal().sum()
+    denominator = pair_matrix.sum()
+    if numerator == denominator or denominator == 0:
+        return 1.0
+    return float(numerator / denominator)
+
+
+def rand_score(preds, target) -> jnp.ndarray:
+    r"""Rand index: fraction of sample pairs on which the clusterings agree."""
+    return _as_scalar(_rand_score_compute(_rand_score_update(preds, target)))
+
+
+def _adjusted_rand_score_compute(contingency: np.ndarray) -> float:
+    (tn, fp), (fn, tp) = calculate_pair_cluster_confusion_matrix(contingency=contingency)
+    if fn == 0 and fp == 0:
+        return 1.0
+    return float(2.0 * (tp * tn - fn * fp) / ((tp + fn) * (fn + tn) + (tp + fp) * (fp + tn)))
+
+
+def adjusted_rand_score(preds, target) -> jnp.ndarray:
+    r"""Chance-adjusted Rand index."""
+    return _as_scalar(_adjusted_rand_score_compute(_rand_score_update(preds, target)))
+
+
+def _fowlkes_mallows_index_update(preds, target) -> Tuple[np.ndarray, int]:
+    check_cluster_labels(preds, target)
+    return calculate_contingency_matrix(preds, target), int(np.asarray(preds).size)
+
+
+def _fowlkes_mallows_index_compute(contingency: np.ndarray, n: int) -> float:
+    tk = (contingency**2).sum() - n
+    if np.isclose(tk, 0):
+        return 0.0
+    pk = (contingency.sum(axis=0) ** 2).sum() - n
+    qk = (contingency.sum(axis=1) ** 2).sum() - n
+    return float(np.sqrt(tk / pk) * np.sqrt(tk / qk))
+
+
+def fowlkes_mallows_index(preds, target) -> jnp.ndarray:
+    r"""Fowlkes-Mallows index: geometric mean of pairwise precision and recall."""
+    contingency, n = _fowlkes_mallows_index_update(preds, target)
+    return _as_scalar(_fowlkes_mallows_index_compute(contingency, n))
+
+
+# -------------------------------------------- homogeneity / completeness / v-measure
+
+def _homogeneity_score_compute(preds, target) -> Tuple[float, float, float, float]:
+    check_cluster_labels(preds, target)
+    if np.asarray(target).size == 0:
+        return 0.0, 0.0, 0.0, 0.0
+    entropy_target = calculate_entropy(target)
+    entropy_preds = calculate_entropy(preds)
+    mutual_info = _mutual_info_score_compute(_mutual_info_score_update(preds, target))
+    homogeneity = mutual_info / entropy_target if entropy_target else 1.0
+    return homogeneity, mutual_info, entropy_preds, entropy_target
+
+
+def homogeneity_score(preds, target) -> jnp.ndarray:
+    r"""Homogeneity: each cluster contains only members of a single class."""
+    return _as_scalar(_homogeneity_score_compute(preds, target)[0])
+
+
+def _completeness_score_compute(preds, target) -> Tuple[float, float]:
+    homogeneity, mutual_info, entropy_preds, _ = _homogeneity_score_compute(preds, target)
+    completeness = mutual_info / entropy_preds if entropy_preds else 1.0
+    return completeness, homogeneity
+
+
+def completeness_score(preds, target) -> jnp.ndarray:
+    r"""Completeness: all members of a class are assigned to the same cluster."""
+    return _as_scalar(_completeness_score_compute(preds, target)[0])
+
+
+def v_measure_score(preds, target, beta: float = 1.0) -> jnp.ndarray:
+    r"""V-measure: weighted harmonic mean of homogeneity and completeness."""
+    completeness, homogeneity = _completeness_score_compute(preds, target)
+    if homogeneity + completeness == 0.0:
+        return _as_scalar(1.0)
+    return _as_scalar((1 + beta) * homogeneity * completeness / (beta * homogeneity + completeness))
+
+
+# ------------------------------------------------------------------ cluster accuracy
+
+def _cluster_accuracy_compute(confmat: np.ndarray) -> float:
+    from scipy.optimize import linear_sum_assignment
+
+    confmat = np.asarray(confmat, np.float64)
+    row_ind, col_ind = linear_sum_assignment(confmat.max() - confmat)
+    return float(confmat[row_ind, col_ind].sum() / confmat.sum())
+
+
+def cluster_accuracy(preds, target, num_classes: int) -> jnp.ndarray:
+    r"""Clustering accuracy: optimal one-to-one label assignment (Hungarian solve via
+    scipy; the reference needs the optional ``torch_linear_assignment`` wheel)."""
+    from ..classification.confusion_matrix import multiclass_confusion_matrix
+
+    check_cluster_labels(preds, target)
+    confmat = multiclass_confusion_matrix(jnp.asarray(preds), jnp.asarray(target), num_classes=num_classes)
+    return _as_scalar(_cluster_accuracy_compute(np.asarray(confmat)))

@@ -1,0 +1,68 @@
+"""Intrinsic (data + labels) clustering scores (reference
+``functional/clustering/{calinski_harabasz_score,davies_bouldin_score,dunn_index}.py``).
+
+The reference loops over clusters with boolean indexing; here cluster sums/centroids
+come from one scatter-add pass and the rest is dense matrix arithmetic.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .utils import _cluster_views, _validate_intrinsic_cluster_data, _validate_intrinsic_labels_to_samples
+
+
+def calinski_harabasz_score(data, labels) -> jnp.ndarray:
+    r"""Calinski-Harabasz score: between/within dispersion ratio."""
+    data = np.asarray(data, np.float64)
+    labels = np.asarray(labels)
+    _validate_intrinsic_cluster_data(data, labels)
+    inverse, counts, centroids = _cluster_views(data, labels)
+    num_labels = counts.size
+    num_samples = data.shape[0]
+    _validate_intrinsic_labels_to_samples(num_labels, num_samples)
+    mean = data.mean(axis=0)
+    between = (((centroids - mean) ** 2).sum(axis=1) * counts).sum()
+    within = ((data - centroids[inverse]) ** 2).sum()
+    if within == 0:
+        return jnp.asarray(1.0, jnp.float32)
+    return jnp.asarray(between * (num_samples - num_labels) / (within * (num_labels - 1.0)), jnp.float32)
+
+
+def davies_bouldin_score(data, labels) -> jnp.ndarray:
+    r"""Davies-Bouldin score: mean worst-case ratio of intra-cluster spread to
+    centroid separation."""
+    data = np.asarray(data, np.float64)
+    labels = np.asarray(labels)
+    _validate_intrinsic_cluster_data(data, labels)
+    inverse, counts, centroids = _cluster_views(data, labels)
+    num_labels = counts.size
+    _validate_intrinsic_labels_to_samples(num_labels, data.shape[0])
+    dists = np.sqrt(((data - centroids[inverse]) ** 2).sum(axis=1))
+    intra = np.zeros(num_labels, np.float64)
+    np.add.at(intra, inverse, dists)
+    intra /= counts
+    diff = centroids[:, None, :] - centroids[None, :, :]
+    centroid_distances = np.sqrt((diff**2).sum(axis=-1))
+    if np.allclose(intra, 0) or np.allclose(centroid_distances, 0):
+        return jnp.asarray(0.0, jnp.float32)
+    centroid_distances[centroid_distances == 0] = np.inf
+    combined = intra[None, :] + intra[:, None]
+    scores = (combined / centroid_distances).max(axis=1)
+    return jnp.asarray(scores.mean(), jnp.float32)
+
+
+def dunn_index(data, labels, p: float = 2) -> jnp.ndarray:
+    r"""Dunn index: min inter-centroid distance over max intra-cluster radius."""
+    data = np.asarray(data, np.float64)
+    labels = np.asarray(labels)
+    inverse, counts, centroids = _cluster_views(data, labels)
+    num_labels = counts.size
+    # inter-cluster distances over all centroid pairs (upper triangle)
+    iu = np.triu_indices(num_labels, k=1)
+    inter = np.linalg.norm(centroids[iu[0]] - centroids[iu[1]], ord=p, axis=1)
+    radii = np.linalg.norm(data - centroids[inverse], ord=p, axis=1)
+    max_intra = np.zeros(num_labels, np.float64)
+    np.maximum.at(max_intra, inverse, radii)
+    return jnp.asarray(inter.min() / max_intra.max(), jnp.float32)

@@ -1,0 +1,139 @@
+"""Clustering shared helpers (reference ``functional/clustering/utils.py``).
+
+Cluster labels are arbitrary integers, so the contingency machinery is inherently
+dynamic-shape (``unique``); it runs host-side in numpy at compute time. The heavy
+per-sample accumulation for these metrics is just label storage (cat states) — there
+is no device hot loop to win.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import numpy as np
+
+from ...utilities.checks import _check_same_shape
+
+
+def check_cluster_labels(preds, target) -> None:
+    """Validate shapes and that labels are real, discrete values."""
+    _check_same_shape(preds, target)
+    for x in (preds, target):
+        dt = np.asarray(x).dtype
+        if not (np.issubdtype(dt, np.integer) or np.issubdtype(dt, np.floating)):
+            raise ValueError(
+                f"Expected real, discrete values for x but received {np.asarray(preds).dtype} and {np.asarray(target).dtype}."
+            )
+        if np.issubdtype(dt, np.floating) and not np.all(np.mod(np.asarray(x), 1) == 0):
+            raise ValueError(
+                f"Expected real, discrete values for x but received {np.asarray(preds).dtype} and {np.asarray(target).dtype}."
+            )
+
+
+def calculate_entropy(x) -> float:
+    """Shannon entropy of a label vector (log form against roundoff)."""
+    x = np.asarray(x).reshape(-1)
+    if x.size == 0:
+        return 1.0
+    p = np.bincount(np.unique(x, return_inverse=True)[1])
+    p = p[p > 0]
+    if p.size == 1:
+        return 0.0
+    n = p.sum()
+    return float(-np.sum((p / n) * (np.log(p) - np.log(n))))
+
+
+def calculate_generalized_mean(x: np.ndarray, p: Union[int, str]) -> float:
+    """Generalized mean with the string shortcuts used by the MI normalizers."""
+    x = np.asarray(x, np.float64)
+    if np.iscomplexobj(x) or np.any(x < 0):
+        raise ValueError("`x` must contain positive real numbers")
+    if isinstance(p, str):
+        if p == "min":
+            return float(x.min())
+        if p == "geometric":
+            return float(np.exp(np.mean(np.log(x))))
+        if p == "arithmetic":
+            return float(x.mean())
+        if p == "max":
+            return float(x.max())
+        raise ValueError("'method' must be 'min', 'geometric', 'arithmetic', or 'max'")
+    return float(np.mean(x**p) ** (1.0 / p))
+
+
+def _validate_average_method_arg(average_method: str) -> None:
+    if average_method not in ("min", "geometric", "arithmetic", "max"):
+        raise ValueError(
+            "Expected argument `average_method` to be one of `min`, `geometric`, `arithmetic`, `max`,"
+            f" but got {average_method}"
+        )
+
+
+def calculate_contingency_matrix(preds, target, eps: Optional[float] = None, sparse: bool = False) -> np.ndarray:
+    """Contingency matrix of shape ``(n_classes_target, n_classes_preds)``."""
+    if eps is not None and sparse is True:
+        raise ValueError("Cannot specify `eps` and return sparse tensor.")
+    preds = np.asarray(preds).reshape(-1)
+    target = np.asarray(target).reshape(-1)
+    if preds.ndim != 1 or target.ndim != 1:
+        raise ValueError(f"Expected 1d `preds` and `target` but got {preds.ndim} and {target.ndim}.")
+    preds_classes, preds_idx = np.unique(preds, return_inverse=True)
+    target_classes, target_idx = np.unique(target, return_inverse=True)
+    contingency = np.zeros((target_classes.size, preds_classes.size), np.float64)
+    np.add.at(contingency, (target_idx, preds_idx), 1)
+    if eps is not None:
+        contingency = contingency + eps
+    return contingency
+
+
+def calculate_pair_cluster_confusion_matrix(
+    preds=None, target=None, contingency: Optional[np.ndarray] = None
+) -> np.ndarray:
+    """2x2 pair confusion matrix over all sample pairs (sklearn
+    ``pair_confusion_matrix`` semantics; not symmetric)."""
+    if preds is None and target is None and contingency is None:
+        raise ValueError("Must provide either `preds` and `target` or `contingency`.")
+    if preds is not None and target is not None and contingency is not None:
+        raise ValueError("Must provide either `preds` and `target` or `contingency`, not both.")
+    if contingency is None:
+        contingency = calculate_contingency_matrix(preds, target)
+    n_samples = contingency.sum()
+    n_c = contingency.sum(axis=1)
+    n_k = contingency.sum(axis=0)
+    sum_squares = (contingency**2).sum()
+    pair_matrix = np.zeros((2, 2), np.float64)
+    pair_matrix[1, 1] = sum_squares - n_samples
+    pair_matrix[0, 1] = (contingency @ n_k).sum() - sum_squares
+    pair_matrix[1, 0] = (contingency.T @ n_c).sum() - sum_squares
+    pair_matrix[0, 0] = n_samples**2 - pair_matrix[0, 1] - pair_matrix[1, 0] - sum_squares
+    return pair_matrix
+
+
+def _validate_intrinsic_cluster_data(data, labels) -> None:
+    data = np.asarray(data)
+    labels = np.asarray(labels)
+    if data.ndim != 2:
+        raise ValueError(f"Expected 2D data, got {data.ndim}D data instead")
+    if not np.issubdtype(data.dtype, np.floating):
+        raise ValueError(f"Expected floating point data, got {data.dtype} data instead")
+    if labels.ndim != 1:
+        raise ValueError(f"Expected 1D labels, got {labels.ndim}D labels instead")
+
+
+def _validate_intrinsic_labels_to_samples(num_labels: int, num_samples: int) -> None:
+    if not 1 < num_labels < num_samples:
+        raise ValueError(
+            "Number of detected clusters must be greater than one and less than the number of samples."
+            f" Got {num_labels} clusters and {num_samples} samples."
+        )
+
+
+def _cluster_views(data: np.ndarray, labels: np.ndarray) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Zero-index labels; return (inverse_labels, counts, centroids)."""
+    _, inverse = np.unique(labels, return_inverse=True)
+    num_labels = int(inverse.max()) + 1 if inverse.size else 0
+    counts = np.bincount(inverse, minlength=num_labels).astype(np.float64)
+    centroids = np.zeros((num_labels, data.shape[1]), np.float64)
+    np.add.at(centroids, inverse, data)
+    centroids /= counts[:, None]
+    return inverse, counts, centroids

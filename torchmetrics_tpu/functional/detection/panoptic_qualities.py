@@ -113,9 +113,18 @@ def _panoptic_quality_update_sample(
     fn = np.zeros(num_categories, np.int64)
     cont_of = np.vectorize(cat_id_to_continuous_id.__getitem__, otypes=[np.int64])
 
+    # instance ids are arbitrary ints (incl. negative sentinels); remap them jointly
+    # to a dense non-negative range so the int64 (category << 32 | instance) encoding
+    # cannot shift into a neighboring category
+    all_inst = np.concatenate([pred_s[:, 1], target_s[:, 1], np.asarray([void_color[1]], np.int64)])
+    inst_values = np.unique(all_inst)
+    pred_s = np.stack([pred_s[:, 0], np.searchsorted(inst_values, pred_s[:, 1])], axis=1)
+    target_s = np.stack([target_s[:, 0], np.searchsorted(inst_values, target_s[:, 1])], axis=1)
+    void_inst = int(np.searchsorted(inst_values, void_color[1]))
+
     pc = _encode(pred_s)
     tc = _encode(target_s)
-    void = int(void_color[0]) * int(_SHIFT) + int(void_color[1])
+    void = int(void_color[0]) * int(_SHIFT) + void_inst
 
     up, p_areas = np.unique(pc, return_counts=True)
     ut, t_areas = np.unique(tc, return_counts=True)

@@ -1,0 +1,25 @@
+"""Nominal tower — stateless kernels (reference ``src/torchmetrics/functional/nominal/``)."""
+
+from ._association import (
+    cramers_v,
+    cramers_v_matrix,
+    pearsons_contingency_coefficient,
+    pearsons_contingency_coefficient_matrix,
+    theils_u,
+    theils_u_matrix,
+    tschuprows_t,
+    tschuprows_t_matrix,
+)
+from .fleiss_kappa import fleiss_kappa
+
+__all__ = [
+    "cramers_v",
+    "cramers_v_matrix",
+    "fleiss_kappa",
+    "pearsons_contingency_coefficient",
+    "pearsons_contingency_coefficient_matrix",
+    "theils_u",
+    "theils_u_matrix",
+    "tschuprows_t",
+    "tschuprows_t_matrix",
+]

@@ -1,0 +1,219 @@
+"""Confusion-matrix association statistics: Cramer's V, Pearson's contingency
+coefficient, Theil's U, Tschuprow's T (reference ``functional/nominal/{cramers,
+pearson,theils_u,tschuprows}.py``).
+
+All four share one sufficient statistic — a ``(C, C)`` contingency table accumulated
+with the jitted one-hot-matmul bincount — and differ only in the host-side scalar
+computed from it, so the update kernel lives here once.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..classification.confusion_matrix import _multiclass_confusion_matrix_update
+from .utils import (
+    _compute_bias_corrected_values,
+    _compute_chi_squared,
+    _drop_empty_rows_and_cols,
+    _handle_nan_in_data,
+    _nominal_input_validation,
+    _unable_to_use_bias_correction_warning,
+)
+
+
+def _nominal_update(
+    preds,
+    target,
+    num_classes: Optional[int] = None,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> jnp.ndarray:
+    """Shared contingency-table update. 2D inputs collapse through argmax; NaN policy
+    is applied host-side (drop is dynamic-shape). ``num_classes=None`` infers the
+    table size from the *collapsed, NaN-handled* labels."""
+    preds = np.asarray(preds)
+    target = np.asarray(target)
+    preds = preds.argmax(1) if preds.ndim == 2 else preds
+    target = target.argmax(1) if target.ndim == 2 else target
+    preds, target = _handle_nan_in_data(preds, target, nan_strategy, nan_replace_value)
+    if num_classes is None:
+        num_classes = int(max(preds.max(initial=0), target.max(initial=0))) + 1
+    preds_j = jnp.asarray(preds.astype(np.int32))
+    target_j = jnp.asarray(target.astype(np.int32))
+    return _multiclass_confusion_matrix_update(preds_j, target_j, None, num_classes)
+
+
+def _cramers_v_update(preds, target, num_classes, nan_strategy="replace", nan_replace_value=0.0):
+    return _nominal_update(preds, target, num_classes, nan_strategy, nan_replace_value)
+
+
+def _cramers_v_compute(confmat, bias_correction: bool) -> jnp.ndarray:
+    confmat = _drop_empty_rows_and_cols(np.asarray(confmat, np.float64))
+    cm_sum = confmat.sum()
+    chi_squared = _compute_chi_squared(confmat, bias_correction)
+    phi_squared = chi_squared / cm_sum
+    num_rows, num_cols = confmat.shape
+    if bias_correction:
+        phi_squared_corrected, rows_corrected, cols_corrected = _compute_bias_corrected_values(
+            phi_squared, num_rows, num_cols, cm_sum
+        )
+        if min(rows_corrected, cols_corrected) == 1:
+            _unable_to_use_bias_correction_warning(metric_name="Cramer's V")
+            return jnp.asarray(float("nan"), jnp.float32)
+        value = np.sqrt(phi_squared_corrected / min(rows_corrected - 1, cols_corrected - 1))
+    else:
+        value = np.sqrt(phi_squared / min(num_rows - 1, num_cols - 1))
+    return jnp.asarray(np.clip(value, 0.0, 1.0), jnp.float32)
+
+
+def cramers_v(
+    preds,
+    target,
+    bias_correction: bool = True,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> jnp.ndarray:
+    r"""Cramer's V: ``sqrt((chi^2/n) / min(r-1, k-1))`` association between two
+    categorical series (reference ``functional/nominal/cramers.py:89``)."""
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    confmat = _cramers_v_update(preds, target, None, nan_strategy, nan_replace_value)
+    return _cramers_v_compute(confmat, bias_correction)
+
+
+def cramers_v_matrix(matrix, bias_correction: bool = True, nan_strategy="replace", nan_replace_value=0.0):
+    """Pairwise Cramer's V over columns of an observation matrix (reference
+    ``functional/nominal/cramers.py:144``)."""
+    return _nominal_matrix(matrix, lambda p, t: cramers_v(p, t, bias_correction, nan_strategy, nan_replace_value))
+
+
+def _pearsons_contingency_coefficient_update(preds, target, num_classes, nan_strategy="replace", nan_replace_value=0.0):
+    return _nominal_update(preds, target, num_classes, nan_strategy, nan_replace_value)
+
+
+def _pearsons_contingency_coefficient_compute(confmat) -> jnp.ndarray:
+    confmat = _drop_empty_rows_and_cols(np.asarray(confmat, np.float64))
+    cm_sum = confmat.sum()
+    chi_squared = _compute_chi_squared(confmat, bias_correction=False)
+    phi_squared = chi_squared / cm_sum
+    value = np.sqrt(phi_squared / (1 + phi_squared))
+    return jnp.asarray(np.clip(value, 0.0, 1.0), jnp.float32)
+
+
+def pearsons_contingency_coefficient(
+    preds, target, nan_strategy: str = "replace", nan_replace_value: Optional[float] = 0.0
+) -> jnp.ndarray:
+    r"""Pearson's contingency coefficient ``sqrt(phi^2 / (1 + phi^2))`` (reference
+    ``functional/nominal/pearson.py:77``)."""
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    confmat = _pearsons_contingency_coefficient_update(preds, target, None, nan_strategy, nan_replace_value)
+    return _pearsons_contingency_coefficient_compute(confmat)
+
+
+def pearsons_contingency_coefficient_matrix(matrix, nan_strategy="replace", nan_replace_value=0.0):
+    """Pairwise Pearson's contingency coefficient over matrix columns."""
+    return _nominal_matrix(matrix, lambda p, t: pearsons_contingency_coefficient(p, t, nan_strategy, nan_replace_value))
+
+
+def _theils_u_update(preds, target, num_classes, nan_strategy="replace", nan_replace_value=0.0):
+    return _nominal_update(preds, target, num_classes, nan_strategy, nan_replace_value)
+
+
+def _conditional_entropy_compute(confmat: np.ndarray) -> float:
+    confmat = _drop_empty_rows_and_cols(confmat)
+    total = confmat.sum()
+    p_xy = confmat / total
+    p_y = (confmat.sum(1) / total)[:, None]
+    with np.errstate(divide="ignore", invalid="ignore"):
+        terms = p_xy * np.log(p_y / p_xy)
+    return float(np.nansum(terms))
+
+
+def _theils_u_compute(confmat) -> jnp.ndarray:
+    confmat = _drop_empty_rows_and_cols(np.asarray(confmat, np.float64))
+    s_xy = _conditional_entropy_compute(confmat)
+    total = confmat.sum()
+    p_x = confmat.sum(0) / total
+    with np.errstate(divide="ignore", invalid="ignore"):
+        s_x = -np.nansum(p_x * np.log(p_x))
+    if s_x == 0:
+        return jnp.asarray(0.0, jnp.float32)
+    return jnp.asarray((s_x - s_xy) / s_x, jnp.float32)
+
+
+def theils_u(
+    preds, target, nan_strategy: str = "replace", nan_replace_value: Optional[float] = 0.0
+) -> jnp.ndarray:
+    r"""Theil's U (uncertainty coefficient) ``(H(X) - H(X|Y)) / H(X)`` — asymmetric
+    association (reference ``functional/nominal/theils_u.py:118``)."""
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    confmat = _theils_u_update(preds, target, None, nan_strategy, nan_replace_value)
+    return _theils_u_compute(confmat)
+
+
+def theils_u_matrix(matrix, nan_strategy="replace", nan_replace_value=0.0):
+    """Pairwise Theil's U over matrix columns (asymmetric — full off-diagonal)."""
+    matrix = np.asarray(matrix)
+    num_vars = matrix.shape[1]
+    out = np.eye(num_vars, dtype=np.float32)
+    for i in range(num_vars):
+        for j in range(num_vars):
+            if i != j:
+                out[i, j] = float(theils_u(matrix[:, i], matrix[:, j], nan_strategy, nan_replace_value))
+    return jnp.asarray(out)
+
+
+def _tschuprows_t_update(preds, target, num_classes, nan_strategy="replace", nan_replace_value=0.0):
+    return _nominal_update(preds, target, num_classes, nan_strategy, nan_replace_value)
+
+
+def _tschuprows_t_compute(confmat, bias_correction: bool) -> jnp.ndarray:
+    confmat = _drop_empty_rows_and_cols(np.asarray(confmat, np.float64))
+    cm_sum = confmat.sum()
+    chi_squared = _compute_chi_squared(confmat, bias_correction)
+    phi_squared = chi_squared / cm_sum
+    num_rows, num_cols = confmat.shape
+    if bias_correction:
+        phi_squared_corrected, rows_corrected, cols_corrected = _compute_bias_corrected_values(
+            phi_squared, num_rows, num_cols, cm_sum
+        )
+        if min(rows_corrected, cols_corrected) == 1:
+            _unable_to_use_bias_correction_warning(metric_name="Tschuprow's T")
+            return jnp.asarray(float("nan"), jnp.float32)
+        value = np.sqrt(phi_squared_corrected / np.sqrt((rows_corrected - 1) * (cols_corrected - 1)))
+    else:
+        value = np.sqrt(phi_squared / np.sqrt((num_rows - 1) * (num_cols - 1)))
+    return jnp.asarray(np.clip(value, 0.0, 1.0), jnp.float32)
+
+
+def tschuprows_t(
+    preds,
+    target,
+    bias_correction: bool = True,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> jnp.ndarray:
+    r"""Tschuprow's T: ``sqrt((chi^2/n) / sqrt((r-1)(k-1)))`` (reference
+    ``functional/nominal/tschuprows.py:95``)."""
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    confmat = _tschuprows_t_update(preds, target, None, nan_strategy, nan_replace_value)
+    return _tschuprows_t_compute(confmat, bias_correction)
+
+
+def tschuprows_t_matrix(matrix, bias_correction: bool = True, nan_strategy="replace", nan_replace_value=0.0):
+    """Pairwise Tschuprow's T over matrix columns."""
+    return _nominal_matrix(matrix, lambda p, t: tschuprows_t(p, t, bias_correction, nan_strategy, nan_replace_value))
+
+
+def _nominal_matrix(matrix, pair_fn) -> jnp.ndarray:
+    """Symmetric pairwise association matrix over observation-matrix columns."""
+    matrix = np.asarray(matrix)
+    num_vars = matrix.shape[1]
+    out = np.eye(num_vars, dtype=np.float32)
+    for i, j in [(i, j) for i in range(num_vars) for j in range(i + 1, num_vars)]:
+        val = float(pair_fn(matrix[:, i], matrix[:, j]))
+        out[i, j] = out[j, i] = val
+    return jnp.asarray(out)

@@ -1,0 +1,53 @@
+"""Fleiss' kappa (reference ``functional/nominal/fleiss_kappa.py``).
+
+Fully jittable: the probs branch collapses through argmax + one-hot sum (static
+category axis), the counts branch is already a dense (N, C) table.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _fleiss_kappa_update(ratings: jnp.ndarray, mode: str = "counts") -> jnp.ndarray:
+    if mode == "probs":
+        ratings = jnp.asarray(ratings)
+        if ratings.ndim != 3 or not jnp.issubdtype(ratings.dtype, jnp.floating):
+            raise ValueError(
+                "If argument ``mode`` is 'probs', ratings must have 3 dimensions with the format"
+                " [n_samples, n_categories, n_raters] and be floating point."
+            )
+        num_categories = ratings.shape[1]
+        choices = jnp.argmax(ratings, axis=1)  # (N, R)
+        return jax.nn.one_hot(choices, num_categories, dtype=jnp.int32).sum(axis=1)
+    ratings = jnp.asarray(ratings)
+    if ratings.ndim != 2 or jnp.issubdtype(ratings.dtype, jnp.floating):
+        raise ValueError(
+            "If argument ``mode`` is `counts`, ratings must have 2 dimensions with the format"
+            " [n_samples, n_categories] and be none floating point."
+        )
+    return ratings
+
+
+def _fleiss_kappa_compute(counts: jnp.ndarray) -> jnp.ndarray:
+    counts = counts.astype(jnp.float32)
+    total = counts.shape[0]
+    num_raters = counts.sum(axis=1).max()
+    p_i = counts.sum(axis=0) / (total * num_raters)
+    p_j = ((counts**2).sum(axis=1) - num_raters) / (num_raters * (num_raters - 1))
+    p_bar = p_j.mean()
+    pe_bar = (p_i**2).sum()
+    return (p_bar - pe_bar) / (1 - pe_bar + 1e-5)
+
+
+def fleiss_kappa(ratings: jnp.ndarray, mode: str = "counts") -> jnp.ndarray:
+    r"""Fleiss' kappa inter-rater agreement: ``(p_bar - pe_bar) / (1 - pe_bar)``.
+
+    ``ratings`` is ``[n_samples, n_categories]`` integer counts (``mode="counts"``) or
+    ``[n_samples, n_categories, n_raters]`` probabilities (``mode="probs"``).
+    """
+    if mode not in ["counts", "probs"]:
+        raise ValueError("Argument ``mode`` must be one of ['counts', 'probs'].")
+    counts = _fleiss_kappa_update(ratings, mode)
+    return _fleiss_kappa_compute(counts)

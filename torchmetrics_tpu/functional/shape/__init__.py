@@ -1,0 +1,5 @@
+"""Shape tower — stateless kernels (reference ``src/torchmetrics/functional/shape/``)."""
+
+from .procrustes import procrustes_disparity
+
+__all__ = ["procrustes_disparity"]

@@ -1,0 +1,5 @@
+"""Nominal tower — stateful metric classes (reference ``src/torchmetrics/nominal/``)."""
+
+from .metrics import CramersV, FleissKappa, PearsonsContingencyCoefficient, TheilsU, TschuprowsT
+
+__all__ = ["CramersV", "FleissKappa", "PearsonsContingencyCoefficient", "TheilsU", "TschuprowsT"]

@@ -1,0 +1,138 @@
+"""Nominal association metric classes (reference ``src/torchmetrics/nominal/*.py``).
+
+All four contingency metrics share one design: a ``(C, C)`` sum-reduced confusion
+state (one psum to sync) accumulated by the jitted bincount kernel, with NaN policy
+applied host-side in ``_prepare_inputs`` and the scalar statistic computed host-side
+from the tiny table.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..functional.nominal._association import (
+    _cramers_v_compute,
+    _nominal_update,
+    _pearsons_contingency_coefficient_compute,
+    _theils_u_compute,
+    _tschuprows_t_compute,
+)
+from ..functional.nominal.fleiss_kappa import _fleiss_kappa_compute, _fleiss_kappa_update
+from ..functional.nominal.utils import _nominal_input_validation
+from ..metric import Metric
+
+
+class _ContingencyMetric(Metric):
+    """Shared shell for the confusion-state nominal metrics."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+    _jittable_compute = False
+
+    def __init__(
+        self,
+        num_classes: int,
+        nan_strategy: str = "replace",
+        nan_replace_value: Optional[float] = 0.0,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(num_classes, int) or num_classes < 1:
+            raise ValueError(f"Expected argument `num_classes` to be a positive integer, but got {num_classes}")
+        _nominal_input_validation(nan_strategy, nan_replace_value)
+        self.num_classes = num_classes
+        self.nan_strategy = nan_strategy
+        self.nan_replace_value = nan_replace_value
+        self.add_state("confmat", jnp.zeros((num_classes, num_classes), jnp.float32), dist_reduce_fx="sum")
+
+    def _prepare_inputs(self, preds, target):
+        # NaN policy + argmax collapse run host-side ('drop' is dynamic-shape)
+        confmat = _nominal_update(preds, target, self.num_classes, self.nan_strategy, self.nan_replace_value)
+        return (confmat,), {}
+
+    def _batch_state(self, confmat):
+        return {"confmat": confmat}
+
+
+class CramersV(_ContingencyMetric):
+    """Cramer's V association statistic (reference ``nominal/cramers.py:31``)."""
+
+    def __init__(
+        self,
+        num_classes: int,
+        bias_correction: bool = True,
+        nan_strategy: str = "replace",
+        nan_replace_value: Optional[float] = 0.0,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(num_classes, nan_strategy, nan_replace_value, **kwargs)
+        self.bias_correction = bias_correction
+
+    def _compute(self, state):
+        return _cramers_v_compute(state["confmat"], self.bias_correction)
+
+
+class PearsonsContingencyCoefficient(_ContingencyMetric):
+    """Pearson's contingency coefficient (reference ``nominal/pearson.py:34``)."""
+
+    def _compute(self, state):
+        return _pearsons_contingency_coefficient_compute(state["confmat"])
+
+
+class TheilsU(_ContingencyMetric):
+    """Theil's U uncertainty coefficient (reference ``nominal/theils_u.py:31``)."""
+
+    def _compute(self, state):
+        return _theils_u_compute(state["confmat"])
+
+
+class TschuprowsT(_ContingencyMetric):
+    """Tschuprow's T association statistic (reference ``nominal/tschuprows.py:31``)."""
+
+    def __init__(
+        self,
+        num_classes: int,
+        bias_correction: bool = True,
+        nan_strategy: str = "replace",
+        nan_replace_value: Optional[float] = 0.0,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(num_classes, nan_strategy, nan_replace_value, **kwargs)
+        self.bias_correction = bias_correction
+
+    def _compute(self, state):
+        return _tschuprows_t_compute(state["confmat"], self.bias_correction)
+
+
+class FleissKappa(Metric):
+    """Fleiss' kappa inter-rater agreement (reference ``nominal/fleiss_kappa.py:30``).
+
+    The per-sample counts table is a cat state — kappa is not decomposable into
+    fixed-size sufficient statistics because the rater normalization depends on the
+    global max rater count.
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, mode: str = "counts", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if mode not in ["counts", "probs"]:
+            raise ValueError("Argument ``mode`` must be one of ['counts', 'probs'].")
+        self.mode = mode
+        self.add_state("counts", default=[], dist_reduce_fx="cat")
+
+    def _batch_state(self, ratings):
+        return {"counts": _fleiss_kappa_update(ratings, self.mode)}
+
+    def _compute(self, state):
+        return _fleiss_kappa_compute(jnp.asarray(state["counts"]))

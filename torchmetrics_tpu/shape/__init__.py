@@ -1,0 +1,5 @@
+"""Shape tower — stateful metric classes (reference ``src/torchmetrics/shape/``)."""
+
+from .procrustes import ProcrustesDisparity
+
+__all__ = ["ProcrustesDisparity"]

@@ -1,0 +1,36 @@
+"""ProcrustesDisparity metric class (reference ``shape/procrustes.py:30``)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+
+from ..functional.shape.procrustes import procrustes_disparity
+from ..metric import Metric
+
+
+class ProcrustesDisparity(Metric):
+    """Running sum/mean of per-sample Procrustes disparity (two sum states)."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, reduction: str = "mean", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if reduction not in ("mean", "sum"):
+            raise ValueError(f"Argument `reduction` must be one of ['mean', 'sum'], got {reduction}")
+        self.reduction = reduction
+        self.add_state("disparity", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.zeros((), jnp.int32), dist_reduce_fx="sum")
+
+    def _batch_state(self, point_cloud1, point_cloud2):
+        disparity = procrustes_disparity(point_cloud1, point_cloud2)
+        return {"disparity": disparity.sum(), "total": jnp.asarray(disparity.size, jnp.int32)}
+
+    def _compute(self, state):
+        if self.reduction == "mean":
+            return state["disparity"] / state["total"]
+        return state["disparity"]
