@@ -282,3 +282,28 @@ def test_cluster_accuracy_rejects_out_of_range():
     m = tm.ClusterAccuracy(num_classes=3)
     with pytest.raises(ValueError, match="labels in"):
         m.update(jnp.asarray(np.array([0, 1, 2, 7, 7, 7])), jnp.asarray(np.array([0, 1, 2, 0, 1, 2])))
+
+
+def test_yates_correction_scipy_semantics():
+    """Regression: Yates correction clamps by |observed-expected|, not blindly 0.5."""
+    from scipy.stats import chi2_contingency
+
+    preds = np.array([1] + [0] + [1] * 18 + [1])
+    target = np.array([0] + [1] + [1] * 18 + [1])
+    # build the 2x2 table scipy sees
+    table = np.zeros((2, 2))
+    np.add.at(table, (target, preds), 1)
+    chi2 = chi2_contingency(table, correction=True).statistic
+    ours = float(F.cramers_v(preds, target, bias_correction=True))
+    # direct check on the chi-squared kernel
+    from torchmetrics_tpu.functional.nominal.utils import _compute_chi_squared
+
+    assert np.isclose(_compute_chi_squared(table.astype(float), bias_correction=True), chi2, atol=1e-8)
+    assert np.isfinite(ours)
+
+
+def test_dunn_index_validation():
+    with pytest.raises(ValueError, match="Number of detected clusters"):
+        F.dunn_index(jnp.asarray(DATA[0]), jnp.zeros(DATA[0].shape[0], jnp.int32))
+    with pytest.raises(ValueError, match="Expected 2D data"):
+        F.dunn_index(jnp.zeros((8,)), jnp.zeros(8, jnp.int32))

@@ -1,0 +1,204 @@
+"""Image tower parity tests (reference-torchmetrics oracle; pure-torch metrics all run
+without optional deps)."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from tests.helpers import _assert_allclose
+from tests.oracle import reference_torchmetrics
+
+import torchmetrics_tpu as tm
+import torchmetrics_tpu.functional as F
+
+_RNG = np.random.default_rng(99)
+NUM_BATCHES, B, C, H, W = 2, 2, 3, 32, 32
+PREDS = _RNG.random((NUM_BATCHES, B, C, H, W)).astype(np.float32)
+TARGET = (0.7 * PREDS + 0.3 * _RNG.random((NUM_BATCHES, B, C, H, W))).astype(np.float32)
+
+
+def _oracle():
+    tm_ref = reference_torchmetrics()
+    if tm_ref is None:
+        pytest.skip("oracle unavailable")
+    import torch
+
+    return tm_ref, torch
+
+
+FUNCTIONAL_CASES = [
+    ("peak_signal_noise_ratio", dict(data_range=1.0), {}),
+    ("peak_signal_noise_ratio", dict(data_range=(0.1, 0.9)), {}),
+    ("structural_similarity_index_measure", dict(), {}),
+    ("structural_similarity_index_measure", dict(gaussian_kernel=False, kernel_size=7), {}),
+    ("structural_similarity_index_measure", dict(data_range=1.0, reduction="none"), {}),
+    ("universal_image_quality_index", dict(), {}),
+    ("spectral_angle_mapper", dict(), {}),
+    ("spectral_angle_mapper", dict(reduction="none"), {}),
+    ("error_relative_global_dimensionless_synthesis", dict(), {}),
+    ("total_variation", dict(), {}),
+    ("total_variation", dict(reduction="mean"), {}),
+    ("relative_average_spectral_error", dict(), {}),
+    ("root_mean_squared_error_using_sliding_window", dict(), {}),
+    ("spatial_correlation_coefficient", dict(), {}),
+    ("spectral_distortion_index", dict(), {}),
+]
+
+
+@pytest.mark.parametrize("name,kwargs,_", FUNCTIONAL_CASES, ids=[f"{c[0]}-{i}" for i, c in enumerate(FUNCTIONAL_CASES)])
+def test_image_functional_parity(name, kwargs, _):
+    tm_ref, torch = _oracle()
+    ref_fn = getattr(tm_ref.functional.image, name)
+    ours_fn = getattr(F, name)
+    for i in range(NUM_BATCHES):
+        if name == "total_variation":
+            ours = ours_fn(jnp.asarray(PREDS[i]), **kwargs)
+            ref = ref_fn(torch.as_tensor(PREDS[i]), **kwargs)
+        else:
+            ours = ours_fn(jnp.asarray(PREDS[i]), jnp.asarray(TARGET[i]), **kwargs)
+            ref = ref_fn(torch.as_tensor(PREDS[i]), torch.as_tensor(TARGET[i]), **kwargs)
+        _assert_allclose(ours, ref.numpy(), atol=1e-4, msg=f"batch {i} {name}")
+
+
+def test_msssim_parity():
+    tm_ref, torch = _oracle()
+    preds = _RNG.random((1, 1, 180, 180)).astype(np.float32)
+    target = (0.8 * preds + 0.2 * _RNG.random((1, 1, 180, 180))).astype(np.float32)
+    ours = F.multiscale_structural_similarity_index_measure(jnp.asarray(preds), jnp.asarray(target), data_range=1.0)
+    ref = tm_ref.functional.image.multiscale_structural_similarity_index_measure(
+        torch.as_tensor(preds), torch.as_tensor(target), data_range=1.0
+    )
+    _assert_allclose(ours, ref.numpy(), atol=1e-4)
+
+
+def test_vif_parity():
+    tm_ref, torch = _oracle()
+    preds = _RNG.random((2, 2, 48, 48)).astype(np.float32)
+    target = (0.85 * preds + 0.15 * _RNG.random((2, 2, 48, 48))).astype(np.float32)
+    ours = F.visual_information_fidelity(jnp.asarray(preds), jnp.asarray(target))
+    ref = tm_ref.functional.image.visual_information_fidelity(torch.as_tensor(preds), torch.as_tensor(target))
+    _assert_allclose(ours, ref.numpy(), atol=1e-4)
+
+
+def test_psnrb_parity():
+    tm_ref, torch = _oracle()
+    preds = PREDS[:, :, :1].reshape(-1, 1, H, W)
+    target = TARGET[:, :, :1].reshape(-1, 1, H, W)
+    ours = F.peak_signal_noise_ratio_with_blocked_effect(jnp.asarray(preds), jnp.asarray(target), data_range=1.0)
+    ref = tm_ref.functional.image.peak_signal_noise_ratio_with_blocked_effect(
+        torch.as_tensor(preds), torch.as_tensor(target), data_range=1.0
+    )
+    _assert_allclose(ours, ref.numpy(), atol=1e-4)
+
+
+def test_d_s_and_qnr_parity():
+    tm_ref, torch = _oracle()
+    preds = _RNG.random((2, 3, 32, 32)).astype(np.float32)
+    ms = _RNG.random((2, 3, 16, 16)).astype(np.float32)
+    pan = _RNG.random((2, 3, 32, 32)).astype(np.float32)
+    pan_lr = _RNG.random((2, 3, 16, 16)).astype(np.float32)
+    # pan_lr provided: no interpolation divergence in play
+    ours = F.spatial_distortion_index(jnp.asarray(preds), jnp.asarray(ms), jnp.asarray(pan), jnp.asarray(pan_lr))
+    ref = tm_ref.functional.image.spatial_distortion_index(
+        torch.as_tensor(preds), torch.as_tensor(ms), torch.as_tensor(pan), torch.as_tensor(pan_lr)
+    )
+    _assert_allclose(ours, ref.numpy(), atol=1e-4)
+    ours_q = F.quality_with_no_reference(jnp.asarray(preds), jnp.asarray(ms), jnp.asarray(pan), jnp.asarray(pan_lr))
+    ref_q = tm_ref.functional.image.quality_with_no_reference(
+        torch.as_tensor(preds), torch.as_tensor(ms), torch.as_tensor(pan), torch.as_tensor(pan_lr)
+    )
+    _assert_allclose(ours_q, ref_q.numpy(), atol=1e-4)
+
+
+def test_image_gradients_parity():
+    tm_ref, torch = _oracle()
+    dy, dx = F.image_gradients(jnp.asarray(PREDS[0]))
+    rdy, rdx = tm_ref.functional.image.image_gradients(torch.as_tensor(PREDS[0]))
+    _assert_allclose(dy, rdy.numpy(), atol=1e-6)
+    _assert_allclose(dx, rdx.numpy(), atol=1e-6)
+
+
+CLASS_CASES = [
+    ("PeakSignalNoiseRatio", dict(data_range=1.0), "two-input"),
+    ("StructuralSimilarityIndexMeasure", dict(data_range=1.0), "two-input"),
+    ("UniversalImageQualityIndex", dict(), "two-input"),
+    ("SpectralAngleMapper", dict(), "two-input"),
+    ("ErrorRelativeGlobalDimensionlessSynthesis", dict(), "two-input"),
+    ("RelativeAverageSpectralError", dict(), "two-input"),
+    ("RootMeanSquaredErrorUsingSlidingWindow", dict(), "two-input"),
+    ("SpatialCorrelationCoefficient", dict(), "two-input"),
+    ("SpectralDistortionIndex", dict(), "two-input"),
+    ("TotalVariation", dict(), "one-input"),
+    ("VisualInformationFidelity", dict(), "vif"),
+]
+
+
+@pytest.mark.parametrize("name,kwargs,mode", CLASS_CASES, ids=[c[0] for c in CLASS_CASES])
+def test_image_class_parity(name, kwargs, mode):
+    tm_ref, torch = _oracle()
+    ours = getattr(tm, name)(**kwargs)
+    ref = getattr(tm_ref.image, name)(**kwargs)
+    if mode == "vif":
+        preds = _RNG.random((NUM_BATCHES, 2, 2, 48, 48)).astype(np.float32)
+        target = (0.8 * preds).astype(np.float32)
+        for i in range(NUM_BATCHES):
+            ours.update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+            ref.update(torch.as_tensor(preds[i]), torch.as_tensor(target[i]))
+    else:
+        for i in range(NUM_BATCHES):
+            if mode == "one-input":
+                ours.update(jnp.asarray(PREDS[i]))
+                ref.update(torch.as_tensor(PREDS[i]))
+            else:
+                ours.update(jnp.asarray(PREDS[i]), jnp.asarray(TARGET[i]))
+                ref.update(torch.as_tensor(PREDS[i]), torch.as_tensor(TARGET[i]))
+    _assert_allclose(ours.compute(), ref.compute().numpy(), atol=1e-4, msg=name)
+
+
+def test_spatial_distortion_index_class_parity():
+    tm_ref, torch = _oracle()
+    ours = tm.SpatialDistortionIndex()
+    ref = tm_ref.image.SpatialDistortionIndex()
+    for _ in range(2):
+        preds = _RNG.random((2, 3, 32, 32)).astype(np.float32)
+        tgt = {
+            "ms": _RNG.random((2, 3, 16, 16)).astype(np.float32),
+            "pan": _RNG.random((2, 3, 32, 32)).astype(np.float32),
+            "pan_lr": _RNG.random((2, 3, 16, 16)).astype(np.float32),
+        }
+        ours.update(jnp.asarray(preds), {k: jnp.asarray(v) for k, v in tgt.items()})
+        ref.update(torch.as_tensor(preds), {k: torch.as_tensor(v) for k, v in tgt.items()})
+    _assert_allclose(ours.compute(), ref.compute().numpy(), atol=1e-4)
+
+
+def test_image_merge_matches_single():
+    single = tm.StructuralSimilarityIndexMeasure(data_range=1.0)
+    shards = [tm.StructuralSimilarityIndexMeasure(data_range=1.0) for _ in range(2)]
+    for i in range(2):
+        single.update(jnp.asarray(PREDS[i]), jnp.asarray(TARGET[i]))
+        shards[i].update(jnp.asarray(PREDS[i]), jnp.asarray(TARGET[i]))
+    shards[0].merge_state(shards[1])
+    _assert_allclose(shards[0].compute(), single.compute(), atol=1e-6)
+
+    single = tm.UniversalImageQualityIndex()
+    shards = [tm.UniversalImageQualityIndex() for _ in range(2)]
+    for i in range(2):
+        single.update(jnp.asarray(PREDS[i]), jnp.asarray(TARGET[i]))
+        shards[i].update(jnp.asarray(PREDS[i]), jnp.asarray(TARGET[i]))
+    shards[0].merge_state(shards[1])
+    _assert_allclose(shards[0].compute(), single.compute(), atol=1e-6)
+
+
+def test_image_validation_errors():
+    with pytest.raises(ValueError, match="Expected `preds` and `target` to have BxCxHxW"):
+        F.universal_image_quality_index(jnp.zeros((3, 3)), jnp.zeros((3, 3)))
+    with pytest.raises(ValueError, match="odd positive"):
+        F.structural_similarity_index_measure(jnp.zeros((1, 1, 8, 8)), jnp.zeros((1, 1, 8, 8)), kernel_size=4)
+    with pytest.raises(ValueError, match="channel dimension"):
+        F.spectral_angle_mapper(jnp.zeros((1, 1, 8, 8)), jnp.zeros((1, 1, 8, 8)))
+    with pytest.raises(RuntimeError, match="4D tensor"):
+        F.total_variation(jnp.zeros((8, 8)))
+    with pytest.raises(ValueError, match="grayscale"):
+        F.peak_signal_noise_ratio_with_blocked_effect(jnp.zeros((1, 3, 8, 8)), jnp.zeros((1, 3, 8, 8)), data_range=1.0)

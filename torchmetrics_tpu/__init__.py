@@ -9,7 +9,7 @@ Every domain package declares its public classes in its own ``__all__``; the fla
 namespace aggregates them (reference ``__init__.py`` re-exports ~100 names the same
 way, hand-listed)."""
 
-from torchmetrics_tpu import classification, clustering, detection, functional, nominal, parallel, regression, retrieval, segmentation, shape, utilities, wrappers
+from torchmetrics_tpu import classification, clustering, detection, functional, image, nominal, parallel, regression, retrieval, segmentation, shape, utilities, wrappers
 from torchmetrics_tpu.aggregation import (
     CatMetric,
     MaxMetric,
@@ -22,6 +22,7 @@ from torchmetrics_tpu.aggregation import (
 from torchmetrics_tpu.classification import *  # noqa: F401,F403
 from torchmetrics_tpu.clustering import *  # noqa: F401,F403
 from torchmetrics_tpu.detection import *  # noqa: F401,F403
+from torchmetrics_tpu.image import *  # noqa: F401,F403
 from torchmetrics_tpu.nominal import *  # noqa: F401,F403
 from torchmetrics_tpu.shape import *  # noqa: F401,F403
 from torchmetrics_tpu.collections import MetricCollection
@@ -66,6 +67,7 @@ __all__ = [
     "retrieval",
     "clustering",
     "detection",
+    "image",
     "nominal",
     "shape",
     "segmentation",
@@ -76,6 +78,7 @@ __all__ = [
     *retrieval.__all__,
     *clustering.__all__,
     *detection.__all__,
+    *image.__all__,
     *nominal.__all__,
     *shape.__all__,
     *segmentation.__all__,

@@ -57,8 +57,10 @@ def dunn_index(data, labels, p: float = 2) -> jnp.ndarray:
     r"""Dunn index: min inter-centroid distance over max intra-cluster radius."""
     data = np.asarray(data, np.float64)
     labels = np.asarray(labels)
+    _validate_intrinsic_cluster_data(data, labels)
     inverse, counts, centroids = _cluster_views(data, labels)
     num_labels = counts.size
+    _validate_intrinsic_labels_to_samples(num_labels, data.shape[0])
     # inter-cluster distances over all centroid pairs (upper triangle)
     iu = np.triu_indices(num_labels, k=1)
     inter = np.linalg.norm(centroids[iu[0]] - centroids[iu[1]], ord=p, axis=1)

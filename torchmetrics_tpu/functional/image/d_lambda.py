@@ -1,0 +1,70 @@
+"""Spectral Distortion Index / D_lambda (reference ``functional/image/d_lambda.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .uqi import universal_image_quality_index
+from .utils import reduce
+
+
+def _spectral_distortion_index_update(preds, target):
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if preds.dtype != target.dtype:
+        raise TypeError(
+            f"Expected `ms` and `fused` to have the same data type. Got ms: {preds.dtype} and fused: {target.dtype}."
+        )
+    if preds.ndim != 4:
+        raise ValueError(
+            f"Expected `preds` and `target` to have BxCxHxW shape. Got preds: {preds.shape} and target: {target.shape}."
+        )
+    if preds.shape[:2] != target.shape[:2]:
+        raise ValueError(
+            "Expected `preds` and `target` to have same batch and channel sizes."
+            f"Got preds: {preds.shape} and target: {target.shape}."
+        )
+    return preds, target
+
+
+def _pairwise_band_uqi(img: jnp.ndarray) -> jnp.ndarray:
+    """(C, C) matrix of mean cross-band UQI scores, computed for all upper-triangle
+    pairs in one batched call (the reference loops bands with repeated concat)."""
+    length = img.shape[1]
+    m = jnp.zeros((length, length))
+    batch = img.shape[0]
+    pairs = [(k, r) for k in range(length) for r in range(k + 1, length)]
+    if not pairs:
+        return m
+    stack1 = jnp.concatenate([img[:, k : k + 1] for k, _ in pairs], axis=0)
+    stack2 = jnp.concatenate([img[:, r : r + 1] for _, r in pairs], axis=0)
+    scores = universal_image_quality_index(stack1, stack2, reduction="none")
+    scores = scores.reshape(len(pairs), -1).mean(axis=1)  # per-pair mean over (B, 1, H', W')
+    rows = jnp.asarray([k for k, _ in pairs])
+    cols = jnp.asarray([r for _, r in pairs])
+    m = m.at[rows, cols].set(scores)
+    return m + m.T
+
+
+def _spectral_distortion_index_compute(
+    preds, target, p: int = 1, reduction: Optional[str] = "elementwise_mean"
+) -> jnp.ndarray:
+    length = preds.shape[1]
+    m1 = _pairwise_band_uqi(target)
+    m2 = _pairwise_band_uqi(preds)
+    diff = jnp.abs(m1 - m2) ** p
+    if length == 1:
+        output = diff ** (1.0 / p)
+    else:
+        output = (1.0 / (length * (length - 1)) * jnp.sum(diff)) ** (1.0 / p)
+    return reduce(output, reduction)
+
+
+def spectral_distortion_index(preds, target, p: int = 1, reduction: Optional[str] = "elementwise_mean") -> jnp.ndarray:
+    """D_lambda: difference of cross-band UQI structure between fused and reference."""
+    if not isinstance(p, int) or p <= 0:
+        raise ValueError(f"Expected `p` to be a positive integer. Got p: {p}.")
+    preds, target = _spectral_distortion_index_update(preds, target)
+    return _spectral_distortion_index_compute(preds, target, p, reduction)

@@ -1,0 +1,91 @@
+"""Spatial Distortion Index / D_s (reference ``functional/image/d_s.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .uqi import universal_image_quality_index
+from .utils import reduce, uniform_filter
+
+
+def _spatial_distortion_index_update(preds, ms, pan, pan_lr=None):
+    preds = jnp.asarray(preds)
+    ms = jnp.asarray(ms)
+    pan = jnp.asarray(pan)
+    pan_lr = jnp.asarray(pan_lr) if pan_lr is not None else None
+    if preds.ndim != 4:
+        raise ValueError(f"Expected `preds` to have BxCxHxW shape. Got preds: {preds.shape}.")
+    for name, other in (("ms", ms), ("pan", pan)) + ((("pan_lr", pan_lr),) if pan_lr is not None else ()):
+        if preds.dtype != other.dtype:
+            raise TypeError(
+                f"Expected `preds` and `{name}` to have the same data type."
+                f" Got preds: {preds.dtype} and {name}: {other.dtype}."
+            )
+        if other.ndim != 4:
+            raise ValueError(f"Expected `{name}` to have BxCxHxW shape. Got {name}: {other.shape}.")
+        if preds.shape[:2] != other.shape[:2]:
+            raise ValueError(
+                f"Expected `preds` and `{name}` to have the same batch and channel sizes."
+                f" Got preds: {preds.shape} and {name}: {other.shape}."
+            )
+    pan_h, pan_w = pan.shape[-2:]
+    ms_h, ms_w = ms.shape[-2:]
+    if preds.shape[-2:] != pan.shape[-2:]:
+        raise ValueError(
+            f"Expected `preds` and `pan` to have the same dimension. Got preds: {preds.shape} and pan: {pan.shape}."
+        )
+    if pan_h % ms_h != 0:
+        raise ValueError(
+            f"Expected height of `pan` to be multiple of height of `ms`. Got preds: {pan_h} and ms: {ms_h}."
+        )
+    if pan_w % ms_w != 0:
+        raise ValueError(f"Expected width of `pan` to be multiple of width of `ms`. Got preds: {pan_w} and ms: {ms_w}.")
+    if pan_lr is not None and pan_lr.shape[-2:] != (ms_h, ms_w):
+        raise ValueError(
+            f"Expected `ms` and `pan_lr` to have the same height and width."
+            f" Got ms: {ms.shape} and pan_lr: {pan_lr.shape}."
+        )
+    return preds, ms, pan, pan_lr
+
+
+def _spatial_distortion_index_compute(
+    preds, ms, pan, pan_lr=None, norm_order: int = 1, window_size: int = 7,
+    reduction: Optional[str] = "elementwise_mean",
+) -> jnp.ndarray:
+    length = preds.shape[1]
+    ms_h, ms_w = ms.shape[-2:]
+    if window_size >= ms_h or window_size >= ms_w:
+        raise ValueError(
+            f"Expected `window_size` to be smaller than dimension of `ms`. Got window_size: {window_size}."
+        )
+    if pan_lr is None:
+        pan_degraded = uniform_filter(pan, window_size=window_size)
+        pan_degraded = jax.image.resize(
+            pan_degraded, (*pan_degraded.shape[:2], ms_h, ms_w), method="bilinear"
+        )
+    else:
+        pan_degraded = pan_lr
+    m1 = jnp.stack([
+        universal_image_quality_index(ms[:, i : i + 1], pan_degraded[:, i : i + 1]) for i in range(length)
+    ])
+    m2 = jnp.stack([
+        universal_image_quality_index(preds[:, i : i + 1], pan[:, i : i + 1]) for i in range(length)
+    ])
+    diff = jnp.abs(m1 - m2) ** norm_order
+    return reduce(diff, reduction) ** (1 / norm_order)
+
+
+def spatial_distortion_index(
+    preds, ms, pan, pan_lr=None, norm_order: int = 1, window_size: int = 7,
+    reduction: Optional[str] = "elementwise_mean",
+) -> jnp.ndarray:
+    """D_s: spatial distortion of a pan-sharpened image vs its panchromatic source."""
+    if not isinstance(norm_order, int) or norm_order <= 0:
+        raise ValueError(f"Expected `norm_order` to be a positive integer. Got norm_order: {norm_order}.")
+    if not isinstance(window_size, int) or window_size <= 0:
+        raise ValueError(f"Expected `window_size` to be a positive integer. Got window_size: {window_size}.")
+    preds, ms, pan, pan_lr = _spatial_distortion_index_update(preds, ms, pan, pan_lr)
+    return _spatial_distortion_index_compute(preds, ms, pan, pan_lr, norm_order, window_size, reduction)

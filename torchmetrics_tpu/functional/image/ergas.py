@@ -1,0 +1,33 @@
+"""ERGAS (reference ``functional/image/ergas.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .utils import _check_image_pair, reduce
+
+
+def _ergas_update(preds, target):
+    return _check_image_pair(preds, target)
+
+
+def _ergas_compute(preds, target, ratio: float = 4, reduction: Optional[str] = "elementwise_mean"):
+    b, c, h, w = preds.shape
+    preds = preds.reshape(b, c, h * w)
+    target = target.reshape(b, c, h * w)
+    diff = preds - target
+    sum_squared_error = jnp.sum(diff * diff, axis=2)
+    rmse_per_band = jnp.sqrt(sum_squared_error / (h * w))
+    mean_target = jnp.mean(target, axis=2)
+    ergas_score = 100 / ratio * jnp.sqrt(jnp.sum((rmse_per_band / mean_target) ** 2, axis=1) / c)
+    return reduce(ergas_score, reduction)
+
+
+def error_relative_global_dimensionless_synthesis(
+    preds, target, ratio: float = 4, reduction: Optional[str] = "elementwise_mean"
+) -> jnp.ndarray:
+    """ERGAS: band-wise relative RMSE aggregated over channels."""
+    preds, target = _ergas_update(preds, target)
+    return _ergas_compute(preds, target, ratio, reduction)

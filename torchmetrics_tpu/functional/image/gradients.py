@@ -1,0 +1,21 @@
+"""Image gradients (reference ``functional/image/gradients.py``)."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+
+def image_gradients(img) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """1-step finite-difference (dy, dx), zero-padded at the far edge (TF semantics)."""
+    if not hasattr(img, "shape"):
+        raise TypeError(f"The `img` expects a value of <Tensor> type but got {type(img)}")
+    img = jnp.asarray(img)
+    if img.ndim != 4:
+        raise RuntimeError(f"The `img` expects a 4D tensor but got {img.ndim}D tensor")
+    dy = img[..., 1:, :] - img[..., :-1, :]
+    dx = img[..., :, 1:] - img[..., :, :-1]
+    dy = jnp.pad(dy, ((0, 0), (0, 0), (0, 1), (0, 0)))
+    dx = jnp.pad(dx, ((0, 0), (0, 0), (0, 0), (0, 1)))
+    return dy, dx

@@ -1,0 +1,67 @@
+"""Peak signal-to-noise ratio (reference ``functional/image/psnr.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import jax.numpy as jnp
+
+from ...utilities.prints import rank_zero_warn
+from .utils import reduce
+
+
+def _psnr_compute(
+    sum_squared_error: jnp.ndarray,
+    num_obs: jnp.ndarray,
+    data_range: jnp.ndarray,
+    base: float = 10.0,
+    reduction: Optional[str] = "elementwise_mean",
+) -> jnp.ndarray:
+    psnr_base_e = 2 * jnp.log(data_range) - jnp.log(sum_squared_error / num_obs)
+    psnr_vals = psnr_base_e * (10 / jnp.log(jnp.asarray(base)))
+    return reduce(psnr_vals, reduction)
+
+
+def _psnr_update(preds, target, dim: Optional[Union[int, Tuple[int, ...]]] = None):
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if not jnp.issubdtype(preds.dtype, jnp.floating):
+        preds = preds.astype(jnp.float32)
+    if not jnp.issubdtype(target.dtype, jnp.floating):
+        target = target.astype(jnp.float32)
+    if dim is None:
+        sum_squared_error = jnp.sum((preds - target) ** 2)
+        num_obs = jnp.asarray(target.size)
+        return sum_squared_error, num_obs
+    diff = preds - target
+    sum_squared_error = jnp.sum(diff * diff, axis=dim)
+    dim_list = [dim] if isinstance(dim, int) else list(dim)
+    if not dim_list:
+        num_obs = jnp.asarray(target.size)
+    else:
+        num_obs = jnp.asarray(int(jnp.prod(jnp.asarray([target.shape[d] for d in dim_list]))))
+        num_obs = jnp.broadcast_to(num_obs, sum_squared_error.shape)
+    return sum_squared_error, num_obs
+
+
+def peak_signal_noise_ratio(
+    preds,
+    target,
+    data_range: Union[float, Tuple[float, float]],
+    base: float = 10.0,
+    reduction: Optional[str] = "elementwise_mean",
+    dim: Optional[Union[int, Tuple[int, ...]]] = None,
+) -> jnp.ndarray:
+    """Compute PSNR; ``data_range`` as a tuple clamps inputs to that interval."""
+    if dim is None and reduction != "elementwise_mean":
+        rank_zero_warn(f"The `reduction={reduction}` will not have any effect when `dim` is None.")
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if isinstance(data_range, tuple):
+        preds = jnp.clip(preds, data_range[0], data_range[1])
+        target = jnp.clip(target, data_range[0], data_range[1])
+        data_range_val = jnp.asarray(data_range[1] - data_range[0], jnp.float32)
+    else:
+        data_range_val = jnp.asarray(float(data_range), jnp.float32)
+    sum_squared_error, num_obs = _psnr_update(preds, target, dim=dim)
+    return _psnr_compute(sum_squared_error, num_obs, data_range_val, base=base, reduction=reduction)

@@ -1,0 +1,43 @@
+"""Relative Average Spectral Error (reference ``functional/image/rase.py``)."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+from .rmse_sw import _rmse_sw_compute, _rmse_sw_update
+from .utils import uniform_filter
+
+
+def _rase_update(
+    preds, target, window_size: int, rmse_map: jnp.ndarray, target_sum: jnp.ndarray, total_images: jnp.ndarray
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    _, rmse_map, total_images = _rmse_sw_update(
+        preds, target, window_size, rmse_val_sum=None, rmse_map=rmse_map, total_images=total_images
+    )
+    target_sum = target_sum + jnp.sum(uniform_filter(target, window_size) / (window_size**2), axis=0)
+    return rmse_map, target_sum, total_images
+
+
+def _rase_compute(rmse_map: jnp.ndarray, target_sum: jnp.ndarray, total_images: jnp.ndarray, window_size: int):
+    _, rmse_map = _rmse_sw_compute(rmse_val_sum=None, rmse_map=rmse_map, total_images=total_images)
+    target_mean = target_sum / total_images
+    target_mean = target_mean.mean(0)  # mean over image channels
+    rase_map = 100 / target_mean * jnp.sqrt(jnp.mean(rmse_map**2, axis=0))
+    crop_slide = round(window_size / 2)
+    return jnp.mean(rase_map[crop_slide:-crop_slide, crop_slide:-crop_slide])
+
+
+def relative_average_spectral_error(preds, target, window_size: int = 8) -> jnp.ndarray:
+    """RASE: percentage RMSE relative to the local target mean."""
+    if not isinstance(window_size, int) or window_size < 1:
+        raise ValueError("Argument `window_size` is expected to be a positive integer.")
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    img_shape = target.shape[1:]
+    rmse_map = jnp.zeros(img_shape, target.dtype)
+    target_sum = jnp.zeros(img_shape, target.dtype)
+    total_images = jnp.asarray(0.0)
+    rmse_map, target_sum, total_images = _rase_update(preds, target, window_size, rmse_map, target_sum, total_images)
+    return _rase_compute(rmse_map, target_sum, total_images, window_size)

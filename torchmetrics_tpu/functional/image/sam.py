@@ -1,0 +1,33 @@
+"""Spectral Angle Mapper (reference ``functional/image/sam.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .utils import _check_image_pair, reduce
+
+
+def _sam_update(preds, target):
+    preds, target = _check_image_pair(preds, target)
+    if preds.shape[1] <= 1:
+        raise ValueError(
+            "Expected channel dimension of `preds` and `target` to be larger than 1."
+            f" Got preds: {preds.shape[1]} and target: {target.shape[1]}."
+        )
+    return preds, target
+
+
+def _sam_compute(preds, target, reduction: Optional[str] = "elementwise_mean"):
+    dot_product = (preds * target).sum(axis=1)
+    preds_norm = jnp.linalg.norm(preds, axis=1)
+    target_norm = jnp.linalg.norm(target, axis=1)
+    sam_score = jnp.arccos(jnp.clip(dot_product / (preds_norm * target_norm), -1, 1))
+    return reduce(sam_score, reduction)
+
+
+def spectral_angle_mapper(preds, target, reduction: Optional[str] = "elementwise_mean") -> jnp.ndarray:
+    """Per-pixel spectral angle between prediction and target spectra (radians)."""
+    preds, target = _sam_update(preds, target)
+    return _sam_compute(preds, target, reduction)

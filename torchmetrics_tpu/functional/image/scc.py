@@ -1,0 +1,115 @@
+"""Spatial Correlation Coefficient (reference ``functional/image/scc.py``)."""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple, Union
+
+import jax.numpy as jnp
+
+from .utils import conv2d, reduce
+
+
+def _scc_update(preds, target, hp_filter, window_size: int):
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if preds.dtype != target.dtype:
+        target = target.astype(preds.dtype)
+    if tuple(preds.shape) != tuple(target.shape):
+        raise RuntimeError(
+            f"Predictions and targets are expected to have the same shape, "
+            f"but got {tuple(preds.shape)} and {tuple(target.shape)}."
+        )
+    if preds.ndim not in (3, 4):
+        raise ValueError(
+            "Expected `preds` and `target` to have batch of colored images with BxCxHxW shape"
+            "  or batch of grayscale images of BxHxW shape."
+            f" Got preds: {preds.shape} and target: {target.shape}."
+        )
+    if preds.ndim == 3:
+        preds = preds[:, None]
+        target = target[:, None]
+    if not window_size > 0:
+        raise ValueError(f"Expected `window_size` to be a positive integer. Got {window_size}.")
+    if window_size > preds.shape[2] or window_size > preds.shape[3]:
+        raise ValueError(
+            f"Expected `window_size` to be less than or equal to the size of the image."
+            f" Got window_size: {window_size} and image size: {preds.shape[2]}x{preds.shape[3]}."
+        )
+    preds = preds.astype(jnp.float32)
+    target = target.astype(jnp.float32)
+    hp_filter = jnp.asarray(hp_filter, preds.dtype)[None, None, :]
+    return preds, target, hp_filter
+
+
+def _symmetric_reflect_pad_2d(img, pad: Union[int, Tuple[int, ...]]):
+    if isinstance(pad, int):
+        pad = (pad, pad, pad, pad)
+    if len(pad) != 4:
+        raise ValueError(f"Expected padding to have length 4, but got {len(pad)}")
+    return jnp.pad(img, ((0, 0), (0, 0), (pad[2], pad[3]), (pad[0], pad[1])), mode="symmetric")
+
+
+def _signal_convolve_2d(img, kernel):
+    """scipy.signal-style 2D convolution: symmetric pad + flipped kernel."""
+    left = math.floor((kernel.shape[3] - 1) / 2)
+    right = math.ceil((kernel.shape[3] - 1) / 2)
+    top = math.floor((kernel.shape[2] - 1) / 2)
+    bottom = math.ceil((kernel.shape[2] - 1) / 2)
+    padded = _symmetric_reflect_pad_2d(img, pad=(left, right, top, bottom))
+    kernel = kernel[:, :, ::-1, ::-1]
+    return conv2d(padded, kernel)
+
+
+def _hp_2d_laplacian(img, kernel):
+    return _signal_convolve_2d(img, kernel) * 2.0
+
+
+def _local_variance_covariance(preds, target, window):
+    left = math.ceil((window.shape[3] - 1) / 2)
+    right = math.floor((window.shape[3] - 1) / 2)
+    preds = jnp.pad(preds, ((0, 0), (0, 0), (left, right), (left, right)))
+    target = jnp.pad(target, ((0, 0), (0, 0), (left, right), (left, right)))
+    preds_mean = conv2d(preds, window)
+    target_mean = conv2d(target, window)
+    preds_var = conv2d(preds**2, window) - preds_mean**2
+    target_var = conv2d(target**2, window) - target_mean**2
+    target_preds_cov = conv2d(target * preds, window) - target_mean * preds_mean
+    return preds_var, target_var, target_preds_cov
+
+
+def _scc_per_channel_compute(preds, target, hp_filter, window_size: int):
+    dtype = preds.dtype
+    window = jnp.ones((1, 1, window_size, window_size), dtype) / (window_size**2)
+    preds_hp = _hp_2d_laplacian(preds, hp_filter)
+    target_hp = _hp_2d_laplacian(target, hp_filter)
+    preds_var, target_var, target_preds_cov = _local_variance_covariance(preds_hp, target_hp, window)
+    preds_var = jnp.clip(preds_var, 0)
+    target_var = jnp.clip(target_var, 0)
+    den = jnp.sqrt(target_var) * jnp.sqrt(preds_var)
+    return jnp.where(den == 0, 0.0, target_preds_cov / jnp.where(den == 0, 1.0, den))
+
+
+def spatial_correlation_coefficient(
+    preds,
+    target,
+    hp_filter: Optional[jnp.ndarray] = None,
+    window_size: int = 8,
+    reduction: Optional[str] = "mean",
+) -> jnp.ndarray:
+    """SCC: local correlation of high-pass-filtered images (sewar semantics)."""
+    if hp_filter is None:
+        hp_filter = jnp.asarray([[-1, -1, -1], [-1, 8, -1], [-1, -1, -1]])
+    if reduction is None:
+        reduction = "none"
+    if reduction not in ("mean", "none"):
+        raise ValueError(f"Expected reduction to be 'mean' or 'none', but got {reduction}")
+    preds, target, hp_filter = _scc_update(preds, target, hp_filter, window_size)
+    per_channel = [
+        _scc_per_channel_compute(preds[:, i : i + 1], target[:, i : i + 1], hp_filter, window_size)
+        for i in range(preds.shape[1])
+    ]
+    scc = jnp.concatenate(per_channel, axis=1)
+    if reduction == "none":
+        return scc.mean(axis=(1, 2, 3))
+    return scc.mean()

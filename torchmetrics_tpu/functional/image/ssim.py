@@ -1,0 +1,263 @@
+"""SSIM + Multi-Scale SSIM (reference ``functional/image/ssim.py``).
+
+One grouped convolution over the stacked ``(5*B, C, ...)`` moment batch computes all
+five local moments in a single XLA conv — same trick as the reference, but the
+gaussian window, padding, elementwise SSIM map, and the MS-SSIM scale pyramid all
+fuse into one jitted program (no per-scale Python dispatch cost at runtime beyond
+trace time).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+
+from .utils import (
+    _check_image_pair,
+    _gaussian_kernel_2d,
+    _gaussian_kernel_3d,
+    avg_pool2d,
+    avg_pool3d,
+    conv2d,
+    conv3d,
+    reduce,
+    reflect_pad_2d,
+    reflect_pad_3d,
+)
+
+
+def _ssim_check_inputs(preds, target):
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if preds.dtype != target.dtype:
+        target = target.astype(preds.dtype)
+    if tuple(preds.shape) != tuple(target.shape):
+        raise RuntimeError(
+            f"Predictions and targets are expected to have the same shape, "
+            f"but got {tuple(preds.shape)} and {tuple(target.shape)}."
+        )
+    if preds.ndim not in (4, 5):
+        raise ValueError(
+            "Expected `preds` and `target` to have BxCxHxW or BxCxDxHxW shape."
+            f" Got preds: {preds.shape} and target: {target.shape}."
+        )
+    return preds, target
+
+
+def _ssim_update(
+    preds,
+    target,
+    gaussian_kernel: bool = True,
+    sigma: Union[float, Sequence[float]] = 1.5,
+    kernel_size: Union[int, Sequence[int]] = 11,
+    data_range: Optional[Union[float, Tuple[float, float]]] = None,
+    k1: float = 0.01,
+    k2: float = 0.03,
+    return_full_image: bool = False,
+    return_contrast_sensitivity: bool = False,
+):
+    is_3d = preds.ndim == 5
+    if not isinstance(kernel_size, Sequence):
+        kernel_size = 3 * [kernel_size] if is_3d else 2 * [kernel_size]
+    if not isinstance(sigma, Sequence):
+        sigma = 3 * [sigma] if is_3d else 2 * [sigma]
+    if len(kernel_size) != preds.ndim - 2 or len(kernel_size) not in (2, 3):
+        raise ValueError(
+            f"`kernel_size` has dimension {len(kernel_size)}, but expected to be two less that target dimensionality,"
+            f" which is: {preds.ndim}"
+        )
+    if len(sigma) != preds.ndim - 2:
+        raise ValueError(
+            f"`sigma` has dimension {len(sigma)}, but expected to be two less that target dimensionality,"
+            f" which is: {preds.ndim}"
+        )
+    if return_full_image and return_contrast_sensitivity:
+        raise ValueError("Arguments `return_full_image` and `return_contrast_sensitivity` are mutually exclusive.")
+    if any(x % 2 == 0 or x <= 0 for x in kernel_size):
+        raise ValueError(f"Expected `kernel_size` to have odd positive number. Got {kernel_size}.")
+    if any(y <= 0 for y in sigma):
+        raise ValueError(f"Expected `sigma` to have positive number. Got {sigma}.")
+
+    if data_range is None:
+        data_range = jnp.maximum(preds.max() - preds.min(), target.max() - target.min())
+    elif isinstance(data_range, tuple):
+        preds = jnp.clip(preds, data_range[0], data_range[1])
+        target = jnp.clip(target, data_range[0], data_range[1])
+        data_range = data_range[1] - data_range[0]
+
+    c1 = (k1 * data_range) ** 2
+    c2 = (k2 * data_range) ** 2
+    channel = preds.shape[1]
+    dtype = preds.dtype
+    gauss_kernel_size = [int(3.5 * s + 0.5) * 2 + 1 for s in sigma]
+
+    if gaussian_kernel:
+        pad_h = (gauss_kernel_size[0] - 1) // 2
+        pad_w = (gauss_kernel_size[1] - 1) // 2
+    else:
+        pad_h = (kernel_size[0] - 1) // 2
+        pad_w = (kernel_size[1] - 1) // 2
+
+    if is_3d:
+        pad_d = (kernel_size[2] - 1) // 2
+        preds = reflect_pad_3d(preds, pad_d, pad_h, pad_w)
+        target = reflect_pad_3d(target, pad_d, pad_h, pad_w)
+        kernel = (
+            _gaussian_kernel_3d(channel, gauss_kernel_size, sigma, dtype)
+            if gaussian_kernel
+            else jnp.ones((channel, 1, *kernel_size), dtype) / jnp.prod(jnp.asarray(kernel_size, dtype))
+        )
+        conv = conv3d
+    else:
+        preds = reflect_pad_2d(preds, pad_h, pad_w)
+        target = reflect_pad_2d(target, pad_h, pad_w)
+        kernel = (
+            _gaussian_kernel_2d(channel, gauss_kernel_size, sigma, dtype)
+            if gaussian_kernel
+            else jnp.ones((channel, 1, *kernel_size), dtype) / jnp.prod(jnp.asarray(kernel_size, dtype))
+        )
+        conv = conv2d
+
+    batch = preds.shape[0]
+    input_list = jnp.concatenate([preds, target, preds * preds, target * target, preds * target])
+    outputs = conv(input_list, kernel.astype(dtype), groups=channel)
+    mu_pred, mu_target, pred_sq, target_sq, pred_target = (
+        outputs[i * batch : (i + 1) * batch] for i in range(5)
+    )
+
+    mu_pred_sq = mu_pred**2
+    mu_target_sq = mu_target**2
+    mu_pred_target = mu_pred * mu_target
+    sigma_pred_sq = jnp.clip(pred_sq - mu_pred_sq, 0.0)
+    sigma_target_sq = jnp.clip(target_sq - mu_target_sq, 0.0)
+    sigma_pred_target = pred_target - mu_pred_target
+
+    upper = 2 * sigma_pred_target.astype(dtype) + c2
+    lower = (sigma_pred_sq + sigma_target_sq).astype(dtype) + c2
+    ssim_full = ((2 * mu_pred_target + c1) * upper) / ((mu_pred_sq + mu_target_sq + c1) * lower)
+    sim = ssim_full.reshape(batch, -1).mean(-1)
+
+    if return_contrast_sensitivity:
+        contrast = upper / lower
+        # the contrast term is cropped back to the unpadded region (reference
+        # ssim.py:176-181); the padded border would bias the MS-SSIM pyramid
+        if is_3d:
+            contrast = contrast[..., pad_h:-pad_h, pad_w:-pad_w, pad_d:-pad_d]
+        else:
+            contrast = contrast[..., pad_h:-pad_h, pad_w:-pad_w]
+        return sim, contrast.reshape(batch, -1).mean(-1)
+    if return_full_image:
+        return sim, ssim_full
+    return sim
+
+
+def _ssim_compute(similarities, reduction: Optional[str] = "elementwise_mean"):
+    return reduce(similarities, reduction)
+
+
+def structural_similarity_index_measure(
+    preds,
+    target,
+    gaussian_kernel: bool = True,
+    sigma: Union[float, Sequence[float]] = 1.5,
+    kernel_size: Union[int, Sequence[int]] = 11,
+    reduction: Optional[str] = "elementwise_mean",
+    data_range: Optional[Union[float, Tuple[float, float]]] = None,
+    k1: float = 0.01,
+    k2: float = 0.03,
+    return_full_image: bool = False,
+    return_contrast_sensitivity: bool = False,
+):
+    """Compute SSIM over NCHW (or NCDHW) image batches."""
+    preds, target = _ssim_check_inputs(preds, target)
+    pack = _ssim_update(
+        preds, target, gaussian_kernel, sigma, kernel_size, data_range, k1, k2,
+        return_full_image, return_contrast_sensitivity,
+    )
+    if isinstance(pack, tuple):
+        similarity, image = pack
+        return _ssim_compute(similarity, reduction), image
+    return _ssim_compute(pack, reduction)
+
+
+def _multiscale_ssim_update(
+    preds,
+    target,
+    gaussian_kernel: bool = True,
+    sigma: Union[float, Sequence[float]] = 1.5,
+    kernel_size: Union[int, Sequence[int]] = 11,
+    data_range: Optional[Union[float, Tuple[float, float]]] = None,
+    k1: float = 0.01,
+    k2: float = 0.03,
+    betas: Tuple[float, ...] = (0.0448, 0.2856, 0.3001, 0.2363, 0.1333),
+    normalize: Optional[str] = None,
+):
+    is_3d = preds.ndim == 5
+    if not isinstance(kernel_size, Sequence):
+        kernel_size = 3 * [kernel_size] if is_3d else 2 * [kernel_size]
+    if preds.shape[-1] < 2 ** len(betas) or preds.shape[-2] < 2 ** len(betas):
+        raise ValueError(
+            f"For a given number of `betas` parameters {len(betas)}, the image height and width dimensions must be"
+            f" larger than or equal to {2 ** len(betas)}."
+        )
+    _betas_div = max(1, (len(betas) - 1)) ** 2
+    if preds.shape[-2] // _betas_div <= kernel_size[0] - 1:
+        raise ValueError(
+            f"For a given number of `betas` parameters {len(betas)} and kernel size {kernel_size[0]},"
+            f" the image height must be larger than {(kernel_size[0] - 1) * _betas_div}."
+        )
+    if preds.shape[-1] // _betas_div <= kernel_size[1] - 1:
+        raise ValueError(
+            f"For a given number of `betas` parameters {len(betas)} and kernel size {kernel_size[1]},"
+            f" the image width must be larger than {(kernel_size[1] - 1) * _betas_div}."
+        )
+
+    mcs_list = []
+    sim = None
+    for _ in range(len(betas)):
+        sim, contrast = _ssim_update(
+            preds, target, gaussian_kernel, sigma, kernel_size, data_range, k1, k2,
+            return_contrast_sensitivity=True,
+        )
+        if normalize == "relu":
+            sim = jnp.maximum(sim, 0.0)
+            contrast = jnp.maximum(contrast, 0.0)
+        mcs_list.append(contrast)
+        if len(kernel_size) == 2:
+            preds = avg_pool2d(preds)
+            target = avg_pool2d(target)
+        else:
+            preds = avg_pool3d(preds)
+            target = avg_pool3d(target)
+    mcs_list[-1] = sim
+    mcs_stack = jnp.stack(mcs_list)
+    if normalize == "simple":
+        mcs_stack = (mcs_stack + 1) / 2
+    betas_arr = jnp.asarray(betas).reshape(-1, 1)
+    return jnp.prod(mcs_stack**betas_arr, axis=0)
+
+
+def multiscale_structural_similarity_index_measure(
+    preds,
+    target,
+    gaussian_kernel: bool = True,
+    sigma: Union[float, Sequence[float]] = 1.5,
+    kernel_size: Union[int, Sequence[int]] = 11,
+    reduction: Optional[str] = "elementwise_mean",
+    data_range: Optional[Union[float, Tuple[float, float]]] = None,
+    k1: float = 0.01,
+    k2: float = 0.03,
+    betas: Tuple[float, ...] = (0.0448, 0.2856, 0.3001, 0.2363, 0.1333),
+    normalize: Optional[str] = "relu",
+):
+    """Compute Multi-Scale SSIM (Wang et al. scale pyramid with contrast terms)."""
+    if not isinstance(betas, tuple) or not all(isinstance(beta, float) for beta in betas):
+        raise ValueError("Argument `betas` is expected to be of a tuple of floats")
+    if normalize and normalize not in ("relu", "simple"):
+        raise ValueError("Argument `normalize` to be expected either `None`, `relu` or `simple`")
+    preds, target = _ssim_check_inputs(preds, target)
+    mcs = _multiscale_ssim_update(
+        preds, target, gaussian_kernel, sigma, kernel_size, data_range, k1, k2, betas, normalize
+    )
+    return reduce(mcs, reduction)

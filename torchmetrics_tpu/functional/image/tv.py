@@ -1,0 +1,34 @@
+"""Total variation (reference ``functional/image/tv.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+
+def _total_variation_update(img) -> Tuple[jnp.ndarray, int]:
+    img = jnp.asarray(img)
+    if img.ndim != 4:
+        raise RuntimeError(f"Expected input `img` to be an 4D tensor, but got {img.shape}")
+    diff1 = img[..., 1:, :] - img[..., :-1, :]
+    diff2 = img[..., :, 1:] - img[..., :, :-1]
+    res1 = jnp.abs(diff1).sum(axis=(1, 2, 3))
+    res2 = jnp.abs(diff2).sum(axis=(1, 2, 3))
+    return res1 + res2, img.shape[0]
+
+
+def _total_variation_compute(score, num_elements, reduction: Optional[str]):
+    if reduction == "mean":
+        return score.sum() / num_elements
+    if reduction == "sum":
+        return score.sum()
+    if reduction is None or reduction == "none":
+        return score
+    raise ValueError("Expected argument `reduction` to either be 'sum', 'mean', 'none' or None")
+
+
+def total_variation(img, reduction: Optional[str] = "sum") -> jnp.ndarray:
+    """Anisotropic total variation of an NCHW image batch."""
+    score, num_elements = _total_variation_update(img)
+    return _total_variation_compute(score, num_elements, reduction)

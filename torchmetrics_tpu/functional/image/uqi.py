@@ -1,0 +1,71 @@
+"""Universal Image Quality Index (reference ``functional/image/uqi.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax.numpy as jnp
+
+from .utils import _check_image_pair, _gaussian_kernel_2d, conv2d, reduce, reflect_pad_2d
+
+
+def _uqi_update(preds, target):
+    return _check_image_pair(preds, target)
+
+
+def _uqi_compute(
+    preds,
+    target,
+    kernel_size: Sequence[int] = (11, 11),
+    sigma: Sequence[float] = (1.5, 1.5),
+    reduction: Optional[str] = "elementwise_mean",
+):
+    if len(kernel_size) != 2 or len(sigma) != 2:
+        raise ValueError(
+            "Expected `kernel_size` and `sigma` to have the length of two."
+            f" Got kernel_size: {len(kernel_size)} and sigma: {len(sigma)}."
+        )
+    if any(x % 2 == 0 or x <= 0 for x in kernel_size):
+        raise ValueError(f"Expected `kernel_size` to have odd positive number. Got {kernel_size}.")
+    if any(y <= 0 for y in sigma):
+        raise ValueError(f"Expected `sigma` to have positive number. Got {sigma}.")
+
+    channel = preds.shape[1]
+    dtype = preds.dtype
+    kernel = _gaussian_kernel_2d(channel, kernel_size, sigma, dtype)
+    pad_h = (kernel_size[0] - 1) // 2
+    pad_w = (kernel_size[1] - 1) // 2
+    preds = reflect_pad_2d(preds, pad_w, pad_h)
+    target = reflect_pad_2d(target, pad_w, pad_h)
+
+    batch = preds.shape[0]
+    input_list = jnp.concatenate([preds, target, preds * preds, target * target, preds * target])
+    outputs = conv2d(input_list, kernel, groups=channel)
+    mu_pred, mu_target, pred_sq, target_sq, pred_target = (
+        outputs[i * batch : (i + 1) * batch] for i in range(5)
+    )
+    mu_pred_sq = mu_pred**2
+    mu_target_sq = mu_target**2
+    mu_pred_target = mu_pred * mu_target
+    sigma_pred_sq = jnp.clip(pred_sq - mu_pred_sq, 0.0)
+    sigma_target_sq = jnp.clip(target_sq - mu_target_sq, 0.0)
+    sigma_pred_target = pred_target - mu_pred_target
+
+    upper = 2 * sigma_pred_target
+    lower = sigma_pred_sq + sigma_target_sq
+    eps = jnp.finfo(sigma_pred_sq.dtype).eps
+    uqi_idx = ((2 * mu_pred_target) * upper) / ((mu_pred_sq + mu_target_sq) * lower + eps)
+    uqi_idx = uqi_idx[..., pad_h:-pad_h, pad_w:-pad_w]
+    return reduce(uqi_idx, reduction)
+
+
+def universal_image_quality_index(
+    preds,
+    target,
+    kernel_size: Sequence[int] = (11, 11),
+    sigma: Sequence[float] = (1.5, 1.5),
+    reduction: Optional[str] = "elementwise_mean",
+) -> jnp.ndarray:
+    """Universal Image Quality Index — SSIM without the stability constants."""
+    preds, target = _uqi_update(preds, target)
+    return _uqi_compute(preds, target, kernel_size, sigma, reduction)

@@ -1,0 +1,134 @@
+"""Image kernel utilities (reference ``functional/image/utils.py``).
+
+Depthwise separable gaussian/uniform filtering expressed as
+``lax.conv_general_dilated`` with ``feature_group_count`` — XLA lowers these onto the
+TPU convolution units; the three padding flavors used by the reference (torch
+reflect = jnp 'reflect', scipy-style symmetric = jnp 'symmetric', asymmetric
+symmetric) map onto ``jnp.pad`` modes.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Array = jax.Array
+
+
+def _gaussian(kernel_size: int, sigma: float, dtype=jnp.float32) -> Array:
+    """1D gaussian kernel ``(1, kernel_size)``."""
+    dist = jnp.arange((1 - kernel_size) / 2, (1 + kernel_size) / 2, 1.0, dtype=dtype)
+    gauss = jnp.exp(-((dist / sigma) ** 2) / 2)
+    return (gauss / gauss.sum())[None, :]
+
+
+def _gaussian_kernel_2d(channel: int, kernel_size: Sequence[int], sigma: Sequence[float], dtype=jnp.float32) -> Array:
+    """Separable 2D gaussian kernel ``(channel, 1, h, w)`` (grouped-conv layout)."""
+    kernel_x = _gaussian(kernel_size[0], sigma[0], dtype)
+    kernel_y = _gaussian(kernel_size[1], sigma[1], dtype)
+    kernel = jnp.matmul(kernel_x.T, kernel_y)  # (h, w)
+    return jnp.broadcast_to(kernel, (channel, 1, kernel_size[0], kernel_size[1]))
+
+
+def _gaussian_kernel_3d(channel: int, kernel_size: Sequence[int], sigma: Sequence[float], dtype=jnp.float32) -> Array:
+    """Separable 3D gaussian kernel ``(channel, 1, d, h, w)``."""
+    kernel_xy = _gaussian_kernel_2d(1, kernel_size[:2], sigma[:2], dtype)[0, 0]
+    kernel_z = _gaussian(kernel_size[2], sigma[2], dtype).reshape(-1)
+    kernel = kernel_xy[None, :, :] * kernel_z[:, None, None]
+    return jnp.broadcast_to(kernel, (channel, 1, *kernel.shape))
+
+
+def conv2d(inputs: Array, kernel: Array, groups: int = 1) -> Array:
+    """NCHW valid conv with OIHW kernel (grouped when groups == channels)."""
+    return lax.conv_general_dilated(
+        inputs,
+        kernel,
+        window_strides=(1, 1),
+        padding="VALID",
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=groups,
+    )
+
+
+def conv3d(inputs: Array, kernel: Array, groups: int = 1) -> Array:
+    """NCDHW valid conv with OIDHW kernel."""
+    return lax.conv_general_dilated(
+        inputs,
+        kernel,
+        window_strides=(1, 1, 1),
+        padding="VALID",
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        feature_group_count=groups,
+    )
+
+
+def reflect_pad_2d(inputs: Array, pad_h: int, pad_w: int) -> Array:
+    """torch ``F.pad(mode='reflect')`` equivalent (no edge duplication)."""
+    return jnp.pad(inputs, ((0, 0), (0, 0), (pad_h, pad_h), (pad_w, pad_w)), mode="reflect")
+
+
+def reflect_pad_3d(inputs: Array, pad_d: int, pad_h: int, pad_w: int) -> Array:
+    return jnp.pad(inputs, ((0, 0), (0, 0), (pad_d, pad_d), (pad_h, pad_h), (pad_w, pad_w)), mode="reflect")
+
+
+def _symmetric_pad_2d(inputs: Array, pad: int, outer_pad: int = 0) -> Array:
+    """scipy-style symmetric padding with asymmetric tail (reference
+    ``_reflection_pad_2d``: left ``pad``, right ``pad + outer_pad - 1``)."""
+    right = pad + outer_pad - 1
+    return jnp.pad(inputs, ((0, 0), (0, 0), (pad, right), (pad, right)), mode="symmetric")
+
+
+def uniform_filter(inputs: Array, window_size: int) -> Array:
+    """Uniform (box) filter with scipy-style symmetric padding — output matches the
+    input's spatial shape (reference ``_uniform_filter``)."""
+    padded = _symmetric_pad_2d(inputs, window_size // 2, window_size % 2)
+    channel = inputs.shape[1]
+    kernel = jnp.ones((channel, 1, window_size, window_size), inputs.dtype) / (window_size**2)
+    return conv2d(padded, kernel, groups=channel)
+
+
+def avg_pool2d(inputs: Array) -> Array:
+    """2x2 stride-2 average pool (NCHW), floor mode like torch's default."""
+    out = lax.reduce_window(inputs, 0.0, lax.add, (1, 1, 2, 2), (1, 1, 2, 2), "VALID")
+    return out / 4.0
+
+
+def avg_pool3d(inputs: Array) -> Array:
+    out = lax.reduce_window(inputs, 0.0, lax.add, (1, 1, 2, 2, 2), (1, 1, 2, 2, 2), "VALID")
+    return out / 8.0
+
+
+def reduce(x: Array, reduction) -> Array:
+    """Reference ``utilities/distributed.py:22`` reduction semantics."""
+    if reduction in ("elementwise_mean", "mean"):
+        return jnp.mean(x)
+    if reduction == "sum":
+        return jnp.sum(x)
+    if reduction in (None, "none"):
+        return x
+    raise ValueError("Expected reduction to be one of ['elementwise_mean', 'sum', 'none', None]")
+
+
+def _check_image_pair(preds, target, require_dtype_match: bool = True, ndim: Tuple[int, ...] = (4,)):
+    import jax.numpy as _jnp
+
+    preds = _jnp.asarray(preds)
+    target = _jnp.asarray(target)
+    if require_dtype_match and preds.dtype != target.dtype:
+        raise TypeError(
+            "Expected `preds` and `target` to have the same data type."
+            f" Got preds: {preds.dtype} and target: {target.dtype}."
+        )
+    if tuple(preds.shape) != tuple(target.shape):
+        raise RuntimeError(
+            f"Predictions and targets are expected to have the same shape, "
+            f"but got {tuple(preds.shape)} and {tuple(target.shape)}."
+        )
+    if preds.ndim not in ndim:
+        raise ValueError(
+            f"Expected `preds` and `target` to have BxCxHxW shape. Got preds: {preds.shape} and target: {target.shape}."
+        )
+    return preds, target

@@ -58,9 +58,11 @@ def _compute_chi_squared(confmat: np.ndarray, bias_correction: bool) -> float:
     if df == 0:
         return 0.0
     if df == 1 and bias_correction:
+        # Yates: move observed toward expected by min(0.5, |diff|). The reference
+        # clamps by |sign(diff)| (always 0.5 — nominal/utils.py:53-56), over-correcting
+        # when |observed-expected| < 0.5; scipy's form is used here instead.
         diff = expected - confmat
-        direction = np.sign(diff)
-        confmat = confmat + direction * np.minimum(0.5, np.abs(direction))
+        confmat = confmat + np.sign(diff) * np.minimum(0.5, np.abs(diff))
     return float(((confmat - expected) ** 2 / expected).sum())
 
 

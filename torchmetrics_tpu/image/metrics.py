@@ -1,0 +1,423 @@
+"""Remaining tensor-math image metric classes (reference ``src/torchmetrics/image/``):
+UQI, VIF, TotalVariation, SAM, SCC, ERGAS, RASE, RMSE-SW, D_lambda, D_s, QNR.
+
+State designs follow the reference: cheap metrics keep scalar sum states; metrics whose
+statistic is not batch-decomposable (UQI/SAM with ``reduction='none'``, ERGAS/RASE,
+the pan-sharpening indices) keep cat states of raw images.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence
+
+import jax.numpy as jnp
+
+from ..functional.image.d_lambda import _spectral_distortion_index_compute, _spectral_distortion_index_update
+from ..functional.image.d_s import _spatial_distortion_index_compute, _spatial_distortion_index_update
+from ..functional.image.ergas import _ergas_compute, _ergas_update
+from ..functional.image.rase import _rase_compute
+from ..functional.image.rmse_sw import _rmse_sw_compute, _rmse_sw_update
+from ..functional.image.sam import _sam_compute, _sam_update
+from ..functional.image.scc import spatial_correlation_coefficient
+from ..functional.image.tv import _total_variation_compute, _total_variation_update
+from ..functional.image.uqi import _uqi_compute, _uqi_update
+from ..functional.image.utils import uniform_filter
+from ..functional.image.vif import _vif_per_channel
+from ..metric import Metric
+
+
+class UniversalImageQualityIndex(Metric):
+    """UQI (reference ``image/uqi.py:31``). Mean/sum reductions fold into two scalar
+    states; ``reduction='none'`` stores raw images (per-pixel map output)."""
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self,
+        kernel_size: Sequence[int] = (11, 11),
+        sigma: Sequence[float] = (1.5, 1.5),
+        reduction: Optional[str] = "elementwise_mean",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if reduction not in ("elementwise_mean", "sum", "none", None):
+            raise ValueError(
+                f"Argument `reduction` must be one of ('elementwise_mean', 'sum', 'none', None), got {reduction}"
+            )
+        self.kernel_size = kernel_size
+        self.sigma = sigma
+        self.reduction = reduction
+        if reduction in ("none", None):
+            self.add_state("preds", default=[], dist_reduce_fx="cat")
+            self.add_state("target", default=[], dist_reduce_fx="cat")
+        else:
+            self.add_state("sum_uqi", jnp.zeros(()), dist_reduce_fx="sum")
+            self.add_state("numel", jnp.zeros((), jnp.int32), dist_reduce_fx="sum")
+
+    def _batch_state(self, preds, target):
+        preds, target = _uqi_update(preds, target)
+        if self.reduction in ("none", None):
+            return {"preds": preds, "target": target}
+        uqi_map = _uqi_compute(preds, target, self.kernel_size, self.sigma, reduction="none")
+        return {"sum_uqi": uqi_map.sum(), "numel": jnp.asarray(uqi_map.size, jnp.int32)}
+
+    def _compute(self, state):
+        if self.reduction in ("none", None):
+            return _uqi_compute(state["preds"], state["target"], self.kernel_size, self.sigma, self.reduction)
+        value = state["sum_uqi"] / state["numel"]
+        return value if self.reduction == "elementwise_mean" else state["sum_uqi"]
+
+
+class VisualInformationFidelity(Metric):
+    """VIF (reference ``image/vif.py:25``) — per-batch scores concatenate."""
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, sigma_n_sq: float = 2.0, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(sigma_n_sq, (float, int)) or sigma_n_sq < 0:
+            raise ValueError(f"Argument `sigma_n_sq` is expected to be a positive float or int, but got {sigma_n_sq}")
+        self.sigma_n_sq = sigma_n_sq
+        self.add_state("vif_score", default=[], dist_reduce_fx="cat")
+
+    def _batch_state(self, preds, target):
+        preds = jnp.asarray(preds, jnp.float32)
+        target = jnp.asarray(target, jnp.float32)
+        channels = preds.shape[1]
+        vif_per_channel = [
+            _vif_per_channel(preds[:, i], target[:, i], self.sigma_n_sq) for i in range(channels)
+        ]
+        score = jnp.mean(jnp.stack(vif_per_channel), axis=0) if channels > 1 else vif_per_channel[0]
+        return {"vif_score": score}
+
+    def _compute(self, state):
+        return jnp.mean(state["vif_score"])
+
+
+class TotalVariation(Metric):
+    """Total variation (reference ``image/tv.py:31``)."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, reduction: Optional[str] = "sum", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if reduction is not None and reduction not in ("sum", "mean", "none"):
+            raise ValueError("Expected argument `reduction` to either be 'sum', 'mean', 'none' or None")
+        self.reduction = reduction
+        if reduction in (None, "none"):
+            self.add_state("score_list", default=[], dist_reduce_fx="cat")
+        else:
+            self.add_state("score", default=jnp.zeros(()), dist_reduce_fx="sum")
+            self.add_state("num_elements", default=jnp.zeros((), jnp.int32), dist_reduce_fx="sum")
+
+    def _batch_state(self, img):
+        score, num_elements = _total_variation_update(img)
+        if self.reduction in (None, "none"):
+            return {"score_list": score}
+        return {"score": score.sum(), "num_elements": jnp.asarray(num_elements, jnp.int32)}
+
+    def _compute(self, state):
+        if self.reduction in (None, "none"):
+            return state["score_list"]
+        return _total_variation_compute(state["score"], state["num_elements"], self.reduction)
+
+
+class SpectralAngleMapper(Metric):
+    """SAM (reference ``image/sam.py:31``)."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, reduction: Optional[str] = "elementwise_mean", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if reduction not in ("elementwise_mean", "sum", "none", None):
+            raise ValueError(
+                f"Argument `reduction` must be one of ('elementwise_mean', 'sum', 'none', None), got {reduction}"
+            )
+        self.reduction = reduction
+        if reduction in ("none", None):
+            self.add_state("preds", default=[], dist_reduce_fx="cat")
+            self.add_state("target", default=[], dist_reduce_fx="cat")
+        else:
+            self.add_state("sum_sam", jnp.zeros(()), dist_reduce_fx="sum")
+            self.add_state("numel", jnp.zeros((), jnp.int32), dist_reduce_fx="sum")
+
+    def _batch_state(self, preds, target):
+        preds, target = _sam_update(preds, target)
+        if self.reduction in ("none", None):
+            return {"preds": preds, "target": target}
+        sam_map = _sam_compute(preds, target, reduction="none")
+        return {"sum_sam": sam_map.sum(), "numel": jnp.asarray(sam_map.size, jnp.int32)}
+
+    def _compute(self, state):
+        if self.reduction in ("none", None):
+            return _sam_compute(state["preds"], state["target"], self.reduction)
+        value = state["sum_sam"] / state["numel"]
+        return value if self.reduction == "elementwise_mean" else state["sum_sam"]
+
+
+class SpatialCorrelationCoefficient(Metric):
+    """SCC (reference ``image/scc.py:24``) — two scalar sum states."""
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self, high_pass_filter: Optional[jnp.ndarray] = None, window_size: int = 8, **kwargs: Any
+    ) -> None:
+        super().__init__(**kwargs)
+        if high_pass_filter is None:
+            high_pass_filter = jnp.asarray([[-1, -1, -1], [-1, 8, -1], [-1, -1, -1]])
+        if not isinstance(window_size, int) or window_size < 1:
+            raise ValueError(f"Expected `window_size` to be a positive integer. Got {window_size}.")
+        self.hp_filter = high_pass_filter
+        self.ws = window_size
+        self.add_state("scc_score", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+    def _batch_state(self, preds, target):
+        scores = spatial_correlation_coefficient(preds, target, self.hp_filter, self.ws, reduction="none")
+        return {"scc_score": scores.sum(), "total": jnp.asarray(float(scores.shape[0]))}
+
+    def _compute(self, state):
+        return state["scc_score"] / state["total"]
+
+
+class ErrorRelativeGlobalDimensionlessSynthesis(Metric):
+    """ERGAS (reference ``image/ergas.py:32``) — cat states of raw images."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, ratio: float = 4, reduction: Optional[str] = "elementwise_mean", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if reduction not in ("elementwise_mean", "sum", "none", None):
+            raise ValueError(
+                f"Argument `reduction` must be one of ('elementwise_mean', 'sum', 'none', None), got {reduction}"
+            )
+        self.ratio = ratio
+        self.reduction = reduction
+        self.add_state("preds", default=[], dist_reduce_fx="cat")
+        self.add_state("target", default=[], dist_reduce_fx="cat")
+
+    def _batch_state(self, preds, target):
+        preds, target = _ergas_update(preds, target)
+        return {"preds": preds, "target": target}
+
+    def _compute(self, state):
+        return _ergas_compute(state["preds"], state["target"], self.ratio, self.reduction)
+
+
+class RelativeAverageSpectralError(Metric):
+    """RASE (reference ``image/rase.py:30``) — cat states (the per-window statistic
+    depends on the global target mean)."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, window_size: int = 8, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(window_size, int) or window_size < 1:
+            raise ValueError(f"Argument `window_size` is expected to be a positive integer, but got {window_size}")
+        self.window_size = window_size
+        self.add_state("preds", default=[], dist_reduce_fx="cat")
+        self.add_state("target", default=[], dist_reduce_fx="cat")
+
+    def _batch_state(self, preds, target):
+        return {"preds": jnp.asarray(preds), "target": jnp.asarray(target)}
+
+    def _compute(self, state):
+        preds = state["preds"]
+        target = state["target"]
+        img_shape = target.shape[1:]
+        rmse_map = jnp.zeros(img_shape, target.dtype)
+        target_sum = jnp.zeros(img_shape, target.dtype)
+        _, rmse_map, total_images = _rmse_sw_update(
+            preds, target, self.window_size, rmse_val_sum=None, rmse_map=rmse_map, total_images=jnp.asarray(0.0)
+        )
+        target_sum = target_sum + jnp.sum(uniform_filter(target, self.window_size) / (self.window_size**2), axis=0)
+        return _rase_compute(rmse_map, target_sum, total_images, self.window_size)
+
+
+class RootMeanSquaredErrorUsingSlidingWindow(Metric):
+    """RMSE-SW (reference ``image/rmse_sw.py:30``) — two scalar sum states."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, window_size: int = 8, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(window_size, int) or window_size < 1:
+            raise ValueError("Argument `window_size` is expected to be a positive integer.")
+        self.window_size = window_size
+        self.add_state("rmse_val_sum", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total_images", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+    def _batch_state(self, preds, target):
+        rmse_val_sum, _, total_images = _rmse_sw_update(
+            preds, target, self.window_size, rmse_val_sum=None, rmse_map=None, total_images=None
+        )
+        return {"rmse_val_sum": rmse_val_sum, "total_images": total_images}
+
+    def _compute(self, state):
+        rmse, _ = _rmse_sw_compute(state["rmse_val_sum"], jnp.zeros(()), state["total_images"])
+        return rmse
+
+
+class SpectralDistortionIndex(Metric):
+    """D_lambda (reference ``image/d_lambda.py:31``) — cat states of raw images."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, p: int = 1, reduction: Optional[str] = "elementwise_mean", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(p, int) or p <= 0:
+            raise ValueError(f"Expected `p` to be a positive integer. Got p: {p}.")
+        self.p = p
+        if reduction not in ("elementwise_mean", "sum", "none"):
+            raise ValueError(
+                f"Expected argument `reduction` be one of ('elementwise_mean', 'sum', 'none') but got {reduction}"
+            )
+        self.reduction = reduction
+        self.add_state("preds", default=[], dist_reduce_fx="cat")
+        self.add_state("target", default=[], dist_reduce_fx="cat")
+
+    def _batch_state(self, preds, target):
+        preds, target = _spectral_distortion_index_update(preds, target)
+        return {"preds": preds, "target": target}
+
+    def _compute(self, state):
+        return _spectral_distortion_index_compute(state["preds"], state["target"], self.p, self.reduction)
+
+
+class SpatialDistortionIndex(Metric):
+    """D_s (reference ``image/d_s.py:35``) — ``target`` is a dict with ms/pan[/pan_lr]."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self, norm_order: int = 1, window_size: int = 7, reduction: Optional[str] = "elementwise_mean", **kwargs: Any
+    ) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(norm_order, int) or norm_order <= 0:
+            raise ValueError(f"Expected `norm_order` to be a positive integer. Got norm_order: {norm_order}.")
+        self.norm_order = norm_order
+        if not isinstance(window_size, int) or window_size <= 0:
+            raise ValueError(f"Expected `window_size` to be a positive integer. Got window_size: {window_size}.")
+        self.window_size = window_size
+        if reduction not in ("elementwise_mean", "sum", "none"):
+            raise ValueError(
+                f"Expected argument `reduction` be one of ('elementwise_mean', 'sum', 'none') but got {reduction}"
+            )
+        self.reduction = reduction
+        self.add_state("preds", default=[], dist_reduce_fx="cat")
+        self.add_state("ms", default=[], dist_reduce_fx="cat")
+        self.add_state("pan", default=[], dist_reduce_fx="cat")
+        self.add_state("pan_lr", default=[], dist_reduce_fx="cat")
+
+    def _batch_state(self, preds, target: Dict[str, Any]):
+        if "ms" not in target or "pan" not in target:
+            raise ValueError(f"Expected `target` to contain keys ms and pan. Got target: {list(target.keys())}")
+        preds, ms, pan, pan_lr = _spatial_distortion_index_update(
+            preds, target["ms"], target["pan"], target.get("pan_lr")
+        )
+        out = {"preds": preds, "ms": ms, "pan": pan}
+        if pan_lr is not None:
+            out["pan_lr"] = pan_lr
+        return out
+
+    def _compute(self, state):
+        pan_lr = state["pan_lr"] if hasattr(state["pan_lr"], "shape") and state["pan_lr"].size else None
+        return _spatial_distortion_index_compute(
+            state["preds"], state["ms"], state["pan"], pan_lr, self.norm_order, self.window_size, self.reduction
+        )
+
+
+class QualityWithNoReference(Metric):
+    """QNR (reference ``image/qnr.py:38``) — composition of D_lambda and D_s."""
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self,
+        alpha: float = 1,
+        beta: float = 1,
+        norm_order: int = 1,
+        window_size: int = 7,
+        reduction: Optional[str] = "elementwise_mean",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(alpha, (int, float)) or alpha < 0:
+            raise ValueError(f"Expected `alpha` to be a non-negative real number. Got alpha: {alpha}.")
+        self.alpha = alpha
+        if not isinstance(beta, (int, float)) or beta < 0:
+            raise ValueError(f"Expected `beta` to be a non-negative real number. Got beta: {beta}.")
+        self.beta = beta
+        if not isinstance(norm_order, int) or norm_order <= 0:
+            raise ValueError(f"Expected `norm_order` to be a positive integer. Got norm_order: {norm_order}.")
+        self.norm_order = norm_order
+        if not isinstance(window_size, int) or window_size <= 0:
+            raise ValueError(f"Expected `window_size` to be a positive integer. Got window_size: {window_size}.")
+        self.window_size = window_size
+        if reduction not in ("elementwise_mean", "sum", "none"):
+            raise ValueError(
+                f"Expected argument `reduction` be one of ('elementwise_mean', 'sum', 'none') but got {reduction}"
+            )
+        self.reduction = reduction
+        self.add_state("preds", default=[], dist_reduce_fx="cat")
+        self.add_state("ms", default=[], dist_reduce_fx="cat")
+        self.add_state("pan", default=[], dist_reduce_fx="cat")
+        self.add_state("pan_lr", default=[], dist_reduce_fx="cat")
+
+    def _batch_state(self, preds, target: Dict[str, Any]):
+        if "ms" not in target or "pan" not in target:
+            raise ValueError(f"Expected `target` to contain keys ms and pan. Got target: {list(target.keys())}")
+        preds, ms, pan, pan_lr = _spatial_distortion_index_update(
+            preds, target["ms"], target["pan"], target.get("pan_lr")
+        )
+        out = {"preds": preds, "ms": ms, "pan": pan}
+        if pan_lr is not None:
+            out["pan_lr"] = pan_lr
+        return out
+
+    def _compute(self, state):
+        pan_lr = state["pan_lr"] if hasattr(state["pan_lr"], "shape") and state["pan_lr"].size else None
+        d_lambda = _spectral_distortion_index_compute(state["preds"], state["ms"], self.norm_order, self.reduction)
+        d_s = _spatial_distortion_index_compute(
+            state["preds"], state["ms"], state["pan"], pan_lr, self.norm_order, self.window_size, self.reduction
+        )
+        return (1 - d_lambda) ** self.alpha * (1 - d_s) ** self.beta

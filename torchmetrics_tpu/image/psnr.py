@@ -1,0 +1,67 @@
+"""PeakSignalNoiseRatio metric class (reference ``image/psnr.py:32``)."""
+
+from __future__ import annotations
+
+from collections.abc import Sequence
+from typing import Any, Optional, Tuple, Union
+
+import jax.numpy as jnp
+
+from ..functional.image.psnr import _psnr_compute, _psnr_update
+from ..metric import Metric
+from ..utilities.prints import rank_zero_warn
+
+
+class PeakSignalNoiseRatio(Metric):
+    """PSNR over accumulated squared error. ``dim=None`` keeps two scalar sum states;
+    with ``dim`` set, per-update error tensors are concatenated (cat states)."""
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(
+        self,
+        data_range: Union[float, Tuple[float, float]],
+        base: float = 10.0,
+        reduction: Optional[str] = "elementwise_mean",
+        dim: Optional[Union[int, Tuple[int, ...]]] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if dim is None and reduction != "elementwise_mean":
+            rank_zero_warn(f"The `reduction={reduction}` will not have any effect when `dim` is None.")
+        if dim is None:
+            self.add_state("sum_squared_error", default=jnp.zeros(()), dist_reduce_fx="sum")
+            self.add_state("total", default=jnp.zeros((), jnp.int32), dist_reduce_fx="sum")
+        else:
+            self.add_state("sum_squared_error", default=[], dist_reduce_fx="cat")
+            self.add_state("total", default=[], dist_reduce_fx="cat")
+        self.clamp_range: Optional[Tuple[float, float]] = None
+        if isinstance(data_range, tuple):
+            self.data_range_val = float(data_range[1] - data_range[0])
+            self.clamp_range = (float(data_range[0]), float(data_range[1]))
+        else:
+            self.data_range_val = float(data_range)
+        self.base = base
+        self.reduction = reduction
+        self.dim = tuple(dim) if isinstance(dim, Sequence) else dim
+
+    def _batch_state(self, preds, target):
+        if self.clamp_range is not None:
+            preds = jnp.clip(preds, *self.clamp_range)
+            target = jnp.clip(target, *self.clamp_range)
+        sum_squared_error, num_obs = _psnr_update(preds, target, dim=self.dim)
+        if self.dim is None:
+            return {"sum_squared_error": sum_squared_error, "total": num_obs.astype(jnp.int32)}
+        return {"sum_squared_error": sum_squared_error, "total": num_obs}
+
+    def _compute(self, state):
+        return _psnr_compute(
+            state["sum_squared_error"],
+            state["total"],
+            jnp.asarray(self.data_range_val),
+            base=self.base,
+            reduction=self.reduction,
+        )

@@ -1,0 +1,53 @@
+"""PeakSignalNoiseRatioWithBlockedEffect metric class (reference ``image/psnrb.py:29``)."""
+
+from __future__ import annotations
+
+from typing import Any, Tuple, Union
+
+import jax.numpy as jnp
+
+from ..functional.image.psnrb import _psnrb_compute, _psnrb_update
+from ..metric import Metric
+
+
+class PeakSignalNoiseRatioWithBlockedEffect(Metric):
+    """PSNR-B over three scalar sum states (squared error, block effect, count)."""
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(
+        self,
+        data_range: Union[float, Tuple[float, float]],
+        block_size: int = 8,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(block_size, int) or block_size < 1:
+            raise ValueError("Argument `block_size` should be a positive integer")
+        self.block_size = block_size
+        self.add_state("sum_squared_error", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.zeros((), jnp.int32), dist_reduce_fx="sum")
+        self.add_state("bef", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.clamp_range = None
+        if isinstance(data_range, tuple):
+            self.data_range_val = float(data_range[1] - data_range[0])
+            self.clamp_range = (float(data_range[0]), float(data_range[1]))
+        else:
+            self.data_range_val = float(data_range)
+
+    def _batch_state(self, preds, target):
+        if self.clamp_range is not None:
+            preds = jnp.clip(preds, *self.clamp_range)
+            target = jnp.clip(target, *self.clamp_range)
+        sum_squared_error, bef, num_obs = _psnrb_update(
+            jnp.asarray(preds), jnp.asarray(target), block_size=self.block_size
+        )
+        return {"sum_squared_error": sum_squared_error, "bef": bef, "total": num_obs.astype(jnp.int32)}
+
+    def _compute(self, state):
+        return _psnrb_compute(
+            state["sum_squared_error"], state["bef"], state["total"], jnp.asarray(self.data_range_val)
+        )

@@ -1,0 +1,156 @@
+"""SSIM / MS-SSIM metric classes (reference ``image/ssim.py:31,242``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+
+from ..functional.image.ssim import _multiscale_ssim_update, _ssim_check_inputs, _ssim_update
+from ..metric import Metric
+
+
+class StructuralSimilarityIndexMeasure(Metric):
+    """SSIM. With mean/sum reduction: two scalar sum states; with ``reduction='none'``:
+    per-sample scores concatenate (cat state)."""
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self,
+        gaussian_kernel: bool = True,
+        sigma: Union[float, Sequence[float]] = 1.5,
+        kernel_size: Union[int, Sequence[int]] = 11,
+        reduction: Optional[str] = "elementwise_mean",
+        data_range: Optional[Union[float, Tuple[float, float]]] = None,
+        k1: float = 0.01,
+        k2: float = 0.03,
+        return_full_image: bool = False,
+        return_contrast_sensitivity: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        valid_reduction = ("elementwise_mean", "sum", "none", None)
+        if reduction not in valid_reduction:
+            raise ValueError(f"Argument `reduction` must be one of {valid_reduction}, but got {reduction}")
+        if reduction in ("elementwise_mean", "sum"):
+            self.add_state("similarity", default=jnp.zeros(()), dist_reduce_fx="sum")
+        else:
+            self.add_state("similarity", default=[], dist_reduce_fx="cat")
+        self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
+        if return_contrast_sensitivity or return_full_image:
+            self.add_state("image_return", default=[], dist_reduce_fx="cat")
+        self.gaussian_kernel = gaussian_kernel
+        self.sigma = sigma
+        self.kernel_size = kernel_size
+        self.reduction = reduction
+        self.data_range = data_range
+        self.k1 = k1
+        self.k2 = k2
+        self.return_full_image = return_full_image
+        self.return_contrast_sensitivity = return_contrast_sensitivity
+
+    def _prepare_inputs(self, preds, target):
+        return _ssim_check_inputs(preds, target), {}
+
+    def _batch_state(self, preds, target):
+        pack = _ssim_update(
+            preds, target, self.gaussian_kernel, self.sigma, self.kernel_size,
+            self.data_range, self.k1, self.k2, self.return_full_image, self.return_contrast_sensitivity,
+        )
+        image = None
+        similarity = pack
+        if isinstance(pack, tuple):
+            similarity, image = pack
+        out = {}
+        if self.reduction in ("elementwise_mean", "sum"):
+            out["similarity"] = similarity.sum()
+            out["total"] = jnp.asarray(float(preds.shape[0]))
+        else:
+            out["similarity"] = similarity
+            out["total"] = jnp.asarray(float(preds.shape[0]))
+        if image is not None:
+            out["image_return"] = image
+        return out
+
+    def _compute(self, state):
+        if self.reduction == "elementwise_mean":
+            similarity = state["similarity"] / state["total"]
+        elif self.reduction == "sum":
+            similarity = state["similarity"]
+        else:
+            similarity = state["similarity"]
+        if self.return_contrast_sensitivity or self.return_full_image:
+            return similarity, state["image_return"]
+        return similarity
+
+
+class MultiScaleStructuralSimilarityIndexMeasure(Metric):
+    """MS-SSIM with the same reduction-dependent state layout as SSIM."""
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self,
+        gaussian_kernel: bool = True,
+        kernel_size: Union[int, Sequence[int]] = 11,
+        sigma: Union[float, Sequence[float]] = 1.5,
+        reduction: Optional[str] = "elementwise_mean",
+        data_range: Optional[Union[float, Tuple[float, float]]] = None,
+        k1: float = 0.01,
+        k2: float = 0.03,
+        betas: Tuple[float, ...] = (0.0448, 0.2856, 0.3001, 0.2363, 0.1333),
+        normalize: Optional[str] = "relu",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        valid_reduction = ("elementwise_mean", "sum", "none", None)
+        if reduction not in valid_reduction:
+            raise ValueError(f"Argument `reduction` must be one of {valid_reduction}, but got {reduction}")
+        if reduction in ("elementwise_mean", "sum"):
+            self.add_state("similarity", default=jnp.zeros(()), dist_reduce_fx="sum")
+        else:
+            self.add_state("similarity", default=[], dist_reduce_fx="cat")
+        self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
+        if not (isinstance(kernel_size, (Sequence, int))):
+            raise ValueError(
+                f"Argument `kernel_size` expected to be an sequence or an int, or a single int. Got {kernel_size}"
+            )
+        if not isinstance(betas, tuple) or not all(isinstance(beta, float) for beta in betas):
+            raise ValueError("Argument `betas` is expected to be of a tuple of floats")
+        if normalize and normalize not in ("relu", "simple"):
+            raise ValueError("Argument `normalize` to be expected either `None`, `relu` or `simple`")
+        self.gaussian_kernel = gaussian_kernel
+        self.sigma = sigma
+        self.kernel_size = kernel_size
+        self.reduction = reduction
+        self.data_range = data_range
+        self.k1 = k1
+        self.k2 = k2
+        self.betas = betas
+        self.normalize = normalize
+
+    def _prepare_inputs(self, preds, target):
+        return _ssim_check_inputs(preds, target), {}
+
+    def _batch_state(self, preds, target):
+        similarity = _multiscale_ssim_update(
+            preds, target, self.gaussian_kernel, self.sigma, self.kernel_size,
+            self.data_range, self.k1, self.k2, self.betas, self.normalize,
+        )
+        if self.reduction in ("elementwise_mean", "sum"):
+            return {"similarity": similarity.sum(), "total": jnp.asarray(float(preds.shape[0]))}
+        return {"similarity": similarity, "total": jnp.asarray(float(preds.shape[0]))}
+
+    def _compute(self, state):
+        if self.reduction == "elementwise_mean":
+            return state["similarity"] / state["total"]
+        return state["similarity"]
