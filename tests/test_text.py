@@ -1,0 +1,299 @@
+"""Text tower parity tests vs the reference oracle (pure-python text metrics all run
+without optional deps; rougeLsum needs the punkt download, so it is tested against
+hand values with our offline fallback splitter instead)."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from tests.helpers import _assert_allclose
+from tests.oracle import reference_torchmetrics
+
+import torchmetrics_tpu as tm
+import torchmetrics_tpu.functional as F
+
+PREDS_A = ["this is the prediction", "there is an other sample"]
+TARGET_A = ["this is the reference", "there is another one"]
+PREDS_B = ["hello there general kenobi", "foo bar foobar"]
+TARGET_B = [["hello there general kenobi", "hello there!"], ["foo bar foobar", "foo bar foobar!"]]
+
+CORPUS_PREDS = [
+    "the cat is on the mat",
+    "a quick brown fox jumps over the lazy dog",
+    "It is a guide to action which ensures that the military always obeys the commands of the party",
+]
+CORPUS_TARGET = [
+    ["there is a cat on the mat", "a cat is on the mat"],
+    ["the quick brown fox jumps over a lazy dog"],
+    [
+        "It is a guide to action that ensures that the military will forever heed Party commands",
+        "It is the guiding principle which guarantees the military forces always being under the command of the Party",
+    ],
+]
+
+
+def _oracle():
+    tm_ref = reference_torchmetrics()
+    if tm_ref is None:
+        pytest.skip("oracle unavailable")
+    return tm_ref
+
+
+ASR_CASES = [
+    ("char_error_rate", "CharErrorRate"),
+    ("word_error_rate", "WordErrorRate"),
+    ("match_error_rate", "MatchErrorRate"),
+    ("word_information_lost", "WordInfoLost"),
+    ("word_information_preserved", "WordInfoPreserved"),
+]
+
+
+@pytest.mark.parametrize("fn_name,cls_name", ASR_CASES, ids=[c[0] for c in ASR_CASES])
+def test_asr_metrics_parity(fn_name, cls_name):
+    tm_ref = _oracle()
+    ours = getattr(F, fn_name)(PREDS_A, TARGET_A)
+    ref = getattr(tm_ref.functional.text, fn_name)(PREDS_A, TARGET_A)
+    _assert_allclose(ours, ref.numpy(), atol=1e-5)
+    ours_m = getattr(tm, cls_name)()
+    ref_m = getattr(tm_ref.text, cls_name)()
+    for p, t in ((PREDS_A, TARGET_A), (PREDS_B[0], TARGET_B[0][0])):
+        ours_m.update(p, t)
+        ref_m.update(p, t)
+    _assert_allclose(ours_m.compute(), ref_m.compute().numpy(), atol=1e-5)
+
+
+@pytest.mark.parametrize("n_gram", [2, 4])
+@pytest.mark.parametrize("smooth", [False, True])
+def test_bleu_parity(n_gram, smooth):
+    tm_ref = _oracle()
+    ours = F.bleu_score(CORPUS_PREDS, CORPUS_TARGET, n_gram=n_gram, smooth=smooth)
+    ref = tm_ref.functional.text.bleu_score(CORPUS_PREDS, CORPUS_TARGET, n_gram=n_gram, smooth=smooth)
+    _assert_allclose(ours, ref.numpy(), atol=1e-5)
+    ours_m = tm.BLEUScore(n_gram=n_gram, smooth=smooth)
+    ref_m = tm_ref.text.BLEUScore(n_gram=n_gram, smooth=smooth)
+    for i in range(len(CORPUS_PREDS)):
+        ours_m.update([CORPUS_PREDS[i]], [CORPUS_TARGET[i]])
+        ref_m.update([CORPUS_PREDS[i]], [CORPUS_TARGET[i]])
+    _assert_allclose(ours_m.compute(), ref_m.compute().numpy(), atol=1e-5)
+
+
+@pytest.mark.parametrize("tokenize", ["none", "13a", "char", "intl", "zh"])
+def test_sacre_bleu_parity(tokenize):
+    tm_ref = _oracle()
+    preds = ["The cat, is on the mat!", "Hello — wörld 123."]
+    target = [["There is a cat on the mat."], ["Hello wörld, 1-2-3!"]]
+    ours = F.sacre_bleu_score(preds, target, tokenize=tokenize, lowercase=True)
+    ref = tm_ref.functional.text.sacre_bleu_score(preds, target, tokenize=tokenize, lowercase=True)
+    _assert_allclose(ours, ref.numpy(), atol=1e-5)
+    ours_m = tm.SacreBLEUScore(tokenize=tokenize)
+    ref_m = tm_ref.text.SacreBLEUScore(tokenize=tokenize)
+    ours_m.update(CORPUS_PREDS, CORPUS_TARGET)
+    ref_m.update(CORPUS_PREDS, CORPUS_TARGET)
+    _assert_allclose(ours_m.compute(), ref_m.compute().numpy(), atol=1e-5)
+
+
+@pytest.mark.parametrize("reduction", ["mean", "sum", "none"])
+@pytest.mark.parametrize("substitution_cost", [1, 2])
+def test_edit_distance_parity(reduction, substitution_cost):
+    tm_ref = _oracle()
+    ours = F.edit_distance(PREDS_A, TARGET_A, substitution_cost=substitution_cost, reduction=reduction)
+    ref = tm_ref.functional.text.edit_distance(
+        PREDS_A, TARGET_A, substitution_cost=substitution_cost, reduction=reduction
+    )
+    _assert_allclose(ours, ref.numpy(), atol=1e-6)
+    ours_m = tm.EditDistance(substitution_cost=substitution_cost, reduction=reduction)
+    ref_m = tm_ref.text.EditDistance(substitution_cost=substitution_cost, reduction=reduction)
+    ours_m.update(PREDS_A, TARGET_A)
+    ours_m.update(PREDS_B, [t[0] for t in TARGET_B])
+    ref_m.update(PREDS_A, TARGET_A)
+    ref_m.update(PREDS_B, [t[0] for t in TARGET_B])
+    _assert_allclose(ours_m.compute(), ref_m.compute().numpy(), atol=1e-6)
+
+
+@pytest.mark.parametrize("n_word_order", [0, 2])
+@pytest.mark.parametrize("whitespace", [False, True])
+def test_chrf_parity(n_word_order, whitespace):
+    tm_ref = _oracle()
+    kwargs = dict(n_word_order=n_word_order, whitespace=whitespace)
+    ours = F.chrf_score(CORPUS_PREDS, CORPUS_TARGET, **kwargs)
+    ref = tm_ref.functional.text.chrf_score(CORPUS_PREDS, CORPUS_TARGET, **kwargs)
+    _assert_allclose(ours, ref.numpy(), atol=1e-5)
+    ours_m = tm.CHRFScore(return_sentence_level_score=True, **kwargs)
+    ref_m = tm_ref.text.CHRFScore(return_sentence_level_score=True, **kwargs)
+    for i in range(len(CORPUS_PREDS)):
+        ours_m.update([CORPUS_PREDS[i]], [CORPUS_TARGET[i]])
+        ref_m.update([CORPUS_PREDS[i]], [CORPUS_TARGET[i]])
+    ours_score, ours_sent = ours_m.compute()
+    ref_score, ref_sent = ref_m.compute()
+    _assert_allclose(ours_score, ref_score.numpy(), atol=1e-5)
+    _assert_allclose(ours_sent, ref_sent.numpy(), atol=1e-5)
+
+
+def test_squad_parity():
+    tm_ref = _oracle()
+    preds = [{"prediction_text": "1976", "id": "id1"}, {"prediction_text": "the big apple", "id": "id2"}]
+    target = [
+        {"answers": {"answer_start": [97], "text": ["1976"]}, "id": "id1"},
+        {"answers": {"answer_start": [1], "text": ["New York City", "the big apple!"]}, "id": "id2"},
+    ]
+    ours = F.squad(preds, target)
+    ref = tm_ref.functional.text.squad(preds, target)
+    _assert_allclose({k: np.asarray(v) for k, v in ours.items()}, {k: v.numpy() for k, v in ref.items()}, atol=1e-4)
+    ours_m = tm.SQuAD()
+    ref_m = tm_ref.text.SQuAD()
+    ours_m.update(preds, target)
+    ref_m.update(preds, target)
+    _assert_allclose(
+        {k: np.asarray(v) for k, v in ours_m.compute().items()},
+        {k: v.numpy() for k, v in ref_m.compute().items()},
+        atol=1e-4,
+    )
+
+
+@pytest.mark.parametrize("ignore_index", [None, 1])
+def test_perplexity_parity(ignore_index):
+    tm_ref = _oracle()
+    import torch
+
+    rng = np.random.default_rng(5)
+    preds = rng.normal(size=(2, 8, 5)).astype(np.float32)
+    target = rng.integers(0, 5, (2, 8))
+    ours = F.perplexity(jnp.asarray(preds), jnp.asarray(target), ignore_index=ignore_index)
+    ref = tm_ref.functional.text.perplexity(
+        torch.as_tensor(preds), torch.as_tensor(target).long(), ignore_index=ignore_index
+    )
+    _assert_allclose(ours, ref.numpy(), atol=1e-4)
+    ours_m = tm.Perplexity(ignore_index=ignore_index)
+    ref_m = tm_ref.text.Perplexity(ignore_index=ignore_index)
+    for i in range(2):
+        ours_m.update(jnp.asarray(preds[i : i + 1]), jnp.asarray(target[i : i + 1]))
+        ref_m.update(torch.as_tensor(preds[i : i + 1]), torch.as_tensor(target[i : i + 1]).long())
+    _assert_allclose(ours_m.compute(), ref_m.compute().numpy(), atol=1e-4)
+
+
+@pytest.mark.parametrize("accumulate", ["best", "avg"])
+@pytest.mark.parametrize("use_stemmer", [False, True])
+def test_rouge_parity_no_lsum(accumulate, use_stemmer):
+    tm_ref = _oracle()
+    keys = ("rouge1", "rouge2", "rougeL")
+    ours = F.rouge_score(CORPUS_PREDS, CORPUS_TARGET, accumulate=accumulate, use_stemmer=use_stemmer, rouge_keys=keys)
+    ref = tm_ref.functional.text.rouge_score(
+        CORPUS_PREDS, CORPUS_TARGET, accumulate=accumulate, use_stemmer=use_stemmer, rouge_keys=keys
+    )
+    _assert_allclose({k: np.asarray(v) for k, v in ours.items()}, {k: v.numpy() for k, v in ref.items()}, atol=1e-5)
+    ours_m = tm.ROUGEScore(accumulate=accumulate, use_stemmer=use_stemmer, rouge_keys=keys)
+    ref_m = tm_ref.text.ROUGEScore(accumulate=accumulate, use_stemmer=use_stemmer, rouge_keys=keys)
+    for i in range(len(CORPUS_PREDS)):
+        ours_m.update([CORPUS_PREDS[i]], [CORPUS_TARGET[i]])
+        ref_m.update([CORPUS_PREDS[i]], [CORPUS_TARGET[i]])
+    _assert_allclose(
+        {k: np.asarray(v) for k, v in ours_m.compute().items()},
+        {k: v.numpy() for k, v in ref_m.compute().items()},
+        atol=1e-5,
+    )
+
+
+def test_rouge_lsum_offline_fallback():
+    # single-sentence inputs: Lsum == L regardless of the splitter
+    res = F.rouge_score("My name is John", "Is your name John", rouge_keys=("rougeL", "rougeLsum"))
+    assert float(res["rougeLsum_fmeasure"]) == pytest.approx(float(res["rougeL_fmeasure"]))
+    # multi-sentence smoke with the regex fallback splitter
+    res2 = F.rouge_score(
+        "The cat sat. The dog ran!", "A cat sat. A dog ran!", rouge_keys=("rougeLsum",)
+    )
+    assert 0.0 < float(res2["rougeLsum_fmeasure"]) <= 1.0
+
+
+def test_text_merge_matches_single():
+    single = tm.BLEUScore()
+    shards = [tm.BLEUScore() for _ in range(3)]
+    for i in range(3):
+        single.update([CORPUS_PREDS[i]], [CORPUS_TARGET[i]])
+        shards[i].update([CORPUS_PREDS[i]], [CORPUS_TARGET[i]])
+    shards[0].merge_state(shards[1])
+    shards[0].merge_state(shards[2])
+    _assert_allclose(shards[0].compute(), single.compute(), atol=1e-6)
+
+    single = tm.WordErrorRate()
+    shards = [tm.WordErrorRate() for _ in range(2)]
+    for i, (p, t) in enumerate(zip(PREDS_A, TARGET_A)):
+        single.update([p], [t])
+        shards[i].update([p], [t])
+    shards[0].merge_state(shards[1])
+    _assert_allclose(shards[0].compute(), single.compute(), atol=1e-6)
+
+
+def test_text_validation_errors():
+    with pytest.raises(ValueError, match="Corpus has different size"):
+        F.bleu_score(["a", "b"], [["a"]])
+    with pytest.raises(ValueError, match="`tokenize`"):
+        tm.SacreBLEUScore(tokenize="bogus")
+    with pytest.raises(ValueError, match="same length"):
+        F.edit_distance(["a"], ["a", "b"])
+    with pytest.raises(KeyError, match="prediction_text"):
+        F.squad({"wrong": "x"}, {"answers": {"text": ["y"]}, "id": "1"})
+    with pytest.raises(ValueError, match="3 dimensions"):
+        F.perplexity(jnp.zeros((2, 3)), jnp.zeros((2, 3), jnp.int32))
+    with pytest.raises(ValueError, match="unknown rouge key"):
+        F.rouge_score("a", "a", rouge_keys=("rougeX",))
+
+
+@pytest.mark.parametrize("normalize", [False, True])
+@pytest.mark.parametrize("no_punctuation", [False, True])
+def test_ter_parity(normalize, no_punctuation):
+    tm_ref = _oracle()
+    kwargs = dict(normalize=normalize, no_punctuation=no_punctuation, return_sentence_level_score=True)
+    ours, ours_sent = F.translation_edit_rate(CORPUS_PREDS, CORPUS_TARGET, **kwargs)
+    ref, ref_sent = tm_ref.functional.text.translation_edit_rate(CORPUS_PREDS, CORPUS_TARGET, **kwargs)
+    _assert_allclose(ours, ref.numpy(), atol=1e-5)
+    _assert_allclose(ours_sent, np.asarray([float(s) for s in ref_sent]), atol=1e-5)
+    ours_m = tm.TranslationEditRate(normalize=normalize, no_punctuation=no_punctuation)
+    ref_m = tm_ref.text.TranslationEditRate(normalize=normalize, no_punctuation=no_punctuation)
+    for i in range(len(CORPUS_PREDS)):
+        ours_m.update([CORPUS_PREDS[i]], [CORPUS_TARGET[i]])
+        ref_m.update([CORPUS_PREDS[i]], [CORPUS_TARGET[i]])
+    _assert_allclose(ours_m.compute(), ref_m.compute().numpy(), atol=1e-5)
+
+
+def test_eed_parity():
+    tm_ref = _oracle()
+    ours, ours_sent = F.extended_edit_distance(CORPUS_PREDS, CORPUS_TARGET, return_sentence_level_score=True)
+    ref, ref_sent = tm_ref.functional.text.extended_edit_distance(
+        CORPUS_PREDS, CORPUS_TARGET, return_sentence_level_score=True
+    )
+    _assert_allclose(ours, ref.numpy(), atol=1e-5)
+    _assert_allclose(ours_sent, np.asarray([float(s) for s in ref_sent]), atol=1e-5)
+    ours_m = tm.ExtendedEditDistance()
+    ref_m = tm_ref.text.ExtendedEditDistance()
+    for i in range(len(CORPUS_PREDS)):
+        ours_m.update([CORPUS_PREDS[i]], [CORPUS_TARGET[i]])
+        ref_m.update([CORPUS_PREDS[i]], [CORPUS_TARGET[i]])
+    _assert_allclose(ours_m.compute(), ref_m.compute().numpy(), atol=1e-5)
+
+
+def test_ter_shifting_case():
+    # a case that requires a block shift: "b c a" -> "a b c" is 1 shift = 1 edit
+    score = F.translation_edit_rate(["b c a"], [["a b c"]])
+    assert float(score) == pytest.approx(1.0 / 3.0)
+
+
+def test_eed_rounding_tie_breaks_match_reference():
+    tm_ref = _oracle()
+    # adversarial repeated-token sentences that produce equal-cost DP cells
+    hyp = ["hello ! don't on is ? Dr. hello !"]
+    ref = ["big small the fast , runs don't end . hello ! dog big fast , big"]
+    ours = F.extended_edit_distance(hyp, [ref])
+    expected = tm_ref.functional.text.extended_edit_distance(hyp, [ref])
+    _assert_allclose(ours, expected.numpy(), atol=1e-7)
+
+
+def test_edit_distance_beam_matches_reference():
+    tm_ref = _oracle()
+    preds = ["cat U.S. runs"]
+    target = ["Dr. is cat very blue ? very dog blue mat big a U.S."]
+    for sc in (1, 2):
+        ours = F.edit_distance(preds, target, substitution_cost=sc)
+        expected = tm_ref.functional.text.edit_distance(preds, target, substitution_cost=sc)
+        _assert_allclose(ours, expected.numpy(), atol=1e-7)

@@ -9,7 +9,7 @@ Every domain package declares its public classes in its own ``__all__``; the fla
 namespace aggregates them (reference ``__init__.py`` re-exports ~100 names the same
 way, hand-listed)."""
 
-from torchmetrics_tpu import classification, clustering, detection, functional, image, nominal, parallel, regression, retrieval, segmentation, shape, utilities, wrappers
+from torchmetrics_tpu import classification, clustering, detection, functional, image, nominal, parallel, regression, retrieval, segmentation, shape, text, utilities, wrappers
 from torchmetrics_tpu.aggregation import (
     CatMetric,
     MaxMetric,
@@ -25,6 +25,7 @@ from torchmetrics_tpu.detection import *  # noqa: F401,F403
 from torchmetrics_tpu.image import *  # noqa: F401,F403
 from torchmetrics_tpu.nominal import *  # noqa: F401,F403
 from torchmetrics_tpu.shape import *  # noqa: F401,F403
+from torchmetrics_tpu.text import *  # noqa: F401,F403
 from torchmetrics_tpu.collections import MetricCollection
 from torchmetrics_tpu.metric import CompositionalMetric, Metric
 from torchmetrics_tpu.regression import *  # noqa: F401,F403
@@ -70,6 +71,7 @@ __all__ = [
     "image",
     "nominal",
     "shape",
+    "text",
     "segmentation",
     "utilities",
     "wrappers",
@@ -81,5 +83,6 @@ __all__ = [
     *image.__all__,
     *nominal.__all__,
     *shape.__all__,
+    *text.__all__,
     *segmentation.__all__,
 ]

@@ -5,7 +5,7 @@ Every domain package declares its public surface in its own ``__all__``; this mo
 aggregates them so the flat ``torchmetrics_tpu.functional.<fn>`` namespace stays in
 lock-step with the per-domain namespaces as domains are added."""
 
-from torchmetrics_tpu.functional import classification, clustering, detection, image, nominal, pairwise, regression, retrieval, segmentation, shape
+from torchmetrics_tpu.functional import classification, clustering, detection, image, nominal, pairwise, regression, retrieval, segmentation, shape, text
 from torchmetrics_tpu.functional.classification import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.regression import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.retrieval import *  # noqa: F401,F403
@@ -15,6 +15,7 @@ from torchmetrics_tpu.functional.image import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.nominal import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.pairwise import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.shape import *  # noqa: F401,F403
+from torchmetrics_tpu.functional.text import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.segmentation import *  # noqa: F401,F403
 
 __all__ = [
@@ -27,5 +28,6 @@ __all__ = [
     *nominal.__all__,
     *pairwise.__all__,
     *shape.__all__,
+    *text.__all__,
     *segmentation.__all__,
 ]

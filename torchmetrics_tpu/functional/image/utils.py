@@ -42,7 +42,10 @@ def _gaussian_kernel_3d(channel: int, kernel_size: Sequence[int], sigma: Sequenc
 
 
 def conv2d(inputs: Array, kernel: Array, groups: int = 1) -> Array:
-    """NCHW valid conv with OIHW kernel (grouped when groups == channels)."""
+    """NCHW valid conv with OIHW kernel (grouped when groups == channels).
+
+    ``Precision.HIGHEST`` keeps fp32 multiplies on TPU — the MXU's default bf16 path
+    shifts conv-based image metrics by up to 1e-2, past the parity envelope."""
     return lax.conv_general_dilated(
         inputs,
         kernel,
@@ -50,11 +53,12 @@ def conv2d(inputs: Array, kernel: Array, groups: int = 1) -> Array:
         padding="VALID",
         dimension_numbers=("NCHW", "OIHW", "NCHW"),
         feature_group_count=groups,
+        precision=lax.Precision.HIGHEST,
     )
 
 
 def conv3d(inputs: Array, kernel: Array, groups: int = 1) -> Array:
-    """NCDHW valid conv with OIDHW kernel."""
+    """NCDHW valid conv with OIDHW kernel (fp32 multiplies — see ``conv2d``)."""
     return lax.conv_general_dilated(
         inputs,
         kernel,
@@ -62,6 +66,7 @@ def conv3d(inputs: Array, kernel: Array, groups: int = 1) -> Array:
         padding="VALID",
         dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
         feature_group_count=groups,
+        precision=lax.Precision.HIGHEST,
     )
 
 

@@ -1,0 +1,35 @@
+"""Text tower — stateless kernels (reference ``src/torchmetrics/functional/text/``)."""
+
+from .asr import (
+    char_error_rate,
+    match_error_rate,
+    word_error_rate,
+    word_information_lost,
+    word_information_preserved,
+)
+from .bleu import bleu_score
+from .chrf import chrf_score
+from .edit import edit_distance
+from .eed import extended_edit_distance
+from .perplexity import perplexity
+from .rouge import rouge_score
+from .sacre_bleu import sacre_bleu_score
+from .squad import squad
+from .ter import translation_edit_rate
+
+__all__ = [
+    "bleu_score",
+    "char_error_rate",
+    "chrf_score",
+    "edit_distance",
+    "extended_edit_distance",
+    "match_error_rate",
+    "perplexity",
+    "rouge_score",
+    "sacre_bleu_score",
+    "squad",
+    "translation_edit_rate",
+    "word_error_rate",
+    "word_information_lost",
+    "word_information_preserved",
+]

@@ -1,0 +1,99 @@
+"""ASR error rates: CER / WER / MER / WIL / WIP (reference
+``functional/text/{cer,wer,mer,wil,wip}.py``).
+
+All five share one host-side tokenize + edit-distance pass and differ only in which
+counts they keep, so a single update computes every statistic and each public facade
+picks its slice.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple, Union
+
+import jax.numpy as jnp
+
+from .helper import _as_list, _edit_distance
+
+TextInput = Union[str, Sequence[str]]
+
+
+def _asr_counts(preds: TextInput, target: TextInput, char_level: bool) -> Tuple[float, float, float, float]:
+    """Returns (edit_errors, sum_max_len, target_total, preds_total)."""
+    preds = _as_list(preds)
+    target = _as_list(target)
+    errors = total = target_total = preds_total = 0.0
+    for pred, tgt in zip(preds, target):
+        pred_tokens = list(pred) if char_level else pred.split()
+        tgt_tokens = list(tgt) if char_level else tgt.split()
+        errors += _edit_distance(pred_tokens, tgt_tokens)
+        total += max(len(tgt_tokens), len(pred_tokens))
+        target_total += len(tgt_tokens)
+        preds_total += len(pred_tokens)
+    return errors, total, target_total, preds_total
+
+
+def _cer_update(preds: TextInput, target: TextInput):
+    errors, _, target_total, _ = _asr_counts(preds, target, char_level=True)
+    return jnp.asarray(errors), jnp.asarray(target_total)
+
+
+def _cer_compute(errors, total):
+    return errors / total
+
+
+def char_error_rate(preds: TextInput, target: TextInput) -> jnp.ndarray:
+    """CER = character edit distance / reference characters."""
+    return _cer_compute(*_cer_update(preds, target))
+
+
+def _wer_update(preds: TextInput, target: TextInput):
+    errors, _, target_total, _ = _asr_counts(preds, target, char_level=False)
+    return jnp.asarray(errors), jnp.asarray(target_total)
+
+
+def _wer_compute(errors, total):
+    return errors / total
+
+
+def word_error_rate(preds: TextInput, target: TextInput) -> jnp.ndarray:
+    """WER = word edit distance / reference words."""
+    return _wer_compute(*_wer_update(preds, target))
+
+
+def _mer_update(preds: TextInput, target: TextInput):
+    errors, total, _, _ = _asr_counts(preds, target, char_level=False)
+    return jnp.asarray(errors), jnp.asarray(total)
+
+
+def _mer_compute(errors, total):
+    return errors / total
+
+
+def match_error_rate(preds: TextInput, target: TextInput) -> jnp.ndarray:
+    """MER = word edit distance / max(reference, prediction) words."""
+    return _mer_compute(*_mer_update(preds, target))
+
+
+def _wil_wip_update(preds: TextInput, target: TextInput):
+    errors, total, target_total, preds_total = _asr_counts(preds, target, char_level=False)
+    # the reference folds hits as (edit_sum - maxlen_sum) into its "errors" state
+    # (functional/text/wil.py:52) — kept verbatim for state-layout parity
+    return jnp.asarray(errors - total), jnp.asarray(target_total), jnp.asarray(preds_total)
+
+
+def _wil_compute(errors, target_total, preds_total):
+    return 1 - ((errors / target_total) * (errors / preds_total))
+
+
+def word_information_lost(preds: TextInput, target: TextInput) -> jnp.ndarray:
+    """WIL = 1 - hit-rate product over reference and prediction lengths."""
+    return _wil_compute(*_wil_wip_update(preds, target))
+
+
+def _wip_compute(errors, target_total, preds_total):
+    return (errors / target_total) * (errors / preds_total)
+
+
+def word_information_preserved(preds: TextInput, target: TextInput) -> jnp.ndarray:
+    """WIP = hit-rate product over reference and prediction lengths."""
+    return _wip_compute(*_wil_wip_update(preds, target))

@@ -1,0 +1,92 @@
+"""BLEU score (reference ``functional/text/bleu.py``).
+
+Host-side n-gram counting producing four device-side sum states (numerator /
+denominator per order, prediction / reference lengths — reference ``text/bleu.py:92-95``);
+the final geometric mean + brevity penalty is pure jnp.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Callable, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from .helper import _count_ngram
+
+
+def _tokenize_fn(sentence: str) -> Sequence[str]:
+    return sentence.split()
+
+
+def _bleu_score_update(
+    preds: Sequence[str],
+    target: Sequence[Sequence[str]],
+    n_gram: int = 4,
+    tokenizer: Callable[[str], Sequence[str]] = _tokenize_fn,
+) -> Tuple[np.ndarray, np.ndarray, float, float]:
+    """Returns (numerator, denominator, preds_len, target_len) contributions."""
+    target_tok = [[tokenizer(line) if line else [] for line in t] for t in target]
+    preds_tok = [tokenizer(line) if line else [] for line in preds]
+    numerator = np.zeros(n_gram)
+    denominator = np.zeros(n_gram)
+    preds_len = 0.0
+    target_len = 0.0
+    for pred, targets in zip(preds_tok, target_tok):
+        preds_len += len(pred)
+        target_len_list = [len(tgt) for tgt in targets]
+        target_len_diff = [abs(len(pred) - x) for x in target_len_list]
+        target_len += target_len_list[target_len_diff.index(min(target_len_diff))]
+        preds_counter: Counter = _count_ngram(pred, n_gram)
+        target_counter: Counter = Counter()
+        for tgt in targets:
+            target_counter |= _count_ngram(tgt, n_gram)
+        ngram_counter_clip = preds_counter & target_counter
+        for counter_clip in ngram_counter_clip:
+            numerator[len(counter_clip) - 1] += ngram_counter_clip[counter_clip]
+        for counter in preds_counter:
+            denominator[len(counter) - 1] += preds_counter[counter]
+    return numerator, denominator, preds_len, target_len
+
+
+def _bleu_score_compute(
+    preds_len, target_len, numerator, denominator, n_gram: int, weights: Sequence[float], smooth: bool
+) -> jnp.ndarray:
+    numerator = jnp.asarray(numerator, jnp.float32)
+    denominator = jnp.asarray(denominator, jnp.float32)
+    preds_len = jnp.asarray(preds_len, jnp.float32)
+    target_len = jnp.asarray(target_len, jnp.float32)
+    if smooth:
+        precision_scores = (numerator + 1.0) / (denominator + 1.0)
+        precision_scores = precision_scores.at[0].set(numerator[0] / denominator[0])
+    else:
+        precision_scores = numerator / denominator
+    log_precision_scores = jnp.asarray(list(weights), jnp.float32) * jnp.log(precision_scores)
+    geometric_mean = jnp.exp(jnp.sum(log_precision_scores))
+    brevity_penalty = jnp.where(preds_len > target_len, 1.0, jnp.exp(1 - (target_len / preds_len)))
+    score = brevity_penalty * geometric_mean
+    return jnp.where(jnp.min(numerator) == 0.0, 0.0, score)
+
+
+def _resolve_weights(n_gram: int, weights: Optional[Sequence[float]]) -> Sequence[float]:
+    if weights is not None and len(weights) != n_gram:
+        raise ValueError(f"List of weights has different weights than `n_gram`: {len(weights)} != {n_gram}")
+    return weights if weights is not None else [1.0 / n_gram] * n_gram
+
+
+def bleu_score(
+    preds: Union[str, Sequence[str]],
+    target: Sequence[Union[str, Sequence[str]]],
+    n_gram: int = 4,
+    smooth: bool = False,
+    weights: Optional[Sequence[float]] = None,
+) -> jnp.ndarray:
+    """Corpus BLEU of machine-translated text against one or more references."""
+    preds_ = [preds] if isinstance(preds, str) else preds
+    target_ = [[tgt] if isinstance(tgt, str) else tgt for tgt in target]
+    if len(preds_) != len(target_):
+        raise ValueError(f"Corpus has different size {len(preds_)} != {len(target_)}")
+    weights = _resolve_weights(n_gram, weights)
+    numerator, denominator, preds_len, target_len = _bleu_score_update(preds_, target_, n_gram)
+    return _bleu_score_compute(preds_len, target_len, numerator, denominator, n_gram, weights, smooth)

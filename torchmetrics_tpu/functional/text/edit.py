@@ -1,0 +1,59 @@
+"""Edit (Levenshtein) distance (reference ``functional/text/edit.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax.numpy as jnp
+
+from .ter import _levenshtein_with_trace
+
+
+def _edit_distance_update(
+    preds: Union[str, Sequence[str]],
+    target: Union[str, Sequence[str]],
+    substitution_cost: int = 1,
+) -> jnp.ndarray:
+    if isinstance(preds, str):
+        preds = [preds]
+    if isinstance(target, str):
+        target = [target]
+    if not all(isinstance(x, str) for x in preds):
+        raise ValueError(f"Expected all values in argument `preds` to be string type, but got {preds}")
+    if not all(isinstance(x, str) for x in target):
+        raise ValueError(f"Expected all values in argument `target` to be string type, but got {target}")
+    if len(preds) != len(target):
+        raise ValueError(
+            f"Expected argument `preds` and `target` to have same length, but got {len(preds)} and {len(target)}"
+        )
+    # beam-limited DP like the reference's _LevenshteinEditDistance (beam width 25):
+    # the beam is part of the reference's observable behavior on length-disparate pairs
+    distance = [_levenshtein_with_trace(list(p), list(t), substitution_cost)[0] for p, t in zip(preds, target)]
+    return jnp.asarray(distance, jnp.int32)
+
+
+def _edit_distance_compute(
+    edit_scores: jnp.ndarray,
+    num_elements,
+    reduction: Optional[str] = "mean",
+) -> jnp.ndarray:
+    if edit_scores.size == 0:
+        return jnp.asarray(0, jnp.int32)
+    if reduction == "mean":
+        return edit_scores.sum() / num_elements
+    if reduction == "sum":
+        return edit_scores.sum()
+    if reduction is None or reduction == "none":
+        return edit_scores
+    raise ValueError("Expected argument `reduction` to either be 'sum', 'mean', 'none' or None")
+
+
+def edit_distance(
+    preds: Union[str, Sequence[str]],
+    target: Union[str, Sequence[str]],
+    substitution_cost: int = 1,
+    reduction: Optional[str] = "mean",
+) -> jnp.ndarray:
+    """Character-level Levenshtein distance with configurable substitution cost."""
+    distance = _edit_distance_update(preds, target, substitution_cost)
+    return _edit_distance_compute(distance, num_elements=distance.size, reduction=reduction)

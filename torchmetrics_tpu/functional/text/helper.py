@@ -1,0 +1,49 @@
+"""Text metric helpers (reference ``functional/text/helper.py``).
+
+String processing is host-side by design (SURVEY §2.6): tokenization and edit-distance
+DP run on CPU, and only the resulting sufficient statistics become device arrays. The
+edit-distance inner loop is vectorized with numpy (row-sweep DP) rather than the
+reference's pure-Python cell loop.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import List, Sequence, Union
+
+import numpy as np
+
+
+def _edit_distance(prediction_tokens: Sequence, reference_tokens: Sequence, substitution_cost: int = 1) -> int:
+    """Levenshtein distance between two token sequences (numpy row-sweep DP)."""
+    n, m = len(prediction_tokens), len(reference_tokens)
+    if n == 0:
+        return m
+    if m == 0:
+        return n
+    # map tokens to ints for vectorized equality
+    vocab = {}
+    a = np.asarray([vocab.setdefault(t, len(vocab)) for t in prediction_tokens], np.int64)
+    b = np.asarray([vocab.setdefault(t, len(vocab)) for t in reference_tokens], np.int64)
+    prev = np.arange(m + 1, dtype=np.int64)
+    offsets = np.arange(m + 1, dtype=np.int64)
+    for i in range(1, n + 1):
+        sub = prev[:-1] + np.where(b != a[i - 1], substitution_cost, 0)
+        delete = prev[1:] + 1
+        vals = np.concatenate(([i], np.minimum(sub, delete)))
+        # fold sequential insertions via prefix-min: cur[j] = min_{k<=j} vals[k] + (j-k)
+        prev = np.minimum.accumulate(vals - offsets) + offsets
+    return int(prev[m])
+
+
+def _count_ngram(ngram_input_list: Sequence[str], n_gram: int) -> Counter:
+    """Counts of all 1..n grams of a token list."""
+    ngram_counter: Counter = Counter()
+    for i in range(1, n_gram + 1):
+        for j in range(len(ngram_input_list) - i + 1):
+            ngram_counter[tuple(ngram_input_list[j : i + j])] += 1
+    return ngram_counter
+
+
+def _as_list(x: Union[str, Sequence[str]]) -> List[str]:
+    return [x] if isinstance(x, str) else list(x)

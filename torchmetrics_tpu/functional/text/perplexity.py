@@ -1,0 +1,60 @@
+"""Perplexity (reference ``functional/text/perplexity.py``).
+
+Fully jittable: one log-softmax gather with ignore-index masking — the only text
+metric whose update is a device kernel end to end.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _check_shape_and_type_consistency(preds, target) -> None:
+    if preds.ndim != 3:
+        raise ValueError(
+            "Input tensor `preds` is expected to have 3 dimensions, [batch_size, seq_len, vocab_size],"
+            f" but got {preds.ndim}."
+        )
+    if target.ndim != 2:
+        raise ValueError(
+            f"Input tensor `target` is expected to have 2 dimensions, [batch_size, seq_len], but got {target.ndim}."
+        )
+    if preds.shape[:2] != target.shape:
+        raise ValueError(
+            "Input tensors `preds` and `target` are expected to have equaling first two dimensions,"
+            f" [batch_size, seq_len], but got {preds.shape[:2]} and {target.shape}."
+        )
+    if not jnp.issubdtype(preds.dtype, jnp.floating):
+        raise TypeError(f"Input tensor `preds` is expected to be of floating point type but got {preds.dtype}.")
+    if not jnp.issubdtype(target.dtype, jnp.integer):
+        raise TypeError(f"Input tensor `target` is expected to be of integer type but got {target.dtype}.")
+
+
+def _perplexity_update(preds, target, ignore_index: Optional[int] = None) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    _check_shape_and_type_consistency(preds, target)
+    log_probs = jax.nn.log_softmax(preds.reshape(-1, preds.shape[-1]), axis=-1)
+    target = target.reshape(-1)
+    if ignore_index is not None:
+        mask = target != ignore_index
+        target = jnp.where(mask, target, 0)
+    else:
+        mask = jnp.ones_like(target, bool)
+    picked = jnp.take_along_axis(log_probs, target[:, None], axis=1)[:, 0]
+    total_log_probs = -(jnp.where(mask, picked, 0.0)).sum()
+    count = mask.sum()
+    return total_log_probs, count
+
+
+def _perplexity_compute(total, count) -> jnp.ndarray:
+    return jnp.exp(total / count)
+
+
+def perplexity(preds, target, ignore_index: Optional[int] = None) -> jnp.ndarray:
+    """exp of the mean negative log-likelihood of the target tokens under ``preds``."""
+    total, count = _perplexity_update(preds, target, ignore_index)
+    return _perplexity_compute(total, count)

@@ -1,0 +1,349 @@
+"""Translation Edit Rate (reference ``functional/text/ter.py``; algorithm follows the
+Tercom/sacrebleu semantics: greedy block-shift search over a trace-producing,
+beam-limited Levenshtein alignment).
+
+All work is host-side; the class keeps two scalar sum states (edits, reference
+length).
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+
+from .helper import _as_list
+
+_MAX_SHIFT_SIZE = 10
+_MAX_SHIFT_DIST = 50
+_MAX_SHIFT_CANDIDATES = 1000
+_BEAM_WIDTH = 25
+_INT_INFINITY = int(1e16)
+
+# edit-operation codes for the trace
+_NOTHING, _SUB, _INS, _DEL, _UNDEF = 0, 1, 2, 3, 4
+
+
+class _TercomTokenizer:
+    """Tercom normalization/tokenization (sacrebleu ``tokenizer_ter`` semantics)."""
+
+    _ASIAN_PUNCTUATION = r"([、。〈-】〔-〟｡-･・])"
+    _FULL_WIDTH_PUNCTUATION = r"([．，？：；！＂（）])"
+
+    def __init__(
+        self,
+        normalize: bool = False,
+        no_punctuation: bool = False,
+        lowercase: bool = True,
+        asian_support: bool = False,
+    ) -> None:
+        self.normalize = normalize
+        self.no_punctuation = no_punctuation
+        self.lowercase = lowercase
+        self.asian_support = asian_support
+
+    def __call__(self, sentence: str) -> str:
+        if not sentence:
+            return ""
+        if self.lowercase:
+            sentence = sentence.lower()
+        if self.normalize:
+            sentence = self._normalize_general_and_western(sentence)
+            if self.asian_support:
+                sentence = self._normalize_asian(sentence)
+        if self.no_punctuation:
+            sentence = self._remove_punct(sentence)
+            if self.asian_support:
+                sentence = self._remove_asian_punct(sentence)
+        return " ".join(sentence.split())
+
+    @staticmethod
+    def _normalize_general_and_western(sentence: str) -> str:
+        sentence = f" {sentence} "
+        rules = [
+            (r"\n-", ""),
+            (r"\n", " "),
+            (r"&quot;", '"'),
+            (r"&amp;", "&"),
+            (r"&lt;", "<"),
+            (r"&gt;", ">"),
+            (r"([{-~[-` -&(-+:-@/])", r" \1 "),
+            (r"'s ", r" 's "),
+            (r"'s$", r" 's"),
+            (r"([^0-9])([\.,])", r"\1 \2 "),
+            (r"([\.,])([^0-9])", r" \1 \2"),
+            (r"([0-9])(-)", r"\1 \2 "),
+        ]
+        for pattern, replacement in rules:
+            sentence = re.sub(pattern, replacement, sentence)
+        return sentence
+
+    @classmethod
+    def _normalize_asian(cls, sentence: str) -> str:
+        sentence = re.sub(r"([一-鿿㐀-䶿])", r" \1 ", sentence)
+        sentence = re.sub(r"([㇀-㇯⺀-⻿])", r" \1 ", sentence)
+        sentence = re.sub(r"([㌀-㏿豈-﫿︰-﹏])", r" \1 ", sentence)
+        sentence = re.sub(r"([㈀-㼢])", r" \1 ", sentence)
+        sentence = re.sub(r"(^|^[぀-ゟ])([぀-ゟ]+)(?=$|^[぀-ゟ])", r"\1 \2 ", sentence)
+        sentence = re.sub(r"(^|^[゠-ヿ])([゠-ヿ]+)(?=$|^[゠-ヿ])", r"\1 \2 ", sentence)
+        sentence = re.sub(r"(^|^[ㇰ-ㇿ])([ㇰ-ㇿ]+)(?=$|^[ㇰ-ㇿ])", r"\1 \2 ", sentence)
+        sentence = re.sub(cls._ASIAN_PUNCTUATION, r" \1 ", sentence)
+        return re.sub(cls._FULL_WIDTH_PUNCTUATION, r" \1 ", sentence)
+
+    @staticmethod
+    def _remove_punct(sentence: str) -> str:
+        return re.sub(r"[\.,\?:;!\"\(\)]", "", sentence)
+
+    @classmethod
+    def _remove_asian_punct(cls, sentence: str) -> str:
+        sentence = re.sub(cls._ASIAN_PUNCTUATION, r"", sentence)
+        return re.sub(cls._FULL_WIDTH_PUNCTUATION, r"", sentence)
+
+
+def _levenshtein_with_trace(
+    pred: List[str], ref: List[str], op_substitute: int = 1
+) -> Tuple[int, List[int]]:
+    """Beam-limited Levenshtein with backtrace (Tercom beam + tie preference
+    substitute > delete > insert; the beam mirrors sacrebleu's lib_ter and is part of
+    the compatibility surface — it changes results on length-disparate pairs)."""
+    n, m = len(pred), len(ref)
+    cost = [[_INT_INFINITY] * (m + 1) for _ in range(n + 1)]
+    op = [[_UNDEF] * (m + 1) for _ in range(n + 1)]
+    for j in range(m + 1):
+        cost[0][j] = j
+        op[0][j] = _INS
+    length_ratio = m / n if pred else 1.0
+    beam_width = math.ceil(length_ratio / 2 + _BEAM_WIDTH) if length_ratio / 2 > _BEAM_WIDTH else _BEAM_WIDTH
+    for i in range(1, n + 1):
+        pseudo_diag = math.floor(i * length_ratio)
+        min_j = max(0, pseudo_diag - beam_width)
+        max_j = m + 1 if i == n else min(m + 1, pseudo_diag + beam_width)
+        for j in range(min_j, max_j):
+            if j == 0:
+                cost[i][j] = cost[i - 1][j] + 1
+                op[i][j] = _DEL
+            else:
+                if pred[i - 1] == ref[j - 1]:
+                    cands = ((cost[i - 1][j - 1], _NOTHING),)
+                else:
+                    cands = ((cost[i - 1][j - 1] + op_substitute, _SUB),)
+                cands += ((cost[i - 1][j] + 1, _DEL), (cost[i][j - 1] + 1, _INS))
+                for c, o in cands:
+                    if cost[i][j] > c:
+                        cost[i][j] = c
+                        op[i][j] = o
+    # backtrace
+    trace: List[int] = []
+    i, j = n, m
+    while i > 0 or j > 0:
+        o = op[i][j]
+        trace.append(o)
+        if o in (_NOTHING, _SUB):
+            i -= 1
+            j -= 1
+        elif o == _INS:
+            j -= 1
+        elif o == _DEL:
+            i -= 1
+        else:  # pragma: no cover - beam always covers the backtrace path
+            raise ValueError("Unknown operation in edit-distance backtrace")
+    trace.reverse()
+    return cost[n][m], trace
+
+
+def _flip_trace(trace: List[int]) -> List[int]:
+    return [_DEL if o == _INS else _INS if o == _DEL else o for o in trace]
+
+
+def _trace_to_alignment(trace: List[int]) -> Tuple[Dict[int, int], List[int], List[int]]:
+    ref_pos = hyp_pos = -1
+    ref_errors: List[int] = []
+    hyp_errors: List[int] = []
+    alignments: Dict[int, int] = {}
+    for o in trace:
+        if o == _NOTHING:
+            hyp_pos += 1
+            ref_pos += 1
+            alignments[ref_pos] = hyp_pos
+            ref_errors.append(0)
+            hyp_errors.append(0)
+        elif o == _SUB:
+            hyp_pos += 1
+            ref_pos += 1
+            alignments[ref_pos] = hyp_pos
+            ref_errors.append(1)
+            hyp_errors.append(1)
+        elif o == _INS:
+            hyp_pos += 1
+            hyp_errors.append(1)
+        elif o == _DEL:
+            ref_pos += 1
+            alignments[ref_pos] = hyp_pos
+            ref_errors.append(1)
+    return alignments, ref_errors, hyp_errors
+
+
+def _find_shifted_pairs(pred_words: List[str], target_words: List[str]) -> Iterator[Tuple[int, int, int]]:
+    for pred_start in range(len(pred_words)):
+        for target_start in range(len(target_words)):
+            if abs(target_start - pred_start) > _MAX_SHIFT_DIST:
+                continue
+            for length in range(1, _MAX_SHIFT_SIZE):
+                if pred_words[pred_start + length - 1] != target_words[target_start + length - 1]:
+                    break
+                yield pred_start, target_start, length
+                if len(pred_words) == pred_start + length or len(target_words) == target_start + length:
+                    break
+
+
+def _perform_shift(words: List[str], start: int, length: int, target: int) -> List[str]:
+    if target < start:
+        return words[:target] + words[start : start + length] + words[target:start] + words[start + length :]
+    if target > start + length:
+        return words[:start] + words[start + length : target] + words[start : start + length] + words[target:]
+    return (
+        words[:start] + words[start + length : length + target] + words[start : start + length] + words[length + target :]
+    )
+
+
+def _shift_words(
+    pred_words: List[str],
+    target_words: List[str],
+    checked_candidates: int,
+) -> Tuple[int, List[str], int]:
+    """One round of the greedy Tercom shift search; returns the best gain."""
+    edit_distance, inv_trace = _levenshtein_with_trace(pred_words, target_words)
+    trace = _flip_trace(inv_trace)
+    alignments, target_errors, pred_errors = _trace_to_alignment(trace)
+    best: Optional[tuple] = None
+    for pred_start, target_start, length in _find_shifted_pairs(pred_words, target_words):
+        # skip shifts where the hypothesis span is already correct, where the
+        # reference span already matches, or that shift within the subsequence
+        if (
+            sum(pred_errors[pred_start : pred_start + length]) == 0
+            or sum(target_errors[target_start : target_start + length]) == 0
+            or pred_start <= alignments[target_start] < pred_start + length
+        ):
+            continue
+        prev_idx = -1
+        for offset in range(-1, length):
+            if target_start + offset == -1:
+                idx = 0
+            elif target_start + offset in alignments:
+                idx = alignments[target_start + offset] + 1
+            else:
+                break
+            if idx == prev_idx:
+                continue
+            prev_idx = idx
+            shifted_words = _perform_shift(pred_words, pred_start, length, idx)
+            candidate = (
+                edit_distance - _levenshtein_with_trace(shifted_words, target_words)[0],
+                length,
+                -pred_start,
+                -idx,
+                shifted_words,
+            )
+            checked_candidates += 1
+            if not best or candidate > best:
+                best = candidate
+        if checked_candidates >= _MAX_SHIFT_CANDIDATES:
+            break
+    if not best:
+        return 0, pred_words, checked_candidates
+    best_score, _, _, _, shifted_words = best
+    return best_score, shifted_words, checked_candidates
+
+
+def _translation_edit_rate(pred_words: List[str], target_words: List[str]) -> float:
+    """Shifts + remaining edit distance between one hypothesis and one reference."""
+    if len(target_words) == 0:
+        return 0.0
+    num_shifts = 0
+    checked_candidates = 0
+    input_words = pred_words
+    while True:
+        delta, new_input_words, checked_candidates = _shift_words(input_words, target_words, checked_candidates)
+        if checked_candidates >= _MAX_SHIFT_CANDIDATES or delta <= 0:
+            break
+        num_shifts += 1
+        input_words = new_input_words
+    edit_distance, _ = _levenshtein_with_trace(input_words, target_words)
+    return float(num_shifts + edit_distance)
+
+
+def _compute_sentence_statistics(pred_words: List[str], target_words: List[List[str]]) -> Tuple[float, float]:
+    tgt_lengths = 0.0
+    best_num_edits = 2e16
+    for tgt_words in target_words:
+        # NOTE: argument order follows the reference (ter.py:371): the reference
+        # sentence is the one being shifted toward the hypothesis
+        num_edits = _translation_edit_rate(tgt_words, pred_words)
+        tgt_lengths += len(tgt_words)
+        if num_edits < best_num_edits:
+            best_num_edits = num_edits
+    avg_tgt_len = tgt_lengths / len(target_words) if target_words else 0.0
+    return best_num_edits, avg_tgt_len
+
+
+def _compute_ter_score_from_statistics(num_edits: float, tgt_length: float) -> float:
+    if tgt_length > 0 and num_edits > 0:
+        return num_edits / tgt_length
+    if tgt_length == 0 and num_edits > 0:
+        return 1.0
+    return 0.0
+
+
+def _ter_update(
+    preds: Union[str, Sequence[str]],
+    target: Sequence[Union[str, Sequence[str]]],
+    tokenizer: _TercomTokenizer,
+) -> Tuple[float, float, List[float]]:
+    """Per-call (total_edits, total_target_length, sentence_ter) contribution."""
+    preds = _as_list(preds)
+    target = [[t] if isinstance(t, str) else list(t) for t in target]
+    if len(preds) != len(target):
+        raise ValueError(f"Corpus has different size {len(preds)} != {len(target)}")
+    total_num_edits = 0.0
+    total_tgt_length = 0.0
+    sentence_ter: List[float] = []
+    for pred, tgt in zip(preds, target):
+        tgt_words_ = [tokenizer(_tgt.rstrip()).split() for _tgt in tgt]
+        pred_words_ = tokenizer(pred.rstrip()).split()
+        num_edits, tgt_length = _compute_sentence_statistics(pred_words_, tgt_words_)
+        total_num_edits += num_edits
+        total_tgt_length += tgt_length
+        sentence_ter.append(_compute_ter_score_from_statistics(num_edits, tgt_length))
+    return total_num_edits, total_tgt_length, sentence_ter
+
+
+def _ter_compute(total_num_edits, total_tgt_length) -> jnp.ndarray:
+    return jnp.asarray(
+        _compute_ter_score_from_statistics(float(total_num_edits), float(total_tgt_length)), jnp.float32
+    )
+
+
+def translation_edit_rate(
+    preds: Union[str, Sequence[str]],
+    target: Sequence[Union[str, Sequence[str]]],
+    normalize: bool = False,
+    no_punctuation: bool = False,
+    lowercase: bool = True,
+    asian_support: bool = False,
+    return_sentence_level_score: bool = False,
+) -> Union[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray]]:
+    """Corpus TER (Tercom/sacrebleu-compatible block-shift edit rate)."""
+    for name, val in (
+        ("normalize", normalize), ("no_punctuation", no_punctuation),
+        ("lowercase", lowercase), ("asian_support", asian_support),
+    ):
+        if not isinstance(val, bool):
+            raise ValueError(f"Expected argument `{name}` to be of type boolean but got {val}.")
+    tokenizer = _TercomTokenizer(normalize, no_punctuation, lowercase, asian_support)
+    total_num_edits, total_tgt_length, sentence_ter = _ter_update(preds, target, tokenizer)
+    score = _ter_compute(total_num_edits, total_tgt_length)
+    if return_sentence_level_score:
+        return score, jnp.asarray(sentence_ter, jnp.float32)
+    return score

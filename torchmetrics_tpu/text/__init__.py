@@ -1,0 +1,35 @@
+"""Text tower — stateful metric classes (reference ``src/torchmetrics/text/``)."""
+
+from .metrics import (
+    BLEUScore,
+    ExtendedEditDistance,
+    TranslationEditRate,
+    CharErrorRate,
+    CHRFScore,
+    EditDistance,
+    MatchErrorRate,
+    Perplexity,
+    ROUGEScore,
+    SacreBLEUScore,
+    SQuAD,
+    WordErrorRate,
+    WordInfoLost,
+    WordInfoPreserved,
+)
+
+__all__ = [
+    "BLEUScore",
+    "CHRFScore",
+    "CharErrorRate",
+    "EditDistance",
+    "ExtendedEditDistance",
+    "MatchErrorRate",
+    "Perplexity",
+    "ROUGEScore",
+    "SQuAD",
+    "SacreBLEUScore",
+    "TranslationEditRate",
+    "WordErrorRate",
+    "WordInfoLost",
+    "WordInfoPreserved",
+]

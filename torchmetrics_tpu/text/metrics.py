@@ -1,0 +1,509 @@
+"""Text tower metric classes (reference ``src/torchmetrics/text/*.py``).
+
+All string processing runs host-side in ``_host_batch_state``; states are fixed-shape
+count tensors (sum-reduced — sync is one psum each) except ROUGE/EditDistance('none')
+which keep per-sentence cat rows like the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..functional.text.asr import (
+    _asr_counts,
+    _cer_compute,
+    _mer_compute,
+    _wer_compute,
+    _wil_compute,
+    _wip_compute,
+)
+from ..functional.text.bleu import _bleu_score_compute, _bleu_score_update, _resolve_weights, _tokenize_fn
+from ..functional.text.chrf import _chrf_score_compute, _chrf_score_update, _validate_chrf_args
+from ..functional.text.edit import _edit_distance_compute, _edit_distance_update
+from ..functional.text.perplexity import _perplexity_compute, _perplexity_update
+from ..functional.text.rouge import (
+    ALLOWED_ACCUMULATE_VALUES,
+    _make_stemmer,
+    _resolve_rouge_keys,
+    _rouge_score_update,
+)
+from ..functional.text.sacre_bleu import AVAILABLE_TOKENIZERS, _SacreBLEUTokenizer
+from ..functional.text.squad import _squad_compute, _squad_input_check, _squad_update
+from ..metric import HostMetric, Metric
+
+
+class BLEUScore(HostMetric):
+    """Corpus BLEU (reference ``text/bleu.py:34``; states ``text/bleu.py:92-95``)."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self, n_gram: int = 4, smooth: bool = False, weights: Optional[Sequence[float]] = None, **kwargs: Any
+    ) -> None:
+        super().__init__(**kwargs)
+        self.n_gram = n_gram
+        self.smooth = smooth
+        self.weights = _resolve_weights(n_gram, weights)
+        self.tokenizer: Callable = _tokenize_fn
+        self.add_state("preds_len", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("target_len", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("numerator", jnp.zeros(self.n_gram), dist_reduce_fx="sum")
+        self.add_state("denominator", jnp.zeros(self.n_gram), dist_reduce_fx="sum")
+
+    def _host_batch_state(self, preds: Sequence[str], target: Sequence[Union[str, Sequence[str]]]):
+        preds_ = [preds] if isinstance(preds, str) else preds
+        target_ = [[tgt] if isinstance(tgt, str) else tgt for tgt in target]
+        if len(preds_) != len(target_):
+            raise ValueError(f"Corpus has different size {len(preds_)} != {len(target_)}")
+        numerator, denominator, preds_len, target_len = _bleu_score_update(
+            preds_, target_, self.n_gram, self.tokenizer
+        )
+        return {
+            "numerator": jnp.asarray(numerator, jnp.float32),
+            "denominator": jnp.asarray(denominator, jnp.float32),
+            "preds_len": jnp.asarray(preds_len, jnp.float32),
+            "target_len": jnp.asarray(target_len, jnp.float32),
+        }
+
+    def _compute(self, state):
+        return _bleu_score_compute(
+            state["preds_len"], state["target_len"], state["numerator"], state["denominator"],
+            self.n_gram, self.weights, self.smooth,
+        )
+
+
+class SacreBLEUScore(BLEUScore):
+    """BLEU with sacrebleu tokenization (reference ``text/sacre_bleu.py:35``)."""
+
+    def __init__(
+        self,
+        n_gram: int = 4,
+        smooth: bool = False,
+        tokenize: str = "13a",
+        lowercase: bool = False,
+        weights: Optional[Sequence[float]] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(n_gram=n_gram, smooth=smooth, weights=weights, **kwargs)
+        if tokenize not in AVAILABLE_TOKENIZERS:
+            raise ValueError(f"Argument `tokenize` expected to be one of {AVAILABLE_TOKENIZERS} but got {tokenize}.")
+        self.tokenizer = _SacreBLEUTokenizer(tokenize, lowercase)
+
+
+class _ASRMetric(HostMetric):
+    """Shared shell for CER/WER/MER: (errors, total) sum states."""
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    _char_level = False
+    _total_is_max = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("errors", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def _host_batch_state(self, preds, target):
+        errors, total_max, target_total, _ = _asr_counts(preds, target, char_level=self._char_level)
+        return {
+            "errors": jnp.asarray(errors, jnp.float32),
+            "total": jnp.asarray(total_max if self._total_is_max else target_total, jnp.float32),
+        }
+
+
+class CharErrorRate(_ASRMetric):
+    """Character error rate (reference ``text/cer.py:29``)."""
+
+    _char_level = True
+
+    def _compute(self, state):
+        return _cer_compute(state["errors"], state["total"])
+
+
+class WordErrorRate(_ASRMetric):
+    """Word error rate (reference ``text/wer.py:29``)."""
+
+    def _compute(self, state):
+        return _wer_compute(state["errors"], state["total"])
+
+
+class MatchErrorRate(_ASRMetric):
+    """Match error rate (reference ``text/mer.py:29``)."""
+
+    _total_is_max = True
+
+    def _compute(self, state):
+        return _mer_compute(state["errors"], state["total"])
+
+
+class _WordInfoMetric(HostMetric):
+    is_differentiable = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("errors", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("target_total", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("preds_total", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def _host_batch_state(self, preds, target):
+        errors, total, target_total, preds_total = _asr_counts(preds, target, char_level=False)
+        return {
+            "errors": jnp.asarray(errors - total, jnp.float32),
+            "target_total": jnp.asarray(target_total, jnp.float32),
+            "preds_total": jnp.asarray(preds_total, jnp.float32),
+        }
+
+
+class WordInfoLost(_WordInfoMetric):
+    """Word information lost (reference ``text/wil.py:28``)."""
+
+    higher_is_better = False
+
+    def _compute(self, state):
+        return _wil_compute(state["errors"], state["target_total"], state["preds_total"])
+
+
+class WordInfoPreserved(_WordInfoMetric):
+    """Word information preserved (reference ``text/wip.py:28``)."""
+
+    higher_is_better = True
+
+    def _compute(self, state):
+        return _wip_compute(state["errors"], state["target_total"], state["preds_total"])
+
+
+class EditDistance(HostMetric):
+    """Levenshtein edit distance (reference ``text/edit.py:30``)."""
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, substitution_cost: int = 1, reduction: Optional[str] = "mean", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not (isinstance(substitution_cost, int) and substitution_cost >= 0):
+            raise ValueError(
+                f"Expected argument `substitution_cost` to be a positive integer, but got {substitution_cost}"
+            )
+        allowed_reduction = (None, "mean", "sum", "none")
+        if reduction not in allowed_reduction:
+            raise ValueError(f"Expected argument `reduction` to be one of {allowed_reduction}, but got {reduction}")
+        self.substitution_cost = substitution_cost
+        self.reduction = reduction
+        if self.reduction in ("none", None):
+            self.add_state("edit_scores_list", default=[], dist_reduce_fx="cat")
+        else:
+            self.add_state("edit_scores", default=jnp.zeros((), jnp.int32), dist_reduce_fx="sum")
+            self.add_state("num_elements", default=jnp.zeros((), jnp.int32), dist_reduce_fx="sum")
+
+    def _host_batch_state(self, preds, target):
+        distance = _edit_distance_update(preds, target, self.substitution_cost)
+        if self.reduction in ("none", None):
+            return {"edit_scores_list": distance}
+        return {
+            "edit_scores": distance.sum(),
+            "num_elements": jnp.asarray(distance.size, jnp.int32),
+        }
+
+    def _compute(self, state):
+        if self.reduction in ("none", None):
+            return _edit_distance_compute(jnp.asarray(state["edit_scores_list"], jnp.int32), 1, self.reduction)
+        return _edit_distance_compute(state["edit_scores"], state["num_elements"], self.reduction)
+
+
+class CHRFScore(HostMetric):
+    """chrF/chrF++ (reference ``text/chrf.py:53``): six per-order count vectors."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self,
+        n_char_order: int = 6,
+        n_word_order: int = 2,
+        beta: float = 2.0,
+        lowercase: bool = False,
+        whitespace: bool = False,
+        return_sentence_level_score: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        _validate_chrf_args(n_char_order, n_word_order, beta)
+        self.n_char_order = n_char_order
+        self.n_word_order = n_word_order
+        self.beta = beta
+        self.lowercase = lowercase
+        self.whitespace = whitespace
+        self.return_sentence_level_score = return_sentence_level_score
+        self.n_order = float(n_char_order + n_word_order)
+        for name in ("preds_char", "preds_word", "target_char", "target_word", "matching_char", "matching_word"):
+            order = n_char_order if "char" in name else n_word_order
+            self.add_state(f"total_{name}_n_grams", jnp.zeros(order), dist_reduce_fx="sum")
+        if return_sentence_level_score:
+            self.add_state("sentence_chrf_score", default=[], dist_reduce_fx="cat")
+
+    def _host_batch_state(self, preds, target):
+        p_char, p_word, t_char, t_word, m_char, m_word, sentence_scores = _chrf_score_update(
+            preds, target, self.n_char_order, self.n_word_order, self.beta, self.lowercase, self.whitespace
+        )
+        out = {
+            "total_preds_char_n_grams": jnp.asarray(p_char, jnp.float32),
+            "total_preds_word_n_grams": jnp.asarray(p_word, jnp.float32),
+            "total_target_char_n_grams": jnp.asarray(t_char, jnp.float32),
+            "total_target_word_n_grams": jnp.asarray(t_word, jnp.float32),
+            "total_matching_char_n_grams": jnp.asarray(m_char, jnp.float32),
+            "total_matching_word_n_grams": jnp.asarray(m_word, jnp.float32),
+        }
+        if self.return_sentence_level_score:
+            out["sentence_chrf_score"] = jnp.asarray(sentence_scores, jnp.float32)
+        return out
+
+    def _compute(self, state):
+        score = _chrf_score_compute(
+            state["total_preds_char_n_grams"], state["total_preds_word_n_grams"],
+            state["total_target_char_n_grams"], state["total_target_word_n_grams"],
+            state["total_matching_char_n_grams"], state["total_matching_word_n_grams"],
+            self.n_order, self.beta,
+        )
+        if self.return_sentence_level_score:
+            return score, jnp.asarray(state["sentence_chrf_score"])
+        return score
+
+
+class SQuAD(HostMetric):
+    """SQuAD EM/F1 (reference ``text/squad.py:35``)."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 100.0
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("f1_score", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("exact_match", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros((), jnp.int32), dist_reduce_fx="sum")
+
+    def _host_batch_state(self, preds, target):
+        preds_dict, target_dict = _squad_input_check(preds, target)
+        f1, exact_match, total = _squad_update(preds_dict, target_dict)
+        return {
+            "f1_score": jnp.asarray(f1, jnp.float32),
+            "exact_match": jnp.asarray(exact_match, jnp.float32),
+            "total": jnp.asarray(total, jnp.int32),
+        }
+
+    def _compute(self, state):
+        return _squad_compute(state["f1_score"], state["exact_match"], state["total"])
+
+
+class Perplexity(Metric):
+    """Perplexity (reference ``text/perplexity.py:29``) — jitted device update."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, ignore_index: Optional[int] = None, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if ignore_index is not None and not isinstance(ignore_index, int):
+            raise ValueError(f"Argument `ignore_index` expected to either be `None` or an `int` but got {ignore_index}")
+        self.ignore_index = ignore_index
+        self.add_state("total_log_probs", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("count", default=jnp.zeros(()), dist_reduce_fx="sum")
+
+    def _batch_state(self, preds, target):
+        total_log_probs, count = _perplexity_update(preds, target, self.ignore_index)
+        return {"total_log_probs": total_log_probs, "count": count.astype(jnp.float32)}
+
+    def _compute(self, state):
+        return _perplexity_compute(state["total_log_probs"], state["count"])
+
+
+class ROUGEScore(HostMetric):
+    """ROUGE-N/L/Lsum (reference ``text/rouge.py:37``): per-sentence cat rows per
+    rouge key and statistic."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self,
+        use_stemmer: bool = False,
+        normalizer: Optional[Callable[[str], str]] = None,
+        tokenizer: Optional[Callable[[str], Sequence[str]]] = None,
+        accumulate: str = "best",
+        rouge_keys: Union[str, Tuple[str, ...]] = ("rouge1", "rouge2", "rougeL", "rougeLsum"),
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if accumulate not in ALLOWED_ACCUMULATE_VALUES:
+            raise ValueError(
+                f"Got unknown accumulate value {accumulate}. Expected to be one of {ALLOWED_ACCUMULATE_VALUES}"
+            )
+        self.rouge_keys, self.rouge_keys_values = _resolve_rouge_keys(rouge_keys)
+        self.stemmer = _make_stemmer(use_stemmer)
+        self.normalizer = normalizer
+        self.tokenizer = tokenizer
+        self.accumulate = accumulate
+        for rouge_key in self.rouge_keys:
+            for score in ("fmeasure", "precision", "recall"):
+                self.add_state(f"{rouge_key}_{score}", default=[], dist_reduce_fx="cat")
+
+    def _host_batch_state(self, preds, target):
+        if isinstance(target, list) and all(isinstance(tgt, str) for tgt in target):
+            target = [target] if isinstance(preds, str) else [[tgt] for tgt in target]
+        if isinstance(preds, str):
+            preds = [preds]
+        if isinstance(target, str):
+            target = [[target]]
+        results = _rouge_score_update(
+            preds, target, self.rouge_keys_values, self.accumulate, self.stemmer, self.normalizer, self.tokenizer
+        )
+        out = {}
+        for rouge_key, key_value in zip(self.rouge_keys, self.rouge_keys_values):
+            for score in ("fmeasure", "precision", "recall"):
+                out[f"{rouge_key}_{score}"] = jnp.asarray(
+                    np.asarray([s[score] for s in results[key_value]], np.float32)
+                )
+        return out
+
+    def _compute(self, state):
+        return {
+            key: jnp.mean(jnp.asarray(state[key]))
+            for key in (f"{rk}_{sc}" for rk in self.rouge_keys for sc in ("fmeasure", "precision", "recall"))
+        }
+
+    def __hash__(self) -> int:
+        # normalizer/tokenizer callables are unhashable with the default state-based hash
+        hash_vals = [self.__class__.__name__, *(str(k) for k in self.rouge_keys)]
+        return hash(tuple(hash_vals))
+
+
+class TranslationEditRate(HostMetric):
+    """TER (reference ``text/ter.py:30``): two scalar sum states + optional
+    sentence-level cat rows."""
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(
+        self,
+        normalize: bool = False,
+        no_punctuation: bool = False,
+        lowercase: bool = True,
+        asian_support: bool = False,
+        return_sentence_level_score: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        from ..functional.text.ter import _TercomTokenizer
+
+        for name, val in (
+            ("normalize", normalize), ("no_punctuation", no_punctuation),
+            ("lowercase", lowercase), ("asian_support", asian_support),
+        ):
+            if not isinstance(val, bool):
+                raise ValueError(f"Expected argument `{name}` to be of type boolean but got {val}.")
+        self.tokenizer = _TercomTokenizer(normalize, no_punctuation, lowercase, asian_support)
+        self.return_sentence_level_score = return_sentence_level_score
+        self.add_state("total_num_edits", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total_tgt_len", jnp.zeros(()), dist_reduce_fx="sum")
+        if return_sentence_level_score:
+            self.add_state("sentence_ter", default=[], dist_reduce_fx="cat")
+
+    def _host_batch_state(self, preds, target):
+        from ..functional.text.ter import _ter_update
+
+        total_num_edits, total_tgt_length, sentence_ter = _ter_update(preds, target, self.tokenizer)
+        out = {
+            "total_num_edits": jnp.asarray(total_num_edits, jnp.float32),
+            "total_tgt_len": jnp.asarray(total_tgt_length, jnp.float32),
+        }
+        if self.return_sentence_level_score:
+            out["sentence_ter"] = jnp.asarray(sentence_ter, jnp.float32)
+        return out
+
+    def _compute(self, state):
+        from ..functional.text.ter import _ter_compute
+
+        score = _ter_compute(state["total_num_edits"], state["total_tgt_len"])
+        if self.return_sentence_level_score:
+            return score, jnp.asarray(state["sentence_ter"])
+        return score
+
+    def __hash__(self) -> int:
+        return hash((self.__class__.__name__, id(self)))
+
+
+class ExtendedEditDistance(HostMetric):
+    """EED (reference ``text/eed.py:29``): per-sentence cat rows."""
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self,
+        language: str = "en",
+        return_sentence_level_score: bool = False,
+        alpha: float = 2.0,
+        rho: float = 0.3,
+        deletion: float = 0.2,
+        insertion: float = 1.0,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if language not in ("en", "ja"):
+            raise ValueError(f"Expected argument `language` to either be `en` or `ja` but got {language}")
+        self.language = language
+        self.return_sentence_level_score = return_sentence_level_score
+        for name, val in (("alpha", alpha), ("rho", rho), ("deletion", deletion), ("insertion", insertion)):
+            if not isinstance(val, float) or val < 0:
+                raise ValueError(f"Parameter `{name}` is expected to be a non-negative float.")
+        self.alpha = alpha
+        self.rho = rho
+        self.deletion = deletion
+        self.insertion = insertion
+        self.add_state("sentence_eed", default=[], dist_reduce_fx="cat")
+
+    def _host_batch_state(self, preds, target):
+        from ..functional.text.eed import _eed_update
+
+        scores = _eed_update(preds, target, self.language, self.alpha, self.rho, self.deletion, self.insertion)
+        return {"sentence_eed": jnp.asarray(scores, jnp.float32)}
+
+    def _compute(self, state):
+        from ..functional.text.eed import _eed_compute
+
+        average = _eed_compute(state["sentence_eed"])
+        if self.return_sentence_level_score:
+            return average, jnp.asarray(state["sentence_eed"])
+        return average
