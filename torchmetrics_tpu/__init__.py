@@ -9,7 +9,7 @@ Every domain package declares its public classes in its own ``__all__``; the fla
 namespace aggregates them (reference ``__init__.py`` re-exports ~100 names the same
 way, hand-listed)."""
 
-from torchmetrics_tpu import classification, clustering, detection, functional, image, nominal, parallel, regression, retrieval, segmentation, shape, text, utilities, wrappers
+from torchmetrics_tpu import audio, classification, clustering, detection, functional, image, nominal, parallel, regression, retrieval, segmentation, shape, text, utilities, wrappers
 from torchmetrics_tpu.aggregation import (
     CatMetric,
     MaxMetric,
@@ -19,6 +19,7 @@ from torchmetrics_tpu.aggregation import (
     RunningSum,
     SumMetric,
 )
+from torchmetrics_tpu.audio import *  # noqa: F401,F403
 from torchmetrics_tpu.classification import *  # noqa: F401,F403
 from torchmetrics_tpu.clustering import *  # noqa: F401,F403
 from torchmetrics_tpu.detection import *  # noqa: F401,F403
@@ -66,6 +67,7 @@ __all__ = [
     "parallel",
     "regression",
     "retrieval",
+    "audio",
     "clustering",
     "detection",
     "image",
@@ -78,6 +80,7 @@ __all__ = [
     *classification.__all__,
     *regression.__all__,
     *retrieval.__all__,
+    *audio.__all__,
     *clustering.__all__,
     *detection.__all__,
     *image.__all__,

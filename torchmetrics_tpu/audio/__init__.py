@@ -1,0 +1,31 @@
+"""Audio tower — stateful metric classes (reference ``src/torchmetrics/audio/``)."""
+
+from .metrics import (
+    ComplexScaleInvariantSignalNoiseRatio,
+    DeepNoiseSuppressionMeanOpinionScore,
+    NonIntrusiveSpeechQualityAssessment,
+    PerceptualEvaluationSpeechQuality,
+    PermutationInvariantTraining,
+    ScaleInvariantSignalDistortionRatio,
+    ScaleInvariantSignalNoiseRatio,
+    ShortTimeObjectiveIntelligibility,
+    SignalDistortionRatio,
+    SignalNoiseRatio,
+    SourceAggregatedSignalDistortionRatio,
+    SpeechReverberationModulationEnergyRatio,
+)
+
+__all__ = [
+    "ComplexScaleInvariantSignalNoiseRatio",
+    "DeepNoiseSuppressionMeanOpinionScore",
+    "NonIntrusiveSpeechQualityAssessment",
+    "PerceptualEvaluationSpeechQuality",
+    "PermutationInvariantTraining",
+    "ScaleInvariantSignalDistortionRatio",
+    "ScaleInvariantSignalNoiseRatio",
+    "ShortTimeObjectiveIntelligibility",
+    "SignalDistortionRatio",
+    "SignalNoiseRatio",
+    "SourceAggregatedSignalDistortionRatio",
+    "SpeechReverberationModulationEnergyRatio",
+]

@@ -1,0 +1,331 @@
+"""Audio metric classes (reference ``src/torchmetrics/audio/*.py``).
+
+Every in-tree metric is a running mean over per-sample scores: two scalar sum states
+(one psum each to sync). The SDR compute and the third-party-backed metrics run their
+per-sample scores host-side (see ``functional/audio``), so those classes use the
+HostMetric shell; the pure-jnp ones use the jitted path.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax.numpy as jnp
+
+from ..functional.audio.external import (
+    deep_noise_suppression_mean_opinion_score,
+    non_intrusive_speech_quality_assessment,
+    perceptual_evaluation_speech_quality,
+    short_time_objective_intelligibility,
+    speech_reverberation_modulation_energy_ratio,
+)
+from ..functional.audio.pit import permutation_invariant_training
+from ..functional.audio.sdr import (
+    scale_invariant_signal_distortion_ratio,
+    signal_distortion_ratio,
+    source_aggregated_signal_distortion_ratio,
+)
+from ..functional.audio.snr import (
+    complex_scale_invariant_signal_noise_ratio,
+    scale_invariant_signal_noise_ratio,
+    signal_noise_ratio,
+)
+from ..metric import HostMetric, Metric
+
+
+class _MeanAudioMetric(Metric):
+    """Running mean of a per-sample jnp audio score."""
+
+    full_state_update = False
+    is_differentiable = True
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("score_sum", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.zeros((), jnp.int32), dist_reduce_fx="sum")
+
+    def _score(self, preds, target) -> jnp.ndarray:
+        raise NotImplementedError
+
+    def _batch_state(self, preds, target):
+        score = self._score(preds, target)
+        return {"score_sum": score.sum(), "total": jnp.asarray(score.size, jnp.int32)}
+
+    def _compute(self, state):
+        return state["score_sum"] / state["total"]
+
+
+class SignalNoiseRatio(_MeanAudioMetric):
+    """SNR (reference ``audio/snr.py:36``)."""
+
+    higher_is_better = True
+
+    def __init__(self, zero_mean: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.zero_mean = zero_mean
+
+    def _score(self, preds, target):
+        return signal_noise_ratio(preds=preds, target=target, zero_mean=self.zero_mean)
+
+
+class ScaleInvariantSignalNoiseRatio(_MeanAudioMetric):
+    """SI-SNR (reference ``audio/snr.py:146``)."""
+
+    higher_is_better = True
+
+    def _score(self, preds, target):
+        return scale_invariant_signal_noise_ratio(preds=preds, target=target)
+
+
+class ComplexScaleInvariantSignalNoiseRatio(_MeanAudioMetric):
+    """C-SI-SNR (reference ``audio/snr.py:245``)."""
+
+    higher_is_better = True
+
+    def __init__(self, zero_mean: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(zero_mean, bool):
+            raise ValueError(f"Expected argument `zero_mean` to be a bool, but got {zero_mean}")
+        self.zero_mean = zero_mean
+
+    def _score(self, preds, target):
+        return complex_scale_invariant_signal_noise_ratio(preds=preds, target=target, zero_mean=self.zero_mean)
+
+
+class ScaleInvariantSignalDistortionRatio(_MeanAudioMetric):
+    """SI-SDR (reference ``audio/sdr.py:173``)."""
+
+    higher_is_better = True
+
+    def __init__(self, zero_mean: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.zero_mean = zero_mean
+
+    def _score(self, preds, target):
+        return scale_invariant_signal_distortion_ratio(preds=preds, target=target, zero_mean=self.zero_mean)
+
+
+class SourceAggregatedSignalDistortionRatio(_MeanAudioMetric):
+    """SA-SDR (reference ``audio/sdr.py:282``)."""
+
+    higher_is_better = True
+
+    def __init__(self, scale_invariant: bool = True, zero_mean: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(scale_invariant, bool):
+            raise ValueError(f"Expected argument `scale_invariant` to be a bool, but got {scale_invariant}")
+        if not isinstance(zero_mean, bool):
+            raise ValueError(f"Expected argument `zero_mean` to be a bool, but got {zero_mean}")
+        self.scale_invariant = scale_invariant
+        self.zero_mean = zero_mean
+
+    def _score(self, preds, target):
+        return source_aggregated_signal_distortion_ratio(
+            preds=preds, target=target, scale_invariant=self.scale_invariant, zero_mean=self.zero_mean
+        )
+
+
+class _HostMeanAudioMetric(HostMetric):
+    """Running mean of a per-sample host-computed audio score."""
+
+    full_state_update = False
+    is_differentiable = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("score_sum", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.zeros((), jnp.int32), dist_reduce_fx="sum")
+
+    def _score(self, preds, target) -> jnp.ndarray:
+        raise NotImplementedError
+
+    def _host_batch_state(self, preds, target):
+        score = self._score(preds, target)
+        return {"score_sum": score.sum(), "total": jnp.asarray(score.size, jnp.int32)}
+
+    def _compute(self, state):
+        return state["score_sum"] / state["total"]
+
+
+class SignalDistortionRatio(_HostMeanAudioMetric):
+    """SDR (reference ``audio/sdr.py:38``) — per-sample Toeplitz solve on host."""
+
+    higher_is_better = True
+
+    def __init__(
+        self,
+        use_cg_iter: Optional[int] = None,
+        filter_length: int = 512,
+        zero_mean: bool = False,
+        load_diag: Optional[float] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.use_cg_iter = use_cg_iter
+        self.filter_length = filter_length
+        self.zero_mean = zero_mean
+        self.load_diag = load_diag
+
+    def _score(self, preds, target):
+        return signal_distortion_ratio(
+            preds, target, self.use_cg_iter, self.filter_length, self.zero_mean, self.load_diag
+        )
+
+
+class PermutationInvariantTraining(_HostMeanAudioMetric):
+    """PIT (reference ``audio/pit.py:31``): mean of the best-permutation metric.
+
+    Host-side update: the >3-speaker branch solves assignment with scipy, and user
+    ``metric_func`` callables are not guaranteed jittable."""
+
+    higher_is_better = True
+
+    def __init__(
+        self,
+        metric_func: Callable,
+        mode: str = "speaker-wise",
+        eval_func: str = "max",
+        **kwargs: Any,
+    ) -> None:
+        base_kwargs = {
+            k: kwargs.pop(k)
+            for k in list(kwargs)
+            if k
+            in (
+                "compute_on_cpu", "dist_sync_on_step", "process_group", "dist_sync_fn",
+                "distributed_available_fn", "sync_on_compute", "compute_with_cache", "jit",
+            )
+        }
+        super().__init__(**base_kwargs)
+        if eval_func not in ("max", "min"):
+            raise ValueError(f'eval_func can only be "max" or "min" but got {eval_func}')
+        if mode not in ("speaker-wise", "permutation-wise"):
+            raise ValueError(f'mode can only be "speaker-wise" or "permutation-wise" but got {mode}')
+        self.metric_func = metric_func
+        self.mode = mode
+        self.eval_func = eval_func
+        self.kwargs = kwargs
+
+    def _score(self, preds, target):
+        best_metric, _ = permutation_invariant_training(
+            preds, target, self.metric_func, self.mode, self.eval_func, **self.kwargs
+        )
+        return best_metric
+
+    def __hash__(self) -> int:
+        return hash((self.__class__.__name__, id(self)))
+
+
+class PerceptualEvaluationSpeechQuality(_HostMeanAudioMetric):
+    """PESQ (reference ``audio/pesq.py:30``) — host callback into the pesq wheel."""
+
+    higher_is_better = True
+    plot_lower_bound = -0.5
+    plot_upper_bound = 4.5
+
+    def __init__(
+        self, fs: int, mode: str, n_processes: int = 1, **kwargs: Any
+    ) -> None:
+        super().__init__(**kwargs)
+        from ..functional.audio.external import _PESQ_AVAILABLE
+
+        if not _PESQ_AVAILABLE:
+            raise ModuleNotFoundError(
+                "PESQ metric requires that pesq is installed."
+                " Either install as `pip install torchmetrics[audio]` or `pip install pesq`."
+            )
+        if fs not in (8000, 16000):
+            raise ValueError(f"Expected argument `fs` to either be 8000 or 16000 but got {fs}")
+        if mode not in ("wb", "nb"):
+            raise ValueError(f"Expected argument `mode` to either be 'wb' or 'nb' but got {mode}")
+        self.fs = fs
+        self.mode = mode
+        self.n_processes = n_processes
+
+    def _score(self, preds, target):
+        return perceptual_evaluation_speech_quality(preds, target, self.fs, self.mode, n_processes=self.n_processes)
+
+
+class ShortTimeObjectiveIntelligibility(_HostMeanAudioMetric):
+    """STOI (reference ``audio/stoi.py:30``) — host callback into pystoi."""
+
+    higher_is_better = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(self, fs: int, extended: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        from ..functional.audio.external import _PYSTOI_AVAILABLE
+
+        if not _PYSTOI_AVAILABLE:
+            raise ModuleNotFoundError(
+                "STOI metric requires that `pystoi` is installed."
+                " Either install as `pip install torchmetrics[audio]` or `pip install pystoi`."
+            )
+        self.fs = fs
+        self.extended = extended
+
+    def _score(self, preds, target):
+        return short_time_objective_intelligibility(preds, target, self.fs, self.extended)
+
+
+class SpeechReverberationModulationEnergyRatio(_HostMeanAudioMetric):
+    """SRMR (reference ``audio/srmr.py:37``) — needs gammatone + torchaudio wheels."""
+
+    higher_is_better = True
+
+    def __init__(self, fs: int, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        from ..functional.audio.external import _GAMMATONE_AVAILABLE, _TORCHAUDIO_AVAILABLE
+
+        if not (_GAMMATONE_AVAILABLE and _TORCHAUDIO_AVAILABLE):
+            raise ModuleNotFoundError(
+                "speech_reverberation_modulation_energy_ratio requires that gammatone and torchaudio are installed."
+                " Either install as `pip install torchmetrics[audio]` or "
+                "`pip install torchaudio` and `pip install git+https://github.com/detly/gammatone`."
+            )
+        self.fs = fs
+
+    def _score(self, preds, target=None):
+        return speech_reverberation_modulation_energy_ratio(preds, self.fs)
+
+
+class DeepNoiseSuppressionMeanOpinionScore(_HostMeanAudioMetric):
+    """DNSMOS (reference ``audio/dnsmos.py:36``) — needs librosa + onnxruntime."""
+
+    higher_is_better = True
+
+    def __init__(self, fs: int, personalized: bool, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        from ..functional.audio.external import _LIBROSA_AVAILABLE, _ONNXRUNTIME_AVAILABLE, _REQUESTS_AVAILABLE
+
+        if not (_LIBROSA_AVAILABLE and _ONNXRUNTIME_AVAILABLE and _REQUESTS_AVAILABLE):
+            raise ModuleNotFoundError(
+                "DNSMOS metric requires that librosa, onnxruntime and requests are installed."
+                " Install as `pip install librosa onnxruntime-gpu requests`."
+            )
+        self.fs = fs
+        self.personalized = personalized
+
+    def _score(self, preds, target=None):
+        return deep_noise_suppression_mean_opinion_score(preds, self.fs, self.personalized)
+
+
+class NonIntrusiveSpeechQualityAssessment(_HostMeanAudioMetric):
+    """NISQA (reference ``audio/nisqa.py:35``) — needs librosa + model download."""
+
+    higher_is_better = True
+
+    def __init__(self, fs: int, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        from ..functional.audio.external import _LIBROSA_AVAILABLE, _REQUESTS_AVAILABLE
+
+        if not (_LIBROSA_AVAILABLE and _REQUESTS_AVAILABLE):
+            raise ModuleNotFoundError(
+                "NISQA metric requires that librosa and requests are installed."
+                " Install as `pip install librosa requests`."
+            )
+        self.fs = fs
+
+    def _score(self, preds, target=None):
+        return non_intrusive_speech_quality_assessment(preds, self.fs)

@@ -5,7 +5,8 @@ Every domain package declares its public surface in its own ``__all__``; this mo
 aggregates them so the flat ``torchmetrics_tpu.functional.<fn>`` namespace stays in
 lock-step with the per-domain namespaces as domains are added."""
 
-from torchmetrics_tpu.functional import classification, clustering, detection, image, nominal, pairwise, regression, retrieval, segmentation, shape, text
+from torchmetrics_tpu.functional import audio, classification, clustering, detection, image, nominal, pairwise, regression, retrieval, segmentation, shape, text
+from torchmetrics_tpu.functional.audio import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.classification import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.regression import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.retrieval import *  # noqa: F401,F403
@@ -22,6 +23,7 @@ __all__ = [
     *classification.__all__,
     *regression.__all__,
     *retrieval.__all__,
+    *audio.__all__,
     *clustering.__all__,
     *detection.__all__,
     *image.__all__,

@@ -1,0 +1,127 @@
+"""Host-callback audio metrics backed by third-party native code: PESQ, STOI, SRMR,
+DNSMOS, NISQA (reference ``functional/audio/{pesq,stoi,srmr,dnsmos,nisqa}.py``).
+
+The reference itself runs these on CPU numpy via optional wheels (its PESQ moves
+tensors to cpu and calls the ``pesq`` C extension — ``functional/audio/pesq.py:101-105``);
+the same escape hatch applies here. When the wheel is absent the functions raise the
+same clear ModuleNotFoundError the reference does.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...utilities.imports import _module_available
+
+_PESQ_AVAILABLE = _module_available("pesq")
+_PYSTOI_AVAILABLE = _module_available("pystoi")
+_GAMMATONE_AVAILABLE = _module_available("gammatone")
+_TORCHAUDIO_AVAILABLE = _module_available("torchaudio")
+_LIBROSA_AVAILABLE = _module_available("librosa")
+_ONNXRUNTIME_AVAILABLE = _module_available("onnxruntime")
+_REQUESTS_AVAILABLE = _module_available("requests")
+
+
+def perceptual_evaluation_speech_quality(
+    preds,
+    target,
+    fs: int,
+    mode: str,
+    keep_same_device: bool = False,
+    n_processes: int = 1,
+) -> jnp.ndarray:
+    """PESQ via the ``pesq`` C extension on host numpy (ITU-T P.862)."""
+    if not _PESQ_AVAILABLE:
+        raise ModuleNotFoundError(
+            "PESQ metric requires that pesq is installed."
+            " Either install as `pip install torchmetrics[audio]` or `pip install pesq`."
+        )
+    if fs not in (8000, 16000):
+        raise ValueError(f"Expected argument `fs` to either be 8000 or 16000 but got {fs}")
+    if mode not in ("wb", "nb"):
+        raise ValueError(f"Expected argument `mode` to either be 'wb' or 'nb' but got {mode}")
+    import pesq as pesq_backend
+
+    from ...utilities.checks import _check_same_shape
+
+    preds_np = np.asarray(preds, np.float32)
+    target_np = np.asarray(target, np.float32)
+    _check_same_shape(preds_np, target_np)
+    if preds_np.ndim == 1:
+        scores = np.asarray(pesq_backend.pesq(fs, target_np, preds_np, mode))
+    else:
+        flat_p = preds_np.reshape(-1, preds_np.shape[-1])
+        flat_t = target_np.reshape(-1, target_np.shape[-1])
+        # flat 1-D batch of scores, like the reference (functional/audio/pesq.py)
+        scores = np.asarray([pesq_backend.pesq(fs, t, p, mode) for p, t in zip(flat_p, flat_t)])
+    return jnp.asarray(scores, jnp.float32)
+
+
+def short_time_objective_intelligibility(preds, target, fs: int, extended: bool = False) -> jnp.ndarray:
+    """STOI via ``pystoi`` on host numpy."""
+    if not _PYSTOI_AVAILABLE:
+        raise ModuleNotFoundError(
+            "ShortTimeObjectiveIntelligibility metric requires that `pystoi` is installed."
+            " Either install as `pip install torchmetrics[audio]` or `pip install pystoi`."
+        )
+    from pystoi import stoi as stoi_backend
+
+    from ...utilities.checks import _check_same_shape
+
+    preds_np = np.asarray(preds, np.float32)
+    target_np = np.asarray(target, np.float32)
+    _check_same_shape(preds_np, target_np)
+    if preds_np.ndim == 1:
+        scores = np.asarray(stoi_backend(target_np, preds_np, fs, extended))
+    else:
+        flat_p = preds_np.reshape(-1, preds_np.shape[-1])
+        flat_t = target_np.reshape(-1, target_np.shape[-1])
+        scores = np.asarray(
+            [stoi_backend(t, p, fs, extended) for p, t in zip(flat_p, flat_t)]
+        ).reshape(preds_np.shape[:-1])
+    return jnp.asarray(scores, jnp.float32)
+
+
+def speech_reverberation_modulation_energy_ratio(preds, fs: int, **kwargs: Any) -> jnp.ndarray:
+    """SRMR — requires the optional ``gammatone`` + ``torchaudio`` wheels."""
+    if not (_GAMMATONE_AVAILABLE and _TORCHAUDIO_AVAILABLE):
+        raise ModuleNotFoundError(
+            "speech_reverberation_modulation_energy_ratio requires that gammatone and torchaudio are installed."
+            " Either install as `pip install torchmetrics[audio]` or "
+            "`pip install torchaudio` and `pip install git+https://github.com/detly/gammatone`."
+        )
+    raise NotImplementedError(
+        "SRMR is recognized but its gammatone-filterbank pipeline is not yet ported; "
+        "the wheels alone do not enable it. Track SURVEY.md §2.8 for the host-callback port."
+    )
+
+
+def deep_noise_suppression_mean_opinion_score(
+    preds, fs: int, personalized: bool, device: Optional[str] = None, num_threads: Optional[int] = None
+) -> jnp.ndarray:
+    """DNSMOS — requires ``librosa`` + ``onnxruntime`` + downloaded ONNX models."""
+    if not (_LIBROSA_AVAILABLE and _ONNXRUNTIME_AVAILABLE and _REQUESTS_AVAILABLE):
+        raise ModuleNotFoundError(
+            "DNSMOS metric requires that librosa, onnxruntime and requests are installed."
+            " Install as `pip install librosa onnxruntime-gpu requests`."
+        )
+    raise NotImplementedError(
+        "DNSMOS is recognized but its ONNX-model inference pipeline is not yet ported; "
+        "the wheels alone do not enable it (the models also require a download)."
+    )
+
+
+def non_intrusive_speech_quality_assessment(preds, fs: int) -> jnp.ndarray:
+    """NISQA — requires ``librosa`` + ``requests`` and the downloaded model weights."""
+    if not (_LIBROSA_AVAILABLE and _REQUESTS_AVAILABLE):
+        raise ModuleNotFoundError(
+            "NISQA metric requires that librosa and requests are installed."
+            " Install as `pip install librosa requests`."
+        )
+    raise NotImplementedError(
+        "NISQA is recognized but its model pipeline is not yet ported; the wheels alone "
+        "do not enable it (the weights also require a download)."
+    )

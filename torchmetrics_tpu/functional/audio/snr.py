@@ -1,0 +1,45 @@
+"""SNR family (reference ``functional/audio/snr.py``) — fully jittable."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...utilities.checks import _check_same_shape
+from .sdr import scale_invariant_signal_distortion_ratio
+
+
+def signal_noise_ratio(preds, target, zero_mean: bool = False) -> jnp.ndarray:
+    """SNR in dB: target power over residual power, per sample over the time axis."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    _check_same_shape(preds, target)
+    eps = jnp.finfo(preds.dtype).eps
+    if zero_mean:
+        target = target - jnp.mean(target, axis=-1, keepdims=True)
+        preds = preds - jnp.mean(preds, axis=-1, keepdims=True)
+    noise = target - preds
+    snr_value = (jnp.sum(target**2, axis=-1) + eps) / (jnp.sum(noise**2, axis=-1) + eps)
+    return 10 * jnp.log10(snr_value)
+
+
+def scale_invariant_signal_noise_ratio(preds, target) -> jnp.ndarray:
+    """SI-SNR: SI-SDR with zero-mean normalization."""
+    return scale_invariant_signal_distortion_ratio(preds=preds, target=target, zero_mean=True)
+
+
+def complex_scale_invariant_signal_noise_ratio(preds, target, zero_mean: bool = False) -> jnp.ndarray:
+    """C-SI-SNR over complex STFT inputs ``(..., freq, time, 2)`` (or complex dtype)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if jnp.iscomplexobj(preds):
+        preds = jnp.stack([preds.real, preds.imag], axis=-1)
+    if jnp.iscomplexobj(target):
+        target = jnp.stack([target.real, target.imag], axis=-1)
+    if (preds.ndim < 3 or preds.shape[-1] != 2) or (target.ndim < 3 or target.shape[-1] != 2):
+        raise RuntimeError(
+            "Predictions and targets are expected to have the shape (..., frequency, time, 2),"
+            f" but got {preds.shape} and {target.shape}."
+        )
+    preds = preds.reshape(*preds.shape[:-3], -1)
+    target = target.reshape(*target.shape[:-3], -1)
+    return scale_invariant_signal_distortion_ratio(preds=preds, target=target, zero_mean=zero_mean)

@@ -114,7 +114,7 @@ def _eed_update(
 
 def _eed_compute(sentence_level_scores) -> jnp.ndarray:
     arr = jnp.asarray(sentence_level_scores, jnp.float32)
-    return jnp.where(arr.size == 0, 0.0, arr.mean()) if arr.size else jnp.asarray(0.0, jnp.float32)
+    return arr.mean() if arr.size else jnp.asarray(0.0, jnp.float32)
 
 
 def extended_edit_distance(
