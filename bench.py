@@ -1,10 +1,19 @@
-"""Benchmark harness — BASELINE.json config #1: multiclass Accuracy update loop.
+"""Benchmark harness over the BASELINE.json configs.
 
-Measures stateful metric-update throughput (updates/sec/chip) of the jitted, donated
-update path on the available accelerator, against a reference-equivalent torch CPU loop
-(the reference library is pure torch ops; no CUDA in this image — see BASELINE.md).
+Primary line (driver contract) stays config #1 — multiclass Accuracy update
+throughput vs a reference-equivalent torch CPU loop — and the remaining configs ride
+in the same single JSON line under "extra":
 
-Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+  #2 fused MetricCollection([Accuracy, F1, AUROC, ConfusionMatrix]) on CIFAR-10-shaped
+     logits through MetricCollection.as_pure() (one XLA program per step)
+  #3 MeanAveragePrecision update throughput on synthetic COCO-shaped boxes + one
+     compute latency
+  #4 FID update throughput through the jitted in-tree InceptionV3 (random weights —
+     identical FLOPs to pretrained) at 299x299
+  sync: in-graph psum latency of the fused collection state over an 8-device CPU mesh
+
+Config #5 (BERTScore+CLIPScore) is reported as unavailable until the model-backed text
+tower lands. Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extra"}.
 """
 
 from __future__ import annotations
@@ -44,9 +53,8 @@ def bench_ours() -> float:
 
 
 def bench_torch_baseline() -> float:
-    """Reference-equivalent stateful loop in pure torch (CPU): argmax + one-hot
-    stat-score accumulation, mirroring reference
-    functional/classification/stat_scores.py multiclass update semantics."""
+    """Reference-equivalent stateful loop in pure torch (CPU): argmax + bincount
+    confusion accumulation, mirroring reference stat_scores update semantics."""
     import torch
 
     rng = np.random.default_rng(0)
@@ -78,6 +86,158 @@ def bench_torch_baseline() -> float:
     return ITERS / elapsed
 
 
+def bench_fused_collection() -> dict:
+    """Config #2: CIFAR-10-shaped logits through the fused PureCollection kernel."""
+    import jax
+    import jax.numpy as jnp
+
+    from torchmetrics_tpu import MetricCollection
+    from torchmetrics_tpu.classification import (
+        MulticlassAccuracy,
+        MulticlassAUROC,
+        MulticlassConfusionMatrix,
+        MulticlassF1Score,
+    )
+
+    num_classes = 10
+    batch = 10000  # CIFAR-10 test-set sized eval chunks
+    rng = np.random.default_rng(1)
+    logits = jnp.asarray(rng.normal(size=(batch, num_classes)).astype(np.float32))
+    probs = jax.nn.softmax(logits)
+    target = jnp.asarray(rng.integers(0, num_classes, batch, dtype=np.int32))
+
+    collection = MetricCollection({
+        "acc": MulticlassAccuracy(num_classes, average="micro", validate_args=False),
+        "f1": MulticlassF1Score(num_classes, average="macro", validate_args=False),
+        "auroc": MulticlassAUROC(num_classes, thresholds=200, validate_args=False),
+        "confmat": MulticlassConfusionMatrix(num_classes, validate_args=False),
+    })
+    pure = collection.as_pure()
+    step = jax.jit(pure.update, donate_argnums=0)
+    states = pure.init()
+    for _ in range(WARMUP):
+        states = step(states, probs, target)
+    jax.block_until_ready(states)
+    start = time.perf_counter()
+    for _ in range(ITERS):
+        states = step(states, probs, target)
+    jax.block_until_ready(states)
+    elapsed = time.perf_counter() - start
+    values = jax.jit(pure.compute)(states)
+    jax.block_until_ready(values)
+    return {"updates_per_sec": round(ITERS / elapsed, 2), "unit": f"fused 4-metric updates/s (batch={batch}, C=10)"}
+
+
+def bench_map() -> dict:
+    """Config #3: mAP on synthetic COCO-shaped detections (100 imgs/update)."""
+    import jax
+
+    from torchmetrics_tpu.detection import MeanAveragePrecision
+
+    rng = np.random.default_rng(2)
+
+    def make_batch(n_imgs=100):
+        preds, target = [], []
+        for _ in range(n_imgs):
+            nd, ng = int(rng.integers(5, 30)), int(rng.integers(3, 20))
+            xy = rng.uniform(0, 400, (nd, 2))
+            wh = rng.uniform(20, 200, (nd, 2))
+            preds.append({
+                "boxes": np.concatenate([xy, xy + wh], -1).astype(np.float32),
+                "scores": rng.uniform(0, 1, nd).astype(np.float32),
+                "labels": rng.integers(0, 80, nd).astype(np.int32),
+            })
+            xy = rng.uniform(0, 400, (ng, 2))
+            wh = rng.uniform(20, 200, (ng, 2))
+            target.append({
+                "boxes": np.concatenate([xy, xy + wh], -1).astype(np.float32),
+                "labels": rng.integers(0, 80, ng).astype(np.int32),
+            })
+        return preds, target
+
+    metric = MeanAveragePrecision()
+    batches = [make_batch() for _ in range(4)]
+    metric.update(*batches[0])
+    start = time.perf_counter()
+    for preds, target in batches:
+        metric.update(preds, target)
+    update_elapsed = time.perf_counter() - start
+    start = time.perf_counter()
+    out = metric.compute()
+    jax.block_until_ready(out["map"])
+    compute_elapsed = time.perf_counter() - start
+    n_imgs = 4 * 100
+    return {
+        "images_per_sec_update": round(n_imgs / update_elapsed, 2),
+        "compute_sec_500imgs_80cls": round(compute_elapsed, 3),
+    }
+
+
+def bench_fid() -> dict:
+    """Config #4: FID update throughput through the jitted InceptionV3 (random
+    weights — same FLOPs as pretrained) on 299x299 batches of 32."""
+    import jax
+    import jax.numpy as jnp
+
+    from torchmetrics_tpu.image import FrechetInceptionDistance
+    from torchmetrics_tpu.image._extractors import InceptionV3Features
+
+    rng = np.random.default_rng(3)
+    imgs = jnp.asarray(rng.random((32, 3, 299, 299)).astype(np.float32))
+    fid = FrechetInceptionDistance(feature=InceptionV3Features(), normalize=True)
+    fid.update(imgs, real=True)
+    fid.update(imgs, real=False)
+    jax.block_until_ready(fid._state)
+    iters = 10
+    start = time.perf_counter()
+    for i in range(iters):
+        fid.update(imgs, real=bool(i % 2))
+    jax.block_until_ready(fid._state)
+    elapsed = time.perf_counter() - start
+    return {"images_per_sec": round(iters * 32 / elapsed, 2), "unit": "InceptionV3-2048 fwd+stats images/s (299x299)"}
+
+
+def bench_sync_latency() -> dict:
+    """In-graph psum of the fused collection state over an 8-device CPU mesh."""
+    import subprocess
+    import sys
+
+    code = r"""
+import os, time, json
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+import numpy as np, jax, jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+from torchmetrics_tpu import MetricCollection
+from torchmetrics_tpu.classification import MulticlassAccuracy, MulticlassAUROC, MulticlassConfusionMatrix, MulticlassF1Score
+num_classes = 10
+collection = MetricCollection({
+    "acc": MulticlassAccuracy(num_classes, average="micro", validate_args=False),
+    "f1": MulticlassF1Score(num_classes, average="macro", validate_args=False),
+    "auroc": MulticlassAUROC(num_classes, thresholds=200, validate_args=False),
+    "confmat": MulticlassConfusionMatrix(num_classes, validate_args=False),
+})
+pure = collection.as_pure()
+mesh = jax.sharding.Mesh(np.array(jax.devices()[:8]), ("data",))
+states = pure.init()
+reduce_fn = jax.jit(shard_map(lambda s: pure.reduce(s, "data"), mesh=mesh,
+                              in_specs=(P(),), out_specs=P(), check_rep=False))
+out = reduce_fn(states); jax.block_until_ready(out)
+start = time.perf_counter()
+for _ in range(50):
+    out = reduce_fn(states)
+jax.block_until_ready(out)
+print(json.dumps({"psum_latency_ms": round((time.perf_counter() - start) / 50 * 1000, 3)}))
+"""
+    try:
+        res = subprocess.run([sys.executable, "-c", code], capture_output=True, text=True, timeout=600)
+        return json.loads(res.stdout.strip().splitlines()[-1])
+    except Exception as err:
+        return {"psum_latency_ms": None, "error": str(err)[:120]}
+
+
 def main() -> None:
     ours = bench_ours()
     try:
@@ -85,6 +245,20 @@ def main() -> None:
     except Exception:
         baseline = float("nan")
     vs = ours / baseline if baseline == baseline and baseline > 0 else float("nan")
+
+    extra = {}
+    for name, fn in (
+        ("fused_collection_cifar10", bench_fused_collection),
+        ("coco_map_synthetic", bench_map),
+        ("fid_inception_fwd", bench_fid),
+        ("sync_allreduce_8dev_cpu", bench_sync_latency),
+    ):
+        try:
+            extra[name] = fn()
+        except Exception as err:  # keep the primary line alive whatever happens
+            extra[name] = {"error": str(err)[:120]}
+    extra["bertscore_clipscore"] = {"status": "unavailable: model-backed text tower pending"}
+
     print(
         json.dumps(
             {
@@ -92,6 +266,7 @@ def main() -> None:
                 "value": round(ours, 2),
                 "unit": "updates/s (batch=65536, C=5)",
                 "vs_baseline": round(vs, 3) if vs == vs else None,
+                "extra": extra,
             }
         )
     )

@@ -363,3 +363,67 @@ class MetricCollection:
         if together:
             return [plot_single_or_multi_val(val, ax=ax)]
         return [plot_single_or_multi_val({k: v}, ax=ax) for k, v in val.items()]
+
+    # ------------------------------------------------------------- fused pure API
+
+    def as_pure(self) -> "PureCollection":
+        """One jittable program over the whole collection (SURVEY §7: compute groups
+        as the *default fused path*).
+
+        Returns a :class:`PureCollection` of pure functions —
+        ``init() -> states``, ``update(states, *batch) -> states``,
+        ``compute(states) -> values``, ``apply(states, *batch) -> (states, values)`` —
+        each one XLA program when jitted. No group bookkeeping is needed: metrics with
+        identical sufficient statistics (Accuracy/F1/... sharing tp/fp/tn/fn) collapse
+        by common-subexpression elimination inside the fused jit, which is the
+        compiler-backed version of the reference's compute groups
+        (reference collections.py:269-303 maintains them by hand).
+
+        Only tensor-state metrics participate (concat states are host-side by design);
+        a metric with list states raises ``TorchMetricsUserError`` at trace time.
+        """
+        return PureCollection(self)
+
+
+class PureCollection:
+    """Pure functional view of a :class:`MetricCollection` (see ``as_pure``)."""
+
+    def __init__(self, collection: MetricCollection) -> None:
+        self._metrics = OrderedDict(collection.items(keep_base=True))
+        self._set_name = collection._set_name
+
+    def init(self) -> Dict[str, Any]:
+        """Fresh default states, keyed by metric name."""
+        return {name: m.init_state() for name, m in self._metrics.items()}
+
+    def update(self, states: Dict[str, Any], *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        """Fold one batch into every metric's state (pure, jittable)."""
+        return {
+            name: m.update_state(states[name], *args, **m._filter_kwargs(**kwargs))
+            for name, m in self._metrics.items()
+        }
+
+    def compute(self, states: Dict[str, Any]) -> Dict[str, Any]:
+        """Values for every metric from its state (pure, jittable). Key naming follows
+        the stateful path's ``_flatten_res`` (bare sub-keys unless they collide)."""
+        res = {name: m.compute_state(states[name]) for name, m in self._metrics.items()}
+        _, duplicates = _flatten_dict(res)
+        out: Dict[str, Any] = {}
+        for name, value in res.items():
+            if isinstance(value, dict):
+                for sub_k, sub_v in value.items():
+                    key = f"{name}_{sub_k}" if duplicates else sub_k
+                    out[self._set_name(key)] = sub_v
+            else:
+                out[self._set_name(name)] = value
+        return out
+
+    def apply(self, states: Dict[str, Any], *args: Any, **kwargs: Any) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+        """Fused eval step: update all states AND emit current values (pure)."""
+        new_states = self.update(states, *args, **kwargs)
+        return new_states, self.compute(new_states)
+
+    def reduce(self, states: Dict[str, Any], axis_name: Any) -> Dict[str, Any]:
+        """Cross-device reduction of every state inside ``shard_map`` (one collective
+        per leaf)."""
+        return {name: m.reduce_state(states[name], axis_name) for name, m in self._metrics.items()}

@@ -38,7 +38,8 @@ def _reduce_auroc(fpr, tpr, average: Optional[str] = "macro", weights=None, dire
         res = jnp.stack([_auc_compute(x, y, direction=direction) for x, y in zip(fpr, tpr)])
     if average is None or average == "none":
         return res
-    if bool(jnp.isnan(res).any()):
+    if not isinstance(res, jax.core.Tracer) and bool(jnp.isnan(res).any()):
+        # host-only advisory; the masked reduction below is jit-safe either way
         rank_zero_warn(
             f"Average precision score for one or more classes was `nan`. Ignoring these classes in {average}-average",
             UserWarning,

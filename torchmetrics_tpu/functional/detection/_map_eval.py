@@ -29,13 +29,13 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from ._box_ops import box_iou_matrix_crowd
 
 # COCO area ranges: all / small / medium / large (reference _mean_ap.py:351-356)
 _AREA_RANGES = np.array(
     [[0.0, 1e5**2], [0.0, 32.0**2], [32.0**2, 96.0**2], [96.0**2, 1e5**2]], np.float32
 )
 _AREA_KEYS = ("all", "small", "medium", "large")
+_ROW_BLOCK = 4096  # matcher rows per XLA call (memory/compile trade-off)
 
 
 def mask_iou_matrix(dets: jnp.ndarray, gts: jnp.ndarray, crowd: jnp.ndarray) -> jnp.ndarray:
@@ -48,6 +48,22 @@ def mask_iou_matrix(dets: jnp.ndarray, gts: jnp.ndarray, crowd: jnp.ndarray) -> 
     union = d_area + g.sum(-1)[None, :] - inter
     denom = jnp.where(crowd[None, :], d_area, union)
     return jnp.where(denom > 0, inter / jnp.where(denom > 0, denom, 1.0), 0.0)
+
+
+def _box_iou_np(det: np.ndarray, gt: np.ndarray, crowd: np.ndarray) -> np.ndarray:
+    """Host pairwise crowd-IoU for one (class, image) cell — small matrices, where a
+    per-cell device dispatch would dominate at COCO scale."""
+    det = det.astype(np.float64)
+    gt = gt.astype(np.float64)
+    lt = np.maximum(det[:, None, :2], gt[None, :, :2])
+    rb = np.minimum(det[:, None, 2:], gt[None, :, 2:])
+    wh = np.clip(rb - lt, 0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    det_area = ((det[:, 2] - det[:, 0]) * (det[:, 3] - det[:, 1]))[:, None]
+    gt_area = ((gt[:, 2] - gt[:, 0]) * (gt[:, 3] - gt[:, 1]))[None, :]
+    union = det_area + gt_area - inter
+    denom = np.where(crowd[None, :], det_area, union)
+    return np.where(denom > 0, inter / np.where(denom > 0, denom, 1.0), 0.0).astype(np.float32)
 
 
 def _bucket(n: int, floor: int = 4) -> int:
@@ -189,40 +205,61 @@ def evaluate_map(
     det_areas_all = [_det_area(inputs, i, iou_type) for i in range(inputs.num_images)]
     gt_areas_all = [_gt_area(inputs, i, iou_type) for i in range(inputs.num_images)]
 
+    # ---- flatten every (class, image) evaluation into ONE matcher batch: matching is
+    # independent per pair, so classes ride the same vmapped leading axis — one XLA
+    # compile per padded bucket instead of one per class
+    rows: List[Tuple[int, int, np.ndarray, np.ndarray]] = []  # (k_idx, img, d_sel, g_sel)
+    class_rows: List[List[int]] = [[] for _ in classes]
     for k_idx, cls in enumerate(classes):
-        # ---- gather per-image class-filtered, score-sorted, maxDet-truncated views
-        per_img = []
         for i in range(inputs.num_images):
             d_sel = np.where(inputs.det_labels[i] == cls)[0]
             g_sel = np.where(inputs.gt_labels[i] == cls)[0]
             if d_sel.size == 0 and g_sel.size == 0:
                 continue
             order = np.argsort(-inputs.det_scores[i][d_sel], kind="mergesort")[:max_det]
-            per_img.append((i, d_sel[order], g_sel))
-        if not per_img:
-            continue
+            class_rows[k_idx].append(len(rows))
+            rows.append((k_idx, i, d_sel[order], g_sel))
+    if not rows:
+        return {
+            "precision": precision, "recall": recall, "scores": scores_out,
+            "classes": np.asarray(classes, np.int32), **({"ious": ious_out} if want_ious else {}),
+        }
 
-        num_i = len(per_img)
-        dmax = _bucket(max((p[1].size for p in per_img), default=1) or 1)
-        gmax = _bucket(max((p[2].size for p in per_img), default=1) or 1)
-        ib = _bucket(num_i)
+    num_rows = len(rows)
+    dmax = _bucket(max((r[2].size for r in rows), default=1) or 1)
+    gmax = _bucket(max((r[3].size for r in rows), default=1) or 1)
 
-        iou_b = np.zeros((ib, dmax, gmax), np.float32)
-        det_valid = np.zeros((ib, dmax), bool)
-        det_area = np.zeros((ib, dmax), np.float32)
-        det_score = np.full((ib, dmax), -np.inf, np.float32)
-        gt_valid = np.zeros((ib, gmax), bool)
-        gt_area = np.zeros((ib, gmax), np.float32)
-        gt_crowd = np.zeros((ib, gmax), bool)
+    # process the row batch in fixed-size blocks: one compile per (block, dmax, gmax)
+    # bucket while bounding peak memory (a COCO-scale eval would otherwise stage a
+    # multi-GB (rows, dmax, gmax) IoU tensor at once)
+    dm_all = np.zeros((num_rows, len(_AREA_RANGES), num_t, dmax), bool)
+    dig_all = np.zeros_like(dm_all)
+    gt_ign_all = np.zeros((num_rows, len(_AREA_RANGES), gmax), bool)
+    det_valid = np.zeros((num_rows, dmax), bool)
+    det_score_b = np.full((num_rows, dmax), -np.inf, np.float32)
+    gt_valid_b = np.zeros((num_rows, gmax), bool)
 
-        for row, (i, d_sel, g_sel) in enumerate(per_img):
+    for block_start in range(0, num_rows, _ROW_BLOCK):
+        block = rows[block_start : block_start + _ROW_BLOCK]
+        rb = _ROW_BLOCK if num_rows > _ROW_BLOCK else _bucket(len(block))
+        iou_b = np.zeros((rb, dmax, gmax), np.float32)
+        bdet_valid = np.zeros((rb, dmax), bool)
+        bdet_area = np.zeros((rb, dmax), np.float32)
+        bgt_valid = np.zeros((rb, gmax), bool)
+        bgt_area = np.zeros((rb, gmax), np.float32)
+        bgt_crowd = np.zeros((rb, gmax), bool)
+
+        for off, (k_idx, i, d_sel, g_sel) in enumerate(block):
             nd, ng = d_sel.size, g_sel.size
+            row = block_start + off
+            bdet_valid[off, :nd] = True
             det_valid[row, :nd] = True
-            det_score[row, :nd] = inputs.det_scores[i][d_sel]
-            det_area[row, :nd] = det_areas_all[i][d_sel]
-            gt_valid[row, :ng] = True
-            gt_area[row, :ng] = gt_areas_all[i][g_sel]
-            gt_crowd[row, :ng] = inputs.gt_crowds[i][g_sel].astype(bool)
+            det_score_b[row, :nd] = inputs.det_scores[i][d_sel]
+            bdet_area[off, :nd] = det_areas_all[i][d_sel]
+            bgt_valid[off, :ng] = True
+            gt_valid_b[row, :ng] = True
+            bgt_area[off, :ng] = gt_areas_all[i][g_sel]
+            bgt_crowd[off, :ng] = inputs.gt_crowds[i][g_sel].astype(bool)
             if nd and ng:
                 if iou_type == "segm":
                     mat = np.asarray(
@@ -233,35 +270,39 @@ def evaluate_map(
                         )
                     )
                 else:
-                    mat = np.asarray(
-                        box_iou_matrix_crowd(
-                            jnp.asarray(inputs.det_boxes[i][d_sel], jnp.float32),
-                            jnp.asarray(inputs.gt_boxes[i][g_sel], jnp.float32),
-                            jnp.asarray(inputs.gt_crowds[i][g_sel].astype(bool)),
-                        )
-                    )
-                iou_b[row, :nd, :ng] = mat
+                    mat = _box_iou_np(inputs.det_boxes[i][d_sel], inputs.gt_boxes[i][g_sel],
+                                      inputs.gt_crowds[i][g_sel].astype(bool))
+                iou_b[off, :nd, :ng] = mat
                 if want_ious:
-                    ious_out[(i, cls)] = mat
+                    ious_out[(i, int(classes[k_idx]))] = mat
             elif want_ious:
-                ious_out[(i, cls)] = np.zeros((nd, ng), np.float32)
+                ious_out[(i, int(classes[k_idx]))] = np.zeros((nd, ng), np.float32)
 
-        dm, dig, gt_ign = _match_kernel(
+        dm_b, dig_b, gt_ign_b = _match_kernel(
             jnp.asarray(iou_b),
-            jnp.asarray(det_valid),
-            jnp.asarray(det_area),
-            jnp.asarray(gt_valid),
-            jnp.asarray(gt_area),
-            jnp.asarray(gt_crowd),
+            jnp.asarray(bdet_valid),
+            jnp.asarray(bdet_area),
+            jnp.asarray(bgt_valid),
+            jnp.asarray(bgt_area),
+            jnp.asarray(bgt_crowd),
             iou_thrs_j,
             area_ranges_j,
         )
-        dm = np.asarray(dm)[:num_i]
-        dig = np.asarray(dig)[:num_i]
-        gt_ign = np.asarray(gt_ign)[:num_i]
-        det_valid = det_valid[:num_i]
-        det_score = det_score[:num_i]
-        gt_valid_n = gt_valid[:num_i]
+        n = len(block)
+        dm_all[block_start : block_start + n] = np.asarray(dm_b)[:n]
+        dig_all[block_start : block_start + n] = np.asarray(dig_b)[:n]
+        gt_ign_all[block_start : block_start + n] = np.asarray(gt_ign_b)[:n]
+
+    for k_idx, cls in enumerate(classes):
+        sel_rows = class_rows[k_idx]
+        if not sel_rows:
+            continue
+        dm = dm_all[sel_rows]
+        dig = dig_all[sel_rows]
+        gt_ign = gt_ign_all[sel_rows]
+        det_valid_c = det_valid[sel_rows]
+        det_score = det_score_b[sel_rows]
+        gt_valid_n = gt_valid_b[sel_rows]
 
         # ---- accumulate (COCOeval.accumulate semantics)
         pos_in_img = np.broadcast_to(np.arange(dmax)[None, :], det_score.shape)
@@ -272,7 +313,7 @@ def evaluate_map(
             dm_a = np.ascontiguousarray(dm[:, a_idx, :, :].transpose(1, 0, 2).reshape(num_t, -1))
             dig_a = np.ascontiguousarray(dig[:, a_idx, :, :].transpose(1, 0, 2).reshape(num_t, -1))
             for m_idx, mdet in enumerate(max_detection_thresholds):
-                sel = det_valid & (pos_in_img < mdet)  # (I, D)
+                sel = det_valid_c & (pos_in_img < mdet)  # (I, D)
                 flat_scores = np.where(sel, det_score, -np.inf).reshape(-1)
                 order = np.argsort(-flat_scores, kind="mergesort")
                 nd = int(sel.sum())

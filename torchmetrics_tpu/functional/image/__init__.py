@@ -4,6 +4,7 @@ from .d_lambda import spectral_distortion_index
 from .d_s import spatial_distortion_index
 from .ergas import error_relative_global_dimensionless_synthesis
 from .gradients import image_gradients
+from .lpips import learned_perceptual_image_patch_similarity
 from .psnr import peak_signal_noise_ratio
 from .psnrb import peak_signal_noise_ratio_with_blocked_effect
 from .qnr import quality_with_no_reference
@@ -19,6 +20,7 @@ from .vif import visual_information_fidelity
 __all__ = [
     "error_relative_global_dimensionless_synthesis",
     "image_gradients",
+    "learned_perceptual_image_patch_similarity",
     "multiscale_structural_similarity_index_measure",
     "peak_signal_noise_ratio",
     "peak_signal_noise_ratio_with_blocked_effect",

@@ -1,0 +1,238 @@
+"""LPIPS (reference ``functional/image/lpips.py``; Zhang et al., CVPR 2018).
+
+The backbone feature stacks (AlexNet / VGG16 / SqueezeNet-1.1 classifier trunks) are
+expressed as declarative layer specs run through one jitted interpreter — adding a
+backbone is a data change, not code. Weights load from a converted pickle (the
+reference pulls torchvision pretrained backbones over the network, which an air-gapped
+pod cannot); ``pretrained=False`` gives deterministic random parameters so the scoring
+machinery stays testable offline.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+# torchvision `features` layer specs: (kind, *args). Conv = (c_in, c_out, k, stride, pad)
+_ALEX_SPEC = [
+    ("conv", 3, 64, 11, 4, 2), ("relu",), ("maxpool", 3, 2),
+    ("conv", 64, 192, 5, 1, 2), ("relu",), ("maxpool", 3, 2),
+    ("conv", 192, 384, 3, 1, 1), ("relu",),
+    ("conv", 384, 256, 3, 1, 1), ("relu",),
+    ("conv", 256, 256, 3, 1, 1), ("relu",),
+]
+_ALEX_TAPS = (2, 5, 8, 10, 12)  # slice end indices -> relu1..relu5
+_ALEX_CHNS = (64, 192, 384, 256, 256)
+
+_VGG_SPEC = (
+    [("conv", 3, 64, 3, 1, 1), ("relu",), ("conv", 64, 64, 3, 1, 1), ("relu",), ("maxpool", 2, 2)]
+    + [("conv", 64, 128, 3, 1, 1), ("relu",), ("conv", 128, 128, 3, 1, 1), ("relu",), ("maxpool", 2, 2)]
+    + [("conv", 128, 256, 3, 1, 1), ("relu",), ("conv", 256, 256, 3, 1, 1), ("relu",),
+       ("conv", 256, 256, 3, 1, 1), ("relu",), ("maxpool", 2, 2)]
+    + [("conv", 256, 512, 3, 1, 1), ("relu",), ("conv", 512, 512, 3, 1, 1), ("relu",),
+       ("conv", 512, 512, 3, 1, 1), ("relu",), ("maxpool", 2, 2)]
+    + [("conv", 512, 512, 3, 1, 1), ("relu",), ("conv", 512, 512, 3, 1, 1), ("relu",),
+       ("conv", 512, 512, 3, 1, 1), ("relu",)]
+)
+_VGG_TAPS = (4, 9, 16, 23, 30)
+_VGG_CHNS = (64, 128, 256, 512, 512)
+
+_SQUEEZE_SPEC = (
+    [("conv", 3, 64, 3, 2, 0), ("relu",), ("maxpool", 3, 2),
+     ("fire", 64, 16, 64, 64), ("fire", 128, 16, 64, 64), ("maxpool", 3, 2),
+     ("fire", 128, 32, 128, 128), ("fire", 256, 32, 128, 128), ("maxpool", 3, 2),
+     ("fire", 256, 48, 192, 192), ("fire", 384, 48, 192, 192),
+     ("fire", 384, 64, 256, 256), ("fire", 512, 64, 256, 256)]
+)
+_SQUEEZE_TAPS = (2, 5, 8, 10, 11, 12, 13)
+_SQUEEZE_CHNS = (64, 128, 256, 384, 384, 512, 512)
+
+_NETS = {
+    "alex": (_ALEX_SPEC, _ALEX_TAPS, _ALEX_CHNS),
+    "vgg": (_VGG_SPEC, _VGG_TAPS, _VGG_CHNS),
+    "squeeze": (_SQUEEZE_SPEC, _SQUEEZE_TAPS, _SQUEEZE_CHNS),
+}
+
+_SHIFT = np.asarray([-0.030, -0.088, -0.188], np.float32)
+_SCALE = np.asarray([0.458, 0.448, 0.450], np.float32)
+
+
+def _conv(x, w, b, stride, pad):
+    out = lax.conv_general_dilated(
+        x, w, (stride, stride), [(pad, pad), (pad, pad)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"), precision=lax.Precision.HIGHEST,
+    )
+    return out + b[None, :, None, None]
+
+
+def _maxpool(x, window, stride):
+    return lax.reduce_window(x, -jnp.inf, lax.max, (1, 1, window, window), (1, 1, stride, stride), "VALID")
+
+
+def _backbone_forward(spec, params: List, taps: Sequence[int], x) -> List[jnp.ndarray]:
+    feats = []
+    for idx, layer in enumerate(spec):
+        kind = layer[0]
+        p = params[idx]
+        if kind == "conv":
+            _, _, _, _, stride, pad = layer
+            x = _conv(x, p["w"], p["b"], stride, pad)
+        elif kind == "relu":
+            x = jax.nn.relu(x)
+        elif kind == "maxpool":
+            x = _maxpool(x, layer[1], layer[2])
+        elif kind == "fire":
+            s = jax.nn.relu(_conv(x, p["sq_w"], p["sq_b"], 1, 0))
+            e1 = jax.nn.relu(_conv(s, p["e1_w"], p["e1_b"], 1, 0))
+            e3 = jax.nn.relu(_conv(s, p["e3_w"], p["e3_b"], 1, 1))
+            x = jnp.concatenate([e1, e3], axis=1)
+        # spec position idx+1 == number of torchvision layers consumed
+        if idx + 1 in taps:
+            feats.append(x)
+    return feats
+
+
+def _normalize_tensor(feat, eps: float = 1e-8):
+    norm_factor = jnp.sqrt(eps + jnp.sum(feat**2, axis=1, keepdims=True))
+    return feat / norm_factor
+
+
+class LPIPSNetwork:
+    """Jitted LPIPS scorer: scaling layer -> backbone taps -> unit-normalize ->
+    squared diff -> 1x1 linear heads -> spatial average -> layer sum."""
+
+    def __init__(
+        self,
+        net_type: str = "alex",
+        pretrained: bool = True,
+        weights_path: Optional[str] = None,
+        seed: int = 0,
+    ) -> None:
+        if net_type not in _NETS:
+            raise ValueError(f"Argument `net_type` must be one of {list(_NETS)}, but got {net_type}")
+        self.net_type = net_type
+        self.spec, self.taps, self.chns = _NETS[net_type]
+        if pretrained:
+            if weights_path is None:
+                raise ModuleNotFoundError(
+                    "Pretrained LPIPS weights are not bundled and cannot be downloaded in an "
+                    "air-gapped environment. Convert them offline with "
+                    "`convert_lpips_weights` and pass `weights_path`, or use `pretrained=False` "
+                    "(random backbone — machinery only)."
+                )
+            with open(weights_path, "rb") as f:
+                payload = pickle.load(f)
+            self.backbone = jax.tree.map(jnp.asarray, payload["backbone"])
+            self.lins = jax.tree.map(jnp.asarray, payload["lins"])
+        else:
+            self.backbone, self.lins = self._random_params(jax.random.PRNGKey(seed))
+        self._apply = jax.jit(self._forward)
+
+    def _random_params(self, key):
+        backbone = []
+        for layer in self.spec:
+            if layer[0] == "conv":
+                _, c_in, c_out, k, _, _ = layer
+                key, k1 = jax.random.split(key)
+                backbone.append({
+                    "w": jax.random.normal(k1, (c_out, c_in, k, k), jnp.float32) / np.sqrt(c_in * k * k),
+                    "b": jnp.zeros(c_out),
+                })
+            elif layer[0] == "fire":
+                _, c_in, sq, e1, e3 = layer
+                key, k1, k2, k3 = jax.random.split(key, 4)
+                backbone.append({
+                    "sq_w": jax.random.normal(k1, (sq, c_in, 1, 1), jnp.float32) / np.sqrt(c_in),
+                    "sq_b": jnp.zeros(sq),
+                    "e1_w": jax.random.normal(k2, (e1, sq, 1, 1), jnp.float32) / np.sqrt(sq),
+                    "e1_b": jnp.zeros(e1),
+                    "e3_w": jax.random.normal(k3, (e3, sq, 3, 3), jnp.float32) / np.sqrt(sq * 9),
+                    "e3_b": jnp.zeros(e3),
+                })
+            else:
+                backbone.append({})
+        lins = []
+        for c in self.chns:
+            key, k1 = jax.random.split(key)
+            lins.append({"w": jnp.abs(jax.random.normal(k1, (1, c, 1, 1), jnp.float32)) / np.sqrt(c)})
+        return backbone, lins
+
+    def _forward(self, backbone, lins, img1, img2):
+        scale = jnp.asarray(_SCALE)[None, :, None, None]
+        shift = jnp.asarray(_SHIFT)[None, :, None, None]
+        in0 = (img1 - shift) / scale
+        in1 = (img2 - shift) / scale
+        feats0 = _backbone_forward(self.spec, backbone, self.taps, in0)
+        feats1 = _backbone_forward(self.spec, backbone, self.taps, in1)
+        res = jnp.zeros(img1.shape[0])
+        for f0, f1, lin in zip(feats0, feats1, lins):
+            diff = (_normalize_tensor(f0) - _normalize_tensor(f1)) ** 2
+            head = lax.conv_general_dilated(
+                diff, lin["w"], (1, 1), "VALID", dimension_numbers=("NCHW", "OIHW", "NCHW"),
+                precision=lax.Precision.HIGHEST,
+            )
+            res = res + head.mean(axis=(2, 3))[:, 0]
+        return res
+
+    def __call__(self, img1, img2, normalize: bool = False) -> jnp.ndarray:
+        img1 = jnp.asarray(img1, jnp.float32)
+        img2 = jnp.asarray(img2, jnp.float32)
+        if normalize:  # inputs in [0, 1] -> [-1, 1]
+            img1 = 2 * img1 - 1
+            img2 = 2 * img2 - 1
+        return self._apply(self.backbone, self.lins, img1, img2)
+
+
+def convert_lpips_weights(backbone_state_dict: Dict, lpips_state_dict: Dict, net_type: str, out_path: str) -> None:
+    """Convert torchvision ``<net>.features`` + reference ``lpips_models/<net>.pth``
+    state_dicts into the pickle this scorer loads (run offline where torch weights
+    are available)."""
+    spec, _, chns = _NETS[net_type]
+    backbone = []
+    tv_idx = 0
+    for layer in spec:
+        if layer[0] == "conv":
+            backbone.append({
+                "w": np.asarray(backbone_state_dict[f"{tv_idx}.weight"]),
+                "b": np.asarray(backbone_state_dict[f"{tv_idx}.bias"]),
+            })
+        elif layer[0] == "fire":
+            backbone.append({
+                "sq_w": np.asarray(backbone_state_dict[f"{tv_idx}.squeeze.weight"]),
+                "sq_b": np.asarray(backbone_state_dict[f"{tv_idx}.squeeze.bias"]),
+                "e1_w": np.asarray(backbone_state_dict[f"{tv_idx}.expand1x1.weight"]),
+                "e1_b": np.asarray(backbone_state_dict[f"{tv_idx}.expand1x1.bias"]),
+                "e3_w": np.asarray(backbone_state_dict[f"{tv_idx}.expand3x3.weight"]),
+                "e3_b": np.asarray(backbone_state_dict[f"{tv_idx}.expand3x3.bias"]),
+            })
+        else:
+            backbone.append({})
+        if layer[0] in ("conv", "relu", "maxpool", "fire"):
+            tv_idx += 1
+    lins = [{"w": np.asarray(lpips_state_dict[f"lin{i}.model.1.weight"])} for i in range(len(chns))]
+    with open(out_path, "wb") as f:
+        pickle.dump({"backbone": backbone, "lins": lins}, f)
+
+
+def learned_perceptual_image_patch_similarity(
+    img1,
+    img2,
+    net_type: str = "alex",
+    reduction: str = "mean",
+    normalize: bool = False,
+    weights_path: Optional[str] = None,
+    pretrained: bool = True,
+) -> jnp.ndarray:
+    """One-shot LPIPS between two image batches (see ``LPIPSNetwork``)."""
+    net = LPIPSNetwork(net_type, pretrained=pretrained, weights_path=weights_path)
+    loss = net(img1, img2, normalize=normalize)
+    if reduction == "mean":
+        return loss.mean()
+    if reduction == "sum":
+        return loss.sum()
+    raise ValueError(f"Argument `reduction` must be one of ['mean', 'sum'], but got {reduction}")

@@ -13,12 +13,24 @@ from .metrics import (
     UniversalImageQualityIndex,
     VisualInformationFidelity,
 )
+from .generative import (
+    FrechetInceptionDistance,
+    InceptionScore,
+    KernelInceptionDistance,
+    MemorizationInformedFrechetInceptionDistance,
+)
+from .lpip import LearnedPerceptualImagePatchSimilarity
 from .psnr import PeakSignalNoiseRatio
 from .psnrb import PeakSignalNoiseRatioWithBlockedEffect
 from .ssim import MultiScaleStructuralSimilarityIndexMeasure, StructuralSimilarityIndexMeasure
 
 __all__ = [
     "ErrorRelativeGlobalDimensionlessSynthesis",
+    "FrechetInceptionDistance",
+    "InceptionScore",
+    "KernelInceptionDistance",
+    "LearnedPerceptualImagePatchSimilarity",
+    "MemorizationInformedFrechetInceptionDistance",
     "MultiScaleStructuralSimilarityIndexMeasure",
     "PeakSignalNoiseRatio",
     "PeakSignalNoiseRatioWithBlockedEffect",

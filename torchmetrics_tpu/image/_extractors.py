@@ -1,0 +1,332 @@
+"""Feature extractors for generative-model metrics (reference ``image/fid.py:45-171``).
+
+The reference embeds torch-fidelity's ``NoTrainInceptionV3`` (downloaded weights).
+Here the contract is a plain callable ``imgs -> (N, num_features)``; the in-tree
+``InceptionV3Features`` is a jitted jnp InceptionV3 forward whose parameters load from
+a converted torch checkpoint (no network access is assumed — conversion happens
+offline via ``convert_torchvision_inception_weights``). Custom extractors (any
+callable, e.g. a jitted flax apply) plug into FID/KID/IS/MiFID exactly like the
+reference's ``feature: Module`` path.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def _conv(x, w, stride=1, padding="SAME"):
+    return lax.conv_general_dilated(
+        x, w, (stride, stride), padding, dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        precision=lax.Precision.HIGHEST,
+    )
+
+
+def _bn(x, scale, bias, mean, var, eps=1e-3):
+    inv = scale / jnp.sqrt(var + eps)
+    return x * inv[None, :, None, None] + (bias - mean * inv)[None, :, None, None]
+
+
+def _basic_conv(x, p, stride=1, padding="SAME"):
+    x = _conv(x, p["w"], stride, padding)
+    return jax.nn.relu(_bn(x, p["scale"], p["bias"], p["mean"], p["var"]))
+
+
+def _maxpool(x, window=3, stride=2):
+    return lax.reduce_window(x, -jnp.inf, lax.max, (1, 1, window, window), (1, 1, stride, stride), "VALID")
+
+
+def _avgpool(x, window=3, stride=1, padding="SAME"):
+    # count_include_pad semantics (torchvision inception): constant window divisor —
+    # a reduce_window over a ones constant also traps XLA in slow constant folding
+    summed = lax.reduce_window(x, 0.0, lax.add, (1, 1, window, window), (1, 1, stride, stride), padding)
+    return summed / (window * window)
+
+
+def _inception_a(x, p):
+    b1 = _basic_conv(x, p["b1"])
+    b5 = _basic_conv(_basic_conv(x, p["b5_1"]), p["b5_2"])
+    b3 = _basic_conv(_basic_conv(_basic_conv(x, p["b3_1"]), p["b3_2"]), p["b3_3"])
+    bp = _basic_conv(_avgpool(x), p["pool"])
+    return jnp.concatenate([b1, b5, b3, bp], axis=1)
+
+
+def _inception_b(x, p):
+    b3 = _basic_conv(x, p["b3"], stride=2, padding="VALID")
+    b3d = _basic_conv(_basic_conv(_basic_conv(x, p["b3d_1"]), p["b3d_2"]), p["b3d_3"], stride=2, padding="VALID")
+    bp = _maxpool(x)
+    return jnp.concatenate([b3, b3d, bp], axis=1)
+
+
+def _inception_c(x, p):
+    b1 = _basic_conv(x, p["b1"])
+    b7 = _basic_conv(_basic_conv(_basic_conv(x, p["b7_1"]), p["b7_2"]), p["b7_3"])
+    b7d = x
+    for key in ("b7d_1", "b7d_2", "b7d_3", "b7d_4", "b7d_5"):
+        b7d = _basic_conv(b7d, p[key])
+    bp = _basic_conv(_avgpool(x), p["pool"])
+    return jnp.concatenate([b1, b7, b7d, bp], axis=1)
+
+
+def _inception_d(x, p):
+    b3 = _basic_conv(_basic_conv(x, p["b3_1"]), p["b3_2"], stride=2, padding="VALID")
+    b7 = x
+    for key in ("b7_1", "b7_2", "b7_3"):
+        b7 = _basic_conv(b7, p[key])
+    b7 = _basic_conv(b7, p["b7_4"], stride=2, padding="VALID")
+    bp = _maxpool(x)
+    return jnp.concatenate([b3, b7, bp], axis=1)
+
+
+def _inception_e(x, p):
+    b1 = _basic_conv(x, p["b1"])
+    b3 = _basic_conv(x, p["b3_1"])
+    b3 = jnp.concatenate([_basic_conv(b3, p["b3_2a"]), _basic_conv(b3, p["b3_2b"])], axis=1)
+    b3d = _basic_conv(_basic_conv(x, p["b3d_1"]), p["b3d_2"])
+    b3d = jnp.concatenate([_basic_conv(b3d, p["b3d_3a"]), _basic_conv(b3d, p["b3d_3b"])], axis=1)
+    bp = _basic_conv(_avgpool(x), p["pool"])
+    return jnp.concatenate([b1, b3, b3d, bp], axis=1)
+
+
+def _inception_forward(params: Dict[str, Any], imgs: jnp.ndarray) -> jnp.ndarray:
+    """InceptionV3 pool3 features ``(N, 2048)`` from NCHW images in [0, 1] at 299x299."""
+    x = (imgs - 0.5) / 0.5  # [-1, 1] normalization
+    x = _basic_conv(x, params["stem1"], stride=2, padding="VALID")
+    x = _basic_conv(x, params["stem2"], padding="VALID")
+    x = _basic_conv(x, params["stem3"])
+    x = _maxpool(x)
+    x = _basic_conv(x, params["stem4"], padding="VALID")
+    x = _basic_conv(x, params["stem5"], padding="VALID")
+    x = _maxpool(x)
+    for key in ("mixed_a1", "mixed_a2", "mixed_a3"):
+        x = _inception_a(x, params[key])
+    x = _inception_b(x, params["mixed_b"])
+    for key in ("mixed_c1", "mixed_c2", "mixed_c3", "mixed_c4"):
+        x = _inception_c(x, params[key])
+    x = _inception_d(x, params["mixed_d"])
+    x = _inception_e(x, params["mixed_e1"])
+    x = _inception_e(x, params["mixed_e2"])
+    return x.mean(axis=(2, 3))  # global average pool -> (N, 2048)
+
+
+class InceptionV3Features:
+    """Jitted InceptionV3 pool3 feature extractor.
+
+    Parameters load from a converted checkpoint (pickle of the jnp param pytree). No
+    pretrained weights ship in-tree and none can be downloaded in an air-gapped pod;
+    bit-exact FID versus the torch-fidelity extractor additionally depends on its
+    TF1-style antialias resize (reference ``image/fid.py:88-101``), so numbers are
+    comparable only within a fixed extractor. Random init is available for pipeline
+    tests.
+    """
+
+    num_features = 2048
+
+    def __init__(self, weights_path: Optional[str] = None, seed: int = 0) -> None:
+        if weights_path is not None:
+            with open(weights_path, "rb") as f:
+                self.params = jax.tree.map(jnp.asarray, pickle.load(f))
+        else:
+            self.params = self._random_params(jax.random.PRNGKey(seed))
+        self._apply = jax.jit(_inception_forward)
+
+    def __call__(self, imgs) -> jnp.ndarray:
+        imgs = jnp.asarray(imgs)
+        if jnp.issubdtype(imgs.dtype, jnp.integer):
+            imgs = imgs.astype(jnp.float32) / 255.0
+        if imgs.shape[-2:] != (299, 299):
+            imgs = jax.image.resize(imgs, (*imgs.shape[:-2], 299, 299), method="bilinear")
+        return self._apply(self.params, imgs)
+
+    # ---------------------------------------------------------------- params
+
+    @staticmethod
+    def _conv_params(key, c_in, c_out, kh, kw):
+        k1, _ = jax.random.split(key)
+        fan_in = c_in * kh * kw
+        return {
+            "w": jax.random.normal(k1, (c_out, c_in, kh, kw), jnp.float32) / np.sqrt(fan_in),
+            "scale": jnp.ones(c_out),
+            "bias": jnp.zeros(c_out),
+            "mean": jnp.zeros(c_out),
+            "var": jnp.ones(c_out),
+        }
+
+    @classmethod
+    def _random_params(cls, key) -> Dict[str, Any]:
+        keys = iter(jax.random.split(key, 128))
+        cp = cls._conv_params
+
+        def block_a(c_in, pool_features):
+            return {
+                "b1": cp(next(keys), c_in, 64, 1, 1),
+                "b5_1": cp(next(keys), c_in, 48, 1, 1),
+                "b5_2": cp(next(keys), 48, 64, 5, 5),
+                "b3_1": cp(next(keys), c_in, 64, 1, 1),
+                "b3_2": cp(next(keys), 64, 96, 3, 3),
+                "b3_3": cp(next(keys), 96, 96, 3, 3),
+                "pool": cp(next(keys), c_in, pool_features, 1, 1),
+            }
+
+        def block_c(c_in, c7):
+            return {
+                "b1": cp(next(keys), c_in, 192, 1, 1),
+                "b7_1": cp(next(keys), c_in, c7, 1, 1),
+                "b7_2": cp(next(keys), c7, c7, 1, 7),
+                "b7_3": cp(next(keys), c7, 192, 7, 1),
+                "b7d_1": cp(next(keys), c_in, c7, 1, 1),
+                "b7d_2": cp(next(keys), c7, c7, 7, 1),
+                "b7d_3": cp(next(keys), c7, c7, 1, 7),
+                "b7d_4": cp(next(keys), c7, c7, 7, 1),
+                "b7d_5": cp(next(keys), c7, 192, 1, 7),
+                "pool": cp(next(keys), c_in, 192, 1, 1),
+            }
+
+        def block_e(c_in):
+            return {
+                "b1": cp(next(keys), c_in, 320, 1, 1),
+                "b3_1": cp(next(keys), c_in, 384, 1, 1),
+                "b3_2a": cp(next(keys), 384, 384, 1, 3),
+                "b3_2b": cp(next(keys), 384, 384, 3, 1),
+                "b3d_1": cp(next(keys), c_in, 448, 1, 1),
+                "b3d_2": cp(next(keys), 448, 384, 3, 3),
+                "b3d_3a": cp(next(keys), 384, 384, 1, 3),
+                "b3d_3b": cp(next(keys), 384, 384, 3, 1),
+                "pool": cp(next(keys), c_in, 192, 1, 1),
+            }
+
+        return {
+            "stem1": cp(next(keys), 3, 32, 3, 3),
+            "stem2": cp(next(keys), 32, 32, 3, 3),
+            "stem3": cp(next(keys), 32, 64, 3, 3),
+            "stem4": cp(next(keys), 64, 80, 1, 1),
+            "stem5": cp(next(keys), 80, 192, 3, 3),
+            "mixed_a1": block_a(192, 32),
+            "mixed_a2": block_a(256, 64),
+            "mixed_a3": block_a(288, 64),
+            "mixed_b": {
+                "b3": cp(next(keys), 288, 384, 3, 3),
+                "b3d_1": cp(next(keys), 288, 64, 1, 1),
+                "b3d_2": cp(next(keys), 64, 96, 3, 3),
+                "b3d_3": cp(next(keys), 96, 96, 3, 3),
+            },
+            "mixed_c1": block_c(768, 128),
+            "mixed_c2": block_c(768, 160),
+            "mixed_c3": block_c(768, 160),
+            "mixed_c4": block_c(768, 192),
+            "mixed_d": {
+                "b3_1": cp(next(keys), 768, 192, 1, 1),
+                "b3_2": cp(next(keys), 192, 320, 3, 3),
+                "b7_1": cp(next(keys), 768, 192, 1, 1),
+                "b7_2": cp(next(keys), 192, 192, 1, 7),
+                "b7_3": cp(next(keys), 192, 192, 7, 1),
+                "b7_4": cp(next(keys), 192, 192, 3, 3),
+            },
+            "mixed_e1": block_e(1280),
+            "mixed_e2": block_e(2048),
+        }
+
+
+def convert_torchvision_inception_weights(state_dict: Dict[str, Any], out_path: str) -> None:
+    """Convert a torchvision ``inception_v3`` state_dict into the pickle pytree this
+    extractor loads (run offline where the torch weights are available)."""
+    import numpy as _np
+
+    def conv(prefix):
+        return {
+            "w": _np.asarray(state_dict[f"{prefix}.conv.weight"]),
+            "scale": _np.asarray(state_dict[f"{prefix}.bn.weight"]),
+            "bias": _np.asarray(state_dict[f"{prefix}.bn.bias"]),
+            "mean": _np.asarray(state_dict[f"{prefix}.bn.running_mean"]),
+            "var": _np.asarray(state_dict[f"{prefix}.bn.running_var"]),
+        }
+
+    params = {
+        "stem1": conv("Conv2d_1a_3x3"),
+        "stem2": conv("Conv2d_2a_3x3"),
+        "stem3": conv("Conv2d_2b_3x3"),
+        "stem4": conv("Conv2d_3b_1x1"),
+        "stem5": conv("Conv2d_4a_3x3"),
+    }
+    for i, name in enumerate(("Mixed_5b", "Mixed_5c", "Mixed_5d"), start=1):
+        params[f"mixed_a{i}"] = {
+            "b1": conv(f"{name}.branch1x1"),
+            "b5_1": conv(f"{name}.branch5x5_1"),
+            "b5_2": conv(f"{name}.branch5x5_2"),
+            "b3_1": conv(f"{name}.branch3x3dbl_1"),
+            "b3_2": conv(f"{name}.branch3x3dbl_2"),
+            "b3_3": conv(f"{name}.branch3x3dbl_3"),
+            "pool": conv(f"{name}.branch_pool"),
+        }
+    params["mixed_b"] = {
+        "b3": conv("Mixed_6a.branch3x3"),
+        "b3d_1": conv("Mixed_6a.branch3x3dbl_1"),
+        "b3d_2": conv("Mixed_6a.branch3x3dbl_2"),
+        "b3d_3": conv("Mixed_6a.branch3x3dbl_3"),
+    }
+    for i, name in enumerate(("Mixed_6b", "Mixed_6c", "Mixed_6d", "Mixed_6e"), start=1):
+        params[f"mixed_c{i}"] = {
+            "b1": conv(f"{name}.branch1x1"),
+            "b7_1": conv(f"{name}.branch7x7_1"),
+            "b7_2": conv(f"{name}.branch7x7_2"),
+            "b7_3": conv(f"{name}.branch7x7_3"),
+            "b7d_1": conv(f"{name}.branch7x7dbl_1"),
+            "b7d_2": conv(f"{name}.branch7x7dbl_2"),
+            "b7d_3": conv(f"{name}.branch7x7dbl_3"),
+            "b7d_4": conv(f"{name}.branch7x7dbl_4"),
+            "b7d_5": conv(f"{name}.branch7x7dbl_5"),
+            "pool": conv(f"{name}.branch_pool"),
+        }
+    params["mixed_d"] = {
+        "b3_1": conv("Mixed_7a.branch3x3_1"),
+        "b3_2": conv("Mixed_7a.branch3x3_2"),
+        "b7_1": conv("Mixed_7a.branch7x7x3_1"),
+        "b7_2": conv("Mixed_7a.branch7x7x3_2"),
+        "b7_3": conv("Mixed_7a.branch7x7x3_3"),
+        "b7_4": conv("Mixed_7a.branch7x7x3_4"),
+    }
+    for i, name in enumerate(("Mixed_7b", "Mixed_7c"), start=1):
+        params[f"mixed_e{i}"] = {
+            "b1": conv(f"{name}.branch1x1"),
+            "b3_1": conv(f"{name}.branch3x3_1"),
+            "b3_2a": conv(f"{name}.branch3x3_2a"),
+            "b3_2b": conv(f"{name}.branch3x3_2b"),
+            "b3d_1": conv(f"{name}.branch3x3dbl_1"),
+            "b3d_2": conv(f"{name}.branch3x3dbl_2"),
+            "b3d_3a": conv(f"{name}.branch3x3dbl_3a"),
+            "b3d_3b": conv(f"{name}.branch3x3dbl_3b"),
+            "pool": conv(f"{name}.branch_pool"),
+        }
+    with open(out_path, "wb") as f:
+        pickle.dump(params, f)
+
+
+def resolve_feature_extractor(
+    feature, normalize: bool, input_img_size: Tuple[int, int, int] = (3, 299, 299)
+) -> Tuple[Callable, int, bool]:
+    """Reference ``feature: int | Module`` resolution: int selects the in-tree
+    InceptionV3 (weights required for meaningful values), any callable is used as-is.
+    Returns (extractor, num_features, used_custom)."""
+    if isinstance(feature, int):
+        if feature != 2048:
+            raise ValueError(
+                "The in-tree InceptionV3 extractor exposes the 2048-d pool3 features; "
+                f"got feature={feature}. Pass a custom callable for other dimensions."
+            )
+        return InceptionV3Features(), 2048, False
+    if callable(feature):
+        num_features = getattr(feature, "num_features", None)
+        if num_features is None:
+            dummy = (
+                jnp.zeros((1, *input_img_size), jnp.float32)
+                if normalize
+                else jnp.zeros((1, *input_img_size), jnp.uint8)
+            )
+            num_features = int(np.asarray(feature(dummy)).shape[-1])
+        return feature, int(num_features), True
+    raise TypeError("Got unknown input to argument `feature`")

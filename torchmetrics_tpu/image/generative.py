@@ -1,0 +1,363 @@
+"""Generative-model metrics: FID, KID, InceptionScore, MiFID (reference
+``image/{fid,kid,inception,mifid}.py``).
+
+State designs mirror the reference: FID keeps O(F^2) feature/cov sums (six psums to
+sync — ``image/fid.py:376-382``); KID/IS/MiFID keep cat feature rows. Device-side
+accumulation is float32 (TPU f64 is emulated); the final Gaussian/MMD algebra runs in
+numpy float64 on host, which bounds the precision loss to the running sums.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..metric import HostMetric, Metric
+from ._extractors import resolve_feature_extractor
+
+
+def _compute_fid(mu1, sigma1, mu2, sigma2) -> float:
+    """Frechet distance between two Gaussians (eigenvalue form, f64 host)."""
+    a = float(((mu1 - mu2) ** 2).sum())
+    b = float(np.trace(sigma1) + np.trace(sigma2))
+    eigvals = np.linalg.eigvals(sigma1 @ sigma2)
+    c = float(np.sqrt(eigvals.astype(np.complex128)).real.sum())
+    return a + b - 2 * c
+
+
+class FrechetInceptionDistance(Metric):
+    """FID (reference ``image/fid.py:197``).
+
+    ``feature`` is the 2048-d in-tree InceptionV3 (int, converted weights required for
+    meaningful values) or any callable ``imgs -> (N, F)`` — e.g. a jitted flax apply.
+    """
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+    _jittable_compute = False
+
+    def __init__(
+        self,
+        feature: Union[int, Any] = 2048,
+        reset_real_features: bool = True,
+        normalize: bool = False,
+        input_img_size: Tuple[int, int, int] = (3, 299, 299),
+        feature_extractor_weights_path: Optional[str] = None,
+        antialias: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(normalize, bool):
+            raise ValueError("Argument `normalize` expected to be a bool")
+        self.normalize = normalize
+        self.antialias = antialias
+        if isinstance(feature, int) and feature_extractor_weights_path is not None:
+            from ._extractors import InceptionV3Features
+
+            if feature != 2048:
+                raise ValueError(
+                    "The in-tree InceptionV3 extractor exposes the 2048-d pool3 features; "
+                    f"got feature={feature}. Pass a custom callable for other dimensions."
+                )
+            self.inception, num_features, self.used_custom_model = (
+                InceptionV3Features(feature_extractor_weights_path), 2048, False,
+            )
+        else:
+            self.inception, num_features, self.used_custom_model = resolve_feature_extractor(
+                feature, normalize, input_img_size
+            )
+        if not isinstance(reset_real_features, bool):
+            raise ValueError("Argument `reset_real_features` expected to be a bool")
+        self.reset_real_features = reset_real_features
+        self.num_features = num_features
+        mx = (num_features, num_features)
+        self.add_state("real_features_sum", jnp.zeros(num_features), dist_reduce_fx="sum")
+        self.add_state("real_features_cov_sum", jnp.zeros(mx), dist_reduce_fx="sum")
+        self.add_state("real_features_num_samples", jnp.zeros((), jnp.int32), dist_reduce_fx="sum")
+        self.add_state("fake_features_sum", jnp.zeros(num_features), dist_reduce_fx="sum")
+        self.add_state("fake_features_cov_sum", jnp.zeros(mx), dist_reduce_fx="sum")
+        self.add_state("fake_features_num_samples", jnp.zeros((), jnp.int32), dist_reduce_fx="sum")
+
+    def _prepare_inputs(self, imgs, real: bool):
+        imgs = jnp.asarray(imgs)
+        if self.normalize and not self.used_custom_model:
+            imgs = (imgs * 255).astype(jnp.uint8)
+        features = jnp.asarray(self.inception(imgs))
+        return (features, jnp.asarray(bool(real))), {}
+
+    def _batch_state(self, features, real):
+        # `real` arrives as a traced 0/1 scalar so one jitted update serves both
+        # branches (multiplicative masking instead of Python control flow)
+        f = features.astype(jnp.float32)
+        fsum = f.sum(axis=0)
+        cov = jnp.matmul(f.T, f, precision="highest")
+        n = jnp.asarray(f.shape[0], jnp.int32)
+        mask = real.astype(jnp.float32)
+        n_mask = real.astype(jnp.int32)
+        return {
+            "real_features_sum": fsum * mask,
+            "real_features_cov_sum": cov * mask,
+            "real_features_num_samples": n * n_mask,
+            "fake_features_sum": fsum * (1 - mask),
+            "fake_features_cov_sum": cov * (1 - mask),
+            "fake_features_num_samples": n * (1 - n_mask),
+        }
+
+    def _compute(self, state):
+        n_real = int(state["real_features_num_samples"])
+        n_fake = int(state["fake_features_num_samples"])
+        if n_real < 2 or n_fake < 2:
+            raise RuntimeError("More than one sample is required for both the real and fake distributed to compute FID")
+        mean_real = np.asarray(state["real_features_sum"], np.float64) / n_real
+        mean_fake = np.asarray(state["fake_features_sum"], np.float64) / n_fake
+        cov_real = (np.asarray(state["real_features_cov_sum"], np.float64) - n_real * np.outer(mean_real, mean_real)) / (n_real - 1)
+        cov_fake = (np.asarray(state["fake_features_cov_sum"], np.float64) - n_fake * np.outer(mean_fake, mean_fake)) / (n_fake - 1)
+        return jnp.asarray(_compute_fid(mean_real, cov_real, mean_fake, cov_fake), jnp.float32)
+
+    def reset(self) -> None:
+        if not self.reset_real_features:
+            keep = {
+                k: self._state[k]
+                for k in ("real_features_sum", "real_features_cov_sum", "real_features_num_samples")
+            }
+            super().reset()
+            self._state.update(keep)
+        else:
+            super().reset()
+
+
+def maximum_mean_discrepancy(k_xx, k_xy, k_yy) -> np.ndarray:
+    m = k_xx.shape[0]
+    kt_xx_sum = (k_xx.sum(axis=-1) - np.diag(k_xx)).sum()
+    kt_yy_sum = (k_yy.sum(axis=-1) - np.diag(k_yy)).sum()
+    k_xy_sum = k_xy.sum()
+    value = (kt_xx_sum + kt_yy_sum) / (m * (m - 1))
+    return value - 2 * k_xy_sum / (m**2)
+
+
+def poly_kernel(f1, f2, degree: int = 3, gamma: Optional[float] = None, coef: float = 1.0) -> np.ndarray:
+    if gamma is None:
+        gamma = 1.0 / f1.shape[1]
+    return (f1 @ f2.T * gamma + coef) ** degree
+
+
+def poly_mmd(f_real, f_fake, degree: int = 3, gamma: Optional[float] = None, coef: float = 1.0) -> np.ndarray:
+    k_11 = poly_kernel(f_real, f_real, degree, gamma, coef)
+    k_22 = poly_kernel(f_fake, f_fake, degree, gamma, coef)
+    k_12 = poly_kernel(f_real, f_fake, degree, gamma, coef)
+    return maximum_mean_discrepancy(k_11, k_12, k_22)
+
+
+class KernelInceptionDistance(HostMetric):
+    """KID (reference ``image/kid.py:71``): polynomial-kernel MMD over random feature
+    subsets; cat feature states."""
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+    _jittable_compute = False
+
+    def __init__(
+        self,
+        feature: Union[int, Any] = 2048,
+        subsets: int = 100,
+        subset_size: int = 1000,
+        degree: int = 3,
+        gamma: Optional[float] = None,
+        coef: float = 1.0,
+        reset_real_features: bool = True,
+        normalize: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.inception, self.num_features, self.used_custom_model = resolve_feature_extractor(feature, normalize)
+        if not (isinstance(subsets, int) and subsets > 0):
+            raise ValueError("Argument `subsets` expected to be integer larger than 0")
+        self.subsets = subsets
+        if not (isinstance(subset_size, int) and subset_size > 0):
+            raise ValueError("Argument `subset_size` expected to be integer larger than 0")
+        self.subset_size = subset_size
+        if not (isinstance(degree, int) and degree > 0):
+            raise ValueError("Argument `degree` expected to be integer larger than 0")
+        self.degree = degree
+        if gamma is not None and not (isinstance(gamma, float) and gamma > 0):
+            raise ValueError("Argument `gamma` expected to be `None` or float larger than 0")
+        self.gamma = gamma
+        if not (isinstance(coef, float) and coef > 0):
+            raise ValueError("Argument `coef` expected to be float larger than 0")
+        self.coef = coef
+        if not isinstance(reset_real_features, bool):
+            raise ValueError("Argument `reset_real_features` expected to be a bool")
+        self.reset_real_features = reset_real_features
+        if not isinstance(normalize, bool):
+            raise ValueError("Argument `normalize` expected to be a bool")
+        self.normalize = normalize
+        self.add_state("real_features", default=[], dist_reduce_fx="cat")
+        self.add_state("fake_features", default=[], dist_reduce_fx="cat")
+
+    def _host_batch_state(self, imgs, real: bool):
+        imgs = jnp.asarray(imgs)
+        if self.normalize and not self.used_custom_model:
+            imgs = (imgs * 255).astype(jnp.uint8)
+        features = jnp.asarray(self.inception(imgs))
+        empty = jnp.zeros((0, features.shape[-1]), features.dtype)
+        if real:
+            return {"real_features": features, "fake_features": empty}
+        return {"fake_features": features, "real_features": empty}
+
+    def _compute(self, state) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        real_features = np.asarray(state["real_features"], np.float64)
+        fake_features = np.asarray(state["fake_features"], np.float64)
+        if real_features.shape[0] < self.subset_size or fake_features.shape[0] < self.subset_size:
+            raise ValueError("Argument `subset_size` should be smaller than the number of samples")
+        rng = np.random.default_rng()
+        kid_scores = []
+        for _ in range(self.subsets):
+            f_real = real_features[rng.permutation(real_features.shape[0])[: self.subset_size]]
+            f_fake = fake_features[rng.permutation(fake_features.shape[0])[: self.subset_size]]
+            kid_scores.append(poly_mmd(f_real, f_fake, self.degree, self.gamma, self.coef))
+        kid = np.asarray(kid_scores)
+        return jnp.asarray(kid.mean(), jnp.float32), jnp.asarray(kid.std(ddof=0), jnp.float32)
+
+    def reset(self) -> None:
+        if not self.reset_real_features:
+            keep = list(self._state["real_features"])
+            super().reset()
+            self._state["real_features"] = keep
+        else:
+            super().reset()
+
+
+class InceptionScore(Metric):
+    """Inception Score (reference ``image/inception.py:35``): exp KL between
+    conditional and marginal label distributions over splits; cat logit states."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    _jittable_compute = False
+
+    def __init__(
+        self,
+        feature: Union[str, int, Any] = "logits_unbiased",
+        splits: int = 10,
+        normalize: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(normalize, bool):
+            raise ValueError("Argument `normalize` expected to be a bool")
+        self.normalize = normalize
+        if feature == "logits_unbiased":
+            raise ModuleNotFoundError(
+                "InceptionScore's default `logits_unbiased` head needs the pretrained InceptionV3 "
+                "classifier, whose weights cannot be downloaded in this air-gapped environment. "
+                "Pass a custom callable producing class logits instead."
+            )
+        self.inception, self.num_features, self.used_custom_model = resolve_feature_extractor(feature, normalize)
+        if not (isinstance(splits, int) and splits > 0):
+            raise ValueError("Argument `splits` expected to be integer larger than 0")
+        self.splits = splits
+        self.add_state("features", default=[], dist_reduce_fx="cat")
+
+    def _prepare_inputs(self, imgs):
+        imgs = jnp.asarray(imgs)
+        # the reference byte-converts for custom extractors too (inception.py:151 has
+        # no used_custom_model check, unlike FID/KID) — quirk preserved for parity
+        if self.normalize:
+            imgs = (imgs * 255).astype(jnp.uint8)
+        return (jnp.asarray(self.inception(imgs)),), {}
+
+    def _batch_state(self, features):
+        return {"features": features}
+
+    def _compute(self, state) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        features = np.asarray(state["features"], np.float64)
+        idx = np.random.default_rng().permutation(features.shape[0])
+        features = features[idx]
+        shifted = features - features.max(axis=1, keepdims=True)
+        log_prob = shifted - np.log(np.exp(shifted).sum(axis=1, keepdims=True))
+        prob = np.exp(log_prob)
+        kl_scores = []
+        for chunk_p, chunk_lp in zip(np.array_split(prob, self.splits), np.array_split(log_prob, self.splits)):
+            mean_prob = chunk_p.mean(axis=0, keepdims=True)
+            kl = chunk_p * (chunk_lp - np.log(mean_prob))
+            kl_scores.append(np.exp(kl.sum(axis=1).mean()))
+        kl = np.asarray(kl_scores)
+        return jnp.asarray(kl.mean(), jnp.float32), jnp.asarray(kl.std(), jnp.float32)
+
+
+class MemorizationInformedFrechetInceptionDistance(HostMetric):
+    """MiFID (reference ``image/mifid.py:67``): FID penalized by the memorization
+    (minimum cosine distance) between fake and real features; cat feature states."""
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+    _jittable_compute = False
+
+    def __init__(
+        self,
+        feature: Union[int, Any] = 2048,
+        reset_real_features: bool = True,
+        normalize: bool = False,
+        cosine_distance_eps: float = 0.1,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.inception, self.num_features, self.used_custom_model = resolve_feature_extractor(feature, normalize)
+        if not isinstance(reset_real_features, bool):
+            raise ValueError("Argument `reset_real_features` expected to be a bool")
+        self.reset_real_features = reset_real_features
+        if not isinstance(normalize, bool):
+            raise ValueError("Argument `normalize` expected to be a bool")
+        self.normalize = normalize
+        if not (isinstance(cosine_distance_eps, float) and 1 > cosine_distance_eps > 0):
+            raise ValueError("Argument `cosine_distance_eps` expected to be a float greater than 0 and less than 1")
+        self.cosine_distance_eps = cosine_distance_eps
+        self.add_state("real_features", default=[], dist_reduce_fx="cat")
+        self.add_state("fake_features", default=[], dist_reduce_fx="cat")
+
+    def _host_batch_state(self, imgs, real: bool):
+        imgs = jnp.asarray(imgs)
+        if self.normalize and not self.used_custom_model:
+            imgs = (imgs * 255).astype(jnp.uint8)
+        features = jnp.asarray(self.inception(imgs))
+        empty = jnp.zeros((0, features.shape[-1]), features.dtype)
+        if real:
+            return {"real_features": features, "fake_features": empty}
+        return {"fake_features": features, "real_features": empty}
+
+    def _compute(self, state):
+        real = np.asarray(state["real_features"], np.float64)
+        fake = np.asarray(state["fake_features"], np.float64)
+        mean_real, mean_fake = real.mean(axis=0), fake.mean(axis=0)
+        cov_real = np.cov(real.T)
+        cov_fake = np.cov(fake.T)
+        fid = _compute_fid(mean_real, cov_real, mean_fake, cov_fake)
+        # memorization distance: per real row, min cosine distance to the fake set
+        # (zero rows dropped — reference mifid.py:37-48)
+        real_nz = real[real.sum(axis=1) != 0]
+        fake_nz = fake[fake.sum(axis=1) != 0]
+        norm_r = real_nz / np.linalg.norm(real_nz, axis=1, keepdims=True)
+        norm_f = fake_nz / np.linalg.norm(fake_nz, axis=1, keepdims=True)
+        d = 1.0 - np.abs(norm_r @ norm_f.T)
+        mean_min_d = d.min(axis=1).mean()
+        distance = mean_min_d if mean_min_d < self.cosine_distance_eps else 1.0
+        value = fid / (distance + 10e-15) if fid > 1e-8 else 0.0
+        return jnp.asarray(value, jnp.float32)
+
+    def reset(self) -> None:
+        if not self.reset_real_features:
+            keep = list(self._state["real_features"])
+            super().reset()
+            self._state["real_features"] = keep
+        else:
+            super().reset()

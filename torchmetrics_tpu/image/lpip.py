@@ -1,0 +1,54 @@
+"""LearnedPerceptualImagePatchSimilarity metric class (reference ``image/lpip.py:41``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+
+from ..functional.image.lpips import LPIPSNetwork
+from ..metric import Metric
+
+
+class LearnedPerceptualImagePatchSimilarity(Metric):
+    """Running-mean LPIPS (two scalar sum states). ``weights_path`` points at a
+    converted weight pickle; ``pretrained=False`` runs the machinery on deterministic
+    random parameters (offline testing)."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self,
+        net_type: str = "alex",
+        reduction: str = "mean",
+        normalize: bool = False,
+        weights_path: Optional[str] = None,
+        pretrained: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        valid_reduction = ("mean", "sum")
+        if reduction not in valid_reduction:
+            raise ValueError(f"Argument `reduction` must be one of {valid_reduction} but got {reduction}")
+        self.reduction = reduction
+        if not isinstance(normalize, bool):
+            raise ValueError(f"Argument `normalize` should be a bool but got {normalize}")
+        self.normalize = normalize
+        self.net = LPIPSNetwork(net_type, pretrained=pretrained, weights_path=weights_path)
+        self.add_state("sum_scores", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def _prepare_inputs(self, img1, img2):
+        return (jnp.asarray(self.net(img1, img2, normalize=self.normalize)),), {}
+
+    def _batch_state(self, loss):
+        return {"sum_scores": loss.sum(), "total": jnp.asarray(float(loss.shape[0]))}
+
+    def _compute(self, state):
+        if self.reduction == "mean":
+            return state["sum_scores"] / state["total"]
+        return state["sum_scores"]

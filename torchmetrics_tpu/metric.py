@@ -241,9 +241,10 @@ class Metric:
                 bs = self._batch_state(*args, **kwargs)
                 appends = {k: v for k, v in bs.items() if k in list_names}
                 bs_t = {k: v for k, v in bs.items() if k not in list_names}
-                # n_prev (prior update count, traced) makes "mean" states an exact
-                # running mean over updates (reference metric.py:481); other tags
-                # ignore the weights
+                # n_prev (prior update count, a DEVICE scalar incremented in-graph —
+                # a per-update host transfer costs ~1.7ms through a TPU tunnel) makes
+                # "mean" states an exact running mean over updates (reference
+                # metric.py:481); other tags ignore the weights
                 new_t = {k: _sync.pairwise_merge(self._reductions.get(k), tensor_state[k], v, weights=(n_prev, 1.0)) for k, v in bs_t.items()} if not self._has_custom_merge() else None
                 if new_t is None:
                     new_t = self._merge({**tensor_state}, bs_t)
@@ -252,10 +253,15 @@ class Metric:
                 # carry through tensor states the batch didn't touch
                 for k, v in tensor_state.items():
                     new_t.setdefault(k, v)
-                return new_t, appends
+                return new_t, appends, n_prev + 1.0
 
-            self._jit_cache[key] = jax.jit(fn, donate_argnums=0) if self._enable_jit else fn
+            self._jit_cache[key] = jax.jit(fn, donate_argnums=(0, 1)) if self._enable_jit else fn
         return self._jit_cache[key]
+
+    def _device_update_count(self):
+        if getattr(self, "_n_prev_dev", None) is None:
+            self._n_prev_dev = jnp.asarray(float(self._update_count), jnp.float32)
+        return self._n_prev_dev
 
     def _has_custom_merge(self) -> bool:
         return type(self)._merge is not Metric._merge
@@ -269,8 +275,9 @@ class Metric:
             )
         args, kwargs = self._prepare_inputs(*args, **kwargs)
         tensors, _ = self._split_tensor_list(self._state)
-        n_prev = jnp.asarray(float(self._update_count), jnp.float32)
-        new_t, appends = self._get_update_fn()(tensors, n_prev, *args, **kwargs)
+        new_t, appends, self._n_prev_dev = self._get_update_fn()(
+            tensors, self._device_update_count(), *args, **kwargs
+        )
         for k, v in new_t.items():
             self._state[k] = v
         for k, v in appends.items():
@@ -323,8 +330,9 @@ class Metric:
 
             self._jit_cache[key] = jax.jit(fn, donate_argnums=0) if (self._enable_jit and self._jittable_compute) else fn
         new_t, appends, val, batch_full = self._jit_cache[key](
-            self._split_tensor_list(self._state)[0], jnp.asarray(float(self._update_count), jnp.float32), *args, **kwargs
+            self._split_tensor_list(self._state)[0], self._device_update_count(), *args, **kwargs
         )
+        self._n_prev_dev = None  # forward does not return the incremented counter
         for k, v in new_t.items():
             self._state[k] = v
         for k, v in appends.items():
@@ -382,6 +390,7 @@ class Metric:
     def reset(self) -> None:
         """Restore default states (reference metric.py:758)."""
         self._update_count = 0
+        self._n_prev_dev = None
         self._computed = None
         for name, default in self._defaults.items():
             self._state[name] = [] if isinstance(default, list) else jnp.asarray(default)
@@ -477,6 +486,7 @@ class Metric:
         # "mean" states (a dict carries weight 1); the reference leaves the count
         # untouched for dicts, but it also doesn't weight means by count at all
         self._update_count += incoming_state._update_count if isinstance(incoming_state, Metric) else 1
+        self._n_prev_dev = None
         self._computed = None
 
     def clone(self) -> "Metric":
@@ -562,6 +572,7 @@ class Metric:
         for k, v in self._state.items():
             self._state[k] = [put(x) for x in v] if isinstance(v, list) else put(v)
         self._device = device_or_sharding
+        self._n_prev_dev = None  # cached device counter stays on the old device otherwise
         return self
 
     def set_dtype(self, dst_type: Any) -> "Metric":
@@ -815,6 +826,7 @@ class CompositionalMetric(Metric):
         if isinstance(self.metric_b, Metric):
             self.metric_b.reset()
         self._update_count = 0
+        self._n_prev_dev = None
         self._computed = None
 
     def persistent(self, mode: bool = False) -> None:
