@@ -139,6 +139,13 @@ def test_mifid_parity_shared_extractor():
     _assert_allclose(ours.compute(), ref.compute().numpy(), atol=1e-2)
 
 
+def test_int_feature_requires_weights():
+    with pytest.raises(ModuleNotFoundError, match="converted InceptionV3 weights"):
+        tm.FrechetInceptionDistance()
+    with pytest.raises(ModuleNotFoundError, match="converted InceptionV3 weights"):
+        tm.KernelInceptionDistance()
+
+
 def test_lpips_machinery_invariants():
     lp = tm.LearnedPerceptualImagePatchSimilarity(pretrained=False)
     imgs = jnp.asarray(_RNG.random((2, 3, 64, 64)).astype(np.float32) * 2 - 1)

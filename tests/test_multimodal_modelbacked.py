@@ -1,0 +1,187 @@
+"""Multimodal + model-backed text tests: CLIPScore/CLIP-IQA machinery with a toy
+embedder, LVE oracle parity, BERTScore parity via the reference's own
+user-model/user-tokenizer seam, and the offline gates."""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from tests.helpers import _assert_allclose
+from tests.oracle import reference_torchmetrics
+
+import torchmetrics_tpu as tm
+import torchmetrics_tpu.functional as F
+
+_RNG = np.random.default_rng(21)
+_EMB = _RNG.normal(size=(64, 12)).astype(np.float32)  # toy vocab embedding table
+
+
+def _oracle():
+    tm_ref = reference_torchmetrics()
+    if tm_ref is None:
+        pytest.skip("oracle unavailable")
+    import torch
+
+    return tm_ref, torch
+
+
+# ----------------------------------------------------------------- CLIPScore
+
+class ToyClip:
+    """Deterministic toy CLIP: images hash to features via mean-pool projection,
+    texts via summed token embeddings."""
+
+    def get_image_features(self, images):
+        flat = jnp.stack([jnp.asarray(i, jnp.float32).reshape(-1)[: 3 * 4] for i in images])
+        return flat @ jnp.asarray(_EMB[: 3 * 4, :8])
+
+    def get_text_features(self, texts):
+        out = []
+        for t in texts:
+            ids = [hash(w) % 64 for w in t.split()]
+            out.append(jnp.asarray(_EMB[ids, :8]).sum(axis=0))
+        return jnp.stack(out)
+
+
+def test_clip_score_machinery():
+    imgs = [jnp.asarray(_RNG.random((3, 4, 4)).astype(np.float32)) for _ in range(3)]
+    texts = ["a cat on a mat", "a dog", "the quick brown fox"]
+    score = F.clip_score(imgs, texts, model_name_or_path=ToyClip())
+    assert 0.0 <= float(score) <= 100.0
+    # identical embeddings give the max score
+    same = F.clip_score(texts, list(texts), model_name_or_path=ToyClip())
+    assert float(same) == pytest.approx(100.0, abs=1e-3)
+
+    metric = tm.CLIPScore(model_name_or_path=ToyClip())
+    metric.update(imgs, texts)
+    metric.update(imgs[:2], texts[:2])
+    assert 0.0 <= float(metric.compute()) <= 100.0
+    # running mean matches one-shot over the concatenation
+    oneshot = F.clip_score(imgs + imgs[:2], texts + texts[:2], model_name_or_path=ToyClip())
+    _assert_allclose(metric.compute(), np.maximum(np.asarray(oneshot), 0), atol=1e-4)
+
+
+def test_clip_score_validation_and_gate():
+    with pytest.raises(ValueError, match="same"):
+        F.clip_score(["a"], ["a", "b"], model_name_or_path=ToyClip())
+    with pytest.raises(ModuleNotFoundError, match="local HF cache|transformers"):
+        tm.CLIPScore(model_name_or_path="openai/clip-vit-large-patch14")
+
+
+def test_clip_iqa_machinery():
+    m = tm.CLIPImageQualityAssessment(model_name_or_path=ToyClip(), prompts=("quality", ("Warm photo.", "Cold photo.")))
+    m.update(jnp.asarray(_RNG.random((2, 3, 4, 4)).astype(np.float32)))
+    out = m.compute()
+    assert set(out) == {"quality", "user_defined_1"}
+    assert all(0.0 <= float(v) <= 1.0 for v in out.values())
+    with pytest.raises(ModuleNotFoundError, match="clip_iqa"):
+        tm.CLIPImageQualityAssessment()
+
+
+# ----------------------------------------------------------------------- LVE
+
+def test_lve_parity():
+    tm_ref, torch = _oracle()
+    pred = _RNG.normal(size=(10, 100, 3)).astype(np.float32)
+    gt = _RNG.normal(size=(12, 100, 3)).astype(np.float32)
+    mouth = [0, 1, 2, 3, 4, 50, 51]
+    ours = F.lip_vertex_error(jnp.asarray(pred), jnp.asarray(gt), mouth)
+    ref = tm_ref.functional.multimodal.lip_vertex_error(torch.as_tensor(pred), torch.as_tensor(gt), mouth)
+    _assert_allclose(ours, ref.numpy(), atol=1e-5)
+    ours_m = tm.LipVertexError(mouth_map=mouth)
+    from torchmetrics.multimodal.lve import LipVertexError as RefLVE  # type: ignore
+
+    ref_m = RefLVE(mouth_map=mouth)
+    for _ in range(2):
+        ours_m.update(jnp.asarray(pred), jnp.asarray(gt))
+        ref_m.update(torch.as_tensor(pred), torch.as_tensor(gt))
+    _assert_allclose(ours_m.compute(), ref_m.compute().numpy(), atol=1e-5)
+
+
+# ------------------------------------------------------------------ BERTScore
+
+class ToyTokenizer:
+    """Whitespace tokenizer over a fixed hashed vocab, with CLS=1 / SEP=2 / PAD=0."""
+
+    def __call__(self, texts, padding=True, truncation=False, max_length=None, return_tensors="np"):
+        rows = [[1] + [3 + (hash(w) % 60) for w in t.split()] + [2] for t in texts]
+        if truncation and max_length:
+            rows = [r[:max_length] for r in rows]
+        width = max(len(r) for r in rows)
+        input_ids = np.zeros((len(rows), width), np.int64)
+        attention_mask = np.zeros((len(rows), width), np.int64)
+        for i, r in enumerate(rows):
+            input_ids[i, : len(r)] = r
+            attention_mask[i, : len(r)] = 1
+        if return_tensors == "pt":
+            import torch
+
+            return {"input_ids": torch.as_tensor(input_ids), "attention_mask": torch.as_tensor(attention_mask)}
+        return {"input_ids": input_ids, "attention_mask": attention_mask}
+
+
+def _jnp_embedder(input_ids, attention_mask):
+    return np.asarray(_EMB)[np.asarray(input_ids)]
+
+
+def _torch_embedder():
+    import torch
+
+    class M(torch.nn.Module):
+        def forward(self, input_ids, attention_mask):
+            return torch.from_numpy(_EMB)[input_ids]
+
+    return M()
+
+
+# lengths strictly ascending in BOTH lists: the reference length-sorts sentences and
+# restores order with a double permutation that is only correct when the sort is the
+# identity — aligned fixtures keep its scores pair-aligned for the comparison
+PREDS = ["hello world", "the cat sat on mats", "a very quick brown fox jumps high"]
+TARGET = ["hello there", "a cat sat on mats", "the quick brown fox jumped so high"]
+
+
+@pytest.mark.parametrize("idf", [False, True])
+def test_bert_score_parity_user_model(idf):
+    tm_ref, torch = _oracle()
+    ours = F.bert_score(PREDS, TARGET, model=_jnp_embedder, user_tokenizer=ToyTokenizer(), idf=idf)
+    ref = tm_ref.functional.text.bert_score(
+        PREDS, TARGET,
+        model=_torch_embedder(),
+        user_tokenizer=ToyTokenizer(),
+        user_forward_fn=lambda model, batch: model(batch["input_ids"], batch["attention_mask"]),
+        idf=idf,
+    )
+    for key in ("precision", "recall", "f1"):
+        _assert_allclose(ours[key], np.asarray(ref[key]), atol=1e-4, msg=f"key={key} idf={idf}")
+
+
+def test_bert_score_class_matches_functional():
+    m = tm.BERTScore(model=_jnp_embedder, user_tokenizer=ToyTokenizer(), max_length=24)
+    m.update(PREDS[:2], TARGET[:2])
+    m.update(PREDS[2:], TARGET[2:])
+    out = m.compute()
+    direct = F.bert_score(PREDS, TARGET, model=_jnp_embedder, user_tokenizer=ToyTokenizer())
+    for key in ("precision", "recall", "f1"):
+        _assert_allclose(out[key], np.asarray(direct[key]), atol=1e-4, msg=key)
+
+
+def test_bert_score_multi_reference_best_f1():
+    multi = [["a cat sat on the mat", "completely unrelated words here"]]
+    single = F.bert_score(["the cat sat on the mat"], ["a cat sat on the mat"],
+                          model=_jnp_embedder, user_tokenizer=ToyTokenizer())
+    best = F.bert_score(["the cat sat on the mat"], multi, model=_jnp_embedder, user_tokenizer=ToyTokenizer())
+    _assert_allclose(best["f1"], np.asarray(single["f1"]), atol=1e-6)
+
+
+def test_model_backed_gates():
+    with pytest.raises(ModuleNotFoundError, match="local HF cache|transformers"):
+        F.bert_score(PREDS, TARGET, model_name_or_path="roberta-large")
+    with pytest.raises(ModuleNotFoundError, match="masked language model"):
+        tm.InfoLM()
+    with pytest.raises(ModuleNotFoundError, match="vmaf"):
+        tm.VideoMultiMethodAssessmentFusion()
+    with pytest.raises(ModuleNotFoundError, match="baseline"):
+        F.bert_score(PREDS, TARGET, model=_jnp_embedder, user_tokenizer=ToyTokenizer(), rescale_with_baseline=True)

@@ -9,7 +9,7 @@ Every domain package declares its public classes in its own ``__all__``; the fla
 namespace aggregates them (reference ``__init__.py`` re-exports ~100 names the same
 way, hand-listed)."""
 
-from torchmetrics_tpu import audio, classification, clustering, detection, functional, image, nominal, parallel, regression, retrieval, segmentation, shape, text, utilities, wrappers
+from torchmetrics_tpu import audio, classification, clustering, detection, functional, image, multimodal, nominal, parallel, regression, retrieval, segmentation, shape, text, utilities, video, wrappers
 from torchmetrics_tpu.aggregation import (
     CatMetric,
     MaxMetric,
@@ -24,9 +24,11 @@ from torchmetrics_tpu.classification import *  # noqa: F401,F403
 from torchmetrics_tpu.clustering import *  # noqa: F401,F403
 from torchmetrics_tpu.detection import *  # noqa: F401,F403
 from torchmetrics_tpu.image import *  # noqa: F401,F403
+from torchmetrics_tpu.multimodal import *  # noqa: F401,F403
 from torchmetrics_tpu.nominal import *  # noqa: F401,F403
 from torchmetrics_tpu.shape import *  # noqa: F401,F403
 from torchmetrics_tpu.text import *  # noqa: F401,F403
+from torchmetrics_tpu.video import *  # noqa: F401,F403
 from torchmetrics_tpu.collections import MetricCollection
 from torchmetrics_tpu.metric import CompositionalMetric, Metric
 from torchmetrics_tpu.regression import *  # noqa: F401,F403
@@ -71,9 +73,11 @@ __all__ = [
     "clustering",
     "detection",
     "image",
+    "multimodal",
     "nominal",
     "shape",
     "text",
+    "video",
     "segmentation",
     "utilities",
     "wrappers",
@@ -84,8 +88,10 @@ __all__ = [
     *clustering.__all__,
     *detection.__all__,
     *image.__all__,
+    *multimodal.__all__,
     *nominal.__all__,
     *shape.__all__,
     *text.__all__,
+    *video.__all__,
     *segmentation.__all__,
 ]

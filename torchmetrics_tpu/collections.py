@@ -26,6 +26,20 @@ from .utilities.prints import rank_zero_warn
 _ERROR_MSG = "Unknown input to MetricCollection."
 
 
+def _flatten_with_naming(res: Dict[str, Any], set_name) -> Dict[str, Any]:
+    """Flatten nested dict results; bare sub-keys unless they collide across metrics."""
+    _, duplicates = _flatten_dict(res)
+    out: Dict[str, Any] = {}
+    for k, v in res.items():
+        if isinstance(v, dict):
+            for sub_k, sub_v in v.items():
+                key = f"{k}_{sub_k}" if duplicates else sub_k
+                out[set_name(key)] = sub_v
+        else:
+            out[set_name(k)] = v
+    return out
+
+
 class MetricCollection:
     """Dict-of-metrics with single update/compute/reset (reference collections.py:59)."""
 
@@ -285,16 +299,7 @@ class MetricCollection:
 
     def _flatten_res(self, res: Dict[str, Any]) -> Dict[str, Any]:
         """Flatten nested dict outputs + apply prefix/postfix (reference :388-407)."""
-        _, duplicates = _flatten_dict(res)
-        out = {}
-        for k, v in res.items():
-            if isinstance(v, dict):
-                for sub_k, sub_v in v.items():
-                    key = f"{k}_{sub_k}" if duplicates else sub_k
-                    out[self._set_name(key)] = sub_v
-            else:
-                out[self._set_name(k)] = v
-        return out
+        return _flatten_with_naming(res, self._set_name)
 
     def reset(self) -> None:
         for metric in self._modules.values():
@@ -407,16 +412,7 @@ class PureCollection:
         """Values for every metric from its state (pure, jittable). Key naming follows
         the stateful path's ``_flatten_res`` (bare sub-keys unless they collide)."""
         res = {name: m.compute_state(states[name]) for name, m in self._metrics.items()}
-        _, duplicates = _flatten_dict(res)
-        out: Dict[str, Any] = {}
-        for name, value in res.items():
-            if isinstance(value, dict):
-                for sub_k, sub_v in value.items():
-                    key = f"{name}_{sub_k}" if duplicates else sub_k
-                    out[self._set_name(key)] = sub_v
-            else:
-                out[self._set_name(name)] = value
-        return out
+        return _flatten_with_naming(res, self._set_name)
 
     def apply(self, states: Dict[str, Any], *args: Any, **kwargs: Any) -> Tuple[Dict[str, Any], Dict[str, Any]]:
         """Fused eval step: update all states AND emit current values (pure)."""

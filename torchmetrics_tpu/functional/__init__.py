@@ -5,7 +5,7 @@ Every domain package declares its public surface in its own ``__all__``; this mo
 aggregates them so the flat ``torchmetrics_tpu.functional.<fn>`` namespace stays in
 lock-step with the per-domain namespaces as domains are added."""
 
-from torchmetrics_tpu.functional import audio, classification, clustering, detection, image, nominal, pairwise, regression, retrieval, segmentation, shape, text
+from torchmetrics_tpu.functional import audio, classification, clustering, detection, image, multimodal, nominal, pairwise, regression, retrieval, segmentation, shape, text
 from torchmetrics_tpu.functional.audio import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.classification import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.regression import *  # noqa: F401,F403
@@ -13,6 +13,7 @@ from torchmetrics_tpu.functional.retrieval import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.clustering import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.detection import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.image import *  # noqa: F401,F403
+from torchmetrics_tpu.functional.multimodal import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.nominal import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.pairwise import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.shape import *  # noqa: F401,F403
@@ -27,6 +28,7 @@ __all__ = [
     *clustering.__all__,
     *detection.__all__,
     *image.__all__,
+    *multimodal.__all__,
     *nominal.__all__,
     *pairwise.__all__,
     *shape.__all__,

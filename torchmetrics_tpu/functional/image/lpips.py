@@ -219,6 +219,9 @@ def convert_lpips_weights(backbone_state_dict: Dict, lpips_state_dict: Dict, net
         pickle.dump({"backbone": backbone, "lins": lins}, f)
 
 
+_NET_CACHE: Dict[Tuple, "LPIPSNetwork"] = {}
+
+
 def learned_perceptual_image_patch_similarity(
     img1,
     img2,
@@ -228,8 +231,13 @@ def learned_perceptual_image_patch_similarity(
     weights_path: Optional[str] = None,
     pretrained: bool = True,
 ) -> jnp.ndarray:
-    """One-shot LPIPS between two image batches (see ``LPIPSNetwork``)."""
-    net = LPIPSNetwork(net_type, pretrained=pretrained, weights_path=weights_path)
+    """One-shot LPIPS between two image batches (see ``LPIPSNetwork``). The network
+    (params + jitted forward) is cached per configuration — per-call construction
+    would re-trace the whole backbone every batch."""
+    key = (net_type, pretrained, weights_path)
+    if key not in _NET_CACHE:
+        _NET_CACHE[key] = LPIPSNetwork(net_type, pretrained=pretrained, weights_path=weights_path)
+    net = _NET_CACHE[key]
     loss = net(img1, img2, normalize=normalize)
     if reduction == "mean":
         return loss.mean()

@@ -1,0 +1,6 @@
+"""Multimodal tower — stateless kernels (reference ``src/torchmetrics/functional/multimodal/``)."""
+
+from .clip_score import clip_score
+from .lve import lip_vertex_error
+
+__all__ = ["clip_score", "lip_vertex_error"]

@@ -1,0 +1,138 @@
+"""CLIPScore (reference ``functional/multimodal/clip_score.py``).
+
+The embedder is pluggable: ``model_name_or_path`` is a HF CLIP checkpoint (loaded
+``local_files_only`` — an air-gapped pod cannot download; a clear error points at the
+cache requirement) or any object exposing ``get_image_features(images) -> (N, D)`` and
+``get_text_features(texts) -> (N, D)`` returning jnp arrays (e.g. a jitted flax CLIP
+apply). The scoring itself — paired cosine similarity x 100, clamped at 0 — is a tiny
+jnp expression over whatever embedder is plugged in.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Tuple, Union
+
+import jax.numpy as jnp
+
+from ...utilities.imports import _module_available
+
+_TRANSFORMERS_AVAILABLE = _module_available("transformers")
+
+
+def _detect_modality(input_data) -> str:
+    if hasattr(input_data, "shape"):
+        return "image"
+    if isinstance(input_data, list):
+        if len(input_data) == 0:
+            raise ValueError("Empty input list")
+        if hasattr(input_data[0], "shape"):
+            return "image"
+        if isinstance(input_data[0], str):
+            return "text"
+    if isinstance(input_data, str):
+        return "text"
+    raise ValueError("Could not automatically determine modality for input_data")
+
+
+def _process_image_data(images) -> List:
+    images = [images] if hasattr(images, "shape") and images.ndim == 3 else list(images)
+    if not all(hasattr(i, "shape") and i.ndim == 3 for i in images):
+        raise ValueError("Expected all images to be 3d but found image that has either more or less")
+    return images
+
+
+def _process_text_data(texts) -> List[str]:
+    return [texts] if not isinstance(texts, list) else texts
+
+
+class _HFClipWrapper:
+    """Adapts a HF CLIPModel+Processor to the pluggable embedder protocol."""
+
+    def __init__(self, model_name_or_path: str) -> None:
+        if not _TRANSFORMERS_AVAILABLE:
+            raise ModuleNotFoundError(
+                "`clip_score` metric requires `transformers` package be installed."
+                " Either install with `pip install transformers>=4.10.0` or `pip install torchmetrics[multimodal]`."
+            )
+        import torch  # noqa: F401
+        from transformers import CLIPModel, CLIPProcessor
+
+        try:
+            self.model = CLIPModel.from_pretrained(model_name_or_path, local_files_only=True)
+            self.processor = CLIPProcessor.from_pretrained(model_name_or_path, local_files_only=True)
+        except Exception as err:
+            raise ModuleNotFoundError(
+                f"CLIP checkpoint {model_name_or_path!r} is not in the local HF cache and this "
+                "environment has no network egress to download it. Pre-populate the cache offline, "
+                "or pass a custom embedder object with get_image_features/get_text_features."
+            ) from err
+        self.model.eval()
+
+    def get_image_features(self, images) -> jnp.ndarray:
+        import numpy as np
+        import torch
+
+        processed = self.processor(images=[np.asarray(i) for i in images], return_tensors="pt", padding=True)
+        with torch.no_grad():
+            feats = self.model.get_image_features(processed["pixel_values"])
+        return jnp.asarray(feats.numpy())
+
+    def get_text_features(self, texts: List[str]) -> jnp.ndarray:
+        import torch
+
+        processed = self.processor(text=texts, return_tensors="pt", padding=True)
+        max_pos = getattr(getattr(self.model.config, "text_config", None), "max_position_embeddings", None)
+        if max_pos is not None and processed["attention_mask"].shape[-1] > max_pos:
+            processed = {k: v[..., :max_pos] for k, v in processed.items()}
+        with torch.no_grad():
+            feats = self.model.get_text_features(processed["input_ids"], processed["attention_mask"])
+        return jnp.asarray(feats.numpy())
+
+
+def _resolve_clip(model_name_or_path: Union[str, Any]):
+    if isinstance(model_name_or_path, str):
+        return _HFClipWrapper(model_name_or_path)
+    if hasattr(model_name_or_path, "get_image_features") and hasattr(model_name_or_path, "get_text_features"):
+        return model_name_or_path
+    raise ValueError(
+        "Expected `model_name_or_path` to be a HF checkpoint string or an object with "
+        "get_image_features/get_text_features."
+    )
+
+
+def _get_features(data, modality: str, model) -> jnp.ndarray:
+    if modality == "image":
+        return jnp.asarray(model.get_image_features(data))
+    if modality == "text":
+        return jnp.asarray(model.get_text_features(data))
+    raise ValueError(f"invalid modality {modality}")
+
+
+def _clip_score_update(source, target, model) -> Tuple[jnp.ndarray, int]:
+    source_modality = _detect_modality(source)
+    target_modality = _detect_modality(target)
+    source_data = _process_image_data(source) if source_modality == "image" else _process_text_data(source)
+    target_data = _process_image_data(target) if target_modality == "image" else _process_text_data(target)
+    if len(source_data) != len(target_data):
+        raise ValueError(
+            "Expected the number of source and target examples to be the same but got "
+            f"{len(source_data)} and {len(target_data)}"
+        )
+    source_features = _get_features(source_data, source_modality, model)
+    target_features = _get_features(target_data, target_modality, model)
+    source_features = source_features / jnp.linalg.norm(source_features, axis=-1, keepdims=True)
+    target_features = target_features / jnp.linalg.norm(target_features, axis=-1, keepdims=True)
+    score = 100 * (source_features * target_features).sum(axis=-1)
+    return score, len(source_data)
+
+
+def clip_score(
+    source,
+    target,
+    model_name_or_path: Union[str, Any] = "openai/clip-vit-large-patch14",
+) -> jnp.ndarray:
+    r"""CLIPScore: ``max(100 * cos(E_source, E_target), 0)`` averaged over pairs;
+    source/target can each be images or texts."""
+    model = _resolve_clip(model_name_or_path)
+    score, _ = _clip_score_update(source, target, model)
+    return jnp.maximum(score.mean(), 0.0)

@@ -1,0 +1,41 @@
+"""Lip Vertex Error (reference ``functional/multimodal/lve.py``)."""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import jax.numpy as jnp
+
+
+def lip_vertex_error(
+    vertices_pred,
+    vertices_gt,
+    mouth_map: Sequence[int],
+    validate_args: bool = True,
+) -> jnp.ndarray:
+    r"""Mean over frames of the max squared L2 error over lip vertices:
+    ``LVE = mean_i max_{v in lip} ||x_{i,v} - xhat_{i,v}||^2``."""
+    vertices_pred = jnp.asarray(vertices_pred)
+    vertices_gt = jnp.asarray(vertices_gt)
+    if validate_args:
+        if vertices_pred.ndim != 3 or vertices_gt.ndim != 3:
+            raise ValueError(
+                f"Expected both vertices_pred and vertices_gt to have 3 dimensions but got "
+                f"{vertices_pred.ndim} and {vertices_gt.ndim} dimensions respectively."
+            )
+        if vertices_pred.shape[1:] != vertices_gt.shape[1:]:
+            raise ValueError(
+                f"Expected vertices_pred and vertices_gt to have same vertex and coordinate dimensions but got "
+                f"{vertices_pred.shape} and {vertices_gt.shape}."
+            )
+        if len(mouth_map) == 0:
+            raise ValueError("Expected mouth_map to be non-empty.")
+        if max(mouth_map) >= vertices_gt.shape[1]:
+            raise ValueError(
+                f"Invalid vertex index {max(mouth_map)} in mouth_map for mesh with {vertices_gt.shape[1]} vertices."
+            )
+    min_frames = min(vertices_pred.shape[0], vertices_gt.shape[0])
+    pred_mouth = vertices_pred[:min_frames, jnp.asarray(list(mouth_map))]
+    gt_mouth = vertices_gt[:min_frames, jnp.asarray(list(mouth_map))]
+    sq_err = ((pred_mouth - gt_mouth) ** 2).sum(axis=-1)  # (T, |mouth|)
+    return sq_err.max(axis=-1).mean()

@@ -1,5 +1,6 @@
 """Text tower — stateless kernels (reference ``src/torchmetrics/functional/text/``)."""
 
+from .bert import bert_score
 from .asr import (
     char_error_rate,
     match_error_rate,
@@ -18,6 +19,7 @@ from .squad import squad
 from .ter import translation_edit_rate
 
 __all__ = [
+    "bert_score",
     "bleu_score",
     "char_error_rate",
     "chrf_score",

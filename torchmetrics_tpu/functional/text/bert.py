@@ -1,0 +1,211 @@
+"""BERTScore (reference ``functional/text/bert.py``; Zhang et al., ICLR 2020).
+
+The contextual embedder is pluggable: ``model_name_or_path`` loads a HF model from the
+*local* cache (no egress), or ``model`` + ``user_tokenizer`` (+ optional
+``user_forward_fn``) supply a custom pipeline — the same seam the reference exposes.
+The matching math (normalized embeddings, special-token masking, IDF weighting, greedy
+cosine alignment) is one fused jnp einsum pipeline.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...utilities.imports import _module_available
+
+_TRANSFORMERS_AVAILABLE = _module_available("transformers")
+
+
+def _load_hf(model_name_or_path: str, num_layers: Optional[int]):
+    if not _TRANSFORMERS_AVAILABLE:
+        raise ModuleNotFoundError(
+            "`bert_score` metric with default models requires `transformers` package be installed."
+            " Either install with `pip install transformers>=4.4` or `pip install torchmetrics[text]`."
+        )
+    import torch
+    from transformers import AutoModel, AutoTokenizer
+
+    try:
+        tokenizer = AutoTokenizer.from_pretrained(model_name_or_path, local_files_only=True)
+        hf_model = AutoModel.from_pretrained(model_name_or_path, local_files_only=True)
+    except Exception as err:
+        raise ModuleNotFoundError(
+            f"Model {model_name_or_path!r} is not in the local HF cache and this environment has "
+            "no network egress to download it. Pre-populate the cache offline, or pass "
+            "`model` + `user_tokenizer` for a custom embedding pipeline."
+        ) from err
+    hf_model.eval()
+
+    def forward(input_ids: np.ndarray, attention_mask: np.ndarray) -> np.ndarray:
+        with torch.no_grad():
+            out = hf_model(
+                torch.as_tensor(input_ids), torch.as_tensor(attention_mask), output_hidden_states=True
+            )
+        layer = num_layers if num_layers is not None else -1
+        return out.hidden_states[layer].numpy()
+
+    return tokenizer, forward
+
+
+def _tokenize(tokenizer, texts: List[str], max_length: int, truncation: bool) -> Dict[str, np.ndarray]:
+    out = tokenizer(
+        texts, padding=True, truncation=truncation, max_length=max_length if truncation else None,
+        return_tensors="np",
+    )
+    return {"input_ids": np.asarray(out["input_ids"]), "attention_mask": np.asarray(out["attention_mask"])}
+
+
+def _process_attention_mask_for_special_tokens(attention_mask: np.ndarray) -> np.ndarray:
+    """Zero out the first token (CLS) and the last attended token (SEP) per row
+    (reference helper_embedding_metric semantics)."""
+    mask = attention_mask.copy().astype(np.float32)
+    mask[:, 0] = 0
+    last = attention_mask.sum(axis=1).astype(int) - 1
+    mask[np.arange(mask.shape[0]), np.clip(last, 0, None)] = 0
+    return mask
+
+
+def _idf_weights(input_ids: np.ndarray, attention_mask: np.ndarray) -> Dict[int, float]:
+    """log((N+1)/(df+1)) document-frequency IDF over the corpus rows; unseen tokens
+    default to log(N+1) (reference helper_embedding_metric.py:259-261)."""
+    num_docs = input_ids.shape[0]
+    df: Counter = Counter()
+    for row, mask in zip(input_ids, attention_mask):
+        df.update(set(row[mask.astype(bool)].tolist()))
+    weights = {tok: float(np.log((num_docs + 1) / (cnt + 1))) for tok, cnt in df.items()}
+    weights["__default__"] = float(np.log(num_docs + 1))
+    return weights
+
+
+def _apply_idf(input_ids: np.ndarray, weights: Dict[int, float]) -> np.ndarray:
+    default = weights.get("__default__", 0.0)
+    lookup = np.vectorize(lambda t: weights.get(int(t), default), otypes=[np.float32])
+    return lookup(input_ids)
+
+
+def _pad_to(arr: np.ndarray, length: int, value: float = 0) -> np.ndarray:
+    if arr.shape[1] >= length:
+        return arr
+    pad = np.full((arr.shape[0], length - arr.shape[1], *arr.shape[2:]), value, arr.dtype)
+    return np.concatenate([arr, pad], axis=1)
+
+
+def _embed(
+    forward: Callable,
+    input_ids: np.ndarray,
+    attention_mask: np.ndarray,
+    target_len: int,
+    idf: bool,
+    idf_lookup: Optional[Dict[int, float]],
+    batch_size: int,
+):
+    """Normalized, special-token-masked embeddings + per-token scale weights."""
+    emb_chunks = []
+    for start in range(0, input_ids.shape[0], batch_size):
+        emb_chunks.append(np.asarray(forward(input_ids[start : start + batch_size], attention_mask[start : start + batch_size])))
+    emb = np.concatenate(emb_chunks) if emb_chunks else np.zeros((0, input_ids.shape[1], 1))
+    emb = emb / np.clip(np.linalg.norm(emb, axis=-1, keepdims=True), 1e-12, None)
+    processed_mask = _process_attention_mask_for_special_tokens(attention_mask)
+    emb = emb * processed_mask[:, :, None]
+    if idf:
+        scale = _apply_idf(input_ids, idf_lookup) * processed_mask
+    else:
+        scale = processed_mask.astype(np.float32)
+    scale = scale / np.clip(scale.sum(-1, keepdims=True), 1e-12, None)
+    return _pad_to(emb, target_len), _pad_to(scale, target_len)
+
+
+def _score_pairs(p_emb, p_scale, t_emb, t_scale):
+    cos = jnp.einsum("bpd,brd->bpr", jnp.asarray(p_emb), jnp.asarray(t_emb))
+    precision = (cos.max(axis=2) * jnp.asarray(p_scale)).sum(-1)
+    recall = (cos.max(axis=1) * jnp.asarray(t_scale)).sum(-1)
+    f1 = 2 * precision * recall / jnp.clip(precision + recall, 1e-12)
+    return precision, recall, f1
+
+
+def bert_score(
+    preds: Union[str, Sequence[str], Dict[str, np.ndarray]],
+    target: Union[str, Sequence[str], Sequence[Sequence[str]], Dict[str, np.ndarray]],
+    model_name_or_path: Optional[str] = None,
+    num_layers: Optional[int] = None,
+    all_layers: bool = False,
+    model: Optional[Callable] = None,
+    user_tokenizer: Any = None,
+    user_forward_fn: Optional[Callable] = None,
+    verbose: bool = False,
+    idf: bool = False,
+    device: Optional[Any] = None,
+    max_length: int = 512,
+    batch_size: int = 64,
+    num_threads: int = 0,
+    return_hash: bool = False,
+    lang: str = "en",
+    rescale_with_baseline: bool = False,
+    baseline_path: Optional[str] = None,
+    baseline_url: Optional[str] = None,
+    truncation: bool = False,
+) -> Dict[str, jnp.ndarray]:
+    """BERTScore precision/recall/F1 via greedy cosine matching of contextual
+    embeddings. Multiple references per prediction score as the best F1."""
+    if all_layers:
+        raise ValueError("`all_layers=True` is only meaningful with per-layer baselines; use num_layers instead.")
+    if rescale_with_baseline:
+        raise ModuleNotFoundError(
+            "`rescale_with_baseline` requires downloading the published baseline files, which an "
+            "air-gapped environment cannot do."
+        )
+    if isinstance(preds, str):
+        preds = [preds]
+    multi_ref = (
+        not isinstance(target, (str, dict))
+        and len(target) > 0
+        and isinstance(target[0], (list, tuple))
+    )
+    if multi_ref:
+        results = []
+        for ref_idx in range(max(len(t) for t in target)):
+            flat_refs = [t[min(ref_idx, len(t) - 1)] for t in target]
+            results.append(
+                bert_score(
+                    preds, flat_refs, model_name_or_path, num_layers, all_layers, model, user_tokenizer,
+                    user_forward_fn, verbose, idf, device, max_length, batch_size, num_threads,
+                    False, lang, rescale_with_baseline, baseline_path, baseline_url, truncation,
+                )
+            )
+        f1s = jnp.stack([r["f1"] for r in results])
+        best = jnp.argmax(f1s, axis=0)
+        pick = lambda key: jnp.take_along_axis(jnp.stack([r[key] for r in results]), best[None], axis=0)[0]
+        return {"precision": pick("precision"), "recall": pick("recall"), "f1": pick("f1")}
+    if isinstance(target, str):
+        target = [target]
+
+    if model is not None:
+        if user_tokenizer is None and not isinstance(preds, dict):
+            raise ValueError("The model must be accompanied by a `user_tokenizer` (or pre-tokenized dict inputs).")
+        forward = (lambda ids, mask: user_forward_fn(model, {"input_ids": ids, "attention_mask": mask})) if user_forward_fn else model
+        tokenizer = user_tokenizer
+    else:
+        tokenizer, forward = _load_hf(model_name_or_path or "roberta-large", num_layers)
+
+    if isinstance(preds, dict):
+        preds_tok = {"input_ids": np.asarray(preds["input_ids"]), "attention_mask": np.asarray(preds["attention_mask"])}
+        target_tok = {"input_ids": np.asarray(target["input_ids"]), "attention_mask": np.asarray(target["attention_mask"])}
+    else:
+        preds_tok = _tokenize(tokenizer, list(preds), max_length, truncation)
+        target_tok = _tokenize(tokenizer, list(target), max_length, truncation)
+    if preds_tok["input_ids"].shape[0] != target_tok["input_ids"].shape[0]:
+        raise ValueError("Number of predicted and reference sentences must be the same.")
+
+    idf_lookup = _idf_weights(target_tok["input_ids"], target_tok["attention_mask"]) if idf else None
+    target_len = max(preds_tok["input_ids"].shape[1], target_tok["input_ids"].shape[1])
+    p_emb, p_scale = _embed(forward, preds_tok["input_ids"], preds_tok["attention_mask"], target_len, idf, idf_lookup, batch_size)
+    t_emb, t_scale = _embed(forward, target_tok["input_ids"], target_tok["attention_mask"], target_len, idf, idf_lookup, batch_size)
+    precision, recall, f1 = _score_pairs(p_emb, p_scale, t_emb, t_scale)
+    out = {"precision": precision, "recall": recall, "f1": f1}
+    if return_hash:
+        out["hash"] = f"{model_name_or_path}_L{num_layers}_idf={idf}"
+    return out

@@ -307,10 +307,14 @@ def convert_torchvision_inception_weights(state_dict: Dict[str, Any], out_path: 
 
 
 def resolve_feature_extractor(
-    feature, normalize: bool, input_img_size: Tuple[int, int, int] = (3, 299, 299)
+    feature,
+    normalize: bool,
+    input_img_size: Tuple[int, int, int] = (3, 299, 299),
+    weights_path: Optional[str] = None,
 ) -> Tuple[Callable, int, bool]:
     """Reference ``feature: int | Module`` resolution: int selects the in-tree
-    InceptionV3 (weights required for meaningful values), any callable is used as-is.
+    InceptionV3 (converted weights REQUIRED — random features would yield plausible
+    but meaningless scores), any callable is used as-is.
     Returns (extractor, num_features, used_custom)."""
     if isinstance(feature, int):
         if feature != 2048:
@@ -318,7 +322,15 @@ def resolve_feature_extractor(
                 "The in-tree InceptionV3 extractor exposes the 2048-d pool3 features; "
                 f"got feature={feature}. Pass a custom callable for other dimensions."
             )
-        return InceptionV3Features(), 2048, False
+        if weights_path is None:
+            raise ModuleNotFoundError(
+                "The integer `feature` selector needs converted InceptionV3 weights, which "
+                "cannot be downloaded in an air-gapped environment. Convert them offline with "
+                "`convert_torchvision_inception_weights` and pass "
+                "`feature_extractor_weights_path`, or pass a custom extractor callable "
+                "(e.g. `InceptionV3Features()` explicitly for random-weight throughput tests)."
+            )
+        return InceptionV3Features(weights_path), 2048, False
     if callable(feature):
         num_features = getattr(feature, "num_features", None)
         if num_features is None:

@@ -55,21 +55,9 @@ class FrechetInceptionDistance(Metric):
             raise ValueError("Argument `normalize` expected to be a bool")
         self.normalize = normalize
         self.antialias = antialias
-        if isinstance(feature, int) and feature_extractor_weights_path is not None:
-            from ._extractors import InceptionV3Features
-
-            if feature != 2048:
-                raise ValueError(
-                    "The in-tree InceptionV3 extractor exposes the 2048-d pool3 features; "
-                    f"got feature={feature}. Pass a custom callable for other dimensions."
-                )
-            self.inception, num_features, self.used_custom_model = (
-                InceptionV3Features(feature_extractor_weights_path), 2048, False,
-            )
-        else:
-            self.inception, num_features, self.used_custom_model = resolve_feature_extractor(
-                feature, normalize, input_img_size
-            )
+        self.inception, num_features, self.used_custom_model = resolve_feature_extractor(
+            feature, normalize, input_img_size, weights_path=feature_extractor_weights_path
+        )
         if not isinstance(reset_real_features, bool):
             raise ValueError("Argument `reset_real_features` expected to be a bool")
         self.reset_real_features = reset_real_features

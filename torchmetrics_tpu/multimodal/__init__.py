@@ -1,0 +1,7 @@
+"""Multimodal tower — stateful metric classes (reference ``src/torchmetrics/multimodal/``)."""
+
+from .clip_iqa import CLIPImageQualityAssessment
+from .clip_score import CLIPScore
+from .lve import LipVertexError
+
+__all__ = ["CLIPImageQualityAssessment", "CLIPScore", "LipVertexError"]

@@ -1,0 +1,105 @@
+"""CLIPImageQualityAssessment (reference ``multimodal/clip_iqa.py:57``).
+
+CLIP-IQA scores an image against positive/negative prompt pairs via softmax over the
+two prompt similarities. The prompt machinery is implemented; the embedder follows the
+same pluggable protocol as CLIPScore (HF local cache or custom object).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple, Union
+
+import jax.numpy as jnp
+
+from ..functional.multimodal.clip_score import _resolve_clip
+from ..metric import HostMetric
+
+_PROMPTS: Dict[str, Tuple[str, str]] = {
+    "quality": ("Good photo.", "Bad photo."),
+    "brightness": ("Bright photo.", "Dark photo."),
+    "noisiness": ("Clean photo.", "Noisy photo."),
+    "colorfullness": ("Colorful photo.", "Dull photo."),
+    "sharpness": ("Sharp photo.", "Blurry photo."),
+    "contrast": ("High contrast photo.", "Low contrast photo."),
+    "complexity": ("Complex photo.", "Simple photo."),
+    "natural": ("Natural photo.", "Synthetic photo."),
+    "happy": ("Happy photo.", "Sad photo."),
+    "scary": ("Scary photo.", "Peaceful photo."),
+    "new": ("New photo.", "Old photo."),
+    "real": ("Real photo.", "Abstract photo."),
+    "beautiful": ("Beautiful photo.", "Ugly photo."),
+    "lonely": ("Lonely photo.", "Sociable photo."),
+    "relaxing": ("Relaxing photo.", "Stressful photo."),
+}
+
+
+class CLIPImageQualityAssessment(HostMetric):
+    """Softmax(pos, neg) prompt-pair probabilities averaged over images. ``prompts``
+    entries are built-in names or custom (positive, negative) tuples."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self,
+        model_name_or_path: Union[str, Any] = "clip_iqa",
+        data_range: float = 1.0,
+        prompts: Tuple[Union[str, Tuple[str, str]], ...] = ("quality",),
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not (isinstance(data_range, (int, float)) and data_range > 0):
+            raise ValueError("Argument `data_range` should be a positive number.")
+        self.data_range = data_range
+        if model_name_or_path == "clip_iqa":
+            raise ModuleNotFoundError(
+                "The default `clip_iqa` checkpoint requires downloading CLIP-IQA weights, which "
+                "an air-gapped environment cannot do. Pass a HF checkpoint present in the local "
+                "cache or a custom embedder with get_image_features/get_text_features."
+            )
+        self.model = _resolve_clip(model_name_or_path)
+        self.prompt_names = []
+        self.prompt_pairs = []
+        for p in prompts:
+            if isinstance(p, str):
+                if p not in _PROMPTS:
+                    raise ValueError(f"Unknown prompt {p}. Available: {sorted(_PROMPTS)}")
+                self.prompt_names.append(p)
+                self.prompt_pairs.append(_PROMPTS[p])
+            elif isinstance(p, tuple) and len(p) == 2:
+                self.prompt_names.append(f"user_defined_{len(self.prompt_names)}")
+                self.prompt_pairs.append(p)
+            else:
+                raise ValueError("Argument `prompts` must contain prompt names or (positive, negative) tuples")
+        self._anchors = None
+        self.add_state("score_sum", jnp.zeros(len(self.prompt_pairs)), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros((), jnp.int32), dist_reduce_fx="sum")
+
+    def _prompt_anchors(self) -> jnp.ndarray:
+        if self._anchors is None:
+            texts = [t for pair in self.prompt_pairs for t in pair]
+            feats = jnp.asarray(self.model.get_text_features(texts))
+            feats = feats / jnp.linalg.norm(feats, axis=-1, keepdims=True)
+            self._anchors = feats.reshape(len(self.prompt_pairs), 2, -1)
+        return self._anchors
+
+    def _host_batch_state(self, images):
+        images = jnp.asarray(images, jnp.float32) / self.data_range
+        img_feats = jnp.asarray(self.model.get_image_features(list(images)))
+        img_feats = img_feats / jnp.linalg.norm(img_feats, axis=-1, keepdims=True)
+        anchors = self._prompt_anchors()  # (P, 2, D)
+        logits = 100 * jnp.einsum("nd,pcd->npc", img_feats, anchors)
+        probs = jnp.exp(logits[..., 0]) / (jnp.exp(logits[..., 0]) + jnp.exp(logits[..., 1]))  # (N, P)
+        return {"score_sum": probs.sum(axis=0), "total": jnp.asarray(images.shape[0], jnp.int32)}
+
+    def _compute(self, state):
+        avg = state["score_sum"] / state["total"]
+        if len(self.prompt_names) == 1:
+            return avg[0]
+        return {name: avg[i] for i, name in enumerate(self.prompt_names)}
+
+    def __hash__(self) -> int:
+        return hash((self.__class__.__name__, id(self)))

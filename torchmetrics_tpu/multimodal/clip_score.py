@@ -1,0 +1,42 @@
+"""CLIPScore metric class (reference ``multimodal/clip_score.py:49``; states ``:193-195``)."""
+
+from __future__ import annotations
+
+from typing import Any, Union
+
+import jax.numpy as jnp
+
+from ..functional.multimodal.clip_score import _clip_score_update, _resolve_clip
+from ..metric import HostMetric
+
+
+class CLIPScore(HostMetric):
+    """Running-mean CLIP score (two sum states; sync is two psums). The embedder is a
+    HF checkpoint (local cache only — no egress) or a custom object with
+    ``get_image_features``/``get_text_features`` (e.g. a jitted flax CLIP apply)."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = True
+    plot_lower_bound = 0.0
+    plot_upper_bound = 100.0
+
+    def __init__(
+        self,
+        model_name_or_path: Union[str, Any] = "openai/clip-vit-large-patch14",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.model = _resolve_clip(model_name_or_path)
+        self.add_state("score", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("n_samples", jnp.zeros((), jnp.int32), dist_reduce_fx="sum")
+
+    def _host_batch_state(self, source, target):
+        score, n_samples = _clip_score_update(source, target, self.model)
+        return {"score": score.sum(), "n_samples": jnp.asarray(n_samples, jnp.int32)}
+
+    def _compute(self, state):
+        return jnp.maximum(state["score"] / state["n_samples"], 0.0)
+
+    def __hash__(self) -> int:
+        return hash((self.__class__.__name__, id(self)))

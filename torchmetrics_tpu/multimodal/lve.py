@@ -1,0 +1,38 @@
+"""LipVertexError metric class (reference ``multimodal/lve.py:28``)."""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax.numpy as jnp
+
+from ..functional.multimodal.lve import lip_vertex_error
+from ..metric import Metric
+
+
+class LipVertexError(Metric):
+    """Running-mean LVE over update calls (sum + count states)."""
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+
+    def __init__(self, mouth_map: Sequence[int], validate_args: bool = True, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(mouth_map, (list, tuple)) or len(mouth_map) == 0:
+            raise ValueError(f"Expected argument `mouth_map` to be a non-empty list but got {mouth_map}")
+        self.mouth_map = list(mouth_map)
+        self.validate_args = validate_args
+        self.add_state("sum_lve", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros((), jnp.int32), dist_reduce_fx="sum")
+
+    def _prepare_inputs(self, vertices_pred, vertices_gt):
+        value = lip_vertex_error(vertices_pred, vertices_gt, self.mouth_map, self.validate_args)
+        return (value,), {}
+
+    def _batch_state(self, value):
+        return {"sum_lve": value, "total": jnp.asarray(1, jnp.int32)}
+
+    def _compute(self, state):
+        return state["sum_lve"] / state["total"]

@@ -1,7 +1,9 @@
 """Text tower — stateful metric classes (reference ``src/torchmetrics/text/``)."""
 
 from .metrics import (
+    BERTScore,
     BLEUScore,
+    InfoLM,
     ExtendedEditDistance,
     TranslationEditRate,
     CharErrorRate,
@@ -18,11 +20,13 @@ from .metrics import (
 )
 
 __all__ = [
+    "BERTScore",
     "BLEUScore",
     "CHRFScore",
     "CharErrorRate",
     "EditDistance",
     "ExtendedEditDistance",
+    "InfoLM",
     "MatchErrorRate",
     "Perplexity",
     "ROUGEScore",

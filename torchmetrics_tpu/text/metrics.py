@@ -507,3 +507,133 @@ class ExtendedEditDistance(HostMetric):
         if self.return_sentence_level_score:
             return average, jnp.asarray(state["sentence_eed"])
         return average
+
+
+class BERTScore(HostMetric):
+    """BERTScore (reference ``text/bert.py:59``): cat states of tokenized
+    input_ids/attention_mask (reference ``text/bert.py:220``); the embedding +
+    matching pipeline runs at compute."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self,
+        model_name_or_path: Optional[str] = None,
+        num_layers: Optional[int] = None,
+        all_layers: bool = False,
+        model: Optional[Callable] = None,
+        user_tokenizer: Optional[Any] = None,
+        user_forward_fn: Optional[Callable] = None,
+        verbose: bool = False,
+        idf: bool = False,
+        max_length: int = 512,
+        batch_size: int = 64,
+        return_hash: bool = False,
+        lang: str = "en",
+        rescale_with_baseline: bool = False,
+        baseline_path: Optional[str] = None,
+        truncation: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        from ..functional.text.bert import _load_hf, _tokenize
+
+        self.num_layers = num_layers
+        self.all_layers = all_layers
+        self.idf = idf
+        self.verbose = verbose
+        self.max_length = max_length
+        self.batch_size = batch_size
+        self.return_hash = return_hash
+        self.lang = lang
+        self.rescale_with_baseline = rescale_with_baseline
+        self.baseline_path = baseline_path
+        self.truncation = truncation
+        self.model_name_or_path = model_name_or_path
+        if model is not None:
+            if user_tokenizer is None:
+                raise ValueError("The model must be accompanied by a `user_tokenizer`.")
+            self._forward = (
+                (lambda ids, mask: user_forward_fn(model, {"input_ids": ids, "attention_mask": mask}))
+                if user_forward_fn
+                else model
+            )
+            self.tokenizer = user_tokenizer
+        else:
+            self.tokenizer, self._forward = _load_hf(model_name_or_path or "roberta-large", num_layers)
+        self._tokenize = _tokenize
+        self.add_state("preds_input_ids", default=[], dist_reduce_fx="cat")
+        self.add_state("preds_attention_mask", default=[], dist_reduce_fx="cat")
+        self.add_state("target_input_ids", default=[], dist_reduce_fx="cat")
+        self.add_state("target_attention_mask", default=[], dist_reduce_fx="cat")
+
+    def _host_batch_state(self, preds, target):
+        preds = [preds] if isinstance(preds, str) else list(preds)
+        target = [target] if isinstance(target, str) else list(target)
+        p = self._tokenize(self.tokenizer, preds, self.max_length, self.truncation)
+        t = self._tokenize(self.tokenizer, target, self.max_length, self.truncation)
+        for tok in (p, t):
+            if tok["input_ids"].shape[1] > self.max_length:
+                raise ValueError(
+                    f"Tokenized input of length {tok['input_ids'].shape[1]} exceeds max_length="
+                    f"{self.max_length}. Enable `truncation=True` or raise `max_length`."
+                )
+        pad = lambda arr: np.pad(arr, ((0, 0), (0, self.max_length - arr.shape[1])))
+        return {
+            "preds_input_ids": jnp.asarray(pad(p["input_ids"])),
+            "preds_attention_mask": jnp.asarray(pad(p["attention_mask"])),
+            "target_input_ids": jnp.asarray(pad(t["input_ids"])),
+            "target_attention_mask": jnp.asarray(pad(t["attention_mask"])),
+        }
+
+    def _compute(self, state):
+        from ..functional.text.bert import bert_score
+
+        preds = {
+            "input_ids": np.asarray(state["preds_input_ids"]),
+            "attention_mask": np.asarray(state["preds_attention_mask"]),
+        }
+        target = {
+            "input_ids": np.asarray(state["target_input_ids"]),
+            "attention_mask": np.asarray(state["target_attention_mask"]),
+        }
+        return bert_score(
+            preds, target, model=self._forward, user_tokenizer=self.tokenizer, idf=self.idf,
+            max_length=self.max_length, batch_size=self.batch_size, return_hash=self.return_hash,
+            lang=self.lang, rescale_with_baseline=self.rescale_with_baseline,
+            baseline_path=self.baseline_path, truncation=self.truncation,
+        )
+
+    def __hash__(self) -> int:
+        return hash((self.__class__.__name__, id(self)))
+
+
+class InfoLM(HostMetric):
+    """InfoLM surface (reference ``text/infolm.py:42``): information measures over
+    masked-LM token distributions. The default pipeline needs a HF masked LM, whose
+    weights cannot be downloaded in an air-gapped environment."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(
+        self,
+        model_name_or_path: str = "bert-base-uncased",
+        temperature: float = 0.25,
+        information_measure: str = "kl_divergence",
+        idf: bool = True,
+        alpha: Optional[float] = None,
+        beta: Optional[float] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        raise ModuleNotFoundError(
+            "InfoLM requires a pretrained HF masked language model, whose weights cannot be "
+            "downloaded in this air-gapped environment. Pre-populate the local HF cache offline "
+            "to enable it."
+        )

@@ -1,0 +1,5 @@
+"""Video tower — stateful metric classes (reference ``src/torchmetrics/video/``)."""
+
+from .vmaf import VideoMultiMethodAssessmentFusion
+
+__all__ = ["VideoMultiMethodAssessmentFusion"]

@@ -1,0 +1,39 @@
+"""VideoMultiMethodAssessmentFusion (reference ``video/vmaf.py:27``).
+
+VMAF fuses elementary video-quality features through a pretrained SVM; the reference
+delegates wholesale to the optional ``vmaf_torch`` wheel (its own gate raises without
+it, ``video/vmaf.py``). The wheel and its model files are not available in this
+environment, so the class gates with the same contract.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..metric import HostMetric
+from ..utilities.imports import _module_available
+
+_VMAF_TORCH_AVAILABLE = _module_available("vmaf_torch")
+
+
+class VideoMultiMethodAssessmentFusion(HostMetric):
+    """VMAF over video pairs (gated on the optional ``vmaf_torch`` wheel, exactly as
+    the reference is)."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 100.0
+
+    def __init__(self, elementary_features: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not _VMAF_TORCH_AVAILABLE:
+            raise ModuleNotFoundError(
+                "vmaf metric requires that vmaf-torch is installed."
+                " Install with `pip install vmaf-torch` (not available on PyPI for all platforms)."
+            )
+        raise NotImplementedError(
+            "vmaf-torch is importable but the TPU-native VMAF pipeline has not been ported; "
+            "the fusion SVM model files also require a download."
+        )  # pragma: no cover - unreachable without the wheel
