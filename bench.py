@@ -303,15 +303,12 @@ def main() -> None:
         ("coco_map_synthetic", bench_map),
         ("fid_inception_fwd", bench_fid),
         ("sync_allreduce_8dev_cpu", bench_sync_latency),
+        ("bertscore_clipscore", bench_bertscore_clipscore),
     ):
         try:
             extra[name] = fn()
         except Exception as err:  # keep the primary line alive whatever happens
             extra[name] = {"error": str(err)[:120]}
-    try:
-        extra["bertscore_clipscore"] = bench_bertscore_clipscore()
-    except Exception as err:
-        extra["bertscore_clipscore"] = {"error": str(err)[:120]}
 
     print(
         json.dumps(

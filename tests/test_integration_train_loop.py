@@ -1,0 +1,103 @@
+"""L6 integration: a toy pjit train/eval loop with metrics inside ``shard_map`` on the
+8-device CPU mesh (SURVEY §7 step 2's "one model running" milestone; the reference's
+analogue is ``tests/integrations/test_lightning.py``). Doubles as executable
+documentation for the recommended training-loop wiring.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from tests.helpers import _assert_allclose
+
+from torchmetrics_tpu import MeanMetric, MetricCollection
+from torchmetrics_tpu.classification import MulticlassAccuracy, MulticlassF1Score
+
+
+def _make_data(rng, n=512, d=16, num_classes=4):
+    w_true = rng.normal(size=(d, num_classes)).astype(np.float32)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    y = (x @ w_true + 0.1 * rng.normal(size=(n, num_classes))).argmax(-1).astype(np.int32)
+    return x, y
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs the 8-device CPU mesh")
+def test_pjit_train_eval_loop_with_metrics():
+    num_classes, d = 4, 16
+    rng = np.random.default_rng(0)
+    x, y = _make_data(rng, n=512, d=d, num_classes=num_classes)
+
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:8]), ("data",))
+    data_sharding = NamedSharding(mesh, P("data"))
+    replicated = NamedSharding(mesh, P())
+
+    params = {
+        "w": jnp.zeros((d, num_classes)),
+        "b": jnp.zeros((num_classes,)),
+    }
+    params = jax.device_put(params, replicated)
+    opt = optax.sgd(0.5)
+    opt_state = opt.init(params)
+
+    collection = MetricCollection({
+        "acc": MulticlassAccuracy(num_classes, average="micro", validate_args=False),
+        "f1": MulticlassF1Score(num_classes, average="macro", validate_args=False),
+    })
+    pure = collection.as_pure()
+    loss_metric = MeanMetric()
+
+    def loss_fn(params, xb, yb):
+        logits = xb @ params["w"] + params["b"]
+        return optax.softmax_cross_entropy_with_integer_labels(logits, yb).mean()
+
+    @jax.jit
+    def train_step(params, opt_state, xb, yb):
+        # data arrives sharded over the mesh; jit + shardings insert the collectives
+        loss, grads = jax.value_and_grad(loss_fn)(params, xb, yb)
+        updates, opt_state = opt.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    def eval_shard(params, xb, yb):
+        # per-shard metric state + in-graph psum — the in-graph sync plane
+        logits = xb @ params["w"] + params["b"]
+        local = pure.update(pure.init(), jax.nn.softmax(logits), yb)
+        return pure.reduce(local, "data")
+
+    eval_step = jax.jit(
+        jax.shard_map(eval_shard, mesh=mesh, in_specs=(P(), P("data"), P("data")), out_specs=P())
+    )
+
+    batch = 128
+    for epoch in range(30):
+        for start in range(0, len(x), batch):
+            xb = jax.device_put(jnp.asarray(x[start : start + batch]), data_sharding)
+            yb = jax.device_put(jnp.asarray(y[start : start + batch]), data_sharding)
+            params, opt_state, loss = train_step(params, opt_state, xb, yb)
+            loss_metric.update(loss)
+
+    # eval epoch: accumulate synced per-batch states into the stateful collection
+    final_states = pure.init()
+    merge = jax.jit(lambda a, b: jax.tree.map(jnp.add, a, b))  # all-sum states here
+    for start in range(0, len(x), batch):
+        xb = jax.device_put(jnp.asarray(x[start : start + batch]), data_sharding)
+        yb = jax.device_put(jnp.asarray(y[start : start + batch]), data_sharding)
+        final_states = merge(final_states, eval_step(params, xb, yb))
+    values = pure.compute(final_states)
+
+    # the model must actually have learned, and the sharded metrics must agree with a
+    # single-device recomputation over the full dataset
+    assert float(values["acc"]) > 0.9
+    single = MetricCollection({
+        "acc": MulticlassAccuracy(num_classes, average="micro", validate_args=False),
+        "f1": MulticlassF1Score(num_classes, average="macro", validate_args=False),
+    })
+    logits = jnp.asarray(x) @ params["w"] + params["b"]
+    single.update(jax.nn.softmax(logits), jnp.asarray(y))
+    _assert_allclose(values, single.compute(), atol=1e-5)
+    assert float(loss_metric.compute()) > 0.0

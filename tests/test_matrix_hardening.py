@@ -1,0 +1,221 @@
+"""Test-matrix hardening (VERDICT #7 / reference testers.py shape):
+
+- half-precision (bf16/f16) runs of representative kernels vs their f32 values
+  (reference ``run_precision_test_cpu/gpu``, testers.py:570-604)
+- differentiability: ``jax.grad`` through differentiable functionals
+  (reference ``run_differentiability_test``, testers.py:638)
+- in-graph shard_map coverage for tensor-state families that previously only ran
+  through the stateful plane (nominal, panoptic, audio, image, perplexity)
+- ``dist_sync_on_step`` semantics through an injected fake gather plane
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from tests.helpers import _assert_allclose
+
+import torchmetrics_tpu as tm
+import torchmetrics_tpu.functional as F
+
+_RNG = np.random.default_rng(11)
+
+
+# ------------------------------------------------------------- half precision
+
+_HALF_DATA = {
+    "mean_squared_error": (_RNG.random(64).astype(np.float32), _RNG.random(64).astype(np.float32)),
+    "mean_absolute_error": (_RNG.random(64).astype(np.float32), _RNG.random(64).astype(np.float32)),
+    "peak_signal_noise_ratio": (
+        _RNG.random((2, 3, 16, 16)).astype(np.float32), _RNG.random((2, 3, 16, 16)).astype(np.float32)),
+    "structural_similarity_index_measure": (
+        _RNG.random((2, 3, 32, 32)).astype(np.float32), _RNG.random((2, 3, 32, 32)).astype(np.float32)),
+    "signal_noise_ratio": (_RNG.random((4, 128)).astype(np.float32), _RNG.random((4, 128)).astype(np.float32)),
+    "pairwise_cosine_similarity": (_RNG.random((6, 8)).astype(np.float32),),
+}
+HALF_TOLS = {
+    "mean_squared_error": 1e-2,
+    "mean_absolute_error": 1e-2,
+    "peak_signal_noise_ratio": 0.3,
+    "structural_similarity_index_measure": 5e-2,
+    "signal_noise_ratio": 0.5,
+    "pairwise_cosine_similarity": 2e-2,
+}
+
+
+@pytest.mark.parametrize("dtype", [jnp.bfloat16, jnp.float16], ids=["bf16", "f16"])
+@pytest.mark.parametrize("name", list(_HALF_DATA), ids=list(_HALF_DATA))
+def test_half_precision_kernels(name, dtype):
+    fn = getattr(F, name)
+    data = _HALF_DATA[name]
+    kwargs = {"data_range": 1.0} if name == "peak_signal_noise_ratio" else {}
+    half = fn(*[jnp.asarray(a, dtype) for a in data], **kwargs)
+    full = fn(*[jnp.asarray(a, jnp.float32) for a in data], **kwargs)
+    np.testing.assert_allclose(
+        np.asarray(half, np.float64), np.asarray(full, np.float64), atol=HALF_TOLS[name], rtol=0.08
+    )
+
+
+@pytest.mark.parametrize("dtype", [jnp.bfloat16, jnp.float16], ids=["bf16", "f16"])
+def test_half_precision_stateful_accuracy(dtype):
+    preds = jnp.asarray(_RNG.random((64, 5)), dtype)
+    target = jnp.asarray(_RNG.integers(0, 5, 64), jnp.int32)
+    m = tm.classification.MulticlassAccuracy(5, average="micro", validate_args=False)
+    m.update(preds, target)
+    m32 = tm.classification.MulticlassAccuracy(5, average="micro", validate_args=False)
+    m32.update(preds.astype(jnp.float32), target)
+    _assert_allclose(m.compute(), m32.compute(), atol=1e-3)
+
+
+# ---------------------------------------------------------- differentiability
+
+DIFF_CASES = [
+    ("mean_squared_error", lambda: (jnp.asarray(_RNG.random(32), jnp.float32), jnp.asarray(_RNG.random(32), jnp.float32))),
+    ("mean_absolute_error", lambda: (jnp.asarray(_RNG.random(32), jnp.float32), jnp.asarray(_RNG.random(32), jnp.float32))),
+    ("scale_invariant_signal_distortion_ratio", lambda: (jnp.asarray(_RNG.random(64), jnp.float32), jnp.asarray(_RNG.random(64), jnp.float32))),
+    ("total_variation", lambda: (jnp.asarray(_RNG.random((1, 1, 8, 8)), jnp.float32),)),
+    ("spectral_angle_mapper", lambda: (jnp.asarray(_RNG.random((1, 3, 8, 8)), jnp.float32), jnp.asarray(_RNG.random((1, 3, 8, 8)), jnp.float32))),
+]
+
+
+@pytest.mark.parametrize("name,make", DIFF_CASES, ids=[c[0] for c in DIFF_CASES])
+def test_functional_differentiability(name, make):
+    fn = getattr(F, name)
+    args = make()
+    grad = jax.grad(lambda *a: jnp.sum(fn(*a)))(*args)
+    assert grad.shape == args[0].shape
+    assert bool(jnp.isfinite(grad).all())
+    assert float(jnp.abs(grad).sum()) > 0
+
+
+def test_ssim_differentiability():
+    preds = jnp.asarray(_RNG.random((1, 1, 16, 16)), jnp.float32)
+    target = jnp.asarray(_RNG.random((1, 1, 16, 16)), jnp.float32)
+    grad = jax.grad(lambda p: F.structural_similarity_index_measure(p, target, data_range=1.0).sum())(preds)
+    assert bool(jnp.isfinite(grad).all())
+
+
+# ------------------------------------------------------------ in-graph planes
+
+def _ingraph_values(metric, *batches):
+    """Run a tensor-state metric fully in-graph over the 8-device mesh and compare
+    against the stateful single-process path."""
+    devices = jax.devices()
+    if len(devices) < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    mesh = jax.sharding.Mesh(np.array(devices[:8]), ("data",))
+
+    def shard_step(*args):
+        local = metric.update_state(metric.init_state(), *args)
+        return metric.reduce_state(local, "data")
+
+    fn = jax.jit(
+        jax.shard_map(
+            shard_step, mesh=mesh, in_specs=tuple(P("data") for _ in batches), out_specs=P()
+        )
+    )
+    synced = fn(*batches)
+    return metric.compute_state(synced)
+
+
+def test_ingraph_nominal_cramers():
+    preds = jnp.asarray(_RNG.integers(0, 4, 64), jnp.int32)
+    target = jnp.asarray(_RNG.integers(0, 4, 64), jnp.int32)
+    m = tm.CramersV(num_classes=4)
+    # nominal preprocessing happens host-side; feed the confmat contribution in-graph
+    from torchmetrics_tpu.functional.classification.confusion_matrix import (
+        _multiclass_confusion_matrix_update,
+    )
+
+    devices = jax.devices()
+    if len(devices) < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    mesh = jax.sharding.Mesh(np.array(devices[:8]), ("data",))
+
+    def shard_step(p, t):
+        local = {"confmat": _multiclass_confusion_matrix_update(p, t, None, 4).astype(jnp.float32)}
+        return m.reduce_state(local, "data")
+
+    fn = jax.jit(jax.shard_map(shard_step, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P()))
+    synced = fn(preds, target)
+    stateful = tm.CramersV(num_classes=4)
+    stateful.update(preds, target)
+    _assert_allclose(m._compute(synced), stateful.compute(), atol=1e-6)
+
+
+def test_ingraph_panoptic():
+    things, stuffs = {0, 1}, {6}
+    cats = np.array([0, 1, 6])
+    arr = np.stack(
+        [cats[_RNG.integers(0, 3, (8, 4, 4))], _RNG.integers(0, 2, (8, 4, 4))], axis=-1
+    ).astype(np.int32)
+    arr2 = np.stack(
+        [cats[_RNG.integers(0, 3, (8, 4, 4))], _RNG.integers(0, 2, (8, 4, 4))], axis=-1
+    ).astype(np.int32)
+    m = tm.PanopticQuality(things=things, stuffs=stuffs)
+    # per-shard host preprocessing -> in-graph psum of the four sum states
+    bs = m._host_batch_state(jnp.asarray(arr), jnp.asarray(arr2))
+    devices = jax.devices()
+    if len(devices) < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    mesh = jax.sharding.Mesh(np.array(devices[:8]), ("data",))
+    stacked = {k: jnp.broadcast_to(v / 8.0, (8, *v.shape)).astype(v.dtype) for k, v in bs.items()}
+
+    def shard_step(contrib):
+        local = {k: v[0] for k, v in contrib.items()}
+        return m.reduce_state(local, "data")
+
+    fn = jax.jit(jax.shard_map(shard_step, mesh=mesh, in_specs=(P("data"),), out_specs=P()))
+    synced = fn(stacked)
+    # int states divided by 8 then psummed across 8 shards reproduce the total
+    for k in bs:
+        if jnp.issubdtype(bs[k].dtype, jnp.floating):
+            _assert_allclose(synced[k], bs[k], atol=1e-4)
+
+
+@pytest.mark.parametrize(
+    "metric_ctor,batch_fn",
+    [
+        (lambda: tm.SignalNoiseRatio(), lambda: (jnp.asarray(_RNG.random((16, 64)), jnp.float32),
+                                                 jnp.asarray(_RNG.random((16, 64)), jnp.float32))),
+        (lambda: tm.PeakSignalNoiseRatio(data_range=1.0), lambda: (jnp.asarray(_RNG.random((8, 3, 8, 8)), jnp.float32),
+                                                                   jnp.asarray(_RNG.random((8, 3, 8, 8)), jnp.float32))),
+        (lambda: tm.TotalVariation(), lambda: (jnp.asarray(_RNG.random((8, 3, 8, 8)), jnp.float32),)),
+        (lambda: tm.Perplexity(), lambda: (jnp.asarray(_RNG.random((8, 6, 5)), jnp.float32),
+                                           jnp.asarray(_RNG.integers(0, 5, (8, 6)), jnp.int32))),
+    ],
+    ids=["snr", "psnr", "tv", "perplexity"],
+)
+def test_ingraph_tensor_state_metrics(metric_ctor, batch_fn):
+    metric = metric_ctor()
+    batches = batch_fn()
+    values = _ingraph_values(metric, *batches)
+    stateful = metric_ctor()
+    stateful.update(*batches)
+    _assert_allclose(values, stateful.compute(), atol=1e-4)
+
+
+# --------------------------------------------------------- dist_sync_on_step
+
+def test_dist_sync_on_step_semantics():
+    """forward() with dist_sync_on_step=True returns the cross-rank-synced value each
+    step (reference metric.py:319 semantics), via an injected fake gather plane."""
+
+    def fake_gather(arr, group=None):
+        # simulate 2 ranks: this rank plus a shifted copy
+        return [arr, arr + 1.0]
+
+    m = tm.SumMetric(dist_sync_on_step=True, dist_sync_fn=fake_gather,
+                     distributed_available_fn=lambda: True)
+    out = m(jnp.asarray(2.0))
+    # local sum = 2; synced = 2 + (2+1) = 5
+    assert float(out) == pytest.approx(5.0)
+    # local (unsynced) state must remain rank-local after the step
+    assert float(m._state["sum_value"]) == pytest.approx(2.0)
+    out2 = m(jnp.asarray(3.0))
+    # local = 5; synced = 5 + 6 = 11
+    assert float(out2) == pytest.approx(11.0)

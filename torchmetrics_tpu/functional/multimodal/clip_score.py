@@ -60,7 +60,7 @@ class _HFClipWrapper:
         try:
             self.model = CLIPModel.from_pretrained(model_name_or_path, local_files_only=True)
             self.processor = CLIPProcessor.from_pretrained(model_name_or_path, local_files_only=True)
-        except Exception as err:
+        except OSError as err:  # HF raises OSError subclasses for cache misses
             raise ModuleNotFoundError(
                 f"CLIP checkpoint {model_name_or_path!r} is not in the local HF cache and this "
                 "environment has no network egress to download it. Pre-populate the cache offline, "

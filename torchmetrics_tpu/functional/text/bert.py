@@ -32,7 +32,7 @@ def _load_hf(model_name_or_path: str, num_layers: Optional[int]):
     try:
         tokenizer = AutoTokenizer.from_pretrained(model_name_or_path, local_files_only=True)
         hf_model = AutoModel.from_pretrained(model_name_or_path, local_files_only=True)
-    except Exception as err:
+    except OSError as err:  # HF raises OSError subclasses for cache misses
         raise ModuleNotFoundError(
             f"Model {model_name_or_path!r} is not in the local HF cache and this environment has "
             "no network egress to download it. Pre-populate the cache offline, or pass "

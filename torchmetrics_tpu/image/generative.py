@@ -160,10 +160,13 @@ class KernelInceptionDistance(HostMetric):
         coef: float = 1.0,
         reset_real_features: bool = True,
         normalize: bool = False,
+        feature_extractor_weights_path: Optional[str] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
-        self.inception, self.num_features, self.used_custom_model = resolve_feature_extractor(feature, normalize)
+        self.inception, self.num_features, self.used_custom_model = resolve_feature_extractor(
+            feature, normalize, weights_path=feature_extractor_weights_path
+        )
         if not (isinstance(subsets, int) and subsets > 0):
             raise ValueError("Argument `subsets` expected to be integer larger than 0")
         self.subsets = subsets
@@ -236,6 +239,7 @@ class InceptionScore(Metric):
         feature: Union[str, int, Any] = "logits_unbiased",
         splits: int = 10,
         normalize: bool = False,
+        feature_extractor_weights_path: Optional[str] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -248,7 +252,9 @@ class InceptionScore(Metric):
                 "classifier, whose weights cannot be downloaded in this air-gapped environment. "
                 "Pass a custom callable producing class logits instead."
             )
-        self.inception, self.num_features, self.used_custom_model = resolve_feature_extractor(feature, normalize)
+        self.inception, self.num_features, self.used_custom_model = resolve_feature_extractor(
+            feature, normalize, weights_path=feature_extractor_weights_path
+        )
         if not (isinstance(splits, int) and splits > 0):
             raise ValueError("Argument `splits` expected to be integer larger than 0")
         self.splits = splits
@@ -297,10 +303,13 @@ class MemorizationInformedFrechetInceptionDistance(HostMetric):
         reset_real_features: bool = True,
         normalize: bool = False,
         cosine_distance_eps: float = 0.1,
+        feature_extractor_weights_path: Optional[str] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
-        self.inception, self.num_features, self.used_custom_model = resolve_feature_extractor(feature, normalize)
+        self.inception, self.num_features, self.used_custom_model = resolve_feature_extractor(
+            feature, normalize, weights_path=feature_extractor_weights_path
+        )
         if not isinstance(reset_real_features, bool):
             raise ValueError("Argument `reset_real_features` expected to be a bool")
         self.reset_real_features = reset_real_features

@@ -275,9 +275,10 @@ class Metric:
             )
         args, kwargs = self._prepare_inputs(*args, **kwargs)
         tensors, _ = self._split_tensor_list(self._state)
-        new_t, appends, self._n_prev_dev = self._get_update_fn()(
-            tensors, self._device_update_count(), *args, **kwargs
-        )
+        with jax.profiler.TraceAnnotation(f"{type(self).__name__}.update"):
+            new_t, appends, self._n_prev_dev = self._get_update_fn()(
+                tensors, self._device_update_count(), *args, **kwargs
+            )
         for k, v in new_t.items():
             self._state[k] = v
         for k, v in appends.items():
@@ -379,7 +380,8 @@ class Metric:
             did_sync = True
         try:
             state = self._concat_state()
-            value = self._compute(state)
+            with jax.profiler.TraceAnnotation(f"{type(self).__name__}.compute"):
+                value = self._compute(state)
         finally:
             if did_sync:
                 self.unsync()

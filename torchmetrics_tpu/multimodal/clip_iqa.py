@@ -9,6 +9,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, Tuple, Union
 
+import jax
 import jax.numpy as jnp
 
 from ..functional.multimodal.clip_score import _resolve_clip
@@ -92,7 +93,9 @@ class CLIPImageQualityAssessment(HostMetric):
         img_feats = img_feats / jnp.linalg.norm(img_feats, axis=-1, keepdims=True)
         anchors = self._prompt_anchors()  # (P, 2, D)
         logits = 100 * jnp.einsum("nd,pcd->npc", img_feats, anchors)
-        probs = jnp.exp(logits[..., 0]) / (jnp.exp(logits[..., 0]) + jnp.exp(logits[..., 1]))  # (N, P)
+        # stable two-way softmax: sigmoid of the logit difference (raw exp overflows
+        # f32 for |cosine| > ~0.887 at the x100 scale)
+        probs = jax.nn.sigmoid(logits[..., 0] - logits[..., 1])  # (N, P)
         return {"score_sum": probs.sum(axis=0), "total": jnp.asarray(images.shape[0], jnp.int32)}
 
     def _compute(self, state):

@@ -542,6 +542,10 @@ class BERTScore(HostMetric):
         super().__init__(**kwargs)
         from ..functional.text.bert import _load_hf, _tokenize
 
+        if all_layers:
+            raise ValueError(
+                "`all_layers=True` is only meaningful with per-layer baselines; use num_layers instead."
+            )
         self.num_layers = num_layers
         self.all_layers = all_layers
         self.idf = idf

@@ -4,11 +4,14 @@ Maintains ``num_bootstraps`` independent copies of a base metric; every ``update
 feeds each copy a resampled-with-replacement view of the batch; ``compute`` reports
 mean/std/quantile/raw over the replica values.
 
-TPU-first notes: the default ``multinomial`` sampler draws a *static-shape* index array
-(size == batch) so the jitted update path never recompiles. The reference's default
-``poisson`` sampler produces variable-length index sets (dynamic shape → recompile per
-unique length on XLA); it is supported for parity but ``multinomial`` is the default
-here (the two estimators are asymptotically equivalent bootstraps).
+TPU-first notes (SURVEY §7 step 5): for tensor-state base metrics the replicas live as
+ONE stacked ``(k, ...)`` state pytree, and every update is a single jitted call that
+vmaps the base metric's pure ``update_state`` over a ``(k, batch)`` resample-index
+matrix — strictly better than the reference's k deepcopies + k sequential updates
+(``wrappers/bootstrapping.py:74-97``). Metrics with concat states (or the ``poisson``
+sampler, whose variable-length index sets are a dynamic-shape recompile trap) fall
+back to per-replica clones. ``multinomial`` draws static-shape index rows and is the
+default here.
 """
 
 from __future__ import annotations
@@ -71,7 +74,7 @@ class BootStrapper(WrapperMetric):
                 f"Expected argument ``sampling_strategy`` to be one of {allowed_sampling}"
                 f" but received {sampling_strategy}"
             )
-        self.metrics = [base_metric.clone() for _ in range(num_bootstraps)]
+        self.base_metric = base_metric.clone()
         self.num_bootstraps = num_bootstraps
         self.mean = mean
         self.std = std
@@ -79,6 +82,38 @@ class BootStrapper(WrapperMetric):
         self.raw = raw
         self.sampling_strategy = sampling_strategy
         self._rng = np.random.default_rng(seed)
+        # vmapped stacked-state fast path: tensor states + jittable compute only
+        self._use_vmap = (
+            sampling_strategy == "multinomial"
+            and not base_metric._list_state_names
+            and base_metric._jittable_compute
+            # bare "mean" states cannot fold statelessly (update_state would raise)
+            and (base_metric._has_custom_merge() or not any(fx == "mean" for fx in base_metric._reductions.values()))
+        )
+        if self._use_vmap:
+            self.metrics = []  # replicas live as one stacked pytree instead
+            self._stacked = jax.tree.map(
+                lambda leaf: jnp.broadcast_to(jnp.asarray(leaf), (num_bootstraps, *jnp.asarray(leaf).shape)).copy(),
+                {k: v for k, v in self.base_metric.init_state().items()},
+            )
+            self._vmap_update = None
+        else:
+            self.metrics = [base_metric.clone() for _ in range(num_bootstraps)]
+
+    def _get_vmap_update(self):
+        if self._vmap_update is None:
+            base = self.base_metric
+
+            def step(stacked, idx_mat, *args, **kwargs):
+                def one(state_k, row):
+                    new_args = tuple(a[row] if hasattr(a, "shape") else a for a in args)
+                    new_kwargs = {k: (v[row] if hasattr(v, "shape") else v) for k, v in kwargs.items()}
+                    return base.update_state(state_k, *new_args, **new_kwargs)
+
+                return jax.vmap(one)(stacked, idx_mat)
+
+            self._vmap_update = jax.jit(step, donate_argnums=0)
+        return self._vmap_update
 
     def update(self, *args: Any, **kwargs: Any) -> None:
         """Feed each replica a resampled view of this batch (bootstrapping.py:126)."""
@@ -87,6 +122,15 @@ class BootStrapper(WrapperMetric):
         if not sizes:
             raise ValueError("None of the input contained tensors, so could not determine the sampling size")
         size = sizes[0]
+        if self._use_vmap:
+            # ONE jitted call: vmap the pure update over a (k, batch) index matrix
+            idx_mat = jnp.asarray(self._rng.integers(0, size, size=(self.num_bootstraps, size)))
+            args = tuple(jnp.asarray(a) if hasattr(a, "shape") else a for a in args)
+            kwargs = {k: (jnp.asarray(v) if hasattr(v, "shape") else v) for k, v in kwargs.items()}
+            self._stacked = self._get_vmap_update()(self._stacked, idx_mat, *args, **kwargs)
+            self._update_count += 1
+            self._computed = None
+            return
         for idx in range(self.num_bootstraps):
             sample_idx = _bootstrap_sampler(self._rng, size, self.sampling_strategy)
             if sample_idx.size == 0:
@@ -100,7 +144,10 @@ class BootStrapper(WrapperMetric):
 
     def compute(self) -> Dict[str, jax.Array]:
         """Aggregate replica values (bootstrapping.py:149)."""
-        computed_vals = jnp.stack([m.compute() for m in self.metrics], axis=0)
+        if self._use_vmap:
+            computed_vals = jax.vmap(self.base_metric.compute_state)(self._stacked)
+        else:
+            computed_vals = jnp.stack([m.compute() for m in self.metrics], axis=0)
         output: Dict[str, jax.Array] = {}
         if self.mean:
             output["mean"] = computed_vals.mean(axis=0)
@@ -116,6 +163,20 @@ class BootStrapper(WrapperMetric):
         """Global accumulate AND batch-only bootstrap dict (reference forward contract:
         the returned value covers this batch alone, like every other metric)."""
         self.update(*args, **kwargs)
+        if self._use_vmap:
+            saved_stacked = self._stacked
+            self._stacked = jax.tree.map(
+                lambda leaf: jnp.broadcast_to(
+                    jnp.asarray(leaf), (self.num_bootstraps, *jnp.asarray(leaf).shape)
+                ).copy(),
+                {k: v for k, v in self.base_metric.init_state().items()},
+            )
+            self.update(*args, **kwargs)  # fresh resample for the batch-only estimate
+            self._update_count -= 1
+            out = self.compute()
+            self._computed = None
+            self._stacked = saved_stacked
+            return out
         saved = [
             {k: (list(v) if isinstance(v, list) else v) for k, v in m._state.items()} for m in self.metrics
         ]
@@ -135,6 +196,13 @@ class BootStrapper(WrapperMetric):
     __call__ = forward
 
     def reset(self) -> None:
+        if self._use_vmap:
+            self._stacked = jax.tree.map(
+                lambda leaf: jnp.broadcast_to(
+                    jnp.asarray(leaf), (self.num_bootstraps, *jnp.asarray(leaf).shape)
+                ).copy(),
+                {k: v for k, v in self.base_metric.init_state().items()},
+            )
         for m in self.metrics:
             m.reset()
         self._update_count = 0
