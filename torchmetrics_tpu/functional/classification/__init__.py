@@ -80,6 +80,12 @@ from .group_fairness import (
     equal_opportunity,
 )
 from .logauc import binary_logauc, logauc, multiclass_logauc, multilabel_logauc
+from ._operating_point_facades import (
+    precision_at_fixed_recall,
+    recall_at_fixed_precision,
+    sensitivity_at_specificity,
+    specificity_at_sensitivity,
+)
 from .precision_fixed_recall import (
     binary_precision_at_fixed_recall,
     multiclass_precision_at_fixed_recall,

@@ -1,0 +1,132 @@
+"""Task-dispatch facades for the curve operating-point metrics (reference
+``functional/classification/{precision_fixed_recall,recall_fixed_precision,
+sensitivity_specificity,specificity_sensitivity}.py`` facade tails).
+
+One shared dispatcher covers all four — the facades differ only in the floor-argument
+name and the underlying binary/multiclass/multilabel triple.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ...utilities.enums import ClassificationTask
+from .precision_fixed_recall import (
+    binary_precision_at_fixed_recall,
+    multiclass_precision_at_fixed_recall,
+    multilabel_precision_at_fixed_recall,
+)
+from .recall_fixed_precision import (
+    binary_recall_at_fixed_precision,
+    multiclass_recall_at_fixed_precision,
+    multilabel_recall_at_fixed_precision,
+)
+from .sensitivity_specificity import (
+    binary_sensitivity_at_specificity,
+    multiclass_sensitivity_at_specificity,
+    multilabel_sensitivity_at_specificity,
+)
+from .specificity_sensitivity import (
+    binary_specificity_at_sensitivity,
+    multiclass_specificity_at_sensitivity,
+    multilabel_specificity_at_sensitivity,
+)
+
+
+def _dispatch(
+    triple,
+    preds,
+    target,
+    task: str,
+    floor: float,
+    thresholds,
+    num_classes: Optional[int],
+    num_labels: Optional[int],
+    ignore_index: Optional[int],
+    validate_args: bool,
+):
+    binary_fn, multiclass_fn, multilabel_fn = triple
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_fn(preds, target, floor, thresholds, ignore_index, validate_args)
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_fn(preds, target, num_classes, floor, thresholds, ignore_index, validate_args)
+    if task == ClassificationTask.MULTILABEL:
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+        return multilabel_fn(preds, target, num_labels, floor, thresholds, ignore_index, validate_args)
+    raise ValueError(f"Not handled value: {task}")
+
+
+def precision_at_fixed_recall(
+    preds,
+    target,
+    task: str,
+    min_recall: float,
+    thresholds=None,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+):
+    """Highest precision (and its threshold) with recall >= ``min_recall``."""
+    return _dispatch(
+        (binary_precision_at_fixed_recall, multiclass_precision_at_fixed_recall, multilabel_precision_at_fixed_recall),
+        preds, target, task, min_recall, thresholds, num_classes, num_labels, ignore_index, validate_args,
+    )
+
+
+def recall_at_fixed_precision(
+    preds,
+    target,
+    task: str,
+    min_precision: float,
+    thresholds=None,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+):
+    """Highest recall (and its threshold) with precision >= ``min_precision``."""
+    return _dispatch(
+        (binary_recall_at_fixed_precision, multiclass_recall_at_fixed_precision, multilabel_recall_at_fixed_precision),
+        preds, target, task, min_precision, thresholds, num_classes, num_labels, ignore_index, validate_args,
+    )
+
+
+def sensitivity_at_specificity(
+    preds,
+    target,
+    task: str,
+    min_specificity: float,
+    thresholds=None,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+):
+    """Highest sensitivity (and its threshold) with specificity >= ``min_specificity``."""
+    return _dispatch(
+        (binary_sensitivity_at_specificity, multiclass_sensitivity_at_specificity, multilabel_sensitivity_at_specificity),
+        preds, target, task, min_specificity, thresholds, num_classes, num_labels, ignore_index, validate_args,
+    )
+
+
+def specificity_at_sensitivity(
+    preds,
+    target,
+    task: str,
+    min_sensitivity: float,
+    thresholds=None,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+):
+    """Highest specificity (and its threshold) with sensitivity >= ``min_sensitivity``."""
+    return _dispatch(
+        (binary_specificity_at_sensitivity, multiclass_specificity_at_sensitivity, multilabel_specificity_at_sensitivity),
+        preds, target, task, min_sensitivity, thresholds, num_classes, num_labels, ignore_index, validate_args,
+    )
