@@ -317,3 +317,40 @@ class TestGroupFairness:
         )
         for k in ref:
             np.testing.assert_allclose(np.asarray(out[k]), np.asarray(ref[k]), atol=1e-6)
+
+
+def test_operating_point_task_facades_dispatch():
+    """The four facade wrappers must dispatch to the matching task kernel."""
+    import numpy as np
+    import jax.numpy as jnp
+    import pytest as _pytest
+
+    import torchmetrics_tpu.functional as F
+
+    rng = np.random.default_rng(0)
+    p_bin = jnp.asarray(rng.random(64).astype(np.float32))
+    t_bin = jnp.asarray((rng.random(64) > 0.5).astype(np.int32))
+    p_mc = jnp.asarray(rng.dirichlet(np.ones(4), 64).astype(np.float32))
+    t_mc = jnp.asarray(rng.integers(0, 4, 64).astype(np.int32))
+
+    cases = [
+        (F.precision_at_fixed_recall, F.binary_precision_at_fixed_recall,
+         F.multiclass_precision_at_fixed_recall, "min_recall"),
+        (F.recall_at_fixed_precision, F.binary_recall_at_fixed_precision,
+         F.multiclass_recall_at_fixed_precision, "min_precision"),
+        (F.sensitivity_at_specificity, F.binary_sensitivity_at_specificity,
+         F.multiclass_sensitivity_at_specificity, "min_specificity"),
+        (F.specificity_at_sensitivity, F.binary_specificity_at_sensitivity,
+         F.multiclass_specificity_at_sensitivity, "min_sensitivity"),
+    ]
+    for facade, binary_fn, multiclass_fn, floor_name in cases:
+        got = facade(p_bin, t_bin, task="binary", **{floor_name: 0.5}, thresholds=50)
+        want = binary_fn(p_bin, t_bin, 0.5, thresholds=50)
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(w), atol=1e-7)
+        got_mc = facade(p_mc, t_mc, task="multiclass", num_classes=4, **{floor_name: 0.5}, thresholds=50)
+        want_mc = multiclass_fn(p_mc, t_mc, 4, 0.5, thresholds=50)
+        for g, w in zip(got_mc, want_mc):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(w), atol=1e-7)
+        with _pytest.raises(ValueError, match="num_classes"):
+            facade(p_mc, t_mc, task="multiclass", **{floor_name: 0.5})
