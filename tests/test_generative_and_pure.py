@@ -252,3 +252,44 @@ def test_device_counter_running_mean_exact():
     for v in vals[:2]:
         m.update(jnp.asarray(v))
     assert float(m.compute()) == pytest.approx(np.mean(vals[:2]))
+
+
+def test_dists_machinery_invariants():
+    imgs = jnp.asarray(_RNG.random((2, 3, 64, 64)).astype(np.float32))
+    m = tm.DeepImageStructureAndTextureSimilarity(pretrained=False)
+    m.update(imgs, imgs)
+    assert float(m.compute()) == pytest.approx(0.0, abs=1e-5)  # identical images
+    m2 = tm.DeepImageStructureAndTextureSimilarity(pretrained=False)
+    m2.update(imgs, jnp.asarray(_RNG.random((2, 3, 64, 64)).astype(np.float32)))
+    assert float(m2.compute()) > 0.0
+    with pytest.raises(ModuleNotFoundError, match="DISTS weights"):
+        tm.DeepImageStructureAndTextureSimilarity()
+
+
+def test_perceptual_path_length_machinery():
+    rng = np.random.default_rng(3)
+    proj = jnp.asarray(rng.normal(size=(8, 3 * 16 * 16)).astype(np.float32) * 0.1)
+
+    class ToyGen:
+        def sample(self, n):
+            return rng.normal(size=(n, 8)).astype(np.float32)
+
+        def __call__(self, z):
+            img = jax.nn.sigmoid(jnp.asarray(z) @ proj)
+            return 255 * img.reshape(-1, 3, 16, 16)
+
+    def toy_sim(a, b):
+        return jnp.abs(a - b).mean(axis=(1, 2, 3))
+
+    mean, std, dist = tm.functional.perceptual_path_length(
+        ToyGen(), num_samples=48, batch_size=16, sim_net=toy_sim, resize=None
+    )
+    assert dist.shape == (48,)
+    assert float(mean) > 0 and float(std) >= 0
+    # smooth generator: distances scale ~1/eps^2 * (eps-step)^2 => finite, stable
+    m = tm.PerceptualPathLength(num_samples=32, batch_size=16, sim_net=toy_sim, resize=None)
+    m.update(ToyGen())
+    mm, ss, dd = m.compute()
+    assert dd.shape == (32,)
+    with pytest.raises(NotImplementedError, match="sample"):
+        tm.functional.perceptual_path_length(object(), num_samples=4, sim_net=toy_sim)

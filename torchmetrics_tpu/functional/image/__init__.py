@@ -1,10 +1,13 @@
 """Image tower — stateless kernels (reference ``src/torchmetrics/functional/image/``)."""
 
+from .arniqa import arniqa
 from .d_lambda import spectral_distortion_index
+from .dists import deep_image_structure_and_texture_similarity
 from .d_s import spatial_distortion_index
 from .ergas import error_relative_global_dimensionless_synthesis
 from .gradients import image_gradients
 from .lpips import learned_perceptual_image_patch_similarity
+from .perceptual_path_length import perceptual_path_length
 from .psnr import peak_signal_noise_ratio
 from .psnrb import peak_signal_noise_ratio_with_blocked_effect
 from .qnr import quality_with_no_reference
@@ -18,11 +21,14 @@ from .uqi import universal_image_quality_index
 from .vif import visual_information_fidelity
 
 __all__ = [
+    "arniqa",
+    "deep_image_structure_and_texture_similarity",
     "error_relative_global_dimensionless_synthesis",
     "image_gradients",
     "learned_perceptual_image_patch_similarity",
     "multiscale_structural_similarity_index_measure",
     "peak_signal_noise_ratio",
+    "perceptual_path_length",
     "peak_signal_noise_ratio_with_blocked_effect",
     "quality_with_no_reference",
     "relative_average_spectral_error",

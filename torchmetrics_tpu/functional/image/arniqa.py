@@ -1,0 +1,47 @@
+"""ARNIQA — no-reference image quality (reference ``functional/image/arniqa.py``).
+
+ARNIQA regresses quality from a pretrained ResNet-50 encoder fine-tuned on quality
+datasets; both the encoder and the regressor head are downloaded weights, which an
+air-gapped environment cannot fetch. The surface gates with a clear error; a custom
+scorer callable is accepted for parity with the pluggable-embedder convention used by
+the other model-backed metrics.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+
+
+def arniqa(
+    img,
+    regressor_dataset: str = "koniq10k",
+    reduction: str = "mean",
+    normalize: bool = True,
+    autocast: bool = False,
+    scorer: Optional[Callable] = None,
+) -> jnp.ndarray:
+    """ARNIQA quality score in [0, 1]. Pass ``scorer`` (``imgs -> (N,)``) to supply
+    the model; the pretrained default requires downloaded weights. ``normalize`` and
+    ``autocast`` belong to the gated pretrained pipeline (they control its input
+    rescaling and mixed precision) and do not affect a custom ``scorer``."""
+    if not isinstance(normalize, bool):
+        raise ValueError(f"Argument `normalize` should be a bool but got {normalize}")
+    if regressor_dataset not in ("kadid10k", "koniq10k"):
+        raise ValueError(
+            f"Argument `regressor_dataset` must be one of ('kadid10k', 'koniq10k'), but got {regressor_dataset}"
+        )
+    if reduction not in ("mean", "sum", "none", None):
+        raise ValueError(f"Argument `reduction` must be one of ('mean', 'sum', 'none', None), but got {reduction}")
+    if scorer is None:
+        raise ModuleNotFoundError(
+            "ARNIQA's pretrained ResNet-50 encoder and regressor weights cannot be downloaded in "
+            "an air-gapped environment. Pass a custom `scorer` callable (imgs -> (N,) scores)."
+        )
+    scores = jnp.asarray(scorer(jnp.asarray(img)))
+    if reduction == "mean":
+        return scores.mean()
+    if reduction == "sum":
+        return scores.sum()
+    return scores

@@ -1,0 +1,155 @@
+"""DISTS — Deep Image Structure and Texture Similarity (reference
+``functional/image/dists.py``; Ding et al., 2020).
+
+VGG16 trunk with hanning-window L2-pooling in place of maxpools, tapped at the five
+relu stages plus the raw input; per-channel texture (mean) and structure (covariance)
+similarities weighted by learned alpha/beta. Weights load from a converted pickle
+(the reference pulls the VGG backbone from torchvision and ships alpha/beta in-tree;
+neither is downloadable in an air-gapped pod) — ``pretrained=False`` gives
+deterministic random parameters for machinery testing.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .lpips import _VGG_SPEC, _conv
+
+_DISTS_CHNS = (3, 64, 128, 256, 512, 512)
+_DISTS_TAPS = (4, 9, 16, 23, 30)  # vgg16.features indices after relu{1_2,2_2,3_3,4_3,5_3}
+_MEAN = np.asarray([0.485, 0.456, 0.406], np.float32)
+_STD = np.asarray([0.229, 0.224, 0.225], np.float32)
+
+
+def _l2pool_filter(channels: int, filter_size: int = 5) -> jnp.ndarray:
+    a = np.hanning(filter_size)[1:-1]
+    g = a[:, None] * a[None, :]
+    g = (g / g.sum()).astype(np.float32)
+    return jnp.asarray(np.broadcast_to(g[None, None], (channels, 1, g.shape[0], g.shape[1])).copy())
+
+
+def _l2pool(x: jnp.ndarray, channels: int, filter_size: int = 5, stride: int = 2) -> jnp.ndarray:
+    pad = (filter_size - 2) // 2
+    out = lax.conv_general_dilated(
+        x**2, _l2pool_filter(channels, filter_size), (stride, stride), [(pad, pad), (pad, pad)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"), feature_group_count=channels,
+        precision=lax.Precision.HIGHEST,
+    )
+    return jnp.sqrt(out + 1e-12)
+
+
+def _dists_backbone(backbone: List, x: jnp.ndarray) -> List[jnp.ndarray]:
+    """VGG16 stages with L2-pooling; returns [input, relu1_2, ..., relu5_3]."""
+    feats = [x]
+    h = (x - jnp.asarray(_MEAN)[None, :, None, None]) / jnp.asarray(_STD)[None, :, None, None]
+    for idx, layer in enumerate(_VGG_SPEC):
+        kind = layer[0]
+        if kind == "conv":
+            _, _, _, _, stride, pad = layer
+            h = _conv(h, backbone[idx]["w"], backbone[idx]["b"], stride, pad)
+        elif kind == "relu":
+            h = jax.nn.relu(h)
+        elif kind == "maxpool":
+            h = _l2pool(h, h.shape[1])  # DISTS swaps maxpool for L2-pooling
+        if idx + 1 in _DISTS_TAPS:
+            feats.append(h)
+    return feats
+
+
+class DISTSNetwork:
+    """Jitted DISTS scorer with learned per-channel alpha/beta weights."""
+
+    def __init__(self, pretrained: bool = True, weights_path: Optional[str] = None, seed: int = 0) -> None:
+        if pretrained:
+            if weights_path is None:
+                raise ModuleNotFoundError(
+                    "Pretrained DISTS weights (VGG backbone + alpha/beta) are not bundled and "
+                    "cannot be downloaded in an air-gapped environment. Convert them offline with "
+                    "`convert_dists_weights` and pass `weights_path`, or use `pretrained=False`."
+                )
+            with open(weights_path, "rb") as f:
+                payload = pickle.load(f)
+            self.backbone = jax.tree.map(jnp.asarray, payload["backbone"])
+            self.alpha = jnp.asarray(payload["alpha"]).reshape(1, -1)
+            self.beta = jnp.asarray(payload["beta"]).reshape(1, -1)
+        else:
+            from .lpips import LPIPSNetwork
+
+            self.backbone = LPIPSNetwork("vgg", pretrained=False, seed=seed).backbone
+            key = jax.random.PRNGKey(seed)
+            k1, k2 = jax.random.split(key)
+            total = sum(_DISTS_CHNS)
+            self.alpha = 0.1 + 0.01 * jax.random.normal(k1, (1, total))
+            self.beta = 0.1 + 0.01 * jax.random.normal(k2, (1, total))
+        self._apply = jax.jit(self._forward)
+
+    def _forward(self, backbone, alpha, beta, x, y):
+        feats0 = _dists_backbone(backbone, x)
+        feats1 = _dists_backbone(backbone, y)
+        c1 = c2 = 1e-6
+        w_sum = alpha.sum() + beta.sum()
+        alphas = jnp.split(alpha / w_sum, np.cumsum(_DISTS_CHNS)[:-1].tolist(), axis=1)
+        betas = jnp.split(beta / w_sum, np.cumsum(_DISTS_CHNS)[:-1].tolist(), axis=1)
+        dist1 = jnp.zeros((x.shape[0],))
+        dist2 = jnp.zeros((x.shape[0],))
+        for k in range(len(_DISTS_CHNS)):
+            x_mean = feats0[k].mean(axis=(2, 3))
+            y_mean = feats1[k].mean(axis=(2, 3))
+            s1 = (2 * x_mean * y_mean + c1) / (x_mean**2 + y_mean**2 + c1)
+            dist1 = dist1 + (alphas[k] * s1).sum(axis=1)
+            x_var = ((feats0[k] - x_mean[:, :, None, None]) ** 2).mean(axis=(2, 3))
+            y_var = ((feats1[k] - y_mean[:, :, None, None]) ** 2).mean(axis=(2, 3))
+            xy_cov = (feats0[k] * feats1[k]).mean(axis=(2, 3)) - x_mean * y_mean
+            s2 = (2 * xy_cov + c2) / (x_var + y_var + c2)
+            dist2 = dist2 + (betas[k] * s2).sum(axis=1)
+        return 1 - (dist1 + dist2)
+
+    def __call__(self, preds, target) -> jnp.ndarray:
+        return self._apply(self.backbone, self.alpha, self.beta, jnp.asarray(preds, jnp.float32), jnp.asarray(target, jnp.float32))
+
+
+def convert_dists_weights(vgg_features_state_dict: Dict, dists_state_dict: Dict, out_path: str) -> None:
+    """Convert torchvision vgg16 ``features`` + the reference's ``dists_models/weights.pt``
+    (alpha/beta) into the pickle this scorer loads (run offline)."""
+    backbone = []
+    for idx, layer in enumerate(_VGG_SPEC):
+        if layer[0] == "conv":
+            backbone.append({
+                "w": np.asarray(vgg_features_state_dict[f"{idx}.weight"]),
+                "b": np.asarray(vgg_features_state_dict[f"{idx}.bias"]),
+            })
+        else:
+            backbone.append({})
+    with open(out_path, "wb") as f:
+        pickle.dump({
+            "backbone": backbone,
+            "alpha": np.asarray(dists_state_dict["alpha"]).reshape(-1),
+            "beta": np.asarray(dists_state_dict["beta"]).reshape(-1),
+        }, f)
+
+
+_NET_CACHE: Dict[Tuple, DISTSNetwork] = {}
+
+
+def deep_image_structure_and_texture_similarity(
+    preds, target, reduction: Optional[str] = None,
+    weights_path: Optional[str] = None, pretrained: bool = True,
+) -> jnp.ndarray:
+    """DISTS between two NCHW image batches in [0, 1]."""
+    key = (pretrained, weights_path)
+    if key not in _NET_CACHE:
+        _NET_CACHE[key] = DISTSNetwork(pretrained=pretrained, weights_path=weights_path)
+    scores = _NET_CACHE[key](preds, target)
+    if reduction == "sum":
+        return scores.sum()
+    if reduction == "mean":
+        return scores.mean()
+    if reduction is None or reduction == "none":
+        return scores
+    raise ValueError(f"Argument `reduction` must be one of ('sum', 'mean', 'none', None), but got {reduction}")

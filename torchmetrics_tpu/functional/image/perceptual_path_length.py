@@ -1,0 +1,187 @@
+"""Perceptual Path Length (reference ``functional/image/perceptual_path_length.py``).
+
+PPL probes a latent-space generator: interpolate latent pairs epsilon apart, generate
+both endpoints, and score the perceptual distance / epsilon^2 with quantile filtering.
+The similarity network is LPIPS (converted weights required offline) or any callable
+``(img1, img2) -> (N,)``; the generator is any object with ``sample(num_samples)`` and
+``__call__(z[, labels])``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class GeneratorType:
+    """Protocol for PPL generators: ``sample(num_samples) -> (N, z)`` latents and a
+    forward producing images scaled to [0, 255]; ``num_classes`` when conditional."""
+
+    @property
+    def num_classes(self) -> int:
+        raise NotImplementedError
+
+    def sample(self, num_samples: int):
+        raise NotImplementedError
+
+
+def _validate_generator_model(generator, conditional: bool = False) -> None:
+    if not hasattr(generator, "sample"):
+        raise NotImplementedError(
+            "The generator must have a `sample` method with signature `sample(num_samples: int) -> Tensor` where the"
+            " returned tensor has shape `(num_samples, z_size)`."
+        )
+    if not callable(generator.sample):
+        raise ValueError("The generator's `sample` method must be callable.")
+    if conditional and not hasattr(generator, "num_classes"):
+        raise AttributeError("The generator must have a `num_classes` attribute when `conditional=True`.")
+    if conditional and not isinstance(generator.num_classes, int):
+        raise ValueError("The generator's `num_classes` attribute must be an integer when `conditional=True`.")
+
+
+def _perceptual_path_length_validate_arguments(
+    num_samples: int = 10_000,
+    conditional: bool = False,
+    batch_size: int = 128,
+    interpolation_method: str = "lerp",
+    epsilon: float = 1e-4,
+    resize: Optional[int] = 64,
+    lower_discard: Optional[float] = 0.01,
+    upper_discard: Optional[float] = 0.99,
+) -> None:
+    if not (isinstance(num_samples, int) and num_samples > 0):
+        raise ValueError(f"Argument `num_samples` must be a positive integer, but got {num_samples}.")
+    if not isinstance(conditional, bool):
+        raise ValueError(f"Argument `conditional` must be a boolean, but got {conditional}.")
+    if not (isinstance(batch_size, int) and batch_size > 0):
+        raise ValueError(f"Argument `batch_size` must be a positive integer, but got {batch_size}.")
+    if interpolation_method not in ["lerp", "slerp_any", "slerp_unit"]:
+        raise ValueError(
+            f"Argument `interpolation_method` must be one of 'lerp', 'slerp_any', 'slerp_unit',"
+            f"got {interpolation_method}."
+        )
+    if not (isinstance(epsilon, float) and epsilon > 0):
+        raise ValueError(f"Argument `epsilon` must be a positive float, but got {epsilon}.")
+    if resize is not None and not (isinstance(resize, int) and resize > 0):
+        raise ValueError(f"Argument `resize` must be a positive integer or `None`, but got {resize}.")
+    if lower_discard is not None and not (isinstance(lower_discard, float) and 0 <= lower_discard <= 1):
+        raise ValueError(
+            f"Argument `lower_discard` must be a float between 0 and 1 or `None`, but got {lower_discard}."
+        )
+    if upper_discard is not None and not (isinstance(upper_discard, float) and 0 <= upper_discard <= 1):
+        raise ValueError(
+            f"Argument `upper_discard` must be a float between 0 and 1 or `None`, but got {upper_discard}."
+        )
+
+
+def _interpolate(latents1, latents2, epsilon: float = 1e-4, interpolation_method: str = "lerp") -> jnp.ndarray:
+    """Step of size epsilon along the latent path (torch-fidelity noise semantics)."""
+    eps = 1e-7
+    latents1 = jnp.asarray(latents1)
+    latents2 = jnp.asarray(latents2)
+    if latents1.shape != latents2.shape:
+        raise ValueError("Latents must have the same shape.")
+    if interpolation_method == "lerp":
+        return latents1 + (latents2 - latents1) * epsilon
+    if interpolation_method == "slerp_any":
+        raw_norm1 = jnp.linalg.norm(latents1, axis=-1, keepdims=True)
+        raw_norm2 = jnp.linalg.norm(latents2, axis=-1, keepdims=True)
+        l1n = latents1 / jnp.clip(raw_norm1, eps)
+        l2n = latents2 / jnp.clip(raw_norm2, eps)
+        d = (l1n * l2n).sum(axis=-1, keepdims=True)
+        # degenerate (zero-norm) or collinear pairs fall back to lerp
+        mask = (raw_norm1 < eps) | (raw_norm2 < eps) | (d > 1 - eps) | (d < -1 + eps)
+        omega = jnp.arccos(jnp.clip(d, -1, 1))
+        denom = jnp.clip(jnp.sin(omega), eps)
+        out = (jnp.sin((1 - epsilon) * omega) / denom) * latents1 + (jnp.sin(epsilon * omega) / denom) * latents2
+        lerped = _interpolate(latents1, latents2, epsilon, "lerp")
+        return jnp.where(mask, lerped, out)
+    if interpolation_method == "slerp_unit":
+        out = _interpolate(latents1, latents2, epsilon, "slerp_any")
+        return out / jnp.clip(jnp.linalg.norm(out, axis=-1, keepdims=True), eps)
+    raise ValueError(
+        f"Interpolation method {interpolation_method} not supported. Choose from 'lerp', 'slerp_any', 'slerp_unit'."
+    )
+
+
+def perceptual_path_length(
+    generator,
+    num_samples: int = 10_000,
+    conditional: bool = False,
+    batch_size: int = 64,
+    interpolation_method: str = "lerp",
+    epsilon: float = 1e-4,
+    resize: Optional[int] = 64,
+    lower_discard: Optional[float] = 0.01,
+    upper_discard: Optional[float] = 0.99,
+    sim_net: Union[Callable, str] = "vgg",
+    sim_net_weights_path: Optional[str] = None,
+    seed: int = 0,
+    device: Optional[Any] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    r"""PPL = E[D(G(I(z1,z2,t)), G(I(z1,z2,t+eps))) / eps^2] with quantile filtering.
+
+    ``sim_net`` is a net-type string (LPIPS — converted weights required offline via
+    ``sim_net_weights_path``) or any callable ``(img1, img2) -> (N,)`` over images in
+    [-1, 1].
+    """
+    _perceptual_path_length_validate_arguments(
+        num_samples, conditional, batch_size, interpolation_method, epsilon, resize, lower_discard, upper_discard
+    )
+    _validate_generator_model(generator, conditional)
+
+    if callable(sim_net) and not isinstance(sim_net, str):
+        net = sim_net
+    elif sim_net in ("alex", "vgg", "squeeze"):
+        from .lpips import LPIPSNetwork
+
+        if sim_net_weights_path is None:
+            raise ModuleNotFoundError(
+                "PPL's default LPIPS similarity needs converted pretrained weights, which cannot "
+                "be downloaded in an air-gapped environment. Convert them offline with "
+                "`convert_lpips_weights` and pass `sim_net_weights_path`, or pass a custom "
+                "similarity callable as `sim_net`."
+            )
+        net = LPIPSNetwork(sim_net, pretrained=True, weights_path=sim_net_weights_path)
+    else:
+        raise ValueError(f"sim_net must be a callable or one of 'alex', 'vgg', 'squeeze', got {sim_net}")
+
+    latent1 = jnp.asarray(generator.sample(num_samples))
+    latent2 = jnp.asarray(generator.sample(num_samples))
+    latent2 = _interpolate(latent1, latent2, epsilon, interpolation_method=interpolation_method)
+    if conditional:
+        labels = jnp.asarray(np.random.default_rng(seed).integers(0, generator.num_classes, num_samples))
+
+    distances = []
+    num_batches = math.ceil(num_samples / batch_size)
+    for batch_idx in range(num_batches):
+        sl = slice(batch_idx * batch_size, (batch_idx + 1) * batch_size)
+        z = jnp.concatenate([latent1[sl], latent2[sl]], axis=0)
+        if conditional:
+            lab = jnp.concatenate([labels[sl], labels[sl]], axis=0)
+            outputs = jnp.asarray(generator(z, lab))
+        else:
+            outputs = jnp.asarray(generator(z))
+        out1, out2 = jnp.split(outputs, 2, axis=0)
+        # generator domain [0, 255] -> similarity domain [-1, 1]
+        out1 = 2 * (out1 / 255) - 1
+        out2 = 2 * (out2 / 255) - 1
+        if resize is not None:
+            out1 = jax.image.resize(out1, (*out1.shape[:2], resize, resize), method="bilinear")
+            out2 = jax.image.resize(out2, (*out2.shape[:2], resize, resize), method="bilinear")
+        distances.append(jnp.asarray(net(out1, out2)) / epsilon**2)
+    dist_arr = jnp.concatenate(distances)
+    mean, std = _quantile_filtered_stats(dist_arr, lower_discard, upper_discard)
+    return mean, std, dist_arr
+
+
+def _quantile_filtered_stats(dist, lower_discard: Optional[float], upper_discard: Optional[float]):
+    """Mean and (unbiased, torch-parity) std of the quantile-filtered distances."""
+    lower = jnp.quantile(dist, lower_discard) if lower_discard is not None else dist.min()
+    upper = jnp.quantile(dist, upper_discard) if upper_discard is not None else dist.max()
+    kept = dist[jnp.asarray((np.asarray(dist) >= np.asarray(lower)) & (np.asarray(dist) <= np.asarray(upper)))]
+    return kept.mean(), kept.std(ddof=1)

@@ -19,12 +19,15 @@ from .generative import (
     KernelInceptionDistance,
     MemorizationInformedFrechetInceptionDistance,
 )
+from .dists import DeepImageStructureAndTextureSimilarity
 from .lpip import LearnedPerceptualImagePatchSimilarity
+from .perceptual_path_length import PerceptualPathLength
 from .psnr import PeakSignalNoiseRatio
 from .psnrb import PeakSignalNoiseRatioWithBlockedEffect
 from .ssim import MultiScaleStructuralSimilarityIndexMeasure, StructuralSimilarityIndexMeasure
 
 __all__ = [
+    "DeepImageStructureAndTextureSimilarity",
     "ErrorRelativeGlobalDimensionlessSynthesis",
     "FrechetInceptionDistance",
     "InceptionScore",
@@ -33,6 +36,7 @@ __all__ = [
     "MemorizationInformedFrechetInceptionDistance",
     "MultiScaleStructuralSimilarityIndexMeasure",
     "PeakSignalNoiseRatio",
+    "PerceptualPathLength",
     "PeakSignalNoiseRatioWithBlockedEffect",
     "QualityWithNoReference",
     "RelativeAverageSpectralError",

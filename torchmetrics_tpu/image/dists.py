@@ -1,0 +1,49 @@
+"""DeepImageStructureAndTextureSimilarity metric class (reference ``image/dists.py:31``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+
+from ..functional.image.dists import DISTSNetwork
+from ..metric import Metric
+
+
+class DeepImageStructureAndTextureSimilarity(Metric):
+    """Running-mean DISTS (two scalar sum states). ``weights_path`` points at a
+    converted weight pickle; ``pretrained=False`` runs the machinery on deterministic
+    random parameters (offline testing)."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self,
+        reduction: str = "mean",
+        weights_path: Optional[str] = None,
+        pretrained: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        # only sum states are kept, so per-image 'none' output cannot be honored here
+        if reduction not in ("mean", "sum"):
+            raise ValueError(f"Argument `reduction` must be one of ('mean', 'sum'), got {reduction}")
+        self.reduction = reduction
+        self.net = DISTSNetwork(pretrained=pretrained, weights_path=weights_path)
+        self.add_state("sum_scores", jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def _prepare_inputs(self, preds, target):
+        return (jnp.asarray(self.net(preds, target)),), {}
+
+    def _batch_state(self, scores):
+        return {"sum_scores": scores.sum(), "total": jnp.asarray(float(scores.shape[0]))}
+
+    def _compute(self, state):
+        if self.reduction == "mean":
+            return state["sum_scores"] / state["total"]
+        return state["sum_scores"]
