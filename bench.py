@@ -9,16 +9,25 @@ in the same single JSON line under "extra":
   #3 MeanAveragePrecision update throughput on synthetic COCO-shaped boxes + one
      compute latency
   #4 FID update throughput through the jitted in-tree InceptionV3 (random weights —
-     identical FLOPs to pretrained) at 299x299
+     identical FLOPs to pretrained) at 299x299, f32 and bf16 trunks
+  #5 BERTScore + CLIPScore machinery throughput through deterministic toy embedders
+     (pretrained HF weights are not downloadable in an air-gapped pod)
   sync: in-graph psum latency of the fused collection state over an 8-device CPU mesh
 
-Config #5 (BERTScore+CLIPScore) is reported as unavailable until the model-backed text
-tower lands. Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extra"}.
+Every config runs in its OWN subprocess: a single device→host readback flips the
+tunneled TPU runtime into synchronous per-call dispatch for the rest of the process
+(~80x slower), so one config's compute() must not poison the next config's loop.
+``vs_baseline`` is measured against a **torch-CPU proxy** (no CUDA device exists in
+this pod); the CUDA north-star comparison in BASELINE.md cannot be run here.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extra"}.
 """
 
 from __future__ import annotations
 
 import json
+import subprocess
+import sys
 import time
 
 import numpy as np
@@ -26,10 +35,10 @@ import numpy as np
 BATCH = 65536
 NUM_CLASSES = 5
 WARMUP = 5
-ITERS = 200
+ITERS = 400
 
 
-def bench_ours() -> float:
+def bench_ours() -> dict:
     import jax
     import jax.numpy as jnp
 
@@ -44,15 +53,17 @@ def bench_ours() -> float:
         metric.update(preds, target)
     jax.block_until_ready(metric._state)
 
-    start = time.perf_counter()
-    for _ in range(ITERS):
-        metric.update(preds, target)
-    jax.block_until_ready(metric._state)
-    elapsed = time.perf_counter() - start
-    return ITERS / elapsed
+    best = 0.0
+    for _ in range(3):  # best-of-3: tunnel latency to the shared TPU pool is noisy
+        start = time.perf_counter()
+        for _ in range(ITERS):
+            metric.update(preds, target)
+        jax.block_until_ready(metric._state)
+        best = max(best, ITERS / (time.perf_counter() - start))
+    return {"updates_per_sec": round(best, 2)}
 
 
-def bench_torch_baseline() -> float:
+def bench_torch_baseline() -> dict:
     """Reference-equivalent stateful loop in pure torch (CPU): argmax + bincount
     confusion accumulation, mirroring reference stat_scores update semantics."""
     import torch
@@ -77,13 +88,16 @@ def bench_torch_baseline() -> float:
             fn = fn + bins.sum(1) - bins.diagonal()
             tn = tn + bins.sum() - bins.sum(0) - bins.sum(1) + bins.diagonal()
 
+    iters = 100
     for _ in range(WARMUP):
         update()
-    start = time.perf_counter()
-    for _ in range(ITERS):
-        update()
-    elapsed = time.perf_counter() - start
-    return ITERS / elapsed
+    best = 0.0
+    for _ in range(3):  # best-of-3 on both sides so vs_baseline compares like for like
+        start = time.perf_counter()
+        for _ in range(iters):
+            update()
+        best = max(best, iters / (time.perf_counter() - start))
+    return {"updates_per_sec": round(best, 2)}
 
 
 def bench_fused_collection() -> dict:
@@ -118,14 +132,16 @@ def bench_fused_collection() -> dict:
     for _ in range(WARMUP):
         states = step(states, probs, target)
     jax.block_until_ready(states)
-    start = time.perf_counter()
-    for _ in range(ITERS):
-        states = step(states, probs, target)
-    jax.block_until_ready(states)
-    elapsed = time.perf_counter() - start
+    best = 0.0
+    for _ in range(3):
+        start = time.perf_counter()
+        for _ in range(ITERS):
+            states = step(states, probs, target)
+        jax.block_until_ready(states)
+        best = max(best, ITERS / (time.perf_counter() - start))
     values = jax.jit(pure.compute)(states)
     jax.block_until_ready(values)
-    return {"updates_per_sec": round(ITERS / elapsed, 2), "unit": f"fused 4-metric updates/s (batch={batch}, C=10)"}
+    return {"updates_per_sec": round(best, 2), "unit": f"fused 4-metric updates/s (batch={batch}, C=10)"}
 
 
 def bench_map() -> dict:
@@ -175,7 +191,7 @@ def bench_map() -> dict:
 
 def bench_fid() -> dict:
     """Config #4: FID update throughput through the jitted InceptionV3 (random
-    weights — same FLOPs as pretrained) on 299x299 batches of 32."""
+    weights — same FLOPs as pretrained) on 299x299 batches."""
     import jax
     import jax.numpy as jnp
 
@@ -183,18 +199,26 @@ def bench_fid() -> dict:
     from torchmetrics_tpu.image._extractors import InceptionV3Features
 
     rng = np.random.default_rng(3)
-    imgs = jnp.asarray(rng.random((32, 3, 299, 299)).astype(np.float32))
-    fid = FrechetInceptionDistance(feature=InceptionV3Features(), normalize=True)
-    fid.update(imgs, real=True)
-    fid.update(imgs, real=False)
-    jax.block_until_ready(fid._state)
-    iters = 10
-    start = time.perf_counter()
-    for i in range(iters):
-        fid.update(imgs, real=bool(i % 2))
-    jax.block_until_ready(fid._state)
-    elapsed = time.perf_counter() - start
-    return {"images_per_sec": round(iters * 32 / elapsed, 2), "unit": "InceptionV3-2048 fwd+stats images/s (299x299)"}
+    out = {}
+    for trunk, batch in (("float32", 64), ("bfloat16", 256)):
+        imgs = jnp.asarray(rng.random((batch, 3, 299, 299)).astype(np.float32))
+        fid = FrechetInceptionDistance(
+            feature=InceptionV3Features(compute_dtype=trunk), normalize=True
+        )
+        fid.update(imgs, real=True)
+        fid.update(imgs, real=False)
+        jax.block_until_ready(fid._state)
+        iters = 10
+        rates = []
+        for _ in range(3):  # median-of-3: the shared TPU pool occasionally hiccups
+            start = time.perf_counter()
+            for i in range(iters):
+                fid.update(imgs, real=bool(i % 2))
+            jax.block_until_ready(fid._state)
+            rates.append(iters * batch / (time.perf_counter() - start))
+        out[f"images_per_sec_{trunk}"] = round(sorted(rates)[1], 2)
+    out["unit"] = "InceptionV3-2048 fwd+stats images/s (299x299)"
+    return out
 
 
 def bench_bertscore_clipscore() -> dict:
@@ -249,74 +273,101 @@ def bench_bertscore_clipscore() -> dict:
 
 
 def bench_sync_latency() -> dict:
-    """In-graph psum of the fused collection state over an 8-device CPU mesh."""
-    import subprocess
-    import sys
+    """In-graph psum of the fused collection state over an 8-device CPU mesh, plus the
+    BASELINE flagship collection (Accuracy+F1+mAP+FID) sync through the same plane."""
+    import os
 
-    code = r"""
-import os, time, json
-os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
-os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-import numpy as np, jax, jax.numpy as jnp
-from jax.sharding import PartitionSpec as P
-from jax.experimental.shard_map import shard_map
-from torchmetrics_tpu import MetricCollection
-from torchmetrics_tpu.classification import MulticlassAccuracy, MulticlassAUROC, MulticlassConfusionMatrix, MulticlassF1Score
-num_classes = 10
-collection = MetricCollection({
-    "acc": MulticlassAccuracy(num_classes, average="micro", validate_args=False),
-    "f1": MulticlassF1Score(num_classes, average="macro", validate_args=False),
-    "auroc": MulticlassAUROC(num_classes, thresholds=200, validate_args=False),
-    "confmat": MulticlassConfusionMatrix(num_classes, validate_args=False),
-})
-pure = collection.as_pure()
-mesh = jax.sharding.Mesh(np.array(jax.devices()[:8]), ("data",))
-states = pure.init()
-reduce_fn = jax.jit(shard_map(lambda s: pure.reduce(s, "data"), mesh=mesh,
-                              in_specs=(P(),), out_specs=P(), check_rep=False))
-out = reduce_fn(states); jax.block_until_ready(out)
-start = time.perf_counter()
-for _ in range(50):
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    from __graft_entry__ import _force_virtual_cpu_mesh
+
+    _force_virtual_cpu_mesh(8)
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from torchmetrics_tpu import MetricCollection
+    from torchmetrics_tpu.classification import (
+        MulticlassAccuracy,
+        MulticlassAUROC,
+        MulticlassConfusionMatrix,
+        MulticlassF1Score,
+    )
+
+    num_classes = 10
+    collection = MetricCollection({
+        "acc": MulticlassAccuracy(num_classes, average="micro", validate_args=False),
+        "f1": MulticlassF1Score(num_classes, average="macro", validate_args=False),
+        "auroc": MulticlassAUROC(num_classes, thresholds=200, validate_args=False),
+        "confmat": MulticlassConfusionMatrix(num_classes, validate_args=False),
+    })
+    pure = collection.as_pure()
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:8]), ("data",))
+    states = pure.init()
+    reduce_fn = jax.jit(jax.shard_map(lambda s: pure.reduce(s, "data"), mesh=mesh,
+                                      in_specs=(P(),), out_specs=P(), check_vma=False))
     out = reduce_fn(states)
-jax.block_until_ready(out)
-print(json.dumps({"psum_latency_ms": round((time.perf_counter() - start) / 50 * 1000, 3)}))
-"""
+    jax.block_until_ready(out)
+    start = time.perf_counter()
+    for _ in range(50):
+        out = reduce_fn(states)
+    jax.block_until_ready(out)
+    result = {"psum_latency_ms": round((time.perf_counter() - start) / 50 * 1000, 3)}
+
+    from __graft_entry__ import _flagship_sync_latency_ms  # shares the dryrun's mesh plumbing
+
+    flagship_mesh = jax.make_mesh((8,), ("dp",), devices=jax.devices()[:8])
+    result["flagship_sync_latency_ms"] = _flagship_sync_latency_ms(flagship_mesh)
+    return result
+
+
+CONFIGS = {
+    "ours": bench_ours,
+    "torch_baseline": bench_torch_baseline,
+    "fused_collection_cifar10": bench_fused_collection,
+    "coco_map_synthetic": bench_map,
+    "fid_inception_fwd": bench_fid,
+    "sync_allreduce_8dev_cpu": bench_sync_latency,
+    "bertscore_clipscore": bench_bertscore_clipscore,
+}
+
+
+def _run_in_subprocess(name: str) -> dict:
     try:
-        res = subprocess.run([sys.executable, "-c", code], capture_output=True, text=True, timeout=600)
+        res = subprocess.run(
+            [sys.executable, __file__, "--only", name],
+            capture_output=True, text=True, timeout=1800,
+        )
         return json.loads(res.stdout.strip().splitlines()[-1])
-    except Exception as err:
-        return {"psum_latency_ms": None, "error": str(err)[:120]}
+    except Exception as err:  # keep the primary JSON line alive whatever happens
+        tail = []
+        if "res" in locals():
+            tail = (res.stderr or res.stdout or "").strip().splitlines()[-3:]
+        return {"error": f"{type(err).__name__}: {err}: {' | '.join(tail)}"[:240]}
 
 
 def main() -> None:
-    ours = bench_ours()
-    try:
-        baseline = bench_torch_baseline()
-    except Exception:
-        baseline = float("nan")
-    vs = ours / baseline if baseline == baseline and baseline > 0 else float("nan")
+    if len(sys.argv) == 3 and sys.argv[1] == "--only":
+        print(json.dumps(CONFIGS[sys.argv[2]]()))
+        return
 
-    extra = {}
-    for name, fn in (
-        ("fused_collection_cifar10", bench_fused_collection),
-        ("coco_map_synthetic", bench_map),
-        ("fid_inception_fwd", bench_fid),
-        ("sync_allreduce_8dev_cpu", bench_sync_latency),
-        ("bertscore_clipscore", bench_bertscore_clipscore),
-    ):
-        try:
-            extra[name] = fn()
-        except Exception as err:  # keep the primary line alive whatever happens
-            extra[name] = {"error": str(err)[:120]}
+    results = {name: _run_in_subprocess(name) for name in CONFIGS}
+    ours = results["ours"].get("updates_per_sec")
+    baseline = results["torch_baseline"].get("updates_per_sec")
+    vs = round(ours / baseline, 3) if ours and baseline else None
 
+    extra = {k: v for k, v in results.items() if k not in ("ours", "torch_baseline")}
+    for name in ("ours", "torch_baseline"):  # surface failures instead of a bare null
+        if "error" in results[name]:
+            extra[f"{name}_error"] = results[name]["error"]
+    extra["torch_cpu_proxy_updates_per_sec"] = baseline
+    extra["vs_baseline_note"] = "torch-CPU proxy (no CUDA device in pod; BASELINE.md north star is vs CUDA GPU)"
     print(
         json.dumps(
             {
                 "metric": "multiclass_accuracy_updates_per_sec",
-                "value": round(ours, 2),
-                "unit": "updates/s (batch=65536, C=5)",
-                "vs_baseline": round(vs, 3) if vs == vs else None,
+                "value": ours,
+                "unit": f"updates/s (batch={BATCH}, C={NUM_CLASSES})",
+                "vs_baseline": vs,
                 "extra": extra,
             }
         )

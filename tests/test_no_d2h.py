@@ -1,0 +1,160 @@
+"""No device→host transfers in construction/update hot paths.
+
+On tunneled TPU runtimes a single D2H readback (an ``np.asarray`` of a device array,
+or jit lowering a closure-captured *device* constant) permanently flips the process
+into synchronous per-call dispatch (~80x slower per call). The contract enforced
+here: metric construction, ``update`` (first call included — lowering embeds
+closure constants), and ``forward`` perform **zero** device→host transfers. Only
+``compute()`` — the value handoff to the user — may read back.
+
+``jax.transfer_guard_device_to_host("disallow")`` turns any violation into an error,
+on every platform, so this guards the TPU behavior from a CPU test.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from conftest import NUM_DEVICES
+
+
+@pytest.fixture()
+def guard():
+    with jax.transfer_guard_device_to_host("disallow"):
+        yield
+
+
+def _cls_batch(n=256, c=5, seed=0):
+    rng = np.random.default_rng(seed)
+    preds = jnp.asarray(rng.normal(size=(n, c)).astype(np.float32))
+    probs = jax.nn.softmax(preds)
+    target = jnp.asarray(rng.integers(0, c, n, dtype=np.int32))
+    return preds, probs, target
+
+
+class TestNoD2HOnUpdate:
+    def test_stat_scores_family(self, guard):
+        from torchmetrics_tpu.classification import (
+            BinaryF1Score,
+            MulticlassAccuracy,
+            MulticlassF1Score,
+            MultilabelAccuracy,
+        )
+
+        preds, probs, target = _cls_batch()
+        for m in (
+            MulticlassAccuracy(5, average="micro", validate_args=False),
+            MulticlassF1Score(5, average="macro", validate_args=False),
+        ):
+            m.update(preds, target)
+            m.update(preds, target)
+        b = BinaryF1Score(validate_args=False)
+        b.update(probs[:, 0], (target > 2).astype(jnp.int32))
+        ml = MultilabelAccuracy(num_labels=5, validate_args=False)
+        ml.update(probs, (probs > 0.2).astype(jnp.int32))
+
+    def test_curve_family_binned(self, guard):
+        from torchmetrics_tpu.classification import (
+            BinaryAUROC,
+            MulticlassAUROC,
+            MulticlassAveragePrecision,
+            MulticlassCalibrationError,
+            MulticlassConfusionMatrix,
+        )
+
+        preds, probs, target = _cls_batch()
+        for m in (
+            MulticlassAUROC(5, thresholds=100, validate_args=False),
+            MulticlassAveragePrecision(5, thresholds=50, validate_args=False),
+            MulticlassConfusionMatrix(5, validate_args=False),
+            MulticlassCalibrationError(5, n_bins=15, validate_args=False),
+        ):
+            m.update(probs, target)
+            m.update(probs, target)
+        b = BinaryAUROC(thresholds=100, validate_args=False)
+        b.update(probs[:, 0], (target > 2).astype(jnp.int32))
+
+    def test_aggregation_and_regression(self, guard):
+        from torchmetrics_tpu.aggregation import MaxMetric, MeanMetric, MinMetric, SumMetric
+        from torchmetrics_tpu.regression import MeanSquaredError, PearsonCorrCoef
+
+        x = jnp.asarray(np.random.default_rng(1).random(128).astype(np.float32))
+        for m in (MaxMetric(), MinMetric(), SumMetric(), MeanMetric()):
+            m.update(x)
+            m.update(x * 2)
+        mse = MeanSquaredError()
+        mse.update(x, x * 1.1)
+        p = PearsonCorrCoef()
+        p.update(x, x * 0.5 + 0.1)
+
+    def test_forward_path(self, guard):
+        from torchmetrics_tpu.classification import MulticlassAccuracy
+
+        preds, _, target = _cls_batch()
+        m = MulticlassAccuracy(5, average="micro", validate_args=False)
+        val = m.forward(preds, target)
+        val2 = m(preds, target)
+        assert val is not None and val2 is not None
+
+    def test_fused_collection_update(self, guard):
+        from torchmetrics_tpu import MetricCollection
+        from torchmetrics_tpu.classification import (
+            MulticlassAccuracy,
+            MulticlassAUROC,
+            MulticlassConfusionMatrix,
+            MulticlassF1Score,
+        )
+
+        _, probs, target = _cls_batch(c=10)
+        pure = MetricCollection({
+            "acc": MulticlassAccuracy(10, average="micro", validate_args=False),
+            "f1": MulticlassF1Score(10, average="macro", validate_args=False),
+            "auroc": MulticlassAUROC(10, thresholds=64, validate_args=False),
+            "confmat": MulticlassConfusionMatrix(10, validate_args=False),
+        }).as_pure()
+        step = jax.jit(pure.update, donate_argnums=0)
+        states = pure.init()
+        for _ in range(2):
+            states = step(states, probs, target)
+        jax.block_until_ready(states)
+
+    def test_fid_update(self, guard):
+        from torchmetrics_tpu.image import FrechetInceptionDistance
+
+        class Toy:
+            num_features = 8
+
+            def __call__(self, imgs):
+                return jnp.reshape(jnp.asarray(imgs, jnp.float32), (imgs.shape[0], -1))[:, :8]
+
+        fid = FrechetInceptionDistance(feature=Toy(), normalize=True)
+        imgs = jnp.asarray(np.random.default_rng(2).random((4, 3, 8, 8)).astype(np.float32))
+        fid.update(imgs, real=True)
+        fid.update(imgs, real=False)
+        jax.block_until_ready(fid._state)
+
+    def test_padded_detection_update(self, guard):
+        from torchmetrics_tpu.detection import PaddedDetectionAccumulator
+
+        acc = PaddedDetectionAccumulator(capacity_images=4, max_detections=4, max_groundtruths=4)
+        state = acc.init()
+        batch = tuple(
+            jnp.zeros(s, d)
+            for s, d in (
+                ((2, 4, 4), jnp.float32), ((2, 4), jnp.float32), ((2, 4), jnp.int32), ((2,), jnp.int32),
+                ((2, 4, 4), jnp.float32), ((2, 4), jnp.int32), ((2, 4), jnp.int32), ((2, 4), jnp.float32),
+                ((2,), jnp.int32),
+            )
+        )
+        state = jax.jit(acc.update)(state, *batch)
+        jax.block_until_ready(state)
+
+    def test_reset_and_reuse(self, guard):
+        from torchmetrics_tpu.classification import MulticlassAccuracy
+
+        preds, _, target = _cls_batch()
+        m = MulticlassAccuracy(5, average="micro", validate_args=False)
+        m.update(preds, target)
+        m.reset()
+        m.update(preds, target)

@@ -80,7 +80,7 @@ class MaxMetric(BaseAggregator):
     higher_is_better = True
 
     def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
-        super().__init__("max", -jnp.asarray(jnp.inf, jnp.float32), nan_strategy, state_name="max_value", **kwargs)
+        super().__init__("max", np.float32(-np.inf), nan_strategy, state_name="max_value", **kwargs)
 
     def _prepare_inputs(self, value):
         self._host_nan_check(value)
@@ -98,7 +98,7 @@ class MinMetric(BaseAggregator):
     higher_is_better = False
 
     def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
-        super().__init__("min", jnp.asarray(jnp.inf, jnp.float32), nan_strategy, state_name="min_value", **kwargs)
+        super().__init__("min", np.float32(np.inf), nan_strategy, state_name="min_value", **kwargs)
 
     def _prepare_inputs(self, value):
         self._host_nan_check(value)
@@ -113,7 +113,7 @@ class SumMetric(BaseAggregator):
     """Running sum (reference aggregation.py:330)."""
 
     def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
-        super().__init__("sum", jnp.zeros((), jnp.float32), nan_strategy, state_name="sum_value", **kwargs)
+        super().__init__("sum", np.zeros((), np.float32), nan_strategy, state_name="sum_value", **kwargs)
 
     def _prepare_inputs(self, value):
         self._host_nan_check(value)
@@ -154,8 +154,8 @@ class MeanMetric(BaseAggregator):
     """Weighted running mean — value & weight sum states (reference aggregation.py:501)."""
 
     def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
-        super().__init__("sum", jnp.zeros((), jnp.float32), nan_strategy, state_name="mean_value", **kwargs)
-        self.add_state("weight", default=jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+        super().__init__("sum", np.zeros((), np.float32), nan_strategy, state_name="mean_value", **kwargs)
+        self.add_state("weight", default=np.zeros((), jnp.float32), dist_reduce_fx="sum")
 
     def _prepare_inputs(self, value, weight=1.0):
         self._host_nan_check(value)
@@ -188,9 +188,9 @@ class _RunningBase(BaseAggregator):
             raise ValueError(f"Argument `window` should be a positive integer but got {window}")
         super().__init__("sum", None, nan_strategy, state_name=None, **kwargs)
         self.window = window
-        self.add_state("ring", default=jnp.zeros((window,), jnp.float32), dist_reduce_fx=None)
-        self.add_state("ring_valid", default=jnp.zeros((window,), jnp.bool_), dist_reduce_fx=None)
-        self.add_state("cursor", default=jnp.zeros((), jnp.int32), dist_reduce_fx=None)
+        self.add_state("ring", default=np.zeros((window,), jnp.float32), dist_reduce_fx=None)
+        self.add_state("ring_valid", default=np.zeros((window,), jnp.bool_), dist_reduce_fx=None)
+        self.add_state("cursor", default=np.zeros((), jnp.int32), dist_reduce_fx=None)
 
     def _prepare_inputs(self, value):
         self._host_nan_check(value)

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 from typing import Any, Callable, Optional
 
+import numpy as np
 import jax.numpy as jnp
 
 from ..functional.audio.external import (
@@ -41,8 +42,8 @@ class _MeanAudioMetric(Metric):
 
     def __init__(self, **kwargs: Any) -> None:
         super().__init__(**kwargs)
-        self.add_state("score_sum", default=jnp.zeros(()), dist_reduce_fx="sum")
-        self.add_state("total", default=jnp.zeros((), jnp.int32), dist_reduce_fx="sum")
+        self.add_state("score_sum", default=np.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", default=np.zeros((), jnp.int32), dist_reduce_fx="sum")
 
     def _score(self, preds, target) -> jnp.ndarray:
         raise NotImplementedError
@@ -133,8 +134,8 @@ class _HostMeanAudioMetric(HostMetric):
 
     def __init__(self, **kwargs: Any) -> None:
         super().__init__(**kwargs)
-        self.add_state("score_sum", default=jnp.zeros(()), dist_reduce_fx="sum")
-        self.add_state("total", default=jnp.zeros((), jnp.int32), dist_reduce_fx="sum")
+        self.add_state("score_sum", default=np.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", default=np.zeros((), jnp.int32), dist_reduce_fx="sum")
 
     def _score(self, preds, target) -> jnp.ndarray:
         raise NotImplementedError

@@ -7,6 +7,7 @@ from __future__ import annotations
 
 from typing import Any, Optional
 
+import numpy as np
 import jax.numpy as jnp
 
 from ..functional.classification.calibration_error import (
@@ -33,9 +34,9 @@ class _CalibrationBase(Metric):
     plot_upper_bound = 1.0
 
     def _create_state(self, n_bins: int) -> None:
-        self.add_state("conf_bin", default=jnp.zeros((n_bins + 1,), jnp.float32), dist_reduce_fx="sum")
-        self.add_state("acc_bin", default=jnp.zeros((n_bins + 1,), jnp.float32), dist_reduce_fx="sum")
-        self.add_state("count_bin", default=jnp.zeros((n_bins + 1,), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("conf_bin", default=np.zeros((n_bins + 1,), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("acc_bin", default=np.zeros((n_bins + 1,), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("count_bin", default=np.zeros((n_bins + 1,), jnp.float32), dist_reduce_fx="sum")
 
     def _compute(self, state):
         return _ce_compute_from_bins(state["conf_bin"], state["acc_bin"], state["count_bin"], self.norm)

@@ -5,6 +5,7 @@ from __future__ import annotations
 
 from typing import Any, Optional
 
+import numpy as np
 import jax.numpy as jnp
 
 from ..functional.classification.confusion_matrix import (
@@ -49,7 +50,7 @@ class BinaryConfusionMatrix(Metric):
         self.ignore_index = ignore_index
         self.normalize = normalize
         self.validate_args = validate_args
-        self.add_state("confmat", default=jnp.zeros((2, 2), jnp.int32), dist_reduce_fx="sum")
+        self.add_state("confmat", default=np.zeros((2, 2), jnp.int32), dist_reduce_fx="sum")
 
     def _prepare_inputs(self, preds, target):
         if self.validate_args:
@@ -90,7 +91,7 @@ class MulticlassConfusionMatrix(Metric):
         self.ignore_index = ignore_index
         self.normalize = normalize
         self.validate_args = validate_args
-        self.add_state("confmat", default=jnp.zeros((num_classes, num_classes), jnp.int32), dist_reduce_fx="sum")
+        self.add_state("confmat", default=np.zeros((num_classes, num_classes), jnp.int32), dist_reduce_fx="sum")
 
     def _prepare_inputs(self, preds, target):
         if self.validate_args:
@@ -133,7 +134,7 @@ class MultilabelConfusionMatrix(Metric):
         self.ignore_index = ignore_index
         self.normalize = normalize
         self.validate_args = validate_args
-        self.add_state("confmat", default=jnp.zeros((num_labels, 2, 2), jnp.int32), dist_reduce_fx="sum")
+        self.add_state("confmat", default=np.zeros((num_labels, 2, 2), jnp.int32), dist_reduce_fx="sum")
 
     def _prepare_inputs(self, preds, target):
         if self.validate_args:

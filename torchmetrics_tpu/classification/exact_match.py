@@ -4,6 +4,7 @@ from __future__ import annotations
 
 from typing import Any, Optional
 
+import numpy as np
 import jax.numpy as jnp
 
 from ..functional.classification.exact_match import (
@@ -34,8 +35,8 @@ class _ExactMatchBase(Metric):
             self.add_state("correct", default=[], dist_reduce_fx="cat")
             self.add_state("total", default=[], dist_reduce_fx="cat")
         else:
-            self.add_state("correct", default=jnp.zeros((), jnp.int32), dist_reduce_fx="sum")
-            self.add_state("total", default=jnp.zeros((), jnp.int32), dist_reduce_fx="sum")
+            self.add_state("correct", default=np.zeros((), jnp.int32), dist_reduce_fx="sum")
+            self.add_state("total", default=np.zeros((), jnp.int32), dist_reduce_fx="sum")
 
     def _compute(self, state):
         return _exact_match_reduce(state["correct"], state["total"])

@@ -8,6 +8,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, Optional
 
+import numpy as np
 import jax
 import jax.numpy as jnp
 
@@ -41,7 +42,7 @@ class _AbstractGroupStatScores(Metric):
         self.ignore_index = ignore_index
         self.validate_args = validate_args
         for s in ("tp", "fp", "tn", "fn"):
-            self.add_state(s, default=jnp.zeros(num_groups, jnp.int32), dist_reduce_fx="sum")
+            self.add_state(s, default=np.zeros(num_groups, jnp.int32), dist_reduce_fx="sum")
 
     def _batch_state(self, preds, target, groups):
         tp, fp, tn, fn = _binary_groups_stat_scores(

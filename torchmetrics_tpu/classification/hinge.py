@@ -4,6 +4,7 @@ from __future__ import annotations
 
 from typing import Any, Optional
 
+import numpy as np
 import jax.numpy as jnp
 
 from ..functional.classification.hinge import (
@@ -37,8 +38,8 @@ class BinaryHingeLoss(Metric):
         self.squared = squared
         self.ignore_index = ignore_index
         self.validate_args = validate_args
-        self.add_state("measures", default=jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
-        self.add_state("total", default=jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("measures", default=np.zeros((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("total", default=np.zeros((), jnp.float32), dist_reduce_fx="sum")
 
     def _prepare_inputs(self, preds, target):
         if self.validate_args:
@@ -77,9 +78,9 @@ class MulticlassHingeLoss(Metric):
         self.multiclass_mode = multiclass_mode
         self.ignore_index = ignore_index
         self.validate_args = validate_args
-        default = jnp.zeros((), jnp.float32) if multiclass_mode == "crammer-singer" else jnp.zeros((num_classes,), jnp.float32)
+        default = np.zeros((), np.float32) if multiclass_mode == "crammer-singer" else np.zeros((num_classes,), np.float32)
         self.add_state("measures", default=default, dist_reduce_fx="sum")
-        self.add_state("total", default=jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("total", default=np.zeros((), jnp.float32), dist_reduce_fx="sum")
 
     def _prepare_inputs(self, preds, target):
         if self.validate_args:

@@ -64,7 +64,7 @@ class BinaryPrecisionRecallCurve(Metric):
             self._jittable_compute = False
         else:
             self.add_state(
-                "confmat", default=jnp.zeros((len(self.thresholds), 2, 2), jnp.int32), dist_reduce_fx="sum"
+                "confmat", default=np.zeros((len(self.thresholds), 2, 2), jnp.int32), dist_reduce_fx="sum"
             )
 
     def _prepare_inputs(self, preds, target):
@@ -129,7 +129,7 @@ class MulticlassPrecisionRecallCurve(Metric):
             self._jittable_compute = False
         else:
             shape = (len(self.thresholds), 2, 2) if average == "micro" else (len(self.thresholds), num_classes, 2, 2)
-            self.add_state("confmat", default=jnp.zeros(shape, jnp.int32), dist_reduce_fx="sum")
+            self.add_state("confmat", default=np.zeros(shape, jnp.int32), dist_reduce_fx="sum")
 
     def _prepare_inputs(self, preds, target):
         if self.validate_args:
@@ -196,7 +196,7 @@ class MultilabelPrecisionRecallCurve(Metric):
             self._jittable_compute = False
         else:
             self.add_state(
-                "confmat", default=jnp.zeros((len(self.thresholds), num_labels, 2, 2), jnp.int32), dist_reduce_fx="sum"
+                "confmat", default=np.zeros((len(self.thresholds), num_labels, 2, 2), jnp.int32), dist_reduce_fx="sum"
             )
 
     def _prepare_inputs(self, preds, target):

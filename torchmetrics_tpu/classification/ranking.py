@@ -4,6 +4,7 @@ from __future__ import annotations
 
 from typing import Any, Optional
 
+import numpy as np
 import jax.numpy as jnp
 
 from ..functional.classification.ranking import (
@@ -28,8 +29,8 @@ class _RankingBase(Metric):
         self.num_labels = num_labels
         self.ignore_index = ignore_index
         self.validate_args = validate_args
-        self.add_state("measure", default=jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
-        self.add_state("total", default=jnp.zeros((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("measure", default=np.zeros((), jnp.float32), dist_reduce_fx="sum")
+        self.add_state("total", default=np.zeros((), jnp.float32), dist_reduce_fx="sum")
 
     _update_fn = None  # (preds, target) -> (measure, total)
 

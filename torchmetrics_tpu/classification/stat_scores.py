@@ -14,6 +14,7 @@ from __future__ import annotations
 from typing import Any, Optional
 
 import jax.numpy as jnp
+import numpy as np
 
 from ..functional.classification.stat_scores import (
     _binary_stat_scores_arg_validation,
@@ -45,7 +46,7 @@ class _AbstractStatScores(Metric):
             if multidim_average == "samplewise":
                 self.add_state(name, default=[], dist_reduce_fx="cat")
             else:
-                d = jnp.zeros((), jnp.int32) if size == 1 else jnp.zeros((size,), jnp.int32)
+                d = np.zeros((), np.int32) if size == 1 else np.zeros((size,), np.int32)
                 self.add_state(name, default=d, dist_reduce_fx="sum")
 
 

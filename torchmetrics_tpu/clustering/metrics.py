@@ -10,6 +10,7 @@ from __future__ import annotations
 
 from typing import Any
 
+import numpy as np
 import jax.numpy as jnp
 
 from ..functional.classification.confusion_matrix import _multiclass_confusion_matrix_update
@@ -182,7 +183,7 @@ class ClusterAccuracy(Metric):
         if not isinstance(num_classes, int) or num_classes < 1:
             raise ValueError(f"Expected argument `num_classes` to be a positive integer, but got {num_classes}")
         self.num_classes = num_classes
-        self.add_state("confmat", default=jnp.zeros((num_classes, num_classes), jnp.int32), dist_reduce_fx="sum")
+        self.add_state("confmat", default=np.zeros((num_classes, num_classes), jnp.int32), dist_reduce_fx="sum")
 
     def _prepare_inputs(self, preds, target):
         import numpy as np

@@ -6,8 +6,11 @@ from .giou import GeneralizedIntersectionOverUnion
 from .iou import IntersectionOverUnion
 from .mean_ap import MeanAveragePrecision
 from .panoptic_qualities import ModifiedPanopticQuality, PanopticQuality
+from .sharded import PaddedDetectionAccumulator, pack_detection_batch
 
 __all__ = [
+    "PaddedDetectionAccumulator",
+    "pack_detection_batch",
     "CompleteIntersectionOverUnion",
     "DistanceIntersectionOverUnion",
     "GeneralizedIntersectionOverUnion",

@@ -57,10 +57,10 @@ class PanopticQuality(HostMetric):
         self.return_per_class = return_per_class
 
         num_categories = len(things) + len(stuffs)
-        self.add_state("iou_sum", default=jnp.zeros(num_categories, jnp.float32), dist_reduce_fx="sum")
-        self.add_state("true_positives", default=jnp.zeros(num_categories, jnp.int32), dist_reduce_fx="sum")
-        self.add_state("false_positives", default=jnp.zeros(num_categories, jnp.int32), dist_reduce_fx="sum")
-        self.add_state("false_negatives", default=jnp.zeros(num_categories, jnp.int32), dist_reduce_fx="sum")
+        self.add_state("iou_sum", default=np.zeros(num_categories, jnp.float32), dist_reduce_fx="sum")
+        self.add_state("true_positives", default=np.zeros(num_categories, jnp.int32), dist_reduce_fx="sum")
+        self.add_state("false_positives", default=np.zeros(num_categories, jnp.int32), dist_reduce_fx="sum")
+        self.add_state("false_negatives", default=np.zeros(num_categories, jnp.int32), dist_reduce_fx="sum")
 
     _modified_stuffs = None  # PQ variant hook (set by ModifiedPanopticQuality)
 

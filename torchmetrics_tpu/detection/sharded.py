@@ -1,0 +1,155 @@
+"""Static-shape detection-state accumulation for sharded eval loops.
+
+The reference's mAP keeps dynamic host lists and syncs them with padded all_gathers
+at compute (reference ``detection/mean_ap.py:107-119``, ``metric.py:501-540``). XLA
+needs static shapes, so the TPU-native design (SURVEY §2.12: "cat-list states become
+pre-allocated ring buffers or gather-at-compute") is:
+
+- every device accumulates its shard of images into **pre-allocated padded buffers**
+  (``capacity_images`` rows of ``max_detections``/``max_groundtruths`` boxes) with one
+  ``lax.dynamic_update_slice`` per leaf per step — pure, jittable, shardable;
+- sync is one static-shape ``all_gather`` per leaf inside ``shard_map``;
+- the gathered pytree unpacks host-side into the list-of-dicts the
+  :class:`~torchmetrics_tpu.detection.MeanAveragePrecision` evaluator consumes
+  (mirroring the reference's host-side pycocotools hand-off).
+
+This is the piece that lets the BASELINE flagship collection
+``[Accuracy, F1, MeanAveragePrecision, FID]`` run as one jitted step across a mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+Array = jax.Array
+StateDict = Dict[str, Array]
+
+__all__ = ["PaddedDetectionAccumulator", "pack_detection_batch"]
+
+
+def pack_detection_batch(
+    preds: Sequence[Dict[str, Any]],
+    target: Sequence[Dict[str, Any]],
+    max_detections: int,
+    max_groundtruths: int,
+) -> Tuple[Array, ...]:
+    """Host helper: list-of-dicts batch → padded arrays for :meth:`update`.
+
+    Returns ``(det_box, det_scores, det_labels, det_counts, gt_box, gt_labels,
+    gt_crowds, gt_area, gt_counts)`` with per-image rows padded to the maxima.
+    """
+    b = len(preds)
+    det_box = np.zeros((b, max_detections, 4), np.float32)
+    det_scores = np.zeros((b, max_detections), np.float32)
+    det_labels = np.zeros((b, max_detections), np.int32)
+    det_counts = np.zeros((b,), np.int32)
+    gt_box = np.zeros((b, max_groundtruths, 4), np.float32)
+    gt_labels = np.zeros((b, max_groundtruths), np.int32)
+    gt_crowds = np.zeros((b, max_groundtruths), np.int32)
+    gt_area = np.zeros((b, max_groundtruths), np.float32)
+    gt_counts = np.zeros((b,), np.int32)
+    for i, (p, t) in enumerate(zip(preds, target)):
+        nd = min(len(np.asarray(p["labels"]).reshape(-1)), max_detections)
+        det_counts[i] = nd
+        if nd:
+            det_box[i, :nd] = np.asarray(p["boxes"], np.float32).reshape(-1, 4)[:nd]
+            det_scores[i, :nd] = np.asarray(p["scores"], np.float32).reshape(-1)[:nd]
+            det_labels[i, :nd] = np.asarray(p["labels"], np.int32).reshape(-1)[:nd]
+        ng = min(len(np.asarray(t["labels"]).reshape(-1)), max_groundtruths)
+        gt_counts[i] = ng
+        if ng:
+            gt_box[i, :ng] = np.asarray(t["boxes"], np.float32).reshape(-1, 4)[:ng]
+            gt_labels[i, :ng] = np.asarray(t["labels"], np.int32).reshape(-1)[:ng]
+            crowd = t.get("iscrowd")
+            if crowd is not None:
+                gt_crowds[i, :ng] = np.asarray(crowd, np.int32).reshape(-1)[:ng]
+            area = t.get("area")
+            if area is not None:
+                gt_area[i, :ng] = np.asarray(area, np.float32).reshape(-1)[:ng]
+    return tuple(
+        jnp.asarray(x)
+        for x in (det_box, det_scores, det_labels, det_counts, gt_box, gt_labels, gt_crowds, gt_area, gt_counts)
+    )
+
+
+class PaddedDetectionAccumulator:
+    """Pure static-shape accumulator for detection metric state (see module doc)."""
+
+    def __init__(self, capacity_images: int, max_detections: int = 100, max_groundtruths: int = 100) -> None:
+        self.capacity_images = capacity_images
+        self.max_detections = max_detections
+        self.max_groundtruths = max_groundtruths
+
+    # ------------------------------------------------------------------- pure
+    def init(self) -> StateDict:
+        i, d, g = self.capacity_images, self.max_detections, self.max_groundtruths
+        return {
+            "det_box": jnp.zeros((i, d, 4), jnp.float32),
+            "det_scores": jnp.zeros((i, d), jnp.float32),
+            "det_labels": jnp.zeros((i, d), jnp.int32),
+            "det_counts": jnp.zeros((i,), jnp.int32),
+            "gt_box": jnp.zeros((i, g, 4), jnp.float32),
+            "gt_labels": jnp.zeros((i, g), jnp.int32),
+            "gt_crowds": jnp.zeros((i, g), jnp.int32),
+            "gt_area": jnp.zeros((i, g), jnp.float32),
+            "gt_counts": jnp.zeros((i,), jnp.int32),
+            "n_images": jnp.zeros((), jnp.int32),
+        }
+
+    def update(self, state: StateDict, det_box, det_scores, det_labels, det_counts,
+               gt_box, gt_labels, gt_crowds, gt_area, gt_counts) -> StateDict:
+        """Write one padded batch (leading axis = images) at the current cursor.
+
+        Pure and jittable; overflow past ``capacity_images`` is clamped by XLA's
+        dynamic-slice semantics (the last rows are overwritten) — size the capacity
+        to the eval shard.
+        """
+        at = state["n_images"]
+        new = dict(state)
+        batch = {
+            "det_box": det_box, "det_scores": det_scores, "det_labels": det_labels,
+            "det_counts": det_counts, "gt_box": gt_box, "gt_labels": gt_labels,
+            "gt_crowds": gt_crowds, "gt_area": gt_area, "gt_counts": gt_counts,
+        }
+        for key, value in batch.items():
+            start = (at,) + (0,) * (value.ndim - 1)
+            new[key] = lax.dynamic_update_slice(state[key], value.astype(state[key].dtype), start)
+        new["n_images"] = at + jnp.asarray(det_counts.shape[0], jnp.int32)
+        return new
+
+    def gather(self, state: StateDict, axis_name: str) -> StateDict:
+        """All-gather every leaf over a mesh axis (inside ``shard_map``): leaves gain a
+        leading device axis; counts stay per-device so the host unpack can trim."""
+        return {k: lax.all_gather(v, axis_name) for k, v in state.items()}
+
+    # ------------------------------------------------------------------- host
+    def to_lists(self, state: StateDict) -> Tuple[List[Dict[str, np.ndarray]], List[Dict[str, np.ndarray]]]:
+        """Gathered (or single-device) state → the ``(preds, target)`` list-of-dicts
+        accepted by ``MeanAveragePrecision.update``. Host-side, trims padding."""
+        host = {k: np.asarray(v) for k, v in state.items()}
+        if host["n_images"].ndim == 0:  # single-device state: add a device axis
+            host = {k: v[None] for k, v in host.items()}
+        preds: List[Dict[str, np.ndarray]] = []
+        target: List[Dict[str, np.ndarray]] = []
+        for dev in range(host["n_images"].shape[0]):
+            n = int(host["n_images"][dev])
+            for i in range(min(n, self.capacity_images)):
+                nd = int(host["det_counts"][dev, i])
+                ng = int(host["gt_counts"][dev, i])
+                preds.append({
+                    "boxes": host["det_box"][dev, i, :nd],
+                    "scores": host["det_scores"][dev, i, :nd],
+                    "labels": host["det_labels"][dev, i, :nd],
+                })
+                target.append({
+                    "boxes": host["gt_box"][dev, i, :ng],
+                    "labels": host["gt_labels"][dev, i, :ng],
+                    "iscrowd": host["gt_crowds"][dev, i, :ng],
+                    "area": host["gt_area"][dev, i, :ng],
+                })
+        return preds, target

@@ -96,13 +96,19 @@ def _exact_count_sum(x: Array, axis=None) -> Array:
 
 
 def _adjust_threshold_arg(thresholds=None):
+    # Host (numpy) on purpose: thresholds are closure-captured by jitted updates, and a
+    # captured *device* constant forces a D2H readback at lowering, which flips
+    # tunneled TPU runtimes into synchronous dispatch for the whole process. Numpy
+    # constants embed from host bytes for free.
     if isinstance(thresholds, int):
-        return jnp.linspace(0, 1, thresholds)
+        return np.linspace(0, 1, thresholds, dtype=np.float32)
     if isinstance(thresholds, list):
-        return jnp.asarray(thresholds, jnp.float32)
+        return np.asarray(thresholds, np.float32)
     if thresholds is None:
         return None
-    return jnp.asarray(thresholds)
+    if isinstance(thresholds, jax.Array):
+        return thresholds  # user-supplied device array: keep (documented slow path)
+    return np.asarray(thresholds, np.float32)  # numpy array, tuple, or other sequence
 
 
 # --------------------------------------------------------------------- binary

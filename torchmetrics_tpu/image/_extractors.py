@@ -19,11 +19,16 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from ..functional.image._resize import resize_bilinear_antialias
+
 
 def _conv(x, w, stride=1, padding="SAME"):
+    # bf16 trunk runs at MXU-native precision; the f32 parity trunk pins HIGHEST so
+    # XLA cannot silently drop the conv stack to bf16 passes
+    precision = lax.Precision.DEFAULT if x.dtype == jnp.bfloat16 else lax.Precision.HIGHEST
     return lax.conv_general_dilated(
         x, w, (stride, stride), padding, dimension_numbers=("NCHW", "OIHW", "NCHW"),
-        precision=lax.Precision.HIGHEST,
+        precision=precision,
     )
 
 
@@ -94,7 +99,11 @@ def _inception_e(x, p):
 
 
 def _inception_forward(params: Dict[str, Any], imgs: jnp.ndarray) -> jnp.ndarray:
-    """InceptionV3 pool3 features ``(N, 2048)`` from NCHW images in [0, 1] at 299x299."""
+    """InceptionV3 pool3 features ``(N, 2048)`` from NCHW images in [0, 1] at 299x299.
+
+    Runs in the dtype of ``imgs`` (f32 parity trunk or bf16 MXU trunk); the global
+    average pool at the end accumulates in f32 either way."""
+    params = jax.tree.map(lambda p: p.astype(imgs.dtype), params)
     x = (imgs - 0.5) / 0.5  # [-1, 1] normalization
     x = _basic_conv(x, params["stem1"], stride=2, padding="VALID")
     x = _basic_conv(x, params["stem2"], padding="VALID")
@@ -111,28 +120,43 @@ def _inception_forward(params: Dict[str, Any], imgs: jnp.ndarray) -> jnp.ndarray
     x = _inception_d(x, params["mixed_d"])
     x = _inception_e(x, params["mixed_e1"])
     x = _inception_e(x, params["mixed_e2"])
-    return x.mean(axis=(2, 3))  # global average pool -> (N, 2048)
+    return x.astype(jnp.float32).mean(axis=(2, 3))  # global average pool -> (N, 2048), f32 accumulation
 
 
 class InceptionV3Features:
     """Jitted InceptionV3 pool3 feature extractor.
 
     Parameters load from a converted checkpoint (pickle of the jnp param pytree). No
-    pretrained weights ship in-tree and none can be downloaded in an air-gapped pod;
-    bit-exact FID versus the torch-fidelity extractor additionally depends on its
-    TF1-style antialias resize (reference ``image/fid.py:88-101``), so numbers are
-    comparable only within a fixed extractor. Random init is available for pipeline
-    tests.
+    pretrained weights ship in-tree and none can be downloaded in an air-gapped pod.
+    Random init is available for pipeline tests.
+
+    ``compute_dtype``: ``"float32"`` (default, ``Precision.HIGHEST`` parity trunk) or
+    ``"bfloat16"`` (MXU-native trunk, ~MXU-peak convs; feature means still accumulate
+    in f32). ``resize_antialias=True`` reproduces the reference extractor's TF1-style
+    antialiased bilinear input resize (reference ``image/fid.py:88-101``) instead of
+    plain bilinear — required for FID numbers comparable to torch-fidelity.
     """
 
     num_features = 2048
 
-    def __init__(self, weights_path: Optional[str] = None, seed: int = 0) -> None:
+    def __init__(
+        self,
+        weights_path: Optional[str] = None,
+        seed: int = 0,
+        compute_dtype: str = "float32",
+        resize_antialias: bool = True,
+    ) -> None:
         if weights_path is not None:
             with open(weights_path, "rb") as f:
                 self.params = jax.tree.map(jnp.asarray, pickle.load(f))
         else:
             self.params = self._random_params(jax.random.PRNGKey(seed))
+        self.compute_dtype = jnp.dtype(compute_dtype)
+        if self.compute_dtype != jnp.float32:
+            # cast once here; the in-forward cast is then a no-op instead of a
+            # per-batch ~24M-param conversion
+            self.params = jax.tree.map(lambda p: p.astype(self.compute_dtype), self.params)
+        self.resize_antialias = resize_antialias
         self._apply = jax.jit(_inception_forward)
 
     def __call__(self, imgs) -> jnp.ndarray:
@@ -140,8 +164,15 @@ class InceptionV3Features:
         if jnp.issubdtype(imgs.dtype, jnp.integer):
             imgs = imgs.astype(jnp.float32) / 255.0
         if imgs.shape[-2:] != (299, 299):
-            imgs = jax.image.resize(imgs, (*imgs.shape[:-2], 299, 299), method="bilinear")
-        return self._apply(self.params, imgs)
+            # resize in f32 regardless of trunk dtype: interpolation parity is what
+            # makes FID comparable across extractors (SURVEY §7 hard part)
+            if self.resize_antialias:
+                imgs = resize_bilinear_antialias(imgs.astype(jnp.float32), (299, 299))
+            else:
+                imgs = jax.image.resize(
+                    imgs.astype(jnp.float32), (*imgs.shape[:-2], 299, 299), method="bilinear"
+                )
+        return self._apply(self.params, imgs.astype(self.compute_dtype))
 
     # ---------------------------------------------------------------- params
 
@@ -311,10 +342,12 @@ def resolve_feature_extractor(
     normalize: bool,
     input_img_size: Tuple[int, int, int] = (3, 299, 299),
     weights_path: Optional[str] = None,
+    antialias: bool = True,
 ) -> Tuple[Callable, int, bool]:
     """Reference ``feature: int | Module`` resolution: int selects the in-tree
     InceptionV3 (converted weights REQUIRED — random features would yield plausible
-    but meaningless scores), any callable is used as-is.
+    but meaningless scores), any callable is used as-is. ``antialias`` picks the
+    reference extractor's resize fork (``image/fid.py:88-101``).
     Returns (extractor, num_features, used_custom)."""
     if isinstance(feature, int):
         if feature != 2048:
@@ -330,7 +363,7 @@ def resolve_feature_extractor(
                 "`feature_extractor_weights_path`, or pass a custom extractor callable "
                 "(e.g. `InceptionV3Features()` explicitly for random-weight throughput tests)."
             )
-        return InceptionV3Features(weights_path), 2048, False
+        return InceptionV3Features(weights_path, resize_antialias=antialias), 2048, False
     if callable(feature):
         num_features = getattr(feature, "num_features", None)
         if num_features is None:
@@ -339,6 +372,10 @@ def resolve_feature_extractor(
                 if normalize
                 else jnp.zeros((1, *input_img_size), jnp.uint8)
             )
-            num_features = int(np.asarray(feature(dummy)).shape[-1])
+            # eval_shape: shape inference without execution or device→host readback
+            try:
+                num_features = int(jax.eval_shape(feature, dummy).shape[-1])
+            except Exception:  # extractor not traceable (host-side model): run it
+                num_features = int(np.asarray(feature(dummy)).shape[-1])
         return feature, int(num_features), True
     raise TypeError("Got unknown input to argument `feature`")

@@ -56,7 +56,8 @@ class FrechetInceptionDistance(Metric):
         self.normalize = normalize
         self.antialias = antialias
         self.inception, num_features, self.used_custom_model = resolve_feature_extractor(
-            feature, normalize, input_img_size, weights_path=feature_extractor_weights_path
+            feature, normalize, input_img_size,
+            weights_path=feature_extractor_weights_path, antialias=antialias,
         )
         if not isinstance(reset_real_features, bool):
             raise ValueError("Argument `reset_real_features` expected to be a bool")

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, Optional, Sequence
 
+import numpy as np
 import jax.numpy as jnp
 
 from ..functional.image.d_lambda import _spectral_distortion_index_compute, _spectral_distortion_index_update
@@ -117,8 +118,8 @@ class TotalVariation(Metric):
         if reduction in (None, "none"):
             self.add_state("score_list", default=[], dist_reduce_fx="cat")
         else:
-            self.add_state("score", default=jnp.zeros(()), dist_reduce_fx="sum")
-            self.add_state("num_elements", default=jnp.zeros((), jnp.int32), dist_reduce_fx="sum")
+            self.add_state("score", default=np.zeros(()), dist_reduce_fx="sum")
+            self.add_state("num_elements", default=np.zeros((), jnp.int32), dist_reduce_fx="sum")
 
     def _batch_state(self, img):
         score, num_elements = _total_variation_update(img)
@@ -187,8 +188,8 @@ class SpatialCorrelationCoefficient(Metric):
             raise ValueError(f"Expected `window_size` to be a positive integer. Got {window_size}.")
         self.hp_filter = high_pass_filter
         self.ws = window_size
-        self.add_state("scc_score", default=jnp.zeros(()), dist_reduce_fx="sum")
-        self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("scc_score", default=np.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", default=np.zeros(()), dist_reduce_fx="sum")
 
     def _batch_state(self, preds, target):
         scores = spatial_correlation_coefficient(preds, target, self.hp_filter, self.ws, reduction="none")
@@ -271,8 +272,8 @@ class RootMeanSquaredErrorUsingSlidingWindow(Metric):
         if not isinstance(window_size, int) or window_size < 1:
             raise ValueError("Argument `window_size` is expected to be a positive integer.")
         self.window_size = window_size
-        self.add_state("rmse_val_sum", default=jnp.zeros(()), dist_reduce_fx="sum")
-        self.add_state("total_images", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("rmse_val_sum", default=np.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total_images", default=np.zeros(()), dist_reduce_fx="sum")
 
     def _batch_state(self, preds, target):
         rmse_val_sum, _, total_images = _rmse_sw_update(

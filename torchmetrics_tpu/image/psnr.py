@@ -5,6 +5,7 @@ from __future__ import annotations
 from collections.abc import Sequence
 from typing import Any, Optional, Tuple, Union
 
+import numpy as np
 import jax.numpy as jnp
 
 from ..functional.image.psnr import _psnr_compute, _psnr_update
@@ -33,8 +34,8 @@ class PeakSignalNoiseRatio(Metric):
         if dim is None and reduction != "elementwise_mean":
             rank_zero_warn(f"The `reduction={reduction}` will not have any effect when `dim` is None.")
         if dim is None:
-            self.add_state("sum_squared_error", default=jnp.zeros(()), dist_reduce_fx="sum")
-            self.add_state("total", default=jnp.zeros((), jnp.int32), dist_reduce_fx="sum")
+            self.add_state("sum_squared_error", default=np.zeros(()), dist_reduce_fx="sum")
+            self.add_state("total", default=np.zeros((), jnp.int32), dist_reduce_fx="sum")
         else:
             self.add_state("sum_squared_error", default=[], dist_reduce_fx="cat")
             self.add_state("total", default=[], dist_reduce_fx="cat")

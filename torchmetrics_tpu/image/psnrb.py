@@ -4,6 +4,7 @@ from __future__ import annotations
 
 from typing import Any, Tuple, Union
 
+import numpy as np
 import jax.numpy as jnp
 
 from ..functional.image.psnrb import _psnrb_compute, _psnrb_update
@@ -28,9 +29,9 @@ class PeakSignalNoiseRatioWithBlockedEffect(Metric):
         if not isinstance(block_size, int) or block_size < 1:
             raise ValueError("Argument `block_size` should be a positive integer")
         self.block_size = block_size
-        self.add_state("sum_squared_error", default=jnp.zeros(()), dist_reduce_fx="sum")
-        self.add_state("total", default=jnp.zeros((), jnp.int32), dist_reduce_fx="sum")
-        self.add_state("bef", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("sum_squared_error", default=np.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", default=np.zeros((), jnp.int32), dist_reduce_fx="sum")
+        self.add_state("bef", default=np.zeros(()), dist_reduce_fx="sum")
         self.clamp_range = None
         if isinstance(data_range, tuple):
             self.data_range_val = float(data_range[1] - data_range[0])

@@ -4,6 +4,7 @@ from __future__ import annotations
 
 from typing import Any, Optional, Sequence, Tuple, Union
 
+import numpy as np
 import jax.numpy as jnp
 
 from ..functional.image.ssim import _multiscale_ssim_update, _ssim_check_inputs, _ssim_update
@@ -38,10 +39,10 @@ class StructuralSimilarityIndexMeasure(Metric):
         if reduction not in valid_reduction:
             raise ValueError(f"Argument `reduction` must be one of {valid_reduction}, but got {reduction}")
         if reduction in ("elementwise_mean", "sum"):
-            self.add_state("similarity", default=jnp.zeros(()), dist_reduce_fx="sum")
+            self.add_state("similarity", default=np.zeros(()), dist_reduce_fx="sum")
         else:
             self.add_state("similarity", default=[], dist_reduce_fx="cat")
-        self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", default=np.zeros(()), dist_reduce_fx="sum")
         if return_contrast_sensitivity or return_full_image:
             self.add_state("image_return", default=[], dist_reduce_fx="cat")
         self.gaussian_kernel = gaussian_kernel
@@ -116,10 +117,10 @@ class MultiScaleStructuralSimilarityIndexMeasure(Metric):
         if reduction not in valid_reduction:
             raise ValueError(f"Argument `reduction` must be one of {valid_reduction}, but got {reduction}")
         if reduction in ("elementwise_mean", "sum"):
-            self.add_state("similarity", default=jnp.zeros(()), dist_reduce_fx="sum")
+            self.add_state("similarity", default=np.zeros(()), dist_reduce_fx="sum")
         else:
             self.add_state("similarity", default=[], dist_reduce_fx="cat")
-        self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", default=np.zeros(()), dist_reduce_fx="sum")
         if not (isinstance(kernel_size, (Sequence, int))):
             raise ValueError(
                 f"Argument `kernel_size` expected to be an sequence or an int, or a single int. Got {kernel_size}"

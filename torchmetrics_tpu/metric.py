@@ -55,6 +55,19 @@ StateDict = Dict[str, Any]
 _ALLOWED_REDUCE = ("sum", "mean", "cat", "min", "max", None)
 
 
+def _fresh_leaf(default: Any) -> Array:
+    """Fresh device buffer from a state default, with no device→host readback.
+
+    ``update()`` donates state buffers to XLA, so the live state must never alias the
+    default. Device-array defaults are value-copied on device (``jnp.copy``); host
+    (numpy/python) defaults upload. Reading a device default back through numpy is
+    deliberately avoided: a single D2H readback flips tunneled TPU runtimes into
+    synchronous per-call dispatch for the rest of the process (~80x slower)."""
+    if isinstance(default, jax.Array):
+        return jnp.copy(default)
+    return jnp.asarray(default)
+
+
 class Metric:
     """Base class for all metrics (stateful shell over a pure core).
 
@@ -63,7 +76,7 @@ class Metric:
         class MyMetric(Metric):
             def __init__(self, **kwargs):
                 super().__init__(**kwargs)
-                self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
+                self.add_state("total", default=np.zeros(()), dist_reduce_fx="sum")
 
             def _batch_state(self, preds, target) -> dict:   # pure, jit-traced
                 return {"total": (preds == target).sum()}
@@ -137,7 +150,7 @@ class Metric:
         empty list (concat state — host list of per-batch arrays).
         """
         if not isinstance(default, (list,)) and not hasattr(default, "shape"):
-            default = jnp.asarray(default)
+            default = np.asarray(default)
         if isinstance(default, list) and default != []:
             raise ValueError("state variable must be a tensor or any empty list (where you can append tensors)")
         if dist_reduce_fx not in _ALLOWED_REDUCE and not callable(dist_reduce_fx):
@@ -147,12 +160,16 @@ class Metric:
         if name in ("_defaults", "_reductions", "_persistent", "_state"):
             raise ValueError(f"The name `{name}` is reserved.")
 
-        # defaults live host-side (numpy): update() donates state buffers to XLA, so a
-        # default aliased into the live state would be deleted by the first update
-        self._defaults[name] = default if isinstance(default, list) else np.asarray(default)
+        # The default is kept wherever it was born — numpy defaults stay numpy, device
+        # defaults stay on device. Reading a device array back (np.asarray) is NOT an
+        # option here: one D2H readback flips tunneled TPU runtimes into synchronous
+        # dispatch for the rest of the process (~80x slower per jitted call). The live
+        # state gets a fresh buffer either way, because update() donates state buffers
+        # to XLA and an aliased default would be deleted by the first update.
+        self._defaults[name] = default
         self._reductions[name] = dist_reduce_fx
         self._persistent[name] = persistent
-        self._state[name] = [] if isinstance(default, list) else jnp.asarray(self._defaults[name])
+        self._state[name] = [] if isinstance(default, list) else _fresh_leaf(default)
         self._jit_cache.clear()
 
     @property
@@ -180,7 +197,7 @@ class Metric:
 
     def init_state(self) -> StateDict:
         """Fresh default state (pure)."""
-        return {n: ([] if isinstance(d, list) else jnp.asarray(d)) for n, d in self._defaults.items()}
+        return {n: ([] if isinstance(d, list) else _fresh_leaf(d)) for n, d in self._defaults.items()}
 
     def _batch_state(self, *args: Any, **kwargs: Any) -> StateDict:
         """This batch's state contribution (pure, jit-traced). REQUIRED override."""
@@ -395,7 +412,7 @@ class Metric:
         self._n_prev_dev = None
         self._computed = None
         for name, default in self._defaults.items():
-            self._state[name] = [] if isinstance(default, list) else jnp.asarray(default)
+            self._state[name] = [] if isinstance(default, list) else _fresh_leaf(default)
         self._is_synced = False
         self._cache = None
 
@@ -584,11 +601,15 @@ class Metric:
             x = jnp.asarray(x)
             return x.astype(dst_type) if jnp.issubdtype(x.dtype, jnp.floating) else x
 
+        def cast_default(v):
+            if isinstance(v, list) or isinstance(v, jax.Array):
+                return cast(v) if isinstance(v, jax.Array) else v
+            arr = np.asarray(v)
+            return arr.astype(dst_type) if np.issubdtype(arr.dtype, np.floating) else arr
+
         for k, v in self._state.items():
             self._state[k] = [cast(x) for x in v] if isinstance(v, list) else cast(v)
-        self._defaults = {
-            k: (v if isinstance(v, list) else np.asarray(cast(v))) for k, v in self._defaults.items()
-        }
+        self._defaults = {k: cast_default(v) for k, v in self._defaults.items()}
         self._dtype = dst_type
         self._jit_cache.clear()
         return self

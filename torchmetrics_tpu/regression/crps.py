@@ -5,6 +5,7 @@ from __future__ import annotations
 
 from typing import Any, Optional
 
+import numpy as np
 import jax.numpy as jnp
 
 from ..functional.regression.crps import _crps_update
@@ -23,9 +24,9 @@ class ContinuousRankedProbabilityScore(Metric):
 
     def __init__(self, **kwargs: Any) -> None:
         super().__init__(**kwargs)
-        self.add_state("diff_sum", default=jnp.zeros(()), dist_reduce_fx="sum")
-        self.add_state("ensemble_sum", default=jnp.zeros(()), dist_reduce_fx="sum")
-        self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("diff_sum", default=np.zeros(()), dist_reduce_fx="sum")
+        self.add_state("ensemble_sum", default=np.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", default=np.zeros(()), dist_reduce_fx="sum")
 
     def _batch_state(self, preds, target):
         batch_size, diff, ensemble_sum = _crps_update(preds, target)
@@ -53,9 +54,9 @@ class CriticalSuccessIndex(Metric):
             raise ValueError(f"Expected keep_sequence_dim to be int or None but got {keep_sequence_dim}")
         self.keep_sequence_dim = keep_sequence_dim
         if keep_sequence_dim is None:
-            self.add_state("hits", default=jnp.zeros(()), dist_reduce_fx="sum")
-            self.add_state("misses", default=jnp.zeros(()), dist_reduce_fx="sum")
-            self.add_state("false_alarms", default=jnp.zeros(()), dist_reduce_fx="sum")
+            self.add_state("hits", default=np.zeros(()), dist_reduce_fx="sum")
+            self.add_state("misses", default=np.zeros(()), dist_reduce_fx="sum")
+            self.add_state("false_alarms", default=np.zeros(()), dist_reduce_fx="sum")
         else:
             self.add_state("hits", default=[], dist_reduce_fx="cat")
             self.add_state("misses", default=[], dist_reduce_fx="cat")

@@ -5,6 +5,7 @@ from __future__ import annotations
 
 from typing import Any, Optional
 
+import numpy as np
 import jax.numpy as jnp
 
 from ..functional.regression.kl_divergence import _jsd_update, _kld_compute, _kld_update
@@ -30,10 +31,10 @@ class _DivergenceBase(Metric):
         self.reduction = reduction
 
         if self.reduction in ("mean", "sum"):
-            self.add_state("measures", default=jnp.zeros(()), dist_reduce_fx="sum")
+            self.add_state("measures", default=np.zeros(()), dist_reduce_fx="sum")
         else:
             self.add_state("measures", default=[], dist_reduce_fx="cat")
-        self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", default=np.zeros(()), dist_reduce_fx="sum")
 
     def _measures(self, p, q):
         raise NotImplementedError

@@ -6,6 +6,7 @@ from __future__ import annotations
 
 from typing import Any
 
+import numpy as np
 import jax.numpy as jnp
 
 from ..functional.regression.log_mse import (
@@ -49,8 +50,8 @@ class MeanSquaredError(Metric):
         if not (isinstance(num_outputs, int) and num_outputs > 0):
             raise ValueError(f"Expected num_outputs to be a positive integer but got {num_outputs}")
         self.num_outputs = num_outputs
-        self.add_state("sum_squared_error", default=jnp.zeros(num_outputs), dist_reduce_fx="sum")
-        self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("sum_squared_error", default=np.zeros(num_outputs), dist_reduce_fx="sum")
+        self.add_state("total", default=np.zeros(()), dist_reduce_fx="sum")
 
     def _batch_state(self, preds, target):
         sse, n = _mean_squared_error_update(preds, target, self.num_outputs)
@@ -73,8 +74,8 @@ class MeanAbsoluteError(Metric):
         if not (isinstance(num_outputs, int) and num_outputs > 0):
             raise ValueError(f"Expected num_outputs to be a positive integer but got {num_outputs}")
         self.num_outputs = num_outputs
-        self.add_state("sum_abs_error", default=jnp.zeros(num_outputs), dist_reduce_fx="sum")
-        self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("sum_abs_error", default=np.zeros(num_outputs), dist_reduce_fx="sum")
+        self.add_state("total", default=np.zeros(()), dist_reduce_fx="sum")
 
     def _batch_state(self, preds, target):
         sae, n = _mean_absolute_error_update(preds, target, self.num_outputs)
@@ -94,8 +95,8 @@ class MeanSquaredLogError(Metric):
 
     def __init__(self, **kwargs: Any) -> None:
         super().__init__(**kwargs)
-        self.add_state("sum_squared_log_error", default=jnp.zeros(()), dist_reduce_fx="sum")
-        self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("sum_squared_log_error", default=np.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", default=np.zeros(()), dist_reduce_fx="sum")
 
     def _batch_state(self, preds, target):
         s, n = _mean_squared_log_error_update(preds, target)
@@ -115,8 +116,8 @@ class MeanAbsolutePercentageError(Metric):
 
     def __init__(self, **kwargs: Any) -> None:
         super().__init__(**kwargs)
-        self.add_state("sum_abs_per_error", default=jnp.zeros(()), dist_reduce_fx="sum")
-        self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("sum_abs_per_error", default=np.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", default=np.zeros(()), dist_reduce_fx="sum")
 
     def _batch_state(self, preds, target):
         s, n = _mean_absolute_percentage_error_update(preds, target)
@@ -136,8 +137,8 @@ class SymmetricMeanAbsolutePercentageError(Metric):
 
     def __init__(self, **kwargs: Any) -> None:
         super().__init__(**kwargs)
-        self.add_state("sum_abs_per_error", default=jnp.zeros(()), dist_reduce_fx="sum")
-        self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("sum_abs_per_error", default=np.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", default=np.zeros(()), dist_reduce_fx="sum")
 
     def _batch_state(self, preds, target):
         s, n = _symmetric_mean_absolute_percentage_error_update(preds, target)
@@ -157,8 +158,8 @@ class WeightedMeanAbsolutePercentageError(Metric):
 
     def __init__(self, **kwargs: Any) -> None:
         super().__init__(**kwargs)
-        self.add_state("sum_abs_error", default=jnp.zeros(()), dist_reduce_fx="sum")
-        self.add_state("sum_scale", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("sum_abs_error", default=np.zeros(()), dist_reduce_fx="sum")
+        self.add_state("sum_scale", default=np.zeros(()), dist_reduce_fx="sum")
 
     def _batch_state(self, preds, target):
         sae, scale = _weighted_mean_absolute_percentage_error_update(preds, target)
@@ -181,8 +182,8 @@ class LogCoshError(Metric):
         if not (isinstance(num_outputs, int) and num_outputs > 0):
             raise ValueError(f"Expected num_outputs to be a positive integer but got {num_outputs}")
         self.num_outputs = num_outputs
-        self.add_state("sum_log_cosh_error", default=jnp.zeros(num_outputs), dist_reduce_fx="sum")
-        self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("sum_log_cosh_error", default=np.zeros(num_outputs), dist_reduce_fx="sum")
+        self.add_state("total", default=np.zeros(()), dist_reduce_fx="sum")
 
     def _batch_state(self, preds, target):
         s, n = _log_cosh_error_update(preds, target, self.num_outputs)
@@ -205,7 +206,7 @@ class MinkowskiDistance(Metric):
         if not (isinstance(p, (float, int)) and p >= 1):
             raise TorchMetricsUserError(f"Argument ``p`` must be a float or int greater than 1, but got {p}")
         self.p = p
-        self.add_state("minkowski_dist_sum", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("minkowski_dist_sum", default=np.zeros(()), dist_reduce_fx="sum")
 
     def _batch_state(self, preds, targets):
         return {"minkowski_dist_sum": _minkowski_distance_update(preds, targets, self.p)}
@@ -227,8 +228,8 @@ class TweedieDevianceScore(Metric):
         if 0 < power < 1:
             raise ValueError(f"Deviance Score is not defined for power={power}.")
         self.power = power
-        self.add_state("sum_deviance_score", default=jnp.zeros(()), dist_reduce_fx="sum")
-        self.add_state("num_observations", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("sum_deviance_score", default=np.zeros(()), dist_reduce_fx="sum")
+        self.add_state("num_observations", default=np.zeros(()), dist_reduce_fx="sum")
 
     def _batch_state(self, preds, targets):
         s, n = _tweedie_deviance_score_update(preds, targets, self.power)

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 from typing import Any
 
+import numpy as np
 import jax.numpy as jnp
 
 from ..functional.regression.mse import _mean_squared_error_update
@@ -38,13 +39,13 @@ class NormalizedRootMeanSquaredError(Metric):
             raise ValueError(f"Expected num_outputs to be a positive integer but got {num_outputs}")
         self.num_outputs = num_outputs
         d = num_outputs
-        self.add_state("sum_squared_error", default=jnp.zeros(d), dist_reduce_fx=None)
-        self.add_state("total", default=jnp.zeros(d), dist_reduce_fx=None)
-        self.add_state("min_val", default=jnp.full((d,), jnp.inf), dist_reduce_fx=None)
-        self.add_state("max_val", default=jnp.full((d,), -jnp.inf), dist_reduce_fx=None)
-        self.add_state("mean_val", default=jnp.zeros(d), dist_reduce_fx=None)
-        self.add_state("var_val", default=jnp.zeros(d), dist_reduce_fx=None)
-        self.add_state("target_squared", default=jnp.zeros(d), dist_reduce_fx=None)
+        self.add_state("sum_squared_error", default=np.zeros(d), dist_reduce_fx=None)
+        self.add_state("total", default=np.zeros(d), dist_reduce_fx=None)
+        self.add_state("min_val", default=np.full((d,), jnp.inf), dist_reduce_fx=None)
+        self.add_state("max_val", default=np.full((d,), -jnp.inf), dist_reduce_fx=None)
+        self.add_state("mean_val", default=np.zeros(d), dist_reduce_fx=None)
+        self.add_state("var_val", default=np.zeros(d), dist_reduce_fx=None)
+        self.add_state("target_squared", default=np.zeros(d), dist_reduce_fx=None)
 
     def _batch_state(self, preds, target):
         sum_squared_error, num_obs = _mean_squared_error_update(preds, target, self.num_outputs)

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 from typing import Any
 
+import numpy as np
 import jax.numpy as jnp
 
 from ..functional.regression.concordance import _concordance_corrcoef_compute
@@ -42,8 +43,8 @@ class _MomentCorrelationBase(Metric):
             raise ValueError("Expected argument `num_outputs` to be an int larger than 0, but got {num_outputs}")
         self.num_outputs = num_outputs
         for key in _MOMENT_KEYS[:-1]:
-            self.add_state(key, default=jnp.zeros(self.num_outputs), dist_reduce_fx=None)
-        self.add_state("n_total", default=jnp.zeros(self.num_outputs), dist_reduce_fx=None)
+            self.add_state(key, default=np.zeros(self.num_outputs), dist_reduce_fx=None)
+        self.add_state("n_total", default=np.zeros(self.num_outputs), dist_reduce_fx=None)
 
     def _batch_state(self, preds, target):
         _check_same_shape(preds, target)

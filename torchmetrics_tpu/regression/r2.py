@@ -5,6 +5,7 @@ from __future__ import annotations
 
 from typing import Any
 
+import numpy as np
 import jax.numpy as jnp
 
 from ..functional.regression.explained_variance import ALLOWED_MULTIOUTPUT, _explained_variance_compute, _explained_variance_update
@@ -30,10 +31,10 @@ class R2Score(Metric):
                 f"Invalid input to argument `multioutput`. Choose one of the following: {('raw_values', 'uniform_average', 'variance_weighted')}"
             )
         self.multioutput = multioutput
-        self.add_state("sum_squared_error", default=jnp.zeros(num_outputs), dist_reduce_fx="sum")
-        self.add_state("sum_error", default=jnp.zeros(num_outputs), dist_reduce_fx="sum")
-        self.add_state("residual", default=jnp.zeros(num_outputs), dist_reduce_fx="sum")
-        self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("sum_squared_error", default=np.zeros(num_outputs), dist_reduce_fx="sum")
+        self.add_state("sum_error", default=np.zeros(num_outputs), dist_reduce_fx="sum")
+        self.add_state("residual", default=np.zeros(num_outputs), dist_reduce_fx="sum")
+        self.add_state("total", default=np.zeros(()), dist_reduce_fx="sum")
 
     def _batch_state(self, preds, target):
         sum_squared_obs, sum_obs, rss, num_obs = _r2_score_update(preds, target)
@@ -61,10 +62,10 @@ class RelativeSquaredError(Metric):
         super().__init__(**kwargs)
         self.num_outputs = num_outputs
         self.squared = squared
-        self.add_state("sum_squared_obs", default=jnp.zeros(num_outputs), dist_reduce_fx="sum")
-        self.add_state("sum_obs", default=jnp.zeros(num_outputs), dist_reduce_fx="sum")
-        self.add_state("sum_squared_error", default=jnp.zeros(num_outputs), dist_reduce_fx="sum")
-        self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("sum_squared_obs", default=np.zeros(num_outputs), dist_reduce_fx="sum")
+        self.add_state("sum_obs", default=np.zeros(num_outputs), dist_reduce_fx="sum")
+        self.add_state("sum_squared_error", default=np.zeros(num_outputs), dist_reduce_fx="sum")
+        self.add_state("total", default=np.zeros(()), dist_reduce_fx="sum")
 
     def _batch_state(self, preds, target):
         sum_squared_obs, sum_obs, rss, num_obs = _r2_score_update(preds, target)
@@ -94,11 +95,11 @@ class ExplainedVariance(Metric):
         if multioutput not in ALLOWED_MULTIOUTPUT:
             raise ValueError(f"Invalid input to argument `multioutput`. Choose one of the following: {ALLOWED_MULTIOUTPUT}")
         self.multioutput = multioutput
-        self.add_state("sum_error", default=jnp.zeros(()), dist_reduce_fx="sum")
-        self.add_state("sum_squared_error", default=jnp.zeros(()), dist_reduce_fx="sum")
-        self.add_state("sum_target", default=jnp.zeros(()), dist_reduce_fx="sum")
-        self.add_state("sum_squared_target", default=jnp.zeros(()), dist_reduce_fx="sum")
-        self.add_state("num_obs", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("sum_error", default=np.zeros(()), dist_reduce_fx="sum")
+        self.add_state("sum_squared_error", default=np.zeros(()), dist_reduce_fx="sum")
+        self.add_state("sum_target", default=np.zeros(()), dist_reduce_fx="sum")
+        self.add_state("sum_squared_target", default=np.zeros(()), dist_reduce_fx="sum")
+        self.add_state("num_obs", default=np.zeros(()), dist_reduce_fx="sum")
 
     def _batch_state(self, preds, target):
         num_obs, sum_error, sum_squared_error, sum_target, sum_squared_target = _explained_variance_update(preds, target)

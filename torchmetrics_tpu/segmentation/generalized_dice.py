@@ -4,6 +4,7 @@ from __future__ import annotations
 
 from typing import Any
 
+import numpy as np
 import jax.numpy as jnp
 
 from ..functional.segmentation.generalized_dice import (
@@ -40,8 +41,8 @@ class GeneralizedDiceScore(Metric):
         self.weight_type = weight_type
         self.input_format = input_format
         num_out = num_classes - 1 if not include_background else num_classes
-        self.add_state("score", default=jnp.zeros(num_out if per_class else 1), dist_reduce_fx="sum")
-        self.add_state("samples", default=jnp.zeros(1), dist_reduce_fx="sum")
+        self.add_state("score", default=np.zeros(num_out if per_class else 1), dist_reduce_fx="sum")
+        self.add_state("samples", default=np.zeros(1), dist_reduce_fx="sum")
 
     def _batch_state(self, preds, target):
         numerator, denominator = _generalized_dice_update(

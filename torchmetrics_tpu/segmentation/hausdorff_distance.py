@@ -4,6 +4,7 @@ from __future__ import annotations
 
 from typing import Any, Optional, Sequence, Union
 
+import numpy as np
 import jax.numpy as jnp
 
 from ..functional.segmentation.hausdorff_distance import (
@@ -41,8 +42,8 @@ class HausdorffDistance(Metric):
         self.spacing = spacing
         self.directed = directed
         self.input_format = input_format
-        self.add_state("score", default=jnp.zeros(()), dist_reduce_fx="sum")
-        self.add_state("total", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("score", default=np.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", default=np.zeros(()), dist_reduce_fx="sum")
 
     def _batch_state(self, preds, target):
         score = hausdorff_distance(

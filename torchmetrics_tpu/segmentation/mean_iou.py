@@ -4,6 +4,7 @@ from __future__ import annotations
 
 from typing import Any, Optional
 
+import numpy as np
 import jax.numpy as jnp
 
 from ..functional.segmentation.mean_iou import (
@@ -45,8 +46,8 @@ class MeanIoU(Metric):
 
     def _init_states(self, num_classes: int) -> None:
         num_out = num_classes - 1 if not self.include_background else num_classes
-        self.add_state("score", default=jnp.zeros(num_out if self.per_class else 1), dist_reduce_fx="sum")
-        self.add_state("num_batches", default=jnp.zeros(num_out if self.per_class else 1), dist_reduce_fx="sum")
+        self.add_state("score", default=np.zeros(num_out if self.per_class else 1), dist_reduce_fx="sum")
+        self.add_state("num_batches", default=np.zeros(num_out if self.per_class else 1), dist_reduce_fx="sum")
         self._is_initialized = True
 
     def _prepare_inputs(self, preds, target):

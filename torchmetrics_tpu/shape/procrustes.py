@@ -4,6 +4,7 @@ from __future__ import annotations
 
 from typing import Any
 
+import numpy as np
 import jax.numpy as jnp
 
 from ..functional.shape.procrustes import procrustes_disparity
@@ -23,8 +24,8 @@ class ProcrustesDisparity(Metric):
         if reduction not in ("mean", "sum"):
             raise ValueError(f"Argument `reduction` must be one of ['mean', 'sum'], got {reduction}")
         self.reduction = reduction
-        self.add_state("disparity", default=jnp.zeros(()), dist_reduce_fx="sum")
-        self.add_state("total", default=jnp.zeros((), jnp.int32), dist_reduce_fx="sum")
+        self.add_state("disparity", default=np.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total", default=np.zeros((), jnp.int32), dist_reduce_fx="sum")
 
     def _batch_state(self, point_cloud1, point_cloud2):
         disparity = procrustes_disparity(point_cloud1, point_cloud2)

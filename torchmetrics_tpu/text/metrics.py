@@ -208,8 +208,8 @@ class EditDistance(HostMetric):
         if self.reduction in ("none", None):
             self.add_state("edit_scores_list", default=[], dist_reduce_fx="cat")
         else:
-            self.add_state("edit_scores", default=jnp.zeros((), jnp.int32), dist_reduce_fx="sum")
-            self.add_state("num_elements", default=jnp.zeros((), jnp.int32), dist_reduce_fx="sum")
+            self.add_state("edit_scores", default=np.zeros((), jnp.int32), dist_reduce_fx="sum")
+            self.add_state("num_elements", default=np.zeros((), jnp.int32), dist_reduce_fx="sum")
 
     def _host_batch_state(self, preds, target):
         distance = _edit_distance_update(preds, target, self.substitution_cost)
@@ -329,8 +329,8 @@ class Perplexity(Metric):
         if ignore_index is not None and not isinstance(ignore_index, int):
             raise ValueError(f"Argument `ignore_index` expected to either be `None` or an `int` but got {ignore_index}")
         self.ignore_index = ignore_index
-        self.add_state("total_log_probs", default=jnp.zeros(()), dist_reduce_fx="sum")
-        self.add_state("count", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("total_log_probs", default=np.zeros(()), dist_reduce_fx="sum")
+        self.add_state("count", default=np.zeros(()), dist_reduce_fx="sum")
 
     def _batch_state(self, preds, target):
         total_log_probs, count = _perplexity_update(preds, target, self.ignore_index)
