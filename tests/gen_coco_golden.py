@@ -1,0 +1,217 @@
+"""Generate ``tests/_data/coco_golden.json`` from the COCOeval-semantics oracle.
+
+Run as ``python tests/gen_coco_golden.py`` from the repo root. Fixtures are
+deliberately UNrestricted — unlike the round-2 parity fixtures they exercise
+crowd ground truths, all four area buckets (including explicit ``area`` fields
+that differ from the box area), score ties, duplicate-box IoU ties, dense
+overlaps (greedy-matcher exhaustion), custom ``max_detection_thresholds`` and
+segmentation masks. Golden numbers come from ``tests/_coco_oracle.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(__file__))
+from _coco_oracle import CocoOracle  # noqa: E402
+
+
+def _boxes(rng, n, lo=0, hi=400, wmin=4, wmax=200):
+    xy = rng.uniform(lo, hi, (n, 2))
+    wh = rng.uniform(wmin, wmax, (n, 2))
+    return np.concatenate([xy, xy + wh], -1).round(2)
+
+
+def dense_overlap(rng):
+    """Clustered boxes with duplicate boxes and tied scores: exhausts the greedy
+    matcher (the regression case for the batched-scatter miscompile)."""
+    preds, target = [], []
+    for _ in range(20):
+        centers = _boxes(rng, 4, 0, 200, 30, 120)
+        gt, dt, scores, glab, dlab = [], [], [], [], []
+        for c in centers:
+            k = int(rng.integers(2, 5))
+            for j in range(k):
+                jitter = rng.uniform(-8, 8, 4).round(2)
+                gt.append(c + jitter * (j > 0))
+                glab.append(int(rng.integers(0, 3)))
+            for j in range(int(rng.integers(2, 6))):
+                jitter = rng.uniform(-10, 10, 4).round(2)
+                dt.append(c + jitter)
+                # tied scores on purpose
+                scores.append(round(float(rng.choice([0.3, 0.5, 0.5, 0.9])), 2))
+                dlab.append(int(rng.integers(0, 3)))
+        # exact duplicate detection (IoU tie on the same gt)
+        dt.append(dt[0])
+        scores.append(scores[0])
+        dlab.append(dlab[0])
+        preds.append({"boxes": np.asarray(dt), "scores": np.asarray(scores), "labels": np.asarray(dlab)})
+        target.append({"boxes": np.asarray(gt), "labels": np.asarray(glab)})
+    return preds, target, {}
+
+
+def crowds_and_areas(rng):
+    """Crowd gts + all four area buckets + explicit area fields != box area."""
+    preds, target = [], []
+    for _ in range(30):
+        ng = int(rng.integers(3, 12))
+        sizes = rng.choice(["s", "m", "l"], ng)
+        gt = []
+        for s in sizes:
+            lo, hi = {"s": (4, 28), "m": (40, 90), "l": (100, 280)}[s]
+            gt.append(_boxes(rng, 1, 0, 400, lo, hi)[0])
+        gt = np.asarray(gt)
+        crowd = (rng.random(ng) < 0.25).astype(int)
+        # explicit area overrides box area for a third of the gts
+        area = np.where(
+            rng.random(ng) < 0.33,
+            rng.uniform(10, 10000, ng).round(1),
+            np.zeros(ng),
+        )
+        glab = rng.integers(0, 5, ng)
+        nd = int(rng.integers(2, 15))
+        use_gt = rng.random(nd) < 0.6
+        dt = np.where(
+            use_gt[:, None],
+            gt[rng.integers(0, ng, nd)] + rng.uniform(-6, 6, (nd, 4)).round(2),
+            _boxes(rng, nd),
+        )
+        preds.append({
+            "boxes": dt,
+            "scores": rng.random(nd).round(3),
+            "labels": rng.integers(0, 5, nd),
+        })
+        target.append({"boxes": gt, "labels": glab, "iscrowd": crowd, "area": area})
+    return preds, target, {}
+
+
+def custom_maxdets(rng):
+    """Many detections per image with maxDets [1, 5, 10]."""
+    preds, target = [], []
+    for _ in range(15):
+        ng = int(rng.integers(4, 10))
+        gt = _boxes(rng, ng, 0, 300, 20, 150)
+        nd = int(rng.integers(15, 30))
+        dt = gt[rng.integers(0, ng, nd)] + rng.uniform(-12, 12, (nd, 4)).round(2)
+        preds.append({
+            "boxes": dt,
+            "scores": rng.random(nd).round(3),
+            "labels": rng.integers(0, 2, nd),
+        })
+        target.append({"boxes": gt, "labels": rng.integers(0, 2, ng)})
+    return preds, target, {"max_detection_thresholds": [1, 5, 10]}
+
+
+def edge_cases(rng):
+    """Handcrafted: det matching only an ignored gt, empty preds/gts, crowd-only
+    images, det outside every area bucket it could score in."""
+    big = 150.0
+    preds = [
+        # det overlaps only a crowd gt -> matched-to-ignored, not a FP
+        {"boxes": np.array([[10, 10, 50, 50]]), "scores": np.array([0.9]), "labels": np.array([0])},
+        # empty prediction, non-empty gt
+        {"boxes": np.zeros((0, 4)), "scores": np.zeros(0), "labels": np.zeros(0, int)},
+        # non-empty prediction, empty gt
+        {"boxes": np.array([[0, 0, 20, 20], [5, 5, 25, 25]]), "scores": np.array([0.7, 0.7]),
+         "labels": np.array([0, 0])},
+        # two dets, one gt: higher score takes it, tie broken by order
+        {"boxes": np.array([[0, 0, big, big], [1, 1, big + 1, big + 1]]),
+         "scores": np.array([0.5, 0.5]), "labels": np.array([1, 1])},
+    ]
+    target = [
+        {"boxes": np.array([[12, 12, 48, 48]]), "labels": np.array([0]), "iscrowd": np.array([1])},
+        {"boxes": np.array([[30, 30, 60, 60]]), "labels": np.array([0])},
+        {"boxes": np.zeros((0, 4)), "labels": np.zeros(0, int)},
+        {"boxes": np.array([[0, 0, big, big]]), "labels": np.array([1])},
+    ]
+    return preds, target, {}
+
+
+def segm(rng):
+    """Random blob masks with crowds; IoU ties via duplicated masks."""
+    H = W = 32
+    preds, target = [], []
+    for _ in range(8):
+        ng = int(rng.integers(2, 5))
+        gmask = np.zeros((ng, H, W), bool)
+        for j in range(ng):
+            cx, cy = rng.integers(4, W - 4, 2)
+            r = int(rng.integers(3, 10))
+            yy, xx = np.mgrid[:H, :W]
+            gmask[j] = (xx - cx) ** 2 + (yy - cy) ** 2 <= r * r
+        nd = int(rng.integers(2, 6))
+        dmask = np.zeros((nd, H, W), bool)
+        for j in range(nd):
+            base = gmask[rng.integers(0, ng)]
+            noise = rng.random((H, W)) < 0.08
+            dmask[j] = base ^ noise
+        dmask[0] = gmask[0]  # exact-duplicate mask
+        preds.append({
+            "masks": dmask,
+            "scores": rng.random(nd).round(3),
+            "labels": rng.integers(0, 2, nd),
+        })
+        target.append({
+            "masks": gmask,
+            "labels": rng.integers(0, 2, ng),
+            "iscrowd": (rng.random(ng) < 0.2).astype(int),
+        })
+    return preds, target, {"iou_type": "segm"}
+
+
+FIXTURES = {
+    "dense_overlap": dense_overlap,
+    "crowds_and_areas": crowds_and_areas,
+    "custom_maxdets": custom_maxdets,
+    "edge_cases": edge_cases,
+    "segm": segm,
+}
+
+
+def _ser_sample(d):
+    out = {}
+    for k, v in d.items():
+        arr = np.asarray(v)
+        if k == "masks":
+            out[k] = np.packbits(arr.astype(np.uint8), axis=None).tolist() + [
+                -1, *arr.shape
+            ]  # packed bits + shape sentinel
+        elif arr.dtype.kind == "f":
+            out[k] = np.round(arr, 6).tolist()
+        else:
+            out[k] = arr.tolist()
+    return out
+
+
+def main() -> None:
+    rng = np.random.default_rng(20260730)
+    blob = {}
+    for name, gen in FIXTURES.items():
+        preds, target, opts = gen(rng)
+        iou_type = opts.pop("iou_type", "bbox")
+        oracle = CocoOracle(**opts)
+        stats = oracle.stats(preds, target, iou_type=iou_type, class_metrics=True)
+        blob[name] = {
+            "opts": opts,
+            "iou_type": iou_type,
+            "preds": [_ser_sample(p) for p in preds],
+            "target": [_ser_sample(t) for t in target],
+            "stats": {
+                k: (v if isinstance(v, list) else round(v, 12)) for k, v in stats.items()
+            },
+        }
+        print(name, "map=%.6f map_small=%.4f map_medium=%.4f map_large=%.4f" % (
+            stats["map"], stats["map_small"], stats["map_medium"], stats["map_large"]))
+    path = os.path.join(os.path.dirname(__file__), "_data", "coco_golden.json")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(blob, f)
+    print("wrote", path, f"({os.path.getsize(path)//1024} KiB)")
+
+
+if __name__ == "__main__":
+    main()

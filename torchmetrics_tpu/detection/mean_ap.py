@@ -169,21 +169,25 @@ class MeanAveragePrecision(HostMetric):
             if "segm" in self.iou_type:
                 gt_mask.append(np.asarray(item["masks"]).astype(bool))
 
+        # states stay host numpy: the evaluator is host-orchestrated (device work is
+        # the batched matcher) and device round-trips at update/concat time would
+        # dominate (and a single D2H readback flips tunneled TPU runtimes into
+        # synchronous dispatch). Sync converts to device arrays only when gathering.
         cat = lambda parts, dtype, width=None: (
-            jnp.asarray(np.concatenate(parts).astype(dtype))
+            np.concatenate(parts).astype(dtype)
             if parts
-            else jnp.zeros((0,) if width is None else (0, width), dtype)
+            else np.zeros((0,) if width is None else (0, width), dtype)
         )
         out = {
             "detection_box": cat(det_box, np.float32, 4),
             "detection_scores": cat(det_score, np.float32),
             "detection_labels": cat(det_label, np.int32),
-            "detection_counts": jnp.asarray(np.asarray(det_count, np.int32)),
+            "detection_counts": np.asarray(det_count, np.int32),
             "groundtruth_box": cat(gt_box, np.float32, 4),
             "groundtruth_labels": cat(gt_label, np.int32),
             "groundtruth_crowds": cat(gt_crowd, np.int32),
             "groundtruth_area": cat(gt_area, np.float32),
-            "groundtruth_counts": jnp.asarray(np.asarray(gt_count, np.int32)),
+            "groundtruth_counts": np.asarray(gt_count, np.int32),
         }
         if "segm" in self.iou_type:
             out["detection_mask"] = det_mask
@@ -209,11 +213,11 @@ class MeanAveragePrecision(HostMetric):
             elif isinstance(v, list):
                 if len(v) == 0:
                     width = 4 if k.endswith("_box") else None
-                    out[k] = jnp.zeros((0,) if width is None else (0, width), jnp.float32)
+                    out[k] = np.zeros((0,) if width is None else (0, width), np.float32)
                 else:
-                    from ..utilities.data import dim_zero_cat
-
-                    out[k] = dim_zero_cat(v)
+                    # host concat: entries are numpy from update; post-sync device
+                    # entries are pulled once here (compute is host-side anyway)
+                    out[k] = np.concatenate([np.asarray(e) for e in v], axis=0)
             else:
                 out[k] = v
         return out

@@ -4,20 +4,30 @@ Re-implements the COCOeval algorithm (the reference delegates to the pycocotools
 extension through ``detection/helpers.py:152`` and keeps a pure-torch template at
 ``detection/_mean_ap.py:149``) as a TPU-first pipeline:
 
-1. per-image IoU matrices (bbox: ``_box_ops`` pairwise kernels; segm: one
-   pixel-flattened matmul per image — MXU work),
-2. a **batched greedy matcher**: ``lax.scan`` over score-sorted detections, vmapped
-   over IoU thresholds x area ranges x images — the reference's four nested Python
-   loops (``_mean_ap.py:598-605``) collapse into one XLA call per class,
-3. numpy accumulation: global stable score sort, cumsum TP/FP, precision envelope
+1. **vectorized row building**: every (class, image) cell becomes one row of a padded
+   ``(rows, dmax)`` / ``(rows, gmax)`` batch via a single lexsort + group-boundary
+   pass over the flat cat-state — no per-cell Python loop,
+2. pairwise IoU for the whole row batch in one broadcast (host f64 for bbox, matching
+   pycocotools' f64 IoU; pixel-matmul per cell for segm),
+3. a **batched greedy matcher**: one ``lax.scan`` over score-sorted detection slots
+   whose body is plain broadcasting over ``rows x areas x thresholds x gts`` — the
+   reference's four nested Python loops (``_mean_ap.py:598-605``) collapse into one
+   XLA program,
+4. numpy accumulation: global stable score sort, cumsum TP/FP, precision envelope
    (reversed running max), 101-point interpolation via ``searchsorted`` — identical
    semantics to COCOeval.accumulate, including the crowd/ignore and tie-breaking
    rules (last ground-truth wins equal IoU; ignored gts only matchable when no
-   non-ignored gt clears the threshold).
+   non-ignored gt clears the threshold). Tested cell-for-cell against the COCOeval
+   matching loop in ``tests/_coco_oracle.py``.
 
-Matching runs in float32 (TPU-native); pycocotools uses float64, so IoU values that
-tie *exactly* at a threshold boundary in f64 may resolve differently — empirically
-immaterial on real boxes.
+The matcher body deliberately avoids ``.at[].set`` scatters inside the scan: the
+scatter formulation miscompiles under XLA for row batches >= 64 (batch-size-dependent
+wrong matches, observed identically on CPU and TPU backends with jax 0.9) — the
+one-hot | or formulation is both correct at every batch size and ~600x faster.
+
+IoU matrices are computed in float64 on host and downcast to float32 for the device
+matcher; IoU values that tie *exactly* at a threshold boundary in f64 may resolve
+differently than pycocotools — empirically immaterial on real boxes.
 """
 
 from __future__ import annotations
@@ -35,24 +45,23 @@ _AREA_RANGES = np.array(
     [[0.0, 1e5**2], [0.0, 32.0**2], [32.0**2, 96.0**2], [96.0**2, 1e5**2]], np.float32
 )
 _AREA_KEYS = ("all", "small", "medium", "large")
-_ROW_BLOCK = 4096  # matcher rows per XLA call (memory/compile trade-off)
+_ROW_BLOCK = 8192  # matcher rows per XLA call (memory/compile trade-off)
 
 
-def mask_iou_matrix(dets: jnp.ndarray, gts: jnp.ndarray, crowd: jnp.ndarray) -> jnp.ndarray:
-    """Pairwise mask IoU ``(D,H,W) x (G,H,W) -> (D,G)`` with COCO crowd semantics
-    (crowd gt: denominator is the detection area). Pixel intersection is one matmul."""
-    d = dets.reshape(dets.shape[0], -1).astype(jnp.float32)
-    g = gts.reshape(gts.shape[0], -1).astype(jnp.float32)
+def _mask_iou_np(dets: np.ndarray, gts: np.ndarray, crowd: np.ndarray) -> np.ndarray:
+    """Host pairwise mask IoU for one cell — per-cell device dispatch would dominate
+    at COCO scale, and host BLAS handles the small pixel matmuls fine."""
+    d = dets.reshape(dets.shape[0], -1).astype(np.float32)
+    g = gts.reshape(gts.shape[0], -1).astype(np.float32)
     inter = d @ g.T
     d_area = d.sum(-1)[:, None]
     union = d_area + g.sum(-1)[None, :] - inter
-    denom = jnp.where(crowd[None, :], d_area, union)
-    return jnp.where(denom > 0, inter / jnp.where(denom > 0, denom, 1.0), 0.0)
+    denom = np.where(crowd[None, :], d_area, union)
+    return np.where(denom > 0, inter / np.where(denom > 0, denom, 1.0), 0.0)
 
 
 def _box_iou_np(det: np.ndarray, gt: np.ndarray, crowd: np.ndarray) -> np.ndarray:
-    """Host pairwise crowd-IoU for one (class, image) cell — small matrices, where a
-    per-cell device dispatch would dominate at COCO scale."""
+    """Host pairwise crowd-IoU for one (class, image) cell (f64, pycocotools dtype)."""
     det = det.astype(np.float64)
     gt = gt.astype(np.float64)
     lt = np.maximum(det[:, None, :2], gt[None, :, :2])
@@ -76,53 +85,55 @@ def _bucket(n: int, floor: int = 4) -> int:
 
 @jax.jit
 def _match_kernel(
-    iou: jnp.ndarray,  # (I, D, G) crowd-adjusted IoU
-    det_valid: jnp.ndarray,  # (I, D) bool, score-sorted per image
-    det_area: jnp.ndarray,  # (I, D)
-    gt_valid: jnp.ndarray,  # (I, G) bool
-    gt_area: jnp.ndarray,  # (I, G)
-    gt_crowd: jnp.ndarray,  # (I, G) bool
+    iou: jnp.ndarray,  # (R, D, G) crowd-adjusted IoU, dets score-sorted per row
+    det_valid: jnp.ndarray,  # (R, D) bool
+    det_area: jnp.ndarray,  # (R, D)
+    gt_valid: jnp.ndarray,  # (R, G) bool
+    gt_area: jnp.ndarray,  # (R, G)
+    gt_crowd: jnp.ndarray,  # (R, G) bool
     iou_thrs: jnp.ndarray,  # (T,)
     area_ranges: jnp.ndarray,  # (A, 2)
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """Greedy COCO matching, batched over images x area ranges x IoU thresholds.
+    """Greedy COCO matching over rows x area ranges x IoU thresholds in one scan.
 
-    Returns ``det_match (I,A,T,D)``, ``det_ignore (I,A,T,D)``, ``gt_ignore (I,A,G)``.
+    Returns ``det_match (R,A,T,D)``, ``det_ignore (R,A,T,D)``, ``gt_ignore (R,A,G)``.
     """
+    gt_ign = (
+        (gt_area[:, None, :] < area_ranges[None, :, :1])
+        | (gt_area[:, None, :] > area_ranges[None, :, 1:])
+        | gt_crowd[:, None, :]
+        | ~gt_valid[:, None, :]
+    )  # (R, A, G)
+    det_out = (det_area[:, None, :] < area_ranges[None, :, :1]) | (
+        det_area[:, None, :] > area_ranges[None, :, 1:]
+    )  # (R, A, D)
+    thr_eff = jnp.minimum(iou_thrs, 1.0 - 1e-10)  # (T,)
     num_gt = iou.shape[-1]
 
-    def per_image(iou_i, dval, darea, gval, garea, gcrowd):
-        gt_ign_a = (
-            (garea[None, :] < area_ranges[:, :1])
-            | (garea[None, :] > area_ranges[:, 1:])
-            | gcrowd[None, :]
-            | ~gval[None, :]
-        )  # (A, G)
-        det_out_a = (darea[None, :] < area_ranges[:, :1]) | (darea[None, :] > area_ranges[:, 1:])  # (A, D)
+    def step(gt_matched, d):  # gt_matched: (R, A, T, G)
+        row = iou[:, d, :][:, None, None, :]  # (R,1,1,G)
+        cand = (
+            gt_valid[:, None, None, :]
+            & (~gt_matched | gt_crowd[:, None, None, :])
+            & (row >= thr_eff[None, None, :, None])
+            & det_valid[:, d][:, None, None, None]
+        )
+        cand_nonign = cand & ~gt_ign[:, :, None, :]
+        pool = jnp.where(cand_nonign.any(-1, keepdims=True), cand_nonign, cand)
+        vals = jnp.where(pool, row, -jnp.inf)
+        m = num_gt - 1 - jnp.argmax(vals[..., ::-1], axis=-1)  # last argmax: later gt wins ties
+        matched = pool.any(-1)  # (R,A,T)
+        oh = jax.nn.one_hot(m, num_gt, dtype=bool) & matched[..., None]
+        gt_matched = gt_matched | oh
+        ign_of_m = (oh & gt_ign[:, :, None, :]).any(-1)  # cheap-to-compile gather of gt_ign[m]
+        return gt_matched, (matched, ign_of_m)
 
-        def per_at(gt_ign, thr):
-            thr_eff = jnp.minimum(thr, 1.0 - 1e-10)
-
-            def step(gt_matched, d):
-                row = iou_i[d]
-                cand = gval & (~gt_matched | gcrowd) & (row >= thr_eff) & dval[d]
-                cand_nonign = cand & ~gt_ign
-                pool = jnp.where(cand_nonign.any(), cand_nonign, cand)
-                vals = jnp.where(pool, row, -jnp.inf)
-                m = num_gt - 1 - jnp.argmax(vals[::-1])  # last argmax: later gt wins ties
-                matched = pool.any()
-                gt_matched = jnp.where(matched, gt_matched.at[m].set(True), gt_matched)
-                return gt_matched, (matched, jnp.where(matched, gt_ign[m], False))
-
-            _, (dm, dig) = lax.scan(step, jnp.zeros(num_gt, bool), jnp.arange(iou_i.shape[0]))
-            return dm, dig
-
-        dm, dig = jax.vmap(lambda gi: jax.vmap(lambda t: per_at(gi, t))(iou_thrs))(gt_ign_a)
-        # (A, T, D, ...) -> unmatched dets outside the area range are ignored
-        dig = dig | (~dm & det_out_a[:, None, :])
-        return dm, dig, gt_ign_a
-
-    return jax.vmap(per_image)(iou, det_valid, det_area, gt_valid, gt_area, gt_crowd)
+    init = jnp.zeros((iou.shape[0], area_ranges.shape[0], iou_thrs.shape[0], num_gt), bool)
+    _, (dm, dig) = lax.scan(step, init, jnp.arange(iou.shape[1]))
+    dm = jnp.moveaxis(dm, 0, -1)  # (R, A, T, D)
+    dig = jnp.moveaxis(dig, 0, -1)
+    dig = dig | (~dm & det_out[:, :, None, :])  # unmatched dets outside the range: ignored
+    return dm, dig, gt_ign
 
 
 class MAPInputs:
@@ -177,6 +188,161 @@ def _gt_area(inputs: MAPInputs, img: int, iou_type: str) -> np.ndarray:
     return np.where(provided > 0, provided, computed)
 
 
+class _RowBatch:
+    """Padded (class, image)-cell row arrays built in one vectorized pass."""
+
+    __slots__ = (
+        "num_rows", "dmax", "gmax", "classes", "class_slices", "row_img", "row_cls",
+        "det_valid", "det_score", "det_area", "det_box", "det_src",
+        "gt_valid", "gt_area", "gt_crowd", "gt_box", "gt_src",
+    )
+
+
+def _build_rows(
+    inputs: MAPInputs, iou_type: str, max_det: int,
+    det_areas_all: List[np.ndarray], gt_areas_all: List[np.ndarray],
+) -> Optional[_RowBatch]:
+    """Flatten every (class, image) cell into padded rows via one lexsort pass.
+
+    Row order is class-major, image-minor, so each class owns a contiguous row
+    slice; dets inside a row are score-sorted (stable) and truncated to
+    ``max_det`` — exactly COCOeval's per-cell ordering.
+    """
+    classes = np.asarray(inputs.classes(), np.int64)
+    if classes.size == 0:
+        return None
+    num_images = inputs.num_images
+    d_sizes = np.array([x.size for x in inputs.det_labels], np.int64)
+    g_sizes = np.array([x.size for x in inputs.gt_labels], np.int64)
+
+    img_d = np.repeat(np.arange(num_images), d_sizes)
+    lab_d = np.searchsorted(classes, np.concatenate(inputs.det_labels) if img_d.size else np.zeros(0, np.int64))
+    score_d = np.concatenate(inputs.det_scores) if img_d.size else np.zeros(0)
+    img_g = np.repeat(np.arange(num_images), g_sizes)
+    lab_g = np.searchsorted(classes, np.concatenate(inputs.gt_labels) if img_g.size else np.zeros(0, np.int64))
+
+    order_d = np.lexsort((-score_d, img_d, lab_d))
+    key_d = lab_d[order_d] * num_images + img_d[order_d]
+    uq_d, start_d = np.unique(key_d, return_index=True)
+    cnt_d = np.diff(np.append(start_d, key_d.size))
+    order_g = np.lexsort((img_g, lab_g))
+    key_g = lab_g[order_g] * num_images + img_g[order_g]
+    uq_g, start_g = np.unique(key_g, return_index=True)
+    cnt_g = np.diff(np.append(start_g, key_g.size))
+
+    all_keys = np.union1d(uq_d, uq_g)  # sorted: class-major, image-minor
+    rb = _RowBatch()
+    rb.num_rows = all_keys.size
+    rb.classes = classes
+    rb.row_img = (all_keys % num_images).astype(np.int64)
+    rb.row_cls = (all_keys // num_images).astype(np.int64)
+    lo = np.searchsorted(rb.row_cls, np.arange(classes.size), side="left")
+    hi = np.searchsorted(rb.row_cls, np.arange(classes.size), side="right")
+    rb.class_slices = [slice(int(a), int(b)) for a, b in zip(lo, hi)]
+
+    # ---- dets: scatter into (rows, dmax) padding, truncating at max_det
+    row_idx_d = np.repeat(np.searchsorted(all_keys, uq_d), cnt_d)
+    pos_d = np.arange(key_d.size) - np.repeat(start_d, cnt_d)
+    keep = pos_d < max_det
+    row_idx_d, pos_d, src_d = row_idx_d[keep], pos_d[keep], order_d[keep]
+    rb.dmax = _bucket(int(pos_d.max()) + 1 if pos_d.size else 1)
+    rb.det_valid = np.zeros((rb.num_rows, rb.dmax), bool)
+    rb.det_valid[row_idx_d, pos_d] = True
+    rb.det_score = np.full((rb.num_rows, rb.dmax), -np.inf, np.float32)
+    rb.det_score[row_idx_d, pos_d] = score_d[src_d]
+    flat_det_area = np.concatenate(det_areas_all) if img_d.size else np.zeros(0)
+    rb.det_area = np.zeros((rb.num_rows, rb.dmax), np.float32)
+    rb.det_area[row_idx_d, pos_d] = flat_det_area[src_d]
+    if iou_type == "bbox":
+        flat_det_box = (
+            np.concatenate(inputs.det_boxes).astype(np.float64).reshape(-1, 4)
+            if img_d.size else np.zeros((0, 4))
+        )
+        rb.det_box = np.zeros((rb.num_rows, rb.dmax, 4), np.float64)
+        rb.det_box[row_idx_d, pos_d] = flat_det_box[src_d]
+    else:
+        rb.det_box = None
+    # per-row flat det source indices (pos-ordered) for segm / extended summary
+    bounds_d = np.searchsorted(row_idx_d, np.arange(rb.num_rows + 1))
+    rb.det_src = (src_d, bounds_d)
+
+    # ---- gts
+    row_idx_g = np.repeat(np.searchsorted(all_keys, uq_g), cnt_g)
+    pos_g = np.arange(key_g.size) - np.repeat(start_g, cnt_g)
+    src_g = order_g
+    rb.gmax = _bucket(int(cnt_g.max()) if cnt_g.size else 1)
+    rb.gt_valid = np.zeros((rb.num_rows, rb.gmax), bool)
+    rb.gt_valid[row_idx_g, pos_g] = True
+    flat_gt_area = np.concatenate(gt_areas_all) if img_g.size else np.zeros(0)
+    rb.gt_area = np.zeros((rb.num_rows, rb.gmax), np.float32)
+    rb.gt_area[row_idx_g, pos_g] = flat_gt_area[src_g]
+    flat_gt_crowd = (
+        np.concatenate(inputs.gt_crowds).astype(bool) if img_g.size else np.zeros(0, bool)
+    )
+    rb.gt_crowd = np.zeros((rb.num_rows, rb.gmax), bool)
+    rb.gt_crowd[row_idx_g, pos_g] = flat_gt_crowd[src_g]
+    if iou_type == "bbox":
+        flat_gt_box = (
+            np.concatenate(inputs.gt_boxes).astype(np.float64).reshape(-1, 4)
+            if img_g.size else np.zeros((0, 4))
+        )
+        rb.gt_box = np.zeros((rb.num_rows, rb.gmax, 4), np.float64)
+        rb.gt_box[row_idx_g, pos_g] = flat_gt_box[src_g]
+    else:
+        rb.gt_box = None
+    bounds_g = np.searchsorted(row_idx_g, np.arange(rb.num_rows + 1))
+    rb.gt_src = (src_g, bounds_g)
+    return rb
+
+
+def _block_iou_bbox(rb: _RowBatch, sl: slice) -> np.ndarray:
+    """Pairwise crowd-adjusted IoU for a row block, f64 math (pycocotools dtype)
+    broadcast in bounded sub-chunks: at COCO scale (dmax=gmax=128) a whole-block
+    broadcast would stage multi-GB f64 temporaries, mostly padding."""
+    n = sl.stop - sl.start
+    out = np.empty((n, rb.dmax, rb.gmax), np.float32)
+    step = max(1, int(128 * 1024 * 1024 // max(1, rb.dmax * rb.gmax * 8 * 4)))
+    for s in range(0, n, step):
+        dbox = rb.det_box[sl.start + s : sl.start + min(s + step, n)]  # (C, dmax, 4)
+        gbox = rb.gt_box[sl.start + s : sl.start + min(s + step, n)]  # (C, gmax, 4)
+        lt = np.maximum(dbox[:, :, None, :2], gbox[:, None, :, :2])
+        rbn = np.minimum(dbox[:, :, None, 2:], gbox[:, None, :, 2:])
+        wh = np.clip(rbn - lt, 0, None)
+        inter = wh[..., 0] * wh[..., 1]
+        d_area = (dbox[..., 2] - dbox[..., 0]) * (dbox[..., 3] - dbox[..., 1])
+        g_area = (gbox[..., 2] - gbox[..., 0]) * (gbox[..., 3] - gbox[..., 1])
+        union = d_area[:, :, None] + g_area[:, None, :] - inter
+        crowd = rb.gt_crowd[sl.start + s : sl.start + min(s + step, n)]
+        denom = np.where(crowd[:, None, :], d_area[:, :, None], union)
+        out[s : s + dbox.shape[0]] = np.where(denom > 0, inter / np.where(denom > 0, denom, 1.0), 0.0)
+    return out
+
+
+def _block_iou_segm(rb: _RowBatch, sl: slice, inputs: MAPInputs) -> np.ndarray:
+    """Segm IoU per cell (pixel matmul on host); cells are ragged in H,W so the
+    block can't be one broadcast like bbox."""
+    src_d, bounds_d = rb.det_src
+    src_g, bounds_g = rb.gt_src
+    d_sizes = np.array([x.size for x in inputs.det_labels], np.int64)
+    g_sizes = np.array([x.size for x in inputs.gt_labels], np.int64)
+    d_off = np.concatenate([[0], np.cumsum(d_sizes)])
+    g_off = np.concatenate([[0], np.cumsum(g_sizes)])
+    iou = np.zeros((sl.stop - sl.start, rb.dmax, rb.gmax), np.float32)
+    for off, r in enumerate(range(sl.start, sl.stop)):
+        ds = src_d[bounds_d[r] : bounds_d[r + 1]]
+        gs = src_g[bounds_g[r] : bounds_g[r + 1]]
+        if ds.size == 0 or gs.size == 0:
+            continue
+        img = rb.row_img[r]
+        d_local = ds - d_off[img]
+        g_local = gs - g_off[img]
+        crowd = inputs.gt_crowds[img][g_local].astype(bool)
+        iou[off, : ds.size, : gs.size] = _mask_iou_np(
+            inputs.det_masks[img][d_local], inputs.gt_masks[img][g_local], crowd
+        )
+    return iou
+
+
 def evaluate_map(
     inputs: MAPInputs,
     iou_type: str,
@@ -191,121 +357,86 @@ def evaluate_map(
     precision; ``classes``: (K,). Entries stay -1 where a (class, area) has no
     non-ignored ground truth (COCOeval convention).
     """
-    classes = inputs.classes()
     num_t, num_r = len(iou_thresholds), len(rec_thresholds)
-    num_k, num_a, num_m = len(classes), len(_AREA_RANGES), len(max_detection_thresholds)
+    classes_list = inputs.classes()
+    num_k, num_a, num_m = len(classes_list), len(_AREA_RANGES), len(max_detection_thresholds)
     precision = -np.ones((num_t, num_r, num_k, num_a, num_m))
     recall = -np.ones((num_t, num_k, num_a, num_m))
     scores_out = -np.ones((num_t, num_r, num_k, num_a, num_m))
     max_det = max_detection_thresholds[-1]
-    iou_thrs_j = jnp.asarray(np.asarray(iou_thresholds, np.float32))
-    area_ranges_j = jnp.asarray(_AREA_RANGES)
     rec_thrs = np.asarray(rec_thresholds, np.float64)
     ious_out: Dict = {}
+
     det_areas_all = [_det_area(inputs, i, iou_type) for i in range(inputs.num_images)]
     gt_areas_all = [_gt_area(inputs, i, iou_type) for i in range(inputs.num_images)]
-
-    # ---- flatten every (class, image) evaluation into ONE matcher batch: matching is
-    # independent per pair, so classes ride the same vmapped leading axis — one XLA
-    # compile per padded bucket instead of one per class
-    rows: List[Tuple[int, int, np.ndarray, np.ndarray]] = []  # (k_idx, img, d_sel, g_sel)
-    class_rows: List[List[int]] = [[] for _ in classes]
-    for k_idx, cls in enumerate(classes):
-        for i in range(inputs.num_images):
-            d_sel = np.where(inputs.det_labels[i] == cls)[0]
-            g_sel = np.where(inputs.gt_labels[i] == cls)[0]
-            if d_sel.size == 0 and g_sel.size == 0:
-                continue
-            order = np.argsort(-inputs.det_scores[i][d_sel], kind="mergesort")[:max_det]
-            class_rows[k_idx].append(len(rows))
-            rows.append((k_idx, i, d_sel[order], g_sel))
-    if not rows:
+    rb = _build_rows(inputs, iou_type, max_det, det_areas_all, gt_areas_all)
+    if rb is None:
         return {
             "precision": precision, "recall": recall, "scores": scores_out,
-            "classes": np.asarray(classes, np.int32), **({"ious": ious_out} if want_ious else {}),
+            "classes": np.asarray(classes_list, np.int32),
+            **({"ious": ious_out} if want_ious else {}),
         }
 
-    num_rows = len(rows)
-    dmax = _bucket(max((r[2].size for r in rows), default=1) or 1)
-    gmax = _bucket(max((r[3].size for r in rows), default=1) or 1)
-
-    # process the row batch in fixed-size blocks: one compile per (block, dmax, gmax)
-    # bucket while bounding peak memory (a COCO-scale eval would otherwise stage a
-    # multi-GB (rows, dmax, gmax) IoU tensor at once)
-    dm_all = np.zeros((num_rows, len(_AREA_RANGES), num_t, dmax), bool)
+    num_rows = rb.num_rows
+    dm_all = np.zeros((num_rows, num_a, num_t, rb.dmax), bool)
     dig_all = np.zeros_like(dm_all)
-    gt_ign_all = np.zeros((num_rows, len(_AREA_RANGES), gmax), bool)
-    det_valid = np.zeros((num_rows, dmax), bool)
-    det_score_b = np.full((num_rows, dmax), -np.inf, np.float32)
-    gt_valid_b = np.zeros((num_rows, gmax), bool)
+    gt_ign_all = np.zeros((num_rows, num_a, rb.gmax), bool)
 
-    for block_start in range(0, num_rows, _ROW_BLOCK):
-        block = rows[block_start : block_start + _ROW_BLOCK]
-        rb = _ROW_BLOCK if num_rows > _ROW_BLOCK else _bucket(len(block))
-        iou_b = np.zeros((rb, dmax, gmax), np.float32)
-        bdet_valid = np.zeros((rb, dmax), bool)
-        bdet_area = np.zeros((rb, dmax), np.float32)
-        bgt_valid = np.zeros((rb, gmax), bool)
-        bgt_area = np.zeros((rb, gmax), np.float32)
-        bgt_crowd = np.zeros((rb, gmax), bool)
+    # The matcher is an XLA program, but COCO cells are tiny (dmax/gmax <= 128):
+    # accelerator round-trips (H2D + D2H per block) dominate any device win, so run
+    # it on the local CPU backend by default — same compiled code, no transfers.
+    # (The mesh-sharded detection path, detection/sharded.py, keeps matching on
+    # device where the state already lives.)
+    matcher_device = jax.local_devices(backend="cpu")[0]
+    with jax.default_device(matcher_device):
+        iou_thrs_j = jnp.asarray(np.asarray(iou_thresholds, np.float32))
+        area_ranges_j = jnp.asarray(_AREA_RANGES)
+        for block_start in range(0, num_rows, _ROW_BLOCK):
+            sl = slice(block_start, min(block_start + _ROW_BLOCK, num_rows))
+            n = sl.stop - sl.start
+            pad = _ROW_BLOCK if num_rows > _ROW_BLOCK else _bucket(n)
+            iou_b = _block_iou_bbox(rb, sl) if iou_type == "bbox" else _block_iou_segm(rb, sl, inputs)
+            if pad > n:
+                iou_b = np.concatenate([iou_b, np.zeros((pad - n, rb.dmax, rb.gmax), np.float32)])
+            pad_rows = lambda a, fill=False: (
+                a[sl] if pad == n else np.concatenate([a[sl], np.full((pad - n, *a.shape[1:]), fill, a.dtype)])
+            )
+            dm_b, dig_b, gt_ign_b = _match_kernel(
+                jnp.asarray(iou_b),
+                jnp.asarray(pad_rows(rb.det_valid)),
+                jnp.asarray(pad_rows(rb.det_area)),
+                jnp.asarray(pad_rows(rb.gt_valid)),
+                jnp.asarray(pad_rows(rb.gt_area)),
+                jnp.asarray(pad_rows(rb.gt_crowd)),
+                iou_thrs_j,
+                area_ranges_j,
+            )
+            dm_all[sl] = np.asarray(dm_b)[:n]
+            dig_all[sl] = np.asarray(dig_b)[:n]
+            gt_ign_all[sl] = np.asarray(gt_ign_b)[:n]
+            if want_ious:
+                src_d, bounds_d = rb.det_src
+                src_g, bounds_g = rb.gt_src
+                for r in range(sl.start, sl.stop):
+                    nd = bounds_d[r + 1] - bounds_d[r]
+                    ng = bounds_g[r + 1] - bounds_g[r]
+                    ious_out[(int(rb.row_img[r]), int(rb.classes[rb.row_cls[r]]))] = iou_b[
+                        r - sl.start, :nd, :ng
+                    ]
 
-        for off, (k_idx, i, d_sel, g_sel) in enumerate(block):
-            nd, ng = d_sel.size, g_sel.size
-            row = block_start + off
-            bdet_valid[off, :nd] = True
-            det_valid[row, :nd] = True
-            det_score_b[row, :nd] = inputs.det_scores[i][d_sel]
-            bdet_area[off, :nd] = det_areas_all[i][d_sel]
-            bgt_valid[off, :ng] = True
-            gt_valid_b[row, :ng] = True
-            bgt_area[off, :ng] = gt_areas_all[i][g_sel]
-            bgt_crowd[off, :ng] = inputs.gt_crowds[i][g_sel].astype(bool)
-            if nd and ng:
-                if iou_type == "segm":
-                    mat = np.asarray(
-                        mask_iou_matrix(
-                            jnp.asarray(inputs.det_masks[i][d_sel]),
-                            jnp.asarray(inputs.gt_masks[i][g_sel]),
-                            jnp.asarray(inputs.gt_crowds[i][g_sel].astype(bool)),
-                        )
-                    )
-                else:
-                    mat = _box_iou_np(inputs.det_boxes[i][d_sel], inputs.gt_boxes[i][g_sel],
-                                      inputs.gt_crowds[i][g_sel].astype(bool))
-                iou_b[off, :nd, :ng] = mat
-                if want_ious:
-                    ious_out[(i, int(classes[k_idx]))] = mat
-            elif want_ious:
-                ious_out[(i, int(classes[k_idx]))] = np.zeros((nd, ng), np.float32)
-
-        dm_b, dig_b, gt_ign_b = _match_kernel(
-            jnp.asarray(iou_b),
-            jnp.asarray(bdet_valid),
-            jnp.asarray(bdet_area),
-            jnp.asarray(bgt_valid),
-            jnp.asarray(bgt_area),
-            jnp.asarray(bgt_crowd),
-            iou_thrs_j,
-            area_ranges_j,
-        )
-        n = len(block)
-        dm_all[block_start : block_start + n] = np.asarray(dm_b)[:n]
-        dig_all[block_start : block_start + n] = np.asarray(dig_b)[:n]
-        gt_ign_all[block_start : block_start + n] = np.asarray(gt_ign_b)[:n]
-
-    for k_idx, cls in enumerate(classes):
-        sel_rows = class_rows[k_idx]
-        if not sel_rows:
+    # ---- accumulate (COCOeval.accumulate semantics), per class over its row slice
+    pos_in_cell = np.arange(rb.dmax)[None, :]
+    for k_idx in range(num_k):
+        sl = rb.class_slices[k_idx]
+        if sl.start == sl.stop:
             continue
-        dm = dm_all[sel_rows]
-        dig = dig_all[sel_rows]
-        gt_ign = gt_ign_all[sel_rows]
-        det_valid_c = det_valid[sel_rows]
-        det_score = det_score_b[sel_rows]
-        gt_valid_n = gt_valid_b[sel_rows]
+        dm = dm_all[sl]
+        dig = dig_all[sl]
+        gt_ign = gt_ign_all[sl]
+        det_valid_c = rb.det_valid[sl]
+        det_score = rb.det_score[sl]
+        gt_valid_n = rb.gt_valid[sl]
 
-        # ---- accumulate (COCOeval.accumulate semantics)
-        pos_in_img = np.broadcast_to(np.arange(dmax)[None, :], det_score.shape)
         for a_idx in range(num_a):
             npig = int((~gt_ign[:, a_idx, :] & gt_valid_n).sum())
             if npig == 0:
@@ -313,7 +444,7 @@ def evaluate_map(
             dm_a = np.ascontiguousarray(dm[:, a_idx, :, :].transpose(1, 0, 2).reshape(num_t, -1))
             dig_a = np.ascontiguousarray(dig[:, a_idx, :, :].transpose(1, 0, 2).reshape(num_t, -1))
             for m_idx, mdet in enumerate(max_detection_thresholds):
-                sel = det_valid_c & (pos_in_img < mdet)  # (I, D)
+                sel = det_valid_c & (pos_in_cell < mdet)  # (rows_c, dmax)
                 flat_scores = np.where(sel, det_score, -np.inf).reshape(-1)
                 order = np.argsort(-flat_scores, kind="mergesort")
                 nd = int(sel.sum())
@@ -345,7 +476,7 @@ def evaluate_map(
         "precision": precision,
         "recall": recall,
         "scores": scores_out,
-        "classes": np.asarray(classes, np.int32),
+        "classes": np.asarray(classes_list, np.int32),
     }
     if want_ious:
         out["ious"] = ious_out

@@ -1,6 +1,7 @@
 """Text tower — stateless kernels (reference ``src/torchmetrics/functional/text/``)."""
 
 from .bert import bert_score
+from .infolm import infolm
 from .asr import (
     char_error_rate,
     match_error_rate,
@@ -20,6 +21,7 @@ from .ter import translation_edit_rate
 
 __all__ = [
     "bert_score",
+    "infolm",
     "bleu_score",
     "char_error_rate",
     "chrf_score",

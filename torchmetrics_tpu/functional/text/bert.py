@@ -5,6 +5,15 @@ The contextual embedder is pluggable: ``model_name_or_path`` loads a HF model fr
 ``user_forward_fn``) supply a custom pipeline — the same seam the reference exposes.
 The matching math (normalized embeddings, special-token masking, IDF weighting, greedy
 cosine alignment) is one fused jnp einsum pipeline.
+
+Known deliberate divergence: the reference sorts sentences by length for batching and
+applies the sorting permutation a second time instead of inverting it
+(``functional/text/bert.py:563-567`` indexing with the output of
+``helper_embedding_metric.py:79-84``), so its per-sentence scores come back
+mis-ordered — and when predictions and references have different length orderings it
+greedily matches the wrong sentence pairs. This implementation keeps input order
+(there is no per-batch recompile to amortize under XLA's static shapes);
+``tests/test_bertscore_hf.py`` checks parity modulo the reference's permutation.
 """
 
 from __future__ import annotations

@@ -1,0 +1,323 @@
+"""InfoLM (reference ``functional/text/infolm.py``; Colombo et al., AAAI 2022).
+
+Information measures between masked-LM token distributions of predicted and
+reference sentences. The distribution of a sentence is the (idf-weighted) average
+over positions of ``softmax(logits[pos] / temperature)`` with position ``pos``
+masked out — one MLM forward per position, exactly the reference pipeline
+(``functional/text/infolm.py:368-425``).
+
+The masked LM is pluggable through the same seam BERTScore uses:
+``model_name_or_path`` loads a HF ``AutoModelForMaskedLM`` from the *local* cache
+(no egress), or ``model`` + ``user_tokenizer`` supply a custom pipeline. The
+information measures themselves (``functional/text/infolm.py:57-210``) are
+self-contained jnp math.
+
+Known deliberate divergence: the reference sorts sentences by length for batching
+and then applies the sorting permutation a second time instead of inverting it
+(``functional/text/infolm.py:539-541`` indexing with the output of
+``helper_embedding_metric.py:79-84``), so its sentence-level scores come back
+mis-ordered — and when predictions and references have different length
+orderings, it pairs the wrong sentences. This implementation keeps input order
+(no sorting is needed: there is no per-batch recompile to amortize under XLA's
+static shapes). Corpus means agree with the reference whenever preds and targets
+share a length ordering; ``tests/test_infolm.py`` checks parity modulo the
+reference's permutation.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...utilities.imports import _module_available
+
+_TRANSFORMERS_AVAILABLE = _module_available("transformers")
+
+_ALLOWED_INFORMATION_MEASURE = (
+    "kl_divergence",
+    "alpha_divergence",
+    "beta_divergence",
+    "ab_divergence",
+    "renyi_divergence",
+    "l1_distance",
+    "l2_distance",
+    "l_infinity_distance",
+    "fisher_rao_distance",
+)
+
+
+class _InformationMeasure:
+    """Vectorized information measures over ``(batch, vocab)`` distributions.
+
+    Validation rules mirror the reference (``functional/text/infolm.py:104-136``):
+    alpha required (and not in {0, 1}) for alpha divergence, not 1 for Rényi;
+    beta required (not in {0, -1}) for beta divergence; AB divergence needs
+    alpha, beta and alpha+beta all nonzero.
+    """
+
+    def __init__(
+        self,
+        information_measure: str,
+        alpha: Optional[float] = None,
+        beta: Optional[float] = None,
+    ) -> None:
+        if information_measure not in _ALLOWED_INFORMATION_MEASURE:
+            raise ValueError(
+                f"Argument `information_measure` expected one of {_ALLOWED_INFORMATION_MEASURE}, "
+                f"got {information_measure}"
+            )
+        self.information_measure = information_measure
+        needs_alpha = ("alpha_divergence", "ab_divergence", "renyi_divergence")
+        if information_measure in needs_alpha and not isinstance(alpha, float):
+            raise ValueError(f"Parameter `alpha` is expected to be defined for {information_measure}.")
+        if information_measure in ("beta_divergence", "ab_divergence") and not isinstance(beta, float):
+            raise ValueError(f"Parameter `beta` is expected to be defined for {information_measure}.")
+        if information_measure == "alpha_divergence" and (not isinstance(alpha, float) or alpha in (0, 1)):
+            raise ValueError(
+                f"Parameter `alpha` is expected to be float differened from 0 and 1 for {information_measure}."
+            )
+        if information_measure == "beta_divergence" and (not isinstance(beta, float) or beta in (0, -1)):
+            raise ValueError(
+                f"Parameter `beta` is expected to be float differened from 0 and -1 for {information_measure}."
+            )
+        if information_measure == "ab_divergence" and (
+            alpha is None or beta is None or 0 in (alpha, beta, alpha + beta)
+        ):
+            raise ValueError(
+                "Parameters `alpha`, `beta` and their sum are expected to be differened from 0 for "
+                f"{information_measure}."
+            )
+        if information_measure == "renyi_divergence" and (not isinstance(alpha, float) or alpha == 1):
+            raise ValueError(f"Parameter `alpha` is expected to be float differened from 1 for {information_measure}.")
+        self.alpha = alpha or 0.0
+        self.beta = beta or 0.0
+
+    def __call__(self, preds_dist: jnp.ndarray, target_dist: jnp.ndarray) -> jnp.ndarray:
+        p = jnp.asarray(preds_dist)
+        t = jnp.asarray(target_dist)
+        fn = getattr(self, f"_{self.information_measure}")
+        return jnp.nan_to_num(fn(p, t))
+
+    @staticmethod
+    def _kl_divergence(p, t):
+        return jnp.sum(t * jnp.log(p / t), axis=-1)
+
+    def _alpha_divergence(self, p, t):
+        a = self.alpha
+        return (1 - jnp.sum(t**a * p ** (1 - a), axis=-1)) / (a * (a - 1))
+
+    def _ab_divergence(self, p, t, alpha: Optional[float] = None):
+        a = self.alpha if alpha is None else alpha
+        b = self.beta
+        x = jnp.log(jnp.sum(t ** (b + a), axis=-1)) / (b * (b + a))
+        y = jnp.log(jnp.sum(p ** (b + a), axis=-1)) / (a * (b + a))
+        z = jnp.log(jnp.sum(t**a * p**b, axis=-1)) / (a * b)
+        return x + y - z
+
+    def _beta_divergence(self, p, t):
+        return self._ab_divergence(p, t, alpha=1.0)
+
+    def _renyi_divergence(self, p, t):
+        a = self.alpha
+        return jnp.log(jnp.sum(t**a * p ** (1 - a), axis=-1)) / (a - 1)
+
+    @staticmethod
+    def _l1_distance(p, t):
+        return jnp.sum(jnp.abs(t - p), axis=-1)
+
+    @staticmethod
+    def _l2_distance(p, t):
+        return jnp.sqrt(jnp.sum((t - p) ** 2, axis=-1))
+
+    @staticmethod
+    def _l_infinity_distance(p, t):
+        return jnp.max(jnp.abs(t - p), axis=-1)
+
+    @staticmethod
+    def _fisher_rao_distance(p, t):
+        return 2 * jnp.arccos(jnp.clip(jnp.sqrt(p * t).sum(-1), 0, 1))
+
+
+def _load_hf_masked_lm(model_name_or_path: str):
+    if not _TRANSFORMERS_AVAILABLE:
+        raise ModuleNotFoundError(
+            "`infolm` metric with default models requires `transformers` package be installed."
+            " Either install with `pip install transformers>=4.4` or `pip install torchmetrics[text]`."
+        )
+    import torch
+    from transformers import AutoModelForMaskedLM, AutoTokenizer
+
+    try:
+        tokenizer = AutoTokenizer.from_pretrained(model_name_or_path, local_files_only=True)
+        hf_model = AutoModelForMaskedLM.from_pretrained(model_name_or_path, local_files_only=True)
+    except OSError as err:
+        raise ModuleNotFoundError(
+            f"Model {model_name_or_path!r} is not in the local HF cache and this environment has "
+            "no network egress to download it. Pre-populate the cache offline, or pass "
+            "`model` + `user_tokenizer` for a custom masked-LM pipeline."
+        ) from err
+    hf_model.eval()
+
+    def forward(input_ids: np.ndarray, attention_mask: np.ndarray) -> np.ndarray:
+        with torch.no_grad():
+            out = hf_model(torch.as_tensor(np.asarray(input_ids)), torch.as_tensor(np.asarray(attention_mask)))
+        return out.logits.numpy()
+
+    max_length = getattr(hf_model.config, "max_length", 512)
+    return tokenizer, forward, max_length
+
+
+def _special_tokens_map(tokenizer: Any) -> Dict[str, int]:
+    """mask/pad/sep/cls ids (reference ``functional/text/infolm.py:322-339``)."""
+    return {
+        "mask_token_id": tokenizer.mask_token_id,
+        "pad_token_id": tokenizer.pad_token_id,
+        "sep_token_id": tokenizer.sep_token_id,
+        "cls_token_id": tokenizer.cls_token_id,
+    }
+
+
+def _token_mask(input_ids: np.ndarray, special: Dict[str, int]) -> np.ndarray:
+    """1 for content tokens, 0 for pad/sep/cls (reference ``infolm.py:342-365``)."""
+    bad = (
+        (input_ids == special["pad_token_id"])
+        | (input_ids == special["sep_token_id"])
+        | (input_ids == special["cls_token_id"])
+    )
+    return ~bad
+
+
+def _tokens_idf(input_ids: np.ndarray) -> Dict[int, float]:
+    """log((N+1)/(df+1)) over full padded rows — the reference counts special and
+    pad tokens too (``helper_embedding_metric.py:242-261``), which zeroes their idf."""
+    num = input_ids.shape[0]
+    df: Counter = Counter()
+    for row in input_ids:
+        df.update(set(row.tolist()))
+    weights = {tok: float(np.log((num + 1) / (cnt + 1))) for tok, cnt in df.items()}
+    weights["__default__"] = float(np.log(num + 1))
+    return weights
+
+
+def _sentence_distributions(
+    forward: Callable,
+    input_ids: np.ndarray,
+    attention_mask: np.ndarray,
+    temperature: float,
+    idf: bool,
+    special: Dict[str, int],
+    batch_size: int,
+) -> np.ndarray:
+    """(B, vocab) discrete distribution per sentence: idf-weighted average over
+    positions of the MLM's softened softmax with that position masked."""
+    num = input_ids.shape[0]
+    idf_lookup = _tokens_idf(input_ids) if idf else None
+    chunks = []
+    for start in range(0, num, batch_size):
+        ids = input_ids[start : start + batch_size]
+        mask = attention_mask[start : start + batch_size]
+        tok_mask = _token_mask(ids, special)
+        # trim to the batch's longest attended sequence (reference collator)
+        l_eff = int(mask.sum(1).max()) if ids.size else 0
+        ids = ids[:, :l_eff]
+        mask = mask[:, :l_eff]
+        tok_mask = tok_mask[:, :l_eff]
+        if idf:
+            default = idf_lookup["__default__"]
+            idf_w = np.vectorize(lambda t: idf_lookup.get(int(t), default), otypes=[np.float32])(ids)
+        acc = None
+        for pos in range(l_eff):
+            ids_m = ids.copy()
+            ids_m[:, pos] = special["mask_token_id"]
+            logits = np.asarray(forward(ids_m, mask))[:, pos, :]
+            prob = np.asarray(jax.nn.softmax(jnp.asarray(logits, jnp.float32) / temperature, axis=-1))
+            w = tok_mask[:, pos].astype(np.float32)
+            if idf:
+                w = w * idf_w[:, pos]
+            contrib = prob * w[:, None]
+            acc = contrib if acc is None else acc + contrib
+        denom = (tok_mask * (idf_w if idf else 1.0)).sum(1).astype(np.float32)
+        if acc is None:
+            acc = np.zeros((ids.shape[0], 1), np.float32)
+        chunks.append(acc / denom[:, None])
+    return np.concatenate(chunks) if chunks else np.zeros((0, 1), np.float32)
+
+
+def _infolm_prepare(
+    model_name_or_path: Optional[str],
+    model: Optional[Callable],
+    user_tokenizer: Any,
+    max_length: Optional[int],
+) -> Tuple[Any, Callable, int, Dict[str, int]]:
+    if model is not None:
+        if user_tokenizer is None:
+            raise ValueError("A custom `model` must be accompanied by a `user_tokenizer`.")
+        tokenizer, forward = user_tokenizer, model
+        max_len = max_length or 512
+    else:
+        tokenizer, forward, model_max = _load_hf_masked_lm(model_name_or_path or "bert-base-uncased")
+        max_len = max_length or model_max
+    return tokenizer, forward, max_len, _special_tokens_map(tokenizer)
+
+
+def _infolm_tokenize(tokenizer: Any, texts: Sequence[str], max_length: int) -> Dict[str, np.ndarray]:
+    out = tokenizer(list(texts), padding="max_length", max_length=max_length, truncation=True, return_tensors="np")
+    return {"input_ids": np.asarray(out["input_ids"]), "attention_mask": np.asarray(out["attention_mask"])}
+
+
+def _infolm_compute(
+    forward: Callable,
+    preds_tok: Dict[str, np.ndarray],
+    target_tok: Dict[str, np.ndarray],
+    temperature: float,
+    idf: bool,
+    measure: _InformationMeasure,
+    special: Dict[str, int],
+    batch_size: int,
+) -> jnp.ndarray:
+    preds_dist = _sentence_distributions(
+        forward, preds_tok["input_ids"], preds_tok["attention_mask"], temperature, idf, special, batch_size
+    )
+    target_dist = _sentence_distributions(
+        forward, target_tok["input_ids"], target_tok["attention_mask"], temperature, idf, special, batch_size
+    )
+    return measure(preds_dist, target_dist)
+
+
+def infolm(
+    preds: Union[str, Sequence[str]],
+    target: Union[str, Sequence[str]],
+    model_name_or_path: str = "bert-base-uncased",
+    temperature: float = 0.25,
+    information_measure: str = "kl_divergence",
+    idf: bool = True,
+    alpha: Optional[float] = None,
+    beta: Optional[float] = None,
+    device: Optional[Any] = None,
+    max_length: Optional[int] = None,
+    batch_size: int = 64,
+    num_threads: int = 0,
+    verbose: bool = True,
+    return_sentence_level_score: bool = False,
+    model: Optional[Callable] = None,
+    user_tokenizer: Any = None,
+) -> Union[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray]]:
+    """Corpus-level InfoLM score (reference ``functional/text/infolm.py:553-662``).
+
+    ``model``/``user_tokenizer`` extend the reference surface with the BERTScore
+    seam so any masked LM (jax or torch) can drive the pipeline.
+    """
+    preds = [preds] if isinstance(preds, str) else list(preds)
+    target = [target] if isinstance(target, str) else list(target)
+    measure = _InformationMeasure(information_measure, alpha, beta)
+    tokenizer, forward, max_len, special = _infolm_prepare(model_name_or_path, model, user_tokenizer, max_length)
+    preds_tok = _infolm_tokenize(tokenizer, preds, max_len)
+    target_tok = _infolm_tokenize(tokenizer, target, max_len)
+    scores = _infolm_compute(forward, preds_tok, target_tok, temperature, idf, measure, special, batch_size)
+    if return_sentence_level_score:
+        return scores.mean(), scores
+    return scores.mean()

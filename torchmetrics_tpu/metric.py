@@ -734,6 +734,24 @@ class HostMetric(Metric):
     def _host_batch_state(self, *args: Any, **kwargs: Any) -> StateDict:
         raise NotImplementedError
 
+    def _concat_state(self, state: Optional[StateDict] = None) -> StateDict:
+        """Concat list states on host when entries are numpy — host metrics evaluate
+        host-side, so a device round-trip here would only add transfers (and a D2H
+        readback flips tunneled TPU runtimes into synchronous dispatch)."""
+        state = self._state if state is None else state
+        out: StateDict = {}
+        for k, v in state.items():
+            if isinstance(v, list):
+                if len(v) == 0:
+                    out[k] = np.zeros((0,), np.float32)
+                elif all(isinstance(e, np.ndarray) for e in v):
+                    out[k] = np.concatenate([np.atleast_1d(e) for e in v], axis=0)
+                else:
+                    out[k] = dim_zero_cat(v)
+            else:
+                out[k] = v
+        return out
+
     def _batch_state(self, *args: Any, **kwargs: Any) -> StateDict:  # pragma: no cover
         return self._host_batch_state(*args, **kwargs)
 

@@ -617,9 +617,15 @@ class BERTScore(HostMetric):
 
 
 class InfoLM(HostMetric):
-    """InfoLM surface (reference ``text/infolm.py:42``): information measures over
-    masked-LM token distributions. The default pipeline needs a HF masked LM, whose
-    weights cannot be downloaded in an air-gapped environment."""
+    """InfoLM (reference ``text/infolm.py:42``): information measures over masked-LM
+    token distributions (``functional/text/infolm.py``). States are the tokenized
+    sentences (4 cat states, reference ``text/infolm.py:168-171``) — rows are padded
+    to ``max_length`` so cross-rank sync is static-width concatenation.
+
+    The masked LM is pluggable: ``model_name_or_path`` loads ``AutoModelForMaskedLM``
+    from the local HF cache (downloads are gated in an air-gapped environment), or
+    ``model`` + ``user_tokenizer`` supply a custom pipeline (the BERTScore seam).
+    """
 
     is_differentiable = False
     higher_is_better = True
@@ -633,11 +639,61 @@ class InfoLM(HostMetric):
         idf: bool = True,
         alpha: Optional[float] = None,
         beta: Optional[float] = None,
+        device: Optional[Any] = None,
+        max_length: Optional[int] = None,
+        batch_size: int = 64,
+        num_threads: int = 0,
+        verbose: bool = True,
+        return_sentence_level_score: bool = False,
+        model: Optional[Callable] = None,
+        user_tokenizer: Any = None,
         **kwargs: Any,
     ) -> None:
+        from ..functional.text.infolm import _InformationMeasure, _infolm_prepare
+
         super().__init__(**kwargs)
-        raise ModuleNotFoundError(
-            "InfoLM requires a pretrained HF masked language model, whose weights cannot be "
-            "downloaded in this air-gapped environment. Pre-populate the local HF cache offline "
-            "to enable it."
+        self.temperature = temperature
+        self.idf = idf
+        self.batch_size = batch_size
+        self.return_sentence_level_score = return_sentence_level_score
+        self._measure = _InformationMeasure(information_measure, alpha, beta)
+        self._tokenizer, self._forward, self.max_length, self._special = _infolm_prepare(
+            model_name_or_path, model, user_tokenizer, max_length
         )
+        self.add_state("preds_input_ids", default=[], dist_reduce_fx="cat")
+        self.add_state("preds_attention_mask", default=[], dist_reduce_fx="cat")
+        self.add_state("target_input_ids", default=[], dist_reduce_fx="cat")
+        self.add_state("target_attention_mask", default=[], dist_reduce_fx="cat")
+
+    def _host_batch_state(self, preds: Union[str, Sequence[str]], target: Union[str, Sequence[str]]) -> dict:
+        from ..functional.text.infolm import _infolm_tokenize
+
+        preds = [preds] if isinstance(preds, str) else list(preds)
+        target = [target] if isinstance(target, str) else list(target)
+        p = _infolm_tokenize(self._tokenizer, preds, self.max_length)
+        t = _infolm_tokenize(self._tokenizer, target, self.max_length)
+        return {
+            "preds_input_ids": p["input_ids"],
+            "preds_attention_mask": p["attention_mask"],
+            "target_input_ids": t["input_ids"],
+            "target_attention_mask": t["attention_mask"],
+        }
+
+    def _compute(self, state: dict):
+        # state arrives pre-concatenated by HostMetric._concat_state
+        from ..functional.text.infolm import _infolm_compute
+
+        cat = lambda v: np.asarray(v)
+        scores = _infolm_compute(
+            self._forward,
+            {"input_ids": cat(state["preds_input_ids"]), "attention_mask": cat(state["preds_attention_mask"])},
+            {"input_ids": cat(state["target_input_ids"]), "attention_mask": cat(state["target_attention_mask"])},
+            self.temperature,
+            self.idf,
+            self._measure,
+            self._special,
+            self.batch_size,
+        )
+        if self.return_sentence_level_score:
+            return scores.mean(), scores
+        return scores.mean()
