@@ -24,7 +24,12 @@ import numpy as np
 import pytest
 from jax.sharding import PartitionSpec as P
 
-ATOL = 1e-6
+# Default parity envelope vs the reference's 1e-8 (reference testers.py:461).
+# Integer-sufficient-statistic metrics (counts, confusion matrices, exact ratios)
+# meet 1e-8; families whose f32 accumulation order legitimately differs from the
+# float64/torch oracle pass an explicit looser atol at the call site with a
+# comment naming the float source.
+ATOL = 1e-8
 
 
 def _assert_allclose(tm_result, ref_result, atol: float = ATOL, msg: str = ""):

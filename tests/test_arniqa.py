@@ -1,0 +1,179 @@
+"""ARNIQA model tests: architecture + converter parity against a from-scratch
+torch ResNet-50 (torchvision is not installed, so the torch twin is built here
+with torchvision's exact module naming), plus the full ARNIQA pipeline against a
+torch replica of the reference's forward (half-scale antialias resize, imagenet
+normalization, L2-normalized feature concat, linear regressor, MOS rescale).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import torch
+from torch import nn
+
+from torchmetrics_tpu.functional.image.arniqa import arniqa
+from torchmetrics_tpu.image import ARNIQA
+from torchmetrics_tpu.image._resnet import convert_resnet50_state_dict, resnet50_features
+
+
+class Bottleneck(nn.Module):
+    expansion = 4
+
+    def __init__(self, inplanes, planes, stride=1, downsample=None):
+        super().__init__()
+        self.conv1 = nn.Conv2d(inplanes, planes, 1, bias=False)
+        self.bn1 = nn.BatchNorm2d(planes)
+        self.conv2 = nn.Conv2d(planes, planes, 3, stride, 1, bias=False)
+        self.bn2 = nn.BatchNorm2d(planes)
+        self.conv3 = nn.Conv2d(planes, planes * 4, 1, bias=False)
+        self.bn3 = nn.BatchNorm2d(planes * 4)
+        self.relu = nn.ReLU(inplace=True)
+        self.downsample = downsample
+
+    def forward(self, x):
+        identity = x
+        out = self.relu(self.bn1(self.conv1(x)))
+        out = self.relu(self.bn2(self.conv2(out)))
+        out = self.bn3(self.conv3(out))
+        if self.downsample is not None:
+            identity = self.downsample(x)
+        return self.relu(out + identity)
+
+
+class TorchResNet50(nn.Module):
+    """torchvision-naming-compatible ResNet-50 trunk (no fc)."""
+
+    def __init__(self):
+        super().__init__()
+        self.inplanes = 64
+        self.conv1 = nn.Conv2d(3, 64, 7, 2, 3, bias=False)
+        self.bn1 = nn.BatchNorm2d(64)
+        self.relu = nn.ReLU(inplace=True)
+        self.maxpool = nn.MaxPool2d(3, 2, 1)
+        self.layer1 = self._make_layer(64, 3, 1)
+        self.layer2 = self._make_layer(128, 4, 2)
+        self.layer3 = self._make_layer(256, 6, 2)
+        self.layer4 = self._make_layer(512, 3, 2)
+        self.avgpool = nn.AdaptiveAvgPool2d(1)
+
+    def _make_layer(self, planes, blocks, stride):
+        downsample = nn.Sequential(
+            nn.Conv2d(self.inplanes, planes * 4, 1, stride, bias=False), nn.BatchNorm2d(planes * 4)
+        )
+        layers = [Bottleneck(self.inplanes, planes, stride, downsample)]
+        self.inplanes = planes * 4
+        layers += [Bottleneck(self.inplanes, planes) for _ in range(1, blocks)]
+        return nn.Sequential(*layers)
+
+    def forward(self, x):
+        x = self.maxpool(self.relu(self.bn1(self.conv1(x))))
+        x = self.layer4(self.layer3(self.layer2(self.layer1(x))))
+        return self.avgpool(x).flatten(1)
+
+
+def _random_torch_resnet(seed=0):
+    torch.manual_seed(seed)
+    model = TorchResNet50().eval()
+    # randomize BN statistics so folding is actually exercised
+    with torch.no_grad():
+        for m in model.modules():
+            if isinstance(m, nn.BatchNorm2d):
+                m.running_mean.normal_(0, 0.5)
+                m.running_var.uniform_(0.5, 2.0)
+    return model
+
+
+@pytest.fixture(scope="module")
+def torch_resnet():
+    return _random_torch_resnet()
+
+
+def test_resnet50_architecture_parity(torch_resnet):
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(2, 3, 64, 64)).astype(np.float32)
+    with torch.no_grad():
+        want = torch_resnet(torch.as_tensor(x)).numpy()
+    got = np.asarray(resnet50_features(convert_resnet50_state_dict(torch_resnet.state_dict()), x))
+    np.testing.assert_allclose(got, want, atol=2e-4)
+
+
+def test_converter_accepts_sequential_keys(torch_resnet):
+    seq = nn.Sequential(*list(torch_resnet.children())[:-1])  # the ARNIQA encoder layout
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(1, 3, 64, 64)).astype(np.float32)
+    got = np.asarray(resnet50_features(convert_resnet50_state_dict(seq.state_dict()), x))
+    with torch.no_grad():
+        want = torch_resnet(torch.as_tensor(x)).numpy()
+    np.testing.assert_allclose(got, want, atol=2e-4)
+
+
+def _torch_arniqa_forward(model, w, b, img, normalize, lo, hi):
+    mean = torch.tensor([0.485, 0.456, 0.406]).view(1, 3, 1, 1)
+    std = torch.tensor([0.229, 0.224, 0.225]).view(1, 3, 1, 1)
+    h, width = img.shape[-2:]
+    img_ds = torch.nn.functional.interpolate(
+        img, size=(h // 2, width // 2), mode="bilinear", antialias=True
+    )
+    if normalize:
+        img = (img - mean) / std
+        img_ds = (img_ds - mean) / std
+    with torch.no_grad():
+        f_full = torch.nn.functional.normalize(model(img), dim=1)
+        f_half = torch.nn.functional.normalize(model(img_ds), dim=1)
+        score = torch.hstack([f_full, f_half]) @ w.T + b
+    return ((score - lo) / (hi - lo)).flatten().numpy()
+
+
+@pytest.mark.parametrize("normalize", [True, False])
+@pytest.mark.parametrize("regressor_dataset", ["koniq10k", "kadid10k"])
+def test_arniqa_pipeline_parity(torch_resnet, normalize, regressor_dataset):
+    torch.manual_seed(2)
+    w = torch.randn(1, 4096) * 0.02
+    b = torch.randn(1)
+    rng = np.random.default_rng(3)
+    img = rng.random((2, 3, 64, 64)).astype(np.float32)
+    lo, hi = {"koniq10k": (1.0, 100.0), "kadid10k": (1.0, 5.0)}[regressor_dataset]
+    want = _torch_arniqa_forward(torch_resnet, w, b, torch.as_tensor(img), normalize, lo, hi)
+    # weights delivered the way the published checkpoint lays them out
+    enc_sd = {f"model.{k}": v for k, v in nn.Sequential(*list(torch_resnet.children())[:-1]).state_dict().items()}
+    reg_sd = {"weights": w.numpy(), "biases": b.numpy()}
+    got = np.asarray(
+        arniqa(
+            img, regressor_dataset=regressor_dataset, reduction="none", normalize=normalize,
+            encoder_weights=enc_sd, regressor_weights=reg_sd,
+        )
+    )
+    np.testing.assert_allclose(got, want, atol=5e-4)
+
+
+def test_arniqa_reductions_and_scorer():
+    scorer = lambda imgs: np.full(imgs.shape[0], 0.5, np.float32)
+    img = np.zeros((4, 3, 16, 16), np.float32)
+    assert float(arniqa(img, scorer=scorer)) == pytest.approx(0.5)
+    assert float(arniqa(img, scorer=scorer, reduction="sum")) == pytest.approx(2.0)
+    assert np.asarray(arniqa(img, scorer=scorer, reduction="none")).shape == (4,)
+
+
+def test_arniqa_class_accumulates(torch_resnet):
+    torch.manual_seed(4)
+    w = torch.randn(1, 4096) * 0.02
+    b = torch.randn(1)
+    enc_sd = {f"model.{k}": v for k, v in nn.Sequential(*list(torch_resnet.children())[:-1]).state_dict().items()}
+    reg_sd = {"weights": w.numpy(), "biases": b.numpy()}
+    m = ARNIQA(encoder_weights=enc_sd, regressor_weights=reg_sd, reduction="mean")
+    rng = np.random.default_rng(5)
+    all_scores = []
+    for _ in range(2):
+        img = rng.random((2, 3, 48, 48)).astype(np.float32)
+        m.update(img)
+        all_scores.append(np.asarray(arniqa(img, reduction="none", encoder_weights=enc_sd, regressor_weights=reg_sd)))
+    np.testing.assert_allclose(float(m.compute()), np.concatenate(all_scores).mean(), rtol=1e-5)
+
+
+def test_arniqa_gates_without_weights(tmp_path, monkeypatch):
+    monkeypatch.setenv("TORCH_HOME", str(tmp_path))  # empty hub cache
+    with pytest.raises(ModuleNotFoundError, match="torch-hub cache"):
+        arniqa(np.zeros((1, 3, 32, 32), np.float32))
+    with pytest.raises(ValueError, match="regressor_dataset"):
+        arniqa(np.zeros((1, 3, 32, 32), np.float32), regressor_dataset="bad")

@@ -1,0 +1,191 @@
+"""VMAF elementary features + NuSVR fusion tests.
+
+``vmaf_torch`` (the reference's only backend) and the trained ``vmaf_v0.6.1``
+SVM model are unavailable offline, so the features are validated by their
+defining properties (identity, monotone degradation, hand-computable motion)
+and the fusion engine against hand-computed RBF kernels on a synthetic
+libvmaf-format model file.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+import torchmetrics_tpu as tm
+from torchmetrics_tpu.functional.video.vmaf import (
+    VmafModel,
+    adm_features,
+    calculate_luma,
+    motion_features,
+    vif_features,
+    vmaf_features,
+    video_multi_method_assessment_fusion,
+)
+
+
+def _videos(seed=0, b=1, f=4, h=36, w=44):
+    rng = np.random.default_rng(seed)
+    base = rng.random((b, 3, f, h, w)).astype(np.float32)
+    return base
+
+
+def _smooth_video(b=1, f=4, h=48, w=48):
+    """Low-frequency content so VIF/ADM statistics are well-conditioned."""
+    y, x = np.mgrid[:h, :w] / h
+    frames = np.stack([np.sin(4 * np.pi * (x + 0.08 * i)) * np.cos(3 * np.pi * y) for i in range(f)])
+    vid = np.repeat(frames[None, None], 3, axis=1).astype(np.float32) * 0.4 + 0.5
+    return np.broadcast_to(vid, (b, 3, f, h, w)).copy()
+
+
+class TestElementaryFeatures:
+    def test_identity_is_perfect(self):
+        vid = _smooth_video()
+        luma = calculate_luma(vid)
+        vifs = vif_features(luma, luma)
+        for k, v in vifs.items():
+            np.testing.assert_allclose(np.asarray(v), 1.0, atol=1e-4, err_msg=k)
+        adms = adm_features(luma, luma)
+        for k, v in adms.items():
+            np.testing.assert_allclose(np.asarray(v), 1.0, atol=1e-3, err_msg=k)
+
+    def test_static_video_zero_motion(self):
+        vid = np.broadcast_to(_smooth_video(f=1)[:, :, :1], (1, 3, 5, 48, 48)).copy()
+        motion, motion2 = motion_features(calculate_luma(vid))
+        np.testing.assert_allclose(np.asarray(motion), 0.0, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(motion2), 0.0, atol=1e-4)
+
+    def test_motion_matches_hand_calc(self):
+        """Two constant frames differing by a constant offset: blur preserves the
+        offset, so motion = |offset| * 255."""
+        vid = np.zeros((1, 3, 2, 32, 32), np.float32)
+        vid[:, :, 1] = 0.1
+        motion, motion2 = motion_features(calculate_luma(vid))
+        np.testing.assert_allclose(np.asarray(motion)[0], [0.0, 25.5], atol=1e-3)
+        np.testing.assert_allclose(np.asarray(motion2)[0], [0.0, 25.5], atol=1e-3)
+
+    def test_degradation_monotone(self):
+        vid = _smooth_video()
+        luma = calculate_luma(vid)
+        rng = np.random.default_rng(1)
+        noise = rng.normal(size=luma.shape).astype(np.float32)
+        vif_mid = np.asarray(vif_features(luma, luma + 8 * noise)["vif_scale0"]).mean()
+        vif_bad = np.asarray(vif_features(luma, luma + 30 * noise)["vif_scale0"]).mean()
+        assert 1.0 > vif_mid > vif_bad
+        adm_mid = np.asarray(adm_features(luma, luma + 8 * noise)["adm2"]).mean()
+        assert adm_mid < 1.0 + 1e-3
+
+    def test_feature_dict_keys_and_shapes(self):
+        vid = _videos(b=2, f=3)
+        out = vmaf_features(vid, vid)
+        expected = {
+            "integer_motion", "integer_motion2", "integer_adm2",
+            *(f"integer_adm_scale{i}" for i in range(4)),
+            *(f"integer_vif_scale{i}" for i in range(4)),
+        }
+        assert set(out) == expected
+        for v in out.values():
+            assert np.asarray(v).shape == (2, 3)
+
+    def test_input_validation(self):
+        with pytest.raises(ValueError, match="batch, 3, frames"):
+            vmaf_features(np.zeros((2, 10, 10)), np.zeros((2, 10, 10)))
+
+
+def _toy_model(tmp_path, feature_names, n_sv=3, seed=0):
+    rng = np.random.default_rng(seed)
+    blob = {
+        "model_dict": {
+            "feature_names": feature_names,
+            "norm_type": "linear_rescale",
+            # entry 0 is the score normalization, rest per-feature
+            "slopes": [0.012, *np.round(rng.uniform(0.5, 2, len(feature_names)), 3).tolist()],
+            "intercepts": [-0.3, *np.round(rng.uniform(-1, 1, len(feature_names)), 3).tolist()],
+            "model": {
+                "gamma": 0.04,
+                "rho": -1.2,
+                "sv_coef": np.round(rng.uniform(-2, 2, n_sv), 3).tolist(),
+                "support_vectors": np.round(rng.uniform(0, 1, (n_sv, len(feature_names))), 3).tolist(),
+            },
+            "score_clip": [0.0, 100.0],
+        }
+    }
+    path = tmp_path / "toy_vmaf_model.json"
+    path.write_text(json.dumps(blob))
+    return str(path), blob["model_dict"]
+
+
+class TestFusion:
+    FEATURES = [
+        "integer_motion2", "integer_adm2",
+        "integer_vif_scale0", "integer_vif_scale1", "integer_vif_scale2", "integer_vif_scale3",
+    ]
+
+    def test_nusvr_matches_hand_calc(self, tmp_path):
+        path, d = _toy_model(tmp_path, self.FEATURES)
+        model = VmafModel.from_file(path)
+        rng = np.random.default_rng(2)
+        feats = {name: rng.uniform(0, 1, (2, 3)) for name in self.FEATURES}
+        got = model.predict(feats)
+        # hand computation
+        x = np.stack([feats[n] for n in self.FEATURES], -1).reshape(-1, 6)
+        xn = np.asarray(d["slopes"][1:]) * x + np.asarray(d["intercepts"][1:])
+        sv = np.asarray(d["model"]["support_vectors"])
+        k = np.exp(-d["model"]["gamma"] * ((xn[:, None] - sv[None]) ** 2).sum(-1))
+        y = (np.asarray(d["model"]["sv_coef"]) * k).sum(-1) - d["model"]["rho"]
+        y = (y - d["intercepts"][0]) / d["slopes"][0]
+        y = np.clip(y, 0, 100).reshape(2, 3)
+        np.testing.assert_allclose(got, y, rtol=1e-12)
+
+    def test_fused_score_end_to_end(self, tmp_path):
+        path, _ = _toy_model(tmp_path, self.FEATURES)
+        vid = _videos(b=2, f=3)
+        score = np.asarray(video_multi_method_assessment_fusion(vid, vid, model_path=path))
+        assert score.shape == (2, 3)
+        assert (score >= 0).all() and (score <= 100).all()
+        out = video_multi_method_assessment_fusion(vid, vid, features=True, model_path=path)
+        assert "vmaf" in out and "integer_adm2" in out
+
+    def test_class_accumulates(self, tmp_path):
+        path, _ = _toy_model(tmp_path, self.FEATURES)
+        m = tm.VideoMultiMethodAssessmentFusion(model_path=path)
+        m.update(_videos(seed=1, f=2), _videos(seed=2, f=2))
+        m.update(_videos(seed=3, f=3), _videos(seed=4, f=3))
+        out = np.asarray(m.compute())
+        assert out.shape == (5,)
+        mf = tm.VideoMultiMethodAssessmentFusion(features=True, model_path=path)
+        mf.update(_videos(seed=1, f=2), _videos(seed=2, f=2))
+        d = mf.compute()
+        assert np.asarray(d["vmaf"]).shape == (2,)
+        assert np.asarray(d["integer_vif_scale3"]).shape == (2,)
+
+    def test_gate_without_any_path(self):
+        with pytest.raises(ModuleNotFoundError, match="vmaf"):
+            video_multi_method_assessment_fusion(_videos(), _videos())
+        with pytest.raises(ModuleNotFoundError, match="vmaf"):
+            tm.VideoMultiMethodAssessmentFusion()
+
+
+def test_libvmaf_feature_name_mapping(tmp_path):
+    """Real libvmaf model files name features VMAF_feature_<x>_score — they must
+    resolve to the in-tree integer_<x> keys."""
+    from torchmetrics_tpu.functional.video.vmaf import _canonical_feature_key
+
+    assert _canonical_feature_key("VMAF_feature_adm2_score") == "integer_adm2"
+    assert _canonical_feature_key("'VMAF_feature_motion2_score'") == "integer_motion2"
+    assert _canonical_feature_key("VMAF_feature_vif_scale0_score") == "integer_vif_scale0"
+    assert _canonical_feature_key("integer_adm2") == "integer_adm2"
+
+    path, _ = _toy_model(
+        tmp_path,
+        [
+            "VMAF_feature_motion2_score", "VMAF_feature_adm2_score",
+            "VMAF_feature_vif_scale0_score", "VMAF_feature_vif_scale1_score",
+            "VMAF_feature_vif_scale2_score", "VMAF_feature_vif_scale3_score",
+        ],
+    )
+    vid = _videos(b=1, f=2)
+    score = np.asarray(video_multi_method_assessment_fusion(vid, vid, model_path=path))
+    assert score.shape == (1, 2) and np.isfinite(score).all()

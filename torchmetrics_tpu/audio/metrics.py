@@ -137,11 +137,11 @@ class _HostMeanAudioMetric(HostMetric):
         self.add_state("score_sum", default=np.zeros(()), dist_reduce_fx="sum")
         self.add_state("total", default=np.zeros((), jnp.int32), dist_reduce_fx="sum")
 
-    def _score(self, preds, target) -> jnp.ndarray:
+    def _score(self, preds, target=None) -> jnp.ndarray:
         raise NotImplementedError
 
-    def _host_batch_state(self, preds, target):
-        score = self._score(preds, target)
+    def _host_batch_state(self, preds, target=None):
+        score = self._score(preds, target) if target is not None else self._score(preds)
         return {"score_sum": score.sum(), "total": jnp.asarray(score.size, jnp.int32)}
 
     def _compute(self, state):
@@ -271,45 +271,81 @@ class ShortTimeObjectiveIntelligibility(_HostMeanAudioMetric):
 
 
 class SpeechReverberationModulationEnergyRatio(_HostMeanAudioMetric):
-    """SRMR (reference ``audio/srmr.py:37``) — needs gammatone + torchaudio wheels."""
+    """SRMR (reference ``audio/srmr.py:37``). The in-tree gammatone + modulation
+    filterbank pipeline (``functional/audio/srmr.py``) needs no optional wheels —
+    the reference requires ``gammatone`` + ``torchaudio`` for the same math."""
 
     higher_is_better = True
 
-    def __init__(self, fs: int, **kwargs: Any) -> None:
+    def __init__(
+        self,
+        fs: int,
+        n_cochlear_filters: int = 23,
+        low_freq: float = 125,
+        min_cf: float = 4,
+        max_cf: Optional[float] = None,
+        norm: bool = False,
+        fast: bool = False,
+        **kwargs: Any,
+    ) -> None:
         super().__init__(**kwargs)
-        from ..functional.audio.external import _GAMMATONE_AVAILABLE, _TORCHAUDIO_AVAILABLE
+        from ..functional.audio.srmr import _srmr_arg_validate
 
-        if not (_GAMMATONE_AVAILABLE and _TORCHAUDIO_AVAILABLE):
-            raise ModuleNotFoundError(
-                "speech_reverberation_modulation_energy_ratio requires that gammatone and torchaudio are installed."
-                " Either install as `pip install torchmetrics[audio]` or "
-                "`pip install torchaudio` and `pip install git+https://github.com/detly/gammatone`."
-            )
+        _srmr_arg_validate(fs, n_cochlear_filters, low_freq, min_cf, max_cf, norm, fast)
         self.fs = fs
+        self.n_cochlear_filters = n_cochlear_filters
+        self.low_freq = low_freq
+        self.min_cf = min_cf
+        self.max_cf = max_cf
+        self.norm = norm
+        self.fast = fast
 
     def _score(self, preds, target=None):
-        return speech_reverberation_modulation_energy_ratio(preds, self.fs)
+        return speech_reverberation_modulation_energy_ratio(
+            preds, self.fs, self.n_cochlear_filters, self.low_freq, self.min_cf,
+            self.max_cf, self.norm, self.fast,
+        )
 
 
 class DeepNoiseSuppressionMeanOpinionScore(_HostMeanAudioMetric):
-    """DNSMOS (reference ``audio/dnsmos.py:36``) — needs librosa + onnxruntime."""
+    """DNSMOS (reference ``audio/dnsmos.py:36``). The melspec feature pipeline is
+    in-tree numpy (``functional/audio/dnsmos.py``); only onnxruntime + the
+    DNS-Challenge model files (or an injected ``infer_fns``) remain external."""
 
     higher_is_better = True
 
-    def __init__(self, fs: int, personalized: bool, **kwargs: Any) -> None:
+    def __init__(
+        self,
+        fs: int,
+        personalized: bool,
+        device: Optional[str] = None,
+        num_threads: Optional[int] = None,
+        cache_session: bool = True,
+        infer_fns: Optional[Any] = None,
+        **kwargs: Any,
+    ) -> None:
         super().__init__(**kwargs)
-        from ..functional.audio.external import _LIBROSA_AVAILABLE, _ONNXRUNTIME_AVAILABLE, _REQUESTS_AVAILABLE
+        from ..functional.audio.dnsmos import _ONNXRUNTIME_AVAILABLE
 
-        if not (_LIBROSA_AVAILABLE and _ONNXRUNTIME_AVAILABLE and _REQUESTS_AVAILABLE):
+        if infer_fns is None and not _ONNXRUNTIME_AVAILABLE:
             raise ModuleNotFoundError(
-                "DNSMOS metric requires that librosa, onnxruntime and requests are installed."
-                " Install as `pip install librosa onnxruntime-gpu requests`."
+                "DNSMOS metric requires that onnxruntime is installed."
+                " Install as `pip install onnxruntime`, or pass `infer_fns`."
             )
         self.fs = fs
         self.personalized = personalized
+        self.num_threads = num_threads
+        self.infer_fns = infer_fns
 
     def _score(self, preds, target=None):
-        return deep_noise_suppression_mean_opinion_score(preds, self.fs, self.personalized)
+        return deep_noise_suppression_mean_opinion_score(
+            preds, self.fs, self.personalized, num_threads=self.num_threads, infer_fns=self.infer_fns
+        )
+
+    def _host_batch_state(self, preds, target=None):
+        # keep the 4 MOS dimensions [p808, sig, bak, ovr] (reference dnsmos.py:127-128)
+        score = np.asarray(self._score(preds)).reshape(-1, 4)
+        return {"score_sum": score.sum(0), "total": jnp.asarray(score.shape[0], jnp.int32)}
 
 
 class NonIntrusiveSpeechQualityAssessment(_HostMeanAudioMetric):

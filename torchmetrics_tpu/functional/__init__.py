@@ -5,7 +5,7 @@ Every domain package declares its public surface in its own ``__all__``; this mo
 aggregates them so the flat ``torchmetrics_tpu.functional.<fn>`` namespace stays in
 lock-step with the per-domain namespaces as domains are added."""
 
-from torchmetrics_tpu.functional import audio, classification, clustering, detection, image, multimodal, nominal, pairwise, regression, retrieval, segmentation, shape, text
+from torchmetrics_tpu.functional import audio, classification, clustering, detection, image, multimodal, nominal, pairwise, regression, retrieval, segmentation, shape, text, video
 from torchmetrics_tpu.functional.audio import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.classification import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.regression import *  # noqa: F401,F403
@@ -19,6 +19,7 @@ from torchmetrics_tpu.functional.pairwise import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.shape import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.text import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.segmentation import *  # noqa: F401,F403
+from torchmetrics_tpu.functional.video import *  # noqa: F401,F403
 
 __all__ = [
     *classification.__all__,
@@ -34,4 +35,5 @@ __all__ = [
     *shape.__all__,
     *text.__all__,
     *segmentation.__all__,
+    *video.__all__,
 ]

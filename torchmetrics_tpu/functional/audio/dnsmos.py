@@ -1,0 +1,209 @@
+"""DNSMOS — Deep Noise Suppression Mean Opinion Score.
+
+Reference surface: ``functional/audio/dnsmos.py`` (melspec features + two ONNX
+models + per-dimension polynomial calibration). The reference needs ``librosa``
+for the mel spectrogram; here the whole feature pipeline (periodic-Hann centered
+STFT, Slaney-norm mel filterbank, ``power_to_db`` with max-ref and 80 dB floor)
+is self-contained numpy, so only ``onnxruntime`` + the Microsoft DNS-Challenge
+model files remain external. Model files are looked up in the reference's cache
+layout (``~/.torchmetrics/DNSMOS``); this environment has no egress so they are
+never downloaded — place them there manually, or inject ``infer_fns`` (a test /
+custom-runtime seam) to run the pipeline without onnxruntime.
+
+Resampling note: the reference resamples through ``librosa.resample`` (soxr);
+here it is ``scipy.signal.resample_poly`` (polyphase kaiser) — a documented
+sub-1e-3 waveform difference for non-16 kHz inputs.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...utilities.imports import _module_available
+
+_ONNXRUNTIME_AVAILABLE = _module_available("onnxruntime")
+
+SAMPLING_RATE = 16000
+INPUT_LENGTH = 9.01
+DNSMOS_DIR = "~/.torchmetrics/DNSMOS"
+
+
+# ---- librosa-equivalent mel spectrogram (numpy) ---------------------------------
+
+def _hz_to_mel_slaney(f: np.ndarray) -> np.ndarray:
+    f = np.asarray(f, np.float64)
+    f_sp = 200.0 / 3
+    mels = f / f_sp
+    min_log_hz = 1000.0
+    logstep = np.log(6.4) / 27.0
+    log_region = f >= min_log_hz
+    return np.where(log_region, min_log_hz / f_sp + np.log(np.maximum(f, 1e-10) / min_log_hz) / logstep, mels)
+
+
+def _mel_to_hz_slaney(m: np.ndarray) -> np.ndarray:
+    m = np.asarray(m, np.float64)
+    f_sp = 200.0 / 3
+    freqs = m * f_sp
+    min_log_hz = 1000.0
+    min_log_mel = min_log_hz / f_sp
+    logstep = np.log(6.4) / 27.0
+    log_region = m >= min_log_mel
+    return np.where(log_region, min_log_hz * np.exp(logstep * (m - min_log_mel)), freqs)
+
+
+def mel_filterbank(sr: int, n_fft: int, n_mels: int, fmin: float = 0.0, fmax: Optional[float] = None) -> np.ndarray:
+    """Slaney-style (librosa-default) triangular mel filterbank, slaney-normalized."""
+    fmax = fmax or sr / 2.0
+    fft_freqs = np.linspace(0, sr / 2.0, 1 + n_fft // 2)
+    mel_pts = _mel_to_hz_slaney(np.linspace(_hz_to_mel_slaney(fmin), _hz_to_mel_slaney(fmax), n_mels + 2))
+    fdiff = np.diff(mel_pts)
+    ramps = mel_pts[:, None] - fft_freqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = np.maximum(0, np.minimum(lower, upper))
+    enorm = 2.0 / (mel_pts[2 : n_mels + 2] - mel_pts[:n_mels])
+    return weights * enorm[:, None]
+
+
+def _stft_power(audio: np.ndarray, n_fft: int, hop_length: int) -> np.ndarray:
+    """|STFT|^2 with librosa's defaults: periodic Hann of win_length=n_fft,
+    center=True constant padding. audio: (B, T) -> (B, 1+n_fft//2, frames)."""
+    window = 0.5 - 0.5 * np.cos(2 * np.pi * np.arange(n_fft) / n_fft)  # periodic hann
+    pad = n_fft // 2
+    x = np.pad(audio, ((0, 0), (pad, pad)))
+    num_frames = 1 + (x.shape[-1] - n_fft) // hop_length
+    idx = np.arange(num_frames)[:, None] * hop_length + np.arange(n_fft)[None, :]
+    frames = x[:, idx] * window  # (B, F, n_fft)
+    spec = np.fft.rfft(frames, axis=-1)
+    return np.abs(spec.transpose(0, 2, 1)) ** 2
+
+
+def _power_to_db(s: np.ndarray, amin: float = 1e-10, top_db: float = 80.0) -> np.ndarray:
+    """librosa.power_to_db with ref=np.max (per-sample max ref)."""
+    ref = np.maximum(s.max(axis=tuple(range(1, s.ndim)), keepdims=True), amin)
+    log_spec = 10.0 * np.log10(np.maximum(amin, s)) - 10.0 * np.log10(ref)
+    return np.maximum(log_spec, log_spec.max(axis=tuple(range(1, s.ndim)), keepdims=True) - top_db)
+
+
+def _audio_melspec(
+    audio: np.ndarray, n_mels: int = 120, frame_size: int = 320, hop_length: int = 160,
+    sr: int = 16000, to_db: bool = True,
+) -> np.ndarray:
+    """Reference ``dnsmos.py:122-155``: mel power spectrogram (n_fft=frame_size+1),
+    transposed to (..., frames, n_mels), optionally (power_to_db(ref=max)+40)/40."""
+    shape = audio.shape
+    x = audio.reshape(-1, shape[-1]).astype(np.float64)
+    n_fft = frame_size + 1
+    power = _stft_power(x, n_fft, hop_length)  # (B, bins, frames)
+    mel = mel_filterbank(sr, n_fft, n_mels) @ power  # (n_mels, bins) @ (B, bins, F) -> (B, n_mels, F)
+    mel = mel.transpose(0, 2, 1)  # (B, frames, n_mels)
+    if to_db:
+        mel = (_power_to_db(mel) + 40) / 40
+    return mel.reshape(shape[:-1] + mel.shape[1:]).astype(np.float32)
+
+
+# ---- ONNX sessions ---------------------------------------------------------------
+
+_SESSION_CACHE: dict = {}
+
+
+def _load_session(path: str, num_threads: Optional[int] = None, cache_session: bool = True):
+    path = os.path.expanduser(path)
+    key = (path, num_threads)
+    if cache_session and key in _SESSION_CACHE:
+        return _SESSION_CACHE[key]
+    if not os.path.exists(path):
+        raise ModuleNotFoundError(
+            f"DNSMOS model file {path!r} not found and this environment has no network "
+            "egress to download it. Fetch the DNS-Challenge ONNX models offline into "
+            f"{DNSMOS_DIR}, or pass `infer_fns=(p808_fn, sig_bak_ovr_fn)`."
+        )
+    import onnxruntime as ort
+
+    opts = ort.SessionOptions()
+    if num_threads is not None:
+        opts.inter_op_num_threads = num_threads
+        opts.intra_op_num_threads = num_threads
+    sess = ort.InferenceSession(path, providers=["CPUExecutionProvider"], sess_options=opts)
+    run = lambda features: sess.run(None, {"input_1": features})[0]
+    if cache_session:
+        _SESSION_CACHE[key] = run
+    return run
+
+
+def _polyfit_val(mos: np.ndarray, personalized: bool) -> np.ndarray:
+    """Raw model outputs -> calibrated MOS, published DNSMOS polynomial fits
+    (reference ``dnsmos.py:158-181``)."""
+    if personalized:
+        p_ovr = np.polynomial.polynomial.Polynomial([-0.11236046, 1.18058466, 0.005101, -0.00533021])
+        p_sig = np.polynomial.polynomial.Polynomial([-0.24348726, 1.19576786, 0.02751166, -0.01019296])
+        p_bak = np.polynomial.polynomial.Polynomial([0.96883132, -0.1644611, 0.44276479, -0.04976499])
+    else:
+        p_ovr = np.polynomial.polynomial.Polynomial([0.04602535, 1.11546468, -0.06766283])
+        p_sig = np.polynomial.polynomial.Polynomial([0.0052439, 1.22083953, -0.08397278])
+        p_bak = np.polynomial.polynomial.Polynomial([-0.39604546, 1.60915514, -0.13166888])
+    mos = mos.copy()
+    mos[..., 1] = p_sig(mos[..., 1])
+    mos[..., 2] = p_bak(mos[..., 2])
+    mos[..., 3] = p_ovr(mos[..., 3])
+    return mos
+
+
+def deep_noise_suppression_mean_opinion_score(
+    preds,
+    fs: int,
+    personalized: bool,
+    device: Optional[str] = None,
+    num_threads: Optional[int] = None,
+    cache_session: bool = True,
+    infer_fns: Optional[Tuple[Callable, Callable]] = None,
+) -> jnp.ndarray:
+    """DNSMOS values ``[..., 4]`` = [p808_mos, mos_sig, mos_bak, mos_ovr]
+    (reference ``dnsmos.py:184-291``).
+
+    ``infer_fns=(p808_fn, sig_bak_ovr_fn)`` bypasses onnxruntime: each callable
+    maps the model's input features to its raw scores (p808: melspec
+    ``(B, frames, 120)`` -> ``(B, 1)``; sig_bak_ovr: raw audio ``(B, T)`` ->
+    ``(B, 3)``).
+    """
+    if infer_fns is not None:
+        p808_run, sbo_run = infer_fns
+    else:
+        if not _ONNXRUNTIME_AVAILABLE:
+            raise ModuleNotFoundError(
+                "DNSMOS metric requires that onnxruntime is installed."
+                " Install as `pip install onnxruntime`, or pass `infer_fns`."
+            )
+        sbo_run = _load_session(
+            f"{DNSMOS_DIR}/{'p' if personalized else ''}DNSMOS/sig_bak_ovr.onnx", num_threads, cache_session
+        )
+        p808_run = _load_session(f"{DNSMOS_DIR}/DNSMOS/model_v8.onnx", num_threads, cache_session)
+
+    audio = np.asarray(preds, np.float32)
+    if fs != SAMPLING_RATE:
+        from scipy.signal import resample_poly
+
+        g = np.gcd(int(fs), SAMPLING_RATE)
+        audio = resample_poly(audio.astype(np.float64), SAMPLING_RATE // g, int(fs) // g, axis=-1).astype(np.float32)
+    len_samples = int(INPUT_LENGTH * SAMPLING_RATE)
+    while audio.shape[-1] < len_samples:
+        audio = np.concatenate([audio, audio], axis=-1)
+    num_hops = int(np.floor(audio.shape[-1] / SAMPLING_RATE) - INPUT_LENGTH) + 1
+
+    moss = []
+    for idx in range(num_hops):
+        seg = audio[..., int(idx * SAMPLING_RATE) : int((idx + INPUT_LENGTH) * SAMPLING_RATE)]
+        if seg.shape[-1] < len_samples:
+            continue
+        shape = seg.shape
+        seg = seg.reshape(-1, shape[-1])
+        raw = np.asarray(p808_run(_audio_melspec(seg[..., :-160]).astype(np.float32)))
+        sbo = np.asarray(sbo_run(seg.astype(np.float32)))
+        mos = np.concatenate([raw, sbo], axis=-1).astype(np.float64)
+        mos = _polyfit_val(mos, personalized)
+        moss.append(mos.reshape(*shape[:-1], 4))
+    return jnp.asarray(np.mean(np.stack(moss, axis=-1), axis=-1))

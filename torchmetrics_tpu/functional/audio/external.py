@@ -85,33 +85,11 @@ def short_time_objective_intelligibility(preds, target, fs: int, extended: bool 
     return jnp.asarray(scores, jnp.float32)
 
 
-def speech_reverberation_modulation_energy_ratio(preds, fs: int, **kwargs: Any) -> jnp.ndarray:
-    """SRMR — requires the optional ``gammatone`` + ``torchaudio`` wheels."""
-    if not (_GAMMATONE_AVAILABLE and _TORCHAUDIO_AVAILABLE):
-        raise ModuleNotFoundError(
-            "speech_reverberation_modulation_energy_ratio requires that gammatone and torchaudio are installed."
-            " Either install as `pip install torchmetrics[audio]` or "
-            "`pip install torchaudio` and `pip install git+https://github.com/detly/gammatone`."
-        )
-    raise NotImplementedError(
-        "SRMR is recognized but its gammatone-filterbank pipeline is not yet ported; "
-        "the wheels alone do not enable it. Track SURVEY.md §2.8 for the host-callback port."
-    )
-
-
-def deep_noise_suppression_mean_opinion_score(
-    preds, fs: int, personalized: bool, device: Optional[str] = None, num_threads: Optional[int] = None
-) -> jnp.ndarray:
-    """DNSMOS — requires ``librosa`` + ``onnxruntime`` + downloaded ONNX models."""
-    if not (_LIBROSA_AVAILABLE and _ONNXRUNTIME_AVAILABLE and _REQUESTS_AVAILABLE):
-        raise ModuleNotFoundError(
-            "DNSMOS metric requires that librosa, onnxruntime and requests are installed."
-            " Install as `pip install librosa onnxruntime-gpu requests`."
-        )
-    raise NotImplementedError(
-        "DNSMOS is recognized but its ONNX-model inference pipeline is not yet ported; "
-        "the wheels alone do not enable it (the models also require a download)."
-    )
+# SRMR and DNSMOS are real in-tree pipelines (./srmr.py, ./dnsmos.py) — unlike the
+# reference, SRMR needs no wheels at all, and DNSMOS needs only onnxruntime + the
+# model files (its librosa melspec is reimplemented in numpy).
+from .dnsmos import deep_noise_suppression_mean_opinion_score  # noqa: F401,E402
+from .srmr import speech_reverberation_modulation_energy_ratio  # noqa: F401,E402
 
 
 def non_intrusive_speech_quality_assessment(preds, fs: int) -> jnp.ndarray:

@@ -1,17 +1,100 @@
 """ARNIQA — no-reference image quality (reference ``functional/image/arniqa.py``).
 
-ARNIQA regresses quality from a pretrained ResNet-50 encoder fine-tuned on quality
-datasets; both the encoder and the regressor head are downloaded weights, which an
-air-gapped environment cannot fetch. The surface gates with a clear error; a custom
-scorer callable is accepted for parity with the pluggable-embedder convention used by
-the other model-backed metrics.
+The full model is in-tree: a jnp ResNet-50 encoder (``image/_resnet.py``) applied
+to the image and its antialias-bilinear half-scale version, L2-normalized features
+concatenated and fed to a linear regressor, score rescaled to [0, 1] by the
+regressor dataset's MOS range (reference ``_ARNIQA.forward``,
+``functional/image/arniqa.py:131-150``). Only the *trained weights* are external:
+they are loaded from the torch-hub cache layout the reference downloads into
+(``~/.cache/torch/hub/checkpoints/ARNIQA.pth`` + ``regressor_<dataset>.pth``), or
+passed directly via ``encoder_weights`` / ``regressor_weights``; with neither
+available the call gates with a clear error. A custom ``scorer`` callable
+bypasses the model entirely (the pluggable-embedder convention shared with the
+other model-backed metrics).
 """
 
 from __future__ import annotations
 
-from typing import Callable, Optional
+import os
+from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax.numpy as jnp
+import numpy as np
+
+_REGRESSOR_DATASETS = {"kadid10k": (1.0, 5.0), "koniq10k": (1.0, 100.0)}
+_IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], np.float32)
+_IMAGENET_STD = np.array([0.229, 0.224, 0.225], np.float32)
+
+
+def _hub_checkpoint(name: str) -> Optional[str]:
+    base = os.path.expanduser(os.environ.get("TORCH_HOME", "~/.cache/torch"))
+    path = os.path.join(base, "hub", "checkpoints", name)
+    return path if os.path.exists(path) else None
+
+
+def _load_arniqa_params(
+    regressor_dataset: str,
+    encoder_weights: Optional[Any],
+    regressor_weights: Optional[Any],
+) -> Tuple[Dict, jnp.ndarray, jnp.ndarray]:
+    from ...image._resnet import convert_resnet50_state_dict
+
+    def _to_state_dict(source: Any, default_name: str) -> Optional[Dict]:
+        if source is None:
+            source = _hub_checkpoint(default_name)
+            if source is None:
+                return None
+        if isinstance(source, (str, os.PathLike)):
+            import torch
+
+            source = torch.load(source, map_location="cpu", weights_only=False)
+        if hasattr(source, "state_dict"):
+            source = source.state_dict()
+        return {k: np.asarray(v) for k, v in dict(source).items()}
+
+    enc_sd = _to_state_dict(encoder_weights, "ARNIQA.pth")
+    reg_sd = _to_state_dict(regressor_weights, f"regressor_{regressor_dataset}.pth")
+    if enc_sd is None or reg_sd is None:
+        raise ModuleNotFoundError(
+            "ARNIQA's pretrained weights are not in the torch-hub cache and this "
+            "environment has no network egress to download them. Fetch ARNIQA.pth and "
+            f"regressor_{regressor_dataset}.pth offline into ~/.cache/torch/hub/checkpoints, "
+            "pass `encoder_weights`/`regressor_weights`, or pass a custom `scorer` callable."
+        )
+    # published checkpoint: keys prefixed "model.", SimCLR projector dropped
+    enc_sd = {k.replace("model.", ""): v for k, v in enc_sd.items() if "projector" not in k}
+    params = convert_resnet50_state_dict(enc_sd)
+    w = jnp.asarray(reg_sd.get("weight", reg_sd.get("weights"))).reshape(1, -1)
+    b = jnp.asarray(reg_sd.get("bias", reg_sd.get("biases"))).reshape(1)
+    return params, w, b
+
+
+def _arniqa_forward(
+    img: jnp.ndarray,
+    params: Dict,
+    w: jnp.ndarray,
+    b: jnp.ndarray,
+    regressor_dataset: str,
+    normalize: bool,
+) -> jnp.ndarray:
+    from ...image._resnet import resnet50_features
+    from ._resize import resize_bilinear_antialias
+
+    h, width = img.shape[-2:]
+    img_ds = resize_bilinear_antialias(img, (h // 2, width // 2))
+    if normalize:
+        mean = jnp.asarray(_IMAGENET_MEAN)[None, :, None, None]
+        std = jnp.asarray(_IMAGENET_STD)[None, :, None, None]
+        img = (img - mean) / std
+        img_ds = (img_ds - mean) / std
+    f_full = resnet50_features(params, img)
+    f_half = resnet50_features(params, img_ds)
+    f_full = f_full / jnp.clip(jnp.linalg.norm(f_full, axis=1, keepdims=True), 1e-12)
+    f_half = f_half / jnp.clip(jnp.linalg.norm(f_half, axis=1, keepdims=True), 1e-12)
+    feats = jnp.concatenate([f_full, f_half], axis=1)
+    score = feats @ w.T + b
+    lo, hi = _REGRESSOR_DATASETS[regressor_dataset]
+    return ((score - lo) / (hi - lo)).reshape(-1)
 
 
 def arniqa(
@@ -21,25 +104,32 @@ def arniqa(
     normalize: bool = True,
     autocast: bool = False,
     scorer: Optional[Callable] = None,
+    encoder_weights: Optional[Any] = None,
+    regressor_weights: Optional[Any] = None,
 ) -> jnp.ndarray:
-    """ARNIQA quality score in [0, 1]. Pass ``scorer`` (``imgs -> (N,)``) to supply
-    the model; the pretrained default requires downloaded weights. ``normalize`` and
-    ``autocast`` belong to the gated pretrained pipeline (they control its input
-    rescaling and mixed precision) and do not affect a custom ``scorer``."""
+    """ARNIQA quality score in [0, 1] for ``(N, 3, H, W)`` images (NCHW, [0, 1]
+    when ``normalize=True``, else already imagenet-normalized).
+
+    ``scorer`` (``imgs -> (N,)``) bypasses the in-tree model; otherwise weights
+    resolve from ``encoder_weights``/``regressor_weights`` (path, state_dict or
+    module) or the torch-hub cache.
+    """
     if not isinstance(normalize, bool):
         raise ValueError(f"Argument `normalize` should be a bool but got {normalize}")
-    if regressor_dataset not in ("kadid10k", "koniq10k"):
+    if regressor_dataset not in _REGRESSOR_DATASETS:
         raise ValueError(
             f"Argument `regressor_dataset` must be one of ('kadid10k', 'koniq10k'), but got {regressor_dataset}"
         )
     if reduction not in ("mean", "sum", "none", None):
         raise ValueError(f"Argument `reduction` must be one of ('mean', 'sum', 'none', None), but got {reduction}")
-    if scorer is None:
-        raise ModuleNotFoundError(
-            "ARNIQA's pretrained ResNet-50 encoder and regressor weights cannot be downloaded in "
-            "an air-gapped environment. Pass a custom `scorer` callable (imgs -> (N,) scores)."
-        )
-    scores = jnp.asarray(scorer(jnp.asarray(img)))
+    img = jnp.asarray(img)
+    if img.ndim == 3:
+        img = img[None]
+    if scorer is not None:
+        scores = jnp.asarray(scorer(img))
+    else:
+        params, w, b = _load_arniqa_params(regressor_dataset, encoder_weights, regressor_weights)
+        scores = _arniqa_forward(img, params, w, b, regressor_dataset, normalize)
     if reduction == "mean":
         return scores.mean()
     if reduction == "sum":

@@ -92,15 +92,14 @@ def _ssim_update(
     dtype = preds.dtype
     gauss_kernel_size = [int(3.5 * s + 0.5) * 2 + 1 for s in sigma]
 
-    if gaussian_kernel:
-        pad_h = (gauss_kernel_size[0] - 1) // 2
-        pad_w = (gauss_kernel_size[1] - 1) // 2
-    else:
-        pad_h = (kernel_size[0] - 1) // 2
-        pad_w = (kernel_size[1] - 1) // 2
+    # kernel_size[i] / sigma[i] act on spatial axis i: (H, W) for NCHW inputs,
+    # (D, H, W) for NCDHW — pads, kernel dims and crops all share this mapping
+    eff_kernel = gauss_kernel_size if gaussian_kernel else kernel_size
+    pad_h = (eff_kernel[0] - 1) // 2
+    pad_w = (eff_kernel[1] - 1) // 2
 
     if is_3d:
-        pad_d = (kernel_size[2] - 1) // 2
+        pad_d, pad_h, pad_w = pad_h, pad_w, (eff_kernel[2] - 1) // 2
         preds = reflect_pad_3d(preds, pad_d, pad_h, pad_w)
         target = reflect_pad_3d(target, pad_d, pad_h, pad_w)
         kernel = (
@@ -143,7 +142,9 @@ def _ssim_update(
         # the contrast term is cropped back to the unpadded region (reference
         # ssim.py:176-181); the padded border would bias the MS-SSIM pyramid
         if is_3d:
-            contrast = contrast[..., pad_h:-pad_h, pad_w:-pad_w, pad_d:-pad_d]
+            # NCDHW: axes are (depth, height, width) — crop in the same order the
+            # padding was applied (anisotropic kernels would otherwise crop wrong axes)
+            contrast = contrast[..., pad_d:-pad_d, pad_h:-pad_h, pad_w:-pad_w]
         else:
             contrast = contrast[..., pad_h:-pad_h, pad_w:-pad_w]
         return sim, contrast.reshape(batch, -1).mean(-1)

@@ -34,10 +34,12 @@ def _gaussian_kernel_2d(channel: int, kernel_size: Sequence[int], sigma: Sequenc
 
 
 def _gaussian_kernel_3d(channel: int, kernel_size: Sequence[int], sigma: Sequence[float], dtype=jnp.float32) -> Array:
-    """Separable 3D gaussian kernel ``(channel, 1, d, h, w)``."""
-    kernel_xy = _gaussian_kernel_2d(1, kernel_size[:2], sigma[:2], dtype)[0, 0]
-    kernel_z = _gaussian(kernel_size[2], sigma[2], dtype).reshape(-1)
-    kernel = kernel_xy[None, :, :] * kernel_z[:, None, None]
+    """Separable 3D gaussian kernel ``(channel, 1, d, h, w)``: ``kernel_size[i]`` /
+    ``sigma[i]`` act on spatial axis ``i`` of NCDHW — (depth, height, width)."""
+    g_d = _gaussian(kernel_size[0], sigma[0], dtype).reshape(-1)
+    g_h = _gaussian(kernel_size[1], sigma[1], dtype).reshape(-1)
+    g_w = _gaussian(kernel_size[2], sigma[2], dtype).reshape(-1)
+    kernel = g_d[:, None, None] * g_h[None, :, None] * g_w[None, None, :]
     return jnp.broadcast_to(kernel, (channel, 1, *kernel.shape))
 
 

@@ -13,6 +13,7 @@ import re
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple, Union
 
 import jax.numpy as jnp
+import numpy as np
 
 from .helper import _as_list
 
@@ -158,54 +159,73 @@ def _flip_trace(trace: List[int]) -> List[int]:
 
 
 def _trace_to_alignment(trace: List[int]) -> Tuple[Dict[int, int], List[int], List[int]]:
-    ref_pos = hyp_pos = -1
-    ref_errors: List[int] = []
-    hyp_errors: List[int] = []
-    alignments: Dict[int, int] = {}
-    for o in trace:
-        if o == _NOTHING:
-            hyp_pos += 1
-            ref_pos += 1
-            alignments[ref_pos] = hyp_pos
-            ref_errors.append(0)
-            hyp_errors.append(0)
-        elif o == _SUB:
-            hyp_pos += 1
-            ref_pos += 1
-            alignments[ref_pos] = hyp_pos
-            ref_errors.append(1)
-            hyp_errors.append(1)
-        elif o == _INS:
-            hyp_pos += 1
-            hyp_errors.append(1)
-        elif o == _DEL:
-            ref_pos += 1
-            alignments[ref_pos] = hyp_pos
-            ref_errors.append(1)
+    """Alignment + per-side error flags from an edit trace, derived via cumulative
+    position counters: the reference side advances on match/substitute/delete, the
+    hypothesis side on match/substitute/insert; a reference position aligns to the
+    hypothesis position current when it was consumed, and a position is an "error"
+    unless its op was a match."""
+    ops = np.asarray(trace, np.int64) if trace else np.zeros(0, np.int64)
+    ref_step = ops != _INS
+    hyp_step = ops != _DEL
+    ref_pos = np.cumsum(ref_step) - 1
+    hyp_pos = np.cumsum(hyp_step) - 1
+    alignments = dict(zip(ref_pos[ref_step].tolist(), hyp_pos[ref_step].tolist()))
+    ref_errors = (ops[ref_step] != _NOTHING).astype(int).tolist()
+    hyp_errors = (ops[hyp_step] != _NOTHING).astype(int).tolist()
     return alignments, ref_errors, hyp_errors
 
 
 def _find_shifted_pairs(pred_words: List[str], target_words: List[str]) -> Iterator[Tuple[int, int, int]]:
-    for pred_start in range(len(pred_words)):
-        for target_start in range(len(target_words)):
-            if abs(target_start - pred_start) > _MAX_SHIFT_DIST:
+    """Common-run candidates ``(pred_start, target_start, 1..run_length)`` for every
+    word shared between the sequences, found through a position index of the target
+    side. Runs are capped by the Tercom shift-size/distance limits; enumeration is
+    (pred_start, target_start, length)-ascending, which the candidate-budget cutoff
+    depends on."""
+    where_in_target: Dict[str, List[int]] = {}
+    for j, word in enumerate(target_words):
+        where_in_target.setdefault(word, []).append(j)
+    for i, word in enumerate(pred_words):
+        for j in where_in_target.get(word, ()):
+            if abs(j - i) > _MAX_SHIFT_DIST:
                 continue
-            for length in range(1, _MAX_SHIFT_SIZE):
-                if pred_words[pred_start + length - 1] != target_words[target_start + length - 1]:
-                    break
-                yield pred_start, target_start, length
-                if len(pred_words) == pred_start + length or len(target_words) == target_start + length:
-                    break
+            run = 1
+            while (
+                run < _MAX_SHIFT_SIZE - 1
+                and i + run < len(pred_words)
+                and j + run < len(target_words)
+                and pred_words[i + run] == target_words[j + run]
+            ):
+                run += 1
+            for length in range(1, run + 1):
+                yield i, j, length
 
 
 def _perform_shift(words: List[str], start: int, length: int, target: int) -> List[str]:
-    if target < start:
-        return words[:target] + words[start : start + length] + words[target:start] + words[start + length :]
-    if target > start + length:
-        return words[:start] + words[start + length : target] + words[start : start + length] + words[target:]
-    return (
-        words[:start] + words[start + length : length + target] + words[start : start + length] + words[length + target :]
-    )
+    """Move ``words[start:start+length]`` so it lands at trace position ``target``:
+    remove the block, then re-insert it (insertion index shifts down by the block
+    length once the removal happens before it)."""
+    block = words[start : start + length]
+    rest = words[:start] + words[start + length :]
+    ins = target - length if target > start + length else target
+    return rest[:ins] + block + rest[ins:]
+
+
+def _candidate_insertion_points(alignments: Dict[int, int], target_start: int, length: int) -> List[int]:
+    """Hypothesis-side insertion indices for a block aimed at ``target_start``: just
+    before the aligned position of each trace slot ``target_start-1 .. target_start+
+    length-1``, stopping at the first unaligned slot. Aligned positions are
+    non-decreasing, so set-dedup equals the adjacent-dedup Tercom performs."""
+    out: List[int] = []
+    for slot in range(target_start - 1, target_start + length):
+        if slot == -1:
+            idx = 0
+        elif slot in alignments:
+            idx = alignments[slot] + 1
+        else:
+            break
+        if not out or idx != out[-1]:
+            out.append(idx)
+    return out
 
 
 def _shift_words(
@@ -215,46 +235,30 @@ def _shift_words(
 ) -> Tuple[int, List[str], int]:
     """One round of the greedy Tercom shift search; returns the best gain."""
     edit_distance, inv_trace = _levenshtein_with_trace(pred_words, target_words)
-    trace = _flip_trace(inv_trace)
-    alignments, target_errors, pred_errors = _trace_to_alignment(trace)
+    alignments, target_errors, pred_errors = _trace_to_alignment(_flip_trace(inv_trace))
+
+    def gain_of(shifted: List[str]) -> int:
+        return edit_distance - _levenshtein_with_trace(shifted, target_words)[0]
+
     best: Optional[tuple] = None
     for pred_start, target_start, length in _find_shifted_pairs(pred_words, target_words):
-        # skip shifts where the hypothesis span is already correct, where the
-        # reference span already matches, or that shift within the subsequence
-        if (
-            sum(pred_errors[pred_start : pred_start + length]) == 0
-            or sum(target_errors[target_start : target_start + length]) == 0
-            or pred_start <= alignments[target_start] < pred_start + length
-        ):
+        span_already_right = sum(pred_errors[pred_start : pred_start + length]) == 0
+        target_span_matched = sum(target_errors[target_start : target_start + length]) == 0
+        shifts_within_itself = pred_start <= alignments[target_start] < pred_start + length
+        if span_already_right or target_span_matched or shifts_within_itself:
             continue
-        prev_idx = -1
-        for offset in range(-1, length):
-            if target_start + offset == -1:
-                idx = 0
-            elif target_start + offset in alignments:
-                idx = alignments[target_start + offset] + 1
-            else:
-                break
-            if idx == prev_idx:
-                continue
-            prev_idx = idx
+        for idx in _candidate_insertion_points(alignments, target_start, length):
             shifted_words = _perform_shift(pred_words, pred_start, length, idx)
-            candidate = (
-                edit_distance - _levenshtein_with_trace(shifted_words, target_words)[0],
-                length,
-                -pred_start,
-                -idx,
-                shifted_words,
-            )
+            # ties prefer longer blocks, then earlier sources, then earlier targets
+            candidate = (gain_of(shifted_words), length, -pred_start, -idx, shifted_words)
             checked_candidates += 1
-            if not best or candidate > best:
+            if best is None or candidate > best:
                 best = candidate
         if checked_candidates >= _MAX_SHIFT_CANDIDATES:
             break
-    if not best:
+    if best is None:
         return 0, pred_words, checked_candidates
-    best_score, _, _, _, shifted_words = best
-    return best_score, shifted_words, checked_candidates
+    return best[0], best[4], checked_candidates
 
 
 def _translation_edit_rate(pred_words: List[str], target_words: List[str]) -> float:

@@ -1,0 +1,20 @@
+"""Video functional kernels (reference ``functional/video/``).
+
+Unlike the reference (which only exports VMAF when the ``vmaf_torch`` wheel is
+importable), the in-tree elementary features and model-file fusion path exist
+unconditionally — gating happens inside the function, per path.
+"""
+
+from .vmaf import (
+    VmafModel,
+    calculate_luma,
+    video_multi_method_assessment_fusion,
+    vmaf_features,
+)
+
+__all__ = [
+    "VmafModel",
+    "calculate_luma",
+    "video_multi_method_assessment_fusion",
+    "vmaf_features",
+]

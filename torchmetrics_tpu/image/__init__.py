@@ -1,6 +1,7 @@
 """Image tower — stateful metric classes (reference ``src/torchmetrics/image/``)."""
 
 from .metrics import (
+    ARNIQA,
     ErrorRelativeGlobalDimensionlessSynthesis,
     QualityWithNoReference,
     RelativeAverageSpectralError,
@@ -27,6 +28,7 @@ from .psnrb import PeakSignalNoiseRatioWithBlockedEffect
 from .ssim import MultiScaleStructuralSimilarityIndexMeasure, StructuralSimilarityIndexMeasure
 
 __all__ = [
+    "ARNIQA",
     "DeepImageStructureAndTextureSimilarity",
     "ErrorRelativeGlobalDimensionlessSynthesis",
     "FrechetInceptionDistance",

@@ -1,0 +1,97 @@
+"""ResNet-50 feature trunk in plain jnp (NCHW), for ARNIQA's encoder.
+
+Standard He et al. bottleneck architecture matching torchvision's ``resnet50``
+layer-for-layer (conv1 7x7/2 -> maxpool 3x3/2 -> layers [3,4,6,3] of expansion-4
+bottlenecks with stride on the 3x3 conv -> global average pool, BN eps 1e-5).
+``convert_resnet50_state_dict`` accepts either torchvision-style key names or the
+index-renamed keys an ``nn.Sequential``-wrapped encoder produces (the layout of
+the published ARNIQA checkpoint, reference ``functional/image/arniqa.py:95-103``).
+Architecture parity is tested against a from-scratch torch ResNet-50 with shared
+random weights in ``tests/test_arniqa.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+_LAYERS = (3, 4, 6, 3)
+
+
+def _conv(x: jnp.ndarray, w: jnp.ndarray, stride: int, pad: int) -> jnp.ndarray:
+    return lax.conv_general_dilated(
+        x, w, (stride, stride), [(pad, pad), (pad, pad)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )
+
+
+def _bn(x: jnp.ndarray, p: Dict[str, jnp.ndarray], eps: float = 1e-5) -> jnp.ndarray:
+    inv = p["weight"] / jnp.sqrt(p["running_var"] + eps)
+    return x * inv[None, :, None, None] + (p["bias"] - p["running_mean"] * inv)[None, :, None, None]
+
+
+def _maxpool_3x3_s2(x: jnp.ndarray) -> jnp.ndarray:
+    return lax.reduce_window(
+        x, -jnp.inf, lax.max, (1, 1, 3, 3), (1, 1, 2, 2), [(0, 0), (0, 0), (1, 1), (1, 1)]
+    )
+
+
+def _bottleneck(x: jnp.ndarray, p: Dict[str, Any], stride: int) -> jnp.ndarray:
+    out = jnp.maximum(_bn(_conv(x, p["conv1"], 1, 0), p["bn1"]), 0)
+    out = jnp.maximum(_bn(_conv(out, p["conv2"], stride, 1), p["bn2"]), 0)
+    out = _bn(_conv(out, p["conv3"], 1, 0), p["bn3"])
+    if "downsample_conv" in p:
+        x = _bn(_conv(x, p["downsample_conv"], stride, 0), p["downsample_bn"])
+    return jnp.maximum(out + x, 0)
+
+
+def resnet50_features(params: Dict[str, Any], x: jnp.ndarray) -> jnp.ndarray:
+    """(N, 3, H, W) -> (N, 2048) globally-average-pooled trunk features."""
+    x = jnp.maximum(_bn(_conv(x, params["conv1"], 2, 3), params["bn1"]), 0)
+    x = _maxpool_3x3_s2(x)
+    for li, blocks in enumerate(_LAYERS, start=1):
+        for bi in range(blocks):
+            stride = 2 if (li > 1 and bi == 0) else 1
+            x = _bottleneck(x, params[f"layer{li}"][bi], stride)
+    return x.mean(axis=(2, 3))
+
+
+def convert_resnet50_state_dict(sd: Dict[str, Any]) -> Dict[str, Any]:
+    """torch state_dict (torchvision names OR Sequential-indexed names) -> params."""
+    arrs = {k: np.asarray(v) for k, v in sd.items()}
+    # Sequential-wrapped encoders rename: 0->conv1, 1->bn1, 4..7->layer1..4
+    if any(k.startswith("0.") for k in arrs):
+        remap = {"0": "conv1", "1": "bn1", "4": "layer1", "5": "layer2", "6": "layer3", "7": "layer4"}
+        arrs = {
+            ".".join([remap.get(k.split(".")[0], k.split(".")[0]), *k.split(".")[1:]]): v
+            for k, v in arrs.items()
+        }
+
+    def bn(prefix: str) -> Dict[str, jnp.ndarray]:
+        return {
+            key: jnp.asarray(arrs[f"{prefix}.{key}"])
+            for key in ("weight", "bias", "running_mean", "running_var")
+        }
+
+    params: Dict[str, Any] = {"conv1": jnp.asarray(arrs["conv1.weight"]), "bn1": bn("bn1")}
+    for li, blocks in enumerate(_LAYERS, start=1):
+        layer = []
+        for bi in range(blocks):
+            pre = f"layer{li}.{bi}"
+            block = {
+                "conv1": jnp.asarray(arrs[f"{pre}.conv1.weight"]),
+                "bn1": bn(f"{pre}.bn1"),
+                "conv2": jnp.asarray(arrs[f"{pre}.conv2.weight"]),
+                "bn2": bn(f"{pre}.bn2"),
+                "conv3": jnp.asarray(arrs[f"{pre}.conv3.weight"]),
+                "bn3": bn(f"{pre}.bn3"),
+            }
+            if f"{pre}.downsample.0.weight" in arrs:
+                block["downsample_conv"] = jnp.asarray(arrs[f"{pre}.downsample.0.weight"])
+                block["downsample_bn"] = bn(f"{pre}.downsample.1")
+            layer.append(block)
+        params[f"layer{li}"] = layer
+    return params

@@ -162,12 +162,17 @@ class KernelInceptionDistance(HostMetric):
         reset_real_features: bool = True,
         normalize: bool = False,
         feature_extractor_weights_path: Optional[str] = None,
+        seed: Optional[int] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
         self.inception, self.num_features, self.used_custom_model = resolve_feature_extractor(
             feature, normalize, weights_path=feature_extractor_weights_path
         )
+        # subset sampling seed: the reference relies on torch's global RNG (users
+        # control it via torch.manual_seed); an explicit kwarg is the jax-idiomatic
+        # equivalent. None -> fresh entropy per compute, like the reference default.
+        self.seed = seed
         if not (isinstance(subsets, int) and subsets > 0):
             raise ValueError("Argument `subsets` expected to be integer larger than 0")
         self.subsets = subsets
@@ -207,7 +212,7 @@ class KernelInceptionDistance(HostMetric):
         fake_features = np.asarray(state["fake_features"], np.float64)
         if real_features.shape[0] < self.subset_size or fake_features.shape[0] < self.subset_size:
             raise ValueError("Argument `subset_size` should be smaller than the number of samples")
-        rng = np.random.default_rng()
+        rng = np.random.default_rng(self.seed)
         kid_scores = []
         for _ in range(self.subsets):
             f_real = real_features[rng.permutation(real_features.shape[0])[: self.subset_size]]
@@ -241,11 +246,13 @@ class InceptionScore(Metric):
         splits: int = 10,
         normalize: bool = False,
         feature_extractor_weights_path: Optional[str] = None,
+        seed: Optional[int] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
         if not isinstance(normalize, bool):
             raise ValueError("Argument `normalize` expected to be a bool")
+        self.seed = seed  # shuffle seed; None -> fresh entropy (reference: torch global RNG)
         self.normalize = normalize
         if feature == "logits_unbiased":
             raise ModuleNotFoundError(
@@ -274,7 +281,7 @@ class InceptionScore(Metric):
 
     def _compute(self, state) -> Tuple[jnp.ndarray, jnp.ndarray]:
         features = np.asarray(state["features"], np.float64)
-        idx = np.random.default_rng().permutation(features.shape[0])
+        idx = np.random.default_rng(self.seed).permutation(features.shape[0])
         features = features[idx]
         shifted = features - features.max(axis=1, keepdims=True)
         log_prob = shifted - np.log(np.exp(shifted).sum(axis=1, keepdims=True))

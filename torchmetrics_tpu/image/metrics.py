@@ -8,7 +8,7 @@ the pan-sharpening indices) keep cat states of raw images.
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional, Sequence
+from typing import Any, Callable, Dict, Optional, Sequence
 
 import numpy as np
 import jax.numpy as jnp
@@ -24,7 +24,7 @@ from ..functional.image.tv import _total_variation_compute, _total_variation_upd
 from ..functional.image.uqi import _uqi_compute, _uqi_update
 from ..functional.image.utils import uniform_filter
 from ..functional.image.vif import _vif_per_channel
-from ..metric import Metric
+from ..metric import HostMetric, Metric
 
 
 class UniversalImageQualityIndex(Metric):
@@ -422,3 +422,72 @@ class QualityWithNoReference(Metric):
             state["preds"], state["ms"], state["pan"], pan_lr, self.norm_order, self.window_size, self.reduction
         )
         return (1 - d_lambda) ** self.alpha * (1 - d_s) ** self.beta
+
+
+class ARNIQA(HostMetric):
+    """ARNIQA no-reference quality (reference ``image/arniqa.py:47``): in-tree jnp
+    ResNet-50 encoder + linear regressor (``functional/image/arniqa.py``); only the
+    trained weights are external (torch-hub cache, explicit arrays, or a custom
+    ``scorer``)."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound = 0.0
+    plot_upper_bound = 1.0
+
+    def __init__(
+        self,
+        regressor_dataset: str = "koniq10k",
+        reduction: str = "mean",
+        normalize: bool = True,
+        autocast: bool = False,
+        scorer: Optional[Callable] = None,
+        encoder_weights: Optional[Any] = None,
+        regressor_weights: Optional[Any] = None,
+        **kwargs: Any,
+    ) -> None:
+        from ..functional.image.arniqa import _REGRESSOR_DATASETS
+
+        super().__init__(**kwargs)
+        if regressor_dataset not in _REGRESSOR_DATASETS:
+            raise ValueError(
+                f"Argument `regressor_dataset` must be one of ('kadid10k', 'koniq10k'), but got {regressor_dataset}"
+            )
+        if reduction not in ("mean", "sum", "none"):
+            raise ValueError(f"Argument `reduction` must be one of ('mean', 'sum', 'none'), but got {reduction}")
+        if not isinstance(normalize, bool):
+            raise ValueError(f"Argument `normalize` should be a bool but got {normalize}")
+        self.regressor_dataset = regressor_dataset
+        self.reduction = reduction
+        self.normalize = normalize
+        self.scorer = scorer
+        self.encoder_weights = encoder_weights
+        self.regressor_weights = regressor_weights
+        self.add_state("sum_scores", default=np.zeros(()), dist_reduce_fx="sum")
+        self.add_state("num_scores", default=np.zeros((), np.int32), dist_reduce_fx="sum")
+        if reduction == "none":
+            # unbounded per-image state only when the caller actually wants it
+            self.add_state("scores", default=[], dist_reduce_fx="cat")
+
+    def _host_batch_state(self, img) -> Dict[str, Any]:
+        from ..functional.image.arniqa import arniqa
+
+        scores = np.asarray(
+            arniqa(
+                img, self.regressor_dataset, reduction="none", normalize=self.normalize,
+                scorer=self.scorer, encoder_weights=self.encoder_weights,
+                regressor_weights=self.regressor_weights,
+            )
+        ).reshape(-1)
+        state = {"sum_scores": scores.sum(), "num_scores": np.asarray(scores.size, np.int32)}
+        if self.reduction == "none":
+            state["scores"] = scores
+        return state
+
+    def _compute(self, state):
+        if self.reduction == "mean":
+            return jnp.asarray(state["sum_scores"]) / jnp.asarray(state["num_scores"])
+        if self.reduction == "sum":
+            return jnp.asarray(state["sum_scores"])
+        return jnp.asarray(np.asarray(state["scores"]))
