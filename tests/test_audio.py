@@ -159,7 +159,7 @@ def test_gated_audio_metrics_raise_clearly():
     # onnxruntime (melspec is in-tree) unless infer_fns are injected
     with pytest.raises(ModuleNotFoundError, match="onnxruntime"):
         tm.DeepNoiseSuppressionMeanOpinionScore(16000, False)
-    with pytest.raises(ModuleNotFoundError, match="librosa"):
+    with pytest.raises(ModuleNotFoundError, match="NISQA checkpoint"):
         tm.NonIntrusiveSpeechQualityAssessment(16000)
 
 

@@ -169,7 +169,10 @@ def test_dists_converter_parity(tmp_path):
 
     torch.manual_seed(12)
     vgg = _vgg16_features().eval()
-    dists_sd = torch.load(_REF_DISTS, map_location="cpu", weights_only=True)  # real alpha/beta
+    if os.path.exists(_REF_DISTS):
+        dists_sd = torch.load(_REF_DISTS, map_location="cpu", weights_only=True)  # real alpha/beta
+    else:
+        dists_sd = {"alpha": torch.rand(1, 1475, 1, 1) * 0.1, "beta": torch.rand(1, 1475, 1, 1) * 0.1}
     alpha, beta = dists_sd["alpha"], dists_sd["beta"]
 
     # reference stage structure: maxpools swapped for L2pool at indices 4/9/16/23

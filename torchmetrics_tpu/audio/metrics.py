@@ -335,11 +335,13 @@ class DeepNoiseSuppressionMeanOpinionScore(_HostMeanAudioMetric):
         self.fs = fs
         self.personalized = personalized
         self.num_threads = num_threads
+        self.cache_session = cache_session
         self.infer_fns = infer_fns
 
     def _score(self, preds, target=None):
         return deep_noise_suppression_mean_opinion_score(
-            preds, self.fs, self.personalized, num_threads=self.num_threads, infer_fns=self.infer_fns
+            preds, self.fs, self.personalized, num_threads=self.num_threads,
+            cache_session=self.cache_session, infer_fns=self.infer_fns,
         )
 
     def _host_batch_state(self, preds, target=None):
@@ -349,20 +351,33 @@ class DeepNoiseSuppressionMeanOpinionScore(_HostMeanAudioMetric):
 
 
 class NonIntrusiveSpeechQualityAssessment(_HostMeanAudioMetric):
-    """NISQA (reference ``audio/nisqa.py:35``) — needs librosa + model download."""
+    """NISQA (reference ``audio/nisqa.py:35``). The melspec + CNN-self-attention
+    pipeline is in-tree jnp (``functional/audio/nisqa.py``); only the published
+    ``nisqa.tar`` checkpoint remains external (reference cache location or
+    ``checkpoint_path``)."""
 
     higher_is_better = True
 
-    def __init__(self, fs: int, **kwargs: Any) -> None:
+    def __init__(self, fs: int, checkpoint_path: Optional[str] = None, **kwargs: Any) -> None:
         super().__init__(**kwargs)
-        from ..functional.audio.external import _LIBROSA_AVAILABLE, _REQUESTS_AVAILABLE
+        import os
 
-        if not (_LIBROSA_AVAILABLE and _REQUESTS_AVAILABLE):
+        from ..functional.audio.nisqa import resolve_checkpoint_path
+
+        path = resolve_checkpoint_path(checkpoint_path)
+        if not os.path.exists(path):
             raise ModuleNotFoundError(
-                "NISQA metric requires that librosa and requests are installed."
-                " Install as `pip install librosa requests`."
+                f"NISQA checkpoint {path!r} not found and this environment has no network "
+                "egress to download it. Fetch the published nisqa.tar offline or pass "
+                "`checkpoint_path=`."
             )
         self.fs = fs
+        self.checkpoint_path = checkpoint_path
 
     def _score(self, preds, target=None):
-        return non_intrusive_speech_quality_assessment(preds, self.fs)
+        return non_intrusive_speech_quality_assessment(preds, self.fs, self.checkpoint_path)
+
+    def _host_batch_state(self, preds, target=None):
+        # keep the 5 score dims [mos, noi, dis, col, loud] (reference nisqa.py:99-110)
+        score = np.asarray(self._score(preds)).reshape(-1, 5)
+        return {"score_sum": score.sum(0), "total": jnp.asarray(score.shape[0], jnp.int32)}

@@ -9,7 +9,6 @@ same clear ModuleNotFoundError the reference does.
 
 from __future__ import annotations
 
-from typing import Any, Optional
 
 import jax.numpy as jnp
 import numpy as np
@@ -18,11 +17,6 @@ from ...utilities.imports import _module_available
 
 _PESQ_AVAILABLE = _module_available("pesq")
 _PYSTOI_AVAILABLE = _module_available("pystoi")
-_GAMMATONE_AVAILABLE = _module_available("gammatone")
-_TORCHAUDIO_AVAILABLE = _module_available("torchaudio")
-_LIBROSA_AVAILABLE = _module_available("librosa")
-_ONNXRUNTIME_AVAILABLE = _module_available("onnxruntime")
-_REQUESTS_AVAILABLE = _module_available("requests")
 
 
 def perceptual_evaluation_speech_quality(
@@ -92,14 +86,7 @@ from .dnsmos import deep_noise_suppression_mean_opinion_score  # noqa: F401,E402
 from .srmr import speech_reverberation_modulation_energy_ratio  # noqa: F401,E402
 
 
-def non_intrusive_speech_quality_assessment(preds, fs: int) -> jnp.ndarray:
-    """NISQA — requires ``librosa`` + ``requests`` and the downloaded model weights."""
-    if not (_LIBROSA_AVAILABLE and _REQUESTS_AVAILABLE):
-        raise ModuleNotFoundError(
-            "NISQA metric requires that librosa and requests are installed."
-            " Install as `pip install librosa requests`."
-        )
-    raise NotImplementedError(
-        "NISQA is recognized but its model pipeline is not yet ported; the wheels alone "
-        "do not enable it (the weights also require a download)."
-    )
+# NISQA is a real in-tree pipeline (./nisqa.py) — melspec + CNN-self-attention model
+# in jnp; unlike the reference it needs neither librosa nor requests, only the
+# published nisqa.tar checkpoint.
+from .nisqa import non_intrusive_speech_quality_assessment  # noqa: F401,E402

@@ -32,12 +32,23 @@ def _hub_checkpoint(name: str) -> Optional[str]:
     return path if os.path.exists(path) else None
 
 
+_PARAM_CACHE: Dict = {}
+
+
 def _load_arniqa_params(
     regressor_dataset: str,
     encoder_weights: Optional[Any],
     regressor_weights: Optional[Any],
 ) -> Tuple[Dict, jnp.ndarray, jnp.ndarray]:
     from ...image._resnet import convert_resnet50_state_dict
+
+    # cache converted params for hashable sources (paths / default hub lookup):
+    # without it every metric update() repeats a full checkpoint load + ResNet-50
+    # conversion + device upload
+    hashable = all(w is None or isinstance(w, (str, os.PathLike)) for w in (encoder_weights, regressor_weights))
+    cache_key = (regressor_dataset, encoder_weights, regressor_weights) if hashable else None
+    if cache_key is not None and cache_key in _PARAM_CACHE:
+        return _PARAM_CACHE[cache_key]
 
     def _to_state_dict(source: Any, default_name: str) -> Optional[Dict]:
         if source is None:
@@ -66,7 +77,10 @@ def _load_arniqa_params(
     params = convert_resnet50_state_dict(enc_sd)
     w = jnp.asarray(reg_sd.get("weight", reg_sd.get("weights"))).reshape(1, -1)
     b = jnp.asarray(reg_sd.get("bias", reg_sd.get("biases"))).reshape(1)
-    return params, w, b
+    out = (params, w, b)
+    if cache_key is not None:
+        _PARAM_CACHE[cache_key] = out
+    return out
 
 
 def _arniqa_forward(
