@@ -200,7 +200,7 @@ def bench_fid() -> dict:
 
     rng = np.random.default_rng(3)
     out = {}
-    for trunk, batch in (("float32", 64), ("bfloat16", 256)):
+    for trunk, batch in (("float32", 64), ("bfloat16", 512)):
         imgs = jnp.asarray(rng.random((batch, 3, 299, 299)).astype(np.float32))
         fid = FrechetInceptionDistance(
             feature=InceptionV3Features(compute_dtype=trunk), normalize=True

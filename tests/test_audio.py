@@ -160,7 +160,8 @@ def test_gated_audio_metrics_raise_clearly():
     with pytest.raises(ModuleNotFoundError, match="onnxruntime"):
         tm.DeepNoiseSuppressionMeanOpinionScore(16000, False)
     with pytest.raises(ModuleNotFoundError, match="NISQA checkpoint"):
-        tm.NonIntrusiveSpeechQualityAssessment(16000)
+        # explicit missing path: hermetic even when the user cache has the real tar
+        tm.NonIntrusiveSpeechQualityAssessment(16000, checkpoint_path="/nonexistent/nisqa.tar")
 
 
 def test_audio_validation_errors():

@@ -131,3 +131,42 @@ def test_text_text_and_image_image_modes(tiny_clip_dir):
         model_name_or_path=tiny_clip_dir,
     )
     np.testing.assert_allclose(float(ours_ii), float(ref_ii), atol=1e-4)
+
+
+def test_clip_iqa_vs_reference_real_hf(tiny_clip_dir):
+    tm = reference_torchmetrics()
+    if tm is None:
+        pytest.skip("reference torchmetrics unavailable")
+    from torchmetrics.multimodal.clip_iqa import CLIPImageQualityAssessment as RefIQA
+
+    from torchmetrics_tpu.multimodal import CLIPImageQualityAssessment
+
+    prompts = ("quality", "brightness", ("Crisp photo.", "Fuzzy photo."))
+    ref = RefIQA(model_name_or_path=tiny_clip_dir, data_range=255.0, prompts=prompts)
+    ours = CLIPImageQualityAssessment(model_name_or_path=tiny_clip_dir, data_range=255.0, prompts=prompts)
+    rng = np.random.default_rng(3)
+    imgs = rng.integers(0, 256, (4, 3, 32, 32)).astype(np.float32)
+    ref.update(torch.as_tensor(imgs))
+    ours.update(imgs)
+    ref_out = ref.compute()
+    ours_out = ours.compute()
+    assert set(np.asarray(list(ours_out)).tolist()) == set(list(ref_out))
+    for key in ref_out:
+        np.testing.assert_allclose(float(ours_out[key]), float(ref_out[key].mean()), atol=1e-4, err_msg=key)
+
+
+def test_clip_iqa_single_prompt_scalar(tiny_clip_dir):
+    tm = reference_torchmetrics()
+    if tm is None:
+        pytest.skip("reference torchmetrics unavailable")
+    from torchmetrics.multimodal.clip_iqa import CLIPImageQualityAssessment as RefIQA
+
+    from torchmetrics_tpu.multimodal import CLIPImageQualityAssessment
+
+    ref = RefIQA(model_name_or_path=tiny_clip_dir, data_range=255.0)
+    ours = CLIPImageQualityAssessment(model_name_or_path=tiny_clip_dir, data_range=255.0)
+    rng = np.random.default_rng(4)
+    imgs = rng.integers(0, 256, (3, 3, 32, 32)).astype(np.float32)
+    ref.update(torch.as_tensor(imgs))
+    ours.update(imgs)
+    np.testing.assert_allclose(float(ours.compute()), float(ref.compute().mean()), atol=1e-4)

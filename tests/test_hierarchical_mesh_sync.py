@@ -1,0 +1,135 @@
+"""Hierarchical multi-slice sync: metric-state reduction over a 2-D (dcn, ici) mesh.
+
+SURVEY §2.12 names the TPU-native multi-slice design: psum-family reductions ride
+ICI within a slice and DCN across slices. These tests run the 8-device CPU mesh
+as 2 slices x 4 chips and verify:
+
+- single-shot reduction over BOTH axes equals the global value,
+- the hierarchical two-stage formulation (reduce over "ici", then over "dcn")
+  equals the single-shot reduction for every reduction kind,
+- the fused MetricCollection reduces correctly over the 2-D mesh.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import torchmetrics_tpu as tm
+from tests.helpers import _assert_allclose
+from torchmetrics_tpu.parallel.sync import reduce_over_axis
+
+pytestmark = pytest.mark.skipif(len(jax.devices()) < 8, reason="needs the 8-device CPU mesh")
+
+
+def _mesh():
+    return jax.make_mesh((2, 4), ("dcn", "ici"))
+
+
+@pytest.mark.parametrize("fx", ["sum", "mean", "max", "min", "cat"])
+def test_two_stage_equals_single_shot(fx):
+    mesh = _mesh()
+    rng = np.random.default_rng(0)
+    per_device = rng.random((8, 4), dtype=np.float32)
+    data = jax.device_put(
+        per_device.reshape(2, 4, 4), NamedSharding(mesh, P("dcn", "ici", None))
+    )
+
+    def one_shot(x):
+        return reduce_over_axis(x.reshape(4), fx, ("dcn", "ici"))
+
+    def hierarchical(x):
+        local = reduce_over_axis(x.reshape(4), fx, "ici")  # intra-slice (ICI)
+        return reduce_over_axis(local, fx, "dcn")  # cross-slice (DCN)
+
+    run = lambda fn: np.asarray(
+        jax.jit(
+            jax.shard_map(
+                fn, mesh=mesh, in_specs=(P("dcn", "ici", None),), out_specs=P(), check_vma=False
+            )
+        )(data)
+    )
+    single = run(one_shot)
+    if fx == "cat":
+        # gather order differs between the fused and staged formulations; the
+        # multiset of rows is the contract (reference sync also documents
+        # order-insensitivity of gathered cat states)
+        np.testing.assert_allclose(
+            np.sort(single.reshape(-1, 4), axis=0), np.sort(run(hierarchical).reshape(-1, 4), axis=0)
+        )
+        np.testing.assert_allclose(np.sort(single.reshape(-1, 4), axis=0), np.sort(per_device, axis=0))
+        return
+    staged = run(hierarchical)
+    np.testing.assert_allclose(single, staged, rtol=1e-6)
+    expected = {
+        "sum": per_device.sum(0),
+        "mean": per_device.mean(0),
+        "max": per_device.max(0),
+        "min": per_device.min(0),
+    }[fx]
+    np.testing.assert_allclose(single, expected, rtol=1e-6)
+
+
+def test_metric_state_reduction_over_2d_mesh():
+    """A real metric's reduce_state over both axes == single-device total."""
+    mesh = _mesh()
+    rng = np.random.default_rng(1)
+    preds = rng.normal(size=(64, 5)).astype(np.float32)
+    target = rng.integers(0, 5, 64).astype(np.int32)
+
+    metric = tm.MulticlassAccuracy(5, average="micro", validate_args=False)
+
+    def shard_fn(p, t):
+        state = metric.update_state(metric.init_state(), p, t)
+        state = metric.reduce_state(state, ("dcn", "ici"))
+        return state
+
+    fn = jax.jit(
+        jax.shard_map(
+            shard_fn, mesh=mesh,
+            in_specs=(P(("dcn", "ici")), P(("dcn", "ici"))),
+            out_specs=P(), check_vma=False,
+        )
+    )
+    synced = fn(jnp.asarray(preds), jnp.asarray(target))
+    value = metric.compute_state(synced)
+
+    single = tm.MulticlassAccuracy(5, average="micro", validate_args=False)
+    single.update(jnp.asarray(preds), jnp.asarray(target))
+    _assert_allclose(value, single.compute())
+
+
+def test_fused_collection_over_2d_mesh():
+    mesh = _mesh()
+    rng = np.random.default_rng(2)
+    probs = jax.nn.softmax(jnp.asarray(rng.normal(size=(64, 10)).astype(np.float32)))
+    target = jnp.asarray(rng.integers(0, 10, 64).astype(np.int32))
+
+    collection = tm.MetricCollection({
+        "acc": tm.classification.MulticlassAccuracy(10, average="micro", validate_args=False),
+        "confmat": tm.classification.MulticlassConfusionMatrix(10, validate_args=False),
+    })
+    pure = collection.as_pure()
+
+    def shard_fn(p, t):
+        states = pure.update(pure.init(), p, t)
+        return pure.reduce(states, ("dcn", "ici"))
+
+    fn = jax.jit(
+        jax.shard_map(
+            shard_fn, mesh=mesh,
+            in_specs=(P(("dcn", "ici")), P(("dcn", "ici"))),
+            out_specs=P(), check_vma=False,
+        )
+    )
+    values = jax.jit(pure.compute)(fn(probs, target))
+
+    ref = tm.MetricCollection({
+        "acc": tm.classification.MulticlassAccuracy(10, average="micro", validate_args=False),
+        "confmat": tm.classification.MulticlassConfusionMatrix(10, validate_args=False),
+    })
+    ref.update(probs, target)
+    _assert_allclose(values, ref.compute())

@@ -74,7 +74,7 @@ def test_clip_iqa_machinery():
     m = tm.CLIPImageQualityAssessment(model_name_or_path=ToyClip(), prompts=("quality", ("Warm photo.", "Cold photo.")))
     m.update(jnp.asarray(_RNG.random((2, 3, 4, 4)).astype(np.float32)))
     out = m.compute()
-    assert set(out) == {"quality", "user_defined_1"}
+    assert set(out) == {"quality", "user_defined_0"}  # reference numbers user prompts among themselves
     assert all(0.0 <= float(v) <= 1.0 for v in out.values())
     with pytest.raises(ModuleNotFoundError, match="clip_iqa"):
         tm.CLIPImageQualityAssessment()

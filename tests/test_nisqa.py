@@ -83,7 +83,7 @@ def test_model_parity_vs_reference_torch(toy_checkpoint):
     with torch.no_grad():
         want = model(torch.as_tensor(segments), torch.tensor([n_wins] * b)).numpy()
     params = convert_nisqa_state_dict(model.state_dict(), TOY_ARGS)
-    got = np.asarray(nisqa_forward(params, TOY_ARGS, segments, n_wins))
+    got = np.asarray(nisqa_forward(params, segments, n_wins, args=TOY_ARGS))
     np.testing.assert_allclose(got, want, atol=1e-4)
 
 

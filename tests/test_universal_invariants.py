@@ -1,0 +1,414 @@
+"""Universal metric-class invariants, swept across the whole tower surface.
+
+The reference's ``_class_test`` (testers.py:142-324) checks a set of structural
+invariants for every metric; round-2 coverage sampled them per-domain. This
+battery runs the full set over ~80 metric classes through one registry of
+(constructor, batch generator) cases:
+
+1. ``compute`` is idempotent (two calls, same value) and matches update+compute
+   replayed on a fresh instance,
+2. ``clone()`` is independent (updating the clone does not disturb the parent),
+3. pickling mid-accumulation preserves state,
+4. ``merge_state`` over two shards equals one-shot accumulation,
+5. ``reset()`` restores defaults (fresh compute on batch 0 matches a new metric),
+6. ``state_dict``/``load_state_dict`` round-trips persistent state.
+
+Model-backed metrics (weights/external artifacts) and wrappers (covered by their
+own test files) are out of scope here.
+"""
+
+from __future__ import annotations
+
+import pickle
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import torchmetrics_tpu as tm
+from tests.helpers import _assert_allclose
+
+_RNG = np.random.default_rng(77)
+N, C, L = 24, 5, 4
+
+
+def _j(x):
+    return jnp.asarray(x)
+
+
+# ---- input generators (one fresh batch per call) --------------------------------
+
+def binary():
+    return _j(_RNG.random(N, dtype=np.float32)), _j(_RNG.integers(0, 2, N).astype(np.int32))
+
+
+def multiclass():
+    return (
+        _j(_RNG.normal(size=(N, C)).astype(np.float32)),
+        _j(_RNG.integers(0, C, N).astype(np.int32)),
+    )
+
+
+def multilabel():
+    return (
+        _j(_RNG.random((N, L), dtype=np.float32)),
+        _j(_RNG.integers(0, 2, (N, L)).astype(np.int32)),
+    )
+
+
+def reg():
+    return _j(_RNG.random(N, dtype=np.float32)), _j(_RNG.random(N, dtype=np.float32) + 0.1)
+
+
+def reg_pos():
+    return _j(_RNG.random(N, dtype=np.float32) + 0.5), _j(_RNG.random(N, dtype=np.float32) + 0.5)
+
+
+def dist():  # probability rows
+    p = _RNG.random((N, C), dtype=np.float32) + 0.05
+    q = _RNG.random((N, C), dtype=np.float32) + 0.05
+    return _j(p / p.sum(1, keepdims=True)), _j(q / q.sum(1, keepdims=True))
+
+
+def audio():
+    return (
+        _j(_RNG.normal(size=(4, 256)).astype(np.float32)),
+        _j(_RNG.normal(size=(4, 256)).astype(np.float32)),
+    )
+
+
+def image():
+    return (
+        _j(_RNG.random((2, 3, 16, 16), dtype=np.float32)),
+        _j(_RNG.random((2, 3, 16, 16), dtype=np.float32)),
+    )
+
+
+def image_big():
+    return (
+        _j(_RNG.random((2, 3, 48, 48), dtype=np.float32)),
+        _j(_RNG.random((2, 3, 48, 48), dtype=np.float32)),
+    )
+
+
+def labels_pair():
+    return _j(_RNG.integers(0, 4, N).astype(np.int32)), _j(_RNG.integers(0, 4, N).astype(np.int32))
+
+
+def intrinsic():
+    return _j(_RNG.normal(size=(N, 3)).astype(np.float32)), _j(_RNG.integers(0, 3, N).astype(np.int32))
+
+
+def retrieval():
+    return (
+        _j(_RNG.random(N, dtype=np.float32)),
+        _j(_RNG.integers(0, 2, N).astype(np.int32)),
+        _j(np.sort(_RNG.integers(0, 4, N)).astype(np.int32)),
+    )
+
+
+def texts():
+    vocab = ["alpha", "beta", "gamma", "delta", "epsilon"]
+    preds = [" ".join(_RNG.choice(vocab, 5)) for _ in range(4)]
+    target = [[" ".join(_RNG.choice(vocab, 5))] for _ in range(4)]
+    return preds, target
+
+
+def texts_flat():
+    preds, target = texts()
+    return preds, [t[0] for t in target]
+
+
+def perplexity():
+    return (
+        _j(_RNG.normal(size=(4, 6, C)).astype(np.float32)),
+        _j(_RNG.integers(0, C, (4, 6)).astype(np.int32)),
+    )
+
+
+def segmentation():
+    return (
+        _j(_RNG.integers(0, 3, (2, 3, 8, 8)).astype(np.int32)),
+        _j(_RNG.integers(0, 2, (2, 3, 8, 8)).astype(np.int32)),
+    )
+
+
+def seg_labels():
+    return (
+        _j(_RNG.integers(0, 3, (2, 8, 8)).astype(np.int32)),
+        _j(_RNG.integers(0, 3, (2, 8, 8)).astype(np.int32)),
+    )
+
+
+def boxes():
+    def make(n):
+        xy = _RNG.uniform(0, 50, (n, 2))
+        wh = _RNG.uniform(5, 30, (n, 2))
+        return np.concatenate([xy, xy + wh], -1).astype(np.float32)
+
+    preds = [{"boxes": _j(make(3)), "scores": _j(_RNG.random(3, dtype=np.float32)),
+              "labels": _j(_RNG.integers(0, 2, 3).astype(np.int32))}]
+    target = [{"boxes": _j(make(2)), "labels": _j(_RNG.integers(0, 2, 2).astype(np.int32))}]
+    return preds, target
+
+
+def agg_value():
+    return (_j(_RNG.random(N, dtype=np.float32)),)
+
+
+def procrustes():
+    return (
+        _j(_RNG.normal(size=(2, 10, 3)).astype(np.float32)),
+        _j(_RNG.normal(size=(2, 10, 3)).astype(np.float32)),
+    )
+
+
+# ---- the registry ----------------------------------------------------------------
+
+CASES = {
+    # classification: binary
+    "BinaryAccuracy": (lambda: tm.BinaryAccuracy(), binary),
+    "BinaryPrecision": (lambda: tm.BinaryPrecision(), binary),
+    "BinaryRecall": (lambda: tm.BinaryRecall(), binary),
+    "BinaryF1Score": (lambda: tm.BinaryF1Score(), binary),
+    "BinaryFBetaScore": (lambda: tm.BinaryFBetaScore(beta=0.5), binary),
+    "BinarySpecificity": (lambda: tm.BinarySpecificity(), binary),
+    "BinaryStatScores": (lambda: tm.BinaryStatScores(), binary),
+    "BinaryHammingDistance": (lambda: tm.BinaryHammingDistance(), binary),
+    "BinaryNegativePredictiveValue": (lambda: tm.BinaryNegativePredictiveValue(), binary),
+    "BinaryCohenKappa": (lambda: tm.BinaryCohenKappa(), binary),
+    "BinaryMatthewsCorrCoef": (lambda: tm.BinaryMatthewsCorrCoef(), binary),
+    "BinaryJaccardIndex": (lambda: tm.BinaryJaccardIndex(), binary),
+    "BinaryConfusionMatrix": (lambda: tm.BinaryConfusionMatrix(), binary),
+    "BinaryAUROC": (lambda: tm.BinaryAUROC(thresholds=16), binary),
+    "BinaryAveragePrecision": (lambda: tm.BinaryAveragePrecision(thresholds=16), binary),
+    "BinaryROC": (lambda: tm.BinaryROC(thresholds=16), binary),
+    "BinaryPrecisionRecallCurve": (lambda: tm.BinaryPrecisionRecallCurve(thresholds=16), binary),
+    "BinaryCalibrationError": (lambda: tm.BinaryCalibrationError(), binary),
+    "BinaryEER": (lambda: tm.BinaryEER(thresholds=16), binary),
+    "BinaryLogAUC": (lambda: tm.BinaryLogAUC(thresholds=16), binary),
+    "BinaryHingeLoss": (lambda: tm.BinaryHingeLoss(), binary),
+    # classification: multiclass
+    "MulticlassAccuracy": (lambda: tm.MulticlassAccuracy(C), multiclass),
+    "MulticlassPrecision": (lambda: tm.MulticlassPrecision(C), multiclass),
+    "MulticlassRecall": (lambda: tm.MulticlassRecall(C), multiclass),
+    "MulticlassF1Score": (lambda: tm.MulticlassF1Score(C), multiclass),
+    "MulticlassSpecificity": (lambda: tm.MulticlassSpecificity(C), multiclass),
+    "MulticlassStatScores": (lambda: tm.MulticlassStatScores(C), multiclass),
+    "MulticlassConfusionMatrix": (lambda: tm.MulticlassConfusionMatrix(C), multiclass),
+    "MulticlassCohenKappa": (lambda: tm.MulticlassCohenKappa(C), multiclass),
+    "MulticlassMatthewsCorrCoef": (lambda: tm.MulticlassMatthewsCorrCoef(C), multiclass),
+    "MulticlassJaccardIndex": (lambda: tm.MulticlassJaccardIndex(C), multiclass),
+    "MulticlassAUROC": (lambda: tm.MulticlassAUROC(C, thresholds=16), multiclass),
+    "MulticlassAveragePrecision": (lambda: tm.MulticlassAveragePrecision(C, thresholds=16), multiclass),
+    "MulticlassROC": (lambda: tm.MulticlassROC(C, thresholds=16), multiclass),
+    "MulticlassCalibrationError": (lambda: tm.MulticlassCalibrationError(C), multiclass),
+    "MulticlassExactMatch": (lambda: tm.MulticlassExactMatch(C), multiclass),
+    "MulticlassHingeLoss": (lambda: tm.MulticlassHingeLoss(C), multiclass),
+    # classification: multilabel
+    "MultilabelAccuracy": (lambda: tm.MultilabelAccuracy(L), multilabel),
+    "MultilabelF1Score": (lambda: tm.MultilabelF1Score(L), multilabel),
+    "MultilabelConfusionMatrix": (lambda: tm.MultilabelConfusionMatrix(L), multilabel),
+    "MultilabelAUROC": (lambda: tm.MultilabelAUROC(L, thresholds=16), multilabel),
+    "MultilabelExactMatch": (lambda: tm.MultilabelExactMatch(L), multilabel),
+    "MultilabelRankingAveragePrecision": (lambda: tm.MultilabelRankingAveragePrecision(L), multilabel),
+    "MultilabelRankingLoss": (lambda: tm.MultilabelRankingLoss(L), multilabel),
+    "MultilabelCoverageError": (lambda: tm.MultilabelCoverageError(L), multilabel),
+    # regression
+    "MeanSquaredError": (lambda: tm.MeanSquaredError(), reg),
+    "MeanAbsoluteError": (lambda: tm.MeanAbsoluteError(), reg),
+    "MeanSquaredLogError": (lambda: tm.MeanSquaredLogError(), reg_pos),
+    "MeanAbsolutePercentageError": (lambda: tm.MeanAbsolutePercentageError(), reg_pos),
+    "SymmetricMeanAbsolutePercentageError": (lambda: tm.SymmetricMeanAbsolutePercentageError(), reg_pos),
+    "WeightedMeanAbsolutePercentageError": (lambda: tm.WeightedMeanAbsolutePercentageError(), reg_pos),
+    "ExplainedVariance": (lambda: tm.ExplainedVariance(), reg),
+    "R2Score": (lambda: tm.R2Score(), reg),
+    "PearsonCorrCoef": (lambda: tm.PearsonCorrCoef(), reg),
+    "SpearmanCorrCoef": (lambda: tm.SpearmanCorrCoef(), reg),
+    "KendallRankCorrCoef": (lambda: tm.KendallRankCorrCoef(), reg),
+    "ConcordanceCorrCoef": (lambda: tm.ConcordanceCorrCoef(), reg),
+    "CosineSimilarity": (lambda: tm.CosineSimilarity(), lambda: (
+        _j(_RNG.random((N, 3), dtype=np.float32)), _j(_RNG.random((N, 3), dtype=np.float32)))),
+    "MinkowskiDistance": (lambda: tm.MinkowskiDistance(p=3), reg),
+    "LogCoshError": (lambda: tm.LogCoshError(), reg),
+    "TweedieDevianceScore": (lambda: tm.TweedieDevianceScore(), reg_pos),
+    "RelativeSquaredError": (lambda: tm.RelativeSquaredError(), reg),
+    "NormalizedRootMeanSquaredError": (lambda: tm.NormalizedRootMeanSquaredError(), reg),
+    "CriticalSuccessIndex": (lambda: tm.CriticalSuccessIndex(0.5), reg),
+    "KLDivergence": (lambda: tm.KLDivergence(), dist),
+    "JensenShannonDivergence": (lambda: tm.JensenShannonDivergence(), dist),
+    "ContinuousRankedProbabilityScore": (lambda: tm.ContinuousRankedProbabilityScore(), lambda: (
+        _j(_RNG.normal(size=(N, 6)).astype(np.float32)), _j(_RNG.normal(size=N).astype(np.float32)))),
+    # aggregation
+    "MeanMetric": (lambda: tm.MeanMetric(), agg_value),
+    "SumMetric": (lambda: tm.SumMetric(), agg_value),
+    "MaxMetric": (lambda: tm.MaxMetric(), agg_value),
+    "MinMetric": (lambda: tm.MinMetric(), agg_value),
+    "CatMetric": (lambda: tm.CatMetric(), agg_value),
+    # audio
+    "SignalNoiseRatio": (lambda: tm.SignalNoiseRatio(), audio),
+    "ScaleInvariantSignalNoiseRatio": (lambda: tm.ScaleInvariantSignalNoiseRatio(), audio),
+    "ScaleInvariantSignalDistortionRatio": (lambda: tm.ScaleInvariantSignalDistortionRatio(), audio),
+    "SourceAggregatedSignalDistortionRatio": (lambda: tm.SourceAggregatedSignalDistortionRatio(), lambda: (
+        _j(_RNG.normal(size=(2, 3, 128)).astype(np.float32)), _j(_RNG.normal(size=(2, 3, 128)).astype(np.float32)))),
+    "SignalDistortionRatio": (lambda: tm.SignalDistortionRatio(filter_length=16), audio),
+    "SpeechReverberationModulationEnergyRatio": (
+        lambda: tm.SpeechReverberationModulationEnergyRatio(8000),
+        lambda: (_j(_RNG.normal(size=(1, 4000)).astype(np.float32)),),
+    ),
+    # image (tensor-math)
+    "PeakSignalNoiseRatio": (lambda: tm.PeakSignalNoiseRatio(data_range=1.0), image),
+    "StructuralSimilarityIndexMeasure": (lambda: tm.StructuralSimilarityIndexMeasure(data_range=1.0), image_big),
+    "MultiScaleStructuralSimilarityIndexMeasure": (
+        lambda: tm.MultiScaleStructuralSimilarityIndexMeasure(data_range=1.0), lambda: (
+            _j(_RNG.random((2, 3, 180, 180), dtype=np.float32)),
+            _j(_RNG.random((2, 3, 180, 180), dtype=np.float32)))),
+    "UniversalImageQualityIndex": (lambda: tm.UniversalImageQualityIndex(), image_big),
+    "TotalVariation": (lambda: tm.TotalVariation(), lambda: (image()[0],)),
+    "SpectralAngleMapper": (lambda: tm.SpectralAngleMapper(), image),
+    "SpatialCorrelationCoefficient": (lambda: tm.SpatialCorrelationCoefficient(), image_big),
+    "ErrorRelativeGlobalDimensionlessSynthesis": (
+        lambda: tm.ErrorRelativeGlobalDimensionlessSynthesis(), image),
+    "RelativeAverageSpectralError": (lambda: tm.RelativeAverageSpectralError(), image_big),
+    "RootMeanSquaredErrorUsingSlidingWindow": (
+        lambda: tm.RootMeanSquaredErrorUsingSlidingWindow(), image_big),
+    "VisualInformationFidelity": (lambda: tm.VisualInformationFidelity(), lambda: (
+        _j(_RNG.random((2, 3, 48, 48), dtype=np.float32)), _j(_RNG.random((2, 3, 48, 48), dtype=np.float32)))),
+    "PeakSignalNoiseRatioWithBlockedEffect": (
+        lambda: tm.PeakSignalNoiseRatioWithBlockedEffect(data_range=1.0), lambda: (
+            _j(_RNG.random((2, 1, 16, 16), dtype=np.float32)),
+            _j(_RNG.random((2, 1, 16, 16), dtype=np.float32)))),
+    # text (host string metrics)
+    "BLEUScore": (lambda: tm.BLEUScore(), texts),
+    "SacreBLEUScore": (lambda: tm.SacreBLEUScore(), texts),
+    "CharErrorRate": (lambda: tm.CharErrorRate(), texts_flat),
+    "WordErrorRate": (lambda: tm.WordErrorRate(), texts_flat),
+    "MatchErrorRate": (lambda: tm.MatchErrorRate(), texts_flat),
+    "WordInfoLost": (lambda: tm.WordInfoLost(), texts_flat),
+    "WordInfoPreserved": (lambda: tm.WordInfoPreserved(), texts_flat),
+    "EditDistance": (lambda: tm.EditDistance(), texts_flat),
+    "ExtendedEditDistance": (lambda: tm.ExtendedEditDistance(), texts_flat),
+    "CHRFScore": (lambda: tm.CHRFScore(), texts),
+    "TranslationEditRate": (lambda: tm.TranslationEditRate(), texts),
+    "Perplexity": (lambda: tm.Perplexity(), perplexity),
+    # retrieval
+    "RetrievalMAP": (lambda: tm.RetrievalMAP(), retrieval),
+    "RetrievalMRR": (lambda: tm.RetrievalMRR(), retrieval),
+    "RetrievalPrecision": (lambda: tm.RetrievalPrecision(), retrieval),
+    "RetrievalRecall": (lambda: tm.RetrievalRecall(), retrieval),
+    "RetrievalHitRate": (lambda: tm.RetrievalHitRate(), retrieval),
+    "RetrievalFallOut": (lambda: tm.RetrievalFallOut(), retrieval),
+    "RetrievalNormalizedDCG": (lambda: tm.RetrievalNormalizedDCG(), retrieval),
+    "RetrievalRPrecision": (lambda: tm.RetrievalRPrecision(), retrieval),
+    "RetrievalAUROC": (lambda: tm.RetrievalAUROC(), retrieval),
+    # clustering
+    "MutualInfoScore": (lambda: tm.MutualInfoScore(), labels_pair),
+    "AdjustedMutualInfoScore": (lambda: tm.AdjustedMutualInfoScore(), labels_pair),
+    "NormalizedMutualInfoScore": (lambda: tm.NormalizedMutualInfoScore(), labels_pair),
+    "RandScore": (lambda: tm.RandScore(), labels_pair),
+    "AdjustedRandScore": (lambda: tm.AdjustedRandScore(), labels_pair),
+    "FowlkesMallowsIndex": (lambda: tm.FowlkesMallowsIndex(), labels_pair),
+    "HomogeneityScore": (lambda: tm.HomogeneityScore(), labels_pair),
+    "CompletenessScore": (lambda: tm.CompletenessScore(), labels_pair),
+    "VMeasureScore": (lambda: tm.VMeasureScore(), labels_pair),
+    "CalinskiHarabaszScore": (lambda: tm.CalinskiHarabaszScore(), intrinsic),
+    "DaviesBouldinScore": (lambda: tm.DaviesBouldinScore(), intrinsic),
+    "DunnIndex": (lambda: tm.DunnIndex(), intrinsic),
+    "ClusterAccuracy": (lambda: tm.ClusterAccuracy(num_classes=4), labels_pair),
+    # nominal
+    "CramersV": (lambda: tm.CramersV(num_classes=4), labels_pair),
+    "PearsonsContingencyCoefficient": (lambda: tm.PearsonsContingencyCoefficient(num_classes=4), labels_pair),
+    "TheilsU": (lambda: tm.TheilsU(num_classes=4), labels_pair),
+    "TschuprowsT": (lambda: tm.TschuprowsT(num_classes=4), labels_pair),
+    "FleissKappa": (lambda: tm.FleissKappa(mode="counts"), lambda: (
+        _j(_RNG.integers(0, 5, (8, 3)).astype(np.int32)),)),
+    # segmentation
+    "DiceScore": (lambda: tm.DiceScore(num_classes=3), segmentation),
+    "GeneralizedDiceScore": (lambda: tm.GeneralizedDiceScore(num_classes=3), segmentation),
+    "MeanIoU": (lambda: tm.MeanIoU(num_classes=3), segmentation),
+    "HausdorffDistance": (lambda: tm.HausdorffDistance(num_classes=3), seg_labels),
+    # detection
+    "IntersectionOverUnion": (lambda: tm.IntersectionOverUnion(), boxes),
+    "GeneralizedIntersectionOverUnion": (lambda: tm.GeneralizedIntersectionOverUnion(), boxes),
+    "DistanceIntersectionOverUnion": (lambda: tm.DistanceIntersectionOverUnion(), boxes),
+    "CompleteIntersectionOverUnion": (lambda: tm.CompleteIntersectionOverUnion(), boxes),
+    "MeanAveragePrecision": (lambda: tm.MeanAveragePrecision(), boxes),
+    # shape
+    "ProcrustesDisparity": (lambda: tm.ProcrustesDisparity(), procrustes),
+}
+
+# merge_state == one-shot does not hold where compute is order/subset dependent
+_SKIP_MERGE = {
+    "SpeechReverberationModulationEnergyRatio",  # single-update generator (one shard empty)
+}
+
+
+@pytest.fixture(scope="module")
+def batches():
+    out = {}
+    for name, (_, gen) in CASES.items():
+        rng_state = np.random.default_rng(hash(name) % 2**32)
+        global _RNG
+        keep = _RNG
+        _RNG = rng_state
+        out[name] = [gen() for _ in range(3)]
+        _RNG = keep
+    return out
+
+
+@pytest.mark.parametrize("name", list(CASES), ids=list(CASES))
+def test_universal_invariants(name, batches):
+    ctor, _ = CASES[name]
+    data = batches[name]
+
+    # 1) update+compute, idempotence
+    metric = ctor()
+    for batch in data:
+        metric.update(*batch)
+    first = metric.compute()
+    again = metric.compute()
+    _assert_allclose(again, first, atol=0, msg=f"{name}: compute not idempotent")
+
+    # 2) clone independence (clone made mid-stream diverges without disturbing parent)
+    metric2 = ctor()
+    metric2.update(*data[0])
+    clone = metric2.clone()
+    clone.update(*data[1])
+    metric2_val = metric2.compute()
+    fresh = ctor()
+    fresh.update(*data[0])
+    _assert_allclose(metric2_val, fresh.compute(), msg=f"{name}: clone update leaked into parent")
+
+    # 3) pickle mid-accumulation
+    metric3 = ctor()
+    metric3.update(*data[0])
+    metric3 = pickle.loads(pickle.dumps(metric3))
+    for batch in data[1:]:
+        metric3.update(*batch)
+    _assert_allclose(metric3.compute(), first, msg=f"{name}: pickle round-trip changed state")
+
+    # 4) merge_state over shards == one-shot
+    if name not in _SKIP_MERGE:
+        a, b = ctor(), ctor()
+        a.update(*data[0])
+        b.update(*data[1])
+        b.update(*data[2])
+        a.merge_state(b)
+        _assert_allclose(a.compute(), first, msg=f"{name}: merge_state != one-shot")
+
+    # 5) reset restores defaults
+    metric.reset()
+    metric.update(*data[0])
+    fresh0 = ctor()
+    fresh0.update(*data[0])
+    _assert_allclose(metric.compute(), fresh0.compute(), msg=f"{name}: reset did not restore defaults")
+
+    # 6) state_dict round-trip (persistence on, like reference persistent states)
+    m_src = ctor()
+    for batch in data:
+        m_src.update(*batch)
+    m_src.persistent(True)
+    sd = m_src.state_dict()
+    m_dst = ctor()
+    m_dst.load_state_dict(sd)
+    _assert_allclose(m_dst.compute(), first, msg=f"{name}: state_dict round-trip broke state")

@@ -360,17 +360,9 @@ class NonIntrusiveSpeechQualityAssessment(_HostMeanAudioMetric):
 
     def __init__(self, fs: int, checkpoint_path: Optional[str] = None, **kwargs: Any) -> None:
         super().__init__(**kwargs)
-        import os
+        from ..functional.audio.nisqa import ensure_checkpoint_exists
 
-        from ..functional.audio.nisqa import resolve_checkpoint_path
-
-        path = resolve_checkpoint_path(checkpoint_path)
-        if not os.path.exists(path):
-            raise ModuleNotFoundError(
-                f"NISQA checkpoint {path!r} not found and this environment has no network "
-                "egress to download it. Fetch the published nisqa.tar offline or pass "
-                "`checkpoint_path=`."
-            )
+        ensure_checkpoint_exists(checkpoint_path)
         self.fs = fs
         self.checkpoint_path = checkpoint_path
 

@@ -173,7 +173,7 @@ def _pool_att_ff(p: Dict[str, Any], x: jnp.ndarray, valid: jnp.ndarray) -> jnp.n
     return pooled @ p["linear3"]["w"].T + p["linear3"]["b"]
 
 
-def nisqa_forward(params: Dict[str, Any], args: Dict[str, Any], segments: jnp.ndarray, n_wins: int) -> jnp.ndarray:
+def nisqa_forward(params: Dict[str, Any], segments: jnp.ndarray, n_wins, *, args: Dict[str, Any]) -> jnp.ndarray:
     """(B, L, n_mels, seg) padded segments -> (B, 5) [mos, noi, dis, col, loud]."""
     b, length = segments.shape[:2]
     valid = jnp.arange(length)[None, :] < n_wins  # (1, L) -> broadcast over batch
@@ -244,7 +244,7 @@ def convert_nisqa_state_dict(sd: Dict[str, Any], args: Dict[str, Any]) -> Dict[s
     }
 
 
-_MODEL_CACHE: Dict[str, Tuple[Dict, Dict]] = {}
+_MODEL_CACHE: Dict[str, Tuple[Dict, Dict, Any]] = {}
 
 
 def resolve_checkpoint_path(checkpoint_path: Optional[str]) -> str:
@@ -252,23 +252,35 @@ def resolve_checkpoint_path(checkpoint_path: Optional[str]) -> str:
     return os.path.expanduser(checkpoint_path or os.path.join(NISQA_DIR, "nisqa.tar"))
 
 
-def _load_nisqa_checkpoint(checkpoint_path: Optional[str]) -> Tuple[Dict, Dict]:
+def ensure_checkpoint_exists(checkpoint_path: Optional[str]) -> str:
+    """Shared construction/load-time gate (one copy of the error text)."""
     path = resolve_checkpoint_path(checkpoint_path)
-    if path in _MODEL_CACHE:
-        return _MODEL_CACHE[path]
     if not os.path.exists(path):
         raise ModuleNotFoundError(
             f"NISQA checkpoint {path!r} not found and this environment has no network "
             "egress to download it. Fetch the published nisqa.tar offline into "
             f"{NISQA_DIR} or pass `checkpoint_path=`."
         )
+    return path
+
+
+def _load_nisqa_checkpoint(checkpoint_path: Optional[str]) -> Tuple[Dict, Dict, Any]:
+    path = ensure_checkpoint_exists(checkpoint_path)
+    if path in _MODEL_CACHE:
+        return _MODEL_CACHE[path]
+    import functools
+
     import torch
 
     ckpt = torch.load(path, map_location="cpu", weights_only=True)
     args = dict(ckpt["args"])
     params = convert_nisqa_state_dict(ckpt["model_state_dict"], args)
-    _MODEL_CACHE[path] = (params, args)
-    return params, args
+    # args drive Python-level structure (pool sizes, layer count) -> close over them
+    # and jit per checkpoint; segments shape is static (max_segments padding) and
+    # n_wins traces as a scalar, so repeated updates hit the compile cache
+    jitted = jax.jit(functools.partial(nisqa_forward, args=args))
+    _MODEL_CACHE[path] = (params, args, jitted)
+    return _MODEL_CACHE[path]
 
 
 def non_intrusive_speech_quality_assessment(
@@ -279,10 +291,10 @@ def non_intrusive_speech_quality_assessment(
     reference surface to load the published ``nisqa.tar`` from a custom location."""
     if not isinstance(fs, int) or fs <= 0:
         raise ValueError(f"Argument `fs` expected to be a positive integer, but got {fs}")
-    params, args = _load_nisqa_checkpoint(checkpoint_path)
+    params, args, jitted_forward = _load_nisqa_checkpoint(checkpoint_path)
     arr = np.asarray(preds, np.float32)
     x = arr.reshape(-1, arr.shape[-1])
     spec = _melspec_amplitude(x, fs, args)
     segments, n_wins = _segment_specs(spec, args)
-    out = nisqa_forward(params, args, jnp.asarray(segments), n_wins)
+    out = jitted_forward(params, jnp.asarray(segments), jnp.asarray(n_wins))
     return out.reshape((*arr.shape[:-1], 5))

@@ -557,11 +557,18 @@ class Metric:
         return destination
 
     def load_state_dict(self, state_dict: dict, prefix: str = "") -> None:
+        loaded = False
         for name in self._defaults:
             key = prefix + name
             if key in state_dict:
                 v = state_dict[key]
                 self._state[name] = [jnp.asarray(x) for x in v] if isinstance(v, list) else jnp.asarray(v)
+                loaded = True
+        if loaded:
+            # restored state counts as updated: compute() on a freshly-loaded metric
+            # is the checkpoint-resume path, not a user error worth warning about
+            self._update_count = max(self._update_count, 1)
+            self._computed = None
 
     def __getstate__(self) -> dict:
         d = dict(self.__dict__)

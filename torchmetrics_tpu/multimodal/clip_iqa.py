@@ -64,6 +64,7 @@ class CLIPImageQualityAssessment(HostMetric):
         self.model = _resolve_clip(model_name_or_path)
         self.prompt_names = []
         self.prompt_pairs = []
+        num_user_defined = 0
         for p in prompts:
             if isinstance(p, str):
                 if p not in _PROMPTS:
@@ -71,7 +72,9 @@ class CLIPImageQualityAssessment(HostMetric):
                 self.prompt_names.append(p)
                 self.prompt_pairs.append(_PROMPTS[p])
             elif isinstance(p, tuple) and len(p) == 2:
-                self.prompt_names.append(f"user_defined_{len(self.prompt_names)}")
+                # reference numbers user prompts among themselves (clip_iqa.py:139)
+                self.prompt_names.append(f"user_defined_{num_user_defined}")
+                num_user_defined += 1
                 self.prompt_pairs.append(p)
             else:
                 raise ValueError("Argument `prompts` must contain prompt names or (positive, negative) tuples")
