@@ -75,7 +75,9 @@ def test_clip_iqa_machinery():
     m.update(jnp.asarray(_RNG.random((2, 3, 4, 4)).astype(np.float32)))
     out = m.compute()
     assert set(out) == {"quality", "user_defined_0"}  # reference numbers user prompts among themselves
-    assert all(0.0 <= float(v) <= 1.0 for v in out.values())
+    for v in out.values():  # per-image scores, reference shape semantics
+        arr = np.asarray(v)
+        assert arr.shape == (2,) and ((0.0 <= arr) & (arr <= 1.0)).all()
     with pytest.raises(ModuleNotFoundError, match="clip_iqa"):
         tm.CLIPImageQualityAssessment()
 

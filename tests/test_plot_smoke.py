@@ -1,0 +1,53 @@
+"""Plot subsystem smoke sweep: ``.plot()`` must produce a figure for every metric
+family (reference gives every metric a ``plot`` method, `metric.py:722-756`,
+backed by ``utilities/plot.py``)."""
+
+from __future__ import annotations
+
+import matplotlib
+
+matplotlib.use("Agg")
+
+import matplotlib.pyplot as plt
+import pytest
+
+from tests.test_universal_invariants import CASES
+
+# one representative per output shape family
+_PLOT_SAMPLE = [
+    "BinaryAccuracy",            # scalar
+    "MulticlassAccuracy",        # scalar (macro)
+    "MulticlassConfusionMatrix", # matrix -> confusion-matrix plot
+    "BinaryROC",                 # curve tuple
+    "BinaryPrecisionRecallCurve",
+    "MulticlassStatScores",      # per-class vector
+    "MeanSquaredError",
+    "PeakSignalNoiseRatio",
+    "RetrievalMAP",
+    "MutualInfoScore",
+    "CramersV",
+    "MeanMetric",
+]
+
+
+@pytest.mark.parametrize("name", _PLOT_SAMPLE)
+def test_plot_returns_figure(name):
+    ctor, gen = CASES[name]
+    metric = ctor()
+    metric.update(*gen())
+    fig, ax = metric.plot()
+    assert fig is not None and ax is not None
+    plt.close(fig)
+
+
+def test_plot_multiple_values():
+    ctor, gen = CASES["BinaryAccuracy"]
+    metric = ctor()
+    vals = []
+    for _ in range(3):
+        metric.update(*gen())
+        vals.append(metric.compute())
+        metric.reset()
+    fig, ax = metric.plot(vals)
+    assert fig is not None
+    plt.close(fig)

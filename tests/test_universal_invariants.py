@@ -2,8 +2,8 @@
 
 The reference's ``_class_test`` (testers.py:142-324) checks a set of structural
 invariants for every metric; round-2 coverage sampled them per-domain. This
-battery runs the full set over ~80 metric classes through one registry of
-(constructor, batch generator) cases:
+battery runs the full set through one registry of
+(constructor, batch generator) cases (~140 classes):
 
 1. ``compute`` is idempotent (two calls, same value) and matches update+compute
    replayed on a fresh instance,
@@ -346,8 +346,12 @@ _SKIP_MERGE = {
 @pytest.fixture(scope="module")
 def batches():
     out = {}
+    import zlib
+
     for name, (_, gen) in CASES.items():
-        rng_state = np.random.default_rng(hash(name) % 2**32)
+        # crc32, not hash(): PYTHONHASHSEED-salted hashes would make every CI run
+        # test different data, so failures could never be reproduced
+        rng_state = np.random.default_rng(zlib.crc32(name.encode()))
         global _RNG
         keep = _RNG
         _RNG = rng_state

@@ -1,6 +1,7 @@
 """Multimodal tower — stateless kernels (reference ``src/torchmetrics/functional/multimodal/``)."""
 
+from .clip_iqa import clip_image_quality_assessment
 from .clip_score import clip_score
 from .lve import lip_vertex_error
 
-__all__ = ["clip_score", "lip_vertex_error"]
+__all__ = ["clip_image_quality_assessment", "clip_score", "lip_vertex_error"]

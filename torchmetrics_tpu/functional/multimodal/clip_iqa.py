@@ -1,0 +1,43 @@
+"""One-shot functional CLIP-IQA (reference ``functional/multimodal/clip_iqa.py:220``).
+
+Unlike the class metric (which averages over accumulated images), the functional
+form returns PER-IMAGE prompt probabilities: a ``(N,)`` array for a single
+prompt, else ``{prompt_name: (N,)}``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+
+def _prompt_pair_probs(model, anchors: jnp.ndarray, images, data_range: float) -> jnp.ndarray:
+    """(N, P) probabilities that each image matches the positive prompt of each pair.
+
+    Stable two-way softmax: sigmoid of the logit difference (raw exp overflows f32
+    for |cosine| > ~0.887 at the x100 scale).
+    """
+    images = jnp.asarray(images, jnp.float32) / data_range
+    img_feats = jnp.asarray(model.get_image_features(list(images)))
+    img_feats = img_feats / jnp.linalg.norm(img_feats, axis=-1, keepdims=True)
+    logits = 100 * jnp.einsum("nd,pcd->npc", img_feats, anchors)
+    return jax.nn.sigmoid(logits[..., 0] - logits[..., 1])
+
+
+def clip_image_quality_assessment(
+    images,
+    model_name_or_path: Union[str, Any] = "clip_iqa",
+    data_range: float = 1.0,
+    prompts: Tuple[Union[str, Tuple[str, str]], ...] = ("quality",),
+) -> Union[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    from ...multimodal.clip_iqa import CLIPImageQualityAssessment
+
+    metric = CLIPImageQualityAssessment(
+        model_name_or_path=model_name_or_path, data_range=data_range, prompts=prompts
+    )
+    probs = _prompt_pair_probs(metric.model, metric._prompt_anchors(), images, metric.data_range)
+    if len(metric.prompt_names) == 1:
+        return probs[:, 0]
+    return {name: probs[:, i] for i, name in enumerate(metric.prompt_names)}

@@ -11,6 +11,7 @@ from typing import Any, Dict, Tuple, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..functional.multimodal.clip_score import _resolve_clip
 from ..metric import HostMetric
@@ -35,8 +36,10 @@ _PROMPTS: Dict[str, Tuple[str, str]] = {
 
 
 class CLIPImageQualityAssessment(HostMetric):
-    """Softmax(pos, neg) prompt-pair probabilities averaged over images. ``prompts``
-    entries are built-in names or custom (positive, negative) tuples."""
+    """Per-image softmax(pos, neg) prompt-pair probabilities (reference
+    ``multimodal/clip_iqa.py:216-221``: ``(N,)`` for one prompt, else
+    ``{prompt: (N,)}``). ``prompts`` entries are built-in names or custom
+    (positive, negative) tuples."""
 
     is_differentiable = False
     higher_is_better = True
@@ -79,8 +82,7 @@ class CLIPImageQualityAssessment(HostMetric):
             else:
                 raise ValueError("Argument `prompts` must contain prompt names or (positive, negative) tuples")
         self._anchors = None
-        self.add_state("score_sum", jnp.zeros(len(self.prompt_pairs)), dist_reduce_fx="sum")
-        self.add_state("total", jnp.zeros((), jnp.int32), dist_reduce_fx="sum")
+        self.add_state("probs_list", default=[], dist_reduce_fx="cat")
 
     def _prompt_anchors(self) -> jnp.ndarray:
         if self._anchors is None:
@@ -90,22 +92,22 @@ class CLIPImageQualityAssessment(HostMetric):
             self._anchors = feats.reshape(len(self.prompt_pairs), 2, -1)
         return self._anchors
 
+    def _per_image_probs(self, images) -> jnp.ndarray:
+        """(N, P) prompt probabilities — shared with the functional one-shot form."""
+        from ..functional.multimodal.clip_iqa import _prompt_pair_probs
+
+        return _prompt_pair_probs(self.model, self._prompt_anchors(), images, self.data_range)
+
     def _host_batch_state(self, images):
-        images = jnp.asarray(images, jnp.float32) / self.data_range
-        img_feats = jnp.asarray(self.model.get_image_features(list(images)))
-        img_feats = img_feats / jnp.linalg.norm(img_feats, axis=-1, keepdims=True)
-        anchors = self._prompt_anchors()  # (P, 2, D)
-        logits = 100 * jnp.einsum("nd,pcd->npc", img_feats, anchors)
-        # stable two-way softmax: sigmoid of the logit difference (raw exp overflows
-        # f32 for |cosine| > ~0.887 at the x100 scale)
-        probs = jax.nn.sigmoid(logits[..., 0] - logits[..., 1])  # (N, P)
-        return {"score_sum": probs.sum(axis=0), "total": jnp.asarray(images.shape[0], jnp.int32)}
+        return {"probs_list": np.asarray(self._per_image_probs(images))}
 
     def _compute(self, state):
-        avg = state["score_sum"] / state["total"]
+        # per-image scores, like the reference (multimodal/clip_iqa.py:216-221):
+        # (N,) for a single prompt, else {prompt: (N,)}
+        probs = jnp.asarray(np.asarray(state["probs_list"])).reshape(-1, len(self.prompt_names))
         if len(self.prompt_names) == 1:
-            return avg[0]
-        return {name: avg[i] for i, name in enumerate(self.prompt_names)}
+            return probs[:, 0]
+        return {name: probs[:, i] for i, name in enumerate(self.prompt_names)}
 
     def __hash__(self) -> int:
         return hash((self.__class__.__name__, id(self)))
