@@ -274,3 +274,19 @@ def test_set_dtype():
     m.set_dtype(jnp.bfloat16)
     m.update(jnp.asarray([1.0]))
     assert m.compute().dtype == jnp.bfloat16
+
+
+def test_compute_on_cpu_offloads_list_states():
+    """compute_on_cpu (reference metric.py:119) moves concat states to host after
+    each update; the default keeps them on device."""
+    import torchmetrics_tpu as tm
+
+    m = tm.CatMetric(compute_on_cpu=True)
+    m.update(jnp.asarray([1.0, 2.0]))
+    m.update(jnp.asarray([3.0]))
+    assert all(isinstance(e, np.ndarray) for e in m._state["value"])
+    np.testing.assert_allclose(np.asarray(m.compute()), [1.0, 2.0, 3.0])
+
+    on_device = tm.CatMetric()
+    on_device.update(jnp.asarray([1.0, 2.0]))
+    assert not isinstance(on_device._state["value"][0], np.ndarray)

@@ -52,9 +52,11 @@ _WORKER = textwrap.dedent(
     confmat.update(jnp.asarray(preds[lo:hi]), jnp.asarray(target[lo:hi]))
     out["confmat"] = np.asarray(confmat.compute()).tolist()
 
-    # concat state: per-process rows gathered and concatenated at compute
+    # concat state with UNEVEN per-process counts: plane-2 gathers lengths first,
+    # pads to the max and trims (reference utilities/distributed.py:130-147)
     cat = tm.CatMetric()
-    cat.update(jnp.asarray(preds[lo:hi, 0]))
+    n_take = 16 if pid == 0 else 9
+    cat.update(jnp.asarray(preds[lo : lo + n_take, 0]))
     out["cat_sorted"] = sorted(np.asarray(cat.compute()).reshape(-1).tolist())
 
     # unsync restores the local view after the synced compute
@@ -63,6 +65,10 @@ _WORKER = textwrap.dedent(
     local_only = tm.MulticlassAccuracy(5, average="micro", sync_on_compute=False)
     local_only.update(jnp.asarray(preds[lo:hi]), jnp.asarray(target[lo:hi]))
     out["acc_local"] = float(local_only.compute())
+
+    # dist_sync_on_step: forward returns the cross-PROCESS-synced value each step
+    step_synced = tm.MulticlassAccuracy(5, average="micro", dist_sync_on_step=True)
+    out["acc_step_synced"] = float(step_synced(jnp.asarray(preds[lo:hi]), jnp.asarray(target[lo:hi])))
 
     print("RESULT" + json.dumps(out))
     """
@@ -115,10 +121,12 @@ def test_two_process_cluster_sync(tmp_path):
     for pid, res in enumerate(outs):
         np.testing.assert_allclose(res["acc"], float(ref_acc.compute()), atol=1e-7, err_msg=f"proc {pid}")
         np.testing.assert_allclose(
-            np.asarray(res["confmat"]), np.asarray(ref_confmat.compute()), err_msg=f"proc {pid}"
+            res["acc_step_synced"], float(ref_acc.compute()), atol=1e-7, err_msg=f"proc {pid} dist_sync_on_step"
         )
         np.testing.assert_allclose(
-            res["cat_sorted"], sorted(preds[:, 0].tolist()), atol=1e-7, err_msg=f"proc {pid}"
+            np.asarray(res["confmat"]), np.asarray(ref_confmat.compute()), err_msg=f"proc {pid}"
         )
+        expected_cat = sorted(preds[0:16, 0].tolist() + preds[16:25, 0].tolist())
+        np.testing.assert_allclose(res["cat_sorted"], expected_cat, atol=1e-7, err_msg=f"proc {pid}")
     # per-process local values differ from the global (proves sync actually ran)
     assert outs[0]["acc_local"] != outs[1]["acc_local"] or outs[0]["acc_local"] != outs[0]["acc"]

@@ -42,7 +42,11 @@ def _reduce_average_precision(precision, recall, average: Optional[str] = "macro
         p, r = _nan_to_zero(precision), _nan_to_zero(recall)
         res = -jnp.sum((r[:, 1:] - r[:, :-1]) * p[:, :-1], axis=1)
     else:
-        res = jnp.stack([-jnp.sum((_nan_to_zero(r)[1:] - _nan_to_zero(r)[:-1]) * _nan_to_zero(p)[:-1]) for p, r in zip(precision, recall)])
+        # unbinned per-class curves: NaNs must PROPAGATE (reference
+        # average_precision.py:53-56 sums the raw curves) — a class with no
+        # positives yields a NaN AP which the macro/weighted reduction then
+        # skips, instead of diluting the average with a spurious 0
+        res = jnp.stack([-jnp.sum((r[1:] - r[:-1]) * p[:-1]) for p, r in zip(precision, recall)])
     if average is None or average == "none":
         return res
     if bool(jnp.isnan(res).any()):

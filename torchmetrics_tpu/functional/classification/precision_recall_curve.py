@@ -193,12 +193,18 @@ def _binary_precision_recall_curve_compute(
         return precision, recall, thresholds
     fps, tps, thres = _binary_clf_curve(state[0], state[1], pos_label=pos_label)
     precision = tps / (tps + fps)
-    recall = tps / tps[-1] if float(tps[-1]) > 0 else jnp.ones_like(tps)
-    if float(tps[-1]) <= 0:
+    recall = tps / tps[-1]
+    # reference quirk preserved (precision_recall_curve.py:?): the all-negative
+    # fallback tests `(target == 0).all()` LITERALLY — so for one-vs-rest class
+    # curves (pos_label != 0) a zero-positive class keeps NaN recall, which is
+    # what lets average-precision mark absent classes NaN and skip them in
+    # macro averaging
+    if bool((np.asarray(state[1]) == 0).all()):
         rank_zero_warn(
             "No positive samples found in target, recall is undefined. Setting recall to one for all thresholds.",
             UserWarning,
         )
+        recall = jnp.ones_like(recall)
     precision = jnp.concatenate([precision[::-1], jnp.ones(1, precision.dtype)])
     recall = jnp.concatenate([recall[::-1], jnp.zeros(1, recall.dtype)])
     return precision, recall, thres[::-1]

@@ -210,22 +210,31 @@ def _multiclass_stat_scores_format(
     preds = jnp.asarray(preds)
     target = jnp.asarray(target)
     n = target.shape[0]
+    target2 = target.reshape(n, -1)
+    if ignore_index is not None:
+        w = (target2 != ignore_index).astype(jnp.int32)
+        target2 = jnp.where(w == 1, target2, 0)
+    else:
+        w = jnp.ones(target2.shape, jnp.int32)
+    # clip stray labels (validated host-side when validate_args) so one_hot stays total
+    target2 = jnp.clip(target2, 0, num_classes - 1).astype(jnp.int32)
     if preds.ndim == target.ndim + 1:  # (N, C, ...) float scores
         c = preds.shape[1]
         scores = jnp.moveaxis(preds.reshape(n, c, -1), 1, -1)  # (N, S, C)
-        oh = select_topk(scores, top_k, dim=-1)
+        if top_k > 1:
+            # reference refinement (_refine_preds_oh, stat_scores.py:347): each sample
+            # predicts exactly ONE class — the target when it sits in the top-k, else
+            # the top-1 — rather than counting all k columns (which would inflate fp/tn)
+            topk_oh = select_topk(scores, top_k, dim=-1)
+            in_topk = jnp.take_along_axis(topk_oh, target2[..., None], axis=-1)[..., 0] > 0
+            refined = jnp.where(in_topk, target2, jnp.argmax(scores, axis=-1))
+            oh = jax.nn.one_hot(refined, num_classes, dtype=jnp.int32)
+        else:
+            oh = select_topk(scores, 1, dim=-1)
     else:  # (N, ...) int labels
         labels = preds.reshape(n, -1)
         oh = jax.nn.one_hot(labels, num_classes, dtype=jnp.int32)
-    target = target.reshape(n, -1)
-    if ignore_index is not None:
-        w = (target != ignore_index).astype(jnp.int32)
-        target = jnp.where(w == 1, target, 0)
-    else:
-        w = jnp.ones(target.shape, jnp.int32)
-    # clip stray labels (validated host-side when validate_args) so one_hot stays total
-    target = jnp.clip(target, 0, num_classes - 1)
-    return oh.astype(jnp.int32), target.astype(jnp.int32), w
+    return oh.astype(jnp.int32), target2, w
 
 
 def _multiclass_stat_scores_update(

@@ -39,5 +39,5 @@ def clip_image_quality_assessment(
     )
     probs = _prompt_pair_probs(metric.model, metric._prompt_anchors(), images, metric.data_range)
     if len(metric.prompt_names) == 1:
-        return probs[:, 0]
+        return probs.squeeze()  # 0-d for a single image, like the reference
     return {name: probs[:, i] for i, name in enumerate(metric.prompt_names)}

@@ -275,6 +275,12 @@ class Metric:
             self._jit_cache[key] = jax.jit(fn, donate_argnums=(0, 1)) if self._enable_jit else fn
         return self._jit_cache[key]
 
+    def _append_list_state(self, name: str, value: Any) -> None:
+        """Append one row to a concat state. compute_on_cpu (reference metric.py:119)
+        offloads it to host — list states are where memory grows, and host storage
+        frees HBM without touching the jitted tensor-state path."""
+        self._state[name].append(np.asarray(value) if self.compute_on_cpu else value)
+
     def _device_update_count(self):
         if getattr(self, "_n_prev_dev", None) is None:
             self._n_prev_dev = jnp.asarray(float(self._update_count), jnp.float32)
@@ -299,7 +305,7 @@ class Metric:
         for k, v in new_t.items():
             self._state[k] = v
         for k, v in appends.items():
-            self._state[k].append(v)
+            self._append_list_state(k, v)
         self._update_count += 1
         self._computed = None
 
@@ -354,7 +360,7 @@ class Metric:
         for k, v in new_t.items():
             self._state[k] = v
         for k, v in appends.items():
-            self._state[k].append(v)
+            self._append_list_state(k, v)
         self._update_count += 1
         self._computed = None
         self._last_batch_state = batch_full  # consumed by MetricCollection compute groups
@@ -779,7 +785,7 @@ class HostMetric(Metric):
             prev = self._state.get(k)
             self._state[k] = jnp.asarray(v).astype(prev.dtype) if hasattr(prev, "dtype") else v
         for k, v in appends.items():
-            self._state[k].append(v)
+            self._append_list_state(k, v)
         self._update_count += 1
         self._computed = None
 

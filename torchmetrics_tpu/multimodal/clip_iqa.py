@@ -104,10 +104,10 @@ class CLIPImageQualityAssessment(HostMetric):
     def _compute(self, state):
         # per-image scores, like the reference (multimodal/clip_iqa.py:216-221):
         # (N,) for a single prompt, else {prompt: (N,)}
-        probs = jnp.asarray(np.asarray(state["probs_list"])).reshape(-1, len(self.prompt_names))
+        probs = state["probs_list"].reshape(-1, len(self.prompt_names))
         if len(self.prompt_names) == 1:
-            return probs[:, 0]
-        return {name: probs[:, i] for i, name in enumerate(self.prompt_names)}
+            return jnp.asarray(probs).squeeze()  # 0-d for a single image, like the reference
+        return {name: jnp.asarray(probs[:, i]) for i, name in enumerate(self.prompt_names)}
 
     def __hash__(self) -> int:
         return hash((self.__class__.__name__, id(self)))

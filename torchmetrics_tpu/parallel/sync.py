@@ -142,15 +142,26 @@ def distributed_available() -> bool:
 def gather_all_arrays(value: Array, process_group: Any = None) -> List[Array]:
     """All-gather one array across JAX processes → list of per-process values.
 
-    Counterpart of reference ``gather_all_tensors`` (utilities/distributed.py:100).
-    Static-shape fast path only: JAX multi-controller requires equal shapes per process;
-    uneven concat-states carry an explicit count and pad to a static capacity instead
-    (the reference pads dynamically at :130-147 — we make capacity static for XLA).
+    Counterpart of reference ``gather_all_tensors`` (utilities/distributed.py:100),
+    including its uneven-shape path: when leading dimensions differ across
+    processes (concat states after different numbers of updates), lengths are
+    gathered first (always equal-shape), every process pads to the maximum, and
+    the gathered results are trimmed back (reference :130-147). Equal shapes take
+    the direct fast path.
     """
+    import numpy as np
     from jax.experimental import multihost_utils
 
-    stacked = multihost_utils.process_allgather(value, tiled=False)
-    return [stacked[i] for i in range(stacked.shape[0])]
+    value = jnp.asarray(value)
+    local_len = jnp.asarray([value.shape[0] if value.ndim else 1], jnp.int32)
+    lengths = np.asarray(multihost_utils.process_allgather(local_len, tiled=False)).reshape(-1)
+    if value.ndim == 0 or int(lengths.min()) == int(lengths.max()):
+        stacked = multihost_utils.process_allgather(value, tiled=False)
+        return [stacked[i] for i in range(stacked.shape[0])]
+    max_len = int(lengths.max())
+    pad = [(0, max_len - value.shape[0])] + [(0, 0)] * (value.ndim - 1)
+    stacked = multihost_utils.process_allgather(jnp.pad(value, pad), tiled=False)
+    return [stacked[i, : int(lengths[i])] for i in range(stacked.shape[0])]
 
 
 def process_sync(
