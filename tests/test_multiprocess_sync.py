@@ -66,6 +66,12 @@ _WORKER = textwrap.dedent(
     local_only.update(jnp.asarray(preds[lo:hi]), jnp.asarray(target[lo:hi]))
     out["acc_local"] = float(local_only.compute())
 
+    # a process with ZERO updates must still participate in the collectives
+    empty_cat = tm.CatMetric()
+    if pid == 0:
+        empty_cat.update(jnp.asarray(preds[:4, 1]))
+    out["empty_cat_sorted"] = sorted(np.asarray(empty_cat.compute()).reshape(-1).tolist())
+
     # dist_sync_on_step: forward returns the cross-PROCESS-synced value each step
     step_synced = tm.MulticlassAccuracy(5, average="micro", dist_sync_on_step=True)
     out["acc_step_synced"] = float(step_synced(jnp.asarray(preds[lo:hi]), jnp.asarray(target[lo:hi])))
@@ -128,5 +134,9 @@ def test_two_process_cluster_sync(tmp_path):
         )
         expected_cat = sorted(preds[0:16, 0].tolist() + preds[16:25, 0].tolist())
         np.testing.assert_allclose(res["cat_sorted"], expected_cat, atol=1e-7, err_msg=f"proc {pid}")
+        np.testing.assert_allclose(
+            res["empty_cat_sorted"], sorted(preds[:4, 1].tolist()), atol=1e-7,
+            err_msg=f"proc {pid} zero-update participation",
+        )
     # per-process local values differ from the global (proves sync actually ran)
     assert outs[0]["acc_local"] != outs[1]["acc_local"] or outs[0]["acc_local"] != outs[0]["acc"]

@@ -1,0 +1,213 @@
+"""Reference-library fuzz, part 2: retrieval / segmentation / image / audio /
+aggregation knob grids on identical data (companion to test_reference_fuzz.py).
+
+The quirk surfaces targeted here: retrieval's ``empty_target_action`` policies
+and per-query top_k, aggregation's ``nan_strategy`` handling, segmentation's
+``include_background``/average knobs, and the image tensor-math stack under
+both random and degenerate (constant image) draws.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import torchmetrics_tpu.functional as F
+from tests.helpers import _assert_allclose
+from tests.oracle import reference_torchmetrics
+
+tm_ref = reference_torchmetrics()
+if tm_ref is None:  # pragma: no cover
+    pytest.skip("reference torchmetrics unavailable", allow_module_level=True)
+
+import torch  # noqa: E402
+import torchmetrics.functional as RF  # noqa: E402
+import torchmetrics.functional.audio as RFA  # noqa: E402
+import torchmetrics.functional.image as RFI  # noqa: E402
+import torchmetrics.functional.retrieval as RFR  # noqa: E402
+import torchmetrics.functional.segmentation as RFS  # noqa: E402
+
+import jax.numpy as jnp  # noqa: E402
+
+
+def _j(x):
+    return jnp.asarray(x)
+
+
+def _t(x):
+    return torch.as_tensor(x)
+
+
+def _from_ref(v):
+    if isinstance(v, dict):
+        return {k: _from_ref(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return type(v)(_from_ref(x) for x in v)
+    return v.numpy() if isinstance(v, torch.Tensor) else v
+
+
+# ------------------------------------------------------------------ retrieval
+
+_RETRIEVAL_FNS = [
+    ("map", F.retrieval_average_precision, RFR.retrieval_average_precision, {}),
+    ("mrr", F.retrieval_reciprocal_rank, RFR.retrieval_reciprocal_rank, {}),
+    ("precision", F.retrieval_precision, RFR.retrieval_precision, dict(top_k=3)),
+    ("recall", F.retrieval_recall, RFR.retrieval_recall, dict(top_k=3)),
+    ("hit_rate", F.retrieval_hit_rate, RFR.retrieval_hit_rate, dict(top_k=3)),
+    ("fall_out", F.retrieval_fall_out, RFR.retrieval_fall_out, dict(top_k=3)),
+    ("ndcg", F.retrieval_normalized_dcg, RFR.retrieval_normalized_dcg, {}),
+    ("r_precision", F.retrieval_r_precision, RFR.retrieval_r_precision, {}),
+    ("auroc", F.retrieval_auroc, RFR.retrieval_auroc, {}),
+]
+
+
+@pytest.mark.parametrize("name,ours,ref,kwargs", _RETRIEVAL_FNS, ids=[c[0] for c in _RETRIEVAL_FNS])
+@pytest.mark.parametrize("seed", [0, 1])
+def test_retrieval_single_query_fns(name, ours, ref, kwargs, seed):
+    rng = np.random.default_rng(seed + 5)
+    preds = rng.random(12, dtype=np.float32)
+    target = rng.integers(0, 2, 12)
+    got = ours(_j(preds), _j(target), **kwargs)
+    want = _from_ref(ref(_t(preds), _t(target), **kwargs))
+    _assert_allclose(got, want, atol=1e-6, msg=name)
+
+
+@pytest.mark.parametrize("empty_action", ["skip", "neg", "pos"])
+def test_retrieval_class_empty_target_actions(empty_action):
+    from torchmetrics.retrieval import RetrievalMAP as RefMAP
+
+    from torchmetrics_tpu import RetrievalMAP
+
+    rng = np.random.default_rng(3)
+    preds = rng.random(24, dtype=np.float32)
+    target = rng.integers(0, 2, 24)
+    target[6:12] = 0  # one query with zero relevant docs
+    indexes = np.repeat(np.arange(4), 6)
+    ours = RetrievalMAP(empty_target_action=empty_action)
+    ref = RefMAP(empty_target_action=empty_action)
+    ours.update(_j(preds), _j(target), indexes=_j(indexes))
+    ref.update(_t(preds), _t(target), indexes=_t(indexes))
+    _assert_allclose(ours.compute(), _from_ref(ref.compute()), atol=1e-6)
+
+
+def test_retrieval_empty_target_error_action():
+    from torchmetrics.retrieval import RetrievalMAP as RefMAP
+
+    from torchmetrics_tpu import RetrievalMAP
+
+    preds = np.asarray([0.1, 0.2, 0.9, 0.4], np.float32)
+    target = np.asarray([0, 0, 1, 1])
+    target[:2] = 0
+    indexes = np.asarray([0, 0, 1, 1])
+    target = np.asarray([0, 0, 1, 1]); target[2:] = 0  # every query empty for q1
+    ours = RetrievalMAP(empty_target_action="error")
+    ref = RefMAP(empty_target_action="error")
+    ours.update(_j(preds), _j(np.asarray([0, 0, 0, 0])), indexes=_j(indexes))
+    ref.update(_t(preds), _t(np.asarray([0, 0, 0, 0])), indexes=_t(indexes))
+    with pytest.raises(Exception):
+        ref.compute()
+    with pytest.raises(Exception):
+        ours.compute()
+
+
+# ---------------------------------------------------------------- aggregation
+
+@pytest.mark.parametrize("nan_strategy", ["ignore", "warn", 42.0])
+@pytest.mark.parametrize("cls_name", ["MeanMetric", "SumMetric", "MaxMetric", "MinMetric"])
+def test_aggregation_nan_strategies(cls_name, nan_strategy):
+    import torchmetrics as TMR
+
+    import torchmetrics_tpu as tm
+
+    rng = np.random.default_rng(7)
+    vals = rng.random(16, dtype=np.float32)
+    vals[[2, 9]] = np.nan
+    ours = getattr(tm, cls_name)(nan_strategy=nan_strategy)
+    ref = getattr(TMR, cls_name)(nan_strategy=nan_strategy)
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        ours.update(_j(vals))
+        ref.update(_t(vals))
+        _assert_allclose(ours.compute(), _from_ref(ref.compute()), atol=1e-6, msg=cls_name)
+
+
+# --------------------------------------------------------------- segmentation
+
+@pytest.mark.parametrize("include_background", [True, False])
+@pytest.mark.parametrize("average", ["micro", "macro", "none"])
+def test_segmentation_dice_knobs(include_background, average):
+    rng = np.random.default_rng(11)
+    preds = rng.integers(0, 2, (3, 4, 8, 8)).astype(np.int64)
+    target = rng.integers(0, 2, (3, 4, 8, 8)).astype(np.int64)
+    got = F.dice_score(_j(preds), _j(target), num_classes=4, include_background=include_background,
+                       average=average, input_format="one-hot")
+    want = _from_ref(RFS.dice_score(_t(preds), _t(target), num_classes=4,
+                                    include_background=include_background, average=average,
+                                    input_format="one-hot"))
+    _assert_allclose(got, want, atol=1e-6, msg=f"dice-{average}-{include_background}")
+
+
+@pytest.mark.parametrize("include_background", [True, False])
+def test_segmentation_miou(include_background):
+    rng = np.random.default_rng(12)
+    preds = rng.integers(0, 2, (3, 4, 8, 8)).astype(np.int64)
+    target = rng.integers(0, 2, (3, 4, 8, 8)).astype(np.int64)
+    got = F.mean_iou(_j(preds), _j(target), num_classes=4, include_background=include_background,
+                     input_format="one-hot")
+    want = _from_ref(RFS.mean_iou(_t(preds), _t(target), num_classes=4,
+                                  include_background=include_background, input_format="one-hot"))
+    _assert_allclose(got, want, atol=1e-6)
+
+
+# ---------------------------------------------------------------------- image
+
+_IMG_FNS = [
+    ("psnr", lambda a, b: F.peak_signal_noise_ratio(a, b, data_range=1.0),
+     lambda a, b: RF.peak_signal_noise_ratio(a, b, data_range=1.0)),
+    ("ssim", lambda a, b: F.structural_similarity_index_measure(a, b, data_range=1.0),
+     lambda a, b: RF.structural_similarity_index_measure(a, b, data_range=1.0)),
+    ("uqi", F.universal_image_quality_index, RF.universal_image_quality_index),
+    ("sam", F.spectral_angle_mapper, RF.spectral_angle_mapper),
+    ("ergas", F.error_relative_global_dimensionless_synthesis,
+     RF.error_relative_global_dimensionless_synthesis),
+    ("tv", lambda a, b: F.total_variation(a), lambda a, b: RF.total_variation(a)),
+    ("vif", F.visual_information_fidelity, RFI.visual_information_fidelity),
+]
+
+
+@pytest.mark.parametrize("name,ours,ref", _IMG_FNS, ids=[c[0] for c in _IMG_FNS])
+@pytest.mark.parametrize("degenerate", [False, True], ids=["random", "constant"])
+def test_image_tensor_math(name, ours, ref, degenerate):
+    rng = np.random.default_rng(13)
+    shape = (2, 3, 41, 41)
+    a = np.full(shape, 0.5, np.float32) if degenerate else rng.random(shape, dtype=np.float32)
+    b = rng.random(shape, dtype=np.float32)
+    got = np.asarray(ours(_j(a), _j(b)))
+    want = np.asarray(_from_ref(ref(_t(a), _t(b))))
+    if np.isnan(want).any() or np.isinf(want).any():
+        assert np.isnan(got).any() or np.isinf(got).any(), f"{name}: ref non-finite, ours finite"
+        return
+    np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-4, err_msg=name)
+
+
+# ---------------------------------------------------------------------- audio
+
+_AUDIO_FNS = [
+    ("snr", F.signal_noise_ratio, RFA.signal_noise_ratio),
+    ("si_snr", F.scale_invariant_signal_noise_ratio, RFA.scale_invariant_signal_noise_ratio),
+    ("si_sdr", F.scale_invariant_signal_distortion_ratio, RFA.scale_invariant_signal_distortion_ratio),
+    ("sa_sdr", F.source_aggregated_signal_distortion_ratio, RFA.source_aggregated_signal_distortion_ratio),
+]
+
+
+@pytest.mark.parametrize("name,ours,ref", _AUDIO_FNS, ids=[c[0] for c in _AUDIO_FNS])
+def test_audio_ratios(name, ours, ref):
+    rng = np.random.default_rng(14)
+    shape = (2, 3, 256) if name == "sa_sdr" else (3, 256)
+    a = rng.normal(size=shape).astype(np.float32)
+    b = rng.normal(size=shape).astype(np.float32)
+    got = ours(_j(a), _j(b))
+    want = _from_ref(ref(_t(a), _t(b)))
+    _assert_allclose(got, want, atol=1e-4, msg=name)

@@ -372,13 +372,17 @@ class Metric:
 
     def _concat_state(self, state: Optional[StateDict] = None) -> StateDict:
         """State with host lists concatenated to single arrays (empty lists dropped to
-        zero-length arrays where possible)."""
+        zero-length arrays where possible). All-numpy lists (compute_on_cpu offload,
+        host metrics) concatenate on host — re-uploading to device here would defeat
+        the offload's whole purpose (states too big for HBM) and add transfers."""
         state = self._state if state is None else state
         out: StateDict = {}
         for k, v in state.items():
             if isinstance(v, list):
                 if len(v) == 0:
                     out[k] = jnp.zeros((0,), jnp.float32)
+                elif all(isinstance(e, np.ndarray) for e in v):
+                    out[k] = np.concatenate([np.atleast_1d(e) for e in v], axis=0)
                 else:
                     out[k] = dim_zero_cat(v)
             else:
@@ -746,24 +750,6 @@ class HostMetric(Metric):
 
     def _host_batch_state(self, *args: Any, **kwargs: Any) -> StateDict:
         raise NotImplementedError
-
-    def _concat_state(self, state: Optional[StateDict] = None) -> StateDict:
-        """Concat list states on host when entries are numpy — host metrics evaluate
-        host-side, so a device round-trip here would only add transfers (and a D2H
-        readback flips tunneled TPU runtimes into synchronous dispatch)."""
-        state = self._state if state is None else state
-        out: StateDict = {}
-        for k, v in state.items():
-            if isinstance(v, list):
-                if len(v) == 0:
-                    out[k] = np.zeros((0,), np.float32)
-                elif all(isinstance(e, np.ndarray) for e in v):
-                    out[k] = np.concatenate([np.atleast_1d(e) for e in v], axis=0)
-                else:
-                    out[k] = dim_zero_cat(v)
-            else:
-                out[k] = v
-        return out
 
     def _batch_state(self, *args: Any, **kwargs: Any) -> StateDict:  # pragma: no cover
         return self._host_batch_state(*args, **kwargs)

@@ -139,29 +139,64 @@ def distributed_available() -> bool:
         return False
 
 
-def gather_all_arrays(value: Array, process_group: Any = None) -> List[Array]:
+_GATHER_MAX_RANK = 8
+_GATHER_DTYPES = (jnp.float32, jnp.float64, jnp.int32, jnp.int64, jnp.bfloat16, jnp.float16, jnp.uint8, jnp.bool_)
+
+
+def gather_all_arrays(value: Optional[Array], process_group: Any = None) -> List[Array]:
     """All-gather one array across JAX processes → list of per-process values.
 
     Counterpart of reference ``gather_all_tensors`` (utilities/distributed.py:100),
-    including its uneven-shape path: when leading dimensions differ across
-    processes (concat states after different numbers of updates), lengths are
-    gathered first (always equal-shape), every process pads to the maximum, and
-    the gathered results are trimmed back (reference :130-147). Equal shapes take
-    the direct fast path.
+    including its uneven-shape path: shapes are gathered first (always a
+    fixed-size vector, so every process enters the collective), every process
+    pads each dimension to the world maximum, and the gathered results are
+    trimmed back per process (reference :130-147). ``value=None`` means "this
+    process has nothing" (a concat state after zero updates) — the process still
+    participates, contributing a zero-length array in the dtype/rank its peers
+    announce, so collectives never desynchronize across states.
     """
     import numpy as np
     from jax.experimental import multihost_utils
 
-    value = jnp.asarray(value)
-    local_len = jnp.asarray([value.shape[0] if value.ndim else 1], jnp.int32)
-    lengths = np.asarray(multihost_utils.process_allgather(local_len, tiled=False)).reshape(-1)
-    if value.ndim == 0 or int(lengths.min()) == int(lengths.max()):
+    vec = np.full(_GATHER_MAX_RANK + 2, -1, np.int64)
+    if value is not None:
+        value = jnp.asarray(value)
+        if value.ndim > _GATHER_MAX_RANK:
+            raise ValueError(f"gather_all_arrays supports rank <= {_GATHER_MAX_RANK}, got {value.ndim}")
+        vec[0] = value.ndim
+        vec[1 : 1 + value.ndim] = value.shape
+        vec[-1] = next(i for i, dt in enumerate(_GATHER_DTYPES) if value.dtype == jnp.dtype(dt))
+    shapes = np.asarray(multihost_utils.process_allgather(jnp.asarray(vec), tiled=False)).reshape(-1, vec.size)
+    known_rows = np.flatnonzero(shapes[:, 0] >= 0)
+    if known_rows.size == 0:
+        return []  # no process has data for this state
+    ranks = shapes[known_rows, 0]
+    if int(ranks.min()) != int(ranks.max()):
+        raise ValueError(f"gather_all_arrays requires equal ranks across processes, got {sorted(set(ranks.tolist()))}")
+    rank = int(ranks[0])
+    dtype = jnp.dtype(_GATHER_DTYPES[int(shapes[known_rows[0], -1])])
+    world = shapes.shape[0]
+    if rank == 0:
+        if value is None:
+            value = jnp.zeros((), dtype)  # scalar states can't signal emptiness; contribute zero
         stacked = multihost_utils.process_allgather(value, tiled=False)
         return [stacked[i] for i in range(stacked.shape[0])]
-    max_len = int(lengths.max())
-    pad = [(0, max_len - value.shape[0])] + [(0, 0)] * (value.ndim - 1)
+    template = shapes[known_rows[0], 1 : 1 + rank].astype(np.int64)
+    dims = np.tile(template, (world, 1))
+    for i in range(world):
+        if shapes[i, 0] >= 0:
+            dims[i] = shapes[i, 1 : 1 + rank]
+        else:
+            dims[i, 0] = 0  # empty contributor: zero length, peers' trailing dims
+    if value is None:
+        value = jnp.zeros(tuple(int(d) for d in dims[jax.process_index()]), dtype)
+    if (dims == dims[0]).all():
+        stacked = multihost_utils.process_allgather(value, tiled=False)
+        return [stacked[i] for i in range(stacked.shape[0])]
+    max_dims = dims.max(axis=0)
+    pad = [(0, int(m) - int(s)) for m, s in zip(max_dims, value.shape)]
     stacked = multihost_utils.process_allgather(jnp.pad(value, pad), tiled=False)
-    return [stacked[i, : int(lengths[i])] for i in range(stacked.shape[0])]
+    return [stacked[(i, *(slice(0, int(d)) for d in dims[i]))] for i in range(world)]
 
 
 def process_sync(
@@ -179,13 +214,17 @@ def process_sync(
     out: Dict[str, Any] = {}
     for name, value in state.items():
         fx = reductions.get(name)
-        if isinstance(value, list):  # concat list state: gather each element? pre-concat first
-            if not value:
-                out[name] = value
-                continue
-            local = jnp.concatenate([jnp.atleast_1d(jnp.asarray(v)) for v in value], axis=0)
+        if isinstance(value, list):  # concat list state: pre-concat, then gather
+            local = (
+                jnp.concatenate([jnp.atleast_1d(jnp.asarray(v)) for v in value], axis=0)
+                if value
+                else None  # zero-update process still participates in the collective
+            )
+            if local is None and dist_sync_fn is not None:
+                # injected gathers keep the plain fn(value, group) contract
+                local = jnp.zeros((0,), jnp.float32)
             gathered = gather(local, process_group)
-            out[name] = [g for g in gathered]
+            out[name] = [g for g in gathered if g.shape[0] > 0] or value
             continue
         gathered = gather(value, process_group)
         out[name] = _fold_gathered(gathered, fx)
