@@ -38,9 +38,10 @@ _WORKER = textwrap.dedent(
     import torchmetrics_tpu as tm
 
     rng = np.random.default_rng(42)  # same stream everywhere; shard by slicing
-    preds = rng.normal(size=(32, 5)).astype(np.float32)
-    target = rng.integers(0, 5, 32).astype(np.int32)
-    lo, hi = pid * 16, (pid + 1) * 16
+    preds = rng.normal(size=(48, 5)).astype(np.float32)
+    target = rng.integers(0, 5, 48).astype(np.int32)
+    shard = 48 // nproc
+    lo, hi = pid * shard, (pid + 1) * shard
 
     out = {}
 
@@ -55,7 +56,7 @@ _WORKER = textwrap.dedent(
     # concat state with UNEVEN per-process counts: plane-2 gathers lengths first,
     # pads to the max and trims (reference utilities/distributed.py:130-147)
     cat = tm.CatMetric()
-    n_take = 16 if pid == 0 else 9
+    n_take = shard if pid == 0 else shard - 7  # uneven on purpose
     cat.update(jnp.asarray(preds[lo : lo + n_take, 0]))
     out["cat_sorted"] = sorted(np.asarray(cat.compute()).reshape(-1).tolist())
 
@@ -76,6 +77,22 @@ _WORKER = textwrap.dedent(
     step_synced = tm.MulticlassAccuracy(5, average="micro", dist_sync_on_step=True)
     out["acc_step_synced"] = float(step_synced(jnp.asarray(preds[lo:hi]), jnp.asarray(target[lo:hi])))
 
+    # a "mean"-reduced state: the n-way fold must be mean-of-stack, not pairwise
+    class MeanState(tm.Metric):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.add_state("m", default=np.zeros(()), dist_reduce_fx="mean")
+
+        def _batch_state(self, x):
+            return {"m": x.mean()}
+
+        def _compute(self, state):
+            return state["m"]
+
+    ms = MeanState()
+    ms.update(jnp.asarray(np.float32(pid + 1.0) * jnp.ones(4)))
+    out["mean_state"] = float(ms.compute())
+
     print("RESULT" + json.dumps(out))
     """
 )
@@ -87,7 +104,8 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_cluster_sync(tmp_path):
+@pytest.mark.parametrize("world", [2, 3])
+def test_process_cluster_sync(tmp_path, world):
     worker = tmp_path / "worker.py"
     worker.write_text(_WORKER)
     env = dict(os.environ)
@@ -98,10 +116,10 @@ def test_two_process_cluster_sync(tmp_path):
     port = str(_free_port())
     procs = [
         subprocess.Popen(
-            [sys.executable, str(worker), str(i), "2", port],
+            [sys.executable, str(worker), str(i), str(world), port],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
         )
-        for i in range(2)
+        for i in range(world)
     ]
     outs = []
     for p in procs:
@@ -117,8 +135,8 @@ def test_two_process_cluster_sync(tmp_path):
     import torchmetrics_tpu as tm
 
     rng = np.random.default_rng(42)
-    preds = rng.normal(size=(32, 5)).astype(np.float32)
-    target = rng.integers(0, 5, 32).astype(np.int32)
+    preds = rng.normal(size=(48, 5)).astype(np.float32)
+    target = rng.integers(0, 5, 48).astype(np.int32)
     ref_acc = tm.MulticlassAccuracy(5, average="micro")
     ref_acc.update(jnp.asarray(preds), jnp.asarray(target))
     ref_confmat = tm.MulticlassConfusionMatrix(5)
@@ -132,11 +150,20 @@ def test_two_process_cluster_sync(tmp_path):
         np.testing.assert_allclose(
             np.asarray(res["confmat"]), np.asarray(ref_confmat.compute()), err_msg=f"proc {pid}"
         )
-        expected_cat = sorted(preds[0:16, 0].tolist() + preds[16:25, 0].tolist())
+        shard = 48 // world
+        expected_cat = sorted(
+            x for p in range(world)
+            for x in preds[p * shard : p * shard + (shard if p == 0 else shard - 7), 0].tolist()
+        )
         np.testing.assert_allclose(res["cat_sorted"], expected_cat, atol=1e-7, err_msg=f"proc {pid}")
         np.testing.assert_allclose(
             res["empty_cat_sorted"], sorted(preds[:4, 1].tolist()), atol=1e-7,
             err_msg=f"proc {pid} zero-update participation",
+        )
+        # mean fold over n ranks: mean(1, 2, ..., world)
+        np.testing.assert_allclose(
+            res["mean_state"], np.mean(np.arange(1, world + 1)), atol=1e-6,
+            err_msg=f"proc {pid} n-way mean fold",
         )
     # per-process local values differ from the global (proves sync actually ran)
     assert outs[0]["acc_local"] != outs[1]["acc_local"] or outs[0]["acc_local"] != outs[0]["acc"]

@@ -96,14 +96,12 @@ def test_retrieval_empty_target_error_action():
     from torchmetrics_tpu import RetrievalMAP
 
     preds = np.asarray([0.1, 0.2, 0.9, 0.4], np.float32)
-    target = np.asarray([0, 0, 1, 1])
-    target[:2] = 0
+    target = np.zeros(4, np.int64)  # every query empty -> "error" action must raise
     indexes = np.asarray([0, 0, 1, 1])
-    target = np.asarray([0, 0, 1, 1]); target[2:] = 0  # every query empty for q1
     ours = RetrievalMAP(empty_target_action="error")
     ref = RefMAP(empty_target_action="error")
-    ours.update(_j(preds), _j(np.asarray([0, 0, 0, 0])), indexes=_j(indexes))
-    ref.update(_t(preds), _t(np.asarray([0, 0, 0, 0])), indexes=_t(indexes))
+    ours.update(_j(preds), _j(target), indexes=_j(indexes))
+    ref.update(_t(preds), _t(target), indexes=_t(indexes))
     with pytest.raises(Exception):
         ref.compute()
     with pytest.raises(Exception):

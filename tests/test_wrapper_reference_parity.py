@@ -1,0 +1,167 @@
+"""Wrapper-layer parity against the reference library on identical data.
+
+The wrappers are pure composition — deterministic given the same base metric —
+so outputs must match the reference exactly: ClasswiseWrapper key naming,
+MultioutputWrapper splitting, MinMaxMetric dict shape, MultitaskWrapper nesting,
+Running window semantics, and MetricTracker best/compute_all bookkeeping.
+(BootStrapper is excluded: resampling RNGs differ by design and its statistics
+are tested elsewhere.)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from tests.helpers import _assert_allclose
+from tests.oracle import reference_torchmetrics
+
+tm_ref = reference_torchmetrics()
+if tm_ref is None:  # pragma: no cover
+    pytest.skip("reference torchmetrics unavailable", allow_module_level=True)
+
+import jax.numpy as jnp  # noqa: E402
+import torch  # noqa: E402
+
+import torchmetrics_tpu as tm  # noqa: E402
+
+N, C = 48, 4
+
+
+def _data(seed=0, batches=3):
+    rng = np.random.default_rng(seed)
+    return [
+        (rng.normal(size=(N, C)).astype(np.float32), rng.integers(0, C, N).astype(np.int64))
+        for _ in range(batches)
+    ]
+
+
+def _from_ref(v):
+    if isinstance(v, dict):
+        return {k: _from_ref(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return type(v)(_from_ref(x) for x in v)
+    return v.numpy() if isinstance(v, torch.Tensor) else v
+
+
+def test_classwise_wrapper_keys_and_values():
+    import torchmetrics as TR
+
+    ours = tm.ClasswiseWrapper(tm.MulticlassAccuracy(C, average=None))
+    ref = TR.ClasswiseWrapper(TR.classification.MulticlassAccuracy(num_classes=C, average=None))
+    for preds, target in _data(1):
+        ours.update(jnp.asarray(preds), jnp.asarray(target))
+        ref.update(torch.as_tensor(preds), torch.as_tensor(target))
+    _assert_allclose(ours.compute(), _from_ref(ref.compute()))
+
+    labels = ["a", "b", "c", "d"]
+    ours_l = tm.ClasswiseWrapper(tm.MulticlassAccuracy(C, average=None), labels=labels)
+    ref_l = TR.ClasswiseWrapper(TR.classification.MulticlassAccuracy(num_classes=C, average=None), labels=labels)
+    preds, target = _data(2, 1)[0]
+    ours_l.update(jnp.asarray(preds), jnp.asarray(target))
+    ref_l.update(torch.as_tensor(preds), torch.as_tensor(target))
+    assert set(ours_l.compute()) == set(_from_ref(ref_l.compute()))
+
+
+def test_multioutput_wrapper():
+    import torchmetrics as TR
+
+    rng = np.random.default_rng(3)
+    p = rng.normal(size=(32, 3)).astype(np.float32)
+    t = rng.normal(size=(32, 3)).astype(np.float32)
+    ours = tm.MultioutputWrapper(tm.MeanSquaredError(), num_outputs=3)
+    ref = TR.MultioutputWrapper(TR.MeanSquaredError(), num_outputs=3)
+    ours.update(jnp.asarray(p), jnp.asarray(t))
+    ref.update(torch.as_tensor(p), torch.as_tensor(t))
+    _assert_allclose(ours.compute(), _from_ref(ref.compute()))
+
+
+def test_minmax_metric():
+    import torchmetrics as TR
+
+    ours = tm.MinMaxMetric(tm.MulticlassAccuracy(C, average="micro"))
+    ref = TR.MinMaxMetric(TR.classification.MulticlassAccuracy(num_classes=C, average="micro"))
+    for preds, target in _data(4, 4):
+        ours(jnp.asarray(preds), jnp.asarray(target))
+        ref(torch.as_tensor(preds), torch.as_tensor(target))
+    _assert_allclose(ours.compute(), _from_ref(ref.compute()))
+
+
+def test_multitask_wrapper():
+    import torchmetrics as TR
+
+    rng = np.random.default_rng(5)
+    cls_p = rng.normal(size=(N, C)).astype(np.float32)
+    cls_t = rng.integers(0, C, N).astype(np.int64)
+    reg_p = rng.normal(size=N).astype(np.float32)
+    reg_t = rng.normal(size=N).astype(np.float32)
+    ours = tm.MultitaskWrapper({
+        "cls": tm.MulticlassAccuracy(C, average="micro"), "reg": tm.MeanSquaredError()
+    })
+    ref = TR.MultitaskWrapper({
+        "cls": TR.classification.MulticlassAccuracy(num_classes=C, average="micro"),
+        "reg": TR.MeanSquaredError(),
+    })
+    ours.update(
+        {"cls": jnp.asarray(cls_p), "reg": jnp.asarray(reg_p)},
+        {"cls": jnp.asarray(cls_t), "reg": jnp.asarray(reg_t)},
+    )
+    ref.update(
+        {"cls": torch.as_tensor(cls_p), "reg": torch.as_tensor(reg_p)},
+        {"cls": torch.as_tensor(cls_t), "reg": torch.as_tensor(reg_t)},
+    )
+    _assert_allclose(ours.compute(), _from_ref(ref.compute()))
+
+
+def test_running_mean_window():
+    import torchmetrics as TR
+
+    ours = tm.Running(tm.MeanMetric(), window=3)
+    ref = TR.wrappers.Running(TR.MeanMetric(), window=3)
+    rng = np.random.default_rng(6)
+    for _ in range(6):
+        chunk = rng.random(8, dtype=np.float32)
+        ours.update(jnp.asarray(chunk))
+        ref.update(torch.as_tensor(chunk))
+        _assert_allclose(ours.compute(), _from_ref(ref.compute()))
+
+
+def test_metric_tracker():
+    import torchmetrics as TR
+
+    ours = tm.MetricTracker(tm.MulticlassAccuracy(C, average="micro"))
+    ref = TR.wrappers.MetricTracker(TR.classification.MulticlassAccuracy(num_classes=C, average="micro"))
+    for step, (preds, target) in enumerate(_data(7, 4)):
+        ours.increment()
+        ref.increment()
+        ours.update(jnp.asarray(preds), jnp.asarray(target))
+        ref.update(torch.as_tensor(preds), torch.as_tensor(target))
+    _assert_allclose(ours.compute_all(), _from_ref(ref.compute_all()))
+    ours_best, ours_idx = ours.best_metric(return_step=True)
+    ref_best, ref_idx = ref.best_metric(return_step=True)
+    assert float(ours_best) == pytest.approx(float(ref_best), abs=1e-7)
+    assert int(ours_idx) == int(ref_idx)
+
+
+def test_collection_prefix_postfix_and_groups():
+    import torchmetrics as TR
+
+    ours = tm.MetricCollection(
+        {"acc": tm.MulticlassAccuracy(C, average="micro"), "f1": tm.MulticlassF1Score(C, average="macro")},
+        prefix="train_", postfix="_v1",
+    )
+    ref = TR.MetricCollection(
+        {
+            "acc": TR.classification.MulticlassAccuracy(num_classes=C, average="micro"),
+            "f1": TR.classification.MulticlassF1Score(num_classes=C, average="macro"),
+        },
+        prefix="train_", postfix="_v1",
+    )
+    for preds, target in _data(8):
+        ours.update(jnp.asarray(preds), jnp.asarray(target))
+        ref.update(torch.as_tensor(preds), torch.as_tensor(target))
+    ours_out = ours.compute()
+    ref_out = _from_ref(ref.compute())
+    assert set(ours_out) == set(ref_out)
+    for k in ref_out:
+        _assert_allclose(ours_out[k], ref_out[k], msg=k)

@@ -380,7 +380,10 @@ class Metric:
         for k, v in state.items():
             if isinstance(v, list):
                 if len(v) == 0:
-                    out[k] = jnp.zeros((0,), jnp.float32)
+                    # numpy, not jnp: host metrics read this back immediately and a
+                    # D2H readback flips tunneled TPU runtimes into sync dispatch;
+                    # jitted consumers accept numpy inputs transparently
+                    out[k] = np.zeros((0,), np.float32)
                 elif all(isinstance(e, np.ndarray) for e in v):
                     out[k] = np.concatenate([np.atleast_1d(e) for e in v], axis=0)
                 else:

@@ -165,7 +165,13 @@ def gather_all_arrays(value: Optional[Array], process_group: Any = None) -> List
             raise ValueError(f"gather_all_arrays supports rank <= {_GATHER_MAX_RANK}, got {value.ndim}")
         vec[0] = value.ndim
         vec[1 : 1 + value.ndim] = value.shape
-        vec[-1] = next(i for i, dt in enumerate(_GATHER_DTYPES) if value.dtype == jnp.dtype(dt))
+        codes = [i for i, dt in enumerate(_GATHER_DTYPES) if value.dtype == jnp.dtype(dt)]
+        if not codes:  # fail BEFORE entering any collective, so peers don't block
+            raise ValueError(
+                f"gather_all_arrays does not support dtype {value.dtype}; supported: "
+                f"{[str(jnp.dtype(d)) for d in _GATHER_DTYPES]}"
+            )
+        vec[-1] = codes[0]
     shapes = np.asarray(multihost_utils.process_allgather(jnp.asarray(vec), tiled=False)).reshape(-1, vec.size)
     known_rows = np.flatnonzero(shapes[:, 0] >= 0)
     if known_rows.size == 0:
