@@ -169,3 +169,31 @@ def test_fuzz_vs_reference_degenerate(name, ours, ref, kwargs, maker):
     got = ours(_to_ours(a), _to_ours(b), **kwargs)
     want = _from_ref(ref(_to_ref(a), _to_ref(b), **kwargs))
     _assert_allclose(got, want, atol=1e-6, msg=f"{name} (degenerate)")
+
+
+# ---------------------------------------------------- operating-point metrics
+
+_OP_CASES = [
+    ("bin_eer", "binary_eer", dict(thresholds=None)),
+    ("bin_eer_binned", "binary_eer", dict(thresholds=31)),
+    ("bin_logauc", "binary_logauc", dict(thresholds=None)),
+    ("bin_sens_at_spec", "binary_sensitivity_at_specificity", dict(min_specificity=0.6, thresholds=None)),
+    ("bin_spec_at_sens", "binary_specificity_at_sensitivity", dict(min_sensitivity=0.6, thresholds=None)),
+    ("bin_prec_at_rec", "binary_precision_at_fixed_recall", dict(min_recall=0.5, thresholds=None)),
+    ("bin_rec_at_prec", "binary_recall_at_fixed_precision", dict(min_precision=0.5, thresholds=None)),
+    ("mc_eer", "multiclass_eer", dict(num_classes=C, thresholds=None)),
+    ("mc_sens_at_spec", "multiclass_sensitivity_at_specificity", dict(num_classes=C, min_specificity=0.6, thresholds=None)),
+]
+
+
+@pytest.mark.parametrize("name,fn_name,kwargs", _OP_CASES, ids=[c[0] for c in _OP_CASES])
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_operating_point_fuzz_vs_reference(name, fn_name, kwargs, seed):
+    rng = np.random.default_rng(seed * 131 + 7)
+    if fn_name.startswith("binary"):
+        a, b = _mk_binary(rng)
+    else:
+        a, b = _mk_multiclass(rng)
+    got = getattr(F, fn_name)(_to_ours(a), _to_ours(b), **kwargs)
+    want = _from_ref(getattr(RFC, fn_name)(_to_ref(a), _to_ref(b), **kwargs))
+    _assert_allclose(got, want, atol=1e-6, msg=name)
