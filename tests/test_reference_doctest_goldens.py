@@ -1,0 +1,208 @@
+"""Reference-printed doctest goldens as third-party anchors (VERDICT r3 #2).
+
+The reference's doctests run under ``torch.manual_seed(42)`` (reference
+``src/conftest.py``), so its printed outputs are free golden numbers computed by
+the REAL native backends the reference wraps: pycocotools (mAP), pesq, pystoi,
+the DNSMOS ONNX models, and vmaf-torch. Replaying the doctest inputs here and
+asserting the printed outputs is the only offline route to third-party
+validation of those pipelines — a shared misreading between our implementation
+and our own oracle cannot fabricate these numbers.
+
+Wheel-backed surfaces (PESQ/STOI/DNSMOS) mirror the reference's availability
+gates: the goldens are committed and asserted whenever the wheel is present, and
+skip with the exact reason otherwise (pinned in ``test_expected_skips``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import torch
+
+import torchmetrics_tpu as tm
+
+
+def _seeded_randn(*shape):
+    return torch.randn(*shape, generator=torch.manual_seed(42)).numpy()
+
+
+# --------------------------------------------------------------------- mAP ---
+# /root/reference/src/torchmetrics/detection/mean_ap.py:231-247 (bbox) and
+# :293-310 (segm): values printed by the pycocotools-backed evaluator.
+
+_MAP_BBOX_GOLDEN = {
+    "map": 0.6, "map_50": 1.0, "map_75": 1.0, "map_large": 0.6, "map_medium": -1.0,
+    "map_per_class": -1.0, "map_small": -1.0, "mar_1": 0.6, "mar_10": 0.6,
+    "mar_100": 0.6, "mar_100_per_class": -1.0, "mar_large": 0.6, "mar_medium": -1.0,
+    "mar_small": -1.0, "classes": 0,
+}
+
+_MAP_SEGM_GOLDEN = {
+    "map": 0.2, "map_50": 1.0, "map_75": 0.0, "map_large": -1.0, "map_medium": -1.0,
+    "map_per_class": -1.0, "map_small": 0.2, "mar_1": 0.2, "mar_10": 0.2,
+    "mar_100": 0.2, "mar_100_per_class": -1.0, "mar_large": -1.0, "mar_medium": -1.0,
+    "mar_small": 0.2, "classes": 0,
+}
+
+
+def _assert_map_golden(result, golden):
+    for key, want in golden.items():
+        got = float(np.asarray(result[key]))
+        # doctest prints 4 decimals; integer sentinels are exact
+        assert got == pytest.approx(want, abs=5e-5), f"{key}: {got} != {want}"
+
+
+def test_default_thresholds_match_torch_linspace():
+    """The pinned default IoU/recall threshold literals must equal the reference's
+    torch.linspace(.., dtype=float32) values EXACTLY — the f32 quantization is
+    load-bearing (the segm golden's map=0.2 hinges on 0.6000000238418579)."""
+    from torchmetrics_tpu.functional.detection._map_eval import (
+        DEFAULT_IOU_THRESHOLDS,
+        DEFAULT_REC_THRESHOLDS,
+    )
+
+    assert DEFAULT_IOU_THRESHOLDS == torch.linspace(0.5, 0.95, round((0.95 - 0.5) / 0.05) + 1).tolist()
+    assert DEFAULT_REC_THRESHOLDS == torch.linspace(0.0, 1.00, round(1.00 / 0.01) + 1).tolist()
+
+
+def test_map_unsorted_custom_thresholds_order_agnostic():
+    """The rank-based eligibility encoding must make user-supplied unsorted
+    iou_thresholds behave identically to the sorted list (per-threshold semantics,
+    like the per-threshold >= comparison it replaced)."""
+    from torchmetrics_tpu.detection import MeanAveragePrecision
+
+    rng = np.random.default_rng(8)
+    preds, target = [], []
+    for _ in range(6):
+        n, m = rng.integers(1, 6), rng.integers(1, 6)
+        xy = rng.uniform(0, 200, (n, 2)); wh = rng.uniform(5, 80, (n, 2))
+        bxy = rng.uniform(0, 200, (m, 2)); bwh = rng.uniform(5, 80, (m, 2))
+        preds.append(dict(boxes=np.concatenate([xy, xy + wh], -1).astype(np.float32),
+                          scores=rng.uniform(size=n).astype(np.float32),
+                          labels=rng.integers(0, 3, n)))
+        target.append(dict(boxes=np.concatenate([bxy, bxy + bwh], -1).astype(np.float32),
+                           labels=rng.integers(0, 3, m)))
+    a = MeanAveragePrecision(iou_thresholds=[0.75, 0.5, 0.6])
+    b = MeanAveragePrecision(iou_thresholds=[0.5, 0.6, 0.75])
+    a.update(preds, target)
+    b.update(preds, target)
+    ra, rb = a.compute(), b.compute()
+    for key in ("map", "map_50", "map_75", "mar_100"):
+        assert float(np.asarray(ra[key])) == pytest.approx(float(np.asarray(rb[key])), abs=1e-7)
+
+
+def test_map_bbox_doctest_golden():
+    from torchmetrics_tpu.detection import MeanAveragePrecision
+
+    preds = [dict(boxes=np.array([[258.0, 41.0, 606.0, 285.0]], np.float32),
+                  scores=np.array([0.536], np.float32), labels=np.array([0]))]
+    target = [dict(boxes=np.array([[214.0, 41.0, 562.0, 285.0]], np.float32),
+                   labels=np.array([0]))]
+    metric = MeanAveragePrecision(iou_type="bbox")
+    metric.update(preds, target)
+    _assert_map_golden(metric.compute(), _MAP_BBOX_GOLDEN)
+
+
+def test_map_segm_doctest_golden():
+    from torchmetrics_tpu.detection import MeanAveragePrecision
+
+    mask_pred = np.zeros((5, 5), bool)
+    mask_pred[1:3, 2:4] = True
+    mask_tgt = np.zeros((5, 5), bool)
+    mask_tgt[1:4, 2] = True
+    mask_tgt[2, 3] = True
+    preds = [dict(masks=mask_pred[None], scores=np.array([0.536], np.float32),
+                  labels=np.array([0]))]
+    target = [dict(masks=mask_tgt[None], labels=np.array([0]))]
+    metric = MeanAveragePrecision(iou_type="segm")
+    metric.update(preds, target)
+    _assert_map_golden(metric.compute(), _MAP_SEGM_GOLDEN)
+
+
+# -------------------------------------------------------------------- VMAF ---
+# /root/reference/src/torchmetrics/functional/video/vmaf.py:107-109: the
+# ``integer_adm2`` rows printed by vmaf-torch (libvmaf's fixed-point path).
+
+_VMAF_ADM2_GOLDEN = np.array([
+    [0.45, 0.45, 0.36, 0.47, 0.43, 0.36, 0.39, 0.41, 0.37, 0.47],
+    [0.42, 0.39, 0.44, 0.37, 0.45, 0.39, 0.38, 0.48, 0.39, 0.39],
+])
+
+
+def test_vmaf_adm2_doctest_golden():
+    """In-tree float ADM vs the vmaf-torch integer-path golden. Envelope 0.05:
+    measured max deviation is 0.0448, the float-vs-fixed-point + deep-scale
+    (2x2 band) boundary residual at this tiny 32x32 frame size. Guards both the
+    algorithm structure (libvmaf float-ADM semantics) and regressions: the
+    pre-round-4 re-derivation sat at 0.205 from this golden."""
+    from torchmetrics_tpu.functional.video.vmaf import adm_features, calculate_luma
+
+    preds = torch.rand(2, 3, 10, 32, 32, generator=torch.manual_seed(42)).numpy()
+    target = torch.rand(2, 3, 10, 32, 32, generator=torch.manual_seed(43)).numpy()
+    ref_luma = calculate_luma(np.asarray(target))
+    dist_luma = calculate_luma(np.asarray(preds))
+    adm2 = np.asarray(adm_features(ref_luma, dist_luma)["adm2"])
+    np.testing.assert_allclose(adm2, _VMAF_ADM2_GOLDEN, atol=0.05)
+
+
+# ------------------------------------------------------------- PESQ / STOI ---
+# /root/reference/src/torchmetrics/functional/audio/pesq.py:71-78 and
+# stoi.py:63-69: values computed by the native pesq / pystoi wheels.
+
+
+def test_pesq_doctest_golden():
+    from torchmetrics_tpu.functional.audio.external import (
+        _PESQ_AVAILABLE,
+        perceptual_evaluation_speech_quality,
+    )
+
+    if not _PESQ_AVAILABLE:
+        pytest.skip("pesq wheel not installed (reference gates identically)")
+    # doctest draws preds then target from one seeded stream
+    gen = torch.manual_seed(42)
+    preds = torch.randn(8000, generator=gen).numpy()
+    target = torch.randn(8000, generator=gen).numpy()
+    nb = float(perceptual_evaluation_speech_quality(preds, target, 8000, "nb"))
+    wb = float(perceptual_evaluation_speech_quality(preds, target, 16000, "wb"))
+    assert nb == pytest.approx(2.2885, abs=5e-4)
+    assert wb == pytest.approx(1.6805, abs=5e-4)
+
+
+def test_stoi_doctest_golden():
+    from torchmetrics_tpu.functional.audio.external import (
+        _PYSTOI_AVAILABLE,
+        short_time_objective_intelligibility,
+    )
+
+    if not _PYSTOI_AVAILABLE:
+        pytest.skip("pystoi wheel not installed (reference gates identically)")
+    gen = torch.manual_seed(42)
+    preds = torch.randn(8000, generator=gen).numpy()
+    target = torch.randn(8000, generator=gen).numpy()
+    val = float(short_time_objective_intelligibility(preds, target, 8000))
+    assert val == pytest.approx(-0.084, abs=1e-3)
+
+
+def test_dnsmos_doctest_golden():
+    """Reference dnsmos.py:227-232 golden ``[2.2..., 2.0..., 1.1..., 1.2...]``
+    needs the trained DNSMOS ONNX models (downloaded artifacts); asserted when a
+    model provider is configured, skipped (reason-pinned) otherwise."""
+    import os
+
+    from torchmetrics_tpu.functional.audio.dnsmos import (
+        _ONNXRUNTIME_AVAILABLE,
+        DNSMOS_DIR,
+        deep_noise_suppression_mean_opinion_score,
+    )
+
+    if not (_ONNXRUNTIME_AVAILABLE and os.path.exists(f"{DNSMOS_DIR}/DNSMOS/model_v8.onnx")):
+        pytest.skip("DNSMOS ONNX models unavailable offline (reference gates identically)")
+
+    gen = torch.manual_seed(42)
+    preds = torch.randn(8000, generator=gen).numpy()
+    moss = np.asarray(deep_noise_suppression_mean_opinion_score(preds, 8000, False))
+    # doctest prints to 1 decimal of precision via ellipsis
+    assert moss[0] == pytest.approx(2.2, abs=0.1)
+    assert moss[1] == pytest.approx(2.0, abs=0.1)
+    assert moss[2] == pytest.approx(1.1, abs=0.1)
+    assert moss[3] == pytest.approx(1.2, abs=0.1)

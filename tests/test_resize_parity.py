@@ -1,0 +1,178 @@
+"""Direct parity battery for BOTH extractor resize forks (VERDICT r3 #1).
+
+The reference extractor (``/root/reference/src/torchmetrics/image/fid.py:88-101``)
+resizes with torch ``F.interpolate(..., antialias=True)`` or torch-fidelity's
+TF1-legacy bilinear. SURVEY §7 names interpolation parity as what makes FID
+comparable across implementations, so each fork is anchored here at FID's actual
+ratios (arbitrary sizes -> 299, up- and downscale, odd sizes):
+
+- ``antialias=True``  -> directly against torch (installed in the pod), twice:
+  (a) the 1-D weight tables recovered from torch by delta-probing (the exact
+  semantics check, <=5e-6 per weight), and (b) end-to-end images at the f32
+  matmul accumulation envelope.
+- ``antialias=False`` -> torch-fidelity is NOT installed here, so the anchor is an
+  independent per-pixel gather oracle written from the TF1
+  ``half_pixel_centers=False`` definition (``src = i * in/out``, floor/ceil taps
+  clamped to the last row) — a gather formulation, deliberately a different
+  computation route than the production matmul kernel.
+
+NOTE: torch's own antialias kernel silently returns garbage when any spatial axis
+has size 1 (verified: a 64->299 ramp with W=1 comes back all-zeros on torch 2.13
+CPU), so every probe here keeps both axes >= 2.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+import torch
+
+from torchmetrics_tpu.functional.image._resize import (
+    _antialias_weights_1d,
+    resize_bilinear_antialias,
+    resize_bilinear_tf1,
+)
+
+# FID-realistic ratio grid: odd/even sizes, up- and downscale, identity, non-299
+# targets so nothing is special-cased to the flagship shape.
+SIZE_GRID = [
+    ((64, 64), (299, 299)),      # upscale (CIFAR -> Inception)
+    ((75, 113), (299, 299)),     # odd up, anisotropic
+    ((171, 171), (299, 299)),    # odd up
+    ((256, 256), (299, 299)),    # even up
+    ((299, 299), (299, 299)),    # identity
+    ((300, 300), (299, 299)),    # near-identity down (worst-case tap layout)
+    ((320, 240), (299, 299)),    # mixed up/down per-axis
+    ((512, 512), (299, 299)),    # even down
+    ((517, 383), (299, 299)),    # odd down, anisotropic
+    ((640, 480), (299, 299)),    # VGA down
+    ((299, 299), (64, 64)),      # strong down, non-299 target
+    ((100, 100), (37, 53)),      # odd small target
+    ((50, 50), (150, 150)),      # exact 3x up
+]
+
+# Unique 1-D (in, out) axis pairs covered by the grid above.
+AXIS_PAIRS = sorted({(i, o) for (ih, iw), (oh, ow) in SIZE_GRID for i, o in ((ih, oh), (iw, ow))})
+
+
+def _rand_imgs(rng: np.random.Generator, h: int, w: int, n: int = 2, c: int = 3) -> np.ndarray:
+    # unit-range content: the normalized extractor input scale
+    return rng.uniform(0.0, 1.0, size=(n, c, h, w)).astype(np.float32)
+
+
+def _torch_aa_weights_1d(in_size: int, out_size: int) -> np.ndarray:
+    """Recover torch's antialias resize weight table by resizing per-row deltas
+    along H (W held at 8: torch's aa kernel mis-handles size-1 axes)."""
+    img = np.zeros((1, 1, in_size, 8), np.float32)
+    rows = []
+    for j in range(in_size):
+        img[:] = 0.0
+        img[0, 0, j, :] = 1.0
+        out = torch.nn.functional.interpolate(
+            torch.from_numpy(img), size=(out_size, 8), mode="bilinear", align_corners=False, antialias=True
+        ).numpy()[0, 0, :, 0]
+        rows.append(out)
+    return np.stack(rows, axis=1)  # (out, in)
+
+
+@pytest.mark.parametrize(("in_size", "out_size"), AXIS_PAIRS)
+def test_antialias_weight_tables_match_torch(in_size, out_size):
+    """The exact semantics anchor: our precomputed 1-D triangle-filter tables carry
+    the same tap support as torch's and agree to 5e-5 per weight. Torch computes its
+    tables in f32 (centers/fractions rounded per-row, measured drift up to ~3e-5 at
+    e.g. 300->299); ours are f64-derived then cast, so the residual is torch-side
+    rounding, not a semantics difference."""
+    ours = _antialias_weights_1d(in_size, out_size)
+    ref = _torch_aa_weights_1d(in_size, out_size)
+    # identical tap support (structure of the filter — the semantic part)
+    np.testing.assert_array_equal(ours > 1e-4, ref > 1e-4)
+    np.testing.assert_allclose(ours, ref, atol=5e-5, rtol=0)
+
+
+@pytest.mark.parametrize(("in_size", "out_size"), SIZE_GRID)
+def test_antialias_fork_matches_torch_end_to_end(in_size, out_size):
+    """Full images vs torch F.interpolate(antialias=True). Tolerance 1e-4 on
+    unit-range data is the f32 envelope: two f32 matmul passes vs torch's f32
+    separable conv accumulate in different orders (measured max ~5e-5)."""
+    rng = np.random.default_rng(42)
+    imgs = _rand_imgs(rng, *in_size)
+    ours = np.asarray(resize_bilinear_antialias(imgs, out_size))
+    ref = torch.nn.functional.interpolate(
+        torch.from_numpy(imgs), size=out_size, mode="bilinear", align_corners=False, antialias=True
+    ).numpy()
+    np.testing.assert_allclose(ours, ref, atol=1e-4, rtol=0)
+
+
+def _tf1_gather_oracle(imgs: np.ndarray, out_size) -> np.ndarray:
+    """Per-pixel TF1-legacy bilinear (half_pixel_centers=False, align_corners=False):
+    src = out_idx * in/out, two taps floor/floor+1 clamped, lerp by the fraction.
+    Gather formulation in f64 — independent of the production matmul kernel."""
+    out = imgs.astype(np.float64)
+    for axis, o in ((-2, out_size[0]), (-1, out_size[1])):
+        n = out.shape[axis]
+        scale = n / o if o > 1 else 0.0
+        src = np.arange(o) * scale
+        lo = np.minimum(np.floor(src).astype(np.int64), n - 1)
+        hi = np.minimum(lo + 1, n - 1)
+        frac = src - lo
+        lo_v = np.take(out, lo, axis=axis)
+        hi_v = np.take(out, hi, axis=axis)
+        shape = [1] * out.ndim
+        shape[axis] = o
+        f = frac.reshape(shape)
+        out = lo_v * (1.0 - f) + hi_v * f
+    return out
+
+
+@pytest.mark.parametrize(("in_size", "out_size"), SIZE_GRID)
+def test_tf1_fork_matches_gather_oracle(in_size, out_size):
+    rng = np.random.default_rng(7)
+    imgs = _rand_imgs(rng, *in_size)
+    ours = np.asarray(resize_bilinear_tf1(imgs, out_size))
+    ref = _tf1_gather_oracle(imgs, out_size)
+    np.testing.assert_allclose(ours, ref, atol=1e-5, rtol=1e-5)
+
+
+def test_tf1_known_values_integer_upscale():
+    """Closed-form TF1 semantics: 2 -> 4 with scale 0.5 gives src = [0, .5, 1, 1.5]
+    -> [a, (a+b)/2, b, b] (last tap clamps to the final source row)."""
+    a, b = 10.0, 30.0
+    img = np.full((1, 1, 2, 2), 0.0, dtype=np.float32)
+    img[0, 0, 0, :] = a
+    img[0, 0, 1, :] = b
+    out = np.asarray(resize_bilinear_tf1(img, (4, 2)))[0, 0, :, 0]
+    np.testing.assert_allclose(out, [a, (a + b) / 2, b, b], atol=1e-5)
+
+
+def test_both_forks_identity_exact():
+    rng = np.random.default_rng(3)
+    imgs = _rand_imgs(rng, 299, 299, n=1)
+    np.testing.assert_allclose(np.asarray(resize_bilinear_antialias(imgs, (299, 299))), imgs, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(resize_bilinear_tf1(imgs, (299, 299))), imgs, atol=1e-6)
+
+
+def test_antialias_upscale_equals_plain_bilinear():
+    """On pure upscale the antialias triangle filter support clamps to 1, so the
+    fork must coincide with torch's non-antialiased half-pixel bilinear."""
+    rng = np.random.default_rng(11)
+    imgs = _rand_imgs(rng, 64, 64)
+    ours = np.asarray(resize_bilinear_antialias(imgs, (128, 128)))
+    ref = torch.nn.functional.interpolate(
+        torch.from_numpy(imgs), size=(128, 128), mode="bilinear", align_corners=False, antialias=False
+    ).numpy()
+    np.testing.assert_allclose(ours, ref, atol=1e-5, rtol=1e-5)
+
+
+def test_extractor_antialias_false_uses_tf1():
+    """Wiring check (round-3 VERDICT weak #1: this branch silently used a third
+    semantics): the extractor's antialias=False path must BE the TF1 kernel."""
+    from torchmetrics_tpu.image._extractors import InceptionV3Features
+
+    rng = np.random.default_rng(5)
+    imgs = _rand_imgs(rng, 64, 64)
+    for antialias, kernel in ((False, resize_bilinear_tf1), (True, resize_bilinear_antialias)):
+        extractor = InceptionV3Features(seed=0, resize_antialias=antialias)
+        got = np.asarray(extractor(imgs))
+        # float input is scaled to the extractor's 0-255 working range before resize
+        expected = np.asarray(extractor._apply(extractor.params, kernel(imgs * 255.0, (299, 299))))
+        np.testing.assert_allclose(got, expected, atol=1e-5, rtol=1e-5)

@@ -390,7 +390,9 @@ def test_inception_converter_parity(tmp_path):
     rng = np.random.default_rng(16)
     imgs = rng.random((2, 3, 299, 299)).astype(np.float32)
     with torch.no_grad():
-        want = twin(torch.as_tensor((imgs - 0.5) / 0.5)).numpy()
+        # the trunk mirrors torch-fidelity's (x - 128)/128 on 0-255 input
+        # (reference image/fid.py:103); [0,1] floats are scaled by 255 on entry
+        want = twin(torch.as_tensor((imgs * 255.0 - 128.0) / 128.0)).numpy()
 
     out = tmp_path / "inception.pkl"
     convert_torchvision_inception_weights(twin.state_dict(), str(out))

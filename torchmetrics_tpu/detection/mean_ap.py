@@ -15,7 +15,13 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 import jax.numpy as jnp
 import numpy as np
 
-from ..functional.detection._map_eval import MAPInputs, evaluate_map, summarize
+from ..functional.detection._map_eval import (
+    DEFAULT_IOU_THRESHOLDS,
+    DEFAULT_REC_THRESHOLDS,
+    MAPInputs,
+    evaluate_map,
+    summarize,
+)
 from ..metric import HostMetric
 from .helpers import _boxes_to_xyxy_np, _input_validator
 
@@ -72,12 +78,14 @@ class MeanAveragePrecision(HostMetric):
             raise ValueError(
                 f"Expected argument `iou_thresholds` to either be `None` or a list of floats but got {iou_thresholds}"
             )
-        self.iou_thresholds = iou_thresholds or np.linspace(0.5, 0.95, round((0.95 - 0.5) / 0.05) + 1).tolist()
+        # defaults are the reference's f32-quantized torch.linspace values — the
+        # quantization is load-bearing for boundary-tie parity (_map_eval.py)
+        self.iou_thresholds = iou_thresholds or list(DEFAULT_IOU_THRESHOLDS)
         if rec_thresholds is not None and not isinstance(rec_thresholds, list):
             raise ValueError(
                 f"Expected argument `rec_thresholds` to either be `None` or a list of floats but got {rec_thresholds}"
             )
-        self.rec_thresholds = rec_thresholds or np.linspace(0.0, 1.00, round(1.00 / 0.01) + 1).tolist()
+        self.rec_thresholds = rec_thresholds or list(DEFAULT_REC_THRESHOLDS)
         if max_detection_thresholds is not None and not isinstance(max_detection_thresholds, list):
             raise ValueError(
                 f"Expected argument `max_detection_thresholds` to either be `None` or a list of ints"

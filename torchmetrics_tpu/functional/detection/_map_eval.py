@@ -25,9 +25,11 @@ scatter formulation miscompiles under XLA for row batches >= 64 (batch-size-depe
 wrong matches, observed identically on CPU and TPU backends with jax 0.9) — the
 one-hot | or formulation is both correct at every batch size and ~600x faster.
 
-IoU matrices are computed in float64 on host and downcast to float32 for the device
-matcher; IoU values that tie *exactly* at a threshold boundary in f64 may resolve
-differently than pycocotools — empirically immaterial on real boxes.
+IoU matrices are computed in float64 on host; threshold eligibility is resolved there
+too (f64 IoU vs f64 thresholds, pycocotools comparison semantics) and shipped to the
+device matcher as an int32 cleared-threshold count, so the f32 IoU the matcher keeps
+for best-match argmax can never flip a boundary tie (caught by the segm doctest
+golden, tests/test_reference_doctest_goldens.py).
 """
 
 from __future__ import annotations
@@ -47,12 +49,47 @@ _AREA_RANGES = np.array(
 _AREA_KEYS = ("all", "small", "medium", "large")
 _ROW_BLOCK = 8192  # matcher rows per XLA call (memory/compile trade-off)
 
+# Default thresholds: the reference builds these with torch.linspace in FLOAT32
+# (mean_ap.py:382,388) and feeds the f32-quantized values into COCOeval as f64, so
+# e.g. its "0.6" IoU threshold is really 0.6000000238418579 — an exact-0.6 IoU does
+# NOT clear it (the segm doctest golden, map 0.2 not 0.3, hinges on this). The exact
+# values are pinned here as literals; tests/test_reference_doctest_goldens.py
+# asserts them against torch.linspace.
+DEFAULT_IOU_THRESHOLDS = [
+    0.5, 0.550000011920929, 0.6000000238418579, 0.6499999761581421, 0.699999988079071,
+    0.75, 0.800000011920929, 0.8500000238418579, 0.8999999761581421, 0.949999988079071,
+]
+DEFAULT_REC_THRESHOLDS = [
+    0.0, 0.009999999776482582, 0.019999999552965164, 0.029999999329447746, 0.03999999910593033,
+    0.04999999701976776, 0.05999999865889549, 0.07000000029802322, 0.07999999821186066, 0.08999999612569809,
+    0.09999999403953552, 0.10999999940395355, 0.11999999731779099, 0.12999999523162842, 0.14000000059604645,
+    0.14999999105930328, 0.1599999964237213, 0.17000000178813934, 0.17999999225139618, 0.1899999976158142,
+    0.19999998807907104, 0.20999999344348907, 0.2199999988079071, 0.22999998927116394, 0.23999999463558197,
+    0.25, 0.25999999046325684, 0.26999998092651367, 0.2800000011920929, 0.28999999165534973,
+    0.29999998211860657, 0.3100000023841858, 0.3199999928474426, 0.32999998331069946, 0.3400000035762787,
+    0.3499999940395355, 0.35999998450279236, 0.3700000047683716, 0.3799999952316284, 0.38999998569488525,
+    0.3999999761581421, 0.4099999964237213, 0.41999998688697815, 0.429999977350235, 0.4399999976158142,
+    0.44999998807907104, 0.4599999785423279, 0.4699999988079071, 0.47999998927116394, 0.4899999797344208,
+    0.5, 0.5099999904632568, 0.5199999809265137, 0.5300000309944153, 0.5400000214576721,
+    0.550000011920929, 0.5600000023841858, 0.5699999928474426, 0.5799999833106995, 0.5900000333786011,
+    0.6000000238418579, 0.6100000143051147, 0.6200000047683716, 0.6299999952316284, 0.6399999856948853,
+    0.6500000357627869, 0.6600000262260437, 0.6700000166893005, 0.6800000071525574, 0.6899999976158142,
+    0.699999988079071, 0.7099999785423279, 0.7200000286102295, 0.7300000190734863, 0.7400000095367432,
+    0.75, 0.7599999904632568, 0.7699999809265137, 0.7800000309944153, 0.7900000214576721,
+    0.800000011920929, 0.8100000023841858, 0.8199999928474426, 0.8299999833106995, 0.8400000333786011,
+    0.8500000238418579, 0.8600000143051147, 0.8700000047683716, 0.8799999952316284, 0.8899999856948853,
+    0.8999999761581421, 0.9100000262260437, 0.9200000166893005, 0.9300000071525574, 0.9399999976158142,
+    0.949999988079071, 0.9599999785423279, 0.9700000286102295, 0.9800000190734863, 0.9900000095367432,
+    1.0,
+]
+
 
 def _mask_iou_np(dets: np.ndarray, gts: np.ndarray, crowd: np.ndarray) -> np.ndarray:
-    """Host pairwise mask IoU for one cell — per-cell device dispatch would dominate
-    at COCO scale, and host BLAS handles the small pixel matmuls fine."""
-    d = dets.reshape(dets.shape[0], -1).astype(np.float32)
-    g = gts.reshape(gts.shape[0], -1).astype(np.float32)
+    """Host pairwise mask IoU for one cell (f64, pycocotools dtype) — per-cell device
+    dispatch would dominate at COCO scale, and host BLAS handles the small pixel
+    matmuls fine."""
+    d = dets.reshape(dets.shape[0], -1).astype(np.float64)
+    g = gts.reshape(gts.shape[0], -1).astype(np.float64)
     inter = d @ g.T
     d_area = d.sum(-1)[:, None]
     union = d_area + g.sum(-1)[None, :] - inter
@@ -86,15 +123,26 @@ def _bucket(n: int, floor: int = 4) -> int:
 @jax.jit
 def _match_kernel(
     iou: jnp.ndarray,  # (R, D, G) crowd-adjusted IoU, dets score-sorted per row
+    clears: jnp.ndarray,  # (R, D, G) int32: #sorted-thresholds cleared, resolved in f64 on host
     det_valid: jnp.ndarray,  # (R, D) bool
     det_area: jnp.ndarray,  # (R, D)
     gt_valid: jnp.ndarray,  # (R, G) bool
     gt_area: jnp.ndarray,  # (R, G)
     gt_crowd: jnp.ndarray,  # (R, G) bool
-    iou_thrs: jnp.ndarray,  # (T,)
+    thr_idx: jnp.ndarray,  # (T,) int32: rank of each threshold in ascending order
     area_ranges: jnp.ndarray,  # (A, 2)
 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Greedy COCO matching over rows x area ranges x IoU thresholds in one scan.
+
+    Threshold eligibility arrives pre-resolved as ``clears`` (``iou >= thrs[t]``
+    iff ``clears > thr_idx[t]``, where ``clears`` counts cleared thresholds in
+    ascending order and ``thr_idx`` is each threshold's rank — order-agnostic for
+    user-supplied unsorted lists): pycocotools compares f64 IoUs against f64
+    thresholds, and ties at a boundary (e.g. an exact-0.6 IoU vs the reference's
+    f32-quantized 0.6000000238418579) resolve differently in f32 — caught by the
+    segm doctest golden (tests/test_reference_doctest_goldens.py). The f32
+    ``iou`` is then only used for best-match argmax, where pycocotools order is
+    preserved.
 
     Returns ``det_match (R,A,T,D)``, ``det_ignore (R,A,T,D)``, ``gt_ignore (R,A,G)``.
     """
@@ -107,15 +155,15 @@ def _match_kernel(
     det_out = (det_area[:, None, :] < area_ranges[None, :, :1]) | (
         det_area[:, None, :] > area_ranges[None, :, 1:]
     )  # (R, A, D)
-    thr_eff = jnp.minimum(iou_thrs, 1.0 - 1e-10)  # (T,)
     num_gt = iou.shape[-1]
 
     def step(gt_matched, d):  # gt_matched: (R, A, T, G)
         row = iou[:, d, :][:, None, None, :]  # (R,1,1,G)
+        clears_row = clears[:, d, :][:, None, None, :]  # (R,1,1,G)
         cand = (
             gt_valid[:, None, None, :]
             & (~gt_matched | gt_crowd[:, None, None, :])
-            & (row >= thr_eff[None, None, :, None])
+            & (clears_row > thr_idx[None, None, :, None])
             & det_valid[:, d][:, None, None, None]
         )
         cand_nonign = cand & ~gt_ign[:, :, None, :]
@@ -128,7 +176,7 @@ def _match_kernel(
         ign_of_m = (oh & gt_ign[:, :, None, :]).any(-1)  # cheap-to-compile gather of gt_ign[m]
         return gt_matched, (matched, ign_of_m)
 
-    init = jnp.zeros((iou.shape[0], area_ranges.shape[0], iou_thrs.shape[0], num_gt), bool)
+    init = jnp.zeros((iou.shape[0], area_ranges.shape[0], thr_idx.shape[0], num_gt), bool)
     _, (dm, dig) = lax.scan(step, init, jnp.arange(iou.shape[1]))
     dm = jnp.moveaxis(dm, 0, -1)  # (R, A, T, D)
     dig = jnp.moveaxis(dig, 0, -1)
@@ -295,12 +343,17 @@ def _build_rows(
     return rb
 
 
-def _block_iou_bbox(rb: _RowBatch, sl: slice) -> np.ndarray:
+def _block_iou_bbox(rb: _RowBatch, sl: slice, thrs64: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
     """Pairwise crowd-adjusted IoU for a row block, f64 math (pycocotools dtype)
     broadcast in bounded sub-chunks: at COCO scale (dmax=gmax=128) a whole-block
-    broadcast would stage multi-GB f64 temporaries, mostly padding."""
+    broadcast would stage multi-GB f64 temporaries, mostly padding.
+
+    Returns ``(iou_f32, clears_i32)``: threshold eligibility is resolved here in
+    f64 against the f64 thresholds (pycocotools comparison semantics) before the
+    downcast, so f32 rounding can never flip a boundary tie."""
     n = sl.stop - sl.start
     out = np.empty((n, rb.dmax, rb.gmax), np.float32)
+    clears = np.empty((n, rb.dmax, rb.gmax), np.int32)
     step = max(1, int(128 * 1024 * 1024 // max(1, rb.dmax * rb.gmax * 8 * 4)))
     for s in range(0, n, step):
         dbox = rb.det_box[sl.start + s : sl.start + min(s + step, n)]  # (C, dmax, 4)
@@ -314,13 +367,16 @@ def _block_iou_bbox(rb: _RowBatch, sl: slice) -> np.ndarray:
         union = d_area[:, :, None] + g_area[:, None, :] - inter
         crowd = rb.gt_crowd[sl.start + s : sl.start + min(s + step, n)]
         denom = np.where(crowd[:, None, :], d_area[:, :, None], union)
-        out[s : s + dbox.shape[0]] = np.where(denom > 0, inter / np.where(denom > 0, denom, 1.0), 0.0)
-    return out
+        iou64 = np.where(denom > 0, inter / np.where(denom > 0, denom, 1.0), 0.0)
+        out[s : s + dbox.shape[0]] = iou64
+        clears[s : s + dbox.shape[0]] = np.searchsorted(thrs64, iou64.reshape(-1), side="right").reshape(iou64.shape)
+    return out, clears
 
 
-def _block_iou_segm(rb: _RowBatch, sl: slice, inputs: MAPInputs) -> np.ndarray:
+def _block_iou_segm(rb: _RowBatch, sl: slice, inputs: MAPInputs, thrs64: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
     """Segm IoU per cell (pixel matmul on host); cells are ragged in H,W so the
-    block can't be one broadcast like bbox."""
+    block can't be one broadcast like bbox. Returns ``(iou_f32, clears_i32)`` with
+    f64 threshold resolution like ``_block_iou_bbox``."""
     src_d, bounds_d = rb.det_src
     src_g, bounds_g = rb.gt_src
     d_sizes = np.array([x.size for x in inputs.det_labels], np.int64)
@@ -328,6 +384,7 @@ def _block_iou_segm(rb: _RowBatch, sl: slice, inputs: MAPInputs) -> np.ndarray:
     d_off = np.concatenate([[0], np.cumsum(d_sizes)])
     g_off = np.concatenate([[0], np.cumsum(g_sizes)])
     iou = np.zeros((sl.stop - sl.start, rb.dmax, rb.gmax), np.float32)
+    clears = np.zeros((sl.stop - sl.start, rb.dmax, rb.gmax), np.int32)
     for off, r in enumerate(range(sl.start, sl.stop)):
         ds = src_d[bounds_d[r] : bounds_d[r + 1]]
         gs = src_g[bounds_g[r] : bounds_g[r + 1]]
@@ -337,10 +394,12 @@ def _block_iou_segm(rb: _RowBatch, sl: slice, inputs: MAPInputs) -> np.ndarray:
         d_local = ds - d_off[img]
         g_local = gs - g_off[img]
         crowd = inputs.gt_crowds[img][g_local].astype(bool)
-        iou[off, : ds.size, : gs.size] = _mask_iou_np(
-            inputs.det_masks[img][d_local], inputs.gt_masks[img][g_local], crowd
-        )
-    return iou
+        cell64 = _mask_iou_np(inputs.det_masks[img][d_local], inputs.gt_masks[img][g_local], crowd)
+        iou[off, : ds.size, : gs.size] = cell64
+        clears[off, : ds.size, : gs.size] = np.searchsorted(
+            thrs64, cell64.reshape(-1), side="right"
+        ).reshape(cell64.shape)
+    return iou, clears
 
 
 def evaluate_map(
@@ -389,26 +448,41 @@ def evaluate_map(
     # device where the state already lives.)
     matcher_device = jax.local_devices(backend="cpu")[0]
     with jax.default_device(matcher_device):
-        iou_thrs_j = jnp.asarray(np.asarray(iou_thresholds, np.float32))
+        # pycocotools clamps each threshold: iou = min(t, 1 - 1e-10), so an exact
+        # 1.0 IoU still clears a 1.0 threshold. `clears` counts against the SORTED
+        # thresholds and each threshold gets its ascending rank, so user-supplied
+        # unsorted lists resolve correctly (searchsorted needs sorted input).
+        thrs_eff = np.minimum(np.asarray(iou_thresholds, np.float64), 1.0 - 1e-10)
+        order = np.argsort(thrs_eff, kind="stable")
+        thrs64 = thrs_eff[order]
+        ranks = np.empty(len(iou_thresholds), np.int32)
+        ranks[order] = np.arange(len(iou_thresholds), dtype=np.int32)
+        thr_idx_j = jnp.asarray(ranks)
         area_ranges_j = jnp.asarray(_AREA_RANGES)
         for block_start in range(0, num_rows, _ROW_BLOCK):
             sl = slice(block_start, min(block_start + _ROW_BLOCK, num_rows))
             n = sl.stop - sl.start
             pad = _ROW_BLOCK if num_rows > _ROW_BLOCK else _bucket(n)
-            iou_b = _block_iou_bbox(rb, sl) if iou_type == "bbox" else _block_iou_segm(rb, sl, inputs)
+            iou_b, clears_b = (
+                _block_iou_bbox(rb, sl, thrs64)
+                if iou_type == "bbox"
+                else _block_iou_segm(rb, sl, inputs, thrs64)
+            )
             if pad > n:
                 iou_b = np.concatenate([iou_b, np.zeros((pad - n, rb.dmax, rb.gmax), np.float32)])
+                clears_b = np.concatenate([clears_b, np.zeros((pad - n, rb.dmax, rb.gmax), np.int32)])
             pad_rows = lambda a, fill=False: (
                 a[sl] if pad == n else np.concatenate([a[sl], np.full((pad - n, *a.shape[1:]), fill, a.dtype)])
             )
             dm_b, dig_b, gt_ign_b = _match_kernel(
                 jnp.asarray(iou_b),
+                jnp.asarray(clears_b),
                 jnp.asarray(pad_rows(rb.det_valid)),
                 jnp.asarray(pad_rows(rb.det_area)),
                 jnp.asarray(pad_rows(rb.gt_valid)),
                 jnp.asarray(pad_rows(rb.gt_area)),
                 jnp.asarray(pad_rows(rb.gt_crowd)),
-                iou_thrs_j,
+                thr_idx_j,
                 area_ranges_j,
             )
             dm_all[sl] = np.asarray(dm_b)[:n]

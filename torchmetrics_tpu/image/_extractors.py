@@ -19,7 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from ..functional.image._resize import resize_bilinear_antialias
+from ..functional.image._resize import resize_bilinear_antialias, resize_bilinear_tf1
 
 
 def _conv(x, w, stride=1, padding="SAME"):
@@ -99,12 +99,15 @@ def _inception_e(x, p):
 
 
 def _inception_forward(params: Dict[str, Any], imgs: jnp.ndarray) -> jnp.ndarray:
-    """InceptionV3 pool3 features ``(N, 2048)`` from NCHW images in [0, 1] at 299x299.
+    """InceptionV3 pool3 features ``(N, 2048)`` from NCHW images on the 0-255 scale
+    at 299x299.
 
     Runs in the dtype of ``imgs`` (f32 parity trunk or bf16 MXU trunk); the global
     average pool at the end accumulates in f32 either way."""
     params = jax.tree.map(lambda p: p.astype(imgs.dtype), params)
-    x = (imgs - 0.5) / 0.5  # [-1, 1] normalization
+    # torch-fidelity trunk normalization (reference image/fid.py:103): (x - 128)/128
+    # on 0-255 input — NOT the torchvision (x - 127.5)/127.5 centering
+    x = (imgs - 128.0) / 128.0
     x = _basic_conv(x, params["stem1"], stride=2, padding="VALID")
     x = _basic_conv(x, params["stem2"], padding="VALID")
     x = _basic_conv(x, params["stem3"])
@@ -132,9 +135,12 @@ class InceptionV3Features:
 
     ``compute_dtype``: ``"float32"`` (default, ``Precision.HIGHEST`` parity trunk) or
     ``"bfloat16"`` (MXU-native trunk, ~MXU-peak convs; feature means still accumulate
-    in f32). ``resize_antialias=True`` reproduces the reference extractor's TF1-style
-    antialiased bilinear input resize (reference ``image/fid.py:88-101``) instead of
-    plain bilinear — required for FID numbers comparable to torch-fidelity.
+    in f32). ``resize_antialias`` selects between the reference extractor's two input
+    resize forks (reference ``image/fid.py:88-101``): ``True`` (its default) is torch
+    ``F.interpolate(..., antialias=True)`` — the PIL-style triangle filter; ``False``
+    is torch-fidelity's TF1-legacy bilinear (``half_pixel_centers=False``), the fork
+    that reproduces the original TF1 FID resize. Both are parity-tested against their
+    torch anchors in ``tests/test_resize_parity.py``.
     """
 
     num_features = 2048
@@ -160,18 +166,25 @@ class InceptionV3Features:
         self._apply = jax.jit(_inception_forward)
 
     def __call__(self, imgs) -> jnp.ndarray:
+        """Integer input is taken as 0-255; float input as normalized [0, 1] (scaled
+        back to 0-255 here — the trunk and both resize forks run on the 0-255 scale
+        exactly like the reference extractor, whose uint8 contract means resize and
+        normalization both see 0-255 values)."""
         imgs = jnp.asarray(imgs)
         if jnp.issubdtype(imgs.dtype, jnp.integer):
-            imgs = imgs.astype(jnp.float32) / 255.0
+            imgs = imgs.astype(jnp.float32)
+        else:
+            imgs = imgs.astype(jnp.float32) * 255.0
         if imgs.shape[-2:] != (299, 299):
             # resize in f32 regardless of trunk dtype: interpolation parity is what
             # makes FID comparable across extractors (SURVEY §7 hard part)
+            # both forks mirror the reference extractor (image/fid.py:88-101):
+            # antialias=True -> torch F.interpolate(..., antialias=True);
+            # antialias=False -> torch-fidelity's TF1-legacy bilinear
             if self.resize_antialias:
-                imgs = resize_bilinear_antialias(imgs.astype(jnp.float32), (299, 299))
+                imgs = resize_bilinear_antialias(imgs, (299, 299))
             else:
-                imgs = jax.image.resize(
-                    imgs.astype(jnp.float32), (*imgs.shape[:-2], 299, 299), method="bilinear"
-                )
+                imgs = resize_bilinear_tf1(imgs, (299, 299))
         return self._apply(self.params, imgs.astype(self.compute_dtype))
 
     # ---------------------------------------------------------------- params
