@@ -19,13 +19,6 @@ import numpy as np
 import pytest
 import torch
 
-import torchmetrics_tpu as tm
-
-
-def _seeded_randn(*shape):
-    return torch.randn(*shape, generator=torch.manual_seed(42)).numpy()
-
-
 # --------------------------------------------------------------------- mAP ---
 # /root/reference/src/torchmetrics/detection/mean_ap.py:231-247 (bbox) and
 # :293-310 (segm): values printed by the pycocotools-backed evaluator.
