@@ -102,3 +102,92 @@ def test_new_functional_wrappers_smoke():
     multi = clip_image_quality_assessment(imgs, model_name_or_path=Toy(), prompts=("quality", ("A.", "B.")))
     assert set(multi) == {"quality", "user_defined_0"}
     assert np.asarray(multi["quality"]).shape == (3,)
+
+
+def test_functional_signature_parity(ref):
+    """Kwarg-level drop-in parity (VERDICT r3 #7): for all 104 reference
+    functional entry points, every reference parameter must exist in ours
+    (extras on our side — e.g. jax-idiomatic `seed` kwargs — are allowed), and
+    shared defaults must agree by repr. Catches drift like the top-level psnr
+    data_range=3.0 deprecated-wrapper quirk and the logauc facade's
+    average=None default, both of which this sweep found."""
+    import inspect
+
+    ref_f = importlib.import_module("torchmetrics.functional")
+    import torchmetrics_tpu.functional as ours_f
+
+    problems = []
+    for name in sorted(ref_f.__all__):
+        rfn = getattr(ref_f, name, None)
+        ofn = getattr(ours_f, name, None)
+        if not callable(rfn) or not callable(ofn):
+            problems.append(f"{name}: not callable on one side")
+            continue
+        try:
+            rsig, osig = inspect.signature(rfn), inspect.signature(ofn)
+        except (ValueError, TypeError):
+            continue
+        for p, rpar in rsig.parameters.items():
+            if rpar.kind in (inspect.Parameter.VAR_POSITIONAL, inspect.Parameter.VAR_KEYWORD):
+                continue
+            opar = osig.parameters.get(p)
+            if opar is None:
+                problems.append(f"{name}: missing parameter `{p}`")
+                continue
+            if rpar.default is not inspect.Parameter.empty and repr(rpar.default) != repr(opar.default):
+                problems.append(f"{name}: `{p}` default {opar.default!r} != reference {rpar.default!r}")
+    assert not problems, "\n".join(problems)
+
+
+def test_utilities_namespace_parity(ref):
+    """The public torchmetrics.utilities surface (VERDICT r3 #7): top-level
+    __all__ plus the utilities.data helpers the reference documents as public."""
+    ref_u = importlib.import_module("torchmetrics.utilities")
+    import torchmetrics_tpu.utilities as ours_u
+
+    missing = sorted(set(ref_u.__all__) - set(dir(ours_u)))
+    assert not missing, f"utilities exports missing vs reference: {missing}"
+
+    ref_data = importlib.import_module("torchmetrics.utilities.data")
+    import torchmetrics_tpu.utilities.data as ours_data
+
+    public_data = [n for n in dir(ref_data) if not n.startswith("_") and callable(getattr(ref_data, n))]
+    missing_data = [n for n in ("to_onehot", "select_topk", "to_categorical", "dim_zero_cat",
+                                "dim_zero_sum", "dim_zero_mean", "dim_zero_max", "dim_zero_min")
+                    if n in public_data and not hasattr(ours_data, n)]
+    assert not missing_data, f"utilities.data helpers missing: {missing_data}"
+
+
+def test_utilities_value_parity(ref):
+    """reduce/class_reduce/to_onehot/select_topk compute the same values as the
+    reference on shared inputs."""
+    import numpy as np
+    import torch
+
+    ref_u = importlib.import_module("torchmetrics.utilities")
+    from torchmetrics.utilities.data import select_topk as ref_topk
+    from torchmetrics.utilities.data import to_onehot as ref_onehot
+
+    import torchmetrics_tpu.utilities as ours_u
+    from torchmetrics_tpu.utilities.data import select_topk, to_onehot
+
+    x = np.arange(6, dtype=np.float32).reshape(2, 3)
+    for red in ("elementwise_mean", "sum", "none"):
+        np.testing.assert_allclose(
+            np.asarray(ours_u.reduce(x, red)), ref_u.reduce(torch.as_tensor(x), red).numpy(), atol=1e-7
+        )
+    num = np.array([2.0, 0.0, 1.0], np.float32)
+    denom = np.array([4.0, 0.0, 2.0], np.float32)
+    w = np.array([4.0, 0.0, 2.0], np.float32)
+    for cr in ("micro", "macro", "weighted", "none"):
+        np.testing.assert_allclose(
+            np.asarray(ours_u.class_reduce(num, denom, w, cr)),
+            ref_u.class_reduce(torch.as_tensor(num), torch.as_tensor(denom), torch.as_tensor(w), cr).numpy(),
+            atol=1e-7,
+        )
+    labels = np.array([0, 2, 1], np.int64)
+    np.testing.assert_array_equal(np.asarray(to_onehot(labels, 3)), ref_onehot(torch.as_tensor(labels), 3).numpy())
+    probs = np.array([[0.1, 0.7, 0.2], [0.5, 0.3, 0.2]], np.float32)
+    np.testing.assert_array_equal(
+        np.asarray(select_topk(probs, 2)), ref_topk(torch.as_tensor(probs), 2).numpy()
+    )

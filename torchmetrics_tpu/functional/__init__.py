@@ -21,6 +21,14 @@ from torchmetrics_tpu.functional.text import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.segmentation import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.video import *  # noqa: F401,F403
 
+# Reference quirk mirrored for drop-in parity: `torchmetrics.functional`'s top-level
+# `peak_signal_noise_ratio` is the deprecated wrapper with `data_range=3.0`
+# (reference functional/__init__.py:63), while `functional.image`'s export requires
+# `data_range`. The compat alias shadows the strict image export here only.
+from torchmetrics_tpu.functional.image.psnr import (  # noqa: E402
+    _compat_peak_signal_noise_ratio as peak_signal_noise_ratio,  # noqa: F811
+)
+
 __all__ = [
     *classification.__all__,
     *regression.__all__,

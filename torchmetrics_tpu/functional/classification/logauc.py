@@ -124,10 +124,11 @@ def multilabel_logauc(
 
 def logauc(
     preds, target, task: str, thresholds=None, num_classes=None, num_labels=None,
-    fpr_range: Tuple[float, float] = (0.001, 0.1), average: Optional[str] = "macro",
+    fpr_range: Tuple[float, float] = (0.001, 0.1), average: Optional[str] = None,
     ignore_index=None, validate_args: bool = True,
 ):
-    """Task dispatch (reference logauc.py facade)."""
+    """Task dispatch (reference logauc.py facade; its default is ``average=None``
+    — per-class scores — even though the per-task functions default to macro)."""
     from ...utilities.enums import ClassificationTask
 
     task = ClassificationTask.from_str(task)

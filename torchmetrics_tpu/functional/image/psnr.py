@@ -65,3 +65,18 @@ def peak_signal_noise_ratio(
         data_range_val = jnp.asarray(float(data_range), jnp.float32)
     sum_squared_error, num_obs = _psnr_update(preds, target, dim=dim)
     return _psnr_compute(sum_squared_error, num_obs, data_range_val, base=base, reduction=reduction)
+
+
+def _compat_peak_signal_noise_ratio(
+    preds,
+    target,
+    data_range: Union[float, Tuple[float, float]] = 3.0,
+    base: float = 10.0,
+    reduction: Optional[str] = "elementwise_mean",
+    dim: Optional[Union[int, Tuple[int, ...]]] = None,
+) -> jnp.ndarray:
+    """Alias exported as top-level ``functional.peak_signal_noise_ratio``: the
+    reference exports its deprecated wrapper there, whose ``data_range`` defaults
+    to 3.0 (reference ``functional/image/_deprecated.py:80-86``), unlike the
+    strict ``functional.image`` export."""
+    return peak_signal_noise_ratio(preds, target, data_range, base, reduction, dim)

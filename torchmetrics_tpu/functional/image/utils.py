@@ -109,14 +109,11 @@ def avg_pool3d(inputs: Array) -> Array:
 
 
 def reduce(x: Array, reduction) -> Array:
-    """Reference ``utilities/distributed.py:22`` reduction semantics."""
-    if reduction in ("elementwise_mean", "mean"):
-        return jnp.mean(x)
-    if reduction == "sum":
-        return jnp.sum(x)
-    if reduction in (None, "none"):
-        return x
-    raise ValueError("Expected reduction to be one of ['elementwise_mean', 'sum', 'none', None]")
+    """Reference ``utilities/distributed.py:22`` semantics, plus the ``'mean'``
+    alias the image metrics accept; delegates to the canonical implementation."""
+    from ...utilities.compute import reduce as _reduce
+
+    return _reduce(x, "elementwise_mean" if reduction == "mean" else reduction)
 
 
 def _check_image_pair(preds, target, require_dtype_match: bool = True, ndim: Tuple[int, ...] = (4,)):

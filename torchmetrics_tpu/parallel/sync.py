@@ -296,27 +296,6 @@ def merge_states(
 # ---------------------------------------------------------------------------
 
 
-def reduce(x: Array, reduction: str) -> Array:
-    if reduction == "elementwise_mean":
-        return jnp.mean(x)
-    if reduction == "sum":
-        return jnp.sum(x)
-    if reduction == "none" or reduction is None:
-        return x
-    raise ValueError("Reduction parameter unknown.")
-
-
-def class_reduce(num: Array, denom: Array, weights: Array, class_reduction: str = "none") -> Array:
-    from ..utilities.compute import _safe_divide
-
-    valid_reduction = ("micro", "macro", "weighted", "none", None)
-    fraction = _safe_divide(jnp.sum(num), jnp.sum(denom)) if class_reduction == "micro" else _safe_divide(num, denom)
-    if class_reduction == "micro":
-        return fraction
-    if class_reduction == "macro":
-        return jnp.mean(fraction)
-    if class_reduction == "weighted":
-        return jnp.sum(fraction * (weights.astype(jnp.float32) / jnp.sum(weights)))
-    if class_reduction == "none" or class_reduction is None:
-        return fraction
-    raise ValueError(f"Reduction parameter {class_reduction!r} unknown. Choose between one of these: {valid_reduction}")
+# canonical implementations live in utilities.compute (single source; the public
+# torchmetrics.utilities surface exports them)
+from ..utilities.compute import class_reduce, reduce  # noqa: E402,F401

@@ -1,4 +1,5 @@
-from .checks import _check_same_shape
+from .checks import _check_same_shape, check_forward_full_state_property
+from .compute import class_reduce, reduce
 from .data import dim_zero_cat, dim_zero_max, dim_zero_mean, dim_zero_min, dim_zero_sum
 from .exceptions import TorchMetricsUserError, TorchMetricsUserWarning
 from .prints import rank_zero_debug, rank_zero_info, rank_zero_warn
@@ -6,6 +7,9 @@ from .prints import rank_zero_debug, rank_zero_info, rank_zero_warn
 __all__ = [
     "TorchMetricsUserError",
     "TorchMetricsUserWarning",
+    "check_forward_full_state_property",
+    "class_reduce",
+    "reduce",
     "dim_zero_cat",
     "dim_zero_max",
     "dim_zero_mean",

@@ -119,3 +119,36 @@ def normalize_logits_if_needed(preds: Array, normalization: str = "sigmoid") -> 
 
 def _auc_reorder_and_compute(x: Array, y: Array) -> Array:
     return _auc_compute(x, y, reorder=True)
+
+
+def reduce(x: Array, reduction: Optional[str]) -> Array:
+    """Reduce a tensor by ``'elementwise_mean'``, ``'sum'``, or ``'none'``/None
+    (public API parity: reference ``utilities/distributed.py:22-42``)."""
+    if reduction == "elementwise_mean":
+        return jnp.mean(x)
+    if reduction == "none" or reduction is None:
+        return jnp.asarray(x)
+    if reduction == "sum":
+        return jnp.sum(x)
+    raise ValueError("Reduction parameter unknown.")
+
+
+def class_reduce(num: Array, denom: Array, weights: Array, class_reduction: Optional[str] = "none") -> Array:
+    """Reduce per-class ``num / denom * weights`` metrics by micro/macro/weighted/none
+    (public API parity: reference ``utilities/distributed.py:45-88``); NaN cells
+    (0-support classes) count as 0, matching the reference's in-place fixup."""
+    valid_reduction = ("micro", "macro", "weighted", "none", None)
+    num = jnp.asarray(num)
+    denom = jnp.asarray(denom)
+    weights = jnp.asarray(weights)
+    fraction = jnp.sum(num) / jnp.sum(denom) if class_reduction == "micro" else num / denom
+    fraction = jnp.where(jnp.isnan(fraction), 0.0, fraction)
+    if class_reduction == "micro":
+        return fraction
+    if class_reduction == "macro":
+        return jnp.mean(fraction)
+    if class_reduction == "weighted":
+        return jnp.sum(fraction * (weights.astype(jnp.float32) / jnp.sum(weights)))
+    if class_reduction == "none" or class_reduction is None:
+        return fraction
+    raise ValueError(f"Reduction parameter {class_reduction} unknown. Choose between one of these: {valid_reduction}")
