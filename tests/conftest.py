@@ -36,3 +36,55 @@ def _assert_cpu_devices():
     assert jax.devices()[0].platform == "cpu"
     assert len(jax.devices()) == NUM_DEVICES
     yield
+
+
+# --------------------------------------------------------------- skip pinning
+# Every legitimate skip is pinned here with its reason prefix (VERDICT r3 #9):
+# a silently-broken import or a flipped availability gate cannot hide as a skip
+# — full-suite runs fail on any skip drift (new skip, vanished skip, or changed
+# reason). Update this table deliberately when adding a gated test.
+EXPECTED_SKIPS = {
+    "tests/test_detection.py": ("reference ModifiedPanopticQuality has no return flags", 2),
+    "tests/test_reference_doctest_goldens.py::test_pesq_doctest_golden": ("pesq wheel not installed", 1),
+    "tests/test_reference_doctest_goldens.py::test_stoi_doctest_golden": ("pystoi wheel not installed", 1),
+    "tests/test_reference_doctest_goldens.py::test_dnsmos_doctest_golden": ("DNSMOS ONNX models unavailable", 1),
+    "tests/test_reference_fuzz.py": ("nan semantics on degenerate draws differ per-library by design", 6),
+    "tests/test_round4_fixes.py::test_dnsmos_mel_filterbank_matches_librosa_if_present": (
+        "could not import 'librosa'", 1,
+    ),
+}
+
+_skip_log: list = []
+
+
+def pytest_runtest_logreport(report):
+    if report.skipped and report.when in ("setup", "call"):
+        reason = report.longrepr[-1] if isinstance(report.longrepr, tuple) else str(report.longrepr)
+        _skip_log.append((report.nodeid, reason))
+
+
+def pytest_sessionfinish(session, exitstatus):
+    # enforce only on (near-)full-suite runs; partial selections legitimately
+    # skip nothing or different subsets (threshold overridable for testing the
+    # hook itself)
+    min_collected = int(os.environ.get("EXPECTED_SKIPS_MIN_COLLECTED", "1200"))
+    if session.testscollected < min_collected or exitstatus != 0:
+        return
+    problems = []
+    expected_total = sum(n for _, n in EXPECTED_SKIPS.values())
+    if len(_skip_log) != expected_total:
+        problems.append(f"expected {expected_total} skips, saw {len(_skip_log)}")
+    for nodeid, reason in _skip_log:
+        matched = False
+        for key, (prefix, _) in EXPECTED_SKIPS.items():
+            if nodeid.startswith(key.split("::")[0]) and (("::" not in key) or key in nodeid):
+                if prefix in reason:
+                    matched = True
+                    break
+        if not matched:
+            problems.append(f"unexpected skip: {nodeid} ({reason})")
+    if problems:
+        session.exitstatus = 1
+        raise pytest.UsageError(
+            "Skip drift vs tests/conftest.py EXPECTED_SKIPS:\n  " + "\n  ".join(problems)
+        )

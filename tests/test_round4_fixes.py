@@ -1,0 +1,99 @@
+"""Regression tests for the round-4 ADVICE fixes."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import torchmetrics_tpu as tm
+
+
+def test_dnsmos_mel_filterbank_matches_librosa_semantics_odd_nfft():
+    """ADVICE r3: odd n_fft (DNSMOS uses 321) bin centers must be rfftfreq, not
+    linspace(0, sr/2): the last rfft bin of an odd-length FFT sits BELOW Nyquist."""
+    from torchmetrics_tpu.functional.audio.dnsmos import mel_filterbank
+
+    sr, n_fft = 16000, 321
+    freqs = np.fft.rfftfreq(n_fft, 1.0 / sr)
+    assert freqs[-1] < sr / 2  # the property linspace gets wrong
+    fb = mel_filterbank(sr, n_fft, 32)
+    assert fb.shape == (32, 1 + n_fft // 2)
+    # independent construction of the expected peak positions: each mel triangle
+    # must peak at the rfft bin nearest its center frequency, which shifts by one
+    # bin vs the linspace grid near Nyquist for odd n_fft
+    from torchmetrics_tpu.functional.audio.dnsmos import _hz_to_mel_slaney, _mel_to_hz_slaney
+
+    mel_pts = _mel_to_hz_slaney(np.linspace(_hz_to_mel_slaney(0.0), _hz_to_mel_slaney(sr / 2.0), 34))
+    for m in range(0, 32, 8):
+        peak_bin = int(np.argmax(fb[m]))
+        expect = int(np.argmin(np.abs(freqs - mel_pts[m + 1])))
+        assert abs(peak_bin - expect) <= 1, (m, peak_bin, expect)
+
+
+def test_dnsmos_mel_filterbank_matches_librosa_if_present():
+    """Self-activating cross-check wherever librosa exists (not in this pod)."""
+    librosa = pytest.importorskip("librosa")
+    from torchmetrics_tpu.functional.audio.dnsmos import mel_filterbank
+
+    sr, n_fft = 16000, 321
+    fb = mel_filterbank(sr, n_fft, 32)
+    ref = librosa.filters.mel(sr=sr, n_fft=n_fft, n_mels=32, htk=False, norm="slaney")
+    np.testing.assert_allclose(fb, ref, atol=1e-6)
+
+
+def test_gather_unsupported_dtype_raises_after_shape_exchange():
+    """ADVICE r3: an unsupported dtype is announced as a sentinel inside the shape
+    collective (not raised before it), so peers can never be left blocked; every
+    rank then raises together."""
+    from torchmetrics_tpu.parallel.sync import gather_all_arrays
+
+    with pytest.raises(ValueError, match="unsupported dtype"):
+        gather_all_arrays(jnp.zeros(3, jnp.complex64))
+
+
+def test_load_state_dict_default_state_keeps_zero_update_count():
+    """ADVICE r3: loading a checkpoint saved BEFORE any update must not mark the
+    metric as updated — compute() keeps warning instead of silently returning the
+    zero-state value."""
+    src = tm.classification.MulticlassAccuracy(3, average="micro")
+    src.persistent(True)
+    sd_fresh = src.state_dict()
+
+    dst = tm.classification.MulticlassAccuracy(3, average="micro")
+    dst.load_state_dict(sd_fresh)
+    assert dst._update_count == 0
+
+    # and a real checkpoint still counts as updated
+    src.update(jnp.asarray(np.eye(3, dtype=np.float32)[[0, 1, 2]]), jnp.asarray([0, 1, 2]))
+    sd_real = src.state_dict()
+    dst2 = tm.classification.MulticlassAccuracy(3, average="micro")
+    dst2.load_state_dict(sd_real)
+    assert dst2._update_count >= 1
+    assert float(dst2.compute()) == 1.0
+
+
+def test_state_dict_roundtrip_preserves_update_count_even_at_default_values():
+    """Code-review r4: SumMetric().update(0.0) leaves the state AT its default;
+    the saved _update_count metadata must still mark the restore as updated."""
+    src = tm.SumMetric()
+    src.update(jnp.asarray(0.0))
+    src.persistent(True)
+    sd = src.state_dict()
+    dst = tm.SumMetric()
+    dst.load_state_dict(sd)
+    assert dst._update_count >= 1
+    assert float(dst.compute()) == 0.0
+
+
+def test_merge_state_accepts_state_dict_with_metadata():
+    """The _update_count metadata entry must not trip the unknown-state check and
+    must weight mean states correctly."""
+    a = tm.MeanMetric()
+    a.update(jnp.asarray([1.0, 3.0]))
+    b = tm.MeanMetric()
+    b.update(jnp.asarray([5.0, 7.0]))
+    b.persistent(True)
+    a.merge_state(b.state_dict())
+    assert float(a.compute()) == 4.0

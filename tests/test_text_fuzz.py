@@ -1,0 +1,152 @@
+"""Text-domain fuzz vs the reference library on random unicode/CJK corpora
+(VERDICT r3 #8 — text was the one domain with no fuzz battery).
+
+The generator mixes ASCII words, CJK runs, accented latin, digits and
+punctuation with variable sentence/corpus sizes and multi-reference targets, so
+tokenizer edge behavior (13a punctuation splits, `intl` unicode categories,
+`zh` han-character isolation, char mode) is exercised on content the fixed
+mini-corpus in test_text.py never reaches.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import torchmetrics_tpu.functional.text as F
+from tests.helpers import _assert_allclose
+from tests.oracle import reference_torchmetrics
+
+_ASCII = ["cat", "on", "the", "mat", "hello", "world", "quick", "brown", "fox", "jumps"]
+_CJK = "猫在垫子上你好世界快狐狸跳懒狗日本語のテスト한국어시험"
+_ACCENT = ["wörld", "naïve", "café", "señor", "Zürich", "résumé"]
+_PUNCT = [",", ".", "!", "?", ";", ":", "—", "(", ")", '"', "'s", "-", "..."]
+_DIGIT = ["123", "3.14", "2-3", "1,000", "42"]
+
+
+def _oracle():
+    tm_ref = reference_torchmetrics()
+    if tm_ref is None:
+        pytest.skip("oracle unavailable")
+    return tm_ref
+
+
+def _rand_sentence(rng: np.random.Generator, min_tokens: int = 1) -> str:
+    n = int(rng.integers(min_tokens, 14))
+    parts = []
+    for _ in range(n):
+        kind = rng.random()
+        if kind < 0.45:
+            parts.append(str(rng.choice(_ASCII)))
+        elif kind < 0.6:
+            k = int(rng.integers(1, 5))
+            start = int(rng.integers(0, len(_CJK) - k))
+            parts.append(_CJK[start : start + k])
+        elif kind < 0.72:
+            parts.append(str(rng.choice(_ACCENT)))
+        elif kind < 0.85:
+            parts.append(str(rng.choice(_DIGIT)))
+        else:
+            parts.append(str(rng.choice(_ASCII)) + str(rng.choice(_PUNCT)))
+    return " ".join(parts)
+
+
+def _rand_corpus(rng: np.random.Generator, n: int, n_refs_max: int = 3):
+    preds = [_rand_sentence(rng) for _ in range(n)]
+    target = [[_rand_sentence(rng) for _ in range(int(rng.integers(1, n_refs_max + 1)))] for _ in range(n)]
+    return preds, target
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.parametrize("tokenize", ["none", "13a", "char", "intl", "zh"])
+def test_sacre_bleu_fuzz(seed, tokenize):
+    tm_ref = _oracle()
+    rng = np.random.default_rng(100 + seed)
+    preds, target = _rand_corpus(rng, 8)
+    for lowercase in (False, True):
+        ours = F.sacre_bleu_score(preds, target, tokenize=tokenize, lowercase=lowercase)
+        ref = tm_ref.functional.text.sacre_bleu_score(preds, target, tokenize=tokenize, lowercase=lowercase)
+        _assert_allclose(ours, ref.numpy(), atol=1e-5, msg=f"tokenize={tokenize} lowercase={lowercase}")
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.parametrize("n_gram,smooth", [(2, False), (4, False), (4, True)])
+def test_bleu_fuzz(seed, n_gram, smooth):
+    tm_ref = _oracle()
+    rng = np.random.default_rng(200 + seed)
+    preds, target = _rand_corpus(rng, 10)
+    ours = F.bleu_score(preds, target, n_gram=n_gram, smooth=smooth)
+    ref = tm_ref.functional.text.bleu_score(preds, target, n_gram=n_gram, smooth=smooth)
+    _assert_allclose(ours, ref.numpy(), atol=1e-5)
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+@pytest.mark.parametrize("n_char_order,n_word_order,whitespace", [(6, 2, False), (6, 0, False), (4, 2, True)])
+def test_chrf_fuzz(seed, n_char_order, n_word_order, whitespace):
+    tm_ref = _oracle()
+    rng = np.random.default_rng(300 + seed)
+    preds, target = _rand_corpus(rng, 8)
+    kwargs = dict(n_char_order=n_char_order, n_word_order=n_word_order, whitespace=whitespace)
+    ours = F.chrf_score(preds, target, **kwargs)
+    ref = tm_ref.functional.text.chrf_score(preds, target, **kwargs)
+    _assert_allclose(ours, ref.numpy(), atol=1e-5)
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+@pytest.mark.parametrize("accumulate", ["avg", "best"])
+@pytest.mark.parametrize("use_stemmer", [False, True])
+def test_rouge_fuzz(seed, accumulate, use_stemmer):
+    tm_ref = _oracle()
+    pytest.importorskip("nltk") if use_stemmer else None
+    rng = np.random.default_rng(400 + seed)
+    preds, target = _rand_corpus(rng, 6)
+    keys = ("rouge1", "rouge2", "rougeL")
+    try:
+        ref = tm_ref.functional.text.rouge_score(
+            preds, target, accumulate=accumulate, use_stemmer=use_stemmer, rouge_keys=keys
+        )
+    except (ModuleNotFoundError, ValueError) as err:
+        pytest.skip(f"reference rouge unavailable: {err}")
+    ours = F.rouge_score(preds, target, accumulate=accumulate, use_stemmer=use_stemmer, rouge_keys=keys)
+    for k in ours:
+        _assert_allclose(ours[k], ref[k].numpy(), atol=1e-5, msg=k)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_asr_fuzz(seed):
+    """wer/cer/mer/wil/wip on random unicode corpora."""
+    tm_ref = _oracle()
+    rng = np.random.default_rng(500 + seed)
+    preds = [_rand_sentence(rng) for _ in range(10)]
+    target = [_rand_sentence(rng) for _ in range(10)]
+    for name in ("word_error_rate", "char_error_rate", "match_error_rate", "word_information_lost",
+                 "word_information_preserved"):
+        ours = getattr(F, name)(preds, target)
+        ref = getattr(tm_ref.functional.text, name)(preds, target)
+        _assert_allclose(ours, ref.numpy(), atol=1e-6, msg=name)
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+@pytest.mark.parametrize("normalize,no_punctuation,asian_support", [
+    (False, False, False), (True, True, False), (False, False, True), (True, False, True),
+])
+def test_ter_fuzz(seed, normalize, no_punctuation, asian_support):
+    tm_ref = _oracle()
+    rng = np.random.default_rng(600 + seed)
+    preds, target = _rand_corpus(rng, 6, n_refs_max=2)
+    kwargs = dict(normalize=normalize, no_punctuation=no_punctuation, asian_support=asian_support)
+    ours = F.translation_edit_rate(preds, target, **kwargs)
+    ref = tm_ref.functional.text.translation_edit_rate(preds, target, **kwargs)
+    _assert_allclose(ours, ref.numpy(), atol=1e-5)
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_edit_distance_fuzz(seed):
+    tm_ref = _oracle()
+    rng = np.random.default_rng(700 + seed)
+    preds = [_rand_sentence(rng) for _ in range(8)]
+    target = [_rand_sentence(rng) for _ in range(8)]
+    for reduction in ("mean", "sum", "none"):
+        ours = F.edit_distance(preds, target, reduction=reduction)
+        ref = tm_ref.functional.text.edit_distance(preds, target, reduction=reduction)
+        _assert_allclose(ours, ref.numpy(), atol=1e-6, msg=f"reduction={reduction}")

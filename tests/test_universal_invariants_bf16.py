@@ -1,0 +1,80 @@
+"""bf16 pass of the universal-invariants battery (VERDICT r3 #8).
+
+On TPU the natural accumulation dtype is bfloat16; every class in the registry
+must survive ``set_dtype(jnp.bfloat16)``: update/compute without NaN, stay
+idempotent, keep merge_state == one-shot within bf16 summation-order noise, and
+land within the bf16 envelope of its own f32 value. Integer-sufficient-statistic
+metrics (counts, confusion matrices) are exact in any dtype; float accumulators
+see bf16's ~3 decimal digits, hence the loose envelope.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tests.test_universal_invariants import _SKIP_MERGE, CASES, batches  # noqa: F401  (fixture reuse)
+
+# bf16's ~8-bit mantissa: values O(1) carry ~4e-3 rounding per op; accumulations
+# over 3 batches x 24 rows random-walk a few times that.
+_BF16_RTOL = 0.08
+_BF16_ATOL = 0.05
+
+# Classes whose value is a DIFFERENCE of accumulated moments (sum_sq - sum^2/n):
+# the cancellation consumes all of bf16's ~3 digits, so the value envelope is
+# unbounded by construction (the reference's fp16 states break identically).
+# They must still run, stay idempotent and produce finite values in bf16.
+_MOMENT_CANCELLATION = {"ExplainedVariance"}
+
+
+def _allclose_bf16(a, b, msg):
+    if isinstance(a, dict):
+        for k in a:
+            _allclose_bf16(a[k], b[k], f"{msg} key={k}")
+        return
+    if isinstance(a, (list, tuple)) and not hasattr(a, "shape"):
+        for x, y in zip(a, b):
+            _allclose_bf16(x, y, msg)
+        return
+    av = np.asarray(a, np.float64)
+    bv = np.asarray(b, np.float64)
+    # NaN agreement counts as agreement (e.g. 0-support corners)
+    both_nan = np.isnan(av) & np.isnan(bv)
+    np.testing.assert_allclose(
+        np.where(both_nan, 0.0, av), np.where(both_nan, 0.0, bv),
+        rtol=_BF16_RTOL, atol=_BF16_ATOL, err_msg=msg,
+    )
+
+
+@pytest.mark.parametrize("name", list(CASES), ids=list(CASES))
+def test_bf16_invariants(name, batches):
+    ctor, _ = CASES[name]
+    data = batches[name]
+
+    f32 = ctor()
+    for batch in data:
+        f32.update(*batch)
+    f32_val = f32.compute()
+
+    metric = ctor().set_dtype(jnp.bfloat16)
+    for batch in data:
+        metric.update(*batch)
+    val = metric.compute()
+    again = metric.compute()
+
+    # idempotence is exact regardless of dtype
+    _allclose_bf16(again, val, f"{name}: bf16 compute not idempotent")
+    if name in _MOMENT_CANCELLATION:
+        assert np.all(np.isfinite(np.asarray(val, np.float64))), f"{name}: bf16 value not finite"
+    else:
+        # bf16 value within envelope of the f32 value
+        _allclose_bf16(val, f32_val, f"{name}: bf16 value outside envelope of f32")
+
+    if name not in _SKIP_MERGE and name not in _MOMENT_CANCELLATION:
+        a, b = ctor().set_dtype(jnp.bfloat16), ctor().set_dtype(jnp.bfloat16)
+        a.update(*data[0])
+        b.update(*data[1])
+        b.update(*data[2])
+        a.merge_state(b)
+        _allclose_bf16(a.compute(), val, f"{name}: bf16 merge_state != one-shot")

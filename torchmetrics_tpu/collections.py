@@ -301,6 +301,37 @@ class MetricCollection:
         """Flatten nested dict outputs + apply prefix/postfix (reference :388-407)."""
         return _flatten_with_naming(res, self._set_name)
 
+    def merge_state(self, incoming: "MetricCollection") -> None:
+        """Pairwise child merge by key (commless map-reduce plane, like
+        ``Metric.merge_state``).
+
+        With active compute groups, members ALIAS the group leader's state dict,
+        so only one metric per group may fold (then members re-alias); merging
+        every member would apply the fold once per group member."""
+        if not isinstance(incoming, MetricCollection):
+            raise ValueError(f"Expected a MetricCollection, got {type(incoming).__name__}")
+        mine = dict(self._modules)
+        theirs = dict(incoming._modules)
+        if set(mine) != set(theirs):
+            raise ValueError(
+                f"Cannot merge collections with different metrics: {sorted(set(mine) ^ set(theirs))}"
+            )
+        if self._groups_checked and self._groups:
+            grouped = {name for members in self._groups.values() for name in members}
+            for members in self._groups.values():
+                leader = members[0]
+                mine[leader].merge_state(theirs[leader])
+                for name in members[1:]:
+                    mine[name]._state = mine[leader]._state
+                    mine[name]._update_count = mine[leader]._update_count
+                    mine[name]._computed = None
+            for name, metric in mine.items():
+                if name not in grouped:
+                    metric.merge_state(theirs[name])
+        else:
+            for name, metric in mine.items():
+                metric.merge_state(theirs[name])
+
     def reset(self) -> None:
         for metric in self._modules.values():
             metric.reset()

@@ -58,7 +58,11 @@ def _mel_to_hz_slaney(m: np.ndarray) -> np.ndarray:
 def mel_filterbank(sr: int, n_fft: int, n_mels: int, fmin: float = 0.0, fmax: Optional[float] = None) -> np.ndarray:
     """Slaney-style (librosa-default) triangular mel filterbank, slaney-normalized."""
     fmax = fmax or sr / 2.0
-    fft_freqs = np.linspace(0, sr / 2.0, 1 + n_fft // 2)
+    # rfftfreq, not linspace(0, sr/2): for ODD n_fft (DNSMOS uses 321) the last
+    # rfft bin sits at sr/2 * (1 - 1/n_fft), and linspace warps every bin center
+    # by n_fft/(n_fft-1) relative to the librosa filterbank the reference feeds
+    # the ONNX models
+    fft_freqs = np.fft.rfftfreq(n_fft, 1.0 / sr)
     mel_pts = _mel_to_hz_slaney(np.linspace(_hz_to_mel_slaney(fmin), _hz_to_mel_slaney(fmax), n_mels + 2))
     fdiff = np.diff(mel_pts)
     ramps = mel_pts[:, None] - fft_freqs[None, :]

@@ -489,7 +489,9 @@ class Metric:
                 raise ValueError(f"Expected incoming state to be of type {type(self).__name__}")
             incoming = incoming_state._state
         elif isinstance(incoming_state, dict):
-            incoming = incoming_state
+            # state_dict()-style dicts carry an "_update_count" metadata entry;
+            # strip it from the state fold and use it as the dict's merge weight
+            incoming = {k: v for k, v in incoming_state.items() if not k.endswith("_update_count")}
             unknown = set(incoming) - set(self._state)
             if unknown:
                 raise RuntimeError(f"Got unknown state keys {sorted(unknown)}")
@@ -504,8 +506,13 @@ class Metric:
             )
         else:
             # weight "mean" states by each side's update count so chained merges stay
-            # exact for any number of participants (a dict carries weight 1)
-            incoming_count = incoming_state._update_count if isinstance(incoming_state, Metric) else 1
+            # exact for any number of participants (a bare dict carries weight 1; a
+            # state_dict()-style dict carries its saved "_update_count")
+            if isinstance(incoming_state, Metric):
+                incoming_count = incoming_state._update_count
+            else:
+                metas = [v for k, v in incoming_state.items() if k.endswith("_update_count")]
+                incoming_count = int(metas[0]) if metas else 1
             merged = _sync.merge_states(
                 {k: v for k, v in self._state.items()},
                 {k: incoming[k] for k in incoming},
@@ -515,9 +522,13 @@ class Metric:
         for k, v in merged.items():
             self._state[k] = v
         # fold the incoming weight into the count so CHAINED merges stay exact for
-        # "mean" states (a dict carries weight 1); the reference leaves the count
-        # untouched for dicts, but it also doesn't weight means by count at all
-        self._update_count += incoming_state._update_count if isinstance(incoming_state, Metric) else 1
+        # "mean" states; the reference leaves the count untouched for dicts, but it
+        # also doesn't weight means by count at all
+        if isinstance(incoming_state, Metric):
+            self._update_count += incoming_state._update_count
+        else:
+            metas = [v for k, v in incoming_state.items() if k.endswith("_update_count")]
+            self._update_count += int(metas[0]) if metas else 1
         self._n_prev_dev = None
         self._computed = None
 
@@ -559,6 +570,7 @@ class Metric:
         """States flagged persistent, as numpy (checkpoint-friendly; orbax takes the
         raw state pytree via ``metric._state`` directly). Reference metric.py:924-956."""
         destination = {} if destination is None else destination
+        wrote_any = False
         for name in self._defaults:
             if not self._persistent[name]:
                 continue
@@ -567,6 +579,12 @@ class Metric:
                 destination[prefix + name] = [np.asarray(x) for x in current]
             else:
                 destination[prefix + name] = np.asarray(current)
+            wrote_any = True
+        if wrote_any:
+            # metadata, not a state: lets load_state_dict restore the updated/fresh
+            # distinction exactly (value equality with defaults is an unreliable
+            # proxy — e.g. SumMetric().update(0.0) leaves the state at its default)
+            destination[prefix + "_update_count"] = int(self._update_count)
         return destination
 
     def load_state_dict(self, state_dict: dict, prefix: str = "") -> None:
@@ -578,9 +596,28 @@ class Metric:
                 self._state[name] = [jnp.asarray(x) for x in v] if isinstance(v, list) else jnp.asarray(v)
                 loaded = True
         if loaded:
-            # restored state counts as updated: compute() on a freshly-loaded metric
-            # is the checkpoint-resume path, not a user error worth warning about
-            self._update_count = max(self._update_count, 1)
+            # restored checkpoints of an UPDATED metric count as updated (resume
+            # path); a checkpoint saved before any update must not — compute()
+            # keeps warning that no updates occurred instead of silently
+            # returning the zero-state value. The saved `_update_count` metadata
+            # decides exactly; older checkpoints without it fall back to a
+            # value-vs-default comparison (imperfect: states can legitimately
+            # equal the defaults after an update).
+            meta_key = prefix + "_update_count"
+            if meta_key in state_dict:
+                self._update_count = max(self._update_count, int(state_dict[meta_key]))
+            else:
+                def _differs(cur, default):
+                    if isinstance(cur, list):
+                        return len(cur) > 0
+                    return not np.array_equal(np.asarray(cur), np.asarray(default))
+
+                if any(
+                    _differs(self._state[name], self._defaults[name])
+                    for name in self._state
+                    if name in self._defaults
+                ):
+                    self._update_count = max(self._update_count, 1)
             self._computed = None
 
     def __getstate__(self) -> dict:

@@ -166,16 +166,26 @@ def gather_all_arrays(value: Optional[Array], process_group: Any = None) -> List
         vec[0] = value.ndim
         vec[1 : 1 + value.ndim] = value.shape
         codes = [i for i, dt in enumerate(_GATHER_DTYPES) if value.dtype == jnp.dtype(dt)]
-        if not codes:  # fail BEFORE entering any collective, so peers don't block
-            raise ValueError(
-                f"gather_all_arrays does not support dtype {value.dtype}; supported: "
-                f"{[str(jnp.dtype(d)) for d in _GATHER_DTYPES]}"
-            )
-        vec[-1] = codes[0]
+        # an unsupported dtype is announced as sentinel -2 INSIDE the shape
+        # collective: raising before it would leave peers with supported dtypes
+        # blocked in process_allgather; this way every rank completes the shape
+        # exchange, sees the sentinel, and raises the same error together
+        vec[-1] = codes[0] if codes else -2
     shapes = np.asarray(multihost_utils.process_allgather(jnp.asarray(vec), tiled=False)).reshape(-1, vec.size)
     known_rows = np.flatnonzero(shapes[:, 0] >= 0)
     if known_rows.size == 0:
         return []  # no process has data for this state
+    codes_seen = sorted(set(shapes[known_rows, -1].tolist()))
+    if -2 in codes_seen:
+        raise ValueError(
+            f"gather_all_arrays got an unsupported dtype on at least one process; supported: "
+            f"{[str(jnp.dtype(d)) for d in _GATHER_DTYPES]}"
+        )
+    if len(codes_seen) > 1:
+        raise ValueError(
+            "gather_all_arrays requires the same dtype on every process, got "
+            f"{[str(jnp.dtype(_GATHER_DTYPES[int(c)])) for c in codes_seen]}"
+        )
     ranks = shapes[known_rows, 0]
     if int(ranks.min()) != int(ranks.max()):
         raise ValueError(f"gather_all_arrays requires equal ranks across processes, got {sorted(set(ranks.tolist()))}")

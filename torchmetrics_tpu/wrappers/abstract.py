@@ -20,6 +20,39 @@ class WrapperMetric(Metric):
     def _wrap_children_kwargs(self, **kwargs: Any) -> Any:
         return kwargs
 
+    # ------------------------------------------------------------------ merge
+    # The base Metric.merge_state folds `self._state` — which for wrappers is
+    # empty; their accumulation lives in child Metric instances. Without this
+    # override, merging two wrapper shards silently kept only the left shard's
+    # data (caught by tests/test_wrapper_merge_fuzz.py).
+
+    def _merge_children(self):
+        """Ordered child Metric instances to pair-merge; wrappers override."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not define its children for merge_state."
+        )
+
+    def _merge_wrapper_extra(self, incoming: "WrapperMetric") -> None:
+        """Hook for wrapper-level non-child state (e.g. MinMax's running extrema)."""
+
+    def merge_state(self, incoming_state) -> None:
+        if not isinstance(incoming_state, WrapperMetric) or type(incoming_state) is not type(self):
+            raise ValueError(
+                f"Expected incoming state to be an instance of {type(self).__name__}; wrapper metrics "
+                "merge wrapper-to-wrapper (their accumulation lives in child metrics, not a state dict)."
+            )
+        mine = list(self._merge_children())
+        theirs = list(incoming_state._merge_children())
+        if len(mine) != len(theirs):
+            raise ValueError(
+                f"Cannot merge {type(self).__name__}: child metric counts differ ({len(mine)} vs {len(theirs)})."
+            )
+        for child, other in zip(mine, theirs):
+            child.merge_state(other)
+        self._merge_wrapper_extra(incoming_state)
+        self._update_count += incoming_state._update_count
+        self._computed = None
+
     def _batch_state(self, *args: Any, **kwargs: Any):  # pragma: no cover - wrappers bypass
         raise NotImplementedError(f"{type(self).__name__} drives its children directly.")
 

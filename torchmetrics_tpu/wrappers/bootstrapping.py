@@ -195,6 +195,31 @@ class BootStrapper(WrapperMetric):
 
     __call__ = forward
 
+    def _merge_children(self):
+        if self._use_vmap:
+            return []  # stacked pytree handled in _merge_wrapper_extra
+        return list(self.metrics)
+
+    def _merge_wrapper_extra(self, incoming: "BootStrapper") -> None:
+        if not self._use_vmap:
+            return
+        # fold the (k, ...) stacked replica states replica-wise — exactly the
+        # per-child merge of the list path, one vectorized fold. Bases with a
+        # custom _merge (dist_reduce_fx=None states, e.g. Pearson's Chan moments)
+        # MUST go through it: their reduction tags are None, which merge_states
+        # would resolve by keeping the left side only.
+        if self.base_metric._has_custom_merge():
+            self._stacked = jax.vmap(self.base_metric._merge)(self._stacked, incoming._stacked)
+        else:
+            from ..parallel import sync as _sync
+
+            self._stacked = _sync.merge_states(
+                self._stacked,
+                incoming._stacked,
+                self.base_metric._reductions,
+                weights=(float(self._update_count), float(incoming._update_count)),
+            )
+
     def reset(self) -> None:
         if self._use_vmap:
             self._stacked = jax.tree.map(

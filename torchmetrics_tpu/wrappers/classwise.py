@@ -75,6 +75,9 @@ class ClasswiseWrapper(WrapperMetric):
 
     __call__ = forward
 
+    def _merge_children(self):
+        return [self.metric]
+
     def reset(self) -> None:
         self.metric.reset()
         self._update_count = 0

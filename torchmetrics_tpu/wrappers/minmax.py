@@ -58,6 +58,14 @@ class MinMaxMetric(WrapperMetric):
 
     __call__ = forward
 
+    def _merge_children(self):
+        return [self._base_metric]
+
+    def _merge_wrapper_extra(self, incoming: "MinMaxMetric") -> None:
+        # running extrema fold by min/max — the natural cross-rank semantics
+        self.min_val = jnp.minimum(self.min_val, incoming.min_val)
+        self.max_val = jnp.maximum(self.max_val, incoming.max_val)
+
     def reset(self) -> None:
         self._base_metric.reset()
         self.min_val = jnp.asarray(jnp.inf)

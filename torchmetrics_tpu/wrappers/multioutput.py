@@ -91,6 +91,9 @@ class MultioutputWrapper(WrapperMetric):
 
     __call__ = forward
 
+    def _merge_children(self):
+        return list(self.metrics)
+
     def reset(self) -> None:
         for m in self.metrics:
             m.reset()

@@ -75,6 +75,21 @@ class MultitaskWrapper(WrapperMetric):
 
     __call__ = forward
 
+    def _merge_children(self):
+        return [self.task_metrics[k] for k in sorted(self.task_metrics)]
+
+    def merge_state(self, incoming_state) -> None:
+        # positional pairing of sorted children is only sound when the task key
+        # sets agree — unequal sets would silently cross-fold different tasks
+        if isinstance(incoming_state, MultitaskWrapper) and set(self.task_metrics) != set(
+            incoming_state.task_metrics
+        ):
+            raise ValueError(
+                "Cannot merge MultitaskWrappers with different tasks: "
+                f"{sorted(set(self.task_metrics) ^ set(incoming_state.task_metrics))}"
+            )
+        super().merge_state(incoming_state)
+
     def reset(self) -> None:
         for metric in self.task_metrics.values():
             metric.reset()

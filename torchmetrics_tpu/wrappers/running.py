@@ -12,6 +12,7 @@ from contextlib import contextmanager
 from typing import Any
 
 from ..metric import Metric
+from ..utilities.exceptions import TorchMetricsUserError
 from .abstract import WrapperMetric
 
 
@@ -75,6 +76,16 @@ class Running(WrapperMetric):
                 probe.merge_state({k: (list(v) if isinstance(v, list) else v) for k, v in contrib.items()})
             probe._update_count = max(1, len(self._ring))
             return probe.compute()
+
+    def merge_state(self, incoming_state) -> None:
+        """A running window is a property of ONE update stream: merging two ranks'
+        windows has no defined order (whose last-``window`` updates win?), so this
+        raises instead of silently interleaving. Sync the base metric directly if
+        cross-rank values are needed."""
+        raise TorchMetricsUserError(
+            "Running metrics hold a stream-local window of the last updates; merging windows across "
+            "ranks has no defined update order. Compute per-rank or wrap an unsynced base metric."
+        )
 
     def reset(self) -> None:
         self.base_metric.reset()

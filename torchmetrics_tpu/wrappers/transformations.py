@@ -26,6 +26,9 @@ class MetricInputTransformer(WrapperMetric):
             )
         self.wrapped_metric = wrapped_metric
 
+    def _merge_children(self):
+        return [self.wrapped_metric]
+
     def transform_pred(self, pred):
         """Identity by default."""
         return pred
