@@ -71,18 +71,22 @@ def pytest_sessionfinish(session, exitstatus):
     if session.testscollected < min_collected or exitstatus != 0:
         return
     problems = []
-    expected_total = sum(n for _, n in EXPECTED_SKIPS.values())
-    if len(_skip_log) != expected_total:
-        problems.append(f"expected {expected_total} skips, saw {len(_skip_log)}")
+    observed = dict.fromkeys(EXPECTED_SKIPS, 0)
     for nodeid, reason in _skip_log:
         matched = False
         for key, (prefix, _) in EXPECTED_SKIPS.items():
             if nodeid.startswith(key.split("::")[0]) and (("::" not in key) or key in nodeid):
                 if prefix in reason:
+                    observed[key] += 1
                     matched = True
                     break
         if not matched:
             problems.append(f"unexpected skip: {nodeid} ({reason})")
+    # per-key counts, not just the total: offsetting drift across categories
+    # (one gate silently stops skipping while another gains a skip) must fail
+    for key, (_, want) in EXPECTED_SKIPS.items():
+        if observed[key] != want:
+            problems.append(f"{key}: expected {want} skips, saw {observed[key]}")
     if problems:
         session.exitstatus = 1
         raise pytest.UsageError(

@@ -191,3 +191,39 @@ def test_utilities_value_parity(ref):
     np.testing.assert_array_equal(
         np.asarray(select_topk(probs, 2)), ref_topk(torch.as_tensor(probs), 2).numpy()
     )
+
+
+def test_class_signature_parity(ref):
+    """Constructor-level drop-in parity over every top-level metric class: all
+    reference parameters present, shared defaults equal by repr. Caught the
+    BootStrapper poisson default, the F1/FBeta facades' missing zero_division,
+    and the top-level PSNR data_range=3.0 deprecated-wrapper quirk."""
+    import inspect
+
+    import torchmetrics as rtm
+
+    import torchmetrics_tpu as tm
+
+    problems = []
+    for name in sorted(rtm.__all__):
+        rcls = getattr(rtm, name, None)
+        ocls = getattr(tm, name, None)
+        if not (inspect.isclass(rcls) and ocls is not None and inspect.isclass(ocls)):
+            continue
+        rsig = (
+            inspect.signature(rcls.__new__) if "__new__" in rcls.__dict__ else inspect.signature(rcls.__init__)
+        )
+        osig = (
+            inspect.signature(ocls.__new__) if "__new__" in ocls.__dict__ else inspect.signature(ocls.__init__)
+        )
+        for p, rpar in rsig.parameters.items():
+            if p in ("self", "cls") or rpar.kind in (
+                inspect.Parameter.VAR_POSITIONAL, inspect.Parameter.VAR_KEYWORD
+            ):
+                continue
+            opar = osig.parameters.get(p)
+            if opar is None:
+                problems.append(f"{name}: missing parameter `{p}`")
+            elif rpar.default is not inspect.Parameter.empty and repr(rpar.default) != repr(opar.default):
+                problems.append(f"{name}: `{p}` default {opar.default!r} != reference {rpar.default!r}")
+    assert not problems, "\n".join(problems)

@@ -82,8 +82,9 @@ WRAPPER_CASES = {
 def test_wrapper_merge_equals_oneshot(name, num_ranks, seed):
     ctor, gen = WRAPPER_CASES[name]
     rng = np.random.default_rng(1000 * seed + num_ranks)
-    # uneven shards: rank r gets r+1 batches (rank 0 the fewest, never zero here)
-    shards = [[gen(rng) for _ in range(r + 1)] for r in range(num_ranks)]
+    # uneven shards: rank r gets r batches — rank 0 saw NOTHING, exercising the
+    # zero-update merge path (empty child states, count-0 weighting)
+    shards = [[gen(rng) for _ in range(r)] for r in range(num_ranks)]
 
     oneshot = ctor()
     for shard in shards:

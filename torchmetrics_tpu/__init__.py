@@ -24,6 +24,11 @@ from torchmetrics_tpu.classification import *  # noqa: F401,F403
 from torchmetrics_tpu.clustering import *  # noqa: F401,F403
 from torchmetrics_tpu.detection import *  # noqa: F401,F403
 from torchmetrics_tpu.image import *  # noqa: F401,F403
+# reference quirk mirrored for drop-in parity: top-level PeakSignalNoiseRatio is
+# the deprecated data_range=3.0 wrapper; image.PeakSignalNoiseRatio stays strict
+from torchmetrics_tpu.image.psnr import (  # noqa: E402
+    _CompatPeakSignalNoiseRatio as PeakSignalNoiseRatio,  # noqa: F811
+)
 from torchmetrics_tpu.multimodal import *  # noqa: F401,F403
 from torchmetrics_tpu.nominal import *  # noqa: F401,F403
 from torchmetrics_tpu.shape import *  # noqa: F401,F403

@@ -222,6 +222,7 @@ class FBetaScore(_ClassificationTaskWrapper):
         top_k: Optional[int] = 1,
         ignore_index: Optional[int] = None,
         validate_args: bool = True,
+        zero_division: float = 0,
         **kwargs: Any,
     ) -> Metric:
         task = ClassificationTask.from_str(task)
@@ -229,6 +230,7 @@ class FBetaScore(_ClassificationTaskWrapper):
             "multidim_average": multidim_average,
             "ignore_index": ignore_index,
             "validate_args": validate_args,
+            "zero_division": zero_division,
         })
         if task == ClassificationTask.BINARY:
             return BinaryFBetaScore(beta, threshold, **kwargs)
@@ -259,6 +261,7 @@ class F1Score(_ClassificationTaskWrapper):
         top_k: Optional[int] = 1,
         ignore_index: Optional[int] = None,
         validate_args: bool = True,
+        zero_division: float = 0,
         **kwargs: Any,
     ) -> Metric:
         task = ClassificationTask.from_str(task)
@@ -266,6 +269,7 @@ class F1Score(_ClassificationTaskWrapper):
             "multidim_average": multidim_average,
             "ignore_index": ignore_index,
             "validate_args": validate_args,
+            "zero_division": zero_division,
         })
         if task == ClassificationTask.BINARY:
             return BinaryF1Score(threshold, **kwargs)

@@ -66,3 +66,19 @@ class PeakSignalNoiseRatio(Metric):
             base=self.base,
             reduction=self.reduction,
         )
+
+
+class _CompatPeakSignalNoiseRatio(PeakSignalNoiseRatio):
+    """Top-level ``torchmetrics_tpu.PeakSignalNoiseRatio`` alias: the reference
+    exports its deprecated wrapper there, whose ``data_range`` defaults to 3.0
+    (reference ``image/_deprecated.py``), unlike the strict ``image`` export."""
+
+    def __init__(
+        self,
+        data_range: Union[float, Tuple[float, float]] = 3.0,
+        base: float = 10.0,
+        reduction: Optional[str] = "elementwise_mean",
+        dim: Optional[Union[int, Tuple[int, ...]]] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(data_range, base, reduction, dim, **kwargs)

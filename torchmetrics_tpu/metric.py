@@ -491,6 +491,7 @@ class Metric:
         elif isinstance(incoming_state, dict):
             # state_dict()-style dicts carry an "_update_count" metadata entry;
             # strip it from the state fold and use it as the dict's merge weight
+            metas = [v for k, v in incoming_state.items() if k.endswith("_update_count")]
             incoming = {k: v for k, v in incoming_state.items() if not k.endswith("_update_count")}
             unknown = set(incoming) - set(self._state)
             if unknown:
@@ -499,6 +500,10 @@ class Metric:
             raise ValueError("Expected incoming state to be a dict or an instance of Metric")
         if self._is_synced:
             raise TorchMetricsUserError("The Metric shouldn't be synced when performing ``merge_state``.")
+        if isinstance(incoming_state, Metric):
+            incoming_count = incoming_state._update_count
+        else:
+            incoming_count = int(metas[0]) if metas else 1
         if self._has_custom_merge():
             merged = self._merge(
                 {k: v for k, v in self._state.items()},
@@ -508,11 +513,6 @@ class Metric:
             # weight "mean" states by each side's update count so chained merges stay
             # exact for any number of participants (a bare dict carries weight 1; a
             # state_dict()-style dict carries its saved "_update_count")
-            if isinstance(incoming_state, Metric):
-                incoming_count = incoming_state._update_count
-            else:
-                metas = [v for k, v in incoming_state.items() if k.endswith("_update_count")]
-                incoming_count = int(metas[0]) if metas else 1
             merged = _sync.merge_states(
                 {k: v for k, v in self._state.items()},
                 {k: incoming[k] for k in incoming},
@@ -524,11 +524,7 @@ class Metric:
         # fold the incoming weight into the count so CHAINED merges stay exact for
         # "mean" states; the reference leaves the count untouched for dicts, but it
         # also doesn't weight means by count at all
-        if isinstance(incoming_state, Metric):
-            self._update_count += incoming_state._update_count
-        else:
-            metas = [v for k, v in incoming_state.items() if k.endswith("_update_count")]
-            self._update_count += int(metas[0]) if metas else 1
+        self._update_count += incoming_count
         self._n_prev_dev = None
         self._computed = None
 
@@ -605,19 +601,23 @@ class Metric:
             # equal the defaults after an update).
             meta_key = prefix + "_update_count"
             if meta_key in state_dict:
-                self._update_count = max(self._update_count, int(state_dict[meta_key]))
+                # the checkpoint's count describes the loaded state exactly — adopt
+                # it (not max: loading into a non-fresh metric REPLACES its states)
+                self._update_count = int(state_dict[meta_key])
             else:
                 def _differs(cur, default):
                     if isinstance(cur, list):
                         return len(cur) > 0
                     return not np.array_equal(np.asarray(cur), np.asarray(default))
 
-                if any(
+                self._update_count = int(any(
                     _differs(self._state[name], self._defaults[name])
                     for name in self._state
                     if name in self._defaults
-                ):
-                    self._update_count = max(self._update_count, 1)
+                ))
+            # the on-device cached counter tracks the replaced state's history;
+            # it must restart from the adopted count (update() re-seeds it)
+            self._n_prev_dev = None
             self._computed = None
 
     def __getstate__(self) -> dict:

@@ -10,8 +10,9 @@ vmaps the base metric's pure ``update_state`` over a ``(k, batch)`` resample-ind
 matrix — strictly better than the reference's k deepcopies + k sequential updates
 (``wrappers/bootstrapping.py:74-97``). Metrics with concat states (or the ``poisson``
 sampler, whose variable-length index sets are a dynamic-shape recompile trap) fall
-back to per-replica clones. ``multinomial`` draws static-shape index rows and is the
-default here.
+back to per-replica clones. The default sampler mirrors the reference
+(``poisson``); pass ``sampling_strategy="multinomial"`` for the static-shape draws
+that unlock the vmapped fast path.
 """
 
 from __future__ import annotations
@@ -47,7 +48,10 @@ class BootStrapper(WrapperMetric):
         mean/std: include mean/std over replicas in output dict.
         quantile: optional quantile(s) to report (float or sequence).
         raw: include the raw per-replica values.
-        sampling_strategy: ``"multinomial"`` (static-shape, default) or ``"poisson"``.
+        sampling_strategy: ``"poisson"`` (reference default) or ``"multinomial"``.
+            Multinomial draws are static-shape, which unlocks the single-call
+            vmapped stacked-state fast path; poisson resamples per replica on
+            the list path.
         seed: host RNG seed for the resampler.
     """
 
@@ -59,7 +63,7 @@ class BootStrapper(WrapperMetric):
         std: bool = True,
         quantile: Optional[Union[float, Sequence[float]]] = None,
         raw: bool = False,
-        sampling_strategy: str = "multinomial",
+        sampling_strategy: str = "poisson",
         seed: int = 0,
         **kwargs: Any,
     ) -> None:
