@@ -1,0 +1,260 @@
+"""Orbax checkpoint round-trips (VERDICT r4 #4).
+
+`metric.py:24` and `docs/core.md` claim the state pytree can be handed to orbax
+as-is; these tests back the claim with save→restore→compute equality through
+`orbax.checkpoint` for every state shape the framework produces: tensor states,
+dynamic cat states, the fused-collection state, wrapper trees (children +
+wrapper-level extrema), the padded detection accumulator, and a sharded state on
+the 8-device CPU mesh (reference resume semantics: metric.py:919-990).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import orbax.checkpoint as ocp
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from torchmetrics_tpu import MetricCollection
+from torchmetrics_tpu.classification import (
+    MulticlassAccuracy,
+    MulticlassAUROC,
+    MulticlassConfusionMatrix,
+    MulticlassF1Score,
+)
+from torchmetrics_tpu.detection import MeanAveragePrecision
+from torchmetrics_tpu.detection.sharded import PaddedDetectionAccumulator
+from torchmetrics_tpu.regression import SpearmanCorrCoef
+from torchmetrics_tpu.wrappers import BootStrapper, MinMaxMetric
+
+from conftest import seed_all
+
+
+def _roundtrip(tmp_path, tree, abstract=None):
+    """Save a pytree through orbax and load it back (fresh checkpointer each way)."""
+    path = tmp_path / "ckpt"
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path, tree)
+    with ocp.StandardCheckpointer() as ckptr:
+        return ckptr.restore(path, abstract) if abstract is not None else ckptr.restore(path)
+
+
+def test_stat_scores_metric_roundtrip(tmp_path):
+    rng = seed_all()
+    metric = MulticlassAccuracy(num_classes=5, average="macro")
+    for _ in range(3):
+        metric.update(
+            jnp.asarray(rng.normal(size=(32, 5)).astype(np.float32)),
+            jnp.asarray(rng.integers(0, 5, 32, dtype=np.int32)),
+        )
+    expected = np.asarray(metric.compute())
+
+    metric.persistent(True)
+    restored_sd = _roundtrip(tmp_path, metric.state_dict())
+    fresh = MulticlassAccuracy(num_classes=5, average="macro")
+    fresh.load_state_dict(restored_sd)
+    assert fresh._update_count == metric._update_count
+    np.testing.assert_allclose(np.asarray(fresh.compute()), expected, atol=1e-8)
+
+
+def test_cat_state_metric_roundtrip(tmp_path):
+    rng = seed_all(7)
+    metric = SpearmanCorrCoef()
+    for _ in range(4):
+        metric.update(
+            jnp.asarray(rng.normal(size=17).astype(np.float32)),
+            jnp.asarray(rng.normal(size=17).astype(np.float32)),
+        )
+    expected = np.asarray(metric.compute())
+
+    metric.persistent(True)
+    restored_sd = _roundtrip(tmp_path, metric.state_dict())
+    fresh = SpearmanCorrCoef()
+    fresh.load_state_dict(restored_sd)
+    np.testing.assert_allclose(np.asarray(fresh.compute()), expected, atol=1e-7)
+
+
+def test_fresh_checkpoint_keeps_no_update_warning(tmp_path):
+    """A checkpoint saved before any update must not mark the restored metric
+    as updated (exact-count semantics, round-4 commit 1475a36)."""
+    fresh_src = MulticlassAccuracy(num_classes=5)
+    fresh_src.persistent(True)
+    restored_sd = _roundtrip(tmp_path, fresh_src.state_dict())
+    fresh = MulticlassAccuracy(num_classes=5)
+    fresh.load_state_dict(restored_sd)
+    assert fresh._update_count == 0
+
+
+def test_fused_collection_state_roundtrip(tmp_path):
+    rng = seed_all(3)
+    collection = MetricCollection({
+        "acc": MulticlassAccuracy(5, average="micro", validate_args=False),
+        "f1": MulticlassF1Score(5, average="macro", validate_args=False),
+        "auroc": MulticlassAUROC(5, thresholds=50, validate_args=False),
+        "confmat": MulticlassConfusionMatrix(5, validate_args=False),
+    })
+    pure = collection.as_pure()
+    states = pure.init()
+    step = jax.jit(pure.update)
+    for _ in range(3):
+        probs = jax.nn.softmax(jnp.asarray(rng.normal(size=(64, 5)).astype(np.float32)))
+        target = jnp.asarray(rng.integers(0, 5, 64, dtype=np.int32))
+        states = step(states, probs, target)
+    expected = {k: np.asarray(v) for k, v in jax.jit(pure.compute)(states).items()}
+
+    restored = _roundtrip(tmp_path, jax.tree.map(np.asarray, states))
+    values = jax.jit(pure.compute)(jax.tree.map(jnp.asarray, restored))
+    for key, want in expected.items():
+        np.testing.assert_allclose(np.asarray(values[key]), want, atol=1e-8, err_msg=key)
+
+
+@pytest.mark.parametrize("wrapper_kind", ["bootstrapper", "minmax"])
+def test_wrapper_roundtrip(tmp_path, wrapper_kind):
+    rng = seed_all(11)
+    if wrapper_kind == "bootstrapper":
+        wrapper = BootStrapper(
+            MulticlassAccuracy(num_classes=4, average="micro"),
+            num_bootstraps=5, sampling_strategy="multinomial", seed=0, raw=True,
+        )
+        fresh = BootStrapper(
+            MulticlassAccuracy(num_classes=4, average="micro"),
+            num_bootstraps=5, sampling_strategy="multinomial", seed=0, raw=True,
+        )
+    else:
+        wrapper = MinMaxMetric(MulticlassAccuracy(num_classes=4, average="micro"))
+        fresh = MinMaxMetric(MulticlassAccuracy(num_classes=4, average="micro"))
+    for _ in range(3):
+        preds = jnp.asarray(rng.normal(size=(24, 4)).astype(np.float32))
+        target = jnp.asarray(rng.integers(0, 4, 24, dtype=np.int32))
+        if wrapper_kind == "minmax":
+            wrapper(preds, target)  # MinMax tracks extrema through forward
+        else:
+            wrapper.update(preds, target)
+    expected = jax.tree.map(np.asarray, wrapper.compute())
+
+    wrapper.persistent(True)
+    restored_sd = _roundtrip(tmp_path, wrapper.state_dict())
+    fresh.load_state_dict(restored_sd)
+    got = jax.tree.map(np.asarray, fresh.compute())
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, atol=1e-8), got, expected)
+
+
+@pytest.mark.parametrize("strategy", ["multinomial", "poisson"])
+def test_bootstrapper_roundtrip_both_paths(tmp_path, strategy):
+    """Checkpoint contents must not depend on the internal fast-path predicate:
+    the vmapped stacked-state path and the per-replica list path both persist
+    their accumulation (review finding r5)."""
+    rng = seed_all(13)
+    def fresh():
+        return BootStrapper(
+            MulticlassAccuracy(num_classes=3, average="micro"),
+            num_bootstraps=4, sampling_strategy=strategy, seed=3,
+        )
+    wrapper = fresh()
+    wrapper.update(
+        jnp.asarray(rng.normal(size=(40, 3)).astype(np.float32)),
+        jnp.asarray(rng.integers(0, 3, 40, dtype=np.int32)),
+    )
+    expected = jax.tree.map(np.asarray, wrapper.compute())
+    wrapper.persistent(True)
+    restored_sd = _roundtrip(tmp_path, wrapper.state_dict())
+    loaded = fresh()
+    loaded.load_state_dict(restored_sd)
+    got = jax.tree.map(np.asarray, loaded.compute())
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, atol=1e-8), got, expected)
+
+
+def test_running_wrapper_roundtrip(tmp_path):
+    from torchmetrics_tpu.aggregation import SumMetric
+    from torchmetrics_tpu.wrappers import Running
+
+    metric = Running(SumMetric(), window=2)
+    for v in (1.0, 2.0, 3.0):
+        metric.update(v)
+    expected = float(metric.compute())  # last-2 window: 5.0
+    metric.persistent(True)
+    restored_sd = _roundtrip(tmp_path, metric.state_dict())
+    loaded = Running(SumMetric(), window=2)
+    loaded.load_state_dict(restored_sd)
+    assert float(loaded.compute()) == expected
+    loaded.update(4.0)  # the window keeps sliding after resume
+    assert float(loaded.compute()) == 7.0
+
+
+def test_default_persistence_wrapper_saves_nothing():
+    """Without persistent(True) a wrapper's state_dict is empty and a restore
+    leaves the target cleanly fresh — never an 'updated' wrapper with empty
+    children (review finding r5: partial checkpoints corrupted compute)."""
+    wrapper = MinMaxMetric(MulticlassAccuracy(num_classes=3, average="micro"))
+    wrapper(jnp.asarray([[0.8, 0.1, 0.1], [0.2, 0.7, 0.1]]), jnp.asarray([0, 1]))
+    sd = wrapper.state_dict()
+    assert sd == {}
+    loaded = MinMaxMetric(MulticlassAccuracy(num_classes=3, average="micro"))
+    loaded.load_state_dict(sd)
+    assert loaded._update_count == 0
+
+
+def _random_padded_batch(rng, acc, n_imgs):
+    d, g = acc.max_detections, acc.max_groundtruths
+    det_counts = rng.integers(1, d, n_imgs).astype(np.int32)
+    gt_counts = rng.integers(1, g, n_imgs).astype(np.int32)
+    xy = rng.uniform(0, 300, (n_imgs, d, 2)).astype(np.float32)
+    wh = rng.uniform(10, 100, (n_imgs, d, 2)).astype(np.float32)
+    gxy = rng.uniform(0, 300, (n_imgs, g, 2)).astype(np.float32)
+    gwh = rng.uniform(10, 100, (n_imgs, g, 2)).astype(np.float32)
+    gt_area = (gwh[..., 0] * gwh[..., 1]).astype(np.float32)
+    return (
+        np.concatenate([xy, xy + wh], -1), rng.uniform(0, 1, (n_imgs, d)).astype(np.float32),
+        rng.integers(0, 6, (n_imgs, d)).astype(np.int32), det_counts,
+        np.concatenate([gxy, gxy + gwh], -1), rng.integers(0, 6, (n_imgs, g)).astype(np.int32),
+        np.zeros((n_imgs, g), np.int32), gt_area, gt_counts,
+    )
+
+
+def test_padded_detection_accumulator_roundtrip(tmp_path):
+    rng = seed_all(5)
+    acc = PaddedDetectionAccumulator(capacity_images=8, max_detections=12, max_groundtruths=9)
+    state = acc.init()
+    update = jax.jit(acc.update)
+    for _ in range(2):
+        state = update(state, *[jnp.asarray(a) for a in _random_padded_batch(rng, acc, 4)])
+
+    restored = _roundtrip(tmp_path, jax.tree.map(np.asarray, state))
+    for key, want in state.items():
+        np.testing.assert_array_equal(np.asarray(restored[key]), np.asarray(want), err_msg=key)
+
+    def _map_of(s):
+        metric = MeanAveragePrecision()
+        metric.update(*acc.to_lists(s))
+        return float(metric.compute()["map"])
+
+    assert _map_of(restored) == _map_of(state)
+
+
+def test_sharded_state_roundtrip(tmp_path):
+    """Sharded save→restore on the 8-device CPU mesh: the accumulator state is
+    sharded over its image axis, checkpointed, restored back onto the SAME
+    shardings via an abstract target, and produces an identical mAP."""
+    rng = seed_all(9)
+    mesh = Mesh(np.array(jax.devices()[:8]), ("dp",))
+    acc = PaddedDetectionAccumulator(capacity_images=16, max_detections=10, max_groundtruths=8)
+    state = acc.init()
+    update = jax.jit(acc.update)
+    state = update(state, *[jnp.asarray(a) for a in _random_padded_batch(rng, acc, 16)])
+
+    def shard_spec(v):
+        return NamedSharding(mesh, P("dp", *([None] * (v.ndim - 1))) if v.ndim >= 1 and v.shape[0] % 8 == 0 else P())
+
+    sharded = {k: jax.device_put(v, shard_spec(v)) for k, v in state.items()}
+    abstract = {k: jax.ShapeDtypeStruct(v.shape, v.dtype, sharding=v.sharding) for k, v in sharded.items()}
+
+    restored = _roundtrip(tmp_path, sharded, abstract=abstract)
+    for key, v in restored.items():
+        assert v.sharding == sharded[key].sharding, key
+        np.testing.assert_array_equal(np.asarray(v), np.asarray(state[key]), err_msg=key)
+
+    before = MeanAveragePrecision()
+    before.update(*acc.to_lists(state))
+    after = MeanAveragePrecision()
+    after.update(*acc.to_lists(restored))
+    assert float(before.compute()["map"]) == float(after.compute()["map"])

@@ -1,0 +1,846 @@
+"""Curated example snippets for `tools/gen_doctests.py`.
+
+Each entry maps (module, ClassName) -> list of python source lines; the generator
+executes them doctest-style on the CPU backend and splices the rendered block into
+the class docstring. Inputs follow the reference's canonical doctest data
+(e.g. reference classification/accuracy.py:373-389) re-expressed as jnp literals.
+"""
+
+J = "import jax.numpy as jnp"
+
+BIN_P = "preds = jnp.asarray([0.11, 0.22, 0.84, 0.73, 0.33, 0.92])"
+BIN_T = "target = jnp.asarray([0, 0, 1, 1, 0, 1])"
+MC_P = ("preds = jnp.asarray([[0.75, 0.05, 0.20], [0.10, 0.80, 0.10],"
+        " [0.20, 0.30, 0.50], [0.25, 0.40, 0.35]])")
+MC_T = "target = jnp.asarray([0, 1, 2, 1])"
+ML_P = "preds = jnp.asarray([[0.75, 0.05, 0.35], [0.45, 0.75, 0.05], [0.05, 0.65, 0.75]])"
+ML_T = "target = jnp.asarray([[1, 0, 1], [0, 0, 0], [0, 1, 1]])"
+REG_P = "preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])"
+REG_T = "target = jnp.asarray([3.0, -0.5, 2.0, 7.0])"
+
+CLS = "torchmetrics_tpu.classification"
+REG = "torchmetrics_tpu.regression"
+AGG = "torchmetrics_tpu.aggregation"
+WRP = "torchmetrics_tpu.wrappers"
+TXT = "torchmetrics_tpu.text"
+AUD = "torchmetrics_tpu.audio"
+DET = "torchmetrics_tpu.detection"
+IMG = "torchmetrics_tpu.image"
+RET = "torchmetrics_tpu.retrieval"
+CLU = "torchmetrics_tpu.clustering"
+NOM = "torchmetrics_tpu.nominal"
+SEG = "torchmetrics_tpu.segmentation"
+SHP = "torchmetrics_tpu.shape"
+MMD = "torchmetrics_tpu.multimodal"
+
+
+def _cls(name, ctor, task, tail=("metric.compute()",)):
+    data = {"bin": (BIN_P, BIN_T), "mc": (MC_P, MC_T), "ml": (ML_P, ML_T)}[task]
+    return [
+        J,
+        f"from {CLS} import {name}",
+        data[0],
+        data[1],
+        f"metric = {name}({ctor})",
+        "metric.update(preds, target)",
+        *tail,
+    ]
+
+
+def _reg(name, ctor="", preds=REG_P, target=REG_T, tail=("metric.compute()",)):
+    return [
+        J,
+        f"from {REG} import {name}",
+        preds,
+        target,
+        f"metric = {name}({ctor})",
+        "metric.update(preds, target)",
+        *tail,
+    ]
+
+
+REGISTRY = {}
+
+# ---------------------------------------------------------------- classification
+for name, ctor, task in [
+    ("BinaryAccuracy", "", "bin"),
+    ("MulticlassAccuracy", "num_classes=3", "mc"),
+    ("MultilabelAccuracy", "num_labels=3", "ml"),
+    ("BinaryPrecision", "", "bin"),
+    ("MulticlassPrecision", "num_classes=3", "mc"),
+    ("MultilabelPrecision", "num_labels=3", "ml"),
+    ("BinaryRecall", "", "bin"),
+    ("MulticlassRecall", "num_classes=3", "mc"),
+    ("MultilabelRecall", "num_labels=3", "ml"),
+    ("BinarySpecificity", "", "bin"),
+    ("MulticlassSpecificity", "num_classes=3", "mc"),
+    ("MultilabelSpecificity", "num_labels=3", "ml"),
+    ("BinaryF1Score", "", "bin"),
+    ("MulticlassF1Score", "num_classes=3", "mc"),
+    ("MultilabelF1Score", "num_labels=3", "ml"),
+    ("BinaryFBetaScore", "beta=2.0", "bin"),
+    ("MulticlassFBetaScore", "beta=2.0, num_classes=3", "mc"),
+    ("MultilabelFBetaScore", "beta=2.0, num_labels=3", "ml"),
+    ("BinaryNegativePredictiveValue", "", "bin"),
+    ("MulticlassNegativePredictiveValue", "num_classes=3", "mc"),
+    ("MultilabelNegativePredictiveValue", "num_labels=3", "ml"),
+    ("BinaryHammingDistance", "", "bin"),
+    ("MulticlassHammingDistance", "num_classes=3", "mc"),
+    ("MultilabelHammingDistance", "num_labels=3", "ml"),
+    ("BinaryStatScores", "", "bin"),
+    ("MulticlassStatScores", "num_classes=3", "mc"),
+    ("MultilabelStatScores", "num_labels=3", "ml"),
+    ("BinaryConfusionMatrix", "", "bin"),
+    ("MulticlassConfusionMatrix", "num_classes=3", "mc"),
+    ("MultilabelConfusionMatrix", "num_labels=3", "ml"),
+    ("BinaryAUROC", "", "bin"),
+    ("MulticlassAUROC", "num_classes=3", "mc"),
+    ("MultilabelAUROC", "num_labels=3", "ml"),
+    ("BinaryAveragePrecision", "", "bin"),
+    ("MulticlassAveragePrecision", "num_classes=3", "mc"),
+    ("MultilabelAveragePrecision", "num_labels=3", "ml"),
+    ("BinaryCalibrationError", "n_bins=3", "bin"),
+    ("MulticlassCalibrationError", "num_classes=3, n_bins=3", "mc"),
+    ("BinaryCohenKappa", "", "bin"),
+    ("MulticlassCohenKappa", "num_classes=3", "mc"),
+    ("BinaryJaccardIndex", "", "bin"),
+    ("MulticlassJaccardIndex", "num_classes=3", "mc"),
+    ("MultilabelJaccardIndex", "num_labels=3", "ml"),
+    ("BinaryMatthewsCorrCoef", "", "bin"),
+    ("MulticlassMatthewsCorrCoef", "num_classes=3", "mc"),
+    ("MultilabelMatthewsCorrCoef", "num_labels=3", "ml"),
+    ("BinaryHingeLoss", "", "bin"),
+    ("MulticlassHingeLoss", "num_classes=3", "mc"),
+    ("MultilabelCoverageError", "num_labels=3", "ml"),
+    ("MultilabelRankingAveragePrecision", "num_labels=3", "ml"),
+    ("MultilabelRankingLoss", "num_labels=3", "ml"),
+    ("BinaryEER", "", "bin"),
+    ("MulticlassEER", "num_classes=3", "mc"),
+    ("MultilabelEER", "num_labels=3", "ml"),
+    ("BinaryLogAUC", "", "bin"),
+    ("MulticlassLogAUC", "num_classes=3", "mc"),
+    ("MultilabelLogAUC", "num_labels=3", "ml"),
+    ("BinaryPrecisionAtFixedRecall", "min_recall=0.5", "bin"),
+    ("MulticlassPrecisionAtFixedRecall", "num_classes=3, min_recall=0.5", "mc"),
+    ("MultilabelPrecisionAtFixedRecall", "num_labels=3, min_recall=0.5", "ml"),
+    ("BinaryRecallAtFixedPrecision", "min_precision=0.5", "bin"),
+    ("MulticlassRecallAtFixedPrecision", "num_classes=3, min_precision=0.5", "mc"),
+    ("MultilabelRecallAtFixedPrecision", "num_labels=3, min_precision=0.5", "ml"),
+    ("BinarySensitivityAtSpecificity", "min_specificity=0.5", "bin"),
+    ("MulticlassSensitivityAtSpecificity", "num_classes=3, min_specificity=0.5", "mc"),
+    ("MultilabelSensitivityAtSpecificity", "num_labels=3, min_specificity=0.5", "ml"),
+    ("BinarySpecificityAtSensitivity", "min_sensitivity=0.5", "bin"),
+    ("MulticlassSpecificityAtSensitivity", "num_classes=3, min_sensitivity=0.5", "mc"),
+    ("MultilabelSpecificityAtSensitivity", "num_labels=3, min_sensitivity=0.5", "ml"),
+    ("BinaryPrecisionRecallCurve", "thresholds=5", "bin"),
+    ("MulticlassPrecisionRecallCurve", "num_classes=3, thresholds=5", "mc"),
+    ("MultilabelPrecisionRecallCurve", "num_labels=3, thresholds=5", "ml"),
+    ("BinaryROC", "thresholds=5", "bin"),
+    ("MulticlassROC", "num_classes=3, thresholds=5", "mc"),
+    ("MultilabelROC", "num_labels=3, thresholds=5", "ml"),
+]:
+    REGISTRY[(CLS, name)] = _cls(name, ctor, task)
+
+REGISTRY[(CLS, "MulticlassExactMatch")] = [
+    J,
+    f"from {CLS} import MulticlassExactMatch",
+    "preds = jnp.asarray([[0, 1, 2], [1, 1, 2]])",
+    "target = jnp.asarray([[0, 1, 2], [2, 1, 2]])",
+    "metric = MulticlassExactMatch(num_classes=3)",
+    "metric.update(preds, target)",
+    "metric.compute()",
+]
+REGISTRY[(CLS, "MultilabelExactMatch")] = _cls("MultilabelExactMatch", "num_labels=3", "ml")
+REGISTRY[(CLS, "BinaryFairness")] = [
+    J,
+    f"from {CLS} import BinaryFairness",
+    "preds = jnp.asarray([0.11, 0.84, 0.22, 0.73, 0.33, 0.92])",
+    "target = jnp.asarray([0, 1, 0, 1, 0, 1])",
+    "groups = jnp.asarray([0, 0, 0, 1, 1, 1])",
+    "metric = BinaryFairness(num_groups=2)",
+    "metric.update(preds, target, groups)",
+    "metric.compute()",
+]
+REGISTRY[(CLS, "BinaryGroupStatRates")] = [
+    J,
+    f"from {CLS} import BinaryGroupStatRates",
+    "preds = jnp.asarray([0.11, 0.84, 0.22, 0.73, 0.33, 0.92])",
+    "target = jnp.asarray([0, 1, 0, 1, 0, 1])",
+    "groups = jnp.asarray([0, 0, 0, 1, 1, 1])",
+    "metric = BinaryGroupStatRates(num_groups=2)",
+    "metric.update(preds, target, groups)",
+    "metric.compute()",
+]
+
+# -------------------------------------------------------------------- regression
+for name, ctor in [
+    ("MeanAbsoluteError", ""),
+    ("MeanSquaredError", ""),
+    ("MeanSquaredLogError", ""),
+    ("MeanAbsolutePercentageError", ""),
+    ("SymmetricMeanAbsolutePercentageError", ""),
+    ("WeightedMeanAbsolutePercentageError", ""),
+    ("NormalizedRootMeanSquaredError", ""),
+    ("LogCoshError", ""),
+    ("ExplainedVariance", ""),
+    ("R2Score", ""),
+    ("PearsonCorrCoef", ""),
+    ("SpearmanCorrCoef", ""),
+    ("KendallRankCorrCoef", ""),
+    ("ConcordanceCorrCoef", ""),
+    ("RelativeSquaredError", ""),
+    ("TweedieDevianceScore", "power=1.5"),
+    ("MinkowskiDistance", "p=3"),
+]:
+    REGISTRY[(REG, name)] = _reg(name, ctor)
+
+REGISTRY[(REG, "MeanSquaredLogError")] = _reg(
+    "MeanSquaredLogError", "",
+    preds="preds = jnp.asarray([2.5, 1.0, 2.0, 8.0])",
+    target="target = jnp.asarray([3.0, 1.5, 2.0, 7.0])",
+)
+REGISTRY[(REG, "CosineSimilarity")] = _reg(
+    "CosineSimilarity", "reduction='mean'",
+    preds="preds = jnp.asarray([[1.0, 2.0, 3.0], [1.0, 0.0, 1.0]])",
+    target="target = jnp.asarray([[1.0, 2.0, 2.0], [0.5, 0.0, 1.0]])",
+)
+REGISTRY[(REG, "KLDivergence")] = _reg(
+    "KLDivergence", "",
+    preds="preds = jnp.asarray([[0.36, 0.48, 0.16]])",
+    target="target = jnp.asarray([[1/3, 1/3, 1/3]])",
+)
+REGISTRY[(REG, "JensenShannonDivergence")] = _reg(
+    "JensenShannonDivergence", "",
+    preds="preds = jnp.asarray([[0.36, 0.48, 0.16]])",
+    target="target = jnp.asarray([[1/3, 1/3, 1/3]])",
+)
+REGISTRY[(REG, "CriticalSuccessIndex")] = _reg(
+    "CriticalSuccessIndex", "0.5",
+    preds="preds = jnp.asarray([0.2, 0.7, 0.9, 0.4])",
+    target="target = jnp.asarray([0.1, 0.8, 0.6, 0.7])",
+)
+REGISTRY[(REG, "ContinuousRankedProbabilityScore")] = _reg(
+    "ContinuousRankedProbabilityScore", "",
+    preds="preds = jnp.asarray([[1.0, 2.0, 3.0], [2.0, 3.0, 4.0]])",
+    target="target = jnp.asarray([2.0, 3.0])",
+)
+
+# ------------------------------------------------------------------- aggregation
+REGISTRY[(AGG, "MeanMetric")] = [
+    J,
+    f"from {AGG} import MeanMetric",
+    "metric = MeanMetric()",
+    "metric.update(1.0)",
+    "metric.update(jnp.asarray([2.0, 3.0]))",
+    "metric.compute()",
+]
+REGISTRY[(AGG, "SumMetric")] = [
+    J,
+    f"from {AGG} import SumMetric",
+    "metric = SumMetric()",
+    "metric.update(1.0)",
+    "metric.update(jnp.asarray([2.0, 3.0]))",
+    "metric.compute()",
+]
+REGISTRY[(AGG, "MaxMetric")] = [
+    J,
+    f"from {AGG} import MaxMetric",
+    "metric = MaxMetric()",
+    "metric.update(1.0)",
+    "metric.update(jnp.asarray([2.0, 3.0]))",
+    "metric.compute()",
+]
+REGISTRY[(AGG, "MinMetric")] = [
+    J,
+    f"from {AGG} import MinMetric",
+    "metric = MinMetric()",
+    "metric.update(1.0)",
+    "metric.update(jnp.asarray([2.0, 3.0]))",
+    "metric.compute()",
+]
+REGISTRY[(AGG, "CatMetric")] = [
+    J,
+    f"from {AGG} import CatMetric",
+    "metric = CatMetric()",
+    "metric.update(1.0)",
+    "metric.update(jnp.asarray([2.0, 3.0]))",
+    "metric.compute()",
+]
+REGISTRY[(AGG, "RunningMean")] = [
+    J,
+    f"from {AGG} import RunningMean",
+    "metric = RunningMean(window=3)",
+    "for batch in [1.0, 2.0, 3.0, 4.0, 5.0]:",
+    "...     metric.update(batch)",
+    "metric.compute()",
+]
+REGISTRY[(AGG, "RunningSum")] = [
+    J,
+    f"from {AGG} import RunningSum",
+    "metric = RunningSum(window=3)",
+    "for batch in [1.0, 2.0, 3.0, 4.0, 5.0]:",
+    "...     metric.update(batch)",
+    "metric.compute()",
+]
+
+# -------------------------------------------------------------------- collections
+REGISTRY[("torchmetrics_tpu.collections", "MetricCollection")] = [
+    J,
+    "from torchmetrics_tpu import MetricCollection",
+    f"from {CLS} import MulticlassAccuracy, MulticlassPrecision",
+    MC_P,
+    MC_T,
+    "collection = MetricCollection({'acc': MulticlassAccuracy(num_classes=3),"
+    " 'prec': MulticlassPrecision(num_classes=3)})",
+    "collection.update(preds, target)",
+    "{k: round(float(v), 4) for k, v in collection.compute().items()}",
+]
+
+# ----------------------------------------------------------------------- wrappers
+REGISTRY[(WRP, "BootStrapper")] = [
+    J,
+    f"from {WRP} import BootStrapper",
+    f"from {CLS} import BinaryAccuracy",
+    BIN_P,
+    BIN_T,
+    "metric = BootStrapper(BinaryAccuracy(), num_bootstraps=4, sampling_strategy='multinomial', seed=7)",
+    "metric.update(preds, target)",
+    "{k: round(float(v), 4) for k, v in metric.compute().items()}",
+]
+REGISTRY[(WRP, "ClasswiseWrapper")] = [
+    J,
+    f"from {WRP} import ClasswiseWrapper",
+    f"from {CLS} import MulticlassAccuracy",
+    MC_P,
+    MC_T,
+    "metric = ClasswiseWrapper(MulticlassAccuracy(num_classes=3, average=None))",
+    "metric.update(preds, target)",
+    "{k: round(float(v), 4) for k, v in metric.compute().items()}",
+]
+REGISTRY[(WRP, "MinMaxMetric")] = [
+    J,
+    f"from {WRP} import MinMaxMetric",
+    f"from {CLS} import BinaryAccuracy",
+    "metric = MinMaxMetric(BinaryAccuracy())",
+    "out1 = metric(jnp.asarray([0.9, 0.1]), jnp.asarray([1, 0]))",
+    "out2 = metric(jnp.asarray([0.9, 0.1]), jnp.asarray([0, 0]))",
+    "{k: round(float(v), 4) for k, v in out2.items()}",
+]
+REGISTRY[(WRP, "MultioutputWrapper")] = [
+    J,
+    f"from {WRP} import MultioutputWrapper",
+    f"from {REG} import MeanSquaredError",
+    "preds = jnp.asarray([[1.0, 10.0], [2.0, 20.0], [3.0, 30.0]])",
+    "target = jnp.asarray([[1.0, 11.0], [2.0, 22.0], [3.0, 33.0]])",
+    "metric = MultioutputWrapper(MeanSquaredError(), num_outputs=2)",
+    "metric.update(preds, target)",
+    "metric.compute()",
+]
+REGISTRY[(WRP, "MultitaskWrapper")] = [
+    J,
+    f"from {WRP} import MultitaskWrapper",
+    f"from {CLS} import BinaryAccuracy",
+    f"from {REG} import MeanSquaredError",
+    "metric = MultitaskWrapper({'cls': BinaryAccuracy(), 'reg': MeanSquaredError()})",
+    "metric.update({'cls': jnp.asarray([0.9, 0.1]), 'reg': jnp.asarray([2.5, 1.0])},"
+    " {'cls': jnp.asarray([1, 0]), 'reg': jnp.asarray([3.0, 1.0])})",
+    "{k: round(float(v), 4) for k, v in metric.compute().items()}",
+]
+REGISTRY[(WRP, "Running")] = [
+    J,
+    f"from {WRP} import Running",
+    f"from {AGG} import SumMetric",
+    "metric = Running(SumMetric(), window=2)",
+    "for batch in [1.0, 2.0, 3.0]:",
+    "...     metric.update(batch)",
+    "metric.compute()",
+]
+REGISTRY[(WRP, "BinaryTargetTransformer")] = [
+    J,
+    f"from {WRP} import BinaryTargetTransformer",
+    f"from {CLS} import BinaryAccuracy",
+    "metric = BinaryTargetTransformer(BinaryAccuracy(), threshold=2)",
+    "metric.update(jnp.asarray([0.8, 0.2, 0.9]), jnp.asarray([3.0, 1.0, 5.0]))",
+    "metric.compute()",
+]
+REGISTRY[(WRP, "LambdaInputTransformer")] = [
+    J,
+    f"from {WRP} import LambdaInputTransformer",
+    f"from {CLS} import BinaryAccuracy",
+    "metric = LambdaInputTransformer(BinaryAccuracy(), transform_pred=lambda p: 1 - p)",
+    "metric.update(jnp.asarray([0.2, 0.8, 0.1]), jnp.asarray([1, 0, 1]))",
+    "metric.compute()",
+]
+
+# --------------------------------------------------------------------------- text
+REGISTRY[(TXT, "BLEUScore")] = [
+    "from torchmetrics_tpu.text import BLEUScore",
+    "preds = ['the cat is on the mat']",
+    "target = [['there is a cat on the mat', 'a cat is on the mat']]",
+    "metric = BLEUScore()",
+    "metric.update(preds, target)",
+    "metric.compute()",
+]
+REGISTRY[(TXT, "SacreBLEUScore")] = [
+    "from torchmetrics_tpu.text import SacreBLEUScore",
+    "preds = ['the cat is on the mat']",
+    "target = [['there is a cat on the mat', 'a cat is on the mat']]",
+    "metric = SacreBLEUScore()",
+    "metric.update(preds, target)",
+    "metric.compute()",
+]
+REGISTRY[(TXT, "CHRFScore")] = [
+    "from torchmetrics_tpu.text import CHRFScore",
+    "preds = ['the cat is on the mat']",
+    "target = [['there is a cat on the mat']]",
+    "metric = CHRFScore()",
+    "metric.update(preds, target)",
+    "metric.compute()",
+]
+REGISTRY[(TXT, "TranslationEditRate")] = [
+    "from torchmetrics_tpu.text import TranslationEditRate",
+    "preds = ['the cat is on the mat']",
+    "target = [['there is a cat on the mat']]",
+    "metric = TranslationEditRate()",
+    "metric.update(preds, target)",
+    "metric.compute()",
+]
+REGISTRY[(TXT, "ROUGEScore")] = [
+    "from torchmetrics_tpu.text import ROUGEScore",
+    "metric = ROUGEScore(rouge_keys='rouge1')",
+    "metric.update(['the cat is on the mat'], [['a cat is on the mat']])",
+    "{k: round(float(v), 4) for k, v in metric.compute().items()}",
+]
+REGISTRY[(TXT, "CharErrorRate")] = [
+    "from torchmetrics_tpu.text import CharErrorRate",
+    "metric = CharErrorRate()",
+    "metric.update(['this is the prediction'], ['this is the reference'])",
+    "metric.compute()",
+]
+REGISTRY[(TXT, "WordErrorRate")] = [
+    "from torchmetrics_tpu.text import WordErrorRate",
+    "metric = WordErrorRate()",
+    "metric.update(['this is the prediction'], ['this is the reference'])",
+    "metric.compute()",
+]
+REGISTRY[(TXT, "MatchErrorRate")] = [
+    "from torchmetrics_tpu.text import MatchErrorRate",
+    "metric = MatchErrorRate()",
+    "metric.update(['this is the prediction'], ['this is the reference'])",
+    "metric.compute()",
+]
+REGISTRY[(TXT, "WordInfoLost")] = [
+    "from torchmetrics_tpu.text import WordInfoLost",
+    "metric = WordInfoLost()",
+    "metric.update(['this is the prediction'], ['this is the reference'])",
+    "metric.compute()",
+]
+REGISTRY[(TXT, "WordInfoPreserved")] = [
+    "from torchmetrics_tpu.text import WordInfoPreserved",
+    "metric = WordInfoPreserved()",
+    "metric.update(['this is the prediction'], ['this is the reference'])",
+    "metric.compute()",
+]
+REGISTRY[(TXT, "EditDistance")] = [
+    "from torchmetrics_tpu.text import EditDistance",
+    "metric = EditDistance()",
+    "metric.update(['rain'], ['shine'])",
+    "metric.compute()",
+]
+REGISTRY[(TXT, "ExtendedEditDistance")] = [
+    "from torchmetrics_tpu.text import ExtendedEditDistance",
+    "metric = ExtendedEditDistance()",
+    "metric.update(['this is the prediction'], [['this is the reference']])",
+    "metric.compute()",
+]
+REGISTRY[(TXT, "SQuAD")] = [
+    "from torchmetrics_tpu.text import SQuAD",
+    "preds = [{'prediction_text': '1976', 'id': '56e10a3be3433e1400422b22'}]",
+    "target = [{'answers': {'answer_start': [97], 'text': ['1976']}, 'id': '56e10a3be3433e1400422b22'}]",
+    "metric = SQuAD()",
+    "metric.update(preds, target)",
+    "{k: round(float(v), 4) for k, v in metric.compute().items()}",
+]
+REGISTRY[(TXT, "Perplexity")] = [
+    J,
+    "from torchmetrics_tpu.text import Perplexity",
+    "preds = jnp.asarray([[[0.2, 0.4, 0.4], [0.5, 0.2, 0.3]]])",
+    "target = jnp.asarray([[1, 0]])",
+    "metric = Perplexity()",
+    "metric.update(jnp.log(preds), target)",
+    "metric.compute()",
+]
+
+# -------------------------------------------------------------------------- audio
+REGISTRY[(AUD, "SignalNoiseRatio")] = [
+    J,
+    "from torchmetrics_tpu.audio import SignalNoiseRatio",
+    "preds = jnp.asarray([2.8, -1.2, 0.06, 1.3])",
+    "target = jnp.asarray([3.0, -0.5, 0.1, 1.0])",
+    "metric = SignalNoiseRatio()",
+    "metric.update(preds, target)",
+    "metric.compute()",
+]
+REGISTRY[(AUD, "ScaleInvariantSignalNoiseRatio")] = [
+    J,
+    "from torchmetrics_tpu.audio import ScaleInvariantSignalNoiseRatio",
+    "preds = jnp.asarray([2.8, -1.2, 0.06, 1.3])",
+    "target = jnp.asarray([3.0, -0.5, 0.1, 1.0])",
+    "metric = ScaleInvariantSignalNoiseRatio()",
+    "metric.update(preds, target)",
+    "metric.compute()",
+]
+REGISTRY[(AUD, "ScaleInvariantSignalDistortionRatio")] = [
+    J,
+    "from torchmetrics_tpu.audio import ScaleInvariantSignalDistortionRatio",
+    "preds = jnp.asarray([2.8, -1.2, 0.06, 1.3])",
+    "target = jnp.asarray([3.0, -0.5, 0.1, 1.0])",
+    "metric = ScaleInvariantSignalDistortionRatio()",
+    "metric.update(preds, target)",
+    "metric.compute()",
+]
+REGISTRY[(AUD, "SignalDistortionRatio")] = [
+    J,
+    "from torchmetrics_tpu.audio import SignalDistortionRatio",
+    "preds = jnp.sin(jnp.arange(800, dtype=jnp.float32) / 20)",
+    "target = jnp.sin(jnp.arange(800, dtype=jnp.float32) / 20 + 0.1)",
+    "metric = SignalDistortionRatio()",
+    "metric.update(preds, target)",
+    "metric.compute()",
+]
+REGISTRY[(AUD, "SourceAggregatedSignalDistortionRatio")] = [
+    J,
+    "from torchmetrics_tpu.audio import SourceAggregatedSignalDistortionRatio",
+    "preds = jnp.stack([jnp.sin(jnp.arange(100.0) / 9), jnp.cos(jnp.arange(100.0) / 7)])[None]",
+    "target = jnp.stack([jnp.sin(jnp.arange(100.0) / 10), jnp.cos(jnp.arange(100.0) / 8)])[None]",
+    "metric = SourceAggregatedSignalDistortionRatio()",
+    "metric.update(preds, target)",
+    "metric.compute()",
+]
+REGISTRY[(AUD, "PermutationInvariantTraining")] = [
+    J,
+    "from torchmetrics_tpu.audio import PermutationInvariantTraining",
+    "from torchmetrics_tpu.functional.audio import scale_invariant_signal_noise_ratio",
+    "preds = jnp.stack([jnp.sin(jnp.arange(100.0) / 9), jnp.cos(jnp.arange(100.0) / 7)])[None]",
+    "target = jnp.stack([jnp.cos(jnp.arange(100.0) / 8), jnp.sin(jnp.arange(100.0) / 10)])[None]",
+    "metric = PermutationInvariantTraining(scale_invariant_signal_noise_ratio, eval_func='max')",
+    "metric.update(preds, target)",
+    "metric.compute()",
+]
+REGISTRY[(AUD, "ComplexScaleInvariantSignalNoiseRatio")] = [
+    J,
+    "from torchmetrics_tpu.audio import ComplexScaleInvariantSignalNoiseRatio",
+    "preds = jnp.stack([jnp.sin(jnp.arange(48.0)).reshape(4, 12), jnp.cos(jnp.arange(48.0)).reshape(4, 12)], axis=-1)[None]",
+    "target = jnp.stack([jnp.cos(jnp.arange(48.0)).reshape(4, 12), jnp.sin(jnp.arange(48.0)).reshape(4, 12)], axis=-1)[None]",
+    "metric = ComplexScaleInvariantSignalNoiseRatio()",
+    "metric.update(preds, target)",
+    "metric.compute()",
+]
+
+# ---------------------------------------------------------------------- detection
+REGISTRY[(DET, "IntersectionOverUnion")] = [
+    J,
+    "from torchmetrics_tpu.detection import IntersectionOverUnion",
+    "preds = [{'boxes': jnp.asarray([[296.55, 93.96, 314.97, 152.79]]),"
+    " 'scores': jnp.asarray([0.236]), 'labels': jnp.asarray([4])}]",
+    "target = [{'boxes': jnp.asarray([[300.00, 100.00, 315.00, 150.00]]), 'labels': jnp.asarray([4])}]",
+    "metric = IntersectionOverUnion()",
+    "metric.update(preds, target)",
+    "{k: round(float(v), 4) for k, v in metric.compute().items()}",
+]
+for name in ("GeneralizedIntersectionOverUnion", "DistanceIntersectionOverUnion", "CompleteIntersectionOverUnion"):
+    REGISTRY[(DET, name)] = [
+        J,
+        f"from torchmetrics_tpu.detection import {name}",
+        "preds = [{'boxes': jnp.asarray([[296.55, 93.96, 314.97, 152.79]]),"
+        " 'scores': jnp.asarray([0.236]), 'labels': jnp.asarray([4])}]",
+        "target = [{'boxes': jnp.asarray([[300.00, 100.00, 315.00, 150.00]]), 'labels': jnp.asarray([4])}]",
+        f"metric = {name}()",
+        "metric.update(preds, target)",
+        "{k: round(float(v), 4) for k, v in metric.compute().items()}",
+    ]
+REGISTRY[(DET, "MeanAveragePrecision")] = [
+    J,
+    "from torchmetrics_tpu.detection import MeanAveragePrecision",
+    "preds = [{'boxes': jnp.asarray([[258.0, 41.0, 606.0, 285.0]]),"
+    " 'scores': jnp.asarray([0.536]), 'labels': jnp.asarray([0])}]",
+    "target = [{'boxes': jnp.asarray([[214.0, 41.0, 562.0, 285.0]]), 'labels': jnp.asarray([0])}]",
+    "metric = MeanAveragePrecision(iou_type='bbox')",
+    "metric.update(preds, target)",
+    "result = metric.compute()",
+    "round(float(result['map']), 4), round(float(result['map_50']), 4)",
+]
+REGISTRY[(DET, "PanopticQuality")] = [
+    J,
+    "from torchmetrics_tpu.detection import PanopticQuality",
+    "preds = jnp.asarray([[[[6, 0], [0, 0], [6, 0], [6, 0]],"
+    " [[0, 0], [0, 0], [6, 0], [0, 1]],"
+    " [[0, 0], [0, 0], [6, 0], [0, 1]],"
+    " [[0, 0], [7, 0], [6, 0], [1, 0]]]])",
+    "target = jnp.asarray([[[[6, 0], [0, 1], [6, 0], [0, 1]],"
+    " [[0, 1], [0, 1], [6, 0], [0, 1]],"
+    " [[0, 1], [0, 1], [6, 0], [1, 0]],"
+    " [[0, 1], [7, 0], [1, 0], [1, 0]]]])",
+    "metric = PanopticQuality(things={0, 1}, stuffs={6, 7})",
+    "metric.update(preds, target)",
+    "metric.compute()",
+]
+
+# -------------------------------------------------------------------------- image
+IMG_A = ("preds = (jnp.arange(48, dtype=jnp.float32).reshape(1, 3, 4, 4) * 37 % 97) / 97")
+IMG_B = ("target = (jnp.arange(48, dtype=jnp.float32).reshape(1, 3, 4, 4) * 31 % 89) / 89")
+REGISTRY[(IMG, "PeakSignalNoiseRatio")] = [
+    J,
+    "from torchmetrics_tpu.image import PeakSignalNoiseRatio",
+    "preds = jnp.asarray([[0.0, 1.0], [2.0, 3.0]])",
+    "target = jnp.asarray([[3.0, 2.0], [1.0, 0.0]])",
+    "metric = PeakSignalNoiseRatio()",
+    "metric.update(preds, target)",
+    "metric.compute()",
+]
+REGISTRY[(IMG, "StructuralSimilarityIndexMeasure")] = [
+    J,
+    "from torchmetrics_tpu.image import StructuralSimilarityIndexMeasure",
+    "preds = (jnp.arange(768, dtype=jnp.float32).reshape(1, 3, 16, 16) * 37 % 97) / 97",
+    "target = (jnp.arange(768, dtype=jnp.float32).reshape(1, 3, 16, 16) * 31 % 89) / 89",
+    "metric = StructuralSimilarityIndexMeasure(data_range=1.0)",
+    "metric.update(preds, target)",
+    "metric.compute()",
+]
+REGISTRY[(IMG, "MultiScaleStructuralSimilarityIndexMeasure")] = [
+    J,
+    "from torchmetrics_tpu.image import MultiScaleStructuralSimilarityIndexMeasure",
+    "preds = (jnp.arange(3 * 180 * 180, dtype=jnp.float32).reshape(1, 3, 180, 180) * 37 % 97) / 97",
+    "target = (jnp.arange(3 * 180 * 180, dtype=jnp.float32).reshape(1, 3, 180, 180) * 31 % 89) / 89",
+    "metric = MultiScaleStructuralSimilarityIndexMeasure(data_range=1.0)",
+    "metric.update(preds, target)",
+    "metric.compute()",
+]
+REGISTRY[(IMG, "UniversalImageQualityIndex")] = [
+    J,
+    "from torchmetrics_tpu.image import UniversalImageQualityIndex",
+    "preds = (jnp.arange(768, dtype=jnp.float32).reshape(1, 3, 16, 16) * 37 % 97) / 97",
+    "target = (jnp.arange(768, dtype=jnp.float32).reshape(1, 3, 16, 16) * 31 % 89) / 89",
+    "metric = UniversalImageQualityIndex()",
+    "metric.update(preds, target)",
+    "metric.compute()",
+]
+REGISTRY[(IMG, "TotalVariation")] = [
+    J,
+    "from torchmetrics_tpu.image import TotalVariation",
+    IMG_A,
+    "metric = TotalVariation()",
+    "metric.update(preds)",
+    "metric.compute()",
+]
+REGISTRY[(IMG, "SpectralAngleMapper")] = [
+    J,
+    "from torchmetrics_tpu.image import SpectralAngleMapper",
+    IMG_A,
+    IMG_B,
+    "metric = SpectralAngleMapper()",
+    "metric.update(preds, target)",
+    "metric.compute()",
+]
+REGISTRY[(IMG, "ErrorRelativeGlobalDimensionlessSynthesis")] = [
+    J,
+    "from torchmetrics_tpu.image import ErrorRelativeGlobalDimensionlessSynthesis",
+    IMG_A,
+    IMG_B,
+    "metric = ErrorRelativeGlobalDimensionlessSynthesis()",
+    "metric.update(preds, target)",
+    "metric.compute()",
+]
+REGISTRY[(IMG, "RelativeAverageSpectralError")] = [
+    J,
+    "from torchmetrics_tpu.image import RelativeAverageSpectralError",
+    "preds = (jnp.arange(768, dtype=jnp.float32).reshape(1, 3, 16, 16) * 37 % 97) / 97",
+    "target = (jnp.arange(768, dtype=jnp.float32).reshape(1, 3, 16, 16) * 31 % 89) / 89",
+    "metric = RelativeAverageSpectralError()",
+    "metric.update(preds, target)",
+    "metric.compute()",
+]
+REGISTRY[(IMG, "RootMeanSquaredErrorUsingSlidingWindow")] = [
+    J,
+    "from torchmetrics_tpu.image import RootMeanSquaredErrorUsingSlidingWindow",
+    "preds = (jnp.arange(768, dtype=jnp.float32).reshape(1, 3, 16, 16) * 37 % 97) / 97",
+    "target = (jnp.arange(768, dtype=jnp.float32).reshape(1, 3, 16, 16) * 31 % 89) / 89",
+    "metric = RootMeanSquaredErrorUsingSlidingWindow()",
+    "metric.update(preds, target)",
+    "metric.compute()",
+]
+REGISTRY[(IMG, "SpatialCorrelationCoefficient")] = [
+    J,
+    "from torchmetrics_tpu.image import SpatialCorrelationCoefficient",
+    "preds = (jnp.arange(768, dtype=jnp.float32).reshape(1, 3, 16, 16) * 37 % 97) / 97",
+    "target = (jnp.arange(768, dtype=jnp.float32).reshape(1, 3, 16, 16) * 31 % 89) / 89",
+    "metric = SpatialCorrelationCoefficient()",
+    "metric.update(preds, target)",
+    "metric.compute()",
+]
+REGISTRY[(IMG, "PeakSignalNoiseRatioWithBlockedEffect")] = [
+    J,
+    "from torchmetrics_tpu.image import PeakSignalNoiseRatioWithBlockedEffect",
+    "preds = (jnp.arange(256, dtype=jnp.float32).reshape(1, 1, 16, 16) * 37 % 97) / 97",
+    "target = (jnp.arange(256, dtype=jnp.float32).reshape(1, 1, 16, 16) * 31 % 89) / 89",
+    "metric = PeakSignalNoiseRatioWithBlockedEffect(block_size=8)",
+    "metric.update(preds, target)",
+    "metric.compute()",
+]
+REGISTRY[(IMG, "VisualInformationFidelity")] = [
+    J,
+    "from torchmetrics_tpu.image import VisualInformationFidelity",
+    "preds = (jnp.arange(3 * 48 * 48, dtype=jnp.float32).reshape(1, 3, 48, 48) * 37 % 97) / 97",
+    "target = (jnp.arange(3 * 48 * 48, dtype=jnp.float32).reshape(1, 3, 48, 48) * 31 % 89) / 89",
+    "metric = VisualInformationFidelity()",
+    "metric.update(preds, target)",
+    "metric.compute()",
+]
+REGISTRY[(IMG, "FrechetInceptionDistance")] = [
+    J,
+    "from torchmetrics_tpu.image import FrechetInceptionDistance",
+    "def tiny_extractor(imgs):",
+    "...     return imgs.reshape(imgs.shape[0], -1)[:, :8].astype(jnp.float32)",
+    "metric = FrechetInceptionDistance(feature=tiny_extractor, normalize=True)",
+    "imgs_real = (jnp.arange(2 * 3 * 16 * 16, dtype=jnp.float32).reshape(2, 3, 16, 16) * 37 % 97) / 97",
+    "imgs_fake = (jnp.arange(2 * 3 * 16 * 16, dtype=jnp.float32).reshape(2, 3, 16, 16) * 31 % 89) / 89",
+    "metric.update(imgs_real, real=True)",
+    "metric.update(imgs_fake, real=False)",
+    "round(float(metric.compute()), 4)",
+]
+
+# ----------------------------------------------------------------------- retrieval
+RET_LINES = [
+    J,
+    "indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])",
+    "preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])",
+    "target = jnp.asarray([False, False, True, False, True, False, True])",
+]
+for name, ctor in [
+    ("RetrievalMAP", ""),
+    ("RetrievalMRR", ""),
+    ("RetrievalPrecision", "top_k=2"),
+    ("RetrievalRecall", "top_k=2"),
+    ("RetrievalHitRate", "top_k=2"),
+    ("RetrievalFallOut", "top_k=2"),
+    ("RetrievalNormalizedDCG", ""),
+    ("RetrievalRPrecision", ""),
+    ("RetrievalAUROC", ""),
+]:
+    REGISTRY[(RET, name)] = [
+        RET_LINES[0],
+        f"from torchmetrics_tpu.retrieval import {name}",
+        *RET_LINES[1:],
+        f"metric = {name}({ctor})",
+        "metric.update(preds, target, indexes=indexes)",
+        "metric.compute()",
+    ]
+
+# ---------------------------------------------------------------------- clustering
+CLU_LABELS = [
+    "preds = jnp.asarray([2, 1, 0, 1, 0])",
+    "target = jnp.asarray([0, 2, 1, 1, 0])",
+]
+for name in [
+    "MutualInfoScore", "NormalizedMutualInfoScore", "AdjustedMutualInfoScore",
+    "RandScore", "AdjustedRandScore", "FowlkesMallowsIndex",
+    "HomogeneityScore", "CompletenessScore", "VMeasureScore",
+]:
+    REGISTRY[(CLU, name)] = [
+        J,
+        f"from torchmetrics_tpu.clustering import {name}",
+        *CLU_LABELS,
+        f"metric = {name}()",
+        "metric.update(preds, target)",
+        "metric.compute()",
+    ]
+REGISTRY[(CLU, "ClusterAccuracy")] = [
+    J,
+    "from torchmetrics_tpu.clustering import ClusterAccuracy",
+    *CLU_LABELS,
+    "metric = ClusterAccuracy(num_classes=3)",
+    "metric.update(preds, target)",
+    "metric.compute()",
+]
+CLU_DATA = [
+    "data = jnp.asarray([[0.0, 0.0], [0.5, 0.0], [10.0, 10.0], [10.5, 10.0], [20.0, 0.0], [20.5, 0.0]])",
+    "labels = jnp.asarray([0, 0, 1, 1, 2, 2])",
+]
+for name in ("CalinskiHarabaszScore", "DaviesBouldinScore", "DunnIndex"):
+    REGISTRY[(CLU, name)] = [
+        J,
+        f"from torchmetrics_tpu.clustering import {name}",
+        *CLU_DATA,
+        f"metric = {name}()",
+        "metric.update(data, labels)",
+        "metric.compute()",
+    ]
+
+# ------------------------------------------------------------------------- nominal
+for name in ("CramersV", "PearsonsContingencyCoefficient", "TheilsU", "TschuprowsT"):
+    REGISTRY[(NOM, name)] = [
+        J,
+        f"from torchmetrics_tpu.nominal import {name}",
+        "preds = jnp.asarray([0, 1, 2, 2, 1, 0, 1, 2, 1, 0])",
+        "target = jnp.asarray([0, 1, 2, 1, 1, 0, 2, 2, 1, 0])",
+        f"metric = {name}(num_classes=3)",
+        "metric.update(preds, target)",
+        "metric.compute()",
+    ]
+REGISTRY[(NOM, "FleissKappa")] = [
+    J,
+    "from torchmetrics_tpu.nominal import FleissKappa",
+    "ratings = jnp.asarray([[0, 4, 1], [2, 2, 1], [4, 0, 1], [1, 3, 1]])",
+    "metric = FleissKappa(mode='counts')",
+    "metric.update(ratings)",
+    "metric.compute()",
+]
+
+# --------------------------------------------------------------------- segmentation
+SEG_LINES = [
+    "preds = jnp.asarray([[[0, 1, 1, 0], [1, 1, 0, 0], [2, 2, 1, 0], [2, 0, 0, 0]]])",
+    "target = jnp.asarray([[[0, 1, 1, 0], [1, 0, 0, 0], [2, 2, 0, 0], [2, 2, 0, 0]]])",
+]
+for name, ctor in [
+    ("DiceScore", "num_classes=3, input_format='index'"),
+    ("GeneralizedDiceScore", "num_classes=3, input_format='index'"),
+    ("MeanIoU", "num_classes=3, input_format='index'"),
+]:
+    REGISTRY[(SEG, name)] = [
+        J,
+        f"from torchmetrics_tpu.segmentation import {name}",
+        *SEG_LINES,
+        f"metric = {name}({ctor})",
+        "metric.update(preds, target)",
+        "metric.compute()",
+    ]
+REGISTRY[(SEG, "HausdorffDistance")] = [
+    J,
+    "from torchmetrics_tpu.segmentation import HausdorffDistance",
+    *SEG_LINES,
+    "metric = HausdorffDistance(num_classes=3, input_format='index')",
+    "metric.update(preds, target)",
+    "metric.compute()",
+]
+
+# -------------------------------------------------------------------------- shape
+REGISTRY[(SHP, "ProcrustesDisparity")] = [
+    J,
+    "from torchmetrics_tpu.shape import ProcrustesDisparity",
+    "point_set1 = jnp.asarray([[[0.0, 0.0], [1.0, 0.0], [1.0, 1.0], [0.0, 1.0]]])",
+    "point_set2 = jnp.asarray([[[0.0, 0.0], [2.0, 0.0], [2.0, 2.0], [0.0, 2.0]]])",
+    "metric = ProcrustesDisparity()",
+    "metric.update(point_set1, point_set2)",
+    "metric.compute()",
+]
+
+# --------------------------------------------------------------------- multimodal
+REGISTRY[(MMD, "LipVertexError")] = [
+    J,
+    "from torchmetrics_tpu.multimodal import LipVertexError",
+    "vertices_pred = (jnp.arange(90, dtype=jnp.float32).reshape(1, 5, 6, 3) * 37 % 19) / 19",
+    "vertices_gt = (jnp.arange(90, dtype=jnp.float32).reshape(1, 5, 6, 3) * 31 % 17) / 17",
+    "metric = LipVertexError(mouth_map=[1, 2, 3])",
+    "metric.update(vertices_pred, vertices_gt)",
+    "metric.compute()",
+]

@@ -1,0 +1,129 @@
+"""Round-5 experiment: where do 6,831 (doc, trunk-only chained) vs 6,008 (driver,
+full FID update) img/s diverge?  Measures on the real chip:
+
+  A. trunk-only, iteration-chained (doc methodology)
+  B. full fid.update loop (driver bench methodology, r04 code path)
+  C. prototype fused update: normalize+quantize+trunk+cov+merge in ONE jitted call
+
+Run each in its own subprocess (D2H poisoning rule).
+"""
+import json
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+BATCH = 512
+
+
+def exp_trunk_chained():
+    import jax
+    import jax.numpy as jnp
+    from torchmetrics_tpu.image._extractors import InceptionV3Features, _inception_forward
+
+    rng = np.random.default_rng(3)
+    ext = InceptionV3Features(compute_dtype="bfloat16")
+    imgs = jnp.asarray((rng.random((BATCH, 3, 299, 299)) * 255).astype(np.float32)).astype(jnp.bfloat16)
+
+    @jax.jit
+    def chained(x):
+        f = _inception_forward(ext.params, x)
+        # fold features back into the next input so each iter data-depends on the last
+        return x + (f.mean() * 0).astype(x.dtype)
+
+    x = imgs
+    for _ in range(3):
+        x = chained(x)
+    jax.block_until_ready(x)
+    iters = 12
+    start = time.perf_counter()
+    for _ in range(iters):
+        x = chained(x)
+    jax.block_until_ready(x)
+    el = time.perf_counter() - start
+    return {"trunk_chained_img_s": round(iters * BATCH / el, 1)}
+
+
+def exp_full_update():
+    import jax
+    import jax.numpy as jnp
+    from torchmetrics_tpu.image import FrechetInceptionDistance
+    from torchmetrics_tpu.image._extractors import InceptionV3Features
+
+    rng = np.random.default_rng(3)
+    imgs = jnp.asarray(rng.random((BATCH, 3, 299, 299)).astype(np.float32))
+    fid = FrechetInceptionDistance(feature=InceptionV3Features(compute_dtype="bfloat16"), normalize=True)
+    fid.update(imgs, real=True)
+    fid.update(imgs, real=False)
+    jax.block_until_ready(fid._state)
+    iters = 10
+    rates = []
+    for _ in range(3):
+        start = time.perf_counter()
+        for i in range(iters):
+            fid.update(imgs, real=bool(i % 2))
+        jax.block_until_ready(fid._state)
+        rates.append(iters * BATCH / (time.perf_counter() - start))
+    return {"full_update_img_s": round(sorted(rates)[1], 1)}
+
+
+def exp_fused_update():
+    import jax
+    import jax.numpy as jnp
+    from torchmetrics_tpu.image._extractors import InceptionV3Features, _inception_forward
+
+    rng = np.random.default_rng(3)
+    ext = InceptionV3Features(compute_dtype="bfloat16")
+    imgs = jnp.asarray(rng.random((BATCH, 3, 299, 299)).astype(np.float32))
+
+    def batch_state(state, x, real):
+        # normalize=True semantics: [0,1] float -> uint8 quantize -> trunk 0-255 scale
+        x = (x * 255).astype(jnp.uint8).astype(jnp.float32)
+        f = _inception_forward(ext.params, x.astype(jnp.bfloat16))
+        f = f.astype(jnp.float32)
+        fsum = f.sum(axis=0)
+        cov = jnp.matmul(f.T, f, precision="highest")
+        n = jnp.asarray(f.shape[0], jnp.int32)
+        m = real.astype(jnp.float32)
+        nm = real.astype(jnp.int32)
+        upd = {
+            "rs": fsum * m, "rc": cov * m, "rn": n * nm,
+            "fs": fsum * (1 - m), "fc": cov * (1 - m), "fn": n * (1 - nm),
+        }
+        return {k: state[k] + upd[k] for k in state}
+
+    step = jax.jit(batch_state, donate_argnums=0)
+    F = 2048
+    state = {
+        "rs": jnp.zeros(F), "rc": jnp.zeros((F, F)), "rn": jnp.zeros((), jnp.int32),
+        "fs": jnp.zeros(F), "fc": jnp.zeros((F, F)), "fn": jnp.zeros((), jnp.int32),
+    }
+    for i in range(2):
+        state = step(state, imgs, jnp.asarray(bool(i % 2)))
+    jax.block_until_ready(state)
+    iters = 10
+    rates = []
+    for _ in range(3):
+        start = time.perf_counter()
+        for i in range(iters):
+            state = step(state, imgs, jnp.asarray(bool(i % 2)))
+        jax.block_until_ready(state)
+        rates.append(iters * BATCH / (time.perf_counter() - start))
+    return {"fused_update_img_s": round(sorted(rates)[1], 1)}
+
+
+EXPS = {"trunk": exp_trunk_chained, "full": exp_full_update, "fused": exp_fused_update}
+
+if __name__ == "__main__":
+    if len(sys.argv) == 2:
+        print(json.dumps(EXPS[sys.argv[1]]()))
+        sys.exit(0)
+    out = {}
+    for name in EXPS:
+        r = subprocess.run([sys.executable, __file__, name], capture_output=True, text=True, timeout=900)
+        try:
+            out.update(json.loads(r.stdout.strip().splitlines()[-1]))
+        except Exception:
+            out[name + "_error"] = (r.stderr or r.stdout)[-400:]
+    print(json.dumps(out))

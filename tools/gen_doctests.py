@@ -1,0 +1,124 @@
+"""Generate `Example:` doctest blocks for the public Metric classes (VERDICT r4 #2).
+
+For each registered class this script executes the example lines in a fresh
+namespace under the SAME environment the test suite uses (CPU backend, 8 virtual
+devices — see tests/conftest.py), captures the repr of every expression line the
+way doctest would, and splices the finished `Example:` block into the class
+docstring in the source file. Idempotent: classes whose docstring already holds
+a `>>>` block are skipped (delete the block to regenerate).
+
+Run:  PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+      XLA_FLAGS=--xla_force_host_platform_device_count=8 python tools/gen_doctests.py
+"""
+
+from __future__ import annotations
+
+import ast
+import importlib
+import inspect
+import os
+import sys
+from pathlib import Path
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+INDENT = "    "
+
+
+def run_example(lines: list[str]) -> list[str]:
+    """Execute example lines doctest-style; return `>>> line` + captured output."""
+    ns: dict = {}
+    out: list[str] = []
+    block: list[str] = []
+
+    def flush_block():
+        if block:
+            exec(compile("\n".join(block), "<example>", "exec"), ns)  # noqa: S102
+            block.clear()
+
+    i = 0
+    while i < len(lines):
+        line = lines[i]
+        cont = []
+        while i + 1 < len(lines) and lines[i + 1].startswith("... "):
+            cont.append(lines[i + 1][4:])
+            i += 1
+        src = "\n".join([line] + cont)
+        out.append(f">>> {line}")
+        out.extend(f"... {c}" for c in cont)
+        try:
+            code = compile(src, "<example>", "eval")
+        except SyntaxError:
+            exec(compile(src, "<example>", "exec"), ns)  # noqa: S102
+        else:
+            value = eval(code, ns)  # noqa: S307
+            if value is not None:
+                out.extend(repr(value).splitlines())
+        i += 1
+    return out
+
+
+def inject(cls, rendered: list[str], header: str = "Example:") -> bool:
+    src_file = Path(inspect.getfile(cls))
+    source = src_file.read_text()
+    tree = ast.parse(source)
+    node = next(
+        (n for n in ast.walk(tree) if isinstance(n, ast.ClassDef) and n.name == cls.__name__),
+        None,
+    )
+    if node is None:
+        raise RuntimeError(f"class {cls.__name__} not found in {src_file}")
+    doc = ast.get_docstring(node, clean=False)
+    if doc is None:
+        raise RuntimeError(f"class {cls.__name__} has no docstring")
+    if ">>>" in doc:
+        return False
+    doc_node = node.body[0].value
+    lines = source.splitlines(keepends=True)
+    last = lines[doc_node.end_lineno - 1]
+    q = last.rfind('"""')
+    if q < 0:
+        raise RuntimeError(f"unsupported docstring quoting for {cls.__name__}")
+    body_indent = INDENT  # class docstrings in this repo sit at one indent level
+    block = "\n\n" + body_indent + header + "\n"
+    block += "".join(f"{body_indent}    {ln}".rstrip() + "\n" for ln in rendered)
+    block += body_indent
+    lines[doc_node.end_lineno - 1] = last[:q] + block + last[q:]
+    src_file.write_text("".join(lines))
+    return True
+
+
+def main(registry: dict) -> None:
+    import jax
+
+    assert jax.devices()[0].platform == "cpu", "generation must run on the CPU backend"
+    written = skipped = failed = 0
+    for (module, cls_name), lines in registry.items():
+        mod = importlib.import_module(module)
+        cls = getattr(mod, cls_name)
+        try:
+            rendered = run_example(lines)
+        except Exception as err:  # noqa: BLE001
+            print(f"FAIL {cls_name}: {type(err).__name__}: {err}")
+            failed += 1
+            continue
+        if inject(cls, rendered):
+            written += 1
+            print(f"ok   {cls_name}")
+        else:
+            skipped += 1
+            print(f"skip {cls_name} (already has an example)")
+    print(f"\n{written} written, {skipped} skipped, {failed} failed")
+    if failed:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    from tools.doctest_registry import REGISTRY
+
+    main(REGISTRY)

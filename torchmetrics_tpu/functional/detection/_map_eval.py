@@ -217,10 +217,15 @@ class MAPInputs:
         return np.unique(np.concatenate(parts)).astype(int).tolist()
 
 
+def _mask_areas(masks: np.ndarray) -> np.ndarray:
+    # sum over every axis but the first: reshape(n, -1) raises on n == 0 (an
+    # empty-image mask stack like (0, H, W) makes -1 ambiguous)
+    return masks.sum(axis=tuple(range(1, masks.ndim))).astype(np.float64)
+
+
 def _det_area(inputs: MAPInputs, img: int, iou_type: str) -> np.ndarray:
     if iou_type == "segm":
-        masks = inputs.det_masks[img]
-        return masks.reshape(masks.shape[0], -1).sum(-1).astype(np.float64)
+        return _mask_areas(inputs.det_masks[img])
     b = inputs.det_boxes[img]
     return ((b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])).astype(np.float64)
 
@@ -228,8 +233,7 @@ def _det_area(inputs: MAPInputs, img: int, iou_type: str) -> np.ndarray:
 def _gt_area(inputs: MAPInputs, img: int, iou_type: str) -> np.ndarray:
     provided = inputs.gt_areas[img].astype(np.float64)
     if iou_type == "segm":
-        masks = inputs.gt_masks[img]
-        computed = masks.reshape(masks.shape[0], -1).sum(-1).astype(np.float64)
+        computed = _mask_areas(inputs.gt_masks[img])
     else:
         b = inputs.gt_boxes[img]
         computed = ((b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])).astype(np.float64)

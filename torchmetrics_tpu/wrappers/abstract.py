@@ -53,6 +53,67 @@ class WrapperMetric(Metric):
         self._update_count += incoming_state._update_count
         self._computed = None
 
+    # ------------------------------------------------------------- checkpoint
+    # The reference's wrappers checkpoint for free through nn.Module recursion
+    # (reference metric.py:919-990 + Module.state_dict). Here the children are
+    # plain attributes, so the wrapper recurses explicitly: children are saved
+    # under their `_merge_children()` order (stable per wrapper type), and
+    # wrapper-level non-child state rides through the `_checkpoint_extra` hook.
+    # Persistence mirrors the base Metric contract: nothing is written (and no
+    # update count is stamped) unless `persistent(True)` was called, so a
+    # default-persistence wrapper restores as cleanly fresh instead of as an
+    # updated metric with empty children.
+
+    _wrapper_persistent = False
+
+    def persistent(self, mode: bool = False) -> None:
+        super().persistent(mode)
+        self._wrapper_persistent = mode
+        for child in self._merge_children():
+            child.persistent(mode)
+
+    def _checkpoint_extra(self) -> dict:
+        """Wrapper-level non-child state to persist (e.g. MinMax extrema)."""
+        return {}
+
+    def _load_checkpoint_extra(self, extra: dict) -> None:
+        """Restore what `_checkpoint_extra` saved; wrappers with extra override."""
+
+    def state_dict(self, destination=None, prefix: str = "") -> dict:
+        import numpy as np
+
+        destination = {} if destination is None else destination
+        before = len(destination)
+        super().state_dict(destination, prefix)
+        for i, child in enumerate(self._merge_children()):
+            child.state_dict(destination, f"{prefix}_child{i}.")
+        if self._wrapper_persistent:
+            for k, v in self._checkpoint_extra().items():
+                destination[f"{prefix}_wrapper_extra.{k}"] = np.asarray(v)
+        if len(destination) > before:
+            destination[prefix + "_wrapper_update_count"] = int(self._update_count)
+        return destination
+
+    def load_state_dict(self, state_dict: dict, prefix: str = "") -> None:
+        import jax.numpy as jnp
+
+        super().load_state_dict(state_dict, prefix)
+        for i, child in enumerate(self._merge_children()):
+            child.load_state_dict(state_dict, f"{prefix}_child{i}.")
+        count_key = prefix + "_wrapper_update_count"
+        if count_key in state_dict:
+            self._update_count = int(state_dict[count_key])
+            self._computed = None
+        extra_prefix = prefix + "_wrapper_extra."
+        extra = {
+            k[len(extra_prefix):]: jnp.asarray(v)
+            for k, v in state_dict.items()
+            if k.startswith(extra_prefix)
+        }
+        if extra:
+            self._load_checkpoint_extra(extra)
+            self._computed = None
+
     def _batch_state(self, *args: Any, **kwargs: Any):  # pragma: no cover - wrappers bypass
         raise NotImplementedError(f"{type(self).__name__} drives its children directly.")
 

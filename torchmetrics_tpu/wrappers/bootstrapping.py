@@ -224,6 +224,18 @@ class BootStrapper(WrapperMetric):
                 weights=(float(self._update_count), float(incoming._update_count)),
             )
 
+    def _checkpoint_extra(self):
+        # the vmapped fast path accumulates in the stacked (k, ...) pytree, not
+        # in child Metric instances — persist it alongside the children
+        return dict(self._stacked) if self._use_vmap else {}
+
+    def _load_checkpoint_extra(self, extra) -> None:
+        if self._use_vmap:
+            self._stacked = {
+                k: jnp.asarray(extra[k]).astype(jnp.asarray(v).dtype)
+                for k, v in self._stacked.items()
+            }
+
     def reset(self) -> None:
         if self._use_vmap:
             self._stacked = jax.tree.map(

@@ -66,6 +66,13 @@ class MinMaxMetric(WrapperMetric):
         self.min_val = jnp.minimum(self.min_val, incoming.min_val)
         self.max_val = jnp.maximum(self.max_val, incoming.max_val)
 
+    def _checkpoint_extra(self):
+        return {"min_val": self.min_val, "max_val": self.max_val}
+
+    def _load_checkpoint_extra(self, extra) -> None:
+        self.min_val = extra["min_val"]
+        self.max_val = extra["max_val"]
+
     def reset(self) -> None:
         self._base_metric.reset()
         self.min_val = jnp.asarray(jnp.inf)

@@ -87,6 +87,57 @@ class Running(WrapperMetric):
             "ranks has no defined update order. Compute per-rank or wrap an unsynced base metric."
         )
 
+    # ------------------------------------------------------------- checkpoint
+    # The wrapper's real state is the ring of per-update state pytrees, not a
+    # child Metric (WrapperMetric's child recursion does not apply — there are
+    # no merge children; window merging is undefined, see merge_state). The
+    # ring is flattened to "<prefix>_ring{i}.{key}[.{j}]" leaves so it rides a
+    # plain array-pytree checkpoint (orbax-friendly, tests/test_orbax_checkpoint.py).
+
+    def persistent(self, mode: bool = False) -> None:
+        self._wrapper_persistent = mode
+        self.base_metric.persistent(mode)
+
+    def state_dict(self, destination=None, prefix: str = "") -> dict:
+        import numpy as np
+
+        destination = {} if destination is None else destination
+        if not self._wrapper_persistent:
+            return destination
+        for i, contrib in enumerate(self._ring):
+            for key, value in contrib.items():
+                if isinstance(value, list):
+                    destination[f"{prefix}_ring{i}.{key}._len"] = len(value)
+                    for j, row in enumerate(value):
+                        destination[f"{prefix}_ring{i}.{key}.{j}"] = np.asarray(row)
+                else:
+                    destination[f"{prefix}_ring{i}.{key}"] = np.asarray(value)
+        destination[prefix + "_ring_len"] = len(self._ring)
+        destination[prefix + "_wrapper_update_count"] = int(self._update_count)
+        return destination
+
+    def load_state_dict(self, state_dict: dict, prefix: str = "") -> None:
+        import jax.numpy as jnp
+
+        if prefix + "_ring_len" not in state_dict:
+            return
+        ring = []
+        for i in range(int(state_dict[prefix + "_ring_len"])):
+            contrib = {}
+            for key, default in self.base_metric._defaults.items():
+                stem = f"{prefix}_ring{i}.{key}"
+                if isinstance(default, list):
+                    contrib[key] = [
+                        jnp.asarray(state_dict[f"{stem}.{j}"])
+                        for j in range(int(state_dict[f"{stem}._len"]))
+                    ]
+                else:
+                    contrib[key] = jnp.asarray(state_dict[stem])
+            ring.append(contrib)
+        self._ring = ring
+        self._update_count = int(state_dict[prefix + "_wrapper_update_count"])
+        self._computed = None
+
     def reset(self) -> None:
         self.base_metric.reset()
         self._ring = []
