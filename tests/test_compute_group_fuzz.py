@@ -1,0 +1,144 @@
+"""Compute-group formation fuzz (VERDICT r4 #7a).
+
+Random subsets of 8-15 multiclass metrics are built as a MetricCollection here
+AND in the reference (tests/oracle.py), fed identical data, and compared on:
+
+- the GROUP PARTITION the state-equality merge discovers (reference
+  collections.py:269-356) — same groups, member-for-member;
+- update-count economy — after the groups are checked, only one state dict per
+  group exists (members alias their leader's states);
+- every computed value, name-for-name, against the reference.
+
+The pool mixes state families deliberately: stat-scores sharers, confusion-matrix
+sharers, binned-curve sharers at TWO different threshold counts (same-family
+metrics with different binning must NOT merge), and loners.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+import torchmetrics_tpu as tm
+from torchmetrics_tpu import MetricCollection
+
+from conftest import seed_all
+from oracle import require_oracle
+
+C = 5
+N = 64
+
+# name -> (our ctor, reference ctor factory taking the reference module)
+POOL = {
+    "acc_macro": (lambda: tm.MulticlassAccuracy(C), lambda R: R.MulticlassAccuracy(C)),
+    "acc_micro": (lambda: tm.MulticlassAccuracy(C, average="micro"), lambda R: R.MulticlassAccuracy(C, average="micro")),
+    "precision": (lambda: tm.MulticlassPrecision(C), lambda R: R.MulticlassPrecision(C)),
+    "recall": (lambda: tm.MulticlassRecall(C), lambda R: R.MulticlassRecall(C)),
+    "f1": (lambda: tm.MulticlassF1Score(C), lambda R: R.MulticlassF1Score(C)),
+    "specificity": (lambda: tm.MulticlassSpecificity(C), lambda R: R.MulticlassSpecificity(C)),
+    "stat_scores": (lambda: tm.MulticlassStatScores(C), lambda R: R.MulticlassStatScores(C)),
+    "confmat": (lambda: tm.MulticlassConfusionMatrix(C), lambda R: R.MulticlassConfusionMatrix(C)),
+    "cohen_kappa": (lambda: tm.MulticlassCohenKappa(C), lambda R: R.MulticlassCohenKappa(C)),
+    "matthews": (lambda: tm.MulticlassMatthewsCorrCoef(C), lambda R: R.MulticlassMatthewsCorrCoef(C)),
+    "jaccard": (lambda: tm.MulticlassJaccardIndex(C), lambda R: R.MulticlassJaccardIndex(C)),
+    "auroc_t17": (lambda: tm.MulticlassAUROC(C, thresholds=17), lambda R: R.MulticlassAUROC(C, thresholds=17)),
+    "ap_t17": (lambda: tm.MulticlassAveragePrecision(C, thresholds=17), lambda R: R.MulticlassAveragePrecision(C, thresholds=17)),
+    "roc_t17": (lambda: tm.MulticlassROC(C, thresholds=17), lambda R: R.MulticlassROC(C, thresholds=17)),
+    "auroc_t31": (lambda: tm.MulticlassAUROC(C, thresholds=31), lambda R: R.MulticlassAUROC(C, thresholds=31)),
+    "ap_t31": (lambda: tm.MulticlassAveragePrecision(C, thresholds=31), lambda R: R.MulticlassAveragePrecision(C, thresholds=31)),
+    "calibration": (lambda: tm.MulticlassCalibrationError(C, n_bins=10), lambda R: R.MulticlassCalibrationError(C, n_bins=10)),
+    "hinge": (lambda: tm.MulticlassHingeLoss(C), lambda R: R.MulticlassHingeLoss(C)),
+    "exact_match": (lambda: tm.MulticlassExactMatch(C), lambda R: R.MulticlassExactMatch(C)),
+}
+
+
+def _partition(groups, modules):
+    """compute_groups dict -> canonical frozenset-of-frozensets of member names."""
+    covered = frozenset(frozenset(members) for members in groups.values())
+    assert sum(len(g) for g in covered) == len(modules)
+    return covered
+
+
+def _flatten(prefix, value, out):
+    import torch
+
+    if isinstance(value, dict):
+        for k, v in value.items():
+            _flatten(f"{prefix}.{k}", v, out)
+    elif isinstance(value, (list, tuple)):
+        for i, v in enumerate(value):
+            _flatten(f"{prefix}.{i}", v, out)
+    else:
+        out[prefix] = value.numpy() if isinstance(value, torch.Tensor) else np.asarray(value)
+
+
+@pytest.mark.parametrize("trial", range(6))
+def test_compute_group_formation_matches_reference(trial):
+    ref_tm = require_oracle()
+    import torch
+
+    from torchmetrics.classification import __dict__ as _refns  # noqa: F401
+
+    R = __import__("torchmetrics").classification
+    rng = seed_all(4200 + trial)
+    names = sorted(rng.choice(sorted(POOL), size=int(rng.integers(8, 16)), replace=False).tolist())
+
+    ours = MetricCollection({n: POOL[n][0]() for n in names})
+    theirs = ref_tm.MetricCollection({n: POOL[n][1](R) for n in names})
+
+    for _ in range(3):
+        logits = rng.normal(size=(N, C)).astype(np.float32)
+        probs = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+        target = rng.integers(0, C, N).astype(np.int64)
+        ours.update(jnp.asarray(probs), jnp.asarray(target.astype(np.int32)))
+        theirs.update(torch.from_numpy(probs), torch.from_numpy(target))
+
+    # 1) group partition: ours must be a COARSENING of the reference's — every
+    # group the reference merges, we merge too (never split a shareable state),
+    # and we may merge strictly more. Known refinement: the reference's
+    # average="micro" stat-scores metrics keep scalar states (can't share with
+    # macro's per-class vectors); ours keep per-class states for micro too and
+    # reduce at compute, so micro joins the stat-scores group — one fewer state
+    # to update, values identical (asserted below).
+    ours_part = _partition(ours.compute_groups, names)
+    ref_part = _partition(theirs.compute_groups, names)
+    for ref_group in ref_part:
+        assert any(ref_group <= our_group for our_group in ours_part), (
+            f"reference merges {sorted(ref_group)} but ours splits it:\n"
+            f"ours {sorted(map(sorted, ours_part))}\nref  {sorted(map(sorted, ref_part))}"
+        )
+    assert len(ours_part) <= len(ref_part)
+
+    # 2) update economy: members alias their leader's state dict — one state per
+    # group, not one per metric (reference collections.py:338-356)
+    distinct_states = {id(ours[name]._state) for name in names}
+    assert len(distinct_states) == len(ours.compute_groups), (
+        f"{len(distinct_states)} distinct state dicts for {len(ours.compute_groups)} groups"
+    )
+
+    # 3) every value matches the reference
+    got, want = {}, {}
+    for key, val in ours.compute().items():
+        _flatten(key, val, got)
+    for key, val in theirs.compute().items():
+        _flatten(key, val, want)
+    assert got.keys() == want.keys()
+    for key in want:
+        np.testing.assert_allclose(got[key], want[key], atol=1e-6, err_msg=f"trial {trial}: {key}")
+
+    # 4) compute() must not have corrupted shared state: a fourth update and
+    # recompute still agrees (state-copy semantics, reference collections.py:250)
+    logits = rng.normal(size=(N, C)).astype(np.float32)
+    probs = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+    target = rng.integers(0, C, N).astype(np.int64)
+    ours.update(jnp.asarray(probs), jnp.asarray(target.astype(np.int32)))
+    theirs.update(torch.from_numpy(probs), torch.from_numpy(target))
+    got2, want2 = {}, {}
+    for key, val in ours.compute().items():
+        _flatten(key, val, got2)
+    for key, val in theirs.compute().items():
+        _flatten(key, val, want2)
+    for key in want2:
+        np.testing.assert_allclose(got2[key], want2[key], atol=1e-6, err_msg=f"trial {trial} post-compute: {key}")

@@ -166,13 +166,18 @@ def test_antialias_upscale_equals_plain_bilinear():
 def test_extractor_antialias_false_uses_tf1():
     """Wiring check (round-3 VERDICT weak #1: this branch silently used a third
     semantics): the extractor's antialias=False path must BE the TF1 kernel."""
-    from torchmetrics_tpu.image._extractors import InceptionV3Features
+    import jax.numpy as jnp
+
+    from torchmetrics_tpu.image._extractors import InceptionV3Features, _inception_forward
 
     rng = np.random.default_rng(5)
     imgs = _rand_imgs(rng, 64, 64)
     for antialias, kernel in ((False, resize_bilinear_tf1), (True, resize_bilinear_antialias)):
         extractor = InceptionV3Features(seed=0, resize_antialias=antialias)
         got = np.asarray(extractor(imgs))
-        # float input is scaled to the extractor's 0-255 working range before resize
-        expected = np.asarray(extractor._apply(extractor.params, kernel(imgs * 255.0, (299, 299))))
+        # float input is scaled to the extractor's 0-255 working range before
+        # resize; applying the bare trunk to an independently-resized copy must
+        # reproduce the extractor's fused preprocess+trunk exactly
+        resized = kernel(jnp.asarray(imgs) * 255.0, (299, 299)).astype(extractor.compute_dtype)
+        expected = np.asarray(_inception_forward(extractor.params, resized))
         np.testing.assert_allclose(got, expected, atol=1e-5, rtol=1e-5)

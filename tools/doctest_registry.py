@@ -189,11 +189,15 @@ for name, ctor in [
     ("KendallRankCorrCoef", ""),
     ("ConcordanceCorrCoef", ""),
     ("RelativeSquaredError", ""),
-    ("TweedieDevianceScore", "power=1.5"),
     ("MinkowskiDistance", "p=3"),
 ]:
     REGISTRY[(REG, name)] = _reg(name, ctor)
 
+REGISTRY[(REG, "TweedieDevianceScore")] = _reg(
+    "TweedieDevianceScore", "power=1.5",
+    preds="preds = jnp.asarray([2.5, 0.5, 2.0, 8.0])",
+    target="target = jnp.asarray([3.0, 0.5, 2.0, 7.0])",
+)
 REGISTRY[(REG, "MeanSquaredLogError")] = _reg(
     "MeanSquaredLogError", "",
     preds="preds = jnp.asarray([2.5, 1.0, 2.0, 8.0])",
@@ -594,7 +598,7 @@ REGISTRY[(IMG, "PeakSignalNoiseRatio")] = [
     "from torchmetrics_tpu.image import PeakSignalNoiseRatio",
     "preds = jnp.asarray([[0.0, 1.0], [2.0, 3.0]])",
     "target = jnp.asarray([[3.0, 2.0], [1.0, 0.0]])",
-    "metric = PeakSignalNoiseRatio()",
+    "metric = PeakSignalNoiseRatio(data_range=3.0)",
     "metric.update(preds, target)",
     "metric.compute()",
 ]
@@ -683,7 +687,7 @@ REGISTRY[(IMG, "PeakSignalNoiseRatioWithBlockedEffect")] = [
     "from torchmetrics_tpu.image import PeakSignalNoiseRatioWithBlockedEffect",
     "preds = (jnp.arange(256, dtype=jnp.float32).reshape(1, 1, 16, 16) * 37 % 97) / 97",
     "target = (jnp.arange(256, dtype=jnp.float32).reshape(1, 1, 16, 16) * 31 % 89) / 89",
-    "metric = PeakSignalNoiseRatioWithBlockedEffect(block_size=8)",
+    "metric = PeakSignalNoiseRatioWithBlockedEffect(data_range=1.0, block_size=8)",
     "metric.update(preds, target)",
     "metric.compute()",
 ]
@@ -838,8 +842,8 @@ REGISTRY[(SHP, "ProcrustesDisparity")] = [
 REGISTRY[(MMD, "LipVertexError")] = [
     J,
     "from torchmetrics_tpu.multimodal import LipVertexError",
-    "vertices_pred = (jnp.arange(90, dtype=jnp.float32).reshape(1, 5, 6, 3) * 37 % 19) / 19",
-    "vertices_gt = (jnp.arange(90, dtype=jnp.float32).reshape(1, 5, 6, 3) * 31 % 17) / 17",
+    "vertices_pred = (jnp.arange(90, dtype=jnp.float32).reshape(5, 6, 3) * 37 % 19) / 19",
+    "vertices_gt = (jnp.arange(90, dtype=jnp.float32).reshape(5, 6, 3) * 31 % 17) / 17",
     "metric = LipVertexError(mouth_map=[1, 2, 3])",
     "metric.update(vertices_pred, vertices_gt)",
     "metric.compute()",

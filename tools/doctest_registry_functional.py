@@ -1,0 +1,215 @@
+"""Functional-API example snippets for `tools/gen_doctests.py` (round 5).
+
+Same contract as tools/doctest_registry.py, targeting the functional entry
+points (the reference carries `Example::` blocks on these too)."""
+
+FM = "torchmetrics_tpu.functional"
+J = "import jax.numpy as jnp"
+
+BIN_P = "preds = jnp.asarray([0.11, 0.22, 0.84, 0.73, 0.33, 0.92])"
+BIN_T = "target = jnp.asarray([0, 0, 1, 1, 0, 1])"
+MC_P = ("preds = jnp.asarray([[0.75, 0.05, 0.20], [0.10, 0.80, 0.10],"
+        " [0.20, 0.30, 0.50], [0.25, 0.40, 0.35]])")
+MC_T = "target = jnp.asarray([0, 1, 2, 1])"
+ML_P = "preds = jnp.asarray([[0.75, 0.05, 0.35], [0.45, 0.75, 0.05], [0.05, 0.65, 0.75]])"
+ML_T = "target = jnp.asarray([[1, 0, 1], [0, 0, 0], [0, 1, 1]])"
+REG_P = "preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])"
+REG_T = "target = jnp.asarray([3.0, -0.5, 2.0, 7.0])"
+
+REGISTRY_F = {}
+
+
+def _fn(name, call, setup):
+    REGISTRY_F[(FM, name)] = [J, f"from {FM} import {name}", *setup, call]
+
+
+# classification: one call per family member
+for task, (P, T), ctor in [
+    ("binary", (BIN_P, BIN_T), ""),
+    ("multiclass", (MC_P, MC_T), "num_classes=3"),
+    ("multilabel", (ML_P, ML_T), "num_labels=3"),
+]:
+    sep = ", " if ctor else ""
+    for stem in ["accuracy", "precision", "recall", "f1_score", "specificity",
+                 "stat_scores", "confusion_matrix", "auroc", "average_precision",
+                 "hamming_distance", "jaccard_index", "matthews_corrcoef",
+                 "negative_predictive_value", "eer", "logauc"]:
+        _fn(f"{task}_{stem}", f"{task}_{stem}(preds, target{sep}{ctor})", [P, T])
+    _fn(f"{task}_fbeta_score", f"{task}_fbeta_score(preds, target, beta=2.0{sep}{ctor})", [P, T])
+    _fn(f"{task}_roc", f"{task}_roc(preds, target{sep}{ctor}, thresholds=5)", [P, T])
+    _fn(f"{task}_precision_recall_curve",
+        f"{task}_precision_recall_curve(preds, target{sep}{ctor}, thresholds=5)", [P, T])
+    _fn(f"{task}_recall_at_fixed_precision",
+        f"{task}_recall_at_fixed_precision(preds, target{sep}{ctor}, min_precision=0.5)", [P, T])
+
+_fn("binary_cohen_kappa", "binary_cohen_kappa(preds, target)", [BIN_P, BIN_T])
+_fn("multiclass_cohen_kappa", "multiclass_cohen_kappa(preds, target, num_classes=3)", [MC_P, MC_T])
+_fn("binary_calibration_error", "binary_calibration_error(preds, target, n_bins=3)", [BIN_P, BIN_T])
+_fn("multiclass_calibration_error", "multiclass_calibration_error(preds, target, num_classes=3, n_bins=3)", [MC_P, MC_T])
+_fn("binary_hinge_loss", "binary_hinge_loss(preds, target)", [BIN_P, BIN_T])
+_fn("multiclass_hinge_loss", "multiclass_hinge_loss(preds, target, num_classes=3)", [MC_P, MC_T])
+_fn("multiclass_exact_match", "multiclass_exact_match(preds, target, num_classes=3)",
+    ["preds = jnp.asarray([[0, 1, 2], [1, 1, 2]])", "target = jnp.asarray([[0, 1, 2], [2, 1, 2]])"])
+_fn("multilabel_exact_match", "multilabel_exact_match(preds, target, num_labels=3)", [ML_P, ML_T])
+_fn("multilabel_ranking_average_precision",
+    "multilabel_ranking_average_precision(preds, target, num_labels=3)", [ML_P, ML_T])
+_fn("multilabel_ranking_loss", "multilabel_ranking_loss(preds, target, num_labels=3)", [ML_P, ML_T])
+_fn("multilabel_coverage_error", "multilabel_coverage_error(preds, target, num_labels=3)", [ML_P, ML_T])
+_fn("accuracy", "accuracy(preds, target, task='multiclass', num_classes=3)", [MC_P, MC_T])
+_fn("f1_score", "f1_score(preds, target, task='multiclass', num_classes=3)", [MC_P, MC_T])
+_fn("auroc", "auroc(preds, target, task='binary')", [BIN_P, BIN_T])
+
+# regression
+for name, call in [
+    ("mean_squared_error", "mean_squared_error(preds, target)"),
+    ("mean_absolute_error", "mean_absolute_error(preds, target)"),
+    ("mean_absolute_percentage_error", "mean_absolute_percentage_error(preds, target)"),
+    ("symmetric_mean_absolute_percentage_error", "symmetric_mean_absolute_percentage_error(preds, target)"),
+    ("weighted_mean_absolute_percentage_error", "weighted_mean_absolute_percentage_error(preds, target)"),
+    ("normalized_root_mean_squared_error", "normalized_root_mean_squared_error(preds, target)"),
+    ("log_cosh_error", "log_cosh_error(preds, target)"),
+    ("explained_variance", "explained_variance(preds, target)"),
+    ("r2_score", "r2_score(preds, target)"),
+    ("pearson_corrcoef", "pearson_corrcoef(preds, target)"),
+    ("spearman_corrcoef", "spearman_corrcoef(preds, target)"),
+    ("kendall_rank_corrcoef", "kendall_rank_corrcoef(preds, target)"),
+    ("concordance_corrcoef", "concordance_corrcoef(preds, target)"),
+    ("relative_squared_error", "relative_squared_error(preds, target)"),
+    ("minkowski_distance", "minkowski_distance(preds, target, p=3)"),
+]:
+    _fn(name, call, [REG_P, REG_T])
+_fn("tweedie_deviance_score", "tweedie_deviance_score(preds, target, power=1.5)",
+    ["preds = jnp.asarray([2.5, 0.5, 2.0, 8.0])", "target = jnp.asarray([3.0, 0.5, 2.0, 7.0])"])
+_fn("mean_squared_log_error", "mean_squared_log_error(preds, target)",
+    ["preds = jnp.asarray([2.5, 1.0, 2.0, 8.0])", "target = jnp.asarray([3.0, 1.5, 2.0, 7.0])"])
+_fn("cosine_similarity", "cosine_similarity(preds, target, reduction='mean')",
+    ["preds = jnp.asarray([[1.0, 2.0, 3.0], [1.0, 0.0, 1.0]])",
+     "target = jnp.asarray([[1.0, 2.0, 2.0], [0.5, 0.0, 1.0]])"])
+_fn("kl_divergence", "kl_divergence(p, q)",
+    ["p = jnp.asarray([[0.36, 0.48, 0.16]])", "q = jnp.asarray([[1/3, 1/3, 1/3]])"])
+_fn("jensen_shannon_divergence", "jensen_shannon_divergence(p, q)",
+    ["p = jnp.asarray([[0.36, 0.48, 0.16]])", "q = jnp.asarray([[1/3, 1/3, 1/3]])"])
+_fn("critical_success_index", "critical_success_index(preds, target, 0.5)",
+    ["preds = jnp.asarray([0.2, 0.7, 0.9, 0.4])", "target = jnp.asarray([0.1, 0.8, 0.6, 0.7])"])
+_fn("continuous_ranked_probability_score", "continuous_ranked_probability_score(preds, target)",
+    ["preds = jnp.asarray([[1.0, 2.0, 3.0], [2.0, 3.0, 4.0]])", "target = jnp.asarray([2.0, 3.0])"])
+
+# pairwise
+PAIR = ["x = jnp.asarray([[2.0, 3.0], [3.0, 5.0]])", "y = jnp.asarray([[1.0, 0.0], [2.0, 1.0]])"]
+for name, call in [
+    ("pairwise_cosine_similarity", "pairwise_cosine_similarity(x, y)"),
+    ("pairwise_euclidean_distance", "pairwise_euclidean_distance(x, y)"),
+    ("pairwise_manhattan_distance", "pairwise_manhattan_distance(x, y)"),
+    ("pairwise_linear_similarity", "pairwise_linear_similarity(x, y)"),
+    ("pairwise_minkowski_distance", "pairwise_minkowski_distance(x, y, exponent=4)"),
+]:
+    _fn(name, call, PAIR)
+
+# text
+TXT2 = ["preds = ['this is the prediction']", "target = ['this is the reference']"]
+TXTN = ["preds = ['the cat is on the mat']", "target = [['there is a cat on the mat', 'a cat is on the mat']]"]
+_fn("bleu_score", "bleu_score(preds, target)", TXTN)
+_fn("sacre_bleu_score", "sacre_bleu_score(preds, target)", TXTN)
+_fn("chrf_score", "chrf_score(preds, target)", TXTN)
+_fn("translation_edit_rate", "translation_edit_rate(preds, target)", TXTN)
+_fn("char_error_rate", "char_error_rate(preds, target)", TXT2)
+_fn("word_error_rate", "word_error_rate(preds, target)", TXT2)
+_fn("match_error_rate", "match_error_rate(preds, target)", TXT2)
+_fn("word_information_lost", "word_information_lost(preds, target)", TXT2)
+_fn("word_information_preserved", "word_information_preserved(preds, target)", TXT2)
+_fn("edit_distance", "edit_distance(['rain'], ['shine'])", [])
+_fn("extended_edit_distance", "extended_edit_distance(preds, [['this is the reference']])", [TXT2[0]])
+_fn("rouge_score", "{k: round(float(v), 4) for k, v in rouge_score(['the cat is on the mat'], [['a cat is on the mat']], rouge_keys='rouge1').items()}", [])
+_fn("squad", "{k: round(float(v), 4) for k, v in squad(preds, target).items()}",
+    ["preds = [{'prediction_text': '1976', 'id': '56e1'}]",
+     "target = [{'answers': {'answer_start': [97], 'text': ['1976']}, 'id': '56e1'}]"])
+_fn("perplexity", "perplexity(jnp.log(preds), target)",
+    ["preds = jnp.asarray([[[0.2, 0.4, 0.4], [0.5, 0.2, 0.3]]])", "target = jnp.asarray([[1, 0]])"])
+
+# audio
+AUD = ["preds = jnp.asarray([2.8, -1.2, 0.06, 1.3])", "target = jnp.asarray([3.0, -0.5, 0.1, 1.0])"]
+_fn("signal_noise_ratio", "signal_noise_ratio(preds, target)", AUD)
+_fn("scale_invariant_signal_noise_ratio", "scale_invariant_signal_noise_ratio(preds, target)", AUD)
+_fn("scale_invariant_signal_distortion_ratio", "scale_invariant_signal_distortion_ratio(preds, target)", AUD)
+_fn("signal_distortion_ratio", "signal_distortion_ratio(preds, target, filter_length=16)",
+    ["preds = jnp.sin(jnp.arange(800, dtype=jnp.float32) / 20)",
+     "target = jnp.sin(jnp.arange(800, dtype=jnp.float32) / 20 + 0.1)"])
+_fn("source_aggregated_signal_distortion_ratio", "source_aggregated_signal_distortion_ratio(preds, target)",
+    ["preds = jnp.stack([jnp.sin(jnp.arange(100.0) / 9), jnp.cos(jnp.arange(100.0) / 7)])[None]",
+     "target = jnp.stack([jnp.sin(jnp.arange(100.0) / 10), jnp.cos(jnp.arange(100.0) / 8)])[None]"])
+_fn("permutation_invariant_training",
+    "[round(float(x), 4) for x in permutation_invariant_training(preds, target, scale_invariant_signal_noise_ratio, eval_func='max')[0]]",
+    ["from torchmetrics_tpu.functional import scale_invariant_signal_noise_ratio",
+     "preds = jnp.stack([jnp.sin(jnp.arange(100.0) / 9), jnp.cos(jnp.arange(100.0) / 7)])[None]",
+     "target = jnp.stack([jnp.cos(jnp.arange(100.0) / 8), jnp.sin(jnp.arange(100.0) / 10)])[None]"])
+
+# clustering / nominal
+CLU = ["preds = jnp.asarray([2, 1, 0, 1, 0])", "target = jnp.asarray([0, 2, 1, 1, 0])"]
+for name in ["mutual_info_score", "normalized_mutual_info_score", "adjusted_mutual_info_score",
+             "rand_score", "adjusted_rand_score", "fowlkes_mallows_index",
+             "homogeneity_score", "completeness_score", "v_measure_score"]:
+    _fn(name, f"{name}(preds, target)", CLU)
+_fn("cluster_accuracy", "cluster_accuracy(preds, target, num_classes=3)", CLU)
+INTR = ["data = jnp.asarray([[0.0, 0.0], [0.5, 0.0], [10.0, 10.0], [10.5, 10.0], [20.0, 0.0], [20.5, 0.0]])",
+        "labels = jnp.asarray([0, 0, 1, 1, 2, 2])"]
+for name in ["calinski_harabasz_score", "davies_bouldin_score", "dunn_index"]:
+    _fn(name, f"{name}(data, labels)", INTR)
+NOM = ["preds = jnp.asarray([0, 1, 2, 2, 1, 0, 1, 2, 1, 0])", "target = jnp.asarray([0, 1, 2, 1, 1, 0, 2, 2, 1, 0])"]
+for name in ["cramers_v", "pearsons_contingency_coefficient", "theils_u", "tschuprows_t"]:
+    _fn(name, f"{name}(preds, target)", NOM)
+_fn("fleiss_kappa", "fleiss_kappa(ratings, mode='counts')",
+    ["ratings = jnp.asarray([[0, 4, 1], [2, 2, 1], [4, 0, 1], [1, 3, 1]])"])
+
+# segmentation
+SEG = ["preds = jnp.asarray([[[0, 1, 1, 0], [1, 1, 0, 0], [2, 2, 1, 0], [2, 0, 0, 0]]])",
+       "target = jnp.asarray([[[0, 1, 1, 0], [1, 0, 0, 0], [2, 2, 0, 0], [2, 2, 0, 0]]])"]
+_fn("dice_score", "dice_score(preds, target, num_classes=3, input_format='index')", SEG)
+_fn("generalized_dice_score", "generalized_dice_score(preds, target, num_classes=3, input_format='index')", SEG)
+_fn("mean_iou", "mean_iou(preds, target, num_classes=3, input_format='index')", SEG)
+_fn("hausdorff_distance", "hausdorff_distance(preds, target, num_classes=3, input_format='index')", SEG)
+
+# detection (functional box-tensor forms)
+BOX = ["preds = jnp.asarray([[296.55, 93.96, 314.97, 152.79], [328.94, 97.05, 342.49, 122.98]])",
+       "target = jnp.asarray([[300.00, 100.00, 315.00, 150.00], [330.00, 100.00, 350.00, 125.00]])"]
+for name in ["intersection_over_union", "generalized_intersection_over_union",
+             "distance_intersection_over_union", "complete_intersection_over_union"]:
+    _fn(name, f"{name}(preds, target)", BOX)
+
+# image
+IMG16 = ["preds = (jnp.arange(768, dtype=jnp.float32).reshape(1, 3, 16, 16) * 37 % 97) / 97",
+         "target = (jnp.arange(768, dtype=jnp.float32).reshape(1, 3, 16, 16) * 31 % 89) / 89"]
+_fn("peak_signal_noise_ratio", "peak_signal_noise_ratio(preds, target)",
+    ["preds = jnp.asarray([[0.0, 1.0], [2.0, 3.0]])", "target = jnp.asarray([[3.0, 2.0], [1.0, 0.0]])"])
+_fn("structural_similarity_index_measure", "structural_similarity_index_measure(preds, target, data_range=1.0)", IMG16)
+_fn("universal_image_quality_index", "universal_image_quality_index(preds, target)", IMG16)
+_fn("total_variation", "total_variation(preds)", [IMG16[0]])
+_fn("spectral_angle_mapper", "spectral_angle_mapper(preds, target)", IMG16)
+_fn("error_relative_global_dimensionless_synthesis",
+    "error_relative_global_dimensionless_synthesis(preds, target)", IMG16)
+_fn("relative_average_spectral_error", "relative_average_spectral_error(preds, target)", IMG16)
+_fn("root_mean_squared_error_using_sliding_window",
+    "root_mean_squared_error_using_sliding_window(preds, target)", IMG16)
+_fn("spatial_correlation_coefficient", "spatial_correlation_coefficient(preds, target)", IMG16)
+_fn("image_gradients", "[g.shape for g in image_gradients(preds)]", [IMG16[0]])
+
+# retrieval (single query per call in the functional form)
+RETR = ["preds = jnp.asarray([0.2, 0.3, 0.5, 0.1])", "target = jnp.asarray([False, True, True, False])"]
+for name, call in [
+    ("retrieval_average_precision", "retrieval_average_precision(preds, target)"),
+    ("retrieval_reciprocal_rank", "retrieval_reciprocal_rank(preds, target)"),
+    ("retrieval_precision", "retrieval_precision(preds, target, top_k=2)"),
+    ("retrieval_recall", "retrieval_recall(preds, target, top_k=2)"),
+    ("retrieval_hit_rate", "retrieval_hit_rate(preds, target, top_k=2)"),
+    ("retrieval_fall_out", "retrieval_fall_out(preds, target, top_k=2)"),
+    ("retrieval_normalized_dcg", "retrieval_normalized_dcg(preds, target)"),
+    ("retrieval_r_precision", "retrieval_r_precision(preds, target)"),
+]:
+    _fn(name, call, RETR)
+
+# shape / multimodal
+_fn("procrustes_disparity", "procrustes_disparity(point_set1, point_set2)",
+    ["point_set1 = jnp.asarray([[[0.0, 0.0], [1.0, 0.0], [1.0, 1.0], [0.0, 1.0]]])",
+     "point_set2 = jnp.asarray([[[0.0, 0.0], [2.0, 0.0], [2.0, 2.0], [0.0, 2.0]]])"])
+_fn("lip_vertex_error", "lip_vertex_error(vertices_pred, vertices_gt, mouth_map=[1, 2, 3])",
+    ["vertices_pred = (jnp.arange(90, dtype=jnp.float32).reshape(5, 6, 3) * 37 % 19) / 19",
+     "vertices_gt = (jnp.arange(90, dtype=jnp.float32).reshape(5, 6, 3) * 31 % 17) / 17"])

@@ -1,0 +1,155 @@
+"""Round-5 experiment (VERDICT r4 #1b): can the profiled worst-case separable
+convs — inception C-block 1x7/7x1 at 17x17 spatial, 26-74 TF/s under XLA's conv
+lowering — go faster as (a) an XLA im2col matmul rewrite, (b) a Pallas kernel?
+
+Timing is iteration-chained (tunnel rule); numerics are checked against the
+lax.conv baseline in f32. Run on the real chip: python tools/exp_sepconv.py
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+B, H, W = 512, 17, 17
+DTYPE = jnp.bfloat16
+
+
+def conv_baseline(x, w, kind):
+    # x (B, C, H, W), w (O, C, kh, kw) — exactly the trunk's lowering
+    return lax.conv_general_dilated(
+        x, w, (1, 1), "SAME", dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        precision=lax.Precision.DEFAULT,
+    )
+
+
+def im2col_matmul_w(x, w, kind):
+    """1x7 conv as one flat matmul: (B*H*W, C*7) @ (C*7, O), NHWC internally.
+
+    The 7 shifted W-slices are gathered from a W-padded copy; XLA fuses the
+    slices+concat into the matmul's operand stream. No conv op anywhere."""
+    o, c = w.shape[0], w.shape[1]
+    xh = jnp.transpose(x, (0, 2, 3, 1))  # (B, H, W, C)
+    xp = jnp.pad(xh, ((0, 0), (0, 0), (3, 3), (0, 0)))
+    cols = jnp.concatenate([xp[:, :, k:k + W, :] for k in range(7)], axis=-1)  # (B,H,W,7C)
+    flat = cols.reshape(B * H * W, 7 * c)
+    wm = jnp.transpose(w.reshape(o, c, 7), (2, 1, 0)).reshape(7 * c, o)  # k-major rows
+    out = jnp.dot(flat, wm.astype(x.dtype), preferred_element_type=jnp.float32)
+    return jnp.transpose(out.reshape(B, H, W, o).astype(x.dtype), (0, 3, 1, 2))
+
+
+def im2col_matmul_h(x, w, kind):
+    """7x1 conv: transpose H<->W then reuse the 1x7 path."""
+    xt = jnp.transpose(x, (0, 1, 3, 2))
+    wt = jnp.transpose(w, (0, 1, 3, 2))
+    return jnp.transpose(im2col_matmul_w(xt, wt, kind), (0, 1, 3, 2))
+
+
+def make_pallas_sepconv(c, o, r_blk=16, pad_c=None):
+    """Pallas kernel: rows x (Wpad, C) blocks; 7 shifted in-VMEM sublane slices
+    accumulate into one (rows*Wpad, O) matmul each. Layout choices per the TPU
+    tiling rules: Wpad=24 (8-aligned sublanes), lanes=C; the (R, 24, C) ->
+    (R*24, C) merge keeps the minor dim intact so Mosaic accepts the shapecast.
+    pad_c pads channels to a 128-multiple so the contraction is tile-exact."""
+    from jax.experimental import pallas as pl
+
+    WPAD = 24  # 3 left pad + 17 + 4 right: every valid shift stays in-row
+    R_BLK = r_blk  # rows (b, h) per grid step -> M = R_BLK*24 matmul rows
+    c_in = c if pad_c is None else pad_c
+
+    def kernel(x_ref, w_ref, out_ref):
+        acc = jnp.zeros((R_BLK * WPAD, o), jnp.float32)
+        for k in range(7):
+            xs = x_ref[:, k:k + 17, :]  # (R_BLK, 17, C) sublane-offset slice
+            xs = jnp.pad(xs, ((0, 0), (3, WPAD - 17 - 3), (0, 0)))  # row j <-> out x = j-3
+            acc += jnp.dot(
+                xs.reshape(R_BLK * WPAD, c_in), w_ref[k], preferred_element_type=jnp.float32
+            )
+        out_ref[:] = acc.reshape(R_BLK, WPAD, o).astype(out_ref.dtype)
+
+    rows = B * H
+
+    @jax.jit
+    def run(x, w):
+        # (B, C, H, W) -> (B*H, Wpad, C), channels optionally zero-padded to c_in
+        xh = jnp.transpose(x, (0, 2, 3, 1)).reshape(rows, W, c)
+        xp = jnp.pad(xh, ((0, 0), (3, 4), (0, c_in - c)))
+        wm = jnp.transpose(w.reshape(o, c, 7), (2, 1, 0)).astype(x.dtype)  # (7, C, O)
+        wm = jnp.pad(wm, ((0, 0), (0, c_in - c), (0, 0)))
+        out = pl.pallas_call(
+            kernel,
+            grid=(rows // R_BLK,),
+            in_specs=[
+                pl.BlockSpec((R_BLK, WPAD, c_in), lambda i: (i, 0, 0)),
+                pl.BlockSpec((7, c_in, o), lambda i: (0, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((R_BLK, WPAD, o), lambda i: (i, 0, 0)),
+            out_shape=jax.ShapeDtypeStruct((rows, WPAD, o), x.dtype),
+        )(xp, wm)
+        out = out[:, 3:3 + W, :]  # valid W range
+        return jnp.transpose(out.reshape(B, H, W, o), (0, 3, 1, 2))
+
+    return run
+
+
+def timed(fn, x, w, iters=30):
+    f = jax.jit(fn)
+    out = f(x, w)
+    jax.block_until_ready(out)
+
+    @jax.jit
+    def chained(x):
+        y = f(x, w)
+        return x + (y.mean() * 0).astype(x.dtype)
+
+    x2 = chained(x)
+    jax.block_until_ready(x2)
+    start = time.perf_counter()
+    for _ in range(iters):
+        x2 = chained(x2)
+    jax.block_until_ready(x2)
+    sec = (time.perf_counter() - start) / iters
+    return out, sec
+
+
+def main():
+    results = {}
+    rng = np.random.default_rng(0)
+    for kind, c, o in (("1x7", 160, 160), ("7x1", 160, 192)):
+        kh, kw = (7, 1) if kind == "7x1" else (1, 7)
+        x = jnp.asarray(rng.normal(size=(B, c, H, W)).astype(np.float32)).astype(DTYPE)
+        w = jnp.asarray((rng.normal(size=(o, c, kh, kw)) / np.sqrt(c * 7)).astype(np.float32)).astype(DTYPE)
+        gflop = 2 * B * H * W * 7 * c * o / 1e9
+
+        ref, base_s = timed(functools.partial(conv_baseline, kind=kind), x, w)
+        results[f"{kind}_conv_baseline"] = {"ms": round(base_s * 1e3, 3), "tflops": round(gflop / base_s / 1e3, 1)}
+
+        im2col = im2col_matmul_h if kind == "7x1" else im2col_matmul_w
+        try:
+            out, s = timed(functools.partial(im2col, kind=kind), x, w)
+            err = float(jnp.max(jnp.abs(out.astype(jnp.float32) - ref.astype(jnp.float32))))
+            results[f"{kind}_im2col_xla"] = {"ms": round(s * 1e3, 3), "tflops": round(gflop / s / 1e3, 1), "max_abs_err": err}
+        except Exception as e:
+            results[f"{kind}_im2col_xla"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+
+        if kind == "1x7" and "--no-pallas" not in sys.argv:
+            for tag, r_blk, pad_c in (("r16", 16, None), ("r64", 64, None), ("r64_c256", 64, 256)):
+                try:
+                    run = make_pallas_sepconv(c, o, r_blk=r_blk, pad_c=pad_c)
+                    out, s = timed(lambda x, w: run(x, w), x, w, iters=10)
+                    err = float(jnp.max(jnp.abs(out.astype(jnp.float32) - ref.astype(jnp.float32))))
+                    results[f"{kind}_pallas_{tag}"] = {"ms": round(s * 1e3, 3), "tflops": round(gflop / s / 1e3, 1), "max_abs_err": err}
+                except Exception as e:
+                    results[f"{kind}_pallas_{tag}"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        print(json.dumps(results), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -74,7 +74,17 @@ class BaseAggregator(Metric):
 
 
 class MaxMetric(BaseAggregator):
-    """Running max (reference aggregation.py:118)."""
+    """Running max (reference aggregation.py:118).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.aggregation import MaxMetric
+        >>> metric = MaxMetric()
+        >>> metric.update(1.0)
+        >>> metric.update(jnp.asarray([2.0, 3.0]))
+        >>> metric.compute()
+        Array(3., dtype=float32)
+    """
 
     full_state_update = True
     higher_is_better = True
@@ -92,7 +102,17 @@ class MaxMetric(BaseAggregator):
 
 
 class MinMetric(BaseAggregator):
-    """Running min (reference aggregation.py:224)."""
+    """Running min (reference aggregation.py:224).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.aggregation import MinMetric
+        >>> metric = MinMetric()
+        >>> metric.update(1.0)
+        >>> metric.update(jnp.asarray([2.0, 3.0]))
+        >>> metric.compute()
+        Array(1., dtype=float32)
+    """
 
     full_state_update = True
     higher_is_better = False
@@ -110,7 +130,17 @@ class MinMetric(BaseAggregator):
 
 
 class SumMetric(BaseAggregator):
-    """Running sum (reference aggregation.py:330)."""
+    """Running sum (reference aggregation.py:330).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.aggregation import SumMetric
+        >>> metric = SumMetric()
+        >>> metric.update(1.0)
+        >>> metric.update(jnp.asarray([2.0, 3.0]))
+        >>> metric.compute()
+        Array(6., dtype=float32)
+    """
 
     def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
         super().__init__("sum", np.zeros((), np.float32), nan_strategy, state_name="sum_value", **kwargs)
@@ -125,7 +155,17 @@ class SumMetric(BaseAggregator):
 
 
 class CatMetric(BaseAggregator):
-    """Concatenate all seen values (reference aggregation.py:436)."""
+    """Concatenate all seen values (reference aggregation.py:436).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.aggregation import CatMetric
+        >>> metric = CatMetric()
+        >>> metric.update(1.0)
+        >>> metric.update(jnp.asarray([2.0, 3.0]))
+        >>> metric.compute()
+        Array([1., 2., 3.], dtype=float32)
+    """
 
     def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
         super().__init__("cat", [], nan_strategy, **kwargs)
@@ -151,7 +191,17 @@ class CatMetric(BaseAggregator):
 
 
 class MeanMetric(BaseAggregator):
-    """Weighted running mean — value & weight sum states (reference aggregation.py:501)."""
+    """Weighted running mean — value & weight sum states (reference aggregation.py:501).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.aggregation import MeanMetric
+        >>> metric = MeanMetric()
+        >>> metric.update(1.0)
+        >>> metric.update(jnp.asarray([2.0, 3.0]))
+        >>> metric.compute()
+        Array(2., dtype=float32)
+    """
 
     def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
         super().__init__("sum", np.zeros((), np.float32), nan_strategy, state_name="mean_value", **kwargs)
@@ -222,7 +272,17 @@ class _RunningBase(BaseAggregator):
 
 
 class RunningMean(_RunningBase):
-    """Mean over the last ``window`` batch-means (reference aggregation.py:628)."""
+    """Mean over the last ``window`` batch-means (reference aggregation.py:628).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.aggregation import RunningMean
+        >>> metric = RunningMean(window=3)
+        >>> for batch in [1.0, 2.0, 3.0, 4.0, 5.0]:
+        ...     metric.update(batch)
+        >>> metric.compute()
+        Array(4., dtype=float32)
+    """
 
     def _agg(self, value):
         return jnp.mean(value)
@@ -235,7 +295,17 @@ class RunningMean(_RunningBase):
 
 
 class RunningSum(_RunningBase):
-    """Sum over the last ``window`` batch-sums (reference aggregation.py:685)."""
+    """Sum over the last ``window`` batch-sums (reference aggregation.py:685).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.aggregation import RunningSum
+        >>> metric = RunningSum(window=3)
+        >>> for batch in [1.0, 2.0, 3.0, 4.0, 5.0]:
+        ...     metric.update(batch)
+        >>> metric.compute()
+        Array(12., dtype=float32)
+    """
 
     def _agg(self, value):
         return jnp.sum(value)

@@ -57,7 +57,18 @@ class _MeanAudioMetric(Metric):
 
 
 class SignalNoiseRatio(_MeanAudioMetric):
-    """SNR (reference ``audio/snr.py:36``)."""
+    """SNR (reference ``audio/snr.py:36``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.audio import SignalNoiseRatio
+        >>> preds = jnp.asarray([2.8, -1.2, 0.06, 1.3])
+        >>> target = jnp.asarray([3.0, -0.5, 0.1, 1.0])
+        >>> metric = SignalNoiseRatio()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(12.176363, dtype=float32)
+    """
 
     higher_is_better = True
 
@@ -70,7 +81,18 @@ class SignalNoiseRatio(_MeanAudioMetric):
 
 
 class ScaleInvariantSignalNoiseRatio(_MeanAudioMetric):
-    """SI-SNR (reference ``audio/snr.py:146``)."""
+    """SI-SNR (reference ``audio/snr.py:146``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.audio import ScaleInvariantSignalNoiseRatio
+        >>> preds = jnp.asarray([2.8, -1.2, 0.06, 1.3])
+        >>> target = jnp.asarray([3.0, -0.5, 0.1, 1.0])
+        >>> metric = ScaleInvariantSignalNoiseRatio()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(12.534763, dtype=float32)
+    """
 
     higher_is_better = True
 
@@ -79,7 +101,18 @@ class ScaleInvariantSignalNoiseRatio(_MeanAudioMetric):
 
 
 class ComplexScaleInvariantSignalNoiseRatio(_MeanAudioMetric):
-    """C-SI-SNR (reference ``audio/snr.py:245``)."""
+    """C-SI-SNR (reference ``audio/snr.py:245``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.audio import ComplexScaleInvariantSignalNoiseRatio
+        >>> preds = jnp.stack([jnp.sin(jnp.arange(48.0)).reshape(4, 12), jnp.cos(jnp.arange(48.0)).reshape(4, 12)], axis=-1)[None]
+        >>> target = jnp.stack([jnp.cos(jnp.arange(48.0)).reshape(4, 12), jnp.sin(jnp.arange(48.0)).reshape(4, 12)], axis=-1)[None]
+        >>> metric = ComplexScaleInvariantSignalNoiseRatio()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(-52.575077, dtype=float32)
+    """
 
     higher_is_better = True
 
@@ -94,7 +127,18 @@ class ComplexScaleInvariantSignalNoiseRatio(_MeanAudioMetric):
 
 
 class ScaleInvariantSignalDistortionRatio(_MeanAudioMetric):
-    """SI-SDR (reference ``audio/sdr.py:173``)."""
+    """SI-SDR (reference ``audio/sdr.py:173``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.audio import ScaleInvariantSignalDistortionRatio
+        >>> preds = jnp.asarray([2.8, -1.2, 0.06, 1.3])
+        >>> target = jnp.asarray([3.0, -0.5, 0.1, 1.0])
+        >>> metric = ScaleInvariantSignalDistortionRatio()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(12.216659, dtype=float32)
+    """
 
     higher_is_better = True
 
@@ -107,7 +151,18 @@ class ScaleInvariantSignalDistortionRatio(_MeanAudioMetric):
 
 
 class SourceAggregatedSignalDistortionRatio(_MeanAudioMetric):
-    """SA-SDR (reference ``audio/sdr.py:282``)."""
+    """SA-SDR (reference ``audio/sdr.py:282``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.audio import SourceAggregatedSignalDistortionRatio
+        >>> preds = jnp.stack([jnp.sin(jnp.arange(100.0) / 9), jnp.cos(jnp.arange(100.0) / 7)])[None]
+        >>> target = jnp.stack([jnp.sin(jnp.arange(100.0) / 10), jnp.cos(jnp.arange(100.0) / 8)])[None]
+        >>> metric = SourceAggregatedSignalDistortionRatio()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(-0.42774835, dtype=float32)
+    """
 
     higher_is_better = True
 
@@ -149,7 +204,18 @@ class _HostMeanAudioMetric(HostMetric):
 
 
 class SignalDistortionRatio(_HostMeanAudioMetric):
-    """SDR (reference ``audio/sdr.py:38``) — per-sample Toeplitz solve on host."""
+    """SDR (reference ``audio/sdr.py:38``) — per-sample Toeplitz solve on host.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.audio import SignalDistortionRatio
+        >>> preds = jnp.sin(jnp.arange(800, dtype=jnp.float32) / 20)
+        >>> target = jnp.sin(jnp.arange(800, dtype=jnp.float32) / 20 + 0.1)
+        >>> metric = SignalDistortionRatio()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(32.214718, dtype=float32)
+    """
 
     higher_is_better = True
 
@@ -177,7 +243,19 @@ class PermutationInvariantTraining(_HostMeanAudioMetric):
     """PIT (reference ``audio/pit.py:31``): mean of the best-permutation metric.
 
     Host-side update: the >3-speaker branch solves assignment with scipy, and user
-    ``metric_func`` callables are not guaranteed jittable."""
+    ``metric_func`` callables are not guaranteed jittable.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.audio import PermutationInvariantTraining
+        >>> from torchmetrics_tpu.functional.audio import scale_invariant_signal_noise_ratio
+        >>> preds = jnp.stack([jnp.sin(jnp.arange(100.0) / 9), jnp.cos(jnp.arange(100.0) / 7)])[None]
+        >>> target = jnp.stack([jnp.cos(jnp.arange(100.0) / 8), jnp.sin(jnp.arange(100.0) / 10)])[None]
+        >>> metric = PermutationInvariantTraining(scale_invariant_signal_noise_ratio, eval_func='max')
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(-0.18667197, dtype=float32)
+    """
 
     higher_is_better = True
 

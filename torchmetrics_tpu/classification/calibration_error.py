@@ -43,6 +43,18 @@ class _CalibrationBase(Metric):
 
 
 class BinaryCalibrationError(_CalibrationBase):
+    """Binary calibration error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import BinaryCalibrationError
+        >>> preds = jnp.asarray([0.11, 0.22, 0.84, 0.73, 0.33, 0.92])
+        >>> target = jnp.asarray([0, 0, 1, 1, 0, 1])
+        >>> metric = BinaryCalibrationError(n_bins=3)
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.195, dtype=float32)
+    """
     def __init__(
         self, n_bins: int = 15, norm: str = "l1", ignore_index: Optional[int] = None,
         validate_args: bool = True, **kwargs: Any,
@@ -68,6 +80,18 @@ class BinaryCalibrationError(_CalibrationBase):
 
 
 class MulticlassCalibrationError(_CalibrationBase):
+    """Multiclass calibration error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import MulticlassCalibrationError
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.20], [0.10, 0.80, 0.10], [0.20, 0.30, 0.50], [0.25, 0.40, 0.35]])
+        >>> target = jnp.asarray([0, 1, 2, 1])
+        >>> metric = MulticlassCalibrationError(num_classes=3, n_bins=3)
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.38750002, dtype=float32)
+    """
     def __init__(
         self, num_classes: int, n_bins: int = 15, norm: str = "l1", ignore_index: Optional[int] = None,
         validate_args: bool = True, **kwargs: Any,

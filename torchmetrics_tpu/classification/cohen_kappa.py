@@ -16,6 +16,18 @@ from .confusion_matrix import BinaryConfusionMatrix, MulticlassConfusionMatrix
 
 
 class BinaryCohenKappa(BinaryConfusionMatrix):
+    """Binary cohen kappa.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import BinaryCohenKappa
+        >>> preds = jnp.asarray([0.11, 0.22, 0.84, 0.73, 0.33, 0.92])
+        >>> target = jnp.asarray([0, 0, 1, 1, 0, 1])
+        >>> metric = BinaryCohenKappa()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(1., dtype=float32)
+    """
     is_differentiable = False
     higher_is_better = True
     plot_lower_bound = 0.0
@@ -43,6 +55,18 @@ class BinaryCohenKappa(BinaryConfusionMatrix):
 
 
 class MulticlassCohenKappa(MulticlassConfusionMatrix):
+    """Multiclass cohen kappa.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import MulticlassCohenKappa
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.20], [0.10, 0.80, 0.10], [0.20, 0.30, 0.50], [0.25, 0.40, 0.35]])
+        >>> target = jnp.asarray([0, 1, 2, 1])
+        >>> metric = MulticlassCohenKappa(num_classes=3)
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(1., dtype=float32)
+    """
     is_differentiable = False
     higher_is_better = True
     plot_lower_bound = 0.0

@@ -31,6 +31,19 @@ from .base import _ClassificationTaskWrapper
 
 
 class BinaryConfusionMatrix(Metric):
+    """Binary confusion matrix.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import BinaryConfusionMatrix
+        >>> preds = jnp.asarray([0.11, 0.22, 0.84, 0.73, 0.33, 0.92])
+        >>> target = jnp.asarray([0, 0, 1, 1, 0, 1])
+        >>> metric = BinaryConfusionMatrix()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array([[3, 0],
+               [0, 3]], dtype=int32)
+    """
     is_differentiable = False
     higher_is_better = None
     full_state_update = False
@@ -72,6 +85,20 @@ class BinaryConfusionMatrix(Metric):
 
 
 class MulticlassConfusionMatrix(Metric):
+    """Multiclass confusion matrix.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import MulticlassConfusionMatrix
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.20], [0.10, 0.80, 0.10], [0.20, 0.30, 0.50], [0.25, 0.40, 0.35]])
+        >>> target = jnp.asarray([0, 1, 2, 1])
+        >>> metric = MulticlassConfusionMatrix(num_classes=3)
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array([[1, 0, 0],
+               [0, 2, 0],
+               [0, 0, 1]], dtype=int32)
+    """
     is_differentiable = False
     higher_is_better = None
     full_state_update = False
@@ -113,6 +140,25 @@ class MulticlassConfusionMatrix(Metric):
 
 
 class MultilabelConfusionMatrix(Metric):
+    """Multilabel confusion matrix.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import MultilabelConfusionMatrix
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.35], [0.45, 0.75, 0.05], [0.05, 0.65, 0.75]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 0, 0], [0, 1, 1]])
+        >>> metric = MultilabelConfusionMatrix(num_labels=3)
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array([[[2, 0],
+                [0, 1]],
+        <BLANKLINE>
+               [[1, 1],
+                [0, 1]],
+        <BLANKLINE>
+               [[1, 0],
+                [1, 1]]], dtype=int32)
+    """
     is_differentiable = False
     higher_is_better = None
     full_state_update = False

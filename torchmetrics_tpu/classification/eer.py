@@ -17,6 +17,18 @@ from .precision_recall_curve import (
 
 
 class BinaryEER(BinaryPrecisionRecallCurve):
+    """Binary e e r.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import BinaryEER
+        >>> preds = jnp.asarray([0.11, 0.22, 0.84, 0.73, 0.33, 0.92])
+        >>> target = jnp.asarray([0, 0, 1, 1, 0, 1])
+        >>> metric = BinaryEER()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0., dtype=float32)
+    """
     is_differentiable = False
     higher_is_better = False
     plot_lower_bound = 0.0
@@ -35,6 +47,18 @@ class BinaryEER(BinaryPrecisionRecallCurve):
 
 
 class MulticlassEER(MulticlassPrecisionRecallCurve):
+    """Multiclass e e r.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import MulticlassEER
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.20], [0.10, 0.80, 0.10], [0.20, 0.30, 0.50], [0.25, 0.40, 0.35]])
+        >>> target = jnp.asarray([0, 1, 2, 1])
+        >>> metric = MulticlassEER(num_classes=3)
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array([0., 0., 0.], dtype=float32)
+    """
     is_differentiable = False
     higher_is_better = False
     plot_lower_bound = 0.0
@@ -67,6 +91,18 @@ class MulticlassEER(MulticlassPrecisionRecallCurve):
 
 
 class MultilabelEER(MultilabelPrecisionRecallCurve):
+    """Multilabel e e r.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import MultilabelEER
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.35], [0.45, 0.75, 0.05], [0.05, 0.65, 0.75]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 0, 0], [0, 1, 1]])
+        >>> metric = MultilabelEER(num_labels=3)
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array([0.  , 0.75, 0.  ], dtype=float32)
+    """
     is_differentiable = False
     higher_is_better = False
     plot_lower_bound = 0.0

@@ -43,6 +43,18 @@ class _ExactMatchBase(Metric):
 
 
 class MulticlassExactMatch(_ExactMatchBase):
+    """Multiclass exact match.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import MulticlassExactMatch
+        >>> preds = jnp.asarray([[0, 1, 2], [1, 1, 2]])
+        >>> target = jnp.asarray([[0, 1, 2], [2, 1, 2]])
+        >>> metric = MulticlassExactMatch(num_classes=3)
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.5, dtype=float32)
+    """
     def __init__(
         self,
         num_classes: int,
@@ -73,6 +85,18 @@ class MulticlassExactMatch(_ExactMatchBase):
 
 
 class MultilabelExactMatch(_ExactMatchBase):
+    """Multilabel exact match.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import MultilabelExactMatch
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.35], [0.45, 0.75, 0.05], [0.05, 0.65, 0.75]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 0, 0], [0, 1, 1]])
+        >>> metric = MultilabelExactMatch(num_labels=3)
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.33333334, dtype=float32)
+    """
     def __init__(
         self,
         num_labels: int,

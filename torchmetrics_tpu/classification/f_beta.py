@@ -17,6 +17,18 @@ def _validate_beta(beta: float) -> None:
 
 
 class BinaryFBetaScore(BinaryStatScores):
+    """Binary f beta score.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import BinaryFBetaScore
+        >>> preds = jnp.asarray([0.11, 0.22, 0.84, 0.73, 0.33, 0.92])
+        >>> target = jnp.asarray([0, 0, 1, 1, 0, 1])
+        >>> metric = BinaryFBetaScore(beta=2.0)
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(1., dtype=float32)
+    """
     is_differentiable = False
     higher_is_better = True
     plot_lower_bound = 0.0
@@ -53,6 +65,18 @@ class BinaryFBetaScore(BinaryStatScores):
 
 
 class BinaryF1Score(BinaryFBetaScore):
+    """Binary f 1 score.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import BinaryF1Score
+        >>> preds = jnp.asarray([0.11, 0.22, 0.84, 0.73, 0.33, 0.92])
+        >>> target = jnp.asarray([0, 0, 1, 1, 0, 1])
+        >>> metric = BinaryF1Score()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(1., dtype=float32)
+    """
     def __init__(
         self,
         threshold: float = 0.5,
@@ -74,6 +98,18 @@ class BinaryF1Score(BinaryFBetaScore):
 
 
 class MulticlassFBetaScore(MulticlassStatScores):
+    """Multiclass f beta score.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import MulticlassFBetaScore
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.20], [0.10, 0.80, 0.10], [0.20, 0.30, 0.50], [0.25, 0.40, 0.35]])
+        >>> target = jnp.asarray([0, 1, 2, 1])
+        >>> metric = MulticlassFBetaScore(beta=2.0, num_classes=3)
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(1., dtype=float32)
+    """
     is_differentiable = False
     higher_is_better = True
     plot_lower_bound = 0.0
@@ -116,6 +152,18 @@ class MulticlassFBetaScore(MulticlassStatScores):
 
 
 class MulticlassF1Score(MulticlassFBetaScore):
+    """Multiclass f 1 score.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import MulticlassF1Score
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.20], [0.10, 0.80, 0.10], [0.20, 0.30, 0.50], [0.25, 0.40, 0.35]])
+        >>> target = jnp.asarray([0, 1, 2, 1])
+        >>> metric = MulticlassF1Score(num_classes=3)
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(1., dtype=float32)
+    """
     def __init__(
         self,
         num_classes: int,
@@ -141,6 +189,18 @@ class MulticlassF1Score(MulticlassFBetaScore):
 
 
 class MultilabelFBetaScore(MultilabelStatScores):
+    """Multilabel f beta score.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import MultilabelFBetaScore
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.35], [0.45, 0.75, 0.05], [0.05, 0.65, 0.75]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 0, 0], [0, 1, 1]])
+        >>> metric = MultilabelFBetaScore(beta=2.0, num_labels=3)
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.79629636, dtype=float32)
+    """
     is_differentiable = False
     higher_is_better = True
     plot_lower_bound = 0.0
@@ -183,6 +243,18 @@ class MultilabelFBetaScore(MultilabelStatScores):
 
 
 class MultilabelF1Score(MultilabelFBetaScore):
+    """Multilabel f 1 score.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import MultilabelF1Score
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.35], [0.45, 0.75, 0.05], [0.05, 0.65, 0.75]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 0, 0], [0, 1, 1]])
+        >>> metric = MultilabelF1Score(num_labels=3)
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.7777778, dtype=float32)
+    """
     def __init__(
         self,
         num_labels: int,

@@ -65,7 +65,19 @@ class _AbstractGroupStatScores(Metric):
 
 
 class BinaryGroupStatRates(_AbstractGroupStatScores):
-    """Per-group tp/fp/tn/fn rates (reference group_fairness.py:60)."""
+    """Per-group tp/fp/tn/fn rates (reference group_fairness.py:60).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import BinaryGroupStatRates
+        >>> preds = jnp.asarray([0.11, 0.84, 0.22, 0.73, 0.33, 0.92])
+        >>> target = jnp.asarray([0, 1, 0, 1, 0, 1])
+        >>> groups = jnp.asarray([0, 0, 0, 1, 1, 1])
+        >>> metric = BinaryGroupStatRates(num_groups=2)
+        >>> metric.update(preds, target, groups)
+        >>> metric.compute()
+        {'group_0': Array([0.33333334, 0.        , 0.6666667 , 0.        ], dtype=float32), 'group_1': Array([0.6666667 , 0.        , 0.33333334, 0.        ], dtype=float32)}
+    """
 
     is_differentiable = False
     higher_is_better = False
@@ -79,7 +91,19 @@ class BinaryGroupStatRates(_AbstractGroupStatScores):
 
 
 class BinaryFairness(_AbstractGroupStatScores):
-    """Demographic parity / equal opportunity ratios (reference group_fairness.py:158)."""
+    """Demographic parity / equal opportunity ratios (reference group_fairness.py:158).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import BinaryFairness
+        >>> preds = jnp.asarray([0.11, 0.84, 0.22, 0.73, 0.33, 0.92])
+        >>> target = jnp.asarray([0, 1, 0, 1, 0, 1])
+        >>> groups = jnp.asarray([0, 0, 0, 1, 1, 1])
+        >>> metric = BinaryFairness(num_groups=2)
+        >>> metric.update(preds, target, groups)
+        >>> metric.compute()
+        {'DP_0_1': Array(0.5, dtype=float32), 'EO_0_0': Array(1., dtype=float32)}
+    """
 
     is_differentiable = False
     higher_is_better = False

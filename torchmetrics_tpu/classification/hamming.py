@@ -12,6 +12,18 @@ from .stat_scores import BinaryStatScores, MulticlassStatScores, MultilabelStatS
 
 
 class BinaryHammingDistance(BinaryStatScores):
+    """Binary hamming distance.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import BinaryHammingDistance
+        >>> preds = jnp.asarray([0.11, 0.22, 0.84, 0.73, 0.33, 0.92])
+        >>> target = jnp.asarray([0, 0, 1, 1, 0, 1])
+        >>> metric = BinaryHammingDistance()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0., dtype=float32)
+    """
     is_differentiable = False
     higher_is_better = False
     plot_lower_bound = 0.0
@@ -25,6 +37,18 @@ class BinaryHammingDistance(BinaryStatScores):
 
 
 class MulticlassHammingDistance(MulticlassStatScores):
+    """Multiclass hamming distance.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import MulticlassHammingDistance
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.20], [0.10, 0.80, 0.10], [0.20, 0.30, 0.50], [0.25, 0.40, 0.35]])
+        >>> target = jnp.asarray([0, 1, 2, 1])
+        >>> metric = MulticlassHammingDistance(num_classes=3)
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0., dtype=float32)
+    """
     is_differentiable = False
     higher_is_better = False
     plot_lower_bound = 0.0
@@ -39,6 +63,18 @@ class MulticlassHammingDistance(MulticlassStatScores):
 
 
 class MultilabelHammingDistance(MultilabelStatScores):
+    """Multilabel hamming distance.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import MultilabelHammingDistance
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.35], [0.45, 0.75, 0.05], [0.05, 0.65, 0.75]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 0, 0], [0, 1, 1]])
+        >>> metric = MultilabelHammingDistance(num_labels=3)
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.22222221, dtype=float32)
+    """
     is_differentiable = False
     higher_is_better = False
     plot_lower_bound = 0.0

@@ -24,6 +24,18 @@ from .base import _ClassificationTaskWrapper
 
 
 class BinaryHingeLoss(Metric):
+    """Binary hinge loss.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import BinaryHingeLoss
+        >>> preds = jnp.asarray([0.11, 0.22, 0.84, 0.73, 0.33, 0.92])
+        >>> target = jnp.asarray([0, 0, 1, 1, 0, 1])
+        >>> metric = BinaryHingeLoss()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.695, dtype=float32)
+    """
     is_differentiable = True
     higher_is_better = False
     full_state_update = False
@@ -56,6 +68,18 @@ class BinaryHingeLoss(Metric):
 
 
 class MulticlassHingeLoss(Metric):
+    """Multiclass hinge loss.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import MulticlassHingeLoss
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.20], [0.10, 0.80, 0.10], [0.20, 0.30, 0.50], [0.25, 0.40, 0.35]])
+        >>> target = jnp.asarray([0, 1, 2, 1])
+        >>> metric = MulticlassHingeLoss(num_classes=3)
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.625, dtype=float32)
+    """
     is_differentiable = True
     higher_is_better = False
     full_state_update = False

@@ -12,6 +12,18 @@ from .confusion_matrix import BinaryConfusionMatrix, MulticlassConfusionMatrix, 
 
 
 class BinaryJaccardIndex(BinaryConfusionMatrix):
+    """Binary jaccard index.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import BinaryJaccardIndex
+        >>> preds = jnp.asarray([0.11, 0.22, 0.84, 0.73, 0.33, 0.92])
+        >>> target = jnp.asarray([0, 0, 1, 1, 0, 1])
+        >>> metric = BinaryJaccardIndex()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(1., dtype=float32)
+    """
     is_differentiable = False
     higher_is_better = True
     plot_lower_bound = 0.0
@@ -32,6 +44,18 @@ class BinaryJaccardIndex(BinaryConfusionMatrix):
 
 
 class MulticlassJaccardIndex(MulticlassConfusionMatrix):
+    """Multiclass jaccard index.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import MulticlassJaccardIndex
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.20], [0.10, 0.80, 0.10], [0.20, 0.30, 0.50], [0.25, 0.40, 0.35]])
+        >>> target = jnp.asarray([0, 1, 2, 1])
+        >>> metric = MulticlassJaccardIndex(num_classes=3)
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(1., dtype=float32)
+    """
     is_differentiable = False
     higher_is_better = True
     plot_lower_bound = 0.0
@@ -59,6 +83,18 @@ class MulticlassJaccardIndex(MulticlassConfusionMatrix):
 
 
 class MultilabelJaccardIndex(MultilabelConfusionMatrix):
+    """Multilabel jaccard index.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import MultilabelJaccardIndex
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.35], [0.45, 0.75, 0.05], [0.05, 0.65, 0.75]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 0, 0], [0, 1, 1]])
+        >>> metric = MultilabelJaccardIndex(num_labels=3)
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.6666667, dtype=float32)
+    """
     is_differentiable = False
     higher_is_better = True
     plot_lower_bound = 0.0

@@ -22,6 +22,18 @@ class _MCCComputeMixin:
 
 
 class BinaryMatthewsCorrCoef(_MCCComputeMixin, BinaryConfusionMatrix):
+    """Binary matthews corr coef.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import BinaryMatthewsCorrCoef
+        >>> preds = jnp.asarray([0.11, 0.22, 0.84, 0.73, 0.33, 0.92])
+        >>> target = jnp.asarray([0, 0, 1, 1, 0, 1])
+        >>> metric = BinaryMatthewsCorrCoef()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(1., dtype=float32)
+    """
     is_differentiable = False
     higher_is_better = True
     plot_lower_bound = -1.0
@@ -34,6 +46,18 @@ class BinaryMatthewsCorrCoef(_MCCComputeMixin, BinaryConfusionMatrix):
 
 
 class MulticlassMatthewsCorrCoef(_MCCComputeMixin, MulticlassConfusionMatrix):
+    """Multiclass matthews corr coef.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import MulticlassMatthewsCorrCoef
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.20], [0.10, 0.80, 0.10], [0.20, 0.30, 0.50], [0.25, 0.40, 0.35]])
+        >>> target = jnp.asarray([0, 1, 2, 1])
+        >>> metric = MulticlassMatthewsCorrCoef(num_classes=3)
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(1., dtype=float32)
+    """
     is_differentiable = False
     higher_is_better = True
     plot_lower_bound = -1.0
@@ -46,6 +70,18 @@ class MulticlassMatthewsCorrCoef(_MCCComputeMixin, MulticlassConfusionMatrix):
 
 
 class MultilabelMatthewsCorrCoef(_MCCComputeMixin, MultilabelConfusionMatrix):
+    """Multilabel matthews corr coef.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import MultilabelMatthewsCorrCoef
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.35], [0.45, 0.75, 0.05], [0.05, 0.65, 0.75]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 0, 0], [0, 1, 1]])
+        >>> metric = MultilabelMatthewsCorrCoef(num_labels=3)
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.55, dtype=float32)
+    """
     is_differentiable = False
     higher_is_better = True
     plot_lower_bound = -1.0

@@ -13,6 +13,18 @@ from .stat_scores import BinaryStatScores, MulticlassStatScores, MultilabelStatS
 
 
 class BinaryNegativePredictiveValue(BinaryStatScores):
+    """Binary negative predictive value.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import BinaryNegativePredictiveValue
+        >>> preds = jnp.asarray([0.11, 0.22, 0.84, 0.73, 0.33, 0.92])
+        >>> target = jnp.asarray([0, 0, 1, 1, 0, 1])
+        >>> metric = BinaryNegativePredictiveValue()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(1., dtype=float32)
+    """
     is_differentiable = False
     higher_is_better = True
     plot_lower_bound = 0.0
@@ -26,6 +38,18 @@ class BinaryNegativePredictiveValue(BinaryStatScores):
 
 
 class MulticlassNegativePredictiveValue(MulticlassStatScores):
+    """Multiclass negative predictive value.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import MulticlassNegativePredictiveValue
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.20], [0.10, 0.80, 0.10], [0.20, 0.30, 0.50], [0.25, 0.40, 0.35]])
+        >>> target = jnp.asarray([0, 1, 2, 1])
+        >>> metric = MulticlassNegativePredictiveValue(num_classes=3)
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(1., dtype=float32)
+    """
     is_differentiable = False
     higher_is_better = True
     plot_lower_bound = 0.0
@@ -41,6 +65,18 @@ class MulticlassNegativePredictiveValue(MulticlassStatScores):
 
 
 class MultilabelNegativePredictiveValue(MultilabelStatScores):
+    """Multilabel negative predictive value.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import MultilabelNegativePredictiveValue
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.35], [0.45, 0.75, 0.05], [0.05, 0.65, 0.75]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 0, 0], [0, 1, 1]])
+        >>> metric = MultilabelNegativePredictiveValue(num_labels=3)
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.8333334, dtype=float32)
+    """
     is_differentiable = False
     higher_is_better = True
     plot_lower_bound = 0.0

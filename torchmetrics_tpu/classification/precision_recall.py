@@ -21,6 +21,18 @@ class _PrecisionRecallMixin:
 
 
 class BinaryPrecision(_PrecisionRecallMixin, BinaryStatScores):
+    """Binary precision.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import BinaryPrecision
+        >>> preds = jnp.asarray([0.11, 0.22, 0.84, 0.73, 0.33, 0.92])
+        >>> target = jnp.asarray([0, 0, 1, 1, 0, 1])
+        >>> metric = BinaryPrecision()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(1., dtype=float32)
+    """
     _stat = "precision"
 
     def _compute(self, state):
@@ -31,10 +43,34 @@ class BinaryPrecision(_PrecisionRecallMixin, BinaryStatScores):
 
 
 class BinaryRecall(BinaryPrecision):
+    """Binary recall.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import BinaryRecall
+        >>> preds = jnp.asarray([0.11, 0.22, 0.84, 0.73, 0.33, 0.92])
+        >>> target = jnp.asarray([0, 0, 1, 1, 0, 1])
+        >>> metric = BinaryRecall()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(1., dtype=float32)
+    """
     _stat = "recall"
 
 
 class MulticlassPrecision(_PrecisionRecallMixin, MulticlassStatScores):
+    """Multiclass precision.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import MulticlassPrecision
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.20], [0.10, 0.80, 0.10], [0.20, 0.30, 0.50], [0.25, 0.40, 0.35]])
+        >>> target = jnp.asarray([0, 1, 2, 1])
+        >>> metric = MulticlassPrecision(num_classes=3)
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(1., dtype=float32)
+    """
     _stat = "precision"
     plot_legend_name = "Class"
 
@@ -47,10 +83,34 @@ class MulticlassPrecision(_PrecisionRecallMixin, MulticlassStatScores):
 
 
 class MulticlassRecall(MulticlassPrecision):
+    """Multiclass recall.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import MulticlassRecall
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.20], [0.10, 0.80, 0.10], [0.20, 0.30, 0.50], [0.25, 0.40, 0.35]])
+        >>> target = jnp.asarray([0, 1, 2, 1])
+        >>> metric = MulticlassRecall(num_classes=3)
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(1., dtype=float32)
+    """
     _stat = "recall"
 
 
 class MultilabelPrecision(_PrecisionRecallMixin, MultilabelStatScores):
+    """Multilabel precision.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import MultilabelPrecision
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.35], [0.45, 0.75, 0.05], [0.05, 0.65, 0.75]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 0, 0], [0, 1, 1]])
+        >>> metric = MultilabelPrecision(num_labels=3)
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.8333334, dtype=float32)
+    """
     _stat = "precision"
     plot_legend_name = "Label"
 
@@ -63,6 +123,18 @@ class MultilabelPrecision(_PrecisionRecallMixin, MultilabelStatScores):
 
 
 class MultilabelRecall(MultilabelPrecision):
+    """Multilabel recall.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import MultilabelRecall
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.35], [0.45, 0.75, 0.05], [0.05, 0.65, 0.75]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 0, 0], [0, 1, 1]])
+        >>> metric = MultilabelRecall(num_labels=3)
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.8333334, dtype=float32)
+    """
     _stat = "recall"
 
 

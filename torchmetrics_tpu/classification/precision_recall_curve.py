@@ -40,6 +40,18 @@ from .base import _ClassificationTaskWrapper
 
 
 class BinaryPrecisionRecallCurve(Metric):
+    """Binary precision recall curve.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import BinaryPrecisionRecallCurve
+        >>> preds = jnp.asarray([0.11, 0.22, 0.84, 0.73, 0.33, 0.92])
+        >>> target = jnp.asarray([0, 0, 1, 1, 0, 1])
+        >>> metric = BinaryPrecisionRecallCurve(thresholds=5)
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        (Array([0.5 , 0.75, 1.  , 1.  ,  nan, 1.  ], dtype=float32), Array([1.       , 1.       , 1.       , 0.6666667, 0.       , 0.       ],      dtype=float32), array([0.  , 0.25, 0.5 , 0.75, 1.  ], dtype=float32))
+    """
     is_differentiable = False
     higher_is_better = None
     full_state_update = False
@@ -100,6 +112,22 @@ class BinaryPrecisionRecallCurve(Metric):
 
 
 class MulticlassPrecisionRecallCurve(Metric):
+    """Multiclass precision recall curve.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import MulticlassPrecisionRecallCurve
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.20], [0.10, 0.80, 0.10], [0.20, 0.30, 0.50], [0.25, 0.40, 0.35]])
+        >>> target = jnp.asarray([0, 1, 2, 1])
+        >>> metric = MulticlassPrecisionRecallCurve(num_classes=3, thresholds=5)
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        (Array([[0.25     , 0.5      , 1.       , 1.       ,       nan, 1.       ],
+               [0.5      , 0.6666667, 1.       , 1.       ,       nan, 1.       ],
+               [0.25     , 0.5      , 1.       ,       nan,       nan, 1.       ]],      dtype=float32), Array([[1. , 1. , 1. , 1. , 0. , 0. ],
+               [1. , 1. , 0.5, 0.5, 0. , 0. ],
+               [1. , 1. , 1. , 0. , 0. , 0. ]], dtype=float32), array([0.  , 0.25, 0.5 , 0.75, 1.  ], dtype=float32))
+    """
     is_differentiable = False
     higher_is_better = None
     full_state_update = False
@@ -169,6 +197,25 @@ class MulticlassPrecisionRecallCurve(Metric):
 
 
 class MultilabelPrecisionRecallCurve(Metric):
+    """Multilabel precision recall curve.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import MultilabelPrecisionRecallCurve
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.35], [0.45, 0.75, 0.05], [0.05, 0.65, 0.75]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 0, 0], [0, 1, 1]])
+        >>> metric = MultilabelPrecisionRecallCurve(num_labels=3, thresholds=5)
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        (Array([[0.33333334, 0.5       , 1.        , 1.        ,        nan,
+                1.        ],
+               [0.33333334, 0.5       , 0.5       , 0.        ,        nan,
+                1.        ],
+               [0.6666667 , 1.        , 1.        , 1.        ,        nan,
+                1.        ]], dtype=float32), Array([[1. , 1. , 1. , 1. , 0. , 0. ],
+               [1. , 1. , 1. , 0. , 0. , 0. ],
+               [1. , 1. , 0.5, 0.5, 0. , 0. ]], dtype=float32), array([0.  , 0.25, 0.5 , 0.75, 1.  ], dtype=float32))
+    """
     is_differentiable = False
     higher_is_better = None
     full_state_update = False

@@ -49,11 +49,35 @@ class _RankingBase(Metric):
 
 
 class MultilabelCoverageError(_RankingBase):
+    """Multilabel coverage error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import MultilabelCoverageError
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.35], [0.45, 0.75, 0.05], [0.05, 0.65, 0.75]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 0, 0], [0, 1, 1]])
+        >>> metric = MultilabelCoverageError(num_labels=3)
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(1.3333334, dtype=float32)
+    """
     higher_is_better = False
     _update_fn = staticmethod(_multilabel_coverage_error_update)
 
 
 class MultilabelRankingAveragePrecision(_RankingBase):
+    """Multilabel ranking average precision.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import MultilabelRankingAveragePrecision
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.35], [0.45, 0.75, 0.05], [0.05, 0.65, 0.75]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 0, 0], [0, 1, 1]])
+        >>> metric = MultilabelRankingAveragePrecision(num_labels=3)
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(1., dtype=float32)
+    """
     higher_is_better = True
     plot_lower_bound = 0.0
     plot_upper_bound = 1.0
@@ -61,6 +85,18 @@ class MultilabelRankingAveragePrecision(_RankingBase):
 
 
 class MultilabelRankingLoss(_RankingBase):
+    """Multilabel ranking loss.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import MultilabelRankingLoss
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.35], [0.45, 0.75, 0.05], [0.05, 0.65, 0.75]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 0, 0], [0, 1, 1]])
+        >>> metric = MultilabelRankingLoss(num_labels=3)
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0., dtype=float32)
+    """
     higher_is_better = False
     plot_lower_bound = 0.0
     _update_fn = staticmethod(_multilabel_ranking_loss_update)

@@ -23,6 +23,18 @@ from .precision_recall_curve import (
 
 
 class BinaryRecallAtFixedPrecision(BinaryPrecisionRecallCurve):
+    """Binary recall at fixed precision.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import BinaryRecallAtFixedPrecision
+        >>> preds = jnp.asarray([0.11, 0.22, 0.84, 0.73, 0.33, 0.92])
+        >>> target = jnp.asarray([0, 0, 1, 1, 0, 1])
+        >>> metric = BinaryRecallAtFixedPrecision(min_precision=0.5)
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        (Array(1., dtype=float32), Array(0.73, dtype=float32))
+    """
     is_differentiable = False
     higher_is_better = True
     plot_lower_bound = 0.0
@@ -46,6 +58,18 @@ class BinaryRecallAtFixedPrecision(BinaryPrecisionRecallCurve):
 
 
 class MulticlassRecallAtFixedPrecision(MulticlassPrecisionRecallCurve):
+    """Multiclass recall at fixed precision.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import MulticlassRecallAtFixedPrecision
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.20], [0.10, 0.80, 0.10], [0.20, 0.30, 0.50], [0.25, 0.40, 0.35]])
+        >>> target = jnp.asarray([0, 1, 2, 1])
+        >>> metric = MulticlassRecallAtFixedPrecision(num_classes=3, min_precision=0.5)
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        (Array([1., 1., 1.], dtype=float32), Array([0.75, 0.4 , 0.5 ], dtype=float32))
+    """
     is_differentiable = False
     higher_is_better = True
     plot_lower_bound = 0.0
@@ -75,6 +99,18 @@ class MulticlassRecallAtFixedPrecision(MulticlassPrecisionRecallCurve):
 
 
 class MultilabelRecallAtFixedPrecision(MultilabelPrecisionRecallCurve):
+    """Multilabel recall at fixed precision.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import MultilabelRecallAtFixedPrecision
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.35], [0.45, 0.75, 0.05], [0.05, 0.65, 0.75]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 0, 0], [0, 1, 1]])
+        >>> metric = MultilabelRecallAtFixedPrecision(num_labels=3, min_precision=0.5)
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        (Array([1., 1., 1.], dtype=float32), Array([0.75, 0.65, 0.35], dtype=float32))
+    """
     is_differentiable = False
     higher_is_better = True
     plot_lower_bound = 0.0

@@ -21,6 +21,18 @@ from .precision_recall_curve import (
 
 
 class BinaryROC(BinaryPrecisionRecallCurve):
+    """Binary r o c.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import BinaryROC
+        >>> preds = jnp.asarray([0.11, 0.22, 0.84, 0.73, 0.33, 0.92])
+        >>> target = jnp.asarray([0, 0, 1, 1, 0, 1])
+        >>> metric = BinaryROC(thresholds=5)
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        (Array([0.        , 0.        , 0.        , 0.33333334, 1.        ],      dtype=float32), Array([0.       , 0.6666667, 1.       , 1.       , 1.       ], dtype=float32), array([1.  , 0.75, 0.5 , 0.25, 0.  ], dtype=float32))
+    """
     def _compute(self, state):
         return _binary_roc_compute(self._curve_state(state), self.thresholds)
 
@@ -32,6 +44,22 @@ class BinaryROC(BinaryPrecisionRecallCurve):
 
 
 class MulticlassROC(MulticlassPrecisionRecallCurve):
+    """Multiclass r o c.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import MulticlassROC
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.20], [0.10, 0.80, 0.10], [0.20, 0.30, 0.50], [0.25, 0.40, 0.35]])
+        >>> target = jnp.asarray([0, 1, 2, 1])
+        >>> metric = MulticlassROC(num_classes=3, thresholds=5)
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        (Array([[0.        , 0.        , 0.        , 0.33333334, 1.        ],
+               [0.        , 0.        , 0.        , 0.5       , 1.        ],
+               [0.        , 0.        , 0.        , 0.33333334, 1.        ]],      dtype=float32), Array([[0. , 1. , 1. , 1. , 1. ],
+               [0. , 0.5, 0.5, 1. , 1. ],
+               [0. , 0. , 1. , 1. , 1. ]], dtype=float32), array([1.  , 0.75, 0.5 , 0.25, 0.  ], dtype=float32))
+    """
     def _compute(self, state):
         return _multiclass_roc_compute(self._curve_state(state), self.num_classes, self.thresholds, self.average)
 
@@ -43,6 +71,22 @@ class MulticlassROC(MulticlassPrecisionRecallCurve):
 
 
 class MultilabelROC(MultilabelPrecisionRecallCurve):
+    """Multilabel r o c.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import MultilabelROC
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.35], [0.45, 0.75, 0.05], [0.05, 0.65, 0.75]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 0, 0], [0, 1, 1]])
+        >>> metric = MultilabelROC(num_labels=3, thresholds=5)
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        (Array([[0. , 0. , 0. , 0.5, 1. ],
+               [0. , 0.5, 0.5, 0.5, 1. ],
+               [0. , 0. , 0. , 0. , 1. ]], dtype=float32), Array([[0. , 1. , 1. , 1. , 1. ],
+               [0. , 0. , 1. , 1. , 1. ],
+               [0. , 0.5, 0.5, 1. , 1. ]], dtype=float32), array([1.  , 0.75, 0.5 , 0.25, 0.  ], dtype=float32))
+    """
     def _compute(self, state):
         return _multilabel_roc_compute(self._curve_state(state), self.num_labels, self.thresholds, self.ignore_index)
 

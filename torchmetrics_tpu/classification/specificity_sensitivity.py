@@ -21,6 +21,18 @@ from .precision_recall_curve import (
 
 
 class BinarySpecificityAtSensitivity(BinaryPrecisionRecallCurve):
+    """Binary specificity at sensitivity.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import BinarySpecificityAtSensitivity
+        >>> preds = jnp.asarray([0.11, 0.22, 0.84, 0.73, 0.33, 0.92])
+        >>> target = jnp.asarray([0, 0, 1, 1, 0, 1])
+        >>> metric = BinarySpecificityAtSensitivity(min_sensitivity=0.5)
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        (Array(1., dtype=float32), Array(0.84, dtype=float32))
+    """
     is_differentiable = False
     higher_is_better = True
     plot_lower_bound = 0.0
@@ -46,6 +58,18 @@ class BinarySpecificityAtSensitivity(BinaryPrecisionRecallCurve):
 
 
 class MulticlassSpecificityAtSensitivity(MulticlassPrecisionRecallCurve):
+    """Multiclass specificity at sensitivity.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import MulticlassSpecificityAtSensitivity
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.20], [0.10, 0.80, 0.10], [0.20, 0.30, 0.50], [0.25, 0.40, 0.35]])
+        >>> target = jnp.asarray([0, 1, 2, 1])
+        >>> metric = MulticlassSpecificityAtSensitivity(num_classes=3, min_sensitivity=0.5)
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        (Array([1., 1., 1.], dtype=float32), Array([0.75, 0.8 , 0.5 ], dtype=float32))
+    """
     is_differentiable = False
     higher_is_better = True
     plot_lower_bound = 0.0
@@ -75,6 +99,18 @@ class MulticlassSpecificityAtSensitivity(MulticlassPrecisionRecallCurve):
 
 
 class MultilabelSpecificityAtSensitivity(MultilabelPrecisionRecallCurve):
+    """Multilabel specificity at sensitivity.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import MultilabelSpecificityAtSensitivity
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.35], [0.45, 0.75, 0.05], [0.05, 0.65, 0.75]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 0, 0], [0, 1, 1]])
+        >>> metric = MultilabelSpecificityAtSensitivity(num_labels=3, min_sensitivity=0.5)
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        (Array([1. , 0.5, 1. ], dtype=float32), Array([0.75, 0.65, 0.75], dtype=float32))
+    """
     is_differentiable = False
     higher_is_better = True
     plot_lower_bound = 0.0

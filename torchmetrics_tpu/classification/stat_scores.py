@@ -51,7 +51,19 @@ class _AbstractStatScores(Metric):
 
 
 class BinaryStatScores(_AbstractStatScores):
-    """Reference: classification/stat_scores.py (BinaryStatScores)."""
+    """Reference: classification/stat_scores.py (BinaryStatScores).
+
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import BinaryStatScores
+        >>> preds = jnp.asarray([0.11, 0.22, 0.84, 0.73, 0.33, 0.92])
+        >>> target = jnp.asarray([0, 0, 1, 1, 0, 1])
+        >>> metric = BinaryStatScores()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array([3, 0, 3, 0, 3], dtype=int32)
+    """
 
     is_differentiable = False
     higher_is_better = None
@@ -91,7 +103,19 @@ class BinaryStatScores(_AbstractStatScores):
 
 
 class MulticlassStatScores(_AbstractStatScores):
-    """Reference: classification/stat_scores.py (MulticlassStatScores)."""
+    """Reference: classification/stat_scores.py (MulticlassStatScores).
+
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import MulticlassStatScores
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.20], [0.10, 0.80, 0.10], [0.20, 0.30, 0.50], [0.25, 0.40, 0.35]])
+        >>> target = jnp.asarray([0, 1, 2, 1])
+        >>> metric = MulticlassStatScores(num_classes=3)
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array([1.3333334, 0.       , 2.6666667, 0.       , 1.3333334], dtype=float32)
+    """
 
     is_differentiable = False
     higher_is_better = None
@@ -137,7 +161,19 @@ class MulticlassStatScores(_AbstractStatScores):
 
 
 class MultilabelStatScores(_AbstractStatScores):
-    """Reference: classification/stat_scores.py (MultilabelStatScores)."""
+    """Reference: classification/stat_scores.py (MultilabelStatScores).
+
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import MultilabelStatScores
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.35], [0.45, 0.75, 0.05], [0.05, 0.65, 0.75]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 0, 0], [0, 1, 1]])
+        >>> metric = MultilabelStatScores(num_labels=3)
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array([1.        , 0.33333334, 1.3333334 , 0.33333334, 1.3333334 ],      dtype=float32)
+    """
 
     is_differentiable = False
     higher_is_better = None

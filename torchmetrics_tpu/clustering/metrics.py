@@ -49,7 +49,18 @@ class _LabelPairMetric(Metric):
 
 class MutualInfoScore(_LabelPairMetric):
     """Mutual information between cluster assignments (reference
-    ``clustering/mutual_info_score.py:29``)."""
+    ``clustering/mutual_info_score.py:29``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.clustering import MutualInfoScore
+        >>> preds = jnp.asarray([2, 1, 0, 1, 0])
+        >>> target = jnp.asarray([0, 2, 1, 1, 0])
+        >>> metric = MutualInfoScore()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.50040245, dtype=float32)
+    """
 
     higher_is_better = True
     plot_lower_bound = 0.0
@@ -60,7 +71,18 @@ class MutualInfoScore(_LabelPairMetric):
 
 class AdjustedMutualInfoScore(_LabelPairMetric):
     """Chance-adjusted mutual information (reference
-    ``clustering/adjusted_mutual_info_score.py:32``)."""
+    ``clustering/adjusted_mutual_info_score.py:32``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.clustering import AdjustedMutualInfoScore
+        >>> preds = jnp.asarray([2, 1, 0, 1, 0])
+        >>> target = jnp.asarray([0, 2, 1, 1, 0])
+        >>> metric = AdjustedMutualInfoScore()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(-0.25, dtype=float32)
+    """
 
     higher_is_better = True
     plot_lower_bound = 0.0
@@ -77,7 +99,18 @@ class AdjustedMutualInfoScore(_LabelPairMetric):
 
 class NormalizedMutualInfoScore(_LabelPairMetric):
     """Entropy-normalized mutual information (reference
-    ``clustering/normalized_mutual_info_score.py:32``)."""
+    ``clustering/normalized_mutual_info_score.py:32``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.clustering import NormalizedMutualInfoScore
+        >>> preds = jnp.asarray([2, 1, 0, 1, 0])
+        >>> target = jnp.asarray([0, 2, 1, 1, 0])
+        >>> metric = NormalizedMutualInfoScore()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.474351, dtype=float32)
+    """
 
     higher_is_better = True
     plot_lower_bound = 0.0
@@ -93,7 +126,18 @@ class NormalizedMutualInfoScore(_LabelPairMetric):
 
 
 class RandScore(_LabelPairMetric):
-    """Rand index (reference ``clustering/rand_score.py:29``)."""
+    """Rand index (reference ``clustering/rand_score.py:29``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.clustering import RandScore
+        >>> preds = jnp.asarray([2, 1, 0, 1, 0])
+        >>> target = jnp.asarray([0, 2, 1, 1, 0])
+        >>> metric = RandScore()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.6, dtype=float32)
+    """
 
     higher_is_better = True
     plot_lower_bound = 0.0
@@ -104,7 +148,18 @@ class RandScore(_LabelPairMetric):
 
 
 class AdjustedRandScore(_LabelPairMetric):
-    """Chance-adjusted Rand index (reference ``clustering/adjusted_rand_score.py:29``)."""
+    """Chance-adjusted Rand index (reference ``clustering/adjusted_rand_score.py:29``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.clustering import AdjustedRandScore
+        >>> preds = jnp.asarray([2, 1, 0, 1, 0])
+        >>> target = jnp.asarray([0, 2, 1, 1, 0])
+        >>> metric = AdjustedRandScore()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(-0.25, dtype=float32)
+    """
 
     higher_is_better = True
     plot_lower_bound = -0.5
@@ -115,7 +170,18 @@ class AdjustedRandScore(_LabelPairMetric):
 
 
 class FowlkesMallowsIndex(_LabelPairMetric):
-    """Fowlkes-Mallows index (reference ``clustering/fowlkes_mallows_index.py:29``)."""
+    """Fowlkes-Mallows index (reference ``clustering/fowlkes_mallows_index.py:29``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.clustering import FowlkesMallowsIndex
+        >>> preds = jnp.asarray([2, 1, 0, 1, 0])
+        >>> target = jnp.asarray([0, 2, 1, 1, 0])
+        >>> metric = FowlkesMallowsIndex()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0., dtype=float32)
+    """
 
     higher_is_better = True
     plot_lower_bound = 0.0
@@ -127,7 +193,18 @@ class FowlkesMallowsIndex(_LabelPairMetric):
 
 class HomogeneityScore(_LabelPairMetric):
     """Homogeneity score (reference
-    ``clustering/homogeneity_completeness_v_measure.py:33``)."""
+    ``clustering/homogeneity_completeness_v_measure.py:33``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.clustering import HomogeneityScore
+        >>> preds = jnp.asarray([2, 1, 0, 1, 0])
+        >>> target = jnp.asarray([0, 2, 1, 1, 0])
+        >>> metric = HomogeneityScore()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.474351, dtype=float32)
+    """
 
     higher_is_better = True
     plot_lower_bound = 0.0
@@ -139,7 +216,18 @@ class HomogeneityScore(_LabelPairMetric):
 
 class CompletenessScore(_LabelPairMetric):
     """Completeness score (reference
-    ``clustering/homogeneity_completeness_v_measure.py:130``)."""
+    ``clustering/homogeneity_completeness_v_measure.py:130``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.clustering import CompletenessScore
+        >>> preds = jnp.asarray([2, 1, 0, 1, 0])
+        >>> target = jnp.asarray([0, 2, 1, 1, 0])
+        >>> metric = CompletenessScore()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.474351, dtype=float32)
+    """
 
     higher_is_better = True
     plot_lower_bound = 0.0
@@ -151,7 +239,18 @@ class CompletenessScore(_LabelPairMetric):
 
 class VMeasureScore(_LabelPairMetric):
     """V-measure score (reference
-    ``clustering/homogeneity_completeness_v_measure.py:226``)."""
+    ``clustering/homogeneity_completeness_v_measure.py:226``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.clustering import VMeasureScore
+        >>> preds = jnp.asarray([2, 1, 0, 1, 0])
+        >>> target = jnp.asarray([0, 2, 1, 1, 0])
+        >>> metric = VMeasureScore()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.474351, dtype=float32)
+    """
 
     higher_is_better = True
     plot_lower_bound = 0.0
@@ -169,7 +268,18 @@ class VMeasureScore(_LabelPairMetric):
 
 class ClusterAccuracy(Metric):
     """Clustering accuracy via optimal label assignment (reference
-    ``clustering/cluster_accuracy.py:35``; Hungarian solve via scipy)."""
+    ``clustering/cluster_accuracy.py:35``; Hungarian solve via scipy).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.clustering import ClusterAccuracy
+        >>> preds = jnp.asarray([2, 1, 0, 1, 0])
+        >>> target = jnp.asarray([0, 2, 1, 1, 0])
+        >>> metric = ClusterAccuracy(num_classes=3)
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.6, dtype=float32)
+    """
 
     is_differentiable = False
     higher_is_better = True
@@ -225,7 +335,18 @@ class _DataLabelMetric(Metric):
 
 
 class CalinskiHarabaszScore(_DataLabelMetric):
-    """Calinski-Harabasz score (reference ``clustering/calinski_harabasz_score.py:29``)."""
+    """Calinski-Harabasz score (reference ``clustering/calinski_harabasz_score.py:29``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.clustering import CalinskiHarabaszScore
+        >>> data = jnp.asarray([[0.0, 0.0], [0.5, 0.0], [10.0, 10.0], [10.5, 10.0], [20.0, 0.0], [20.5, 0.0]])
+        >>> labels = jnp.asarray([0, 0, 1, 1, 2, 2])
+        >>> metric = CalinskiHarabaszScore()
+        >>> metric.update(data, labels)
+        >>> metric.compute()
+        Array(2133.3333, dtype=float32)
+    """
 
     higher_is_better = True
     plot_lower_bound = 0.0
@@ -235,7 +356,18 @@ class CalinskiHarabaszScore(_DataLabelMetric):
 
 
 class DaviesBouldinScore(_DataLabelMetric):
-    """Davies-Bouldin score (reference ``clustering/davies_bouldin_score.py:29``)."""
+    """Davies-Bouldin score (reference ``clustering/davies_bouldin_score.py:29``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.clustering import DaviesBouldinScore
+        >>> data = jnp.asarray([[0.0, 0.0], [0.5, 0.0], [10.0, 10.0], [10.5, 10.0], [20.0, 0.0], [20.5, 0.0]])
+        >>> labels = jnp.asarray([0, 0, 1, 1, 2, 2])
+        >>> metric = DaviesBouldinScore()
+        >>> metric.update(data, labels)
+        >>> metric.compute()
+        Array(0.03535534, dtype=float32)
+    """
 
     higher_is_better = False
     plot_lower_bound = 0.0
@@ -245,7 +377,18 @@ class DaviesBouldinScore(_DataLabelMetric):
 
 
 class DunnIndex(_DataLabelMetric):
-    """Dunn index (reference ``clustering/dunn_index.py:29``)."""
+    """Dunn index (reference ``clustering/dunn_index.py:29``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.clustering import DunnIndex
+        >>> data = jnp.asarray([[0.0, 0.0], [0.5, 0.0], [10.0, 10.0], [10.5, 10.0], [20.0, 0.0], [20.5, 0.0]])
+        >>> labels = jnp.asarray([0, 0, 1, 1, 2, 2])
+        >>> metric = DunnIndex()
+        >>> metric.update(data, labels)
+        >>> metric.compute()
+        Array(56.568542, dtype=float32)
+    """
 
     higher_is_better = True
     plot_lower_bound = 0.0

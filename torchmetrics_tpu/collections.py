@@ -41,7 +41,19 @@ def _flatten_with_naming(res: Dict[str, Any], set_name) -> Dict[str, Any]:
 
 
 class MetricCollection:
-    """Dict-of-metrics with single update/compute/reset (reference collections.py:59)."""
+    """Dict-of-metrics with single update/compute/reset (reference collections.py:59).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import MetricCollection
+        >>> from torchmetrics_tpu.classification import MulticlassAccuracy, MulticlassPrecision
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.20], [0.10, 0.80, 0.10], [0.20, 0.30, 0.50], [0.25, 0.40, 0.35]])
+        >>> target = jnp.asarray([0, 1, 2, 1])
+        >>> collection = MetricCollection({'acc': MulticlassAccuracy(num_classes=3), 'prec': MulticlassPrecision(num_classes=3)})
+        >>> collection.update(preds, target)
+        >>> {k: round(float(v), 4) for k, v in collection.compute().items()}
+        {'acc': 1.0, 'prec': 1.0}
+    """
 
     _modules: "OrderedDict[str, Metric]"
 

@@ -11,7 +11,18 @@ from .iou import IntersectionOverUnion
 
 
 class DistanceIntersectionOverUnion(IntersectionOverUnion):
-    """DIoU over list-of-dict box inputs; same state design as ``IntersectionOverUnion``."""
+    """DIoU over list-of-dict box inputs; same state design as ``IntersectionOverUnion``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.detection import DistanceIntersectionOverUnion
+        >>> preds = [{'boxes': jnp.asarray([[296.55, 93.96, 314.97, 152.79]]), 'scores': jnp.asarray([0.236]), 'labels': jnp.asarray([4])}]
+        >>> target = [{'boxes': jnp.asarray([[300.00, 100.00, 315.00, 150.00]]), 'labels': jnp.asarray([4])}]
+        >>> metric = DistanceIntersectionOverUnion()
+        >>> metric.update(preds, target)
+        >>> {k: round(float(v), 4) for k, v in metric.compute().items()}
+        {'diou': 0.6883}
+    """
 
     _iou_type: str = "diou"
     _invalid_val: float = -1.0

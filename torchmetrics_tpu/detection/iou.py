@@ -25,6 +25,17 @@ class IntersectionOverUnion(HostMetric):
     Update accepts ``preds``/``target`` lists of per-image dicts with ``boxes`` (N,4)
     and ``labels`` (N,) (plus ``scores`` ignored here); compute returns
     ``{"iou": mean, ...}`` with optional per-class entries.
+
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.detection import IntersectionOverUnion
+        >>> preds = [{'boxes': jnp.asarray([[296.55, 93.96, 314.97, 152.79]]), 'scores': jnp.asarray([0.236]), 'labels': jnp.asarray([4])}]
+        >>> target = [{'boxes': jnp.asarray([[300.00, 100.00, 315.00, 150.00]]), 'labels': jnp.asarray([4])}]
+        >>> metric = IntersectionOverUnion()
+        >>> metric.update(preds, target)
+        >>> {k: round(float(v), 4) for k, v in metric.compute().items()}
+        {'iou': 0.6898}
     """
 
     is_differentiable: bool = False

@@ -43,6 +43,18 @@ class MeanAveragePrecision(HostMetric):
     ``target`` dicts may carry ``iscrowd`` and ``area`` like the reference's coco
     backend; crowd ground truths use the COCO crowd-IoU convention and are ignored in
     scoring.
+
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.detection import MeanAveragePrecision
+        >>> preds = [{'boxes': jnp.asarray([[258.0, 41.0, 606.0, 285.0]]), 'scores': jnp.asarray([0.536]), 'labels': jnp.asarray([0])}]
+        >>> target = [{'boxes': jnp.asarray([[214.0, 41.0, 562.0, 285.0]]), 'labels': jnp.asarray([0])}]
+        >>> metric = MeanAveragePrecision(iou_type='bbox')
+        >>> metric.update(preds, target)
+        >>> result = metric.compute()
+        >>> round(float(result['map']), 4), round(float(result['map_50']), 4)
+        (0.6, 1.0)
     """
 
     is_differentiable: bool = False

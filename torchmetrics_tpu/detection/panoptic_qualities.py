@@ -30,6 +30,17 @@ class PanopticQuality(HostMetric):
 
     Inputs are ``(B, *spatial_dims, 2)`` int arrays of ``(category_id, instance_id)``
     pairs; stuff instance ids are ignored.
+
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.detection import PanopticQuality
+        >>> preds = jnp.asarray([[[[6, 0], [0, 0], [6, 0], [6, 0]], [[0, 0], [0, 0], [6, 0], [0, 1]], [[0, 0], [0, 0], [6, 0], [0, 1]], [[0, 0], [7, 0], [6, 0], [1, 0]]]])
+        >>> target = jnp.asarray([[[[6, 0], [0, 1], [6, 0], [0, 1]], [[0, 1], [0, 1], [6, 0], [0, 1]], [[0, 1], [0, 1], [6, 0], [1, 0]], [[0, 1], [7, 0], [1, 0], [1, 0]]]])
+        >>> metric = PanopticQuality(things={0, 1}, stuffs={6, 7})
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.5416667, dtype=float32)
     """
 
     is_differentiable: bool = False

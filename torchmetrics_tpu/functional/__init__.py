@@ -45,3 +45,20 @@ __all__ = [
     *segmentation.__all__,
     *video.__all__,
 ]
+
+# Factory-built entry points (stat-scores family, task dispatchers) have no
+# source `def` to carry a docstring example; attach the generated ones at import
+# so help() shows them (executed in CI by tests/test_doctest_examples.py).
+try:  # pragma: no cover - absent only before the generator first runs
+    from torchmetrics_tpu.functional._doctest_examples import EXAMPLES as _DOCTEST_EXAMPLES
+except ImportError:
+    _DOCTEST_EXAMPLES = {}
+def _attach_doctest_examples() -> None:
+    for name, example in _DOCTEST_EXAMPLES.items():
+        fn = globals().get(name)
+        if fn is not None and ">>>" not in (fn.__doc__ or ""):
+            title = name.replace("_", " ").capitalize()
+            fn.__doc__ = (fn.__doc__ or f"{title}.") + example
+
+
+_attach_doctest_examples()

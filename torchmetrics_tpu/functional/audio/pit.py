@@ -62,6 +62,16 @@ def permutation_invariant_training(
 
     ``metric_func(preds, target)`` must return per-sample values; ``mode`` decides
     whether it sees speaker pairs or whole permutations (reference semantics).
+
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import permutation_invariant_training
+        >>> from torchmetrics_tpu.functional import scale_invariant_signal_noise_ratio
+        >>> preds = jnp.stack([jnp.sin(jnp.arange(100.0) / 9), jnp.cos(jnp.arange(100.0) / 7)])[None]
+        >>> target = jnp.stack([jnp.cos(jnp.arange(100.0) / 8), jnp.sin(jnp.arange(100.0) / 10)])[None]
+        >>> [round(float(x), 4) for x in permutation_invariant_training(preds, target, scale_invariant_signal_noise_ratio, eval_func='max')[0]]
+        [-0.1867]
     """
     preds = jnp.asarray(preds)
     target = jnp.asarray(target)

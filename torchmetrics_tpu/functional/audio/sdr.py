@@ -27,7 +27,16 @@ def signal_distortion_ratio(
     load_diag: Optional[float] = None,
 ) -> jnp.ndarray:
     """SDR in dB via the optimal linear distortion filter (fast-bss-eval semantics).
-    ``use_cg_iter`` is accepted for API parity; the Levinson solve is always direct."""
+    ``use_cg_iter`` is accepted for API parity; the Levinson solve is always direct.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import signal_distortion_ratio
+        >>> preds = jnp.sin(jnp.arange(800, dtype=jnp.float32) / 20)
+        >>> target = jnp.sin(jnp.arange(800, dtype=jnp.float32) / 20 + 0.1)
+        >>> signal_distortion_ratio(preds, target, filter_length=16)
+        Array(31.780607, dtype=float32)
+    """
     preds = np.asarray(preds, np.float64)
     target = np.asarray(target, np.float64)
     _check_same_shape(preds, target)
@@ -57,7 +66,16 @@ def signal_distortion_ratio(
 
 
 def scale_invariant_signal_distortion_ratio(preds, target, zero_mean: bool = False) -> jnp.ndarray:
-    """SI-SDR in dB (scale-invariant projection residual)."""
+    """SI-SDR in dB (scale-invariant projection residual).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import scale_invariant_signal_distortion_ratio
+        >>> preds = jnp.asarray([2.8, -1.2, 0.06, 1.3])
+        >>> target = jnp.asarray([3.0, -0.5, 0.1, 1.0])
+        >>> scale_invariant_signal_distortion_ratio(preds, target)
+        Array(12.216659, dtype=float32)
+    """
     preds = jnp.asarray(preds)
     target = jnp.asarray(target)
     _check_same_shape(preds, target)
@@ -77,7 +95,16 @@ def scale_invariant_signal_distortion_ratio(preds, target, zero_mean: bool = Fal
 def source_aggregated_signal_distortion_ratio(
     preds, target, scale_invariant: bool = True, zero_mean: bool = False
 ) -> jnp.ndarray:
-    """SA-SDR over ``(..., spk, time)``: one dB ratio over all speakers jointly."""
+    """SA-SDR over ``(..., spk, time)``: one dB ratio over all speakers jointly.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import source_aggregated_signal_distortion_ratio
+        >>> preds = jnp.stack([jnp.sin(jnp.arange(100.0) / 9), jnp.cos(jnp.arange(100.0) / 7)])[None]
+        >>> target = jnp.stack([jnp.sin(jnp.arange(100.0) / 10), jnp.cos(jnp.arange(100.0) / 8)])[None]
+        >>> source_aggregated_signal_distortion_ratio(preds, target)
+        Array([-0.4277478], dtype=float32)
+    """
     preds = jnp.asarray(preds)
     target = jnp.asarray(target)
     _check_same_shape(preds, target)

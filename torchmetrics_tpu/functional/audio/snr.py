@@ -9,7 +9,16 @@ from .sdr import scale_invariant_signal_distortion_ratio
 
 
 def signal_noise_ratio(preds, target, zero_mean: bool = False) -> jnp.ndarray:
-    """SNR in dB: target power over residual power, per sample over the time axis."""
+    """SNR in dB: target power over residual power, per sample over the time axis.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import signal_noise_ratio
+        >>> preds = jnp.asarray([2.8, -1.2, 0.06, 1.3])
+        >>> target = jnp.asarray([3.0, -0.5, 0.1, 1.0])
+        >>> signal_noise_ratio(preds, target)
+        Array(12.176362, dtype=float32)
+    """
     preds = jnp.asarray(preds)
     target = jnp.asarray(target)
     _check_same_shape(preds, target)
@@ -23,7 +32,16 @@ def signal_noise_ratio(preds, target, zero_mean: bool = False) -> jnp.ndarray:
 
 
 def scale_invariant_signal_noise_ratio(preds, target) -> jnp.ndarray:
-    """SI-SNR: SI-SDR with zero-mean normalization."""
+    """SI-SNR: SI-SDR with zero-mean normalization.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import scale_invariant_signal_noise_ratio
+        >>> preds = jnp.asarray([2.8, -1.2, 0.06, 1.3])
+        >>> target = jnp.asarray([3.0, -0.5, 0.1, 1.0])
+        >>> scale_invariant_signal_noise_ratio(preds, target)
+        Array(12.534761, dtype=float32)
+    """
     return scale_invariant_signal_distortion_ratio(preds=preds, target=target, zero_mean=True)
 
 

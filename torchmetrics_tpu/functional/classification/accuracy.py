@@ -61,6 +61,16 @@ def binary_accuracy(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
+    """Binary accuracy.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import binary_accuracy
+        >>> preds = jnp.asarray([0.11, 0.22, 0.84, 0.73, 0.33, 0.92])
+        >>> target = jnp.asarray([0, 0, 1, 1, 0, 1])
+        >>> binary_accuracy(preds, target)
+        Array(1., dtype=float32)
+    """
     if validate_args:
         _binary_stat_scores_arg_validation(threshold, multidim_average, ignore_index)
         _binary_stat_scores_tensor_validation(preds, target, multidim_average, ignore_index)
@@ -79,6 +89,16 @@ def multiclass_accuracy(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
+    """Multiclass accuracy.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import multiclass_accuracy
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.20], [0.10, 0.80, 0.10], [0.20, 0.30, 0.50], [0.25, 0.40, 0.35]])
+        >>> target = jnp.asarray([0, 1, 2, 1])
+        >>> multiclass_accuracy(preds, target, num_classes=3)
+        Array(1., dtype=float32)
+    """
     if validate_args:
         _multiclass_stat_scores_arg_validation(num_classes, top_k, average, multidim_average, ignore_index)
         _multiclass_stat_scores_tensor_validation(preds, target, num_classes, multidim_average, ignore_index)
@@ -97,6 +117,16 @@ def multilabel_accuracy(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
+    """Multilabel accuracy.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import multilabel_accuracy
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.35], [0.45, 0.75, 0.05], [0.05, 0.65, 0.75]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 0, 0], [0, 1, 1]])
+        >>> multilabel_accuracy(preds, target, num_labels=3)
+        Array(0.7777778, dtype=float32)
+    """
     if validate_args:
         _multilabel_stat_scores_arg_validation(num_labels, threshold, average, multidim_average, ignore_index)
         _multilabel_stat_scores_tensor_validation(preds, target, num_labels, multidim_average, ignore_index)
@@ -118,7 +148,16 @@ def accuracy(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
-    """Task facade (reference accuracy.py:462)."""
+    """Task facade (reference accuracy.py:462).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import accuracy
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.20], [0.10, 0.80, 0.10], [0.20, 0.30, 0.50], [0.25, 0.40, 0.35]])
+        >>> target = jnp.asarray([0, 1, 2, 1])
+        >>> accuracy(preds, target, task='multiclass', num_classes=3)
+        Array(1., dtype=float32)
+    """
     task = ClassificationTask.from_str(task)
     if task == ClassificationTask.BINARY:
         return binary_accuracy(preds, target, threshold, multidim_average, ignore_index, validate_args)

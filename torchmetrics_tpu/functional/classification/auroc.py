@@ -79,6 +79,16 @@ def binary_auroc(
     preds, target, max_fpr: Optional[float] = None, thresholds=None, ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
+    """Binary auroc.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import binary_auroc
+        >>> preds = jnp.asarray([0.11, 0.22, 0.84, 0.73, 0.33, 0.92])
+        >>> target = jnp.asarray([0, 0, 1, 1, 0, 1])
+        >>> binary_auroc(preds, target)
+        Array(1., dtype=float32)
+    """
     if validate_args:
         _binary_auroc_arg_validation(max_fpr, thresholds, ignore_index)
         _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
@@ -112,6 +122,16 @@ def multiclass_auroc(
     preds, target, num_classes: int, average: Optional[str] = "macro", thresholds=None,
     ignore_index: Optional[int] = None, validate_args: bool = True,
 ) -> Array:
+    """Multiclass auroc.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import multiclass_auroc
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.20], [0.10, 0.80, 0.10], [0.20, 0.30, 0.50], [0.25, 0.40, 0.35]])
+        >>> target = jnp.asarray([0, 1, 2, 1])
+        >>> multiclass_auroc(preds, target, num_classes=3)
+        Array(1., dtype=float32)
+    """
     if validate_args:
         _multiclass_auroc_arg_validation(num_classes, average, thresholds, ignore_index)
         _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
@@ -161,6 +181,16 @@ def multilabel_auroc(
     preds, target, num_labels: int, average: Optional[str] = "macro", thresholds=None,
     ignore_index: Optional[int] = None, validate_args: bool = True,
 ) -> Array:
+    """Multilabel auroc.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import multilabel_auroc
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.35], [0.45, 0.75, 0.05], [0.05, 0.65, 0.75]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 0, 0], [0, 1, 1]])
+        >>> multilabel_auroc(preds, target, num_labels=3)
+        Array(0.8333333, dtype=float32)
+    """
     if validate_args:
         _multilabel_auroc_arg_validation(num_labels, average, thresholds, ignore_index)
         _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
@@ -183,7 +213,16 @@ def auroc(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ):
-    """Task facade."""
+    """Task facade.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import auroc
+        >>> preds = jnp.asarray([0.11, 0.22, 0.84, 0.73, 0.33, 0.92])
+        >>> target = jnp.asarray([0, 0, 1, 1, 0, 1])
+        >>> auroc(preds, target, task='binary')
+        Array(1., dtype=float32)
+    """
     from ...utilities.enums import ClassificationTask
 
     task = ClassificationTask.from_str(task)

@@ -73,6 +73,16 @@ def _binary_average_precision_compute(state, thresholds: Optional[Array]) -> Arr
 def binary_average_precision(
     preds, target, thresholds=None, ignore_index: Optional[int] = None, validate_args: bool = True
 ) -> Array:
+    """Binary average precision.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import binary_average_precision
+        >>> preds = jnp.asarray([0.11, 0.22, 0.84, 0.73, 0.33, 0.92])
+        >>> target = jnp.asarray([0, 0, 1, 1, 0, 1])
+        >>> binary_average_precision(preds, target)
+        Array(1., dtype=float32)
+    """
     if validate_args:
         _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
         _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
@@ -105,6 +115,16 @@ def multiclass_average_precision(
     preds, target, num_classes: int, average: Optional[str] = "macro", thresholds=None,
     ignore_index: Optional[int] = None, validate_args: bool = True,
 ) -> Array:
+    """Multiclass average precision.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import multiclass_average_precision
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.20], [0.10, 0.80, 0.10], [0.20, 0.30, 0.50], [0.25, 0.40, 0.35]])
+        >>> target = jnp.asarray([0, 1, 2, 1])
+        >>> multiclass_average_precision(preds, target, num_classes=3)
+        Array(1., dtype=float32)
+    """
     if validate_args:
         _multiclass_average_precision_arg_validation(num_classes, average, thresholds, ignore_index)
         _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
@@ -154,6 +174,16 @@ def multilabel_average_precision(
     preds, target, num_labels: int, average: Optional[str] = "macro", thresholds=None,
     ignore_index: Optional[int] = None, validate_args: bool = True,
 ) -> Array:
+    """Multilabel average precision.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import multilabel_average_precision
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.35], [0.45, 0.75, 0.05], [0.05, 0.65, 0.75]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 0, 0], [0, 1, 1]])
+        >>> multilabel_average_precision(preds, target, num_labels=3)
+        Array(0.8333333, dtype=float32)
+    """
     if validate_args:
         _multilabel_average_precision_arg_validation(num_labels, average, thresholds, ignore_index)
         _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)

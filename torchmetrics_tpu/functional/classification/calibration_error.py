@@ -87,6 +87,16 @@ def binary_calibration_error(
     preds, target, n_bins: int = 15, norm: str = "l1", ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
+    """Binary calibration error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import binary_calibration_error
+        >>> preds = jnp.asarray([0.11, 0.22, 0.84, 0.73, 0.33, 0.92])
+        >>> target = jnp.asarray([0, 0, 1, 1, 0, 1])
+        >>> binary_calibration_error(preds, target, n_bins=3)
+        Array(0.195, dtype=float32)
+    """
     if validate_args:
         _binary_calibration_error_arg_validation(n_bins, norm, ignore_index)
         _binary_calibration_error_tensor_validation(preds, target, ignore_index)
@@ -114,6 +124,16 @@ def multiclass_calibration_error(
     preds, target, num_classes: int, n_bins: int = 15, norm: str = "l1",
     ignore_index: Optional[int] = None, validate_args: bool = True,
 ) -> Array:
+    """Multiclass calibration error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import multiclass_calibration_error
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.20], [0.10, 0.80, 0.10], [0.20, 0.30, 0.50], [0.25, 0.40, 0.35]])
+        >>> target = jnp.asarray([0, 1, 2, 1])
+        >>> multiclass_calibration_error(preds, target, num_classes=3, n_bins=3)
+        Array(0.38750002, dtype=float32)
+    """
     if validate_args:
         _multiclass_calibration_error_arg_validation(num_classes, n_bins, norm, ignore_index)
         from .stat_scores import _multiclass_stat_scores_tensor_validation

@@ -55,6 +55,16 @@ def binary_cohen_kappa(
     preds, target, threshold: float = 0.5, weights: Optional[str] = None,
     ignore_index: Optional[int] = None, validate_args: bool = True,
 ) -> Array:
+    """Binary cohen kappa.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import binary_cohen_kappa
+        >>> preds = jnp.asarray([0.11, 0.22, 0.84, 0.73, 0.33, 0.92])
+        >>> target = jnp.asarray([0, 0, 1, 1, 0, 1])
+        >>> binary_cohen_kappa(preds, target)
+        Array(1., dtype=float32)
+    """
     if validate_args:
         _binary_cohen_kappa_arg_validation(threshold, ignore_index, weights)
         _binary_confusion_matrix_tensor_validation(preds, target, ignore_index)
@@ -75,6 +85,16 @@ def multiclass_cohen_kappa(
     preds, target, num_classes: int, weights: Optional[str] = None,
     ignore_index: Optional[int] = None, validate_args: bool = True,
 ) -> Array:
+    """Multiclass cohen kappa.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import multiclass_cohen_kappa
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.20], [0.10, 0.80, 0.10], [0.20, 0.30, 0.50], [0.25, 0.40, 0.35]])
+        >>> target = jnp.asarray([0, 1, 2, 1])
+        >>> multiclass_cohen_kappa(preds, target, num_classes=3)
+        Array(1., dtype=float32)
+    """
     if validate_args:
         _multiclass_cohen_kappa_arg_validation(num_classes, ignore_index, weights)
         _multiclass_confusion_matrix_tensor_validation(preds, target, num_classes, ignore_index)

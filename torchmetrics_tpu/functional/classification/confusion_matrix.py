@@ -92,6 +92,17 @@ def binary_confusion_matrix(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
+    """Binary confusion matrix.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import binary_confusion_matrix
+        >>> preds = jnp.asarray([0.11, 0.22, 0.84, 0.73, 0.33, 0.92])
+        >>> target = jnp.asarray([0, 0, 1, 1, 0, 1])
+        >>> binary_confusion_matrix(preds, target)
+        Array([[3., 0.],
+               [0., 3.]], dtype=float32)
+    """
     if validate_args:
         _binary_confusion_matrix_arg_validation(threshold, ignore_index, normalize)
         _binary_confusion_matrix_tensor_validation(preds, target, ignore_index)
@@ -155,6 +166,18 @@ def multiclass_confusion_matrix(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
+    """Multiclass confusion matrix.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import multiclass_confusion_matrix
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.20], [0.10, 0.80, 0.10], [0.20, 0.30, 0.50], [0.25, 0.40, 0.35]])
+        >>> target = jnp.asarray([0, 1, 2, 1])
+        >>> multiclass_confusion_matrix(preds, target, num_classes=3)
+        Array([[1., 0., 0.],
+               [0., 2., 0.],
+               [0., 0., 1.]], dtype=float32)
+    """
     if validate_args:
         _multiclass_confusion_matrix_arg_validation(num_classes, ignore_index, normalize)
         _multiclass_confusion_matrix_tensor_validation(preds, target, num_classes, ignore_index)
@@ -230,6 +253,23 @@ def multilabel_confusion_matrix(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
+    """Multilabel confusion matrix.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import multilabel_confusion_matrix
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.35], [0.45, 0.75, 0.05], [0.05, 0.65, 0.75]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 0, 0], [0, 1, 1]])
+        >>> multilabel_confusion_matrix(preds, target, num_labels=3)
+        Array([[[2, 0],
+                [0, 1]],
+        <BLANKLINE>
+               [[1, 1],
+                [0, 1]],
+        <BLANKLINE>
+               [[1, 0],
+                [1, 1]]], dtype=int32)
+    """
     if validate_args:
         _multilabel_confusion_matrix_arg_validation(num_labels, threshold, ignore_index, normalize)
         _multilabel_confusion_matrix_tensor_validation(preds, target, num_labels, ignore_index)

@@ -41,6 +41,16 @@ def _eer_compute(fpr: Union[Array, List[Array]], tpr: Union[Array, List[Array]])
 
 
 def binary_eer(preds, target, thresholds=None, ignore_index=None, validate_args: bool = True) -> Array:
+    """Binary eer.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import binary_eer
+        >>> preds = jnp.asarray([0.11, 0.22, 0.84, 0.73, 0.33, 0.92])
+        >>> target = jnp.asarray([0, 0, 1, 1, 0, 1])
+        >>> binary_eer(preds, target)
+        Array(0., dtype=float32)
+    """
     if validate_args:
         _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
     preds, target, thresholds, w = _binary_precision_recall_curve_format(preds, target, thresholds, ignore_index)
@@ -56,6 +66,16 @@ def binary_eer(preds, target, thresholds=None, ignore_index=None, validate_args:
 def multiclass_eer(
     preds, target, num_classes: int, thresholds=None, average=None, ignore_index=None, validate_args: bool = True
 ) -> Array:
+    """Multiclass eer.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import multiclass_eer
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.20], [0.10, 0.80, 0.10], [0.20, 0.30, 0.50], [0.25, 0.40, 0.35]])
+        >>> target = jnp.asarray([0, 1, 2, 1])
+        >>> multiclass_eer(preds, target, num_classes=3)
+        Array([0., 0., 0.], dtype=float32)
+    """
     if validate_args:
         if average not in ("micro", "macro", None):
             raise ValueError(f"Expected argument `average` to be one of ('micro', 'macro', None), but got {average}")
@@ -78,6 +98,16 @@ def multiclass_eer(
 def multilabel_eer(
     preds, target, num_labels: int, thresholds=None, ignore_index=None, validate_args: bool = True
 ) -> Array:
+    """Multilabel eer.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import multilabel_eer
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.35], [0.45, 0.75, 0.05], [0.05, 0.65, 0.75]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 0, 0], [0, 1, 1]])
+        >>> multilabel_eer(preds, target, num_labels=3)
+        Array([0.  , 0.75, 0.  ], dtype=float32)
+    """
     if validate_args:
         _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
     preds, target, thresholds, w = _multilabel_precision_recall_curve_format(

@@ -50,6 +50,16 @@ def multiclass_exact_match(
     preds, target, num_classes: int, multidim_average: str = "global",
     ignore_index: Optional[int] = None, validate_args: bool = True,
 ) -> Array:
+    """Multiclass exact match.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import multiclass_exact_match
+        >>> preds = jnp.asarray([[0, 1, 2], [1, 1, 2]])
+        >>> target = jnp.asarray([[0, 1, 2], [2, 1, 2]])
+        >>> multiclass_exact_match(preds, target, num_classes=3)
+        Array(0.5, dtype=float32)
+    """
     if validate_args:
         _multiclass_stat_scores_arg_validation(num_classes, 1, None, multidim_average, ignore_index)
         _multiclass_stat_scores_tensor_validation(preds, target, num_classes, multidim_average, ignore_index)
@@ -73,6 +83,16 @@ def multilabel_exact_match(
     preds, target, num_labels: int, threshold: float = 0.5, multidim_average: str = "global",
     ignore_index: Optional[int] = None, validate_args: bool = True,
 ) -> Array:
+    """Multilabel exact match.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import multilabel_exact_match
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.35], [0.45, 0.75, 0.05], [0.05, 0.65, 0.75]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 0, 0], [0, 1, 1]])
+        >>> multilabel_exact_match(preds, target, num_labels=3)
+        Array(0.33333334, dtype=float32)
+    """
     if validate_args:
         _multilabel_stat_scores_arg_validation(num_labels, threshold, None, multidim_average, ignore_index)
         _multilabel_stat_scores_tensor_validation(preds, target, num_labels, multidim_average, ignore_index)

@@ -57,6 +57,16 @@ def _binary_hinge_loss_update(preds: Array, target: Array, squared: bool, weight
 def binary_hinge_loss(
     preds, target, squared: bool = False, ignore_index: Optional[int] = None, validate_args: bool = True
 ) -> Array:
+    """Binary hinge loss.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import binary_hinge_loss
+        >>> preds = jnp.asarray([0.11, 0.22, 0.84, 0.73, 0.33, 0.92])
+        >>> target = jnp.asarray([0, 0, 1, 1, 0, 1])
+        >>> binary_hinge_loss(preds, target)
+        Array(0.695, dtype=float32)
+    """
     if validate_args:
         _binary_hinge_loss_arg_validation(squared, ignore_index)
         _binary_hinge_loss_tensor_validation(preds, target, ignore_index)
@@ -115,6 +125,16 @@ def multiclass_hinge_loss(
     preds, target, num_classes: int, squared: bool = False, multiclass_mode: str = "crammer-singer",
     ignore_index: Optional[int] = None, validate_args: bool = True,
 ) -> Array:
+    """Multiclass hinge loss.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import multiclass_hinge_loss
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.20], [0.10, 0.80, 0.10], [0.20, 0.30, 0.50], [0.25, 0.40, 0.35]])
+        >>> target = jnp.asarray([0, 1, 2, 1])
+        >>> multiclass_hinge_loss(preds, target, num_classes=3)
+        Array(0.625, dtype=float32)
+    """
     if validate_args:
         _multiclass_hinge_loss_arg_validation(num_classes, squared, multiclass_mode, ignore_index)
         from .stat_scores import _multiclass_stat_scores_tensor_validation

@@ -73,6 +73,16 @@ def binary_logauc(
     preds, target, fpr_range: Tuple[float, float] = (0.001, 0.1), thresholds=None, ignore_index=None,
     validate_args: bool = True,
 ) -> Array:
+    """Binary logauc.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import binary_logauc
+        >>> preds = jnp.asarray([0.11, 0.22, 0.84, 0.73, 0.33, 0.92])
+        >>> target = jnp.asarray([0, 0, 1, 1, 0, 1])
+        >>> binary_logauc(preds, target)
+        Array(1., dtype=float32)
+    """
     if validate_args:
         _validate_fpr_range(fpr_range)
         _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
@@ -91,6 +101,16 @@ def multiclass_logauc(
     preds, target, num_classes: int, fpr_range: Tuple[float, float] = (0.001, 0.1), average: Optional[str] = "macro",
     thresholds=None, ignore_index=None, validate_args: bool = True,
 ) -> Array:
+    """Multiclass logauc.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import multiclass_logauc
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.20], [0.10, 0.80, 0.10], [0.20, 0.30, 0.50], [0.25, 0.40, 0.35]])
+        >>> target = jnp.asarray([0, 1, 2, 1])
+        >>> multiclass_logauc(preds, target, num_classes=3)
+        Array(1., dtype=float32)
+    """
     if validate_args:
         _validate_fpr_range(fpr_range)
         _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
@@ -111,6 +131,16 @@ def multilabel_logauc(
     preds, target, num_labels: int, fpr_range: Tuple[float, float] = (0.001, 0.1), average: Optional[str] = "macro",
     thresholds=None, ignore_index=None, validate_args: bool = True,
 ) -> Array:
+    """Multilabel logauc.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import multilabel_logauc
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.35], [0.45, 0.75, 0.05], [0.05, 0.65, 0.75]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 0, 0], [0, 1, 1]])
+        >>> multilabel_logauc(preds, target, num_labels=3)
+        Array(0.6666667, dtype=float32)
+    """
     if validate_args:
         _validate_fpr_range(fpr_range)
         _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)

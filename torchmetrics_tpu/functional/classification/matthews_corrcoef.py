@@ -81,6 +81,16 @@ def _matthews_corrcoef_reduce(confmat: Array) -> Array:
 def binary_matthews_corrcoef(
     preds, target, threshold: float = 0.5, ignore_index: Optional[int] = None, validate_args: bool = True
 ) -> Array:
+    """Binary matthews corrcoef.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import binary_matthews_corrcoef
+        >>> preds = jnp.asarray([0.11, 0.22, 0.84, 0.73, 0.33, 0.92])
+        >>> target = jnp.asarray([0, 0, 1, 1, 0, 1])
+        >>> binary_matthews_corrcoef(preds, target)
+        Array(1., dtype=float32)
+    """
     if validate_args:
         _binary_confusion_matrix_arg_validation(threshold, ignore_index, normalize=None)
         _binary_confusion_matrix_tensor_validation(preds, target, ignore_index)
@@ -92,6 +102,16 @@ def binary_matthews_corrcoef(
 def multiclass_matthews_corrcoef(
     preds, target, num_classes: int, ignore_index: Optional[int] = None, validate_args: bool = True
 ) -> Array:
+    """Multiclass matthews corrcoef.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import multiclass_matthews_corrcoef
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.20], [0.10, 0.80, 0.10], [0.20, 0.30, 0.50], [0.25, 0.40, 0.35]])
+        >>> target = jnp.asarray([0, 1, 2, 1])
+        >>> multiclass_matthews_corrcoef(preds, target, num_classes=3)
+        Array(1., dtype=float32)
+    """
     if validate_args:
         _multiclass_confusion_matrix_arg_validation(num_classes, ignore_index, normalize=None)
         _multiclass_confusion_matrix_tensor_validation(preds, target, num_classes, ignore_index)
@@ -104,6 +124,16 @@ def multilabel_matthews_corrcoef(
     preds, target, num_labels: int, threshold: float = 0.5, ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
+    """Multilabel matthews corrcoef.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import multilabel_matthews_corrcoef
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.35], [0.45, 0.75, 0.05], [0.05, 0.65, 0.75]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 0, 0], [0, 1, 1]])
+        >>> multilabel_matthews_corrcoef(preds, target, num_labels=3)
+        Array(0.55, dtype=float32)
+    """
     if validate_args:
         _multilabel_confusion_matrix_arg_validation(num_labels, threshold, ignore_index, normalize=None)
         _multilabel_confusion_matrix_tensor_validation(preds, target, num_labels, ignore_index)

@@ -213,6 +213,16 @@ def _binary_precision_recall_curve_compute(
 def binary_precision_recall_curve(
     preds, target, thresholds=None, ignore_index: Optional[int] = None, validate_args: bool = True
 ):
+    """Binary precision recall curve.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import binary_precision_recall_curve
+        >>> preds = jnp.asarray([0.11, 0.22, 0.84, 0.73, 0.33, 0.92])
+        >>> target = jnp.asarray([0, 0, 1, 1, 0, 1])
+        >>> binary_precision_recall_curve(preds, target, thresholds=5)
+        (Array([0.5 , 0.75, 1.  , 1.  ,  nan, 1.  ], dtype=float32), Array([1.       , 1.       , 1.       , 0.6666667, 0.       , 0.       ],      dtype=float32), array([0.  , 0.25, 0.5 , 0.75, 1.  ], dtype=float32))
+    """
     if validate_args:
         _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
         _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
@@ -335,6 +345,20 @@ def multiclass_precision_recall_curve(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ):
+    """Multiclass precision recall curve.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import multiclass_precision_recall_curve
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.20], [0.10, 0.80, 0.10], [0.20, 0.30, 0.50], [0.25, 0.40, 0.35]])
+        >>> target = jnp.asarray([0, 1, 2, 1])
+        >>> multiclass_precision_recall_curve(preds, target, num_classes=3, thresholds=5)
+        (Array([[0.25     , 0.5      , 1.       , 1.       ,       nan, 1.       ],
+               [0.5      , 0.6666667, 1.       , 1.       ,       nan, 1.       ],
+               [0.25     , 0.5      , 1.       ,       nan,       nan, 1.       ]],      dtype=float32), Array([[1. , 1. , 1. , 1. , 0. , 0. ],
+               [1. , 1. , 0.5, 0.5, 0. , 0. ],
+               [1. , 1. , 1. , 0. , 0. , 0. ]], dtype=float32), array([0.  , 0.25, 0.5 , 0.75, 1.  ], dtype=float32))
+    """
     if validate_args:
         _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index, average)
         _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
@@ -428,6 +452,23 @@ def _multilabel_precision_recall_curve_compute(
 def multilabel_precision_recall_curve(
     preds, target, num_labels: int, thresholds=None, ignore_index: Optional[int] = None, validate_args: bool = True
 ):
+    """Multilabel precision recall curve.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import multilabel_precision_recall_curve
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.35], [0.45, 0.75, 0.05], [0.05, 0.65, 0.75]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 0, 0], [0, 1, 1]])
+        >>> multilabel_precision_recall_curve(preds, target, num_labels=3, thresholds=5)
+        (Array([[0.33333334, 0.5       , 1.        , 1.        ,        nan,
+                1.        ],
+               [0.33333334, 0.5       , 0.5       , 0.        ,        nan,
+                1.        ],
+               [0.6666667 , 1.        , 1.        , 1.        ,        nan,
+                1.        ]], dtype=float32), Array([[1. , 1. , 1. , 1. , 0. , 0. ],
+               [1. , 1. , 1. , 0. , 0. , 0. ],
+               [1. , 1. , 0.5, 0.5, 0. , 0. ]], dtype=float32), array([0.  , 0.25, 0.5 , 0.75, 1.  ], dtype=float32))
+    """
     if validate_args:
         _multilabel_precision_recall_curve_arg_validation(num_labels, thresholds, ignore_index)
         _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)

@@ -52,6 +52,16 @@ def _multilabel_coverage_error_update(preds: Array, target: Array) -> Tuple[Arra
 def multilabel_coverage_error(
     preds, target, num_labels: int, ignore_index: Optional[int] = None, validate_args: bool = True
 ) -> Array:
+    """Multilabel coverage error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import multilabel_coverage_error
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.35], [0.45, 0.75, 0.05], [0.05, 0.65, 0.75]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 0, 0], [0, 1, 1]])
+        >>> multilabel_coverage_error(preds, target, num_labels=3)
+        Array(1.3333334, dtype=float32)
+    """
     if validate_args:
         _multilabel_ranking_tensor_validation(preds, target, num_labels, ignore_index)
     preds, target = _multilabel_ranking_format(preds, target, num_labels, ignore_index)
@@ -76,6 +86,16 @@ def _multilabel_ranking_average_precision_update(preds: Array, target: Array) ->
 def multilabel_ranking_average_precision(
     preds, target, num_labels: int, ignore_index: Optional[int] = None, validate_args: bool = True
 ) -> Array:
+    """Multilabel ranking average precision.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import multilabel_ranking_average_precision
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.35], [0.45, 0.75, 0.05], [0.05, 0.65, 0.75]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 0, 0], [0, 1, 1]])
+        >>> multilabel_ranking_average_precision(preds, target, num_labels=3)
+        Array(1., dtype=float32)
+    """
     if validate_args:
         _multilabel_ranking_tensor_validation(preds, target, num_labels, ignore_index)
     preds, target = _multilabel_ranking_format(preds, target, num_labels, ignore_index)
@@ -100,6 +120,16 @@ def _multilabel_ranking_loss_update(preds: Array, target: Array) -> Tuple[Array,
 def multilabel_ranking_loss(
     preds, target, num_labels: int, ignore_index: Optional[int] = None, validate_args: bool = True
 ) -> Array:
+    """Multilabel ranking loss.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import multilabel_ranking_loss
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.35], [0.45, 0.75, 0.05], [0.05, 0.65, 0.75]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 0, 0], [0, 1, 1]])
+        >>> multilabel_ranking_loss(preds, target, num_labels=3)
+        Array(0., dtype=float32)
+    """
     if validate_args:
         _multilabel_ranking_tensor_validation(preds, target, num_labels, ignore_index)
     preds, target = _multilabel_ranking_format(preds, target, num_labels, ignore_index)

@@ -53,6 +53,16 @@ def _binary_recall_at_fixed_precision_compute(state, thresholds, min_precision: 
 def binary_recall_at_fixed_precision(
     preds, target, min_precision: float, thresholds=None, ignore_index=None, validate_args: bool = True
 ):
+    """Binary recall at fixed precision.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import binary_recall_at_fixed_precision
+        >>> preds = jnp.asarray([0.11, 0.22, 0.84, 0.73, 0.33, 0.92])
+        >>> target = jnp.asarray([0, 0, 1, 1, 0, 1])
+        >>> binary_recall_at_fixed_precision(preds, target, min_precision=0.5)
+        (Array(1., dtype=float32), Array(0.73, dtype=float32))
+    """
     if validate_args:
         _binary_recall_at_fixed_precision_arg_validation(min_precision, thresholds, ignore_index)
         _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
@@ -81,6 +91,16 @@ def _multiclass_recall_at_fixed_precision_compute(
 def multiclass_recall_at_fixed_precision(
     preds, target, num_classes: int, min_precision: float, thresholds=None, ignore_index=None, validate_args: bool = True
 ):
+    """Multiclass recall at fixed precision.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import multiclass_recall_at_fixed_precision
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.20], [0.10, 0.80, 0.10], [0.20, 0.30, 0.50], [0.25, 0.40, 0.35]])
+        >>> target = jnp.asarray([0, 1, 2, 1])
+        >>> multiclass_recall_at_fixed_precision(preds, target, num_classes=3, min_precision=0.5)
+        (Array([1., 1., 1.], dtype=float32), Array([0.75, 0.4 , 0.5 ], dtype=float32))
+    """
     if validate_args:
         _multiclass_recall_at_fixed_precision_arg_validation(num_classes, min_precision, thresholds, ignore_index)
         _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
@@ -111,6 +131,16 @@ def _multilabel_recall_at_fixed_precision_compute(
 def multilabel_recall_at_fixed_precision(
     preds, target, num_labels: int, min_precision: float, thresholds=None, ignore_index=None, validate_args: bool = True
 ):
+    """Multilabel recall at fixed precision.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import multilabel_recall_at_fixed_precision
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.35], [0.45, 0.75, 0.05], [0.05, 0.65, 0.75]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 0, 0], [0, 1, 1]])
+        >>> multilabel_recall_at_fixed_precision(preds, target, num_labels=3, min_precision=0.5)
+        (Array([1., 1., 1.], dtype=float32), Array([0.75, 0.65, 0.35], dtype=float32))
+    """
     if validate_args:
         _multilabel_recall_at_fixed_precision_arg_validation(num_labels, min_precision, thresholds, ignore_index)
         _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)

@@ -118,7 +118,16 @@ def binary_stat_scores(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
-    """tp/fp/tn/fn/support for binary tasks. Reference: stat_scores.py:140-216."""
+    """tp/fp/tn/fn/support for binary tasks. Reference: stat_scores.py:140-216.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import binary_stat_scores
+        >>> preds = jnp.asarray([0.11, 0.22, 0.84, 0.73, 0.33, 0.92])
+        >>> target = jnp.asarray([0, 0, 1, 1, 0, 1])
+        >>> binary_stat_scores(preds, target)
+        Array([3, 0, 3, 0, 3], dtype=int32)
+    """
     if validate_args:
         _binary_stat_scores_arg_validation(threshold, multidim_average, ignore_index)
         _binary_stat_scores_tensor_validation(preds, target, multidim_average, ignore_index)
@@ -257,9 +266,23 @@ def _multiclass_stat_scores_update(
 def _multiclass_stat_scores_compute(
     tp: Array, fp: Array, tn: Array, fn: Array, average: Optional[str] = "macro", multidim_average: str = "global"
 ) -> Array:
+    """Average-strategy aggregation over the class axis (reference
+    stat_scores.py:454-480): micro sums, macro means in float, weighted uses
+    support weights (per-sample-normalized on the samplewise path), none keeps
+    the (..., C, 5) table."""
     res = jnp.stack([tp, fp, tn, fn, tp + fn], axis=-1)
+    sum_dim = 0 if multidim_average == "global" else 1
     if average == "micro":
-        return res.sum(-2)
+        return res.sum(sum_dim) if res.ndim > 1 else res
+    if average == "macro":
+        return res.astype(jnp.float32).mean(sum_dim)
+    if average == "weighted":
+        weight = tp + fn
+        if multidim_average == "global":
+            norm = weight / weight.sum()
+        else:
+            norm = weight / weight.sum(-1, keepdims=True)
+        return (res * norm.reshape(*weight.shape, 1)).sum(sum_dim)
     return res
 
 
@@ -273,7 +296,16 @@ def multiclass_stat_scores(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
-    """tp/fp/tn/fn/support for multiclass tasks. Reference: stat_scores.py:486-581."""
+    """tp/fp/tn/fn/support for multiclass tasks. Reference: stat_scores.py:486-581.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import multiclass_stat_scores
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.20], [0.10, 0.80, 0.10], [0.20, 0.30, 0.50], [0.25, 0.40, 0.35]])
+        >>> target = jnp.asarray([0, 1, 2, 1])
+        >>> multiclass_stat_scores(preds, target, num_classes=3)
+        Array([1.3333334, 0.       , 2.6666667, 0.       , 1.3333334], dtype=float32)
+    """
     if validate_args:
         _multiclass_stat_scores_arg_validation(num_classes, top_k, average, multidim_average, ignore_index)
         _multiclass_stat_scores_tensor_validation(preds, target, num_classes, multidim_average, ignore_index)
@@ -366,7 +398,22 @@ def _multilabel_stat_scores_update(
     return tp, fp, tn, fn
 
 
-_multilabel_stat_scores_compute = _multiclass_stat_scores_compute
+def _multilabel_stat_scores_compute(
+    tp: Array, fp: Array, tn: Array, fn: Array, average: Optional[str] = "macro", multidim_average: str = "global"
+) -> Array:
+    """Multilabel variant (reference stat_scores.py:719-744): like multiclass,
+    except `weighted` normalizes by the GLOBAL support sum even on the
+    samplewise path — a deliberate reference asymmetry kept for parity."""
+    res = jnp.stack([tp, fp, tn, fn, tp + fn], axis=-1)
+    sum_dim = 0 if multidim_average == "global" else 1
+    if average == "micro":
+        return res.sum(sum_dim)
+    if average == "macro":
+        return res.astype(jnp.float32).mean(sum_dim)
+    if average == "weighted":
+        weight = tp + fn
+        return (res * (weight / weight.sum()).reshape(*weight.shape, 1)).sum(sum_dim)
+    return res
 
 
 def multilabel_stat_scores(
@@ -379,7 +426,16 @@ def multilabel_stat_scores(
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Array:
-    """tp/fp/tn/fn/support for multilabel tasks."""
+    """tp/fp/tn/fn/support for multilabel tasks.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import multilabel_stat_scores
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.35], [0.45, 0.75, 0.05], [0.05, 0.65, 0.75]])
+        >>> target = jnp.asarray([[1, 0, 1], [0, 0, 0], [0, 1, 1]])
+        >>> multilabel_stat_scores(preds, target, num_labels=3)
+        Array([1.        , 0.33333334, 1.3333334 , 0.33333334, 1.3333334 ],      dtype=float32)
+    """
     if validate_args:
         _multilabel_stat_scores_arg_validation(num_labels, threshold, average, multidim_average, ignore_index)
         _multilabel_stat_scores_tensor_validation(preds, target, num_labels, multidim_average, ignore_index)

@@ -51,7 +51,16 @@ def _mutual_info_score_compute(contingency: np.ndarray) -> float:
 
 def mutual_info_score(preds, target) -> jnp.ndarray:
     r"""Mutual information between two clusterings (reference
-    ``functional/clustering/mutual_info_score.py:65``)."""
+    ``functional/clustering/mutual_info_score.py:65``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import mutual_info_score
+        >>> preds = jnp.asarray([2, 1, 0, 1, 0])
+        >>> target = jnp.asarray([0, 2, 1, 1, 0])
+        >>> mutual_info_score(preds, target)
+        Array(0.50040245, dtype=float32)
+    """
     return _as_scalar(_mutual_info_score_compute(_mutual_info_score_update(preds, target)))
 
 
@@ -100,7 +109,16 @@ def expected_mutual_info_score(contingency: np.ndarray, n_samples: int) -> float
 
 
 def adjusted_mutual_info_score(preds, target, average_method: str = "arithmetic") -> jnp.ndarray:
-    r"""Adjusted mutual information: ``(MI - E[MI]) / (normalizer - E[MI])``."""
+    r"""Adjusted mutual information: ``(MI - E[MI]) / (normalizer - E[MI])``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import adjusted_mutual_info_score
+        >>> preds = jnp.asarray([2, 1, 0, 1, 0])
+        >>> target = jnp.asarray([0, 2, 1, 1, 0])
+        >>> adjusted_mutual_info_score(preds, target)
+        Array(-0.25, dtype=float32)
+    """
     _validate_average_method_arg(average_method)
     contingency = _mutual_info_score_update(preds, target)
     mutual_info = _mutual_info_score_compute(contingency)
@@ -116,7 +134,16 @@ def adjusted_mutual_info_score(preds, target, average_method: str = "arithmetic"
 
 
 def normalized_mutual_info_score(preds, target, average_method: str = "arithmetic") -> jnp.ndarray:
-    r"""Normalized mutual information: ``MI / generalized_mean(H(preds), H(target))``."""
+    r"""Normalized mutual information: ``MI / generalized_mean(H(preds), H(target))``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import normalized_mutual_info_score
+        >>> preds = jnp.asarray([2, 1, 0, 1, 0])
+        >>> target = jnp.asarray([0, 2, 1, 1, 0])
+        >>> normalized_mutual_info_score(preds, target)
+        Array(0.474351, dtype=float32)
+    """
     check_cluster_labels(preds, target)
     _validate_average_method_arg(average_method)
     mutual_info = _mutual_info_score_compute(_mutual_info_score_update(preds, target))
@@ -145,7 +172,16 @@ def _rand_score_compute(contingency: np.ndarray) -> float:
 
 
 def rand_score(preds, target) -> jnp.ndarray:
-    r"""Rand index: fraction of sample pairs on which the clusterings agree."""
+    r"""Rand index: fraction of sample pairs on which the clusterings agree.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import rand_score
+        >>> preds = jnp.asarray([2, 1, 0, 1, 0])
+        >>> target = jnp.asarray([0, 2, 1, 1, 0])
+        >>> rand_score(preds, target)
+        Array(0.6, dtype=float32)
+    """
     return _as_scalar(_rand_score_compute(_rand_score_update(preds, target)))
 
 
@@ -157,7 +193,16 @@ def _adjusted_rand_score_compute(contingency: np.ndarray) -> float:
 
 
 def adjusted_rand_score(preds, target) -> jnp.ndarray:
-    r"""Chance-adjusted Rand index."""
+    r"""Chance-adjusted Rand index.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import adjusted_rand_score
+        >>> preds = jnp.asarray([2, 1, 0, 1, 0])
+        >>> target = jnp.asarray([0, 2, 1, 1, 0])
+        >>> adjusted_rand_score(preds, target)
+        Array(-0.25, dtype=float32)
+    """
     return _as_scalar(_adjusted_rand_score_compute(_rand_score_update(preds, target)))
 
 
@@ -176,7 +221,16 @@ def _fowlkes_mallows_index_compute(contingency: np.ndarray, n: int) -> float:
 
 
 def fowlkes_mallows_index(preds, target) -> jnp.ndarray:
-    r"""Fowlkes-Mallows index: geometric mean of pairwise precision and recall."""
+    r"""Fowlkes-Mallows index: geometric mean of pairwise precision and recall.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import fowlkes_mallows_index
+        >>> preds = jnp.asarray([2, 1, 0, 1, 0])
+        >>> target = jnp.asarray([0, 2, 1, 1, 0])
+        >>> fowlkes_mallows_index(preds, target)
+        Array(0., dtype=float32)
+    """
     contingency, n = _fowlkes_mallows_index_update(preds, target)
     return _as_scalar(_fowlkes_mallows_index_compute(contingency, n))
 
@@ -195,7 +249,16 @@ def _homogeneity_score_compute(preds, target) -> Tuple[float, float, float, floa
 
 
 def homogeneity_score(preds, target) -> jnp.ndarray:
-    r"""Homogeneity: each cluster contains only members of a single class."""
+    r"""Homogeneity: each cluster contains only members of a single class.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import homogeneity_score
+        >>> preds = jnp.asarray([2, 1, 0, 1, 0])
+        >>> target = jnp.asarray([0, 2, 1, 1, 0])
+        >>> homogeneity_score(preds, target)
+        Array(0.474351, dtype=float32)
+    """
     return _as_scalar(_homogeneity_score_compute(preds, target)[0])
 
 
@@ -206,12 +269,30 @@ def _completeness_score_compute(preds, target) -> Tuple[float, float]:
 
 
 def completeness_score(preds, target) -> jnp.ndarray:
-    r"""Completeness: all members of a class are assigned to the same cluster."""
+    r"""Completeness: all members of a class are assigned to the same cluster.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import completeness_score
+        >>> preds = jnp.asarray([2, 1, 0, 1, 0])
+        >>> target = jnp.asarray([0, 2, 1, 1, 0])
+        >>> completeness_score(preds, target)
+        Array(0.474351, dtype=float32)
+    """
     return _as_scalar(_completeness_score_compute(preds, target)[0])
 
 
 def v_measure_score(preds, target, beta: float = 1.0) -> jnp.ndarray:
-    r"""V-measure: weighted harmonic mean of homogeneity and completeness."""
+    r"""V-measure: weighted harmonic mean of homogeneity and completeness.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import v_measure_score
+        >>> preds = jnp.asarray([2, 1, 0, 1, 0])
+        >>> target = jnp.asarray([0, 2, 1, 1, 0])
+        >>> v_measure_score(preds, target)
+        Array(0.474351, dtype=float32)
+    """
     completeness, homogeneity = _completeness_score_compute(preds, target)
     if homogeneity + completeness == 0.0:
         return _as_scalar(1.0)
@@ -230,7 +311,16 @@ def _cluster_accuracy_compute(confmat: np.ndarray) -> float:
 
 def cluster_accuracy(preds, target, num_classes: int) -> jnp.ndarray:
     r"""Clustering accuracy: optimal one-to-one label assignment (Hungarian solve via
-    scipy; the reference needs the optional ``torch_linear_assignment`` wheel)."""
+    scipy; the reference needs the optional ``torch_linear_assignment`` wheel).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import cluster_accuracy
+        >>> preds = jnp.asarray([2, 1, 0, 1, 0])
+        >>> target = jnp.asarray([0, 2, 1, 1, 0])
+        >>> cluster_accuracy(preds, target, num_classes=3)
+        Array(0.6, dtype=float32)
+    """
     from ..classification.confusion_matrix import multiclass_confusion_matrix
 
     check_cluster_labels(preds, target)

@@ -14,7 +14,16 @@ from .utils import _cluster_views, _validate_intrinsic_cluster_data, _validate_i
 
 
 def calinski_harabasz_score(data, labels) -> jnp.ndarray:
-    r"""Calinski-Harabasz score: between/within dispersion ratio."""
+    r"""Calinski-Harabasz score: between/within dispersion ratio.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import calinski_harabasz_score
+        >>> data = jnp.asarray([[0.0, 0.0], [0.5, 0.0], [10.0, 10.0], [10.5, 10.0], [20.0, 0.0], [20.5, 0.0]])
+        >>> labels = jnp.asarray([0, 0, 1, 1, 2, 2])
+        >>> calinski_harabasz_score(data, labels)
+        Array(2133.3333, dtype=float32)
+    """
     data = np.asarray(data, np.float64)
     labels = np.asarray(labels)
     _validate_intrinsic_cluster_data(data, labels)
@@ -32,7 +41,16 @@ def calinski_harabasz_score(data, labels) -> jnp.ndarray:
 
 def davies_bouldin_score(data, labels) -> jnp.ndarray:
     r"""Davies-Bouldin score: mean worst-case ratio of intra-cluster spread to
-    centroid separation."""
+    centroid separation.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import davies_bouldin_score
+        >>> data = jnp.asarray([[0.0, 0.0], [0.5, 0.0], [10.0, 10.0], [10.5, 10.0], [20.0, 0.0], [20.5, 0.0]])
+        >>> labels = jnp.asarray([0, 0, 1, 1, 2, 2])
+        >>> davies_bouldin_score(data, labels)
+        Array(0.03535534, dtype=float32)
+    """
     data = np.asarray(data, np.float64)
     labels = np.asarray(labels)
     _validate_intrinsic_cluster_data(data, labels)
@@ -54,7 +72,16 @@ def davies_bouldin_score(data, labels) -> jnp.ndarray:
 
 
 def dunn_index(data, labels, p: float = 2) -> jnp.ndarray:
-    r"""Dunn index: min inter-centroid distance over max intra-cluster radius."""
+    r"""Dunn index: min inter-centroid distance over max intra-cluster radius.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import dunn_index
+        >>> data = jnp.asarray([[0.0, 0.0], [0.5, 0.0], [10.0, 10.0], [10.5, 10.0], [20.0, 0.0], [20.5, 0.0]])
+        >>> labels = jnp.asarray([0, 0, 1, 1, 2, 2])
+        >>> dunn_index(data, labels)
+        Array(56.568542, dtype=float32)
+    """
     data = np.asarray(data, np.float64)
     labels = np.asarray(labels)
     _validate_intrinsic_cluster_data(data, labels)

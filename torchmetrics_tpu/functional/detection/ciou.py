@@ -25,6 +25,15 @@ def complete_intersection_over_union(
     replacement_val: float = 0,
     aggregate: bool = True,
 ) -> jnp.ndarray:
-    """Compute CIoU between two sets of xyxy boxes."""
+    """Compute CIoU between two sets of xyxy boxes.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import complete_intersection_over_union
+        >>> preds = jnp.asarray([[296.55, 93.96, 314.97, 152.79], [328.94, 97.05, 342.49, 122.98]])
+        >>> target = jnp.asarray([[300.00, 100.00, 315.00, 150.00], [330.00, 100.00, 350.00, 125.00]])
+        >>> complete_intersection_over_union(preds, target)
+        Array(0.5881959, dtype=float32)
+    """
     iou = _ciou_update(preds, target, iou_threshold, replacement_val)
     return _ciou_compute(iou, aggregate)

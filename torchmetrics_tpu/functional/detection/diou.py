@@ -25,6 +25,15 @@ def distance_intersection_over_union(
     replacement_val: float = 0,
     aggregate: bool = True,
 ) -> jnp.ndarray:
-    """Compute DIoU between two sets of xyxy boxes."""
+    """Compute DIoU between two sets of xyxy boxes.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import distance_intersection_over_union
+        >>> preds = jnp.asarray([[296.55, 93.96, 314.97, 152.79], [328.94, 97.05, 342.49, 122.98]])
+        >>> target = jnp.asarray([[300.00, 100.00, 315.00, 150.00], [330.00, 100.00, 350.00, 125.00]])
+        >>> distance_intersection_over_union(preds, target)
+        Array(0.5884219, dtype=float32)
+    """
     iou = _diou_update(preds, target, iou_threshold, replacement_val)
     return _diou_compute(iou, aggregate)

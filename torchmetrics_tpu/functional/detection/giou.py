@@ -25,6 +25,15 @@ def generalized_intersection_over_union(
     replacement_val: float = 0,
     aggregate: bool = True,
 ) -> jnp.ndarray:
-    """Compute GIoU between two sets of xyxy boxes."""
+    """Compute GIoU between two sets of xyxy boxes.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import generalized_intersection_over_union
+        >>> preds = jnp.asarray([[296.55, 93.96, 314.97, 152.79], [328.94, 97.05, 342.49, 122.98]])
+        >>> target = jnp.asarray([[300.00, 100.00, 315.00, 150.00], [330.00, 100.00, 350.00, 125.00]])
+        >>> generalized_intersection_over_union(preds, target)
+        Array(0.57842493, dtype=float32)
+    """
     iou = _giou_update(preds, target, iou_threshold, replacement_val)
     return _giou_compute(iou, aggregate)

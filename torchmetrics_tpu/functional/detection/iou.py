@@ -66,6 +66,15 @@ def intersection_over_union(
 ) -> jnp.ndarray:
     """Compute IoU between two sets of xyxy boxes (reference
     ``functional/detection/iou.py:52``). ``aggregate=True`` returns the mean of the
-    matrix diagonal; otherwise the full ``(N, M)`` matrix."""
+    matrix diagonal; otherwise the full ``(N, M)`` matrix.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import intersection_over_union
+        >>> preds = jnp.asarray([[296.55, 93.96, 314.97, 152.79], [328.94, 97.05, 342.49, 122.98]])
+        >>> target = jnp.asarray([[300.00, 100.00, 315.00, 150.00], [330.00, 100.00, 350.00, 125.00]])
+        >>> intersection_over_union(preds, target)
+        Array(0.5991845, dtype=float32)
+    """
     iou = _iou_update(preds, target, iou_threshold, replacement_val)
     return _iou_compute(iou, aggregate)

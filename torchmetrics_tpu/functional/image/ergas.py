@@ -28,6 +28,15 @@ def _ergas_compute(preds, target, ratio: float = 4, reduction: Optional[str] = "
 def error_relative_global_dimensionless_synthesis(
     preds, target, ratio: float = 4, reduction: Optional[str] = "elementwise_mean"
 ) -> jnp.ndarray:
-    """ERGAS: band-wise relative RMSE aggregated over channels."""
+    """ERGAS: band-wise relative RMSE aggregated over channels.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import error_relative_global_dimensionless_synthesis
+        >>> preds = (jnp.arange(768, dtype=jnp.float32).reshape(1, 3, 16, 16) * 37 % 97) / 97
+        >>> target = (jnp.arange(768, dtype=jnp.float32).reshape(1, 3, 16, 16) * 31 % 89) / 89
+        >>> error_relative_global_dimensionless_synthesis(preds, target)
+        Array(20.90032, dtype=float32)
+    """
     preds, target = _ergas_update(preds, target)
     return _ergas_compute(preds, target, ratio, reduction)

@@ -8,7 +8,15 @@ import jax.numpy as jnp
 
 
 def image_gradients(img) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """1-step finite-difference (dy, dx), zero-padded at the far edge (TF semantics)."""
+    """1-step finite-difference (dy, dx), zero-padded at the far edge (TF semantics).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import image_gradients
+        >>> preds = (jnp.arange(768, dtype=jnp.float32).reshape(1, 3, 16, 16) * 37 % 97) / 97
+        >>> [g.shape for g in image_gradients(preds)]
+        [(1, 3, 16, 16), (1, 3, 16, 16)]
+    """
     if not hasattr(img, "shape"):
         raise TypeError(f"The `img` expects a value of <Tensor> type but got {type(img)}")
     img = jnp.asarray(img)

@@ -78,5 +78,14 @@ def _compat_peak_signal_noise_ratio(
     """Alias exported as top-level ``functional.peak_signal_noise_ratio``: the
     reference exports its deprecated wrapper there, whose ``data_range`` defaults
     to 3.0 (reference ``functional/image/_deprecated.py:80-86``), unlike the
-    strict ``functional.image`` export."""
+    strict ``functional.image`` export.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import peak_signal_noise_ratio
+        >>> preds = jnp.asarray([[0.0, 1.0], [2.0, 3.0]])
+        >>> target = jnp.asarray([[3.0, 2.0], [1.0, 0.0]])
+        >>> peak_signal_noise_ratio(preds, target)
+        Array(2.552725, dtype=float32)
+    """
     return peak_signal_noise_ratio(preds, target, data_range, base, reduction, dim)

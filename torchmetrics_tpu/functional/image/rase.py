@@ -30,7 +30,16 @@ def _rase_compute(rmse_map: jnp.ndarray, target_sum: jnp.ndarray, total_images: 
 
 
 def relative_average_spectral_error(preds, target, window_size: int = 8) -> jnp.ndarray:
-    """RASE: percentage RMSE relative to the local target mean."""
+    """RASE: percentage RMSE relative to the local target mean.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import relative_average_spectral_error
+        >>> preds = (jnp.arange(768, dtype=jnp.float32).reshape(1, 3, 16, 16) * 37 % 97) / 97
+        >>> target = (jnp.arange(768, dtype=jnp.float32).reshape(1, 3, 16, 16) * 31 % 89) / 89
+        >>> relative_average_spectral_error(preds, target)
+        Array(5315.8857, dtype=float32)
+    """
     if not isinstance(window_size, int) or window_size < 1:
         raise ValueError("Argument `window_size` is expected to be a positive integer.")
     preds = jnp.asarray(preds)

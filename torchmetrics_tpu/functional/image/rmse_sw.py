@@ -42,7 +42,16 @@ def _rmse_sw_compute(rmse_val_sum: Optional[jnp.ndarray], rmse_map: jnp.ndarray,
 def root_mean_squared_error_using_sliding_window(
     preds, target, window_size: int = 8, return_rmse_map: bool = False
 ):
-    """RMSE over a uniform sliding window (optionally returning the error map)."""
+    """RMSE over a uniform sliding window (optionally returning the error map).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import root_mean_squared_error_using_sliding_window
+        >>> preds = (jnp.arange(768, dtype=jnp.float32).reshape(1, 3, 16, 16) * 37 % 97) / 97
+        >>> target = (jnp.arange(768, dtype=jnp.float32).reshape(1, 3, 16, 16) * 31 % 89) / 89
+        >>> root_mean_squared_error_using_sliding_window(preds, target)
+        Array(0.40987822, dtype=float32)
+    """
     if not isinstance(window_size, int) or window_size < 1:
         raise ValueError("Argument `window_size` is expected to be a positive integer.")
     rmse_val_sum, rmse_map, total_images = _rmse_sw_update(

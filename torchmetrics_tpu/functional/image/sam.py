@@ -28,6 +28,15 @@ def _sam_compute(preds, target, reduction: Optional[str] = "elementwise_mean"):
 
 
 def spectral_angle_mapper(preds, target, reduction: Optional[str] = "elementwise_mean") -> jnp.ndarray:
-    """Per-pixel spectral angle between prediction and target spectra (radians)."""
+    """Per-pixel spectral angle between prediction and target spectra (radians).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import spectral_angle_mapper
+        >>> preds = (jnp.arange(768, dtype=jnp.float32).reshape(1, 3, 16, 16) * 37 % 97) / 97
+        >>> target = (jnp.arange(768, dtype=jnp.float32).reshape(1, 3, 16, 16) * 31 % 89) / 89
+        >>> spectral_angle_mapper(preds, target)
+        Array(0.65371865, dtype=float32)
+    """
     preds, target = _sam_update(preds, target)
     return _sam_compute(preds, target, reduction)

@@ -97,7 +97,16 @@ def spatial_correlation_coefficient(
     window_size: int = 8,
     reduction: Optional[str] = "mean",
 ) -> jnp.ndarray:
-    """SCC: local correlation of high-pass-filtered images (sewar semantics)."""
+    """SCC: local correlation of high-pass-filtered images (sewar semantics).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import spatial_correlation_coefficient
+        >>> preds = (jnp.arange(768, dtype=jnp.float32).reshape(1, 3, 16, 16) * 37 % 97) / 97
+        >>> target = (jnp.arange(768, dtype=jnp.float32).reshape(1, 3, 16, 16) * 31 % 89) / 89
+        >>> spatial_correlation_coefficient(preds, target)
+        Array(-0.03273273, dtype=float32)
+    """
     if hp_filter is None:
         hp_filter = jnp.asarray([[-1, -1, -1], [-1, 8, -1], [-1, -1, -1]])
     if reduction is None:

@@ -170,7 +170,16 @@ def structural_similarity_index_measure(
     return_full_image: bool = False,
     return_contrast_sensitivity: bool = False,
 ):
-    """Compute SSIM over NCHW (or NCDHW) image batches."""
+    """Compute SSIM over NCHW (or NCDHW) image batches.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import structural_similarity_index_measure
+        >>> preds = (jnp.arange(768, dtype=jnp.float32).reshape(1, 3, 16, 16) * 37 % 97) / 97
+        >>> target = (jnp.arange(768, dtype=jnp.float32).reshape(1, 3, 16, 16) * 31 % 89) / 89
+        >>> structural_similarity_index_measure(preds, target, data_range=1.0)
+        Array(-0.0257605, dtype=float32)
+    """
     preds, target = _ssim_check_inputs(preds, target)
     pack = _ssim_update(
         preds, target, gaussian_kernel, sigma, kernel_size, data_range, k1, k2,

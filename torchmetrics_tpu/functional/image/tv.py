@@ -29,6 +29,14 @@ def _total_variation_compute(score, num_elements, reduction: Optional[str]):
 
 
 def total_variation(img, reduction: Optional[str] = "sum") -> jnp.ndarray:
-    """Anisotropic total variation of an NCHW image batch."""
+    """Anisotropic total variation of an NCHW image batch.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import total_variation
+        >>> preds = (jnp.arange(768, dtype=jnp.float32).reshape(1, 3, 16, 16) * 37 % 97) / 97
+        >>> total_variation(preds)
+        Array(471.78384, dtype=float32)
+    """
     score, num_elements = _total_variation_update(img)
     return _total_variation_compute(score, num_elements, reduction)

@@ -66,6 +66,15 @@ def universal_image_quality_index(
     sigma: Sequence[float] = (1.5, 1.5),
     reduction: Optional[str] = "elementwise_mean",
 ) -> jnp.ndarray:
-    """Universal Image Quality Index — SSIM without the stability constants."""
+    """Universal Image Quality Index — SSIM without the stability constants.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import universal_image_quality_index
+        >>> preds = (jnp.arange(768, dtype=jnp.float32).reshape(1, 3, 16, 16) * 37 % 97) / 97
+        >>> target = (jnp.arange(768, dtype=jnp.float32).reshape(1, 3, 16, 16) * 31 % 89) / 89
+        >>> universal_image_quality_index(preds, target)
+        Array(0.05859915, dtype=float32)
+    """
     preds, target = _uqi_update(preds, target)
     return _uqi_compute(preds, target, kernel_size, sigma, reduction)

@@ -14,7 +14,16 @@ def lip_vertex_error(
     validate_args: bool = True,
 ) -> jnp.ndarray:
     r"""Mean over frames of the max squared L2 error over lip vertices:
-    ``LVE = mean_i max_{v in lip} ||x_{i,v} - xhat_{i,v}||^2``."""
+    ``LVE = mean_i max_{v in lip} ||x_{i,v} - xhat_{i,v}||^2``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import lip_vertex_error
+        >>> vertices_pred = (jnp.arange(90, dtype=jnp.float32).reshape(5, 6, 3) * 37 % 19) / 19
+        >>> vertices_gt = (jnp.arange(90, dtype=jnp.float32).reshape(5, 6, 3) * 31 % 17) / 17
+        >>> lip_vertex_error(vertices_pred, vertices_gt, mouth_map=[1, 2, 3])
+        Array(0.9050102, dtype=float32)
+    """
     vertices_pred = jnp.asarray(vertices_pred)
     vertices_gt = jnp.asarray(vertices_gt)
     if validate_args:

@@ -78,7 +78,16 @@ def cramers_v(
     nan_replace_value: Optional[float] = 0.0,
 ) -> jnp.ndarray:
     r"""Cramer's V: ``sqrt((chi^2/n) / min(r-1, k-1))`` association between two
-    categorical series (reference ``functional/nominal/cramers.py:89``)."""
+    categorical series (reference ``functional/nominal/cramers.py:89``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import cramers_v
+        >>> preds = jnp.asarray([0, 1, 2, 2, 1, 0, 1, 2, 1, 0])
+        >>> target = jnp.asarray([0, 1, 2, 1, 1, 0, 2, 2, 1, 0])
+        >>> cramers_v(preds, target)
+        Array(0.6846532, dtype=float32)
+    """
     _nominal_input_validation(nan_strategy, nan_replace_value)
     confmat = _cramers_v_update(preds, target, None, nan_strategy, nan_replace_value)
     return _cramers_v_compute(confmat, bias_correction)
@@ -107,7 +116,16 @@ def pearsons_contingency_coefficient(
     preds, target, nan_strategy: str = "replace", nan_replace_value: Optional[float] = 0.0
 ) -> jnp.ndarray:
     r"""Pearson's contingency coefficient ``sqrt(phi^2 / (1 + phi^2))`` (reference
-    ``functional/nominal/pearson.py:77``)."""
+    ``functional/nominal/pearson.py:77``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import pearsons_contingency_coefficient
+        >>> preds = jnp.asarray([0, 1, 2, 2, 1, 0, 1, 2, 1, 0])
+        >>> target = jnp.asarray([0, 1, 2, 1, 1, 0, 2, 2, 1, 0])
+        >>> pearsons_contingency_coefficient(preds, target)
+        Array(0.73480344, dtype=float32)
+    """
     _nominal_input_validation(nan_strategy, nan_replace_value)
     confmat = _pearsons_contingency_coefficient_update(preds, target, None, nan_strategy, nan_replace_value)
     return _pearsons_contingency_coefficient_compute(confmat)
@@ -148,7 +166,16 @@ def theils_u(
     preds, target, nan_strategy: str = "replace", nan_replace_value: Optional[float] = 0.0
 ) -> jnp.ndarray:
     r"""Theil's U (uncertainty coefficient) ``(H(X) - H(X|Y)) / H(X)`` — asymmetric
-    association (reference ``functional/nominal/theils_u.py:118``)."""
+    association (reference ``functional/nominal/theils_u.py:118``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import theils_u
+        >>> preds = jnp.asarray([0, 1, 2, 2, 1, 0, 1, 2, 1, 0])
+        >>> target = jnp.asarray([0, 1, 2, 1, 1, 0, 2, 2, 1, 0])
+        >>> theils_u(preds, target)
+        Array(0.61806566, dtype=float32)
+    """
     _nominal_input_validation(nan_strategy, nan_replace_value)
     confmat = _theils_u_update(preds, target, None, nan_strategy, nan_replace_value)
     return _theils_u_compute(confmat)
@@ -197,7 +224,16 @@ def tschuprows_t(
     nan_replace_value: Optional[float] = 0.0,
 ) -> jnp.ndarray:
     r"""Tschuprow's T: ``sqrt((chi^2/n) / sqrt((r-1)(k-1)))`` (reference
-    ``functional/nominal/tschuprows.py:95``)."""
+    ``functional/nominal/tschuprows.py:95``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import tschuprows_t
+        >>> preds = jnp.asarray([0, 1, 2, 2, 1, 0, 1, 2, 1, 0])
+        >>> target = jnp.asarray([0, 1, 2, 1, 1, 0, 2, 2, 1, 0])
+        >>> tschuprows_t(preds, target)
+        Array(0.6846532, dtype=float32)
+    """
     _nominal_input_validation(nan_strategy, nan_replace_value)
     confmat = _tschuprows_t_update(preds, target, None, nan_strategy, nan_replace_value)
     return _tschuprows_t_compute(confmat, bias_correction)

@@ -46,6 +46,14 @@ def fleiss_kappa(ratings: jnp.ndarray, mode: str = "counts") -> jnp.ndarray:
 
     ``ratings`` is ``[n_samples, n_categories]`` integer counts (``mode="counts"``) or
     ``[n_samples, n_categories, n_raters]`` probabilities (``mode="probs"``).
+
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import fleiss_kappa
+        >>> ratings = jnp.asarray([[0, 4, 1], [2, 2, 1], [4, 0, 1], [1, 3, 1]])
+        >>> fleiss_kappa(ratings, mode='counts')
+        Array(0.09448675, dtype=float32)
     """
     if mode not in ["counts", "probs"]:
         raise ValueError("Argument ``mode`` must be one of ['counts', 'probs'].")

@@ -29,7 +29,17 @@ def pairwise_cosine_similarity(
     x, y=None, reduction: Optional[str] = None, zero_diagonal: Optional[bool] = None
 ) -> jnp.ndarray:
     r"""Pairwise cosine similarity ``<x,y>/(||x||*||y||)`` between rows of x and y
-    (or x with itself when y is omitted, diagonal zeroed by default)."""
+    (or x with itself when y is omitted, diagonal zeroed by default).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import pairwise_cosine_similarity
+        >>> x = jnp.asarray([[2.0, 3.0], [3.0, 5.0]])
+        >>> y = jnp.asarray([[1.0, 0.0], [2.0, 1.0]])
+        >>> pairwise_cosine_similarity(x, y)
+        Array([[0.5547002 , 0.86824316],
+               [0.5144958 , 0.84366155]], dtype=float32)
+    """
     return _reduce_distance_matrix(_pairwise_cosine_similarity_update(x, y, zero_diagonal), reduction)
 
 
@@ -45,7 +55,17 @@ def pairwise_euclidean_distance(
     x, y=None, reduction: Optional[str] = None, zero_diagonal: Optional[bool] = None
 ) -> jnp.ndarray:
     r"""Pairwise euclidean distance via the ``||x||^2 + ||y||^2 - 2<x,y>`` identity
-    (one matmul; clamped at zero against cancellation)."""
+    (one matmul; clamped at zero against cancellation).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import pairwise_euclidean_distance
+        >>> x = jnp.asarray([[2.0, 3.0], [3.0, 5.0]])
+        >>> y = jnp.asarray([[1.0, 0.0], [2.0, 1.0]])
+        >>> pairwise_euclidean_distance(x, y)
+        Array([[3.1622777, 2.       ],
+               [5.3851647, 4.1231055]], dtype=float32)
+    """
     return _reduce_distance_matrix(_pairwise_euclidean_distance_update(x, y, zero_diagonal), reduction)
 
 
@@ -57,7 +77,17 @@ def _pairwise_linear_similarity_update(x, y=None, zero_diagonal: Optional[bool] 
 def pairwise_linear_similarity(
     x, y=None, reduction: Optional[str] = None, zero_diagonal: Optional[bool] = None
 ) -> jnp.ndarray:
-    r"""Pairwise linear similarity ``<x,y>`` between rows."""
+    r"""Pairwise linear similarity ``<x,y>`` between rows.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import pairwise_linear_similarity
+        >>> x = jnp.asarray([[2.0, 3.0], [3.0, 5.0]])
+        >>> y = jnp.asarray([[1.0, 0.0], [2.0, 1.0]])
+        >>> pairwise_linear_similarity(x, y)
+        Array([[ 2.,  7.],
+               [ 3., 11.]], dtype=float32)
+    """
     return _reduce_distance_matrix(_pairwise_linear_similarity_update(x, y, zero_diagonal), reduction)
 
 
@@ -70,7 +100,17 @@ def _pairwise_manhattan_distance_update(x, y=None, zero_diagonal: Optional[bool]
 def pairwise_manhattan_distance(
     x, y=None, reduction: Optional[str] = None, zero_diagonal: Optional[bool] = None
 ) -> jnp.ndarray:
-    r"""Pairwise manhattan (L1) distance between rows."""
+    r"""Pairwise manhattan (L1) distance between rows.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import pairwise_manhattan_distance
+        >>> x = jnp.asarray([[2.0, 3.0], [3.0, 5.0]])
+        >>> y = jnp.asarray([[1.0, 0.0], [2.0, 1.0]])
+        >>> pairwise_manhattan_distance(x, y)
+        Array([[4., 2.],
+               [7., 5.]], dtype=float32)
+    """
     return _reduce_distance_matrix(_pairwise_manhattan_distance_update(x, y, zero_diagonal), reduction)
 
 
@@ -91,5 +131,15 @@ def pairwise_minkowski_distance(
     reduction: Optional[str] = None,
     zero_diagonal: Optional[bool] = None,
 ) -> jnp.ndarray:
-    r"""Pairwise minkowski distance ``(sum |x_i - y_j|^p)^(1/p)`` between rows."""
+    r"""Pairwise minkowski distance ``(sum |x_i - y_j|^p)^(1/p)`` between rows.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import pairwise_minkowski_distance
+        >>> x = jnp.asarray([[2.0, 3.0], [3.0, 5.0]])
+        >>> y = jnp.asarray([[1.0, 0.0], [2.0, 1.0]])
+        >>> pairwise_minkowski_distance(x, y, exponent=4)
+        Array([[3.0092168, 2.       ],
+               [5.0316973, 4.0039005]], dtype=float32)
+    """
     return _reduce_distance_matrix(_pairwise_minkowski_distance_update(x, y, exponent, zero_diagonal), reduction)

@@ -28,7 +28,16 @@ def _concordance_corrcoef_compute(
 
 
 def concordance_corrcoef(preds, target) -> Array:
-    """One-shot concordance correlation coefficient."""
+    """One-shot concordance correlation coefficient.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import concordance_corrcoef
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> concordance_corrcoef(preds, target)
+        Array(0.9777347, dtype=float32)
+    """
     preds = jnp.asarray(preds)
     num_outputs = 1 if preds.ndim == 1 else preds.shape[-1]
     d = (num_outputs,) if num_outputs > 1 else ()

@@ -35,5 +35,15 @@ def _cosine_similarity_compute(preds: Array, target: Array, reduction: Optional[
 
 
 def cosine_similarity(preds, target, reduction: Optional[str] = "sum") -> Array:
+    """Cosine similarity.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import cosine_similarity
+        >>> preds = jnp.asarray([[1.0, 2.0, 3.0], [1.0, 0.0, 1.0]])
+        >>> target = jnp.asarray([[1.0, 2.0, 2.0], [0.5, 0.0, 1.0]])
+        >>> cosine_similarity(preds, target, reduction='mean')
+        Array(0.96432054, dtype=float32)
+    """
     preds, target = _cosine_similarity_update(preds, target)
     return _cosine_similarity_compute(preds, target, reduction)

@@ -36,5 +36,15 @@ def _crps_compute(batch_size, diff: Array, ensemble_sum: Array) -> Array:
 
 
 def continuous_ranked_probability_score(preds, target) -> Array:
+    """Continuous ranked probability score.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import continuous_ranked_probability_score
+        >>> preds = jnp.asarray([[1.0, 2.0, 3.0], [2.0, 3.0, 4.0]])
+        >>> target = jnp.asarray([2.0, 3.0])
+        >>> continuous_ranked_probability_score(preds, target)
+        Array(0.22222224, dtype=float32)
+    """
     batch_size, diff, ensemble_sum = _crps_update(preds, target)
     return _crps_compute(batch_size, diff, ensemble_sum)

@@ -35,5 +35,15 @@ def _critical_success_index_compute(hits: Array, misses: Array, false_alarms: Ar
 
 
 def critical_success_index(preds, target, threshold: float, keep_sequence_dim: Optional[int] = None) -> Array:
+    """Critical success index.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import critical_success_index
+        >>> preds = jnp.asarray([0.2, 0.7, 0.9, 0.4])
+        >>> target = jnp.asarray([0.1, 0.8, 0.6, 0.7])
+        >>> critical_success_index(preds, target, 0.5)
+        Array(0.6666667, dtype=float32)
+    """
     hits, misses, false_alarms = _critical_success_index_update(preds, target, threshold, keep_sequence_dim)
     return _critical_success_index_compute(hits, misses, false_alarms)

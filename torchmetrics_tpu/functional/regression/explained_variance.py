@@ -59,6 +59,16 @@ def _explained_variance_compute(
 
 
 def explained_variance(preds, target, multioutput: str = "uniform_average") -> Array:
+    """Explained variance.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import explained_variance
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> explained_variance(preds, target)
+        Array(0.95717347, dtype=float32)
+    """
     if multioutput not in ALLOWED_MULTIOUTPUT:
         raise ValueError(f"Argument `multioutput` must be one of {ALLOWED_MULTIOUTPUT}, but got {multioutput}")
     num_obs, sum_error, ss_error, sum_target, ss_target = _explained_variance_update(preds, target)

@@ -139,7 +139,16 @@ def kendall_rank_corrcoef(
     t_test: bool = False,
     alternative: Optional[str] = "two-sided",
 ):
-    """Kendall's tau; returns ``tau`` or ``(tau, p_value)`` when ``t_test``."""
+    """Kendall's tau; returns ``tau`` or ``(tau, p_value)`` when ``t_test``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import kendall_rank_corrcoef
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> kendall_rank_corrcoef(preds, target)
+        Array(1., dtype=float32)
+    """
     if variant not in _ALLOWED_VARIANTS:
         raise ValueError(f"Argument `variant` is expected to be one of {_ALLOWED_VARIANTS}, but got {variant!r}")
     if not isinstance(t_test, bool):

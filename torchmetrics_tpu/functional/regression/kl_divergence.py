@@ -46,6 +46,16 @@ def _kld_compute(measures: Array, total, reduction: Optional[str] = "mean") -> A
 
 
 def kl_divergence(p, q, log_prob: bool = False, reduction: Optional[str] = "mean") -> Array:
+    """Kl divergence.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import kl_divergence
+        >>> p = jnp.asarray([[0.36, 0.48, 0.16]])
+        >>> q = jnp.asarray([[1/3, 1/3, 1/3]])
+        >>> kl_divergence(p, q)
+        Array(0.0852996, dtype=float32)
+    """
     measures, total = _kld_update(p, q, log_prob)
     return _kld_compute(measures, total, reduction)
 
@@ -72,5 +82,15 @@ def _jsd_compute(measures: Array, total, reduction: Optional[str] = "mean") -> A
 
 
 def jensen_shannon_divergence(p, q, log_prob: bool = False, reduction: Optional[str] = "mean") -> Array:
+    """Jensen shannon divergence.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import jensen_shannon_divergence
+        >>> p = jnp.asarray([[0.36, 0.48, 0.16]])
+        >>> q = jnp.asarray([[1/3, 1/3, 1/3]])
+        >>> jensen_shannon_divergence(p, q)
+        Array(0.02245985, dtype=float32)
+    """
     measures, total = _jsd_update(p, q, log_prob)
     return _jsd_compute(measures, total, reduction)

@@ -25,6 +25,16 @@ def _mean_squared_log_error_compute(sum_squared_log_error: Array, num_obs) -> Ar
 
 
 def mean_squared_log_error(preds, target) -> Array:
+    """Mean squared log error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import mean_squared_log_error
+        >>> preds = jnp.asarray([2.5, 1.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, 1.5, 2.0, 7.0])
+        >>> mean_squared_log_error(preds, target)
+        Array(0.02037413, dtype=float32)
+    """
     s, n = _mean_squared_log_error_update(preds, target)
     return _mean_squared_log_error_compute(s, n)
 
@@ -50,6 +60,16 @@ def _log_cosh_error_compute(sum_log_cosh_error: Array, num_obs) -> Array:
 
 
 def log_cosh_error(preds, target) -> Array:
+    """Log cosh error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import log_cosh_error
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> log_cosh_error(preds, target)
+        Array(0.16850246, dtype=float32)
+    """
     preds = jnp.asarray(preds)
     num_outputs = 1 if preds.ndim == 1 else preds.shape[1]
     s, n = _log_cosh_error_update(preds, target, num_outputs)

@@ -28,5 +28,15 @@ def _mean_absolute_error_compute(sum_abs_error: Array, num_obs) -> Array:
 
 
 def mean_absolute_error(preds, target, num_outputs: int = 1) -> Array:
+    """Mean absolute error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import mean_absolute_error
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> mean_absolute_error(preds, target)
+        Array(0.5, dtype=float32)
+    """
     sum_abs_error, num_obs = _mean_absolute_error_update(preds, target, num_outputs)
     return _mean_absolute_error_compute(sum_abs_error, num_obs)

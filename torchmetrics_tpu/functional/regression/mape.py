@@ -26,6 +26,16 @@ def _mean_absolute_percentage_error_compute(sum_abs_per_error: Array, num_obs) -
 
 
 def mean_absolute_percentage_error(preds, target) -> Array:
+    """Mean absolute percentage error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import mean_absolute_percentage_error
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> mean_absolute_percentage_error(preds, target)
+        Array(0.32738096, dtype=float32)
+    """
     s, n = _mean_absolute_percentage_error_update(preds, target)
     return _mean_absolute_percentage_error_compute(s, n)
 
@@ -43,6 +53,16 @@ def _symmetric_mean_absolute_percentage_error_compute(sum_abs_per_error: Array, 
 
 
 def symmetric_mean_absolute_percentage_error(preds, target) -> Array:
+    """Symmetric mean absolute percentage error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import symmetric_mean_absolute_percentage_error
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> symmetric_mean_absolute_percentage_error(preds, target)
+        Array(0.5787879, dtype=float32)
+    """
     s, n = _symmetric_mean_absolute_percentage_error_update(preds, target)
     return _symmetric_mean_absolute_percentage_error_compute(s, n)
 
@@ -59,5 +79,15 @@ def _weighted_mean_absolute_percentage_error_compute(sum_abs_error: Array, sum_s
 
 
 def weighted_mean_absolute_percentage_error(preds, target) -> Array:
+    """Weighted mean absolute percentage error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import weighted_mean_absolute_percentage_error
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> weighted_mean_absolute_percentage_error(preds, target)
+        Array(0.16, dtype=float32)
+    """
     sum_abs_error, sum_scale = _weighted_mean_absolute_percentage_error_update(preds, target)
     return _weighted_mean_absolute_percentage_error_compute(sum_abs_error, sum_scale)

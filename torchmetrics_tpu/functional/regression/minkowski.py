@@ -25,5 +25,15 @@ def _minkowski_distance_compute(distance: Array, p: float) -> Array:
 
 
 def minkowski_distance(preds, targets, p: float) -> Array:
+    """Minkowski distance.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import minkowski_distance
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> minkowski_distance(preds, target, p=3)
+        Array(1.0772173, dtype=float32)
+    """
     distance = _minkowski_distance_update(preds, targets, p)
     return _minkowski_distance_compute(distance, p)

@@ -31,6 +31,15 @@ def _mean_squared_error_compute(sum_squared_error: Array, num_obs, squared: bool
 
 
 def mean_squared_error(preds, target, squared: bool = True, num_outputs: int = 1) -> Array:
-    """MSE (or RMSE with ``squared=False``)."""
+    """MSE (or RMSE with ``squared=False``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import mean_squared_error
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> mean_squared_error(preds, target)
+        Array(0.375, dtype=float32)
+    """
     sum_squared_error, num_obs = _mean_squared_error_update(preds, target, num_outputs)
     return _mean_squared_error_compute(sum_squared_error, num_obs, squared)

@@ -36,6 +36,16 @@ def _normalized_root_mean_squared_error_compute(sum_squared_error: Array, num_ob
 
 
 def normalized_root_mean_squared_error(preds, target, normalization: str = "mean", num_outputs: int = 1) -> Array:
+    """Normalized root mean squared error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import normalized_root_mean_squared_error
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> normalized_root_mean_squared_error(preds, target)
+        Array(0.21299912, dtype=float32)
+    """
     if normalization not in _ALLOWED_NORM:
         raise ValueError(f"Argument `normalization` should be either 'mean', 'range', 'std' or 'l2', but got {normalization}")
     sum_squared_error, num_obs, denom = _normalized_root_mean_squared_error_update(preds, target, num_outputs, normalization)

@@ -127,7 +127,16 @@ def _final_aggregation(
 
 
 def pearson_corrcoef(preds, target) -> Array:
-    """One-shot Pearson correlation coefficient."""
+    """One-shot Pearson correlation coefficient.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import pearson_corrcoef
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> pearson_corrcoef(preds, target)
+        Array(0.98486954, dtype=float32)
+    """
     preds = jnp.asarray(preds)
     num_outputs = 1 if preds.ndim == 1 else preds.shape[-1]
     d = (num_outputs,) if num_outputs > 1 else ()

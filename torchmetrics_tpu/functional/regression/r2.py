@@ -71,6 +71,16 @@ def _r2_score_compute(
 
 
 def r2_score(preds, target, adjusted: int = 0, multioutput: str = "uniform_average") -> Array:
+    """2.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import r2_score
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> r2_score(preds, target)
+        Array(0.94860816, dtype=float32)
+    """
     sum_squared_obs, sum_obs, rss, num_obs = _r2_score_update(preds, target)
     if num_obs < 2:
         raise ValueError("Needs at least two samples to calculate r2 score.")
@@ -85,5 +95,15 @@ def _relative_squared_error_compute(sum_squared_obs: Array, sum_obs: Array, rss:
 
 
 def relative_squared_error(preds, target, squared: bool = True) -> Array:
+    """Relative squared error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import relative_squared_error
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> relative_squared_error(preds, target)
+        Array(0.05139186, dtype=float32)
+    """
     sum_squared_obs, sum_obs, rss, num_obs = _r2_score_update(preds, target)
     return _relative_squared_error_compute(sum_squared_obs, sum_obs, rss, num_obs, squared)

@@ -42,6 +42,16 @@ def _spearman_corrcoef_compute(preds: Array, target: Array, eps: float = 1e-6) -
 
 
 def spearman_corrcoef(preds, target) -> Array:
+    """Spearman corrcoef.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import spearman_corrcoef
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> spearman_corrcoef(preds, target)
+        Array(0.9999992, dtype=float32)
+    """
     preds = jnp.asarray(preds)
     num_outputs = 1 if preds.ndim == 1 else preds.shape[-1]
     preds, target = _spearman_corrcoef_update(preds, target, num_outputs)

@@ -50,5 +50,15 @@ def _tweedie_deviance_score_compute(sum_deviance_score: Array, num_observations:
 
 
 def tweedie_deviance_score(preds, targets, power: float = 0.0) -> Array:
+    """Tweedie deviance score.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import tweedie_deviance_score
+        >>> preds = jnp.asarray([2.5, 0.5, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, 0.5, 2.0, 7.0])
+        >>> tweedie_deviance_score(preds, target, power=1.5)
+        Array(0.0262022, dtype=float32)
+    """
     s, n = _tweedie_deviance_score_update(preds, targets, power)
     return _tweedie_deviance_score_compute(s, n)

@@ -39,21 +39,48 @@ def _as_row(preds, target, allow_non_binary_target=False):
 
 
 def retrieval_average_precision(preds, target, top_k: Optional[int] = None) -> Array:
-    """AP of one query (reference functional/retrieval/average_precision.py:16)."""
+    """AP of one query (reference functional/retrieval/average_precision.py:16).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import retrieval_average_precision
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1])
+        >>> target = jnp.asarray([False, True, True, False])
+        >>> retrieval_average_precision(preds, target)
+        Array(1., dtype=float32)
+    """
     _validate_top_k(top_k)
     p, t, m = _as_row(preds, target)
     return _ap_kernel(p, t, m, top_k)[0]
 
 
 def retrieval_reciprocal_rank(preds, target, top_k: Optional[int] = None) -> Array:
-    """RR of one query (reference functional/retrieval/reciprocal_rank.py:16)."""
+    """RR of one query (reference functional/retrieval/reciprocal_rank.py:16).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import retrieval_reciprocal_rank
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1])
+        >>> target = jnp.asarray([False, True, True, False])
+        >>> retrieval_reciprocal_rank(preds, target)
+        Array(1., dtype=float32, weak_type=True)
+    """
     _validate_top_k(top_k)
     p, t, m = _as_row(preds, target)
     return _rr_kernel(p, t, m, top_k)[0]
 
 
 def retrieval_precision(preds, target, top_k: Optional[int] = None, adaptive_k: bool = False) -> Array:
-    """Precision@k of one query (reference functional/retrieval/precision.py:20)."""
+    """Precision@k of one query (reference functional/retrieval/precision.py:20).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import retrieval_precision
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1])
+        >>> target = jnp.asarray([False, True, True, False])
+        >>> retrieval_precision(preds, target, top_k=2)
+        Array(1., dtype=float32)
+    """
     if not isinstance(adaptive_k, bool):
         raise ValueError("`adaptive_k` has to be a boolean")
     _validate_top_k(top_k)
@@ -62,34 +89,79 @@ def retrieval_precision(preds, target, top_k: Optional[int] = None, adaptive_k: 
 
 
 def retrieval_recall(preds, target, top_k: Optional[int] = None) -> Array:
-    """Recall@k of one query (reference functional/retrieval/recall.py:20)."""
+    """Recall@k of one query (reference functional/retrieval/recall.py:20).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import retrieval_recall
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1])
+        >>> target = jnp.asarray([False, True, True, False])
+        >>> retrieval_recall(preds, target, top_k=2)
+        Array(1., dtype=float32)
+    """
     _validate_top_k(top_k)
     p, t, m = _as_row(preds, target)
     return _recall_kernel(p, t, m, top_k)[0]
 
 
 def retrieval_hit_rate(preds, target, top_k: Optional[int] = None) -> Array:
-    """HitRate@k of one query (reference functional/retrieval/hit_rate.py:20)."""
+    """HitRate@k of one query (reference functional/retrieval/hit_rate.py:20).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import retrieval_hit_rate
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1])
+        >>> target = jnp.asarray([False, True, True, False])
+        >>> retrieval_hit_rate(preds, target, top_k=2)
+        Array(1., dtype=float32)
+    """
     _validate_top_k(top_k)
     p, t, m = _as_row(preds, target)
     return _hit_rate_kernel(p, t, m, top_k)[0]
 
 
 def retrieval_fall_out(preds, target, top_k: Optional[int] = None) -> Array:
-    """FallOut@k of one query (reference functional/retrieval/fall_out.py:20)."""
+    """FallOut@k of one query (reference functional/retrieval/fall_out.py:20).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import retrieval_fall_out
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1])
+        >>> target = jnp.asarray([False, True, True, False])
+        >>> retrieval_fall_out(preds, target, top_k=2)
+        Array(0., dtype=float32)
+    """
     _validate_top_k(top_k)
     p, t, m = _as_row(preds, target)
     return _fall_out_kernel(p, t, m, top_k)[0]
 
 
 def retrieval_r_precision(preds, target) -> Array:
-    """R-Precision of one query (reference functional/retrieval/r_precision.py:16)."""
+    """R-Precision of one query (reference functional/retrieval/r_precision.py:16).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import retrieval_r_precision
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1])
+        >>> target = jnp.asarray([False, True, True, False])
+        >>> retrieval_r_precision(preds, target)
+        Array(1., dtype=float32)
+    """
     p, t, m = _as_row(preds, target)
     return _r_precision_kernel(p, t, m)[0]
 
 
 def retrieval_normalized_dcg(preds, target, top_k: Optional[int] = None) -> Array:
-    """NDCG of one query; non-binary gains allowed (reference functional/retrieval/ndcg.py)."""
+    """NDCG of one query; non-binary gains allowed (reference functional/retrieval/ndcg.py).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import retrieval_normalized_dcg
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1])
+        >>> target = jnp.asarray([False, True, True, False])
+        >>> retrieval_normalized_dcg(preds, target)
+        Array(1., dtype=float32)
+    """
     _validate_top_k(top_k)
     p, t, m = _as_row(preds, target, allow_non_binary_target=True)
     return _ndcg_kernel(p, t, m, top_k)[0]

@@ -100,7 +100,16 @@ def dice_score(
     input_format: str = "one-hot",
     aggregation_level: Optional[str] = "samplewise",
 ) -> Array:
-    """Compute the Dice score for semantic segmentation (reference dice.py:105)."""
+    """Compute the Dice score for semantic segmentation (reference dice.py:105).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import dice_score
+        >>> preds = jnp.asarray([[[0, 1, 1, 0], [1, 1, 0, 0], [2, 2, 1, 0], [2, 0, 0, 0]]])
+        >>> target = jnp.asarray([[[0, 1, 1, 0], [1, 0, 0, 0], [2, 2, 0, 0], [2, 2, 0, 0]]])
+        >>> dice_score(preds, target, num_classes=3, input_format='index')
+        Array([0.8102241], dtype=float32)
+    """
     _dice_score_validate_args(num_classes, include_background, average, input_format, aggregation_level)
     numerator, denominator, support = _dice_score_update(preds, target, num_classes, include_background, input_format)
     return _dice_score_compute(numerator, denominator, average, aggregation_level=aggregation_level, support=support)

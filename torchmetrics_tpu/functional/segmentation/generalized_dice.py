@@ -92,7 +92,16 @@ def generalized_dice_score(
     weight_type: str = "square",
     input_format: str = "one-hot",
 ) -> Array:
-    """Generalized Dice Score (reference generalized_dice.py:96)."""
+    """Generalized Dice Score (reference generalized_dice.py:96).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import generalized_dice_score
+        >>> preds = jnp.asarray([[[0, 1, 1, 0], [1, 1, 0, 0], [2, 2, 1, 0], [2, 0, 0, 0]]])
+        >>> target = jnp.asarray([[[0, 1, 1, 0], [1, 0, 0, 0], [2, 2, 0, 0], [2, 2, 0, 0]]])
+        >>> generalized_dice_score(preds, target, num_classes=3, input_format='index')
+        Array([0.7905575], dtype=float32)
+    """
     _generalized_dice_validate_args(num_classes, include_background, per_class, weight_type, input_format)
     numerator, denominator = _generalized_dice_update(
         preds, target, num_classes, include_background, weight_type, input_format

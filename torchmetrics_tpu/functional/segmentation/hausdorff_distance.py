@@ -53,7 +53,16 @@ def hausdorff_distance(
     directed: bool = False,
     input_format: str = "one-hot",
 ) -> Array:
-    """Hausdorff distance per (sample, class): ``(N, C)`` (reference hausdorff_distance.py:50)."""
+    """Hausdorff distance per (sample, class): ``(N, C)`` (reference hausdorff_distance.py:50).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import hausdorff_distance
+        >>> preds = jnp.asarray([[[0, 1, 1, 0], [1, 1, 0, 0], [2, 2, 1, 0], [2, 0, 0, 0]]])
+        >>> target = jnp.asarray([[[0, 1, 1, 0], [1, 0, 0, 0], [2, 2, 0, 0], [2, 2, 0, 0]]])
+        >>> hausdorff_distance(preds, target, num_classes=3, input_format='index')
+        Array([[2., 1.]], dtype=float32)
+    """
     _hausdorff_distance_validate_args(num_classes, include_background, distance_metric, spacing, directed, input_format)
     preds, target = _segmentation_inputs_format(preds, target, include_background, num_classes, input_format)
     if directed:

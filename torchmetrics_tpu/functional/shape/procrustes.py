@@ -19,7 +19,16 @@ def procrustes_disparity(
 ) -> Union[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]]:
     """Batched Procrustes analysis (scipy.spatial.procrustes semantics over a leading
     batch axis). Returns per-sample disparity, plus scale and rotation when
-    ``return_all=True``."""
+    ``return_all=True``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import procrustes_disparity
+        >>> point_set1 = jnp.asarray([[[0.0, 0.0], [1.0, 0.0], [1.0, 1.0], [0.0, 1.0]]])
+        >>> point_set2 = jnp.asarray([[[0.0, 0.0], [2.0, 0.0], [2.0, 2.0], [0.0, 2.0]]])
+        >>> procrustes_disparity(point_set1, point_set2)
+        Array([7.1054274e-15], dtype=float32)
+    """
     point_cloud1 = jnp.asarray(point_cloud1, jnp.float32)
     point_cloud2 = jnp.asarray(point_cloud2, jnp.float32)
     _check_same_shape(point_cloud1, point_cloud2)

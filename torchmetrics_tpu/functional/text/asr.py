@@ -42,7 +42,16 @@ def _cer_compute(errors, total):
 
 
 def char_error_rate(preds: TextInput, target: TextInput) -> jnp.ndarray:
-    """CER = character edit distance / reference characters."""
+    """CER = character edit distance / reference characters.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import char_error_rate
+        >>> preds = ['this is the prediction']
+        >>> target = ['this is the reference']
+        >>> char_error_rate(preds, target)
+        Array(0.3809524, dtype=float32, weak_type=True)
+    """
     return _cer_compute(*_cer_update(preds, target))
 
 
@@ -56,7 +65,16 @@ def _wer_compute(errors, total):
 
 
 def word_error_rate(preds: TextInput, target: TextInput) -> jnp.ndarray:
-    """WER = word edit distance / reference words."""
+    """WER = word edit distance / reference words.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import word_error_rate
+        >>> preds = ['this is the prediction']
+        >>> target = ['this is the reference']
+        >>> word_error_rate(preds, target)
+        Array(0.25, dtype=float32, weak_type=True)
+    """
     return _wer_compute(*_wer_update(preds, target))
 
 
@@ -70,7 +88,16 @@ def _mer_compute(errors, total):
 
 
 def match_error_rate(preds: TextInput, target: TextInput) -> jnp.ndarray:
-    """MER = word edit distance / max(reference, prediction) words."""
+    """MER = word edit distance / max(reference, prediction) words.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import match_error_rate
+        >>> preds = ['this is the prediction']
+        >>> target = ['this is the reference']
+        >>> match_error_rate(preds, target)
+        Array(0.25, dtype=float32, weak_type=True)
+    """
     return _mer_compute(*_mer_update(preds, target))
 
 
@@ -86,7 +113,16 @@ def _wil_compute(errors, target_total, preds_total):
 
 
 def word_information_lost(preds: TextInput, target: TextInput) -> jnp.ndarray:
-    """WIL = 1 - hit-rate product over reference and prediction lengths."""
+    """WIL = 1 - hit-rate product over reference and prediction lengths.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import word_information_lost
+        >>> preds = ['this is the prediction']
+        >>> target = ['this is the reference']
+        >>> word_information_lost(preds, target)
+        Array(0.4375, dtype=float32, weak_type=True)
+    """
     return _wil_compute(*_wil_wip_update(preds, target))
 
 
@@ -95,5 +131,14 @@ def _wip_compute(errors, target_total, preds_total):
 
 
 def word_information_preserved(preds: TextInput, target: TextInput) -> jnp.ndarray:
-    """WIP = hit-rate product over reference and prediction lengths."""
+    """WIP = hit-rate product over reference and prediction lengths.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import word_information_preserved
+        >>> preds = ['this is the prediction']
+        >>> target = ['this is the reference']
+        >>> word_information_preserved(preds, target)
+        Array(0.5625, dtype=float32, weak_type=True)
+    """
     return _wip_compute(*_wil_wip_update(preds, target))

@@ -82,7 +82,16 @@ def bleu_score(
     smooth: bool = False,
     weights: Optional[Sequence[float]] = None,
 ) -> jnp.ndarray:
-    """Corpus BLEU of machine-translated text against one or more references."""
+    """Corpus BLEU of machine-translated text against one or more references.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import bleu_score
+        >>> preds = ['the cat is on the mat']
+        >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
+        >>> bleu_score(preds, target)
+        Array(0.75983566, dtype=float32)
+    """
     preds_ = [preds] if isinstance(preds, str) else preds
     target_ = [[tgt] if isinstance(tgt, str) else tgt for tgt in target]
     if len(preds_) != len(target_):

@@ -153,7 +153,16 @@ def chrf_score(
     return_sentence_level_score: bool = False,
 ) -> Union[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray]]:
     """chrF (``n_word_order=0``) / chrF++ (default) score against the best-matching
-    reference per sentence."""
+    reference per sentence.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import chrf_score
+        >>> preds = ['the cat is on the mat']
+        >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
+        >>> chrf_score(preds, target)
+        Array(0.86404645, dtype=float32)
+    """
     _validate_chrf_args(n_char_order, n_word_order, beta)
     n_order = float(n_char_order + n_word_order)
     *totals, sentence_scores = _chrf_score_update(

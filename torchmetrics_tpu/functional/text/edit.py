@@ -54,6 +54,13 @@ def edit_distance(
     substitution_cost: int = 1,
     reduction: Optional[str] = "mean",
 ) -> jnp.ndarray:
-    """Character-level Levenshtein distance with configurable substitution cost."""
+    """Character-level Levenshtein distance with configurable substitution cost.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import edit_distance
+        >>> edit_distance(['rain'], ['shine'])
+        Array(3., dtype=float32)
+    """
     distance = _edit_distance_update(preds, target, substitution_cost)
     return _edit_distance_compute(distance, num_elements=distance.size, reduction=reduction)

@@ -127,7 +127,15 @@ def extended_edit_distance(
     deletion: float = 0.2,
     insertion: float = 1.0,
 ) -> Union[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray]]:
-    """Corpus EED averaged over sentence-level best-reference scores."""
+    """Corpus EED averaged over sentence-level best-reference scores.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import extended_edit_distance
+        >>> preds = ['this is the prediction']
+        >>> extended_edit_distance(preds, [['this is the reference']])
+        Array(0.38345864, dtype=float32)
+    """
     for name, val in (("alpha", alpha), ("rho", rho), ("deletion", deletion), ("insertion", insertion)):
         if not isinstance(val, float) or val < 0:
             raise ValueError(f"Parameter `{name}` is expected to be a non-negative float.")

@@ -55,6 +55,15 @@ def _perplexity_compute(total, count) -> jnp.ndarray:
 
 
 def perplexity(preds, target, ignore_index: Optional[int] = None) -> jnp.ndarray:
-    """exp of the mean negative log-likelihood of the target tokens under ``preds``."""
+    """exp of the mean negative log-likelihood of the target tokens under ``preds``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import perplexity
+        >>> preds = jnp.asarray([[[0.2, 0.4, 0.4], [0.5, 0.2, 0.3]]])
+        >>> target = jnp.asarray([[1, 0]])
+        >>> perplexity(jnp.log(preds), target)
+        Array(2.236068, dtype=float32)
+    """
     total, count = _perplexity_update(preds, target, ignore_index)
     return _perplexity_compute(total, count)

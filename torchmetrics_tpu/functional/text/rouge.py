@@ -238,7 +238,14 @@ def rouge_score(
     tokenizer: Optional[Callable[[str], Sequence[str]]] = None,
     rouge_keys: Union[str, Tuple[str, ...]] = ("rouge1", "rouge2", "rougeL", "rougeLsum"),
 ) -> Dict[str, jnp.ndarray]:
-    """ROUGE-N/L/Lsum precision/recall/F over the best (or averaged) reference."""
+    """ROUGE-N/L/Lsum precision/recall/F over the best (or averaged) reference.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import rouge_score
+        >>> {k: round(float(v), 4) for k, v in rouge_score(['the cat is on the mat'], [['a cat is on the mat']], rouge_keys='rouge1').items()}
+        {'rouge1_fmeasure': 0.8333, 'rouge1_precision': 0.8333, 'rouge1_recall': 0.8333}
+    """
     if accumulate not in ALLOWED_ACCUMULATE_VALUES:
         raise ValueError(
             f"Got unknown accumulate value {accumulate}. Expected to be one of {ALLOWED_ACCUMULATE_VALUES}"

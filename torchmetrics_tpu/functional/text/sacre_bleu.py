@@ -133,7 +133,16 @@ def sacre_bleu_score(
     lowercase: bool = False,
     weights: Optional[Sequence[float]] = None,
 ) -> jnp.ndarray:
-    """BLEU with sacrebleu's standardized tokenization pipeline."""
+    """BLEU with sacrebleu's standardized tokenization pipeline.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import sacre_bleu_score
+        >>> preds = ['the cat is on the mat']
+        >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
+        >>> sacre_bleu_score(preds, target)
+        Array(0.75983566, dtype=float32)
+    """
     target_ = [[tgt] if isinstance(tgt, str) else tgt for tgt in target]
     if len(preds) != len(target_):
         raise ValueError(f"Corpus has different size {len(preds)} != {len(target_)}")

@@ -110,7 +110,16 @@ def _squad_compute(f1, exact_match, total) -> Dict[str, jnp.ndarray]:
 
 
 def squad(preds, target) -> Dict[str, jnp.ndarray]:
-    """SQuAD v1 exact-match and token-F1 over prediction/target answer dicts."""
+    """SQuAD v1 exact-match and token-F1 over prediction/target answer dicts.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import squad
+        >>> preds = [{'prediction_text': '1976', 'id': '56e1'}]
+        >>> target = [{'answers': {'answer_start': [97], 'text': ['1976']}, 'id': '56e1'}]
+        >>> {k: round(float(v), 4) for k, v in squad(preds, target).items()}
+        {'exact_match': 100.0, 'f1': 100.0}
+    """
     preds_dict, target_dict = _squad_input_check(preds, target)
     f1, exact_match, total = _squad_update(preds_dict, target_dict)
     return _squad_compute(f1, exact_match, total)

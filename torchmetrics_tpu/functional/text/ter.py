@@ -338,7 +338,16 @@ def translation_edit_rate(
     asian_support: bool = False,
     return_sentence_level_score: bool = False,
 ) -> Union[jnp.ndarray, Tuple[jnp.ndarray, jnp.ndarray]]:
-    """Corpus TER (Tercom/sacrebleu-compatible block-shift edit rate)."""
+    """Corpus TER (Tercom/sacrebleu-compatible block-shift edit rate).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional import translation_edit_rate
+        >>> preds = ['the cat is on the mat']
+        >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
+        >>> translation_edit_rate(preds, target)
+        Array(0.15384616, dtype=float32)
+    """
     for name, val in (
         ("normalize", normalize), ("no_punctuation", no_punctuation),
         ("lowercase", lowercase), ("asian_support", asian_support),

@@ -32,6 +32,20 @@ class FrechetInceptionDistance(Metric):
 
     ``feature`` is the 2048-d in-tree InceptionV3 (int, converted weights required for
     meaningful values) or any callable ``imgs -> (N, F)`` — e.g. a jitted flax apply.
+
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.image import FrechetInceptionDistance
+        >>> def tiny_extractor(imgs):
+        ...     return imgs.reshape(imgs.shape[0], -1)[:, :8].astype(jnp.float32)
+        >>> metric = FrechetInceptionDistance(feature=tiny_extractor, normalize=True)
+        >>> imgs_real = (jnp.arange(2 * 3 * 16 * 16, dtype=jnp.float32).reshape(2, 3, 16, 16) * 37 % 97) / 97
+        >>> imgs_fake = (jnp.arange(2 * 3 * 16 * 16, dtype=jnp.float32).reshape(2, 3, 16, 16) * 31 % 89) / 89
+        >>> metric.update(imgs_real, real=True)
+        >>> metric.update(imgs_fake, real=False)
+        >>> round(float(metric.compute()), 4)
+        1.4741
     """
 
     is_differentiable = False

@@ -29,7 +29,18 @@ from ..metric import HostMetric, Metric
 
 class UniversalImageQualityIndex(Metric):
     """UQI (reference ``image/uqi.py:31``). Mean/sum reductions fold into two scalar
-    states; ``reduction='none'`` stores raw images (per-pixel map output)."""
+    states; ``reduction='none'`` stores raw images (per-pixel map output).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.image import UniversalImageQualityIndex
+        >>> preds = (jnp.arange(768, dtype=jnp.float32).reshape(1, 3, 16, 16) * 37 % 97) / 97
+        >>> target = (jnp.arange(768, dtype=jnp.float32).reshape(1, 3, 16, 16) * 31 % 89) / 89
+        >>> metric = UniversalImageQualityIndex()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.05859956, dtype=float32)
+    """
 
     is_differentiable = True
     higher_is_better = True
@@ -74,7 +85,18 @@ class UniversalImageQualityIndex(Metric):
 
 
 class VisualInformationFidelity(Metric):
-    """VIF (reference ``image/vif.py:25``) — per-batch scores concatenate."""
+    """VIF (reference ``image/vif.py:25``) — per-batch scores concatenate.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.image import VisualInformationFidelity
+        >>> preds = (jnp.arange(3 * 48 * 48, dtype=jnp.float32).reshape(1, 3, 48, 48) * 37 % 97) / 97
+        >>> target = (jnp.arange(3 * 48 * 48, dtype=jnp.float32).reshape(1, 3, 48, 48) * 31 % 89) / 89
+        >>> metric = VisualInformationFidelity()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.00125213, dtype=float32)
+    """
 
     is_differentiable = True
     higher_is_better = True
@@ -103,7 +125,17 @@ class VisualInformationFidelity(Metric):
 
 
 class TotalVariation(Metric):
-    """Total variation (reference ``image/tv.py:31``)."""
+    """Total variation (reference ``image/tv.py:31``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.image import TotalVariation
+        >>> preds = (jnp.arange(48, dtype=jnp.float32).reshape(1, 3, 4, 4) * 37 % 97) / 97
+        >>> metric = TotalVariation()
+        >>> metric.update(preds)
+        >>> metric.compute()
+        Array(34.62887, dtype=float32)
+    """
 
     is_differentiable = True
     higher_is_better = False
@@ -134,7 +166,18 @@ class TotalVariation(Metric):
 
 
 class SpectralAngleMapper(Metric):
-    """SAM (reference ``image/sam.py:31``)."""
+    """SAM (reference ``image/sam.py:31``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.image import SpectralAngleMapper
+        >>> preds = (jnp.arange(48, dtype=jnp.float32).reshape(1, 3, 4, 4) * 37 % 97) / 97
+        >>> target = (jnp.arange(48, dtype=jnp.float32).reshape(1, 3, 4, 4) * 31 % 89) / 89
+        >>> metric = SpectralAngleMapper()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.6083105, dtype=float32)
+    """
 
     is_differentiable = True
     higher_is_better = False
@@ -170,7 +213,18 @@ class SpectralAngleMapper(Metric):
 
 
 class SpatialCorrelationCoefficient(Metric):
-    """SCC (reference ``image/scc.py:24``) — two scalar sum states."""
+    """SCC (reference ``image/scc.py:24``) — two scalar sum states.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.image import SpatialCorrelationCoefficient
+        >>> preds = (jnp.arange(768, dtype=jnp.float32).reshape(1, 3, 16, 16) * 37 % 97) / 97
+        >>> target = (jnp.arange(768, dtype=jnp.float32).reshape(1, 3, 16, 16) * 31 % 89) / 89
+        >>> metric = SpatialCorrelationCoefficient()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(-0.03273272, dtype=float32)
+    """
 
     is_differentiable = True
     higher_is_better = True
@@ -200,7 +254,18 @@ class SpatialCorrelationCoefficient(Metric):
 
 
 class ErrorRelativeGlobalDimensionlessSynthesis(Metric):
-    """ERGAS (reference ``image/ergas.py:32``) — cat states of raw images."""
+    """ERGAS (reference ``image/ergas.py:32``) — cat states of raw images.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.image import ErrorRelativeGlobalDimensionlessSynthesis
+        >>> preds = (jnp.arange(48, dtype=jnp.float32).reshape(1, 3, 4, 4) * 37 % 97) / 97
+        >>> target = (jnp.arange(48, dtype=jnp.float32).reshape(1, 3, 4, 4) * 31 % 89) / 89
+        >>> metric = ErrorRelativeGlobalDimensionlessSynthesis()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(21.296127, dtype=float32)
+    """
 
     is_differentiable = True
     higher_is_better = False
@@ -228,7 +293,18 @@ class ErrorRelativeGlobalDimensionlessSynthesis(Metric):
 
 class RelativeAverageSpectralError(Metric):
     """RASE (reference ``image/rase.py:30``) — cat states (the per-window statistic
-    depends on the global target mean)."""
+    depends on the global target mean).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.image import RelativeAverageSpectralError
+        >>> preds = (jnp.arange(768, dtype=jnp.float32).reshape(1, 3, 16, 16) * 37 % 97) / 97
+        >>> target = (jnp.arange(768, dtype=jnp.float32).reshape(1, 3, 16, 16) * 31 % 89) / 89
+        >>> metric = RelativeAverageSpectralError()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(5315.8857, dtype=float32)
+    """
 
     is_differentiable = True
     higher_is_better = False
@@ -260,7 +336,18 @@ class RelativeAverageSpectralError(Metric):
 
 
 class RootMeanSquaredErrorUsingSlidingWindow(Metric):
-    """RMSE-SW (reference ``image/rmse_sw.py:30``) — two scalar sum states."""
+    """RMSE-SW (reference ``image/rmse_sw.py:30``) — two scalar sum states.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.image import RootMeanSquaredErrorUsingSlidingWindow
+        >>> preds = (jnp.arange(768, dtype=jnp.float32).reshape(1, 3, 16, 16) * 37 % 97) / 97
+        >>> target = (jnp.arange(768, dtype=jnp.float32).reshape(1, 3, 16, 16) * 31 % 89) / 89
+        >>> metric = RootMeanSquaredErrorUsingSlidingWindow()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.40987822, dtype=float32)
+    """
 
     is_differentiable = True
     higher_is_better = False

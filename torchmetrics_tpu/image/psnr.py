@@ -15,7 +15,18 @@ from ..utilities.prints import rank_zero_warn
 
 class PeakSignalNoiseRatio(Metric):
     """PSNR over accumulated squared error. ``dim=None`` keeps two scalar sum states;
-    with ``dim`` set, per-update error tensors are concatenated (cat states)."""
+    with ``dim`` set, per-update error tensors are concatenated (cat states).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.image import PeakSignalNoiseRatio
+        >>> preds = jnp.asarray([[0.0, 1.0], [2.0, 3.0]])
+        >>> target = jnp.asarray([[3.0, 2.0], [1.0, 0.0]])
+        >>> metric = PeakSignalNoiseRatio(data_range=3.0)
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(2.552725, dtype=float32)
+    """
 
     is_differentiable = True
     higher_is_better = True

@@ -12,7 +12,18 @@ from ..metric import Metric
 
 
 class PeakSignalNoiseRatioWithBlockedEffect(Metric):
-    """PSNR-B over three scalar sum states (squared error, block effect, count)."""
+    """PSNR-B over three scalar sum states (squared error, block effect, count).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.image import PeakSignalNoiseRatioWithBlockedEffect
+        >>> preds = (jnp.arange(256, dtype=jnp.float32).reshape(1, 1, 16, 16) * 37 % 97) / 97
+        >>> target = (jnp.arange(256, dtype=jnp.float32).reshape(1, 1, 16, 16) * 31 % 89) / 89
+        >>> metric = PeakSignalNoiseRatioWithBlockedEffect(data_range=1.0, block_size=8)
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(7.6286135, dtype=float32)
+    """
 
     is_differentiable = True
     higher_is_better = True

@@ -13,7 +13,18 @@ from ..metric import Metric
 
 class StructuralSimilarityIndexMeasure(Metric):
     """SSIM. With mean/sum reduction: two scalar sum states; with ``reduction='none'``:
-    per-sample scores concatenate (cat state)."""
+    per-sample scores concatenate (cat state).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.image import StructuralSimilarityIndexMeasure
+        >>> preds = (jnp.arange(768, dtype=jnp.float32).reshape(1, 3, 16, 16) * 37 % 97) / 97
+        >>> target = (jnp.arange(768, dtype=jnp.float32).reshape(1, 3, 16, 16) * 31 % 89) / 89
+        >>> metric = StructuralSimilarityIndexMeasure(data_range=1.0)
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(-0.02576008, dtype=float32)
+    """
 
     is_differentiable = True
     higher_is_better = True
@@ -91,7 +102,18 @@ class StructuralSimilarityIndexMeasure(Metric):
 
 
 class MultiScaleStructuralSimilarityIndexMeasure(Metric):
-    """MS-SSIM with the same reduction-dependent state layout as SSIM."""
+    """MS-SSIM with the same reduction-dependent state layout as SSIM.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.image import MultiScaleStructuralSimilarityIndexMeasure
+        >>> preds = (jnp.arange(3 * 180 * 180, dtype=jnp.float32).reshape(1, 3, 180, 180) * 37 % 97) / 97
+        >>> target = (jnp.arange(3 * 180 * 180, dtype=jnp.float32).reshape(1, 3, 180, 180) * 31 % 89) / 89
+        >>> metric = MultiScaleStructuralSimilarityIndexMeasure(data_range=1.0)
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.14033246, dtype=float32)
+    """
 
     is_differentiable = True
     higher_is_better = True

@@ -11,7 +11,18 @@ from ..metric import Metric
 
 
 class LipVertexError(Metric):
-    """Running-mean LVE over update calls (sum + count states)."""
+    """Running-mean LVE over update calls (sum + count states).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.multimodal import LipVertexError
+        >>> vertices_pred = (jnp.arange(90, dtype=jnp.float32).reshape(5, 6, 3) * 37 % 19) / 19
+        >>> vertices_gt = (jnp.arange(90, dtype=jnp.float32).reshape(5, 6, 3) * 31 % 17) / 17
+        >>> metric = LipVertexError(mouth_map=[1, 2, 3])
+        >>> metric.update(vertices_pred, vertices_gt)
+        >>> metric.compute()
+        Array(0.9050102, dtype=float32)
+    """
 
     is_differentiable = False
     higher_is_better = False

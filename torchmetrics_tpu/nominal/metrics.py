@@ -61,7 +61,18 @@ class _ContingencyMetric(Metric):
 
 
 class CramersV(_ContingencyMetric):
-    """Cramer's V association statistic (reference ``nominal/cramers.py:31``)."""
+    """Cramer's V association statistic (reference ``nominal/cramers.py:31``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.nominal import CramersV
+        >>> preds = jnp.asarray([0, 1, 2, 2, 1, 0, 1, 2, 1, 0])
+        >>> target = jnp.asarray([0, 1, 2, 1, 1, 0, 2, 2, 1, 0])
+        >>> metric = CramersV(num_classes=3)
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.6846532, dtype=float32)
+    """
 
     def __init__(
         self,
@@ -79,21 +90,54 @@ class CramersV(_ContingencyMetric):
 
 
 class PearsonsContingencyCoefficient(_ContingencyMetric):
-    """Pearson's contingency coefficient (reference ``nominal/pearson.py:34``)."""
+    """Pearson's contingency coefficient (reference ``nominal/pearson.py:34``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.nominal import PearsonsContingencyCoefficient
+        >>> preds = jnp.asarray([0, 1, 2, 2, 1, 0, 1, 2, 1, 0])
+        >>> target = jnp.asarray([0, 1, 2, 1, 1, 0, 2, 2, 1, 0])
+        >>> metric = PearsonsContingencyCoefficient(num_classes=3)
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.73480344, dtype=float32)
+    """
 
     def _compute(self, state):
         return _pearsons_contingency_coefficient_compute(state["confmat"])
 
 
 class TheilsU(_ContingencyMetric):
-    """Theil's U uncertainty coefficient (reference ``nominal/theils_u.py:31``)."""
+    """Theil's U uncertainty coefficient (reference ``nominal/theils_u.py:31``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.nominal import TheilsU
+        >>> preds = jnp.asarray([0, 1, 2, 2, 1, 0, 1, 2, 1, 0])
+        >>> target = jnp.asarray([0, 1, 2, 1, 1, 0, 2, 2, 1, 0])
+        >>> metric = TheilsU(num_classes=3)
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.61806566, dtype=float32)
+    """
 
     def _compute(self, state):
         return _theils_u_compute(state["confmat"])
 
 
 class TschuprowsT(_ContingencyMetric):
-    """Tschuprow's T association statistic (reference ``nominal/tschuprows.py:31``)."""
+    """Tschuprow's T association statistic (reference ``nominal/tschuprows.py:31``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.nominal import TschuprowsT
+        >>> preds = jnp.asarray([0, 1, 2, 2, 1, 0, 1, 2, 1, 0])
+        >>> target = jnp.asarray([0, 1, 2, 1, 1, 0, 2, 2, 1, 0])
+        >>> metric = TschuprowsT(num_classes=3)
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.6846532, dtype=float32)
+    """
 
     def __init__(
         self,
@@ -116,6 +160,16 @@ class FleissKappa(Metric):
     The per-sample counts table is a cat state — kappa is not decomposable into
     fixed-size sufficient statistics because the rater normalization depends on the
     global max rater count.
+
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.nominal import FleissKappa
+        >>> ratings = jnp.asarray([[0, 4, 1], [2, 2, 1], [4, 0, 1], [1, 3, 1]])
+        >>> metric = FleissKappa(mode='counts')
+        >>> metric.update(ratings)
+        >>> metric.compute()
+        Array(0.09448675, dtype=float32)
     """
 
     is_differentiable = False

@@ -15,7 +15,18 @@ from ..metric import Metric
 
 class ContinuousRankedProbabilityScore(Metric):
     """Reference regression/crps.py:29. Sum-state formulation: mean(diff−spread) over
-    all samples ≡ (Σdiff − Σspread)/N, so three scalar sum states suffice."""
+    all samples ≡ (Σdiff − Σspread)/N, so three scalar sum states suffice.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.regression import ContinuousRankedProbabilityScore
+        >>> preds = jnp.asarray([[1.0, 2.0, 3.0], [2.0, 3.0, 4.0]])
+        >>> target = jnp.asarray([2.0, 3.0])
+        >>> metric = ContinuousRankedProbabilityScore()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.22222224, dtype=float32)
+    """
 
     is_differentiable = True
     higher_is_better = False
@@ -41,7 +52,18 @@ class ContinuousRankedProbabilityScore(Metric):
 
 
 class CriticalSuccessIndex(Metric):
-    """Reference regression/csi.py:24."""
+    """Reference regression/csi.py:24.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.regression import CriticalSuccessIndex
+        >>> preds = jnp.asarray([0.2, 0.7, 0.9, 0.4])
+        >>> target = jnp.asarray([0.1, 0.8, 0.6, 0.7])
+        >>> metric = CriticalSuccessIndex(0.5)
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.6666667, dtype=float32)
+    """
 
     is_differentiable = False
     higher_is_better = True

@@ -55,14 +55,36 @@ class _DivergenceBase(Metric):
 
 
 class KLDivergence(_DivergenceBase):
-    """Reference regression/kl_divergence.py:31."""
+    """Reference regression/kl_divergence.py:31.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.regression import KLDivergence
+        >>> preds = jnp.asarray([[0.36, 0.48, 0.16]])
+        >>> target = jnp.asarray([[1/3, 1/3, 1/3]])
+        >>> metric = KLDivergence()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.0852996, dtype=float32)
+    """
 
     def _measures(self, p, q):
         return _kld_update(p, q, self.log_prob)
 
 
 class JensenShannonDivergence(_DivergenceBase):
-    """Reference regression/js_divergence.py:31."""
+    """Reference regression/js_divergence.py:31.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.regression import JensenShannonDivergence
+        >>> preds = jnp.asarray([[0.36, 0.48, 0.16]])
+        >>> target = jnp.asarray([[1/3, 1/3, 1/3]])
+        >>> metric = JensenShannonDivergence()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.02245985, dtype=float32)
+    """
 
     def _measures(self, p, q):
         return _jsd_update(p, q, self.log_prob)

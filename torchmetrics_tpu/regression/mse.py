@@ -35,7 +35,18 @@ from ..utilities.exceptions import TorchMetricsUserError
 
 
 class MeanSquaredError(Metric):
-    """MSE (or RMSE with ``squared=False``). Reference regression/mse.py:29."""
+    """MSE (or RMSE with ``squared=False``). Reference regression/mse.py:29.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.regression import MeanSquaredError
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> metric = MeanSquaredError()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.375, dtype=float32)
+    """
 
     is_differentiable = True
     higher_is_better = False
@@ -62,7 +73,18 @@ class MeanSquaredError(Metric):
 
 
 class MeanAbsoluteError(Metric):
-    """Reference regression/mae.py:29."""
+    """Reference regression/mae.py:29.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.regression import MeanAbsoluteError
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> metric = MeanAbsoluteError()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.5, dtype=float32)
+    """
 
     is_differentiable = True
     higher_is_better = False
@@ -86,7 +108,18 @@ class MeanAbsoluteError(Metric):
 
 
 class MeanSquaredLogError(Metric):
-    """Reference regression/log_mse.py:28."""
+    """Reference regression/log_mse.py:28.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.regression import MeanSquaredLogError
+        >>> preds = jnp.asarray([2.5, 1.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, 1.5, 2.0, 7.0])
+        >>> metric = MeanSquaredLogError()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.02037413, dtype=float32)
+    """
 
     is_differentiable = True
     higher_is_better = False
@@ -107,7 +140,18 @@ class MeanSquaredLogError(Metric):
 
 
 class MeanAbsolutePercentageError(Metric):
-    """Reference regression/mape.py:31."""
+    """Reference regression/mape.py:31.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.regression import MeanAbsolutePercentageError
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> metric = MeanAbsolutePercentageError()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.32738096, dtype=float32)
+    """
 
     is_differentiable = True
     higher_is_better = False
@@ -128,7 +172,18 @@ class MeanAbsolutePercentageError(Metric):
 
 
 class SymmetricMeanAbsolutePercentageError(Metric):
-    """Reference regression/symmetric_mape.py:31."""
+    """Reference regression/symmetric_mape.py:31.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.regression import SymmetricMeanAbsolutePercentageError
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> metric = SymmetricMeanAbsolutePercentageError()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.5787879, dtype=float32)
+    """
 
     is_differentiable = True
     higher_is_better = False
@@ -149,7 +204,18 @@ class SymmetricMeanAbsolutePercentageError(Metric):
 
 
 class WeightedMeanAbsolutePercentageError(Metric):
-    """Reference regression/wmape.py:32."""
+    """Reference regression/wmape.py:32.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.regression import WeightedMeanAbsolutePercentageError
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> metric = WeightedMeanAbsolutePercentageError()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.16, dtype=float32)
+    """
 
     is_differentiable = True
     higher_is_better = False
@@ -170,7 +236,18 @@ class WeightedMeanAbsolutePercentageError(Metric):
 
 
 class LogCoshError(Metric):
-    """Reference regression/log_cosh.py:29."""
+    """Reference regression/log_cosh.py:29.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.regression import LogCoshError
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> metric = LogCoshError()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.16850246, dtype=float32)
+    """
 
     is_differentiable = True
     higher_is_better = False
@@ -194,7 +271,18 @@ class LogCoshError(Metric):
 
 
 class MinkowskiDistance(Metric):
-    """Reference regression/minkowski.py:30."""
+    """Reference regression/minkowski.py:30.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.regression import MinkowskiDistance
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> metric = MinkowskiDistance(p=3)
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(1.0772173, dtype=float32)
+    """
 
     is_differentiable = True
     higher_is_better = False
@@ -216,7 +304,18 @@ class MinkowskiDistance(Metric):
 
 
 class TweedieDevianceScore(Metric):
-    """Reference regression/tweedie_deviance.py:32."""
+    """Reference regression/tweedie_deviance.py:32.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.regression import TweedieDevianceScore
+        >>> preds = jnp.asarray([2.5, 0.5, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, 0.5, 2.0, 7.0])
+        >>> metric = TweedieDevianceScore(power=1.5)
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.0262022, dtype=float32)
+    """
 
     is_differentiable = True
     higher_is_better = False

@@ -21,7 +21,18 @@ _KEYS = ("sum_squared_error", "total", "min_val", "max_val", "mean_val", "var_va
 
 
 class NormalizedRootMeanSquaredError(Metric):
-    """Reference regression/nrmse.py:95."""
+    """Reference regression/nrmse.py:95.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.regression import NormalizedRootMeanSquaredError
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> metric = NormalizedRootMeanSquaredError()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.21299912, dtype=float32)
+    """
 
     is_differentiable = True
     higher_is_better = False

@@ -80,7 +80,18 @@ class _MomentCorrelationBase(Metric):
 
 
 class PearsonCorrCoef(_MomentCorrelationBase):
-    """Reference regression/pearson.py:100."""
+    """Reference regression/pearson.py:100.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.regression import PearsonCorrCoef
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> metric = PearsonCorrCoef()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.98486954, dtype=float32)
+    """
 
     higher_is_better = None
 
@@ -92,7 +103,18 @@ class PearsonCorrCoef(_MomentCorrelationBase):
 
 
 class ConcordanceCorrCoef(_MomentCorrelationBase):
-    """Reference regression/concordance.py:28."""
+    """Reference regression/concordance.py:28.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.regression import ConcordanceCorrCoef
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> metric = ConcordanceCorrCoef()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.9777347, dtype=float32)
+    """
 
     higher_is_better = None
 

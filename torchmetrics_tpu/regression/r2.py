@@ -14,7 +14,18 @@ from ..metric import Metric
 
 
 class R2Score(Metric):
-    """Reference regression/r2.py:28."""
+    """Reference regression/r2.py:28.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.regression import R2Score
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> metric = R2Score()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.94860816, dtype=float32)
+    """
 
     is_differentiable = True
     higher_is_better = True
@@ -52,7 +63,18 @@ class R2Score(Metric):
 
 
 class RelativeSquaredError(Metric):
-    """Reference regression/rse.py:30."""
+    """Reference regression/rse.py:30.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.regression import RelativeSquaredError
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> metric = RelativeSquaredError()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.05139186, dtype=float32)
+    """
 
     is_differentiable = True
     higher_is_better = False
@@ -83,7 +105,18 @@ class RelativeSquaredError(Metric):
 
 
 class ExplainedVariance(Metric):
-    """Reference regression/explained_variance.py:33."""
+    """Reference regression/explained_variance.py:33.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.regression import ExplainedVariance
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> metric = ExplainedVariance()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.95717347, dtype=float32)
+    """
 
     is_differentiable = True
     higher_is_better = True

@@ -19,7 +19,18 @@ from ..metric import Metric
 
 
 class SpearmanCorrCoef(Metric):
-    """Reference regression/spearman.py:30."""
+    """Reference regression/spearman.py:30.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.regression import SpearmanCorrCoef
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> metric = SpearmanCorrCoef()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.9999992, dtype=float32)
+    """
 
     is_differentiable = False
     higher_is_better = True
@@ -44,7 +55,18 @@ class SpearmanCorrCoef(Metric):
 
 
 class KendallRankCorrCoef(Metric):
-    """Reference regression/kendall.py:36."""
+    """Reference regression/kendall.py:36.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.regression import KendallRankCorrCoef
+        >>> preds = jnp.asarray([2.5, 0.0, 2.0, 8.0])
+        >>> target = jnp.asarray([3.0, -0.5, 2.0, 7.0])
+        >>> metric = KendallRankCorrCoef()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(1., dtype=float32)
+    """
 
     is_differentiable = False
     higher_is_better = None
@@ -87,7 +109,18 @@ class KendallRankCorrCoef(Metric):
 
 
 class CosineSimilarity(Metric):
-    """Reference regression/cosine_similarity.py:30."""
+    """Reference regression/cosine_similarity.py:30.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.regression import CosineSimilarity
+        >>> preds = jnp.asarray([[1.0, 2.0, 3.0], [1.0, 0.0, 1.0]])
+        >>> target = jnp.asarray([[1.0, 2.0, 2.0], [0.5, 0.0, 1.0]])
+        >>> metric = CosineSimilarity(reduction='mean')
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.96432054, dtype=float32)
+    """
 
     is_differentiable = True
     higher_is_better = True

@@ -40,21 +40,57 @@ class _TopKRetrievalMetric(RetrievalMetric):
 
 
 class RetrievalMAP(_TopKRetrievalMetric):
-    """Mean Average Precision (reference retrieval/average_precision.py:29)."""
+    """Mean Average Precision (reference retrieval/average_precision.py:29).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.retrieval import RetrievalMAP
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> metric = RetrievalMAP()
+        >>> metric.update(preds, target, indexes=indexes)
+        >>> metric.compute()
+        Array(0.7916667, dtype=float32)
+    """
 
     def _metric_padded(self, preds, target, mask):
         return _ap_kernel(preds, target, mask, self.top_k)
 
 
 class RetrievalMRR(_TopKRetrievalMetric):
-    """Mean Reciprocal Rank (reference retrieval/reciprocal_rank.py:29)."""
+    """Mean Reciprocal Rank (reference retrieval/reciprocal_rank.py:29).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.retrieval import RetrievalMRR
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> metric = RetrievalMRR()
+        >>> metric.update(preds, target, indexes=indexes)
+        >>> metric.compute()
+        Array(0.75, dtype=float32)
+    """
 
     def _metric_padded(self, preds, target, mask):
         return _rr_kernel(preds, target, mask, self.top_k)
 
 
 class RetrievalPrecision(_TopKRetrievalMetric):
-    """Precision@k (reference retrieval/precision.py:29)."""
+    """Precision@k (reference retrieval/precision.py:29).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.retrieval import RetrievalPrecision
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> metric = RetrievalPrecision(top_k=2)
+        >>> metric.update(preds, target, indexes=indexes)
+        >>> metric.compute()
+        Array(0.5, dtype=float32)
+    """
 
     def __init__(self, empty_target_action: str = "neg", ignore_index: Optional[int] = None,
                  top_k: Optional[int] = None, adaptive_k: bool = False,
@@ -69,14 +105,38 @@ class RetrievalPrecision(_TopKRetrievalMetric):
 
 
 class RetrievalRecall(_TopKRetrievalMetric):
-    """Recall@k (reference retrieval/recall.py:29)."""
+    """Recall@k (reference retrieval/recall.py:29).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.retrieval import RetrievalRecall
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> metric = RetrievalRecall(top_k=2)
+        >>> metric.update(preds, target, indexes=indexes)
+        >>> metric.compute()
+        Array(0.75, dtype=float32)
+    """
 
     def _metric_padded(self, preds, target, mask):
         return _recall_kernel(preds, target, mask, self.top_k)
 
 
 class RetrievalHitRate(_TopKRetrievalMetric):
-    """HitRate@k (reference retrieval/hit_rate.py:29)."""
+    """HitRate@k (reference retrieval/hit_rate.py:29).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.retrieval import RetrievalHitRate
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> metric = RetrievalHitRate(top_k=2)
+        >>> metric.update(preds, target, indexes=indexes)
+        >>> metric.compute()
+        Array(1., dtype=float32)
+    """
 
     def _metric_padded(self, preds, target, mask):
         return _hit_rate_kernel(preds, target, mask, self.top_k)
@@ -84,7 +144,19 @@ class RetrievalHitRate(_TopKRetrievalMetric):
 
 class RetrievalFallOut(_TopKRetrievalMetric):
     """FallOut@k (reference retrieval/fall_out.py:31). Lower is better; the empty-query
-    policy keys on queries with no NEGATIVE targets."""
+    policy keys on queries with no NEGATIVE targets.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.retrieval import RetrievalFallOut
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> metric = RetrievalFallOut(top_k=2)
+        >>> metric.update(preds, target, indexes=indexes)
+        >>> metric.compute()
+        Array(0.5, dtype=float32)
+    """
 
     higher_is_better = False
 
@@ -100,14 +172,38 @@ class RetrievalFallOut(_TopKRetrievalMetric):
 
 
 class RetrievalRPrecision(RetrievalMetric):
-    """R-Precision (reference retrieval/r_precision.py:28)."""
+    """R-Precision (reference retrieval/r_precision.py:28).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.retrieval import RetrievalRPrecision
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> metric = RetrievalRPrecision()
+        >>> metric.update(preds, target, indexes=indexes)
+        >>> metric.compute()
+        Array(0.75, dtype=float32)
+    """
 
     def _metric_padded(self, preds, target, mask):
         return _r_precision_kernel(preds, target, mask)
 
 
 class RetrievalNormalizedDCG(_TopKRetrievalMetric):
-    """NDCG@k; non-binary gains allowed (reference retrieval/ndcg.py:29)."""
+    """NDCG@k; non-binary gains allowed (reference retrieval/ndcg.py:29).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.retrieval import RetrievalNormalizedDCG
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> metric = RetrievalNormalizedDCG()
+        >>> metric.update(preds, target, indexes=indexes)
+        >>> metric.compute()
+        Array(0.8467132, dtype=float32)
+    """
 
     allow_non_binary_target = True
 
@@ -116,7 +212,19 @@ class RetrievalNormalizedDCG(_TopKRetrievalMetric):
 
 
 class RetrievalAUROC(_TopKRetrievalMetric):
-    """Per-query AUROC (reference retrieval/auroc.py:29)."""
+    """Per-query AUROC (reference retrieval/auroc.py:29).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.retrieval import RetrievalAUROC
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> metric = RetrievalAUROC()
+        >>> metric.update(preds, target, indexes=indexes)
+        >>> metric.compute()
+        Array(0.75, dtype=float32)
+    """
 
     def __init__(self, empty_target_action: str = "neg", ignore_index: Optional[int] = None,
                  top_k: Optional[int] = None, max_fpr: Optional[float] = None,

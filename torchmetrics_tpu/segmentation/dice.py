@@ -16,7 +16,18 @@ from ..metric import Metric
 
 class DiceScore(Metric):
     """Dice score over per-sample sufficient statistics (cat states, like the reference
-    segmentation/dice.py:139-141 — samplewise aggregation needs per-sample rows)."""
+    segmentation/dice.py:139-141 — samplewise aggregation needs per-sample rows).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.segmentation import DiceScore
+        >>> preds = jnp.asarray([[[0, 1, 1, 0], [1, 1, 0, 0], [2, 2, 1, 0], [2, 0, 0, 0]]])
+        >>> target = jnp.asarray([[[0, 1, 1, 0], [1, 0, 0, 0], [2, 2, 0, 0], [2, 2, 0, 0]]])
+        >>> metric = DiceScore(num_classes=3, input_format='index')
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.8102241, dtype=float32)
+    """
 
     is_differentiable = False
     higher_is_better = True

@@ -16,7 +16,18 @@ from ..metric import Metric
 
 
 class GeneralizedDiceScore(Metric):
-    """Static-shape sum states (score, samples) — fully in-graph shardable."""
+    """Static-shape sum states (score, samples) — fully in-graph shardable.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.segmentation import GeneralizedDiceScore
+        >>> preds = jnp.asarray([[[0, 1, 1, 0], [1, 1, 0, 0], [2, 2, 1, 0], [2, 0, 0, 0]]])
+        >>> target = jnp.asarray([[[0, 1, 1, 0], [1, 0, 0, 0], [2, 2, 0, 0], [2, 2, 0, 0]]])
+        >>> metric = GeneralizedDiceScore(num_classes=3, input_format='index')
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array([0.7905575], dtype=float32)
+    """
 
     is_differentiable = False
     higher_is_better = True

@@ -15,7 +15,18 @@ from ..metric import Metric
 
 
 class HausdorffDistance(Metric):
-    """Mean Hausdorff distance over (sample, class) pairs; scalar sum + count states."""
+    """Mean Hausdorff distance over (sample, class) pairs; scalar sum + count states.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.segmentation import HausdorffDistance
+        >>> preds = jnp.asarray([[[0, 1, 1, 0], [1, 1, 0, 0], [2, 2, 1, 0], [2, 0, 0, 0]]])
+        >>> target = jnp.asarray([[[0, 1, 1, 0], [1, 0, 0, 0], [2, 2, 0, 0], [2, 2, 0, 0]]])
+        >>> metric = HausdorffDistance(num_classes=3, input_format='index')
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(1.5, dtype=float32)
+    """
 
     is_differentiable = False
     higher_is_better = False

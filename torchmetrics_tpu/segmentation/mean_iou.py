@@ -18,7 +18,18 @@ from ..metric import Metric
 class MeanIoU(Metric):
     """Static-shape sum states (per-class score sums + valid-batch counts) — fully
     in-graph shardable. ``num_classes`` may be inferred from the first batch when the
-    input format carries a class axis (reference mean_iou.py:131-169)."""
+    input format carries a class axis (reference mean_iou.py:131-169).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.segmentation import MeanIoU
+        >>> preds = jnp.asarray([[[0, 1, 1, 0], [1, 1, 0, 0], [2, 2, 1, 0], [2, 0, 0, 0]]])
+        >>> target = jnp.asarray([[[0, 1, 1, 0], [1, 0, 0, 0], [2, 2, 0, 0], [2, 2, 0, 0]]])
+        >>> metric = MeanIoU(num_classes=3, input_format='index')
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.68333334, dtype=float32)
+    """
 
     is_differentiable = False
     higher_is_better = True

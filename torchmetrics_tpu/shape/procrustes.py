@@ -12,7 +12,18 @@ from ..metric import Metric
 
 
 class ProcrustesDisparity(Metric):
-    """Running sum/mean of per-sample Procrustes disparity (two sum states)."""
+    """Running sum/mean of per-sample Procrustes disparity (two sum states).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.shape import ProcrustesDisparity
+        >>> point_set1 = jnp.asarray([[[0.0, 0.0], [1.0, 0.0], [1.0, 1.0], [0.0, 1.0]]])
+        >>> point_set2 = jnp.asarray([[[0.0, 0.0], [2.0, 0.0], [2.0, 2.0], [0.0, 2.0]]])
+        >>> metric = ProcrustesDisparity()
+        >>> metric.update(point_set1, point_set2)
+        >>> metric.compute()
+        Array(3.5527135e-15, dtype=float32)
+    """
 
     is_differentiable = True
     higher_is_better = False

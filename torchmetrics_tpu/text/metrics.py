@@ -36,7 +36,17 @@ from ..metric import HostMetric, Metric
 
 
 class BLEUScore(HostMetric):
-    """Corpus BLEU (reference ``text/bleu.py:34``; states ``text/bleu.py:92-95``)."""
+    """Corpus BLEU (reference ``text/bleu.py:34``; states ``text/bleu.py:92-95``).
+
+    Example:
+        >>> from torchmetrics_tpu.text import BLEUScore
+        >>> preds = ['the cat is on the mat']
+        >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
+        >>> metric = BLEUScore()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.75983566, dtype=float32)
+    """
 
     is_differentiable = False
     higher_is_better = True
@@ -80,7 +90,17 @@ class BLEUScore(HostMetric):
 
 
 class SacreBLEUScore(BLEUScore):
-    """BLEU with sacrebleu tokenization (reference ``text/sacre_bleu.py:35``)."""
+    """BLEU with sacrebleu tokenization (reference ``text/sacre_bleu.py:35``).
+
+    Example:
+        >>> from torchmetrics_tpu.text import SacreBLEUScore
+        >>> preds = ['the cat is on the mat']
+        >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
+        >>> metric = SacreBLEUScore()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.75983566, dtype=float32)
+    """
 
     def __init__(
         self,
@@ -123,7 +143,15 @@ class _ASRMetric(HostMetric):
 
 
 class CharErrorRate(_ASRMetric):
-    """Character error rate (reference ``text/cer.py:29``)."""
+    """Character error rate (reference ``text/cer.py:29``).
+
+    Example:
+        >>> from torchmetrics_tpu.text import CharErrorRate
+        >>> metric = CharErrorRate()
+        >>> metric.update(['this is the prediction'], ['this is the reference'])
+        >>> metric.compute()
+        Array(0.3809524, dtype=float32)
+    """
 
     _char_level = True
 
@@ -132,14 +160,30 @@ class CharErrorRate(_ASRMetric):
 
 
 class WordErrorRate(_ASRMetric):
-    """Word error rate (reference ``text/wer.py:29``)."""
+    """Word error rate (reference ``text/wer.py:29``).
+
+    Example:
+        >>> from torchmetrics_tpu.text import WordErrorRate
+        >>> metric = WordErrorRate()
+        >>> metric.update(['this is the prediction'], ['this is the reference'])
+        >>> metric.compute()
+        Array(0.25, dtype=float32)
+    """
 
     def _compute(self, state):
         return _wer_compute(state["errors"], state["total"])
 
 
 class MatchErrorRate(_ASRMetric):
-    """Match error rate (reference ``text/mer.py:29``)."""
+    """Match error rate (reference ``text/mer.py:29``).
+
+    Example:
+        >>> from torchmetrics_tpu.text import MatchErrorRate
+        >>> metric = MatchErrorRate()
+        >>> metric.update(['this is the prediction'], ['this is the reference'])
+        >>> metric.compute()
+        Array(0.25, dtype=float32)
+    """
 
     _total_is_max = True
 
@@ -169,7 +213,15 @@ class _WordInfoMetric(HostMetric):
 
 
 class WordInfoLost(_WordInfoMetric):
-    """Word information lost (reference ``text/wil.py:28``)."""
+    """Word information lost (reference ``text/wil.py:28``).
+
+    Example:
+        >>> from torchmetrics_tpu.text import WordInfoLost
+        >>> metric = WordInfoLost()
+        >>> metric.update(['this is the prediction'], ['this is the reference'])
+        >>> metric.compute()
+        Array(0.4375, dtype=float32)
+    """
 
     higher_is_better = False
 
@@ -178,7 +230,15 @@ class WordInfoLost(_WordInfoMetric):
 
 
 class WordInfoPreserved(_WordInfoMetric):
-    """Word information preserved (reference ``text/wip.py:28``)."""
+    """Word information preserved (reference ``text/wip.py:28``).
+
+    Example:
+        >>> from torchmetrics_tpu.text import WordInfoPreserved
+        >>> metric = WordInfoPreserved()
+        >>> metric.update(['this is the prediction'], ['this is the reference'])
+        >>> metric.compute()
+        Array(0.5625, dtype=float32)
+    """
 
     higher_is_better = True
 
@@ -187,7 +247,15 @@ class WordInfoPreserved(_WordInfoMetric):
 
 
 class EditDistance(HostMetric):
-    """Levenshtein edit distance (reference ``text/edit.py:30``)."""
+    """Levenshtein edit distance (reference ``text/edit.py:30``).
+
+    Example:
+        >>> from torchmetrics_tpu.text import EditDistance
+        >>> metric = EditDistance()
+        >>> metric.update(['rain'], ['shine'])
+        >>> metric.compute()
+        Array(3., dtype=float32)
+    """
 
     is_differentiable = False
     higher_is_better = False
@@ -227,7 +295,17 @@ class EditDistance(HostMetric):
 
 
 class CHRFScore(HostMetric):
-    """chrF/chrF++ (reference ``text/chrf.py:53``): six per-order count vectors."""
+    """chrF/chrF++ (reference ``text/chrf.py:53``): six per-order count vectors.
+
+    Example:
+        >>> from torchmetrics_tpu.text import CHRFScore
+        >>> preds = ['the cat is on the mat']
+        >>> target = [['there is a cat on the mat']]
+        >>> metric = CHRFScore()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.4941851, dtype=float32)
+    """
 
     is_differentiable = False
     higher_is_better = True
@@ -289,7 +367,17 @@ class CHRFScore(HostMetric):
 
 
 class SQuAD(HostMetric):
-    """SQuAD EM/F1 (reference ``text/squad.py:35``)."""
+    """SQuAD EM/F1 (reference ``text/squad.py:35``).
+
+    Example:
+        >>> from torchmetrics_tpu.text import SQuAD
+        >>> preds = [{'prediction_text': '1976', 'id': '56e10a3be3433e1400422b22'}]
+        >>> target = [{'answers': {'answer_start': [97], 'text': ['1976']}, 'id': '56e10a3be3433e1400422b22'}]
+        >>> metric = SQuAD()
+        >>> metric.update(preds, target)
+        >>> {k: round(float(v), 4) for k, v in metric.compute().items()}
+        {'exact_match': 100.0, 'f1': 100.0}
+    """
 
     is_differentiable = False
     higher_is_better = True
@@ -317,7 +405,18 @@ class SQuAD(HostMetric):
 
 
 class Perplexity(Metric):
-    """Perplexity (reference ``text/perplexity.py:29``) — jitted device update."""
+    """Perplexity (reference ``text/perplexity.py:29``) — jitted device update.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.text import Perplexity
+        >>> preds = jnp.asarray([[[0.2, 0.4, 0.4], [0.5, 0.2, 0.3]]])
+        >>> target = jnp.asarray([[1, 0]])
+        >>> metric = Perplexity()
+        >>> metric.update(jnp.log(preds), target)
+        >>> metric.compute()
+        Array(2.236068, dtype=float32)
+    """
 
     is_differentiable = True
     higher_is_better = False
@@ -342,7 +441,15 @@ class Perplexity(Metric):
 
 class ROUGEScore(HostMetric):
     """ROUGE-N/L/Lsum (reference ``text/rouge.py:37``): per-sentence cat rows per
-    rouge key and statistic."""
+    rouge key and statistic.
+
+    Example:
+        >>> from torchmetrics_tpu.text import ROUGEScore
+        >>> metric = ROUGEScore(rouge_keys='rouge1')
+        >>> metric.update(['the cat is on the mat'], [['a cat is on the mat']])
+        >>> {k: round(float(v), 4) for k, v in metric.compute().items()}
+        {'rouge1_fmeasure': 0.8333, 'rouge1_precision': 0.8333, 'rouge1_recall': 0.8333}
+    """
 
     is_differentiable = False
     higher_is_better = True
@@ -405,7 +512,17 @@ class ROUGEScore(HostMetric):
 
 class TranslationEditRate(HostMetric):
     """TER (reference ``text/ter.py:30``): two scalar sum states + optional
-    sentence-level cat rows."""
+    sentence-level cat rows.
+
+    Example:
+        >>> from torchmetrics_tpu.text import TranslationEditRate
+        >>> preds = ['the cat is on the mat']
+        >>> target = [['there is a cat on the mat']]
+        >>> metric = TranslationEditRate()
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array(0.42857143, dtype=float32)
+    """
 
     is_differentiable = False
     higher_is_better = False
@@ -462,7 +579,15 @@ class TranslationEditRate(HostMetric):
 
 
 class ExtendedEditDistance(HostMetric):
-    """EED (reference ``text/eed.py:29``): per-sentence cat rows."""
+    """EED (reference ``text/eed.py:29``): per-sentence cat rows.
+
+    Example:
+        >>> from torchmetrics_tpu.text import ExtendedEditDistance
+        >>> metric = ExtendedEditDistance()
+        >>> metric.update(['this is the prediction'], [['this is the reference']])
+        >>> metric.compute()
+        Array(0.38345864, dtype=float32)
+    """
 
     is_differentiable = False
     higher_is_better = False

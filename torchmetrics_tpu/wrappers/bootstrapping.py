@@ -53,6 +53,18 @@ class BootStrapper(WrapperMetric):
             vmapped stacked-state fast path; poisson resamples per replica on
             the list path.
         seed: host RNG seed for the resampler.
+
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.wrappers import BootStrapper
+        >>> from torchmetrics_tpu.classification import BinaryAccuracy
+        >>> preds = jnp.asarray([0.11, 0.22, 0.84, 0.73, 0.33, 0.92])
+        >>> target = jnp.asarray([0, 0, 1, 1, 0, 1])
+        >>> metric = BootStrapper(BinaryAccuracy(), num_bootstraps=4, sampling_strategy='multinomial', seed=7)
+        >>> metric.update(preds, target)
+        >>> {k: round(float(v), 4) for k, v in metric.compute().items()}
+        {'mean': 1.0, 'std': 0.0}
     """
 
     def __init__(
@@ -120,9 +132,20 @@ class BootStrapper(WrapperMetric):
         return self._vmap_update
 
     def update(self, *args: Any, **kwargs: Any) -> None:
-        """Feed each replica a resampled view of this batch (bootstrapping.py:126)."""
+        """Feed each replica a resampled view of this batch (bootstrapping.py:126).
+
+        Tensors resample along dim 0. When the inputs are SAMPLE LISTS instead
+        (detection's list-of-image-dicts, text's list-of-sentences), the list
+        elements are the resampling unit — bootstrapping over images/sentences.
+        The reference's tensor-only resampler recurses into detection dicts and
+        resamples boxes WITHIN images (wrappers/bootstrapping.py:172-178), which
+        is not a bootstrap of the evaluation sample; this is a deliberate,
+        tested divergence (tests/test_wrapper_detection_fuzz.py)."""
         sizes = [len(a) for a in args if hasattr(a, "shape")]
         sizes += [len(v) for v in kwargs.values() if hasattr(v, "shape")]
+        if not sizes:
+            sizes = [len(a) for a in args if isinstance(a, (list, tuple))]
+            sizes += [len(v) for v in kwargs.values() if isinstance(v, (list, tuple))]
         if not sizes:
             raise ValueError("None of the input contained tensors, so could not determine the sampling size")
         size = sizes[0]
@@ -140,25 +163,39 @@ class BootStrapper(WrapperMetric):
             if sample_idx.size == 0:
                 continue
             idx_arr = jnp.asarray(sample_idx)
-            new_args = tuple(a[idx_arr] if hasattr(a, "shape") else a for a in args)
-            new_kwargs = {k: (v[idx_arr] if hasattr(v, "shape") else v) for k, v in kwargs.items()}
-            self.metrics[idx].update(*new_args, **new_kwargs)
+
+            def take(a):
+                if hasattr(a, "shape"):
+                    return a[idx_arr]
+                if isinstance(a, (list, tuple)):
+                    return [a[int(i)] for i in sample_idx]
+                return a
+
+            self.metrics[idx].update(*(take(a) for a in args), **{k: take(v) for k, v in kwargs.items()})
         self._update_count += 1
         self._computed = None
 
     def compute(self) -> Dict[str, jax.Array]:
-        """Aggregate replica values (bootstrapping.py:149)."""
+        """Aggregate replica values (bootstrapping.py:149).
+
+        Dict-returning bases (detection's mAP) aggregate leaf-wise: each output
+        key gets its own mean/std/... over replicas (requires per-replica
+        outputs of matching shape — with per-class outputs, data where a
+        bootstrap draw can drop a class entirely makes shapes ragged)."""
         if self._use_vmap:
             computed_vals = jax.vmap(self.base_metric.compute_state)(self._stacked)
         else:
-            computed_vals = jnp.stack([m.compute() for m in self.metrics], axis=0)
+            vals = [m.compute() for m in self.metrics]
+            computed_vals = jax.tree.map(lambda *xs: jnp.stack([jnp.asarray(x) for x in xs], axis=0), *vals)
         output: Dict[str, jax.Array] = {}
         if self.mean:
-            output["mean"] = computed_vals.mean(axis=0)
+            output["mean"] = jax.tree.map(lambda v: v.mean(axis=0), computed_vals)
         if self.std:
-            output["std"] = computed_vals.std(axis=0, ddof=1)
+            output["std"] = jax.tree.map(lambda v: v.astype(jnp.float32).std(axis=0, ddof=1), computed_vals)
         if self.quantile is not None:
-            output["quantile"] = jnp.quantile(computed_vals, jnp.asarray(self.quantile), axis=0)
+            output["quantile"] = jax.tree.map(
+                lambda v: jnp.quantile(v.astype(jnp.float32), jnp.asarray(self.quantile), axis=0), computed_vals
+            )
         if self.raw:
             output["raw"] = computed_vals
         return output

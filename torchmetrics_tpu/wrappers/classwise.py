@@ -22,6 +22,18 @@ class ClasswiseWrapper(WrapperMetric):
         prefix: key prefix; defaults to ``<metricname>_`` when neither prefix nor
             postfix is given (reference classwise.py:156).
         postfix: key postfix.
+
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.wrappers import ClasswiseWrapper
+        >>> from torchmetrics_tpu.classification import MulticlassAccuracy
+        >>> preds = jnp.asarray([[0.75, 0.05, 0.20], [0.10, 0.80, 0.10], [0.20, 0.30, 0.50], [0.25, 0.40, 0.35]])
+        >>> target = jnp.asarray([0, 1, 2, 1])
+        >>> metric = ClasswiseWrapper(MulticlassAccuracy(num_classes=3, average=None))
+        >>> metric.update(preds, target)
+        >>> {k: round(float(v), 4) for k, v in metric.compute().items()}
+        {'multiclassaccuracy_0': 1.0, 'multiclassaccuracy_1': 1.0, 'multiclassaccuracy_2': 1.0}
     """
 
     def __init__(
@@ -47,7 +59,34 @@ class ClasswiseWrapper(WrapperMetric):
         self._prefix = prefix or ""
         self._postfix = postfix or ""
 
-    def _convert_output(self, x: jax.Array) -> Dict[str, jax.Array]:
+    def _convert_output(self, x) -> Dict[str, jax.Array]:
+        if isinstance(x, dict):
+            # dict-returning metrics (detection): label the `*_per_class` vectors
+            # per class — the reference's tensor-only wrapper degenerates to
+            # enumerating dict KEYS here (classwise.py:154-166), which is never
+            # what a detection user wants; scalars pass through under their own
+            # names. Class labels come from `labels`, else the metric's
+            # `classes` output, else indices.
+            out: Dict[str, jax.Array] = {}
+            for key, val in x.items():
+                if key.endswith("_per_class") and getattr(val, "ndim", 0) == 1:
+                    stem = key[: -len("_per_class")]
+                    if self.labels is not None:
+                        labels = self.labels
+                    elif "classes" in x and getattr(x["classes"], "ndim", 0) == 1 and x["classes"].shape[0] == val.shape[0]:
+                        labels = [int(c) for c in x["classes"]]
+                    else:
+                        labels = list(range(int(val.shape[0])))
+                    if len(labels) != int(val.shape[0]):
+                        raise ValueError(
+                            f"Expected number of labels ({len(labels)}) to match the per-class "
+                            f"output length ({int(val.shape[0])}) for key {key!r}."
+                        )
+                    for i, lab in enumerate(labels):
+                        out[f"{self._prefix}{stem}_{lab}{self._postfix}"] = val[i]
+                elif key != "classes":
+                    out[f"{self._prefix}{key}{self._postfix}"] = val
+            return out
         n = int(x.shape[0]) if getattr(x, "ndim", 0) > 0 else 1
         labels = self.labels if self.labels is not None else list(range(n))
         if len(labels) != n:

@@ -15,7 +15,18 @@ from .abstract import WrapperMetric
 
 
 class MinMaxMetric(WrapperMetric):
-    """Report ``{"raw": value, "min": lowest-seen, "max": highest-seen}``."""
+    """Report ``{"raw": value, "min": lowest-seen, "max": highest-seen}``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.wrappers import MinMaxMetric
+        >>> from torchmetrics_tpu.classification import BinaryAccuracy
+        >>> metric = MinMaxMetric(BinaryAccuracy())
+        >>> out1 = metric(jnp.asarray([0.9, 0.1]), jnp.asarray([1, 0]))
+        >>> out2 = metric(jnp.asarray([0.9, 0.1]), jnp.asarray([0, 0]))
+        >>> {k: round(float(v), 4) for k, v in out2.items()}
+        {'raw': 0.5, 'max': 1.0, 'min': 0.5}
+    """
 
     def __init__(self, base_metric: Metric, **kwargs: Any) -> None:
         super().__init__(**kwargs)

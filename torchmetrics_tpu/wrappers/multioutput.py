@@ -33,6 +33,18 @@ class MultioutputWrapper(WrapperMetric):
         output_dim: dimension to slice inputs along.
         remove_nans: drop dim-0 rows containing NaN in any input (per output slice).
         squeeze_outputs: squeeze the selected slice's output dim before updating.
+
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.wrappers import MultioutputWrapper
+        >>> from torchmetrics_tpu.regression import MeanSquaredError
+        >>> preds = jnp.asarray([[1.0, 10.0], [2.0, 20.0], [3.0, 30.0]])
+        >>> target = jnp.asarray([[1.0, 11.0], [2.0, 22.0], [3.0, 33.0]])
+        >>> metric = MultioutputWrapper(MeanSquaredError(), num_outputs=2)
+        >>> metric.update(preds, target)
+        >>> metric.compute()
+        Array([0.       , 4.6666665], dtype=float32)
     """
 
     is_differentiable = False

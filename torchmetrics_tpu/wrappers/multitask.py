@@ -18,6 +18,17 @@ class MultitaskWrapper(WrapperMetric):
     Args:
         task_metrics: dict of task name → ``Metric`` or ``MetricCollection``.
         prefix / postfix: added to task keys in the output dict.
+
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.wrappers import MultitaskWrapper
+        >>> from torchmetrics_tpu.classification import BinaryAccuracy
+        >>> from torchmetrics_tpu.regression import MeanSquaredError
+        >>> metric = MultitaskWrapper({'cls': BinaryAccuracy(), 'reg': MeanSquaredError()})
+        >>> metric.update({'cls': jnp.asarray([0.9, 0.1]), 'reg': jnp.asarray([2.5, 1.0])}, {'cls': jnp.asarray([1, 0]), 'reg': jnp.asarray([3.0, 1.0])})
+        >>> {k: round(float(v), 4) for k, v in metric.compute().items()}
+        {'cls': 1.0, 'reg': 0.125}
     """
 
     def __init__(

@@ -21,7 +21,18 @@ def _snapshot(metric: Metric) -> dict:
 
 
 class Running(WrapperMetric):
-    """Wrap a metric so ``compute()`` covers only the last ``window`` updates."""
+    """Wrap a metric so ``compute()`` covers only the last ``window`` updates.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.wrappers import Running
+        >>> from torchmetrics_tpu.aggregation import SumMetric
+        >>> metric = Running(SumMetric(), window=2)
+        >>> for batch in [1.0, 2.0, 3.0]:
+        ...     metric.update(batch)
+        >>> metric.compute()
+        Array(5., dtype=float32)
+    """
 
     def __init__(self, base_metric: Metric, window: int = 5) -> None:
         super().__init__()

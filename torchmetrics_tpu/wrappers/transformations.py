@@ -68,7 +68,17 @@ class MetricInputTransformer(WrapperMetric):
 
 
 class LambdaInputTransformer(MetricInputTransformer):
-    """Transform inputs with user-provided callables (transformations.py:84)."""
+    """Transform inputs with user-provided callables (transformations.py:84).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.wrappers import LambdaInputTransformer
+        >>> from torchmetrics_tpu.classification import BinaryAccuracy
+        >>> metric = LambdaInputTransformer(BinaryAccuracy(), transform_pred=lambda p: 1 - p)
+        >>> metric.update(jnp.asarray([0.2, 0.8, 0.1]), jnp.asarray([1, 0, 1]))
+        >>> metric.compute()
+        Array(1., dtype=float32)
+    """
 
     def __init__(
         self,
@@ -89,7 +99,17 @@ class LambdaInputTransformer(MetricInputTransformer):
 
 
 class BinaryTargetTransformer(MetricInputTransformer):
-    """Binarize targets at ``threshold`` (transformations.py:137)."""
+    """Binarize targets at ``threshold`` (transformations.py:137).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.wrappers import BinaryTargetTransformer
+        >>> from torchmetrics_tpu.classification import BinaryAccuracy
+        >>> metric = BinaryTargetTransformer(BinaryAccuracy(), threshold=2)
+        >>> metric.update(jnp.asarray([0.8, 0.2, 0.9]), jnp.asarray([3.0, 1.0, 5.0]))
+        >>> metric.compute()
+        Array(1., dtype=float32)
+    """
 
     def __init__(
         self, wrapped_metric: Union[Metric, MetricCollection], threshold: float = 0, **kwargs: Any
