@@ -11,7 +11,7 @@ import pytest
 _EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "examples")
 
 
-@pytest.mark.parametrize("script", ["pjit_eval_loop.py", "fid_clipscore_custom_extractor.py"])
+@pytest.mark.parametrize("script", ["pjit_eval_loop.py", "fid_clipscore_custom_extractor.py", "checkpoint_resume.py"])
 def test_example_runs(script):
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)

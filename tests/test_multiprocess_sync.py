@@ -167,3 +167,145 @@ def test_process_cluster_sync(tmp_path, world):
         )
     # per-process local values differ from the global (proves sync actually ran)
     assert outs[0]["acc_local"] != outs[1]["acc_local"] or outs[0]["acc_local"] != outs[0]["acc"]
+
+
+_WORKER_COMPOSITE = textwrap.dedent(
+    """
+    import json, sys
+    import jax
+
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    jax.distributed.initialize(f"127.0.0.1:{port}", num_processes=nproc, process_id=pid)
+
+    import jax.numpy as jnp
+    import numpy as np
+    import torchmetrics_tpu as tm
+    from torchmetrics_tpu import MetricCollection
+    from torchmetrics_tpu.detection import MeanAveragePrecision
+    from torchmetrics_tpu.wrappers import MinMaxMetric
+
+    rng = np.random.default_rng(7)  # same stream everywhere; shard by slicing
+    preds = rng.normal(size=(48, 5)).astype(np.float32)
+    target = rng.integers(0, 5, 48).astype(np.int32)
+    shard = 48 // nproc
+    lo, hi = pid * shard, (pid + 1) * shard
+    out = {}
+
+    # MetricCollection with compute groups through plane-2 sync: every process
+    # must see the GLOBAL value for every member
+    coll = MetricCollection({
+        "acc": tm.MulticlassAccuracy(5, average="micro"),
+        "f1": tm.MulticlassF1Score(5, average="macro"),
+        "auroc": tm.MulticlassAUROC(5, thresholds=16),
+        "confmat": tm.MulticlassConfusionMatrix(5),
+    })
+    coll.update(jnp.asarray(preds[lo:hi]), jnp.asarray(target[lo:hi]))
+    out["collection"] = {k: np.asarray(v).tolist() for k, v in coll.compute().items()}
+
+    # wrapper: the child metric syncs at compute -> raw is global
+    mm = MinMaxMetric(tm.MulticlassAccuracy(5, average="micro"))
+    mm(jnp.asarray(preds[lo:hi]), jnp.asarray(target[lo:hi]))
+    out["minmax_raw"] = float(mm.compute()["raw"])
+
+    # detection: per-image list states with UNEVEN shapes across processes
+    boxes = rng.uniform(0, 100, (12, 3, 2)).astype(np.float32)  # 12 imgs, 3 boxes
+    wh = rng.uniform(5, 40, (12, 3, 2)).astype(np.float32)
+    labels = rng.integers(0, 3, (12, 3)).astype(np.int32)
+    scores = rng.uniform(0.1, 1, (12, 3)).astype(np.float32)
+    per = 12 // nproc
+    m = MeanAveragePrecision()
+    d_preds, d_tgt = [], []
+    for i in range(pid * per, (pid + 1) * per):
+        nd = 3 if i % 2 == 0 else 2  # uneven per-image counts
+        bb = np.concatenate([boxes[i, :nd], boxes[i, :nd] + wh[i, :nd]], -1)
+        d_preds.append({"boxes": jnp.asarray(bb + rng.standard_normal(bb.shape).astype(np.float32)),
+                        "scores": jnp.asarray(scores[i, :nd]), "labels": jnp.asarray(labels[i, :nd])})
+        d_tgt.append({"boxes": jnp.asarray(bb), "labels": jnp.asarray(labels[i, :nd])})
+    m.update(d_preds, d_tgt)
+    out["map"] = float(m.compute()["map"])
+
+    print("RESULT" + json.dumps(out))
+    """
+)
+
+
+@pytest.mark.parametrize("world", [2])
+def test_process_cluster_composite_sync(tmp_path, world):
+    """Collections (compute groups), wrappers, and detection list states through
+    the REAL plane-2 process gather — every process reports the global value."""
+    worker = tmp_path / "worker.py"
+    worker.write_text(_WORKER_COMPOSITE)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = os.path.join(os.path.dirname(__file__), "..") + os.pathsep + env.get("PYTHONPATH", "")
+    port = str(_free_port())
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), str(i), str(world), port],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env,
+        )
+        for i in range(world)
+    ]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=280)
+        assert p.returncode == 0, out[-3000:]
+        payload = [line for line in out.splitlines() if line.startswith("RESULT")]
+        assert payload, out[-3000:]
+        outs.append(json.loads(payload[-1][len("RESULT"):]))
+
+    # one-process ground truth over the full data (same generator stream)
+    import jax.numpy as jnp
+
+    import torchmetrics_tpu as tm
+    from torchmetrics_tpu import MetricCollection
+    from torchmetrics_tpu.detection import MeanAveragePrecision
+
+    rng = np.random.default_rng(7)
+    preds = rng.normal(size=(48, 5)).astype(np.float32)
+    target = rng.integers(0, 5, 48).astype(np.int32)
+    ref = MetricCollection({
+        "acc": tm.MulticlassAccuracy(5, average="micro"),
+        "f1": tm.MulticlassF1Score(5, average="macro"),
+        "auroc": tm.MulticlassAUROC(5, thresholds=16),
+        "confmat": tm.MulticlassConfusionMatrix(5),
+    })
+    ref.update(jnp.asarray(preds), jnp.asarray(target))
+    want = {k: np.asarray(v) for k, v in ref.compute().items()}
+
+    boxes = rng.uniform(0, 100, (12, 3, 2)).astype(np.float32)
+    wh = rng.uniform(5, 40, (12, 3, 2)).astype(np.float32)
+    labels = rng.integers(0, 3, (12, 3)).astype(np.int32)
+    scores = rng.uniform(0.1, 1, (12, 3)).astype(np.float32)
+    # the workers consume their rng in shard order: replay pid-by-pid so the
+    # jitter draws line up with each worker's stream
+    ref_map = MeanAveragePrecision()
+    per = 12 // world
+    for pid in range(world):
+        wrng = np.random.default_rng(7)
+        wrng.normal(size=(48, 5))
+        wrng.integers(0, 5, 48)
+        wrng.uniform(0, 100, (12, 3, 2))
+        wrng.uniform(5, 40, (12, 3, 2))
+        wrng.integers(0, 3, (12, 3))
+        wrng.uniform(0.1, 1, (12, 3))
+        d_preds, d_tgt = [], []
+        for i in range(pid * per, (pid + 1) * per):
+            nd = 3 if i % 2 == 0 else 2
+            bb = np.concatenate([boxes[i, :nd], boxes[i, :nd] + wh[i, :nd]], -1)
+            d_preds.append({"boxes": jnp.asarray(bb + wrng.standard_normal(bb.shape).astype(np.float32)),
+                            "scores": jnp.asarray(scores[i, :nd]), "labels": jnp.asarray(labels[i, :nd])})
+            d_tgt.append({"boxes": jnp.asarray(bb), "labels": jnp.asarray(labels[i, :nd])})
+        ref_map.update(d_preds, d_tgt)
+    want_map = float(ref_map.compute()["map"])
+
+    for pid, res in enumerate(outs):
+        for key, val in want.items():
+            np.testing.assert_allclose(
+                np.asarray(res["collection"][key]), val, atol=1e-6, err_msg=f"proc {pid} collection {key}"
+            )
+        np.testing.assert_allclose(res["minmax_raw"], float(want["acc"]), atol=1e-7, err_msg=f"proc {pid} minmax")
+        np.testing.assert_allclose(res["map"], want_map, atol=1e-7, err_msg=f"proc {pid} mAP")

@@ -1,0 +1,127 @@
+"""Round-5 regression pins.
+
+1. `feature_network` declarations: FeatureShare's documented use case ("FID+KID+IS
+   run one extractor forward per batch") silently required an attribute no
+   in-tree metric declared — the wrapper raised on the real classes. Pin the
+   declarations AND the actual sharing (extractor called once per update).
+2. The FID fused path must NOT engage through a NetworkCache-wrapped extractor
+   (type-level probe): a FeatureShare'd FID goes through the shared cache.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.image import (
+    FrechetInceptionDistance,
+    InceptionScore,
+    KernelInceptionDistance,
+)
+from torchmetrics_tpu.wrappers import FeatureShare
+
+
+class CountingExtractor:
+    num_features = 8
+
+    def __init__(self):
+        self.calls = 0
+
+    def __call__(self, imgs):
+        self.calls += 1
+        return jnp.asarray(imgs).reshape(imgs.shape[0], -1)[:, :8].astype(jnp.float32)
+
+
+def test_feature_share_dedupes_real_generative_metrics():
+    ext = CountingExtractor()
+    fs = FeatureShare([
+        FrechetInceptionDistance(feature=ext),
+        KernelInceptionDistance(feature=ext, subset_size=2),
+        InceptionScore(feature=ext),
+    ])
+    rng = np.random.default_rng(0)
+    imgs = jnp.asarray(rng.integers(0, 255, (2, 3, 8, 8)).astype(np.uint8))
+    fs.update(imgs, real=True)
+    assert ext.calls == 1, f"extractor ran {ext.calls}x for one shared update"
+    fs.update(jnp.asarray(rng.integers(0, 255, (2, 3, 8, 8)).astype(np.uint8)), real=False)
+    assert ext.calls == 2
+    out = fs.compute()
+    assert {"FrechetInceptionDistance", "KernelInceptionDistance", "InceptionScore"} <= set(out)
+
+
+def test_feature_network_declared_on_model_backed_metrics():
+    from torchmetrics_tpu.image.generative import (
+        FrechetInceptionDistance as FID,
+        InceptionScore as IS,
+        KernelInceptionDistance as KID,
+        MemorizationInformedFrechetInceptionDistance as MiFID,
+    )
+    from torchmetrics_tpu.image.lpip import LearnedPerceptualImagePatchSimilarity as LPIPS
+    from torchmetrics_tpu.multimodal.clip_iqa import CLIPImageQualityAssessment as CLIPIQA
+    from torchmetrics_tpu.multimodal.clip_score import CLIPScore
+
+    assert FID.feature_network == "inception"
+    assert KID.feature_network == "inception"
+    assert IS.feature_network == "inception"
+    assert MiFID.feature_network == "inception"
+    assert LPIPS.feature_network == "net"
+    assert CLIPIQA.feature_network == "model"
+    assert CLIPScore.feature_network == "model"
+
+
+def test_feature_share_stock_inception_normalize_numpy_input():
+    """The review-found hole: with the stock Inception extractor, normalize=True
+    (and/or numpy inputs) each member used to quantize/convert a PRIVATE copy,
+    re-keying the id-based cache — the trunk silently ran once per member. The
+    normalize flag now rides through the shared call, keyed on the caller's
+    original buffer: ONE trunk forward per batch."""
+    from torchmetrics_tpu.image._extractors import InceptionV3Features
+
+    ext = InceptionV3Features(compute_dtype="float32")
+    calls = {"n": 0}
+    orig_apply = ext._apply
+
+    def counting_apply(imgs):
+        calls["n"] += 1
+        return orig_apply(imgs)
+
+    ext._apply = counting_apply
+    fs = FeatureShare([
+        FrechetInceptionDistance(feature=ext, normalize=True),
+        KernelInceptionDistance(feature=ext, normalize=True, subset_size=2),
+    ])
+    rng = np.random.default_rng(1)
+    imgs_np = rng.random((2, 3, 16, 16)).astype(np.float32)  # numpy, [0,1] floats
+    fs.update(imgs_np, real=True)
+    assert calls["n"] == 1, f"trunk ran {calls['n']}x for one shared normalize=True update"
+
+
+def test_classwise_wrapper_labels_index_by_class_id():
+    """User labels are indexed by OBSERVED class id, not position: with sparse
+    observed classes {1, 2}, labels[1]/labels[2] must be used (a positional zip
+    would attribute class 1's value to labels[0])."""
+    from torchmetrics_tpu.detection import MeanAveragePrecision
+    from torchmetrics_tpu.wrappers import ClasswiseWrapper
+
+    preds = [{
+        "boxes": jnp.asarray([[10.0, 10.0, 50.0, 50.0], [60.0, 60.0, 90.0, 90.0]]),
+        "scores": jnp.asarray([0.9, 0.8]),
+        "labels": jnp.asarray([1, 2]),
+    }]
+    target = [{
+        "boxes": jnp.asarray([[10.0, 10.0, 50.0, 50.0], [60.0, 60.0, 90.0, 90.0]]),
+        "labels": jnp.asarray([1, 2]),
+    }]
+    wrapped = ClasswiseWrapper(MeanAveragePrecision(class_metrics=True), labels=["zero", "one", "two"])
+    wrapped.update(preds, target)
+    out = wrapped.compute()
+    keys = set(out)
+    assert "meanaverageprecision_map_one" in keys and "meanaverageprecision_map_two" in keys
+    assert "meanaverageprecision_map_zero" not in keys  # class 0 never observed
+    # too-few labels for the observed ids raises instead of mislabeling
+    import pytest as _pytest
+
+    short = ClasswiseWrapper(MeanAveragePrecision(class_metrics=True), labels=["only", "two_labels"])
+    short.update(preds, target)
+    with _pytest.raises(ValueError, match="class id"):
+        short.compute()

@@ -342,6 +342,10 @@ _SKIP_MERGE = {
     "SpeechReverberationModulationEnergyRatio",  # single-update generator (one shard empty)
 }
 
+# forward's batch-value contract cannot hold where a metric's value is not
+# defined on a single batch; pin exceptions BY NAME (empty until proven needed)
+_SKIP_FORWARD: set = set()
+
 
 @pytest.fixture(scope="module")
 def batches():
@@ -416,3 +420,16 @@ def test_universal_invariants(name, batches):
     m_dst = ctor()
     m_dst.load_state_dict(sd)
     _assert_allclose(m_dst.compute(), first, msg=f"{name}: state_dict round-trip broke state")
+
+    # 7) forward contract (reference metric.py:287): returns THIS batch's value
+    # while accumulating globally — batch value == fresh-metric(single batch),
+    # and the accumulation afterwards equals plain sequential updates
+    if name not in _SKIP_FORWARD:
+        m_fwd = ctor()
+        batch_val = m_fwd(*data[0])
+        fresh1 = ctor()
+        fresh1.update(*data[0])
+        _assert_allclose(batch_val, fresh1.compute(), msg=f"{name}: forward batch value != single-batch compute")
+        for batch in data[1:]:
+            m_fwd(*batch)
+        _assert_allclose(m_fwd.compute(), first, msg=f"{name}: forward accumulation != update accumulation")

@@ -1,0 +1,112 @@
+"""Validation-path parity: malformed inputs raise like the reference.
+
+The reference's test suites spend thousands of lines asserting that bad
+constructor args and bad tensors fail LOUDLY with ValueError/RuntimeError
+(e.g. unittests/classification/test_accuracy.py's error cases). This battery
+drives the same malformed inputs through BOTH libraries and requires the same
+exception FAMILY on each side (exact messages are API surface we already mirror
+where load-bearing; types are the contract users catch on).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+import torch
+
+from oracle import require_oracle
+
+BIN_P = np.asarray([0.2, 0.8, 0.6], np.float32)
+BIN_T = np.asarray([0, 1, 1], np.int64)
+MC_P = np.asarray([[0.8, 0.1, 0.1], [0.2, 0.7, 0.1]], np.float32)
+MC_T = np.asarray([0, 1], np.int64)
+
+# (name, our call, reference call) — each callable gets (jnp|torch converter)
+CASES = [
+    ("binary_bad_threshold",
+     lambda F: F.binary_accuracy(jnp.asarray(BIN_P), jnp.asarray(BIN_T), threshold=2.0),
+     lambda R: R.binary_accuracy(torch.tensor(BIN_P), torch.tensor(BIN_T), threshold=2.0)),
+    ("binary_shape_mismatch",
+     lambda F: F.binary_accuracy(jnp.asarray(BIN_P), jnp.asarray(BIN_T[:2])),
+     lambda R: R.binary_accuracy(torch.tensor(BIN_P), torch.tensor(BIN_T[:2]))),
+    ("binary_target_out_of_range",
+     lambda F: F.binary_accuracy(jnp.asarray(BIN_P), jnp.asarray([0, 1, 3])),
+     lambda R: R.binary_accuracy(torch.tensor(BIN_P), torch.tensor([0, 1, 3]))),
+    ("mc_num_classes_too_small",
+     lambda F: F.multiclass_accuracy(jnp.asarray(MC_P), jnp.asarray(MC_T), num_classes=1),
+     lambda R: R.multiclass_accuracy(torch.tensor(MC_P), torch.tensor(MC_T), num_classes=1)),
+    ("mc_bad_average",
+     lambda F: F.multiclass_accuracy(jnp.asarray(MC_P), jnp.asarray(MC_T), num_classes=3, average="bogus"),
+     lambda R: R.multiclass_accuracy(torch.tensor(MC_P), torch.tensor(MC_T), num_classes=3, average="bogus")),
+    ("mc_topk_exceeds_classes",
+     lambda F: F.multiclass_accuracy(jnp.asarray(MC_P), jnp.asarray(MC_T), num_classes=3, top_k=5),
+     lambda R: R.multiclass_accuracy(torch.tensor(MC_P), torch.tensor(MC_T), num_classes=3, top_k=5)),
+    ("mc_target_out_of_range",
+     lambda F: F.multiclass_accuracy(jnp.asarray(MC_P), jnp.asarray([0, 7]), num_classes=3),
+     lambda R: R.multiclass_accuracy(torch.tensor(MC_P), torch.tensor([0, 7]), num_classes=3)),
+    ("mc_pred_dim_mismatch",
+     lambda F: F.multiclass_accuracy(jnp.asarray(MC_P[:, :2]), jnp.asarray(MC_T), num_classes=3),
+     lambda R: R.multiclass_accuracy(torch.tensor(MC_P[:, :2]), torch.tensor(MC_T), num_classes=3)),
+    ("ml_num_labels_mismatch",
+     lambda F: F.multilabel_accuracy(jnp.asarray(MC_P), jnp.asarray((MC_P > 0.5).astype(np.int64)), num_labels=5),
+     lambda R: R.multilabel_accuracy(torch.tensor(MC_P), torch.tensor((MC_P > 0.5).astype(np.int64)), num_labels=5)),
+    ("confmat_bad_normalize",
+     lambda F: F.multiclass_confusion_matrix(jnp.asarray(MC_P), jnp.asarray(MC_T), num_classes=3, normalize="bad"),
+     lambda R: R.multiclass_confusion_matrix(torch.tensor(MC_P), torch.tensor(MC_T), num_classes=3, normalize="bad")),
+    ("curve_bad_thresholds",
+     lambda F: F.binary_roc(jnp.asarray(BIN_P), jnp.asarray(BIN_T), thresholds=-3),
+     lambda R: R.binary_roc(torch.tensor(BIN_P), torch.tensor(BIN_T), thresholds=-3)),
+    ("fbeta_bad_beta",
+     lambda F: F.binary_fbeta_score(jnp.asarray(BIN_P), jnp.asarray(BIN_T), beta=-1.0),
+     lambda R: R.binary_fbeta_score(torch.tensor(BIN_P), torch.tensor(BIN_T), beta=-1.0)),
+    ("calibration_bad_norm",
+     lambda F: F.binary_calibration_error(jnp.asarray(BIN_P), jnp.asarray(BIN_T), norm="bogus"),
+     lambda R: R.binary_calibration_error(torch.tensor(BIN_P), torch.tensor(BIN_T), norm="bogus")),
+    ("mse_shape_mismatch",
+     lambda F: F.mean_squared_error(jnp.asarray(BIN_P), jnp.asarray(BIN_P[:2])),
+     lambda R: R.mean_squared_error(torch.tensor(BIN_P), torch.tensor(BIN_P[:2]))),
+    ("minkowski_bad_p",
+     lambda F: F.minkowski_distance(jnp.asarray(BIN_P), jnp.asarray(BIN_P), p=0.5),
+     lambda R: R.minkowski_distance(torch.tensor(BIN_P), torch.tensor(BIN_P), p=0.5)),
+    ("kl_shape_mismatch",
+     lambda F: F.kl_divergence(jnp.asarray(MC_P), jnp.asarray(MC_P[:, :2])),
+     lambda R: R.kl_divergence(torch.tensor(MC_P), torch.tensor(MC_P[:, :2]))),
+]
+
+
+def _raised(call, lib):
+    try:
+        call(lib)
+    except Exception as err:  # noqa: BLE001
+        return err
+    return None
+
+
+@pytest.mark.parametrize("name,ours,ref", CASES, ids=[c[0] for c in CASES])
+def test_validation_error_parity(name, ours, ref):
+    ref_tm = require_oracle()
+    import torchmetrics.functional as RF
+    import torchmetrics.functional.classification as RFC
+
+    import torchmetrics_tpu.functional as F
+
+    class _RefNS:  # reference exposes classification fns in a subnamespace
+        def __getattr__(self, item):
+            return getattr(RFC, item, None) or getattr(RF, item)
+
+    ref_err = _raised(ref, _RefNS())
+    our_err = _raised(ours, F)
+    assert ref_err is not None, f"{name}: reference accepted the malformed input — drop the case"
+    assert our_err is not None, f"{name}: reference raised {type(ref_err).__name__} but we accepted the input"
+    # same exception family: ValueError-like config errors vs RuntimeError-like
+    # data errors (the distinction users catch on). Library-specific classes
+    # (TorchMetricsUserError) match by NAME — each library defines its own.
+    def family(err):
+        return "ValueError" if isinstance(err, ValueError) else type(err).__name__
+
+    assert family(our_err) == family(ref_err), (
+        f"{name}: ours raised {type(our_err).__name__}({our_err}) vs reference "
+        f"{type(ref_err).__name__}({ref_err})"
+    )

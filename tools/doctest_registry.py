@@ -848,3 +848,53 @@ REGISTRY[(MMD, "LipVertexError")] = [
     "metric.update(vertices_pred, vertices_gt)",
     "metric.compute()",
 ]
+
+# --------------------------------------------------- round-5 late additions
+REGISTRY[("torchmetrics_tpu.wrappers.tracker", "MetricTracker")] = [
+    J,
+    "from torchmetrics_tpu.wrappers import MetricTracker",
+    f"from {CLS} import MulticlassAccuracy",
+    "tracker = MetricTracker(MulticlassAccuracy(num_classes=3))",
+    "for epoch in range(2):",
+    "...     tracker.increment()",
+    "...     tracker.update(jnp.asarray([[0.8, 0.1, 0.1], [0.2, 0.7, 0.1]]),"
+    " jnp.asarray([0, epoch]))",
+    "best, which = tracker.best_metric(return_step=True)",
+    "round(float(best), 4), which",
+]
+REGISTRY[("torchmetrics_tpu.wrappers.feature_share", "FeatureShare")] = [
+    J,
+    "from torchmetrics_tpu.wrappers import FeatureShare",
+    "from torchmetrics_tpu.image import FrechetInceptionDistance, KernelInceptionDistance",
+    "def tiny_extractor(imgs):",
+    "...     return imgs.reshape(imgs.shape[0], -1)[:, :8].astype(jnp.float32)",
+    "fs = FeatureShare([FrechetInceptionDistance(feature=tiny_extractor),"
+    " KernelInceptionDistance(feature=tiny_extractor, subset_size=2)])",
+    "imgs_a = (jnp.arange(2 * 3 * 16 * 16).reshape(2, 3, 16, 16) * 37 % 255).astype(jnp.uint8)",
+    "imgs_b = (jnp.arange(2 * 3 * 16 * 16).reshape(2, 3, 16, 16) * 31 % 255).astype(jnp.uint8)",
+    "fs.update(imgs_a, real=True)",
+    "fs.update(imgs_b, real=False)",
+    "sorted(fs.compute())",
+]
+REGISTRY[(RET, "RetrievalPrecisionRecallCurve")] = [
+    J,
+    "from torchmetrics_tpu.retrieval import RetrievalPrecisionRecallCurve",
+    "indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])",
+    "preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])",
+    "target = jnp.asarray([False, False, True, False, True, False, True])",
+    "metric = RetrievalPrecisionRecallCurve(max_k=4)",
+    "metric.update(preds, target, indexes=indexes)",
+    "precisions, recalls, top_k = metric.compute()",
+    "precisions",
+    "recalls",
+]
+REGISTRY[(RET, "RetrievalRecallAtFixedPrecision")] = [
+    J,
+    "from torchmetrics_tpu.retrieval import RetrievalRecallAtFixedPrecision",
+    "indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])",
+    "preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])",
+    "target = jnp.asarray([False, False, True, False, True, False, True])",
+    "metric = RetrievalRecallAtFixedPrecision(min_precision=0.5, max_k=4)",
+    "metric.update(preds, target, indexes=indexes)",
+    "metric.compute()",
+]

@@ -167,6 +167,11 @@ class InceptionV3Features:
     """
 
     num_features = 2048
+    # generative metrics pass their normalize flag THROUGH the call instead of
+    # quantizing a private copy first: under FeatureShare the id-keyed cache
+    # then sees every member's ORIGINAL input buffer (one trunk forward per
+    # batch, as the wrapper documents)
+    accepts_normalize = True
 
     def __init__(
         self,
@@ -215,7 +220,10 @@ class InceptionV3Features:
                 imgs = resize_bilinear_tf1(imgs, (299, 299))
         return _inception_forward(self.params, imgs.astype(self.compute_dtype))
 
-    def __call__(self, imgs) -> jnp.ndarray:
+    def __call__(self, imgs, normalize: bool = False) -> jnp.ndarray:
+        imgs = jnp.asarray(imgs)
+        if normalize:  # [0,1] floats quantize to uint8 levels (reference image/fid.py:309)
+            imgs = (imgs * 255).astype(jnp.uint8)
         return self._apply(imgs)
 
     # ---------------------------------------------------------------- params

@@ -18,6 +18,20 @@ from ..metric import HostMetric, Metric
 from ._extractors import resolve_feature_extractor
 
 
+def _extract_features(extractor, imgs, quantize: bool):
+    """Run the (possibly FeatureShare-cached) extractor with the CALLER's array
+    object as the cache key. Extractors advertising ``accepts_normalize`` do the
+    [0,1]→uint8 quantize themselves, so share members with identical settings
+    hit the same id-keyed NetworkCache entry instead of each quantizing (and
+    thereby re-keying) a private copy. Legacy custom callables keep the
+    metric-side quantize."""
+    if getattr(extractor, "accepts_normalize", False):
+        return extractor(imgs, normalize=quantize)
+    if quantize:
+        imgs = (jnp.asarray(imgs) * 255).astype(jnp.uint8)
+    return extractor(imgs)
+
+
 def _compute_fid(mu1, sigma1, mu2, sigma2) -> float:
     """Frechet distance between two Gaussians (eigenvalue form, f64 host)."""
     a = float(((mu1 - mu2) ** 2).sum())
@@ -47,6 +61,8 @@ class FrechetInceptionDistance(Metric):
         >>> round(float(metric.compute()), 4)
         1.4741
     """
+    # extractor attribute FeatureShare dedupes (reference declares the same name)
+    feature_network: str = "inception"
 
     is_differentiable = False
     higher_is_better = False
@@ -86,18 +102,17 @@ class FrechetInceptionDistance(Metric):
         self.add_state("fake_features_num_samples", jnp.zeros((), jnp.int32), dist_reduce_fx="sum")
 
     def _prepare_inputs(self, imgs, real: bool):
-        imgs = jnp.asarray(imgs)
         # fused path: raw images go straight into the jitted update, where
         # quantize+resize+trunk+cov run as ONE dispatch (the tunnel's
         # per-dispatch latency costs ~11% img/s on the split path). The probe
         # looks at the TYPE, not the instance: FeatureShare swaps `inception`
         # for a NetworkCache whose __getattr__ would forward `in_graph_forward`
         # to the wrapped extractor and silently bypass the shared memoization.
-        if getattr(type(self.inception), "in_graph_forward", None) is not None and imgs.ndim == 4:
-            return (imgs, jnp.asarray(bool(real))), {}
-        if self.normalize and not self.used_custom_model:
-            imgs = (imgs * 255).astype(jnp.uint8)
-        features = jnp.asarray(self.inception(imgs))
+        if getattr(type(self.inception), "in_graph_forward", None) is not None and getattr(imgs, "ndim", 0) == 4:
+            return (jnp.asarray(imgs), jnp.asarray(bool(real))), {}
+        features = jnp.asarray(
+            _extract_features(self.inception, imgs, self.normalize and not self.used_custom_model)
+        )
         return (features, jnp.asarray(bool(real))), {}
 
     def _batch_state(self, features, real):
@@ -172,6 +187,8 @@ def poly_mmd(f_real, f_fake, degree: int = 3, gamma: Optional[float] = None, coe
 class KernelInceptionDistance(HostMetric):
     """KID (reference ``image/kid.py:71``): polynomial-kernel MMD over random feature
     subsets; cat feature states."""
+    # extractor attribute FeatureShare dedupes (reference declares the same name)
+    feature_network: str = "inception"
 
     is_differentiable = False
     higher_is_better = False
@@ -226,10 +243,9 @@ class KernelInceptionDistance(HostMetric):
         self.add_state("fake_features", default=[], dist_reduce_fx="cat")
 
     def _host_batch_state(self, imgs, real: bool):
-        imgs = jnp.asarray(imgs)
-        if self.normalize and not self.used_custom_model:
-            imgs = (imgs * 255).astype(jnp.uint8)
-        features = jnp.asarray(self.inception(imgs))
+        features = jnp.asarray(
+            _extract_features(self.inception, imgs, self.normalize and not self.used_custom_model)
+        )
         empty = jnp.zeros((0, features.shape[-1]), features.dtype)
         if real:
             return {"real_features": features, "fake_features": empty}
@@ -261,6 +277,8 @@ class KernelInceptionDistance(HostMetric):
 class InceptionScore(Metric):
     """Inception Score (reference ``image/inception.py:35``): exp KL between
     conditional and marginal label distributions over splits; cat logit states."""
+    # extractor attribute FeatureShare dedupes (reference declares the same name)
+    feature_network: str = "inception"
 
     is_differentiable = False
     higher_is_better = True
@@ -300,9 +318,7 @@ class InceptionScore(Metric):
         imgs = jnp.asarray(imgs)
         # the reference byte-converts for custom extractors too (inception.py:151 has
         # no used_custom_model check, unlike FID/KID) — quirk preserved for parity
-        if self.normalize:
-            imgs = (imgs * 255).astype(jnp.uint8)
-        return (jnp.asarray(self.inception(imgs)),), {}
+        return (jnp.asarray(_extract_features(self.inception, imgs, self.normalize)),), {}
 
     def _batch_state(self, features):
         return {"features": features}
@@ -326,6 +342,8 @@ class InceptionScore(Metric):
 class MemorizationInformedFrechetInceptionDistance(HostMetric):
     """MiFID (reference ``image/mifid.py:67``): FID penalized by the memorization
     (minimum cosine distance) between fake and real features; cat feature states."""
+    # extractor attribute FeatureShare dedupes (reference declares the same name)
+    feature_network: str = "inception"
 
     is_differentiable = False
     higher_is_better = False
@@ -359,10 +377,9 @@ class MemorizationInformedFrechetInceptionDistance(HostMetric):
         self.add_state("fake_features", default=[], dist_reduce_fx="cat")
 
     def _host_batch_state(self, imgs, real: bool):
-        imgs = jnp.asarray(imgs)
-        if self.normalize and not self.used_custom_model:
-            imgs = (imgs * 255).astype(jnp.uint8)
-        features = jnp.asarray(self.inception(imgs))
+        features = jnp.asarray(
+            _extract_features(self.inception, imgs, self.normalize and not self.used_custom_model)
+        )
         empty = jnp.zeros((0, features.shape[-1]), features.dtype)
         if real:
             return {"real_features": features, "fake_features": empty}

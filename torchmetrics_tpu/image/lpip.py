@@ -14,6 +14,8 @@ class LearnedPerceptualImagePatchSimilarity(Metric):
     """Running-mean LPIPS (two scalar sum states). ``weights_path`` points at a
     converted weight pickle; ``pretrained=False`` runs the machinery on deterministic
     random parameters (offline testing)."""
+    # extractor attribute FeatureShare dedupes (reference declares the same name)
+    feature_network: str = "net"
 
     is_differentiable = True
     higher_is_better = False

@@ -40,6 +40,8 @@ class CLIPImageQualityAssessment(HostMetric):
     ``multimodal/clip_iqa.py:216-221``: ``(N,)`` for one prompt, else
     ``{prompt: (N,)}``). ``prompts`` entries are built-in names or custom
     (positive, negative) tuples."""
+    # extractor attribute FeatureShare dedupes (reference declares the same name)
+    feature_network: str = "model"
 
     is_differentiable = False
     higher_is_better = True

@@ -14,6 +14,8 @@ class CLIPScore(HostMetric):
     """Running-mean CLIP score (two sum states; sync is two psums). The embedder is a
     HF checkpoint (local cache only — no egress) or a custom object with
     ``get_image_features``/``get_text_features`` (e.g. a jitted flax CLIP apply)."""
+    # extractor attribute FeatureShare dedupes (reference declares the same name)
+    feature_network: str = "model"
 
     is_differentiable = False
     higher_is_better = True

@@ -249,7 +249,22 @@ class RetrievalAUROC(_TopKRetrievalMetric):
 
 class RetrievalPrecisionRecallCurve(RetrievalMetric):
     """Averaged precision/recall @ k=1..max_k curves
-    (reference retrieval/precision_recall_curve.py:64)."""
+    (reference retrieval/precision_recall_curve.py:64).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.retrieval import RetrievalPrecisionRecallCurve
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> metric = RetrievalPrecisionRecallCurve(max_k=4)
+        >>> metric.update(preds, target, indexes=indexes)
+        >>> precisions, recalls, top_k = metric.compute()
+        >>> precisions
+        Array([0.5  , 0.5  , 0.5  , 0.375], dtype=float32)
+        >>> recalls
+        Array([0.5 , 0.75, 1.  , 1.  ], dtype=float32)
+    """
 
     higher_is_better = None
 
@@ -303,7 +318,19 @@ class RetrievalPrecisionRecallCurve(RetrievalMetric):
 
 class RetrievalRecallAtFixedPrecision(RetrievalPrecisionRecallCurve):
     """Max recall@k with averaged precision@k >= floor
-    (reference retrieval/precision_recall_curve.py:297)."""
+    (reference retrieval/precision_recall_curve.py:297).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.retrieval import RetrievalRecallAtFixedPrecision
+        >>> indexes = jnp.asarray([0, 0, 0, 1, 1, 1, 1])
+        >>> preds = jnp.asarray([0.2, 0.3, 0.5, 0.1, 0.3, 0.5, 0.2])
+        >>> target = jnp.asarray([False, False, True, False, True, False, True])
+        >>> metric = RetrievalRecallAtFixedPrecision(min_precision=0.5, max_k=4)
+        >>> metric.update(preds, target, indexes=indexes)
+        >>> metric.compute()
+        (Array(1., dtype=float32), Array(3, dtype=int32))
+    """
 
     higher_is_better = True
 

@@ -71,17 +71,23 @@ class ClasswiseWrapper(WrapperMetric):
             for key, val in x.items():
                 if key.endswith("_per_class") and getattr(val, "ndim", 0) == 1:
                     stem = key[: -len("_per_class")]
-                    if self.labels is not None:
-                        labels = self.labels
-                    elif "classes" in x and getattr(x["classes"], "ndim", 0) == 1 and x["classes"].shape[0] == val.shape[0]:
-                        labels = [int(c) for c in x["classes"]]
+                    # per-class vectors align with the metric's OBSERVED class
+                    # ids (`classes`), which may be sparse — user labels are
+                    # indexed BY CLASS ID, never positionally (a positional zip
+                    # would silently mislabel every class when ids skip 0)
+                    if "classes" in x and getattr(x["classes"], "ndim", 0) == 1 and x["classes"].shape[0] == val.shape[0]:
+                        class_ids = [int(c) for c in x["classes"]]
                     else:
-                        labels = list(range(int(val.shape[0])))
-                    if len(labels) != int(val.shape[0]):
-                        raise ValueError(
-                            f"Expected number of labels ({len(labels)}) to match the per-class "
-                            f"output length ({int(val.shape[0])}) for key {key!r}."
-                        )
+                        class_ids = list(range(int(val.shape[0])))
+                    if self.labels is not None:
+                        if class_ids and max(class_ids) >= len(self.labels):
+                            raise ValueError(
+                                f"Metric reported class id {max(class_ids)} but only "
+                                f"{len(self.labels)} labels were given for key {key!r}."
+                            )
+                        labels = [self.labels[c] for c in class_ids]
+                    else:
+                        labels = class_ids
                     for i, lab in enumerate(labels):
                         out[f"{self._prefix}{stem}_{lab}{self._postfix}"] = val[i]
                 elif key != "classes":

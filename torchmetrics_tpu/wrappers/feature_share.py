@@ -44,7 +44,22 @@ class NetworkCache:
 
 
 class FeatureShare(MetricCollection):
-    """MetricCollection that dedupes the members' shared feature extractor."""
+    """MetricCollection that dedupes the members' shared feature extractor.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.wrappers import FeatureShare
+        >>> from torchmetrics_tpu.image import FrechetInceptionDistance, KernelInceptionDistance
+        >>> def tiny_extractor(imgs):
+        ...     return imgs.reshape(imgs.shape[0], -1)[:, :8].astype(jnp.float32)
+        >>> fs = FeatureShare([FrechetInceptionDistance(feature=tiny_extractor), KernelInceptionDistance(feature=tiny_extractor, subset_size=2)])
+        >>> imgs_a = (jnp.arange(2 * 3 * 16 * 16).reshape(2, 3, 16, 16) * 37 % 255).astype(jnp.uint8)
+        >>> imgs_b = (jnp.arange(2 * 3 * 16 * 16).reshape(2, 3, 16, 16) * 31 % 255).astype(jnp.uint8)
+        >>> fs.update(imgs_a, real=True)
+        >>> fs.update(imgs_b, real=False)
+        >>> sorted(fs.compute())
+        ['FrechetInceptionDistance', 'KernelInceptionDistance']
+    """
 
     def __init__(
         self,

@@ -18,7 +18,20 @@ from ..utilities.prints import rank_zero_warn
 
 
 class MetricTracker:
-    """List of per-step metric clones with best-value bookkeeping."""
+    """List of per-step metric clones with best-value bookkeeping.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.wrappers import MetricTracker
+        >>> from torchmetrics_tpu.classification import MulticlassAccuracy
+        >>> tracker = MetricTracker(MulticlassAccuracy(num_classes=3))
+        >>> for epoch in range(2):
+        ...     tracker.increment()
+        ...     tracker.update(jnp.asarray([[0.8, 0.1, 0.1], [0.2, 0.7, 0.1]]), jnp.asarray([0, epoch]))
+        >>> best, which = tracker.best_metric(return_step=True)
+        >>> round(float(best), 4), which
+        (1.0, 1)
+    """
 
     def __init__(
         self, metric: Union[Metric, MetricCollection], maximize: Union[bool, List[bool], None] = None
