@@ -1,0 +1,117 @@
+"""Fused-collection (as_pure) fuzz: the one-XLA-program path must agree with the
+stateful API on random metric subsets (VERDICT r4 weak #6 breadth: the fused
+path was exercised on fixed 4-metric collections only).
+
+Each trial samples 4-10 metrics from the compute-group pool, runs the same
+batches through (a) the stateful MetricCollection and (b) `as_pure()` with a
+jitted donated update, and requires name-for-name equality. An in-graph
+8-device reduce over sharded per-device states closes the loop on plane 1 for
+the fused path.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from torchmetrics_tpu import MetricCollection
+
+from conftest import seed_all
+from test_compute_group_fuzz import POOL, _flatten
+
+C = 5
+N = 48
+
+
+def _collection(names):
+    return MetricCollection({n: POOL[n][0]() for n in names})
+
+
+@pytest.mark.parametrize("trial", range(5))
+def test_as_pure_matches_stateful(trial):
+    rng = seed_all(8800 + trial)
+    names = sorted(rng.choice(sorted(POOL), size=int(rng.integers(4, 11)), replace=False).tolist())
+    batches = []
+    for _ in range(3):
+        logits = rng.normal(size=(N, C)).astype(np.float32)
+        probs = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+        batches.append((jnp.asarray(probs), jnp.asarray(rng.integers(0, C, N, dtype=np.int32))))
+
+    stateful = _collection(names)
+    for probs, target in batches:
+        stateful.update(probs, target)
+    want = {}
+    for key, val in stateful.compute().items():
+        _flatten(key, val, want)
+
+    base = _collection(names)
+    pure = base.as_pure()
+    step = jax.jit(pure.update, donate_argnums=0)
+    states = pure.init()
+    for probs, target in batches:
+        states = step(states, probs, target)
+    # contract: compute jits iff every member's compute is device-traceable;
+    # host-compute members (MCC's f64 edge cases) compute eagerly instead
+    all_jittable = all(m._jittable_compute for m in base.values())
+    compute = jax.jit(pure.compute) if all_jittable else pure.compute
+    got = {}
+    for key, val in compute(states).items():
+        _flatten(key, val, got)
+
+    assert got.keys() == want.keys()
+    for key in want:
+        np.testing.assert_allclose(got[key], want[key], atol=1e-6, err_msg=f"trial {trial}: {key}")
+
+
+def test_host_compute_member_raises_clearly_under_jit():
+    """Jitting pure.compute over a host-compute member (MCC's f64 edge handling)
+    fails at trace time with actionable guidance, not a cryptic tracer error."""
+    from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
+
+    pure = _collection(["acc_macro", "matthews"]).as_pure()
+    states = pure.init()
+    rng = seed_all(5)
+    probs = np.exp(rng.normal(size=(N, C))).astype(np.float32)
+    probs /= probs.sum(-1, keepdims=True)
+    states = pure.update(states, jnp.asarray(probs), jnp.asarray(rng.integers(0, C, N, dtype=np.int32)))
+    with pytest.raises(TorchMetricsUserError, match="OUTSIDE jit"):
+        jax.jit(pure.compute)(states)
+    # the eager path still computes everything
+    vals = pure.compute(states)
+    assert set(vals) == {"acc_macro", "matthews"}
+
+
+def test_as_pure_mesh_reduce_matches_oneshot():
+    """Per-device fused updates + one in-graph reduce == one-shot accumulation."""
+    rng = seed_all(99)
+    names = sorted(POOL)[:6]
+    batches = []
+    for _ in range(8):
+        logits = rng.normal(size=(N, C)).astype(np.float32)
+        probs = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+        batches.append((jnp.asarray(probs), jnp.asarray(rng.integers(0, C, N, dtype=np.int32))))
+
+    oneshot = _collection(names)
+    for probs, target in batches:
+        oneshot.update(probs, target)
+    want = {}
+    for key, val in oneshot.compute().items():
+        _flatten(key, val, want)
+
+    pure = _collection(names).as_pure()
+    per_dev = [pure.update(pure.init(), *b) for b in batches]
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *per_dev)
+    mesh = Mesh(np.array(jax.devices()[:8]), ("dp",))
+    reduce_fn = jax.jit(jax.shard_map(
+        lambda s: pure.reduce(jax.tree.map(lambda v: v[0], s), "dp"),
+        mesh=mesh, in_specs=(P("dp"),), out_specs=P(), check_vma=False,
+    ))
+    reduced = reduce_fn(stacked)
+    got = {}
+    for key, val in jax.jit(pure.compute)(reduced).items():
+        _flatten(key, val, got)
+    for key in want:
+        np.testing.assert_allclose(got[key], want[key], atol=1e-6, err_msg=key)

@@ -53,7 +53,9 @@ class BinaryAUROC(BinaryPrecisionRecallCurve):
             _binary_auroc_arg_validation(max_fpr, thresholds, ignore_index)
         self.validate_args = validate_args
         self.max_fpr = max_fpr
-        self._jittable_compute = False  # partial-AUC path uses host searchsorted
+        # only the partial-AUC path (max_fpr) needs host searchsorted; the plain
+        # binned trapezoid is branchless and jits (fused-collection path)
+        self._jittable_compute = max_fpr is None and thresholds is not None
 
     def _compute(self, state):
         return _binary_auroc_compute(self._curve_state(state), self.thresholds, self.max_fpr)
@@ -98,7 +100,8 @@ class MulticlassAUROC(MulticlassPrecisionRecallCurve):
             _multiclass_auroc_arg_validation(num_classes, average, thresholds, ignore_index)
         self.validate_args = validate_args
         self.average = average  # reduction average (curve average stays None)
-        self._jittable_compute = False
+        # binned reduction is branchless (the NaN-class warning is trace-guarded)
+        self._jittable_compute = thresholds is not None
 
     def _compute(self, state):
         return _multiclass_auroc_compute(self._curve_state(state), self.num_classes, self.average, self.thresholds)
@@ -142,7 +145,8 @@ class MultilabelAUROC(MultilabelPrecisionRecallCurve):
             _multilabel_auroc_arg_validation(num_labels, average, thresholds, ignore_index)
         self.validate_args = validate_args
         self.average = average
-        self._jittable_compute = False
+        # binned reduction is branchless (the NaN-class warning is trace-guarded)
+        self._jittable_compute = thresholds is not None
 
     def _compute(self, state):
         return _multilabel_auroc_compute(

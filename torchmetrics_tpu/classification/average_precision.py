@@ -82,7 +82,9 @@ class MulticlassAveragePrecision(MulticlassPrecisionRecallCurve):
             _multiclass_average_precision_arg_validation(num_classes, average, thresholds, ignore_index)
         self.validate_args = validate_args
         self.average = average
-        self._jittable_compute = False
+        # binned curves reduce branchlessly (the NaN-class warning is trace-safe
+        # and skipped under jit); only the unbinned list-state path is host-side
+        self._jittable_compute = thresholds is not None
 
     def _compute(self, state):
         return _multiclass_average_precision_compute(
@@ -128,7 +130,9 @@ class MultilabelAveragePrecision(MultilabelPrecisionRecallCurve):
             _multilabel_average_precision_arg_validation(num_labels, average, thresholds, ignore_index)
         self.validate_args = validate_args
         self.average = average
-        self._jittable_compute = False
+        # binned curves reduce branchlessly (the NaN-class warning is trace-safe
+        # and skipped under jit); only the unbinned list-state path is host-side
+        self._jittable_compute = thresholds is not None
 
     def _compute(self, state):
         return _multilabel_average_precision_compute(

@@ -9,6 +9,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ...utilities.checks import _is_traced
 from ...utilities.compute import _auc_compute, _safe_divide
 from ...utilities.prints import rank_zero_warn
 from .precision_recall_curve import (
@@ -38,7 +39,7 @@ def _reduce_auroc(fpr, tpr, average: Optional[str] = "macro", weights=None, dire
         res = jnp.stack([_auc_compute(x, y, direction=direction) for x, y in zip(fpr, tpr)])
     if average is None or average == "none":
         return res
-    if not isinstance(res, jax.core.Tracer) and bool(jnp.isnan(res).any()):
+    if not _is_traced(res) and bool(jnp.isnan(res).any()):
         # host-only advisory; the masked reduction below is jit-safe either way
         rank_zero_warn(
             f"Average precision score for one or more classes was `nan`. Ignoring these classes in {average}-average",

@@ -10,6 +10,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ...utilities.checks import _is_traced
 from ...utilities.compute import _safe_divide
 from ...utilities.prints import rank_zero_warn
 from .precision_recall_curve import (
@@ -49,7 +50,10 @@ def _reduce_average_precision(precision, recall, average: Optional[str] = "macro
         res = jnp.stack([-jnp.sum((r[1:] - r[:-1]) * p[:-1]) for p, r in zip(precision, recall)])
     if average is None or average == "none":
         return res
-    if bool(jnp.isnan(res).any()):
+    # the NaN-class warning needs a concrete value; under jit (the fused
+    # collection path) the masked reduction below is already branchless, so the
+    # warning is simply skipped rather than breaking the trace
+    if not _is_traced(res) and bool(jnp.isnan(res).any()):
         rank_zero_warn(
             f"Average precision score for one or more classes was `nan`. Ignoring these classes in {average}-average",
             UserWarning,

@@ -45,6 +45,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .parallel import sync as _sync
+from .utilities.checks import _is_traced
 from .utilities.data import _flatten, dim_zero_cat
 from .utilities.exceptions import TorchMetricsUserError
 from .utilities.prints import rank_zero_warn
@@ -235,7 +236,17 @@ class Metric:
         return self._merge(state, self._batch_state(*args, **kwargs))
 
     def compute_state(self, state: StateDict) -> Any:
-        """Pure compute for use inside user ``jit``."""
+        """Pure compute for use inside user ``jit`` (when ``_jittable_compute``)."""
+        if not self._jittable_compute:
+            leaves = [v for v in jax.tree.leaves(state) if hasattr(v, "dtype")]
+            if leaves and _is_traced(*leaves):
+                # fail at trace time with guidance instead of a cryptic
+                # TracerArrayConversionError from the host-side numpy compute
+                raise TorchMetricsUserError(
+                    f"{type(self).__name__}.compute runs on host (f64 edge-case handling or "
+                    "host algorithms) and cannot trace under jit. Call `pure.compute(states)` "
+                    "OUTSIDE jit for collections containing it, and jit only `pure.update`."
+                )
         return self._compute(state)
 
     def reduce_state(self, state: StateDict, axis_name: Union[str, Sequence[str]]) -> StateDict:
