@@ -76,6 +76,13 @@ CASES = [
 ]
 
 
+def _family(err):
+    """Exception family for parity: ValueError-like config errors collapse to
+    'ValueError'; library-specific classes (TorchMetricsUserError) match by NAME
+    since each library defines its own."""
+    return "ValueError" if isinstance(err, ValueError) else type(err).__name__
+
+
 def _raised(call, lib):
     try:
         call(lib)
@@ -101,12 +108,60 @@ def test_validation_error_parity(name, ours, ref):
     assert ref_err is not None, f"{name}: reference accepted the malformed input — drop the case"
     assert our_err is not None, f"{name}: reference raised {type(ref_err).__name__} but we accepted the input"
     # same exception family: ValueError-like config errors vs RuntimeError-like
-    # data errors (the distinction users catch on). Library-specific classes
-    # (TorchMetricsUserError) match by NAME — each library defines its own.
-    def family(err):
-        return "ValueError" if isinstance(err, ValueError) else type(err).__name__
-
-    assert family(our_err) == family(ref_err), (
+    # data errors (the distinction users catch on)
+    assert _family(our_err) == _family(ref_err), (
         f"{name}: ours raised {type(our_err).__name__}({our_err}) vs reference "
         f"{type(ref_err).__name__}({ref_err})"
+    )
+
+
+# ----------------------------------------------------------- class constructors
+CTOR_CASES = [
+    ("metric_bad_kwarg",
+     lambda M: M.MulticlassAccuracy(num_classes=3, bogus_kwarg=1),
+     lambda R: R.MulticlassAccuracy(num_classes=3, bogus_kwarg=1)),
+    ("fbeta_ctor_bad_beta",
+     lambda M: M.BinaryFBetaScore(beta=-2.0),
+     lambda R: R.BinaryFBetaScore(beta=-2.0)),
+    ("curve_ctor_bad_thresholds",
+     lambda M: M.BinaryPrecisionRecallCurve(thresholds=1),
+     lambda R: R.BinaryPrecisionRecallCurve(thresholds=1)),
+    ("statscores_ctor_bad_mda",
+     lambda M: M.MulticlassStatScores(num_classes=3, multidim_average="bogus"),
+     lambda R: R.MulticlassStatScores(num_classes=3, multidim_average="bogus")),
+    ("calibration_ctor_bad_nbins",
+     lambda M: M.BinaryCalibrationError(n_bins=0),
+     lambda R: R.BinaryCalibrationError(n_bins=0)),
+    # dropped: BinaryAUROC(max_fpr=3.0) and RecallAtFixedPrecision(min_precision=1.5)
+    # — the reference ACCEPTS these invalid configs at construction; this
+    # implementation raises eagerly (stricter on purpose, not a parity target)
+    ("classwise_bad_labels",
+     lambda M: __import__("torchmetrics_tpu").wrappers.ClasswiseWrapper(
+         M.MulticlassAccuracy(num_classes=3, average=None), labels="not_a_list"),
+     lambda R: __import__("torchmetrics").wrappers.ClasswiseWrapper(
+         R.MulticlassAccuracy(num_classes=3, average=None), labels="not_a_list")),
+    ("bootstrap_bad_strategy",
+     lambda M: __import__("torchmetrics_tpu").wrappers.BootStrapper(
+         M.BinaryAccuracy(), sampling_strategy="bogus"),
+     lambda R: __import__("torchmetrics").wrappers.BootStrapper(
+         R.BinaryAccuracy(), sampling_strategy="bogus")),
+    ("minmax_non_metric",
+     lambda M: __import__("torchmetrics_tpu").wrappers.MinMaxMetric("not_a_metric"),
+     lambda R: __import__("torchmetrics").wrappers.MinMaxMetric("not_a_metric")),
+]
+
+
+@pytest.mark.parametrize("name,ours,ref", CTOR_CASES, ids=[c[0] for c in CTOR_CASES])
+def test_constructor_error_parity(name, ours, ref):
+    require_oracle()
+    import torchmetrics.classification as RC
+
+    import torchmetrics_tpu.classification as MC
+
+    ref_err = _raised(ref, RC)
+    our_err = _raised(ours, MC)
+    assert ref_err is not None, f"{name}: reference accepted the bad constructor — drop the case"
+    assert our_err is not None, f"{name}: reference raised {type(ref_err).__name__} but we accepted it"
+    assert _family(our_err) == _family(ref_err), (
+        f"{name}: ours {type(our_err).__name__}({our_err}) vs reference {type(ref_err).__name__}({ref_err})"
     )
