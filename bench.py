@@ -20,12 +20,22 @@ tunneled TPU runtime into synchronous per-call dispatch for the rest of the proc
 ``vs_baseline`` is measured against a **torch-CPU proxy** (no CUDA device exists in
 this pod); the CUDA north-star comparison in BASELINE.md cannot be run here.
 
+Transient-failure retry (round-5 postmortem): the flagship FID config once died on a
+remote-compile infra error ("INTERNAL: ... response body closed before all bytes were
+read") and the round's headline number was lost because nothing retried. Each config
+now runs under a bounded RetryPolicy (2 retries, exponential backoff); the per-config
+JSON records ``attempts`` and, when a retry saved the number, ``recovered_from`` —
+so a transient error can no longer erase a round's headline. Only errors classified
+transient by ``torchmetrics_tpu.reliability`` retry; deterministic failures surface
+immediately.
+
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extra"}.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import subprocess
 import sys
 import time
@@ -382,6 +392,19 @@ def bench_sync_latency() -> dict:
     return result
 
 
+def bench_fault_selftest() -> dict:
+    """Hidden config (leading underscore: excluded from the main run) proving the
+    retry wrapper end to end: the FIRST subprocess attempt dies with the round-5
+    crash message, the retry recovers, and the JSON records ``recovered_from``.
+    Exercised by tests/test_reliability.py."""
+    if os.environ.get("BENCH_ATTEMPT", "1") == "1":
+        raise RuntimeError(
+            "INTERNAL: stream terminated by RST_STREAM: response body closed "
+            "before all bytes were read (injected transient fault)"
+        )
+    return {"ok": True}
+
+
 CONFIGS = {
     "ours": bench_ours,
     "torch_baseline": bench_torch_baseline,
@@ -390,14 +413,19 @@ CONFIGS = {
     "fid_inception_fwd": bench_fid,
     "sync_allreduce_8dev_cpu": bench_sync_latency,
     "bertscore_clipscore": bench_bertscore_clipscore,
+    "_fault_selftest": bench_fault_selftest,
 }
 
+MAX_ATTEMPTS = 3  # 2 retries — bounds a flaky pod's wall-clock to ~3x one config
 
-def _run_in_subprocess(name: str) -> dict:
+
+def _attempt_subprocess(name: str, attempt: int) -> dict:
+    env = dict(os.environ)
+    env["BENCH_ATTEMPT"] = str(attempt)  # consumed by the fault self-test config
     try:
         res = subprocess.run(
             [sys.executable, __file__, "--only", name],
-            capture_output=True, text=True, timeout=1800,
+            capture_output=True, text=True, timeout=1800, env=env,
         )
         return json.loads(res.stdout.strip().splitlines()[-1])
     except Exception as err:  # keep the primary JSON line alive whatever happens
@@ -407,12 +435,57 @@ def _run_in_subprocess(name: str) -> dict:
         return {"error": f"{type(err).__name__}: {err}: {' | '.join(tail)}"[:240]}
 
 
+# Stdlib-only mirror of torchmetrics_tpu.reliability.retry's message classifier —
+# the driver parent must not import the package (keeps jax out of the parent
+# process; each config subprocess initializes its own runtime). A parity test in
+# tests/test_reliability.py pins these markers against the canonical ones.
+_TRANSIENT_MARKERS = (
+    "internal:", "unavailable:", "deadline_exceeded", "deadline exceeded", "aborted:",
+    "cancelled:", "response body closed", "connection reset",
+    "connection refused", "connection closed", "broken pipe", "socket closed",
+    "transport closed", "stream terminated", "stream removed", "rst_stream",
+    "failed to connect", "temporarily unavailable", "preempted", "host dropped",
+    "participant dropped", "heartbeat timeout", "coordination service",
+)
+_DETERMINISTIC_MARKERS = (
+    "invalid_argument", "invalid argument:", "not_found", "unimplemented",
+    "failed_precondition", "out_of_range", "permission_denied", "unauthenticated",
+    "resource_exhausted",  # TPU/XLA OOM status — deterministic, never re-run
+)
+
+
+def _is_transient_error_text(text: str) -> bool:
+    low = text.lower()
+    if any(m in low for m in _DETERMINISTIC_MARKERS):
+        return False
+    return any(m in low for m in _TRANSIENT_MARKERS)
+
+
+def _run_in_subprocess(name: str) -> dict:
+    """One config under the retry policy: transient infra errors (classified by
+    message — the subprocess is already dead, there is no exception object) get
+    up to MAX_ATTEMPTS runs with exponential backoff; deterministic failures and
+    exhausted budgets return the error as before, now with attempt accounting."""
+    recovered_from = []
+    for attempt in range(1, MAX_ATTEMPTS + 1):
+        out = _attempt_subprocess(name, attempt)
+        err = out.get("error")
+        if err is None or not _is_transient_error_text(err) or attempt == MAX_ATTEMPTS:
+            out["attempts"] = attempt
+            if recovered_from and err is None:
+                out["recovered_from"] = recovered_from
+            return out
+        recovered_from.append(err)
+        time.sleep(min(1.0 * 2.0 ** (attempt - 1), 8.0))
+    raise AssertionError("unreachable")  # pragma: no cover
+
+
 def main() -> None:
     if len(sys.argv) == 3 and sys.argv[1] == "--only":
         print(json.dumps(CONFIGS[sys.argv[2]]()))
         return
 
-    results = {name: _run_in_subprocess(name) for name in CONFIGS}
+    results = {name: _run_in_subprocess(name) for name in CONFIGS if not name.startswith("_")}
     ours = results["ours"].get("updates_per_sec")
     baseline = results["torch_baseline"].get("updates_per_sec")
     vs = round(ours / baseline, 3) if ours and baseline else None

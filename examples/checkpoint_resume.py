@@ -6,6 +6,11 @@ metric.py:919-990). This script evaluates half a dataset, checkpoints the
 collection + a wrapper, "restarts" (fresh objects), restores, finishes the
 second half, and checks the resumed result equals a never-interrupted run.
 
+It also demonstrates the reliability layer's restore guard: a truncated
+checkpoint (lost keys — a half-written file on a preempted pod) raises
+``StateCorruptionError`` at ``load_state_dict`` instead of silently resuming
+from garbage (see docs/reliability.md).
+
 Run: JAX_PLATFORMS=cpu python examples/checkpoint_resume.py
 """
 
@@ -72,6 +77,17 @@ def main() -> None:
     extrema = {k: round(float(v), 4) for k, v in resumed_tracker.compute().items()}
     print("resumed == uninterrupted:", {k: round(v, 4) for k, v in got.items()})
     print("accuracy extrema across the stream:", extrema)
+
+    # ---- a truncated checkpoint must REFUSE to load, not resume from garbage
+    from torchmetrics_tpu.reliability import truncate_state_dict
+    from torchmetrics_tpu.utilities.exceptions import StateCorruptionError
+
+    damaged = truncate_state_dict(restored["collection"], drop_keys=["acc.tp"])
+    try:
+        make_collection().load_state_dict(damaged)
+        raise AssertionError("truncated checkpoint loaded silently")
+    except StateCorruptionError as err:
+        print("truncated checkpoint rejected:", str(err)[:90], "...")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,671 @@
+"""Fault-injection: state-integrity guards and graceful degradation.
+
+Covers the recovery paths the reliability layer promises (ISSUE 1):
+
+- NaN/Inf corruption of a named state leaf is caught by guards at the merge and
+  sync boundaries (StateCorruptionError; the healthy accumulator is untouched);
+- a truncated/partial checkpoint raises StateCorruptionError at restore instead of
+  silently loading garbage;
+- MetricCollection quarantine: a collection of 4 metrics with one poisoned member
+  still computes the other 3, reports the quarantined member's status+error, and
+  splits it out of its fused compute group; ``on_error="raise"`` (default) keeps
+  today's behavior exactly; ``on_error="skip"`` misses only the failing batch.
+"""
+
+from __future__ import annotations
+
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import torchmetrics_tpu as tm
+from torchmetrics_tpu import MetricCollection, QuarantinedMetric
+from torchmetrics_tpu.reliability import (
+    ReliabilityConfig,
+    poison_state_leaf,
+    truncate_state_dict,
+    validate_state,
+)
+from torchmetrics_tpu.utilities.exceptions import StateCorruptionError
+
+pytestmark = pytest.mark.faults
+
+NUM_CLASSES = 5
+
+
+def _cls_data(n=48, seed=0):
+    rng = np.random.default_rng(seed)
+    preds = jnp.asarray(rng.normal(size=(n, NUM_CLASSES)).astype(np.float32))
+    target = jnp.asarray(rng.integers(0, NUM_CLASSES, n, dtype=np.int32))
+    return preds, target
+
+
+# ----------------------------------------------------------------- guards: merge
+
+
+class TestGuardsAtMerge:
+    def test_nan_leaf_in_incoming_shard_caught(self):
+        preds, target = _cls_data()
+        acc = tm.MulticlassAccuracy(NUM_CLASSES, average="micro", reliability=ReliabilityConfig())
+        shard = tm.MulticlassAccuracy(NUM_CLASSES, average="micro")
+        acc.update(preds, target)
+        shard.update(preds, target)
+        before = {k: np.asarray(v) for k, v in acc.metric_state.items()}
+
+        # int states can't hold NaN; an aggregation metric's float state can
+        mean = tm.MeanMetric(reliability=ReliabilityConfig())
+        mean_shard = tm.MeanMetric()
+        mean.update(jnp.asarray([1.0, 2.0]))
+        mean_shard.update(jnp.asarray([3.0, 4.0]))
+        poison_state_leaf(mean_shard, "mean_value", kind="nan")
+        with pytest.raises(StateCorruptionError, match="non-finite"):
+            mean.merge_state(mean_shard)
+        assert np.isclose(float(mean.compute()), 1.5)  # accumulator untouched
+
+        # shape/dtype damage on an int-state metric is caught structurally
+        shard._state["tp"] = shard._state["tp"].astype(jnp.float32) * jnp.nan
+        with pytest.raises(StateCorruptionError):
+            acc.merge_state(shard)
+        after = {k: np.asarray(v) for k, v in acc.metric_state.items()}
+        for k in before:
+            np.testing.assert_array_equal(before[k], after[k])
+
+    def test_inf_leaf_caught(self):
+        m = tm.SumMetric(reliability=ReliabilityConfig())
+        other = tm.SumMetric()
+        m.update(jnp.asarray(1.0))
+        other.update(jnp.asarray(2.0))
+        poison_state_leaf(other, "sum_value", kind="inf")
+        with pytest.raises(StateCorruptionError, match="non-finite"):
+            m.merge_state(other)
+
+    def test_clean_merge_unaffected(self):
+        a = tm.MeanMetric(reliability=ReliabilityConfig())
+        b = tm.MeanMetric()
+        a.update(jnp.asarray([1.0, 2.0]))
+        b.update(jnp.asarray([3.0, 4.0]))
+        a.merge_state(b)
+        assert np.isclose(float(a.compute()), 2.5)
+
+    def test_guards_off_without_config(self):
+        """No ReliabilityConfig → merge folds NaN silently (today's behavior)."""
+        a, b = tm.MeanMetric(), tm.MeanMetric()
+        a.update(jnp.asarray(1.0))
+        b.update(jnp.asarray(2.0))
+        poison_state_leaf(b, "mean_value")
+        a.merge_state(b)  # no raise
+        assert np.isnan(float(a.compute()))
+
+
+# ------------------------------------------------------------------ guards: sync
+
+
+class TestGuardsAtSync:
+    def test_nan_participant_caught_at_sync(self):
+        """A NaN contribution from one gather participant corrupts the folded state;
+        validate_on_sync raises and the LOCAL state survives for a clean retry path."""
+
+        def nan_gather(value, process_group=None):
+            v = jnp.asarray(value)
+            bad = jnp.full_like(v.astype(jnp.float32), jnp.nan)
+            return [v, bad]
+
+        m = tm.MeanMetric(
+            dist_sync_fn=nan_gather,
+            distributed_available_fn=lambda: True,
+            reliability=ReliabilityConfig(),
+        )
+        m.update(jnp.asarray([2.0, 4.0]))
+        with pytest.raises(StateCorruptionError, match="sync"):
+            m.sync()
+        assert not m._is_synced
+        assert np.isclose(float(np.asarray(m._state["mean_value"])), 6.0)  # local intact (sum-form state)
+
+    def test_validate_state_direct(self):
+        m = tm.MeanMetric()
+        m.update(jnp.asarray([1.0]))
+        validate_state(m)  # clean
+        poison_state_leaf(m, "mean_value")
+        with pytest.raises(StateCorruptionError, match="mean_value"):
+            validate_state(m)
+
+
+# ----------------------------------------------------------- checkpoint restore
+
+
+class TestTruncatedCheckpoint:
+    def _saved(self):
+        preds, target = _cls_data()
+        m = tm.MulticlassAccuracy(NUM_CLASSES, average="micro")
+        m.update(preds, target)
+        m.persistent(True)
+        return m, m.state_dict()
+
+    def test_dropped_key_raises(self):
+        _, sd = self._saved()
+        bad = truncate_state_dict(sd, drop_keys=["fp"])
+        fresh = tm.MulticlassAccuracy(NUM_CLASSES, average="micro")
+        with pytest.raises(StateCorruptionError, match="truncated"):
+            fresh.load_state_dict(bad)
+
+    def test_sliced_array_raises(self):
+        _, sd = self._saved()
+        bad = truncate_state_dict(sd, slice_keys=["tp"])
+        fresh = tm.MulticlassAccuracy(NUM_CLASSES, average="micro")
+        with pytest.raises(StateCorruptionError, match="shape"):
+            fresh.load_state_dict(bad)
+
+    def test_clean_restore_still_works(self):
+        m, sd = self._saved()
+        fresh = tm.MulticlassAccuracy(NUM_CLASSES, average="micro")
+        fresh.load_state_dict(sd)
+        np.testing.assert_array_equal(np.asarray(fresh.compute()), np.asarray(m.compute()))
+        assert fresh.update_count == m.update_count
+
+    def test_absent_metric_still_noop(self):
+        """A checkpoint that simply doesn't contain this metric loads as a no-op
+        (collection checkpoints routinely hold other metrics' keys)."""
+        fresh = tm.MulticlassAccuracy(NUM_CLASSES, average="micro")
+        fresh.load_state_dict({"someothermetric.total": np.zeros(())})
+        assert fresh.update_count == 0
+
+    def test_validate_false_escape_hatch(self):
+        _, sd = self._saved()
+        bad = truncate_state_dict(sd, drop_keys=["fp"])
+        fresh = tm.MulticlassAccuracy(NUM_CLASSES, average="micro")
+        fresh.load_state_dict(bad, validate=False)  # forced partial load, no raise
+        assert fresh.update_count > 0
+
+    def test_collection_truncated_checkpoint(self):
+        preds, target = _cls_data()
+        coll = MetricCollection({
+            "acc": tm.MulticlassAccuracy(NUM_CLASSES, average="micro"),
+            "conf": tm.MulticlassConfusionMatrix(NUM_CLASSES),
+        })
+        coll.update(preds, target)
+        coll.persistent(True)
+        sd = coll.state_dict()
+        bad = truncate_state_dict(sd, drop_keys=["acc.tp"])
+        fresh = MetricCollection({
+            "acc": tm.MulticlassAccuracy(NUM_CLASSES, average="micro"),
+            "conf": tm.MulticlassConfusionMatrix(NUM_CLASSES),
+        })
+        with pytest.raises(StateCorruptionError, match="truncated"):
+            fresh.load_state_dict(bad)
+
+    def test_restore_finiteness_opt_in(self):
+        m = tm.MeanMetric()
+        m.update(jnp.asarray([1.0]))
+        m.persistent(True)
+        sd = m.state_dict()
+        sd["mean_value"] = np.asarray(np.nan, np.float32)
+        # default: structural checks only → loads
+        loose = tm.MeanMetric()
+        loose.load_state_dict(dict(sd))
+        # opted in: finiteness scan rejects
+        strict = tm.MeanMetric(reliability=ReliabilityConfig())
+        with pytest.raises(StateCorruptionError, match="non-finite"):
+            strict.load_state_dict(dict(sd))
+
+
+# ------------------------------------------------------------------- quarantine
+
+
+class _PoisonAfter(tm.Metric):
+    """Healthy for the first N updates, then raises — a realistically delayed
+    poisoning (e.g. a NaN logit arriving mid-eval)."""
+
+    def __init__(self, healthy_updates=1, **kw):
+        super().__init__(**kw)
+        self.add_state("n", default=np.zeros(()), dist_reduce_fx="sum")
+        self.healthy_updates = healthy_updates
+
+    def _batch_state(self, preds, target):
+        return {"n": jnp.ones(())}
+
+    def _prepare_inputs(self, *args, **kwargs):
+        if self._update_count >= self.healthy_updates:
+            raise RuntimeError("poisoned member: simulated in-metric failure")
+        return args, kwargs
+
+    def _compute(self, state):
+        return state["n"]
+
+
+def _quad_collection(on_error, poison_kw=None, **coll_kw):
+    return MetricCollection(
+        {
+            "acc": tm.MulticlassAccuracy(NUM_CLASSES, average="micro"),
+            "f1": tm.MulticlassF1Score(NUM_CLASSES, average="macro"),
+            "conf": tm.MulticlassConfusionMatrix(NUM_CLASSES),
+            "poison": _PoisonAfter(**(poison_kw or {})),
+        },
+        on_error=on_error,
+        **coll_kw,
+    )
+
+
+class TestQuarantine:
+    def test_three_of_four_still_compute(self):
+        preds, target = _cls_data()
+        ref = _quad_collection("raise", poison_kw={"healthy_updates": 99})
+        coll = _quad_collection("quarantine")
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", UserWarning)
+            for _ in range(3):
+                ref.update(preds, target)
+                coll.update(preds, target)
+        assert list(coll.quarantined) == ["poison"]
+        out = coll.compute()
+        ref_out = ref.compute()
+        for key in ("acc", "f1", "conf"):
+            np.testing.assert_array_equal(np.asarray(out[key]), np.asarray(ref_out[key]), err_msg=key)
+        status = out["poison"]
+        assert isinstance(status, QuarantinedMetric)
+        assert status.status == "quarantined"
+        assert status.stage == "update"
+        assert "poisoned member" in status.error
+        assert status.update_count == 1  # froze after its one healthy update
+
+    def test_forward_surfaces_status(self):
+        preds, target = _cls_data()
+        coll = _quad_collection("quarantine")
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", UserWarning)
+            first = coll.forward(preds, target)
+            second = coll.forward(preds, target)
+        assert not isinstance(first["poison"], QuarantinedMetric)
+        assert isinstance(second["poison"], QuarantinedMetric)
+        assert not isinstance(second["acc"], QuarantinedMetric)
+
+    def test_raise_mode_preserves_behavior(self):
+        preds, target = _cls_data()
+        coll = _quad_collection("raise")
+        coll.update(preds, target)
+        with pytest.raises(RuntimeError, match="poisoned member"):
+            coll.update(preds, target)
+
+    def test_invalid_mode_rejected(self):
+        with pytest.raises(ValueError, match="on_error"):
+            _quad_collection("explode")
+
+    def test_compute_group_split_keeps_members_alive(self):
+        """Two metrics sharing one compute group (identical states): when the group
+        LEADER is quarantined, the surviving member takes over mid-batch and its
+        values match an unfaulted run exactly."""
+        preds, target = _cls_data()
+
+        coll = MetricCollection(
+            {
+                # alphabetical insert order makes the poisoned metric the leader of
+                # the merged {a_poison, recall} group (same tp/fp/tn/fn states)
+                "a_poison": tm.MulticlassRecall(NUM_CLASSES, average="micro"),
+                "recall": tm.MulticlassRecall(NUM_CLASSES, average="micro"),
+                "conf": tm.MulticlassConfusionMatrix(NUM_CLASSES),
+            },
+            on_error="quarantine",
+        )
+        ref = MetricCollection({
+            "recall": tm.MulticlassRecall(NUM_CLASSES, average="micro"),
+            "conf": tm.MulticlassConfusionMatrix(NUM_CLASSES),
+        })
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", UserWarning)
+            coll.update(preds, target)
+            ref.update(preds, target)
+            groups = {frozenset(m) for m in coll.compute_groups.values()}
+            assert frozenset({"a_poison", "recall"}) in groups
+            # poison the leader from here on
+            coll["a_poison"]._prepare_inputs = _raise_prepare
+            coll.update(preds, target)
+            ref.update(preds, target)
+        assert list(coll.quarantined) == ["a_poison"]
+        groups = {frozenset(m) for m in coll.compute_groups.values()}
+        assert frozenset({"recall"}) in groups  # split: survivor runs alone
+        out = coll.compute()
+        ref_out = ref.compute()
+        np.testing.assert_array_equal(np.asarray(out["recall"]), np.asarray(ref_out["recall"]))
+        np.testing.assert_array_equal(np.asarray(out["conf"]), np.asarray(ref_out["conf"]))
+        # frozen at its last good state: one update's worth
+        assert isinstance(out["a_poison"], QuarantinedMetric)
+        assert out["a_poison"].update_count == 1
+
+    def test_reset_lifts_quarantine(self):
+        preds, target = _cls_data()
+        coll = _quad_collection("quarantine")
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", UserWarning)
+            coll.update(preds, target)
+            coll.update(preds, target)
+        assert coll.quarantined
+        coll.reset()
+        assert not coll.quarantined
+        coll["poison"].healthy_updates = 99  # healed
+        coll.update(preds, target)
+        out = coll.compute()
+        assert not isinstance(out["poison"], QuarantinedMetric)
+
+    def test_skip_mode_misses_only_failing_batch(self):
+        preds, target = _cls_data()
+        coll = _quad_collection("skip", poison_kw={"healthy_updates": 1})
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", UserWarning)
+            coll.update(preds, target)  # healthy
+            coll.update(preds, target)  # poison raises once → skipped, not frozen
+            coll["poison"].healthy_updates = 99  # heals after the one failure
+            coll.update(preds, target)  # healthy again
+        assert not coll.quarantined
+        assert coll["poison"].update_count == 2  # missed exactly the failing batch
+        assert coll["acc"].update_count == 3
+
+    def test_compute_failure_quarantines(self):
+        preds, target = _cls_data()
+
+        class BadCompute(tm.Metric):
+            def __init__(self, **kw):
+                super().__init__(**kw)
+                self.add_state("n", default=np.zeros(()), dist_reduce_fx="sum")
+
+            def _batch_state(self, preds, target):
+                return {"n": jnp.ones(())}
+
+            def _compute(self, state):
+                raise RuntimeError("compute blew up")
+
+        coll = MetricCollection(
+            {"acc": tm.MulticlassAccuracy(NUM_CLASSES, average="micro"), "bad": BadCompute()},
+            on_error="quarantine",
+        )
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", UserWarning)
+            coll.update(preds, target)
+            out = coll.compute()
+        assert isinstance(out["bad"], QuarantinedMetric)
+        assert out["bad"].stage == "compute"
+        assert not isinstance(out["acc"], QuarantinedMetric)
+        assert "bad" in coll.quarantined
+
+
+def _raise_prepare(*args, **kwargs):
+    raise RuntimeError("poisoned member: leader fails post-grouping")
+
+
+# ------------------------------------------------- review regressions (hardening)
+
+
+class TestReviewRegressions:
+    def test_merge_guard_catches_corrupt_local_accumulator(self):
+        """The LOCAL side is validated too — a merged-dict validation would let the
+        incoming (clean) keys shadow a NaN-corrupted accumulator and launder it."""
+        acc = tm.MeanMetric(reliability=ReliabilityConfig())
+        clean = tm.MeanMetric()
+        acc.update(jnp.asarray([1.0, 2.0]))
+        clean.update(jnp.asarray([3.0, 4.0]))
+        poison_state_leaf(acc, "mean_value", kind="nan")
+        with pytest.raises(StateCorruptionError, match=r"local.*non-finite|non-finite"):
+            acc.merge_state(clean)
+
+    def test_quarantined_state_survives_survivor_donated_updates(self):
+        """Detaching a member copies its BUFFERS, not just containers: the survivor's
+        donated jitted updates must not delete the frozen metric's arrays."""
+        preds, target = _cls_data()
+        coll = MetricCollection(
+            {
+                "a_poison": tm.MulticlassRecall(NUM_CLASSES, average="micro"),
+                "recall": tm.MulticlassRecall(NUM_CLASSES, average="micro"),
+            },
+            on_error="quarantine",
+        )
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", UserWarning)
+            coll.update(preds, target)
+            frozen_before = {k: np.asarray(v) for k, v in coll["a_poison"]._state.items()}
+            coll["a_poison"]._prepare_inputs = _raise_prepare
+            coll.update(preds, target)  # quarantines a_poison, survivor takes over
+            coll.update(preds, target)  # survivor's donated update must not touch it
+        # frozen state is still readable (not deleted buffers) and unchanged
+        coll.persistent(True)
+        sd = coll.state_dict()
+        for k, v in frozen_before.items():
+            np.testing.assert_array_equal(np.asarray(coll["a_poison"]._state[k]), v)
+            np.testing.assert_array_equal(np.asarray(sd[f"a_poison.{k}"]), v)
+
+    def test_skip_mode_with_explicit_compute_groups_keeps_updating(self):
+        """A skip-mode failure inside an explicit compute_groups list re-adds the
+        metric as its own singleton group — it misses only the failing batch."""
+        preds, target = _cls_data()
+        coll = MetricCollection(
+            {
+                "acc": tm.MulticlassAccuracy(NUM_CLASSES, average="micro"),
+                "poison": _PoisonAfter(healthy_updates=1),
+            },
+            compute_groups=[["acc"], ["poison"]],
+            on_error="skip",
+        )
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", UserWarning)
+            coll.update(preds, target)  # healthy
+            coll.update(preds, target)  # poison raises once -> skipped
+            coll["poison"].healthy_updates = 99
+            coll.update(preds, target)  # must update again (not silently dropped)
+        assert coll["poison"]._update_count == 2
+        assert coll["acc"]._update_count == 3
+
+    def test_collection_with_wrapper_restores(self):
+        """Wrapper metrics accept the validate= kwarg threaded through
+        MetricCollection.load_state_dict (restore of wrapper-containing
+        collections must not TypeError)."""
+        from torchmetrics_tpu.wrappers import ClasswiseWrapper, MinMaxMetric
+
+        preds, target = _cls_data()
+        coll = MetricCollection(
+            {
+                "cw": ClasswiseWrapper(tm.MulticlassAccuracy(NUM_CLASSES, average=None)),
+                "mm": MinMaxMetric(tm.MulticlassAccuracy(NUM_CLASSES, average="micro")),
+            }
+        )
+        coll.update(preds, target)
+        coll.persistent(True)
+        sd = coll.state_dict()
+        fresh = MetricCollection(
+            {
+                "cw": ClasswiseWrapper(tm.MulticlassAccuracy(NUM_CLASSES, average=None)),
+                "mm": MinMaxMetric(tm.MulticlassAccuracy(NUM_CLASSES, average="micro")),
+            }
+        )
+        fresh.load_state_dict(sd)
+        got, want = fresh.compute(), coll.compute()
+        for k in want:
+            np.testing.assert_allclose(np.asarray(got[k]), np.asarray(want[k]))
+
+    def test_healthy_degrading_collection_keeps_groups_across_reset(self):
+        """A skip/quarantine collection that never failed keeps its fused compute
+        groups through reset() (no per-epoch group re-derivation tax)."""
+        preds, target = _cls_data()
+        coll = MetricCollection(
+            {
+                "prec": tm.MulticlassPrecision(NUM_CLASSES, average="micro"),
+                "rec": tm.MulticlassRecall(NUM_CLASSES, average="micro"),
+            },
+            on_error="skip",
+        )
+        coll.update(preds, target)
+        groups_before = {frozenset(m) for m in coll.compute_groups.values()}
+        assert frozenset({"prec", "rec"}) in groups_before
+        coll.reset()
+        assert coll._groups_checked  # fused groups survived the reset
+        assert {frozenset(m) for m in coll.compute_groups.values()} == groups_before
+        coll.update(preds, target)
+        assert {frozenset(m) for m in coll.compute_groups.values()} == groups_before
+
+    def test_merge_folds_healthy_groupmate_when_incoming_leader_quarantined(self):
+        """An incoming collection that quarantined the fused group's LEADER must not
+        cost the merge its healthy group-mates' contributions — the fold routes
+        through the first member healthy on both sides."""
+        preds_a, target_a = _cls_data(seed=1)
+        preds_b, target_b = _cls_data(seed=2)
+
+        def _pair(on_error):
+            return MetricCollection(
+                {
+                    "a_poison": tm.MulticlassRecall(NUM_CLASSES, average="micro"),
+                    "recall": tm.MulticlassRecall(NUM_CLASSES, average="micro"),
+                },
+                on_error=on_error,
+            )
+
+        shard_a, shard_b = _pair("quarantine"), _pair("quarantine")
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", UserWarning)
+            shard_a.update(preds_a, target_a)
+            shard_b.update(preds_b, target_b)
+            # B quarantines the group leader; its 'recall' keeps the full stream
+            shard_b["a_poison"]._prepare_inputs = _raise_prepare
+            shard_b.update(preds_b, target_b)
+            shard_a.update(preds_a, target_a)
+            shard_a.merge_state(shard_b)
+        ref = tm.MulticlassRecall(NUM_CLASSES, average="micro")
+        for p, t in ((preds_a, target_a), (preds_a, target_a), (preds_b, target_b), (preds_b, target_b)):
+            ref.update(p, t)
+        np.testing.assert_array_equal(
+            np.asarray(shard_a["recall"].compute()), np.asarray(ref.compute())
+        )
+
+    def test_running_truncated_checkpoint_raises(self):
+        """Running wrapper honors validate=: a lost ring key raises
+        StateCorruptionError instead of a bare KeyError / silent empty resume."""
+        from torchmetrics_tpu.reliability import truncate_state_dict
+        from torchmetrics_tpu.wrappers import Running
+
+        run = Running(tm.MeanMetric(), window=3)
+        for v in (1.0, 2.0, 3.0):
+            run.update(jnp.asarray(v))
+        run.persistent(True)
+        sd = run.state_dict()
+        ring_keys = [k for k in sd if k.startswith("_ring0.")]
+        assert ring_keys, sorted(sd)
+        with pytest.raises(StateCorruptionError, match="truncated"):
+            Running(tm.MeanMetric(), window=3).load_state_dict(
+                truncate_state_dict(sd, drop_keys=ring_keys)
+            )
+        with pytest.raises(StateCorruptionError, match="truncated"):
+            Running(tm.MeanMetric(), window=3).load_state_dict(
+                truncate_state_dict(sd, drop_keys=["_ring_len"])
+            )
+
+    def test_running_missing_update_count_raises(self):
+        """A checkpoint that kept the ring but lost '_wrapper_update_count' is
+        truncated too — StateCorruptionError, not a bare KeyError; the target
+        wrapper is left untouched."""
+        from torchmetrics_tpu.wrappers import Running
+
+        run = Running(tm.MeanMetric(), window=3)
+        for v in (1.0, 2.0, 3.0):
+            run.update(jnp.asarray(v))
+        run.persistent(True)
+        sd = run.state_dict()
+        fresh = Running(tm.MeanMetric(), window=3)
+        with pytest.raises(StateCorruptionError, match="truncated"):
+            fresh.load_state_dict(truncate_state_dict(sd, drop_keys=["_wrapper_update_count"]))
+        assert fresh._ring == [] and fresh._update_count == 0
+
+    def test_mixed_persistence_checkpoint_loads_clean(self):
+        """A metric whose states mix persistent and non-persistent flags saves a
+        legitimate PARTIAL checkpoint — the '_saved_states' manifest keeps the
+        truncation guard from rejecting it, while an actually-lost key still raises."""
+
+        class Mixed(tm.Metric):
+            def __init__(self, **kw):
+                super().__init__(**kw)
+                self.add_state("total", default=np.zeros(()), dist_reduce_fx="sum", persistent=True)
+                self.add_state("scratch", default=np.zeros(()), dist_reduce_fx="sum", persistent=False)
+
+            def _batch_state(self, x):
+                return {"total": jnp.asarray(x), "scratch": jnp.asarray(x)}
+
+            def _compute(self, state):
+                return state["total"]
+
+        m = Mixed()
+        m.update(jnp.asarray(2.0))
+        sd = m.state_dict()
+        assert "total" in sd and "scratch" not in sd  # partial by design
+        fresh = Mixed()
+        fresh.load_state_dict(sd)  # must NOT raise "truncated"
+        assert float(np.asarray(fresh._state["total"])) == 2.0
+        with pytest.raises(StateCorruptionError, match="truncated"):
+            Mixed().load_state_dict(truncate_state_dict(sd, drop_keys=["total"]))
+
+    def test_reset_after_degradation_dealiases_group_state(self):
+        """reset() on a degraded collection must break the state-dict aliasing of
+        formerly-fused members: the next (ungrouped) update runs every metric
+        separately, and a still-shared dict would absorb each batch twice."""
+        preds, target = _cls_data()
+        coll = MetricCollection(
+            {
+                "prec": tm.MulticlassPrecision(NUM_CLASSES, average="micro"),
+                "rec": tm.MulticlassRecall(NUM_CLASSES, average="micro"),
+                "poison": _PoisonAfter(healthy_updates=1),
+            },
+            on_error="skip",
+        )
+        ref = tm.MulticlassPrecision(NUM_CLASSES, average="micro")
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", UserWarning)
+            coll.update(preds, target)  # fuses prec+rec
+            coll.update(preds, target)  # poison fails -> _degraded
+            coll.reset()
+            coll.update(preds, target)  # ungrouped pass: must not double-count
+            ref.update(preds, target)
+        assert coll["prec"]._state is not coll["rec"]._state or coll.compute_groups
+        np.testing.assert_array_equal(
+            np.asarray(coll["prec"].compute()), np.asarray(ref.compute())
+        )
+
+    def test_first_batch_failure_does_not_fuse_rolled_back_defaults(self):
+        """A first-batch failure under 'skip' rolls metrics back to identical default
+        states — group derivation must wait for a clean batch instead of fusing
+        distinct metrics whose states merely LOOK equal."""
+        preds, target = _cls_data()
+
+        class FailFirst(_PoisonAfter):
+            def _prepare_inputs(self, *args, **kwargs):
+                self.calls = getattr(self, "calls", 0) + 1
+                if self.calls == 1:
+                    raise RuntimeError("bad first batch")
+                return args, kwargs
+
+        coll = MetricCollection(
+            {
+                "a": FailFirst(healthy_updates=1),
+                "b": FailFirst(healthy_updates=1),
+            },
+            on_error="skip",
+        )
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", UserWarning)
+            coll.update(preds, target)  # both fail -> rolled back to defaults
+        assert not coll._groups_checked  # derivation deferred, nothing fused
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", UserWarning)
+            coll.update(preds, target)  # clean batch derives groups normally
+        assert coll["a"]._update_count == 1 and coll["b"]._update_count == 1
+
+    def test_sync_tolerates_legit_nan_in_cat_state(self):
+        """Finiteness guards are scoped to aggregate leaves: raw cat states carrying
+        NaN by construction (masked preds) must survive a validated sync."""
+
+        def fake_gather(value, process_group=None):
+            v = jnp.asarray(value)
+            return [v, v]
+
+        m = tm.CatMetric(
+            dist_sync_fn=fake_gather,
+            distributed_available_fn=lambda: True,
+            reliability=ReliabilityConfig(),
+        )
+        m.update(jnp.asarray([1.0, jnp.nan, 3.0]))  # legit NaN in raw data
+        m.sync()  # must NOT raise StateCorruptionError
+        assert m._is_synced

@@ -93,6 +93,25 @@ _WORKER = textwrap.dedent(
     ms.update(jnp.asarray(np.float32(pid + 1.0) * jnp.ones(4)))
     out["mean_state"] = float(ms.compute())
 
+    # fault-injected sync (reliability layer): every rank's first gather raises a
+    # transient participant-drop BEFORE entering the collective (deterministic and
+    # rank-symmetric, so the cluster retries in lockstep); the RetryPolicy re-runs
+    # process_sync through the REAL gather_all_arrays and the recovered value must
+    # equal the global one
+    from torchmetrics_tpu.reliability import FlakyGather, ReliabilityConfig, RetryPolicy
+
+    flaky = FlakyGather(fail_times=1)
+    racc = tm.MulticlassAccuracy(
+        5, average="micro", dist_sync_fn=flaky,
+        reliability=ReliabilityConfig(retry=RetryPolicy(max_attempts=3, backoff_base=0.01)),
+    )
+    racc.update(jnp.asarray(preds[lo:hi]), jnp.asarray(target[lo:hi]))
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", UserWarning)
+        out["acc_retry_sync"] = float(racc.compute())
+    out["flaky_gather_failures"] = flaky.failures
+
     print("RESULT" + json.dumps(out))
     """
 )
@@ -164,6 +183,13 @@ def test_process_cluster_sync(tmp_path, world):
         np.testing.assert_allclose(
             res["mean_state"], np.mean(np.arange(1, world + 1)), atol=1e-6,
             err_msg=f"proc {pid} n-way mean fold",
+        )
+        # fault-injected sync: the transient participant drop was retried through
+        # the real collective and the recovered value equals the global one
+        assert res["flaky_gather_failures"] == 1, f"proc {pid} fault did not fire"
+        np.testing.assert_allclose(
+            res["acc_retry_sync"], float(ref_acc.compute()), atol=1e-7,
+            err_msg=f"proc {pid} retried sync parity",
         )
     # per-process local values differ from the global (proves sync actually ran)
     assert outs[0]["acc_local"] != outs[1]["acc_local"] or outs[0]["acc_local"] != outs[0]["acc"]

@@ -9,7 +9,7 @@ Every domain package declares its public classes in its own ``__all__``; the fla
 namespace aggregates them (reference ``__init__.py`` re-exports ~100 names the same
 way, hand-listed)."""
 
-from torchmetrics_tpu import audio, classification, clustering, detection, functional, image, multimodal, nominal, parallel, regression, retrieval, segmentation, shape, text, utilities, video, wrappers
+from torchmetrics_tpu import audio, classification, clustering, detection, functional, image, multimodal, nominal, parallel, regression, reliability, retrieval, segmentation, shape, text, utilities, video, wrappers
 from torchmetrics_tpu.aggregation import (
     CatMetric,
     MaxMetric,
@@ -34,8 +34,9 @@ from torchmetrics_tpu.nominal import *  # noqa: F401,F403
 from torchmetrics_tpu.shape import *  # noqa: F401,F403
 from torchmetrics_tpu.text import *  # noqa: F401,F403
 from torchmetrics_tpu.video import *  # noqa: F401,F403
-from torchmetrics_tpu.collections import MetricCollection
+from torchmetrics_tpu.collections import MetricCollection, QuarantinedMetric
 from torchmetrics_tpu.metric import CompositionalMetric, Metric
+from torchmetrics_tpu.reliability import ReliabilityConfig, RetryPolicy
 from torchmetrics_tpu.regression import *  # noqa: F401,F403
 from torchmetrics_tpu.retrieval import *  # noqa: F401,F403
 from torchmetrics_tpu.segmentation import *  # noqa: F401,F403
@@ -66,10 +67,14 @@ __all__ = [
     "Metric",
     "MetricCollection",
     "MinMetric",
+    "QuarantinedMetric",
+    "ReliabilityConfig",
+    "RetryPolicy",
     "RunningMean",
     "RunningSum",
     "SumMetric",
     "classification",
+    "reliability",
     "functional",
     "parallel",
     "regression",

@@ -23,7 +23,7 @@ from .precision_recall_curve import (
 
 
 class BinaryAUROC(BinaryPrecisionRecallCurve):
-    """Binary a u r o c.
+    """Binary AUROC (area under the receiver operating characteristic curve).
 
     Example:
         >>> import jax.numpy as jnp
@@ -65,7 +65,7 @@ class BinaryAUROC(BinaryPrecisionRecallCurve):
 
 
 class MulticlassAUROC(MulticlassPrecisionRecallCurve):
-    """Multiclass a u r o c.
+    """Multiclass AUROC (area under the receiver operating characteristic curve).
 
     Example:
         >>> import jax.numpy as jnp
@@ -111,7 +111,7 @@ class MulticlassAUROC(MulticlassPrecisionRecallCurve):
 
 
 class MultilabelAUROC(MultilabelPrecisionRecallCurve):
-    """Multilabel a u r o c.
+    """Multilabel AUROC (area under the receiver operating characteristic curve).
 
     Example:
         >>> import jax.numpy as jnp

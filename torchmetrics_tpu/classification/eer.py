@@ -17,7 +17,7 @@ from .precision_recall_curve import (
 
 
 class BinaryEER(BinaryPrecisionRecallCurve):
-    """Binary e e r.
+    """Binary EER (equal error rate).
 
     Example:
         >>> import jax.numpy as jnp
@@ -47,7 +47,7 @@ class BinaryEER(BinaryPrecisionRecallCurve):
 
 
 class MulticlassEER(MulticlassPrecisionRecallCurve):
-    """Multiclass e e r.
+    """Multiclass EER (equal error rate).
 
     Example:
         >>> import jax.numpy as jnp
@@ -91,7 +91,7 @@ class MulticlassEER(MulticlassPrecisionRecallCurve):
 
 
 class MultilabelEER(MultilabelPrecisionRecallCurve):
-    """Multilabel e e r.
+    """Multilabel EER (equal error rate).
 
     Example:
         >>> import jax.numpy as jnp

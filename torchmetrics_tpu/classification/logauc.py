@@ -17,7 +17,7 @@ from .precision_recall_curve import (
 
 
 class BinaryLogAUC(BinaryPrecisionRecallCurve):
-    """Binary log a u c.
+    """Binary LogAUC (area under the ROC curve over a log-scaled FPR range).
 
     Example:
         >>> import jax.numpy as jnp
@@ -53,7 +53,7 @@ class BinaryLogAUC(BinaryPrecisionRecallCurve):
 
 
 class MulticlassLogAUC(MulticlassPrecisionRecallCurve):
-    """Multiclass log a u c.
+    """Multiclass LogAUC (area under the ROC curve over a log-scaled FPR range).
 
     Example:
         >>> import jax.numpy as jnp
@@ -94,7 +94,7 @@ class MulticlassLogAUC(MulticlassPrecisionRecallCurve):
 
 
 class MultilabelLogAUC(MultilabelPrecisionRecallCurve):
-    """Multilabel log a u c.
+    """Multilabel LogAUC (area under the ROC curve over a log-scaled FPR range).
 
     Example:
         >>> import jax.numpy as jnp

@@ -50,7 +50,7 @@ class BinaryPrecisionRecallCurve(Metric):
         >>> metric = BinaryPrecisionRecallCurve(thresholds=5)
         >>> metric.update(preds, target)
         >>> metric.compute()
-        (Array([0.5 , 0.75, 1.  , 1.  ,  nan, 1.  ], dtype=float32), Array([1.       , 1.       , 1.       , 0.6666667, 0.       , 0.       ],      dtype=float32), array([0.  , 0.25, 0.5 , 0.75, 1.  ], dtype=float32))
+        (Array([0.5 , 0.75, 1.  , 1.  ,  nan, 1.  ], dtype=float32), Array([1.       , 1.       , 1.       , 0.6666667, 0.       , 0.       ],      dtype=float32), Array([0.  , 0.25, 0.5 , 0.75, 1.  ], dtype=float32))
     """
     is_differentiable = False
     higher_is_better = None
@@ -126,7 +126,7 @@ class MulticlassPrecisionRecallCurve(Metric):
                [0.5      , 0.6666667, 1.       , 1.       ,       nan, 1.       ],
                [0.25     , 0.5      , 1.       ,       nan,       nan, 1.       ]],      dtype=float32), Array([[1. , 1. , 1. , 1. , 0. , 0. ],
                [1. , 1. , 0.5, 0.5, 0. , 0. ],
-               [1. , 1. , 1. , 0. , 0. , 0. ]], dtype=float32), array([0.  , 0.25, 0.5 , 0.75, 1.  ], dtype=float32))
+               [1. , 1. , 1. , 0. , 0. , 0. ]], dtype=float32), Array([0.  , 0.25, 0.5 , 0.75, 1.  ], dtype=float32))
     """
     is_differentiable = False
     higher_is_better = None
@@ -214,7 +214,7 @@ class MultilabelPrecisionRecallCurve(Metric):
                [0.6666667 , 1.        , 1.        , 1.        ,        nan,
                 1.        ]], dtype=float32), Array([[1. , 1. , 1. , 1. , 0. , 0. ],
                [1. , 1. , 1. , 0. , 0. , 0. ],
-               [1. , 1. , 0.5, 0.5, 0. , 0. ]], dtype=float32), array([0.  , 0.25, 0.5 , 0.75, 1.  ], dtype=float32))
+               [1. , 1. , 0.5, 0.5, 0. , 0. ]], dtype=float32), Array([0.  , 0.25, 0.5 , 0.75, 1.  ], dtype=float32))
     """
     is_differentiable = False
     higher_is_better = None

@@ -31,7 +31,7 @@ class BinaryROC(BinaryPrecisionRecallCurve):
         >>> metric = BinaryROC(thresholds=5)
         >>> metric.update(preds, target)
         >>> metric.compute()
-        (Array([0.        , 0.        , 0.        , 0.33333334, 1.        ],      dtype=float32), Array([0.       , 0.6666667, 1.       , 1.       , 1.       ], dtype=float32), array([1.  , 0.75, 0.5 , 0.25, 0.  ], dtype=float32))
+        (Array([0.        , 0.        , 0.        , 0.33333334, 1.        ],      dtype=float32), Array([0.       , 0.6666667, 1.       , 1.       , 1.       ], dtype=float32), Array([1.  , 0.75, 0.5 , 0.25, 0.  ], dtype=float32))
     """
     def _compute(self, state):
         return _binary_roc_compute(self._curve_state(state), self.thresholds)
@@ -58,7 +58,7 @@ class MulticlassROC(MulticlassPrecisionRecallCurve):
                [0.        , 0.        , 0.        , 0.5       , 1.        ],
                [0.        , 0.        , 0.        , 0.33333334, 1.        ]],      dtype=float32), Array([[0. , 1. , 1. , 1. , 1. ],
                [0. , 0.5, 0.5, 1. , 1. ],
-               [0. , 0. , 1. , 1. , 1. ]], dtype=float32), array([1.  , 0.75, 0.5 , 0.25, 0.  ], dtype=float32))
+               [0. , 0. , 1. , 1. , 1. ]], dtype=float32), Array([1.  , 0.75, 0.5 , 0.25, 0.  ], dtype=float32))
     """
     def _compute(self, state):
         return _multiclass_roc_compute(self._curve_state(state), self.num_classes, self.thresholds, self.average)
@@ -85,7 +85,7 @@ class MultilabelROC(MultilabelPrecisionRecallCurve):
                [0. , 0.5, 0.5, 0.5, 1. ],
                [0. , 0. , 0. , 0. , 1. ]], dtype=float32), Array([[0. , 1. , 1. , 1. , 1. ],
                [0. , 0. , 1. , 1. , 1. ],
-               [0. , 0.5, 0.5, 1. , 1. ]], dtype=float32), array([1.  , 0.75, 0.5 , 0.25, 0.  ], dtype=float32))
+               [0. , 0.5, 0.5, 1. , 1. ]], dtype=float32), Array([1.  , 0.75, 0.5 , 0.25, 0.  ], dtype=float32))
     """
     def _compute(self, state):
         return _multilabel_roc_compute(self._curve_state(state), self.num_labels, self.thresholds, self.ignore_index)

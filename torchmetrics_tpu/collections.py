@@ -12,6 +12,7 @@ if members are later fused into one jit.
 
 from __future__ import annotations
 
+import dataclasses
 from collections import OrderedDict
 from copy import deepcopy
 from typing import Any, Dict, Hashable, Iterable, List, Mapping, Optional, Sequence, Tuple, Union
@@ -24,6 +25,31 @@ from .utilities.data import _flatten_dict, allclose
 from .utilities.prints import rank_zero_warn
 
 _ERROR_MSG = "Unknown input to MetricCollection."
+
+_ON_ERROR_MODES = ("raise", "skip", "quarantine")
+
+
+@dataclasses.dataclass(frozen=True)
+class QuarantinedMetric:
+    """Status marker surfaced in ``compute()`` for a metric that failed under
+    ``on_error="quarantine"`` (or errored at compute under ``on_error="skip"``).
+
+    The healthy rest of the collection keeps computing; this object carries what a
+    monitoring layer needs: which metric, at which stage, the last error, and how
+    many updates it had absorbed before failing.
+    """
+
+    name: str
+    status: str  # "quarantined" (permanent until reset) | "skipped" (this compute only)
+    stage: str  # "update" | "forward" | "compute"
+    error: str  # repr of the triggering exception
+    update_count: int
+
+    def __repr__(self) -> str:  # compact, log-friendly
+        return (
+            f"QuarantinedMetric({self.name!r}, status={self.status!r}, stage={self.stage!r}, "
+            f"after {self.update_count} updates: {self.error})"
+        )
 
 
 def _flatten_with_naming(res: Dict[str, Any], set_name) -> Dict[str, Any]:
@@ -64,7 +90,18 @@ class MetricCollection:
         prefix: Optional[str] = None,
         postfix: Optional[str] = None,
         compute_groups: Union[bool, List[List[str]]] = True,
+        on_error: str = "raise",
     ) -> None:
+        """``on_error`` (graceful-degradation policy, reliability layer):
+
+        - ``"raise"`` (default): any metric error propagates — today's behavior.
+        - ``"skip"``: the failing metric misses that batch (warned); a compute
+          failure yields a :class:`QuarantinedMetric` marker for that key only.
+        - ``"quarantine"``: the failing metric is frozen at its last good state,
+          split out of its compute group (the donated fused update keeps serving
+          the healthy members), excluded from further updates, and reported as a
+          :class:`QuarantinedMetric` in ``compute()``. ``reset()`` lifts it.
+        """
         self._modules = OrderedDict()
         self.prefix = self._check_arg(prefix, "prefix")
         self.postfix = self._check_arg(postfix, "postfix")
@@ -72,6 +109,11 @@ class MetricCollection:
         self._groups_checked = False
         self._state_is_copy = False
         self._groups: Dict[int, List[str]] = {}
+        if on_error not in _ON_ERROR_MODES:
+            raise ValueError(f"Expected `on_error` to be one of {_ON_ERROR_MODES}, got {on_error!r}")
+        self.on_error = on_error
+        self._quarantined: Dict[str, Tuple[str, BaseException]] = {}  # name -> (stage, exc)
+        self._degraded = False  # any failure-driven group split happened since reset
         self.add_metrics(metrics, *additional_metrics)
 
     @staticmethod
@@ -180,7 +222,8 @@ class MetricCollection:
         return self._groups
 
     def _init_compute_groups(self) -> None:
-        """Reference collections.py:521."""
+        """Reference collections.py:521. Quarantined metrics never join a group —
+        their state is frozen and must not alias a live leader's dict."""
         if isinstance(self._enable_compute_groups, list):
             self._groups = dict(enumerate(self._enable_compute_groups))
             for v in self._groups.values():
@@ -189,9 +232,20 @@ class MetricCollection:
                         raise ValueError(
                             f"Input {name} in `compute_groups` argument does not match a metric in the collection."
                         )
+            if self._quarantined:
+                self._groups = {
+                    i: kept
+                    for i, (_, kept) in enumerate(
+                        (gid, [n for n in members if n not in self._quarantined])
+                        for gid, members in self._groups.items()
+                    )
+                    if kept
+                }
             self._groups_checked = True
         elif self._enable_compute_groups:
-            self._groups = {i: [str(k)] for i, k in enumerate(self._modules)}
+            self._groups = {
+                i: [str(k)] for i, k in enumerate(k for k in self._modules if k not in self._quarantined)
+            }
         else:
             self._groups = {}
 
@@ -255,24 +309,143 @@ class MetricCollection:
                         member._state = leader._state
         self._state_is_copy = copy_state
 
+    # ----------------------------------------------------- graceful degradation
+
+    @property
+    def quarantined(self) -> Dict[str, BaseException]:
+        """Currently quarantined metrics: name → last exception (empty when healthy)."""
+        return {name: exc for name, (_, exc) in self._quarantined.items()}
+
+    def _status_marker(self, name: str) -> QuarantinedMetric:
+        stage, exc = self._quarantined[name]
+        return QuarantinedMetric(
+            name=name, status="quarantined", stage=stage, error=repr(exc),
+            update_count=self._modules[name]._update_count,
+        )
+
+    def _failure_marker(self, name: str, stage: str, exc: BaseException) -> QuarantinedMetric:
+        status = "quarantined" if name in self._quarantined else "skipped"
+        return QuarantinedMetric(
+            name=name, status=status, stage=stage, error=repr(exc),
+            update_count=self._modules[name]._update_count,
+        )
+
+    def _detach_from_group(self, name: str) -> None:
+        """Split ``name`` out of its compute group: de-alias its state (members share
+        the leader's state DICT OBJECT, so a frozen/failed member must get its own
+        copy before the survivors' donated update mutates the shared one). Buffers
+        are copied too, not just containers — the survivors' jitted update DONATES
+        the shared arrays, which would leave the detached metric holding deleted
+        buffers (same hazard Metric.__deepcopy__ documents)."""
+        metric = self._modules[name]
+        metric._state = self._state_backup(metric)
+        metric._computed = None
+        for gid in list(self._groups):
+            members = self._groups[gid]
+            if name in members:
+                members.remove(name)
+                if not members:
+                    del self._groups[gid]
+                break
+
+    @staticmethod
+    def _state_backup(metric: Metric) -> Dict[str, Any]:
+        """Undonated copies of a metric's tensor leaves (list leaves keep their
+        elements — they never enter the donated call, only the containers are
+        copied so a failed batch's appends can be rolled back)."""
+        return {
+            k: (list(v) if isinstance(v, list) else jnp.copy(v))
+            for k, v in metric._state.items()
+        }
+
+    @staticmethod
+    def _state_restore(metric: Metric, backup: Dict[str, Any]) -> None:
+        """Roll a metric back to a pre-attempt backup IN PLACE — group members
+        alias the state dict object, so the dict itself must survive. A failed
+        donated dispatch may have deleted the live buffers (real donation on TPU;
+        a no-op on CPU), which is why degrading policies back up before every
+        attempt instead of assuming dispatch atomicity."""
+        metric._state.clear()
+        metric._state.update(backup)
+        metric._n_prev_dev = None  # the device-side counter was donated too
+        metric._computed = None
+
+    def _handle_metric_error(self, name: str, exc: BaseException, stage: str) -> None:
+        """Degrade per policy (never called under ``on_error="raise"``)."""
+        self._detach_from_group(name)
+        self._degraded = True
+        if self.on_error == "quarantine":
+            self._quarantined[name] = (stage, exc)
+            rank_zero_warn(
+                f"Metric {name!r} failed during {stage} and was quarantined "
+                f"(on_error='quarantine'); the rest of the collection continues: {exc!r}",
+                UserWarning,
+            )
+        else:  # skip: misses this batch only; continues as its own compute group
+            if self._groups_checked and self._enable_compute_groups:
+                # applies to explicit compute_groups lists too — without a group of
+                # its own the metric would silently miss every future batch
+                self._groups[max(self._groups, default=-1) + 1] = [name]
+            rank_zero_warn(
+                f"Metric {name!r} failed during {stage} and was skipped for this batch "
+                f"(on_error='skip'): {exc!r}",
+                UserWarning,
+            )
+
     # -------------------------------------------------------------- lifecycle
+
+    def _update_group(self, members: List[str], args: tuple, kwargs: dict) -> None:
+        """Update one compute group; on failure under a degrading policy the shared
+        state rolls back to its pre-attempt backup (the donated buffers may be
+        deleted), the failing member is split out, and the next member takes over
+        as leader for THIS batch."""
+        while members:
+            leader = self._modules[members[0]]
+            if self.on_error == "raise":
+                leader.update(*args, **leader._filter_kwargs(**kwargs))
+            else:
+                backup = self._state_backup(leader)
+                try:
+                    leader.update(*args, **leader._filter_kwargs(**kwargs))
+                except Exception as exc:  # noqa: BLE001 — policy decides
+                    self._state_restore(leader, backup)
+                    self._handle_metric_error(members[0], exc, "update")
+                    continue
+            for name in members[1:]:
+                member = self._modules[name]
+                member._update_count = leader._update_count
+                member._computed = None
+            return
 
     def update(self, *args: Any, **kwargs: Any) -> None:
         """Reference collections.py:237-267."""
         if self._groups_checked and self._groups:
             # only group leaders run update; members share the leader's state dict
-            for members in self._groups.values():
-                leader = self._modules[members[0]]
-                leader.update(*args, **leader._filter_kwargs(**kwargs))
-                for name in members[1:]:
-                    member = self._modules[name]
-                    member._update_count = leader._update_count
-                    member._computed = None
+            for members in list(self._groups.values()):
+                self._update_group(members, args, kwargs)
             if self._state_is_copy:
                 self._compute_groups_create_state_ref()
         else:
-            for metric in self._modules.values():
-                metric.update(*args, **metric._filter_kwargs(**kwargs))
+            failed_this_batch = False
+            for name, metric in list(self._modules.items()):
+                if name in self._quarantined:
+                    continue
+                if self.on_error == "raise":
+                    metric.update(*args, **metric._filter_kwargs(**kwargs))
+                else:
+                    backup = self._state_backup(metric)
+                    try:
+                        metric.update(*args, **metric._filter_kwargs(**kwargs))
+                    except Exception as exc:  # noqa: BLE001
+                        self._state_restore(metric, backup)
+                        self._handle_metric_error(name, exc, "update")
+                        failed_this_batch = True
+            if failed_this_batch and not self._groups_checked:
+                # never derive fusion groups from a batch where some metric was
+                # rolled back to defaults: state-equality would wrongly fuse
+                # distinct metrics sitting at identical default states — wait for
+                # a clean batch instead
+                return
             if self._enable_compute_groups and not self._groups_checked:
                 self._init_compute_groups()
                 if not isinstance(self._enable_compute_groups, list):
@@ -280,21 +453,70 @@ class MetricCollection:
                 self._compute_groups_create_state_ref()
             self._groups_checked = True
 
+    def _forward_group(self, members: List[str], res: Dict[str, Any], args: tuple, kwargs: dict) -> None:
+        while members:
+            name = members[0]
+            leader = self._modules[name]
+            if self.on_error == "raise":
+                res[name] = leader.forward(*args, **leader._filter_kwargs(**kwargs))
+            else:
+                backup = self._state_backup(leader)
+                try:
+                    res[name] = leader.forward(*args, **leader._filter_kwargs(**kwargs))
+                except Exception as exc:  # noqa: BLE001
+                    self._state_restore(leader, backup)
+                    self._handle_metric_error(name, exc, "forward")
+                    res[name] = self._failure_marker(name, "forward", exc)
+                    continue
+            for mname in list(members[1:]):
+                member = self._modules[mname]
+                if self.on_error == "raise":
+                    res[mname] = member._compute(leader._last_batch_state)
+                else:
+                    try:
+                        res[mname] = member._compute(leader._last_batch_state)
+                    except Exception as exc:  # noqa: BLE001
+                        # the leader's forward already folded this batch into the
+                        # SHARED state the member detaches with — sync the count
+                        # first, or count-weighted ('mean') states skew forever
+                        member._update_count = leader._update_count
+                        self._handle_metric_error(mname, exc, "forward")
+                        res[mname] = self._failure_marker(mname, "forward", exc)
+                        continue
+                member._update_count = leader._update_count
+                member._computed = None
+            return
+
     def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
         """Batch values for all metrics + state accumulation."""
-        res = {}
+        res: Dict[str, Any] = {}
         if self._groups_checked and self._groups:
-            for members in self._groups.values():
-                leader = self._modules[members[0]]
-                res[members[0]] = leader.forward(*args, **leader._filter_kwargs(**kwargs))
-                for name in members[1:]:
-                    member = self._modules[name]
-                    res[name] = member._compute(leader._last_batch_state)
-                    member._update_count = leader._update_count
-                    member._computed = None
+            for members in list(self._groups.values()):
+                self._forward_group(members, res, args, kwargs)
+            for name in self._quarantined:
+                res.setdefault(name, self._status_marker(name))
+            res = {name: res[name] for name in self._modules if name in res}
         else:
-            for name, metric in self._modules.items():
-                res[name] = metric.forward(*args, **metric._filter_kwargs(**kwargs))
+            failed_this_batch = False
+            for name, metric in list(self._modules.items()):
+                if name in self._quarantined:
+                    res[name] = self._status_marker(name)
+                    continue
+                if self.on_error == "raise":
+                    res[name] = metric.forward(*args, **metric._filter_kwargs(**kwargs))
+                else:
+                    backup = self._state_backup(metric)
+                    try:
+                        res[name] = metric.forward(*args, **metric._filter_kwargs(**kwargs))
+                    except Exception as exc:  # noqa: BLE001
+                        self._state_restore(metric, backup)
+                        self._handle_metric_error(name, exc, "forward")
+                        res[name] = self._failure_marker(name, "forward", exc)
+                        failed_this_batch = True
+            if failed_this_batch and not self._groups_checked:
+                # as in update(): rolled-back default states must not seed the
+                # state-equality group derivation
+                return self._flatten_res(res)
             if self._enable_compute_groups and not self._groups_checked:
                 self._init_compute_groups()
                 if not isinstance(self._enable_compute_groups, list):
@@ -306,7 +528,18 @@ class MetricCollection:
     __call__ = forward
 
     def compute(self) -> Dict[str, Any]:
-        res = {name: metric.compute() for name, metric in self._modules.items()}
+        res: Dict[str, Any] = {}
+        for name, metric in self._modules.items():
+            if name in self._quarantined:
+                res[name] = self._status_marker(name)
+            elif self.on_error == "raise":
+                res[name] = metric.compute()
+            else:
+                try:
+                    res[name] = metric.compute()
+                except Exception as exc:  # noqa: BLE001
+                    self._handle_metric_error(name, exc, "compute")
+                    res[name] = self._failure_marker(name, "compute", exc)
         return self._flatten_res(res)
 
     def _flatten_res(self, res: Dict[str, Any]) -> Dict[str, Any]:
@@ -328,26 +561,63 @@ class MetricCollection:
             raise ValueError(
                 f"Cannot merge collections with different metrics: {sorted(set(mine) ^ set(theirs))}"
             )
+        frozen = set(self._quarantined) | set(incoming._quarantined)
+        if frozen:
+            rank_zero_warn(
+                f"merge_state skipping quarantined metrics {sorted(frozen)}: their states are "
+                "frozen at the last good value and must not fold.",
+                UserWarning,
+            )
         if self._groups_checked and self._groups:
             grouped = {name for members in self._groups.values() for name in members}
             for members in self._groups.values():
-                leader = members[0]
+                # fold through the first member healthy on BOTH sides: an incoming
+                # quarantine only freezes THAT metric's shard, not its group-mates'
+                # contributions (skipping the whole group would silently drop them)
+                live = [n for n in members if n not in frozen]
+                if not live:
+                    rank_zero_warn(
+                        f"merge_state: compute group {members} has no member healthy on "
+                        "both sides; the incoming contribution of this group is dropped.",
+                        UserWarning,
+                    )
+                    continue
+                leader = live[0]
                 mine[leader].merge_state(theirs[leader])
-                for name in members[1:]:
+                for name in members:
+                    if name == leader:
+                        continue
                     mine[name]._state = mine[leader]._state
                     mine[name]._update_count = mine[leader]._update_count
                     mine[name]._computed = None
             for name, metric in mine.items():
-                if name not in grouped:
+                if name not in grouped and name not in frozen:
                     metric.merge_state(theirs[name])
         else:
             for name, metric in mine.items():
-                metric.merge_state(theirs[name])
+                if name not in frozen:
+                    metric.merge_state(theirs[name])
 
     def reset(self) -> None:
         for metric in self._modules.values():
             metric.reset()
-        if self._groups_checked and self._groups:
+        if self._quarantined or self._degraded:
+            # lift quarantine and forget the failure-driven group splits: groups
+            # re-derive from scratch on the next update (same as a fresh collection).
+            # A healthy skip/quarantine collection keeps its fused groups — only
+            # collections that actually split pay the re-derivation. Formerly-grouped
+            # members must also stop ALIASING one state: with groups cleared the next
+            # update runs every metric separately, so a still-shared dict would absorb
+            # the same batch once per member (double-counting) and a shared BUFFER
+            # would be deleted by the first member's donated update.
+            for metric in self._modules.values():
+                metric._state = self._state_backup(metric)
+            self._quarantined.clear()
+            self._degraded = False
+            self._groups = {}
+            self._groups_checked = False
+            self._state_is_copy = False
+        elif self._groups_checked and self._groups:
             self._compute_groups_create_state_ref()
 
     def clone(self, prefix: Optional[str] = None, postfix: Optional[str] = None) -> "MetricCollection":
@@ -382,9 +652,9 @@ class MetricCollection:
             metric.state_dict(out, prefix=f"{name}.")
         return out
 
-    def load_state_dict(self, state_dict: Dict[str, Any]) -> None:
+    def load_state_dict(self, state_dict: Dict[str, Any], validate: bool = True) -> None:
         for name, metric in self._modules.items():
-            metric.load_state_dict(state_dict, prefix=f"{name}.")
+            metric.load_state_dict(state_dict, prefix=f"{name}.", validate=validate)
 
     def sync(self, **kwargs: Any) -> None:
         for metric in self._modules.values():

@@ -190,7 +190,10 @@ def _binary_precision_recall_curve_compute(
         recall = _safe_divide(tps, tps + fns, zero_division=jnp.nan)
         precision = jnp.concatenate([precision, jnp.ones(1, precision.dtype)])
         recall = jnp.concatenate([recall, jnp.zeros(1, recall.dtype)])
-        return precision, recall, thresholds
+        # thresholds live as numpy until here (closure-captured by jitted updates);
+        # the OUTPUT tuple is homogeneous jax Arrays like the reference's device
+        # tensors (ADVICE round 5)
+        return precision, recall, jnp.asarray(thresholds)
     fps, tps, thres = _binary_clf_curve(state[0], state[1], pos_label=pos_label)
     precision = tps / (tps + fps)
     recall = tps / tps[-1]
@@ -221,7 +224,7 @@ def binary_precision_recall_curve(
         >>> preds = jnp.asarray([0.11, 0.22, 0.84, 0.73, 0.33, 0.92])
         >>> target = jnp.asarray([0, 0, 1, 1, 0, 1])
         >>> binary_precision_recall_curve(preds, target, thresholds=5)
-        (Array([0.5 , 0.75, 1.  , 1.  ,  nan, 1.  ], dtype=float32), Array([1.       , 1.       , 1.       , 0.6666667, 0.       , 0.       ],      dtype=float32), array([0.  , 0.25, 0.5 , 0.75, 1.  ], dtype=float32))
+        (Array([0.5 , 0.75, 1.  , 1.  ,  nan, 1.  ], dtype=float32), Array([1.       , 1.       , 1.       , 0.6666667, 0.       , 0.       ],      dtype=float32), Array([0.  , 0.25, 0.5 , 0.75, 1.  ], dtype=float32))
     """
     if validate_args:
         _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
@@ -326,7 +329,7 @@ def _multiclass_precision_recall_curve_compute(
         recall = _safe_divide(tps, tps + fns, zero_division=jnp.nan)
         precision = jnp.concatenate([precision, jnp.ones((1, num_classes), precision.dtype)], axis=0).T
         recall = jnp.concatenate([recall, jnp.zeros((1, num_classes), recall.dtype)], axis=0).T
-        return precision, recall, thresholds
+        return precision, recall, jnp.asarray(thresholds)  # homogeneous jax output tuple
     precision_list, recall_list, thres_list = [], [], []
     for i in range(num_classes):
         p, r, t = _binary_precision_recall_curve_compute((state[0][:, i], state[1]), None, pos_label=i)
@@ -357,7 +360,7 @@ def multiclass_precision_recall_curve(
                [0.5      , 0.6666667, 1.       , 1.       ,       nan, 1.       ],
                [0.25     , 0.5      , 1.       ,       nan,       nan, 1.       ]],      dtype=float32), Array([[1. , 1. , 1. , 1. , 0. , 0. ],
                [1. , 1. , 0.5, 0.5, 0. , 0. ],
-               [1. , 1. , 1. , 0. , 0. , 0. ]], dtype=float32), array([0.  , 0.25, 0.5 , 0.75, 1.  ], dtype=float32))
+               [1. , 1. , 1. , 0. , 0. , 0. ]], dtype=float32), Array([0.  , 0.25, 0.5 , 0.75, 1.  ], dtype=float32))
     """
     if validate_args:
         _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index, average)
@@ -467,7 +470,7 @@ def multilabel_precision_recall_curve(
                [0.6666667 , 1.        , 1.        , 1.        ,        nan,
                 1.        ]], dtype=float32), Array([[1. , 1. , 1. , 1. , 0. , 0. ],
                [1. , 1. , 1. , 0. , 0. , 0. ],
-               [1. , 1. , 0.5, 0.5, 0. , 0. ]], dtype=float32), array([0.  , 0.25, 0.5 , 0.75, 1.  ], dtype=float32))
+               [1. , 1. , 0.5, 0.5, 0. , 0. ]], dtype=float32), Array([0.  , 0.25, 0.5 , 0.75, 1.  ], dtype=float32))
     """
     if validate_args:
         _multilabel_precision_recall_curve_arg_validation(num_labels, thresholds, ignore_index)

@@ -40,7 +40,8 @@ def _binary_roc_compute(
         tns = state[:, 0, 0]
         tpr = _safe_divide(tps, tps + fns)[::-1]
         fpr = _safe_divide(fps, fps + tns)[::-1]
-        return fpr, tpr, thresholds[::-1]
+        # homogeneous jax output tuple (thresholds are host numpy until compute)
+        return fpr, tpr, jnp.asarray(thresholds)[::-1]
     fps, tps, thres = _binary_clf_curve(preds=state[0], target=state[1], pos_label=pos_label)
     # extra threshold so the curve starts at (0, 0)
     tps = jnp.concatenate([jnp.zeros(1, tps.dtype), tps])
@@ -68,7 +69,7 @@ def binary_roc(preds, target, thresholds=None, ignore_index: Optional[int] = Non
         >>> preds = jnp.asarray([0.11, 0.22, 0.84, 0.73, 0.33, 0.92])
         >>> target = jnp.asarray([0, 0, 1, 1, 0, 1])
         >>> binary_roc(preds, target, thresholds=5)
-        (Array([0.        , 0.        , 0.        , 0.33333334, 1.        ],      dtype=float32), Array([0.       , 0.6666667, 1.       , 1.       , 1.       ], dtype=float32), array([1.  , 0.75, 0.5 , 0.25, 0.  ], dtype=float32))
+        (Array([0.        , 0.        , 0.        , 0.33333334, 1.        ],      dtype=float32), Array([0.       , 0.6666667, 1.       , 1.       , 1.       ], dtype=float32), Array([1.  , 0.75, 0.5 , 0.25, 0.  ], dtype=float32))
     """
     if validate_args:
         _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
@@ -95,7 +96,7 @@ def _multiclass_roc_compute(
         fpr = _safe_divide(fps, fps + tns)[::-1].T
         if average == "macro":
             return _macro_interpolate_curves(fpr, tpr, jnp.tile(thresholds[::-1], num_classes), num_classes)
-        return fpr, tpr, thresholds[::-1]
+        return fpr, tpr, jnp.asarray(thresholds)[::-1]
     fpr_list, tpr_list, thres_list = [], [], []
     for i in range(num_classes):
         f, t, th = _binary_roc_compute((state[0][:, i], state[1]), None, pos_label=i)
@@ -141,7 +142,7 @@ def multiclass_roc(
                [0.        , 0.        , 0.        , 0.5       , 1.        ],
                [0.        , 0.        , 0.        , 0.33333334, 1.        ]],      dtype=float32), Array([[0. , 1. , 1. , 1. , 1. ],
                [0. , 0.5, 0.5, 1. , 1. ],
-               [0. , 0. , 1. , 1. , 1. ]], dtype=float32), array([1.  , 0.75, 0.5 , 0.25, 0.  ], dtype=float32))
+               [0. , 0. , 1. , 1. , 1. ]], dtype=float32), Array([1.  , 0.75, 0.5 , 0.25, 0.  ], dtype=float32))
     """
     if validate_args:
         _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index, average)
@@ -190,7 +191,7 @@ def multilabel_roc(
                [0. , 0.5, 0.5, 0.5, 1. ],
                [0. , 0. , 0. , 0. , 1. ]], dtype=float32), Array([[0. , 1. , 1. , 1. , 1. ],
                [0. , 0. , 1. , 1. , 1. ],
-               [0. , 0.5, 0.5, 1. , 1. ]], dtype=float32), array([1.  , 0.75, 0.5 , 0.25, 0.  ], dtype=float32))
+               [0. , 0.5, 0.5, 1. , 1. ]], dtype=float32), Array([1.  , 0.75, 0.5 , 0.25, 0.  ], dtype=float32))
     """
     if validate_args:
         _multilabel_precision_recall_curve_arg_validation(num_labels, thresholds, ignore_index)

@@ -45,6 +45,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from .parallel import sync as _sync
+from .reliability.guards import validate_restored, validate_state
+from .reliability.retry import ReliabilityConfig
 from .utilities.checks import _is_traced
 from .utilities.data import _flatten, dim_zero_cat
 from .utilities.exceptions import TorchMetricsUserError
@@ -89,7 +91,10 @@ class Metric:
     ``compute_on_cpu``, ``dist_sync_on_step``, ``process_group`` (mesh axis name(s)),
     ``dist_sync_fn``, ``distributed_available_fn``, ``sync_on_compute``,
     ``compute_with_cache``, plus TPU-specific ``jit`` (default True) to disable the
-    jitted update path for debugging.
+    jitted update path for debugging, and ``reliability`` (a
+    :class:`~torchmetrics_tpu.reliability.ReliabilityConfig`, default ``None``) to
+    opt into transient-failure retry at the dispatch boundaries and state-integrity
+    guards at sync/merge/restore boundaries.
     """
 
     __jit_warned = False
@@ -120,6 +125,12 @@ class Metric:
             raise ValueError(f"Expected keyword argument `sync_on_compute` to be a `bool` but got {self.sync_on_compute}")
         self.compute_with_cache = kwargs.pop("compute_with_cache", True)
         self._enable_jit = kwargs.pop("jit", True)
+        self._reliability = kwargs.pop("reliability", None)
+        if self._reliability is not None and not isinstance(self._reliability, ReliabilityConfig):
+            raise ValueError(
+                f"Expected keyword argument `reliability` to be a `ReliabilityConfig` but got {self._reliability}"
+            )
+        self._fault_hook = None  # fault-injection seam (reliability/faults.py)
         if kwargs:
             kwargs_ = [f"`{a}`" for a in sorted(kwargs)]
             raise ValueError(f"Unexpected keyword arguments: {', '.join(kwargs_)}")
@@ -300,6 +311,61 @@ class Metric:
     def _has_custom_merge(self) -> bool:
         return type(self)._merge is not Metric._merge
 
+    # --------------------------------------------------------- reliability seam
+
+    def _attempt(self, tag: str, thunk: Callable[[], Any]) -> Any:
+        """One dispatch attempt; the fault-injection hook fires where a remote
+        compile/dispatch failure would surface (before the XLA call)."""
+        hook = self._fault_hook
+        if hook is not None:
+            hook(tag)
+        return thunk()
+
+    def _reliable_call(self, tag: str, thunk: Callable[[], Any], restore: Optional[Callable] = None) -> Any:
+        """Dispatch boundary: retries transient failures when a RetryPolicy is
+        configured; otherwise today's single-attempt behavior, byte for byte.
+        ``restore(exc, attempt)`` re-materializes donated inputs before a retry."""
+        rel = self._reliability
+        if rel is None or rel.retry is None:
+            return self._attempt(tag, thunk)
+        return rel.retry.call(
+            lambda: self._attempt(tag, thunk), on_retry=restore, describe=f"{type(self).__name__}.{tag}"
+        )
+
+    def _donation_safe_dispatch(self, tag: str, call: Callable[..., Any], tensors: StateDict) -> Any:
+        """Dispatch a jitted call that DONATES its tensor-state argument (and, for
+        ``update``, the device counter). ``call(t, n)`` receives the live tensor
+        dict and device-side update counter.
+
+        Default path (no retry): single attempt, no copies — byte-for-byte today's
+        behavior. With a RetryPolicy: an undonated device-side backup lets every
+        retry see intact inputs, and when the budget is exhausted the backup
+        replaces the donated (deleted) live buffers in ``self._state`` before the
+        exception re-raises, so the metric stays usable at its last good state.
+        """
+        rel = self._reliability
+        if rel is None or rel.retry is None:
+            return self._attempt(tag, lambda: call(tensors, self._device_update_count()))
+        backup = {k: jnp.copy(v) for k, v in tensors.items()}
+        n_backup = jnp.copy(self._device_update_count())
+        live = {"t": tensors, "n": self._device_update_count()}
+
+        def restore(exc: BaseException, attempt: int) -> None:
+            live["t"] = {k: jnp.copy(v) for k, v in backup.items()}
+            live["n"] = jnp.copy(n_backup)
+
+        try:
+            return rel.retry.call(
+                lambda: self._attempt(tag, lambda: call(live["t"], live["n"])),
+                on_retry=restore,
+                describe=f"{type(self).__name__}.{tag}",
+            )
+        except Exception:
+            for k, v in backup.items():
+                self._state[k] = v
+            self._n_prev_dev = None
+            raise
+
     def update(self, *args: Any, **kwargs: Any) -> None:
         """Accumulate this batch into global state (one donated XLA call)."""
         if self._is_synced:
@@ -309,9 +375,10 @@ class Metric:
             )
         args, kwargs = self._prepare_inputs(*args, **kwargs)
         tensors, _ = self._split_tensor_list(self._state)
+        fn = self._get_update_fn()
         with jax.profiler.TraceAnnotation(f"{type(self).__name__}.update"):
-            new_t, appends, self._n_prev_dev = self._get_update_fn()(
-                tensors, self._device_update_count(), *args, **kwargs
+            new_t, appends, self._n_prev_dev = self._donation_safe_dispatch(
+                "update", lambda t, n: fn(t, n, *args, **kwargs), tensors
             )
         for k, v in new_t.items():
             self._state[k] = v
@@ -364,8 +431,10 @@ class Metric:
                 return new_t, appends, val, batch_full
 
             self._jit_cache[key] = jax.jit(fn, donate_argnums=0) if (self._enable_jit and self._jittable_compute) else fn
-        new_t, appends, val, batch_full = self._jit_cache[key](
-            self._split_tensor_list(self._state)[0], self._device_update_count(), *args, **kwargs
+        fwd = self._jit_cache[key]
+        tensors = self._split_tensor_list(self._state)[0]
+        new_t, appends, val, batch_full = self._donation_safe_dispatch(
+            "forward", lambda t, n: fwd(t, n, *args, **kwargs), tensors
         )
         self._n_prev_dev = None  # forward does not return the incremented counter
         for k, v in new_t.items():
@@ -422,7 +491,7 @@ class Metric:
         try:
             state = self._concat_state()
             with jax.profiler.TraceAnnotation(f"{type(self).__name__}.compute"):
-                value = self._compute(state)
+                value = self._reliable_call("compute", lambda: self._compute(state))
         finally:
             if did_sync:
                 self.unsync()
@@ -456,12 +525,20 @@ class Metric:
         if not should_sync or not is_dist:
             return
         self._cache = {k: (list(v) if isinstance(v, list) else v) for k, v in self._state.items()}
-        synced = _sync.process_sync(
-            self._state,
-            self._reductions,
-            process_group=process_group or self.process_group,
-            dist_sync_fn=dist_sync_fn or self.dist_sync_fn,
+        synced = self._reliable_call(
+            "sync",
+            lambda: _sync.process_sync(
+                self._state,
+                self._reductions,
+                process_group=process_group or self.process_group,
+                dist_sync_fn=dist_sync_fn or self.dist_sync_fn,
+            ),
         )
+        rel = self._reliability
+        if rel is not None and rel.validate_on_sync:
+            # a corrupt contribution from any participant must not silently become
+            # this process's state — StateCorruptionError leaves local state intact
+            validate_state(self, synced, context=f"{type(self).__name__}.sync", check_finite=rel.check_finite)
         self._state = synced
         self._is_synced = True
 
@@ -503,7 +580,11 @@ class Metric:
             # state_dict()-style dicts carry an "_update_count" metadata entry;
             # strip it from the state fold and use it as the dict's merge weight
             metas = [v for k, v in incoming_state.items() if k.endswith("_update_count")]
-            incoming = {k: v for k, v in incoming_state.items() if not k.endswith("_update_count")}
+            incoming = {
+                k: v
+                for k, v in incoming_state.items()
+                if not k.endswith(("_update_count", "_saved_states"))
+            }
             unknown = set(incoming) - set(self._state)
             if unknown:
                 raise RuntimeError(f"Got unknown state keys {sorted(unknown)}")
@@ -511,6 +592,23 @@ class Metric:
             raise ValueError("Expected incoming state to be a dict or an instance of Metric")
         if self._is_synced:
             raise TorchMetricsUserError("The Metric shouldn't be synced when performing ``merge_state``.")
+        rel = self._reliability
+        if rel is not None and rel.validate_on_merge:
+            # validate BOTH sides before folding, separately — merging the dicts
+            # would let incoming keys shadow the local accumulator's leaves and a
+            # corrupt accumulator would hide behind a clean-looking merged value
+            validate_state(
+                self,
+                self._state,
+                context=f"{type(self).__name__}.merge_state (local)",
+                check_finite=rel.check_finite,
+            )
+            validate_state(
+                self,
+                incoming,
+                context=f"{type(self).__name__}.merge_state (incoming)",
+                check_finite=rel.check_finite,
+            )
         if isinstance(incoming_state, Metric):
             incoming_count = incoming_state._update_count
         else:
@@ -588,13 +686,31 @@ class Metric:
                 destination[prefix + name] = np.asarray(current)
             wrote_any = True
         if wrote_any:
-            # metadata, not a state: lets load_state_dict restore the updated/fresh
-            # distinction exactly (value equality with defaults is an unreliable
-            # proxy — e.g. SumMetric().update(0.0) leaves the state at its default)
+            # metadata, not states: `_update_count` lets load_state_dict restore the
+            # updated/fresh distinction exactly (value equality with defaults is an
+            # unreliable proxy — e.g. SumMetric().update(0.0) leaves the state at its
+            # default); `_saved_states` records how many state leaves this save wrote,
+            # so restore can tell a truncated file from a legitimate partial save
+            # (mixed persistent/non-persistent states)
             destination[prefix + "_update_count"] = int(self._update_count)
+            destination[prefix + "_saved_states"] = int(
+                sum(1 for name in self._defaults if self._persistent[name])
+            )
         return destination
 
-    def load_state_dict(self, state_dict: dict, prefix: str = "") -> None:
+    def load_state_dict(self, state_dict: dict, prefix: str = "", validate: bool = True) -> None:
+        if validate:
+            # structural guard (always on): a truncated checkpoint — lost keys or
+            # partially-written arrays — raises StateCorruptionError instead of
+            # silently loading garbage. Finiteness scans are opt-in via
+            # ReliabilityConfig (a legitimately saved cat state may carry NaN).
+            rel = self._reliability
+            validate_restored(
+                self,
+                state_dict,
+                prefix,
+                check_finite=rel is not None and rel.validate_on_restore and rel.check_finite,
+            )
         loaded = False
         for name in self._defaults:
             key = prefix + name
@@ -641,6 +757,7 @@ class Metric:
         d["_cache"] = None
         d["_computed"] = None
         d["dist_sync_fn"] = None  # callables don't survive pickling
+        d["_fault_hook"] = None  # injection hooks are process-local by nature
         return d
 
     def __setstate__(self, state: dict) -> None:
@@ -650,6 +767,8 @@ class Metric:
             k: ([jnp.asarray(x) for x in v] if isinstance(v, list) else jnp.asarray(v)) for k, v in self._state.items()
         }
         self.distributed_available_fn = _sync.distributed_available
+        self.__dict__.setdefault("_reliability", None)
+        self.__dict__.setdefault("_fault_hook", None)
 
     # ------------------------------------------------------------ device/dtype
 
@@ -833,7 +952,11 @@ class HostMetric(Metric):
                 "HINT: Did you forget to call ``unsync`` ?"
             )
         args, kwargs = self._prepare_inputs(*args, **kwargs)
-        self._fold_batch(self._host_batch_state(*args, **kwargs))
+        # retry wraps only the batch-state computation (the expensive/dispatchy
+        # part, e.g. third-party host callbacks); the fold below is pure local
+        # assignment and must not be double-applied by a retry
+        bs = self._reliable_call("update", lambda: self._host_batch_state(*args, **kwargs))
+        self._fold_batch(bs)
 
     def forward(self, *args: Any, **kwargs: Any) -> Any:
         if self._is_synced:
@@ -845,7 +968,7 @@ class HostMetric(Metric):
             self._computed = None
             return val
         args, kwargs = self._prepare_inputs(*args, **kwargs)
-        bs = self._host_batch_state(*args, **kwargs)
+        bs = self._reliable_call("forward", lambda: self._host_batch_state(*args, **kwargs))
         batch_full = dict(self.init_state())
         for k, v in bs.items():
             if k in self._list_state_names:

@@ -225,6 +225,13 @@ def process_sync(
 
     ``dist_sync_fn`` is the injection seam (reference metric.py:133): signature
     ``fn(value, group) -> list_of_values``.
+
+    Transient-failure retry lives one level up: ``Metric.sync`` wraps the whole
+    ``process_sync`` call under its ``ReliabilityConfig`` retry policy. That is
+    only safe when every rank runs the same deterministic policy and the failure
+    surfaces on all ranks before the collective is entered (host dropout /
+    coordination-service faults do; a one-rank mid-collective abort needs the
+    cluster-level restart path instead).
     """
     gather = dist_sync_fn or gather_all_arrays
     out: Dict[str, Any] = {}

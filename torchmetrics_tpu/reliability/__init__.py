@@ -1,0 +1,48 @@
+"""Reliability layer: transient-failure retry, fault injection, state-integrity guards.
+
+Grown out of the round-5 postmortem (the flagship FID bench config crashed on a
+transient remote-compile infra error and nothing retried it): a production eval
+stack on preemptible TPU pods must classify failures, retry the transient ones,
+guard state integrity at trust boundaries, and degrade gracefully instead of
+letting one bad metric kill the whole eval loop. See ``docs/reliability.md``.
+
+Everything here is opt-in: without a :class:`ReliabilityConfig` the metric runtime
+is byte-for-byte unchanged.
+"""
+
+from .faults import (
+    ROUND5_CRASH_MESSAGE,
+    DispatchFaultHook,
+    FlakyGather,
+    inject_dispatch_fault,
+    make_transient_error,
+    poison_state_leaf,
+    truncate_state_dict,
+)
+from .guards import validate_restored, validate_state
+from .retry import (
+    DETERMINISTIC,
+    TRANSIENT,
+    ReliabilityConfig,
+    RetryPolicy,
+    classify_exception,
+    is_transient_error_text,
+)
+
+__all__ = [
+    "DETERMINISTIC",
+    "TRANSIENT",
+    "ROUND5_CRASH_MESSAGE",
+    "DispatchFaultHook",
+    "FlakyGather",
+    "ReliabilityConfig",
+    "RetryPolicy",
+    "classify_exception",
+    "inject_dispatch_fault",
+    "is_transient_error_text",
+    "make_transient_error",
+    "poison_state_leaf",
+    "truncate_state_dict",
+    "validate_restored",
+    "validate_state",
+]

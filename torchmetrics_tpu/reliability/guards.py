@@ -1,0 +1,175 @@
+"""State-integrity guards.
+
+``validate_state`` checks a metric's state dict against the invariants its
+``init_state()`` spec implies — every registered leaf present, tensor leaves with
+shape-preserving reduction tags matching the default's shape/dtype, floating leaves
+finite — and raises :class:`~torchmetrics_tpu.utilities.exceptions.StateCorruptionError`
+naming the offending leaf.
+
+Guards run at the *boundaries* where corrupt state crosses trust domains — sync
+(another host's contribution), merge (another shard's accumulator), checkpoint
+restore (bytes from disk) — never per-update: the finiteness scan needs a
+device→host readback, which per-update would flip tunneled TPU runtimes into
+synchronous dispatch (metric.py's standing constraint).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Mapping, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utilities.exceptions import StateCorruptionError
+
+# reduction tags under which a tensor leaf keeps its default shape forever
+_SHAPE_PRESERVING = ("sum", "mean", "min", "max")
+
+
+def _spec_leaf(default: Any):
+    """Shape/dtype the live leaf must carry, derived the same way the live state is
+    born (``_fresh_leaf``): through ``jnp.asarray``, so x64-truncation matches."""
+    return jnp.asarray(default)
+
+
+def _check_tensor_leaf(
+    name: str, value: Any, default: Any, fx: Any, context: str, check_finite: bool
+) -> None:
+    if isinstance(value, list):
+        raise StateCorruptionError(
+            f"{context}: state '{name}' is a list but its spec is a tensor state."
+        )
+    if not hasattr(value, "shape") and not np.isscalar(value):
+        raise StateCorruptionError(
+            f"{context}: state '{name}' is {type(value).__name__}, expected an array."
+        )
+    value = jnp.asarray(value)
+    if isinstance(fx, str) and fx in _SHAPE_PRESERVING:
+        spec = _spec_leaf(default)
+        if tuple(value.shape) != tuple(spec.shape):
+            raise StateCorruptionError(
+                f"{context}: state '{name}' has shape {tuple(value.shape)}, "
+                f"spec requires {tuple(spec.shape)} (reduction '{fx}' preserves shape)."
+            )
+        if value.dtype != spec.dtype:
+            raise StateCorruptionError(
+                f"{context}: state '{name}' has dtype {value.dtype}, spec requires {spec.dtype}."
+            )
+        # finiteness is an invariant only for AGGREGATE leaves: a NaN in a
+        # sum/mean/min/max accumulator is always corruption, while raw-data
+        # leaves (cat lists, None-tagged gathers) may carry NaN by construction
+        # (e.g. masked user preds) — scanning those would reject healthy state
+        if check_finite and jnp.issubdtype(value.dtype, jnp.floating):
+            if not bool(jnp.isfinite(value).all()):
+                raise StateCorruptionError(
+                    f"{context}: state '{name}' contains non-finite values (NaN/Inf)."
+                )
+
+
+def validate_state(
+    metric: Any,
+    state: Optional[Dict[str, Any]] = None,
+    context: str = "validate_state",
+    check_finite: bool = True,
+) -> None:
+    """Validate ``state`` (default: the metric's live state) against the metric's
+    ``init_state()`` spec. Raises :class:`StateCorruptionError` naming the first
+    violated leaf; returns ``None`` on a clean state.
+
+    Sync can legitimately reshape ``None``-tagged leaves (world-stacked gather) and
+    grow ``cat`` leaves, so shape/dtype is enforced only for the shape-preserving
+    reduction tags; presence is enforced for every leaf; finiteness only for
+    aggregate (shape-preserving) leaves — raw-data leaves may carry NaN by
+    construction.
+    """
+    state = metric._state if state is None else state
+    for name, default in metric._defaults.items():
+        if name not in state:
+            raise StateCorruptionError(
+                f"{context}: state '{name}' of {type(metric).__name__} is missing "
+                f"(truncated or partially-written state)."
+            )
+        value = state[name]
+        fx = metric._reductions.get(name)
+        if isinstance(default, list):
+            # list (cat) leaves hold RAW user data — NaN can be legitimate there
+            # (masked preds), so only presence/type are enforced, never finiteness
+            elems = value if isinstance(value, list) else [value]
+            for i, elem in enumerate(elems):
+                if not hasattr(elem, "shape") and not np.isscalar(elem):
+                    raise StateCorruptionError(
+                        f"{context}: state '{name}[{i}]' is {type(elem).__name__}, expected an array."
+                    )
+        else:
+            _check_tensor_leaf(name, value, default, fx, context, check_finite)
+
+
+def validate_restored(
+    metric: Any,
+    state_dict: Mapping[str, Any],
+    prefix: str = "",
+    check_finite: bool = False,
+) -> None:
+    """Structural validation of a checkpoint slice BEFORE it is adopted.
+
+    A truncated/partial checkpoint must raise instead of silently loading garbage:
+    when the checkpoint's ``_update_count`` metadata proves this metric *was* saved,
+    every registered state must either be wholly present or wholly absent — some
+    present and some missing means the file lost keys. Present tensor leaves with
+    shape-preserving tags must match the spec's shape (a sliced/partially-written
+    array is corruption, not a resume).
+
+    ``check_finite=False`` by default: a legitimately saved state may carry NaN by
+    construction (e.g. raw user preds in a cat state); opt in via
+    ``ReliabilityConfig(validate_on_restore=True)``.
+    """
+    meta_key = prefix + "_update_count"
+    manifest_key = prefix + "_saved_states"
+    names = list(metric._defaults)
+    present = [n for n in names if prefix + n in state_dict]
+    if manifest_key in state_dict:
+        # the save recorded how many state leaves it wrote: fewer surviving means
+        # the file lost keys, while a partial-but-complete save (mixed persistent/
+        # non-persistent states) validates cleanly
+        expected = int(state_dict[manifest_key])
+        if len(present) < expected:
+            raise StateCorruptionError(
+                f"Checkpoint slice '{prefix}*' for {type(metric).__name__} is truncated: "
+                f"{expected} state(s) were saved but only {len(present)} "
+                f"({sorted(present)}) survived. Pass validate=False to force a partial load."
+            )
+    elif present and meta_key in state_dict:
+        # pre-manifest checkpoint: all-or-nothing heuristic (can false-positive on
+        # metrics mixing persistent and non-persistent states — re-save to fix)
+        missing = [n for n in names if prefix + n not in state_dict]
+        if missing:
+            raise StateCorruptionError(
+                f"Checkpoint slice '{prefix}*' for {type(metric).__name__} is truncated: "
+                f"has {sorted(present)} but is missing {sorted(missing)} "
+                f"(its '_update_count' metadata proves the metric was saved whole). "
+                f"Pass validate=False to force a partial load."
+            )
+    if not present:
+        return  # metric absent from this checkpoint — load_state_dict no-ops
+    for name in present:
+        default = metric._defaults[name]
+        value = state_dict[prefix + name]
+        fx = metric._reductions.get(name)
+        if isinstance(default, list):
+            if not isinstance(value, (list, tuple)):
+                raise StateCorruptionError(
+                    f"Checkpoint state '{prefix}{name}' should be a list of arrays, "
+                    f"got {type(value).__name__}."
+                )
+            if check_finite:
+                for i, elem in enumerate(value):
+                    arr = jnp.asarray(elem)
+                    if jnp.issubdtype(arr.dtype, jnp.floating) and not bool(jnp.isfinite(arr).all()):
+                        raise StateCorruptionError(
+                            f"Checkpoint state '{prefix}{name}[{i}]' contains non-finite values."
+                        )
+        else:
+            _check_tensor_leaf(
+                name, value, default, fx, f"checkpoint restore ('{prefix}{name}')", check_finite
+            )

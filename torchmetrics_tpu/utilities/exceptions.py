@@ -1,6 +1,9 @@
 """Exception types.
 
-Parity: reference ``src/torchmetrics/utilities/exceptions.py``.
+Parity: reference ``src/torchmetrics/utilities/exceptions.py``, extended with the
+reliability-layer taxonomy (``reliability/``): infrastructure faults that are safe to
+retry vs state corruption that must never be (retrying corrupted state would launder
+garbage into a "successful" eval).
 """
 
 
@@ -10,3 +13,22 @@ class TorchMetricsUserError(Exception):
 
 class TorchMetricsUserWarning(UserWarning):
     """Warning raised on questionable usage of the metric API."""
+
+
+class TransientRuntimeError(RuntimeError):
+    """A transient infrastructure fault (remote compile service, RPC transport, host
+    dropout) that is safe to retry with the same inputs.
+
+    Raised by the fault-injection harness and used by :mod:`..reliability.retry` as
+    the always-retryable exception type; real runtime faults (``JaxRuntimeError``
+    with an ``INTERNAL:``/``UNAVAILABLE:`` status) are classified by message.
+    """
+
+
+class StateCorruptionError(RuntimeError):
+    """A metric state violated its ``init_state()`` spec — missing leaf, wrong
+    shape/dtype, or non-finite values — at a sync/merge/checkpoint-restore boundary.
+
+    Never retryable: the state itself is damaged, so re-running the same operation
+    can only propagate the damage.
+    """

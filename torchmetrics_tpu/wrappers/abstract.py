@@ -94,12 +94,12 @@ class WrapperMetric(Metric):
             destination[prefix + "_wrapper_update_count"] = int(self._update_count)
         return destination
 
-    def load_state_dict(self, state_dict: dict, prefix: str = "") -> None:
+    def load_state_dict(self, state_dict: dict, prefix: str = "", validate: bool = True) -> None:
         import jax.numpy as jnp
 
-        super().load_state_dict(state_dict, prefix)
+        super().load_state_dict(state_dict, prefix, validate=validate)
         for i, child in enumerate(self._merge_children()):
-            child.load_state_dict(state_dict, f"{prefix}_child{i}.")
+            child.load_state_dict(state_dict, f"{prefix}_child{i}.", validate=validate)
         count_key = prefix + "_wrapper_update_count"
         if count_key in state_dict:
             self._update_count = int(state_dict[count_key])

@@ -90,7 +90,11 @@ class ClasswiseWrapper(WrapperMetric):
                         labels = class_ids
                     for i, lab in enumerate(labels):
                         out[f"{self._prefix}{stem}_{lab}{self._postfix}"] = val[i]
-                elif key != "classes":
+                else:
+                    # `classes` is consumed for labeling above but still passes
+                    # through under its prefixed name — downstream consumers need
+                    # the observed-class-id vector to interpret sparse outputs
+                    # (ADVICE round 5: dropping it silently lost information)
                     out[f"{self._prefix}{key}{self._postfix}"] = val
             return out
         n = int(x.shape[0]) if getattr(x, "ndim", 0) > 0 else 1

@@ -127,26 +127,51 @@ class Running(WrapperMetric):
         destination[prefix + "_wrapper_update_count"] = int(self._update_count)
         return destination
 
-    def load_state_dict(self, state_dict: dict, prefix: str = "") -> None:
+    def load_state_dict(self, state_dict: dict, prefix: str = "", validate: bool = True) -> None:
         import jax.numpy as jnp
 
+        from ..utilities.exceptions import StateCorruptionError
+
         if prefix + "_ring_len" not in state_dict:
+            if validate and prefix + "_wrapper_update_count" in state_dict:
+                # the update-count metadata proves this wrapper WAS saved — a
+                # missing ring length means the checkpoint lost keys
+                raise StateCorruptionError(
+                    f"Checkpoint slice '{prefix}*' for {type(self).__name__} is truncated: "
+                    f"'_wrapper_update_count' is present but '_ring_len' is missing. "
+                    f"Pass validate=False to skip the load."
+                )
             return
         ring = []
-        for i in range(int(state_dict[prefix + "_ring_len"])):
-            contrib = {}
-            for key, default in self.base_metric._defaults.items():
-                stem = f"{prefix}_ring{i}.{key}"
-                if isinstance(default, list):
-                    contrib[key] = [
-                        jnp.asarray(state_dict[f"{stem}.{j}"])
-                        for j in range(int(state_dict[f"{stem}._len"]))
-                    ]
-                else:
-                    contrib[key] = jnp.asarray(state_dict[stem])
-            ring.append(contrib)
+        try:
+            for i in range(int(state_dict[prefix + "_ring_len"])):
+                contrib = {}
+                for key, default in self.base_metric._defaults.items():
+                    stem = f"{prefix}_ring{i}.{key}"
+                    if isinstance(default, list):
+                        contrib[key] = [
+                            jnp.asarray(state_dict[f"{stem}.{j}"])
+                            for j in range(int(state_dict[f"{stem}._len"]))
+                        ]
+                    else:
+                        contrib[key] = jnp.asarray(state_dict[stem])
+                ring.append(contrib)
+        except KeyError as err:
+            if validate:
+                raise StateCorruptionError(
+                    f"Checkpoint slice '{prefix}*' for {type(self).__name__} is truncated: "
+                    f"ring entry key {err} is missing (partially-written ring)."
+                ) from err
+            raise
+        count_key = prefix + "_wrapper_update_count"
+        if count_key not in state_dict and validate:
+            raise StateCorruptionError(
+                f"Checkpoint slice '{prefix}*' for {type(self).__name__} is truncated: "
+                f"the ring is present but '_wrapper_update_count' is missing."
+            )
         self._ring = ring
-        self._update_count = int(state_dict[prefix + "_wrapper_update_count"])
+        if count_key in state_dict:
+            self._update_count = int(state_dict[count_key])
         self._computed = None
 
     def reset(self) -> None:
