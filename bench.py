@@ -47,6 +47,24 @@ NUM_CLASSES = 5
 WARMUP = 5
 ITERS = 400
 
+TELEMETRY_PROBE_STEPS = 8
+
+
+def _telemetry_probe(probe) -> dict:
+    """Per-config telemetry summary (compiles, retraces, d2h readbacks, sync
+    calls) from a short instrumented probe run AFTER the timed loop — the
+    measured loops stay un-instrumented so opting the bench into observability
+    never moves the headline numbers. ``probe()`` should rebuild the config's
+    metric fresh and run a few updates + a compute, mirroring the loop shape."""
+    from torchmetrics_tpu import observability as obs
+
+    try:
+        with obs.telemetry_session() as rec:
+            probe()
+        return rec.counters.snapshot().summary(brief=True)
+    except Exception as err:  # a probe failure must not cost the config its number
+        return {"error": f"{type(err).__name__}: {err}"[:240]}
+
 
 def bench_ours() -> dict:
     import jax
@@ -70,7 +88,14 @@ def bench_ours() -> dict:
             metric.update(preds, target)
         jax.block_until_ready(metric._state)
         best = max(best, ITERS / (time.perf_counter() - start))
-    return {"updates_per_sec": round(best, 2)}
+
+    def probe():
+        m = MulticlassAccuracy(num_classes=NUM_CLASSES, average="micro", validate_args=False)
+        for _ in range(TELEMETRY_PROBE_STEPS):
+            m.update(preds, target)
+        jax.block_until_ready(m._state)
+
+    return {"updates_per_sec": round(best, 2), "telemetry": _telemetry_probe(probe)}
 
 
 def bench_torch_baseline() -> dict:
@@ -178,11 +203,27 @@ def bench_fused_collection() -> dict:
         for m in ms.values():
             jax.block_until_ready(m._state)
         best_unfused = max(best_unfused, ITERS / (time.perf_counter() - start))
+
+    def probe():
+        # the stateful collection (the instrumented dispatch path): group fusion
+        # means one leader dispatch per step serves all four members
+        c = MetricCollection({
+            "acc": MulticlassAccuracy(num_classes, average="micro", validate_args=False),
+            "f1": MulticlassF1Score(num_classes, average="macro", validate_args=False),
+            "auroc": MulticlassAUROC(num_classes, thresholds=200, validate_args=False),
+            "confmat": MulticlassConfusionMatrix(num_classes, validate_args=False),
+        })
+        for _ in range(TELEMETRY_PROBE_STEPS):
+            c.update(probs, target)
+        for m in c.values():
+            jax.block_until_ready(m._state)
+
     return {
         "updates_per_sec": round(best, 2),
         "unit": f"fused 4-metric updates/s (batch={batch}, C=10)",
         "unfused_4_dispatch_updates_per_sec": round(best_unfused, 2),
         "fused_speedup_vs_unfused": round(best / best_unfused, 2),
+        "telemetry": _telemetry_probe(probe),
     }
 
 
@@ -235,10 +276,19 @@ def bench_map() -> dict:
     out = big.compute()
     jax.block_until_ready(out["map"])
     compute_5k = time.perf_counter() - start
+
+    def probe():
+        m = MeanAveragePrecision()
+        p, t = make_batch(n_imgs=5)
+        m.update(p, t)
+        m.update(p, t)
+        m.compute()
+
     return {
         "images_per_sec_update": round(n_imgs / update_elapsed, 2),
         "compute_sec_500imgs_80cls": round(compute_elapsed, 3),
         "compute_sec_5000imgs_80cls": round(compute_5k, 3),
+        "telemetry": _telemetry_probe(probe),
     }
 
 
@@ -270,6 +320,12 @@ def bench_fid() -> dict:
             jax.block_until_ready(fid._state)
             rates.append(iters * batch / (time.perf_counter() - start))
         out[f"images_per_sec_{trunk}"] = round(sorted(rates)[1], 2)
+        if trunk == "bfloat16":  # probe once, on the already-warm flagship trunk
+            def probe(fid=fid, imgs=imgs):
+                fid.update(imgs, real=True)
+                fid.update(imgs, real=False)
+                jax.block_until_ready(fid._state)
+            out["telemetry"] = _telemetry_probe(probe)
     out["unit"] = "InceptionV3-2048 fwd+stats images/s (299x299)"
     return out
 

@@ -158,3 +158,54 @@ class TestNoD2HOnUpdate:
         m.update(preds, target)
         m.reset()
         m.update(preds, target)
+
+
+@pytest.mark.telemetry
+class TestTelemetryD2HContract:
+    """The observability layer's two-sided contract with this file's invariant:
+    enabled telemetry must not ADD readbacks to the hot loop (signatures, clocks
+    and counters are host metadata), and its d2h counter must agree with the
+    transfer guard that the instrumented loop performed zero."""
+
+    def test_instrumented_hot_loop_zero_readbacks(self, guard):
+        from torchmetrics_tpu import observability as obs
+        from torchmetrics_tpu.classification import MulticlassAccuracy, MulticlassF1Score
+
+        preds, _, target = _cls_batch()
+        with obs.telemetry_session() as rec:
+            for m in (
+                MulticlassAccuracy(5, average="micro", validate_args=False),
+                MulticlassF1Score(5, average="macro", validate_args=False),
+            ):
+                m.update(preds, target)
+                m.update(preds, target)
+                m.forward(preds, target)
+        snap = rec.counters.snapshot()
+        assert snap["dispatches"] == 6
+        assert snap["jit_compiles"] + snap["jit_cache_hits"] == snap["dispatches"]
+        assert snap["d2h_readbacks"] == 0
+
+    def test_blocking_timing_mode_no_readbacks(self, guard):
+        # block_until_ready waits on futures without transferring — the honest
+        # wall-clock mode must stay inside the no-D2H contract too
+        from torchmetrics_tpu import observability as obs
+        from torchmetrics_tpu.classification import MulticlassAccuracy
+
+        preds, _, target = _cls_batch()
+        with obs.telemetry_session(obs.TelemetryConfig(block_until_ready=True)) as rec:
+            m = MulticlassAccuracy(5, average="micro", validate_args=False)
+            m.update(preds, target)
+            m.update(preds, target)
+        assert rec.counters.snapshot()["d2h_readbacks"] == 0
+
+    def test_disabled_telemetry_keeps_hot_loop_clean(self, guard):
+        # the None-recorder branch is the production default: same zero-transfer
+        # guarantee, no session anywhere in the process
+        from torchmetrics_tpu import observability as obs
+        from torchmetrics_tpu.classification import MulticlassAccuracy
+
+        assert not obs.enabled()
+        preds, _, target = _cls_batch()
+        m = MulticlassAccuracy(5, average="micro", validate_args=False)
+        m.update(preds, target)
+        m.forward(preds, target)

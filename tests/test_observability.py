@@ -1,0 +1,404 @@
+"""Observability layer: events, counters, sinks, tracing, and the acceptance
+contract — with telemetry enabled, a scripted run's counters reconcile exactly
+(compiles + cache hits == dispatches, the injected retry appears as an event,
+the hot loop performs zero device→host readbacks); with telemetry disabled,
+the dispatch path constructs no events and does no telemetry work."""
+
+import json
+import os
+import warnings
+
+import importlib.util
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import torchmetrics_tpu as tm
+from torchmetrics_tpu import MetricCollection, observability as obs
+from torchmetrics_tpu.metric import HostMetric, Metric
+from torchmetrics_tpu.reliability import (
+    ReliabilityConfig,
+    RetryPolicy,
+    inject_dispatch_fault,
+)
+
+pytestmark = pytest.mark.telemetry
+
+_FAST_RETRY = dict(backoff_base=0.0, jitter=0.0, sleep_fn=lambda s: None)
+
+
+def _x(n=8, seed=0):
+    return jnp.asarray(np.random.default_rng(seed).random(n).astype(np.float32))
+
+
+class _SumState(Metric):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.add_state("s", default=np.zeros(()), dist_reduce_fx="sum")
+
+    def _batch_state(self, x):
+        return {"s": x.sum()}
+
+    def _compute(self, state):
+        return state["s"]
+
+
+class _HostSum(HostMetric):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.add_state("s", default=np.zeros(()), dist_reduce_fx="sum")
+
+    def _host_batch_state(self, x):
+        return {"s": jnp.asarray(np.asarray(x).sum())}
+
+    def _compute(self, state):
+        return state["s"]
+
+
+# --------------------------------------------------------------- unit: counters
+
+
+def test_counters_snapshot_and_diff():
+    c = obs.Counters()
+    assert c.record_dispatch("M#0.update", "f32(4,)") == (True, 1)
+    assert c.record_dispatch("M#0.update", "f32(4,)") == (False, 1)
+    assert c.record_dispatch("M#0.update", "f32(5,)") == (True, 2)
+    c.record_d2h(128)
+    first = c.snapshot()
+    c.record_dispatch("M#0.update", "f32(6,)")
+    c.record_sync(256)
+    second = c.snapshot()
+    assert first["dispatches"] == 3
+    assert first["jit_compiles"] == 2 and first["jit_cache_hits"] == 1
+    assert first["retraces"] == 1
+    assert first["d2h_readbacks"] == 1 and first["d2h_bytes"] == 128
+    delta = second.diff(first)
+    assert delta["dispatches"] == 1 and delta["jit_compiles"] == 1
+    assert delta["sync_calls"] == 1 and delta["sync_payload_bytes"] == 256
+    assert delta.per_key["M#0.update"]["signatures"] == ["f32(6,)"]
+    brief = second.summary(brief=True)
+    assert set(brief) == {
+        "dispatches", "jit_compiles", "jit_cache_hits", "retraces",
+        "host_dispatches", "d2h_readbacks", "sync_calls",
+    }
+    c.reset()
+    assert c.snapshot()["dispatches"] == 0
+
+
+# ------------------------------------------------------------------ unit: sinks
+
+
+def test_ring_buffer_sink_evicts_oldest():
+    sink = obs.RingBufferSink(capacity=3)
+    for i in range(5):
+        sink.emit(obs.TelemetryEvent(kind="dispatch", metric=f"m{i}", tag="update", timestamp=float(i)))
+    assert sink.evicted == 2
+    assert [e.metric for e in sink.events] == ["m2", "m3", "m4"]
+    assert len(sink.of_kind("dispatch")) == 3
+    assert len(sink.drain()) == 3 and sink.events == ()
+
+
+def test_jsonl_sink_and_trace_report(tmp_path):
+    trace = tmp_path / "trace.jsonl"
+    cfg = obs.TelemetryConfig(sinks=(obs.JSONLSink(str(trace)), obs.RingBufferSink()))
+    m = _SumState(reliability=ReliabilityConfig(retry=RetryPolicy(max_attempts=3, **_FAST_RETRY)))
+    with obs.telemetry_session(cfg):
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", UserWarning)
+            with inject_dispatch_fault(m, fail_on=2, times=1, tag="update"):
+                for _ in range(3):
+                    m.update(_x())
+        m.compute()
+    lines = [json.loads(l) for l in trace.read_text().splitlines()]
+    assert all("kind" in e and "timestamp" in e for e in lines)
+    assert {"dispatch", "retry", "compute"} <= {e["kind"] for e in lines}
+
+    # tools/trace_report.py renders the same file into a per-metric table
+    spec = importlib.util.spec_from_file_location(
+        "trace_report", os.path.join(os.path.dirname(__file__), "..", "tools", "trace_report.py")
+    )
+    trace_report = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(trace_report)
+    report = trace_report.aggregate(trace_report.load_events(str(trace)))
+    rows = {(r["metric"], r["phase"]): r for r in report["rows"]}
+    update_row = rows[("_SumState#0", "update")]
+    assert update_row["events"] == 3
+    assert update_row["compiles"] == 1 and update_row["cache_hits"] == 2
+    assert report["totals"]["retries"] == 1
+    rendered = trace_report.render_table(report)
+    assert "_SumState#0" in rendered and "retries: 1" in rendered
+
+
+def test_callback_sink_hooks():
+    seen = {"update": 0, "compute": 0, "sync": 0, "retry": 0, "quarantine": 0, "any": 0}
+    cb = obs.CallbackSink(
+        on_update=lambda e: seen.__setitem__("update", seen["update"] + 1),
+        on_compute=lambda e: seen.__setitem__("compute", seen["compute"] + 1),
+        on_sync=lambda e: seen.__setitem__("sync", seen["sync"] + 1),
+        on_retry=lambda e: seen.__setitem__("retry", seen["retry"] + 1),
+        on_quarantine=lambda e: seen.__setitem__("quarantine", seen["quarantine"] + 1),
+        on_event=lambda e: seen.__setitem__("any", seen["any"] + 1),
+    )
+    pol = RetryPolicy(max_attempts=3, **_FAST_RETRY)
+    m = _SumState(
+        reliability=ReliabilityConfig(retry=pol, check_finite=False),
+        distributed_available_fn=lambda: True,
+        dist_sync_fn=lambda v, g: [v, v],
+    )
+    col = MetricCollection({"bomb": _SumState()}, on_error="quarantine")
+    with obs.telemetry_session(obs.TelemetryConfig(sinks=(cb,))):
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", UserWarning)
+            with inject_dispatch_fault(m, fail_on=1, times=1, tag="update"):
+                m.update(_x())
+            m.compute()  # fake-distributed -> sync event too
+            col.update(_x())
+            with inject_dispatch_fault(col["bomb"], fail_on=1, times=5, tag="update"):
+                col.update(_x())
+    assert seen["update"] >= 1 and seen["compute"] == 1 and seen["sync"] == 1
+    assert seen["retry"] >= 1 and seen["quarantine"] == 1
+    assert seen["any"] >= sum(v for k, v in seen.items() if k != "any")
+
+
+# ------------------------------------------------- acceptance: scripted run
+
+
+def test_scripted_run_counters_reconcile():
+    """update×K under one injected transient fault → sync → compute: compiles +
+    cache hits == dispatch count, the retry shows up as an on_retry event, and
+    the hot loop performs zero device→host readbacks (transfer-guard enforced)."""
+    K = 6
+    pol = RetryPolicy(max_attempts=3, **_FAST_RETRY)
+    m = _SumState(
+        reliability=ReliabilityConfig(retry=pol),
+        distributed_available_fn=lambda: True,
+        dist_sync_fn=lambda v, g: [v, v],
+    )
+    x = _x()
+    with obs.telemetry_session() as rec:
+        with jax.transfer_guard_device_to_host("disallow"):
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore", UserWarning)
+                with inject_dispatch_fault(m, fail_on=3, times=1, tag="update") as hook:
+                    for _ in range(K):
+                        m.update(x)
+        hot = rec.counters.snapshot()
+        value = m.compute()
+    assert hook.raised == 1
+    # hot loop: every dispatch is a compile or a cache hit, nothing unaccounted
+    assert hot["dispatches"] == K
+    assert hot["jit_compiles"] + hot["jit_cache_hits"] == hot["dispatches"]
+    assert hot["jit_compiles"] == 1 and hot["retraces"] == 0
+    # the injected transient fault surfaced as exactly one retry event
+    assert hot["retries"] == 1
+    retry_events = rec.events_of("retry")
+    assert len(retry_events) == 1 and retry_events[0].payload["attempt"] == 1
+    # the hot loop performed ZERO device→host readbacks (counter + guard agree)
+    assert hot["d2h_readbacks"] == 0
+    # sync + compute happened after the hot loop and were recorded
+    final = rec.counters.snapshot()
+    assert final["sync_calls"] == 1 and final["gather_calls"] == 1
+    assert final["sync_payload_bytes"] == 4  # one f32 scalar state
+    assert final["computes"] == 1
+    assert len(rec.events_of("sync")) == 1
+    # telemetry never changed the math: 6 updates x sum(x), two "processes"
+    assert float(value) == pytest.approx(2 * K * float(np.asarray(x).sum()), rel=1e-5)
+
+
+def test_disabled_telemetry_constructs_no_events(monkeypatch):
+    """With no session active the dispatch path must do NO telemetry work: no
+    event objects, no signature hashing, no clock reads."""
+    def boom(*a, **k):
+        raise AssertionError("telemetry work performed while disabled")
+
+    assert not obs.enabled()
+    monkeypatch.setattr(obs.events.TelemetryEvent, "__init__", boom)
+    monkeypatch.setattr(obs.TelemetryRecorder, "_signature", staticmethod(boom))
+    monkeypatch.setattr(obs.tracing, "monotonic", boom)
+    m = _SumState()
+    m.update(_x())
+    m.forward(_x())
+    assert float(m.compute()) > 0
+    h = _HostSum()
+    h.update(_x())
+    h.compute()
+    # sync path too (fake distributed)
+    s = _SumState(distributed_available_fn=lambda: True, dist_sync_fn=lambda v, g: [v, v])
+    s.update(_x())
+    s.compute()
+
+
+# ------------------------------------------------------------------ satellites
+
+
+def test_retrace_sentinel_names_offending_shapes():
+    m = _SumState()
+    cfg = obs.TelemetryConfig(retrace_warn_threshold=2)
+    with obs.telemetry_session(cfg) as rec:
+        with pytest.warns(UserWarning, match=r"Retrace sentinel.*_SumState#\d+\.update"):
+            for n in (4, 5, 6, 7):
+                m.update(_x(n))
+        # threshold crossing warns once; retrace events track every new signature
+        assert len(rec.events_of("retrace")) == 3
+        assert rec.counters.snapshot()["retraces"] == 3
+        sigs = rec.events_of("retrace")[0].signature
+        assert "float32" in sigs
+    with obs.telemetry_session(cfg):
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")  # stable shapes: sentinel stays quiet
+            m2 = _SumState()
+            for _ in range(6):
+                m2.update(_x(4))
+
+
+def test_retry_exhausted_warns_and_emits_event():
+    pol = RetryPolicy(max_attempts=2, **_FAST_RETRY)
+    m = _SumState(reliability=ReliabilityConfig(retry=pol))
+    with obs.telemetry_session() as rec:
+        with pytest.warns(UserWarning, match="Retry budget exhausted"):
+            with inject_dispatch_fault(m, fail_on=1, times=5, tag="update"):
+                with pytest.raises(Exception):
+                    m.update(_x())
+    snap = rec.counters.snapshot()
+    assert snap["retries"] == 1 and snap["retries_exhausted"] == 1
+    ev = rec.events_of("retry_exhausted")
+    assert len(ev) == 1
+    assert ev[0].metric == "_SumState.update"
+    assert ev[0].payload["attempts"] == 2
+
+
+def test_quarantine_and_skip_events():
+    for mode, status, counter in (("quarantine", "quarantined", "quarantines"), ("skip", "skipped", "skips")):
+        col = MetricCollection({"ok": tm.SumMetric(), "bad": _SumState()}, on_error=mode)
+        with obs.telemetry_session() as rec:
+            col.update(_x())
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore", UserWarning)
+                with inject_dispatch_fault(col["bad"], fail_on=1, times=5, tag="update"):
+                    col.update(_x())
+        events = rec.events_of("quarantine")
+        assert len(events) == 1, mode
+        assert events[0].metric == "bad" and events[0].tag == "update"
+        assert events[0].payload["status"] == status
+        assert rec.counters.snapshot()[counter] == 1
+
+
+def test_collection_telemetry_summary_fused_attribution():
+    col = MetricCollection({"s1": tm.SumMetric(), "s2": tm.SumMetric()})
+    with obs.telemetry_session():
+        col.update(_x())  # both dispatch; groups derived after this batch
+        col.update(_x())  # fused: only the leader dispatches
+        summary = col.telemetry_summary()
+    assert summary["enabled"]
+    members = summary["members"]
+    leaders = [n for n, info in members.items() if "fused_into" not in info]
+    followers = [n for n, info in members.items() if "fused_into" in info]
+    assert len(leaders) == 1 and len(followers) == 1
+    assert members[followers[0]]["fused_into"] == leaders[0]
+    assert members[leaders[0]]["dispatches"] == 2
+    assert members[followers[0]]["dispatches"] == 1  # pre-fusion batch only
+    assert summary["counters"]["dispatches"] == 3
+    assert list(summary["compute_groups"].values()) == [[leaders[0], followers[0]]]
+
+
+def test_telemetry_summary_disabled():
+    col = MetricCollection({"s": tm.SumMetric()})
+    assert col.telemetry_summary() == {"enabled": False}
+
+
+def test_host_metric_dispatch_recorded():
+    h = _HostSum()
+    with obs.telemetry_session() as rec:
+        h.update(_x())
+        h.forward(_x())
+    snap = rec.counters.snapshot()
+    assert snap["host_dispatches"] == 2 and snap["dispatches"] == 0
+    ev = rec.events_of("dispatch")
+    assert all(e.payload.get("jitted") is False for e in ev)
+
+
+def test_state_dict_d2h_counted():
+    m = tm.SumMetric()
+    m.persistent(True)
+    m.update(_x())
+    with obs.telemetry_session() as rec:
+        m.state_dict()
+    snap = rec.counters.snapshot()
+    assert snap["d2h_readbacks"] == 1 and snap["d2h_bytes"] == 4  # f32 scalar
+    assert rec.events_of("d2h")[0].tag == "state_dict"
+
+
+def test_compute_on_cpu_append_d2h_counted():
+    m = tm.CatMetric(compute_on_cpu=True)
+    with obs.telemetry_session() as rec:
+        m.update(_x(4))
+        m.update(_x(4))
+    snap = rec.counters.snapshot()
+    assert snap["d2h_readbacks"] == 2 and snap["d2h_bytes"] == 32
+    assert all(e.tag == "compute_on_cpu_append" for e in rec.events_of("d2h"))
+
+
+def test_blocking_timing_mode_records_durations():
+    with obs.telemetry_session(obs.TelemetryConfig(block_until_ready=True)) as rec:
+        m = _SumState()
+        for _ in range(3):
+            m.update(_x())
+        m.compute()
+    spans = rec.events_of("dispatch", "compute")
+    assert len(spans) == 4
+    assert all(e.duration_s is not None and e.duration_s >= 0 for e in spans)
+
+
+def test_fault_injected_run_events_captured():
+    """Reliability + observability together: a FlakyGather sync retry and a
+    dispatch-fault retry both land in one session's event stream."""
+    from torchmetrics_tpu.reliability import FlakyGather
+
+    pol = RetryPolicy(max_attempts=3, **_FAST_RETRY)
+    flaky = FlakyGather(inner=lambda v, g: [v, v], fail_times=1)
+    m = _SumState(
+        reliability=ReliabilityConfig(retry=pol),
+        distributed_available_fn=lambda: True,
+        dist_sync_fn=flaky,
+    )
+    with obs.telemetry_session() as rec:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", UserWarning)
+            with inject_dispatch_fault(m, fail_on=1, times=1, tag="update"):
+                m.update(_x())
+            m.compute()
+    snap = rec.counters.snapshot()
+    assert snap["retries"] == 2  # one dispatch retry + one sync retry
+    describes = [e.metric for e in rec.events_of("retry")]
+    assert "_SumState.update" in describes and "_SumState.sync" in describes
+    assert snap["sync_calls"] == 2  # failed attempt + successful retry both entered process_sync
+    assert flaky.failures == 1
+
+
+def test_metric_identity_fresh_per_session():
+    """A metric surviving its session gets a fresh id in the next one — stale
+    stamps (or unpickled metrics) must never merge into an unrelated metric's
+    counters."""
+    survivor = _SumState()
+    with obs.telemetry_session() as rec1:
+        survivor.update(_x())
+    with obs.telemetry_session() as rec2:
+        other = _SumState()
+        other.update(_x())  # claims id 0 of the new session
+        survivor.update(_x())
+    assert rec1.counters.snapshot()["dispatches"] == 1
+    keys2 = set(rec2.counters.snapshot().per_key)
+    assert keys2 == {"_SumState#0.update", "_SumState#1.update"}
+    assert rec2.metric_summary(other)["dispatches"] == 1
+    assert rec2.metric_summary(survivor)["dispatches"] == 1
+
+
+def test_session_lifecycle_and_replacement():
+    rec1 = obs.enable()
+    assert obs.active() is rec1 and obs.enabled()
+    rec2 = obs.enable()  # replaces (closes) rec1
+    assert obs.active() is rec2
+    out = obs.disable()
+    assert out is rec2 and not obs.enabled()
+    assert obs.disable() is None  # idempotent

@@ -1,0 +1,141 @@
+#!/usr/bin/env python
+"""Render a telemetry JSONL trace into a per-metric summary table.
+
+Input: the file a :class:`torchmetrics_tpu.observability.JSONLSink` wrote —
+one JSON object per line, the :meth:`TelemetryEvent.to_dict` shape. Stdlib
+only (no jax import): runs on a laptop against a trace scp'd off a pod.
+
+Usage::
+
+    python tools/trace_report.py trace.jsonl
+    python tools/trace_report.py trace.jsonl --json   # machine-readable
+
+Per (metric, phase) row: event count, compiles vs cache hits, retraces, and
+total/mean span time (honest device wall-clock only if the trace was recorded
+under ``TelemetryConfig(block_until_ready=True)``; otherwise dispatch/enqueue
+latency). Footer totals cover retries, quarantines, and instrumented
+device→host readbacks — the three "why did it get slow/wrong" signals.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, Iterable, List, Tuple
+
+
+def load_events(path: str) -> List[Dict[str, Any]]:
+    events = []
+    with open(path, "r", encoding="utf-8") as fh:
+        for lineno, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except json.JSONDecodeError as err:
+                print(f"warning: {path}:{lineno}: unparseable line skipped ({err})", file=sys.stderr)
+    return events
+
+
+def aggregate(events: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
+    """Fold a raw event stream into the report structure."""
+    rows: Dict[Tuple[str, str], Dict[str, Any]] = {}
+    totals = {"retries": 0, "retries_exhausted": 0, "quarantines": 0, "d2h_readbacks": 0, "d2h_bytes": 0}
+    retries: List[Dict[str, Any]] = []
+    quarantines: List[Dict[str, Any]] = []
+    for ev in events:
+        kind = ev.get("kind", "")
+        metric = ev.get("metric", "") or "<process>"
+        tag = ev.get("tag", "")
+        if kind in ("dispatch", "compute", "sync"):
+            row = rows.setdefault((metric, tag), {
+                "events": 0, "compiles": 0, "cache_hits": 0, "retraces": 0,
+                "total_s": 0.0, "timed": 0,
+            })
+            row["events"] += 1
+            if kind == "dispatch":
+                if ev.get("cache_hit") is False:
+                    row["compiles"] += 1
+                elif ev.get("cache_hit") is True:
+                    row["cache_hits"] += 1
+            dur = ev.get("duration_s")
+            if dur is not None:
+                row["total_s"] += float(dur)
+                row["timed"] += 1
+        elif kind == "retrace":
+            row = rows.setdefault((metric, tag), {
+                "events": 0, "compiles": 0, "cache_hits": 0, "retraces": 0,
+                "total_s": 0.0, "timed": 0,
+            })
+            row["retraces"] += 1
+        elif kind == "retry":
+            totals["retries"] += 1
+            retries.append(ev)
+        elif kind == "retry_exhausted":
+            totals["retries_exhausted"] += 1
+            retries.append(ev)
+        elif kind == "quarantine":
+            totals["quarantines"] += 1
+            quarantines.append(ev)
+        elif kind == "d2h":
+            totals["d2h_readbacks"] += 1
+            totals["d2h_bytes"] += int(ev.get("payload", {}).get("nbytes", 0))
+    report_rows = []
+    for (metric, tag), row in sorted(rows.items()):
+        mean_ms = (row["total_s"] / row["timed"] * 1000.0) if row["timed"] else None
+        report_rows.append({
+            "metric": metric,
+            "phase": tag,
+            "events": row["events"],
+            "compiles": row["compiles"],
+            "cache_hits": row["cache_hits"],
+            "retraces": row["retraces"],
+            "total_s": round(row["total_s"], 6),
+            "mean_ms": round(mean_ms, 3) if mean_ms is not None else None,
+        })
+    return {"rows": report_rows, "totals": totals, "retries": retries, "quarantines": quarantines}
+
+
+def render_table(report: Dict[str, Any]) -> str:
+    headers = ("metric", "phase", "events", "compiles", "cache_hits", "retraces", "total_s", "mean_ms")
+    table = [[str(r[h]) if r[h] is not None else "-" for h in headers] for r in report["rows"]]
+    widths = [max(len(h), *(len(row[i]) for row in table)) if table else len(h) for i, h in enumerate(headers)]
+    lines = [
+        "  ".join(h.ljust(w) for h, w in zip(headers, widths)),
+        "  ".join("-" * w for w in widths),
+    ]
+    for row in table:
+        lines.append("  ".join(c.ljust(w) for c, w in zip(row, widths)))
+    t = report["totals"]
+    lines.append("")
+    lines.append(
+        f"retries: {t['retries']} (exhausted: {t['retries_exhausted']})  "
+        f"quarantines: {t['quarantines']}  "
+        f"d2h readbacks: {t['d2h_readbacks']} ({t['d2h_bytes']} bytes)"
+    )
+    for ev in report["retries"]:
+        p = ev.get("payload", {})
+        lines.append(f"  retry[{ev.get('kind')}] {ev.get('metric')}: attempt {p.get('attempt', p.get('attempts'))}: {p.get('error')}")
+    for ev in report["quarantines"]:
+        p = ev.get("payload", {})
+        lines.append(f"  quarantine {ev.get('metric')} at {ev.get('tag')} ({p.get('status')}): {p.get('error')}")
+    return "\n".join(lines)
+
+
+def main(argv: List[str] = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("trace", help="JSONL trace written by observability.JSONLSink")
+    parser.add_argument("--json", action="store_true", help="emit the aggregated report as JSON")
+    args = parser.parse_args(argv)
+    report = aggregate(load_events(args.trace))
+    if args.json:
+        print(json.dumps(report, indent=2))
+    else:
+        print(render_table(report))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

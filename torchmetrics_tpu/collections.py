@@ -20,6 +20,7 @@ from typing import Any, Dict, Hashable, Iterable, List, Mapping, Optional, Seque
 import jax.numpy as jnp
 import numpy as np
 
+from . import observability as _observability
 from .metric import Metric
 from .utilities.data import _flatten_dict, allclose
 from .utilities.prints import rank_zero_warn
@@ -374,6 +375,15 @@ class MetricCollection:
         """Degrade per policy (never called under ``on_error="raise"``)."""
         self._detach_from_group(name)
         self._degraded = True
+        rec = _observability._ACTIVE
+        if rec is not None:
+            # the degradation decision lands in the telemetry stream at the
+            # moment it is made, not only as a marker in a later compute()
+            rec.record_quarantine(
+                name, stage,
+                "quarantined" if self.on_error == "quarantine" else "skipped",
+                exc, self._modules[name]._update_count,
+            )
         if self.on_error == "quarantine":
             self._quarantined[name] = (stage, exc)
             rank_zero_warn(
@@ -681,6 +691,42 @@ class MetricCollection:
         if together:
             return [plot_single_or_multi_val(val, ax=ax)]
         return [plot_single_or_multi_val({k: v}, ax=ax) for k, v in val.items()]
+
+    # --------------------------------------------------------------- telemetry
+
+    def telemetry_summary(self) -> Dict[str, Any]:
+        """Per-member dispatch attribution from the active telemetry session.
+
+        Fused compute groups dispatch once through their leader; members show
+        zero dispatches of their own plus a ``fused_into`` pointer, which is
+        exactly the attribution an operator needs to read a trace of a fused
+        collection ("why does only ``acc`` show compiles?"). Quarantined
+        members carry their frozen status. ``{"enabled": False}`` when no
+        session is active.
+        """
+        rec = _observability.active()
+        if rec is None:
+            return {"enabled": False}
+        groups = {gid: list(m) for gid, m in self._groups.items()} if self._groups_checked else {}
+        leader_of = {
+            name: members[0] for members in groups.values() for name in members[1:]
+        }
+        members_out: Dict[str, Any] = {}
+        for name, metric in self._modules.items():
+            info = rec.metric_summary(metric)
+            if name in leader_of:
+                info["fused_into"] = leader_of[name]
+            if name in self._quarantined:
+                stage, exc = self._quarantined[name]
+                info["status"] = "quarantined"
+                info["quarantine_stage"] = stage
+            members_out[name] = info
+        return {
+            "enabled": True,
+            "members": members_out,
+            "compute_groups": groups,
+            "counters": rec.counters.snapshot().summary(brief=True),
+        }
 
     # ------------------------------------------------------------- fused pure API
 

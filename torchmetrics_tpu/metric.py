@@ -44,6 +44,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import observability as _observability
+from .observability import tracing as _tracing
 from .parallel import sync as _sync
 from .reliability.guards import validate_restored, validate_state
 from .reliability.retry import ReliabilityConfig
@@ -277,16 +279,21 @@ class Metric:
             list_names = set(self._list_state_names)
 
             def fn(tensor_state, n_prev, *args, **kwargs):
-                bs = self._batch_state(*args, **kwargs)
+                # named_scope: trace-time HLO name prefixes so this metric's ops
+                # stay attributable in the xprof device view even after XLA fuses
+                # a whole collection into one program (zero runtime cost)
+                with jax.named_scope(f"{type(self).__name__}.batch_state"):
+                    bs = self._batch_state(*args, **kwargs)
                 appends = {k: v for k, v in bs.items() if k in list_names}
                 bs_t = {k: v for k, v in bs.items() if k not in list_names}
                 # n_prev (prior update count, a DEVICE scalar incremented in-graph —
                 # a per-update host transfer costs ~1.7ms through a TPU tunnel) makes
                 # "mean" states an exact running mean over updates (reference
                 # metric.py:481); other tags ignore the weights
-                new_t = {k: _sync.pairwise_merge(self._reductions.get(k), tensor_state[k], v, weights=(n_prev, 1.0)) for k, v in bs_t.items()} if not self._has_custom_merge() else None
-                if new_t is None:
-                    new_t = self._merge({**tensor_state}, bs_t)
+                with jax.named_scope(f"{type(self).__name__}.merge"):
+                    new_t = {k: _sync.pairwise_merge(self._reductions.get(k), tensor_state[k], v, weights=(n_prev, 1.0)) for k, v in bs_t.items()} if not self._has_custom_merge() else None
+                    if new_t is None:
+                        new_t = self._merge({**tensor_state}, bs_t)
                 # keep state dtype stable under merge promotion (set_dtype semantics)
                 new_t = {k: jnp.asarray(v).astype(tensor_state[k].dtype) if k in tensor_state else v for k, v in new_t.items()}
                 # carry through tensor states the batch didn't touch
@@ -301,7 +308,15 @@ class Metric:
         """Append one row to a concat state. compute_on_cpu (reference metric.py:119)
         offloads it to host — list states are where memory grows, and host storage
         frees HBM without touching the jitted tensor-state path."""
-        self._state[name].append(np.asarray(value) if self.compute_on_cpu else value)
+        if not self.compute_on_cpu:
+            self._state[name].append(value)
+            return
+        rec = _observability._ACTIVE
+        if rec is not None and isinstance(value, jax.Array):
+            # the offload is a deliberate device→host readback — count it so an
+            # operator can see it (and so the hot tensor loop proves it has none)
+            rec.record_d2h("compute_on_cpu_append", value.size * value.dtype.itemsize, metric=self)
+        self._state[name].append(np.asarray(value))
 
     def _device_update_count(self):
         if getattr(self, "_n_prev_dev", None) is None:
@@ -324,18 +339,52 @@ class Metric:
     def _reliable_call(self, tag: str, thunk: Callable[[], Any], restore: Optional[Callable] = None) -> Any:
         """Dispatch boundary: retries transient failures when a RetryPolicy is
         configured; otherwise today's single-attempt behavior, byte for byte.
-        ``restore(exc, attempt)`` re-materializes donated inputs before a retry."""
+        ``restore(exc, attempt)`` re-materializes donated inputs before a retry.
+
+        Telemetry: HostMetric routes its eager ``update``/``forward`` through
+        here (the jitted tensor path uses ``_donation_safe_dispatch`` instead),
+        so those tags record as host dispatches when a session is active.
+        """
         rel = self._reliability
         if rel is None or rel.retry is None:
-            return self._attempt(tag, thunk)
-        return rel.retry.call(
-            lambda: self._attempt(tag, thunk), on_retry=restore, describe=f"{type(self).__name__}.{tag}"
-        )
+            attempt = lambda: self._attempt(tag, thunk)
+        else:
+            attempt = lambda: rel.retry.call(
+                lambda: self._attempt(tag, thunk), on_retry=restore,
+                describe=f"{type(self).__name__}.{tag}",
+            )
+        rec = _observability._ACTIVE
+        if rec is None or tag not in ("update", "forward"):
+            return attempt()
+        t0 = _tracing.monotonic()
+        with _tracing.trace_span(f"{type(self).__name__}.{tag}"):
+            out = attempt()
+        rec.record_host_dispatch(self, tag, rec.finish(out, t0))
+        return out
 
-    def _donation_safe_dispatch(self, tag: str, call: Callable[..., Any], tensors: StateDict) -> Any:
+    def _donation_safe_dispatch(
+        self, tag: str, call: Callable[..., Any], tensors: StateDict, inputs: Optional[tuple] = None
+    ) -> Any:
         """Dispatch a jitted call that DONATES its tensor-state argument (and, for
         ``update``, the device counter). ``call(t, n)`` receives the live tensor
         dict and device-side update counter.
+
+        ``inputs`` is the batch's ``(args, kwargs)`` — read only when a telemetry
+        session is active, for the shape/dtype dispatch signature (metadata only,
+        no device access). Disabled telemetry costs one ``None``-check here.
+        """
+        rec = _observability._ACTIVE
+        if rec is None:
+            with _tracing.trace_span(f"{type(self).__name__}.{tag}"):
+                return self._dispatch_donated(tag, call, tensors)
+        t0 = _tracing.monotonic()
+        with _tracing.trace_span(f"{type(self).__name__}.{tag}"):
+            result = self._dispatch_donated(tag, call, tensors)
+        rec.record_dispatch(self, tag, inputs, rec.finish(result, t0))
+        return result
+
+    def _dispatch_donated(self, tag: str, call: Callable[..., Any], tensors: StateDict) -> Any:
+        """The donation-safe dispatch body.
 
         Default path (no retry): single attempt, no copies — byte-for-byte today's
         behavior. With a RetryPolicy: an undonated device-side backup lets every
@@ -376,10 +425,9 @@ class Metric:
         args, kwargs = self._prepare_inputs(*args, **kwargs)
         tensors, _ = self._split_tensor_list(self._state)
         fn = self._get_update_fn()
-        with jax.profiler.TraceAnnotation(f"{type(self).__name__}.update"):
-            new_t, appends, self._n_prev_dev = self._donation_safe_dispatch(
-                "update", lambda t, n: fn(t, n, *args, **kwargs), tensors
-            )
+        new_t, appends, self._n_prev_dev = self._donation_safe_dispatch(
+            "update", lambda t, n: fn(t, n, *args, **kwargs), tensors, inputs=(args, kwargs)
+        )
         for k, v in new_t.items():
             self._state[k] = v
         for k, v in appends.items():
@@ -412,13 +460,15 @@ class Metric:
             list_names = set(self._list_state_names)
 
             def fn(tensor_state, n_prev, *args, **kwargs):
-                bs = self._batch_state(*args, **kwargs)
+                with jax.named_scope(f"{type(self).__name__}.batch_state"):
+                    bs = self._batch_state(*args, **kwargs)
                 appends = {k: v for k, v in bs.items() if k in list_names}
                 bs_t = {k: v for k, v in bs.items() if k not in list_names}
-                new_t = self._merge(dict(tensor_state), bs_t) if self._has_custom_merge() else {
-                    k: _sync.pairwise_merge(self._reductions.get(k), tensor_state[k], v, weights=(n_prev, 1.0))
-                    for k, v in bs_t.items()
-                }
+                with jax.named_scope(f"{type(self).__name__}.merge"):
+                    new_t = self._merge(dict(tensor_state), bs_t) if self._has_custom_merge() else {
+                        k: _sync.pairwise_merge(self._reductions.get(k), tensor_state[k], v, weights=(n_prev, 1.0))
+                        for k, v in bs_t.items()
+                    }
                 new_t = {k: jnp.asarray(v).astype(tensor_state[k].dtype) if k in tensor_state else v for k, v in new_t.items()}
                 for k, v in tensor_state.items():
                     new_t.setdefault(k, v)
@@ -427,14 +477,15 @@ class Metric:
                 for k, v in defaults_t.items():
                     batch_full.setdefault(k, v)
                 batch_full.update(appends)
-                val = self._compute(batch_full) if self._jittable_compute else None
+                with jax.named_scope(f"{type(self).__name__}.compute"):
+                    val = self._compute(batch_full) if self._jittable_compute else None
                 return new_t, appends, val, batch_full
 
             self._jit_cache[key] = jax.jit(fn, donate_argnums=0) if (self._enable_jit and self._jittable_compute) else fn
         fwd = self._jit_cache[key]
         tensors = self._split_tensor_list(self._state)[0]
         new_t, appends, val, batch_full = self._donation_safe_dispatch(
-            "forward", lambda t, n: fwd(t, n, *args, **kwargs), tensors
+            "forward", lambda t, n: fwd(t, n, *args, **kwargs), tensors, inputs=(args, kwargs)
         )
         self._n_prev_dev = None  # forward does not return the incremented counter
         for k, v in new_t.items():
@@ -490,8 +541,14 @@ class Metric:
             did_sync = True
         try:
             state = self._concat_state()
-            with jax.profiler.TraceAnnotation(f"{type(self).__name__}.compute"):
-                value = self._reliable_call("compute", lambda: self._compute(state))
+            rec = _observability._ACTIVE
+            with _tracing.trace_span(f"{type(self).__name__}.compute"):
+                if rec is None:
+                    value = self._reliable_call("compute", lambda: self._compute(state))
+                else:
+                    t0 = _tracing.monotonic()
+                    value = self._reliable_call("compute", lambda: self._compute(state))
+                    rec.record_compute(self, rec.finish(value, t0))
         finally:
             if did_sync:
                 self.unsync()
@@ -525,15 +582,26 @@ class Metric:
         if not should_sync or not is_dist:
             return
         self._cache = {k: (list(v) if isinstance(v, list) else v) for k, v in self._state.items()}
-        synced = self._reliable_call(
-            "sync",
-            lambda: _sync.process_sync(
-                self._state,
-                self._reductions,
-                process_group=process_group or self.process_group,
-                dist_sync_fn=dist_sync_fn or self.dist_sync_fn,
-            ),
-        )
+        rec = _observability._ACTIVE
+        t0 = _tracing.monotonic() if rec is not None else 0.0
+        bytes0 = rec.counters.value("sync_payload_bytes") if rec is not None else 0
+        with _tracing.trace_span(f"{type(self).__name__}.sync"):
+            synced = self._reliable_call(
+                "sync",
+                lambda: _sync.process_sync(
+                    self._state,
+                    self._reductions,
+                    process_group=process_group or self.process_group,
+                    dist_sync_fn=dist_sync_fn or self.dist_sync_fn,
+                ),
+            )
+        if rec is not None:
+            # payload bytes were accumulated leaf-by-leaf inside process_sync;
+            # the delta is this sync's contribution
+            rec.record_sync(
+                self, rec.finish(synced, t0),
+                rec.counters.value("sync_payload_bytes") - bytes0,
+            )
         rel = self._reliability
         if rel is not None and rel.validate_on_sync:
             # a corrupt contribution from any participant must not silently become
@@ -675,11 +743,18 @@ class Metric:
         """States flagged persistent, as numpy (checkpoint-friendly; orbax takes the
         raw state pytree via ``metric._state`` directly). Reference metric.py:924-956."""
         destination = {} if destination is None else destination
+        rec = _observability._ACTIVE
         wrote_any = False
         for name in self._defaults:
             if not self._persistent[name]:
                 continue
             current = self._state[name]
+            if rec is not None:
+                # checkpointing legitimately reads device state back — count the
+                # transfers (size from metadata, before the conversion happens)
+                for leaf in current if isinstance(current, list) else (current,):
+                    if isinstance(leaf, jax.Array):
+                        rec.record_d2h("state_dict", leaf.size * leaf.dtype.itemsize, metric=self)
             if isinstance(current, list):
                 destination[prefix + name] = [np.asarray(x) for x in current]
             else:
@@ -758,6 +833,7 @@ class Metric:
         d["_computed"] = None
         d["dist_sync_fn"] = None  # callables don't survive pickling
         d["_fault_hook"] = None  # injection hooks are process-local by nature
+        d.pop("_telemetry_id", None)  # telemetry identity is session-local
         return d
 
     def __setstate__(self, state: dict) -> None:

@@ -1,0 +1,321 @@
+"""Observability layer: dispatch tracing, retrace/D2H counters, sync timing,
+profiler integration.
+
+Opt-in and zero-overhead when disabled: the runtime's hot paths read one module
+attribute (``_ACTIVE``) and take the plain branch when it is ``None`` — no
+event objects, no signature hashing, no clock reads (guarded by a test). With a
+session active, every jitted dispatch is counted (compile vs cache hit per
+``_jit_cache`` key, by input shape/dtype signature), retrace churn trips a
+rank-zero sentinel naming the offending shapes, instrumented device→host
+readback sites increment a counter the hot loop must keep at zero, and
+``process_sync`` reports invocations plus payload bytes. The reliability
+layer's retry/quarantine decisions — previously visible only as warnings —
+land in the same event stream.
+
+Typical session::
+
+    from torchmetrics_tpu import observability as obs
+
+    with obs.telemetry_session() as rec:            # in-memory ring buffer
+        run_eval()
+    print(rec.counters.snapshot().summary(brief=True))
+    retries = rec.events_of("retry")
+
+    obs.enable(obs.TelemetryConfig(sinks=(obs.JSONLSink("trace.jsonl"),)))
+    run_eval()                                      # then: tools/trace_report.py trace.jsonl
+    obs.disable()
+
+See ``docs/observability.md`` for the event model, counter semantics, the
+xprof workflow, and overhead notes.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import itertools
+from typing import Any, Dict, Iterator, Optional, Tuple
+
+from ..utilities.prints import rank_zero_warn
+from . import tracing
+from .counters import COUNTER_FIELDS, Counters, CountersSnapshot
+from .events import (
+    EVENT_KINDS,
+    CallbackSink,
+    JSONLSink,
+    RingBufferSink,
+    Sink,
+    TelemetryEvent,
+)
+
+__all__ = [
+    "COUNTER_FIELDS",
+    "EVENT_KINDS",
+    "CallbackSink",
+    "Counters",
+    "CountersSnapshot",
+    "JSONLSink",
+    "RingBufferSink",
+    "Sink",
+    "TelemetryConfig",
+    "TelemetryEvent",
+    "TelemetryRecorder",
+    "active",
+    "disable",
+    "enable",
+    "enabled",
+    "telemetry_session",
+    "tracing",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class TelemetryConfig:
+    """Knobs for one telemetry session.
+
+    Args:
+        sinks: event sinks. Empty (the default) gets one in-memory
+            :class:`RingBufferSink` of ``ring_buffer_size`` so a bare
+            ``telemetry_session()`` is already inspectable.
+        ring_buffer_size: capacity of that default ring buffer.
+        block_until_ready: blocking-timing mode — ``jax.block_until_ready``
+            after every dispatch/compute so ``duration_s`` is honest device
+            wall-clock instead of async enqueue latency. Serializes the
+            pipeline; for attribution runs, never for production loops.
+        retrace_warn_threshold: the retrace sentinel fires a rank-zero warning
+            when a single metric's dispatch key accumulates MORE than this many
+            distinct input shape/dtype signatures (shape-instability recompile
+            churn). Warned once per key.
+    """
+
+    sinks: Tuple[Sink, ...] = ()
+    ring_buffer_size: int = 4096
+    block_until_ready: bool = False
+    retrace_warn_threshold: int = 8
+
+
+class TelemetryRecorder:
+    """The live session object: counters registry + event fan-out.
+
+    Runtime code never talks to sinks directly — it calls the ``record_*``
+    methods below, which bump counters and construct exactly one event. All
+    inputs are host metadata (shapes, dtypes, monotonic clocks, byte counts
+    derived from ``.size``/``.itemsize``): recording never reads device memory,
+    so an instrumented hot loop stays D2H-free.
+    """
+
+    def __init__(self, config: Optional[TelemetryConfig] = None) -> None:
+        self.config = config or TelemetryConfig()
+        self.counters = Counters()
+        self.sinks: Tuple[Sink, ...] = self.config.sinks or (
+            RingBufferSink(self.config.ring_buffer_size),
+        )
+        self._epoch = next(_SESSION_EPOCHS)
+        self._ids = itertools.count()
+        self._retrace_warned: set = set()
+
+    # ------------------------------------------------------------- identities
+
+    def _metric_name(self, metric: Any) -> str:
+        """Stable per-instance identity ``ClassName#n``, assigned on first sight
+        within THIS session. The stamp carries the session epoch so a metric
+        that outlives its session (or arrives pickled from another process)
+        gets a fresh id instead of colliding with an unrelated metric's
+        counters. Clones deepcopy the stamp and merge with their origin —
+        documented approximation."""
+        stamp = metric.__dict__.get("_telemetry_id")
+        if not (isinstance(stamp, tuple) and stamp[0] == self._epoch):
+            stamp = (self._epoch, next(self._ids))
+            metric._telemetry_id = stamp
+        return f"{type(metric).__name__}#{stamp[1]}"
+
+    @staticmethod
+    def _signature(inputs: Optional[tuple]) -> str:
+        """Shape/dtype key of a dispatch's inputs — metadata only, no device
+        access. Mirrors what ``jax.jit`` keys its own trace cache on."""
+        if not inputs:
+            return "()"
+        import jax
+
+        parts = []
+        for leaf in jax.tree.leaves(inputs):
+            if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+                parts.append(f"{leaf.dtype}{tuple(leaf.shape)}")
+            else:
+                parts.append(type(leaf).__name__)
+        return "|".join(parts) or "()"
+
+    # ---------------------------------------------------------------- fan-out
+
+    def emit(self, event: TelemetryEvent) -> None:
+        for sink in self.sinks:
+            sink.emit(event)
+
+    def _event(self, kind: str, metric: str, tag: str, **kw: Any) -> None:
+        self.emit(TelemetryEvent(kind=kind, metric=metric, tag=tag, timestamp=tracing.monotonic(), **kw))
+
+    # --------------------------------------------------------- runtime seams
+
+    def finish(self, result: Any, t0: float) -> float:
+        """Duration of a span started at ``t0``; blocking-timing mode waits for
+        the dispatched work first (honest wall-clock)."""
+        if self.config.block_until_ready:
+            tracing.block_for_timing(result)
+        return tracing.monotonic() - t0
+
+    def record_dispatch(self, metric: Any, tag: str, inputs: Optional[tuple], duration_s: float) -> None:
+        """One successful jitted donated dispatch (``update``/``forward``)."""
+        name = self._metric_name(metric)
+        key = f"{name}.{tag}"
+        sig = self._signature(inputs)
+        is_new, n_sigs = self.counters.record_dispatch(key, sig)
+        self._event(
+            "dispatch", name, tag, duration_s=duration_s, signature=sig, cache_hit=not is_new
+        )
+        if is_new and n_sigs > 1:
+            self._event("retrace", name, tag, signature=sig, payload={"n_signatures": n_sigs})
+        if is_new and n_sigs > self.config.retrace_warn_threshold and key not in self._retrace_warned:
+            self._retrace_warned.add(key)
+            shapes = self.counters.signatures(key)
+            rank_zero_warn(
+                f"Retrace sentinel: {key} has compiled for {n_sigs} distinct input "
+                f"shape/dtype signatures (> {self.config.retrace_warn_threshold}) — every new "
+                f"signature is a fresh XLA trace+compile. Pad or bucket inputs to a stable "
+                f"shape. Signatures seen: {shapes}.",
+                UserWarning,
+            )
+
+    def record_host_dispatch(self, metric: Any, tag: str, duration_s: float) -> None:
+        """A HostMetric eager dispatch (never jitted — no compile/hit split)."""
+        self.counters.record_host_dispatch()
+        self._event("dispatch", self._metric_name(metric), tag, duration_s=duration_s, payload={"jitted": False})
+
+    def record_compute(self, metric: Any, duration_s: float) -> None:
+        self.counters.record_compute()
+        self._event("compute", self._metric_name(metric), "compute", duration_s=duration_s)
+
+    def record_sync(self, metric: Any, duration_s: float, payload_bytes: int) -> None:
+        """One ``Metric.sync`` through ``process_sync`` (the per-leaf gather
+        counts and byte totals land in the counters from ``parallel/sync.py``)."""
+        self._event(
+            "sync", self._metric_name(metric), "sync", duration_s=duration_s,
+            payload={"payload_bytes": int(payload_bytes)},
+        )
+
+    def record_d2h(self, site: str, nbytes: int, metric: Any = None) -> None:
+        """An instrumented device→host readback (``state_dict``,
+        ``compute_on_cpu`` appends, finiteness guards). The hot loop's
+        contract is that this counter stays at zero."""
+        self.counters.record_d2h(nbytes)
+        name = self._metric_name(metric) if metric is not None else ""
+        self._event("d2h", name, site, payload={"nbytes": int(nbytes)})
+
+    def record_retry(self, describe: str, attempt: int, exc: BaseException) -> None:
+        self.counters.record_retry()
+        self._event(
+            "retry", describe, "retry",
+            payload={"attempt": attempt, "error": repr(exc)[:240]},
+        )
+
+    def record_retry_exhausted(self, describe: str, attempts: int, exc: BaseException) -> None:
+        self.counters.record_retry_exhausted()
+        self._event(
+            "retry_exhausted", describe, "retry",
+            payload={"attempts": attempts, "error": repr(exc)[:240]},
+        )
+
+    def record_quarantine(self, name: str, stage: str, status: str, exc: BaseException, update_count: int) -> None:
+        self.counters.record_quarantine(status)
+        self._event(
+            "quarantine", name, stage,
+            payload={"status": status, "error": repr(exc)[:240], "update_count": update_count},
+        )
+
+    # -------------------------------------------------------------- inspection
+
+    def metric_summary(self, metric: Any) -> Dict[str, Any]:
+        """Per-tag dispatch accounting for one metric instance."""
+        stamp = metric.__dict__.get("_telemetry_id")
+        if not (isinstance(stamp, tuple) and stamp[0] == self._epoch):
+            return {"dispatches": 0, "tags": {}}
+        prefix = f"{type(metric).__name__}#{stamp[1]}."
+        tags: Dict[str, Any] = {}
+        total = 0
+        for key, rec in self.counters.keys_for(prefix).items():
+            tag = key[len(prefix):]
+            n = rec["compiles"] + rec["cache_hits"]
+            total += n
+            tags[tag] = {
+                "dispatches": n,
+                "compiles": rec["compiles"],
+                "cache_hits": rec["cache_hits"],
+                "retraces": max(0, rec["compiles"] - 1),
+                "signatures": rec["signatures"],
+            }
+        return {"dispatches": total, "tags": tags}
+
+    @property
+    def events(self) -> Tuple[TelemetryEvent, ...]:
+        """Events from the session's first ring-buffer sink (empty tuple when
+        only external sinks are configured)."""
+        for sink in self.sinks:
+            if isinstance(sink, RingBufferSink):
+                return sink.events
+        return ()
+
+    def events_of(self, *kinds: str) -> Tuple[TelemetryEvent, ...]:
+        return tuple(e for e in self.events if e.kind in kinds)
+
+    def close(self) -> None:
+        for sink in self.sinks:
+            sink.close()
+
+
+# Session epochs make metric identity stamps self-invalidating across sessions
+# (a stale stamp from a dead session or an unpickled metric never collides).
+_SESSION_EPOCHS = itertools.count()
+
+# The one module attribute the hot paths read. ``None`` == disabled: the
+# dispatch path takes a single pointer-compare branch and does nothing else.
+_ACTIVE: Optional[TelemetryRecorder] = None
+
+
+def active() -> Optional[TelemetryRecorder]:
+    """The currently active recorder, or ``None`` when telemetry is disabled."""
+    return _ACTIVE
+
+
+def enabled() -> bool:
+    return _ACTIVE is not None
+
+
+def enable(config: Optional[TelemetryConfig] = None) -> TelemetryRecorder:
+    """Start a process-wide telemetry session (replaces any active one)."""
+    global _ACTIVE
+    if _ACTIVE is not None:
+        _ACTIVE.close()
+    _ACTIVE = TelemetryRecorder(config)
+    return _ACTIVE
+
+
+def disable() -> Optional[TelemetryRecorder]:
+    """End the session; returns the (closed) recorder for post-hoc inspection."""
+    global _ACTIVE
+    rec, _ACTIVE = _ACTIVE, None
+    if rec is not None:
+        rec.close()
+    return rec
+
+
+@contextlib.contextmanager
+def telemetry_session(config: Optional[TelemetryConfig] = None) -> Iterator[TelemetryRecorder]:
+    """``with telemetry_session() as rec: ...`` — enable for the block, always
+    disable after (the recorder stays readable)."""
+    rec = enable(config)
+    try:
+        yield rec
+    finally:
+        if _ACTIVE is rec:
+            disable()
+        else:  # a nested enable() replaced us — don't kill the newer session
+            rec.close()

@@ -1,0 +1,194 @@
+"""Process-wide telemetry counters.
+
+Everything the runtime can observe without a device→host readback is counted
+here: jitted dispatches split into compiles vs cache hits per ``_jit_cache`` key
+(first-seen input shape/dtype signature == a trace/compile; a repeat == a cache
+hit, mirroring ``jax.jit``'s own cache discipline), retraces (every compile
+beyond a key's first), device→host readbacks at the runtime's instrumented
+sites (``state_dict``, ``compute_on_cpu`` appends, finiteness guards),
+``process_sync`` invocations with payload bytes (computed from array metadata —
+``shape``/``dtype`` never touch the device), and the reliability layer's
+retry/quarantine totals.
+
+The registry is pure stdlib (no jax import): the bench driver and
+``tools/trace_report.py`` consume snapshots without initializing a runtime.
+Counting happens only while a telemetry session is active — a disabled process
+never calls into this module from a dispatch path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+# every scalar the registry tracks, in reporting order
+COUNTER_FIELDS: Tuple[str, ...] = (
+    "dispatches",  # jitted donated dispatches (update/forward tensor path)
+    "jit_compiles",  # first-seen (key, signature) pairs — one XLA trace each
+    "jit_cache_hits",  # repeat signatures — served from jit's cache
+    "retraces",  # compiles beyond a key's first (shape/dtype churn)
+    "host_dispatches",  # HostMetric update/forward (eager, never jitted)
+    "computes",  # Metric.compute invocations
+    "d2h_readbacks",  # device→host transfers at instrumented runtime sites
+    "d2h_bytes",
+    "sync_calls",  # process_sync invocations
+    "sync_payload_bytes",  # bytes entering the cross-process gather
+    "gather_calls",  # gather_all_arrays collectives (one per state leaf)
+    "retries",  # transient failures accepted for retry
+    "retries_exhausted",  # retry budgets that ran out on a transient failure
+    "quarantines",  # metrics frozen by MetricCollection(on_error="quarantine")
+    "skips",  # per-batch skips under on_error="skip"
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class CountersSnapshot:
+    """Immutable point-in-time view of a :class:`Counters` registry."""
+
+    counts: Dict[str, int]
+    per_key: Dict[str, Dict[str, Any]]
+
+    def __getitem__(self, name: str) -> int:
+        return self.counts[name]
+
+    def diff(self, earlier: "CountersSnapshot") -> "CountersSnapshot":
+        """This snapshot minus an ``earlier`` one (per-key signatures: only the
+        ones that appeared in between)."""
+        counts = {k: v - earlier.counts.get(k, 0) for k, v in self.counts.items()}
+        per_key: Dict[str, Dict[str, Any]] = {}
+        for key, rec in self.per_key.items():
+            old = earlier.per_key.get(key, {})
+            old_sigs = set(old.get("signatures", ()))
+            delta = {
+                "compiles": rec["compiles"] - old.get("compiles", 0),
+                "cache_hits": rec["cache_hits"] - old.get("cache_hits", 0),
+                "signatures": [s for s in rec["signatures"] if s not in old_sigs],
+            }
+            if delta["compiles"] or delta["cache_hits"] or delta["signatures"]:
+                per_key[key] = delta
+        return CountersSnapshot(counts=counts, per_key=per_key)
+
+    def summary(self, brief: bool = False) -> Dict[str, Any]:
+        """Flat JSON-friendly dict. ``brief`` keeps only the headline counters
+        (the shape bench configs embed next to ``attempts``/``recovered_from``)."""
+        if brief:
+            keys = (
+                "dispatches", "jit_compiles", "jit_cache_hits", "retraces",
+                "host_dispatches", "d2h_readbacks", "sync_calls",
+            )
+            return {k: self.counts[k] for k in keys}
+        out: Dict[str, Any] = dict(self.counts)
+        out["per_key"] = {
+            k: {"compiles": v["compiles"], "cache_hits": v["cache_hits"],
+                "signatures": list(v["signatures"])}
+            for k, v in self.per_key.items()
+        }
+        return out
+
+
+class Counters:
+    """Mutable counters registry (one per telemetry session; thread-safe)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = {f: 0 for f in COUNTER_FIELDS}
+        # "ClassName#id.tag" -> {"compiles", "cache_hits", "signatures": [..]}
+        self._per_key: Dict[str, Dict[str, Any]] = {}
+
+    # -------------------------------------------------------------- recording
+
+    def record_dispatch(self, key: str, signature: str) -> Tuple[bool, int]:
+        """One successful jitted dispatch under ``key`` with the given input
+        ``signature``. Returns ``(is_new_signature, n_signatures_for_key)``."""
+        with self._lock:
+            rec = self._per_key.setdefault(
+                # "signatures" keeps first-seen order for reports; "_sig_set" is
+                # the O(1) membership twin — a retrace storm (the pathology this
+                # counter diagnoses) must not make its own bookkeeping O(n)
+                key, {"compiles": 0, "cache_hits": 0, "signatures": [], "_sig_set": set()}
+            )
+            self._counts["dispatches"] += 1
+            if signature in rec["_sig_set"]:
+                rec["cache_hits"] += 1
+                self._counts["jit_cache_hits"] += 1
+                return False, len(rec["signatures"])
+            rec["signatures"].append(signature)
+            rec["_sig_set"].add(signature)
+            rec["compiles"] += 1
+            self._counts["jit_compiles"] += 1
+            if len(rec["signatures"]) > 1:
+                self._counts["retraces"] += 1
+            return True, len(rec["signatures"])
+
+    def record_host_dispatch(self) -> None:
+        with self._lock:
+            self._counts["host_dispatches"] += 1
+
+    def record_compute(self) -> None:
+        with self._lock:
+            self._counts["computes"] += 1
+
+    def record_d2h(self, nbytes: int) -> None:
+        with self._lock:
+            self._counts["d2h_readbacks"] += 1
+            self._counts["d2h_bytes"] += int(nbytes)
+
+    def record_sync(self, payload_bytes: int) -> None:
+        with self._lock:
+            self._counts["sync_calls"] += 1
+            self._counts["sync_payload_bytes"] += int(payload_bytes)
+
+    def record_gather(self) -> None:
+        with self._lock:
+            self._counts["gather_calls"] += 1
+
+    def record_retry(self) -> None:
+        with self._lock:
+            self._counts["retries"] += 1
+
+    def record_retry_exhausted(self) -> None:
+        with self._lock:
+            self._counts["retries_exhausted"] += 1
+
+    def record_quarantine(self, status: str) -> None:
+        with self._lock:
+            self._counts["quarantines" if status == "quarantined" else "skips"] += 1
+
+    # --------------------------------------------------------------- querying
+
+    def value(self, name: str) -> int:
+        with self._lock:
+            return self._counts[name]
+
+    def signatures(self, key: str) -> List[str]:
+        with self._lock:
+            rec = self._per_key.get(key)
+            return list(rec["signatures"]) if rec else []
+
+    def keys_for(self, prefix: str) -> Dict[str, Dict[str, Any]]:
+        """Per-key records whose key starts with ``prefix`` (instance lookup:
+        keys are ``ClassName#id.tag``, so ``ClassName#id.`` selects one metric)."""
+        with self._lock:
+            return {
+                k: {"compiles": v["compiles"], "cache_hits": v["cache_hits"],
+                    "signatures": list(v["signatures"])}
+                for k, v in self._per_key.items()
+                if k.startswith(prefix)
+            }
+
+    def snapshot(self) -> CountersSnapshot:
+        with self._lock:
+            return CountersSnapshot(
+                counts=dict(self._counts),
+                per_key={
+                    k: {"compiles": v["compiles"], "cache_hits": v["cache_hits"],
+                        "signatures": list(v["signatures"])}
+                    for k, v in self._per_key.items()
+                },
+            )
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts = {f: 0 for f in COUNTER_FIELDS}
+            self._per_key = {}

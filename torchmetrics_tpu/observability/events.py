@@ -1,0 +1,191 @@
+"""Structured telemetry events and pluggable sinks.
+
+One event per observable runtime moment — a jitted dispatch, a compute, a
+cross-process sync, a retry, a quarantine, a retrace, an instrumented
+device→host readback. Timestamps are **monotonic-clock** (``time.monotonic``):
+telemetry orders and measures, it does not tell wall-clock time (a trace
+consumer that needs an epoch anchor records one itself at session start).
+
+Sinks are deliberately tiny: ``emit(event)`` plus optional ``close()``. The
+runtime never constructs an event unless a telemetry session is active, so a
+slow sink can only ever tax an opted-in process.
+
+Everything here is stdlib-only; ``tools/trace_report.py`` re-reads the JSONL
+output without importing jax.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import json
+from typing import Any, Callable, Dict, List, Mapping, Optional, Tuple
+
+# the closed set of event kinds the runtime emits
+EVENT_KINDS: Tuple[str, ...] = (
+    "dispatch",  # a jitted (or HostMetric eager) update/forward dispatch
+    "compute",  # Metric.compute
+    "sync",  # Metric.sync through process_sync
+    "retry",  # a transient failure accepted for retry
+    "retry_exhausted",  # retry budget ran out on a transient failure
+    "quarantine",  # MetricCollection froze/skipped a failing member
+    "retrace",  # a dispatch key saw a NEW shape/dtype signature (recompile)
+    "d2h",  # an instrumented device→host readback
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class TelemetryEvent:
+    """One structured telemetry record.
+
+    Args:
+        kind: one of :data:`EVENT_KINDS`.
+        metric: metric identity — ``ClassName#instance_id`` for runtime events,
+            a collection key for quarantine events, a ``describe`` string for
+            retry events.
+        tag: dispatch tag / stage (``update``/``forward``/``compute``/``sync``,
+            or a site name for ``d2h``).
+        timestamp: ``time.monotonic()`` at emission.
+        duration_s: measured span for dispatch/compute/sync events (honest
+            wall-clock only under the blocking-timing mode — async dispatch
+            returns before the device finishes).
+        signature: the input shape/dtype key for dispatch/retrace events.
+        cache_hit: for dispatch events — False on the signature's first sight.
+        payload: kind-specific extras (attempt numbers, error reprs, byte
+            counts, ...).
+    """
+
+    kind: str
+    metric: str
+    tag: str
+    timestamp: float
+    duration_s: Optional[float] = None
+    signature: Optional[str] = None
+    cache_hit: Optional[bool] = None
+    payload: Mapping[str, Any] = dataclasses.field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "kind": self.kind,
+            "metric": self.metric,
+            "tag": self.tag,
+            "timestamp": round(self.timestamp, 9),
+        }
+        if self.duration_s is not None:
+            out["duration_s"] = round(self.duration_s, 9)
+        if self.signature is not None:
+            out["signature"] = self.signature
+        if self.cache_hit is not None:
+            out["cache_hit"] = self.cache_hit
+        if self.payload:
+            out["payload"] = dict(self.payload)
+        return out
+
+
+class Sink:
+    """Sink protocol: receives every event of a session."""
+
+    def emit(self, event: TelemetryEvent) -> None:  # pragma: no cover - protocol
+        raise NotImplementedError
+
+    def close(self) -> None:
+        """Flush/release resources at session end. Default: nothing."""
+
+
+class RingBufferSink(Sink):
+    """Bounded in-memory event buffer (oldest events evicted first; O(1) emit —
+    this sink sits on the instrumented dispatch path)."""
+
+    def __init__(self, capacity: int = 4096) -> None:
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = capacity
+        self._events: "collections.deque[TelemetryEvent]" = collections.deque(maxlen=capacity)
+        self.evicted = 0  # how many events fell off the front
+
+    def emit(self, event: TelemetryEvent) -> None:
+        if len(self._events) == self.capacity:
+            self.evicted += 1  # deque(maxlen) drops the oldest on append
+        self._events.append(event)
+
+    @property
+    def events(self) -> Tuple[TelemetryEvent, ...]:
+        return tuple(self._events)
+
+    def of_kind(self, *kinds: str) -> Tuple[TelemetryEvent, ...]:
+        return tuple(e for e in self._events if e.kind in kinds)
+
+    def drain(self) -> Tuple[TelemetryEvent, ...]:
+        out = tuple(self._events)
+        self._events.clear()
+        return out
+
+
+class JSONLSink(Sink):
+    """Appends one JSON line per event to ``path`` (opened lazily, flushed per
+    event so a crashed process still leaves a readable trace). The format is
+    what ``tools/trace_report.py`` renders."""
+
+    def __init__(self, path: str) -> None:
+        self.path = str(path)
+        self._fh = None
+        self.written = 0
+
+    def emit(self, event: TelemetryEvent) -> None:
+        if self._fh is None:
+            self._fh = open(self.path, "a", encoding="utf-8")
+        self._fh.write(json.dumps(event.to_dict()) + "\n")
+        self._fh.flush()
+        self.written += 1
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+
+class CallbackSink(Sink):
+    """Routes events to user hooks by kind.
+
+    ``on_update`` fires for dispatch events (tags ``update``/``forward``),
+    ``on_compute`` for compute, ``on_sync`` for sync, ``on_retry`` for
+    retry/retry_exhausted, ``on_quarantine`` for quarantine. ``on_event``
+    fires for *every* event (including retrace/d2h). Hook exceptions propagate
+    — a monitoring callback that raises is a bug worth surfacing, not
+    swallowing.
+    """
+
+    def __init__(
+        self,
+        on_update: Optional[Callable[[TelemetryEvent], None]] = None,
+        on_compute: Optional[Callable[[TelemetryEvent], None]] = None,
+        on_sync: Optional[Callable[[TelemetryEvent], None]] = None,
+        on_retry: Optional[Callable[[TelemetryEvent], None]] = None,
+        on_quarantine: Optional[Callable[[TelemetryEvent], None]] = None,
+        on_event: Optional[Callable[[TelemetryEvent], None]] = None,
+    ) -> None:
+        self.on_update = on_update
+        self.on_compute = on_compute
+        self.on_sync = on_sync
+        self.on_retry = on_retry
+        self.on_quarantine = on_quarantine
+        self.on_event = on_event
+
+    def emit(self, event: TelemetryEvent) -> None:
+        if self.on_event is not None:
+            self.on_event(event)
+        if event.kind == "dispatch":
+            if self.on_update is not None and event.tag in ("update", "forward"):
+                self.on_update(event)
+        elif event.kind == "compute":
+            if self.on_compute is not None:
+                self.on_compute(event)
+        elif event.kind == "sync":
+            if self.on_sync is not None:
+                self.on_sync(event)
+        elif event.kind in ("retry", "retry_exhausted"):
+            if self.on_retry is not None:
+                self.on_retry(event)
+        elif event.kind == "quarantine":
+            if self.on_quarantine is not None:
+                self.on_quarantine(event)

@@ -1,0 +1,52 @@
+"""Profiler integration: xprof annotations and honest dispatch timing.
+
+Two complementary mechanisms, both free when nobody is looking:
+
+- :func:`trace_span` — a host-side ``jax.profiler.TraceAnnotation``. Shows up
+  as a named span on the xprof host timeline, so metric work is attributable
+  next to the model's steps. Constructed unconditionally at the dispatch
+  boundaries (it was already there for ``update``/``compute``; the telemetry
+  layer extends it to ``forward``/``sync``) — its cost without an active
+  profiler is a counter bump inside jax.
+- :func:`graph_scope` — ``jax.named_scope``: a *trace-time* HLO name prefix.
+  Zero runtime cost (it only exists while jit is tracing) and it is what makes
+  a metric's ops findable in the xprof device view: the fused collection's HLO
+  otherwise CSEs four metrics into an anonymous soup.
+
+Timing: async dispatch returns when XLA has *enqueued* the work, so a bare
+``monotonic()`` pair measures dispatch latency, not device time. The
+blocking-timing mode (``TelemetryConfig(block_until_ready=True)``) inserts
+:func:`block_for_timing` after each dispatch for honest per-call wall-clock —
+at the price of serializing the pipeline, which is exactly why it is opt-in
+per session and never the default.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any
+
+import jax
+
+
+def trace_span(label: str):
+    """Host-side profiler span (xprof host timeline / TraceMe)."""
+    return jax.profiler.TraceAnnotation(label)
+
+
+def graph_scope(label: str):
+    """Trace-time HLO name scope — wrap jitted metric bodies so their ops carry
+    the metric's name in the xprof device view. No runtime cost."""
+    return jax.named_scope(label)
+
+
+def monotonic() -> float:
+    """The telemetry clock (monotonic; never wall time)."""
+    return time.monotonic()
+
+
+def block_for_timing(value: Any) -> Any:
+    """Wait for the dispatched work to complete so the surrounding monotonic
+    pair measures device wall-clock, not enqueue latency. ``block_until_ready``
+    waits on futures without transferring — no device→host readback."""
+    return jax.block_until_ready(value)

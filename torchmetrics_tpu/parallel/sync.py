@@ -27,6 +27,9 @@ from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence, Union
 import jax
 import jax.numpy as jnp
 
+from .. import observability as _observability
+from ..observability import tracing as _tracing
+
 Array = jax.Array
 Reduction = Union[str, Callable, None]
 
@@ -234,24 +237,42 @@ def process_sync(
     cluster-level restart path instead).
     """
     gather = dist_sync_fn or gather_all_arrays
+    rec = _observability._ACTIVE
+    if rec is not None:
+        rec.counters.record_sync(_payload_bytes(state))
     out: Dict[str, Any] = {}
-    for name, value in state.items():
-        fx = reductions.get(name)
-        if isinstance(value, list):  # concat list state: pre-concat, then gather
-            local = (
-                jnp.concatenate([jnp.atleast_1d(jnp.asarray(v)) for v in value], axis=0)
-                if value
-                else None  # zero-update process still participates in the collective
-            )
-            if local is None and dist_sync_fn is not None:
-                # injected gathers keep the plain fn(value, group) contract
-                local = jnp.zeros((0,), jnp.float32)
-            gathered = gather(local, process_group)
-            out[name] = [g for g in gathered if g.shape[0] > 0] or value
-            continue
-        gathered = gather(value, process_group)
-        out[name] = _fold_gathered(gathered, fx)
+    with _tracing.trace_span("process_sync"):
+        for name, value in state.items():
+            fx = reductions.get(name)
+            if rec is not None:
+                rec.counters.record_gather()
+            if isinstance(value, list):  # concat list state: pre-concat, then gather
+                local = (
+                    jnp.concatenate([jnp.atleast_1d(jnp.asarray(v)) for v in value], axis=0)
+                    if value
+                    else None  # zero-update process still participates in the collective
+                )
+                if local is None and dist_sync_fn is not None:
+                    # injected gathers keep the plain fn(value, group) contract
+                    local = jnp.zeros((0,), jnp.float32)
+                gathered = gather(local, process_group)
+                out[name] = [g for g in gathered if g.shape[0] > 0] or value
+                continue
+            gathered = gather(value, process_group)
+            out[name] = _fold_gathered(gathered, fx)
     return out
+
+
+def _payload_bytes(state: Dict[str, Any]) -> int:
+    """Bytes this process contributes to a sync — from ``size``/``itemsize``
+    metadata only, never a device read."""
+    total = 0
+    for value in state.values():
+        leaves = value if isinstance(value, list) else [value]
+        for leaf in leaves:
+            if hasattr(leaf, "size") and hasattr(leaf, "dtype"):
+                total += int(leaf.size) * jnp.dtype(leaf.dtype).itemsize
+    return total
 
 
 def _fold_gathered(gathered: List[Array], fx: Reduction):

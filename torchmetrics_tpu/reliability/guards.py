@@ -21,7 +21,17 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import observability as _observability
 from ..utilities.exceptions import StateCorruptionError
+
+
+def _mark_finite_scan() -> None:
+    """Each finiteness scan reads ONE bool back from device — the readback the
+    guards' boundary-only placement exists to amortize. Counted so a telemetry
+    trace shows exactly where the D2H budget goes."""
+    rec = _observability._ACTIVE
+    if rec is not None:
+        rec.record_d2h("finiteness_guard", 1)
 
 # reduction tags under which a tensor leaf keeps its default shape forever
 _SHAPE_PRESERVING = ("sum", "mean", "min", "max")
@@ -61,6 +71,7 @@ def _check_tensor_leaf(
         # leaves (cat lists, None-tagged gathers) may carry NaN by construction
         # (e.g. masked user preds) — scanning those would reject healthy state
         if check_finite and jnp.issubdtype(value.dtype, jnp.floating):
+            _mark_finite_scan()
             if not bool(jnp.isfinite(value).all()):
                 raise StateCorruptionError(
                     f"{context}: state '{name}' contains non-finite values (NaN/Inf)."
@@ -165,10 +176,12 @@ def validate_restored(
             if check_finite:
                 for i, elem in enumerate(value):
                     arr = jnp.asarray(elem)
-                    if jnp.issubdtype(arr.dtype, jnp.floating) and not bool(jnp.isfinite(arr).all()):
-                        raise StateCorruptionError(
-                            f"Checkpoint state '{prefix}{name}[{i}]' contains non-finite values."
-                        )
+                    if jnp.issubdtype(arr.dtype, jnp.floating):
+                        _mark_finite_scan()
+                        if not bool(jnp.isfinite(arr).all()):
+                            raise StateCorruptionError(
+                                f"Checkpoint state '{prefix}{name}[{i}]' contains non-finite values."
+                            )
         else:
             _check_tensor_leaf(
                 name, value, default, fx, f"checkpoint restore ('{prefix}{name}')", check_finite

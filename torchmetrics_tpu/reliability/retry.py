@@ -211,24 +211,55 @@ class RetryPolicy:
         outcome = _RetryOutcome()
         return self._call(thunk, on_retry, describe, outcome), outcome
 
-    def _call(self, thunk, on_retry, describe, outcome: "_RetryOutcome") -> Any:
+    @staticmethod
+    def _warn_nonfatal(message: str) -> None:
+        """Warn without letting a warnings-as-errors filter (``python -W error``,
+        pytest ``filterwarnings = error``) convert the advisory into an exception
+        inside the retry loop's except handler — that would mask the original
+        transient failure and abort every retry, defeating the feature the
+        warning merely narrates."""
         from ..utilities.prints import rank_zero_warn
+
+        try:
+            rank_zero_warn(message, UserWarning)
+        except Exception:  # noqa: BLE001 — the warning must never outrank the retry
+            pass
+
+    def _call(self, thunk, on_retry, describe, outcome: "_RetryOutcome") -> Any:
+        from ..observability import active as _telemetry_active
 
         while True:
             outcome.attempts += 1
             try:
                 return thunk()
             except Exception as exc:  # noqa: BLE001 — classifier decides
-                if self.classify(exc) != TRANSIENT or outcome.attempts >= self.max_attempts:
+                transient = self.classify(exc) == TRANSIENT
+                if not transient or outcome.attempts >= self.max_attempts:
+                    if transient:
+                        # exhausted budget on a transient fault: the moment the
+                        # failure becomes final must not pass silently — warn and
+                        # record before the original exception re-raises
+                        self._warn_nonfatal(
+                            f"Retry budget exhausted for {describe or 'metric dispatch'} "
+                            f"after {outcome.attempts} attempts; giving up on transient "
+                            f"failure: {exc!r}"
+                        )
+                        rec = _telemetry_active()
+                        if rec is not None:
+                            rec.record_retry_exhausted(
+                                describe or "metric dispatch", outcome.attempts, exc
+                            )
                     raise
                 outcome.recovered_from.append(f"{type(exc).__name__}: {exc}"[:240])
                 delay = self.delay_for(outcome.attempts)
-                rank_zero_warn(
+                self._warn_nonfatal(
                     f"Transient failure in {describe or 'metric dispatch'} "
                     f"(attempt {outcome.attempts}/{self.max_attempts}): {exc!r}. "
-                    f"Retrying in {delay:.3f}s.",
-                    UserWarning,
+                    f"Retrying in {delay:.3f}s."
                 )
+                rec = _telemetry_active()
+                if rec is not None:
+                    rec.record_retry(describe or "metric dispatch", outcome.attempts, exc)
                 if delay > 0:
                     self.sleep_fn(delay)
                 if on_retry is not None:
