@@ -52,16 +52,27 @@ TELEMETRY_PROBE_STEPS = 8
 
 def _telemetry_probe(probe) -> dict:
     """Per-config telemetry summary (compiles, retraces, d2h readbacks, sync
-    calls) from a short instrumented probe run AFTER the timed loop — the
-    measured loops stay un-instrumented so opting the bench into observability
-    never moves the headline numbers. ``probe()`` should rebuild the config's
-    metric fresh and run a few updates + a compute, mirroring the loop shape."""
+    calls — plus compiled cost and state-memory columns) from a short
+    instrumented probe run AFTER the timed loop — the measured loops stay
+    un-instrumented so opting the bench into observability never moves the
+    headline numbers. ``probe()`` should rebuild the config's metric fresh,
+    run a few updates + a compute mirroring the loop shape, and return the
+    metric/collection so its state footprint can be recorded."""
     from torchmetrics_tpu import observability as obs
 
     try:
         with obs.telemetry_session() as rec:
-            probe()
-        return rec.counters.snapshot().summary(brief=True)
+            obj = probe()
+        out = rec.counters.snapshot().summary(brief=True)
+        # dispatch-weighted XLA cost of the probe's compiled programs — FLOPs
+        # and HBM traffic per round become comparable columns in bench_compare
+        out["cost"] = rec.cost_summary()
+        if obj is not None and hasattr(obj, "state_memory"):
+            out["state_memory_bytes"] = obj.state_memory()["total_bytes"]
+        peaks = rec.memory_snapshot()
+        if peaks:
+            out["state_memory_peak_bytes"] = max(m["peak_bytes"] for m in peaks.values())
+        return out
     except Exception as err:  # a probe failure must not cost the config its number
         return {"error": f"{type(err).__name__}: {err}"[:240]}
 
@@ -94,6 +105,7 @@ def bench_ours() -> dict:
         for _ in range(TELEMETRY_PROBE_STEPS):
             m.update(preds, target)
         jax.block_until_ready(m._state)
+        return m
 
     return {"updates_per_sec": round(best, 2), "telemetry": _telemetry_probe(probe)}
 
@@ -217,6 +229,7 @@ def bench_fused_collection() -> dict:
             c.update(probs, target)
         for m in c.values():
             jax.block_until_ready(m._state)
+        return c
 
     return {
         "updates_per_sec": round(best, 2),
@@ -283,6 +296,7 @@ def bench_map() -> dict:
         m.update(p, t)
         m.update(p, t)
         m.compute()
+        return m
 
     return {
         "images_per_sec_update": round(n_imgs / update_elapsed, 2),
@@ -325,6 +339,7 @@ def bench_fid() -> dict:
                 fid.update(imgs, real=True)
                 fid.update(imgs, real=False)
                 jax.block_until_ready(fid._state)
+                return fid
             out["telemetry"] = _telemetry_probe(probe)
     out["unit"] = "InceptionV3-2048 fwd+stats images/s (299x299)"
     return out
@@ -517,6 +532,34 @@ def _is_transient_error_text(text: str) -> bool:
     return any(m in low for m in _TRANSIENT_MARKERS)
 
 
+def _regression_verdict(current_parsed: dict) -> dict:
+    """Gate this round against the latest BENCH_r*.json on disk via
+    tools/bench_compare.py (stdlib-only, loaded by path — the parent stays
+    jax-free). Missing history or a comparator hiccup reports instead of
+    failing the round."""
+    import glob
+    import importlib.util
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        rounds = sorted(glob.glob(os.path.join(here, "BENCH_r*.json")))
+        if not rounds:
+            return {"verdict": "no_previous_round"}
+        previous = rounds[-1]
+        spec = importlib.util.spec_from_file_location(
+            "bench_compare", os.path.join(here, "tools", "bench_compare.py")
+        )
+        bench_compare = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(bench_compare)
+        with open(previous, "r", encoding="utf-8") as fh:
+            prev_doc = json.load(fh)
+        out = bench_compare.verdict_against_previous(prev_doc, current_parsed)
+        out["against"] = os.path.basename(previous)
+        return out
+    except Exception as err:  # the verdict must never cost the round its numbers
+        return {"verdict": "error", "error": f"{type(err).__name__}: {err}"[:240]}
+
+
 def _run_in_subprocess(name: str) -> dict:
     """One config under the retry policy: transient infra errors (classified by
     message — the subprocess is already dead, there is no exception object) get
@@ -552,17 +595,17 @@ def main() -> None:
             extra[f"{name}_error"] = results[name]["error"]
     extra["torch_cpu_proxy_updates_per_sec"] = baseline
     extra["vs_baseline_note"] = "torch-CPU proxy (no CUDA device in pod; BASELINE.md north star is vs CUDA GPU)"
-    print(
-        json.dumps(
-            {
-                "metric": "multiclass_accuracy_updates_per_sec",
-                "value": ours,
-                "unit": f"updates/s (batch={BATCH}, C={NUM_CLASSES})",
-                "vs_baseline": vs,
-                "extra": extra,
-            }
-        )
-    )
+    parsed = {
+        "metric": "multiclass_accuracy_updates_per_sec",
+        "value": ours,
+        "unit": f"updates/s (batch={BATCH}, C={NUM_CLASSES})",
+        "vs_baseline": vs,
+        "extra": extra,
+    }
+    # every round carries its own verdict vs the previous round on disk, so a
+    # perf regression is a field in the JSON line instead of a human diff
+    extra["regression_vs_previous"] = _regression_verdict(parsed)
+    print(json.dumps(parsed))
 
 
 if __name__ == "__main__":

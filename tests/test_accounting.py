@@ -1,0 +1,307 @@
+"""Cost & memory accounting + fleet aggregation — the PR-4 acceptance contract:
+
+- every dispatch key the counters record as a compile has a cost entry
+  (``cost_snapshot()`` keys == compile-counter keys), harvested with zero
+  device→host traffic (transfer-guard enforced);
+- ``state_memory()`` totals match the sum of state-leaf ``nbytes`` with zero
+  D2H under the transfer guard, fused-group aliases are not double-counted,
+  and the unbounded-growth sentinel fires once per list state;
+- ``aggregate_counters()`` over N simulated ranks equals the sum of the N
+  per-rank snapshots, and the distributed rollup rides the parallel/sync
+  gather plane with a metadata-sized payload."""
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import torchmetrics_tpu as tm
+from torchmetrics_tpu import MetricCollection, observability as obs
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.observability import memory as obs_memory
+from torchmetrics_tpu.parallel import sync as par_sync
+
+pytestmark = pytest.mark.telemetry
+
+
+def _x(n=8, seed=0):
+    return jnp.asarray(np.random.default_rng(seed).random(n).astype(np.float32))
+
+
+class _SumState(Metric):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.add_state("s", default=np.zeros(()), dist_reduce_fx="sum")
+
+    def _batch_state(self, x):
+        return {"s": x.sum()}
+
+    def _compute(self, state):
+        return state["s"]
+
+
+# ------------------------------------------------------------------ costs
+
+
+def test_cost_entries_reconcile_with_compile_keys():
+    """Acceptance: cost_snapshot() keys == compile-counter keys, with the run
+    totals weighted by how often each compiled signature actually dispatched."""
+    m = _SumState()
+    with obs.telemetry_session() as rec:
+        with jax.transfer_guard_device_to_host("disallow"):  # harvest is aval-only
+            for _ in range(3):
+                m.update(_x(8))
+            m.update(_x(4))  # second signature -> second compile + cost entry
+    snap = rec.counters.snapshot()
+    costs = rec.cost_snapshot()
+    assert set(costs) == set(snap.per_key)
+    key = next(iter(costs))
+    sigs = costs[key]
+    assert len(sigs) == snap.per_key[key]["compiles"] == 2
+    for rec_d in sigs.values():
+        assert rec_d["available"] is True
+        assert rec_d["flops"] > 0 and rec_d["bytes_accessed"] > 0
+        assert rec_d["argument_bytes"] > 0
+    # dispatch-weighted totals: sum over signatures of per-call flops x count
+    sig_counts = snap.per_key[key]["sig_counts"]
+    assert sum(sig_counts.values()) == snap["dispatches"] == 4
+    expected = sum(sigs[s]["flops"] * n for s, n in sig_counts.items())
+    totals = snap.cost_totals()
+    assert totals["run_flops"] == pytest.approx(expected)
+    assert totals["compiled_programs"] == 2 and totals["unavailable"] == 0
+    # the non-brief counters summary folds the same numbers in
+    full = snap.summary()
+    assert full["cost_totals"]["run_flops"] == pytest.approx(expected)
+    assert set(full["costs"]) == set(snap.per_key)
+
+
+def test_cost_placeholder_keeps_reconciliation_for_eager_path():
+    """jit=False metrics still count compiles by signature novelty; the cost
+    registry records an unavailable placeholder so the 1:1 key invariant holds."""
+    m = _SumState(jit=False)
+    with obs.telemetry_session() as rec:
+        m.update(_x())
+    snap = rec.counters.snapshot()
+    costs = rec.cost_snapshot()
+    assert set(costs) == set(snap.per_key) and len(costs) == 1
+    (record,) = [r for sigs in costs.values() for r in sigs.values()]
+    assert record["available"] is False and "lowerable" in record["error"]
+    assert snap.cost_totals()["unavailable"] == 1
+
+
+def test_cost_accounting_config_off():
+    m = _SumState()
+    with obs.telemetry_session(obs.TelemetryConfig(cost_accounting=False)) as rec:
+        m.update(_x())
+    assert rec.cost_snapshot() == {}
+    assert "costs" not in rec.counters.snapshot().summary()
+
+
+def test_cost_snapshot_diff_isolates_new_programs():
+    m = _SumState()
+    with obs.telemetry_session() as rec:
+        m.update(_x(8))
+        first = rec.counters.snapshot()
+        m.update(_x(8))  # cache hit: no new program
+        m.update(_x(4))  # fresh compile
+        delta = rec.counters.snapshot().diff(first)
+    (sigs,) = delta.costs.values()
+    assert len(sigs) == 1  # only the (4,) program is new in the window
+    (key_rec,) = delta.per_key.values()
+    assert key_rec["sig_counts"] == {"float32(8,)": 1, "float32(4,)": 1}
+
+
+def test_module_level_cost_snapshot():
+    assert obs.cost_snapshot() == {}  # disabled -> empty, never raises
+    m = _SumState()
+    with obs.telemetry_session():
+        m.update(_x())
+        assert set(obs.cost_snapshot()) == {f"_SumState#0.update"}
+
+
+# ----------------------------------------------------------------- memory
+
+
+def test_state_memory_matches_leaf_nbytes_zero_d2h():
+    """Acceptance: totals == sum of state-leaf nbytes, under a disallow guard."""
+    m = tm.CatMetric()
+    m.update(_x(8))
+    m.update(_x(8))
+    s = tm.SumMetric()
+    s.update(_x(8))
+    with jax.transfer_guard_device_to_host("disallow"):
+        cat_mem = m.state_memory()
+        sum_mem = s.state_memory()
+    expected = sum(
+        leaf.size * leaf.dtype.itemsize
+        for v in m._state.values()
+        for leaf in (v if isinstance(v, list) else [v])
+    )
+    assert cat_mem["total_bytes"] == expected == 64
+    assert cat_mem["states"]["value"] == {"kind": "list", "nbytes": 64, "elements": 2}
+    assert sum_mem["states"]["sum_value"]["kind"] == "tensor"
+    assert sum_mem["states"]["sum_value"]["dtype"] == "float32"
+    assert sum_mem["total_bytes"] == 4
+
+
+def test_collection_state_memory_dedups_aliased_groups():
+    col = MetricCollection({"s1": tm.SumMetric(), "s2": tm.SumMetric()})
+    col.update(_x())
+    col.update(_x())  # groups derived: s2 aliases s1's state dict
+    report = col.state_memory()
+    aliased = [n for n, r in report["members"].items() if "aliased_to" in r]
+    holders = [n for n, r in report["members"].items() if "aliased_to" not in r]
+    assert len(aliased) == 1 and len(holders) == 1
+    assert report["members"][aliased[0]]["aliased_to"] == holders[0]
+    # the shared dict is charged once: total == one metric's footprint
+    assert report["total_bytes"] == report["members"][holders[0]]["total_bytes"] == 4
+
+
+def test_peak_tracking_and_growth_sentinel_warns_once():
+    cfg = obs.TelemetryConfig(state_growth_warn_bytes=40)
+    m = tm.CatMetric()
+    with obs.telemetry_session(cfg) as rec:
+        m.update(_x(8))  # 32 bytes: under threshold
+        with pytest.warns(UserWarning, match="State growth sentinel.*CatMetric#0.value"):
+            m.update(_x(8))  # 64 bytes: crosses
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")  # crossed already -> warned once only
+            m.update(_x(8))
+    events = rec.events_of("state_growth")
+    assert len(events) == 1
+    assert events[0].payload["nbytes"] == 64 and events[0].payload["elements"] == 2
+    mem = rec.memory_snapshot()["CatMetric#0"]
+    assert mem["current_bytes"] == mem["peak_bytes"] == 96
+    assert mem["per_state_peak"]["value"] == 96
+
+
+def test_memory_tracking_config_off():
+    m = tm.CatMetric()
+    with obs.telemetry_session(obs.TelemetryConfig(track_state_memory=False)) as rec:
+        m.update(_x())
+    assert rec.memory_snapshot() == {}
+
+
+def test_telemetry_summary_carries_state_bytes():
+    col = MetricCollection({"s1": tm.SumMetric(), "s2": tm.SumMetric()})
+    with obs.telemetry_session():
+        col.update(_x())
+        summary = col.telemetry_summary()
+    assert summary["state_memory_bytes"] == 4  # aliased pair counted once
+    assert all(info["state_bytes"] == 4 for info in summary["members"].values())
+
+
+def test_state_memory_helpers_are_metadata_only():
+    assert obs_memory.leaf_nbytes(np.zeros((4, 2), np.float64)) == 64
+    assert obs_memory.leaf_nbytes("not an array") == 0
+    report = obs_memory.state_memory({"a": [np.zeros(3, np.float32)], "b": np.zeros((), np.int64)})
+    assert report["total_bytes"] == 12 + 8
+
+
+# ------------------------------------------------------------------ fleet
+
+
+def _snapshot_with(dispatches=0, sync_time_us=0, sync_calls=0, key=None):
+    c = obs.Counters()
+    for i in range(dispatches):
+        c.record_dispatch(key or "M#0.update", "f32(4,)")
+    for _ in range(sync_calls):
+        c.record_sync(16)
+    c.record_sync_time(sync_time_us / 1e6)
+    return c.snapshot()
+
+
+def test_aggregate_counters_equals_sum_of_ranks():
+    """Acceptance: fleet totals == exact fieldwise sum of per-rank snapshots."""
+    ranks = [
+        _snapshot_with(dispatches=3, sync_time_us=100, sync_calls=1, key="A#0.update"),
+        _snapshot_with(dispatches=5, sync_time_us=900, sync_calls=1, key="A#0.update"),
+        _snapshot_with(dispatches=2, sync_time_us=400, sync_calls=2, key="B#0.update"),
+    ]
+    fleet = obs.aggregate_counters(ranks)
+    assert fleet.ranks == 3
+    for field in obs.COUNTER_FIELDS:
+        assert fleet.totals[field] == sum(r.counts[field] for r in ranks), field
+    assert fleet["dispatches"] == 10 and fleet["sync_calls"] == 4
+    # per-key union: shared keys sum, distinct keys survive
+    assert fleet.per_key["A#0.update"]["compiles"] == 2  # one first-sight per rank
+    assert fleet.per_key["A#0.update"]["sig_counts"] == {"f32(4,)": 8}
+    assert fleet.per_key["B#0.update"]["compiles"] == 1
+    # straggler attribution: rank 1 holds the sync-time max
+    skew = fleet.stragglers["sync_time_us"]
+    assert (skew["min"], skew["max"], skew["skew"]) == (100, 900, 800)
+    assert skew["min_rank"] == 0 and skew["max_rank"] == 1
+    brief = fleet.summary(brief=True)
+    assert brief["fleet"] is True and brief["ranks"] == 3 and brief["dispatches"] == 10
+    full = fleet.summary()
+    assert len(full["per_rank"]) == 3 and full["totals"]["dispatches"] == 10
+
+
+def test_aggregate_counters_accepts_vectors_and_rejects_bad_shapes():
+    snap = _snapshot_with(dispatches=4)
+    fleet = obs.aggregate_counters([snap, snap.counts_vector(), dict(snap.counts)])
+    assert fleet.totals["dispatches"] == 12
+    with pytest.raises(ValueError, match="at least one"):
+        obs.aggregate_counters([])
+    with pytest.raises(ValueError, match="entries"):
+        obs.aggregate_counters([[1, 2, 3]])
+
+
+def test_gather_counters_through_gather_plane():
+    """The distributed rollup rides parallel/sync with a metadata payload: an
+    injected 2-way gather doubles every total and keeps local per-key records."""
+    m = _SumState()
+    with obs.telemetry_session() as rec:
+        for _ in range(4):
+            m.update(_x())
+        fleet = obs.gather_counters(dist_sync_fn=lambda v, g: [v, v])
+        local = rec.counters.snapshot()
+    assert fleet.ranks == 2
+    for field in obs.COUNTER_FIELDS:
+        assert fleet.totals[field] == 2 * local.counts[field], field
+    assert fleet.per_key["_SumState#0.update"]["compiles"] == 1  # local records only
+    # single process, no injected gather: a one-rank fleet, not an error
+    solo = obs.gather_counters(local)
+    assert solo.ranks == 1 and solo.totals == {f: local.counts[f] for f in obs.COUNTER_FIELDS}
+
+
+def test_recorder_summary_fleet_mode():
+    m = _SumState(
+        distributed_available_fn=lambda: True,
+        dist_sync_fn=lambda v, g: [v, v],
+    )
+    with obs.telemetry_session() as rec:
+        m.update(_x())
+        m.compute()  # fake-distributed: one sync with timed duration
+        out = rec.summary(brief=True, fleet=True, dist_sync_fn=lambda v, g: [v, v])
+    assert out["fleet"] is True and out["ranks"] == 2
+    assert out["sync_calls"] == 2 * out["local"]["sync_calls"] == 2
+    assert out["stragglers"]["sync_time_us"]["max"] >= 0
+    # local-only summary stays the plain counters shape
+    local = rec.summary(brief=True)
+    assert "fleet" not in local and local["dispatches"] == 1
+
+
+def test_gather_metadata_vector_single_process():
+    assert par_sync.gather_metadata_vector([1, 2, 3]) == [[1, 2, 3]]
+    doubled = par_sync.gather_metadata_vector([4, 5], dist_sync_fn=lambda v, g: [v, v])
+    assert doubled == [[4, 5], [4, 5]]
+
+
+def test_gather_metadata_vector_survives_int32_overflow():
+    """Counters past 2**31 (a >2 GiB cumulative sync payload) must gather
+    exactly despite jax's default x64-disabled int64→int32 downcast — the
+    (hi, lo) split keeps values below 2**62 exact."""
+    big = [2**31 + 5, 7 * 2**32, 0, 3]
+    gathered = par_sync.gather_metadata_vector(big, dist_sync_fn=lambda v, g: [v, v])
+    assert gathered == [big, big]
+    with pytest.raises(ValueError, match="2\\*\\*62"):
+        par_sync.gather_metadata_vector([-1])
+
+
+def test_gather_counters_requires_session_or_snapshot():
+    assert not obs.enabled()
+    with pytest.raises(RuntimeError, match="active telemetry session"):
+        obs.gather_counters()

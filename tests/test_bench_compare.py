@@ -1,0 +1,259 @@
+"""tools/bench_compare.py + multi-file tools/trace_report.py — stdlib-only
+(deliberately no jax import: these are the CI smoke tests for the offline
+tooling, runnable on a bare runner the way an operator would use them).
+
+Fixture trajectories mirror the real ``BENCH_r0*.json`` driver shape
+(``n``/``cmd``/``rc``/``tail``/``parsed``); the acceptance contract is that
+``--check`` exits nonzero on an injected regression and zero on the repo's
+real r01→r05 history."""
+
+import glob
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.telemetry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH_COMPARE = os.path.join(REPO, "tools", "bench_compare.py")
+TRACE_REPORT = os.path.join(REPO, "tools", "trace_report.py")
+
+
+def _load(path):
+    spec = importlib.util.spec_from_file_location(os.path.basename(path)[:-3], path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+bench_compare = _load(BENCH_COMPARE)
+
+
+def _round(n, value, fused=27000.0, psum_ms=2.6, fid_bf16=6000.0, extra_overrides=None):
+    """One driver-shaped round file body mirroring the real BENCH_r0*.json."""
+    parsed = {
+        "metric": "multiclass_accuracy_updates_per_sec",
+        "value": value,
+        "unit": "updates/s (batch=65536, C=5)",
+        "vs_baseline": round(value / 423.0, 3),
+        "extra": {
+            "fused_collection_cifar10": {
+                "updates_per_sec": fused,
+                "unfused_4_dispatch_updates_per_sec": fused / 3.1,
+                "fused_speedup_vs_unfused": 3.1,
+            },
+            "coco_map_synthetic": {"images_per_sec_update": 106000.0, "compute_sec_500imgs_80cls": 2.3},
+            "fid_inception_fwd": {"images_per_sec_bfloat16": fid_bf16},
+            "sync_allreduce_8dev_cpu": {"psum_latency_ms": psum_ms},
+            "torch_cpu_proxy_updates_per_sec": 423.0,
+        },
+    }
+    if extra_overrides:
+        parsed["extra"].update(extra_overrides)
+    return {"n": n, "cmd": "python bench.py", "rc": 0, "tail": json.dumps(parsed), "parsed": parsed}
+
+
+def _write_rounds(tmp_path, rounds):
+    paths = []
+    for i, doc in enumerate(rounds, 1):
+        p = tmp_path / f"BENCH_r{i:02d}.json"
+        p.write_text(json.dumps(doc))
+        paths.append(str(p))
+    return paths
+
+
+# ------------------------------------------------------------- unit behavior
+
+
+def test_direction_inference():
+    assert bench_compare.direction("value") == "higher"
+    assert bench_compare.direction("extra.fused_collection_cifar10.updates_per_sec") == "higher"
+    assert bench_compare.direction("extra.fused_collection_cifar10.fused_speedup_vs_unfused") == "higher"
+    assert bench_compare.direction("extra.sync_allreduce_8dev_cpu.psum_latency_ms") == "lower"
+    assert bench_compare.direction("extra.bertscore_clipscore.bertscore_compile_sec") == "lower"
+    assert bench_compare.direction("extra.ours.telemetry.state_memory_bytes") is None  # informational
+    assert bench_compare.direction("extra.fid_inception_fwd.attempts") is None
+
+
+def test_regression_and_improvement_classification(tmp_path):
+    prev = bench_compare.extract_metrics(_round(1, 30000.0))
+    cur = bench_compare.extract_metrics(_round(2, 14000.0, psum_ms=1.9))  # -53% headline
+    rows = {r["metric"]: r for r in bench_compare.compare_metrics(prev, cur)}
+    assert rows["value"]["verdict"] == "regression"
+    assert rows["vs_baseline"]["verdict"] == "regression"
+    assert rows["extra.sync_allreduce_8dev_cpu.psum_latency_ms"]["verdict"] == "improved"
+    assert rows["extra.fused_collection_cifar10.updates_per_sec"]["verdict"] == "ok"
+
+
+def test_latency_increase_regresses_throughput_untouched():
+    prev = bench_compare.extract_metrics(_round(1, 30000.0, psum_ms=2.0))
+    cur = bench_compare.extract_metrics(_round(2, 30000.0, psum_ms=4.5))  # +125% latency
+    rows = {r["metric"]: r for r in bench_compare.compare_metrics(prev, cur)}
+    assert rows["extra.sync_allreduce_8dev_cpu.psum_latency_ms"]["verdict"] == "regression"
+    assert rows["value"]["verdict"] == "ok"
+
+
+def test_missing_config_reported_but_not_gated(tmp_path):
+    """A config that errored in the newer round (the real r05 FID case) must
+    not trip the gate — bench's retry layer already owns that failure mode."""
+    healthy = _round(1, 30000.0)
+    errored = _round(2, 30000.0)
+    errored["parsed"]["extra"]["fid_inception_fwd"] = {"error": "INTERNAL: remote_compile: ..."}
+    paths = _write_rounds(tmp_path, [healthy, errored])
+    report = bench_compare.compare_rounds(paths)
+    rows = {r["metric"]: r for r in report["transitions"][0]["rows"]}
+    assert rows["extra.fid_inception_fwd.images_per_sec_bfloat16"]["verdict"] == "missing"
+    assert report["verdict"] == "ok"
+
+
+def test_per_metric_threshold_override():
+    prev = bench_compare.extract_metrics(_round(1, 30000.0))
+    cur = bench_compare.extract_metrics(_round(2, 27000.0))  # -10%
+    rows = {r["metric"]: r for r in bench_compare.compare_metrics(prev, cur)}
+    assert rows["value"]["verdict"] == "ok"  # inside the default 25%
+    rows = {r["metric"]: r for r in bench_compare.compare_metrics(prev, cur, overrides={"value": 0.05})}
+    assert rows["value"]["verdict"] == "regression"
+
+
+def test_verdict_against_previous_block():
+    prev, cur = _round(1, 30000.0), _round(2, 12000.0)
+    out = bench_compare.verdict_against_previous(prev["parsed"], cur["parsed"])
+    assert out["verdict"] == "regression"
+    assert any(r["metric"] == "value" for r in out["regressions"])
+    out = bench_compare.verdict_against_previous(prev["parsed"], _round(2, 29500.0)["parsed"])
+    assert out["verdict"] == "ok" and out["regressions"] == []
+
+
+def test_embedded_verdict_block_not_flattened():
+    """The regression_vs_previous block a round embeds is comparison output —
+    it must not become metrics that every later comparison chases."""
+    doc = _round(2, 30000.0)
+    doc["parsed"]["extra"]["regression_vs_previous"] = {
+        "verdict": "ok", "improved": 3, "ok": 5,
+        "regressions": [{"metric": "value", "old": 1.0, "new": 0.5, "delta_pct": -50.0}],
+    }
+    metrics = bench_compare.extract_metrics(doc)
+    assert not any("regression_vs_previous" in name for name in metrics)
+    rows = bench_compare.compare_metrics(bench_compare.extract_metrics(_round(1, 30000.0)), metrics)
+    assert not any("regression_vs_previous" in r["metric"] for r in rows)
+
+
+# -------------------------------------------------------------- CLI smoke
+
+
+def _cli(args):
+    return subprocess.run([sys.executable, *args], capture_output=True, text=True, timeout=120)
+
+
+def test_cli_check_trips_on_injected_regression(tmp_path):
+    """Acceptance: a mid-trajectory injected regression exits nonzero."""
+    paths = _write_rounds(tmp_path, [
+        _round(1, 29000.0), _round(2, 30000.0), _round(3, 15000.0), _round(4, 15200.0),
+    ])
+    res = _cli([BENCH_COMPARE, *paths, "--check"])
+    assert res.returncode == 1, res.stdout + res.stderr
+    assert "REGRESSION" in res.stdout and "value" in res.stdout
+    # same trajectory without --check reports but exits zero
+    assert _cli([BENCH_COMPARE, *paths]).returncode == 0
+
+
+def test_cli_check_passes_real_history():
+    """Acceptance: the repo's real r01→r05 trajectory passes the gate."""
+    rounds = sorted(glob.glob(os.path.join(REPO, "BENCH_r0*.json")))
+    assert len(rounds) >= 2, "expected the seeded BENCH_r0*.json history"
+    res = _cli([BENCH_COMPARE, *rounds, "--check"])
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "verdict: OK" in res.stdout
+
+
+def test_cli_json_output_and_threshold_flags(tmp_path):
+    paths = _write_rounds(tmp_path, [_round(1, 30000.0), _round(2, 27500.0)])
+    res = _cli([BENCH_COMPARE, *paths, "--json"])
+    report = json.loads(res.stdout)
+    assert report["verdict"] == "ok" and len(report["transitions"]) == 1
+    res = _cli([BENCH_COMPARE, *paths, "--check", "--threshold-for", "value=0.01"])
+    assert res.returncode == 1
+
+
+def test_cli_rejects_single_round(tmp_path):
+    paths = _write_rounds(tmp_path, [_round(1, 30000.0)])
+    res = _cli([BENCH_COMPARE, *paths])
+    assert res.returncode == 2 and "at least two" in res.stderr
+
+
+# --------------------------------------------- multi-host trace_report CLI
+
+
+def _event(kind, metric, tag, ts, **kw):
+    return json.dumps({"kind": kind, "metric": metric, "tag": tag, "timestamp": ts, **kw})
+
+
+def test_trace_report_cli_multi_host(tmp_path):
+    """Two per-host traces: per-rank rows, sync payload footer, and the
+    skip-bad-line tolerance for a trace truncated by preemption."""
+    host0 = tmp_path / "host0.jsonl"
+    host0.write_text("\n".join([
+        _event("dispatch", "Acc#0", "update", 1.0, cache_hit=False, duration_s=0.5),
+        _event("dispatch", "Acc#0", "update", 2.0, cache_hit=True, duration_s=0.25),
+        _event("sync", "Acc#0", "sync", 3.0, payload={"payload_bytes": 128}),
+    ]) + "\n")
+    host1 = tmp_path / "host1.jsonl"
+    host1.write_text("\n".join([
+        _event("dispatch", "Acc#0", "update", 1.0, cache_hit=False),
+        _event("sync", "Acc#0", "sync", 3.5, payload={"payload_bytes": 64}),
+        '{"kind": "sync", "metric": "Acc#0", "truncat',  # preempted mid-write
+    ]) + "\n")
+    res = _cli([TRACE_REPORT, str(host0), str(host1)])
+    assert res.returncode == 0, res.stderr
+    assert "unparseable line skipped" in res.stderr
+    assert "rank" in res.stdout.splitlines()[0]
+    assert "syncs: 2 (192 payload bytes)" in res.stdout
+    # machine-readable: one dispatch row per rank
+    res = _cli([TRACE_REPORT, str(host0), str(host1), "--json"])
+    report = json.loads(res.stdout)
+    update_rows = [r for r in report["rows"] if r["phase"] == "update"]
+    assert sorted(r["rank"] for r in update_rows) == [0, 1]
+    assert report["totals"]["sync_payload_bytes"] == 192
+
+
+def test_trace_report_cli_single_file_keeps_plain_shape(tmp_path):
+    trace = tmp_path / "t.jsonl"
+    trace.write_text(_event("dispatch", "Acc#0", "update", 1.0, cache_hit=False) + "\n")
+    res = _cli([TRACE_REPORT, str(trace), "--json"])
+    report = json.loads(res.stdout)
+    assert report["multi_rank"] is False
+    assert "rank" not in report["rows"][0]
+    assert not res.stdout.startswith("rank")
+
+
+def test_trace_report_ranks_sort_numerically(tmp_path):
+    """A 12-host merge must order ranks 0..11, not lexicographically 0,1,10,11,2..."""
+    trace_report = _load(TRACE_REPORT)
+    events = []
+    for rank in range(12):
+        events.extend(trace_report.load_events(_write_trace(tmp_path, rank), rank=rank))
+    report = trace_report.aggregate(events)
+    assert [r["rank"] for r in report["rows"]] == list(range(12))
+
+
+def _write_trace(tmp_path, rank):
+    p = tmp_path / f"host{rank}.jsonl"
+    p.write_text(_event("dispatch", "Acc#0", "update", 1.0) + "\n")
+    return str(p)
+
+
+def test_trace_report_cli_rank_labels(tmp_path):
+    a = tmp_path / "a.jsonl"
+    b = tmp_path / "b.jsonl"
+    a.write_text(_event("dispatch", "Acc#0", "update", 1.0) + "\n")
+    b.write_text(_event("dispatch", "Acc#0", "update", 1.0) + "\n")
+    res = _cli([TRACE_REPORT, str(a), str(b), "--rank", "host-a", "--rank", "host-b", "--json"])
+    report = json.loads(res.stdout)
+    assert sorted(r["rank"] for r in report["rows"]) == ["host-a", "host-b"]
+    # digit labels coerce to ints: rank 2 orders before rank 10
+    res = _cli([TRACE_REPORT, str(a), str(b), "--rank", "10", "--rank", "2", "--json"])
+    assert [r["rank"] for r in json.loads(res.stdout)["rows"]] == [2, 10]

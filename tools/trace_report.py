@@ -1,20 +1,27 @@
 #!/usr/bin/env python
-"""Render a telemetry JSONL trace into a per-metric summary table.
+"""Render telemetry JSONL traces into a per-metric summary table.
 
-Input: the file a :class:`torchmetrics_tpu.observability.JSONLSink` wrote —
-one JSON object per line, the :meth:`TelemetryEvent.to_dict` shape. Stdlib
-only (no jax import): runs on a laptop against a trace scp'd off a pod.
+Input: one or more files a :class:`torchmetrics_tpu.observability.JSONLSink`
+wrote — one JSON object per line, the :meth:`TelemetryEvent.to_dict` shape.
+Stdlib only (no jax import): runs on a laptop against traces scp'd off a pod.
 
 Usage::
 
     python tools/trace_report.py trace.jsonl
-    python tools/trace_report.py trace.jsonl --json   # machine-readable
+    python tools/trace_report.py host0.jsonl host1.jsonl ...   # one file per host
+    python tools/trace_report.py trace.jsonl --json            # machine-readable
 
-Per (metric, phase) row: event count, compiles vs cache hits, retraces, and
-total/mean span time (honest device wall-clock only if the trace was recorded
-under ``TelemetryConfig(block_until_ready=True)``; otherwise dispatch/enqueue
-latency). Footer totals cover retries, quarantines, and instrumented
-device→host readbacks — the three "why did it get slow/wrong" signals.
+With multiple files each file is one rank (in argument order, or ``--rank``
+labels) and every row keeps a per-rank column, so a fleet's traces stay
+attributable after merging. Unparseable lines (a host preempted mid-write)
+are skipped with a warning.
+
+Per (rank, metric, phase) row: event count, compiles vs cache hits, retraces,
+and total/mean span time (honest device wall-clock only if the trace was
+recorded under ``TelemetryConfig(block_until_ready=True)``; otherwise
+dispatch/enqueue latency). Footer totals cover retries, quarantines,
+instrumented device→host readbacks, and sync calls with payload bytes — the
+"why did it get slow/wrong/expensive" signals.
 """
 
 from __future__ import annotations
@@ -22,10 +29,12 @@ from __future__ import annotations
 import argparse
 import json
 import sys
-from typing import Any, Dict, Iterable, List, Tuple
+from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 
-def load_events(path: str) -> List[Dict[str, Any]]:
+def load_events(path: str, rank: Optional[Any] = None) -> List[Dict[str, Any]]:
+    """Read one trace file; ``rank`` (if given) is stamped on every event so a
+    multi-host merge keeps attribution."""
     events = []
     with open(path, "r", encoding="utf-8") as fh:
         for lineno, line in enumerate(fh, 1):
@@ -33,43 +42,54 @@ def load_events(path: str) -> List[Dict[str, Any]]:
             if not line:
                 continue
             try:
-                events.append(json.loads(line))
+                ev = json.loads(line)
             except json.JSONDecodeError as err:
                 print(f"warning: {path}:{lineno}: unparseable line skipped ({err})", file=sys.stderr)
+                continue
+            if rank is not None:
+                ev["_rank"] = rank
+            events.append(ev)
     return events
 
 
+def _new_row() -> Dict[str, Any]:
+    return {"events": 0, "compiles": 0, "cache_hits": 0, "retraces": 0, "total_s": 0.0, "timed": 0}
+
+
 def aggregate(events: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
-    """Fold a raw event stream into the report structure."""
-    rows: Dict[Tuple[str, str], Dict[str, Any]] = {}
-    totals = {"retries": 0, "retries_exhausted": 0, "quarantines": 0, "d2h_readbacks": 0, "d2h_bytes": 0}
+    """Fold a raw (possibly multi-rank) event stream into the report structure."""
+    rows: Dict[Tuple[Any, str, str], Dict[str, Any]] = {}
+    totals = {
+        "retries": 0, "retries_exhausted": 0, "quarantines": 0,
+        "d2h_readbacks": 0, "d2h_bytes": 0,
+        "sync_calls": 0, "sync_payload_bytes": 0,
+    }
     retries: List[Dict[str, Any]] = []
     quarantines: List[Dict[str, Any]] = []
+    any_rank = False
     for ev in events:
         kind = ev.get("kind", "")
         metric = ev.get("metric", "") or "<process>"
         tag = ev.get("tag", "")
+        rank = ev.get("_rank")
+        any_rank = any_rank or rank is not None
         if kind in ("dispatch", "compute", "sync"):
-            row = rows.setdefault((metric, tag), {
-                "events": 0, "compiles": 0, "cache_hits": 0, "retraces": 0,
-                "total_s": 0.0, "timed": 0,
-            })
+            row = rows.setdefault((rank, metric, tag), _new_row())
             row["events"] += 1
             if kind == "dispatch":
                 if ev.get("cache_hit") is False:
                     row["compiles"] += 1
                 elif ev.get("cache_hit") is True:
                     row["cache_hits"] += 1
+            elif kind == "sync":
+                totals["sync_calls"] += 1
+                totals["sync_payload_bytes"] += int(ev.get("payload", {}).get("payload_bytes", 0))
             dur = ev.get("duration_s")
             if dur is not None:
                 row["total_s"] += float(dur)
                 row["timed"] += 1
         elif kind == "retrace":
-            row = rows.setdefault((metric, tag), {
-                "events": 0, "compiles": 0, "cache_hits": 0, "retraces": 0,
-                "total_s": 0.0, "timed": 0,
-            })
-            row["retraces"] += 1
+            rows.setdefault((rank, metric, tag), _new_row())["retraces"] += 1
         elif kind == "retry":
             totals["retries"] += 1
             retries.append(ev)
@@ -82,10 +102,19 @@ def aggregate(events: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
         elif kind == "d2h":
             totals["d2h_readbacks"] += 1
             totals["d2h_bytes"] += int(ev.get("payload", {}).get("nbytes", 0))
+    def _rank_key(rank: Any) -> Tuple[int, int, str]:
+        # ints sort numerically (rank 2 before rank 10 on a 64-host pod),
+        # string labels lexicographically after, None (single file) first
+        if rank is None:
+            return (0, 0, "")
+        if isinstance(rank, int):
+            return (1, rank, "")
+        return (2, 0, str(rank))
+
     report_rows = []
-    for (metric, tag), row in sorted(rows.items()):
+    for (rank, metric, tag), row in sorted(rows.items(), key=lambda kv: (_rank_key(kv[0][0]), kv[0][1], kv[0][2])):
         mean_ms = (row["total_s"] / row["timed"] * 1000.0) if row["timed"] else None
-        report_rows.append({
+        out_row = {
             "metric": metric,
             "phase": tag,
             "events": row["events"],
@@ -94,13 +123,21 @@ def aggregate(events: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
             "retraces": row["retraces"],
             "total_s": round(row["total_s"], 6),
             "mean_ms": round(mean_ms, 3) if mean_ms is not None else None,
-        })
-    return {"rows": report_rows, "totals": totals, "retries": retries, "quarantines": quarantines}
+        }
+        if any_rank:
+            out_row["rank"] = rank
+        report_rows.append(out_row)
+    return {
+        "rows": report_rows, "totals": totals, "retries": retries, "quarantines": quarantines,
+        "multi_rank": any_rank,
+    }
 
 
 def render_table(report: Dict[str, Any]) -> str:
-    headers = ("metric", "phase", "events", "compiles", "cache_hits", "retraces", "total_s", "mean_ms")
-    table = [[str(r[h]) if r[h] is not None else "-" for h in headers] for r in report["rows"]]
+    headers: Tuple[str, ...] = ("metric", "phase", "events", "compiles", "cache_hits", "retraces", "total_s", "mean_ms")
+    if report.get("multi_rank"):
+        headers = ("rank",) + headers
+    table = [[str(r.get(h)) if r.get(h) is not None else "-" for h in headers] for r in report["rows"]]
     widths = [max(len(h), *(len(row[i]) for row in table)) if table else len(h) for i, h in enumerate(headers)]
     lines = [
         "  ".join(h.ljust(w) for h, w in zip(headers, widths)),
@@ -113,7 +150,8 @@ def render_table(report: Dict[str, Any]) -> str:
     lines.append(
         f"retries: {t['retries']} (exhausted: {t['retries_exhausted']})  "
         f"quarantines: {t['quarantines']}  "
-        f"d2h readbacks: {t['d2h_readbacks']} ({t['d2h_bytes']} bytes)"
+        f"d2h readbacks: {t['d2h_readbacks']} ({t['d2h_bytes']} bytes)  "
+        f"syncs: {t['sync_calls']} ({t['sync_payload_bytes']} payload bytes)"
     )
     for ev in report["retries"]:
         p = ev.get("payload", {})
@@ -126,10 +164,24 @@ def render_table(report: Dict[str, Any]) -> str:
 
 def main(argv: List[str] = None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    parser.add_argument("trace", help="JSONL trace written by observability.JSONLSink")
+    parser.add_argument("traces", nargs="+", help="JSONL trace(s) written by observability.JSONLSink (one per host)")
     parser.add_argument("--json", action="store_true", help="emit the aggregated report as JSON")
+    parser.add_argument("--rank", action="append", default=None,
+                        help="rank label per trace file, in order (default: 0, 1, ...)")
     args = parser.parse_args(argv)
-    report = aggregate(load_events(args.trace))
+    if args.rank is not None and len(args.rank) != len(args.traces):
+        parser.error(f"got {len(args.rank)} --rank labels for {len(args.traces)} traces")
+    multi = len(args.traces) > 1
+    events: List[Dict[str, Any]] = []
+    for i, path in enumerate(args.traces):
+        if args.rank is not None:
+            # digit labels become ints so ranks order numerically, same as the
+            # auto-assigned defaults (rank 2 before rank 10 on a 64-host pod)
+            rank: Any = int(args.rank[i]) if args.rank[i].isdigit() else args.rank[i]
+        else:
+            rank = i if multi else None
+        events.extend(load_events(path, rank=rank))
+    report = aggregate(events)
     if args.json:
         print(json.dumps(report, indent=2))
     else:

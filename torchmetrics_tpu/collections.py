@@ -694,6 +694,31 @@ class MetricCollection:
 
     # --------------------------------------------------------------- telemetry
 
+    def state_memory(self) -> Dict[str, Any]:
+        """Per-member state-memory footprint (metadata only, zero D2H).
+
+        Fused compute-group members ALIAS their leader's state dict, so a naive
+        per-member sum would charge one buffer once per member; aliased members
+        report their bytes but carry an ``aliased_to`` pointer and only the
+        first holder of each distinct state dict contributes to
+        ``total_bytes`` — the number that actually lives in HBM.
+        """
+        from .observability import memory as _memory
+
+        members: Dict[str, Any] = {}
+        seen: Dict[int, str] = {}
+        total = 0
+        for name, metric in self._modules.items():
+            report = _memory.state_memory(metric._state)
+            holder = seen.get(id(metric._state))
+            if holder is not None:
+                report["aliased_to"] = holder
+            else:
+                seen[id(metric._state)] = name
+                total += report["total_bytes"]
+            members[name] = report
+        return {"members": members, "total_bytes": total}
+
     def telemetry_summary(self) -> Dict[str, Any]:
         """Per-member dispatch attribution from the active telemetry session.
 
@@ -711,6 +736,7 @@ class MetricCollection:
         leader_of = {
             name: members[0] for members in groups.values() for name in members[1:]
         }
+        mem = self.state_memory()
         members_out: Dict[str, Any] = {}
         for name, metric in self._modules.items():
             info = rec.metric_summary(metric)
@@ -720,12 +746,14 @@ class MetricCollection:
                 stage, exc = self._quarantined[name]
                 info["status"] = "quarantined"
                 info["quarantine_stage"] = stage
+            info["state_bytes"] = mem["members"][name]["total_bytes"]
             members_out[name] = info
         return {
             "enabled": True,
             "members": members_out,
             "compute_groups": groups,
             "counters": rec.counters.snapshot().summary(brief=True),
+            "state_memory_bytes": mem["total_bytes"],
         }
 
     # ------------------------------------------------------------- fused pure API

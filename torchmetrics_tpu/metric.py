@@ -45,6 +45,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import observability as _observability
+from .observability import costs as _obs_costs
+from .observability import memory as _obs_memory
 from .observability import tracing as _tracing
 from .parallel import sync as _sync
 from .reliability.guards import validate_restored, validate_state
@@ -363,7 +365,12 @@ class Metric:
         return out
 
     def _donation_safe_dispatch(
-        self, tag: str, call: Callable[..., Any], tensors: StateDict, inputs: Optional[tuple] = None
+        self,
+        tag: str,
+        call: Callable[..., Any],
+        tensors: StateDict,
+        inputs: Optional[tuple] = None,
+        jitted: Optional[Callable] = None,
     ) -> Any:
         """Dispatch a jitted call that DONATES its tensor-state argument (and, for
         ``update``, the device counter). ``call(t, n)`` receives the live tensor
@@ -371,16 +378,25 @@ class Metric:
 
         ``inputs`` is the batch's ``(args, kwargs)`` — read only when a telemetry
         session is active, for the shape/dtype dispatch signature (metadata only,
-        no device access). Disabled telemetry costs one ``None``-check here.
+        no device access). ``jitted`` is the underlying ``jax.jit`` object for
+        this tag — the cost-accounting layer AOT-lowers it from avals when the
+        dispatch turns out to be a fresh compile (``observability/costs.py``).
+        Disabled telemetry costs one ``None``-check here.
         """
         rec = _observability._ACTIVE
         if rec is None:
             with _tracing.trace_span(f"{type(self).__name__}.{tag}"):
                 return self._dispatch_donated(tag, call, tensors)
+        lower = None
+        if rec.config.cost_accounting:
+            # lazy thunk: reference capture only — avals are built (from the
+            # donated-then-deleted buffers' surviving metadata) solely when the
+            # recorder sees a fresh compile
+            lower = _obs_costs.make_lowerer(jitted, tensors, self._device_update_count(), inputs)
         t0 = _tracing.monotonic()
         with _tracing.trace_span(f"{type(self).__name__}.{tag}"):
             result = self._dispatch_donated(tag, call, tensors)
-        rec.record_dispatch(self, tag, inputs, rec.finish(result, t0))
+        rec.record_dispatch(self, tag, inputs, rec.finish(result, t0), lower=lower)
         return result
 
     def _dispatch_donated(self, tag: str, call: Callable[..., Any], tensors: StateDict) -> Any:
@@ -426,7 +442,8 @@ class Metric:
         tensors, _ = self._split_tensor_list(self._state)
         fn = self._get_update_fn()
         new_t, appends, self._n_prev_dev = self._donation_safe_dispatch(
-            "update", lambda t, n: fn(t, n, *args, **kwargs), tensors, inputs=(args, kwargs)
+            "update", lambda t, n: fn(t, n, *args, **kwargs), tensors, inputs=(args, kwargs),
+            jitted=fn,
         )
         for k, v in new_t.items():
             self._state[k] = v
@@ -434,6 +451,9 @@ class Metric:
             self._append_list_state(k, v)
         self._update_count += 1
         self._computed = None
+        rec = _observability._ACTIVE
+        if rec is not None:
+            rec.record_state_memory(self)
 
     def _batch_state_full(self, *args: Any, **kwargs: Any) -> StateDict:
         """Batch state with concat states as single arrays (compute-ready)."""
@@ -485,7 +505,8 @@ class Metric:
         fwd = self._jit_cache[key]
         tensors = self._split_tensor_list(self._state)[0]
         new_t, appends, val, batch_full = self._donation_safe_dispatch(
-            "forward", lambda t, n: fwd(t, n, *args, **kwargs), tensors, inputs=(args, kwargs)
+            "forward", lambda t, n: fwd(t, n, *args, **kwargs), tensors, inputs=(args, kwargs),
+            jitted=fwd,
         )
         self._n_prev_dev = None  # forward does not return the incremented counter
         for k, v in new_t.items():
@@ -494,6 +515,9 @@ class Metric:
             self._append_list_state(k, v)
         self._update_count += 1
         self._computed = None
+        rec = _observability._ACTIVE
+        if rec is not None:
+            rec.record_state_memory(self)
         self._last_batch_state = batch_full  # consumed by MetricCollection compute groups
         if val is None and not self._jittable_compute:
             val = self._compute(batch_full)
@@ -897,6 +921,24 @@ class Metric:
     def metric_state(self) -> StateDict:
         return {k: (list(v) if isinstance(v, list) else v) for k, v in self._state.items()}
 
+    def state_memory(self) -> Dict[str, Any]:
+        """Per-state device-memory footprint from array metadata — zero
+        device→host traffic (safe under a disallow transfer guard and inside a
+        hot loop). Tensor states report shape/dtype; list ("cat") states report
+        element counts, the one axis that grows without bound between resets.
+
+        Example:
+            >>> import jax.numpy as jnp
+            >>> from torchmetrics_tpu import CatMetric
+            >>> metric = CatMetric()
+            >>> metric.update(jnp.asarray([1.0, 2.0, 3.0]))
+            >>> metric.state_memory()["total_bytes"]
+            12
+            >>> metric.state_memory()["states"]["value"]["elements"]
+            1
+        """
+        return _obs_memory.state_memory(self._state)
+
     # ------------------------------------------------------------ kwarg filter
 
     def _filter_kwargs(self, **kwargs: Any) -> Dict[str, Any]:
@@ -1020,6 +1062,9 @@ class HostMetric(Metric):
             self._append_list_state(k, v)
         self._update_count += 1
         self._computed = None
+        rec = _observability._ACTIVE
+        if rec is not None:
+            rec.record_state_memory(self)
 
     def update(self, *args: Any, **kwargs: Any) -> None:
         if self._is_synced:

@@ -37,8 +37,17 @@ import itertools
 from typing import Any, Dict, Iterator, Optional, Tuple
 
 from ..utilities.prints import rank_zero_warn
+from . import costs as costs_module
+from . import memory as memory_module
 from . import tracing
-from .counters import COUNTER_FIELDS, Counters, CountersSnapshot
+from .costs import CostRecord, CostRegistry
+from .counters import (
+    COUNTER_FIELDS,
+    Counters,
+    CountersSnapshot,
+    FleetSnapshot,
+    aggregate_counters,
+)
 from .events import (
     EVENT_KINDS,
     CallbackSink,
@@ -47,23 +56,32 @@ from .events import (
     Sink,
     TelemetryEvent,
 )
+from .memory import StateMemoryTracker, state_memory
 
 __all__ = [
     "COUNTER_FIELDS",
     "EVENT_KINDS",
     "CallbackSink",
+    "CostRecord",
+    "CostRegistry",
     "Counters",
     "CountersSnapshot",
+    "FleetSnapshot",
     "JSONLSink",
     "RingBufferSink",
     "Sink",
+    "StateMemoryTracker",
     "TelemetryConfig",
     "TelemetryEvent",
     "TelemetryRecorder",
     "active",
+    "aggregate_counters",
+    "cost_snapshot",
     "disable",
     "enable",
     "enabled",
+    "gather_counters",
+    "state_memory",
     "telemetry_session",
     "tracing",
 ]
@@ -86,12 +104,26 @@ class TelemetryConfig:
             when a single metric's dispatch key accumulates MORE than this many
             distinct input shape/dtype signatures (shape-instability recompile
             churn). Warned once per key.
+        cost_accounting: harvest FLOPs/HBM cost analysis for every fresh
+            compile (an AOT re-lower+compile per new signature — compile-time
+            cost only, aval-based, zero device traffic). Disable for sessions
+            where even compile time matters.
+        track_state_memory: track per-metric state bytes (metadata-only) after
+            every instrumented update, keeping peaks and arming the
+            unbounded-growth sentinel.
+        state_growth_warn_bytes: the growth sentinel rank-zero-warns (once per
+            metric/state) when a single list/cat state exceeds this many bytes
+            — cat states are the one unbounded growth axis in the runtime and
+            the #1 silent OOM cause in long evals.
     """
 
     sinks: Tuple[Sink, ...] = ()
     ring_buffer_size: int = 4096
     block_until_ready: bool = False
     retrace_warn_threshold: int = 8
+    cost_accounting: bool = True
+    track_state_memory: bool = True
+    state_growth_warn_bytes: int = 256 * 2**20
 
 
 class TelemetryRecorder:
@@ -107,6 +139,9 @@ class TelemetryRecorder:
     def __init__(self, config: Optional[TelemetryConfig] = None) -> None:
         self.config = config or TelemetryConfig()
         self.counters = Counters()
+        self.costs = CostRegistry()
+        self.counters.attach_costs(self.costs)  # cost entries ride along in snapshots
+        self.memory = StateMemoryTracker(self.config.state_growth_warn_bytes)
         self.sinks: Tuple[Sink, ...] = self.config.sinks or (
             RingBufferSink(self.config.ring_buffer_size),
         )
@@ -163,11 +198,28 @@ class TelemetryRecorder:
             tracing.block_for_timing(result)
         return tracing.monotonic() - t0
 
-    def record_dispatch(self, metric: Any, tag: str, inputs: Optional[tuple], duration_s: float) -> None:
-        """One successful jitted donated dispatch (``update``/``forward``)."""
+    def record_dispatch(
+        self,
+        metric: Any,
+        tag: str,
+        inputs: Optional[tuple],
+        duration_s: float,
+        lower: Optional[Any] = None,
+    ) -> None:
+        """One successful jitted donated dispatch (``update``/``forward``).
+
+        ``lower`` is the cost-accounting hook (``costs.make_lowerer``): a thunk
+        that AOT-compiles this dispatch's program from avals. It runs only when
+        the signature is fresh — i.e. exactly when the compile counter ticks —
+        so the cost registry reconciles 1:1 with ``jit_compiles`` per key.
+        """
         name = self._metric_name(metric)
         key = f"{name}.{tag}"
         sig = self._signature(inputs)
+        if self.config.cost_accounting and not self.counters.has_signature(key, sig):
+            # harvest BEFORE the compile counter ticks: a concurrent snapshot
+            # must never see a counted compile without its cost entry
+            self.costs.harvest(key, sig, lower)
         is_new, n_sigs = self.counters.record_dispatch(key, sig)
         self._event(
             "dispatch", name, tag, duration_s=duration_s, signature=sig, cache_hit=not is_new
@@ -196,11 +248,37 @@ class TelemetryRecorder:
 
     def record_sync(self, metric: Any, duration_s: float, payload_bytes: int) -> None:
         """One ``Metric.sync`` through ``process_sync`` (the per-leaf gather
-        counts and byte totals land in the counters from ``parallel/sync.py``)."""
+        counts and byte totals land in the counters from ``parallel/sync.py``;
+        the duration feeds the fleet rollup's straggler attribution)."""
+        self.counters.record_sync_time(duration_s)
         self._event(
             "sync", self._metric_name(metric), "sync", duration_s=duration_s,
             payload={"payload_bytes": int(payload_bytes)},
         )
+
+    def record_state_memory(self, metric: Any) -> None:
+        """Refresh a metric's state-memory footprint after an update (metadata
+        only — shape×itemsize, never a device read). Fires the unbounded-growth
+        sentinel the first time a list/cat state crosses the configured
+        threshold: one rank-zero warning + one ``state_growth`` event per
+        (metric, state)."""
+        if not self.config.track_state_memory:
+            return
+        name = self._metric_name(metric)
+        for sname, info in self.memory.observe(name, metric._state):
+            self._event(
+                "state_growth", name, sname,
+                payload={"nbytes": info["nbytes"], "elements": info["elements"],
+                         "threshold_bytes": self.config.state_growth_warn_bytes},
+            )
+            rank_zero_warn(
+                f"State growth sentinel: {name}.{sname} is a list ('cat') state holding "
+                f"{info['nbytes']} bytes across {info['elements']} appended batches "
+                f"(> {self.config.state_growth_warn_bytes}). Cat states grow without bound "
+                f"until compute() — consider a binned/sufficient-statistic variant, "
+                f"compute_on_cpu=True to keep growth off HBM, or periodic compute+reset.",
+                UserWarning,
+            )
 
     def record_d2h(self, site: str, nbytes: int, metric: Any = None) -> None:
         """An instrumented device→host readback (``state_dict``,
@@ -254,6 +332,41 @@ class TelemetryRecorder:
             }
         return {"dispatches": total, "tags": tags}
 
+    def cost_snapshot(self) -> Dict[str, Any]:
+        """Per-dispatch-key compiled costs: ``{key: {signature: record}}``.
+        Reconciles with the compile counters — every key counted as a compile
+        has an entry (placeholders mark unavailable analysis)."""
+        return self.costs.snapshot()
+
+    def cost_summary(self) -> Dict[str, Any]:
+        """Dispatch-weighted run cost totals (``run_flops`` etc.) — the flat
+        block bench configs embed next to the brief counters."""
+        return self.counters.snapshot().cost_totals()
+
+    def memory_snapshot(self) -> Dict[str, Any]:
+        """Per-metric state-memory report: current and peak bytes, per-state
+        breakdown, per-state peaks."""
+        return self.memory.snapshot()
+
+    def summary(
+        self,
+        brief: bool = False,
+        fleet: bool = False,
+        process_group: Any = None,
+        dist_sync_fn: Any = None,
+    ) -> Dict[str, Any]:
+        """Session summary. ``fleet=True`` gathers every rank's counters
+        through the metadata gather plane and returns pod-wide totals plus
+        straggler attribution; the local summary rides along under
+        ``"local"``. Local-only otherwise."""
+        snap = self.counters.snapshot()
+        if not fleet:
+            return snap.summary(brief=brief)
+        fleet_snap = gather_counters(snap, process_group=process_group, dist_sync_fn=dist_sync_fn)
+        out = fleet_snap.summary(brief=brief)
+        out["local"] = snap.summary(brief=True)
+        return out
+
     @property
     def events(self) -> Tuple[TelemetryEvent, ...]:
         """Events from the session's first ring-buffer sink (empty tuple when
@@ -305,6 +418,45 @@ def disable() -> Optional[TelemetryRecorder]:
     if rec is not None:
         rec.close()
     return rec
+
+
+def cost_snapshot() -> Dict[str, Any]:
+    """The active session's per-key compiled costs (empty when disabled)."""
+    return _ACTIVE.cost_snapshot() if _ACTIVE is not None else {}
+
+
+def gather_counters(
+    snapshot: Optional[CountersSnapshot] = None,
+    process_group: Any = None,
+    dist_sync_fn: Any = None,
+) -> FleetSnapshot:
+    """Gather this process's counters across all ranks and merge them.
+
+    The payload is metadata-sized — one int64 vector of :data:`COUNTER_FIELDS`
+    per rank — shipped through the same ``parallel/sync.py`` gather plane the
+    metric states use (``dist_sync_fn`` is the usual injection seam). With one
+    process (or no snapshot source) this degrades to a single-rank fleet view.
+    Remote ranks contribute counts only; per-key dispatch records stay local
+    (strings don't ride the array gather), so the merged ``per_key`` covers
+    this rank alone.
+    """
+    if snapshot is None:
+        if _ACTIVE is None:
+            raise RuntimeError("gather_counters needs an active telemetry session or an explicit snapshot")
+        snapshot = _ACTIVE.counters.snapshot()
+    from ..parallel import sync as _sync  # lazy: parallel.sync imports this module
+
+    rows = _sync.gather_metadata_vector(
+        snapshot.counts_vector(), process_group=process_group, dist_sync_fn=dist_sync_fn
+    )
+    my_rank = None
+    for i, row in enumerate(rows):  # re-attach local per-key records to our own row
+        if row == snapshot.counts_vector() and my_rank is None:
+            my_rank = i
+    ranks: list = list(rows)
+    if my_rank is not None:
+        ranks[my_rank] = snapshot
+    return aggregate_counters(ranks)
 
 
 @contextlib.contextmanager

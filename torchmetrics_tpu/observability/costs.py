@@ -1,0 +1,175 @@
+"""Per-dispatch-key compiled cost accounting.
+
+The compile counters (``counters.py``) answer *how many* XLA programs a run
+built; this module answers *what each one costs*: FLOPs and bytes accessed from
+XLA's ``cost_analysis()``, and argument/output/temp HBM footprints from
+``memory_analysis()`` — both harvested from an AOT re-lowering of the jitted
+update (``jitted.lower(avals).compile()``) at the moment the dispatch counters
+record a fresh compile. Harvesting uses **avals only** (``jax.ShapeDtypeStruct``
+built from shape/dtype metadata), so it never reads device memory — an
+instrumented hot loop stays D2H-free even with cost accounting on.
+
+The registry reconciles 1:1 with the compile counters: every ``(key,
+signature)`` pair the counters count as a compile gets exactly one
+:class:`CostRecord` — a placeholder with ``available=False`` when the program
+cannot be lowered (``jit=False`` metrics) or the backend declines analysis —
+so ``cost_snapshot().keys() == per-key compile keys`` always holds.
+
+The registry itself is pure stdlib (the bench driver reads snapshots without a
+runtime); only :func:`harvest_compiled` touches jax, lazily, and only inside an
+opted-in telemetry session.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Callable, Dict, Optional
+
+#: cost_analysis scalars we extract, in reporting order
+COST_FIELDS = ("flops", "bytes_accessed", "transcendentals")
+#: memory_analysis scalars we extract (per-program HBM footprint)
+MEMORY_FIELDS = (
+    "argument_bytes", "output_bytes", "temp_bytes", "alias_bytes", "generated_code_bytes",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class CostRecord:
+    """Compiled cost of ONE XLA program — a ``(dispatch key, signature)`` pair.
+
+    ``available=False`` marks a placeholder: the compile was counted but its
+    cost could not be harvested (eager ``jit=False`` path, or a backend without
+    ``cost_analysis``/``memory_analysis`` support); ``error`` says why. The
+    placeholder keeps the registry reconciling 1:1 with the compile counters.
+    """
+
+    key: str
+    signature: str
+    available: bool
+    flops: float = 0.0
+    bytes_accessed: float = 0.0
+    transcendentals: float = 0.0
+    argument_bytes: int = 0
+    output_bytes: int = 0
+    temp_bytes: int = 0
+    alias_bytes: int = 0
+    generated_code_bytes: int = 0
+    error: Optional[str] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"available": self.available}
+        for f in COST_FIELDS + MEMORY_FIELDS:
+            out[f] = getattr(self, f)
+        if self.error is not None:
+            out["error"] = self.error
+        return out
+
+
+def make_lowerer(jitted: Any, tensors: Dict[str, Any], n_prev: Any, inputs: Optional[tuple]) -> Optional[Callable[[], Any]]:
+    """Zero-arg thunk that AOT-lowers and compiles ``jitted`` for this dispatch's
+    shapes — or ``None`` when the function is not lowerable (eager path).
+
+    Everything is LAZY: the thunk only captures references, and the recorder
+    invokes it solely for fresh compiles — the ~100% cache-hit steady state
+    pays one closure allocation per dispatch, no aval construction. Laziness is
+    safe even though the dispatch donates (and deletes) the live buffers before
+    the thunk runs: deleted jax arrays keep their ``shape``/``dtype`` metadata,
+    which is all the avals read.
+    """
+    if jitted is None or not hasattr(jitted, "lower"):
+        return None
+
+    def lower() -> Any:
+        import jax
+
+        def to_aval(x: Any) -> Any:
+            if hasattr(x, "shape") and hasattr(x, "dtype"):
+                return jax.ShapeDtypeStruct(tuple(x.shape), x.dtype)
+            return x
+
+        t_avals = {k: to_aval(v) for k, v in tensors.items()}
+        args, kwargs = inputs if inputs else ((), {})
+        a_avals = jax.tree.map(to_aval, args)
+        k_avals = jax.tree.map(to_aval, kwargs)
+        return jitted.lower(t_avals, to_aval(n_prev), *a_avals, **k_avals).compile()
+
+    return lower
+
+
+def harvest_compiled(key: str, signature: str, lower: Optional[Callable[[], Any]]) -> CostRecord:
+    """Harvest one program's cost; never raises (a placeholder records why not).
+
+    ``cost_analysis()`` returns one dict per computation on older jax (a list)
+    and a flat dict on newer — both shapes are accepted. Backends report
+    unavailable scalars as negative values; those clamp to zero so totals stay
+    additive.
+    """
+    if lower is None:
+        return CostRecord(key=key, signature=signature, available=False,
+                          error="program not lowerable (eager/jit-disabled dispatch path)")
+    try:
+        compiled = lower()
+    except Exception as err:  # noqa: BLE001 — accounting must never break a dispatch
+        return CostRecord(key=key, signature=signature, available=False,
+                          error=f"lower/compile failed: {err!r}"[:240])
+    ca: Dict[str, Any] = {}
+    try:
+        raw = compiled.cost_analysis()
+        if isinstance(raw, (list, tuple)):
+            raw = raw[0] if raw else {}
+        ca = dict(raw or {})
+    except Exception as err:  # noqa: BLE001
+        return CostRecord(key=key, signature=signature, available=False,
+                          error=f"cost_analysis failed: {err!r}"[:240])
+    clamp = lambda v: max(0.0, float(v or 0.0))
+    fields: Dict[str, Any] = {
+        "flops": clamp(ca.get("flops")),
+        "bytes_accessed": clamp(ca.get("bytes accessed")),
+        "transcendentals": clamp(ca.get("transcendentals")),
+    }
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:  # noqa: BLE001 — memory stats are best-effort per backend
+        ma = None
+    if ma is not None:
+        fields.update(
+            argument_bytes=int(getattr(ma, "argument_size_in_bytes", 0) or 0),
+            output_bytes=int(getattr(ma, "output_size_in_bytes", 0) or 0),
+            temp_bytes=int(getattr(ma, "temp_size_in_bytes", 0) or 0),
+            alias_bytes=int(getattr(ma, "alias_size_in_bytes", 0) or 0),
+            generated_code_bytes=int(getattr(ma, "generated_code_size_in_bytes", 0) or 0),
+        )
+    return CostRecord(key=key, signature=signature, available=True, **fields)
+
+
+class CostRegistry:
+    """Thread-safe per-session store of :class:`CostRecord`s, keyed like the
+    compile counters: ``ClassName#n.tag`` → signature → record."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._per_key: Dict[str, Dict[str, CostRecord]] = {}
+
+    def harvest(self, key: str, signature: str, lower: Optional[Callable[[], Any]]) -> CostRecord:
+        """Harvest and record one program (idempotent per ``(key, signature)``)."""
+        with self._lock:
+            existing = self._per_key.get(key, {}).get(signature)
+        if existing is not None:
+            return existing
+        record = harvest_compiled(key, signature, lower)
+        with self._lock:
+            self._per_key.setdefault(key, {})[signature] = record
+        return record
+
+    def snapshot(self) -> Dict[str, Dict[str, Dict[str, Any]]]:
+        """``{key: {signature: record_dict}}`` — JSON-friendly, immutable copy."""
+        with self._lock:
+            return {
+                key: {sig: rec.to_dict() for sig, rec in sigs.items()}
+                for key, sigs in self._per_key.items()
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._per_key = {}

@@ -20,7 +20,7 @@ from __future__ import annotations
 
 import dataclasses
 import threading
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple, Union
 
 # every scalar the registry tracks, in reporting order
 COUNTER_FIELDS: Tuple[str, ...] = (
@@ -34,6 +34,7 @@ COUNTER_FIELDS: Tuple[str, ...] = (
     "d2h_bytes",
     "sync_calls",  # process_sync invocations
     "sync_payload_bytes",  # bytes entering the cross-process gather
+    "sync_time_us",  # wall-clock spent inside Metric.sync (straggler signal)
     "gather_calls",  # gather_all_arrays collectives (one per state leaf)
     "retries",  # transient failures accepted for retry
     "retries_exhausted",  # retry budgets that ran out on a transient failure
@@ -44,30 +45,48 @@ COUNTER_FIELDS: Tuple[str, ...] = (
 
 @dataclasses.dataclass(frozen=True)
 class CountersSnapshot:
-    """Immutable point-in-time view of a :class:`Counters` registry."""
+    """Immutable point-in-time view of a :class:`Counters` registry.
+
+    ``costs`` is the per-key compiled-cost map (``observability/costs.py``) as
+    of the snapshot — empty when no cost registry is attached (standalone
+    counters, cost accounting disabled).
+    """
 
     counts: Dict[str, int]
     per_key: Dict[str, Dict[str, Any]]
+    costs: Dict[str, Dict[str, Any]] = dataclasses.field(default_factory=dict)
 
     def __getitem__(self, name: str) -> int:
         return self.counts[name]
 
     def diff(self, earlier: "CountersSnapshot") -> "CountersSnapshot":
-        """This snapshot minus an ``earlier`` one (per-key signatures: only the
-        ones that appeared in between)."""
+        """This snapshot minus an ``earlier`` one (per-key signatures and cost
+        entries: only the ones that appeared in between)."""
         counts = {k: v - earlier.counts.get(k, 0) for k, v in self.counts.items()}
         per_key: Dict[str, Dict[str, Any]] = {}
         for key, rec in self.per_key.items():
             old = earlier.per_key.get(key, {})
             old_sigs = set(old.get("signatures", ()))
+            old_counts = old.get("sig_counts", {})
             delta = {
                 "compiles": rec["compiles"] - old.get("compiles", 0),
                 "cache_hits": rec["cache_hits"] - old.get("cache_hits", 0),
                 "signatures": [s for s in rec["signatures"] if s not in old_sigs],
+                "sig_counts": {
+                    s: n - old_counts.get(s, 0)
+                    for s, n in rec.get("sig_counts", {}).items()
+                    if n - old_counts.get(s, 0)
+                },
             }
             if delta["compiles"] or delta["cache_hits"] or delta["signatures"]:
                 per_key[key] = delta
-        return CountersSnapshot(counts=counts, per_key=per_key)
+        costs = {}
+        for key, sigs in self.costs.items():
+            old_sigs = set(earlier.costs.get(key, {}))
+            fresh = {s: rec for s, rec in sigs.items() if s not in old_sigs}
+            if fresh:
+                costs[key] = fresh
+        return CountersSnapshot(counts=counts, per_key=per_key, costs=costs)
 
     def summary(self, brief: bool = False) -> Dict[str, Any]:
         """Flat JSON-friendly dict. ``brief`` keeps only the headline counters
@@ -81,10 +100,47 @@ class CountersSnapshot:
         out: Dict[str, Any] = dict(self.counts)
         out["per_key"] = {
             k: {"compiles": v["compiles"], "cache_hits": v["cache_hits"],
-                "signatures": list(v["signatures"])}
+                "signatures": list(v["signatures"]),
+                "sig_counts": dict(v.get("sig_counts", {}))}
             for k, v in self.per_key.items()
         }
+        if self.costs:
+            out["costs"] = {k: {s: dict(r) for s, r in v.items()} for k, v in self.costs.items()}
+            out["cost_totals"] = self.cost_totals()
         return out
+
+    def cost_totals(self) -> Dict[str, Any]:
+        """Dispatch-weighted run totals: each program's per-call cost times how
+        often its exact ``(key, signature)`` dispatched — the per-program cost
+        attribution the compile counters alone cannot give."""
+        totals: Dict[str, Any] = {
+            "run_flops": 0.0, "run_bytes_accessed": 0.0, "run_transcendentals": 0.0,
+            "compiled_programs": 0, "unavailable": 0,
+            "peak_argument_bytes": 0, "peak_output_bytes": 0, "peak_temp_bytes": 0,
+        }
+        for key, sigs in self.costs.items():
+            sig_counts = self.per_key.get(key, {}).get("sig_counts", {})
+            for sig, rec in sigs.items():
+                totals["compiled_programs"] += 1
+                if not rec.get("available"):
+                    totals["unavailable"] += 1
+                    continue
+                n = int(sig_counts.get(sig, 0))
+                totals["run_flops"] += rec.get("flops", 0.0) * n
+                totals["run_bytes_accessed"] += rec.get("bytes_accessed", 0.0) * n
+                totals["run_transcendentals"] += rec.get("transcendentals", 0.0) * n
+                for peak, field in (
+                    ("peak_argument_bytes", "argument_bytes"),
+                    ("peak_output_bytes", "output_bytes"),
+                    ("peak_temp_bytes", "temp_bytes"),
+                ):
+                    totals[peak] = max(totals[peak], int(rec.get(field, 0)))
+        return totals
+
+    def counts_vector(self) -> List[int]:
+        """Counts as an int vector in :data:`COUNTER_FIELDS` order — the
+        metadata-only payload the fleet gather plane ships per rank."""
+        return [int(self.counts.get(f, 0)) for f in COUNTER_FIELDS]
 
 
 class Counters:
@@ -93,8 +149,16 @@ class Counters:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._counts: Dict[str, int] = {f: 0 for f in COUNTER_FIELDS}
-        # "ClassName#id.tag" -> {"compiles", "cache_hits", "signatures": [..]}
+        # "ClassName#id.tag" -> {"compiles", "cache_hits", "signatures": [..],
+        #                        "sig_counts": {sig: dispatches}}
         self._per_key: Dict[str, Dict[str, Any]] = {}
+        # optional costs.CostRegistry — its snapshot rides along in snapshot()
+        self._costs: Optional[Any] = None
+
+    def attach_costs(self, registry: Any) -> None:
+        """Fold a ``costs.CostRegistry``'s snapshots into this registry's
+        (the recorder attaches its per-session registry here)."""
+        self._costs = registry
 
     # -------------------------------------------------------------- recording
 
@@ -106,9 +170,11 @@ class Counters:
                 # "signatures" keeps first-seen order for reports; "_sig_set" is
                 # the O(1) membership twin — a retrace storm (the pathology this
                 # counter diagnoses) must not make its own bookkeeping O(n)
-                key, {"compiles": 0, "cache_hits": 0, "signatures": [], "_sig_set": set()}
+                key, {"compiles": 0, "cache_hits": 0, "signatures": [], "_sig_set": set(),
+                      "sig_counts": {}}
             )
             self._counts["dispatches"] += 1
+            rec["sig_counts"][signature] = rec["sig_counts"].get(signature, 0) + 1
             if signature in rec["_sig_set"]:
                 rec["cache_hits"] += 1
                 self._counts["jit_cache_hits"] += 1
@@ -120,6 +186,14 @@ class Counters:
             if len(rec["signatures"]) > 1:
                 self._counts["retraces"] += 1
             return True, len(rec["signatures"])
+
+    def has_signature(self, key: str, signature: str) -> bool:
+        """Whether ``(key, signature)`` has already been counted (the recorder
+        peeks this to harvest a fresh program's cost BEFORE the compile counter
+        ticks — see :meth:`snapshot` for why the ordering matters)."""
+        with self._lock:
+            rec = self._per_key.get(key)
+            return rec is not None and signature in rec["_sig_set"]
 
     def record_host_dispatch(self) -> None:
         with self._lock:
@@ -138,6 +212,12 @@ class Counters:
         with self._lock:
             self._counts["sync_calls"] += 1
             self._counts["sync_payload_bytes"] += int(payload_bytes)
+
+    def record_sync_time(self, duration_s: float) -> None:
+        """Wall-clock of one ``Metric.sync`` (microseconds; the fleet rollup
+        turns per-rank totals into straggler min/max skew)."""
+        with self._lock:
+            self._counts["sync_time_us"] += max(0, int(duration_s * 1e6))
 
     def record_gather(self) -> None:
         with self._lock:
@@ -172,23 +252,151 @@ class Counters:
         with self._lock:
             return {
                 k: {"compiles": v["compiles"], "cache_hits": v["cache_hits"],
-                    "signatures": list(v["signatures"])}
+                    "signatures": list(v["signatures"]),
+                    "sig_counts": dict(v["sig_counts"])}
                 for k, v in self._per_key.items()
                 if k.startswith(prefix)
             }
 
     def snapshot(self) -> CountersSnapshot:
         with self._lock:
-            return CountersSnapshot(
-                counts=dict(self._counts),
-                per_key={
-                    k: {"compiles": v["compiles"], "cache_hits": v["cache_hits"],
-                        "signatures": list(v["signatures"])}
-                    for k, v in self._per_key.items()
-                },
-            )
+            counts = dict(self._counts)
+            per_key = {
+                k: {"compiles": v["compiles"], "cache_hits": v["cache_hits"],
+                    "signatures": list(v["signatures"]),
+                    "sig_counts": dict(v["sig_counts"])}
+                for k, v in self._per_key.items()
+            }
+        # Cost registry read AFTER the counts, then trimmed to the counted
+        # signatures. The recorder harvests a fresh program's cost BEFORE
+        # ticking its compile counter, so every signature visible in per_key
+        # already has its cost entry by the time the counts were copied —
+        # a concurrent snapshot can never catch a compile without its cost
+        # (the 1:1 reconciliation invariant); entries harvested after the
+        # counts copy are dropped from THIS snapshot, not lost.
+        costs: Dict[str, Dict[str, Any]] = {}
+        if self._costs is not None:
+            for key, sigs in self._costs.snapshot().items():
+                counted = set(per_key.get(key, {}).get("signatures", ()))
+                kept = {s: r for s, r in sigs.items() if s in counted}
+                if kept:
+                    costs[key] = kept
+        return CountersSnapshot(counts=counts, per_key=per_key, costs=costs)
 
     def reset(self) -> None:
         with self._lock:
             self._counts = {f: 0 for f in COUNTER_FIELDS}
             self._per_key = {}
+        if self._costs is not None:
+            self._costs.reset()
+
+
+# ---------------------------------------------------------------------------
+# fleet aggregation (pure merge; the gather plane lives in parallel/sync.py)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetSnapshot:
+    """Pod-wide counter rollup: N per-rank snapshots merged into one view.
+
+    ``totals`` is the exact fieldwise sum of the per-rank counts; ``per_key``
+    is the union of per-rank dispatch records (available only when full
+    snapshots were aggregated — the cross-host gather ships counts vectors
+    only, metadata-sized); ``stragglers`` attributes sync-time skew to ranks.
+    """
+
+    per_rank: Tuple[Dict[str, int], ...]
+    totals: Dict[str, int]
+    per_key: Dict[str, Dict[str, Any]]
+    stragglers: Dict[str, Any]
+
+    @property
+    def ranks(self) -> int:
+        return len(self.per_rank)
+
+    def __getitem__(self, name: str) -> int:
+        return self.totals[name]
+
+    def summary(self, brief: bool = False) -> Dict[str, Any]:
+        if brief:
+            keys = (
+                "dispatches", "jit_compiles", "jit_cache_hits", "retraces",
+                "host_dispatches", "d2h_readbacks", "sync_calls",
+            )
+            return {
+                "fleet": True, "ranks": self.ranks,
+                **{k: self.totals[k] for k in keys},
+                "stragglers": dict(self.stragglers),
+            }
+        return {
+            "fleet": True,
+            "ranks": self.ranks,
+            "totals": dict(self.totals),
+            "per_rank": [dict(r) for r in self.per_rank],
+            "per_key": {k: dict(v) for k, v in self.per_key.items()},
+            "stragglers": dict(self.stragglers),
+        }
+
+
+def _rank_counts(snap: Union["CountersSnapshot", Mapping[str, int], Sequence[int]]) -> Dict[str, int]:
+    """Normalize one rank's contribution: a full snapshot, a counts mapping, or
+    the bare counts vector the gather plane ships."""
+    if isinstance(snap, CountersSnapshot):
+        return {f: int(snap.counts.get(f, 0)) for f in COUNTER_FIELDS}
+    if isinstance(snap, Mapping):
+        return {f: int(snap.get(f, 0)) for f in COUNTER_FIELDS}
+    values = list(snap)
+    if len(values) != len(COUNTER_FIELDS):
+        raise ValueError(
+            f"counts vector has {len(values)} entries, expected {len(COUNTER_FIELDS)} "
+            f"({', '.join(COUNTER_FIELDS)})"
+        )
+    return {f: int(v) for f, v in zip(COUNTER_FIELDS, values)}
+
+
+def _skew(per_rank: Sequence[Dict[str, int]], field: str) -> Dict[str, int]:
+    values = [r[field] for r in per_rank]
+    lo, hi = min(values), max(values)
+    return {
+        "min": lo, "max": hi, "skew": hi - lo,
+        "min_rank": values.index(lo), "max_rank": values.index(hi),
+    }
+
+
+def aggregate_counters(
+    snapshots: Sequence[Union["CountersSnapshot", Mapping[str, int], Sequence[int]]],
+) -> FleetSnapshot:
+    """Merge per-rank counter snapshots into one fleet view (pure, stdlib).
+
+    ``totals`` equals the exact fieldwise sum of the inputs — the invariant the
+    acceptance test pins — and ``stragglers`` carries per-rank min/max skew for
+    the sync-time and sync-call fields (the rank holding the max sync time is
+    the pod's straggler candidate). Accepts full :class:`CountersSnapshot`
+    objects (simulated ranks, tests), plain counts mappings, or the raw counts
+    vectors the gather plane returns.
+    """
+    if not snapshots:
+        raise ValueError("aggregate_counters needs at least one rank snapshot")
+    per_rank = tuple(_rank_counts(s) for s in snapshots)
+    totals = {f: sum(r[f] for r in per_rank) for f in COUNTER_FIELDS}
+    per_key: Dict[str, Dict[str, Any]] = {}
+    for snap in snapshots:
+        if not isinstance(snap, CountersSnapshot):
+            continue
+        for key, rec in snap.per_key.items():
+            merged = per_key.setdefault(
+                key, {"compiles": 0, "cache_hits": 0, "signatures": [], "sig_counts": {}}
+            )
+            merged["compiles"] += rec["compiles"]
+            merged["cache_hits"] += rec["cache_hits"]
+            for sig in rec["signatures"]:
+                if sig not in merged["signatures"]:
+                    merged["signatures"].append(sig)
+            for sig, n in rec.get("sig_counts", {}).items():
+                merged["sig_counts"][sig] = merged["sig_counts"].get(sig, 0) + n
+    stragglers = {
+        "sync_time_us": _skew(per_rank, "sync_time_us"),
+        "sync_calls": _skew(per_rank, "sync_calls"),
+    }
+    return FleetSnapshot(per_rank=per_rank, totals=totals, per_key=per_key, stragglers=stragglers)

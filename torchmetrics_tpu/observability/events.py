@@ -19,6 +19,7 @@ from __future__ import annotations
 import collections
 import dataclasses
 import json
+import os
 from typing import Any, Callable, Dict, List, Mapping, Optional, Tuple
 
 # the closed set of event kinds the runtime emits
@@ -31,6 +32,7 @@ EVENT_KINDS: Tuple[str, ...] = (
     "quarantine",  # MetricCollection froze/skipped a failing member
     "retrace",  # a dispatch key saw a NEW shape/dtype signature (recompile)
     "d2h",  # an instrumented device→host readback
+    "state_growth",  # a list/cat state crossed the unbounded-growth threshold
 )
 
 
@@ -122,26 +124,52 @@ class RingBufferSink(Sink):
 
 
 class JSONLSink(Sink):
-    """Appends one JSON line per event to ``path`` (opened lazily, flushed per
-    event so a crashed process still leaves a readable trace). The format is
-    what ``tools/trace_report.py`` renders."""
+    """Appends one JSON line per event to ``path`` (opened lazily). The format
+    is what ``tools/trace_report.py`` renders.
 
-    def __init__(self, path: str) -> None:
+    ``flush_every=1`` (the default) flushes per event so a crashed process
+    still leaves a readable trace; raising it batches flushes for hot sessions.
+    Either way ``close()`` — and context-manager exit, which routes through it —
+    flushes AND fsyncs, so a trace ``scp``'d off a preempted host ends on a
+    complete line. A line truncated by a hard kill mid-write is still possible;
+    ``trace_report.py``'s skip-bad-line tolerance covers that tail case.
+    """
+
+    def __init__(self, path: str, flush_every: int = 1) -> None:
+        if flush_every < 1:
+            raise ValueError(f"flush_every must be >= 1, got {flush_every}")
         self.path = str(path)
+        self.flush_every = flush_every
         self._fh = None
+        self._unflushed = 0
         self.written = 0
 
     def emit(self, event: TelemetryEvent) -> None:
         if self._fh is None:
             self._fh = open(self.path, "a", encoding="utf-8")
         self._fh.write(json.dumps(event.to_dict()) + "\n")
-        self._fh.flush()
         self.written += 1
+        self._unflushed += 1
+        if self._unflushed >= self.flush_every:
+            self._fh.flush()
+            self._unflushed = 0
 
     def close(self) -> None:
         if self._fh is not None:
+            self._fh.flush()
+            try:
+                os.fsync(self._fh.fileno())
+            except OSError:  # non-seekable/pseudo files: flushed is the best we get
+                pass
             self._fh.close()
             self._fh = None
+            self._unflushed = 0
+
+    def __enter__(self) -> "JSONLSink":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()
 
 
 class CallbackSink(Sink):

@@ -263,6 +263,42 @@ def process_sync(
     return out
 
 
+def gather_metadata_vector(
+    values: Sequence[int],
+    process_group: Any = None,
+    dist_sync_fn: Optional[Callable] = None,
+) -> List[List[int]]:
+    """All-gather one small per-host int64 metadata vector → list of per-rank
+    vectors, indexed by process.
+
+    This is the fleet-telemetry rollup plane: counter snapshots ride the SAME
+    gather machinery as metric states (``dist_sync_fn`` stays the injection
+    seam), but the payload is metadata-sized — a handful of integers per rank,
+    never state data. Values ship as (hi, lo) 31-bit int32 halves: with jax's
+    default x64-disabled config ``jnp.asarray`` silently downcasts int64 to
+    int32, which would wrap byte/time counters past 2**31 (a >2 GiB cumulative
+    sync payload is a normal afternoon on a pod). The split keeps every value
+    below 2**62 exact on any config. Single-process (and no injected gather):
+    the local vector comes straight back without touching a device.
+    """
+    import numpy as np
+
+    vals = [int(v) for v in values]
+    if any(not 0 <= v < 1 << 62 for v in vals):
+        raise ValueError(f"gather_metadata_vector values must be in [0, 2**62), got {vals}")
+    if dist_sync_fn is None and not distributed_available():
+        return [vals]
+    gather = dist_sync_fn or gather_all_arrays
+    halves = np.empty(2 * len(vals), np.int32)
+    halves[0::2] = [v >> 31 for v in vals]
+    halves[1::2] = [v & 0x7FFFFFFF for v in vals]
+    out: List[List[int]] = []
+    for g in gather(jnp.asarray(halves), process_group):
+        row = np.asarray(g)
+        out.append([(int(hi) << 31) | int(lo) for hi, lo in zip(row[0::2], row[1::2])])
+    return out
+
+
 def _payload_bytes(state: Dict[str, Any]) -> int:
     """Bytes this process contributes to a sync — from ``size``/``itemsize``
     metadata only, never a device read."""
