@@ -435,6 +435,7 @@ def bench_sync_latency() -> dict:
         MulticlassConfusionMatrix,
         MulticlassF1Score,
     )
+    from torchmetrics_tpu.parallel import shard_map as _shard_map
 
     num_classes = 10
     collection = MetricCollection({
@@ -446,7 +447,7 @@ def bench_sync_latency() -> dict:
     pure = collection.as_pure()
     mesh = jax.sharding.Mesh(np.array(jax.devices()[:8]), ("data",))
     states = pure.init()
-    reduce_fn = jax.jit(jax.shard_map(lambda s: pure.reduce(s, "data"), mesh=mesh,
+    reduce_fn = jax.jit(_shard_map(lambda s: pure.reduce(s, "data"), mesh=mesh,
                                       in_specs=(P(),), out_specs=P(), check_vma=False))
     out = reduce_fn(states)
     jax.block_until_ready(out)
@@ -461,6 +462,100 @@ def bench_sync_latency() -> dict:
     flagship_mesh = jax.make_mesh((8,), ("dp",), devices=jax.devices()[:8])
     result["flagship_sync_latency_ms"] = _flagship_sync_latency_ms(flagship_mesh)
     return result
+
+
+def bench_collection_sync() -> dict:
+    """Config ``collection_sync_16metrics``: a 16-metric fixed-shape collection
+    synced through the coalesced plane. ``compute_groups=False`` keeps 16
+    distinct state dicts (the honest K·L per-leaf story: 64 leaves); the
+    coalesced ``MetricCollection.sync`` must land at ``collectives_per_sync``
+    ≤ 4 (1 metadata gather + one bucket per dtype) vs ≥ 16 per-leaf. Also
+    times the in-graph plane both ways over the 8-device CPU mesh."""
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    from __graft_entry__ import _force_virtual_cpu_mesh
+
+    _force_virtual_cpu_mesh(8)
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from torchmetrics_tpu import MetricCollection
+    from torchmetrics_tpu import observability as obs
+    from torchmetrics_tpu.classification import (
+        MulticlassAccuracy,
+        MulticlassF1Score,
+        MulticlassPrecision,
+        MulticlassRecall,
+    )
+    from torchmetrics_tpu.parallel import coalesce, shard_map as _shard_map
+    from torchmetrics_tpu.parallel import sync as par_sync
+
+    num_classes = 10
+    metrics = {
+        f"{cls.__name__}_{avg}": cls(num_classes, average=avg, validate_args=False)
+        for cls in (MulticlassAccuracy, MulticlassF1Score, MulticlassPrecision, MulticlassRecall)
+        for avg in ("micro", "macro", "weighted", "none")
+    }
+    collection = MetricCollection(dict(metrics), compute_groups=False)
+    rng = np.random.default_rng(11)
+    preds = jnp.asarray(rng.normal(size=(4096, num_classes)).astype(np.float32))
+    target = jnp.asarray(rng.integers(0, num_classes, 4096, dtype=np.int32))
+    collection.update(preds, target)
+    for m in collection.values():
+        jax.block_until_ready(m._state)
+    force_dist = lambda: True  # world-of-one real collectives (process_allgather)
+
+    with obs.telemetry_session() as rec:
+        collection.sync(distributed_available=force_dist)
+        collection.unsync()
+        brief = rec.counters.snapshot().summary(brief=True)
+
+    # host-plane latency, coalesced collection sync vs per-leaf per-member
+    states = [m._state for m in collection.values()]
+    reductions = [m._reductions for m in collection.values()]
+    iters = 20
+    start = time.perf_counter()
+    for _ in range(iters):
+        coalesce.coalesced_process_sync(states, reductions)
+    coalesced_ms = (time.perf_counter() - start) / iters * 1000
+    start = time.perf_counter()
+    for _ in range(iters):
+        for st, red in zip(states, reductions):
+            par_sync._process_sync_per_leaf(st, red)
+    per_leaf_ms = (time.perf_counter() - start) / iters * 1000
+
+    # in-graph plane over the 8-device CPU mesh, bucketed vs per-leaf
+    pure = collection.as_pure()
+    mesh = jax.make_mesh((8,), ("dp",), devices=jax.devices()[:8])
+    pure_states = pure.init()
+    coal_fn = jax.jit(_shard_map(lambda s: pure.reduce(s, "dp"), mesh=mesh,
+                                 in_specs=(P(),), out_specs=P(), check_vma=False))
+    names = list(metrics)
+    leaf_fn = jax.jit(_shard_map(
+        lambda s: {n: par_sync.reduce_states_per_leaf(s[n], collection[n]._reductions, "dp") for n in names},
+        mesh=mesh, in_specs=(P(),), out_specs=P(), check_vma=False,
+    ))
+    for fn in (coal_fn, leaf_fn):
+        jax.block_until_ready(fn(pure_states))
+    t = {}
+    for key, fn in (("ingraph_coalesced_ms", coal_fn), ("ingraph_per_leaf_ms", leaf_fn)):
+        start = time.perf_counter()
+        for _ in range(50):
+            out = fn(pure_states)
+        jax.block_until_ready(out)
+        t[key] = round((time.perf_counter() - start) / 50 * 1000, 3)
+
+    plan = coalesce.collective_counts(states, reductions)
+    return {
+        "collectives_per_sync": brief["collectives_per_sync"],
+        "leaves_coalesced_per_sync": brief["gathers_coalesced"],
+        "per_leaf_collectives": plan["process_per_leaf"],
+        "host_sync_coalesced_ms": round(coalesced_ms, 3),
+        "host_sync_per_leaf_ms": round(per_leaf_ms, 3),
+        "ingraph_coalesced_ms": t["ingraph_coalesced_ms"],
+        "ingraph_per_leaf_ms": t["ingraph_per_leaf_ms"],
+        "unit": "16-metric fixed-shape collection sync (8-dev CPU mesh in-graph; world-1 host plane)",
+    }
 
 
 def bench_fault_selftest() -> dict:
@@ -483,11 +578,30 @@ CONFIGS = {
     "coco_map_synthetic": bench_map,
     "fid_inception_fwd": bench_fid,
     "sync_allreduce_8dev_cpu": bench_sync_latency,
+    "collection_sync_16metrics": bench_collection_sync,
     "bertscore_clipscore": bench_bertscore_clipscore,
     "_fault_selftest": bench_fault_selftest,
 }
 
 MAX_ATTEMPTS = 3  # 2 retries — bounds a flaky pod's wall-clock to ~3x one config
+
+
+def _crash_report(res) -> dict:
+    """A config subprocess died before printing its JSON line (the BENCH_r05
+    fid failure mode: a remote-compile infra error truncates stdout and the
+    raw ``IndexError: list index out of range`` used to mangle the report).
+    Pick the actual error line out of the crash text and classify it through
+    the reliability classifier so the retry loop can act on it."""
+    crash_text = ((res.stderr or "") + "\n" + (res.stdout or "")).strip()
+    lines = [l.strip() for l in crash_text.splitlines() if l.strip()]
+    headline = next(
+        (l for l in reversed(lines) if "Error" in l or _is_transient_error_text(l)),
+        lines[-1] if lines else "subprocess produced no output",
+    )
+    return {
+        "error": headline[:240],
+        "transient": _is_transient_error_text(crash_text),
+    }
 
 
 def _attempt_subprocess(name: str, attempt: int) -> dict:
@@ -498,12 +612,18 @@ def _attempt_subprocess(name: str, attempt: int) -> dict:
             [sys.executable, __file__, "--only", name],
             capture_output=True, text=True, timeout=1800, env=env,
         )
-        return json.loads(res.stdout.strip().splitlines()[-1])
+        out_lines = (res.stdout or "").strip().splitlines()
+        if not out_lines:
+            return _crash_report(res)
+        try:
+            return json.loads(out_lines[-1])
+        except json.JSONDecodeError:
+            return _crash_report(res)
+    except subprocess.TimeoutExpired as err:
+        return {"error": f"TimeoutExpired: {err}"[:240], "transient": False}
     except Exception as err:  # keep the primary JSON line alive whatever happens
-        tail = []
-        if "res" in locals():
-            tail = (res.stderr or res.stdout or "").strip().splitlines()[-3:]
-        return {"error": f"{type(err).__name__}: {err}: {' | '.join(tail)}"[:240]}
+        msg = f"{type(err).__name__}: {err}"
+        return {"error": msg[:240], "transient": _is_transient_error_text(msg)}
 
 
 # Stdlib-only mirror of torchmetrics_tpu.reliability.retry's message classifier —
@@ -569,7 +689,12 @@ def _run_in_subprocess(name: str) -> dict:
     for attempt in range(1, MAX_ATTEMPTS + 1):
         out = _attempt_subprocess(name, attempt)
         err = out.get("error")
-        if err is None or not _is_transient_error_text(err) or attempt == MAX_ATTEMPTS:
+        # crash reports carry their own classifier verdict; in-band error
+        # strings (a config returning {"error": ...}) are classified here
+        transient = out.get("transient", _is_transient_error_text(err) if err else False)
+        if err is not None:
+            out.setdefault("transient", transient)
+        if err is None or not transient or attempt == MAX_ATTEMPTS:
             out["attempts"] = attempt
             if recovered_from and err is None:
                 out["recovered_from"] = recovered_from

@@ -18,6 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from torchmetrics_tpu.parallel import shard_map as _shard_map
 from torchmetrics_tpu import MetricCollection
 from torchmetrics_tpu.classification import (
     MulticlassAccuracy,
@@ -42,7 +43,7 @@ def main() -> None:
     # one XLA program: update every metric's state from this shard's batch
     @jax.jit
     def eval_step(states, logits, target):
-        return jax.shard_map(
+        return _shard_map(
             pure.update, mesh=mesh,
             in_specs=(P(), P("dp"), P("dp")),
             out_specs=P(),
@@ -52,7 +53,7 @@ def main() -> None:
     # in-graph cross-device reduction (psum/pmax/all_gather over the mesh axis)
     @jax.jit
     def sync(states):
-        return jax.shard_map(
+        return _shard_map(
             lambda s: pure.reduce(s, "dp"), mesh=mesh,
             in_specs=(P(),), out_specs=P(), check_vma=False,
         )(states)

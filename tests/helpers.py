@@ -19,6 +19,7 @@ from functools import partial
 from typing import Any, Callable, Dict, Optional, Sequence
 
 import jax
+from torchmetrics_tpu.parallel import shard_map as _shard_map
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -179,7 +180,7 @@ class MetricTester:
             state = metric.update_state(metric.init_state(), p, t)
             return metric.reduce_state(state, "dp")
 
-        fn = jax.shard_map(
+        fn = _shard_map(
             shard_fn,
             mesh=mesh,
             in_specs=(P("dp"), P("dp")),

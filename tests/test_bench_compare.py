@@ -77,6 +77,36 @@ def test_direction_inference():
     assert bench_compare.direction("extra.bertscore_clipscore.bertscore_compile_sec") == "lower"
     assert bench_compare.direction("extra.ours.telemetry.state_memory_bytes") is None  # informational
     assert bench_compare.direction("extra.fid_inception_fwd.attempts") is None
+    # coalesced-sync config: the collective count gates (lower is better); the
+    # deterministic leaf-count constants stay informational
+    assert bench_compare.direction("extra.collection_sync_16metrics.collectives_per_sync") == "lower"
+    assert bench_compare.direction("extra.collection_sync_16metrics.host_sync_coalesced_ms") == "lower"
+    assert bench_compare.direction("extra.collection_sync_16metrics.leaves_coalesced_per_sync") is None
+    assert bench_compare.direction("extra.collection_sync_16metrics.per_leaf_collectives") is None
+
+
+def test_check_trips_on_per_leaf_collective_regression(tmp_path):
+    """The acceptance gate: a round whose collection sync slid back toward
+    per-leaf collectives (2 → 64 per sync) must trip ``--check`` even though
+    every latency/throughput held steady."""
+    sync_cfg = lambda colls: {"collection_sync_16metrics": {
+        "collectives_per_sync": colls, "leaves_coalesced_per_sync": 64,
+        "per_leaf_collectives": 64, "host_sync_coalesced_ms": 12.0,
+    }}
+    good = _round(1, 29500.0, extra_overrides=sync_cfg(2.0))
+    bad = _round(2, 29500.0, extra_overrides=sync_cfg(64.0))
+    paths = _write_rounds(tmp_path, [good, bad])
+    report = bench_compare.compare_rounds(paths)
+    regressed = [
+        r["metric"] for tr in report["transitions"] for r in tr["rows"] if r["verdict"] == "regression"
+    ]
+    assert "extra.collection_sync_16metrics.collectives_per_sync" in regressed
+    assert report["verdict"] == "regression"
+    # and a steady coalesced round passes
+    (tmp_path / "ok").mkdir()
+    steady = _write_rounds(tmp_path / "ok", [good, _round(2, 29500.0, extra_overrides=sync_cfg(2.0))])
+    report_ok = bench_compare.compare_rounds(steady)
+    assert report_ok["verdict"] == "ok"
 
 
 def test_regression_and_improvement_classification(tmp_path):
@@ -211,7 +241,7 @@ def test_trace_report_cli_multi_host(tmp_path):
     assert res.returncode == 0, res.stderr
     assert "unparseable line skipped" in res.stderr
     assert "rank" in res.stdout.splitlines()[0]
-    assert "syncs: 2 (192 payload bytes)" in res.stdout
+    assert "syncs: 2 (192 payload bytes" in res.stdout  # footer now also totals collectives
     # machine-readable: one dispatch row per rank
     res = _cli([TRACE_REPORT, str(host0), str(host1), "--json"])
     report = json.loads(res.stdout)

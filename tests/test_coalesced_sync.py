@@ -1,0 +1,550 @@
+"""Coalesced sync plane (ISSUE 5): bucketed single-collective synchronization.
+
+Parity contract: for EVERY reduction tag (sum / mean / weighted-mean / max /
+min / cat / custom callable), mixed dtypes including bf16, uneven cat shapes
+across ranks, and zero-update ranks, the bucketed plane must produce results
+**bitwise identical** to the per-leaf plane — the buckets only change the
+transport, never the fold. Reliability: a faulty bucketed gather (FlakyGather)
+must roll back to the last good state exactly like the per-leaf path.
+
+Worlds are simulated through the ``dist_sync_fn`` injection seam with replay
+fakes: the coalesced fake answers each collective with what every simulated
+rank's ``build_local_metadata``/``build_bucket_payload`` would ship; the
+per-leaf fake answers each leaf gather with every rank's prepared leaf.
+"""
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import torchmetrics_tpu as tm
+from torchmetrics_tpu import MetricCollection, Metric
+from torchmetrics_tpu import observability as obs
+from torchmetrics_tpu.parallel import coalesce as C
+from torchmetrics_tpu.parallel import shard_map as shard_map_compat
+from torchmetrics_tpu.parallel import sync as S
+from torchmetrics_tpu.reliability import FlakyGather, ReliabilityConfig, RetryPolicy
+from torchmetrics_tpu.utilities.exceptions import TransientRuntimeError
+
+# --------------------------------------------------------------- world fakes
+
+
+class CoalescedWorld:
+    """dist_sync_fn simulating N ranks for the coalesced plane: call 0 answers
+    the metadata collective, call k answers bucket k-1, each row produced by
+    the same payload builders the real rank would run."""
+
+    def __init__(self, states_per_rank, reductions):
+        self.states_per_rank = states_per_rank
+        self.reductions = reductions
+        self.calls = 0
+        self.metas = None
+
+    def __call__(self, value, group=None):
+        k = self.calls
+        self.calls += 1
+        if k == 0:
+            self.metas = [
+                C.build_local_metadata([s], [self.reductions]) for s in self.states_per_rank
+            ]
+            return [jnp.asarray(m) for m in self.metas]
+        return [
+            C.build_bucket_payload([s], [self.reductions], k - 1, self.metas)
+            for s in self.states_per_rank
+        ]
+
+
+def per_leaf_world(states_per_rank):
+    """dist_sync_fn replaying the per-leaf plane: one call per leaf in dict
+    order, each returning every rank's prepared (list states pre-concatenated)
+    value."""
+    order = list(states_per_rank[0])
+    counter = {"i": 0}
+
+    def prepared(v):
+        if isinstance(v, list):
+            if not v:
+                return jnp.zeros((0,), jnp.float32)
+            return jnp.concatenate([jnp.atleast_1d(jnp.asarray(x)) for x in v], axis=0)
+        return jnp.asarray(v)
+
+    def fake(value, group=None):
+        name = order[counter["i"] % len(order)]
+        counter["i"] += 1
+        return [prepared(s[name]) for s in states_per_rank]
+
+    return fake
+
+
+def _assert_state_equal(a, b, context=""):
+    assert set(a) == set(b)
+    for k in a:
+        va, vb = a[k], b[k]
+        if isinstance(va, list) or isinstance(vb, list):
+            assert isinstance(va, list) and isinstance(vb, list), f"{context}:{k}"
+            assert len(va) == len(vb), f"{context}:{k}"
+            for x, y in zip(va, vb):
+                np.testing.assert_array_equal(np.asarray(x), np.asarray(y), err_msg=f"{context}:{k}")
+        else:
+            assert jnp.asarray(va).dtype == jnp.asarray(vb).dtype, f"{context}:{k}"
+            np.testing.assert_array_equal(np.asarray(va), np.asarray(vb), err_msg=f"{context}:{k}")
+
+
+# ------------------------------------------------------- cross-process parity
+
+
+def _make_rank_state(rng, rank, world, empty_cat=False):
+    """One rank's state covering every reduction tag and mixed dtypes."""
+    k = int(rng.integers(1, 5))  # uneven cat length per rank
+    cat_list = (
+        []
+        if empty_cat
+        else [jnp.asarray(rng.normal(size=(int(rng.integers(1, 3)), 2)).astype(np.float32)) for _ in range(k)]
+    )
+    return {
+        "s_f32": jnp.asarray(rng.normal(size=(3, 2)).astype(np.float32)),
+        "s_bf16": jnp.asarray(rng.normal(size=(4,)).astype(np.float32)).astype(jnp.bfloat16),
+        "s_i32": jnp.asarray(rng.integers(0, 100, (2, 2)).astype(np.int32)),
+        "mean_f32": jnp.asarray(rng.normal(size=(3,)).astype(np.float32)),
+        "mx": jnp.asarray(np.float32(rng.normal())),
+        "mn_bf16": jnp.asarray(rng.normal(size=(2,)).astype(np.float32)).astype(jnp.bfloat16),
+        "cat_t": jnp.asarray(rng.normal(size=(k, 3)).astype(np.float32)),
+        "cat_l": cat_list,
+        "custom": jnp.asarray(rng.normal(size=(2,)).astype(np.float32)),
+        "none_t": jnp.asarray(rng.normal(size=(2,)).astype(np.float32)),
+    }
+
+
+_FULL_REDUCTIONS = {
+    "s_f32": "sum",
+    "s_bf16": "sum",
+    "s_i32": "sum",
+    "mean_f32": "mean",
+    "mx": "max",
+    "mn_bf16": "min",
+    "cat_t": "cat",
+    "cat_l": "cat",
+    "custom": lambda stacked: jnp.sum(stacked * 2.0, axis=0),
+    "none_t": None,
+}
+
+
+@pytest.mark.parametrize("world", [2, 3, 4])
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_coalesced_equals_per_leaf_all_tags(world, seed):
+    """Bucketed sync == per-leaf sync, bitwise, for every tag, mixed dtypes
+    (incl. bf16), uneven cat shapes, and a zero-update rank."""
+    rng = np.random.default_rng(seed)
+    states = [
+        _make_rank_state(rng, r, world, empty_cat=(r == world - 1 and seed % 2 == 0))
+        for r in range(world)
+    ]
+    coal = S.process_sync(dict(states[0]), _FULL_REDUCTIONS, dist_sync_fn=CoalescedWorld(states, _FULL_REDUCTIONS))
+    leaf = S._process_sync_per_leaf(dict(states[0]), _FULL_REDUCTIONS, dist_sync_fn=per_leaf_world(states))
+    _assert_state_equal(coal, leaf, context=f"world={world} seed={seed}")
+
+
+def test_coalesced_collective_count():
+    """3 dtypes in the state table → 1 metadata + 3 bucket collectives, vs one
+    gather per leaf (10 leaves) on the per-leaf plane."""
+    rng = np.random.default_rng(7)
+    states = [_make_rank_state(rng, r, 2) for r in range(2)]
+    fw = CoalescedWorld(states, _FULL_REDUCTIONS)
+    S.process_sync(dict(states[0]), _FULL_REDUCTIONS, dist_sync_fn=fw)
+    assert fw.calls == 4  # metadata + f32 + bf16 + i32
+    plan = C.collective_counts([states[0]], [_FULL_REDUCTIONS])
+    # per-leaf: 10 leaves × (shape exchange + payload gather)
+    assert plan["process_coalesced"] == 4 and plan["process_per_leaf"] == 20
+
+
+def test_weighted_mean_rides_sum_bucket():
+    """MeanMetric-style weighted mean: value and weight are both "sum" states
+    and must ride the same sum bucket, reproducing the per-leaf fold."""
+    ms = [tm.aggregation.MeanMetric() for _ in range(3)]
+    vals = ([1.0, 5.0], [2.0], [10.0, 20.0, 30.0])
+    for m, v in zip(ms, vals):
+        m.update(jnp.asarray(v))
+    states = [dict(m._state) for m in ms]
+    reds = ms[0]._reductions
+    fw = CoalescedWorld(states, reds)
+    out = S.process_sync(dict(states[0]), reds, dist_sync_fn=fw)
+    leaf = S._process_sync_per_leaf(dict(states[0]), reds, dist_sync_fn=per_leaf_world(states))
+    _assert_state_equal(out, leaf)
+    assert fw.calls == 2  # one metadata + one f32 sum bucket for value AND weight
+    expected = np.mean([x for chunk in vals for x in chunk])
+    got = float(out["mean_value"]) / float(out["weight"]) if "weight" in out else None
+    if got is not None:
+        np.testing.assert_allclose(got, expected, rtol=1e-6)
+
+
+def test_mangled_metadata_falls_back_to_per_leaf():
+    """An injected gather that rewrites payload values (the classic rank-offset
+    fake) breaks the metadata decode — the plane must fall back to per-leaf and
+    still produce the per-leaf answer."""
+    fake = lambda v, g=None: [jnp.asarray(v) + i for i in range(3)]
+    out = S.process_sync({"v": jnp.asarray(4.0)}, {"v": "mean"}, dist_sync_fn=fake)
+    np.testing.assert_allclose(float(out["v"]), 5.0)
+
+
+def test_injected_gather_rejecting_metadata_falls_back():
+    """A user's dist_sync_fn written against the documented per-leaf seam may
+    assert on its input — a deterministic rejection of the metadata vector
+    must fall back to the per-leaf plane (transients still reach the retry
+    layer, pinned by the FlakyGather tests)."""
+    def strict_fake(v, g=None):
+        assert jnp.asarray(v).dtype == jnp.float32, "my seam only ships f32 states"
+        return [jnp.asarray(v), jnp.asarray(v)]
+
+    out = S.process_sync({"x": jnp.asarray([1.0, 2.0])}, {"x": "sum"}, dist_sync_fn=strict_fake)
+    np.testing.assert_allclose(np.asarray(out["x"]), [2.0, 4.0])
+
+
+def test_fallback_sync_still_counts_its_collectives():
+    """collectives_per_sync stays honest on fallback: the metadata collective
+    that ran before the per-leaf fallback is counted alongside the per-leaf
+    gathers."""
+    fake = lambda v, g=None: [jnp.asarray(v) + i for i in range(2)]  # mangles metadata
+    with obs.telemetry_session() as rec:
+        S.process_sync({"a": jnp.asarray(1.0), "b": jnp.asarray(2.0)}, {"a": "sum", "b": "sum"}, dist_sync_fn=fake)
+        snap = rec.counters.snapshot()
+    assert snap["gather_calls"] == 2  # per-leaf plane ran
+    assert snap["sync_collectives"] == 3  # 1 metadata (before fallback) + 2 leaves
+
+
+def test_mixed_dtype_across_ranks_raises():
+    states = [{"x": jnp.zeros((2,), jnp.float32)}, {"x": jnp.zeros((2,), jnp.int32)}]
+    with pytest.raises(ValueError, match="same dtype"):
+        S.process_sync(dict(states[0]), {"x": "sum"}, dist_sync_fn=CoalescedWorld(states, {"x": "sum"}))
+
+
+def test_unsupported_dtype_raises_after_metadata_exchange():
+    states = [{"x": jnp.zeros((2,), jnp.complex64)}]
+    with pytest.raises(ValueError, match="unsupported dtype"):
+        S.process_sync(dict(states[0]), {"x": "sum"}, dist_sync_fn=CoalescedWorld(states, {"x": "sum"}))
+
+
+# ------------------------------------------------------- reliability contract
+
+
+def test_flaky_gather_retries_under_coalescing():
+    """FlakyGather raises on the first (metadata) collective; the retry re-runs
+    the whole coalesced sync and the recovered value equals the global one."""
+    states_per_rank = None
+
+    class _Sum(Metric):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.add_state("x", default=np.zeros(()), dist_reduce_fx="sum")
+
+        def _batch_state(self, x):
+            return {"x": jnp.asarray(x, jnp.float32).sum()}
+
+        def _compute(self, state):
+            return state["x"]
+
+    inner = CoalescedWorld.__call__  # bound later
+    world_states = [{"x": jnp.asarray(3.0)}, {"x": jnp.asarray(4.0)}]
+    replay = CoalescedWorld(world_states, {"x": "sum"})
+    flaky = FlakyGather(inner=replay, fail_times=1)
+    m = _Sum(
+        dist_sync_fn=flaky,
+        distributed_available_fn=lambda: True,
+        reliability=ReliabilityConfig(retry=RetryPolicy(max_attempts=3, backoff_base=0.001)),
+    )
+    m.update(np.asarray(3.0))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", UserWarning)
+        val = m.compute()
+    assert flaky.failures == 1
+    np.testing.assert_allclose(float(val), 7.0)
+    np.testing.assert_allclose(float(m._state["x"]), 3.0)  # local state restored
+
+
+def test_flaky_gather_exhausted_rolls_back():
+    """Retry budget exhausted mid-coalesced-sync: the metric must stay at its
+    last good state (nothing committed)."""
+    flaky = FlakyGather(inner=lambda v, g=None: [v, v], fail_times=10)
+    m = tm.aggregation.SumMetric(
+        dist_sync_fn=flaky,
+        distributed_available_fn=lambda: True,
+        reliability=ReliabilityConfig(retry=RetryPolicy(max_attempts=2, backoff_base=0.001)),
+    )
+    m.update(jnp.asarray([1.0, 2.0]))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", UserWarning)
+        with pytest.raises(TransientRuntimeError):
+            m.sync()
+    assert not m._is_synced
+    np.testing.assert_allclose(float(m._state["sum_value"]), 3.0)
+
+
+def test_collection_flaky_gather_rolls_back_all_members():
+    """A faulty bucketed gather under MetricCollection.sync leaves EVERY member
+    at its last good state (atomic commit), then a retrying collection
+    recovers."""
+    flaky = FlakyGather(inner=lambda v, g=None: [jnp.asarray(v), jnp.asarray(v)], fail_times=1)
+    pol = ReliabilityConfig(retry=RetryPolicy(max_attempts=3, backoff_base=0.001))
+    coll = MetricCollection({
+        "s": tm.aggregation.SumMetric(dist_sync_fn=flaky, reliability=pol),
+        "m": tm.aggregation.MaxMetric(dist_sync_fn=flaky, reliability=pol),
+    }, compute_groups=False)
+    coll["s"].update(jnp.asarray([1.0, 2.0]))
+    coll["m"].update(jnp.asarray([5.0]))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", UserWarning)
+        coll.sync(distributed_available=lambda: True)
+    assert flaky.failures == 1  # first collective failed, whole sync retried
+    np.testing.assert_allclose(float(coll["s"]._state["sum_value"]), 6.0)  # v,v world
+    coll.unsync()
+    np.testing.assert_allclose(float(coll["s"]._state["sum_value"]), 3.0)
+    np.testing.assert_allclose(float(coll["m"]._state["max_value"]), 5.0)
+
+
+# ------------------------------------------------- collection-level coalescing
+
+
+def _stat_collection(compute_groups):
+    from torchmetrics_tpu.classification import (
+        MulticlassAccuracy,
+        MulticlassF1Score,
+        MulticlassPrecision,
+        MulticlassRecall,
+    )
+
+    metrics = {
+        f"{cls.__name__}_{avg}": cls(5, average=avg, validate_args=False)
+        for cls in (MulticlassAccuracy, MulticlassF1Score, MulticlassPrecision, MulticlassRecall)
+        for avg in ("micro", "macro", "weighted", "none")
+    }
+    return MetricCollection(metrics, compute_groups=compute_groups)
+
+
+def test_collection_sync_16_metrics_under_4_collectives():
+    """The acceptance shape: 16 fixed-shape metrics sync in ≤ 4 collectives
+    (vs ≥ 16 per-leaf), values identical to per-member syncs."""
+    coll = _stat_collection(compute_groups=False)
+    rng = np.random.default_rng(3)
+    preds = jnp.asarray(rng.normal(size=(64, 5)).astype(np.float32))
+    target = jnp.asarray(rng.integers(0, 5, 64, dtype=np.int32))
+    coll.update(preds, target)
+    ref = {k: np.asarray(v) for k, v in coll.compute().items()}
+    with obs.telemetry_session() as rec:
+        coll.sync(distributed_available=lambda: True)
+        snap = rec.counters.snapshot()
+    synced = {k: np.asarray(v) for k, v in coll.compute().items()}
+    coll.unsync()
+    assert snap["sync_calls"] == 1
+    assert 0 < snap["sync_collectives"] <= 4
+    assert snap["gathers_coalesced"] == 16 * 4  # every tp/fp/tn/fn leaf coalesced
+    assert snap["gather_calls"] == 0  # nothing fell back to per-leaf
+    assert snap.summary(brief=True)["collectives_per_sync"] <= 4.0
+    for k in ref:  # world of one: synced values == local values
+        np.testing.assert_allclose(synced[k], ref[k], err_msg=k)
+
+
+def test_collection_sync_fused_groups_charged_once():
+    """Fused compute-group members ALIAS one state dict: the coalesced sync
+    gathers it once, members re-alias through sync AND unsync."""
+    coll = _stat_collection(compute_groups=True)
+    rng = np.random.default_rng(4)
+    preds = jnp.asarray(rng.normal(size=(32, 5)).astype(np.float32))
+    target = jnp.asarray(rng.integers(0, 5, 32, dtype=np.int32))
+    coll.update(preds, target)
+    assert len(coll.compute_groups) == 1  # the whole family shares tp/fp/tn/fn
+    with obs.telemetry_session() as rec:
+        coll.sync(distributed_available=lambda: True)
+        snap = rec.counters.snapshot()
+    assert snap["gathers_coalesced"] == 4  # ONE shared dict → 4 leaves, charged once
+    members = list(coll.values())
+    assert all(m._state is members[0]._state for m in members)  # aliasing kept
+    coll.unsync()
+    assert all(m._state is members[0]._state for m in members)  # ...and after unsync
+
+
+def test_collection_mixed_seams_fall_back_to_per_member():
+    """Members with different dist_sync_fn seams cannot share a collective —
+    the collection must sync them per-member (same values as before)."""
+    fake_a = lambda v, g=None: [jnp.asarray(v), jnp.asarray(v)]
+    fake_b = lambda v, g=None: [jnp.asarray(v), jnp.asarray(v), jnp.asarray(v)]
+    coll = MetricCollection({
+        "a": tm.aggregation.SumMetric(dist_sync_fn=fake_a),
+        "b": tm.aggregation.SumMetric(dist_sync_fn=fake_b),
+    }, compute_groups=False)
+    coll["a"].update(jnp.asarray([1.0]))
+    coll["b"].update(jnp.asarray([1.0]))
+    coll.sync(distributed_available=lambda: True)
+    np.testing.assert_allclose(float(coll["a"]._state["sum_value"]), 2.0)
+    np.testing.assert_allclose(float(coll["b"]._state["sum_value"]), 3.0)
+    coll.unsync()
+
+
+def test_compute_presyncs_collection_once():
+    """MetricCollection.compute() pre-syncs every sync_on_compute member in ONE
+    coalesced sync instead of one per member."""
+    coll = _stat_collection(compute_groups=False)
+    rng = np.random.default_rng(5)
+    preds = jnp.asarray(rng.normal(size=(32, 5)).astype(np.float32))
+    target = jnp.asarray(rng.integers(0, 5, 32, dtype=np.int32))
+    coll.update(preds, target)
+    for m in coll.values():
+        m.distributed_available_fn = lambda: True
+    with obs.telemetry_session() as rec:
+        values = coll.compute()
+        snap = rec.counters.snapshot()
+    assert snap["sync_calls"] == 1  # one coalesced sync for all 16 members
+    assert snap["sync_collectives"] <= 4
+    assert not any(m._is_synced for m in coll.values())  # unsynced after compute
+    assert len(values) >= 16
+
+
+def test_compute_inside_sync_context_does_not_resync():
+    """A pre-synced metric computes on the synced state instead of raising
+    (the guard that enables collection-level pre-sync)."""
+    m = tm.aggregation.SumMetric(
+        dist_sync_fn=lambda v, g=None: [jnp.asarray(v), jnp.asarray(v)],
+        distributed_available_fn=lambda: True,
+    )
+    m.update(jnp.asarray([2.0]))
+    m.sync()
+    val = m.compute()  # previously raised "already been synced"
+    np.testing.assert_allclose(float(val), 4.0)
+    m.unsync()
+    np.testing.assert_allclose(float(m._state["sum_value"]), 2.0)
+
+
+# ----------------------------------------------------------- in-graph plane
+
+
+def _mesh8():
+    return jax.make_mesh((8,), ("dp",), devices=jax.devices()[:8])
+
+
+def test_ingraph_bucketed_reduce_matches_per_leaf():
+    """All tags, mixed dtypes: bucketed reduce_states == per-leaf, bitwise,
+    inside shard_map over the 8-device CPU mesh."""
+    from jax.sharding import PartitionSpec as P
+
+    state = {
+        "a": jnp.arange(4.0),
+        "b": jnp.asarray(2.0),
+        "i": jnp.arange(6, dtype=jnp.int32),
+        "m": jnp.asarray(3.0),
+        "bf": jnp.asarray([1.5, -2.0], jnp.bfloat16),
+        "cat": jnp.arange(2.0),
+        "cust": jnp.asarray([1.0, 4.0]),
+        "skip": jnp.asarray(9.0),
+    }
+    reds = {
+        "a": "sum", "b": "max", "i": "sum", "m": "mean", "bf": "min",
+        "cat": "cat", "cust": lambda g: jnp.max(g, axis=0), "skip": None,
+    }
+    mesh = _mesh8()
+    f_new = jax.jit(shard_map_compat(lambda s: S.reduce_states(s, reds, "dp"), mesh=mesh,
+                                     in_specs=(P(),), out_specs=P(), check_vma=False))
+    f_old = jax.jit(shard_map_compat(lambda s: S.reduce_states_per_leaf(s, reds, "dp"), mesh=mesh,
+                                     in_specs=(P(),), out_specs=P(), check_vma=False))
+    a, b = f_new(state), f_old(state)
+    for k in state:
+        assert jnp.asarray(a[k]).dtype == jnp.asarray(b[k]).dtype, k
+        np.testing.assert_array_equal(np.asarray(a[k]), np.asarray(b[k]), err_msg=k)
+
+
+def test_ingraph_collection_reduce_coalesced_matches_per_member():
+    """PureCollection.reduce coalesces across members (including one that
+    overrides reduce_state and must keep its exact fold)."""
+    from jax.sharding import PartitionSpec as P
+
+    from torchmetrics_tpu.classification import MulticlassAccuracy, MulticlassF1Score
+    from torchmetrics_tpu.regression import PearsonCorrCoef
+
+    coll = MetricCollection({
+        "acc": MulticlassAccuracy(5, average="micro", validate_args=False),
+        "f1": MulticlassF1Score(5, average="macro", validate_args=False),
+        "pearson": PearsonCorrCoef(),
+    })
+    pure = coll.as_pure()
+    mesh = _mesh8()
+    rng = np.random.default_rng(6)
+    preds = jnp.asarray(rng.normal(size=(32, 5)).astype(np.float32))
+    target = jnp.asarray(rng.integers(0, 5, 32, dtype=np.int32))
+    reg_p = jnp.asarray(rng.normal(size=(32,)).astype(np.float32))
+    reg_t = reg_p * 0.5 + jnp.asarray(rng.normal(size=(32,)).astype(np.float32)) * 0.1
+
+    def step(preds, target, rp, rt):
+        states = pure.init()
+        states["acc"] = coll["acc"].update_state(states["acc"], preds, target)
+        states["f1"] = coll["f1"].update_state(states["f1"], preds, target)
+        states["pearson"] = coll["pearson"].update_state(states["pearson"], rp, rt)
+        return pure.reduce(states, "dp")
+
+    def step_per_member(preds, target, rp, rt):
+        states = pure.init()
+        states["acc"] = coll["acc"].update_state(states["acc"], preds, target)
+        states["f1"] = coll["f1"].update_state(states["f1"], preds, target)
+        states["pearson"] = coll["pearson"].update_state(states["pearson"], rp, rt)
+        return {n: coll[n].reduce_state(states[n], "dp") for n in states}
+
+    P4 = (P("dp"), P("dp"), P("dp"), P("dp"))
+    f_new = jax.jit(shard_map_compat(step, mesh=mesh, in_specs=P4, out_specs=P(), check_vma=False))
+    f_old = jax.jit(shard_map_compat(step_per_member, mesh=mesh, in_specs=P4, out_specs=P(), check_vma=False))
+    a, b = f_new(preds, target, reg_p, reg_t), f_old(preds, target, reg_p, reg_t)
+    for name in a:
+        for k in a[name]:
+            np.testing.assert_allclose(
+                np.asarray(a[name][k]), np.asarray(b[name][k]), rtol=1e-6, err_msg=f"{name}.{k}"
+            )
+
+
+# ------------------------------------------------ fleet counter rollup plane
+
+
+def test_gather_metadata_vector_is_single_collective():
+    calls = {"n": 0}
+
+    def fake(v, g=None):
+        calls["n"] += 1
+        return [jnp.asarray(v), jnp.asarray(v)]
+
+    rows = S.gather_metadata_vector([3, (1 << 40) + 7], dist_sync_fn=fake)
+    assert calls["n"] == 1  # ONE collective — no per-leaf shape round-trip
+    assert rows == [[3, (1 << 40) + 7]] * 2
+
+
+def test_fleet_rollup_piggybacks_on_coalesced_sync(monkeypatch):
+    """After a coalesced sync under an active session, summary(fleet=True)
+    reuses the counter rows the sync's metadata collective shipped — zero
+    extra collectives."""
+    C.clear_fleet_mailbox()
+    m = tm.aggregation.SumMetric()
+    m.update(jnp.asarray([1.0, 2.0]))
+    with obs.telemetry_session() as rec:
+        m.sync(distributed_available=lambda: True)  # real world-of-one collectives
+        m.unsync()
+        rows = C.fleet_counter_rows()
+        assert rows is not None
+        assert rows[1] == 0 and len(rows[0]) == 1  # one rank, local index 0
+
+        def boom(*a, **k):
+            raise AssertionError("fleet rollup launched a collective after a coalesced sync")
+
+        monkeypatch.setattr(S, "gather_metadata_vector", boom)
+        fleet = obs.gather_counters()
+        assert fleet.ranks == 1
+        assert fleet.totals["sync_calls"] == rec.counters.value("sync_calls")
+    C.clear_fleet_mailbox()
+
+
+def test_fleet_mailbox_invalidated_by_new_session():
+    C.clear_fleet_mailbox()
+    m = tm.aggregation.SumMetric()
+    m.update(jnp.asarray([1.0]))
+    with obs.telemetry_session():
+        m.sync(distributed_available=lambda: True)
+        m.unsync()
+        assert C.fleet_counter_rows() is not None
+    with obs.telemetry_session():
+        assert C.fleet_counter_rows() is None  # stale rows never leak across sessions
+    C.clear_fleet_mailbox()

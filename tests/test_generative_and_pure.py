@@ -208,7 +208,7 @@ def test_as_pure_matches_stateful_collection():
 
 
 def test_as_pure_in_graph_sharded():
-    from jax.experimental.shard_map import shard_map
+    from torchmetrics_tpu.parallel import shard_map
 
     devices = jax.devices()
     if len(devices) < 8:

@@ -13,6 +13,7 @@ as 2 slices x 4 chips and verify:
 from __future__ import annotations
 
 import jax
+from torchmetrics_tpu.parallel import shard_map as _shard_map
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -47,7 +48,7 @@ def test_two_stage_equals_single_shot(fx):
 
     run = lambda fn: np.asarray(
         jax.jit(
-            jax.shard_map(
+            _shard_map(
                 fn, mesh=mesh, in_specs=(P("dcn", "ici", None),), out_specs=P(), check_vma=False
             )
         )(data)
@@ -88,7 +89,7 @@ def test_metric_state_reduction_over_2d_mesh():
         return state
 
     fn = jax.jit(
-        jax.shard_map(
+        _shard_map(
             shard_fn, mesh=mesh,
             in_specs=(P(("dcn", "ici")), P(("dcn", "ici"))),
             out_specs=P(), check_vma=False,
@@ -119,7 +120,7 @@ def test_fused_collection_over_2d_mesh():
         return pure.reduce(states, ("dcn", "ici"))
 
     fn = jax.jit(
-        jax.shard_map(
+        _shard_map(
             shard_fn, mesh=mesh,
             in_specs=(P(("dcn", "ici")), P(("dcn", "ici"))),
             out_specs=P(), check_vma=False,

@@ -8,6 +8,7 @@ from __future__ import annotations
 
 import numpy as np
 import jax
+from torchmetrics_tpu.parallel import shard_map as _shard_map
 import jax.numpy as jnp
 import optax
 import pytest
@@ -70,7 +71,7 @@ def test_pjit_train_eval_loop_with_metrics():
         return pure.reduce(local, "data")
 
     eval_step = jax.jit(
-        jax.shard_map(eval_shard, mesh=mesh, in_specs=(P(), P("data"), P("data")), out_specs=P())
+        _shard_map(eval_shard, mesh=mesh, in_specs=(P(), P("data"), P("data")), out_specs=P())
     )
 
     batch = 128

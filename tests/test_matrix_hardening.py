@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import numpy as np
 import jax
+from torchmetrics_tpu.parallel import shard_map as _shard_map
 import jax.numpy as jnp
 import pytest
 from jax.sharding import PartitionSpec as P
@@ -114,7 +115,7 @@ def _ingraph_values(metric, *batches):
         return metric.reduce_state(local, "data")
 
     fn = jax.jit(
-        jax.shard_map(
+        _shard_map(
             shard_step, mesh=mesh, in_specs=tuple(P("data") for _ in batches), out_specs=P()
         )
     )
@@ -140,7 +141,7 @@ def test_ingraph_nominal_cramers():
         local = {"confmat": _multiclass_confusion_matrix_update(p, t, None, 4).astype(jnp.float32)}
         return m.reduce_state(local, "data")
 
-    fn = jax.jit(jax.shard_map(shard_step, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P()))
+    fn = jax.jit(_shard_map(shard_step, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P()))
     synced = fn(preds, target)
     stateful = tm.CramersV(num_classes=4)
     stateful.update(preds, target)
@@ -169,7 +170,7 @@ def test_ingraph_panoptic():
         local = {k: v[0] for k, v in contrib.items()}
         return m.reduce_state(local, "data")
 
-    fn = jax.jit(jax.shard_map(shard_step, mesh=mesh, in_specs=(P("data"),), out_specs=P()))
+    fn = jax.jit(_shard_map(shard_step, mesh=mesh, in_specs=(P("data"),), out_specs=P()))
     synced = fn(stacked)
     # int states divided by 8 then psummed across 8 shards reproduce the total
     for k in bs:

@@ -81,6 +81,7 @@ def test_counters_snapshot_and_diff():
     assert set(brief) == {
         "dispatches", "jit_compiles", "jit_cache_hits", "retraces",
         "host_dispatches", "d2h_readbacks", "sync_calls",
+        "gathers_coalesced", "collectives_per_sync",
     }
     c.reset()
     assert c.snapshot()["dispatches"] == 0
@@ -239,9 +240,12 @@ def test_scripted_run_counters_reconcile():
     assert len(retry_events) == 1 and retry_events[0].payload["attempt"] == 1
     # the hot loop performed ZERO device→host readbacks (counter + guard agree)
     assert hot["d2h_readbacks"] == 0
-    # sync + compute happened after the hot loop and were recorded
+    # sync + compute happened after the hot loop and were recorded; the single
+    # scalar leaf rode the coalesced plane (metadata + one bucket collective),
+    # so the per-leaf gather counter stays at zero
     final = rec.counters.snapshot()
-    assert final["sync_calls"] == 1 and final["gather_calls"] == 1
+    assert final["sync_calls"] == 1 and final["gather_calls"] == 0
+    assert final["gathers_coalesced"] == 1 and final["sync_collectives"] == 2
     assert final["sync_payload_bytes"] == 4  # one f32 scalar state
     assert final["computes"] == 1
     assert len(rec.events_of("sync")) == 1

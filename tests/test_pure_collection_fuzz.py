@@ -12,6 +12,7 @@ the fused path.
 from __future__ import annotations
 
 import jax
+from torchmetrics_tpu.parallel import shard_map as _shard_map
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -105,7 +106,7 @@ def test_as_pure_mesh_reduce_matches_oneshot():
     per_dev = [pure.update(pure.init(), *b) for b in batches]
     stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *per_dev)
     mesh = Mesh(np.array(jax.devices()[:8]), ("dp",))
-    reduce_fn = jax.jit(jax.shard_map(
+    reduce_fn = jax.jit(_shard_map(
         lambda s: pure.reduce(jax.tree.map(lambda v: v[0], s), "dp"),
         mesh=mesh, in_specs=(P("dp"),), out_specs=P(), check_vma=False,
     ))

@@ -8,6 +8,7 @@ FID])`` as one jitted sharded step on the 8-device CPU mesh, and the
 """
 
 import jax
+from torchmetrics_tpu.parallel import shard_map as _shard_map
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -93,7 +94,7 @@ class TestPaddedDetectionAccumulator:
             state = acc.update(acc.init(), *batch)
             return acc.gather(state, "dp")
 
-        fn = jax.jit(jax.shard_map(
+        fn = jax.jit(_shard_map(
             step, mesh=mesh, in_specs=tuple(P("dp") for _ in batch), out_specs=P(),
             check_vma=False,
         ))

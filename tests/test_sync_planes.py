@@ -140,3 +140,48 @@ def test_update_state_mean_raises():
     m = DummyMean()
     with pytest.raises(TorchMetricsUserError, match="mean"):
         m.update_state(m.init_state(), np.asarray(1.0))
+
+
+# ------------------------------------------------------- coalesced fast path
+# (the full parity fuzz lives in tests/test_coalesced_sync.py; these pin the
+# plane-2 entry point's behavior)
+
+
+def test_process_sync_coalesces_multi_leaf_state():
+    """A faithful replay world rides the coalesced plane: one metadata gather
+    plus one collective per dtype bucket, per-leaf results preserved."""
+    from torchmetrics_tpu.parallel import coalesce as C
+
+    states = [
+        {"a": jnp.asarray([1.0, 2.0]), "b": jnp.asarray(3.0), "c": jnp.asarray([1], jnp.int32)},
+        {"a": jnp.asarray([10.0, 20.0]), "b": jnp.asarray(7.0), "c": jnp.asarray([4], jnp.int32)},
+    ]
+    reds = {"a": "sum", "b": "max", "c": "sum"}
+
+    class World:
+        def __init__(self):
+            self.calls = 0
+
+        def __call__(self, v, g=None):
+            k = self.calls
+            self.calls += 1
+            if k == 0:
+                self.metas = [C.build_local_metadata([s], [reds]) for s in states]
+                return [jnp.asarray(m) for m in self.metas]
+            return [C.build_bucket_payload([s], [reds], k - 1, self.metas) for s in states]
+
+    w = World()
+    out = _sync.process_sync(dict(states[0]), reds, dist_sync_fn=w)
+    assert w.calls == 3  # metadata + f32 bucket + i32 bucket (5 leaves total)
+    assert np.allclose(np.asarray(out["a"]), [11.0, 22.0])
+    assert float(out["b"]) == 7.0 and int(out["c"][0]) == 5
+
+
+def test_process_sync_per_leaf_fallback_keeps_injection_contract():
+    """Value-mutating fakes (the reference seam's classic shape) keep working
+    byte-for-byte through the per-leaf fallback."""
+    m = DummyMean(dist_sync_fn=_fake_gather_factory(3))
+    m.update(np.asarray(4.0))
+    m.sync(distributed_available=lambda: True)
+    assert np.isclose(float(m._state["v"]), 5.0)
+    m.unsync()

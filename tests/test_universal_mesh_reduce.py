@@ -17,6 +17,7 @@ from __future__ import annotations
 import zlib
 
 import jax
+from torchmetrics_tpu.parallel import shard_map as _shard_map
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -101,7 +102,7 @@ def test_mesh_reduce_matches_oneshot(name):
     template = shard_metrics[0]
     mesh = Mesh(np.array(jax.devices()[:NDEV]), ("dp",))
     reduce_fn = jax.jit(
-        jax.shard_map(
+        _shard_map(
             lambda s: template.reduce_state({k: v[0] for k, v in s.items()}, "dp"),
             mesh=mesh, in_specs=(P("dp"),), out_specs=P(), check_vma=False,
         )

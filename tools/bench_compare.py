@@ -50,6 +50,14 @@ THRESHOLDS: Dict[str, float] = {
     # CPU-mesh collective latencies: ±10% run-to-run is normal background noise
     "extra.sync_allreduce_8dev_cpu.psum_latency_ms": 0.5,
     "extra.sync_allreduce_8dev_cpu.flagship_sync_latency_ms": 0.5,
+    # coalesced-sync config: the collective COUNT is deterministic (a tight gate
+    # — a regression back to per-leaf collectives is a >10x move), the CPU-mesh
+    # latencies wobble like the other mesh configs
+    "extra.collection_sync_16metrics.collectives_per_sync": 0.25,
+    "extra.collection_sync_16metrics.host_sync_coalesced_ms": 0.5,
+    "extra.collection_sync_16metrics.host_sync_per_leaf_ms": 0.5,
+    "extra.collection_sync_16metrics.ingraph_coalesced_ms": 0.5,
+    "extra.collection_sync_16metrics.ingraph_per_leaf_ms": 0.5,
     # one-shot compute latencies (single measurement, no best-of-3)
     "extra.coco_map_synthetic.compute_sec_500imgs_80cls": 0.5,
     "extra.coco_map_synthetic.compute_sec_5000imgs_80cls": 0.5,
@@ -58,14 +66,22 @@ THRESHOLDS: Dict[str, float] = {
 _HIGHER_MARKERS = ("per_sec", "speedup", "throughput")
 _HIGHER_EXACT = ("value", "vs_baseline")
 _LOWER_MARKERS = ("latency", "compile", "_sec", "_ms", "_bytes", "bytes_", "time")
+# collective counts per sync: fewer is the whole point of the coalesced plane —
+# a move back toward per-leaf collectives must gate even though the name
+# carries no latency/throughput marker
+_LOWER_EXACT = ("collectives_per_sync",)
+# deterministic workload constants of the coalesced-sync config (leaf counts)
+_INFO_EXACT = ("leaves_coalesced_per_sync", "per_leaf_collectives")
 
 
 def direction(name: str) -> Optional[str]:
     """``"higher"``/``"lower"`` = which way is good; ``None`` = informational
     (telemetry counters, attempt counts — constants of the workload, not perf)."""
     leaf = name.split(".")[-1]
-    if ".telemetry" in name or leaf in ("attempts", "n", "rc"):
+    if ".telemetry" in name or leaf in ("attempts", "n", "rc") or leaf in _INFO_EXACT:
         return None
+    if leaf in _LOWER_EXACT:
+        return "lower"
     if leaf in _HIGHER_EXACT or any(m in leaf for m in _HIGHER_MARKERS):
         return "higher"
     if any(m in leaf for m in _LOWER_MARKERS):

@@ -63,6 +63,7 @@ def aggregate(events: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
         "retries": 0, "retries_exhausted": 0, "quarantines": 0,
         "d2h_readbacks": 0, "d2h_bytes": 0,
         "sync_calls": 0, "sync_payload_bytes": 0,
+        "sync_collectives": 0, "leaves_coalesced": 0,
     }
     retries: List[Dict[str, Any]] = []
     quarantines: List[Dict[str, Any]] = []
@@ -83,7 +84,10 @@ def aggregate(events: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
                     row["cache_hits"] += 1
             elif kind == "sync":
                 totals["sync_calls"] += 1
-                totals["sync_payload_bytes"] += int(ev.get("payload", {}).get("payload_bytes", 0))
+                payload = ev.get("payload", {})
+                totals["sync_payload_bytes"] += int(payload.get("payload_bytes", 0))
+                totals["sync_collectives"] += int(payload.get("collectives", 0))
+                totals["leaves_coalesced"] += int(payload.get("coalesced_leaves", 0))
             dur = ev.get("duration_s")
             if dur is not None:
                 row["total_s"] += float(dur)
@@ -147,11 +151,14 @@ def render_table(report: Dict[str, Any]) -> str:
         lines.append("  ".join(c.ljust(w) for c, w in zip(row, widths)))
     t = report["totals"]
     lines.append("")
+    per_sync = round(t["sync_collectives"] / t["sync_calls"], 2) if t["sync_calls"] else 0
     lines.append(
         f"retries: {t['retries']} (exhausted: {t['retries_exhausted']})  "
         f"quarantines: {t['quarantines']}  "
         f"d2h readbacks: {t['d2h_readbacks']} ({t['d2h_bytes']} bytes)  "
-        f"syncs: {t['sync_calls']} ({t['sync_payload_bytes']} payload bytes)"
+        f"syncs: {t['sync_calls']} ({t['sync_payload_bytes']} payload bytes, "
+        f"{t['sync_collectives']} collectives = {per_sync}/sync, "
+        f"{t['leaves_coalesced']} leaves coalesced)"
     )
     for ev in report["retries"]:
         p = ev.get("payload", {})

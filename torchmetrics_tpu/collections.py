@@ -22,7 +22,12 @@ import numpy as np
 
 from . import observability as _observability
 from .metric import Metric
+from .observability import tracing as _tracing
+from .parallel import coalesce as _coalesce
+from .parallel import sync as _par_sync
+from .reliability.guards import validate_state
 from .utilities.data import _flatten_dict, allclose
+from .utilities.exceptions import TorchMetricsUserError
 from .utilities.prints import rank_zero_warn
 
 _ERROR_MSG = "Unknown input to MetricCollection."
@@ -538,19 +543,54 @@ class MetricCollection:
     __call__ = forward
 
     def compute(self) -> Dict[str, Any]:
-        res: Dict[str, Any] = {}
-        for name, metric in self._modules.items():
-            if name in self._quarantined:
-                res[name] = self._status_marker(name)
-            elif self.on_error == "raise":
-                res[name] = metric.compute()
-            else:
-                try:
+        # coalesced pre-sync: every member that would sync inside its own
+        # compute() syncs HERE through one bucketed collective set instead of
+        # K independent per-metric syncs (members see _is_synced and skip
+        # their own); unsync restores local views afterwards
+        presynced = self._presync_for_compute()
+        try:
+            res: Dict[str, Any] = {}
+            for name, metric in self._modules.items():
+                if name in self._quarantined:
+                    res[name] = self._status_marker(name)
+                elif self.on_error == "raise":
                     res[name] = metric.compute()
-                except Exception as exc:  # noqa: BLE001
-                    self._handle_metric_error(name, exc, "compute")
-                    res[name] = self._failure_marker(name, "compute", exc)
+                else:
+                    try:
+                        res[name] = metric.compute()
+                    except Exception as exc:  # noqa: BLE001
+                        self._handle_metric_error(name, exc, "compute")
+                        res[name] = self._failure_marker(name, "compute", exc)
+        finally:
+            for metric in presynced:
+                if metric._is_synced:
+                    metric.unsync()
         return self._flatten_res(res)
+
+    def _presync_for_compute(self) -> List[Metric]:
+        """Coalesce the sync_on_compute syncs of all members into one bucketed
+        sync. Only under ``on_error="raise"`` (degrading policies attribute
+        failures per member, which a fused collective cannot); any condition
+        the fast path cannot honor simply leaves members to sync themselves
+        inside compute() exactly as before. Returns the members this call
+        synced (the caller owns their unsync)."""
+        if self.on_error != "raise":
+            return []
+        members = [
+            m
+            for m in self._modules.values()
+            if m.sync_on_compute
+            and not m._is_synced
+            and not (m.compute_with_cache and m._computed is not None)
+            # only replace the sync that Metric.compute itself would run; a
+            # member with a custom compute keeps its own sync discipline
+            and type(m).compute is Metric.compute
+        ]
+        if not members:
+            return []
+        if not self._coalesced_sync(members):
+            return []
+        return [m for m in members if m._is_synced]
 
     def _flatten_res(self, res: Dict[str, Any]) -> Dict[str, Any]:
         """Flatten nested dict outputs + apply prefix/postfix (reference :388-407)."""
@@ -667,8 +707,132 @@ class MetricCollection:
             metric.load_state_dict(state_dict, prefix=f"{name}.", validate=validate)
 
     def sync(self, **kwargs: Any) -> None:
+        """Cross-process sync of every member. Fast path: ALL members' states
+        coalesce into one bucketed collective set (K·L per-leaf collectives →
+        1 metadata gather + one padded gather per dtype); fused compute-group
+        members share one state dict and are gathered/charged exactly once,
+        re-aliasing on commit. Falls back to per-member ``Metric.sync`` when
+        members disagree on gather seams (mixed ``dist_sync_fn``/
+        ``process_group``/availability)."""
+        if self._coalesced_sync(list(self._modules.values()), **kwargs):
+            return
         for metric in self._modules.values():
             metric.sync(**kwargs)
+
+    def _coalesced_sync(
+        self,
+        metrics: List[Metric],
+        dist_sync_fn: Optional[Any] = None,
+        process_group: Optional[Any] = None,
+        should_sync: bool = True,
+        distributed_available: Optional[Any] = None,
+    ) -> bool:
+        """Coalesced multi-metric sync. Returns ``True`` when this call fully
+        handled the sync (including the distributed-unavailable no-op) and
+        ``False`` when the caller must fall back to per-member syncs.
+
+        Reliability contract: nothing is committed until every bucket has
+        gathered and every member's synced state validated, so a faulty
+        bucketed gather (e.g. ``FlakyGather``) leaves every member at its last
+        good state — exactly the per-leaf rollback guarantee. Retry uses the
+        first member's ``ReliabilityConfig`` (members of one collection share
+        a policy in practice; mixed policies still roll back atomically)."""
+        if not should_sync or not metrics:
+            return True
+        fns = {id(dist_sync_fn or m.dist_sync_fn) for m in metrics}
+        groups = {id(process_group or m.process_group) for m in metrics}
+        # a plain list, never a Metric-keyed dict: Metric.__hash__ is state-id
+        # based (fused members collide) and __eq__ builds CompositionalMetric,
+        # so distinct members would silently collapse to one entry
+        avail_fns = [(distributed_available or m.distributed_available_fn) for m in metrics]
+        if len(fns) > 1 or len(groups) > 1:
+            return False  # mixed gather seams: per-member semantics required
+        if any(type(m).sync is not Metric.sync for m in metrics):
+            return False  # a member customizes sync: honor it per-member
+        # ordering mirrors Metric.sync: the already-synced error fires BEFORE
+        # the availability check, so the no-op below can't swallow it
+        if any(m._is_synced for m in metrics):
+            raise TorchMetricsUserError("The Metric has already been synced.")
+        avails = {bool(fn()) for fn in avail_fns}
+        if len(avails) > 1:
+            return False
+        if not avails.pop():
+            return True  # nowhere to sync — same no-op as per-member path
+        fn = dist_sync_fn or metrics[0].dist_sync_fn
+        group = process_group or metrics[0].process_group
+        # fused compute-group members alias ONE state dict: gather it once
+        holders: "OrderedDict[int, Metric]" = OrderedDict()
+        aliased: Dict[int, List[Metric]] = {}
+        for m in metrics:
+            key = id(m._state)
+            holders.setdefault(key, m)
+            aliased.setdefault(key, []).append(m)
+        states = [holders[k]._state for k in holders]
+        reductions = [holders[k]._reductions for k in holders]
+        rec = _observability._ACTIVE
+        t0 = _tracing.monotonic() if rec is not None else 0.0
+        bytes_total = sum(_par_sync._payload_bytes(s) for s in states)
+        coll0 = rec.counters.value("sync_collectives") if rec is not None else 0
+        coal0 = rec.counters.value("gathers_coalesced") if rec is not None else 0
+        def attempt() -> List[Dict[str, Any]]:
+            return _coalesce.coalesced_process_sync(
+                states, reductions, process_group=group, dist_sync_fn=fn
+            )
+
+        def count_attempt(exc: BaseException, attempt_no: int) -> None:
+            # a transiently-failed attempt still entered the sync plane — count
+            # it like the per-metric path does (process_sync records at entry)
+            if rec is not None:
+                rec.counters.record_sync(bytes_total)
+
+        retry = next(
+            (m._reliability.retry for m in metrics if m._reliability is not None and m._reliability.retry is not None),
+            None,
+        )
+        with _tracing.trace_span("MetricCollection.sync"):
+            try:
+                if retry is None:
+                    synced = attempt()
+                else:
+                    synced = retry.call(attempt, on_retry=count_attempt, describe="MetricCollection.sync")
+            except _coalesce.CoalesceFallback:
+                # nothing committed AND nothing recorded for this attempt: the
+                # per-member path records its own syncs (charging the abandoned
+                # attempt too would double-count one logical sync)
+                return False
+        if rec is not None:  # the successful attempt is one sync entry
+            rec.counters.record_sync(bytes_total)
+        # validate BEFORE committing anything: a corrupt contribution must not
+        # become any member's state (and a partial commit must never happen).
+        # Fused members share one dict AND one validation semantics (fusion
+        # requires equal defaults/reductions) — scan each distinct dict once,
+        # with the strictest finiteness setting among its members.
+        for key, synced_dict in zip(holders, synced):
+            validators = [m for m in aliased[key] if m._reliability is not None and m._reliability.validate_on_sync]
+            if validators:
+                validate_state(
+                    validators[0], synced_dict,
+                    context=f"{type(validators[0]).__name__}.sync",
+                    check_finite=any(m._reliability.check_finite for m in validators),
+                )
+        # atomic commit: one shared cache + one shared synced dict per distinct
+        # state dict, so group members keep ALIASING through sync/unsync
+        for key, synced_dict in zip(holders, synced):
+            holder = holders[key]
+            cache = {
+                k: (list(v) if isinstance(v, list) else v) for k, v in holder._state.items()
+            }
+            for m in aliased[key]:
+                m._cache = cache
+                m._state = synced_dict
+                m._is_synced = True
+        if rec is not None:
+            rec.record_sync(
+                self, rec.finish(synced, t0), bytes_total,
+                collectives=rec.counters.value("sync_collectives") - coll0,
+                coalesced_leaves=rec.counters.value("gathers_coalesced") - coal0,
+            )
+        return True
 
     def unsync(self, **kwargs: Any) -> None:
         for metric in self._modules.values():
@@ -807,6 +971,22 @@ class PureCollection:
         return new_states, self.compute(new_states)
 
     def reduce(self, states: Dict[str, Any], axis_name: Any) -> Dict[str, Any]:
-        """Cross-device reduction of every state inside ``shard_map`` (one collective
-        per leaf)."""
-        return {name: m.reduce_state(states[name], axis_name) for name, m in self._metrics.items()}
+        """Cross-device reduction of every member's state inside ``shard_map``,
+        coalesced across the WHOLE collection: all members' leaves share one
+        collective per (reduction-class × dtype) bucket instead of one per
+        leaf. Members overriding ``reduce_state`` (exact-fold metrics like
+        Pearson) keep their own reduction."""
+        out: Dict[str, Any] = {}
+        default_names = [
+            name for name, m in self._metrics.items()
+            if type(m).reduce_state is Metric.reduce_state
+        ]
+        for name, m in self._metrics.items():
+            if name not in default_names:
+                out[name] = m.reduce_state(states[name], axis_name)
+        if default_names:
+            reduced = _coalesce.reduce_many(
+                [(states[n], self._metrics[n]._reductions) for n in default_names], axis_name
+            )
+            out.update(dict(zip(default_names, reduced)))
+        return {name: out[name] for name in self._metrics}

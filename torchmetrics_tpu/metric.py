@@ -19,8 +19,10 @@ Everything else falls out of purity:
 - ``forward`` = same call, additionally returning ``compute(batch_state)`` — no
   cache/restore gymnastics (reference's ``_forward_full_state_update`` double-update).
 - ``merge_state`` = pytree fold (free).
-- sync = per-leaf ``psum/pmax/pmin/all_gather`` over mesh axes (in-graph) or
-  process-allgather + fold (multi-controller) — see ``parallel/sync.py``.
+- sync = bucketed ``psum/pmax/pmin/all_gather`` over mesh axes (in-graph) or a
+  coalesced process-allgather + fold (multi-controller) — one collective per
+  (reduction-class × dtype) bucket, not per leaf; see ``parallel/sync.py`` and
+  ``parallel/coalesce.py``.
 - checkpoint = the state dict *is* a pytree; hand it to orbax as-is.
 
 A thin stateful OO shell on top preserves the reference's public API surface
@@ -265,7 +267,8 @@ class Metric:
         return self._compute(state)
 
     def reduce_state(self, state: StateDict, axis_name: Union[str, Sequence[str]]) -> StateDict:
-        """Cross-device reduction inside ``shard_map`` (one collective per leaf)."""
+        """Cross-device reduction inside ``shard_map`` — coalesced: one
+        collective per (reduction-class × dtype) bucket, not one per leaf."""
         return _sync.reduce_states(state, self._reductions, axis_name)
 
     # ------------------------------------------------------------- lifecycle
@@ -560,7 +563,10 @@ class Metric:
             return self._computed
 
         did_sync = False
-        if self.sync_on_compute and self.distributed_available_fn():
+        # an already-synced metric (sync_context, or a collection-level
+        # coalesced pre-sync) computes on the synced state as-is; whoever
+        # synced it owns the unsync
+        if self.sync_on_compute and not self._is_synced and self.distributed_available_fn():
             self.sync()
             did_sync = True
         try:
@@ -609,6 +615,8 @@ class Metric:
         rec = _observability._ACTIVE
         t0 = _tracing.monotonic() if rec is not None else 0.0
         bytes0 = rec.counters.value("sync_payload_bytes") if rec is not None else 0
+        coll0 = rec.counters.value("sync_collectives") if rec is not None else 0
+        coal0 = rec.counters.value("gathers_coalesced") if rec is not None else 0
         with _tracing.trace_span(f"{type(self).__name__}.sync"):
             synced = self._reliable_call(
                 "sync",
@@ -620,11 +628,13 @@ class Metric:
                 ),
             )
         if rec is not None:
-            # payload bytes were accumulated leaf-by-leaf inside process_sync;
-            # the delta is this sync's contribution
+            # payload bytes / collective counts were accumulated inside
+            # process_sync; the deltas are this sync's contribution
             rec.record_sync(
                 self, rec.finish(synced, t0),
                 rec.counters.value("sync_payload_bytes") - bytes0,
+                collectives=rec.counters.value("sync_collectives") - coll0,
+                coalesced_leaves=rec.counters.value("gathers_coalesced") - coal0,
             )
         rel = self._reliability
         if rel is not None and rel.validate_on_sync:

@@ -246,14 +246,28 @@ class TelemetryRecorder:
         self.counters.record_compute()
         self._event("compute", self._metric_name(metric), "compute", duration_s=duration_s)
 
-    def record_sync(self, metric: Any, duration_s: float, payload_bytes: int) -> None:
-        """One ``Metric.sync`` through ``process_sync`` (the per-leaf gather
-        counts and byte totals land in the counters from ``parallel/sync.py``;
-        the duration feeds the fleet rollup's straggler attribution)."""
+    def record_sync(
+        self,
+        metric: Any,
+        duration_s: float,
+        payload_bytes: int,
+        collectives: int = 0,
+        coalesced_leaves: int = 0,
+    ) -> None:
+        """One ``Metric.sync``/``MetricCollection.sync`` through the sync
+        planes (gather/bucket counts and byte totals land in the counters from
+        ``parallel/sync.py``; the duration feeds the fleet rollup's straggler
+        attribution). ``collectives`` is how many collectives this sync
+        launched and ``coalesced_leaves`` how many state leaves rode a
+        coalesced bucket — the per-sync view of the K·L → buckets reduction."""
         self.counters.record_sync_time(duration_s)
         self._event(
             "sync", self._metric_name(metric), "sync", duration_s=duration_s,
-            payload={"payload_bytes": int(payload_bytes)},
+            payload={
+                "payload_bytes": int(payload_bytes),
+                "collectives": int(collectives),
+                "coalesced_leaves": int(coalesced_leaves),
+            },
         )
 
     def record_state_memory(self, metric: Any) -> None:
@@ -429,32 +443,49 @@ def gather_counters(
     snapshot: Optional[CountersSnapshot] = None,
     process_group: Any = None,
     dist_sync_fn: Any = None,
+    prefer_sync_rows: bool = True,
 ) -> FleetSnapshot:
     """Gather this process's counters across all ranks and merge them.
 
     The payload is metadata-sized — one int64 vector of :data:`COUNTER_FIELDS`
-    per rank — shipped through the same ``parallel/sync.py`` gather plane the
-    metric states use (``dist_sync_fn`` is the usual injection seam). With one
-    process (or no snapshot source) this degrades to a single-rank fleet view.
-    Remote ranks contribute counts only; per-key dispatch records stay local
-    (strings don't ride the array gather), so the merged ``per_key`` covers
-    this rank alone.
+    per rank — and rides the coalesced gather plane. When a coalesced sync
+    already ran under the active session, its metadata collective carried
+    every rank's counter vector, so this rollup reuses those rows and launches
+    **zero extra collectives** (the local row is refreshed from ``snapshot``;
+    remote rows are as of each rank's last sync — pass
+    ``prefer_sync_rows=False`` to force a fresh collective). Otherwise one
+    ``gather_metadata_vector`` collective runs (``dist_sync_fn`` is the usual
+    injection seam and always bypasses the cached rows). With one process (or
+    no snapshot source) this degrades to a single-rank fleet view. Remote
+    ranks contribute counts only; per-key dispatch records stay local (strings
+    don't ride the array gather), so the merged ``per_key`` covers this rank
+    alone.
     """
     if snapshot is None:
         if _ACTIVE is None:
             raise RuntimeError("gather_counters needs an active telemetry session or an explicit snapshot")
         snapshot = _ACTIVE.counters.snapshot()
-    from ..parallel import sync as _sync  # lazy: parallel.sync imports this module
+    from ..parallel import coalesce as _coalesce  # lazy: parallel imports this module
+    from ..parallel import sync as _sync
 
-    rows = _sync.gather_metadata_vector(
-        snapshot.counts_vector(), process_group=process_group, dist_sync_fn=dist_sync_fn
-    )
-    my_rank = None
-    for i, row in enumerate(rows):  # re-attach local per-key records to our own row
-        if row == snapshot.counts_vector() and my_rank is None:
-            my_rank = i
+    rows: Any = None
+    my_rank: Optional[int] = None
+    # cached rows describe the LAST sync's whole-world metadata collective: an
+    # explicit process_group (a different scope) or injected gather always
+    # forces a fresh collective
+    if prefer_sync_rows and dist_sync_fn is None and process_group is None:
+        cached = _coalesce.fleet_counter_rows()
+        if cached is not None:
+            rows, my_rank = cached
+    if rows is None:
+        rows = _sync.gather_metadata_vector(
+            snapshot.counts_vector(), process_group=process_group, dist_sync_fn=dist_sync_fn
+        )
+        for i, row in enumerate(rows):  # re-attach local per-key records to our own row
+            if row == snapshot.counts_vector() and my_rank is None:
+                my_rank = i
     ranks: list = list(rows)
-    if my_rank is not None:
+    if my_rank is not None and 0 <= my_rank < len(ranks):
         ranks[my_rank] = snapshot
     return aggregate_counters(ranks)
 

@@ -35,12 +35,25 @@ COUNTER_FIELDS: Tuple[str, ...] = (
     "sync_calls",  # process_sync invocations
     "sync_payload_bytes",  # bytes entering the cross-process gather
     "sync_time_us",  # wall-clock spent inside Metric.sync (straggler signal)
-    "gather_calls",  # gather_all_arrays collectives (one per state leaf)
+    "gather_calls",  # per-leaf gather_all_arrays collectives (fallback plane)
+    "gathers_coalesced",  # state leaves served by a coalesced bucket (no own collective)
+    "sync_collectives",  # collectives actually launched by the sync planes
     "retries",  # transient failures accepted for retry
     "retries_exhausted",  # retry budgets that ran out on a transient failure
     "quarantines",  # metrics frozen by MetricCollection(on_error="quarantine")
     "skips",  # per-batch skips under on_error="skip"
 )
+
+
+def _collectives_per_sync(counts: Mapping[str, int]) -> float:
+    """Derived headline of the coalesced sync plane: collectives launched per
+    ``process_sync``/collection sync. K·L per-leaf collectives collapse to
+    1 metadata gather + one per dtype bucket — this ratio is the direct
+    observable of that reduction (0.0 before any sync ran)."""
+    syncs = int(counts.get("sync_calls", 0))
+    if not syncs:
+        return 0.0
+    return round(int(counts.get("sync_collectives", 0)) / syncs, 3)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -95,9 +108,13 @@ class CountersSnapshot:
             keys = (
                 "dispatches", "jit_compiles", "jit_cache_hits", "retraces",
                 "host_dispatches", "d2h_readbacks", "sync_calls",
+                "gathers_coalesced",
             )
-            return {k: self.counts[k] for k in keys}
+            out = {k: self.counts[k] for k in keys}
+            out["collectives_per_sync"] = _collectives_per_sync(self.counts)
+            return out
         out: Dict[str, Any] = dict(self.counts)
+        out["collectives_per_sync"] = _collectives_per_sync(self.counts)
         out["per_key"] = {
             k: {"compiles": v["compiles"], "cache_hits": v["cache_hits"],
                 "signatures": list(v["signatures"]),
@@ -223,6 +240,18 @@ class Counters:
         with self._lock:
             self._counts["gather_calls"] += 1
 
+    def record_coalesced(self, n_leaves: int) -> None:
+        """``n_leaves`` state leaves rode a coalesced bucket (no per-leaf
+        collective of their own)."""
+        with self._lock:
+            self._counts["gathers_coalesced"] += int(n_leaves)
+
+    def record_sync_collectives(self, n: int) -> None:
+        """``n`` collectives launched by a sync plane (coalesced: metadata +
+        one per bucket; per-leaf fallback: one per leaf)."""
+        with self._lock:
+            self._counts["sync_collectives"] += int(n)
+
     def record_retry(self) -> None:
         with self._lock:
             self._counts["retries"] += 1
@@ -240,6 +269,13 @@ class Counters:
     def value(self, name: str) -> int:
         with self._lock:
             return self._counts[name]
+
+    def counts_vector(self) -> List[int]:
+        """Counts in :data:`COUNTER_FIELDS` order without the full snapshot
+        copy — the sync-latency path ships this on every coalesced sync, so it
+        must not pay the per-key/costs deep copies ``snapshot()`` does."""
+        with self._lock:
+            return [int(self._counts.get(f, 0)) for f in COUNTER_FIELDS]
 
     def signatures(self, key: str) -> List[str]:
         with self._lock:
@@ -323,10 +359,12 @@ class FleetSnapshot:
             keys = (
                 "dispatches", "jit_compiles", "jit_cache_hits", "retraces",
                 "host_dispatches", "d2h_readbacks", "sync_calls",
+                "gathers_coalesced",
             )
             return {
                 "fleet": True, "ranks": self.ranks,
                 **{k: self.totals[k] for k in keys},
+                "collectives_per_sync": _collectives_per_sync(self.totals),
                 "stragglers": dict(self.stragglers),
             }
         return {

@@ -1,4 +1,6 @@
-from .mesh import DEFAULT_AXIS, batch_sharding, make_2d_mesh, make_data_mesh, replicated
+from . import coalesce
+from .coalesce import CoalesceFallback, coalesced_process_sync, collective_counts, reduce_many
+from .mesh import DEFAULT_AXIS, batch_sharding, make_2d_mesh, make_data_mesh, replicated, shard_map
 from .sync import (
     distributed_available,
     gather_all_arrays,
@@ -7,11 +9,16 @@ from .sync import (
     process_sync,
     reduce_over_axis,
     reduce_states,
+    reduce_states_per_leaf,
 )
 
 __all__ = [
+    "CoalesceFallback",
     "DEFAULT_AXIS",
     "batch_sharding",
+    "coalesce",
+    "coalesced_process_sync",
+    "collective_counts",
     "distributed_available",
     "gather_all_arrays",
     "make_2d_mesh",
@@ -19,7 +26,10 @@ __all__ = [
     "merge_states",
     "pairwise_merge",
     "process_sync",
+    "reduce_many",
     "reduce_over_axis",
     "reduce_states",
+    "reduce_states_per_leaf",
     "replicated",
+    "shard_map",
 ]

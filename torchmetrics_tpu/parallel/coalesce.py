@@ -1,0 +1,581 @@
+"""Coalesced sync plane — bucketed single-collective state synchronization.
+
+The per-leaf sync model (SURVEY §2.12, ``parallel/sync.py``) launches one
+collective per state leaf: a ``MetricCollection`` with K members × L leaves pays
+K·L collectives per sync, each with full launch latency. At metric-state scale
+the payloads are tiny (a handful of scalars and small vectors), so sync cost is
+dominated by per-leaf dispatch, not bytes — the classic case for bucketing many
+small cross-replica reductions into few large collectives (DrJAX, EQuARX).
+
+This module coalesces both host-driven planes and the in-graph plane:
+
+- **In-graph** (:func:`reduce_many`): all fixed-shape leaves of one or many
+  state dicts are raveled and concatenated into per-(reduction-class × dtype)
+  flat buckets — one ``lax.psum`` for sum/mean buckets (mean divides by the
+  static axis size afterwards), one ``lax.pmax``/``pmin``, and one
+  ``lax.all_gather`` per dtype for cat/custom leaves (each leaf's slice is
+  reshaped back to ``(world, *shape)`` so cat concatenation and custom
+  reductions see exactly what the per-leaf collective produced).
+
+- **Cross-process** (:func:`coalesced_process_sync`): ONE up-front
+  shape-metadata gather describes every leaf of every participating metric
+  (replacing the per-leaf shape round-trip inside ``gather_all_arrays``),
+  then ONE padded ``process_allgather`` per dtype bucket ships all leaves of
+  that dtype at once — uneven cat lengths across ranks are absorbed by the
+  metadata-driven padding/trimming. The per-leaf **merge semantics are
+  preserved exactly**: the gathered flat rows are split back into the same
+  per-(rank, leaf) arrays the per-leaf plane would have produced and folded
+  through the same ``_fold_gathered``/list-filter logic, so bucketed results
+  are bitwise identical to per-leaf results. Weighted-mean weight states are
+  ordinary ``"sum"`` leaves and ride the same sum bucket as their values.
+
+**Per-leaf fallback**: when the gathered metadata cannot be decoded
+consistently (e.g. an injected ``dist_sync_fn`` that rewrites payload values,
+or ranks disagreeing on the leaf table), :class:`CoalesceFallback` is raised
+and the caller re-runs the per-leaf plane. The decision is made from the
+*gathered* rows, which every rank sees identically, so a real fleet always
+falls back in lockstep — collectives never desynchronize. Transient infra
+errors are NOT converted to fallbacks; they propagate to the retry layer
+(``FlakyGather`` + ``RetryPolicy`` behave exactly as on the per-leaf plane,
+and no state is mutated until every bucket has gathered, so a faulty bucketed
+gather leaves the caller at its last good state).
+
+**Fleet-counter piggyback**: the metadata collective reserves a fixed section
+for the telemetry counters vector (:data:`~torchmetrics_tpu.observability.
+counters.COUNTER_FIELDS`, shipped as 31-bit halves like
+``gather_metadata_vector``). Every coalesced sync therefore refreshes a
+process-local mailbox of per-rank counter rows for free;
+``observability.gather_counters`` consumes it so a fleet
+``summary(fleet=True)`` after a sync adds zero extra collectives.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import observability as _observability
+from ..observability.counters import COUNTER_FIELDS
+
+Array = jax.Array
+Reduction = Union[str, Callable, None]
+
+_MAX_RANK = 8
+# shared dtype table (parallel/sync.py aliases this as _GATHER_DTYPES)
+GATHER_DTYPES = (
+    jnp.float32, jnp.float64, jnp.int32, jnp.int64,
+    jnp.bfloat16, jnp.float16, jnp.uint8, jnp.bool_,
+)
+
+_MAGIC = 0x436F414C  # "CoAL"
+_VERSION = 1
+_HEADER_LEN = 4  # [magic, version, n_leaves, n_counter_fields]
+_LEAF_REC_LEN = 2 + _MAX_RANK + 1  # [dtype_code, ndim, d0..d7, kind]
+_KIND_TENSOR = 0
+_KIND_LIST = 1
+
+# dtype sentinels inside the metadata collective (mirrors gather_all_arrays:
+# announcing problems IN the collective keeps every rank unblocked, then all
+# ranks raise the same error together)
+_CODE_EMPTY = -1  # zero-update list state: no data, dtype unknown on this rank
+_CODE_UNSUPPORTED = -2
+_CODE_RANK_OVERFLOW = -3
+_CODE_DIM_OVERFLOW = -4  # a dimension does not fit the int32 metadata encoding
+
+
+class CoalesceFallback(Exception):
+    """Internal control flow: the gathered metadata could not be decoded into a
+    consistent world plan — the caller must re-run the per-leaf plane. Never
+    raised for transient infra errors (those propagate to the retry layer)."""
+
+
+# ---------------------------------------------------------------------------
+# leaf table
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class _Leaf:
+    state_idx: int
+    name: str
+    fx: Reduction
+    is_list: bool
+    array: Optional[Any]  # list states pre-concatenated; None == no data
+    original: Any
+
+
+def _dtype_code(arr: Any) -> int:
+    dt = jnp.dtype(arr.dtype)
+    for i, cand in enumerate(GATHER_DTYPES):
+        if dt == jnp.dtype(cand):
+            return i
+    return _CODE_UNSUPPORTED
+
+
+def _prepare_leaves(
+    states: Sequence[Dict[str, Any]], reductions_list: Sequence[Mapping[str, Reduction]]
+) -> List[_Leaf]:
+    """Ordered leaf table over one or many state dicts. List ("cat") states are
+    pre-concatenated exactly like the per-leaf plane does before gathering."""
+    leaves: List[_Leaf] = []
+    for si, (state, reds) in enumerate(zip(states, reductions_list)):
+        for name, value in state.items():
+            fx = reds.get(name)
+            if isinstance(value, list):
+                arr = (
+                    jnp.concatenate([jnp.atleast_1d(jnp.asarray(v)) for v in value], axis=0)
+                    if value
+                    else None
+                )
+                leaves.append(_Leaf(si, name, fx, True, arr, value))
+            else:
+                leaves.append(_Leaf(si, name, fx, False, jnp.asarray(value), value))
+    return leaves
+
+
+def build_local_metadata(
+    states: Sequence[Dict[str, Any]],
+    reductions_list: Sequence[Mapping[str, Reduction]],
+    counters_vector: Optional[Sequence[int]] = None,
+) -> np.ndarray:
+    """This rank's metadata row: leaf shapes/dtypes plus the (always-reserved)
+    telemetry counters section, as one int32 vector. Fixed length across ranks
+    for a given leaf table — the collective needs no shape negotiation."""
+    return _encode_metadata(_prepare_leaves(states, reductions_list), counters_vector)
+
+
+def _encode_metadata(leaves: Sequence[_Leaf], counters_vector: Optional[Sequence[int]]) -> np.ndarray:
+    n_fields = len(COUNTER_FIELDS)
+    vec = np.zeros(_HEADER_LEN + len(leaves) * _LEAF_REC_LEN + 2 * n_fields, np.int32)
+    vec[0], vec[1], vec[2], vec[3] = _MAGIC, _VERSION, len(leaves), n_fields
+    for i, leaf in enumerate(leaves):
+        rec = vec[_HEADER_LEN + i * _LEAF_REC_LEN :]
+        if leaf.array is None:
+            rec[0], rec[1] = _CODE_EMPTY, 1  # zero-length; peers decide the rest
+        else:
+            arr = leaf.array
+            if arr.ndim > _MAX_RANK:
+                rec[0], rec[1] = _CODE_RANK_OVERFLOW, 1
+            elif any(s >= 1 << 31 for s in arr.shape):
+                # announced INSIDE the collective (like the other sentinels):
+                # a local pre-gather fallback would desynchronize the fleet —
+                # this way every rank sees the overflow and falls back together
+                rec[0], rec[1] = _CODE_DIM_OVERFLOW, 1
+            else:
+                rec[0] = _dtype_code(arr)
+                rec[1] = arr.ndim
+                for d, s in enumerate(arr.shape):
+                    rec[2 + d] = s
+        rec[2 + _MAX_RANK] = _KIND_LIST if leaf.is_list else _KIND_TENSOR
+    if counters_vector is not None:
+        vals = [int(v) for v in counters_vector]
+        if len(vals) != n_fields:
+            raise ValueError(f"counters vector must have {n_fields} entries, got {len(vals)}")
+        tail = vec[_HEADER_LEN + len(leaves) * _LEAF_REC_LEN :]
+        tail[0::2] = [v >> 31 for v in vals]
+        tail[1::2] = [v & 0x7FFFFFFF for v in vals]
+    return vec
+
+
+# ---------------------------------------------------------------------------
+# world plan (decoded from the gathered metadata rows)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class _LeafPlan:
+    dtype: Any  # resolved np/jnp dtype; None == every rank empty (leaf skipped)
+    dims: List[Tuple[int, ...]]  # per-rank shapes (empty ranks: zero-length)
+    counts: List[int]  # per-rank element counts
+
+
+@dataclasses.dataclass
+class _WorldPlan:
+    world: int
+    leaf_plans: List[_LeafPlan]
+    buckets: "Dict[Any, List[int]]"  # dtype -> leaf indices, first-appearance order
+    counter_rows: List[List[int]]  # per-rank counters decoded from the piggyback
+
+
+def _decode_rows(rows: Sequence[Any], n_leaves: int) -> List[np.ndarray]:
+    decoded = []
+    expect_len = _HEADER_LEN + n_leaves * _LEAF_REC_LEN + 2 * len(COUNTER_FIELDS)
+    for row in rows:
+        arr = np.asarray(row).ravel()
+        if arr.size != expect_len or not np.issubdtype(arr.dtype, np.integer):
+            raise CoalesceFallback("metadata row has unexpected length/dtype")
+        if int(arr[0]) != _MAGIC or int(arr[1]) != _VERSION or int(arr[2]) != n_leaves:
+            raise CoalesceFallback("metadata row failed validation")
+        decoded.append(arr.astype(np.int64))
+    return decoded
+
+
+def _plan_from_rows(rows: Sequence[Any], leaves: Sequence[_Leaf]) -> _WorldPlan:
+    decoded = _decode_rows(rows, len(leaves))
+    world = len(decoded)
+    leaf_plans: List[_LeafPlan] = []
+    buckets: Dict[Any, List[int]] = {}
+    for i, leaf in enumerate(leaves):
+        recs = [row[_HEADER_LEN + i * _LEAF_REC_LEN :][: _LEAF_REC_LEN] for row in decoded]
+        kinds = {int(r[2 + _MAX_RANK]) for r in recs}
+        if kinds != {_KIND_LIST if leaf.is_list else _KIND_TENSOR}:
+            raise CoalesceFallback("ranks disagree on the leaf kind table")
+        codes = sorted({int(r[0]) for r in recs})
+        if _CODE_DIM_OVERFLOW in codes:
+            # the per-leaf plane's int64 shape vector CAN express this — fall
+            # back (lockstep: every rank sees the sentinel in the same rows)
+            raise CoalesceFallback("a leaf dimension does not fit the metadata encoding")
+        if _CODE_RANK_OVERFLOW in codes:
+            raise ValueError(f"coalesced sync supports rank <= {_MAX_RANK} state leaves")
+        known = [c for c in codes if c >= 0]
+        if _CODE_UNSUPPORTED in codes:
+            raise ValueError(
+                f"coalesced sync got an unsupported dtype on at least one process; supported: "
+                f"{[str(jnp.dtype(d)) for d in GATHER_DTYPES]}"
+            )
+        if len(known) > 1:
+            raise ValueError(
+                "coalesced sync requires the same dtype on every process, got "
+                f"{[str(jnp.dtype(GATHER_DTYPES[c])) for c in known]}"
+            )
+        if not known:  # every rank empty: leaf keeps its local value
+            leaf_plans.append(_LeafPlan(None, [(0,)] * world, [0] * world))
+            continue
+        if any(not 0 <= c < len(GATHER_DTYPES) for c in known):
+            raise CoalesceFallback("metadata row carries an invalid dtype code")
+        dtype = jnp.dtype(GATHER_DTYPES[known[0]])
+        ndims = {int(r[1]) for r in recs if int(r[0]) >= 0}
+        if len(ndims) > 1:
+            raise ValueError(
+                f"coalesced sync requires equal ranks across processes, got {sorted(ndims)}"
+            )
+        ndim = ndims.pop()
+        if not 0 <= ndim <= _MAX_RANK:
+            raise CoalesceFallback("metadata row carries an invalid ndim")
+        template = next(
+            tuple(int(d) for d in r[2 : 2 + ndim]) for r in recs if int(r[0]) >= 0
+        )
+        dims: List[Tuple[int, ...]] = []
+        for r in recs:
+            if int(r[0]) >= 0:
+                shape = tuple(int(d) for d in r[2 : 2 + ndim])
+                if any(d < 0 for d in shape):
+                    raise CoalesceFallback("metadata row carries a negative dimension")
+                dims.append(shape)
+            else:  # empty contributor: zero length, peers' trailing dims
+                dims.append((0,) + template[1:] if ndim else ())
+        # empty contributors hold zero elements regardless of trailing dims
+        counts = [
+            0 if int(r[0]) < 0 else (int(np.prod(d)) if d else 1)
+            for r, d in zip(recs, dims)
+        ]
+        leaf_plans.append(_LeafPlan(dtype, dims, counts))
+        buckets.setdefault(dtype, []).append(i)
+    counter_rows = []
+    tail_at = _HEADER_LEN + len(leaves) * _LEAF_REC_LEN
+    for row in decoded:
+        halves = row[tail_at:]
+        counter_rows.append(
+            [(int(hi) << 31) | int(lo) for hi, lo in zip(halves[0::2], halves[1::2])]
+        )
+    return _WorldPlan(world=world, leaf_plans=leaf_plans, buckets=buckets, counter_rows=counter_rows)
+
+
+def build_bucket_payload(
+    states: Sequence[Dict[str, Any]],
+    reductions_list: Sequence[Mapping[str, Reduction]],
+    bucket_index: int,
+    metadata_rows: Sequence[Any],
+) -> Array:
+    """This rank's padded flat payload for bucket ``bucket_index`` under the
+    gathered ``metadata_rows`` — the replay API that lets a test fake simulate
+    each rank of a world deterministically."""
+    leaves = _prepare_leaves(states, reductions_list)
+    plan = _plan_from_rows(metadata_rows, leaves)
+    dtype = list(plan.buckets)[bucket_index]
+    return _local_bucket_flat(leaves, plan, dtype)
+
+
+def _local_bucket_flat(leaves: Sequence[_Leaf], plan: _WorldPlan, dtype: Any) -> Array:
+    parts = []
+    for li in plan.buckets[dtype]:
+        leaf = leaves[li]
+        if leaf.array is None:
+            continue  # zero elements — nothing to ship
+        parts.append(jnp.ravel(jnp.asarray(leaf.array)))
+    flat = (
+        jnp.concatenate(parts) if parts else jnp.zeros((0,), dtype)
+    ).astype(dtype)
+    totals = [
+        sum(plan.leaf_plans[li].counts[r] for li in plan.buckets[dtype])
+        for r in range(plan.world)
+    ]
+    pad = max(totals) - int(flat.shape[0])
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), dtype)])
+    return flat
+
+
+# ---------------------------------------------------------------------------
+# cross-process coalesced sync (plane 2)
+# ---------------------------------------------------------------------------
+
+
+def process_rows(value: Any) -> List[Any]:
+    """Per-process rows of one real ``process_allgather`` — normalized for the
+    world of one, where process_allgather returns the input UNSTACKED (shared
+    by both sync planes; the single place that knows this quirk)."""
+    value = jnp.asarray(value)
+    if jax.process_count() == 1:
+        return [value]
+    from jax.experimental import multihost_utils
+
+    stacked = multihost_utils.process_allgather(value, tiled=False)
+    return [stacked[i] for i in range(stacked.shape[0])]
+
+
+def _make_gather(process_group: Any, dist_sync_fn: Optional[Callable]) -> Callable:
+    if dist_sync_fn is not None:
+        def gather(arr):
+            return [jnp.asarray(r) for r in dist_sync_fn(jnp.asarray(arr), process_group)]
+
+        return gather
+    return process_rows
+
+
+def coalesced_process_sync(
+    states: Sequence[Dict[str, Any]],
+    reductions_list: Sequence[Mapping[str, Reduction]],
+    process_group: Any = None,
+    dist_sync_fn: Optional[Callable] = None,
+) -> List[Dict[str, Any]]:
+    """Synchronize one or many state dicts across processes with one metadata
+    collective plus one padded gather per dtype bucket.
+
+    Returns new state dicts (inputs untouched — callers commit atomically, so
+    any failure leaves every metric at its last good state). Raises
+    :class:`CoalesceFallback` when the gathered metadata is unusable; the
+    caller then re-runs the per-leaf plane.
+    """
+    from . import sync as _sync  # lazy: sync.py imports this module at top level
+
+    leaves = _prepare_leaves(states, reductions_list)
+    rec = _observability._ACTIVE
+    counters_vec = None
+    if rec is not None and dist_sync_fn is None:
+        counters_vec = rec.counters.counts_vector()
+    meta = _encode_metadata(leaves, counters_vec)
+    gather = _make_gather(process_group, dist_sync_fn)
+    try:
+        rows = gather(meta)  # collective #1: the single up-front shape/metadata gather
+    except Exception as err:
+        # an injected gather written against the documented per-leaf seam may
+        # choke on the metadata vector (asserts on dtype/shape of state leaves)
+        # — deterministic failures fall back to the per-leaf plane it was
+        # written for. Transient errors (FlakyGather & friends) and anything
+        # from a REAL collective still propagate to the retry layer: a local
+        # fallback there would desynchronize the fleet / bypass retry.
+        from ..reliability.retry import TRANSIENT, classify_exception
+
+        if dist_sync_fn is not None and classify_exception(err) != TRANSIENT:
+            raise CoalesceFallback(f"injected gather rejected the metadata vector: {err!r}") from err
+        raise
+    if rec is not None:  # launch-time counting: fallbacks keep their collectives
+        rec.counters.record_sync_collectives(1)
+    plan = _plan_from_rows(rows, leaves)
+    if dist_sync_fn is None:
+        _deposit_fleet_rows(plan, rec)
+    per_leaf_gathered: List[Optional[List[Array]]] = [None] * len(leaves)
+    for dtype, leaf_idxs in plan.buckets.items():
+        flat = _local_bucket_flat(leaves, plan, dtype)
+        rows_b = gather(flat)  # one collective serves every leaf of this dtype
+        if rec is not None:
+            rec.counters.record_sync_collectives(1)
+        if len(rows_b) != plan.world:
+            raise CoalesceFallback("bucket gather returned a different world size than the metadata")
+        for r in range(plan.world):
+            offset = 0
+            row = jnp.asarray(rows_b[r])
+            for li in leaf_idxs:
+                lp = plan.leaf_plans[li]
+                n = lp.counts[r]
+                seg = row[offset : offset + n].reshape(lp.dims[r])
+                offset += n
+                if per_leaf_gathered[li] is None:
+                    per_leaf_gathered[li] = []
+                per_leaf_gathered[li].append(seg)
+    outs = [dict(s) for s in states]
+    for leaf, gathered in zip(leaves, per_leaf_gathered):
+        if gathered is None:
+            continue  # every rank empty: keep the local value (per-leaf semantics)
+        if leaf.is_list:
+            vals = [g for g in gathered if g.shape[0] > 0]
+            outs[leaf.state_idx][leaf.name] = vals or leaf.original
+        else:
+            outs[leaf.state_idx][leaf.name] = _sync._fold_gathered(gathered, leaf.fx)
+    if rec is not None:
+        rec.counters.record_coalesced(sum(1 for g in per_leaf_gathered if g is not None))
+    return outs
+
+
+# ---------------------------------------------------------------------------
+# fleet-counter piggyback mailbox
+# ---------------------------------------------------------------------------
+
+_FLEET_MAILBOX: Dict[str, Any] = {"session_epoch": None, "rows": None, "local_index": None}
+
+
+def _deposit_fleet_rows(plan: _WorldPlan, rec: Any) -> None:
+    if rec is None:
+        return
+    # keyed on the session EPOCH, not id(rec): a dead recorder's id can be
+    # reused by the next allocation, which would leak stale rows cross-session
+    _FLEET_MAILBOX["session_epoch"] = getattr(rec, "_epoch", None)
+    _FLEET_MAILBOX["rows"] = [list(r) for r in plan.counter_rows]
+    _FLEET_MAILBOX["local_index"] = jax.process_index()
+
+
+def fleet_counter_rows() -> Optional[Tuple[List[List[int]], int]]:
+    """Per-rank counter rows captured by the last coalesced sync's metadata
+    collective, plus this process's index — or ``None`` when no coalesced sync
+    ran under the currently active telemetry session. Remote rows are as of
+    each rank's last sync (a rank without an active session contributes
+    zeros); the consumer replaces the local row with a fresh snapshot."""
+    rec = _observability._ACTIVE
+    if (
+        rec is None
+        or _FLEET_MAILBOX["rows"] is None
+        or _FLEET_MAILBOX["session_epoch"] is None
+        or _FLEET_MAILBOX["session_epoch"] != getattr(rec, "_epoch", None)
+    ):
+        return None
+    rows = _FLEET_MAILBOX["rows"]
+    if any(len(r) != len(COUNTER_FIELDS) for r in rows):
+        return None
+    return [list(r) for r in rows], int(_FLEET_MAILBOX["local_index"])
+
+
+def clear_fleet_mailbox() -> None:
+    _FLEET_MAILBOX.update({"session_epoch": None, "rows": None, "local_index": None})
+
+
+def gather_host_rows(
+    vector: Any, process_group: Any = None, dist_sync_fn: Optional[Callable] = None
+) -> List[np.ndarray]:
+    """One-collective gather of a fixed-length host metadata vector (equal
+    length on every rank by contract — no shape negotiation, unlike
+    ``gather_all_arrays``' two-collective shape-then-payload dance)."""
+    gather = _make_gather(process_group, dist_sync_fn)
+    return [np.asarray(r) for r in gather(np.asarray(vector))]
+
+
+# ---------------------------------------------------------------------------
+# in-graph bucketed reduction (plane 1)
+# ---------------------------------------------------------------------------
+
+_NUMERIC_CLASS = {"sum": "sum", "mean": "sum", "max": "max", "min": "min"}
+_NUMERIC_OP = {"sum": jax.lax.psum, "max": jax.lax.pmax, "min": jax.lax.pmin}
+
+
+def reduce_many(
+    pairs: Sequence[Tuple[Dict[str, Any], Mapping[str, Reduction]]],
+    axis_name: Union[str, Sequence[str]],
+) -> List[Dict[str, Any]]:
+    """Reduce every leaf of one or many state dicts across a named mesh axis
+    with one collective per (reduction-class × dtype) bucket. Call inside
+    ``shard_map``; shapes are static so no metadata exchange is needed.
+
+    Produces exactly what the per-leaf ``reduce_over_axis`` would: psum/pmax/
+    pmin are elementwise, so reducing the concatenated flat bucket and slicing
+    back is bitwise identical; cat/custom leaves are recovered from their
+    all-gathered slice as ``(world, *shape)`` before tiling/applying ``fx``.
+    """
+    outs = [dict(s) for s, _ in pairs]
+    numeric: Dict[Tuple[str, Any], List[Tuple[int, str, Any, Reduction]]] = {}
+    gathered: Dict[Any, List[Tuple[int, str, Any, Reduction, str]]] = {}
+    for pi, (state, reds) in enumerate(pairs):
+        for name, value in state.items():
+            fx = reds.get(name)
+            if fx is None:
+                continue  # passthrough (per-leaf semantics)
+            if callable(fx):
+                gathered.setdefault(jnp.asarray(value).dtype, []).append(
+                    (pi, name, jnp.asarray(value), fx, "custom")
+                )
+            elif fx in _NUMERIC_CLASS:
+                arr = jnp.asarray(value)
+                numeric.setdefault((_NUMERIC_CLASS[fx], arr.dtype), []).append((pi, name, arr, fx))
+            elif fx == "cat":
+                arr = jnp.atleast_1d(jnp.asarray(value))
+                gathered.setdefault(arr.dtype, []).append((pi, name, arr, fx, "cat"))
+            else:
+                raise ValueError(f"Unknown dist_reduce_fx: {fx!r}")
+    axis_size = None
+    for (cls, dtype), leaves in numeric.items():
+        flat = jnp.concatenate([jnp.ravel(arr) for _, _, arr, _ in leaves])
+        red = _NUMERIC_OP[cls](flat, axis_name)
+        offset = 0
+        for pi, name, arr, fx in leaves:
+            n = int(np.prod(arr.shape)) if arr.shape else 1
+            seg = red[offset : offset + n].reshape(arr.shape)
+            offset += n
+            if fx == "mean":
+                if axis_size is None:
+                    axis_size = jax.lax.psum(1, axis_name)  # static: constant-folded
+                seg = seg / axis_size
+            outs[pi][name] = seg
+    for dtype, leaves in gathered.items():
+        flat = jnp.concatenate([jnp.ravel(arr) for _, _, arr, _, _ in leaves])
+        g = jax.lax.all_gather(flat, axis_name, axis=0, tiled=False)  # (world, L)
+        world = g.shape[0]
+        offset = 0
+        for pi, name, arr, fx, mode in leaves:
+            n = int(np.prod(arr.shape)) if arr.shape else 1
+            seg = g[:, offset : offset + n].reshape((world,) + arr.shape)
+            offset += n
+            if mode == "cat":
+                outs[pi][name] = seg.reshape((world * arr.shape[0],) + arr.shape[1:])
+            else:
+                outs[pi][name] = fx(seg)
+    return outs
+
+
+def collective_counts(
+    states: Sequence[Dict[str, Any]], reductions_list: Sequence[Mapping[str, Reduction]]
+) -> Dict[str, int]:
+    """Static collective-count model for a sync of these states: how many
+    collectives each plane launches, coalesced vs per-leaf (for benches/docs —
+    no communication happens here)."""
+    in_graph_buckets: set = set()
+    process_buckets: set = set()
+    n_leaves = 0
+    per_leaf_in_graph = 0
+    for state, reds in zip(states, reductions_list):
+        for name, value in state.items():
+            fx = reds.get(name)
+            n_leaves += 1
+            if isinstance(value, list):
+                arr = jnp.asarray(value[0]) if value else None
+            else:
+                arr = jnp.asarray(value)
+            if arr is not None:
+                process_buckets.add(str(arr.dtype))
+            if fx is None:
+                continue
+            per_leaf_in_graph += 1
+            if callable(fx) or fx == "cat":
+                in_graph_buckets.add(("gather", str(arr.dtype) if arr is not None else "?"))
+            else:
+                in_graph_buckets.add((_NUMERIC_CLASS[fx], str(arr.dtype)))
+    return {
+        "leaves": n_leaves,
+        "in_graph_coalesced": len(in_graph_buckets),
+        "in_graph_per_leaf": per_leaf_in_graph,
+        "process_coalesced": 1 + len(process_buckets),  # metadata + one per dtype
+        # gather_all_arrays pays a shape exchange + a payload gather per leaf
+        "process_per_leaf": 2 * n_leaves,
+    }

@@ -15,6 +15,24 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 DEFAULT_AXIS = "metrics_dp"
 
 
+def shard_map(f, mesh: Mesh, in_specs, out_specs, check_vma: Optional[bool] = None):
+    """``jax.shard_map`` across jax versions.
+
+    Newer jax exposes ``jax.shard_map(..., check_vma=)``; older releases only
+    have ``jax.experimental.shard_map.shard_map(..., check_rep=)`` (same knob,
+    earlier name). Every in-repo shard_map site goes through this helper so the
+    sharded planes run on either runtime. ``check_vma=None`` keeps the
+    runtime's own default.
+    """
+    if hasattr(jax, "shard_map"):
+        kwargs = {} if check_vma is None else {"check_vma": check_vma}
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    kwargs = {} if check_vma is None else {"check_rep": check_vma}
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs)
+
+
 def make_data_mesh(n_devices: Optional[int] = None, axis_name: str = DEFAULT_AXIS) -> Mesh:
     """1-D data-parallel mesh over the first ``n_devices`` devices."""
     devs = jax.devices()[: (n_devices or len(jax.devices()))]

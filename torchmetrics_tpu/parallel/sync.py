@@ -12,12 +12,18 @@ TPU-native model — three sync planes, all driven by the same per-state reducti
    barrier+shape-gather+pad dance (reference utilities/distributed.py:100-153); XLA
    lowers these onto ICI collectives directly.
 2. **Cross-process** (``process_sync``): multi-controller JAX (one process per host,
-   torchmetrics' usage pattern) — ``multihost_utils.process_allgather`` per state then a
-   host-side fold with the registered merge. Used by ``Metric.sync()`` when
+   torchmetrics' usage pattern) — ``multihost_utils.process_allgather`` + host-side
+   fold with the registered merge. Used by ``Metric.sync()`` when
    ``jax.process_count() > 1``.
 3. **Commless** (``merge_states``): pure pytree fold of two state dicts — the
    reference's ``merge_state`` (metric.py:404) — also the building block for tree
    reductions of gathered custom states.
+
+Planes 1 and 2 are **coalesced** (``parallel/coalesce.py``): all leaves ride one
+collective per (reduction-class × dtype) bucket — K·L per-leaf collectives
+collapse to a handful per sync — with the per-leaf plane kept as the bitwise
+parity oracle and automatic fallback (``reduce_states_per_leaf``,
+``_process_sync_per_leaf``). See docs/distributed.md, "Coalesced synchronization".
 """
 
 from __future__ import annotations
@@ -29,6 +35,7 @@ import jax.numpy as jnp
 
 from .. import observability as _observability
 from ..observability import tracing as _tracing
+from . import coalesce as _coalesce
 
 Array = jax.Array
 Reduction = Union[str, Callable, None]
@@ -124,7 +131,19 @@ def reduce_over_axis(value: Array, fx: Reduction, axis_name: Union[str, Sequence
 def reduce_states(
     state: Dict[str, Any], reductions: Mapping[str, Reduction], axis_name: Union[str, Sequence[str]]
 ) -> Dict[str, Any]:
-    """Reduce a whole state dict across a mesh axis (in-graph)."""
+    """Reduce a whole state dict across a mesh axis (in-graph), **coalesced**:
+    all leaves ride one collective per (reduction-class × dtype) bucket instead
+    of one per leaf (``parallel/coalesce.py``). Bitwise-equal to the per-leaf
+    plane — psum/pmax/pmin are elementwise and gather slices are restored to
+    the exact per-leaf layout before cat/custom folding."""
+    return _coalesce.reduce_many([(state, reductions)], axis_name)[0]
+
+
+def reduce_states_per_leaf(
+    state: Dict[str, Any], reductions: Mapping[str, Reduction], axis_name: Union[str, Sequence[str]]
+) -> Dict[str, Any]:
+    """Reference per-leaf plane (one collective per leaf) — kept as the parity
+    oracle for the coalesced path and for debugging collective layouts."""
     return {k: reduce_over_axis(v, reductions.get(k), axis_name) for k, v in state.items()}
 
 
@@ -143,7 +162,7 @@ def distributed_available() -> bool:
 
 
 _GATHER_MAX_RANK = 8
-_GATHER_DTYPES = (jnp.float32, jnp.float64, jnp.int32, jnp.int64, jnp.bfloat16, jnp.float16, jnp.uint8, jnp.bool_)
+_GATHER_DTYPES = _coalesce.GATHER_DTYPES  # single source for both planes
 
 
 def gather_all_arrays(value: Optional[Array], process_group: Any = None) -> List[Array]:
@@ -160,6 +179,8 @@ def gather_all_arrays(value: Optional[Array], process_group: Any = None) -> List
     """
     import numpy as np
     from jax.experimental import multihost_utils
+
+    _rows = _coalesce.process_rows  # world-of-one-normalized process_allgather
 
     vec = np.full(_GATHER_MAX_RANK + 2, -1, np.int64)
     if value is not None:
@@ -198,8 +219,7 @@ def gather_all_arrays(value: Optional[Array], process_group: Any = None) -> List
     if rank == 0:
         if value is None:
             value = jnp.zeros((), dtype)  # scalar states can't signal emptiness; contribute zero
-        stacked = multihost_utils.process_allgather(value, tiled=False)
-        return [stacked[i] for i in range(stacked.shape[0])]
+        return _rows(value)
     template = shapes[known_rows[0], 1 : 1 + rank].astype(np.int64)
     dims = np.tile(template, (world, 1))
     for i in range(world):
@@ -210,8 +230,7 @@ def gather_all_arrays(value: Optional[Array], process_group: Any = None) -> List
     if value is None:
         value = jnp.zeros(tuple(int(d) for d in dims[jax.process_index()]), dtype)
     if (dims == dims[0]).all():
-        stacked = multihost_utils.process_allgather(value, tiled=False)
-        return [stacked[i] for i in range(stacked.shape[0])]
+        return _rows(value)
     max_dims = dims.max(axis=0)
     pad = [(0, int(m) - int(s)) for m, s in zip(max_dims, value.shape)]
     stacked = multihost_utils.process_allgather(jnp.pad(value, pad), tiled=False)
@@ -236,30 +255,55 @@ def process_sync(
     coordination-service faults do; a one-rank mid-collective abort needs the
     cluster-level restart path instead).
     """
-    gather = dist_sync_fn or gather_all_arrays
     rec = _observability._ACTIVE
     if rec is not None:
         rec.counters.record_sync(_payload_bytes(state))
-    out: Dict[str, Any] = {}
     with _tracing.trace_span("process_sync"):
-        for name, value in state.items():
-            fx = reductions.get(name)
-            if rec is not None:
-                rec.counters.record_gather()
-            if isinstance(value, list):  # concat list state: pre-concat, then gather
-                local = (
-                    jnp.concatenate([jnp.atleast_1d(jnp.asarray(v)) for v in value], axis=0)
-                    if value
-                    else None  # zero-update process still participates in the collective
-                )
-                if local is None and dist_sync_fn is not None:
-                    # injected gathers keep the plain fn(value, group) contract
-                    local = jnp.zeros((0,), jnp.float32)
-                gathered = gather(local, process_group)
-                out[name] = [g for g in gathered if g.shape[0] > 0] or value
-                continue
-            gathered = gather(value, process_group)
-            out[name] = _fold_gathered(gathered, fx)
+        try:
+            # coalesced fast path: one metadata collective + one padded gather
+            # per dtype bucket serves every leaf at once; per-leaf merge
+            # semantics preserved exactly (parallel/coalesce.py)
+            return _coalesce.coalesced_process_sync(
+                [state], [reductions], process_group=process_group, dist_sync_fn=dist_sync_fn
+            )[0]
+        except _coalesce.CoalesceFallback:
+            # undecodable/inconsistent metadata (e.g. an injected gather that
+            # rewrites values): every rank sees the same gathered rows, so the
+            # whole fleet falls back to the per-leaf plane in lockstep
+            return _process_sync_per_leaf(state, reductions, process_group, dist_sync_fn)
+
+
+def _process_sync_per_leaf(
+    state: Dict[str, Any],
+    reductions: Mapping[str, Reduction],
+    process_group: Any = None,
+    dist_sync_fn: Optional[Callable] = None,
+) -> Dict[str, Any]:
+    """The per-leaf plane: one ``gather_all_arrays`` per state leaf."""
+    gather = dist_sync_fn or gather_all_arrays
+    rec = _observability._ACTIVE
+    out: Dict[str, Any] = {}
+    for name, value in state.items():
+        fx = reductions.get(name)
+        if rec is not None:
+            rec.counters.record_gather()
+            # the real gather_all_arrays launches TWO collectives per leaf
+            # (shape-vector exchange + payload); an injected fn is one call
+            rec.counters.record_sync_collectives(1 if dist_sync_fn is not None else 2)
+        if isinstance(value, list):  # concat list state: pre-concat, then gather
+            local = (
+                jnp.concatenate([jnp.atleast_1d(jnp.asarray(v)) for v in value], axis=0)
+                if value
+                else None  # zero-update process still participates in the collective
+            )
+            if local is None and dist_sync_fn is not None:
+                # injected gathers keep the plain fn(value, group) contract
+                local = jnp.zeros((0,), jnp.float32)
+            gathered = gather(local, process_group)
+            out[name] = [g for g in gathered if g.shape[0] > 0] or value
+            continue
+        gathered = gather(value, process_group)
+        out[name] = _fold_gathered(gathered, fx)
     return out
 
 
@@ -272,14 +316,21 @@ def gather_metadata_vector(
     vectors, indexed by process.
 
     This is the fleet-telemetry rollup plane: counter snapshots ride the SAME
-    gather machinery as metric states (``dist_sync_fn`` stays the injection
-    seam), but the payload is metadata-sized — a handful of integers per rank,
-    never state data. Values ship as (hi, lo) 31-bit int32 halves: with jax's
-    default x64-disabled config ``jnp.asarray`` silently downcasts int64 to
-    int32, which would wrap byte/time counters past 2**31 (a >2 GiB cumulative
-    sync payload is a normal afternoon on a pod). The split keeps every value
-    below 2**62 exact on any config. Single-process (and no injected gather):
-    the local vector comes straight back without touching a device.
+    coalesced gather plane as metric states (``dist_sync_fn`` stays the
+    injection seam), but the payload is metadata-sized — a handful of integers
+    per rank, never state data. The vector has the same length on every rank
+    by contract, so it ships through ``coalesce.gather_host_rows`` as ONE
+    collective (no per-leaf shape round-trip — ``gather_all_arrays`` would pay
+    a shape collective first). Values ship as (hi, lo) 31-bit int32 halves:
+    with jax's default x64-disabled config ``jnp.asarray`` silently downcasts
+    int64 to int32, which would wrap byte/time counters past 2**31 (a >2 GiB
+    cumulative sync payload is a normal afternoon on a pod). The split keeps
+    every value below 2**62 exact on any config. Single-process (and no
+    injected gather): the local vector comes straight back without touching a
+    device. Note that a coalesced sync already ships the active session's
+    counter vector inside its metadata collective — ``observability.
+    gather_counters`` reuses those rows, so a fleet rollup right after a sync
+    calls this function not at all.
     """
     import numpy as np
 
@@ -288,13 +339,11 @@ def gather_metadata_vector(
         raise ValueError(f"gather_metadata_vector values must be in [0, 2**62), got {vals}")
     if dist_sync_fn is None and not distributed_available():
         return [vals]
-    gather = dist_sync_fn or gather_all_arrays
     halves = np.empty(2 * len(vals), np.int32)
     halves[0::2] = [v >> 31 for v in vals]
     halves[1::2] = [v & 0x7FFFFFFF for v in vals]
     out: List[List[int]] = []
-    for g in gather(jnp.asarray(halves), process_group):
-        row = np.asarray(g)
+    for row in _coalesce.gather_host_rows(halves, process_group, dist_sync_fn):
         out.append([(int(hi) << 31) | int(lo) for hi, lo in zip(row[0::2], row[1::2])])
     return out
 

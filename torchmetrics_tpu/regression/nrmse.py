@@ -95,7 +95,7 @@ class NormalizedRootMeanSquaredError(Metric):
 
         gathered = {k: jax.lax.all_gather(state[k], axis_name, axis=0) for k in _KEYS}
         acc = {k: gathered[k][0] for k in _KEYS}
-        for i in range(1, jax.lax.axis_size(axis_name)):
+        for i in range(1, jax.lax.psum(1, axis_name)):  # static axis size (folds at trace)
             acc = self._merge(acc, {k: gathered[k][i] for k in _KEYS})
         return acc
 
