@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import subprocess
 import sys
 import time
@@ -48,6 +49,7 @@ WARMUP = 5
 ITERS = 400
 
 TELEMETRY_PROBE_STEPS = 8
+LATENCY_PROBE_STEPS = 24  # enough samples for a meaningful p99 column
 
 
 def _telemetry_probe(probe) -> dict:
@@ -75,6 +77,34 @@ def _telemetry_probe(probe) -> dict:
         return out
     except Exception as err:  # a probe failure must not cost the config its number
         return {"error": f"{type(err).__name__}: {err}"[:240]}
+
+
+def _latency_probe(probe, spec: dict) -> dict:
+    """Latency percentile columns from a short blocking-timing re-run.
+
+    ``spec`` maps a histogram kind to the percentiles to emit, e.g.
+    ``{"update": ("p50", "p99"), "sync": ("p99",)}`` →
+    ``update_p50_us / update_p99_us / sync_p99_us``. A separate session from
+    ``_telemetry_probe`` because honest per-call latency needs
+    ``block_until_ready`` (which serializes the pipeline) and must never leak
+    into the throughput-probe counters. The timed headline loops stay
+    un-instrumented either way."""
+    from torchmetrics_tpu import observability as obs
+
+    try:
+        with obs.telemetry_session(obs.TelemetryConfig(block_until_ready=True)) as rec:
+            probe()
+        lat = rec.latency_summary()
+        out = {}
+        for kind, percentiles in spec.items():
+            block = lat.get(kind, {})
+            for p in percentiles:
+                val = block.get(f"{p}_us")
+                if val is not None:
+                    out[f"{kind}_{p}_us"] = val
+        return out
+    except Exception as err:  # a probe failure must not cost the config its number
+        return {"latency_probe_error": f"{type(err).__name__}: {err}"[:240]}
 
 
 def bench_ours() -> dict:
@@ -107,7 +137,16 @@ def bench_ours() -> dict:
         jax.block_until_ready(m._state)
         return m
 
-    return {"updates_per_sec": round(best, 2), "telemetry": _telemetry_probe(probe)}
+    def latency_probe():
+        m = MulticlassAccuracy(num_classes=NUM_CLASSES, average="micro", validate_args=False)
+        for _ in range(LATENCY_PROBE_STEPS):
+            m.update(preds, target)
+
+    out = {"updates_per_sec": round(best, 2), "telemetry": _telemetry_probe(probe)}
+    # flagship per-update latency distribution: the columns the regression
+    # gate watches for tail blowups the throughput mean would average away
+    out.update(_latency_probe(latency_probe, {"update": ("p50", "p99")}))
+    return out
 
 
 def bench_torch_baseline() -> dict:
@@ -545,11 +584,25 @@ def bench_collection_sync() -> dict:
         jax.block_until_ready(out)
         t[key] = round((time.perf_counter() - start) / 50 * 1000, 3)
 
+    # latency percentile columns: per-member update p50/p99 and collection
+    # sync p99 under blocking timing (the shapes are warm — this measures
+    # steady-state dispatch+device latency, not compiles)
+    def latency_probe():
+        for _ in range(8):
+            collection.update(preds, target)
+        for m in collection.values():
+            jax.block_until_ready(m._state)
+        collection.sync(distributed_available=force_dist)
+        collection.unsync()
+
+    latency_cols = _latency_probe(latency_probe, {"update": ("p50", "p99"), "sync": ("p99",)})
+
     plan = coalesce.collective_counts(states, reductions)
     return {
         "collectives_per_sync": brief["collectives_per_sync"],
         "leaves_coalesced_per_sync": brief["gathers_coalesced"],
         "per_leaf_collectives": plan["process_per_leaf"],
+        **latency_cols,
         "host_sync_coalesced_ms": round(coalesced_ms, 3),
         "host_sync_per_leaf_ms": round(per_leaf_ms, 3),
         "ingraph_coalesced_ms": t["ingraph_coalesced_ms"],
@@ -586,6 +639,42 @@ CONFIGS = {
 MAX_ATTEMPTS = 3  # 2 retries — bounds a flaky pod's wall-clock to ~3x one config
 
 
+# "ValueError:" / "jax.errors.JaxRuntimeError:" — the exception-report shape a
+# python traceback (or a crash handler quoting one) puts at line start; the
+# candidate form also accepts a BARE name at end of line ("MemoryError" — the
+# message-less OOM shape), which must still qualify as a headline
+_ERROR_TOKEN_RE = re.compile(r"(?:[A-Za-z_][\w.]*\.)?[A-Z][A-Za-z0-9_]*(?:Error|Exception)\s*:")
+_ERROR_LINE_RE = re.compile(r"(?:[A-Za-z_][\w.]*\.)?[A-Z][A-Za-z0-9_]*(?:Error|Exception)\s*(?::|$)")
+
+
+def _crash_headline(crash_text: str) -> str:
+    """The one line worth reporting from a crashed subprocess's output.
+
+    Hardened against the two ways BENCH_r05's fid report got mangled:
+    (1) log capture can collapse a whole traceback onto ONE line with " | "
+    joiners — those are treated as line breaks, so the headline is never a
+    240-char soup ending in a truncated JAX footer; (2) a crash handler can
+    chain exception reports into one line ("IndexError: ...: jax.errors.
+    JaxRuntimeError: INTERNAL: ...") — the INNERMOST report wins, because the
+    outer ones are artifacts of whatever caught the real error. Among
+    candidate lines, one the reliability classifier calls transient is
+    preferred over a later deterministic artifact (a chained traceback's
+    secondary `IndexError` must not shadow the root-cause infra fault)."""
+    lines = []
+    for raw in crash_text.splitlines():
+        lines.extend(seg.strip() for seg in raw.split(" | "))
+    lines = [l for l in lines if l]
+    candidates = [l for l in reversed(lines) if _ERROR_LINE_RE.search(l) or _is_transient_error_text(l)]
+    headline = next(
+        (l for l in candidates if _is_transient_error_text(l)),
+        candidates[0] if candidates else (lines[-1] if lines else "subprocess produced no output"),
+    )
+    matches = list(_ERROR_TOKEN_RE.finditer(headline))
+    if len(matches) > 1:
+        headline = headline[matches[-1].start():].strip()
+    return headline
+
+
 def _crash_report(res) -> dict:
     """A config subprocess died before printing its JSON line (the BENCH_r05
     fid failure mode: a remote-compile infra error truncates stdout and the
@@ -593,13 +682,8 @@ def _crash_report(res) -> dict:
     Pick the actual error line out of the crash text and classify it through
     the reliability classifier so the retry loop can act on it."""
     crash_text = ((res.stderr or "") + "\n" + (res.stdout or "")).strip()
-    lines = [l.strip() for l in crash_text.splitlines() if l.strip()]
-    headline = next(
-        (l for l in reversed(lines) if "Error" in l or _is_transient_error_text(l)),
-        lines[-1] if lines else "subprocess produced no output",
-    )
     return {
-        "error": headline[:240],
+        "error": _crash_headline(crash_text)[:240],
         "transient": _is_transient_error_text(crash_text),
     }
 
@@ -718,6 +802,12 @@ def main() -> None:
     for name in ("ours", "torch_baseline"):  # surface failures instead of a bare null
         if "error" in results[name]:
             extra[f"{name}_error"] = results[name]["error"]
+    # flagship latency columns ride extra so bench_compare gates them (the
+    # "ours" block itself never lands in the JSON line); a probe failure is
+    # surfaced rather than silently disarming the p99 gate columns
+    for col in ("update_p50_us", "update_p99_us", "latency_probe_error"):
+        if col in results["ours"]:
+            extra[col] = results["ours"][col]
     extra["torch_cpu_proxy_updates_per_sec"] = baseline
     extra["vs_baseline_note"] = "torch-CPU proxy (no CUDA device in pod; BASELINE.md north star is vs CUDA GPU)"
     parsed = {

@@ -215,6 +215,155 @@ def test_cli_rejects_single_round(tmp_path):
     assert res.returncode == 2 and "at least two" in res.stderr
 
 
+# -------------------------------------------- latency percentile columns gate
+
+
+def test_latency_percentile_columns_direction_and_gate(tmp_path):
+    """The health-plane bench columns (update_p50_us/update_p99_us/sync_p99_us)
+    gate as latencies: a p99 blowup trips --check; absence in older rounds is
+    'new', never a regression."""
+    assert bench_compare.direction("extra.update_p99_us") == "lower"
+    assert bench_compare.direction("extra.collection_sync_16metrics.sync_p99_us") == "lower"
+    # registered thresholds exist for every emitted column
+    for name in (
+        "extra.update_p50_us", "extra.update_p99_us",
+        "extra.collection_sync_16metrics.update_p50_us",
+        "extra.collection_sync_16metrics.update_p99_us",
+        "extra.collection_sync_16metrics.sync_p99_us",
+    ):
+        assert name in bench_compare.THRESHOLDS
+    cols = lambda p99: {"update_p50_us": 450.0, "update_p99_us": p99,
+                        "collection_sync_16metrics": {"sync_p99_us": 40000.0,
+                                                      "collectives_per_sync": 2.0}}
+    old = _round(1, 29500.0)  # pre-health-plane round: no latency columns
+    good = _round(2, 29500.0, extra_overrides=cols(900.0))
+    bad = _round(3, 29500.0, extra_overrides=cols(9000.0))  # 10x p99 blowup
+    paths = _write_rounds(tmp_path, [old, good, bad])
+    res = _cli([BENCH_COMPARE, *paths, "--check"])
+    assert res.returncode == 1, res.stdout + res.stderr
+    assert "update_p99_us" in res.stdout
+    report = bench_compare.compare_rounds(paths)
+    first = {r["metric"]: r for r in report["transitions"][0]["rows"]}
+    assert first["extra.update_p99_us"]["verdict"] == "new"  # no history: no gate
+    # steady columns pass
+    (tmp_path / "ok").mkdir()
+    steady = _write_rounds(tmp_path / "ok", [good, _round(3, 29500.0, extra_overrides=cols(980.0))])
+    assert _cli([BENCH_COMPARE, *steady, "--check"]).returncode == 0
+
+
+# ------------------------------------------------- bench crash-report harden
+
+
+BENCH = os.path.join(REPO, "bench.py")
+
+# the exact mangled headline BENCH_r05 recorded for fid_inception_fwd — the
+# whole collapsed crash text arrived as ONE " | "-joined line and the old
+# extractor reported it (IndexError artifact + truncated JAX footer) verbatim
+R05_FID_STDOUT = (
+    "IndexError: list index out of range: jax.errors.JaxRuntimeError: INTERNAL: "
+    "http://127.0.0.1:8083/remote_compile: read body: response body closed before "
+    "all bytes were read | -------------------- | For simplicity, JAX has removed "
+    "its interna"
+)
+
+
+class _Res:
+    def __init__(self, stdout="", stderr=""):
+        self.stdout, self.stderr = stdout, stderr
+
+
+def test_crash_report_r05_fid_fixture():
+    """Acceptance (satellite): the exact r05 stdout now yields the clean
+    {"error": <root cause>, "transient": true} shape — innermost exception,
+    no " | " soup, no secondary-IndexError artifact."""
+    bench = _load(BENCH)
+    out = bench._crash_report(_Res(stdout=R05_FID_STDOUT))
+    assert out == {
+        "error": "jax.errors.JaxRuntimeError: INTERNAL: http://127.0.0.1:8083/"
+                 "remote_compile: read body: response body closed before all bytes were read",
+        "transient": True,
+    }
+
+
+def test_crash_report_chained_traceback_prefers_root_cause():
+    """A real chained traceback ends on the secondary IndexError; the headline
+    must still be the transient root cause (and classify transient)."""
+    bench = _load(BENCH)
+    tb = (
+        "Traceback (most recent call last):\n"
+        '  File "bench.py", line 1, in probe\n'
+        "jax.errors.JaxRuntimeError: INTERNAL: read body: response body closed "
+        "before all bytes were read\n\n"
+        "During handling of the above exception, another exception occurred:\n\n"
+        "Traceback (most recent call last):\n"
+        '  File "bench.py", line 2, in report\n'
+        "IndexError: list index out of range\n"
+    )
+    out = bench._crash_report(_Res(stderr=tb))
+    assert out["transient"] is True
+    assert out["error"].startswith("jax.errors.JaxRuntimeError: INTERNAL:")
+
+
+def test_crash_report_plain_cases_unchanged():
+    bench = _load(BENCH)
+    out = bench._crash_report(_Res(stderr="ValueError: operands could not be broadcast"))
+    assert out == {"error": "ValueError: operands could not be broadcast", "transient": False}
+    out = bench._crash_report(_Res())
+    assert out == {"error": "subprocess produced no output", "transient": False}
+
+
+# ------------------------------------------- trace_report percentile columns
+
+
+def _hist_event(metric, kind, count, buckets, ts=9.0):
+    return json.dumps({
+        "kind": "hist", "metric": metric, "tag": kind, "timestamp": ts,
+        "payload": {"count": count, "sum": 0, "buckets": buckets},
+    })
+
+
+def test_trace_report_cli_latency_percentile_columns(tmp_path):
+    """Acceptance (satellite): hist events become per-metric p50/p99 columns
+    joined onto the dispatch rows, plus a footer latency line."""
+    trace = tmp_path / "t.jsonl"
+    # 10 updates: 8 fast (~bucket 5: 32-64us) + 2 slow (~bucket 15: 32-65ms)
+    trace.write_text("\n".join([
+        _event("dispatch", "Acc#0", "update", 1.0, cache_hit=False, duration_s=0.0001),
+        _event("dispatch", "Acc#0", "update", 2.0, cache_hit=True, duration_s=0.0001),
+        _hist_event("Acc#0", "update", 10, {"5": 8, "15": 2}),
+        _hist_event("Acc#0", "sync", 1, {"15": 1}),
+        _hist_event("Acc#0", "sync_payload", 1, {"2": 1}),  # size kind: footer only
+    ]) + "\n")
+    res = _cli([TRACE_REPORT, str(trace), "--json"])
+    assert res.returncode == 0, res.stderr
+    report = json.loads(res.stdout)
+    rows = {(r["metric"], r["phase"]): r for r in report["rows"]}
+    update = rows[("Acc#0", "update")]
+    # p50 inside bucket 5 (32-64us -> ms), p99 inside bucket 15 (32.8-65.5ms)
+    assert 0.032 <= update["p50_ms"] <= 0.064
+    assert 32.0 <= update["p99_ms"] <= 66.0
+    sync_row = rows[("Acc#0", "sync")]  # hist-only key still gets a row
+    assert 32.0 <= sync_row["p99_ms"] <= 66.0
+    assert ("Acc#0", "sync_payload") not in rows  # size kinds never fake a phase row
+    assert report["latency"]["update"]["count"] == 10
+    assert report["latency"]["sync_payload"]["p99_bytes"] is not None
+    # table rendering: new columns + footer line
+    res = _cli([TRACE_REPORT, str(trace)])
+    header = res.stdout.splitlines()[0]
+    assert "p50_ms" in header and "p99_ms" in header
+    assert "latency:" in res.stdout and "update p99" in res.stdout
+
+
+def test_trace_report_without_hist_events_keeps_dash_columns(tmp_path):
+    trace = tmp_path / "plain.jsonl"
+    trace.write_text(_event("dispatch", "Acc#0", "update", 1.0, cache_hit=False) + "\n")
+    res = _cli([TRACE_REPORT, str(trace), "--json"])
+    report = json.loads(res.stdout)
+    assert report["rows"][0]["p50_ms"] is None and report["latency"] == {}
+    res = _cli([TRACE_REPORT, str(trace)])
+    assert "latency:" not in res.stdout
+
+
 # --------------------------------------------- multi-host trace_report CLI
 
 

@@ -1,0 +1,597 @@
+"""Health plane (ISSUE 7): latency histograms, SLO/alert engine, live export.
+
+Acceptance contract:
+
+- **Histogram fleet merge is exact**: merged bucket counts equal the fieldwise
+  sum over simulated ranks, and a rollup issued after a coalesced sync reuses
+  the metadata collective's piggybacked rows — zero extra collectives.
+- **Percentile sanity**: a log2-bucket estimate is within its bucket (factor
+  of 2) of the true quantile of the recorded raw samples, and the quantile
+  ladder is monotone.
+- **SLO rules trip** on an injected latency/retry breach, respect their
+  cooldown, and drive the optional degradation callback.
+- **`/metricsz` parses as valid Prometheus text exposition format** (name
+  syntax, declared families, cumulative histogram buckets, +Inf == _count).
+"""
+
+import http.client
+import json
+import re
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import torchmetrics_tpu as tm
+from torchmetrics_tpu import MetricCollection, observability as obs
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.observability import histograms as H
+from torchmetrics_tpu.parallel import coalesce as C
+from torchmetrics_tpu.parallel import sync as S
+from torchmetrics_tpu.reliability import (
+    ReliabilityConfig,
+    RetryPolicy,
+    inject_dispatch_fault,
+)
+
+pytestmark = pytest.mark.slo
+
+_FAST_RETRY = dict(backoff_base=0.0, jitter=0.0, sleep_fn=lambda s: None)
+
+
+class _SumState(Metric):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.add_state("s", default=np.zeros(()), dist_reduce_fx="sum")
+
+    def _batch_state(self, x):
+        return {"s": x.sum()}
+
+    def _compute(self, state):
+        return state["s"]
+
+
+def _x(n=8, seed=0):
+    return jnp.asarray(np.random.default_rng(seed).random(n).astype(np.float32))
+
+
+# ------------------------------------------------------------ histogram unit
+
+
+def test_bucket_placement_and_bounds():
+    assert H.bucket_index(0) == 0 and H.bucket_index(1) == 0
+    assert H.bucket_index(2) == 1 and H.bucket_index(3) == 1
+    assert H.bucket_index(1024) == 10
+    assert H.bucket_index(1 << 60) == H.N_BUCKETS - 1  # open-ended top bucket
+    lo, hi = H.bucket_bounds(5)
+    assert (lo, hi) == (32, 64)
+    assert H.bucket_bounds(0) == (0, 2)
+
+
+def test_histogram_record_merge_vector_roundtrip():
+    a, b = H.Histogram(), H.Histogram()
+    for v in (1, 5, 5, 300):
+        a.record(v)
+    for v in (7, 100_000):
+        b.record(v)
+    merged = a.copy().merge(b)
+    assert merged.count == 6 and merged.total == 1 + 5 + 5 + 300 + 7 + 100_000
+    for bucket in range(H.N_BUCKETS):
+        assert merged.counts[bucket] == a.counts[bucket] + b.counts[bucket]
+    assert merged.lo == 1 and merged.hi == 100_000
+    back = H.Histogram.from_vector(a.to_vector())
+    assert back.counts == a.counts and back.count == a.count and back.total == a.total
+
+
+def test_percentile_sanity_against_raw_samples():
+    """The estimate must land within the true quantile's log2 bucket — i.e.
+    within a factor of 2 — and the quantile ladder must be monotone."""
+    rng = np.random.default_rng(7)
+    samples = np.exp(rng.normal(loc=7.0, scale=2.0, size=2000)).astype(np.int64) + 1
+    hist = H.Histogram()
+    for v in samples:
+        hist.record(int(v))
+    prev = 0.0
+    for name, q in H.PERCENTILES:
+        est = hist.percentile(q)
+        true = float(np.quantile(samples, q))
+        assert est is not None
+        assert est / true < 2.05 and true / est < 2.05, (name, est, true)
+        assert est >= prev  # monotone ladder
+        prev = est
+    assert hist.percentile(1.0) <= hist.hi
+    assert H.Histogram().percentile(0.5) is None
+
+
+def test_registry_keys_and_kind_totals():
+    reg = H.HistogramRegistry()
+    reg.record_duration("update", "Acc#0", 0.001)
+    reg.record_duration("update", "F1#1", 0.002)
+    reg.record("sync_payload", "Acc#0", 4096)
+    snap = reg.snapshot()
+    assert set(snap) == {"update", "sync_payload"}
+    assert set(snap["update"]) == {"Acc#0", "F1#1"}
+    totals = reg.kind_totals()
+    assert totals["update"].count == 2
+    vec = reg.fleet_vector()
+    assert len(vec) == H.FLEET_VECTOR_LEN
+    decoded = H.decode_fleet_vector(vec)
+    assert decoded["update"].count == 2 and decoded["sync_payload"].total == 4096
+
+
+# ------------------------------------------------------- fleet merge exactness
+
+
+def _simulated_rank_registries(n_ranks=4, events_per_rank=200):
+    rng = np.random.default_rng(11)
+    regs = []
+    for r in range(n_ranks):
+        reg = H.HistogramRegistry()
+        for _ in range(events_per_rank):
+            kind = H.FLEET_HISTOGRAM_KINDS[int(rng.integers(len(H.FLEET_HISTOGRAM_KINDS)))]
+            reg.record(kind, f"key{rng.integers(3)}", int(rng.integers(0, 1 << 20)))
+        regs.append(reg)
+    return regs
+
+
+def test_fleet_merge_equals_fieldwise_sum_over_simulated_ranks():
+    """Acceptance: merged bucket counts == exact fieldwise sum over ranks."""
+    regs = _simulated_rank_registries()
+    vectors = [reg.fleet_vector() for reg in regs]
+    merged = obs.aggregate_histograms(vectors)
+    per_rank = [H.decode_fleet_vector(v) for v in vectors]
+    for kind in H.FLEET_HISTOGRAM_KINDS:
+        for b in range(H.N_BUCKETS):
+            assert merged[kind].counts[b] == sum(p[kind].counts[b] for p in per_rank), (kind, b)
+        assert merged[kind].count == sum(p[kind].count for p in per_rank)
+        assert merged[kind].total == sum(p[kind].total for p in per_rank)
+    # elementwise over the raw vectors too (the transport-level contract)
+    assert H.merge_vectors(vectors) == [sum(col) for col in zip(*vectors)]
+
+
+def test_gather_histograms_through_injected_gather_plane():
+    """The rollup rides gather_metadata_vector: ONE collective total, and
+    values past 2**31 survive the int32 halves encoding."""
+    regs = _simulated_rank_registries(n_ranks=3)
+    regs[0].record("sync_payload", "big", (1 << 40) + 13)  # past int32
+    vectors = [reg.fleet_vector() for reg in regs]
+
+    def halves(vec):
+        out = np.empty(2 * len(vec), np.int32)
+        out[0::2] = [v >> 31 for v in vec]
+        out[1::2] = [v & 0x7FFFFFFF for v in vec]
+        return out
+
+    calls = {"n": 0}
+
+    def fake(value, group=None):
+        calls["n"] += 1
+        return [jnp.asarray(halves(vec)) for vec in vectors]  # each simulated rank's row
+
+    merged = obs.gather_histograms(vector=vectors[0], dist_sync_fn=fake)
+    assert calls["n"] == 1  # one collective — no per-kind round-trips
+    expect = H.aggregate_histograms(vectors)
+    for kind in H.FLEET_HISTOGRAM_KINDS:
+        assert merged[kind].counts == expect[kind].counts
+        assert merged[kind].total == expect[kind].total
+    assert merged["sync_payload"].total >= (1 << 40) + 13  # 62-bit exactness held
+
+
+def test_fleet_histogram_rollup_piggybacks_on_coalesced_sync(monkeypatch):
+    """Acceptance: after a coalesced sync under an active session, the
+    histogram rollup reuses the rows the sync's metadata collective shipped —
+    ZERO extra collectives — and the local row is refreshed live."""
+    C.clear_fleet_mailbox()
+    m = tm.aggregation.SumMetric()
+    m.update(jnp.asarray([1.0, 2.0]))
+    with obs.telemetry_session() as rec:
+        m.sync(distributed_available=lambda: True)  # real world-of-one collectives
+        m.unsync()
+        rows = C.fleet_histogram_rows()
+        assert rows is not None
+        assert rows[1] == 0 and len(rows[0]) == 1  # one rank, local index 0
+        assert len(rows[0][0]) == H.FLEET_VECTOR_LEN
+
+        def boom(*a, **k):
+            raise AssertionError("histogram rollup launched a collective after a coalesced sync")
+
+        monkeypatch.setattr(S, "gather_metadata_vector", boom)
+        m.compute()  # more local histogram activity AFTER the sync...
+        fleet = obs.gather_histograms()
+        # ...which the refreshed local row must include (mailbox rows predate it)
+        local = H.decode_fleet_vector(rec.histograms.fleet_vector())
+        for kind in H.FLEET_HISTOGRAM_KINDS:
+            assert fleet[kind].counts == local[kind].counts
+        assert fleet["sync"].count == 1 and fleet["compute"].count == 1
+    C.clear_fleet_mailbox()
+
+
+def test_fleet_histogram_mailbox_invalidated_by_new_session():
+    C.clear_fleet_mailbox()
+    m = tm.aggregation.SumMetric()
+    m.update(jnp.asarray([1.0]))
+    with obs.telemetry_session():
+        m.sync(distributed_available=lambda: True)
+        m.unsync()
+        assert C.fleet_histogram_rows() is not None
+    with obs.telemetry_session():
+        assert C.fleet_histogram_rows() is None  # stale rows never leak
+    C.clear_fleet_mailbox()
+
+
+# ----------------------------------------------------------------- recording
+
+
+def test_dispatch_boundaries_feed_histograms():
+    """update/forward/compute/sync all land in the session's histograms, keyed
+    by metric identity; sync also records its payload size."""
+    m = _SumState(distributed_available_fn=lambda: True, dist_sync_fn=lambda v, g: [v, v])
+    with obs.telemetry_session() as rec:
+        m.update(_x())
+        m.forward(_x())
+        m.compute()  # fake-distributed: records a sync too
+        snap = rec.histograms.snapshot()
+    assert snap["update"]["_SumState#0"].count == 1
+    assert snap["forward"]["_SumState#0"].count == 1
+    assert snap["compute"]["_SumState#0"].count == 1
+    assert snap["sync"]["_SumState#0"].count == 1
+    assert snap["sync_payload"]["_SumState#0"].total == 4  # one f32 scalar
+    lat = rec.latency_summary()
+    assert lat["update"]["count"] == 1 and lat["update"]["p99_us"] is not None
+
+
+def test_retry_backoff_and_collection_latency_attribution():
+    pol = RetryPolicy(max_attempts=3, backoff_base=0.004, backoff_factor=1.0,
+                      jitter=0.0, sleep_fn=lambda s: None)
+    m = _SumState(reliability=ReliabilityConfig(retry=pol))
+    col = MetricCollection({"a": tm.SumMetric(), "b": tm.MeanMetric()}, compute_groups=False)
+    with obs.telemetry_session() as rec:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", UserWarning)
+            with inject_dispatch_fault(m, fail_on=1, times=1, tag="update"):
+                m.update(_x())
+        col.update(_x())
+        backoff = rec.histograms.kind_totals()["retry_backoff"]
+        assert backoff.count == 1 and backoff.total == 4000  # 4ms accepted delay
+        summary = col.telemetry_summary()
+    for name in ("a", "b"):
+        assert summary["members"][name]["latency_us"]["update"]["count"] == 1
+        assert summary["members"][name]["latency_us"]["update"]["p99_us"] is not None
+
+
+def test_hist_events_flushed_at_session_close():
+    m = _SumState()
+    with obs.telemetry_session() as rec:
+        for _ in range(3):
+            m.update(_x())
+    hist_events = rec.events_of("hist")
+    assert any(e.tag == "update" and e.metric == "_SumState#0" for e in hist_events)
+    ev = next(e for e in hist_events if e.tag == "update")
+    assert ev.payload["count"] == 3
+    assert sum(ev.payload["buckets"].values()) == 3
+
+
+def test_trace_report_percentile_parity():
+    """tools/trace_report.py's stdlib percentile mirror must match the
+    canonical estimator on the same bucket counts (merged histograms carry no
+    lo/hi, so the math is identical)."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "trace_report", os.path.join(os.path.dirname(__file__), "..", "tools", "trace_report.py")
+    )
+    trace_report = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(trace_report)
+    rng = np.random.default_rng(3)
+    hist = H.Histogram()
+    for v in rng.integers(0, 1 << 24, size=500):
+        hist.record(int(v))
+    canonical = H.Histogram.from_vector(hist.to_vector())  # drops lo/hi like a merge
+    buckets = {b: c for b, c in enumerate(hist.counts) if c}
+    for _, q in H.PERCENTILES:
+        mirror = trace_report._hist_percentile(buckets, hist.count, q)
+        assert mirror == pytest.approx(canonical.percentile(q), rel=1e-12)
+
+
+# ----------------------------------------------------------------- SLO engine
+
+
+def test_slo_rule_trips_on_injected_latency_breach_and_cooldown():
+    rule = obs.SloRule(
+        name="update_p99", expr="p99('update') > 1000", window=60.0,
+        severity="warning", cooldown=30.0,
+    )
+    with obs.telemetry_session(obs.TelemetryConfig(slo_rules=(rule,))) as rec:
+        # inject a latency breach straight at the recording seam: 50 ms updates
+        for _ in range(10):
+            rec.histograms.record_duration("update", "M#0", 0.050)
+        with pytest.warns(UserWarning, match=r"SLO breach \[warning\] update_p99"):
+            fired = rec.slo.evaluate(rec, now=100.0)
+        assert [a["rule"] for a in fired] == ["update_p99"]
+        # still breached inside the cooldown: no second alert
+        assert rec.slo.evaluate(rec, now=110.0) == []
+        assert rec.slo.snapshot()["rules"]["update_p99"]["breached"] is True
+        # past the cooldown the alert fires again
+        with pytest.warns(UserWarning, match="SLO breach"):
+            fired = rec.slo.evaluate(rec, now=131.0)
+        assert len(fired) == 1
+        state = rec.slo.snapshot()["rules"]["update_p99"]
+        assert state["alerts"] == 2 and state["breaches"] == 3
+        assert rec.counters.snapshot()["alerts"] == 2
+        alerts = rec.events_of("alert")
+        assert len(alerts) == 2 and alerts[0].metric == "update_p99"
+        assert alerts[0].tag == "warning" and alerts[0].payload["kind"] == "breach"
+
+
+def test_slo_retry_rate_breach_from_real_injected_faults():
+    """A real fault-injected run trips the shipped retry-rate rule at the next
+    sync boundary (slo_eval_on_sync), and the degradation callback sees it."""
+    seen = []
+    rules = (
+        obs.SloRule(
+            name="retry_rate",
+            expr="retries >= 2 and retries / max(dispatches + sync_calls, 1) > 0.2",
+            window=60.0, severity="critical", cooldown=0.0,
+            on_breach=seen.append,
+        ),
+    )
+    pol = RetryPolicy(max_attempts=5, **_FAST_RETRY)
+    m = _SumState(
+        reliability=ReliabilityConfig(retry=pol),
+        distributed_available_fn=lambda: True,
+        dist_sync_fn=lambda v, g: [v, v],
+    )
+    with obs.telemetry_session(obs.TelemetryConfig(slo_rules=rules)) as rec:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", UserWarning)
+            with inject_dispatch_fault(m, fail_on=1, times=3, tag="update"):
+                m.update(_x())
+            m.compute()  # sync boundary -> rules evaluate
+        assert rec.counters.snapshot()["retries"] == 3
+        assert [a["rule"] for a in seen] == ["retry_rate"]
+        assert seen[0]["severity"] == "critical"
+        assert rec.slo.breached(min_severity="critical") == ["retry_rate"]
+
+
+def test_slo_callback_exception_is_contained():
+    def bad_callback(alert):
+        raise RuntimeError("remediation exploded")
+
+    rule = obs.SloRule(name="always", expr="total('dispatches') >= 0", window=10.0,
+                       cooldown=0.0, on_breach=bad_callback)
+    with obs.telemetry_session(obs.TelemetryConfig(slo_rules=(rule,))) as rec:
+        with pytest.warns(UserWarning, match="SLO breach"):
+            fired = rec.slo.evaluate(rec, now=1.0)
+    assert fired[0]["callback_error"].startswith("RuntimeError")
+
+
+def test_slo_rule_error_disables_rule_once():
+    rule = obs.SloRule(name="typo", expr="p99('no_such_kind') > 1", window=10.0)
+    with obs.telemetry_session(obs.TelemetryConfig(slo_rules=(rule,))) as rec:
+        with pytest.warns(UserWarning, match="disabled for this session"):
+            fired = rec.slo.evaluate(rec, now=1.0)
+        assert fired[0]["kind"] == "rule_error"
+        assert rec.slo.evaluate(rec, now=2.0) == []  # disabled, not re-warned
+        assert rec.slo.snapshot()["rules"]["typo"]["error"] is not None
+    with pytest.raises(SyntaxError):
+        obs.SloRule(name="bad", expr="p99(")  # syntax errors fail at construction
+
+
+def test_rate_rule_survives_first_evaluation():
+    """A session's first evaluation shares the genesis timestamp; a rule
+    dividing by `window` must neither die with ZeroDivisionError nor see a
+    microscopic window that turns any delta into a breach (floor: 1s)."""
+    rule = obs.SloRule(name="rate", expr="retries / window > 0.5", window=60.0, cooldown=0.0)
+    with obs.telemetry_session(obs.TelemetryConfig(slo_rules=(rule,))) as rec:
+        assert rec.evaluate_slos() == []
+        assert rec.slo.snapshot()["rules"]["rate"]["error"] is None
+
+
+def test_slo_engine_thread_safe_and_ring_bounded():
+    """The engine is hammered concurrently by the training thread (sync
+    boundaries), the flusher, and health-server request threads — no deque
+    races, and the sample ring never grows unboundedly on a high-frequency
+    observe loop (spacing thinning + hard cap)."""
+    import threading
+
+    from torchmetrics_tpu.observability import slo as slo_mod
+
+    rule = obs.SloRule(name="quiet", expr="retries > 10**9", window=5.0, cooldown=0.0)
+    with obs.telemetry_session(obs.TelemetryConfig(slo_rules=(rule,))) as rec:
+        errors = []
+
+        def worker():
+            try:
+                for _ in range(200):
+                    rec.evaluate_slos()
+            except Exception as err:  # noqa: BLE001 — the race IS the failure
+                errors.append(err)
+
+        threads = [threading.Thread(target=worker) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert errors == []
+        # a per-batch observe storm (explicit clock, 50 Hz for 5 windows) stays
+        # bounded: thinning keeps ~2 samples per window/_MAX_SAMPLES spacing
+        for i in range(5000):
+            rec.slo.observe(rec, now=1000.0 + i * 0.02)
+        assert len(rec.slo._samples) <= slo_mod._MAX_SAMPLES
+
+
+def test_default_rule_pack_quiet_on_healthy_run():
+    m = _SumState(distributed_available_fn=lambda: True, dist_sync_fn=lambda v, g: [v, v])
+    with obs.telemetry_session(obs.TelemetryConfig(slo_rules=obs.default_rules())) as rec:
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")  # any alert warning fails the test
+            for _ in range(5):
+                m.update(_x())
+            m.compute()
+            rec.evaluate_slos()
+        assert rec.slo.breached() == []
+        assert rec.counters.snapshot()["alerts"] == 0
+
+
+def test_state_growth_rule_trips_via_sentinel():
+    rules = (obs.SloRule(name="growth", expr="state_growths > 0", window=60.0, cooldown=0.0),)
+    cfg = obs.TelemetryConfig(slo_rules=rules, state_growth_warn_bytes=8)
+    cat = tm.CatMetric()
+    with obs.telemetry_session(cfg) as rec:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore", UserWarning)
+            cat.update(_x(64))  # 256 bytes of cat state > 8-byte threshold
+            fired = rec.slo.evaluate(rec, now=1.0)
+    assert [a["rule"] for a in fired] == ["growth"]
+    assert rec.counters.snapshot()["state_growths"] == 1
+
+
+# -------------------------------------------------------------- live export
+
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_SAMPLE_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>[^}]*)\})?"
+    r" (?P<value>[-+]?(?:[0-9]*\.?[0-9]+(?:[eE][-+]?[0-9]+)?|NaN|\+?Inf))$"
+)
+_LABEL_RE = re.compile(r'^[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"$')
+
+
+def _parse_prometheus(text):
+    """Minimal Prometheus text-format validator: returns {family: type} and
+    the parsed samples; raises AssertionError on any malformed line."""
+    types, samples = {}, []
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            assert _NAME_RE.match(line.split()[2]), line
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(None, 3)
+            assert _NAME_RE.match(name) and kind in ("counter", "gauge", "histogram"), line
+            types[name] = kind
+            continue
+        assert not line.startswith("#"), f"unknown comment: {line}"
+        m = _SAMPLE_RE.match(line)
+        assert m, f"malformed sample line: {line!r}"
+        for label in filter(None, (m.group("labels") or "").split(",")):
+            assert _LABEL_RE.match(label), f"malformed label: {label!r} in {line!r}"
+        # every sample belongs to a declared family (histograms via suffixes)
+        name = m.group("name")
+        family = re.sub(r"_(bucket|sum|count)$", "", name)
+        assert name in types or family in types, f"undeclared family: {name}"
+        samples.append((name, m.group("labels") or "", m.group("value")))
+    return types, samples
+
+
+def test_metricsz_parses_as_valid_prometheus_text():
+    """Acceptance: a live scrape of /metricsz is valid exposition format with
+    coherent histogram series."""
+    m = _SumState(distributed_available_fn=lambda: True, dist_sync_fn=lambda v, g: [v, v])
+    with obs.telemetry_session(obs.TelemetryConfig(slo_rules=obs.default_rules())):
+        for _ in range(4):
+            m.update(_x())
+        m.compute()
+        with obs.HealthServer(port=0) as srv:
+            conn = http.client.HTTPConnection(srv.host, srv.port, timeout=30)
+            conn.request("GET", "/metricsz")
+            resp = conn.getresponse()
+            assert resp.status == 200
+            assert resp.getheader("Content-Type", "").startswith("text/plain")
+            text = resp.read().decode()
+    types, samples = _parse_prometheus(text)
+    assert types["tpu_metrics_dispatches_total"] == "counter"
+    assert types["tpu_metrics_latency_seconds"] == "histogram"
+    by_name = {}
+    for name, labels, value in samples:
+        by_name.setdefault(name, []).append((labels, value))
+    assert ("", "4") in by_name["tpu_metrics_dispatches_total"]
+    # histogram coherence for the update series: cumulative non-decreasing,
+    # +Inf bucket == _count
+    update = [
+        (labels, float(v)) for labels, v in by_name["tpu_metrics_latency_seconds_bucket"]
+        if 'kind="update"' in labels
+    ]
+    assert update, "no update latency series exported"
+    cums = [v for _, v in update[:-1]]
+    assert cums == sorted(cums)
+    inf = next(v for labels, v in update if 'le="+Inf"' in labels)
+    count = next(
+        float(v) for labels, v in by_name["tpu_metrics_latency_seconds_count"]
+        if 'kind="update"' in labels
+    )
+    assert inf == count == 4.0
+    # SLO families exported too (default pack active)
+    assert types["tpu_metrics_slo_breached"] == "gauge"
+
+
+def test_health_endpoints_json_and_critical_503():
+    always_critical = obs.SloRule(
+        name="tripwire", expr="total('dispatches') > 0", window=10.0,
+        severity="critical", cooldown=0.0,
+    )
+    m = _SumState()
+    with obs.telemetry_session(obs.TelemetryConfig(slo_rules=(always_critical,))):
+        with obs.HealthServer(port=0) as srv:
+            def get(path):
+                conn = http.client.HTTPConnection(srv.host, srv.port, timeout=30)
+                conn.request("GET", path)
+                resp = conn.getresponse()
+                return resp.status, json.loads(resp.read().decode())
+
+            status, doc = get("/healthz")
+            assert status == 200 and doc["status"] == "ok"  # nothing dispatched yet
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore", UserWarning)
+                m.update(_x())
+                status, doc = get("/healthz")
+            assert status == 503 and doc["status"] == "critical"
+            assert doc["breached_rules"] == ["tripwire"]
+            status, doc = get("/costz")
+            assert status == 200 and doc["telemetry"] is True
+            assert "cost_totals" in doc and "state_memory" in doc
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore", UserWarning)
+                status, doc = get("/sloz")
+            assert status == 200 and doc["rules"]["tripwire"]["breached"] is True
+            assert doc["rules"]["tripwire"]["severity"] == "critical"
+            status, doc = get("/nothing")
+            assert status == 404 and "/metricsz" in doc["endpoints"]
+    # no session: endpoints stay up and honest
+    with obs.HealthServer(port=0) as srv:
+        conn = http.client.HTTPConnection(srv.host, srv.port, timeout=30)
+        conn.request("GET", "/healthz")
+        resp = conn.getresponse()
+        doc = json.loads(resp.read().decode())
+        assert resp.status == 200 and doc == {"status": "ok", "telemetry": False}
+
+
+def test_metrics_flusher_atomic_file(tmp_path):
+    path = tmp_path / "metrics.prom"
+    m = _SumState()
+    with obs.telemetry_session():
+        m.update(_x())
+        flusher = obs.MetricsFlusher(str(path), interval_s=60.0)
+        text = flusher.flush_now()
+    assert path.read_text() == text
+    types, _ = _parse_prometheus(text)
+    assert "tpu_metrics_dispatches_total" in types
+    assert not (tmp_path / "metrics.prom.tmp").exists()  # atomic replace, no droppings
+    # without a session the flusher still renders a liveness document
+    flusher.flush_now()
+    assert "tpu_metrics_telemetry_enabled 0" in path.read_text()
+    with pytest.raises(ValueError, match="interval_s"):
+        obs.MetricsFlusher(str(path), interval_s=0)
+
+
+def test_summary_carries_latency_block():
+    m = _SumState()
+    with obs.telemetry_session() as rec:
+        m.update(_x())
+        full = rec.summary()
+    assert full["latency"]["update"]["count"] == 1
+    assert full["latency"]["update"]["p50_us"] is not None

@@ -154,7 +154,8 @@ def test_jsonl_sink_flushes_on_close_and_context_exit(tmp_path):
     m = _SumState()
     with obs.telemetry_session(obs.TelemetryConfig(sinks=(obs.JSONLSink(str(trace), flush_every=64),))):
         m.update(_x())
-    assert {json.loads(l)["kind"] for l in trace.read_text().splitlines()} == {"dispatch"}
+    # the dispatch line plus the histogram snapshot the session flushes at close
+    assert {json.loads(l)["kind"] for l in trace.read_text().splitlines()} == {"dispatch", "hist"}
 
 
 def test_jsonl_trace_tolerates_bad_line(tmp_path):
@@ -255,7 +256,9 @@ def test_scripted_run_counters_reconcile():
 
 def test_disabled_telemetry_constructs_no_events(monkeypatch):
     """With no session active the dispatch path must do NO telemetry work: no
-    event objects, no signature hashing, no clock reads."""
+    event objects, no signature hashing, no clock reads, no histogram
+    recording, no SLO evaluation (and, established elsewhere by transfer
+    guard, no D2H)."""
     def boom(*a, **k):
         raise AssertionError("telemetry work performed while disabled")
 
@@ -263,6 +266,13 @@ def test_disabled_telemetry_constructs_no_events(monkeypatch):
     monkeypatch.setattr(obs.events.TelemetryEvent, "__init__", boom)
     monkeypatch.setattr(obs.TelemetryRecorder, "_signature", staticmethod(boom))
     monkeypatch.setattr(obs.tracing, "monotonic", boom)
+    # the health plane must be just as silent: recording a histogram sample,
+    # feeding the SLO window, or evaluating a rule while disabled is a leak
+    monkeypatch.setattr(obs.Histogram, "record", boom)
+    monkeypatch.setattr(obs.HistogramRegistry, "record", boom)
+    monkeypatch.setattr(obs.HistogramRegistry, "record_duration", boom)
+    monkeypatch.setattr(obs.SloEngine, "observe", boom)
+    monkeypatch.setattr(obs.SloEngine, "evaluate", boom)
     m = _SumState()
     m.update(_x())
     m.forward(_x())
@@ -274,6 +284,13 @@ def test_disabled_telemetry_constructs_no_events(monkeypatch):
     s = _SumState(distributed_available_fn=lambda: True, dist_sync_fn=lambda v, g: [v, v])
     s.update(_x())
     s.compute()
+    # retry path: a disabled session must not record backoff histograms either
+    pol = RetryPolicy(max_attempts=2, **_FAST_RETRY)
+    r = _SumState(reliability=ReliabilityConfig(retry=pol))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", UserWarning)
+        with inject_dispatch_fault(r, fail_on=1, times=1, tag="update"):
+            r.update(_x())
 
 
 # ------------------------------------------------------------------ satellites

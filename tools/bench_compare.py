@@ -61,11 +61,19 @@ THRESHOLDS: Dict[str, float] = {
     # one-shot compute latencies (single measurement, no best-of-3)
     "extra.coco_map_synthetic.compute_sec_500imgs_80cls": 0.5,
     "extra.coco_map_synthetic.compute_sec_5000imgs_80cls": 0.5,
+    # blocking-timing latency percentiles from short probes (24/8-sample
+    # distributions on a shared pod wobble; the gate is for order-of-magnitude
+    # tail blowups, not ±30% noise)
+    "extra.update_p50_us": 0.6,
+    "extra.update_p99_us": 0.6,
+    "extra.collection_sync_16metrics.update_p50_us": 0.6,
+    "extra.collection_sync_16metrics.update_p99_us": 0.6,
+    "extra.collection_sync_16metrics.sync_p99_us": 0.6,
 }
 
 _HIGHER_MARKERS = ("per_sec", "speedup", "throughput")
 _HIGHER_EXACT = ("value", "vs_baseline")
-_LOWER_MARKERS = ("latency", "compile", "_sec", "_ms", "_bytes", "bytes_", "time")
+_LOWER_MARKERS = ("latency", "compile", "_sec", "_ms", "_us", "_bytes", "bytes_", "time")
 # collective counts per sync: fewer is the whole point of the coalesced plane —
 # a move back toward per-leaf collectives must gate even though the name
 # carries no latency/throughput marker

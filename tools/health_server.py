@@ -1,0 +1,97 @@
+#!/usr/bin/env python
+"""Serve a live health/metrics endpoint for a torchmetrics_tpu process.
+
+Library use (the normal path — the server answers from whatever telemetry
+session is active in THIS process, so it belongs next to your loop)::
+
+    from torchmetrics_tpu import observability as obs
+
+    obs.enable(obs.TelemetryConfig(slo_rules=obs.default_rules()))
+    server = obs.HealthServer(port=8080).start()   # same class this CLI wraps
+    ... run the eval/serving loop ...
+
+    $ curl localhost:8080/healthz    # liveness + SLO verdict (503 on critical)
+    $ curl localhost:8080/metricsz   # Prometheus text format (scrape target)
+    $ curl localhost:8080/costz      # compiled-cost + state-memory accounting
+    $ curl localhost:8080/sloz       # rule states + recent alerts
+
+Standalone use (this file): starts a session with the default SLO rule pack,
+an optional demo workload so every endpoint has live data to show, and an
+optional on-disk scrape file via the background flusher::
+
+    python tools/health_server.py --port 8080 --demo
+    python tools/health_server.py --port 8080 --flush-to /tmp/metrics.prom
+
+The demo loop is a real metric (`MulticlassAccuracy`) updating continuously —
+useful for poking the endpoints and wiring dashboards without a training job.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import threading
+import time
+
+
+def _demo_loop(stop: threading.Event) -> None:
+    import jax
+    import numpy as np
+
+    from torchmetrics_tpu.classification import MulticlassAccuracy
+
+    rng = np.random.default_rng(0)
+    preds = np.asarray(rng.normal(size=(1024, 10)).astype(np.float32))
+    target = np.asarray(rng.integers(0, 10, 1024, dtype=np.int32))
+    metric = MulticlassAccuracy(num_classes=10, average="micro", validate_args=False)
+    while not stop.is_set():
+        metric.update(preds, target)
+        jax.block_until_ready(metric._state)
+        metric.compute()
+        stop.wait(0.25)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=8080, help="0 binds an ephemeral port")
+    parser.add_argument("--demo", action="store_true",
+                        help="run a demo metric loop so the endpoints show live data")
+    parser.add_argument("--flush-to", default=None, metavar="PATH",
+                        help="also write the Prometheus text to PATH on an interval")
+    parser.add_argument("--flush-interval", type=float, default=5.0)
+    args = parser.parse_args(argv)
+
+    from torchmetrics_tpu import observability as obs
+
+    obs.enable(obs.TelemetryConfig(slo_rules=obs.default_rules()))
+    server = obs.HealthServer(host=args.host, port=args.port).start()
+    print(f"health plane listening on http://{server.host}:{server.port} "
+          f"(/healthz /metricsz /costz /sloz)", flush=True)
+
+    flusher = None
+    if args.flush_to:
+        flusher = obs.MetricsFlusher(args.flush_to, interval_s=args.flush_interval).start()
+        print(f"flushing Prometheus text to {args.flush_to} every {args.flush_interval}s", flush=True)
+
+    stop = threading.Event()
+    if args.demo:
+        threading.Thread(target=_demo_loop, args=(stop,), daemon=True).start()
+        print("demo workload running (MulticlassAccuracy updates @4Hz)", flush=True)
+
+    try:
+        while True:
+            time.sleep(1.0)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        stop.set()
+        if flusher is not None:
+            flusher.stop()
+        server.stop()
+        obs.disable()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -17,11 +17,13 @@ attributable after merging. Unparseable lines (a host preempted mid-write)
 are skipped with a warning.
 
 Per (rank, metric, phase) row: event count, compiles vs cache hits, retraces,
-and total/mean span time (honest device wall-clock only if the trace was
+total/mean span time (honest device wall-clock only if the trace was
 recorded under ``TelemetryConfig(block_until_ready=True)``; otherwise
-dispatch/enqueue latency). Footer totals cover retries, quarantines,
-instrumented device→host readbacks, and sync calls with payload bytes — the
-"why did it get slow/wrong/expensive" signals.
+dispatch/enqueue latency), and — when the trace carries ``hist`` events (the
+log2 latency histograms a session flushes at close) — p50/p99 latency columns
+per metric and phase. Footer totals cover retries, quarantines, instrumented
+device→host readbacks, sync calls with payload bytes, and per-kind fleet
+latency percentiles — the "why did it get slow/wrong/expensive" signals.
 """
 
 from __future__ import annotations
@@ -56,6 +58,40 @@ def _new_row() -> Dict[str, Any]:
     return {"events": 0, "compiles": 0, "cache_hits": 0, "retraces": 0, "total_s": 0.0, "timed": 0}
 
 
+# latency histogram kinds that join report rows (size kinds stay footer-only)
+_LATENCY_KINDS = ("update", "forward", "compute", "sync")
+
+
+def _hist_percentile(buckets: Dict[int, int], count: int, q: float) -> Optional[float]:
+    """Quantile estimate from log2 bucket counts — a stdlib mirror of
+    ``observability/histograms.py`` (bucket ``b`` spans ``[2^b, 2^(b+1))``,
+    linear interpolation inside the target bucket). Kept dependency-free so
+    traces render on a laptop; pinned against the canonical implementation by
+    a parity test."""
+    if count <= 0 or not buckets:
+        return None
+    target = q * count
+    cum = 0
+    for b in sorted(buckets):
+        c = buckets[b]
+        if c <= 0:
+            continue
+        if cum + c >= target:
+            lo = 0 if b == 0 else 2 ** b
+            hi = 2 ** (b + 1)
+            return lo + (hi - lo) * (target - cum) / c
+        cum += c
+    return float(2 ** (max(buckets) + 1))
+
+
+def _merge_hist(store: Dict[Any, Dict[str, Any]], key: Any, payload: Dict[str, Any]) -> None:
+    ent = store.setdefault(key, {"count": 0, "buckets": {}})
+    ent["count"] += int(payload.get("count", 0))
+    for b, c in (payload.get("buckets") or {}).items():
+        b = int(b)
+        ent["buckets"][b] = ent["buckets"].get(b, 0) + int(c)
+
+
 def aggregate(events: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
     """Fold a raw (possibly multi-rank) event stream into the report structure."""
     rows: Dict[Tuple[Any, str, str], Dict[str, Any]] = {}
@@ -67,6 +103,8 @@ def aggregate(events: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
     }
     retries: List[Dict[str, Any]] = []
     quarantines: List[Dict[str, Any]] = []
+    row_hists: Dict[Tuple[Any, str, str], Dict[str, Any]] = {}  # joins report rows
+    kind_hists: Dict[str, Dict[str, Any]] = {}  # per-kind fleet totals (footer)
     any_rank = False
     for ev in events:
         kind = ev.get("kind", "")
@@ -106,6 +144,14 @@ def aggregate(events: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
         elif kind == "d2h":
             totals["d2h_readbacks"] += 1
             totals["d2h_bytes"] += int(ev.get("payload", {}).get("nbytes", 0))
+        elif kind == "hist":
+            # a session-close histogram snapshot: metric=key, tag=histogram
+            # kind; latency kinds join the matching report row, every kind
+            # folds into the footer's fleet totals
+            payload = ev.get("payload", {})
+            if tag in _LATENCY_KINDS:
+                _merge_hist(row_hists, (rank, metric, tag), payload)
+            _merge_hist(kind_hists, tag, payload)
     def _rank_key(rank: Any) -> Tuple[int, int, str]:
         # ints sort numerically (rank 2 before rank 10 on a 64-host pod),
         # string labels lexicographically after, None (single file) first
@@ -115,9 +161,16 @@ def aggregate(events: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
             return (1, rank, "")
         return (2, 0, str(rank))
 
+    for key in row_hists:  # a hist-only key still deserves a row
+        rows.setdefault(key, _new_row())
     report_rows = []
     for (rank, metric, tag), row in sorted(rows.items(), key=lambda kv: (_rank_key(kv[0][0]), kv[0][1], kv[0][2])):
         mean_ms = (row["total_s"] / row["timed"] * 1000.0) if row["timed"] else None
+        hist = row_hists.get((rank, metric, tag))
+        p50 = p99 = None
+        if hist:
+            p50 = _hist_percentile(hist["buckets"], hist["count"], 0.50)
+            p99 = _hist_percentile(hist["buckets"], hist["count"], 0.99)
         out_row = {
             "metric": metric,
             "phase": tag,
@@ -127,18 +180,33 @@ def aggregate(events: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
             "retraces": row["retraces"],
             "total_s": round(row["total_s"], 6),
             "mean_ms": round(mean_ms, 3) if mean_ms is not None else None,
+            "p50_ms": round(p50 / 1000.0, 3) if p50 is not None else None,
+            "p99_ms": round(p99 / 1000.0, 3) if p99 is not None else None,
         }
         if any_rank:
             out_row["rank"] = rank
         report_rows.append(out_row)
+    latency: Dict[str, Any] = {}
+    for kind, hist in sorted(kind_hists.items()):
+        p50 = _hist_percentile(hist["buckets"], hist["count"], 0.50)
+        p99 = _hist_percentile(hist["buckets"], hist["count"], 0.99)
+        div = 1.0 if kind in ("sync_payload", "gather_bytes") else 1000.0  # bytes vs us→ms
+        latency[kind] = {
+            "count": hist["count"],
+            ("p50_bytes" if div == 1.0 else "p50_ms"): round(p50 / div, 3) if p50 is not None else None,
+            ("p99_bytes" if div == 1.0 else "p99_ms"): round(p99 / div, 3) if p99 is not None else None,
+        }
     return {
         "rows": report_rows, "totals": totals, "retries": retries, "quarantines": quarantines,
-        "multi_rank": any_rank,
+        "latency": latency, "multi_rank": any_rank,
     }
 
 
 def render_table(report: Dict[str, Any]) -> str:
-    headers: Tuple[str, ...] = ("metric", "phase", "events", "compiles", "cache_hits", "retraces", "total_s", "mean_ms")
+    headers: Tuple[str, ...] = (
+        "metric", "phase", "events", "compiles", "cache_hits", "retraces",
+        "total_s", "mean_ms", "p50_ms", "p99_ms",
+    )
     if report.get("multi_rank"):
         headers = ("rank",) + headers
     table = [[str(r.get(h)) if r.get(h) is not None else "-" for h in headers] for r in report["rows"]]
@@ -160,6 +228,13 @@ def render_table(report: Dict[str, Any]) -> str:
         f"{t['sync_collectives']} collectives = {per_sync}/sync, "
         f"{t['leaves_coalesced']} leaves coalesced)"
     )
+    if report.get("latency"):
+        parts = []
+        for kind, block in report["latency"].items():
+            p99_key = "p99_bytes" if "p99_bytes" in block else "p99_ms"
+            unit = "B" if p99_key == "p99_bytes" else "ms"
+            parts.append(f"{kind} p99 {block[p99_key]}{unit} (n={block['count']})")
+        lines.append("latency: " + "  ".join(parts))
     for ev in report["retries"]:
         p = ev.get("payload", {})
         lines.append(f"  retry[{ev.get('kind')}] {ev.get('metric')}: attempt {p.get('attempt', p.get('attempts'))}: {p.get('error')}")

@@ -904,6 +904,9 @@ class MetricCollection:
         members_out: Dict[str, Any] = {}
         for name, metric in self._modules.items():
             info = rec.metric_summary(metric)
+            latency = rec.metric_latency(metric)
+            if latency:  # per-stage p50/p99 from the session's histograms
+                info["latency_us"] = latency
             if name in leader_of:
                 info["fused_into"] = leader_of[name]
             if name in self._quarantined:

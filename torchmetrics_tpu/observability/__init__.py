@@ -38,7 +38,10 @@ from typing import Any, Dict, Iterator, Optional, Tuple
 
 from ..utilities.prints import rank_zero_warn
 from . import costs as costs_module
+from . import events
+from . import histograms as histograms_module
 from . import memory as memory_module
+from . import slo as slo_module
 from . import tracing
 from .costs import CostRecord, CostRegistry
 from .counters import (
@@ -56,31 +59,52 @@ from .events import (
     Sink,
     TelemetryEvent,
 )
+from .histograms import (
+    FLEET_HISTOGRAM_KINDS,
+    Histogram,
+    HistogramRegistry,
+    aggregate_histograms,
+)
 from .memory import StateMemoryTracker, state_memory
+from .slo import SloEngine, SloRule, default_rules
+from . import export  # noqa: E402 — needs histograms imported first
+from .export import HealthServer, MetricsFlusher, render_prometheus
 
 __all__ = [
     "COUNTER_FIELDS",
     "EVENT_KINDS",
+    "FLEET_HISTOGRAM_KINDS",
     "CallbackSink",
     "CostRecord",
     "CostRegistry",
     "Counters",
     "CountersSnapshot",
     "FleetSnapshot",
+    "HealthServer",
+    "Histogram",
+    "HistogramRegistry",
     "JSONLSink",
+    "MetricsFlusher",
     "RingBufferSink",
     "Sink",
+    "SloEngine",
+    "SloRule",
     "StateMemoryTracker",
     "TelemetryConfig",
     "TelemetryEvent",
     "TelemetryRecorder",
     "active",
     "aggregate_counters",
+    "aggregate_histograms",
     "cost_snapshot",
+    "default_rules",
     "disable",
     "enable",
     "enabled",
+    "export",
     "gather_counters",
+    "gather_histograms",
+    "render_prometheus",
     "state_memory",
     "telemetry_session",
     "tracing",
@@ -115,6 +139,13 @@ class TelemetryConfig:
             metric/state) when a single list/cat state exceeds this many bytes
             — cat states are the one unbounded growth axis in the runtime and
             the #1 silent OOM cause in long evals.
+        slo_rules: declarative health rules (``observability/slo.py``) the
+            session evaluates over rolling counter/histogram windows — start
+            from :func:`slo.default_rules`. Empty (the default) arms nothing.
+        slo_eval_on_sync: evaluate the rules at every recorded sync boundary
+            (low-frequency, already collective-shaped — the natural heartbeat
+            of a training/eval loop). The export layer's background flusher
+            and the health server evaluate on their own cadence regardless.
     """
 
     sinks: Tuple[Sink, ...] = ()
@@ -124,6 +155,8 @@ class TelemetryConfig:
     cost_accounting: bool = True
     track_state_memory: bool = True
     state_growth_warn_bytes: int = 256 * 2**20
+    slo_rules: Tuple[SloRule, ...] = ()
+    slo_eval_on_sync: bool = True
 
 
 class TelemetryRecorder:
@@ -142,12 +175,15 @@ class TelemetryRecorder:
         self.costs = CostRegistry()
         self.counters.attach_costs(self.costs)  # cost entries ride along in snapshots
         self.memory = StateMemoryTracker(self.config.state_growth_warn_bytes)
+        self.histograms = HistogramRegistry()
+        self.slo = SloEngine(self.config.slo_rules)
         self.sinks: Tuple[Sink, ...] = self.config.sinks or (
             RingBufferSink(self.config.ring_buffer_size),
         )
         self._epoch = next(_SESSION_EPOCHS)
         self._ids = itertools.count()
         self._retrace_warned: set = set()
+        self._closed = False
 
     # ------------------------------------------------------------- identities
 
@@ -221,6 +257,7 @@ class TelemetryRecorder:
             # must never see a counted compile without its cost entry
             self.costs.harvest(key, sig, lower)
         is_new, n_sigs = self.counters.record_dispatch(key, sig)
+        self.histograms.record_duration(tag, name, duration_s)
         self._event(
             "dispatch", name, tag, duration_s=duration_s, signature=sig, cache_hit=not is_new
         )
@@ -239,12 +276,16 @@ class TelemetryRecorder:
 
     def record_host_dispatch(self, metric: Any, tag: str, duration_s: float) -> None:
         """A HostMetric eager dispatch (never jitted — no compile/hit split)."""
+        name = self._metric_name(metric)
         self.counters.record_host_dispatch()
-        self._event("dispatch", self._metric_name(metric), tag, duration_s=duration_s, payload={"jitted": False})
+        self.histograms.record_duration(tag, name, duration_s)
+        self._event("dispatch", name, tag, duration_s=duration_s, payload={"jitted": False})
 
     def record_compute(self, metric: Any, duration_s: float) -> None:
+        name = self._metric_name(metric)
         self.counters.record_compute()
-        self._event("compute", self._metric_name(metric), "compute", duration_s=duration_s)
+        self.histograms.record_duration("compute", name, duration_s)
+        self._event("compute", name, "compute", duration_s=duration_s)
 
     def record_sync(
         self,
@@ -260,15 +301,29 @@ class TelemetryRecorder:
         attribution). ``collectives`` is how many collectives this sync
         launched and ``coalesced_leaves`` how many state leaves rode a
         coalesced bucket — the per-sync view of the K·L → buckets reduction."""
+        name = self._metric_name(metric)
         self.counters.record_sync_time(duration_s)
+        self.histograms.record_duration("sync", name, duration_s)
+        self.histograms.record("sync_payload", name, int(payload_bytes))
         self._event(
-            "sync", self._metric_name(metric), "sync", duration_s=duration_s,
+            "sync", name, "sync", duration_s=duration_s,
             payload={
                 "payload_bytes": int(payload_bytes),
                 "collectives": int(collectives),
                 "coalesced_leaves": int(coalesced_leaves),
             },
         )
+        # sync boundaries are the loop's natural low-frequency heartbeat — the
+        # place a rolling SLO window gets fed without touching the update path
+        if self.config.slo_eval_on_sync and self.slo.rules:
+            self.slo.observe_and_evaluate(self)
+
+    def record_gather_payload(self, plane: str, nbytes: int) -> None:
+        """Size of one sync-plane collective payload (``plane`` is
+        ``"coalesced"`` or ``"per_leaf"``) — the distribution that shows
+        whether bucketing is actually producing few-large instead of
+        many-small collectives. Metadata-derived bytes, never a device read."""
+        self.histograms.record("gather_bytes", plane, int(nbytes))
 
     def record_state_memory(self, metric: Any) -> None:
         """Refresh a metric's state-memory footprint after an update (metadata
@@ -280,6 +335,7 @@ class TelemetryRecorder:
             return
         name = self._metric_name(metric)
         for sname, info in self.memory.observe(name, metric._state):
+            self.counters.record_state_growth()
             self._event(
                 "state_growth", name, sname,
                 payload={"nbytes": info["nbytes"], "elements": info["elements"],
@@ -302,11 +358,14 @@ class TelemetryRecorder:
         name = self._metric_name(metric) if metric is not None else ""
         self._event("d2h", name, site, payload={"nbytes": int(nbytes)})
 
-    def record_retry(self, describe: str, attempt: int, exc: BaseException) -> None:
+    def record_retry(
+        self, describe: str, attempt: int, exc: BaseException, delay_s: float = 0.0
+    ) -> None:
         self.counters.record_retry()
+        self.histograms.record_duration("retry_backoff", describe, delay_s)
         self._event(
             "retry", describe, "retry",
-            payload={"attempt": attempt, "error": repr(exc)[:240]},
+            payload={"attempt": attempt, "error": repr(exc)[:240], "delay_s": round(delay_s, 6)},
         )
 
     def record_retry_exhausted(self, describe: str, attempts: int, exc: BaseException) -> None:
@@ -362,6 +421,60 @@ class TelemetryRecorder:
         breakdown, per-state peaks."""
         return self.memory.snapshot()
 
+    def histogram_snapshot(self) -> Dict[str, Dict[str, Any]]:
+        """Per-(kind, key) latency/size distributions as flat report blocks:
+        ``{kind: {key: {count, sum, mean, p50, p95, p99, p999, buckets}}}``.
+        Latency kinds are microseconds; size kinds
+        (:data:`histograms.SIZE_KINDS`) are bytes."""
+        return {
+            kind: {key: hist.summary() for key, hist in keys.items()}
+            for kind, keys in self.histograms.snapshot().items()
+        }
+
+    def latency_summary(self) -> Dict[str, Any]:
+        """Per-kind percentile headline merged across all keys — the block the
+        full ``summary()`` and the bench columns embed (``*_us`` for latency
+        kinds, ``*_bytes`` for size kinds)."""
+        out: Dict[str, Any] = {}
+        for kind, hist in self.histograms.kind_totals().items():
+            unit = "bytes" if kind in histograms_module.SIZE_KINDS else "us"
+            block: Dict[str, Any] = {"count": hist.count}
+            for name, est in hist.percentiles().items():
+                block[f"{name}_{unit}"] = round(est, 1) if est is not None else None
+            out[kind] = block
+        return out
+
+    def metric_latency(self, metric: Any) -> Dict[str, Any]:
+        """One metric's per-stage latency percentiles (``update``/``forward``/
+        ``compute``/``sync`` — whichever this session recorded), for
+        ``MetricCollection.telemetry_summary()``'s per-member attribution."""
+        stamp = metric.__dict__.get("_telemetry_id")
+        if not (isinstance(stamp, tuple) and stamp[0] == self._epoch):
+            return {}
+        name = f"{type(metric).__name__}#{stamp[1]}"
+        out: Dict[str, Any] = {}
+        for kind in ("update", "forward", "compute", "sync"):
+            hist = self.histograms.get(kind, name)
+            if hist is None or not hist.count:
+                continue
+            pct = hist.percentiles()
+            out[kind] = {
+                "count": hist.count,
+                "p50_us": round(pct["p50"], 1) if pct["p50"] is not None else None,
+                "p99_us": round(pct["p99"], 1) if pct["p99"] is not None else None,
+            }
+        return out
+
+    def evaluate_slos(self, now: float = None) -> list:
+        """Evaluate the session's SLO rules right now (the health server and
+        the export flusher call this on their own cadence; sync boundaries do
+        it automatically under ``slo_eval_on_sync``). Returns alerts emitted
+        by this evaluation."""
+        return self.slo.observe_and_evaluate(self, now=now)
+
+    def slo_snapshot(self) -> Dict[str, Any]:
+        return self.slo.snapshot()
+
     def summary(
         self,
         brief: bool = False,
@@ -375,7 +488,10 @@ class TelemetryRecorder:
         ``"local"``. Local-only otherwise."""
         snap = self.counters.snapshot()
         if not fleet:
-            return snap.summary(brief=brief)
+            out = snap.summary(brief=brief)
+            if not brief:
+                out["latency"] = self.latency_summary()
+            return out
         fleet_snap = gather_counters(snap, process_group=process_group, dist_sync_fn=dist_sync_fn)
         out = fleet_snap.summary(brief=brief)
         out["local"] = snap.summary(brief=True)
@@ -394,6 +510,17 @@ class TelemetryRecorder:
         return tuple(e for e in self.events if e.kind in kinds)
 
     def close(self) -> None:
+        if self._closed:  # idempotent: a replaced-then-disabled session must
+            return        # not flush its histograms into the sinks twice
+        self._closed = True
+        # flush the final histogram state into the event stream before the
+        # sinks close: one ``hist`` event per (kind, key), so a JSONL trace
+        # carries the latency distributions ``tools/trace_report.py`` renders
+        # as percentile columns (bucket counts ride sparse — mostly zeros)
+        for kind, keys in self.histograms.snapshot().items():
+            for key, hist in keys.items():
+                if hist.count:
+                    self._event("hist", key, kind, payload=hist.summary())
         for sink in self.sinks:
             sink.close()
 
@@ -488,6 +615,48 @@ def gather_counters(
     if my_rank is not None and 0 <= my_rank < len(ranks):
         ranks[my_rank] = snapshot
     return aggregate_counters(ranks)
+
+
+def gather_histograms(
+    vector: Optional[list] = None,
+    process_group: Any = None,
+    dist_sync_fn: Any = None,
+    prefer_sync_rows: bool = True,
+) -> Dict[str, Histogram]:
+    """Merge every rank's per-kind latency/size histograms into fleet
+    distributions (``{kind: Histogram}`` — p99 across the POD, not per host).
+
+    Same transport contract as :func:`gather_counters`: the payload is one int
+    vector of :data:`histograms.FLEET_VECTOR_LEN` entries per rank (fieldwise
+    sum IS the exact merge), and a coalesced sync under the active session
+    already shipped every rank's vector inside its metadata collective — this
+    rollup reuses those rows and launches **zero extra collectives** (local
+    row refreshed live; remote rows as of each rank's last sync; pass
+    ``prefer_sync_rows=False`` to force a fresh ``gather_metadata_vector``
+    collective). Per-key histograms stay local, like per-key dispatch records.
+    """
+    if vector is None:
+        if _ACTIVE is None:
+            raise RuntimeError("gather_histograms needs an active telemetry session or an explicit vector")
+        vector = _ACTIVE.histograms.fleet_vector()
+    from ..parallel import coalesce as _coalesce  # lazy: parallel imports this module
+    from ..parallel import sync as _sync
+
+    rows: Any = None
+    my_rank: Optional[int] = None
+    if prefer_sync_rows and dist_sync_fn is None and process_group is None:
+        cached = _coalesce.fleet_histogram_rows()
+        if cached is not None:
+            rows, my_rank = cached
+    if rows is None:
+        rows = _sync.gather_metadata_vector(
+            vector, process_group=process_group, dist_sync_fn=dist_sync_fn
+        )
+    else:
+        rows = list(rows)
+        if my_rank is not None and 0 <= my_rank < len(rows):
+            rows[my_rank] = vector  # local row refreshed from the live registry
+    return aggregate_histograms(rows)
 
 
 @contextlib.contextmanager

@@ -42,6 +42,8 @@ COUNTER_FIELDS: Tuple[str, ...] = (
     "retries_exhausted",  # retry budgets that ran out on a transient failure
     "quarantines",  # metrics frozen by MetricCollection(on_error="quarantine")
     "skips",  # per-batch skips under on_error="skip"
+    "state_growths",  # list/cat states that crossed the unbounded-growth sentinel
+    "alerts",  # SLO engine alerts emitted (breaches + rule errors)
 )
 
 
@@ -263,6 +265,14 @@ class Counters:
     def record_quarantine(self, status: str) -> None:
         with self._lock:
             self._counts["quarantines" if status == "quarantined" else "skips"] += 1
+
+    def record_state_growth(self) -> None:
+        with self._lock:
+            self._counts["state_growths"] += 1
+
+    def record_alert(self) -> None:
+        with self._lock:
+            self._counts["alerts"] += 1
 
     # --------------------------------------------------------------- querying
 

@@ -20,6 +20,7 @@ import collections
 import dataclasses
 import json
 import os
+import threading
 from typing import Any, Callable, Dict, List, Mapping, Optional, Tuple
 
 # the closed set of event kinds the runtime emits
@@ -33,6 +34,8 @@ EVENT_KINDS: Tuple[str, ...] = (
     "retrace",  # a dispatch key saw a NEW shape/dtype signature (recompile)
     "d2h",  # an instrumented device→host readback
     "state_growth",  # a list/cat state crossed the unbounded-growth threshold
+    "alert",  # an SLO rule breached (or errored) — observability/slo.py
+    "hist",  # a latency/size histogram snapshot (flushed at session close)
 )
 
 
@@ -104,23 +107,29 @@ class RingBufferSink(Sink):
         self.capacity = capacity
         self._events: "collections.deque[TelemetryEvent]" = collections.deque(maxlen=capacity)
         self.evicted = 0  # how many events fell off the front
+        # server/flusher threads emit alerts while the training thread emits
+        # dispatches and readers snapshot — iterating a deque mid-append raises
+        self._emit_lock = threading.Lock()
 
     def emit(self, event: TelemetryEvent) -> None:
-        if len(self._events) == self.capacity:
-            self.evicted += 1  # deque(maxlen) drops the oldest on append
-        self._events.append(event)
+        with self._emit_lock:
+            if len(self._events) == self.capacity:
+                self.evicted += 1  # deque(maxlen) drops the oldest on append
+            self._events.append(event)
 
     @property
     def events(self) -> Tuple[TelemetryEvent, ...]:
-        return tuple(self._events)
+        with self._emit_lock:
+            return tuple(self._events)
 
     def of_kind(self, *kinds: str) -> Tuple[TelemetryEvent, ...]:
-        return tuple(e for e in self._events if e.kind in kinds)
+        return tuple(e for e in self.events if e.kind in kinds)
 
     def drain(self) -> Tuple[TelemetryEvent, ...]:
-        out = tuple(self._events)
-        self._events.clear()
-        return out
+        with self._emit_lock:
+            out = tuple(self._events)
+            self._events.clear()
+            return out
 
 
 class JSONLSink(Sink):
@@ -143,27 +152,34 @@ class JSONLSink(Sink):
         self._fh = None
         self._unflushed = 0
         self.written = 0
+        # the health plane emits from server/flusher threads too — the lazy
+        # open, the write, and the flush counter must not interleave with the
+        # training thread's events (a merged line is a silently dropped event)
+        self._emit_lock = threading.Lock()
 
     def emit(self, event: TelemetryEvent) -> None:
-        if self._fh is None:
-            self._fh = open(self.path, "a", encoding="utf-8")
-        self._fh.write(json.dumps(event.to_dict()) + "\n")
-        self.written += 1
-        self._unflushed += 1
-        if self._unflushed >= self.flush_every:
-            self._fh.flush()
-            self._unflushed = 0
+        line = json.dumps(event.to_dict()) + "\n"
+        with self._emit_lock:
+            if self._fh is None:
+                self._fh = open(self.path, "a", encoding="utf-8")
+            self._fh.write(line)
+            self.written += 1
+            self._unflushed += 1
+            if self._unflushed >= self.flush_every:
+                self._fh.flush()
+                self._unflushed = 0
 
     def close(self) -> None:
-        if self._fh is not None:
-            self._fh.flush()
-            try:
-                os.fsync(self._fh.fileno())
-            except OSError:  # non-seekable/pseudo files: flushed is the best we get
-                pass
-            self._fh.close()
-            self._fh = None
-            self._unflushed = 0
+        with self._emit_lock:
+            if self._fh is not None:
+                self._fh.flush()
+                try:
+                    os.fsync(self._fh.fileno())
+                except OSError:  # non-seekable/pseudo files: flushed is the best we get
+                    pass
+                self._fh.close()
+                self._fh = None
+                self._unflushed = 0
 
     def __enter__(self) -> "JSONLSink":
         return self

@@ -60,6 +60,7 @@ import numpy as np
 
 from .. import observability as _observability
 from ..observability.counters import COUNTER_FIELDS
+from ..observability.histograms import FLEET_VECTOR_LEN as _HIST_VEC_LEN
 
 Array = jax.Array
 Reduction = Union[str, Callable, None]
@@ -72,7 +73,9 @@ GATHER_DTYPES = (
 )
 
 _MAGIC = 0x436F414C  # "CoAL"
-_VERSION = 1
+# v2: the reserved telemetry tail grew a fixed histogram section (per-kind
+# latency/size totals — observability/histograms.py) after the counter halves
+_VERSION = 2
 _HEADER_LEN = 4  # [magic, version, n_leaves, n_counter_fields]
 _LEAF_REC_LEN = 2 + _MAX_RANK + 1  # [dtype_code, ndim, d0..d7, kind]
 _KIND_TENSOR = 0
@@ -141,16 +144,40 @@ def build_local_metadata(
     states: Sequence[Dict[str, Any]],
     reductions_list: Sequence[Mapping[str, Reduction]],
     counters_vector: Optional[Sequence[int]] = None,
+    hist_vector: Optional[Sequence[int]] = None,
 ) -> np.ndarray:
     """This rank's metadata row: leaf shapes/dtypes plus the (always-reserved)
-    telemetry counters section, as one int32 vector. Fixed length across ranks
-    for a given leaf table — the collective needs no shape negotiation."""
-    return _encode_metadata(_prepare_leaves(states, reductions_list), counters_vector)
+    telemetry counters + histogram sections, as one int32 vector. Fixed length
+    across ranks for a given leaf table — the collective needs no shape
+    negotiation."""
+    return _encode_metadata(_prepare_leaves(states, reductions_list), counters_vector, hist_vector)
 
 
-def _encode_metadata(leaves: Sequence[_Leaf], counters_vector: Optional[Sequence[int]]) -> np.ndarray:
+def _pack_halves(dest: np.ndarray, values: Sequence[int]) -> None:
+    """31-bit (hi, lo) int32 halves — same encoding as
+    ``gather_metadata_vector`` (int64 would silently downcast under jax's
+    default x64-disabled config)."""
+    vals = [int(v) for v in values]
+    dest[0::2] = [v >> 31 for v in vals]
+    dest[1::2] = [v & 0x7FFFFFFF for v in vals]
+
+
+def unpack_halves(halves: Sequence[int]) -> List[int]:
+    """Inverse of :func:`_pack_halves` — the single decode both piggyback row
+    kinds and ``gather_metadata_vector`` share."""
+    return [(int(hi) << 31) | int(lo) for hi, lo in zip(halves[0::2], halves[1::2])]
+
+
+def _encode_metadata(
+    leaves: Sequence[_Leaf],
+    counters_vector: Optional[Sequence[int]],
+    hist_vector: Optional[Sequence[int]] = None,
+) -> np.ndarray:
     n_fields = len(COUNTER_FIELDS)
-    vec = np.zeros(_HEADER_LEN + len(leaves) * _LEAF_REC_LEN + 2 * n_fields, np.int32)
+    vec = np.zeros(
+        _HEADER_LEN + len(leaves) * _LEAF_REC_LEN + 2 * n_fields + 2 * _HIST_VEC_LEN,
+        np.int32,
+    )
     vec[0], vec[1], vec[2], vec[3] = _MAGIC, _VERSION, len(leaves), n_fields
     for i, leaf in enumerate(leaves):
         rec = vec[_HEADER_LEN + i * _LEAF_REC_LEN :]
@@ -171,13 +198,17 @@ def _encode_metadata(leaves: Sequence[_Leaf], counters_vector: Optional[Sequence
                 for d, s in enumerate(arr.shape):
                     rec[2 + d] = s
         rec[2 + _MAX_RANK] = _KIND_LIST if leaf.is_list else _KIND_TENSOR
+    tail_at = _HEADER_LEN + len(leaves) * _LEAF_REC_LEN
     if counters_vector is not None:
         vals = [int(v) for v in counters_vector]
         if len(vals) != n_fields:
             raise ValueError(f"counters vector must have {n_fields} entries, got {len(vals)}")
-        tail = vec[_HEADER_LEN + len(leaves) * _LEAF_REC_LEN :]
-        tail[0::2] = [v >> 31 for v in vals]
-        tail[1::2] = [v & 0x7FFFFFFF for v in vals]
+        _pack_halves(vec[tail_at : tail_at + 2 * n_fields], vals)
+    if hist_vector is not None:
+        vals = [int(v) for v in hist_vector]
+        if len(vals) != _HIST_VEC_LEN:
+            raise ValueError(f"histogram vector must have {_HIST_VEC_LEN} entries, got {len(vals)}")
+        _pack_halves(vec[tail_at + 2 * n_fields :], vals)
     return vec
 
 
@@ -199,11 +230,12 @@ class _WorldPlan:
     leaf_plans: List[_LeafPlan]
     buckets: "Dict[Any, List[int]]"  # dtype -> leaf indices, first-appearance order
     counter_rows: List[List[int]]  # per-rank counters decoded from the piggyback
+    hist_rows: List[List[int]]  # per-rank fleet histogram vectors, same piggyback
 
 
 def _decode_rows(rows: Sequence[Any], n_leaves: int) -> List[np.ndarray]:
     decoded = []
-    expect_len = _HEADER_LEN + n_leaves * _LEAF_REC_LEN + 2 * len(COUNTER_FIELDS)
+    expect_len = _HEADER_LEN + n_leaves * _LEAF_REC_LEN + 2 * len(COUNTER_FIELDS) + 2 * _HIST_VEC_LEN
     for row in rows:
         arr = np.asarray(row).ravel()
         if arr.size != expect_len or not np.issubdtype(arr.dtype, np.integer):
@@ -276,13 +308,16 @@ def _plan_from_rows(rows: Sequence[Any], leaves: Sequence[_Leaf]) -> _WorldPlan:
         leaf_plans.append(_LeafPlan(dtype, dims, counts))
         buckets.setdefault(dtype, []).append(i)
     counter_rows = []
+    hist_rows = []
     tail_at = _HEADER_LEN + len(leaves) * _LEAF_REC_LEN
+    hist_at = tail_at + 2 * len(COUNTER_FIELDS)
     for row in decoded:
-        halves = row[tail_at:]
-        counter_rows.append(
-            [(int(hi) << 31) | int(lo) for hi, lo in zip(halves[0::2], halves[1::2])]
-        )
-    return _WorldPlan(world=world, leaf_plans=leaf_plans, buckets=buckets, counter_rows=counter_rows)
+        counter_rows.append(unpack_halves(row[tail_at:hist_at]))
+        hist_rows.append(unpack_halves(row[hist_at:]))
+    return _WorldPlan(
+        world=world, leaf_plans=leaf_plans, buckets=buckets,
+        counter_rows=counter_rows, hist_rows=hist_rows,
+    )
 
 
 def build_bucket_payload(
@@ -366,9 +401,11 @@ def coalesced_process_sync(
     leaves = _prepare_leaves(states, reductions_list)
     rec = _observability._ACTIVE
     counters_vec = None
+    hist_vec = None
     if rec is not None and dist_sync_fn is None:
         counters_vec = rec.counters.counts_vector()
-    meta = _encode_metadata(leaves, counters_vec)
+        hist_vec = rec.histograms.fleet_vector()
+    meta = _encode_metadata(leaves, counters_vec, hist_vec)
     gather = _make_gather(process_group, dist_sync_fn)
     try:
         rows = gather(meta)  # collective #1: the single up-front shape/metadata gather
@@ -395,6 +432,11 @@ def coalesced_process_sync(
         rows_b = gather(flat)  # one collective serves every leaf of this dtype
         if rec is not None:
             rec.counters.record_sync_collectives(1)
+            # payload-size distribution of the bucketed collective (metadata
+            # math only) — the few-large-vs-many-small observable of coalescing
+            rec.record_gather_payload(
+                "coalesced", int(flat.size) * jnp.dtype(flat.dtype).itemsize
+            )
         if len(rows_b) != plan.world:
             raise CoalesceFallback("bucket gather returned a different world size than the metadata")
         for r in range(plan.world):
@@ -426,7 +468,9 @@ def coalesced_process_sync(
 # fleet-counter piggyback mailbox
 # ---------------------------------------------------------------------------
 
-_FLEET_MAILBOX: Dict[str, Any] = {"session_epoch": None, "rows": None, "local_index": None}
+_FLEET_MAILBOX: Dict[str, Any] = {
+    "session_epoch": None, "rows": None, "hist_rows": None, "local_index": None,
+}
 
 
 def _deposit_fleet_rows(plan: _WorldPlan, rec: Any) -> None:
@@ -436,7 +480,26 @@ def _deposit_fleet_rows(plan: _WorldPlan, rec: Any) -> None:
     # reused by the next allocation, which would leak stale rows cross-session
     _FLEET_MAILBOX["session_epoch"] = getattr(rec, "_epoch", None)
     _FLEET_MAILBOX["rows"] = [list(r) for r in plan.counter_rows]
+    _FLEET_MAILBOX["hist_rows"] = [list(r) for r in plan.hist_rows]
     _FLEET_MAILBOX["local_index"] = jax.process_index()
+
+
+def _fleet_rows(field: str, row_len: int) -> Optional[Tuple[List[List[int]], int]]:
+    """Shared mailbox-validity discipline for both piggybacked row kinds:
+    rows exist, belong to the ACTIVE session's epoch, and have the expected
+    vector length — else ``None`` (the caller launches a fresh collective)."""
+    rec = _observability._ACTIVE
+    if (
+        rec is None
+        or _FLEET_MAILBOX[field] is None
+        or _FLEET_MAILBOX["session_epoch"] is None
+        or _FLEET_MAILBOX["session_epoch"] != getattr(rec, "_epoch", None)
+    ):
+        return None
+    rows = _FLEET_MAILBOX[field]
+    if any(len(r) != row_len for r in rows):
+        return None
+    return [list(r) for r in rows], int(_FLEET_MAILBOX["local_index"])
 
 
 def fleet_counter_rows() -> Optional[Tuple[List[List[int]], int]]:
@@ -445,22 +508,21 @@ def fleet_counter_rows() -> Optional[Tuple[List[List[int]], int]]:
     ran under the currently active telemetry session. Remote rows are as of
     each rank's last sync (a rank without an active session contributes
     zeros); the consumer replaces the local row with a fresh snapshot."""
-    rec = _observability._ACTIVE
-    if (
-        rec is None
-        or _FLEET_MAILBOX["rows"] is None
-        or _FLEET_MAILBOX["session_epoch"] is None
-        or _FLEET_MAILBOX["session_epoch"] != getattr(rec, "_epoch", None)
-    ):
-        return None
-    rows = _FLEET_MAILBOX["rows"]
-    if any(len(r) != len(COUNTER_FIELDS) for r in rows):
-        return None
-    return [list(r) for r in rows], int(_FLEET_MAILBOX["local_index"])
+    return _fleet_rows("rows", len(COUNTER_FIELDS))
+
+
+def fleet_histogram_rows() -> Optional[Tuple[List[List[int]], int]]:
+    """Per-rank fleet histogram vectors captured by the last coalesced sync's
+    metadata collective (same mailbox discipline as :func:`fleet_counter_rows`:
+    keyed to the active session's epoch, local row to be refreshed by the
+    consumer) — or ``None`` when no coalesced sync ran under this session."""
+    return _fleet_rows("hist_rows", _HIST_VEC_LEN)
 
 
 def clear_fleet_mailbox() -> None:
-    _FLEET_MAILBOX.update({"session_epoch": None, "rows": None, "local_index": None})
+    _FLEET_MAILBOX.update(
+        {"session_epoch": None, "rows": None, "hist_rows": None, "local_index": None}
+    )
 
 
 def gather_host_rows(

@@ -290,6 +290,9 @@ def _process_sync_per_leaf(
             # the real gather_all_arrays launches TWO collectives per leaf
             # (shape-vector exchange + payload); an injected fn is one call
             rec.counters.record_sync_collectives(1 if dist_sync_fn is not None else 2)
+            # per-collective payload size (metadata math) — contrast with the
+            # "coalesced" series: per-leaf syncs show many small collectives
+            rec.record_gather_payload("per_leaf", _payload_bytes({name: value}))
         if isinstance(value, list):  # concat list state: pre-concat, then gather
             local = (
                 jnp.concatenate([jnp.atleast_1d(jnp.asarray(v)) for v in value], axis=0)
@@ -340,12 +343,11 @@ def gather_metadata_vector(
     if dist_sync_fn is None and not distributed_available():
         return [vals]
     halves = np.empty(2 * len(vals), np.int32)
-    halves[0::2] = [v >> 31 for v in vals]
-    halves[1::2] = [v & 0x7FFFFFFF for v in vals]
-    out: List[List[int]] = []
-    for row in _coalesce.gather_host_rows(halves, process_group, dist_sync_fn):
-        out.append([(int(hi) << 31) | int(lo) for hi, lo in zip(row[0::2], row[1::2])])
-    return out
+    _coalesce._pack_halves(halves, vals)
+    return [
+        _coalesce.unpack_halves(row)
+        for row in _coalesce.gather_host_rows(halves, process_group, dist_sync_fn)
+    ]
 
 
 def _payload_bytes(state: Dict[str, Any]) -> int:

@@ -259,7 +259,12 @@ class RetryPolicy:
                 )
                 rec = _telemetry_active()
                 if rec is not None:
-                    rec.record_retry(describe or "metric dispatch", outcome.attempts, exc)
+                    # the accepted backoff delay feeds the retry_backoff
+                    # histogram — wall-clock a fleet spends waiting out
+                    # transient faults, not just how often it retried
+                    rec.record_retry(
+                        describe or "metric dispatch", outcome.attempts, exc, delay_s=delay
+                    )
                 if delay > 0:
                     self.sleep_fn(delay)
                 if on_retry is not None:
