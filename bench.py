@@ -51,6 +51,13 @@ ITERS = 400
 TELEMETRY_PROBE_STEPS = 8
 LATENCY_PROBE_STEPS = 24  # enough samples for a meaningful p99 column
 
+# Configs that additionally measure time-to-first-update cold vs warm through
+# the AOT compile cache (torchmetrics_tpu/aot/): three fresh subprocesses per
+# config — precompile (populates a temp cache), cold (no plane), warm (plane
+# enabled on the populated cache) — so each measurement pays its own full
+# trace/compile-or-load path, exactly like an autoscaled instance booting.
+TTFU_CONFIGS = ("ours", "collection_sync_16metrics", "bertscore_clipscore")
+
 
 def _telemetry_probe(probe) -> dict:
     """Per-config telemetry summary (compiles, retraces, d2h readbacks, sync
@@ -518,24 +525,15 @@ def bench_collection_sync() -> dict:
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
-    from torchmetrics_tpu import MetricCollection
     from torchmetrics_tpu import observability as obs
-    from torchmetrics_tpu.classification import (
-        MulticlassAccuracy,
-        MulticlassF1Score,
-        MulticlassPrecision,
-        MulticlassRecall,
-    )
     from torchmetrics_tpu.parallel import coalesce, shard_map as _shard_map
     from torchmetrics_tpu.parallel import sync as par_sync
 
     num_classes = 10
-    metrics = {
-        f"{cls.__name__}_{avg}": cls(num_classes, average=avg, validate_args=False)
-        for cls in (MulticlassAccuracy, MulticlassF1Score, MulticlassPrecision, MulticlassRecall)
-        for avg in ("micro", "macro", "weighted", "none")
-    }
-    collection = MetricCollection(dict(metrics), compute_groups=False)
+    # the 16-metric workload definition is shared with tools/warm_cache.py
+    # ("classification16") and the ttfu probes — one source of truth
+    collection, _ = _warm_cache_builders()["classification16"](num_classes=num_classes)
+    metrics = dict(collection.items(keep_base=True))
     rng = np.random.default_rng(11)
     preds = jnp.asarray(rng.normal(size=(4096, num_classes)).astype(np.float32))
     target = jnp.asarray(rng.integers(0, num_classes, 4096, dtype=np.int32))
@@ -611,6 +609,129 @@ def bench_collection_sync() -> dict:
     }
 
 
+def _warm_cache_builders():
+    """The canonical warm-start set builders from ``tools/warm_cache.py``,
+    loaded by path (runs in the measurement CHILD processes, where jax is
+    fine). One shared definition is what keeps the deploy-time cache, the
+    bench's warm column, and serving byte-identical — editing shapes in one
+    place cannot silently turn the others into cold compiles."""
+    import importlib.util
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    spec = importlib.util.spec_from_file_location(
+        "warm_cache", os.path.join(here, "tools", "warm_cache.py")
+    )
+    warm_cache = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(warm_cache)
+    return warm_cache.BUILDERS
+
+
+def _ttfu_spec(name: str):
+    """Build the config's metric (or collection) plus its representative
+    first batch, WITHOUT updating — the caller times the first update.
+    The jit-dispatched configs come from the shared warm-cache builders."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    if name == "ours":
+        return _warm_cache_builders()["flagship"](batch=BATCH, num_classes=NUM_CLASSES)
+    if name == "collection_sync_16metrics":
+        return _warm_cache_builders()["classification16"]()
+    if name == "bertscore_clipscore":
+        # the config's metric-level surface is CLIPScore with the same toy
+        # embedder the throughput config uses; it dispatches host-side, so
+        # this column measures (and documents) that the AOT plane cannot help
+        # eager metrics — warm ≈ cold is the honest expectation here
+        from torchmetrics_tpu.multimodal import CLIPScore
+
+        emb = rng.normal(size=(512, 64)).astype(np.float32)
+
+        class ToyClip:
+            def get_image_features(self, images):
+                return jnp.stack([jnp.asarray(i, jnp.float32).reshape(-1)[:64] for i in images])
+
+            def get_text_features(self, texts):
+                return jnp.stack([
+                    jnp.asarray(emb[[hash(w) % 512 for w in t.split()], :64].sum(0)) for t in texts
+                ])
+
+        vocab = ["alpha", "beta", "gamma", "delta", "epsilon", "zeta", "eta", "theta"]
+        sentences = [" ".join(rng.choice(vocab, 12)) for _ in range(64)]
+        imgs = [jnp.asarray(rng.random((3, 8, 8)).astype(np.float32)) for _ in range(64)]
+        return CLIPScore(model_name_or_path=ToyClip()), (imgs, sentences)
+    raise KeyError(name)
+
+
+def _ttfu_block_ready(obj) -> None:
+    import jax
+
+    states = [m._state for m in obj.values()] if hasattr(obj, "values") else [obj._state]
+    for state in states:
+        jax.block_until_ready([v for v in jax.tree.leaves(state) if hasattr(v, "block_until_ready")])
+
+
+def _ttfu_child(name: str, mode: str, aot_dir: str) -> None:
+    """One time-to-first-update measurement in THIS (fresh) process."""
+    from torchmetrics_tpu import aot
+
+    obj, args = _ttfu_spec(name)
+    if mode == "precompile":
+        aot.enable(aot_dir)
+        report = obj.precompile(*args)
+        rows = list(report.values())
+        # collection reports nest one {tag: row} per member
+        flat = [r for item in rows for r in (item.values() if isinstance(item, dict) and "status" not in item else [item])]
+        written = sum(1 for r in flat if isinstance(r, dict) and r.get("status") in ("written", "cached"))
+        print(json.dumps({"precompiled": written, "stats": aot.active_plane().stats}))
+        return
+    if mode == "warm":
+        aot.enable(aot_dir)
+    start = time.perf_counter()
+    obj.update(*args)
+    _ttfu_block_ready(obj)
+    out = {"time_to_first_update_s": round(time.perf_counter() - start, 4)}
+    if mode == "warm":
+        stats = dict(aot.active_plane().stats)
+        out["aot"] = {k: stats[k] for k in ("loads", "misses", "corrupt")}
+    print(json.dumps(out))
+
+
+def _ttfu_block(name: str) -> dict:
+    """Parent-side orchestration of one config's cold/warm columns (stdlib
+    only). A failure in any step reports ``ttfu_error`` instead of costing
+    the config its throughput numbers."""
+    import shutil
+    import tempfile
+
+    cache_dir = tempfile.mkdtemp(prefix="bench-aot-")
+    try:
+        steps = {}
+        for mode in ("precompile", "cold", "warm"):
+            res = subprocess.run(
+                [sys.executable, __file__, "--ttfu", name, "--mode", mode, "--aot-dir", cache_dir],
+                capture_output=True, text=True, timeout=900,
+            )
+            lines = (res.stdout or "").strip().splitlines()
+            if res.returncode != 0 or not lines:
+                crash = ((res.stderr or "") + "\n" + (res.stdout or "")).strip()
+                return {"ttfu_error": f"{mode}: {_crash_headline(crash)}"[:240]}
+            steps[mode] = json.loads(lines[-1])
+        cold = steps["cold"]["time_to_first_update_s"]
+        warm = steps["warm"]["time_to_first_update_s"]
+        out = {
+            "time_to_first_update_cold_s": cold,
+            "time_to_first_update_warm_s": warm,
+            "ttfu_warm_speedup_x": round(cold / warm, 2) if warm else None,
+            "ttfu_precompiled_programs": steps["precompile"].get("precompiled", 0),
+            "ttfu_warm_aot": steps["warm"].get("aot", {}),
+        }
+        return out
+    except Exception as err:  # noqa: BLE001 — the column must not cost the round
+        return {"ttfu_error": f"{type(err).__name__}: {err}"[:240]}
+    finally:
+        shutil.rmtree(cache_dir, ignore_errors=True)
+
+
 def bench_fault_selftest() -> dict:
     """Hidden config (leading underscore: excluded from the main run) proving the
     retry wrapper end to end: the FIRST subprocess attempt dies with the round-5
@@ -637,6 +758,13 @@ CONFIGS = {
 }
 
 MAX_ATTEMPTS = 3  # 2 retries — bounds a flaky pod's wall-clock to ~3x one config
+
+# Per-config extra re-attempts granted ONLY for transiently-classified crashes,
+# applied before the {"error", "transient": true} headline is emitted. The fid
+# probe still dies in-pod on remote_compile transport flakes (ROADMAP) — one
+# extra shot beyond the global budget has historically been enough to land its
+# headline, and deterministic failures never consume it.
+_EXTRA_TRANSIENT_ATTEMPTS = {"fid_inception_fwd": 1}
 
 
 # "ValueError:" / "jax.errors.JaxRuntimeError:" — the exception-report shape a
@@ -770,7 +898,8 @@ def _run_in_subprocess(name: str) -> dict:
     up to MAX_ATTEMPTS runs with exponential backoff; deterministic failures and
     exhausted budgets return the error as before, now with attempt accounting."""
     recovered_from = []
-    for attempt in range(1, MAX_ATTEMPTS + 1):
+    max_attempts = MAX_ATTEMPTS + _EXTRA_TRANSIENT_ATTEMPTS.get(name, 0)
+    for attempt in range(1, max_attempts + 1):
         out = _attempt_subprocess(name, attempt)
         err = out.get("error")
         # crash reports carry their own classifier verdict; in-band error
@@ -778,7 +907,7 @@ def _run_in_subprocess(name: str) -> dict:
         transient = out.get("transient", _is_transient_error_text(err) if err else False)
         if err is not None:
             out.setdefault("transient", transient)
-        if err is None or not transient or attempt == MAX_ATTEMPTS:
+        if err is None or not transient or attempt == max_attempts:
             out["attempts"] = attempt
             if recovered_from and err is None:
                 out["recovered_from"] = recovered_from
@@ -792,8 +921,16 @@ def main() -> None:
     if len(sys.argv) == 3 and sys.argv[1] == "--only":
         print(json.dumps(CONFIGS[sys.argv[2]]()))
         return
+    if len(sys.argv) == 7 and sys.argv[1] == "--ttfu":
+        _ttfu_child(sys.argv[2], sys.argv[4], sys.argv[6])
+        return
 
     results = {name: _run_in_subprocess(name) for name in CONFIGS if not name.startswith("_")}
+    # cold vs warm first-update columns (AOT compile cache) for the flagship +
+    # the two compile-dominated configs; each measurement is its own trio of
+    # fresh subprocesses so the numbers are honest boot costs
+    for name in TTFU_CONFIGS:
+        results[name].update(_ttfu_block(name))
     ours = results["ours"].get("updates_per_sec")
     baseline = results["torch_baseline"].get("updates_per_sec")
     vs = round(ours / baseline, 3) if ours and baseline else None
@@ -802,10 +939,14 @@ def main() -> None:
     for name in ("ours", "torch_baseline"):  # surface failures instead of a bare null
         if "error" in results[name]:
             extra[f"{name}_error"] = results[name]["error"]
-    # flagship latency columns ride extra so bench_compare gates them (the
-    # "ours" block itself never lands in the JSON line); a probe failure is
-    # surfaced rather than silently disarming the p99 gate columns
-    for col in ("update_p50_us", "update_p99_us", "latency_probe_error"):
+    # flagship latency + warm-start columns ride extra so bench_compare gates
+    # them (the "ours" block itself never lands in the JSON line); a probe
+    # failure is surfaced rather than silently disarming the gate columns
+    for col in (
+        "update_p50_us", "update_p99_us", "latency_probe_error",
+        "time_to_first_update_cold_s", "time_to_first_update_warm_s",
+        "ttfu_warm_speedup_x", "ttfu_precompiled_programs", "ttfu_warm_aot", "ttfu_error",
+    ):
         if col in results["ours"]:
             extra[col] = results["ours"][col]
     extra["torch_cpu_proxy_updates_per_sec"] = baseline

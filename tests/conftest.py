@@ -15,6 +15,9 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
+import hashlib
+import sys
+
 import numpy as np
 import pytest
 
@@ -36,6 +39,25 @@ def _assert_cpu_devices():
     assert jax.devices()[0].platform == "cpu"
     assert len(jax.devices()) == NUM_DEVICES
     yield
+
+
+@pytest.fixture(autouse=True)
+def _aot_cache_isolation(request, tmp_path_factory, monkeypatch):
+    """Every test sees its OWN AOT compile-cache directory (via the
+    ``TORCHMETRICS_TPU_AOT_CACHE`` default) and no plane leaks across tests —
+    a test that warms a cache must never hand a later test a warm start, and
+    nothing in the suite may touch a developer's shared ``~/.cache``. The
+    directory itself is created lazily by the plane, so tests that never
+    enable AOT pay one setenv and nothing else."""
+    leaf = hashlib.sha1(request.node.nodeid.encode("utf-8")).hexdigest()[:12]
+    monkeypatch.setenv(
+        "TORCHMETRICS_TPU_AOT_CACHE",
+        str(tmp_path_factory.getbasetemp() / "aot-cache" / leaf),
+    )
+    yield
+    aot_mod = sys.modules.get("torchmetrics_tpu.aot")
+    if aot_mod is not None and aot_mod._ACTIVE is not None:
+        aot_mod.disable()
 
 
 # --------------------------------------------------------------- skip pinning

@@ -1,0 +1,776 @@
+"""AOT compile cache + warm-start precompile plane (``torchmetrics_tpu/aot``).
+
+Pins the PR's acceptance contracts:
+
+- dispatch-key signature stability: permuted kwargs, weak-typed Python
+  scalars, and equivalent ``ShapeDtypeStruct`` inputs map to ONE key (a key
+  miss silently turns every warm start into a cold compile);
+- counter reconciliation extended: ``jit_compiles + jit_cache_hits +
+  aot_cache_hits == dispatches`` holds exactly, including under injected
+  cache corruption (corrupt entry → miss → fresh compile, never an error);
+- the ``jax.export`` vs ``jax.experimental.export`` version shim resolves on
+  this runtime and round-trips a program (parity-pinned like the PR 4
+  ``shard_map`` shim);
+- warm starts load bitwise-identical programs: values match the jit path.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import torchmetrics_tpu as tm
+from torchmetrics_tpu import MetricCollection, aot
+from torchmetrics_tpu import observability as obs
+from torchmetrics_tpu.aot import cache as aot_cache
+from torchmetrics_tpu.aot import codecs, compat, keys
+from torchmetrics_tpu.classification import MulticlassAccuracy, MulticlassF1Score
+from torchmetrics_tpu.metric import HostMetric, Metric
+from torchmetrics_tpu.parallel import mesh as par_mesh
+
+pytestmark = pytest.mark.aot
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class _Weighted(Metric):
+    """Tensor-state metric taking positional + keyword inputs (signature tests)."""
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.add_state("total", default=np.zeros(()), dist_reduce_fx="sum")
+
+    def _batch_state(self, x, *, weight=1.0, bias=0.0):
+        return {"total": (x * weight + bias).sum()}
+
+    def _compute(self, state):
+        return state["total"]
+
+
+class _HostSum(HostMetric):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.add_state("s", default=np.zeros(()), dist_reduce_fx="sum")
+
+    def _host_batch_state(self, x):
+        return {"s": jnp.asarray(np.asarray(x).sum())}
+
+    def _compute(self, state):
+        return state["s"]
+
+
+def _x(n=6):
+    return jnp.asarray(np.arange(n, dtype=np.float32))
+
+
+def _acc(ncls=5):
+    return MulticlassAccuracy(num_classes=ncls, average="micro", validate_args=False)
+
+
+def _batch(ncls=5, batch=128, seed=0):
+    rng = np.random.default_rng(seed)
+    preds = jnp.asarray(rng.normal(size=(batch, ncls)).astype(np.float32))
+    target = jnp.asarray(rng.integers(0, ncls, batch, dtype=np.int32))
+    return preds, target
+
+
+def _plane(tmp_path, **cfg):
+    return aot.enable(config=aot.AotConfig(cache_dir=str(tmp_path / "cache"), **cfg))
+
+
+# ------------------------------------------------------- signature stability
+
+
+def test_signature_kwargs_commute():
+    a = jnp.zeros((4, 3), jnp.float32)
+    s1 = keys.dispatch_signature(((a,), {"weight": _x(4), "bias": _x(4)}))
+    s2 = keys.dispatch_signature(((a,), dict(reversed(list({"weight": _x(4), "bias": _x(4)}.items())))))
+    assert s1 == s2
+    k1 = keys.cache_key(_Weighted(), "update", {}, ((a,), {"weight": _x(4), "bias": _x(4)}))
+    k2 = keys.cache_key(_Weighted(), "update", {}, ((a,), {"bias": _x(4), "weight": _x(4)}))
+    assert k1 == k2
+
+
+def test_signature_weak_python_scalars_value_free():
+    a = jnp.zeros((4,), jnp.float32)
+    # different VALUES, same key — jit keys on type, not value
+    assert keys.dispatch_signature(((a, 1.0), {})) == keys.dispatch_signature(((a, 2.5), {}))
+    assert keys.dispatch_signature(((a, 3), {})) == keys.dispatch_signature(((a, 7), {}))
+    # a python float and the weak f32 scalar jax traces it as are ONE key
+    assert keys.dispatch_signature(((a, 1.0), {})) == keys.dispatch_signature(((a, jnp.asarray(1.0)), {}))
+    # …but a STRONG f32 scalar is a different program, hence a different key
+    assert keys.dispatch_signature(((a, 1.0), {})) != keys.dispatch_signature(
+        ((a, jnp.asarray(1.0, jnp.float32)), {})
+    )
+    # int vs float scalars differ
+    assert keys.dispatch_signature(((a, 1), {})) != keys.dispatch_signature(((a, 1.0), {}))
+
+
+def test_signature_shapedtypestruct_equals_concrete():
+    concrete = jnp.zeros((8, 3), jnp.float32)
+    spec = jax.ShapeDtypeStruct((8, 3), jnp.float32)
+    assert keys.dispatch_signature(((concrete,), {})) == keys.dispatch_signature(((spec,), {}))
+    # numpy f64 canonicalizes to the f32 program jit would build
+    np64 = np.zeros((8, 3), np.float64)
+    assert keys.dispatch_signature(((np64,), {})) == keys.dispatch_signature(((concrete,), {}))
+    # shape and dtype changes still miss
+    assert keys.dispatch_signature(((concrete,), {})) != keys.dispatch_signature(
+        ((jnp.zeros((8, 4), jnp.float32),), {})
+    )
+    assert keys.dispatch_signature(((concrete,), {})) != keys.dispatch_signature(
+        ((jnp.zeros((8, 3), jnp.int32),), {})
+    )
+
+
+def test_structure_hash_separates_layouts():
+    a, b = _x(4), _x(4)
+    flat = ((a, b), {})
+    nested = (((a, b),), {})
+    # same leaves → same display signature (the counters' legacy view)…
+    assert keys.dispatch_signature(flat) == keys.dispatch_signature(nested)
+    # …but different calling conventions never share a cache entry
+    assert keys.structure_hash(flat) != keys.structure_hash(nested)
+    m = _Weighted()
+    assert keys.cache_key(m, "update", {}, flat) != keys.cache_key(m, "update", {}, nested)
+
+
+def test_memo_distinguishes_calling_conventions(tmp_path):
+    """Two conventions that flatten to the same leaves (positional vs kwarg)
+    must not share a memo slot: the second convention misses and compiles —
+    it never receives the first convention's executable (which would
+    TypeError on the dispatch path)."""
+    _plane(tmp_path)
+    x, w = _x(8), _x(8)
+
+    class _TwoArg(Metric):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.add_state("total", default=np.zeros(()), dist_reduce_fx="sum")
+
+        def _batch_state(self, a, b=None):
+            return {"total": (a * b).sum()}
+
+        def _compute(self, state):
+            return state["total"]
+
+    m = _TwoArg()
+    m.precompile(x, w)  # positional convention
+    aot.disable()
+    _plane(tmp_path)
+    warm = _TwoArg()
+    with obs.telemetry_session() as rec:
+        warm.update(x, w)       # positional: served from cache
+        warm.update(x, b=w)     # kwarg form: same leaves, different pytree
+    c = rec.counters.snapshot().counts
+    # the PLANE saw two distinct programs (one load, one probe+miss); the
+    # counters key on the flat signature, so the second dispatch lands in the
+    # jit_cache_hits bucket (the documented signature-novelty approximation)
+    # — the identity still reconciles exactly
+    assert c["aot_cache_hits"] == 1 and c["aot_cache_misses"] == 1
+    assert c["jit_compiles"] + c["jit_cache_hits"] + c["aot_cache_hits"] == c["dispatches"] == 2
+    ref = _TwoArg()
+    aot.disable()
+    ref.update(x, w)
+    ref.update(x, b=w)
+    assert np.array_equal(np.asarray(warm.compute()), np.asarray(ref.compute()))
+
+
+def test_warm_service_new_shape_is_not_a_retrace_storm(tmp_path):
+    """A service that precompiled many shapes is warm, not churning: retrace
+    events and the sentinel fire only on actual recompiles beyond a key's
+    first compile."""
+    _plane(tmp_path)
+    m = _acc()
+    for n in (8, 16, 32, 64):
+        m.precompile(*_batch(batch=n))
+    aot.disable()
+    _plane(tmp_path)
+    warm = _acc()
+    with obs.telemetry_session(obs.TelemetryConfig(retrace_warn_threshold=2)) as rec:
+        import warnings
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")  # any sentinel warning fails the test
+            for n in (8, 16, 32, 64):
+                warm.update(*_batch(batch=n))   # four aot loads, zero compiles
+            warm.update(*_batch(batch=128))     # ONE legitimate new-shape compile
+    snap = rec.counters.snapshot()
+    assert snap["aot_cache_hits"] == 4 and snap["jit_compiles"] == 1
+    assert snap["retraces"] == 0                 # the key's FIRST compile
+    assert rec.events_of("retrace") == ()
+
+
+def test_metric_config_shapes_the_key():
+    preds, target = _batch()
+    inputs = ((preds, target), {})
+    k_micro = keys.cache_key(_acc(), "update", {}, inputs)
+    macro = MulticlassAccuracy(num_classes=5, average="macro", validate_args=False)
+    top2 = MulticlassAccuracy(num_classes=5, average="micro", top_k=2, validate_args=False)
+    assert keys.cache_key(macro, "update", {}, inputs) != k_micro
+    assert keys.cache_key(top2, "update", {}, inputs) != k_micro
+    # distinct instances of the SAME construction share the key (that is the
+    # whole point: the cache outlives any one Python object)
+    assert keys.cache_key(_acc(), "update", {}, inputs) == k_micro
+
+
+def test_runtime_fingerprint_in_key(monkeypatch):
+    preds, target = _batch()
+    inputs = ((preds, target), {})
+    k1 = keys.cache_key(_acc(), "update", {}, inputs)
+    monkeypatch.setattr(par_mesh, "runtime_fingerprint", lambda mesh=None: "jax=9.9.9|backend=other")
+    k2 = keys.cache_key(_acc(), "update", {}, inputs)
+    assert k1 != k2
+    monkeypatch.undo()
+    real = par_mesh.runtime_fingerprint()
+    assert "jax=" in real and "backend=" in real and "ndev=" in real
+
+
+def test_package_version_is_a_coarse_invalidator(monkeypatch):
+    """The class-bytecode digest only sees the class's OWN methods; the
+    package version in the key guarantees a library upgrade misses even when
+    a thin delegator's bytecode is unchanged."""
+    preds, target = _batch()
+    inputs = ((preds, target), {})
+    k1 = keys.cache_key(_acc(), "update", {}, inputs)
+    assert f"pkg={keys.package_version()}" in k1
+    monkeypatch.setattr(keys, "package_version", lambda: "99.99.99")
+    assert keys.cache_key(_acc(), "update", {}, inputs) != k1
+
+
+def test_x64_mode_keys_in_runtime_fingerprint():
+    fp = par_mesh.runtime_fingerprint()
+    assert "x64=0" in fp  # the suite runs with x64 disabled
+    # scalar tokens derive from the live canonicalization, not hardcoded names
+    assert keys.dispatch_signature(((1.0,), {})).startswith(str(jax.dtypes.canonicalize_dtype(float)))
+
+
+def test_device_array_config_is_uncacheable(tmp_path):
+    """A config attribute holding a DEVICE array (baked-in constants) cannot
+    be identified without a D2H read — such metrics must be uncacheable
+    (permanent miss), never false-hittable across different constants."""
+
+    class _Scaled(Metric):
+        def __init__(self, scale, **kw):
+            super().__init__(**kw)
+            self.scale = scale  # a jax array: values are constant-folded into the program
+            self.add_state("total", default=np.zeros(()), dist_reduce_fx="sum")
+
+        def _batch_state(self, x):
+            return {"total": (x * self.scale).sum()}
+
+        def _compute(self, state):
+            return state["total"]
+
+    with pytest.raises(keys.UnfingerprintableConfig):
+        keys.metric_fingerprint(_Scaled(jnp.asarray([2.0])))
+    plane = _plane(tmp_path)
+    m = _Scaled(jnp.asarray([2.0]))
+    report = m.precompile(_x(4))
+    assert report["update"]["status"] == "skipped" and "uncacheable" in report["update"]["reason"]
+    # dispatch with the plane active: jit path owns it — no error, no probe
+    with obs.telemetry_session() as rec:
+        m.update(_x(4))
+    c = rec.counters.snapshot().counts
+    assert c["jit_compiles"] == 1 and c["aot_cache_misses"] == 0 and c["aot_cache_hits"] == 0
+    assert plane.stats["misses"] == 0
+    # numpy constants stay cacheable — and different values get different keys
+    k_np2 = keys.metric_fingerprint(_Scaled(np.asarray([2.0])))
+    k_np9 = keys.metric_fingerprint(_Scaled(np.asarray([9.0])))
+    assert k_np2 != k_np9
+
+
+def test_precompile_with_placeholders_skips_value_validation(tmp_path):
+    """Documented placeholder workflow: ShapeDtypeStruct examples precompile
+    even on metrics whose validate_args path reads input VALUES — and the
+    entry still warm-serves the real concrete batch."""
+    _plane(tmp_path)
+    m = MulticlassAccuracy(num_classes=5, average="micro")  # validate_args=True default
+    report = m.precompile(
+        jax.ShapeDtypeStruct((128, 5), jnp.float32), jax.ShapeDtypeStruct((128,), jnp.int32)
+    )
+    assert report["update"]["status"] == "written"
+    aot.disable()
+    _plane(tmp_path)
+    warm = MulticlassAccuracy(num_classes=5, average="micro")
+    preds, target = _batch()
+    with obs.telemetry_session() as rec:
+        warm.update(preds, target)
+    assert rec.counters.snapshot()["aot_cache_hits"] == 1
+
+
+def test_precompile_explicit_cache_dir_wins_over_active_plane(tmp_path):
+    plane_a = _plane(tmp_path)
+    dir_b = str(tmp_path / "bake-cache")
+    preds, target = _batch()
+    report = _acc().precompile(preds, target, cache_dir=dir_b)
+    assert report["update"]["status"] == "written"
+    assert plane_a.cache.scan()["entries"] == 0  # nothing leaked into the active plane
+    assert aot.AotCache(dir_b).scan()["entries"] == 1
+
+
+# ------------------------------------------------------------ cache container
+
+
+def test_cache_put_get_roundtrip_and_scan(tmp_path):
+    c = aot_cache.AotCache(str(tmp_path))
+    path = c.put("key-1", {"a": b"payload-a", "b": b"payload-bb"}, {"tag": "update"})
+    assert os.path.exists(path) and c.has("key-1")
+    entry = c.get("key-1")
+    assert entry.sections == {"a": b"payload-a", "b": b"payload-bb"}
+    assert entry.meta == {"tag": "update"}
+    assert c.get("other-key") is None
+    report = c.scan()
+    assert report["entries"] == 1 and report["undecodable"] == []
+    # same-key rewrite is atomic last-wins
+    c.put("key-1", {"a": b"v2"}, {})
+    assert c.get("key-1").sections == {"a": b"v2"}
+    assert c.clear() == 1 and c.get("key-1") is None
+
+
+@pytest.mark.parametrize("corruption", ["truncate", "bitflip", "magic", "empty", "header"])
+def test_cache_corruption_is_a_miss_never_an_error(tmp_path, corruption):
+    c = aot_cache.AotCache(str(tmp_path))
+    path = c.put("k", {"x": b"A" * 256}, {})
+    raw = bytearray(open(path, "rb").read())
+    if corruption == "truncate":
+        raw = raw[: len(raw) // 2]
+    elif corruption == "bitflip":
+        raw[-10] ^= 0xFF  # payload bit rot → checksum mismatch
+    elif corruption == "magic":
+        raw[:4] = b"XXXX"
+    elif corruption == "empty":
+        raw = bytearray()
+    elif corruption == "header":
+        raw[len(aot_cache.MAGIC) + 4 : len(aot_cache.MAGIC) + 8] = b"\x00\x00\x00\x00"
+    with open(path, "wb") as fh:
+        fh.write(bytes(raw))
+    assert c.get("k") is None
+    report = c.scan()
+    assert report["entries"] == 0 and len(report["undecodable"]) == 1
+
+
+def test_cache_prune_tmp(tmp_path):
+    c = aot_cache.AotCache(str(tmp_path))
+    open(os.path.join(c.root, ".tmp-123-dead"), "wb").write(b"partial")
+    assert c.prune_tmp() == 1
+    assert not any(n.startswith(".tmp-") for n in os.listdir(c.root))
+
+
+# ----------------------------------------------------------- export shim
+
+
+def test_export_shim_parity_and_roundtrip():
+    """The jax.export/jax.experimental.export shim resolves on this runtime
+    and round-trips a program — mirrors the PR 4 shard_map shim pinning."""
+    assert compat.export_available()
+    mod = compat.export_module()
+    assert hasattr(mod, "export") and hasattr(mod, "deserialize")
+    # whichever module generation resolved, it IS one of the two known homes
+    assert mod.__name__ in ("jax.export", "jax.experimental.export")
+    jf = jax.jit(lambda x: x * 2.0)
+    blob = codecs.encode_exported(jf, (jax.ShapeDtypeStruct((3,), jnp.float32),), {})
+    loaded = codecs.decode_exported(blob)
+    out = loaded(jnp.asarray([1.0, 2.0, 3.0]))
+    np.testing.assert_allclose(np.asarray(out), [2.0, 4.0, 6.0])
+
+
+def test_exec_codec_roundtrip_preserves_values():
+    # no donation: the plane caches undonated programs only (a deserialized
+    # executable's aliasing is invisible to python-side donation bookkeeping)
+    jf = jax.jit(lambda s, n, x: ({k: v + x.sum() for k, v in s.items()}, n + 1.0))
+    avals = (
+        {"t": jax.ShapeDtypeStruct((), jnp.float32)},
+        jax.ShapeDtypeStruct((), jnp.float32),
+        jax.ShapeDtypeStruct((4,), jnp.float32),
+    )
+    compiled = jf.lower(*avals).compile()
+    blob = codecs.encode_executable(compiled)
+    loaded = codecs.decode_executable(blob)
+    out = loaded({"t": jnp.asarray(1.0, jnp.float32)}, jnp.asarray(0.0, jnp.float32), _x(4))
+    assert float(out[0]["t"]) == 7.0 and float(out[1]) == 1.0
+    with pytest.raises(codecs.CodecError):
+        codecs.decode_executable(b"not a payload")
+
+
+# ----------------------------------------------- warm start through dispatch
+
+
+def test_precompile_then_warm_dispatch_reconciles(tmp_path):
+    """Acceptance core: populate → fresh metric serves its first update from
+    the cache; compiles + jit_cache_hits + aot_cache_hits == dispatches."""
+    _plane(tmp_path)
+    preds, target = _batch()
+    report = _acc().precompile(preds, target)
+    assert report["update"]["status"] == "written"
+    assert codecs.CODEC_EXEC in report["update"]["codecs"]
+
+    aot.disable()
+    plane = _plane(tmp_path)  # simulated reboot: new plane, same directory
+    warm = _acc()
+    with obs.telemetry_session() as rec:
+        warm.update(preds, target)
+        warm.update(preds, target)
+        value = warm.compute()
+    c = rec.counters.snapshot().counts
+    assert c["dispatches"] == 2
+    assert c["aot_cache_hits"] == 1 and c["jit_compiles"] == 0 and c["jit_cache_hits"] == 1
+    assert c["jit_compiles"] + c["jit_cache_hits"] + c["aot_cache_hits"] == c["dispatches"]
+    assert c["aot_cache_misses"] == 0 and c["aot_deserialize_us"] > 0
+    assert plane.stats["loads"] == 1
+    ev = rec.events_of("aot_load")
+    assert len(ev) == 1 and ev[0].payload["codec"] == codecs.CODEC_EXEC and ev[0].payload["nbytes"] > 0
+    # bitwise parity with the plain jit path
+    cold = _acc()
+    aot.disable()
+    cold.update(preds, target)
+    cold.update(preds, target)
+    assert np.array_equal(np.asarray(value), np.asarray(cold.compute()))
+    # per-tag attribution shows the aot hit
+    with obs.telemetry_session() as rec2:
+        aot.enable(config=aot.AotConfig(cache_dir=str(tmp_path / "cache")))
+        m3 = _acc()
+        m3.update(preds, target)
+        tags = rec2.metric_summary(m3)["tags"]
+    assert tags["update"]["aot_hits"] == 1 and tags["update"]["compiles"] == 0
+
+
+def test_corrupt_entry_misses_and_reconciles(tmp_path):
+    """Acceptance criterion verbatim: the reconciliation invariant holds
+    exactly under injected cache corruption — corrupt entry → miss → fresh
+    compile, no exception."""
+    plane = _plane(tmp_path)
+    preds, target = _batch()
+    _acc().precompile(preds, target)
+    (entry_file,) = [f for f in os.listdir(plane.cache.root) if f.endswith(".aot")]
+    path = os.path.join(plane.cache.root, entry_file)
+    raw = bytearray(open(path, "rb").read())
+    raw[len(raw) // 2] ^= 0xFF
+    with open(path, "wb") as fh:
+        fh.write(bytes(raw))
+
+    aot.disable()
+    plane = _plane(tmp_path)
+    m = _acc()
+    with obs.telemetry_session() as rec:
+        m.update(preds, target)  # corrupt → miss → fresh compile, no raise
+        m.update(preds, target)
+        value = m.compute()
+    c = rec.counters.snapshot().counts
+    assert c["jit_compiles"] == 1 and c["aot_cache_hits"] == 0 and c["aot_cache_misses"] == 1
+    assert c["jit_compiles"] + c["jit_cache_hits"] + c["aot_cache_hits"] == c["dispatches"] == 2
+    assert plane.stats["corrupt"] == 1
+    cold = _acc()
+    cold.update(preds, target)
+    cold.update(preds, target)
+    assert np.array_equal(np.asarray(value), np.asarray(cold.compute()))
+
+
+def test_warm_start_with_kwargs_and_scalars(tmp_path):
+    _plane(tmp_path)
+    x = _x(16)
+    m = _Weighted()
+    m.precompile(x, weight=2.0, bias=1.0)
+    aot.disable()
+    _plane(tmp_path)
+    warm = _Weighted()
+    with obs.telemetry_session() as rec:
+        warm.update(x, weight=3.0, bias=0.5)  # different VALUES, same program
+    c = rec.counters.snapshot().counts
+    assert c["aot_cache_hits"] == 1 and c["jit_compiles"] == 0
+    ref = _Weighted()
+    aot.disable()
+    ref.update(x, weight=3.0, bias=0.5)
+    assert np.array_equal(np.asarray(warm.compute()), np.asarray(ref.compute()))
+
+
+def test_forward_tag_precompiles_and_serves(tmp_path):
+    _plane(tmp_path)
+    preds, target = _batch()
+    report = _acc().precompile(preds, target, tags=("update", "forward"))
+    assert report["forward"]["status"] == "written"
+    aot.disable()
+    _plane(tmp_path)
+    warm = _acc()
+    with obs.telemetry_session() as rec:
+        val = warm.forward(preds, target)
+    c = rec.counters.snapshot().counts
+    assert c["aot_cache_hits"] == 1 and c["jit_compiles"] == 0
+    ref = _acc()
+    aot.disable()
+    assert np.array_equal(np.asarray(val), np.asarray(ref.forward(preds, target)))
+
+
+def test_collection_precompile_warms_every_member(tmp_path):
+    _plane(tmp_path)
+    ncls = 10
+    preds, target = _batch(ncls=ncls, batch=256)
+
+    def build():
+        return MetricCollection({
+            "acc": MulticlassAccuracy(ncls, average="micro", validate_args=False),
+            "f1": MulticlassF1Score(ncls, average="macro", validate_args=False),
+        }, compute_groups=False)
+
+    report = build().precompile(preds, target)
+    assert all(rows["update"]["status"] == "written" for rows in report.values())
+    aot.disable()
+    _plane(tmp_path)
+    warm = build()
+    with obs.telemetry_session() as rec:
+        warm.update(preds, target)
+        values = warm.compute()
+    c = rec.counters.snapshot().counts
+    assert c["jit_compiles"] == 0 and c["aot_cache_hits"] == 2
+    assert c["jit_compiles"] + c["jit_cache_hits"] + c["aot_cache_hits"] == c["dispatches"]
+    ref = build()
+    aot.disable()
+    ref.update(preds, target)
+    for k, v in ref.compute().items():
+        assert np.array_equal(np.asarray(values[k]), np.asarray(v))
+
+
+def test_second_member_instance_shares_entry(tmp_path):
+    """Content addressing: N identically-configured instances → ONE entry."""
+    plane = _plane(tmp_path)
+    preds, target = _batch()
+    _acc().precompile(preds, target)
+    report = _acc().precompile(preds, target)
+    assert report["update"]["status"] == "cached"
+    assert plane.cache.scan()["entries"] == 1
+
+
+def test_write_on_miss_self_warms(tmp_path):
+    plane = _plane(tmp_path, write_on_miss=True)
+    preds, target = _batch()
+    m = _acc()
+    with obs.telemetry_session() as rec:
+        m.update(preds, target)  # miss → compile → write-through
+    assert rec.counters.snapshot()["aot_cache_misses"] == 1
+    assert plane.stats["writes"] == 1 and plane.cache.scan()["entries"] == 1
+    aot.disable()
+    _plane(tmp_path)
+    warm = _acc()
+    with obs.telemetry_session() as rec2:
+        warm.update(preds, target)  # the NEXT boot is warm
+    assert rec2.counters.snapshot()["aot_cache_hits"] == 1
+
+
+def test_backend_without_exec_serialization_degrades_to_portable(tmp_path, monkeypatch):
+    """A backend whose PJRT refuses executable serialization still warm-starts
+    through the portable jax.export payload (skips trace+lowering; XLA
+    recompiles at load) instead of failing precompile outright."""
+    monkeypatch.setattr(
+        codecs, "encode_executable",
+        lambda compiled: (_ for _ in ()).throw(codecs.CodecError("backend refused")),
+    )
+    _plane(tmp_path)
+    preds, target = _batch()
+    report = _acc().precompile(preds, target)
+    assert report["update"]["status"] == "written"
+    assert report["update"]["codecs"] == [codecs.CODEC_HLO]
+    monkeypatch.undo()
+    aot.disable()
+    _plane(tmp_path)
+    warm = _acc()
+    with obs.telemetry_session() as rec:
+        warm.update(preds, target)
+    c = rec.counters.snapshot().counts
+    assert c["aot_cache_hits"] == 1 and c["jit_compiles"] == 0
+    assert rec.events_of("aot_load")[0].payload["codec"] == codecs.CODEC_HLO
+
+
+def test_placement_mismatch_demotes_to_jit_not_crash(tmp_path):
+    """Input placement/sharding is invisible to the shape/dtype key: a loaded
+    executable called with inputs on another device must demote to the jit
+    path (cached programs never donate, so the inputs are intact) — never an
+    exception on the dispatch path."""
+    _plane(tmp_path)
+    preds, target = _batch()
+    _acc().precompile(preds, target)
+    aot.disable()
+    _plane(tmp_path)
+    warm = _acc()
+    dev1 = jax.devices()[1]
+    p1, t1 = jax.device_put(preds, dev1), jax.device_put(target, dev1)
+    with obs.telemetry_session() as rec:
+        warm.update(p1, t1)  # placement mismatch → demote, no raise
+        value = warm.compute()
+    c = rec.counters.snapshot().counts
+    # the jit path actually served it: counted as a compile, and the slot's
+    # demotion registers as an aot miss — the identity stays exact
+    assert c["jit_compiles"] == 1 and c["aot_cache_hits"] == 0 and c["aot_cache_misses"] == 1
+    assert c["jit_compiles"] + c["jit_cache_hits"] + c["aot_cache_hits"] == c["dispatches"] == 1
+    ref = _acc()
+    aot.disable()
+    ref.update(preds, target)
+    assert np.array_equal(np.asarray(value), np.asarray(ref.compute()))
+
+
+def test_stale_runtime_fingerprint_misses(tmp_path, monkeypatch):
+    _plane(tmp_path)
+    preds, target = _batch()
+    _acc().precompile(preds, target)
+    aot.disable()
+    _plane(tmp_path)
+    # an upgraded runtime generation must never load yesterday's executables
+    monkeypatch.setattr(par_mesh, "runtime_fingerprint", lambda mesh=None: "jax=99.0|backend=tpu-v9")
+    m = _acc()
+    with obs.telemetry_session() as rec:
+        m.update(preds, target)
+    c = rec.counters.snapshot().counts
+    assert c["aot_cache_hits"] == 0 and c["aot_cache_misses"] == 1 and c["jit_compiles"] == 1
+
+
+def test_host_metric_precompile_skips_cleanly(tmp_path):
+    _plane(tmp_path)
+    report = _HostSum().precompile(_x())
+    assert report["update"]["status"] == "skipped"
+    # a heterogeneous collection stays total
+    coll = MetricCollection({"host": _HostSum(), "acc": _acc()})
+    rows = coll.precompile(*_batch())
+    assert rows["host"]["update"]["status"] == "skipped"
+    assert rows["acc"]["update"]["status"] in ("written", "cached")
+
+
+def test_jit_disabled_metric_skips(tmp_path):
+    _plane(tmp_path)
+    m = MulticlassAccuracy(num_classes=5, average="micro", validate_args=False, jit=False)
+    report = m.precompile(*_batch())
+    assert report["update"]["status"] == "skipped"
+    # and the dispatch path never consults the plane for it
+    with obs.telemetry_session() as rec:
+        m.update(*_batch())
+    assert rec.counters.snapshot()["aot_cache_misses"] == 0
+
+
+def test_memo_invalidation_on_set_dtype(tmp_path):
+    _plane(tmp_path)
+    preds, target = _batch()
+    m = _acc()
+    m.precompile(preds, target)
+    assert m.__dict__.get("_aot_memo")
+    m.set_dtype(jnp.bfloat16)
+    assert not m.__dict__.get("_aot_memo")  # stale programs dropped with the jit cache
+    clone = _acc()
+    clone.precompile(preds, target)
+    assert clone.clone().__dict__.get("_aot_memo", {}) == {}
+    import pickle
+
+    assert "_aot_memo" not in pickle.loads(pickle.dumps(clone)).__dict__
+
+
+def test_plane_disabled_is_default_and_inert(monkeypatch):
+    assert aot.active_plane() is None  # the conftest fixture guarantees no leak
+    # with the plane disabled, the dispatch path must never reach the plane —
+    # one module-attribute None-check is the whole overhead
+    calls = []
+    monkeypatch.setattr(aot.AotPlane, "lookup_dispatch", lambda *a, **k: calls.append(1))
+    m = _acc()
+    m.update(*_batch())
+    assert calls == []
+
+
+def test_aot_session_context_restores_previous():
+    with aot.aot_session() as plane:
+        assert aot.active_plane() is plane
+        with aot.aot_session() as inner:
+            assert aot.active_plane() is inner
+        assert aot.active_plane() is plane
+    assert aot.active_plane() is None
+
+
+# --------------------------------------------------- health-plane integration
+
+
+def test_aot_load_rides_fleet_histogram_vector(tmp_path):
+    from torchmetrics_tpu.observability import histograms as H
+
+    assert "aot_load" in H.FLEET_HISTOGRAM_KINDS
+    _plane(tmp_path)
+    preds, target = _batch()
+    _acc().precompile(preds, target)
+    aot.disable()
+    _plane(tmp_path)
+    m = _acc()
+    with obs.telemetry_session() as rec:
+        m.update(preds, target)
+        vec = rec.histograms.fleet_vector()
+    merged = H.aggregate_histograms([vec, vec])
+    assert merged["aot_load"].count == 2  # exact fieldwise-sum merge
+    assert rec.latency_summary()["aot_load"]["count"] == 1
+
+
+def test_counters_record_dispatch_aot_semantics():
+    """Unit pin of the extended invariant, including retrace accounting:
+    aot-served signatures never count as retraces."""
+    c = obs.Counters()
+    # second return element counts the key's COMPILES (not signatures): with
+    # no aot activity it equals the old distinct-signature count exactly
+    assert c.record_dispatch("M#0.update", "f32(4,)", aot_loaded=True) == (True, 0)
+    assert c.record_dispatch("M#0.update", "f32(4,)") == (False, 0)
+    assert c.record_dispatch("M#0.update", "f32(5,)") == (True, 1)  # first COMPILE
+    assert c.record_dispatch("M#0.update", "f32(6,)") == (True, 2)  # first retrace
+    snap = c.snapshot()
+    assert snap["aot_cache_hits"] == 1 and snap["jit_compiles"] == 2 and snap["jit_cache_hits"] == 1
+    assert snap["retraces"] == 1
+    assert snap["jit_compiles"] + snap["jit_cache_hits"] + snap["aot_cache_hits"] == snap["dispatches"]
+    rec = snap.per_key["M#0.update"]
+    assert rec["aot_hits"] == 1 and rec["compiles"] == 2
+    # fleet merge carries the aot fields
+    fleet = obs.aggregate_counters([snap, snap])
+    assert fleet["aot_cache_hits"] == 2
+    assert fleet.per_key["M#0.update"]["aot_hits"] == 2
+
+
+# ----------------------------------------------------------------- tooling
+
+
+def test_warm_cache_cli_populates_and_scans(tmp_path):
+    cache_dir = str(tmp_path / "cli-cache")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "warm_cache.py"),
+         "--cache-dir", cache_dir, "--set", "flagship", "--batch", "32"],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO,
+    )
+    assert res.returncode == 0, res.stderr[-2000:]
+    report = json.loads(res.stdout)
+    assert report["sets"]["flagship"]["counts"]["written"] == 1
+    res2 = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "warm_cache.py"),
+         "--cache-dir", cache_dir, "--scan"],
+        capture_output=True, text=True, timeout=120, env=env, cwd=REPO,
+    )
+    assert res2.returncode == 0
+    scan = json.loads(res2.stdout)
+    assert scan["entries"] == 1 and scan["undecodable"] == []
+    # the populated cache actually warm-starts a fresh metric in-process
+    aot.enable(cache_dir)
+    m = MulticlassAccuracy(num_classes=5, average="micro", validate_args=False)
+    preds = jnp.zeros((32, 5), jnp.float32)
+    target = jnp.zeros((32,), jnp.int32)
+    with obs.telemetry_session() as rec:
+        m.update(preds, target)
+    assert rec.counters.snapshot()["aot_cache_hits"] == 1
+
+
+def test_bench_ttfu_specs_build():
+    """The bench's time-to-first-update builders construct without updating
+    (cheap smoke — the full trio runs real subprocesses in the bench)."""
+    sys.path.insert(0, REPO)
+    try:
+        import bench
+
+        for name in bench.TTFU_CONFIGS:
+            obj, args = bench._ttfu_spec(name)
+            assert hasattr(obj, "update") and isinstance(args, tuple)
+        assert set(bench.TTFU_CONFIGS) <= set(bench.CONFIGS)
+    finally:
+        sys.path.remove(REPO)

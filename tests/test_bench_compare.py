@@ -140,6 +140,63 @@ def test_missing_config_reported_but_not_gated(tmp_path):
     assert report["verdict"] == "ok"
 
 
+def test_strict_missing_gates_dropped_configs(tmp_path):
+    """--strict-missing (PR 6 satellite): a config silently dropped from the
+    newer round is listed in every report and, under --check --strict-missing,
+    fails the gate that would otherwise say 'no regressions'."""
+    healthy = _round(1, 30000.0)
+    errored = _round(2, 30000.0)
+    errored["parsed"]["extra"]["fid_inception_fwd"] = {"error": "INTERNAL: remote_compile: ..."}
+    paths = _write_rounds(tmp_path, [healthy, errored])
+    report = bench_compare.compare_rounds(paths)
+    assert report["missing"] == 1
+    assert report["transitions"][0]["missing"] == ["extra.fid_inception_fwd.images_per_sec_bfloat16"]
+    # the default text report lists the dropped metrics by name
+    text = bench_compare.render_report(report)
+    assert "missing from" in text and "images_per_sec_bfloat16" in text
+    # default gate: passes; strict gate: fails; strict with nothing missing: passes
+    assert bench_compare.main(paths + ["--check"]) == 0
+    assert bench_compare.main(paths + ["--check", "--strict-missing"]) == 1
+    same_dir = tmp_path / "same"
+    same_dir.mkdir()
+    same = _write_rounds(same_dir, [healthy, _round(2, 30000.0)])
+    assert bench_compare.main(same + ["--check", "--strict-missing"]) == 0
+
+
+def test_ttfu_columns_direction_and_gate(tmp_path):
+    """time_to_first_update columns (AOT warm start): cold/warm gate in the
+    lower direction, the speedup ratio in the higher direction — a warm path
+    that silently falls back to compiling trips --check."""
+    assert bench_compare.direction("extra.time_to_first_update_cold_s") == "lower"
+    assert bench_compare.direction("extra.time_to_first_update_warm_s") == "lower"
+    assert bench_compare.direction("extra.ttfu_warm_speedup_x") == "higher"
+    assert bench_compare.direction("extra.ttfu_precompiled_programs") is None
+    good = _round(1, 30000.0, extra_overrides={
+        "time_to_first_update_cold_s": 0.25, "time_to_first_update_warm_s": 0.03,
+        "ttfu_warm_speedup_x": 8.3,
+    })
+    # the warm path regressing to ~cold (a silently broken cache) must gate
+    broken = _round(2, 30000.0, extra_overrides={
+        "time_to_first_update_cold_s": 0.25, "time_to_first_update_warm_s": 0.24,
+        "ttfu_warm_speedup_x": 1.04,
+    })
+    paths = _write_rounds(tmp_path, [good, broken])
+    report = bench_compare.compare_rounds(paths)
+    reg = {r["metric"] for t in report["transitions"] for r in t["rows"] if r["verdict"] == "regression"}
+    assert "extra.time_to_first_update_warm_s" in reg
+    assert "extra.ttfu_warm_speedup_x" in reg
+    # ordinary shared-pod wobble stays inside the thresholds
+    wobble = _round(2, 30000.0, extra_overrides={
+        "time_to_first_update_cold_s": 0.31, "time_to_first_update_warm_s": 0.035,
+        "ttfu_warm_speedup_x": 8.9,
+    })
+    wobble_dir = tmp_path / "wobble"
+    wobble_dir.mkdir()
+    paths = _write_rounds(wobble_dir, [good, wobble])
+    report = bench_compare.compare_rounds(paths)
+    assert report["verdict"] == "ok" and report["missing"] == 0
+
+
 def test_per_metric_threshold_override():
     prev = bench_compare.extract_metrics(_round(1, 30000.0))
     cur = bench_compare.extract_metrics(_round(2, 27000.0))  # -10%

@@ -368,6 +368,52 @@ def test_bench_retry_wrapper_records_recovery():
     assert "response body closed" in out["recovered_from"][0]
 
 
+def test_bench_fid_gets_one_extra_transient_attempt(monkeypatch):
+    """PR 6 satellite: the fid probe's remote_compile transport flake gets ONE
+    re-attempt beyond the global budget before the {"error", "transient"}
+    headline is emitted — and deterministic failures never consume it."""
+    import bench
+
+    calls = []
+
+    def fake_attempt(name, attempt):
+        calls.append(attempt)
+        return {"error": "INTERNAL: stream terminated by RST_STREAM", "transient": True}
+
+    monkeypatch.setattr(bench, "_attempt_subprocess", fake_attempt)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    out = bench._run_in_subprocess("fid_inception_fwd")
+    assert out["attempts"] == bench.MAX_ATTEMPTS + 1 == len(calls)
+    assert out["transient"] is True and "error" in out
+
+    # a non-fid config keeps the global budget
+    calls.clear()
+    out = bench._run_in_subprocess("coco_map_synthetic")
+    assert out["attempts"] == bench.MAX_ATTEMPTS == len(calls)
+
+    # the extra shot can actually SAVE the headline on the final attempt
+    def flaky_until_last(name, attempt):
+        calls.append(attempt)
+        if attempt <= bench.MAX_ATTEMPTS:
+            return {"error": "INTERNAL: stream terminated by RST_STREAM", "transient": True}
+        return {"ok": True}
+
+    calls.clear()
+    monkeypatch.setattr(bench, "_attempt_subprocess", flaky_until_last)
+    out = bench._run_in_subprocess("fid_inception_fwd")
+    assert out.get("ok") is True and out["attempts"] == bench.MAX_ATTEMPTS + 1
+    assert len(out["recovered_from"]) == bench.MAX_ATTEMPTS
+
+    # deterministic failures surface immediately — no extra attempt burned
+    calls.clear()
+    monkeypatch.setattr(
+        bench, "_attempt_subprocess",
+        lambda name, attempt: (calls.append(attempt), {"error": "INVALID_ARGUMENT: bad shapes", "transient": False})[1],
+    )
+    out = bench._run_in_subprocess("fid_inception_fwd")
+    assert out["attempts"] == 1 == len(calls)
+
+
 def test_bench_config_names_hidden_from_main_run():
     import bench
 

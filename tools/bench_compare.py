@@ -21,14 +21,18 @@ sync latencies, the torch-CPU proxy denominator) carry wider built-in
 overrides. ``--threshold`` changes the default; ``--threshold-for NAME=FRAC``
 (repeatable) overrides one metric.
 
-A config missing from the newer round (e.g. a config that errored that round —
-the bench's retry layer already surfaces those) is reported but never gates:
-the gate only judges metrics present on both sides.
+A metric missing from the newer round (e.g. a config that errored that round —
+the bench's retry layer already surfaces those) is listed in every report
+under a dedicated "missing" line. By default it never gates — the gate only
+judges metrics present on both sides — but ``--strict-missing`` makes
+``--check`` fail on silently dropped metrics too, so a config that quietly
+stops reporting cannot slip past CI as "no regressions".
 
 Usage::
 
     python tools/bench_compare.py BENCH_r0*.json            # report
     python tools/bench_compare.py BENCH_r0*.json --check    # exit 1 on regression
+    python tools/bench_compare.py a.json b.json --check --strict-missing
     python tools/bench_compare.py prev.json cur.json --json # machine-readable
 """
 
@@ -69,6 +73,20 @@ THRESHOLDS: Dict[str, float] = {
     "extra.collection_sync_16metrics.update_p50_us": 0.6,
     "extra.collection_sync_16metrics.update_p99_us": 0.6,
     "extra.collection_sync_16metrics.sync_p99_us": 0.6,
+    # time-to-first-update (AOT warm-start plane): cold numbers are dominated
+    # by XLA compile wall-clock, which wobbles hard on a shared pod; warm
+    # numbers are deserialize+dispatch and wobble less but are small absolute
+    # values. Lower-direction via the "time" marker; gate order-of-magnitude
+    # regressions (a warm path that silently falls back to compiling is ~5-8x).
+    "extra.time_to_first_update_cold_s": 0.6,
+    "extra.time_to_first_update_warm_s": 0.6,
+    "extra.ttfu_warm_speedup_x": 0.5,
+    "extra.bertscore_clipscore.time_to_first_update_cold_s": 0.6,
+    "extra.bertscore_clipscore.time_to_first_update_warm_s": 0.6,
+    "extra.bertscore_clipscore.ttfu_warm_speedup_x": 0.5,
+    "extra.collection_sync_16metrics.time_to_first_update_cold_s": 0.6,
+    "extra.collection_sync_16metrics.time_to_first_update_warm_s": 0.6,
+    "extra.collection_sync_16metrics.ttfu_warm_speedup_x": 0.5,
 }
 
 _HIGHER_MARKERS = ("per_sec", "speedup", "throughput")
@@ -78,8 +96,10 @@ _LOWER_MARKERS = ("latency", "compile", "_sec", "_ms", "_us", "_bytes", "bytes_"
 # a move back toward per-leaf collectives must gate even though the name
 # carries no latency/throughput marker
 _LOWER_EXACT = ("collectives_per_sync",)
-# deterministic workload constants of the coalesced-sync config (leaf counts)
-_INFO_EXACT = ("leaves_coalesced_per_sync", "per_leaf_collectives")
+# deterministic workload constants: the coalesced-sync config's leaf counts,
+# and the warm-start column's program count ("precompiled" would otherwise
+# match the "compile" latency marker and gate a constant)
+_INFO_EXACT = ("leaves_coalesced_per_sync", "per_leaf_collectives", "ttfu_precompiled_programs")
 
 
 def direction(name: str) -> Optional[str]:
@@ -183,15 +203,19 @@ def compare_rounds(
     docs = [load_round(p) for p in paths]
     transitions = []
     regressions = 0
+    missing_total = 0
     for i in range(1, len(docs)):
         rows = compare_metrics(docs[i - 1], docs[i], threshold=threshold, overrides=overrides)
         n_reg = sum(1 for r in rows if r["verdict"] == "regression")
+        missing = [r["metric"] for r in rows if r["verdict"] == "missing"]
         regressions += n_reg
+        missing_total += len(missing)
         transitions.append({
             "from": paths[i - 1], "to": paths[i], "rows": rows,
-            "regressions": n_reg,
+            "regressions": n_reg, "missing": missing,
         })
     return {"transitions": transitions, "regressions": regressions,
+            "missing": missing_total,
             "verdict": "regression" if regressions else "ok"}
 
 
@@ -235,9 +259,17 @@ def render_report(report: Dict[str, Any], verbose: bool = False) -> str:
         lines.append("  ".join(h.ljust(w) for h, w in zip(headers, widths)))
         for row in table:
             lines.append("  ".join(c.ljust(w) for c, w in zip(row, widths)))
+        if tr.get("missing"):
+            # silently dropped metrics get their own line even in the terse
+            # report — a config that stops reporting must stay visible
+            lines.append(
+                f"  missing from {tr['to']} ({len(tr['missing'])}, gated only "
+                f"under --strict-missing): " + ", ".join(tr["missing"])
+            )
         lines.append("")
     lines.append(
-        f"verdict: {report['verdict'].upper()} ({report['regressions']} regression(s) "
+        f"verdict: {report['verdict'].upper()} ({report['regressions']} regression(s), "
+        f"{report.get('missing', 0)} missing metric(s) "
         f"across {len(report['transitions'])} transition(s))"
     )
     return "\n".join(lines)
@@ -248,6 +280,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("rounds", nargs="+", help="two or more BENCH_*.json round files, oldest first")
     parser.add_argument("--check", action="store_true",
                         help="exit nonzero when any transition regresses (the CI gate)")
+    parser.add_argument("--strict-missing", action="store_true",
+                        help="with --check: also fail on metrics present in an older "
+                             "round but missing from a newer one (silently dropped configs)")
     parser.add_argument("--threshold", type=float, default=DEFAULT_THRESHOLD,
                         help=f"default relative-regression threshold (default {DEFAULT_THRESHOLD})")
     parser.add_argument("--threshold-for", action="append", default=[], metavar="NAME=FRAC",
@@ -269,6 +304,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     else:
         print(render_report(report, verbose=args.verbose))
     if args.check and report["regressions"]:
+        return 1
+    if args.check and args.strict_missing and report.get("missing", 0):
         return 1
     return 0
 

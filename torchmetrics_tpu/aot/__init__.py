@@ -1,0 +1,413 @@
+"""Persistent AOT compile cache + warm-start precompile plane.
+
+BENCH_r05's cold-path profile is compilation all the way down: BERTScore
+spends 6.7 s and CLIPScore 11.3 s compiling against millisecond-scale
+steady-state updates, which makes a freshly-booted autoscaled instance
+useless for seconds to minutes. The unit worth persisting is the **compiled
+program**, not the Python object: this plane serializes the jitted
+update/forward executables keyed by the same ``(callable, shape/dtype
+signature)`` identity the compile counters already track, parks them in an
+on-disk content-addressed cache, and teaches ``Metric._donation_safe_dispatch``
+to LOAD a program for a first-seen signature instead of compiling it.
+
+Usage::
+
+    from torchmetrics_tpu import aot
+
+    # boot-time warm start (or: python tools/warm_cache.py --set flagship)
+    aot.enable("/var/cache/metrics-aot")
+    metric.precompile(example_preds, example_target)     # populates the cache
+
+    # …in the serving process (same cache dir):
+    aot.enable("/var/cache/metrics-aot")
+    metric.update(preds, target)      # loads the executable — no compile
+
+Design contracts:
+
+- **Opt-in, zero overhead when disabled**: the dispatch hot path reads one
+  module attribute (``_ACTIVE``) — the same discipline as the telemetry layer.
+- **Stale-safe keys**: the cache key carries the jax/jaxlib/backend/device
+  fingerprint (``parallel.mesh.runtime_fingerprint``) plus the metric's code
+  + config fingerprint, so an upgraded runtime or a changed metric MISSES;
+  it never loads a wrong program.
+- **Corruption is a miss**: undecodable bytes anywhere (container, header,
+  checksum, codec payload) fall back to a fresh compile — never an exception
+  on the dispatch path.
+- **Counters reconcile exactly**: with a telemetry session active,
+  ``jit_compiles + jit_cache_hits + aot_cache_hits == dispatches`` holds —
+  a dispatch is served by exactly one of {fresh compile, in-memory program,
+  cache load}. ``aot_cache_misses`` and ``aot_deserialize_us`` ride along,
+  and every load emits an ``aot_load`` telemetry event + histogram sample.
+
+See ``docs/performance.md`` ("Cold start & warm start") for key anatomy,
+invalidation rules, and the ``tools/warm_cache.py`` boot workflow.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from typing import Any, Dict, Mapping, Optional, Tuple
+
+from . import codecs, compat, keys
+from .cache import AotCache
+from .keys import CACHE_FORMAT_VERSION, cache_key, dispatch_signature, metric_fingerprint
+
+__all__ = [
+    "AotCache",
+    "AotConfig",
+    "AotPlane",
+    "CACHE_FORMAT_VERSION",
+    "DEFAULT_CACHE_ENV",
+    "active_plane",
+    "aot_session",
+    "cache_key",
+    "codecs",
+    "compat",
+    "default_cache_dir",
+    "disable",
+    "dispatch_signature",
+    "enable",
+    "enabled",
+    "keys",
+    "metric_fingerprint",
+]
+
+#: environment override for the default cache directory (the test suite's
+#: conftest points this at a per-test tmp dir so tests never share a cache)
+DEFAULT_CACHE_ENV = "TORCHMETRICS_TPU_AOT_CACHE"
+
+
+def default_cache_dir() -> str:
+    env = os.environ.get(DEFAULT_CACHE_ENV)
+    if env:
+        return env
+    return os.path.join(os.path.expanduser("~"), ".cache", "torchmetrics_tpu", "aot")
+
+
+@dataclasses.dataclass(frozen=True)
+class AotConfig:
+    """Knobs for one AOT plane.
+
+    Args:
+        cache_dir: on-disk cache root (default: ``$TORCHMETRICS_TPU_AOT_CACHE``
+            or ``~/.cache/torchmetrics_tpu/aot``).
+        store_portable: also write the ``jax.export`` StableHLO payload next
+            to the native executable — loads on a runtime whose executable
+            format drifted still skip trace+lowering (XLA recompiles).
+        write_on_miss: write-through — after a dispatch-time cache miss
+            compiles fresh, serialize that program into the cache so the NEXT
+            boot warm-starts. Costs one extra AOT re-lower+compile per new
+            signature (same price as cost accounting), so it is off by
+            default; turn it on in long-lived services, keep it off in
+            one-shot jobs.
+    """
+
+    cache_dir: Optional[str] = None
+    store_portable: bool = True
+    write_on_miss: bool = False
+
+
+class _DispatchEntry:
+    """Per-``(tag, signature, structure)`` memo slot on a metric instance.
+
+    ``compiled is None`` marks a remembered miss (the jit path owns this
+    signature for the rest of the process — no repeat disk probes).
+    ``event_pending``/``miss_pending`` are one-shot flags consumed the first
+    time a telemetry session observes the dispatch, so counters/events land
+    even when the session starts after the plane.
+    """
+
+    __slots__ = ("compiled", "key", "signature", "codec", "nbytes", "load_s",
+                 "source", "event_pending", "miss_pending", "store_pending")
+
+    def __init__(self, compiled: Any, key: str, signature: str, codec: str = "",
+                 nbytes: int = 0, load_s: float = 0.0, source: str = "disk",
+                 event_pending: bool = False, miss_pending: bool = False,
+                 store_pending: bool = False) -> None:
+        self.compiled = compiled
+        self.key = key
+        self.signature = signature
+        self.codec = codec
+        self.nbytes = nbytes
+        self.load_s = load_s
+        self.source = source
+        self.event_pending = event_pending
+        self.miss_pending = miss_pending
+        self.store_pending = store_pending
+
+
+class AotPlane:
+    """The live plane: one on-disk cache + per-process load bookkeeping."""
+
+    def __init__(self, config: Optional[AotConfig] = None) -> None:
+        self.config = config or AotConfig()
+        self.cache = AotCache(self.config.cache_dir or default_cache_dir())
+        # host-side stats independent of any telemetry session (the CLI and
+        # the bench warm-start probes read these)
+        self.stats: Dict[str, int] = {
+            "loads": 0, "misses": 0, "corrupt": 0, "writes": 0, "load_ns": 0,
+        }
+
+    # ------------------------------------------------------------ dispatch path
+
+    def lookup_dispatch(
+        self, metric: Any, tag: str, tensors: Mapping[str, Any], inputs: Optional[tuple]
+    ) -> Optional[_DispatchEntry]:
+        """Resolve one dispatch against the cache (memo → disk → miss).
+
+        Returns a :class:`_DispatchEntry` whose ``compiled`` is the loaded
+        program, or one marking a remembered miss, or ``None`` when the
+        dispatch cannot be keyed at all (no inputs metadata)."""
+        if inputs is None:
+            return None
+        memo = metric.__dict__.get("_aot_memo")
+        if memo is None:
+            memo = metric.__dict__.setdefault("_aot_memo", {})
+        # the memo key carries the structure hash too: two calling
+        # conventions can flatten to the same leaf signature, and handing one
+        # the other's executable would TypeError on the dispatch path
+        sig, tree = keys.dispatch_signature_parts(inputs)
+        memo_key = (tag, sig, tree)
+        slot = memo.get(memo_key)
+        if slot is not None:
+            return slot
+        try:
+            key = keys.cache_key(metric, tag, tensors, inputs, signature=sig, tree_hash=tree)
+        except keys.UnfingerprintableConfig:
+            # the metric cannot be safely identified (device-array config) —
+            # permanently uncacheable: the jit path owns every signature, no
+            # disk probes, no miss counting (nothing was probed)
+            slot = _DispatchEntry(None, "", sig, source="unfingerprintable")
+            memo[memo_key] = slot
+            return slot
+        t0 = time.perf_counter()
+        entry = self.cache.get(key)
+        if entry is None:
+            # an entry file that EXISTS but failed container validation
+            # (magic/header/checksum/truncation) is corruption, not absence —
+            # both are misses, but the distinction matters to an operator
+            if os.path.exists(self.cache.path_for(key)):
+                self.stats["corrupt"] += 1
+            self.stats["misses"] += 1
+            slot = _DispatchEntry(
+                None, key, sig, miss_pending=True,
+                store_pending=self.config.write_on_miss,
+            )
+            memo[memo_key] = slot
+            return slot
+        donate = tuple(entry.meta.get("donate", ()))
+        try:
+            compiled, codec = codecs.decode_entry(entry.sections, donate)
+        except codecs.CodecError:
+            # every payload in the entry is undecodable on this runtime —
+            # treat as corruption: miss, fresh compile, no exception
+            self.stats["corrupt"] += 1
+            self.stats["misses"] += 1
+            slot = _DispatchEntry(
+                None, key, sig, miss_pending=True,
+                store_pending=self.config.write_on_miss,
+            )
+            memo[memo_key] = slot
+            return slot
+        load_s = time.perf_counter() - t0
+        self.stats["loads"] += 1
+        self.stats["load_ns"] += int(load_s * 1e9)
+        slot = _DispatchEntry(
+            compiled, key, sig, codec=codec, nbytes=entry.nbytes, load_s=load_s,
+            source="disk", event_pending=True,
+        )
+        memo[memo_key] = slot
+        return slot
+
+    def store_from_dispatch(
+        self,
+        metric: Any,
+        tag: str,
+        tensors: Mapping[str, Any],
+        n_prev: Any,
+        inputs: tuple,
+        jitted: Any,
+        entry: _DispatchEntry,
+    ) -> None:
+        """Write-through after a missed dispatch compiled fresh (one extra
+        AOT re-lower+compile, from aval metadata only — the donated live
+        buffers are already deleted but their shape/dtype survives). Any
+        failure is swallowed: a cache write must never break a dispatch."""
+        entry.store_pending = False  # one attempt per signature
+        try:
+            args, kwargs = inputs
+            t_avals = {k: _to_aval(v) for k, v in tensors.items()}
+            a_avals = tuple(_map_avals(args))
+            k_avals = {k: v for k, v in zip(kwargs, _map_avals(tuple(kwargs.values())))}
+            compiled = jitted.lower(t_avals, _to_aval(n_prev), *a_avals, **k_avals).compile()
+            donate: Tuple[int, ...] = ()  # cached programs never donate — see Metric._aot_program
+            sections, meta = codecs.encode_sections(
+                compiled, jitted, (t_avals, _to_aval(n_prev)) + a_avals, k_avals,
+                store_portable=self.config.store_portable,
+            )
+            meta.update(self._entry_meta(metric, tag, entry.signature, donate))
+            self.cache.put(entry.key, sections, meta)
+            self.stats["writes"] += 1
+            # the freshly compiled program also serves this signature's future
+            # dispatches in-process
+            entry.compiled = compiled
+            entry.source = "write_on_miss"
+        except Exception:  # noqa: BLE001
+            pass
+
+    # ------------------------------------------------------------- precompile
+
+    def precompile_program(
+        self,
+        metric: Any,
+        tag: str,
+        jitted: Any,
+        donate: Tuple[int, ...],
+        tensors: Mapping[str, Any],
+        example_args: tuple,
+        example_kwargs: Dict[str, Any],
+        force: bool = False,
+    ) -> Dict[str, Any]:
+        """Compile one ``(metric, tag, signature)`` program ahead of traffic
+        and publish it. Returns a report row; primes the metric's in-process
+        memo so the first real dispatch is already warm."""
+        inputs = (example_args, example_kwargs)
+        sig, tree = keys.dispatch_signature_parts(inputs)
+        key = keys.cache_key(metric, tag, tensors, inputs, signature=sig, tree_hash=tree)
+        row: Dict[str, Any] = {"tag": tag, "signature": sig, "entry": self.cache.entry_name(key)}
+        if not force and self.cache.has(key):
+            row["status"] = "cached"
+            return row
+        t0 = time.perf_counter()
+        t_avals = {k: _to_aval(v) for k, v in tensors.items()}
+        n_aval = _counter_aval()
+        a_avals = tuple(_map_avals(example_args))
+        k_avals = {k: v for k, v in zip(example_kwargs, _map_avals(tuple(example_kwargs.values())))}
+        compiled = jitted.lower(t_avals, n_aval, *a_avals, **k_avals).compile()
+        compile_s = time.perf_counter() - t0
+        sections, meta = codecs.encode_sections(
+            compiled, jitted, (t_avals, n_aval) + a_avals, k_avals,
+            store_portable=self.config.store_portable,
+        )
+        meta.update(self._entry_meta(metric, tag, sig, donate))
+        path = self.cache.put(key, sections, meta)
+        self.stats["writes"] += 1
+        memo = metric.__dict__.setdefault("_aot_memo", {})
+        memo[(tag, sig, tree)] = _DispatchEntry(
+            compiled, key, sig, codec=(meta.get("codecs") or ["in_process"])[0],
+            nbytes=os.path.getsize(path), source="precompile",
+        )
+        row.update({
+            "status": "written",
+            "compile_s": round(compile_s, 4),
+            "bytes": os.path.getsize(path),
+            "codecs": meta.get("codecs", []),
+        })
+        return row
+
+    @staticmethod
+    def _entry_meta(metric: Any, tag: str, sig: str, donate: Tuple[int, ...]) -> Dict[str, Any]:
+        import jax
+
+        from ..parallel.mesh import runtime_fingerprint
+
+        return {
+            "tag": tag,
+            "donate": list(donate),
+            "signature": sig,
+            "class": f"{type(metric).__module__}.{type(metric).__qualname__}",
+            "runtime": runtime_fingerprint(),
+            "jax": jax.__version__,
+            "created_unix": int(time.time()),
+        }
+
+
+def _counter_aval() -> Any:
+    import jax
+    import jax.numpy as jnp
+
+    return jax.ShapeDtypeStruct((), jnp.float32)
+
+
+def _to_aval(x: Any) -> Any:
+    """Example input → the aval jit would trace it as (weak-typed Python
+    scalars included). Accepts concrete arrays, numpy arrays, and
+    ``ShapeDtypeStruct`` placeholders interchangeably."""
+    import jax
+    import jax.numpy as jnp
+
+    if isinstance(x, bool):
+        return jax.ShapeDtypeStruct((), jnp.bool_)
+    if isinstance(x, (int, float, complex)):
+        # canonicalize from the live config so x64 mode traces int64/float64
+        # weak scalars exactly like jit would
+        return jax.ShapeDtypeStruct((), jax.dtypes.canonicalize_dtype(type(x)), weak_type=True)
+    if hasattr(x, "shape") and hasattr(x, "dtype"):
+        return jax.ShapeDtypeStruct(
+            tuple(x.shape), jax.dtypes.canonicalize_dtype(x.dtype),
+            weak_type=bool(getattr(x, "weak_type", False)),
+        )
+    raise TypeError(
+        f"cannot build an aval from example input of type {type(x).__name__}; "
+        "pass arrays, numpy arrays, jax.ShapeDtypeStruct placeholders, or Python scalars"
+    )
+
+
+def _map_avals(values: tuple) -> list:
+    import jax
+
+    return [jax.tree_util.tree_map(_to_aval, v) for v in values]
+
+
+# ---------------------------------------------------------------------------
+# module-level switch — the one attribute the dispatch hot path reads
+# ---------------------------------------------------------------------------
+
+_ACTIVE: Optional[AotPlane] = None
+
+
+def active_plane() -> Optional[AotPlane]:
+    return _ACTIVE
+
+
+def enabled() -> bool:
+    return _ACTIVE is not None
+
+
+def enable(cache_dir: Optional[str] = None, config: Optional[AotConfig] = None) -> AotPlane:
+    """Activate the AOT plane process-wide (replaces any active plane)."""
+    global _ACTIVE
+    if config is None:
+        config = AotConfig(cache_dir=cache_dir)
+    elif cache_dir is not None:
+        config = dataclasses.replace(config, cache_dir=cache_dir)
+    _ACTIVE = AotPlane(config)
+    return _ACTIVE
+
+
+def disable() -> Optional[AotPlane]:
+    """Deactivate; returns the (inert) plane for post-hoc inspection."""
+    global _ACTIVE
+    plane, _ACTIVE = _ACTIVE, None
+    return plane
+
+
+class aot_session:
+    """``with aot.aot_session(cache_dir) as plane: ...`` — enable for the
+    block, restore the previous plane after."""
+
+    def __init__(self, cache_dir: Optional[str] = None, config: Optional[AotConfig] = None) -> None:
+        self._cache_dir = cache_dir
+        self._config = config
+        self._prev: Optional[AotPlane] = None
+
+    def __enter__(self) -> AotPlane:
+        global _ACTIVE
+        self._prev = _ACTIVE
+        return enable(self._cache_dir, self._config)
+
+    def __exit__(self, *exc: Any) -> None:
+        global _ACTIVE
+        _ACTIVE = self._prev

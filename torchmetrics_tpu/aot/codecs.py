@@ -1,0 +1,172 @@
+"""Serialization codecs for compiled metric programs.
+
+Two codecs, layered by what they can skip at load time:
+
+- :data:`CODEC_EXEC` (``"pjrt_exec"``) — the native compiled executable via
+  ``jax.experimental.serialize_executable`` (PJRT's own binary format wrapped
+  in its pickler). Loading skips EVERYTHING: no Python trace, no jax lowering,
+  no XLA backend compile — this is the codec that turns a multi-second cold
+  start into a millisecond-scale load, and it is valid only for the exact
+  runtime generation in the cache key's fingerprint.
+- :data:`CODEC_HLO` (``"stablehlo"``) — the portable StableHLO module via the
+  ``jax.export`` shim (``aot.compat``). Loading still pays the XLA backend
+  compile, but skips the Python trace + jax lowering; it is the fallback when
+  the native payload fails to deserialize (e.g. a jaxlib that changed its
+  executable format under the same fingerprint) and the honest answer on
+  backends whose executables refuse serialization.
+
+Both payloads travel with the pytree structure of the program's calling
+convention, stored as an index-leafed *skeleton* (plain containers — the
+pytreedefs themselves don't pickle) and rebuilt with ``tree_structure`` at
+load.
+
+Cached programs are compiled WITHOUT buffer donation (``Metric._aot_program``).
+A donated program's input-output aliasing is baked into the executable and DOES
+survive the native round-trip — but jax's Python-side donation bookkeeping does
+not: the caller's input arrays never learn their buffers were consumed, so the
+old state array's garbage collection frees memory underneath the aliased
+output (observed as nondeterministic state corruption). Metric states are
+tiny sufficient statistics, so the undonated output allocation is noise.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+from . import compat
+
+CODEC_EXEC = "pjrt_exec"
+CODEC_HLO = "stablehlo"
+
+#: load preference order — native first, portable fallback
+CODEC_ORDER: Tuple[str, ...] = (CODEC_EXEC, CODEC_HLO)
+
+
+class CodecError(Exception):
+    """A payload could not be produced or decoded (callers treat decode
+    failures as cache misses)."""
+
+
+def _tree_skeleton(treedef: Any) -> Any:
+    import jax
+
+    return jax.tree_util.tree_unflatten(treedef, list(range(treedef.num_leaves)))
+
+
+def _tree_from_skeleton(skel: Any) -> Any:
+    import jax
+
+    return jax.tree_util.tree_structure(skel)
+
+
+# ----------------------------------------------------------------- pjrt_exec
+
+
+def encode_executable(compiled: Any) -> bytes:
+    """``jax.stages.Compiled`` → native executable payload."""
+    try:
+        from jax.experimental import serialize_executable as se
+
+        payload, in_tree, out_tree = se.serialize(compiled)
+    except Exception as err:  # noqa: BLE001 — backend may refuse serialization
+        raise CodecError(f"executable serialization unavailable: {err!r}") from err
+    return pickle.dumps({
+        "payload": payload,
+        "in_skel": _tree_skeleton(in_tree),
+        "out_skel": _tree_skeleton(out_tree),
+    })
+
+
+def decode_executable(blob: bytes) -> Any:
+    """Native payload → loaded ``jax.stages.Compiled`` (callable)."""
+    try:
+        from jax.experimental import serialize_executable as se
+
+        d = pickle.loads(blob)
+        in_tree = _tree_from_skeleton(d["in_skel"])
+        out_tree = _tree_from_skeleton(d["out_skel"])
+        return se.deserialize_and_load(d["payload"], in_tree, out_tree)
+    except Exception as err:  # noqa: BLE001 — any decode failure is a miss
+        raise CodecError(f"executable deserialization failed: {err!r}") from err
+
+
+# ----------------------------------------------------------------- stablehlo
+
+
+def encode_exported(jitted: Any, avals: Sequence[Any], kw_avals: Dict[str, Any]) -> bytes:
+    if not compat.export_available():
+        raise CodecError("no jax export module on this runtime")
+    try:
+        exported = compat.export_program(jitted, *avals, **kw_avals)
+        return compat.serialize_exported(exported)
+    except Exception as err:  # noqa: BLE001
+        raise CodecError(f"jax.export serialization failed: {err!r}") from err
+
+
+def decode_exported(blob: bytes, donate_argnums: Tuple[int, ...] = ()) -> Callable[..., Any]:
+    """Portable payload → a jitted callable over the deserialized module.
+
+    The first call compiles the StableHLO on the local backend (trace and
+    lowering are already paid for); repeats hit jit's in-memory cache.
+    """
+    try:
+        import jax
+
+        exported = compat.deserialize_exported(blob)
+        return jax.jit(exported.call, donate_argnums=tuple(donate_argnums))
+    except Exception as err:  # noqa: BLE001
+        raise CodecError(f"jax.export deserialization failed: {err!r}") from err
+
+
+def encode_sections(
+    compiled: Any,
+    jitted: Any,
+    avals: Sequence[Any],
+    kw_avals: Dict[str, Any],
+    store_portable: bool = True,
+) -> Tuple[Dict[str, bytes], Dict[str, Any]]:
+    """Build the cache sections for one program. Each codec is best-effort —
+    a backend whose PJRT refuses executable serialization still gets a
+    portable entry (warm starts then skip trace+lowering but recompile), and
+    vice versa; only BOTH failing is an error. What failed and why lands in
+    the entry metadata."""
+    sections: Dict[str, bytes] = {}
+    meta: Dict[str, Any] = {"codecs": []}
+    try:
+        sections[CODEC_EXEC] = encode_executable(compiled)
+        meta["codecs"].append(CODEC_EXEC)
+    except CodecError as err:
+        meta["native_error"] = str(err)[:200]
+    if store_portable or not sections:
+        try:
+            sections[CODEC_HLO] = encode_exported(jitted, avals, kw_avals)
+            meta["codecs"].append(CODEC_HLO)
+        except CodecError as err:
+            meta["portable_error"] = str(err)[:200]
+    if not sections:
+        raise CodecError(
+            "no codec could serialize this program: "
+            f"native={meta.get('native_error')!r} portable={meta.get('portable_error')!r}"
+        )
+    return sections, meta
+
+
+def decode_entry(sections: Dict[str, bytes], donate_argnums: Tuple[int, ...]) -> Tuple[Any, str]:
+    """Load the best available payload → ``(callable, codec_name)``.
+
+    Tries codecs in :data:`CODEC_ORDER`; raises :class:`CodecError` only when
+    every present section fails (the caller turns that into a cache miss).
+    """
+    last: Optional[CodecError] = None
+    for codec in CODEC_ORDER:
+        blob = sections.get(codec)
+        if not blob:
+            continue
+        try:
+            if codec == CODEC_EXEC:
+                return decode_executable(blob), codec
+            return decode_exported(blob, donate_argnums), codec
+        except CodecError as err:
+            last = err
+    raise last or CodecError("entry carries no known codec section")

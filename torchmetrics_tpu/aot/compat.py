@@ -1,0 +1,66 @@
+"""``jax.export`` across jax versions — the AOT plane's portability seam.
+
+Newer jax ships the stabilized module as ``jax.export``; older releases only
+have ``jax.experimental.export`` (same surface, earlier home, and on some
+versions the module exists at BOTH paths during the migration window). Every
+in-repo export/deserialize site goes through these helpers so the portable
+StableHLO codec runs on either runtime — the same discipline as the
+``parallel.mesh.shard_map`` shim (PR 4), and pinned by the same kind of parity
+test (``tests/test_aot_cache.py``).
+
+Note ``jax.export`` may be importable as a module while ``getattr(jax,
+"export")`` raises (deprecation-managed attribute on 0.4.3x) — resolution here
+always goes through ``importlib``, never attribute access on ``jax``.
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Any, Optional
+
+_EXPORT_MODULE: Optional[Any] = None
+
+
+def export_module() -> Any:
+    """The resolved export module: ``jax.export`` when available, else
+    ``jax.experimental.export``. Raises ``ImportError`` when neither exists
+    (ancient jax) — callers treat that as "portable codec unavailable"."""
+    global _EXPORT_MODULE
+    if _EXPORT_MODULE is None:
+        try:
+            _EXPORT_MODULE = importlib.import_module("jax.export")
+        except ImportError:
+            _EXPORT_MODULE = importlib.import_module("jax.experimental.export")
+    return _EXPORT_MODULE
+
+
+def export_available() -> bool:
+    try:
+        mod = export_module()
+    except ImportError:
+        return False
+    return hasattr(mod, "export") and hasattr(mod, "deserialize")
+
+
+def export_program(jitted: Any, *avals: Any, **kw_avals: Any) -> Any:
+    """Export a jitted callable for the given argument avals → ``Exported``.
+
+    Both module generations use the two-step ``export(fn)(*specs)`` calling
+    convention; a TypeError from a very old one-step signature falls through
+    to the direct call form.
+    """
+    mod = export_module()
+    try:
+        return mod.export(jitted)(*avals, **kw_avals)
+    except TypeError:
+        return mod.export(jitted, *avals, **kw_avals)
+
+
+def serialize_exported(exported: Any) -> bytes:
+    return bytes(exported.serialize())
+
+
+def deserialize_exported(blob: bytes) -> Any:
+    """Bytes → ``Exported``. Newer jax takes ``bytearray``; pass one for both."""
+    mod = export_module()
+    return mod.deserialize(bytearray(blob))

@@ -1,0 +1,265 @@
+"""Dispatch-key signatures and cache-key anatomy for the AOT compile plane.
+
+A cache entry is addressed by everything that decides which XLA program a
+dispatch runs, and nothing else:
+
+    tmaot<format> | runtime fingerprint | metric fingerprint | tag
+                  | state signature    | input signature
+
+- **runtime fingerprint** (``parallel.mesh.runtime_fingerprint``): jax/jaxlib
+  version, backend platform + platform version, device kind, device/process
+  counts. A serialized executable is native code for one runtime generation —
+  any drift here must miss, never load.
+- **package version** (:func:`package_version`): the coarse invalidator — the
+  bytecode digest below only sees the CLASS's own methods, so a thin
+  ``_batch_state`` delegating into functional helpers would not change when
+  the helpers' math does; folding the package version in makes every library
+  upgrade a guaranteed miss.
+- **metric fingerprint**: class identity, the pure core's code objects
+  (``_batch_state``/``_merge``/``_compute`` bytecode — an in-place edit to
+  the class's math invalidates without version bookkeeping), and the
+  instance's configuration attributes (one level of plain-object recursion
+  so e.g. an extractor's ``compute_dtype`` lands in the key; numpy config
+  arrays content-hash on host; a config holding DEVICE arrays raises
+  :class:`UnfingerprintableConfig` — hashing those would be a D2H readback,
+  so such metrics are uncacheable rather than false-hittable).
+- **state signature**: tensor-state names/shapes/dtypes plus reduction tags —
+  what the donated state argument looks like to XLA.
+- **input signature** (:func:`dispatch_signature`): the same shape/dtype key
+  the compile counters track per dispatch, hardened for cache use: kwargs
+  commute (pytree flattening sorts dict keys), weak-typed Python scalars
+  canonicalize to the scalar aval jit actually traces (``1.0`` and ``2.0``
+  are one key; a value never leaks into the key), and ``ShapeDtypeStruct``
+  placeholders and concrete arrays of the same shape/dtype are
+  indistinguishable. The cache key additionally folds in a hash of the
+  pytree STRUCTURE (:func:`structure_hash`) so two argument layouts that
+  flatten to the same leaves cannot collide into one executable — the
+  display signature stays the flat token string the counters have always
+  reported.
+
+A key is a MISS if anything fails to fingerprint — a false miss costs one
+compile; a false hit runs the wrong program.
+
+Everything here reads host metadata only (shapes, dtypes, code objects,
+config attributes); building a key never touches device memory.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Dict, Mapping, Optional, Tuple
+
+#: bump when the key anatomy or the on-disk container changes incompatibly
+CACHE_FORMAT_VERSION = 1
+
+
+class UnfingerprintableConfig(Exception):
+    """A metric's configuration cannot be identified without reading device
+    memory (it holds jax arrays — e.g. baked-in weights). The plane treats
+    such metrics as uncacheable: a false MISS forever beats loading a program
+    whose constants silently belong to a different instance."""
+
+
+def _short_hash(text: str, n: int = 10) -> str:
+    return hashlib.sha256(text.encode("utf-8")).hexdigest()[:n]
+
+
+def _scalar_token(t: type) -> str:
+    """Canonical token of a weak-typed Python scalar — derived from the live
+    jax config, so ``x64`` mode keys ``1.0`` as the float64 program it would
+    actually trace (bool is never weak)."""
+    import jax
+
+    dtype = jax.dtypes.canonicalize_dtype(t)
+    return f"{dtype}()" if t is bool else f"{dtype}()*"
+
+
+def _leaf_token(leaf: Any) -> str:
+    """Shape/dtype token of one input leaf (metadata only).
+
+    Weak-typed leaves carry a ``*`` suffix: jit keys its trace cache on weak
+    typing, so a weak and a strong f32 scalar are genuinely different
+    programs and must be different cache keys too.
+    """
+    t = type(leaf)
+    if t in (bool, int, float, complex):
+        return _scalar_token(t)
+    if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+        try:
+            import jax
+
+            dtype = jax.dtypes.canonicalize_dtype(leaf.dtype)
+        except Exception:  # noqa: BLE001 — canonicalization is best-effort
+            dtype = leaf.dtype
+        weak = "*" if getattr(leaf, "weak_type", False) else ""
+        return f"{dtype}{tuple(leaf.shape)}{weak}"
+    return t.__name__
+
+
+def dispatch_signature(inputs: Optional[tuple]) -> str:
+    """Shape/dtype/structure key of a dispatch's ``(args, kwargs)``.
+
+    This is THE dispatch-key signature: the telemetry compile counters and
+    the AOT cache key both use it, which is what lets ``aot_cache_hits``
+    reconcile exactly against ``dispatches`` (one shared notion of
+    signature novelty). Mirrors what ``jax.jit`` keys its own cache on.
+    """
+    return dispatch_signature_parts(inputs)[0]
+
+
+def dispatch_signature_parts(inputs: Optional[tuple]) -> Tuple[str, str]:
+    """``(flat signature, structure hash)`` from ONE pytree flatten — the
+    form the dispatch hot path uses, so plane lookup and telemetry never
+    flatten the same inputs twice."""
+    if not inputs:
+        return "()", "0"
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(inputs)
+    sig = "|".join(_leaf_token(leaf) for leaf in leaves) or "()"
+    return sig, _short_hash(str(treedef), 8)
+
+
+def structure_hash(inputs: Optional[tuple]) -> str:
+    """Short hash of the inputs' pytree structure. Keeps e.g. ``f(a, b)`` and
+    ``f((a, b))`` apart in the CACHE key (and the plane's per-metric memo) —
+    same leaves, different calling convention, different executable.
+    ``jax.jit`` keys on the treedef too; only the human-facing signature
+    string elides it."""
+    return dispatch_signature_parts(inputs)[1]
+
+
+def _value_token(value: Any, depth: int = 1) -> str:
+    """Config-attribute token for the metric fingerprint. Primitives by value,
+    arrays by content hash (numpy) or metadata (device arrays — hashing those
+    would be a D2H readback), callables by qualname, other objects by type
+    plus one level of their own primitive attributes."""
+    if value is None or isinstance(value, (bool, int, float, complex, str)):
+        return repr(value)
+    if isinstance(value, (tuple, list)):
+        inner = ",".join(_value_token(v, depth) for v in value)
+        return f"{type(value).__name__}[{inner}]"
+    if isinstance(value, dict):
+        inner = ",".join(f"{k!r}:{_value_token(v, depth)}" for k, v in sorted(value.items(), key=lambda kv: repr(kv[0])))
+        return f"dict[{inner}]"
+    try:
+        import numpy as np
+
+        if isinstance(value, np.ndarray):
+            return f"np:{value.dtype}{value.shape}:{hashlib.sha256(value.tobytes()).hexdigest()[:12]}"
+    except Exception:  # noqa: BLE001
+        pass
+    if hasattr(value, "shape") and hasattr(value, "dtype"):
+        # a device array in the CONFIG (not an input — inputs are keyed by
+        # shape/dtype, which is correct for them) is typically a baked-in
+        # constant the compiled program closes over. Its VALUES shape the
+        # program, but hashing them would be a D2H readback (which flips
+        # tunneled runtimes into sync dispatch for the whole process) — so
+        # the metric is declared uncacheable rather than risking a false hit
+        # that runs another instance's constants.
+        raise UnfingerprintableConfig(
+            f"config attribute holds a device array ({value.dtype}{tuple(value.shape)}); "
+            "hashing it would read device memory — keep program-shaping config as "
+            "numpy/python values to make this metric AOT-cacheable"
+        )
+    if callable(value):
+        return f"fn:{getattr(value, '__module__', '?')}.{getattr(value, '__qualname__', type(value).__name__)}"
+    if depth > 0 and hasattr(value, "__dict__"):
+        inner = ",".join(
+            f"{k}={_value_token(v, depth - 1)}"
+            for k, v in sorted(vars(value).items())
+            if not k.startswith("_")
+        )
+        return f"obj:{type(value).__module__}.{type(value).__qualname__}({inner})"
+    return f"obj:{type(value).__module__}.{type(value).__qualname__}"
+
+
+# runtime/bookkeeping attributes that never shape the compiled program
+_FINGERPRINT_SKIP = frozenset({
+    "compute_on_cpu", "dist_sync_on_step", "process_group", "dist_sync_fn",
+    "distributed_available_fn", "sync_on_compute", "compute_with_cache",
+})
+
+
+def _code_digest(h: "hashlib._Hash", func: Any) -> None:
+    code = getattr(func, "__code__", None)
+    if code is None:
+        h.update(repr(func).encode())
+        return
+    h.update(code.co_code)
+    h.update(repr(code.co_consts).encode())
+
+
+def package_version() -> str:
+    """The installed package's own version — folded into every cache key.
+    The bytecode digest in :func:`metric_fingerprint` only sees the class's
+    OWN methods; a thin ``_batch_state`` delegating into functional helpers
+    would not change when the helpers do, so the package version is the
+    coarse invalidator that makes any library upgrade a guaranteed miss."""
+    try:
+        from .. import __version__
+
+        return str(__version__)
+    except Exception:  # noqa: BLE001 — a versionless build still gets a stable key
+        return "unversioned"
+
+
+def metric_fingerprint(metric: Any) -> str:
+    """Identity of the program-shaping parts of one metric instance.
+
+    Raises :class:`UnfingerprintableConfig` when the config cannot be
+    identified without device reads (the plane then treats the metric as
+    uncacheable)."""
+    cls = type(metric)
+    h = hashlib.sha256()
+    for name in ("_batch_state", "_merge", "_compute"):
+        fn = getattr(cls, name, None)
+        if fn is not None:
+            _code_digest(h, fn)
+    config_parts = []
+    for k, v in sorted(metric.__dict__.items()):
+        if k.startswith("_") or k in _FINGERPRINT_SKIP:
+            continue
+        config_parts.append(f"{k}={_value_token(v)}")
+    h.update(";".join(config_parts).encode("utf-8"))
+    return f"{cls.__module__}.{cls.__qualname__}:{h.hexdigest()[:16]}"
+
+
+def state_signature(tensors: Mapping[str, Any], reductions: Mapping[str, Any]) -> str:
+    """Tensor-state layout of the donated state argument."""
+    parts = []
+    for name in sorted(tensors):
+        red = reductions.get(name)
+        red_tok = red if isinstance(red, (str, type(None))) else getattr(red, "__qualname__", "callable")
+        parts.append(f"{name}:{_leaf_token(tensors[name])}:{red_tok}")
+    return ",".join(parts) or "(stateless)"
+
+
+def cache_key(
+    metric: Any,
+    tag: str,
+    tensors: Mapping[str, Any],
+    inputs: Optional[tuple],
+    runtime: Optional[str] = None,
+    signature: Optional[str] = None,
+    tree_hash: Optional[str] = None,
+) -> str:
+    """The full cache key for one ``(metric, tag, input signature)`` program.
+    ``signature``/``tree_hash`` accept precomputed parts (the dispatch path
+    already has them) — omitted, they derive from ``inputs``."""
+    if runtime is None:
+        from ..parallel.mesh import runtime_fingerprint
+
+        runtime = runtime_fingerprint()
+    if signature is None or tree_hash is None:
+        signature, tree_hash = dispatch_signature_parts(inputs)
+    return "|".join([
+        f"tmaot{CACHE_FORMAT_VERSION}",
+        f"pkg={package_version()}",
+        runtime,
+        metric_fingerprint(metric),
+        f"tag={tag}",
+        f"state={state_signature(tensors, getattr(metric, '_reductions', {}))}",
+        f"in={signature}",
+        f"tree={tree_hash}",
+    ])

@@ -856,6 +856,40 @@ class MetricCollection:
             return [plot_single_or_multi_val(val, ax=ax)]
         return [plot_single_or_multi_val({k: v}, ax=ax) for k, v in val.items()]
 
+    # ------------------------------------------------------- warm start (aot/)
+
+    def precompile(
+        self,
+        *example_inputs: Any,
+        tags: Sequence[str] = ("update",),
+        cache_dir: Optional[str] = None,
+        force: bool = False,
+        **example_kwargs: Any,
+    ) -> Dict[str, Any]:
+        """Warm-start the whole collection: compile every member's dispatch
+        program(s) for the example input shapes and publish the serialized
+        executables into the AOT cache (``torchmetrics_tpu.aot``).
+
+        Every member precompiles individually — on a fresh boot the first
+        real batch dispatches each member once before compute groups derive,
+        so per-member entries are exactly what that first batch loads.
+        Heterogeneous collections reuse the update-path kwarg filtering;
+        quarantined members are skipped. Returns ``{member: {tag: row}}``.
+        """
+        report: Dict[str, Any] = {}
+        for name, metric in self._modules.items():
+            if name in self._quarantined:
+                report[name] = {"status": "skipped", "reason": "quarantined"}
+                continue
+            report[name] = metric.precompile(
+                *example_inputs,
+                tags=tags,
+                cache_dir=cache_dir,
+                force=force,
+                **metric._filter_kwargs(**example_kwargs),
+            )
+        return report
+
     # --------------------------------------------------------------- telemetry
 
     def state_memory(self) -> Dict[str, Any]:

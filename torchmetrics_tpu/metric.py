@@ -46,6 +46,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import aot as _aot
 from . import observability as _observability
 from .observability import costs as _obs_costs
 from .observability import memory as _obs_memory
@@ -189,6 +190,7 @@ class Metric:
         self._persistent[name] = persistent
         self._state[name] = [] if isinstance(default, list) else _fresh_leaf(default)
         self._jit_cache.clear()
+        self.__dict__.pop("_aot_memo", None)  # state layout changed — loaded programs are stale
 
     @property
     def _list_state_names(self) -> Tuple[str, ...]:
@@ -306,7 +308,39 @@ class Metric:
                     new_t.setdefault(k, v)
                 return new_t, appends, n_prev + 1.0
 
+            self._jit_cache[f"{key}.raw"] = fn  # undonated source for _aot_program
             self._jit_cache[key] = jax.jit(fn, donate_argnums=(0, 1)) if self._enable_jit else fn
+        return self._jit_cache[key]
+
+    def _get_forward_fn(self) -> Callable:
+        key = "forward"
+        if key not in self._jit_cache:
+            list_names = set(self._list_state_names)
+
+            def fn(tensor_state, n_prev, *args, **kwargs):
+                with jax.named_scope(f"{type(self).__name__}.batch_state"):
+                    bs = self._batch_state(*args, **kwargs)
+                appends = {k: v for k, v in bs.items() if k in list_names}
+                bs_t = {k: v for k, v in bs.items() if k not in list_names}
+                with jax.named_scope(f"{type(self).__name__}.merge"):
+                    new_t = self._merge(dict(tensor_state), bs_t) if self._has_custom_merge() else {
+                        k: _sync.pairwise_merge(self._reductions.get(k), tensor_state[k], v, weights=(n_prev, 1.0))
+                        for k, v in bs_t.items()
+                    }
+                new_t = {k: jnp.asarray(v).astype(tensor_state[k].dtype) if k in tensor_state else v for k, v in new_t.items()}
+                for k, v in tensor_state.items():
+                    new_t.setdefault(k, v)
+                batch_full = dict(bs_t)
+                defaults_t, _ = self._split_tensor_list(self.init_state())
+                for k, v in defaults_t.items():
+                    batch_full.setdefault(k, v)
+                batch_full.update(appends)
+                with jax.named_scope(f"{type(self).__name__}.compute"):
+                    val = self._compute(batch_full) if self._jittable_compute else None
+                return new_t, appends, val, batch_full
+
+            self._jit_cache[f"{key}.raw"] = fn  # undonated source for _aot_program
+            self._jit_cache[key] = jax.jit(fn, donate_argnums=0) if (self._enable_jit and self._jittable_compute) else fn
         return self._jit_cache[key]
 
     def _append_list_state(self, name: str, value: Any) -> None:
@@ -325,7 +359,13 @@ class Metric:
 
     def _device_update_count(self):
         if getattr(self, "_n_prev_dev", None) is None:
-            self._n_prev_dev = jnp.asarray(float(self._update_count), jnp.float32)
+            # device_put, not jnp.asarray: a pure H2D transfer. An eager
+            # asarray would COMPILE a tiny convert_element_type program, and
+            # as the process's first eager op that compile (~40ms, plus jit
+            # machinery warmup) lands on the warm-boot critical path — it
+            # would dominate the whole AOT loaded-executable budget. Same
+            # value, same canonicalized f32 dtype.
+            self._n_prev_dev = jax.device_put(np.float32(self._update_count))
         return self._n_prev_dev
 
     def _has_custom_merge(self) -> bool:
@@ -380,26 +420,100 @@ class Metric:
         dict and device-side update counter.
 
         ``inputs`` is the batch's ``(args, kwargs)`` — read only when a telemetry
-        session is active, for the shape/dtype dispatch signature (metadata only,
-        no device access). ``jitted`` is the underlying ``jax.jit`` object for
-        this tag — the cost-accounting layer AOT-lowers it from avals when the
-        dispatch turns out to be a fresh compile (``observability/costs.py``).
-        Disabled telemetry costs one ``None``-check here.
+        session or the AOT compile plane is active, for the shape/dtype dispatch
+        signature (metadata only, no device access). ``jitted`` is the underlying
+        ``jax.jit`` object for this tag — the cost-accounting layer AOT-lowers it
+        from avals when the dispatch turns out to be a fresh compile
+        (``observability/costs.py``). Disabled telemetry and a disabled AOT plane
+        each cost one ``None``-check here.
+
+        With the AOT plane active (``torchmetrics_tpu.aot.enable``), a
+        first-seen signature consults the on-disk executable cache BEFORE
+        dispatching: a hit swaps ``call`` for the deserialized executable (no
+        trace, no compile — the warm-start path), a miss is remembered so the
+        jit path owns that signature for the rest of the process, and a
+        corrupt entry is just a miss. Counters keep
+        ``jit_compiles + jit_cache_hits + aot_cache_hits == dispatches`` exact.
         """
+        plane = _aot._ACTIVE
+        aot_slot = None
+        if (
+            plane is not None
+            and inputs is not None
+            and self._enable_jit
+            and jitted is not None
+            and hasattr(jitted, "lower")
+        ):
+            aot_slot = plane.lookup_dispatch(self, tag, tensors, inputs)
+            if aot_slot is not None and aot_slot.compiled is not None:
+                a_args, a_kwargs = inputs
+                loaded = aot_slot.compiled
+                jit_call = call
+                used = aot_slot  # closure sees demotion through the slot
+
+                def call(t, n):  # noqa: ANN001 — mirrors the jit-call shape
+                    if used.compiled is None:  # demoted on an earlier attempt
+                        return jit_call(t, n)
+                    try:
+                        return loaded(t, n, *a_args, **a_kwargs)
+                    except (TypeError, ValueError):
+                        # a calling-convention or input-placement/sharding
+                        # mismatch the key could not see — detected BEFORE
+                        # execution, and cached programs never donate, so the
+                        # inputs are intact: demote this slot to a remembered
+                        # miss and take the jit path (never an exception on
+                        # the dispatch path)
+                        used.compiled = None
+                        used.source = "demoted"
+                        used.event_pending = False
+                        used.miss_pending = True
+                        return jit_call(t, n)
+
         rec = _observability._ACTIVE
         if rec is None:
             with _tracing.trace_span(f"{type(self).__name__}.{tag}"):
-                return self._dispatch_donated(tag, call, tensors)
-        lower = None
-        if rec.config.cost_accounting:
-            # lazy thunk: reference capture only — avals are built (from the
-            # donated-then-deleted buffers' surviving metadata) solely when the
-            # recorder sees a fresh compile
-            lower = _obs_costs.make_lowerer(jitted, tensors, self._device_update_count(), inputs)
+                result = self._dispatch_donated(tag, call, tensors)
+            if aot_slot is not None and aot_slot.store_pending:
+                plane.store_from_dispatch(
+                    self, tag, tensors, self._device_update_count(), inputs,
+                    self._aot_program(tag)[0], aot_slot
+                )
+            return result
         t0 = _tracing.monotonic()
         with _tracing.trace_span(f"{type(self).__name__}.{tag}"):
             result = self._dispatch_donated(tag, call, tensors)
-        rec.record_dispatch(self, tag, inputs, rec.finish(result, t0), lower=lower)
+        # aot_hit is decided AFTER the dispatch: a mid-call demotion means the
+        # jit path actually served it
+        aot_hit = aot_slot is not None and aot_slot.compiled is not None
+        lower = None
+        if rec.config.cost_accounting:
+            if aot_hit and isinstance(aot_slot.compiled, jax.stages.Compiled):
+                # the natively loaded executable IS the compiled program — its
+                # cost harvests without the usual re-lower+compile. (A
+                # portable-codec load is a jit wrapper, not a Compiled; it
+                # falls through to the aval re-lowering path below.)
+                lower = lambda c=aot_slot.compiled: c  # noqa: E731
+            else:
+                # lazy thunk: reference capture only — avals are built (from the
+                # donated-then-deleted buffers' surviving metadata) solely when
+                # the recorder sees a fresh compile
+                lower = _obs_costs.make_lowerer(jitted, tensors, self._device_update_count(), inputs)
+        if aot_hit and aot_slot.event_pending:
+            aot_slot.event_pending = False  # one aot_load event per cache load
+            rec.record_aot_load(self, tag, aot_slot.load_s, aot_slot.nbytes, aot_slot.key, aot_slot.codec)
+        if aot_slot is not None and aot_slot.compiled is None and aot_slot.miss_pending:
+            aot_slot.miss_pending = False
+            rec.record_aot_miss()
+        rec.record_dispatch(
+            self, tag, inputs, rec.finish(result, t0), lower=lower, aot_loaded=aot_hit,
+            # reuse the plane's signature — one pytree flatten per dispatch
+            signature=aot_slot.signature if aot_slot is not None else None,
+        )
+        if aot_slot is not None and aot_slot.store_pending:
+            plane.store_from_dispatch(
+                self, tag, tensors, self._device_update_count(), inputs,
+                self._aot_program(tag)[0], aot_slot
+            )
         return result
 
     def _dispatch_donated(self, tag: str, call: Callable[..., Any], tensors: StateDict) -> Any:
@@ -478,34 +592,7 @@ class Metric:
             self._computed = None
             return val
         args, kwargs = self._prepare_inputs(*args, **kwargs)
-        key = "forward"
-        if key not in self._jit_cache:
-            list_names = set(self._list_state_names)
-
-            def fn(tensor_state, n_prev, *args, **kwargs):
-                with jax.named_scope(f"{type(self).__name__}.batch_state"):
-                    bs = self._batch_state(*args, **kwargs)
-                appends = {k: v for k, v in bs.items() if k in list_names}
-                bs_t = {k: v for k, v in bs.items() if k not in list_names}
-                with jax.named_scope(f"{type(self).__name__}.merge"):
-                    new_t = self._merge(dict(tensor_state), bs_t) if self._has_custom_merge() else {
-                        k: _sync.pairwise_merge(self._reductions.get(k), tensor_state[k], v, weights=(n_prev, 1.0))
-                        for k, v in bs_t.items()
-                    }
-                new_t = {k: jnp.asarray(v).astype(tensor_state[k].dtype) if k in tensor_state else v for k, v in new_t.items()}
-                for k, v in tensor_state.items():
-                    new_t.setdefault(k, v)
-                batch_full = dict(bs_t)
-                defaults_t, _ = self._split_tensor_list(self.init_state())
-                for k, v in defaults_t.items():
-                    batch_full.setdefault(k, v)
-                batch_full.update(appends)
-                with jax.named_scope(f"{type(self).__name__}.compute"):
-                    val = self._compute(batch_full) if self._jittable_compute else None
-                return new_t, appends, val, batch_full
-
-            self._jit_cache[key] = jax.jit(fn, donate_argnums=0) if (self._enable_jit and self._jittable_compute) else fn
-        fwd = self._jit_cache[key]
+        fwd = self._get_forward_fn()
         tensors = self._split_tensor_list(self._state)[0]
         new_t, appends, val, batch_full = self._donation_safe_dispatch(
             "forward", lambda t, n: fwd(t, n, *args, **kwargs), tensors, inputs=(args, kwargs),
@@ -752,7 +839,7 @@ class Metric:
             n: ([jnp.copy(x) for x in s] if isinstance(s, list) else jnp.copy(s)) for n, s in d.items()
         }
         for k, v in self.__dict__.items():
-            if k == "_jit_cache":
+            if k in ("_jit_cache", "_aot_memo"):
                 object.__setattr__(new, k, {})
             elif k == "_state":
                 object.__setattr__(new, k, copy_state(v))
@@ -859,6 +946,7 @@ class Metric:
     def __getstate__(self) -> dict:
         d = dict(self.__dict__)
         d.pop("_jit_cache", None)
+        d.pop("_aot_memo", None)  # loaded executables are process-local
         d["_state"] = {
             k: ([np.asarray(x) for x in v] if isinstance(v, list) else np.asarray(v)) for k, v in self._state.items()
         }
@@ -909,6 +997,7 @@ class Metric:
         self._defaults = {k: cast_default(v) for k, v in self._defaults.items()}
         self._dtype = dst_type
         self._jit_cache.clear()
+        self.__dict__.pop("_aot_memo", None)  # dtypes changed — loaded programs are stale
         return self
 
     @property
@@ -948,6 +1037,104 @@ class Metric:
             1
         """
         return _obs_memory.state_memory(self._state)
+
+    # ------------------------------------------------------- warm start (aot/)
+
+    def _aot_program(self, tag: str) -> Tuple[Callable, Tuple[int, ...]]:
+        """The jitted program behind one dispatch tag, as the AOT plane
+        caches it: compiled WITHOUT buffer donation.
+
+        The live dispatch path donates its tiny state buffers, but a
+        deserialized executable's input-output aliasing is invisible to
+        jax's Python-side donation bookkeeping — the old state array would
+        keep owning the very buffer the output aliases, and its eventual
+        garbage collection frees that memory underneath the live result
+        (observed as nondeterministic state corruption). Metric states are
+        sufficient statistics (bytes to KBs), so forgoing donation costs one
+        tiny output allocation per warm dispatch; the large batch inputs
+        were never donated. Returns ``(jitted, donate_spec)`` with an empty
+        donate spec; the eager paths return their non-lowerable callable so
+        ``precompile`` skips them."""
+        if tag == "update":
+            primary = self._get_update_fn()
+        elif tag == "forward":
+            primary = self._get_forward_fn()
+        else:
+            raise ValueError(f"Unknown dispatch tag {tag!r}; expected 'update' or 'forward'")
+        raw = self._jit_cache.get(f"{tag}.raw")
+        if raw is None or not hasattr(primary, "lower"):
+            return primary, ()
+        aot_key = f"{tag}.aot"
+        if aot_key not in self._jit_cache:
+            self._jit_cache[aot_key] = jax.jit(raw)
+        return self._jit_cache[aot_key], ()
+
+    def precompile(
+        self,
+        *example_inputs: Any,
+        tags: Sequence[str] = ("update",),
+        cache_dir: Optional[str] = None,
+        force: bool = False,
+        **example_kwargs: Any,
+    ) -> Dict[str, Any]:
+        """Compile this metric's dispatch program(s) for the given example
+        input shapes AHEAD of traffic and publish the serialized executables
+        into the AOT cache, so a freshly booted process serves its first
+        update from a cache load instead of a multi-second compile.
+
+        Example inputs may be concrete arrays, numpy arrays,
+        ``jax.ShapeDtypeStruct`` placeholders, or Python scalars — only
+        shape/dtype metadata is read; no example values influence the program
+        or the cache key. Uses the active plane
+        (:func:`torchmetrics_tpu.aot.enable`) or, for one-off population, an
+        explicit ``cache_dir``. Returns ``{tag: report_row}``; a program whose
+        entry already exists reports ``"cached"`` (``force=True`` rewrites).
+
+        See ``docs/performance.md`` ("Cold start & warm start") and
+        ``tools/warm_cache.py`` for the boot-time workflow.
+        """
+        if cache_dir is not None:
+            # an explicit cache_dir always wins — a deploy hook populating a
+            # bake-time cache must not silently write into whatever plane the
+            # process happens to have active
+            plane = _aot.AotPlane(_aot.AotConfig(cache_dir=cache_dir))
+        else:
+            plane = _aot._ACTIVE
+            if plane is None:
+                raise TorchMetricsUserError(
+                    "precompile needs an active AOT plane — call "
+                    "torchmetrics_tpu.aot.enable(cache_dir) first, or pass cache_dir=."
+                )
+        report: Dict[str, Any] = {}
+        if not self._enable_jit:
+            return {tag: {"status": "skipped", "reason": "jit disabled on this metric"} for tag in tags}
+        # the same host-side formatting the real dispatch applies — the
+        # precompiled signature must match what update()/forward() will key
+        # on. ShapeDtypeStruct placeholders carry no values, so value-level
+        # validation/formatting cannot run on them: placeholder calls skip
+        # _prepare_inputs and must therefore be given POST-prepare shapes
+        # (for most metrics prepare is identity or validation-only).
+        has_placeholder = any(
+            isinstance(leaf, jax.ShapeDtypeStruct)
+            for leaf in jax.tree_util.tree_leaves((example_inputs, example_kwargs))
+        )
+        if has_placeholder:
+            args, kwargs = example_inputs, example_kwargs
+        else:
+            args, kwargs = self._prepare_inputs(*example_inputs, **example_kwargs)
+        tensors, _ = self._split_tensor_list(self._state)
+        for tag in tags:
+            fn, donate = self._aot_program(tag)
+            if not hasattr(fn, "lower"):
+                report[tag] = {"status": "skipped", "reason": "program not jitted (eager/host compute path)"}
+                continue
+            try:
+                report[tag] = plane.precompile_program(
+                    self, tag, fn, donate, tensors, args, kwargs, force=force
+                )
+            except _aot.keys.UnfingerprintableConfig as err:
+                report[tag] = {"status": "skipped", "reason": f"uncacheable: {err}"}
+        return report
 
     # ------------------------------------------------------------ kwarg filter
 
@@ -1046,6 +1233,15 @@ class HostMetric(Metric):
 
     _jittable_compute = False
 
+    def precompile(self, *example_inputs: Any, tags: Sequence[str] = ("update",), **kwargs: Any) -> Dict[str, Any]:
+        """Host metrics dispatch eagerly — there is no jitted program to
+        cache. A no-op report keeps ``MetricCollection.precompile`` total
+        over heterogeneous collections."""
+        return {
+            tag: {"status": "skipped", "reason": "host-side metric — no jitted dispatch program"}
+            for tag in tags
+        }
+
     def _host_batch_state(self, *args: Any, **kwargs: Any) -> StateDict:
         raise NotImplementedError
 
@@ -1127,6 +1323,27 @@ class CompositionalMetric(Metric):
 
     def _filter_kwargs(self, **kwargs: Any) -> Dict[str, Any]:
         return kwargs
+
+    def precompile(
+        self,
+        *example_inputs: Any,
+        tags: Sequence[str] = ("update",),
+        cache_dir: Optional[str] = None,
+        force: bool = False,
+        **example_kwargs: Any,
+    ) -> Dict[str, Any]:
+        """Warm both operands — the composition itself has no program. Example
+        kwargs route through each operand's kwarg filter, exactly like the
+        composed ``update`` does, so the cached signatures match what real
+        traffic dispatches."""
+        report: Dict[str, Any] = {}
+        for side, operand in (("metric_a", self.metric_a), ("metric_b", self.metric_b)):
+            if isinstance(operand, Metric):
+                report[side] = operand.precompile(
+                    *example_inputs, tags=tags, cache_dir=cache_dir, force=force,
+                    **operand._filter_kwargs(**example_kwargs),
+                )
+        return report
 
     def update(self, *args: Any, **kwargs: Any) -> None:
         if isinstance(self.metric_a, Metric):

@@ -203,18 +203,14 @@ class TelemetryRecorder:
     @staticmethod
     def _signature(inputs: Optional[tuple]) -> str:
         """Shape/dtype key of a dispatch's inputs — metadata only, no device
-        access. Mirrors what ``jax.jit`` keys its own trace cache on."""
-        if not inputs:
-            return "()"
-        import jax
+        access. Mirrors what ``jax.jit`` keys its own trace cache on. The
+        implementation is shared with the AOT compile cache
+        (``aot.keys.dispatch_signature``): counters and cache entries keying
+        on the same signature is what makes ``aot_cache_hits`` reconcile
+        exactly against ``dispatches``."""
+        from ..aot import keys as _aot_keys
 
-        parts = []
-        for leaf in jax.tree.leaves(inputs):
-            if hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
-                parts.append(f"{leaf.dtype}{tuple(leaf.shape)}")
-            else:
-                parts.append(type(leaf).__name__)
-        return "|".join(parts) or "()"
+        return _aot_keys.dispatch_signature(inputs)
 
     # ---------------------------------------------------------------- fan-out
 
@@ -241,6 +237,8 @@ class TelemetryRecorder:
         inputs: Optional[tuple],
         duration_s: float,
         lower: Optional[Any] = None,
+        aot_loaded: bool = False,
+        signature: Optional[str] = None,
     ) -> None:
         """One successful jitted donated dispatch (``update``/``forward``).
 
@@ -248,31 +246,68 @@ class TelemetryRecorder:
         that AOT-compiles this dispatch's program from avals. It runs only when
         the signature is fresh — i.e. exactly when the compile counter ticks —
         so the cost registry reconciles 1:1 with ``jit_compiles`` per key.
+        ``aot_loaded`` marks a dispatch served by a deserialized executable
+        from the AOT cache: a fresh signature then counts as ``aot_cache_hits``
+        instead of a compile (the lower thunk for such a dispatch returns the
+        loaded executable, so its cost entry still harvests without compiling).
+        ``signature`` accepts the plane's precomputed signature so the hot
+        path never flattens the same inputs twice.
         """
         name = self._metric_name(metric)
         key = f"{name}.{tag}"
-        sig = self._signature(inputs)
+        sig = signature if signature is not None else self._signature(inputs)
         if self.config.cost_accounting and not self.counters.has_signature(key, sig):
             # harvest BEFORE the compile counter ticks: a concurrent snapshot
             # must never see a counted compile without its cost entry
             self.costs.harvest(key, sig, lower)
-        is_new, n_sigs = self.counters.record_dispatch(key, sig)
+        is_new, n_compiles = self.counters.record_dispatch(key, sig, aot_loaded=aot_loaded)
         self.histograms.record_duration(tag, name, duration_s)
         self._event(
-            "dispatch", name, tag, duration_s=duration_s, signature=sig, cache_hit=not is_new
+            "dispatch", name, tag, duration_s=duration_s, signature=sig, cache_hit=not is_new,
+            payload={"aot": True} if aot_loaded else {},
         )
-        if is_new and n_sigs > 1:
-            self._event("retrace", name, tag, signature=sig, payload={"n_signatures": n_sigs})
-        if is_new and n_sigs > self.config.retrace_warn_threshold and key not in self._retrace_warned:
+        # retrace events/sentinel track actual RECOMPILES (the key's compiles
+        # beyond its first), mirroring the retraces counter exactly — an
+        # AOT-served fresh signature recompiled nothing, and a service that
+        # deliberately precompiled many shapes is warm, not churning
+        if is_new and not aot_loaded and n_compiles > 1:
+            self._event("retrace", name, tag, signature=sig, payload={"n_compiles": n_compiles})
+        if is_new and not aot_loaded and n_compiles > self.config.retrace_warn_threshold and key not in self._retrace_warned:
             self._retrace_warned.add(key)
             shapes = self.counters.signatures(key)
             rank_zero_warn(
-                f"Retrace sentinel: {key} has compiled for {n_sigs} distinct input "
+                f"Retrace sentinel: {key} has compiled for {n_compiles} distinct input "
                 f"shape/dtype signatures (> {self.config.retrace_warn_threshold}) — every new "
                 f"signature is a fresh XLA trace+compile. Pad or bucket inputs to a stable "
                 f"shape. Signatures seen: {shapes}.",
                 UserWarning,
             )
+
+    def record_aot_load(
+        self, metric: Any, tag: str, duration_s: float, nbytes: int, key: str, codec: str
+    ) -> None:
+        """One serialized executable loaded from the AOT compile cache for
+        this metric's ``tag`` program (``aot/``): deserialize wall-clock into
+        the ``aot_deserialize_us`` counter and the ``aot_load`` histogram
+        kind, plus one ``aot_load`` event carrying entry size, codec, and the
+        cache entry's content address."""
+        import hashlib
+
+        name = self._metric_name(metric)
+        self.counters.record_aot_deserialize(duration_s)
+        self.histograms.record_duration("aot_load", name, duration_s)
+        self._event(
+            "aot_load", name, tag, duration_s=duration_s,
+            # the entry field is the cache file's content address (prefix),
+            # not the raw key — keys are long and carry config reprs
+            payload={"nbytes": int(nbytes), "codec": codec,
+                     "entry": hashlib.sha256(key.encode("utf-8")).hexdigest()[:16]},
+        )
+
+    def record_aot_miss(self) -> None:
+        """The AOT plane probed the disk for a first-seen signature and found
+        nothing usable — the dispatch fell back to a fresh compile."""
+        self.counters.record_aot_miss()
 
     def record_host_dispatch(self, metric: Any, tag: str, duration_s: float) -> None:
         """A HostMetric eager dispatch (never jitted — no compile/hit split)."""
@@ -394,12 +429,14 @@ class TelemetryRecorder:
         total = 0
         for key, rec in self.counters.keys_for(prefix).items():
             tag = key[len(prefix):]
-            n = rec["compiles"] + rec["cache_hits"]
+            aot_hits = rec.get("aot_hits", 0)
+            n = rec["compiles"] + rec["cache_hits"] + aot_hits
             total += n
             tags[tag] = {
                 "dispatches": n,
                 "compiles": rec["compiles"],
                 "cache_hits": rec["cache_hits"],
+                "aot_hits": aot_hits,
                 "retraces": max(0, rec["compiles"] - 1),
                 "signatures": rec["signatures"],
             }
@@ -453,7 +490,7 @@ class TelemetryRecorder:
             return {}
         name = f"{type(metric).__name__}#{stamp[1]}"
         out: Dict[str, Any] = {}
-        for kind in ("update", "forward", "compute", "sync"):
+        for kind in ("update", "forward", "compute", "sync", "aot_load"):
             hist = self.histograms.get(kind, name)
             if hist is None or not hist.count:
                 continue

@@ -26,8 +26,11 @@ from typing import Any, Dict, List, Mapping, Optional, Sequence, Tuple, Union
 COUNTER_FIELDS: Tuple[str, ...] = (
     "dispatches",  # jitted donated dispatches (update/forward tensor path)
     "jit_compiles",  # first-seen (key, signature) pairs — one XLA trace each
-    "jit_cache_hits",  # repeat signatures — served from jit's cache
-    "retraces",  # compiles beyond a key's first (shape/dtype churn)
+    "jit_cache_hits",  # repeat signatures — served from an in-memory program
+    "retraces",  # compiles beyond a key's first actual compile (shape/dtype churn)
+    "aot_cache_hits",  # first-seen signatures served by a LOADED executable (aot/)
+    "aot_cache_misses",  # aot-plane disk probes that found nothing usable
+    "aot_deserialize_us",  # wall-clock spent loading serialized executables
     "host_dispatches",  # HostMetric update/forward (eager, never jitted)
     "computes",  # Metric.compute invocations
     "d2h_readbacks",  # device→host transfers at instrumented runtime sites
@@ -86,6 +89,7 @@ class CountersSnapshot:
             delta = {
                 "compiles": rec["compiles"] - old.get("compiles", 0),
                 "cache_hits": rec["cache_hits"] - old.get("cache_hits", 0),
+                "aot_hits": rec.get("aot_hits", 0) - old.get("aot_hits", 0),
                 "signatures": [s for s in rec["signatures"] if s not in old_sigs],
                 "sig_counts": {
                     s: n - old_counts.get(s, 0)
@@ -93,7 +97,7 @@ class CountersSnapshot:
                     if n - old_counts.get(s, 0)
                 },
             }
-            if delta["compiles"] or delta["cache_hits"] or delta["signatures"]:
+            if delta["compiles"] or delta["cache_hits"] or delta["aot_hits"] or delta["signatures"]:
                 per_key[key] = delta
         costs = {}
         for key, sigs in self.costs.items():
@@ -119,6 +123,7 @@ class CountersSnapshot:
         out["collectives_per_sync"] = _collectives_per_sync(self.counts)
         out["per_key"] = {
             k: {"compiles": v["compiles"], "cache_hits": v["cache_hits"],
+                "aot_hits": v.get("aot_hits", 0),
                 "signatures": list(v["signatures"]),
                 "sig_counts": dict(v.get("sig_counts", {}))}
             for k, v in self.per_key.items()
@@ -181,30 +186,61 @@ class Counters:
 
     # -------------------------------------------------------------- recording
 
-    def record_dispatch(self, key: str, signature: str) -> Tuple[bool, int]:
+    def record_dispatch(self, key: str, signature: str, aot_loaded: bool = False) -> Tuple[bool, int]:
         """One successful jitted dispatch under ``key`` with the given input
-        ``signature``. Returns ``(is_new_signature, n_signatures_for_key)``."""
+        ``signature``. Returns ``(is_new_signature, n_compiles_for_key)`` —
+        the second element counts the key's actual COMPILES (not distinct
+        signatures), which is what the retrace event/sentinel key off.
+
+        ``aot_loaded`` marks a dispatch served by a deserialized executable
+        from the AOT cache: a FIRST-seen signature then counts as an
+        ``aot_cache_hit`` instead of a compile (and never as a retrace —
+        nothing recompiled), keeping ``jit_compiles + jit_cache_hits +
+        aot_cache_hits == dispatches`` an exact identity. Repeat signatures
+        count as ``jit_cache_hits`` either way: they are served by an
+        in-memory program, whichever plane first materialized it. With no AOT
+        activity, compiles == distinct signatures, so the return is exactly
+        what it always was.
+        """
         with self._lock:
             rec = self._per_key.setdefault(
                 # "signatures" keeps first-seen order for reports; "_sig_set" is
                 # the O(1) membership twin — a retrace storm (the pathology this
                 # counter diagnoses) must not make its own bookkeeping O(n)
-                key, {"compiles": 0, "cache_hits": 0, "signatures": [], "_sig_set": set(),
-                      "sig_counts": {}}
+                key, {"compiles": 0, "cache_hits": 0, "aot_hits": 0, "signatures": [],
+                      "_sig_set": set(), "sig_counts": {}}
             )
             self._counts["dispatches"] += 1
             rec["sig_counts"][signature] = rec["sig_counts"].get(signature, 0) + 1
             if signature in rec["_sig_set"]:
                 rec["cache_hits"] += 1
                 self._counts["jit_cache_hits"] += 1
-                return False, len(rec["signatures"])
+                return False, rec["compiles"]
             rec["signatures"].append(signature)
             rec["_sig_set"].add(signature)
-            rec["compiles"] += 1
-            self._counts["jit_compiles"] += 1
-            if len(rec["signatures"]) > 1:
-                self._counts["retraces"] += 1
-            return True, len(rec["signatures"])
+            if aot_loaded:
+                rec["aot_hits"] += 1
+                self._counts["aot_cache_hits"] += 1
+            else:
+                rec["compiles"] += 1
+                self._counts["jit_compiles"] += 1
+                # a retrace is a recompile beyond the key's first COMPILE —
+                # signatures served by the AOT cache never recompiled anything
+                if rec["compiles"] > 1:
+                    self._counts["retraces"] += 1
+            return True, rec["compiles"]
+
+    def record_aot_miss(self) -> None:
+        """The AOT plane probed the disk cache for a first-seen signature and
+        found nothing usable (absent, stale-keyed, or corrupt — all misses)."""
+        with self._lock:
+            self._counts["aot_cache_misses"] += 1
+
+    def record_aot_deserialize(self, duration_s: float) -> None:
+        """Wall-clock of one executable load (microseconds, accumulated like
+        ``sync_time_us``)."""
+        with self._lock:
+            self._counts["aot_deserialize_us"] += max(0, int(duration_s * 1e6))
 
     def has_signature(self, key: str, signature: str) -> bool:
         """Whether ``(key, signature)`` has already been counted (the recorder
@@ -298,6 +334,7 @@ class Counters:
         with self._lock:
             return {
                 k: {"compiles": v["compiles"], "cache_hits": v["cache_hits"],
+                    "aot_hits": v.get("aot_hits", 0),
                     "signatures": list(v["signatures"]),
                     "sig_counts": dict(v["sig_counts"])}
                 for k, v in self._per_key.items()
@@ -309,6 +346,7 @@ class Counters:
             counts = dict(self._counts)
             per_key = {
                 k: {"compiles": v["compiles"], "cache_hits": v["cache_hits"],
+                    "aot_hits": v.get("aot_hits", 0),
                     "signatures": list(v["signatures"]),
                     "sig_counts": dict(v["sig_counts"])}
                 for k, v in self._per_key.items()
@@ -434,10 +472,11 @@ def aggregate_counters(
             continue
         for key, rec in snap.per_key.items():
             merged = per_key.setdefault(
-                key, {"compiles": 0, "cache_hits": 0, "signatures": [], "sig_counts": {}}
+                key, {"compiles": 0, "cache_hits": 0, "aot_hits": 0, "signatures": [], "sig_counts": {}}
             )
             merged["compiles"] += rec["compiles"]
             merged["cache_hits"] += rec["cache_hits"]
+            merged["aot_hits"] += rec.get("aot_hits", 0)
             for sig in rec["signatures"]:
                 if sig not in merged["signatures"]:
                     merged["signatures"].append(sig)

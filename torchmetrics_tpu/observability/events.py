@@ -32,6 +32,7 @@ EVENT_KINDS: Tuple[str, ...] = (
     "retry_exhausted",  # retry budget ran out on a transient failure
     "quarantine",  # MetricCollection froze/skipped a failing member
     "retrace",  # a dispatch key saw a NEW shape/dtype signature (recompile)
+    "aot_load",  # a serialized executable was loaded from the AOT cache (aot/)
     "d2h",  # an instrumented device→host readback
     "state_growth",  # a list/cat state crossed the unbounded-growth threshold
     "alert",  # an SLO rule breached (or errored) — observability/slo.py

@@ -39,7 +39,7 @@ from typing import Any, Dict, Iterable, List, Mapping, Optional, Sequence, Tuple
 N_BUCKETS = 32
 
 # The kinds whose per-kind totals ride the fleet plane, in vector order. The
-# first five are latency histograms (microseconds); the last two are size
+# first six are latency histograms (microseconds); the last two are size
 # histograms (bytes). Fixed across ranks by construction — the fleet vector
 # needs no key exchange.
 FLEET_HISTOGRAM_KINDS: Tuple[str, ...] = (
@@ -48,6 +48,7 @@ FLEET_HISTOGRAM_KINDS: Tuple[str, ...] = (
     "compute",       # Metric.compute latency
     "sync",          # Metric.sync / MetricCollection.sync wall-clock
     "retry_backoff", # backoff delay accepted before a transient retry
+    "aot_load",      # serialized-executable load latency (aot compile cache)
     "sync_payload",  # bytes a process contributed to one sync
     "gather_bytes",  # bytes of one sync-plane collective payload
 )

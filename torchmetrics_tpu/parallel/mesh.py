@@ -33,6 +33,47 @@ def shard_map(f, mesh: Mesh, in_specs, out_specs, check_vma: Optional[bool] = No
     return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs)
 
 
+def runtime_fingerprint(mesh: Optional[Mesh] = None) -> str:
+    """Backend/topology identity for AOT compile-cache keys (``aot/``).
+
+    A serialized executable is native code for one runtime generation: any
+    drift in jax/jaxlib version, backend platform (+ its reported platform
+    version, which tracks the XLA build), device kind, or device/process
+    topology must make the cache key MISS — a stale entry loading would run
+    a wrong (or un-loadable) program. Collective-bearing programs also bake
+    in the mesh layout, so an explicit ``mesh`` folds its axis shape in.
+    Metadata only; never touches a device.
+    """
+    import jax as _jax
+
+    dev = _jax.devices()[0]
+    platform_version = str(getattr(getattr(dev, "client", None), "platform_version", "") or "")
+    parts = [
+        f"jax={_jax.__version__}",
+        f"jaxlib={_jaxlib_version()}",
+        f"backend={_jax.default_backend()}",
+        f"platver={platform_version[:60]}",
+        f"device={getattr(dev, 'device_kind', type(dev).__name__)}",
+        f"ndev={_jax.device_count()}",
+        f"nproc={_jax.process_count()}",
+        # x64 mode changes what every Python scalar and f64 input canonicalizes
+        # to — a different program for the same signature string, so it must key
+        f"x64={int(bool(_jax.config.jax_enable_x64))}",
+    ]
+    if mesh is not None:
+        parts.append(f"mesh={tuple(sorted(dict(mesh.shape).items()))!r}")
+    return "|".join(parts)
+
+
+def _jaxlib_version() -> str:
+    try:
+        import jaxlib
+
+        return getattr(jaxlib, "__version__", "?")
+    except Exception:  # noqa: BLE001 — fingerprint stays usable without jaxlib metadata
+        return "?"
+
+
 def make_data_mesh(n_devices: Optional[int] = None, axis_name: str = DEFAULT_AXIS) -> Mesh:
     """1-D data-parallel mesh over the first ``n_devices`` devices."""
     devs = jax.devices()[: (n_devices or len(jax.devices()))]
