@@ -774,3 +774,144 @@ def test_bench_ttfu_specs_build():
         assert set(bench.TTFU_CONFIGS) <= set(bench.CONFIGS)
     finally:
         sys.path.remove(REPO)
+
+
+# ------------------------------------------------- threaded prefetch (PR 9)
+
+
+def test_collection_precompile_prefetch_overlaps_loads(tmp_path):
+    """Second boot of a collection: precompile reports every member 'cached'
+    AND deserializes the entries on a thread pool into the dispatch memos —
+    the first real batch is then served without a single disk probe, and the
+    report's wall clock documents the overlap vs the serial sum."""
+    cache = str(tmp_path / "prefetch")
+    ncls = 10
+    preds = jnp.zeros((64, ncls), jnp.float32)
+    target = jnp.zeros((64,), jnp.int32)
+
+    def build():
+        return MetricCollection({
+            "acc": MulticlassAccuracy(ncls, average="micro", validate_args=False),
+            "f1": MulticlassF1Score(ncls, average="macro", validate_args=False),
+        }, compute_groups=False)
+
+    aot.enable(cache)
+    first = build().precompile(preds, target)
+    assert "_prefetch" not in first  # fresh writes are already primed in-process
+    aot.disable()
+
+    aot.enable(cache)
+    coll = build()
+    report = coll.precompile(preds, target)
+    pf = report["_prefetch"]
+    assert pf["loaded"] == 2
+    assert pf["serial_load_s"] >= 0 and pf["wall_s"] >= 0
+    assert all(rows["update"]["status"] == "loaded" for name, rows in pf["members"].items())
+    with obs.telemetry_session() as rec:
+        coll.update(preds, target)
+    c = rec.counters.snapshot().counts
+    # memo-primed loads: dispatches hit the prefetched executables, the
+    # deserialize wall-clock still lands in the counter at first observation
+    assert c["aot_cache_hits"] == 2 and c["jit_compiles"] == 0
+    assert c["aot_deserialize_us"] > 0
+    assert len(rec.events_of("aot_load")) == 2
+    aot.disable()
+
+
+def test_prefetch_compiled_miss_is_remembered(tmp_path):
+    _plane(tmp_path)
+    m = _acc()
+    preds, target = _batch()
+    report = m.prefetch_compiled(preds, target)
+    assert report["update"]["status"] == "miss"
+    with obs.telemetry_session() as rec:
+        m.update(preds, target)  # remembered miss: jit path owns it, no re-probe
+    c = rec.counters.snapshot().counts
+    assert c["jit_compiles"] == 1 and c["aot_cache_hits"] == 0
+    plane = aot.active_plane()
+    assert plane.stats["misses"] == 1  # the prefetch probe, not the dispatch
+
+
+def test_prefetch_compiled_host_metric_skips():
+    aot.enable()
+    try:
+        report = _HostSum().prefetch_compiled(_x())
+        assert report["update"]["status"] == "skipped"
+    finally:
+        aot.disable()
+
+
+# --------------------------------------------- cache size budgeting (PR 9)
+
+
+def test_cache_prune_lru_by_last_hit(tmp_path):
+    """--max-bytes semantics: least-recently-hit entries (mtime order)
+    evicted first, budget respected, undecodable files always reclaimed —
+    and get() refreshes an entry's mtime so real loads ARE hits."""
+    import time as _time
+
+    plane = _plane(tmp_path)
+    for n in (8, 16, 32, 64):
+        _acc().precompile(*_batch(batch=n))
+    scan = plane.cache.scan()
+    assert scan["entries"] == 4 and scan["bytes"] > 0
+    # a corrupt file is reclaimed unconditionally, whatever the budget
+    bad = os.path.join(plane.cache.root, "deadbeef.aot")
+    with open(bad, "wb") as fh:
+        fh.write(b"not an entry")
+    # get() stamps last-hit: an artificially ancient entry comes back fresh
+    entry = next(plane.cache.entries())
+    os.utime(entry.path, (1, 1))
+    assert os.stat(entry.path).st_mtime < 100
+    assert plane.cache.get(entry.key) is not None
+    assert os.stat(entry.path).st_mtime > 100
+    # explicit recency split: two cold entries, two hot survivors
+    now = _time.time()
+    entries = sorted(plane.cache.entries(), key=lambda e: e.path)
+    cold, hot = entries[:2], entries[2:]
+    for i, e in enumerate(cold):
+        os.utime(e.path, (now - 1000 - i, now - 1000 - i))
+    for e in hot:
+        os.utime(e.path, (now, now))
+    budget = sum(os.path.getsize(e.path) for e in hot)
+    report = plane.cache.prune(budget)
+    assert "deadbeef.aot" in report["removed"]
+    assert report["kept_bytes"] <= budget
+    left = {f for f in os.listdir(plane.cache.root) if f.endswith(".aot")}
+    assert left == {os.path.basename(e.path) for e in hot}
+    assert {os.path.basename(e.path) for e in cold} <= set(report["removed"])
+
+
+def test_warm_cache_cli_max_bytes(tmp_path):
+    cache_dir = str(tmp_path / "cli-prune")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "warm_cache.py"),
+         "--cache-dir", cache_dir, "--set", "flagship", "--batch", "32"],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO,
+    )
+    assert res.returncode == 0, res.stderr[-2000:]
+    res = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "warm_cache.py"),
+         "--cache-dir", cache_dir, "--max-bytes", "1K"],
+        capture_output=True, text=True, timeout=120, env=env, cwd=REPO,
+    )
+    assert res.returncode == 0, res.stderr[-2000:]
+    report = json.loads(res.stdout)
+    assert report["max_bytes"] == 1024
+    assert report["scan"]["bytes"] <= 1024
+    # suffix parsing is exact
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "warm_cache_t", os.path.join(REPO, "tools", "warm_cache.py"))
+        wc = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(wc)
+        assert wc.parse_size("512M") == 512 * 2**20
+        assert wc.parse_size("2G") == 2 * 2**30
+        assert wc.parse_size("65536") == 65536
+        assert wc.parse_size("1KB") == 1024
+    finally:
+        sys.path.remove(os.path.join(REPO, "tools"))

@@ -493,3 +493,54 @@ def test_trace_report_cli_rank_labels(tmp_path):
     # digit labels coerce to ints: rank 2 orders before rank 10
     res = _cli([TRACE_REPORT, str(a), str(b), "--rank", "10", "--rank", "2", "--json"])
     assert [r["rank"] for r in json.loads(res.stdout)["rows"]] == [2, 10]
+
+
+def test_serving_columns_direction_and_gate(tmp_path):
+    """multi_tenant_serving columns: throughputs and the speedup gate higher,
+    spill latency gates lower, the one-compile proof gates lower (a slide to
+    per-tenant compiles is THE pathology), and the baseline's one-shot boot
+    cost plus churn-move count stay informational."""
+    assert bench_compare.direction("extra.multi_tenant_serving.tenants_per_sec_1k") == "higher"
+    assert bench_compare.direction("extra.multi_tenant_serving.tenants_per_sec_8k") == "higher"
+    assert bench_compare.direction("extra.multi_tenant_serving.vs_naive_speedup_1k") == "higher"
+    assert bench_compare.direction("extra.multi_tenant_serving.tenant_spill_us") == "lower"
+    assert bench_compare.direction("extra.multi_tenant_serving.vupdate_fresh_compiles") == "lower"
+    assert bench_compare.direction("extra.multi_tenant_serving.naive_boot_ms_per_tenant") is None
+    assert bench_compare.direction("extra.multi_tenant_serving.spill_moves") is None
+    assert bench_compare.direction("extra.multi_tenant_serving.telemetry.tenants_per_dispatch") is None
+    # outside a telemetry block the amortization ratio gates higher
+    assert bench_compare.direction("tenants_per_dispatch") == "higher"
+
+    good = _round(1, 30000.0, extra_overrides={"multi_tenant_serving": {
+        "tenants_per_sec_1k": 60000.0, "tenants_per_sec_8k": 55000.0,
+        "naive_tenants_per_sec": 5000.0, "vs_naive_speedup_1k": 12.0,
+        "tenant_spill_us": 300.0, "vupdate_fresh_compiles": 1,
+        "naive_boot_ms_per_tenant": 90.0, "spill_moves": 512,
+    }})
+    # an engine sliding back toward one-dispatch-per-tenant must trip --check
+    broken = _round(2, 30000.0, extra_overrides={"multi_tenant_serving": {
+        "tenants_per_sec_1k": 9000.0, "tenants_per_sec_8k": 8500.0,
+        "naive_tenants_per_sec": 5000.0, "vs_naive_speedup_1k": 1.8,
+        "tenant_spill_us": 2500.0, "vupdate_fresh_compiles": 100,
+        "naive_boot_ms_per_tenant": 90.0, "spill_moves": 512,
+    }})
+    paths = _write_rounds(tmp_path, [good, broken])
+    report = bench_compare.compare_rounds(paths)
+    reg = {r["metric"] for t in report["transitions"] for r in t["rows"] if r["verdict"] == "regression"}
+    assert "extra.multi_tenant_serving.tenants_per_sec_1k" in reg
+    assert "extra.multi_tenant_serving.vs_naive_speedup_1k" in reg
+    assert "extra.multi_tenant_serving.tenant_spill_us" in reg
+    assert "extra.multi_tenant_serving.vupdate_fresh_compiles" in reg
+    assert bench_compare.main(paths + ["--check"]) == 1
+    # shared-pod wobble stays inside the thresholds
+    wobble = _round(2, 30000.0, extra_overrides={"multi_tenant_serving": {
+        "tenants_per_sec_1k": 48000.0, "tenants_per_sec_8k": 44000.0,
+        "naive_tenants_per_sec": 5600.0, "vs_naive_speedup_1k": 8.6,
+        "tenant_spill_us": 420.0, "vupdate_fresh_compiles": 1,
+        "naive_boot_ms_per_tenant": 70.0, "spill_moves": 512,
+    }})
+    wobble_dir = tmp_path / "wobble"
+    wobble_dir.mkdir()
+    paths = _write_rounds(wobble_dir, [good, wobble])
+    report = bench_compare.compare_rounds(paths)
+    assert report["verdict"] == "ok" and report["missing"] == 0

@@ -82,6 +82,7 @@ def test_counters_snapshot_and_diff():
         "dispatches", "jit_compiles", "jit_cache_hits", "retraces",
         "host_dispatches", "d2h_readbacks", "sync_calls",
         "gathers_coalesced", "collectives_per_sync",
+        "serve_dispatches", "tenants_per_dispatch",
     }
     c.reset()
     assert c.snapshot()["dispatches"] == 0

@@ -1,0 +1,549 @@
+"""Multi-tenant serving engine tests (torchmetrics_tpu/serving).
+
+The load-bearing claims, each pinned:
+
+- **tenant isolation**: N tenants interleaved through the stacked/vmapped
+  megabatch plane produce bitwise-identical integer states (and allclose
+  float values) to N independently-updated reference metrics — across
+  update/compute/reset, eviction + readmission round-trips, and checkpoint
+  restore;
+- **one compile, many tenants**: the compile counters show exactly ONE fresh
+  XLA compile per (shape-class × tag) regardless of tenant count, and
+  ``serve_tenant_rows``/``tenants_per_dispatch`` reconcile exactly;
+- **self-warming boot**: with ``ServingConfig(aot_cache_dir=...)`` the first
+  boot writes through (``write_on_miss``) and the SECOND boot serves its
+  first megabatch from a cache load (zero compiles, ``aot_cache_hits == 1``);
+- **fault isolation**: a poisoned megabatch quarantines only the offending
+  tenant — the stack rolls back, healthy tenants keep bitwise parity.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu import aot, observability as obs
+from torchmetrics_tpu.aggregation import MaxMetric, MeanMetric
+from torchmetrics_tpu.classification import MulticlassAccuracy
+from torchmetrics_tpu.metric import TENANT_COUNT_KEY
+from torchmetrics_tpu.serving import ServingConfig, ServingEngine
+from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
+
+pytestmark = pytest.mark.serving
+
+NUM_CLASSES = 3
+BATCH = 4
+
+
+def _acc():
+    return MulticlassAccuracy(num_classes=NUM_CLASSES, average="micro", validate_args=False)
+
+
+def _batches(rng, n, batch=BATCH):
+    return [
+        (jnp.asarray(rng.normal(size=(batch, NUM_CLASSES)).astype(np.float32)),
+         jnp.asarray(rng.integers(0, NUM_CLASSES, batch, dtype=np.int32)))
+        for _ in range(n)
+    ]
+
+
+def _assert_state_parity(engine, tenant_id, ref):
+    """Engine slice vs reference metric state: bitwise for integer states,
+    allclose for float."""
+    t = engine._tenants[tenant_id]
+    state = engine._tenant_state(t)
+    for name, ref_v in ref._state.items():
+        got = np.asarray(state[name])
+        want = np.asarray(ref_v)
+        if np.issubdtype(want.dtype, np.integer) or np.issubdtype(want.dtype, np.bool_):
+            np.testing.assert_array_equal(got, want, err_msg=f"{tenant_id}/{name}")
+        else:
+            np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6, err_msg=f"{tenant_id}/{name}")
+
+
+# ------------------------------------------------------------------- basics
+
+
+def test_single_tenant_matches_reference_with_padding():
+    """One tenant in a megabatch of 8 → 7 scratch pad rows; values and the
+    integer states must still match the plain stateful metric exactly."""
+    rng = np.random.default_rng(0)
+    engine = ServingEngine(_acc(), ServingConfig(capacity=8, megabatch_size=8))
+    ref = _acc()
+    for preds, target in _batches(rng, 3):
+        engine.update("only", preds, target)
+        ref.update(preds, target)
+    engine.flush()
+    assert engine.stats["padded_rows"] > 0
+    _assert_state_parity(engine, "only", ref)
+    assert abs(float(engine.compute("only")) - float(ref.compute())) < 1e-6
+    assert engine.update_count("only") == 3
+
+
+def test_tenant_isolation_fuzz():
+    """N tenants, shuffled interleaved traffic, repeated flushes, a mid-run
+    reset — every tenant stays bitwise-isolated from every other."""
+    rng = np.random.default_rng(1)
+    n_tenants, rounds = 9, 3
+    engine = ServingEngine(_acc(), ServingConfig(capacity=16, megabatch_size=4))
+    refs = {t: _acc() for t in range(n_tenants)}
+    per_tenant = {t: _batches(rng, rounds) for t in range(n_tenants)}
+    order = [(t, i) for t in range(n_tenants) for i in range(rounds)]
+    rng.shuffle(order)
+    for step, (t, i) in enumerate(order):
+        preds, target = per_tenant[t][i]
+        engine.update(t, preds, target)
+        refs[t].update(preds, target)
+        if step == len(order) // 2:
+            engine.flush()
+            engine.reset(4)
+            refs[4] = _acc()
+    engine.flush()
+    for t in range(n_tenants):
+        _assert_state_parity(engine, t, refs[t])
+        assert abs(float(engine.compute(t)) - float(refs[t].compute())) < 1e-6
+
+
+def test_mean_metric_per_tenant_running_mean():
+    """'mean'-reduced states weight by the PER-ROW update count inside the
+    stack — tenants with different update depths must not cross-contaminate."""
+    rng = np.random.default_rng(2)
+    engine = ServingEngine(MeanMetric(), ServingConfig(capacity=8, megabatch_size=3))
+    refs = {t: MeanMetric() for t in range(5)}
+    for t in range(5):
+        for _ in range(t + 1):  # tenant t gets t+1 updates
+            v = jnp.asarray(rng.normal(size=(4,)).astype(np.float32))
+            engine.update(t, v)
+            refs[t].update(v)
+    engine.flush()
+    for t in range(5):
+        np.testing.assert_allclose(
+            float(engine.compute(t)), float(refs[t].compute()), rtol=1e-5
+        )
+
+
+def test_kwargs_traffic_and_structure_distinct_classes():
+    """Keyword batches ride the vmapped fold (stacked as a kwargs pytree);
+    kwargs-vs-positional traffic is a different calling convention and must
+    land in a DIFFERENT shape-class (same leaves, different treedef)."""
+    rng = np.random.default_rng(17)
+    engine = ServingEngine(MeanMetric(), ServingConfig(capacity=8, megabatch_size=3))
+    refs = {t: MeanMetric() for t in range(4)}
+    for _ in range(3):
+        for t in range(4):
+            v = rng.normal(size=(5,)).astype(np.float32)
+            w = rng.uniform(0.5, 2.0, size=(5,)).astype(np.float32)
+            engine.update(t, v, weight=w)
+            refs[t].update(v, weight=w)
+    engine.flush()
+    for t in range(4):
+        np.testing.assert_allclose(float(engine.compute(t)), float(refs[t].compute()), rtol=1e-5)
+    engine.update("positional", rng.normal(size=(5,)).astype(np.float32))
+    engine.flush()
+    assert len(engine._classes) == 2
+
+
+def test_nonzero_default_states_survive_stacking():
+    """MinMetric/MaxMetric defaults are ±inf — the stack must tile the real
+    default, not zeros, or the first megabatch folds against garbage."""
+    rng = np.random.default_rng(3)
+    engine = ServingEngine(MaxMetric(), ServingConfig(capacity=4, megabatch_size=2))
+    ref = MaxMetric()
+    v = jnp.asarray(rng.normal(size=(6,)).astype(np.float32) - 10.0)  # all negative
+    engine.update("a", v)
+    ref.update(v)
+    engine.flush()
+    np.testing.assert_allclose(float(engine.compute("a")), float(ref.compute()), rtol=1e-6)
+
+
+def test_concat_state_metric_rejected():
+    from torchmetrics_tpu.aggregation import CatMetric
+
+    with pytest.raises(TorchMetricsUserError, match="concat states"):
+        ServingEngine(CatMetric())
+
+
+def test_config_validation():
+    with pytest.raises(ValueError, match="on_error"):
+        ServingConfig(on_error="explode")
+    with pytest.raises(ValueError, match="capacity"):
+        ServingConfig(capacity=0)
+    # a chunk wider than the stack could never be seated — rejected up front
+    with pytest.raises(ValueError, match="megabatch_size"):
+        ServingConfig(capacity=4, megabatch_size=8)
+
+
+@pytest.mark.parametrize("on_error", ["raise", "quarantine"])
+def test_full_width_megabatch_never_evicts_its_own_members(on_error):
+    """Regression: capacity == megabatch_size with an over-subscribed fleet.
+    Seating the chunk's later members used to evict its EARLIER members (the
+    oldest-touched tenants are exactly the chunk front), crashing in 'raise'
+    mode and falsely quarantining healthy tenants in 'quarantine' mode —
+    megabatch members are now pinned against each other during admission."""
+    rng = np.random.default_rng(18)
+    engine = ServingEngine(
+        _acc(), ServingConfig(capacity=4, megabatch_size=4, on_error=on_error, auto_flush=False)
+    )
+    refs = {t: _acc() for t in range(8)}
+    batch = _batches(rng, 1)[0]
+    for t in range(8):  # ingest evicts earlier tenants: chunk 1's members are all spilled
+        engine.update(t, *batch)
+        refs[t].update(*batch)
+    engine.flush()
+    roster = engine.tenants()
+    assert not any(r["quarantined"] for r in roster.values()), roster
+    for t in range(8):
+        _assert_state_parity(engine, t, refs[t])
+
+
+# --------------------------------------------------------- spill / readmission
+
+
+def test_eviction_readmission_roundtrip_parity():
+    """Capacity 3, fleet of 8, churned in shuffled order: every touch past
+    capacity spills the LRU tenant to host and readmits on return — states
+    stay bitwise-correct through arbitrarily many round-trips."""
+    rng = np.random.default_rng(4)
+    engine = ServingEngine(_acc(), ServingConfig(capacity=3, megabatch_size=2))
+    refs = {t: _acc() for t in range(8)}
+    per_tenant = {t: _batches(rng, 4) for t in range(8)}
+    order = [(t, i) for t in range(8) for i in range(4)]
+    rng.shuffle(order)
+    for t, i in order:
+        preds, target = per_tenant[t][i]
+        engine.update(t, preds, target)
+        refs[t].update(preds, target)
+    engine.flush()
+    assert engine.stats["spills"] > 0 and engine.stats["readmissions"] > 0
+    for t in range(8):
+        _assert_state_parity(engine, t, refs[t])
+    summ = engine.summary()
+    assert summ["tenant_spill_us"] > 0
+    mem = engine.memory()
+    assert mem["spilled_tenants"] == len([t for t in engine.tenants().values() if t["spilled"]])
+
+
+def test_spilled_tenant_computes_without_readmission():
+    rng = np.random.default_rng(5)
+    engine = ServingEngine(_acc(), ServingConfig(capacity=4, megabatch_size=2))
+    ref = _acc()
+    for preds, target in _batches(rng, 2):
+        engine.update("cold", preds, target)
+        ref.update(preds, target)
+    engine.flush()
+    engine.evict("cold")
+    readmissions_before = engine.stats["readmissions"]
+    assert engine.tenants()["cold"]["spilled"]
+    assert abs(float(engine.compute("cold")) - float(ref.compute())) < 1e-6
+    # a read is not traffic: no slot churn
+    assert engine.tenants()["cold"]["spilled"]
+    assert engine.stats["readmissions"] == readmissions_before
+
+
+def test_spill_disabled_raises_at_capacity():
+    rng = np.random.default_rng(6)
+    engine = ServingEngine(_acc(), ServingConfig(capacity=2, megabatch_size=2, spill=False))
+    (preds, target), = _batches(rng, 1)
+    engine.update("a", preds, target)
+    engine.update("b", preds, target)
+    with pytest.raises(TorchMetricsUserError, match="full"):
+        engine.update("c", preds, target)
+
+
+def test_spill_telemetry_counters():
+    rng = np.random.default_rng(7)
+    with obs.telemetry_session() as rec:
+        engine = ServingEngine(_acc(), ServingConfig(capacity=2, megabatch_size=2))
+        for t in range(4):
+            for preds, target in _batches(rng, 2):
+                engine.update(t, preds, target)
+        engine.flush()
+    c = rec.counters.snapshot().counts
+    assert c["tenant_spills"] == engine.stats["spills"] > 0
+    assert c["tenant_readmits"] == engine.stats["readmissions"]
+    assert c["tenant_spill_us"] > 0
+    assert rec.events_of("tenant_spill")
+
+
+# ------------------------------------------------- one compile, many tenants
+
+
+def test_one_compile_many_tenants_counters_reconcile():
+    """The acceptance proof: 40 tenants, multiple flushes — exactly one fresh
+    compile on the vupdate key, and the serving counters reconcile exactly
+    (tenant rows == total updates; dispatch identity holds)."""
+    rng = np.random.default_rng(8)
+    batches = _batches(rng, 2)
+    with obs.telemetry_session() as rec:
+        engine = ServingEngine(_acc(), ServingConfig(capacity=64, megabatch_size=8))
+        for preds, target in batches:
+            for t in range(40):
+                engine.update(t, preds, target)
+            engine.flush()
+    snap = rec.counters.snapshot()
+    vkeys = {k: v for k, v in snap.per_key.items() if k.endswith(".vupdate")}
+    assert len(vkeys) == 1
+    (rec_row,) = vkeys.values()
+    assert rec_row["compiles"] == 1  # ONE compile serves all 40 tenants
+    c = snap.counts
+    assert c["serve_tenant_rows"] == 80 == engine.stats["tenant_rows"]
+    assert c["serve_dispatches"] == engine.stats["dispatches"] == c["dispatches"]
+    assert c["jit_compiles"] + c["jit_cache_hits"] + c["aot_cache_hits"] == c["dispatches"]
+    brief = snap.summary(brief=True)
+    assert brief["tenants_per_dispatch"] == pytest.approx(80 / c["serve_dispatches"])
+    assert rec.events_of("serve")
+
+
+def test_shape_class_bucketing():
+    """Two batch shapes → two stacks, two compiles (one each), full parity;
+    a tenant switching shapes mid-stream is rejected with guidance."""
+    rng = np.random.default_rng(9)
+    small = _batches(rng, 1, batch=4)[0]
+    big = _batches(rng, 1, batch=6)[0]
+    with obs.telemetry_session() as rec:
+        engine = ServingEngine(_acc(), ServingConfig(capacity=8, megabatch_size=2))
+        ref_a, ref_b = _acc(), _acc()
+        engine.update("a", *small); ref_a.update(*small)
+        engine.update("b", *big); ref_b.update(*big)
+        engine.flush()
+    snap = rec.counters.snapshot()
+    (rec_row,) = [v for k, v in snap.per_key.items() if k.endswith(".vupdate")]
+    assert rec_row["compiles"] == 2  # one per shape-class
+    assert len(engine._classes) == 2
+    _assert_state_parity(engine, "a", ref_a)
+    _assert_state_parity(engine, "b", ref_b)
+    with pytest.raises(TorchMetricsUserError, match="shape-class"):
+        engine.update("a", *big)
+
+
+# ---------------------------------------------------------- fault isolation
+
+
+def test_fault_injected_megabatch_quarantines_only_offender():
+    rng = np.random.default_rng(10)
+    engine = ServingEngine(
+        _acc(), ServingConfig(capacity=16, megabatch_size=4, on_error="quarantine", auto_flush=False)
+    )
+    refs = {t: _acc() for t in range(8)}
+    bad = {3}
+
+    def hook(tenant_ids):
+        if any(t in bad for t in tenant_ids):
+            raise RuntimeError("injected tenant fault")
+
+    engine._fault_hook = hook
+    batch = _batches(rng, 1)[0]
+    for t in range(8):
+        engine.update(t, *batch)
+        if t not in bad:
+            refs[t].update(*batch)
+    engine.flush()
+    roster = engine.tenants()
+    assert roster[3]["quarantined"] and engine.stats["quarantined"] == 1
+    assert all(not roster[t]["quarantined"] for t in range(8) if t != 3)
+    for t in range(8):
+        if t in bad:
+            continue
+        _assert_state_parity(engine, t, refs[t])
+    # quarantined tenant rejects traffic until reset lifts it
+    with pytest.raises(TorchMetricsUserError, match="quarantined"):
+        engine.update(3, *batch)
+    engine.reset(3)
+    engine._fault_hook = None
+    engine.update(3, *batch)
+    engine.flush()
+    ref3 = _acc()
+    ref3.update(*batch)
+    _assert_state_parity(engine, 3, ref3)
+
+
+def test_quarantine_emits_telemetry():
+    rng = np.random.default_rng(11)
+    batch = _batches(rng, 1)[0]
+    with obs.telemetry_session() as rec:
+        engine = ServingEngine(
+            _acc(), ServingConfig(capacity=8, megabatch_size=2, on_error="quarantine", auto_flush=False)
+        )
+        engine._fault_hook = lambda tids: (_ for _ in ()).throw(RuntimeError("boom"))
+        engine.update("x", *batch)
+        engine.flush()
+    assert rec.counters.snapshot().counts["quarantines"] == 1
+    assert rec.events_of("quarantine")
+
+
+# ----------------------------------------------------- checkpoint round-trips
+
+
+def test_checkpoint_roundtrips_with_standalone_metric():
+    rng = np.random.default_rng(12)
+    engine = ServingEngine(_acc(), ServingConfig(capacity=4, megabatch_size=2))
+    ref = _acc()
+    for preds, target in _batches(rng, 3):
+        engine.update("ckpt", preds, target)
+        ref.update(preds, target)
+    engine.flush()
+    sd = engine.state_dict("ckpt")
+    assert sd["_update_count"] == 3
+    # engine checkpoint → standalone metric
+    solo = _acc()
+    solo.load_state_dict(sd)
+    np.testing.assert_allclose(float(solo.compute()), float(ref.compute()), rtol=1e-6)
+    # standalone metric checkpoint → fresh engine tenant (restores as spilled,
+    # readmits on next traffic)
+    ref.persistent(True)
+    engine2 = ServingEngine(_acc(), ServingConfig(capacity=4, megabatch_size=2))
+    engine2.load_state_dict("restored", ref.state_dict())
+    extra = _batches(rng, 1)[0]
+    engine2.update("restored", *extra)
+    engine2.flush()
+    ref.update(*extra)
+    _assert_state_parity(engine2, "restored", ref)
+
+
+def test_load_state_dict_validates_keys():
+    engine = ServingEngine(_acc(), ServingConfig(capacity=2, megabatch_size=2))
+    with pytest.raises(TorchMetricsUserError, match="missing"):
+        engine.load_state_dict("t", {"tp": np.zeros(NUM_CLASSES, np.int32)})
+    with pytest.raises(TorchMetricsUserError, match="unknown"):
+        engine.load_state_dict("t", {
+            **{k: np.zeros(NUM_CLASSES, np.int32) for k in ("tp", "fp", "tn", "fn")},
+            "bogus": np.zeros(3),
+        })
+
+
+# ------------------------------------------------------- self-warming (aot)
+
+
+@pytest.mark.aot
+def test_write_on_miss_second_boot_is_warm(tmp_path):
+    """Boot 1: miss → compile → write-through. Boot 2 (fresh engine, fresh
+    template, same cache dir): the megabatch program LOADS — zero fresh
+    compiles, aot_cache_hits == 1, identical values."""
+    cache = str(tmp_path / "serve-aot")
+    rng = np.random.default_rng(13)
+    batch = _batches(rng, 1)[0]
+    cfg = lambda: ServingConfig(capacity=8, megabatch_size=4, aot_cache_dir=cache)
+
+    e1 = ServingEngine(_acc(), cfg())
+    for t in range(4):
+        e1.update(t, *batch)
+    e1.flush()
+    plane1 = aot.active_plane()
+    assert plane1.stats["writes"] >= 1 and plane1.stats["misses"] >= 1
+    v1 = float(e1.compute(0))
+    aot.disable()
+
+    with obs.telemetry_session() as rec:
+        e2 = ServingEngine(_acc(), cfg())
+        for t in range(4):
+            e2.update(t, *batch)
+        e2.flush()
+        v2 = float(e2.compute(0))
+    plane2 = aot.active_plane()
+    assert plane2.stats["loads"] == 1 and plane2.stats["misses"] == 0
+    snap = rec.counters.snapshot()
+    (rec_row,) = [v for k, v in snap.per_key.items() if k.endswith(".vupdate")]
+    assert rec_row["compiles"] == 0 and rec_row["aot_hits"] == 1
+    c = snap.counts
+    assert c["aot_cache_hits"] == 1
+    assert c["jit_compiles"] + c["jit_cache_hits"] + c["aot_cache_hits"] == c["dispatches"]
+    assert v1 == v2
+    aot.disable()
+
+
+@pytest.mark.aot
+def test_engine_precompile_and_prefetch(tmp_path):
+    """Deploy-time warm start: precompile publishes the megabatch program for
+    an example shape-class; a fresh boot prefetches it and serves its first
+    real megabatch without compiling."""
+    cache = str(tmp_path / "precompile-aot")
+    rng = np.random.default_rng(14)
+    batch = _batches(rng, 1)[0]
+    aot.enable(cache)
+    e1 = ServingEngine(_acc(), ServingConfig(capacity=8, megabatch_size=4))
+    report = e1.precompile(*batch)
+    (row,) = report.values()
+    assert row["status"] == "written"
+    assert e1.precompile(*batch)[list(report)[0]]["status"] == "cached"
+    aot.disable()
+
+    aot.enable(cache)
+    e2 = ServingEngine(_acc(), ServingConfig(capacity=8, megabatch_size=4))
+    (pref,) = e2.prefetch(*batch).values()
+    assert pref["status"] == "loaded"
+    with obs.telemetry_session() as rec:
+        e2.update("t", *batch)
+        e2.flush()
+    snap = rec.counters.snapshot()
+    (rec_row,) = [v for k, v in snap.per_key.items() if k.endswith(".vupdate")]
+    assert rec_row["compiles"] == 0 and rec_row["aot_hits"] == 1
+    aot.disable()
+
+
+# ------------------------------------------------------- placement / sharding
+
+
+def test_shard_by_tenant_placement():
+    """Stacks placed with parallel.tenant_sharding spread tenant rows over
+    the 8-device CPU mesh; parity is unchanged. capacity=15 → 16 rows, evenly
+    divisible by the mesh axis."""
+    from torchmetrics_tpu.parallel import tenant_sharding
+
+    mesh = jax.make_mesh((8,), ("tenants",), devices=jax.devices()[:8])
+    sharding = tenant_sharding(mesh)
+    rng = np.random.default_rng(15)
+    engine = ServingEngine(
+        _acc(), ServingConfig(capacity=15, megabatch_size=4, sharding=sharding)
+    )
+    refs = {t: _acc() for t in range(6)}
+    for preds, target in _batches(rng, 2):
+        for t in range(6):
+            engine.update(t, preds, target)
+            refs[t].update(preds, target)
+    engine.flush()
+    for t in range(6):
+        _assert_state_parity(engine, t, refs[t])
+    cls = next(iter(engine._classes.values()))
+    assert cls.stacked[TENANT_COUNT_KEY].shape == (16,)
+
+
+def test_tenant_sharding_unknown_axis_raises():
+    from torchmetrics_tpu.parallel import tenant_sharding
+
+    mesh = jax.make_mesh((8,), ("dp",), devices=jax.devices()[:8])
+    with pytest.raises(ValueError, match="no axis"):
+        tenant_sharding(mesh)
+
+
+# ------------------------------------------------------------- misc plumbing
+
+
+def test_template_is_not_disturbed():
+    rng = np.random.default_rng(16)
+    template = _acc()
+    engine = ServingEngine(template, ServingConfig(capacity=4, megabatch_size=2))
+    batch = _batches(rng, 1)[0]
+    engine.update("t", *batch)
+    engine.flush()
+    assert template.update_count == 0
+    assert all(int(np.asarray(v).sum()) == 0 for v in template._state.values())
+
+
+def test_counters_fleet_vector_includes_serving_fields():
+    """The new serve_*/tenant_* fields ride the fleet counter vector and
+    aggregate by exact fieldwise sum like every other field."""
+    from torchmetrics_tpu.observability import COUNTER_FIELDS, Counters, aggregate_counters
+
+    a, b = Counters(), Counters()
+    a.record_serve_dispatch(8, 2)
+    a.record_tenant_spill(0.001)
+    b.record_serve_dispatch(4, 0)
+    b.record_tenant_spill(0.002, readmit=True)
+    fleet = aggregate_counters([a.snapshot(), b.snapshot()])
+    assert fleet["serve_dispatches"] == 2
+    assert fleet["serve_tenant_rows"] == 12
+    assert fleet["tenant_spills"] == 1 and fleet["tenant_readmits"] == 1
+    assert fleet["tenant_spill_us"] == 3000
+    assert len(a.counts_vector()) == len(COUNTER_FIELDS)
+    assert "serve_dispatches" in COUNTER_FIELDS

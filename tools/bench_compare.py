@@ -87,19 +87,37 @@ THRESHOLDS: Dict[str, float] = {
     "extra.collection_sync_16metrics.time_to_first_update_cold_s": 0.6,
     "extra.collection_sync_16metrics.time_to_first_update_warm_s": 0.6,
     "extra.collection_sync_16metrics.ttfu_warm_speedup_x": 0.5,
+    # multi-tenant serving engine: throughputs wobble like the flagship on a
+    # shared pod; the naive baseline is a denominator like the torch proxy;
+    # the spill column is a host<->device copy latency (noisy small values).
+    # vupdate_fresh_compiles is DELIBERATELY gated tight lower-direction: it
+    # is deterministically 1 per shape-class — any growth is a per-tenant
+    # compile explosion, the exact pathology the engine exists to kill.
+    "extra.multi_tenant_serving.tenants_per_sec_1k": 0.4,
+    "extra.multi_tenant_serving.tenants_per_sec_8k": 0.4,
+    "extra.multi_tenant_serving.naive_tenants_per_sec": 0.4,
+    "extra.multi_tenant_serving.vs_naive_speedup_1k": 0.4,
+    "extra.multi_tenant_serving.tenant_spill_us": 0.6,
+    "extra.multi_tenant_serving.vupdate_fresh_compiles": 0.25,
 }
 
 _HIGHER_MARKERS = ("per_sec", "speedup", "throughput")
-_HIGHER_EXACT = ("value", "vs_baseline")
+# tenants_per_dispatch: rows amortized per serving dispatch — more per
+# dispatch is the whole point of the megabatch plane, and the name carries no
+# throughput marker
+_HIGHER_EXACT = ("value", "vs_baseline", "tenants_per_dispatch")
 _LOWER_MARKERS = ("latency", "compile", "_sec", "_ms", "_us", "_bytes", "bytes_", "time")
 # collective counts per sync: fewer is the whole point of the coalesced plane —
 # a move back toward per-leaf collectives must gate even though the name
 # carries no latency/throughput marker
 _LOWER_EXACT = ("collectives_per_sync",)
 # deterministic workload constants: the coalesced-sync config's leaf counts,
-# and the warm-start column's program count ("precompiled" would otherwise
-# match the "compile" latency marker and gate a constant)
-_INFO_EXACT = ("leaves_coalesced_per_sync", "per_leaf_collectives", "ttfu_precompiled_programs")
+# the warm-start column's program count ("precompiled" would otherwise match
+# the "compile" latency marker and gate a constant), and the serving
+# baseline's one-shot boot cost / churn-move count (baseline properties, not
+# engine perf)
+_INFO_EXACT = ("leaves_coalesced_per_sync", "per_leaf_collectives", "ttfu_precompiled_programs",
+               "naive_boot_ms_per_tenant", "spill_moves")
 
 
 def direction(name: str) -> Optional[str]:

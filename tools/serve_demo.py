@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""End-to-end demo of the multi-tenant serving engine.
+
+Spins up a :class:`~torchmetrics_tpu.serving.ServingEngine` over one metric
+template, drives synthetic per-tenant traffic through the stacked/vmapped
+megabatch plane, and prints one JSON report proving the engine's three
+headline claims on YOUR machine:
+
+- **throughput**: ``tenants_per_sec`` through the megabatch dispatch vs the
+  naive one-python-dispatch-per-tenant loop;
+- **one compile, many tenants**: the telemetry compile counters show exactly
+  one fresh XLA compile per (shape-class × tag) regardless of tenant count
+  (``tenants_per_dispatch`` reconciles against the engine's own stats);
+- **self-warming boot**: with ``--cache-dir``, the first run compiles and
+  writes through (``write_on_miss``); run the same command again and the
+  report shows the megabatch program LOADED from the AOT cache instead.
+
+Examples::
+
+    python tools/serve_demo.py --tenants 1000 --steps 4
+    python tools/serve_demo.py --tenants 8000 --capacity 2048      # LRU spill churn
+    python tools/serve_demo.py --cache-dir /tmp/serve-aot          # run twice: 2nd boot is warm
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+# runnable as a bare script from anywhere: the package lives one level up
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[1])
+    parser.add_argument("--tenants", type=int, default=1000, help="fleet size (default 1000)")
+    parser.add_argument("--steps", type=int, default=4, help="traffic rounds over the whole fleet")
+    parser.add_argument("--batch", type=int, default=32, help="events per tenant batch")
+    parser.add_argument("--num-classes", type=int, default=10)
+    parser.add_argument("--megabatch", type=int, default=512, help="tenant rows per dispatch")
+    parser.add_argument("--capacity", type=int, default=None,
+                        help="resident slots (default: fleet size; smaller forces LRU spill churn)")
+    parser.add_argument("--cache-dir", default=None,
+                        help="AOT cache dir: boot self-warms via write_on_miss (2nd run loads)")
+    parser.add_argument("--skip-naive", action="store_true",
+                        help="skip the naive per-dispatch baseline loop")
+    args = parser.parse_args(argv)
+
+    import numpy as np
+
+    import jax
+    from torchmetrics_tpu import observability as obs
+    from torchmetrics_tpu.classification import MulticlassAccuracy
+    from torchmetrics_tpu.serving import ServingConfig, ServingEngine
+
+    rng = np.random.default_rng(0)
+    preds = rng.normal(size=(args.batch, args.num_classes)).astype(np.float32)
+    target = rng.integers(0, args.num_classes, args.batch, dtype=np.int32)
+    mk = lambda: MulticlassAccuracy(args.num_classes, average="micro", validate_args=False)
+
+    out = {
+        "tenants": args.tenants, "steps": args.steps, "batch": args.batch,
+        "megabatch": args.megabatch, "capacity": args.capacity or args.tenants,
+    }
+
+    config = ServingConfig(
+        capacity=args.capacity or args.tenants,
+        megabatch_size=args.megabatch,
+        aot_cache_dir=args.cache_dir,
+    )
+    with obs.telemetry_session() as rec:
+        boot0 = time.perf_counter()
+        engine = ServingEngine(mk(), config)
+        for t in range(args.tenants):
+            engine.update(t, preds, target)
+        engine.flush()
+        engine.block_until_ready()
+        out["boot_first_round_s"] = round(time.perf_counter() - boot0, 4)
+
+        start = time.perf_counter()
+        for _ in range(args.steps):
+            for t in range(args.tenants):
+                engine.update(t, preds, target)
+            engine.flush()
+        engine.block_until_ready()
+        elapsed = time.perf_counter() - start
+        out["tenants_per_sec"] = round(args.tenants * args.steps / elapsed, 2)
+        out["sample_values"] = {
+            str(t): round(float(engine.compute(t)), 6) for t in (0, args.tenants - 1)
+        }
+    snap = rec.counters.snapshot()
+    out["one_compile_proof"] = {
+        "vupdate_fresh_compiles": sum(
+            v["compiles"] for k, v in snap.per_key.items() if k.endswith(".vupdate")
+        ),
+        "aot_cache_hits": snap.counts["aot_cache_hits"],
+        "tenants_per_dispatch": snap.summary(brief=True)["tenants_per_dispatch"],
+    }
+    out["engine"] = engine.summary()
+    out["memory"] = engine.memory()
+    if args.cache_dir:
+        from torchmetrics_tpu import aot
+
+        plane = aot.active_plane()
+        if plane is not None:
+            out["aot"] = dict(plane.stats)
+            out["aot"]["hint"] = (
+                "loads>0 means this boot was WARM (served from the cache); "
+                "writes>0 means it self-warmed the next boot"
+            )
+
+    if not args.skip_naive:
+        n = min(args.tenants, 64)  # rate is dispatch-bound, tenant-count-invariant
+        objs = [mk() for _ in range(n)]
+        for m in objs:
+            m.update(preds, target)
+        for m in objs:
+            jax.block_until_ready(m._state)
+        start = time.perf_counter()
+        for _ in range(args.steps):
+            for m in objs:
+                m.update(preds, target)
+        for m in objs:
+            jax.block_until_ready(m._state)
+        naive = n * args.steps / (time.perf_counter() - start)
+        out["naive_tenants_per_sec"] = round(naive, 2)
+        out["speedup_vs_naive"] = round(out["tenants_per_sec"] / naive, 2)
+
+    print(json.dumps(out, indent=2, default=str))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -17,7 +17,11 @@ Named sets pin the exact metric constructions + input shapes of the bench
 configs, so the cache they produce is byte-identical to what the bench's warm
 column measures. ``--batch``/``--num-classes`` override shapes for custom
 traffic; ``--list`` shows the sets; ``--scan`` reports cache health (entries,
-bytes, undecodable files); ``--prune-tmp`` sweeps crashed writers' temp files.
+total bytes, undecodable files); ``--prune-tmp`` sweeps crashed writers' temp
+files; ``--max-bytes SIZE`` (plain bytes or K/M/G suffix) LRU-prunes the cache
+to a size budget — least-recently-hit entries go first (every validated load
+refreshes an entry's mtime), so a self-warming fleet (``write_on_miss``)
+cannot grow the cache unboundedly.
 
 Prints one JSON report. Exit code 0 unless precompilation itself fails.
 """
@@ -141,6 +145,15 @@ def _count_rows(report: Dict[str, Any]) -> Dict[str, int]:
     return counts
 
 
+def parse_size(text: str) -> int:
+    """``"512M"``/``"2G"``/``"65536"`` → bytes (K/M/G/T binary suffixes)."""
+    s = text.strip().upper().removesuffix("B")
+    units = {"K": 1 << 10, "M": 1 << 20, "G": 1 << 30, "T": 1 << 40}
+    if s and s[-1] in units:
+        return int(float(s[:-1]) * units[s[-1]])
+    return int(s)
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[1])
     parser.add_argument("--cache-dir", default=None,
@@ -156,6 +169,9 @@ def main(argv=None) -> int:
     parser.add_argument("--list", action="store_true", help="list the named sets and exit")
     parser.add_argument("--scan", action="store_true", help="report cache health and exit")
     parser.add_argument("--prune-tmp", action="store_true", help="sweep orphaned temp files and exit")
+    parser.add_argument("--max-bytes", default=None, metavar="SIZE",
+                        help="LRU-prune the cache to this size budget and exit "
+                             "(bytes, or K/M/G suffix; least-recently-hit entries removed first)")
     args = parser.parse_args(argv)
 
     if args.list:
@@ -170,6 +186,11 @@ def main(argv=None) -> int:
         return 0
     if args.prune_tmp:
         print(json.dumps({"swept": plane.cache.prune_tmp()}))
+        return 0
+    if args.max_bytes is not None:
+        report = plane.cache.prune(parse_size(args.max_bytes))
+        report["scan"] = plane.cache.scan()
+        print(json.dumps(report, indent=2))
         return 0
 
     names = list(SETS) if args.all else args.sets

@@ -47,6 +47,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import threading
 import time
 from typing import Any, Dict, Mapping, Optional, Tuple
 
@@ -145,10 +146,17 @@ class AotPlane:
         self.config = config or AotConfig()
         self.cache = AotCache(self.config.cache_dir or default_cache_dir())
         # host-side stats independent of any telemetry session (the CLI and
-        # the bench warm-start probes read these)
+        # the bench warm-start probes read these); lock-guarded because
+        # MetricCollection.precompile prefetches entries from a thread pool
         self.stats: Dict[str, int] = {
             "loads": 0, "misses": 0, "corrupt": 0, "writes": 0, "load_ns": 0,
         }
+        self._stats_lock = threading.Lock()
+
+    def _bump(self, **deltas: int) -> None:
+        with self._stats_lock:
+            for key, delta in deltas.items():
+                self.stats[key] += delta
 
     # ------------------------------------------------------------ dispatch path
 
@@ -189,8 +197,8 @@ class AotPlane:
             # (magic/header/checksum/truncation) is corruption, not absence —
             # both are misses, but the distinction matters to an operator
             if os.path.exists(self.cache.path_for(key)):
-                self.stats["corrupt"] += 1
-            self.stats["misses"] += 1
+                self._bump(corrupt=1)
+            self._bump(misses=1)
             slot = _DispatchEntry(
                 None, key, sig, miss_pending=True,
                 store_pending=self.config.write_on_miss,
@@ -203,8 +211,7 @@ class AotPlane:
         except codecs.CodecError:
             # every payload in the entry is undecodable on this runtime —
             # treat as corruption: miss, fresh compile, no exception
-            self.stats["corrupt"] += 1
-            self.stats["misses"] += 1
+            self._bump(corrupt=1, misses=1)
             slot = _DispatchEntry(
                 None, key, sig, miss_pending=True,
                 store_pending=self.config.write_on_miss,
@@ -212,8 +219,7 @@ class AotPlane:
             memo[memo_key] = slot
             return slot
         load_s = time.perf_counter() - t0
-        self.stats["loads"] += 1
-        self.stats["load_ns"] += int(load_s * 1e9)
+        self._bump(loads=1, load_ns=int(load_s * 1e9))
         slot = _DispatchEntry(
             compiled, key, sig, codec=codec, nbytes=entry.nbytes, load_s=load_s,
             source="disk", event_pending=True,
@@ -249,7 +255,7 @@ class AotPlane:
             )
             meta.update(self._entry_meta(metric, tag, entry.signature, donate))
             self.cache.put(entry.key, sections, meta)
-            self.stats["writes"] += 1
+            self._bump(writes=1)
             # the freshly compiled program also serves this signature's future
             # dispatches in-process
             entry.compiled = compiled
@@ -293,7 +299,7 @@ class AotPlane:
         )
         meta.update(self._entry_meta(metric, tag, sig, donate))
         path = self.cache.put(key, sections, meta)
-        self.stats["writes"] += 1
+        self._bump(writes=1)
         memo = metric.__dict__.setdefault("_aot_memo", {})
         memo[(tag, sig, tree)] = _DispatchEntry(
             compiled, key, sig, codec=(meta.get("codecs") or ["in_process"])[0],

@@ -124,6 +124,12 @@ class AotCache:
             # key mismatch: truncated-to-another-entry or a hash collision —
             # either way this is not the requested program
             return None
+        try:
+            # refresh mtime as a last-hit stamp: the size-budget pruner evicts
+            # least-recently-LOADED entries, not least-recently-written ones
+            os.utime(path)
+        except OSError:
+            pass
         return entry
 
     @staticmethod
@@ -198,6 +204,59 @@ class AotCache:
                 ok += 1
                 total_bytes += len(raw)
         return {"root": self.root, "entries": ok, "bytes": total_bytes, "undecodable": corrupt}
+
+    def prune(self, max_bytes: int) -> Dict[str, Any]:
+        """LRU size budget: delete entries, least-recently-hit first, until
+        the cache's decodable bytes fit ``max_bytes``.
+
+        Recency is the file mtime, which :meth:`get` refreshes on every
+        validated load — so a self-warming fleet's hot programs survive and
+        the long tail of one-off shapes gets reclaimed. Undecodable ``.aot``
+        files are deleted unconditionally (they can never serve a load), as
+        are orphaned temp files. Returns a report dict.
+        """
+        if max_bytes < 0:
+            raise ValueError(f"max_bytes must be >= 0, got {max_bytes}")
+        swept_tmp = self.prune_tmp()
+        live: List[Tuple[float, int, str]] = []  # (mtime, size, path)
+        removed: List[str] = []
+        freed = 0
+        for name in sorted(os.listdir(self.root)):
+            if not name.endswith(".aot"):
+                continue
+            path = os.path.join(self.root, name)
+            try:
+                stat = os.stat(path)
+                with open(path, "rb") as fh:
+                    raw = fh.read()
+            except OSError:
+                continue
+            if self._decode(raw, path) is None:
+                try:
+                    os.unlink(path)
+                    removed.append(name)
+                    freed += len(raw)
+                except OSError:
+                    pass
+                continue
+            live.append((stat.st_mtime, len(raw), path))
+        total = sum(size for _, size, _ in live)
+        live.sort()  # oldest last-hit first
+        for _, size, path in live:
+            if total <= max_bytes:
+                break
+            try:
+                os.unlink(path)
+            except OSError:
+                continue
+            removed.append(os.path.basename(path))
+            freed += size
+            total -= size
+        return {
+            "root": self.root, "max_bytes": int(max_bytes), "removed": removed,
+            "freed_bytes": freed, "kept_entries": sum(1 for _, s, p in live if os.path.exists(p)),
+            "kept_bytes": total, "swept_tmp": swept_tmp,
+        }
 
     def prune_tmp(self) -> int:
         """Sweep orphaned temp files from crashed writers."""

@@ -64,6 +64,11 @@ StateDict = Dict[str, Any]
 
 _ALLOWED_REDUCE = ("sum", "mean", "cat", "min", "max", None)
 
+#: reserved leaf name for the per-row update-count vector a serving stack
+#: carries next to the real tensor states (``torchmetrics_tpu/serving``) —
+#: the stacked analogue of the scalar ``_device_update_count`` counter
+TENANT_COUNT_KEY = "__tenant_n"
+
 
 def _fresh_leaf(default: Any) -> Array:
     """Fresh device buffer from a state default, with no device→host readback.
@@ -341,6 +346,58 @@ class Metric:
 
             self._jit_cache[f"{key}.raw"] = fn  # undonated source for _aot_program
             self._jit_cache[key] = jax.jit(fn, donate_argnums=0) if (self._enable_jit and self._jittable_compute) else fn
+        return self._jit_cache[key]
+
+    def _get_vupdate_fn(self) -> Callable:
+        """The vmapped megabatch program behind the serving engine's stacked
+        dispatch (``torchmetrics_tpu/serving``): ONE XLA call updates many
+        logical metric states held as a stacked pytree.
+
+        Calling convention (fixed by ``_donation_safe_dispatch`` and the AOT
+        plane): ``fn(stacked, n_scalar, idx, args, kwargs)`` where ``stacked``
+        maps every tensor-state name to a ``(rows, *state_shape)`` array plus
+        the :data:`TENANT_COUNT_KEY` per-row update-count vector, ``idx`` is
+        the ``(M,)`` int32 row address of each megabatch entry, and
+        ``args``/``kwargs`` are the per-entry batch pytrees stacked along a
+        leading ``M`` axis. The body gathers the addressed rows, applies the
+        SAME single-metric update fold (``update.raw`` — running-mean weights
+        included, so per-row semantics are identical to ``update()``) under
+        ``jax.vmap``, and scatters the results back; rows ``idx`` does not
+        address pass through untouched. Only the stacked dict is donated —
+        the scalar counter argument is the calling-convention placeholder
+        every dispatch tag shares (serving keeps its real per-row counts
+        inside the stack), and donating it would delete the live
+        ``_n_prev_dev`` buffer under the ordinary update path.
+        """
+        key = "vupdate"
+        if key not in self._jit_cache:
+            if self._list_state_names:
+                raise TorchMetricsUserError(
+                    f"{type(self).__name__} holds dynamic-length concat states and cannot be "
+                    "served from a stacked pytree; use a binned/static-shape variant."
+                )
+            self._get_update_fn()  # materializes the shared "update.raw" body
+            raw = self._jit_cache["update.raw"]
+
+            def per_row(tensor_state, n_prev, a, kw):
+                new_t, _appends, n_next = raw(tensor_state, n_prev, *a, **kw)
+                return new_t, n_next
+
+            def fn(stacked, n_scalar, idx, args, kwargs):
+                del n_scalar  # placeholder — see the docstring
+                counts = stacked[TENANT_COUNT_KEY]
+                states = {k: v for k, v in stacked.items() if k != TENANT_COUNT_KEY}
+                with jax.named_scope(f"{type(self).__name__}.gather_rows"):
+                    rows = {k: jnp.take(v, idx, axis=0) for k, v in states.items()}
+                    n_rows = jnp.take(counts, idx, axis=0)
+                new_rows, new_n = jax.vmap(per_row)(rows, n_rows, args, kwargs)
+                with jax.named_scope(f"{type(self).__name__}.scatter_rows"):
+                    out = {k: v.at[idx].set(new_rows[k]) for k, v in states.items()}
+                    out[TENANT_COUNT_KEY] = counts.at[idx].set(new_n)
+                return out
+
+            self._jit_cache[f"{key}.raw"] = fn  # undonated source for _aot_program
+            self._jit_cache[key] = jax.jit(fn, donate_argnums=0) if self._enable_jit else fn
         return self._jit_cache[key]
 
     def _append_list_state(self, name: str, value: Any) -> None:
@@ -1059,8 +1116,10 @@ class Metric:
             primary = self._get_update_fn()
         elif tag == "forward":
             primary = self._get_forward_fn()
+        elif tag == "vupdate":
+            primary = self._get_vupdate_fn()
         else:
-            raise ValueError(f"Unknown dispatch tag {tag!r}; expected 'update' or 'forward'")
+            raise ValueError(f"Unknown dispatch tag {tag!r}; expected 'update', 'forward' or 'vupdate'")
         raw = self._jit_cache.get(f"{tag}.raw")
         if raw is None or not hasattr(primary, "lower"):
             return primary, ()
@@ -1134,6 +1193,57 @@ class Metric:
                 )
             except _aot.keys.UnfingerprintableConfig as err:
                 report[tag] = {"status": "skipped", "reason": f"uncacheable: {err}"}
+        return report
+
+    def prefetch_compiled(
+        self,
+        *example_inputs: Any,
+        tags: Sequence[str] = ("update",),
+        **example_kwargs: Any,
+    ) -> Dict[str, Any]:
+        """Load this metric's cached executables for the example signature
+        into the in-process dispatch memo WITHOUT compiling on a miss.
+
+        The read-only sibling of :meth:`precompile`: a hit deserializes the
+        program and primes ``_aot_memo`` so the first real dispatch is served
+        from memory (no disk probe on the traffic path); a miss is remembered
+        exactly like a dispatch-time miss (the jit path owns that signature —
+        and, under ``AotConfig(write_on_miss=True)``, the fresh compile will
+        write through). Thread-safe against OTHER metrics prefetching
+        concurrently — ``MetricCollection.precompile`` overlaps its members'
+        deserializations on a thread pool. Returns ``{tag: row}``.
+        """
+        plane = _aot._ACTIVE
+        if plane is None:
+            raise TorchMetricsUserError(
+                "prefetch_compiled needs an active AOT plane — call "
+                "torchmetrics_tpu.aot.enable(cache_dir) first."
+            )
+        if not self._enable_jit:
+            return {tag: {"status": "skipped", "reason": "jit disabled on this metric"} for tag in tags}
+        has_placeholder = any(
+            isinstance(leaf, jax.ShapeDtypeStruct)
+            for leaf in jax.tree_util.tree_leaves((example_inputs, example_kwargs))
+        )
+        if has_placeholder:
+            args, kwargs = example_inputs, example_kwargs
+        else:
+            args, kwargs = self._prepare_inputs(*example_inputs, **example_kwargs)
+        tensors, _ = self._split_tensor_list(self._state)
+        report: Dict[str, Any] = {}
+        for tag in tags:
+            fn, _donate = self._aot_program(tag)
+            if not hasattr(fn, "lower"):
+                report[tag] = {"status": "skipped", "reason": "program not jitted (eager/host compute path)"}
+                continue
+            slot = plane.lookup_dispatch(self, tag, tensors, (args, kwargs))
+            if slot is not None and slot.compiled is not None:
+                report[tag] = {
+                    "status": "loaded", "codec": slot.codec,
+                    "load_s": round(slot.load_s, 6), "bytes": slot.nbytes,
+                }
+            else:
+                report[tag] = {"status": "miss"}
         return report
 
     # ------------------------------------------------------------ kwarg filter
@@ -1242,6 +1352,13 @@ class HostMetric(Metric):
             for tag in tags
         }
 
+    def prefetch_compiled(self, *example_inputs: Any, tags: Sequence[str] = ("update",), **kwargs: Any) -> Dict[str, Any]:
+        """No jitted program — nothing to deserialize (see :meth:`precompile`)."""
+        return {
+            tag: {"status": "skipped", "reason": "host-side metric — no jitted dispatch program"}
+            for tag in tags
+        }
+
     def _host_batch_state(self, *args: Any, **kwargs: Any) -> StateDict:
         raise NotImplementedError
 
@@ -1342,6 +1459,18 @@ class CompositionalMetric(Metric):
                 report[side] = operand.precompile(
                     *example_inputs, tags=tags, cache_dir=cache_dir, force=force,
                     **operand._filter_kwargs(**example_kwargs),
+                )
+        return report
+
+    def prefetch_compiled(
+        self, *example_inputs: Any, tags: Sequence[str] = ("update",), **example_kwargs: Any
+    ) -> Dict[str, Any]:
+        """Prefetch both operands' cached programs (the composition has none)."""
+        report: Dict[str, Any] = {}
+        for side, operand in (("metric_a", self.metric_a), ("metric_b", self.metric_b)):
+            if isinstance(operand, Metric):
+                report[side] = operand.prefetch_compiled(
+                    *example_inputs, tags=tags, **operand._filter_kwargs(**example_kwargs),
                 )
         return report
 

@@ -385,6 +385,36 @@ class TelemetryRecorder:
                 UserWarning,
             )
 
+    def record_serve_dispatch(self, metric: Any, rows: int, padded: int = 0) -> None:
+        """One megabatched serving dispatch (``torchmetrics_tpu/serving``):
+        ``rows`` real tenant rows updated by a single vmapped program (plus
+        ``padded`` scratch rows keeping the dispatch signature fixed). The
+        dispatch latency itself was already recorded by :meth:`record_dispatch`
+        under the ``vupdate`` tag — this adds the tenant-amortization view the
+        derived ``tenants_per_dispatch`` headline reports."""
+        name = self._metric_name(metric)
+        self.counters.record_serve_dispatch(rows, padded)
+        self._event(
+            "serve", name, "vupdate",
+            payload={"tenant_rows": int(rows), "padded_rows": int(padded)},
+        )
+
+    def record_tenant_spill(
+        self, metric: Any, duration_s: float, nbytes: int, readmit: bool = False
+    ) -> None:
+        """One LRU spill of a cold tenant's state rows to host memory (or,
+        ``readmit=True``, the upload back into a stack slot). Wall-clock lands
+        in ``tenant_spill_us`` and the ``tenant_spill`` histogram kind; bytes
+        come from array metadata (the spill itself is the D2H — accounted
+        separately via :meth:`record_d2h` at the call site)."""
+        name = self._metric_name(metric)
+        self.counters.record_tenant_spill(duration_s, readmit=readmit)
+        self.histograms.record_duration("tenant_spill", name, duration_s)
+        self._event(
+            "tenant_spill", name, "readmit" if readmit else "spill",
+            duration_s=duration_s, payload={"nbytes": int(nbytes)},
+        )
+
     def record_d2h(self, site: str, nbytes: int, metric: Any = None) -> None:
         """An instrumented device→host readback (``state_dict``,
         ``compute_on_cpu`` appends, finiteness guards). The hot loop's

@@ -47,7 +47,25 @@ COUNTER_FIELDS: Tuple[str, ...] = (
     "skips",  # per-batch skips under on_error="skip"
     "state_growths",  # list/cat states that crossed the unbounded-growth sentinel
     "alerts",  # SLO engine alerts emitted (breaches + rule errors)
+    "serve_dispatches",  # megabatched stacked-state dispatches (serving engine)
+    "serve_tenant_rows",  # real tenant rows those dispatches served
+    "serve_padded_rows",  # scratch pad rows burned to keep megabatch signatures fixed
+    "tenant_spills",  # cold tenant states spilled from the stack to host memory
+    "tenant_readmits",  # spilled tenant states uploaded back into a stack slot
+    "tenant_spill_us",  # wall-clock spent spilling/readmitting tenant state
 )
+
+
+def _tenants_per_dispatch(counts: Mapping[str, int]) -> float:
+    """Derived headline of the serving engine: real tenant rows served per
+    megabatched dispatch. One python dispatch per tenant reads 1.0; the
+    stacked/vmapped plane reads close to the megabatch size — the direct
+    observable of one-compile-many-tenants amortization (0.0 before any
+    serving dispatch ran)."""
+    dispatches = int(counts.get("serve_dispatches", 0))
+    if not dispatches:
+        return 0.0
+    return round(int(counts.get("serve_tenant_rows", 0)) / dispatches, 3)
 
 
 def _collectives_per_sync(counts: Mapping[str, int]) -> float:
@@ -114,13 +132,15 @@ class CountersSnapshot:
             keys = (
                 "dispatches", "jit_compiles", "jit_cache_hits", "retraces",
                 "host_dispatches", "d2h_readbacks", "sync_calls",
-                "gathers_coalesced",
+                "gathers_coalesced", "serve_dispatches",
             )
             out = {k: self.counts[k] for k in keys}
             out["collectives_per_sync"] = _collectives_per_sync(self.counts)
+            out["tenants_per_dispatch"] = _tenants_per_dispatch(self.counts)
             return out
         out: Dict[str, Any] = dict(self.counts)
         out["collectives_per_sync"] = _collectives_per_sync(self.counts)
+        out["tenants_per_dispatch"] = _tenants_per_dispatch(self.counts)
         out["per_key"] = {
             k: {"compiles": v["compiles"], "cache_hits": v["cache_hits"],
                 "aot_hits": v.get("aot_hits", 0),
@@ -306,6 +326,21 @@ class Counters:
         with self._lock:
             self._counts["state_growths"] += 1
 
+    def record_serve_dispatch(self, rows: int, padded: int = 0) -> None:
+        """One megabatched serving dispatch that updated ``rows`` real tenant
+        rows (plus ``padded`` scratch rows keeping the signature fixed)."""
+        with self._lock:
+            self._counts["serve_dispatches"] += 1
+            self._counts["serve_tenant_rows"] += int(rows)
+            self._counts["serve_padded_rows"] += int(padded)
+
+    def record_tenant_spill(self, duration_s: float, readmit: bool = False) -> None:
+        """One tenant-state spill to host (or, ``readmit=True``, an upload
+        back into a stack slot); wall-clock accumulates like ``sync_time_us``."""
+        with self._lock:
+            self._counts["tenant_readmits" if readmit else "tenant_spills"] += 1
+            self._counts["tenant_spill_us"] += max(0, int(duration_s * 1e6))
+
     def record_alert(self) -> None:
         with self._lock:
             self._counts["alerts"] += 1
@@ -407,12 +442,13 @@ class FleetSnapshot:
             keys = (
                 "dispatches", "jit_compiles", "jit_cache_hits", "retraces",
                 "host_dispatches", "d2h_readbacks", "sync_calls",
-                "gathers_coalesced",
+                "gathers_coalesced", "serve_dispatches",
             )
             return {
                 "fleet": True, "ranks": self.ranks,
                 **{k: self.totals[k] for k in keys},
                 "collectives_per_sync": _collectives_per_sync(self.totals),
+                "tenants_per_dispatch": _tenants_per_dispatch(self.totals),
                 "stragglers": dict(self.stragglers),
             }
         return {

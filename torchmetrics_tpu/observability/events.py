@@ -37,6 +37,8 @@ EVENT_KINDS: Tuple[str, ...] = (
     "state_growth",  # a list/cat state crossed the unbounded-growth threshold
     "alert",  # an SLO rule breached (or errored) — observability/slo.py
     "hist",  # a latency/size histogram snapshot (flushed at session close)
+    "serve",  # a megabatched stacked-state dispatch (serving engine)
+    "tenant_spill",  # tenant state spilled to host / readmitted into a stack
 )
 
 

@@ -1,6 +1,15 @@
 from . import coalesce
 from .coalesce import CoalesceFallback, coalesced_process_sync, collective_counts, reduce_many
-from .mesh import DEFAULT_AXIS, batch_sharding, make_2d_mesh, make_data_mesh, replicated, shard_map
+from .mesh import (
+    DEFAULT_AXIS,
+    DEFAULT_TENANT_AXIS,
+    batch_sharding,
+    make_2d_mesh,
+    make_data_mesh,
+    replicated,
+    shard_map,
+    tenant_sharding,
+)
 from .sync import (
     distributed_available,
     gather_all_arrays,
@@ -15,6 +24,7 @@ from .sync import (
 __all__ = [
     "CoalesceFallback",
     "DEFAULT_AXIS",
+    "DEFAULT_TENANT_AXIS",
     "batch_sharding",
     "coalesce",
     "coalesced_process_sync",
@@ -32,4 +42,5 @@ __all__ = [
     "reduce_states_per_leaf",
     "replicated",
     "shard_map",
+    "tenant_sharding",
 ]

@@ -78,7 +78,9 @@ _MAGIC = 0x436F414C  # "CoAL"
 # v3: the counter vector gained the aot_cache_* fields and the histogram
 # section the aot_load kind (PR 6) — both tails grew, so mixed-version ranks
 # must fail validation rather than misparse each other's rows
-_VERSION = 3
+# v4: the counter vector gained the serving-engine fields (serve_* /
+# tenant_*) — same mixed-version rule
+_VERSION = 4
 _HEADER_LEN = 4  # [magic, version, n_leaves, n_counter_fields]
 _LEAF_REC_LEN = 2 + _MAX_RANK + 1  # [dtype_code, ndim, d0..d7, kind]
 _KIND_TENSOR = 0

@@ -14,6 +14,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 DEFAULT_AXIS = "metrics_dp"
 
+#: mesh-axis name the serving engine shards its stacked tenant states over
+DEFAULT_TENANT_AXIS = "tenants"
+
 
 def shard_map(f, mesh: Mesh, in_specs, out_specs, check_vma: Optional[bool] = None):
     """``jax.shard_map`` across jax versions.
@@ -91,4 +94,20 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 
 def batch_sharding(mesh: Mesh, axis_name: str = DEFAULT_AXIS) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec(axis_name))
+
+
+def tenant_sharding(mesh: Mesh, axis_name: str = DEFAULT_TENANT_AXIS) -> NamedSharding:
+    """Shard-by-tenant placement for the serving engine's stacked states.
+
+    Every stack leaf carries a leading tenant-row axis; partitioning THAT axis
+    over a mesh axis spreads the fleet's state (and the vmapped megabatch
+    work addressing it) across devices while each tenant's row stays whole on
+    one device — tenants never need cross-device reduction with each other.
+    Pass the result as ``ServingConfig(sharding=...)``; pick a stack row count
+    (``capacity + 1`` — one scratch row rides along) divisible by the mesh
+    axis size so XLA keeps the gather/scatter local-major.
+    """
+    if axis_name not in mesh.shape:
+        raise ValueError(f"mesh has no axis {axis_name!r}; axes: {tuple(mesh.shape)}")
     return NamedSharding(mesh, PartitionSpec(axis_name))

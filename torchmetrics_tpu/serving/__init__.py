@@ -1,0 +1,6 @@
+"""Multi-tenant serving engine — stacked tenant states, vmapped megabatch
+dispatch, LRU spill, per-tenant lifecycle. See ``docs/serving.md``."""
+
+from .engine import ServingConfig, ServingEngine
+
+__all__ = ["ServingConfig", "ServingEngine"]

@@ -1,0 +1,745 @@
+"""Multi-tenant serving engine: vmapped megabatch dispatch over stacked states.
+
+The runtime layers below (reliability, telemetry, coalesced sync, AOT warm
+start) all assume ONE training loop owning a handful of metric objects. A
+metric *service* — the ROADMAP's "millions of users" north star — inverts the
+shape: thousands of logical sessions, each a tiny per-tenant state, each fed a
+trickle of traffic. One python dispatch per tenant per batch is the killer:
+dispatch overhead (tens of microseconds on CPU, ~ms through a TPU tunnel)
+dwarfs the per-tenant math, and one ``Metric`` object per tenant multiplies
+trace/compile cost by the fleet size.
+
+The DrJAX-style fix (PAPERS.md): hold all tenants of a *shape-class* as one
+**stacked pytree** — every tensor-state leaf grows a leading tenant-row axis —
+and update many tenants per XLA call:
+
+- ``update(tenant_id, *batch)`` buffers traffic per shape-class (the
+  shape/dtype signature of the batch — the same notion the compile counters
+  and the AOT cache key on);
+- a **megabatch** is up to ``megabatch_size`` distinct tenants' batches
+  stacked along a leading axis, padded to a FIXED size with scratch rows so
+  the dispatch signature never varies → **one XLA compile per (shape-class ×
+  tag) regardless of tenant count**, provable from the compile counters
+  (``tenants_per_dispatch`` and ``aot_cache_hits`` reconcile exactly);
+- the program (``Metric._get_vupdate_fn``) gathers the addressed rows,
+  ``jax.vmap``s the SAME single-metric update fold over them (running-mean
+  weights ride a per-row count vector inside the stack), and scatters back —
+  dispatched through ``Metric._donation_safe_dispatch`` so donation, the
+  telemetry counters, and the AOT compile cache all apply unchanged.
+
+Around the hot path: tenant admission with **LRU spill** of cold tenant state
+to host memory (slots are finite; spilled tenants readmit transparently on
+their next traffic, and spill/readmit wall-clock lands in the
+``tenant_spill_us`` counter), per-tenant ``compute``/``reset``/checkpoint by
+slicing the stack (checkpoints round-trip with ``Metric.load_state_dict``),
+optional shard-by-tenant placement over a mesh axis
+(``parallel.tenant_sharding``), and engine-level fault isolation
+(``on_error="quarantine"``: a poisoned megabatch is rolled back and re-driven
+one tenant at a time, quarantining only the offending tenant, never the
+stack). With ``ServingConfig(aot_cache_dir=...)`` a freshly booted server
+self-warms: the first megabatch per shape-class either loads a serialized
+executable or compiles once and writes through (``write_on_miss``), so the
+SECOND boot serves its first traffic from a cache load.
+
+See ``docs/serving.md`` for the architecture walk-through and
+``tools/serve_demo.py`` for a runnable end-to-end demo.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import time
+from collections import deque
+from typing import Any, Callable, Dict, Hashable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import aot as _aot
+from .. import observability as _observability
+from ..aot import keys as _aot_keys
+from ..metric import TENANT_COUNT_KEY, Metric
+from ..utilities.exceptions import TorchMetricsUserError
+
+StateDict = Dict[str, Any]
+
+_ON_ERROR_MODES = ("raise", "quarantine")
+
+
+@dataclasses.dataclass(frozen=True)
+class ServingConfig:
+    """Knobs for one :class:`ServingEngine`.
+
+    Args:
+        capacity: resident tenant slots per shape-class stack. Each stack
+            allocates ``capacity + 1`` rows — the extra row is the scratch
+            slot megabatch padding scatters into (pick ``capacity + 1``
+            divisible by the mesh axis size when sharding).
+        megabatch_size: tenant rows per dispatch. Every megabatch is padded
+            to exactly this many rows so each shape-class compiles ONE
+            program; undersized flushes burn scratch rows (cheap), oversized
+            queues split into several dispatches.
+        auto_flush: dispatch a shape-class as soon as a full megabatch of
+            distinct tenants is pending (otherwise only :meth:`ServingEngine.
+            flush` dispatches).
+        spill: evict the least-recently-used tenant's state rows to host
+            memory when a stack is full (off: admission past capacity raises).
+        on_error: ``"raise"`` propagates any dispatch failure (no rollback
+            copies on the hot path — the default); ``"quarantine"`` backs the
+            stack up before every megabatch, rolls back on failure, re-drives
+            the entries one tenant at a time, and quarantines only the
+            offending tenant(s).
+        aot_cache_dir: activate the AOT compile-cache plane process-wide at
+            engine construction, pointed at this directory, with
+            ``write_on_miss`` below — the self-warming boot path (a second
+            boot loads executables instead of compiling). ``None`` leaves
+            whatever plane is active untouched.
+        write_on_miss: with ``aot_cache_dir``: write freshly compiled
+            megabatch programs through to the cache so the NEXT boot is warm.
+        sharding: a ``jax.sharding.Sharding`` applied to every stack leaf
+            (leading axis = tenant rows) — see ``parallel.tenant_sharding``.
+    """
+
+    capacity: int = 1024
+    megabatch_size: int = 256
+    auto_flush: bool = True
+    spill: bool = True
+    on_error: str = "raise"
+    aot_cache_dir: Optional[str] = None
+    write_on_miss: bool = True
+    sharding: Any = None
+
+    def __post_init__(self) -> None:
+        if self.capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {self.capacity}")
+        if self.megabatch_size < 1:
+            raise ValueError(f"megabatch_size must be >= 1, got {self.megabatch_size}")
+        if self.megabatch_size > self.capacity:
+            # every megabatch member needs a resident slot for the duration of
+            # its dispatch — a chunk wider than the stack cannot be seated
+            raise ValueError(
+                f"megabatch_size ({self.megabatch_size}) must be <= capacity ({self.capacity})"
+            )
+        if self.on_error not in _ON_ERROR_MODES:
+            raise ValueError(f"Expected `on_error` to be one of {_ON_ERROR_MODES}, got {self.on_error!r}")
+
+
+class _Tenant:
+    """Host-side bookkeeping for one logical session."""
+
+    __slots__ = ("tenant_id", "shape_key", "slot", "update_count", "last_touch",
+                 "pending", "quarantined", "error", "spilled")
+
+    def __init__(self, tenant_id: Hashable) -> None:
+        self.tenant_id = tenant_id
+        self.shape_key: Optional[str] = None
+        self.slot: Optional[int] = None  # row in the shape-class stack; None = not resident
+        self.update_count = 0
+        self.last_touch = 0
+        self.pending = 0  # queued batches not yet dispatched
+        self.quarantined = False
+        self.error: Optional[str] = None
+        # host copy of the state rows while evicted: {"state": {name: np}, "count": float}
+        self.spilled: Optional[Dict[str, Any]] = None
+
+    @property
+    def resident(self) -> bool:
+        return self.slot is not None
+
+
+class _ShapeClass:
+    """One stacked pytree + its traffic queue: all tenants whose batches share
+    a shape/dtype signature."""
+
+    __slots__ = ("key", "stacked", "free", "slot_tenant", "queue", "pad_example", "dispatches")
+
+    def __init__(self, key: str, stacked: StateDict, capacity: int, pad_example: Tuple[tuple, dict]) -> None:
+        self.key = key
+        self.stacked = stacked  # tensor states + TENANT_COUNT_KEY, leaves (capacity+1, ...)
+        self.free: List[int] = list(range(capacity))  # row `capacity` is the scratch slot
+        self.slot_tenant: Dict[int, Hashable] = {}
+        self.queue: deque = deque()  # (tenant_id, args, kwargs) in arrival order
+        self.pad_example = pad_example  # zero batch used for megabatch padding
+        self.dispatches = 0
+
+
+class ServingEngine:
+    """Sessionized multi-tenant metric serving over one metric template.
+
+    Example (conceptual)::
+
+        engine = ServingEngine(MulticlassAccuracy(num_classes=10, validate_args=False),
+                               ServingConfig(capacity=8192, megabatch_size=256))
+        engine.update("user-1", preds, target)     # buffered, auto-dispatched
+        engine.flush()                             # drain partial megabatches
+        engine.compute("user-1")                   # slice one tenant's value
+        engine.state_dict("user-1")                # per-tenant checkpoint
+
+    The template metric must hold only static-shape tensor states (no concat
+    lists) with its jitted dispatch path enabled; the engine works on a
+    private clone, so the caller's object is never touched.
+    """
+
+    def __init__(self, template: Metric, config: Optional[ServingConfig] = None) -> None:
+        if not isinstance(template, Metric):
+            raise TorchMetricsUserError(f"ServingEngine needs a Metric template, got {type(template).__name__}")
+        self.config = config or ServingConfig()
+        if template._list_state_names:
+            raise TorchMetricsUserError(
+                f"{type(template).__name__} holds dynamic-length concat states and cannot be "
+                "served from a stacked pytree; use a binned/static-shape variant."
+            )
+        if not template._enable_jit:
+            raise TorchMetricsUserError("ServingEngine requires a jit-enabled metric template (jit=True).")
+        # private clone: the engine's dispatches must not disturb the caller's
+        # object, and per-metric reliability retry is incompatible with the
+        # stacked dispatch (its exhausted-budget restore writes into
+        # `_state`) — fault tolerance is engine-level (on_error="quarantine")
+        self._metric = template.clone()
+        self._metric._reliability = None
+        self._metric._fault_hook = None
+        self._defaults_t, _ = self._metric._split_tensor_list(self._metric.init_state())
+        self._classes: Dict[str, _ShapeClass] = {}
+        self._tenants: Dict[Hashable, _Tenant] = {}
+        self._touch = itertools.count(1)
+        # (treedef, leaf-metadata) → shape-class key. The full signature string
+        # costs ~30µs to build; at fleet ingest rates that is the hot path, so
+        # repeat shapes resolve through this exact-metadata memo instead.
+        self._sig_cache: Dict[Any, str] = {}
+        #: engine-fault injection seam (tests): called with the megabatch's
+        #: tenant ids right before each dispatch; raising fails the dispatch
+        self._fault_hook: Optional[Callable[[List[Hashable]], None]] = None
+        self.stats: Dict[str, int] = {
+            "dispatches": 0, "tenant_rows": 0, "padded_rows": 0, "flushes": 0,
+            "spills": 0, "readmissions": 0, "spill_ns": 0, "quarantined": 0,
+            "dropped_batches": 0,
+        }
+        if self.config.aot_cache_dir is not None:
+            # the self-warming boot path: every fresh megabatch compile writes
+            # through, so the next boot of this server loads instead
+            _aot.enable(config=_aot.AotConfig(
+                cache_dir=self.config.aot_cache_dir,
+                write_on_miss=self.config.write_on_miss,
+            ))
+
+    # ------------------------------------------------------------- shape-classes
+
+    @staticmethod
+    def _shape_key(args: tuple, kwargs: dict) -> str:
+        sig, tree = _aot_keys.dispatch_signature_parts((args, kwargs))
+        return f"{sig}#{tree}"
+
+    def _shape_key_cached(self, args: tuple, kwargs: dict) -> str:
+        """Shape-class key with an exact-metadata fast path: the memo key is
+        the pytree structure plus every leaf's (shape, dtype, weak) — the
+        same facts the signature string encodes, compared without string
+        building. A never-seen combination falls through to the full key."""
+        leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+        meta = tuple(
+            (np.shape(leaf), getattr(leaf, "dtype", None) or type(leaf),
+             bool(getattr(leaf, "weak_type", False)))
+            for leaf in leaves
+        )
+        ck = (treedef, meta)
+        key = self._sig_cache.get(ck)
+        if key is None:
+            key = self._shape_key(args, kwargs)
+            self._sig_cache[ck] = key
+        return key
+
+    def _ensure_class(self, key: str, args: tuple, kwargs: dict) -> _ShapeClass:
+        cls = self._classes.get(key)
+        if cls is not None:
+            return cls
+        rows = self.config.capacity + 1  # + the scratch row padding scatters into
+        stacked: StateDict = {
+            name: jnp.repeat(jnp.asarray(leaf)[None], rows, axis=0)
+            for name, leaf in self._defaults_t.items()
+        }
+        stacked[TENANT_COUNT_KEY] = jnp.zeros((rows,), jnp.float32)
+        if self.config.sharding is not None:
+            stacked = jax.device_put(stacked, self.config.sharding)
+        # zero pytree with the class's exact leaf shapes/dtypes — the values
+        # never reach a real tenant (pad rows scatter into the scratch slot)
+        pad = jax.tree.map(lambda leaf: np.zeros(np.shape(leaf), _np_dtype(leaf)), (args, kwargs))
+        cls = _ShapeClass(key, stacked, self.config.capacity, pad)
+        self._classes[key] = cls
+        return cls
+
+    # ------------------------------------------------------------------ tenants
+
+    def _tenant(self, tenant_id: Hashable) -> _Tenant:
+        t = self._tenants.get(tenant_id)
+        if t is None:
+            t = _Tenant(tenant_id)
+            self._tenants[tenant_id] = t
+        return t
+
+    def _admit(self, t: _Tenant, cls: _ShapeClass, pinned: frozenset = frozenset()) -> None:
+        """Give ``t`` a stack slot, evicting the LRU resident if needed, and
+        upload its spilled state (readmission) or a fresh default row.
+        ``pinned`` tenants (the megabatch currently being seated) are never
+        eviction candidates — seating a late member must not unseat an early
+        one mid-dispatch."""
+        if t.resident:
+            return
+        if not cls.free:
+            self._evict_lru(cls, pinned)
+        slot = cls.free.pop()
+        cls.slot_tenant[slot] = t.tenant_id
+        t.slot = slot
+        if t.spilled is not None:
+            t0 = time.perf_counter()
+            host = t.spilled
+            for name, value in host["state"].items():
+                cls.stacked[name] = cls.stacked[name].at[slot].set(jnp.asarray(value))
+            cls.stacked[TENANT_COUNT_KEY] = cls.stacked[TENANT_COUNT_KEY].at[slot].set(
+                jnp.float32(host["count"])
+            )
+            dur = time.perf_counter() - t0
+            t.spilled = None
+            self.stats["readmissions"] += 1
+            self.stats["spill_ns"] += int(dur * 1e9)
+            rec = _observability._ACTIVE
+            if rec is not None:
+                rec.record_tenant_spill(self._metric, dur, _state_bytes(host["state"]), readmit=True)
+        else:
+            # the slot may hold a previously evicted tenant's stale rows
+            for name, leaf in self._defaults_t.items():
+                cls.stacked[name] = cls.stacked[name].at[slot].set(jnp.asarray(leaf))
+            cls.stacked[TENANT_COUNT_KEY] = cls.stacked[TENANT_COUNT_KEY].at[slot].set(0.0)
+
+    def _evict_lru(self, cls: _ShapeClass, pinned: frozenset = frozenset()) -> None:
+        if not self.config.spill:
+            raise TorchMetricsUserError(
+                f"shape-class stack is full ({self.config.capacity} resident tenants) and "
+                "spill is disabled — raise ServingConfig.capacity or enable spill."
+            )
+        # least-recently-touched unpinned resident; tenants with queued
+        # traffic are evicted only when every candidate has traffic pending
+        # (they would readmit within the same flush — correct, just slower)
+        candidates = [
+            self._tenants[tid] for tid in cls.slot_tenant.values() if tid not in pinned
+        ]
+        if not candidates:  # unreachable: megabatch_size <= capacity by config
+            raise TorchMetricsUserError(
+                "every resident tenant is part of the megabatch being seated — "
+                "megabatch_size must not exceed capacity"
+            )
+        victim = min(candidates, key=lambda t: (t.pending > 0, t.last_touch))
+        self._spill(victim, cls)
+
+    def _spill(self, t: _Tenant, cls: _ShapeClass) -> None:
+        """Move one resident tenant's state rows to host memory (LRU spill).
+
+        The row reads are a deliberate device→host transfer — counted like
+        ``state_dict``'s — but all byte accounting is metadata-only
+        (shape × itemsize), never an extra device read."""
+        assert t.slot is not None
+        t0 = time.perf_counter()
+        state = {name: np.asarray(cls.stacked[name][t.slot]) for name in self._defaults_t}
+        count = float(np.asarray(cls.stacked[TENANT_COUNT_KEY][t.slot]))
+        dur = time.perf_counter() - t0
+        t.spilled = {"state": state, "count": count}
+        cls.slot_tenant.pop(t.slot, None)
+        cls.free.append(t.slot)
+        t.slot = None
+        self.stats["spills"] += 1
+        self.stats["spill_ns"] += int(dur * 1e9)
+        rec = _observability._ACTIVE
+        if rec is not None:
+            nbytes = _state_bytes(state)
+            rec.record_tenant_spill(self._metric, dur, nbytes)
+            rec.record_d2h("tenant_spill", nbytes, metric=self._metric)
+
+    # ------------------------------------------------------------------ ingest
+
+    def update(self, tenant_id: Hashable, *args: Any, **kwargs: Any) -> None:
+        """Route one ``(tenant_id, batch)`` into its shape-class megabatch
+        queue (dispatched when a full megabatch accumulates, at
+        :meth:`flush`, or before any per-tenant read)."""
+        t = self._tenant(tenant_id)
+        if t.quarantined:
+            raise TorchMetricsUserError(
+                f"tenant {tenant_id!r} is quarantined (last error: {t.error}); reset() lifts it."
+            )
+        args, kwargs = self._metric._prepare_inputs(*args, **kwargs)
+        key = self._shape_key_cached(args, kwargs)
+        if t.shape_key is None:
+            t.shape_key = key
+        elif t.shape_key != key:
+            raise TorchMetricsUserError(
+                f"tenant {tenant_id!r} sent a batch of shape-class {key} but its state lives "
+                f"in shape-class {t.shape_key}; per-tenant traffic must keep a stable "
+                "batch shape/dtype (pad or bucket inputs)."
+            )
+        cls = self._ensure_class(key, args, kwargs)
+        self._admit(t, cls)
+        cls.queue.append((tenant_id, args, kwargs))
+        t.pending += 1
+        t.last_touch = next(self._touch)
+        if self.config.auto_flush and len(cls.queue) >= self.config.megabatch_size:
+            self._dispatch_chunk(cls)
+
+    def flush(self) -> int:
+        """Dispatch every pending megabatch (partial ones padded with scratch
+        rows). Returns the number of tenant batches served."""
+        served = 0
+        self.stats["flushes"] += 1
+        for cls in self._classes.values():
+            while cls.queue:
+                served += self._dispatch_chunk(cls)
+        return served
+
+    # ---------------------------------------------------------------- dispatch
+
+    def _dispatch_chunk(self, cls: _ShapeClass) -> int:
+        """Pull up to ``megabatch_size`` DISTINCT tenants' batches off the
+        queue and serve them with one vmapped dispatch. A tenant with several
+        queued batches contributes one per chunk (the per-row fold is one
+        batch deep); the rest go back to the queue front in order."""
+        entries: List[Tuple[Hashable, tuple, dict]] = []
+        seen: set = set()
+        holdback: List[Tuple[Hashable, tuple, dict]] = []
+        while cls.queue and len(entries) < self.config.megabatch_size:
+            tid, args, kwargs = cls.queue.popleft()
+            t = self._tenants[tid]
+            if t.quarantined:
+                t.pending -= 1
+                self.stats["dropped_batches"] += 1
+                continue
+            if tid in seen:
+                holdback.append((tid, args, kwargs))
+                continue
+            seen.add(tid)
+            entries.append((tid, args, kwargs))
+        cls.queue.extendleft(reversed(holdback))
+        if not entries:
+            return 0
+        if self.config.on_error == "raise":
+            self._dispatch_rows(cls, entries)
+            return len(entries)
+        # quarantine mode: back up, roll back on failure, isolate per tenant
+        backup = {k: jnp.copy(v) for k, v in cls.stacked.items()}
+        try:
+            self._dispatch_rows(cls, entries)
+            return len(entries)
+        except Exception:
+            cls.stacked = backup
+        served = 0
+        for entry in entries:
+            single_backup = {k: jnp.copy(v) for k, v in cls.stacked.items()}
+            try:
+                self._dispatch_rows(cls, [entry])
+                served += 1
+            except Exception as err:  # noqa: BLE001 — quarantine, never poison the stack
+                cls.stacked = single_backup
+                self._quarantine(entry[0], err)
+        return served
+
+    def _dispatch_rows(self, cls: _ShapeClass, entries: List[Tuple[Hashable, tuple, dict]]) -> None:
+        """One megabatch dispatch: stack entries + pad to the fixed size,
+        donate the stack through ``_donation_safe_dispatch`` (telemetry + AOT
+        planes apply), commit the new stack and the host bookkeeping."""
+        m = self.config.megabatch_size
+        real = len(entries)
+        scratch = self.config.capacity  # the reserved pad row
+        # seat every member first, pinned against each other: admitting a late
+        # member must never evict an earlier one out of this very megabatch
+        # (possible when capacity-many chunk members have the oldest touches)
+        pinned = frozenset(tid for tid, _, _ in entries)
+        for tid, _, _ in entries:
+            t = self._tenants[tid]
+            if not t.resident:
+                self._admit(t, cls, pinned)
+        idx = np.full((m,), scratch, np.int32)
+        batches = []
+        for i, (tid, args, kwargs) in enumerate(entries):
+            idx[i] = self._tenants[tid].slot
+            batches.append((args, kwargs))
+        batches.extend([cls.pad_example] * (m - real))
+        mb_args, mb_kwargs = jax.tree.map(_stack_leaves, *batches)
+        idx_dev = jnp.asarray(idx)
+        if self._fault_hook is not None:
+            self._fault_hook([tid for tid, _, _ in entries])
+        fn = self._metric._get_vupdate_fn()
+        inputs = ((idx_dev, mb_args, mb_kwargs), {})
+        new_stacked = self._metric._donation_safe_dispatch(
+            "vupdate",
+            lambda t, n: fn(t, n, idx_dev, mb_args, mb_kwargs),
+            cls.stacked,
+            inputs=inputs,
+            jitted=fn,
+        )
+        cls.stacked = new_stacked
+        cls.dispatches += 1
+        self.stats["dispatches"] += 1
+        self.stats["tenant_rows"] += real
+        self.stats["padded_rows"] += m - real
+        for tid, _, _ in entries:
+            t = self._tenants[tid]
+            t.update_count += 1
+            t.pending -= 1
+        rec = _observability._ACTIVE
+        if rec is not None:
+            rec.record_serve_dispatch(self._metric, real, m - real)
+
+    def _quarantine(self, tenant_id: Hashable, exc: BaseException) -> None:
+        t = self._tenants[tenant_id]
+        t.quarantined = True
+        t.error = f"{type(exc).__name__}: {exc}"[:240]
+        # drop the tenant's remaining queued batches everywhere
+        if t.shape_key is not None and t.shape_key in self._classes:
+            cls = self._classes[t.shape_key]
+            kept = [e for e in cls.queue if e[0] != tenant_id]
+            self.stats["dropped_batches"] += len(cls.queue) - len(kept)
+            cls.queue = deque(kept)
+        t.pending = 0
+        self.stats["quarantined"] += 1
+        rec = _observability._ACTIVE
+        if rec is not None:
+            rec.record_quarantine(repr(tenant_id), "vupdate", "quarantined", exc, t.update_count)
+
+    # ---------------------------------------------------------------- reads
+
+    def _tenant_state(self, t: _Tenant) -> StateDict:
+        """One tenant's state dict — a stack slice when resident, the host
+        copy when spilled (no readmission: reads never churn the LRU)."""
+        if t.spilled is not None:
+            return {k: jnp.asarray(v) for k, v in t.spilled["state"].items()}
+        if t.slot is None:
+            return {k: jnp.asarray(v) for k, v in self._defaults_t.items()}
+        cls = self._classes[t.shape_key]
+        return {name: cls.stacked[name][t.slot] for name in self._defaults_t}
+
+    def compute(self, tenant_id: Hashable) -> Any:
+        """One tenant's metric value, by slicing its rows out of the stack
+        (pending traffic is flushed first so the value is current)."""
+        t = self._require(tenant_id)
+        if t.quarantined:
+            raise TorchMetricsUserError(
+                f"tenant {tenant_id!r} is quarantined (last error: {t.error}); reset() lifts it."
+            )
+        if t.pending:
+            self.flush()
+        return self._metric._compute(self._tenant_state(t))
+
+    def compute_all(self) -> Dict[Hashable, Any]:
+        """Every non-quarantined tenant's value (flushes pending traffic once)."""
+        self.flush()
+        return {
+            tid: self._metric._compute(self._tenant_state(t))
+            for tid, t in self._tenants.items()
+            if not t.quarantined
+        }
+
+    def update_count(self, tenant_id: Hashable) -> int:
+        return self._require(tenant_id).update_count
+
+    def tenants(self) -> Dict[Hashable, Dict[str, Any]]:
+        """Fleet roster: per-tenant residency/quarantine/update status."""
+        return {
+            tid: {
+                "resident": t.resident, "spilled": t.spilled is not None,
+                "quarantined": t.quarantined, "update_count": t.update_count,
+                "pending": t.pending, "shape_class": t.shape_key,
+            }
+            for tid, t in self._tenants.items()
+        }
+
+    def _require(self, tenant_id: Hashable) -> _Tenant:
+        t = self._tenants.get(tenant_id)
+        if t is None:
+            raise KeyError(f"unknown tenant {tenant_id!r}")
+        return t
+
+    # ------------------------------------------------------------- lifecycle
+
+    def reset(self, tenant_id: Hashable) -> None:
+        """Restore one tenant to default state (lifts quarantine, drops its
+        queued traffic, keeps its slot)."""
+        t = self._require(tenant_id)
+        if t.shape_key is not None and t.shape_key in self._classes:
+            cls = self._classes[t.shape_key]
+            kept = [e for e in cls.queue if e[0] != tenant_id]
+            self.stats["dropped_batches"] += len(cls.queue) - len(kept)
+            cls.queue = deque(kept)
+            if t.slot is not None:
+                for name, leaf in self._defaults_t.items():
+                    cls.stacked[name] = cls.stacked[name].at[t.slot].set(jnp.asarray(leaf))
+                cls.stacked[TENANT_COUNT_KEY] = cls.stacked[TENANT_COUNT_KEY].at[t.slot].set(0.0)
+        t.spilled = None
+        t.pending = 0
+        t.update_count = 0
+        t.quarantined = False
+        t.error = None
+
+    def evict(self, tenant_id: Hashable) -> None:
+        """Force-spill one resident tenant's state to host (admin path)."""
+        t = self._require(tenant_id)
+        if t.resident and t.shape_key is not None:
+            self._spill(t, self._classes[t.shape_key])
+
+    def state_dict(self, tenant_id: Hashable) -> Dict[str, Any]:
+        """One tenant's checkpoint, shaped exactly like ``Metric.state_dict``
+        output so it loads into a standalone metric (and back via
+        :meth:`load_state_dict`). Pending traffic is flushed first."""
+        t = self._require(tenant_id)
+        if t.pending:
+            self.flush()
+        state = self._tenant_state(t)
+        out: Dict[str, Any] = {name: np.asarray(v) for name, v in state.items()}
+        out["_update_count"] = int(t.update_count)
+        out["_saved_states"] = len(out) - 1
+        return out
+
+    def load_state_dict(self, tenant_id: Hashable, state_dict: Dict[str, Any]) -> None:
+        """Restore one tenant from a checkpoint (its own or a standalone
+        ``Metric.state_dict``). The state parks as a host-side (spilled)
+        tenant and uploads into a stack slot on its next traffic."""
+        t = self._tenant(tenant_id)
+        if t.pending:
+            raise TorchMetricsUserError(
+                f"tenant {tenant_id!r} has {t.pending} undispatched batches; flush() before restoring."
+            )
+        unknown = [k for k in state_dict if k not in self._defaults_t and not k.startswith("_")]
+        if unknown:
+            raise TorchMetricsUserError(f"checkpoint carries unknown state keys {sorted(unknown)}")
+        missing = [k for k in self._defaults_t if k not in state_dict]
+        if missing:
+            raise TorchMetricsUserError(f"checkpoint is missing state keys {sorted(missing)}")
+        if t.resident and t.shape_key is not None:
+            cls = self._classes[t.shape_key]
+            cls.slot_tenant.pop(t.slot, None)
+            cls.free.append(t.slot)
+            t.slot = None
+        t.update_count = int(state_dict.get("_update_count", 1))
+        t.spilled = {
+            "state": {k: np.asarray(state_dict[k]) for k in self._defaults_t},
+            "count": float(t.update_count),
+        }
+        t.quarantined = False
+        t.error = None
+
+    # ------------------------------------------------------------ warm start
+
+    def _megabatch_sds(
+        self, example_inputs: tuple, example_kwargs: dict
+    ) -> Tuple[str, _ShapeClass, tuple]:
+        """Shape-class key, its (created) stack, and the megabatch-shaped
+        ``(idx, args, kwargs)`` avals for one example batch — EXACTLY the
+        calling convention ``_dispatch_rows`` dispatches, so warm-start keys
+        match what real traffic will look up."""
+        args, kwargs = self._metric._prepare_inputs(*example_inputs, **example_kwargs)
+        key = self._shape_key(args, kwargs)
+        cls = self._ensure_class(key, args, kwargs)
+        m = self.config.megabatch_size
+        idx = jax.ShapeDtypeStruct((m,), jnp.int32)
+        stack_sds = lambda leaf: jax.ShapeDtypeStruct((m,) + tuple(np.shape(leaf)), _np_dtype(leaf))
+        mb_args, mb_kwargs = jax.tree.map(stack_sds, (args, kwargs))
+        return key, cls, (idx, mb_args, mb_kwargs)
+
+    def precompile(self, *example_inputs: Any, force: bool = False, **example_kwargs: Any) -> Dict[str, Any]:
+        """Compile (or confirm cached) the megabatch program for the example
+        batch's shape-class ahead of traffic and publish it into the active
+        AOT cache — the deploy-time half of the self-warming boot story."""
+        plane = _aot._ACTIVE
+        if plane is None:
+            raise TorchMetricsUserError(
+                "precompile needs an active AOT plane — pass ServingConfig(aot_cache_dir=...) "
+                "or call torchmetrics_tpu.aot.enable(cache_dir) first."
+            )
+        key, cls, mb = self._megabatch_sds(example_inputs, example_kwargs)
+        fn, donate = self._metric._aot_program("vupdate")
+        row = plane.precompile_program(
+            self._metric, "vupdate", fn, donate, cls.stacked, mb, {}, force=force,
+        )
+        return {key: row}
+
+    def prefetch(self, *example_inputs: Any, **example_kwargs: Any) -> Dict[str, Any]:
+        """Load the example shape-class's cached megabatch executable into the
+        dispatch memo without compiling on a miss (boot-time warm read)."""
+        plane = _aot._ACTIVE
+        if plane is None:
+            raise TorchMetricsUserError("prefetch needs an active AOT plane.")
+        key, cls, mb = self._megabatch_sds(example_inputs, example_kwargs)
+        self._metric._get_vupdate_fn()
+        slot = plane.lookup_dispatch(self._metric, "vupdate", cls.stacked, (mb, {}))
+        if slot is not None and slot.compiled is not None:
+            return {key: {"status": "loaded", "codec": slot.codec, "load_s": round(slot.load_s, 6)}}
+        return {key: {"status": "miss"}}
+
+    # ----------------------------------------------------------- observability
+
+    def memory(self) -> Dict[str, Any]:
+        """Resident (stacked, device) vs spilled (host) state footprint —
+        metadata only (shape × itemsize), zero device reads."""
+        from ..observability import memory as _memory
+
+        classes = {}
+        resident = 0
+        for key, cls in self._classes.items():
+            report = _memory.state_memory(cls.stacked)
+            classes[key] = {
+                "rows": self.config.capacity + 1,
+                "resident_tenants": len(cls.slot_tenant),
+                "total_bytes": report["total_bytes"],
+            }
+            resident += report["total_bytes"]
+        spilled = sum(
+            _state_bytes(t.spilled["state"]) for t in self._tenants.values() if t.spilled is not None
+        )
+        return {
+            "classes": classes,
+            "resident_bytes": resident,
+            "spilled_tenants": sum(1 for t in self._tenants.values() if t.spilled is not None),
+            "spilled_host_bytes": spilled,
+        }
+
+    def summary(self) -> Dict[str, Any]:
+        """Engine-side stats (independent of any telemetry session)."""
+        s = dict(self.stats)
+        s["tenants"] = len(self._tenants)
+        s["shape_classes"] = len(self._classes)
+        s["tenants_per_dispatch"] = (
+            round(s["tenant_rows"] / s["dispatches"], 3) if s["dispatches"] else 0.0
+        )
+        s["tenant_spill_us"] = s.pop("spill_ns") // 1000
+        return s
+
+    def block_until_ready(self) -> None:
+        """Wait for every stack's pending device work (bench/test timing aid)."""
+        for cls in self._classes.values():
+            jax.block_until_ready(cls.stacked)
+
+
+def _stack_leaves(*leaves: Any) -> jax.Array:
+    """Stack one megabatch leaf across its M entries, cheaply.
+
+    ``jnp.stack`` pays one eager ``expand_dims`` per entry and ``jnp.asarray``
+    pays a dtype-lattice walk per entry — hundreds of tiny host dispatches per
+    megabatch, which at fleet ingest rates dominates the dispatch itself. Host
+    inputs stack in numpy and upload once; device inputs (guaranteed
+    shape/dtype-identical by the shape-class) ride a single raw
+    ``lax.concatenate`` + reshape pair."""
+    if not isinstance(leaves[0], jax.Array):
+        if all(isinstance(leaf, np.ndarray) or np.isscalar(leaf) for leaf in leaves):
+            return jnp.asarray(np.stack(leaves, axis=0))
+    arrs = [leaf if isinstance(leaf, jax.Array) else jnp.asarray(leaf) for leaf in leaves]
+    if arrs[0].ndim == 0:
+        return jnp.stack(arrs)
+    shape = arrs[0].shape
+    return jax.lax.concatenate(arrs, 0).reshape((len(arrs),) + tuple(shape))
+
+
+def _np_dtype(leaf: Any) -> Any:
+    dt = getattr(leaf, "dtype", None)
+    if dt is not None:
+        return dt
+    return np.asarray(leaf).dtype
+
+
+def _state_bytes(state: Dict[str, Any]) -> int:
+    return int(sum(np.asarray(v).size * np.asarray(v).dtype.itemsize for v in state.values()))
