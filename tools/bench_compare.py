@@ -99,13 +99,30 @@ THRESHOLDS: Dict[str, float] = {
     "extra.multi_tenant_serving.vs_naive_speedup_1k": 0.4,
     "extra.multi_tenant_serving.tenant_spill_us": 0.6,
     "extra.multi_tenant_serving.vupdate_fresh_compiles": 0.25,
+    # streaming plane: throughputs wobble like the flagship; the overlap
+    # fraction depends on sleep-simulated collective latency vs real update
+    # cost, so gate only an order-of-magnitude collapse (overlap going to ~0
+    # means the async plane silently serialized). wupdate_fresh_compiles is
+    # deterministically 1 like vupdate's proof; async_state_parity is exactly
+    # 1.0 — any drop (parity broken) gates immediately.
+    "extra.streaming_window.plain_updates_per_sec": 0.4,
+    "extra.streaming_window.windowed_updates_per_sec": 0.4,
+    "extra.streaming_window.decayed_updates_per_sec": 0.4,
+    "extra.streaming_window.async_sync_overlap_pct": 0.5,
+    "extra.streaming_window.blocking_sync_ms": 0.6,
+    "extra.streaming_window.wupdate_fresh_compiles": 0.25,
+    "extra.streaming_window.async_state_parity": 0.01,
 }
 
 _HIGHER_MARKERS = ("per_sec", "speedup", "throughput")
 # tenants_per_dispatch: rows amortized per serving dispatch — more per
 # dispatch is the whole point of the megabatch plane, and the name carries no
-# throughput marker
-_HIGHER_EXACT = ("value", "vs_baseline", "tenants_per_dispatch")
+# throughput marker. async_sync_overlap_pct: the fraction of sync latency the
+# double-buffered plane hides — more hidden is the whole point.
+# async_state_parity: exactly 1.0 when async == blocking bitwise; any drop is
+# a correctness regression, not noise.
+_HIGHER_EXACT = ("value", "vs_baseline", "tenants_per_dispatch",
+                 "async_sync_overlap_pct", "async_state_parity")
 _LOWER_MARKERS = ("latency", "compile", "_sec", "_ms", "_us", "_bytes", "bytes_", "time")
 # collective counts per sync: fewer is the whole point of the coalesced plane —
 # a move back toward per-leaf collectives must gate even though the name
@@ -117,7 +134,12 @@ _LOWER_EXACT = ("collectives_per_sync",)
 # baseline's one-shot boot cost / churn-move count (baseline properties, not
 # engine perf)
 _INFO_EXACT = ("leaves_coalesced_per_sync", "per_leaf_collectives", "ttfu_precompiled_programs",
-               "naive_boot_ms_per_tenant", "spill_moves")
+               "naive_boot_ms_per_tenant", "spill_moves",
+               # streaming config: the overhead ratio and the tiny commit-wait/
+               # gather latencies are quotients of two noisy measurements —
+               # the throughput and overlap columns gate the same regressions
+               "window_overhead_pct", "async_commit_wait_ms", "async_gather_ms",
+               "async_overlap_updates", "window_rolls")
 
 
 def direction(name: str) -> Optional[str]:

@@ -100,7 +100,10 @@ def aggregate(events: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
         "d2h_readbacks": 0, "d2h_bytes": 0,
         "sync_calls": 0, "sync_payload_bytes": 0,
         "sync_collectives": 0, "leaves_coalesced": 0,
+        "window_wraps": 0, "async_syncs": 0, "serve_rejected": 0,
     }
+    # async double-buffered syncs: gather wall vs commit wait, per event
+    async_stats = {"gather_s": 0.0, "wait_s": 0.0, "overlap_pct_sum": 0.0, "fallbacks": 0}
     retries: List[Dict[str, Any]] = []
     quarantines: List[Dict[str, Any]] = []
     row_hists: Dict[Tuple[Any, str, str], Dict[str, Any]] = {}  # joins report rows
@@ -144,6 +147,19 @@ def aggregate(events: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
         elif kind == "d2h":
             totals["d2h_readbacks"] += 1
             totals["d2h_bytes"] += int(ev.get("payload", {}).get("nbytes", 0))
+        elif kind == "window_roll":
+            # one event per COMPLETED window wrap (per-roll latency rides the
+            # wupdate dispatch rows; the window_rolls counter ticks per roll)
+            totals["window_wraps"] += 1
+        elif kind == "serve_rejected":
+            totals["serve_rejected"] += 1
+        elif kind == "async_sync":
+            totals["async_syncs"] += 1
+            payload = ev.get("payload", {})
+            async_stats["gather_s"] += float(ev.get("duration_s") or 0.0)
+            async_stats["wait_s"] += float(payload.get("wait_s", 0.0))
+            async_stats["overlap_pct_sum"] += float(payload.get("overlap_pct", 0.0))
+            async_stats["fallbacks"] += 1 if payload.get("fallback") else 0
         elif kind == "hist":
             # a session-close histogram snapshot: metric=key, tag=histogram
             # kind; latency kinds join the matching report row, every kind
@@ -196,9 +212,21 @@ def aggregate(events: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
             ("p50_bytes" if div == 1.0 else "p50_ms"): round(p50 / div, 3) if p50 is not None else None,
             ("p99_bytes" if div == 1.0 else "p99_ms"): round(p99 / div, 3) if p99 is not None else None,
         }
+    streaming = None
+    if totals["async_syncs"] or totals["window_wraps"] or totals["serve_rejected"]:
+        n = totals["async_syncs"]
+        streaming = {
+            "window_wraps": totals["window_wraps"],
+            "async_syncs": n,
+            "serve_rejected": totals["serve_rejected"],
+            "async_gather_ms": round(async_stats["gather_s"] * 1000.0, 3),
+            "async_wait_ms": round(async_stats["wait_s"] * 1000.0, 3),
+            "mean_overlap_pct": round(async_stats["overlap_pct_sum"] / n, 2) if n else None,
+            "async_fallbacks": async_stats["fallbacks"],
+        }
     return {
         "rows": report_rows, "totals": totals, "retries": retries, "quarantines": quarantines,
-        "latency": latency, "multi_rank": any_rank,
+        "latency": latency, "multi_rank": any_rank, "streaming": streaming,
     }
 
 
@@ -228,6 +256,22 @@ def render_table(report: Dict[str, Any]) -> str:
         f"{t['sync_collectives']} collectives = {per_sync}/sync, "
         f"{t['leaves_coalesced']} leaves coalesced)"
     )
+    if report.get("streaming"):
+        s = report["streaming"]
+        line = (
+            f"streaming: {s['window_wraps']} window wraps  "
+            f"{s['async_syncs']} async syncs"
+        )
+        if s["async_syncs"]:
+            line += (
+                f" (gather {s['async_gather_ms']}ms, commit wait {s['async_wait_ms']}ms, "
+                f"mean overlap {s['mean_overlap_pct']}%"
+                + (f", {s['async_fallbacks']} per-leaf fallback(s)" if s["async_fallbacks"] else "")
+                + ")"
+            )
+        if s["serve_rejected"]:
+            line += f"  admission-rejected batches: {s['serve_rejected']}"
+        lines.append(line)
     if report.get("latency"):
         parts = []
         for kind, block in report["latency"].items():

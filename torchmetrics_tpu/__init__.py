@@ -9,7 +9,7 @@ Every domain package declares its public classes in its own ``__all__``; the fla
 namespace aggregates them (reference ``__init__.py`` re-exports ~100 names the same
 way, hand-listed)."""
 
-from torchmetrics_tpu import audio, classification, clustering, detection, functional, image, multimodal, nominal, observability, parallel, regression, reliability, retrieval, segmentation, serving, shape, text, utilities, video, wrappers
+from torchmetrics_tpu import audio, classification, clustering, detection, functional, image, multimodal, nominal, observability, parallel, regression, reliability, retrieval, segmentation, serving, shape, streaming, text, utilities, video, wrappers
 from torchmetrics_tpu.aggregation import (
     CatMetric,
     MaxMetric,
@@ -81,6 +81,7 @@ __all__ = [
     "regression",
     "retrieval",
     "serving",
+    "streaming",
     "audio",
     "clustering",
     "detection",

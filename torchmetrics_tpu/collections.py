@@ -707,18 +707,31 @@ class MetricCollection:
         for name, metric in self._modules.items():
             metric.load_state_dict(state_dict, prefix=f"{name}.", validate=validate)
 
-    def sync(self, **kwargs: Any) -> None:
+    def sync(self, async_: bool = False, **kwargs: Any) -> Any:
         """Cross-process sync of every member. Fast path: ALL members' states
         coalesce into one bucketed collective set (K·L per-leaf collectives →
         1 metadata gather + one padded gather per dtype); fused compute-group
         members share one state dict and are gathered/charged exactly once,
         re-aliasing on commit. Falls back to per-member ``Metric.sync`` when
         members disagree on gather seams (mixed ``dist_sync_fn``/
-        ``process_group``/availability)."""
+        ``process_group``/availability).
+
+        ``async_=True`` returns an
+        :class:`~torchmetrics_tpu.parallel.AsyncSyncHandle` instead of
+        blocking: the bucketed gather of the CURRENT states launches in the
+        background while the collection keeps updating (the next window);
+        ``handle.commit()`` barriers, validates, and atomically swaps every
+        member to the synced previous-window state — the live (since-updated)
+        state parks in the sync cache and ``unsync()`` restores it, so the
+        overlap loses nothing. A failed gather commits NOTHING (members keep
+        their last good state). See ``docs/streaming.md``."""
+        if async_:
+            return self._async_sync(**kwargs)
         if self._coalesced_sync(list(self._modules.values()), **kwargs):
-            return
+            return None
         for metric in self._modules.values():
             metric.sync(**kwargs)
+        return None
 
     def _coalesced_sync(
         self,
@@ -834,6 +847,118 @@ class MetricCollection:
                 coalesced_leaves=rec.counters.value("gathers_coalesced") - coal0,
             )
         return True
+
+    def _async_sync(
+        self,
+        dist_sync_fn: Optional[Any] = None,
+        process_group: Optional[Any] = None,
+        should_sync: bool = True,
+        distributed_available: Optional[Any] = None,
+        rebuffer: bool = True,
+    ) -> "Any":
+        """Launch the double-buffered background sync (``sync(async_=True)``).
+
+        Freeze is a SHALLOW snapshot of each distinct state dict — jax arrays
+        are immutable, so freezing copies nothing. The hazard is donation:
+        the members' jitted updates donate (delete) their live buffers, so
+        under ``rebuffer=True`` (default) the LIVE dict entries are replaced
+        with value copies (metric states are bytes-to-KBs) and the in-flight
+        gather owns the frozen buffers exclusively. A caller that rotates the
+        window itself (``reset()`` right after launch replaces the live
+        entries wholesale) can pass ``rebuffer=False`` for a fully zero-copy
+        freeze. Unlike the blocking path there is no per-member fallback —
+        mixed gather seams or custom ``sync`` overrides raise, because a
+        background per-member sync could not preserve per-member semantics.
+
+        Commit protocol (``AsyncSyncHandle.commit``): barrier → validate
+        every member's synced state (nothing installs on a corrupt
+        contribution or a failed gather — members keep their last good
+        state) → atomically swap: each member's live (possibly since-updated)
+        state becomes its sync cache, the synced previous-window state
+        becomes ``_state``, ``_is_synced`` flips; ``unsync()`` restores the
+        live state. Fused compute groups keep aliasing through the swap.
+        """
+        from .parallel.async_sync import AsyncSyncHandle
+
+        metrics = list(self._modules.values())
+        if any(m._is_synced for m in metrics):
+            raise TorchMetricsUserError("The Metric has already been synced.")
+        fns = {id(dist_sync_fn or m.dist_sync_fn) for m in metrics}
+        groups = {id(process_group or m.process_group) for m in metrics}
+        if len(fns) > 1 or len(groups) > 1 or any(type(m).sync is not Metric.sync for m in metrics):
+            raise TorchMetricsUserError(
+                "sync(async_=True) requires uniform gather seams and the default Metric.sync "
+                "across members; use the blocking sync() for mixed collections."
+            )
+        avail_fns = [(distributed_available or m.distributed_available_fn) for m in metrics]
+        avails = {bool(fn()) for fn in avail_fns}
+        if len(avails) > 1:
+            raise TorchMetricsUserError(
+                "sync(async_=True) requires members to agree on distributed availability."
+            )
+        if not should_sync or not metrics or not avails.pop():
+            return AsyncSyncHandle.noop(label="MetricCollection.sync")
+        fn = dist_sync_fn or metrics[0].dist_sync_fn
+        group = process_group or metrics[0].process_group
+        holders: "OrderedDict[int, Metric]" = OrderedDict()
+        aliased: Dict[int, List[Metric]] = {}
+        for m in metrics:
+            key = id(m._state)
+            holders.setdefault(key, m)
+            aliased.setdefault(key, []).append(m)
+        holder_keys = list(holders)
+        frozen: List[Dict[str, Any]] = []
+        for key in holder_keys:
+            live = holders[key]._state
+            fro: Dict[str, Any] = {}
+            for name, v in list(live.items()):
+                if isinstance(v, list):
+                    # freeze the CONTAINER (appends to the live list must not
+                    # leak into the in-flight gather); elements never donate
+                    fro[name] = list(v)
+                else:
+                    fro[name] = v
+                    if rebuffer:
+                        live[name] = jnp.copy(v)  # live side re-buffered; frozen owns the original
+            frozen.append(fro)
+        reductions = [holders[k]._reductions for k in holder_keys]
+        retry = next(
+            (m._reliability.retry for m in metrics if m._reliability is not None and m._reliability.retry is not None),
+            None,
+        )
+
+        def committer(synced: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+            # validate BEFORE committing anything (same discipline as the
+            # blocking coalesced sync): a corrupt contribution must not become
+            # any member's state, and a partial commit must never happen
+            for key, synced_dict in zip(holder_keys, synced):
+                validators = [
+                    m for m in aliased[key]
+                    if m._reliability is not None and m._reliability.validate_on_sync
+                ]
+                if validators:
+                    validate_state(
+                        validators[0], synced_dict,
+                        context=f"{type(validators[0]).__name__}.sync",
+                        check_finite=any(m._reliability.check_finite for m in validators),
+                    )
+            for key, synced_dict in zip(holder_keys, synced):
+                holder = holders[key]
+                # the CURRENT (possibly overlap-updated) state parks in the
+                # cache; unsync restores it — the next window loses nothing
+                cache = {
+                    k2: (list(v) if isinstance(v, list) else v) for k2, v in holder._state.items()
+                }
+                for m in aliased[key]:
+                    m._cache = cache
+                    m._state = synced_dict
+                    m._is_synced = True
+            return synced
+
+        return AsyncSyncHandle(
+            frozen, reductions, process_group=group, dist_sync_fn=fn,
+            retry=retry, committer=committer, label="MetricCollection.sync",
+        )
 
     def unsync(self, **kwargs: Any) -> None:
         for metric in self._modules.values():

@@ -69,6 +69,20 @@ _ALLOWED_REDUCE = ("sum", "mean", "cat", "min", "max", None)
 #: the stacked analogue of the scalar ``_device_update_count`` counter
 TENANT_COUNT_KEY = "__tenant_n"
 
+#: reserved leaves inside a :class:`~torchmetrics_tpu.streaming.SlidingWindow`
+#: ring pytree: the monotone roll cursor (slot = cursor mod window, kept on
+#: device so rolling never pays a per-update host round-trip) and the per-slot
+#: fill vector ("has this bucket received an update yet") that window folds
+#: mask on
+WINDOW_CURSOR_KEY = "__window_cursor"
+WINDOW_COUNT_KEY = "__window_n"
+
+#: reserved leaf carrying an :class:`~torchmetrics_tpu.streaming.
+#: ExponentialDecay` wrapper's decayed update-weight scalar (the
+#: exponentially-discounted analogue of ``_device_update_count`` — the weight
+#: "mean" states fold against)
+DECAY_WEIGHT_KEY = "__decay_n"
+
 
 def _fresh_leaf(default: Any) -> Array:
     """Fresh device buffer from a state default, with no device→host readback.
@@ -400,6 +414,157 @@ class Metric:
             self._jit_cache[key] = jax.jit(fn, donate_argnums=0) if self._enable_jit else fn
         return self._jit_cache[key]
 
+    def _get_wupdate_fn(self) -> Callable:
+        """The windowed roll+scatter program behind
+        :class:`~torchmetrics_tpu.streaming.SlidingWindow`: ONE donated XLA
+        call writes this batch's isolated state contribution into the next
+        ring slot.
+
+        Calling convention (fixed by ``_donation_safe_dispatch`` and the AOT
+        plane): ``fn(ring, n_scalar, *args, **kwargs)`` where ``ring`` maps
+        every tensor-state name to a ``(window, *state_shape)`` bucket stack
+        plus the :data:`WINDOW_CURSOR_KEY` roll counter and
+        :data:`WINDOW_COUNT_KEY` per-slot fill vector. The body computes the
+        batch state, scatters it into slot ``cursor % window`` (overwriting
+        whatever expired there), marks the slot filled, and advances the
+        cursor — O(1) work per update regardless of window size, and no
+        unbounded concatenation anywhere. Leaves the batch does not touch
+        store their DEFAULT value (the merge identity), so the window fold at
+        compute time sees exactly what a fresh per-update metric would have
+        produced. List ("cat") contributions are returned for the wrapper's
+        bounded host-side ring, mirroring the plain update path. Only the
+        ring dict is donated; the scalar counter argument is the shared
+        calling-convention placeholder (see ``_get_vupdate_fn``)."""
+        key = "wupdate"
+        if key not in self._jit_cache:
+            list_names = set(self._list_state_names)
+            defaults_t, _ = self._split_tensor_list(self.init_state())
+            reserved = (WINDOW_CURSOR_KEY, WINDOW_COUNT_KEY)
+
+            def fn(ring, n_scalar, *args, **kwargs):
+                del n_scalar  # placeholder — see _get_vupdate_fn's docstring
+                cursor = ring[WINDOW_CURSOR_KEY]
+                counts = ring[WINDOW_COUNT_KEY]
+                states = {k: v for k, v in ring.items() if k not in reserved}
+                slot = jnp.mod(cursor, counts.shape[0])
+                with jax.named_scope(f"{type(self).__name__}.batch_state"):
+                    bs = self._batch_state(*args, **kwargs)
+                appends = {k: v for k, v in bs.items() if k in list_names}
+                bs_t = {k: v for k, v in bs.items() if k not in list_names}
+                with jax.named_scope(f"{type(self).__name__}.window_roll"):
+                    out = {}
+                    for k, v in states.items():
+                        contrib = bs_t.get(k, defaults_t.get(k))
+                        out[k] = v.at[slot].set(jnp.asarray(contrib).astype(v.dtype))
+                    out[WINDOW_COUNT_KEY] = counts.at[slot].set(1.0)
+                    out[WINDOW_CURSOR_KEY] = cursor + 1
+                return out, appends
+
+            self._jit_cache[f"{key}.raw"] = fn  # undonated source for _aot_program
+            self._jit_cache[key] = jax.jit(fn, donate_argnums=0) if self._enable_jit else fn
+        return self._jit_cache[key]
+
+    def _get_dupdate_fn(self) -> Callable:
+        """The exponentially-decayed update program behind
+        :class:`~torchmetrics_tpu.streaming.ExponentialDecay`: the plain
+        update fold with the decay factor folded into the accumulating
+        leaves AT UPDATE TIME — O(1) state, no history.
+
+        Calling convention: ``fn(tensors, n_scalar, decay, *args, **kwargs)``
+        where ``tensors`` carries the decayed state plus the
+        :data:`DECAY_WEIGHT_KEY` scalar (the discounted update count "mean"
+        states weigh against) and ``decay`` is a traced f32 scalar — keeping
+        it in data rather than baked into the program means one executable
+        (and one AOT cache entry) serves every halflife. Per reduction tag:
+        ``sum`` leaves scale by ``decay`` before absorbing the batch
+        (untouched sum leaves still decay — the stream moved on), ``mean``
+        leaves fold as a weighted mean against the decayed weight, ``max``/
+        ``min``/``None`` keep their ordinary merge (a decayed extremum has no
+        defined meaning). Metrics with custom ``_merge``, concat states, or
+        callable reductions are rejected by the wrapper — an unknown fold
+        cannot be discounted safely."""
+        key = "dupdate"
+        if key not in self._jit_cache:
+            if self._list_state_names:
+                raise TorchMetricsUserError(
+                    f"{type(self).__name__} holds dynamic-length concat states; exponential "
+                    "decay over an unbounded concatenation is undefined — use a "
+                    "binned/sufficient-statistic variant."
+                )
+            if self._has_custom_merge():
+                raise TorchMetricsUserError(
+                    f"{type(self).__name__} overrides _merge; a decay factor cannot be folded "
+                    "into an unknown merge safely."
+                )
+            reductions = dict(self._reductions)
+
+            def fn(tensors, n_scalar, decay, *args, **kwargs):
+                del n_scalar  # placeholder — see _get_vupdate_fn's docstring
+                w = tensors[DECAY_WEIGHT_KEY]
+                states = {k: v for k, v in tensors.items() if k != DECAY_WEIGHT_KEY}
+                with jax.named_scope(f"{type(self).__name__}.batch_state"):
+                    bs = self._batch_state(*args, **kwargs)
+                bs_t = {k: jnp.asarray(v) for k, v in bs.items()}
+                with jax.named_scope(f"{type(self).__name__}.decay_merge"):
+                    out = {}
+                    for k, v in states.items():
+                        fx = reductions.get(k)
+                        b = bs_t.get(k)
+                        if fx == "sum":
+                            contrib = v.dtype.type(0) if b is None else b.astype(v.dtype)
+                            out[k] = v * jnp.asarray(decay, v.dtype) + contrib
+                        elif fx == "mean" and b is not None:
+                            out[k] = jnp.asarray(
+                                _sync.weighted_mean(v, b, w * decay, 1.0)
+                            ).astype(v.dtype)
+                        elif fx == "max" and b is not None:
+                            out[k] = jnp.maximum(v, b.astype(v.dtype))
+                        elif fx == "min" and b is not None:
+                            out[k] = jnp.minimum(v, b.astype(v.dtype))
+                        else:  # untouched non-sum leaves and fx=None: keep
+                            out[k] = v
+                    out[DECAY_WEIGHT_KEY] = w * decay + 1.0
+                return out
+
+            self._jit_cache[f"{key}.raw"] = fn  # undonated source for _aot_program
+            self._jit_cache[key] = jax.jit(fn, donate_argnums=0) if self._enable_jit else fn
+        return self._jit_cache[key]
+
+    def _get_vcompute_fn(self) -> Callable:
+        """The vmapped batch-compute program behind
+        ``ServingEngine.compute_all``: ONE undonated XLA call computes every
+        row of a stacked tenant pytree at once (the eager alternative slices
+        and dispatches once per tenant).
+
+        Calling convention: ``fn(stacked, n_scalar, *args, **kwargs)`` —
+        the trailing batch args are SIGNATURE CARRIERS only (the engine
+        passes its shape-class's zero pad example so each shape-class keys
+        its own compile/cache entry; the body never reads them). Compiled
+        WITHOUT donation: the stack keeps serving traffic after the read."""
+        key = "vcompute"
+        if key not in self._jit_cache:
+            if self._list_state_names:
+                raise TorchMetricsUserError(
+                    f"{type(self).__name__} holds dynamic-length concat states and cannot be "
+                    "served from a stacked pytree; use a binned/static-shape variant."
+                )
+            if not self._jittable_compute:
+                raise TorchMetricsUserError(
+                    f"{type(self).__name__}.compute runs on host and cannot vmap; "
+                    "per-tenant compute falls back to eager slicing."
+                )
+
+            def fn(stacked, n_scalar, *args, **kwargs):
+                del n_scalar, args, kwargs  # shape-class identity carriers only
+                states = {k: v for k, v in stacked.items() if k != TENANT_COUNT_KEY}
+                with jax.named_scope(f"{type(self).__name__}.vcompute"):
+                    return jax.vmap(self._compute)(states)
+
+            self._jit_cache[f"{key}.raw"] = fn  # undonated source for _aot_program
+            # no donation: compute is a read — the stack stays live for traffic
+            self._jit_cache[key] = jax.jit(fn) if self._enable_jit else fn
+        return self._jit_cache[key]
+
     def _append_list_state(self, name: str, value: Any) -> None:
         """Append one row to a concat state. compute_on_cpu (reference metric.py:119)
         offloads it to host — list states are where memory grows, and host storage
@@ -471,6 +636,7 @@ class Metric:
         tensors: StateDict,
         inputs: Optional[tuple] = None,
         jitted: Optional[Callable] = None,
+        owner: Optional[StateDict] = None,
     ) -> Any:
         """Dispatch a jitted call that DONATES its tensor-state argument (and, for
         ``update``, the device counter). ``call(t, n)`` receives the live tensor
@@ -491,6 +657,12 @@ class Metric:
         jit path owns that signature for the rest of the process, and a
         corrupt entry is just a miss. Counters keep
         ``jit_compiles + jit_cache_hits + aot_cache_hits == dispatches`` exact.
+
+        ``owner`` names the dict that OWNS ``tensors`` when it is not this
+        metric's ``_state`` (a streaming wrapper's ring/decay pytree): an
+        exhausted retry budget restores the pre-attempt backup into the
+        owner, so rollback lands in the right state and never pollutes the
+        base metric's dict with reserved ring keys.
         """
         plane = _aot._ACTIVE
         aot_slot = None
@@ -529,7 +701,7 @@ class Metric:
         rec = _observability._ACTIVE
         if rec is None:
             with _tracing.trace_span(f"{type(self).__name__}.{tag}"):
-                result = self._dispatch_donated(tag, call, tensors)
+                result = self._dispatch_donated(tag, call, tensors, owner=owner)
             if aot_slot is not None and aot_slot.store_pending:
                 plane.store_from_dispatch(
                     self, tag, tensors, self._device_update_count(), inputs,
@@ -538,7 +710,7 @@ class Metric:
             return result
         t0 = _tracing.monotonic()
         with _tracing.trace_span(f"{type(self).__name__}.{tag}"):
-            result = self._dispatch_donated(tag, call, tensors)
+            result = self._dispatch_donated(tag, call, tensors, owner=owner)
         # aot_hit is decided AFTER the dispatch: a mid-call demotion means the
         # jit path actually served it
         aot_hit = aot_slot is not None and aot_slot.compiled is not None
@@ -573,14 +745,18 @@ class Metric:
             )
         return result
 
-    def _dispatch_donated(self, tag: str, call: Callable[..., Any], tensors: StateDict) -> Any:
+    def _dispatch_donated(
+        self, tag: str, call: Callable[..., Any], tensors: StateDict,
+        owner: Optional[StateDict] = None,
+    ) -> Any:
         """The donation-safe dispatch body.
 
         Default path (no retry): single attempt, no copies — byte-for-byte today's
         behavior. With a RetryPolicy: an undonated device-side backup lets every
         retry see intact inputs, and when the budget is exhausted the backup
-        replaces the donated (deleted) live buffers in ``self._state`` before the
-        exception re-raises, so the metric stays usable at its last good state.
+        replaces the donated (deleted) live buffers in ``self._state`` (or the
+        explicit ``owner`` dict a streaming wrapper passes) before the exception
+        re-raises, so the metric stays usable at its last good state.
         """
         rel = self._reliability
         if rel is None or rel.retry is None:
@@ -600,8 +776,9 @@ class Metric:
                 describe=f"{type(self).__name__}.{tag}",
             )
         except Exception:
+            target = self._state if owner is None else owner
             for k, v in backup.items():
-                self._state[k] = v
+                target[k] = v
             self._n_prev_dev = None
             raise
 
@@ -1118,8 +1295,17 @@ class Metric:
             primary = self._get_forward_fn()
         elif tag == "vupdate":
             primary = self._get_vupdate_fn()
+        elif tag == "wupdate":
+            primary = self._get_wupdate_fn()
+        elif tag == "dupdate":
+            primary = self._get_dupdate_fn()
+        elif tag == "vcompute":
+            primary = self._get_vcompute_fn()
         else:
-            raise ValueError(f"Unknown dispatch tag {tag!r}; expected 'update', 'forward' or 'vupdate'")
+            raise ValueError(
+                f"Unknown dispatch tag {tag!r}; expected 'update', 'forward', 'vupdate', "
+                "'wupdate', 'dupdate' or 'vcompute'"
+            )
         raw = self._jit_cache.get(f"{tag}.raw")
         if raw is None or not hasattr(primary, "lower"):
             return primary, ()

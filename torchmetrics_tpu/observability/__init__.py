@@ -183,6 +183,8 @@ class TelemetryRecorder:
         self._epoch = next(_SESSION_EPOCHS)
         self._ids = itertools.count()
         self._retrace_warned: set = set()
+        self._drift: Dict[str, float] = {}  # last score per DriftMonitor name
+        self._drift_warned: set = set()
         self._closed = False
 
     # ------------------------------------------------------------- identities
@@ -415,6 +417,104 @@ class TelemetryRecorder:
             duration_s=duration_s, payload={"nbytes": int(nbytes)},
         )
 
+    def record_window_roll(self, metric: Any, window: int, filled: int, wrapped: bool) -> None:
+        """One SlidingWindow ring-slot roll (streaming plane). The counter
+        ticks on every roll; the ``window_roll`` EVENT fires only when the
+        window wrapped (a full window of updates completed) so the stream
+        stays low-rate — the per-roll dispatch latency already rides the
+        ``wupdate`` dispatch events/histograms."""
+        name = self._metric_name(metric)
+        self.counters.record_window_roll()
+        if wrapped:
+            self._event(
+                "window_roll", name, "wupdate",
+                payload={"window": int(window), "filled": int(filled)},
+            )
+
+    def record_async_sync(
+        self,
+        label: str,
+        gather_s: float,
+        wait_s: float,
+        payload_bytes: int,
+        collectives: int = 0,
+        fallback: bool = False,
+    ) -> None:
+        """One committed double-buffered background sync
+        (``parallel.AsyncSyncHandle``). ``gather_s`` is the gather's full
+        wall-clock (what a blocking sync would have cost the caller — it
+        feeds ``sync_time_us`` and the ``sync`` histogram like any sync);
+        ``wait_s`` is how long ``commit()`` actually blocked. The difference,
+        reported as ``overlap_pct``, is the sync latency the overlap hid —
+        the direct observable of the double-buffered plane."""
+        self.counters.record_async_sync(wait_s)
+        self.counters.record_sync_time(gather_s)
+        self.histograms.record_duration("sync", label, gather_s)
+        overlap = max(0.0, 1.0 - (wait_s / gather_s)) * 100.0 if gather_s > 0 else 0.0
+        self._event(
+            "async_sync", label, "sync", duration_s=gather_s,
+            payload={
+                "wait_s": round(wait_s, 6),
+                "overlap_pct": round(overlap, 2),
+                "payload_bytes": int(payload_bytes),
+                "collectives": int(collectives),
+                "fallback": bool(fallback),
+            },
+        )
+
+    def record_drift(
+        self,
+        name: str,
+        score: float,
+        breached: bool,
+        threshold: float,
+        severity: str = "warning",
+    ) -> None:
+        """One DriftMonitor evaluation. The latest score lands in the SLO
+        expression namespace as ``drift(name)``; a breach additionally rides
+        the ``alert`` event kind (plus the ``alerts`` counter and a once-per-
+        name rank-zero warning), exactly like an SLO rule breach — drift IS a
+        health signal, so it shares the alerting channel."""
+        self.counters.record_drift(breached)
+        self._drift[name] = float(score)
+        if not breached:
+            return
+        self.counters.record_alert()
+        self._event(
+            "alert", name, "drift",
+            payload={
+                "kind": "drift",
+                "severity": severity,
+                "score": round(float(score), 6),
+                "threshold": float(threshold),
+            },
+        )
+        if name not in self._drift_warned:
+            self._drift_warned.add(name)
+            rank_zero_warn(
+                f"Drift breach [{severity}] {name}: score {float(score):.6g} over threshold "
+                f"{float(threshold):.6g} (test window vs reference window diverged).",
+                UserWarning,
+            )
+
+    def drift_score(self, name: str) -> float:
+        """Latest score a DriftMonitor recorded under ``name`` (0.0 when none
+        ran) — the value the SLO namespace's ``drift(name)`` reads."""
+        return self._drift.get(name, 0.0)
+
+    def drift_scores(self) -> Dict[str, float]:
+        return dict(self._drift)
+
+    def record_serve_rejected(self, metric: Any, tenant_id: Any) -> None:
+        """One tenant batch shed by the serving admission rate limit — the
+        overload signal an autoscaler watches instead of LRU-spill churn."""
+        name = self._metric_name(metric)
+        self.counters.record_serve_rejected()
+        self._event(
+            "serve_rejected", name, "admission",
+            payload={"tenant": repr(tenant_id)[:80]},
+        )
+
     def record_d2h(self, site: str, nbytes: int, metric: Any = None) -> None:
         """An instrumented device→host readback (``state_dict``,
         ``compute_on_cpu`` appends, finiteness guards). The hot loop's
@@ -520,7 +620,7 @@ class TelemetryRecorder:
             return {}
         name = f"{type(metric).__name__}#{stamp[1]}"
         out: Dict[str, Any] = {}
-        for kind in ("update", "forward", "compute", "sync", "aot_load"):
+        for kind in ("update", "forward", "compute", "sync", "aot_load", "wupdate", "dupdate", "vupdate"):
             hist = self.histograms.get(kind, name)
             if hist is None or not hist.count:
                 continue

@@ -53,6 +53,12 @@ COUNTER_FIELDS: Tuple[str, ...] = (
     "tenant_spills",  # cold tenant states spilled from the stack to host memory
     "tenant_readmits",  # spilled tenant states uploaded back into a stack slot
     "tenant_spill_us",  # wall-clock spent spilling/readmitting tenant state
+    "window_rolls",  # SlidingWindow ring-slot rolls (streaming plane, wupdate dispatches)
+    "async_syncs",  # double-buffered background syncs committed (AsyncSyncHandle)
+    "async_sync_wait_us",  # wall-clock commit() actually blocked — the UNHIDDEN sync latency
+    "drift_evals",  # DriftMonitor window-vs-reference evaluations
+    "drift_breaches",  # evaluations whose drift score crossed the monitor's threshold
+    "serve_rejected",  # tenant batches shed by the serving admission rate limit
 )
 
 
@@ -344,6 +350,32 @@ class Counters:
     def record_alert(self) -> None:
         with self._lock:
             self._counts["alerts"] += 1
+
+    def record_window_roll(self) -> None:
+        """One SlidingWindow ring-slot roll (a windowed ``wupdate`` dispatch)."""
+        with self._lock:
+            self._counts["window_rolls"] += 1
+
+    def record_async_sync(self, wait_s: float) -> None:
+        """One committed double-buffered background sync; ``wait_s`` is how
+        long ``commit()`` actually blocked — the part of the sync latency the
+        overlap did NOT hide (the gather's full wall-clock still lands in
+        ``sync_time_us`` like a blocking sync)."""
+        with self._lock:
+            self._counts["async_syncs"] += 1
+            self._counts["async_sync_wait_us"] += max(0, int(wait_s * 1e6))
+
+    def record_drift(self, breached: bool) -> None:
+        """One DriftMonitor evaluation (``breached``: score over threshold)."""
+        with self._lock:
+            self._counts["drift_evals"] += 1
+            if breached:
+                self._counts["drift_breaches"] += 1
+
+    def record_serve_rejected(self) -> None:
+        """One tenant batch shed by the serving admission rate limit."""
+        with self._lock:
+            self._counts["serve_rejected"] += 1
 
     # --------------------------------------------------------------- querying
 

@@ -39,6 +39,9 @@ EVENT_KINDS: Tuple[str, ...] = (
     "hist",  # a latency/size histogram snapshot (flushed at session close)
     "serve",  # a megabatched stacked-state dispatch (serving engine)
     "tenant_spill",  # tenant state spilled to host / readmitted into a stack
+    "window_roll",  # a SlidingWindow completed a full window wrap (streaming plane)
+    "async_sync",  # a double-buffered background sync committed (overlap accounting)
+    "serve_rejected",  # a tenant batch shed by the serving admission rate limit
 )
 
 

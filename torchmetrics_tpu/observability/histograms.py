@@ -39,9 +39,11 @@ from typing import Any, Dict, Iterable, List, Mapping, Optional, Sequence, Tuple
 N_BUCKETS = 32
 
 # The kinds whose per-kind totals ride the fleet plane, in vector order. The
-# first six are latency histograms (microseconds); the last two are size
+# first seven are latency histograms (microseconds); the last two are size
 # histograms (bytes). Fixed across ranks by construction — the fleet vector
-# needs no key exchange.
+# needs no key exchange. (Growing this tuple changes the piggyback layout:
+# bump parallel/coalesce._VERSION — the streaming "wupdate" addition rode the
+# v5 bump together with the counter-vector growth.)
 FLEET_HISTOGRAM_KINDS: Tuple[str, ...] = (
     "update",        # jitted/host update dispatch latency
     "forward",       # forward dispatch latency
@@ -49,6 +51,7 @@ FLEET_HISTOGRAM_KINDS: Tuple[str, ...] = (
     "sync",          # Metric.sync / MetricCollection.sync wall-clock
     "retry_backoff", # backoff delay accepted before a transient retry
     "aot_load",      # serialized-executable load latency (aot compile cache)
+    "wupdate",       # SlidingWindow ring-roll dispatch latency (streaming plane)
     "sync_payload",  # bytes a process contributed to one sync
     "gather_bytes",  # bytes of one sync-plane collective payload
 )

@@ -23,6 +23,10 @@ Expression namespace (everything is computed over the rule's window):
   ``0.0`` when the window recorded nothing of that kind (a no-data window
   never breaches a ``>`` threshold);
 - ``collectives_per_sync`` — the derived coalescing headline over the window;
+- ``drift(name)`` — the latest score a
+  :class:`~torchmetrics_tpu.streaming.DriftMonitor` recorded under ``name``
+  (``0.0`` when none ran) — lets an SLO rule page on sustained drift, e.g.
+  ``drift('accuracy') > 0.1 and drift_evals > 3``;
 - ``window`` — the seconds of history actually covered (shorter than the
   configured window early in a session);
 - ``max`` / ``min`` / ``abs`` — the only builtins exposed.
@@ -332,6 +336,12 @@ class SloEngine:
                 if state.error is not None:
                     continue
                 ns = self._namespace(current, self._baseline_for(rule, t))
+                # drift scores are recorder-local gauges (not window deltas):
+                # the namespace exposes the latest value a DriftMonitor
+                # recorded under each name
+                drift_fn = getattr(recorder, "drift_score", None)
+                if drift_fn is not None:
+                    ns["drift"] = drift_fn
                 try:
                     breached = bool(eval(rule.expr, {"__builtins__": {}}, ns))  # noqa: S307 — operator config
                 except Exception as err:

@@ -1,4 +1,5 @@
 from . import coalesce
+from .async_sync import AsyncSyncHandle
 from .coalesce import CoalesceFallback, coalesced_process_sync, collective_counts, reduce_many
 from .mesh import (
     DEFAULT_AXIS,
@@ -22,6 +23,7 @@ from .sync import (
 )
 
 __all__ = [
+    "AsyncSyncHandle",
     "CoalesceFallback",
     "DEFAULT_AXIS",
     "DEFAULT_TENANT_AXIS",

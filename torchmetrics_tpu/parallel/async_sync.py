@@ -1,0 +1,247 @@
+"""Double-buffered asynchronous state synchronization.
+
+Every sync plane so far is serial with updates: the caller blocks on the
+collective set before touching its metrics again, so the full gather latency
+lands on the hot path. Real monitoring traffic has the opposite shape — the
+*previous* window's state is frozen (the window rolled, or the caller rotated
+state via ``reset()``) while the *current* window keeps accumulating — which
+is exactly the compute/communication overlap pjit-era training stacks practice
+(arXiv:2204.06514): ship the frozen buffers in the background, keep the update
+loop running, and pay only the residual wait at the commit barrier.
+
+:class:`AsyncSyncHandle` is that overlap as an object:
+
+- **launch** (construction): a daemon worker thread runs the SAME coalesced
+  bucketed gather the blocking planes use (``coalesce.coalesced_process_sync``
+  — one metadata collective plus one padded gather per dtype bucket), with the
+  per-leaf plane preserved as the in-worker fallback when the gathered
+  metadata cannot be decoded (``CoalesceFallback``) and the caller's
+  ``RetryPolicy`` honored for transient gather failures;
+- **overlap**: the caller keeps updating. The frozen snapshot is a *shallow*
+  dict copy — jax arrays are immutable, so freezing is zero-copy — and the
+  caller guarantees the frozen buffers stay exclusively owned (either by
+  rotating/resetting its live state, or by re-buffering the live side the way
+  ``MetricCollection.sync(async_=True)`` does), because a donated update on a
+  still-aliased buffer would delete it under the in-flight gather;
+- **commit** (the barrier): waits for the worker, re-raises any failure with
+  NOTHING installed (the caller keeps its last good state — the same
+  commit-after-validate rollback discipline as the blocking collection sync),
+  runs the caller's ``committer`` (which validates BEFORE installing), and
+  records the overlap accounting: the gather's full wall-clock vs how long
+  commit actually blocked — the difference is the sync latency the overlap
+  hid (``async_sync`` event, ``async_syncs``/``async_sync_wait_us`` counters).
+
+Single-threaded jax note: the worker only drives HOST-side collectives
+(``process_allgather`` / an injected ``dist_sync_fn``); it never touches the
+caller's donated dispatch path, so the update loop and the gather share the
+runtime safely.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence, Union
+
+import jax.numpy as jnp
+
+from .. import observability as _observability
+from ..utilities.exceptions import TorchMetricsUserError
+from . import coalesce as _coalesce
+
+StateDict = Dict[str, Any]
+Reduction = Union[str, Callable, None]
+
+
+class AsyncSyncHandle:
+    """One in-flight background sync of frozen state dicts.
+
+    Args:
+        states: the frozen state dicts to synchronize (the handle shallow-
+            copies each dict, so the caller may keep mutating its own dict
+            CONTAINERS; the captured arrays must stay exclusively owned —
+            see the module docstring's donation note).
+        reductions: one reduction mapping per state dict.
+        process_group / dist_sync_fn: the usual gather seams.
+        retry: an optional :class:`~torchmetrics_tpu.reliability.RetryPolicy`
+            applied to the whole gather attempt (transient failures retry in
+            the worker; the per-leaf ``CoalesceFallback`` path is taken
+            inside each attempt exactly like the blocking plane).
+        committer: called under :meth:`commit` with the synced state list —
+            the seam where ``MetricCollection`` validates and atomically
+            installs. Its exceptions propagate from ``commit()`` with nothing
+            recorded as committed.
+        label: telemetry identity for the ``async_sync`` event.
+        noop: build an already-completed empty handle (the distributed-
+            unavailable case — ``commit()`` is a cheap no-op barrier).
+    """
+
+    def __init__(
+        self,
+        states: Sequence[StateDict],
+        reductions: Sequence[Mapping[str, Reduction]],
+        process_group: Any = None,
+        dist_sync_fn: Optional[Callable] = None,
+        retry: Any = None,
+        committer: Optional[Callable[[List[StateDict]], Any]] = None,
+        label: str = "AsyncSyncHandle",
+        noop: bool = False,
+    ) -> None:
+        self.label = label
+        self._committer = committer
+        self._states = [
+            {k: (list(v) if isinstance(v, list) else v) for k, v in s.items()} for s in states
+        ]
+        self._reductions = [dict(r) for r in reductions]
+        self._process_group = process_group
+        self._dist_sync_fn = dist_sync_fn
+        self._retry = retry
+        self._result: Optional[List[StateDict]] = None
+        self._error: Optional[BaseException] = None
+        self._gather_s = 0.0
+        self._wait_s = 0.0
+        self._collectives = 0
+        self._fallback = False
+        self._committed = False
+        self._done = threading.Event()
+        self._payload_bytes = sum(_payload_bytes(s) for s in self._states)
+        if noop:
+            self._result = []
+            self._states = []
+            self._done.set()
+            self._thread = None
+            return
+        self._thread = threading.Thread(
+            target=self._work, name=f"tm-async-sync:{label}", daemon=True
+        )
+        self._thread.start()
+
+    # ----------------------------------------------------------------- worker
+
+    def _attempt(self) -> List[StateDict]:
+        from . import sync as _sync  # late: sync.py imports coalesce at top level
+
+        try:
+            return _coalesce.coalesced_process_sync(
+                self._states, self._reductions,
+                process_group=self._process_group, dist_sync_fn=self._dist_sync_fn,
+            )
+        except _coalesce.CoalesceFallback:
+            # per-leaf fallback preserved, in lockstep: every rank decodes the
+            # same gathered metadata, so a real fleet falls back together
+            self._fallback = True
+            return [
+                _sync._process_sync_per_leaf(
+                    s, r, self._process_group, self._dist_sync_fn
+                )
+                for s, r in zip(self._states, self._reductions)
+            ]
+
+    def _work(self) -> None:
+        rec = _observability._ACTIVE
+        coll0 = rec.counters.value("sync_collectives") if rec is not None else 0
+        t0 = time.perf_counter()
+        try:
+            if self._retry is None:
+                self._result = self._attempt()
+            else:
+                self._result = self._retry.call(self._attempt, describe=self.label)
+            if rec is not None:
+                # one successful sync entry, mirroring the blocking planes
+                rec.counters.record_sync(self._payload_bytes)
+                self._collectives = rec.counters.value("sync_collectives") - coll0
+        except BaseException as err:  # noqa: BLE001 — re-raised at commit()
+            self._error = err
+        finally:
+            self._gather_s = time.perf_counter() - t0
+            self._done.set()
+
+    # ------------------------------------------------------------------- API
+
+    @classmethod
+    def noop(cls, label: str = "AsyncSyncHandle") -> "AsyncSyncHandle":
+        """An already-completed empty handle (nothing to sync — the
+        distributed-unavailable no-op, kept so call sites stay uniform)."""
+        return cls([], [], label=label, noop=True)
+
+    @property
+    def done(self) -> bool:
+        """Whether the background gather finished (success or failure)."""
+        return self._done.is_set()
+
+    @property
+    def committed(self) -> bool:
+        return self._committed
+
+    @property
+    def overlap_pct(self) -> float:
+        """How much of the gather's wall-clock the overlap hid (valid after
+        :meth:`commit`): 100% means commit never blocked."""
+        if self._gather_s <= 0:
+            return 0.0
+        return max(0.0, 1.0 - self._wait_s / self._gather_s) * 100.0
+
+    @property
+    def gather_s(self) -> float:
+        return self._gather_s
+
+    @property
+    def wait_s(self) -> float:
+        return self._wait_s
+
+    @property
+    def used_fallback(self) -> bool:
+        return self._fallback
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until the background gather finishes (no install)."""
+        return self._done.wait(timeout)
+
+    def result(self) -> List[StateDict]:
+        """The synced state dicts (blocks; raises the worker's failure)."""
+        self._done.wait()
+        if self._error is not None:
+            raise self._error
+        assert self._result is not None
+        return self._result
+
+    def commit(self) -> Any:
+        """Barrier + validate + atomic install.
+
+        Waits for the gather, re-raises any worker failure with NOTHING
+        installed (the caller stays at its last good state), then runs the
+        committer (which validates before installing). Returns the
+        committer's result (the synced state list when no committer is set).
+        Telemetry records the overlap accounting on success. One-shot on
+        SUCCESS only: a failed commit leaves the handle uncommitted —
+        ``committed`` stays ``False``, and calling again re-raises the real
+        error (or re-runs a committer that rejected validation) instead of a
+        misleading "already ran".
+        """
+        if self._committed:
+            raise TorchMetricsUserError(f"{self.label}: commit() already ran for this handle.")
+        t0 = time.perf_counter()
+        self._done.wait()
+        self._wait_s = time.perf_counter() - t0
+        if self._error is not None:
+            raise self._error
+        assert self._result is not None
+        out = self._committer(self._result) if self._committer is not None else self._result
+        self._committed = True
+        rec = _observability._ACTIVE
+        if rec is not None and self._states:
+            rec.record_async_sync(
+                self.label, self._gather_s, self._wait_s, self._payload_bytes,
+                collectives=self._collectives, fallback=self._fallback,
+            )
+        return out
+
+
+def _payload_bytes(state: StateDict) -> int:
+    total = 0
+    for value in state.values():
+        leaves = value if isinstance(value, list) else [value]
+        for leaf in leaves:
+            if hasattr(leaf, "size") and hasattr(leaf, "dtype"):
+                total += int(leaf.size) * jnp.dtype(leaf.dtype).itemsize
+    return total

@@ -80,7 +80,12 @@ _MAGIC = 0x436F414C  # "CoAL"
 # must fail validation rather than misparse each other's rows
 # v4: the counter vector gained the serving-engine fields (serve_* /
 # tenant_*) — same mixed-version rule
-_VERSION = 4
+# v5: the counter vector gained the streaming-plane fields (window_rolls /
+# async_sync* / drift_* / serve_rejected). Mixed-version ranks fail row
+# validation (CoalesceFallback → lockstep per-leaf sync) and deposit NO
+# mailbox rows, so fleet rollups degrade to a fresh collective / local
+# rollup instead of misdecoding another version's half-packed layout
+_VERSION = 5
 _HEADER_LEN = 4  # [magic, version, n_leaves, n_counter_fields]
 _LEAF_REC_LEN = 2 + _MAX_RANK + 1  # [dtype_code, ndim, d0..d7, kind]
 _KIND_TENSOR = 0

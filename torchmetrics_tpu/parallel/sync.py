@@ -24,6 +24,14 @@ collective per (reduction-class × dtype) bucket — K·L per-leaf collectives
 collapse to a handful per sync — with the per-leaf plane kept as the bitwise
 parity oracle and automatic fallback (``reduce_states_per_leaf``,
 ``_process_sync_per_leaf``). See docs/distributed.md, "Coalesced synchronization".
+
+Plane 2 additionally runs **double-buffered** (``parallel/async_sync.py``):
+:class:`~torchmetrics_tpu.parallel.AsyncSyncHandle` ships a frozen previous
+window's states through the same coalesced gather on a background worker while
+the current window keeps updating, committing with the blocking plane's
+commit-after-validate rollback discipline — ``MetricCollection.sync(async_=
+True)`` and ``ServingEngine.sync_async`` are the entry points
+(docs/streaming.md, "Async double-buffered sync").
 """
 
 from __future__ import annotations

@@ -91,6 +91,12 @@ class ServingConfig:
             stack up before every megabatch, rolls back on failure, re-drives
             the entries one tenant at a time, and quarantines only the
             offending tenant(s).
+        max_tenants_per_sec: admission rate limit — a token bucket refilled
+            at this rate (burst capacity = one second's tokens) gates
+            :meth:`ServingEngine.update`; a batch arriving with the bucket
+            empty is SHED (``update`` returns ``False``, the
+            ``serve_rejected`` counter/event fires) instead of queueing into
+            LRU-spill thrash. ``None`` (default) admits everything.
         aot_cache_dir: activate the AOT compile-cache plane process-wide at
             engine construction, pointed at this directory, with
             ``write_on_miss`` below — the self-warming boot path (a second
@@ -107,6 +113,7 @@ class ServingConfig:
     auto_flush: bool = True
     spill: bool = True
     on_error: str = "raise"
+    max_tenants_per_sec: Optional[float] = None
     aot_cache_dir: Optional[str] = None
     write_on_miss: bool = True
     sharding: Any = None
@@ -114,6 +121,10 @@ class ServingConfig:
     def __post_init__(self) -> None:
         if self.capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {self.capacity}")
+        if self.max_tenants_per_sec is not None and not self.max_tenants_per_sec > 0:
+            raise ValueError(
+                f"max_tenants_per_sec must be > 0 (or None), got {self.max_tenants_per_sec}"
+            )
         if self.megabatch_size < 1:
             raise ValueError(f"megabatch_size must be >= 1, got {self.megabatch_size}")
         if self.megabatch_size > self.capacity:
@@ -214,8 +225,20 @@ class ServingEngine:
         self.stats: Dict[str, int] = {
             "dispatches": 0, "tenant_rows": 0, "padded_rows": 0, "flushes": 0,
             "spills": 0, "readmissions": 0, "spill_ns": 0, "quarantined": 0,
-            "dropped_batches": 0,
+            "dropped_batches": 0, "rejected_batches": 0,
         }
+        # admission token bucket (ServingConfig.max_tenants_per_sec): starts
+        # full (one second's burst, floored at one whole token so sub-1/s
+        # rates can admit at all); `_clock` is the injection seam tests use
+        self._clock: Callable[[], float] = time.monotonic
+        self._rl_tokens = (
+            max(float(self.config.max_tenants_per_sec), 1.0)
+            if self.config.max_tenants_per_sec is not None else 0.0
+        )
+        self._rl_last: Optional[float] = None
+        # vmapped batch-compute support memo: None = untried, False = this
+        # metric's _compute cannot vmap (host path / untraceable) — eager wins
+        self._vcompute_ok: Optional[bool] = None
         if self.config.aot_cache_dir is not None:
             # the self-warming boot path: every fresh megabatch compile writes
             # through, so the next boot of this server loads instead
@@ -249,10 +272,11 @@ class ServingEngine:
             self._sig_cache[ck] = key
         return key
 
-    def _ensure_class(self, key: str, args: tuple, kwargs: dict) -> _ShapeClass:
-        cls = self._classes.get(key)
-        if cls is not None:
-            return cls
+    def _fresh_stack(self) -> StateDict:
+        """A default-valued stack with the engine's exact layout (rows =
+        capacity + scratch, every tensor leaf + :data:`TENANT_COUNT_KEY`,
+        sharding applied) — the ONE definition of the stacked calling
+        convention, shared by shape-class creation and window rotation."""
         rows = self.config.capacity + 1  # + the scratch row padding scatters into
         stacked: StateDict = {
             name: jnp.repeat(jnp.asarray(leaf)[None], rows, axis=0)
@@ -261,6 +285,13 @@ class ServingEngine:
         stacked[TENANT_COUNT_KEY] = jnp.zeros((rows,), jnp.float32)
         if self.config.sharding is not None:
             stacked = jax.device_put(stacked, self.config.sharding)
+        return stacked
+
+    def _ensure_class(self, key: str, args: tuple, kwargs: dict) -> _ShapeClass:
+        cls = self._classes.get(key)
+        if cls is not None:
+            return cls
+        stacked = self._fresh_stack()
         # zero pytree with the class's exact leaf shapes/dtypes — the values
         # never reach a real tenant (pad rows scatter into the scratch slot)
         pad = jax.tree.map(lambda leaf: np.zeros(np.shape(leaf), _np_dtype(leaf)), (args, kwargs))
@@ -356,10 +387,42 @@ class ServingEngine:
 
     # ------------------------------------------------------------------ ingest
 
-    def update(self, tenant_id: Hashable, *args: Any, **kwargs: Any) -> None:
+    def _admit_rate(self) -> bool:
+        """Token-bucket admission: refill at ``max_tenants_per_sec``, burst
+        capacity one second's tokens — floored at ONE token, because admission
+        spends a whole token and a sub-1/s rate capped below 1.0 could never
+        admit anything (a permanent outage, not a limit). ``True`` = admitted
+        (one token spent)."""
+        rate = self.config.max_tenants_per_sec
+        if rate is None:
+            return True
+        now = self._clock()
+        if self._rl_last is None:
+            self._rl_last = now
+        cap = max(float(rate), 1.0)
+        self._rl_tokens = min(cap, self._rl_tokens + (now - self._rl_last) * float(rate))
+        self._rl_last = now
+        if self._rl_tokens >= 1.0:
+            self._rl_tokens -= 1.0
+            return True
+        return False
+
+    def update(self, tenant_id: Hashable, *args: Any, **kwargs: Any) -> bool:
         """Route one ``(tenant_id, batch)`` into its shape-class megabatch
         queue (dispatched when a full megabatch accumulates, at
-        :meth:`flush`, or before any per-tenant read)."""
+        :meth:`flush`, or before any per-tenant read).
+
+        Returns ``True`` when the batch was admitted. With
+        ``ServingConfig(max_tenants_per_sec=...)`` set, an over-rate batch is
+        SHED — ``False`` comes back, the ``serve_rejected`` counter/event
+        fires, and no tenant state/queue/LRU bookkeeping is touched — so
+        overload degrades to dropped samples instead of spill thrash."""
+        if not self._admit_rate():
+            self.stats["rejected_batches"] += 1
+            rec = _observability._ACTIVE
+            if rec is not None:
+                rec.record_serve_rejected(self._metric, tenant_id)
+            return False
         t = self._tenant(tenant_id)
         if t.quarantined:
             raise TorchMetricsUserError(
@@ -382,6 +445,7 @@ class ServingEngine:
         t.last_touch = next(self._touch)
         if self.config.auto_flush and len(cls.queue) >= self.config.megabatch_size:
             self._dispatch_chunk(cls)
+        return True
 
     def flush(self) -> int:
         """Dispatch every pending megabatch (partial ones padded with scratch
@@ -472,6 +536,7 @@ class ServingEngine:
             cls.stacked,
             inputs=inputs,
             jitted=fn,
+            owner=cls.stacked,  # defensive: rollback lands in the stack, not _state
         )
         cls.stacked = new_stacked
         cls.dispatches += 1
@@ -527,13 +592,57 @@ class ServingEngine:
         return self._metric._compute(self._tenant_state(t))
 
     def compute_all(self) -> Dict[Hashable, Any]:
-        """Every non-quarantined tenant's value (flushes pending traffic once)."""
+        """Every non-quarantined tenant's value (flushes pending traffic once).
+
+        Resident tenants compute through ONE vmapped XLA call per shape-class
+        (``Metric._get_vcompute_fn`` over the whole stack — the compile
+        counters prove one ``vcompute`` compile per shape-class regardless of
+        fleet size), replacing the eager per-tenant stack-slicing loop whose
+        python dispatch overhead scaled with the roster. Spilled tenants and
+        metrics whose ``_compute`` cannot trace (host computes) fall back to
+        the eager slice path — values are identical either way."""
         self.flush()
-        return {
-            tid: self._metric._compute(self._tenant_state(t))
-            for tid, t in self._tenants.items()
-            if not t.quarantined
-        }
+        out: Dict[Hashable, Any] = {}
+        done: set = set()
+        if self._vcompute_ok is not False:
+            for cls in self._classes.values():
+                residents = [
+                    (slot, tid) for slot, tid in cls.slot_tenant.items()
+                    if not self._tenants[tid].quarantined
+                ]
+                if not residents:
+                    continue
+                try:
+                    vals = self._vcompute(cls)
+                except Exception:  # noqa: BLE001 — eager slicing below serves everyone
+                    self._vcompute_ok = False
+                    break
+                self._vcompute_ok = True
+                for slot, tid in residents:
+                    out[tid] = jax.tree.map(lambda a, s=slot: a[s], vals)
+                    done.add(tid)
+        for tid, t in self._tenants.items():
+            if tid in done or t.quarantined:
+                continue
+            out[tid] = self._metric._compute(self._tenant_state(t))
+        return {tid: out[tid] for tid in self._tenants if tid in out}
+
+    def _vcompute(self, cls: _ShapeClass) -> Any:
+        """One whole-stack vmapped compute, dispatched through the usual
+        donation-safe seam (telemetry + AOT planes apply; the program itself
+        never donates — the stack keeps serving traffic). Every row computes
+        (free/scratch rows are discarded) so the dispatch signature is fixed
+        per shape-class; the class's zero pad example rides along purely as
+        the signature carrier that keys each class's own compile."""
+        fn = self._metric._get_vcompute_fn()
+        pa, pk = cls.pad_example
+        # owner= is defensive: the engine strips its clone's reliability, but
+        # should retry ever engage, an exhausted-budget rollback must restore
+        # into the STACK, never pollute the template metric's _state
+        return self._metric._donation_safe_dispatch(
+            "vcompute", lambda t, n: fn(t, n, *pa, **pk), cls.stacked,
+            inputs=(pa, pk), jitted=fn, owner=cls.stacked,
+        )
 
     def update_count(self, tenant_id: Hashable) -> int:
         return self._require(tenant_id).update_count
@@ -670,6 +779,82 @@ class ServingEngine:
         if slot is not None and slot.compiled is not None:
             return {key: {"status": "loaded", "codec": slot.codec, "load_s": round(slot.load_s, 6)}}
         return {key: {"status": "miss"}}
+
+    # ------------------------------------------------------------ async sync
+
+    def sync_async(
+        self,
+        process_group: Any = None,
+        dist_sync_fn: Optional[Callable] = None,
+        reset_window: bool = False,
+    ) -> Any:
+        """Launch a background coalesced sync of every shape-class's stacked
+        tenant states — the hook that takes windowed per-tenant metrics' sync
+        off the hot path (see ``docs/streaming.md``).
+
+        Pending megabatch queues are ``flush()``-ed first (same read-path
+        convention as ``compute``/``compute_all``), so every batch admitted
+        before the call lands in the window it arrived in. ``handle.commit()``
+        returns ``{shape_class_key: synced_stack}`` — a GLOBAL (cross-rank
+        folded) read-side snapshot of the RESIDENT rows; the live stacks keep
+        serving traffic untouched, so committing never discards updates that
+        arrived during the overlap. Spilled (cold, host-side) tenants are not
+        part of the stacks and therefore not part of the snapshot — readmit
+        (or size capacity for) the tenants a window report must cover.
+        Cross-rank row folding requires every rank to seat the same tenant in
+        the same slot (a shard-by-tenant placement contract); "mean"-tagged
+        leaves are rejected because a rowwise mean cannot weight per-row
+        counts — keep sum+weight states (see ``MeanMetric``).
+
+        ``reset_window=True`` rotates the window: the frozen stacks keep the
+        current buffers (zero-copy), the live stacks restart from defaults,
+        and spilled tenants' host copies are dropped to defaults too (a
+        half-rotated fleet would readmit OLD-window state into the new
+        window) — the serving analogue of ``SlidingWindow``'s roll. With
+        ``reset_window=False`` the live stacks are re-buffered (one value
+        copy per stack) so the engine's donated dispatches cannot delete the
+        frozen buffers mid-gather.
+        """
+        from ..parallel.async_sync import AsyncSyncHandle
+
+        if any(fx == "mean" for fx in self._metric._reductions.values()):
+            raise TorchMetricsUserError(
+                "sync_async cannot fold bare 'mean'-reduced stacked states across ranks "
+                "without per-row counts; keep sum+weight states instead (see MeanMetric)."
+            )
+        self.flush()  # admitted-but-queued batches belong to THIS window
+        keys_list = list(self._classes)
+        if not keys_list:
+            return AsyncSyncHandle.noop(label="ServingEngine.sync_async")
+        states: List[StateDict] = []
+        reductions: List[Dict[str, Any]] = []
+        for key in keys_list:
+            cls = self._classes[key]
+            frozen = dict(cls.stacked)  # shallow: zero-copy freeze
+            if reset_window:
+                cls.stacked = self._fresh_stack()
+            else:
+                # live side re-buffered: the engine's donated megabatch
+                # dispatches must not delete the frozen buffers mid-gather
+                cls.stacked = {name: jnp.copy(v) for name, v in cls.stacked.items()}
+            states.append(frozen)
+            red = {name: self._metric._reductions.get(name) for name in self._defaults_t}
+            red[TENANT_COUNT_KEY] = "sum"  # per-row update counts sum across ranks
+            reductions.append(red)
+        if reset_window:
+            # the whole fleet rotates, spilled tenants included: their host
+            # copies are OLD-window state and must not readmit into the fresh one
+            for t in self._tenants.values():
+                if t.spilled is not None:
+                    t.spilled = None
+
+        def committer(synced: List[StateDict]) -> Dict[str, StateDict]:
+            return dict(zip(keys_list, synced))
+
+        return AsyncSyncHandle(
+            states, reductions, process_group=process_group, dist_sync_fn=dist_sync_fn,
+            committer=committer, label="ServingEngine.sync_async",
+        )
 
     # ----------------------------------------------------------- observability
 

@@ -1,0 +1,27 @@
+"""Streaming plane: windowed/decayed metrics over infinite streams.
+
+Forever-accumulating metrics answer epoch questions; monitoring traffic asks
+windowed ones. This package holds the O(1)-per-update stream transforms —
+
+- :class:`SlidingWindow` — the metric over exactly the last ``window``
+  updates (ring of bucket states, one donated roll+scatter XLA call per
+  update, no unbounded ``cat``);
+- :class:`ExponentialDecay` — the metric with exponentially discounted
+  history (decay folded into sum/count/mean leaves at update time);
+- :class:`DriftMonitor` — current-window vs. previous-block divergence,
+  wired into the SLO/alert engine (``drift(name)`` namespace entries,
+  breaches ride the ``alert`` event kind)
+
+— plus their sync-side counterpart,
+:class:`~torchmetrics_tpu.parallel.AsyncSyncHandle` (``parallel/``), the
+double-buffered background sync ``MetricCollection.sync(async_=True)`` and
+``ServingEngine.sync_async`` launch so the previous window's collective set
+overlaps the current window's updates.
+
+See ``docs/streaming.md``.
+"""
+
+from .drift import DriftMonitor
+from .window import ExponentialDecay, SlidingWindow
+
+__all__ = ["DriftMonitor", "ExponentialDecay", "SlidingWindow"]

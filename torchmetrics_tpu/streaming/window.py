@@ -1,0 +1,491 @@
+"""Windowed and decayed metric transforms over infinite streams.
+
+Every metric in this runtime accumulates forever: state is a sufficient
+statistic of the WHOLE stream, which is the right shape for an eval epoch and
+the wrong shape for monitoring traffic — "accuracy over the last 10k
+predictions" and "error rate with a 1-hour halflife" are windowed questions a
+forever-accumulator cannot answer without replaying history. The two
+transforms here answer them with O(1) work per update and bounded state,
+following the O(1)-state streaming-accumulator discipline of compiler-first
+caching stacks (arXiv:2603.09555):
+
+- :class:`SlidingWindow` — the metric over exactly the last ``window``
+  updates. The state is a RING of ``window`` bucket states (one stacked
+  device pytree, each bucket one update's isolated contribution); every
+  update is ONE donated XLA call (``Metric._get_wupdate_fn``) that scatters
+  the batch state into the next slot — no unbounded ``cat``, no per-update
+  host round-trip, no O(window) work until ``compute()`` folds the buckets
+  through the metric's own merge semantics.
+- :class:`ExponentialDecay` — the metric over the whole stream with
+  exponentially discounted history (``halflife`` in updates). No ring at
+  all: the decay factor folds into the sum/count/mean leaves AT UPDATE TIME
+  (``Metric._get_dupdate_fn``), so the state stays exactly one copy of the
+  metric's own state plus one weight scalar.
+
+Both dispatch through ``Metric._donation_safe_dispatch`` under their own tags
+(``wupdate`` / ``dupdate``), so the reliability retry/rollback plane, the
+telemetry counters/events/histograms, and the AOT warm-start cache apply to
+windowed traffic unchanged. The wrappers are stream-local by construction:
+``merge_state`` across ranks has no defined update order and raises (same
+contract as :class:`~torchmetrics_tpu.wrappers.Running`); fleet-wide windowed
+values come from syncing the window FOLD, or from the serving engine's
+stacked plane.
+
+See ``docs/streaming.md`` for the window semantics and the decay math.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import observability as _observability
+from ..metric import DECAY_WEIGHT_KEY, WINDOW_COUNT_KEY, WINDOW_CURSOR_KEY, HostMetric, Metric
+from ..observability import memory as _obs_memory
+from ..parallel import sync as _sync
+from ..utilities.exceptions import TorchMetricsUserError
+from ..utilities.prints import rank_zero_warn
+
+StateDict = Dict[str, Any]
+
+_RING_RESERVED = (WINDOW_CURSOR_KEY, WINDOW_COUNT_KEY)
+
+
+def _check_base(base: Metric, transform: str) -> None:
+    if not isinstance(base, Metric):
+        raise TorchMetricsUserError(
+            f"{transform} wraps a torchmetrics_tpu.Metric, got {type(base).__name__}"
+        )
+    if isinstance(base, HostMetric):
+        raise TorchMetricsUserError(
+            f"{transform} needs a jitted batch-state core; {type(base).__name__} computes its "
+            "batch state on host (text/detection/audio paths)."
+        )
+    if type(base)._batch_state is Metric._batch_state:
+        raise TorchMetricsUserError(
+            f"{type(base).__name__} has no pure _batch_state core to window "
+            "(compositions/wrappers: wrap the operands instead)."
+        )
+    if not base._enable_jit:
+        raise TorchMetricsUserError(f"{transform} requires a jit-enabled metric (jit=True).")
+
+
+def _mask_rows(mask: jax.Array, ndim: int) -> jax.Array:
+    """Broadcast a ``(B,)`` slot mask against ``(B, *state_shape)`` buckets."""
+    return mask.reshape(mask.shape + (1,) * (ndim - 1))
+
+
+class SlidingWindow(Metric):
+    """Metric value over exactly the last ``window`` updates of a stream.
+
+    Ring semantics: bucket ``i`` holds update ``i``'s isolated state
+    contribution; an update past the window overwrites the expired bucket in
+    place (one donated scatter — O(1) per update, O(window) state, zero
+    growth). ``compute()`` folds the live buckets through the metric's own
+    merge machinery, so the value is exactly what a fresh metric fed only the
+    trailing ``window`` batches would report (the window-parity oracle
+    ``tests/test_streaming.py`` pins across metric families).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.streaming import SlidingWindow
+        >>> from torchmetrics_tpu.aggregation import SumMetric
+        >>> metric = SlidingWindow(SumMetric(), window=2)
+        >>> for batch in [1.0, 2.0, 3.0]:
+        ...     metric.update(batch)
+        >>> float(metric.compute())
+        5.0
+    """
+
+    def __init__(self, base_metric: Metric, window: int) -> None:
+        super().__init__()
+        _check_base(base_metric, "SlidingWindow")
+        if not (isinstance(window, int) and window > 0):
+            raise ValueError(f"Expected argument `window` to be a positive integer but got {window}")
+        for name, fx in base_metric._reductions.items():
+            if fx == "cat" and name not in base_metric._list_state_names:
+                raise TorchMetricsUserError(
+                    f"{type(base_metric).__name__}.{name} is a 'cat'-reduced TENSOR state whose "
+                    "shape grows per update — it cannot live in a fixed ring; keep cat data in "
+                    "list states."
+                )
+        self.base_metric = base_metric
+        self.window = int(window)
+        self._ring: Optional[StateDict] = None  # lazy: built on first update
+        self._append_ring: List[Optional[Dict[str, list]]] = []
+
+    # ------------------------------------------------------------------ ring
+
+    def _init_ring(self) -> None:
+        base = self.base_metric
+        defaults_t, _ = base._split_tensor_list(base.init_state())
+        ring: StateDict = {
+            k: jnp.repeat(jnp.asarray(v)[None], self.window, axis=0)
+            for k, v in defaults_t.items()
+        }
+        ring[WINDOW_COUNT_KEY] = jnp.zeros((self.window,), jnp.float32)
+        ring[WINDOW_CURSOR_KEY] = jnp.zeros((), jnp.int32)
+        self._ring = ring
+        self._append_ring = [None] * self.window
+
+    def _slot_order(self) -> List[int]:
+        """Live slots, oldest update first (host mirror of the device cursor)."""
+        filled = min(self._update_count, self.window)
+        return [(self._update_count - filled + i) % self.window for i in range(filled)]
+
+    # ------------------------------------------------------------- lifecycle
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Roll this batch's contribution into the next ring slot (one
+        donated XLA call under the ``wupdate`` dispatch tag)."""
+        if self._is_synced:
+            raise TorchMetricsUserError(
+                "The Metric shouldn't be synced when performing ``update``. "
+                "HINT: Did you forget to call ``unsync`` ?"
+            )
+        base = self.base_metric
+        args, kwargs = base._prepare_inputs(*args, **kwargs)
+        if self._ring is None:
+            self._init_ring()
+        fn = base._get_wupdate_fn()
+        slot = self._update_count % self.window
+        new_ring, appends = base._donation_safe_dispatch(
+            "wupdate", lambda t, n: fn(t, n, *args, **kwargs), self._ring,
+            inputs=(args, kwargs), jitted=fn, owner=self._ring,
+        )
+        self._ring = new_ring
+        if base._list_state_names:
+            # bounded host-side ring of list ("cat") contributions: the slot's
+            # previous occupant expires with the overwrite, exactly like the
+            # device buckets — window memory never grows past `window` updates
+            self._append_ring[slot] = {k: [v] for k, v in appends.items()}
+        self._update_count += 1
+        self._computed = None
+        rec = _observability._ACTIVE
+        if rec is not None:
+            rec.record_window_roll(
+                base, self.window, min(self._update_count, self.window),
+                wrapped=self._update_count % self.window == 0,
+            )
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        """Roll the batch in AND return this batch's own value (the newest
+        bucket computed alone — no double update)."""
+        self.update(*args, **kwargs)
+        return self._bucket_value((self._update_count - 1) % self.window)
+
+    __call__ = forward
+
+    def _bucket_value(self, slot: int) -> Any:
+        base = self.base_metric
+        batch = dict(base.init_state())
+        for k, v in self._ring.items():
+            if k not in _RING_RESERVED:
+                batch[k] = v[slot]
+        if base._list_state_names:
+            bucket = self._append_ring[slot] or {}
+            for name in base._list_state_names:
+                batch[name] = list(bucket.get(name, []))
+        return base._compute(base._concat_state(batch))
+
+    # --------------------------------------------------------------- folding
+
+    def window_state(self) -> StateDict:
+        """The trailing window folded into one compute-ready state dict —
+        exactly the state a fresh metric fed the last ``window`` batches
+        would hold (list states stay host lists; ``_concat_state`` applies
+        downstream)."""
+        base = self.base_metric
+        defaults = base.init_state()
+        if self._ring is None:
+            return defaults
+        order = self._slot_order()
+        states = {k: v for k, v in self._ring.items() if k not in _RING_RESERVED}
+        out: StateDict = {}
+        if base._has_custom_merge():
+            # sequential fold through the metric's OWN merge, in stream order
+            # — bitwise the per-update fold a plain metric would have run
+            acc = {k: jnp.asarray(defaults[k]) for k in states}
+            for slot in order:
+                bucket = {k: v[slot] for k, v in states.items()}
+                merged = base._merge(dict(acc), bucket)
+                acc = {
+                    k: jnp.asarray(v).astype(states[k].dtype) if k in states else v
+                    for k, v in merged.items()
+                }
+            out.update(acc)
+        else:
+            mask = self._ring[WINDOW_COUNT_KEY] > 0
+            for k, v in states.items():
+                fx = base._reductions.get(k)
+                d = jnp.asarray(defaults[k])
+                if fx is None:
+                    out[k] = d  # fx=None keeps the local (default) value, as update does
+                elif callable(fx):
+                    acc = d
+                    for slot in order:
+                        acc = _sync.pairwise_merge(fx, acc, v[slot])
+                    out[k] = acc
+                elif fx == "sum":
+                    m = _mask_rows(mask, v.ndim)
+                    out[k] = (d + jnp.where(m, v, jnp.zeros_like(v)).sum(axis=0)).astype(v.dtype)
+                elif fx == "mean":
+                    m = _mask_rows(mask, v.ndim)
+                    n = mask.sum()
+                    mean = (v * m.astype(v.dtype)).sum(axis=0) / jnp.maximum(n, 1.0).astype(v.dtype)
+                    out[k] = jnp.where(n > 0, mean, d).astype(v.dtype)
+                elif fx == "max":
+                    out[k] = jnp.maximum(d, jnp.where(_mask_rows(mask, v.ndim), v, d).max(axis=0))
+                elif fx == "min":
+                    out[k] = jnp.minimum(d, jnp.where(_mask_rows(mask, v.ndim), v, d).min(axis=0))
+                else:  # pragma: no cover — construction rejects tensor "cat"
+                    raise TorchMetricsUserError(f"Unsupported reduction {fx!r} in a window fold")
+        for name in base._list_state_names:
+            rows: list = []
+            for slot in order:
+                bucket = self._append_ring[slot] or {}
+                rows.extend(bucket.get(name, []))
+            out[name] = rows
+        return out
+
+    def compute(self) -> Any:
+        if self._update_count == 0 and not self._update_called_warned:
+            rank_zero_warn(
+                f"The ``compute`` method of metric {type(self).__name__} was called before the "
+                "``update`` method which may lead to errors, as metric states have not yet been updated.",
+                UserWarning,
+            )
+            self._update_called_warned = True
+        if self.compute_with_cache and self._computed is not None:
+            return self._computed
+        base = self.base_metric
+        value = base._compute(base._concat_state(self.window_state()))
+        if self.compute_with_cache:
+            self._computed = value
+        return value
+
+    def reset(self) -> None:
+        self._ring = None
+        self._append_ring = []
+        self._update_count = 0
+        self._computed = None
+        self._is_synced = False
+        self._cache = None
+
+    # ------------------------------------------------------------- contracts
+
+    def merge_state(self, incoming_state: Any) -> None:
+        """A sliding window is a property of ONE update stream (same contract
+        as ``wrappers.Running``): merging two ranks' windows has no defined
+        update order, so this raises instead of silently interleaving."""
+        raise TorchMetricsUserError(
+            "SlidingWindow holds a stream-local window of the last updates; merging windows "
+            "across ranks has no defined update order. Sync the window FOLD instead: "
+            "compute per-rank, or feed window_state() into the sync planes."
+        )
+
+    def sync(self, dist_sync_fn: Any = None, process_group: Any = None,
+             should_sync: bool = True, distributed_available: Any = None) -> None:
+        """The wrapper's registered ``_state`` is EMPTY (the ring is the real
+        state), so the inherited sync would 'succeed' while shipping nothing
+        and then brick ``update()`` behind ``_is_synced`` — raise instead,
+        mirroring :meth:`merge_state` (no-op when nothing would sync, exactly
+        like ``Metric.sync``'s unavailable path)."""
+        is_dist = (distributed_available or self.distributed_available_fn)()
+        if not should_sync or not is_dist:
+            return
+        raise TorchMetricsUserError(
+            "SlidingWindow is stream-local and cannot cross-process sync; sync the window "
+            "FOLD instead (feed window_state() into the sync planes, or compute per-rank)."
+        )
+
+    def state_memory(self) -> Dict[str, Any]:
+        """Ring footprint (metadata only, zero D2H) — the bounded-by-window
+        invariant an operator checks instead of the cat-growth sentinel."""
+        return _obs_memory.state_memory(dict(self._ring or {}))
+
+    def _filter_kwargs(self, **kwargs: Any) -> Dict[str, Any]:
+        return self.base_metric._filter_kwargs(**kwargs)
+
+    def __repr__(self) -> str:
+        return f"SlidingWindow({self.base_metric!r}, window={self.window})"
+
+
+class ExponentialDecay(Metric):
+    """Metric over the whole stream with exponentially discounted history.
+
+    ``halflife`` is measured in UPDATES: a batch ``h`` updates old carries
+    half the weight of the current one (``decay = 2**(-1/halflife)``; pass
+    ``decay`` directly to pin the factor). State stays O(1): the factor folds
+    into the accumulating leaves at update time —
+
+    - ``sum`` leaves:   ``s_n = d * s_{n-1} + x_n``  (so ``s_n = Σ d^k x_{n-k}``),
+    - ``mean`` leaves:  weighted mean against the decayed update count
+      ``w_n = d * w_{n-1} + 1`` (so ratios like accuracy become the
+      exponentially weighted average of their batch values),
+    - ``max``/``min``/``None`` leaves keep their plain merge (an extremum
+      has no meaningful discount).
+
+    Integer sum/mean leaves are promoted to float32 at construction —
+    discounted counts are fractional by nature.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.streaming import ExponentialDecay
+        >>> from torchmetrics_tpu.aggregation import SumMetric
+        >>> metric = ExponentialDecay(SumMetric(), decay=0.5)
+        >>> for batch in [1.0, 1.0, 1.0]:
+        ...     metric.update(batch)
+        >>> float(metric.compute())
+        1.75
+    """
+
+    def __init__(
+        self,
+        base_metric: Metric,
+        halflife: Optional[float] = None,
+        decay: Optional[float] = None,
+    ) -> None:
+        super().__init__()
+        _check_base(base_metric, "ExponentialDecay")
+        if (halflife is None) == (decay is None):
+            raise ValueError("Pass exactly one of `halflife` (in updates) or `decay` (per-update factor).")
+        if halflife is not None:
+            if not halflife > 0:
+                raise ValueError(f"Expected `halflife` > 0, got {halflife}")
+            decay = float(2.0 ** (-1.0 / float(halflife)))
+        if not 0.0 < decay < 1.0:
+            raise ValueError(f"Expected `decay` in (0, 1), got {decay}")
+        if base_metric._list_state_names:
+            raise TorchMetricsUserError(
+                f"{type(base_metric).__name__} holds dynamic-length concat states; exponential "
+                "decay over an unbounded concatenation is undefined."
+            )
+        if base_metric._has_custom_merge():
+            raise TorchMetricsUserError(
+                f"{type(base_metric).__name__} overrides _merge; a decay factor cannot be "
+                "folded into an unknown merge safely."
+            )
+        for name, fx in base_metric._reductions.items():
+            if callable(fx) or fx == "cat":
+                raise TorchMetricsUserError(
+                    f"{type(base_metric).__name__}.{name} uses reduction {fx!r}, which has no "
+                    "defined exponential discount; only sum/mean/max/min/None states decay."
+                )
+        self.base_metric = base_metric
+        self.halflife = float(halflife) if halflife is not None else None
+        self.decay = float(decay)
+        self._dstate: Optional[StateDict] = None
+        self._decay_arr = None  # lazy device scalar (traced input, never donated)
+
+    def _init_dstate(self) -> None:
+        base = self.base_metric
+        defaults_t, _ = base._split_tensor_list(base.init_state())
+        st: StateDict = {}
+        for k, v in defaults_t.items():
+            v = jnp.asarray(v)
+            if base._reductions.get(k) in ("sum", "mean") and not jnp.issubdtype(v.dtype, jnp.floating):
+                v = v.astype(jnp.float32)  # discounted counts are fractional
+            st[k] = v
+        st[DECAY_WEIGHT_KEY] = jnp.zeros((), jnp.float32)
+        self._dstate = st
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Fold this batch in with the decay applied (one donated XLA call
+        under the ``dupdate`` dispatch tag)."""
+        if self._is_synced:
+            raise TorchMetricsUserError(
+                "The Metric shouldn't be synced when performing ``update``. "
+                "HINT: Did you forget to call ``unsync`` ?"
+            )
+        base = self.base_metric
+        args, kwargs = base._prepare_inputs(*args, **kwargs)
+        if self._dstate is None:
+            self._init_dstate()
+        if self._decay_arr is None:
+            self._decay_arr = jnp.asarray(np.float32(self.decay))
+        fn = base._get_dupdate_fn()
+        decay = self._decay_arr
+        self._dstate = base._donation_safe_dispatch(
+            "dupdate", lambda t, n: fn(t, n, decay, *args, **kwargs), self._dstate,
+            inputs=((decay,) + args, kwargs), jitted=fn, owner=self._dstate,
+        )
+        self._update_count += 1
+        self._computed = None
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        """Fold the batch in and return the post-update decayed value (the
+        streaming dashboard reading, not the batch-only value)."""
+        self.update(*args, **kwargs)
+        return self.compute()
+
+    __call__ = forward
+
+    def compute(self) -> Any:
+        if self._update_count == 0 and not self._update_called_warned:
+            rank_zero_warn(
+                f"The ``compute`` method of metric {type(self).__name__} was called before the "
+                "``update`` method which may lead to errors, as metric states have not yet been updated.",
+                UserWarning,
+            )
+            self._update_called_warned = True
+        if self.compute_with_cache and self._computed is not None:
+            return self._computed
+        base = self.base_metric
+        if self._dstate is None:
+            state = {k: v for k, v in base.init_state().items()}
+        else:
+            state = {k: v for k, v in self._dstate.items() if k != DECAY_WEIGHT_KEY}
+        value = base._compute(state)
+        if self.compute_with_cache:
+            self._computed = value
+        return value
+
+    @property
+    def decayed_count(self) -> Any:
+        """The discounted update count ``Σ d^k`` (device scalar; ``0.0``
+        before the first update) — the weight "mean" states fold against."""
+        if self._dstate is None:
+            return jnp.zeros((), jnp.float32)
+        return self._dstate[DECAY_WEIGHT_KEY]
+
+    def reset(self) -> None:
+        self._dstate = None
+        self._update_count = 0
+        self._computed = None
+        self._is_synced = False
+        self._cache = None
+
+    def merge_state(self, incoming_state: Any) -> None:
+        """Decayed state is a property of ONE update stream: folding two
+        ranks' discounted histories has no defined interleaving order."""
+        raise TorchMetricsUserError(
+            "ExponentialDecay holds a stream-local discounted history; merging across ranks "
+            "has no defined update order. Compute per-rank instead."
+        )
+
+    def sync(self, dist_sync_fn: Any = None, process_group: Any = None,
+             should_sync: bool = True, distributed_available: Any = None) -> None:
+        """See :meth:`SlidingWindow.sync` — the registered ``_state`` is
+        empty, so the inherited sync would ship nothing and trap updates."""
+        is_dist = (distributed_available or self.distributed_available_fn)()
+        if not should_sync or not is_dist:
+            return
+        raise TorchMetricsUserError(
+            "ExponentialDecay is stream-local and cannot cross-process sync; compute "
+            "per-rank instead."
+        )
+
+    def state_memory(self) -> Dict[str, Any]:
+        return _obs_memory.state_memory(dict(self._dstate or {}))
+
+    def _filter_kwargs(self, **kwargs: Any) -> Dict[str, Any]:
+        return self.base_metric._filter_kwargs(**kwargs)
+
+    def __repr__(self) -> str:
+        if self.halflife is not None:
+            return f"ExponentialDecay({self.base_metric!r}, halflife={self.halflife})"
+        return f"ExponentialDecay({self.base_metric!r}, decay={self.decay})"
