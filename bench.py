@@ -1211,6 +1211,16 @@ def main() -> None:
             extra[col] = results["ours"][col]
     extra["torch_cpu_proxy_updates_per_sec"] = baseline
     extra["vs_baseline_note"] = "torch-CPU proxy (no CUDA device in pod; BASELINE.md north star is vs CUDA GPU)"
+    # graftlint raw finding count (stdlib-only static pass — the bench parent
+    # never imports jax): informational bench_compare column, so the lint
+    # state of each round is tracked in the perf history
+    try:
+        from tools.graftlint.runner import run_checks as _graftlint_checks
+
+        _lint_findings, _ = _graftlint_checks(os.path.dirname(os.path.abspath(__file__)))
+        extra["lint_findings"] = len(_lint_findings)
+    except Exception as exc:  # a broken lint pass must not kill the bench round
+        extra["lint_findings_error"] = f"{type(exc).__name__}: {exc}"
     parsed = {
         "metric": "multiclass_accuracy_updates_per_sec",
         "value": ours,

@@ -1,0 +1,34 @@
+"""Fixture stand-in for torchmetrics_tpu.metric — parsed, never imported."""
+
+TENANT_COUNT_KEY = "__tenant_n"
+WINDOW_CURSOR_KEY = "__window_cursor"
+WINDOW_COUNT_KEY = "__window_n"
+DECAY_WEIGHT_KEY = "__decay_n"
+
+
+class Metric:
+    def add_state(self, name, default, dist_reduce_fx=None, persistent=False):
+        pass
+
+    def _batch_state(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def _merge(self, a, b):
+        return a
+
+    def _compute(self, state):
+        raise NotImplementedError
+
+    def _donation_safe_dispatch(self, tag, call, tensors, **kwargs):
+        return call(tensors, 0)
+
+    def _aot_program(self, tag):
+        if tag == "update":
+            return None, ()
+        elif tag == "forward":
+            return None, ()
+        raise ValueError(f"Unknown dispatch tag {tag!r}")
+
+
+class HostMetric(Metric):
+    pass

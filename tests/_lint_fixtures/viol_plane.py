@@ -1,0 +1,63 @@
+"""Admissibility fixtures: a concat-state metric and a bare-mean metric."""
+
+import numpy as np
+
+from .metric import Metric
+
+
+class ConcatStateMetric(Metric):
+    """Unconditional list ("cat") state: inadmissible to vupdate/dupdate."""
+
+    def __init__(self):
+        super().__init__()
+        self.add_state("values", default=[], dist_reduce_fx="cat")
+
+    def _batch_state(self, x):
+        return {"values": x}
+
+    def _compute(self, state):
+        return state["values"]
+
+
+class BareMeanMetric(Metric):
+    """Bare 'mean' state without a custom merge: no stateless in-graph fold."""
+
+    def __init__(self):
+        super().__init__()
+        self.add_state("avg", default=np.zeros(()), dist_reduce_fx="mean")
+
+    def _batch_state(self, x):
+        return {"avg": x}
+
+    def _compute(self, state):
+        return state["avg"]
+
+
+class CleanMetric(Metric):
+    """Sum state, jittable everywhere: admissible to every plane."""
+
+    def __init__(self):
+        super().__init__()
+        self.add_state("total", default=np.zeros(()), dist_reduce_fx="sum")
+
+    def _batch_state(self, x):
+        return {"total": x}
+
+    def _compute(self, state):
+        return state["total"]
+
+
+class HostSideMetric(Metric):
+    """Host compute path — excluded from vcompute."""
+
+    _jittable_compute = False
+
+    def __init__(self):
+        super().__init__()
+        self.add_state("total", default=np.zeros(()), dist_reduce_fx="sum")
+
+    def _batch_state(self, x):
+        return {"total": x}
+
+    def _compute(self, state):
+        return state["total"]

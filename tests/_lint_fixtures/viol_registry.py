@@ -1,0 +1,37 @@
+"""Reserved-key collision, dunder near-miss, and an unregistered dispatch tag."""
+
+import numpy as np
+
+from .metric import Metric
+
+
+class ReservedKeyMetric(Metric):
+    def __init__(self):
+        super().__init__()
+        # collides with the serving plane's per-row count leaf
+        self.add_state("__tenant_n", default=np.zeros(()), dist_reduce_fx="sum")
+        # dunder near-miss of the reserved namespace
+        self.add_state("__shadow", default=np.zeros(()), dist_reduce_fx="sum")
+
+    def _batch_state(self, x):
+        return {"__tenant_n": x}
+
+    def _compute(self, state):
+        return state["__tenant_n"]
+
+
+class RogueTagMetric(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("total", default=np.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, *args):
+        fn = lambda t, n: (t, n)  # noqa: E731
+        # "zupdate" is not registered in Metric._aot_program
+        self._donation_safe_dispatch("zupdate", fn, {})
+
+    def _batch_state(self, x):
+        return {"total": x}
+
+    def _compute(self, state):
+        return state["total"]

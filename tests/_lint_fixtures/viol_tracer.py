@@ -1,0 +1,23 @@
+"""One violation per tracer-hygiene rule, all inside a jit-reachable body."""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .metric import Metric
+
+
+class ItemLeak(Metric):
+    def __init__(self):
+        super().__init__()
+        self.add_state("total", default=np.zeros(()), dist_reduce_fx="sum")
+
+    def _batch_state(self, preds, target):
+        scale = 1.0
+        if preds > 0:  # tracer/py-branch: Python branch on a traced value
+            scale = float(jnp.max(preds))  # tracer/coercion
+        host = np.asarray(preds)  # tracer/numpy-call
+        return {"total": host.sum() * scale + target.item()}  # tracer/item
+
+    def _compute(self, state):
+        return state["total"]

@@ -1,0 +1,371 @@
+"""graftlint: golden-fixture coverage + real-tree cleanliness + drift gates.
+
+Marker ``lint``. The static tests are stdlib-only (the linter never imports
+the package under analysis); only the runtime cross-validation of the
+plane-admissibility matrix needs jax.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.lint
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES_DIR = os.path.join(REPO_ROOT, "tests", "_lint_fixtures")
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from tools.graftlint.admissibility import build_matrix  # noqa: E402
+from tools.graftlint.astindex import PackageIndex  # noqa: E402
+from tools.graftlint.baseline import (  # noqa: E402
+    load_baseline,
+    parse_baseline,
+    resolve_against_baseline,
+)
+from tools.graftlint.docgen import check_docs  # noqa: E402
+from tools.graftlint.layout import (  # noqa: E402
+    check_fleet_layout,
+    parse_int_assign,
+    parse_str_tuple,
+)
+from tools.graftlint.model import build_models  # noqa: E402
+from tools.graftlint.registry import check_registry  # noqa: E402
+from tools.graftlint.runner import build_index, run_checks  # noqa: E402
+from tools.graftlint.tracer import check_tracer_hygiene  # noqa: E402
+
+
+def _fixture_index() -> PackageIndex:
+    return PackageIndex(FIXTURES_DIR, "_lint_fixtures")
+
+
+def _read(relpath: str) -> str:
+    with open(os.path.join(REPO_ROOT, relpath), "r", encoding="utf-8") as fh:
+        return fh.read()
+
+
+def _ledger() -> dict:
+    return json.loads(_read("tools/graftlint/layout_ledger.json"))
+
+
+COUNTERS_SRC = _read("torchmetrics_tpu/observability/counters.py")
+HISTOGRAMS_SRC = _read("torchmetrics_tpu/observability/histograms.py")
+COALESCE_SRC = _read("torchmetrics_tpu/parallel/coalesce.py")
+EVENTS_SRC = _read("torchmetrics_tpu/observability/events.py")
+OBS_MD = _read("docs/observability.md")
+
+
+# --------------------------------------------------------------------- gate
+
+def test_repo_is_clean_against_baseline():
+    """THE tier-1 gate: the full pass over the real tree resolves clean
+    against the committed baseline (new findings / stale or unjustified
+    baseline entries all fail)."""
+    findings, _ = run_checks(REPO_ROOT)
+    entries, fmt_errors = load_baseline(
+        os.path.join(REPO_ROOT, "tools", "graftlint", "baseline.txt"))
+    assert not fmt_errors, fmt_errors
+    res = resolve_against_baseline(findings, entries)
+    msgs = [f.render() for f in res["new"]]
+    assert not res["new"], "new graftlint findings:\n" + "\n".join(msgs)
+    assert not res["stale"], f"stale baseline entries: {[e.fingerprint for e in res['stale']]}"
+    assert not res["unjustified"], (
+        f"unjustified baseline entries: {[e.fingerprint for e in res['unjustified']]}")
+
+
+def test_cli_check_exit_zero_on_repo():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", "--check"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_check_exit_nonzero_on_fixtures(tmp_path):
+    """Exit-code contract: each golden-fixture family makes --check fail."""
+    empty_baseline = tmp_path / "baseline.txt"
+    empty_baseline.write_text("")
+    for family in ("tracer", "registry"):
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.graftlint", "--check",
+             "--root", os.path.join(REPO_ROOT, "tests"),
+             "--package", "_lint_fixtures",
+             "--baseline", str(empty_baseline),
+             "--family", family],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=300)
+        assert proc.returncode == 1, (family, proc.stdout, proc.stderr)
+
+
+# ---------------------------------------------------------- tracer hygiene
+
+def test_tracer_fixture_fires_every_rule():
+    idx = _fixture_index()
+    findings = check_tracer_hygiene(idx, build_models(idx))
+    rules = {f.rule for f in findings if "viol_tracer" in f.path}
+    assert rules == {"tracer/item", "tracer/coercion", "tracer/numpy-call", "tracer/py-branch"}, (
+        sorted(f.render() for f in findings))
+    # and each anchors on the offending method
+    assert all(f.symbol == "ItemLeak._batch_state" for f in findings if "viol_tracer" in f.path)
+
+
+def test_tracer_clean_on_real_tree():
+    findings, _ = run_checks(REPO_ROOT, families=("tracer",))
+    tracer = [f for f in findings if f.rule.startswith("tracer/")]
+    assert tracer == [], "\n".join(f.render() for f in tracer)
+
+
+# ----------------------------------------------------------------- registry
+
+def test_registry_fixture_fires():
+    idx = _fixture_index()
+    findings = check_registry(idx)
+    rules = {f.rule for f in findings}
+    assert "registry/reserved-key" in rules
+    assert "registry/reserved-prefix" in rules
+    assert "registry/unregistered-tag" in rules
+    byrule = {f.rule: f for f in findings}
+    assert byrule["registry/reserved-key"].detail == "__tenant_n"
+    assert byrule["registry/unregistered-tag"].detail == "zupdate"
+
+
+def test_registry_clean_on_real_tree():
+    idx = build_index(REPO_ROOT)
+    findings = check_registry(idx)
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_registered_tags_match_runtime_set():
+    """The statically parsed tag registry is exactly the six runtime planes."""
+    from tools.graftlint.registry import registered_tags, reserved_keys
+    idx = build_index(REPO_ROOT)
+    assert registered_tags(idx) == {"update", "forward", "vupdate", "wupdate", "dupdate", "vcompute"}
+    assert reserved_keys(idx) == {"__tenant_n", "__window_cursor", "__window_n", "__decay_n"}
+
+
+# ------------------------------------------------------------- fleet layout
+
+def test_layout_clean_on_real_tree():
+    findings = check_fleet_layout(
+        COUNTERS_SRC, HISTOGRAMS_SRC, COALESCE_SRC, EVENTS_SRC, _ledger(), OBS_MD)
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_counter_growth_without_version_bump_is_caught():
+    """THE acceptance scenario: mutate a copy of COUNTER_FIELDS, keep
+    _VERSION — the drift check must fire."""
+    mutated = COUNTERS_SRC.replace(
+        '"serve_rejected",', '"serve_rejected",\n    "graftlint_probe_counter",')
+    assert mutated != COUNTERS_SRC
+    findings = check_fleet_layout(
+        mutated, HISTOGRAMS_SRC, COALESCE_SRC, EVENTS_SRC, _ledger(), OBS_MD)
+    assert any(f.rule == "layout/counter-drift" for f in findings), (
+        [f.rule for f in findings])
+
+
+def test_histogram_growth_without_version_bump_is_caught():
+    mutated = HISTOGRAMS_SRC.replace(
+        '"gather_bytes",', '"gather_bytes",\n    "graftlint_probe_kind",')
+    assert mutated != HISTOGRAMS_SRC
+    findings = check_fleet_layout(
+        COUNTERS_SRC, mutated, COALESCE_SRC, EVENTS_SRC, _ledger(), OBS_MD)
+    assert any(f.rule == "layout/hist-drift" for f in findings)
+
+
+def test_version_bump_without_ledger_is_caught():
+    led = _ledger()
+    version = parse_int_assign(COALESCE_SRC, "_VERSION")
+    mutated = COALESCE_SRC.replace(f"_VERSION = {version}", f"_VERSION = {version + 1}", 1)
+    assert mutated != COALESCE_SRC
+    findings = check_fleet_layout(
+        COUNTERS_SRC, HISTOGRAMS_SRC, mutated, EVENTS_SRC, led, OBS_MD)
+    assert any(f.rule == "layout/ledger-stale" for f in findings)
+
+
+def test_undocumented_counter_is_caught():
+    """Doc-drift: a counter missing from docs/observability.md fails."""
+    led = _ledger()
+    led["counter_fields"] = led["counter_fields"] + ["graftlint_probe_counter"]
+    mutated = COUNTERS_SRC.replace(
+        '"serve_rejected",', '"serve_rejected",\n    "graftlint_probe_counter",')
+    findings = check_fleet_layout(
+        mutated, HISTOGRAMS_SRC, COALESCE_SRC, EVENTS_SRC, led, OBS_MD)
+    assert any(f.rule == "layout/doc-counter" and f.detail == "graftlint_probe_counter"
+               for f in findings)
+
+
+def test_ledger_matches_sources_exactly():
+    led = _ledger()
+    assert led["counter_fields"] == parse_str_tuple(COUNTERS_SRC, "COUNTER_FIELDS")
+    assert led["histogram_kinds"] == parse_str_tuple(HISTOGRAMS_SRC, "FLEET_HISTOGRAM_KINDS")
+    assert led["version"] == parse_int_assign(COALESCE_SRC, "_VERSION")
+
+
+# ------------------------------------------------------------ admissibility
+
+def test_fixture_admissibility_rows():
+    idx = _fixture_index()
+    matrix = build_matrix(build_models(idx))
+    rows = matrix["metrics"]
+    cat = rows["_lint_fixtures.viol_plane.ConcatStateMetric"]["planes"]
+    assert cat["vupdate"] == "no" and cat["dupdate"] == "no" and cat["ingraph"] == "no"
+    # a LIST cat state rides SlidingWindow's bounded host ring
+    assert cat["wupdate"] == "yes"
+    mean = rows["_lint_fixtures.viol_plane.BareMeanMetric"]["planes"]
+    assert mean["ingraph"] == "no" and mean["vupdate"] == "yes"
+    clean = rows["_lint_fixtures.viol_plane.CleanMetric"]["planes"]
+    assert set(clean.values()) == {"yes"}
+    host = rows["_lint_fixtures.viol_plane.HostSideMetric"]["planes"]
+    assert host["vcompute"] == "no" and host["vupdate"] == "yes"
+
+
+def test_docs_matrix_tables_in_sync():
+    _, matrix = run_checks(REPO_ROOT, families=("registry",))  # cheap family; matrix always built
+    findings = check_docs(matrix, REPO_ROOT)
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_matrix_covers_known_classes():
+    _, matrix = run_checks(REPO_ROOT, families=("registry",))
+    rows = matrix["metrics"]
+    for cls in (
+        "torchmetrics_tpu.aggregation.MeanMetric",
+        "torchmetrics_tpu.classification.accuracy.MulticlassAccuracy",
+        "torchmetrics_tpu.classification.confusion_matrix.MulticlassConfusionMatrix",
+        "torchmetrics_tpu.regression.pearson.PearsonCorrCoef",
+    ):
+        assert cls in rows, f"{cls} missing from the admissibility matrix"
+    # wrappers/framework bases are excluded, not misclassified
+    assert "torchmetrics_tpu.wrappers.running.Running" in matrix["excluded_abstract_or_wrapper"]
+
+
+def test_matrix_runtime_cross_validation():
+    """The static verdicts agree with the real runtime guards on a sample."""
+    pytest.importorskip("jax")
+    from torchmetrics_tpu.aggregation import MeanMetric
+    from torchmetrics_tpu.classification import BinaryAUROC, MulticlassConfusionMatrix
+    from torchmetrics_tpu.regression import PearsonCorrCoef
+    from torchmetrics_tpu.streaming import ExponentialDecay
+    from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
+
+    _, matrix = run_checks(REPO_ROOT, families=("registry",))
+    rows = matrix["metrics"]
+
+    # vupdate yes -> the stacked program materializes
+    assert rows["torchmetrics_tpu.aggregation.MeanMetric"]["planes"]["vupdate"] == "yes"
+    MeanMetric()._get_vupdate_fn()
+    assert rows["torchmetrics_tpu.classification.confusion_matrix.MulticlassConfusionMatrix"][
+        "planes"]["vupdate"] == "yes"
+    MulticlassConfusionMatrix(num_classes=3)._get_vupdate_fn()
+
+    # dupdate no (custom _merge) -> ExponentialDecay rejects at construction
+    assert rows["torchmetrics_tpu.regression.pearson.PearsonCorrCoef"]["planes"]["dupdate"] == "no"
+    with pytest.raises(TorchMetricsUserError):
+        ExponentialDecay(PearsonCorrCoef(), decay=0.5)
+    # dupdate yes -> accepted
+    assert rows["torchmetrics_tpu.aggregation.MeanMetric"]["planes"]["dupdate"] == "yes"
+    ExponentialDecay(MeanMetric(), decay=0.5)
+
+    # "?" = config-conditional: BOTH runtime outcomes are reachable
+    assert rows["torchmetrics_tpu.classification.auroc.BinaryAUROC"]["planes"]["vupdate"] == "?"
+    with pytest.raises(TorchMetricsUserError):
+        BinaryAUROC()._get_vupdate_fn()  # thresholds=None -> cat list state
+    BinaryAUROC(thresholds=16)._get_vupdate_fn()  # binned -> static state
+
+
+def test_matrix_runtime_cross_validation_host_metric():
+    pytest.importorskip("jax")
+    from torchmetrics_tpu.aggregation import SumMetric
+    from torchmetrics_tpu.streaming import SlidingWindow
+    from torchmetrics_tpu.text import ROUGEScore
+    from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
+
+    _, matrix = run_checks(REPO_ROOT, families=("registry",))
+    rows = matrix["metrics"]
+    assert rows["torchmetrics_tpu.text.metrics.ROUGEScore"]["planes"]["wupdate"] == "no"
+    with pytest.raises(TorchMetricsUserError):
+        SlidingWindow(ROUGEScore(), window=4)
+    assert rows["torchmetrics_tpu.aggregation.SumMetric"]["planes"]["wupdate"] == "yes"
+    SlidingWindow(SumMetric(), window=4)
+
+
+# ----------------------------------------------------------------- baseline
+
+def test_baseline_mechanics(tmp_path):
+    from tools.graftlint.core import Finding
+    f1 = Finding("tracer/item", "pkg/a.py", "Cls._batch_state", "item()", "msg", 10)
+    f2 = Finding("tracer/item", "pkg/b.py", "Cls2._batch_state", "item()", "msg", 20)
+    entries, errors = parse_baseline(
+        f"{f1.fingerprint}  # validated eager-only path\n"
+        f"{f2.fingerprint}  # TODO: justify\n"
+        "tracer/item|gone.py|X.y|item()  # fixed long ago\n"
+        "malformed-line-without-pipes  # nope\n")
+    assert len(errors) == 1  # the malformed line
+    res = resolve_against_baseline([f1, f2], entries)
+    assert res["new"] == []
+    assert len(res["baselined"]) == 2
+    assert [e.fingerprint for e in res["stale"]] == ["tracer/item|gone.py|X.y|item()"]
+    assert [e.fingerprint for e in res["unjustified"]] == [f2.fingerprint]
+
+
+def test_family_subset_does_not_mark_other_families_stale(tmp_path):
+    """--family runs must only resolve the selected families' baseline
+    entries — an unselected family's live suppression is not 'stale'."""
+    baseline = tmp_path / "baseline.txt"
+    # a justified tracer entry matching the fixture violation, which the
+    # layout-only run does NOT produce findings for
+    baseline.write_text(
+        "tracer/item|_lint_fixtures/viol_tracer.py|ItemLeak._batch_state|item()"
+        "  # documented fixture violation\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint", "--check",
+         "--root", os.path.join(REPO_ROOT, "tests"),
+         "--package", "_lint_fixtures",
+         "--baseline", str(baseline),
+         "--family", "plane"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=300)
+    assert "[baseline/stale]" not in proc.stdout, proc.stdout
+    # and the tracer-family run still honors (and consumes) the entry
+    proc2 = subprocess.run(
+        [sys.executable, "-m", "tools.graftlint",
+         "--root", os.path.join(REPO_ROOT, "tests"),
+         "--package", "_lint_fixtures",
+         "--baseline", str(baseline),
+         "--family", "tracer"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=300)
+    assert "1 baselined" in proc2.stdout and "0 stale" in proc2.stdout, proc2.stdout
+
+
+def test_group_range_validation_rejects_id_equal_to_num_groups():
+    """Group ids are 0..num_groups-1: id == num_groups must raise (eagerly)."""
+    pytest.importorskip("jax")
+    import jax.numpy as jnp
+    from torchmetrics_tpu.functional.classification.group_fairness import _groups_validation
+    with pytest.raises(ValueError):
+        _groups_validation(jnp.asarray([0, 1, 2]), num_groups=2)
+    _groups_validation(jnp.asarray([0, 1]), num_groups=2)  # in range: fine
+
+
+def test_fingerprint_excludes_line_numbers():
+    from tools.graftlint.core import Finding
+    a = Finding("r", "p.py", "S.m", "d", "msg", 1)
+    b = Finding("r", "p.py", "S.m", "d", "other msg", 999)
+    assert a.fingerprint == b.fingerprint
+
+
+# ------------------------------------------------------- bench integration
+
+def test_bench_compare_lint_findings_is_informational():
+    """The lint_findings column is tracked but never gated (a lint-count
+    move is not a perf regression)."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "bench_compare_for_lint", os.path.join(REPO_ROOT, "tools", "bench_compare.py"))
+    bc = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bc)
+    assert bc.direction("extra.lint_findings") is None
+    rows = bc.compare_metrics({"extra.lint_findings": 0.0}, {"extra.lint_findings": 25.0})
+    assert rows[0]["verdict"] == "info"

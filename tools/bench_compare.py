@@ -139,7 +139,11 @@ _INFO_EXACT = ("leaves_coalesced_per_sync", "per_leaf_collectives", "ttfu_precom
                # gather latencies are quotients of two noisy measurements —
                # the throughput and overlap columns gate the same regressions
                "window_overhead_pct", "async_commit_wait_ms", "async_gather_ms",
-               "async_overlap_updates", "window_rolls")
+               "async_overlap_updates", "window_rolls",
+               # graftlint raw finding count: tracked across rounds so lint
+               # state is visible in the perf history, but a lint move is not
+               # a perf regression — the tier-1 pytest gate owns enforcement
+               "lint_findings")
 
 
 def direction(name: str) -> Optional[str]:

@@ -1,0 +1,46 @@
+"""graftlint — whole-codebase plane-contract checker + tracer-hygiene linter.
+
+Stdlib-only (like ``tools/bench_compare.py``): parses ``torchmetrics_tpu/``
+with :mod:`ast` and never imports jax or the package under analysis, so it
+runs on bare CI runners, laptops, and the bench parent process.
+
+Four check families (see ``docs/static_analysis.md``):
+
+- **tracer hygiene** — ``.item()``/``.tolist()``, ``float()/int()/bool()``
+  coercions, ``np.*`` calls, ``jax.device_get`` and Python ``if``/``while``
+  branching on traced values inside jit-reachable bodies (``_batch_state`` /
+  ``_merge`` / ``_compute``, the ``_get_*_fn`` dispatch programs, and
+  everything transitively reachable from them).
+- **fleet layout** — ``COUNTER_FIELDS`` / ``FLEET_HISTOGRAM_KINDS`` /
+  ``parallel.coalesce._VERSION`` drift against the committed
+  ``layout_ledger.json``, plus doc drift: every counter field, event kind and
+  histogram kind must be named in ``docs/observability.md``.
+- **plane admissibility** — a machine-readable matrix of which dispatch
+  planes (``vupdate``/``wupdate``/``dupdate``/``vcompute``, tenant sharding,
+  in-graph) each Metric subclass can legally enter, derived from its
+  ``add_state`` declarations; the generated tables in ``docs/serving.md`` /
+  ``docs/streaming.md`` must stay in sync.
+- **reserved-key & tag registry** — no metric state may collide with the
+  reserved leaves in ``metric.py``; every tag passed to
+  ``_donation_safe_dispatch`` must be registered in ``_aot_program``.
+
+Findings resolve against ``tools/graftlint/baseline.txt`` — new violations
+fail ``--check``, documented false positives (every entry carries a
+justification) don't.
+"""
+
+from __future__ import annotations
+
+from .core import Finding, RULE_FAMILIES, repo_root_from  # noqa: F401
+from .runner import run_checks  # noqa: F401
+from .baseline import load_baseline, resolve_against_baseline, format_baseline  # noqa: F401
+
+__all__ = [
+    "Finding",
+    "RULE_FAMILIES",
+    "run_checks",
+    "load_baseline",
+    "resolve_against_baseline",
+    "format_baseline",
+    "repo_root_from",
+]

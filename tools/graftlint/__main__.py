@@ -1,0 +1,13 @@
+"""``python -m tools.graftlint`` entry point (run from the repo root)."""
+
+import sys
+
+from .cli import main
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:
+        # `... | head` is a legitimate way to read a report, but a truncated
+        # run must never masquerade as a clean gate — distinct nonzero code
+        sys.exit(120)

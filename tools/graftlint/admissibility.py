@@ -1,0 +1,158 @@
+"""Plane-admissibility matrix: which dispatch planes each metric can enter.
+
+The verdict per (metric, plane) is ``yes`` / ``no`` / ``?`` (statically
+undecidable — dynamic state declarations or a config-dependent flag), with
+machine-readable reasons. The rules mirror the runtime guards exactly:
+
+- ``vupdate`` (serving megabatch) / tenant sharding — ``_get_vupdate_fn`` and
+  ``ServingEngine.__init__`` reject concat (list) states and host metrics;
+  wrappers without a pure ``_batch_state`` core cannot be stacked.
+- ``vcompute`` (vmapped ``compute_all``) — additionally needs a jittable
+  ``_compute`` (``_jittable_compute``).
+- ``wupdate`` (:class:`SlidingWindow`) — rejects host metrics, missing
+  ``_batch_state``, and 'cat'-reduced TENSOR states (growing shapes cannot
+  live in a fixed ring); list-typed cat states ride the bounded host ring.
+- ``dupdate`` (:class:`ExponentialDecay`) — additionally rejects list
+  states, custom ``_merge``, and cat/callable reductions (an unknown fold
+  cannot be discounted safely).
+- ``ingraph`` (``update_state`` under user jit) — rejects list states and
+  bare 'mean' states without a custom merge (the stateless fold would
+  diverge from the exact running mean).
+
+The serialized matrix is the contract ``docs/serving.md`` /
+``docs/streaming.md`` tables are generated from, and
+``tests/test_static_analysis.py`` cross-validates a sample against the real
+runtime guards.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from .model import MetricModel
+
+PLANES = ("vupdate", "vcompute", "wupdate", "dupdate", "tenant_sharding", "ingraph")
+
+YES, NO, MAYBE = "yes", "no", "?"
+
+
+def _tri(cond: Optional[bool]) -> str:
+    if cond is True:
+        return YES
+    if cond is False:
+        return NO
+    return MAYBE
+
+
+def _merge_verdicts(*parts: Tuple[str, Optional[str]]) -> Tuple[str, List[str]]:
+    """AND over tri-state conditions; reasons collected for no/maybe."""
+    verdict = YES
+    reasons: List[str] = []
+    for v, reason in parts:
+        if v == NO:
+            if verdict != NO:
+                reasons = []
+            verdict = NO
+            if reason and reason not in reasons:
+                reasons.append(reason)
+        elif v == MAYBE and verdict == YES:
+            verdict = MAYBE
+            if reason:
+                reasons.append(reason)
+        elif v == MAYBE and verdict == MAYBE and reason and reason not in reasons:
+            reasons.append(reason)
+    return verdict, reasons
+
+
+def admissibility(model: MetricModel) -> Dict[str, Any]:
+    """The per-class row of the matrix."""
+    host = (_tri(not model.is_host), "host-side batch state (HostMetric)" if model.is_host else None)
+    core = (
+        _tri(model.has_batch_state),
+        None if model.has_batch_state else "no pure _batch_state core (wrapper/composition)",
+    )
+
+    unk = ("dynamic state declarations" if model.dynamic_states
+           else "config-conditional states (depends on construction args)")
+    lists = model.has_list_state()
+    no_lists = (
+        _tri(None if lists is None else not lists),
+        "concat (list) state" if lists else (unk if lists is None else None),
+    )
+    cat_tensor = model.has_cat_tensor_state()
+    no_cat_tensor = (
+        _tri(None if cat_tensor is None else not cat_tensor),
+        "'cat'-reduced tensor state (growing shape)" if cat_tensor
+        else (unk if cat_tensor is None else None),
+    )
+    jittable = model.jittable_compute
+    jit_compute = (
+        YES if jittable is True else (NO if jittable is False else MAYBE),
+        None if jittable is True else (
+            "host-side _compute (_jittable_compute=False)" if jittable is False
+            else "config-dependent _jittable_compute"
+        ),
+    )
+    merge_ok = (_tri(not model.custom_merge), "custom _merge override" if model.custom_merge else None)
+    undecayable = model.has_undecayable_reduction()
+    decayable = (
+        _tri(None if undecayable is None else not undecayable),
+        "cat/callable reduction (no defined discount)" if undecayable
+        else (unk if undecayable is None else None),
+    )
+    bare_mean = model.has_bare_mean_state()
+    ingraph_mean = (
+        YES if (model.custom_merge or bare_mean is False) else (NO if bare_mean else MAYBE),
+        None if (model.custom_merge or bare_mean is False) else (
+            "bare 'mean' state cannot fold statelessly" if bare_mean else unk
+        ),
+    )
+
+    rows: Dict[str, Any] = {}
+    v_vup = _merge_verdicts(host, core, no_lists)
+    rows["vupdate"] = v_vup
+    rows["tenant_sharding"] = v_vup  # sharding applies to the same stacked plane
+    rows["vcompute"] = _merge_verdicts(host, core, no_lists, jit_compute)
+    rows["wupdate"] = _merge_verdicts(host, core, no_cat_tensor)
+    rows["dupdate"] = _merge_verdicts(host, core, no_lists, merge_ok, decayable)
+    rows["ingraph"] = _merge_verdicts(no_lists, ingraph_mean)
+
+    return {
+        "class": model.qualname,
+        "module": model.cls.module.modname,
+        "planes": {p: rows[p][0] for p in PLANES},
+        "reasons": {p: rows[p][1] for p in PLANES if rows[p][1]},
+        "states": [
+            {"name": s.name, "list": s.is_list, "fx": s.fx, "conditional": s.conditional}
+            for s in model.states
+        ],
+        "flags": {
+            "host": model.is_host,
+            "custom_merge": model.custom_merge,
+            "jittable_compute": model.jittable_compute,
+            "dynamic_states": model.dynamic_states,
+        },
+    }
+
+
+def build_matrix(models: Dict[str, MetricModel]) -> Dict[str, Any]:
+    """Machine-readable matrix over all *concrete* metric classes, plus the
+    abstract/wrapper classes listed separately (excluded from plane rows)."""
+    concrete: Dict[str, Any] = {}
+    excluded: List[str] = []
+    for qual in sorted(models):
+        m = models[qual]
+        if m.concrete:
+            concrete[qual] = admissibility(m)
+        else:
+            excluded.append(qual)
+    totals = {p: {YES: 0, NO: 0, MAYBE: 0} for p in PLANES}
+    for row in concrete.values():
+        for p in PLANES:
+            totals[p][row["planes"][p]] += 1
+    return {
+        "planes": list(PLANES),
+        "metrics": concrete,
+        "excluded_abstract_or_wrapper": excluded,
+        "totals": totals,
+    }

@@ -1,0 +1,270 @@
+"""Whole-package AST index: modules, imports, classes, functions, bases.
+
+Pure :mod:`ast` — nothing under analysis is ever imported. Resolution is
+name-based and best-effort: a linter wants high recall with a baseline escape
+hatch, not a type checker's soundness. Unresolvable bases named ``Metric`` /
+``HostMetric`` are treated as the roots (this is what makes the golden
+fixtures — small files that *mention* the package without shipping it —
+analyzable with the same code paths as the real tree).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterable, List, Optional, Tuple
+
+METRIC_ROOT = "Metric"
+HOST_ROOT = "HostMetric"
+
+
+class FunctionInfo:
+    __slots__ = ("name", "qualname", "node", "module", "class_name")
+
+    def __init__(self, name: str, qualname: str, node: ast.AST, module: "ModuleInfo",
+                 class_name: Optional[str] = None) -> None:
+        self.name = name
+        self.qualname = qualname
+        self.node = node
+        self.module = module
+        self.class_name = class_name
+
+
+class ClassInfo:
+    __slots__ = ("name", "module", "node", "base_exprs", "methods", "class_attrs")
+
+    def __init__(self, name: str, module: "ModuleInfo", node: ast.ClassDef) -> None:
+        self.name = name
+        self.module = module
+        self.node = node
+        self.base_exprs: List[str] = [_dotted(b) for b in node.bases]
+        self.methods: Dict[str, FunctionInfo] = {}
+        self.class_attrs: Dict[str, ast.AST] = {}
+        for stmt in node.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.methods[stmt.name] = FunctionInfo(
+                    stmt.name, f"{name}.{stmt.name}", stmt, module, class_name=name)
+            elif isinstance(stmt, ast.Assign):
+                for tgt in stmt.targets:
+                    if isinstance(tgt, ast.Name):
+                        self.class_attrs[tgt.id] = stmt.value
+            elif isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target, ast.Name):
+                if stmt.value is not None:
+                    self.class_attrs[stmt.target.id] = stmt.value
+
+    @property
+    def qualname(self) -> str:
+        return f"{self.module.modname}.{self.name}"
+
+
+class ModuleInfo:
+    __slots__ = ("relpath", "modname", "tree", "imports", "import_modules",
+                 "classes", "functions")
+
+    def __init__(self, relpath: str, modname: str, tree: ast.Module) -> None:
+        self.relpath = relpath
+        self.modname = modname
+        self.tree = tree
+        #: local name -> fully dotted origin ("numpy", "torchmetrics_tpu.metric.Metric", ...)
+        self.imports: Dict[str, str] = {}
+        #: local name -> dotted module (for `import x.y as z` / `from . import sync`)
+        self.import_modules: Dict[str, str] = {}
+        self.classes: Dict[str, ClassInfo] = {}
+        self.functions: Dict[str, FunctionInfo] = {}
+        self._scan()
+
+    def _scan(self) -> None:
+        pkg_parts = self.modname.split(".")
+        for node in self.tree.body:
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    local = alias.asname or alias.name.split(".")[0]
+                    self.import_modules[local] = alias.name if alias.asname else alias.name.split(".")[0]
+                    self.imports[local] = alias.name
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    # relative import: resolve against this module's package
+                    base = pkg_parts[: len(pkg_parts) - node.level]
+                    origin = ".".join(base + ([node.module] if node.module else []))
+                else:
+                    origin = node.module or ""
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    local = alias.asname or alias.name
+                    self.imports[local] = f"{origin}.{alias.name}" if origin else alias.name
+                    self.import_modules.setdefault(local, f"{origin}.{alias.name}" if origin else alias.name)
+            elif isinstance(node, ast.ClassDef):
+                self.classes[node.name] = ClassInfo(node.name, self, node)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions[node.name] = FunctionInfo(node.name, node.name, node, self)
+
+
+def _dotted(node: ast.AST) -> str:
+    """Best-effort dotted-name rendering of a base-class/callee expression."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return f"{_dotted(node.value)}.{node.attr}"
+    if isinstance(node, ast.Subscript):  # Generic[...] bases
+        return _dotted(node.value)
+    if isinstance(node, ast.Call):
+        return _dotted(node.func)
+    return ""
+
+
+class PackageIndex:
+    """Index of one python package directory (non-importing)."""
+
+    def __init__(self, package_dir: str, package_name: Optional[str] = None) -> None:
+        self.package_dir = os.path.abspath(package_dir)
+        self.package_name = package_name or os.path.basename(self.package_dir.rstrip(os.sep))
+        self.modules: Dict[str, ModuleInfo] = {}
+        self.classes_by_name: Dict[str, List[ClassInfo]] = {}
+        self.errors: List[Tuple[str, str]] = []  # (relpath, error)
+        self._mro_cache: Dict[str, List[ClassInfo]] = {}
+        self._load()
+
+    # ------------------------------------------------------------------ load
+    def _load(self) -> None:
+        root = self.package_dir
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+            for fname in sorted(filenames):
+                if not fname.endswith(".py"):
+                    continue
+                full = os.path.join(dirpath, fname)
+                relpath = os.path.relpath(full, os.path.dirname(root)).replace(os.sep, "/")
+                modname = relpath[:-3].replace("/", ".")
+                if modname.endswith(".__init__"):
+                    modname = modname[: -len(".__init__")]
+                try:
+                    with open(full, "r", encoding="utf-8") as fh:
+                        tree = ast.parse(fh.read(), filename=relpath)
+                except (SyntaxError, OSError) as exc:  # surfaced, never fatal
+                    self.errors.append((relpath, f"{type(exc).__name__}: {exc}"))
+                    continue
+                mod = ModuleInfo(relpath, modname, tree)
+                self.modules[modname] = mod
+                for cls in mod.classes.values():
+                    self.classes_by_name.setdefault(cls.name, []).append(cls)
+
+    # ------------------------------------------------------------ resolution
+    def resolve_class(self, name: str, from_module: Optional[ModuleInfo]) -> Optional[ClassInfo]:
+        """Resolve a (possibly dotted) class-name expression to a ClassInfo."""
+        if not name:
+            return None
+        head, _, rest = name.partition(".")
+        if from_module is not None:
+            if rest:
+                # mod.Cls — resolve the module alias then the class inside it
+                target_mod = from_module.import_modules.get(head)
+                if target_mod:
+                    mod = self.modules.get(target_mod) or self.modules.get(f"{target_mod}.{rest.rsplit('.', 1)[0]}")
+                    cls_name = rest.rsplit(".", 1)[-1]
+                    if mod and cls_name in mod.classes:
+                        return mod.classes[cls_name]
+                    # alias points at a class imported under a dotted path
+                    origin = from_module.imports.get(head)
+                    if origin:
+                        found = self._class_at(f"{origin}.{rest}")
+                        if found:
+                            return found
+            else:
+                if head in from_module.classes:
+                    return from_module.classes[head]
+                origin = from_module.imports.get(head)
+                if origin:
+                    found = self._class_at(origin)
+                    if found:
+                        return found
+        simple = name.rsplit(".", 1)[-1]
+        cands = self.classes_by_name.get(simple) or []
+        if cands:
+            return cands[0]  # ambiguous: best-effort first (stable sorted load order)
+        return None
+
+    def _class_at(self, dotted: str) -> Optional[ClassInfo]:
+        modname, _, cls = dotted.rpartition(".")
+        mod = self.modules.get(modname)
+        if mod and cls in mod.classes:
+            return mod.classes[cls]
+        # re-exported through a package __init__: chase one alias hop
+        if mod is None and modname:
+            pkg = self.modules.get(modname.rsplit(".", 1)[0]) if "." in modname else None
+            if pkg:
+                origin = pkg.imports.get(cls)
+                if origin and origin != dotted:
+                    return self._class_at(origin)
+        if mod and cls in mod.imports and mod.imports[cls] != dotted:
+            return self._class_at(mod.imports[cls])
+        return None
+
+    # ------------------------------------------------------------------- mro
+    def linearize(self, cls: ClassInfo) -> List[ClassInfo]:
+        """Depth-first, deduped base chain (single inheritance dominates this
+        codebase; a full C3 adds nothing a linter needs)."""
+        key = cls.qualname
+        if key in self._mro_cache:
+            return self._mro_cache[key]
+        self._mro_cache[key] = [cls]  # cycle guard
+        out: List[ClassInfo] = [cls]
+        seen = {cls.qualname}
+        for base_expr in cls.base_exprs:
+            base = self.resolve_class(base_expr, cls.module)
+            if base is None or base.qualname in seen:
+                continue
+            for anc in self.linearize(base):
+                if anc.qualname not in seen:
+                    seen.add(anc.qualname)
+                    out.append(anc)
+        self._mro_cache[key] = out
+        return out
+
+    def _root_names(self, cls: ClassInfo) -> set:
+        """Names of unresolvable bases anywhere up the chain (fixture escape
+        hatch: `class Foo(Metric)` with no metric.py in the indexed tree)."""
+        names = set()
+        for anc in self.linearize(cls):
+            for expr in anc.base_exprs:
+                if self.resolve_class(expr, anc.module) is None:
+                    names.add(expr.rsplit(".", 1)[-1])
+        return names
+
+    def is_metric_subclass(self, cls: ClassInfo) -> bool:
+        for anc in self.linearize(cls):
+            if anc.name == METRIC_ROOT and anc.module.modname.endswith(".metric"):
+                return True
+        return METRIC_ROOT in self._root_names(cls) or HOST_ROOT in self._root_names(cls)
+
+    def is_host_metric(self, cls: ClassInfo) -> bool:
+        for anc in self.linearize(cls):
+            if anc.name == HOST_ROOT:
+                return True
+        return HOST_ROOT in self._root_names(cls)
+
+    def find_method(self, cls: ClassInfo, name: str) -> Optional[FunctionInfo]:
+        for anc in self.linearize(cls):
+            if name in anc.methods:
+                return anc.methods[name]
+        return None
+
+    def defines_below_root(self, cls: ClassInfo, method: str,
+                           roots: Iterable[str] = (METRIC_ROOT, HOST_ROOT)) -> bool:
+        """Does any class in the chain below the framework roots define
+        ``method``? (= "custom override" from the runtime's point of view)."""
+        for anc in self.linearize(cls):
+            if anc.name in roots and anc.module.modname.endswith(".metric"):
+                continue
+            if method in anc.methods:
+                return True
+        return False
+
+    def metric_classes(self) -> List[ClassInfo]:
+        out = []
+        for mod in self.modules.values():
+            for cls in mod.classes.values():
+                if self.is_metric_subclass(cls):
+                    out.append(cls)
+        out.sort(key=lambda c: c.qualname)
+        return out

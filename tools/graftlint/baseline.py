@@ -1,0 +1,97 @@
+"""Baseline resolution: documented findings don't fail, new ones do.
+
+Format (one finding per line)::
+
+    rule|path|symbol|detail  # justification (required, non-TODO)
+
+The fingerprint excludes line numbers so the baseline survives unrelated
+edits. ``--check`` fails on (a) findings not in the baseline, (b) stale
+baseline entries that no longer match anything (the violation was fixed —
+delete the entry so it cannot mask a future regression), and (c) entries
+with a missing or placeholder justification (the baseline documents false
+positives; it is not a mute button).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Sequence, Tuple
+
+from .core import Finding
+
+
+@dataclasses.dataclass(frozen=True)
+class BaselineEntry:
+    fingerprint: str
+    justification: str
+    line_no: int  # in the baseline file, for error messages
+
+
+def parse_baseline(text: str) -> Tuple[List[BaselineEntry], List[str]]:
+    """Returns (entries, format_errors)."""
+    entries: List[BaselineEntry] = []
+    errors: List[str] = []
+    for i, raw in enumerate(text.splitlines(), start=1):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        fingerprint, sep, justification = line.partition("  #")
+        if not sep:
+            fingerprint, sep, justification = line.partition(" #")
+        fingerprint = fingerprint.strip()
+        justification = justification.strip()
+        if fingerprint.count("|") != 3:
+            errors.append(f"baseline line {i}: malformed fingerprint {fingerprint!r} "
+                          "(expected rule|path|symbol|detail)")
+            continue
+        entries.append(BaselineEntry(fingerprint, justification, i))
+    return entries, errors
+
+
+def load_baseline(path: str) -> Tuple[List[BaselineEntry], List[str]]:
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            return parse_baseline(fh.read())
+    except OSError:
+        return [], []
+
+
+def resolve_against_baseline(
+    findings: Sequence[Finding], entries: Sequence[BaselineEntry]
+) -> Dict[str, list]:
+    """Split findings into new vs baselined; surface stale/unjustified entries."""
+    by_fp: Dict[str, List[Finding]] = {}
+    for f in findings:
+        by_fp.setdefault(f.fingerprint, []).append(f)
+    known = {e.fingerprint for e in entries}
+    new = [f for f in findings if f.fingerprint not in known]
+    baselined = [f for f in findings if f.fingerprint in known]
+    stale = [e for e in entries if e.fingerprint not in by_fp]
+    unjustified = [
+        e for e in entries
+        if e.fingerprint in by_fp
+        and (not e.justification or e.justification.upper().startswith("TODO"))
+    ]
+    return {"new": new, "baselined": baselined, "stale": stale, "unjustified": unjustified}
+
+
+def format_baseline(findings: Sequence[Finding], existing: Sequence[BaselineEntry] = ()) -> str:
+    """Render a baseline for the given findings, carrying over existing
+    justifications and marking new entries ``TODO: justify`` (a written
+    baseline does NOT pass --check until every TODO becomes a real reason)."""
+    just = {e.fingerprint: e.justification for e in existing}
+    lines = [
+        "# graftlint baseline — documented findings that do not fail --check.",
+        "# One entry per line: rule|path|symbol|detail  # justification",
+        "# Every entry MUST carry a real justification (TODO placeholders fail).",
+        "# Delete entries when the underlying finding is fixed (stale entries fail).",
+        "",
+    ]
+    seen = set()
+    for f in sorted(findings, key=lambda f: f.fingerprint):
+        if f.fingerprint in seen:
+            continue
+        seen.add(f.fingerprint)
+        reason = just.get(f.fingerprint) or "TODO: justify"
+        lines.append(f"{f.fingerprint}  # {reason}")
+    return "\n".join(lines) + "\n"

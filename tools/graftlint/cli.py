@@ -1,0 +1,157 @@
+"""graftlint CLI.
+
+Exit-code contract (the CI gate relies on it):
+
+- ``0`` — clean: no new findings, no stale baseline entries, every baseline
+  entry justified.
+- ``1`` — violations (new findings, stale entries, TODO justifications, or
+  baseline format errors) under ``--check``; without ``--check`` the report
+  prints and the exit code is still 1 when new findings exist, so plain
+  ``python -m tools.graftlint`` is usable as a gate too.
+- ``2`` — usage / internal error.
+
+Usage::
+
+    python -m tools.graftlint --check            # the tier-1 gate
+    python -m tools.graftlint --json             # machine-readable findings
+    python -m tools.graftlint --matrix           # plane-admissibility matrix JSON
+    python -m tools.graftlint --write-docs       # regenerate docs tables
+    python -m tools.graftlint --write-baseline   # (re)write baseline, keeping reasons
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from .baseline import format_baseline, load_baseline, resolve_against_baseline
+from .core import repo_root_from
+from .docgen import write_docs
+from .runner import run_checks
+
+DEFAULT_BASELINE = os.path.join("tools", "graftlint", "baseline.txt")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="graftlint", description=__doc__.splitlines()[0])
+    parser.add_argument("--root", default=None,
+                        help="repo root (default: auto-detected from this file / cwd)")
+    parser.add_argument("--package", default="torchmetrics_tpu",
+                        help="package directory under the root to analyze")
+    parser.add_argument("--baseline", default=None,
+                        help=f"baseline file (default: <root>/{DEFAULT_BASELINE})")
+    parser.add_argument("--check", action="store_true",
+                        help="enforce the exit-code contract (tier-1 gate)")
+    parser.add_argument("--family", action="append", default=[],
+                        choices=["tracer", "layout", "plane", "registry"],
+                        help="run only the named check families (repeatable)")
+    parser.add_argument("--json", action="store_true", help="emit findings as JSON")
+    parser.add_argument("--matrix", action="store_true",
+                        help="emit the plane-admissibility matrix as JSON and exit")
+    parser.add_argument("--write-docs", action="store_true",
+                        help="regenerate the admissibility tables in docs/ and exit")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="write the baseline for current findings (existing "
+                             "justifications carried over; new entries get TODO)")
+    args = parser.parse_args(argv)
+
+    root = os.path.abspath(args.root) if args.root else repo_root_from(os.getcwd() if os.path.isdir(
+        os.path.join(os.getcwd(), args.package)) else None)
+    if not os.path.isdir(os.path.join(root, args.package)):
+        print(f"graftlint: package directory {args.package!r} not found under {root}",
+              file=sys.stderr)
+        return 2
+
+    families = tuple(args.family) if args.family else None
+    try:
+        findings, matrix = run_checks(
+            root, package=args.package, families=families,
+            need_matrix=args.matrix or args.write_docs)
+    except Exception as exc:  # the gate must fail loudly, not crash silently
+        print(f"graftlint: internal error: {type(exc).__name__}: {exc}", file=sys.stderr)
+        return 2
+
+    if args.matrix:
+        print(json.dumps(matrix, indent=2, ensure_ascii=False))
+        return 0
+    if args.write_docs:
+        touched = write_docs(matrix, root)
+        print("regenerated: " + (", ".join(touched) if touched else "(nothing)"))
+        return 0
+
+    baseline_path = args.baseline or os.path.join(root, DEFAULT_BASELINE)
+    all_entries, fmt_errors = load_baseline(baseline_path)
+    entries = all_entries
+    if families:
+        # a partial run must only resolve the selected families' baseline
+        # entries — otherwise every entry from an unselected family would
+        # read as "stale" and fail --check with advice to delete a live
+        # suppression
+        prefixes = tuple(f"{fam}/" for fam in families)
+        entries = [e for e in entries if e.fingerprint.startswith(prefixes)]
+
+    if args.write_baseline:
+        if fmt_errors:
+            # a malformed line's justification would be silently rewritten as
+            # TODO — make the user fix the typo before regenerating
+            for err in fmt_errors:
+                print(f"[baseline/format] {err}", file=sys.stderr)
+            print("graftlint: refusing --write-baseline over a baseline with "
+                  "format errors (fix the lines above first)", file=sys.stderr)
+            return 1
+        text = format_baseline(findings, entries)
+        if families:
+            # a family-scoped rewrite only saw the selected families'
+            # findings — the other families' reviewed entries (and their
+            # justifications) must survive verbatim
+            for e in all_entries:
+                if not e.fingerprint.startswith(prefixes):
+                    text += f"{e.fingerprint}  # {e.justification}\n"
+        with open(baseline_path, "w", encoding="utf-8") as fh:
+            fh.write(text)
+        n = sum(1 for line in text.splitlines() if line and not line.startswith("#"))
+        print(f"wrote {baseline_path} ({n} entries)")
+        return 0
+
+    res = resolve_against_baseline(findings, entries)
+    # plain runs gate on new findings only; --check additionally enforces
+    # baseline hygiene (stale entries, TODO justifications, format errors)
+    problems = bool(res["new"]) or (
+        args.check and (bool(res["stale"]) or bool(res["unjustified"]) or bool(fmt_errors)))
+
+    if args.json:
+        print(json.dumps({
+            "root": root,
+            "new": [vars(f) | {"fingerprint": f.fingerprint} for f in res["new"]],
+            "baselined": [f.fingerprint for f in res["baselined"]],
+            "stale_baseline_entries": [e.fingerprint for e in res["stale"]],
+            "unjustified_baseline_entries": [e.fingerprint for e in res["unjustified"]],
+            "baseline_format_errors": fmt_errors,
+            "counts": {
+                "new": len(res["new"]), "baselined": len(res["baselined"]),
+                "stale": len(res["stale"]), "unjustified": len(res["unjustified"]),
+                "total_findings": len(findings),
+            },
+            "verdict": "fail" if problems else "ok",
+        }, indent=2, ensure_ascii=False))
+    else:
+        for f in res["new"]:
+            print(f.render())
+        for e in res["stale"]:
+            print(f"{os.path.relpath(baseline_path, root)}:{e.line_no}: [baseline/stale] "
+                  f"{e.fingerprint} no longer matches any finding — delete it")
+        for e in res["unjustified"]:
+            print(f"{os.path.relpath(baseline_path, root)}:{e.line_no}: [baseline/unjustified] "
+                  f"{e.fingerprint} has no real justification")
+        for err in fmt_errors:
+            print(f"[baseline/format] {err}")
+        status = "FAIL" if problems else "OK"
+        print(f"graftlint: {status} — {len(res['new'])} new, {len(res['baselined'])} baselined, "
+              f"{len(res['stale'])} stale, {len(res['unjustified'])} unjustified "
+              f"({len(findings)} raw findings)")
+
+    return 1 if problems else 0

@@ -1,0 +1,60 @@
+"""Shared finding model for graftlint.
+
+A finding's **fingerprint** deliberately excludes line numbers: the baseline
+must survive unrelated edits to the same file, so it anchors on
+(rule, file, symbol, detail) — the symbol is a qualified name
+(``Class.method`` / module-level name) and the detail names the construct
+(``item()``, ``np.asarray``, ``if:preds`` ...). Line numbers are carried for
+reporting only.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+# rule-id prefix per check family (docs/static_analysis.md mirrors this table)
+RULE_FAMILIES = {
+    "tracer": "tracer hygiene inside jit-reachable bodies",
+    "layout": "fleet metadata-vector layout + doc drift",
+    "plane": "plane-admissibility matrix + generated docs",
+    "registry": "reserved state keys + dispatch-tag registry",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str  # e.g. "tracer/item"
+    path: str  # repo-relative posix path
+    symbol: str  # stable anchor: qualified function/class name (or module)
+    detail: str  # short stable construct id, part of the fingerprint
+    message: str  # human-readable explanation (NOT in the fingerprint)
+    line: int = 0  # reported only
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.rule}|{self.path}|{self.symbol}|{self.detail}"
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}" if self.line else self.path
+        return f"{loc}: [{self.rule}] {self.symbol}: {self.message}"
+
+
+def repo_root_from(start: Optional[str] = None) -> str:
+    """Walk up from ``start`` (default: this file) to the directory holding
+    ``torchmetrics_tpu/`` — lets the CLI run from any cwd inside the repo."""
+    here = os.path.abspath(start or os.path.dirname(__file__))
+    probe = here
+    for _ in range(8):
+        if os.path.isdir(os.path.join(probe, "torchmetrics_tpu")):
+            return probe
+        parent = os.path.dirname(probe)
+        if parent == probe:
+            break
+        probe = parent
+    return here
+
+
+def rel(path: str, root: str) -> str:
+    return os.path.relpath(os.path.abspath(path), os.path.abspath(root)).replace(os.sep, "/")

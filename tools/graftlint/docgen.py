@@ -1,0 +1,171 @@
+"""Generated plane-admissibility tables for docs/serving.md + docs/streaming.md.
+
+The tables live between marker comments and are regenerated with
+``python -m tools.graftlint --write-docs``; ``--check`` verifies the
+committed docs match the freshly derived matrix (doc drift = finding).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+from .core import Finding
+
+BEGIN = "<!-- graftlint:{name}:begin (generated — `python -m tools.graftlint --write-docs`) -->"
+END = "<!-- graftlint:{name}:end -->"
+
+# (doc file, marker name, plane columns, column headers)
+DOC_TABLES = (
+    ("docs/serving.md", "serving-matrix", ("vupdate", "vcompute", "tenant_sharding"),
+     ("`vupdate` (megabatch)", "`vcompute` (compute_all)", "tenant sharding")),
+    ("docs/streaming.md", "streaming-matrix", ("wupdate", "dupdate"),
+     ("`wupdate` (SlidingWindow)", "`dupdate` (ExponentialDecay)")),
+)
+
+_GLYPH = {"yes": "✓", "no": "✗", "?": "?"}
+
+
+def _module_rollup(matrix: Dict[str, Any], planes: Tuple[str, ...]) -> List[Tuple[str, Dict[str, Dict[str, int]]]]:
+    """Per-module counts of yes/no/? for each plane column."""
+    by_mod: Dict[str, Dict[str, Dict[str, int]]] = {}
+    for row in matrix["metrics"].values():
+        mod = row["module"]
+        # collapse to the subsystem package (classification, image, ...)
+        parts = mod.split(".")
+        group = parts[1] if len(parts) > 1 else parts[0]
+        slot = by_mod.setdefault(group, {p: {"yes": 0, "no": 0, "?": 0} for p in planes})
+        for p in planes:
+            slot[p][row["planes"][p]] += 1
+    return sorted(by_mod.items())
+
+
+def render_table(matrix: Dict[str, Any], name: str, planes: Tuple[str, ...],
+                 headers: Tuple[str, ...]) -> str:
+    """Markdown: a per-module rollup plus the explicit inadmissible list with
+    reasons (the full per-class matrix is the machine-readable JSON:
+    ``python -m tools.graftlint --matrix``)."""
+    lines = [BEGIN.format(name=name), ""]
+    lines.append("| metric family | " + " | ".join(headers) + " |")
+    lines.append("|---|" + "---|" * len(planes))
+    for group, counts in _module_rollup(matrix, planes):
+        cells = []
+        for p in planes:
+            c = counts[p]
+            total = c["yes"] + c["no"] + c["?"]
+            part = f"{c['yes']}/{total}"
+            if c["?"]:
+                part += f" ({c['?']}?)"
+            cells.append(part)
+        lines.append(f"| `{group}` | " + " | ".join(cells) + " |")
+    # explicit inadmissible/undecidable rows, one compact line each
+    short = {
+        "concat (list) state": "concat state",
+        "'cat'-reduced tensor state (growing shape)": "cat tensor state",
+        "host-side batch state (HostMetric)": "host metric",
+        "no pure _batch_state core (wrapper/composition)": "no batch-state core",
+        "host-side _compute (_jittable_compute=False)": "host compute",
+        "custom _merge override": "custom merge",
+        "cat/callable reduction (no defined discount)": "undecayable reduction",
+        "bare 'mean' state cannot fold statelessly": "bare mean state",
+        "dynamic state declarations": "dynamic states",
+        "config-conditional states (depends on construction args)": "config-conditional states",
+        "config-dependent _jittable_compute": "config-dependent compute path",
+    }
+    blocked: List[str] = []
+    for qual in sorted(matrix["metrics"]):
+        row = matrix["metrics"][qual]
+        verdicts = [row["planes"][p] for p in planes]
+        if all(v == "yes" for v in verdicts):
+            continue
+        cls = qual.rsplit(".", 1)[-1]
+        reasons: List[str] = []
+        for p in planes:
+            for r in row["reasons"].get(p, []):
+                s = short.get(r, r)
+                if s not in reasons:
+                    reasons.append(s)
+        cells = " | ".join(_GLYPH[v] for v in verdicts)
+        blocked.append(f"| `{cls}` | {cells} | {'; '.join(reasons)} |")
+    lines.append("")
+    lines.append(f"Cells are admissible/total per family (`?` = statically undecidable: "
+                 f"admissibility depends on construction arguments). "
+                 f"{len(matrix['metrics'])} concrete metrics analyzed. "
+                 "Metrics not admissible everywhere (full per-class detail: "
+                 "`python -m tools.graftlint --matrix`):")
+    lines.append("")
+    if blocked:
+        lines.append("| metric | " + " | ".join(headers) + " | why |")
+        lines.append("|---|" + "---|" * (len(planes) + 1))
+        lines.extend(blocked)
+    else:
+        lines.append("(none — every analyzed metric is admissible)")
+    lines.append("")
+    lines.append(END.format(name=name))
+    return "\n".join(lines)
+
+
+def _splice(doc: str, name: str, block: str) -> Optional[str]:
+    begin = BEGIN.format(name=name)
+    end = END.format(name=name)
+    b = doc.find(begin)
+    e = doc.find(end)
+    if b == -1 or e == -1 or e < b:
+        return None
+    return doc[:b] + block + doc[e + len(end):]
+
+
+def check_docs(matrix: Dict[str, Any], root: str) -> List[Finding]:
+    findings: List[Finding] = []
+    for relpath, name, planes, headers in DOC_TABLES:
+        path = os.path.join(root, relpath)
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                doc = fh.read()
+        except OSError:
+            findings.append(Finding(
+                "plane/doc-missing", relpath, name, "missing",
+                f"{relpath} not found — the generated admissibility table has no home"))
+            continue
+        block = render_table(matrix, name, planes, headers)
+        if BEGIN.format(name=name) not in doc:
+            findings.append(Finding(
+                "plane/docs-stale", relpath, name, "no-markers",
+                f"{relpath} has no graftlint:{name} markers — run "
+                "`python -m tools.graftlint --write-docs` and commit"))
+        elif _splice(doc, name, block) != doc:
+            findings.append(Finding(
+                "plane/docs-stale", relpath, name, "stale",
+                f"the generated {name} table in {relpath} does not match the derived "
+                "matrix — run `python -m tools.graftlint --write-docs` and commit"))
+    return findings
+
+
+def write_docs(matrix: Dict[str, Any], root: str) -> List[str]:
+    """Regenerate the doc tables in place; returns the files touched."""
+    touched: List[str] = []
+    for relpath, name, planes, headers in DOC_TABLES:
+        path = os.path.join(root, relpath)
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                doc = fh.read()
+        except OSError:
+            continue
+        block = render_table(matrix, name, planes, headers)
+        if BEGIN.format(name=name) not in doc:
+            # first run: append a section at the end of the doc
+            doc = doc.rstrip("\n") + "\n\n## Plane admissibility (generated)\n\n" + block + "\n"
+        else:
+            spliced = _splice(doc, name, block)
+            if spliced is None:
+                # begin marker present but end marker missing/reordered —
+                # surface it instead of silently leaving the gate stuck
+                touched.append(f"{relpath} (SKIPPED: graftlint:{name} markers malformed — fix by hand)")
+                continue
+            if spliced == doc:
+                continue  # already up to date
+            doc = spliced
+        with open(path, "w", encoding="utf-8") as fh:
+            fh.write(doc)
+        touched.append(relpath)
+    return touched

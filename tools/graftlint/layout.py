@@ -1,0 +1,219 @@
+"""Fleet metadata-vector layout drift + documentation drift.
+
+The coalesced sync plane piggybacks one fixed-layout int64 vector per rank
+(counter fields + histogram kinds) on its metadata collective; the layout is
+versioned by ``parallel/coalesce.py:_VERSION`` so mixed-version fleets
+degrade to lockstep fallback instead of misdecoding. That contract lives in
+three files that must move together — exactly the drift a runtime test can't
+see until two different builds meet in one pod.
+
+The committed ``tools/graftlint/layout_ledger.json`` is the acknowledgment
+record: it pins (version, counter fields, histogram kinds) as one triple.
+Growing ``COUNTER_FIELDS`` or ``FLEET_HISTOGRAM_KINDS`` without bumping
+``_VERSION`` **and** re-pinning the ledger is an error; so is bumping the
+version without touching the ledger. The ledger update is the deliberate
+act — a PR that changes the wire layout must show it in the diff.
+
+Doc drift: every counter field, event kind and histogram kind must be named
+(in backticks) in ``docs/observability.md`` — the operator-facing tables may
+not silently lag the registries.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+from .core import Finding
+
+LEDGER_NAME = "layout_ledger.json"
+
+
+def parse_str_tuple(source: str, varname: str) -> Optional[List[str]]:
+    """Extract a module-level ``VARNAME = ("a", "b", ...)`` string tuple."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return None
+    for node in tree.body:
+        targets: List[ast.expr] = []
+        value: Optional[ast.expr] = None
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        for tgt in targets:
+            if isinstance(tgt, ast.Name) and tgt.id == varname:
+                if isinstance(value, ast.Tuple) and all(
+                    isinstance(e, ast.Constant) and isinstance(e.value, str) for e in value.elts
+                ):
+                    return [e.value for e in value.elts]
+                return None
+    return None
+
+
+def parse_int_assign(source: str, varname: str) -> Optional[int]:
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return None
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if (isinstance(tgt, ast.Name) and tgt.id == varname
+                        and isinstance(node.value, ast.Constant)
+                        and isinstance(node.value.value, int)):
+                    return node.value.value
+    return None
+
+
+def _read(path: str) -> Optional[str]:
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            return fh.read()
+    except OSError:
+        return None
+
+
+def backticked_tokens(markdown: str) -> set:
+    """Every token that appears inside backticks anywhere in the document
+    (split on non-identifier chars, so "`retries` / `retries_exhausted`"
+    and "`tpu_metrics_alerts_total`" both register their names).
+
+    Fenced ``` code blocks are stripped first: a stray triple-backtick would
+    flip the inline-span pairing for the rest of the document, and example
+    code mentioning a counter is not documentation of it anyway."""
+    import re
+
+    prose = re.sub(r"```.*?```", " ", markdown, flags=re.DOTALL)
+    tokens = set()
+    for span in re.findall(r"`([^`\n]+)`", prose):
+        for word in re.split(r"[^A-Za-z0-9_]+", span):
+            if word:
+                tokens.add(word)
+    return tokens
+
+
+def check_fleet_layout(
+    counters_src: Optional[str],
+    histograms_src: Optional[str],
+    coalesce_src: Optional[str],
+    events_src: Optional[str],
+    ledger: Optional[Dict[str, Any]],
+    observability_md: Optional[str],
+) -> List[Finding]:
+    """Source-text based so tests can feed mutated copies."""
+    findings: List[Finding] = []
+    c_path = "torchmetrics_tpu/observability/counters.py"
+    h_path = "torchmetrics_tpu/observability/histograms.py"
+    v_path = "torchmetrics_tpu/parallel/coalesce.py"
+    e_path = "torchmetrics_tpu/observability/events.py"
+    doc_path = "docs/observability.md"
+
+    fields = parse_str_tuple(counters_src, "COUNTER_FIELDS") if counters_src else None
+    kinds = parse_str_tuple(histograms_src, "FLEET_HISTOGRAM_KINDS") if histograms_src else None
+    version = parse_int_assign(coalesce_src, "_VERSION") if coalesce_src else None
+    event_kinds = parse_str_tuple(events_src, "EVENT_KINDS") if events_src else None
+
+    for val, path, what in (
+        (fields, c_path, "COUNTER_FIELDS"),
+        (kinds, h_path, "FLEET_HISTOGRAM_KINDS"),
+        (version, v_path, "_VERSION"),
+        (event_kinds, e_path, "EVENT_KINDS"),
+    ):
+        if val is None:
+            findings.append(Finding(
+                "layout/unparseable", path, what, "unparseable",
+                f"could not statically extract {what} — the drift check is blind; "
+                "keep it a literal tuple/int assignment"))
+    if fields is None or kinds is None or version is None:
+        return findings
+
+    if ledger is None:
+        findings.append(Finding(
+            "layout/ledger-missing", f"tools/graftlint/{LEDGER_NAME}", "ledger", "missing",
+            "layout ledger missing/unreadable — commit the (version, fields, kinds) pin"))
+        return findings
+
+    led_version = ledger.get("version")
+    led_fields = list(ledger.get("counter_fields", []))
+    led_kinds = list(ledger.get("histogram_kinds", []))
+
+    fields_changed = fields != led_fields
+    kinds_changed = kinds != led_kinds
+
+    if version == led_version:
+        if fields_changed:
+            added = [f for f in fields if f not in led_fields]
+            removed = [f for f in led_fields if f not in fields]
+            findings.append(Finding(
+                "layout/counter-drift", c_path, "COUNTER_FIELDS",
+                f"v{version}:+{len(added)}-{len(removed)}",
+                "COUNTER_FIELDS changed (added: %s; removed: %s) without bumping "
+                "parallel/coalesce._VERSION — a mixed-version fleet would misdecode the "
+                "piggybacked counter vector. Bump _VERSION and re-pin tools/graftlint/%s."
+                % (added or "-", removed or "-", LEDGER_NAME)))
+        if kinds_changed:
+            findings.append(Finding(
+                "layout/hist-drift", h_path, "FLEET_HISTOGRAM_KINDS",
+                f"v{version}:{len(kinds)}vs{len(led_kinds)}",
+                "FLEET_HISTOGRAM_KINDS changed without bumping parallel/coalesce._VERSION — "
+                "the fleet histogram vector layout shifted under the same wire version. "
+                f"Bump _VERSION and re-pin tools/graftlint/{LEDGER_NAME}."))
+    else:
+        # version moved: the ledger must be re-pinned to the new triple
+        findings.append(Finding(
+            "layout/ledger-stale", v_path, "_VERSION", f"{led_version}->{version}",
+            f"parallel/coalesce._VERSION is {version} but tools/graftlint/{LEDGER_NAME} pins "
+            f"{led_version} — re-pin the ledger to the new (version, fields, kinds) triple "
+            "in the same PR that changes the layout."))
+
+    # ---- documentation drift -------------------------------------------------
+    if observability_md is None:
+        findings.append(Finding(
+            "layout/doc-missing", doc_path, "docs", "missing",
+            "docs/observability.md not found — counter/event tables unauditable"))
+        return findings
+    doc_tokens = backticked_tokens(observability_md)
+    for field in fields:
+        if field not in doc_tokens:
+            findings.append(Finding(
+                "layout/doc-counter", doc_path, "counters", field,
+                f"counter field `{field}` (COUNTER_FIELDS) is not documented in {doc_path}"))
+    for kind in kinds:
+        if kind not in doc_tokens:
+            findings.append(Finding(
+                "layout/doc-hist-kind", doc_path, "histograms", kind,
+                f"fleet histogram kind `{kind}` is not documented in {doc_path}"))
+    if event_kinds:
+        for kind in event_kinds:
+            if kind not in doc_tokens:
+                findings.append(Finding(
+                    "layout/doc-event", doc_path, "events", kind,
+                    f"event kind `{kind}` (EVENT_KINDS) is not documented in {doc_path}"))
+        # the doc's enumerated event-kind list must be the CLOSED set: every
+        # kind named in the "Event model" section, none missing (the PR 9/10
+        # kinds went stale exactly this way)
+    return findings
+
+
+def run(root: str) -> List[Finding]:
+    """Repo-rooted convenience wrapper around :func:`check_fleet_layout`."""
+    ledger_path = os.path.join(root, "tools", "graftlint", LEDGER_NAME)
+    ledger: Optional[Dict[str, Any]] = None
+    raw = _read(ledger_path)
+    if raw is not None:
+        try:
+            ledger = json.loads(raw)
+        except ValueError:
+            ledger = None
+    return check_fleet_layout(
+        _read(os.path.join(root, "torchmetrics_tpu", "observability", "counters.py")),
+        _read(os.path.join(root, "torchmetrics_tpu", "observability", "histograms.py")),
+        _read(os.path.join(root, "torchmetrics_tpu", "parallel", "coalesce.py")),
+        _read(os.path.join(root, "torchmetrics_tpu", "observability", "events.py")),
+        ledger,
+        _read(os.path.join(root, "docs", "observability.md")),
+    )

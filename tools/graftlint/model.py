@@ -1,0 +1,380 @@
+"""Static Metric-subclass model: state declarations + plane-relevant flags.
+
+Derives, per concrete ``Metric`` subclass, the ``add_state`` declarations
+(name, list-vs-tensor default, reduce tag) **without importing anything** —
+including through the ``BaseAggregator`` idiom where the literal arguments
+live in a subclass's ``super().__init__("max", np.float32(-inf),
+state_name="max_value")`` call and the ``add_state`` call sits in the base
+with parameter names: a small constant-propagation pass binds the base
+``__init__``'s parameters from the resolved call and recurses (bounded
+depth).
+
+Anything unresolvable degrades to ``dynamic`` rather than guessing — the
+admissibility matrix reports those planes as ``?`` and the runtime
+cross-validation test (``tests/test_static_analysis.py``) covers a sample.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+from .astindex import ClassInfo, PackageIndex
+
+# sentinels for the tiny abstract interpreter
+_UNKNOWN = object()
+_LIST = object()  # a literal empty-list default (concat state)
+_CALLABLE = object()  # a function/lambda reduce fx
+
+
+@dataclasses.dataclass
+class StateDecl:
+    name: Optional[str]  # None = dynamic name
+    is_list: Optional[bool]  # None = undecidable
+    fx: Any  # "sum"/"mean"/"cat"/"min"/"max"/None/"callable"/"dynamic"
+    conditional: bool  # declared under if/for/try — may not exist at runtime
+    declared_in: str  # qualified class name of the add_state call site
+    line: int = 0
+
+
+@dataclasses.dataclass
+class MetricModel:
+    cls: ClassInfo
+    states: List[StateDecl]
+    dynamic_states: bool  # an add_state resolution failed somewhere
+    jittable_compute: Any  # True / False / "conditional"
+    custom_merge: bool
+    has_batch_state: bool
+    is_host: bool
+    has_init: bool
+
+    @property
+    def qualname(self) -> str:
+        return self.cls.qualname
+
+    @property
+    def concrete(self) -> bool:
+        """Heuristic: declares (or inherits) at least one state AND a batch
+        core — bases/wrappers without either are not servable metrics."""
+        return bool(self.states) and (self.has_batch_state or self.is_host)
+
+    def has_list_state(self) -> Optional[bool]:
+        """True/False when decidable; None when any declaration is dynamic or
+        config-conditional (e.g. the curve metrics' binned-vs-cat split on
+        ``thresholds`` — admissibility depends on construction args)."""
+        if any(s.is_list is True and not s.conditional for s in self.states):
+            return True
+        if self.dynamic_states or any(
+            s.is_list is None or (s.is_list is True and s.conditional) for s in self.states
+        ):
+            return None
+        return False
+
+    def has_cat_tensor_state(self) -> Optional[bool]:
+        hit = unknown = False
+        for s in self.states:
+            if s.fx == "cat" and s.is_list is False:
+                if s.conditional:
+                    unknown = True
+                else:
+                    hit = True
+            elif s.fx == "dynamic" or s.is_list is None:
+                unknown = True
+        if hit:
+            return True
+        return None if (unknown or self.dynamic_states) else False
+
+    def has_bare_mean_state(self) -> Optional[bool]:
+        if any(s.fx == "mean" and not s.conditional for s in self.states):
+            return True
+        if self.dynamic_states or any(
+            s.fx == "dynamic" or (s.fx == "mean" and s.conditional) for s in self.states
+        ):
+            return None
+        return False
+
+    def has_undecayable_reduction(self) -> Optional[bool]:
+        """cat / callable reduce tags — ExponentialDecay rejects both."""
+        if any(s.fx in ("cat", "callable") and not s.conditional for s in self.states):
+            return True
+        if self.dynamic_states or any(
+            s.fx == "dynamic" or (s.fx in ("cat", "callable") and s.conditional)
+            for s in self.states
+        ):
+            return None
+        return False
+
+
+def _resolve(node: Optional[ast.AST], bindings: Dict[str, Any]) -> Any:
+    """Tiny constant evaluation: literals, bound parameter names, and the
+    shapes add_state cares about (empty list, callable)."""
+    if node is None:
+        return None
+    if isinstance(node, ast.Constant):
+        return node.value
+    if isinstance(node, ast.List):
+        return _LIST if not node.elts else _UNKNOWN
+    if isinstance(node, ast.Name):
+        if node.id in bindings:
+            return bindings[node.id]
+        return _UNKNOWN
+    if isinstance(node, ast.Lambda):
+        return _CALLABLE
+    if isinstance(node, ast.Attribute):
+        # np.float32(...) handled by Call below; a bare attribute used as a
+        # reduce fx (e.g. ``jnp.concatenate``) is a callable
+        return _CALLABLE
+    if isinstance(node, ast.Call):
+        # np.zeros(()), jnp.asarray(0.0), np.float32(-inf): an array-ish
+        # default — definitely not a list
+        return _UNKNOWN
+    if isinstance(node, (ast.UnaryOp, ast.BinOp)):
+        return _UNKNOWN
+    if isinstance(node, ast.IfExp):
+        a = _resolve(node.body, bindings)
+        b = _resolve(node.orelse, bindings)
+        return a if a == b else _UNKNOWN
+    return _UNKNOWN
+
+
+def _nested_in_flow(root: ast.AST, target: ast.AST) -> bool:
+    """True when target sits under If/For/While/Try anywhere below root."""
+    flow = (ast.If, ast.For, ast.While, ast.Try)
+
+    def rec(n: ast.AST, under: bool) -> Optional[bool]:
+        if n is target:
+            return under
+        for child in ast.iter_child_nodes(n):
+            got = rec(child, under or isinstance(n, flow))
+            if got is not None:
+                return got
+        return None
+
+    return bool(rec(root, isinstance(root, flow)))
+
+
+class _InitInterpreter:
+    """Walks an ``__init__`` body collecting add_state calls, following
+    ``super().__init__`` / ``Base.__init__(self, ...)`` with literal-argument
+    parameter binding (bounded depth, cycle-safe)."""
+
+    MAX_DEPTH = 12
+
+    def __init__(self, index: PackageIndex, origin: ClassInfo) -> None:
+        self.index = index
+        self.origin = origin
+        self.states: List[StateDecl] = []
+        self.dynamic = False
+        self.jittable_assign: Any = _UNKNOWN  # last self._jittable_compute= seen
+        self._visited: set = set()
+
+    # -------------------------------------------------------------- binding
+    def _bind_params(self, fn: ast.FunctionDef, call: ast.Call,
+                     caller_bindings: Dict[str, Any]) -> Dict[str, Any]:
+        params = [a.arg for a in fn.args.args[1:]]  # drop self
+        defaults = fn.args.defaults
+        bindings: Dict[str, Any] = {}
+        # defaults first (right-aligned)
+        for param, dflt in zip(params[len(params) - len(defaults):], defaults):
+            bindings[param] = _resolve(dflt, {})
+        for kwarg, kwdflt in zip(fn.args.kwonlyargs, fn.args.kw_defaults):
+            if kwdflt is not None:
+                bindings[kwarg.arg] = _resolve(kwdflt, {})
+        # positional args from the call
+        for param, arg in zip(params, call.args):
+            if isinstance(arg, ast.Starred):
+                break
+            bindings[param] = _resolve(arg, caller_bindings)
+        # keyword args from the call
+        kw_params = set(params) | {a.arg for a in fn.args.kwonlyargs}
+        for kw in call.keywords:
+            if kw.arg is None:  # **kwargs forwarding — values unresolvable
+                continue
+            if kw.arg in kw_params:
+                bindings[kw.arg] = _resolve(kw.value, caller_bindings)
+        return bindings
+
+    # ------------------------------------------------------------------ run
+    def run(self, cls: ClassInfo, bindings: Dict[str, Any], depth: int = 0) -> None:
+        if depth > self.MAX_DEPTH or cls.qualname in self._visited:
+            return
+        self._visited.add(cls.qualname)
+        init = cls.methods.get("__init__")
+        if init is None:
+            # no own __init__: the first ancestor in the linearization that
+            # defines one runs with the same bindings (mixin-aware)
+            for anc in self.index.linearize(cls)[1:]:
+                if "__init__" in anc.methods:
+                    self.run(anc, bindings, depth + 1)
+                    return
+            return
+        self._walk_body(init.node, cls, bindings, depth)
+
+    def _first_resolved_base(self, cls: ClassInfo) -> Optional[ClassInfo]:
+        for expr in cls.base_exprs:
+            base = self.index.resolve_class(expr, cls.module)
+            if base is not None:
+                return base
+        return None
+
+    def _walk_body(self, fn_node: ast.FunctionDef, cls: ClassInfo,
+                   bindings: Dict[str, Any], depth: int) -> None:
+        # `for name in ("tp", "fp", ...): self.add_state(name, ...)` — bind
+        # the loop variable to the literal element set so every state is
+        # recorded by name instead of degrading to "dynamic"
+        for node in ast.walk(fn_node):
+            if (isinstance(node, ast.For) and isinstance(node.target, ast.Name)
+                    and isinstance(node.iter, (ast.Tuple, ast.List))
+                    and node.iter.elts
+                    and all(isinstance(e, ast.Constant) and isinstance(e.value, str)
+                            for e in node.iter.elts)):
+                bindings.setdefault(node.target.id,
+                                    ("__anyof__", tuple(e.value for e in node.iter.elts)))
+        for node in ast.walk(fn_node):
+            if isinstance(node, ast.Assign):
+                # track simple constant locals + self._jittable_compute flags
+                val = _resolve(node.value, bindings)
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name) and val is not _UNKNOWN:
+                        bindings.setdefault(tgt.id, val)
+                    elif (isinstance(tgt, ast.Attribute) and tgt.attr == "_jittable_compute"
+                          and isinstance(tgt.value, ast.Name) and tgt.value.id == "self"):
+                        self.jittable_assign = val if isinstance(val, bool) else "conditional"
+            elif isinstance(node, ast.Call):
+                callee = node.func
+                if isinstance(callee, ast.Attribute) and callee.attr == "add_state":
+                    self._record_add_state(node, cls, fn_node, bindings)
+                elif (isinstance(callee, ast.Attribute)
+                      and isinstance(callee.value, ast.Name) and callee.value.id == "self"
+                      and callee.attr not in ("add_state", "__init__")):
+                    # state-creating helper methods (the stat_scores
+                    # `self._create_state(size, multidim_average)` idiom) —
+                    # resolved against the ORIGIN class (python MRO semantics)
+                    helper = self.index.find_method(self.origin, callee.attr)
+                    if helper is not None and depth < self.MAX_DEPTH:
+                        key = (id(helper.node), "helper")
+                        if key not in self._visited and self._mentions_add_state(helper.node):
+                            self._visited.add(key)
+                            child = self._bind_params(helper.node, node, bindings)
+                            owner = self.index.resolve_class(helper.class_name, helper.module) or cls
+                            self._walk_body(helper.node, owner, child, depth + 1)
+                elif isinstance(callee, ast.Attribute) and callee.attr == "__init__":
+                    base = self._resolve_init_target(callee, cls)
+                    if base is not None:
+                        # bind against the ancestor whose __init__ actually runs
+                        target = next(
+                            (anc for anc in self.index.linearize(base) if "__init__" in anc.methods),
+                            None,
+                        )
+                        child = (
+                            self._bind_params(target.methods["__init__"].node, node, bindings)
+                            if target is not None else {}
+                        )
+                        self.run(target or base, child, depth + 1)
+
+    @staticmethod
+    def _mentions_add_state(fn_node: ast.AST) -> bool:
+        return any(
+            isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
+            and n.func.attr == "add_state"
+            for n in ast.walk(fn_node)
+        )
+
+    def _resolve_init_target(self, callee: ast.Attribute, cls: ClassInfo) -> Optional[ClassInfo]:
+        v = callee.value
+        if isinstance(v, ast.Call) and isinstance(v.func, ast.Name) and v.func.id == "super":
+            # python MRO semantics, mixin-aware: the first class AFTER cls in
+            # the linearization that actually defines __init__ (a compute
+            # mixin without one must not swallow the chain)
+            chain = self.index.linearize(cls)
+            for anc in chain[1:]:
+                if "__init__" in anc.methods:
+                    return anc
+            return self._first_resolved_base(cls)
+        if isinstance(v, ast.Name):  # Base.__init__(self, ...)
+            return self.index.resolve_class(v.id, cls.module)
+        return None
+
+    def _record_add_state(self, call: ast.Call, cls: ClassInfo,
+                          fn_node: ast.FunctionDef, bindings: Dict[str, Any]) -> None:
+        args: Dict[str, Any] = {}
+        names = ("name", "default", "dist_reduce_fx", "persistent")
+        for i, a in enumerate(call.args[:4]):
+            args[names[i]] = _resolve(a, bindings)
+        for kw in call.keywords:
+            if kw.arg in names:
+                args[kw.arg] = _resolve(kw.value, bindings)
+        name = args.get("name", _UNKNOWN)
+        default = args.get("default", _UNKNOWN)
+        fx = args.get("dist_reduce_fx", None)
+
+        names: List[Optional[str]]
+        if isinstance(name, str):
+            names = [name]
+        elif isinstance(name, tuple) and len(name) == 2 and name[0] == "__anyof__":
+            names = list(name[1])  # loop-literal binding: one decl per element
+        else:
+            names = [None]
+            self.dynamic = True
+        if default is _LIST:
+            is_list: Optional[bool] = True
+            if fx is None:  # runtime defaults list states to "cat"
+                fx = "cat"
+        elif default is _UNKNOWN:
+            is_list = False  # array-ish expression (Call/np attr) — not a literal []
+        elif isinstance(default, list):
+            is_list = True
+        else:
+            is_list = False
+        if fx is _CALLABLE:
+            fx_val: Any = "callable"
+        elif fx is _UNKNOWN:
+            fx_val = "dynamic"
+            self.dynamic = True
+        elif isinstance(fx, str) or fx is None:
+            fx_val = fx
+        else:
+            fx_val = "dynamic"
+            self.dynamic = True
+        for decl_name in names:
+            self.states.append(StateDecl(
+                name=decl_name, is_list=is_list, fx=fx_val,
+                conditional=_nested_in_flow(fn_node, call),
+                declared_in=cls.qualname, line=getattr(call, "lineno", 0),
+            ))
+
+
+def build_model(index: PackageIndex, cls: ClassInfo) -> MetricModel:
+    interp = _InitInterpreter(index, cls)
+    interp.run(cls, {}, 0)
+
+    # _jittable_compute: __init__ assignment wins, else nearest class attr
+    jittable: Any = True
+    for anc in index.linearize(cls):
+        if "_jittable_compute" in anc.class_attrs:
+            v = anc.class_attrs["_jittable_compute"]
+            jittable = v.value if isinstance(v, ast.Constant) and isinstance(v.value, bool) else "conditional"
+            break
+    if interp.jittable_assign is not _UNKNOWN:
+        jittable = interp.jittable_assign
+
+    return MetricModel(
+        cls=cls,
+        states=interp.states,
+        dynamic_states=interp.dynamic,
+        jittable_compute=jittable,
+        custom_merge=index.defines_below_root(cls, "_merge"),
+        has_batch_state=index.defines_below_root(cls, "_batch_state"),
+        is_host=index.is_host_metric(cls),
+        has_init=index.find_method(cls, "__init__") is not None,
+    )
+
+
+def build_models(index: PackageIndex) -> Dict[str, MetricModel]:
+    out: Dict[str, MetricModel] = {}
+    for cls in index.metric_classes():
+        if cls.name in ("Metric", "HostMetric") and cls.module.modname.endswith(".metric"):
+            continue  # the framework roots are not metrics
+        out[cls.qualname] = build_model(index, cls)
+    return out

@@ -1,0 +1,146 @@
+"""Reserved-key & dispatch-tag registry checks.
+
+Reserved state-leaf keys: the serving/streaming planes store their own
+bookkeeping leaves (``__tenant_n``, ``__window_cursor``, ``__window_n``,
+``__decay_n``) NEXT TO the metric's real states inside one stacked pytree —
+a metric declaring a colliding (or near-miss dunder-prefixed) state name
+would be silently shadowed or corrupt the plane's cursor math. The reserved
+set is parsed from ``metric.py``'s ``*_KEY`` constants, so growing it there
+automatically widens the check.
+
+Dispatch tags: every ``_donation_safe_dispatch(tag, ...)`` call site must use
+a tag registered in ``Metric._aot_program`` — an unregistered tag dispatches
+fine on the happy path but silently loses AOT warm-start (``_aot_program``
+raises when the plane tries to key the cache) and precompile coverage.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from .astindex import PackageIndex
+from .core import Finding
+
+# runtime-reserved attribute names add_state itself rejects — kept for the
+# near-miss check message only
+RUNTIME_RESERVED = ("_defaults", "_reductions", "_persistent", "_state")
+
+
+def reserved_keys(index: PackageIndex) -> Set[str]:
+    """The ``*_KEY = "__x"`` constants in metric.py (TENANT_COUNT_KEY, ...)."""
+    out: Set[str] = set()
+    for mod in index.modules.values():
+        if not mod.modname.endswith(".metric"):
+            continue
+        for node in mod.tree.body:
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Constant):
+                val = node.value.value
+                for tgt in node.targets:
+                    if (isinstance(tgt, ast.Name) and tgt.id.endswith("_KEY")
+                            and isinstance(val, str)):
+                        out.add(val)
+    return out
+
+
+def registered_tags(index: PackageIndex) -> Set[str]:
+    """Tags ``Metric._aot_program`` recognizes (``tag == "x"`` comparisons)."""
+    tags: Set[str] = set()
+    for mod in index.modules.values():
+        if not mod.modname.endswith(".metric"):
+            continue
+        for cls in mod.classes.values():
+            fn = cls.methods.get("_aot_program")
+            if fn is None:
+                continue
+            for node in ast.walk(fn.node):
+                if isinstance(node, ast.Compare) and isinstance(node.left, ast.Name) \
+                        and node.left.id == "tag":
+                    for comp in node.comparators:
+                        if isinstance(comp, ast.Constant) and isinstance(comp.value, str):
+                            tags.add(comp.value)
+    return tags
+
+
+def check_registry(index: PackageIndex) -> List[Finding]:
+    findings: List[Finding] = []
+    reserved = reserved_keys(index)
+    tags = registered_tags(index)
+
+    if not tags:
+        findings.append(Finding(
+            "registry/no-tag-registry", "torchmetrics_tpu/metric.py", "Metric._aot_program",
+            "unparseable", "could not extract the registered dispatch-tag set from "
+            "_aot_program — the tag check is blind"))
+
+    for mod in index.modules.values():
+        # ---- add_state reserved-key collisions -----------------------------
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr == "add_state":
+                name_node: Optional[ast.expr] = node.args[0] if node.args else None
+                for kw in node.keywords:
+                    if kw.arg == "name":
+                        name_node = kw.value
+                if isinstance(name_node, ast.Constant) and isinstance(name_node.value, str):
+                    name = name_node.value
+                    if name in reserved:
+                        findings.append(Finding(
+                            "registry/reserved-key", mod.relpath,
+                            _enclosing(mod, node), name,
+                            f"state name {name!r} collides with a reserved plane leaf — "
+                            "the serving/streaming stacks store their bookkeeping under it",
+                            node.lineno))
+                    elif name.startswith("__"):
+                        findings.append(Finding(
+                            "registry/reserved-prefix", mod.relpath,
+                            _enclosing(mod, node), name,
+                            f"state name {name!r} uses the double-underscore prefix reserved "
+                            "for plane bookkeeping leaves (near-miss of "
+                            f"{sorted(reserved)}) — rename it",
+                            node.lineno))
+            # ---- dispatch-tag registration ---------------------------------
+            elif isinstance(f, ast.Attribute) and f.attr == "_donation_safe_dispatch":
+                tag_node: Optional[ast.expr] = node.args[0] if node.args else None
+                for kw in node.keywords:
+                    if kw.arg == "tag":
+                        tag_node = kw.value
+                if isinstance(tag_node, ast.Constant) and isinstance(tag_node.value, str):
+                    tag = tag_node.value
+                    if tags and tag not in tags:
+                        findings.append(Finding(
+                            "registry/unregistered-tag", mod.relpath,
+                            _enclosing(mod, node), tag,
+                            f"dispatch tag {tag!r} is not registered in Metric._aot_program "
+                            f"(known: {sorted(tags)}) — the AOT plane cannot key or warm it",
+                            node.lineno))
+                elif tag_node is not None:
+                    findings.append(Finding(
+                        "registry/dynamic-tag", mod.relpath,
+                        _enclosing(mod, node), "dynamic",
+                        "_donation_safe_dispatch called with a non-literal tag — "
+                        "registration cannot be verified statically",
+                        node.lineno))
+    return findings
+
+
+def _enclosing(mod, target: ast.AST) -> str:
+    """Qualified name of the function/class lexically containing ``target``."""
+    best = mod.modname.rsplit(".", 1)[-1]
+
+    def rec(node: ast.AST, qual: str) -> Optional[str]:
+        for child in ast.iter_child_nodes(node):
+            name = qual
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                name = f"{qual}.{child.name}" if qual else child.name
+            if child is target:
+                return name
+            got = rec(child, name)
+            if got is not None:
+                return got
+        return None
+
+    found = rec(mod.tree, "")
+    return found or best

@@ -1,0 +1,58 @@
+"""Top-level orchestration: build the index once, run all four families."""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import layout
+from .admissibility import build_matrix
+from .astindex import PackageIndex
+from .core import Finding
+from .docgen import check_docs
+from .model import build_models
+from .registry import check_registry
+from .tracer import check_tracer_hygiene
+
+
+def build_index(root: str, package: str = "torchmetrics_tpu") -> PackageIndex:
+    return PackageIndex(os.path.join(root, package), package)
+
+
+def run_checks(
+    root: str,
+    package: str = "torchmetrics_tpu",
+    families: Optional[Tuple[str, ...]] = None,
+    index: Optional[PackageIndex] = None,
+    need_matrix: bool = True,
+) -> Tuple[List[Finding], Dict[str, Any]]:
+    """Run the selected check families over the repo at ``root``.
+
+    Returns ``(findings, matrix)`` — the matrix rides along because the CLI
+    and the doc generator both need it and the derivation is the expensive
+    step. Only the tracer and plane families consume it; with
+    ``need_matrix=False`` a run restricted to the other families skips the
+    model/matrix derivation entirely and returns ``(findings, {})``.
+    """
+    families = families or ("tracer", "layout", "plane", "registry")
+    idx = index or build_index(root, package)
+    matrix: Dict[str, Any] = {}
+    if need_matrix or "tracer" in families or "plane" in families:
+        models = build_models(idx)
+        matrix = build_matrix(models)
+
+    findings: List[Finding] = []
+    for relpath, err in idx.errors:
+        findings.append(Finding(
+            "internal/parse-error", relpath, "module", "parse-error",
+            f"could not parse: {err}"))
+    if "tracer" in families:
+        findings.extend(check_tracer_hygiene(idx, models))
+    if "layout" in families:
+        findings.extend(layout.run(root))
+    if "plane" in families:
+        findings.extend(check_docs(matrix, root))
+    if "registry" in families:
+        findings.extend(check_registry(idx))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.detail))
+    return findings, matrix

@@ -1,0 +1,413 @@
+"""Tracer-hygiene lint: host-coercion and Python-control-flow hazards inside
+jit-reachable bodies.
+
+Complements the RUNTIME transfer-guard tests (``tests/test_no_d2h.py``) —
+which only see the code paths tests execute — with whole-codebase static
+coverage. A violation inside a jit-traced body is one of the classic silent
+killers: ``.item()``/``.tolist()`` and ``float()/int()/bool()`` force a
+device→host readback (one flips tunneled TPU runtimes into synchronous
+dispatch, ~80× slower for the rest of the process), ``np.*`` calls on traced
+values fall off the XLA graph (TracerArrayConversionError at best, silent
+host math at worst), and Python ``if``/``while`` on a traced value is a
+ConcretizationTypeError waiting for the first non-trivial input.
+
+**Jit-reachable set.** Seeds: every non-host Metric subclass's
+``_batch_state`` / ``_merge`` / ``update_state`` (and ``_compute`` unless the
+class pins ``_jittable_compute = False`` — host computes may use numpy
+freely), plus the dispatch-program builders in ``metric.py`` (functions
+nested inside ``_get_*_fn``). The set closes transitively over same-package
+calls (``self.helper()``, imported functional kernels, ``module.fn()``), so
+the functional kernels a ``_batch_state`` traces through are covered without
+blanket-flagging the genuinely host-side functional families (text,
+detection, ...).
+
+Static-metadata accessors (``.shape`` / ``.ndim`` / ``.dtype`` / ``.size`` /
+``len()`` / ``isinstance`` / ``is None``) never trip the branch check —
+branching on those is resolved at trace time and is exactly how shape
+polymorphism is supposed to work.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .astindex import ClassInfo, FunctionInfo, PackageIndex
+from .core import Finding
+from .model import MetricModel
+
+# numpy attributes that are metadata/dtype-level and legal at trace time
+NP_ALLOWED = {
+    "float16", "float32", "float64", "int8", "int16", "int32", "int64",
+    "uint8", "uint16", "uint32", "uint64", "bool_", "complex64", "complex128",
+    "dtype", "finfo", "iinfo", "issubdtype", "promote_types", "result_type",
+    "ndim", "isscalar", "can_cast",
+}
+
+# attribute accesses that yield static (trace-time) metadata, not traced data
+STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "itemsize", "nbytes"}
+
+# builtins whose result on an array is static metadata; `_is_traced` is the
+# package's own trace-detection guard (utilities/checks.py) — its result is
+# by definition trace-time static
+STATIC_CALLS = {"len", "isinstance", "hasattr", "getattr", "type", "callable", "_is_traced"}
+
+# receiver-method evidence that a name is used as a traced array
+_ARRAY_METHODS = {
+    "astype", "sum", "mean", "max", "min", "reshape", "ravel", "flatten",
+    "transpose", "clip", "round", "take", "argmax", "argmin", "cumsum",
+    "squeeze", "at", "prod", "std", "var", "dot", "conj",
+}
+
+SEED_METHODS = ("_batch_state", "_merge", "update_state")
+
+
+class _Body:
+    """One jit-reachable function body with its analysis context.
+
+    ``seed=True`` (the metric's own ``_batch_state``/``_merge``/``_compute``/
+    ``update_state``) means EVERY data parameter is traced by construction —
+    they receive the batch inputs / state dicts directly. For transitively
+    reached helpers (functional kernels mixing arrays with static config
+    scalars like ``num_classes``), tracedness is evidence-based: a parameter
+    only counts once it is used as an array (jnp call argument, array-method
+    receiver)."""
+
+    __slots__ = ("fn", "arrayish", "np_aliases", "jax_aliases", "seed")
+
+    def __init__(self, fn: FunctionInfo, seed: bool = False) -> None:
+        self.fn = fn
+        self.seed = seed
+        mod = fn.module
+        self.np_aliases = {
+            local for local, origin in mod.import_modules.items() if origin == "numpy"
+        }
+        self.jax_aliases = {
+            local for local, origin in mod.import_modules.items()
+            if origin in ("jax", "jax.numpy")
+        }
+        self.arrayish = self._arrayish_params(fn.node)
+
+    def _arrayish_params(self, node: ast.AST) -> Set[str]:
+        """Parameters used as arrays (all of them, for seed bodies)."""
+        args = getattr(node, "args", None)
+        params = set()
+        if args is not None:
+            params = {a.arg for a in list(args.args) + list(args.kwonlyargs) if a.arg != "self"}
+            if args.vararg:
+                params.add(args.vararg.arg)
+        # a param the body isinstance-checks is a host scalar/config by
+        # contract (the check itself would raise on a tracer) — never traced
+        host_checked: Set[str] = set()
+        for n in ast.walk(node):
+            if (isinstance(n, ast.Call) and isinstance(n.func, ast.Name)
+                    and n.func.id == "isinstance" and n.args
+                    and isinstance(n.args[0], ast.Name)):
+                host_checked.add(n.args[0].id)
+        evidence: Set[str] = set(params - host_checked) if self.seed else set()
+        if not self.seed:
+            for n in ast.walk(node):
+                # x.astype(...) / x.sum() — receiver used as an array
+                if isinstance(n, ast.Attribute) and n.attr in _ARRAY_METHODS and isinstance(n.value, ast.Name):
+                    if n.value.id in params:
+                        evidence.add(n.value.id)
+                # jnp.foo(x, ...) — positional args to jax/numpy-namespace calls
+                elif isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute):
+                    recv = n.func.value
+                    if isinstance(recv, ast.Name) and recv.id in self.jax_aliases:
+                        for a in n.args:
+                            if isinstance(a, ast.Name) and a.id in params:
+                                evidence.add(a.id)
+        # propagate through simple local assignments (v = jnp.abs(x); two
+        # passes cover short chains — enough for lint recall)
+        for _ in range(2):
+            for n in ast.walk(node):
+                if isinstance(n, ast.Assign) and _is_traced_expr_names(n.value, evidence, self.jax_aliases):
+                    for tgt in n.targets:
+                        if isinstance(tgt, ast.Name):
+                            evidence.add(tgt.id)
+        return evidence - host_checked
+
+
+def _is_traced_expr_names(node: ast.AST, arrayish: Set[str], jax_aliases: Set[str]) -> bool:
+    """Assignment-RHS tracedness for the local-propagation pass (a trimmed
+    mirror of :func:`_is_traced_expr` that needs no _Body)."""
+    if isinstance(node, ast.Name):
+        return node.id in arrayish
+    if isinstance(node, ast.Call):
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            recv = f.value
+            if isinstance(recv, ast.Name) and recv.id in jax_aliases:
+                return f.attr not in STATIC_ATTRS and f.attr not in NP_ALLOWED
+            if f.attr in _ARRAY_METHODS:
+                return _is_traced_expr_names(recv, arrayish, jax_aliases)
+            if isinstance(recv, ast.Name) and recv.id == "self":
+                # self._helper(x): a function of traced args yields traced data
+                return any(_is_traced_expr_names(a, arrayish, jax_aliases) for a in node.args)
+        return False
+    if isinstance(node, ast.BinOp):
+        return (_is_traced_expr_names(node.left, arrayish, jax_aliases)
+                or _is_traced_expr_names(node.right, arrayish, jax_aliases))
+    if isinstance(node, ast.UnaryOp):
+        return _is_traced_expr_names(node.operand, arrayish, jax_aliases)
+    if isinstance(node, ast.Subscript):
+        return _is_traced_expr_names(node.value, arrayish, jax_aliases)
+    return False
+
+
+def _compute_seed_bodies(index: PackageIndex, models: Dict[str, MetricModel]) -> List[FunctionInfo]:
+    seeds: List[FunctionInfo] = []
+    seen: Set[int] = set()
+
+    def add(fn: Optional[FunctionInfo]) -> None:
+        if fn is not None and id(fn.node) not in seen:
+            seen.add(id(fn.node))
+            seeds.append(fn)
+
+    for model in models.values():
+        cls = model.cls
+        if model.is_host:
+            continue  # eager by design: numpy/host work is the whole point
+        for name in SEED_METHODS:
+            if name in cls.methods:
+                add(cls.methods[name])
+        if "_compute" in cls.methods and model.jittable_compute is not False:
+            add(cls.methods["_compute"])
+    # dispatch-program builders in metric.py: functions nested in _get_*_fn
+    for mod in index.modules.values():
+        if not mod.modname.endswith(".metric"):
+            continue
+        for cls in mod.classes.values():
+            for mname, m in cls.methods.items():
+                if mname.startswith("_get_") and mname.endswith("_fn"):
+                    for n in ast.walk(m.node):
+                        if isinstance(n, ast.FunctionDef) and n is not m.node:
+                            add(FunctionInfo(n.name, f"{cls.name}.{mname}.{n.name}",
+                                             n, mod, class_name=cls.name))
+    return seeds
+
+
+def _callees(fn: FunctionInfo, index: PackageIndex) -> List[FunctionInfo]:
+    """Same-package functions/methods a body calls (name-based)."""
+    out: List[FunctionInfo] = []
+    mod = fn.module
+    cls: Optional[ClassInfo] = mod.classes.get(fn.class_name) if fn.class_name else None
+    for n in ast.walk(fn.node):
+        if not isinstance(n, ast.Call):
+            continue
+        f = n.func
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+            if f.value.id == "self" and cls is not None:
+                target = index.find_method(cls, f.attr)
+                if target is not None:
+                    out.append(target)
+            elif f.value.id in mod.import_modules:
+                target_modname = mod.import_modules[f.value.id]
+                target_mod = index.modules.get(target_modname)
+                if target_mod and f.attr in target_mod.functions:
+                    out.append(target_mod.functions[f.attr])
+        elif isinstance(f, ast.Name):
+            if f.id in mod.functions:
+                out.append(mod.functions[f.id])
+            elif f.id in mod.imports:
+                origin = mod.imports[f.id]
+                target_modname, _, fn_name = origin.rpartition(".")
+                target_mod = index.modules.get(target_modname)
+                if target_mod and fn_name in target_mod.functions:
+                    out.append(target_mod.functions[fn_name])
+    return out
+
+
+def jit_reachable(index: PackageIndex, models: Dict[str, MetricModel]) -> List[Tuple[FunctionInfo, bool]]:
+    """Transitive closure of the seed set over same-package calls.
+
+    Returns ``(body, is_seed)`` pairs — seeds get the stricter
+    all-params-are-traced treatment (see :class:`_Body`)."""
+    seeds = _compute_seed_bodies(index, models)
+    seed_ids = {id(f.node) for f in seeds}
+    seen: Set[int] = set(seed_ids)
+    queue = list(seeds)
+    order: List[Tuple[FunctionInfo, bool]] = []
+    while queue:
+        fn = queue.pop()
+        order.append((fn, id(fn.node) in seed_ids))
+        for callee in _callees(fn, index):
+            if id(callee.node) not in seen:
+                seen.add(id(callee.node))
+                queue.append(callee)
+    order.sort(key=lambda pair: (pair[0].module.relpath, pair[0].qualname))
+    return order
+
+
+# --------------------------------------------------------------- violations
+
+def _guard_kind(test: ast.AST) -> Optional[str]:
+    """Classify an ``_is_traced`` guard test: ``"traced"`` (body runs under
+    trace), ``"not-traced"`` (body runs only on concrete values), or None."""
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        return {"traced": "not-traced", "not-traced": "traced"}.get(_guard_kind(test.operand) or "")
+    if isinstance(test, ast.Call):
+        f = test.func
+        name = f.id if isinstance(f, ast.Name) else (f.attr if isinstance(f, ast.Attribute) else "")
+        if name == "_is_traced":
+            return "traced"
+    return None
+
+
+def _concrete_only_nodes(fn_node: ast.AST) -> Set[int]:
+    """Node ids inside concrete-only paths, per the runtime's own guard
+    idiom: ``if not _is_traced(...): <host work>`` bodies and everything
+    after an early ``if _is_traced(...): return``. Host coercions there are
+    deliberate eager-path behavior, not jit hazards."""
+    roots: List[ast.AST] = []
+
+    def scan(stmts: List[ast.stmt]) -> None:
+        stop = False
+        for stmt in stmts:
+            if stop:
+                roots.append(stmt)
+                continue
+            if isinstance(stmt, ast.If):
+                kind = _guard_kind(stmt.test)
+                if kind == "not-traced":
+                    roots.extend(stmt.body)
+                    scan(stmt.orelse)
+                    continue
+                if kind == "traced":
+                    scan(stmt.body)
+                    roots.extend(stmt.orelse)
+                    if stmt.body and isinstance(stmt.body[-1], (ast.Return, ast.Raise)):
+                        stop = True
+                    continue
+            for field in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, field, None)
+                if isinstance(sub, list):
+                    scan(sub)
+
+    scan(getattr(fn_node, "body", []))
+    skipped: Set[int] = set()
+    for root in roots:
+        for n in ast.walk(root):
+            skipped.add(id(n))
+    return skipped
+
+def _is_traced_expr(node: ast.AST, body: "_Body") -> bool:
+    """Evidence that an expression yields a TRACED value (high precision:
+    config scalars, ``self.*`` attributes, shapes and plain params never
+    trip this — only names with array-usage evidence and jnp-namespace call
+    results do)."""
+    if isinstance(node, ast.Name):
+        return node.id in body.arrayish
+    if isinstance(node, ast.Attribute):
+        if node.attr in STATIC_ATTRS:
+            return False
+        if node.attr in _ARRAY_METHODS:  # x.sum(...) receiver chain
+            return _is_traced_expr(node.value, body)
+        return False
+    if isinstance(node, ast.Call):
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            recv = f.value
+            if isinstance(recv, ast.Name) and recv.id in body.jax_aliases:
+                # jnp.sum(x) is traced; jnp.result_type/issubdtype/finfo are
+                # dtype-level metadata and static under trace
+                return f.attr not in STATIC_ATTRS and f.attr not in NP_ALLOWED
+            if f.attr in _ARRAY_METHODS:
+                return _is_traced_expr(recv, body)
+        return False
+    if isinstance(node, ast.BinOp):
+        return _is_traced_expr(node.left, body) or _is_traced_expr(node.right, body)
+    if isinstance(node, ast.UnaryOp):
+        return _is_traced_expr(node.operand, body)
+    if isinstance(node, ast.Subscript):
+        return _is_traced_expr(node.value, body)
+    return False
+
+
+def _test_uses_traced(node: ast.AST, arrayish: Set[str]) -> Optional[str]:
+    """Name of a traced value used non-statically in a branch test, if any."""
+    if isinstance(node, ast.Name):
+        return node.id if node.id in arrayish else None
+    if isinstance(node, ast.Attribute):
+        if node.attr in STATIC_ATTRS:
+            return None
+        return _test_uses_traced(node.value, arrayish)
+    if isinstance(node, ast.Call):
+        f = node.func
+        if isinstance(f, ast.Name) and f.id in STATIC_CALLS:
+            return None
+        for child in list(node.args) + [kw.value for kw in node.keywords]:
+            hit = _test_uses_traced(child, arrayish)
+            if hit:
+                return hit
+        return None
+    if isinstance(node, ast.Compare):
+        # `x is None` / `x is not None` are trace-time identity checks and
+        # `k in state` is dict-key membership — both static under trace
+        if all(isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn)) for op in node.ops):
+            return None
+        for child in [node.left] + list(node.comparators):
+            hit = _test_uses_traced(child, arrayish)
+            if hit:
+                return hit
+        return None
+    if isinstance(node, (ast.BoolOp, ast.BinOp, ast.UnaryOp, ast.Subscript, ast.IfExp)):
+        for child in ast.iter_child_nodes(node):
+            hit = _test_uses_traced(child, arrayish)
+            if hit:
+                return hit
+        return None
+    return None
+
+
+def check_tracer_hygiene(index: PackageIndex, models: Dict[str, MetricModel]) -> List[Finding]:
+    findings: List[Finding] = []
+    for fn, is_seed in jit_reachable(index, models):
+        body = _Body(fn, seed=is_seed)
+        path = fn.module.relpath
+        sym = fn.qualname
+        concrete_only = _concrete_only_nodes(fn.node)
+        for node in ast.walk(fn.node):
+            if id(node) in concrete_only:
+                continue
+            if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+                attr = node.func.attr
+                recv = node.func.value
+                if attr in ("item", "tolist") and not node.args:
+                    findings.append(Finding(
+                        "tracer/item", path, sym, f"{attr}()",
+                        f".{attr}() forces a device→host readback inside a jit-reachable body",
+                        node.lineno))
+                elif attr == "device_get":
+                    findings.append(Finding(
+                        "tracer/device-get", path, sym, "device_get",
+                        "jax.device_get inside a jit-reachable body is an explicit D2H transfer",
+                        node.lineno))
+                elif (isinstance(recv, ast.Name) and recv.id in body.np_aliases
+                      and attr not in NP_ALLOWED
+                      and any(_is_traced_expr(a, body) for a in node.args)):
+                    findings.append(Finding(
+                        "tracer/numpy-call", path, sym, f"np.{attr}",
+                        f"np.{attr}(...) on a traced value falls off the XLA graph "
+                        "(host math / TracerArrayConversionError); use jnp or hoist to _prepare_inputs",
+                        node.lineno))
+            elif (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+                  and node.func.id in ("float", "int", "bool") and len(node.args) == 1):
+                arg = node.args[0]
+                if _is_traced_expr(arg, body):
+                    findings.append(Finding(
+                        "tracer/coercion", path, sym, f"{node.func.id}()",
+                        f"{node.func.id}(...) on a traced value is a concretizing "
+                        "device→host coercion inside a jit-reachable body",
+                        node.lineno))
+            elif isinstance(node, (ast.If, ast.While)):
+                hit = _test_uses_traced(node.test, body.arrayish)
+                if hit:
+                    kind = "if" if isinstance(node, ast.If) else "while"
+                    findings.append(Finding(
+                        "tracer/py-branch", path, sym, f"{kind}:{hit}",
+                        f"Python `{kind}` on traced value `{hit}` — trace-time "
+                        "ConcretizationTypeError; use jnp.where/lax.cond",
+                        node.lineno))
+    return findings

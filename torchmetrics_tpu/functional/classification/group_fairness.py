@@ -13,6 +13,7 @@ from typing import Dict, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from ...utilities.checks import _is_traced
 from ...utilities.compute import _safe_divide
 from ...utilities.prints import rank_zero_warn
 from .stat_scores import (
@@ -25,13 +26,17 @@ Array = jax.Array
 
 
 def _groups_validation(groups: Array, num_groups: int) -> None:
-    if int(jnp.max(groups)) > num_groups:
-        raise ValueError(
-            f"The largest number in the groups tensor is {int(jnp.max(groups))}, which is larger than the specified",
-            f"number of groups {num_groups}. The group identifiers should be ``0, 1, ..., (num_groups - 1)``.",
-        )
     if not jnp.issubdtype(jnp.asarray(groups).dtype, jnp.integer):
         raise ValueError(f"Expected dtype of argument groups to be integer, not {jnp.asarray(groups).dtype}.")
+    if _is_traced(groups):
+        # under jit the values are abstract — the range check would concretize
+        # (ConcretizationTypeError); it runs eagerly in _prepare_inputs instead
+        return
+    if int(jnp.max(groups)) >= num_groups:
+        raise ValueError(
+            f"The largest number in the groups tensor is {int(jnp.max(groups))}, which is out of range for the "
+            f"specified number of groups {num_groups}. The group identifiers should be ``0, 1, ..., (num_groups - 1)``."
+        )
 
 
 def _binary_groups_stat_scores(

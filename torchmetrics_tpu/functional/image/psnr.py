@@ -39,7 +39,12 @@ def _psnr_update(preds, target, dim: Optional[Union[int, Tuple[int, ...]]] = Non
     if not dim_list:
         num_obs = jnp.asarray(target.size)
     else:
-        num_obs = jnp.asarray(int(jnp.prod(jnp.asarray([target.shape[d] for d in dim_list]))))
+        # shapes are trace-time static: a plain python product, never a
+        # device op + int() readback of its result
+        n = 1
+        for d in dim_list:
+            n *= target.shape[d]
+        num_obs = jnp.asarray(n)
         num_obs = jnp.broadcast_to(num_obs, sum_squared_error.shape)
     return sum_squared_error, num_obs
 
