@@ -864,9 +864,9 @@ def bench_streaming() -> dict:
     mk = lambda: MulticlassAccuracy(num_classes, average="micro", validate_args=False)
 
     def live_state(metric):
-        # the wrapper's real state is its ring/decay pytree; plain metrics
-        # keep theirs in _state — block on whichever actually holds the work
-        for attr in ("_ring", "_dstate"):
+        # the wrapper's real state is its window/ring/decay pytree; plain
+        # metrics keep theirs in _state — block on whatever holds the work
+        for attr in ("_wstate", "_ring", "_dstate"):
             obj = getattr(metric, attr, None)
             if obj is not None:
                 return obj
@@ -893,14 +893,17 @@ def bench_streaming() -> dict:
         (out["plain_updates_per_sec"] / out["windowed_updates_per_sec"] - 1.0) * 100.0, 2
     )
 
-    # one-compile proof: N rolls, exactly one fresh wupdate compile
+    # one-compile proof: N rolls, exactly one fresh windowed-program compile
+    # (the auto tier is now dual — the column keeps its historical name and
+    # counts across every window tag so the proof survives tier changes)
     with obs.telemetry_session() as rec:
         sw = SlidingWindow(mk(), 32)
         for _ in range(40):
             sw.update(preds, target)
     snap = rec.counters.snapshot()
     out["wupdate_fresh_compiles"] = sum(
-        v["compiles"] for k, v in snap.per_key.items() if k.endswith(".wupdate")
+        v["compiles"] for k, v in snap.per_key.items()
+        if k.endswith((".wupdate", ".wdual", ".wstack"))
     )
     out["window_rolls"] = snap.counts["window_rolls"]
 
@@ -990,6 +993,133 @@ def bench_streaming() -> dict:
     return out
 
 
+def bench_streaming_100k() -> dict:
+    """Config ``streaming_window_100k``: the tiered windowed state (ISSUE 12)
+    at a window length the PR 10 ring could never hold per-tenant.
+
+    - ``state_memory_bytes_100k`` / ``_1k`` + ``dual_mem_window_ratio``: a
+      dual-form window's state bytes must be WINDOW-INDEPENDENT (ratio 1.0) —
+      the whole point of the recurrent form; the ring column reports the same
+      metric's ring cost at the feasible comparison window for scale.
+    - ``dual_updates_per_sec_100k`` vs ``ring_updates_per_sec``: per-update
+      cost of the fused dual program (no roll-cursor scatter) against the
+      PR 10 donated ring scatter at the ring's feasible window — the dual
+      update must not be slower. ``two_stack_updates_per_sec_100k`` rides the
+      same loop with the tier forced (paned DABA stacks).
+    - ``windowed_tenants_per_sec_1k`` / ``plain_tenants_per_sec_1k`` +
+      ``windowed_serving_ratio``: ServingEngine(window=) throughput against
+      the unwindowed engine at the same shape — windowed tenants must hold
+      ≥80% of the unwindowed rate (gated via the ratio).
+    - ``vwupdate_fresh_compiles``: one-compile proof for the windowed
+      megabatch program, like vupdate's.
+    """
+    import jax
+
+    from torchmetrics_tpu import observability as obs
+    from torchmetrics_tpu.classification import MulticlassAccuracy
+    from torchmetrics_tpu.serving import ServingConfig, ServingEngine
+    from torchmetrics_tpu.streaming import SlidingWindow
+
+    num_classes, batch = 10, 2048
+    big_window, ring_window = 100_000, 4096
+    rng = np.random.default_rng(23)
+
+    import jax.numpy as jnp
+
+    preds_dev = jnp.asarray(rng.normal(size=(batch, num_classes)).astype(np.float32))
+    target_dev = jnp.asarray(rng.integers(0, num_classes, batch, dtype=np.int32))
+    mk = lambda: MulticlassAccuracy(num_classes, average="micro", validate_args=False)
+
+    def live_state(metric):
+        for attr in ("_wstate", "_ring"):
+            obj = getattr(metric, attr, None)
+            if obj is not None:
+                return obj
+        return metric._state
+
+    def rate(metric, iters=150, warm=40):
+        for _ in range(warm):
+            metric.update(preds_dev, target_dev)
+        jax.block_until_ready(live_state(metric))
+        best = 0.0
+        for _ in range(3):
+            start = time.perf_counter()
+            for _ in range(iters):
+                metric.update(preds_dev, target_dev)
+            jax.block_until_ready(live_state(metric))
+            best = max(best, iters / (time.perf_counter() - start))
+        return round(best, 2)
+
+    out = {}
+    out["dual_updates_per_sec_100k"] = rate(SlidingWindow(mk(), big_window))
+    out["two_stack_updates_per_sec_100k"] = rate(SlidingWindow(mk(), big_window, tier="two_stack"))
+    out["ring_updates_per_sec"] = rate(SlidingWindow(mk(), ring_window, tier="ring"))
+    out["ring_window"] = ring_window
+
+    # window-independence: metadata-only state bytes (zero device reads)
+    b100k = SlidingWindow(mk(), big_window).state_memory()["total_bytes"]
+    b1k = SlidingWindow(mk(), 1000).state_memory()["total_bytes"]
+    ring_bytes = SlidingWindow(mk(), ring_window, tier="ring")
+    ring_bytes.update(preds_dev, target_dev)
+    out["state_memory_bytes_100k"] = b100k
+    out["state_memory_bytes_1k"] = b1k
+    out["dual_mem_window_ratio"] = round(b100k / b1k, 4)
+    out["ring_state_memory_bytes"] = ring_bytes.state_memory()["total_bytes"]
+
+    # windowed tenants vs plain tenants at the same serving shape. HOST numpy
+    # batches (the RPC ingest shape) like the multi_tenant_serving config.
+    preds_host = np.asarray(preds_dev[:32])
+    target_host = np.asarray(target_dev[:32])
+    n_tenants, mbs, steps = 1000, 256, 3
+
+    def tenants_rate(config):
+        engine = ServingEngine(mk(), config)
+        for t in range(n_tenants):
+            engine.update(t, preds_host, target_host)
+        engine.flush()
+        engine.block_until_ready()
+        best = 0.0
+        for _ in range(3):
+            start = time.perf_counter()
+            for _ in range(steps):
+                for t in range(n_tenants):
+                    engine.update(t, preds_host, target_host)
+                engine.flush()
+            engine.block_until_ready()
+            best = max(best, n_tenants * steps / (time.perf_counter() - start))
+        return round(best, 2)
+
+    out["plain_tenants_per_sec_1k"] = tenants_rate(
+        ServingConfig(capacity=n_tenants, megabatch_size=mbs)
+    )
+    out["windowed_tenants_per_sec_1k"] = tenants_rate(
+        ServingConfig(capacity=n_tenants, megabatch_size=mbs, window=big_window)
+    )
+    out["windowed_serving_ratio"] = round(
+        out["windowed_tenants_per_sec_1k"] / out["plain_tenants_per_sec_1k"], 3
+    )
+
+    # one-compile proof: every windowed tenant of a shape-class shares ONE
+    # fresh vwupdate compile (plus the window_rolls/rotations accounting)
+    with obs.telemetry_session() as rec:
+        eng = ServingEngine(mk(), ServingConfig(capacity=64, megabatch_size=32, window=8))
+        for rounds in range(3):
+            for t in range(64):
+                eng.update(t, preds_host, target_host)
+            eng.flush()
+        eng.block_until_ready()
+    snap = rec.counters.snapshot()
+    out["vwupdate_fresh_compiles"] = sum(
+        v["compiles"] for k, v in snap.per_key.items() if k.endswith(".vwupdate")
+    )
+    out["windowed_rows_recorded"] = snap.counts["window_rolls"]
+    out["unit"] = (
+        f"updates/s (batch={batch}, C={num_classes}, dual/two-stack window={big_window}, "
+        f"ring window={ring_window}; serving: {n_tenants} tenants, megabatch={mbs})"
+    )
+    return out
+
+
 def bench_fault_selftest() -> dict:
     """Hidden config (leading underscore: excluded from the main run) proving the
     retry wrapper end to end: the FIRST subprocess attempt dies with the round-5
@@ -1014,6 +1144,7 @@ CONFIGS = {
     "bertscore_clipscore": bench_bertscore_clipscore,
     "multi_tenant_serving": bench_multi_tenant,
     "streaming_window": bench_streaming,
+    "streaming_window_100k": bench_streaming_100k,
     "_fault_selftest": bench_fault_selftest,
 }
 

@@ -139,11 +139,22 @@ def test_registry_clean_on_real_tree():
 
 
 def test_registered_tags_match_runtime_set():
-    """The statically parsed tag registry is exactly the six runtime planes."""
+    """The statically parsed tag registry is exactly the ten runtime planes
+    (ISSUE 12 added the tiered-window tags wdual/wstack/vwupdate/vwcompute)."""
     from tools.graftlint.registry import registered_tags, reserved_keys
     idx = build_index(REPO_ROOT)
-    assert registered_tags(idx) == {"update", "forward", "vupdate", "wupdate", "dupdate", "vcompute"}
-    assert reserved_keys(idx) == {"__tenant_n", "__window_cursor", "__window_n", "__decay_n"}
+    assert registered_tags(idx) == {
+        "update", "forward", "vupdate", "wupdate", "wdual", "wstack",
+        "vwupdate", "vwcompute", "dupdate", "vcompute",
+    }
+    assert reserved_keys(idx) == {
+        "__tenant_n", "__window_cursor", "__window_n", "__decay_n",
+        # two-stack window accumulator PREFIXES (each real state name k gets
+        # companion leaves under prefix+k; the `__` near-miss check covers
+        # the whole namespace — the dual tier packs its pair under the
+        # state's own name and needs no reserved prefix)
+        "__window_front:", "__window_back:", "__window_bagg:",
+    }
 
 
 # ------------------------------------------------------------- fleet layout
@@ -274,6 +285,47 @@ def test_matrix_runtime_cross_validation():
     with pytest.raises(TorchMetricsUserError):
         BinaryAUROC()._get_vupdate_fn()  # thresholds=None -> cat list state
     BinaryAUROC(thresholds=16)._get_vupdate_fn()  # binned -> static state
+
+
+def test_matrix_window_tier_cross_validation():
+    """The static window-tier column (ISSUE 12) agrees with the runtime
+    `metric.window_tier` derivation and the windowed-serving guard."""
+    pytest.importorskip("jax")
+    from torchmetrics_tpu.aggregation import CatMetric, MaxMetric, MeanMetric, SumMetric
+    from torchmetrics_tpu.classification import MulticlassConfusionMatrix
+    from torchmetrics_tpu.metric import window_tier
+    from torchmetrics_tpu.regression import PearsonCorrCoef
+    from torchmetrics_tpu.serving import ServingConfig, ServingEngine
+    from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
+
+    _, matrix = run_checks(REPO_ROOT, families=("registry",))
+    rows = matrix["metrics"]
+    pairs = [
+        ("torchmetrics_tpu.aggregation.SumMetric", SumMetric()),
+        ("torchmetrics_tpu.aggregation.MeanMetric", MeanMetric()),
+        ("torchmetrics_tpu.aggregation.MaxMetric", MaxMetric()),
+        ("torchmetrics_tpu.classification.confusion_matrix.MulticlassConfusionMatrix",
+         MulticlassConfusionMatrix(num_classes=3, validate_args=False)),
+        ("torchmetrics_tpu.regression.pearson.PearsonCorrCoef", PearsonCorrCoef()),
+    ]
+    for qual, inst in pairs:
+        assert rows[qual]["window_tier"] == window_tier(inst), qual
+    # CatMetric's states are config-conditional (nan_strategy) -> static "?",
+    # while this concrete construction lands in the ring tier at runtime
+    assert rows["torchmetrics_tpu.aggregation.CatMetric"]["window_tier"] in ("ring", "?")
+    assert window_tier(CatMetric()) == "ring"
+    # vwupdate verdicts mirror the windowed-engine construction guard
+    assert rows["torchmetrics_tpu.classification.confusion_matrix.MulticlassConfusionMatrix"][
+        "planes"]["vwupdate"] == "yes"
+    ServingEngine(MulticlassConfusionMatrix(num_classes=3, validate_args=False),
+                  ServingConfig(capacity=4, megabatch_size=2, window=4))
+    assert rows["torchmetrics_tpu.regression.pearson.PearsonCorrCoef"]["planes"]["vwupdate"] == "no"
+    with pytest.raises(TorchMetricsUserError):
+        ServingEngine(PearsonCorrCoef(), ServingConfig(capacity=4, megabatch_size=2, window=4))
+    # the matrix carries fleet-wide tier totals for the doc rollup
+    totals = matrix["window_tier_totals"]
+    assert set(totals) == {"dual", "two_stack", "ring", "?"}
+    assert sum(totals.values()) == len(rows)
 
 
 def test_matrix_runtime_cross_validation_host_metric():

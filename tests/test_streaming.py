@@ -126,6 +126,21 @@ def _freeze_states(coll):
 # ------------------------------------------------------------ window parity
 
 
+def _oracle_check(sw, factory, batches, rtol=1e-5, atol=1e-6):
+    """THE window-parity oracle, tier-aware: the wrapped value equals a fresh
+    metric fed exactly the trailing ``covered_updates()`` batches. For the
+    ring tier covered == min(n, window) (per-update exact); the dual/two-stack
+    tiers advance the boundary in hops, and covered names the exact span."""
+    for b in batches:
+        sw.update(*b)
+    cov = sw.covered_updates()
+    assert cov >= min(len(batches), sw.window)  # never LESS context than asked
+    plain = factory()
+    for b in batches[-cov:] if cov else []:
+        plain.update(*b)
+    _value_close(sw.compute(), plain.compute(), rtol=rtol, atol=atol)
+
+
 WINDOW_FAMILIES = [
     ("accuracy", lambda: MulticlassAccuracy(num_classes=5, average="micro", validate_args=False)),
     ("precision", lambda: MulticlassPrecision(num_classes=5, average="macro", validate_args=False)),
@@ -134,33 +149,50 @@ WINDOW_FAMILIES = [
 
 
 @pytest.mark.parametrize("name,factory", WINDOW_FAMILIES, ids=[f[0] for f in WINDOW_FAMILIES])
+@pytest.mark.parametrize("tier", ["auto", "dual", "two_stack", "ring"])
 @pytest.mark.parametrize("window,stream", [(4, 11), (5, 5), (8, 3)])
-def test_window_parity_classification(name, factory, window, stream):
-    """The oracle: SlidingWindow(N) over the stream == plain metric over the
-    trailing N batches, for windows smaller, equal, and larger than the stream."""
+def test_window_parity_classification(name, factory, tier, window, stream):
+    """The oracle across every tier (forced explicitly — the ISSUE 12
+    acceptance bar), for windows smaller, equal, and larger than the stream.
+    These sum-reduced classification metrics auto-select the dual tier."""
     rng = np.random.default_rng(hash((name, window, stream)) % (2**32))
     batches = _cls_batches(rng, stream)
-    sw = SlidingWindow(factory(), window)
-    for p, t in batches:
+    sw = SlidingWindow(factory(), window, tier=tier)
+    if tier == "auto":
+        assert sw.tier == "dual"  # sum-reduced states collapse to the pair
+    _oracle_check(sw, factory, batches)
+
+
+def test_window_ring_tier_exact_trailing_n():
+    """The forced ring stays per-update exact: covered == min(n, window) at
+    EVERY phase (the PR 10 contract, now an opt-in tier)."""
+    rng = np.random.default_rng(11)
+    batches = _cls_batches(rng, 11)
+    mk = WINDOW_FAMILIES[0][1]
+    sw = SlidingWindow(mk(), 4, tier="ring")
+    for i, (p, t) in enumerate(batches):
         sw.update(p, t)
-    plain = factory()
-    for p, t in batches[-window:]:
+        assert sw.covered_updates() == min(i + 1, 4)
+    plain = mk()
+    for p, t in batches[-4:]:
         plain.update(p, t)
     _value_close(sw.compute(), plain.compute())
 
 
-@pytest.mark.parametrize("factory,feed", [
-    (SumMetric, "scalar"),
-    (MeanMetric, "vector"),
-    (MaxMetric, "scalar"),
-    (MinMetric, "vector"),
-    (MeanSquaredError, "pair"),
+@pytest.mark.parametrize("factory,feed,expect_tier", [
+    (SumMetric, "scalar", "dual"),
+    (MeanMetric, "vector", "dual"),
+    (MaxMetric, "scalar", "two_stack"),
+    (MinMetric, "vector", "two_stack"),
+    (MeanSquaredError, "pair", "dual"),
 ])
-def test_window_parity_aggregation_regression(factory, feed):
+@pytest.mark.parametrize("tier", ["auto", "ring"])
+def test_window_parity_aggregation_regression(factory, feed, expect_tier, tier):
     rng = np.random.default_rng(3)
     window, stream = 3, 9
-    sw = SlidingWindow(factory(), window)
-    plain = factory()
+    sw = SlidingWindow(factory(), window, tier=tier)
+    if tier == "auto":
+        assert sw.tier == expect_tier
     batches = []
     for _ in range(stream):
         if feed == "scalar":
@@ -172,26 +204,31 @@ def test_window_parity_aggregation_regression(factory, feed):
                 jnp.asarray(rng.normal(size=(6,)).astype(np.float32)),
                 jnp.asarray(rng.normal(size=(6,)).astype(np.float32)),
             ))
-    for b in batches:
-        sw.update(*b)
-    for b in batches[-window:]:
-        plain.update(*b)
-    _value_close(sw.compute(), plain.compute())
+    _oracle_check(sw, factory, batches)
 
 
-def test_window_parity_bf16_inputs():
+@pytest.mark.parametrize("tier,pane", [("dual", None), ("two_stack", None),
+                                       ("two_stack", 3), ("ring", None)])
+def test_window_parity_tier_fuzz(tier, pane):
+    """Per-tier fuzz at awkward window/stream phases, incl. a pane that does
+    not divide the window (two-stack rounds the effective window UP)."""
+    rng = np.random.default_rng(29)
+    mk = lambda: MulticlassAccuracy(num_classes=5, average="micro", validate_args=False)
+    for window, stream in [(4, 11), (7, 23), (16, 5), (10, 37)]:
+        batches = _cls_batches(rng, stream)
+        sw = SlidingWindow(mk(), window, tier=tier, pane=pane)
+        _oracle_check(sw, mk, batches)
+
+
+@pytest.mark.parametrize("tier", ["dual", "two_stack", "ring"])
+def test_window_parity_bf16_inputs(tier):
     rng = np.random.default_rng(7)
     window = 3
     batches = _cls_batches(rng, 7, dtype=np.float32)
     batches = [(p.astype(jnp.bfloat16), t) for p, t in batches]
     mk = lambda: MulticlassAccuracy(num_classes=5, average="micro", validate_args=False)
-    sw = SlidingWindow(mk(), window)
-    plain = mk()
-    for p, t in batches:
-        sw.update(p, t)
-    for p, t in batches[-window:]:
-        plain.update(p, t)
-    _value_close(sw.compute(), plain.compute(), rtol=2e-2, atol=1e-2)
+    sw = SlidingWindow(mk(), window, tier=tier)
+    _oracle_check(sw, mk, batches, rtol=2e-2, atol=1e-2)
 
 
 def test_window_parity_list_state_bounded():
@@ -233,22 +270,45 @@ def test_window_forward_batch_value_and_reset():
 
 
 def test_window_one_compile_and_telemetry():
-    """One fresh wupdate compile serves every roll; the window_rolls counter
-    ticks per roll and the window_roll event fires once per completed wrap."""
+    """One fresh compile serves every windowed update (now under the dual
+    tier's ``wdual`` tag); window_rolls ticks per update, window_rotations
+    per dual block rotation, and the window_roll event fires per wrap."""
     rng = np.random.default_rng(5)
     batches = _cls_batches(rng, 10)
     with obs.telemetry_session() as rec:
         sw = SlidingWindow(MulticlassAccuracy(num_classes=5, average="micro", validate_args=False), 4)
+        assert sw.tier == "dual"
+        for p, t in batches:
+            sw.update(p, t)
+    snap = rec.counters.snapshot()
+    wkeys = {k: v for k, v in snap.per_key.items() if k.endswith(".wdual")}
+    assert sum(v["compiles"] for v in wkeys.values()) == 1
+    assert sum(v["compiles"] + v["cache_hits"] + v["aot_hits"] for v in wkeys.values()) == 10
+    assert snap["window_rolls"] == 10
+    assert snap["window_rotations"] == 2  # dual blocks rotated at updates 4 and 8
+    wraps = rec.events_of("window_roll")
+    assert len(wraps) == 2  # 10 updates / window 4 → wraps at 4 and 8
+    assert wraps[0].payload["window"] == 4
+    assert wraps[0].payload["tier"] == "dual" and wraps[0].tag == "wdual"
+
+
+def test_window_ring_one_compile_unchanged():
+    """The forced ring keeps its PR 10 contract: one wupdate compile, a roll
+    per update, zero rotations (rotation is a dual/two-stack notion)."""
+    rng = np.random.default_rng(6)
+    batches = _cls_batches(rng, 6)
+    with obs.telemetry_session() as rec:
+        sw = SlidingWindow(
+            MulticlassAccuracy(num_classes=5, average="micro", validate_args=False), 3,
+            tier="ring",
+        )
         for p, t in batches:
             sw.update(p, t)
     snap = rec.counters.snapshot()
     wkeys = {k: v for k, v in snap.per_key.items() if k.endswith(".wupdate")}
     assert sum(v["compiles"] for v in wkeys.values()) == 1
-    assert sum(v["compiles"] + v["cache_hits"] + v["aot_hits"] for v in wkeys.values()) == 10
-    assert snap["window_rolls"] == 10
-    wraps = rec.events_of("window_roll")
-    assert len(wraps) == 2  # 10 updates / window 4 → wraps at 4 and 8
-    assert wraps[0].payload["window"] == 4
+    assert snap["window_rolls"] == 6
+    assert snap["window_rotations"] == 0
 
 
 def test_window_rejects_host_and_composition():
@@ -768,25 +828,32 @@ def test_drift_monitor_rolling_reference_and_reset():
 
 
 def test_coalesce_version_is_bumped_for_streaming_counters():
-    assert C._VERSION == 5
+    assert C._VERSION == 6  # v6: tiered windows (window_rotations + wdual/wstack kinds)
     # the streaming counters are real fields of the piggybacked vector
-    for f in ("window_rolls", "async_syncs", "async_sync_wait_us",
+    for f in ("window_rolls", "window_rotations", "async_syncs", "async_sync_wait_us",
               "drift_evals", "drift_breaches", "serve_rejected"):
         assert f in obs.COUNTER_FIELDS
-    # the windowed roll's latency kind rides the fleet histogram vector
-    assert "wupdate" in obs.FLEET_HISTOGRAM_KINDS
+    # every window tier's dispatch latency kind rides the fleet histogram vector
+    for kind in ("wupdate", "wdual", "wstack"):
+        assert kind in obs.FLEET_HISTOGRAM_KINDS
 
 
-def test_wupdate_latency_rides_fleet_vector():
+def test_window_latency_rides_fleet_vector():
     from torchmetrics_tpu.observability import histograms as H
 
     with obs.telemetry_session() as rec:
-        sw = SlidingWindow(SumMetric(), 3)
+        sw = SlidingWindow(SumMetric(), 3, tier="ring")
         for x in range(5):
             sw.update(float(x))
+        dual = SlidingWindow(SumMetric(), 3)  # auto: dual
+        dual.update(1.0)
+        stack = SlidingWindow(MaxMetric(), 3)  # auto: two_stack
+        stack.update(1.0)
         vec = rec.histograms.fleet_vector()
     kinds = H.decode_fleet_vector(vec)
     assert kinds["wupdate"].count == 5
+    assert kinds["wdual"].count == 1
+    assert kinds["wstack"].count == 1
 
 
 def test_mixed_version_rows_degrade_to_local_rollup():
@@ -875,3 +942,332 @@ def test_async_handle_bare_usage_and_result():
     out = handle.commit()
     assert out is synced
     assert handle.overlap_pct >= 0.0
+
+
+# ------------------------------------------------- tiered windows (ISSUE 12)
+
+
+class IntCountMetric(Metric):
+    """int32 'sum' state — exercises the dual/two-stack accumulator dtype
+    policy (integer sum/mean leaves promote so long windows can't saturate)."""
+
+    def __init__(self):
+        super().__init__()
+        self.add_state("n", default=np.zeros((), np.int32), dist_reduce_fx="sum")
+
+    def _batch_state(self, x):
+        return {"n": jnp.asarray(x, jnp.int32).sum()}
+
+    def _compute(self, state):
+        return state["n"]
+
+
+class CallableReduceMetric(Metric):
+    """Callable (semigroup) reduction — lands in the two-stack tier."""
+
+    def __init__(self):
+        super().__init__()
+        self.add_state("prod", default=np.ones(()), dist_reduce_fx=lambda s: jnp.prod(s, axis=0))
+
+    def _batch_state(self, x):
+        return {"prod": jnp.asarray(x, jnp.float32)}
+
+    def _compute(self, state):
+        return state["prod"]
+
+
+def test_window_tier_selection_pinned():
+    """The reduce-tag → tier derivation (the same one graftlint's matrix
+    performs statically): sum/mean/None → dual; max/min/callable semigroups →
+    two_stack; custom merge / list-cat states → ring."""
+    from torchmetrics_tpu.metric import window_tier
+
+    assert window_tier(SumMetric()) == "dual"
+    assert window_tier(MeanMetric()) == "dual"
+    assert window_tier(MeanSquaredError()) == "dual"
+    assert window_tier(MulticlassAccuracy(num_classes=5, validate_args=False)) == "dual"
+    assert window_tier(MulticlassConfusionMatrix(num_classes=5, validate_args=False)) == "dual"
+    assert window_tier(IntCountMetric()) == "dual"
+    assert window_tier(MaxMetric()) == "two_stack"
+    assert window_tier(MinMetric()) == "two_stack"
+    assert window_tier(CallableReduceMetric()) == "two_stack"
+    assert window_tier(CatMetric()) == "ring"          # list ("cat") state
+    assert window_tier(LastValueMetric()) == "ring"    # custom _merge
+    # the wrapper reports the chosen tier per metric
+    assert SlidingWindow(MaxMetric(), 8).tier == "two_stack"
+    assert SlidingWindow(CatMetric(), 8).tier == "ring"
+    # an explicit pane is a granularity request: it forces the paned tier
+    # (pane=1 == exact per-update sliding) instead of being silently dropped
+    sw = SlidingWindow(SumMetric(), 8, pane=1)
+    assert sw.tier == "two_stack" and sw.pane == 1
+    with pytest.raises(ValueError):
+        SlidingWindow(SumMetric(), 8, tier="dual", pane=1)  # pane is two-stack-only
+    with pytest.raises(TorchMetricsUserError):
+        SlidingWindow(CatMetric(), 8, pane=1)  # ring-only metric cannot take a pane
+
+
+def test_window_tier_forced_rejections():
+    with pytest.raises(TorchMetricsUserError):
+        SlidingWindow(MaxMetric(), 4, tier="dual")  # max cannot fold in the pair
+    with pytest.raises(TorchMetricsUserError):
+        SlidingWindow(LastValueMetric(), 4, tier="two_stack")  # custom merge
+    with pytest.raises(TorchMetricsUserError):
+        SlidingWindow(CatMetric(), 4, tier="dual")  # list states need the ring
+    with pytest.raises(ValueError):
+        SlidingWindow(SumMetric(), 4, tier="bogus")
+    with pytest.raises(ValueError):
+        SlidingWindow(MaxMetric(), 4, tier="two_stack", pane=0)
+    # forcing ring anywhere is always legal (the exact-trailing-N opt-in)
+    assert SlidingWindow(MaxMetric(), 4, tier="ring").tier == "ring"
+
+
+def test_window_parity_callable_reduction_two_stack():
+    """Callable semigroup folds ride the two-stack tier in stream order."""
+    sw = SlidingWindow(CallableReduceMetric(), 4, pane=2)
+    assert sw.tier == "two_stack"
+    vals = [1.5, 2.0, 0.5, 3.0, 1.25, 0.8, 2.5]
+    for v in vals:
+        sw.update(v)
+    cov = sw.covered_updates()
+    expect = float(np.prod(vals[-cov:]))
+    np.testing.assert_allclose(float(np.asarray(sw.compute())), expect, rtol=1e-6)
+
+
+def test_window_dual_accumulator_dtype_policy():
+    """ISSUE 12 dtype fix: integer sum/mean leaves promote in the dual/
+    two-stack accumulators (f32 under x64-off — exact below 2^24) so a long
+    window cannot silently saturate int32; the fold's closed form stays
+    exact. The ring keeps the metric's own integer dtype (one update's
+    contribution per bucket never accumulates)."""
+    sw = SlidingWindow(IntCountMetric(), 5)
+    assert sw.tier == "dual"
+    for _ in range(12):
+        sw.update(np.full((3,), 1, np.int32))
+    leaf = sw._wstate["n"]
+    assert leaf.dtype == jnp.float32  # promoted pair (x64 off in tier-1 runs)
+    cov = sw.covered_updates()
+    assert float(np.asarray(sw.compute())) == 3.0 * cov  # closed form, exact
+    stack = SlidingWindow(IntCountMetric(), 6, tier="two_stack", pane=2)
+    for _ in range(9):
+        stack.update(np.full((2,), 1, np.int32))
+    assert stack._wstate["n"].dtype == jnp.float32
+    assert float(np.asarray(stack.compute())) == 2.0 * stack.covered_updates()
+    ring = SlidingWindow(IntCountMetric(), 4, tier="ring")
+    ring.update(np.full((2,), 1, np.int32))
+    assert ring._ring["n"].dtype == jnp.int32  # per-bucket contributions: no growth
+
+
+def test_window_state_memory_window_independent():
+    """The memory model the 100k bench gates: dual and two-stack state bytes
+    do not depend on the window length; the ring's do."""
+    mk = lambda: MulticlassAccuracy(num_classes=5, average="micro", validate_args=False)
+    dual_small = SlidingWindow(mk(), 1_000).state_memory()["total_bytes"]
+    dual_big = SlidingWindow(mk(), 100_000).state_memory()["total_bytes"]
+    assert dual_small == dual_big
+    stack_small = SlidingWindow(MaxMetric(), 1_000).state_memory()["total_bytes"]
+    stack_big = SlidingWindow(MaxMetric(), 100_000).state_memory()["total_bytes"]
+    assert stack_small == stack_big
+    ring_small = SlidingWindow(mk(), 8, tier="ring")
+    ring_big = SlidingWindow(mk(), 64, tier="ring")
+    p, t = _cls_batches(np.random.default_rng(0), 1)[0]
+    ring_small.update(p, t)
+    ring_big.update(p, t)
+    assert ring_big.state_memory()["total_bytes"] > ring_small.state_memory()["total_bytes"]
+
+
+@pytest.mark.aot
+def test_window_dual_aot_warm_start(tmp_path):
+    """AOT warm start for the new tags: a second 'boot' serves the first
+    wdual/wstack dispatch from the serialized-executable cache, and the
+    warm values match the cold path bitwise."""
+    from torchmetrics_tpu import aot
+
+    mk = lambda: MulticlassAccuracy(num_classes=5, average="micro", validate_args=False)
+    rng = np.random.default_rng(31)
+    batches = _cls_batches(rng, 6)
+    aot.enable(config=aot.AotConfig(cache_dir=str(tmp_path / "cache"), write_on_miss=True))
+    cold = SlidingWindow(mk(), 4)
+    stack_cold = SlidingWindow(MaxMetric(), 4, pane=2)
+    for p, t in batches:
+        cold.update(p, t)
+        stack_cold.update(float(np.asarray(p).sum()))
+    cold_value = np.asarray(cold.compute())
+    aot.disable()
+    aot.enable(config=aot.AotConfig(cache_dir=str(tmp_path / "cache")))  # fresh "boot"
+    with obs.telemetry_session() as rec:
+        warm = SlidingWindow(mk(), 4)
+        stack_warm = SlidingWindow(MaxMetric(), 4, pane=2)
+        for p, t in batches:
+            warm.update(p, t)
+            stack_warm.update(float(np.asarray(p).sum()))
+    aot.disable()
+    snap = rec.counters.snapshot()
+    assert snap["aot_cache_hits"] >= 2  # one wdual + one wstack load
+    for tag in (".wdual", ".wstack"):
+        keys = {k: v for k, v in snap.per_key.items() if k.endswith(tag)}
+        assert sum(v["compiles"] for v in keys.values()) == 0, tag
+        assert sum(v["aot_hits"] for v in keys.values()) == 1, tag
+    np.testing.assert_array_equal(np.asarray(warm.compute()), cold_value)
+
+
+# ------------------------------------------- windowed tenants (ServingEngine)
+
+
+@pytest.mark.serving
+def test_windowed_serving_parity_one_compile_and_rotations():
+    """ServingConfig(window=): every tenant gets a dual window inside the
+    stacked pytree; per-tenant values satisfy the covered-span oracle, ONE
+    vwupdate compile serves the fleet, compute_all folds windows vmapped,
+    and rotation accounting reaches the telemetry counters."""
+    rng = np.random.default_rng(41)
+    mk = lambda: MulticlassAccuracy(num_classes=4, average="micro", validate_args=False)
+    streams = {
+        t: [_serve_batch(rng) for _ in range(9)] for t in range(12)
+    }
+    with obs.telemetry_session() as rec:
+        eng = ServingEngine(mk(), ServingConfig(capacity=16, megabatch_size=4, window=3))
+        for i in range(9):
+            for t in range(12):
+                eng.update(t, *streams[t][i])
+        eng.flush()
+        for t in range(12):
+            cov = eng.covered_updates(t)
+            assert 3 <= cov < 6  # dual hop: window <= covered < 2*window
+            plain = mk()
+            for b in streams[t][-cov:]:
+                plain.update(*b)
+            np.testing.assert_allclose(
+                np.asarray(eng.compute(t)), np.asarray(plain.compute()), rtol=1e-6
+            )
+        vals = eng.compute_all()
+        for t in range(12):
+            np.testing.assert_allclose(
+                np.asarray(vals[t]), np.asarray(eng.compute(t)), rtol=1e-6
+            )
+    snap = rec.counters.snapshot()
+    vw = {k: v for k, v in snap.per_key.items() if k.endswith(".vwupdate")}
+    assert sum(v["compiles"] for v in vw.values()) == 1
+    assert snap["window_rolls"] == 12 * 9
+    assert snap["window_rotations"] == 12 * 3  # each tenant rotated at 3, 6, 9
+    s = eng.summary()
+    assert s["window"] == 3 and s["window_tier"] == "dual"
+    assert s["window_rotations"] == 12 * 3
+
+
+@pytest.mark.serving
+def test_windowed_serving_two_stack_spill_and_checkpoint():
+    """Two-stack windowed tenants survive LRU spill/readmit and checkpoint
+    round-trips (window-layout leaves ride the same host copies)."""
+    mk = MaxMetric
+    rng = np.random.default_rng(43)
+    eng = ServingEngine(
+        mk(), ServingConfig(capacity=4, megabatch_size=2, window=6,
+                            window_tier="two_stack", window_pane=2)
+    )
+    assert eng.summary()["window_tier"] == "two_stack"
+    vals = {t: [float(rng.normal()) for _ in range(13)] for t in range(8)}
+    for i in range(13):
+        for t in range(8):
+            eng.update(t, vals[t][i])
+    eng.flush()
+    assert any(t.spilled is not None for t in eng._tenants.values())
+    for t in range(8):
+        cov = eng.covered_updates(t)
+        assert cov >= 6
+        expect = max(vals[t][-cov:])
+        np.testing.assert_allclose(np.asarray(eng.compute(t)), expect, rtol=1e-6)
+    before = np.asarray(eng.compute(5))
+    sd = eng.state_dict(5)
+    eng.reset(5)
+    np.testing.assert_allclose(np.asarray(eng.compute(5)), MaxMetric().compute())
+    eng.load_state_dict(5, sd)
+    np.testing.assert_array_equal(np.asarray(eng.compute(5)), before)
+
+
+@pytest.mark.serving
+def test_windowed_serving_rejections_and_contracts():
+    mk = lambda: MulticlassAccuracy(num_classes=4, average="micro", validate_args=False)
+    with pytest.raises(TorchMetricsUserError):
+        ServingEngine(CatMetric(), ServingConfig(window=4))  # ring-only tier
+    with pytest.raises(ValueError):
+        ServingConfig(window=0)
+    with pytest.raises(ValueError):
+        ServingConfig(window=4, window_tier="ring")
+    eng = ServingEngine(mk(), ServingConfig(capacity=4, megabatch_size=2, window=4))
+    rng = np.random.default_rng(44)
+    eng.update(0, *_serve_batch(rng))
+    eng.flush()
+    with pytest.raises(TorchMetricsUserError):
+        eng.sync_async()  # windowed stacks have no defined cross-rank row fold
+    # a windowed checkpoint refuses to load into a differently-shaped engine
+    plain = ServingEngine(mk(), ServingConfig(capacity=4, megabatch_size=2))
+    plain.update(0, *_serve_batch(rng))
+    plain.flush()
+    with pytest.raises(TorchMetricsUserError):
+        eng.load_state_dict(1, plain.state_dict(0))
+
+
+@pytest.mark.serving
+def test_windowed_serving_quarantine_isolates_offender():
+    """Engine-level fault isolation works unchanged under vwupdate: a
+    poisoned megabatch rolls back and only the offender is quarantined."""
+    mk = lambda: MulticlassAccuracy(num_classes=4, average="micro", validate_args=False)
+    rng = np.random.default_rng(45)
+    eng = ServingEngine(
+        mk(), ServingConfig(capacity=8, megabatch_size=4, on_error="quarantine", window=3)
+    )
+    batch = _serve_batch(rng)
+    boom = {"armed": False}
+
+    def hook(tids):
+        if boom["armed"] and 2 in tids:  # fails the megabatch AND the re-drive
+            raise RuntimeError("poisoned tenant")
+
+    for t in range(4):
+        eng.update(t, *batch)
+    eng.flush()
+    eng._fault_hook = hook
+    boom["armed"] = True
+    for t in range(4):
+        eng.update(t, *batch)
+    eng.flush()
+    eng._fault_hook = None
+    roster = eng.tenants()
+    assert roster[2]["quarantined"]
+    for t in (0, 1, 3):
+        assert not roster[t]["quarantined"]
+        assert eng._tenants[t].update_count == 2
+
+
+@pytest.mark.serving
+def test_windowed_serving_ragged_phase_parity():
+    """Tenants at DIFFERENT window phases inside one vmapped megabatch: the
+    branch-free rotation/flip selection is per-row, so a dispatch that
+    rotates tenant A's block (or flips its two-stack) while tenant B is
+    mid-block must keep both exact. Ragged traffic drives every phase."""
+    rng = np.random.default_rng(77)
+    for cfg_kw, mk in [({}, SumMetric),
+                       ({"window_tier": "two_stack", "window_pane": 2}, SumMetric),
+                       ({}, MaxMetric)]:
+        eng = ServingEngine(mk(), ServingConfig(capacity=16, megabatch_size=4, window=5, **cfg_kw))
+        streams = {t: [] for t in range(10)}
+        for i in range(23):
+            for t in range(10):
+                if (i + t) % (t % 3 + 1) == 0:  # tenant-dependent cadence
+                    v = float(rng.normal())
+                    streams[t].append(v)
+                    eng.update(t, v)
+        eng.flush()
+        for t in range(10):
+            cov = eng.covered_updates(t)
+            plain = mk()
+            for v in streams[t][-cov:] if cov else []:
+                plain.update(v)
+            np.testing.assert_allclose(
+                np.asarray(eng.compute(t)), np.asarray(plain.compute()), rtol=1e-5
+            )
+        vals = eng.compute_all()
+        for t in range(10):
+            np.testing.assert_allclose(
+                np.asarray(vals[t]), np.asarray(eng.compute(t)), rtol=1e-6
+            )

@@ -112,7 +112,45 @@ THRESHOLDS: Dict[str, float] = {
     "extra.streaming_window.blocking_sync_ms": 0.6,
     "extra.streaming_window.wupdate_fresh_compiles": 0.25,
     "extra.streaming_window.async_state_parity": 0.01,
+    # tiered windowed state (ISSUE 12): throughputs wobble like the flagship;
+    # the memory columns are metadata-only and DETERMINISTIC, so they gate
+    # tight — dual_mem_window_ratio is exactly 1.0 by construction (a 100k
+    # window costing more than a 1k one means the constant-memory invariant
+    # broke), and vwupdate_fresh_compiles is deterministically 1 like the
+    # other one-compile proofs. windowed_serving_ratio is the ≥80%-of-
+    # unwindowed acceptance headline: a drop below threshold means windowed
+    # tenants stopped keeping up with the plain stacked plane.
+    "extra.streaming_window_100k.dual_updates_per_sec_100k": 0.4,
+    "extra.streaming_window_100k.two_stack_updates_per_sec_100k": 0.4,
+    "extra.streaming_window_100k.ring_updates_per_sec": 0.4,
+    "extra.streaming_window_100k.plain_tenants_per_sec_1k": 0.4,
+    "extra.streaming_window_100k.windowed_tenants_per_sec_1k": 0.4,
+    "extra.streaming_window_100k.windowed_serving_ratio": 0.2,
+    "extra.streaming_window_100k.state_memory_bytes_100k": 0.05,
+    "extra.streaming_window_100k.state_memory_bytes_1k": 0.05,
+    "extra.streaming_window_100k.dual_mem_window_ratio": 0.01,
+    "extra.streaming_window_100k.vwupdate_fresh_compiles": 0.25,
 }
+
+# Metrics KNOWN to go missing in some rounds for an environmental reason,
+# keyed by dotted-name prefix. The fid probe still dies in-pod on a
+# remote_compile transport flake (classified transient, bounded re-attempts —
+# the ROADMAP's standing known issue); when it does, its throughput columns
+# vanish from the round. Matching missing rows are reported on their own
+# informational line with the reason, and never consume the regression
+# gate's attention — not even under --strict-missing — so round reports stop
+# re-reporting a known flake as a fresh anomaly. A later round where the
+# probe lands again simply reports the columns as returning ("new").
+EXPECTED_MISSING: Dict[str, str] = {
+    "extra.fid_inception_fwd.": "fid remote_compile transport flake (transient; ROADMAP known issue)",
+}
+
+
+def expected_missing_reason(name: str) -> Optional[str]:
+    for prefix, reason in EXPECTED_MISSING.items():
+        if name.startswith(prefix):
+            return reason
+    return None
 
 _HIGHER_MARKERS = ("per_sec", "speedup", "throughput")
 # tenants_per_dispatch: rows amortized per serving dispatch — more per
@@ -121,13 +159,18 @@ _HIGHER_MARKERS = ("per_sec", "speedup", "throughput")
 # double-buffered plane hides — more hidden is the whole point.
 # async_state_parity: exactly 1.0 when async == blocking bitwise; any drop is
 # a correctness regression, not noise.
+# windowed_serving_ratio: windowed-vs-plain serving throughput (the ≥80%
+# acceptance headline — higher is the point, and the name carries no marker)
 _HIGHER_EXACT = ("value", "vs_baseline", "tenants_per_dispatch",
-                 "async_sync_overlap_pct", "async_state_parity")
+                 "async_sync_overlap_pct", "async_state_parity",
+                 "windowed_serving_ratio")
 _LOWER_MARKERS = ("latency", "compile", "_sec", "_ms", "_us", "_bytes", "bytes_", "time")
 # collective counts per sync: fewer is the whole point of the coalesced plane —
 # a move back toward per-leaf collectives must gate even though the name
-# carries no latency/throughput marker
-_LOWER_EXACT = ("collectives_per_sync",)
+# carries no latency/throughput marker. dual_mem_window_ratio: 100k-vs-1k
+# window state bytes, exactly 1.0 by construction — any growth means the
+# dual form's window-independent-memory invariant broke.
+_LOWER_EXACT = ("collectives_per_sync", "dual_mem_window_ratio")
 # deterministic workload constants: the coalesced-sync config's leaf counts,
 # the warm-start column's program count ("precompiled" would otherwise match
 # the "compile" latency marker and gate a constant), and the serving
@@ -143,7 +186,11 @@ _INFO_EXACT = ("leaves_coalesced_per_sync", "per_leaf_collectives", "ttfu_precom
                # graftlint raw finding count: tracked across rounds so lint
                # state is visible in the perf history, but a lint move is not
                # a perf regression — the tier-1 pytest gate owns enforcement
-               "lint_findings")
+               "lint_findings",
+               # streaming_window_100k constants: the ring comparison window /
+               # its O(window) bytes (workload descriptors, not perf) and the
+               # telemetry row count of the one-compile probe
+               "ring_window", "ring_state_memory_bytes", "windowed_rows_recorded")
 
 
 def direction(name: str) -> Optional[str]:
@@ -216,7 +263,13 @@ def compare_metrics(
         if old is None:
             row["verdict"] = "new"
         elif new is None:
-            row["verdict"] = "missing"
+            reason = expected_missing_reason(name)
+            if reason is not None:
+                # expected-known: reported with its reason, never gated
+                row["verdict"] = "known_missing"
+                row["reason"] = reason
+            else:
+                row["verdict"] = "missing"
         elif row["direction"] is None or old == 0:
             row["verdict"] = "info"
         else:
@@ -232,7 +285,8 @@ def compare_metrics(
             else:
                 row["verdict"] = "ok"
         rows.append(row)
-    order = {"regression": 0, "missing": 1, "ok": 2, "improved": 3, "info": 4, "new": 5}
+    order = {"regression": 0, "missing": 1, "ok": 2, "improved": 3, "info": 4,
+             "known_missing": 5, "new": 6}
     rows.sort(key=lambda r: (order[r["verdict"]], r["metric"]))
     return rows
 
@@ -252,11 +306,12 @@ def compare_rounds(
         rows = compare_metrics(docs[i - 1], docs[i], threshold=threshold, overrides=overrides)
         n_reg = sum(1 for r in rows if r["verdict"] == "regression")
         missing = [r["metric"] for r in rows if r["verdict"] == "missing"]
+        known = [r["metric"] for r in rows if r["verdict"] == "known_missing"]
         regressions += n_reg
         missing_total += len(missing)
         transitions.append({
             "from": paths[i - 1], "to": paths[i], "rows": rows,
-            "regressions": n_reg, "missing": missing,
+            "regressions": n_reg, "missing": missing, "known_missing": known,
         })
     return {"transitions": transitions, "regressions": regressions,
             "missing": missing_total,
@@ -274,13 +329,17 @@ def verdict_against_previous(
         {"metric": r["metric"], "old": r["old"], "new": r["new"], "delta_pct": r["delta_pct"]}
         for r in rows if r["verdict"] == "regression"
     ]
-    return {
+    out = {
         "verdict": "regression" if regressions else "ok",
         "regressions": regressions,
         "improved": sum(1 for r in rows if r["verdict"] == "improved"),
         "ok": sum(1 for r in rows if r["verdict"] == "ok"),
         "missing": [r["metric"] for r in rows if r["verdict"] == "missing"],
     }
+    known = [r["metric"] for r in rows if r["verdict"] == "known_missing"]
+    if known:
+        out["known_missing"] = known
+    return out
 
 
 def _fmt(v: Any) -> str:
@@ -309,6 +368,12 @@ def render_report(report: Dict[str, Any], verbose: bool = False) -> str:
             lines.append(
                 f"  missing from {tr['to']} ({len(tr['missing'])}, gated only "
                 f"under --strict-missing): " + ", ".join(tr["missing"])
+            )
+        if tr.get("known_missing"):
+            reasons = sorted({expected_missing_reason(m) or "known" for m in tr["known_missing"]})
+            lines.append(
+                f"  expected-known missing ({len(tr['known_missing'])}, informational, "
+                f"never gated — {'; '.join(reasons)}): " + ", ".join(tr["known_missing"])
             )
         lines.append("")
     lines.append(

@@ -31,9 +31,47 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from .model import MetricModel
 
-PLANES = ("vupdate", "vcompute", "wupdate", "dupdate", "tenant_sharding", "ingraph")
+PLANES = ("vupdate", "vcompute", "vwupdate", "wupdate", "dupdate", "tenant_sharding", "ingraph")
+
+#: tiered window representations (metric.WINDOW_TIERS), derived statically
+#: from the same reduce-tag facts the runtime's `window_tier()` reads
+WINDOW_TIER_VALUES = ("dual", "two_stack", "ring", "?")
 
 YES, NO, MAYBE = "yes", "no", "?"
+
+
+def derive_window_tier(model: MetricModel) -> Tuple[str, List[str]]:
+    """The tiered-window representation (``metric.window_tier`` mirror):
+    ``dual`` (sum/mean/None tags — constant pair), ``two_stack`` (adds
+    max/min/callable semigroup folds — paned DABA stacks), ``ring``
+    (custom merge / cat states — per-update buckets), or ``?`` when the
+    state declarations are config-conditional/dynamic."""
+    if model.custom_merge:
+        return "ring", ["custom _merge override"]
+    lists = model.has_list_state()
+    if lists:
+        return "ring", ["concat (list) state"]
+    unknown = lists is None or model.dynamic_states
+    tags = set()
+    for s in model.states:
+        if s.is_list:
+            continue
+        if s.fx == "dynamic" or s.is_list is None:
+            unknown = True
+            continue
+        if s.fx == "cat":
+            if s.conditional:
+                unknown = True
+                continue
+            return "ring", ["'cat'-reduced tensor state (growing shape)"]
+        tags.add(s.fx)
+    if unknown:
+        return "?", ["config-conditional states (depends on construction args)"]
+    if tags <= {"sum", "mean", None}:
+        return "dual", []
+    if tags <= {"sum", "mean", "max", "min", None, "callable"}:
+        return "two_stack", []
+    return "ring", ["unclassifiable reduction"]  # pragma: no cover — tag set is closed
 
 
 def _tri(cond: Optional[bool]) -> str:
@@ -108,11 +146,22 @@ def admissibility(model: MetricModel) -> Dict[str, Any]:
         ),
     )
 
+    tier, tier_reasons = derive_window_tier(model)
+    # windowed serving (ServingConfig(window=)): vupdate-admissible AND a
+    # constant-memory tier — a per-tenant ring would be ×window rows, which
+    # the engine refuses at construction
+    tier_ok = (
+        (NO, "ring window tier (per-tenant state would scale with the window)")
+        if tier == "ring" else
+        (MAYBE, "window tier statically undecidable") if tier == "?" else (YES, None)
+    )
+
     rows: Dict[str, Any] = {}
     v_vup = _merge_verdicts(host, core, no_lists)
     rows["vupdate"] = v_vup
     rows["tenant_sharding"] = v_vup  # sharding applies to the same stacked plane
     rows["vcompute"] = _merge_verdicts(host, core, no_lists, jit_compute)
+    rows["vwupdate"] = _merge_verdicts(host, core, no_lists, tier_ok)
     rows["wupdate"] = _merge_verdicts(host, core, no_cat_tensor)
     rows["dupdate"] = _merge_verdicts(host, core, no_lists, merge_ok, decayable)
     rows["ingraph"] = _merge_verdicts(no_lists, ingraph_mean)
@@ -121,6 +170,8 @@ def admissibility(model: MetricModel) -> Dict[str, Any]:
         "class": model.qualname,
         "module": model.cls.module.modname,
         "planes": {p: rows[p][0] for p in PLANES},
+        "window_tier": tier,
+        "window_tier_reasons": tier_reasons,
         "reasons": {p: rows[p][1] for p in PLANES if rows[p][1]},
         "states": [
             {"name": s.name, "list": s.is_list, "fx": s.fx, "conditional": s.conditional}
@@ -147,12 +198,16 @@ def build_matrix(models: Dict[str, MetricModel]) -> Dict[str, Any]:
         else:
             excluded.append(qual)
     totals = {p: {YES: 0, NO: 0, MAYBE: 0} for p in PLANES}
+    tier_totals = {t: 0 for t in WINDOW_TIER_VALUES}
     for row in concrete.values():
         for p in PLANES:
             totals[p][row["planes"][p]] += 1
+        tier_totals[row["window_tier"]] += 1
     return {
         "planes": list(PLANES),
+        "window_tiers": list(WINDOW_TIER_VALUES),
         "metrics": concrete,
         "excluded_abstract_or_wrapper": excluded,
         "totals": totals,
+        "window_tier_totals": tier_totals,
     }

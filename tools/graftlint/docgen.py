@@ -15,19 +15,23 @@ from .core import Finding
 BEGIN = "<!-- graftlint:{name}:begin (generated — `python -m tools.graftlint --write-docs`) -->"
 END = "<!-- graftlint:{name}:end -->"
 
-# (doc file, marker name, plane columns, column headers)
+# (doc file, marker name, plane columns, column headers, include window-tier column)
 DOC_TABLES = (
-    ("docs/serving.md", "serving-matrix", ("vupdate", "vcompute", "tenant_sharding"),
-     ("`vupdate` (megabatch)", "`vcompute` (compute_all)", "tenant sharding")),
+    ("docs/serving.md", "serving-matrix", ("vupdate", "vcompute", "vwupdate", "tenant_sharding"),
+     ("`vupdate` (megabatch)", "`vcompute` (compute_all)", "`vwupdate` (windowed tenants)",
+      "tenant sharding"), True),
     ("docs/streaming.md", "streaming-matrix", ("wupdate", "dupdate"),
-     ("`wupdate` (SlidingWindow)", "`dupdate` (ExponentialDecay)")),
+     ("`wupdate` (SlidingWindow)", "`dupdate` (ExponentialDecay)"), True),
 )
 
 _GLYPH = {"yes": "✓", "no": "✗", "?": "?"}
+_TIER_GLYPH = {"dual": "dual", "two_stack": "2stack", "ring": "ring", "?": "?"}
+_TIER_ORDER = ("dual", "two_stack", "ring", "?")
 
 
 def _module_rollup(matrix: Dict[str, Any], planes: Tuple[str, ...]) -> List[Tuple[str, Dict[str, Dict[str, int]]]]:
-    """Per-module counts of yes/no/? for each plane column."""
+    """Per-module counts of yes/no/? for each plane column (plus the
+    window-tier distribution under the pseudo-column ``window_tier``)."""
     by_mod: Dict[str, Dict[str, Dict[str, int]]] = {}
     for row in matrix["metrics"].values():
         mod = row["module"]
@@ -37,17 +41,23 @@ def _module_rollup(matrix: Dict[str, Any], planes: Tuple[str, ...]) -> List[Tupl
         slot = by_mod.setdefault(group, {p: {"yes": 0, "no": 0, "?": 0} for p in planes})
         for p in planes:
             slot[p][row["planes"][p]] += 1
+        tiers = slot.setdefault("window_tier", {t: 0 for t in _TIER_ORDER})
+        tiers[row.get("window_tier", "?")] += 1
     return sorted(by_mod.items())
 
 
 def render_table(matrix: Dict[str, Any], name: str, planes: Tuple[str, ...],
-                 headers: Tuple[str, ...]) -> str:
+                 headers: Tuple[str, ...], tier_column: bool = False) -> str:
     """Markdown: a per-module rollup plus the explicit inadmissible list with
     reasons (the full per-class matrix is the machine-readable JSON:
-    ``python -m tools.graftlint --matrix``)."""
+    ``python -m tools.graftlint --matrix``). ``tier_column`` appends the
+    window-tier distribution (which constant-memory representation each
+    family's windows get — ISSUE 12's tiered windowed state)."""
     lines = [BEGIN.format(name=name), ""]
-    lines.append("| metric family | " + " | ".join(headers) + " |")
-    lines.append("|---|" + "---|" * len(planes))
+    n_cols = len(planes) + (1 if tier_column else 0)
+    tier_header = (" window tier |",) if tier_column else ()
+    lines.append("| metric family | " + " | ".join(headers) + " |" + "".join(tier_header))
+    lines.append("|---|" + "---|" * n_cols)
     for group, counts in _module_rollup(matrix, planes):
         cells = []
         for p in planes:
@@ -57,6 +67,11 @@ def render_table(matrix: Dict[str, Any], name: str, planes: Tuple[str, ...],
             if c["?"]:
                 part += f" ({c['?']}?)"
             cells.append(part)
+        if tier_column:
+            tiers = counts["window_tier"]
+            cells.append(" ".join(
+                f"{_TIER_GLYPH[t]}:{tiers[t]}" for t in _TIER_ORDER if tiers[t]
+            ))
         lines.append(f"| `{group}` | " + " | ".join(cells) + " |")
     # explicit inadmissible/undecidable rows, one compact line each
     short = {
@@ -71,6 +86,8 @@ def render_table(matrix: Dict[str, Any], name: str, planes: Tuple[str, ...],
         "dynamic state declarations": "dynamic states",
         "config-conditional states (depends on construction args)": "config-conditional states",
         "config-dependent _jittable_compute": "config-dependent compute path",
+        "ring window tier (per-tenant state would scale with the window)": "ring window tier",
+        "window tier statically undecidable": "tier undecidable",
     }
     blocked: List[str] = []
     for qual in sorted(matrix["metrics"]):
@@ -88,9 +105,14 @@ def render_table(matrix: Dict[str, Any], name: str, planes: Tuple[str, ...],
         cells = " | ".join(_GLYPH[v] for v in verdicts)
         blocked.append(f"| `{cls}` | {cells} | {'; '.join(reasons)} |")
     lines.append("")
+    tier_note = (
+        " The window-tier column counts which constant-memory window representation "
+        "each family's metrics get (`dual` pair / `2stack` paned DABA / `ring` fallback; "
+        "see docs/streaming.md \"Dual-form windows\")." if tier_column else ""
+    )
     lines.append(f"Cells are admissible/total per family (`?` = statically undecidable: "
                  f"admissibility depends on construction arguments). "
-                 f"{len(matrix['metrics'])} concrete metrics analyzed. "
+                 f"{len(matrix['metrics'])} concrete metrics analyzed.{tier_note} "
                  "Metrics not admissible everywhere (full per-class detail: "
                  "`python -m tools.graftlint --matrix`):")
     lines.append("")
@@ -117,7 +139,7 @@ def _splice(doc: str, name: str, block: str) -> Optional[str]:
 
 def check_docs(matrix: Dict[str, Any], root: str) -> List[Finding]:
     findings: List[Finding] = []
-    for relpath, name, planes, headers in DOC_TABLES:
+    for relpath, name, planes, headers, tier_column in DOC_TABLES:
         path = os.path.join(root, relpath)
         try:
             with open(path, "r", encoding="utf-8") as fh:
@@ -127,7 +149,7 @@ def check_docs(matrix: Dict[str, Any], root: str) -> List[Finding]:
                 "plane/doc-missing", relpath, name, "missing",
                 f"{relpath} not found — the generated admissibility table has no home"))
             continue
-        block = render_table(matrix, name, planes, headers)
+        block = render_table(matrix, name, planes, headers, tier_column)
         if BEGIN.format(name=name) not in doc:
             findings.append(Finding(
                 "plane/docs-stale", relpath, name, "no-markers",
@@ -144,14 +166,14 @@ def check_docs(matrix: Dict[str, Any], root: str) -> List[Finding]:
 def write_docs(matrix: Dict[str, Any], root: str) -> List[str]:
     """Regenerate the doc tables in place; returns the files touched."""
     touched: List[str] = []
-    for relpath, name, planes, headers in DOC_TABLES:
+    for relpath, name, planes, headers, tier_column in DOC_TABLES:
         path = os.path.join(root, relpath)
         try:
             with open(path, "r", encoding="utf-8") as fh:
                 doc = fh.read()
         except OSError:
             continue
-        block = render_table(matrix, name, planes, headers)
+        block = render_table(matrix, name, planes, headers, tier_column)
         if BEGIN.format(name=name) not in doc:
             # first run: append a section at the end of the doc
             doc = doc.rstrip("\n") + "\n\n## Plane admissibility (generated)\n\n" + block + "\n"

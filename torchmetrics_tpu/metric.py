@@ -83,6 +83,25 @@ WINDOW_COUNT_KEY = "__window_n"
 #: "mean" states fold against)
 DECAY_WEIGHT_KEY = "__decay_n"
 
+#: reserved leaf-name prefixes inside the two-stack window representation
+#: (``streaming.SlidingWindow`` tier "two_stack" and the serving engine's
+#: windowed tenant stacks): each real tensor-state name ``k`` gets companion
+#: accumulator leaves under ``prefix + k`` — the (DABA-style) front
+#: suffix-fold stack, the back pane-fold stack, and the running fold of the
+#: back stack. The dual tier needs no companion leaves: its pair packs into
+#: one ``(2, *shape)`` leaf under the state's own name (row 0 = expiring
+#: previous block, row 1 = current block) — fewer buffers per donated call
+#: than the ring.
+WINDOW_FRONT_KEY = "__window_front:"
+WINDOW_BACK_KEY = "__window_back:"
+WINDOW_BAGG_KEY = "__window_bagg:"
+
+#: window tiers, in preference order: "dual" (constant pair of block
+#: accumulators — sum/mean/None reduce-tags), "two_stack" (DABA-style paned
+#: two-stack — adds max/min/callable semigroup folds), "ring" (the PR 10
+#: per-update bucket ring — custom merges, list/cat states, exact trailing-N)
+WINDOW_TIERS = ("dual", "two_stack", "ring")
+
 
 def _fresh_leaf(default: Any) -> Array:
     """Fresh device buffer from a state default, with no device→host readback.
@@ -95,6 +114,284 @@ def _fresh_leaf(default: Any) -> Array:
     if isinstance(default, jax.Array):
         return jnp.copy(default)
     return jnp.asarray(default)
+
+
+# ---------------------------------------------------------------------------
+# Tiered window representation (streaming.SlidingWindow / serving window=)
+#
+# The recurrent↔dual trade from compiler-first O(1)-caching stacks
+# (arXiv:2603.09555) applied to metric algebra: the PR 10 ring is the "dual"
+# (attention-like) form — it materializes every update's contribution and is
+# exact at per-update granularity, at O(window) HBM. The recurrent forms below
+# collapse the window to a CONSTANT number of accumulators; the window
+# boundary then advances in hops (block/pane granularity), and the value is
+# exactly the metric over the trailing ``covered`` updates with
+# ``window <= covered < window + hop``. Which form a metric gets is derived
+# from its reduce-tags (`window_tier`), the same derivation graftlint's
+# admissibility matrix performs statically.
+# ---------------------------------------------------------------------------
+
+#: fixed two-stack depth: panes per window. Window-independent by
+#: construction — a 100k-update window still costs 2·depth+2 accumulators.
+WINDOW_STACK_DEPTH = 16
+
+
+def window_tier(metric: "Metric") -> str:
+    """The tiered-window representation this metric's reduce-tags admit.
+
+    - ``"dual"`` — every tensor reduction is ``sum``/``mean``/``None``: the
+      window collapses to a pair of block accumulators (running current block
+      + expiring previous block), no ring, no scatter.
+    - ``"two_stack"`` — additionally ``max``/``min``/callable semigroup
+      folds: a DABA-style paned two-stack (front suffix-fold stack + back
+      pane-fold stack + flip), O(1) amortized, window-independent memory.
+    - ``"ring"`` — custom ``_merge`` or list ("cat") states: only the PR 10
+      per-update bucket ring can represent them (also the exact-trailing-N
+      opt-in for any metric).
+    """
+    if metric._has_custom_merge() or metric._list_state_names:
+        return "ring"
+    tags = set()
+    for fx in metric._reductions.values():
+        if fx == "cat":
+            return "ring"  # cat TENSOR state (the wrapper rejects it anyway)
+        tags.add("callable" if callable(fx) else fx)
+    if tags <= {"sum", "mean", None}:
+        return "dual"
+    if tags <= {"sum", "mean", "max", "min", None, "callable"}:
+        return "two_stack"
+    return "ring"
+
+
+def window_stack_geometry(window: int, pane: Optional[int] = None) -> Tuple[int, int]:
+    """``(pane_size, depth)`` for a two-stack window: ``depth`` panes of
+    ``pane_size`` updates each, ``depth * pane_size >= window``. ``pane=1``
+    degenerates to exact per-update sliding (memory 2·window); the default
+    keeps depth at :data:`WINDOW_STACK_DEPTH` so memory is window-independent."""
+    if pane is None:
+        pane = max(1, -(-int(window) // WINDOW_STACK_DEPTH))  # ceil division
+    pane = int(pane)
+    if pane < 1:
+        raise ValueError(f"Expected `pane` >= 1, got {pane}")
+    depth = max(1, -(-int(window) // pane))
+    return pane, depth
+
+
+def _window_init_leaf(default: Any, fx: Any) -> Array:
+    """The merge-identity start value for one window accumulator: sum/mean
+    leaves accumulate CONTRIBUTIONS only (zeros; the metric default is folded
+    back in once at fold time, and mean leaves ride their own weight), while
+    max/min/callable/None leaves start at the metric default — which IS their
+    merge identity (the ring fold relies on the same invariant).
+
+    Accumulator dtype policy: integer ``sum``/``mean`` leaves promote (int64
+    under x64, else float32 — exact for counts below 2^24) so a 100k-update
+    window of int32 counts cannot silently saturate; every other leaf keeps
+    the metric's own dtype. Documented in docs/streaming.md ("Accumulator
+    dtypes"). Dtype inspection is metadata-only and static under trace."""
+    d = jnp.asarray(default)
+    if fx in ("sum", "mean"):
+        if jnp.issubdtype(d.dtype, jnp.integer):
+            d = d.astype(jnp.int64 if jax.config.jax_enable_x64 else jnp.float32)
+        return jnp.zeros_like(d)
+    return jnp.copy(d)
+
+
+def window_defaults(
+    metric: "Metric", window: int, tier: str, pane: Optional[int] = None
+) -> StateDict:
+    """The default (empty) windowed state pytree for one stream — the single
+    definition of each tier's state layout, shared by ``SlidingWindow`` and
+    the serving engine's per-tenant stacks (which add a leading row axis)."""
+    defaults_t, _ = metric._split_tensor_list(metric.init_state())
+    reductions = metric._reductions
+    st: StateDict = {}
+    if tier == "dual":
+        for k, v in defaults_t.items():
+            init = _window_init_leaf(v, reductions.get(k))
+            # packed pair under ONE leaf: row 0 = previous (expiring) block,
+            # row 1 = current block — half the buffers of a two-dict layout,
+            # and buffer count is what a donated dispatch pays per call
+            st[k] = jnp.repeat(init[None], 2, axis=0)
+        st[WINDOW_COUNT_KEY] = jnp.zeros((2,), jnp.float32)  # [prev_n, cur_n]
+    elif tier == "two_stack":
+        _, depth = window_stack_geometry(window, pane)
+        for k, v in defaults_t.items():
+            fx = reductions.get(k)
+            init = _window_init_leaf(v, fx)
+            st[k] = init  # current (partial) pane fold
+            st[WINDOW_BAGG_KEY + k] = jnp.copy(init)  # running fold of the back stack
+            st[WINDOW_FRONT_KEY + k] = jnp.repeat(init[None], depth, axis=0)
+            st[WINDOW_BACK_KEY + k] = jnp.repeat(init[None], depth, axis=0)
+        st[WINDOW_COUNT_KEY] = jnp.zeros((3,), jnp.float32)  # [front, back, cur-pane]
+    else:  # pragma: no cover — callers route "ring" to the bucket-ring layout
+        raise ValueError(f"window_defaults builds 'dual'/'two_stack' layouts, not {tier!r}")
+    return st
+
+
+def _fold_tag(fx: Any, a, b, w_a, w_b):
+    """Merge two window accumulators of one state in STREAM ORDER (``a``
+    older) under its reduce tag; ``w_*`` are the update counts each side
+    covers ("mean" weights; other tags ignore them)."""
+    if fx == "mean":
+        return _sync.weighted_mean(a, b, w_a, w_b)
+    if fx == "sum":
+        return a + jnp.asarray(b).astype(jnp.asarray(a).dtype)
+    if fx is None:
+        return a
+    return _sync.pairwise_merge(fx, a, b)
+
+
+def _dual_step(reductions: Dict[str, Any], defaults_t: StateDict,
+               st: StateDict, window, bs_t: StateDict) -> StateDict:
+    """One dual-pair window update (single stream; the vmapped serving form
+    maps this over tenant rows). Fold the batch into the current block; when
+    the block reaches ``window`` updates, rotate: current becomes the
+    previous (expiring) block and a fresh block starts. No scatter, no
+    cursor indexing — ``window`` is a TRACED scalar, so one executable
+    serves every window length."""
+    counts = st[WINDOW_COUNT_KEY]
+    cur_n = counts[1]
+    new_n = cur_n + 1.0
+    rotate = new_n >= window
+    out: StateDict = {}
+    for k in defaults_t:
+        pair = st[k]  # (2, *shape): [previous block, current block]
+        fx = reductions.get(k)
+        b = bs_t.get(k)
+        if b is None or fx is None:
+            new_cur = pair[1]
+        else:
+            new_cur = jnp.asarray(_fold_tag(fx, pair[1], b, cur_n, 1.0)).astype(pair.dtype)
+        init = _window_init_leaf(defaults_t[k], fx)
+        out[k] = jnp.where(
+            rotate,
+            jnp.stack([new_cur, init]),  # current becomes the expiring block
+            pair.at[1].set(new_cur),
+        )
+    out[WINDOW_COUNT_KEY] = jnp.where(
+        rotate,
+        jnp.stack([new_n, jnp.zeros_like(new_n)]),
+        jnp.stack([counts[0], new_n]),
+    )
+    return out
+
+
+def _dual_fold(reductions: Dict[str, Any], defaults_t: StateDict, st: StateDict) -> StateDict:
+    """Collapse a dual pair into one compute-ready state: previous block ⊕
+    current block, exactly the metric over the trailing
+    ``prev_n + cur_n`` updates."""
+    counts = st[WINDOW_COUNT_KEY]
+    prev_n, cur_n = counts[0], counts[1]
+    total = prev_n + cur_n
+    out: StateDict = {}
+    for k, default in defaults_t.items():
+        fx = reductions.get(k)
+        d = jnp.asarray(default)
+        pair = st[k]  # (2, *shape): [previous block, current block]
+        if fx == "sum":
+            out[k] = d.astype(pair.dtype) + pair.sum(axis=0)
+        elif fx == "mean":
+            merged = _sync.weighted_mean(pair[0], pair[1], prev_n, cur_n)
+            out[k] = jnp.where(total > 0, merged, d.astype(pair.dtype)).astype(pair.dtype)
+        else:  # fx None: keep the local default, exactly as update() does
+            out[k] = d
+    return out
+
+
+def _stack_step(reductions: Dict[str, Any], defaults_t: StateDict, depth: int,
+                st: StateDict, pane, bs_t: StateDict) -> StateDict:
+    """One DABA-style two-stack window update (single stream).
+
+    The window is ``depth`` panes of ``pane`` updates (``pane`` traced,
+    ``depth`` static from the stack shapes). The batch folds into the current
+    pane; a completed pane is pushed onto the back stack (one tiny
+    ``depth``-axis scatter) and folded into the running back aggregate; once
+    the window is full each push evicts the oldest front pane by bumping the
+    front position — O(1), the front stack holds PRECOMPUTED suffix folds.
+    When the front drains, the flip recomputes the suffix folds of the (by
+    then exactly full) back stack — ``depth`` static merges, amortized
+    O(1/depth) per update, and evaluated under ``where`` so the whole update
+    stays ONE branch-free XLA program."""
+    counts = st[WINDOW_COUNT_KEY]
+    fc, bc, cc = counts[0], counts[1], counts[2]
+    cc_next = cc + 1.0
+    complete = cc_next >= pane
+    d_f = jnp.float32(depth)
+    full = (fc + bc) >= d_f
+    flip = complete & full & (fc <= 0.0)
+    evict = complete & full
+    fc_after = jnp.where(flip, d_f - 1.0, jnp.where(evict, fc - 1.0, fc))
+    bc_base = jnp.where(flip, 0.0, bc)  # panes in the back stack pre-push
+    bc_after = jnp.where(complete, bc_base + 1.0, bc)
+    cc_after = jnp.where(complete, 0.0, cc_next)
+    push_idx = jnp.where(complete, bc_base, d_f).astype(jnp.int32)  # d = dropped no-op
+
+    out: StateDict = {}
+    for k in defaults_t:
+        fx = reductions.get(k)
+        b = bs_t.get(k)
+        cur = st[k]
+        if b is None or fx is None:
+            pane_fold = cur
+        else:
+            pane_fold = jnp.asarray(_fold_tag(fx, cur, b, cc, 1.0)).astype(cur.dtype)
+        init = _window_init_leaf(defaults_t[k], fx)
+        F, B, A = st[WINDOW_FRONT_KEY + k], st[WINDOW_BACK_KEY + k], st[WINDOW_BAGG_KEY + k]
+        # flip: suffix folds of the full back stack, oldest-first stream order
+        # (static loop — depth is a shape constant, the trace unrolls it)
+        suffix = init
+        flip_rows: List[Array] = []
+        for i in reversed(range(depth)):
+            suffix = jnp.asarray(
+                _fold_tag(fx, B[i], suffix, pane, (depth - 1 - i) * pane)
+            ).astype(cur.dtype)
+            flip_rows.append(suffix)
+        F_flip = jnp.stack(flip_rows[::-1], axis=0)
+        out[WINDOW_FRONT_KEY + k] = jnp.where(flip, F_flip, F)
+        # push the completed pane into the back stack + running aggregate
+        out[WINDOW_BACK_KEY + k] = B.at[push_idx].set(
+            pane_fold.astype(B.dtype), mode="drop"
+        )
+        A_base = jnp.where(flip, init, A)
+        A_pushed = jnp.asarray(
+            _fold_tag(fx, A_base, pane_fold, bc_base * pane, cc_next)
+        ).astype(cur.dtype)
+        out[WINDOW_BAGG_KEY + k] = jnp.where(complete, A_pushed, A)
+        out[k] = jnp.where(complete, init, pane_fold)
+    out[WINDOW_COUNT_KEY] = jnp.stack([fc_after, bc_after, cc_after])
+    return out
+
+
+def _stack_fold(reductions: Dict[str, Any], defaults_t: StateDict, depth: int,
+                st: StateDict, pane) -> StateDict:
+    """Collapse a two-stack window into one compute-ready state: front
+    suffix-fold (oldest panes, precomputed) ⊕ back aggregate ⊕ current
+    partial pane, in stream order."""
+    counts = st[WINDOW_COUNT_KEY]
+    fc, bc, cc = counts[0], counts[1], counts[2]
+    front_n = fc * pane
+    back_n = bc * pane
+    total = front_n + back_n + cc
+    front_pos = jnp.clip(depth - fc, 0, depth - 1).astype(jnp.int32)
+    out: StateDict = {}
+    for k, default in defaults_t.items():
+        fx = reductions.get(k)
+        d = jnp.asarray(default)
+        init = _window_init_leaf(default, fx)
+        top = jnp.take(st[WINDOW_FRONT_KEY + k], front_pos, axis=0)
+        acc = jnp.where(fc > 0, top, init)
+        acc = jnp.asarray(_fold_tag(fx, acc, st[WINDOW_BAGG_KEY + k], front_n, back_n))
+        acc = jnp.asarray(_fold_tag(fx, acc, st[k], front_n + back_n, cc)).astype(init.dtype)
+        if fx == "sum":
+            out[k] = d.astype(acc.dtype) + acc
+        elif fx == "mean":
+            out[k] = jnp.where(total > 0, acc, d.astype(acc.dtype))
+        elif fx is None:
+            out[k] = d
+        else:  # max/min/callable: init IS the default (merge identity)
+            out[k] = acc
+    return out
 
 
 class Metric:
@@ -528,6 +825,202 @@ class Metric:
 
             self._jit_cache[f"{key}.raw"] = fn  # undonated source for _aot_program
             self._jit_cache[key] = jax.jit(fn, donate_argnums=0) if self._enable_jit else fn
+        return self._jit_cache[key]
+
+    def _check_windowable(self, tier: str) -> None:
+        """Construction-time guards for the constant-memory window tiers —
+        the mirror of what :func:`window_tier` derives (and graftlint's
+        matrix pins statically)."""
+        if self._list_state_names:
+            raise TorchMetricsUserError(
+                f"{type(self).__name__} holds dynamic-length concat states; only the "
+                "'ring' window tier can hold them (bounded host ring)."
+            )
+        if self._has_custom_merge():
+            raise TorchMetricsUserError(
+                f"{type(self).__name__} overrides _merge; an unknown merge cannot be "
+                "folded into constant-size window accumulators — use the 'ring' tier."
+            )
+        allowed = ({"sum", "mean", None} if tier == "dual"
+                   else {"sum", "mean", "max", "min", None})
+        for name, fx in self._reductions.items():
+            if callable(fx):
+                if tier == "dual":
+                    raise TorchMetricsUserError(
+                        f"{type(self).__name__}.{name} uses a callable reduction; the dual "
+                        "pair folds only sum/mean closed forms — use tier 'two_stack'."
+                    )
+                continue
+            if fx not in allowed:
+                raise TorchMetricsUserError(
+                    f"{type(self).__name__}.{name} uses reduction {fx!r}, which the "
+                    f"{tier!r} window tier cannot fold; use the 'ring' tier."
+                )
+
+    def _get_wdual_fn(self) -> Callable:
+        """The dual-pair window program (tier 1 of the tiered window
+        representation): ONE donated fused XLA call folds the batch into a
+        constant-size pair of block accumulators — no ring, no roll-cursor
+        scatter, state cost independent of the window length.
+
+        Calling convention: ``fn(wstate, n_scalar, window, *args, **kwargs)``
+        where ``wstate`` is the :func:`window_defaults` dual layout (one
+        packed ``(2, *shape)`` pair per tensor state — row 0 the expiring
+        previous block, row 1 the current block — plus the ``(2,)``
+        :data:`WINDOW_COUNT_KEY` vector) and ``window`` is a TRACED
+        f32 scalar — one executable (and one AOT cache entry) serves every
+        window length, exactly like ``dupdate``'s traced decay. Returns only
+        the new state: extra outputs cost real dispatch overhead on the hot
+        path (``SlidingWindow.forward`` recomputes the batch value eagerly,
+        like the ring tier's bucket read)."""
+        key = "wdual"
+        if key not in self._jit_cache:
+            self._check_windowable("dual")
+            reductions = dict(self._reductions)
+            defaults_t, _ = self._split_tensor_list(self.init_state())
+
+            def fn(wstate, n_scalar, window, *args, **kwargs):
+                del n_scalar  # placeholder — see _get_vupdate_fn's docstring
+                with jax.named_scope(f"{type(self).__name__}.batch_state"):
+                    bs = self._batch_state(*args, **kwargs)
+                bs_t = {k: jnp.asarray(v) for k, v in bs.items()}
+                with jax.named_scope(f"{type(self).__name__}.window_dual"):
+                    return _dual_step(reductions, defaults_t, wstate, window, bs_t)
+
+            self._jit_cache[f"{key}.raw"] = fn  # undonated source for _aot_program
+            self._jit_cache[key] = jax.jit(fn, donate_argnums=0) if self._enable_jit else fn
+        return self._jit_cache[key]
+
+    def _get_wstack_fn(self, depth: int) -> Callable:
+        """The two-stack (DABA-style) window program (tier 2): ONE donated
+        fused XLA call folds the batch into the current pane and — selected
+        branch-free under ``where`` — pushes completed panes, evicts expired
+        front panes, and flips the back stack into precomputed suffix folds
+        when the front drains. ``depth`` (panes per window) is a static shape
+        constant; the pane LENGTH is a traced scalar, so one executable per
+        depth serves every window length.
+
+        Calling convention: ``fn(wstate, n_scalar, pane, *args, **kwargs)``
+        with the :func:`window_defaults` two-stack layout; returns only the
+        new state, like ``wdual``."""
+        key = "wstack"
+        if key not in self._jit_cache:
+            self._check_windowable("two_stack")
+            reductions = dict(self._reductions)
+            defaults_t, _ = self._split_tensor_list(self.init_state())
+            self._jit_cache[f"{key}.depth"] = int(depth)
+
+            def fn(wstate, n_scalar, pane, *args, **kwargs):
+                del n_scalar  # placeholder — see _get_vupdate_fn's docstring
+                with jax.named_scope(f"{type(self).__name__}.batch_state"):
+                    bs = self._batch_state(*args, **kwargs)
+                bs_t = {k: jnp.asarray(v) for k, v in bs.items()}
+                with jax.named_scope(f"{type(self).__name__}.window_two_stack"):
+                    return _stack_step(reductions, defaults_t, depth, wstate, pane, bs_t)
+
+            self._jit_cache[f"{key}.raw"] = fn  # undonated source for _aot_program
+            self._jit_cache[key] = jax.jit(fn, donate_argnums=0) if self._enable_jit else fn
+        elif self._jit_cache.get(f"{key}.depth") != int(depth):
+            raise TorchMetricsUserError(
+                "one metric instance can back only one two-stack depth "
+                f"(compiled {self._jit_cache.get(f'{key}.depth')}, requested {depth}); "
+                "wrap a clone() for a different pane geometry."
+            )
+        return self._jit_cache[key]
+
+    def _get_vwupdate_fn(self, tier: str, depth: int = 0) -> Callable:
+        """The vmapped WINDOWED megabatch program behind
+        ``ServingEngine(window=...)``: one XLA call advances many tenants'
+        dual/two-stack window states held as a stacked pytree — the serving
+        engine's leaves grow by a small constant factor, NOT ×window.
+
+        Calling convention: ``fn(stacked, n_scalar, wparam, idx, args,
+        kwargs)`` — like ``vupdate`` plus the traced window parameter
+        (``window`` length for the dual tier, ``pane`` length for the
+        two-stack tier) threaded through to every row's step."""
+        key = "vwupdate"
+        if key not in self._jit_cache:
+            if self._list_state_names:
+                raise TorchMetricsUserError(
+                    f"{type(self).__name__} holds dynamic-length concat states and cannot be "
+                    "served from a stacked pytree; use a binned/static-shape variant."
+                )
+            self._check_windowable(tier)
+            self._jit_cache[f"{key}.tier"] = (tier, int(depth))
+            reductions = dict(self._reductions)
+            defaults_t, _ = self._split_tensor_list(self.init_state())
+
+            def fn(stacked, n_scalar, wparam, idx, args, kwargs):
+                del n_scalar  # placeholder — see _get_vupdate_fn's docstring
+                counts = stacked[TENANT_COUNT_KEY]
+                states = {k: v for k, v in stacked.items() if k != TENANT_COUNT_KEY}
+
+                def per_row(row_state, n_prev, a, kw):
+                    bs = self._batch_state(*a, **kw)
+                    bs_t = {k: jnp.asarray(v) for k, v in bs.items()}
+                    if tier == "dual":
+                        new = _dual_step(reductions, defaults_t, row_state, wparam, bs_t)
+                    else:
+                        new = _stack_step(reductions, defaults_t, depth, row_state, wparam, bs_t)
+                    return new, n_prev + 1.0
+
+                with jax.named_scope(f"{type(self).__name__}.gather_rows"):
+                    rows = {k: jnp.take(v, idx, axis=0) for k, v in states.items()}
+                    n_rows = jnp.take(counts, idx, axis=0)
+                new_rows, new_n = jax.vmap(per_row)(rows, n_rows, args, kwargs)
+                with jax.named_scope(f"{type(self).__name__}.scatter_rows"):
+                    out = {k: v.at[idx].set(new_rows[k]) for k, v in states.items()}
+                    out[TENANT_COUNT_KEY] = counts.at[idx].set(new_n)
+                return out
+
+            self._jit_cache[f"{key}.raw"] = fn  # undonated source for _aot_program
+            self._jit_cache[key] = jax.jit(fn, donate_argnums=0) if self._enable_jit else fn
+        elif self._jit_cache.get(f"{key}.tier") != (tier, int(depth)):
+            raise TorchMetricsUserError(
+                "one metric instance can back only one windowed-serving geometry "
+                f"(compiled {self._jit_cache.get(f'{key}.tier')}, requested {(tier, depth)})."
+            )
+        return self._jit_cache[key]
+
+    def _get_vwcompute_fn(self, tier: str, depth: int = 0) -> Callable:
+        """The vmapped windowed batch-compute program behind
+        ``ServingEngine.compute_all`` when windowed: ONE undonated XLA call
+        folds every row's dual/two-stack window AND computes it. The trailing
+        batch args are signature carriers only (see ``_get_vcompute_fn``)."""
+        key = "vwcompute"
+        if key not in self._jit_cache:
+            if not self._jittable_compute:
+                raise TorchMetricsUserError(
+                    f"{type(self).__name__}.compute runs on host and cannot vmap; "
+                    "per-tenant compute falls back to eager slicing."
+                )
+            self._check_windowable(tier)
+            self._jit_cache[f"{key}.tier"] = (tier, int(depth))
+            reductions = dict(self._reductions)
+            defaults_t, _ = self._split_tensor_list(self.init_state())
+
+            def fn(stacked, n_scalar, wparam, *args, **kwargs):
+                del n_scalar, args, kwargs  # shape-class identity carriers only
+                states = {k: v for k, v in stacked.items() if k != TENANT_COUNT_KEY}
+
+                def per_row(row_state):
+                    if tier == "dual":
+                        folded = _dual_fold(reductions, defaults_t, row_state)
+                    else:
+                        folded = _stack_fold(reductions, defaults_t, depth, row_state, wparam)
+                    return self._compute(folded)
+
+                with jax.named_scope(f"{type(self).__name__}.vwcompute"):
+                    return jax.vmap(per_row)(states)
+
+            self._jit_cache[f"{key}.raw"] = fn  # undonated source for _aot_program
+            # no donation: compute is a read — the stack stays live for traffic
+            self._jit_cache[key] = jax.jit(fn) if self._enable_jit else fn
+        elif self._jit_cache.get(f"{key}.tier") != (tier, int(depth)):
+            raise TorchMetricsUserError(
+                "one metric instance can back only one windowed-serving geometry "
+                f"(compiled {self._jit_cache.get(f'{key}.tier')}, requested {(tier, depth)})."
+            )
         return self._jit_cache[key]
 
     def _get_vcompute_fn(self) -> Callable:
@@ -1301,10 +1794,21 @@ class Metric:
             primary = self._get_dupdate_fn()
         elif tag == "vcompute":
             primary = self._get_vcompute_fn()
+        elif tag == "wdual":
+            primary = self._get_wdual_fn()
+        elif tag == "wstack" or tag == "vwupdate" or tag == "vwcompute":
+            # geometry-parameterized windowed programs: built by their owning
+            # plane (SlidingWindow / ServingEngine(window=)) before any dispatch
+            primary = self._jit_cache.get(tag)
+            if primary is None:
+                raise TorchMetricsUserError(
+                    f"the {tag!r} program is parameterized by its window geometry and is "
+                    "built by its owner (SlidingWindow / ServingEngine(window=)) first"
+                )
         else:
             raise ValueError(
                 f"Unknown dispatch tag {tag!r}; expected 'update', 'forward', 'vupdate', "
-                "'wupdate', 'dupdate' or 'vcompute'"
+                "'wupdate', 'wdual', 'wstack', 'vwupdate', 'vwcompute', 'dupdate' or 'vcompute'"
             )
         raw = self._jit_cache.get(f"{tag}.raw")
         if raw is None or not hasattr(primary, "lower"):

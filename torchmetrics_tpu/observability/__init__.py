@@ -417,18 +417,22 @@ class TelemetryRecorder:
             duration_s=duration_s, payload={"nbytes": int(nbytes)},
         )
 
-    def record_window_roll(self, metric: Any, window: int, filled: int, wrapped: bool) -> None:
-        """One SlidingWindow ring-slot roll (streaming plane). The counter
-        ticks on every roll; the ``window_roll`` EVENT fires only when the
-        window wrapped (a full window of updates completed) so the stream
-        stays low-rate — the per-roll dispatch latency already rides the
-        ``wupdate`` dispatch events/histograms."""
+    def record_window_roll(self, metric: Any, window: int, filled: int, wrapped: bool,
+                           tier: str = "ring", rotated: bool = False) -> None:
+        """One SlidingWindow update (streaming plane). The ``window_rolls``
+        counter ticks on every update and ``window_rotations`` on every dual
+        block rotation / two-stack pane completion (``rotated``); the
+        ``window_roll`` EVENT fires only when the window wrapped (a full
+        window of updates completed) so the stream stays low-rate — the
+        per-update dispatch latency already rides the ``wupdate``/``wdual``/
+        ``wstack`` dispatch events/histograms."""
         name = self._metric_name(metric)
-        self.counters.record_window_roll()
+        self.counters.record_window_roll(rotated=rotated)
         if wrapped:
             self._event(
-                "window_roll", name, "wupdate",
-                payload={"window": int(window), "filled": int(filled)},
+                "window_roll", name,
+                {"ring": "wupdate", "dual": "wdual", "two_stack": "wstack"}.get(tier, "wupdate"),
+                payload={"window": int(window), "filled": int(filled), "tier": tier},
             )
 
     def record_async_sync(
@@ -620,7 +624,8 @@ class TelemetryRecorder:
             return {}
         name = f"{type(metric).__name__}#{stamp[1]}"
         out: Dict[str, Any] = {}
-        for kind in ("update", "forward", "compute", "sync", "aot_load", "wupdate", "dupdate", "vupdate"):
+        for kind in ("update", "forward", "compute", "sync", "aot_load", "wupdate",
+                     "wdual", "wstack", "dupdate", "vupdate", "vwupdate"):
             hist = self.histograms.get(kind, name)
             if hist is None or not hist.count:
                 continue

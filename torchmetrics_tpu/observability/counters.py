@@ -53,7 +53,8 @@ COUNTER_FIELDS: Tuple[str, ...] = (
     "tenant_spills",  # cold tenant states spilled from the stack to host memory
     "tenant_readmits",  # spilled tenant states uploaded back into a stack slot
     "tenant_spill_us",  # wall-clock spent spilling/readmitting tenant state
-    "window_rolls",  # SlidingWindow ring-slot rolls (streaming plane, wupdate dispatches)
+    "window_rolls",  # SlidingWindow updates (streaming plane, wupdate/wdual/wstack dispatches)
+    "window_rotations",  # dual block rotations / two-stack pane completions (window hop progress)
     "async_syncs",  # double-buffered background syncs committed (AsyncSyncHandle)
     "async_sync_wait_us",  # wall-clock commit() actually blocked — the UNHIDDEN sync latency
     "drift_evals",  # DriftMonitor window-vs-reference evaluations
@@ -351,10 +352,20 @@ class Counters:
         with self._lock:
             self._counts["alerts"] += 1
 
-    def record_window_roll(self) -> None:
-        """One SlidingWindow ring-slot roll (a windowed ``wupdate`` dispatch)."""
+    def record_window_roll(self, rotated: bool = False) -> None:
+        """One SlidingWindow update (a windowed ``wupdate``/``wdual``/
+        ``wstack`` dispatch); ``rotated`` marks a dual block rotation or a
+        two-stack pane completion — the hop cadence of the constant-memory
+        window tiers."""
+        self.record_window_rolls(1, 1 if rotated else 0)
+
+    def record_window_rolls(self, n: int, rotations: int = 0) -> None:
+        """Bulk form: ``n`` windowed per-tenant row updates (one vmapped
+        ``vwupdate`` megabatch advances many tenant windows at once), of
+        which ``rotations`` completed a block/pane."""
         with self._lock:
-            self._counts["window_rolls"] += 1
+            self._counts["window_rolls"] += int(n)
+            self._counts["window_rotations"] += int(rotations)
 
     def record_async_sync(self, wait_s: float) -> None:
         """One committed double-buffered background sync; ``wait_s`` is how

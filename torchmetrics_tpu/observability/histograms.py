@@ -39,11 +39,12 @@ from typing import Any, Dict, Iterable, List, Mapping, Optional, Sequence, Tuple
 N_BUCKETS = 32
 
 # The kinds whose per-kind totals ride the fleet plane, in vector order. The
-# first seven are latency histograms (microseconds); the last two are size
+# first nine are latency histograms (microseconds); the last two are size
 # histograms (bytes). Fixed across ranks by construction — the fleet vector
 # needs no key exchange. (Growing this tuple changes the piggyback layout:
 # bump parallel/coalesce._VERSION — the streaming "wupdate" addition rode the
-# v5 bump together with the counter-vector growth.)
+# v5 bump, the tiered-window "wdual"/"wstack" additions the v6 bump, each
+# together with the counter-vector growth.)
 FLEET_HISTOGRAM_KINDS: Tuple[str, ...] = (
     "update",        # jitted/host update dispatch latency
     "forward",       # forward dispatch latency
@@ -52,6 +53,8 @@ FLEET_HISTOGRAM_KINDS: Tuple[str, ...] = (
     "retry_backoff", # backoff delay accepted before a transient retry
     "aot_load",      # serialized-executable load latency (aot compile cache)
     "wupdate",       # SlidingWindow ring-roll dispatch latency (streaming plane)
+    "wdual",         # dual-pair window dispatch latency (tiered windows)
+    "wstack",        # two-stack window dispatch latency (tiered windows)
     "sync_payload",  # bytes a process contributed to one sync
     "gather_bytes",  # bytes of one sync-plane collective payload
 )

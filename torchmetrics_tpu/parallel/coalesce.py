@@ -85,7 +85,9 @@ _MAGIC = 0x436F414C  # "CoAL"
 # validation (CoalesceFallback → lockstep per-leaf sync) and deposit NO
 # mailbox rows, so fleet rollups degrade to a fresh collective / local
 # rollup instead of misdecoding another version's half-packed layout
-_VERSION = 5
+# v6: tiered windows — the counter vector gained window_rotations and the
+# fleet histogram vector gained the wdual/wstack dispatch kinds
+_VERSION = 6
 _HEADER_LEN = 4  # [magic, version, n_leaves, n_counter_fields]
 _LEAF_REC_LEN = 2 + _MAX_RANK + 1  # [dtype_code, ndim, d0..d7, kind]
 _KIND_TENSOR = 0

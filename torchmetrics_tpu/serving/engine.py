@@ -60,7 +60,15 @@ import numpy as np
 from .. import aot as _aot
 from .. import observability as _observability
 from ..aot import keys as _aot_keys
-from ..metric import TENANT_COUNT_KEY, Metric
+from ..metric import (
+    TENANT_COUNT_KEY,
+    Metric,
+    _dual_fold,
+    _stack_fold,
+    window_defaults,
+    window_stack_geometry,
+    window_tier,
+)
 from ..utilities.exceptions import TorchMetricsUserError
 
 StateDict = Dict[str, Any]
@@ -106,6 +114,22 @@ class ServingConfig:
             megabatch programs through to the cache so the NEXT boot is warm.
         sharding: a ``jax.sharding.Sharding`` applied to every stack leaf
             (leading axis = tenant rows) — see ``parallel.tenant_sharding``.
+        window: give every tenant a SLIDING WINDOW of this many updates
+            instead of a forever accumulator ("each tenant's last-hour
+            accuracy"). The per-tenant state uses the constant-memory
+            dual/two-stack window tiers (``docs/streaming.md``), so the
+            stacked leaves grow by a small constant factor — NOT ×window —
+            and updates stay one vmapped megabatch dispatch (tag
+            ``vwupdate``). Metrics whose reduce-tags only admit the ring
+            tier are rejected (a per-tenant ring would multiply the stack by
+            the window length). Per-tenant values are exact over the
+            trailing ``covered_updates(tenant)`` updates (window-hop
+            semantics, same contract as ``SlidingWindow``).
+        window_tier: ``"auto"`` derives dual/two_stack from the template's
+            reduce-tags; force ``"two_stack"`` for a tighter hop (one pane
+            instead of one window) on sum/mean metrics.
+        window_pane: two-stack pane length override (default: window-
+            independent depth of ``metric.WINDOW_STACK_DEPTH`` panes).
     """
 
     capacity: int = 1024
@@ -117,10 +141,20 @@ class ServingConfig:
     aot_cache_dir: Optional[str] = None
     write_on_miss: bool = True
     sharding: Any = None
+    window: Optional[int] = None
+    window_tier: str = "auto"
+    window_pane: Optional[int] = None
 
     def __post_init__(self) -> None:
         if self.capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {self.capacity}")
+        if self.window is not None and not (isinstance(self.window, int) and self.window > 0):
+            raise ValueError(f"window must be a positive integer (or None), got {self.window}")
+        if self.window_tier not in ("auto", "dual", "two_stack"):
+            raise ValueError(
+                f"window_tier must be 'auto', 'dual' or 'two_stack', got {self.window_tier!r} "
+                "(the ring tier cannot be stacked per tenant — its rows scale with the window)"
+            )
         if self.max_tenants_per_sec is not None and not self.max_tenants_per_sec > 0:
             raise ValueError(
                 f"max_tenants_per_sec must be > 0 (or None), got {self.max_tenants_per_sec}"
@@ -212,6 +246,35 @@ class ServingEngine:
         self._metric._reliability = None
         self._metric._fault_hook = None
         self._defaults_t, _ = self._metric._split_tensor_list(self._metric.init_state())
+        # windowed tenants: constant-memory dual/two-stack window state per
+        # row (docs/streaming.md "Dual-form windows") — the ring tier is
+        # refused because its per-row cost is ×window, exactly the HBM
+        # explosion ServingConfig(window=) exists to avoid
+        self._window = self.config.window
+        self._wtier: Optional[str] = None
+        self._wpane: Optional[int] = None
+        self._wdepth: int = 0
+        self._wparam_arr = None  # lazy device scalar (window / pane length)
+        if self._window is not None:
+            tier = self.config.window_tier
+            if tier == "auto":
+                tier = window_tier(self._metric)
+            if tier == "ring":
+                raise TorchMetricsUserError(
+                    f"{type(template).__name__}'s reduce-tags only admit the 'ring' window "
+                    "tier (custom _merge / cat states), whose per-tenant cost is ×window — "
+                    "windowed serving needs a dual/two-stack-admissible metric "
+                    "(see the window-tier column in docs/serving.md)."
+                )
+            self._metric._check_windowable(tier)
+            self._wtier = tier
+            if tier == "two_stack":
+                self._wpane, self._wdepth = window_stack_geometry(self._window, self.config.window_pane)
+            self._row_defaults = window_defaults(
+                self._metric, self._window, tier, self._wpane
+            )
+        else:
+            self._row_defaults = self._defaults_t
         self._classes: Dict[str, _ShapeClass] = {}
         self._tenants: Dict[Hashable, _Tenant] = {}
         self._touch = itertools.count(1)
@@ -225,7 +288,7 @@ class ServingEngine:
         self.stats: Dict[str, int] = {
             "dispatches": 0, "tenant_rows": 0, "padded_rows": 0, "flushes": 0,
             "spills": 0, "readmissions": 0, "spill_ns": 0, "quarantined": 0,
-            "dropped_batches": 0, "rejected_batches": 0,
+            "dropped_batches": 0, "rejected_batches": 0, "window_rotations": 0,
         }
         # admission token bucket (ServingConfig.max_tenants_per_sec): starts
         # full (one second's burst, floored at one whole token so sub-1/s
@@ -280,7 +343,7 @@ class ServingEngine:
         rows = self.config.capacity + 1  # + the scratch row padding scatters into
         stacked: StateDict = {
             name: jnp.repeat(jnp.asarray(leaf)[None], rows, axis=0)
-            for name, leaf in self._defaults_t.items()
+            for name, leaf in self._row_defaults.items()
         }
         stacked[TENANT_COUNT_KEY] = jnp.zeros((rows,), jnp.float32)
         if self.config.sharding is not None:
@@ -338,7 +401,7 @@ class ServingEngine:
                 rec.record_tenant_spill(self._metric, dur, _state_bytes(host["state"]), readmit=True)
         else:
             # the slot may hold a previously evicted tenant's stale rows
-            for name, leaf in self._defaults_t.items():
+            for name, leaf in self._row_defaults.items():
                 cls.stacked[name] = cls.stacked[name].at[slot].set(jnp.asarray(leaf))
             cls.stacked[TENANT_COUNT_KEY] = cls.stacked[TENANT_COUNT_KEY].at[slot].set(0.0)
 
@@ -370,7 +433,7 @@ class ServingEngine:
         (shape × itemsize), never an extra device read."""
         assert t.slot is not None
         t0 = time.perf_counter()
-        state = {name: np.asarray(cls.stacked[name][t.slot]) for name in self._defaults_t}
+        state = {name: np.asarray(cls.stacked[name][t.slot]) for name in self._row_defaults}
         count = float(np.asarray(cls.stacked[TENANT_COUNT_KEY][t.slot]))
         dur = time.perf_counter() - t0
         t.spilled = {"state": state, "count": count}
@@ -528,28 +591,46 @@ class ServingEngine:
         idx_dev = jnp.asarray(idx)
         if self._fault_hook is not None:
             self._fault_hook([tid for tid, _, _ in entries])
-        fn = self._metric._get_vupdate_fn()
-        inputs = ((idx_dev, mb_args, mb_kwargs), {})
-        new_stacked = self._metric._donation_safe_dispatch(
-            "vupdate",
-            lambda t, n: fn(t, n, idx_dev, mb_args, mb_kwargs),
-            cls.stacked,
-            inputs=inputs,
-            jitted=fn,
-            owner=cls.stacked,  # defensive: rollback lands in the stack, not _state
-        )
+        if self._wtier is not None:
+            fn = self._metric._get_vwupdate_fn(self._wtier, self._wdepth)
+            warr = self._wparam()
+            new_stacked = self._metric._donation_safe_dispatch(
+                "vwupdate",
+                lambda t, n: fn(t, n, warr, idx_dev, mb_args, mb_kwargs),
+                cls.stacked,
+                inputs=((warr, idx_dev, mb_args, mb_kwargs), {}),
+                jitted=fn,
+                owner=cls.stacked,  # defensive: rollback lands in the stack, not _state
+            )
+        else:
+            fn = self._metric._get_vupdate_fn()
+            new_stacked = self._metric._donation_safe_dispatch(
+                "vupdate",
+                lambda t, n: fn(t, n, idx_dev, mb_args, mb_kwargs),
+                cls.stacked,
+                inputs=((idx_dev, mb_args, mb_kwargs), {}),
+                jitted=fn,
+                owner=cls.stacked,  # defensive: rollback lands in the stack, not _state
+            )
         cls.stacked = new_stacked
         cls.dispatches += 1
         self.stats["dispatches"] += 1
         self.stats["tenant_rows"] += real
         self.stats["padded_rows"] += m - real
+        hop = self._window if self._wtier == "dual" else self._wpane
+        rotations = 0
         for tid, _, _ in entries:
             t = self._tenants[tid]
             t.update_count += 1
             t.pending -= 1
+            if self._wtier is not None and t.update_count % hop == 0:
+                rotations += 1
+        self.stats["window_rotations"] += rotations
         rec = _observability._ACTIVE
         if rec is not None:
             rec.record_serve_dispatch(self._metric, real, m - real)
+            if self._wtier is not None:
+                rec.counters.record_window_rolls(real, rotations)
 
     def _quarantine(self, tenant_id: Hashable, exc: BaseException) -> None:
         t = self._tenants[tenant_id]
@@ -569,19 +650,53 @@ class ServingEngine:
 
     # ---------------------------------------------------------------- reads
 
+    def _wparam(self):
+        """The traced window parameter (window length for dual, pane length
+        for two-stack) as a cached device scalar."""
+        if self._wparam_arr is None:
+            wparam = self._window if self._wtier == "dual" else self._wpane
+            self._wparam_arr = jax.device_put(np.float32(wparam))
+        return self._wparam_arr
+
+    def _fold_row(self, row_state: StateDict) -> StateDict:
+        """Collapse one tenant's windowed row into a compute-ready state
+        (identity for unwindowed engines)."""
+        if self._wtier is None:
+            return row_state
+        if self._wtier == "dual":
+            return _dual_fold(dict(self._metric._reductions), self._defaults_t, row_state)
+        return _stack_fold(
+            dict(self._metric._reductions), self._defaults_t, self._wdepth,
+            row_state, self._wparam(),
+        )
+
+    def covered_updates(self, tenant_id: Hashable) -> int:
+        """How many trailing updates one tenant's value folds (the windowed
+        serving analogue of ``SlidingWindow.covered_updates``; the tenant's
+        whole history when the engine is unwindowed)."""
+        n = self._require(tenant_id).update_count
+        if self._wtier == "dual":
+            return (self._window if n >= self._window else 0) + n % self._window
+        if self._wtier == "two_stack":
+            full_panes, cc = divmod(n, self._wpane)
+            return min(full_panes, self._wdepth) * self._wpane + cc
+        return n
+
     def _tenant_state(self, t: _Tenant) -> StateDict:
-        """One tenant's state dict — a stack slice when resident, the host
-        copy when spilled (no readmission: reads never churn the LRU)."""
+        """One tenant's (window-layout) state dict — a stack slice when
+        resident, the host copy when spilled (no readmission: reads never
+        churn the LRU)."""
         if t.spilled is not None:
             return {k: jnp.asarray(v) for k, v in t.spilled["state"].items()}
         if t.slot is None:
-            return {k: jnp.asarray(v) for k, v in self._defaults_t.items()}
+            return {k: jnp.asarray(v) for k, v in self._row_defaults.items()}
         cls = self._classes[t.shape_key]
-        return {name: cls.stacked[name][t.slot] for name in self._defaults_t}
+        return {name: cls.stacked[name][t.slot] for name in self._row_defaults}
 
     def compute(self, tenant_id: Hashable) -> Any:
         """One tenant's metric value, by slicing its rows out of the stack
-        (pending traffic is flushed first so the value is current)."""
+        (pending traffic is flushed first so the value is current; windowed
+        engines fold the row's dual/two-stack window first)."""
         t = self._require(tenant_id)
         if t.quarantined:
             raise TorchMetricsUserError(
@@ -589,7 +704,7 @@ class ServingEngine:
             )
         if t.pending:
             self.flush()
-        return self._metric._compute(self._tenant_state(t))
+        return self._metric._compute(self._fold_row(self._tenant_state(t)))
 
     def compute_all(self) -> Dict[Hashable, Any]:
         """Every non-quarantined tenant's value (flushes pending traffic once).
@@ -624,7 +739,7 @@ class ServingEngine:
         for tid, t in self._tenants.items():
             if tid in done or t.quarantined:
                 continue
-            out[tid] = self._metric._compute(self._tenant_state(t))
+            out[tid] = self._metric._compute(self._fold_row(self._tenant_state(t)))
         return {tid: out[tid] for tid in self._tenants if tid in out}
 
     def _vcompute(self, cls: _ShapeClass) -> Any:
@@ -633,12 +748,21 @@ class ServingEngine:
         never donates — the stack keeps serving traffic). Every row computes
         (free/scratch rows are discarded) so the dispatch signature is fixed
         per shape-class; the class's zero pad example rides along purely as
-        the signature carrier that keys each class's own compile."""
-        fn = self._metric._get_vcompute_fn()
+        the signature carrier that keys each class's own compile. Windowed
+        engines route through ``vwcompute``, which folds every row's
+        dual/two-stack window INSIDE the same vmapped call."""
         pa, pk = cls.pad_example
         # owner= is defensive: the engine strips its clone's reliability, but
         # should retry ever engage, an exhausted-budget rollback must restore
         # into the STACK, never pollute the template metric's _state
+        if self._wtier is not None:
+            fn = self._metric._get_vwcompute_fn(self._wtier, self._wdepth)
+            warr = self._wparam()
+            return self._metric._donation_safe_dispatch(
+                "vwcompute", lambda t, n: fn(t, n, warr, *pa, **pk), cls.stacked,
+                inputs=((warr,) + tuple(pa), pk), jitted=fn, owner=cls.stacked,
+            )
+        fn = self._metric._get_vcompute_fn()
         return self._metric._donation_safe_dispatch(
             "vcompute", lambda t, n: fn(t, n, *pa, **pk), cls.stacked,
             inputs=(pa, pk), jitted=fn, owner=cls.stacked,
@@ -676,7 +800,7 @@ class ServingEngine:
             self.stats["dropped_batches"] += len(cls.queue) - len(kept)
             cls.queue = deque(kept)
             if t.slot is not None:
-                for name, leaf in self._defaults_t.items():
+                for name, leaf in self._row_defaults.items():
                     cls.stacked[name] = cls.stacked[name].at[t.slot].set(jnp.asarray(leaf))
                 cls.stacked[TENANT_COUNT_KEY] = cls.stacked[TENANT_COUNT_KEY].at[t.slot].set(0.0)
         t.spilled = None
@@ -694,7 +818,10 @@ class ServingEngine:
     def state_dict(self, tenant_id: Hashable) -> Dict[str, Any]:
         """One tenant's checkpoint, shaped exactly like ``Metric.state_dict``
         output so it loads into a standalone metric (and back via
-        :meth:`load_state_dict`). Pending traffic is flushed first."""
+        :meth:`load_state_dict`). Pending traffic is flushed first. Windowed
+        engines checkpoint the WINDOW-LAYOUT leaves (restorable only into an
+        engine with the same window geometry — the window cannot be rebuilt
+        from its fold)."""
         t = self._require(tenant_id)
         if t.pending:
             self.flush()
@@ -705,20 +832,25 @@ class ServingEngine:
         return out
 
     def load_state_dict(self, tenant_id: Hashable, state_dict: Dict[str, Any]) -> None:
-        """Restore one tenant from a checkpoint (its own or a standalone
-        ``Metric.state_dict``). The state parks as a host-side (spilled)
-        tenant and uploads into a stack slot on its next traffic."""
+        """Restore one tenant from a checkpoint (its own or, for unwindowed
+        engines, a standalone ``Metric.state_dict``). The state parks as a
+        host-side (spilled) tenant and uploads into a stack slot on its next
+        traffic."""
         t = self._tenant(tenant_id)
         if t.pending:
             raise TorchMetricsUserError(
                 f"tenant {tenant_id!r} has {t.pending} undispatched batches; flush() before restoring."
             )
-        unknown = [k for k in state_dict if k not in self._defaults_t and not k.startswith("_")]
+        unknown = [k for k in state_dict if k not in self._row_defaults and not k.startswith("_")]
         if unknown:
             raise TorchMetricsUserError(f"checkpoint carries unknown state keys {sorted(unknown)}")
-        missing = [k for k in self._defaults_t if k not in state_dict]
+        missing = [k for k in self._row_defaults if k not in state_dict]
         if missing:
-            raise TorchMetricsUserError(f"checkpoint is missing state keys {sorted(missing)}")
+            raise TorchMetricsUserError(
+                f"checkpoint is missing state keys {sorted(missing)}"
+                + (" (windowed engines need window-layout checkpoints of the same geometry)"
+                   if self._wtier is not None else "")
+            )
         if t.resident and t.shape_key is not None:
             cls = self._classes[t.shape_key]
             cls.slot_tenant.pop(t.slot, None)
@@ -726,7 +858,7 @@ class ServingEngine:
             t.slot = None
         t.update_count = int(state_dict.get("_update_count", 1))
         t.spilled = {
-            "state": {k: np.asarray(state_dict[k]) for k in self._defaults_t},
+            "state": {k: np.asarray(state_dict[k]) for k in self._row_defaults},
             "count": float(t.update_count),
         }
         t.quarantined = False
@@ -748,7 +880,24 @@ class ServingEngine:
         idx = jax.ShapeDtypeStruct((m,), jnp.int32)
         stack_sds = lambda leaf: jax.ShapeDtypeStruct((m,) + tuple(np.shape(leaf)), _np_dtype(leaf))
         mb_args, mb_kwargs = jax.tree.map(stack_sds, (args, kwargs))
+        if self._wtier is not None:
+            # windowed calling convention threads the traced window parameter
+            wparam = jax.ShapeDtypeStruct((), jnp.float32)
+            return key, cls, (wparam, idx, mb_args, mb_kwargs)
         return key, cls, (idx, mb_args, mb_kwargs)
+
+    def _serve_tag(self) -> str:
+        """The engine's megabatch dispatch tag: ``vwupdate`` when windowed."""
+        return "vupdate" if self._wtier is None else "vwupdate"
+
+    def _build_serve_fn(self) -> None:
+        """Materialize the megabatch program for this engine's mode (the
+        windowed builders are geometry-parameterized, so warm-start paths
+        must build before ``_aot_program`` can key the cache)."""
+        if self._wtier is None:
+            self._metric._get_vupdate_fn()
+        else:
+            self._metric._get_vwupdate_fn(self._wtier, self._wdepth)
 
     def precompile(self, *example_inputs: Any, force: bool = False, **example_kwargs: Any) -> Dict[str, Any]:
         """Compile (or confirm cached) the megabatch program for the example
@@ -761,9 +910,11 @@ class ServingEngine:
                 "or call torchmetrics_tpu.aot.enable(cache_dir) first."
             )
         key, cls, mb = self._megabatch_sds(example_inputs, example_kwargs)
-        fn, donate = self._metric._aot_program("vupdate")
+        tag = self._serve_tag()
+        self._build_serve_fn()
+        fn, donate = self._metric._aot_program(tag)
         row = plane.precompile_program(
-            self._metric, "vupdate", fn, donate, cls.stacked, mb, {}, force=force,
+            self._metric, tag, fn, donate, cls.stacked, mb, {}, force=force,
         )
         return {key: row}
 
@@ -774,8 +925,8 @@ class ServingEngine:
         if plane is None:
             raise TorchMetricsUserError("prefetch needs an active AOT plane.")
         key, cls, mb = self._megabatch_sds(example_inputs, example_kwargs)
-        self._metric._get_vupdate_fn()
-        slot = plane.lookup_dispatch(self._metric, "vupdate", cls.stacked, (mb, {}))
+        self._build_serve_fn()
+        slot = plane.lookup_dispatch(self._metric, self._serve_tag(), cls.stacked, (mb, {}))
         if slot is not None and slot.compiled is not None:
             return {key: {"status": "loaded", "codec": slot.codec, "load_s": round(slot.load_s, 6)}}
         return {key: {"status": "miss"}}
@@ -817,6 +968,13 @@ class ServingEngine:
         """
         from ..parallel.async_sync import AsyncSyncHandle
 
+        if self._wtier is not None:
+            raise TorchMetricsUserError(
+                "sync_async cannot fold windowed tenant stacks across ranks: dual/two-stack "
+                "accumulators carry block/pane phase that has no defined rowwise cross-rank "
+                "merge. Compute per-rank windowed values instead (compute_all), or sync an "
+                "unwindowed engine."
+            )
         if any(fx == "mean" for fx in self._metric._reductions.values()):
             raise TorchMetricsUserError(
                 "sync_async cannot fold bare 'mean'-reduced stacked states across ranks "
@@ -892,6 +1050,13 @@ class ServingEngine:
             round(s["tenant_rows"] / s["dispatches"], 3) if s["dispatches"] else 0.0
         )
         s["tenant_spill_us"] = s.pop("spill_ns") // 1000
+        # the chosen per-tenant window tier, reported per-engine (ISSUE 12):
+        # None when unwindowed; dual/two_stack carry their geometry
+        s["window"] = self._window
+        s["window_tier"] = self._wtier
+        if self._wtier == "two_stack":
+            s["window_pane"] = self._wpane
+            s["window_depth"] = self._wdepth
         return s
 
     def block_until_ready(self) -> None:

@@ -3,9 +3,12 @@
 Forever-accumulating metrics answer epoch questions; monitoring traffic asks
 windowed ones. This package holds the O(1)-per-update stream transforms —
 
-- :class:`SlidingWindow` — the metric over exactly the last ``window``
-  updates (ring of bucket states, one donated roll+scatter XLA call per
-  update, no unbounded ``cat``);
+- :class:`SlidingWindow` — the metric over the last ``window`` updates,
+  represented by a TIER selected from the metric's reduce-tags
+  (:func:`window_tier`): a constant-memory dual pair (sum/mean), a
+  DABA-style paned two-stack (max/min/callable semigroups), or the exact
+  per-update bucket ring (custom merges, cat states) — one donated XLA call
+  per update in every tier, no unbounded ``cat``;
 - :class:`ExponentialDecay` — the metric with exponentially discounted
   history (decay folded into sum/count/mean leaves at update time);
 - :class:`DriftMonitor` — current-window vs. previous-block divergence,
@@ -21,7 +24,8 @@ overlaps the current window's updates.
 See ``docs/streaming.md``.
 """
 
+from ..metric import window_tier
 from .drift import DriftMonitor
 from .window import ExponentialDecay, SlidingWindow
 
-__all__ = ["DriftMonitor", "ExponentialDecay", "SlidingWindow"]
+__all__ = ["DriftMonitor", "ExponentialDecay", "SlidingWindow", "window_tier"]

@@ -43,7 +43,19 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import observability as _observability
-from ..metric import DECAY_WEIGHT_KEY, WINDOW_COUNT_KEY, WINDOW_CURSOR_KEY, HostMetric, Metric
+from ..metric import (
+    DECAY_WEIGHT_KEY,
+    WINDOW_COUNT_KEY,
+    WINDOW_CURSOR_KEY,
+    WINDOW_TIERS,
+    HostMetric,
+    Metric,
+    _dual_fold,
+    _stack_fold,
+    window_defaults,
+    window_stack_geometry,
+    window_tier,
+)
 from ..observability import memory as _obs_memory
 from ..parallel import sync as _sync
 from ..utilities.exceptions import TorchMetricsUserError
@@ -79,28 +91,53 @@ def _mask_rows(mask: jax.Array, ndim: int) -> jax.Array:
 
 
 class SlidingWindow(Metric):
-    """Metric value over exactly the last ``window`` updates of a stream.
+    """Metric value over the last ``window`` updates of a stream.
 
-    Ring semantics: bucket ``i`` holds update ``i``'s isolated state
-    contribution; an update past the window overwrites the expired bucket in
-    place (one donated scatter — O(1) per update, O(window) state, zero
-    growth). ``compute()`` folds the live buckets through the metric's own
-    merge machinery, so the value is exactly what a fresh metric fed only the
-    trailing ``window`` batches would report (the window-parity oracle
-    ``tests/test_streaming.py`` pins across metric families).
+    The representation is TIERED, selected automatically from the metric's
+    reduce-tags (``tier="auto"``; see :func:`torchmetrics_tpu.metric.
+    window_tier` and the graftlint admissibility matrix):
+
+    - ``"dual"`` (sum/mean/None tags) — a constant-size PAIR of block
+      accumulators (running current block + expiring previous block): state
+      cost independent of the window length, no ring, no roll-cursor scatter.
+      The window boundary advances in hops of ``window`` updates, so the
+      value is exactly the metric over the trailing :meth:`covered_updates`
+      updates, with ``window <= covered < 2*window`` once warm.
+    - ``"two_stack"`` (adds max/min/callable semigroup folds) — a DABA-style
+      paned two-stack (front suffix-fold stack + back pane-fold stack +
+      flip): window-independent memory (``2*depth + 2`` accumulators),
+      O(1)-amortized updates, and a tighter hop of one pane
+      (``window <= covered < window + 2*pane``). ``pane=1`` degenerates to
+      EXACT per-update sliding at 2×window memory.
+    - ``"ring"`` (custom ``_merge``, list/cat states — or forced) — the
+      per-update bucket ring: exact trailing-``window`` at every step,
+      O(window) state, one donated roll+scatter per update.
+
+    All tiers satisfy the window-parity oracle: ``compute()`` equals a fresh
+    metric fed exactly the trailing :meth:`covered_updates` batches
+    (``covered == min(n, window)`` for the ring), fuzzed per tier in
+    ``tests/test_streaming.py``.
 
     Example:
         >>> import jax.numpy as jnp
         >>> from torchmetrics_tpu.streaming import SlidingWindow
         >>> from torchmetrics_tpu.aggregation import SumMetric
-        >>> metric = SlidingWindow(SumMetric(), window=2)
-        >>> for batch in [1.0, 2.0, 3.0]:
+        >>> metric = SlidingWindow(SumMetric(), window=2)   # sum tags -> dual tier
+        >>> for batch in [1.0, 2.0, 3.0, 4.0]:
         ...     metric.update(batch)
+        >>> metric.covered_updates()                        # the exact trailing span
+        2
         >>> float(metric.compute())
+        7.0
+        >>> exact = SlidingWindow(SumMetric(), window=2, tier="ring")
+        >>> for batch in [1.0, 2.0, 3.0]:
+        ...     exact.update(batch)
+        >>> float(exact.compute())                          # per-update exact ring
         5.0
     """
 
-    def __init__(self, base_metric: Metric, window: int) -> None:
+    def __init__(self, base_metric: Metric, window: int, tier: str = "auto",
+                 pane: Optional[int] = None) -> None:
         super().__init__()
         _check_base(base_metric, "SlidingWindow")
         if not (isinstance(window, int) and window > 0):
@@ -112,10 +149,34 @@ class SlidingWindow(Metric):
                     "shape grows per update — it cannot live in a fixed ring; keep cat data in "
                     "list states."
                 )
+        if tier not in ("auto",) + WINDOW_TIERS:
+            raise ValueError(f"Expected `tier` to be 'auto' or one of {WINDOW_TIERS}, got {tier!r}")
+        if tier == "auto":
+            tier = window_tier(base_metric)
+            if pane is not None and tier != "two_stack":
+                # an explicit pane is a GRANULARITY request — it only means
+                # anything in the paned representation, so it forces the
+                # two-stack tier (dual-admissible metrics are always
+                # two-stack-admissible; ring-only metrics fail loud below)
+                base_metric._check_windowable("two_stack")
+                tier = "two_stack"
+        elif tier != "ring":
+            base_metric._check_windowable(tier)  # forced tier: fail loud at construction
+        if pane is not None and tier != "two_stack":
+            raise ValueError(
+                f"`pane` only applies to the two-stack tier, but tier={tier!r} was forced"
+            )
         self.base_metric = base_metric
         self.window = int(window)
-        self._ring: Optional[StateDict] = None  # lazy: built on first update
+        self.tier = tier
+        if tier == "two_stack":
+            self.pane, self.depth = window_stack_geometry(self.window, pane)
+        else:
+            self.pane, self.depth = None, None
+        self._ring: Optional[StateDict] = None  # ring tier only; lazy on first update
         self._append_ring: List[Optional[Dict[str, list]]] = []
+        self._wstate: Optional[StateDict] = None  # dual/two-stack tiers; lazy
+        self._wparam_arr = None  # device scalar: window (dual) / pane (two-stack)
 
     # ------------------------------------------------------------------ ring
 
@@ -138,9 +199,35 @@ class SlidingWindow(Metric):
 
     # ------------------------------------------------------------- lifecycle
 
+    def _dispatch_tiered(self, args: tuple, kwargs: dict) -> None:
+        """One dual/two-stack windowed update: a single donated fused XLA
+        call under the ``wdual``/``wstack`` dispatch tag."""
+        base = self.base_metric
+        if self._wstate is None:
+            self._wstate = window_defaults(base, self.window, self.tier, self.pane)
+        if self._wparam_arr is None:
+            # traced scalar input (like dupdate's decay): one executable —
+            # and one AOT cache entry — serves every window/pane length
+            wparam = self.window if self.tier == "dual" else self.pane
+            self._wparam_arr = jax.device_put(np.float32(wparam))
+        warr = self._wparam_arr
+        if self.tier == "dual":
+            fn = base._get_wdual_fn()
+            self._wstate = base._donation_safe_dispatch(
+                "wdual", lambda t, n: fn(t, n, warr, *args, **kwargs), self._wstate,
+                inputs=((warr,) + args, kwargs), jitted=fn, owner=self._wstate,
+            )
+        else:
+            fn = base._get_wstack_fn(self.depth)
+            self._wstate = base._donation_safe_dispatch(
+                "wstack", lambda t, n: fn(t, n, warr, *args, **kwargs), self._wstate,
+                inputs=((warr,) + args, kwargs), jitted=fn, owner=self._wstate,
+            )
+
     def update(self, *args: Any, **kwargs: Any) -> None:
-        """Roll this batch's contribution into the next ring slot (one
-        donated XLA call under the ``wupdate`` dispatch tag)."""
+        """Fold this batch's contribution into the windowed state (one
+        donated XLA call under the tier's dispatch tag — ``wdual``/
+        ``wstack``/``wupdate``)."""
         if self._is_synced:
             raise TorchMetricsUserError(
                 "The Metric shouldn't be synced when performing ``update``. "
@@ -148,34 +235,64 @@ class SlidingWindow(Metric):
             )
         base = self.base_metric
         args, kwargs = base._prepare_inputs(*args, **kwargs)
-        if self._ring is None:
-            self._init_ring()
-        fn = base._get_wupdate_fn()
-        slot = self._update_count % self.window
-        new_ring, appends = base._donation_safe_dispatch(
-            "wupdate", lambda t, n: fn(t, n, *args, **kwargs), self._ring,
-            inputs=(args, kwargs), jitted=fn, owner=self._ring,
-        )
-        self._ring = new_ring
-        if base._list_state_names:
-            # bounded host-side ring of list ("cat") contributions: the slot's
-            # previous occupant expires with the overwrite, exactly like the
-            # device buckets — window memory never grows past `window` updates
-            self._append_ring[slot] = {k: [v] for k, v in appends.items()}
+        if self.tier == "ring":
+            if self._ring is None:
+                self._init_ring()
+            fn = base._get_wupdate_fn()
+            slot = self._update_count % self.window
+            new_ring, appends = base._donation_safe_dispatch(
+                "wupdate", lambda t, n: fn(t, n, *args, **kwargs), self._ring,
+                inputs=(args, kwargs), jitted=fn, owner=self._ring,
+            )
+            self._ring = new_ring
+            if base._list_state_names:
+                # bounded host-side ring of list ("cat") contributions: the slot's
+                # previous occupant expires with the overwrite, exactly like the
+                # device buckets — window memory never grows past `window` updates
+                self._append_ring[slot] = {k: [v] for k, v in appends.items()}
+        else:
+            self._dispatch_tiered(args, kwargs)
         self._update_count += 1
         self._computed = None
         rec = _observability._ACTIVE
         if rec is not None:
+            n = self._update_count
+            hop = self.window if self.tier != "two_stack" else self.pane
             rec.record_window_roll(
-                base, self.window, min(self._update_count, self.window),
-                wrapped=self._update_count % self.window == 0,
+                base, self.window, min(n, self.window),
+                wrapped=n % self.window == 0,
+                tier=self.tier,
+                rotated=self.tier != "ring" and n % hop == 0,
             )
 
+    def covered_updates(self) -> int:
+        """How many trailing updates the current value folds — the span the
+        window-parity oracle compares against. Exactly ``min(n, window)`` for
+        the ring; the constant-memory tiers advance the window boundary in
+        hops (``window`` for dual, one pane for two-stack), so once warm
+        ``window <= covered < window + hop``."""
+        n = self._update_count
+        if self.tier == "dual":
+            return (self.window if n >= self.window else 0) + n % self.window
+        if self.tier == "two_stack":
+            full_panes, cc = divmod(n, self.pane)
+            return min(full_panes, self.depth) * self.pane + cc
+        return min(n, self.window)
+
     def forward(self, *args: Any, **kwargs: Any) -> Any:
-        """Roll the batch in AND return this batch's own value (the newest
-        bucket computed alone — no double update)."""
+        """Fold the batch in AND return this batch's own value (the batch
+        contribution computed alone — no double update). Like the ring
+        tier's bucket read, the batch value is computed eagerly off the hot
+        path; the windowed update itself stays one donated XLA call."""
         self.update(*args, **kwargs)
-        return self._bucket_value((self._update_count - 1) % self.window)
+        if self.tier == "ring":
+            return self._bucket_value((self._update_count - 1) % self.window)
+        base = self.base_metric
+        args, kwargs = base._prepare_inputs(*args, **kwargs)
+        bs = base._batch_state(*args, **kwargs)
+        batch_full = dict(base.init_state())
+        batch_full.update({k: jnp.asarray(v) for k, v in bs.items()})
+        return base._compute(base._concat_state(batch_full))
 
     __call__ = forward
 
@@ -195,11 +312,21 @@ class SlidingWindow(Metric):
 
     def window_state(self) -> StateDict:
         """The trailing window folded into one compute-ready state dict —
-        exactly the state a fresh metric fed the last ``window`` batches
-        would hold (list states stay host lists; ``_concat_state`` applies
-        downstream)."""
+        exactly the state a fresh metric fed the last :meth:`covered_updates`
+        batches would hold (list states stay host lists; ``_concat_state``
+        applies downstream)."""
         base = self.base_metric
         defaults = base.init_state()
+        if self.tier != "ring":
+            if self._wstate is None:
+                return defaults
+            defaults_t, _ = base._split_tensor_list(defaults)
+            if self.tier == "dual":
+                return _dual_fold(dict(base._reductions), defaults_t, self._wstate)
+            return _stack_fold(
+                dict(base._reductions), defaults_t, self.depth, self._wstate,
+                jnp.float32(self.pane),
+            )
         if self._ring is None:
             return defaults
         order = self._slot_order()
@@ -270,6 +397,7 @@ class SlidingWindow(Metric):
     def reset(self) -> None:
         self._ring = None
         self._append_ring = []
+        self._wstate = None
         self._update_count = 0
         self._computed = None
         self._is_synced = False
@@ -303,15 +431,27 @@ class SlidingWindow(Metric):
         )
 
     def state_memory(self) -> Dict[str, Any]:
-        """Ring footprint (metadata only, zero D2H) — the bounded-by-window
-        invariant an operator checks instead of the cat-growth sentinel."""
+        """Windowed-state footprint (metadata only, zero D2H) — for the dual
+        and two-stack tiers the invariant an operator checks is
+        window-INDEPENDENCE (a 100k window costs the same bytes as a 1k one);
+        for the ring it is bounded-by-window growth."""
+        if self.tier != "ring":
+            state = self._wstate
+            if state is None:
+                # report the layout's cost even before traffic — as avals
+                # (eval_shape), so the metadata-only claim holds: no device
+                # buffers are materialized just to be counted
+                state = jax.eval_shape(
+                    lambda: window_defaults(self.base_metric, self.window, self.tier, self.pane)
+                )
+            return _obs_memory.state_memory(dict(state))
         return _obs_memory.state_memory(dict(self._ring or {}))
 
     def _filter_kwargs(self, **kwargs: Any) -> Dict[str, Any]:
         return self.base_metric._filter_kwargs(**kwargs)
 
     def __repr__(self) -> str:
-        return f"SlidingWindow({self.base_metric!r}, window={self.window})"
+        return f"SlidingWindow({self.base_metric!r}, window={self.window}, tier={self.tier!r})"
 
 
 class ExponentialDecay(Metric):
