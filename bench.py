@@ -1120,6 +1120,151 @@ def bench_streaming_100k() -> dict:
     return out
 
 
+def bench_quantized_sync() -> dict:
+    """Config ``quantized_sync``: payload bytes + host sync latency, exact vs
+    bf16 vs int8 codecs, on a 16-metric collection world over a simulated
+    2-rank replay world. The byte columns come from the DETERMINISTIC
+    metadata-only byte model (``parallel.quantized_payload_model``) so the
+    gate never wobbles; the latency columns time the real coalesced plane
+    (encode + decode + fake transport) and document codec overhead. The world
+    mixes every eligibility class on purpose: calibration metrics carry the
+    compressible f32 vectors, stat metrics are int32 exact-bypass witnesses,
+    regression scalars sit under the min-leaf-bytes floor, and CatMetric
+    exercises the uneven cat path."""
+    import jax
+    import jax.numpy as jnp
+
+    from torchmetrics_tpu import MetricCollection
+    from torchmetrics_tpu import observability as obs
+    from torchmetrics_tpu.aggregation import CatMetric, MaxMetric, MeanMetric, MinMetric
+    from torchmetrics_tpu.classification import (
+        MulticlassAccuracy,
+        MulticlassCalibrationError,
+        MulticlassF1Score,
+        MulticlassPrecision,
+        MulticlassRecall,
+    )
+    from torchmetrics_tpu.parallel import SyncConfig, coalesce
+    from torchmetrics_tpu.regression import (
+        MeanAbsoluteError,
+        MeanSquaredError,
+        PearsonCorrCoef,
+        R2Score,
+    )
+
+    num_classes = 10
+    collection = MetricCollection({
+        **{f"cal_{n}": MulticlassCalibrationError(num_classes, n_bins=n, validate_args=False)
+           for n in (64, 128, 256, 512)},
+        "acc": MulticlassAccuracy(num_classes, average="macro", validate_args=False),
+        "f1": MulticlassF1Score(num_classes, average="macro", validate_args=False),
+        "prec": MulticlassPrecision(num_classes, average="macro", validate_args=False),
+        "rec": MulticlassRecall(num_classes, average="macro", validate_args=False),
+        "mse": MeanSquaredError(),
+        "mae": MeanAbsoluteError(),
+        "pearson": PearsonCorrCoef(),
+        "r2": R2Score(),
+        "mean": MeanMetric(),
+        "mx": MaxMetric(),
+        "mn": MinMetric(),
+        "cat": CatMetric(),
+    }, compute_groups=False)
+    rng = np.random.default_rng(13)
+    preds = jnp.asarray(rng.normal(size=(4096, num_classes)).astype(np.float32))
+    target = jnp.asarray(rng.integers(0, num_classes, 4096, dtype=np.int32))
+    vals = jnp.asarray(rng.normal(size=(4096,)).astype(np.float32))
+    for name, m in collection.items(keep_base=True):
+        if name.startswith(("cal", "acc", "f1", "prec", "rec")):
+            m.update(preds, target)
+        elif name in ("mse", "mae", "pearson", "r2"):
+            m.update(vals, vals * 0.9 + 0.1)
+        else:
+            m.update(vals[:1024])
+    for m in collection.values():
+        jax.block_until_ready(m._state)
+    states = [dict(m._state) for m in collection.values()]
+    reductions = [dict(m._reductions) for m in collection.values()]
+
+    class ReplayWorld:
+        """2-rank replay fake: call 0 answers the metadata collective from
+        each simulated rank's own builder (each rank owns its SyncConfig so
+        residual stores stay per-rank), call k answers bucket k-1."""
+
+        def __init__(self, configs):
+            self.configs = configs
+            self.calls = 0
+            self.metas = None
+
+        def __call__(self, value, group=None):
+            k = self.calls
+            self.calls += 1
+            if k == 0:
+                self.metas = [
+                    coalesce.build_local_metadata(states, reductions, sync_config=c)
+                    for c in self.configs
+                ]
+                return [jnp.asarray(mv) for mv in self.metas]
+            return [
+                coalesce.build_bucket_payload(states, reductions, k - 1, self.metas, sync_config=c)
+                for c in self.configs
+            ]
+
+    out: dict = {}
+    synced_by_codec = {}
+    iters = 10
+    for codec in ("none", "bf16", "int8"):
+        cfg = SyncConfig(codec=codec) if codec != "none" else None
+        model = coalesce.quantized_payload_model(states, reductions, cfg, world=2)
+        suffix = "exact" if codec == "none" else codec
+        out[f"sync_payload_bytes_{suffix}"] = model["shipped_bytes"]
+        if codec != "none":
+            out[f"{codec}_compression_x"] = round(
+                model["exact_bytes"] / model["shipped_bytes"], 3
+            )
+            eligible = model["eligible_shipped_bytes"]
+            out[f"{codec}_eligible_compression_x"] = round(
+                model["eligible_exact_bytes"] / eligible, 3
+            ) if eligible else 0.0
+            # per-codec: int8's metadata section is ~65x bf16's (scale slots)
+            out[f"{codec}_quantized_buckets"] = model["quantized_buckets"]
+            out[f"{codec}_quant_meta_bytes"] = model["quant_meta_bytes"]
+        with obs.telemetry_session():
+            configs = [
+                SyncConfig(codec=codec) if codec != "none" else None for _ in range(2)
+            ]
+            start = time.perf_counter()
+            for _ in range(iters):
+                fw = ReplayWorld(configs)
+                synced = coalesce.coalesced_process_sync(
+                    states, reductions, dist_sync_fn=fw, sync_config=configs[0]
+                )
+            out[f"sync_host_ms_{suffix}"] = round(
+                (time.perf_counter() - start) / iters * 1000, 3
+            )
+        synced_by_codec[codec] = synced
+
+    # exact-tag parity: every leaf the codec must NOT touch — int32 stat
+    # counts AND the exact-forced float leaves (sub-floor regression scalars)
+    # that ship as raw bitcast bytes INSIDE quantized buckets — is bitwise
+    # identical to the exact-plane result
+    floor = SyncConfig(codec="int8").min_leaf_bytes
+    parity = 1.0
+    for exact_state, int8_state in zip(synced_by_codec["none"], synced_by_codec["int8"]):
+        for key, val in exact_state.items():
+            if isinstance(val, list):
+                continue
+            arr = jnp.asarray(val)
+            exact_forced = (
+                arr.dtype in (jnp.int32, jnp.int64, jnp.bool_)
+                or int(arr.size) * arr.dtype.itemsize < floor
+            )
+            if exact_forced and not np.array_equal(np.asarray(val), np.asarray(int8_state[key])):
+                parity = 0.0
+    out["exact_tag_parity"] = parity
+    out["unit"] = "wire bytes / host ms, 16-metric mixed collection, simulated 2-rank world"
+    return out
+
+
 def bench_fault_selftest() -> dict:
     """Hidden config (leading underscore: excluded from the main run) proving the
     retry wrapper end to end: the FIRST subprocess attempt dies with the round-5
@@ -1145,6 +1290,7 @@ CONFIGS = {
     "multi_tenant_serving": bench_multi_tenant,
     "streaming_window": bench_streaming,
     "streaming_window_100k": bench_streaming_100k,
+    "quantized_sync": bench_quantized_sync,
     "_fault_selftest": bench_fault_selftest,
 }
 

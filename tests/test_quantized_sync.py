@@ -1,0 +1,729 @@
+"""Quantized sync plane (ISSUE 13): compressed collective buckets + codec spill.
+
+The PR 4 per-leaf parity oracle becomes the error-bound harness: every
+(tag x dtype x codec) combination is fuzzed against the exact plane with
+analytically derived tolerances — int8 block quantization is within
+``block_range/510`` per element per rank (summed across ranks for additive
+folds), bf16 within relative ``2^-8`` — while exact-tagged buckets
+(integer/bool dtypes, custom ``_merge`` leaves, ``fx=None`` leaves,
+bf16-dtype inputs, under-floor and over-budget leaves) must stay BITWISE
+identical to the per-leaf oracle. Error-feedback residuals telescope
+(bounded cumulative drift over N repeated syncs) and roll back with the
+sync: a FlakyGather mid-sync or an exhausted retry leaves the residual
+buffers untouched. World-of-one syncs skip the codec entirely.
+
+Worlds are simulated through the ``dist_sync_fn`` replay seam exactly like
+tests/test_coalesced_sync.py, each simulated rank owning its own SyncConfig
+(residual stores are per-rank state).
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import torchmetrics_tpu as tm
+from torchmetrics_tpu import MetricCollection, Metric
+from torchmetrics_tpu import observability as obs
+from torchmetrics_tpu.parallel import SyncConfig
+from torchmetrics_tpu.parallel import coalesce as C
+from torchmetrics_tpu.parallel import quantize as Q
+from torchmetrics_tpu.parallel import sync as S
+from torchmetrics_tpu.reliability import FlakyGather
+from torchmetrics_tpu.utilities.exceptions import TransientRuntimeError
+
+pytestmark = pytest.mark.quant
+
+
+# --------------------------------------------------------------- world fakes
+
+
+class QuantWorld:
+    """dist_sync_fn simulating N ranks for the quantized coalesced plane:
+    call 0 answers the metadata collective, call k answers bucket k-1, each
+    rank's row built by the same builders the real rank runs — rank i under
+    its OWN SyncConfig (``configs[i]``; None = exact)."""
+
+    def __init__(self, states_per_rank, reductions, configs=None):
+        self.states_per_rank = states_per_rank
+        self.reductions = reductions
+        self.configs = configs or [None] * len(states_per_rank)
+        self.calls = 0
+        self.metas = None
+        self.payload_bytes = []
+
+    def __call__(self, value, group=None):
+        k = self.calls
+        self.calls += 1
+        v = jnp.asarray(value)
+        self.payload_bytes.append(int(v.size) * v.dtype.itemsize)
+        if k == 0:
+            self.metas = [
+                C.build_local_metadata([s], [self.reductions], sync_config=c)
+                for s, c in zip(self.states_per_rank, self.configs)
+            ]
+            return [jnp.asarray(m) for m in self.metas]
+        return [
+            C.build_bucket_payload([s], [self.reductions], k - 1, self.metas, sync_config=c)
+            for s, c in zip(self.states_per_rank, self.configs)
+        ]
+
+
+class MultiQuantWorld:
+    """Same replay discipline for a MetricCollection's coalesced sync: every
+    rank ships ALL member states in one leaf table."""
+
+    def __init__(self, states_list_per_rank, reductions_list, configs=None):
+        self.states_list_per_rank = states_list_per_rank
+        self.reductions_list = reductions_list
+        self.configs = configs or [None] * len(states_list_per_rank)
+        self.calls = 0
+        self.metas = None
+
+    def __call__(self, value, group=None):
+        k = self.calls
+        self.calls += 1
+        if k == 0:
+            self.metas = [
+                C.build_local_metadata(sl, self.reductions_list, sync_config=c)
+                for sl, c in zip(self.states_list_per_rank, self.configs)
+            ]
+            return [jnp.asarray(m) for m in self.metas]
+        return [
+            C.build_bucket_payload(sl, self.reductions_list, k - 1, self.metas, sync_config=c)
+            for sl, c in zip(self.states_list_per_rank, self.configs)
+        ]
+
+
+def per_leaf_world(states_per_rank):
+    order = list(states_per_rank[0])
+    counter = {"i": 0}
+
+    def prepared(v):
+        if isinstance(v, list):
+            if not v:
+                return jnp.zeros((0,), jnp.float32)
+            return jnp.concatenate([jnp.atleast_1d(jnp.asarray(x)) for x in v], axis=0)
+        return jnp.asarray(v)
+
+    def fake(value, group=None):
+        name = order[counter["i"] % len(order)]
+        counter["i"] += 1
+        return [prepared(s[name]) for s in states_per_rank]
+
+    return fake
+
+
+def _make_rank_state(rng, empty_cat=False):
+    """Every reduction tag, mixed dtypes, sizes above the eligibility floor."""
+    k = int(rng.integers(1, 5))
+    cat_list = (
+        []
+        if empty_cat
+        else [jnp.asarray(rng.normal(size=(int(rng.integers(16, 33)),)).astype(np.float32)) for _ in range(k)]
+    )
+    return {
+        "s_f32": jnp.asarray(rng.normal(size=(4, 16)).astype(np.float32)),
+        "s_bf16": jnp.asarray(rng.normal(size=(40,)).astype(np.float32)).astype(jnp.bfloat16),
+        "s_i32": jnp.asarray(rng.integers(0, 100, (3, 8)).astype(np.int32)),
+        "mean_f32": jnp.asarray(rng.normal(size=(48,)).astype(np.float32)),
+        "mx": jnp.asarray(rng.normal(size=(32,)).astype(np.float32)),
+        "mn": jnp.asarray(rng.normal(size=(24,)).astype(np.float32)),
+        "cat_t": jnp.asarray(rng.normal(size=(k, 20)).astype(np.float32)),
+        "cat_l": cat_list,
+        "custom": jnp.asarray(rng.normal(size=(30,)).astype(np.float32)),
+        "none_t": jnp.asarray(rng.normal(size=(20,)).astype(np.float32)),
+        "tiny": jnp.asarray(rng.normal(size=(2,)).astype(np.float32)),  # under the floor
+    }
+
+
+_REDUCTIONS = {
+    "s_f32": "sum",
+    "s_bf16": "sum",
+    "s_i32": "sum",
+    "mean_f32": "mean",
+    "mx": "max",
+    "mn": "min",
+    "cat_t": "cat",
+    "cat_l": "cat",
+    "custom": lambda stacked: jnp.sum(stacked * 2.0, axis=0),
+    "none_t": None,
+    "tiny": "sum",
+}
+
+# leaves the codec must NEVER touch, whatever the config
+_EXACT_LEAVES = ("s_bf16", "s_i32", "custom", "none_t", "tiny")
+
+
+def _int8_bound(x):
+    x = np.asarray(jnp.ravel(jnp.asarray(x)), np.float64)
+    if x.size == 0:
+        return 0.0
+    return float(x.max() - x.min()) / 255.0 / 2.0
+
+
+def _bf16_bound(x):
+    x = np.asarray(jnp.ravel(jnp.asarray(x)), np.float64)
+    if x.size == 0:
+        return 0.0
+    return float(np.abs(x).max()) * 2.0 ** -8
+
+
+def _leaf_bound(codec, states, name, fx, world):
+    """Analytic per-element tolerance of one folded leaf (single-block int8
+    bound upper-bounds every finer block partition; scale/zero are f32, add
+    a small epsilon for their own rounding)."""
+    bound_fn = _int8_bound if codec == "int8" else _bf16_bound
+    per_rank = []
+    for s in states:
+        v = s[name]
+        if isinstance(v, list):
+            v = (
+                jnp.concatenate([jnp.atleast_1d(jnp.asarray(e)) for e in v])
+                if v
+                else jnp.zeros((0,), jnp.float32)
+            )
+        per_rank.append(bound_fn(v))
+    eps = 1e-5
+    if fx == "sum":
+        return sum(per_rank) + eps
+    if fx == "mean":
+        return sum(per_rank) / world + eps
+    return max(per_rank) + eps  # max/min/cat: elementwise per contributor
+
+
+# ------------------------------------------------- (tag x dtype x codec) fuzz
+
+
+@pytest.mark.parametrize("codec", ["bf16", "int8"])
+@pytest.mark.parametrize("world", [2, 3])
+@pytest.mark.parametrize("seed", [0, 1])
+def test_fuzz_all_tags_within_analytic_bounds(codec, world, seed):
+    """Quantized sync == exact per-leaf sync within the analytic per-codec
+    bound for every eligible tag, with exact-tagged leaves BITWISE identical
+    — including uneven cat shapes, bf16 inputs, and a zero-update rank."""
+    rng = np.random.default_rng(seed)
+    states = [
+        _make_rank_state(rng, empty_cat=(r == world - 1 and seed % 2 == 0))
+        for r in range(world)
+    ]
+    configs = [SyncConfig(codec=codec) for _ in range(world)]
+    fw = QuantWorld(states, _REDUCTIONS, configs)
+    out = C.coalesced_process_sync(
+        [dict(states[0])], [_REDUCTIONS], dist_sync_fn=fw, sync_config=configs[0]
+    )[0]
+    oracle = S._process_sync_per_leaf(
+        dict(states[0]), _REDUCTIONS, dist_sync_fn=per_leaf_world(states)
+    )
+    ctx = f"codec={codec} world={world} seed={seed}"
+    for name in _EXACT_LEAVES:
+        np.testing.assert_array_equal(
+            np.asarray(out[name]), np.asarray(oracle[name]), err_msg=f"{ctx}:{name}"
+        )
+        assert jnp.asarray(out[name]).dtype == jnp.asarray(oracle[name]).dtype
+    for name in ("s_f32", "mean_f32", "mx", "mn", "cat_t"):
+        tol = _leaf_bound(codec, states, name, _REDUCTIONS[name], world)
+        np.testing.assert_allclose(
+            np.asarray(out[name], np.float64),
+            np.asarray(oracle[name], np.float64),
+            atol=tol, rtol=0, err_msg=f"{ctx}:{name}",
+        )
+        assert jnp.asarray(out[name]).dtype == jnp.asarray(oracle[name]).dtype
+    # cat list leaves come back as world-length lists of bounded segments
+    got_l, ref_l = out["cat_l"], oracle["cat_l"]
+    assert isinstance(got_l, list)
+    got = np.concatenate([np.asarray(g, np.float64).ravel() for g in got_l])
+    ref = np.concatenate([np.asarray(g, np.float64).ravel() for g in ref_l])
+    tol = _leaf_bound(codec, states, "cat_l", "cat", world)
+    np.testing.assert_allclose(got, ref, atol=tol, rtol=0, err_msg=f"{ctx}:cat_l")
+
+
+def test_collective_count_unchanged_by_quantization():
+    """A quantized sync launches exactly as many collectives as an exact one
+    — the scale metadata rides the existing metadata collective."""
+    rng = np.random.default_rng(7)
+    states = [_make_rank_state(rng) for _ in range(2)]
+    exact = QuantWorld(states, _REDUCTIONS)
+    S.process_sync(dict(states[0]), _REDUCTIONS, dist_sync_fn=exact)
+    configs = [SyncConfig(codec="int8") for _ in range(2)]
+    quant = QuantWorld(states, _REDUCTIONS, configs)
+    S.process_sync(
+        dict(states[0]), _REDUCTIONS, dist_sync_fn=quant, sync_config=configs[0]
+    )
+    assert quant.calls == exact.calls
+    # and the f32 bucket actually shrank on the wire (call 1 = first bucket)
+    assert quant.payload_bytes[1] < exact.payload_bytes[1]
+
+
+def test_error_budget_forces_exact_bitwise():
+    """A per-tag budget below the worst-case bound forces the exact path —
+    the whole sync is then bitwise identical to the unquantized plane."""
+    rng = np.random.default_rng(3)
+    states = [_make_rank_state(rng) for _ in range(2)]
+    budget = {t: 0.0 for t in Q.ELIGIBLE_TAGS}
+    configs = [SyncConfig(codec="int8", error_budget=budget) for _ in range(2)]
+    fw = QuantWorld(states, _REDUCTIONS, configs)
+    out = C.coalesced_process_sync(
+        [dict(states[0])], [_REDUCTIONS], dist_sync_fn=fw, sync_config=configs[0]
+    )[0]
+    ew = QuantWorld(states, _REDUCTIONS)
+    ref = C.coalesced_process_sync([dict(states[0])], [_REDUCTIONS], dist_sync_fn=ew)[0]
+    for name in ref:
+        a, b = out[name], ref[name]
+        if isinstance(a, list):
+            for x, y in zip(a, b):
+                np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+        else:
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b), err_msg=name)
+    assert configs[0].residual_norm() == 0.0  # nothing quantized, nothing owed
+
+
+def test_mixed_rank_eligibility_per_rank_decode():
+    """Eligibility is a PER-RANK decision: a rank whose data blows the budget
+    ships exact (bitwise contribution) while its peer compresses — no
+    cross-rank veto needed, because each rank's segment decodes under its own
+    announced codes."""
+    rng = np.random.default_rng(5)
+    base = {"v": jnp.asarray(rng.normal(size=(64,)).astype(np.float32))}
+    big = {"v": jnp.asarray((rng.normal(size=(64,)) * 1e4).astype(np.float32))}
+    reds = {"v": "sum"}
+    budget = {"sum": 25.0}  # rank0 (range ~6) passes, rank1 (range ~6e4) fails
+    configs = [SyncConfig(codec="int8", error_budget=budget) for _ in range(2)]
+    fw = QuantWorld([base, big], reds, configs)
+    out = C.coalesced_process_sync([dict(base)], [reds], dist_sync_fn=fw, sync_config=configs[0])[0]
+    # rank1 exact + rank0 within its own bound
+    tol = _int8_bound(base["v"]) + 1e-5
+    expect = np.asarray(base["v"], np.float64) + np.asarray(big["v"], np.float64)
+    np.testing.assert_allclose(np.asarray(out["v"], np.float64), expect, atol=tol, rtol=0)
+    # and the sanity inverse: with no budget both ranks quantize — error grows
+    configs2 = [SyncConfig(codec="int8") for _ in range(2)]
+    fw2 = QuantWorld([base, big], reds, configs2)
+    out2 = C.coalesced_process_sync([dict(base)], [reds], dist_sync_fn=fw2, sync_config=configs2[0])[0]
+    tol2 = _int8_bound(base["v"]) + _int8_bound(big["v"]) + 1e-5
+    np.testing.assert_allclose(np.asarray(out2["v"], np.float64), expect, atol=tol2, rtol=0)
+
+
+# ------------------------------------------------------------ world of one
+
+
+def test_world_of_one_skips_codec_entirely():
+    """Single process + enabled codec: compress/decompress must be a NO-OP —
+    bitwise result, no residuals, no quant counters (pinned satellite)."""
+    state = {"v": jnp.asarray(np.linspace(0.0, 5.0, 64, dtype=np.float32))}
+    reds = {"v": "sum"}
+    cfg = SyncConfig(codec="int8")
+    assert jax.process_count() == 1
+    with obs.telemetry_session() as rec:
+        out = C.coalesced_process_sync([dict(state)], [reds], sync_config=cfg)[0]
+        snap = rec.counters.snapshot()
+    np.testing.assert_array_equal(np.asarray(out["v"]), np.asarray(state["v"]))
+    assert cfg.residual_norm() == 0.0
+    assert snap["quantized_buckets"] == 0 and snap["sync_bytes_saved"] == 0
+    # the deterministic byte model agrees: world=1 ships exact bytes
+    model = C.quantized_payload_model([state], [reds], cfg, world=1)
+    assert model["shipped_bytes"] == model["exact_bytes"]
+    assert model["quantized_buckets"] == 0
+
+
+# ------------------------------------------------------- error feedback
+
+
+_EF_N = 512  # leaf length: > BUCKET_SCALE_SLOTS so blocks stay multi-element
+             # (single-element blocks quantize exactly and the test trivializes)
+
+
+def _mean_world(x_np, configs):
+    states = [
+        {"v": jnp.asarray(x_np)},
+        {"v": jnp.asarray(x_np)},
+    ]
+    return states, QuantWorld(states, {"v": "sum"}, configs)
+
+
+def test_error_feedback_telescopes_over_repeated_syncs():
+    """N repeated quantized syncs of the same running-mean state: cumulative
+    shipped-vs-true drift stays within ONE quantization step (the
+    telescoping bound), instead of growing linearly like the no-feedback
+    codec's bias. Rank 1's replay config never commits (fresh contribution
+    each sync), so its constant dequantized value is subtracted out to
+    isolate rank 0's feedback stream."""
+    rng = np.random.default_rng(11)
+    x = rng.normal(size=(_EF_N,)).astype(np.float32)
+    n_syncs = 24
+
+    def run(feedback):
+        cfg0 = SyncConfig(codec="int8", error_feedback=feedback)
+        cum = np.zeros((_EF_N,), np.float64)
+        for _ in range(n_syncs):
+            cfg1 = SyncConfig(codec="int8", error_feedback=False)
+            states, fw = _mean_world(x, [cfg0, cfg1])
+            out = C.coalesced_process_sync(
+                [dict(states[0])], [{"v": "sum"}], dist_sync_fn=fw, sync_config=cfg0
+            )[0]
+            cum += np.asarray(out["v"], np.float64)
+        return cum, cfg0
+
+    # rank1's constant contribution: one quantization round-trip with the
+    # plane's own block allocation (one leaf, the whole fixed slot pool)
+    nb = Q.allocate_blocks([_EF_N], Q.BUCKET_SCALE_SLOTS)[0]
+    q, s, z = Q.block_quantize(jnp.asarray(x), nb)
+    rank1_const = np.asarray(Q.block_dequantize(q, s, z, _EF_N, jnp.float32), np.float64)
+
+    per_sync_bound = _int8_bound(x) + 1e-5
+    cum_fb, cfg_fb = run(True)
+    drift_fb = np.abs(cum_fb - n_syncs * rank1_const - n_syncs * np.asarray(x, np.float64))
+    # telescoping: total drift of the feedback stream is ONE step, not N
+    assert float(drift_fb.max()) <= 2.0 * per_sync_bound
+    assert 0.0 <= cfg_fb.residual_norm() <= np.sqrt(_EF_N) * per_sync_bound * 1.01
+
+    cum_raw, _ = run(False)
+    drift_raw = np.abs(cum_raw - n_syncs * rank1_const - n_syncs * np.asarray(x, np.float64))
+    # feedback never does worse than the raw codec's accumulated bias
+    assert float(drift_fb.max()) <= float(drift_raw.max()) + 2.0 * per_sync_bound
+
+
+def test_flaky_gather_leaves_residuals_uncommitted():
+    """A transient failure mid-sync (metadata OR bucket collective) must not
+    commit residuals — the retry re-quantizes from the same base, so a failed
+    sync can never double-apply feedback."""
+    rng = np.random.default_rng(13)
+    x = rng.normal(size=(64,)).astype(np.float32)
+    for fail_times in (1, 2):  # fail on the metadata call / the bucket call
+        cfg0 = SyncConfig(codec="int8")
+        cfg1 = SyncConfig(codec="int8")
+        states, fw = _mean_world(x, [cfg0, cfg1])
+        flaky = FlakyGather(inner=fw, fail_times=fail_times)
+        with pytest.raises(TransientRuntimeError):
+            C.coalesced_process_sync(
+                [dict(states[0])], [{"v": "sum"}], dist_sync_fn=flaky, sync_config=cfg0
+            )
+        assert cfg0.residual_norm() == 0.0, f"fail_times={fail_times}"
+    # and a successful retry after the transient commits exactly one step:
+    cfg0 = SyncConfig(codec="int8")
+    states, fw = _mean_world(x, [cfg0, SyncConfig(codec="int8")])
+    flaky = FlakyGather(inner=fw, fail_times=1)
+    with pytest.raises(TransientRuntimeError):
+        C.coalesced_process_sync(
+            [dict(states[0])], [{"v": "sum"}], dist_sync_fn=flaky, sync_config=cfg0
+        )
+    states2, fw2 = _mean_world(x, [cfg0, SyncConfig(codec="int8")])
+    C.coalesced_process_sync(
+        [dict(states2[0])], [{"v": "sum"}], dist_sync_fn=fw2, sync_config=cfg0
+    )
+    clean = SyncConfig(codec="int8")
+    states3, fw3 = _mean_world(x, [clean, SyncConfig(codec="int8")])
+    C.coalesced_process_sync(
+        [dict(states3[0])], [{"v": "sum"}], dist_sync_fn=fw3, sync_config=clean
+    )
+    assert cfg0.residual_norm() == pytest.approx(clean.residual_norm())
+
+
+def test_metric_sync_exhausted_retry_restores_residuals():
+    """Through the full Metric.sync retry stack: an exhausted transient
+    budget rolls the metric back to its last good state AND leaves the
+    residual store untouched."""
+    from torchmetrics_tpu.reliability import ReliabilityConfig, RetryPolicy
+
+    class _Sum(Metric):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.add_state("x", jnp.zeros((64,)), dist_reduce_fx="sum")
+
+        def _batch_state(self, x):
+            return {"x": jnp.asarray(x, jnp.float32)}
+
+        def _compute(self, state):
+            return state["x"].sum()
+
+    m = _Sum(reliability=ReliabilityConfig(retry=RetryPolicy(max_attempts=2, backoff_base=0.0)))
+    m.update(jnp.asarray(np.linspace(0, 1, 64, dtype=np.float32)))
+    before = {k: np.asarray(v) for k, v in m._state.items()}
+    cfg = SyncConfig(codec="int8")
+    flaky = FlakyGather(inner=lambda v, g=None: [jnp.asarray(v)] * 2, fail_times=10)
+    with pytest.raises(TransientRuntimeError):
+        m.sync(dist_sync_fn=flaky, distributed_available=lambda: True, sync_config=cfg)
+    np.testing.assert_array_equal(np.asarray(m._state["x"]), before["x"])
+    assert cfg.residual_norm() == 0.0
+
+
+# ------------------------------------------------ collection + async threading
+
+
+def _float_collection():
+    col = MetricCollection({
+        # n_bins sized so the compressible payload clearly out-weighs the
+        # fixed quant metadata section (2 records x BUCKET_SCALE_SLOTS pairs)
+        "cal": tm.classification.MulticlassCalibrationError(5, n_bins=512, validate_args=False),
+        "mse": tm.regression.MeanSquaredError(),
+        "mean": tm.aggregation.MeanMetric(),
+    }, compute_groups=False)
+    rng = np.random.default_rng(17)
+    preds = jnp.asarray(rng.normal(size=(256, 5)).astype(np.float32))
+    target = jnp.asarray(rng.integers(0, 5, 256, dtype=np.int32))
+    col["cal"].update(preds, target)
+    col["mse"].update(jnp.asarray(rng.normal(size=(128,)).astype(np.float32)),
+                      jnp.asarray(rng.normal(size=(128,)).astype(np.float32)))
+    col["mean"].update(jnp.asarray(rng.normal(size=(128,)).astype(np.float32)))
+    return col
+
+
+def test_collection_sync_threads_sync_config():
+    """MetricCollection.sync(sync_config=...) quantizes the one bucketed
+    collective set; results match the exact collection sync within the
+    analytic bound, and the quant counters tick."""
+    col = _float_collection()
+    states = [dict(m._state) for m in col.values()]
+    reds = [dict(m._reductions) for m in col.values()]
+    configs = [SyncConfig(codec="int8") for _ in range(2)]
+    fw = MultiQuantWorld([states, states], reds, configs)
+    with obs.telemetry_session() as rec:
+        col.sync(dist_sync_fn=fw, distributed_available=lambda: True, sync_config=configs[0])
+        snap = rec.counters.snapshot()
+        quant_events = rec.events_of("quant")
+    assert snap["quantized_buckets"] >= 1
+    assert snap["sync_bytes_saved"] > 0
+    assert len(quant_events) == 1
+    payload = quant_events[0].payload
+    assert payload["shipped_bytes"] < payload["raw_bytes"]
+    assert payload["compression_x"] > 1.5
+    assert rec.quant_feedback_norm() == pytest.approx(configs[0].residual_norm())
+    # value sanity: synced calibration state ~= 2x the local one (2 identical ranks)
+    cal_synced = np.asarray(col["cal"]._state["conf_bin"], np.float64)
+    col.unsync()
+    cal_local = np.asarray(col["cal"]._state["conf_bin"], np.float64)
+    tol = 2 * _int8_bound(cal_local) + 1e-5
+    np.testing.assert_allclose(cal_synced, 2.0 * cal_local, atol=tol, rtol=0)
+
+
+def test_async_sync_compresses_in_worker_bitwise_vs_blocking():
+    """sync(async_=True, sync_config=...) quantizes in the background worker;
+    committed states are BITWISE identical to the blocking quantized sync
+    (deterministic codec, same residual base)."""
+    col_a = _float_collection()
+    col_b = _float_collection()
+    states = [dict(m._state) for m in col_a.values()]
+    reds = [dict(m._reductions) for m in col_a.values()]
+
+    cfg_blocking = [SyncConfig(codec="int8") for _ in range(2)]
+    fw_b = MultiQuantWorld([states, states], reds, cfg_blocking)
+    col_a.sync(dist_sync_fn=fw_b, distributed_available=lambda: True, sync_config=cfg_blocking[0])
+
+    cfg_async = [SyncConfig(codec="int8") for _ in range(2)]
+    fw_a = MultiQuantWorld([states, states], reds, cfg_async)
+    handle = col_b.sync(
+        async_=True, dist_sync_fn=fw_a, distributed_available=lambda: True,
+        sync_config=cfg_async[0],
+    )
+    handle.commit()
+    for (na, ma), (nb, mb) in zip(col_a.items(keep_base=True), col_b.items(keep_base=True)):
+        for key in ma._state:
+            np.testing.assert_array_equal(
+                np.asarray(ma._state[key]), np.asarray(mb._state[key]),
+                err_msg=f"{na}:{key}",
+            )
+    assert cfg_async[0].residual_norm() == pytest.approx(cfg_blocking[0].residual_norm())
+
+
+# ------------------------------------------------------------- payload model
+
+
+def test_payload_model_hits_acceptance_ratios():
+    """The deterministic byte model (what the bench gates) shows >=1.9x for
+    bf16-eligible f32 buckets and >=1.9x overall for int8 on a float-heavy
+    16-leaf world."""
+    rng = np.random.default_rng(23)
+    state = {
+        f"v{i}": jnp.asarray(rng.normal(size=(256,)).astype(np.float32)) for i in range(12)
+    }
+    state["counts"] = jnp.asarray(rng.integers(0, 9, (16,)).astype(np.int32))
+    state["tiny"] = jnp.asarray(rng.normal(size=(2,)).astype(np.float32))
+    reds = {k: "sum" for k in state}
+    for codec, overall_min in (("bf16", 1.9), ("int8", 1.9)):
+        model = C.quantized_payload_model([state], [reds], SyncConfig(codec=codec), world=2)
+        assert model["quantized_buckets"] == 1
+        assert model["leaves_quantized"] == 12
+        eligible_x = model["eligible_exact_bytes"] / model["eligible_shipped_bytes"]
+        assert eligible_x >= 1.9, codec
+        overall_x = model["exact_bytes"] / model["shipped_bytes"]
+        assert overall_x >= overall_min, codec
+    exact_model = C.quantized_payload_model([state], [reds], None, world=2)
+    assert exact_model["shipped_bytes"] == exact_model["exact_bytes"]
+
+
+# --------------------------------------------------------------- spill codec
+
+
+def _spill_engine(codec, rng_seed=29):
+    from torchmetrics_tpu.serving import ServingConfig, ServingEngine
+
+    eng = ServingEngine(
+        tm.classification.MulticlassCalibrationError(5, n_bins=64, validate_args=False),
+        ServingConfig(capacity=2, megabatch_size=2, spill_codec=codec),
+    )
+    rng = np.random.default_rng(rng_seed)
+    batches = {
+        tid: (
+            jnp.asarray(rng.normal(size=(64, 5)).astype(np.float32)),
+            jnp.asarray(rng.integers(0, 5, 64, dtype=np.int32)),
+        )
+        for tid in ("a", "b", "c")
+    }
+    for tid, (p, t) in batches.items():
+        eng.update(tid, p, t)
+    eng.flush()
+    return eng, batches
+
+
+def test_spill_codec_shrinks_host_bytes_counts_exact():
+    """int8 spill: fewer host bytes per cold tenant, bounded value error on
+    spilled reads AND after readmission, exact update counts either way."""
+    eng_none, batches = _spill_engine("none")
+    eng_q, _ = _spill_engine("int8")
+    assert eng_q.tenants()["a"]["spilled"] and eng_none.tenants()["a"]["spilled"]
+    assert eng_q.memory()["spilled_host_bytes"] < eng_none.memory()["spilled_host_bytes"]
+    assert eng_q.stats["spill_bytes_saved"] > 0
+    assert eng_none.stats["spill_bytes_saved"] == 0
+    # spilled read (no readmission): values within the block bound of exact
+    exact_state = {
+        k: np.asarray(v, np.float64) for k, v in eng_none._tenant_state(eng_none._tenants["a"]).items()
+    }
+    q_state = {
+        k: np.asarray(v, np.float64) for k, v in eng_q._tenant_state(eng_q._tenants["a"]).items()
+    }
+    for k in exact_state:
+        tol = _int8_bound(exact_state[k]) + 1e-5
+        np.testing.assert_allclose(q_state[k], exact_state[k], atol=tol, rtol=0, err_msg=k)
+    assert eng_q.update_count("a") == eng_none.update_count("a")
+    # readmission (traffic returns): same bound holds through the round-trip
+    p, t = batches["a"]
+    eng_q.update("a", p, t)
+    eng_none.update("a", p, t)
+    eng_q.flush()
+    eng_none.flush()
+    va, vn = float(eng_q.compute("a")), float(eng_none.compute("a"))
+    assert abs(va - vn) < 0.05  # calibration error is a [0,1] statistic
+    # exact codec round-trips bitwise: none-engine spilled state == its stack row
+    sd = eng_none.state_dict("b")
+    eng_none.load_state_dict("b", sd)
+    sd2 = eng_none.state_dict("b")
+    for k, v in sd.items():
+        np.testing.assert_array_equal(np.asarray(v), np.asarray(sd2[k]), err_msg=k)
+
+
+def test_spill_codec_rejected_at_config_time():
+    from torchmetrics_tpu.serving import ServingConfig
+
+    with pytest.raises(ValueError, match="spill_codec"):
+        ServingConfig(spill_codec="int4")
+
+
+# ------------------------------------------------------------- misc contracts
+
+
+def test_residual_prefix_pinned_to_metric_constant():
+    from torchmetrics_tpu import metric as metric_mod
+
+    assert Q.RESIDUAL_KEY_PREFIX == metric_mod.QUANT_RESIDUAL_KEY
+
+
+def test_sync_config_validation_and_pickle():
+    import pickle
+
+    with pytest.raises(ValueError, match="codec"):
+        SyncConfig(codec="int4")
+    with pytest.raises(ValueError, match="min_leaf_bytes"):
+        SyncConfig(min_leaf_bytes=-1)
+    cfg = SyncConfig(codec="int8")
+    cfg._commit_residuals({"k": np.ones((3,), np.float32)})
+    clone = pickle.loads(pickle.dumps(cfg))
+    assert clone.codec == "int8" and clone.residual_norm() == 0.0  # residuals never ride pickles
+    assert cfg.residual_norm() > 0.0
+    cfg.clear_residuals()
+    assert cfg.residual_norm() == 0.0
+
+
+def test_block_quantize_roundtrip_bounds():
+    rng = np.random.default_rng(31)
+    for n, nb in ((1, 1), (7, 2), (64, 4), (1000, 16)):
+        x = jnp.asarray(rng.normal(size=(n,)).astype(np.float32) * 10)
+        q, s, z = Q.block_quantize(x, nb)
+        deq = np.asarray(Q.block_dequantize(q, s, z, n, jnp.float32), np.float64)
+        bound = float(np.max(s)) / 2.0 + 1e-6
+        assert np.abs(deq - np.asarray(x, np.float64)).max() <= bound
+    # constant block: exact round-trip (scale degenerates to 1, zero carries it)
+    x = jnp.full((16,), 3.25, jnp.float32)
+    q, s, z = Q.block_quantize(x, 2)
+    np.testing.assert_array_equal(
+        np.asarray(Q.block_dequantize(q, s, z, 16, jnp.float32)), np.asarray(x)
+    )
+
+
+def test_trace_report_renders_quant_events(tmp_path):
+    """tools/trace_report.py: quant events get a per-codec compression table
+    and bytes-saved joins the sync footer totals."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "trace_report", "/root/repo/tools/trace_report.py"
+    )
+    trace_report = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(trace_report)
+
+    path = tmp_path / "trace.jsonl"
+    events = [
+        {"kind": "sync", "metric": "MetricCollection", "tag": "sync", "timestamp": 1.0,
+         "payload": {"payload_bytes": 4096, "collectives": 3, "coalesced_leaves": 8}},
+        {"kind": "quant", "metric": "coalesced_sync", "tag": "int8", "timestamp": 1.1,
+         "payload": {"buckets": 1, "leaves": 6, "raw_bytes": 4096, "shipped_bytes": 1200,
+                     "bytes_saved": 2896, "compression_x": 3.413, "feedback_norm": 0.002}},
+    ]
+    path.write_text("\n".join(json.dumps(e) for e in events) + "\n")
+    report = trace_report.aggregate(trace_report.load_events(str(path)))
+    assert report["totals"]["quant_syncs"] == 1
+    assert report["totals"]["quant_bytes_saved"] == 2896
+    assert report["quant"][0]["codec"] == "int8"
+    assert report["quant"][0]["compression_x"] == pytest.approx(3.413, abs=0.01)
+    text = trace_report.render_table(report)
+    assert "quantized syncs:" in text
+    assert "2896 bytes saved quantized" in text
+    assert "3.413" in text
+
+
+def test_corrupt_codec_bits_degrade_to_lockstep_fallback():
+    """A same-version peer row with impossible codec announcements (codec
+    bits on an int32 leaf / an unknown code on an f32 leaf) must degrade to
+    the exact per-leaf plane via CoalesceFallback — never a KeyError or a
+    silently mis-sliced bucket."""
+    state = {"v": jnp.asarray(np.linspace(0, 1, 64, dtype=np.float32)),
+             "n": jnp.asarray(np.arange(8, dtype=np.int32))}
+    reds = {"v": "sum", "n": "sum"}
+    cfg = SyncConfig(codec="int8")
+    meta = np.array(C.build_local_metadata([state], [reds], sync_config=cfg))
+    for leaf_idx, bad_code in ((1, 2), (0, 3)):  # int32 leaf flagged / unknown code
+        corrupt = np.array(meta)
+        slot = 4 + leaf_idx * 11 + 10  # _HEADER_LEN + i*_LEAF_REC_LEN + kind slot
+        corrupt[slot] = (corrupt[slot] & 1) | (bad_code << 1)
+
+        def fake(v, g=None, _c=corrupt):
+            a = np.asarray(v)
+            if a.dtype.kind == "i" and a.size == meta.size:
+                return [jnp.asarray(_c), jnp.asarray(_c)]
+            return [jnp.asarray(v), jnp.asarray(v)]  # per-leaf fallback rows
+
+        out = S.process_sync(dict(state), reds, dist_sync_fn=fake,
+                             sync_config=SyncConfig(codec="int8"))
+        np.testing.assert_allclose(np.asarray(out["v"]), 2 * np.asarray(state["v"]), rtol=1e-6)
+        np.testing.assert_array_equal(np.asarray(out["n"]), 2 * np.asarray(state["n"]))
+
+
+def test_fallback_plane_stays_exact():
+    """A CoalesceFallback (mangled metadata) under an enabled codec re-runs
+    the per-leaf plane EXACTLY — quantization only exists on the fast path."""
+    cfg = SyncConfig(codec="int8")
+    fake = lambda v, g=None: [jnp.asarray(v) + i for i in range(3)]
+    out = S.process_sync({"v": jnp.asarray(4.0)}, {"v": "mean"}, dist_sync_fn=fake,
+                         sync_config=cfg)
+    np.testing.assert_allclose(float(out["v"]), 5.0)
+    assert cfg.residual_norm() == 0.0

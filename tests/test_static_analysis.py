@@ -154,6 +154,10 @@ def test_registered_tags_match_runtime_set():
         # the whole namespace — the dual tier packs its pair under the
         # state's own name and needs no reserved prefix)
         "__window_front:", "__window_back:", "__window_bagg:",
+        # quantized sync plane's error-feedback residual namespace (ISSUE 13;
+        # mirrors parallel.quantize.RESIDUAL_KEY_PREFIX, pinned equal in
+        # tests/test_quantized_sync.py)
+        "__quant_err:",
     }
 
 

@@ -130,6 +130,20 @@ THRESHOLDS: Dict[str, float] = {
     "extra.streaming_window_100k.state_memory_bytes_1k": 0.05,
     "extra.streaming_window_100k.dual_mem_window_ratio": 0.01,
     "extra.streaming_window_100k.vwupdate_fresh_compiles": 0.25,
+    # quantized sync plane (ISSUE 13): the payload-byte columns come from a
+    # DETERMINISTIC metadata-only byte model (same collection, same codec →
+    # same bytes), so they gate tight — growth means the codec silently
+    # stopped compressing (or scale metadata bloated). The host-latency
+    # columns time real replay-world syncs on a shared pod and wobble like
+    # the other host-plane latencies. exact_tag_parity is exactly 1.0 —
+    # any drop means an exact-tagged bucket stopped being bitwise.
+    "extra.quantized_sync.sync_payload_bytes_exact": 0.05,
+    "extra.quantized_sync.sync_payload_bytes_bf16": 0.05,
+    "extra.quantized_sync.sync_payload_bytes_int8": 0.05,
+    "extra.quantized_sync.sync_host_ms_exact": 0.6,
+    "extra.quantized_sync.sync_host_ms_bf16": 0.6,
+    "extra.quantized_sync.sync_host_ms_int8": 0.6,
+    "extra.quantized_sync.exact_tag_parity": 0.01,
 }
 
 # Metrics KNOWN to go missing in some rounds for an environmental reason,
@@ -161,9 +175,11 @@ _HIGHER_MARKERS = ("per_sec", "speedup", "throughput")
 # a correctness regression, not noise.
 # windowed_serving_ratio: windowed-vs-plain serving throughput (the ≥80%
 # acceptance headline — higher is the point, and the name carries no marker)
+# exact_tag_parity: 1.0 when every exact-tagged bucket of a quantized sync is
+# bitwise identical to the per-leaf oracle — any drop is a correctness break.
 _HIGHER_EXACT = ("value", "vs_baseline", "tenants_per_dispatch",
                  "async_sync_overlap_pct", "async_state_parity",
-                 "windowed_serving_ratio")
+                 "windowed_serving_ratio", "exact_tag_parity")
 _LOWER_MARKERS = ("latency", "compile", "_sec", "_ms", "_us", "_bytes", "bytes_", "time")
 # collective counts per sync: fewer is the whole point of the coalesced plane —
 # a move back toward per-leaf collectives must gate even though the name
@@ -190,7 +206,15 @@ _INFO_EXACT = ("leaves_coalesced_per_sync", "per_leaf_collectives", "ttfu_precom
                # streaming_window_100k constants: the ring comparison window /
                # its O(window) bytes (workload descriptors, not perf) and the
                # telemetry row count of the one-compile probe
-               "ring_window", "ring_state_memory_bytes", "windowed_rows_recorded")
+               "ring_window", "ring_state_memory_bytes", "windowed_rows_recorded",
+               # quantized_sync: compression ratios are info-pinned (tracked
+               # across rounds; the deterministic byte columns gate the same
+               # regressions without dividing two gated numbers), and the
+               # bucket count is a workload constant of the 16-metric world
+               "bf16_compression_x", "int8_compression_x",
+               "bf16_eligible_compression_x", "int8_eligible_compression_x",
+               "bf16_quantized_buckets", "int8_quantized_buckets",
+               "bf16_quant_meta_bytes", "int8_quant_meta_bytes")
 
 
 def direction(name: str) -> Optional[str]:

@@ -101,9 +101,12 @@ def aggregate(events: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
         "sync_calls": 0, "sync_payload_bytes": 0,
         "sync_collectives": 0, "leaves_coalesced": 0,
         "window_wraps": 0, "async_syncs": 0, "serve_rejected": 0,
+        "quant_syncs": 0, "quant_bytes_saved": 0,
     }
     # async double-buffered syncs: gather wall vs commit wait, per event
     async_stats = {"gather_s": 0.0, "wait_s": 0.0, "overlap_pct_sum": 0.0, "fallbacks": 0}
+    # quantized syncs: per-(rank, codec) compression rows
+    quant_rows: Dict[Tuple[Any, str], Dict[str, Any]] = {}
     retries: List[Dict[str, Any]] = []
     quarantines: List[Dict[str, Any]] = []
     row_hists: Dict[Tuple[Any, str, str], Dict[str, Any]] = {}  # joins report rows
@@ -153,6 +156,24 @@ def aggregate(events: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
             totals["window_wraps"] += 1
         elif kind == "serve_rejected":
             totals["serve_rejected"] += 1
+        elif kind == "quant":
+            # one event per quantized coalesced sync: tag carries the codec,
+            # payload the raw-vs-shipped byte accounting
+            payload = ev.get("payload", {})
+            totals["quant_syncs"] += 1
+            # clamped like the sync_bytes_saved counter, so the footer and
+            # the fleet counter agree; the per-codec rows below keep the raw
+            # raw/shipped bytes (a compression_x < 1 stays visible there)
+            totals["quant_bytes_saved"] += max(0, int(payload.get("bytes_saved", 0)))
+            qrow = quant_rows.setdefault(
+                (rank, tag), {"events": 0, "raw_bytes": 0, "shipped_bytes": 0,
+                              "buckets": 0, "feedback_norm": 0.0}
+            )
+            qrow["events"] += 1
+            qrow["raw_bytes"] += int(payload.get("raw_bytes", 0))
+            qrow["shipped_bytes"] += int(payload.get("shipped_bytes", 0))
+            qrow["buckets"] += int(payload.get("buckets", 0))
+            qrow["feedback_norm"] = float(payload.get("feedback_norm", 0.0))
         elif kind == "async_sync":
             totals["async_syncs"] += 1
             payload = ev.get("payload", {})
@@ -224,9 +245,25 @@ def aggregate(events: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
             "mean_overlap_pct": round(async_stats["overlap_pct_sum"] / n, 2) if n else None,
             "async_fallbacks": async_stats["fallbacks"],
         }
+    quant = []
+    for (rank, codec), qrow in sorted(quant_rows.items(), key=lambda kv: (_rank_key(kv[0][0]), kv[0][1])):
+        shipped = qrow["shipped_bytes"]
+        entry = {
+            "codec": codec,
+            "events": qrow["events"],
+            "buckets": qrow["buckets"],
+            "raw_bytes": qrow["raw_bytes"],
+            "shipped_bytes": shipped,
+            "compression_x": round(qrow["raw_bytes"] / shipped, 3) if shipped else None,
+            "feedback_norm": qrow["feedback_norm"],
+        }
+        if any_rank:
+            entry["rank"] = rank
+        quant.append(entry)
     return {
         "rows": report_rows, "totals": totals, "retries": retries, "quarantines": quarantines,
         "latency": latency, "multi_rank": any_rank, "streaming": streaming,
+        "quant": quant or None,
     }
 
 
@@ -248,14 +285,27 @@ def render_table(report: Dict[str, Any]) -> str:
     t = report["totals"]
     lines.append("")
     per_sync = round(t["sync_collectives"] / t["sync_calls"], 2) if t["sync_calls"] else 0
+    saved = f", {t['quant_bytes_saved']} bytes saved quantized" if t["quant_syncs"] else ""
     lines.append(
         f"retries: {t['retries']} (exhausted: {t['retries_exhausted']})  "
         f"quarantines: {t['quarantines']}  "
         f"d2h readbacks: {t['d2h_readbacks']} ({t['d2h_bytes']} bytes)  "
         f"syncs: {t['sync_calls']} ({t['sync_payload_bytes']} payload bytes, "
         f"{t['sync_collectives']} collectives = {per_sync}/sync, "
-        f"{t['leaves_coalesced']} leaves coalesced)"
+        f"{t['leaves_coalesced']} leaves coalesced{saved})"
     )
+    if report.get("quant"):
+        qheaders = ("codec", "events", "buckets", "raw_bytes", "shipped_bytes",
+                    "compression_x", "feedback_norm")
+        if report.get("multi_rank"):
+            qheaders = ("rank",) + qheaders
+        qtable = [[str(r.get(h)) if r.get(h) is not None else "-" for h in qheaders]
+                  for r in report["quant"]]
+        qwidths = [max(len(h), *(len(row[i]) for row in qtable)) for i, h in enumerate(qheaders)]
+        lines.append("quantized syncs:")
+        lines.append("  " + "  ".join(h.ljust(w) for h, w in zip(qheaders, qwidths)))
+        for row in qtable:
+            lines.append("  " + "  ".join(c.ljust(w) for c, w in zip(row, qwidths)))
     if report.get("streaming"):
         s = report["streaming"]
         line = (

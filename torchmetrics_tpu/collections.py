@@ -707,7 +707,7 @@ class MetricCollection:
         for name, metric in self._modules.items():
             metric.load_state_dict(state_dict, prefix=f"{name}.", validate=validate)
 
-    def sync(self, async_: bool = False, **kwargs: Any) -> Any:
+    def sync(self, async_: bool = False, sync_config: Optional[Any] = None, **kwargs: Any) -> Any:
         """Cross-process sync of every member. Fast path: ALL members' states
         coalesce into one bucketed collective set (K·L per-leaf collectives →
         1 metadata gather + one padded gather per dtype); fused compute-group
@@ -724,10 +724,17 @@ class MetricCollection:
         member to the synced previous-window state — the live (since-updated)
         state parks in the sync cache and ``unsync()`` restores it, so the
         overlap loses nothing. A failed gather commits NOTHING (members keep
-        their last good state). See ``docs/streaming.md``."""
+        their last good state). See ``docs/streaming.md``.
+
+        ``sync_config`` (:class:`~torchmetrics_tpu.parallel.SyncConfig`) opts
+        the coalesced fast path into quantized (bf16/int8) buckets; use ONE
+        config per collection across repeated syncs so its error-feedback
+        residuals fold correctly. The per-member fallback below stays exact —
+        residual keys are positional within the coalesced leaf table, so a
+        per-member re-run must not consume them (docs/distributed.md)."""
         if async_:
-            return self._async_sync(**kwargs)
-        if self._coalesced_sync(list(self._modules.values()), **kwargs):
+            return self._async_sync(sync_config=sync_config, **kwargs)
+        if self._coalesced_sync(list(self._modules.values()), sync_config=sync_config, **kwargs):
             return None
         for metric in self._modules.values():
             metric.sync(**kwargs)
@@ -740,6 +747,7 @@ class MetricCollection:
         process_group: Optional[Any] = None,
         should_sync: bool = True,
         distributed_available: Optional[Any] = None,
+        sync_config: Optional[Any] = None,
     ) -> bool:
         """Coalesced multi-metric sync. Returns ``True`` when this call fully
         handled the sync (including the distributed-unavailable no-op) and
@@ -790,7 +798,8 @@ class MetricCollection:
         coal0 = rec.counters.value("gathers_coalesced") if rec is not None else 0
         def attempt() -> List[Dict[str, Any]]:
             return _coalesce.coalesced_process_sync(
-                states, reductions, process_group=group, dist_sync_fn=fn
+                states, reductions, process_group=group, dist_sync_fn=fn,
+                sync_config=sync_config,
             )
 
         def count_attempt(exc: BaseException, attempt_no: int) -> None:
@@ -855,6 +864,7 @@ class MetricCollection:
         should_sync: bool = True,
         distributed_available: Optional[Any] = None,
         rebuffer: bool = True,
+        sync_config: Optional[Any] = None,
     ) -> "Any":
         """Launch the double-buffered background sync (``sync(async_=True)``).
 
@@ -958,6 +968,7 @@ class MetricCollection:
         return AsyncSyncHandle(
             frozen, reductions, process_group=group, dist_sync_fn=fn,
             retry=retry, committer=committer, label="MetricCollection.sync",
+            sync_config=sync_config,
         )
 
     def unsync(self, **kwargs: Any) -> None:

@@ -102,6 +102,14 @@ WINDOW_BAGG_KEY = "__window_bagg:"
 #: per-update bucket ring — custom merges, list/cat states, exact trailing-N)
 WINDOW_TIERS = ("dual", "two_stack", "ring")
 
+#: reserved leaf-name prefix of the quantized sync plane's error-feedback
+#: residual buffers (``parallel/quantize.py`` — the store keys residuals as
+#: ``prefix + "<state_idx>:<leaf_name>"``). Mirrors
+#: ``parallel.quantize.RESIDUAL_KEY_PREFIX`` (pinned equal by test) so the
+#: graftlint reserved-key registry, which parses metric.py's ``*_KEY``
+#: constants, covers the quant namespace too.
+QUANT_RESIDUAL_KEY = "__quant_err:"
+
 
 def _fresh_leaf(default: Any) -> Array:
     """Fresh device buffer from a state default, with no device→host readback.
@@ -1418,8 +1426,14 @@ class Metric:
         process_group: Optional[Any] = None,
         should_sync: bool = True,
         distributed_available: Optional[Callable] = None,
+        sync_config: Optional[Any] = None,
     ) -> None:
-        """Replace local state with cross-process-reduced state (reference metric.py:573)."""
+        """Replace local state with cross-process-reduced state (reference metric.py:573).
+
+        ``sync_config`` (:class:`~torchmetrics_tpu.parallel.SyncConfig`) opts
+        this sync into the quantized (bf16/int8) collective buckets; use ONE
+        config instance per metric across repeated syncs so its error-feedback
+        residuals fold correctly (docs/distributed.md)."""
         if self._is_synced and should_sync:
             raise TorchMetricsUserError("The Metric has already been synced.")
         is_dist = (distributed_available or self.distributed_available_fn)()
@@ -1439,6 +1453,7 @@ class Metric:
                     self._reductions,
                     process_group=process_group or self.process_group,
                     dist_sync_fn=dist_sync_fn or self.dist_sync_fn,
+                    sync_config=sync_config,
                 ),
             )
         if rec is not None:

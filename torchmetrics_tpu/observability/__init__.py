@@ -185,6 +185,7 @@ class TelemetryRecorder:
         self._retrace_warned: set = set()
         self._drift: Dict[str, float] = {}  # last score per DriftMonitor name
         self._drift_warned: set = set()
+        self._quant_norm = 0.0  # latest error-feedback residual L2 (gauge)
         self._closed = False
 
     # ------------------------------------------------------------- identities
@@ -508,6 +509,46 @@ class TelemetryRecorder:
 
     def drift_scores(self) -> Dict[str, float]:
         return dict(self._drift)
+
+    def record_quant(
+        self,
+        label: str,
+        codec: str,
+        buckets: int,
+        leaves: int,
+        raw_bytes: int,
+        shipped_bytes: int,
+        feedback_norm: float = 0.0,
+    ) -> None:
+        """One coalesced sync that shipped quantized buckets
+        (``parallel/quantize.py``). ``raw_bytes`` is what the exact plane
+        would have put on the wire for those buckets, ``shipped_bytes`` what
+        the codec actually shipped (scale metadata included); the difference
+        feeds the ``sync_bytes_saved`` counter and the per-event compression
+        ratio ``tools/trace_report.py`` renders. ``feedback_norm`` is the
+        residual store's L2 after the sync — the ``quant_error_feedback_norm``
+        gauge (``quant_feedback_norm`` in the SLO namespace): a norm that
+        climbs sync over sync means the codec is too coarse for the data."""
+        self.counters.record_quant(buckets, raw_bytes - shipped_bytes)
+        self._quant_norm = float(feedback_norm)
+        ratio = (raw_bytes / shipped_bytes) if shipped_bytes > 0 else 0.0
+        self._event(
+            "quant", label, codec,
+            payload={
+                "buckets": int(buckets),
+                "leaves": int(leaves),
+                "raw_bytes": int(raw_bytes),
+                "shipped_bytes": int(shipped_bytes),
+                "bytes_saved": int(raw_bytes - shipped_bytes),
+                "compression_x": round(ratio, 3),
+                "feedback_norm": round(float(feedback_norm), 9),
+            },
+        )
+
+    def quant_feedback_norm(self) -> float:
+        """Latest ``quant_error_feedback_norm`` gauge value (0.0 before any
+        quantized sync) — the SLO namespace exposes it by the same name."""
+        return self._quant_norm
 
     def record_serve_rejected(self, metric: Any, tenant_id: Any) -> None:
         """One tenant batch shed by the serving admission rate limit — the

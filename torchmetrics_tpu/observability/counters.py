@@ -41,6 +41,8 @@ COUNTER_FIELDS: Tuple[str, ...] = (
     "gather_calls",  # per-leaf gather_all_arrays collectives (fallback plane)
     "gathers_coalesced",  # state leaves served by a coalesced bucket (no own collective)
     "sync_collectives",  # collectives actually launched by the sync planes
+    "sync_bytes_saved",  # wire bytes the quantized codecs shaved off sync payloads
+    "quantized_buckets",  # dtype buckets shipped as compressed byte streams
     "retries",  # transient failures accepted for retry
     "retries_exhausted",  # retry budgets that ran out on a transient failure
     "quarantines",  # metrics frozen by MetricCollection(on_error="quarantine")
@@ -316,6 +318,17 @@ class Counters:
         one per bucket; per-leaf fallback: one per leaf)."""
         with self._lock:
             self._counts["sync_collectives"] += int(n)
+
+    def record_quant(self, buckets: int, bytes_saved: int) -> None:
+        """One quantized coalesced sync: ``buckets`` dtype buckets shipped as
+        compressed byte streams, saving ``bytes_saved`` wire bytes vs the
+        exact plane (payload minus the scale metadata that rode the metadata
+        collective; clamped at zero — a pathological all-tiny-leaf sync could
+        cost more in scales than it saves, which the eligibility floor
+        normally prevents)."""
+        with self._lock:
+            self._counts["quantized_buckets"] += int(buckets)
+            self._counts["sync_bytes_saved"] += max(0, int(bytes_saved))
 
     def record_retry(self) -> None:
         with self._lock:

@@ -42,6 +42,7 @@ EVENT_KINDS: Tuple[str, ...] = (
     "window_roll",  # a SlidingWindow completed a full window wrap (streaming plane)
     "async_sync",  # a double-buffered background sync committed (overlap accounting)
     "serve_rejected",  # a tenant batch shed by the serving admission rate limit
+    "quant",  # a coalesced sync shipped quantized buckets (compression accounting)
 )
 
 

@@ -342,6 +342,12 @@ class SloEngine:
                 drift_fn = getattr(recorder, "drift_score", None)
                 if drift_fn is not None:
                     ns["drift"] = drift_fn
+                # the quantized sync plane's error-feedback residual norm is a
+                # SCALAR gauge (unlike drift's per-name lookup), so expose the
+                # value itself — rules write `quant_feedback_norm > 1e-3`
+                quant_fn = getattr(recorder, "quant_feedback_norm", None)
+                if quant_fn is not None:
+                    ns["quant_feedback_norm"] = quant_fn()
                 try:
                     breached = bool(eval(rule.expr, {"__builtins__": {}}, ns))  # noqa: S307 — operator config
                 except Exception as err:

@@ -1,6 +1,12 @@
-from . import coalesce
+from . import coalesce, quantize
 from .async_sync import AsyncSyncHandle
-from .coalesce import CoalesceFallback, coalesced_process_sync, collective_counts, reduce_many
+from .coalesce import (
+    CoalesceFallback,
+    coalesced_process_sync,
+    collective_counts,
+    quantized_payload_model,
+    reduce_many,
+)
 from .mesh import (
     DEFAULT_AXIS,
     DEFAULT_TENANT_AXIS,
@@ -11,6 +17,7 @@ from .mesh import (
     shard_map,
     tenant_sharding,
 )
+from .quantize import SyncConfig
 from .sync import (
     distributed_available,
     gather_all_arrays,
@@ -27,6 +34,7 @@ __all__ = [
     "CoalesceFallback",
     "DEFAULT_AXIS",
     "DEFAULT_TENANT_AXIS",
+    "SyncConfig",
     "batch_sharding",
     "coalesce",
     "coalesced_process_sync",
@@ -38,6 +46,8 @@ __all__ = [
     "merge_states",
     "pairwise_merge",
     "process_sync",
+    "quantize",
+    "quantized_payload_model",
     "reduce_many",
     "reduce_over_axis",
     "reduce_states",

@@ -67,6 +67,14 @@ class AsyncSyncHandle:
             applied to the whole gather attempt (transient failures retry in
             the worker; the per-leaf ``CoalesceFallback`` path is taken
             inside each attempt exactly like the blocking plane).
+        sync_config: an optional
+            :class:`~torchmetrics_tpu.parallel.SyncConfig` — the worker
+            quantizes the frozen buckets IN the background thread, so the
+            codec's encode cost overlaps with ongoing updates exactly like
+            the gather latency does (the bandwidth win compounds with the
+            overlap win). Error-feedback residuals commit from the worker
+            only after every bucket gathered; a failed or per-leaf-fallback
+            attempt leaves them untouched.
         committer: called under :meth:`commit` with the synced state list —
             the seam where ``MetricCollection`` validates and atomically
             installs. Its exceptions propagate from ``commit()`` with nothing
@@ -86,9 +94,11 @@ class AsyncSyncHandle:
         committer: Optional[Callable[[List[StateDict]], Any]] = None,
         label: str = "AsyncSyncHandle",
         noop: bool = False,
+        sync_config: Optional[Any] = None,
     ) -> None:
         self.label = label
         self._committer = committer
+        self._sync_config = sync_config
         self._states = [
             {k: (list(v) if isinstance(v, list) else v) for k, v in s.items()} for s in states
         ]
@@ -125,6 +135,7 @@ class AsyncSyncHandle:
             return _coalesce.coalesced_process_sync(
                 self._states, self._reductions,
                 process_group=self._process_group, dist_sync_fn=self._dist_sync_fn,
+                sync_config=self._sync_config,
             )
         except _coalesce.CoalesceFallback:
             # per-leaf fallback preserved, in lockstep: every rank decodes the

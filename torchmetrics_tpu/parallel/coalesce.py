@@ -61,6 +61,7 @@ import numpy as np
 from .. import observability as _observability
 from ..observability.counters import COUNTER_FIELDS
 from ..observability.histograms import FLEET_VECTOR_LEN as _HIST_VEC_LEN
+from . import quantize as _quantize
 
 Array = jax.Array
 Reduction = Union[str, Callable, None]
@@ -87,9 +88,14 @@ _MAGIC = 0x436F414C  # "CoAL"
 # rollup instead of misdecoding another version's half-packed layout
 # v6: tiered windows — the counter vector gained window_rotations and the
 # fleet histogram vector gained the wdual/wstack dispatch kinds
-_VERSION = 6
+# v7: quantized sync plane — the counter vector gained sync_bytes_saved /
+# quantized_buckets, each leaf record's kind slot now packs the announced
+# codec code in its upper bits (kind = slot & 1, codec = slot >> 1), and a
+# quant section (per-bucket block-scale records, parallel/quantize.py) rides
+# the metadata tail when the caller passed an enabled SyncConfig
+_VERSION = 7
 _HEADER_LEN = 4  # [magic, version, n_leaves, n_counter_fields]
-_LEAF_REC_LEN = 2 + _MAX_RANK + 1  # [dtype_code, ndim, d0..d7, kind]
+_LEAF_REC_LEN = 2 + _MAX_RANK + 1  # [dtype_code, ndim, d0..d7, kind|codec<<1]
 _KIND_TENSOR = 0
 _KIND_LIST = 1
 
@@ -123,12 +129,16 @@ class _Leaf:
     original: Any
 
 
-def _dtype_code(arr: Any) -> int:
-    dt = jnp.dtype(arr.dtype)
+def _dtype_code_of(dt: Any) -> int:
+    dt = jnp.dtype(dt)
     for i, cand in enumerate(GATHER_DTYPES):
         if dt == jnp.dtype(cand):
             return i
     return _CODE_UNSUPPORTED
+
+
+def _dtype_code(arr: Any) -> int:
+    return _dtype_code_of(arr.dtype)
 
 
 def _prepare_leaves(
@@ -157,12 +167,23 @@ def build_local_metadata(
     reductions_list: Sequence[Mapping[str, Reduction]],
     counters_vector: Optional[Sequence[int]] = None,
     hist_vector: Optional[Sequence[int]] = None,
+    sync_config: Optional[Any] = None,
 ) -> np.ndarray:
     """This rank's metadata row: leaf shapes/dtypes plus the (always-reserved)
-    telemetry counters + histogram sections, as one int32 vector. Fixed length
+    telemetry counters + histogram sections — and, with an enabled
+    ``sync_config``, the quant section announcing this rank's per-leaf codec
+    decisions and per-bucket block scales — as one int32 vector. Fixed length
     across ranks for a given leaf table — the collective needs no shape
     negotiation."""
-    return _encode_metadata(_prepare_leaves(states, reductions_list), counters_vector, hist_vector)
+    leaves = _prepare_leaves(states, reductions_list)
+    qctx = _make_qctx(leaves, sync_config)
+    return _encode_metadata(leaves, counters_vector, hist_vector, qctx)
+
+
+def _make_qctx(leaves: Sequence[_Leaf], sync_config: Optional[Any]) -> Optional[Any]:
+    if sync_config is None or not getattr(sync_config, "enabled", False):
+        return None
+    return _quantize.QuantContext(sync_config, leaves)
 
 
 def _pack_halves(dest: np.ndarray, values: Sequence[int]) -> None:
@@ -180,14 +201,32 @@ def unpack_halves(halves: Sequence[int]) -> List[int]:
     return [(int(hi) << 31) | int(lo) for hi, lo in zip(halves[0::2], halves[1::2])]
 
 
+def _quant_record_lens(qctx: Any) -> List[int]:
+    """Quant-section record lengths — a FIXED layout: one record per dtype in
+    ``quantize.QUANT_SECTION_DTYPES`` (``[codec, n_blocks]``, plus the
+    reserved ``(scale, zero)`` slot pairs for int8), whether or not this rank
+    currently holds leaves of that dtype. Lengths depend only on the codec
+    (rank-agreed config), so the metadata vector length is rank-invariant
+    even when empty list leaves hide a dtype on some ranks — the real
+    ``process_allgather`` requires equal row shapes."""
+    if qctx is None:
+        return []
+    per = 2 + (2 * _quantize.BUCKET_SCALE_SLOTS if qctx.config.codec == "int8" else 0)
+    return [per] * len(_quantize.QUANT_SECTION_DTYPES)
+
+
 def _encode_metadata(
     leaves: Sequence[_Leaf],
     counters_vector: Optional[Sequence[int]],
     hist_vector: Optional[Sequence[int]] = None,
+    qctx: Optional[Any] = None,
 ) -> np.ndarray:
     n_fields = len(COUNTER_FIELDS)
+    quant_lens = _quant_record_lens(qctx)
+    quant_len = sum(quant_lens)
     vec = np.zeros(
-        _HEADER_LEN + len(leaves) * _LEAF_REC_LEN + 2 * n_fields + 2 * _HIST_VEC_LEN,
+        _HEADER_LEN + len(leaves) * _LEAF_REC_LEN + 2 * n_fields + 2 * _HIST_VEC_LEN
+        + quant_len,
         np.int32,
     )
     vec[0], vec[1], vec[2], vec[3] = _MAGIC, _VERSION, len(leaves), n_fields
@@ -209,7 +248,9 @@ def _encode_metadata(
                 rec[1] = arr.ndim
                 for d, s in enumerate(arr.shape):
                     rec[2 + d] = s
-        rec[2 + _MAX_RANK] = _KIND_LIST if leaf.is_list else _KIND_TENSOR
+        kind = _KIND_LIST if leaf.is_list else _KIND_TENSOR
+        codec = qctx.leaf_code(i) if qctx is not None else 0
+        rec[2 + _MAX_RANK] = kind | (codec << 1)
     tail_at = _HEADER_LEN + len(leaves) * _LEAF_REC_LEN
     if counters_vector is not None:
         vals = [int(v) for v in counters_vector]
@@ -220,7 +261,20 @@ def _encode_metadata(
         vals = [int(v) for v in hist_vector]
         if len(vals) != _HIST_VEC_LEN:
             raise ValueError(f"histogram vector must have {_HIST_VEC_LEN} entries, got {len(vals)}")
-        _pack_halves(vec[tail_at + 2 * n_fields :], vals)
+        _pack_halves(vec[tail_at + 2 * n_fields : tail_at + 2 * n_fields + 2 * _HIST_VEC_LEN], vals)
+    if qctx is not None:
+        at = tail_at + 2 * n_fields + 2 * _HIST_VEC_LEN
+        for dt, rec_len in zip(_quantize.QUANT_SECTION_DTYPES, quant_lens):
+            vec[at] = qctx.config.codec_code
+            blocks = qctx.bucket_blocks.get(jnp.dtype(dt), [])
+            vec[at + 1] = sum(blocks)
+            if qctx.config.codec == "int8":
+                scales = qctx.bucket_scales.get(jnp.dtype(dt), np.zeros((0,), np.float32))
+                zeros = qctx.bucket_zeros.get(jnp.dtype(dt), np.zeros((0,), np.float32))
+                slots = _quantize.BUCKET_SCALE_SLOTS
+                vec[at + 2 : at + 2 + len(scales)] = _quantize.f32_bits(scales)
+                vec[at + 2 + slots : at + 2 + slots + len(zeros)] = _quantize.f32_bits(zeros)
+            at += rec_len
     return vec
 
 
@@ -237,17 +291,31 @@ class _LeafPlan:
 
 
 @dataclasses.dataclass
+class _QuantPlan:
+    """Decoded quant announcements of every rank (parallel/quantize.py)."""
+
+    codec: str  # the rank-agreed configured codec name
+    leaf_codes: List[List[int]]  # [leaf][rank] announced codec code
+    # dtype -> per-rank (n_blocks_used, scales f32, zeros f32)
+    bucket_scales: Dict[Any, List[Tuple[int, np.ndarray, np.ndarray]]]
+
+
+@dataclasses.dataclass
 class _WorldPlan:
     world: int
     leaf_plans: List[_LeafPlan]
     buckets: "Dict[Any, List[int]]"  # dtype -> leaf indices, first-appearance order
     counter_rows: List[List[int]]  # per-rank counters decoded from the piggyback
     hist_rows: List[List[int]]  # per-rank fleet histogram vectors, same piggyback
+    quant: Optional[_QuantPlan] = None
 
 
-def _decode_rows(rows: Sequence[Any], n_leaves: int) -> List[np.ndarray]:
+def _decode_rows(rows: Sequence[Any], n_leaves: int, quant_len: int = 0) -> List[np.ndarray]:
     decoded = []
-    expect_len = _HEADER_LEN + n_leaves * _LEAF_REC_LEN + 2 * len(COUNTER_FIELDS) + 2 * _HIST_VEC_LEN
+    expect_len = (
+        _HEADER_LEN + n_leaves * _LEAF_REC_LEN + 2 * len(COUNTER_FIELDS)
+        + 2 * _HIST_VEC_LEN + quant_len
+    )
     for row in rows:
         arr = np.asarray(row).ravel()
         if arr.size != expect_len or not np.issubdtype(arr.dtype, np.integer):
@@ -258,16 +326,36 @@ def _decode_rows(rows: Sequence[Any], n_leaves: int) -> List[np.ndarray]:
     return decoded
 
 
-def _plan_from_rows(rows: Sequence[Any], leaves: Sequence[_Leaf]) -> _WorldPlan:
-    decoded = _decode_rows(rows, len(leaves))
+def _plan_from_rows(
+    rows: Sequence[Any], leaves: Sequence[_Leaf], qctx: Optional[Any] = None
+) -> _WorldPlan:
+    quant_lens = _quant_record_lens(qctx)
+    decoded = _decode_rows(rows, len(leaves), sum(quant_lens))
     world = len(decoded)
     leaf_plans: List[_LeafPlan] = []
     buckets: Dict[Any, List[int]] = {}
+    leaf_codes: List[List[int]] = []
     for i, leaf in enumerate(leaves):
         recs = [row[_HEADER_LEN + i * _LEAF_REC_LEN :][: _LEAF_REC_LEN] for row in decoded]
-        kinds = {int(r[2 + _MAX_RANK]) for r in recs}
+        kinds = {int(r[2 + _MAX_RANK]) & 1 for r in recs}
+        leaf_codes.append([int(r[2 + _MAX_RANK]) >> 1 for r in recs])
         if kinds != {_KIND_LIST if leaf.is_list else _KIND_TENSOR}:
             raise CoalesceFallback("ranks disagree on the leaf kind table")
+        # codec announcements must be ones this world could have produced: the
+        # configured codec on a quant-capable with-data leaf, 0 everywhere
+        # else — a corrupt (or buggy future-peer) row degrades to the exact
+        # per-leaf plane in lockstep rather than mis-slicing a bucket
+        cfg_code = qctx.config.codec_code if qctx is not None else 0
+        for code, r in zip(leaf_codes[-1], recs):
+            quantizable = (
+                cfg_code != 0
+                and int(r[0]) >= 0
+                and int(r[0]) < len(GATHER_DTYPES)
+                and jnp.dtype(GATHER_DTYPES[int(r[0])])
+                in (jnp.dtype(jnp.float32), jnp.dtype(jnp.float64))
+            )
+            if code not in ((0, cfg_code) if quantizable else (0,)):
+                raise CoalesceFallback("leaf record carries an impossible codec announcement")
         codes = sorted({int(r[0]) for r in recs})
         if _CODE_DIM_OVERFLOW in codes:
             # the per-leaf plane's int64 shape vector CAN express this — fall
@@ -323,12 +411,42 @@ def _plan_from_rows(rows: Sequence[Any], leaves: Sequence[_Leaf]) -> _WorldPlan:
     hist_rows = []
     tail_at = _HEADER_LEN + len(leaves) * _LEAF_REC_LEN
     hist_at = tail_at + 2 * len(COUNTER_FIELDS)
+    quant_at = hist_at + 2 * _HIST_VEC_LEN
     for row in decoded:
         counter_rows.append(unpack_halves(row[tail_at:hist_at]))
-        hist_rows.append(unpack_halves(row[hist_at:]))
+        hist_rows.append(unpack_halves(row[hist_at:quant_at]))
+    quant = None
+    if qctx is not None:
+        # fixed section layout: one record per QUANT_SECTION_DTYPES entry on
+        # every rank, so decode walks the same offsets the encoder wrote
+        bucket_scales: Dict[Any, List[Tuple[int, np.ndarray, np.ndarray]]] = {}
+        slots = _quantize.BUCKET_SCALE_SLOTS if qctx.config.codec == "int8" else 0
+        rec_len = 2 + 2 * slots
+        for row in decoded:
+            at = quant_at
+            for dt in _quantize.QUANT_SECTION_DTYPES:
+                code = int(row[at])
+                if code not in (0, qctx.config.codec_code):
+                    raise CoalesceFallback("quant record carries an unknown codec code")
+                n_blocks = int(row[at + 1])
+                if not 0 <= n_blocks <= slots:  # bf16 records carry no blocks
+                    raise CoalesceFallback("quant record carries an invalid block count")
+                if slots:
+                    scales = _quantize.bits_f32(row[at + 2 : at + 2 + n_blocks])
+                    zeros = _quantize.bits_f32(row[at + 2 + slots : at + 2 + slots + n_blocks])
+                else:
+                    scales = np.zeros((0,), np.float32)
+                    zeros = np.zeros((0,), np.float32)
+                bucket_scales.setdefault(jnp.dtype(dt), []).append((n_blocks, scales, zeros))
+                at += rec_len
+            if at != row.size:
+                raise CoalesceFallback("quant section does not match the fixed layout")
+        quant = _QuantPlan(
+            codec=qctx.config.codec, leaf_codes=leaf_codes, bucket_scales=bucket_scales
+        )
     return _WorldPlan(
         world=world, leaf_plans=leaf_plans, buckets=buckets,
-        counter_rows=counter_rows, hist_rows=hist_rows,
+        counter_rows=counter_rows, hist_rows=hist_rows, quant=quant,
     )
 
 
@@ -337,13 +455,20 @@ def build_bucket_payload(
     reductions_list: Sequence[Mapping[str, Reduction]],
     bucket_index: int,
     metadata_rows: Sequence[Any],
+    sync_config: Optional[Any] = None,
 ) -> Array:
     """This rank's padded flat payload for bucket ``bucket_index`` under the
     gathered ``metadata_rows`` — the replay API that lets a test fake simulate
-    each rank of a world deterministically."""
+    each rank of a world deterministically. With an enabled ``sync_config``
+    the payload is the quantized byte stream the real rank would ship
+    (deterministic: the scales match what ``build_local_metadata`` announced,
+    as long as the config's residual store is unchanged in between)."""
     leaves = _prepare_leaves(states, reductions_list)
-    plan = _plan_from_rows(metadata_rows, leaves)
+    qctx = _make_qctx(leaves, sync_config)
+    plan = _plan_from_rows(metadata_rows, leaves, qctx)
     dtype = list(plan.buckets)[bucket_index]
+    if _bucket_quantized(plan, dtype):
+        return _local_bucket_bytes(leaves, plan, dtype, qctx)
     return _local_bucket_flat(leaves, plan, dtype)
 
 
@@ -365,6 +490,110 @@ def _local_bucket_flat(leaves: Sequence[_Leaf], plan: _WorldPlan, dtype: Any) ->
     if pad:
         flat = jnp.concatenate([flat, jnp.zeros((pad,), dtype)])
     return flat
+
+
+def _bucket_quantized(plan: _WorldPlan, dtype: Any) -> bool:
+    """Whether this bucket ships as a quantized byte stream: some rank
+    announced a codec for one of its leaves — and there is more than one rank
+    (a world-of-one sync skips the codec entirely; a lossy round-trip with
+    nobody to ship to would be pure error)."""
+    if plan.quant is None or plan.world <= 1:
+        return False
+    return any(
+        code != 0
+        for li in plan.buckets[dtype]
+        for code in plan.quant.leaf_codes[li]
+    )
+
+
+def _bucket_byte_totals(plan: _WorldPlan, dtype: Any) -> List[int]:
+    """Per-rank wire bytes of a quantized bucket (metadata math only)."""
+    itemsize = jnp.dtype(dtype).itemsize
+    totals = []
+    for r in range(plan.world):
+        total = 0
+        for li in plan.buckets[dtype]:
+            code = plan.quant.leaf_codes[li][r]
+            total += plan.leaf_plans[li].counts[r] * _quantize.codec_width(code, itemsize)
+        totals.append(total)
+    return totals
+
+
+def _local_bucket_bytes(
+    leaves: Sequence[_Leaf], plan: _WorldPlan, dtype: Any, qctx: Any
+) -> Array:
+    """This rank's byte-stream payload for a quantized bucket: exact leaves
+    as raw bitcast bytes (bit-for-bit), quantized leaves as their codec
+    payloads, padded with zeros to the world's max byte total."""
+    parts = []
+    for li in plan.buckets[dtype]:
+        leaf = leaves[li]
+        if leaf.array is None:
+            continue
+        code = qctx.leaf_code(li)
+        if code == 0:
+            parts.append(_quantize.to_bytes(jnp.asarray(leaf.array).astype(dtype)))
+        else:
+            parts.append(qctx.payloads[li])
+    flat = jnp.concatenate(parts) if parts else jnp.zeros((0,), jnp.uint8)
+    pad = max(_bucket_byte_totals(plan, dtype)) - int(flat.shape[0])
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.uint8)])
+    return flat
+
+
+def _decode_bucket_rows(
+    plan: _WorldPlan, dtype: Any, rows_b: Sequence[Any]
+) -> List[List[Optional[Array]]]:
+    """Per-(rank, leaf) arrays of one quantized bucket: each rank's segment
+    decodes under that rank's OWN announced codes and scales (exact segments
+    bitcast back bit-for-bit, int8 segments through the rank's block scales
+    split by the same deterministic allocation its encoder ran)."""
+    itemsize = jnp.dtype(dtype).itemsize
+    leaf_idxs = plan.buckets[dtype]
+    out: List[List[Optional[Array]]] = [[] for _ in leaf_idxs]
+    for r in range(plan.world):
+        row = jnp.asarray(rows_b[r])
+        if row.dtype != jnp.uint8:
+            row = row.astype(jnp.uint8)
+        # rank r's int8 block allocation over ITS announced-quantized leaves,
+        # from the same fixed slot pool its encoder drew on
+        n_blocks_r, scales_r, zeros_r = plan.quant.bucket_scales[dtype][r]
+        q_counts = [
+            plan.leaf_plans[li].counts[r]
+            for li in leaf_idxs
+            if plan.quant.leaf_codes[li][r] == _quantize.CODEC_INT8
+        ]
+        blocks = _quantize.allocate_blocks(q_counts, _quantize.BUCKET_SCALE_SLOTS)
+        if q_counts and sum(blocks) != n_blocks_r:
+            raise CoalesceFallback("quant scales do not match the announced block count")
+        offset = 0
+        scale_off = 0
+        qi = 0
+        for j, li in enumerate(leaf_idxs):
+            lp = plan.leaf_plans[li]
+            n = lp.counts[r]
+            code = plan.quant.leaf_codes[li][r]
+            width = _quantize.codec_width(code, itemsize)
+            seg = row[offset : offset + n * width]
+            offset += n * width
+            if code == _quantize.CODEC_BF16:
+                arr = _quantize.from_bytes(seg, n, jnp.bfloat16).astype(dtype)
+            elif code == _quantize.CODEC_INT8:
+                nb = blocks[qi]
+                arr = _quantize.block_dequantize(
+                    seg,
+                    scales_r[scale_off : scale_off + nb],
+                    zeros_r[scale_off : scale_off + nb],
+                    n,
+                    dtype,
+                )
+                scale_off += nb
+                qi += 1
+            else:
+                arr = _quantize.from_bytes(seg, n, dtype)
+            out[j].append(arr.reshape(lp.dims[r]))
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -399,25 +628,40 @@ def coalesced_process_sync(
     reductions_list: Sequence[Mapping[str, Reduction]],
     process_group: Any = None,
     dist_sync_fn: Optional[Callable] = None,
+    sync_config: Optional[Any] = None,
 ) -> List[Dict[str, Any]]:
     """Synchronize one or many state dicts across processes with one metadata
     collective plus one padded gather per dtype bucket.
 
+    ``sync_config`` (:class:`~torchmetrics_tpu.parallel.quantize.SyncConfig`)
+    opts eligible float buckets into the quantized byte-stream wire format —
+    same collective count, compressed payloads, per-leaf codec/scale metadata
+    riding the up-front metadata collective. Error-feedback residuals commit
+    only after every bucket gathered, so transient failures and retries can
+    never double-apply feedback.
+
     Returns new state dicts (inputs untouched — callers commit atomically, so
     any failure leaves every metric at its last good state). Raises
     :class:`CoalesceFallback` when the gathered metadata is unusable; the
-    caller then re-runs the per-leaf plane.
+    caller then re-runs the per-leaf plane (always exact — quantization only
+    exists on the coalesced fast path).
     """
     from . import sync as _sync  # lazy: sync.py imports this module at top level
 
     leaves = _prepare_leaves(states, reductions_list)
+    if sync_config is not None and dist_sync_fn is None and not _sync.distributed_available():
+        # single process with real collectives: the world-of-one bypass would
+        # discard the encoding anyway — skip the encode cost up front (replay
+        # fakes keep their qctx; simulated worlds have world > 1)
+        sync_config = None
+    qctx = _make_qctx(leaves, sync_config)
     rec = _observability._ACTIVE
     counters_vec = None
     hist_vec = None
     if rec is not None and dist_sync_fn is None:
         counters_vec = rec.counters.counts_vector()
         hist_vec = rec.histograms.fleet_vector()
-    meta = _encode_metadata(leaves, counters_vec, hist_vec)
+    meta = _encode_metadata(leaves, counters_vec, hist_vec, qctx)
     gather = _make_gather(process_group, dist_sync_fn)
     try:
         rows = gather(meta)  # collective #1: the single up-front shape/metadata gather
@@ -435,12 +679,23 @@ def coalesced_process_sync(
         raise
     if rec is not None:  # launch-time counting: fallbacks keep their collectives
         rec.counters.record_sync_collectives(1)
-    plan = _plan_from_rows(rows, leaves)
+    plan = _plan_from_rows(rows, leaves, qctx)
     if dist_sync_fn is None:
         _deposit_fleet_rows(plan, rec)
     per_leaf_gathered: List[Optional[List[Array]]] = [None] * len(leaves)
+    quant_stats = {"buckets": 0, "raw_bytes": 0, "shipped_bytes": 0}
     for dtype, leaf_idxs in plan.buckets.items():
-        flat = _local_bucket_flat(leaves, plan, dtype)
+        quantized = _bucket_quantized(plan, dtype)
+        if quantized:
+            flat = _local_bucket_bytes(leaves, plan, dtype, qctx)
+            quant_stats["buckets"] += 1
+            quant_stats["shipped_bytes"] += int(flat.size)
+            quant_stats["raw_bytes"] += max(
+                sum(plan.leaf_plans[li].counts[r] for li in leaf_idxs)
+                for r in range(plan.world)
+            ) * jnp.dtype(dtype).itemsize
+        else:
+            flat = _local_bucket_flat(leaves, plan, dtype)
         rows_b = gather(flat)  # one collective serves every leaf of this dtype
         if rec is not None:
             rec.counters.record_sync_collectives(1)
@@ -451,6 +706,13 @@ def coalesced_process_sync(
             )
         if len(rows_b) != plan.world:
             raise CoalesceFallback("bucket gather returned a different world size than the metadata")
+        if quantized:
+            decoded_bucket = _decode_bucket_rows(plan, dtype, rows_b)
+            for j, li in enumerate(leaf_idxs):
+                if per_leaf_gathered[li] is None:
+                    per_leaf_gathered[li] = []
+                per_leaf_gathered[li].extend(decoded_bucket[j])
+            continue
         for r in range(plan.world):
             offset = 0
             row = jnp.asarray(rows_b[r])
@@ -462,6 +724,21 @@ def coalesced_process_sync(
                 if per_leaf_gathered[li] is None:
                     per_leaf_gathered[li] = []
                 per_leaf_gathered[li].append(seg)
+    if qctx is not None:
+        # every bucket gathered — the sync succeeded, residuals may commit
+        # (a failure above left the store untouched, so retries re-quantize
+        # from the same base instead of double-applying feedback)
+        commit_stats = qctx.commit(plan.world)
+        if rec is not None and quant_stats["buckets"]:
+            meta_bytes = 4 * sum(_quant_record_lens(qctx))
+            rec.record_quant(
+                "coalesced_sync", sync_config.codec,
+                buckets=quant_stats["buckets"],
+                leaves=commit_stats["leaves_quantized"],
+                raw_bytes=quant_stats["raw_bytes"],
+                shipped_bytes=quant_stats["shipped_bytes"] + meta_bytes,
+                feedback_norm=sync_config.residual_norm(),
+            )
     outs = [dict(s) for s in states]
     for leaf, gathered in zip(leaves, per_leaf_gathered):
         if gathered is None:
@@ -616,6 +893,57 @@ def reduce_many(
             else:
                 outs[pi][name] = fx(seg)
     return outs
+
+
+def quantized_payload_model(
+    states: Sequence[Dict[str, Any]],
+    reductions_list: Sequence[Mapping[str, Reduction]],
+    sync_config: Optional[Any] = None,
+    world: int = 2,
+) -> Dict[str, int]:
+    """Deterministic byte model of one sync over ``world`` identical ranks:
+    what the exact plane would ship vs what the quantized plane ships
+    (payload + scale metadata), total and restricted to the codec-eligible
+    leaves. Metadata math only — no communication, no device reads beyond
+    the scale computation; the ``quantized_sync`` bench gates on it."""
+    leaves = _prepare_leaves(states, reductions_list)
+    qctx = _make_qctx(leaves, sync_config)
+    meta = _encode_metadata(leaves, None, None, qctx)
+    plan = _plan_from_rows([meta] * max(1, int(world)), leaves, qctx)
+    out: Dict[str, int] = {
+        "buckets": len(plan.buckets), "quantized_buckets": 0, "leaves_quantized": 0,
+        "exact_bytes": 0, "shipped_bytes": 0, "quant_meta_bytes": 0,
+        "eligible_exact_bytes": 0, "eligible_shipped_bytes": 0,
+    }
+    for dtype, leaf_idxs in plan.buckets.items():
+        itemsize = jnp.dtype(dtype).itemsize
+        exact = max(
+            sum(plan.leaf_plans[li].counts[r] for li in leaf_idxs)
+            for r in range(plan.world)
+        ) * itemsize
+        out["exact_bytes"] += exact
+        if _bucket_quantized(plan, dtype):
+            out["quantized_buckets"] += 1
+            out["shipped_bytes"] += max(_bucket_byte_totals(plan, dtype))
+        else:
+            out["shipped_bytes"] += exact
+    if qctx is not None and plan.world > 1:
+        out["quant_meta_bytes"] = 4 * sum(_quant_record_lens(qctx))
+        out["shipped_bytes"] += out["quant_meta_bytes"]
+        for dt in qctx.bucket_order:
+            quant_lis = [li for li in qctx.bucket_leaves[dt] if qctx.leaf_code(li) != 0]
+            blocks = dict(zip(quant_lis, qctx.bucket_blocks[dt]))
+            for li in quant_lis:
+                code = qctx.leaf_code(li)
+                out["leaves_quantized"] += 1
+                arr = leaves[li].array
+                count = int(jnp.asarray(arr).size)
+                itemsize = jnp.dtype(arr.dtype).itemsize
+                out["eligible_exact_bytes"] += count * itemsize
+                out["eligible_shipped_bytes"] += count * _quantize.codec_width(code, itemsize)
+                if code == _quantize.CODEC_INT8:
+                    out["eligible_shipped_bytes"] += 8 * blocks[li]
+    return out
 
 
 def collective_counts(

@@ -250,11 +250,17 @@ def process_sync(
     reductions: Mapping[str, Reduction],
     process_group: Any = None,
     dist_sync_fn: Optional[Callable] = None,
+    sync_config: Optional[Any] = None,
 ) -> Dict[str, Any]:
     """Synchronize a state dict across JAX processes (host-driven plane).
 
     ``dist_sync_fn`` is the injection seam (reference metric.py:133): signature
     ``fn(value, group) -> list_of_values``.
+
+    ``sync_config`` (:class:`~torchmetrics_tpu.parallel.SyncConfig`) opts the
+    coalesced fast path into quantized (bf16/int8) buckets — see
+    docs/distributed.md, "Quantized synchronization". The per-leaf fallback
+    plane below is always exact.
 
     Transient-failure retry lives one level up: ``Metric.sync`` wraps the whole
     ``process_sync`` call under its ``ReliabilityConfig`` retry policy. That is
@@ -272,7 +278,8 @@ def process_sync(
             # per dtype bucket serves every leaf at once; per-leaf merge
             # semantics preserved exactly (parallel/coalesce.py)
             return _coalesce.coalesced_process_sync(
-                [state], [reductions], process_group=process_group, dist_sync_fn=dist_sync_fn
+                [state], [reductions], process_group=process_group,
+                dist_sync_fn=dist_sync_fn, sync_config=sync_config,
             )[0]
         except _coalesce.CoalesceFallback:
             # undecodable/inconsistent metadata (e.g. an injected gather that
