@@ -1265,6 +1265,60 @@ def bench_quantized_sync() -> dict:
     return out
 
 
+def bench_production_soak() -> dict:
+    """Config ``production_soak``: the chaos plane end to end (``torchmetrics_tpu/
+    chaos``) — Zipf-skewed, bursty, churning tenant traffic with one scheduled
+    fault of every kind driven through the serving engine (quarantine mode,
+    int8 spill codec, token-bucket admission on a virtual clock), the
+    streaming side-channels, the witness sync (bf16 quantize-on-sync, flaky
+    gather + retry), and the SLO engine.
+
+    The correctness columns are DETERMINISTIC and gate tight in
+    tools/bench_compare.py: ``recovered_faults`` is an exact count,
+    ``soak_recovery_parity`` is 1.0 iff zero faults went unrecovered,
+    ``reconciliation_parity`` is 1.0 iff the health plane's
+    ``compiles + hits + aot_hits == dispatches`` identity held, and
+    ``soak_determinism_parity`` is 1.0 iff a second identical run reproduced
+    the first's entire counter block. ``shed_rate`` rides the virtual clock,
+    so it is deterministic too. Only the throughput/latency columns wobble.
+    """
+    import warnings
+
+    from torchmetrics_tpu.chaos import SoakConfig, TrafficConfig, run_soak
+
+    config = SoakConfig(
+        traffic=TrafficConfig(seed=23, tenants=24, steps=120),
+        capacity=8,
+        megabatch_size=4,
+        spill_codec="int8",
+        sync_codec="bf16",
+        max_tenants_per_sec=40.0,
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")  # SLO breach + retry warnings are the point
+        first = run_soak(config)
+        second = run_soak(config)  # the determinism headline, measured
+    c = first.counters
+    return {
+        "tenants_per_sec": first.timing["tenants_per_sec"],
+        "update_p50_us": first.timing["update_p50_us"],
+        "update_p99_us": first.timing["update_p99_us"],
+        "shed_rate": c["shed_rate"],
+        "events": c["events"],
+        "faults_injected": c["faults_injected"],
+        "recovered_faults": c["recovered_faults"],
+        "quarantined_faults": c["quarantined_faults"],
+        "unrecovered_faults": c["unrecovered_faults"],
+        "soak_recovery_parity": 1.0 if c["unrecovered_faults"] == 0 else 0.0,
+        "reconciliation_parity": 1.0 if first.reconciliation["exact"] else 0.0,
+        "soak_determinism_parity": 1.0 if first.counters == second.counters else 0.0,
+        "slo_breaches": len(first.slo_breaches),
+        "spills": c["engine_spills"],
+        "readmissions": c["engine_readmissions"],
+        "unit": "seeded chaos soak, 120 steps, one fault of every kind, virtual-clock admission",
+    }
+
+
 def bench_fault_selftest() -> dict:
     """Hidden config (leading underscore: excluded from the main run) proving the
     retry wrapper end to end: the FIRST subprocess attempt dies with the round-5
@@ -1291,6 +1345,7 @@ CONFIGS = {
     "streaming_window": bench_streaming,
     "streaming_window_100k": bench_streaming_100k,
     "quantized_sync": bench_quantized_sync,
+    "production_soak": bench_production_soak,
     "_fault_selftest": bench_fault_selftest,
 }
 

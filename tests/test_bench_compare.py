@@ -260,6 +260,64 @@ def test_ttfu_columns_direction_and_gate(tmp_path):
     assert report["verdict"] == "ok" and report["missing"] == 0
 
 
+def test_production_soak_columns_direction_and_gate(tmp_path):
+    """production_soak columns (chaos plane): shed_rate gates lower-exact,
+    the recovery/reconciliation/determinism parities and recovered_faults
+    gate higher-exact, latencies gate lower; the raw fault tallies are
+    info-only (they'd hit the ``old == 0`` info short-circuit anyway — the
+    zero-unrecovered invariant is gated through soak_recovery_parity)."""
+    assert bench_compare.direction("extra.production_soak.shed_rate") == "lower"
+    assert bench_compare.direction("extra.production_soak.recovered_faults") == "higher"
+    assert bench_compare.direction("extra.production_soak.soak_recovery_parity") == "higher"
+    assert bench_compare.direction("extra.production_soak.reconciliation_parity") == "higher"
+    assert bench_compare.direction("extra.production_soak.soak_determinism_parity") == "higher"
+    assert bench_compare.direction("extra.production_soak.update_p99_us") == "lower"
+    assert bench_compare.direction("extra.production_soak.tenants_per_sec") == "higher"
+    assert bench_compare.direction("extra.production_soak.faults_injected") is None
+    assert bench_compare.direction("extra.production_soak.unrecovered_faults") is None
+
+    def soak(shed_rate, recovery=1.0, determinism=1.0, p99=900.0):
+        return {"production_soak": {
+            "tenants_per_sec": 5200.0, "update_p50_us": 450.0, "update_p99_us": p99,
+            "shed_rate": shed_rate, "events": 322, "faults_injected": 8,
+            "recovered_faults": 6, "quarantined_faults": 1,
+            "unrecovered_faults": 0 if recovery == 1.0 else 1,
+            "soak_recovery_parity": recovery, "reconciliation_parity": 1.0,
+            "soak_determinism_parity": determinism, "slo_breaches": 2,
+            "spills": 7, "readmissions": 3, "unit": "tenant rows/s",
+        }}
+
+    good = _round(1, 30000.0, extra_overrides=soak(0.09))
+    # an admission plane shedding 2.8x more of the same traffic must gate
+    shedding = _round(2, 30000.0, extra_overrides=soak(0.25))
+    paths = _write_rounds(tmp_path, [good, shedding])
+    report = bench_compare.compare_rounds(paths)
+    reg = {r["metric"] for t in report["transitions"] for r in t["rows"] if r["verdict"] == "regression"}
+    assert "extra.production_soak.shed_rate" in reg
+    assert bench_compare.main(paths + ["--check"]) == 1
+    # a fault going unrecovered (parity 1.0 -> 0.0) gates even though the raw
+    # unrecovered count is info-only (0 -> 1 would short-circuit to "info")
+    broken_dir = tmp_path / "unrecovered"
+    broken_dir.mkdir()
+    paths = _write_rounds(broken_dir, [good, _round(2, 30000.0, extra_overrides=soak(0.09, recovery=0.0))])
+    report = bench_compare.compare_rounds(paths)
+    reg = {r["metric"] for t in report["transitions"] for r in t["rows"] if r["verdict"] == "regression"}
+    assert "extra.production_soak.soak_recovery_parity" in reg
+    assert bench_compare.main(paths + ["--check"]) == 1
+    # a nondeterministic rerun (determinism parity 1.0 -> 0.0) gates too
+    nondet_dir = tmp_path / "nondet"
+    nondet_dir.mkdir()
+    paths = _write_rounds(nondet_dir, [good, _round(2, 30000.0, extra_overrides=soak(0.09, determinism=0.0))])
+    assert bench_compare.main(paths + ["--check"]) == 1
+    # identical soak columns ride through clean
+    steady_dir = tmp_path / "steady"
+    steady_dir.mkdir()
+    paths = _write_rounds(steady_dir, [good, _round(2, 30000.0, extra_overrides=soak(0.09))])
+    report = bench_compare.compare_rounds(paths)
+    assert report["verdict"] == "ok"
+    assert bench_compare.main(paths + ["--check"]) == 0
+
+
 def test_per_metric_threshold_override():
     prev = bench_compare.extract_metrics(_round(1, 30000.0))
     cur = bench_compare.extract_metrics(_round(2, 27000.0))  # -10%

@@ -1,0 +1,253 @@
+"""Chaos-plane tests (torchmetrics_tpu/chaos). Marker ``chaos``.
+
+The load-bearing claims, each pinned:
+
+- **replayable traffic**: one seed is one stream — schedule AND batch
+  payloads — and a saved trace reproduces the model byte for byte, so a
+  failing soak replays exactly;
+- **declarative faults**: a :class:`FaultSchedule` JSON round-trips and
+  validates its specs eagerly, and the default schedule covers every kind;
+- **the soak contract**: ``run_soak`` is deterministic (two runs, identical
+  counter blocks), every scheduled fault resolves to its designed outcome
+  (transients recover, the tenant fault quarantines exactly its target,
+  poisons/flaky gathers/clock skews recover), nothing goes unrecovered, the
+  health-plane compile reconciliation stays exact, and the run genuinely
+  exercises shed + spill/readmit + drift side-channels;
+- **no new dispatch seams**: the soak composes EXISTING planes — the
+  runtime dispatch-tag registry is unchanged.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import warnings
+
+import numpy as np
+import pytest
+
+from torchmetrics_tpu.chaos import (
+    FAULT_KINDS,
+    FaultSchedule,
+    FaultSpec,
+    SoakConfig,
+    TrafficConfig,
+    TrafficModel,
+    default_fault_schedule,
+    run_soak,
+)
+from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
+
+pytestmark = pytest.mark.chaos
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+
+# ------------------------------------------------------------------ traffic
+
+
+def test_same_seed_same_stream_different_seed_differs():
+    a, b = TrafficModel(TrafficConfig(seed=5)), TrafficModel(TrafficConfig(seed=5))
+    sa, sb = a.schedule(), b.schedule()
+    np.testing.assert_array_equal(sa[0], sb[0])
+    np.testing.assert_array_equal(sa[1], sb[1])
+    for ea, eb in zip(a.events(), b.events()):
+        np.testing.assert_array_equal(ea.batch[0], eb.batch[0])
+        np.testing.assert_array_equal(ea.batch[1], eb.batch[1])
+        if ea.index >= 16:
+            break
+    c = TrafficModel(TrafficConfig(seed=6))
+    assert (
+        c.num_events != a.num_events
+        or not np.array_equal(c.schedule()[1], sa[1])
+    )
+
+
+def test_zipf_popularity_skews_to_head_tenants():
+    model = TrafficModel(TrafficConfig(seed=3, tenants=16, steps=200, churn_every=0))
+    _, tenants = model.schedule()
+    counts = np.bincount(tenants, minlength=16)
+    # head tenant dominates any tail tenant under s=1.1 over 200 steps
+    assert counts[0] > counts[8] and counts[0] > counts[15]
+    assert counts[: 4].sum() > counts[8:].sum()
+
+
+def test_churn_rotates_roster_and_batches_are_order_independent():
+    model = TrafficModel(TrafficConfig(seed=9, tenants=8, steps=90, churn_every=20, churn_count=3))
+    _, tenants = model.schedule()
+    # churn introduced brand-new tenant ids past the initial roster
+    assert int(tenants.max()) >= 8
+    # batch payloads key on (seed, event index) alone: regenerating event k
+    # standalone matches the value seen mid-iteration
+    ev = next(e for e in model.events() if e.index == 7)
+    preds, target = model._batch(7, ev.tenant_id)
+    np.testing.assert_array_equal(ev.batch[0], preds)
+    np.testing.assert_array_equal(ev.batch[1], target)
+
+
+def test_trace_round_trip_is_byte_identical(tmp_path):
+    model = TrafficModel(TrafficConfig(seed=11, tenants=10, steps=40))
+    path = str(tmp_path / "s11.trace")
+    written = model.save_trace(path)
+    assert written == os.path.getsize(path) == len(model.trace_bytes())
+    back = TrafficModel.load_trace(path)
+    assert back.replayed and not model.replayed
+    assert back.config == model.config
+    assert back.trace_bytes() == model.trace_bytes()
+    for ea, eb in zip(model.events(), back.events()):
+        assert ea.tenant_id == eb.tenant_id and ea.step == eb.step
+        np.testing.assert_array_equal(ea.batch[0], eb.batch[0])
+
+
+def test_trace_rejects_garbage(tmp_path):
+    bad = tmp_path / "bad.trace"
+    bad.write_bytes(b"NOTATRACE-at-all")
+    with pytest.raises(TorchMetricsUserError, match="bad magic"):
+        TrafficModel.load_trace(str(bad))
+    model = TrafficModel(TrafficConfig(seed=1, tenants=4, steps=20))
+    cut = tmp_path / "cut.trace"
+    cut.write_bytes(model.trace_bytes()[:-8])
+    with pytest.raises(TorchMetricsUserError, match="truncated"):
+        TrafficModel.load_trace(str(cut))
+
+
+def test_traffic_config_validates():
+    with pytest.raises(ValueError, match="seed"):
+        TrafficConfig(seed=-1)
+    with pytest.raises(ValueError, match="tenants"):
+        TrafficConfig(tenants=0)
+    with pytest.raises(ValueError, match="burst_prob"):
+        TrafficConfig(burst_prob=1.5)
+    with pytest.raises(ValueError, match="shape_classes"):
+        TrafficConfig(shape_classes=())
+
+
+# ----------------------------------------------------------------- schedule
+
+
+def test_fault_spec_validates():
+    with pytest.raises(ValueError, match="kind"):
+        FaultSpec(step=0, kind="meteor_strike")
+    with pytest.raises(ValueError, match="tenant_fault"):
+        FaultSpec(step=0, kind="tenant_fault")
+    with pytest.raises(ValueError, match="clock_skew"):
+        FaultSpec(step=0, kind="clock_skew", target="sideways")
+    with pytest.raises(ValueError, match="count"):
+        FaultSpec(step=0, kind="dispatch_transient", count=0)
+    with pytest.raises(ValueError, match="step"):
+        FaultSpec(step=-1, kind="dispatch_transient")
+
+
+def test_schedule_json_round_trip(tmp_path):
+    sched = default_fault_schedule(60, tenant=2)
+    assert {s.kind for s in sched} == set(FAULT_KINDS)
+    back = FaultSchedule.from_json(sched.to_json())
+    assert back.specs == sched.specs
+    path = str(tmp_path / "faults.json")
+    sched.save(path)
+    assert FaultSchedule.load(path).specs == sched.specs
+    with pytest.raises(TorchMetricsUserError, match="malformed"):
+        FaultSchedule.from_json('{"version": 1, "faults": [{"bogus": true}]}')
+    assert sched.due(sched.specs[0].step) and not sched.due(0)
+    assert sched.last_step < 60
+
+
+# --------------------------------------------------------------------- soak
+
+
+@pytest.fixture(scope="module")
+def soak_pair():
+    """The pinned CPU-sized soak, run twice (the determinism contract needs
+    both runs in one process) — shared across the assertions below."""
+    cfg = SoakConfig(
+        traffic=TrafficConfig(
+            seed=7, tenants=12, steps=40, base_rate=3.0, churn_every=14, churn_count=3
+        ),
+        capacity=6,
+        megabatch_size=3,
+        sync_every=10,
+        max_tenants_per_sec=30.0,
+        spill_codec="int8",
+        sync_codec="bf16",
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return cfg, run_soak(cfg), run_soak(cfg)
+
+
+def test_soak_is_deterministic(soak_pair):
+    _, r1, r2 = soak_pair
+    assert r1.counters == r2.counters
+    assert r1.faults == r2.faults
+    assert r1.reconciliation["exact"] and r2.reconciliation["exact"]
+
+
+def test_soak_recovers_every_fault_kind(soak_pair):
+    _, r1, _ = soak_pair
+    outcomes = {rec["kind"]: rec["outcome"] for rec in r1.faults}
+    assert outcomes == {
+        "dispatch_transient": "recovered",
+        "tenant_fault": "quarantined",
+        "state_poison": "recovered",
+        "gather_flaky": "recovered",
+        "clock_skew": "recovered",
+    }
+    assert r1.counters["unrecovered_faults"] == 0
+    assert r1.counters["quarantined_faults"] == 1
+    assert r1.counters["recovered_faults"] >= 4
+    assert (
+        r1.counters["faults_injected"]
+        >= r1.counters["recovered_faults"] + r1.counters["quarantined_faults"]
+    )
+
+
+def test_soak_reconciles_and_exercises_every_plane(soak_pair):
+    _, r1, _ = soak_pair
+    rec = r1.reconciliation
+    assert (
+        rec["jit_compiles"] + rec["jit_cache_hits"] + rec["aot_cache_hits"]
+        == rec["dispatches"]
+    )
+    c = r1.counters
+    assert c["admitted"] > 0 and c["events"] == c["admitted"] + c["shed"] + c["dropped_quarantined"]
+    assert c["shed"] > 0 and c["engine_rejected_batches"] == c["shed"]
+    assert c["engine_spills"] > 0 and c["engine_readmissions"] > 0
+    assert c["drift_evals"] > 0 and c["epochs"] > 0
+    assert 0.0 < c["shed_rate"] < 1.0
+
+
+def test_soak_replays_recorded_trace_exactly(soak_pair, tmp_path):
+    cfg, r1, _ = soak_pair
+    model = TrafficModel(cfg.traffic)
+    path = str(tmp_path / "soak.trace")
+    model.save_trace(path)
+    replay = TrafficModel.load_trace(path)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        r3 = run_soak(cfg, traffic_model=replay)
+    assert r3.config["replayed"] is True
+    assert r3.counters == r1.counters
+    assert r3.faults == r1.faults
+
+
+def test_soak_rejects_out_of_range_schedule():
+    sched = FaultSchedule([FaultSpec(step=500, kind="dispatch_transient")])
+    cfg = SoakConfig(
+        traffic=TrafficConfig(seed=1, tenants=4, steps=20), faults=sched
+    )
+    with pytest.raises(TorchMetricsUserError, match="step 500"):
+        run_soak(cfg)
+
+
+def test_soak_introduces_no_new_dispatch_tag():
+    """The chaos plane orchestrates existing planes — the whole-repo runtime
+    dispatch-tag registry must be exactly the pre-chaos set."""
+    from tools.graftlint.registry import registered_tags
+    from tools.graftlint.runner import build_index
+
+    assert registered_tags(build_index(REPO_ROOT)) == {
+        "update", "forward", "vupdate", "wupdate", "wdual", "wstack",
+        "vwupdate", "vwcompute", "dupdate", "vcompute",
+    }

@@ -358,6 +358,76 @@ def test_fault_injected_megabatch_quarantines_only_offender():
     _assert_state_parity(engine, 3, ref3)
 
 
+def test_spilled_offender_quarantine_keeps_codec_peers_bitwise():
+    """Fault-injected megabatch whose members got evicted (codec-spilled)
+    AFTER enqueue: with twice as many distinct queued tenants as slots, the
+    flush must re-seat each chunk INSIDE ``_dispatch_rows`` — readmissions
+    decode int8-spilled rows and evictions spill pending residents mid-flush.
+    When that dispatch then fails, the quarantine rollback must restore the
+    seating bookkeeping along with the stack; otherwise the re-drives fold
+    healthy tenants' batches onto the rolled-back victims' rows. Only the
+    pinned offender may quarantine, and every surviving peer's state must
+    equal its reference bitwise (int states cross the int8 codec raw)."""
+    rng = np.random.default_rng(12)
+    engine = ServingEngine(
+        _acc(),
+        ServingConfig(
+            capacity=4,
+            megabatch_size=4,
+            on_error="quarantine",
+            auto_flush=False,
+            spill_codec="int8",
+        ),
+    )
+    refs = {t: _acc() for t in range(8)}
+    # per-tenant DISTINCT batches: if a rollback leaves a tenant pointed at
+    # another tenant's rows, the folded values diverge and parity catches it
+    first = _batches(rng, 8)
+    second = _batches(rng, 8)
+
+    # seat 0-3, then push them out with 4-7: 0-3 now live int8-encoded on host
+    for t in range(4):
+        engine.update(t, *first[t])
+        refs[t].update(*first[t])
+    engine.flush()
+    for t in range(4, 8):
+        engine.update(t, *first[t])
+        refs[t].update(*first[t])
+    engine.flush()
+    assert engine.stats["spills"] >= 4
+    assert all(engine._tenants[t].spilled is not None for t in range(4))
+
+    # queue a second round for ALL EIGHT tenants before flushing: enqueue-time
+    # admission churns the four slots end to end, so by flush time tenants 0-3
+    # are spilled AGAIN (still holding only their first-round states) and the
+    # flush itself must readmit them inside the faulted dispatch
+    def hook(tenant_ids):
+        if 0 in tenant_ids:
+            raise RuntimeError("injected fault pinned to spilled tenant 0")
+
+    engine._fault_hook = hook
+    for t in range(8):
+        engine.update(t, *second[t])
+        if t != 0:
+            refs[t].update(*second[t])
+    assert all(engine._tenants[t].spilled is not None for t in range(4))
+    engine.flush()
+
+    roster = engine.tenants()
+    assert roster[0]["quarantined"] and engine.stats["quarantined"] == 1
+    assert all(not roster[t]["quarantined"] for t in range(1, 8))
+    for t in range(1, 8):
+        _assert_state_parity(engine, t, refs[t])
+    # reset lifts the quarantine and the tenant serves again from a clean row
+    engine.reset(0)
+    engine._fault_hook = None
+    engine.update(0, *second[0])
+    engine.flush()
+    ref0 = _acc()
+    ref0.update(*second[0])
+    _assert_state_parity(engine, 0, ref0)
+
+
 def test_quarantine_emits_telemetry():
     rng = np.random.default_rng(11)
     batch = _batches(rng, 1)[0]

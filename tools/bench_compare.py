@@ -144,6 +144,21 @@ THRESHOLDS: Dict[str, float] = {
     "extra.quantized_sync.sync_host_ms_bf16": 0.6,
     "extra.quantized_sync.sync_host_ms_int8": 0.6,
     "extra.quantized_sync.exact_tag_parity": 0.01,
+    # production soak (chaos plane, ISSUE 15): the correctness columns are
+    # DETERMINISTIC — traffic, faults, and admission all ride seeded RNG and a
+    # virtual clock — so they gate tight: recovered_faults is an exact count,
+    # the parity columns are exactly 1.0 (any drop = an unrecovered fault, a
+    # broken counter-reconciliation identity, or a nondeterministic rerun),
+    # and shed_rate moves only if admission behavior changes. Throughput and
+    # the latency percentiles wobble like the other host-plane numbers.
+    "extra.production_soak.tenants_per_sec": 0.4,
+    "extra.production_soak.update_p50_us": 0.6,
+    "extra.production_soak.update_p99_us": 0.6,
+    "extra.production_soak.shed_rate": 0.05,
+    "extra.production_soak.recovered_faults": 0.01,
+    "extra.production_soak.soak_recovery_parity": 0.01,
+    "extra.production_soak.reconciliation_parity": 0.01,
+    "extra.production_soak.soak_determinism_parity": 0.01,
 }
 
 # Metrics KNOWN to go missing in some rounds for an environmental reason,
@@ -179,14 +194,22 @@ _HIGHER_MARKERS = ("per_sec", "speedup", "throughput")
 # bitwise identical to the per-leaf oracle — any drop is a correctness break.
 _HIGHER_EXACT = ("value", "vs_baseline", "tenants_per_dispatch",
                  "async_sync_overlap_pct", "async_state_parity",
-                 "windowed_serving_ratio", "exact_tag_parity")
+                 "windowed_serving_ratio", "exact_tag_parity",
+                 # production_soak: exact recovered-fault count plus the three
+                 # 1.0-parity gates (zero-unrecovered, counter reconciliation,
+                 # same-seed determinism) — any drop is a correctness break
+                 "recovered_faults", "soak_recovery_parity",
+                 "reconciliation_parity", "soak_determinism_parity")
 _LOWER_MARKERS = ("latency", "compile", "_sec", "_ms", "_us", "_bytes", "bytes_", "time")
 # collective counts per sync: fewer is the whole point of the coalesced plane —
 # a move back toward per-leaf collectives must gate even though the name
 # carries no latency/throughput marker. dual_mem_window_ratio: 100k-vs-1k
 # window state bytes, exactly 1.0 by construction — any growth means the
 # dual form's window-independent-memory invariant broke.
-_LOWER_EXACT = ("collectives_per_sync", "dual_mem_window_ratio")
+_LOWER_EXACT = ("collectives_per_sync", "dual_mem_window_ratio",
+                # production_soak overload shed fraction: deterministic on the
+                # virtual clock — more shedding means admission regressed
+                "shed_rate")
 # deterministic workload constants: the coalesced-sync config's leaf counts,
 # the warm-start column's program count ("precompiled" would otherwise match
 # the "compile" latency marker and gate a constant), and the serving
@@ -214,7 +237,14 @@ _INFO_EXACT = ("leaves_coalesced_per_sync", "per_leaf_collectives", "ttfu_precom
                "bf16_compression_x", "int8_compression_x",
                "bf16_eligible_compression_x", "int8_eligible_compression_x",
                "bf16_quantized_buckets", "int8_quantized_buckets",
-               "bf16_quant_meta_bytes", "int8_quant_meta_bytes")
+               "bf16_quant_meta_bytes", "int8_quant_meta_bytes",
+               # production_soak workload descriptors: the injected/quarantined/
+               # unrecovered raw counts are tracked for the history (the parity
+               # and recovered columns gate the same regressions without the
+               # old==0 info-verdict trap on unrecovered_faults), and the SLO
+               # breach count rides real-clock windows
+               "faults_injected", "quarantined_faults", "unrecovered_faults",
+               "slo_breaches", "spills", "readmissions")
 
 
 def direction(name: str) -> Optional[str]:

@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Standalone chaos soak: record, replay, and gate the production-shaped run.
+
+Drives :func:`torchmetrics_tpu.chaos.run_soak` — Zipf/bursty/churning traffic
+through the serving + streaming + reliability + observability planes with a
+deterministic fault schedule — and prints the :class:`SoakReport` as JSON.
+Exit code is 1 when any fault went unrecovered or the health-plane counter
+reconciliation broke, so the soak gates in CI as-is.
+
+Examples::
+
+    python tools/chaos_soak.py --seed 7                      # seeded run
+    python tools/chaos_soak.py --seed 7 --trace /tmp/s7.trace  # record the stream
+    python tools/chaos_soak.py --replay /tmp/s7.trace          # byte-for-byte replay
+    python tools/chaos_soak.py --seed 7 --faults faults.json   # custom schedule
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# runnable as a bare script from anywhere: the package lives one level up
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[1])
+    parser.add_argument("--seed", type=int, default=0, help="traffic seed (default 0)")
+    parser.add_argument("--steps", type=int, default=120, help="traffic steps (default 120)")
+    parser.add_argument("--tenants", type=int, default=24, help="initial roster size")
+    parser.add_argument("--trace", default=None, metavar="PATH",
+                        help="save the simulated traffic trace here before running")
+    parser.add_argument("--replay", default=None, metavar="PATH",
+                        help="replay a recorded trace instead of simulating "
+                             "(--seed/--steps/--tenants are ignored)")
+    parser.add_argument("--faults", default=None, metavar="PATH",
+                        help="FaultSchedule JSON (default: one fault of every kind)")
+    parser.add_argument("--capacity", type=int, default=16, help="resident tenant slots")
+    parser.add_argument("--megabatch", type=int, default=4, help="tenant rows per dispatch")
+    parser.add_argument("--spill-codec", default="int8", choices=("none", "bf16", "int8"))
+    parser.add_argument("--sync-codec", default=None, choices=(None, "none", "bf16", "int8"))
+    parser.add_argument("--window", type=int, default=None,
+                        help="per-tenant sliding window length (default: forever accumulators)")
+    parser.add_argument("--rate", type=float, default=40.0,
+                        help="admission limit, tenants/sec on the virtual clock (0 = unlimited)")
+    parser.add_argument("--summary", action="store_true",
+                        help="print the one-line summary instead of the full JSON report")
+    args = parser.parse_args(argv)
+
+    from torchmetrics_tpu.chaos import (
+        FaultSchedule,
+        SoakConfig,
+        TrafficConfig,
+        TrafficModel,
+        run_soak,
+    )
+
+    model = None
+    if args.replay:
+        model = TrafficModel.load_trace(args.replay)
+        traffic = model.config
+    else:
+        traffic = TrafficConfig(seed=args.seed, tenants=args.tenants, steps=args.steps)
+        model = TrafficModel(traffic)
+    if args.trace:
+        written = model.save_trace(args.trace)
+        print(f"# trace: {written} bytes -> {args.trace}", file=sys.stderr)
+
+    faults = FaultSchedule.load(args.faults) if args.faults else None
+    config = SoakConfig(
+        traffic=traffic,
+        faults=faults,
+        capacity=args.capacity,
+        megabatch_size=args.megabatch,
+        spill_codec=args.spill_codec,
+        sync_codec=args.sync_codec,
+        window=args.window,
+        max_tenants_per_sec=args.rate or None,
+    )
+    report = run_soak(config, traffic_model=model)
+
+    if args.summary:
+        print(report.summary())
+    else:
+        print(json.dumps(report.to_dict(), indent=2, default=str))
+    failed = report.counters["unrecovered_faults"] > 0 or not report.reconciliation["exact"]
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

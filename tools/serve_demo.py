@@ -49,6 +49,9 @@ def main(argv=None) -> int:
                         help="AOT cache dir: boot self-warms via write_on_miss (2nd run loads)")
     parser.add_argument("--skip-naive", action="store_true",
                         help="skip the naive per-dispatch baseline loop")
+    parser.add_argument("--chaos", type=int, default=None, metavar="SEED",
+                        help="also run a small seeded chaos soak (one fault of every kind "
+                             "through the full stack) and print its recovery summary")
     args = parser.parse_args(argv)
 
     import numpy as np
@@ -131,7 +134,29 @@ def main(argv=None) -> int:
         out["naive_tenants_per_sec"] = round(naive, 2)
         out["speedup_vs_naive"] = round(out["tenants_per_sec"] / naive, 2)
 
+    if args.chaos is not None:
+        from torchmetrics_tpu.chaos import SoakConfig, TrafficConfig, run_soak
+
+        report = run_soak(SoakConfig(
+            traffic=TrafficConfig(seed=args.chaos, tenants=min(args.tenants, 24), steps=60),
+            capacity=8, megabatch_size=4, spill_codec="int8",
+        ))
+        c = report.counters
+        out["chaos"] = {
+            "seed": args.chaos,
+            "events": c["events"],
+            "shed_rate": c["shed_rate"],
+            "faults": {r["kind"]: r["outcome"] for r in report.faults},
+            "recovered": c["recovered_faults"],
+            "quarantined": c["quarantined_faults"],
+            "unrecovered": c["unrecovered_faults"],
+            "reconciliation": "OK" if report.reconciliation["exact"] else "BROKEN",
+            "hint": report.summary(),
+        }
+
     print(json.dumps(out, indent=2, default=str))
+    if args.chaos is not None and out["chaos"]["unrecovered"]:
+        return 1
     return 0
 
 

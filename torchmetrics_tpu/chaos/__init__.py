@@ -1,0 +1,33 @@
+"""Chaos plane: replayable traffic, scheduled faults, the production soak.
+
+Three pieces (see ``docs/chaos.md``):
+
+- :class:`TrafficModel` / :class:`TrafficConfig` — a seeded, Zipf-skewed,
+  bursty, churning tenant stream; same seed ⇒ same stream, serializable to
+  a byte-for-byte replayable trace file;
+- :class:`FaultSchedule` / :class:`FaultSpec` — declarative arming of the
+  repo's existing fault-injection seams at exact steps;
+- :func:`run_soak` / :class:`SoakConfig` / :class:`SoakReport` — the
+  end-to-end harness driving the serving + streaming + reliability +
+  observability planes through one trace, with SLO verdicts and a
+  deterministic fault/recovery/shed ledger. ``bench.py``'s
+  ``production_soak`` config and ``tools/chaos_soak.py`` front it.
+"""
+
+from .schedule import FAULT_KINDS, FaultSchedule, FaultSpec, default_fault_schedule
+from .soak import SoakConfig, SoakReport, run_soak, soak_rules
+from .traffic import TrafficConfig, TrafficEvent, TrafficModel
+
+__all__ = [
+    "FAULT_KINDS",
+    "FaultSchedule",
+    "FaultSpec",
+    "SoakConfig",
+    "SoakReport",
+    "TrafficConfig",
+    "TrafficEvent",
+    "TrafficModel",
+    "default_fault_schedule",
+    "run_soak",
+    "soak_rules",
+]

@@ -1,0 +1,173 @@
+"""Declarative, deterministic fault schedules.
+
+A :class:`FaultSchedule` is a list of :class:`FaultSpec` entries arming the
+repo's EXISTING injection points at exact traffic steps — no new failure
+machinery, just a scheduler over the seams every recovery path already
+tests through (``reliability/faults.py``, the serving ``_fault_hook``, the
+token-bucket clock):
+
+==================  ==========================================================
+kind                what fires, and what "recovered" means
+==================  ==========================================================
+dispatch_transient  the next ``count`` MEGABATCH dispatches raise a transient
+                    infra error (the round-5 crash class). The quarantine
+                    path re-drives per tenant; the transient does not
+                    reproduce on re-drives, so every tenant survives —
+                    recovered = each raise absorbed with zero quarantines.
+tenant_fault        a deterministic per-tenant poison: every dispatch whose
+                    megabatch contains tenant ``target`` raises, INCLUDING
+                    the single-tenant re-drive — so the engine quarantines
+                    exactly that tenant and readmits the peers. Counted as
+                    a quarantined (contained) fault, never unrecovered.
+state_poison        ``poison_state_leaf`` NaN-floods the witness metric's
+                    leaf ``target`` (default ``"tp"``) at the step; the next
+                    sync epoch's ``validate_state`` raises
+                    ``StateCorruptionError`` and the harness resets the
+                    witness — recovered at that epoch.
+gather_flaky        the witness's next sync gathers through ``FlakyGather``
+                    (first ``count`` collective calls drop a participant);
+                    the metric's retry policy re-enters the sync — recovered
+                    when the sync lands within budget.
+clock_skew          the virtual admission clock jumps by ``float(target)``
+                    seconds (negative = backwards skew, which DRAINS the
+                    token bucket — the refill formula sees a negative
+                    delta); recovered when the first post-skew batch is
+                    admitted again.
+==================  ==========================================================
+
+Schedules serialize to/from JSON (``to_json``/``from_json``, ``save``/
+``load``) so a failing soak's faults replay alongside its traffic trace.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from ..utilities.exceptions import TorchMetricsUserError
+
+FAULT_KINDS = (
+    "dispatch_transient",
+    "tenant_fault",
+    "state_poison",
+    "gather_flaky",
+    "clock_skew",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """One scheduled fault.
+
+    Args:
+        step: traffic step at which the fault arms (0-based; fires before
+            the step's events are driven).
+        kind: one of :data:`FAULT_KINDS`.
+        target: kind-specific — tenant id (``tenant_fault``), state leaf
+            name (``state_poison``), skew seconds (``clock_skew``); unused
+            otherwise.
+        count: kind-specific repetition — failing dispatches
+            (``dispatch_transient``) or failing gather calls
+            (``gather_flaky``).
+    """
+
+    step: int
+    kind: str
+    target: Optional[str] = None
+    count: int = 1
+
+    def __post_init__(self) -> None:
+        if not (isinstance(self.step, int) and self.step >= 0):
+            raise ValueError(f"step must be a non-negative integer, got {self.step}")
+        if self.kind not in FAULT_KINDS:
+            raise ValueError(f"kind must be one of {FAULT_KINDS}, got {self.kind!r}")
+        if not (isinstance(self.count, int) and self.count >= 1):
+            raise ValueError(f"count must be a positive integer, got {self.count}")
+        if self.kind == "tenant_fault" and self.target is None:
+            raise ValueError("tenant_fault needs target=<tenant id>")
+        if self.kind == "clock_skew":
+            try:
+                float(self.target)  # type: ignore[arg-type]
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"clock_skew needs target=<seconds as float string>, got {self.target!r}"
+                ) from None
+
+
+class FaultSchedule:
+    """An ordered, replayable set of :class:`FaultSpec` entries."""
+
+    def __init__(self, specs: Iterable[FaultSpec] = ()) -> None:
+        specs = list(specs)
+        for s in specs:
+            if not isinstance(s, FaultSpec):
+                raise TorchMetricsUserError(
+                    f"FaultSchedule entries must be FaultSpec, got {type(s).__name__}"
+                )
+        self.specs: Tuple[FaultSpec, ...] = tuple(sorted(specs, key=lambda s: (s.step, s.kind)))
+
+    def due(self, step: int) -> List[FaultSpec]:
+        """Specs arming exactly at ``step``."""
+        return [s for s in self.specs if s.step == step]
+
+    @property
+    def last_step(self) -> int:
+        return max((s.step for s in self.specs), default=-1)
+
+    def __len__(self) -> int:
+        return len(self.specs)
+
+    def __iter__(self):
+        return iter(self.specs)
+
+    # ------------------------------------------------------------ round trip
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {"version": 1, "faults": [dataclasses.asdict(s) for s in self.specs]},
+            sort_keys=True,
+            indent=2,
+        )
+
+    @classmethod
+    def from_json(cls, text: str) -> "FaultSchedule":
+        doc = json.loads(text)
+        entries = doc["faults"] if isinstance(doc, dict) else doc
+        try:
+            return cls(FaultSpec(**e) for e in entries)
+        except TypeError as err:
+            raise TorchMetricsUserError(f"malformed fault schedule: {err}") from err
+
+    def save(self, path: str) -> None:
+        with open(path, "w", encoding="utf-8") as fh:
+            fh.write(self.to_json() + "\n")
+
+    @classmethod
+    def load(cls, path: str) -> "FaultSchedule":
+        with open(path, "r", encoding="utf-8") as fh:
+            return cls.from_json(fh.read())
+
+    def __repr__(self) -> str:
+        kinds: Dict[str, int] = {}
+        for s in self.specs:
+            kinds[s.kind] = kinds.get(s.kind, 0) + 1
+        return f"FaultSchedule({len(self.specs)} faults: {kinds})"
+
+
+def default_fault_schedule(steps: int, tenant: int = 1) -> FaultSchedule:
+    """One fault of every kind, spread across the run — the schedule the
+    demo/bench/CLI use when none is supplied. ``tenant`` is the id the
+    ``tenant_fault`` entry quarantines (pick a mid-popularity one so its
+    loss is visible but not dominant)."""
+    if steps < 10:
+        raise ValueError(f"need >= 10 steps to spread the default faults, got {steps}")
+    return FaultSchedule(
+        [
+            FaultSpec(step=max(1, steps // 5), kind="dispatch_transient", count=2),
+            FaultSpec(step=max(2, (2 * steps) // 5), kind="tenant_fault", target=str(tenant)),
+            FaultSpec(step=max(3, steps // 2), kind="state_poison", target="tp"),
+            FaultSpec(step=max(4, (3 * steps) // 5), kind="gather_flaky", count=2),
+            FaultSpec(step=max(5, (3 * steps) // 4), kind="clock_skew", target="-2.0"),
+        ]
+    )

@@ -1,0 +1,548 @@
+"""The production soak: every plane, one run, verdicts attached.
+
+``run_soak`` drives a :class:`~torchmetrics_tpu.serving.ServingEngine`
+(quarantine mode, LRU spill with an optional codec, token-bucket admission
+on a VIRTUAL clock, optional per-tenant windows, optional AOT self-warming)
+plus :class:`~torchmetrics_tpu.streaming.SlidingWindow` /
+:class:`~torchmetrics_tpu.streaming.DriftMonitor` side-channels through one
+seeded :class:`~torchmetrics_tpu.chaos.TrafficModel`, arming a
+:class:`~torchmetrics_tpu.chaos.FaultSchedule` at exact steps, inside one
+telemetry session whose SLO engine (``default_rules()`` + :func:`soak_rules`)
+renders verdicts each sync epoch.
+
+Determinism contract: the ``SoakReport.counters`` block — admission/shed,
+engine stats (minus wall-clock nanoseconds), and the fault ledger
+(injected/recovered/quarantined/unrecovered) — is a pure function of
+``(SoakConfig, seed, fault schedule)``. Admission runs on a virtual clock
+advancing ``seconds_per_step`` per traffic step (``ServingConfig(clock=)``),
+so even shed counts replay exactly. Latency percentiles and SLO breach
+timing ride real wall-clock and live in the non-contractual ``timing`` /
+``slo_breaches`` blocks.
+
+Fault accounting (``docs/chaos.md`` has the full table):
+
+- *recovered* — the plane absorbed the fault and service continued:
+  transient megabatch raises re-driven clean, poisons caught by
+  ``validate_state`` and reset, flaky gathers retried home, clock skews
+  admitting again;
+- *quarantined* — the engine CONTAINED a deterministic per-tenant fault by
+  quarantining exactly the offender (the designed blast radius, not a
+  failure of recovery);
+- *unrecovered* — anything that escaped: an exception out of the serve
+  loop, a sync that exhausted its retry budget, corruption detected with no
+  armed poison, a skew still shedding at run end. A healthy soak reports
+  **zero**, and the ``production_soak`` bench gate pins that.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+
+from .. import observability as _observability
+from ..classification import MulticlassAccuracy
+from ..observability.slo import SloRule, default_rules
+from ..parallel import SyncConfig
+from ..reliability import (
+    FlakyGather,
+    ReliabilityConfig,
+    RetryPolicy,
+    make_transient_error,
+    poison_state_leaf,
+    validate_state,
+)
+from ..serving import ServingConfig, ServingEngine
+from ..streaming import DriftMonitor, SlidingWindow
+from ..utilities.exceptions import StateCorruptionError, TorchMetricsUserError
+from .schedule import FaultSchedule, FaultSpec, default_fault_schedule
+from .traffic import TrafficConfig, TrafficModel
+
+
+@dataclasses.dataclass(frozen=True)
+class SoakConfig:
+    """One soak run, fully specified (defaults are CPU-test sized).
+
+    Args:
+        traffic: the seeded load (ignored when ``run_soak`` is handed a
+            replayed :class:`TrafficModel` directly).
+        faults: the schedule; ``None`` arms :func:`default_fault_schedule`
+            over the traffic's step count.
+        capacity / megabatch_size / spill_codec / window /
+        max_tenants_per_sec / aot_cache_dir: forwarded into
+            :class:`~torchmetrics_tpu.serving.ServingConfig` (quarantine
+            mode and spill are always on — the soak exists to exercise
+            them).
+        seconds_per_step: virtual seconds the admission clock advances per
+            traffic step.
+        sync_every: sync-epoch cadence in steps — each epoch validates the
+            witness, syncs it through the (possibly flaky) gather, commits
+            the engine's async stacked sync (or ``compute_all`` on windowed
+            engines), and evaluates the SLO rules.
+        sync_codec: ``None`` syncs exact; else a
+            :class:`~torchmetrics_tpu.parallel.SyncConfig` codec name for
+            quantize-on-sync (one config instance lives across the run, so
+            error-feedback residuals fold correctly).
+        side_channel_every: update the SlidingWindow/DriftMonitor side
+            channels every Nth event (they dispatch per update — this keeps
+            the CPU soak fast without changing the engine path).
+        drift_reference / drift_test: DriftMonitor window geometry.
+        shed_rate_max: threshold for the ``soak_shed_rate`` SLO rule.
+        retry_attempts: witness sync retry budget (the ``gather_flaky``
+            recovery headroom).
+    """
+
+    traffic: TrafficConfig = dataclasses.field(default_factory=TrafficConfig)
+    faults: Optional[FaultSchedule] = None
+    capacity: int = 16
+    megabatch_size: int = 4
+    spill_codec: str = "none"
+    window: Optional[int] = None
+    max_tenants_per_sec: Optional[float] = 40.0
+    aot_cache_dir: Optional[str] = None
+    seconds_per_step: float = 0.25
+    sync_every: int = 20
+    sync_codec: Optional[str] = None
+    side_channel_every: int = 4
+    drift_reference: int = 48
+    drift_test: int = 16
+    shed_rate_max: float = 0.5
+    retry_attempts: int = 5
+
+    def __post_init__(self) -> None:
+        if self.sync_every < 1:
+            raise ValueError(f"sync_every must be >= 1, got {self.sync_every}")
+        if self.seconds_per_step <= 0:
+            raise ValueError(f"seconds_per_step must be > 0, got {self.seconds_per_step}")
+        if self.side_channel_every < 1:
+            raise ValueError(f"side_channel_every must be >= 1, got {self.side_channel_every}")
+        if not 0.0 < self.shed_rate_max <= 1.0:
+            raise ValueError(f"shed_rate_max must be in (0, 1], got {self.shed_rate_max}")
+        if self.retry_attempts < 1:
+            raise ValueError(f"retry_attempts must be >= 1, got {self.retry_attempts}")
+
+
+def soak_rules(
+    shed_rate_max: float = 0.5,
+    drift_threshold: float = 0.75,
+) -> Tuple[SloRule, ...]:
+    """Soak-specific SLO rules layered on ``default_rules()``: overload shed
+    rate, any quarantine in the window, and sustained side-channel drift."""
+    return (
+        SloRule(
+            name="soak_shed_rate",
+            expr=(
+                "serve_rejected >= 3 and "
+                f"serve_rejected / max(serve_tenant_rows + serve_rejected, 1) > {shed_rate_max}"
+            ),
+            window=120.0,
+            severity="critical",
+            description="admission shedding more than the overload budget",
+        ),
+        SloRule(
+            name="soak_quarantine",
+            expr="quarantines > 0",
+            window=120.0,
+            severity="warning",
+            description="a tenant was quarantined this window (contained deterministic fault)",
+        ),
+        SloRule(
+            name="soak_drift",
+            expr=f"drift('soak') > {drift_threshold}",
+            window=240.0,
+            severity="warning",
+            description="side-channel stream drifted past the soak threshold",
+        ),
+    )
+
+
+@dataclasses.dataclass
+class SoakReport:
+    """Structured soak verdict. ``counters`` is the deterministic block (the
+    replay/determinism contract); ``timing`` and ``slo_breaches`` carry
+    wall-clock observations; ``faults`` is the per-spec ledger;
+    ``reconciliation`` is the health-plane identity
+    ``jit_compiles + jit_cache_hits + aot_cache_hits == dispatches``."""
+
+    counters: Dict[str, Any]
+    timing: Dict[str, float]
+    faults: List[Dict[str, Any]]
+    slo_breaches: List[Dict[str, Any]]
+    reconciliation: Dict[str, Any]
+    config: Dict[str, Any]
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    def summary(self) -> str:
+        c = self.counters
+        return (
+            f"soak seed={self.config.get('seed')}: {c['events']} events, "
+            f"{c['admitted']} admitted, {c['shed']} shed "
+            f"(rate {c['shed_rate']:.3f}); faults injected={c['faults_injected']} "
+            f"recovered={c['recovered_faults']} quarantined={c['quarantined_faults']} "
+            f"unrecovered={c['unrecovered_faults']}; "
+            f"reconciliation={'OK' if self.reconciliation['exact'] else 'BROKEN'}"
+        )
+
+
+class _ChaosHook:
+    """Multiplexing ``ServingEngine._fault_hook``: one seam, two behaviors.
+
+    Transient faults fire only on MEGABATCH dispatches (``len > 1``) so the
+    quarantine path's single-tenant re-drives always pass — a transient by
+    definition does not reproduce. Tenant faults fire whenever the target is
+    present, re-drive included, so exactly that tenant quarantines; the hook
+    disarms on the single-entry raise (the raise that quarantines)."""
+
+    def __init__(self) -> None:
+        self.transient_left = 0
+        self.transient_raised = 0
+        self.tenant_targets: set = set()
+        self.tenant_raised = 0
+        self.tenant_contained = 0
+
+    def __call__(self, tenant_ids: List[Any]) -> None:
+        tids = [int(t) for t in tenant_ids]
+        armed = [t for t in tids if t in self.tenant_targets]
+        if armed:
+            self.tenant_raised += 1
+            if len(tids) == 1:
+                # the re-drive raise: the engine quarantines this tenant next
+                self.tenant_targets.discard(tids[0])
+                self.tenant_contained += 1
+            raise RuntimeError(
+                f"chaos: deterministic fault pinned to tenant {armed[0]}"
+            )
+        if self.transient_left > 0 and len(tids) > 1:
+            self.transient_left -= 1
+            self.transient_raised += 1
+            raise make_transient_error()
+
+
+class _WitnessGather:
+    """World-of-one gather for the witness sync, with a ``FlakyGather``
+    armed over it while a ``gather_flaky`` fault is live."""
+
+    def __init__(self) -> None:
+        self._flaky: Optional[FlakyGather] = None
+
+    def base(self, value: Any, group: Any = None) -> List[Any]:
+        return [jnp.asarray(value)]
+
+    def arm(self, fail_times: int) -> None:
+        self._flaky = FlakyGather(inner=self.base, fail_times=fail_times)
+
+    @property
+    def armed_failures(self) -> int:
+        return self._flaky.failures if self._flaky is not None else 0
+
+    def disarm(self) -> None:
+        self._flaky = None
+
+    def __call__(self, value: Any, group: Any = None) -> List[Any]:
+        if self._flaky is not None:
+            return self._flaky(value, group)
+        return self.base(value, group)
+
+
+def _metric(num_classes: int, reliability: Optional[ReliabilityConfig] = None) -> MulticlassAccuracy:
+    return MulticlassAccuracy(
+        num_classes=num_classes, average="micro", validate_args=False,
+        reliability=reliability,
+    )
+
+
+def run_soak(
+    config: Optional[SoakConfig] = None,
+    traffic_model: Optional[TrafficModel] = None,
+) -> SoakReport:
+    """Run one soak; see the module docstring for the contract. Pass
+    ``traffic_model`` (e.g. :meth:`TrafficModel.load_trace`) to replay a
+    recorded stream instead of simulating ``config.traffic``."""
+    cfg = config if config is not None else SoakConfig()
+    model = traffic_model if traffic_model is not None else TrafficModel(cfg.traffic)
+    traffic = model.config
+    faults = cfg.faults if cfg.faults is not None else default_fault_schedule(traffic.steps)
+    if faults.last_step >= traffic.steps:
+        raise TorchMetricsUserError(
+            f"fault schedule reaches step {faults.last_step} but the traffic "
+            f"runs only {traffic.steps} steps."
+        )
+
+    clock = {"t": 0.0}
+    engine = ServingEngine(
+        _metric(traffic.num_classes),
+        ServingConfig(
+            capacity=cfg.capacity,
+            megabatch_size=cfg.megabatch_size,
+            spill=True,
+            spill_codec=cfg.spill_codec,
+            on_error="quarantine",
+            max_tenants_per_sec=cfg.max_tenants_per_sec,
+            clock=lambda: clock["t"],
+            window=cfg.window,
+            aot_cache_dir=cfg.aot_cache_dir,
+        ),
+    )
+    hook = _ChaosHook()
+    engine._fault_hook = hook
+    gather = _WitnessGather()
+    # the witness: a fleet-level side metric whose sync path carries the
+    # gather_flaky/state_poison faults (its retry budget is the recovery)
+    witness = _metric(
+        traffic.num_classes,
+        reliability=ReliabilityConfig(
+            retry=RetryPolicy(
+                max_attempts=cfg.retry_attempts, backoff_base=0.0, jitter=0.0,
+                sleep_fn=lambda _s: None,
+            )
+        ),
+    )
+    sync_cfg = SyncConfig(codec=cfg.sync_codec) if cfg.sync_codec else None
+    sliding = SlidingWindow(_metric(traffic.num_classes), cfg.drift_test * 2)
+    drift = DriftMonitor(
+        _metric(traffic.num_classes),
+        reference_window=cfg.drift_reference,
+        test_window=cfg.drift_test,
+        threshold=0.75,
+        name="soak",
+        eval_every=cfg.drift_test,
+    )
+
+    # fault ledger: per-spec records resolved as recoveries land (FIFO per kind)
+    records: List[Dict[str, Any]] = []
+    pending: Dict[str, List[Dict[str, Any]]] = {k: [] for k in (
+        "dispatch_transient", "tenant_fault", "state_poison", "gather_flaky", "clock_skew",
+    )}
+    recovered = 0
+    unrecovered = 0
+    skew_pending = 0
+    armed_poisons = 0
+    epochs = 0
+    slo_breaches: List[Dict[str, Any]] = []
+    quarantined_tids: set = set()
+    known_quarantines = 0
+    admitted = 0
+    shed = 0
+    dropped_quarantined = 0
+    events_total = 0
+
+    def _arm(spec: FaultSpec) -> None:
+        nonlocal skew_pending, armed_poisons
+        rec = {
+            "step": spec.step, "kind": spec.kind, "target": spec.target,
+            "count": spec.count, "outcome": "pending",
+        }
+        records.append(rec)
+        pending[spec.kind].append(rec)
+        if spec.kind == "dispatch_transient":
+            hook.transient_left += spec.count
+        elif spec.kind == "tenant_fault":
+            hook.tenant_targets.add(int(spec.target))  # type: ignore[arg-type]
+        elif spec.kind == "state_poison":
+            poison_state_leaf(witness, spec.target or "tp")
+            armed_poisons += 1
+        elif spec.kind == "gather_flaky":
+            gather.arm(spec.count)
+        elif spec.kind == "clock_skew":
+            clock["t"] += float(spec.target)  # type: ignore[arg-type]
+            skew_pending += 1
+
+    def _resolve(kind: str, outcome: str, n: int = 1) -> None:
+        for _ in range(n):
+            if pending[kind]:
+                pending[kind].pop(0)["outcome"] = outcome
+
+    def _sync_epoch() -> None:
+        nonlocal recovered, unrecovered, armed_poisons, epochs
+        epochs += 1
+        engine.flush()
+        # 1. witness integrity: an armed poison MUST be caught here
+        try:
+            validate_state(witness, context=f"soak epoch {epochs}")
+        except StateCorruptionError:
+            witness.reset()
+            if armed_poisons:
+                recovered += armed_poisons
+                _resolve("state_poison", "recovered", armed_poisons)
+                armed_poisons = 0
+            else:
+                unrecovered += 1
+        # 2. witness sync through the (possibly flaky) gather, retry armed
+        try:
+            witness.sync(
+                dist_sync_fn=gather,
+                distributed_available=lambda: True,
+                sync_config=sync_cfg,
+            )
+            witness.unsync()
+            if gather.armed_failures:
+                recovered += gather.armed_failures
+                _resolve("gather_flaky", "recovered")
+            gather.disarm()
+        except Exception:  # noqa: BLE001 — an escaped sync is an unrecovered fault
+            unrecovered += 1
+            _resolve("gather_flaky", "unrecovered")
+            gather.disarm()
+        # 3. engine read side: async stacked sync (plain engines) or the
+        # windowed per-tenant read (sync_async rejects windowed stacks)
+        if cfg.window is None:
+            engine.sync_async(dist_sync_fn=gather.base, sync_config=sync_cfg).commit()
+        else:
+            engine.compute_all()
+        # 4. SLO verdicts (real-clock windows — informational)
+        rec = _observability._ACTIVE
+        if rec is not None:
+            for alert in rec.evaluate_slos():
+                slo_breaches.append({
+                    "epoch": epochs,
+                    "rule": alert.get("rule", "?"),
+                    "severity": alert.get("severity", "?"),
+                })
+
+    def _refresh_quarantined() -> None:
+        nonlocal known_quarantines
+        known_quarantines = engine.stats["quarantined"]
+        quarantined_tids.clear()
+        quarantined_tids.update(
+            tid for tid, info in engine.tenants().items() if info["quarantined"]
+        )
+
+    t0 = time.perf_counter()
+    with _observability.telemetry_session(
+        _observability.TelemetryConfig(
+            slo_rules=tuple(default_rules()) + soak_rules(shed_rate_max=cfg.shed_rate_max),
+        )
+    ) as rec:
+        current_step = -1
+        for ev in model.events():
+            while current_step < ev.step:
+                current_step += 1
+                clock["t"] += cfg.seconds_per_step
+                for spec in faults.due(current_step):
+                    _arm(spec)
+                if current_step and current_step % cfg.sync_every == 0:
+                    _sync_epoch()
+            events_total += 1
+            tid = int(ev.tenant_id)
+            if tid in quarantined_tids:
+                dropped_quarantined += 1
+                continue
+            try:
+                ok = engine.update(tid, ev.batch[0], ev.batch[1])
+            except Exception:  # noqa: BLE001 — an escaped dispatch is unrecovered
+                unrecovered += 1
+                ok = False
+            if ok:
+                admitted += 1
+                if skew_pending:
+                    # service admitted again after the jump: skew absorbed
+                    recovered += skew_pending
+                    _resolve("clock_skew", "recovered", skew_pending)
+                    skew_pending = 0
+            else:
+                shed += 1
+            if engine.stats["quarantined"] != known_quarantines:
+                _refresh_quarantined()
+            if ev.index % cfg.side_channel_every == 0:
+                witness.update(ev.batch[0], ev.batch[1])
+                sliding.update(ev.batch[0], ev.batch[1])
+                drift.update(ev.batch[0], ev.batch[1])
+        # drain the remaining steps (faults/epochs past the last event)
+        while current_step < traffic.steps - 1:
+            current_step += 1
+            clock["t"] += cfg.seconds_per_step
+            for spec in faults.due(current_step):
+                _arm(spec)
+            if current_step and current_step % cfg.sync_every == 0:
+                _sync_epoch()
+        _sync_epoch()  # the closing epoch: catches late poisons/flaky syncs
+        elapsed = time.perf_counter() - t0
+
+        # ledger close-out
+        if skew_pending:
+            unrecovered += skew_pending
+            _resolve("clock_skew", "unrecovered", skew_pending)
+        recovered += hook.transient_raised
+        consumed = hook.transient_raised
+        for r in list(pending["dispatch_transient"]):
+            if consumed >= r["count"]:
+                consumed -= r["count"]
+                _resolve("dispatch_transient", "recovered")
+        _resolve("tenant_fault", "quarantined", hook.tenant_contained)
+        for kind_pending in pending.values():
+            for r in kind_pending:
+                if r["outcome"] == "pending":
+                    r["outcome"] = "not_fired"
+        quarantined_faults = engine.stats["quarantined"]
+        injected = (
+            hook.transient_raised + hook.tenant_raised + sum(
+                1 for r in records if r["kind"] in ("state_poison", "clock_skew")
+            ) + sum(r["count"] for r in records if r["kind"] == "gather_flaky")
+        )
+
+        snap = rec.counters.snapshot().counts
+        lat = rec.latency_summary()
+        reconciliation = {
+            "dispatches": int(snap.get("dispatches", 0)),
+            "jit_compiles": int(snap.get("jit_compiles", 0)),
+            "jit_cache_hits": int(snap.get("jit_cache_hits", 0)),
+            "aot_cache_hits": int(snap.get("aot_cache_hits", 0)),
+        }
+        reconciliation["exact"] = (
+            reconciliation["jit_compiles"]
+            + reconciliation["jit_cache_hits"]
+            + reconciliation["aot_cache_hits"]
+            == reconciliation["dispatches"]
+        )
+        update_kind = "vwupdate" if cfg.window is not None else "vupdate"
+        kind_lat = lat.get(update_kind) or {}
+
+    stats = dict(engine.stats)
+    stats.pop("spill_ns", None)  # wall-clock — outside the determinism contract
+    served = admitted
+    shed_rate = round(shed / max(served + shed, 1), 6)
+    counters: Dict[str, Any] = {
+        "events": events_total,
+        "admitted": admitted,
+        "shed": shed,
+        "shed_rate": shed_rate,
+        "dropped_quarantined": dropped_quarantined,
+        "steps": traffic.steps,
+        "epochs": epochs,
+        "tenants": len(engine.tenants()),
+        "drift_evals": len(drift.history),
+        "faults_injected": injected,
+        "recovered_faults": recovered,
+        "quarantined_faults": quarantined_faults,
+        "unrecovered_faults": unrecovered,
+        **{f"engine_{k}": int(v) for k, v in stats.items()},
+    }
+    timing = {
+        "elapsed_s": round(elapsed, 6),
+        "tenants_per_sec": round(stats["tenant_rows"] / max(elapsed, 1e-9), 3),
+        "update_p50_us": float(kind_lat.get("p50_us", 0.0)),
+        "update_p99_us": float(kind_lat.get("p99_us", 0.0)),
+    }
+    return SoakReport(
+        counters=counters,
+        timing=timing,
+        faults=records,
+        slo_breaches=slo_breaches,
+        reconciliation=reconciliation,
+        config={
+            "seed": traffic.seed,
+            "steps": traffic.steps,
+            "tenants": traffic.tenants,
+            "spill_codec": cfg.spill_codec,
+            "sync_codec": cfg.sync_codec,
+            "window": cfg.window,
+            "capacity": cfg.capacity,
+            "megabatch_size": cfg.megabatch_size,
+            "faults": len(faults),
+            "replayed": model.replayed,
+        },
+    )

@@ -116,6 +116,13 @@ class ServingConfig:
             empty is SHED (``update`` returns ``False``, the
             ``serve_rejected`` counter/event fires) instead of queueing into
             LRU-spill thrash. ``None`` (default) admits everything.
+        clock: monotonic-seconds source for the admission token bucket
+            (default ``time.monotonic``). Injecting a virtual clock makes
+            admission/shed decisions exactly reproducible — the chaos soak
+            harness (``torchmetrics_tpu.chaos``) advances one per simulated
+            step, and a scripted *backwards* jump models real clock skew
+            (a negative delta drains tokens, so the bucket sheds until the
+            clock catches up). Ignored when ``max_tenants_per_sec`` is None.
         aot_cache_dir: activate the AOT compile-cache plane process-wide at
             engine construction, pointed at this directory, with
             ``write_on_miss`` below — the self-warming boot path (a second
@@ -150,6 +157,7 @@ class ServingConfig:
     spill_codec: str = "none"
     on_error: str = "raise"
     max_tenants_per_sec: Optional[float] = None
+    clock: Optional[Callable[[], float]] = None
     aot_cache_dir: Optional[str] = None
     write_on_miss: bool = True
     sharding: Any = None
@@ -171,6 +179,8 @@ class ServingConfig:
             raise ValueError(
                 f"max_tenants_per_sec must be > 0 (or None), got {self.max_tenants_per_sec}"
             )
+        if self.clock is not None and not callable(self.clock):
+            raise ValueError(f"clock must be a zero-arg callable returning seconds, got {self.clock!r}")
         if self.spill_codec not in _quantize.CODEC_NAMES:
             raise ValueError(
                 f"spill_codec must be one of {sorted(_quantize.CODEC_NAMES)}, "
@@ -310,8 +320,9 @@ class ServingEngine:
         }
         # admission token bucket (ServingConfig.max_tenants_per_sec): starts
         # full (one second's burst, floored at one whole token so sub-1/s
-        # rates can admit at all); `_clock` is the injection seam tests use
-        self._clock: Callable[[], float] = time.monotonic
+        # rates can admit at all); ServingConfig(clock=) injects a virtual
+        # time source (chaos soak, deterministic operators' drills)
+        self._clock: Callable[[], float] = self.config.clock or time.monotonic
         self._rl_tokens = (
             max(float(self.config.max_tenants_per_sec), 1.0)
             if self.config.max_tenants_per_sec is not None else 0.0
@@ -576,23 +587,63 @@ class ServingEngine:
         if self.config.on_error == "raise":
             self._dispatch_rows(cls, entries)
             return len(entries)
-        # quarantine mode: back up, roll back on failure, isolate per tenant
+        # quarantine mode: back up, roll back on failure, isolate per tenant.
+        # Seating happens INSIDE _dispatch_rows (readmissions decode spilled
+        # rows, evictions spill LRU residents), so the rollback must restore
+        # the seating bookkeeping alongside the stack values — restoring only
+        # the arrays would leave a readmitted tenant marked resident over a
+        # slot whose rolled-back rows belong to the evicted victim, and the
+        # per-tenant re-drive would then fold healthy batches into the wrong
+        # tenant's counts (the spill-codec × quarantine regression test pins
+        # this).
         backup = {k: jnp.copy(v) for k, v in cls.stacked.items()}
+        seating = self._seating_snapshot(cls, entries)
         try:
             self._dispatch_rows(cls, entries)
             return len(entries)
         except Exception:
             cls.stacked = backup
+            self._restore_seating(cls, seating)
         served = 0
         for entry in entries:
             single_backup = {k: jnp.copy(v) for k, v in cls.stacked.items()}
+            single_seating = self._seating_snapshot(cls, [entry])
             try:
                 self._dispatch_rows(cls, [entry])
                 served += 1
             except Exception as err:  # noqa: BLE001 — quarantine, never poison the stack
                 cls.stacked = single_backup
+                self._restore_seating(cls, single_seating)
                 self._quarantine(entry[0], err)
         return served
+
+    def _seating_snapshot(
+        self, cls: _ShapeClass, entries: List[Tuple[Hashable, tuple, dict]]
+    ) -> Tuple[Dict[int, Hashable], List[int], Dict[Hashable, Tuple[Optional[int], Any]]]:
+        """Rollback unit for the seating a dispatch may perform: the class's
+        slot maps plus (slot, spilled) for every tenant seating can touch —
+        current residents (eviction victims) and the megabatch members
+        (readmissions). Spilled dicts are never mutated in place, so holding
+        the reference is enough."""
+        tids = set(cls.slot_tenant.values()) | {tid for tid, _, _ in entries}
+        return (
+            dict(cls.slot_tenant),
+            list(cls.free),
+            {tid: (self._tenants[tid].slot, self._tenants[tid].spilled) for tid in tids},
+        )
+
+    def _restore_seating(
+        self,
+        cls: _ShapeClass,
+        snap: Tuple[Dict[int, Hashable], List[int], Dict[Hashable, Tuple[Optional[int], Any]]],
+    ) -> None:
+        slot_tenant, free, per_tenant = snap
+        cls.slot_tenant = dict(slot_tenant)
+        cls.free = list(free)
+        for tid, (slot, spilled) in per_tenant.items():
+            t = self._tenants[tid]
+            t.slot = slot
+            t.spilled = spilled
 
     def _dispatch_rows(self, cls: _ShapeClass, entries: List[Tuple[Hashable, tuple, dict]]) -> None:
         """One megabatch dispatch: stack entries + pad to the fixed size,
@@ -972,6 +1023,7 @@ class ServingEngine:
         process_group: Any = None,
         dist_sync_fn: Optional[Callable] = None,
         reset_window: bool = False,
+        sync_config: Optional[Any] = None,
     ) -> Any:
         """Launch a background coalesced sync of every shape-class's stacked
         tenant states — the hook that takes windowed per-tenant metrics' sync
@@ -999,6 +1051,11 @@ class ServingEngine:
         ``reset_window=False`` the live stacks are re-buffered (one value
         copy per stack) so the engine's donated dispatches cannot delete the
         frozen buffers mid-gather.
+
+        ``sync_config`` (:class:`~torchmetrics_tpu.parallel.SyncConfig`)
+        opts the background gather into the quantized collective buckets —
+        pass ONE config instance across repeated syncs so its error-feedback
+        residuals fold correctly (``docs/distributed.md``).
         """
         from ..parallel.async_sync import AsyncSyncHandle
 
@@ -1046,6 +1103,7 @@ class ServingEngine:
         return AsyncSyncHandle(
             states, reductions, process_group=process_group, dist_sync_fn=dist_sync_fn,
             committer=committer, label="ServingEngine.sync_async",
+            sync_config=sync_config,
         )
 
     # ----------------------------------------------------------- observability
