@@ -1319,6 +1319,73 @@ def bench_production_soak() -> dict:
     }
 
 
+def bench_durable_failover() -> dict:
+    """Config ``durable_failover``: the durability & failover plane end to end —
+    the chaos soak with write-ahead journaling and periodic crash-consistent
+    snapshots, KILLED at step 70 and failed over to a cold standby that
+    restores the latest snapshot and replays the journal tail, then driven to
+    completion (rank loss and coordination outage included in the schedule).
+
+    The gate columns are exact: ``failover_state_parity`` is 1.0 iff the
+    standby's post-replay state was bitwise identical to the killed primary's,
+    ``recovery_parity`` is 1.0 iff the failed-over run finished with the SAME
+    final engine digest as an uninterrupted reference run,
+    ``degraded_sync_parity`` is 1.0 iff every scheduled rank loss reconciled
+    on rejoin, and ``failover_rpo_records`` pins record loss at zero
+    (fsync-per-record journaling). ``failover_rto_ms`` is the wall-clock cost
+    of restore + replay — the latency headline this plane exists to bound.
+    Uses ``spill_codec="none"``: bitwise parity is the point, so nothing
+    lossy may sit between the state and the digest.
+    """
+    import dataclasses as _dc
+    import tempfile
+    import warnings
+
+    from torchmetrics_tpu.chaos import SoakConfig, TrafficConfig, run_soak
+
+    with tempfile.TemporaryDirectory() as dur_dir:
+        config = SoakConfig(
+            traffic=TrafficConfig(seed=31, tenants=24, steps=120),
+            capacity=8,
+            megabatch_size=4,
+            spill_codec="none",
+            max_tenants_per_sec=40.0,
+            durability_dir=dur_dir,
+            snapshot_every=30,
+            failover_at=70,
+            journal_fsync_every=1,
+        )
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")  # SLO breach + retry warnings are the point
+            failover = run_soak(config)
+            reference = run_soak(_dc.replace(
+                config, durability_dir=None, snapshot_every=None, failover_at=None,
+            ))
+    c = failover.counters
+    return {
+        "tenants_per_sec": failover.timing["tenants_per_sec"],
+        "failover_rto_ms": failover.timing["failover_rto_ms"],
+        "failover_rpo_records": c["failover_rpo_records"],
+        "replayed_records": c["replayed_records"],
+        "journal_records": c["journal_records"],
+        "journal_fsyncs": c["journal_fsyncs"],
+        "snapshots": c["snapshots"],
+        "snapshot_restores": c["snapshot_restores"],
+        "degraded_syncs": c["degraded_syncs"],
+        "rank_rejoins": c["rank_rejoins"],
+        "faults_injected": c["faults_injected"],
+        "recovered_faults": c["recovered_faults"],
+        "unrecovered_faults": c["unrecovered_faults"],
+        "failover_state_parity": c["failover_state_parity"],
+        "degraded_sync_parity": c["degraded_sync_parity"],
+        "recovery_parity": (
+            1.0 if failover.config["state_digest"] == reference.config["state_digest"] else 0.0
+        ),
+        "soak_recovery_parity": 1.0 if c["unrecovered_faults"] == 0 else 0.0,
+        "unit": "seeded durable soak, 120 steps, kill+failover at step 70, journal fsync per record",
+    }
+
+
 def bench_fault_selftest() -> dict:
     """Hidden config (leading underscore: excluded from the main run) proving the
     retry wrapper end to end: the FIRST subprocess attempt dies with the round-5
@@ -1346,6 +1413,7 @@ CONFIGS = {
     "streaming_window_100k": bench_streaming_100k,
     "quantized_sync": bench_quantized_sync,
     "production_soak": bench_production_soak,
+    "durable_failover": bench_durable_failover,
     "_fault_selftest": bench_fault_selftest,
 }
 

@@ -318,6 +318,54 @@ def test_production_soak_columns_direction_and_gate(tmp_path):
     assert bench_compare.main(paths + ["--check"]) == 0
 
 
+def test_durable_failover_columns_direction_and_gate(tmp_path):
+    """durable_failover columns (durability plane): the three parities and
+    recovery_parity gate higher-exact (a torn snapshot or lost journal tail
+    shows up as failover_state_parity/recovery_parity 1.0 -> 0.0), RPO gates
+    lower-exact, RTO as an ordinary latency; the journal/snapshot tallies are
+    info-only."""
+    assert bench_compare.direction("extra.durable_failover.failover_state_parity") == "higher"
+    assert bench_compare.direction("extra.durable_failover.recovery_parity") == "higher"
+    assert bench_compare.direction("extra.durable_failover.degraded_sync_parity") == "higher"
+    assert bench_compare.direction("extra.durable_failover.failover_rpo_records") == "lower"
+    assert bench_compare.direction("extra.durable_failover.failover_rto_ms") == "lower"
+    assert bench_compare.direction("extra.durable_failover.journal_records") is None
+    assert bench_compare.direction("extra.durable_failover.snapshots") is None
+
+    def failover(state_parity=1.0, recovery=1.0, rpo=0):
+        return {"durable_failover": {
+            "tenants_per_sec": 86.0, "failover_rto_ms": 1300.0,
+            "failover_rpo_records": rpo, "replayed_records": 43,
+            "journal_records": 759, "journal_fsyncs": 759, "snapshots": 3,
+            "snapshot_restores": 1, "degraded_syncs": 1, "rank_rejoins": 1,
+            "faults_injected": 11, "recovered_faults": 9, "unrecovered_faults": 0,
+            "failover_state_parity": state_parity, "degraded_sync_parity": 1.0,
+            "recovery_parity": recovery, "soak_recovery_parity": 1.0,
+            "unit": "seeded durable soak",
+        }}
+
+    good = _round(1, 30000.0, extra_overrides=failover())
+    # a torn snapshot / diverged standby: bitwise parity 1.0 -> 0.0 must gate
+    torn = _round(2, 30000.0, extra_overrides=failover(state_parity=0.0))
+    paths = _write_rounds(tmp_path, [good, torn])
+    report = bench_compare.compare_rounds(paths)
+    reg = {r["metric"] for t in report["transitions"] for r in t["rows"] if r["verdict"] == "regression"}
+    assert "extra.durable_failover.failover_state_parity" in reg
+    assert bench_compare.main(paths + ["--check"]) == 1
+    # journal loss against the reference run: recovery_parity gates the same way
+    lost_dir = tmp_path / "lost"
+    lost_dir.mkdir()
+    paths = _write_rounds(lost_dir, [good, _round(2, 30000.0, extra_overrides=failover(recovery=0.0))])
+    assert bench_compare.main(paths + ["--check"]) == 1
+    # identical durable columns ride through clean
+    steady_dir = tmp_path / "steady"
+    steady_dir.mkdir()
+    paths = _write_rounds(steady_dir, [good, _round(2, 30000.0, extra_overrides=failover())])
+    report = bench_compare.compare_rounds(paths)
+    assert report["verdict"] == "ok"
+    assert bench_compare.main(paths + ["--check"]) == 0
+
+
 def test_per_metric_threshold_override():
     prev = bench_compare.extract_metrics(_round(1, 30000.0))
     cur = bench_compare.extract_metrics(_round(2, 27000.0))  # -10%

@@ -113,6 +113,28 @@ def test_trace_rejects_garbage(tmp_path):
         TrafficModel.load_trace(str(cut))
 
 
+def test_artifact_saves_replace_torn_files_atomically(tmp_path):
+    """Both replay artifacts publish via tmp + fsync + os.replace: a torn or
+    garbage file at the destination is atomically replaced, never appended to
+    or half-overwritten, and no tmp staging files survive."""
+    model = TrafficModel(TrafficConfig(seed=2, tenants=4, steps=10))
+    trace = tmp_path / "soak.trace"
+    trace.write_bytes(b"TORN-GARBAGE-FROM-A-CRASHED-WRITER")
+    with pytest.raises(TorchMetricsUserError):
+        TrafficModel.load_trace(str(trace))
+    model.save_trace(str(trace))
+    assert TrafficModel.load_trace(str(trace)).trace_bytes() == model.trace_bytes()
+
+    sched = default_fault_schedule(30)
+    faults = tmp_path / "faults.json"
+    faults.write_text('{"version": 1, "faults": [{"torn')
+    with pytest.raises(TorchMetricsUserError):
+        FaultSchedule.load(str(faults))
+    sched.save(str(faults))
+    assert FaultSchedule.load(str(faults)).specs == sched.specs
+    assert not any(".tmp-" in name for name in os.listdir(tmp_path))
+
+
 def test_traffic_config_validates():
     with pytest.raises(ValueError, match="seed"):
         TrafficConfig(seed=-1)
@@ -188,15 +210,20 @@ def test_soak_recovers_every_fault_kind(soak_pair):
     _, r1, _ = soak_pair
     outcomes = {rec["kind"]: rec["outcome"] for rec in r1.faults}
     assert outcomes == {
+        "rank_loss": "recovered",
         "dispatch_transient": "recovered",
         "tenant_fault": "quarantined",
         "state_poison": "recovered",
         "gather_flaky": "recovered",
         "clock_skew": "recovered",
+        "coordination_outage": "recovered",
     }
     assert r1.counters["unrecovered_faults"] == 0
     assert r1.counters["quarantined_faults"] == 1
-    assert r1.counters["recovered_faults"] >= 4
+    assert r1.counters["recovered_faults"] >= 6
+    assert r1.counters["degraded_syncs"] >= 1
+    assert r1.counters["rank_rejoins"] >= 1
+    assert r1.counters["degraded_sync_parity"] == 1.0
     assert (
         r1.counters["faults_injected"]
         >= r1.counters["recovered_faults"] + r1.counters["quarantined_faults"]
@@ -239,6 +266,25 @@ def test_soak_rejects_out_of_range_schedule():
     )
     with pytest.raises(TorchMetricsUserError, match="step 500"):
         run_soak(cfg)
+
+
+def test_fault_kind_registry_is_coherent():
+    """FAULT_KINDS, the soak's arming table, and its resolution ledger must
+    agree — graftlint's registry family cross-checks them statically, and the
+    live tree must come up clean."""
+    from tools.graftlint.registry import (
+        check_fault_registry,
+        fault_kinds,
+        soak_armed_kinds,
+        soak_resolved_kinds,
+    )
+    from tools.graftlint.runner import build_index
+
+    index = build_index(REPO_ROOT)
+    assert tuple(fault_kinds(index)) == FAULT_KINDS  # declaration order too
+    assert soak_armed_kinds(index) == set(FAULT_KINDS)
+    assert soak_resolved_kinds(index) == set(FAULT_KINDS)
+    assert check_fault_registry(index) == []
 
 
 def test_soak_introduces_no_new_dispatch_tag():

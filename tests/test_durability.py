@@ -1,0 +1,535 @@
+"""Durability & failover plane tests (serving/durability + degraded sync).
+Marker ``durability``.
+
+The load-bearing claims, each pinned:
+
+- **crash consistency**: a snapshot is either bitwise what was written or
+  refused — EVERY kill point (truncation at any byte), bitflip, and stale
+  format version raises ``StateCorruptionError``, never a silent partial
+  load, and the previous generation stays loadable;
+- **the journal contract**: records survive segment rotation in strict seq
+  order, a torn tail on the LAST segment is the bounded-loss crash window
+  (tolerated), while a damaged complete record or a damaged earlier segment
+  is corruption (raises);
+- **restore + replay = the primary, bitwise**: a standby that restores the
+  latest snapshot and replays the journal tail reaches the exact pre-crash
+  engine state — replay is idempotent (seq dedup) and digest-verified;
+- **degraded sync**: a rank lost mid-collective (``DeadRank``) folds over
+  the survivor quorum (no hang, no zero-row fold), revival reconciles as a
+  rejoin with no double-count, and the counters/events tell the story;
+- **kill-and-failover soak**: the chaos plane's mid-run failover drill ends
+  with zero unrecovered faults and both parity gates at 1.0.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import warnings
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from torchmetrics_tpu.aggregation import SumMetric
+from torchmetrics_tpu.classification import MulticlassAccuracy
+from torchmetrics_tpu.observability import telemetry_session
+from torchmetrics_tpu.parallel import AsyncSyncHandle, coalesce as C
+from torchmetrics_tpu.reliability import DeadRank
+from torchmetrics_tpu.serving import (
+    ServingConfig,
+    ServingEngine,
+    SnapshotStore,
+    TrafficJournal,
+    batch_digest,
+)
+from torchmetrics_tpu.serving.durability import SNAPSHOT_MAGIC, _HEADER_LEN_FMT
+from torchmetrics_tpu.utilities.exceptions import (
+    StateCorruptionError,
+    TorchMetricsUserError,
+)
+
+pytestmark = pytest.mark.durability
+
+NUM_CLASSES = 3
+BATCH = 4
+
+
+@pytest.fixture(autouse=True)
+def _clean_liveness():
+    """The degraded-sync plane's tombstone table is process-global; isolate
+    every test from a neighbour's dead ranks."""
+    C.clear_dead_ranks()
+    yield
+    C.clear_dead_ranks()
+
+
+def _acc():
+    return MulticlassAccuracy(num_classes=NUM_CLASSES, average="micro", validate_args=False)
+
+
+def _batch(rng):
+    return (
+        jnp.asarray(rng.normal(size=(BATCH, NUM_CLASSES)).astype(np.float32)),
+        jnp.asarray(rng.integers(0, NUM_CLASSES, BATCH, dtype=np.int32)),
+    )
+
+
+# ------------------------------------------------------------ snapshot store
+
+
+def _sections():
+    return {
+        "a/int": np.arange(12, dtype=np.int64).reshape(3, 4),
+        "b/float": np.linspace(-1.0, 1.0, 7, dtype=np.float32),
+        "c/empty": np.zeros((0, 2), dtype=np.float64),
+    }
+
+
+def test_snapshot_round_trip_and_generations(tmp_path):
+    store = SnapshotStore(str(tmp_path))
+    meta = {"applied_seq": 41, "note": "gen1"}
+    out = store.write(meta, _sections())
+    assert out["generation"] == 1 and out["bytes"] == os.path.getsize(out["path"])
+    store.write({"note": "gen2"}, {"x": np.ones(3)})
+    assert store.generations() == [1, 2]
+    # latest by default
+    m2, s2 = store.read()
+    assert m2 == {"note": "gen2"} and list(s2) == ["x"]
+    # an older generation stays addressable
+    m1, s1 = store.read(generation=1)
+    assert m1 == meta
+    for name, want in _sections().items():
+        np.testing.assert_array_equal(s1[name], want)
+        assert s1[name].dtype == want.dtype
+
+
+def test_snapshot_every_kill_point_refuses_to_load(tmp_path):
+    """Truncation at EVERY byte offset of the container must raise
+    ``StateCorruptionError`` — a torn snapshot never half-loads."""
+    store = SnapshotStore(str(tmp_path / "src"))
+    path = store.write({"k": 1}, _sections())["path"]
+    raw = open(path, "rb").read()
+    offsets = sorted(set(range(0, len(raw), 7)) | {0, 1, len(SNAPSHOT_MAGIC), len(raw) - 1})
+    for i, cut in enumerate(offsets):
+        victim = SnapshotStore(str(tmp_path / f"cut{i}"))
+        with open(victim.path_for(1), "wb") as fh:
+            fh.write(raw[:cut])
+        with pytest.raises(StateCorruptionError):
+            victim.read()
+
+
+def test_snapshot_bitflip_and_stale_version_refuse_to_load(tmp_path):
+    store = SnapshotStore(str(tmp_path / "src"))
+    path = store.write({"k": 1}, _sections())["path"]
+    raw = open(path, "rb").read()
+    hoff = len(SNAPSHOT_MAGIC)
+    (hlen,) = struct.unpack_from(_HEADER_LEN_FMT, raw, hoff)
+    body_at = hoff + struct.calcsize(_HEADER_LEN_FMT) + hlen
+    flips = {
+        "magic": 0,
+        "header": hoff + struct.calcsize(_HEADER_LEN_FMT) + hlen // 2,
+        "payload": body_at + (len(raw) - body_at) // 2,
+    }
+    for i, (label, at) in enumerate(flips.items()):
+        victim = SnapshotStore(str(tmp_path / f"flip-{label}"))
+        damaged = bytearray(raw)
+        damaged[at] ^= 0xFF
+        with open(victim.path_for(1), "wb") as fh:
+            fh.write(bytes(damaged))
+        with pytest.raises(StateCorruptionError):
+            victim.read()
+    # a FUTURE format version is refused, not misdecoded
+    header = json.loads(raw[hoff + struct.calcsize(_HEADER_LEN_FMT) : body_at])
+    header["version"] = 99
+    hb = json.dumps(header, sort_keys=True).encode("utf-8")
+    stale = SNAPSHOT_MAGIC + struct.pack(_HEADER_LEN_FMT, len(hb)) + hb + raw[body_at:]
+    victim = SnapshotStore(str(tmp_path / "stale"))
+    with open(victim.path_for(1), "wb") as fh:
+        fh.write(stale)
+    with pytest.raises(StateCorruptionError, match="version"):
+        victim.read()
+
+
+def test_previous_generation_survives_a_torn_latest(tmp_path):
+    store = SnapshotStore(str(tmp_path))
+    store.write({"note": "good"}, _sections())
+    p2 = store.write({"note": "torn"}, _sections())["path"]
+    raw = open(p2, "rb").read()
+    with open(p2, "wb") as fh:
+        fh.write(raw[: len(raw) // 2])
+    with pytest.raises(StateCorruptionError):
+        store.read()  # latest is torn
+    meta, _ = store.read(generation=1)  # explicit fallback stays intact
+    assert meta == {"note": "good"}
+    assert not any(".tmp-" in n for n in os.listdir(tmp_path))
+
+
+def test_empty_store_is_a_user_error_not_corruption(tmp_path):
+    with pytest.raises(TorchMetricsUserError, match="no snapshot generations"):
+        SnapshotStore(str(tmp_path)).read()
+
+
+# ---------------------------------------------------------------- journal
+
+
+def test_journal_round_trip_rotation_and_fsync_batching(tmp_path):
+    root = str(tmp_path)
+    with TrafficJournal(root, fsync_every=2, segment_records=3) as j:
+        for seq in range(1, 9):
+            j.append(f"tenant-{seq % 3}", f"d{seq}", seq, t=seq * 0.5)
+    assert j.records == 8
+    # fsync batching: 4 size-2 batches; rotation/close flushes ride the same path
+    assert j.fsyncs >= 4
+    segs = [n for n in os.listdir(root) if n.startswith("seg-")]
+    assert len(segs) >= 3  # 8 records at 3/segment rotated at least twice
+    recs = TrafficJournal.read(root)
+    assert [r.seq for r in recs] == list(range(1, 9))
+    assert recs[0].tenant_id == "tenant-1" and recs[0].digest == "d1"
+    assert recs[3].t == 2.0
+    # a fresh instance opens a NEW segment and appends after history
+    with TrafficJournal(root) as j2:
+        j2.append(7, "d9", 9)
+    recs = TrafficJournal.read(root)
+    assert [r.seq for r in recs] == list(range(1, 10))
+    assert recs[-1].tenant_id == 7  # int ids round-trip as ints
+
+
+def test_journal_torn_tail_tolerated_corruption_raises(tmp_path):
+    root = str(tmp_path)
+    with TrafficJournal(root, segment_records=4) as j:
+        for seq in range(1, 7):
+            j.append("t", f"d{seq}", seq)
+    segs = sorted(n for n in os.listdir(root) if n.startswith("seg-"))
+    last = os.path.join(root, segs[-1])
+    raw = open(last, "rb").read()
+    # torn tail on the FINAL segment: bounded loss, reads the intact prefix
+    with open(last, "wb") as fh:
+        fh.write(raw[:-5])
+    recs = TrafficJournal.read(root)
+    assert [r.seq for r in recs] == [1, 2, 3, 4, 5]
+    # a COMPLETE record with a flipped body byte is corruption, not a tail
+    first = os.path.join(root, segs[0])
+    raw0 = bytearray(open(first, "rb").read())
+    raw0[-3] ^= 0x01
+    with open(first, "wb") as fh:
+        fh.write(bytes(raw0))
+    with pytest.raises(StateCorruptionError, match="CRC"):
+        TrafficJournal.read(root)
+
+
+def test_journal_damage_to_a_rotated_segment_raises(tmp_path):
+    root = str(tmp_path)
+    with TrafficJournal(root, segment_records=2) as j:
+        for seq in range(1, 6):
+            j.append("t", f"d{seq}", seq)
+    segs = sorted(n for n in os.listdir(root) if n.startswith("seg-"))
+    assert len(segs) >= 2
+    first = os.path.join(root, segs[0])
+    raw = open(first, "rb").read()
+    with open(first, "wb") as fh:
+        fh.write(raw[:-4])  # truncation NOT on the final segment
+    with pytest.raises(StateCorruptionError, match="truncated"):
+        TrafficJournal.read(root)
+
+
+def test_journal_sequence_regression_raises(tmp_path):
+    root = str(tmp_path)
+    with TrafficJournal(root) as j:
+        j.append("t", "d5", 5)
+        j.append("t", "d3", 3)
+    with pytest.raises(StateCorruptionError, match="regressed"):
+        TrafficJournal.read(root)
+
+
+def test_journal_validates_and_reads_missing_root_as_empty(tmp_path):
+    with pytest.raises(TorchMetricsUserError, match="fsync_every"):
+        TrafficJournal(str(tmp_path), fsync_every=0)
+    assert TrafficJournal.read(str(tmp_path / "never-created")) == []
+
+
+def test_batch_digest_is_content_addressed():
+    rng = np.random.default_rng(3)
+    preds, target = _batch(rng)
+    base = batch_digest((preds, target), {})
+    assert base == batch_digest((jnp.asarray(np.asarray(preds)), target), {})
+    bumped = preds.at[0, 0].add(1.0)
+    assert batch_digest((bumped, target), {}) != base
+    assert batch_digest((preds, target.astype(jnp.float32)), {}) != base
+    assert batch_digest((preds[:2], target[:2]), {}) != base
+
+
+# ----------------------------------------------- engine snapshot + replay
+
+
+def _config(root, **kw):
+    kw.setdefault("capacity", 6)
+    kw.setdefault("megabatch_size", 3)
+    return ServingConfig(journal=os.path.join(root, "journal"), **kw)
+
+
+def test_engine_restore_plus_replay_reaches_bitwise_parity(tmp_path):
+    """The headline recovery contract: kill the primary after a snapshot and
+    more journaled traffic — restore + replay on a cold standby reproduces
+    every tenant's state bit for bit."""
+    root = str(tmp_path)
+    snap_dir = os.path.join(root, "snaps")
+    rng = np.random.default_rng(17)
+    tenants = [f"t{i}" for i in range(8)]  # 8 tenants, capacity 6: spill in play
+    primary = ServingEngine(_acc(), _config(root))
+    retained = {}
+    for step in range(30):
+        b = _batch(rng)
+        assert primary.update(tenants[step % len(tenants)], *b)
+        retained[primary._applied_seq] = ((b[0], b[1]), {})
+        if step == 14:
+            out = primary.snapshot(snap_dir)
+            assert out["generation"] == 1 and out["tenants"] == len(tenants)
+    primary.flush()
+    want = {tid: primary.state_dict(tid) for tid in tenants}
+    want_vals = {tid: float(primary.compute(tid)) for tid in tenants}
+    primary.close()  # the kill point — journal tail is on disk
+
+    standby = ServingEngine(_acc(), _config(root))
+    standby.restore(snap_dir)
+    records = TrafficJournal.read(os.path.join(root, "journal"))
+    replayed = standby.replay_journal(records, lambda r: retained[r.seq])
+    assert replayed == 30 - 15  # everything after the snapshot, exactly once
+    standby.flush()
+    for tid in tenants:
+        got = standby.state_dict(tid)
+        assert sorted(got) == sorted(want[tid])
+        for name, v in want[tid].items():
+            np.testing.assert_array_equal(np.asarray(got[name]), np.asarray(v), err_msg=f"{tid}/{name}")
+        assert float(standby.compute(tid)) == want_vals[tid]
+    # replay is idempotent: a retry applies nothing
+    assert standby.replay_journal(records, lambda r: retained[r.seq]) == 0
+    standby.close()
+
+
+def test_replay_verifies_digests_and_restore_checks_geometry(tmp_path):
+    root = str(tmp_path)
+    snap_dir = os.path.join(root, "snaps")
+    rng = np.random.default_rng(5)
+    engine = ServingEngine(_acc(), _config(root))
+    retained = {}
+    for _ in range(4):
+        b = _batch(rng)
+        engine.update("solo", *b)
+        retained[engine._applied_seq] = ((b[0], b[1]), {})
+    engine.snapshot(snap_dir)
+    engine.close()
+    # geometry mismatch: refuse before touching any state
+    other = ServingEngine(_acc(), ServingConfig(capacity=8, megabatch_size=4))
+    with pytest.raises(TorchMetricsUserError, match="geometry"):
+        other.restore(snap_dir)
+    # a retention buffer that diverged from what the primary admitted
+    standby = ServingEngine(_acc(), _config(root))
+    records = TrafficJournal.read(os.path.join(root, "journal"))
+    wrong = _batch(np.random.default_rng(99))
+    standby._applied_seq = 0  # force every record through the digest check
+    with pytest.raises(StateCorruptionError, match="digest"):
+        standby.replay_journal(records, lambda r: ((wrong[0], wrong[1]), {}))
+    standby.close()
+
+
+def test_journal_requires_json_safe_tenant_ids(tmp_path):
+    engine = ServingEngine(_acc(), _config(str(tmp_path)))
+    rng = np.random.default_rng(0)
+    with pytest.raises(TorchMetricsUserError, match="tenant ids"):
+        engine.update(("tuple", "id"), *_batch(rng))
+    engine.close()
+
+
+# ------------------------------------------------------------ degraded sync
+
+
+def test_dead_rank_survivor_quorum_then_rejoin():
+    """World of 2, rank 1 dead: the coalesced sync folds the survivor only
+    (no hang, no zero-row fold), marks itself degraded, and the revival sync
+    reconciles the rejoin — folding the returned rank exactly once."""
+    dead = DeadRank(world=2, rank=1)
+    m = SumMetric(dist_sync_fn=dead, distributed_available_fn=lambda: True)
+    m.update(jnp.asarray(3.0))
+    with telemetry_session() as rec:
+        m.sync()
+        assert float(m.sum_value) == 3.0  # survivor quorum: local only
+        m.unsync()
+        assert C.dead_ranks() == {1: 1}
+        dead.revive()
+        m.sync()  # the revival sync IS the rejoin reconciliation
+        assert float(m.sum_value) == 6.0  # rejoined mirror folds once
+        m.unsync()
+        assert C.dead_ranks() == {}
+    assert rec.counters.value("degraded_syncs") >= 1
+    assert rec.counters.value("rank_rejoins") >= 1
+    kinds = {e.kind for e in rec.events_of("degraded_sync", "rank_rejoin")}
+    assert kinds == {"degraded_sync", "rank_rejoin"}
+
+
+def test_dead_rank_validates():
+    with pytest.raises(ValueError, match="world"):
+        DeadRank(world=1)
+    with pytest.raises(ValueError, match="rank"):
+        DeadRank(world=2, rank=2)
+
+
+def test_async_handle_reports_degraded_world():
+    dead = DeadRank(world=2, rank=1)
+    handle = AsyncSyncHandle([{"sum_value": jnp.asarray(4.0)}], [{"sum_value": "sum"}], dist_sync_fn=dead)
+    (synced,) = handle.commit()
+    assert float(synced["sum_value"]) == 4.0
+    assert handle.degraded and handle.dead_ranks == C.dead_ranks() != {}
+    dead.revive()
+    handle = AsyncSyncHandle([{"sum_value": jnp.asarray(4.0)}], [{"sum_value": "sum"}], dist_sync_fn=dead)
+    (synced,) = handle.commit()
+    assert float(synced["sum_value"]) == 8.0
+    assert not handle.degraded and handle.dead_ranks == {}
+
+
+def test_liveness_epoch_bumps_monotonically():
+    e0 = C.liveness_epoch()
+    assert C.bump_liveness_epoch() == e0 + 1
+    assert C.liveness_epoch() == e0 + 1
+
+
+# --------------------------------------------------- kill-and-failover soak
+
+
+def test_durable_failover_soak_parity(tmp_path):
+    """The acceptance drill: a seeded soak with rank_loss + coordination_outage
+    scheduled AND a mid-run kill-and-failover — zero unrecovered faults, exact
+    reconciliation, both parity gates at 1.0, RPO zero at fsync_every=1."""
+    from torchmetrics_tpu.chaos import SoakConfig, TrafficConfig, run_soak
+
+    cfg = SoakConfig(
+        traffic=TrafficConfig(
+            seed=7, tenants=12, steps=40, base_rate=3.0, churn_every=14, churn_count=3
+        ),
+        capacity=6,
+        megabatch_size=3,
+        sync_every=10,
+        max_tenants_per_sec=30.0,
+        spill_codec="int8",
+        sync_codec="bf16",
+        durability_dir=str(tmp_path),
+        snapshot_every=12,
+        failover_at=26,
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        r = run_soak(cfg)
+    assert r.counters["unrecovered_faults"] == 0
+    assert r.reconciliation["exact"]
+    assert r.counters["failovers"] == 1
+    assert r.counters["failover_state_parity"] == 1.0
+    assert r.counters["degraded_sync_parity"] == 1.0
+    assert r.counters["failover_rpo_records"] == 0
+    assert r.counters["snapshots"] >= 2 and r.counters["snapshot_restores"] == 1
+    assert r.counters["replayed_records"] > 0
+    assert r.counters["journal_records"] == r.counters["journal_fsyncs"] > 0
+    assert r.counters["degraded_syncs"] >= 1 and r.counters["rank_rejoins"] >= 1
+    assert r.timing["failover_rto_ms"] > 0.0
+    outcomes = {rec["kind"]: rec["outcome"] for rec in r.faults}
+    assert outcomes["rank_loss"] == "recovered"
+    assert outcomes["coordination_outage"] == "recovered"
+
+
+def test_quarantine_transition_survives_failover_replay(tmp_path):
+    """A quarantine AFTER the last snapshot must come back on the standby:
+    the WAL journals the transition (error text + the rolled-back admission
+    seqs) and replay re-applies the flag while skipping the folds the primary
+    rolled back. Without the record, a standby replaying the fault-free
+    journal would fold the very batch the primary refused and come up with
+    the tenant live — state divergence (the regression this pins)."""
+    root = str(tmp_path)
+    snap_dir = os.path.join(root, "snaps")
+    rng = np.random.default_rng(11)
+    tenants = [f"t{i}" for i in range(6)]
+    primary = ServingEngine(_acc(), _config(root, capacity=4, on_error="quarantine"))
+    poison = {"armed": False}
+
+    def hook(tids):
+        if poison["armed"] and "t3" in tids:
+            raise RuntimeError("injected poison for t3")
+
+    primary._fault_hook = hook
+    retained = {}
+    for i in range(40):
+        tid = tenants[i % len(tenants)]
+        if tid == "t3" and primary.tenants().get("t3", {}).get("quarantined"):
+            continue  # the primary refuses a quarantined tenant's traffic
+        b = _batch(rng)
+        assert primary.update(tid, *b)
+        retained[primary._applied_seq] = ((b[0], b[1]), {})
+        if i == 14:
+            primary.snapshot(snap_dir)
+        if i == 16:
+            poison["armed"] = True  # quarantine lands INSIDE the replay window
+        if i == 22:
+            poison["armed"] = False
+    primary.flush()
+    info_p = primary.tenants()
+    assert info_p["t3"]["quarantined"]
+    err_p = primary._tenants["t3"].error
+    live = [t for t in tenants if not info_p[t]["quarantined"]]
+    want = {t: {k: np.asarray(v) for k, v in primary.state_dict(t).items()} for t in live}
+    primary.close()
+
+    records = TrafficJournal.read(os.path.join(root, "journal"))
+    quar = [r for r in records if r.kind == "quarantine"]
+    assert len(quar) == 1 and quar[0].tenant_id == "t3" and quar[0].rolled_back
+
+    standby = ServingEngine(_acc(), _config(root, capacity=4, on_error="quarantine"))
+    standby.restore(snap_dir)
+    replayed = standby.replay_journal(records, lambda r: retained[r.seq])
+    assert replayed > 0
+    standby.flush()
+    info_s = standby.tenants()
+    assert info_s["t3"]["quarantined"]
+    assert info_s["t3"]["update_count"] == info_p["t3"]["update_count"]
+    assert standby._tenants["t3"].error == err_p
+    assert standby.stats["quarantined"] == 1
+    for t in live:
+        assert info_s[t]["update_count"] == info_p[t]["update_count"]
+        got = standby.state_dict(t)
+        for name, v in want[t].items():
+            np.testing.assert_array_equal(np.asarray(got[name]), v, err_msg=f"{t}/{name}")
+    # idempotent: a retried replay applies nothing, quarantine included
+    assert standby.replay_journal(records, lambda r: retained[r.seq]) == 0
+    standby.close()
+
+
+def test_soak_parity_with_quarantine_in_replay_window(tmp_path):
+    """The CLI config that first exposed the missing quarantine record: the
+    tenant_fault quarantine (step 12) lands between the last snapshot (step
+    10) and the kill point (step 16), so the standby can only reach parity by
+    honoring the journaled transition — and must report the quarantine it
+    inherited, not resurrect the tenant."""
+    from torchmetrics_tpu.chaos import SoakConfig, TrafficConfig, run_soak
+
+    cfg = SoakConfig(
+        traffic=TrafficConfig(seed=3, tenants=8, steps=30),
+        capacity=6,
+        megabatch_size=3,
+        spill_codec="int8",
+        max_tenants_per_sec=40.0,
+        durability_dir=str(tmp_path),
+        snapshot_every=10,
+        failover_at=16,
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        r = run_soak(cfg)
+    assert r.counters["unrecovered_faults"] == 0
+    assert r.reconciliation["exact"]
+    assert r.counters["failovers"] == 1
+    assert r.counters["failover_state_parity"] == 1.0
+    assert r.counters["degraded_sync_parity"] == 1.0
+    assert r.counters["failover_rpo_records"] == 0
+    # the standby carries the primary's quarantine across the failover
+    assert r.counters["quarantined_faults"] == 1
+    outcomes = {rec["kind"]: rec["outcome"] for rec in r.faults}
+    assert outcomes["tenant_fault"] == "quarantined"

@@ -87,6 +87,13 @@ THRESHOLDS: Dict[str, float] = {
     "extra.collection_sync_16metrics.time_to_first_update_cold_s": 0.6,
     "extra.collection_sync_16metrics.time_to_first_update_warm_s": 0.6,
     "extra.collection_sync_16metrics.ttfu_warm_speedup_x": 0.5,
+    # durable_failover: RTO is restore+replay wall-clock on a shared pod
+    # (dominated by standby recompiles) — gate order-of-magnitude blowups
+    # only; the parity gates are exact 1.0-or-broken columns
+    "extra.durable_failover.failover_rto_ms": 0.6,
+    "extra.durable_failover.failover_state_parity": 0.01,
+    "extra.durable_failover.recovery_parity": 0.01,
+    "extra.durable_failover.degraded_sync_parity": 0.01,
     # multi-tenant serving engine: throughputs wobble like the flagship on a
     # shared pod; the naive baseline is a denominator like the torch proxy;
     # the spill column is a host<->device copy latency (noisy small values).
@@ -199,7 +206,12 @@ _HIGHER_EXACT = ("value", "vs_baseline", "tenants_per_dispatch",
                  # 1.0-parity gates (zero-unrecovered, counter reconciliation,
                  # same-seed determinism) — any drop is a correctness break
                  "recovered_faults", "soak_recovery_parity",
-                 "reconciliation_parity", "soak_determinism_parity")
+                 "reconciliation_parity", "soak_determinism_parity",
+                 # durable_failover: 1.0-parity gates — standby bitwise-equal
+                 # to the killed primary, failed-over run digest-equal to the
+                 # uninterrupted reference, every rank loss reconciled
+                 "failover_state_parity", "recovery_parity",
+                 "degraded_sync_parity")
 _LOWER_MARKERS = ("latency", "compile", "_sec", "_ms", "_us", "_bytes", "bytes_", "time")
 # collective counts per sync: fewer is the whole point of the coalesced plane —
 # a move back toward per-leaf collectives must gate even though the name
@@ -209,7 +221,10 @@ _LOWER_MARKERS = ("latency", "compile", "_sec", "_ms", "_us", "_bytes", "bytes_"
 _LOWER_EXACT = ("collectives_per_sync", "dual_mem_window_ratio",
                 # production_soak overload shed fraction: deterministic on the
                 # virtual clock — more shedding means admission regressed
-                "shed_rate")
+                "shed_rate",
+                # durable_failover record loss: exactly 0 with fsync-per-record
+                # journaling — any growth is durability regressing
+                "failover_rpo_records")
 # deterministic workload constants: the coalesced-sync config's leaf counts,
 # the warm-start column's program count ("precompiled" would otherwise match
 # the "compile" latency marker and gate a constant), and the serving
@@ -244,7 +259,14 @@ _INFO_EXACT = ("leaves_coalesced_per_sync", "per_leaf_collectives", "ttfu_precom
                # old==0 info-verdict trap on unrecovered_faults), and the SLO
                # breach count rides real-clock windows
                "faults_injected", "quarantined_faults", "unrecovered_faults",
-               "slo_breaches", "spills", "readmissions")
+               "slo_breaches", "spills", "readmissions",
+               # durable_failover workload descriptors: journal/snapshot/replay
+               # volumes and the degraded-sync counts are deterministic
+               # constants of the seeded run — the parity and RPO columns gate
+               # the regressions these would only restate
+               "replayed_records", "journal_records", "journal_fsyncs",
+               "snapshots", "snapshot_restores", "degraded_syncs",
+               "rank_rejoins", "failovers")
 
 
 def direction(name: str) -> Optional[str]:

@@ -13,6 +13,8 @@ Examples::
     python tools/chaos_soak.py --seed 7 --trace /tmp/s7.trace  # record the stream
     python tools/chaos_soak.py --replay /tmp/s7.trace          # byte-for-byte replay
     python tools/chaos_soak.py --seed 7 --faults faults.json   # custom schedule
+    python tools/chaos_soak.py --seed 7 --durability-dir /tmp/dur \
+        --snapshot-every 30 --failover-at 70               # kill + failover, parity-gated
 """
 
 from __future__ import annotations
@@ -48,6 +50,14 @@ def main(argv=None) -> int:
                         help="per-tenant sliding window length (default: forever accumulators)")
     parser.add_argument("--rate", type=float, default=40.0,
                         help="admission limit, tenants/sec on the virtual clock (0 = unlimited)")
+    parser.add_argument("--durability-dir", default=None, metavar="DIR",
+                        help="root for the write-ahead journal and snapshots "
+                             "(required by --snapshot-every/--failover-at)")
+    parser.add_argument("--snapshot-every", type=int, default=None, metavar="N",
+                        help="crash-consistent engine snapshot every N steps")
+    parser.add_argument("--failover-at", type=int, default=None, metavar="STEP",
+                        help="kill the primary at STEP and fail over to a standby "
+                             "(latest snapshot + journal-tail replay, parity-checked)")
     parser.add_argument("--summary", action="store_true",
                         help="print the one-line summary instead of the full JSON report")
     args = parser.parse_args(argv)
@@ -71,6 +81,8 @@ def main(argv=None) -> int:
         written = model.save_trace(args.trace)
         print(f"# trace: {written} bytes -> {args.trace}", file=sys.stderr)
 
+    if (args.snapshot_every or args.failover_at) and not args.durability_dir:
+        parser.error("--snapshot-every/--failover-at need --durability-dir")
     faults = FaultSchedule.load(args.faults) if args.faults else None
     config = SoakConfig(
         traffic=traffic,
@@ -81,6 +93,9 @@ def main(argv=None) -> int:
         sync_codec=args.sync_codec,
         window=args.window,
         max_tenants_per_sec=args.rate or None,
+        durability_dir=args.durability_dir,
+        snapshot_every=args.snapshot_every,
+        failover_at=args.failover_at,
     )
     report = run_soak(config, traffic_model=model)
 
@@ -89,6 +104,11 @@ def main(argv=None) -> int:
     else:
         print(json.dumps(report.to_dict(), indent=2, default=str))
     failed = report.counters["unrecovered_faults"] > 0 or not report.reconciliation["exact"]
+    # the failover parity gate: a standby that is not bitwise the primary fails CI
+    if report.counters.get("failover_state_parity", 1.0) != 1.0:
+        failed = True
+    if report.counters.get("degraded_sync_parity", 1.0) != 1.0:
+        failed = True
     return 1 if failed else 0
 
 

@@ -12,6 +12,12 @@ Dispatch tags: every ``_donation_safe_dispatch(tag, ...)`` call site must use
 a tag registered in ``Metric._aot_program`` — an unregistered tag dispatches
 fine on the happy path but silently loses AOT warm-start (``_aot_program``
 raises when the plane tries to key the cache) and precompile coverage.
+
+Fault kinds: every kind in ``chaos/schedule.py``'s ``FAULT_KINDS`` must have
+an arming branch (``spec.kind == "<kind>"``) AND a ledger resolution
+(``_resolve("<kind>", ...)``) in ``chaos/soak.py`` — a kind the soak cannot
+arm schedules silently as a no-op, and a kind it never resolves leaves a
+permanently-pending ledger entry that close-out mislabels ``not_fired``.
 """
 
 from __future__ import annotations
@@ -62,10 +68,106 @@ def registered_tags(index: PackageIndex) -> Set[str]:
     return tags
 
 
+def fault_kinds(index: PackageIndex) -> Optional[List[str]]:
+    """The literal ``FAULT_KINDS`` tuple from ``chaos/schedule.py``."""
+    for mod in index.modules.values():
+        if not mod.modname.endswith("chaos.schedule"):
+            continue
+        for node in mod.tree.body:
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name) and tgt.id == "FAULT_KINDS":
+                        val = node.value
+                        if isinstance(val, ast.Tuple) and all(
+                            isinstance(e, ast.Constant) and isinstance(e.value, str)
+                            for e in val.elts
+                        ):
+                            return [e.value for e in val.elts]
+                        return None
+    return None
+
+
+def soak_armed_kinds(index: PackageIndex) -> Optional[Set[str]]:
+    """Kinds ``chaos/soak.py`` arms (``spec.kind == "<kind>"`` comparisons)."""
+    for mod in index.modules.values():
+        if not mod.modname.endswith("chaos.soak"):
+            continue
+        kinds: Set[str] = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Compare) and isinstance(node.left, ast.Attribute) \
+                    and node.left.attr == "kind":
+                for comp in node.comparators:
+                    if isinstance(comp, ast.Constant) and isinstance(comp.value, str):
+                        kinds.add(comp.value)
+                    elif isinstance(comp, (ast.Tuple, ast.List, ast.Set)):
+                        for e in comp.elts:
+                            if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                                kinds.add(e.value)
+        return kinds
+    return None
+
+
+def soak_resolved_kinds(index: PackageIndex) -> Optional[Set[str]]:
+    """Kinds ``chaos/soak.py`` resolves (``_resolve("<kind>", ...)`` calls)."""
+    for mod in index.modules.values():
+        if not mod.modname.endswith("chaos.soak"):
+            continue
+        kinds: Set[str] = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+                    and node.func.id == "_resolve" and node.args:
+                first = node.args[0]
+                if isinstance(first, ast.Constant) and isinstance(first.value, str):
+                    kinds.add(first.value)
+        return kinds
+    return None
+
+
+def check_fault_registry(index: PackageIndex) -> List[Finding]:
+    """FAULT_KINDS ↔ soak arming/resolution coherence."""
+    findings: List[Finding] = []
+    sched_path = "torchmetrics_tpu/chaos/schedule.py"
+    soak_path = "torchmetrics_tpu/chaos/soak.py"
+    kinds = fault_kinds(index)
+    armed = soak_armed_kinds(index)
+    resolved = soak_resolved_kinds(index)
+    if kinds is None:
+        findings.append(Finding(
+            "registry/no-fault-kinds", sched_path, "FAULT_KINDS", "unparseable",
+            "could not extract the FAULT_KINDS literal tuple from chaos/schedule.py — "
+            "the fault-kind coherence check is blind"))
+        return findings
+    if armed is None or resolved is None:
+        findings.append(Finding(
+            "registry/no-soak", soak_path, "run_soak", "unparseable",
+            "could not index chaos/soak.py — the fault-kind coherence check is blind"))
+        return findings
+    for kind in kinds:
+        if kind not in armed:
+            findings.append(Finding(
+                "registry/fault-unarmed", soak_path, "run_soak._arm", kind,
+                f"fault kind {kind!r} is in FAULT_KINDS but chaos/soak.py has no "
+                "arming branch (spec.kind == ...) for it — a schedule carrying it "
+                "soaks as a silent no-op"))
+        if kind not in resolved:
+            findings.append(Finding(
+                "registry/fault-unresolved", soak_path, "run_soak", kind,
+                f"fault kind {kind!r} is in FAULT_KINDS but chaos/soak.py never "
+                f"resolves it (_resolve({kind!r}, ...)) — its ledger entry can "
+                "never leave 'pending' and close-out mislabels it 'not_fired'"))
+    for kind in sorted(armed - set(kinds)):
+        findings.append(Finding(
+            "registry/fault-unknown", soak_path, "run_soak._arm", kind,
+            f"chaos/soak.py arms fault kind {kind!r} which is not in FAULT_KINDS — "
+            "FaultSpec validation rejects it, so the branch is dead code"))
+    return findings
+
+
 def check_registry(index: PackageIndex) -> List[Finding]:
     findings: List[Finding] = []
     reserved = reserved_keys(index)
     tags = registered_tags(index)
+    findings.extend(check_fault_registry(index))
 
     if not tags:
         findings.append(Finding(

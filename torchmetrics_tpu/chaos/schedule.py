@@ -33,6 +33,18 @@ clock_skew          the virtual admission clock jumps by ``float(target)``
                     token bucket — the refill formula sees a negative
                     delta); recovered when the first post-skew batch is
                     admitted again.
+rank_loss           the witness's gather seam dies as ``DeadRank``: every
+                    collective row for the simulated peer rank is an
+                    all-zero tombstone. The coalesced plane completes each
+                    sync over the survivor quorum (``degraded_syncs``
+                    counts them); ``count`` sync epochs later the rank
+                    revives — recovered when the rejoin sync reconciles it
+                    (``rank_rejoins``) with zero hangs or double counts.
+coordination_outage the next ``count`` collective calls raise an
+                    UNAVAILABLE coordination-service error BEFORE any
+                    collective is entered (all ranks fail in lockstep);
+                    the retry policy re-enters the sync — recovered when
+                    the sync lands within budget.
 ==================  ==========================================================
 
 Schedules serialize to/from JSON (``to_json``/``from_json``, ``save``/
@@ -53,6 +65,8 @@ FAULT_KINDS = (
     "state_poison",
     "gather_flaky",
     "clock_skew",
+    "rank_loss",
+    "coordination_outage",
 )
 
 
@@ -68,8 +82,9 @@ class FaultSpec:
             name (``state_poison``), skew seconds (``clock_skew``); unused
             otherwise.
         count: kind-specific repetition — failing dispatches
-            (``dispatch_transient``) or failing gather calls
-            (``gather_flaky``).
+            (``dispatch_transient``), failing gather calls
+            (``gather_flaky`` / ``coordination_outage``), or degraded sync
+            epochs before the dead rank revives (``rank_loss``).
     """
 
     step: int
@@ -132,7 +147,11 @@ class FaultSchedule:
 
     @classmethod
     def from_json(cls, text: str) -> "FaultSchedule":
-        doc = json.loads(text)
+        try:
+            doc = json.loads(text)
+        except json.JSONDecodeError as err:
+            # a torn/garbage file must fail cleanly, not leak a decoder error
+            raise TorchMetricsUserError(f"malformed fault schedule: {err}") from err
         entries = doc["faults"] if isinstance(doc, dict) else doc
         try:
             return cls(FaultSpec(**e) for e in entries)
@@ -140,8 +159,23 @@ class FaultSchedule:
             raise TorchMetricsUserError(f"malformed fault schedule: {err}") from err
 
     def save(self, path: str) -> None:
-        with open(path, "w", encoding="utf-8") as fh:
-            fh.write(self.to_json() + "\n")
+        # atomic: a schedule torn by a mid-write crash must never replay as a
+        # plausible-but-wrong fault set (same tmp+fsync+rename discipline as
+        # the AOT cache and the durability snapshot store)
+        import os
+        import uuid
+
+        path = str(path)
+        tmp = f"{path}.tmp-{os.getpid()}-{uuid.uuid4().hex[:8]}"
+        try:
+            with open(tmp, "w", encoding="utf-8") as fh:
+                fh.write(self.to_json() + "\n")
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, path)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
 
     @classmethod
     def load(cls, path: str) -> "FaultSchedule":
@@ -164,10 +198,12 @@ def default_fault_schedule(steps: int, tenant: int = 1) -> FaultSchedule:
         raise ValueError(f"need >= 10 steps to spread the default faults, got {steps}")
     return FaultSchedule(
         [
+            FaultSpec(step=max(1, steps // 10), kind="rank_loss", count=1),
             FaultSpec(step=max(1, steps // 5), kind="dispatch_transient", count=2),
             FaultSpec(step=max(2, (2 * steps) // 5), kind="tenant_fault", target=str(tenant)),
             FaultSpec(step=max(3, steps // 2), kind="state_poison", target="tp"),
             FaultSpec(step=max(4, (3 * steps) // 5), kind="gather_flaky", count=2),
             FaultSpec(step=max(5, (3 * steps) // 4), kind="clock_skew", target="-2.0"),
+            FaultSpec(step=max(6, (7 * steps) // 10), kind="coordination_outage", count=2),
         ]
     )

@@ -37,16 +37,21 @@ Fault accounting (``docs/chaos.md`` has the full table):
 from __future__ import annotations
 
 import dataclasses
+import hashlib
+import os
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax.numpy as jnp
+import numpy as np
 
 from .. import observability as _observability
 from ..classification import MulticlassAccuracy
 from ..observability.slo import SloRule, default_rules
 from ..parallel import SyncConfig
+from ..parallel import coalesce as _coalesce
 from ..reliability import (
+    DeadRank,
     FlakyGather,
     ReliabilityConfig,
     RetryPolicy,
@@ -54,10 +59,10 @@ from ..reliability import (
     poison_state_leaf,
     validate_state,
 )
-from ..serving import ServingConfig, ServingEngine
+from ..serving import ServingConfig, ServingEngine, TrafficJournal
 from ..streaming import DriftMonitor, SlidingWindow
 from ..utilities.exceptions import StateCorruptionError, TorchMetricsUserError
-from .schedule import FaultSchedule, FaultSpec, default_fault_schedule
+from .schedule import FAULT_KINDS, FaultSchedule, FaultSpec, default_fault_schedule
 from .traffic import TrafficConfig, TrafficModel
 
 
@@ -90,8 +95,21 @@ class SoakConfig:
             the CPU soak fast without changing the engine path).
         drift_reference / drift_test: DriftMonitor window geometry.
         shed_rate_max: threshold for the ``soak_shed_rate`` SLO rule.
-        retry_attempts: witness sync retry budget (the ``gather_flaky``
-            recovery headroom).
+        retry_attempts: witness sync retry budget (the ``gather_flaky`` /
+            ``coordination_outage`` recovery headroom).
+        durability_dir: root directory for the durability plane — the
+            engine's write-ahead journal lives in ``<dir>/journal`` and
+            crash-consistent snapshots in ``<dir>/snapshots``. Required
+            when ``snapshot_every`` or ``failover_at`` is set.
+        snapshot_every: snapshot the engine every N traffic steps (the
+            standby's restore point).
+        failover_at: at this step the primary engine is KILLED and a cold
+            standby takes over: restore the latest snapshot, replay the
+            journal tail against the retained batches, and verify bitwise
+            state parity against the pre-kill primary. ``timing`` gains
+            ``failover_rto_ms``; ``counters`` gain the replay/parity block.
+        journal_fsync_every: fsync cadence of the write-ahead journal
+            (1 = every record, the RPO=0 setting the parity gate assumes).
     """
 
     traffic: TrafficConfig = dataclasses.field(default_factory=TrafficConfig)
@@ -110,10 +128,22 @@ class SoakConfig:
     drift_test: int = 16
     shed_rate_max: float = 0.5
     retry_attempts: int = 5
+    durability_dir: Optional[str] = None
+    snapshot_every: Optional[int] = None
+    failover_at: Optional[int] = None
+    journal_fsync_every: int = 1
 
     def __post_init__(self) -> None:
         if self.sync_every < 1:
             raise ValueError(f"sync_every must be >= 1, got {self.sync_every}")
+        if self.snapshot_every is not None and self.snapshot_every < 1:
+            raise ValueError(f"snapshot_every must be >= 1, got {self.snapshot_every}")
+        if self.failover_at is not None and self.failover_at < 1:
+            raise ValueError(f"failover_at must be >= 1, got {self.failover_at}")
+        if (self.snapshot_every is not None or self.failover_at is not None) and not self.durability_dir:
+            raise ValueError("snapshot_every/failover_at need durability_dir")
+        if self.journal_fsync_every < 1:
+            raise ValueError(f"journal_fsync_every must be >= 1, got {self.journal_fsync_every}")
         if self.seconds_per_step <= 0:
             raise ValueError(f"seconds_per_step must be > 0, got {self.seconds_per_step}")
         if self.side_channel_every < 1:
@@ -223,17 +253,38 @@ class _ChaosHook:
 
 
 class _WitnessGather:
-    """World-of-one gather for the witness sync, with a ``FlakyGather``
-    armed over it while a ``gather_flaky`` fault is live."""
+    """World-of-one gather for the witness sync, with the schedule's
+    collective faults layered over it:
+
+    - ``arm(n)`` — a ``FlakyGather`` drops a participant on the next ``n``
+      calls (``gather_flaky``);
+    - ``arm_outage(n)`` — a second ``FlakyGather`` raises an UNAVAILABLE
+      coordination-service error on the next ``n`` calls
+      (``coordination_outage``);
+    - ``arm_dead_rank()`` — every collective runs through a
+      :class:`~torchmetrics_tpu.reliability.DeadRank` world-of-two whose
+      peer rank is tombstoned until :meth:`revive_rank` — the coalesced
+      plane's degraded-quorum path, not a raise.
+
+    Layering order on a call: flaky raise, then outage raise, then the
+    (possibly dead-rank-widened) collective.
+    """
 
     def __init__(self) -> None:
         self._flaky: Optional[FlakyGather] = None
+        self._outage: Optional[FlakyGather] = None
+        self._dead: Optional[DeadRank] = None
 
     def base(self, value: Any, group: Any = None) -> List[Any]:
         return [jnp.asarray(value)]
 
+    def _inner(self, value: Any, group: Any = None) -> List[Any]:
+        if self._dead is not None:
+            return self._dead(value, group)
+        return self.base(value, group)
+
     def arm(self, fail_times: int) -> None:
-        self._flaky = FlakyGather(inner=self.base, fail_times=fail_times)
+        self._flaky = FlakyGather(inner=self._inner, fail_times=fail_times)
 
     @property
     def armed_failures(self) -> int:
@@ -242,10 +293,38 @@ class _WitnessGather:
     def disarm(self) -> None:
         self._flaky = None
 
+    def arm_outage(self, fail_times: int) -> None:
+        self._outage = FlakyGather(
+            inner=self._inner,
+            fail_times=fail_times,
+            exc_factory=lambda: make_transient_error(
+                "UNAVAILABLE: coordination service unreachable during collective setup"
+            ),
+        )
+
+    @property
+    def outage_failures(self) -> int:
+        return self._outage.failures if self._outage is not None else 0
+
+    def disarm_outage(self) -> None:
+        self._outage = None
+
+    def arm_dead_rank(self) -> None:
+        self._dead = DeadRank(inner=self.base, world=2, rank=1)
+
+    def revive_rank(self) -> None:
+        if self._dead is not None:
+            self._dead.revive()
+
+    def disarm_dead_rank(self) -> None:
+        self._dead = None
+
     def __call__(self, value: Any, group: Any = None) -> List[Any]:
-        if self._flaky is not None:
-            return self._flaky(value, group)
-        return self.base(value, group)
+        if self._flaky is not None and self._flaky.failures < self._flaky.fail_times:
+            return self._flaky(value, group)  # raises (participant drop)
+        if self._outage is not None and self._outage.failures < self._outage.fail_times:
+            return self._outage(value, group)  # raises (coordination outage)
+        return self._inner(value, group)
 
 
 def _metric(num_classes: int, reliability: Optional[ReliabilityConfig] = None) -> MulticlassAccuracy:
@@ -253,6 +332,30 @@ def _metric(num_classes: int, reliability: Optional[ReliabilityConfig] = None) -
         num_classes=num_classes, average="micro", validate_args=False,
         reliability=reliability,
     )
+
+
+def _engine_digest(engine: ServingEngine) -> str:
+    """Canonical digest of the whole engine's tenant state — id, quarantine
+    flag, update count, and every state leaf's exact bytes, in sorted tenant
+    order. Two engines with equal digests are bitwise-identical as far as
+    any tenant read can tell; the failover parity gate compares these."""
+    h = hashlib.sha256()
+    roster = engine.tenants()
+    for tid in sorted(roster, key=repr):
+        info = roster[tid]
+        h.update(f"{tid!r}|{info['quarantined']}|{info['update_count']}".encode("utf-8"))
+        if info["quarantined"]:
+            continue  # a quarantined tenant's state is frozen garbage by contract
+        state = engine.state_dict(tid)
+        for name in sorted(state):
+            if name.startswith("_"):
+                continue
+            arr = np.asarray(state[name])
+            h.update(name.encode("utf-8"))
+            h.update(str(arr.dtype).encode("utf-8"))
+            h.update(str(arr.shape).encode("utf-8"))
+            h.update(arr.tobytes())
+    return h.hexdigest()
 
 
 def run_soak(
@@ -272,10 +375,13 @@ def run_soak(
             f"runs only {traffic.steps} steps."
         )
 
+    _coalesce.clear_dead_ranks()  # liveness ledger is process-global — fresh run, fresh ledger
+    journal_dir = os.path.join(cfg.durability_dir, "journal") if cfg.durability_dir else None
+    snap_dir = os.path.join(cfg.durability_dir, "snapshots") if cfg.durability_dir else None
     clock = {"t": 0.0}
-    engine = ServingEngine(
-        _metric(traffic.num_classes),
-        ServingConfig(
+
+    def _serving_config() -> ServingConfig:
+        return ServingConfig(
             capacity=cfg.capacity,
             megabatch_size=cfg.megabatch_size,
             spill=True,
@@ -285,8 +391,11 @@ def run_soak(
             clock=lambda: clock["t"],
             window=cfg.window,
             aot_cache_dir=cfg.aot_cache_dir,
-        ),
-    )
+            journal=journal_dir,
+            journal_fsync_every=cfg.journal_fsync_every,
+        )
+
+    engine = ServingEngine(_metric(traffic.num_classes), _serving_config())
     hook = _ChaosHook()
     engine._fault_hook = hook
     gather = _WitnessGather()
@@ -314,13 +423,20 @@ def run_soak(
 
     # fault ledger: per-spec records resolved as recoveries land (FIFO per kind)
     records: List[Dict[str, Any]] = []
-    pending: Dict[str, List[Dict[str, Any]]] = {k: [] for k in (
-        "dispatch_transient", "tenant_fault", "state_poison", "gather_flaky", "clock_skew",
-    )}
+    pending: Dict[str, List[Dict[str, Any]]] = {k: [] for k in FAULT_KINDS}
     recovered = 0
     unrecovered = 0
     skew_pending = 0
     armed_poisons = 0
+    # rank_loss staged recovery: N degraded sync epochs, revive, then the
+    # rejoin sync reconciles — tracked via the degraded_syncs/rank_rejoins
+    # counter deltas each epoch
+    dead_epochs_left = 0
+    awaiting_rejoin = False
+    # retained admitted batches keyed by journal seq — the failover standby's
+    # replay source (pruned at every snapshot: covered seqs never replay)
+    retained: Dict[int, Tuple[tuple, dict]] = {}
+    failover_info: Dict[str, Any] = {}
     epochs = 0
     slo_breaches: List[Dict[str, Any]] = []
     quarantined_tids: set = set()
@@ -331,7 +447,7 @@ def run_soak(
     events_total = 0
 
     def _arm(spec: FaultSpec) -> None:
-        nonlocal skew_pending, armed_poisons
+        nonlocal skew_pending, armed_poisons, dead_epochs_left, awaiting_rejoin
         rec = {
             "step": spec.step, "kind": spec.kind, "target": spec.target,
             "count": spec.count, "outcome": "pending",
@@ -350,6 +466,12 @@ def run_soak(
         elif spec.kind == "clock_skew":
             clock["t"] += float(spec.target)  # type: ignore[arg-type]
             skew_pending += 1
+        elif spec.kind == "rank_loss":
+            gather.arm_dead_rank()
+            dead_epochs_left = spec.count
+            awaiting_rejoin = False
+        elif spec.kind == "coordination_outage":
+            gather.arm_outage(spec.count)
 
     def _resolve(kind: str, outcome: str, n: int = 1) -> None:
         for _ in range(n):
@@ -358,8 +480,12 @@ def run_soak(
 
     def _sync_epoch() -> None:
         nonlocal recovered, unrecovered, armed_poisons, epochs
+        nonlocal dead_epochs_left, awaiting_rejoin
         epochs += 1
         engine.flush()
+        act = _observability._ACTIVE
+        deg0 = act.counters.value("degraded_syncs") if act is not None else 0
+        rej0 = act.counters.value("rank_rejoins") if act is not None else 0
         # 1. witness integrity: an armed poison MUST be caught here
         try:
             validate_state(witness, context=f"soak epoch {epochs}")
@@ -371,7 +497,8 @@ def run_soak(
                 armed_poisons = 0
             else:
                 unrecovered += 1
-        # 2. witness sync through the (possibly flaky) gather, retry armed
+        # 2. witness sync through the (possibly flaky/dead-rank) gather,
+        # retry armed
         try:
             witness.sync(
                 dist_sync_fn=gather,
@@ -383,10 +510,30 @@ def run_soak(
                 recovered += gather.armed_failures
                 _resolve("gather_flaky", "recovered")
             gather.disarm()
+            if gather.outage_failures:
+                recovered += gather.outage_failures
+                _resolve("coordination_outage", "recovered")
+            gather.disarm_outage()
+            # rank_loss staged flow: each degraded epoch ticks the countdown;
+            # at zero the rank revives, and the NEXT sync's rejoin resolves it
+            if awaiting_rejoin:
+                if act is not None and act.counters.value("rank_rejoins") > rej0:
+                    recovered += 1
+                    _resolve("rank_loss", "recovered")
+                    awaiting_rejoin = False
+                    gather.disarm_dead_rank()
+            elif dead_epochs_left > 0:
+                if act is not None and act.counters.value("degraded_syncs") > deg0:
+                    dead_epochs_left -= 1
+                    if dead_epochs_left == 0:
+                        gather.revive_rank()
+                        awaiting_rejoin = True
         except Exception:  # noqa: BLE001 — an escaped sync is an unrecovered fault
             unrecovered += 1
             _resolve("gather_flaky", "unrecovered")
+            _resolve("coordination_outage", "unrecovered")
             gather.disarm()
+            gather.disarm_outage()
         # 3. engine read side: async stacked sync (plain engines) or the
         # windowed per-tenant read (sync_async rejects windowed stacks)
         if cfg.window is None:
@@ -411,6 +558,53 @@ def run_soak(
             tid for tid, info in engine.tenants().items() if info["quarantined"]
         )
 
+    def _snapshot() -> None:
+        info = engine.snapshot(snap_dir)
+        failover_info["snapshots"] = failover_info.get("snapshots", 0) + 1
+        failover_info["last_generation"] = info["generation"]
+        # everything the snapshot covers never replays — prune the retention
+        # buffer so its footprint is one snapshot interval, not the whole run
+        cutoff = engine._applied_seq
+        for seq in [s for s in retained if s <= cutoff]:
+            del retained[seq]
+
+    def _failover() -> None:
+        """Kill the primary, bring up a cold standby from the latest snapshot
+        plus the journal tail, and verify bitwise state parity."""
+        nonlocal engine
+        # parity reference: the primary's exact pre-kill state (flush first so
+        # queued megabatches land — the journal already holds their admissions)
+        engine.flush()
+        pre_digest = _engine_digest(engine)
+        pre_seq = engine._applied_seq  # the last admission the primary applied
+        engine.close()  # the kill point: after the last durable journal write
+        # ---- the primary is dead from here on ----
+        t_rto = time.perf_counter()
+        standby = ServingEngine(_metric(traffic.num_classes), _serving_config())
+        standby._fault_hook = hook
+        if failover_info.get("snapshots"):
+            standby.restore(snap_dir)
+        # with no snapshot yet the standby replays the journal from scratch
+        replayed = standby.replay_journal(
+            TrafficJournal.read(journal_dir), lambda r: retained[r.seq],
+        )
+        standby.flush()
+        rto_ms = (time.perf_counter() - t_rto) * 1000.0
+        post_digest = _engine_digest(standby)
+        engine = standby
+        _refresh_quarantined()
+        failover_info.update(
+            failovers=failover_info.get("failovers", 0) + 1,
+            rto_ms=round(rto_ms, 3),
+            replayed=replayed,
+            # RPO in records: admissions the primary applied that the standby
+            # could not reconstruct (0 with fsync-per-record journaling)
+            rpo_records=max(0, pre_seq - standby._applied_seq),
+            state_parity=1.0 if post_digest == pre_digest else 0.0,
+            pre_digest=pre_digest,
+            post_digest=post_digest,
+        )
+
     t0 = time.perf_counter()
     with _observability.telemetry_session(
         _observability.TelemetryConfig(
@@ -424,6 +618,10 @@ def run_soak(
                 clock["t"] += cfg.seconds_per_step
                 for spec in faults.due(current_step):
                     _arm(spec)
+                if cfg.snapshot_every and current_step and current_step % cfg.snapshot_every == 0:
+                    _snapshot()
+                if cfg.failover_at is not None and current_step == cfg.failover_at:
+                    _failover()
                 if current_step and current_step % cfg.sync_every == 0:
                     _sync_epoch()
             events_total += 1
@@ -438,6 +636,9 @@ def run_soak(
                 ok = False
             if ok:
                 admitted += 1
+                if engine._journal is not None:
+                    # the standby's replay source for this journaled admission
+                    retained[engine._applied_seq] = ((ev.batch[0], ev.batch[1]), {})
                 if skew_pending:
                     # service admitted again after the jump: skew absorbed
                     recovered += skew_pending
@@ -457,6 +658,10 @@ def run_soak(
             clock["t"] += cfg.seconds_per_step
             for spec in faults.due(current_step):
                 _arm(spec)
+            if cfg.snapshot_every and current_step and current_step % cfg.snapshot_every == 0:
+                _snapshot()
+            if cfg.failover_at is not None and current_step == cfg.failover_at:
+                _failover()
             if current_step and current_step % cfg.sync_every == 0:
                 _sync_epoch()
         _sync_epoch()  # the closing epoch: catches late poisons/flaky syncs
@@ -473,6 +678,11 @@ def run_soak(
                 consumed -= r["count"]
                 _resolve("dispatch_transient", "recovered")
         _resolve("tenant_fault", "quarantined", hook.tenant_contained)
+        # a rank_loss that armed but never reconciled (rejoin sync never came)
+        # is unrecovered — every other still-pending spec simply never fired
+        for r in list(pending["rank_loss"]):
+            unrecovered += 1
+            _resolve("rank_loss", "unrecovered")
         for kind_pending in pending.values():
             for r in kind_pending:
                 if r["outcome"] == "pending":
@@ -480,8 +690,11 @@ def run_soak(
         quarantined_faults = engine.stats["quarantined"]
         injected = (
             hook.transient_raised + hook.tenant_raised + sum(
-                1 for r in records if r["kind"] in ("state_poison", "clock_skew")
-            ) + sum(r["count"] for r in records if r["kind"] == "gather_flaky")
+                1 for r in records if r["kind"] in ("state_poison", "clock_skew", "rank_loss")
+            ) + sum(
+                r["count"] for r in records
+                if r["kind"] in ("gather_flaky", "coordination_outage")
+            )
         )
 
         snap = rec.counters.snapshot().counts
@@ -501,6 +714,16 @@ def run_soak(
         update_kind = "vwupdate" if cfg.window is not None else "vupdate"
         kind_lat = lat.get(update_kind) or {}
 
+    final_digest = _engine_digest(engine)
+    engine.close()  # release the journal segment cleanly
+    # degraded-sync reconciliation: every scheduled rank loss recovered AND
+    # the liveness ledger drained (no rank still marked dead at run end)
+    rank_loss_ok = all(
+        r["outcome"] in ("recovered", "not_fired")
+        for r in records if r["kind"] == "rank_loss"
+    )
+    degraded_parity = 1.0 if rank_loss_ok and not _coalesce.dead_ranks() else 0.0
+
     stats = dict(engine.stats)
     stats.pop("spill_ns", None)  # wall-clock — outside the determinism contract
     served = admitted
@@ -519,13 +742,28 @@ def run_soak(
         "recovered_faults": recovered,
         "quarantined_faults": quarantined_faults,
         "unrecovered_faults": unrecovered,
+        "degraded_syncs": int(snap.get("degraded_syncs", 0)),
+        "rank_rejoins": int(snap.get("rank_rejoins", 0)),
+        "degraded_sync_parity": degraded_parity,
         **{f"engine_{k}": int(v) for k, v in stats.items()},
     }
+    if cfg.durability_dir:
+        counters.update({
+            "journal_records": int(snap.get("journal_records", 0)),
+            "journal_fsyncs": int(snap.get("journal_fsyncs", 0)),
+            "snapshots": int(snap.get("snapshots", 0)),
+            "snapshot_restores": int(snap.get("snapshot_restores", 0)),
+            "replayed_records": int(failover_info.get("replayed", 0)),
+            "failovers": int(failover_info.get("failovers", 0)),
+            "failover_rpo_records": int(failover_info.get("rpo_records", 0)),
+            "failover_state_parity": float(failover_info.get("state_parity", 1.0)),
+        })
     timing = {
         "elapsed_s": round(elapsed, 6),
         "tenants_per_sec": round(stats["tenant_rows"] / max(elapsed, 1e-9), 3),
         "update_p50_us": float(kind_lat.get("p50_us", 0.0)),
         "update_p99_us": float(kind_lat.get("p99_us", 0.0)),
+        "failover_rto_ms": float(failover_info.get("rto_ms", 0.0)),
     }
     return SoakReport(
         counters=counters,
@@ -544,5 +782,8 @@ def run_soak(
             "megabatch_size": cfg.megabatch_size,
             "faults": len(faults),
             "replayed": model.replayed,
+            "snapshot_every": cfg.snapshot_every,
+            "failover_at": cfg.failover_at,
+            "state_digest": final_digest,
         },
     )

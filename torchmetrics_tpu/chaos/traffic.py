@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
 import struct
 from typing import Iterator, List, Optional, Tuple
 
@@ -250,10 +251,26 @@ class TrafficModel:
         return bytes(out)
 
     def save_trace(self, path: str) -> int:
-        """Write the trace file; returns bytes written."""
+        """Write the trace file atomically; returns bytes written.
+
+        tmp + fsync + ``os.replace``: a crash mid-write leaves either the
+        previous trace or none — never a torn file that ``load_trace`` would
+        half-parse into a silently different replay.
+        """
+        import uuid
+
         payload = self.trace_bytes()
-        with open(path, "wb") as fh:
-            fh.write(payload)
+        path = str(path)
+        tmp = f"{path}.tmp-{os.getpid()}-{uuid.uuid4().hex[:8]}"
+        try:
+            with open(tmp, "wb") as fh:
+                fh.write(payload)
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, path)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
         return len(payload)
 
     @classmethod

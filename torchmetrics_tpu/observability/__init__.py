@@ -560,6 +560,52 @@ class TelemetryRecorder:
             payload={"tenant": repr(tenant_id)[:80]},
         )
 
+    def record_snapshot(
+        self, metric: Any, op: str, duration_s: float, nbytes: int, generation: int
+    ) -> None:
+        """One durability-plane snapshot ``op`` (``"write"`` or
+        ``"restore"``) of a serving engine: the whole-fleet state landed in
+        (or loaded from) one content-addressed generation."""
+        name = self._metric_name(metric)
+        self.counters.record_snapshot(restore=(op == "restore"))
+        self._event(
+            "snapshot", name, op,
+            duration_s=duration_s,
+            payload={"bytes": int(nbytes), "generation": int(generation)},
+        )
+
+    def record_journal_replay(self, metric: Any, records: int, duration_s: float) -> None:
+        """``records`` write-ahead journal entries rolled forward into a
+        restored engine (the failover tail between the snapshot point and the
+        crash)."""
+        name = self._metric_name(metric)
+        self.counters.record_journal_replay(records)
+        self._event(
+            "journal", name, "replay",
+            duration_s=duration_s,
+            payload={"records": int(records)},
+        )
+
+    def record_degraded_sync(self, label: str, dead: Any, world: int) -> None:
+        """One coalesced sync that completed over a survivor quorum: the
+        ranks in ``dead`` presented tombstone metadata rows, the fold covered
+        the survivors, and the sync is marked degraded instead of hanging."""
+        self.counters.record_degraded_sync()
+        self._event(
+            "degraded_sync", label, "quorum",
+            payload={"dead": [int(r) for r in dead], "world": int(world)},
+        )
+
+    def record_rank_rejoin(self, label: str, rank: int, epoch: int) -> None:
+        """A previously dead rank presented a live metadata row again — its
+        accumulated contribution folds back in on this sync (full-state
+        gather: reconciliation without double counting)."""
+        self.counters.record_rank_rejoin()
+        self._event(
+            "rank_rejoin", label, "rejoin",
+            payload={"rank": int(rank), "epoch": int(epoch)},
+        )
+
     def record_d2h(self, site: str, nbytes: int, metric: Any = None) -> None:
         """An instrumented device→host readback (``state_dict``,
         ``compute_on_cpu`` appends, finiteness guards). The hot loop's

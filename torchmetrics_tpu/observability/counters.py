@@ -62,6 +62,13 @@ COUNTER_FIELDS: Tuple[str, ...] = (
     "drift_evals",  # DriftMonitor window-vs-reference evaluations
     "drift_breaches",  # evaluations whose drift score crossed the monitor's threshold
     "serve_rejected",  # tenant batches shed by the serving admission rate limit
+    "snapshots",  # crash-consistent engine snapshot generations written (durability plane)
+    "snapshot_restores",  # engine restores from a snapshot generation
+    "journal_records",  # batches appended to the write-ahead traffic journal
+    "journal_fsyncs",  # journal appends that reached stable storage (fsync batches)
+    "replayed_records",  # journal records rolled forward into a restored engine
+    "degraded_syncs",  # coalesced syncs completed over a survivor quorum (dead rank seen)
+    "rank_rejoins",  # previously dead ranks whose contribution reconciled on rejoin
 )
 
 
@@ -400,6 +407,37 @@ class Counters:
         """One tenant batch shed by the serving admission rate limit."""
         with self._lock:
             self._counts["serve_rejected"] += 1
+
+    def record_snapshot(self, restore: bool = False) -> None:
+        """One crash-consistent engine snapshot written (``restore=True``:
+        one engine restored from a generation instead)."""
+        with self._lock:
+            self._counts["snapshot_restores" if restore else "snapshots"] += 1
+
+    def record_journal_append(self, fsynced: bool) -> None:
+        """One batch appended to the write-ahead journal; ``fsynced`` marks
+        the appends that closed an fsync batch (stable-storage boundary)."""
+        with self._lock:
+            self._counts["journal_records"] += 1
+            if fsynced:
+                self._counts["journal_fsyncs"] += 1
+
+    def record_journal_replay(self, records: int) -> None:
+        """``records`` journal entries rolled forward into a restored engine."""
+        with self._lock:
+            self._counts["replayed_records"] += int(records)
+
+    def record_degraded_sync(self) -> None:
+        """One coalesced sync that completed over a survivor quorum because a
+        rank presented a dead (all-zero) metadata row."""
+        with self._lock:
+            self._counts["degraded_syncs"] += 1
+
+    def record_rank_rejoin(self) -> None:
+        """One previously dead rank seen alive again — its accumulated state
+        folds back in on this very sync (full-state gather, no double count)."""
+        with self._lock:
+            self._counts["rank_rejoins"] += 1
 
     # --------------------------------------------------------------- querying
 

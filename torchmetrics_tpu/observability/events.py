@@ -43,6 +43,10 @@ EVENT_KINDS: Tuple[str, ...] = (
     "async_sync",  # a double-buffered background sync committed (overlap accounting)
     "serve_rejected",  # a tenant batch shed by the serving admission rate limit
     "quant",  # a coalesced sync shipped quantized buckets (compression accounting)
+    "snapshot",  # a crash-consistent engine snapshot written or restored (durability plane)
+    "journal",  # write-ahead journal records replayed into a restored engine
+    "degraded_sync",  # a coalesced sync completed over a survivor quorum (dead rank)
+    "rank_rejoin",  # a previously dead rank reconciled back into the coalesced sync
 )
 
 

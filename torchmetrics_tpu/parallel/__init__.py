@@ -2,6 +2,7 @@ from . import coalesce, quantize
 from .async_sync import AsyncSyncHandle
 from .coalesce import (
     CoalesceFallback,
+    clear_dead_ranks,
     coalesced_process_sync,
     collective_counts,
     quantized_payload_model,
@@ -37,6 +38,7 @@ __all__ = [
     "SyncConfig",
     "batch_sharding",
     "coalesce",
+    "clear_dead_ranks",
     "coalesced_process_sync",
     "collective_counts",
     "distributed_available",

@@ -112,6 +112,7 @@ class AsyncSyncHandle:
         self._wait_s = 0.0
         self._collectives = 0
         self._fallback = False
+        self._dead_ranks: Dict[int, int] = {}
         self._committed = False
         self._done = threading.Event()
         self._payload_bytes = sum(_payload_bytes(s) for s in self._states)
@@ -157,6 +158,9 @@ class AsyncSyncHandle:
                 self._result = self._attempt()
             else:
                 self._result = self._retry.call(self._attempt, describe=self.label)
+            # the coalesced plane's liveness ledger at commit time: non-empty
+            # means this gather completed over a survivor quorum (degraded)
+            self._dead_ranks = dict(_coalesce.dead_ranks())
             if rec is not None:
                 # one successful sync entry, mirroring the blocking planes
                 rec.counters.record_sync(self._payload_bytes)
@@ -203,6 +207,21 @@ class AsyncSyncHandle:
     @property
     def used_fallback(self) -> bool:
         return self._fallback
+
+    @property
+    def degraded(self) -> bool:
+        """Whether this handle's gather completed over a survivor quorum —
+        one or more ranks were dead (all-zero tombstone rows) when the
+        coalesced collective ran. The synced states are still valid: they
+        fold the survivors only, and the missing contribution reconciles
+        when the rank rejoins."""
+        return bool(self._dead_ranks)
+
+    @property
+    def dead_ranks(self) -> Dict[int, int]:
+        """Rank → consecutive-degraded-sync count observed at gather time
+        (a snapshot of :func:`~torchmetrics_tpu.parallel.coalesce.dead_ranks`)."""
+        return dict(self._dead_ranks)
 
     def wait(self, timeout: Optional[float] = None) -> bool:
         """Block until the background gather finishes (no install)."""

@@ -93,8 +93,14 @@ _MAGIC = 0x436F414C  # "CoAL"
 # codec code in its upper bits (kind = slot & 1, codec = slot >> 1), and a
 # quant section (per-bucket block-scale records, parallel/quantize.py) rides
 # the metadata tail when the caller passed an enabled SyncConfig
-_VERSION = 7
-_HEADER_LEN = 4  # [magic, version, n_leaves, n_counter_fields]
+# v8: durability plane — the header grew a per-rank liveness/epoch slot pair
+# (alive flag + liveness epoch) and the counter vector the snapshot/journal/
+# degraded-sync fields. An ALL-ZERO metadata row is a rank tombstone (a rank
+# that died mid-collective contributes zeros to the gather): the plan marks
+# it dead, the bucket folds cover the survivor quorum, and the sync completes
+# degraded instead of hanging or folding the zero row as data
+_VERSION = 8
+_HEADER_LEN = 6  # [magic, version, n_leaves, n_counter_fields, alive, epoch]
 _LEAF_REC_LEN = 2 + _MAX_RANK + 1  # [dtype_code, ndim, d0..d7, kind|codec<<1]
 _KIND_TENSOR = 0
 _KIND_LIST = 1
@@ -112,6 +118,46 @@ class CoalesceFallback(Exception):
     """Internal control flow: the gathered metadata could not be decoded into a
     consistent world plan — the caller must re-run the per-leaf plane. Never
     raised for transient infra errors (those propagate to the retry layer)."""
+
+
+# ---------------------------------------------------------------------------
+# rank liveness (durability plane)
+# ---------------------------------------------------------------------------
+
+# this process's liveness epoch, announced in every metadata row. A process
+# that restarts (warm-standby failover) bumps it, so peers can tell a rejoin
+# from a rank that never died.
+_LIVENESS: Dict[str, int] = {"epoch": 1}
+# rank index -> consecutive degraded syncs it has been seen dead for. A rank
+# present here whose metadata row comes back alive is a REJOIN: its
+# accumulated state folds into that very sync (full-state gather), so
+# reconciliation needs no transfer of missed deltas and can never double
+# count — the fold always covers each survivor's total accumulator exactly
+# once.
+_DEAD_RANKS: Dict[int, int] = {}
+
+
+def liveness_epoch() -> int:
+    """This process's current liveness epoch (starts at 1)."""
+    return _LIVENESS["epoch"]
+
+
+def bump_liveness_epoch() -> int:
+    """Announce a fresh liveness epoch (a restarted / failed-over process
+    calls this so peers see its rows as a NEW incarnation)."""
+    _LIVENESS["epoch"] += 1
+    return _LIVENESS["epoch"]
+
+
+def dead_ranks() -> Dict[int, int]:
+    """Ranks currently tombstoned by the degraded-sync plane (rank index →
+    consecutive degraded syncs seen dead)."""
+    return dict(_DEAD_RANKS)
+
+
+def clear_dead_ranks() -> None:
+    """Forget all tombstones (test/soak-run isolation)."""
+    _DEAD_RANKS.clear()
 
 
 # ---------------------------------------------------------------------------
@@ -230,6 +276,9 @@ def _encode_metadata(
         np.int32,
     )
     vec[0], vec[1], vec[2], vec[3] = _MAGIC, _VERSION, len(leaves), n_fields
+    # liveness slot pair (v8): a live rank always announces alive=1 plus its
+    # epoch — an all-zero row can therefore ONLY be a dead rank's tombstone
+    vec[4], vec[5] = 1, _LIVENESS["epoch"]
     for i, leaf in enumerate(leaves):
         rec = vec[_HEADER_LEN + i * _LEAF_REC_LEN :]
         if leaf.array is None:
@@ -308,10 +357,14 @@ class _WorldPlan:
     counter_rows: List[List[int]]  # per-rank counters decoded from the piggyback
     hist_rows: List[List[int]]  # per-rank fleet histogram vectors, same piggyback
     quant: Optional[_QuantPlan] = None
+    # per-rank liveness (v8): False = the rank contributed an all-zero
+    # tombstone row and the bucket folds skip its (zero) segments
+    alive: List[bool] = dataclasses.field(default_factory=list)
+    epochs: List[int] = dataclasses.field(default_factory=list)  # 0 for dead ranks
 
 
-def _decode_rows(rows: Sequence[Any], n_leaves: int, quant_len: int = 0) -> List[np.ndarray]:
-    decoded = []
+def _decode_rows(rows: Sequence[Any], n_leaves: int, quant_len: int = 0) -> List[Optional[np.ndarray]]:
+    decoded: List[Optional[np.ndarray]] = []
     expect_len = (
         _HEADER_LEN + n_leaves * _LEAF_REC_LEN + 2 * len(COUNTER_FIELDS)
         + 2 * _HIST_VEC_LEN + quant_len
@@ -320,9 +373,21 @@ def _decode_rows(rows: Sequence[Any], n_leaves: int, quant_len: int = 0) -> List
         arr = np.asarray(row).ravel()
         if arr.size != expect_len or not np.issubdtype(arr.dtype, np.integer):
             raise CoalesceFallback("metadata row has unexpected length/dtype")
+        if not arr.any():
+            # a rank that died mid-collective contributes all zeros. This must
+            # be recognized BEFORE magic validation: a fallback here would
+            # re-run the per-leaf plane, which has no tombstone notion and
+            # would fold the dead rank's zero payloads as data
+            decoded.append(None)
+            continue
         if int(arr[0]) != _MAGIC or int(arr[1]) != _VERSION or int(arr[2]) != n_leaves:
             raise CoalesceFallback("metadata row failed validation")
+        if int(arr[4]) != 1 or int(arr[5]) < 1:
+            raise CoalesceFallback("metadata row carries an invalid liveness slot")
         decoded.append(arr.astype(np.int64))
+    if decoded and all(r is None for r in decoded):
+        # no survivor quorum — nothing here can complete the sync
+        raise CoalesceFallback("every rank's metadata row is a tombstone")
     return decoded
 
 
@@ -332,11 +397,22 @@ def _plan_from_rows(
     quant_lens = _quant_record_lens(qctx)
     decoded = _decode_rows(rows, len(leaves), sum(quant_lens))
     world = len(decoded)
+    alive = [row is not None for row in decoded]
+    epochs = [0 if row is None else int(row[5]) for row in decoded]
     leaf_plans: List[_LeafPlan] = []
     buckets: Dict[Any, List[int]] = {}
     leaf_codes: List[List[int]] = []
     for i, leaf in enumerate(leaves):
-        recs = [row[_HEADER_LEN + i * _LEAF_REC_LEN :][: _LEAF_REC_LEN] for row in decoded]
+        # a dead rank's leaves decode as EMPTY contributors (count 0, codec 0,
+        # the leaf's own kind) so the padding totals and bucket offsets stay
+        # well-defined; its zero bucket segments are skipped at fold time
+        tomb = np.zeros((_LEAF_REC_LEN,), np.int64)
+        tomb[0], tomb[1] = _CODE_EMPTY, 1
+        tomb[2 + _MAX_RANK] = _KIND_LIST if leaf.is_list else _KIND_TENSOR
+        recs = [
+            tomb if row is None else row[_HEADER_LEN + i * _LEAF_REC_LEN :][: _LEAF_REC_LEN]
+            for row in decoded
+        ]
         kinds = {int(r[2 + _MAX_RANK]) & 1 for r in recs}
         leaf_codes.append([int(r[2 + _MAX_RANK]) >> 1 for r in recs])
         if kinds != {_KIND_LIST if leaf.is_list else _KIND_TENSOR}:
@@ -413,6 +489,10 @@ def _plan_from_rows(
     hist_at = tail_at + 2 * len(COUNTER_FIELDS)
     quant_at = hist_at + 2 * _HIST_VEC_LEN
     for row in decoded:
+        if row is None:  # dead ranks contribute zero telemetry (like no session)
+            counter_rows.append([0] * len(COUNTER_FIELDS))
+            hist_rows.append([0] * _HIST_VEC_LEN)
+            continue
         counter_rows.append(unpack_halves(row[tail_at:hist_at]))
         hist_rows.append(unpack_halves(row[hist_at:quant_at]))
     quant = None
@@ -423,6 +503,12 @@ def _plan_from_rows(
         slots = _quantize.BUCKET_SCALE_SLOTS if qctx.config.codec == "int8" else 0
         rec_len = 2 + 2 * slots
         for row in decoded:
+            if row is None:  # dead rank: no quantized segments to decode
+                for dt in _quantize.QUANT_SECTION_DTYPES:
+                    bucket_scales.setdefault(jnp.dtype(dt), []).append(
+                        (0, np.zeros((0,), np.float32), np.zeros((0,), np.float32))
+                    )
+                continue
             at = quant_at
             for dt in _quantize.QUANT_SECTION_DTYPES:
                 code = int(row[at])
@@ -447,6 +533,7 @@ def _plan_from_rows(
     return _WorldPlan(
         world=world, leaf_plans=leaf_plans, buckets=buckets,
         counter_rows=counter_rows, hist_rows=hist_rows, quant=quant,
+        alive=alive, epochs=epochs,
     )
 
 
@@ -553,6 +640,8 @@ def _decode_bucket_rows(
     leaf_idxs = plan.buckets[dtype]
     out: List[List[Optional[Array]]] = [[] for _ in leaf_idxs]
     for r in range(plan.world):
+        if not plan.alive[r]:
+            continue  # tombstoned rank: its row is zeros, the quorum folds on
         row = jnp.asarray(rows_b[r])
         if row.dtype != jnp.uint8:
             row = row.astype(jnp.uint8)
@@ -714,6 +803,8 @@ def coalesced_process_sync(
                 per_leaf_gathered[li].extend(decoded_bucket[j])
             continue
         for r in range(plan.world):
+            if not plan.alive[r]:
+                continue  # tombstoned rank: its row is zeros, the quorum folds on
             offset = 0
             row = jnp.asarray(rows_b[r])
             for li in leaf_idxs:
@@ -750,6 +841,21 @@ def coalesced_process_sync(
             outs[leaf.state_idx][leaf.name] = _sync._fold_gathered(gathered, leaf.fx)
     if rec is not None:
         rec.counters.record_coalesced(sum(1 for g in per_leaf_gathered if g is not None))
+    # liveness bookkeeping LAST — only a sync that fully committed may mark
+    # ranks dead or reconcile a rejoin (a failed gather retries from scratch)
+    dead = [r for r in range(plan.world) if not plan.alive[r]]
+    rejoined = [r for r in range(plan.world) if plan.alive[r] and r in _DEAD_RANKS]
+    for r in dead:
+        _DEAD_RANKS[r] = _DEAD_RANKS.get(r, 0) + 1
+    for r in rejoined:
+        # the rejoined rank's full accumulator was part of THIS sync's gather,
+        # so its missed contribution just reconciled — no double count possible
+        _DEAD_RANKS.pop(r, None)
+    if rec is not None:
+        if dead:
+            rec.record_degraded_sync("coalesced_sync", dead, plan.world)
+        for r in rejoined:
+            rec.record_rank_rejoin("coalesced_sync", r, plan.epochs[r])
     return outs
 
 

@@ -12,6 +12,7 @@ is byte-for-byte unchanged.
 
 from .faults import (
     ROUND5_CRASH_MESSAGE,
+    DeadRank,
     DispatchFaultHook,
     FlakyGather,
     inject_dispatch_fault,
@@ -33,6 +34,7 @@ __all__ = [
     "DETERMINISTIC",
     "TRANSIENT",
     "ROUND5_CRASH_MESSAGE",
+    "DeadRank",
     "DispatchFaultHook",
     "FlakyGather",
     "ReliabilityConfig",

@@ -146,6 +146,57 @@ class FlakyGather:
         return self.inner(value, group)
 
 
+class DeadRank:
+    """A ``dist_sync_fn`` wrapper simulating a rank DYING mid-collective in a
+    ``world``-rank fleet — the failure the degraded-sync plane
+    (``parallel/coalesce.py`` v8) exists to survive.
+
+    Every gathered result is widened to ``world`` rows by mirroring the local
+    row for the simulated peers (the world-of-one test-fleet trick); while
+    rank ``rank`` is dead its row in EVERY collective result is zeroed —
+    exactly the all-zero metadata tombstone and zero bucket payload a real
+    lost participant leaves behind. The coalesced plane must complete the
+    sync over the survivor quorum and mark it degraded. :meth:`revive` brings
+    the rank back: its rows mirror the live ones again, so the next coalesced
+    sync observes the rejoin and reconciles its contribution.
+
+    Deterministic (counters, not clocks): ``calls`` counts collectives
+    served, ``zeroed`` the rows tombstoned while dead.
+    """
+
+    def __init__(self, inner: Optional[Callable] = None, world: int = 2, rank: int = 1) -> None:
+        if inner is None:
+            from ..parallel.sync import gather_all_arrays as inner  # late: avoids cycle
+        if world < 2:
+            raise ValueError(f"DeadRank needs a world of at least 2, got {world}")
+        if not 0 <= rank < world:
+            raise ValueError(f"rank must be in [0, {world}), got {rank}")
+        self.inner = inner
+        self.world = world
+        self.rank = rank
+        self.dead = True
+        self.calls = 0
+        self.zeroed = 0
+
+    def revive(self) -> None:
+        """Bring the dead rank back — its next rows are live mirrors, which a
+        coalesced sync sees as the rejoin."""
+        self.dead = False
+
+    def kill(self) -> None:
+        self.dead = True
+
+    def __call__(self, value, group=None):
+        self.calls += 1
+        rows = [jnp.asarray(r) for r in self.inner(value, group)]
+        while len(rows) < self.world:  # mirror the local row for simulated peers
+            rows.append(jnp.asarray(rows[0]))
+        if self.dead:
+            rows[self.rank] = jnp.zeros_like(rows[self.rank])
+            self.zeroed += 1
+        return rows
+
+
 def truncate_state_dict(
     state_dict: Dict[str, Any],
     drop_keys: Optional[Iterable[str]] = None,

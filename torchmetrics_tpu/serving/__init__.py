@@ -1,6 +1,15 @@
 """Multi-tenant serving engine — stacked tenant states, vmapped megabatch
-dispatch, LRU spill, per-tenant lifecycle. See ``docs/serving.md``."""
+dispatch, LRU spill, per-tenant lifecycle, crash-consistent snapshots and a
+write-ahead traffic journal. See ``docs/serving.md``."""
 
+from .durability import JournalRecord, SnapshotStore, TrafficJournal, batch_digest
 from .engine import ServingConfig, ServingEngine
 
-__all__ = ["ServingConfig", "ServingEngine"]
+__all__ = [
+    "JournalRecord",
+    "ServingConfig",
+    "ServingEngine",
+    "SnapshotStore",
+    "TrafficJournal",
+    "batch_digest",
+]

@@ -61,6 +61,7 @@ from .. import aot as _aot
 from .. import observability as _observability
 from ..aot import keys as _aot_keys
 from ..parallel import quantize as _quantize
+from . import durability as _durability
 from ..metric import (
     TENANT_COUNT_KEY,
     Metric,
@@ -70,7 +71,7 @@ from ..metric import (
     window_stack_geometry,
     window_tier,
 )
-from ..utilities.exceptions import TorchMetricsUserError
+from ..utilities.exceptions import StateCorruptionError, TorchMetricsUserError
 
 StateDict = Dict[str, Any]
 
@@ -148,6 +149,18 @@ class ServingConfig:
             instead of one window) on sum/mean metrics.
         window_pane: two-stack pane length override (default: window-
             independent depth of ``metric.WINDOW_STACK_DEPTH`` panes).
+        journal: directory for a write-ahead traffic journal
+            (``serving/durability.py``): every admitted batch appends a
+            ``(seq, tenant_id, batch-digest, clock)`` record BEFORE it is
+            queued for dispatch, so :meth:`ServingEngine.restore` + journal
+            replay reaches the exact pre-crash state. ``None`` (default)
+            journals nothing. Only str/int tenant ids can be journaled.
+        journal_fsync_every: fsync the journal every this-many appends (plus
+            on rotation/close). ``1`` is RPO=0 — no admitted batch can be
+            lost; larger values batch fsyncs and bound the loss window at
+            ``journal_fsync_every - 1`` records.
+        journal_segment_records: rotate to a fresh journal segment file after
+            this many records (bounds per-file recovery scan cost).
     """
 
     capacity: int = 1024
@@ -164,6 +177,9 @@ class ServingConfig:
     window: Optional[int] = None
     window_tier: str = "auto"
     window_pane: Optional[int] = None
+    journal: Optional[str] = None
+    journal_fsync_every: int = 1
+    journal_segment_records: int = 512
 
     def __post_init__(self) -> None:
         if self.capacity < 1:
@@ -196,13 +212,21 @@ class ServingConfig:
             )
         if self.on_error not in _ON_ERROR_MODES:
             raise ValueError(f"Expected `on_error` to be one of {_ON_ERROR_MODES}, got {self.on_error!r}")
+        if self.journal is not None and not isinstance(self.journal, str):
+            raise ValueError(f"journal must be a directory path (or None), got {self.journal!r}")
+        if self.journal_fsync_every < 1:
+            raise ValueError(f"journal_fsync_every must be >= 1, got {self.journal_fsync_every}")
+        if self.journal_segment_records < 1:
+            raise ValueError(
+                f"journal_segment_records must be >= 1, got {self.journal_segment_records}"
+            )
 
 
 class _Tenant:
     """Host-side bookkeeping for one logical session."""
 
     __slots__ = ("tenant_id", "shape_key", "slot", "update_count", "last_touch",
-                 "pending", "quarantined", "error", "spilled")
+                 "pending", "quarantined", "error", "spilled", "unfolded")
 
     def __init__(self, tenant_id: Hashable) -> None:
         self.tenant_id = tenant_id
@@ -215,6 +239,9 @@ class _Tenant:
         self.error: Optional[str] = None
         # host copy of the state rows while evicted: {"state": {name: np}, "count": float}
         self.spilled: Optional[Dict[str, Any]] = None
+        # journal seqs admitted but not yet folded (journaling engines only);
+        # a quarantine rolls these back and records them so replay skips them
+        self.unfolded: List[int] = []
 
     @property
     def resident(self) -> bool:
@@ -331,6 +358,20 @@ class ServingEngine:
         # vmapped batch-compute support memo: None = untried, False = this
         # metric's _compute cannot vmap (host path / untraceable) — eager wins
         self._vcompute_ok: Optional[bool] = None
+        # durability plane (serving/durability.py): write-ahead journal handle
+        # plus the sequence cursor pair that makes restore+replay exactly-once
+        # (_next_seq = next admission's record, _applied_seq = highest folded)
+        self._journal: Optional[_durability.TrafficJournal] = None
+        self._next_seq = 1
+        self._applied_seq = 0
+        self._replaying = False
+        self._replay_clock: Optional[float] = None
+        if self.config.journal is not None:
+            self._journal = _durability.TrafficJournal(
+                self.config.journal,
+                fsync_every=self.config.journal_fsync_every,
+                segment_records=self.config.journal_segment_records,
+            )
         if self.config.aot_cache_dir is not None:
             # the self-warming boot path: every fresh megabatch compile writes
             # through, so the next boot of this server loads instead
@@ -498,7 +539,10 @@ class ServingEngine:
         rate = self.config.max_tenants_per_sec
         if rate is None:
             return True
-        now = self._clock()
+        # replay drives the bucket with the JOURNALED admission clock, so the
+        # standby's token state converges on the primary's exactly (refills
+        # compose: two refills over [t0,t1],[t1,t2] equal one over [t0,t2])
+        now = self._replay_clock if self._replaying and self._replay_clock is not None else self._clock()
         if self._rl_last is None:
             self._rl_last = now
         cap = max(float(rate), 1.0)
@@ -542,6 +586,23 @@ class ServingEngine:
             )
         cls = self._ensure_class(key, args, kwargs)
         self._admit(t, cls)
+        if self._journal is not None and not self._replaying:
+            # write-ahead: the record must be durable-ordered BEFORE the batch
+            # can dispatch; digest covers the prepared inputs, t the admission
+            # clock (bucket replay), seq the exactly-once dedup cursor
+            seq = self._next_seq
+            synced = self._journal.append(
+                tenant_id,
+                _durability.batch_digest(args, kwargs),
+                seq,
+                t=self._rl_last if self.config.max_tenants_per_sec is not None else 0.0,
+            )
+            self._next_seq = seq + 1
+            self._applied_seq = seq
+            t.unfolded.append(seq)
+            rec = _observability._ACTIVE
+            if rec is not None:
+                rec.counters.record_journal_append(synced)
         cls.queue.append((tenant_id, args, kwargs))
         t.pending += 1
         t.last_touch = next(self._touch)
@@ -702,6 +763,8 @@ class ServingEngine:
             t = self._tenants[tid]
             t.update_count += 1
             t.pending -= 1
+            if t.unfolded:
+                del t.unfolded[0]  # this fold retires its write-ahead admission
             if self._wtier is not None and t.update_count % hop == 0:
                 rotations += 1
         self.stats["window_rotations"] += rotations
@@ -713,8 +776,28 @@ class ServingEngine:
 
     def _quarantine(self, tenant_id: Hashable, exc: BaseException) -> None:
         t = self._tenants[tenant_id]
+        err_text = f"{type(exc).__name__}: {exc}"[:240]
+        synced: Optional[bool] = None
+        if self._journal is not None and not self._replaying:
+            # the quarantine is a state transition the WAL must carry: a
+            # standby replaying this journal has no fault environment, so
+            # without this record it would fold the very batches the primary
+            # rolled back and come up with the tenant live — state divergence.
+            # The record names the rolled-back admission seqs (everything this
+            # tenant admitted but never folded); replay skips those and
+            # re-applies the flag instead.
+            # the record takes a seq from the admission counter (the journal
+            # enforces strict seq ordering) but does NOT advance _applied_seq:
+            # that cursor names the highest applied ADMISSION, and callers key
+            # their retention buffers on it right after update() returns
+            seq = self._next_seq
+            synced = self._journal.append(
+                tenant_id, err_text, seq, kind="quarantine", rolled_back=list(t.unfolded),
+            )
+            self._next_seq = seq + 1
+            t.unfolded = []
         t.quarantined = True
-        t.error = f"{type(exc).__name__}: {exc}"[:240]
+        t.error = err_text
         # drop the tenant's remaining queued batches everywhere
         if t.shape_key is not None and t.shape_key in self._classes:
             cls = self._classes[t.shape_key]
@@ -725,6 +808,8 @@ class ServingEngine:
         self.stats["quarantined"] += 1
         rec = _observability._ACTIVE
         if rec is not None:
+            if synced is not None:
+                rec.counters.record_journal_append(synced)
             rec.record_quarantine(repr(tenant_id), "vupdate", "quarantined", exc, t.update_count)
 
     # ---------------------------------------------------------------- reads
@@ -948,6 +1033,222 @@ class ServingEngine:
         }
         t.quarantined = False
         t.error = None
+
+    # ------------------------------------------------------------- durability
+
+    def _geometry(self) -> Dict[str, Any]:
+        """The config facts a snapshot must match to be restorable: stack
+        layout, window geometry, spill codec and admission rate (the journal
+        replays the token bucket, so the rate must agree too)."""
+        return {
+            "capacity": self.config.capacity,
+            "megabatch_size": self.config.megabatch_size,
+            "spill_codec": self.config.spill_codec,
+            "max_tenants_per_sec": self.config.max_tenants_per_sec,
+            "window": self._window,
+            "window_tier": self._wtier,
+            "window_pane": self._wpane,
+            "window_depth": self._wdepth,
+            "state_keys": sorted(self._row_defaults),
+        }
+
+    def snapshot(self, directory: str) -> Dict[str, Any]:
+        """Write one crash-consistent whole-engine snapshot generation.
+
+        Pending megabatches are flushed first, then EVERY tenant's state rows
+        (window layout included), seating/LRU/quarantine bookkeeping, the
+        admission bucket, engine stats and the journal cursors land in one
+        content-addressed container (``serving/durability.SnapshotStore`` —
+        the ``aot/cache.py`` tmp+fsync+``os.replace`` discipline). Returns
+        ``{"generation", "path", "bytes", "tenants"}``."""
+        t0 = time.perf_counter()
+        self.flush()
+        store = _durability.SnapshotStore(directory)
+        sections: Dict[str, np.ndarray] = {}
+        tenants_meta: List[Dict[str, Any]] = []
+        for i, (tid, t) in enumerate(self._tenants.items()):
+            entry: Dict[str, Any] = {
+                "id": _durability.encode_tenant_id(tid),
+                "shape_key": t.shape_key,
+                "update_count": int(t.update_count),
+                "last_touch": int(t.last_touch),
+                "quarantined": bool(t.quarantined),
+                "error": t.error,
+                "state": False,
+            }
+            if t.slot is not None or t.spilled is not None:
+                state = self._tenant_state(t)
+                for name in self._row_defaults:
+                    sections[f"t{i}/{name}"] = np.asarray(state[name])
+                if t.spilled is not None:
+                    entry["count"] = float(t.spilled["count"])
+                else:
+                    cls = self._classes[t.shape_key]
+                    entry["count"] = float(np.asarray(cls.stacked[TENANT_COUNT_KEY][t.slot]))
+                entry["state"] = True
+            tenants_meta.append(entry)
+        meta = {
+            "engine": self._geometry(),
+            "tenants": tenants_meta,
+            "stats": dict(self.stats),
+            "rl": {"tokens": float(self._rl_tokens), "last": self._rl_last},
+            # consuming one tick here shifts every later touch by one — order,
+            # which is all LRU eviction compares, is preserved
+            "touch": next(self._touch),
+            "applied_seq": int(self._applied_seq),
+            "next_seq": int(self._next_seq),
+        }
+        out = store.write(meta, sections)
+        out["tenants"] = len(tenants_meta)
+        rec = _observability._ACTIVE
+        if rec is not None:
+            rec.record_snapshot(
+                self._metric, "write", time.perf_counter() - t0,
+                out["bytes"], out["generation"],
+            )
+        return out
+
+    def restore(self, directory: str, generation: Optional[int] = None) -> Dict[str, Any]:
+        """Load one snapshot generation (latest by default) into this engine.
+
+        The engine must have the same geometry the snapshot was taken with
+        (capacity, megabatch size, window shape, spill codec, admission rate
+        — mismatch raises ``TorchMetricsUserError``); a torn or corrupt
+        snapshot raises ``StateCorruptionError`` and loads NOTHING. Every
+        tenant parks host-side (the ``load_state_dict`` spill convention) and
+        reseats lazily on its next traffic. Follow with
+        :meth:`replay_journal` to roll forward past the snapshot point."""
+        t0 = time.perf_counter()
+        store = _durability.SnapshotStore(directory)
+        meta, sections = store.read(generation)
+        theirs = meta.get("engine")
+        mine = self._geometry()
+        if theirs != mine:
+            raise TorchMetricsUserError(
+                f"snapshot engine geometry {theirs!r} does not match this engine's {mine!r}; "
+                "restore into an identically configured engine."
+            )
+        self._classes = {}
+        self._tenants = {}
+        try:
+            for i, entry in enumerate(meta["tenants"]):
+                tid = _durability.decode_tenant_id(entry["id"])
+                t = _Tenant(tid)
+                self._tenants[tid] = t
+                t.shape_key = entry["shape_key"]
+                t.update_count = int(entry["update_count"])
+                t.last_touch = int(entry["last_touch"])
+                t.quarantined = bool(entry["quarantined"])
+                t.error = entry["error"]
+                if entry["state"]:
+                    state = {
+                        name: np.asarray(sections[f"t{i}/{name}"])
+                        for name in self._row_defaults
+                    }
+                    t.spilled = {
+                        "state": _quantize.encode_spill_state(state, self.config.spill_codec),
+                        "count": float(entry["count"]),
+                    }
+            self.stats = {k: meta["stats"].get(k, 0) for k in self.stats}
+            rl = meta["rl"]
+            self._rl_tokens = float(rl["tokens"])
+            self._rl_last = None if rl["last"] is None else float(rl["last"])
+            self._touch = itertools.count(int(meta["touch"]))
+            self._applied_seq = int(meta["applied_seq"])
+            self._next_seq = int(meta["next_seq"])
+        except (KeyError, TypeError, ValueError) as err:
+            raise StateCorruptionError(
+                f"snapshot in {directory!r} decodes but its bookkeeping is malformed: {err}"
+            ) from err
+        gens = store.generations()
+        used = int(generation) if generation is not None else gens[-1]
+        rec = _observability._ACTIVE
+        if rec is not None:
+            rec.record_snapshot(
+                self._metric, "restore", time.perf_counter() - t0, 0, used,
+            )
+        return {"generation": used, "tenants": len(self._tenants)}
+
+    def replay_journal(
+        self,
+        records: List[_durability.JournalRecord],
+        fetch: Callable[[_durability.JournalRecord], Tuple[tuple, dict]],
+    ) -> int:
+        """Roll a restored engine forward through the journal tail.
+
+        ``fetch(record) -> (args, kwargs)`` resolves each record's batch from
+        the traffic source's retention buffer; the journaled digest is
+        verified against the refetched (prepared) batch before it is applied.
+        Records at or below the snapshot's applied-seq cursor are skipped —
+        replay is exactly-once no matter how often it is retried.
+
+        ``kind="quarantine"`` records re-apply the primary's quarantine
+        transition: the tenant comes back flagged (with the journaled error
+        text) and the admissions the record names as rolled back are skipped
+        outright — the primary never folded them, so replay must not either.
+        Returns the number of records applied."""
+        t0 = time.perf_counter()
+        replayed = 0
+        # admissions a later quarantine rolled back on the primary — collected
+        # up front because they appear in the journal BEFORE the quarantine
+        # record that dooms them
+        rolled: set = set()
+        for jrec in records:
+            if jrec.kind == "quarantine":
+                rolled.update(jrec.rolled_back)
+        for jrec in records:
+            if jrec.seq <= self._applied_seq:
+                continue  # already folded before the snapshot — exactly-once
+            if jrec.kind == "quarantine":
+                t = self._tenant(jrec.tenant_id)
+                if not t.quarantined:
+                    t.quarantined = True
+                    t.error = jrec.digest
+                    t.pending = 0
+                    t.unfolded = []
+                    self.stats["quarantined"] += 1
+                self._applied_seq = jrec.seq
+                self._next_seq = max(self._next_seq, jrec.seq + 1)
+                replayed += 1
+                continue
+            if jrec.seq in rolled:
+                # admitted on the primary but rolled back by the quarantine
+                # that journaled this seq — advance the cursor without folding
+                self._applied_seq = jrec.seq
+                self._next_seq = max(self._next_seq, jrec.seq + 1)
+                continue
+            args, kwargs = fetch(jrec)
+            pargs, pkwargs = self._metric._prepare_inputs(*args, **kwargs)
+            if _durability.batch_digest(pargs, pkwargs) != jrec.digest:
+                raise StateCorruptionError(
+                    f"journal seq {jrec.seq}: refetched batch does not match the journaled "
+                    "digest — the retention buffer diverged from what the primary admitted."
+                )
+            self._replaying = True
+            self._replay_clock = jrec.t
+            try:
+                ok = self.update(jrec.tenant_id, *args, **kwargs)
+            finally:
+                self._replaying = False
+                self._replay_clock = None
+            if not ok:
+                raise StateCorruptionError(
+                    f"journal seq {jrec.seq}: replayed admission was shed — the admission "
+                    "bucket diverged from the journaled run (config mismatch?)."
+                )
+            self._applied_seq = jrec.seq
+            self._next_seq = max(self._next_seq, jrec.seq + 1)
+            replayed += 1
+        rec = _observability._ACTIVE
+        if rec is not None and replayed:
+            rec.record_journal_replay(self._metric, replayed, time.perf_counter() - t0)
+        return replayed
+
+    def close(self) -> None:
+        """Release the write-ahead journal handle (flushes its pending tail).
+        A no-op for engines without a journal."""
+        if self._journal is not None:
+            self._journal.close()
 
     # ------------------------------------------------------------ warm start
 
