@@ -366,6 +366,64 @@ def test_durable_failover_columns_direction_and_gate(tmp_path):
     assert bench_compare.main(paths + ["--check"]) == 0
 
 
+def test_fleet_failover_columns_direction_and_gate(tmp_path):
+    """fleet_failover columns (fleet plane): the three parities gate
+    higher-exact (a lost batch, a tenant seated twice, or a nondeterministic
+    counter block shows up as a 1.0 -> 0.0 drop), RPO and the double-count
+    tally gate lower-exact, and the workload tallies — including the
+    wall-clock migration_us, which the "_us" marker would otherwise pin
+    lower — ride info-only."""
+    assert bench_compare.direction("extra.fleet_failover.fleet_failover_parity") == "higher"
+    assert bench_compare.direction("extra.fleet_failover.migration_parity") == "higher"
+    assert bench_compare.direction("extra.fleet_failover.fleet_determinism_parity") == "higher"
+    assert bench_compare.direction("extra.fleet_failover.failover_rpo_records") == "lower"
+    assert bench_compare.direction("extra.fleet_failover.double_counted_batches") == "lower"
+    assert bench_compare.direction("extra.fleet_failover.migration_us") is None
+    assert bench_compare.direction("extra.fleet_failover.host_failovers") is None
+    assert bench_compare.direction("extra.fleet_failover.tenant_migrations") is None
+    assert bench_compare.direction("extra.fleet_failover.lease_expiries") is None
+    assert bench_compare.direction("extra.fleet_failover.fleet_heartbeats") is None
+
+    def fleet(parity=1.0, migration=1.0, determinism=1.0, double=0):
+        return {"fleet_failover": {
+            "events": 841, "hosts": 3, "hosts_joined": 1, "host_failovers": 1,
+            "tenant_migrations": 8, "lease_expiries": 1, "fleet_heartbeats": 320,
+            "adopted_tenants": 3, "parked_batches": 5, "replayed_records": 3,
+            "migration_us": 97000.0, "failover_rpo_records": 0,
+            "double_counted_batches": double, "faults_injected": 2,
+            "recovered_faults": 2, "unrecovered_faults": 0,
+            "fleet_failover_parity": parity, "migration_parity": migration,
+            "fleet_determinism_parity": determinism, "soak_recovery_parity": 1.0,
+            "unit": "seeded 3-host fleet soak",
+        }}
+
+    good = _round(1, 30000.0, extra_overrides=fleet())
+    # a lost/double-folded batch: per-tenant parity 1.0 -> 0.0 must gate
+    lost = _round(2, 30000.0, extra_overrides=fleet(parity=0.0))
+    paths = _write_rounds(tmp_path, [good, lost])
+    report = bench_compare.compare_rounds(paths)
+    reg = {r["metric"] for t in report["transitions"] for r in t["rows"] if r["verdict"] == "regression"}
+    assert "extra.fleet_failover.fleet_failover_parity" in reg
+    assert bench_compare.main(paths + ["--check"]) == 1
+    # a migration that did not land bitwise gates the same way
+    mig_dir = tmp_path / "mig"
+    mig_dir.mkdir()
+    paths = _write_rounds(mig_dir, [good, _round(2, 30000.0, extra_overrides=fleet(migration=0.0))])
+    assert bench_compare.main(paths + ["--check"]) == 1
+    # a counter block that stopped replaying run-to-run gates too
+    det_dir = tmp_path / "det"
+    det_dir.mkdir()
+    paths = _write_rounds(det_dir, [good, _round(2, 30000.0, extra_overrides=fleet(determinism=0.0))])
+    assert bench_compare.main(paths + ["--check"]) == 1
+    # identical fleet columns ride through clean
+    steady_dir = tmp_path / "steady"
+    steady_dir.mkdir()
+    paths = _write_rounds(steady_dir, [good, _round(2, 30000.0, extra_overrides=fleet())])
+    report = bench_compare.compare_rounds(paths)
+    assert report["verdict"] == "ok"
+    assert bench_compare.main(paths + ["--check"]) == 0
+
+
 def test_per_metric_threshold_override():
     prev = bench_compare.extract_metrics(_round(1, 30000.0))
     cur = bench_compare.extract_metrics(_round(2, 27000.0))  # -10%

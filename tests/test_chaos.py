@@ -164,7 +164,9 @@ def test_fault_spec_validates():
 
 def test_schedule_json_round_trip(tmp_path):
     sched = default_fault_schedule(60, tenant=2)
-    assert {s.kind for s in sched} == set(FAULT_KINDS)
+    # the default schedule covers every single-host kind; the host_* kinds
+    # are fleet-soak-only by design (a single-host soak refuses them)
+    assert {s.kind for s in sched} == set(FAULT_KINDS) - {"host_loss", "host_join"}
     back = FaultSchedule.from_json(sched.to_json())
     assert back.specs == sched.specs
     path = str(tmp_path / "faults.json")

@@ -1,0 +1,598 @@
+"""Fleet failover plane tests (torchmetrics_tpu/fleet). Marker ``fleet``.
+
+The load-bearing claims, each pinned:
+
+- **placement**: the weighted rendezvous map is deterministic, respects
+  weights, and a host join/leave produces the MINIMAL move set — only
+  tenants whose rendezvous winner actually changed relocate;
+- **membership**: leases walk alive → suspect → dead on the injected
+  clock; a suspect that revives causes NO spurious failover (the flap
+  window), expiry reports exactly once, and a rejoin after expiry bumps
+  the liveness epoch (the coalesce-v8 discipline);
+- **migration kill-point fuzz**: a kill at EVERY protocol stage boundary —
+  drain, snapshot, transfer (including a torn transferred artifact),
+  restore — aborts cleanly: every tenant whole on exactly one host,
+  digests untouched, no residual artifacts; a kill after cutover is
+  post-commit and the destination owns everything;
+- **failover**: lease expiry makes survivors adopt the dead host's tenants
+  from its latest snapshot generation + journal tail, bitwise
+  (restore + replay = pre-crash state), with RPO 0 at ``fsync_every=1``,
+  and a tenant first seen inside the suspicion window is re-placed, not
+  lost;
+- **bounded retention** (satellite): ``SnapshotStore.prune`` never removes
+  the newest generation, and a store pruned to ``keep_last=1`` with its
+  covered journal segments swept still restores + replays to parity;
+- **the fleet soak**: ``run_soak(fleet_hosts=N)`` with ``host_loss`` +
+  ``host_join`` ends at per-tenant parity 1.0 against an uninterrupted
+  single-host reference, zero double counts, and a byte-identical counter
+  block on a second run.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+from torchmetrics_tpu.chaos import (
+    FaultSchedule,
+    FaultSpec,
+    SoakConfig,
+    TrafficConfig,
+    run_soak,
+)
+from torchmetrics_tpu.classification import MulticlassAccuracy
+from torchmetrics_tpu.fleet import (
+    MIGRATION_STAGES,
+    FleetController,
+    LeaseConfig,
+    Membership,
+    MigrationAborted,
+    Move,
+    place,
+    place_all,
+    placement_score,
+    rebalance_plan,
+    tenant_state_digest,
+)
+from torchmetrics_tpu.serving import ServingConfig, ServingEngine, SnapshotStore
+from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
+
+pytestmark = pytest.mark.fleet
+
+NUM_CLASSES = 3
+BATCH = 4
+
+
+def _metric():
+    return MulticlassAccuracy(NUM_CLASSES, average="micro", validate_args=False)
+
+
+def _batch(i: int):
+    rng = np.random.default_rng(1000 + i)
+    preds = rng.normal(size=(BATCH, NUM_CLASSES)).astype(np.float32)
+    target = rng.integers(0, NUM_CLASSES, BATCH, dtype=np.int32)
+    return preds, target
+
+
+def _serving(**kw) -> ServingConfig:
+    base = dict(capacity=16, megabatch_size=4, journal_fsync_every=1)
+    base.update(kw)
+    return ServingConfig(**base)
+
+
+def _fleet(tmp_path, hosts=3, clock=None, lease=None, **serving_kw):
+    return FleetController(
+        _metric,
+        root=str(tmp_path / "fleet"),
+        hosts=hosts,
+        serving=_serving(**serving_kw),
+        lease=lease,
+        clock=clock,
+    )
+
+
+def _expire(fc, clock, until=7.0, step=1.0):
+    """Advance the virtual clock in heartbeat-sized ticks (live hosts renew,
+    killed hosts stay silent) until the victim's lease expires; returns every
+    host poll() failed over along the way."""
+    failed = []
+    while clock["t"] < until:
+        clock["t"] += step
+        fc.heartbeat_all()
+        failed += fc.poll()
+    return failed
+
+
+def _roster_count(controller, tid) -> int:
+    """On how many live engines does ``tid`` hold state? (exactly-one gate)"""
+    return sum(
+        1
+        for h in controller._hosts.values()
+        if not h.killed and tid in h.engine.tenants()
+    )
+
+
+# ------------------------------------------------------------------ placement
+
+
+def test_placement_deterministic_and_total():
+    hosts = {"a": 1.0, "b": 1.0, "c": 1.0}
+    for tid in range(50):
+        first = place(tid, hosts)
+        assert first in hosts
+        assert all(place(tid, hosts) == first for _ in range(3))
+    assignment = place_all(range(50), hosts)
+    assert assignment == {tid: place(tid, hosts) for tid in range(50)}
+    # every host wins something at this size (rendezvous spreads)
+    assert set(assignment.values()) == set(hosts)
+
+
+def test_placement_score_positive_and_weighted():
+    assert placement_score("a", 7) > 0
+    # the -w/ln(u) transform scales expected share linearly in weight: over
+    # many tenants the weight-3 host must own strictly more than a weight-1
+    counts = {"light": 0, "heavy": 0}
+    for tid in range(400):
+        counts[place(tid, {"light": 1.0, "heavy": 3.0})] += 1
+    assert counts["heavy"] > counts["light"]
+    with pytest.raises(TorchMetricsUserError):
+        place(0, {})
+
+
+def test_rebalance_join_is_minimal():
+    hosts = {"a": 1.0, "b": 1.0}
+    assignment = place_all(range(60), hosts)
+    grown = dict(hosts, c=1.0)
+    plan = rebalance_plan(assignment, grown)
+    assert plan  # the new host gets its fair share
+    for move in plan:
+        assert isinstance(move, Move)
+        assert move.dst == "c"  # join moves ONLY onto the joiner
+        assert move.src == assignment[move.tenant_id]
+        assert place(move.tenant_id, grown) == "c"
+    # everything not in the plan keeps its seat under the grown map
+    moved = {m.tenant_id for m in plan}
+    for tid, host in assignment.items():
+        if tid not in moved:
+            assert place(tid, grown) == host
+
+
+def test_rebalance_leave_moves_only_the_leaver():
+    hosts = {"a": 1.0, "b": 1.0, "c": 1.0}
+    assignment = place_all(range(60), hosts)
+    shrunk = {h: w for h, w in hosts.items() if h != "c"}
+    plan = rebalance_plan(assignment, shrunk)
+    assert {m.tenant_id for m in plan} == {
+        tid for tid, host in assignment.items() if host == "c"
+    }
+    for move in plan:
+        # the old owner is gone from the map: src is None by contract (the
+        # adoption form a failover consumes), and the seat is a survivor
+        assert move.src is None and move.dst in shrunk
+
+
+# ----------------------------------------------------------------- membership
+
+
+def test_lease_state_machine_and_flap():
+    clock = {"t": 0.0}
+    m = Membership(lambda: clock["t"], LeaseConfig(
+        heartbeat_interval=1.0, suspect_after=3.0, dead_after=6.0,
+    ))
+    m.join("h0")
+    assert m.state("h0") == "alive"
+    clock["t"] = 4.0
+    assert m.state("h0") == "suspect"
+    # the flap: a suspect that heartbeats revives with NO expiry reported
+    m.heartbeat("h0")
+    assert m.state("h0") == "alive"
+    assert m.expire() == []
+    # silence past dead_after expires exactly once
+    clock["t"] = 11.0
+    assert m.state("h0") == "dead"
+    assert m.expire() == ["h0"]
+    assert m.expire() == []
+    # dead hosts are out of the placement map; heartbeats cannot resurrect
+    assert "h0" not in m.hosts()
+    m.heartbeat("h0")
+    assert m.state("h0") == "dead"
+    # rejoin is a NEW incarnation: epoch bumps (coalesce-v8 discipline)
+    member = m.join("h0")
+    assert member.epoch == 2
+    assert m.state("h0") == "alive"
+
+
+def test_lease_config_validation():
+    with pytest.raises(ValueError):
+        LeaseConfig(suspect_after=5.0, dead_after=4.0)
+    with pytest.raises(ValueError):
+        LeaseConfig(heartbeat_interval=0.0)
+    with pytest.raises(TorchMetricsUserError):
+        Membership(clock=None)  # type: ignore[arg-type]
+
+
+def test_suspect_keeps_tenants_no_spurious_failover(tmp_path):
+    """A host that merely misses heartbeats (never crashed) keeps serving its
+    tenants, and poll() must not fail it over before the lease expires."""
+    clock = {"t": 0.0}
+    fc = _fleet(tmp_path, hosts=2, clock=lambda: clock["t"],
+                lease=LeaseConfig(suspect_after=2.0, dead_after=5.0))
+    for i in range(8):
+        fc.serve(i, *_batch(i))
+    fc.flush()
+    before = fc.tenant_digests()
+    # only host-0 heartbeats; host-1 goes silent into the suspect window
+    clock["t"] = 3.0
+    fc.membership.heartbeat("host-0")
+    assert fc.hosts()["host-1"] == "suspect"
+    assert fc.poll() == []  # suspect != dead: no failover
+    # routing still targets the suspect — traffic lands on its engine
+    suspect_tenants = [t for t, h in fc.tenants().items() if h == "host-1"]
+    assert suspect_tenants, "rendezvous should seat someone on host-1"
+    assert fc.serve(suspect_tenants[0], *_batch(99))
+    # the flap resolves: host-1 heartbeats again, nothing moved
+    fc.membership.heartbeat("host-1")
+    assert fc.hosts()["host-1"] == "alive"
+    assert fc.stats["failovers"] == 0
+    after = fc.tenant_digests()
+    for tid in before:
+        if tid != suspect_tenants[0]:
+            assert after[tid] == before[tid]
+    fc.close()
+
+
+# ------------------------------------------------------- migration kill fuzz
+
+
+class _Boom(RuntimeError):
+    pass
+
+
+def test_migration_stages_are_the_contract():
+    assert MIGRATION_STAGES == ("drain", "snapshot", "transfer", "restore", "cutover")
+
+
+@pytest.mark.parametrize("stage", [s for s in MIGRATION_STAGES if s != "cutover"])
+def test_migration_kill_point_fuzz(tmp_path, stage):
+    """A kill at every pre-commit stage boundary aborts cleanly: ownership
+    never flips, the destination holds nothing, digests are untouched, no
+    transfer artifact survives — then the SAME migration succeeds."""
+    fc = _fleet(tmp_path, hosts=2)
+    for i in range(10):
+        fc.serve(i, *_batch(i))
+    fc.flush()
+    victims = [t for t, h in fc.tenants().items() if h == "host-0"][:3]
+    assert victims
+    before_digests = fc.tenant_digests()
+    before_owner = dict(fc.tenants())
+
+    def hook(s):
+        if s == stage:
+            raise _Boom(f"killed at {s}")
+
+    with pytest.raises(MigrationAborted) as err:
+        fc.migrate(victims, "host-1", _stage_hook=hook)
+    assert isinstance(err.value.__cause__, _Boom)
+    # nothing moved, nothing lost, nothing duplicated
+    assert fc.tenants() == before_owner
+    assert fc.tenant_digests() == before_digests
+    for tid in victims:
+        assert _roster_count(fc, tid) == 1
+    for h in fc._hosts.values():
+        for box in (h.outbox_dir, h.inbox_dir):
+            assert not (os.path.isdir(box) and SnapshotStore(box).generations()), (
+                f"stage {stage!r} left a transfer artifact in {box}"
+            )
+    assert fc.stats["aborted_migrations"] == 1
+    assert fc.stats["migrated_tenants"] == 0
+    # the protocol is re-runnable after the abort: same move, clean commit
+    out = fc.migrate(victims, "host-1")
+    assert out["moved"] == len(victims) and out["parity_failures"] == 0
+    after = fc.tenant_digests()
+    for tid in victims:
+        assert fc.tenants()[tid] == "host-1"
+        assert after[tid] == before_digests[tid]
+        assert _roster_count(fc, tid) == 1
+    fc.close()
+
+
+def test_migration_torn_transfer_artifact_aborts(tmp_path):
+    """A transfer that tears mid-copy is caught by the artifact's sha256 at
+    restore-on-dst — the migration aborts with the source authoritative."""
+    fc = _fleet(tmp_path, hosts=2)
+    for i in range(8):
+        fc.serve(i, *_batch(i))
+    fc.flush()
+    victims = [t for t, h in fc.tenants().items() if h == "host-0"][:2]
+    before = fc.tenant_digests()
+    inbox = fc._hosts["host-1"].inbox_dir
+
+    def tear(stage):
+        if stage == "transfer":
+            gen = SnapshotStore(inbox).generations()[-1]
+            path = SnapshotStore(inbox).path_for(gen)
+            size = os.path.getsize(path)
+            with open(path, "r+b") as fh:
+                fh.truncate(size // 2)
+
+    with pytest.raises(MigrationAborted):
+        fc.migrate(victims, "host-1", _stage_hook=tear)
+    assert fc.tenant_digests() == before
+    for tid in victims:
+        assert fc.tenants()[tid] == "host-0"
+        assert _roster_count(fc, tid) == 1
+    assert not SnapshotStore(inbox).generations()
+    fc.close()
+
+
+def test_migration_kill_after_cutover_is_post_commit(tmp_path):
+    """The cutover hook fires AFTER the commit point: a kill there leaves the
+    destination owning every tenant exactly once (the migration is final)."""
+    fc = _fleet(tmp_path, hosts=2)
+    for i in range(8):
+        fc.serve(i, *_batch(i))
+    fc.flush()
+    victims = [t for t, h in fc.tenants().items() if h == "host-0"][:2]
+    before = fc.tenant_digests()
+
+    def hook(stage):
+        if stage == "cutover":
+            raise _Boom("killed after commit")
+
+    with pytest.raises(_Boom):
+        fc.migrate(victims, "host-1", _stage_hook=hook)
+    after = fc.tenant_digests()
+    for tid in victims:
+        assert fc.tenants()[tid] == "host-1"
+        assert after[tid] == before[tid]
+        assert _roster_count(fc, tid) == 1
+    fc.close()
+
+
+def test_migration_guard_rails(tmp_path):
+    fc = _fleet(tmp_path, hosts=2)
+    fc.serve(0, *_batch(0))
+    with pytest.raises(TorchMetricsUserError):
+        fc.migrate([999], "host-1")  # unknown tenant
+    fc.kill_host("host-1")
+    with pytest.raises(TorchMetricsUserError):
+        fc.migrate([0], "host-1")  # dead destination
+    fc.close()
+
+
+# ------------------------------------------------------------------ failover
+
+
+def test_failover_bitwise_parity_and_rpo_zero(tmp_path):
+    """Lease expiry → survivors adopt from snapshot + journal tail, bitwise,
+    with RPO 0 at fsync-per-record; parked suspicion-window traffic replays
+    to the adopter in order."""
+    clock = {"t": 0.0}
+    fc = _fleet(tmp_path, hosts=3, clock=lambda: clock["t"],
+                lease=LeaseConfig(suspect_after=2.0, dead_after=5.0))
+    for i in range(18):
+        fc.serve(i % 9, *_batch(i))
+    fc.flush()
+    fc.snapshot_all()
+    for i in range(18, 27):
+        fc.serve(i % 9, *_batch(i))  # post-snapshot tail lives in the journal
+    fc.flush()
+    pre = fc.tenant_digests()
+    victim_tenants = {t for t, h in fc.tenants().items() if h == "host-1"}
+    assert victim_tenants
+    fc.kill_host("host-1")
+    # suspicion-window traffic for the dead host parks, nothing is dropped
+    parked_tid = sorted(victim_tenants)[0]
+    assert fc.serve(parked_tid, *_batch(777))
+    assert fc.stats["parked"] == 1
+    assert _expire(fc, clock) == ["host-1"]
+    assert fc.stats["failovers"] == 1
+    assert fc.stats["rpo_records"] == 0  # fsync_every=1: the journal is whole
+    assert fc.stats["replayed_parked"] == 1
+    assert "host-1" not in fc.hosts()
+    post = fc.tenant_digests()
+    for tid in pre:
+        if tid == parked_tid:
+            continue  # absorbed one extra (parked) batch by design
+        assert post[tid] == pre[tid], f"tenant {tid} not bitwise after adoption"
+        assert _roster_count(fc, tid) == 1
+    # the parked tenant folded the extra batch exactly once
+    ref = ServingEngine(_metric(), dataclasses.replace(_serving(), journal=None))
+    for i in range(27):
+        if i % 9 == parked_tid:
+            ref.update(parked_tid, *_batch(i))
+    ref.update(parked_tid, *_batch(777))
+    ref.flush()
+    assert post[parked_tid] == tenant_state_digest(ref, parked_tid)
+    ref.close()
+    fc.close()
+
+
+def test_failover_rejoin_no_double_count(tmp_path):
+    """After expiry + adoption the dead host can rejoin (epoch bump) and the
+    fleet still matches the uninterrupted reference — no tenant folded
+    anything twice across kill, adoption, and rejoin."""
+    clock = {"t": 0.0}
+    fc = _fleet(tmp_path, hosts=2, clock=lambda: clock["t"],
+                lease=LeaseConfig(suspect_after=2.0, dead_after=5.0))
+    log = []
+    for i in range(12):
+        fc.serve(i % 6, *_batch(i))
+        log.append((i % 6, i))
+    fc.flush()
+    fc.snapshot_all()
+    fc.kill_host("host-1")
+    assert _expire(fc, clock) == ["host-1"]
+    fc.add_host("host-1")  # rejoin: a NEW incarnation of the same id
+    assert fc.membership.members()["host-1"].epoch == 2
+    for i in range(12, 24):
+        fc.serve(i % 6, *_batch(i))
+        log.append((i % 6, i))
+    fleet_digests = fc.tenant_digests()
+    ref = ServingEngine(_metric(), dataclasses.replace(_serving(), journal=None))
+    for tid, i in log:
+        ref.update(tid, *_batch(i))
+    ref.flush()
+    for tid in set(t for t, _ in log):
+        assert fleet_digests[tid] == tenant_state_digest(ref, tid)
+    ref.close()
+    fc.close()
+
+
+def test_failover_replaces_stateless_suspicion_window_tenant(tmp_path):
+    """A tenant FIRST seen while its rendezvous owner is down has no durable
+    state to adopt — failover must re-place it (not KeyError, not lose it)
+    and the parked batches must fold on the new owner."""
+    clock = {"t": 0.0}
+    fc = _fleet(tmp_path, hosts=2, clock=lambda: clock["t"],
+                lease=LeaseConfig(suspect_after=2.0, dead_after=5.0))
+    fc.kill_host("host-1")
+    # find a tenant whose rendezvous seat is the dead host
+    fresh = next(t for t in range(1000) if fc.owner(t) == "host-1")
+    assert fc.serve(fresh, *_batch(0))  # parks: owner dead, lease unexpired
+    assert _expire(fc, clock) == ["host-1"]
+    assert fc.tenants()[fresh] == "host-0"  # re-placed among survivors
+    fc.flush()
+    ref = ServingEngine(_metric(), dataclasses.replace(_serving(), journal=None))
+    ref.update(fresh, *_batch(0))
+    ref.flush()
+    assert fc.tenant_digests()[fresh] == tenant_state_digest(ref, fresh)
+    ref.close()
+    fc.close()
+
+
+# ----------------------------------------------- bounded retention satellite
+
+
+def test_snapshot_prune_keeps_newest(tmp_path):
+    engine = ServingEngine(_metric(), _serving())
+    store_dir = str(tmp_path / "snaps")
+    for i in range(4):
+        engine.update(0, *_batch(i))
+        engine.flush()
+        engine.snapshot(store_dir)
+    store = SnapshotStore(store_dir)
+    gens = store.generations()
+    assert len(gens) == 4
+    doomed = store.prune(keep_last=2)
+    assert doomed == gens[:2]
+    assert store.generations() == gens[2:]
+    # the newest generation is untouchable and still loads
+    store.prune(keep_last=1)
+    assert store.generations() == [gens[-1]]
+    meta, _ = store.read(gens[-1])
+    assert meta["applied_seq"] >= 0 or True  # loadable is the assertion
+    with pytest.raises(TorchMetricsUserError):
+        store.prune(keep_last=0)
+    engine.close()
+
+
+def test_pruned_store_still_restores_and_replays_to_parity(tmp_path):
+    """retain_snapshots=1 prunes old generations AND the journal segments
+    they cover — and the survivor recipe (newest snapshot + remaining
+    journal) still reconstructs the pre-crash state bitwise."""
+    cfg = _serving(
+        journal=str(tmp_path / "journal"),
+        journal_segment_records=4,  # force rotations so pruning has prey
+        retain_snapshots=1,
+    )
+    engine = ServingEngine(_metric(), cfg)
+    retained = {}
+    snap_dir = str(tmp_path / "snaps")
+    for i in range(24):
+        engine.update(i % 5, *_batch(i))
+        engine.flush()
+        retained[engine._applied_seq] = ((_batch(i)), {})
+        if i % 6 == 5:
+            info = engine.snapshot(snap_dir)
+    assert SnapshotStore(snap_dir).generations() and len(
+        SnapshotStore(snap_dir).generations()
+    ) == 1  # keep_last=1 held
+    assert info.get("pruned_generations", 0) >= 1
+    seg_files = [f for f in os.listdir(tmp_path / "journal") if f.endswith(".tmj")]
+    assert len(seg_files) < 24 // 4 + 1, "covered journal segments were not pruned"
+    # more traffic past the last snapshot, then crash
+    for i in range(24, 30):
+        engine.update(i % 5, *_batch(i))
+        engine.flush()
+        retained[engine._applied_seq] = ((_batch(i)), {})
+    pre = {tid: tenant_state_digest(engine, tid) for tid in engine.tenants()}
+    engine._journal.crash()
+    # standby: newest snapshot + surviving journal tail
+    standby = ServingEngine(_metric(), dataclasses.replace(cfg, journal=None))
+    standby.restore(snap_dir)
+    from torchmetrics_tpu.serving import TrafficJournal
+
+    records = TrafficJournal.read(str(tmp_path / "journal"))
+    standby.replay_journal(records, lambda r: retained[r.seq])
+    standby.flush()
+    for tid, digest in pre.items():
+        assert tenant_state_digest(standby, tid) == digest
+    standby.close()
+
+
+# ---------------------------------------------------------------- fleet soak
+
+
+def _soak_config(root, steps=30, faults=None):
+    return SoakConfig(
+        traffic=TrafficConfig(steps=steps, tenants=10, seed=7),
+        faults=faults,
+        capacity=12,
+        megabatch_size=4,
+        spill_codec="none",
+        durability_dir=str(root),
+        snapshot_every=6,
+        journal_fsync_every=1,
+        fleet_hosts=3,
+    )
+
+
+def test_fleet_soak_parity_determinism_and_ledger(tmp_path):
+    faults = FaultSchedule([
+        FaultSpec(step=8, kind="host_loss", target="host-1"),
+        FaultSpec(step=16, kind="host_join"),
+    ])
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        first = run_soak(_soak_config(tmp_path / "a", faults=faults))
+        second = run_soak(_soak_config(tmp_path / "b", faults=faults))
+    c = first.counters
+    assert c["fleet_failover_parity"] == 1.0
+    assert c["migration_parity"] == 1.0
+    assert c["double_counted_batches"] == 0
+    assert c["failover_rpo_records"] == 0  # fsync_every=1
+    assert c["unrecovered_faults"] == 0
+    assert c["host_failovers"] == 1 and c["lease_expiries"] == 1
+    assert {r["kind"]: r["outcome"] for r in first.faults} == {
+        "host_loss": "recovered", "host_join": "recovered",
+    }
+    # the determinism contract: entire counter block byte-identical, and the
+    # combined per-tenant digest too
+    assert first.counters == second.counters
+    assert first.config["state_digest"] == second.config["state_digest"]
+    assert "migration_us" in first.timing  # wall-clock lives OUTSIDE counters
+
+
+def test_fleet_soak_guard_rails(tmp_path):
+    # host faults outside fleet mode are refused, not silently ignored
+    with pytest.raises(TorchMetricsUserError, match="fleet"):
+        run_soak(SoakConfig(
+            traffic=TrafficConfig(steps=12, tenants=4, seed=1),
+            faults=FaultSchedule([FaultSpec(step=2, kind="host_loss", target="host-0")]),
+        ))
+    # fleet mode arms ONLY host faults
+    with pytest.raises(TorchMetricsUserError, match="host_loss/host_join"):
+        run_soak(dataclasses.replace(
+            _soak_config(tmp_path),
+            faults=FaultSchedule([FaultSpec(step=2, kind="gather_flaky")]),
+        ))
+    # a fleet of one cannot fail over
+    with pytest.raises(ValueError, match="fleet_hosts"):
+        SoakConfig(fleet_hosts=1, durability_dir=str(tmp_path))
+    with pytest.raises(ValueError, match="durability_dir"):
+        SoakConfig(fleet_hosts=3)

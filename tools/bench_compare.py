@@ -94,6 +94,13 @@ THRESHOLDS: Dict[str, float] = {
     "extra.durable_failover.failover_state_parity": 0.01,
     "extra.durable_failover.recovery_parity": 0.01,
     "extra.durable_failover.degraded_sync_parity": 0.01,
+    # fleet_failover: the parity gates are exact 1.0-or-broken columns — the
+    # per-tenant digest match vs the uninterrupted reference, the bitwise
+    # migration landing, and run-to-run counter-block determinism
+    "extra.fleet_failover.fleet_failover_parity": 0.01,
+    "extra.fleet_failover.migration_parity": 0.01,
+    "extra.fleet_failover.fleet_determinism_parity": 0.01,
+    "extra.fleet_failover.soak_recovery_parity": 0.01,
     # multi-tenant serving engine: throughputs wobble like the flagship on a
     # shared pod; the naive baseline is a denominator like the torch proxy;
     # the spill column is a host<->device copy latency (noisy small values).
@@ -211,7 +218,12 @@ _HIGHER_EXACT = ("value", "vs_baseline", "tenants_per_dispatch",
                  # to the killed primary, failed-over run digest-equal to the
                  # uninterrupted reference, every rank loss reconciled
                  "failover_state_parity", "recovery_parity",
-                 "degraded_sync_parity")
+                 "degraded_sync_parity",
+                 # fleet_failover: 1.0-parity gates — every tenant digest-equal
+                 # to the uninterrupted single-host reference, every migration
+                 # bitwise, the whole counter block replayable run-to-run
+                 "fleet_failover_parity", "migration_parity",
+                 "fleet_determinism_parity")
 _LOWER_MARKERS = ("latency", "compile", "_sec", "_ms", "_us", "_bytes", "bytes_", "time")
 # collective counts per sync: fewer is the whole point of the coalesced plane —
 # a move back toward per-leaf collectives must gate even though the name
@@ -224,7 +236,11 @@ _LOWER_EXACT = ("collectives_per_sync", "dual_mem_window_ratio",
                 "shed_rate",
                 # durable_failover record loss: exactly 0 with fsync-per-record
                 # journaling — any growth is durability regressing
-                "failover_rpo_records")
+                "failover_rpo_records",
+                # fleet_failover exactly-once gate: a batch folded twice
+                # (a tenant seated on two hosts, a journal record re-applied)
+                # shows up here — exactly 0, any growth is a double count
+                "double_counted_batches")
 # deterministic workload constants: the coalesced-sync config's leaf counts,
 # the warm-start column's program count ("precompiled" would otherwise match
 # the "compile" latency marker and gate a constant), and the serving
@@ -266,7 +282,15 @@ _INFO_EXACT = ("leaves_coalesced_per_sync", "per_leaf_collectives", "ttfu_precom
                # the regressions these would only restate
                "replayed_records", "journal_records", "journal_fsyncs",
                "snapshots", "snapshot_restores", "degraded_syncs",
-               "rank_rejoins", "failovers")
+               "rank_rejoins", "failovers",
+               # fleet_failover workload descriptors: deterministic tallies of
+               # the seeded run (the parity/RPO/double-count columns gate the
+               # regressions these restate); migration_us is the wall-clock
+               # cost of the live moves — a latency headline too noisy at this
+               # scale to gate ("_us" would otherwise pin it lower-is-better)
+               "hosts", "hosts_joined", "host_failovers", "tenant_migrations",
+               "lease_expiries", "fleet_heartbeats", "adopted_tenants",
+               "parked_batches", "migration_us")
 
 
 def direction(name: str) -> Optional[str]:

@@ -15,6 +15,8 @@ Examples::
     python tools/chaos_soak.py --seed 7 --faults faults.json   # custom schedule
     python tools/chaos_soak.py --seed 7 --durability-dir /tmp/dur \
         --snapshot-every 30 --failover-at 70               # kill + failover, parity-gated
+    python tools/chaos_soak.py --seed 7 --durability-dir /tmp/dur \
+        --hosts 3 --kill-host host-1@40 --join-host @80    # fleet soak, parity-gated
 """
 
 from __future__ import annotations
@@ -58,12 +60,29 @@ def main(argv=None) -> int:
     parser.add_argument("--failover-at", type=int, default=None, metavar="STEP",
                         help="kill the primary at STEP and fail over to a standby "
                              "(latest snapshot + journal-tail replay, parity-checked)")
+    parser.add_argument("--retain-snapshots", type=int, default=None, metavar="K",
+                        help="keep only the newest K snapshot generations per host "
+                             "and prune journal segments they cover")
+    parser.add_argument("--hosts", type=int, default=None, metavar="N",
+                        help="run the FLEET soak over N member hosts "
+                             "(needs --durability-dir; arms host_loss/host_join only)")
+    parser.add_argument("--kill-host", action="append", default=[],
+                        metavar="HOST@STEP",
+                        help="fleet: crash HOST at STEP (journal tears at last "
+                             "fsync; lease runs to expiry, survivors adopt). "
+                             "Repeatable, e.g. --kill-host host-1@40")
+    parser.add_argument("--join-host", action="append", default=[],
+                        metavar="[HOST]@STEP",
+                        help="fleet: a new host joins at STEP and the rendezvous "
+                             "fair share of tenants migrates onto it. Repeatable; "
+                             "name optional, e.g. --join-host @80 or late-1@80")
     parser.add_argument("--summary", action="store_true",
                         help="print the one-line summary instead of the full JSON report")
     args = parser.parse_args(argv)
 
     from torchmetrics_tpu.chaos import (
         FaultSchedule,
+        FaultSpec,
         SoakConfig,
         TrafficConfig,
         TrafficModel,
@@ -84,6 +103,35 @@ def main(argv=None) -> int:
     if (args.snapshot_every or args.failover_at) and not args.durability_dir:
         parser.error("--snapshot-every/--failover-at need --durability-dir")
     faults = FaultSchedule.load(args.faults) if args.faults else None
+
+    if args.kill_host or args.join_host:
+        if args.hosts is None:
+            parser.error("--kill-host/--join-host need --hosts N (fleet soak)")
+
+        def _at(value: str, flag: str):
+            host, sep, step = value.rpartition("@")
+            if not sep or not step.isdigit():
+                parser.error(f"{flag} wants HOST@STEP, got {value!r}")
+            return host or None, int(step)
+
+        fleet_specs = list(faults) if faults is not None else []
+        for v in args.kill_host:
+            host, step = _at(v, "--kill-host")
+            if host is None:
+                parser.error(f"--kill-host needs a host id, got {v!r}")
+            fleet_specs.append(FaultSpec(step=step, kind="host_loss", target=host))
+        for v in args.join_host:
+            host, step = _at(v, "--join-host")
+            fleet_specs.append(FaultSpec(step=step, kind="host_join", target=host))
+        faults = FaultSchedule(fleet_specs)
+    if args.hosts is not None:
+        if not args.durability_dir:
+            parser.error("--hosts needs --durability-dir (per-host journals/snapshots)")
+        if args.failover_at is not None:
+            parser.error("--failover-at is the single-host drill; use --kill-host for fleets")
+        if faults is None:
+            faults = FaultSchedule([])  # fleet default: no faults, not every-kind
+
     config = SoakConfig(
         traffic=traffic,
         faults=faults,
@@ -96,6 +144,8 @@ def main(argv=None) -> int:
         durability_dir=args.durability_dir,
         snapshot_every=args.snapshot_every,
         failover_at=args.failover_at,
+        retain_snapshots=args.retain_snapshots,
+        fleet_hosts=args.hosts,
     )
     report = run_soak(config, traffic_model=model)
 
@@ -108,6 +158,14 @@ def main(argv=None) -> int:
     if report.counters.get("failover_state_parity", 1.0) != 1.0:
         failed = True
     if report.counters.get("degraded_sync_parity", 1.0) != 1.0:
+        failed = True
+    # fleet gates: per-tenant parity vs the uninterrupted reference, exact
+    # migration state parity, and zero double-folded batches
+    if report.counters.get("fleet_failover_parity", 1.0) != 1.0:
+        failed = True
+    if report.counters.get("migration_parity", 1.0) != 1.0:
+        failed = True
+    if report.counters.get("double_counted_batches", 0) != 0:
         failed = True
     return 1 if failed else 0
 

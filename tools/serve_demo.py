@@ -52,6 +52,10 @@ def main(argv=None) -> int:
     parser.add_argument("--chaos", type=int, default=None, metavar="SEED",
                         help="also run a small seeded chaos soak (one fault of every kind "
                              "through the full stack) and print its recovery summary")
+    parser.add_argument("--fleet", type=int, default=None, metavar="HOSTS",
+                        help="also run a small fleet smoke: HOSTS member engines, one "
+                             "host killed mid-run (lease expiry -> failover), one late "
+                             "join (rendezvous rebalance), per-tenant parity checked")
     args = parser.parse_args(argv)
 
     import numpy as np
@@ -154,8 +158,52 @@ def main(argv=None) -> int:
             "hint": report.summary(),
         }
 
+    if args.fleet is not None:
+        import tempfile
+
+        from torchmetrics_tpu.chaos import (
+            FaultSchedule,
+            FaultSpec,
+            SoakConfig,
+            TrafficConfig,
+            run_soak,
+        )
+
+        with tempfile.TemporaryDirectory(prefix="serve-demo-fleet-") as root:
+            report = run_soak(SoakConfig(
+                traffic=TrafficConfig(seed=0, tenants=min(args.tenants, 16), steps=40),
+                faults=FaultSchedule([
+                    FaultSpec(step=12, kind="host_loss", target="host-1"),
+                    FaultSpec(step=24, kind="host_join"),
+                ]),
+                capacity=8, megabatch_size=4, spill_codec="int8",
+                durability_dir=root, snapshot_every=8,
+                fleet_hosts=args.fleet,
+            ))
+        c = report.counters
+        out["fleet"] = {
+            "hosts": args.fleet,
+            "events": c["events"],
+            "failovers": c["host_failovers"],
+            "migrations": c["tenant_migrations"],
+            "fleet_failover_parity": c["fleet_failover_parity"],
+            "migration_parity": c["migration_parity"],
+            "failover_rpo_records": c["failover_rpo_records"],
+            "double_counted_batches": c["double_counted_batches"],
+            "unrecovered": c["unrecovered_faults"],
+            "hint": "parity 1.0 = the fleet folded every batch exactly once, "
+                    "bitwise-equal to one uninterrupted engine",
+        }
+
     print(json.dumps(out, indent=2, default=str))
     if args.chaos is not None and out["chaos"]["unrecovered"]:
+        return 1
+    if args.fleet is not None and (
+        out["fleet"]["fleet_failover_parity"] != 1.0
+        or out["fleet"]["migration_parity"] != 1.0
+        or out["fleet"]["double_counted_batches"]
+        or out["fleet"]["unrecovered"]
+    ):
         return 1
     return 0
 

@@ -67,7 +67,7 @@ class SignalNoiseRatio(_MeanAudioMetric):
         >>> metric = SignalNoiseRatio()
         >>> metric.update(preds, target)
         >>> metric.compute()
-        Array(12.176363, dtype=float32)
+        Array(12.176362, dtype=float32)
     """
 
     higher_is_better = True
@@ -91,7 +91,7 @@ class ScaleInvariantSignalNoiseRatio(_MeanAudioMetric):
         >>> metric = ScaleInvariantSignalNoiseRatio()
         >>> metric.update(preds, target)
         >>> metric.compute()
-        Array(12.534763, dtype=float32)
+        Array(12.534761, dtype=float32)
     """
 
     higher_is_better = True
@@ -111,7 +111,7 @@ class ComplexScaleInvariantSignalNoiseRatio(_MeanAudioMetric):
         >>> metric = ComplexScaleInvariantSignalNoiseRatio()
         >>> metric.update(preds, target)
         >>> metric.compute()
-        Array(-52.575077, dtype=float32)
+        Array(-52.57505, dtype=float32)
     """
 
     higher_is_better = True
@@ -137,7 +137,7 @@ class ScaleInvariantSignalDistortionRatio(_MeanAudioMetric):
         >>> metric = ScaleInvariantSignalDistortionRatio()
         >>> metric.update(preds, target)
         >>> metric.compute()
-        Array(12.216659, dtype=float32)
+        Array(12.216658, dtype=float32)
     """
 
     higher_is_better = True
@@ -161,7 +161,7 @@ class SourceAggregatedSignalDistortionRatio(_MeanAudioMetric):
         >>> metric = SourceAggregatedSignalDistortionRatio()
         >>> metric.update(preds, target)
         >>> metric.compute()
-        Array(-0.42774835, dtype=float32)
+        Array(-0.427748, dtype=float32)
     """
 
     higher_is_better = True
@@ -254,7 +254,7 @@ class PermutationInvariantTraining(_HostMeanAudioMetric):
         >>> metric = PermutationInvariantTraining(scale_invariant_signal_noise_ratio, eval_func='max')
         >>> metric.update(preds, target)
         >>> metric.compute()
-        Array(-0.18667197, dtype=float32)
+        Array(-0.18667257, dtype=float32)
     """
 
     higher_is_better = True

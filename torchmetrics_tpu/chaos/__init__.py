@@ -15,7 +15,7 @@ Three pieces (see ``docs/chaos.md``):
 """
 
 from .schedule import FAULT_KINDS, FaultSchedule, FaultSpec, default_fault_schedule
-from .soak import SoakConfig, SoakReport, run_soak, soak_rules
+from .soak import SoakConfig, SoakReport, run_fleet_soak, run_soak, soak_rules
 from .traffic import TrafficConfig, TrafficEvent, TrafficModel
 
 __all__ = [
@@ -28,6 +28,7 @@ __all__ = [
     "TrafficEvent",
     "TrafficModel",
     "default_fault_schedule",
+    "run_fleet_soak",
     "run_soak",
     "soak_rules",
 ]

@@ -45,6 +45,17 @@ coordination_outage the next ``count`` collective calls raise an
                     collective is entered (all ranks fail in lockstep);
                     the retry policy re-enters the sync — recovered when
                     the sync lands within budget.
+host_loss           (fleet soak only) member host ``target`` crashes: its
+                    journal tears at the last fsync, heartbeats stop, the
+                    lease runs to expiry — recovered when the survivors
+                    adopt its tenants from its latest snapshot generation
+                    plus the journal tail (``host_failovers`` ticks,
+                    bitwise parity against the uninterrupted reference).
+host_join           (fleet soak only) a new member host joins (``target``
+                    names it, default ``host-<n>``): the rendezvous fair
+                    share of tenants migrates onto it via the full
+                    drain → cutover protocol — recovered when the minimal
+                    move set commits with per-tenant state parity.
 ==================  ==========================================================
 
 Schedules serialize to/from JSON (``to_json``/``from_json``, ``save``/
@@ -67,6 +78,8 @@ FAULT_KINDS = (
     "clock_skew",
     "rank_loss",
     "coordination_outage",
+    "host_loss",
+    "host_join",
 )
 
 
@@ -79,7 +92,8 @@ class FaultSpec:
             the step's events are driven).
         kind: one of :data:`FAULT_KINDS`.
         target: kind-specific — tenant id (``tenant_fault``), state leaf
-            name (``state_poison``), skew seconds (``clock_skew``); unused
+            name (``state_poison``), skew seconds (``clock_skew``), host id
+            (``host_loss``, required; ``host_join``, optional); unused
             otherwise.
         count: kind-specific repetition — failing dispatches
             (``dispatch_transient``), failing gather calls
@@ -101,6 +115,8 @@ class FaultSpec:
             raise ValueError(f"count must be a positive integer, got {self.count}")
         if self.kind == "tenant_fault" and self.target is None:
             raise ValueError("tenant_fault needs target=<tenant id>")
+        if self.kind == "host_loss" and self.target is None:
+            raise ValueError("host_loss needs target=<host id>")
         if self.kind == "clock_skew":
             try:
                 float(self.target)  # type: ignore[arg-type]

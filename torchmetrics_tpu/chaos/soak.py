@@ -110,6 +110,19 @@ class SoakConfig:
             ``failover_rto_ms``; ``counters`` gain the replay/parity block.
         journal_fsync_every: fsync cadence of the write-ahead journal
             (1 = every record, the RPO=0 setting the parity gate assumes).
+        retain_snapshots: keep only the newest N snapshot generations per
+            engine (``ServingConfig.retain_snapshots``) — journal segments
+            every retained snapshot covers are pruned with them. ``None``
+            retains everything (unbounded growth under ``snapshot_every``).
+        fleet_hosts: run the FLEET soak (:func:`run_fleet_soak`) over this
+            many member hosts behind one :class:`FleetController` instead
+            of a single engine. Fleet mode admits unlimited (the per-tenant
+            parity gate compares against an uninterrupted single-host
+            reference, so admission must not fork) and arms only the
+            ``host_loss`` / ``host_join`` fault kinds.
+        fleet_suspect_after / fleet_dead_after: lease thresholds in virtual
+            seconds (suspect keeps its tenants — the flap window; dead
+            triggers adoption). Heartbeats renew every traffic step.
     """
 
     traffic: TrafficConfig = dataclasses.field(default_factory=TrafficConfig)
@@ -132,6 +145,10 @@ class SoakConfig:
     snapshot_every: Optional[int] = None
     failover_at: Optional[int] = None
     journal_fsync_every: int = 1
+    retain_snapshots: Optional[int] = None
+    fleet_hosts: Optional[int] = None
+    fleet_suspect_after: float = 0.75
+    fleet_dead_after: float = 1.5
 
     def __post_init__(self) -> None:
         if self.sync_every < 1:
@@ -152,6 +169,21 @@ class SoakConfig:
             raise ValueError(f"shed_rate_max must be in (0, 1], got {self.shed_rate_max}")
         if self.retry_attempts < 1:
             raise ValueError(f"retry_attempts must be >= 1, got {self.retry_attempts}")
+        if self.retain_snapshots is not None and self.retain_snapshots < 1:
+            raise ValueError(f"retain_snapshots must be >= 1, got {self.retain_snapshots}")
+        if self.fleet_hosts is not None:
+            if self.fleet_hosts < 2:
+                raise ValueError(
+                    f"fleet_hosts must be >= 2 (a fleet of one cannot fail over), "
+                    f"got {self.fleet_hosts}"
+                )
+            if not self.durability_dir:
+                raise ValueError("fleet_hosts needs durability_dir (per-host journals/snapshots)")
+        if not self.fleet_dead_after > self.fleet_suspect_after > 0:
+            raise ValueError(
+                f"need fleet_dead_after > fleet_suspect_after > 0, got "
+                f"{self.fleet_dead_after} / {self.fleet_suspect_after}"
+            )
 
 
 def soak_rules(
@@ -366,6 +398,8 @@ def run_soak(
     ``traffic_model`` (e.g. :meth:`TrafficModel.load_trace`) to replay a
     recorded stream instead of simulating ``config.traffic``."""
     cfg = config if config is not None else SoakConfig()
+    if cfg.fleet_hosts is not None:
+        return run_fleet_soak(cfg, traffic_model)
     model = traffic_model if traffic_model is not None else TrafficModel(cfg.traffic)
     traffic = model.config
     faults = cfg.faults if cfg.faults is not None else default_fault_schedule(traffic.steps)
@@ -373,6 +407,12 @@ def run_soak(
         raise TorchMetricsUserError(
             f"fault schedule reaches step {faults.last_step} but the traffic "
             f"runs only {traffic.steps} steps."
+        )
+    fleet_kinds = [s.kind for s in faults if s.kind in ("host_loss", "host_join")]
+    if fleet_kinds:
+        raise TorchMetricsUserError(
+            f"{sorted(set(fleet_kinds))} faults need the fleet soak — set "
+            "SoakConfig(fleet_hosts=N)"
         )
 
     _coalesce.clear_dead_ranks()  # liveness ledger is process-global — fresh run, fresh ledger
@@ -393,6 +433,7 @@ def run_soak(
             aot_cache_dir=cfg.aot_cache_dir,
             journal=journal_dir,
             journal_fsync_every=cfg.journal_fsync_every,
+            retain_snapshots=cfg.retain_snapshots,
         )
 
     engine = ServingEngine(_metric(traffic.num_classes), _serving_config())
@@ -785,5 +826,275 @@ def run_soak(
             "snapshot_every": cfg.snapshot_every,
             "failover_at": cfg.failover_at,
             "state_digest": final_digest,
+        },
+    )
+
+
+def run_fleet_soak(
+    config: Optional[SoakConfig] = None,
+    traffic_model: Optional[TrafficModel] = None,
+) -> SoakReport:
+    """The fleet soak: one :class:`~torchmetrics_tpu.fleet.FleetController`
+    over ``cfg.fleet_hosts`` member engines, driven by the same seeded
+    traffic, arming ``host_loss`` (crash a member, lease runs to expiry,
+    survivors adopt) and ``host_join`` (late member, rendezvous rebalance)
+    at exact steps.
+
+    The verdict is the per-tenant parity gate: after the run, the SAME
+    traffic folds into one uninterrupted single-host reference engine, and
+    every tenant's state digest must match bitwise —
+    ``fleet_failover_parity`` 1.0 means no kill point lost a batch, seated
+    a tenant twice, or double-folded a journaled record. Admission runs
+    unlimited in fleet mode so the reference cannot fork on shed decisions.
+    The ``counters`` block stays a pure function of (config, seed, faults);
+    ``migration_us`` is wall-clock and reports under ``timing``."""
+    if config is None or config.fleet_hosts is None:
+        raise TorchMetricsUserError(
+            "run_fleet_soak needs SoakConfig(fleet_hosts=N, durability_dir=...)"
+        )
+    cfg = config
+    from ..fleet import FleetController, LeaseConfig
+
+    model = traffic_model if traffic_model is not None else TrafficModel(cfg.traffic)
+    traffic = model.config
+    faults = cfg.faults if cfg.faults is not None else FaultSchedule([])
+    if faults.last_step >= traffic.steps:
+        raise TorchMetricsUserError(
+            f"fault schedule reaches step {faults.last_step} but the traffic "
+            f"runs only {traffic.steps} steps."
+        )
+    foreign = sorted({s.kind for s in faults} - {"host_loss", "host_join"})
+    if foreign:
+        raise TorchMetricsUserError(
+            f"the fleet soak arms only host_loss/host_join, got {foreign} — "
+            "run the single-host soak for the other kinds"
+        )
+
+    clock = {"t": 0.0}
+    serving = ServingConfig(
+        capacity=cfg.capacity,
+        megabatch_size=cfg.megabatch_size,
+        spill=True,
+        spill_codec=cfg.spill_codec,
+        on_error="quarantine",
+        max_tenants_per_sec=None,  # parity: admission must match the reference
+        window=cfg.window,
+        aot_cache_dir=cfg.aot_cache_dir,
+        journal_fsync_every=cfg.journal_fsync_every,
+        retain_snapshots=cfg.retain_snapshots,
+    )
+
+    def _fleet_metric() -> MulticlassAccuracy:
+        return _metric(traffic.num_classes)
+
+    records: List[Dict[str, Any]] = []
+    pending: Dict[str, List[Dict[str, Any]]] = {k: [] for k in FAULT_KINDS}
+    recovered = 0
+    unrecovered = 0
+    joined_hosts = 0
+    events_total = 0
+    served = 0
+    # arrival-ordered replay source for the reference engine: the exact
+    # batches the fleet saw (CPU-test sized traffic — bounded by the run)
+    replay_log: List[Tuple[int, tuple, dict]] = []
+
+    def _resolve(kind: str, outcome: str, n: int = 1) -> None:
+        for _ in range(n):
+            if pending[kind]:
+                pending[kind].pop(0)["outcome"] = outcome
+
+    t0 = time.perf_counter()
+    with _observability.telemetry_session(
+        _observability.TelemetryConfig(
+            slo_rules=tuple(default_rules()) + soak_rules(shed_rate_max=cfg.shed_rate_max),
+        )
+    ) as rec:
+        controller = FleetController(
+            _fleet_metric,
+            root=os.path.join(cfg.durability_dir, "fleet"),
+            hosts=cfg.fleet_hosts,
+            serving=serving,
+            lease=LeaseConfig(
+                heartbeat_interval=cfg.seconds_per_step,
+                suspect_after=cfg.fleet_suspect_after,
+                dead_after=cfg.fleet_dead_after,
+            ),
+            clock=lambda: clock["t"],
+        )
+
+        def _arm(spec: FaultSpec) -> None:
+            nonlocal joined_hosts, recovered, unrecovered
+            entry = {
+                "step": spec.step, "kind": spec.kind, "target": spec.target,
+                "count": spec.count, "outcome": "pending",
+            }
+            records.append(entry)
+            pending[spec.kind].append(entry)
+            if spec.kind == "host_loss":
+                controller.kill_host(str(spec.target))
+            elif spec.kind == "host_join":
+                host_id = spec.target or f"host-{cfg.fleet_hosts + joined_hosts}"
+                joined_hosts += 1
+                bad_before = controller.stats["migration_parity_failures"]
+                controller.add_host(str(host_id))
+                # the rebalance commits synchronously: recovered iff every
+                # move landed with per-tenant parity intact
+                if controller.stats["migration_parity_failures"] == bad_before:
+                    recovered += 1
+                    _resolve("host_join", "recovered")
+                else:
+                    unrecovered += 1
+                    _resolve("host_join", "unrecovered")
+
+        def _tick(step: int) -> None:
+            nonlocal recovered
+            clock["t"] += cfg.seconds_per_step
+            controller.heartbeat_all()
+            for host_id in controller.poll():
+                # survivors adopted the dead host's roster — host_loss done
+                recovered += 1
+                _resolve("host_loss", "recovered")
+            for spec in faults.due(step):
+                _arm(spec)
+            if cfg.snapshot_every and step and step % cfg.snapshot_every == 0:
+                controller.snapshot_all()
+
+        current_step = -1
+        for ev in model.events():
+            while current_step < ev.step:
+                current_step += 1
+                _tick(current_step)
+            events_total += 1
+            tid = int(ev.tenant_id)
+            replay_log.append((tid, (ev.batch[0], ev.batch[1]), {}))
+            if controller.serve(tid, ev.batch[0], ev.batch[1]):
+                served += 1
+            else:
+                unrecovered += 1  # unlimited admission: a rejection is a bug
+        while current_step < traffic.steps - 1:
+            current_step += 1
+            _tick(current_step)
+        # run the leases out so a kill near the end still fails over inside
+        # the run (the drain window is part of the soak, not lost coverage)
+        drain_ticks = int(cfg.fleet_dead_after / cfg.seconds_per_step) + 2
+        for _ in range(drain_ticks):
+            if not pending["host_loss"]:
+                break
+            current_step += 1
+            _tick(current_step)
+        controller.flush()
+        fleet_digests = controller.tenant_digests()
+        fleet_counts = {
+            tid: controller._hosts[host].engine.tenants()[tid]["update_count"]
+            for tid, host in controller.tenants().items()
+            if host in controller._hosts and not controller._hosts[host].killed
+        }
+        elapsed = time.perf_counter() - t0
+
+        # ---- the uninterrupted single-host reference: same batches, same
+        # arrival order, one engine, no faults — the parity oracle
+        reference = ServingEngine(
+            _fleet_metric(),
+            dataclasses.replace(serving, journal=None, clock=lambda: clock["t"]),
+        )
+        for tid, args, kwargs in replay_log:
+            reference.update(tid, *args, **kwargs)
+        reference.flush()
+        from ..fleet import tenant_state_digest as _tsd
+
+        ref_digests = {tid: _tsd(reference, tid) for tid in reference.tenants()}
+        ref_counts = {
+            tid: info["update_count"] for tid, info in reference.tenants().items()
+        }
+        parity = 1.0 if fleet_digests == ref_digests else 0.0
+        double_counted = sum(
+            max(0, int(fleet_counts.get(tid, 0)) - int(ref_counts.get(tid, 0)))
+            for tid in set(fleet_counts) | set(ref_counts)
+        )
+        reference.close()
+        controller.close()
+
+        # ledger close-out: a host_loss whose lease never expired in-run is
+        # unrecovered; anything else still pending never fired
+        for entry in list(pending["host_loss"]):
+            unrecovered += 1
+            _resolve("host_loss", "unrecovered")
+        for kind_pending in pending.values():
+            for entry in kind_pending:
+                if entry["outcome"] == "pending":
+                    entry["outcome"] = "not_fired"
+        injected = sum(1 for r in records if r["outcome"] != "not_fired")
+
+        snap = rec.counters.snapshot().counts
+        reconciliation = {
+            "dispatches": int(snap.get("dispatches", 0)),
+            "jit_compiles": int(snap.get("jit_compiles", 0)),
+            "jit_cache_hits": int(snap.get("jit_cache_hits", 0)),
+            "aot_cache_hits": int(snap.get("aot_cache_hits", 0)),
+        }
+        reconciliation["exact"] = (
+            reconciliation["jit_compiles"]
+            + reconciliation["jit_cache_hits"]
+            + reconciliation["aot_cache_hits"]
+            == reconciliation["dispatches"]
+        )
+
+    cstats = controller.stats
+    migration_parity = 1.0 if cstats["migration_parity_failures"] == 0 else 0.0
+    digest_h = hashlib.sha256()
+    for tid in sorted(fleet_digests, key=repr):
+        digest_h.update(f"{tid!r}={fleet_digests[tid]}".encode("utf-8"))
+    counters: Dict[str, Any] = {
+        "events": events_total,
+        "admitted": served,
+        "shed": 0,
+        "shed_rate": 0.0,
+        "steps": traffic.steps,
+        "tenants": len(fleet_digests),
+        "hosts": int(cfg.fleet_hosts),
+        "hosts_joined": joined_hosts,
+        "faults_injected": injected,
+        "recovered_faults": recovered,
+        "quarantined_faults": 0,
+        "unrecovered_faults": unrecovered,
+        "fleet_failover_parity": parity,
+        "migration_parity": migration_parity,
+        "failover_rpo_records": int(cstats["rpo_records"]),
+        "double_counted_batches": int(double_counted),
+        "host_failovers": int(snap.get("host_failovers", 0)),
+        "tenant_migrations": int(snap.get("tenant_migrations", 0)),
+        "lease_expiries": int(snap.get("lease_expiries", 0)),
+        "fleet_heartbeats": int(snap.get("fleet_heartbeats", 0)),
+        "adopted_tenants": int(cstats["adopted_tenants"]),
+        "parked_batches": int(cstats["parked"]),
+        "replayed_records": int(cstats["failover_replayed"]),
+        "snapshots": int(snap.get("snapshots", 0)),
+        "snapshot_restores": int(snap.get("snapshot_restores", 0)),
+        "journal_records": int(snap.get("journal_records", 0)),
+        "journal_fsyncs": int(snap.get("journal_fsyncs", 0)),
+    }
+    timing = {
+        "elapsed_s": round(elapsed, 6),
+        "migration_us": float(snap.get("migration_us", 0)),
+    }
+    return SoakReport(
+        counters=counters,
+        timing=timing,
+        faults=records,
+        slo_breaches=[],
+        reconciliation=reconciliation,
+        config={
+            "seed": traffic.seed,
+            "steps": traffic.steps,
+            "tenants": traffic.tenants,
+            "spill_codec": cfg.spill_codec,
+            "window": cfg.window,
+            "capacity": cfg.capacity,
+            "megabatch_size": cfg.megabatch_size,
+            "fleet_hosts": cfg.fleet_hosts,
+            "faults": len(faults),
+            "replayed": model.replayed,
+            "snapshot_every": cfg.snapshot_every,
+            "state_digest": digest_h.hexdigest(),
         },
     )

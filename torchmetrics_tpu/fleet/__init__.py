@@ -1,0 +1,37 @@
+"""Fleet failover plane: multi-host tenant placement, live migration, and
+host-loss recovery over N serving engines (``docs/fleet.md``).
+
+- :mod:`~torchmetrics_tpu.fleet.placement` — deterministic weighted
+  rendezvous-hash tenant→host map and the minimal-move rebalance planner;
+- :mod:`~torchmetrics_tpu.fleet.membership` — lease/heartbeat liveness on
+  the injectable virtual clock (alive → suspect → dead);
+- :mod:`~torchmetrics_tpu.fleet.controller` — the routing surface:
+  ``serve`` by placement, ``migrate`` with the drain → snapshot-slice →
+  transfer → restore → cutover protocol, and lease-expiry failover from
+  each host's snapshot generation + journal tail.
+"""
+
+from .controller import (
+    MIGRATION_STAGES,
+    FleetController,
+    MigrationAborted,
+    tenant_state_digest,
+)
+from .membership import LEASE_STATES, LeaseConfig, Member, Membership
+from .placement import Move, place, place_all, placement_score, rebalance_plan
+
+__all__ = [
+    "MIGRATION_STAGES",
+    "LEASE_STATES",
+    "FleetController",
+    "MigrationAborted",
+    "LeaseConfig",
+    "Member",
+    "Membership",
+    "Move",
+    "place",
+    "place_all",
+    "placement_score",
+    "rebalance_plan",
+    "tenant_state_digest",
+]

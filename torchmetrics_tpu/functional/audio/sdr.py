@@ -74,7 +74,7 @@ def scale_invariant_signal_distortion_ratio(preds, target, zero_mean: bool = Fal
         >>> preds = jnp.asarray([2.8, -1.2, 0.06, 1.3])
         >>> target = jnp.asarray([3.0, -0.5, 0.1, 1.0])
         >>> scale_invariant_signal_distortion_ratio(preds, target)
-        Array(12.216659, dtype=float32)
+        Array(12.216658, dtype=float32)
     """
     preds = jnp.asarray(preds)
     target = jnp.asarray(target)
@@ -103,7 +103,7 @@ def source_aggregated_signal_distortion_ratio(
         >>> preds = jnp.stack([jnp.sin(jnp.arange(100.0) / 9), jnp.cos(jnp.arange(100.0) / 7)])[None]
         >>> target = jnp.stack([jnp.sin(jnp.arange(100.0) / 10), jnp.cos(jnp.arange(100.0) / 8)])[None]
         >>> source_aggregated_signal_distortion_ratio(preds, target)
-        Array([-0.4277478], dtype=float32)
+        Array([-0.427748], dtype=float32)
     """
     preds = jnp.asarray(preds)
     target = jnp.asarray(target)

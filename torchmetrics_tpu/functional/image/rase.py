@@ -38,7 +38,7 @@ def relative_average_spectral_error(preds, target, window_size: int = 8) -> jnp.
         >>> preds = (jnp.arange(768, dtype=jnp.float32).reshape(1, 3, 16, 16) * 37 % 97) / 97
         >>> target = (jnp.arange(768, dtype=jnp.float32).reshape(1, 3, 16, 16) * 31 % 89) / 89
         >>> relative_average_spectral_error(preds, target)
-        Array(5315.8857, dtype=float32)
+        Array(5315.8853, dtype=float32)
     """
     if not isinstance(window_size, int) or window_size < 1:
         raise ValueError("Argument `window_size` is expected to be a positive integer.")

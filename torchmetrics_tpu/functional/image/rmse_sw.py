@@ -50,7 +50,7 @@ def root_mean_squared_error_using_sliding_window(
         >>> preds = (jnp.arange(768, dtype=jnp.float32).reshape(1, 3, 16, 16) * 37 % 97) / 97
         >>> target = (jnp.arange(768, dtype=jnp.float32).reshape(1, 3, 16, 16) * 31 % 89) / 89
         >>> root_mean_squared_error_using_sliding_window(preds, target)
-        Array(0.40987822, dtype=float32)
+        Array(0.4098781, dtype=float32)
     """
     if not isinstance(window_size, int) or window_size < 1:
         raise ValueError("Argument `window_size` is expected to be a positive integer.")

@@ -36,7 +36,7 @@ def spectral_angle_mapper(preds, target, reduction: Optional[str] = "elementwise
         >>> preds = (jnp.arange(768, dtype=jnp.float32).reshape(1, 3, 16, 16) * 37 % 97) / 97
         >>> target = (jnp.arange(768, dtype=jnp.float32).reshape(1, 3, 16, 16) * 31 % 89) / 89
         >>> spectral_angle_mapper(preds, target)
-        Array(0.65371865, dtype=float32)
+        Array(0.65371835, dtype=float32)
     """
     preds, target = _sam_update(preds, target)
     return _sam_compute(preds, target, reduction)

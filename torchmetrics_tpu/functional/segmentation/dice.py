@@ -108,7 +108,7 @@ def dice_score(
         >>> preds = jnp.asarray([[[0, 1, 1, 0], [1, 1, 0, 0], [2, 2, 1, 0], [2, 0, 0, 0]]])
         >>> target = jnp.asarray([[[0, 1, 1, 0], [1, 0, 0, 0], [2, 2, 0, 0], [2, 2, 0, 0]]])
         >>> dice_score(preds, target, num_classes=3, input_format='index')
-        Array([0.8102241], dtype=float32)
+        Array([0.81022406], dtype=float32)
     """
     _dice_score_validate_args(num_classes, include_background, average, input_format, aggregation_level)
     numerator, denominator, support = _dice_score_update(preds, target, num_classes, include_background, input_format)

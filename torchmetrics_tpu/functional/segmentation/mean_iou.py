@@ -87,7 +87,7 @@ def mean_iou(
         >>> preds = jnp.asarray([[[0, 1, 1, 0], [1, 1, 0, 0], [2, 2, 1, 0], [2, 0, 0, 0]]])
         >>> target = jnp.asarray([[[0, 1, 1, 0], [1, 0, 0, 0], [2, 2, 0, 0], [2, 2, 0, 0]]])
         >>> mean_iou(preds, target, num_classes=3, input_format='index')
-        Array([0.68333334], dtype=float32)
+        Array([0.6833334], dtype=float32)
     """
     _mean_iou_validate_args(num_classes, include_background, per_class, input_format)
     intersection, union = _mean_iou_update(preds, target, num_classes, include_background, input_format)

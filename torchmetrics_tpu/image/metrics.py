@@ -39,7 +39,7 @@ class UniversalImageQualityIndex(Metric):
         >>> metric = UniversalImageQualityIndex()
         >>> metric.update(preds, target)
         >>> metric.compute()
-        Array(0.05859956, dtype=float32)
+        Array(0.05859955, dtype=float32)
     """
 
     is_differentiable = True
@@ -176,7 +176,7 @@ class SpectralAngleMapper(Metric):
         >>> metric = SpectralAngleMapper()
         >>> metric.update(preds, target)
         >>> metric.compute()
-        Array(0.6083105, dtype=float32)
+        Array(0.6083106, dtype=float32)
     """
 
     is_differentiable = True
@@ -303,7 +303,7 @@ class RelativeAverageSpectralError(Metric):
         >>> metric = RelativeAverageSpectralError()
         >>> metric.update(preds, target)
         >>> metric.compute()
-        Array(5315.8857, dtype=float32)
+        Array(5315.8853, dtype=float32)
     """
 
     is_differentiable = True
@@ -346,7 +346,7 @@ class RootMeanSquaredErrorUsingSlidingWindow(Metric):
         >>> metric = RootMeanSquaredErrorUsingSlidingWindow()
         >>> metric.update(preds, target)
         >>> metric.compute()
-        Array(0.40987822, dtype=float32)
+        Array(0.4098781, dtype=float32)
     """
 
     is_differentiable = True

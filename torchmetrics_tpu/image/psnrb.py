@@ -22,7 +22,7 @@ class PeakSignalNoiseRatioWithBlockedEffect(Metric):
         >>> metric = PeakSignalNoiseRatioWithBlockedEffect(data_range=1.0, block_size=8)
         >>> metric.update(preds, target)
         >>> metric.compute()
-        Array(7.6286135, dtype=float32)
+        Array(7.6286116, dtype=float32)
     """
 
     is_differentiable = True

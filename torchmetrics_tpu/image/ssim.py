@@ -112,7 +112,7 @@ class MultiScaleStructuralSimilarityIndexMeasure(Metric):
         >>> metric = MultiScaleStructuralSimilarityIndexMeasure(data_range=1.0)
         >>> metric.update(preds, target)
         >>> metric.compute()
-        Array(0.14033246, dtype=float32)
+        Array(0.14033245, dtype=float32)
     """
 
     is_differentiable = True

@@ -606,6 +606,43 @@ class TelemetryRecorder:
             payload={"rank": int(rank), "epoch": int(epoch)},
         )
 
+    def record_fleet_heartbeat(self, host: str) -> None:
+        """One member-host lease renewal (fleet plane). Counter-only — a
+        heartbeat per host per step would swamp the event stream."""
+        self.counters.record_fleet_heartbeat()
+
+    def record_lease_expiry(self, host: str) -> None:
+        """One host lease past ``dead_after`` — the suspect → dead transition
+        the failover path keys off."""
+        self.counters.record_lease_expiry()
+
+    def record_migration(
+        self, label: str, src: str, dst: str, tenants: int, duration_s: float
+    ) -> None:
+        """One COMMITTED migration: ``tenants`` drained on ``src``,
+        snapshot-sliced, transferred, restored on ``dst`` and cut over."""
+        self.counters.record_migration(tenants, int(duration_s * 1e6))
+        self._event(
+            "migration", label, "commit",
+            duration_s=duration_s,
+            payload={"src": str(src), "dst": str(dst), "tenants": int(tenants)},
+        )
+
+    def record_host_failover(
+        self, label: str, host: str, tenants: int, replayed: int, rpo_records: int
+    ) -> None:
+        """One dead host's roster adopted by survivors: restored from its
+        latest snapshot generation plus ``replayed`` journal-tail records,
+        with ``rpo_records`` admissions unrecoverable (the fsync window)."""
+        self.counters.record_host_failover()
+        self._event(
+            "failover", label, "adopt",
+            payload={
+                "host": str(host), "tenants": int(tenants),
+                "replayed": int(replayed), "rpo_records": int(rpo_records),
+            },
+        )
+
     def record_d2h(self, site: str, nbytes: int, metric: Any = None) -> None:
         """An instrumented device→host readback (``state_dict``,
         ``compute_on_cpu`` appends, finiteness guards). The hot loop's

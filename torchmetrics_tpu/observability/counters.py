@@ -69,6 +69,11 @@ COUNTER_FIELDS: Tuple[str, ...] = (
     "replayed_records",  # journal records rolled forward into a restored engine
     "degraded_syncs",  # coalesced syncs completed over a survivor quorum (dead rank seen)
     "rank_rejoins",  # previously dead ranks whose contribution reconciled on rejoin
+    "fleet_heartbeats",  # member-host lease renewals seen by the fleet controller
+    "lease_expiries",  # host leases that ran past dead_after (suspect -> dead transitions)
+    "host_failovers",  # dead hosts whose tenants survivors adopted (snapshot + journal tail)
+    "tenant_migrations",  # tenants moved host-to-host by the committed migrate protocol
+    "migration_us",  # wall-clock spent inside committed migrations (drain -> cutover)
 )
 
 
@@ -438,6 +443,30 @@ class Counters:
         folds back in on this very sync (full-state gather, no double count)."""
         with self._lock:
             self._counts["rank_rejoins"] += 1
+
+    def record_fleet_heartbeat(self) -> None:
+        """One member-host lease renewal accepted by the fleet controller."""
+        with self._lock:
+            self._counts["fleet_heartbeats"] += 1
+
+    def record_lease_expiry(self) -> None:
+        """One host lease that ran past its expiry — the suspect → dead
+        transition that triggers tenant adoption by the survivors."""
+        with self._lock:
+            self._counts["lease_expiries"] += 1
+
+    def record_host_failover(self) -> None:
+        """One dead host whose tenant roster was adopted by survivors
+        (latest snapshot generation + journal-tail replay)."""
+        with self._lock:
+            self._counts["host_failovers"] += 1
+
+    def record_migration(self, tenants: int, duration_us: int) -> None:
+        """One committed host-to-host migration: ``tenants`` moved, with the
+        wall-clock the drain → cutover protocol took."""
+        with self._lock:
+            self._counts["tenant_migrations"] += int(tenants)
+            self._counts["migration_us"] += int(duration_us)
 
     # --------------------------------------------------------------- querying
 

@@ -47,6 +47,8 @@ EVENT_KINDS: Tuple[str, ...] = (
     "journal",  # write-ahead journal records replayed into a restored engine
     "degraded_sync",  # a coalesced sync completed over a survivor quorum (dead rank)
     "rank_rejoin",  # a previously dead rank reconciled back into the coalesced sync
+    "migration",  # a committed host-to-host tenant migration (fleet plane)
+    "failover",  # a dead host's tenants adopted by survivors (fleet plane)
 )
 
 

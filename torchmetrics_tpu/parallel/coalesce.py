@@ -99,7 +99,11 @@ _MAGIC = 0x436F414C  # "CoAL"
 # that died mid-collective contributes zeros to the gather): the plan marks
 # it dead, the bucket folds cover the survivor quorum, and the sync completes
 # degraded instead of hanging or folding the zero row as data
-_VERSION = 8
+# v9: fleet failover plane — the counter vector gained the fleet controller
+# fields (fleet_heartbeats / lease_expiries / host_failovers /
+# tenant_migrations / migration_us). Same mixed-version rule: an older rank's
+# shorter vector fails row validation rather than misaligning the new tail
+_VERSION = 9
 _HEADER_LEN = 6  # [magic, version, n_leaves, n_counter_fields, alive, epoch]
 _LEAF_REC_LEN = 2 + _MAX_RANK + 1  # [dtype_code, ndim, d0..d7, kind|codec<<1]
 _KIND_TENSOR = 0

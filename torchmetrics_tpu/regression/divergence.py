@@ -65,7 +65,7 @@ class KLDivergence(_DivergenceBase):
         >>> metric = KLDivergence()
         >>> metric.update(preds, target)
         >>> metric.compute()
-        Array(0.0852996, dtype=float32)
+        Array(0.08529959, dtype=float32)
     """
 
     def _measures(self, p, q):

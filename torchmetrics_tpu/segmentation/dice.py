@@ -26,7 +26,7 @@ class DiceScore(Metric):
         >>> metric = DiceScore(num_classes=3, input_format='index')
         >>> metric.update(preds, target)
         >>> metric.compute()
-        Array(0.8102241, dtype=float32)
+        Array(0.81022406, dtype=float32)
     """
 
     is_differentiable = False

@@ -28,7 +28,7 @@ class MeanIoU(Metric):
         >>> metric = MeanIoU(num_classes=3, input_format='index')
         >>> metric.update(preds, target)
         >>> metric.compute()
-        Array(0.68333334, dtype=float32)
+        Array(0.6833334, dtype=float32)
     """
 
     is_differentiable = False

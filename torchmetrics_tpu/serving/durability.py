@@ -180,6 +180,26 @@ class SnapshotStore:
         _fsync_write(self.root, final, blob)
         return {"generation": generation, "path": final, "bytes": len(blob)}
 
+    def prune(self, keep_last: int = 1) -> List[int]:
+        """Delete all but the newest ``keep_last`` generations; returns the
+        generations removed (oldest first).
+
+        The newest generation is never removable (``keep_last`` must be
+        >= 1): pruning bounds disk growth, it must not take away the only
+        snapshot a restore could start from. Deleting an old generation is
+        safe at any time — generations are immutable once published, and
+        nothing references one except an explicit ``read(generation=)``."""
+        if keep_last < 1:
+            raise TorchMetricsUserError(f"keep_last must be >= 1, got {keep_last}")
+        gens = self.generations()
+        doomed = gens[:-int(keep_last)] if len(gens) > keep_last else []
+        for gen in doomed:
+            try:
+                os.unlink(self.path_for(gen))
+            except OSError:
+                pass  # already gone — pruning is idempotent
+        return doomed
+
     def read(self, generation: Optional[int] = None) -> Tuple[Dict[str, Any], Dict[str, np.ndarray]]:
         """Decode one generation (latest by default) → ``(meta, sections)``.
 
@@ -306,6 +326,7 @@ class TrafficJournal:
         self._fh.write(struct.pack(_HEADER_LEN_FMT, len(header)))
         self._fh.write(header)
         self._seg_records = 0
+        self._synced_bytes = 0  # durable high-water mark of the active segment
 
     def append(
         self,
@@ -351,6 +372,7 @@ class TrafficJournal:
         if self._pending:
             self.fsyncs += 1
         self._pending = 0
+        self._synced_bytes = self._fh.tell()
 
     def _rotate(self) -> None:
         self.flush()
@@ -362,6 +384,23 @@ class TrafficJournal:
         if self._fh is not None and not self._fh.closed:
             self.flush()
             self._fh.close()
+
+    def crash(self) -> None:
+        """Simulate process death at this instant: cut the active segment
+        back to its last fsync, discarding every record past the durable
+        high-water mark — exactly the torn tail :meth:`read` tolerates on
+        the final segment. With ``fsync_every=1`` nothing is lost (RPO=0);
+        larger batches lose at most the pending ``fsync_every - 1``
+        records. The fleet soak's ``host_loss`` fault uses this so a killed
+        host's journal looks like a real crash, not a clean shutdown."""
+        if self._fh is None or self._fh.closed:
+            return
+        path = self._seg_path(self._segment)
+        try:
+            self._fh.close()  # flushes python buffers; durability is decided below
+        finally:
+            with open(path, "r+b") as fh:
+                fh.truncate(self._synced_bytes)
 
     def __enter__(self) -> "TrafficJournal":
         return self
@@ -390,67 +429,100 @@ class TrafficJournal:
         out: List[JournalRecord] = []
         last_seq = 0
         for si, path in enumerate(segments):
-            is_last = si == len(segments) - 1
-            with open(path, "rb") as fh:
-                raw = fh.read()
-            ctx = f"journal segment {path!r}"
-            off = len(JOURNAL_MAGIC)
-            if not raw.startswith(JOURNAL_MAGIC) or len(raw) < off + _REC_FRAME_LEN - 4:
-                if is_last and len(raw) < off + struct.calcsize(_HEADER_LEN_FMT):
-                    break  # rotation crashed before the header landed
-                raise StateCorruptionError(f"{ctx}: bad magic")
-            (hlen,) = struct.unpack_from(_HEADER_LEN_FMT, raw, off)
-            off += struct.calcsize(_HEADER_LEN_FMT)
-            if hlen <= 0 or hlen > _MAX_HEADER_BYTES:
-                raise StateCorruptionError(f"{ctx}: header length {hlen} out of bounds")
-            if off + hlen > len(raw):
-                if is_last:
-                    break  # torn header tail on the final segment
-                raise StateCorruptionError(f"{ctx}: truncated header")
-            try:
-                header = json.loads(raw[off : off + hlen].decode("utf-8"))
-            except Exception as err:  # noqa: BLE001
-                raise StateCorruptionError(f"{ctx}: undecodable header: {err}") from err
-            if header.get("version") != JOURNAL_VERSION:
-                raise StateCorruptionError(f"{ctx}: unsupported version {header.get('version')}")
-            off += hlen
-            while off < len(raw):
-                if off + _REC_FRAME_LEN > len(raw):
-                    if is_last:
-                        off = len(raw)
-                        break  # torn frame tail — bounded loss
-                    raise StateCorruptionError(f"{ctx}: truncated record frame")
-                blen, crc = struct.unpack_from(_REC_FRAME_FMT, raw, off)
-                body_at = off + _REC_FRAME_LEN
-                if body_at + blen > len(raw):
-                    if is_last:
-                        off = len(raw)
-                        break  # torn body tail — bounded loss
-                    raise StateCorruptionError(f"{ctx}: truncated record body")
-                body = raw[body_at : body_at + blen]
-                if zlib.crc32(body) != crc:
-                    # a COMPLETE record that fails its CRC is a bitflip, not a
-                    # crash tail — never silently skipped
-                    raise StateCorruptionError(f"{ctx}: record CRC mismatch at offset {off}")
-                try:
-                    doc = json.loads(body.decode("utf-8"))
-                    rec = JournalRecord(
-                        seq=int(doc["seq"]),
-                        tenant_id=decode_tenant_id(doc["tenant"]),
-                        digest=str(doc["digest"]),
-                        t=float(doc.get("t", 0.0)),
-                        kind=str(doc.get("kind", "admit")),
-                        rolled_back=tuple(int(s) for s in doc.get("rolled_back", ())),
-                    )
-                except StateCorruptionError:
-                    raise
-                except Exception as err:  # noqa: BLE001
-                    raise StateCorruptionError(f"{ctx}: undecodable record: {err}") from err
+            for rec in _decode_segment(path, is_last=si == len(segments) - 1):
                 if rec.seq <= last_seq:
                     raise StateCorruptionError(
-                        f"{ctx}: sequence regressed ({rec.seq} after {last_seq})"
+                        f"journal segment {path!r}: sequence regressed ({rec.seq} after {last_seq})"
                     )
                 last_seq = rec.seq
                 out.append(rec)
-                off = body_at + blen
         return out
+
+    # ----------------------------------------------------------------- prune
+
+    def prune_covered(self, applied_seq: int) -> List[int]:
+        """Delete rotated segments whose every record is already covered by a
+        retained snapshot's seq cursor; returns the segments removed.
+
+        Replay skips records at or below the snapshot's ``applied_seq``, so a
+        segment whose last record's seq is ``<= applied_seq`` contributes
+        nothing to any restore that starts from that snapshot (or a newer
+        one) — it is dead weight. Seqs are monotone across segments, so
+        pruning stops at the first segment with an uncovered record. The
+        segment currently open for appends is never touched."""
+        removed: List[int] = []
+        for seg in self._segments():
+            if seg >= self._segment:
+                break
+            recs = _decode_segment(self._seg_path(seg), is_last=False)
+            if recs and recs[-1].seq > int(applied_seq):
+                break
+            try:
+                os.unlink(self._seg_path(seg))
+            except OSError:
+                pass  # already gone — pruning is idempotent
+            removed.append(seg)
+        return removed
+
+
+def _decode_segment(path: str, is_last: bool) -> List[JournalRecord]:
+    """Decode one segment file. Torn tails are tolerated only when
+    ``is_last`` (nothing was ever appended past a rotated segment's fsync);
+    any complete-but-wrong frame raises :class:`StateCorruptionError`."""
+    with open(path, "rb") as fh:
+        raw = fh.read()
+    ctx = f"journal segment {path!r}"
+    out: List[JournalRecord] = []
+    off = len(JOURNAL_MAGIC)
+    if not raw.startswith(JOURNAL_MAGIC) or len(raw) < off + _REC_FRAME_LEN - 4:
+        if is_last and len(raw) < off + struct.calcsize(_HEADER_LEN_FMT):
+            return out  # rotation crashed before the header landed
+        raise StateCorruptionError(f"{ctx}: bad magic")
+    (hlen,) = struct.unpack_from(_HEADER_LEN_FMT, raw, off)
+    off += struct.calcsize(_HEADER_LEN_FMT)
+    if hlen <= 0 or hlen > _MAX_HEADER_BYTES:
+        raise StateCorruptionError(f"{ctx}: header length {hlen} out of bounds")
+    if off + hlen > len(raw):
+        if is_last:
+            return out  # torn header tail on the final segment
+        raise StateCorruptionError(f"{ctx}: truncated header")
+    try:
+        header = json.loads(raw[off : off + hlen].decode("utf-8"))
+    except Exception as err:  # noqa: BLE001
+        raise StateCorruptionError(f"{ctx}: undecodable header: {err}") from err
+    if header.get("version") != JOURNAL_VERSION:
+        raise StateCorruptionError(f"{ctx}: unsupported version {header.get('version')}")
+    off += hlen
+    while off < len(raw):
+        if off + _REC_FRAME_LEN > len(raw):
+            if is_last:
+                break  # torn frame tail — bounded loss
+            raise StateCorruptionError(f"{ctx}: truncated record frame")
+        blen, crc = struct.unpack_from(_REC_FRAME_FMT, raw, off)
+        body_at = off + _REC_FRAME_LEN
+        if body_at + blen > len(raw):
+            if is_last:
+                break  # torn body tail — bounded loss
+            raise StateCorruptionError(f"{ctx}: truncated record body")
+        body = raw[body_at : body_at + blen]
+        if zlib.crc32(body) != crc:
+            # a COMPLETE record that fails its CRC is a bitflip, not a
+            # crash tail — never silently skipped
+            raise StateCorruptionError(f"{ctx}: record CRC mismatch at offset {off}")
+        try:
+            doc = json.loads(body.decode("utf-8"))
+            rec = JournalRecord(
+                seq=int(doc["seq"]),
+                tenant_id=decode_tenant_id(doc["tenant"]),
+                digest=str(doc["digest"]),
+                t=float(doc.get("t", 0.0)),
+                kind=str(doc.get("kind", "admit")),
+                rolled_back=tuple(int(s) for s in doc.get("rolled_back", ())),
+            )
+        except StateCorruptionError:
+            raise
+        except Exception as err:  # noqa: BLE001
+            raise StateCorruptionError(f"{ctx}: undecodable record: {err}") from err
+        out.append(rec)
+        off = body_at + blen
+    return out

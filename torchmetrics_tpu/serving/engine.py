@@ -161,6 +161,14 @@ class ServingConfig:
             ``journal_fsync_every - 1`` records.
         journal_segment_records: rotate to a fresh journal segment file after
             this many records (bounds per-file recovery scan cost).
+        retain_snapshots: keep only the newest N snapshot generations after
+            each :meth:`ServingEngine.snapshot` (and drop journal segments
+            every retained snapshot already covers). ``None`` (default)
+            retains everything — unbounded disk growth under periodic
+            snapshotting. The newest generation is never pruned, and the
+            journal tail past the OLDEST retained snapshot's seq cursor is
+            always kept, so restore + replay from any retained generation
+            still reaches the exact pre-crash state.
     """
 
     capacity: int = 1024
@@ -180,6 +188,7 @@ class ServingConfig:
     journal: Optional[str] = None
     journal_fsync_every: int = 1
     journal_segment_records: int = 512
+    retain_snapshots: Optional[int] = None
 
     def __post_init__(self) -> None:
         if self.capacity < 1:
@@ -219,6 +228,11 @@ class ServingConfig:
         if self.journal_segment_records < 1:
             raise ValueError(
                 f"journal_segment_records must be >= 1, got {self.journal_segment_records}"
+            )
+        if self.retain_snapshots is not None and self.retain_snapshots < 1:
+            raise ValueError(
+                f"retain_snapshots must be >= 1 (or None for unbounded), "
+                f"got {self.retain_snapshots}"
             )
 
 
@@ -982,6 +996,24 @@ class ServingEngine:
         if t.resident and t.shape_key is not None:
             self._spill(t, self._classes[t.shape_key])
 
+    def forget(self, tenant_id: Hashable) -> None:
+        """Drop one tenant entirely — slot freed (row zeroed back to the
+        default state), spilled copy and bookkeeping discarded. The fleet
+        migration cutover uses this on the source host once the destination
+        owns the tenant; any queued traffic is flushed first so no admitted
+        batch is silently dropped."""
+        t = self._require(tenant_id)
+        if t.pending:
+            self.flush()
+        if t.resident and t.shape_key in self._classes:
+            cls = self._classes[t.shape_key]
+            for name, leaf in self._row_defaults.items():
+                cls.stacked[name] = cls.stacked[name].at[t.slot].set(jnp.asarray(leaf))
+            cls.stacked[TENANT_COUNT_KEY] = cls.stacked[TENANT_COUNT_KEY].at[t.slot].set(0.0)
+            cls.slot_tenant.pop(t.slot, None)
+            cls.free.append(t.slot)
+        del self._tenants[tenant_id]
+
     def state_dict(self, tenant_id: Hashable) -> Dict[str, Any]:
         """One tenant's checkpoint, shaped exactly like ``Metric.state_dict``
         output so it loads into a standalone metric (and back via
@@ -1100,6 +1132,14 @@ class ServingEngine:
         }
         out = store.write(meta, sections)
         out["tenants"] = len(tenants_meta)
+        if self.config.retain_snapshots is not None:
+            pruned = store.prune(keep_last=self.config.retain_snapshots)
+            out["pruned_generations"] = len(pruned)
+            if pruned and self._journal is not None:
+                # the OLDEST retained snapshot's cursor bounds what replay can
+                # ever need — segments at or below it are dead weight
+                oldest_meta, _ = store.read(store.generations()[0])
+                self._journal.prune_covered(int(oldest_meta.get("applied_seq", 0)))
         rec = _observability._ACTIVE
         if rec is not None:
             rec.record_snapshot(
