@@ -274,6 +274,9 @@ def test_disabled_telemetry_constructs_no_events(monkeypatch):
     monkeypatch.setattr(obs.HistogramRegistry, "record_duration", boom)
     monkeypatch.setattr(obs.SloEngine, "observe", boom)
     monkeypatch.setattr(obs.SloEngine, "evaluate", boom)
+    # the causal trace plane must be silent too: no span objects, no id hashing
+    monkeypatch.setattr(obs.spans.SpanContext, "__init__", boom)
+    monkeypatch.setattr(obs.spans, "_digest", boom)
     m = _SumState()
     m.update(_x())
     m.forward(_x())

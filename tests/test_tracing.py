@@ -1,0 +1,398 @@
+"""Causal trace plane tests (observability/spans.py, observability/flightrec.py,
+the fleet control tower). Marker ``tracing``.
+
+The load-bearing claims, each pinned:
+
+- **deterministic ids**: trace/span ids are pure functions of their parts —
+  no wall clock, no PRNG — so two same-seed runs produce identical causal
+  trees;
+- **propagation**: events emitted under an active span carry its
+  trace/span/parent ids; megabatch seating fans admission spans into the
+  ``serve`` event's ``links``;
+- **flight recorder**: terminal events auto-dump an atomic JSON artifact
+  whose ``causal``/``counters`` blocks are clock-free (the determinism
+  contract) while wall-clock detail lands in ``runtime``;
+- **the drill** (acceptance): a seeded fleet soak with a ``host_loss`` dumps
+  an artifact whose causal tree links the fault-ledger entry → the failover
+  event (roster naming the dead host's tenants) → the adopted tenants'
+  replay spans — byte-identical across two same-seed runs;
+- **control tower**: ``FleetController.telemetry()`` rolls up per-host
+  counters + hot tenants, and ``/fleetz`` (plus ``/sloz``/``/metricsz``)
+  answer against a live fleet;
+- **render coverage** (lint): every ``EVENT_KINDS`` entry has a pinned
+  ``EVENT_RENDERERS`` row in tools/trace_report.py, enforced by graftlint.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import http.client
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import warnings
+
+import numpy as np
+import pytest
+
+import torchmetrics_tpu.observability as obs
+from torchmetrics_tpu.chaos import (
+    FaultSchedule,
+    FaultSpec,
+    SoakConfig,
+    TrafficConfig,
+    run_soak,
+)
+from torchmetrics_tpu.classification import MulticlassAccuracy
+from torchmetrics_tpu.fleet import FleetController, active_controller
+from torchmetrics_tpu.serving import ServingConfig, ServingEngine
+
+pytestmark = pytest.mark.tracing
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NUM_CLASSES = 3
+BATCH = 4
+
+
+def _metric():
+    return MulticlassAccuracy(NUM_CLASSES, average="micro", validate_args=False)
+
+
+def _batch(i: int):
+    rng = np.random.default_rng(1000 + i)
+    preds = rng.normal(size=(BATCH, NUM_CLASSES)).astype(np.float32)
+    target = rng.integers(0, NUM_CLASSES, BATCH, dtype=np.int32)
+    return preds, target
+
+
+def _load_trace_report():
+    spec = importlib.util.spec_from_file_location(
+        "trace_report", os.path.join(REPO, "tools", "trace_report.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ------------------------------------------------------------------- spans
+
+
+def test_span_ids_deterministic_and_nested():
+    t1 = obs.spans.derive_trace_id("serve", "7", 3)
+    t2 = obs.spans.derive_trace_id("serve", "7", 3)
+    assert t1 == t2 and len(t1) == 16 and int(t1, 16) >= 0
+    assert obs.spans.derive_trace_id("serve", "7", 4) != t1
+    s1 = obs.spans.derive_span_id(t1, None, "a")
+    assert s1 == obs.spans.derive_span_id(t1, None, "a")
+    assert s1 != obs.spans.derive_span_id(t1, s1, "a")  # parent feeds the hash
+
+    assert obs.spans.current() is None
+    root = obs.spans.enter("root", 1)
+    assert obs.spans.current() is root and root.parent_id is None
+    child = obs.spans.enter("child")
+    assert child.trace_id == root.trace_id  # trace inherited from parent
+    assert child.parent_id == root.span_id
+    obs.spans.exit(child)
+    assert obs.spans.current() is root
+    # exit(root) pops leaked frames above it too
+    leaked = obs.spans.enter("leaked")
+    assert obs.spans.current() is leaked
+    obs.spans.exit(root)
+    assert obs.spans.current() is None
+
+    with obs.spans.scope("scoped", 9) as ctx:
+        assert obs.spans.current() is ctx
+    assert obs.spans.current() is None
+
+
+def test_events_carry_active_span_and_serve_links():
+    with obs.telemetry_session() as rec:
+        with obs.spans.scope("fault", "host-1") as ctx:
+            rec.record_degraded_sync("acc", [2], 4)
+        rec.record_rank_rejoin("acc", 2, 5)  # outside any span
+        (deg,) = rec.events_of("degraded_sync")
+        assert deg.trace_id == ctx.trace_id
+        assert deg.span_id == ctx.span_id and deg.parent_id is None
+        (rej,) = rec.events_of("rank_rejoin")
+        assert rej.trace_id is None and rej.span_id is None
+
+        # megabatch fan-in: admission spans land in the serve event's links
+        engine = ServingEngine(_metric(), ServingConfig(capacity=8, megabatch_size=2))
+        with obs.spans.scope("serve", "t0", 1) as c0:
+            engine.update(0, *_batch(0))
+        with obs.spans.scope("serve", "t1", 2) as c1:
+            engine.update(1, *_batch(1))
+        engine.flush()
+        serve_events = rec.events_of("serve")
+        assert serve_events, "megabatch dispatch emitted no serve event"
+        links = [tid for ev in serve_events for tid in ev.payload.get("links", ())]
+        assert {c0.trace_id, c1.trace_id} <= set(links)
+        engine.close()
+
+
+def test_jsonl_sink_stamps_host(tmp_path):
+    trace = tmp_path / "t.jsonl"
+    sink = obs.JSONLSink(str(trace), host="pod-3")
+    with obs.telemetry_session(obs.TelemetryConfig(sinks=(sink, obs.RingBufferSink()))) as rec:
+        rec.record_rank_rejoin("acc", 1, 1)
+    lines = [json.loads(l) for l in trace.read_text().splitlines()]
+    assert lines and all(e["host"] == "pod-3" for e in lines)
+    # default host: the machine's hostname, never absent
+    assert obs.JSONLSink(str(tmp_path / "u.jsonl")).host
+
+
+# ---------------------------------------------------------- flight recorder
+
+
+def test_flight_recorder_auto_dump_contract(tmp_path):
+    flight = obs.FlightRecorder(dump_dir=str(tmp_path / "fr"))
+    cfg = obs.TelemetryConfig(sinks=(obs.RingBufferSink(), flight))
+    with obs.telemetry_session(cfg) as rec:
+        with obs.spans.scope("collection", "acc"):
+            rec.record_quarantine("acc", "update", "frozen", ValueError("boom"), 7)
+        assert len(flight.dumps) == 1  # quarantine is a DUMP_KIND
+        # the dump itself emitted a flightrec event + ticked the counter
+        (fev,) = rec.events_of("flightrec")
+        assert fev.tag == "quarantine" and fev.payload["seq"] == 1
+        assert rec.counters.snapshot()["flightrec_dumps"] == 1
+    art = flight.dumps[0]
+    files = sorted(os.listdir(tmp_path / "fr"))
+    assert files == ["flightrec-quarantine-0001.json"]
+    on_disk = json.loads((tmp_path / "fr" / files[0]).read_text())
+    assert on_disk["reason"] == "quarantine"
+    # determinism contract: no clocks or byte sizes inside the causal block
+    for ev in on_disk["causal"]["events"]:
+        assert "timestamp" not in ev and "duration_s" not in ev
+        assert "bytes" not in ev.get("payload", {})
+    for field in art["counters"]:
+        assert field not in obs.flightrec_module.NONDETERMINISTIC_COUNTERS
+    # the quarantine event is in the tree under the collection span
+    trees = on_disk["causal"]["tree"]
+    kinds = [e[0] for t in trees for s in t["spans"] for e in s["events"]]
+    assert "quarantine" in kinds
+
+    # explicit dump with no session still writes a (counter-less) artifact
+    lone = obs.FlightRecorder(dump_dir=str(tmp_path / "lone"))
+    art2 = lone.dump("manual", extra={"note": 1})
+    assert art2["counters"] == {} and art2["extra"] == {"note": 1}
+    assert os.path.exists(os.path.join(str(tmp_path / "lone"), "flightrec-manual-0001.json"))
+
+
+def _drill_config(root, seed=7):
+    return SoakConfig(
+        traffic=TrafficConfig(steps=30, tenants=10, seed=seed),
+        faults=FaultSchedule([FaultSpec(step=8, kind="host_loss", target="host-1")]),
+        capacity=12,
+        megabatch_size=4,
+        spill_codec="none",
+        durability_dir=str(root),
+        snapshot_every=6,
+        journal_fsync_every=1,
+        fleet_hosts=3,
+    )
+
+
+@pytest.mark.fleet
+@pytest.mark.chaos
+def test_fleet_soak_dump_on_kill_drill(tmp_path):
+    """Acceptance: the seeded host-loss drill dumps an artifact whose causal
+    tree links fault-ledger entry → failover event (roster = the killed
+    host's in-flight tenants) → the adopted tenants' replay spans, and the
+    contractual block is byte-identical across two same-seed runs."""
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        first = run_soak(_drill_config(tmp_path / "a"))
+        second = run_soak(_drill_config(tmp_path / "b"))
+    assert first.counters["unrecovered_faults"] == 0
+    assert first.counters["host_failovers"] == 1
+
+    def _artifact(root):
+        fr = root / "flightrec"
+        files = sorted(os.listdir(fr))
+        assert files, "the host_loss drill dumped no artifact"
+        assert files[0].startswith("flightrec-failover-")
+        return json.loads((fr / files[0]).read_text())
+
+    art = _artifact(tmp_path / "a")
+    fault = first.faults[0]
+    assert fault["kind"] == "host_loss" and fault["trace_id"]
+
+    # the fault-ledger trace id roots a tree in the artifact
+    trees = {t["trace"]: t for t in art["causal"]["tree"]}
+    assert fault["trace_id"] in trees
+    tree = trees[fault["trace_id"]]
+
+    def _walk(nodes):
+        for n in nodes:
+            yield n
+            yield from _walk(n["children"])
+
+    kinds = [e[0] for n in _walk(tree["spans"]) for e in n["events"]]
+    assert "failover" in kinds  # the adoption happened INSIDE the fault trace
+    assert "journal" in kinds or "snapshot" in kinds  # replay/restore spans linked
+
+    # the failover event names the killed host and its in-flight tenants
+    failover_evs = [e for e in art["causal"]["events"] if e["kind"] == "failover"]
+    assert failover_evs
+    payload = failover_evs[0]["payload"]
+    assert payload["host"] == "host-1"
+    assert payload["roster"], "failover event carries no adopted-tenant roster"
+    assert len(payload["roster"]) == payload["tenants"]
+    assert failover_evs[0]["trace_id"] == fault["trace_id"]
+
+    # byte-identical determinism contract across the two same-seed runs
+    art_b = _artifact(tmp_path / "b")
+    blob = lambda a: json.dumps(
+        {"causal": a["causal"], "counters": a["counters"]}, sort_keys=True
+    )
+    assert blob(art) == blob(art_b)
+
+    # the soak report carries the control-tower rollup (non-contractual)
+    ft = first.fleet_telemetry
+    assert ft and set(ft["hosts"]) == {"host-0", "host-2"}  # host-1 is dead
+    assert ft["totals"]["serve_dispatches"] > 0
+
+
+# ------------------------------------------------------------ control tower
+
+
+@pytest.mark.slo
+def test_control_tower_telemetry_and_fleetz(tmp_path):
+    with obs.telemetry_session(obs.TelemetryConfig(slo_rules=obs.default_rules())):
+        fc = FleetController(
+            _metric,
+            root=str(tmp_path / "fleet"),
+            hosts=3,
+            serving=ServingConfig(capacity=16, megabatch_size=4, journal_fsync_every=1),
+        )
+        assert active_controller() is fc
+        for i in range(8):
+            fc.serve(i, *_batch(i))
+        fc.flush()
+        tower = fc.telemetry(top_k=3)
+        assert set(tower["hosts"]) == {"host-0", "host-1", "host-2"}
+        assert tower["totals"]["serve_tenant_rows"] == 8
+        assert sum(h["serve_tenant_rows"] for h in tower["hosts"].values()) == 8
+        assert len(tower["hot_tenants"]) == 3 and tower["tenant_count"] == 8
+        assert tower["hot_tenants"][0]["rows"] >= tower["hot_tenants"][-1]["rows"]
+        assert set(tower["membership"].values()) == {"alive"}
+        assert "vupdate" in tower.get("latency", {})
+
+        with obs.HealthServer(port=0) as srv:
+            def get(path):
+                conn = http.client.HTTPConnection(srv.host, srv.port, timeout=30)
+                conn.request("GET", path)
+                resp = conn.getresponse()
+                return resp.status, resp.read().decode()
+
+            status, body = get("/fleetz")
+            doc = json.loads(body)
+            assert status == 200 and doc["fleet"] is True
+            assert doc["totals"] == tower["totals"]
+            assert doc["tenant_count"] == 8
+            # the rest of the health plane answers over the same live fleet
+            status, body = get("/sloz")
+            assert status == 200 and "rules" in json.loads(body)
+            status, body = get("/metricsz")
+            assert status == 200
+            assert "tpu_metrics_serve_dispatches_total" in body
+            status, body = get("/nope")
+            assert status == 404 and "/fleetz" in json.loads(body)["endpoints"]
+        fc.close()
+        assert active_controller() is None
+    # no controller: /fleetz stays honest
+    with obs.HealthServer(port=0) as srv:
+        conn = http.client.HTTPConnection(srv.host, srv.port, timeout=30)
+        conn.request("GET", "/fleetz")
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert json.loads(resp.read().decode()) == {"fleet": False}
+
+
+# ------------------------------------------------------- rendering & lint
+
+
+def test_trace_report_tree_and_renderer_coverage(tmp_path):
+    tr = _load_trace_report()
+    assert set(tr.EVENT_RENDERERS) == set(obs.EVENT_KINDS)
+
+    # the stdlib tree builder mirrors the canonical flightrec one exactly
+    events = []
+    with obs.telemetry_session() as rec:
+        with obs.spans.scope("fault", "host-9") as root:
+            rec.record_degraded_sync("acc", [1], 4)
+            with obs.spans.scope("inner"):
+                rec.record_rank_rejoin("acc", 1, 2)
+        events = [e.to_dict() for e in rec.events]
+    canonical = obs.flightrec_module.build_causal_tree(events)
+    mirrored = tr.build_causal_tree(events)
+    assert json.dumps(canonical, sort_keys=True) == json.dumps(mirrored, sort_keys=True)
+    assert canonical[0]["trace"] == root.trace_id
+    rendered = tr.render_tree(mirrored)
+    assert "degraded_sync" in rendered and "rank_rejoin" in rendered
+
+    # --tree CLI renders both a JSONL trace and a flight-recorder artifact
+    trace = tmp_path / "t.jsonl"
+    trace.write_text("\n".join(json.dumps(e) for e in events) + "\n")
+    flight = obs.FlightRecorder(dump_dir=str(tmp_path / "fr"))
+    for e in rec.events:
+        flight.emit(e)
+    art_path = flight.dump("manual")["runtime"]["path"]
+    script = os.path.join(REPO, "tools", "trace_report.py")
+    for src in (str(trace), art_path):
+        res = subprocess.run(
+            [sys.executable, script, src, "--tree"],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert res.returncode == 0, res.stderr
+        assert f"trace {root.trace_id}" in res.stdout
+        assert "rank_rejoin" in res.stdout
+
+
+@pytest.mark.lint
+def test_graftlint_renderer_rule():
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        from graftlint import layout
+    finally:
+        sys.path.pop(0)
+
+    def _read(*parts):
+        with open(os.path.join(REPO, *parts), "r", encoding="utf-8") as fh:
+            return fh.read()
+
+    srcs = dict(
+        counters_src=_read("torchmetrics_tpu", "observability", "counters.py"),
+        histograms_src=_read("torchmetrics_tpu", "observability", "histograms.py"),
+        coalesce_src=_read("torchmetrics_tpu", "parallel", "coalesce.py"),
+        events_src=_read("torchmetrics_tpu", "observability", "events.py"),
+        ledger=json.loads(_read("tools", "graftlint", "layout_ledger.json")),
+        observability_md=_read("docs", "observability.md"),
+    )
+    report_src = _read("tools", "trace_report.py")
+
+    def rules(trace_report_src):
+        fs = layout.check_fleet_layout(
+            srcs["counters_src"], srcs["histograms_src"], srcs["coalesce_src"],
+            srcs["events_src"], srcs["ledger"], srcs["observability_md"],
+            trace_report_src=trace_report_src,
+        )
+        return [f for f in fs if f.rule.startswith("layout/renderer")]
+
+    assert rules(report_src) == []  # the committed table is complete
+    # drop one renderer row: the missing kind is named
+    mutated = report_src.replace('"flightrec": ', '"_dropped": ', 1)
+    found = rules(mutated)
+    assert any(f.rule == "layout/renderer-missing" and f.detail == "flightrec" for f in found)
+    assert any(f.rule == "layout/renderer-unknown" and f.detail == "_dropped" for f in found)
+    # a computed table is unauditable, not silently accepted
+    unparseable = report_src.replace(
+        "EVENT_RENDERERS: Dict[str, str] = {", "EVENT_RENDERERS: Dict[str, str] = dict({", 1
+    ).replace('"flightrec": "flight-recorder section: one line per postmortem artifact",\n}',
+              '"flightrec": "flight-recorder section: one line per postmortem artifact",\n})')
+    found = rules(unparseable)
+    assert any(f.rule == "layout/renderer-unparseable" for f in found)
+    # repo-rooted runner wires the real file through (no renderer findings)
+    assert [f for f in layout.run(REPO) if f.rule.startswith("layout/renderer")] == []

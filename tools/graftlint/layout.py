@@ -54,6 +54,31 @@ def parse_str_tuple(source: str, varname: str) -> Optional[List[str]]:
     return None
 
 
+def parse_dict_str_keys(source: str, varname: str) -> Optional[List[str]]:
+    """Extract the string keys of a module-level ``VARNAME = {"a": ..., ...}``
+    dict literal (values are free-form; only the key set is contractual)."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return None
+    for node in tree.body:
+        targets: List[ast.expr] = []
+        value: Optional[ast.expr] = None
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        for tgt in targets:
+            if isinstance(tgt, ast.Name) and tgt.id == varname:
+                if isinstance(value, ast.Dict) and all(
+                    isinstance(k, ast.Constant) and isinstance(k.value, str)
+                    for k in value.keys
+                ):
+                    return [k.value for k in value.keys]
+                return None
+    return None
+
+
 def parse_int_assign(source: str, varname: str) -> Optional[int]:
     try:
         tree = ast.parse(source)
@@ -103,14 +128,48 @@ def check_fleet_layout(
     events_src: Optional[str],
     ledger: Optional[Dict[str, Any]],
     observability_md: Optional[str],
+    *,
+    trace_report_src: Optional[str] = None,
 ) -> List[Finding]:
-    """Source-text based so tests can feed mutated copies."""
+    """Source-text based so tests can feed mutated copies.
+
+    ``trace_report_src`` (keyword-only; ``None`` skips the check) is
+    ``tools/trace_report.py`` — its pinned ``EVENT_RENDERERS`` table must
+    cover ``EVENT_KINDS`` exactly, so a new event kind cannot ship without a
+    rendering story."""
     findings: List[Finding] = []
     c_path = "torchmetrics_tpu/observability/counters.py"
     h_path = "torchmetrics_tpu/observability/histograms.py"
     v_path = "torchmetrics_tpu/parallel/coalesce.py"
     e_path = "torchmetrics_tpu/observability/events.py"
     doc_path = "docs/observability.md"
+    r_path = "tools/trace_report.py"
+
+    if trace_report_src is not None:
+        kinds_for_renderers = (
+            parse_str_tuple(events_src, "EVENT_KINDS") if events_src else None
+        ) or []
+        renderers = parse_dict_str_keys(trace_report_src, "EVENT_RENDERERS")
+        if renderers is None:
+            findings.append(Finding(
+                "layout/renderer-unparseable", r_path, "EVENT_RENDERERS", "unparseable",
+                "could not statically extract EVENT_RENDERERS from tools/trace_report.py "
+                "— keep it a plain {str: ...} dict literal so the renderer-coverage "
+                "check stays auditable"))
+        else:
+            for kind in kinds_for_renderers:
+                if kind not in renderers:
+                    findings.append(Finding(
+                        "layout/renderer-missing", r_path, "EVENT_RENDERERS", kind,
+                        f"event kind `{kind}` (EVENT_KINDS) has no entry in "
+                        "tools/trace_report.py:EVENT_RENDERERS — every kind the runtime "
+                        "emits must say where it lands in the trace report"))
+            for kind in renderers:
+                if kind not in kinds_for_renderers:
+                    findings.append(Finding(
+                        "layout/renderer-unknown", r_path, "EVENT_RENDERERS", kind,
+                        f"EVENT_RENDERERS names `{kind}` which is not in EVENT_KINDS — "
+                        "a stale renderer row hides real coverage gaps"))
 
     fields = parse_str_tuple(counters_src, "COUNTER_FIELDS") if counters_src else None
     kinds = parse_str_tuple(histograms_src, "FLEET_HISTOGRAM_KINDS") if histograms_src else None
@@ -216,4 +275,5 @@ def run(root: str) -> List[Finding]:
         _read(os.path.join(root, "torchmetrics_tpu", "observability", "events.py")),
         ledger,
         _read(os.path.join(root, "docs", "observability.md")),
+        trace_report_src=_read(os.path.join(root, "tools", "trace_report.py")) or "",
     )

@@ -194,6 +194,19 @@ def main(argv=None) -> int:
             "hint": "parity 1.0 = the fleet folded every batch exactly once, "
                     "bitwise-equal to one uninterrupted engine",
         }
+        # the control tower rollup: FleetController.telemetry() captured just
+        # before teardown — per-host counters plus the hottest tenants
+        ft = report.fleet_telemetry
+        if ft:
+            out["fleet"]["control_tower"] = {
+                "per_host": {
+                    host: {k: v for k, v in counters.items() if v}
+                    for host, counters in sorted(ft.get("hosts", {}).items())
+                },
+                "hot_tenants": ft.get("hot_tenants", []),
+                "membership": ft.get("membership", {}),
+                "tenant_count": ft.get("tenant_count"),
+            }
 
     print(json.dumps(out, indent=2, default=str))
     if args.chaos is not None and out["chaos"]["unrecovered"]:

@@ -34,9 +34,45 @@ import sys
 from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 
+# The pinned kind → rendering table: every entry of
+# ``torchmetrics_tpu.observability.EVENT_KINDS`` MUST have a row here saying
+# where that kind lands in the report (graftlint's layout/renderer-missing
+# rule diffs the two, so a new event kind cannot ship render-less). Keys are
+# parsed statically — keep this a plain dict literal.
+EVENT_RENDERERS: Dict[str, str] = {
+    "dispatch": "per-(metric, phase) row: events/compiles/cache_hits/span time",
+    "compute": "per-(metric, phase) row: events + span time",
+    "sync": "per-(metric, phase) row + footer sync totals (payload bytes, collectives)",
+    "retry": "footer retry total + one detail line per event",
+    "retry_exhausted": "footer exhausted total + one detail line per event",
+    "quarantine": "footer quarantine total + one detail line per event",
+    "retrace": "retraces column on the matching (metric, phase) row",
+    "aot_load": "footer aot_loads total",
+    "d2h": "footer d2h readback/byte totals",
+    "state_growth": "footer state_growth_warnings total",
+    "alert": "footer alerts total + one detail line per breach",
+    "hist": "p50/p99 columns on latency rows + footer fleet percentiles",
+    "serve": "per-(metric, phase) row (megabatched vupdate dispatches)",
+    "tenant_spill": "footer tenant spill/readmit totals",
+    "window_roll": "streaming section: window wrap total",
+    "async_sync": "streaming section: overlap/wait accounting",
+    "serve_rejected": "streaming section: admission-rejected total",
+    "quant": "quantized-sync per-codec compression rows",
+    "snapshot": "durability section: write/restore counts + bytes",
+    "journal": "durability section: replay count + records rolled forward",
+    "degraded_sync": "fleet section: survivor-quorum sync count + dead ranks",
+    "rank_rejoin": "fleet section: rejoin count",
+    "migration": "fleet section: committed moves + tenants + src→dst routes",
+    "failover": "fleet section: adoptions + replay/RPO + one detail line per host",
+    "flightrec": "flight-recorder section: one line per postmortem artifact",
+}
+
+
 def load_events(path: str, rank: Optional[Any] = None) -> List[Dict[str, Any]]:
     """Read one trace file; ``rank`` (if given) is stamped on every event so a
-    multi-host merge keeps attribution."""
+    multi-host merge keeps attribution. With no explicit rank, the per-line
+    ``host`` field a :class:`JSONLSink` stamps becomes the rank label — a
+    fleet's merged traces attribute themselves."""
     events = []
     with open(path, "r", encoding="utf-8") as fh:
         for lineno, line in enumerate(fh, 1):
@@ -50,6 +86,8 @@ def load_events(path: str, rank: Optional[Any] = None) -> List[Dict[str, Any]]:
                 continue
             if rank is not None:
                 ev["_rank"] = rank
+            elif "host" in ev:
+                ev["_rank"] = ev["host"]
             events.append(ev)
     return events
 
@@ -102,7 +140,23 @@ def aggregate(events: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
         "sync_collectives": 0, "leaves_coalesced": 0,
         "window_wraps": 0, "async_syncs": 0, "serve_rejected": 0,
         "quant_syncs": 0, "quant_bytes_saved": 0,
+        "aot_loads": 0, "state_growth_warnings": 0, "alerts": 0,
+        "tenant_spills": 0, "tenant_readmits": 0,
     }
+    # durability plane: snapshot/journal events (engine crash-consistency)
+    durability = {
+        "snapshot_writes": 0, "snapshot_restores": 0, "snapshot_bytes": 0,
+        "journal_replays": 0, "journal_records_replayed": 0,
+    }
+    # fleet plane: quorum syncs, rejoins, migrations, host failovers
+    fleet: Dict[str, Any] = {
+        "degraded_syncs": 0, "dead_ranks": set(), "rank_rejoins": 0,
+        "migrations": 0, "tenants_migrated": 0, "routes": [],
+        "failovers": 0, "tenants_adopted": 0, "records_replayed": 0,
+        "rpo_records": 0, "failover_details": [],
+    }
+    flightrec: List[Dict[str, Any]] = []
+    alerts: List[Dict[str, Any]] = []
     # async double-buffered syncs: gather wall vs commit wait, per event
     async_stats = {"gather_s": 0.0, "wait_s": 0.0, "overlap_pct_sum": 0.0, "fallbacks": 0}
     # quantized syncs: per-(rank, codec) compression rows
@@ -118,7 +172,7 @@ def aggregate(events: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
         tag = ev.get("tag", "")
         rank = ev.get("_rank")
         any_rank = any_rank or rank is not None
-        if kind in ("dispatch", "compute", "sync"):
+        if kind in ("dispatch", "compute", "sync", "serve"):
             row = rows.setdefault((rank, metric, tag), _new_row())
             row["events"] += 1
             if kind == "dispatch":
@@ -189,6 +243,64 @@ def aggregate(events: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
             if tag in _LATENCY_KINDS:
                 _merge_hist(row_hists, (rank, metric, tag), payload)
             _merge_hist(kind_hists, tag, payload)
+        elif kind == "aot_load":
+            totals["aot_loads"] += 1
+        elif kind == "state_growth":
+            totals["state_growth_warnings"] += 1
+        elif kind == "alert":
+            totals["alerts"] += 1
+            alerts.append(ev)
+        elif kind == "tenant_spill":
+            if tag == "readmit":
+                totals["tenant_readmits"] += 1
+            else:
+                totals["tenant_spills"] += 1
+        elif kind == "snapshot":
+            payload = ev.get("payload", {})
+            if tag == "restore":
+                durability["snapshot_restores"] += 1
+            else:
+                durability["snapshot_writes"] += 1
+            durability["snapshot_bytes"] += int(payload.get("bytes", 0))
+        elif kind == "journal":
+            payload = ev.get("payload", {})
+            durability["journal_replays"] += 1
+            durability["journal_records_replayed"] += int(payload.get("records", 0))
+        elif kind == "degraded_sync":
+            payload = ev.get("payload", {})
+            fleet["degraded_syncs"] += 1
+            fleet["dead_ranks"].update(int(r) for r in payload.get("dead", ()))
+        elif kind == "rank_rejoin":
+            fleet["rank_rejoins"] += 1
+        elif kind == "migration":
+            payload = ev.get("payload", {})
+            fleet["migrations"] += 1
+            fleet["tenants_migrated"] += int(payload.get("tenants", 0))
+            route = f"{payload.get('src', '?')}->{payload.get('dst', '?')}"
+            if route not in fleet["routes"]:
+                fleet["routes"].append(route)
+        elif kind == "failover":
+            payload = ev.get("payload", {})
+            fleet["failovers"] += 1
+            fleet["tenants_adopted"] += int(payload.get("tenants", 0))
+            fleet["records_replayed"] += int(payload.get("replayed", 0))
+            fleet["rpo_records"] += int(payload.get("rpo_records", 0))
+            fleet["failover_details"].append({
+                "host": payload.get("host"),
+                "tenants": int(payload.get("tenants", 0)),
+                "replayed": int(payload.get("replayed", 0)),
+                "rpo_records": int(payload.get("rpo_records", 0)),
+                "roster": list(payload.get("roster", ())),
+                "trace_id": ev.get("trace_id"),
+            })
+        elif kind == "flightrec":
+            payload = ev.get("payload", {})
+            flightrec.append({
+                "reason": tag,
+                "seq": payload.get("seq"),
+                "events": payload.get("events"),
+                "path": payload.get("path"),
+            })
     def _rank_key(rank: Any) -> Tuple[int, int, str]:
         # ints sort numerically (rank 2 before rank 10 on a 64-host pod),
         # string labels lexicographically after, None (single file) first
@@ -260,11 +372,98 @@ def aggregate(events: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
         if any_rank:
             entry["rank"] = rank
         quant.append(entry)
+    durability_out = None
+    if any(durability.values()):
+        durability_out = dict(durability)
+    fleet_out = None
+    if (fleet["degraded_syncs"] or fleet["rank_rejoins"] or fleet["migrations"]
+            or fleet["failovers"]):
+        fleet_out = dict(fleet)
+        fleet_out["dead_ranks"] = sorted(fleet["dead_ranks"])
     return {
         "rows": report_rows, "totals": totals, "retries": retries, "quarantines": quarantines,
         "latency": latency, "multi_rank": any_rank, "streaming": streaming,
-        "quant": quant or None,
+        "quant": quant or None, "alerts": alerts or None,
+        "durability": durability_out, "fleet": fleet_out,
+        "flightrec": flightrec or None,
     }
+
+
+def build_causal_tree(events: Iterable[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Group span-carrying events into per-trace span trees — a stdlib mirror
+    of ``observability.flightrec.build_causal_tree`` (kept dependency-free so
+    traces render on a laptop; pinned against the canonical implementation by
+    a parity test). Span nodes: ``{"span", "parent", "events", "children"}``;
+    a span whose parent never emitted becomes a root."""
+    by_trace: Dict[str, Dict[str, Dict[str, Any]]] = {}
+    trace_order: List[str] = []
+    for ev in events:
+        trace_id = ev.get("trace_id")
+        span_id = ev.get("span_id")
+        if trace_id is None or span_id is None:
+            continue
+        if trace_id not in by_trace:
+            by_trace[trace_id] = {}
+            trace_order.append(trace_id)
+        spans = by_trace[trace_id]
+        node = spans.get(span_id)
+        if node is None:
+            node = {"span": span_id, "parent": ev.get("parent_id"),
+                    "events": [], "children": []}
+            spans[span_id] = node
+        node["events"].append([ev.get("kind"), ev.get("metric"), ev.get("tag")])
+    trees: List[Dict[str, Any]] = []
+    for trace_id in sorted(by_trace):
+        spans = by_trace[trace_id]
+        roots: List[Dict[str, Any]] = []
+        for node in spans.values():
+            parent = node["parent"]
+            if parent is not None and parent in spans and spans[parent] is not node:
+                spans[parent]["children"].append(node)
+            else:
+                roots.append(node)
+        trees.append({"trace": trace_id, "spans": roots})
+    return trees
+
+
+def render_tree(trees: List[Dict[str, Any]]) -> str:
+    """ASCII causal-tree view: one block per trace, spans indented under
+    their parents, each span listing its (kind, metric, tag) events."""
+    lines: List[str] = []
+
+    def _span(node: Dict[str, Any], depth: int) -> None:
+        pad = "  " * depth
+        parent = f" parent={node['parent']}" if node.get("parent") else ""
+        lines.append(f"{pad}span {node['span']}{parent}")
+        for kind, metric, tag in node["events"]:
+            lines.append(f"{pad}  - {kind} {metric} [{tag}]")
+        for child in node["children"]:
+            _span(child, depth + 1)
+
+    for tree in trees:
+        lines.append(f"trace {tree['trace']}")
+        for root in tree["spans"]:
+            _span(root, 1)
+        lines.append("")
+    if not lines:
+        return "(no span-carrying events)"
+    return "\n".join(lines).rstrip()
+
+
+def load_tree_source(path: str, rank: Optional[Any] = None) -> List[Dict[str, Any]]:
+    """Events for ``--tree``: a JSONL trace, or a flight-recorder artifact
+    (a single JSON object whose ``causal.events`` block carries the ring)."""
+    with open(path, "r", encoding="utf-8") as fh:
+        head = fh.read(1)
+    if head == "{":
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                doc = json.load(fh)
+        except json.JSONDecodeError:
+            doc = None
+        if isinstance(doc, dict) and isinstance(doc.get("causal"), dict):
+            return list(doc["causal"].get("events", ()))
+    return load_events(path, rank=rank)
 
 
 def render_table(report: Dict[str, Any]) -> str:
@@ -322,6 +521,46 @@ def render_table(report: Dict[str, Any]) -> str:
         if s["serve_rejected"]:
             line += f"  admission-rejected batches: {s['serve_rejected']}"
         lines.append(line)
+    if report.get("durability"):
+        d = report["durability"]
+        lines.append(
+            f"durability: {d['snapshot_writes']} snapshot write(s) + "
+            f"{d['snapshot_restores']} restore(s) ({d['snapshot_bytes']} bytes)  "
+            f"journal replays: {d['journal_replays']} "
+            f"({d['journal_records_replayed']} records rolled forward)"
+        )
+    if report.get("fleet"):
+        f = report["fleet"]
+        line = (
+            f"fleet: {f['failovers']} failover(s) ({f['tenants_adopted']} tenants adopted, "
+            f"{f['records_replayed']} records replayed, RPO {f['rpo_records']})  "
+            f"migrations: {f['migrations']} ({f['tenants_migrated']} tenants"
+        )
+        if f["routes"]:
+            line += ", " + ", ".join(f["routes"])
+        line += ")"
+        if f["degraded_syncs"] or f["rank_rejoins"]:
+            line += (
+                f"  degraded syncs: {f['degraded_syncs']} "
+                f"(dead ranks: {f['dead_ranks']})  rejoins: {f['rank_rejoins']}"
+            )
+        lines.append(line)
+        for det in f["failover_details"]:
+            roster = ", ".join(det["roster"]) if det["roster"] else "-"
+            trace = f" trace={det['trace_id']}" if det.get("trace_id") else ""
+            lines.append(
+                f"  failover {det['host']}: {det['tenants']} tenant(s) "
+                f"[{roster}] replayed={det['replayed']} rpo={det['rpo_records']}{trace}"
+            )
+    if report.get("flightrec"):
+        lines.append("flight recorder dumps:")
+        for d in report["flightrec"]:
+            path = f" -> {d['path']}" if d.get("path") else ""
+            lines.append(f"  #{d.get('seq')} {d['reason']} ({d.get('events')} events in ring){path}")
+    if report.get("alerts"):
+        for ev in report["alerts"]:
+            p = ev.get("payload", {})
+            lines.append(f"  alert {ev.get('metric')}: {p.get('rule', ev.get('tag'))}: {p.get('message', '')}")
     if report.get("latency"):
         parts = []
         for kind, block in report["latency"].items():
@@ -344,6 +583,10 @@ def main(argv: List[str] = None) -> int:
     parser.add_argument("--json", action="store_true", help="emit the aggregated report as JSON")
     parser.add_argument("--rank", action="append", default=None,
                         help="rank label per trace file, in order (default: 0, 1, ...)")
+    parser.add_argument("--tree", action="store_true",
+                        help="render the causal span tree (trace_id/span_id/parent_id) "
+                             "instead of the summary table; also accepts a "
+                             "flight-recorder artifact JSON")
     args = parser.parse_args(argv)
     if args.rank is not None and len(args.rank) != len(args.traces):
         parser.error(f"got {len(args.rank)} --rank labels for {len(args.traces)} traces")
@@ -356,7 +599,15 @@ def main(argv: List[str] = None) -> int:
             rank: Any = int(args.rank[i]) if args.rank[i].isdigit() else args.rank[i]
         else:
             rank = i if multi else None
-        events.extend(load_events(path, rank=rank))
+        loader = load_tree_source if args.tree else load_events
+        events.extend(loader(path, rank=rank))
+    if args.tree:
+        trees = build_causal_tree(events)
+        if args.json:
+            print(json.dumps(trees, indent=2))
+        else:
+            print(render_tree(trees))
+        return 0
     report = aggregate(events)
     if args.json:
         print(json.dumps(report, indent=2))

@@ -46,6 +46,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import observability as _observability
+from ..observability import spans as _spans
 from ..classification import MulticlassAccuracy
 from ..observability.slo import SloRule, default_rules
 from ..parallel import SyncConfig
@@ -234,6 +235,10 @@ class SoakReport:
     slo_breaches: List[Dict[str, Any]]
     reconciliation: Dict[str, Any]
     config: Dict[str, Any]
+    # the fleet control tower rollup (FleetController.telemetry()) captured
+    # just before teardown — fleet soaks only; carries wall-clock latency
+    # summaries, so it lives OUTSIDE the counters determinism contract
+    fleet_telemetry: Optional[Dict[str, Any]] = None
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -436,6 +441,11 @@ def run_soak(
             retain_snapshots=cfg.retain_snapshots,
         )
 
+    flight = (
+        _observability.FlightRecorder(
+            dump_dir=os.path.join(cfg.durability_dir, "flightrec"))
+        if cfg.durability_dir else None
+    )
     engine = ServingEngine(_metric(traffic.num_classes), _serving_config())
     hook = _ChaosHook()
     engine._fault_hook = hook
@@ -492,6 +502,8 @@ def run_soak(
         rec = {
             "step": spec.step, "kind": spec.kind, "target": spec.target,
             "count": spec.count, "outcome": "pending",
+            "trace_id": _spans.derive_trace_id(
+                "fault", traffic.seed, spec.step, spec.kind, spec.target),
         }
         records.append(rec)
         pending[spec.kind].append(rec)
@@ -538,6 +550,8 @@ def run_soak(
                 armed_poisons = 0
             else:
                 unrecovered += 1
+                if flight is not None:
+                    flight.dump("state_corruption", extra={"epoch": epochs})
         # 2. witness sync through the (possibly flaky/dead-rank) gather,
         # retry armed
         try:
@@ -650,6 +664,9 @@ def run_soak(
     with _observability.telemetry_session(
         _observability.TelemetryConfig(
             slo_rules=tuple(default_rules()) + soak_rules(shed_rate_max=cfg.shed_rate_max),
+            sinks=(
+                (_observability.RingBufferSink(), flight) if flight is not None else ()
+            ),
         )
     ) as rec:
         current_step = -1
@@ -728,6 +745,8 @@ def run_soak(
             for r in kind_pending:
                 if r["outcome"] == "pending":
                     r["outcome"] = "not_fired"
+        if unrecovered and flight is not None:
+            flight.dump("unrecovered_faults", extra={"ledger": records})
         quarantined_faults = engine.stats["quarantined"]
         injected = (
             hook.transient_raised + hook.tenant_raised + sum(
@@ -903,10 +922,13 @@ def run_fleet_soak(
             if pending[kind]:
                 pending[kind].pop(0)["outcome"] = outcome
 
+    flight = _observability.FlightRecorder(
+        dump_dir=os.path.join(cfg.durability_dir, "flightrec"))
     t0 = time.perf_counter()
     with _observability.telemetry_session(
         _observability.TelemetryConfig(
             slo_rules=tuple(default_rules()) + soak_rules(shed_rate_max=cfg.shed_rate_max),
+            sinks=(_observability.RingBufferSink(), flight),
         )
     ) as rec:
         controller = FleetController(
@@ -927,11 +949,18 @@ def run_fleet_soak(
             entry = {
                 "step": spec.step, "kind": spec.kind, "target": spec.target,
                 "count": spec.count, "outcome": "pending",
+                "trace_id": _spans.derive_trace_id(
+                    "fault", traffic.seed, spec.step, spec.kind, spec.target),
             }
             records.append(entry)
             pending[spec.kind].append(entry)
             if spec.kind == "host_loss":
-                controller.kill_host(str(spec.target))
+                ctx = _spans.enter(
+                    "fault", spec.kind, str(spec.target), trace=entry["trace_id"])
+                try:
+                    controller.kill_host(str(spec.target))
+                finally:
+                    _spans.exit(ctx)
             elif spec.kind == "host_join":
                 host_id = spec.target or f"host-{cfg.fleet_hosts + joined_hosts}"
                 joined_hosts += 1
@@ -1012,6 +1041,7 @@ def run_fleet_soak(
             for tid in set(fleet_counts) | set(ref_counts)
         )
         reference.close()
+        fleet_telemetry = controller.telemetry()
         controller.close()
 
         # ledger close-out: a host_loss whose lease never expired in-run is
@@ -1023,6 +1053,8 @@ def run_fleet_soak(
             for entry in kind_pending:
                 if entry["outcome"] == "pending":
                     entry["outcome"] = "not_fired"
+        if unrecovered:
+            flight.dump("unrecovered_faults", extra={"ledger": records})
         injected = sum(1 for r in records if r["outcome"] != "not_fired")
 
         snap = rec.counters.snapshot().counts
@@ -1097,4 +1129,5 @@ def run_fleet_soak(
             "snapshot_every": cfg.snapshot_every,
             "state_digest": digest_h.hexdigest(),
         },
+        fleet_telemetry=fleet_telemetry,
     )

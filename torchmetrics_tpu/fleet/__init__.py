@@ -15,6 +15,7 @@ from .controller import (
     MIGRATION_STAGES,
     FleetController,
     MigrationAborted,
+    active_controller,
     tenant_state_digest,
 )
 from .membership import LEASE_STATES, LeaseConfig, Member, Membership
@@ -25,6 +26,7 @@ __all__ = [
     "LEASE_STATES",
     "FleetController",
     "MigrationAborted",
+    "active_controller",
     "LeaseConfig",
     "Member",
     "Membership",

@@ -38,11 +38,13 @@ from __future__ import annotations
 import dataclasses
 import os
 import time
+import weakref
 from typing import Any, Callable, Dict, Hashable, Iterable, List, Optional, Tuple
 
 import numpy as np
 
 from .. import observability as _observability
+from ..observability import spans as _spans
 from ..serving import ServingConfig, ServingEngine
 from ..serving import durability as _durability
 from ..utilities.exceptions import StateCorruptionError, TorchMetricsUserError
@@ -53,8 +55,22 @@ __all__ = [
     "MIGRATION_STAGES",
     "MigrationAborted",
     "FleetController",
+    "active_controller",
     "tenant_state_digest",
 ]
+
+# the most recently constructed live controller (weak — close() clears it);
+# the health plane's /fleetz endpoint and the flight recorder's seating
+# snapshot answer from here without holding the fleet alive
+_ACTIVE_CONTROLLER: Optional["weakref.ReferenceType[FleetController]"] = None
+
+
+def active_controller() -> Optional["FleetController"]:
+    """The live :class:`FleetController`, if one exists (else ``None``)."""
+    ref = _ACTIVE_CONTROLLER
+    if ref is None:
+        return None
+    return ref()
 
 # the migrate protocol's stages, in order; the post-stage hook fires after
 # each stage's effect lands (kill-point fuzz drives every boundary)
@@ -94,7 +110,7 @@ class _Host:
     pruned at every snapshot — the soak's retention discipline)."""
 
     __slots__ = ("host_id", "engine", "journal_dir", "snap_dir", "outbox_dir",
-                 "inbox_dir", "retained", "killed", "pre_kill_seq")
+                 "inbox_dir", "retained", "killed", "pre_kill_seq", "kill_trace")
 
     def __init__(self, host_id: str, engine: ServingEngine, root: str) -> None:
         self.host_id = host_id
@@ -106,6 +122,9 @@ class _Host:
         self.retained: Dict[int, Tuple[tuple, dict]] = {}
         self.killed = False
         self.pre_kill_seq = 0
+        # the span active at kill time (the fault-ledger trace) — the later
+        # failover chains its adoption spans off this, linking cause to effect
+        self.kill_trace: Optional[_spans.SpanContext] = None
 
 
 class FleetController:
@@ -154,8 +173,11 @@ class FleetController:
             "failover_replayed": 0, "rpo_records": 0, "lease_expiries": 0,
             "dropped_quarantined_adoptions": 0,
         }
+        self._serve_seq = 0  # request-span sequence (telemetry-only, deterministic)
         for h in hosts:
             self.add_host(str(h), rebalance=False)
+        global _ACTIVE_CONTROLLER
+        _ACTIVE_CONTROLLER = weakref.ref(self)
 
     # --------------------------------------------------------------- hosts
 
@@ -202,6 +224,8 @@ class FleetController:
         if h.engine._journal is not None:
             h.engine._journal.crash()
         h.killed = True
+        if _observability._ACTIVE is not None:
+            h.kill_trace = _spans.current()
 
     def heartbeat_all(self) -> None:
         """One heartbeat round: every non-killed host renews its lease."""
@@ -247,20 +271,31 @@ class FleetController:
         owning host). Returns the engine's admission verdict; batches for a
         crashed-but-unexpired owner park and count as admitted (they replay
         to the adopting host — the suspicion window drops nothing)."""
-        host = self.owner(tenant_id)
-        h = self._hosts[host]
-        if h.killed:
-            # the owner is down but its lease has not expired: hold the
-            # batch (arrival order) until failover reseats the tenant
-            self._parked.append((tenant_id, args, dict(kwargs)))
-            self.stats["parked"] += 1
-            return True
-        ok = h.engine.update(tenant_id, *args, **kwargs)
-        if ok:
-            self.stats["served"] += 1
-            if h.engine._journal is not None:
-                h.retained[h.engine._applied_seq] = (args, dict(kwargs))
-        return ok
+        ctx = None
+        if _observability._ACTIVE is not None:
+            # request span: everything the routed batch triggers (admission,
+            # journal append, the megabatch dispatch it seats into) links
+            # back to this deterministic per-request trace
+            self._serve_seq += 1
+            ctx = _spans.enter("serve", repr(tenant_id), self._serve_seq)
+        try:
+            host = self.owner(tenant_id)
+            h = self._hosts[host]
+            if h.killed:
+                # the owner is down but its lease has not expired: hold the
+                # batch (arrival order) until failover reseats the tenant
+                self._parked.append((tenant_id, args, dict(kwargs)))
+                self.stats["parked"] += 1
+                return True
+            ok = h.engine.update(tenant_id, *args, **kwargs)
+            if ok:
+                self.stats["served"] += 1
+                if h.engine._journal is not None:
+                    h.retained[h.engine._applied_seq] = (args, dict(kwargs))
+            return ok
+        finally:
+            if ctx is not None:
+                _spans.exit(ctx)
 
     def _drain_parked(self) -> None:
         """Redeliver parked traffic whose tenant has a live owner again."""
@@ -312,6 +347,23 @@ class FleetController:
                 f"host {host_id!r} expired but no live host remains to adopt its tenants"
             )
         survivors = {s: w for s, w in survivors.items() if not self._hosts[s].killed}
+        rec = _observability._ACTIVE
+        ctx = None
+        if rec is not None:
+            # child of the kill-time span when one was recorded: the fault-
+            # ledger trace id flows through restore/replay/adoption events
+            ctx = _spans.enter("failover", host_id, parent=h.kill_trace)
+        try:
+            self._failover_adopt(host_id, h, survivors, rec)
+        finally:
+            if ctx is not None:
+                _spans.exit(ctx)
+        self._drain_parked()
+
+    def _failover_adopt(
+        self, host_id: str, h: _Host, survivors: Dict[str, float],
+        rec: Optional[Any],
+    ) -> None:
         # bitwise reconstruction: latest snapshot + journal tail
         recovery = ServingEngine(
             self._metric_factory(),
@@ -326,6 +378,7 @@ class FleetController:
         # adoption: every tenant moves to its new rendezvous owner
         roster = recovery.tenants()
         adopted = 0
+        adopted_ids: List[str] = []
         touched: List[str] = []
         for tenant_id in sorted(roster, key=repr):
             if roster[tenant_id]["quarantined"]:
@@ -340,6 +393,7 @@ class FleetController:
             )
             self._owner[tenant_id] = dst
             adopted += 1
+            adopted_ids.append(repr(tenant_id))
             if dst not in touched:
                 touched.append(dst)
         for dst in touched:
@@ -353,10 +407,10 @@ class FleetController:
         self.stats["adopted_tenants"] += adopted
         self.stats["failover_replayed"] += replayed
         self.stats["rpo_records"] = max(self.stats["rpo_records"], rpo)
-        rec = _observability._ACTIVE
         if rec is not None:
-            rec.record_host_failover(host_id, host_id, adopted, replayed, rpo)
-        self._drain_parked()
+            rec.record_host_failover(
+                host_id, host_id, adopted, replayed, rpo, roster=adopted_ids,
+            )
 
     # ------------------------------------------------------------ migration
 
@@ -393,20 +447,27 @@ class FleetController:
         t0 = time.perf_counter()
         moved = 0
         parity_failures = 0
-        for src in sorted(by_src):
-            moved_n, bad = self._migrate_group(src, by_src[src], dst, hook)
-            moved += moved_n
-            parity_failures += bad
-        duration = time.perf_counter() - t0
-        if moved:
-            self.stats["migrations"] += 1
-            self.stats["migrated_tenants"] += moved
-            self.stats["migration_parity_failures"] += parity_failures
-            rec = _observability._ACTIVE
-            if rec is not None:
-                rec.record_migration(
-                    "fleet", ",".join(sorted(by_src)), dst, moved, duration
-                )
+        ctx = None
+        if _observability._ACTIVE is not None:
+            ctx = _spans.enter("migration", ",".join(sorted(by_src)), dst, len(tenants))
+        try:
+            for src in sorted(by_src):
+                moved_n, bad = self._migrate_group(src, by_src[src], dst, hook)
+                moved += moved_n
+                parity_failures += bad
+            duration = time.perf_counter() - t0
+            if moved:
+                self.stats["migrations"] += 1
+                self.stats["migrated_tenants"] += moved
+                self.stats["migration_parity_failures"] += parity_failures
+                rec = _observability._ACTIVE
+                if rec is not None:
+                    rec.record_migration(
+                        "fleet", ",".join(sorted(by_src)), dst, moved, duration
+                    )
+        finally:
+            if ctx is not None:
+                _spans.exit(ctx)
         return {"moved": moved, "src_hosts": sorted(by_src), "parity_failures": parity_failures}
 
     def _migrate_group(
@@ -422,13 +483,29 @@ class FleetController:
         inbox_path: Optional[str] = None
         generation: Optional[int] = None
         restored: List[Hashable] = []
+        # per-stage child spans of the ambient migration span: events a stage
+        # triggers (snapshots, dispatches) attribute to THEIR stage boundary
+        stage_ctx: List[Optional[_spans.SpanContext]] = [None]
+
+        def _stage_enter(name: str) -> None:
+            if _observability._ACTIVE is not None:
+                stage_ctx[0] = _spans.enter("migrate_stage", src, dst, name)
+
+        def _stage_exit() -> None:
+            if stage_ctx[0] is not None:
+                _spans.exit(stage_ctx[0])
+                stage_ctx[0] = None
+
         try:
             # 1. drain: queued megabatches land on src (their admissions are
             # already journaled — nothing new can be lost past this point)
+            _stage_enter("drain")
             src_h.engine.flush()
             hook("drain")
+            _stage_exit()
             # 2. snapshot-slice: the tenants' exact state rows, published as
             # one atomic sha256-sealed artifact in src's outbox
+            _stage_enter("snapshot")
             slices = {tid: src_h.engine.state_dict(tid) for tid in tids}
             pre_digests = {tid: tenant_state_digest(src_h.engine, tid) for tid in tids}
             sections: Dict[str, np.ndarray] = {}
@@ -446,6 +523,8 @@ class FleetController:
             info = outbox.write({"src": src, "dst": dst, "tenants": entries}, sections)
             outbox_path, generation = info["path"], info["generation"]
             hook("snapshot")
+            _stage_exit()
+            _stage_enter("transfer")
             # 3. transfer: ship the artifact bytes to dst's inbox (the
             # simulated network copy — a kill here leaves at worst a torn
             # file that restore's sha256 check rejects)
@@ -456,6 +535,8 @@ class FleetController:
             with open(inbox_path, "wb") as fh:
                 fh.write(payload)
             hook("transfer")
+            _stage_exit()
+            _stage_enter("restore")
             # 4. restore: decode the artifact ON DST (sha256-verified — a
             # torn transfer dies here, not after cutover) and park each
             # tenant's state on the destination engine
@@ -469,8 +550,10 @@ class FleetController:
                 dst_h.engine.load_state_dict(tid, sd)
                 restored.append(tid)
             hook("restore")
+            _stage_exit()
         except BaseException as err:
             # ---- abort: ownership never flipped; scrub every partial effect
+            _stage_exit()
             self.stats["aborted_migrations"] += 1
             for tid in restored:
                 try:
@@ -491,6 +574,7 @@ class FleetController:
         # forgets, artifacts are swept, and both hosts snapshot so their own
         # "latest snapshot + journal tail" recipes stay complete. A kill
         # from here on is post-commit: the destination owns every tenant.
+        _stage_enter("cutover")
         parity_failures = 0
         for tid in tids:
             if tenant_state_digest(dst_h.engine, tid) != pre_digests[tid]:
@@ -506,6 +590,7 @@ class FleetController:
         self.snapshot_host(src)
         self.snapshot_host(dst)
         hook("cutover")
+        _stage_exit()
         return len(tids), parity_failures
 
     # ------------------------------------------------------------- read side
@@ -519,6 +604,78 @@ class FleetController:
     def tenants(self) -> Dict[Hashable, str]:
         """tenant → owning host (the routing table)."""
         return dict(self._owner)
+
+    def engines(self) -> Dict[str, ServingEngine]:
+        """host id → live engine (killed hosts excluded) — the read seam the
+        control tower and the flight recorder's seating snapshot use."""
+        return {
+            host_id: h.engine
+            for host_id, h in sorted(self._hosts.items())
+            if not h.killed
+        }
+
+    # the control tower's per-host engine-stat → fleet-counter-field mapping
+    # (one shared recorder serves every engine in this process, so per-host
+    # attribution must come from each engine's own stats, not the counters)
+    _STATS_COUNTER_MAP: Tuple[Tuple[str, str], ...] = (
+        ("serve_dispatches", "dispatches"),
+        ("serve_tenant_rows", "tenant_rows"),
+        ("serve_padded_rows", "padded_rows"),
+        ("tenant_spills", "spills"),
+        ("tenant_readmits", "readmissions"),
+        ("quarantines", "quarantined"),
+        ("serve_rejected", "rejected_batches"),
+        ("window_rotations", "window_rotations"),
+    )
+
+    def telemetry(self, top_k: int = 5) -> Dict[str, Any]:
+        """The fleet control tower: one rollup of per-host counters (merged
+        through :func:`aggregate_counters`), per-kind latency histograms,
+        top-``top_k`` hot tenants (by folded rows, with spill/quarantine
+        flags), lease states, and the controller's own lifecycle stats.
+        This is what ``/fleetz`` serves and ``serve_demo --fleet`` prints."""
+        from ..observability.counters import aggregate_counters
+
+        live = self.engines()
+        per_host: Dict[str, Dict[str, int]] = {
+            host_id: {
+                field: int(engine.stats.get(stat, 0))
+                for field, stat in self._STATS_COUNTER_MAP
+            }
+            for host_id, engine in live.items()
+        }
+        hosts_sorted = sorted(per_host)
+        totals: Dict[str, int] = {field: 0 for field, _ in self._STATS_COUNTER_MAP}
+        if per_host:
+            merged = aggregate_counters([per_host[h] for h in hosts_sorted])
+            totals = {
+                field: int(merged.totals.get(field, 0))
+                for field, _ in self._STATS_COUNTER_MAP
+            }
+        hot: List[Dict[str, Any]] = []
+        for host_id, engine in live.items():
+            for tid, info in engine.tenants().items():
+                hot.append({
+                    "tenant": repr(tid)[:80],
+                    "host": host_id,
+                    "rows": int(info["update_count"]),
+                    "spilled": bool(info["spilled"]),
+                    "quarantined": bool(info["quarantined"]),
+                })
+        hot.sort(key=lambda r: (-r["rows"], r["tenant"], r["host"]))
+        out: Dict[str, Any] = {
+            "hosts": per_host,
+            "totals": totals,
+            "hot_tenants": hot[:max(0, int(top_k))],
+            "tenant_count": len(hot),
+            "membership": self.hosts(),
+            "parked": len(self._parked),
+            "stats": dict(self.stats),
+        }
+        rec = _observability._ACTIVE
+        if rec is not None:
+            out["latency"] = rec.latency_summary()
+        return out
 
     def tenant_digests(self) -> Dict[Hashable, str]:
         """Per-tenant state digests across the whole fleet (the parity
@@ -539,6 +696,9 @@ class FleetController:
                 h.engine.flush()
 
     def close(self) -> None:
+        global _ACTIVE_CONTROLLER
         for h in self._hosts.values():
             if not h.killed:
                 h.engine.close()
+        if _ACTIVE_CONTROLLER is not None and _ACTIVE_CONTROLLER() is self:
+            _ACTIVE_CONTROLLER = None

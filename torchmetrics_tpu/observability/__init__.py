@@ -34,14 +34,16 @@ from __future__ import annotations
 import contextlib
 import dataclasses
 import itertools
-from typing import Any, Dict, Iterator, Optional, Tuple
+from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 from ..utilities.prints import rank_zero_warn
 from . import costs as costs_module
 from . import events
+from . import flightrec as flightrec_module
 from . import histograms as histograms_module
 from . import memory as memory_module
 from . import slo as slo_module
+from . import spans
 from . import tracing
 from .costs import CostRecord, CostRegistry
 from .counters import (
@@ -59,6 +61,7 @@ from .events import (
     Sink,
     TelemetryEvent,
 )
+from .flightrec import FlightRecorder
 from .histograms import (
     FLEET_HISTOGRAM_KINDS,
     Histogram,
@@ -80,6 +83,7 @@ __all__ = [
     "Counters",
     "CountersSnapshot",
     "FleetSnapshot",
+    "FlightRecorder",
     "HealthServer",
     "Histogram",
     "HistogramRegistry",
@@ -105,6 +109,7 @@ __all__ = [
     "gather_counters",
     "gather_histograms",
     "render_prometheus",
+    "spans",
     "state_memory",
     "telemetry_session",
     "tracing",
@@ -222,6 +227,11 @@ class TelemetryRecorder:
             sink.emit(event)
 
     def _event(self, kind: str, metric: str, tag: str, **kw: Any) -> None:
+        ctx = spans.current()
+        if ctx is not None and "trace_id" not in kw:
+            kw["trace_id"] = ctx.trace_id
+            kw["span_id"] = ctx.span_id
+            kw["parent_id"] = ctx.parent_id
         self.emit(TelemetryEvent(kind=kind, metric=metric, tag=tag, timestamp=tracing.monotonic(), **kw))
 
     # --------------------------------------------------------- runtime seams
@@ -388,19 +398,24 @@ class TelemetryRecorder:
                 UserWarning,
             )
 
-    def record_serve_dispatch(self, metric: Any, rows: int, padded: int = 0) -> None:
+    def record_serve_dispatch(
+        self, metric: Any, rows: int, padded: int = 0,
+        links: Optional[List[str]] = None,
+    ) -> None:
         """One megabatched serving dispatch (``torchmetrics_tpu/serving``):
         ``rows`` real tenant rows updated by a single vmapped program (plus
         ``padded`` scratch rows keeping the dispatch signature fixed). The
         dispatch latency itself was already recorded by :meth:`record_dispatch`
         under the ``vupdate`` tag — this adds the tenant-amortization view the
-        derived ``tenants_per_dispatch`` headline reports."""
+        derived ``tenants_per_dispatch`` headline reports. ``links`` carries
+        the (bounded) trace ids of the seated rows' admission spans — a
+        megabatch folds many requests, so the serve event fans IN."""
         name = self._metric_name(metric)
         self.counters.record_serve_dispatch(rows, padded)
-        self._event(
-            "serve", name, "vupdate",
-            payload={"tenant_rows": int(rows), "padded_rows": int(padded)},
-        )
+        payload: Dict[str, Any] = {"tenant_rows": int(rows), "padded_rows": int(padded)}
+        if links:
+            payload["links"] = list(links)
+        self._event("serve", name, "vupdate", payload=payload)
 
     def record_tenant_spill(
         self, metric: Any, duration_s: float, nbytes: int, readmit: bool = False
@@ -629,19 +644,22 @@ class TelemetryRecorder:
         )
 
     def record_host_failover(
-        self, label: str, host: str, tenants: int, replayed: int, rpo_records: int
+        self, label: str, host: str, tenants: int, replayed: int, rpo_records: int,
+        roster: Optional[List[str]] = None,
     ) -> None:
         """One dead host's roster adopted by survivors: restored from its
         latest snapshot generation plus ``replayed`` journal-tail records,
-        with ``rpo_records`` admissions unrecoverable (the fsync window)."""
+        with ``rpo_records`` admissions unrecoverable (the fsync window).
+        ``roster`` names the adopted tenants (bounded repr list) so a flight-
+        recorder dump identifies the dead host's in-flight sessions."""
         self.counters.record_host_failover()
-        self._event(
-            "failover", label, "adopt",
-            payload={
-                "host": str(host), "tenants": int(tenants),
-                "replayed": int(replayed), "rpo_records": int(rpo_records),
-            },
-        )
+        payload: Dict[str, Any] = {
+            "host": str(host), "tenants": int(tenants),
+            "replayed": int(replayed), "rpo_records": int(rpo_records),
+        }
+        if roster:
+            payload["roster"] = [str(t)[:80] for t in roster[:32]]
+        self._event("failover", label, "adopt", payload=payload)
 
     def record_d2h(self, site: str, nbytes: int, metric: Any = None) -> None:
         """An instrumented device→host readback (``state_dict``,

@@ -74,6 +74,7 @@ COUNTER_FIELDS: Tuple[str, ...] = (
     "host_failovers",  # dead hosts whose tenants survivors adopted (snapshot + journal tail)
     "tenant_migrations",  # tenants moved host-to-host by the committed migrate protocol
     "migration_us",  # wall-clock spent inside committed migrations (drain -> cutover)
+    "flightrec_dumps",  # postmortem artifacts the flight recorder dumped (observability plane)
 )
 
 
@@ -467,6 +468,12 @@ class Counters:
         with self._lock:
             self._counts["tenant_migrations"] += int(tenants)
             self._counts["migration_us"] += int(duration_us)
+
+    def record_flightrec_dump(self) -> None:
+        """One postmortem artifact dumped by the flight recorder (auto-trigger
+        or explicit ``dump()``)."""
+        with self._lock:
+            self._counts["flightrec_dumps"] += 1
 
     # --------------------------------------------------------------- querying
 

@@ -20,6 +20,7 @@ import collections
 import dataclasses
 import json
 import os
+import socket
 import threading
 from typing import Any, Callable, Dict, List, Mapping, Optional, Tuple
 
@@ -49,6 +50,7 @@ EVENT_KINDS: Tuple[str, ...] = (
     "rank_rejoin",  # a previously dead rank reconciled back into the coalesced sync
     "migration",  # a committed host-to-host tenant migration (fleet plane)
     "failover",  # a dead host's tenants adopted by survivors (fleet plane)
+    "flightrec",  # the flight recorder dumped a postmortem artifact
 )
 
 
@@ -69,6 +71,9 @@ class TelemetryEvent:
             returns before the device finishes).
         signature: the input shape/dtype key for dispatch/retrace events.
         cache_hit: for dispatch events — False on the signature's first sight.
+        trace_id / span_id / parent_id: causal trace linkage (deterministic
+            sha256-derived ids from ``observability/spans.py``) — stamped by
+            the recorder when a span is active, ``None`` otherwise.
         payload: kind-specific extras (attempt numbers, error reprs, byte
             counts, ...).
     """
@@ -80,6 +85,9 @@ class TelemetryEvent:
     duration_s: Optional[float] = None
     signature: Optional[str] = None
     cache_hit: Optional[bool] = None
+    trace_id: Optional[str] = None
+    span_id: Optional[str] = None
+    parent_id: Optional[str] = None
     payload: Mapping[str, Any] = dataclasses.field(default_factory=dict)
 
     def to_dict(self) -> Dict[str, Any]:
@@ -95,6 +103,12 @@ class TelemetryEvent:
             out["signature"] = self.signature
         if self.cache_hit is not None:
             out["cache_hit"] = self.cache_hit
+        if self.trace_id is not None:
+            out["trace_id"] = self.trace_id
+        if self.span_id is not None:
+            out["span_id"] = self.span_id
+        if self.parent_id is not None:
+            out["parent_id"] = self.parent_id
         if self.payload:
             out["payload"] = dict(self.payload)
         return out
@@ -155,13 +169,21 @@ class JSONLSink(Sink):
     flushes AND fsyncs, so a trace ``scp``'d off a preempted host ends on a
     complete line. A line truncated by a hard kill mid-write is still possible;
     ``trace_report.py``'s skip-bad-line tolerance covers that tail case.
+
+    Every line carries a ``host`` field (``host=`` override, defaulting to
+    ``socket.gethostname()``) so JSONL files merged across a fleet attribute
+    each event to its emitter — ``trace_report.py`` uses it as the rank label
+    when no explicit ``--rank`` mapping is given.
     """
 
-    def __init__(self, path: str, flush_every: int = 1) -> None:
+    def __init__(self, path: str, flush_every: int = 1, host: Optional[str] = None) -> None:
         if flush_every < 1:
             raise ValueError(f"flush_every must be >= 1, got {flush_every}")
         self.path = str(path)
         self.flush_every = flush_every
+        if host is None:
+            host = socket.gethostname()
+        self.host = str(host)
         self._fh = None
         self._unflushed = 0
         self.written = 0
@@ -171,7 +193,9 @@ class JSONLSink(Sink):
         self._emit_lock = threading.Lock()
 
     def emit(self, event: TelemetryEvent) -> None:
-        line = json.dumps(event.to_dict()) + "\n"
+        record = event.to_dict()
+        record["host"] = self.host
+        line = json.dumps(record) + "\n"
         with self._emit_lock:
             if self._fh is None:
                 self._fh = open(self.path, "a", encoding="utf-8")

@@ -49,6 +49,7 @@ _COUNTER_HELP = {
     "quarantines": "metrics frozen by on_error='quarantine'",
     "state_growths": "cat states past the unbounded-growth sentinel",
     "alerts": "SLO alerts emitted",
+    "flightrec_dumps": "postmortem artifacts dumped by the flight recorder",
 }
 
 
@@ -320,6 +321,22 @@ def _sloz_doc() -> Tuple[int, Dict[str, Any]]:
     return 200, {"telemetry": True, **rec.slo_snapshot()}
 
 
+def _fleetz_doc() -> Tuple[int, Dict[str, Any]]:
+    """The fleet control tower: the live controller's rollup, if one exists.
+
+    The controller registers itself weakly at construction (cleared on
+    ``close()``); the lazy import keeps the health plane importable without
+    the fleet/serving stack."""
+    try:
+        from ..fleet import controller as _fleet_controller
+    except Exception:  # noqa: BLE001 — health must answer even if fleet can't import
+        return 200, {"fleet": False}
+    fc = _fleet_controller.active_controller()
+    if fc is None:
+        return 200, {"fleet": False}
+    return 200, {"fleet": True, **fc.telemetry()}
+
+
 class _HealthHandler(BaseHTTPRequestHandler):
     server_version = "tpu-metrics-health/1"
 
@@ -337,11 +354,15 @@ class _HealthHandler(BaseHTTPRequestHandler):
             elif path == "/sloz":
                 status, doc = _sloz_doc()
                 self._reply(status, json.dumps(doc, default=str), "application/json")
+            elif path == "/fleetz":
+                status, doc = _fleetz_doc()
+                self._reply(status, json.dumps(doc, default=str), "application/json")
             else:
                 self._reply(
                     404,
                     json.dumps({"error": f"unknown path {path}",
-                                "endpoints": ["/healthz", "/metricsz", "/costz", "/sloz"]}),
+                                "endpoints": ["/healthz", "/metricsz", "/costz",
+                                              "/sloz", "/fleetz"]}),
                     "application/json",
                 )
         except Exception as err:  # noqa: BLE001 — a render bug must answer 500, not hang

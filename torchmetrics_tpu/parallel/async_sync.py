@@ -46,6 +46,7 @@ from typing import Any, Callable, Dict, List, Mapping, Optional, Sequence, Union
 import jax.numpy as jnp
 
 from .. import observability as _observability
+from ..observability import spans as _obs_spans
 from ..utilities.exceptions import TorchMetricsUserError
 from . import coalesce as _coalesce
 
@@ -114,6 +115,10 @@ class AsyncSyncHandle:
         self._fallback = False
         self._dead_ranks: Dict[int, int] = {}
         self._committed = False
+        # the request span active when the sync was LAUNCHED: commit() may run
+        # much later (or on another thread) — the async_sync event must still
+        # attribute the overlap window to the trace that started it
+        self._trace = _obs_spans.current() if _observability._ACTIVE is not None else None
         self._done = threading.Event()
         self._payload_bytes = sum(_payload_bytes(s) for s in self._states)
         if noop:
@@ -260,10 +265,17 @@ class AsyncSyncHandle:
         self._committed = True
         rec = _observability._ACTIVE
         if rec is not None and self._states:
-            rec.record_async_sync(
-                self.label, self._gather_s, self._wait_s, self._payload_bytes,
-                collectives=self._collectives, fallback=self._fallback,
-            )
+            ctx = None
+            if self._trace is not None:
+                ctx = _obs_spans.enter("commit", self.label, parent=self._trace)
+            try:
+                rec.record_async_sync(
+                    self.label, self._gather_s, self._wait_s, self._payload_bytes,
+                    collectives=self._collectives, fallback=self._fallback,
+                )
+            finally:
+                if ctx is not None:
+                    _obs_spans.exit(ctx)
         return out
 
 

@@ -103,7 +103,9 @@ _MAGIC = 0x436F414C  # "CoAL"
 # fields (fleet_heartbeats / lease_expiries / host_failovers /
 # tenant_migrations / migration_us). Same mixed-version rule: an older rank's
 # shorter vector fails row validation rather than misaligning the new tail
-_VERSION = 9
+# v10: causal trace plane — the counter vector gained flightrec_dumps (the
+# flight recorder's postmortem artifact count rides the fleet rollup)
+_VERSION = 10
 _HEADER_LEN = 6  # [magic, version, n_leaves, n_counter_fields, alive, epoch]
 _LEAF_REC_LEN = 2 + _MAX_RANK + 1  # [dtype_code, ndim, d0..d7, kind|codec<<1]
 _KIND_TENSOR = 0

@@ -59,6 +59,7 @@ import numpy as np
 
 from .. import aot as _aot
 from .. import observability as _observability
+from ..observability import spans as _spans
 from ..aot import keys as _aot_keys
 from ..parallel import quantize as _quantize
 from . import durability as _durability
@@ -240,7 +241,7 @@ class _Tenant:
     """Host-side bookkeeping for one logical session."""
 
     __slots__ = ("tenant_id", "shape_key", "slot", "update_count", "last_touch",
-                 "pending", "quarantined", "error", "spilled", "unfolded")
+                 "pending", "quarantined", "error", "spilled", "unfolded", "trace")
 
     def __init__(self, tenant_id: Hashable) -> None:
         self.tenant_id = tenant_id
@@ -256,6 +257,9 @@ class _Tenant:
         # journal seqs admitted but not yet folded (journaling engines only);
         # a quarantine rolls these back and records them so replay skips them
         self.unfolded: List[int] = []
+        # span active at the LAST admission (telemetry-only): the megabatch
+        # dispatch links its fan-in back to the request traces it folds
+        self.trace: Optional[Any] = None
 
     @property
     def resident(self) -> bool:
@@ -617,6 +621,10 @@ class ServingEngine:
             rec = _observability._ACTIVE
             if rec is not None:
                 rec.counters.record_journal_append(synced)
+        if _observability._ACTIVE is not None:
+            ctx = _spans.current()
+            if ctx is not None:
+                t.trace = ctx
         cls.queue.append((tenant_id, args, kwargs))
         t.pending += 1
         t.last_touch = next(self._touch)
@@ -784,7 +792,14 @@ class ServingEngine:
         self.stats["window_rotations"] += rotations
         rec = _observability._ACTIVE
         if rec is not None:
-            rec.record_serve_dispatch(self._metric, real, m - real)
+            links: List[str] = []
+            for tid, _, _ in entries:
+                t = self._tenants[tid]
+                if t.trace is not None:
+                    if len(links) < 8:  # bounded: a megabatch folds many requests
+                        links.append(t.trace.trace_id)
+                    t.trace = None
+            rec.record_serve_dispatch(self._metric, real, m - real, links=links)
             if self._wtier is not None:
                 rec.counters.record_window_rolls(real, rotations)
 
@@ -1264,6 +1279,9 @@ class ServingEngine:
                     f"journal seq {jrec.seq}: refetched batch does not match the journaled "
                     "digest — the retention buffer diverged from what the primary admitted."
                 )
+            ctx = None
+            if _observability._ACTIVE is not None:
+                ctx = _spans.enter("replay", jrec.seq, repr(jrec.tenant_id))
             self._replaying = True
             self._replay_clock = jrec.t
             try:
@@ -1271,6 +1289,8 @@ class ServingEngine:
             finally:
                 self._replaying = False
                 self._replay_clock = None
+                if ctx is not None:
+                    _spans.exit(ctx)
             if not ok:
                 raise StateCorruptionError(
                     f"journal seq {jrec.seq}: replayed admission was shed — the admission "
