@@ -1478,6 +1478,161 @@ def bench_fault_selftest() -> dict:
     return {"ok": True}
 
 
+def bench_telemetry_history() -> dict:
+    """Config ``telemetry_history``: the telemetry history plane end to end —
+    telescoping multi-resolution retention fed at the sync heartbeat on a
+    virtual clock, time-travel queries (in-process AND over a live
+    ``/historyz``), and the multi-window burn-rate drill.
+
+    The correctness columns are DETERMINISTIC and gate tight in
+    tools/bench_compare.py: ``history_mem_savings_x`` pins the O(levels)
+    retention ratio against a naive finest-resolution ring covering the
+    longest span, ``history_determinism_parity`` is 1.0 iff two identical
+    virtual-clock sessions retained byte-identical exported blocks,
+    ``historyz_parity`` is 1.0 iff a live ``/historyz?at=`` answer equals the
+    in-process ``history.at(t)``, and ``burn_drill_parity`` is 1.0 iff an
+    injected breach (transient spike, then sustained burn) paged the
+    ``burn()`` rule EXACTLY once while the single-window rule flapped.
+    Only the query-latency columns wobble.
+    """
+    import importlib.util
+    import json as _json
+    import time as _time
+    import urllib.request
+    import warnings
+
+    import torchmetrics_tpu.observability as obs
+
+    # the one canonical percentile estimator, loaded by file path the same
+    # way tools/trace_report.py consumes it (stdlib-only, no jax init)
+    qpath = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "torchmetrics_tpu", "observability", "quantile.py")
+    spec = importlib.util.spec_from_file_location("_bench_quantile", qpath)
+    quantile = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(quantile)
+
+    horizon_s = 2 * 3600.0  # two virtual hours: the top level folds too
+
+    def _scripted_session() -> tuple:
+        """Feed a fixed schedule through a virtual-clock session; returns the
+        (still queryable) history plus its deterministic export."""
+        clock = {"t": 0.0}
+        cfg = obs.TelemetryConfig(history_clock=lambda: clock["t"])
+        with obs.telemetry_session(cfg) as rec:
+            step = 0
+            while clock["t"] < horizon_s:
+                clock["t"] += 5.0
+                step += 1
+                rec.counters.record_dispatch("bench", f"sig{step % 3}")
+                if step % 7 == 0:
+                    rec.counters.record_d2h(64)
+                rec.observe_history()
+            return rec.history, rec.history_block(last_n=16)
+
+    history, block_a = _scripted_session()
+    _, block_b = _scripted_session()
+    determinism = 1.0 if (
+        _json.dumps(block_a, sort_keys=True) == _json.dumps(block_b, sort_keys=True)
+    ) else 0.0
+
+    # O(levels) pin: a naive ring keeping the LONGEST span at the FINEST
+    # resolution holds longest/finest blocks; the telescope holds ~sum(keep)
+    spans = history.spans
+    naive_blocks = int(spans[-1] / spans[0])
+    retained = history.block_count()
+    mem_savings = naive_blocks / max(retained, 1)
+
+    # time-travel query latency over the retained levels (µs percentiles via
+    # the shared estimator — the same math the trace report renders)
+    n_queries = 400
+    buckets: dict = {}
+    for i in range(n_queries):
+        tq = (i * 7919.0) % horizon_s
+        t0 = _time.perf_counter()
+        history.at(tq)
+        us = int((_time.perf_counter() - t0) * 1e6)
+        b = quantile.bucket_index(us)
+        buckets[b] = buckets.get(b, 0) + 1
+    q_p50 = quantile.percentile_from_buckets(buckets, n_queries, 0.50)
+    q_p99 = quantile.percentile_from_buckets(buckets, n_queries, 0.99)
+
+    # live /historyz parity: the HTTP answer must equal the in-process query
+    clock = {"t": 0.0}
+    historyz_parity = 0.0
+    with obs.telemetry_session(
+        obs.TelemetryConfig(history_clock=lambda: clock["t"])
+    ) as rec:
+        for step in range(300):
+            clock["t"] += 5.0
+            rec.counters.record_dispatch("bench", f"sig{step % 3}")
+            rec.observe_history()
+        with obs.HealthServer(port=0) as server:
+            url = f"http://{server.host}:{server.port}/historyz?at=777.0"
+            body = _json.loads(urllib.request.urlopen(url, timeout=10).read())
+            in_proc = _json.loads(_json.dumps(rec.history.at(777.0)))
+            historyz_parity = 1.0 if body.get("block") == in_proc else 0.0
+
+    # burn drill: a transient spike then a sustained burn. The single-window
+    # rule pages on the spike and re-pages through the sustained phase every
+    # cooldown (the flap); the burn() rule needs BOTH windows burning, so the
+    # spike never pages it and the sustained burn pages it exactly once
+    # (its cooldown outlives the drill).
+    rules = (
+        obs.SloRule(
+            name="single_window_d2h",
+            expr="d2h_readbacks > 0",
+            window=60.0,
+            cooldown=60.0,
+            severity="warning",
+            description="drill: single-window rule (expected to flap)",
+        ),
+        obs.SloRule(
+            name="burn_d2h",
+            expr="burn('d2h_readbacks / window > 0.04', 60.0, 600.0)",
+            window=60.0,
+            cooldown=1800.0,
+            severity="critical",
+            description="drill: multi-window burn-rate rule (pages once)",
+        ),
+    )
+    clock = {"t": 0.0}
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")  # breach warnings are the point
+        with obs.telemetry_session(
+            obs.TelemetryConfig(
+                slo_rules=rules, slo_eval_on_sync=False,
+                history_clock=lambda: clock["t"],
+            )
+        ) as rec:
+            while clock["t"] < 1200.0:
+                clock["t"] += 10.0
+                if clock["t"] == 100.0:
+                    for _ in range(3):  # the transient spike
+                        rec.counters.record_d2h(64)
+                if clock["t"] >= 600.0:  # the sustained burn
+                    rec.counters.record_d2h(64)
+                rec.evaluate_slos(now=clock["t"])
+            counts = rec.counters.snapshot().counts
+            burn_pages = int(counts.get("burn_alerts", 0))
+            single_alerts = sum(
+                1 for ev in rec.events_of("alert") if ev.metric == "single_window_d2h"
+            )
+
+    return {
+        "history_mem_savings_x": round(mem_savings, 3),
+        "history_blocks_retained": retained,
+        "history_folds": history.folds,
+        "history_determinism_parity": determinism,
+        "historyz_parity": historyz_parity,
+        "history_query_p50_us": round(q_p50, 1) if q_p50 is not None else None,
+        "history_query_p99_us": round(q_p99, 1) if q_p99 is not None else None,
+        "burn_drill_parity": 1.0 if burn_pages == 1 else 0.0,
+        "burn_pages": burn_pages,
+        "single_window_alerts": single_alerts,
+        "unit": "2h virtual-clock retention + time-travel queries + burn drill",
+    }
+
+
 CONFIGS = {
     "ours": bench_ours,
     "torch_baseline": bench_torch_baseline,
@@ -1494,6 +1649,7 @@ CONFIGS = {
     "production_soak": bench_production_soak,
     "durable_failover": bench_durable_failover,
     "fleet_failover": bench_fleet_failover,
+    "telemetry_history": bench_telemetry_history,
     "_fault_selftest": bench_fault_selftest,
 }
 

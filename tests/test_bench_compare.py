@@ -535,6 +535,64 @@ def test_latency_percentile_columns_direction_and_gate(tmp_path):
     assert _cli([BENCH_COMPARE, *steady, "--check"]).returncode == 0
 
 
+# -------------------------------------------- telemetry history columns gate
+
+
+def test_telemetry_history_columns_direction_and_gate(tmp_path):
+    """The telemetry_history bench columns gate their contract: the O(levels)
+    memory ratio and the determinism/endpoint/burn-drill parities are
+    higher-exact, query latencies gate as latencies, and the raw block/fold
+    counts stay informational. An injected memory-ratio collapse AND a missed
+    burn page each trip --check."""
+    assert bench_compare.direction("extra.telemetry_history.history_mem_savings_x") == "higher"
+    assert bench_compare.direction("extra.telemetry_history.history_determinism_parity") == "higher"
+    assert bench_compare.direction("extra.telemetry_history.historyz_parity") == "higher"
+    assert bench_compare.direction("extra.telemetry_history.burn_drill_parity") == "higher"
+    assert bench_compare.direction("extra.telemetry_history.history_query_p50_us") == "lower"
+    assert bench_compare.direction("extra.telemetry_history.history_query_p99_us") == "lower"
+    # the raw counts carry no direction: retention tuning may legitimately
+    # move them either way
+    assert bench_compare.direction("extra.telemetry_history.history_blocks_retained") is None
+    assert bench_compare.direction("extra.telemetry_history.history_folds") is None
+    assert bench_compare.direction("extra.telemetry_history.burn_pages") is None
+    assert bench_compare.direction("extra.telemetry_history.single_window_alerts") is None
+    for name in (
+        "extra.telemetry_history.history_mem_savings_x",
+        "extra.telemetry_history.history_determinism_parity",
+        "extra.telemetry_history.historyz_parity",
+        "extra.telemetry_history.burn_drill_parity",
+        "extra.telemetry_history.history_query_p50_us",
+        "extra.telemetry_history.history_query_p99_us",
+    ):
+        assert name in bench_compare.THRESHOLDS
+    cols = lambda savings, burn: {"telemetry_history": {
+        "history_mem_savings_x": savings, "history_blocks_retained": 81.0,
+        "history_folds": 2278.0, "history_determinism_parity": 1.0,
+        "historyz_parity": 1.0, "history_query_p50_us": 25.0,
+        "history_query_p99_us": 64.0, "burn_drill_parity": burn,
+        "burn_pages": 1.0 if burn else 0.0, "single_window_alerts": 12.0,
+    }}
+    good = _round(1, 29500.0, extra_overrides=cols(44.4, 1.0))
+    # regression A: retention degenerated toward the naive ring (44x → 4x)
+    mem_bad = _round(2, 29500.0, extra_overrides=cols(4.0, 1.0))
+    paths = _write_rounds(tmp_path, [good, mem_bad])
+    res = _cli([BENCH_COMPARE, *paths, "--check"])
+    assert res.returncode == 1, res.stdout + res.stderr
+    assert "history_mem_savings_x" in res.stdout
+    # regression B: the burn drill missed its page (parity 1.0 → 0.0)
+    (tmp_path / "burn").mkdir()
+    burn_bad = _round(2, 29500.0, extra_overrides=cols(44.4, 0.0))
+    paths = _write_rounds(tmp_path / "burn", [good, burn_bad])
+    res = _cli([BENCH_COMPARE, *paths, "--check"])
+    assert res.returncode == 1, res.stdout + res.stderr
+    assert "burn_drill_parity" in res.stdout
+    # steady rounds pass (small mem-ratio jitter stays inside the threshold)
+    (tmp_path / "ok").mkdir()
+    steady = _write_rounds(
+        tmp_path / "ok", [good, _round(2, 29500.0, extra_overrides=cols(44.0, 1.0))])
+    assert _cli([BENCH_COMPARE, *steady, "--check"]).returncode == 0
+
+
 # ------------------------------------------------- bench crash-report harden
 
 

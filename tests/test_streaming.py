@@ -828,7 +828,7 @@ def test_drift_monitor_rolling_reference_and_reset():
 
 
 def test_coalesce_version_is_bumped_for_streaming_counters():
-    assert C._VERSION == 10  # v10: causal trace plane (flightrec_dumps)
+    assert C._VERSION == 11  # v11: telemetry history plane (history_folds/burn_alerts)
     # the streaming counters are real fields of the piggybacked vector
     for f in ("window_rolls", "window_rotations", "async_syncs", "async_sync_wait_us",
               "drift_evals", "drift_breaches", "serve_rejected"):

@@ -1,0 +1,494 @@
+"""Telemetry history plane (ISSUE 19): telescoping multi-resolution retention,
+time-travel queries, multi-window burn-rate SLOs.
+
+Acceptance contract:
+
+- **Retention is O(levels)**: hours of virtual time retain ~sum(keep) blocks,
+  never one block per finest-span tick (the naive-ring comparison the
+  `telemetry_history` bench pins as `history_mem_savings_x`).
+- **History is deterministic under an injected clock**: two identical
+  virtual-clock sessions (and two same-seed fleet soaks) export byte-identical
+  history blocks — the same contract as the flight recorder's causal block.
+- **`/historyz?at=` answers exactly what `history.at(t)` answers in-process.**
+- **The burn drill pages exactly once**: an injected transient spike plus a
+  sustained burn fire the multi-window `burn()` rule ONE time (cooldown
+  honored) while a single-window rule flaps.
+- **One percentile estimator**: `Histogram.percentile`, the trace-report
+  columns, and the bench consume `observability/quantile.py` — pinned by a
+  sweep over every bucket boundary.
+"""
+
+import dataclasses
+import http.client
+import importlib.util
+import json
+import os
+import warnings
+
+import pytest
+
+from torchmetrics_tpu import observability as obs
+from torchmetrics_tpu.chaos import (
+    FaultSchedule,
+    FaultSpec,
+    SoakConfig,
+    TrafficConfig,
+    run_soak,
+)
+from torchmetrics_tpu.observability import histograms as H
+from torchmetrics_tpu.observability import quantile as Q
+from torchmetrics_tpu.observability.counters import COUNTER_FIELDS
+from torchmetrics_tpu.observability.events import EVENT_KINDS
+from torchmetrics_tpu.parallel import coalesce as C
+from torchmetrics_tpu.streaming import TelescopingFold
+
+pytestmark = pytest.mark.timeseries
+
+
+def _load_trace_report():
+    spec = importlib.util.spec_from_file_location(
+        "trace_report", os.path.join(os.path.dirname(__file__), "..", "tools", "trace_report.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _get(port, path):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=10)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    body = resp.read().decode("utf-8")
+    status = resp.status
+    conn.close()
+    return status, body
+
+
+# ------------------------------------------------------------ TelescopingFold
+
+
+def test_fold_closes_blocks_into_coarser_levels():
+    f = TelescopingFold(spans=(1.0, 10.0))
+    f.feed(0.2, 1)
+    f.feed(0.7, 2)  # same 1s block: merged into the open value
+    assert f.blocks(0) == [(0.0, 1.0, 3)]  # open block reported with its end
+    f.feed(1.5, 5)  # closes [0,1): stays at level 0 AND folds into level 1
+    assert f.blocks(0) == [(0.0, 1.0, 3), (1.0, 2.0, 5)]
+    assert f.blocks(1) == [(0.0, 10.0, 3)]
+    assert f.folds == 1
+    f.feed(11.0, 7)  # closes [1,2) at level 0; its value folds into level 1
+    assert (0.0, 10.0, 8) in f.blocks(1)
+    assert f.folds == 2
+
+
+def test_fold_merges_vectors_and_keeps_out_of_order_samples():
+    f = TelescopingFold(spans=(1.0, 10.0))
+    f.feed(0.1, [1, 2])
+    f.feed(0.9, [10, 20])
+    assert f.blocks(0) == [(0.0, 1.0, [11, 22])]
+    f.feed(2.0, [1, 1])
+    # an out-of-order sample (clock went backwards across a block boundary)
+    # is kept, coarsely, in the currently-open block — never dropped
+    f.feed(0.5, [100, 100])
+    assert f.blocks(0)[-1] == (2.0, 3.0, [101, 101])
+
+
+def test_fold_validation_and_defaults():
+    with pytest.raises(ValueError):
+        TelescopingFold(spans=(10.0, 1.0))  # spans must strictly increase
+    with pytest.raises(ValueError):
+        TelescopingFold(spans=())
+    f = TelescopingFold()  # default spans tile each level into the next
+    assert f.spans == (1.0, 10.0, 60.0, 3600.0)
+    with pytest.raises(IndexError):
+        f.blocks(99)
+
+
+def test_fold_memory_is_o_levels_not_o_elapsed():
+    """Three virtual hours of 1 Hz feeds: a naive finest-resolution ring
+    covering the longest span would hold 3600 blocks; the telescope holds
+    ~sum(keep) regardless of elapsed time."""
+    f = TelescopingFold(spans=(1.0, 10.0, 60.0, 3600.0))
+    ticks = 3 * 3600
+    for i in range(ticks):
+        f.feed(float(i), 1)
+    cap = sum(f.keep) + len(f.spans)  # every ring full + every open block
+    assert f.block_count() <= cap
+    naive = 3600  # longest span / finest span
+    assert naive / f.block_count() > 30.0
+    # a fully-telescoped window is LOSSLESS: the first closed top-level block
+    # carries exactly its hour's worth of samples
+    assert f.blocks(len(f.spans) - 1)[0] == (0.0, 3600.0, 3600)
+
+
+# ----------------------------------------------------------- TelemetryHistory
+
+
+def test_history_validates_vector_lengths():
+    h = obs.TelemetryHistory(clock=lambda: 0.0)
+    with pytest.raises(ValueError, match="history sample"):
+        h.observe([0, 1], [0] * H.FLEET_VECTOR_LEN)
+    with pytest.raises(ValueError, match="history sample"):
+        h.observe([0] * len(COUNTER_FIELDS), [0, 1, 2])
+
+
+def test_history_retains_deltas_and_answers_time_travel_queries():
+    clock = {"t": 0.0}
+    cfg = obs.TelemetryConfig(history_clock=lambda: clock["t"])
+    with obs.telemetry_session(cfg) as rec:
+        for i in range(40):
+            clock["t"] += 1.0
+            rec.counters.record_dispatch("m", f"sig{i % 4}")
+            rec.histograms.record_duration("update", "M#0", 0.001)
+            rec.observe_history()
+        # at(): the finest retained block covering the instant, carrying the
+        # DELTA over that block (not the absolute counter state)
+        block = rec.history.at(clock["t"] - 0.5)
+        assert block is not None and block["level"] == 0
+        assert block["counters"]["dispatches"] == 1
+        assert block["histograms"]["update"]["count"] == 1
+        # an early instant has telescoped into a coarser level by now
+        early = rec.history.at(2.0)
+        assert early is not None and early["level"] >= 1
+        assert rec.history.at(-5.0) is None  # before the session: no block
+        # range(): docs overlapping the window, at the requested level
+        docs = rec.history.range(0.0, clock["t"] + 1.0, level=1)
+        assert docs and all(d["span"] == 10.0 for d in docs)
+        # conservation: every closed finest block's delta folded up — the 10s
+        # level carries all 39 closed dispatches (the 40th is still open at
+        # the finest level)
+        assert sum(d["counters"].get("dispatches", 0) for d in rec.history.range(
+            0.0, float("inf"), level=1)) == 39
+        levels = rec.history.levels()
+        assert levels["samples"] == 40 and len(levels["levels"]) == 4
+        # the fold cadence is itself observable: counter + history events
+        assert rec.counters.snapshot().counts["history_folds"] == rec.history.folds
+        ev = rec.events_of("history")
+        assert ev and ev[-1].payload["blocks"] == rec.history.block_count()
+
+
+def test_history_export_is_deterministic_and_drops_wall_clock_counters():
+    def _run():
+        clock = {"t": 0.0}
+        with obs.telemetry_session(
+            obs.TelemetryConfig(history_clock=lambda: clock["t"])
+        ) as rec:
+            for i in range(150):
+                clock["t"] += 3.0
+                rec.counters.record_dispatch("m", f"sig{i % 2}")
+                rec.counters.record_sync_time(123 + i)  # wall-clock-tainted
+                rec.observe_history()
+            return rec.history_block(last_n=8)
+
+    a, b = _run(), _run()
+    assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+    for level in a["levels"]:
+        for block in level["blocks"]:
+            assert "sync_time_us" not in block["counters"]
+            assert block["counters"].get("dispatches", 0) >= 0
+
+
+def test_history_disabled_by_config():
+    with obs.telemetry_session(
+        obs.TelemetryConfig(history_spans=None)
+    ) as rec:
+        assert rec.history is None
+        assert rec.observe_history() == 0
+        assert rec.history_block() is None
+        with obs.HealthServer(port=0) as server:
+            status, body = _get(server.port, "/historyz")
+            assert status == 200 and json.loads(body) == {"telemetry": False}
+
+
+# ------------------------------------------------------- percentile parity
+
+
+def test_one_percentile_estimator_across_every_bucket_boundary():
+    """Satellite: the ONE stdlib estimator (`observability/quantile.py`) is
+    what `Histogram.percentile`, trace_report's columns, and the bench all
+    answer with — swept at both edges of every log2 bucket."""
+    trace_report = _load_trace_report()
+    for b in range(Q.N_BUCKETS):
+        for v in (1 << b, (1 << (b + 1)) - 1):
+            hist = H.Histogram()
+            hist.record(v)
+            hist.record(v)
+            hist.record(max(v // 2, 1))
+            merged = H.Histogram.from_vector(hist.to_vector())  # no lo/hi, like a fleet merge
+            sparse = {i: c for i, c in enumerate(hist.counts) if c}
+            for _, q in H.PERCENTILES:
+                canonical = Q.percentile_from_buckets(sparse, hist.count, q)
+                assert trace_report._hist_percentile(sparse, hist.count, q) == canonical
+                assert Q.percentile_from_buckets(list(hist.counts), hist.count, q) == canonical
+                assert merged.percentile(q) == pytest.approx(canonical, rel=1e-12)
+                clamped = Q.percentile_from_buckets(
+                    sparse, hist.count, q, lo=hist.lo, hi=hist.hi)
+                assert hist.percentile(q) == pytest.approx(clamped, rel=1e-12)
+                assert hist.lo <= hist.percentile(q) <= hist.hi
+    assert Q.percentile_from_buckets({}, 0, 0.5) is None
+    assert Q.percentile_from_buckets({3: 0}, 5, 0.5) is None
+
+
+# ------------------------------------------------------------ burn-rate SLOs
+
+
+_SINGLE = obs.SloRule(
+    name="single_window_d2h", expr="d2h_readbacks > 0",
+    window=60.0, cooldown=60.0, severity="warning",
+)
+_BURN = obs.SloRule(
+    name="burn_d2h", expr="burn('d2h_readbacks / window > 0.04', 60.0, 600.0)",
+    window=60.0, cooldown=1800.0, severity="critical",
+)
+
+
+def _drill(rec, clock):
+    while clock["t"] < 1200.0:
+        clock["t"] += 10.0
+        if clock["t"] == 100.0:
+            for _ in range(3):  # the transient spike
+                rec.counters.record_d2h(64)
+        if clock["t"] >= 600.0:  # the sustained burn
+            rec.counters.record_d2h(64)
+        rec.evaluate_slos(now=clock["t"])
+
+
+def test_burn_rule_pages_exactly_once_while_single_window_flaps():
+    clock = {"t": 0.0}
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with obs.telemetry_session(
+            obs.TelemetryConfig(
+                slo_rules=(_SINGLE, _BURN), slo_eval_on_sync=False,
+                history_clock=lambda: clock["t"],
+            )
+        ) as rec:
+            _drill(rec, clock)
+            counts = rec.counters.snapshot().counts
+            assert counts["burn_alerts"] == 1  # exactly once, cooldown honored
+            pages = rec.events_of("burn_alert")
+            assert len(pages) == 1
+            assert pages[0].metric == "burn_d2h" and pages[0].tag == "critical"
+            assert pages[0].payload["short_window"] == 60.0
+            assert pages[0].payload["long_window"] == 600.0
+            # the single-window rule flapped: the spike plus one page per
+            # cooldown through the sustained phase
+            single = [e for e in rec.events_of("alert") if e.metric == "single_window_d2h"]
+            assert len(single) >= 3
+            # the burn page annotates the alert it rides with both windows
+            burn_alert = next(
+                a for a in rec.slo.snapshot()["recent_alerts"]
+                if a["rule"] == "burn_d2h")
+            assert burn_alert["burn"] == {"short": 60.0, "long": 600.0}
+
+
+def test_transient_spike_alone_never_pages_the_burn_rule():
+    clock = {"t": 0.0}
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with obs.telemetry_session(
+            obs.TelemetryConfig(slo_rules=(_SINGLE, _BURN), slo_eval_on_sync=False)
+        ) as rec:
+            while clock["t"] < 500.0:  # spike at t=100, then silence
+                clock["t"] += 10.0
+                if clock["t"] == 100.0:
+                    for _ in range(3):
+                        rec.counters.record_d2h(64)
+                rec.evaluate_slos(now=clock["t"])
+            counts = rec.counters.snapshot().counts
+            assert counts["burn_alerts"] == 0  # the long window stayed clean
+            single = [e for e in rec.events_of("alert") if e.metric == "single_window_d2h"]
+            assert len(single) >= 1  # the single-window rule paged on the spike
+
+
+def test_rate_and_delta_helpers_in_rule_expressions():
+    rule = obs.SloRule(
+        name="rate_rule", expr="rate('d2h_readbacks', 10.0) > 0.5 and delta('d2h_readbacks', 10.0) >= 6",
+        window=10.0, cooldown=1e9, severity="warning",
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with obs.telemetry_session(
+            obs.TelemetryConfig(slo_rules=(rule,), slo_eval_on_sync=False)
+        ) as rec:
+            rec.evaluate_slos(now=1.0)
+            assert not rec.slo.snapshot()["rules"]["rate_rule"]["breached"]
+            for _ in range(8):
+                rec.counters.record_d2h(1)
+            rec.evaluate_slos(now=11.0)
+            assert rec.slo.snapshot()["rules"]["rate_rule"]["breached"]
+    # unknown counters fail loud: rule_error, not a silent False
+    bad = obs.SloRule(name="bad", expr="delta('nope', 5.0) > 0", window=5.0)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with obs.telemetry_session(
+            obs.TelemetryConfig(slo_rules=(bad,), slo_eval_on_sync=False)
+        ) as rec:
+            fired = rec.slo.evaluate(rec, now=20.0)
+            assert fired and fired[0]["kind"] == "rule_error"
+
+
+# -------------------------------------------------------------- live /historyz
+
+
+def test_historyz_endpoint_matches_in_process_queries():
+    clock = {"t": 0.0}
+    with obs.telemetry_session(
+        obs.TelemetryConfig(history_clock=lambda: clock["t"])
+    ) as rec:
+        for i in range(120):
+            clock["t"] += 2.0
+            rec.counters.record_dispatch("m", f"sig{i % 3}")
+            rec.observe_history()
+        with obs.HealthServer(port=0) as server:
+            # the full levels document
+            status, body = _get(server.port, "/historyz")
+            doc = json.loads(body)
+            assert status == 200 and doc["telemetry"] is True
+            assert doc["history"] == json.loads(json.dumps(rec.history.levels()))
+            # ?at= answers byte-for-byte what history.at() answers in-process
+            t_query = clock["t"] - 1.0
+            status, body = _get(server.port, f"/historyz?at={t_query}")
+            doc = json.loads(body)
+            assert status == 200
+            assert doc["block"] == json.loads(json.dumps(rec.history.at(t_query)))
+            # ?level= slices one level
+            status, body = _get(server.port, "/historyz?level=1")
+            doc = json.loads(body)
+            assert status == 200 and all(b["level"] == 1 for b in doc["blocks"])
+            # malformed params answer 400, not a hung socket or a 500
+            status, body = _get(server.port, "/historyz?at=yesterday")
+            assert status == 400
+            # the 404 endpoint table names /historyz
+            status, body = _get(server.port, "/nope")
+            assert status == 404 and "/historyz" in body
+
+
+# --------------------------------------------------- /metricsz exposition golden
+
+
+def test_metricsz_histogram_exposition_golden():
+    """Satellite: histograms export as proper Prometheus cumulative
+    `_bucket{le=...}`/`_sum`/`_count` lines — pinned as a golden block so the
+    exposition format cannot drift silently."""
+    with obs.telemetry_session() as rec:
+        for us in (3, 50, 1000):
+            rec.histograms.record_duration("update", "G#0", us / 1e6)
+        text = obs.render_prometheus(rec)
+    start = text.index("# HELP tpu_metrics_latency_seconds ")
+    end = text.index("\n", text.index("_count", start))
+    golden = "\n".join([
+        '# HELP tpu_metrics_latency_seconds dispatch-boundary latency distribution (log2 buckets)',
+        '# TYPE tpu_metrics_latency_seconds histogram',
+        'tpu_metrics_latency_seconds_bucket{kind="update",key="G#0",le="2e-06"} 0',
+        'tpu_metrics_latency_seconds_bucket{kind="update",key="G#0",le="4e-06"} 1',
+        'tpu_metrics_latency_seconds_bucket{kind="update",key="G#0",le="6.4e-05"} 2',
+        'tpu_metrics_latency_seconds_bucket{kind="update",key="G#0",le="0.001024"} 3',
+        'tpu_metrics_latency_seconds_bucket{kind="update",key="G#0",le="+Inf"} 3',
+        'tpu_metrics_latency_seconds_sum{kind="update",key="G#0"} 0.001053',
+        'tpu_metrics_latency_seconds_count{kind="update",key="G#0"} 3',
+    ])
+    assert text[start:end] == golden
+
+
+# ----------------------------------------------- artifacts, soaks, rendering
+
+
+def test_flightrec_artifact_carries_deterministic_history_block(tmp_path):
+    def _run(root):
+        clock = {"t": 0.0}
+        flight = obs.FlightRecorder(dump_dir=str(root))
+        with obs.telemetry_session(
+            obs.TelemetryConfig(sinks=(obs.RingBufferSink(), flight),
+                                history_clock=lambda: clock["t"])
+        ) as rec:
+            for i in range(60):
+                clock["t"] += 1.0
+                rec.counters.record_dispatch("m", f"sig{i % 2}")
+                rec.observe_history()
+            artifact = flight.dump("drill")
+            assert artifact["history"] == rec.history_block()
+        return artifact["history"]
+
+    a = _run(tmp_path / "a")
+    b = _run(tmp_path / "b")
+    assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+    assert a["levels"] and any(lv["blocks"] for lv in a["levels"])
+
+
+def test_fleet_soak_history_blocks_are_byte_identical_across_seeds(tmp_path):
+    def _cfg(root):
+        return SoakConfig(
+            traffic=TrafficConfig(steps=30, tenants=10, seed=7),
+            faults=FaultSchedule([FaultSpec(step=8, kind="host_loss", target="host-1")]),
+            capacity=12,
+            megabatch_size=4,
+            spill_codec="none",
+            durability_dir=str(root),
+            snapshot_every=6,
+            journal_fsync_every=1,
+            fleet_hosts=3,
+        )
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        first = run_soak(_cfg(tmp_path / "a"))
+        second = run_soak(_cfg(tmp_path / "b"))
+    assert first.history is not None and first.history["levels"]
+    assert json.dumps(first.history, sort_keys=True) == json.dumps(
+        second.history, sort_keys=True)
+    # the control tower rollup carries the retained levels too
+    assert "history" in first.fleet_telemetry
+    # and the report round-trips through its dict form with the block intact
+    assert first.to_dict()["history"] == first.history
+
+
+def test_single_host_soak_history_is_deterministic(tmp_path):
+    cfg = SoakConfig(traffic=TrafficConfig(steps=40, tenants=8, seed=11))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        first = run_soak(cfg)
+        second = run_soak(cfg)
+    assert first.history is not None
+    assert json.dumps(first.history, sort_keys=True) == json.dumps(
+        second.history, sort_keys=True)
+
+
+def test_trace_report_renders_history_and_burn_events(tmp_path, capsys):
+    trace_report = _load_trace_report()
+    # the aggregate path: history folds total, burn pages get detail lines
+    events = [
+        {"kind": "history", "metric": "telemetry", "tag": "fold",
+         "payload": {"folds": 3, "blocks": 12}},
+        {"kind": "burn_alert", "metric": "burn_d2h", "tag": "critical",
+         "payload": {"short_window": 60.0, "long_window": 600.0, "at": 700.0}},
+    ]
+    report = trace_report.aggregate(events)
+    assert report["totals"]["history_folds"] == 3
+    assert report["totals"]["burn_alerts"] == 1
+    table = trace_report.render_table(report)
+    assert "history folds: 3" in table
+    assert "burn page burn_d2h" in table
+    # the --history timeline from a flight-recorder-shaped artifact
+    clock = {"t": 0.0}
+    with obs.telemetry_session(
+        obs.TelemetryConfig(history_clock=lambda: clock["t"])
+    ) as rec:
+        for i in range(90):
+            clock["t"] += 1.0
+            rec.counters.record_dispatch("m", "sig")
+            rec.observe_history()
+        block = rec.history_block(last_n=8)
+    path = tmp_path / "artifact.json"
+    path.write_text(json.dumps({"history": block}))
+    assert trace_report.main([str(path), "--history"]) == 0
+    out = capsys.readouterr().out
+    assert "telemetry history:" in out and "level 0 (span 1" in out and "|" in out
+    # every event kind still has a renderer row (history/burn_alert included)
+    assert set(trace_report.EVENT_RENDERERS) == set(EVENT_KINDS)
+
+
+def test_wire_layout_pins_version_11():
+    assert COUNTER_FIELDS[-2:] == ("history_folds", "burn_alerts")
+    assert "history" in EVENT_KINDS and "burn_alert" in EVENT_KINDS
+    assert C._VERSION == 11

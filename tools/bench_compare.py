@@ -101,6 +101,18 @@ THRESHOLDS: Dict[str, float] = {
     "extra.fleet_failover.migration_parity": 0.01,
     "extra.fleet_failover.fleet_determinism_parity": 0.01,
     "extra.fleet_failover.soak_recovery_parity": 0.01,
+    # telemetry_history: the memory-savings ratio is deterministic (both
+    # numerator and denominator are block counts of a scripted virtual-clock
+    # run — gate any real shrink); the parity gates are exact 1.0-or-broken
+    # columns (byte-identical retention, live /historyz == in-process query,
+    # burn drill paged exactly once); query latencies are wall-clock µs on a
+    # shared pod — gate order-of-magnitude blowups only
+    "extra.telemetry_history.history_mem_savings_x": 0.05,
+    "extra.telemetry_history.history_determinism_parity": 0.01,
+    "extra.telemetry_history.historyz_parity": 0.01,
+    "extra.telemetry_history.burn_drill_parity": 0.01,
+    "extra.telemetry_history.history_query_p50_us": 0.6,
+    "extra.telemetry_history.history_query_p99_us": 0.6,
     # multi-tenant serving engine: throughputs wobble like the flagship on a
     # shared pod; the naive baseline is a denominator like the torch proxy;
     # the spill column is a host<->device copy latency (noisy small values).
@@ -223,7 +235,13 @@ _HIGHER_EXACT = ("value", "vs_baseline", "tenants_per_dispatch",
                  # to the uninterrupted single-host reference, every migration
                  # bitwise, the whole counter block replayable run-to-run
                  "fleet_failover_parity", "migration_parity",
-                 "fleet_determinism_parity")
+                 "fleet_determinism_parity",
+                 # telemetry_history: the O(levels) retention ratio (a drop
+                 # means the telescope is hoarding blocks) plus the 1.0-parity
+                 # gates — byte-identical same-seed retention, live /historyz
+                 # answering the in-process query, burn drill paging once
+                 "history_mem_savings_x", "history_determinism_parity",
+                 "historyz_parity", "burn_drill_parity")
 _LOWER_MARKERS = ("latency", "compile", "_sec", "_ms", "_us", "_bytes", "bytes_", "time")
 # collective counts per sync: fewer is the whole point of the coalesced plane —
 # a move back toward per-leaf collectives must gate even though the name
@@ -290,14 +308,23 @@ _INFO_EXACT = ("leaves_coalesced_per_sync", "per_leaf_collectives", "ttfu_precom
                # scale to gate ("_us" would otherwise pin it lower-is-better)
                "hosts", "hosts_joined", "host_failovers", "tenant_migrations",
                "lease_expiries", "fleet_heartbeats", "adopted_tenants",
-               "parked_batches", "migration_us")
+               "parked_batches", "migration_us",
+               # telemetry_history workload descriptors: deterministic tallies
+               # of the scripted drill (the savings-ratio and parity columns
+               # gate the regressions these restate — burn_pages != 1 already
+               # zeroes burn_drill_parity)
+               "history_blocks_retained", "history_folds", "burn_pages",
+               "single_window_alerts")
 
 
 def direction(name: str) -> Optional[str]:
     """``"higher"``/``"lower"`` = which way is good; ``None`` = informational
     (telemetry counters, attempt counts — constants of the workload, not perf)."""
-    leaf = name.split(".")[-1]
-    if ".telemetry" in name or leaf in ("attempts", "n", "rc") or leaf in _INFO_EXACT:
+    parts = name.split(".")
+    leaf = parts[-1]
+    # exact segment match: the "telemetry" counter group is informational, but
+    # the telemetry_history bench columns gate like any other config's
+    if "telemetry" in parts or leaf in ("attempts", "n", "rc") or leaf in _INFO_EXACT:
         return None
     if leaf in _LOWER_EXACT:
         return "lower"

@@ -29,9 +29,23 @@ latency percentiles — the "why did it get slow/wrong/expensive" signals.
 from __future__ import annotations
 
 import argparse
+import importlib.util
 import json
+import os
 import sys
 from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+# the one canonical log2-bucket percentile estimator lives in
+# ``torchmetrics_tpu/observability/quantile.py`` — itself stdlib-only, so we
+# load it by file path instead of importing the package (which would
+# initialize jax); traces keep rendering on a laptop
+_QUANTILE_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), os.pardir,
+    "torchmetrics_tpu", "observability", "quantile.py",
+)
+_spec = importlib.util.spec_from_file_location("_tm_quantile", _QUANTILE_PATH)
+_quantile = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_quantile)
 
 
 # The pinned kind → rendering table: every entry of
@@ -65,6 +79,8 @@ EVENT_RENDERERS: Dict[str, str] = {
     "migration": "fleet section: committed moves + tenants + src→dst routes",
     "failover": "fleet section: adoptions + replay/RPO + one detail line per host",
     "flightrec": "flight-recorder section: one line per postmortem artifact",
+    "history": "footer history-fold total; retained blocks render via --history",
+    "burn_alert": "footer burn-page total + one detail line per page",
 }
 
 
@@ -101,25 +117,11 @@ _LATENCY_KINDS = ("update", "forward", "compute", "sync")
 
 
 def _hist_percentile(buckets: Dict[int, int], count: int, q: float) -> Optional[float]:
-    """Quantile estimate from log2 bucket counts — a stdlib mirror of
-    ``observability/histograms.py`` (bucket ``b`` spans ``[2^b, 2^(b+1))``,
-    linear interpolation inside the target bucket). Kept dependency-free so
-    traces render on a laptop; pinned against the canonical implementation by
-    a parity test."""
-    if count <= 0 or not buckets:
-        return None
-    target = q * count
-    cum = 0
-    for b in sorted(buckets):
-        c = buckets[b]
-        if c <= 0:
-            continue
-        if cum + c >= target:
-            lo = 0 if b == 0 else 2 ** b
-            hi = 2 ** (b + 1)
-            return lo + (hi - lo) * (target - cum) / c
-        cum += c
-    return float(2 ** (max(buckets) + 1))
+    """Quantile estimate from log2 bucket counts — delegates to the ONE
+    canonical estimator (``observability/quantile.py``, loaded by file path
+    above), so this tool, ``Histogram.percentile`` and the bench columns can
+    never drift apart; pinned by a bucket-boundary parity sweep."""
+    return _quantile.percentile_from_buckets(buckets, count, q)
 
 
 def _merge_hist(store: Dict[Any, Dict[str, Any]], key: Any, payload: Dict[str, Any]) -> None:
@@ -142,6 +144,7 @@ def aggregate(events: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
         "quant_syncs": 0, "quant_bytes_saved": 0,
         "aot_loads": 0, "state_growth_warnings": 0, "alerts": 0,
         "tenant_spills": 0, "tenant_readmits": 0,
+        "history_folds": 0, "burn_alerts": 0,
     }
     # durability plane: snapshot/journal events (engine crash-consistency)
     durability = {
@@ -157,6 +160,7 @@ def aggregate(events: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
     }
     flightrec: List[Dict[str, Any]] = []
     alerts: List[Dict[str, Any]] = []
+    burn_alerts: List[Dict[str, Any]] = []
     # async double-buffered syncs: gather wall vs commit wait, per event
     async_stats = {"gather_s": 0.0, "wait_s": 0.0, "overlap_pct_sum": 0.0, "fallbacks": 0}
     # quantized syncs: per-(rank, codec) compression rows
@@ -250,6 +254,13 @@ def aggregate(events: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
         elif kind == "alert":
             totals["alerts"] += 1
             alerts.append(ev)
+        elif kind == "burn_alert":
+            totals["burn_alerts"] += 1
+            burn_alerts.append(ev)
+        elif kind == "history":
+            # one event per feed that closed retained blocks; the blocks
+            # themselves render from an artifact/report via --history
+            totals["history_folds"] += int(ev.get("payload", {}).get("folds", 0))
         elif kind == "tenant_spill":
             if tag == "readmit":
                 totals["tenant_readmits"] += 1
@@ -384,6 +395,7 @@ def aggregate(events: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
         "rows": report_rows, "totals": totals, "retries": retries, "quarantines": quarantines,
         "latency": latency, "multi_rank": any_rank, "streaming": streaming,
         "quant": quant or None, "alerts": alerts or None,
+        "burn_alerts": burn_alerts or None,
         "durability": durability_out, "fleet": fleet_out,
         "flightrec": flightrec or None,
     }
@@ -464,6 +476,71 @@ def load_tree_source(path: str, rank: Optional[Any] = None) -> List[Dict[str, An
         if isinstance(doc, dict) and isinstance(doc.get("causal"), dict):
             return list(doc["causal"].get("events", ()))
     return load_events(path, rank=rank)
+
+
+def load_history_source(path: str) -> Optional[Dict[str, Any]]:
+    """The telemetry-history block for ``--history``: a flight-recorder
+    artifact or a ``SoakReport`` JSON (both carry it under ``"history"``), or
+    the block itself (``/historyz`` body or a bare ``export_block`` dump)."""
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            doc = json.load(fh)
+    except (json.JSONDecodeError, OSError):
+        return None
+    if not isinstance(doc, dict):
+        return None
+    if isinstance(doc.get("history"), dict):
+        return doc["history"]
+    if isinstance(doc.get("levels"), list):
+        return doc
+    return None
+
+
+# intensity ramp for the sparklines (pure ASCII: renders over any ssh/pager)
+_SPARK_CHARS = " .:-=+*#%@"
+
+
+def _block_weight(block: Dict[str, Any]) -> int:
+    """One block's activity: total event count when the block carries the
+    deterministic export shape, total counter delta otherwise (the
+    ``levels()``/``/historyz`` shape)."""
+    events = block.get("events") or {}
+    if events:
+        return sum(int(v) for v in events.values())
+    return sum(int(v) for v in (block.get("counters") or {}).values())
+
+
+def render_history(history: Optional[Dict[str, Any]]) -> str:
+    """ASCII timeline of the retained telescoping levels: per level one line
+    with the covered virtual-time range, retained block count, and a
+    sparkline of per-block activity (finest level first — recent detail on
+    top, coarse archive below)."""
+    if not history or not history.get("levels"):
+        return "(no telemetry history block)"
+    lines = [
+        f"telemetry history: spans={history.get('spans')} "
+        f"samples={history.get('samples')} folds={history.get('folds')}"
+    ]
+    for i, level in enumerate(history["levels"]):
+        span = level.get("span", "?")
+        blocks = level.get("blocks") or []
+        if not blocks:
+            lines.append(f"  level {i} (span {span}s): no retained blocks")
+            continue
+        weights = [_block_weight(b) for b in blocks]
+        peak = max(weights) or 1
+        spark = "".join(
+            _SPARK_CHARS[min(len(_SPARK_CHARS) - 1,
+                             (w * (len(_SPARK_CHARS) - 1)) // peak)]
+            for w in weights
+        )
+        t0 = blocks[0].get("start")
+        t1 = blocks[-1].get("end")
+        lines.append(
+            f"  level {i} (span {span}s): [{t0:g} .. {t1:g}]  "
+            f"{len(blocks)} block(s)  |{spark}|  peak {peak}/blk"
+        )
+    return "\n".join(lines)
 
 
 def render_table(report: Dict[str, Any]) -> str:
@@ -561,6 +638,16 @@ def render_table(report: Dict[str, Any]) -> str:
         for ev in report["alerts"]:
             p = ev.get("payload", {})
             lines.append(f"  alert {ev.get('metric')}: {p.get('rule', ev.get('tag'))}: {p.get('message', '')}")
+    if report.get("burn_alerts"):
+        for ev in report["burn_alerts"]:
+            p = ev.get("payload", {})
+            lines.append(
+                f"  burn page {ev.get('metric')} ({ev.get('tag')}): "
+                f"short {p.get('short_window')}s AND long {p.get('long_window')}s burned"
+            )
+    if report["totals"]["history_folds"]:
+        lines.append(f"history folds: {report['totals']['history_folds']} "
+                     "(render retained blocks from an artifact/report with --history)")
     if report.get("latency"):
         parts = []
         for kind, block in report["latency"].items():
@@ -587,9 +674,28 @@ def main(argv: List[str] = None) -> int:
                         help="render the causal span tree (trace_id/span_id/parent_id) "
                              "instead of the summary table; also accepts a "
                              "flight-recorder artifact JSON")
+    parser.add_argument("--history", action="store_true",
+                        help="render the telemetry-history timeline (retained "
+                             "telescoping levels as ASCII sparklines) from a "
+                             "flight-recorder artifact, SoakReport JSON, or "
+                             "/historyz body")
     args = parser.parse_args(argv)
     if args.rank is not None and len(args.rank) != len(args.traces):
         parser.error(f"got {len(args.rank)} --rank labels for {len(args.traces)} traces")
+    if args.history:
+        rc = 0
+        for path in args.traces:
+            history = load_history_source(path)
+            if len(args.traces) > 1:
+                print(f"== {path}")
+            if args.json:
+                print(json.dumps(history, indent=2))
+            elif history is None:
+                print(f"warning: {path}: no history block found", file=sys.stderr)
+                rc = 1
+            else:
+                print(render_history(history))
+        return rc
     multi = len(args.traces) > 1
     events: List[Dict[str, Any]] = []
     for i, path in enumerate(args.traces):

@@ -239,6 +239,11 @@ class SoakReport:
     # just before teardown — fleet soaks only; carries wall-clock latency
     # summaries, so it lives OUTSIDE the counters determinism contract
     fleet_telemetry: Optional[Dict[str, Any]] = None
+    # the telemetry history's deterministic export (recorder.history_block()):
+    # retained level boundaries keyed by the soak's virtual clock, so two
+    # same-seed runs carry byte-identical blocks — INSIDE the determinism
+    # contract, same standing as ``counters`` (pinned by test and bench)
+    history: Optional[Dict[str, Any]] = None
 
     def to_dict(self) -> Dict[str, Any]:
         return dataclasses.asdict(self)
@@ -667,6 +672,9 @@ def run_soak(
             sinks=(
                 (_observability.RingBufferSink(), flight) if flight is not None else ()
             ),
+            # history keyed by the soak's virtual clock: same seed ⇒ same
+            # block boundaries ⇒ byte-identical SoakReport.history
+            history_clock=lambda: clock["t"],
         )
     ) as rec:
         current_step = -1
@@ -759,6 +767,7 @@ def run_soak(
 
         snap = rec.counters.snapshot().counts
         lat = rec.latency_summary()
+        history_block = rec.history_block(last_n=16)
         reconciliation = {
             "dispatches": int(snap.get("dispatches", 0)),
             "jit_compiles": int(snap.get("jit_compiles", 0)),
@@ -846,6 +855,7 @@ def run_soak(
             "failover_at": cfg.failover_at,
             "state_digest": final_digest,
         },
+        history=history_block,
     )
 
 
@@ -929,6 +939,9 @@ def run_fleet_soak(
         _observability.TelemetryConfig(
             slo_rules=tuple(default_rules()) + soak_rules(shed_rate_max=cfg.shed_rate_max),
             sinks=(_observability.RingBufferSink(), flight),
+            # same virtual-clock keying as the single-host soak: same seed ⇒
+            # byte-identical SoakReport.history across fleet runs
+            history_clock=lambda: clock["t"],
         )
     ) as rec:
         controller = FleetController(
@@ -1058,6 +1071,7 @@ def run_fleet_soak(
         injected = sum(1 for r in records if r["outcome"] != "not_fired")
 
         snap = rec.counters.snapshot().counts
+        history_block = rec.history_block(last_n=16)
         reconciliation = {
             "dispatches": int(snap.get("dispatches", 0)),
             "jit_compiles": int(snap.get("jit_compiles", 0)),
@@ -1130,4 +1144,5 @@ def run_fleet_soak(
             "state_digest": digest_h.hexdigest(),
         },
         fleet_telemetry=fleet_telemetry,
+        history=history_block,
     )

@@ -675,6 +675,10 @@ class FleetController:
         rec = _observability._ACTIVE
         if rec is not None:
             out["latency"] = rec.latency_summary()
+            if rec.history is not None:
+                # the fleet sim shares one recorder per process, so this IS the
+                # fleet-wide history: retained level boundaries ride the tower
+                out["history"] = rec.history.levels()
         return out
 
     def tenant_digests(self) -> Dict[Hashable, str]:

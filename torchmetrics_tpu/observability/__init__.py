@@ -44,6 +44,7 @@ from . import histograms as histograms_module
 from . import memory as memory_module
 from . import slo as slo_module
 from . import spans
+from . import timeseries as timeseries_module
 from . import tracing
 from .costs import CostRecord, CostRegistry
 from .counters import (
@@ -70,6 +71,7 @@ from .histograms import (
 )
 from .memory import StateMemoryTracker, state_memory
 from .slo import SloEngine, SloRule, default_rules
+from .timeseries import TelemetryHistory
 from . import export  # noqa: E402 — needs histograms imported first
 from .export import HealthServer, MetricsFlusher, render_prometheus
 
@@ -96,6 +98,7 @@ __all__ = [
     "StateMemoryTracker",
     "TelemetryConfig",
     "TelemetryEvent",
+    "TelemetryHistory",
     "TelemetryRecorder",
     "active",
     "aggregate_counters",
@@ -151,6 +154,18 @@ class TelemetryConfig:
             (low-frequency, already collective-shaped — the natural heartbeat
             of a training/eval loop). The export layer's background flusher
             and the health server evaluate on their own cadence regardless.
+        history_spans: level spans (seconds) of the session's telemetry
+            history (``observability/timeseries.py``) — telescoping retention
+            of counter/histogram deltas, fed at the same sync heartbeat the
+            SLO window rides, queried via ``history.at(t)`` / ``/historyz``.
+            ``None`` disables retention entirely.
+        history_keep: per-level closed-block retention caps (defaults to
+            tiling the next level + 24 at the top — see
+            :class:`~torchmetrics_tpu.streaming.TelescopingFold`).
+        history_clock: the history's time source — the determinism seam.
+            Soak/fleet runs inject their virtual clock so same-seed runs
+            retain byte-identical history blocks; defaults to the monotonic
+            clock the event timestamps already use.
     """
 
     sinks: Tuple[Sink, ...] = ()
@@ -162,6 +177,9 @@ class TelemetryConfig:
     state_growth_warn_bytes: int = 256 * 2**20
     slo_rules: Tuple[SloRule, ...] = ()
     slo_eval_on_sync: bool = True
+    history_spans: Optional[Tuple[float, ...]] = timeseries_module.DEFAULT_SPANS
+    history_keep: Optional[Tuple[int, ...]] = None
+    history_clock: Optional[Any] = None  # Callable[[], float]; Any keeps the dataclass hashable-friendly
 
 
 class TelemetryRecorder:
@@ -182,6 +200,15 @@ class TelemetryRecorder:
         self.memory = StateMemoryTracker(self.config.state_growth_warn_bytes)
         self.histograms = HistogramRegistry()
         self.slo = SloEngine(self.config.slo_rules)
+        self.history: Optional[TelemetryHistory] = (
+            TelemetryHistory(
+                spans=self.config.history_spans,
+                keep=self.config.history_keep,
+                clock=self.config.history_clock,
+            )
+            if self.config.history_spans
+            else None
+        )
         self.sinks: Tuple[Sink, ...] = self.config.sinks or (
             RingBufferSink(self.config.ring_buffer_size),
         )
@@ -365,6 +392,12 @@ class TelemetryRecorder:
         # place a rolling SLO window gets fed without touching the update path
         if self.config.slo_eval_on_sync and self.slo.rules:
             self.slo.observe_and_evaluate(self)
+        # ... and the telemetry history telescopes the same heartbeat into its
+        # multi-resolution retention levels — gated on a new finest block
+        # having started, so the vector snapshots are built at most once per
+        # block span and the per-sync cost stays a clock compare
+        if self.history is not None and self.history.due():
+            self.observe_history()
 
     def record_gather_payload(self, plane: str, nbytes: int) -> None:
         """Size of one sync-plane collective payload (``plane`` is
@@ -779,6 +812,40 @@ class TelemetryRecorder:
             }
         return out
 
+    def observe_history(self, now: float = None) -> int:
+        """Feed one counter/histogram snapshot into the session's telescoping
+        telemetry history (no-op when ``history_spans`` disabled retention).
+        Returns the number of blocks the feed closed; each closure bumps the
+        ``history_folds`` counter and emits one ``history`` event so the fold
+        cadence itself is observable."""
+        if self.history is None:
+            return 0
+        folds = self.history.observe(
+            self.counters.counts_vector(),
+            self.histograms.fleet_vector(),
+            now=now,
+        )
+        if folds:
+            self.counters.record_history_folds(folds)
+            self._event(
+                "history",
+                "telemetry",
+                "fold",
+                payload={"folds": folds, "blocks": self.history.block_count()},
+            )
+        return folds
+
+    def history_block(self, last_n: int = 8) -> Optional[Dict[str, Any]]:
+        """The deterministic history export: last ``last_n`` retained block
+        boundaries per level, wall-clock-tainted counters dropped — the block
+        a flight-recorder dump and a ``SoakReport`` carry contractually
+        (byte-identical across same-seed virtual-clock runs)."""
+        if self.history is None:
+            return None
+        return self.history.export_block(
+            last_n=last_n, drop=flightrec_module.NONDETERMINISTIC_COUNTERS
+        )
+
     def evaluate_slos(self, now: float = None) -> list:
         """Evaluate the session's SLO rules right now (the health server and
         the export flusher call this on their own cadence; sync boundaries do
@@ -827,6 +894,10 @@ class TelemetryRecorder:
         if self._closed:  # idempotent: a replaced-then-disabled session must
             return        # not flush its histograms into the sinks twice
         self._closed = True
+        # fold the session's final counter state into the history so the last
+        # partial block is retained before the sinks stop listening
+        if self.history is not None:
+            self.observe_history()
         # flush the final histogram state into the event stream before the
         # sinks close: one ``hist`` event per (kind, key), so a JSONL trace
         # carries the latency distributions ``tools/trace_report.py`` renders

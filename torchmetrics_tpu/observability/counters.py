@@ -75,6 +75,8 @@ COUNTER_FIELDS: Tuple[str, ...] = (
     "tenant_migrations",  # tenants moved host-to-host by the committed migrate protocol
     "migration_us",  # wall-clock spent inside committed migrations (drain -> cutover)
     "flightrec_dumps",  # postmortem artifacts the flight recorder dumped (observability plane)
+    "history_folds",  # telemetry-history blocks closed/telescoped (timeseries plane)
+    "burn_alerts",  # multi-window burn-rate pages (both short AND long window burned)
 )
 
 
@@ -474,6 +476,18 @@ class Counters:
         or explicit ``dump()``)."""
         with self._lock:
             self._counts["flightrec_dumps"] += 1
+
+    def record_history_folds(self, folds: int = 1) -> None:
+        """``folds`` telemetry-history blocks closed (each fold telescopes a
+        fine block into the coarser level above it)."""
+        with self._lock:
+            self._counts["history_folds"] += int(folds)
+
+    def record_burn_alert(self) -> None:
+        """One multi-window burn-rate page: a ``burn(expr, short, long)`` rule
+        breached with BOTH windows burning (cooldown-gated, like alerts)."""
+        with self._lock:
+            self._counts["burn_alerts"] += 1
 
     # --------------------------------------------------------------- querying
 

@@ -51,6 +51,8 @@ EVENT_KINDS: Tuple[str, ...] = (
     "migration",  # a committed host-to-host tenant migration (fleet plane)
     "failover",  # a dead host's tenants adopted by survivors (fleet plane)
     "flightrec",  # the flight recorder dumped a postmortem artifact
+    "history",  # the telemetry history telescoped retained blocks (timeseries plane)
+    "burn_alert",  # a multi-window burn-rate rule paged (short AND long window burned)
 )
 
 

@@ -15,8 +15,11 @@ Three pieces, all fed from **snapshots** so the hot path is never touched:
 - :class:`HealthServer` — a stdlib ``ThreadingHTTPServer`` serving
   ``/healthz`` (liveness + SLO verdict; 503 while a *critical* rule is
   breached), ``/metricsz`` (the Prometheus text), ``/costz`` (compiled-cost
-  accounting as JSON), and ``/sloz`` (rule states + recent alerts as JSON) —
-  each request takes fresh snapshots, so what a scraper sees is live.
+  accounting as JSON), ``/sloz`` (rule states + recent alerts as JSON),
+  ``/fleetz`` (the live fleet controller's rollup), and ``/historyz`` (the
+  telemetry history's retained levels; ``?at=``/``?level=`` time-travel
+  queries) — each request takes fresh snapshots, so what a scraper sees is
+  live. The full endpoint table lives in ``docs/observability.md``.
 
 Everything degrades gracefully with no active session: the renderer emits the
 ``telemetry_enabled 0`` gauge and whatever a passed-in recorder holds; the
@@ -28,6 +31,7 @@ from __future__ import annotations
 import json
 import os
 import threading
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -337,6 +341,36 @@ def _fleetz_doc() -> Tuple[int, Dict[str, Any]]:
     return 200, {"fleet": True, **fc.telemetry()}
 
 
+def _historyz_doc(query: str) -> Tuple[int, Dict[str, Any]]:
+    """The telemetry-history time machine over HTTP.
+
+    No params: every retained level with its block boundaries
+    (``history.levels()``). ``?at=T``: the finest retained block covering
+    instant ``T`` — byte-for-byte what ``history.at(T)`` answers in-process.
+    ``?level=i``: that level's blocks only. Degrades to
+    ``{"telemetry": false}`` with no active session (or history disabled)."""
+    from . import active as _active
+
+    rec = _active()
+    if rec is None or rec.history is None:
+        return 200, {"telemetry": False}
+    params = urllib.parse.parse_qs(query)
+    if "at" in params:
+        try:
+            t = float(params["at"][0])
+        except (ValueError, IndexError):
+            return 400, {"telemetry": True, "error": "?at= expects a float timestamp"}
+        return 200, {"telemetry": True, "at": t, "block": rec.history.at(t)}
+    if "level" in params:
+        try:
+            level = int(params["level"][0])
+            blocks = rec.history.range(float("-inf"), float("inf"), level=level)
+        except (ValueError, IndexError):
+            return 400, {"telemetry": True, "error": "?level= expects a valid level index"}
+        return 200, {"telemetry": True, "level": level, "blocks": blocks}
+    return 200, {"telemetry": True, "history": rec.history.levels()}
+
+
 class _HealthHandler(BaseHTTPRequestHandler):
     server_version = "tpu-metrics-health/1"
 
@@ -357,12 +391,16 @@ class _HealthHandler(BaseHTTPRequestHandler):
             elif path == "/fleetz":
                 status, doc = _fleetz_doc()
                 self._reply(status, json.dumps(doc, default=str), "application/json")
+            elif path == "/historyz":
+                query = self.path.split("?", 1)[1] if "?" in self.path else ""
+                status, doc = _historyz_doc(query)
+                self._reply(status, json.dumps(doc, default=str), "application/json")
             else:
                 self._reply(
                     404,
                     json.dumps({"error": f"unknown path {path}",
                                 "endpoints": ["/healthz", "/metricsz", "/costz",
-                                              "/sloz", "/fleetz"]}),
+                                              "/sloz", "/fleetz", "/historyz"]}),
                     "application/json",
                 )
         except Exception as err:  # noqa: BLE001 — a render bug must answer 500, not hang

@@ -52,6 +52,7 @@ NONDETERMINISTIC_COUNTERS = frozenset({
     "migration_us",
     "async_sync_wait_us",
     "alerts",
+    "burn_alerts",  # SLO evaluation (and thus burn paging) rides the real clock
 })
 
 # payload keys whose values depend on wall-clock or on-disk encoding details
@@ -151,6 +152,7 @@ class FlightRecorder(Sink):
                 "tree": build_causal_tree(contractual),
             },
             "counters": {},
+            "history": None,
             "runtime": {},
         }
         if extra is not None:
@@ -164,6 +166,9 @@ class FlightRecorder(Sink):
             artifact["counters"] = {
                 k: v for k, v in counts.items() if k not in NONDETERMINISTIC_COUNTERS
             }
+            # contractual like ``causal``/``counters``: the retained level
+            # boundaries are byte-identical across same-seed virtual-clock runs
+            artifact["history"] = rec.history_block()
             artifact["runtime"] = {
                 "counters_wall_clock": {
                     k: counts[k] for k in sorted(NONDETERMINISTIC_COUNTERS) if k in counts

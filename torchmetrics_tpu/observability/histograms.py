@@ -24,19 +24,18 @@ a mean hides the one rank holding the barrier). The design constraints:
   :data:`FLEET_HISTOGRAM_KINDS` order, small enough to piggyback on the
   coalesced sync's metadata collective (``parallel/coalesce.py``).
 
-Stdlib-only (no jax import): ``tools/trace_report.py`` and the bench driver
-mirror the percentile math without initializing a runtime.
+Stdlib-only (no jax import). The bucket table and the quantile walk live in
+``quantile.py`` (re-exported here): the ONE canonical estimator, which
+``tools/trace_report.py`` and the bench driver load by file path instead of
+mirroring the math by hand.
 """
 
 from __future__ import annotations
 
 import threading
-from typing import Any, Dict, Iterable, List, Mapping, Optional, Sequence, Tuple
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 
-# Bucket b counts values v with 2^b <= v < 2^(b+1) (bucket 0 also absorbs 0).
-# 32 buckets cover 1 us .. ~71 minutes for latencies and 1 byte .. 4 GiB for
-# per-sync payloads — beyond either end the exact magnitude stops mattering.
-N_BUCKETS = 32
+from .quantile import N_BUCKETS, bucket_bounds, bucket_index, percentile_from_buckets
 
 # The kinds whose per-kind totals ride the fleet plane, in vector order. The
 # first nine are latency histograms (microseconds); the last two are size
@@ -76,19 +75,6 @@ PERCENTILES: Tuple[Tuple[str, float], ...] = (
 )
 
 
-def bucket_index(value: int) -> int:
-    """Bucket for a non-negative integer value: ``floor(log2(value))`` clamped
-    to the table (0 and 1 land in bucket 0; the top bucket is open-ended)."""
-    if value < 2:
-        return 0
-    return min(value.bit_length() - 1, N_BUCKETS - 1)
-
-
-def bucket_bounds(index: int) -> Tuple[int, int]:
-    """``[lower, upper)`` of bucket ``index`` (lower of bucket 0 is 0)."""
-    return (0 if index == 0 else 1 << index), 1 << (index + 1)
-
-
 class Histogram:
     """One mergeable log2 histogram (fixed buckets + count + value sum).
 
@@ -121,31 +107,12 @@ class Histogram:
     # ------------------------------------------------------------------ math
 
     def percentile(self, q: float) -> Optional[float]:
-        """Estimate the ``q``-quantile (``0 < q <= 1``) by walking the bucket
-        cumulative and interpolating linearly inside the target bucket. Exact
-        to within the bucket's width; clamped to the observed ``[lo, hi]``
-        when the exact extrema are known (local histograms)."""
-        if self.count == 0:
-            return None
-        target = q * self.count
-        cum = 0
-        est: Optional[float] = None
-        for b, c in enumerate(self.counts):
-            if c == 0:
-                continue
-            if cum + c >= target:
-                lower, upper = bucket_bounds(b)
-                est = lower + (upper - lower) * (target - cum) / c
-                break
-            cum += c
-        if est is None:  # float rounding pushed target past the last count
-            top = max(b for b, c in enumerate(self.counts) if c)
-            est = float(bucket_bounds(top)[1])
-        if self.lo is not None:
-            est = max(est, float(self.lo))
-        if self.hi is not None:
-            est = min(est, float(self.hi))
-        return est
+        """Estimate the ``q``-quantile (``0 < q <= 1``) via the shared
+        log2-bucket walk (:func:`~torchmetrics_tpu.observability.quantile.
+        percentile_from_buckets`). Exact to within the bucket's width;
+        clamped to the observed ``[lo, hi]`` when the exact extrema are
+        known (local histograms)."""
+        return percentile_from_buckets(self.counts, self.count, q, lo=self.lo, hi=self.hi)
 
     def percentiles(self) -> Dict[str, Optional[float]]:
         return {name: self.percentile(q) for name, q in PERCENTILES}

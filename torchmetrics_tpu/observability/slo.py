@@ -200,6 +200,8 @@ class SloEngine:
         # the first evaluation after a breach already sees it instead of
         # comparing the current state against itself.
         self._genesis: Optional[Tuple[float, Dict[str, int], List[int]]] = None
+        # burn(expr, ...) sub-expressions, compiled once per distinct string
+        self._burn_codes: Dict[str, Any] = {}
 
     # ------------------------------------------------------------- sampling
 
@@ -285,11 +287,11 @@ class SloEngine:
         )
         return ns
 
-    def _baseline_for(self, rule: SloRule, now: float) -> Tuple[float, Dict[str, int], List[int]]:
-        """Newest sample at or older than ``now - rule.window`` (so the delta
+    def _baseline_at(self, now: float, window: float) -> Tuple[float, Dict[str, int], List[int]]:
+        """Newest sample at or older than ``now - window`` (so the delta
         covers at least the window); a session younger than the window deltas
         against the zero genesis sample (= everything since session start)."""
-        edge = now - rule.window
+        edge = now - window
         chosen = self._genesis
         for sample in self._samples:
             if sample[0] <= edge:
@@ -297,6 +299,9 @@ class SloEngine:
             else:
                 break
         return chosen
+
+    def _baseline_for(self, rule: SloRule, now: float) -> Tuple[float, Dict[str, int], List[int]]:
+        return self._baseline_at(now, rule.window)
 
     def observe_and_evaluate(self, recorder: Any, now: Optional[float] = None) -> List[Dict[str, Any]]:
         """Feed the window and evaluate in one step, building the (counters +
@@ -336,18 +341,8 @@ class SloEngine:
                 if state.error is not None:
                     continue
                 ns = self._namespace(current, self._baseline_for(rule, t))
-                # drift scores are recorder-local gauges (not window deltas):
-                # the namespace exposes the latest value a DriftMonitor
-                # recorded under each name
-                drift_fn = getattr(recorder, "drift_score", None)
-                if drift_fn is not None:
-                    ns["drift"] = drift_fn
-                # the quantized sync plane's error-feedback residual norm is a
-                # SCALAR gauge (unlike drift's per-name lookup), so expose the
-                # value itself — rules write `quant_feedback_norm > 1e-3`
-                quant_fn = getattr(recorder, "quant_feedback_norm", None)
-                if quant_fn is not None:
-                    ns["quant_feedback_norm"] = quant_fn()
+                self._inject_gauges(ns, recorder)
+                burn_state = self._inject_timetravel(ns, recorder, current, t, rule)
                 try:
                     breached = bool(eval(rule.expr, {"__builtins__": {}}, ns))  # noqa: S307 — operator config
                 except Exception as err:
@@ -366,6 +361,11 @@ class SloEngine:
                 state.last_alert_at = t
                 state.alerts += 1
                 alert = self._emit(recorder, rule, t, kind="breach", window=ns["window"])
+                if burn_state["burned"]:
+                    # a multi-window burn page rides the SAME cooldown as the
+                    # alert above — exactly once per cooldown, never flapping
+                    alert["burn"] = {"short": burn_state["short"], "long": burn_state["long"]}
+                    self._emit_burn(recorder, rule, t, burn_state)
                 if rule.on_breach is not None:
                     callbacks.append((rule, alert))
                 fired.append(alert)
@@ -378,6 +378,87 @@ class SloEngine:
             except Exception as err:  # noqa: BLE001 — remediation must not kill the sync path
                 alert["callback_error"] = f"{type(err).__name__}: {err}"[:240]
         return fired
+
+    @staticmethod
+    def _inject_gauges(ns: Dict[str, Any], recorder: Any) -> None:
+        # drift scores are recorder-local gauges (not window deltas): the
+        # namespace exposes the latest value a DriftMonitor recorded under
+        # each name
+        drift_fn = getattr(recorder, "drift_score", None)
+        if drift_fn is not None:
+            ns["drift"] = drift_fn
+        # the quantized sync plane's error-feedback residual norm is a
+        # SCALAR gauge (unlike drift's per-name lookup), so expose the
+        # value itself — rules write `quant_feedback_norm > 1e-3`
+        quant_fn = getattr(recorder, "quant_feedback_norm", None)
+        if quant_fn is not None:
+            ns["quant_feedback_norm"] = quant_fn()
+
+    def _inject_timetravel(
+        self,
+        ns: Dict[str, Any],
+        recorder: Any,
+        current: Tuple[float, Dict[str, int], List[int]],
+        t: float,
+        rule: SloRule,
+    ) -> Dict[str, Any]:
+        """``rate()``/``delta()``/``burn()`` — the telemetry-history plane's
+        SLO face: windowed counter lookups at ARBITRARY windows over the
+        sample ring (a plain name is always the delta over the rule's own
+        window; these reach past it). Returns the burn bookkeeping cell the
+        breach path reads to decide whether this alert is also a burn page."""
+        _, counts1, _ = current
+        burn_state: Dict[str, Any] = {"burned": False, "short": None, "long": None}
+
+        def _delta(name: str, window: float) -> int:
+            if name not in counts1:
+                raise NameError(f"unknown counter {name!r}; known: {COUNTER_FIELDS}")
+            _, counts0, _ = self._baseline_at(t, window)
+            return counts1[name] - counts0.get(name, 0)
+
+        def _rate(name: str, window: Optional[float] = None) -> float:
+            """Per-second rate of a counter over ``window`` (default: the
+            rule's own window), with the same 1s elapsed floor as ``window``."""
+            if name not in counts1:
+                raise NameError(f"unknown counter {name!r}; known: {COUNTER_FIELDS}")
+            t0, counts0, _ = self._baseline_at(t, rule.window if window is None else window)
+            return (counts1[name] - counts0.get(name, 0)) / max(t - t0, 1.0)
+
+        def _burn(expr: str, short: float, long: float) -> bool:
+            """Google-SRE multi-window burn rate: ``expr`` must hold over BOTH
+            the short and the long window — a short spike alone never pages
+            (the long window is clean), a slow burn alone never pages at the
+            tail (the short window has recovered); both burning is the page."""
+            code = self._burn_codes.get(expr)
+            if code is None:
+                code = self._burn_codes[expr] = compile(expr, f"<burn:{expr}>", "eval")
+            burned = True
+            for w in (short, long):
+                wns = self._namespace(current, self._baseline_at(t, w))
+                self._inject_gauges(wns, recorder)
+                wns["rate"], wns["delta"] = _rate, _delta
+                burned = bool(eval(code, {"__builtins__": {}}, wns)) and burned  # noqa: S307 — operator config
+            if burned:
+                burn_state.update(burned=True, short=short, long=long)
+            return burned
+
+        ns["rate"], ns["delta"], ns["burn"] = _rate, _delta, _burn
+        return burn_state
+
+    def _emit_burn(self, recorder: Any, rule: SloRule, t: float, burn_state: Dict[str, Any]) -> None:
+        """The burn page itself, alongside the regular alert: its own event
+        kind + counter so pager routing can treat a multi-window burn as the
+        high-confidence page it is."""
+        recorder.counters.record_burn_alert()
+        recorder._event(
+            "burn_alert", rule.name, rule.severity,
+            payload={
+                "kind": "burn",
+                "short_window": burn_state["short"],
+                "long_window": burn_state["long"],
+                "at": t,
+            },
+        )
 
     def _emit(self, recorder: Any, rule: SloRule, t: float, kind: str, **extra: Any) -> Dict[str, Any]:
         alert: Dict[str, Any] = {

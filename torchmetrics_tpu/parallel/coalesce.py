@@ -105,7 +105,10 @@ _MAGIC = 0x436F414C  # "CoAL"
 # shorter vector fails row validation rather than misaligning the new tail
 # v10: causal trace plane — the counter vector gained flightrec_dumps (the
 # flight recorder's postmortem artifact count rides the fleet rollup)
-_VERSION = 10
+# v11: telemetry history plane — the counter vector gained history_folds
+# (telescoped retention blocks closed) and burn_alerts (multi-window
+# burn-rate pages); same mixed-version lockstep-fallback rule as every bump
+_VERSION = 11
 _HEADER_LEN = 6  # [magic, version, n_leaves, n_counter_fields, alive, epoch]
 _LEAF_REC_LEN = 2 + _MAX_RANK + 1  # [dtype_code, ndim, d0..d7, kind|codec<<1]
 _KIND_TENSOR = 0

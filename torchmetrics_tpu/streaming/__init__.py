@@ -15,6 +15,14 @@ windowed ones. This package holds the O(1)-per-update stream transforms —
   wired into the SLO/alert engine (``drift(name)`` namespace entries,
   breaches ride the ``alert`` event kind)
 
+- :class:`TelescopingFold` — the telescoping multi-resolution retention
+  fold (``telescope.py``, stdlib-only): bounded per-level rings of closed
+  blocks, each level folding into the coarser one above — O(levels) memory
+  for "the last 10s at 1s, the last hour at 1m, the last day at 1h". The
+  telemetry history plane (``observability/timeseries.py``, ``/historyz``)
+  rides it today; per-tenant telescoped metric states are the ROADMAP
+  follow-on
+
 — plus their sync-side counterpart,
 :class:`~torchmetrics_tpu.parallel.AsyncSyncHandle` (``parallel/``), the
 double-buffered background sync ``MetricCollection.sync(async_=True)`` and
@@ -24,8 +32,9 @@ overlaps the current window's updates.
 See ``docs/streaming.md``.
 """
 
+from .telescope import TelescopingFold  # stdlib-only: import before the jax-backed tiers
 from ..metric import window_tier
 from .drift import DriftMonitor
 from .window import ExponentialDecay, SlidingWindow
 
-__all__ = ["DriftMonitor", "ExponentialDecay", "SlidingWindow", "window_tier"]
+__all__ = ["DriftMonitor", "ExponentialDecay", "SlidingWindow", "TelescopingFold", "window_tier"]
