@@ -287,9 +287,16 @@ def bench_fused_collection() -> dict:
 
 
 def bench_map() -> dict:
-    """Config #3: mAP on synthetic COCO-shaped detections (100 imgs/update)."""
+    """Config #3: mAP on synthetic COCO-shaped detections (100 imgs/update).
+
+    Two evaluators share the same batches: the host evaluator (the parity
+    oracle) and the device-resident ``backend="device"`` evaluator, whose
+    compute is one jitted program. ``map_parity`` pins the two against each
+    other every round and ``map_fresh_compiles`` proves repeat computes reuse
+    one compiled program (signature-stable padded state)."""
     import jax
 
+    from torchmetrics_tpu import observability as obs
     from torchmetrics_tpu.detection import MeanAveragePrecision
 
     rng = np.random.default_rng(2)
@@ -328,13 +335,43 @@ def bench_map() -> dict:
 
     # the advertised COCO-val-2017 scale: 5k images / 80 classes in one compute
     # (correctness at this scale is oracle-pinned in tests/test_map_scale.py)
+    big_batches = [make_batch() for _ in range(50)]
     big = MeanAveragePrecision()
-    for _ in range(50):
-        big.update(*make_batch())
+    for preds, target in big_batches:
+        big.update(preds, target)
     start = time.perf_counter()
     out = big.compute()
     jax.block_until_ready(out["map"])
     compute_5k = time.perf_counter() - start
+
+    # device evaluator, same batches: cold includes the one-off jit of the
+    # evaluator program; the gated column is the steady-state (warm) compute
+    dev = MeanAveragePrecision(backend="device", capacity=98304)
+    start = time.perf_counter()
+    for preds, target in big_batches:
+        dev.update(preds, target)
+    jax.block_until_ready(dev._state["det_rows"])
+    dev_update = time.perf_counter() - start
+    start = time.perf_counter()
+    out_dev = dev.compute()
+    jax.block_until_ready(out_dev["map"])
+    dev_cold = time.perf_counter() - start
+    # repeat computes under telemetry: the session's first dispatch absorbs the
+    # cost-harvest re-lowering, the second is the honest steady-state column;
+    # one first-seen signature across the repeats == map_fresh_compiles of 1
+    with obs.telemetry_session() as rec:
+        dev._computed = None  # drop the memo so each compute re-dispatches
+        jax.block_until_ready(dev.compute()["map"])
+        dev._computed = None
+        start = time.perf_counter()
+        out_dev = dev.compute()
+        jax.block_until_ready(out_dev["map"])
+        dev_warm = time.perf_counter() - start
+    fresh_compiles = rec.counters.snapshot().summary(brief=True)["jit_compiles"]
+    scalar_keys = [k for k in out if np.asarray(out[k]).ndim == 0]
+    parity = all(
+        abs(float(out[k]) - float(out_dev[k])) <= 1e-4 for k in scalar_keys
+    )
 
     def probe():
         m = MeanAveragePrecision()
@@ -348,6 +385,11 @@ def bench_map() -> dict:
         "images_per_sec_update": round(n_imgs / update_elapsed, 2),
         "compute_sec_500imgs_80cls": round(compute_elapsed, 3),
         "compute_sec_5000imgs_80cls": round(compute_5k, 3),
+        "device_images_per_sec_update": round(50 * 100 / dev_update, 2),
+        "device_compute_cold_sec_5000imgs_80cls": round(dev_cold, 3),
+        "device_compute_sec_5000imgs_80cls": round(dev_warm, 3),
+        "map_parity": 1.0 if parity else 0.0,
+        "map_fresh_compiles": fresh_compiles,
         "telemetry": _telemetry_probe(probe),
     }
 
@@ -440,24 +482,32 @@ def bench_bertscore_clipscore() -> dict:
 
     imgs = [jnp.asarray(rng.random((3, 8, 8)).astype(np.float32)) for _ in range(256)]
 
+    # one metric across the reps: the scoring half is a jitted dispatch program
+    # now, and steady state means reusing the compiled (bucketed) signature
+    metric = CLIPScore(model_name_or_path=ToyClip())
+
     def clip_once():
-        metric = CLIPScore(model_name_or_path=ToyClip())
+        metric.reset()
         metric.update(imgs, sentences)
-        return metric.compute()
+        return float(metric.compute())
 
     start = time.perf_counter()
     clip_once()
-    clip_compile = time.perf_counter() - start
+    clip_cold = time.perf_counter() - start
     start = time.perf_counter()
     for _ in range(reps):
         clip_once()
     clip_elapsed = (time.perf_counter() - start) / reps
+    # raw columns, not a `max(cold - steady, 0.0)` clamp: the clamp could
+    # report 0.0 for a compile regression smaller than one steady-state call
     return {
         "bertscore_pairs_per_sec_toy_embedder": round(256 / bert_elapsed, 2),
-        "bertscore_compile_sec": round(max(bert_compile - bert_elapsed, 0.0), 3),
+        "bertscore_cold_call_sec": round(bert_compile, 3),
+        "bertscore_steady_state_sec": round(bert_elapsed, 3),
         "clipscore_pairs_per_sec_toy_embedder": round(256 / clip_elapsed, 2),
-        "clipscore_compile_sec": round(max(clip_compile - clip_elapsed, 0.0), 3),
-        "note": "steady-state machinery rate (cold-call jit overhead reported separately); pretrained HF weights not downloadable offline",
+        "clipscore_cold_call_sec": round(clip_cold, 3),
+        "clipscore_steady_state_sec": round(clip_elapsed, 3),
+        "note": "raw cold first call (trace+compile included) vs steady-state repeat; pretrained HF weights not downloadable offline",
     }
 
 
@@ -630,8 +680,6 @@ def _ttfu_spec(name: str):
     """Build the config's metric (or collection) plus its representative
     first batch, WITHOUT updating — the caller times the first update.
     The jit-dispatched configs come from the shared warm-cache builders."""
-    import jax.numpy as jnp
-
     rng = np.random.default_rng(0)
     if name == "ours":
         return _warm_cache_builders()["flagship"](batch=BATCH, num_classes=NUM_CLASSES)
@@ -639,25 +687,27 @@ def _ttfu_spec(name: str):
         return _warm_cache_builders()["classification16"]()
     if name == "bertscore_clipscore":
         # the config's metric-level surface is CLIPScore with the same toy
-        # embedder the throughput config uses; it dispatches host-side, so
-        # this column measures (and documents) that the AOT plane cannot help
-        # eager metrics — warm ≈ cold is the honest expectation here
+        # embedder the throughput config uses. Its scoring half is a jitted
+        # "update" dispatch program now, so the warm column is a real AOT
+        # load (the former "~1x honesty" row). The embedder stays pure numpy
+        # here so the column isolates dispatch warm-up, not eager-op compiles
+        # inside a toy model.
         from torchmetrics_tpu.multimodal import CLIPScore
 
         emb = rng.normal(size=(512, 64)).astype(np.float32)
 
         class ToyClip:
             def get_image_features(self, images):
-                return jnp.stack([jnp.asarray(i, jnp.float32).reshape(-1)[:64] for i in images])
+                return np.stack([np.asarray(i, np.float32).reshape(-1)[:64] for i in images])
 
             def get_text_features(self, texts):
-                return jnp.stack([
-                    jnp.asarray(emb[[hash(w) % 512 for w in t.split()], :64].sum(0)) for t in texts
+                return np.stack([
+                    emb[[hash(w) % 512 for w in t.split()], :64].sum(0) for t in texts
                 ])
 
         vocab = ["alpha", "beta", "gamma", "delta", "epsilon", "zeta", "eta", "theta"]
         sentences = [" ".join(rng.choice(vocab, 12)) for _ in range(64)]
-        imgs = [jnp.asarray(rng.random((3, 8, 8)).astype(np.float32)) for _ in range(64)]
+        imgs = [rng.random((3, 8, 8)).astype(np.float32) for _ in range(64)]
         return CLIPScore(model_name_or_path=ToyClip()), (imgs, sentences)
     raise KeyError(name)
 

@@ -260,6 +260,76 @@ def test_ttfu_columns_direction_and_gate(tmp_path):
     assert report["verdict"] == "ok" and report["missing"] == 0
 
 
+def test_device_map_and_embedder_columns_direction_and_gate(tmp_path):
+    """Re-homed evaluator columns (device mAP + embedder pipelines): the device
+    compute latencies gate lower, map_parity gates higher-exact (1.0-or-broken
+    vs the host oracle), map_fresh_compiles stays informational, the raw
+    cold/steady embedder columns gate lower, and the retired clamped
+    *_compile_sec columns report expected-known missing — never gated."""
+    assert bench_compare.direction("extra.coco_map_synthetic.device_compute_sec_5000imgs_80cls") == "lower"
+    assert bench_compare.direction("extra.coco_map_synthetic.device_compute_cold_sec_5000imgs_80cls") == "lower"
+    assert bench_compare.direction("extra.coco_map_synthetic.device_images_per_sec_update") == "higher"
+    assert bench_compare.direction("extra.coco_map_synthetic.map_parity") == "higher"
+    assert bench_compare.direction("extra.coco_map_synthetic.map_fresh_compiles") is None
+    assert bench_compare.direction("extra.bertscore_clipscore.bertscore_cold_call_sec") == "lower"
+    assert bench_compare.direction("extra.bertscore_clipscore.bertscore_steady_state_sec") == "lower"
+    assert bench_compare.direction("extra.bertscore_clipscore.clipscore_cold_call_sec") == "lower"
+    assert bench_compare.direction("extra.bertscore_clipscore.clipscore_steady_state_sec") == "lower"
+
+    def cfg(dev_warm, parity, compiles, clip_cold):
+        return {
+            "coco_map_synthetic": {
+                "images_per_sec_update": 106000.0, "compute_sec_5000imgs_80cls": 2.2,
+                "device_images_per_sec_update": 10000.0,
+                "device_compute_cold_sec_5000imgs_80cls": 4.4,
+                "device_compute_sec_5000imgs_80cls": dev_warm,
+                "map_parity": parity, "map_fresh_compiles": compiles,
+            },
+            "bertscore_clipscore": {
+                "bertscore_pairs_per_sec_toy_embedder": 38000.0,
+                "bertscore_cold_call_sec": 0.25, "bertscore_steady_state_sec": 0.007,
+                "clipscore_pairs_per_sec_toy_embedder": 3500.0,
+                "clipscore_cold_call_sec": clip_cold, "clipscore_steady_state_sec": 0.07,
+            },
+        }
+
+    good = _round(1, 30000.0, extra_overrides=cfg(0.5, 1.0, 1, 0.3))
+    # injected regressions: warm device compute sliding back to host speed, a
+    # parity break against the oracle, a compile-count blowup (info only), and
+    # a cold-call compile regression the old clamp could have hidden as 0.0
+    broken = _round(2, 30000.0, extra_overrides=cfg(2.9, 0.0, 4, 3.5))
+    paths = _write_rounds(tmp_path, [good, broken])
+    report = bench_compare.compare_rounds(paths)
+    rows = {r["metric"]: r for r in report["transitions"][0]["rows"]}
+    reg = {m for m, r in rows.items() if r["verdict"] == "regression"}
+    assert "extra.coco_map_synthetic.device_compute_sec_5000imgs_80cls" in reg
+    assert "extra.coco_map_synthetic.map_parity" in reg
+    assert "extra.bertscore_clipscore.clipscore_cold_call_sec" in reg
+    assert rows["extra.coco_map_synthetic.map_fresh_compiles"]["verdict"] == "info"
+    # ordinary shared-pod wobble stays inside the thresholds
+    wobble_dir = tmp_path / "wobble"
+    wobble_dir.mkdir()
+    wobble = _round(2, 30000.0, extra_overrides=cfg(0.58, 1.0, 1, 0.41))
+    paths = _write_rounds(wobble_dir, [good, wobble])
+    assert bench_compare.compare_rounds(paths)["verdict"] == "ok"
+    # the retired clamped columns: an old round that still reports them vs a
+    # new round on the raw pair — expected-known missing, never gated
+    retired_dir = tmp_path / "retired"
+    retired_dir.mkdir()
+    old_cfg = cfg(0.5, 1.0, 1, 0.3)
+    old_cfg["bertscore_clipscore"]["bertscore_compile_sec"] = 6.69
+    old_cfg["bertscore_clipscore"]["clipscore_compile_sec"] = 11.35
+    old = _round(1, 30000.0, extra_overrides=old_cfg)
+    paths = _write_rounds(retired_dir, [old, _round(2, 30000.0, extra_overrides=cfg(0.5, 1.0, 1, 0.3))])
+    report = bench_compare.compare_rounds(paths)
+    assert report["verdict"] == "ok" and report["missing"] == 0
+    assert set(report["transitions"][0]["known_missing"]) == {
+        "extra.bertscore_clipscore.bertscore_compile_sec",
+        "extra.bertscore_clipscore.clipscore_compile_sec",
+    }
+    assert bench_compare.main(paths + ["--check", "--strict-missing"]) == 0
+
+
 def test_production_soak_columns_direction_and_gate(tmp_path):
     """production_soak columns (chaos plane): shed_rate gates lower-exact,
     the recovery/reconciliation/determinism parities and recovered_faults

@@ -297,5 +297,5 @@ def test_soak_introduces_no_new_dispatch_tag():
 
     assert registered_tags(build_index(REPO_ROOT)) == {
         "update", "forward", "vupdate", "wupdate", "wdual", "wstack",
-        "vwupdate", "vwcompute", "dupdate", "vcompute",
+        "vwupdate", "vwcompute", "dupdate", "vcompute", "mapeval", "escore",
     }

@@ -1,0 +1,268 @@
+"""Device mAP evaluator (``MeanAveragePrecision(backend="device")``) vs the host
+oracle: parity fuzz across the COCO knobs (iscrowd, user areas, custom maxDets,
+degenerate boxes, empty images), the fixed-capacity sentinels, merge/reset
+semantics, and the mapeval AOT warm-start path.
+
+Parity tolerance is 1e-4: the device program evaluates in f32 (IoU thresholds
+are quantized identically on both sides), the host oracle accumulates in f64.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import torchmetrics_tpu
+from torchmetrics_tpu import aot
+from torchmetrics_tpu.detection import DeviceMeanAveragePrecision, MeanAveragePrecision
+from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
+
+pytestmark = pytest.mark.detection
+
+ATOL = 1e-4
+
+
+def _rand_dataset(
+    rng,
+    n_imgs: int = 9,
+    n_cls: int = 6,
+    max_det: int = 12,
+    max_gt: int = 8,
+    crowd_rate: float = 0.0,
+    area_rate: float = 0.0,
+    degenerate_rate: float = 0.0,
+    empty_rate: float = 0.15,
+    canvas: float = 120.0,
+):
+    """One batch of COCO-shaped preds/targets exercising the requested knobs."""
+    preds, target = [], []
+    for _ in range(n_imgs):
+        nd = 0 if rng.random() < empty_rate else int(rng.integers(1, max_det + 1))
+        ng = 0 if rng.random() < empty_rate else int(rng.integers(1, max_gt + 1))
+        xy = rng.uniform(0, canvas, (nd, 2))
+        wh = rng.uniform(2, 60, (nd, 2))
+        boxes = np.concatenate([xy, xy + wh], -1).astype(np.float32)
+        if degenerate_rate and nd:
+            flip = rng.random(nd) < degenerate_rate  # zero/negative extent boxes
+            boxes[flip] = boxes[flip][:, [2, 3, 0, 1]]
+        preds.append({
+            "boxes": boxes,
+            "scores": rng.uniform(0, 1, nd).astype(np.float32),
+            "labels": rng.integers(0, n_cls, nd).astype(np.int32),
+        })
+        xy = rng.uniform(0, canvas, (ng, 2))
+        wh = rng.uniform(2, 60, (ng, 2))
+        tgt = {
+            "boxes": np.concatenate([xy, xy + wh], -1).astype(np.float32),
+            "labels": rng.integers(0, n_cls, ng).astype(np.int32),
+        }
+        if crowd_rate:
+            tgt["iscrowd"] = (rng.random(ng) < crowd_rate).astype(np.int32)
+        if area_rate:
+            area = (wh[:, 0] * wh[:, 1]).astype(np.float32)
+            use = rng.random(ng) < area_rate
+            tgt["area"] = np.where(use, area * rng.uniform(0.2, 30.0, ng).astype(np.float32), 0.0)
+        target.append(tgt)
+    return preds, target
+
+
+def _assert_parity(host_out, dev_out, class_metrics=False, last_mdet=100):
+    for key, val in host_out.items():
+        arr = np.asarray(val)
+        if arr.ndim == 0 and arr.dtype.kind == "f":
+            assert abs(float(val) - float(dev_out[key])) <= ATOL, (
+                f"{key}: host={float(val)} device={float(dev_out[key])}"
+            )
+    if class_metrics:
+        np.testing.assert_array_equal(np.asarray(host_out["classes"]), np.asarray(dev_out["classes"]))
+        for key in ("map_per_class", f"mar_{last_mdet}_per_class"):
+            np.testing.assert_allclose(
+                np.asarray(dev_out[key]), np.asarray(host_out[key]), atol=ATOL, err_msg=key
+            )
+
+
+def _pair(seed_or_batches, host_kwargs=None, dev_kwargs=None, n_updates=2, **dataset_kw):
+    """Feed identical batches to host + device evaluators, return both computes."""
+    host = MeanAveragePrecision(**(host_kwargs or {}))
+    dev = MeanAveragePrecision(backend="device", num_classes=dataset_kw.get("n_cls", 6),
+                               capacity=2048, **(dev_kwargs or {}))
+    if isinstance(seed_or_batches, list):
+        batches = seed_or_batches
+    else:
+        rng = np.random.default_rng(seed_or_batches)
+        batches = [_rand_dataset(rng, **dataset_kw) for _ in range(n_updates)]
+    for preds, target in batches:
+        host.update(preds, target)
+        dev.update(preds, target)
+    return host.compute(), dev.compute(), dev
+
+
+# ------------------------------------------------------------------ parity fuzz
+
+
+_EXTRA = pytest.mark.slow  # extended fuzz seeds ride the scale tier, out of tier-1
+
+
+@pytest.mark.parametrize("seed", (0, 1, 2, *(pytest.param(s, marks=_EXTRA) for s in (3, 4, 5))))
+def test_device_parity_fuzz(seed):
+    host_out, dev_out, _ = _pair(seed)
+    _assert_parity(host_out, dev_out)
+
+
+@pytest.mark.parametrize("seed", (0, 1, pytest.param(2, marks=_EXTRA)))
+def test_device_parity_iscrowd_and_user_areas(seed):
+    """Crowd gts (det-denominator IoU, ignored matches don't count) and
+    user-provided areas overriding the box area for range assignment."""
+    host_out, dev_out, _ = _pair(seed, crowd_rate=0.3, area_rate=0.5)
+    _assert_parity(host_out, dev_out)
+
+
+@pytest.mark.parametrize("seed", (3, pytest.param(4, marks=_EXTRA)))
+def test_device_parity_degenerate_boxes(seed):
+    """Zero/negative-extent boxes score zero IoU but still consume maxDet
+    slots and count as FPs, exactly like the host path."""
+    host_out, dev_out, _ = _pair(seed, degenerate_rate=0.4)
+    _assert_parity(host_out, dev_out)
+
+
+def test_device_parity_custom_maxdets():
+    kw = {"max_detection_thresholds": [2, 5, 20]}
+    host_out, dev_out, _ = _pair(7, host_kwargs=kw, dev_kwargs=kw, max_det=25)
+    _assert_parity(host_out, dev_out)
+    assert "mar_2" in dev_out and "mar_20" in dev_out
+
+
+@pytest.mark.parametrize("seed", (6, pytest.param(5, marks=_EXTRA)))
+def test_device_parity_class_metrics(seed):
+    kw = {"class_metrics": True}
+    host_out, dev_out, _ = _pair(seed, host_kwargs=kw, dev_kwargs=kw)
+    _assert_parity(host_out, dev_out, class_metrics=True)
+
+
+def test_device_parity_empty_preds_and_targets():
+    """All-empty images on either side: npig==0 classes report -1 like the
+    host evaluator; fully empty state returns the -1 sentinel dict."""
+    rng = np.random.default_rng(11)
+    preds, target = _rand_dataset(rng, n_imgs=8)
+    no_dets = [{"boxes": np.zeros((0, 4), np.float32), "scores": np.zeros(0, np.float32),
+                "labels": np.zeros(0, np.int32)} for _ in preds]
+    no_gts = [{"boxes": np.zeros((0, 4), np.float32), "labels": np.zeros(0, np.int32)}
+              for _ in target]
+    host_out, dev_out, _ = _pair([(no_dets, target)])
+    _assert_parity(host_out, dev_out)
+    host_out, dev_out, _ = _pair([(preds, no_gts)])
+    _assert_parity(host_out, dev_out)
+
+
+def test_device_empty_compute_sentinel():
+    dev = MeanAveragePrecision(backend="device")
+    out = dev.compute()
+    assert float(out["map"]) == -1.0 and float(out["mar_100"]) == -1.0
+    assert np.asarray(out["classes"]).size == 0
+
+
+def test_device_reset_then_reuse():
+    host_out, dev_out, dev = _pair(13)
+    dev.reset()
+    assert dev._rows_used == {"det": 0, "gt": 0, "img": 0}
+    rng = np.random.default_rng(14)
+    preds, target = _rand_dataset(rng)
+    host = MeanAveragePrecision()
+    host.update(preds, target)
+    dev.update(preds, target)
+    _assert_parity(host.compute(), dev.compute())
+
+
+# ----------------------------------------------------------- capacity sentinels
+
+
+def test_device_capacity_overflow_raises():
+    """Overflow raises BEFORE dispatch (the in-graph append would silently
+    drop rows), and the state stays usable at its pre-overflow contents."""
+    rng = np.random.default_rng(21)
+    dev = DeviceMeanAveragePrecision(capacity=64, num_classes=6)
+    preds, target = _rand_dataset(rng, n_imgs=4, empty_rate=0.0)
+    dev.update(preds, target)
+    big_preds, big_target = _rand_dataset(rng, n_imgs=40, empty_rate=0.0)
+    with pytest.raises(TorchMetricsUserError, match="overflow"):
+        dev.update(big_preds, big_target)
+    out = dev.compute()  # pre-overflow rows still compute
+    assert float(out["map"]) >= -1.0
+
+
+def test_device_capacity_boundary_exact_fit():
+    """A batch landing exactly on the capacity boundary is accepted; one more
+    row overflows."""
+    one_det = [{"boxes": np.asarray([[0.0, 0.0, 10.0, 10.0]], np.float32),
+                "scores": np.asarray([0.9], np.float32), "labels": np.asarray([0], np.int32)}]
+    one_gt = [{"boxes": np.asarray([[0.0, 0.0, 10.0, 10.0]], np.float32),
+               "labels": np.asarray([0], np.int32)}]
+    dev = DeviceMeanAveragePrecision(capacity=2, num_classes=2)
+    dev.update(one_det, one_gt)
+    dev.update(one_det, one_gt)  # det rows now exactly at capacity
+    with pytest.raises(TorchMetricsUserError, match="overflow"):
+        dev.update(one_det, one_gt)
+
+
+def test_device_label_and_group_cap_validation():
+    dev = DeviceMeanAveragePrecision(capacity=256, num_classes=3, gt_group_cap=2)
+    bad_label = [{"boxes": np.asarray([[0.0, 0.0, 5.0, 5.0]], np.float32),
+                  "scores": np.asarray([0.5], np.float32), "labels": np.asarray([3], np.int32)}]
+    empty_gt = [{"boxes": np.zeros((0, 4), np.float32), "labels": np.zeros(0, np.int32)}]
+    with pytest.raises(ValueError, match="num_classes"):
+        dev.update(bad_label, empty_gt)
+    empty_det = [{"boxes": np.zeros((0, 4), np.float32), "scores": np.zeros(0, np.float32),
+                  "labels": np.zeros(0, np.int32)}]
+    crowded = [{"boxes": np.tile(np.asarray([[0.0, 0.0, 5.0, 5.0]], np.float32), (3, 1)),
+                "labels": np.zeros(3, np.int32)}]
+    with pytest.raises(ValueError, match="gt_group_cap"):
+        dev.update(empty_det, crowded)
+
+
+def test_device_config_validation():
+    with pytest.raises(ValueError, match="iou_type"):
+        DeviceMeanAveragePrecision(iou_type="segm")
+    with pytest.raises(ValueError, match="extended summary"):
+        DeviceMeanAveragePrecision(extended_summary=True)
+    with pytest.raises(ValueError, match="average"):
+        DeviceMeanAveragePrecision(average="micro")
+    with pytest.raises(ValueError, match="capacity"):
+        DeviceMeanAveragePrecision(capacity=0)
+
+
+def test_backend_keyword_routes_construction():
+    dev = MeanAveragePrecision(backend="device", capacity=128)
+    assert isinstance(dev, DeviceMeanAveragePrecision) and dev.capacity == 128
+    host = MeanAveragePrecision()
+    assert not isinstance(host, DeviceMeanAveragePrecision)
+
+
+# ------------------------------------------------------------- AOT warm start
+
+
+@pytest.mark.aot
+def test_mapeval_precompile_and_warm_boot(tmp_path):
+    """precompile writes the mapeval program; a fresh metric on a fresh plane
+    over the same cache dir serves its first compute from a disk load."""
+    cache = str(tmp_path / "aot")
+    rng = np.random.default_rng(31)
+    preds, target = _rand_dataset(rng)
+    geometry = {"capacity": 512, "num_classes": 6}
+
+    dev = DeviceMeanAveragePrecision(**geometry)
+    report = dev.precompile(cache_dir=cache)
+    assert report["mapeval"]["status"] == "written"
+
+    aot.enable(cache)
+    try:
+        warm = DeviceMeanAveragePrecision(**geometry)
+        warm.update(preds, target)
+        out = warm.compute()
+        slots = warm.__dict__.get("_aot_memo", {})
+        sources = {k[0]: v.source for k, v in slots.items()}
+        assert sources.get("mapeval") == "disk"
+    finally:
+        aot.disable()
+    host = MeanAveragePrecision()
+    host.update(preds, target)
+    _assert_parity(host.compute(), out)

@@ -139,13 +139,14 @@ def test_registry_clean_on_real_tree():
 
 
 def test_registered_tags_match_runtime_set():
-    """The statically parsed tag registry is exactly the ten runtime planes
-    (ISSUE 12 added the tiered-window tags wdual/wstack/vwupdate/vwcompute)."""
+    """The statically parsed tag registry is exactly the twelve runtime planes
+    (ISSUE 12 added the tiered-window tags wdual/wstack/vwupdate/vwcompute;
+    ISSUE 20 the re-homed evaluator tags mapeval/escore)."""
     from tools.graftlint.registry import registered_tags, reserved_keys
     idx = build_index(REPO_ROOT)
     assert registered_tags(idx) == {
         "update", "forward", "vupdate", "wupdate", "wdual", "wstack",
-        "vwupdate", "vwcompute", "dupdate", "vcompute",
+        "vwupdate", "vwcompute", "dupdate", "vcompute", "mapeval", "escore",
     }
     assert reserved_keys(idx) == {
         "__tenant_n", "__window_cursor", "__window_n", "__decay_n",
@@ -346,6 +347,61 @@ def test_matrix_runtime_cross_validation_host_metric():
         SlidingWindow(ROUGEScore(), window=4)
     assert rows["torchmetrics_tpu.aggregation.SumMetric"]["planes"]["wupdate"] == "yes"
     SlidingWindow(SumMetric(), window=4)
+
+
+def test_matrix_runtime_cross_validation_rehomed_metrics():
+    """ISSUE 20 flipped rows: DeviceMeanAveragePrecision enters the matrix and
+    CLIPScore leaves the not-admissible-everywhere tables. Every flipped
+    verdict is cross-validated against the real runtime guard."""
+    pytest.importorskip("jax")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from torchmetrics_tpu.detection import DeviceMeanAveragePrecision
+    from torchmetrics_tpu.multimodal import CLIPScore
+    from torchmetrics_tpu.serving import ServingConfig, ServingEngine
+    from torchmetrics_tpu.streaming import ExponentialDecay, SlidingWindow
+    from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
+
+    emb = np.random.default_rng(0).standard_normal((64, 8)).astype(np.float32)
+
+    class ToyClip:
+        def get_image_features(self, images):
+            flat = jnp.stack([jnp.asarray(i, jnp.float32).reshape(-1)[:12] for i in images])
+            return flat @ jnp.asarray(emb[:12])
+
+        def get_text_features(self, texts):
+            return jnp.stack([jnp.asarray(emb[[hash(w) % 64 for w in t.split()]]).sum(axis=0)
+                              for t in texts])
+
+    _, matrix = run_checks(REPO_ROOT, families=("registry",))
+    rows = matrix["metrics"]
+
+    dev_row = rows["torchmetrics_tpu.detection.mean_ap.DeviceMeanAveragePrecision"]
+    assert dev_row["planes"] == {
+        "vupdate": "yes", "vcompute": "no", "vwupdate": "no", "wupdate": "yes",
+        "dupdate": "no", "tenant_sharding": "yes", "ingraph": "yes",
+    }
+    assert dev_row["window_tier"] == "ring"
+    dev = lambda: DeviceMeanAveragePrecision(capacity=64, num_classes=3)  # noqa: E731
+    dev()._get_vupdate_fn()  # vupdate yes: stacked program materializes
+    assert dev()._jittable_compute is False  # vcompute no: host-side _compute
+    SlidingWindow(dev(), window=4)  # wupdate yes
+    with pytest.raises(TorchMetricsUserError):  # dupdate no: custom _merge
+        ExponentialDecay(dev(), decay=0.5)
+    ServingEngine(dev(), ServingConfig(capacity=4, megabatch_size=2))  # sharding yes
+    with pytest.raises(TorchMetricsUserError):  # vwupdate no: ring window tier
+        ServingEngine(dev(), ServingConfig(capacity=4, megabatch_size=2, window=4))
+
+    clip_row = rows["torchmetrics_tpu.multimodal.clip_score.CLIPScore"]
+    assert all(v == "yes" for v in clip_row["planes"].values()), clip_row["planes"]
+    assert clip_row["window_tier"] == "dual"
+    clip = lambda: CLIPScore(model_name_or_path=ToyClip())  # noqa: E731
+    clip()._get_vupdate_fn()
+    assert clip()._jittable_compute is True
+    SlidingWindow(clip(), window=4)
+    ExponentialDecay(clip(), decay=0.5)
+    ServingEngine(clip(), ServingConfig(capacity=4, megabatch_size=2, window=4))
 
 
 # ----------------------------------------------------------------- baseline

@@ -4,7 +4,7 @@ from .ciou import CompleteIntersectionOverUnion
 from .diou import DistanceIntersectionOverUnion
 from .giou import GeneralizedIntersectionOverUnion
 from .iou import IntersectionOverUnion
-from .mean_ap import MeanAveragePrecision
+from .mean_ap import DeviceMeanAveragePrecision, MeanAveragePrecision
 from .panoptic_qualities import ModifiedPanopticQuality, PanopticQuality
 from .sharded import PaddedDetectionAccumulator, pack_detection_batch
 
@@ -15,6 +15,7 @@ __all__ = [
     "DistanceIntersectionOverUnion",
     "GeneralizedIntersectionOverUnion",
     "IntersectionOverUnion",
+    "DeviceMeanAveragePrecision",
     "MeanAveragePrecision",
     "ModifiedPanopticQuality",
     "PanopticQuality",

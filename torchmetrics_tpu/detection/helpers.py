@@ -14,6 +14,8 @@ from typing import Dict, Tuple, Union
 
 import numpy as np
 
+from ..functional.detection._map_eval import _bucket
+
 
 def _is_arraylike(x) -> bool:
     return hasattr(x, "shape") and hasattr(x, "dtype")
@@ -95,3 +97,95 @@ def _input_validator(
                     f" different length (expected {item[ivn].shape[0]} labels and scores,"
                     f" got {item['labels'].shape[0]} labels and {item['scores'].shape[0]} scores)"
                 )
+
+
+def _build_device_rows(
+    preds: Sequence[Dict],
+    targets: Sequence[Dict],
+    box_format: str,
+    num_classes: int,
+    gt_group_cap: int,
+    max_det: int,
+    warn_many: bool = True,
+) -> Tuple[np.ndarray, np.ndarray, int, int, int]:
+    """Flatten one update batch into the device evaluator's padded row layout.
+
+    Returns ``(det_rows, gt_rows, n_det, n_gt, n_img)`` where the row arrays are
+    bucket-padded (next power of two, floor 8) so repeated updates reuse a handful of
+    compiled "update" signatures instead of one per batch shape. Image ids are batch-
+    LOCAL (0..n_img); the device merge re-bases them against the absorbed image count.
+
+    Device-layout invariants the jit program cannot check are enforced here: labels in
+    ``[0, num_classes)`` and at most ``gt_group_cap`` ground truths per (image, class)
+    cell — the matcher's static gt-window width.
+    """
+    _input_validator(preds, targets, iou_type="bbox")
+    det_parts, gt_parts = [], []
+    for i, item in enumerate(preds):
+        boxes = _boxes_to_xyxy_np(item["boxes"], box_format)
+        labels = np.asarray(item["labels"]).astype(np.int64).reshape(-1)
+        scores = np.asarray(item["scores"]).astype(np.float32).reshape(-1)
+        if labels.size and (labels.min() < 0 or labels.max() >= num_classes):
+            raise ValueError(
+                f"Device mAP labels must lie in [0, {num_classes}) (the `num_classes` config); "
+                f"sample {i} in predictions has labels outside that range"
+            )
+        if warn_many and labels.size > max_det:
+            from ..utilities.prints import rank_zero_warn
+
+            rank_zero_warn(
+                f"Encountered more than {max_det} detections in a single image. "
+                "This means that certain detections with the lowest scores will be ignored, that may have "
+                "an undesirable impact on performance. Please consider adjusting the `max_detection_threshold` "
+                "argument to adjust this behavior.",
+                UserWarning,
+            )
+        det_parts.append(
+            np.column_stack([
+                np.full(labels.size, i, np.float32),
+                labels.astype(np.float32),
+                scores,
+                boxes.astype(np.float32),
+            ]).astype(np.float32)
+        )
+    for i, item in enumerate(targets):
+        labels = np.asarray(item["labels"]).astype(np.int64).reshape(-1)
+        boxes = _boxes_to_xyxy_np(item["boxes"], box_format)
+        if labels.size and (labels.min() < 0 or labels.max() >= num_classes):
+            raise ValueError(
+                f"Device mAP labels must lie in [0, {num_classes}) (the `num_classes` config); "
+                f"sample {i} in target has labels outside that range"
+            )
+        if labels.size:
+            _, counts = np.unique(labels, return_counts=True)
+            if counts.max() > gt_group_cap:
+                raise ValueError(
+                    f"Sample {i} in target has {int(counts.max())} ground truths for one class, but the "
+                    f"device evaluator's gt window is capped at gt_group_cap={gt_group_cap}. "
+                    "Raise `gt_group_cap` (a compile-time width) on the metric."
+                )
+        crowd = item.get("iscrowd")
+        crowd = (
+            np.asarray(crowd).astype(np.float32).reshape(-1) if crowd is not None else np.zeros(labels.size, np.float32)
+        )
+        area = item.get("area")
+        area = (
+            np.asarray(area).astype(np.float32).reshape(-1) if area is not None else np.zeros(labels.size, np.float32)
+        )
+        gt_parts.append(
+            np.column_stack([
+                np.full(labels.size, i, np.float32),
+                labels.astype(np.float32),
+                crowd,
+                area,
+                boxes.astype(np.float32),
+            ]).astype(np.float32)
+        )
+    det = np.concatenate(det_parts, axis=0) if det_parts else np.zeros((0, 7), np.float32)
+    gt = np.concatenate(gt_parts, axis=0) if gt_parts else np.zeros((0, 8), np.float32)
+    n_det, n_gt, n_img = det.shape[0], gt.shape[0], len(preds)
+    det_pad = np.zeros((_bucket(max(n_det, 1), floor=8), 7), np.float32)
+    det_pad[:n_det] = det
+    gt_pad = np.zeros((_bucket(max(n_gt, 1), floor=8), 8), np.float32)
+    gt_pad[:n_gt] = gt
+    return det_pad, gt_pad, n_det, n_gt, n_img

@@ -12,9 +12,12 @@ from __future__ import annotations
 
 from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import aot as _aot
+from ..functional.detection._map_device import build_mapeval_program
 from ..functional.detection._map_eval import (
     DEFAULT_IOU_THRESHOLDS,
     DEFAULT_REC_THRESHOLDS,
@@ -22,8 +25,9 @@ from ..functional.detection._map_eval import (
     evaluate_map,
     summarize,
 )
-from ..metric import HostMetric
-from .helpers import _boxes_to_xyxy_np, _input_validator
+from ..metric import HostMetric, Metric
+from ..utilities.exceptions import TorchMetricsUserError
+from .helpers import _boxes_to_xyxy_np, _build_device_rows, _input_validator
 
 
 def _split_by_counts(flat: np.ndarray, counts: np.ndarray) -> List[np.ndarray]:
@@ -64,6 +68,15 @@ class MeanAveragePrecision(HostMetric):
     plot_upper_bound: float = 1.0
 
     warn_on_many_detections: bool = True
+
+    def __new__(cls, *args: Any, **kwargs: Any) -> "MeanAveragePrecision":
+        # backend="device" re-homes the evaluator as one jit-compiled program over
+        # fixed-capacity padded device state (DeviceMeanAveragePrecision below); the
+        # host evaluator stays the default and the parity oracle. Returning a
+        # non-subclass instance skips this __init__ by construction.
+        if cls is MeanAveragePrecision and kwargs.get("backend") == "device":
+            return DeviceMeanAveragePrecision(*args, **kwargs)
+        return super().__new__(cls)
 
     def __init__(
         self,
@@ -425,3 +438,289 @@ class MeanAveragePrecision(HostMetric):
             for r in (by_img_p[i] for i in img_ids)
         ]
         return preds_out, target_out
+
+
+# One matcher program per compile-time geometry, shared across instances: the
+# evaluator is multi-second to trace+compile, and re-creating a metric (per-epoch
+# evals, tests) must hit jax's executable cache instead of re-tracing a fresh closure.
+_MAPEVAL_PROGRAMS: Dict[tuple, Tuple[Any, Any]] = {}
+
+
+class DeviceMeanAveragePrecision(Metric):
+    """COCO mAP as one jit-compiled device program (``MeanAveragePrecision(backend="device")``).
+
+    The re-homed escape hatch from the host evaluator: state is a fixed-capacity padded
+    row layout on device (``det_rows (capacity, 7)``, ``gt_rows (capacity, 8)`` plus
+    i32 cursors) instead of unbounded host lists, updates append rows in-graph through
+    the standard donated "update" dispatch, and ``compute()`` runs the WHOLE evaluation
+    (greedy matcher + accumulate + summarize — ``functional/detection/_map_device.py``)
+    as a single program under the registered ``"mapeval"`` tag, so telemetry,
+    reliability retry and the AOT warm-start cache apply to it like any other dispatch.
+    One program is compiled per ``(capacity, num_classes, gt_group_cap, thresholds)``
+    signature; repeated computes reuse it (``map_fresh_compiles == 1``).
+
+    Device-specific config (compile-time geometry):
+
+    - ``capacity``: max accumulated rows for detections and ground truths each.
+      Overflow raises ``TorchMetricsUserError`` at update time, like the state-growth
+      sentinel — the device scatter would otherwise drop rows silently.
+    - ``num_classes``: labels must lie in ``[0, num_classes)``.
+    - ``gt_group_cap``: max ground truths per (image, class) cell — the matcher's
+      static gt-window width.
+
+    Restrictions vs the host oracle: ``iou_type="bbox"``, ``average="macro"`` and
+    ``extended_summary=False`` only (the host evaluator remains available for the
+    rest). Parity is exact up to f32-vs-f64 IoU threshold rounding
+    (``tests/test_map_device.py``).
+    """
+
+    is_differentiable: bool = False
+    higher_is_better: Optional[bool] = True
+    full_state_update: bool = True
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+    warn_on_many_detections: bool = True
+    _jittable_compute: bool = False  # compute is a host-orchestrated "mapeval" dispatch
+
+    def __init__(
+        self,
+        box_format: str = "xyxy",
+        iou_type: Union[str, Tuple[str, ...]] = "bbox",
+        iou_thresholds: Optional[List[float]] = None,
+        rec_thresholds: Optional[List[float]] = None,
+        max_detection_thresholds: Optional[List[int]] = None,
+        class_metrics: bool = False,
+        extended_summary: bool = False,
+        average: str = "macro",
+        backend: str = "device",
+        capacity: int = 4096,
+        num_classes: int = 80,
+        gt_group_cap: int = 32,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        allowed_box_formats = ("xyxy", "xywh", "cxcywh")
+        if box_format not in allowed_box_formats:
+            raise ValueError(f"Expected argument `box_format` to be one of {allowed_box_formats} but got {box_format}")
+        self.box_format = box_format
+        iou_type = (iou_type,) if isinstance(iou_type, str) else tuple(iou_type)
+        if iou_type != ("bbox",):
+            raise ValueError(
+                f"The device mAP evaluator supports `iou_type='bbox'` only, got {iou_type}. "
+                "Use the host backend for segmentation IoU."
+            )
+        self.iou_type = iou_type
+        if iou_thresholds is not None and not isinstance(iou_thresholds, list):
+            raise ValueError(
+                f"Expected argument `iou_thresholds` to either be `None` or a list of floats but got {iou_thresholds}"
+            )
+        self.iou_thresholds = iou_thresholds or list(DEFAULT_IOU_THRESHOLDS)
+        if rec_thresholds is not None and not isinstance(rec_thresholds, list):
+            raise ValueError(
+                f"Expected argument `rec_thresholds` to either be `None` or a list of floats but got {rec_thresholds}"
+            )
+        self.rec_thresholds = rec_thresholds or list(DEFAULT_REC_THRESHOLDS)
+        if max_detection_thresholds is not None and not isinstance(max_detection_thresholds, list):
+            raise ValueError(
+                f"Expected argument `max_detection_thresholds` to either be `None` or a list of ints"
+                f" but got {max_detection_thresholds}"
+            )
+        if max_detection_thresholds is not None and len(max_detection_thresholds) != 3:
+            raise ValueError(
+                "When providing a list of max detection thresholds it should have length 3."
+                f" Got value {len(max_detection_thresholds)}"
+            )
+        self.max_detection_thresholds = sorted(max_detection_thresholds or [1, 10, 100])
+        if not isinstance(class_metrics, bool):
+            raise ValueError("Expected argument `class_metrics` to be a boolean")
+        self.class_metrics = class_metrics
+        if not isinstance(extended_summary, bool):
+            raise ValueError("Expected argument `extended_summary` to be a boolean")
+        if extended_summary:
+            raise ValueError(
+                "The device mAP evaluator does not materialize the extended summary "
+                "(precision/recall/score tensors stay device-internal); use the host backend."
+            )
+        self.extended_summary = False
+        if average != "macro":
+            raise ValueError(f"The device mAP evaluator supports `average='macro'` only, got {average}")
+        self.average = average
+        if backend != "device":
+            raise ValueError(f"Expected argument `backend` to be 'device' but got {backend}")
+        self.backend = backend
+        for name, val in (("capacity", capacity), ("num_classes", num_classes), ("gt_group_cap", gt_group_cap)):
+            if not isinstance(val, int) or val <= 0:
+                raise ValueError(f"Expected argument `{name}` to be a positive int but got {val}")
+        self.capacity = capacity
+        self.num_classes = num_classes
+        self.gt_group_cap = gt_group_cap
+
+        self.add_state("det_rows", default=jnp.zeros((capacity, 7), jnp.float32))
+        self.add_state("gt_rows", default=jnp.zeros((capacity, 8), jnp.float32))
+        self.add_state("det_n", default=jnp.zeros((), jnp.int32))
+        self.add_state("gt_n", default=jnp.zeros((), jnp.int32))
+        self.add_state("img_n", default=jnp.zeros((), jnp.int32))
+        # host mirror of the cursors: the in-graph row append drops out-of-capacity
+        # rows silently (mode="drop"), so overflow must raise BEFORE dispatch
+        self._rows_used = {"det": 0, "gt": 0, "img": 0}
+
+    # ------------------------------------------------------------------ update
+
+    def _prepare_inputs(self, preds: Sequence[Dict], target: Sequence[Dict]) -> Tuple[tuple, dict]:
+        det_rows, gt_rows, n_det, n_gt, n_img = _build_device_rows(
+            preds,
+            target,
+            box_format=self.box_format,
+            num_classes=self.num_classes,
+            gt_group_cap=self.gt_group_cap,
+            max_det=self.max_detection_thresholds[-1],
+            warn_many=self.warn_on_many_detections,
+        )
+        for kind, n in (("det", n_det), ("gt", n_gt)):
+            if self._rows_used[kind] + n > self.capacity:
+                raise TorchMetricsUserError(
+                    f"Device mAP state overflow: accumulating {n} more {kind} rows would exceed "
+                    f"capacity={self.capacity} ({self._rows_used[kind]} already used). Raise `capacity` "
+                    "(a compile-time size) or compute/reset more often."
+                )
+        if (self._rows_used["img"] + n_img) * self.num_classes >= np.iinfo(np.int32).max:
+            raise TorchMetricsUserError(
+                "Device mAP image count overflow: image_count * num_classes must stay below 2**31 "
+                "(the evaluator's int32 cell keys)."
+            )
+        self._rows_used["det"] += n_det
+        self._rows_used["gt"] += n_gt
+        self._rows_used["img"] += n_img
+        return (
+            jnp.asarray(det_rows),
+            jnp.asarray(gt_rows),
+            jnp.asarray(n_det, jnp.int32),
+            jnp.asarray(n_gt, jnp.int32),
+            jnp.asarray(n_img, jnp.int32),
+        ), {}
+
+    def _batch_state(self, det_rows, gt_rows, det_n, gt_n, img_n) -> Dict[str, jnp.ndarray]:
+        return {"det_rows": det_rows, "gt_rows": gt_rows, "det_n": det_n, "gt_n": gt_n, "img_n": img_n}
+
+    def _merge(self, a: Dict[str, jnp.ndarray], b: Dict[str, jnp.ndarray]) -> Dict[str, jnp.ndarray]:
+        # append b's rows at a's cursors; b's image ids are local to its batch (or
+        # rank), so re-base them by the images a has already absorbed. Rows beyond
+        # capacity drop here — the host-side sentinel in _prepare_inputs raises first.
+        off = a["img_n"].astype(jnp.float32)
+        b_det = jnp.concatenate([b["det_rows"][:, :1] + off, b["det_rows"][:, 1:]], axis=1)
+        b_gt = jnp.concatenate([b["gt_rows"][:, :1] + off, b["gt_rows"][:, 1:]], axis=1)
+        didx = a["det_n"] + jnp.arange(b_det.shape[0], dtype=jnp.int32)
+        gidx = a["gt_n"] + jnp.arange(b_gt.shape[0], dtype=jnp.int32)
+        return {
+            "det_rows": a["det_rows"].at[didx].set(b_det, mode="drop"),
+            "gt_rows": a["gt_rows"].at[gidx].set(b_gt, mode="drop"),
+            "det_n": a["det_n"] + b["det_n"],
+            "gt_n": a["gt_n"] + b["gt_n"],
+            "img_n": a["img_n"] + b["img_n"],
+        }
+
+    def reset(self) -> None:
+        super().reset()
+        self._rows_used = {"det": 0, "gt": 0, "img": 0}
+
+    # ----------------------------------------------------------------- compute
+
+    def _get_mapeval_fn(self):
+        if "mapeval" not in self._jit_cache:
+            key = (
+                self.capacity,
+                self.num_classes,
+                self.gt_group_cap,
+                tuple(self.iou_thresholds),
+                tuple(self.rec_thresholds),
+                tuple(self.max_detection_thresholds),
+            )
+            if key not in _MAPEVAL_PROGRAMS:
+                raw = build_mapeval_program(*key)
+                _MAPEVAL_PROGRAMS[key] = (raw, jax.jit(raw))
+            raw, jitted = _MAPEVAL_PROGRAMS[key]
+            self._jit_cache["mapeval.raw"] = raw  # undonated source for _aot_program
+            self._jit_cache["mapeval"] = jitted if self._enable_jit else raw
+        return self._jit_cache["mapeval"]
+
+    def _empty_result(self) -> Dict[str, jnp.ndarray]:
+        # no images seen: the host evaluator's sentinel dict, key for key
+        result: Dict[str, jnp.ndarray] = {}
+        for key in (
+            "map", "map_50", "map_75", "map_small", "map_medium", "map_large",
+            *(f"mar_{m}" for m in self.max_detection_thresholds),
+            "mar_small", "mar_medium", "mar_large",
+        ):
+            result[key] = jnp.asarray(-1.0, jnp.float32)
+        result["map_per_class"] = jnp.asarray([-1.0], jnp.float32)
+        result[f"mar_{self.max_detection_thresholds[-1]}_per_class"] = jnp.asarray([-1.0], jnp.float32)
+        result["classes"] = jnp.zeros((0,), jnp.int32)
+        return result
+
+    def _compute(self, state: Dict[str, Any]) -> Dict[str, jnp.ndarray]:
+        if int(np.asarray(state["img_n"])) == 0:
+            return self._empty_result()
+        tensors = {k: state[k] for k in ("det_rows", "gt_rows", "det_n", "gt_n", "img_n")}
+        fn = self._get_mapeval_fn()
+        out = self._donation_safe_dispatch("mapeval", fn, tensors, inputs=((), {}), jitted=fn)
+        last = self.max_detection_thresholds[-1]
+        result: Dict[str, jnp.ndarray] = {
+            key: jnp.asarray(out[key], jnp.float32)
+            for key in (
+                "map", "map_small", "map_medium", "map_large",
+                "mar_small", "mar_medium", "mar_large", "map_50", "map_75",
+                *(f"mar_{m}" for m in self.max_detection_thresholds),
+            )
+        }
+        present = np.asarray(out["present"])
+        if self.class_metrics:
+            result["map_per_class"] = jnp.asarray(np.asarray(out["map_per_class"])[present], jnp.float32)
+            result[f"mar_{last}_per_class"] = jnp.asarray(np.asarray(out["mar_per_class"])[present], jnp.float32)
+        else:
+            result["map_per_class"] = jnp.asarray(-1.0, jnp.float32)
+            result[f"mar_{last}_per_class"] = jnp.asarray(-1.0, jnp.float32)
+        result["classes"] = jnp.asarray(np.nonzero(present)[0], jnp.int32)
+        return result
+
+    # -------------------------------------------------------------- warm start
+
+    def precompile(
+        self,
+        *example_inputs: Any,
+        tags: Sequence[str] = ("mapeval",),
+        cache_dir: Optional[str] = None,
+        force: bool = False,
+        **example_kwargs: Any,
+    ) -> Dict[str, Any]:
+        """Like :meth:`Metric.precompile`, plus the ``"mapeval"`` evaluator program.
+
+        The evaluator's dispatch signature is empty (it reads only the padded state),
+        so ``"mapeval"`` needs no example inputs; other tags delegate to the base
+        implementation with whatever examples are given.
+        """
+        tags = tuple(tags)
+        report: Dict[str, Any] = {}
+        rest = tuple(t for t in tags if t != "mapeval")
+        if rest:
+            report.update(
+                super().precompile(*example_inputs, tags=rest, cache_dir=cache_dir, force=force, **example_kwargs)
+            )
+        if "mapeval" not in tags:
+            return report
+        if cache_dir is not None:
+            plane = _aot.AotPlane(_aot.AotConfig(cache_dir=cache_dir))
+        else:
+            plane = _aot._ACTIVE
+            if plane is None:
+                raise TorchMetricsUserError(
+                    "precompile needs an active AOT plane — call "
+                    "torchmetrics_tpu.aot.enable(cache_dir) first, or pass cache_dir=."
+                )
+        if not self._enable_jit:
+            report["mapeval"] = {"status": "skipped", "reason": "jit disabled on this metric"}
+            return report
+        self._get_mapeval_fn()
+        fn, donate = self._aot_program("mapeval")
+        tensors, _ = self._split_tensor_list(self._state)
+        report["mapeval"] = plane.precompile_program(self, "mapeval", fn, donate, tensors, (), {}, force=force)
+        return report

@@ -1,0 +1,336 @@
+"""Device-resident COCO mAP — one jit-compiled program from padded state to summary.
+
+The host evaluator (``_map_eval.py``) stays the parity oracle; this module is the
+re-homed escape hatch: the WHOLE evaluation (greedy matcher + COCOeval.accumulate +
+summarize) is a single XLA program over a fixed-capacity padded row state, so the
+telemetry/reliability/AOT planes apply to mAP compute exactly like any other dispatch
+tag ("mapeval"), and a warm boot loads the 3s-to-derive evaluator from the AOT cache.
+
+Layout (built host-side in ``detection/helpers.py:_build_device_rows``):
+
+- ``det_rows`` ``(capacity, 7)`` f32: ``[img, label, score, x1, y1, x2, y2]``
+- ``gt_rows``  ``(capacity, 8)`` f32: ``[img, label, iscrowd, area, x1, y1, x2, y2]``
+- ``det_n`` / ``gt_n`` / ``img_n`` i32 scalars — valid-row cursors
+
+Algorithm, fully vectorized except one dynamic-trip-count loop:
+
+1. sort gts by cell key ``img * K + label`` (stable: in-cell order = input order, the
+   pycocotools tie-break order); each det finds its cell's gt window via two
+   ``searchsorted`` calls — windows are bounded by ``gt_group_cap`` (validated at
+   update time), so per-det gt views are a static ``(D, Gc)`` gather,
+2. per-cell score ranks from one lexsort + first-occurrence ``searchsorted``; dets
+   that can match anything (valid, inside maxDet, non-empty window) are compacted to
+   the front, and a ``lax.fori_loop`` with a DYNAMIC trip count walks only those —
+   the body mirrors ``_map_eval._match_kernel`` (candidate pool, prefer-non-ignored,
+   last-argmax tie-break) over an ``(A, T, Gc)`` window slice,
+3. accumulation as segment ops: one global ``(class, -score, img, rank)`` lexsort,
+   per-class TP/FP cumsums by subtracting class-start prefixes, 101-point PR
+   interpolation as a scatter-max into ``(class, rec_bin)`` buckets + a reversed
+   ``associative_scan`` max (the precision envelope and the ``searchsorted`` gather
+   collapse into one suffix-max), and masked means reproduce ``summarize``.
+
+Parity note: threshold eligibility is resolved in f32 (the state dtype) against the
+f32-quantized thresholds, where the host oracle resolves f64 IoU vs f64 thresholds —
+results are bit-identical except for IoUs within f32 rounding of a threshold
+(tests/test_map_device.py fuzzes parity to 1e-4 on summary stats).
+
+The matcher body deliberately avoids ``.at[].set`` scatters inside the loop — that
+formulation miscompiles under XLA for row batches >= 64 (see ``_map_eval.py``); all
+loop write-backs are ``dynamic_update_slice`` + the one-hot|or formulation. Scatters
+OUTSIDE the loop (the PR-bucket scatter-max, the state-merge row append) are fine.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ._map_eval import _AREA_RANGES
+
+_INT32_MAX = np.iinfo(np.int32).max
+
+
+def build_mapeval_program(
+    capacity: int,
+    num_classes: int,
+    gt_group_cap: int,
+    iou_thresholds: List[float],
+    rec_thresholds: List[float],
+    max_detection_thresholds: List[int],
+) -> Callable:
+    """The raw (un-jitted) "mapeval" program for one (capacity, classes) signature.
+
+    Returns ``fn(tensors, n) -> {summary scalars, per-class arrays, present mask}``
+    with the ``(t, n)`` calling convention every dispatch tag shares (``n`` — the
+    device update counter — is unused; compute is a pure read of the state).
+    """
+    D, K, Gc = int(capacity), int(num_classes), int(gt_group_cap)
+    A = int(_AREA_RANGES.shape[0])
+    T, R, M = len(iou_thresholds), len(rec_thresholds), len(max_detection_thresholds)
+    mdet_last = int(max_detection_thresholds[-1])
+    # pycocotools clamps each threshold to min(t, 1 - 1e-10) in f64 so an exact-1.0
+    # IoU clears a 1.0 threshold; quantizing the clamped value to f32 keeps that
+    # behavior (f32(1 - 1e-10) == 1.0 and f32 IoUs saturate at 1.0)
+    thrs_np = np.minimum(np.asarray(iou_thresholds, np.float64), 1.0 - 1e-10).astype(np.float32)
+    # summaries are means over all R bins, so sorting user-supplied recall
+    # thresholds is observation-free (extended_summary is host-evaluator-only)
+    rec_np = np.sort(np.asarray(rec_thresholds, np.float32))
+    mdets_np = np.asarray(max_detection_thresholds, np.int32)
+    t50 = iou_thresholds.index(0.5) if 0.5 in iou_thresholds else None
+    t75 = iou_thresholds.index(0.75) if 0.75 in iou_thresholds else None
+    eps = np.float32(np.spacing(np.float64(1.0)))  # COCOeval's precision denominator guard
+
+    def fn(tensors: Dict[str, jnp.ndarray], n: Any) -> Dict[str, jnp.ndarray]:
+        del n
+        det, gt = tensors["det_rows"], tensors["gt_rows"]
+        det_n, gt_n = tensors["det_n"], tensors["gt_n"]
+        thrs = jnp.asarray(thrs_np)
+        rec_t = jnp.asarray(rec_np)
+        areas = jnp.asarray(_AREA_RANGES)  # (A, 2)
+        slot = jnp.arange(D, dtype=jnp.int32)
+
+        d_img = det[:, 0].astype(jnp.int32)
+        d_lab = det[:, 1].astype(jnp.int32)
+        d_score = det[:, 2]
+        d_box = det[:, 3:7]
+        g_img = gt[:, 0].astype(jnp.int32)
+        g_lab = gt[:, 1].astype(jnp.int32)
+        g_crowd = gt[:, 2] > 0
+        g_area_user = gt[:, 3]
+        g_box = gt[:, 4:8]
+        dvalid = slot < det_n
+        gvalid = slot < gt_n
+
+        d_area = (d_box[:, 2] - d_box[:, 0]) * (d_box[:, 3] - d_box[:, 1])
+        g_area_box = (g_box[:, 2] - g_box[:, 0]) * (g_box[:, 3] - g_box[:, 1])
+        g_area = jnp.where(g_area_user > 0, g_area_user, g_area_box)
+
+        # ---- gts sorted by cell; stable, so in-cell order stays input order (the
+        # pycocotools last-argmax tie-break depends on it)
+        g_key = jnp.where(gvalid, g_img * K + g_lab, _INT32_MAX)
+        g_order = jnp.argsort(g_key)  # jnp.argsort is stable
+        gs_key = g_key[g_order]
+        gs_valid = gvalid[g_order]
+        gs_lab = jnp.where(gs_valid, g_lab[g_order], K)
+        gs_crowd = g_crowd[g_order] & gs_valid
+        gs_area = g_area[g_order]
+        gs_box = g_box[g_order]
+
+        # ---- each det's gt window [glo, ghi) in the sorted order
+        d_key = jnp.where(dvalid, d_img * K + d_lab, _INT32_MAX)
+        glo = jnp.searchsorted(gs_key, d_key, side="left").astype(jnp.int32)
+        ghi = jnp.searchsorted(gs_key, d_key, side="right").astype(jnp.int32)
+
+        # ---- per-cell score rank (stable descending — COCOeval's det order)
+        neg_score = jnp.where(dvalid, -d_score, jnp.inf)
+        d_order = jnp.lexsort((slot, neg_score, d_key))
+        key_sorted = d_key[d_order]
+        cell_start = jnp.searchsorted(key_sorted, key_sorted, side="left")
+        rank_sorted = (slot - cell_start).astype(jnp.int32)
+
+        # ---- compact matchable dets to the front, keeping (cell, -score) order
+        glo_sorted, ghi_sorted = glo[d_order], ghi[d_order]
+        part = dvalid[d_order] & (rank_sorted < mdet_last) & (ghi_sorted > glo_sorted)
+        comp = jnp.argsort(~part)
+        perm = d_order[comp]
+        n_match = part.sum().astype(jnp.int32)
+
+        img_c = d_img[perm]
+        valid_c = dvalid[perm]
+        lab_c = jnp.where(valid_c, d_lab[perm], K)
+        score_c = d_score[perm]
+        box_c = d_box[perm]
+        area_c = d_area[perm]
+        rank_c = rank_sorted[comp]
+        glo_c, ghi_c = glo_sorted[comp], ghi_sorted[comp]
+
+        # ---- windowed gt views + crowd-adjusted pairwise IoU, outside the loop
+        widx = glo_c[:, None] + jnp.arange(Gc, dtype=jnp.int32)[None, :]
+        w_in = widx < ghi_c[:, None]  # (D, Gc)
+        widx_cl = jnp.minimum(widx, D - 1)
+        wg_box = gs_box[widx_cl]  # (D, Gc, 4)
+        wg_crowd = gs_crowd[widx_cl] & w_in
+        wg_area = gs_area[widx_cl]
+
+        lt = jnp.maximum(box_c[:, None, :2], wg_box[..., :2])
+        rb = jnp.minimum(box_c[:, None, 2:], wg_box[..., 2:])
+        wh = jnp.clip(rb - lt, 0.0, None)
+        inter = wh[..., 0] * wh[..., 1]
+        wg_box_area = (wg_box[..., 2] - wg_box[..., 0]) * (wg_box[..., 3] - wg_box[..., 1])
+        union = area_c[:, None] + wg_box_area - inter
+        denom = jnp.where(wg_crowd, area_c[:, None], union)
+        w_iou = jnp.where(denom > 0, inter / jnp.where(denom > 0, denom, 1.0), 0.0)
+
+        wg_ign = (
+            (wg_area[:, None, :] < areas[None, :, 0:1])
+            | (wg_area[:, None, :] > areas[None, :, 1:2])
+            | wg_crowd[:, None, :]
+            | ~w_in[:, None, :]
+        )  # (D, A, Gc)
+        det_out = (area_c[:, None] < areas[None, :, 0]) | (area_c[:, None] > areas[None, :, 1])
+
+        # ---- greedy matcher: dynamic trip count, window-local state updates.
+        # gmatch carries Gc slack so the window slice never clamps at the tail.
+        gmatch0 = jnp.zeros((A, T, D + Gc), bool)
+        dm0 = jnp.zeros((D, A, T), bool)
+        dig0 = jnp.zeros((D, A, T), bool)
+
+        def body(i, carry):
+            gmatch, dm, dig = carry
+            lo = glo_c[i]
+            wi = w_iou[i]  # (Gc,)
+            win, wcr, wig = w_in[i], wg_crowd[i], wg_ign[i]
+            clr = wi[None, :] >= thrs[:, None]  # (T, Gc)
+            mwin = lax.dynamic_slice(gmatch, (0, 0, lo), (A, T, Gc))
+            cand = win[None, None, :] & (~mwin | wcr[None, None, :]) & clr[None, :, :]
+            cand_ni = cand & ~wig[:, None, :]
+            pool = jnp.where(cand_ni.any(-1, keepdims=True), cand_ni, cand)
+            vals = jnp.where(pool, wi[None, None, :], -jnp.inf)
+            m = Gc - 1 - jnp.argmax(vals[..., ::-1], axis=-1)  # last argmax: later gt wins ties
+            hit = pool.any(-1)  # (A, T)
+            oh = jax.nn.one_hot(m, Gc, dtype=bool) & hit[..., None]
+            gmatch = lax.dynamic_update_slice(gmatch, mwin | oh, (0, 0, lo))
+            ign_of_m = (oh & wig[:, None, :]).any(-1)
+            dm = lax.dynamic_update_slice(dm, hit[None], (i, 0, 0))
+            dig = lax.dynamic_update_slice(dig, ign_of_m[None], (i, 0, 0))
+            return gmatch, dm, dig
+
+        _, dm, dig = lax.fori_loop(0, n_match, body, (gmatch0, dm0, dig0))
+        dig = dig | (~dm & det_out[:, :, None])  # unmatched dets outside the range: ignored
+
+        # ---- COCOeval.accumulate: one global sort, per-class segment cumsums
+        sel_lab = jnp.where(valid_c & (rank_c < mdet_last), lab_c, K)
+        acc = jnp.lexsort((rank_c, img_c, jnp.where(sel_lab < K, -score_c, jnp.inf), sel_lab))
+        lab_s = sel_lab[acc]
+        rank_s = rank_c[acc]
+        dm_s, dig_s = dm[acc], dig[acc]
+
+        mdets = jnp.asarray(mdets_np)
+        sel = (lab_s[:, None] < K) & (rank_s[:, None] < mdets[None, :])  # (D, M)
+        cls_start = jnp.searchsorted(lab_s, jnp.arange(K, dtype=jnp.int32), side="left").astype(jnp.int32)
+        cls_end = jnp.searchsorted(lab_s, jnp.arange(K, dtype=jnp.int32), side="right").astype(jnp.int32)
+        lab_cl = jnp.minimum(lab_s, K - 1)
+
+        # summarize() reads precision at the LAST maxDet only, and the extended
+        # precision/scores tensors never leave the device — so the whole PR-curve
+        # pipeline runs on (D, A, T), M-free (3x less traffic than the host layout)
+        tps = (dm_s & ~dig_s).astype(jnp.float32)  # (D, A, T); every segment row
+        fps = (~dm_s & ~dig_s).astype(jnp.float32)  # already has rank < mdet_last
+        tp_cum_g = jnp.cumsum(tps, axis=0)
+        fp_cum_g = jnp.cumsum(fps, axis=0)
+        has_prefix = (cls_start > 0)[:, None, None]
+        base_tp = jnp.where(has_prefix, tp_cum_g[jnp.maximum(cls_start - 1, 0)], 0.0)  # (K, A, T)
+        base_fp = jnp.where(has_prefix, fp_cum_g[jnp.maximum(cls_start - 1, 0)], 0.0)
+        tp = tp_cum_g - base_tp[lab_cl]
+        fp = fp_cum_g - base_fp[lab_cl]
+
+        gs_ign = (
+            (gs_area[:, None] < areas[None, :, 0])
+            | (gs_area[:, None] > areas[None, :, 1])
+            | gs_crowd[:, None]
+        )  # (D, A)
+        counted = (gs_valid[:, None] & ~gs_ign).astype(jnp.float32)
+        npig = jax.ops.segment_sum(counted, gs_lab, num_segments=K + 1)[:K]  # (K, A)
+        npig_d = npig[lab_cl]  # (D, A)
+        rc = jnp.where(npig_d[:, :, None] > 0, tp / jnp.maximum(npig_d, 1.0)[:, :, None], 0.0)
+        pr = tp / (tp + fp + eps)
+
+        # precision envelope = suffix max of pr within each class segment (a flip +
+        # forward segmented-max scan; XLA CPU serializes large scatters, so the
+        # scatter-into-recall-bins formulation is ~6x slower than this)
+        seg_end = jnp.concatenate([lab_s[:-1] != lab_s[1:], jnp.ones((1,), bool)])
+        flag_r = seg_end[::-1][:, None, None]
+
+        def seg_max(a, b):
+            va, fa = a
+            vb, fb = b
+            return jnp.where(fb, vb, jnp.maximum(va, vb)), fa | fb
+
+        env_r, _ = lax.associative_scan(seg_max, (pr[::-1], flag_r))
+        pr_env = env_r[::-1]  # (D, A, T)
+
+        # 101-point interpolation: rc is non-decreasing within a class segment, so
+        # q[c, r] = pr_env[lower_bound(rc[seg_c], rec_thrs[r])] — one vectorized
+        # binary search over (K, R, A, T) replaces the host's per-cell searchsorted
+        lane = jnp.arange(A * T, dtype=jnp.int32).reshape(1, 1, A, T)
+        rc_lin = rc.reshape(-1)
+        lo = jnp.broadcast_to(cls_start[:, None, None, None], (K, R, A, T))
+        hi = jnp.broadcast_to(cls_end[:, None, None, None], (K, R, A, T))
+        thr = rec_t[None, :, None, None]
+        for _ in range(max(D.bit_length(), 1)):
+            mid = (lo + hi) // 2
+            v = rc_lin[mid * (A * T) + lane]
+            go_right = (v < thr) & (mid < hi)
+            lo = jnp.where(go_right, mid + 1, lo)
+            hi = jnp.where(go_right, hi, mid)
+        found = lo < cls_end[:, None, None, None]
+        q_idx = jnp.minimum(lo, D - 1) * (A * T) + lane
+        q = jnp.where(found, pr_env.reshape(-1)[q_idx], 0.0)  # (K, R, A, T)
+
+        tp_tot = jnp.stack(
+            [
+                jax.ops.segment_sum(
+                    (dm_s & ~dig_s & sel[:, m, None, None]).astype(jnp.float32), lab_s, num_segments=K + 1
+                )[:K]
+                for m in range(M)
+            ],
+            axis=-1,
+        )  # (K, A, T, M)
+        nd_cnt = jax.ops.segment_sum(sel.astype(jnp.float32), lab_s, num_segments=K + 1)[:K]  # (K, M)
+        valid_cell = npig > 0  # (K, A)
+        rec_raw = jnp.where(
+            nd_cnt[:, None, None, :] > 0, tp_tot / jnp.maximum(npig, 1.0)[:, :, None, None], 0.0
+        )
+        recall = jnp.where(valid_cell[:, :, None, None], rec_raw, -1.0)  # (K, A, T, M)
+        q = jnp.where(valid_cell[:, None, :, None], q, -1.0)  # (K, R, A, T)
+
+        # ---- summarize: masked means are exactly the host's mean-over-entries > -1
+        # (inside a valid cell every entry is >= 0; invalid cells are uniform -1)
+        lastm = M - 1
+
+        def _precision_mean(a_idx: int, t_idx=None):
+            block = q[:, :, a_idx, :]  # (K, R, T)
+            if t_idx is not None:
+                block = block[:, :, t_idx : t_idx + 1]
+            w = valid_cell[:, a_idx].astype(jnp.float32)
+            cnt = w.sum() * (block.shape[1] * block.shape[2])
+            return jnp.where(cnt > 0, (block * w[:, None, None]).sum() / jnp.maximum(cnt, 1.0), -1.0)
+
+        def _recall_mean(a_idx: int, m_idx: int):
+            block = recall[:, a_idx, :, m_idx]  # (K, T)
+            w = valid_cell[:, a_idx].astype(jnp.float32)
+            cnt = w.sum() * block.shape[1]
+            return jnp.where(cnt > 0, (block * w[:, None]).sum() / jnp.maximum(cnt, 1.0), -1.0)
+
+        out: Dict[str, jnp.ndarray] = {
+            "map": _precision_mean(0),
+            "map_small": _precision_mean(1),
+            "map_medium": _precision_mean(2),
+            "map_large": _precision_mean(3),
+            "mar_small": _recall_mean(1, lastm),
+            "mar_medium": _recall_mean(2, lastm),
+            "mar_large": _recall_mean(3, lastm),
+            "map_50": _precision_mean(0, t50) if t50 is not None else jnp.float32(-1.0),
+            "map_75": _precision_mean(0, t75) if t75 is not None else jnp.float32(-1.0),
+        }
+        for m_idx, mdet in enumerate(max_detection_thresholds):
+            out[f"mar_{mdet}"] = _recall_mean(0, m_idx)
+
+        pc_q = q[:, :, 0, :]  # (K, R, T)
+        out["map_per_class"] = jnp.where(valid_cell[:, 0], pc_q.sum((1, 2)) / (R * T), -1.0)
+        out["mar_per_class"] = jnp.where(valid_cell[:, 0], recall[:, 0, :, lastm].sum(1) / T, -1.0)
+
+        det_seen = jax.ops.segment_sum(
+            dvalid.astype(jnp.int32), jnp.where(dvalid, d_lab, K), num_segments=K + 1
+        )[:K]
+        gt_seen = jax.ops.segment_sum(
+            gvalid.astype(jnp.int32), jnp.where(gvalid, g_lab, K), num_segments=K + 1
+        )[:K]
+        out["present"] = (det_seen + gt_seen) > 0
+        return out
+
+    return fn

@@ -108,7 +108,12 @@ def _get_features(data, modality: str, model) -> jnp.ndarray:
     raise ValueError(f"invalid modality {modality}")
 
 
-def _clip_score_update(source, target, model) -> Tuple[jnp.ndarray, int]:
+def _clip_score_features(source, target, model) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Validate one batch and run the (host/eager) embedder: ``(N, D)`` feature pair.
+
+    This is the non-jittable half of the CLIPScore update — the metric class calls it
+    from ``_prepare_inputs`` so the scoring half (normalize + paired cosine) stays
+    inside the jitted, AOT-cacheable "update" program."""
     source_modality = _detect_modality(source)
     target_modality = _detect_modality(target)
     source_data = _process_image_data(source) if source_modality == "image" else _process_text_data(source)
@@ -118,12 +123,16 @@ def _clip_score_update(source, target, model) -> Tuple[jnp.ndarray, int]:
             "Expected the number of source and target examples to be the same but got "
             f"{len(source_data)} and {len(target_data)}"
         )
-    source_features = _get_features(source_data, source_modality, model)
-    target_features = _get_features(target_data, target_modality, model)
+    return _get_features(source_data, source_modality, model), _get_features(target_data, target_modality, model)
+
+
+def _clip_score_update(source, target, model) -> Tuple[jnp.ndarray, int]:
+    source_features, target_features = _clip_score_features(source, target, model)
+    n_samples = source_features.shape[0]
     source_features = source_features / jnp.linalg.norm(source_features, axis=-1, keepdims=True)
     target_features = target_features / jnp.linalg.norm(target_features, axis=-1, keepdims=True)
     score = 100 * (source_features * target_features).sum(axis=-1)
-    return score, len(source_data)
+    return score, n_samples
 
 
 def clip_score(

@@ -157,9 +157,15 @@ def bert_score(
     baseline_path: Optional[str] = None,
     baseline_url: Optional[str] = None,
     truncation: bool = False,
+    score_fn: Optional[Callable] = None,
 ) -> Dict[str, jnp.ndarray]:
     """BERTScore precision/recall/F1 via greedy cosine matching of contextual
-    embeddings. Multiple references per prediction score as the best F1."""
+    embeddings. Multiple references per prediction score as the best F1.
+
+    ``score_fn(p_emb, p_scale, t_emb, t_scale) -> (precision, recall, f1)`` replaces
+    the default matching pipeline (:func:`_score_pairs`) — the seam the ``BERTScore``
+    metric class uses to route scoring through its jitted, AOT-cacheable "escore"
+    dispatch program instead of tracing fresh every compute."""
     if all_layers:
         raise ValueError("`all_layers=True` is only meaningful with per-layer baselines; use num_layers instead.")
     if rescale_with_baseline:
@@ -183,6 +189,7 @@ def bert_score(
                     preds, flat_refs, model_name_or_path, num_layers, all_layers, model, user_tokenizer,
                     user_forward_fn, verbose, idf, device, max_length, batch_size, num_threads,
                     False, lang, rescale_with_baseline, baseline_path, baseline_url, truncation,
+                    score_fn=score_fn,
                 )
             )
         f1s = jnp.stack([r["f1"] for r in results])
@@ -213,7 +220,7 @@ def bert_score(
     target_len = max(preds_tok["input_ids"].shape[1], target_tok["input_ids"].shape[1])
     p_emb, p_scale = _embed(forward, preds_tok["input_ids"], preds_tok["attention_mask"], target_len, idf, idf_lookup, batch_size)
     t_emb, t_scale = _embed(forward, target_tok["input_ids"], target_tok["attention_mask"], target_len, idf, idf_lookup, batch_size)
-    precision, recall, f1 = _score_pairs(p_emb, p_scale, t_emb, t_scale)
+    precision, recall, f1 = (score_fn or _score_pairs)(p_emb, p_scale, t_emb, t_scale)
     out = {"precision": precision, "recall": recall, "f1": f1}
     if return_hash:
         out["hash"] = f"{model_name_or_path}_L{num_layers}_idf={idf}"

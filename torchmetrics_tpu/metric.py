@@ -1820,10 +1820,21 @@ class Metric:
                     f"the {tag!r} program is parameterized by its window geometry and is "
                     "built by its owner (SlidingWindow / ServingEngine(window=)) first"
                 )
+        elif tag == "mapeval" or tag == "escore":
+            # re-homed evaluator programs: parameterized by metric config (mAP
+            # capacity/classes geometry, embedder padding buckets) and built
+            # lazily by the owning metric before its first dispatch
+            primary = self._jit_cache.get(tag)
+            if primary is None:
+                raise TorchMetricsUserError(
+                    f"the {tag!r} program is parameterized by its owner's configuration and is "
+                    "built by the owning metric (DeviceMeanAveragePrecision / BERTScore) first"
+                )
         else:
             raise ValueError(
                 f"Unknown dispatch tag {tag!r}; expected 'update', 'forward', 'vupdate', "
-                "'wupdate', 'wdual', 'wstack', 'vwupdate', 'vwcompute', 'dupdate' or 'vcompute'"
+                "'wupdate', 'wdual', 'wstack', 'vwupdate', 'vwcompute', 'dupdate', "
+                "'vcompute', 'mapeval' or 'escore'"
             )
         raw = self._jit_cache.get(f"{tag}.raw")
         if raw is None or not hasattr(primary, "lower"):

@@ -2,18 +2,29 @@
 
 from __future__ import annotations
 
-from typing import Any, Union
+from typing import Any, Dict, Tuple, Union
 
 import jax.numpy as jnp
+import numpy as np
 
-from ..functional.multimodal.clip_score import _clip_score_update, _resolve_clip
-from ..metric import HostMetric
+from ..functional.detection._map_eval import _bucket
+from ..functional.multimodal.clip_score import _clip_score_features, _resolve_clip
+from ..metric import Metric
 
 
-class CLIPScore(HostMetric):
+class CLIPScore(Metric):
     """Running-mean CLIP score (two sum states; sync is two psums). The embedder is a
     HF checkpoint (local cache only — no egress) or a custom object with
-    ``get_image_features``/``get_text_features`` (e.g. a jitted flax CLIP apply)."""
+    ``get_image_features``/``get_text_features`` (e.g. a jitted flax CLIP apply).
+
+    Re-homed from the eager host path: the embedder runs in ``_prepare_inputs`` (it is
+    arbitrary host code), but the scoring half — normalize + paired cosine x 100 —
+    traces into the standard donated "update" program, so it jit-compiles once per
+    bucketed batch size and AOT-caches like any device metric. Feature batches are
+    zero-padded to power-of-two buckets with an explicit validity mask; padded rows
+    score 0 and are excluded from the sample count.
+    """
+
     # extractor attribute FeatureShare dedupes (reference declares the same name)
     feature_network: str = "model"
 
@@ -33,9 +44,25 @@ class CLIPScore(HostMetric):
         self.add_state("score", jnp.zeros(()), dist_reduce_fx="sum")
         self.add_state("n_samples", jnp.zeros((), jnp.int32), dist_reduce_fx="sum")
 
-    def _host_batch_state(self, source, target):
-        score, n_samples = _clip_score_update(source, target, self.model)
-        return {"score": score.sum(), "n_samples": jnp.asarray(n_samples, jnp.int32)}
+    def _prepare_inputs(self, source, target) -> Tuple[tuple, dict]:
+        src, tgt = _clip_score_features(source, target, self.model)
+        src = np.asarray(src, np.float32)
+        tgt = np.asarray(tgt, np.float32)
+        n = src.shape[0]
+        cap = _bucket(max(n, 1), floor=4)
+        src_p = np.zeros((cap, src.shape[1]), np.float32)
+        tgt_p = np.zeros((cap, tgt.shape[1]), np.float32)
+        mask = np.zeros((cap,), np.float32)
+        src_p[:n], tgt_p[:n], mask[:n] = src, tgt, 1.0
+        return (jnp.asarray(src_p), jnp.asarray(tgt_p), jnp.asarray(mask)), {}
+
+    def _batch_state(self, source_features, target_features, mask) -> Dict[str, jnp.ndarray]:
+        # the norm guard only engages on zero-padded rows (real embeddings have
+        # norms far above 1e-8); padded rows then contribute exactly 0
+        s = source_features / jnp.maximum(jnp.linalg.norm(source_features, axis=-1, keepdims=True), 1e-8)
+        t = target_features / jnp.maximum(jnp.linalg.norm(target_features, axis=-1, keepdims=True), 1e-8)
+        score = (100 * (s * t).sum(axis=-1) * mask).sum()
+        return {"score": score, "n_samples": mask.sum().astype(jnp.int32)}
 
     def _compute(self, state):
         return jnp.maximum(state["score"] / state["n_samples"], 0.0)

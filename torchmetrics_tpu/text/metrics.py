@@ -7,8 +7,9 @@ which keep per-sentence cat rows like the reference.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Optional, Sequence, Tuple, Union
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple, Union
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -33,6 +34,7 @@ from ..functional.text.rouge import (
 from ..functional.text.sacre_bleu import AVAILABLE_TOKENIZERS, _SacreBLEUTokenizer
 from ..functional.text.squad import _squad_compute, _squad_input_check, _squad_update
 from ..metric import HostMetric, Metric
+from ..utilities.exceptions import TorchMetricsUserError
 
 
 class BLEUScore(HostMetric):
@@ -637,7 +639,16 @@ class ExtendedEditDistance(HostMetric):
 class BERTScore(HostMetric):
     """BERTScore (reference ``text/bert.py:59``): cat states of tokenized
     input_ids/attention_mask (reference ``text/bert.py:220``); the embedding +
-    matching pipeline runs at compute."""
+    matching pipeline runs at compute.
+
+    The matching half — greedy cosine alignment over normalized embeddings — is
+    re-homed onto the jitted "escore" dispatch program: embeddings are zero-padded
+    to power-of-two (batch, token) buckets so repeat computes reuse one compiled
+    program per bucket signature, and an active AOT plane serves it from disk on
+    warm boot. Zero padding is exactly parity-safe: the special-token mask already
+    zeroes at least one position per row, so all-zero candidate columns are already
+    in every row's max, and padded scale weights contribute nothing to the weighted
+    sums. The embedder itself (arbitrary host code) stays eager."""
 
     is_differentiable = False
     higher_is_better = True
@@ -735,7 +746,118 @@ class BERTScore(HostMetric):
             max_length=self.max_length, batch_size=self.batch_size, return_hash=self.return_hash,
             lang=self.lang, rescale_with_baseline=self.rescale_with_baseline,
             baseline_path=self.baseline_path, truncation=self.truncation,
+            score_fn=self._dispatch_escore,
         )
+
+    # --------------------------------------------------- jitted matching ("escore")
+
+    def _get_escore_fn(self):
+        if "escore" not in self._jit_cache:
+            from ..functional.text.bert import _score_pairs
+
+            def raw(tensor_state, n, p_emb, p_scale, t_emb, t_scale):
+                # tensor_state/n are the dispatch convention's donated slots —
+                # this metric has no tensor states, so both are empty/unused
+                return _score_pairs(p_emb, p_scale, t_emb, t_scale)
+
+            self._jit_cache["escore.raw"] = raw  # undonated source for _aot_program
+            self._jit_cache["escore"] = jax.jit(raw) if self._enable_jit else raw
+        return self._jit_cache["escore"]
+
+    @staticmethod
+    def _pad_escore(p_emb, p_scale, t_emb, t_scale) -> Tuple[tuple, int]:
+        """Zero-pad one scoring batch to power-of-two (batch, token) buckets."""
+        from ..functional.detection._map_eval import _bucket
+
+        p_emb = np.asarray(p_emb, np.float32)
+        t_emb = np.asarray(t_emb, np.float32)
+        p_scale = np.asarray(p_scale, np.float32)
+        t_scale = np.asarray(t_scale, np.float32)
+        batch, length = p_emb.shape[0], max(p_emb.shape[1], t_emb.shape[1])
+        b_cap = _bucket(max(batch, 1), floor=4)
+        l_cap = _bucket(max(length, 1), floor=8)
+        pad3 = lambda a: np.pad(a, ((0, b_cap - a.shape[0]), (0, l_cap - a.shape[1]), (0, 0)))
+        pad2 = lambda a: np.pad(a, ((0, b_cap - a.shape[0]), (0, l_cap - a.shape[1])))
+        padded = (
+            jnp.asarray(pad3(p_emb)), jnp.asarray(pad2(p_scale)),
+            jnp.asarray(pad3(t_emb)), jnp.asarray(pad2(t_scale)),
+        )
+        return padded, batch
+
+    def _dispatch_escore(self, p_emb, p_scale, t_emb, t_scale):
+        """``score_fn`` seam of :func:`bert_score`: pad to buckets, run the jitted
+        escore program through the standard dispatch stack, slice real rows back."""
+        (pe, ps, te, ts), batch = self._pad_escore(p_emb, p_scale, t_emb, t_scale)
+        fn = self._get_escore_fn()
+        precision, recall, f1 = self._donation_safe_dispatch(
+            "escore", lambda t, n: fn(t, n, pe, ps, te, ts), {},
+            inputs=((pe, ps, te, ts), {}), jitted=fn,
+        )
+        return precision[:batch], recall[:batch], f1[:batch]
+
+    # ------------------------------------------------------------------ warm start
+
+    def precompile(
+        self,
+        *example_inputs: Any,
+        tags: Sequence[str] = ("escore",),
+        cache_dir: Optional[str] = None,
+        force: bool = False,
+        **example_kwargs: Any,
+    ) -> Dict[str, Any]:
+        """Ahead-of-traffic compile of the ``"escore"`` matching program.
+
+        ``example_inputs`` is one ``(preds, target)`` sentence batch; it is
+        tokenized and embedded exactly as ``compute`` would, and the resulting
+        bucketed signature is compiled into the active (or ``cache_dir``) AOT
+        cache. Other tags fall back to the host no-op report."""
+        tags = tuple(tags)
+        report: Dict[str, Any] = {}
+        rest = tuple(t for t in tags if t != "escore")
+        if rest:
+            report.update(super().precompile(*example_inputs, tags=rest, **example_kwargs))
+        if "escore" not in tags:
+            return report
+        if cache_dir is not None:
+            from .. import aot as _aot
+
+            plane = _aot.AotPlane(_aot.AotConfig(cache_dir=cache_dir))
+        else:
+            from ..aot import _ACTIVE as plane
+
+            if plane is None:
+                raise TorchMetricsUserError(
+                    "precompile needs an active AOT plane — call "
+                    "torchmetrics_tpu.aot.enable(cache_dir) first, or pass cache_dir=."
+                )
+        if not self._enable_jit:
+            report["escore"] = {"status": "skipped", "reason": "jit disabled on this metric"}
+            return report
+        from ..functional.text.bert import _embed, _idf_weights
+
+        preds, target = example_inputs
+        preds = [preds] if isinstance(preds, str) else list(preds)
+        target = [target] if isinstance(target, str) else list(target)
+        p = self._tokenize(self.tokenizer, preds, self.max_length, self.truncation)
+        t = self._tokenize(self.tokenizer, target, self.max_length, self.truncation)
+        # state rows are padded to max_length, so compute always scores at that width
+        pad = lambda arr: np.pad(arr, ((0, 0), (0, self.max_length - arr.shape[1])))
+        p = {k: pad(v) for k, v in p.items()}
+        t = {k: pad(v) for k, v in t.items()}
+        idf_lookup = _idf_weights(t["input_ids"], t["attention_mask"]) if self.idf else None
+        p_emb, p_scale = _embed(
+            self._forward, p["input_ids"], p["attention_mask"], self.max_length,
+            self.idf, idf_lookup, self.batch_size,
+        )
+        t_emb, t_scale = _embed(
+            self._forward, t["input_ids"], t["attention_mask"], self.max_length,
+            self.idf, idf_lookup, self.batch_size,
+        )
+        (pe, ps, te, ts), _ = self._pad_escore(p_emb, p_scale, t_emb, t_scale)
+        self._get_escore_fn()
+        fn, donate = self._aot_program("escore")
+        report["escore"] = plane.precompile_program(self, "escore", fn, donate, {}, (pe, ps, te, ts), {}, force=force)
+        return report
 
     def __hash__(self) -> int:
         return hash((self.__class__.__name__, id(self)))
